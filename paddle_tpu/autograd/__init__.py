@@ -1,0 +1,20 @@
+from . import backward as backward_mode
+from .backward import grad, run_backward
+from .engine import GradNode, apply_op, make_op
+from .grad_mode import enable_grad, is_grad_enabled, no_grad, set_grad_enabled
+
+__all__ = [
+    "grad",
+    "run_backward",
+    "GradNode",
+    "apply_op",
+    "make_op",
+    "no_grad",
+    "enable_grad",
+    "is_grad_enabled",
+    "set_grad_enabled",
+    "PyLayer",
+    "PyLayerContext",
+]
+
+from .py_layer import PyLayer, PyLayerContext  # noqa: E402

@@ -1,0 +1,322 @@
+"""Backward engine: topological walk over GradNodes.
+
+Parity target: egr::Backward / RunBackward (reference:
+paddle/fluid/eager/backward.cc:428, :105 — in-degree map :23,
+GradTensorHolder accumulation, GeneralGrad for partial graphs) and
+paddle.grad (python/paddle/autograd/backward_mode.py).
+"""
+from __future__ import annotations
+
+from collections import defaultdict, deque
+
+import jax
+import jax.numpy as jnp
+
+from .engine import GradNode, _is_diff_dtype
+from .grad_mode import no_grad
+
+
+def _ones_like(data):
+    return jnp.ones(data.shape, data.dtype)
+
+
+def _accum(a, b):
+    return b if a is None else a + b
+
+
+class _Holder:
+    """GradTensorHolder parity: accumulates per-output cotangents of a node."""
+
+    def __init__(self, node: GradNode):
+        self.slots = [None] * len(node.out_avals)
+
+    def add(self, idx, value):
+        self.slots[idx] = _accum(self.slots[idx], value)
+
+
+def run_backward(
+    tensors,
+    grad_tensors=None,
+    retain_graph: bool = False,
+    create_graph: bool = False,
+    inputs=None,
+    allow_unused: bool = False,
+    accumulate_grad: bool = True,
+    no_grad_vars=None,
+):
+    """Run backward from ``tensors``. If ``inputs`` is given, return their
+    grads (paddle.grad semantics, only_inputs=True) instead of accumulating
+    into every leaf ``.grad``."""
+    from ..tensor.tensor import Tensor
+
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif isinstance(grad_tensors, Tensor):
+        grad_tensors = [grad_tensors]
+    if len(grad_tensors) != len(tensors):
+        raise ValueError("grad_tensors must match tensors in length")
+    no_grad_ids = {id(t) for t in (no_grad_vars or [])}
+
+    if create_graph:
+        retain_graph = True
+
+    # --- Seed cotangents ---
+    holders: dict[GradNode, _Holder] = {}
+    leaf_results: dict[int, object] = {}  # id(tensor) -> grad data/Tensor
+    target_ids = None
+    target_edges = {}  # id(tensor) -> ("leaf", t) | ("node", node, idx)
+    if inputs is not None:
+        target_ids = set()
+        for t in inputs:
+            target_ids.add(id(t))
+            if t._grad_node is not None:
+                target_edges[id(t)] = ("node", t._grad_node, t._out_index)
+            else:
+                target_edges[id(t)] = ("leaf", t)
+
+    def wrap(value):
+        # In create_graph mode cotangents flow as Tensors (recorded); else raw.
+        if create_graph and not isinstance(value, Tensor):
+            return Tensor(value, stop_gradient=True)
+        return value
+
+    def unwrap(value):
+        return value._data if isinstance(value, Tensor) else value
+
+    seed_nodes = []
+    for t, g in zip(tensors, grad_tensors):
+        if t.stop_gradient and t._grad_node is None:
+            raise RuntimeError(
+                "Tensor.backward() on a tensor with stop_gradient=True and no graph"
+            )
+        if g is None:
+            # paddle semantics: a None grad_tensor seeds ones for ANY shape
+            # (reference: tensor_patch_methods.py backward docstring).
+            g_data = _ones_like(t._data)
+        else:
+            g_data = g._data if isinstance(g, Tensor) else jnp.asarray(g)
+        node = t._grad_node
+        if node is None:
+            # Leaf root: grad is the seed itself.
+            if id(t) not in no_grad_ids:
+                if target_ids is None or id(t) in target_ids:
+                    leaf_results[id(t)] = _accum(leaf_results.get(id(t)), wrap(g_data))
+            continue
+        if node not in holders:
+            holders[node] = _Holder(node)
+            seed_nodes.append(node)
+        holders[node].add(t._out_index, wrap(g_data))
+
+    # --- Discover reachable graph (DFS over producer edges) ---
+    reachable: set[GradNode] = set()
+    stack = list(holders.keys())
+    while stack:
+        n = stack.pop()
+        if n in reachable:
+            continue
+        reachable.add(n)
+        for kind, *rest in n.input_edges:
+            if kind == "node":
+                producer = rest[0]
+                if producer not in reachable:
+                    stack.append(producer)
+
+    # --- Prune to nodes that can reach a target (GeneralGrad parity) ---
+    if target_ids is not None:
+        target_node_outs = {
+            (edge[1], edge[2]) for edge in target_edges.values() if edge[0] == "node"
+        }
+        target_leaf_ids = {
+            id(edge[1]) for edge in target_edges.values() if edge[0] == "leaf"
+        }
+        # A node is "active" if one of its input edges hits a target leaf, a
+        # target (node,out) pair, or an active producer.
+        active: dict[GradNode, bool] = {}
+
+        def is_active(n: GradNode) -> bool:
+            if n in active:
+                return active[n]
+            active[n] = False  # cycle guard (graph is a DAG)
+            result = False
+            for kind, *rest in n.input_edges:
+                if kind == "leaf":
+                    if id(rest[0]) in target_leaf_ids:
+                        result = True
+                        break
+                else:
+                    producer, out_idx = rest
+                    if (producer, out_idx) in target_node_outs or is_active(producer):
+                        result = True
+                        break
+            active[n] = result
+            return result
+
+        reachable = {n for n in reachable if is_active(n)}
+        # Seed-node holders stay: their slots may hold grads for targets even
+        # if the node itself is pruned.
+
+    # --- In-degree: count consumer edges within the graph ---
+    pending = defaultdict(int)
+    for n in reachable:
+        for kind, *rest in n.input_edges:
+            if kind == "node" and rest[0] in reachable:
+                pending[rest[0]] += 1
+
+    queue = deque(n for n in reachable if pending[n] == 0 and n in holders)
+    processed: list[GradNode] = []
+
+    def fire_tensor_hooks(node, idx, grad):
+        ref = node.out_tensor_refs[idx]
+        t = ref() if ref is not None else None
+        if t is not None and t._hooks:
+            for hook in list(t._hooks.values()):
+                wrapped = grad if isinstance(grad, Tensor) else Tensor(grad, stop_gradient=True)
+                new = hook(wrapped)
+                if new is not None:
+                    grad = new if create_graph else unwrap(new)
+        return grad
+
+    def accumulate_leaf(t, grad):
+        if id(t) in no_grad_ids:
+            return
+        if t._hooks:
+            for hook in list(t._hooks.values()):
+                wrapped = grad if isinstance(grad, Tensor) else Tensor(grad, stop_gradient=True)
+                new = hook(wrapped)
+                if new is not None:
+                    grad = new if create_graph else unwrap(new)
+        if target_ids is not None:
+            if id(t) in target_ids:
+                leaf_results[id(t)] = _accum(leaf_results.get(id(t)), grad)
+            return
+        if accumulate_grad:
+            grad_t = grad if isinstance(grad, Tensor) else Tensor(grad, stop_gradient=True)
+            if t.grad is None:
+                t.grad = grad_t
+            else:
+                t.grad = Tensor(t.grad._data + grad_t._data, stop_gradient=True) \
+                    if not create_graph else t.grad + grad_t
+
+    while queue:
+        node = queue.popleft()
+        processed.append(node)
+        holder = holders.get(node) or _Holder(node)
+        # Fill missing output cotangents with zeros; fire tensor hooks.
+        cots = []
+        zeros = None
+        for i, slot in enumerate(holder.slots):
+            if slot is None:
+                if zeros is None:
+                    zeros = node.zero_cotangents()
+                val = zeros[i]
+            else:
+                val = fire_tensor_hooks(node, i, slot)
+            cots.append(val)
+
+        if create_graph:
+            cot_tensors = [
+                c if isinstance(c, Tensor) else Tensor(c, stop_gradient=True)
+                for c, aval in zip(cots, node.out_avals)
+                if _is_diff_dtype(aval.dtype)
+            ]
+            in_grads = node.run_vjp_recorded(cot_tensors)
+            if not isinstance(in_grads, (tuple, list)):
+                in_grads = (in_grads,)
+        else:
+            with no_grad():
+                raw = [unwrap(c) for c in cots]
+                in_grads = node.run_vjp(raw)
+
+        for (kind, *rest), grad in zip(node.input_edges, in_grads):
+            if grad is None:
+                continue
+            if kind == "leaf":
+                accumulate_leaf(rest[0], grad)
+            else:
+                producer, out_idx = rest
+                # (grads for intermediate targets are collected from holders
+                #  at the end — nothing special to do here)
+                if producer in reachable:
+                    if producer not in holders:
+                        holders[producer] = _Holder(producer)
+                    holders[producer].add(out_idx, grad)
+                    pending[producer] -= 1
+                    if pending[producer] == 0:
+                        queue.append(producer)
+                elif producer in holders or target_ids is not None:
+                    # Pruned producer may still hold a target output slot.
+                    if producer not in holders:
+                        holders[producer] = _Holder(producer)
+                    holders[producer].add(out_idx, grad)
+
+    def _release():
+        if not retain_graph:
+            for node in processed:
+                node.release()
+
+    if target_ids is None:
+        _release()
+        return None
+
+    # --- Collect target grads (before release, so an unused-input error
+    #     leaves the graph intact for a retry with allow_unused=True) ---
+    results = []
+    for t in inputs:
+        edge = target_edges[id(t)]
+        if edge[0] == "leaf":
+            grad = leaf_results.get(id(t))
+        else:
+            _, node, idx = edge
+            holder = holders.get(node)
+            grad = holder.slots[idx] if holder is not None else None
+        if grad is None:
+            if not allow_unused:
+                raise RuntimeError(
+                    "One of the differentiated tensors appears to not have "
+                    "been used in the graph (set allow_unused=True to allow this)"
+                )
+            results.append(None)
+        else:
+            from ..tensor.tensor import Tensor as _T
+
+            if not isinstance(grad, _T):
+                grad = _T(grad, stop_gradient=not create_graph)
+            elif create_graph:
+                pass  # already recorded
+            results.append(grad)
+    _release()
+    return results
+
+
+def grad(
+    outputs,
+    inputs,
+    grad_outputs=None,
+    retain_graph=None,
+    create_graph: bool = False,
+    only_inputs: bool = True,
+    allow_unused: bool = False,
+    no_grad_vars=None,
+):
+    """paddle.grad parity (reference: python/paddle/base/dygraph/base.py:grad)."""
+    from ..tensor.tensor import Tensor
+
+    if isinstance(outputs, Tensor):
+        outputs = [outputs]
+    if isinstance(inputs, Tensor):
+        inputs = [inputs]
+    if not only_inputs:
+        raise NotImplementedError("only_inputs=False is deprecated in the reference")
+    if retain_graph is None:
+        retain_graph = create_graph
+    return run_backward(
+        outputs,
+        grad_outputs,
+        retain_graph=retain_graph,
+        create_graph=create_graph,
+        inputs=inputs,
+        allow_unused=allow_unused,
+        no_grad_vars=no_grad_vars,
+    )

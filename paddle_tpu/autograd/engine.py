@@ -1,0 +1,231 @@
+"""Define-by-run autograd over JAX.
+
+This is the TPU-native answer to paddle's eager engine (reference:
+paddle/fluid/eager/ — GradNodeBase grad_node_info.h:197, TensorWrapper
+tensor_wrapper.h, generated ad_funcs): instead of codegen'd per-op C++ grad
+nodes, every op application records ONE generic ``GradNode`` whose backward is
+the ``jax.vjp`` of the op's pure function. Eager execution *is* jax eager
+execution; under ``jax.jit`` tracing the same tape works on tracers, so jit and
+eager share one code path (SURVEY.md §7.1 "one IR").
+"""
+from __future__ import annotations
+
+import weakref
+from typing import Any, Callable
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..framework import flags
+from .grad_mode import is_grad_enabled
+
+# Hook installed by paddle_tpu.amp to auto-cast inputs per-op (O1/O2).
+# Signature: amp_cast_hook(op_name, leaves) -> leaves
+amp_cast_hook: Callable | None = None
+
+# Hook installed by the profiler to wrap op execution in RecordEvent ranges.
+op_profile_hook: Callable | None = None
+
+
+def _is_tensor(x) -> bool:
+    from ..tensor.tensor import Tensor
+
+    return isinstance(x, Tensor)
+
+
+def _float0_zeros(aval):
+    return np.zeros(aval.shape, dtype=jax.dtypes.float0)
+
+
+def _is_diff_dtype(dtype) -> bool:
+    return jnp.issubdtype(dtype, jnp.floating) or jnp.issubdtype(dtype, jnp.complexfloating)
+
+
+class GradNode:
+    """One recorded op application.
+
+    ``vjp_fn`` is the jax.vjp closure (first-order fast path). For
+    ``create_graph=True`` backward, the node re-applies the vjp *through the
+    tape* using the saved pure function + input tensors (TensorWrapper parity),
+    so higher-order gradients chain correctly.
+    """
+
+    __slots__ = (
+        "name",
+        "vjp_fn",
+        "pure_fn",
+        "input_tensors",
+        "input_edges",
+        "out_avals",
+        "out_tensor_refs",
+        "released",
+        "__weakref__",
+    )
+
+    def __init__(self, name, vjp_fn, pure_fn, input_tensors, out_avals):
+        self.name = name
+        self.vjp_fn = vjp_fn
+        self.pure_fn = pure_fn
+        self.input_tensors = input_tensors  # strong refs, like TensorWrapper
+        self.out_avals = out_avals
+        self.out_tensor_refs: list = [None] * len(out_avals)
+        self.released = False
+        # edges: per diff-input, either ("node", producer, out_idx) or ("leaf", tensor)
+        edges = []
+        for t in input_tensors:
+            if t._grad_node is not None:
+                edges.append(("node", t._grad_node, t._out_index))
+            else:
+                edges.append(("leaf", t))
+        self.input_edges = edges
+
+    def release(self):
+        self.vjp_fn = None
+        self.pure_fn = None
+        self.input_tensors = None
+        self.released = True
+
+    def zero_cotangents(self):
+        cots = []
+        for aval in self.out_avals:
+            if _is_diff_dtype(aval.dtype):
+                cots.append(jnp.zeros(aval.shape, aval.dtype))
+            else:
+                cots.append(_float0_zeros(aval))
+        return cots
+
+    def run_vjp(self, cotangents):
+        """First-order backward: raw arrays in, raw arrays out."""
+        if self.released:
+            raise RuntimeError(
+                f"GradNode for op '{self.name}' has been released. "
+                "Call backward(retain_graph=True) to backward a graph twice."
+            )
+        return self.vjp_fn(tuple(cotangents))
+
+    def run_vjp_recorded(self, cotangent_tensors):
+        """Higher-order backward: re-derive the vjp through the tape so the
+        gradient computation itself is differentiable (create_graph=True)."""
+        if self.released:
+            raise RuntimeError(
+                f"GradNode for op '{self.name}' has been released; cannot "
+                "create_graph over a released graph."
+            )
+        pure_fn = self.pure_fn
+        n_in = len(self.input_tensors)
+        non_diff = [not _is_diff_dtype(a.dtype) for a in self.out_avals]
+        avals = self.out_avals
+
+        def grad_fn(*primals_and_cots):
+            primals = primals_and_cots[:n_in]
+            cots = list(primals_and_cots[n_in:])
+            # Re-insert float0 zeros for non-differentiable outputs.
+            full = []
+            ci = 0
+            for i, nd in enumerate(non_diff):
+                if nd:
+                    full.append(_float0_zeros(avals[i]))
+                else:
+                    full.append(cots[ci])
+                    ci += 1
+            _, vjp_fn = jax.vjp(pure_fn, *primals)
+            return vjp_fn(tuple(full))
+
+        diff_cots = [c for c, nd in zip(cotangent_tensors, non_diff) if not nd]
+        return apply_op(self.name + "_grad", grad_fn, *self.input_tensors, *diff_cots)
+
+
+def _check_nan_inf(name, arrays):
+    for a in arrays:
+        if isinstance(a, jax.core.Tracer) or not _is_diff_dtype(a.dtype):
+            continue
+        if bool(jnp.any(~jnp.isfinite(a))):
+            msg = f"Operator {name} output contains NaN/Inf"
+            if flags.flag("check_nan_inf_level") == 0:
+                raise FloatingPointError(msg)
+            print("WARNING:", msg)
+
+
+def apply_op(name: str, fn: Callable, *args, **kwargs):
+    """Execute ``fn`` (a pure jax function over unwrapped args) on Tensor
+    arguments, recording a GradNode when grad is required.
+
+    Tensors may appear anywhere in the (args, kwargs) pytree. Non-Tensor leaves
+    and non-differentiable Tensors are closed over; the vjp runs only over
+    differentiable (floating, stop_gradient=False) inputs.
+    """
+    from ..tensor.tensor import Tensor
+
+    leaves, treedef = jax.tree.flatten((args, kwargs), is_leaf=_is_tensor)
+
+    if amp_cast_hook is not None:
+        leaves = amp_cast_hook(name, leaves)
+
+    grad_on = is_grad_enabled()
+    diff_pos = []
+    if grad_on:
+        for i, leaf in enumerate(leaves):
+            if (
+                isinstance(leaf, Tensor)
+                and not leaf.stop_gradient
+                and _is_diff_dtype(leaf._data.dtype)
+            ):
+                diff_pos.append(i)
+
+    out_treedef_box = [None]
+
+    def rebuild(diff_datas):
+        rebuilt = list(leaves)
+        for p, d in zip(diff_pos, diff_datas):
+            rebuilt[p] = d
+        rebuilt = [l._data if isinstance(l, Tensor) else l for l in rebuilt]
+        a, kw = jax.tree.unflatten(treedef, rebuilt)
+        return a, kw
+
+    def pure_fn(*diff_datas):
+        a, kw = rebuild(diff_datas)
+        out = fn(*a, **kw)
+        out_leaves, out_td = jax.tree.flatten(out)
+        out_treedef_box[0] = out_td
+        return tuple(out_leaves)
+
+    if op_profile_hook is not None:
+        op_profile_hook(name)
+
+    node = None
+    if diff_pos:
+        diff_datas = [leaves[p]._data for p in diff_pos]
+        out_flat, vjp_fn = jax.vjp(pure_fn, *diff_datas)
+        out_avals = [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in out_flat]
+        node = GradNode(name, vjp_fn, pure_fn, [leaves[p] for p in diff_pos], out_avals)
+    else:
+        out_flat = pure_fn()
+
+    if flags.flag("check_nan_inf"):
+        _check_nan_inf(name, out_flat)
+
+    out_tensors = []
+    for i, data in enumerate(out_flat):
+        if node is not None and _is_diff_dtype(data.dtype):
+            t = Tensor(data, stop_gradient=False)
+            t._grad_node = node
+            t._out_index = i
+            node.out_tensor_refs[i] = weakref.ref(t)
+        else:
+            t = Tensor(data, stop_gradient=True)
+        out_tensors.append(t)
+
+    result = jax.tree.unflatten(out_treedef_box[0], out_tensors)
+    return result
+
+
+def make_op(name: str, fn: Callable) -> Callable:
+    """Wrap a pure jax function as a framework op."""
+
+    def op(*args, **kwargs):
+        return apply_op(name, fn, *args, **kwargs)
+
+    op.__name__ = name
+    return op

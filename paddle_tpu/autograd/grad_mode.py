@@ -1,0 +1,71 @@
+"""Gradient-recording mode switches.
+
+Parity: paddle.no_grad / paddle.enable_grad / paddle.set_grad_enabled /
+paddle.is_grad_enabled (reference: python/paddle/base/dygraph/base.py).
+"""
+from __future__ import annotations
+
+import functools
+import threading
+
+
+class _GradState(threading.local):
+    def __init__(self):
+        self.enabled = True
+
+
+_state = _GradState()
+
+
+def is_grad_enabled() -> bool:
+    return _state.enabled
+
+
+class set_grad_enabled:
+    """Context manager / function to toggle grad recording."""
+
+    def __init__(self, mode: bool):
+        self._mode = bool(mode)
+        self._prev = _state.enabled
+        _state.enabled = self._mode
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        _state.enabled = self._prev
+        return False
+
+
+class _DecoratorContextManager:
+    def __call__(self, func):
+        @functools.wraps(func)
+        def wrapper(*args, **kwargs):
+            with self.__class__():
+                return func(*args, **kwargs)
+
+        return wrapper
+
+
+class no_grad(_DecoratorContextManager):
+    """Disable autograd recording (usable as context manager or decorator)."""
+
+    def __enter__(self):
+        self._prev = _state.enabled
+        _state.enabled = False
+        return self
+
+    def __exit__(self, *exc):
+        _state.enabled = self._prev
+        return False
+
+
+class enable_grad(_DecoratorContextManager):
+    def __enter__(self):
+        self._prev = _state.enabled
+        _state.enabled = True
+        return self
+
+    def __exit__(self, *exc):
+        _state.enabled = self._prev
+        return False

@@ -1,0 +1,175 @@
+"""PyLayer: user-defined autograd ops.
+
+Parity: paddle.autograd.PyLayer (reference: python/paddle/autograd/py_layer.py:270,
+C++ side paddle/fluid/eager/pylayer/). The custom backward composes framework
+ops, so create_graph chains through it naturally.
+"""
+from __future__ import annotations
+
+import jax
+
+from .engine import GradNode, _is_diff_dtype
+from .grad_mode import enable_grad, is_grad_enabled, no_grad
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self.materialize_grads = True
+        self._non_differentiable = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    def saved_tensor(self):
+        return self._saved
+
+    def mark_non_differentiable(self, *tensors):
+        self._non_differentiable = tensors
+
+    def set_materialize_grads(self, value: bool):
+        self.materialize_grads = bool(value)
+
+
+class _PyLayerNode(GradNode):
+    __slots__ = ("ctx", "backward_fn", "all_inputs", "diff_positions")
+
+    def __init__(self, name, ctx, backward_fn, all_tensor_inputs, diff_positions, out_avals):
+        # Bypass GradNode.__init__'s vjp plumbing; edges are over the
+        # DIFFERENTIABLE inputs only, but paddle's backward contract is one
+        # grad per forward tensor input (reference: py_layer.py:286) — so we
+        # keep both views and map between them.
+        self.name = name
+        self.vjp_fn = None
+        self.pure_fn = None
+        self.all_inputs = all_tensor_inputs
+        self.diff_positions = diff_positions  # indices into all_inputs
+        self.input_tensors = [all_tensor_inputs[i] for i in diff_positions]
+        self.out_avals = out_avals
+        self.out_tensor_refs = [None] * len(out_avals)
+        self.released = False
+        self.ctx = ctx
+        self.backward_fn = backward_fn
+        edges = []
+        for t in self.input_tensors:
+            if t._grad_node is not None:
+                edges.append(("node", t._grad_node, t._out_index))
+            else:
+                edges.append(("leaf", t))
+        self.input_edges = edges
+
+    def release(self):
+        self.backward_fn = None
+        self.ctx = None
+        self.input_tensors = None
+        self.all_inputs = None
+        self.released = True
+
+    def _call_backward(self, cot_tensors):
+        """cot_tensors: one grad Tensor per forward OUTPUT (paddle contract).
+        Returns grads for the differentiable inputs, selected from the
+        one-grad-per-tensor-input list the user's backward returns."""
+        grads = self.backward_fn(self.ctx, *cot_tensors)
+        if not isinstance(grads, (tuple, list)):
+            grads = (grads,)
+        n_all = len(self.all_inputs)
+        if len(grads) == n_all:
+            selected = [grads[i] for i in self.diff_positions]
+        elif len(grads) == len(self.diff_positions):
+            # Also accept grads aligned with just the differentiable inputs.
+            selected = list(grads)
+        else:
+            raise ValueError(
+                f"{self.name}.backward returned {len(grads)} grads; expected one "
+                f"per forward tensor input ({n_all})"
+            )
+        return selected
+
+    def _full_cotangents(self, per_output):
+        """One grad Tensor per output; non-float outputs get zeros so the user
+        backward always sees len(outputs) args (paddle-style)."""
+        import jax.numpy as jnp
+
+        from ..tensor.tensor import Tensor
+
+        outs = []
+        for c, a in zip(per_output, self.out_avals):
+            if c is not None and _is_diff_dtype(a.dtype):
+                outs.append(c if isinstance(c, Tensor) else Tensor(c, stop_gradient=True))
+            else:
+                outs.append(Tensor(jnp.zeros(a.shape, a.dtype if _is_diff_dtype(a.dtype) else "float32"), stop_gradient=True))
+        return outs
+
+    def run_vjp(self, cotangents):
+        if self.released:
+            raise RuntimeError("PyLayer node released; use retain_graph=True")
+        cot_tensors = self._full_cotangents(list(cotangents))
+        with no_grad():
+            grads = self._call_backward(cot_tensors)
+        return tuple(g._data if g is not None else None for g in grads)
+
+    def run_vjp_recorded(self, cotangent_tensors):
+        # Engine passes cotangents for diff outputs only; rebuild the full
+        # per-output list.
+        it = iter(cotangent_tensors)
+        per_output = [
+            next(it) if _is_diff_dtype(a.dtype) else None for a in self.out_avals
+        ]
+        with enable_grad():
+            return tuple(self._call_backward(self._full_cotangents(per_output)))
+
+
+class PyLayerMeta(type):
+    pass
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        from ..tensor.tensor import Tensor
+
+        ctx = PyLayerContext()
+        grad_on = is_grad_enabled()
+        with no_grad():
+            outputs = cls.forward(ctx, *args, **kwargs)
+
+        single = not isinstance(outputs, (tuple, list))
+        out_list = [outputs] if single else list(outputs)
+
+        leaves = jax.tree.flatten(
+            (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor)
+        )[0]
+        tensor_inputs = [l for l in leaves if isinstance(l, Tensor)]
+        diff_positions = [
+            i
+            for i, l in enumerate(tensor_inputs)
+            if not l.stop_gradient and _is_diff_dtype(l._data.dtype)
+        ]
+
+        if grad_on and diff_positions:
+            import weakref
+
+            non_diff_ids = {id(t) for t in ctx._non_differentiable}
+            out_avals = [jax.ShapeDtypeStruct(t._data.shape, t._data.dtype) for t in out_list]
+            node = _PyLayerNode(
+                cls.__name__, ctx, cls.backward, tensor_inputs, diff_positions, out_avals
+            )
+            for i, t in enumerate(out_list):
+                if _is_diff_dtype(t._data.dtype) and id(t) not in non_diff_ids:
+                    t.stop_gradient = False
+                    t._grad_node = node
+                    t._out_index = i
+                    node.out_tensor_refs[i] = weakref.ref(t)
+        return out_list[0] if single else tuple(out_list)
+
+
+class LegacyPyLayer(PyLayer):
+    pass

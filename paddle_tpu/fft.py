@@ -1,0 +1,58 @@
+"""paddle.fft parity over jnp.fft."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .autograd.engine import apply_op
+
+
+def _wrap(name, fn):
+    def op(x, n=None, axis=-1, norm="backward", name=None):
+        return apply_op(name, lambda v: fn(v, n=n, axis=axis, norm=norm), x)
+
+    op.__name__ = name
+    return op
+
+
+def _wrap_nd(name, fn):
+    def op(x, s=None, axes=None, norm="backward", name=None):
+        return apply_op(name, lambda v: fn(v, s=s, axes=axes, norm=norm), x)
+
+    op.__name__ = name
+    return op
+
+
+fft = _wrap("fft", jnp.fft.fft)
+ifft = _wrap("ifft", jnp.fft.ifft)
+rfft = _wrap("rfft", jnp.fft.rfft)
+irfft = _wrap("irfft", jnp.fft.irfft)
+hfft = _wrap("hfft", jnp.fft.hfft)
+ihfft = _wrap("ihfft", jnp.fft.ihfft)
+fft2 = _wrap_nd("fft2", lambda v, s, axes, norm: jnp.fft.fft2(v, s=s, axes=axes or (-2, -1), norm=norm))
+ifft2 = _wrap_nd("ifft2", lambda v, s, axes, norm: jnp.fft.ifft2(v, s=s, axes=axes or (-2, -1), norm=norm))
+rfft2 = _wrap_nd("rfft2", lambda v, s, axes, norm: jnp.fft.rfft2(v, s=s, axes=axes or (-2, -1), norm=norm))
+irfft2 = _wrap_nd("irfft2", lambda v, s, axes, norm: jnp.fft.irfft2(v, s=s, axes=axes or (-2, -1), norm=norm))
+fftn = _wrap_nd("fftn", lambda v, s, axes, norm: jnp.fft.fftn(v, s=s, axes=axes, norm=norm))
+ifftn = _wrap_nd("ifftn", lambda v, s, axes, norm: jnp.fft.ifftn(v, s=s, axes=axes, norm=norm))
+rfftn = _wrap_nd("rfftn", lambda v, s, axes, norm: jnp.fft.rfftn(v, s=s, axes=axes, norm=norm))
+irfftn = _wrap_nd("irfftn", lambda v, s, axes, norm: jnp.fft.irfftn(v, s=s, axes=axes, norm=norm))
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    from .tensor.tensor import Tensor
+
+    return Tensor(jnp.fft.fftfreq(n, d))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    from .tensor.tensor import Tensor
+
+    return Tensor(jnp.fft.rfftfreq(n, d))
+
+
+def fftshift(x, axes=None, name=None):
+    return apply_op("fftshift", lambda v: jnp.fft.fftshift(v, axes=axes), x)
+
+
+def ifftshift(x, axes=None, name=None):
+    return apply_op("ifftshift", lambda v: jnp.fft.ifftshift(v, axes=axes), x)

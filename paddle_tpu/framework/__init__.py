@@ -1,0 +1,31 @@
+from . import config, dtype, flags, place, random
+from .config import get_default_dtype, set_default_dtype
+from .dtype import (
+    DType,
+    bfloat16,
+    bool_,
+    complex64,
+    complex128,
+    convert_dtype,
+    float16,
+    float32,
+    float64,
+    int8,
+    int16,
+    int32,
+    int64,
+    to_jax_dtype,
+    uint8,
+)
+from .flags import get_flags, set_flags
+from .place import (
+    CPUPlace,
+    CustomPlace,
+    Place,
+    TPUPlace,
+    device_count,
+    get_device,
+    is_compiled_with_cuda,
+    set_device,
+)
+from .random import Generator, default_generator, get_rng_state, seed, set_rng_state

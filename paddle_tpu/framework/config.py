@@ -1,0 +1,22 @@
+"""Process-global configuration (default dtype etc.).
+
+Parity: paddle.set_default_dtype / get_default_dtype
+(reference: python/paddle/framework/framework.py).
+"""
+from __future__ import annotations
+
+_default_dtype = "float32"
+
+
+def set_default_dtype(dtype):
+    from .dtype import convert_dtype
+
+    global _default_dtype
+    d = convert_dtype(dtype)
+    if not (d.is_floating or d.is_complex):
+        raise TypeError(f"default dtype must be floating point, got {d.name}")
+    _default_dtype = d.name
+
+
+def get_default_dtype() -> str:
+    return _default_dtype

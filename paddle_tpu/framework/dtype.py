@@ -1,0 +1,143 @@
+"""Data types for the TPU-native framework.
+
+Parity target: paddle's DataType surface (reference: paddle/phi/common/data_type.h,
+python/paddle/framework/dtype.py). We expose singleton ``DType`` objects that
+compare equal to their string names, numpy dtypes, and jax dtypes, so user code
+written either way works.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+import ml_dtypes  # ships with jax
+
+
+class DType:
+    """A framework dtype. Wraps a numpy/jax dtype and a canonical name."""
+
+    _registry: dict = {}
+
+    __slots__ = ("name", "np_dtype", "is_floating", "is_complex", "is_integer", "is_bool")
+
+    def __init__(self, name: str, np_dtype):
+        self.name = name
+        self.np_dtype = np.dtype(np_dtype)
+        kind = self.np_dtype.kind
+        self.is_floating = kind == "f" or np_dtype in (jnp.bfloat16, ml_dtypes.bfloat16)
+        self.is_complex = kind == "c"
+        self.is_bool = kind == "b"
+        self.is_integer = kind in ("i", "u")
+        DType._registry[name] = self
+
+    def __repr__(self):
+        return f"paddle_tpu.{self.name}"
+
+    def __hash__(self):
+        return hash(self.name)
+
+    def __eq__(self, other):
+        if isinstance(other, DType):
+            return self.name == other.name
+        if isinstance(other, str):
+            try:
+                return self.name == convert_dtype(other).name
+            except (TypeError, ValueError):
+                return False
+        try:
+            return self.np_dtype == np.dtype(other)
+        except TypeError:
+            return NotImplemented
+
+    def __ne__(self, other):
+        result = self.__eq__(other)
+        return result if result is NotImplemented else not result
+
+    @property
+    def itemsize(self):
+        return self.np_dtype.itemsize
+
+
+bool_ = DType("bool", np.bool_)
+uint8 = DType("uint8", np.uint8)
+int8 = DType("int8", np.int8)
+int16 = DType("int16", np.int16)
+int32 = DType("int32", np.int32)
+int64 = DType("int64", np.int64)
+float16 = DType("float16", np.float16)
+bfloat16 = DType("bfloat16", ml_dtypes.bfloat16)
+float32 = DType("float32", np.float32)
+float64 = DType("float64", np.float64)
+complex64 = DType("complex64", np.complex64)
+complex128 = DType("complex128", np.complex128)
+
+_ALIASES = {
+    "float": "float32",
+    "double": "float64",
+    "half": "float16",
+    "int": "int32",
+    "long": "int64",
+    "bool_": "bool",
+    "uint8_t": "uint8",
+}
+
+
+def convert_dtype(dtype) -> DType:
+    """Normalize any dtype spelling (str / numpy / jax / DType) to a DType."""
+    if dtype is None:
+        raise TypeError("dtype must not be None")
+    if isinstance(dtype, DType):
+        return dtype
+    if isinstance(dtype, str):
+        name = _ALIASES.get(dtype, dtype)
+        got = DType._registry.get(name)
+        if got is None:
+            raise ValueError(f"unsupported dtype string: {dtype!r}")
+        return got
+    # numpy / jnp scalar types and dtype objects
+    np_dtype = np.dtype(dtype)
+    name = np_dtype.name
+    got = DType._registry.get(name)
+    if got is None:
+        raise ValueError(f"unsupported dtype: {dtype!r}")
+    return got
+
+
+def to_jax_dtype(dtype):
+    """DType (or any spelling) -> numpy dtype usable by jnp."""
+    return convert_dtype(dtype).np_dtype
+
+
+def default_float_dtype() -> DType:
+    from . import config
+
+    return convert_dtype(config.get_default_dtype())
+
+
+def is_floating_point(dtype) -> bool:
+    return convert_dtype(dtype).is_floating
+
+
+def is_integer(dtype) -> bool:
+    return convert_dtype(dtype).is_integer
+
+
+def is_complex(dtype) -> bool:
+    return convert_dtype(dtype).is_complex
+
+
+def promote_types(a, b) -> DType:
+    """Binary-op result dtype (numpy-style promotion, matching paddle's
+    type-promotion rules for float x float / int x float mixes —
+    reference: paddle/phi/common/type_promotion.h)."""
+    da, db = convert_dtype(a), convert_dtype(b)
+    # bf16 x f16 -> f32 (numpy would fail on ml_dtypes pairs)
+    pair = {da.name, db.name}
+    if pair == {"bfloat16", "float16"}:
+        return DType._registry["float32"]
+    if da.name == "bfloat16" or db.name == "bfloat16":
+        other = db if da.name == "bfloat16" else da
+        if other.is_integer or other.is_bool or other.name == "bfloat16":
+            return DType._registry["bfloat16"]
+        return other if other.is_floating or other.is_complex else DType._registry["bfloat16"]
+    return convert_dtype(np.promote_types(da.np_dtype, db.np_dtype))

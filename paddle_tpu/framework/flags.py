@@ -1,0 +1,77 @@
+"""Global flag registry with environment-variable binding.
+
+Parity target: paddle's native flags (reference: paddle/utils/flags_native.cc,
+paddle/phi/core/flags.cc — PHI_DEFINE_EXPORTED_* with FLAGS_* env pickup) and
+the python surface paddle.set_flags / paddle.get_flags.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any
+
+_FLAGS: dict[str, Any] = {}
+_DEFS: dict[str, tuple[type, Any, str]] = {}
+
+
+def define_flag(name: str, default, help_str: str = "", flag_type: type | None = None):
+    """Register a flag. Environment variable FLAGS_<name> overrides the default
+    at definition time (matching flags_native.cc GetFromEnv)."""
+    if not name.startswith("FLAGS_"):
+        name = "FLAGS_" + name
+    flag_type = flag_type or type(default)
+    _DEFS[name] = (flag_type, default, help_str)
+    env = os.environ.get(name)
+    if env is not None:
+        _FLAGS[name] = _coerce(flag_type, env)
+    else:
+        _FLAGS[name] = default
+    return _FLAGS[name]
+
+
+def _coerce(flag_type, value):
+    if flag_type is bool:
+        if isinstance(value, str):
+            return value.lower() in ("1", "true", "yes", "on")
+        return bool(value)
+    return flag_type(value)
+
+
+def set_flags(flags: dict):
+    """paddle.set_flags parity."""
+    for name, value in flags.items():
+        if not name.startswith("FLAGS_"):
+            name = "FLAGS_" + name
+        if name not in _DEFS:
+            raise ValueError(f"unknown flag: {name}")
+        _FLAGS[name] = _coerce(_DEFS[name][0], value)
+
+
+def get_flags(flags) -> dict:
+    """paddle.get_flags parity; accepts a name or list of names."""
+    if isinstance(flags, str):
+        flags = [flags]
+    out = {}
+    for name in flags:
+        key = name if name.startswith("FLAGS_") else "FLAGS_" + name
+        if key not in _FLAGS:
+            raise ValueError(f"unknown flag: {name}")
+        out[name] = _FLAGS[key]
+    return out
+
+
+def flag(name: str):
+    key = name if name.startswith("FLAGS_") else "FLAGS_" + name
+    return _FLAGS[key]
+
+
+# --- Core flags (subset of phi/core/flags.cc relevant on TPU) ---
+define_flag("check_nan_inf", False, "check outputs of every op for nan/inf")
+define_flag("check_nan_inf_level", 0, "0: abort on nan/inf; >=1: log only")
+define_flag("low_precision_op_list", 0, "collect low-precision op call stats")
+define_flag("use_stride_kernel", True, "enable view/stride ops where possible")
+define_flag("benchmark", False, "synchronize after every op for timing")
+define_flag("eager_delete_tensor_gb", 0.0, "(ignored; XLA manages memory)")
+define_flag("allocator_strategy", "auto_growth", "(informational on TPU)")
+define_flag("fraction_of_gpu_memory_to_use", 0.92, "(informational on TPU)")
+define_flag("dynamic_static_unified_comm", True, "single comm stack (always true here)")
+define_flag("tpu_matmul_precision", "default", "jax matmul precision: default|high|highest")

@@ -1,0 +1,73 @@
+"""Random state management.
+
+Parity target: paddle.seed / paddle.get_rng_state / Generator (reference:
+python/paddle/framework/random.py, phi Generator). TPU-native design: state is a
+JAX PRNG key plus a counter; every consumer draws a fresh subkey via fold-in, so
+eager and traced execution share one mechanism. Under jit tracing, the
+trace-time wrapper installs a *traced* base key (see paddle_tpu.jit), making
+compiled functions stochastic across calls instead of baking one mask in.
+"""
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+
+class Generator:
+    """A stateful RNG. ``next_key()`` returns a fresh jax PRNG key each call."""
+
+    def __init__(self, seed: int = 0):
+        self._seed = int(seed)
+        self._counter = 0
+        self._base_key = None  # lazily created (allows pre-backend import)
+        # When set, keys derive from this (possibly traced) key instead of the
+        # eager state — used by jit tracing to thread randomness as an input.
+        self._trace_key = None
+
+    def manual_seed(self, seed: int):
+        self._seed = int(seed)
+        self._counter = 0
+        self._base_key = None
+        return self
+
+    seed = manual_seed
+
+    def initial_seed(self) -> int:
+        return self._seed
+
+    def _ensure_base(self):
+        if self._base_key is None:
+            self._base_key = jax.random.key(self._seed)
+        return self._base_key
+
+    def next_key(self):
+        if self._trace_key is not None:
+            key = jax.random.fold_in(self._trace_key, self._counter)
+        else:
+            key = jax.random.fold_in(self._ensure_base(), self._counter)
+        self._counter += 1
+        return key
+
+    def get_state(self):
+        return (self._seed, self._counter)
+
+    def set_state(self, state):
+        self._seed, self._counter = int(state[0]), int(state[1])
+        self._base_key = None
+
+
+default_generator = Generator(seed=np.random.randint(0, 2**31 - 1))
+
+
+def seed(value: int) -> Generator:
+    """paddle.seed parity: reset the global generator."""
+    default_generator.manual_seed(value)
+    return default_generator
+
+
+def get_rng_state():
+    return default_generator.get_state()
+
+
+def set_rng_state(state):
+    default_generator.set_state(state)

@@ -1,0 +1,203 @@
+"""Assemble the Tensor surface: bind op functions as methods + operators.
+
+Parity: python/paddle/base/dygraph/tensor_patch_methods.py:78 (method
+monkey-patching) and python/paddle/tensor/__init__.py's method tables.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..autograd.engine import apply_op
+from . import creation, einsum, linalg, logic, manipulation, math, random, search, stat
+from .tensor import Parameter, Tensor, register_tensor_method
+
+__all__ = [
+    "Tensor",
+    "Parameter",
+    "creation",
+    "math",
+    "manipulation",
+    "logic",
+    "linalg",
+    "search",
+    "stat",
+    "random",
+    "einsum",
+]
+
+
+# --- indexing ---
+def _convert_index(idx):
+    if isinstance(idx, Tensor):
+        if idx.dtype.is_bool:
+            return np.asarray(idx._data)  # dynamic-shape mask: eager only
+        return idx._data
+    if isinstance(idx, (list, np.ndarray)):
+        return jnp.asarray(np.asarray(idx))
+    if isinstance(idx, tuple):
+        return tuple(_convert_index(i) for i in idx)
+    return idx
+
+
+def _getitem(self, idx):
+    cidx = _convert_index(idx)
+    return apply_op("getitem", lambda v: v[cidx], self)
+
+
+def _setitem(self, idx, value):
+    cidx = _convert_index(idx)
+    if isinstance(value, Tensor):
+        out = apply_op(
+            "setitem", lambda v, val: v.at[cidx].set(val.astype(v.dtype)), self, value
+        )
+    else:
+        val = value
+
+        def fn(v):
+            return v.at[cidx].set(jnp.asarray(val).astype(v.dtype))
+
+        out = apply_op("setitem", fn, self)
+    manipulation._inplace(self, out)
+
+
+register_tensor_method("__getitem__", _getitem)
+register_tensor_method("__setitem__", _setitem)
+
+
+# --- arithmetic operators ---
+def _swap(fn):
+    return lambda self, other: fn(other if isinstance(other, Tensor) else Tensor(_np_scalar(other, self)), self)
+
+
+def _np_scalar(value, like: Tensor):
+    arr = np.asarray(value)
+    if arr.dtype == np.float64 and like.dtype.is_floating:
+        arr = arr.astype(like.dtype.np_dtype)
+    if arr.dtype == np.int64 and like.dtype.is_floating:
+        arr = arr.astype(like.dtype.np_dtype)
+    return arr
+
+
+def _scalar_op(fn):
+    def method(self, other):
+        if isinstance(other, (int, float, bool, complex, np.ndarray, np.generic)):
+            other = Tensor(_np_scalar(other, self))
+        elif not isinstance(other, Tensor):
+            return NotImplemented
+        return fn(self, other)
+
+    return method
+
+
+_OPERATORS = {
+    "__add__": math.add,
+    "__radd__": math.add,
+    "__sub__": math.subtract,
+    "__mul__": math.multiply,
+    "__rmul__": math.multiply,
+    "__truediv__": math.divide,
+    "__floordiv__": math.floor_divide,
+    "__mod__": math.mod,
+    "__pow__": math.pow,
+    "__matmul__": math.matmul,
+    "__and__": logic.bitwise_and,
+    "__or__": logic.bitwise_or,
+    "__xor__": logic.bitwise_xor,
+    "__eq__": logic.equal,
+    "__ne__": logic.not_equal,
+    "__lt__": logic.less_than,
+    "__le__": logic.less_equal,
+    "__gt__": logic.greater_than,
+    "__ge__": logic.greater_equal,
+}
+for name, fn in _OPERATORS.items():
+    register_tensor_method(name, _scalar_op(fn))
+
+register_tensor_method("__rsub__", _swap(math.subtract))
+register_tensor_method("__rtruediv__", _swap(math.divide))
+register_tensor_method("__rfloordiv__", _swap(math.floor_divide))
+register_tensor_method("__rmod__", _swap(math.mod))
+register_tensor_method("__rpow__", _swap(math.pow))
+register_tensor_method("__rmatmul__", _swap(math.matmul))
+register_tensor_method("__neg__", lambda self: math.neg(self))
+register_tensor_method("__abs__", lambda self: math.abs(self))
+register_tensor_method("__invert__", lambda self: logic.bitwise_not(self))
+
+
+def _iadd(self, other):
+    return manipulation._inplace(self, _scalar_op(math.add)(self, other))
+
+
+def _isub(self, other):
+    return manipulation._inplace(self, _scalar_op(math.subtract)(self, other))
+
+
+def _imul(self, other):
+    return manipulation._inplace(self, _scalar_op(math.multiply)(self, other))
+
+
+def _idiv(self, other):
+    return manipulation._inplace(self, _scalar_op(math.divide)(self, other))
+
+
+register_tensor_method("__iadd__", _iadd)
+register_tensor_method("__isub__", _isub)
+register_tensor_method("__imul__", _imul)
+register_tensor_method("__itruediv__", _idiv)
+
+
+# --- bind free functions as methods ---
+_METHOD_SOURCES = [
+    (math, """add subtract multiply divide mod remainder floor_divide floor_mod pow
+     matmul mm bmm dot mv addmm inner outer kron abs sqrt rsqrt square exp expm1 log
+     log2 log10 log1p sin cos tan asin acos atan sinh cosh tanh asinh acosh atanh
+     atan2 floor ceil trunc frac sign reciprocal neg erf erfinv lgamma digamma
+     sigmoid logit round clip lerp nan_to_num scale maximum minimum fmax fmin hypot
+     heaviside gcd lcm sum mean prod max min amax amin nansum nanmean logsumexp trace
+     diagonal cumsum cumprod cummax cummin logcumsumexp diff isfinite isinf isnan all
+     any count_nonzero real imag conj angle deg2rad rad2deg take stanh increment
+     rint copysign isneginf isposinf isreal ldexp logaddexp nextafter exponent
+     multiplex"""),
+    (manipulation, """reshape reshape_ transpose transpose_ t moveaxis swapaxes
+     flatten squeeze squeeze_ unsqueeze unsqueeze_ split chunk unbind unstack tile
+     expand expand_as broadcast_to flip rot90 roll repeat_interleave gather gather_nd
+     take_along_axis put_along_axis scatter scatter_ scatter_nd_add index_select
+     index_sample index_add index_put index_fill masked_select masked_fill
+     masked_fill_ masked_scatter slice strided_slice crop as_strided tensordot
+     unfold view_as"""),
+    (logic, """equal not_equal greater_than greater_equal less_than less_equal
+     logical_and logical_or logical_xor logical_not bitwise_and bitwise_or
+     bitwise_xor bitwise_not equal_all isclose allclose is_empty
+     bitwise_left_shift bitwise_right_shift"""),
+    (linalg, """norm dist cond cross cholesky cholesky_solve inv inverse det slogdet
+     solve triangular_solve lstsq qr svd eig eigvals matrix_power matrix_rank pinv
+     lu lu_unpack corrcoef"""),
+    (search, """argmax argmin argsort sort topk kthvalue mode where nonzero
+     searchsorted bucketize unique unique_consecutive histogram bincount"""),
+    (stat, "std var median nanmedian quantile nanquantile"),
+    (creation, "tril triu diag diagflat diag_embed numel"),
+    (random, "bernoulli_ uniform_ normal_ exponential_ multinomial"),
+]
+
+for module, names in _METHOD_SOURCES:
+    for n in names.split():
+        fn = getattr(module, n)
+        register_tensor_method(n, fn)
+
+# A few spelling aliases paddle exposes as methods.
+register_tensor_method("mod_", lambda self, y, name=None: manipulation._inplace(self, math.mod(self, y)))
+register_tensor_method("add_", lambda self, y, name=None: manipulation._inplace(self, _scalar_op(math.add)(self, y)))
+register_tensor_method("subtract_", lambda self, y, name=None: manipulation._inplace(self, _scalar_op(math.subtract)(self, y)))
+register_tensor_method("multiply_", lambda self, y, name=None: manipulation._inplace(self, _scalar_op(math.multiply)(self, y)))
+register_tensor_method("divide_", lambda self, y, name=None: manipulation._inplace(self, _scalar_op(math.divide)(self, y)))
+register_tensor_method("clip_", lambda self, min=None, max=None, name=None: manipulation._inplace(self, math.clip(self, min, max)))
+register_tensor_method("scale_", lambda self, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None: manipulation._inplace(self, math.scale(self, scale, bias, bias_after_scale)))
+register_tensor_method("exp_", lambda self, name=None: manipulation._inplace(self, math.exp(self)))
+register_tensor_method("sqrt_", lambda self, name=None: manipulation._inplace(self, math.sqrt(self)))
+register_tensor_method("rsqrt_", lambda self, name=None: manipulation._inplace(self, math.rsqrt(self)))
+register_tensor_method("flatten_", lambda self, start_axis=0, stop_axis=-1, name=None: manipulation._inplace(self, manipulation.flatten(self, start_axis, stop_axis)))
+register_tensor_method("tanh_", lambda self, name=None: manipulation._inplace(self, math.tanh(self)))
+register_tensor_method("abs_", lambda self, name=None: manipulation._inplace(self, math.abs(self)))

@@ -1,0 +1,213 @@
+"""Tensor creation ops.
+
+Parity: python/paddle/tensor/creation.py in the reference.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..autograd.engine import apply_op
+from ..framework import config
+from ..framework import dtype as dtype_mod
+from .tensor import Tensor
+
+
+def _default_dtype():
+    return dtype_mod.to_jax_dtype(config.get_default_dtype())
+
+
+def _resolve(dtype, default=None):
+    if dtype is None:
+        return default if default is not None else _default_dtype()
+    return dtype_mod.to_jax_dtype(dtype)
+
+
+def _shape_list(shape):
+    if isinstance(shape, Tensor):
+        return [int(s) for s in shape.numpy()]
+    if isinstance(shape, (int, np.integer)):
+        return [int(shape)]
+    return [int(s._data if isinstance(s, Tensor) else s) for s in shape]
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True) -> Tensor:
+    if isinstance(data, Tensor):
+        out = data.astype(dtype) if dtype is not None else data.clone()
+        out.stop_gradient = stop_gradient
+        return out
+    t = Tensor(data, dtype=dtype, place=place, stop_gradient=stop_gradient)
+    return t
+
+
+def zeros(shape, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.zeros(_shape_list(shape), _resolve(dtype)))
+
+
+def ones(shape, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.ones(_shape_list(shape), _resolve(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None) -> Tensor:
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None:
+        if isinstance(fill_value, bool):
+            dtype = np.bool_
+        elif isinstance(fill_value, int):
+            dtype = np.int64
+        else:
+            dtype = _default_dtype()
+    return Tensor(jnp.full(_shape_list(shape), fill_value, _resolve(dtype)))
+
+
+def empty(shape, dtype=None, name=None) -> Tensor:
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.zeros(x._data.shape, _resolve(dtype, x._data.dtype)))
+
+
+def ones_like(x, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.ones(x._data.shape, _resolve(dtype, x._data.dtype)))
+
+
+def full_like(x, fill_value, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.full(x._data.shape, fill_value, _resolve(dtype, x._data.dtype)))
+
+
+def empty_like(x, dtype=None, name=None) -> Tensor:
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None) -> Tensor:
+    def val(v):
+        return v.item() if isinstance(v, Tensor) else v
+
+    start, end, step = val(start), val(end), val(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        dtype = (
+            np.int64
+            if all(isinstance(v, (int, np.integer)) for v in (start, end, step))
+            else _default_dtype()
+        )
+    return Tensor(jnp.arange(start, end, step, _resolve(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None) -> Tensor:
+    def val(v):
+        return v.item() if isinstance(v, Tensor) else v
+
+    return Tensor(jnp.linspace(val(start), val(stop), int(val(num)), dtype=_resolve(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.logspace(start, stop, int(num), base=base, dtype=_resolve(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.eye(num_rows, num_columns, dtype=_resolve(dtype)))
+
+
+def diag(x, offset=0, padding_value=0, name=None) -> Tensor:
+    if padding_value != 0 and x.ndim == 1:
+        def fn(v):
+            d = jnp.diag(v, k=offset)
+            mask = jnp.eye(d.shape[0], d.shape[1], k=offset, dtype=bool)
+            return jnp.where(mask, d, jnp.asarray(padding_value, d.dtype))
+
+        return apply_op("diag", fn, x)
+    return apply_op("diag", lambda v: jnp.diag(v, k=offset), x)
+
+
+def diagflat(x, offset=0, name=None) -> Tensor:
+    return apply_op("diagflat", lambda v: jnp.diagflat(v, k=offset), x)
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None) -> Tensor:
+    def fn(v):
+        out = jnp.zeros(v.shape + (v.shape[-1] + abs(offset),), v.dtype)
+        idx = jnp.arange(v.shape[-1])
+        if offset >= 0:
+            out = out.at[..., idx, idx + offset].set(v)
+        else:
+            out = out.at[..., idx - offset, idx].set(v)
+        last = out.shape[-1]
+        out = jnp.reshape(out, v.shape[:-1] + (v.shape[-1] + abs(offset), last))
+        return jnp.moveaxis(jnp.moveaxis(out, -2, dim1), -1, dim2)
+
+    return apply_op("diag_embed", fn, x)
+
+
+def tril(x, diagonal=0, name=None) -> Tensor:
+    return apply_op("tril", lambda v: jnp.tril(v, k=diagonal), x)
+
+
+def triu(x, diagonal=0, name=None) -> Tensor:
+    return apply_op("triu", lambda v: jnp.triu(v, k=diagonal), x)
+
+
+def tril_indices(row, col, offset=0, dtype="int64"):
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(jnp.stack([jnp.asarray(r), jnp.asarray(c)]).astype(dtype_mod.to_jax_dtype(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    r, c = np.triu_indices(row, offset, col if col is not None else row)
+    return Tensor(jnp.stack([jnp.asarray(r), jnp.asarray(c)]).astype(dtype_mod.to_jax_dtype(dtype)))
+
+
+def meshgrid(*args, **kwargs):
+    tensors = args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args
+    outs = apply_op("meshgrid", lambda *xs: tuple(jnp.meshgrid(*xs, indexing="ij")), *tensors)
+    return list(outs)
+
+
+def assign(x, output=None) -> Tensor:
+    src = x if isinstance(x, Tensor) else Tensor(np.asarray(x))
+    out = apply_op("assign", lambda v: v + 0 if jnp.issubdtype(v.dtype, jnp.number) else v, src)
+    if output is not None:
+        output._data = out._data
+        output._grad_node = out._grad_node
+        output._out_index = out._out_index
+        return output
+    return out
+
+
+def clone(x, name=None) -> Tensor:
+    return x.clone()
+
+
+def numel(x, name=None) -> Tensor:
+    return Tensor(jnp.asarray(x._data.size, jnp.int64))
+
+
+def rank(x) -> Tensor:
+    return Tensor(jnp.asarray(x._data.ndim, jnp.int32))
+
+
+def shape(x) -> Tensor:
+    return Tensor(jnp.asarray(x._data.shape, jnp.int32))
+
+
+def is_tensor(x) -> bool:
+    return isinstance(x, Tensor)
+
+
+def complex(real, imag, name=None) -> Tensor:
+    return apply_op("complex", lambda r, i: jax.lax.complex(r, i), real, imag)
+
+
+def as_complex(x, name=None) -> Tensor:
+    return apply_op("as_complex", lambda v: jax.lax.complex(v[..., 0], v[..., 1]), x)
+
+
+def as_real(x, name=None) -> Tensor:
+    return apply_op("as_real", lambda v: jnp.stack([jnp.real(v), jnp.imag(v)], axis=-1), x)
+
+
+import jax  # noqa: E402  (used by complex ops above)

@@ -1,0 +1,10 @@
+"""einsum (paddle.einsum parity; python/paddle/tensor/einsum.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..autograd.engine import apply_op
+
+
+def einsum(equation, *operands, name=None):
+    return apply_op("einsum", lambda *ops: jnp.einsum(equation, *ops), *operands)

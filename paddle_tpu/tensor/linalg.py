@@ -1,0 +1,271 @@
+"""Linear algebra ops (paddle.linalg parity).
+
+Parity: python/paddle/tensor/linalg.py. Decompositions route through
+jax.numpy.linalg / jax.scipy.linalg (XLA lowers these to TPU-supported
+factorizations; some fall back to CPU on TPU just like the reference's
+CPU-only kernels).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..autograd.engine import apply_op
+from .tensor import Tensor
+from .math import matmul, dot, bmm, mv  # re-exported  # noqa: F401
+
+
+def transpose_last2(x):
+    return apply_op("transpose_last2", lambda v: jnp.swapaxes(v, -1, -2), x)
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    def fn(v):
+        if p is None or p == "fro":
+            if axis is None:
+                return jnp.sqrt(jnp.sum(jnp.square(jnp.abs(v))))
+            return jnp.linalg.norm(v, ord=None, axis=_ax(axis), keepdims=keepdim)
+        if p == "nuc":
+            return jnp.linalg.norm(v, ord="nuc", axis=_ax(axis), keepdims=keepdim)
+        if p == float("inf") or p == float("-inf") or isinstance(p, (int, float)):
+            if axis is None:
+                flat = jnp.abs(v.reshape(-1))
+                if p == float("inf"):
+                    return jnp.max(flat)
+                if p == float("-inf"):
+                    return jnp.min(flat)
+                if p == 0:
+                    return jnp.sum((flat != 0).astype(v.dtype))
+                return jnp.sum(flat**p) ** (1.0 / p)
+            return jnp.linalg.norm(v, ord=p, axis=_ax(axis), keepdims=keepdim)
+        raise ValueError(f"unsupported norm order {p}")
+
+    return apply_op("norm", fn, x)
+
+
+def _ax(axis):
+    if isinstance(axis, (list, tuple)):
+        return tuple(axis)
+    return axis
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    return apply_op(
+        "vector_norm",
+        lambda v: jnp.linalg.vector_norm(v, ord=p, axis=_ax(axis), keepdims=keepdim),
+        x,
+    )
+
+
+def matrix_norm(x, p="fro", axis=[-2, -1], keepdim=False, name=None):
+    return apply_op(
+        "matrix_norm",
+        lambda v: jnp.linalg.matrix_norm(v, ord=p, keepdims=keepdim),
+        x,
+    )
+
+
+def dist(x, y, p=2, name=None):
+    return norm(x - y, p=p)
+
+
+def cond(x, p=None, name=None):
+    return apply_op("cond", lambda v: jnp.linalg.cond(v, p=p), x)
+
+
+def cross(x, y, axis=9, name=None):
+    def fn(a, b):
+        ax = axis
+        if ax == 9:  # paddle default: first axis with dim 3
+            ax = next(i for i, s in enumerate(a.shape) if s == 3)
+        return jnp.cross(a, b, axis=ax)
+
+    return apply_op("cross", fn, x, y)
+
+
+def cholesky(x, upper=False, name=None):
+    def fn(v):
+        L = jnp.linalg.cholesky(v)
+        return jnp.swapaxes(L, -1, -2).conj() if upper else L
+
+    return apply_op("cholesky", fn, x)
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def fn(b, L):
+        Lm = jnp.swapaxes(L, -1, -2).conj() if upper else L
+        z = jax.scipy.linalg.solve_triangular(Lm, b, lower=True)
+        return jax.scipy.linalg.solve_triangular(jnp.swapaxes(Lm, -1, -2).conj(), z, lower=False)
+
+    return apply_op("cholesky_solve", fn, x, y)
+
+
+def inv(x, name=None):
+    return apply_op("inv", jnp.linalg.inv, x)
+
+
+inverse = inv
+
+
+def det(x, name=None):
+    return apply_op("det", jnp.linalg.det, x)
+
+
+def slogdet(x, name=None):
+    def fn(v):
+        sign, logabs = jnp.linalg.slogdet(v)
+        return jnp.stack([sign, logabs])
+
+    return apply_op("slogdet", fn, x)
+
+
+def solve(x, y, name=None):
+    return apply_op("solve", jnp.linalg.solve, x, y)
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    def fn(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0, unit_diagonal=unitriangular
+        )
+
+    return apply_op("triangular_solve", fn, x, y)
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    def fn(a, b):
+        sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+        return sol, res, rank, sv
+
+    sol, res, rank, sv = apply_op("lstsq", fn, x, y)
+    return sol, res, rank, sv
+
+
+def qr(x, mode="reduced", name=None):
+    def fn(v):
+        q, r = jnp.linalg.qr(v, mode=mode)
+        return q, r
+
+    return apply_op("qr", fn, x)
+
+
+def svd(x, full_matrices=False, name=None):
+    def fn(v):
+        u, s, vh = jnp.linalg.svd(v, full_matrices=full_matrices)
+        return u, s, jnp.swapaxes(vh, -1, -2).conj()
+
+    return apply_op("svd", fn, x)
+
+
+def svdvals(x, name=None):
+    return apply_op("svdvals", lambda v: jnp.linalg.svd(v, compute_uv=False), x)
+
+
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    u, s, v = svd(x)
+    from .manipulation import slice as slice_op
+
+    return u[..., :q], s[..., :q], v[..., :q]
+
+
+def eig(x, name=None):
+    def fn(v):
+        w, vec = jnp.linalg.eig(v)
+        return w, vec
+
+    return apply_op("eig", fn, x)
+
+
+def eigvals(x, name=None):
+    return apply_op("eigvals", jnp.linalg.eigvals, x)
+
+
+def eigh(x, UPLO="L", name=None):
+    def fn(v):
+        w, vec = jnp.linalg.eigh(v, UPLO=UPLO)
+        return w, vec
+
+    return apply_op("eigh", fn, x)
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return apply_op("eigvalsh", lambda v: jnp.linalg.eigvalsh(v, UPLO=UPLO), x)
+
+
+def matrix_power(x, n, name=None):
+    return apply_op("matrix_power", lambda v: jnp.linalg.matrix_power(v, n), x)
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return apply_op(
+        "matrix_rank", lambda v: jnp.linalg.matrix_rank(v, rtol=tol).astype(jnp.int64), x
+    )
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply_op("pinv", lambda v: jnp.linalg.pinv(v, rtol=rcond, hermitian=hermitian), x)
+
+
+def multi_dot(x, name=None):
+    return apply_op("multi_dot", lambda *vs: jnp.linalg.multi_dot(vs), *x)
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    def fn(v):
+        lu_mat, piv = jax.scipy.linalg.lu_factor(v)
+        return lu_mat, (piv + 1).astype(jnp.int32)
+
+    lu_mat, piv = apply_op("lu", fn, x)
+    if get_infos:
+        return lu_mat, piv, Tensor(jnp.zeros((), jnp.int32))
+    return lu_mat, piv
+
+
+def lu_unpack(lu_data, lu_pivots, unpack_ludata=True, unpack_pivots=True, name=None):
+    def fn(lu_mat, piv):
+        m = lu_mat.shape[-2]
+        L = jnp.tril(lu_mat, -1) + jnp.eye(m, lu_mat.shape[-1], dtype=lu_mat.dtype)
+        U = jnp.triu(lu_mat)
+        perm = jnp.arange(m)
+        piv0 = piv - 1
+
+        def body(i, p):
+            a, b = p[i], p[piv0[i]]
+            p = p.at[i].set(b)
+            return p.at[piv0[i]].set(a)
+
+        perm = jax.lax.fori_loop(0, piv.shape[-1], body, perm)
+        P = jnp.eye(m, dtype=lu_mat.dtype)[perm].T
+        return P, L[..., :m, :], U
+
+    return apply_op("lu_unpack", fn, lu_data, lu_pivots)
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return apply_op("corrcoef", lambda v: jnp.corrcoef(v, rowvar=rowvar), x)
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return apply_op(
+        "cov", lambda v: jnp.cov(v, rowvar=rowvar, ddof=1 if ddof else 0), x
+    )
+
+
+def householder_product(x, tau, name=None):
+    def fn(a, t):
+        m, n = a.shape[-2], a.shape[-1]
+        eye = jnp.eye(m, dtype=a.dtype)
+
+        def body(i, Q):
+            v = jnp.where(jnp.arange(m) < i, 0.0, a[..., :, i].at[i].set(1.0))
+            H = eye - t[..., i] * jnp.outer(v, v.conj())
+            return Q @ H
+
+        Q = jax.lax.fori_loop(0, n, body, eye)
+        return Q[..., :, :n]
+
+    return apply_op("householder_product", fn, x, tau)
+
+
+def triangular_matmul(*a, **k):  # placeholder for API table completeness
+    raise NotImplementedError

@@ -1,0 +1,57 @@
+"""Comparison / logical / bitwise ops.
+
+Parity: python/paddle/tensor/logic.py.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..autograd.engine import apply_op, make_op
+from .tensor import Tensor
+
+equal = make_op("equal", jnp.equal)
+not_equal = make_op("not_equal", jnp.not_equal)
+greater_than = make_op("greater_than", jnp.greater)
+greater_equal = make_op("greater_equal", jnp.greater_equal)
+less_than = make_op("less_than", jnp.less)
+less_equal = make_op("less_equal", jnp.less_equal)
+
+logical_and = make_op("logical_and", jnp.logical_and)
+logical_or = make_op("logical_or", jnp.logical_or)
+logical_xor = make_op("logical_xor", jnp.logical_xor)
+logical_not = make_op("logical_not", jnp.logical_not)
+
+bitwise_and = make_op("bitwise_and", jnp.bitwise_and)
+bitwise_or = make_op("bitwise_or", jnp.bitwise_or)
+bitwise_xor = make_op("bitwise_xor", jnp.bitwise_xor)
+bitwise_not = make_op("bitwise_not", jnp.bitwise_not)
+bitwise_invert = bitwise_not
+bitwise_left_shift = make_op("bitwise_left_shift", jnp.left_shift)
+bitwise_right_shift = make_op("bitwise_right_shift", jnp.right_shift)
+
+
+def equal_all(x, y, name=None) -> Tensor:
+    return apply_op(
+        "equal_all",
+        lambda a, b: jnp.asarray(a.shape == b.shape) & jnp.all(a == b)
+        if a.shape == b.shape
+        else jnp.asarray(False),
+        x,
+        y,
+    )
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None) -> Tensor:
+    return apply_op(
+        "isclose", lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan), x, y
+    )
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None) -> Tensor:
+    return apply_op(
+        "allclose", lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan), x, y
+    )
+
+
+def is_empty(x, name=None) -> Tensor:
+    return Tensor(jnp.asarray(x._data.size == 0))

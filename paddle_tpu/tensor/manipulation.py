@@ -1,0 +1,462 @@
+"""Shape/layout manipulation ops.
+
+Parity: python/paddle/tensor/manipulation.py. All views are functional (XLA
+has no aliasing at this level); in-place variants rebind the handle's data and
+grad node, which keeps autograd exact.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..autograd.engine import apply_op
+from .tensor import Tensor
+
+
+_py_slice = slice  # capture the builtin before the paddle-style `slice` op shadows it
+
+
+def _int_list(v):
+    if isinstance(v, Tensor):
+        return [int(s) for s in v.numpy()]
+    if isinstance(v, (int, np.integer)):
+        return [int(v)]
+    return [int(s.item() if isinstance(s, Tensor) else s) for s in v]
+
+
+def _inplace(x, out):
+    x._data = out._data
+    x._grad_node = out._grad_node
+    x._out_index = out._out_index
+    x.stop_gradient = out.stop_gradient
+    return x
+
+
+def reshape(x, shape, name=None):
+    shape = _int_list(shape)
+    return apply_op("reshape", lambda v: jnp.reshape(v, shape), x)
+
+
+def reshape_(x, shape, name=None):
+    return _inplace(x, reshape(x, shape))
+
+
+view = reshape
+
+
+def transpose(x, perm, name=None):
+    perm = _int_list(perm)
+    return apply_op("transpose", lambda v: jnp.transpose(v, perm), x)
+
+
+def t(x, name=None):
+    if x.ndim <= 1:
+        return x.clone()
+    return apply_op("t", lambda v: v.T, x)
+
+
+def moveaxis(x, source, destination, name=None):
+    return apply_op("moveaxis", lambda v: jnp.moveaxis(v, source, destination), x)
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    return apply_op("swapaxes", lambda v: jnp.swapaxes(v, axis0, axis1), x)
+
+
+transpose_ = lambda x, perm, name=None: _inplace(x, transpose(x, perm))
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    def fn(v):
+        nd = v.ndim
+        s = start_axis % nd if nd else 0
+        e = stop_axis % nd if nd else 0
+        new_shape = v.shape[:s] + (-1,) + v.shape[e + 1 :]
+        return jnp.reshape(v, new_shape)
+
+    return apply_op("flatten", fn, x)
+
+
+def squeeze(x, axis=None, name=None):
+    def fn(v):
+        if axis is None:
+            return jnp.squeeze(v)
+        axes = tuple(a % v.ndim for a in (_int_list(axis)))
+        axes = tuple(a for a in axes if v.shape[a] == 1)
+        return jnp.squeeze(v, axis=axes) if axes else v
+
+    return apply_op("squeeze", fn, x)
+
+
+def squeeze_(x, axis=None, name=None):
+    return _inplace(x, squeeze(x, axis))
+
+
+def unsqueeze(x, axis, name=None):
+    axes = _int_list(axis)
+
+    def fn(v):
+        # axes are positions in the FINAL shape (numpy expand_dims semantics)
+        final_nd = v.ndim + len(axes)
+        norm = tuple(a % final_nd for a in axes)
+        return jnp.expand_dims(v, norm)
+
+    return apply_op("unsqueeze", fn, x)
+
+
+def unsqueeze_(x, axis, name=None):
+    return _inplace(x, unsqueeze(x, axis))
+
+
+def concat(x, axis=0, name=None):
+    tensors = list(x)
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return apply_op("concat", lambda *vs: jnp.concatenate(vs, axis=axis), *tensors)
+
+
+def stack(x, axis=0, name=None):
+    tensors = list(x)
+    return apply_op("stack", lambda *vs: jnp.stack(vs, axis=axis), *tensors)
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    dim_size = x._data.shape[axis]
+    if isinstance(num_or_sections, int):
+        if dim_size % num_or_sections != 0:
+            raise ValueError(
+                f"The input's size along the split dimension ({dim_size}) must be "
+                f"evenly divisible by num_or_sections ({num_or_sections})"
+            )
+        sections = [dim_size // num_or_sections] * num_or_sections
+    else:
+        sections = [int(s) for s in num_or_sections]
+        if -1 in sections:
+            known = builtins_sum(s for s in sections if s != -1)
+            sections = [dim_size - known if s == -1 else s for s in sections]
+    offsets = np.cumsum([0] + sections)
+
+    def fn(v):
+        return tuple(
+            jax.lax.slice_in_dim(v, int(offsets[i]), int(offsets[i + 1]), axis=axis)
+            for i in range(len(sections))
+        )
+
+    return list(apply_op("split", fn, x))
+
+
+def builtins_sum(it):
+    total = 0
+    for v in it:
+        total += v
+    return total
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def unbind(x, axis=0, name=None):
+    n = x._data.shape[axis]
+
+    def fn(v):
+        return tuple(jnp.squeeze(s, axis=axis) for s in jnp.split(v, n, axis=axis))
+
+    return list(apply_op("unbind", fn, x))
+
+
+unstack = unbind
+
+
+def tile(x, repeat_times, name=None):
+    reps = _int_list(repeat_times)
+    return apply_op("tile", lambda v: jnp.tile(v, reps), x)
+
+
+def expand(x, shape, name=None):
+    shape = _int_list(shape)
+
+    def fn(v):
+        target = list(shape)
+        offset = len(target) - v.ndim
+        for i in range(v.ndim):
+            if target[offset + i] == -1:
+                target[offset + i] = v.shape[i]
+        return jnp.broadcast_to(v, target)
+
+    return apply_op("expand", fn, x)
+
+
+def expand_as(x, y, name=None):
+    return apply_op("expand_as", lambda v, w: jnp.broadcast_to(v, w.shape), x, y)
+
+
+def broadcast_to(x, shape, name=None):
+    shape = _int_list(shape)
+    return apply_op("broadcast_to", lambda v: jnp.broadcast_to(v, shape), x)
+
+
+def broadcast_tensors(inputs, name=None):
+    return list(apply_op("broadcast_tensors", lambda *vs: tuple(jnp.broadcast_arrays(*vs)), *inputs))
+
+
+def flip(x, axis, name=None):
+    axes = _int_list(axis)
+    return apply_op("flip", lambda v: jnp.flip(v, axis=axes), x)
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply_op("rot90", lambda v: jnp.rot90(v, k=k, axes=tuple(axes)), x)
+
+
+def roll(x, shifts, axis=None, name=None):
+    sh = _int_list(shifts) if not isinstance(shifts, int) else shifts
+    ax = _int_list(axis) if axis is not None and not isinstance(axis, int) else axis
+    if isinstance(sh, list) and len(sh) == 1:
+        sh = sh[0]
+    if isinstance(ax, list) and len(ax) == 1:
+        ax = ax[0]
+    return apply_op("roll", lambda v: jnp.roll(v, sh, axis=ax), x)
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    if isinstance(repeats, Tensor):
+        reps = jnp.asarray(repeats.numpy())
+        return apply_op(
+            "repeat_interleave", lambda v: jnp.repeat(v, reps, axis=axis), x
+        )
+    return apply_op("repeat_interleave", lambda v: jnp.repeat(v, repeats, axis=axis), x)
+
+
+# --- gather/scatter family ---
+def gather(x, index, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return apply_op("gather", lambda v, i: jnp.take(v, i.reshape(-1) if i.ndim > 1 else i, axis=axis), x, index)
+
+
+def gather_nd(x, index, name=None):
+    def fn(v, idx):
+        idx_tuple = tuple(jnp.moveaxis(idx, -1, 0))
+        return v[idx_tuple]
+
+    return apply_op("gather_nd", fn, x, index)
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    return apply_op(
+        "take_along_axis", lambda v, i: jnp.take_along_axis(v, i, axis=axis), arr, indices
+    )
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", include_self=True, broadcast=True, name=None):
+    def fn(v, i, val):
+        val = jnp.broadcast_to(val, i.shape).astype(v.dtype) if not hasattr(val, "shape") or val.shape != i.shape else val.astype(v.dtype)
+        dims = list(range(v.ndim))
+        idx = [jnp.arange(s).reshape([-1 if d == k else 1 for k in range(v.ndim)]) for d, s in enumerate(i.shape)]
+        idx[axis] = i
+        full = tuple(jnp.broadcast_to(ix, i.shape) for ix in idx)
+        if reduce == "assign":
+            return v.at[full].set(val)
+        if reduce in ("add", "sum"):
+            return v.at[full].add(val)
+        if reduce in ("mul", "multiply"):
+            return v.at[full].multiply(val)
+        if reduce == "amax":
+            return v.at[full].max(val)
+        if reduce == "amin":
+            return v.at[full].min(val)
+        raise ValueError(f"unsupported reduce: {reduce}")
+
+    vals = values if isinstance(values, Tensor) else Tensor(jnp.asarray(values))
+    return apply_op("put_along_axis", fn, arr, indices, vals)
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    def fn(v, i, u):
+        i = i.reshape(-1)
+        if overwrite:
+            return v.at[i].set(u.astype(v.dtype))
+        # paddle semantics: zero the target rows then accumulate
+        zeroed = v.at[i].set(jnp.zeros_like(u, dtype=v.dtype))
+        return zeroed.at[i].add(u.astype(v.dtype))
+
+    return apply_op("scatter", fn, x, index, updates)
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    return _inplace(x, scatter(x, index, updates, overwrite))
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    def fn(v, i, u):
+        idx_tuple = tuple(jnp.moveaxis(i, -1, 0))
+        return v.at[idx_tuple].add(u.astype(v.dtype))
+
+    return apply_op("scatter_nd_add", fn, x, index, updates)
+
+
+def scatter_nd(index, updates, shape, name=None):
+    from .creation import zeros
+
+    base = zeros(shape, dtype=updates.dtype)
+    return scatter_nd_add(base, index, updates)
+
+
+def index_select(x, index, axis=0, name=None):
+    return apply_op("index_select", lambda v, i: jnp.take(v, i, axis=axis), x, index)
+
+
+def index_sample(x, index, name=None):
+    return apply_op(
+        "index_sample", lambda v, i: jnp.take_along_axis(v, i, axis=1), x, index
+    )
+
+
+def index_add(x, index, axis, value, name=None):
+    def fn(v, i, val):
+        moved = jnp.moveaxis(v, axis, 0)
+        moved = moved.at[i].add(jnp.moveaxis(val, axis, 0).astype(v.dtype))
+        return jnp.moveaxis(moved, 0, axis)
+
+    return apply_op("index_add", fn, x, index, value)
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    def fn(v, val, *idx):
+        if accumulate:
+            return v.at[tuple(idx)].add(val.astype(v.dtype))
+        return v.at[tuple(idx)].set(val.astype(v.dtype))
+
+    return apply_op("index_put", fn, x, value, *indices)
+
+
+def index_fill(x, index, axis, value, name=None):
+    def fn(v, i):
+        moved = jnp.moveaxis(v, axis, 0)
+        moved = moved.at[i].set(jnp.asarray(value, v.dtype))
+        return jnp.moveaxis(moved, 0, axis)
+
+    return apply_op("index_fill", fn, x, index)
+
+
+def masked_select(x, mask, name=None):
+    # Dynamic output shape: eager-only (like reference's masked_select on GPU).
+    return apply_op("masked_select", lambda v: v[np.asarray(mask._data)], x)
+
+
+def masked_fill(x, mask, value, name=None):
+    val = value._data if isinstance(value, Tensor) else value
+
+    def fn(v, m):
+        return jnp.where(m, jnp.asarray(val, v.dtype), v)
+
+    return apply_op("masked_fill", fn, x, mask)
+
+
+def masked_fill_(x, mask, value, name=None):
+    return _inplace(x, masked_fill(x, mask, value))
+
+
+def masked_scatter(x, mask, value, name=None):
+    def fn(v, m, val):
+        flat_idx = jnp.cumsum(m.reshape(-1).astype(jnp.int32)) - 1
+        gathered = jnp.take(val.reshape(-1), jnp.clip(flat_idx, 0, val.size - 1))
+        return jnp.where(m, gathered.reshape(v.shape).astype(v.dtype), v)
+
+    return apply_op("masked_scatter", fn, x, mask, value)
+
+
+# --- slicing ---
+def slice(input, axes, starts, ends, name=None):
+    axes = _int_list(axes)
+    starts = _int_list(starts)
+    ends = _int_list(ends)
+
+    def fn(v):
+        out = v
+        for a, s, e in zip(axes, starts, ends):
+            dim = v.shape[a]
+            s2 = max(s + dim, 0) if s < 0 else min(s, dim)
+            e2 = max(e + dim, 0) if e < 0 else min(e, dim)
+            out = jax.lax.slice_in_dim(out, s2, e2, axis=a)
+        return out
+
+    return apply_op("slice", fn, input)
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    axes, starts, ends, strides = map(_int_list, (axes, starts, ends, strides))
+
+    def fn(v):
+        idx = [_py_slice(None)] * v.ndim
+        for a, s, e, st in zip(axes, starts, ends, strides):
+            idx[a] = _py_slice(s, e, st)
+        return v[tuple(idx)]
+
+    return apply_op("strided_slice", fn, x)
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    shape = _int_list(shape)
+    offsets = _int_list(offsets) if offsets is not None else [0] * len(shape)
+
+    def fn(v):
+        starts = offsets
+        sizes = [sh if sh != -1 else v.shape[i] - starts[i] for i, sh in enumerate(shape)]
+        return jax.lax.dynamic_slice(v, starts, sizes)
+
+    return apply_op("crop", fn, x)
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    def fn(v):
+        flat = v.reshape(-1)
+        idx = np.zeros(shape, dtype=np.int64) + offset
+        for d, (s, st) in enumerate(zip(shape, stride)):
+            rng = np.arange(s) * st
+            idx = idx + rng.reshape([-1 if i == d else 1 for i in range(len(shape))])
+        return flat[idx]
+
+    return apply_op("as_strided", fn, x)
+
+
+def tensordot(x, y, axes=2, name=None):
+    ax = axes
+    if isinstance(ax, Tensor):
+        ax = ax.tolist()
+    return apply_op("tensordot", lambda a, b: jnp.tensordot(a, b, axes=ax), x, y)
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [apply_op("atleast_1d", jnp.atleast_1d, x) for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs, name=None):
+    outs = [apply_op("atleast_2d", jnp.atleast_2d, x) for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs, name=None):
+    outs = [apply_op("atleast_3d", jnp.atleast_3d, x) for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def view_as(x, other, name=None):
+    return reshape(x, other.shape)
+
+
+def unfold(x, axis, size, step, name=None):
+    def fn(v):
+        n = (v.shape[axis] - size) // step + 1
+        idx = (np.arange(n) * step)[:, None] + np.arange(size)[None, :]
+        moved = jnp.moveaxis(v, axis, 0)
+        out = moved[idx]  # [n, size, ...]
+        return jnp.moveaxis(out, (0, 1), (axis, v.ndim))
+
+    return apply_op("unfold", fn, x)

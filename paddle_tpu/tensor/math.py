@@ -1,0 +1,396 @@
+"""Math ops (elementwise, reductions, cumulative, special functions).
+
+Parity: python/paddle/tensor/math.py (+ ops.py) in the reference. Each op is a
+pure jax function routed through the autograd engine's apply_op.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import jax.scipy.special as jsp
+
+from ..autograd.engine import apply_op, make_op
+from ..framework import dtype as dtype_mod
+from .tensor import Tensor
+
+
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+# --- binary elementwise ---
+add = make_op("add", jnp.add)
+subtract = make_op("subtract", jnp.subtract)
+multiply = make_op("multiply", jnp.multiply)
+mod = make_op("mod", jnp.mod)
+remainder = mod
+floor_mod = mod
+floor_divide = make_op("floor_divide", jnp.floor_divide)
+maximum = make_op("maximum", jnp.maximum)
+minimum = make_op("minimum", jnp.minimum)
+fmax = make_op("fmax", jnp.fmax)
+fmin = make_op("fmin", jnp.fmin)
+hypot = make_op("hypot", jnp.hypot)
+logaddexp = make_op("logaddexp", jnp.logaddexp)
+nextafter = make_op("nextafter", jnp.nextafter)
+copysign = make_op("copysign", jnp.copysign)
+heaviside = make_op("heaviside", jnp.heaviside)
+gcd = make_op("gcd", jnp.gcd)
+lcm = make_op("lcm", jnp.lcm)
+ldexp = make_op("ldexp", jnp.ldexp)
+inner = make_op("inner", jnp.inner)
+outer = make_op("outer", lambda x, y: jnp.outer(x, y))
+kron = make_op("kron", jnp.kron)
+
+
+def divide(x, y, name=None):
+    # paddle divide: int/int -> float (true divide)
+    return apply_op("divide", jnp.true_divide, x, y)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    def fn(v, s, b):
+        return v * s + b if bias_after_scale else (v + b) * s
+
+    return apply_op("scale", fn, x, scale, bias)
+
+
+def pow(x, y, name=None):
+    return apply_op("pow", jnp.power, x, y)
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def fn(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim >= 2 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim >= 2 else b
+        return jnp.matmul(a, b)
+
+    return apply_op("matmul", fn, x, y)
+
+
+def mm(x, y, name=None):
+    return matmul(x, y)
+
+
+def bmm(x, y, name=None):
+    return apply_op("bmm", jnp.matmul, x, y)
+
+
+def dot(x, y, name=None):
+    return apply_op("dot", lambda a, b: jnp.sum(a * b, axis=-1), x, y)
+
+
+def mv(x, vec, name=None):
+    return apply_op("mv", jnp.matmul, x, vec)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return apply_op("addmm", lambda i, a, b: beta * i + alpha * jnp.matmul(a, b), input, x, y)
+
+
+# --- unary elementwise ---
+def _unary(name, fn):
+    return make_op(name, fn)
+
+
+abs = _unary("abs", jnp.abs)
+absolute = abs
+sqrt = _unary("sqrt", jnp.sqrt)
+rsqrt = _unary("rsqrt", jax.lax.rsqrt)
+square = _unary("square", jnp.square)
+exp = _unary("exp", jnp.exp)
+expm1 = _unary("expm1", jnp.expm1)
+log = _unary("log", jnp.log)
+log2 = _unary("log2", jnp.log2)
+log10 = _unary("log10", jnp.log10)
+log1p = _unary("log1p", jnp.log1p)
+sin = _unary("sin", jnp.sin)
+cos = _unary("cos", jnp.cos)
+tan = _unary("tan", jnp.tan)
+asin = _unary("asin", jnp.arcsin)
+acos = _unary("acos", jnp.arccos)
+atan = _unary("atan", jnp.arctan)
+arcsin, arccos, arctan = asin, acos, atan
+sinh = _unary("sinh", jnp.sinh)
+cosh = _unary("cosh", jnp.cosh)
+tanh = _unary("tanh", jnp.tanh)
+asinh = _unary("asinh", jnp.arcsinh)
+acosh = _unary("acosh", jnp.arccosh)
+atanh = _unary("atanh", jnp.arctanh)
+atan2 = make_op("atan2", jnp.arctan2)
+floor = _unary("floor", jnp.floor)
+ceil = _unary("ceil", jnp.ceil)
+trunc = _unary("trunc", jnp.trunc)
+frac = _unary("frac", lambda x: x - jnp.trunc(x))
+sign = _unary("sign", jnp.sign)
+sgn = sign
+reciprocal = _unary("reciprocal", jnp.reciprocal)
+neg = _unary("neg", jnp.negative)
+negative = neg
+erf = _unary("erf", jsp.erf)
+erfinv = _unary("erfinv", jsp.erfinv)
+lgamma = _unary("lgamma", jsp.gammaln)
+digamma = _unary("digamma", jsp.digamma)
+polygamma = lambda x, n, name=None: apply_op("polygamma", lambda v: jsp.polygamma(n, v), x)
+i0 = _unary("i0", jsp.i0)
+i0e = _unary("i0e", jsp.i0e)
+i1 = _unary("i1", jsp.i1)
+i1e = _unary("i1e", jsp.i1e)
+sigmoid = _unary("sigmoid", jax.nn.sigmoid)
+logit = lambda x, eps=None, name=None: apply_op(
+    "logit",
+    lambda v: jsp.logit(jnp.clip(v, eps, 1 - eps) if eps is not None else v),
+    x,
+)
+real = _unary("real", jnp.real)
+imag = _unary("imag", jnp.imag)
+conj = _unary("conj", jnp.conj)
+angle = _unary("angle", jnp.angle)
+deg2rad = _unary("deg2rad", jnp.deg2rad)
+rad2deg = _unary("rad2deg", jnp.rad2deg)
+exponent = _unary("exponent", lambda x: jnp.frexp(x)[1].astype(jnp.int32))
+
+
+def round(x, decimals=0, name=None):
+    return apply_op("round", lambda v: jnp.round(v, decimals), x)
+
+
+def rint(x, name=None):
+    return apply_op("rint", jnp.rint, x)
+
+
+def increment(x, value=1.0, name=None):
+    out = apply_op("increment", lambda v: v + jnp.asarray(value, v.dtype), x)
+    x._data = out._data
+    x._grad_node = out._grad_node
+    x._out_index = out._out_index
+    return x
+
+
+def clip(x, min=None, max=None, name=None):
+    # Tensor bounds stay in-graph (differentiable + trace-safe), scalars are
+    # closed over.
+    if isinstance(min, Tensor) and isinstance(max, Tensor):
+        return apply_op("clip", lambda v, lo, hi: jnp.clip(v, lo, hi), x, min, max)
+    if isinstance(min, Tensor):
+        return apply_op("clip", lambda v, lo: jnp.clip(v, lo, max), x, min)
+    if isinstance(max, Tensor):
+        return apply_op("clip", lambda v, hi: jnp.clip(v, min, hi), x, max)
+    return apply_op("clip", lambda v: jnp.clip(v, min, max), x)
+
+
+def lerp(x, y, weight, name=None):
+    return apply_op("lerp", lambda a, b, w: a + w * (b - a), x, y, weight)
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return apply_op(
+        "nan_to_num", lambda v: jnp.nan_to_num(v, nan=nan, posinf=posinf, neginf=neginf), x
+    )
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return apply_op("stanh", lambda v: scale_b * jnp.tanh(scale_a * v), x)
+
+
+def multiplex(inputs, index, name=None):
+    return apply_op(
+        "multiplex",
+        lambda idx, *ins: jnp.stack(ins, 0)[idx.reshape(-1), jnp.arange(ins[0].shape[0])],
+        index,
+        *inputs,
+    )
+
+
+# --- reductions ---
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    want = dtype_mod.to_jax_dtype(dtype) if dtype is not None else None
+
+    def fn(v):
+        out = jnp.sum(v, axis=_axis(axis), keepdims=keepdim)
+        return out.astype(want) if want is not None else out
+
+    return apply_op("sum", fn, x)
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    return apply_op("mean", lambda v: jnp.mean(v, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    want = dtype_mod.to_jax_dtype(dtype) if dtype is not None else None
+
+    def fn(v):
+        out = jnp.prod(v, axis=_axis(axis), keepdims=keepdim)
+        return out.astype(want) if want is not None else out
+
+    return apply_op("prod", fn, x)
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    return apply_op("max", lambda v: jnp.max(v, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    return apply_op("min", lambda v: jnp.min(v, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def amax(x, axis=None, keepdim=False, name=None):
+    return max(x, axis, keepdim)
+
+
+def amin(x, axis=None, keepdim=False, name=None):
+    return min(x, axis, keepdim)
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    return apply_op("nansum", lambda v: jnp.nansum(v, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    return apply_op("nanmean", lambda v: jnp.nanmean(v, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return apply_op(
+        "logsumexp", lambda v: jsp.logsumexp(v, axis=_axis(axis), keepdims=keepdim), x
+    )
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply_op("trace", lambda v: jnp.trace(v, offset, axis1, axis2), x)
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply_op("diagonal", lambda v: jnp.diagonal(v, offset, axis1, axis2), x)
+
+
+# --- cumulative ---
+def cumsum(x, axis=None, dtype=None, name=None):
+    def fn(v):
+        out = jnp.cumsum(v if axis is not None else v.reshape(-1), axis=axis if axis is not None else 0)
+        return out
+
+    return apply_op("cumsum", fn, x)
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    return apply_op("cumprod", lambda v: jnp.cumprod(v, axis=dim), x)
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    def fn(v):
+        a = 0 if axis is None else axis
+        vv = v.reshape(-1) if axis is None else v
+        values = jax.lax.associative_scan(jnp.maximum, vv, axis=a)
+        eq = vv == values
+        idx = jnp.arange(vv.shape[a]).reshape([-1 if i == a % vv.ndim else 1 for i in range(vv.ndim)])
+        indices = jax.lax.associative_scan(jnp.maximum, jnp.where(eq, idx, 0), axis=a)
+        return values, indices.astype(jnp.int64)
+
+    return apply_op("cummax", fn, x)
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    def fn(v):
+        a = 0 if axis is None else axis
+        vv = v.reshape(-1) if axis is None else v
+        values = jax.lax.associative_scan(jnp.minimum, vv, axis=a)
+        eq = vv == values
+        idx = jnp.arange(vv.shape[a]).reshape([-1 if i == a % vv.ndim else 1 for i in range(vv.ndim)])
+        indices = jax.lax.associative_scan(jnp.maximum, jnp.where(eq, idx, 0), axis=a)
+        return values, indices.astype(jnp.int64)
+
+    return apply_op("cummin", fn, x)
+
+
+def logcumsumexp(x, axis=None, name=None):
+    def fn(v):
+        vv = v.reshape(-1) if axis is None else v
+        a = 0 if axis is None else axis
+        return jax.lax.associative_scan(jnp.logaddexp, vv, axis=a)
+
+    return apply_op("logcumsumexp", fn, x)
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    args = [x]
+    if prepend is not None:
+        args.append(prepend)
+    if append is not None:
+        args.append(append)
+
+    def fn(v, *rest):
+        pre = rest[0] if prepend is not None else None
+        app = rest[-1] if append is not None and len(rest) > (1 if prepend is not None else 0) else (
+            rest[0] if append is not None and prepend is None else None
+        )
+        return jnp.diff(v, n=n, axis=axis, prepend=pre, append=app)
+
+    return apply_op("diff", fn, *args)
+
+
+# --- checks ---
+isfinite = _unary("isfinite", jnp.isfinite)
+isinf = _unary("isinf", jnp.isinf)
+isnan = _unary("isnan", jnp.isnan)
+isreal = _unary("isreal", jnp.isreal)
+isneginf = _unary("isneginf", jnp.isneginf)
+isposinf = _unary("isposinf", jnp.isposinf)
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    return apply_op("all", lambda v: jnp.all(v, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    return apply_op("any", lambda v: jnp.any(v, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return apply_op(
+        "count_nonzero",
+        lambda v: jnp.count_nonzero(v, axis=_axis(axis), keepdims=keepdim).astype(jnp.int64),
+        x,
+    )
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def take(x, index, mode="raise", name=None):
+    if mode == "raise":
+        # Bounds-check eagerly when concrete (paddle raises on OOB); traced
+        # values can't raise, fall back to clip there.
+        idx_data = index._data if isinstance(index, Tensor) else jnp.asarray(index)
+        n = x._data.size
+        if not isinstance(idx_data, jax.core.Tracer) and not isinstance(
+            x._data, jax.core.Tracer
+        ):
+            import numpy as _np
+
+            idx_np = _np.asarray(idx_data)
+            if idx_np.size and (idx_np.min() < -n or idx_np.max() >= n):
+                raise IndexError(
+                    f"take index out of range for tensor of {n} elements"
+                )
+        mode = "clip"
+    jmode = {"clip": "clip", "wrap": "wrap"}[mode]
+
+    def fn(v, i):
+        flat = v.reshape(-1)
+        i = jnp.where(i < 0, i + flat.shape[0], i)  # paddle: negatives index from end
+        return jnp.take(flat, i, mode=jmode)
+
+    return apply_op("take", fn, x, index)

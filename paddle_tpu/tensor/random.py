@@ -1,0 +1,176 @@
+"""Random sampling ops.
+
+Parity: python/paddle/tensor/random.py. All draws consume keys from the global
+default_generator (framework/random.py) so seeding/reproducibility matches
+paddle.seed semantics, and jit tracing can thread keys as inputs.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..autograd.engine import apply_op
+from ..framework import config
+from ..framework import dtype as dtype_mod
+from ..framework.random import default_generator
+from .creation import _shape_list
+from .tensor import Tensor
+
+
+def _resolve(dtype):
+    if dtype is None:
+        return dtype_mod.to_jax_dtype(config.get_default_dtype())
+    return dtype_mod.to_jax_dtype(dtype)
+
+
+def rand(shape, dtype=None, name=None) -> Tensor:
+    key = default_generator.next_key()
+    return Tensor(jax.random.uniform(key, _shape_list(shape), _resolve(dtype)))
+
+
+def randn(shape, dtype=None, name=None) -> Tensor:
+    key = default_generator.next_key()
+    return Tensor(jax.random.normal(key, _shape_list(shape), _resolve(dtype)))
+
+
+def standard_normal(shape, dtype=None, name=None) -> Tensor:
+    return randn(shape, dtype)
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None) -> Tensor:
+    key = default_generator.next_key()
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._data if isinstance(mean, Tensor) else mean
+        s = std._data if isinstance(std, Tensor) else std
+        out_shape = np.broadcast_shapes(
+            np.shape(m) if not isinstance(m, jax.Array) else m.shape,
+            np.shape(s) if not isinstance(s, jax.Array) else s.shape,
+        )
+        return Tensor(jax.random.normal(key, out_shape) * s + m)
+    shape = _shape_list(shape) if shape is not None else []
+    return Tensor(jax.random.normal(key, shape) * std + mean)
+
+
+def gaussian(shape, mean=0.0, std=1.0, seed=0, dtype=None, name=None) -> Tensor:
+    key = default_generator.next_key() if seed == 0 else jax.random.key(seed)
+    return Tensor(jax.random.normal(key, _shape_list(shape), _resolve(dtype)) * std + mean)
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None) -> Tensor:
+    key = default_generator.next_key() if seed == 0 else jax.random.key(seed)
+    return Tensor(
+        jax.random.uniform(key, _shape_list(shape), _resolve(dtype), minval=min, maxval=max)
+    )
+
+
+def randint(low=0, high=None, shape=[1], dtype="int64", name=None) -> Tensor:
+    if high is None:
+        low, high = 0, low
+    key = default_generator.next_key()
+    return Tensor(
+        jax.random.randint(key, _shape_list(shape), low, high).astype(
+            dtype_mod.to_jax_dtype(dtype)
+        )
+    )
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None) -> Tensor:
+    if high is None:
+        low, high = 0, low
+    key = default_generator.next_key()
+    want = dtype_mod.to_jax_dtype(dtype) if dtype is not None else x._data.dtype
+    return Tensor(jax.random.randint(key, x._data.shape, low, high).astype(want))
+
+
+def randperm(n, dtype="int64", name=None) -> Tensor:
+    key = default_generator.next_key()
+    return Tensor(jax.random.permutation(key, n).astype(dtype_mod.to_jax_dtype(dtype)))
+
+
+def shuffle(x, axis=0):
+    key = default_generator.next_key()
+    return apply_op("shuffle", lambda v: jax.random.permutation(key, v, axis=axis), x)
+
+
+def bernoulli(x, name=None) -> Tensor:
+    key = default_generator.next_key()
+    return apply_op(
+        "bernoulli",
+        lambda p: jax.random.bernoulli(key, p.astype(jnp.float32)).astype(p.dtype),
+        x,
+    )
+
+
+def bernoulli_(x, p=0.5, name=None):
+    key = default_generator.next_key()
+    x._data = jax.random.bernoulli(key, p, x._data.shape).astype(x._data.dtype)
+    return x
+
+
+def poisson(x, name=None) -> Tensor:
+    key = default_generator.next_key()
+    return apply_op(
+        "poisson", lambda lam: jax.random.poisson(key, lam.astype(jnp.float32)).astype(lam.dtype), x
+    )
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None) -> Tensor:
+    key = default_generator.next_key()
+
+    def fn(probs):
+        p = probs / jnp.sum(probs, axis=-1, keepdims=True)
+        if probs.ndim == 1:
+            return jax.random.choice(
+                key, probs.shape[-1], (num_samples,), replace=replacement, p=p
+            ).astype(jnp.int64)
+        keys = jax.random.split(key, probs.shape[0])
+        return jnp.stack(
+            [
+                jax.random.choice(k, probs.shape[-1], (num_samples,), replace=replacement, p=pp)
+                for k, pp in zip(keys, p)
+            ]
+        ).astype(jnp.int64)
+
+    return Tensor(fn(x._data))
+
+
+def rand_like(x, dtype=None, name=None):
+    key = default_generator.next_key()
+    want = dtype_mod.to_jax_dtype(dtype) if dtype is not None else x._data.dtype
+    return Tensor(jax.random.uniform(key, x._data.shape, want))
+
+
+def randn_like(x, dtype=None, name=None):
+    key = default_generator.next_key()
+    want = dtype_mod.to_jax_dtype(dtype) if dtype is not None else x._data.dtype
+    return Tensor(jax.random.normal(key, x._data.shape, want))
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    key = default_generator.next_key()
+    x._data = jax.random.uniform(key, x._data.shape, x._data.dtype, minval=min, maxval=max)
+    return x
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    key = default_generator.next_key()
+    x._data = jax.random.normal(key, x._data.shape, x._data.dtype) * std + mean
+    return x
+
+
+def exponential_(x, lam=1.0, name=None):
+    key = default_generator.next_key()
+    x._data = (jax.random.exponential(key, x._data.shape) / lam).astype(x._data.dtype)
+    return x
+
+
+def binomial(count, prob, name=None):
+    key = default_generator.next_key()
+    return apply_op(
+        "binomial",
+        lambda n, p: jax.random.binomial(key, n.astype(jnp.float32), p.astype(jnp.float32)).astype(jnp.int64),
+        count,
+        prob,
+    )

@@ -1,0 +1,211 @@
+"""Search / sort / sampling-index ops.
+
+Parity: python/paddle/tensor/search.py. Ops with data-dependent output shapes
+(nonzero, unique, masked_select) are eager-only, matching the reference's note
+that these break static graphs too.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..autograd.engine import apply_op
+from .tensor import Tensor
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    def fn(v):
+        if axis is None:
+            out = jnp.argmax(v.reshape(-1))
+            return out.reshape((1,) * v.ndim) if keepdim else out
+        out = jnp.argmax(v, axis=axis)
+        return jnp.expand_dims(out, axis) if keepdim else out
+
+    return apply_op("argmax", fn, x).astype(dtype)
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    def fn(v):
+        if axis is None:
+            out = jnp.argmin(v.reshape(-1))
+            return out.reshape((1,) * v.ndim) if keepdim else out
+        out = jnp.argmin(v, axis=axis)
+        return jnp.expand_dims(out, axis) if keepdim else out
+
+    return apply_op("argmin", fn, x).astype(dtype)
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    def fn(v):
+        idx = jnp.argsort(v, axis=axis, stable=stable, descending=descending)
+        return idx.astype(jnp.int64)
+
+    return apply_op("argsort", fn, x)
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    def fn(v):
+        return jnp.sort(v, axis=axis, descending=descending, stable=stable)
+
+    return apply_op("sort", fn, x)
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    if isinstance(k, Tensor):
+        k = int(k.item())
+
+    def fn(v):
+        ax = -1 if axis is None else axis
+        moved = jnp.moveaxis(v, ax, -1)
+        if largest:
+            vals, idx = jax.lax.top_k(moved, k)
+        else:
+            vals, idx = jax.lax.top_k(-moved, k)
+            vals = -vals
+        return jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx.astype(jnp.int64), -1, ax)
+
+    return apply_op("topk", fn, x)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def fn(v):
+        sorted_v = jnp.sort(v, axis=axis)
+        sorted_i = jnp.argsort(v, axis=axis)
+        vals = jnp.take(sorted_v, k - 1, axis=axis)
+        idx = jnp.take(sorted_i, k - 1, axis=axis).astype(jnp.int64)
+        if keepdim:
+            vals = jnp.expand_dims(vals, axis)
+            idx = jnp.expand_dims(idx, axis)
+        return vals, idx
+
+    return apply_op("kthvalue", fn, x)
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    def fn(v):
+        sorted_v = jnp.sort(v, axis=axis)
+        n = v.shape[axis]
+        # mode = value with the longest run in sorted order
+        moved = jnp.moveaxis(sorted_v, axis, -1)
+        eq = moved[..., 1:] == moved[..., :-1]
+        run = jnp.concatenate([jnp.zeros_like(moved[..., :1], dtype=jnp.int32),
+                               jnp.cumsum(eq, axis=-1, dtype=jnp.int32)], axis=-1)
+        # reset cumulative count at run boundaries
+        reset = jnp.where(eq, 0, jnp.arange(1, n, dtype=jnp.int32))
+        start = jax.lax.associative_scan(jnp.maximum, jnp.concatenate(
+            [jnp.zeros_like(moved[..., :1], dtype=jnp.int32), reset], axis=-1), axis=-1)
+        length = jnp.arange(n, dtype=jnp.int32) - start
+        best = jnp.argmax(length, axis=-1)
+        vals = jnp.take_along_axis(moved, best[..., None], axis=-1)[..., 0]
+        idx_sorted = jnp.moveaxis(jnp.argsort(v, axis=axis), axis, -1)
+        idx = jnp.take_along_axis(idx_sorted, best[..., None], axis=-1)[..., 0].astype(jnp.int64)
+        if keepdim:
+            vals = jnp.expand_dims(vals, axis)
+            idx = jnp.expand_dims(idx, axis)
+        return vals, idx
+
+    return apply_op("mode", fn, x)
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return apply_op("where", lambda c, a, b: jnp.where(c, a, b), condition, x, y)
+
+
+def where_(condition, x, y, name=None):
+    from .manipulation import _inplace
+
+    return _inplace(x, where(condition, x, y))
+
+
+def nonzero(x, as_tuple=False):
+    arr = np.asarray(x._data)
+    idx = np.nonzero(arr)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i.astype(np.int64))[:, None]) for i in idx)
+    return Tensor(jnp.asarray(np.stack(idx, axis=1).astype(np.int64)))
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    side = "right" if right else "left"
+
+    def fn(seq, v):
+        if seq.ndim == 1:
+            out = jnp.searchsorted(seq, v, side=side)
+        else:
+            out = jax.vmap(lambda s, w: jnp.searchsorted(s, w, side=side))(
+                seq.reshape(-1, seq.shape[-1]), v.reshape(-1, v.shape[-1])
+            ).reshape(v.shape)
+        return out.astype(jnp.int32 if out_int32 else jnp.int64)
+
+    return apply_op("searchsorted", fn, sorted_sequence, values)
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32, right)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
+    arr = np.asarray(x._data)
+    res = np.unique(arr, return_index=True, return_inverse=True, return_counts=True, axis=axis)
+    vals, index, inverse, counts = res
+    outs = [Tensor(jnp.asarray(vals))]
+    if return_index:
+        outs.append(Tensor(jnp.asarray(index.astype(np.int64))))
+    if return_inverse:
+        outs.append(Tensor(jnp.asarray(inverse.astype(np.int64))))
+    if return_counts:
+        outs.append(Tensor(jnp.asarray(counts.astype(np.int64))))
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
+    arr = np.asarray(x._data)
+    if axis is None:
+        arr = arr.reshape(-1)
+        axis = 0
+    moved = np.moveaxis(arr, axis, 0)
+    keep = np.ones(moved.shape[0], dtype=bool)
+    if moved.shape[0] > 1:
+        flat = moved.reshape(moved.shape[0], -1)
+        keep[1:] = np.any(flat[1:] != flat[:-1], axis=1)
+    vals = np.moveaxis(moved[keep], 0, axis)
+    outs = [Tensor(jnp.asarray(vals))]
+    if return_inverse:
+        inverse = np.cumsum(keep) - 1
+        outs.append(Tensor(jnp.asarray(inverse.astype(np.int64))))
+    if return_counts:
+        idx = np.nonzero(keep)[0]
+        counts = np.diff(np.append(idx, moved.shape[0]))
+        outs.append(Tensor(jnp.asarray(counts.astype(np.int64))))
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+def histogram(input, bins=100, min=0, max=0, weight=None, density=False, name=None):
+    arr = np.asarray(input._data)
+    lo, hi = (float(arr.min()), float(arr.max())) if min == 0 and max == 0 else (min, max)
+    w = np.asarray(weight._data) if weight is not None else None
+    hist, _ = np.histogram(arr, bins=bins, range=(lo, hi), weights=w, density=density)
+    return Tensor(jnp.asarray(hist if density or w is not None else hist.astype(np.int64)))
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None, name=None):
+    arr = np.asarray(x._data)
+    w = np.asarray(weights._data) if weights is not None else None
+    hist, edges = np.histogramdd(arr, bins=bins, range=ranges, density=density, weights=w)
+    return Tensor(jnp.asarray(hist)), [Tensor(jnp.asarray(e)) for e in edges]
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    arr = np.asarray(x._data)
+    w = np.asarray(weights._data) if weights is not None else None
+    return Tensor(jnp.asarray(np.bincount(arr, weights=w, minlength=minlength)))
+
+
+def index_fill_(x, index, axis, value, name=None):
+    from .manipulation import _inplace, index_fill
+
+    return _inplace(x, index_fill(x, index, axis, value))

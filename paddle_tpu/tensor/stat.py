@@ -1,0 +1,64 @@
+"""Statistics ops (paddle.tensor.stat parity)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..autograd.engine import apply_op
+from .math import _axis
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply_op(
+        "std",
+        lambda v: jnp.std(v, axis=_axis(axis), ddof=1 if unbiased else 0, keepdims=keepdim),
+        x,
+    )
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply_op(
+        "var",
+        lambda v: jnp.var(v, axis=_axis(axis), ddof=1 if unbiased else 0, keepdims=keepdim),
+        x,
+    )
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    def fn(v):
+        if mode == "avg":
+            return jnp.median(v, axis=_axis(axis), keepdims=keepdim)
+        # mode == "min": lower median
+        ax = _axis(axis)
+        if ax is None:
+            flat = jnp.sort(v.reshape(-1))
+            return flat[(flat.shape[0] - 1) // 2]
+        srt = jnp.sort(v, axis=ax)
+        idx = (v.shape[ax] - 1) // 2
+        out = jnp.take(srt, idx, axis=ax)
+        return jnp.expand_dims(out, ax) if keepdim else out
+
+    return apply_op("median", fn, x)
+
+
+def nanmedian(x, axis=None, keepdim=False, mode="avg", name=None):
+    return apply_op(
+        "nanmedian", lambda v: jnp.nanmedian(v, axis=_axis(axis), keepdims=keepdim), x
+    )
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    def fn(v):
+        return jnp.quantile(
+            v, jnp.asarray(q), axis=_axis(axis), keepdims=keepdim, method=interpolation
+        )
+
+    return apply_op("quantile", fn, x)
+
+
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    def fn(v):
+        return jnp.nanquantile(
+            v, jnp.asarray(q), axis=_axis(axis), keepdims=keepdim, method=interpolation
+        )
+
+    return apply_op("nanquantile", fn, x)

@@ -1,0 +1,354 @@
+"""The eager Tensor: a define-by-run handle over a jax.Array.
+
+Parity target: paddle.Tensor (reference: paddle/phi/api/include/tensor.h:82 +
+~300 python-patched methods, python/paddle/base/dygraph/tensor_patch_methods.py:78).
+The TPU-native design keeps the handle thin: data is an immutable jax.Array
+(possibly sharded across a Mesh — that's what makes it a "DistTensor"), and
+autograd state lives on the handle. Most methods are bound by the op modules
+via ``register_tensor_method``.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..framework import dtype as dtype_mod
+from ..framework.place import CPUPlace, Place, TPUPlace, _expected_place
+
+
+def _coerce_data(data, dtype=None):
+    if isinstance(data, Tensor):
+        data = data._data
+    if isinstance(data, (jax.Array, jax.core.Tracer)):
+        if dtype is not None:
+            want = dtype_mod.to_jax_dtype(dtype)
+            if data.dtype != want:
+                data = data.astype(want)
+        return data
+    arr = np.asarray(data)
+    if dtype is not None:
+        arr = arr.astype(dtype_mod.to_jax_dtype(dtype))
+    elif arr.dtype == np.float64:
+        # Match the framework default dtype for python floats/np float64 input.
+        from ..framework import config
+
+        arr = arr.astype(dtype_mod.to_jax_dtype(config.get_default_dtype()))
+    return jnp.asarray(arr)
+
+
+class Tensor:
+    __slots__ = (
+        "_data",
+        "stop_gradient",
+        "_grad_node",
+        "_out_index",
+        "grad",
+        "name",
+        "persistable",
+        "_hooks",
+        "_hook_counter",
+        "_placements",
+        "__weakref__",
+        "__dict__",
+    )
+
+    def __init__(self, data, dtype=None, place=None, stop_gradient: bool = True, name=None):
+        self._data = _coerce_data(data, dtype)
+        self.stop_gradient = bool(stop_gradient)
+        self._grad_node = None
+        self._out_index = 0
+        self.grad = None
+        self.name = name or f"generated_tensor_{id(self)}"
+        self.persistable = False
+        self._hooks = {}
+        self._hook_counter = 0
+        self._placements = None  # set for DistTensor (distributed.auto_parallel)
+
+    # --- basic properties ---
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    ndimension = lambda self: self._data.ndim
+    dim = ndimension
+
+    @property
+    def size(self):
+        return int(self._data.size)
+
+    @property
+    def dtype(self) -> dtype_mod.DType:
+        return dtype_mod.convert_dtype(self._data.dtype)
+
+    @property
+    def place(self) -> Place:
+        data = self._data
+        if isinstance(data, jax.core.Tracer):
+            return _expected_place()
+        try:
+            dev = list(data.devices())[0]
+        except Exception:
+            return _expected_place()
+        return CPUPlace(dev.id) if dev.platform == "cpu" else TPUPlace(dev.id)
+
+    @property
+    def is_leaf(self) -> bool:
+        return self._grad_node is None
+
+    @property
+    def is_dist(self) -> bool:
+        return self._placements is not None
+
+    @property
+    def T(self):
+        # paddle semantics: reverse ALL axes (numpy-style), not just the last two.
+        from ..autograd.engine import apply_op
+
+        if self.ndim < 2:
+            return self
+        return apply_op("transpose_all", lambda v: v.T, self)
+
+    @property
+    def mT(self):
+        from ..autograd.engine import apply_op
+
+        return apply_op("mT", lambda v: jnp.swapaxes(v, -1, -2), self)
+
+    # (.real()/.imag() are bound as methods by tensor/__init__.py, matching
+    #  paddle's method spelling rather than torch's property spelling.)
+
+    # --- conversions ---
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._data)
+
+    def __array__(self, dtype=None):
+        arr = self.numpy()
+        return arr.astype(dtype) if dtype is not None else arr
+
+    def item(self, *args):
+        if args:
+            return self.numpy().item(*args)
+        return self.numpy().item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def astype(self, dtype):
+        from ..autograd.engine import apply_op
+
+        want = dtype_mod.to_jax_dtype(dtype)
+        return apply_op("cast", lambda x: x.astype(want), self)
+
+    cast = astype
+
+    def cpu(self):
+        out = Tensor(jax.device_put(self._data, jax.devices("cpu")[0]))
+        out.stop_gradient = self.stop_gradient
+        return out
+
+    def to(self, *args, **kwargs):
+        t = self
+        for a in list(args) + list(kwargs.values()):
+            if isinstance(a, (str, Place)) and not isinstance(a, str) or (
+                isinstance(a, str) and a.split(":")[0] in ("cpu", "tpu", "gpu", "xpu")
+            ):
+                continue  # device moves are no-ops inside one backend
+            try:
+                t = t.astype(a)
+            except (TypeError, ValueError):
+                pass
+        return t
+
+    # --- autograd surface ---
+    def backward(self, grad_tensor=None, retain_graph: bool = False):
+        from ..autograd.backward import run_backward
+
+        run_backward([self], [grad_tensor], retain_graph=retain_graph)
+
+    def detach(self) -> "Tensor":
+        out = Tensor(self._data, stop_gradient=True)
+        out._placements = self._placements
+        return out
+
+    def detach_(self):
+        self._grad_node = None
+        self.stop_gradient = True
+        return self
+
+    def clone(self) -> "Tensor":
+        from ..autograd.engine import apply_op
+
+        return apply_op("clone", lambda x: x + 0, self)
+
+    def register_hook(self, hook):
+        self._hook_counter += 1
+        hook_id = self._hook_counter
+        self._hooks[hook_id] = hook
+
+        class _Handle:
+            def remove(inner):
+                self._hooks.pop(hook_id, None)
+
+        return _Handle()
+
+    def clear_grad(self, set_to_zero: bool = False):
+        if set_to_zero and self.grad is not None:
+            self.grad = Tensor(jnp.zeros_like(self.grad._data))
+        else:
+            self.grad = None
+
+    clear_gradient = clear_grad
+
+    def retain_grads(self):
+        # Non-leaf grad retention: install a hook that stores the grad.
+        if self.is_leaf:
+            return
+
+        def _store(g):
+            self.grad = g.detach() if isinstance(g, Tensor) else Tensor(g)
+            return None
+
+        self.register_hook(_store)
+
+    # --- mutation (functional under the hood; autograd-safe) ---
+    def set_value(self, value):
+        new = _coerce_data(value, None)
+        if tuple(new.shape) != tuple(self._data.shape):
+            raise ValueError(
+                f"set_value shape mismatch: {tuple(new.shape)} vs {tuple(self._data.shape)}"
+            )
+        if new.dtype != self._data.dtype:
+            new = new.astype(self._data.dtype)
+        self._data = new
+        return self
+
+    def copy_(self, other, *args):
+        return self.set_value(other)
+
+    def fill_(self, value):
+        self._data = jnp.full_like(self._data, value)
+        return self
+
+    def zero_(self):
+        return self.fill_(0)
+
+    # --- python protocol ---
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._data.shape[0]
+
+    def __bool__(self):
+        return bool(self.numpy())
+
+    def __int__(self):
+        return int(self.numpy())
+
+    def __float__(self):
+        return float(self.numpy())
+
+    def __index__(self):
+        return int(self.numpy())
+
+    def __hash__(self):
+        return id(self)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __repr__(self):
+        grad_part = "" if self.stop_gradient else ", stop_gradient=False"
+        if isinstance(self._data, jax.core.Tracer):
+            return (
+                f"Tensor(shape={self.shape}, dtype={self.dtype.name}{grad_part}, "
+                f"traced={self._data})"
+            )
+        return (
+            f"Tensor(shape={self.shape}, dtype={self.dtype.name}, "
+            f"place={self.place}{grad_part},\n       {self.numpy()})"
+        )
+
+    def __format__(self, spec):
+        if self.ndim == 0:
+            return format(self.item(), spec)
+        return repr(self)
+
+    def __dlpack__(self, *a, **k):
+        return self._data.__dlpack__(*a, **k)
+
+    # element_size / nbytes
+    def element_size(self):
+        return self.dtype.itemsize
+
+    @property
+    def nbytes(self):
+        return self.size * self.element_size()
+
+    def numel(self):
+        return self.size
+
+    def is_contiguous(self):
+        return True
+
+    def contiguous(self):
+        return self
+
+    def pin_memory(self):
+        return self
+
+    def cuda(self, *a, **k):
+        return self
+
+    def value(self):
+        return self
+
+    def get_tensor(self):
+        return self
+
+    def _is_initialized(self):
+        return True
+
+    # Distributed surface (filled by paddle_tpu.distributed):
+    @property
+    def placements(self):
+        return self._placements
+
+    @property
+    def process_mesh(self):
+        if self._placements is None:
+            return None
+        from ..distributed.auto_parallel.api import _mesh_of
+
+        return _mesh_of(self)
+
+
+def register_tensor_method(name: str, fn):
+    """Bind a function as a Tensor method (tensor_patch_methods parity)."""
+    setattr(Tensor, name, fn)
+
+
+class Parameter(Tensor):
+    """A trainable Tensor (paddle.base.framework.EagerParamBase parity)."""
+
+    def __init__(self, data, dtype=None, trainable: bool = True, name=None):
+        super().__init__(data, dtype=dtype, stop_gradient=not trainable, name=name)
+        self.persistable = True
+        self.trainable = trainable
+
+    @property
+    def trainable(self):
+        return not self.stop_gradient
+
+    @trainable.setter
+    def trainable(self, value):
+        self.stop_gradient = not value
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()

@@ -1,0 +1,36 @@
+"""Test fixture: force an 8-device virtual CPU mesh BEFORE jax initializes.
+
+Mirrors the reference's test strategy (SURVEY.md §4): multi-device tests run on
+local virtual devices, no cluster needed. Real-TPU runs (bench.py, graft entry)
+don't import this.
+
+NOTE: this environment's sitecustomize registers an "axon" TPU-tunnel platform
+and force-sets jax_platforms="axon,cpu" in every process, overriding the
+JAX_PLATFORMS env var. Backend init is lazy, so overriding the config here
+(before any jnp op runs) pins the suite to the virtual CPU mesh.
+"""
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(2024)
+
+
+@pytest.fixture(autouse=True)
+def _seeded():
+    import paddle_tpu
+
+    paddle_tpu.seed(1234)
+    yield

@@ -1,0 +1,276 @@
+"""Autograd engine tests.
+
+Parity targets: backward semantics of egr::Backward (reference:
+paddle/fluid/eager/backward.cc) — grad accumulation, retain_graph, hooks,
+paddle.grad partial graphs, stop_gradient, no_grad, double backward, PyLayer.
+Gradients are checked against hand-derived formulas (OpTest-style).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def t(arr, sg=False):
+    return paddle.to_tensor(np.asarray(arr, np.float32), stop_gradient=sg)
+
+
+class TestBackwardBasics:
+    def test_simple_chain(self):
+        x = t([2.0, 3.0])
+        y = (x * x).sum()
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [4.0, 6.0])
+
+    def test_grad_accumulation(self):
+        x = t([1.0])
+        for _ in range(3):
+            (x * 2).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [6.0])
+        x.clear_grad()
+        assert x.grad is None
+
+    def test_branching_graph(self):
+        x = t([2.0])
+        a = x * 3
+        b = x * 5
+        (a + b).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [8.0])
+
+    def test_diamond(self):
+        x = t([2.0])
+        y = x * x  # 4
+        z = y + y * y  # 4 + 16; dz/dy = 1 + 2y = 9; dy/dx = 2x = 4 -> 36
+        z.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [36.0])
+
+    def test_stop_gradient_blocks(self):
+        x = t([1.0])
+        w = t([2.0], sg=True)
+        y = (x * w).sum()
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0])
+        assert w.grad is None
+
+    def test_detach(self):
+        x = t([3.0])
+        y = x * 2
+        z = y.detach() * x
+        z.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [6.0])  # only via direct x
+
+    def test_no_grad_context(self):
+        x = t([1.0])
+        with paddle.no_grad():
+            y = x * 2
+        assert y.stop_gradient and y._grad_node is None
+
+    def test_non_scalar_backward_seeds_ones(self):
+        # paddle parity: None grad_tensor means ones for ANY shape
+        x = t([1.0, 2.0])
+        y = x * 2
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+        x.clear_grad()
+        y2 = x * 2
+        y2.backward(paddle.to_tensor(np.float32([1.0, 0.5])))
+        np.testing.assert_allclose(x.grad.numpy(), [2.0, 1.0])
+
+    def test_retain_graph(self):
+        x = t([2.0])
+        y = (x * x).sum()
+        y.backward(retain_graph=True)
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [8.0])
+        with pytest.raises(RuntimeError):
+            y.backward()
+
+    def test_matmul_grad(self):
+        a_np = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+        b_np = np.random.RandomState(1).randn(4, 2).astype(np.float32)
+        a, b = t(a_np), t(b_np)
+        paddle.matmul(a, b).sum().backward()
+        np.testing.assert_allclose(a.grad.numpy(), np.ones((3, 2)) @ b_np.T, rtol=1e-5)
+        np.testing.assert_allclose(b.grad.numpy(), a_np.T @ np.ones((3, 2)), rtol=1e-5)
+
+    def test_broadcast_grad_reduces(self):
+        x = t(np.ones((3, 4)))
+        b = t(np.ones((4,)))
+        (x + b).sum().backward()
+        np.testing.assert_allclose(b.grad.numpy(), [3.0] * 4)
+
+    def test_multi_output_op(self):
+        x = t(np.float32([[1, 5, 3]]))
+        v, i = paddle.topk(x, 2)
+        v.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [[0, 1, 1]])
+
+    def test_indexing_grad(self):
+        x = t([1.0, 2.0, 3.0])
+        (x[1:] * 2).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [0, 2, 2])
+
+
+class TestPaddleGrad:
+    def test_grad_basic(self):
+        x = t([3.0])
+        y = x * x
+        (gx,) = paddle.grad(y, x)
+        np.testing.assert_allclose(gx.numpy(), [6.0])
+        assert x.grad is None  # paddle.grad does not touch .grad
+
+    def test_grad_intermediate(self):
+        x = t([2.0])
+        y = x * x
+        z = y * 3
+        (gy,) = paddle.grad(z, y)
+        np.testing.assert_allclose(gy.numpy(), [3.0])
+
+    def test_grad_multiple_inputs(self):
+        x, w = t([2.0]), t([5.0])
+        y = x * w
+        gx, gw = paddle.grad(y, [x, w])
+        np.testing.assert_allclose(gx.numpy(), [5.0])
+        np.testing.assert_allclose(gw.numpy(), [2.0])
+
+    def test_allow_unused(self):
+        x, z = t([1.0]), t([1.0])
+        y = x * 2
+        with pytest.raises(RuntimeError):
+            paddle.grad(y, [x, z])
+        gx, gz = paddle.grad(y, [x, z], allow_unused=True)
+        assert gz is None
+
+    def test_double_backward(self):
+        x = t([2.0])
+        y = x * x * x  # y = x^3, y' = 3x^2, y'' = 6x
+        (gx,) = paddle.grad(y, x, create_graph=True)
+        np.testing.assert_allclose(gx.numpy(), [12.0])
+        (ggx,) = paddle.grad(gx, x)
+        np.testing.assert_allclose(ggx.numpy(), [12.0])
+
+    def test_double_backward_sin(self):
+        x = t([1.0])
+        y = paddle.sin(x)
+        (g1,) = paddle.grad(y, x, create_graph=True)
+        (g2,) = paddle.grad(g1, x)
+        np.testing.assert_allclose(g2.numpy(), [-np.sin(1.0)], rtol=1e-5)
+
+
+class TestHooks:
+    def test_leaf_hook_modifies_grad(self):
+        x = t([1.0])
+        x.register_hook(lambda g: g * 10)
+        (x * 2).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [20.0])
+
+    def test_intermediate_hook(self):
+        seen = []
+        x = t([1.0])
+        y = x * 2
+        y.register_hook(lambda g: seen.append(g.numpy().copy()))
+        (y * 3).sum().backward()
+        assert len(seen) == 1
+        np.testing.assert_allclose(seen[0], [3.0])
+        np.testing.assert_allclose(x.grad.numpy(), [6.0])
+
+    def test_hook_remove(self):
+        x = t([1.0])
+        h = x.register_hook(lambda g: g * 10)
+        h.remove()
+        (x * 2).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+    def test_retain_grads_non_leaf(self):
+        x = t([2.0])
+        y = x * 3
+        y.retain_grads()
+        (y * y).sum().backward()
+        np.testing.assert_allclose(y.grad.numpy(), [12.0])
+
+
+class TestPyLayer:
+    def test_custom_forward_backward(self):
+        class Cube(paddle.autograd.PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * x * x
+
+            @staticmethod
+            def backward(ctx, gy):
+                (x,) = ctx.saved_tensor()
+                return gy * 3 * x * x
+
+        x = t([2.0])
+        y = Cube.apply(x)
+        np.testing.assert_allclose(y.numpy(), [8.0])
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [12.0])
+
+    def test_multi_input_output(self):
+        class MulAdd(paddle.autograd.PyLayer):
+            @staticmethod
+            def forward(ctx, a, b):
+                ctx.save_for_backward(a, b)
+                return a * b, a + b
+
+            @staticmethod
+            def backward(ctx, ga, gb):
+                a, b = ctx.saved_tensor()
+                return ga * b + gb, ga * a + gb
+
+        a, b = t([2.0]), t([3.0])
+        p, s = MulAdd.apply(a, b)
+        (p + s).sum().backward()
+        np.testing.assert_allclose(a.grad.numpy(), [4.0])  # b + 1
+        np.testing.assert_allclose(b.grad.numpy(), [3.0])  # a + 1
+
+    def test_non_differentiable_input(self):
+        # paddle contract: backward returns one grad per forward tensor input,
+        # including stop_gradient ones (None for those).
+        class MaskedScale(paddle.autograd.PyLayer):
+            @staticmethod
+            def forward(ctx, x, mask):
+                ctx.save_for_backward(mask)
+                return x * mask
+
+            @staticmethod
+            def backward(ctx, gy):
+                (mask,) = ctx.saved_tensor()
+                return gy * mask, None
+
+        x = t([1.0, 2.0])
+        mask = t([1.0, 0.0], sg=True)
+        y = MaskedScale.apply(x, mask)
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [1.0, 0.0])
+
+
+class TestNumericalGradient:
+    """Finite-difference checks (OpTest gradient checking parity)."""
+
+    @pytest.mark.parametrize(
+        "op",
+        [
+            lambda x: paddle.tanh(x).sum(),
+            lambda x: (x * paddle.sigmoid(x)).sum(),
+            lambda x: paddle.logsumexp(x),
+            lambda x: paddle.sqrt(paddle.square(x).sum() + 1.0),
+        ],
+    )
+    def test_fd_matches(self, op, rng):
+        x_np = rng.randn(4, 5).astype(np.float64)
+        x = paddle.to_tensor(x_np.astype(np.float32), stop_gradient=False)
+        y = op(x)
+        y.backward()
+        eps = 1e-3
+        fd = np.zeros_like(x_np, np.float64)
+        for i in range(x_np.size):
+            xp, xm = x_np.reshape(-1).copy(), x_np.reshape(-1).copy()
+            xp[i] += eps
+            xm[i] -= eps
+            yp = op(paddle.to_tensor(xp.reshape(x_np.shape).astype(np.float32), stop_gradient=True))
+            ym = op(paddle.to_tensor(xm.reshape(x_np.shape).astype(np.float32), stop_gradient=True))
+            fd.reshape(-1)[i] = (float(yp.numpy()) - float(ym.numpy())) / (2 * eps)
+        np.testing.assert_allclose(x.grad.numpy(), fd, atol=2e-2, rtol=2e-2)

@@ -1,0 +1,261 @@
+"""Op-level golden tests vs numpy (OpTest parity — reference
+test/legacy_test/op_test.py:420 checks forward against numpy reference impls)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def t(arr, **kw):
+    return paddle.to_tensor(arr, **kw)
+
+
+class TestCreation:
+    def test_to_tensor_numpy_roundtrip(self):
+        x = t(np.arange(6).reshape(2, 3).astype(np.float32))
+        assert x.shape == [2, 3]
+        assert x.dtype == paddle.float32
+        np.testing.assert_array_equal(x.numpy(), np.arange(6).reshape(2, 3))
+
+    def test_default_dtype_for_python_floats(self):
+        assert t([1.0, 2.0]).dtype == paddle.float32
+        assert t([1, 2]).dtype == paddle.int64 or t([1, 2]).dtype == paddle.int32
+
+    def test_zeros_ones_full(self):
+        assert paddle.zeros([2, 3]).numpy().sum() == 0
+        assert paddle.ones([4], dtype="int64").numpy().sum() == 4
+        np.testing.assert_array_equal(paddle.full([2], 7).numpy(), [7, 7])
+
+    def test_arange_linspace_eye(self):
+        np.testing.assert_array_equal(paddle.arange(5).numpy(), np.arange(5))
+        np.testing.assert_allclose(
+            paddle.linspace(0, 1, 5).numpy(), np.linspace(0, 1, 5), rtol=1e-6
+        )
+        np.testing.assert_array_equal(paddle.eye(3).numpy(), np.eye(3, dtype=np.float32))
+
+    def test_tril_triu(self):
+        a = np.arange(9).reshape(3, 3).astype(np.float32)
+        np.testing.assert_array_equal(paddle.tril(t(a)).numpy(), np.tril(a))
+        np.testing.assert_array_equal(paddle.triu(t(a), 1).numpy(), np.triu(a, 1))
+
+
+class TestMath:
+    def test_binary_ops(self, rng):
+        a = rng.randn(3, 4).astype(np.float32)
+        b = rng.randn(3, 4).astype(np.float32)
+        np.testing.assert_allclose((t(a) + t(b)).numpy(), a + b, rtol=1e-6)
+        np.testing.assert_allclose((t(a) * t(b)).numpy(), a * b, rtol=1e-6)
+        np.testing.assert_allclose((t(a) - 2.5).numpy(), a - 2.5, rtol=1e-6)
+        np.testing.assert_allclose((3.0 / t(np.abs(a) + 1)).numpy(), 3.0 / (np.abs(a) + 1), rtol=1e-6)
+
+    def test_matmul(self, rng):
+        a = rng.randn(2, 3, 4).astype(np.float32)
+        b = rng.randn(2, 4, 5).astype(np.float32)
+        np.testing.assert_allclose(paddle.matmul(t(a), t(b)).numpy(), a @ b, rtol=1e-5)
+        np.testing.assert_allclose(
+            paddle.matmul(t(a), t(b.swapaxes(-1, -2)), transpose_y=True).numpy(),
+            a @ b,
+            rtol=1e-5,
+        )
+
+    def test_reductions(self, rng):
+        a = rng.randn(3, 4, 5).astype(np.float32)
+        np.testing.assert_allclose(paddle.sum(t(a)).numpy(), a.sum(), rtol=1e-5)
+        np.testing.assert_allclose(
+            paddle.mean(t(a), axis=1).numpy(), a.mean(axis=1), rtol=1e-5
+        )
+        np.testing.assert_allclose(
+            paddle.max(t(a), axis=[0, 2], keepdim=True).numpy(),
+            a.max(axis=(0, 2), keepdims=True),
+            rtol=1e-6,
+        )
+        np.testing.assert_allclose(
+            t(a).prod(axis=-1).numpy(), a.prod(axis=-1), rtol=1e-4
+        )
+
+    def test_unary(self, rng):
+        a = np.abs(rng.randn(10)).astype(np.float32) + 0.1
+        # XLA's vectorized f32 transcendentals differ from numpy's in the last
+        # few ulps; same tolerance class OpTest uses for fp32.
+        tol = dict(rtol=5e-4, atol=1e-4)
+        np.testing.assert_allclose(paddle.sqrt(t(a)).numpy(), np.sqrt(a), **tol)
+        np.testing.assert_allclose(paddle.log(t(a)).numpy(), np.log(a), **tol)
+        np.testing.assert_allclose(paddle.tanh(t(a)).numpy(), np.tanh(a), **tol)
+        np.testing.assert_allclose(t(a).rsqrt().numpy(), 1 / np.sqrt(a), **tol)
+
+    def test_cumulative(self, rng):
+        a = rng.randn(3, 4).astype(np.float32)
+        np.testing.assert_allclose(paddle.cumsum(t(a), axis=1).numpy(), a.cumsum(axis=1), rtol=1e-5)
+        np.testing.assert_allclose(paddle.cumsum(t(a)).numpy(), a.cumsum(), rtol=1e-5)
+        v, i = paddle.cummax(t(a), axis=0)
+        np.testing.assert_allclose(v.numpy(), np.maximum.accumulate(a, axis=0), rtol=1e-6)
+
+    def test_clip_round_sign(self, rng):
+        a = rng.randn(8).astype(np.float32)
+        np.testing.assert_allclose(paddle.clip(t(a), -0.5, 0.5).numpy(), a.clip(-0.5, 0.5))
+        np.testing.assert_array_equal(paddle.sign(t(a)).numpy(), np.sign(a))
+
+    def test_dtype_promotion(self):
+        x = t(np.ones(3, np.float32))
+        y = t(np.ones(3, np.int32))
+        assert (x + y).dtype == paddle.float32
+
+    def test_einsum(self, rng):
+        a = rng.randn(3, 4).astype(np.float32)
+        b = rng.randn(4, 5).astype(np.float32)
+        np.testing.assert_allclose(
+            paddle.einsum("ij,jk->ik", t(a), t(b)).numpy(), a @ b, rtol=1e-5
+        )
+
+
+class TestManipulation:
+    def test_reshape_transpose_flatten(self, rng):
+        a = rng.randn(2, 3, 4).astype(np.float32)
+        assert paddle.reshape(t(a), [4, 6]).shape == [4, 6]
+        assert paddle.transpose(t(a), [2, 0, 1]).shape == [4, 2, 3]
+        assert paddle.flatten(t(a), 1, 2).shape == [2, 12]
+        assert t(a).T.shape == [4, 3, 2]
+
+    def test_concat_stack_split(self, rng):
+        a = rng.randn(2, 3).astype(np.float32)
+        b = rng.randn(2, 3).astype(np.float32)
+        np.testing.assert_array_equal(
+            paddle.concat([t(a), t(b)], axis=0).numpy(), np.concatenate([a, b], 0)
+        )
+        np.testing.assert_array_equal(
+            paddle.stack([t(a), t(b)], axis=1).numpy(), np.stack([a, b], 1)
+        )
+        parts = paddle.split(t(a), 3, axis=1)
+        assert len(parts) == 3 and parts[0].shape == [2, 1]
+        parts = paddle.split(t(a), [1, -1], axis=1)
+        assert parts[1].shape == [2, 2]
+
+    def test_squeeze_unsqueeze_expand(self, rng):
+        a = rng.randn(1, 3, 1).astype(np.float32)
+        assert paddle.squeeze(t(a)).shape == [3]
+        assert paddle.squeeze(t(a), axis=0).shape == [3, 1]
+        assert paddle.unsqueeze(t(a), [0, 4]).shape == [1, 1, 3, 1, 1]
+        assert paddle.expand(t(np.float32([[1], [2]])), [2, 3]).shape == [2, 3]
+
+    def test_gather_scatter(self, rng):
+        a = rng.randn(5, 3).astype(np.float32)
+        idx = np.array([0, 2, 4])
+        np.testing.assert_array_equal(paddle.gather(t(a), t(idx)).numpy(), a[idx])
+        upd = np.ones((2, 3), np.float32)
+        out = paddle.scatter(t(a), t(np.array([1, 3])), t(upd))
+        expect = a.copy()
+        expect[[1, 3]] = 1
+        np.testing.assert_array_equal(out.numpy(), expect)
+
+    def test_indexing(self, rng):
+        a = rng.randn(4, 5).astype(np.float32)
+        x = t(a)
+        np.testing.assert_array_equal(x[1].numpy(), a[1])
+        np.testing.assert_array_equal(x[1:3, ::2].numpy(), a[1:3, ::2])
+        np.testing.assert_array_equal(x[:, None].numpy(), a[:, None])
+        mask = a > 0
+        np.testing.assert_array_equal(x[t(mask)].numpy(), a[mask])
+        x[0] = 0.0
+        assert x.numpy()[0].sum() == 0
+
+    def test_tile_roll_flip(self, rng):
+        a = rng.randn(2, 3).astype(np.float32)
+        np.testing.assert_array_equal(paddle.tile(t(a), [2, 1]).numpy(), np.tile(a, (2, 1)))
+        np.testing.assert_array_equal(paddle.roll(t(a), 1, 0).numpy(), np.roll(a, 1, 0))
+        np.testing.assert_array_equal(paddle.flip(t(a), [1]).numpy(), a[:, ::-1])
+
+
+class TestLogicSearch:
+    def test_comparisons(self, rng):
+        a = rng.randn(6).astype(np.float32)
+        b = rng.randn(6).astype(np.float32)
+        np.testing.assert_array_equal((t(a) > t(b)).numpy(), a > b)
+        np.testing.assert_array_equal((t(a) == t(a)).numpy(), np.ones(6, bool))
+        assert bool(paddle.allclose(t(a), t(a)))
+
+    def test_argmax_topk_sort(self, rng):
+        a = rng.randn(4, 6).astype(np.float32)
+        np.testing.assert_array_equal(paddle.argmax(t(a), axis=1).numpy(), a.argmax(1))
+        v, i = paddle.topk(t(a), 3, axis=1)
+        np.testing.assert_allclose(v.numpy(), np.sort(a, 1)[:, ::-1][:, :3], rtol=1e-6)
+        np.testing.assert_allclose(paddle.sort(t(a), axis=0).numpy(), np.sort(a, 0))
+
+    def test_where_nonzero_unique(self):
+        a = np.array([[1, 0], [0, 2]], np.float32)
+        np.testing.assert_array_equal(
+            paddle.where(t(a) > 0, t(a), t(-a)).numpy(), np.where(a > 0, a, -a)
+        )
+        nz = paddle.nonzero(t(a))
+        np.testing.assert_array_equal(nz.numpy(), np.stack(np.nonzero(a), 1))
+        u = paddle.unique(t(np.array([3, 1, 2, 1, 3])))
+        np.testing.assert_array_equal(u.numpy(), [1, 2, 3])
+
+
+class TestLinalg:
+    def test_solve_inv_det(self, rng):
+        a = rng.randn(3, 3).astype(np.float32) + 3 * np.eye(3, dtype=np.float32)
+        b = rng.randn(3, 2).astype(np.float32)
+        np.testing.assert_allclose(
+            paddle.linalg.solve(t(a), t(b)).numpy(), np.linalg.solve(a, b), rtol=1e-4
+        )
+        np.testing.assert_allclose(
+            paddle.linalg.inv(t(a)).numpy(), np.linalg.inv(a), rtol=1e-4, atol=1e-5
+        )
+        np.testing.assert_allclose(
+            paddle.linalg.det(t(a)).numpy(), np.linalg.det(a), rtol=1e-4
+        )
+
+    def test_norm_qr_svd(self, rng):
+        a = rng.randn(4, 3).astype(np.float32)
+        np.testing.assert_allclose(paddle.linalg.norm(t(a)).numpy(), np.linalg.norm(a), rtol=1e-5)
+        q, r = paddle.linalg.qr(t(a))
+        np.testing.assert_allclose((q.numpy() @ r.numpy()), a, atol=1e-5)
+        u, s, v = paddle.linalg.svd(t(a))
+        np.testing.assert_allclose(
+            u.numpy() @ np.diag(s.numpy()) @ v.numpy().T, a, atol=1e-5
+        )
+
+
+class TestRandomAndStat:
+    def test_seed_reproducibility(self):
+        paddle.seed(7)
+        a = paddle.randn([4, 4]).numpy()
+        paddle.seed(7)
+        b = paddle.randn([4, 4]).numpy()
+        np.testing.assert_array_equal(a, b)
+        c = paddle.randn([4, 4]).numpy()
+        assert not np.array_equal(b, c)
+
+    def test_rand_ranges(self):
+        u = paddle.uniform([1000], min=2.0, max=3.0).numpy()
+        assert u.min() >= 2.0 and u.max() <= 3.0
+        r = paddle.randint(0, 5, [1000]).numpy()
+        assert r.min() >= 0 and r.max() < 5 and r.dtype == np.int64
+
+    def test_std_var_median(self, rng):
+        a = rng.randn(50).astype(np.float32)
+        np.testing.assert_allclose(paddle.std(t(a)).numpy(), a.std(ddof=1), rtol=1e-4)
+        np.testing.assert_allclose(paddle.var(t(a), unbiased=False).numpy(), a.var(), rtol=1e-4)
+        np.testing.assert_allclose(paddle.median(t(a)).numpy(), np.median(a), rtol=1e-5)
+
+
+class TestTensorSurface:
+    def test_astype_item_repr(self):
+        x = t(np.float32([1.5]))
+        assert x.astype("int32").dtype == paddle.int32
+        assert x.item() == 1.5
+        assert "Tensor" in repr(x)
+
+    def test_inplace_ops(self):
+        x = t(np.float32([1, 2, 3]))
+        x += 1
+        np.testing.assert_array_equal(x.numpy(), [2, 3, 4])
+        x.scale_(2.0)
+        np.testing.assert_array_equal(x.numpy(), [4, 6, 8])
+
+    def test_set_value_and_fill(self):
+        x = t(np.zeros((2, 2), np.float32))
+        x.set_value(np.ones((2, 2), np.float32))
+        assert x.numpy().sum() == 4
+        x.fill_(3.0)
+        assert x.numpy().sum() == 12
