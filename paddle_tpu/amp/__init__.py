@@ -1,0 +1,22 @@
+from .auto_cast import (
+    amp_guard,
+    auto_cast,
+    decorate,
+    is_bfloat16_supported,
+    is_float16_supported,
+    white_list,
+    black_list,
+)
+from .grad_scaler import AmpScaler, GradScaler
+from . import debugging
+
+__all__ = [
+    "auto_cast",
+    "amp_guard",
+    "decorate",
+    "GradScaler",
+    "AmpScaler",
+    "is_bfloat16_supported",
+    "is_float16_supported",
+    "debugging",
+]

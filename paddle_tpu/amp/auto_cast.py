@@ -1,0 +1,201 @@
+"""AMP autocast.
+
+Parity: paddle.amp.auto_cast / amp_guard / decorate (reference:
+python/paddle/amp/auto_cast.py:273/703/787 — O1 per-op autocast via
+allow/block lists, O2 pure-low-precision with master weights). The cast hook
+plugs into the autograd engine's apply_op, the same interception point the
+reference generates into every ad_func (eager_gen.py:1826).
+"""
+from __future__ import annotations
+
+import threading
+from collections import Counter
+
+import jax.numpy as jnp
+
+from ..autograd import engine
+from ..framework import dtype as dtype_mod
+from ..framework import flags
+from ..tensor.tensor import Tensor
+from . import amp_lists
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.dtype = "bfloat16"
+        self.level = "O1"
+        self.custom_white = set()
+        self.custom_black = set()
+        self.op_stats: Counter | None = None
+
+
+_state = _AmpState()
+
+
+def _amp_dtype():
+    return dtype_mod.to_jax_dtype(_state.dtype)
+
+
+def white_list():
+    return amp_lists.WHITE_LIST | _state.custom_white
+
+
+def black_list():
+    return (amp_lists.BLACK_LIST - _state.custom_white) | _state.custom_black
+
+
+def _cast_hook(op_name: str, leaves: list) -> list:
+    if not _state.enabled:
+        return leaves
+    low = _amp_dtype()
+    if _state.level == "O2":
+        # pure low precision: cast every float input except blocklist ops
+        target = jnp.float32 if op_name in black_list() else low
+    else:
+        if op_name in white_list():
+            target = low
+        elif op_name in black_list():
+            target = jnp.float32
+        else:
+            # O1 gray ops: promote to the widest input float dtype
+            has_f32 = any(
+                isinstance(l, Tensor) and l._data.dtype == jnp.float32 for l in leaves
+            )
+            target = jnp.float32 if has_f32 else None
+    if target is None:
+        return leaves
+    if _state.op_stats is not None and target == low:
+        _state.op_stats[op_name] += 1
+    out = []
+    for leaf in leaves:
+        if (
+            isinstance(leaf, Tensor)
+            and leaf._data.dtype in (jnp.float32, jnp.float16, jnp.bfloat16)
+            and leaf._data.dtype != target
+        ):
+            out.append(leaf.astype(target))
+        else:
+            out.append(leaf)
+    return out
+
+
+engine.amp_cast_hook = _cast_hook
+
+
+class auto_cast:
+    """Context manager enabling AMP (paddle.amp.auto_cast parity)."""
+
+    def __init__(
+        self,
+        enable: bool = True,
+        custom_white_list=None,
+        custom_black_list=None,
+        level: str = "O1",
+        dtype: str = "float16",
+        use_promote: bool = True,
+    ):
+        if level not in ("O0", "O1", "O2"):
+            raise ValueError(f"level must be O0/O1/O2, got {level}")
+        self._cfg = (
+            bool(enable) and level != "O0",
+            set(custom_white_list or ()),
+            set(custom_black_list or ()),
+            level,
+            dtype,
+        )
+        self._saved = None
+
+    def __enter__(self):
+        self._saved = (
+            _state.enabled,
+            _state.custom_white,
+            _state.custom_black,
+            _state.level,
+            _state.dtype,
+        )
+        (
+            _state.enabled,
+            _state.custom_white,
+            _state.custom_black,
+            _state.level,
+            _state.dtype,
+        ) = self._cfg
+        return self
+
+    def __exit__(self, *exc):
+        (
+            _state.enabled,
+            _state.custom_white,
+            _state.custom_black,
+            _state.level,
+            _state.dtype,
+        ) = self._saved
+        return False
+
+
+amp_guard = auto_cast
+
+
+def decorate(
+    models,
+    optimizers=None,
+    level: str = "O1",
+    dtype: str = "float16",
+    master_weight=None,
+    save_dtype=None,
+    master_grad: bool = False,
+    excluded_layers=None,
+):
+    """O2: cast model params to low precision; optimizer keeps fp32 masters
+    (multi_precision). Norm layers stay fp32 (paddle keeps them fp32 in O2)."""
+    from ..nn.layer.norm import LayerNorm, RMSNorm, _BatchNormBase
+
+    single_model = not isinstance(models, (list, tuple))
+    model_list = [models] if single_model else list(models)
+    if level == "O2":
+        excluded = tuple(excluded_layers or ()) + (LayerNorm, RMSNorm, _BatchNormBase)
+        for model in model_list:
+            for layer in model.sublayers(include_self=True):
+                if isinstance(layer, excluded):
+                    continue
+                for p in layer._parameters.values():
+                    if p is not None and p._data.dtype == jnp.float32:
+                        p._data = p._data.astype(dtype_mod.to_jax_dtype(dtype))
+            model._casted_by_pure_fp16 = True
+        if optimizers is not None:
+            opt_list = optimizers if isinstance(optimizers, (list, tuple)) else [optimizers]
+            for opt in opt_list:
+                opt._multi_precision = True
+    if optimizers is None:
+        return models
+    return models, optimizers
+
+
+def is_float16_supported(device=None) -> bool:
+    return True
+
+
+def is_bfloat16_supported(device=None) -> bool:
+    return True
+
+
+def collect_operator_stats():
+    """Context manager counting low-precision op calls
+    (paddle.amp.debugging.collect_operator_stats parity)."""
+
+    class _Collector:
+        def __enter__(self):
+            _state.op_stats = Counter()
+            return self
+
+        def __exit__(self, *exc):
+            stats = _state.op_stats
+            _state.op_stats = None
+            print("<------------------- op list -------------------->")
+            for op, count in sorted((stats or {}).items()):
+                print(f"  {op}: {count} low-precision calls")
+            print("<------------------------------------------------->")
+            return False
+
+    return _Collector()

@@ -1,0 +1,50 @@
+"""AMP numerical debugging.
+
+Parity: python/paddle/amp/debugging.py (TensorCheckerConfig:157,
+enable_tensor_checker:634, check_numerics:339, collect_operator_stats:540).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework import flags
+from ..tensor.tensor import Tensor
+from .auto_cast import collect_operator_stats  # re-export  # noqa: F401
+
+
+class DebugMode:
+    CHECK_NAN_INF_AND_ABORT = 0
+    CHECK_NAN_INF = 1
+    CHECK_ALL = 4
+
+
+class TensorCheckerConfig:
+    def __init__(self, enable=False, debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT, output_dir=None, checked_op_list=None, skipped_op_list=None, debug_step=None, stack_height_limit=1):
+        self.enable = enable
+        self.debug_mode = debug_mode
+        self.output_dir = output_dir
+        self.checked_op_list = checked_op_list
+        self.skipped_op_list = skipped_op_list
+
+
+def enable_tensor_checker(config: TensorCheckerConfig):
+    if config.enable:
+        level = 0 if config.debug_mode == DebugMode.CHECK_NAN_INF_AND_ABORT else 1
+        flags.set_flags({"FLAGS_check_nan_inf": True, "FLAGS_check_nan_inf_level": level})
+
+
+def disable_tensor_checker():
+    flags.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def check_numerics(tensor: Tensor, op_type: str = "", var_name: str = "", debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT):
+    """Return (num_nan, num_inf, num_zero) and optionally abort."""
+    data = tensor._data
+    n_nan = int(jnp.sum(jnp.isnan(data)))
+    n_inf = int(jnp.sum(jnp.isinf(data)))
+    n_zero = int(jnp.sum(data == 0))
+    if debug_mode == DebugMode.CHECK_NAN_INF_AND_ABORT and (n_nan or n_inf):
+        raise FloatingPointError(
+            f"check_numerics: {op_type}/{var_name} has {n_nan} NaN, {n_inf} Inf"
+        )
+    return Tensor(jnp.asarray(n_nan)), Tensor(jnp.asarray(n_inf)), Tensor(jnp.asarray(n_zero))

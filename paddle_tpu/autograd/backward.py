@@ -230,10 +230,9 @@ def run_backward(
                 in_grads = node.run_vjp(raw)
 
         for (kind, *rest), grad in zip(node.input_edges, in_grads):
-            if grad is None:
-                continue
             if kind == "leaf":
-                accumulate_leaf(rest[0], grad)
+                if grad is not None:
+                    accumulate_leaf(rest[0], grad)
             else:
                 producer, out_idx = rest
                 # (grads for intermediate targets are collected from holders
@@ -241,11 +240,14 @@ def run_backward(
                 if producer in reachable:
                     if producer not in holders:
                         holders[producer] = _Holder(producer)
-                    holders[producer].add(out_idx, grad)
+                    if grad is not None:
+                        holders[producer].add(out_idx, grad)
+                    # A None grad still counts as a delivered contribution —
+                    # the producer must not wait for it forever.
                     pending[producer] -= 1
                     if pending[producer] == 0:
                         queue.append(producer)
-                elif producer in holders or target_ids is not None:
+                elif grad is not None and (producer in holders or target_ids is not None):
                     # Pruned producer may still hold a target output slot.
                     if producer not in holders:
                         holders[producer] = _Holder(producer)

@@ -28,6 +28,21 @@ amp_cast_hook: Callable | None = None
 # Hook installed by the profiler to wrap op execution in RecordEvent ranges.
 op_profile_hook: Callable | None = None
 
+# Ops whose outputs are never differentiable (comparisons, index producers,
+# predicates). Skipping the vjp for these avoids residual construction and
+# dead GradNode allocation in hot training loops.
+NON_DIFF_OPS = frozenset(
+    {
+        "equal", "not_equal", "greater_than", "greater_equal", "less_than",
+        "less_equal", "logical_and", "logical_or", "logical_xor", "logical_not",
+        "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not",
+        "bitwise_left_shift", "bitwise_right_shift", "equal_all", "isclose",
+        "allclose", "argmax", "argmin", "argsort", "isfinite", "isinf",
+        "isnan", "isreal", "isneginf", "isposinf", "count_nonzero",
+        "searchsorted", "bucketize", "one_hot", "exponent",
+    }
+)
+
 
 def _is_tensor(x) -> bool:
     from ..tensor.tensor import Tensor
@@ -163,7 +178,7 @@ def apply_op(name: str, fn: Callable, *args, **kwargs):
     if amp_cast_hook is not None:
         leaves = amp_cast_hook(name, leaves)
 
-    grad_on = is_grad_enabled()
+    grad_on = is_grad_enabled() and name not in NON_DIFF_OPS
     diff_pos = []
     if grad_on:
         for i, leaf in enumerate(leaves):

@@ -1,0 +1,95 @@
+"""paddle.device parity (reference: python/paddle/device/__init__.py)."""
+from __future__ import annotations
+
+import jax
+
+from ..framework.place import (  # noqa: F401
+    CPUPlace,
+    CustomPlace,
+    Place,
+    TPUPlace,
+    device_count,
+    get_device,
+    is_compiled_with_cuda,
+    set_device,
+)
+
+
+def get_all_device_type():
+    return sorted({d.platform for d in jax.devices()})
+
+
+def get_available_device():
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def get_available_custom_device():
+    return []
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def is_compiled_with_custom_device(device_type: str):
+    return device_type in get_all_device_type()
+
+
+def synchronize(device=None):
+    """Block until all queued device work completes (paddle.device.synchronize)."""
+    (jax.device_put(0) + 0).block_until_ready()
+
+
+class Stream:
+    """Streams are an XLA-internal concept; the facade exists for API parity."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = device
+
+    def synchronize(self):
+        synchronize()
+
+    def wait_stream(self, stream):
+        pass
+
+    def record_event(self, event=None):
+        return event or Event()
+
+    def wait_event(self, event):
+        pass
+
+
+class Event:
+    def __init__(self, enable_timing=False, blocking=False, interprocess=False):
+        pass
+
+    def record(self, stream=None):
+        pass
+
+    def query(self):
+        return True
+
+    def synchronize(self):
+        synchronize()
+
+
+def current_stream(device=None):
+    return Stream(device)
+
+
+def set_stream(stream):
+    return stream
+
+
+def stream_guard(stream):
+    import contextlib
+
+    @contextlib.contextmanager
+    def guard():
+        yield
+
+    return guard()

@@ -1,0 +1,71 @@
+"""paddle.distributed parity (built out in paddle_tpu/distributed/*).
+
+This module re-exports the communication API, parallel environment, fleet,
+and auto_parallel surfaces. See SURVEY.md §2.6/§2.7 for the capability map.
+"""
+from __future__ import annotations
+
+import os
+
+
+def get_rank(group=None) -> int:
+    if group is not None:
+        return group.get_group_rank(get_rank())
+    import jax
+
+    try:
+        return jax.process_index()
+    except RuntimeError:
+        return int(os.environ.get("PADDLE_TRAINER_ID", 0))
+
+
+def get_world_size(group=None) -> int:
+    if group is not None:
+        return group.world_size
+    import jax
+
+    try:
+        return jax.process_count()
+    except RuntimeError:
+        return int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+
+
+_parallel_env_initialized = False
+
+
+def is_initialized() -> bool:
+    return _parallel_env_initialized
+
+
+def init_parallel_env():
+    global _parallel_env_initialized
+    _parallel_env_initialized = True
+    from .collective import _init_default_group
+
+    _init_default_group()
+
+
+def __getattr__(name):
+    # Lazy: the heavy submodules import jax collectives; avoid import cycles.
+    import importlib
+
+    mods = {
+        "fleet": ".fleet",
+        "collective": ".collective",
+        "auto_parallel": ".auto_parallel",
+        "checkpoint": ".checkpoint",
+        "launch": ".launch",
+        "parallel": ".parallel",
+        "sharding": ".sharding",
+        "utils": ".utils",
+    }
+    if name in mods:
+        return importlib.import_module(mods[name], __name__)
+    for source in (".collective", ".parallel", ".auto_parallel.api", ".mesh"):
+        try:
+            mod = importlib.import_module(source, __name__)
+        except ImportError:
+            continue
+        if hasattr(mod, name):
+            return getattr(mod, name)
+    raise AttributeError(f"module 'paddle_tpu.distributed' has no attribute {name!r}")

@@ -1,0 +1,46 @@
+"""paddle.io parity: datasets, samplers, DataLoader.
+
+Reference: python/paddle/io/ (reader.py:216 DataLoader, dataloader/*). The
+multiprocess worker pool is host-side (feeding the TPU is a host job); worker
+processes use the same index-batch protocol as the reference's worker.py.
+"""
+from .dataloader import DataLoader, get_worker_info
+from .dataset import (
+    ChainDataset,
+    ComposeDataset,
+    ConcatDataset,
+    Dataset,
+    IterableDataset,
+    Subset,
+    TensorDataset,
+    random_split,
+)
+from .sampler import (
+    BatchSampler,
+    DistributedBatchSampler,
+    RandomSampler,
+    Sampler,
+    SequenceSampler,
+    SubsetRandomSampler,
+    WeightedRandomSampler,
+)
+
+__all__ = [
+    "Dataset",
+    "IterableDataset",
+    "TensorDataset",
+    "ComposeDataset",
+    "ChainDataset",
+    "ConcatDataset",
+    "Subset",
+    "random_split",
+    "Sampler",
+    "SequenceSampler",
+    "RandomSampler",
+    "WeightedRandomSampler",
+    "SubsetRandomSampler",
+    "BatchSampler",
+    "DistributedBatchSampler",
+    "DataLoader",
+    "get_worker_info",
+]

@@ -1,0 +1,239 @@
+"""DataLoader.
+
+Parity: paddle.io.DataLoader (reference: python/paddle/io/reader.py:216,
+dataloader/dataloader_iter.py, worker.py). Host-side multiprocess workers via
+the stdlib multiprocessing Pool protocol: the main process iterates the batch
+sampler, ships index lists to workers, workers return collated numpy batches,
+the main process wraps them as Tensors (device upload happens lazily on first
+op, or eagerly via jnp.asarray).
+"""
+from __future__ import annotations
+
+import itertools
+import multiprocessing as mp
+import queue as queue_mod
+import threading
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..tensor.tensor import Tensor
+from .dataset import IterableDataset
+from .sampler import BatchSampler
+
+
+@dataclass
+class WorkerInfo:
+    id: int
+    num_workers: int
+    dataset: object
+    seed: int
+
+
+_worker_info = None
+
+
+def get_worker_info():
+    return _worker_info
+
+
+def default_collate_fn(batch):
+    sample = batch[0]
+    if isinstance(sample, Tensor):
+        import jax.numpy as jnp
+
+        return Tensor(jnp.stack([b._data for b in batch]))
+    if isinstance(sample, np.ndarray):
+        return Tensor(np.stack(batch))
+    if isinstance(sample, (int, float, np.number)):
+        return Tensor(np.asarray(batch))
+    if isinstance(sample, (list, tuple)):
+        transposed = list(zip(*batch))
+        return type(sample)(default_collate_fn(list(items)) for items in transposed)
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([b[k] for b in batch]) for k in sample}
+    if isinstance(sample, (str, bytes)):
+        return list(batch)
+    return batch
+
+
+def _np_collate(batch):
+    """Collate to numpy (picklable) in worker processes."""
+    sample = batch[0]
+    if isinstance(sample, Tensor):
+        return np.stack([b.numpy() for b in batch])
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    if isinstance(sample, (int, float, np.number)):
+        return np.asarray(batch)
+    if isinstance(sample, (list, tuple)):
+        transposed = list(zip(*batch))
+        return type(sample)(_np_collate(list(items)) for items in transposed)
+    if isinstance(sample, dict):
+        return {k: _np_collate([b[k] for b in batch]) for k in sample}
+    return batch
+
+
+def _rewrap(obj):
+    if isinstance(obj, np.ndarray):
+        return Tensor(obj)
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_rewrap(o) for o in obj)
+    if isinstance(obj, dict):
+        return {k: _rewrap(v) for k, v in obj.items()}
+    return obj
+
+
+def _worker_loop(dataset, index_queue, data_queue, collate_fn, worker_id, num_workers, seed):
+    global _worker_info
+    _worker_info = WorkerInfo(worker_id, num_workers, dataset, seed)
+    np.random.seed((seed + worker_id) & 0x7FFFFFFF)
+    while True:
+        task = index_queue.get()
+        if task is None:
+            break
+        batch_id, indices = task
+        try:
+            samples = [dataset[i] for i in indices]
+            data = collate_fn(samples)
+            data_queue.put((batch_id, data, None))
+        except Exception as e:  # propagate to main process
+            data_queue.put((batch_id, None, e))
+
+
+class DataLoader:
+    def __init__(
+        self,
+        dataset,
+        feed_list=None,
+        places=None,
+        return_list=True,
+        batch_sampler=None,
+        batch_size=1,
+        shuffle=False,
+        drop_last=False,
+        collate_fn=None,
+        num_workers=0,
+        use_buffer_reader=True,
+        prefetch_factor=2,
+        use_shared_memory=True,
+        timeout=0,
+        worker_init_fn=None,
+        persistent_workers=False,
+    ):
+        self.dataset = dataset
+        self.num_workers = num_workers
+        self.collate_fn = collate_fn
+        self.prefetch_factor = prefetch_factor
+        self.worker_init_fn = worker_init_fn
+        self._iterable_mode = isinstance(dataset, IterableDataset)
+        if self._iterable_mode:
+            self.batch_size = batch_size
+            self.batch_sampler = None
+            self.drop_last = drop_last
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+            self.batch_size = getattr(batch_sampler, "batch_size", batch_size)
+        else:
+            if batch_size is None:
+                self.batch_sampler = None
+                self.batch_size = None
+            else:
+                self.batch_sampler = BatchSampler(
+                    dataset, shuffle=shuffle, batch_size=batch_size, drop_last=drop_last
+                )
+                self.batch_size = batch_size
+
+    def __len__(self):
+        if self._iterable_mode:
+            raise TypeError("IterableDataset DataLoader has no len()")
+        if self.batch_sampler is None:
+            return len(self.dataset)
+        return len(self.batch_sampler)
+
+    def __iter__(self):
+        if self._iterable_mode:
+            return self._iter_iterable()
+        if self.batch_sampler is None:
+            return (self._collate_one(self.dataset[i]) for i in range(len(self.dataset)))
+        if self.num_workers == 0:
+            return self._iter_single()
+        return self._iter_multiprocess()
+
+    def _collate_one(self, sample):
+        fn = self.collate_fn or default_collate_fn
+        return fn([sample])
+
+    def _iter_iterable(self):
+        fn = self.collate_fn or default_collate_fn
+        batch = []
+        for sample in self.dataset:
+            batch.append(sample)
+            if len(batch) == self.batch_size:
+                yield fn(batch)
+                batch = []
+        if batch and not self.drop_last:
+            yield fn(batch)
+
+    def _iter_single(self):
+        fn = self.collate_fn or default_collate_fn
+        for indices in self.batch_sampler:
+            yield fn([self.dataset[i] for i in indices])
+
+    def _iter_multiprocess(self):
+        """Index-queue / data-queue worker pool (worker.py protocol)."""
+        ctx = mp.get_context("fork")
+        from ..framework.random import default_generator
+
+        seed = default_generator.initial_seed()
+        index_queues = []
+        data_queue = ctx.Queue()
+        workers = []
+        collate = _np_collate if self.collate_fn is None else self.collate_fn
+        for wid in range(self.num_workers):
+            iq = ctx.Queue()
+            w = ctx.Process(
+                target=_worker_loop,
+                args=(self.dataset, iq, data_queue, collate, wid, self.num_workers, seed),
+                daemon=True,
+            )
+            w.start()
+            index_queues.append(iq)
+            workers.append(w)
+        try:
+            sampler_iter = enumerate(iter(self.batch_sampler))
+            n_dispatched = 0
+            n_received = 0
+            buffers = {}
+            next_yield = 0
+            # prime the pipeline
+            for _ in range(self.num_workers * self.prefetch_factor):
+                try:
+                    bid, indices = next(sampler_iter)
+                except StopIteration:
+                    break
+                index_queues[bid % self.num_workers].put((bid, indices))
+                n_dispatched += 1
+            while n_received < n_dispatched:
+                bid, data, err = data_queue.get()
+                n_received += 1
+                if err is not None:
+                    raise err
+                buffers[bid] = data
+                try:
+                    nbid, indices = next(sampler_iter)
+                    index_queues[nbid % self.num_workers].put((nbid, indices))
+                    n_dispatched += 1
+                except StopIteration:
+                    pass
+                while next_yield in buffers:
+                    out = buffers.pop(next_yield)
+                    next_yield += 1
+                    yield _rewrap(out) if self.collate_fn is None else out
+        finally:
+            for iq in index_queues:
+                iq.put(None)
+            for w in workers:
+                w.join(timeout=1)
+                if w.is_alive():
+                    w.terminate()

@@ -1,0 +1,101 @@
+"""Gradient clipping (parity: python/paddle/nn/clip.py).
+
+ClipGradByGlobalNorm computes the global norm with a single fused jit'd
+reduction over the whole grad pytree (one XLA program, not per-tensor ops).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor.tensor import Tensor
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            out.append((p, Tensor(jnp.clip(g._data, self.min, self.max))))
+        return out
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            norm = jnp.sqrt(jnp.sum(jnp.square(g._data.astype(jnp.float32))))
+            scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+            out.append((p, Tensor((g._data * scale).astype(g._data.dtype))))
+        return out
+
+
+@jax.jit
+def _global_norm_scale(grads_flat, clip_norm):
+    sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in grads_flat)
+    gnorm = jnp.sqrt(sq)
+    return jnp.where(gnorm > clip_norm, clip_norm / jnp.maximum(gnorm, 1e-12), 1.0)
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name="default_group", auto_skip_clip=False):
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+
+    def __call__(self, params_grads):
+        grads = [g._data for p, g in params_grads if g is not None]
+        if not grads:
+            return params_grads
+        scale = _global_norm_scale(grads, self.clip_norm)
+        out = []
+        for p, g in params_grads:
+            if g is None or (hasattr(p, "need_clip") and p.need_clip is False):
+                out.append((p, g))
+            else:
+                out.append((p, Tensor((g._data * scale).astype(g._data.dtype))))
+        return out
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0, error_if_nonfinite=False):
+    """torch-style utility paddle also ships (nn/utils/clip_grad_norm_.py)."""
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    grads = [p.grad._data for p in parameters if p.grad is not None]
+    if not grads:
+        return Tensor(jnp.zeros(()))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack([jnp.max(jnp.abs(g)) for g in grads]))
+    else:
+        total = jnp.sum(jnp.stack([jnp.sum(jnp.abs(g) ** norm_type) for g in grads])) ** (
+            1.0 / norm_type
+        )
+    scale = jnp.minimum(max_norm / (total + 1e-6), 1.0)
+    for p in parameters:
+        if p.grad is not None:
+            p.grad._data = (p.grad._data * scale).astype(p.grad._data.dtype)
+    return Tensor(total)
+
+
+def clip_grad_value_(parameters, clip_value):
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    for p in parameters:
+        if p.grad is not None:
+            p.grad._data = jnp.clip(p.grad._data, -clip_value, clip_value)

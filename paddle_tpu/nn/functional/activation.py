@@ -1,0 +1,192 @@
+"""Activation functionals (parity: python/paddle/nn/functional/activation.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...autograd.engine import apply_op, make_op
+
+relu = make_op("relu", jax.nn.relu)
+relu6 = make_op("relu6", jax.nn.relu6)
+sigmoid = make_op("sigmoid", jax.nn.sigmoid)
+log_sigmoid = make_op("log_sigmoid", jax.nn.log_sigmoid)
+tanh = make_op("tanh", jnp.tanh)
+silu = make_op("silu", jax.nn.silu)
+swish = silu
+mish = make_op("mish", lambda x: x * jnp.tanh(jax.nn.softplus(x)))
+tanhshrink = make_op("tanhshrink", lambda x: x - jnp.tanh(x))
+softsign = make_op("softsign", jax.nn.soft_sign)
+
+
+def gelu(x, approximate=False, name=None):
+    return apply_op("gelu", lambda v: jax.nn.gelu(v, approximate=approximate), x)
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply_op("leaky_relu", lambda v: jax.nn.leaky_relu(v, negative_slope), x)
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply_op("elu", lambda v: jax.nn.elu(v, alpha), x)
+
+
+def elu_(x, alpha=1.0, name=None):
+    from ...tensor.manipulation import _inplace
+
+    return _inplace(x, elu(x, alpha))
+
+
+def selu(
+    x,
+    scale=1.0507009873554804934193349852946,
+    alpha=1.6732632423543772848170429916717,
+    name=None,
+):
+    return apply_op(
+        "selu", lambda v: scale * jnp.where(v > 0, v, alpha * jnp.expm1(v)), x
+    )
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply_op("celu", lambda v: jax.nn.celu(v, alpha), x)
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return apply_op("hardtanh", lambda v: jnp.clip(v, min, max), x)
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply_op(
+        "hardshrink", lambda v: jnp.where(jnp.abs(v) > threshold, v, 0.0), x
+    )
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply_op(
+        "softshrink",
+        lambda v: jnp.where(
+            v > threshold, v - threshold, jnp.where(v < -threshold, v + threshold, 0.0)
+        ),
+        x,
+    )
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return apply_op("hardsigmoid", lambda v: jnp.clip(v * slope + offset, 0.0, 1.0), x)
+
+
+def hardswish(x, name=None):
+    return apply_op("hardswish", lambda v: v * jnp.clip(v + 3.0, 0.0, 6.0) / 6.0, x)
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return apply_op(
+        "softplus",
+        lambda v: jnp.where(v * beta > threshold, v, jax.nn.softplus(v * beta) / beta),
+        x,
+    )
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def fn(v, w):
+        if w.size == 1:
+            return jnp.where(v >= 0, v, w.reshape(()) * v)
+        ax = 1 if data_format[1] == "C" else v.ndim - 1
+        shape = [1] * v.ndim
+        shape[ax] = w.size
+        return jnp.where(v >= 0, v, w.reshape(shape) * v)
+
+    return apply_op("prelu", fn, x, weight)
+
+
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=True, name=None):
+    from ...framework.random import default_generator
+
+    if training:
+        key = default_generator.next_key()
+
+        def fn(v):
+            alpha = jax.random.uniform(key, v.shape, v.dtype, lower, upper)
+            return jnp.where(v >= 0, v, alpha * v)
+
+        return apply_op("rrelu", fn, x)
+    mid = (lower + upper) / 2.0
+    return apply_op("rrelu", lambda v: jnp.where(v >= 0, v, mid * v), x)
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    def fn(v):
+        if dtype is not None:
+            from ...framework.dtype import to_jax_dtype
+
+            v = v.astype(to_jax_dtype(dtype))
+        return jax.nn.softmax(v, axis=axis)
+
+    return apply_op("softmax", fn, x)
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    from ...tensor.manipulation import _inplace
+
+    return _inplace(x, softmax(x, axis, dtype))
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    def fn(v):
+        if dtype is not None:
+            from ...framework.dtype import to_jax_dtype
+
+            v = v.astype(to_jax_dtype(dtype))
+        return jax.nn.log_softmax(v, axis=axis)
+
+    return apply_op("log_softmax", fn, x)
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...framework.random import default_generator
+
+    key = default_generator.next_key()
+
+    def fn(v):
+        g = -jnp.log(-jnp.log(jax.random.uniform(key, v.shape) + 1e-20) + 1e-20)
+        y = jax.nn.softmax((v + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            one_hot = jnp.zeros_like(y)
+            one_hot = jnp.put_along_axis(one_hot, idx, 1.0, axis=axis, inplace=False)
+            y = one_hot + y - jax.lax.stop_gradient(y)
+        return y
+
+    return apply_op("gumbel_softmax", fn, x)
+
+
+def maxout(x, groups, axis=1, name=None):
+    def fn(v):
+        shape = list(v.shape)
+        c = shape[axis]
+        shape[axis : axis + 1] = [c // groups, groups]
+        return jnp.max(v.reshape(shape), axis=axis + 1)
+
+    return apply_op("maxout", fn, x)
+
+
+def glu(x, axis=-1, name=None):
+    return apply_op("glu", lambda v: jax.nn.glu(v, axis=axis), x)
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return apply_op(
+        "thresholded_relu", lambda v: jnp.where(v > threshold, v, value), x
+    )
+
+
+def relu_(x, name=None):
+    from ...tensor.manipulation import _inplace
+
+    return _inplace(x, relu(x))
+
+
+def tanh_(x, name=None):
+    from ...tensor.manipulation import _inplace
+
+    return _inplace(x, tanh(x))

@@ -1,0 +1,134 @@
+"""Attention functionals.
+
+Parity: paddle's scaled_dot_product_attention / flash_attention surface
+(reference: python/paddle/nn/functional/flash_attention.py, kernel
+paddle/phi/kernels/gpu/flash_attn_kernel.cu:128-245). TPU-native: the hot path
+is a Pallas flash-attention kernel (paddle_tpu/ops/pallas/flash_attention.py);
+a pure-XLA fallback covers CPU tests and odd shapes.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...autograd.engine import apply_op
+
+
+def _sdpa_ref(q, k, v, mask=None, causal=False, scale=None, dropout_p=0.0, dropout_key=None):
+    """Reference attention over [B, S, H, D] (paddle layout)."""
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+    # [B, S, H, D] -> [B, H, S, D]
+    qh = jnp.swapaxes(q, 1, 2)
+    kh = jnp.swapaxes(k, 1, 2)
+    vh = jnp.swapaxes(v, 1, 2)
+    if kh.shape[1] != qh.shape[1]:  # GQA: repeat kv heads
+        rep = qh.shape[1] // kh.shape[1]
+        kh = jnp.repeat(kh, rep, axis=1)
+        vh = jnp.repeat(vh, rep, axis=1)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * s
+    logits = logits.astype(jnp.float32)
+    if causal:
+        q_len, k_len = logits.shape[-2], logits.shape[-1]
+        causal_mask = jnp.tril(jnp.ones((q_len, k_len), bool), k_len - q_len)
+        logits = jnp.where(causal_mask, logits, -1e30)
+    if mask is not None:
+        logits = logits + mask.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    if dropout_p > 0.0 and dropout_key is not None:
+        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_p, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0).astype(q.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vh)
+    return jnp.swapaxes(out, 1, 2)  # back to [B, S, H, D]
+
+
+def scaled_dot_product_attention(
+    query,
+    key,
+    value,
+    attn_mask=None,
+    dropout_p=0.0,
+    is_causal=False,
+    training=True,
+    name=None,
+):
+    """Inputs [batch, seq, heads, head_dim] (paddle layout)."""
+    from ...framework.random import default_generator
+
+    dkey = default_generator.next_key() if (dropout_p > 0.0 and training) else None
+    use_flash = _flash_usable(query)
+
+    def fn(q, k, v, *rest):
+        mask = rest[0] if rest else None
+        if use_flash and mask is None:
+            from ...ops.pallas.flash_attention import flash_attention
+
+            return flash_attention(q, k, v, causal=is_causal)
+        return _sdpa_ref(
+            q, k, v, mask=mask, causal=is_causal,
+            dropout_p=dropout_p if training else 0.0, dropout_key=dkey,
+        )
+
+    args = [query, key, value] + ([attn_mask] if attn_mask is not None else [])
+    return apply_op("scaled_dot_product_attention", fn, *args)
+
+
+def _flash_usable(query) -> bool:
+    """Pallas flash attention needs TPU + aligned head dims."""
+    import jax as _jax
+
+    try:
+        platform = _jax.devices()[0].platform
+    except RuntimeError:
+        return False
+    if platform not in ("tpu",):
+        return False
+    d = query._data.shape[-1] if hasattr(query, "_data") else query.shape[-1]
+    s = query._data.shape[1] if hasattr(query, "_data") else query.shape[1]
+    return d % 128 == 0 and s % 128 == 0
+
+
+def flash_attention(
+    query, key, value, dropout=0.0, causal=False, return_softmax=False,
+    fixed_seed_offset=None, rng_name="", training=True, name=None,
+):
+    """paddle.nn.functional.flash_attention.flash_attention parity."""
+    out = scaled_dot_product_attention(
+        query, key, value, dropout_p=dropout, is_causal=causal, training=training
+    )
+    if return_softmax:
+        return out, None
+    return out, None
+
+
+def flash_attn_unpadded(
+    query, key, value, cu_seqlens_q, cu_seqlens_k, max_seqlen_q, max_seqlen_k,
+    scale=None, dropout=0.0, causal=False, return_softmax=False, training=True, name=None,
+):
+    """Varlen flash attention: [total_tokens, H, D] with cumulative seqlens.
+
+    XLA fallback: segment-masked attention over the packed batch.
+    """
+
+    def fn(q, k, v, cu_q, cu_k):
+        total_q = q.shape[0]
+        seg_q = jnp.searchsorted(cu_q, jnp.arange(total_q), side="right") - 1
+        total_k = k.shape[0]
+        seg_k = jnp.searchsorted(cu_k, jnp.arange(total_k), side="right") - 1
+        d = q.shape[-1]
+        s = scale if scale is not None else 1.0 / math.sqrt(d)
+        logits = jnp.einsum("qhd,khd->hqk", q, k) * s
+        logits = logits.astype(jnp.float32)
+        same = seg_q[:, None] == seg_k[None, :]
+        if causal:
+            pos_q = jnp.arange(total_q) - jnp.take(cu_q, seg_q)
+            pos_k = jnp.arange(total_k) - jnp.take(cu_k, seg_k)
+            same = same & (pos_k[None, :] <= pos_q[:, None])
+        logits = jnp.where(same[None], logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        return jnp.einsum("hqk,khd->qhd", probs, v)
+
+    out = apply_op("flash_attn_unpadded", fn, query, key, value, cu_seqlens_q, cu_seqlens_k)
+    return out, None

@@ -1,0 +1,288 @@
+"""Common functionals: linear, dropout, embedding, padding, one_hot,
+interpolate, unfold, cosine_similarity.
+
+Parity: python/paddle/nn/functional/common.py + input.py.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...autograd.engine import apply_op
+from ...framework.random import default_generator
+from ...tensor.tensor import Tensor
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b with W[in, out] (paddle convention)."""
+    if bias is not None:
+        return apply_op("linear", lambda v, w, b: jnp.matmul(v, w) + b, x, weight, bias)
+    return apply_op("linear", lambda v, w: jnp.matmul(v, w), x, weight)
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    if not training or p == 0.0:
+        return x.clone() if isinstance(x, Tensor) else x
+    key = default_generator.next_key()
+
+    def fn(v):
+        shape = list(v.shape)
+        if axis is not None:
+            axes = [axis] if isinstance(axis, int) else list(axis)
+            mask_shape = [s if i in [a % v.ndim for a in axes] else 1 for i, s in enumerate(shape)]
+        else:
+            mask_shape = shape
+        keep = jax.random.bernoulli(key, 1.0 - p, mask_shape)
+        if mode == "upscale_in_train":
+            return jnp.where(keep, v / (1.0 - p), jnp.zeros((), v.dtype)).astype(v.dtype)
+        return jnp.where(keep, v, jnp.zeros((), v.dtype))
+
+    return apply_op("dropout", fn, x)
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x.clone()
+    key = default_generator.next_key()
+    alpha = 1.6732632423543772848170429916717
+    scale = 1.0507009873554804934193349852946
+    alpha_p = -alpha * scale
+
+    def fn(v):
+        keep = jax.random.bernoulli(key, 1.0 - p, v.shape)
+        a = (1.0 / np.sqrt((1.0 - p) * (1.0 + p * alpha_p**2))).astype(np.float32)
+        b = -a * alpha_p * p
+        return (a * jnp.where(keep, v, jnp.asarray(alpha_p, v.dtype)) + b).astype(v.dtype)
+
+    return apply_op("alpha_dropout", fn, x)
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    def fn(ids, w):
+        out = jnp.take(w, ids, axis=0)
+        if padding_idx is not None:
+            mask = (ids != padding_idx)[..., None]
+            out = out * mask.astype(out.dtype)
+        return out
+
+    return apply_op("embedding", fn, x, weight)
+
+
+def one_hot(x, num_classes, name=None):
+    return apply_op(
+        "one_hot", lambda v: jax.nn.one_hot(v, num_classes, dtype=jnp.float32), x
+    )
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", pad_from_left_axis=False, name=None):
+    from ...tensor.manipulation import _int_list
+
+    pad = _int_list(pad)
+
+    def fn(v):
+        nd = v.ndim
+        if len(pad) == 2 * nd:
+            # full-form: paddle orders [before0, after0, before1, after1, ...]
+            widths = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+        else:
+            # partial form applies to the spatial dims per data_format,
+            # ordered from the LAST spatial dim backwards (torch-style).
+            widths = [(0, 0)] * nd
+            n_spatial = len(pad) // 2
+            if data_format.startswith("N") and data_format[1] == "C":
+                spatial = list(range(2, nd))
+            else:
+                spatial = list(range(1, nd - 1))
+            for i in range(n_spatial):
+                dim = spatial[len(spatial) - 1 - i]
+                widths[dim] = (pad[2 * i], pad[2 * i + 1])
+        jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+        if jmode == "constant":
+            return jnp.pad(v, widths, mode=jmode, constant_values=value)
+        return jnp.pad(v, widths, mode=jmode)
+
+    return apply_op("pad", fn, x)
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    return pad(x, padding, mode="constant", value=0.0, data_format=data_format)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    def fn(a, b):
+        dot = jnp.sum(a * b, axis=axis)
+        na = jnp.sqrt(jnp.sum(a * a, axis=axis))
+        nb = jnp.sqrt(jnp.sum(b * b, axis=axis))
+        return dot / jnp.maximum(na * nb, eps)
+
+    return apply_op("cosine_similarity", fn, x1, x2)
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def fn(v):
+        norm = jnp.sum(jnp.abs(v) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+        return v / jnp.maximum(norm, epsilon)
+
+    return apply_op("normalize", fn, x)
+
+
+def interpolate(
+    x,
+    size=None,
+    scale_factor=None,
+    mode="nearest",
+    align_corners=False,
+    align_mode=0,
+    data_format="NCHW",
+    name=None,
+):
+    def fn(v):
+        channel_last = data_format in ("NHWC", "NWC", "NDHWC")
+        spatial_ndim = v.ndim - 2
+        if channel_last:
+            spatial = v.shape[1:-1]
+        else:
+            spatial = v.shape[2:]
+        if size is not None:
+            out_spatial = [int(s.item() if isinstance(s, Tensor) else s) for s in (size if isinstance(size, (list, tuple)) else [size])]
+        else:
+            sf = scale_factor if isinstance(scale_factor, (list, tuple)) else [scale_factor] * spatial_ndim
+            out_spatial = [int(s * f) for s, f in zip(spatial, sf)]
+        method = {"nearest": "nearest", "bilinear": "linear", "trilinear": "linear", "linear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
+        if channel_last:
+            out_shape = (v.shape[0], *out_spatial, v.shape[-1])
+        else:
+            out_shape = (v.shape[0], v.shape[1], *out_spatial)
+        return jax.image.resize(v, out_shape, method=method).astype(v.dtype)
+
+    return apply_op("interpolate", fn, x)
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode, data_format)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+
+    def fn(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            v = v.reshape(n, c // (r * r), r, r, h, w)
+            v = v.transpose(0, 1, 4, 2, 5, 3)
+            return v.reshape(n, c // (r * r), h * r, w * r)
+        n, h, w, c = v.shape
+        v = v.reshape(n, h, w, r, r, c // (r * r))
+        v = v.transpose(0, 1, 3, 2, 4, 5)
+        return v.reshape(n, h * r, w * r, c // (r * r))
+
+    return apply_op("pixel_shuffle", fn, x)
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = downscale_factor
+
+    def fn(v):
+        n, c, h, w = v.shape
+        v = v.reshape(n, c, h // r, r, w // r, r)
+        v = v.transpose(0, 1, 3, 5, 2, 4)
+        return v.reshape(n, c * r * r, h // r, w // r)
+
+    return apply_op("pixel_unshuffle", fn, x)
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    def fn(v):
+        n, c, h, w = v.shape
+        v = v.reshape(n, groups, c // groups, h, w)
+        return v.transpose(0, 2, 1, 3, 4).reshape(n, c, h, w)
+
+    return apply_op("channel_shuffle", fn, x)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col: [N,C,H,W] -> [N, C*kh*kw, L] (paddle semantics)."""
+
+    def _pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+
+    kh, kw = _pair(kernel_sizes)
+    sh, sw = _pair(strides)
+    ph, pw = _pair(paddings) if not (isinstance(paddings, (list, tuple)) and len(paddings) == 4) else (paddings[0], paddings[1])
+    dh, dw = _pair(dilations)
+
+    def fn(v):
+        n, c, h, w = v.shape
+        v = jnp.pad(v, [(0, 0), (0, 0), (ph, ph), (pw, pw)])
+        out_h = (h + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+        out_w = (w + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+        patches = jax.lax.conv_general_dilated_patches(
+            v, (kh, kw), (sh, sw), "VALID", rhs_dilation=(dh, dw),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        )  # [N, C*kh*kw, out_h, out_w]
+        return patches.reshape(n, c * kh * kw, out_h * out_w)
+
+    return apply_op("unfold", fn, x)
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    def _pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+
+    oh, ow = _pair(output_sizes)
+    kh, kw = _pair(kernel_sizes)
+    sh, sw = _pair(strides)
+    ph, pw = _pair(paddings)
+    dh, dw = _pair(dilations)
+
+    def fn(v):
+        n, ckk, L = v.shape
+        c = ckk // (kh * kw)
+        out_h = (oh + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+        out_w = (ow + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+        v = v.reshape(n, c, kh, kw, out_h, out_w)
+        out = jnp.zeros((n, c, oh + 2 * ph, ow + 2 * pw), v.dtype)
+        for i in range(kh):
+            for j in range(kw):
+                hi = i * dh
+                wi = j * dw
+                out = out.at[
+                    :, :, hi : hi + out_h * sh : sh, wi : wi + out_w * sw : sw
+                ].add(v[:, :, i, j])
+        return out[:, :, ph : ph + oh, pw : pw + ow]
+
+    return apply_op("fold", fn, x)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def fn(l, *rest):
+        k = l.shape[-1]
+        if rest:
+            return (1 - epsilon) * l + epsilon * rest[0]
+        return (1 - epsilon) * l + epsilon / k
+
+    args = (label, prior_dist) if prior_dist is not None else (label,)
+    return apply_op("label_smooth", fn, *args)
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    def fn(a, b, w, *rest):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if rest:
+            out = out + rest[0]
+        return out
+
+    args = (x1, x2, weight) + ((bias,) if bias is not None else ())
+    return apply_op("bilinear", fn, *args)

@@ -1,0 +1,139 @@
+"""Convolution functionals via lax.conv_general_dilated (MXU-friendly).
+
+Parity: python/paddle/nn/functional/conv.py. Paddle weight layout is
+[out_c, in_c/groups, *kernel]; data layouts NCHW (default) or NHWC. On TPU,
+XLA lowers conv_general_dilated directly onto the MXU.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...autograd.engine import apply_op
+
+
+def _tuple(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(v)
+
+
+def _padding(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()  # SAME / VALID
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * n:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(n)]
+    return [tuple(p) for p in padding]
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, n, channel_last, name):
+    stride = _tuple(stride, n)
+    dilation = _tuple(dilation, n)
+    pad = _padding(padding, n)
+    if channel_last:
+        lhs_spec = "N" + "DHW"[3 - n :] + "C"
+    else:
+        lhs_spec = "NC" + "DHW"[3 - n :]
+    out_spec = lhs_spec
+    rhs_spec = "OI" + "DHW"[3 - n :]
+    dn = (lhs_spec, rhs_spec, out_spec)
+
+    def fn(v, w, *rest):
+        out = jax.lax.conv_general_dilated(
+            v,
+            w,
+            window_strides=stride,
+            padding=pad,
+            rhs_dilation=dilation,
+            dimension_numbers=dn,
+            feature_group_count=groups,
+            preferred_element_type=None,
+        )
+        if rest:
+            b = rest[0]
+            shape = [1] * out.ndim
+            shape[lhs_spec.index("C")] = b.shape[0]
+            out = out + b.reshape(shape)
+        return out
+
+    args = (x, weight) + ((bias,) if bias is not None else ())
+    return apply_op(name, fn, *args)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCL", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1, data_format == "NLC", "conv1d")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2, data_format == "NHWC", "conv2d")
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3, data_format == "NDHWC", "conv3d")
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation, groups, n, channel_last, output_size, name):
+    stride = _tuple(stride, n)
+    dilation = _tuple(dilation, n)
+    out_pad = _tuple(output_padding, n) if output_padding is not None else (0,) * n
+    if isinstance(padding, str):
+        raise NotImplementedError("string padding for conv_transpose")
+    pad = _padding(padding, n)
+    if channel_last:
+        lhs_spec = "N" + "DHW"[3 - n :] + "C"
+    else:
+        lhs_spec = "NC" + "DHW"[3 - n :]
+    rhs_spec = "IO" + "DHW"[3 - n :]  # paddle transpose-conv weight is [in_c, out_c/groups, *k]
+    dn = (lhs_spec, rhs_spec, lhs_spec)
+
+    def fn(v, w, *rest):
+        # Gradient-of-conv formulation: lhs_dilation implements the stride.
+        k_eff = [dilation[i] * (w.shape[2 + i] - 1) + 1 for i in range(n)]
+        trans_pad = [
+            (k_eff[i] - 1 - pad[i][0], k_eff[i] - 1 - pad[i][1] + out_pad[i])
+            for i in range(n)
+        ]
+        if groups > 1:
+            # jax transposed conv with groups: reshape weight [I, O/g, ...] ->
+            # batch groups along O
+            pass
+        w_flipped = jnp.flip(w, axis=tuple(range(2, 2 + n)))
+        # swap I/O for the flipped-kernel correlation form
+        out = jax.lax.conv_general_dilated(
+            v,
+            jnp.swapaxes(w_flipped, 0, 1) if groups == 1 else w_flipped.reshape(
+                groups, w.shape[0] // groups, *w.shape[1:]
+            ).swapaxes(1, 2).reshape(w.shape[1] * groups, w.shape[0] // groups, *w.shape[2:]),
+            window_strides=(1,) * n,
+            padding=trans_pad,
+            lhs_dilation=stride,
+            rhs_dilation=dilation,
+            dimension_numbers=(lhs_spec, "OI" + "DHW"[3 - n :], lhs_spec),
+            feature_group_count=groups,
+        )
+        if rest:
+            b = rest[0]
+            shape = [1] * out.ndim
+            shape[lhs_spec.index("C")] = b.shape[0]
+            out = out + b.reshape(shape)
+        return out
+
+    args = (x, weight) + ((bias,) if bias is not None else ())
+    return apply_op(name, fn, *args)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1, output_size=None, data_format="NCL", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation, groups, 1, data_format == "NLC", output_size, "conv1d_transpose")
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1, output_size=None, data_format="NCHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation, groups, 2, data_format == "NHWC", output_size, "conv2d_transpose")
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1, output_size=None, data_format="NCDHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation, groups, 3, data_format == "NDHWC", output_size, "conv3d_transpose")

@@ -1,0 +1,347 @@
+"""Loss functionals (parity: python/paddle/nn/functional/loss.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...autograd.engine import apply_op
+from ...tensor.tensor import Tensor
+
+
+def _reduce(out, reduction):
+    if reduction == "mean":
+        return jnp.mean(out)
+    if reduction == "sum":
+        return jnp.sum(out)
+    return out
+
+
+def cross_entropy(
+    input,
+    label,
+    weight=None,
+    ignore_index=-100,
+    reduction="mean",
+    soft_label=False,
+    axis=-1,
+    use_softmax=True,
+    label_smoothing=0.0,
+    name=None,
+):
+    def fn(logits, lab, *rest):
+        logp = jax.nn.log_softmax(logits, axis=axis) if use_softmax else jnp.log(
+            jnp.clip(logits, 1e-15, 1.0)
+        )
+        n_classes = logits.shape[axis]
+        if soft_label or (lab.ndim == logits.ndim and lab.shape == logits.shape):
+            soft = lab
+            if label_smoothing > 0:
+                soft = soft * (1 - label_smoothing) + label_smoothing / n_classes
+            loss = -jnp.sum(soft * logp, axis=axis)
+            valid = jnp.ones(loss.shape, logits.dtype)
+        else:
+            lab_idx = lab
+            if lab_idx.ndim == logits.ndim:
+                lab_idx = jnp.squeeze(lab_idx, axis=axis)
+            valid = (lab_idx != ignore_index).astype(logits.dtype)
+            safe = jnp.where(lab_idx == ignore_index, 0, lab_idx)
+            picked = jnp.take_along_axis(
+                logp, jnp.expand_dims(safe, axis % logits.ndim), axis=axis
+            ).squeeze(axis % logits.ndim)
+            if label_smoothing > 0:
+                smooth = -jnp.mean(logp, axis=axis)
+                loss = (1 - label_smoothing) * (-picked) + label_smoothing * smooth
+            else:
+                loss = -picked
+            loss = loss * valid
+            if rest:  # class weights
+                w = jnp.take(rest[0], safe)
+                loss = loss * w
+                valid = valid * w
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(valid), 1e-12)
+        if reduction == "sum":
+            return jnp.sum(loss)
+        return loss
+
+    args = [input, label] + ([weight] if weight is not None else [])
+    return apply_op("cross_entropy", fn, *args)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100, axis=-1, return_softmax=False, numeric_stable_mode=True):
+    loss = cross_entropy(logits, label, soft_label=soft_label, ignore_index=ignore_index, reduction="none", axis=axis)
+    from .activation import softmax as _softmax
+
+    loss = apply_op("unsqueeze_last", lambda v: jnp.expand_dims(v, axis), loss)
+    if return_softmax:
+        return loss, _softmax(logits, axis=axis)
+    return loss
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):
+    def fn(logp, lab, *rest):
+        valid = (lab != ignore_index).astype(logp.dtype)
+        safe = jnp.where(lab == ignore_index, 0, lab)
+        if logp.ndim > 2:  # [N, C, d1...] -> move C last
+            moved = jnp.moveaxis(logp, 1, -1)
+        else:
+            moved = logp
+        picked = jnp.take_along_axis(moved, safe[..., None], axis=-1)[..., 0]
+        loss = -picked * valid
+        den = valid
+        if rest:
+            w = jnp.take(rest[0], safe)
+            loss = loss * w
+            den = valid * w
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(den), 1e-12)
+        if reduction == "sum":
+            return jnp.sum(loss)
+        return loss
+
+    args = [input, label] + ([weight] if weight is not None else [])
+    return apply_op("nll_loss", fn, *args)
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return apply_op(
+        "mse_loss", lambda a, b: _reduce(jnp.square(a - b), reduction), input, label
+    )
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return apply_op(
+        "l1_loss", lambda a, b: _reduce(jnp.abs(a - b), reduction), input, label
+    )
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def fn(a, b):
+        d = a - b
+        loss = jnp.where(
+            jnp.abs(d) < delta, 0.5 * d * d / delta, jnp.abs(d) - 0.5 * delta
+        )
+        return _reduce(loss, reduction)
+
+    return apply_op("smooth_l1_loss", fn, input, label)
+
+
+def huber_loss(input, label, delta=1.0, reduction="mean", name=None):
+    def fn(a, b):
+        d = a - b
+        loss = jnp.where(jnp.abs(d) <= delta, 0.5 * d * d, delta * (jnp.abs(d) - 0.5 * delta))
+        return _reduce(loss, reduction)
+
+    return apply_op("huber_loss", fn, input, label)
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    def fn(p, y, *rest):
+        p = jnp.clip(p, 1e-12, 1 - 1e-12)
+        loss = -(y * jnp.log(p) + (1 - y) * jnp.log(1 - p))
+        if rest:
+            loss = loss * rest[0]
+        return _reduce(loss, reduction)
+
+    args = [input, label] + ([weight] if weight is not None else [])
+    return apply_op("binary_cross_entropy", fn, *args)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean", pos_weight=None, name=None):
+    def fn(z, y, *rest):
+        i = 0
+        if pos_weight is not None:
+            pw = rest[i]
+            i += 1
+            log_sig = jax.nn.log_sigmoid(z)
+            log_one_minus = jax.nn.log_sigmoid(-z)
+            loss = -(pw * y * log_sig + (1 - y) * log_one_minus)
+        else:
+            loss = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        if weight is not None:
+            loss = loss * rest[i]
+        return _reduce(loss, reduction)
+
+    args = [logit, label]
+    if pos_weight is not None:
+        args.append(pos_weight)
+    if weight is not None:
+        args.append(weight)
+    return apply_op("bce_with_logits", fn, *args)
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    def fn(logp, target):
+        if log_target:
+            loss = jnp.exp(target) * (target - logp)
+        else:
+            safe_t = jnp.clip(target, 1e-12, None)
+            loss = target * (jnp.log(safe_t) - logp)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / logp.shape[0]
+        return _reduce(loss, reduction)
+
+    return apply_op("kl_div", fn, input, label)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):
+    return apply_op(
+        "margin_ranking_loss",
+        lambda a, b, y: _reduce(jnp.maximum(0.0, -y * (a - b) + margin), reduction),
+        input,
+        other,
+        label,
+    )
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    return apply_op(
+        "hinge_embedding_loss",
+        lambda x, y: _reduce(
+            jnp.where(y == 1, x, jnp.maximum(0.0, margin - x)), reduction
+        ),
+        input,
+        label,
+    )
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0, reduction="mean", name=None):
+    def fn(a, b, y):
+        cos = jnp.sum(a * b, -1) / (
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1) + 1e-12
+        )
+        loss = jnp.where(y == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce(loss, reduction)
+
+    return apply_op("cosine_embedding_loss", fn, input1, input2, label)
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0, epsilon=1e-6, swap=False, reduction="mean", name=None):
+    def fn(a, pos, neg):
+        dp = jnp.sum(jnp.abs(a - pos) ** p, -1) ** (1 / p)
+        dn = jnp.sum(jnp.abs(a - neg) ** p, -1) ** (1 / p)
+        if swap:
+            dn2 = jnp.sum(jnp.abs(pos - neg) ** p, -1) ** (1 / p)
+            dn = jnp.minimum(dn, dn2)
+        return _reduce(jnp.maximum(0.0, dp - dn + margin), reduction)
+
+    return apply_op("triplet_margin_loss", fn, input, positive, negative)
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean", name=None):
+    def fn(x, y, *rest):
+        loss = -(y * jax.nn.log_sigmoid(x) + (1 - y) * jax.nn.log_sigmoid(-x))
+        loss = jnp.mean(loss, axis=-1)
+        if rest:
+            loss = loss * rest[0]
+        return _reduce(loss, reduction)
+
+    args = [input, label] + ([weight] if weight is not None else [])
+    return apply_op("multi_label_soft_margin_loss", fn, *args)
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    return apply_op(
+        "soft_margin_loss",
+        lambda x, y: _reduce(jnp.log1p(jnp.exp(-y * x)), reduction),
+        input,
+        label,
+    )
+
+
+def square_error_cost(input, label):
+    return apply_op("square_error_cost", lambda a, b: jnp.square(a - b), input, label)
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    return apply_op(
+        "log_loss",
+        lambda p, y: -y * jnp.log(p + epsilon) - (1 - y) * jnp.log(1 - p + epsilon),
+        input,
+        label,
+    )
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0, reduction="sum", name=None):
+    def fn(z, y, *rest):
+        p = jax.nn.sigmoid(z)
+        ce = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        loss = a_t * ((1 - p_t) ** gamma) * ce
+        if rest:
+            loss = loss / rest[0]
+        return _reduce(loss, reduction)
+
+    args = [logit, label] + ([normalizer] if normalizer is not None else [])
+    return apply_op("sigmoid_focal_loss", fn, *args)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0, reduction="mean", norm_by_times=False):
+    """CTC via the standard log-alpha dynamic program (lax.scan over time)."""
+
+    def fn(lp, lab, in_len, lab_len):
+        # lp: [T, N, C] log-softmax already applied by caller convention
+        lp = jax.nn.log_softmax(lp, axis=-1)
+        T, N, C = lp.shape
+        S = lab.shape[1]
+        ext = jnp.full((N, 2 * S + 1), blank, dtype=lab.dtype)
+        ext = ext.at[:, 1::2].set(lab)
+        ext_len = 2 * lab_len + 1
+        neg_inf = -1e30
+        alpha0 = jnp.full((N, 2 * S + 1), neg_inf)
+        alpha0 = alpha0.at[:, 0].set(lp[0, jnp.arange(N), blank])
+        alpha0 = alpha0.at[:, 1].set(
+            jnp.where(S > 0, lp[0, jnp.arange(N), ext[:, 1]], neg_inf)
+        )
+
+        same = jnp.concatenate(
+            [jnp.ones((N, 2), bool), ext[:, 2:] == ext[:, :-2]], axis=1
+        )
+
+        def step(alpha, lp_t):
+            a1 = alpha
+            a2 = jnp.concatenate([jnp.full((N, 1), neg_inf), alpha[:, :-1]], 1)
+            a3 = jnp.concatenate([jnp.full((N, 2), neg_inf), alpha[:, :-2]], 1)
+            a3 = jnp.where(same, neg_inf, a3)
+            merged = jnp.logaddexp(jnp.logaddexp(a1, a2), a3)
+            emit = jnp.take_along_axis(lp_t, ext, axis=1)
+            return merged + emit, None
+
+        alpha, _ = jax.lax.scan(step, alpha0, lp[1:])
+        # alpha at each sequence's final time step: handle variable input_lengths
+        def gather_final(alpha_all, t_idx, n):
+            return alpha_all
+
+        # rescan retaining per-step alphas for variable lengths
+        def step2(carry, lp_t):
+            alpha, t = carry
+            a1 = alpha
+            a2 = jnp.concatenate([jnp.full((N, 1), neg_inf), alpha[:, :-1]], 1)
+            a3 = jnp.concatenate([jnp.full((N, 2), neg_inf), alpha[:, :-2]], 1)
+            a3 = jnp.where(same, neg_inf, a3)
+            merged = jnp.logaddexp(jnp.logaddexp(a1, a2), a3)
+            emit = jnp.take_along_axis(lp_t, ext, axis=1)
+            new_alpha = merged + emit
+            return (new_alpha, t + 1), new_alpha
+
+        (alphaT, _), alphas = jax.lax.scan(step2, (alpha0, 1), lp[1:])
+        all_alphas = jnp.concatenate([alpha0[None], alphas], 0)  # [T, N, 2S+1]
+        t_final = jnp.clip(in_len - 1, 0, T - 1)
+        final = all_alphas[t_final, jnp.arange(N)]  # [N, 2S+1]
+        idx_last = jnp.clip(ext_len - 1, 0, 2 * S)
+        idx_prev = jnp.clip(ext_len - 2, 0, 2 * S)
+        ll = jnp.logaddexp(
+            jnp.take_along_axis(final, idx_last[:, None], 1)[:, 0],
+            jnp.take_along_axis(final, idx_prev[:, None], 1)[:, 0],
+        )
+        loss = -ll
+        if norm_by_times:
+            loss = loss / in_len.astype(loss.dtype)
+        if reduction == "mean":
+            return jnp.mean(loss / lab_len.astype(loss.dtype))
+        if reduction == "sum":
+            return jnp.sum(loss)
+        return loss
+
+    return apply_op("ctc_loss", fn, log_probs, labels, input_lengths, label_lengths)

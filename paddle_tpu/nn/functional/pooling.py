@@ -1,0 +1,156 @@
+"""Pooling functionals via lax.reduce_window.
+
+Parity: python/paddle/nn/functional/pooling.py.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...autograd.engine import apply_op
+
+
+def _tuple(v, n):
+    if v is None:
+        return None
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(int(i) for i in v)
+
+
+def _pool_pad(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()
+    p = _tuple(padding, n)
+    if p is not None and len(p) == n:
+        return [(pi, pi) for pi in p]
+    return [tuple(x) for x in padding]
+
+
+def _reduce_pool(x, kernel, stride, pad, n, channel_last, init, op, name):
+    kernel = _tuple(kernel, n)
+    stride = _tuple(stride, n) if stride is not None else kernel
+    padding = _pool_pad(pad, n)
+
+    def fn(v):
+        if channel_last:
+            dims = (1,) + kernel + (1,)
+            strides = (1,) + stride + (1,)
+        else:
+            dims = (1, 1) + kernel
+            strides = (1, 1) + stride
+        if isinstance(padding, str):
+            pads = padding
+        elif channel_last:
+            pads = [(0, 0)] + padding + [(0, 0)]
+        else:
+            pads = [(0, 0), (0, 0)] + padding
+        return jax.lax.reduce_window(v, jnp.asarray(init, v.dtype), op, dims, strides, pads)
+
+    return apply_op(name, fn, x)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, name=None):
+    out = _reduce_pool(x, kernel_size, stride, padding, 1, False, -np.inf, jax.lax.max, "max_pool1d")
+    return (out, None) if return_mask else out
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, return_mask=False, data_format="NCHW", name=None):
+    out = _reduce_pool(x, kernel_size, stride, padding, 2, data_format == "NHWC", -np.inf, jax.lax.max, "max_pool2d")
+    return (out, None) if return_mask else out
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False, return_mask=False, data_format="NCDHW", name=None):
+    out = _reduce_pool(x, kernel_size, stride, padding, 3, data_format == "NDHWC", -np.inf, jax.lax.max, "max_pool3d")
+    return (out, None) if return_mask else out
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True, ceil_mode=False, name=None):
+    return _avg_pool(x, kernel_size, stride, padding, 1, False, exclusive, "avg_pool1d")
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True, divisor_override=None, data_format="NCHW", name=None):
+    return _avg_pool(x, kernel_size, stride, padding, 2, data_format == "NHWC", exclusive, "avg_pool2d", divisor_override)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True, divisor_override=None, data_format="NCDHW", name=None):
+    return _avg_pool(x, kernel_size, stride, padding, 3, data_format == "NDHWC", exclusive, "avg_pool3d", divisor_override)
+
+
+def _avg_pool(x, kernel, stride, pad, n, channel_last, exclusive, name, divisor_override=None):
+    kernel = _tuple(kernel, n)
+    stride = _tuple(stride, n) if stride is not None else kernel
+    padding = _pool_pad(pad, n)
+
+    def fn(v):
+        if channel_last:
+            dims = (1,) + kernel + (1,)
+            strides = (1,) + stride + (1,)
+            pads = padding if isinstance(padding, str) else [(0, 0)] + padding + [(0, 0)]
+        else:
+            dims = (1, 1) + kernel
+            strides = (1, 1) + stride
+            pads = padding if isinstance(padding, str) else [(0, 0), (0, 0)] + padding
+        summed = jax.lax.reduce_window(v, jnp.asarray(0, v.dtype), jax.lax.add, dims, strides, pads)
+        if divisor_override:
+            return summed / divisor_override
+        if exclusive:
+            ones = jnp.ones_like(v)
+            counts = jax.lax.reduce_window(ones, jnp.asarray(0, v.dtype), jax.lax.add, dims, strides, pads)
+            return summed / counts
+        return summed / np.prod(kernel)
+
+    return apply_op(name, fn, x)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive(x, output_size, 1, "avg")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive(x, output_size, 2, "avg", data_format == "NHWC")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive(x, output_size, 3, "avg", data_format == "NDHWC")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    out = _adaptive(x, output_size, 1, "max")
+    return (out, None) if return_mask else out
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    out = _adaptive(x, output_size, 2, "max")
+    return (out, None) if return_mask else out
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    out = _adaptive(x, output_size, 3, "max")
+    return (out, None) if return_mask else out
+
+
+def _adaptive(x, output_size, n, mode, channel_last=False):
+    out_sizes = _tuple(output_size, n)
+
+    def fn(v):
+        spatial_start = 1 if channel_last else 2
+        out = v
+        for d in range(n):
+            axis = spatial_start + d
+            in_size = out.shape[axis]
+            want = out_sizes[d] if out_sizes[d] is not None else in_size
+            # adaptive pooling: boundaries floor(i*in/out), ceil((i+1)*in/out)
+            starts = [int(np.floor(i * in_size / want)) for i in range(want)]
+            ends = [int(np.ceil((i + 1) * in_size / want)) for i in range(want)]
+            pieces = []
+            for s, e in zip(starts, ends):
+                seg = jax.lax.slice_in_dim(out, s, e, axis=axis)
+                red = jnp.max(seg, axis=axis, keepdims=True) if mode == "max" else jnp.mean(seg, axis=axis, keepdims=True)
+                pieces.append(red)
+            out = jnp.concatenate(pieces, axis=axis)
+        return out
+
+    return apply_op(f"adaptive_{mode}_pool{n}d", fn, x)

@@ -1,0 +1,215 @@
+"""Weight initializers.
+
+Parity: python/paddle/nn/initializer/ (Constant, Normal, TruncatedNormal,
+Uniform, XavierNormal/Uniform, KaimingNormal/Uniform, Assign, Orthogonal,
+Dirac, calculate_gain). Each initializer is a callable
+``(shape, dtype) -> jax array``.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...framework import dtype as dtype_mod
+from ...framework.random import default_generator
+
+
+def calculate_gain(nonlinearity: str, param=None) -> float:
+    recommended = {
+        "sigmoid": 1.0,
+        "linear": 1.0,
+        "conv1d": 1.0,
+        "conv2d": 1.0,
+        "conv3d": 1.0,
+        "tanh": 5.0 / 3,
+        "relu": math.sqrt(2.0),
+        "leaky_relu": math.sqrt(2.0 / (1 + (param if param is not None else 0.01) ** 2)),
+        "selu": 3.0 / 4,
+    }
+    if nonlinearity not in recommended:
+        raise ValueError(f"unsupported nonlinearity: {nonlinearity}")
+    return recommended[nonlinearity]
+
+
+def _fan_in_out(shape):
+    shape = list(shape)
+    if len(shape) < 2:
+        fan_in = fan_out = shape[0] if shape else 1
+    else:
+        receptive = int(np.prod(shape[2:])) if len(shape) > 2 else 1
+        fan_in = shape[0] * receptive if len(shape) > 2 else shape[0]
+        fan_out = shape[1] * receptive if len(shape) > 2 else shape[1]
+        if len(shape) > 2:
+            # conv weights in paddle are [out_c, in_c, *k]
+            fan_in = shape[1] * receptive
+            fan_out = shape[0] * receptive
+    return fan_in, fan_out
+
+
+class Initializer:
+    def __call__(self, shape, dtype="float32"):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value: float = 0.0):
+        self.value = value
+
+    def __call__(self, shape, dtype="float32"):
+        return jnp.full(list(shape), self.value, dtype_mod.to_jax_dtype(dtype))
+
+
+class Normal(Initializer):
+    def __init__(self, mean: float = 0.0, std: float = 1.0, name=None):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype="float32"):
+        key = default_generator.next_key()
+        return (
+            jax.random.normal(key, list(shape), dtype_mod.to_jax_dtype(dtype)) * self.std
+            + self.mean
+        )
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean: float = 0.0, std: float = 1.0, a: float = -2.0, b: float = 2.0, name=None):
+        self.mean, self.std, self.a, self.b = mean, std, a, b
+
+    def __call__(self, shape, dtype="float32"):
+        key = default_generator.next_key()
+        return (
+            jax.random.truncated_normal(
+                key, self.a, self.b, list(shape), dtype_mod.to_jax_dtype(dtype)
+            )
+            * self.std
+            + self.mean
+        )
+
+
+class Uniform(Initializer):
+    def __init__(self, low: float = -1.0, high: float = 1.0, name=None):
+        self.low, self.high = low, high
+
+    def __call__(self, shape, dtype="float32"):
+        key = default_generator.next_key()
+        return jax.random.uniform(
+            key, list(shape), dtype_mod.to_jax_dtype(dtype), self.low, self.high
+        )
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain: float = 1.0, name=None):
+        self._fan_in, self._fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype="float32"):
+        fan_in, fan_out = _fan_in_out(shape)
+        fan_in = self._fan_in if self._fan_in is not None else fan_in
+        fan_out = self._fan_out if self._fan_out is not None else fan_out
+        std = self.gain * math.sqrt(2.0 / (fan_in + fan_out))
+        key = default_generator.next_key()
+        return jax.random.normal(key, list(shape), dtype_mod.to_jax_dtype(dtype)) * std
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain: float = 1.0, name=None):
+        self._fan_in, self._fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype="float32"):
+        fan_in, fan_out = _fan_in_out(shape)
+        fan_in = self._fan_in if self._fan_in is not None else fan_in
+        fan_out = self._fan_out if self._fan_out is not None else fan_out
+        limit = self.gain * math.sqrt(6.0 / (fan_in + fan_out))
+        key = default_generator.next_key()
+        return jax.random.uniform(
+            key, list(shape), dtype_mod.to_jax_dtype(dtype), -limit, limit
+        )
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope: float = 0.0, nonlinearity: str = "relu"):
+        self._fan_in, self.negative_slope, self.nonlinearity = fan_in, negative_slope, nonlinearity
+
+    def __call__(self, shape, dtype="float32"):
+        fan_in, _ = _fan_in_out(shape)
+        fan_in = self._fan_in if self._fan_in is not None else fan_in
+        gain = calculate_gain(self.nonlinearity, self.negative_slope)
+        std = gain / math.sqrt(fan_in)
+        key = default_generator.next_key()
+        return jax.random.normal(key, list(shape), dtype_mod.to_jax_dtype(dtype)) * std
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope: float = 0.0, nonlinearity: str = "relu"):
+        self._fan_in, self.negative_slope, self.nonlinearity = fan_in, negative_slope, nonlinearity
+
+    def __call__(self, shape, dtype="float32"):
+        fan_in, _ = _fan_in_out(shape)
+        fan_in = self._fan_in if self._fan_in is not None else fan_in
+        gain = calculate_gain(self.nonlinearity, self.negative_slope)
+        limit = gain * math.sqrt(3.0 / fan_in)
+        key = default_generator.next_key()
+        return jax.random.uniform(
+            key, list(shape), dtype_mod.to_jax_dtype(dtype), -limit, limit
+        )
+
+
+class Assign(Initializer):
+    def __init__(self, value, name=None):
+        self.value = value
+
+    def __call__(self, shape, dtype="float32"):
+        from ...tensor.tensor import Tensor
+
+        v = self.value
+        if isinstance(v, Tensor):
+            v = v.numpy()
+        arr = np.asarray(v).astype(dtype_mod.to_jax_dtype(dtype))
+        if list(arr.shape) != list(shape):
+            arr = arr.reshape(list(shape))
+        return jnp.asarray(arr)
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain: float = 1.0, name=None):
+        self.gain = gain
+
+    def __call__(self, shape, dtype="float32"):
+        key = default_generator.next_key()
+        shape = list(shape)
+        rows, cols = shape[0], int(np.prod(shape[1:]))
+        mat = jax.random.normal(key, (max(rows, cols), min(rows, cols)))
+        q, r = jnp.linalg.qr(mat)
+        q = q * jnp.sign(jnp.diagonal(r))
+        if rows < cols:
+            q = q.T
+        return (self.gain * q[:rows, :cols]).reshape(shape).astype(dtype_mod.to_jax_dtype(dtype))
+
+
+class Dirac(Initializer):
+    def __init__(self, groups: int = 1, name=None):
+        self.groups = groups
+
+    def __call__(self, shape, dtype="float32"):
+        out = np.zeros(shape, dtype_mod.to_jax_dtype(dtype))
+        out_c, in_c = shape[0], shape[1]
+        mins = min(out_c // self.groups, in_c)
+        centers = [s // 2 for s in shape[2:]]
+        for g in range(self.groups):
+            for i in range(mins):
+                idx = (g * (out_c // self.groups) + i, i, *centers)
+                out[idx] = 1.0
+        return jnp.asarray(out)
+
+
+# functional-style aliases paddle exposes
+constant_ = Constant
+normal_ = Normal
+uniform_ = Uniform
+xavier_normal_ = XavierNormal
+xavier_uniform_ = XavierUniform
+kaiming_normal_ = KaimingNormal
+kaiming_uniform_ = KaimingUniform

@@ -1,0 +1,76 @@
+"""Activation layers (parity: python/paddle/nn/layer/activation.py)."""
+from __future__ import annotations
+
+from .. import functional as F
+from ..initializer import Constant
+from .layers import Layer
+
+
+def _simple(name, fn_name, **fixed):
+    def forward(self, x):
+        return getattr(F, fn_name)(x, **fixed, **self._kwargs)
+
+    def __init__(self, *args, name=None, **kwargs):
+        Layer.__init__(self)
+        # positional args map onto the functional's named params in order
+        self._kwargs = kwargs
+        if args:
+            import inspect
+
+            params = [
+                p
+                for p in inspect.signature(getattr(F, fn_name)).parameters.values()
+            ][1:]
+            for p, a in zip(params, args):
+                self._kwargs[p.name] = a
+
+    return type(name, (Layer,), {"__init__": __init__, "forward": forward})
+
+
+CELU = _simple("CELU", "celu")
+ELU = _simple("ELU", "elu")
+GELU = _simple("GELU", "gelu")
+Hardshrink = _simple("Hardshrink", "hardshrink")
+Hardsigmoid = _simple("Hardsigmoid", "hardsigmoid")
+Hardswish = _simple("Hardswish", "hardswish")
+Hardtanh = _simple("Hardtanh", "hardtanh")
+LeakyReLU = _simple("LeakyReLU", "leaky_relu")
+LogSigmoid = _simple("LogSigmoid", "log_sigmoid")
+LogSoftmax = _simple("LogSoftmax", "log_softmax")
+Maxout = _simple("Maxout", "maxout")
+Mish = _simple("Mish", "mish")
+ReLU = _simple("ReLU", "relu")
+ReLU6 = _simple("ReLU6", "relu6")
+SELU = _simple("SELU", "selu")
+Sigmoid = _simple("Sigmoid", "sigmoid")
+Silu = _simple("Silu", "silu")
+Softmax = _simple("Softmax", "softmax")
+Softplus = _simple("Softplus", "softplus")
+Softshrink = _simple("Softshrink", "softshrink")
+Softsign = _simple("Softsign", "softsign")
+Swish = _simple("Swish", "swish")
+Tanh = _simple("Tanh", "tanh")
+Tanhshrink = _simple("Tanhshrink", "tanhshrink")
+ThresholdedReLU = _simple("ThresholdedReLU", "thresholded_relu")
+GLU = _simple("GLU", "glu")
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters=1, init=0.25, weight_attr=None, data_format="NCHW", name=None):
+        super().__init__()
+        self._data_format = data_format
+        self.weight = self.create_parameter(
+            [num_parameters], attr=weight_attr, default_initializer=Constant(init)
+        )
+
+    def forward(self, x):
+        return F.prelu(x, self.weight, self._data_format)
+
+
+class RReLU(Layer):
+    def __init__(self, lower=1.0 / 8.0, upper=1.0 / 3.0, name=None):
+        super().__init__()
+        self.lower, self.upper = lower, upper
+
+    def forward(self, x):
+        return F.rrelu(x, self.lower, self.upper, training=self.training)

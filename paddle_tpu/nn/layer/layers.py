@@ -1,0 +1,345 @@
+"""Layer: the module base class.
+
+Parity: paddle.nn.Layer (reference: python/paddle/nn/layer/layers.py:334 —
+sublayers/parameters registration, hooks, state_dict, train/eval, apply, to).
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Callable, Iterator
+
+import numpy as np
+
+from ...framework import dtype as dtype_mod
+from ...tensor.tensor import Parameter, Tensor
+
+
+class HookRemoveHelper:
+    def __init__(self, hooks: dict, hook_id: int):
+        self._hooks = hooks
+        self._hook_id = hook_id
+
+    def remove(self):
+        self._hooks.pop(self._hook_id, None)
+
+
+class Layer:
+    def __init__(self, name_scope: str | None = None, dtype: str = "float32"):
+        self.training = True
+        self._dtype = dtype
+        self._parameters: OrderedDict[str, Parameter] = OrderedDict()
+        self._sub_layers: OrderedDict[str, Layer] = OrderedDict()
+        self._buffers: OrderedDict[str, Tensor] = OrderedDict()
+        self._non_persistable_buffer_names: set[str] = set()
+        self._forward_pre_hooks: OrderedDict[int, Callable] = OrderedDict()
+        self._forward_post_hooks: OrderedDict[int, Callable] = OrderedDict()
+        self._hook_counter = 0
+        self._name_scope = name_scope or self.__class__.__name__.lower()
+        self._casted_by_pure_fp16 = False
+
+    # --- registration ---
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        buffers = self.__dict__.get("_buffers")
+        if isinstance(value, Parameter):
+            if params is None:
+                raise RuntimeError("call Layer.__init__ before assigning parameters")
+            for store in (layers, buffers):
+                if store is not None:
+                    store.pop(name, None)
+            params[name] = value
+        elif isinstance(value, Layer):
+            if layers is None:
+                raise RuntimeError("call Layer.__init__ before assigning sublayers")
+            for store in (params, buffers):
+                if store is not None:
+                    store.pop(name, None)
+            layers[name] = value
+        elif isinstance(value, Tensor) and buffers is not None and name in buffers:
+            buffers[name] = value
+        else:
+            if params is not None:
+                params.pop(name, None)
+            if layers is not None:
+                layers.pop(name, None)
+            if buffers is not None and not isinstance(value, Tensor):
+                buffers.pop(name, None)
+            object.__setattr__(self, name, value)
+            return
+        # registered containers hold the value; shadow in __dict__ is removed
+        self.__dict__.pop(name, None)
+
+    def __getattr__(self, name):
+        for store_name in ("_parameters", "_sub_layers", "_buffers"):
+            store = self.__dict__.get(store_name)
+            if store is not None and name in store:
+                return store[name]
+        raise AttributeError(
+            f"'{self.__class__.__name__}' object has no attribute '{name}'"
+        )
+
+    def __delattr__(self, name):
+        for store_name in ("_parameters", "_sub_layers", "_buffers"):
+            store = self.__dict__.get(store_name)
+            if store is not None and name in store:
+                del store[name]
+                return
+        object.__delattr__(self, name)
+
+    def __dir__(self):
+        return list(super().__dir__()) + list(self._parameters) + list(self._sub_layers) + list(self._buffers)
+
+    # --- creation helpers (create_parameter parity) ---
+    def create_parameter(
+        self,
+        shape,
+        attr=None,
+        dtype=None,
+        is_bias: bool = False,
+        default_initializer=None,
+    ) -> Parameter:
+        from ..initializer import Constant, XavierUniform
+
+        dtype = dtype or self._dtype
+        init = default_initializer
+        name = None
+        learning_rate = 1.0
+        if attr is not None and attr is not False:
+            from ...framework.param_attr import ParamAttr
+
+            if isinstance(attr, ParamAttr):
+                init = attr.initializer or init
+                name = attr.name
+                learning_rate = attr.learning_rate
+            elif callable(attr):
+                init = attr
+        if init is None:
+            init = Constant(0.0) if is_bias else XavierUniform()
+        data = init(shape, dtype)
+        p = Parameter(data, dtype=dtype, name=name)
+        p.optimize_attr = {"learning_rate": learning_rate}
+        return p
+
+    def create_tensor(self, name=None, dtype=None):
+        return Tensor(np.zeros([0], dtype_mod.to_jax_dtype(dtype or self._dtype)))
+
+    def register_buffer(self, name: str, tensor: Tensor, persistable: bool = True):
+        if tensor is not None and not isinstance(tensor, Tensor):
+            tensor = Tensor(tensor)
+        self.__dict__.pop(name, None)
+        self._parameters.pop(name, None)
+        self._sub_layers.pop(name, None)
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names.add(name)
+
+    def add_parameter(self, name: str, parameter: Parameter) -> Parameter:
+        setattr(self, name, parameter)
+        return parameter
+
+    def add_sublayer(self, name: str, sublayer: "Layer") -> "Layer":
+        setattr(self, name, sublayer)
+        return sublayer
+
+    # --- traversal ---
+    def named_parameters(self, prefix="", include_sublayers=True) -> Iterator:
+        seen = set()
+        for name, layer_prefix, layer in self._walk(prefix):
+            for pname, p in layer._parameters.items():
+                if p is not None and id(p) not in seen:
+                    seen.add(id(p))
+                    yield (layer_prefix + pname, p)
+            if not include_sublayers:
+                break
+
+    def parameters(self, include_sublayers=True) -> list:
+        return [p for _, p in self.named_parameters(include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix="", include_sublayers=True) -> Iterator:
+        seen = set()
+        for name, layer_prefix, layer in self._walk(prefix):
+            for bname, b in layer._buffers.items():
+                if b is not None and id(b) not in seen:
+                    seen.add(id(b))
+                    yield (layer_prefix + bname, b)
+            if not include_sublayers:
+                break
+
+    def buffers(self, include_sublayers=True) -> list:
+        return [b for _, b in self.named_buffers(include_sublayers=include_sublayers)]
+
+    def named_sublayers(self, prefix="", include_self=False) -> Iterator:
+        if include_self:
+            yield (prefix, self)
+        for name, sub in self._sub_layers.items():
+            if sub is None:
+                continue
+            sub_prefix = prefix + ("." if prefix else "") + name
+            yield (sub_prefix, sub)
+            yield from sub.named_sublayers(prefix=sub_prefix)
+
+    def sublayers(self, include_self=False) -> list:
+        return [l for _, l in self.named_sublayers(include_self=include_self)]
+
+    def children(self) -> Iterator["Layer"]:
+        for _, sub in self.named_children():
+            yield sub
+
+    def named_children(self) -> Iterator:
+        for name, sub in self._sub_layers.items():
+            if sub is not None:
+                yield name, sub
+
+    def _walk(self, prefix=""):
+        """Yield (name, param_prefix, layer) for self and every sublayer."""
+        yield ("", prefix, self)
+        for name, sub in self._sub_layers.items():
+            if sub is None:
+                continue
+            yield from (
+                (n, p, l)
+                for n, p, l in sub._walk(prefix + name + ".")
+            )
+
+    def apply(self, fn: Callable) -> "Layer":
+        for layer in self.sublayers(include_self=True):
+            fn(layer)
+        return self
+
+    # --- mode ---
+    def train(self):
+        for layer in self.sublayers(include_self=True):
+            layer.training = True
+        return self
+
+    def eval(self):
+        for layer in self.sublayers(include_self=True):
+            layer.training = False
+        return self
+
+    # --- hooks ---
+    def register_forward_pre_hook(self, hook) -> HookRemoveHelper:
+        self._hook_counter += 1
+        self._forward_pre_hooks[self._hook_counter] = hook
+        return HookRemoveHelper(self._forward_pre_hooks, self._hook_counter)
+
+    def register_forward_post_hook(self, hook) -> HookRemoveHelper:
+        self._hook_counter += 1
+        self._forward_post_hooks[self._hook_counter] = hook
+        return HookRemoveHelper(self._forward_post_hooks, self._hook_counter)
+
+    # --- call ---
+    def __call__(self, *inputs, **kwargs):
+        for hook in list(self._forward_pre_hooks.values()):
+            result = hook(self, inputs)
+            if result is not None:
+                inputs = result if isinstance(result, tuple) else (result,)
+        outputs = self.forward(*inputs, **kwargs)
+        for hook in list(self._forward_post_hooks.values()):
+            result = hook(self, inputs, outputs)
+            if result is not None:
+                outputs = result
+        return outputs
+
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    # --- state dict ---
+    def state_dict(
+        self,
+        destination=None,
+        include_sublayers=True,
+        structured_name_prefix="",
+        use_hook=True,
+    ) -> OrderedDict:
+        out = destination if destination is not None else OrderedDict()
+        for name, p in self.named_parameters(prefix=structured_name_prefix):
+            out[name] = p
+        for name, b in self.named_buffers(prefix=structured_name_prefix):
+            short = name.rsplit(".", 1)[-1]
+            # find owning layer to check persistability
+            out[name] = b
+        # filter non-persistable buffers
+        for name, layer_prefix, layer in self._walk(structured_name_prefix):
+            for bname in layer._non_persistable_buffer_names:
+                out.pop(layer_prefix + bname, None)
+        return out
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        own = self.state_dict()
+        missing, unexpected = [], []
+        matched = {}
+        for name, value in state_dict.items():
+            if name in own:
+                matched[name] = value
+            else:
+                unexpected.append(name)
+        for name in own:
+            if name not in matched:
+                missing.append(name)
+        for name, value in matched.items():
+            target = own[name]
+            arr = value.numpy() if isinstance(value, Tensor) else np.asarray(value)
+            if list(arr.shape) != list(target.shape):
+                raise ValueError(
+                    f"state_dict shape mismatch for {name}: "
+                    f"{list(arr.shape)} vs {list(target.shape)}"
+                )
+            target.set_value(arr.astype(target.dtype.np_dtype))
+        return missing, unexpected
+
+    load_dict = set_state_dict
+
+    # --- dtype / device movement ---
+    def to(self, device=None, dtype=None, blocking=None):
+        if dtype is not None:
+            self._to_dtype(dtype)
+        return self
+
+    def _to_dtype(self, dtype, include_norms: bool = True):
+        want = dtype_mod.convert_dtype(dtype)
+        for _, p in self.named_parameters():
+            if p.dtype.is_floating:
+                p._data = p._data.astype(want.np_dtype)
+        for _, b in self.named_buffers():
+            if b is not None and b.dtype.is_floating:
+                b._data = b._data.astype(want.np_dtype)
+        self._dtype = want.name
+        return self
+
+    def astype(self, dtype):
+        return self._to_dtype(dtype)
+
+    def float(self):
+        return self._to_dtype("float32")
+
+    def bfloat16(self):
+        return self._to_dtype("bfloat16")
+
+    def float16(self):
+        return self._to_dtype("float16")
+
+    def full_name(self):
+        return self._name_scope
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_grad()
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = []
+        for name, sub in self._sub_layers.items():
+            sub_repr = repr(sub).split("\n")
+            sub_repr = [sub_repr[0]] + ["  " + l for l in sub_repr[1:]]
+            lines.append(f"  ({name}): " + "\n".join(sub_repr))
+        body = ""
+        if extra and not lines:
+            body = extra
+        elif lines:
+            body = "\n" + "\n".join(lines) + "\n"
+        return f"{self.__class__.__name__}({body})"
+
+    def extra_repr(self):
+        return ""

@@ -1,0 +1,33 @@
+"""Pooling layers (parity: python/paddle/nn/layer/pooling.py)."""
+from __future__ import annotations
+
+from .. import functional as F
+from .layers import Layer
+
+
+def _make(name, fn_name, arg_names):
+    def __init__(self, *args, **kwargs):
+        Layer.__init__(self)
+        merged = dict(zip(arg_names, args))
+        merged.update(kwargs)
+        merged.pop("name", None)
+        self._kwargs = merged
+
+    def forward(self, x):
+        return getattr(F, fn_name)(x, **self._kwargs)
+
+    return type(name, (Layer,), {"__init__": __init__, "forward": forward})
+
+
+MaxPool1D = _make("MaxPool1D", "max_pool1d", ["kernel_size", "stride", "padding", "return_mask", "ceil_mode"])
+MaxPool2D = _make("MaxPool2D", "max_pool2d", ["kernel_size", "stride", "padding", "ceil_mode", "return_mask", "data_format"])
+MaxPool3D = _make("MaxPool3D", "max_pool3d", ["kernel_size", "stride", "padding", "ceil_mode", "return_mask", "data_format"])
+AvgPool1D = _make("AvgPool1D", "avg_pool1d", ["kernel_size", "stride", "padding", "exclusive", "ceil_mode"])
+AvgPool2D = _make("AvgPool2D", "avg_pool2d", ["kernel_size", "stride", "padding", "ceil_mode", "exclusive", "divisor_override", "data_format"])
+AvgPool3D = _make("AvgPool3D", "avg_pool3d", ["kernel_size", "stride", "padding", "ceil_mode", "exclusive", "divisor_override", "data_format"])
+AdaptiveAvgPool1D = _make("AdaptiveAvgPool1D", "adaptive_avg_pool1d", ["output_size"])
+AdaptiveAvgPool2D = _make("AdaptiveAvgPool2D", "adaptive_avg_pool2d", ["output_size", "data_format"])
+AdaptiveAvgPool3D = _make("AdaptiveAvgPool3D", "adaptive_avg_pool3d", ["output_size", "data_format"])
+AdaptiveMaxPool1D = _make("AdaptiveMaxPool1D", "adaptive_max_pool1d", ["output_size", "return_mask"])
+AdaptiveMaxPool2D = _make("AdaptiveMaxPool2D", "adaptive_max_pool2d", ["output_size", "return_mask"])
+AdaptiveMaxPool3D = _make("AdaptiveMaxPool3D", "adaptive_max_pool3d", ["output_size", "return_mask"])

@@ -1,0 +1,280 @@
+"""Recurrent layers via lax.scan (XLA-friendly sequential scan).
+
+Parity: python/paddle/nn/layer/rnn.py (SimpleRNN/LSTM/GRU + cells). The whole
+sequence loop is ONE scan inside ONE autograd op, so jit sees structured
+control flow (no Python loop unrolling).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...autograd.engine import apply_op
+from ...tensor.tensor import Tensor
+from ..initializer import Uniform
+from .layers import Layer
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None, init_value=0.0, batch_dim_idx=0):
+        b = batch_ref.shape[batch_dim_idx]
+        from ...tensor.creation import full
+
+        return full([b, self.hidden_size], init_value, dtype=dtype or "float32")
+
+
+def _rnn_params(layer, input_size, hidden_size, gates):
+    k = 1.0 / np.sqrt(hidden_size)
+    init = Uniform(-k, k)
+    layer.weight_ih = layer.create_parameter([gates * hidden_size, input_size], default_initializer=init)
+    layer.weight_hh = layer.create_parameter([gates * hidden_size, hidden_size], default_initializer=init)
+    layer.bias_ih = layer.create_parameter([gates * hidden_size], is_bias=True, default_initializer=init)
+    layer.bias_hh = layer.create_parameter([gates * hidden_size], is_bias=True, default_initializer=init)
+
+
+def _lstm_step(x_t, h, c, w_ih, w_hh, b_ih, b_hh):
+    gates = x_t @ w_ih.T + h @ w_hh.T + b_ih + b_hh
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+    g = jnp.tanh(g)
+    c_new = f * c + i * g
+    h_new = o * jnp.tanh(c_new)
+    return h_new, c_new
+
+
+def _gru_step(x_t, h, w_ih, w_hh, b_ih, b_hh):
+    gi = x_t @ w_ih.T + b_ih
+    gh = h @ w_hh.T + b_hh
+    i_r, i_z, i_n = jnp.split(gi, 3, axis=-1)
+    h_r, h_z, h_n = jnp.split(gh, 3, axis=-1)
+    r = jax.nn.sigmoid(i_r + h_r)
+    z = jax.nn.sigmoid(i_z + h_z)
+    n = jnp.tanh(i_n + r * h_n)
+    return (1 - z) * n + z * h
+
+
+def _simple_step(x_t, h, w_ih, w_hh, b_ih, b_hh, activation):
+    out = x_t @ w_ih.T + h @ w_hh.T + b_ih + b_hh
+    return jnp.tanh(out) if activation == "tanh" else jax.nn.relu(out)
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        _rnn_params(self, input_size, hidden_size, 4)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = (self.get_initial_states(inputs), self.get_initial_states(inputs))
+        h, c = states
+
+        def fn(x, hh, cc, w_ih, w_hh, b_ih, b_hh):
+            return _lstm_step(x, hh, cc, w_ih, w_hh, b_ih, b_hh)
+
+        h_new, c_new = apply_op(
+            "lstm_cell", fn, inputs, h, c, self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh
+        )
+        return h_new, (h_new, c_new)
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        _rnn_params(self, input_size, hidden_size, 3)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h_new = apply_op(
+            "gru_cell", _gru_step, inputs, states, self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh
+        )
+        return h_new, h_new
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh", weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.activation = activation
+        _rnn_params(self, input_size, hidden_size, 1)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h_new = apply_op(
+            "simple_rnn_cell",
+            lambda x, h, wi, wh, bi, bh: _simple_step(x, h, wi, wh, bi, bh, self.activation),
+            inputs, states, self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh,
+        )
+        return h_new, h_new
+
+
+class _RNNBase(Layer):
+    """Multi-layer (bi)directional recurrent net; one lax.scan per layer&dir."""
+
+    MODE_GATES = {"LSTM": 4, "GRU": 3, "RNN_TANH": 1, "RNN_RELU": 1}
+
+    def __init__(self, mode, input_size, hidden_size, num_layers=1, direction="forward", time_major=False, dropout=0.0, activation="tanh"):
+        super().__init__()
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirect = direction in ("bidirect", "bidirectional")
+        num_dirs = 2 if self.bidirect else 1
+        gates = self.MODE_GATES[mode]
+        k = 1.0 / np.sqrt(hidden_size)
+        init = Uniform(-k, k)
+        self._all_weights = []
+        for layer_i in range(num_layers):
+            for d in range(num_dirs):
+                in_size = input_size if layer_i == 0 else hidden_size * num_dirs
+                suffix = f"_l{layer_i}" + ("_reverse" if d else "")
+                w_ih = self.create_parameter([gates * hidden_size, in_size], default_initializer=init)
+                w_hh = self.create_parameter([gates * hidden_size, hidden_size], default_initializer=init)
+                b_ih = self.create_parameter([gates * hidden_size], is_bias=True, default_initializer=init)
+                b_hh = self.create_parameter([gates * hidden_size], is_bias=True, default_initializer=init)
+                for n, p in [("weight_ih", w_ih), ("weight_hh", w_hh), ("bias_ih", b_ih), ("bias_hh", b_hh)]:
+                    self.add_parameter(n + suffix, p)
+                self._all_weights.append((w_ih, w_hh, b_ih, b_hh))
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        num_dirs = 2 if self.bidirect else 1
+        is_lstm = self.mode == "LSTM"
+        batch_axis = 1 if self.time_major else 0
+        b = inputs.shape[batch_axis]
+        n_states = self.num_layers * num_dirs
+        from ...tensor.creation import zeros
+
+        if initial_states is None:
+            h0 = zeros([n_states, b, self.hidden_size], dtype=inputs.dtype)
+            initial_states = (h0, zeros([n_states, b, self.hidden_size], dtype=inputs.dtype)) if is_lstm else h0
+
+        flat_weights = [w for tup in self._all_weights for w in tup]
+        mode = self.mode
+        time_major = self.time_major
+        num_layers = self.num_layers
+        activation = "tanh" if mode != "RNN_RELU" else "relu"
+
+        def fn(x, *rest):
+            if is_lstm:
+                h0_, c0_ = rest[0], rest[1]
+                weights = rest[2:]
+            else:
+                h0_ = rest[0]
+                weights = rest[1:]
+            if not time_major:
+                x = jnp.swapaxes(x, 0, 1)  # [T, B, F]
+            layer_in = x
+            final_h, final_c = [], []
+            wi = 0
+            for li in range(num_layers):
+                dir_outs = []
+                for d in range(num_dirs):
+                    w_ih, w_hh, b_ih, b_hh = weights[4 * wi : 4 * wi + 4]
+                    wi += 1
+                    idx = li * num_dirs + d
+                    h_init = h0_[idx]
+                    c_init = c0_[idx] if is_lstm else None
+                    seq = jnp.flip(layer_in, 0) if d == 1 else layer_in
+
+                    if is_lstm:
+                        def step(carry, x_t, _w=(w_ih, w_hh, b_ih, b_hh)):
+                            hh, cc = carry
+                            h_new, c_new = _lstm_step(x_t, hh, cc, *_w)
+                            return (h_new, c_new), h_new
+
+                        (h_fin, c_fin), outs = jax.lax.scan(step, (h_init, c_init), seq)
+                        final_c.append(c_fin)
+                    elif mode == "GRU":
+                        def step(h, x_t, _w=(w_ih, w_hh, b_ih, b_hh)):
+                            h_new = _gru_step(x_t, h, *_w)
+                            return h_new, h_new
+
+                        h_fin, outs = jax.lax.scan(step, h_init, seq)
+                    else:
+                        def step(h, x_t, _w=(w_ih, w_hh, b_ih, b_hh)):
+                            h_new = _simple_step(x_t, h, *_w, activation)
+                            return h_new, h_new
+
+                        h_fin, outs = jax.lax.scan(step, h_init, seq)
+                    if d == 1:
+                        outs = jnp.flip(outs, 0)
+                    final_h.append(h_fin)
+                    dir_outs.append(outs)
+                layer_in = jnp.concatenate(dir_outs, axis=-1) if num_dirs == 2 else dir_outs[0]
+            out = layer_in if time_major else jnp.swapaxes(layer_in, 0, 1)
+            h_stack = jnp.stack(final_h, 0)
+            if is_lstm:
+                return out, h_stack, jnp.stack(final_c, 0)
+            return out, h_stack
+
+        if is_lstm:
+            out, h, c = apply_op(
+                f"rnn_{mode}", fn, inputs, initial_states[0], initial_states[1], *flat_weights
+            )
+            return out, (h, c)
+        out, h = apply_op(f"rnn_{mode}", fn, inputs, initial_states, *flat_weights)
+        return out, h
+
+
+class LSTM(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward", time_major=False, dropout=0.0, weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__("LSTM", input_size, hidden_size, num_layers, direction, time_major, dropout)
+
+
+class GRU(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward", time_major=False, dropout=0.0, weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__("GRU", input_size, hidden_size, num_layers, direction, time_major, dropout)
+
+
+class SimpleRNN(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward", time_major=False, dropout=0.0, activation="tanh", weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__("RNN_TANH" if activation == "tanh" else "RNN_RELU", input_size, hidden_size, num_layers, direction, time_major, dropout)
+
+
+class RNN(Layer):
+    """Generic RNN wrapper running a cell over a sequence (paddle.nn.RNN)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...tensor.manipulation import stack, unbind
+
+        time_axis = 0 if self.time_major else 1
+        steps = unbind(inputs, axis=time_axis)
+        if self.is_reverse:
+            steps = steps[::-1]
+        states = initial_states
+        outs = []
+        for x_t in steps:
+            out, states = self.cell(x_t, states)
+            outs.append(out)
+        if self.is_reverse:
+            outs = outs[::-1]
+        return stack(outs, axis=time_axis), states
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...tensor.manipulation import concat
+
+        states_fw, states_bw = (initial_states or (None, None))
+        out_fw, st_fw = self.rnn_fw(inputs, states_fw)
+        out_bw, st_bw = self.rnn_bw(inputs, states_bw)
+        return concat([out_fw, out_bw], axis=-1), (st_fw, st_bw)

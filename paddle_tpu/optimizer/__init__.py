@@ -1,0 +1,319 @@
+"""paddle.optimizer parity: SGD/Momentum/Adam/AdamW/Adamax/Adagrad/Adadelta/
+RMSProp/Lamb/Rprop/LBFGS + lr schedulers.
+
+Update rules are pure jax functions executed inside the base class's single
+fused jit update (optimizer.py).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import lr
+from .lr import LRScheduler
+from .optimizer import Optimizer
+
+
+class SGD(Optimizer):
+    _accumulator_names = ()
+
+    def _update_rule(self, param, grad, state, lr_):
+        return param - lr_ * grad, state
+
+
+class Momentum(Optimizer):
+    _accumulator_names = ("velocity",)
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None, use_nesterov=False, weight_decay=None, grad_clip=None, multi_precision=False, name=None):
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision, name)
+
+    def _update_rule(self, param, grad, state, lr_):
+        v = self._momentum * state["velocity"] + grad
+        if self._nesterov:
+            new_p = param - lr_ * (grad + self._momentum * v)
+        else:
+            new_p = param - lr_ * v
+        state["velocity"] = v
+        return new_p, state
+
+
+class Adam(Optimizer):
+    _accumulator_names = ("moment1", "moment2")
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-08, parameters=None, weight_decay=None, grad_clip=None, lazy_mode=False, multi_precision=False, use_multi_tensor=False, amsgrad=False, name=None):
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._amsgrad = amsgrad
+        if amsgrad:
+            self._accumulator_names = ("moment1", "moment2", "moment2_max")
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision, name)
+
+    def _update_rule(self, param, grad, state, lr_):
+        t = state["_step"]
+        m = self._beta1 * state["moment1"] + (1 - self._beta1) * grad
+        v = self._beta2 * state["moment2"] + (1 - self._beta2) * grad * grad
+        state["moment1"], state["moment2"] = m, v
+        m_hat = m / (1 - self._beta1**t)
+        if self._amsgrad:
+            v_max = jnp.maximum(state["moment2_max"], v)
+            state["moment2_max"] = v_max
+            v_hat = v_max / (1 - self._beta2**t)
+        else:
+            v_hat = v / (1 - self._beta2**t)
+        return param - lr_ * m_hat / (jnp.sqrt(v_hat) + self._eps), state
+
+
+class AdamW(Adam):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-08, parameters=None, weight_decay=0.01, lr_ratio=None, apply_decay_param_fun=None, grad_clip=None, lazy_mode=False, multi_precision=False, amsgrad=False, name=None):
+        self._apply_decay_fun = apply_decay_param_fun
+        self._lr_ratio = lr_ratio
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters, weight_decay, grad_clip, lazy_mode, multi_precision, False, amsgrad, name)
+
+    def _decay_mode(self):
+        # decoupled decay is applied by the base batch update, per-param
+        return "decoupled"
+
+    def _param_decay_coeff(self, p):
+        if self._apply_decay_fun is not None and not self._apply_decay_fun(p.name):
+            return 0.0
+        return self._decay_coeff()
+
+    def _param_lr_scale(self, p):
+        scale = super()._param_lr_scale(p)
+        if self._lr_ratio is not None:
+            scale *= float(self._lr_ratio(p))
+        return scale
+
+
+class Adamax(Optimizer):
+    _accumulator_names = ("moment", "inf_norm")
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-08, parameters=None, weight_decay=None, grad_clip=None, multi_precision=False, name=None):
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision, name)
+
+    def _update_rule(self, param, grad, state, lr_):
+        t = state["_step"]
+        m = self._beta1 * state["moment"] + (1 - self._beta1) * grad
+        u = jnp.maximum(self._beta2 * state["inf_norm"], jnp.abs(grad))
+        state["moment"], state["inf_norm"] = m, u
+        return param - (lr_ / (1 - self._beta1**t)) * m / (u + self._eps), state
+
+
+class Adagrad(Optimizer):
+    _accumulator_names = ("moment",)
+
+    def __init__(self, learning_rate, epsilon=1e-06, parameters=None, weight_decay=None, grad_clip=None, multi_precision=False, initial_accumulator_value=0.0, name=None):
+        self._eps = epsilon
+        self._init_acc = initial_accumulator_value
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision, name)
+
+    def _create_accumulators(self, p):
+        state = super()._create_accumulators(p)
+        if self._init_acc:
+            state["moment"] = state["moment"] + self._init_acc
+        return state
+
+    def _update_rule(self, param, grad, state, lr_):
+        acc = state["moment"] + grad * grad
+        state["moment"] = acc
+        return param - lr_ * grad / (jnp.sqrt(acc) + self._eps), state
+
+
+class Adadelta(Optimizer):
+    _accumulator_names = ("avg_squared_grad", "avg_squared_update")
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-06, rho=0.95, parameters=None, weight_decay=None, grad_clip=None, multi_precision=False, name=None):
+        self._eps, self._rho = epsilon, rho
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision, name)
+
+    def _update_rule(self, param, grad, state, lr_):
+        avg_sq = self._rho * state["avg_squared_grad"] + (1 - self._rho) * grad * grad
+        update = (
+            jnp.sqrt(state["avg_squared_update"] + self._eps)
+            / jnp.sqrt(avg_sq + self._eps)
+            * grad
+        )
+        state["avg_squared_grad"] = avg_sq
+        state["avg_squared_update"] = (
+            self._rho * state["avg_squared_update"] + (1 - self._rho) * update * update
+        )
+        return param - lr_ * update, state
+
+
+class RMSProp(Optimizer):
+    _accumulator_names = ("mean_square", "mean_grad", "momentum_acc")
+
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-06, momentum=0.0, centered=False, parameters=None, weight_decay=None, grad_clip=None, multi_precision=False, name=None):
+        self._rho, self._eps, self._momentum, self._centered = rho, epsilon, momentum, centered
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision, name)
+
+    def _update_rule(self, param, grad, state, lr_):
+        ms = self._rho * state["mean_square"] + (1 - self._rho) * grad * grad
+        state["mean_square"] = ms
+        if self._centered:
+            mg = self._rho * state["mean_grad"] + (1 - self._rho) * grad
+            state["mean_grad"] = mg
+            denom = jnp.sqrt(ms - mg * mg + self._eps)
+        else:
+            denom = jnp.sqrt(ms + self._eps)
+        mom = self._momentum * state["momentum_acc"] + lr_ * grad / denom
+        state["momentum_acc"] = mom
+        return param - mom, state
+
+
+class Lamb(Optimizer):
+    _accumulator_names = ("moment1", "moment2")
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9, beta2=0.999, epsilon=1e-06, parameters=None, grad_clip=None, exclude_from_weight_decay_fn=None, multi_precision=False, name=None):
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._lamb_wd = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+        super().__init__(learning_rate, parameters, None, grad_clip, multi_precision, name)
+
+    def _update_rule(self, param, grad, state, lr_):
+        t = state["_step"]
+        m = self._beta1 * state["moment1"] + (1 - self._beta1) * grad
+        v = self._beta2 * state["moment2"] + (1 - self._beta2) * grad * grad
+        state["moment1"], state["moment2"] = m, v
+        m_hat = m / (1 - self._beta1**t)
+        v_hat = v / (1 - self._beta2**t)
+        r = m_hat / (jnp.sqrt(v_hat) + self._eps) + self._lamb_wd * param
+        w_norm = jnp.sqrt(jnp.sum(param * param))
+        r_norm = jnp.sqrt(jnp.sum(r * r))
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        return param - lr_ * trust * r, state
+
+
+class Rprop(Optimizer):
+    _accumulator_names = ("prev_grad", "step_size")
+
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50), parameters=None, etas=(0.5, 1.2), grad_clip=None, multi_precision=False, name=None):
+        self._eta_neg, self._eta_pos = etas
+        self._lr_min, self._lr_max = learning_rate_range
+        self._init_lr = learning_rate
+        super().__init__(learning_rate, parameters, None, grad_clip, multi_precision, name)
+
+    def _create_accumulators(self, p):
+        state = super()._create_accumulators(p)
+        state["step_size"] = state["step_size"] + self._init_lr
+        return state
+
+    def _update_rule(self, param, grad, state, lr_):
+        sign = jnp.sign(grad * state["prev_grad"])
+        step = jnp.where(
+            sign > 0,
+            jnp.minimum(state["step_size"] * self._eta_pos, self._lr_max),
+            jnp.where(
+                sign < 0,
+                jnp.maximum(state["step_size"] * self._eta_neg, self._lr_min),
+                state["step_size"],
+            ),
+        )
+        grad_eff = jnp.where(sign < 0, 0.0, grad)
+        state["prev_grad"] = grad_eff
+        state["step_size"] = step
+        return param - step * jnp.sign(grad_eff), state
+
+
+class LBFGS(Optimizer):
+    """Eager L-BFGS with strong-Wolfe-free backtracking (paddle parity at the
+    API level; reference optimizer/lbfgs.py)."""
+
+    _accumulator_names = ()
+
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None, tolerance_grad=1e-07, tolerance_change=1e-09, history_size=100, line_search_fn=None, parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, False, name)
+        self.max_iter = max_iter
+        self.history_size = history_size
+        self.tolerance_grad = tolerance_grad
+        self.tolerance_change = tolerance_change
+        self._history = []
+
+    def step(self, closure=None):
+        import numpy as np
+
+        if closure is None:
+            raise ValueError("LBFGS.step requires a closure returning the loss")
+        from ..autograd.grad_mode import enable_grad
+
+        def flat_params():
+            return jnp.concatenate([p._data.reshape(-1) for p in self._parameter_list])
+
+        def set_flat(vec):
+            off = 0
+            for p in self._parameter_list:
+                n = p._data.size
+                p._data = vec[off : off + n].reshape(p._data.shape)
+                off += n
+
+        def eval_closure():
+            self.clear_grad()
+            with enable_grad():
+                loss = closure()
+            g = jnp.concatenate(
+                [
+                    (p.grad._data if p.grad is not None else jnp.zeros_like(p._data)).reshape(-1)
+                    for p in self._parameter_list
+                ]
+            )
+            return float(loss.numpy()), g
+
+        loss, g = eval_closure()
+        for _ in range(self.max_iter):
+            if float(jnp.max(jnp.abs(g))) <= self.tolerance_grad:
+                break
+            # two-loop recursion
+            q = g
+            alphas = []
+            for s, y, rho in reversed(self._history):
+                a = rho * jnp.dot(s, q)
+                alphas.append(a)
+                q = q - a * y
+            if self._history:
+                s, y, _ = self._history[-1]
+                gamma = jnp.dot(s, y) / jnp.maximum(jnp.dot(y, y), 1e-10)
+                q = q * gamma
+            for (s, y, rho), a in zip(self._history, reversed(alphas)):
+                b = rho * jnp.dot(y, q)
+                q = q + (a - b) * s
+            d = -q
+            x0 = flat_params()
+            t = self.get_lr()
+            f0 = loss
+            for _ls in range(20):
+                set_flat(x0 + t * d)
+                new_loss, new_g = eval_closure()
+                if new_loss <= f0 + 1e-4 * t * float(jnp.dot(g, d)):
+                    break
+                t *= 0.5
+            s_vec = t * d
+            y_vec = new_g - g
+            ys = float(jnp.dot(y_vec, s_vec))
+            if ys > 1e-10:
+                self._history.append((s_vec, y_vec, 1.0 / ys))
+                if len(self._history) > self.history_size:
+                    self._history.pop(0)
+            if abs(new_loss - loss) < self.tolerance_change:
+                loss, g = new_loss, new_g
+                break
+            loss, g = new_loss, new_g
+        return loss
+
+
+__all__ = [
+    "Optimizer",
+    "SGD",
+    "Momentum",
+    "Adam",
+    "AdamW",
+    "Adamax",
+    "Adagrad",
+    "Adadelta",
+    "RMSProp",
+    "Lamb",
+    "Rprop",
+    "LBFGS",
+    "lr",
+    "LRScheduler",
+]

@@ -1,0 +1,22 @@
+"""paddle.regularizer parity (python/paddle/regularizer.py)."""
+from __future__ import annotations
+
+
+class WeightDecayRegularizer:
+    pass
+
+
+class L2Decay(WeightDecayRegularizer):
+    def __init__(self, coeff: float = 0.0):
+        self._coeff = float(coeff)
+
+    def __float__(self):
+        return self._coeff
+
+
+class L1Decay(WeightDecayRegularizer):
+    def __init__(self, coeff: float = 0.0):
+        self._coeff = float(coeff)
+
+    def __float__(self):
+        return self._coeff

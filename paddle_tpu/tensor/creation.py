@@ -130,14 +130,13 @@ def diagflat(x, offset=0, name=None) -> Tensor:
 
 def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None) -> Tensor:
     def fn(v):
-        out = jnp.zeros(v.shape + (v.shape[-1] + abs(offset),), v.dtype)
-        idx = jnp.arange(v.shape[-1])
-        if offset >= 0:
-            out = out.at[..., idx, idx + offset].set(v)
-        else:
-            out = out.at[..., idx - offset, idx].set(v)
-        last = out.shape[-1]
-        out = jnp.reshape(out, v.shape[:-1] + (v.shape[-1] + abs(offset), last))
+        n = v.shape[-1]
+        m = n + abs(offset)
+        out = jnp.zeros(v.shape[:-1] + (m, m), v.dtype)
+        idx = jnp.arange(n)
+        rows = idx + (-offset if offset < 0 else 0)
+        cols = idx + (offset if offset > 0 else 0)
+        out = out.at[..., rows, cols].set(v)
         return jnp.moveaxis(jnp.moveaxis(out, -2, dim1), -1, dim2)
 
     return apply_op("diag_embed", fn, x)

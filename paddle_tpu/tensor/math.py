@@ -217,8 +217,8 @@ def sum(x, axis=None, dtype=None, keepdim=False, name=None):
     want = dtype_mod.to_jax_dtype(dtype) if dtype is not None else None
 
     def fn(v):
-        out = jnp.sum(v, axis=_axis(axis), keepdims=keepdim)
-        return out.astype(want) if want is not None else out
+        # accumulate IN the requested dtype (overflow semantics parity)
+        return jnp.sum(v, axis=_axis(axis), keepdims=keepdim, dtype=want)
 
     return apply_op("sum", fn, x)
 
@@ -254,7 +254,12 @@ def amin(x, axis=None, keepdim=False, name=None):
 
 
 def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
-    return apply_op("nansum", lambda v: jnp.nansum(v, axis=_axis(axis), keepdims=keepdim), x)
+    want = dtype_mod.to_jax_dtype(dtype) if dtype is not None else None
+    return apply_op(
+        "nansum",
+        lambda v: jnp.nansum(v, axis=_axis(axis), keepdims=keepdim, dtype=want),
+        x,
+    )
 
 
 def nanmean(x, axis=None, keepdim=False, name=None):
@@ -277,15 +282,21 @@ def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
 
 # --- cumulative ---
 def cumsum(x, axis=None, dtype=None, name=None):
+    want = dtype_mod.to_jax_dtype(dtype) if dtype is not None else None
+
     def fn(v):
-        out = jnp.cumsum(v if axis is not None else v.reshape(-1), axis=axis if axis is not None else 0)
-        return out
+        return jnp.cumsum(
+            v if axis is not None else v.reshape(-1),
+            axis=axis if axis is not None else 0,
+            dtype=want,
+        )
 
     return apply_op("cumsum", fn, x)
 
 
 def cumprod(x, dim=None, dtype=None, name=None):
-    return apply_op("cumprod", lambda v: jnp.cumprod(v, axis=dim), x)
+    want = dtype_mod.to_jax_dtype(dtype) if dtype is not None else None
+    return apply_op("cumprod", lambda v: jnp.cumprod(v, axis=dim, dtype=want), x)
 
 
 def cummax(x, axis=None, dtype="int64", name=None):

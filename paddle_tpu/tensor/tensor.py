@@ -60,7 +60,9 @@ class Tensor:
         self._grad_node = None
         self._out_index = 0
         self.grad = None
-        self.name = name or f"generated_tensor_{id(self)}"
+        # Monotonic counter, not id(): names must be stable across process
+        # restarts (optimizer state_dict keys are derived from them).
+        self.name = name or f"generated_tensor_{_next_name_index()}"
         self.persistable = False
         self._hooks = {}
         self._hook_counter = 0
@@ -329,6 +331,15 @@ class Tensor:
         return _mesh_of(self)
 
 
+_name_counter = [0]
+_param_counter = [0]
+
+
+def _next_name_index() -> int:
+    _name_counter[0] += 1
+    return _name_counter[0]
+
+
 def register_tensor_method(name: str, fn):
     """Bind a function as a Tensor method (tensor_patch_methods parity)."""
     setattr(Tensor, name, fn)
@@ -338,6 +349,12 @@ class Parameter(Tensor):
     """A trainable Tensor (paddle.base.framework.EagerParamBase parity)."""
 
     def __init__(self, data, dtype=None, trainable: bool = True, name=None):
+        if name is None:
+            # Deterministic creation-order names (param_0, param_1, ...):
+            # rebuilding the same model in a fresh process reproduces them, so
+            # optimizer state_dict keys survive checkpoints.
+            name = f"param_{_param_counter[0]}"
+            _param_counter[0] += 1
         super().__init__(data, dtype=dtype, stop_gradient=not trainable, name=name)
         self.persistable = True
         self.trainable = trainable

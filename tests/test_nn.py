@@ -1,0 +1,248 @@
+"""nn.Layer stack tests (subsystem API tier, SURVEY.md §4)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+import paddle_tpu.nn.functional as F
+
+
+def t(arr, sg=True):
+    return paddle.to_tensor(np.asarray(arr, np.float32), stop_gradient=sg)
+
+
+class TestLayerBase:
+    def test_registration_and_traversal(self):
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc1 = nn.Linear(4, 8)
+                self.fc2 = nn.Linear(8, 2)
+
+            def forward(self, x):
+                return self.fc2(F.relu(self.fc1(x)))
+
+        net = Net()
+        names = [n for n, _ in net.named_parameters()]
+        assert names == ["fc1.weight", "fc1.bias", "fc2.weight", "fc2.bias"]
+        assert len(net.sublayers()) == 2
+        out = net(t(np.ones((3, 4))))
+        assert out.shape == [3, 2]
+
+    def test_state_dict_roundtrip(self):
+        net = nn.Linear(3, 3)
+        sd = net.state_dict()
+        net2 = nn.Linear(3, 3)
+        net2.set_state_dict(sd)
+        np.testing.assert_array_equal(net.weight.numpy(), net2.weight.numpy())
+
+    def test_train_eval_mode(self):
+        net = nn.Sequential(nn.Linear(2, 2), nn.Dropout(0.5))
+        net.eval()
+        assert not net[1].training
+        x = t(np.ones((4, 2)))
+        np.testing.assert_array_equal(net(x).numpy(), net(x).numpy())  # no dropout in eval
+        net.train()
+        assert net[1].training
+
+    def test_forward_hooks(self):
+        net = nn.Linear(2, 2)
+        calls = []
+        h = net.register_forward_post_hook(lambda layer, inp, out: calls.append(1))
+        net(t(np.ones((1, 2))))
+        assert calls == [1]
+        h.remove()
+        net(t(np.ones((1, 2))))
+        assert calls == [1]
+
+    def test_buffers_in_state_dict(self):
+        bn = nn.BatchNorm1D(4)
+        sd = bn.state_dict()
+        assert "_mean" in sd and "_variance" in sd and "weight" in sd
+
+    def test_to_dtype(self):
+        net = nn.Linear(2, 2)
+        net.bfloat16()
+        assert net.weight.dtype == paddle.bfloat16
+
+
+class TestLayers:
+    def test_linear_matches_numpy(self, rng):
+        net = nn.Linear(5, 3)
+        x = rng.randn(2, 5).astype(np.float32)
+        expect = x @ net.weight.numpy() + net.bias.numpy()
+        np.testing.assert_allclose(net(t(x)).numpy(), expect, rtol=1e-5)
+
+    def test_conv2d_shape_and_golden(self, rng):
+        conv = nn.Conv2D(3, 8, 3, stride=2, padding=1)
+        x = rng.randn(2, 3, 16, 16).astype(np.float32)
+        out = conv(t(x))
+        assert out.shape == [2, 8, 8, 8]
+        # golden check against explicit correlation for one output position
+        w = conv.weight.numpy()
+        b = conv.bias.numpy()
+        xp = np.pad(x, [(0, 0), (0, 0), (1, 1), (1, 1)])
+        patch = xp[0, :, 2:5, 2:5]  # output position (1,1): rows 2*1..+3
+        expect = (patch * w[1]).sum() + b[1]
+        np.testing.assert_allclose(out.numpy()[0, 1, 1, 1], expect, rtol=1e-4)
+
+    def test_conv_backward(self, rng):
+        conv = nn.Conv2D(2, 4, 3)
+        x = paddle.to_tensor(rng.randn(1, 2, 8, 8).astype(np.float32), stop_gradient=False)
+        conv(x).sum().backward()
+        assert conv.weight.grad is not None
+        assert x.grad.shape == [1, 2, 8, 8]
+
+    def test_batchnorm_train_vs_eval(self, rng):
+        bn = nn.BatchNorm1D(4)
+        x = rng.randn(16, 4).astype(np.float32) * 3 + 1
+        bn.train()
+        out = bn(t(x))
+        np.testing.assert_allclose(out.numpy().mean(0), np.zeros(4), atol=1e-5)
+        np.testing.assert_allclose(out.numpy().std(0), np.ones(4), atol=1e-2)
+        # running stats moved toward batch stats
+        assert abs(bn._mean.numpy().mean() - 0.1 * x.mean()) < 0.1
+        bn.eval()
+        out_eval = bn(t(x))
+        assert not np.allclose(out_eval.numpy().mean(0), np.zeros(4), atol=1e-3)
+
+    def test_layernorm_golden(self, rng):
+        ln = nn.LayerNorm(8)
+        x = rng.randn(4, 8).astype(np.float32)
+        out = ln(t(x)).numpy()
+        expect = (x - x.mean(-1, keepdims=True)) / np.sqrt(x.var(-1, keepdims=True) + 1e-5)
+        np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)
+
+    def test_embedding_and_padding_idx(self):
+        emb = nn.Embedding(10, 4, padding_idx=0)
+        ids = paddle.to_tensor(np.array([[1, 0, 3]]))
+        out = emb(ids)
+        assert out.shape == [1, 3, 4]
+        np.testing.assert_array_equal(out.numpy()[0, 1], np.zeros(4))
+
+    def test_pooling(self, rng):
+        x = rng.randn(1, 2, 8, 8).astype(np.float32)
+        mp = nn.MaxPool2D(2)(t(x))
+        assert mp.shape == [1, 2, 4, 4]
+        np.testing.assert_allclose(
+            mp.numpy()[0, 0, 0, 0], x[0, 0, :2, :2].max(), rtol=1e-6
+        )
+        ap = nn.AvgPool2D(2)(t(x))
+        np.testing.assert_allclose(
+            ap.numpy()[0, 0, 0, 0], x[0, 0, :2, :2].mean(), rtol=1e-5
+        )
+        ad = nn.AdaptiveAvgPool2D(1)(t(x))
+        np.testing.assert_allclose(ad.numpy()[0, 0, 0, 0], x[0, 0].mean(), rtol=1e-5)
+
+    def test_dropout_statistics(self):
+        paddle.seed(0)
+        x = t(np.ones((1000,)))
+        out = F.dropout(x, p=0.3, training=True)
+        kept = (out.numpy() != 0).mean()
+        assert 0.6 < kept < 0.8
+        # upscale_in_train: kept values scaled by 1/(1-p)
+        np.testing.assert_allclose(out.numpy()[out.numpy() != 0][0], 1 / 0.7, rtol=1e-5)
+
+    def test_activations_golden(self, rng):
+        x = rng.randn(10).astype(np.float32)
+        from math import erf
+
+        np.testing.assert_allclose(F.relu(t(x)).numpy(), np.maximum(x, 0))
+        gelu_expect = 0.5 * x * (1 + np.vectorize(erf)(x / np.sqrt(2)))
+        np.testing.assert_allclose(F.gelu(t(x)).numpy(), gelu_expect, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(
+            F.leaky_relu(t(x), 0.1).numpy(), np.where(x > 0, x, 0.1 * x), rtol=1e-6
+        )
+        sm = F.softmax(t(x)).numpy()
+        np.testing.assert_allclose(sm.sum(), 1.0, rtol=1e-5)
+
+    def test_rnn_lstm_gru(self, rng):
+        x = t(rng.randn(2, 5, 3).astype(np.float32))
+        lstm = nn.LSTM(3, 4, num_layers=2)
+        out, (h, c) = lstm(x)
+        assert out.shape == [2, 5, 4]
+        assert h.shape == [2, 2, 4] and c.shape == [2, 2, 4]
+        gru = nn.GRU(3, 4, direction="bidirect")
+        out, h = gru(x)
+        assert out.shape == [2, 5, 8]
+        assert h.shape == [2, 2, 4]
+
+    def test_lstm_backward(self, rng):
+        lstm = nn.LSTM(3, 4)
+        x = paddle.to_tensor(rng.randn(2, 5, 3).astype(np.float32), stop_gradient=False)
+        out, _ = lstm(x)
+        out.sum().backward()
+        assert x.grad is not None
+        assert lstm.weight_ih_l0.grad is not None
+
+    def test_transformer_encoder(self, rng):
+        layer = nn.TransformerEncoderLayer(d_model=16, nhead=4, dim_feedforward=32)
+        enc = nn.TransformerEncoder(layer, 2)
+        enc.eval()
+        x = t(rng.randn(2, 6, 16).astype(np.float32))
+        out = enc(x)
+        assert out.shape == [2, 6, 16]
+
+    def test_multihead_attention_causal_mask(self, rng):
+        mha = nn.MultiHeadAttention(8, 2)
+        mha.eval()
+        x = t(rng.randn(1, 4, 8).astype(np.float32))
+        mask = paddle.to_tensor(np.tril(np.ones((1, 1, 4, 4))).astype(bool))
+        out = mha(x, x, x, attn_mask=mask)
+        assert out.shape == [1, 4, 8]
+
+
+class TestLosses:
+    def test_cross_entropy_golden(self, rng):
+        logits = rng.randn(4, 5).astype(np.float32)
+        labels = np.array([0, 2, 1, 4])
+        loss = F.cross_entropy(t(logits), paddle.to_tensor(labels)).numpy()
+        # numpy reference
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        expect = -np.log(p[np.arange(4), labels]).mean()
+        np.testing.assert_allclose(loss, expect, rtol=1e-5)
+
+    def test_cross_entropy_ignore_index(self, rng):
+        logits = rng.randn(4, 5).astype(np.float32)
+        labels = np.array([0, -100, 1, -100])
+        loss = F.cross_entropy(t(logits), paddle.to_tensor(labels)).numpy()
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        expect = -np.log(p[[0, 2], [0, 1]]).mean()
+        np.testing.assert_allclose(loss, expect, rtol=1e-5)
+
+    def test_mse_l1_bce(self, rng):
+        a, b = rng.rand(6).astype(np.float32), rng.rand(6).astype(np.float32)
+        np.testing.assert_allclose(F.mse_loss(t(a), t(b)).numpy(), ((a - b) ** 2).mean(), rtol=1e-5)
+        np.testing.assert_allclose(F.l1_loss(t(a), t(b)).numpy(), np.abs(a - b).mean(), rtol=1e-5)
+        y = (rng.rand(6) > 0.5).astype(np.float32)
+        bce = F.binary_cross_entropy(t(a), t(y)).numpy()
+        expect = -(y * np.log(a) + (1 - y) * np.log(1 - a)).mean()
+        np.testing.assert_allclose(bce, expect, rtol=1e-4)
+
+    def test_loss_layers(self, rng):
+        crit = nn.CrossEntropyLoss(label_smoothing=0.1)
+        logits = paddle.to_tensor(rng.randn(3, 4).astype(np.float32), stop_gradient=False)
+        loss = crit(logits, paddle.to_tensor(np.array([1, 2, 0])))
+        loss.backward()
+        assert logits.grad is not None
+
+
+class TestInitializers:
+    def test_constant_xavier_kaiming(self):
+        from paddle_tpu.nn import initializer as I
+
+        c = I.Constant(3.0)([2, 2], "float32")
+        assert np.asarray(c).sum() == 12
+        xu = np.asarray(I.XavierUniform()([100, 100], "float32"))
+        limit = np.sqrt(6 / 200)
+        assert np.abs(xu).max() <= limit + 1e-6
+        kn = np.asarray(I.KaimingNormal()([100, 100], "float32"))
+        assert 0.1 < kn.std() / np.sqrt(2 / 100) < 1.5
+
+    def test_orthogonal(self):
+        from paddle_tpu.nn import initializer as I
+
+        q = np.asarray(I.Orthogonal()([6, 4], "float32"))
+        np.testing.assert_allclose(q.T @ q, np.eye(4), atol=1e-5)
