@@ -1,7 +1,8 @@
-"""paddle.distributed parity (built out in paddle_tpu/distributed/*).
+"""paddle.distributed parity surface.
 
-This module re-exports the communication API, parallel environment, fleet,
-and auto_parallel surfaces. See SURVEY.md §2.6/§2.7 for the capability map.
+Layer map (SURVEY.md §2.6/§2.7): communication API (collective.py), parallel
+env + DataParallel (parallel.py), semi-auto API (auto_parallel/), device mesh
+(mesh.py), fleet hybrid-parallel (fleet/), sharding stages, checkpoint, launch.
 """
 from __future__ import annotations
 
@@ -39,33 +40,101 @@ def is_initialized() -> bool:
 
 def init_parallel_env():
     global _parallel_env_initialized
-    _parallel_env_initialized = True
     from .collective import _init_default_group
 
     _init_default_group()
+    from .parallel import ParallelEnv
+
+    _parallel_env_initialized = True
+    return ParallelEnv()
+
+
+def spawn(func, args=(), nprocs=-1, **kwargs):
+    """paddle.distributed.spawn parity: on TPU single-controller the mesh spans
+    all devices in ONE process, so spawn degenerates to a direct call."""
+    init_parallel_env()
+    return func(*args)
+
+
+_LAZY = {
+    # submodules
+    "fleet": ".fleet",
+    "collective": ".collective",
+    "auto_parallel": ".auto_parallel",
+    "checkpoint": ".checkpoint",
+    "launch": ".launch",
+    "parallel": ".parallel",
+    "sharding": ".sharding",
+    "utils": ".utils",
+    "communication": ".collective",
+}
+
+# name -> source module for flat re-exports
+_FLAT = {
+    # mesh / auto_parallel
+    "ProcessMesh": ".mesh",
+    "get_mesh": ".mesh",
+    "set_mesh": ".mesh",
+    "auto_mesh": ".mesh",
+    "in_spmd_region": ".mesh",
+    "Placement": ".auto_parallel.placement",
+    "Shard": ".auto_parallel.placement",
+    "Replicate": ".auto_parallel.placement",
+    "Partial": ".auto_parallel.placement",
+    "ReduceType": ".auto_parallel.placement",
+    "shard_tensor": ".auto_parallel.api",
+    "dtensor_from_fn": ".auto_parallel.api",
+    "reshard": ".auto_parallel.api",
+    "shard_layer": ".auto_parallel.api",
+    "shard_optimizer": ".auto_parallel.api",
+    "shard_dataloader": ".auto_parallel.api",
+    "ShardDataloader": ".auto_parallel.api",
+    "unshard_dtensor": ".auto_parallel.api",
+    # collectives
+    "ReduceOp": ".collective",
+    "Group": ".collective",
+    "new_group": ".collective",
+    "get_group": ".collective",
+    "is_available": ".collective",
+    "all_reduce": ".collective",
+    "all_gather": ".collective",
+    "all_gather_object": ".collective",
+    "broadcast": ".collective",
+    "broadcast_object_list": ".collective",
+    "reduce": ".collective",
+    "reduce_scatter": ".collective",
+    "scatter": ".collective",
+    "alltoall": ".collective",
+    "alltoall_single": ".collective",
+    "all_to_all": ".collective",
+    "send": ".collective",
+    "recv": ".collective",
+    "isend": ".collective",
+    "irecv": ".collective",
+    "P2POp": ".collective",
+    "batch_isend_irecv": ".collective",
+    "barrier": ".collective",
+    "gather": ".collective",
+    "p2p_push": ".collective",
+    "stack_ranks": ".collective",
+    "rank_slice": ".collective",
+    # parallel env
+    "ParallelEnv": ".parallel",
+    "DataParallel": ".parallel",
+}
 
 
 def __getattr__(name):
-    # Lazy: the heavy submodules import jax collectives; avoid import cycles.
     import importlib
 
-    mods = {
-        "fleet": ".fleet",
-        "collective": ".collective",
-        "auto_parallel": ".auto_parallel",
-        "checkpoint": ".checkpoint",
-        "launch": ".launch",
-        "parallel": ".parallel",
-        "sharding": ".sharding",
-        "utils": ".utils",
-    }
-    if name in mods:
-        return importlib.import_module(mods[name], __name__)
-    for source in (".collective", ".parallel", ".auto_parallel.api", ".mesh"):
+    if name in _LAZY:
         try:
-            mod = importlib.import_module(source, __name__)
-        except ImportError:
-            continue
-        if hasattr(mod, name):
-            return getattr(mod, name)
+            return importlib.import_module(_LAZY[name], __name__)
+        except ImportError as e:
+            raise AttributeError(
+                f"module 'paddle_tpu.distributed' has no attribute {name!r}"
+            ) from e
+    if name in _FLAT:
+        mod = importlib.import_module(_FLAT[name], __name__)
+        return getattr(mod, name)
     raise AttributeError(f"module 'paddle_tpu.distributed' has no attribute {name!r}")

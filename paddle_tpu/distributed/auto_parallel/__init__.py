@@ -1,0 +1,35 @@
+"""paddle.distributed.auto_parallel parity (semi-auto dygraph API).
+
+See SURVEY.md §2.7 "Semi-auto (dygraph)" row for the reference map.
+"""
+from ..mesh import ProcessMesh, get_mesh, set_mesh
+from .placement import Partial, Placement, ReduceType, Replicate, Shard
+from .api import (
+    ShardDataloader,
+    dtensor_from_fn,
+    reshard,
+    shard_dataloader,
+    shard_layer,
+    shard_optimizer,
+    shard_tensor,
+    unshard_dtensor,
+)
+
+__all__ = [
+    "ProcessMesh",
+    "get_mesh",
+    "set_mesh",
+    "Placement",
+    "Partial",
+    "Replicate",
+    "Shard",
+    "ReduceType",
+    "shard_tensor",
+    "dtensor_from_fn",
+    "reshard",
+    "shard_layer",
+    "shard_optimizer",
+    "shard_dataloader",
+    "ShardDataloader",
+    "unshard_dtensor",
+]

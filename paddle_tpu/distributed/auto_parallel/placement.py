@@ -1,0 +1,114 @@
+"""Placements: how one logical tensor dim maps onto one mesh dim.
+
+Reference parity: paddle/phi/core/distributed/auto_parallel/placement_types.h:36
+(Shard/Replicate/Partial) and python/paddle/distributed/auto_parallel/
+placement_type.py. On TPU these lower to jax.sharding.PartitionSpec entries;
+Partial is tracked as metadata (the XLA partitioner materialises pending
+reductions itself during propagation — SURVEY.md §2.7 semi-auto row).
+"""
+from __future__ import annotations
+
+
+class ReduceType:
+    kRedSum = "sum"
+    kRedMax = "max"
+    kRedMin = "min"
+    kRedProd = "prod"
+    kRedAvg = "avg"
+    kRedAny = "any"
+    kRedAll = "all"
+
+
+class Placement:
+    def is_shard(self, dim=None) -> bool:
+        return False
+
+    def is_replicated(self) -> bool:
+        return False
+
+    def is_partial(self) -> bool:
+        return False
+
+
+class Replicate(Placement):
+    def is_replicated(self) -> bool:
+        return True
+
+    def __eq__(self, other):
+        return isinstance(other, Replicate)
+
+    def __hash__(self):
+        return hash("Replicate")
+
+    def __repr__(self):
+        return "Replicate()"
+
+
+class Shard(Placement):
+    def __init__(self, dim: int):
+        self._dim = int(dim)
+
+    def get_dim(self) -> int:
+        return self._dim
+
+    @property
+    def dim(self) -> int:
+        return self._dim
+
+    def is_shard(self, dim=None) -> bool:
+        return dim is None or dim == self._dim
+
+    def __eq__(self, other):
+        return isinstance(other, Shard) and other._dim == self._dim
+
+    def __hash__(self):
+        return hash(("Shard", self._dim))
+
+    def __repr__(self):
+        return f"Shard(dim={self._dim})"
+
+
+class Partial(Placement):
+    def __init__(self, reduce_type: str = ReduceType.kRedSum):
+        self._reduce_type = reduce_type
+
+    @property
+    def reduce_type(self):
+        return self._reduce_type
+
+    def is_partial(self) -> bool:
+        return True
+
+    def __eq__(self, other):
+        return isinstance(other, Partial) and other._reduce_type == self._reduce_type
+
+    def __hash__(self):
+        return hash(("Partial", self._reduce_type))
+
+    def __repr__(self):
+        return f"Partial(reduce_type={self._reduce_type})"
+
+
+def placements_to_spec(placements, mesh):
+    """Lower a placements list (one entry per MESH dim) to a PartitionSpec
+    (one entry per TENSOR dim). Partial contributes no sharding (metadata only).
+    """
+    from jax.sharding import PartitionSpec
+
+    ndim = max(
+        (p.get_dim() for p in placements if isinstance(p, Shard)),
+        default=-1,
+    )
+    # spec needs entries up to the highest sharded tensor dim
+    entries: list = [None] * (ndim + 1)
+    for mesh_dim, p in enumerate(placements):
+        if isinstance(p, Shard):
+            d = p.get_dim()
+            name = mesh.dim_names[mesh_dim]
+            if entries[d] is None:
+                entries[d] = name
+            elif isinstance(entries[d], tuple):
+                entries[d] = entries[d] + (name,)
+            else:
+                entries[d] = (entries[d], name)
+    return PartitionSpec(*entries)

@@ -1,0 +1,492 @@
+"""Collective communication API (paddle.distributed.* parity).
+
+Reference layering (SURVEY.md §5.8): NCCL → CommContext → ProcessGroup →
+python functional collectives over Group objects
+(python/paddle/distributed/communication/*.py, group.py).
+
+TPU-native design — one API, two execution paths:
+
+1. **SPMD path** (inside ``shard_map``/``pjit`` where the group's mesh axis is
+   bound): collectives lower to XLA HLO collectives (``lax.psum``,
+   ``lax.all_gather``, ``lax.all_to_all``, ``lax.ppermute``) over ICI. This is
+   the path hybrid-parallel layers use — compiled, fused, and overlapped by
+   XLA's latency-hiding scheduler (the reference gets overlap from comm
+   streams; XLA gets it from the scheduler).
+
+2. **Eager path** (plain python): single-controller global-view semantics with
+   the **rank-major convention** — a "per-rank local tensor of shape S" is the
+   global tensor of shape ``[nranks, *S]`` sharded over the group axis on dim 0
+   (exactly jax.pmap's data model; on multi-host each process holds its own
+   rank-slices). ``all_reduce`` reduces dim 0; ``all_gather`` replicates; etc.
+   Each eager collective is one ``jit``-cached XLA executable per
+   (op, shape, dtype, group) — the "cached single-collective executables"
+   design called out in SURVEY.md §5.8.
+"""
+from __future__ import annotations
+
+import pickle
+from typing import Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..tensor.tensor import Tensor
+from ..autograd.engine import apply_op
+from .mesh import in_spmd_region
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+_REDUCERS = {
+    ReduceOp.SUM: (jnp.sum, lax.psum),
+    ReduceOp.MAX: (jnp.max, lax.pmax),
+    ReduceOp.MIN: (jnp.min, lax.pmin),
+    ReduceOp.PROD: (lambda x, axis: jnp.prod(x, axis=axis), None),
+    ReduceOp.AVG: (jnp.mean, lax.pmean),
+}
+
+
+class Group:
+    """A communicator: an ordered set of ranks bound to a mesh axis.
+
+    Reference: communication/group.py Group + ProcessGroup ring-id semantics;
+    here a group IS a 1-D device mesh whose axis name is used both for eager
+    shardings and for lax collectives inside shard_map.
+    """
+
+    _counter = [0]
+
+    def __init__(self, ranks: Sequence[int], axis_name: str | None = None, gid=None):
+        self.ranks = list(ranks)
+        self.nranks = len(self.ranks)
+        self.world_size = self.nranks
+        if gid is None:
+            Group._counter[0] += 1
+            gid = Group._counter[0]
+        self.id = gid
+        self.axis_name = axis_name or f"group_{gid}"
+        self._jax_mesh = None
+
+    @property
+    def process_group(self):
+        return self
+
+    def get_group_rank(self, rank: int) -> int:
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    @property
+    def rank(self) -> int:
+        from . import get_rank
+
+        return self.get_group_rank(get_rank())
+
+    def to_jax_mesh(self) -> Mesh:
+        if self._jax_mesh is None:
+            devices = jax.devices()
+            n = len(devices)
+            devs = np.array([devices[r % n] for r in self.ranks])
+            self._jax_mesh = Mesh(devs, (self.axis_name,))
+        return self._jax_mesh
+
+    def rank_sharding(self) -> NamedSharding:
+        """Sharding for rank-major stacked tensors (dim 0 = rank)."""
+        return NamedSharding(self.to_jax_mesh(), P(self.axis_name))
+
+    def replicated_sharding(self) -> NamedSharding:
+        return NamedSharding(self.to_jax_mesh(), P())
+
+    def __repr__(self):
+        return f"Group(id={self.id}, ranks={self.ranks}, axis={self.axis_name!r})"
+
+
+_default_group: Group | None = None
+_groups: dict[int, Group] = {}
+
+
+def _init_default_group() -> Group:
+    global _default_group
+    if _default_group is None:
+        n = len(jax.devices())
+        _default_group = Group(list(range(n)), axis_name="world", gid=0)
+        _groups[0] = _default_group
+    return _default_group
+
+
+def get_group(gid: int = 0) -> Group:
+    if gid == 0:
+        return _init_default_group()
+    return _groups[gid]
+
+
+def new_group(ranks=None, backend=None, timeout=None, axis_name=None) -> Group:
+    if ranks is None:
+        return _init_default_group()
+    g = Group(list(ranks), axis_name=axis_name)
+    _groups[g.id] = g
+    return g
+
+
+def _resolve_group(group) -> Group:
+    if group is None:
+        return _init_default_group()
+    return group
+
+
+def _unwrap(t):
+    return t._data if isinstance(t, Tensor) else jnp.asarray(t)
+
+
+def is_available() -> bool:
+    return True
+
+
+# ---------------------------------------------------------------------------
+# collectives
+# ---------------------------------------------------------------------------
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    """SUM/MAX/... across the group.
+
+    SPMD path: per-rank local value in, reduced value out (lax.psum).
+    Eager path: rank-major ``[nranks, *S]`` in, ``[nranks, *S]`` out with every
+    rank slot holding the reduction (paddle semantics: in-place on each rank).
+    """
+    g = _resolve_group(group)
+    if in_spmd_region(g.axis_name):
+        _, pred = _REDUCERS[op]
+        if pred is None:
+            raise NotImplementedError(f"reduce op {op} inside SPMD region")
+        return apply_op(f"all_reduce_{op}", lambda x: pred(x, g.axis_name), tensor)
+    red, _ = _REDUCERS[op]
+    if op == ReduceOp.PROD:
+        fn = lambda x: jnp.broadcast_to(jnp.prod(x, axis=0, keepdims=True), x.shape)
+    else:
+        fn = lambda x: jnp.broadcast_to(red(x, axis=0, keepdims=True), x.shape)
+    out = apply_op(f"all_reduce_{op}", fn, tensor)
+    if isinstance(tensor, Tensor):
+        tensor._data = out._data  # paddle all_reduce is in-place
+    return out
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    """Like all_reduce but only rank ``dst`` holds the result (others keep
+    their input — eager rank-major emulation updates only the dst slot)."""
+    g = _resolve_group(group)
+    if in_spmd_region(g.axis_name):
+        _, pred = _REDUCERS[op]
+        return apply_op(f"reduce_{op}", lambda x: pred(x, g.axis_name), tensor)
+    dst_idx = g.get_group_rank(dst) if dst in g.ranks else dst
+    red, _ = _REDUCERS[op]
+
+    def fn(x):
+        r = red(x, axis=0, keepdims=True)
+        return x.at[dst_idx].set(r[0])
+
+    out = apply_op(f"reduce_{op}", fn, tensor)
+    if isinstance(tensor, Tensor):
+        tensor._data = out._data
+    return out
+
+
+def all_gather(tensor_or_list, tensor=None, group=None, sync_op=True, axis=0):
+    """paddle signature: all_gather(tensor_list, tensor, group).
+
+    SPMD path: returns the gathered (concatenated on ``axis``) array.
+    Eager path (rank-major [n, *S] input): appends n tensors, each the
+    replicated value of one rank's slice, to ``tensor_list``.
+    """
+    g = _resolve_group(group)
+    if tensor is None or not isinstance(tensor_or_list, list):
+        # functional form: all_gather(tensor) -> concat over ranks
+        x = tensor_or_list if tensor is None else tensor
+        if in_spmd_region(g.axis_name):
+            return apply_op(
+                "all_gather",
+                lambda v: lax.all_gather(v, g.axis_name, axis=axis, tiled=True),
+                x,
+            )
+        # eager rank-major: [n, *S] -> [n, n*S_axis] per-rank concat == just
+        # the replicated concat of slices
+        def fn(v):
+            parts = [v[i] for i in range(g.nranks)]
+            cat = jnp.concatenate(parts, axis=axis)
+            return jnp.broadcast_to(cat[None], (g.nranks,) + cat.shape)
+
+        return apply_op("all_gather", fn, x)
+
+    tensor_list, x = tensor_or_list, tensor
+    if in_spmd_region(g.axis_name):
+        gathered = apply_op(
+            "all_gather",
+            lambda v: lax.all_gather(v, g.axis_name, axis=0, tiled=False),
+            x,
+        )
+        tensor_list.extend(gathered[i] for i in range(g.nranks))
+        return tensor_list
+    for i in range(g.nranks):
+        sl = apply_op(
+            "all_gather_slice",
+            lambda v, i=i: jnp.broadcast_to(v[i][None], v.shape),
+            x,
+        )
+        tensor_list.append(sl)
+    return tensor_list
+
+
+def all_gather_object(object_list, obj, group=None):
+    g = _resolve_group(group)
+    # control-plane: single-controller already sees every rank's object
+    object_list.extend([obj] * g.nranks)
+    return object_list
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    g = _resolve_group(group)
+    if in_spmd_region(g.axis_name):
+        # inside SPMD: select src's value via all_gather + index (XLA folds it)
+        src_idx = g.get_group_rank(src) if src in g.ranks else src
+        return apply_op(
+            "broadcast",
+            lambda v: lax.all_gather(v, g.axis_name, axis=0)[src_idx],
+            tensor,
+        )
+    src_idx = g.get_group_rank(src) if src in g.ranks else src
+    out = apply_op(
+        "broadcast",
+        lambda v: jnp.broadcast_to(v[src_idx][None], v.shape),
+        tensor,
+    )
+    if isinstance(tensor, Tensor):
+        tensor._data = out._data
+    return out
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    return object_list  # single-controller: all ranks share the object
+
+
+def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None, sync_op=True):
+    """SPMD: lax.psum_scatter. Eager rank-major: in [n, n, *S] (rank-major of
+    per-rank stacked contributions) or functional [n, *S] where S splits n-ways
+    on dim 1 -> out [n, *S/n]: out[r] = sum_r' in[r'] chunk r."""
+    g = _resolve_group(group)
+    if in_spmd_region(g.axis_name):
+        return apply_op(
+            f"reduce_scatter_{op}",
+            lambda v: lax.psum_scatter(v, g.axis_name, scatter_dimension=0, tiled=True),
+            tensor if tensor_list is None else tensor_list,
+        )
+    x = tensor if tensor_list is None else tensor_list
+    if isinstance(x, list):
+        x = stack_ranks_like(x, g)
+
+    def fn(v):
+        red = jnp.sum(v, axis=0) if op == ReduceOp.SUM else _REDUCERS[op][0](v, axis=0)
+        # red: [n*S0/n...] -> split dim 0 into n chunks, rank r gets chunk r
+        chunks = jnp.reshape(red, (g.nranks, -1) + red.shape[1:])
+        return chunks
+
+    return apply_op(f"reduce_scatter_{op}", fn, x)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    g = _resolve_group(group)
+    src_idx = g.get_group_rank(src) if src in g.ranks else src
+    if in_spmd_region(g.axis_name):
+        def fn(v):
+            full = lax.all_gather(v, g.axis_name, axis=0)[src_idx]
+            i = lax.axis_index(g.axis_name)
+            return lax.dynamic_index_in_dim(full, i, axis=0, keepdims=False)
+
+        return apply_op("scatter", fn, tensor if tensor_list is None else jnp.stack([_unwrap(t) for t in tensor_list]))
+    # eager rank-major: input [n, *S] from src; out[r] = in[src][r]... paddle:
+    # src rank provides tensor_list of n tensors; rank r receives list[r].
+    if tensor_list is not None:
+        stacked = jnp.stack([_unwrap(t) for t in tensor_list])
+        out = Tensor(stacked)
+    else:
+        out = apply_op("scatter", lambda v: v, tensor)
+    if isinstance(tensor, Tensor) and tensor_list is not None:
+        tensor._data = out._data
+    return out
+
+
+def alltoall(out_tensor_list, in_tensor_list=None, group=None, sync_op=True):
+    """paddle signature: alltoall(out_tensor_list, in_tensor_list).
+
+    SPMD path: pass a single array; lax.all_to_all splits dim 0, concats dim 0.
+    Eager rank-major: in [n, n, *S] -> out[r][i] = in[i][r] (transpose of the
+    two leading rank dims).
+    """
+    g = _resolve_group(group)
+    if in_spmd_region(g.axis_name):
+        x = out_tensor_list if in_tensor_list is None else in_tensor_list
+        return apply_op(
+            "alltoall",
+            lambda v: lax.all_to_all(v, g.axis_name, split_axis=0, concat_axis=0, tiled=True),
+            x,
+        )
+    if in_tensor_list is None:
+        return apply_op("alltoall", lambda v: jnp.swapaxes(v, 0, 1), out_tensor_list)
+    stacked = jnp.stack([_unwrap(t) for t in in_tensor_list], axis=0)
+    swapped = jnp.swapaxes(stacked, 0, 1)
+    out_tensor_list.clear()
+    out_tensor_list.extend(Tensor(swapped[i]) for i in range(g.nranks))
+    return out_tensor_list
+
+
+all_to_all = alltoall  # paddle exposes both spellings
+
+
+def alltoall_single(out_tensor, in_tensor=None, in_split_sizes=None, out_split_sizes=None, group=None, sync_op=True):
+    g = _resolve_group(group)
+    x = out_tensor if in_tensor is None else in_tensor
+    if in_spmd_region(g.axis_name):
+        return apply_op(
+            "alltoall_single",
+            lambda v: lax.all_to_all(v, g.axis_name, split_axis=0, concat_axis=0, tiled=True),
+            x,
+        )
+    # eager rank-major [n, S0, ...]: S0 divides into n chunks
+    def fn(v):
+        n = g.nranks
+        chunked = v.reshape((n, n, -1) + v.shape[2:])
+        return jnp.swapaxes(chunked, 0, 1).reshape(v.shape)
+
+    return apply_op("alltoall_single", fn, x)
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    g = _resolve_group(group)
+    if in_spmd_region(g.axis_name):
+        raise RuntimeError(
+            "Inside SPMD regions use paddle_tpu.distributed.p2p_push "
+            "(lax.ppermute) — send/recv pairs are a two-controller idiom."
+        )
+    _pending_sends.setdefault((g.id, dst), []).append(tensor)
+    return None
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    g = _resolve_group(group)
+    pend = _pending_sends.get((g.id, recv_rank_of(g)), None)
+    if pend:
+        val = pend.pop(0)
+        if isinstance(tensor, Tensor):
+            tensor._data = _unwrap(val)
+        return tensor
+    return tensor
+
+
+def recv_rank_of(g):
+    return g.rank if g.rank >= 0 else 0
+
+
+_pending_sends: dict = {}
+
+
+def isend(tensor, dst=0, group=None):
+    send(tensor, dst, group)
+    return _DoneTask()
+
+
+def irecv(tensor, src=0, group=None):
+    recv(tensor, src, group)
+    return _DoneTask()
+
+
+class _DoneTask:
+    def wait(self):
+        return True
+
+    def is_completed(self):
+        return True
+
+
+class P2POp:
+    def __init__(self, op, tensor, peer, group=None):
+        self.op = op
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
+def batch_isend_irecv(p2p_op_list):
+    tasks = []
+    for op in p2p_op_list:
+        tasks.append(op.op(op.tensor, op.peer, op.group) or _DoneTask())
+    return tasks
+
+
+def p2p_push(x, perm, group=None):
+    """TPU-native pipeline edge: collective-permute over the group axis.
+
+    ``perm``: list of (src_rank, dst_rank) pairs. Usable only inside SPMD
+    regions (shard_map) — this is what the pipeline schedule uses for
+    send_forward/recv_forward (reference p2p_communication.py:313).
+    """
+    g = _resolve_group(group)
+    return apply_op("p2p_push", lambda v: lax.ppermute(v, g.axis_name, perm), x)
+
+
+def barrier(group=None):
+    g = _resolve_group(group)
+    # an all-reduce of a scalar IS the reference's barrier
+    # (process_group_nccl.cc:351)
+    t = Tensor(jnp.zeros((g.nranks,), jnp.float32))
+    all_reduce(t, group=g)
+    jax.block_until_ready(t._data)
+    return None
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    g = _resolve_group(group)
+    if gather_list is None:
+        gather_list = []
+    for i in range(g.nranks):
+        gather_list.append(apply_op("gather_slice", lambda v, i=i: v[i], tensor))
+    return gather_list
+
+
+# ---------------------------------------------------------------------------
+# rank-major helpers (the eager-emulation data model)
+# ---------------------------------------------------------------------------
+
+def stack_ranks(values, group=None) -> Tensor:
+    """Build a rank-major tensor [nranks, *S] from per-rank values, sharded so
+    rank r's slice lives on device r (the eager collective input format)."""
+    g = _resolve_group(group)
+    arr = jnp.stack([_unwrap(v) for v in values], axis=0)
+    arr = jax.device_put(arr, g.rank_sharding())
+    return Tensor(arr)
+
+
+def stack_ranks_like(tensor_list, group=None):
+    g = _resolve_group(group)
+    return jnp.stack([_unwrap(t) for t in tensor_list], axis=0)
+
+
+def rank_slice(t: Tensor, r: int) -> Tensor:
+    """Extract rank r's local value from a rank-major tensor."""
+    return apply_op("rank_slice", lambda v: v[r], t)
+
+
+# object helpers ------------------------------------------------------------
+
+def _object_to_tensor(obj):
+    data = pickle.dumps(obj)
+    return Tensor(jnp.frombuffer(data, dtype=jnp.uint8).copy()), len(data)
+
+
+def _tensor_to_object(t, size):
+    return pickle.loads(np.asarray(t._data)[:size].tobytes())

@@ -1,0 +1,171 @@
+"""Device mesh: the TPU-native replacement for ring-id comm groups.
+
+Reference parity: paddle's ProcessMesh (paddle/phi/core/distributed/auto_parallel/
+process_mesh.h:34, python/paddle/distributed/auto_parallel/process_mesh.py) and
+the CommunicateTopology cartesian rank system (fleet/base/topology.py:61).
+
+TPU-native design (SURVEY.md §5.8): groups are mesh axes; collectives are XLA
+HLO collectives emitted over those axes. A ``ProcessMesh`` here is a thin,
+paddle-shaped wrapper that lowers to ``jax.sharding.Mesh``.
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+
+def _all_devices():
+    return list(jax.devices())
+
+
+class ProcessMesh:
+    """An n-dimensional cartesian arrangement of devices with named axes.
+
+    paddle signature: ``ProcessMesh(mesh=[[0,1],[2,3]], dim_names=["dp","mp"])``
+    where entries are global device (process) ids.
+    """
+
+    def __init__(self, mesh, dim_names=None, process_ids=None):
+        if isinstance(mesh, ProcessMesh):
+            self._mesh = mesh._mesh.copy()
+            dim_names = dim_names or mesh._dim_names
+        else:
+            self._mesh = np.asarray(mesh, dtype=np.int64)
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(self._mesh.ndim)]
+        if len(dim_names) != self._mesh.ndim:
+            raise ValueError(
+                f"dim_names {dim_names} does not match mesh ndim {self._mesh.ndim}"
+            )
+        self._dim_names = list(dim_names)
+        self._jax_mesh = None
+        self._lock = threading.Lock()
+
+    # --- paddle surface ---
+    @property
+    def mesh(self):
+        return self._mesh
+
+    @property
+    def shape(self):
+        return list(self._mesh.shape)
+
+    @property
+    def ndim(self):
+        return self._mesh.ndim
+
+    @property
+    def dim_names(self):
+        return list(self._dim_names)
+
+    @property
+    def process_ids(self):
+        return [int(p) for p in self._mesh.flatten()]
+
+    @property
+    def size(self):
+        return int(self._mesh.size)
+
+    def get_dim_size(self, dim_name) -> int:
+        return int(self._mesh.shape[self._dim_names.index(dim_name)])
+
+    def get_rank_by_dim_and_process_id(self, dim_name, process_id) -> int:
+        axis = self._dim_names.index(dim_name)
+        where = np.argwhere(self._mesh == process_id)
+        if where.size == 0:
+            return -1
+        return int(where[0][axis])
+
+    def get_mesh_with_dim(self, dim_name, index=None):
+        """Reorder so ``dim_name`` is first; optionally index into it (submesh)."""
+        axis = self._dim_names.index(dim_name)
+        order = [axis] + [i for i in range(self.ndim) if i != axis]
+        new_mesh = self._mesh.transpose(order)
+        new_names = [self._dim_names[i] for i in order]
+        if index is not None:
+            return ProcessMesh(new_mesh[index], new_names[1:])
+        return ProcessMesh(new_mesh, new_names)
+
+    def __getitem__(self, index):
+        sub = self._mesh[index]
+        if np.isscalar(sub) or sub.ndim == 0:
+            sub = np.asarray([sub])
+            return ProcessMesh(sub, [self._dim_names[-1]])
+        # drop the indexed leading dims' names
+        dropped = self.ndim - sub.ndim
+        return ProcessMesh(sub, self._dim_names[dropped:])
+
+    def __eq__(self, other):
+        if not isinstance(other, ProcessMesh):
+            return False
+        return (
+            self._dim_names == other._dim_names
+            and np.array_equal(self._mesh, other._mesh)
+        )
+
+    def __ne__(self, other):
+        return not self.__eq__(other)
+
+    def __hash__(self):
+        return hash((tuple(self._dim_names), self._mesh.tobytes()))
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self.shape}, dim_names={self._dim_names})"
+
+    # --- jax lowering ---
+    def to_jax(self) -> Mesh:
+        with self._lock:
+            if self._jax_mesh is None:
+                devices = _all_devices()
+                n = len(devices)
+                dev_arr = np.empty(self._mesh.shape, dtype=object)
+                for idx, pid in np.ndenumerate(self._mesh):
+                    # Virtual ranks beyond the real device count wrap around —
+                    # lets mesh-shape parity code run on fewer physical chips.
+                    dev_arr[idx] = devices[int(pid) % n]
+                self._jax_mesh = Mesh(dev_arr, tuple(self._dim_names))
+        return self._jax_mesh
+
+
+_global_mesh: ProcessMesh | None = None
+
+
+def get_mesh() -> ProcessMesh | None:
+    return _global_mesh
+
+
+def set_mesh(mesh) -> None:
+    global _global_mesh
+    if mesh is not None and not isinstance(mesh, ProcessMesh):
+        mesh = ProcessMesh(mesh)
+    _global_mesh = mesh
+
+
+def auto_mesh(shape=None, dim_names=None) -> ProcessMesh:
+    """Build a mesh over all visible devices (1-D by default)."""
+    n = len(_all_devices())
+    if shape is None:
+        shape = [n]
+        dim_names = dim_names or ["x"]
+    return ProcessMesh(np.arange(int(np.prod(shape))).reshape(shape), dim_names)
+
+
+def in_spmd_region(axis_name: str | None = None) -> bool:
+    """True when tracing inside shard_map/pmap where ``axis_name`` is bound.
+
+    This is how the functional collectives pick between the compiled-SPMD path
+    (lax.psum & friends) and the eager global-view path.
+    """
+    try:
+        from jax._src.core import get_axis_env
+
+        env = get_axis_env()
+        if axis_name is None:
+            return bool(getattr(env, "axis_sizes", {}))
+        return env.axis_exists(axis_name)
+    except Exception:
+        return False
