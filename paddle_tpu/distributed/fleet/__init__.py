@@ -1,0 +1,56 @@
+"""paddle.distributed.fleet parity.
+
+Reference: python/paddle/distributed/fleet/__init__.py — the fleet singleton's
+methods are exposed at module level.
+"""
+from .distributed_strategy import DistributedStrategy
+from .fleet import (
+    barrier_worker,
+    collective_perf,
+    distributed_model,
+    distributed_optimizer,
+    distributed_scaler,
+    get_hybrid_communicate_group,
+    init,
+    is_first_worker,
+    is_initialized,
+    is_server,
+    is_worker,
+    server_endpoints,
+    stop_worker,
+    worker_endpoints,
+    worker_index,
+    worker_num,
+)
+from .topology import CommunicateTopology, HybridCommunicateGroup, ParallelMode
+from . import meta_parallel
+from . import meta_optimizers
+from . import utils
+from .utils import recompute
+
+__all__ = [
+    "DistributedStrategy",
+    "init",
+    "is_initialized",
+    "distributed_model",
+    "distributed_optimizer",
+    "distributed_scaler",
+    "get_hybrid_communicate_group",
+    "worker_index",
+    "worker_num",
+    "is_first_worker",
+    "is_worker",
+    "is_server",
+    "worker_endpoints",
+    "server_endpoints",
+    "barrier_worker",
+    "stop_worker",
+    "collective_perf",
+    "CommunicateTopology",
+    "HybridCommunicateGroup",
+    "ParallelMode",
+    "meta_parallel",
+    "meta_optimizers",
+    "utils",
+    "recompute",
+]

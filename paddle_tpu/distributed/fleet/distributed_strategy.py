@@ -1,0 +1,86 @@
+"""DistributedStrategy: the typed strategy/config tree.
+
+Reference: protobuf-backed DistributedStrategy (framework/
+distributed_strategy.proto:359, ~270 fields; HybridConfig :95) wrapped by
+fleet/base/distributed_strategy.py. The TPU build keeps one plain-python
+typed tree (SURVEY.md §5.6 "one typed config tree") with the same field
+names; env-var overrides are handled by the flags module.
+"""
+from __future__ import annotations
+
+import copy
+
+
+_HYBRID_DEFAULTS = {
+    "dp_degree": 1,
+    "mp_degree": 1,
+    "pp_degree": 1,
+    "sharding_degree": 1,
+    "sep_degree": 1,
+    "order": ["dp", "pp", "sharding", "sep", "mp"],
+}
+
+
+class DistributedStrategy:
+    def __init__(self):
+        # hybrid parallel
+        self.hybrid_configs = copy.deepcopy(_HYBRID_DEFAULTS)
+        self.hybrid_parallel_order = list(_HYBRID_DEFAULTS["order"])
+        # AMP
+        self.amp = False
+        self.amp_configs = {
+            "init_loss_scaling": 32768.0,
+            "use_pure_fp16": False,
+            "use_fp16_guard": False,
+            "custom_white_list": [],
+            "custom_black_list": [],
+        }
+        # recompute
+        self.recompute = False
+        self.recompute_configs = {"checkpoints": []}
+        # sharding
+        self.sharding = False
+        self.sharding_configs = {"sharding_degree": 1, "stage": 1, "offload": False}
+        # pipeline
+        self.pipeline = False
+        self.pipeline_configs = {
+            "accumulate_steps": 1,
+            "micro_batch_size": 1,
+            "schedule_mode": "1F1B",
+        }
+        # gradient merge
+        self.gradient_merge = False
+        self.gradient_merge_configs = {"k_steps": 1, "avg": True}
+        # misc meta-optimizer toggles (static fleet parity)
+        self.lamb = False
+        self.lars = False
+        self.dgc = False
+        self.localsgd = False
+        self.fp16_allreduce = False
+        self.find_unused_parameters = False
+        self.tensor_parallel = False
+        self.tensor_parallel_configs = {"tensor_parallel_degree": 1}
+        self.heter_ccl_mode = False
+        self.gradient_scale_configs = {"scale_strategy": "avg"}
+        self.without_graph_optimization = True
+
+    def __setattr__(self, key, value):
+        if key == "hybrid_configs" and hasattr(self, "hybrid_configs"):
+            # merge (paddle semantics: partial dict update)
+            merged = dict(self.__dict__["hybrid_configs"])
+            merged.update(value)
+            object.__setattr__(self, key, merged)
+            if "order" in value:
+                object.__setattr__(self, "hybrid_parallel_order", list(value["order"]))
+            return
+        object.__setattr__(self, key, value)
+
+    def to_dict(self):
+        return {k: copy.deepcopy(v) for k, v in self.__dict__.items()}
+
+    def __repr__(self):
+        lines = ["DistributedStrategy("]
+        for k, v in self.__dict__.items():
+            lines.append(f"  {k}={v!r},")
+        lines.append(")")
+        return "\n".join(lines)

@@ -1,0 +1,202 @@
+"""The fleet facade: init / distributed_model / distributed_optimizer.
+
+Reference: python/paddle/distributed/fleet/fleet.py — fleet.init (:167),
+_init_hybrid_parallel_env (:603; axis order ["dp","pp","sharding","sep","mp"]
+:631-654), plus fleet/model.py:32 (wrapper choice by degrees) and the
+worker/server info surface.
+
+TPU-native: init builds the CommunicateTopology/HybridCommunicateGroup over a
+ProcessMesh and publishes it as the global mesh; wrappers annotate shardings
+instead of spawning communicators.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..mesh import set_mesh
+from .distributed_strategy import DistributedStrategy
+from .topology import CommunicateTopology, HybridCommunicateGroup
+from .meta_parallel import (
+    PipelineParallel,
+    PipelineParallelWithInterleave,
+    SegmentParallel,
+    TensorParallel,
+    _set_hcg,
+)
+from .meta_parallel.pp_layers import PipelineLayer
+from .meta_optimizers import HybridParallelOptimizer
+
+
+_fleet_state = {
+    "initialized": False,
+    "strategy": None,
+    "hcg": None,
+}
+
+
+def init(role_maker=None, is_collective: bool = True, strategy: DistributedStrategy | None = None, log_level="INFO"):
+    if strategy is None:
+        strategy = DistributedStrategy()
+    hybrid = strategy.hybrid_configs
+    import jax
+
+    n = len(jax.devices())
+    degrees = {
+        "dp": int(hybrid.get("dp_degree", 1)),
+        "pp": int(hybrid.get("pp_degree", 1)),
+        "sharding": int(hybrid.get("sharding_degree", 1)),
+        "sep": int(hybrid.get("sep_degree", 1)),
+        "mp": int(hybrid.get("mp_degree", 1)),
+    }
+    specified = int(np.prod(list(degrees.values())))
+    if specified <= 1:
+        degrees["dp"] = n  # pure DP default (reference: dp fills the rest)
+    elif n % specified == 0 and n // specified > 1:
+        degrees["dp"] *= n // specified
+
+    order = list(strategy.hybrid_parallel_order)
+    name_of = {"dp": "data", "pp": "pipe", "sharding": "sharding", "sep": "sep", "mp": "model"}
+    topo = CommunicateTopology(
+        hybrid_group_names=[name_of[a] for a in order],
+        dims=[degrees[a] for a in order],
+    )
+    hcg = HybridCommunicateGroup(topo)
+    _set_hcg(hcg)
+    set_mesh(hcg.process_mesh)
+
+    _fleet_state.update(initialized=True, strategy=strategy, hcg=hcg)
+    from .. import init_parallel_env
+
+    init_parallel_env()
+    return None
+
+
+def is_initialized() -> bool:
+    return _fleet_state["initialized"]
+
+
+def get_hybrid_communicate_group() -> HybridCommunicateGroup:
+    return _fleet_state["hcg"]
+
+
+def _strategy() -> DistributedStrategy:
+    return _fleet_state["strategy"] or DistributedStrategy()
+
+
+def distributed_model(model):
+    """Pick the wrapper by parallel degrees (reference fleet/model.py:32)."""
+    hcg = _fleet_state["hcg"]
+    if hcg is None:
+        init()
+        hcg = _fleet_state["hcg"]
+    strategy = _strategy()
+    if hcg.get_pipe_parallel_world_size() > 1:
+        if isinstance(model, PipelineLayer):
+            if getattr(model, "_num_virtual", 1) > 1:
+                return PipelineParallelWithInterleave(model, hcg=hcg, strategy=strategy)
+            return PipelineParallel(model, hcg=hcg, strategy=strategy)
+        raise TypeError(
+            "pp_degree > 1 requires the model to be a PipelineLayer "
+            "(reference fleet/model.py raises the same)"
+        )
+    if hcg.get_sep_parallel_world_size() > 1:
+        return SegmentParallel(model, hcg=hcg)
+    if hcg.get_model_parallel_world_size() > 1:
+        return TensorParallel(model, hcg=hcg)
+    if hcg.get_data_parallel_world_size() > 1:
+        from ..parallel import DataParallel
+
+        return DataParallel(model, mesh=hcg.process_mesh, dp_axis="dp")
+    return model
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    hcg = _fleet_state["hcg"]
+    if hcg is None:
+        init(strategy=strategy)
+        hcg = _fleet_state["hcg"]
+    return HybridParallelOptimizer(optimizer, hcg=hcg, strategy=_strategy())
+
+
+def distributed_scaler(scaler):
+    from .meta_optimizers import HybridParallelGradScaler
+
+    return HybridParallelGradScaler(scaler, _fleet_state["hcg"])
+
+
+# --- worker/server info surface (reference fleet.py worker_* family) ---
+
+def worker_index() -> int:
+    from .. import get_rank
+
+    return get_rank()
+
+
+def worker_num() -> int:
+    from .. import get_world_size
+
+    return get_world_size()
+
+
+def is_first_worker() -> bool:
+    return worker_index() == 0
+
+def is_worker() -> bool:
+    return True
+
+
+def is_server() -> bool:
+    return False
+
+
+def worker_endpoints(to_string=False):
+    eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "127.0.0.1:0").split(",")
+    return ",".join(eps) if to_string else eps
+
+
+def server_endpoints(to_string=False):
+    return "" if to_string else []
+
+
+def barrier_worker():
+    from ..collective import barrier
+
+    barrier()
+
+
+def stop_worker():
+    return None
+
+
+# collective perf probe (reference fleet.py:367 collective_perf) ------------
+
+def collective_perf(comm_type: str = "allreduce", round: int = 50, size_and_time=None):
+    """Sweep a collective across message sizes, return {bytes: seconds}.
+
+    Reference fleet.py:367-603 sweeps 1MB→1GB with thresholds; this is the
+    measurement tool for BASELINE's collective table.
+    """
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..collective import ReduceOp, _init_default_group, all_reduce
+    from ...tensor.tensor import Tensor
+
+    g = _init_default_group()
+    results = {}
+    sizes = list(size_and_time or [2**20, 2**22, 2**24])
+    for size in sizes:
+        n_elem = size // 4
+        x = Tensor(jnp.ones((g.nranks, max(n_elem // g.nranks, 1)), jnp.float32))
+        all_reduce(x, group=g)  # warmup + compile
+        jax.block_until_ready(x._data)
+        t0 = time.perf_counter()
+        for _ in range(round):
+            all_reduce(x, group=g)
+        jax.block_until_ready(x._data)
+        results[size] = (time.perf_counter() - t0) / round
+    return results

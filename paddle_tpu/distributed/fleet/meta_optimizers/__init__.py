@@ -1,0 +1,15 @@
+"""fleet.meta_optimizers (dygraph subset — static meta-optimizers collapse
+into strategy-driven wrappers on TPU; SURVEY.md §2.7 meta-optimizer row)."""
+from .dygraph_optimizer import (
+    DygraphShardingOptimizer,
+    GroupShardedOptimizerStage2,
+    HybridParallelGradScaler,
+    HybridParallelOptimizer,
+)
+
+__all__ = [
+    "DygraphShardingOptimizer",
+    "GroupShardedOptimizerStage2",
+    "HybridParallelOptimizer",
+    "HybridParallelGradScaler",
+]

@@ -1,0 +1,17 @@
+from .dygraph_sharding_optimizer import (
+    DygraphShardingOptimizer,
+    GroupShardedOptimizerStage2,
+)
+from .hybrid_parallel_optimizer import (
+    HybridParallelClipGrad,
+    HybridParallelGradScaler,
+    HybridParallelOptimizer,
+)
+
+__all__ = [
+    "DygraphShardingOptimizer",
+    "GroupShardedOptimizerStage2",
+    "HybridParallelClipGrad",
+    "HybridParallelOptimizer",
+    "HybridParallelGradScaler",
+]

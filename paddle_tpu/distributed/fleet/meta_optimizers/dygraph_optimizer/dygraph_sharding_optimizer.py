@@ -1,0 +1,143 @@
+"""Sharded-optimizer stages (ZeRO) — TPU-native placement-based design.
+
+Reference: fleet/meta_optimizers/dygraph_optimizer/dygraph_sharding_optimizer.py:48
+(stage 1: partition optimizer states by param across the sharding group,
+reduce grads to owners, broadcast updated params) and
+fleet/meta_parallel/sharding/group_sharded_stage2.py:46 / _stage3.py:85.
+
+TPU-native: ZeRO stages are STORAGE PLACEMENTS of the same logical arrays —
+  stage 1 (os):    optimizer states sharded over the ``sharding`` axis
+  stage 2 (os_g):  + gradients sharded
+  stage 3 (p_g_os):+ parameters sharded (gathered on use by XLA = FSDP)
+The reference's reduce-to-owner / broadcast-back choreography is exactly what
+GSPMD emits from these placements (reduce-scatter into the sharded state
+update, all-gather on param use), fused into the step program.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def _sharding_mesh(hcg=None, group=None):
+    """The 1-D jax mesh of the sharding group."""
+    if hcg is not None:
+        g = hcg.get_sharding_parallel_group()
+        return g.to_jax_mesh(), g.axis_name
+    if group is not None:
+        return group.to_jax_mesh(), group.axis_name
+    from ....collective import _init_default_group
+
+    g = _init_default_group()
+    return g.to_jax_mesh(), g.axis_name
+
+
+def _shard_leading(arr, mesh, axis_name):
+    """Place an array sharded on dim 0 over the axis if divisible, else
+    replicated (small params stay replicated — the reference assigns whole
+    params to ranks; leading-dim sharding is the XLA-friendly equivalent)."""
+    n = mesh.shape[axis_name]
+    if arr.ndim >= 1 and arr.shape[0] % n == 0 and arr.shape[0] > 0:
+        spec = P(axis_name, *([None] * (arr.ndim - 1)))
+    else:
+        spec = P()
+    return jax.device_put(arr, NamedSharding(mesh, spec))
+
+
+class DygraphShardingOptimizer:
+    """Stage-1 wrapper: optimizer states live sharded; params/grads untouched.
+
+    Matches the reference class name/surface (:48). ``comm_overlap`` /
+    tensor-fusion options are accepted and ignored — XLA owns fusion/overlap.
+    """
+
+    def __init__(self, optimizer, hcg=None, group=None, **kwargs):
+        self._inner_opt = optimizer
+        self._mesh, self._axis = _sharding_mesh(hcg, group)
+        self._install_state_placement(optimizer)
+        self._param_shardings = {}
+
+    def _install_state_placement(self, optimizer):
+        orig_create = optimizer._create_accumulators
+        mesh, axis = self._mesh, self._axis
+
+        def create(p):
+            state = orig_create(p)
+            return {k: _shard_leading(v, mesh, axis) for k, v in state.items()}
+
+        optimizer._create_accumulators = create
+        # master weights are optimizer state too (ZeRO shards them)
+        orig_ensure = optimizer._ensure_state
+
+        def ensure(p):
+            st = orig_ensure(p)
+            mw = optimizer._master_weights.get(id(p))
+            if mw is not None and not _is_placed(mw, axis):
+                optimizer._master_weights[id(p)] = _shard_leading(mw, mesh, axis)
+            return st
+
+        optimizer._ensure_state = ensure
+
+    def _snapshot_param_placements(self):
+        for p in self._inner_opt._parameter_list:
+            self._param_shardings[id(p)] = getattr(p._data, "sharding", None)
+
+    def _restore_param_placements(self):
+        for p in self._inner_opt._parameter_list:
+            sh = self._param_shardings.get(id(p))
+            if sh is not None and getattr(p._data, "sharding", None) != sh:
+                p._data = jax.device_put(p._data, sh)
+
+    def _pre_step(self):
+        pass
+
+    def step(self):
+        self._snapshot_param_placements()
+        self._pre_step()
+        self._inner_opt.step()
+        # params keep their logical placement (reference: post-step broadcast
+        # of updated params back to all ranks)
+        self._restore_param_placements()
+
+    def reduce_gradients(self, parameter_list=None, hcg=None):
+        """Reference :276 — grads reduced to owning rank. Structural here."""
+        return None
+
+    def clear_grad(self, *a, **k):
+        self._inner_opt.clear_grad(*a, **k)
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, *a, **k):
+        loss.backward()
+        self.step()
+        return None, None
+
+    def state_dict(self):
+        return self._inner_opt.state_dict()
+
+    def set_state_dict(self, sd):
+        return self._inner_opt.set_state_dict(sd)
+
+    def __getattr__(self, name):
+        return getattr(self._inner_opt, name)
+
+
+class GroupShardedOptimizerStage2(DygraphShardingOptimizer):
+    """Stage-2: + gradients sharded before the update (reference
+    group_sharded_optimizer_stage2.py:53)."""
+
+    def _pre_step(self):
+        mesh, axis = self._mesh, self._axis
+        for p in self._inner_opt._parameter_list:
+            if p.grad is not None:
+                p.grad._data = _shard_leading(p.grad._data, mesh, axis)
+
+
+def _is_placed(arr, axis_name):
+    sh = getattr(arr, "sharding", None)
+    spec = getattr(sh, "spec", None)
+    return spec is not None and axis_name in jax.tree.leaves(tuple(spec))

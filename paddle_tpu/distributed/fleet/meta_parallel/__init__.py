@@ -1,0 +1,60 @@
+"""fleet.meta_parallel: hybrid-parallel model wrappers and layers.
+
+Reference: python/paddle/distributed/fleet/meta_parallel/ (SURVEY.md §2.7).
+"""
+from __future__ import annotations
+
+_HCG = None
+
+
+def _set_hcg(hcg):
+    global _HCG
+    _HCG = hcg
+
+
+def _get_hcg():
+    return _HCG
+
+
+from .mp_layers import (  # noqa: E402
+    ColumnParallelLinear,
+    ParallelCrossEntropy,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from .random import (  # noqa: E402
+    RNGStatesTracker,
+    get_rng_state_tracker,
+    model_parallel_random_seed,
+)
+from .pp_layers import LayerDesc, PipelineLayer, SegmentLayers, SharedLayerDesc  # noqa: E402
+from .pipeline_parallel import (  # noqa: E402
+    PipelineParallel,
+    PipelineParallelWithInterleave,
+    SegmentParallel,
+    TensorParallel,
+)
+from .moe_layer import MoELayer, top1_gating, top2_gating  # noqa: E402
+from .gspmd_pipeline import pipeline_spmd, shard_stacked_params, stack_stage_params  # noqa: E402
+
+__all__ = [
+    "ColumnParallelLinear",
+    "RowParallelLinear",
+    "VocabParallelEmbedding",
+    "ParallelCrossEntropy",
+    "RNGStatesTracker",
+    "get_rng_state_tracker",
+    "model_parallel_random_seed",
+    "LayerDesc",
+    "SharedLayerDesc",
+    "SegmentLayers",
+    "PipelineLayer",
+    "PipelineParallel",
+    "PipelineParallelWithInterleave",
+    "SegmentParallel",
+    "TensorParallel",
+    "MoELayer",
+    "pipeline_spmd",
+    "stack_stage_params",
+    "shard_stacked_params",
+]

@@ -1,0 +1,109 @@
+"""Compiled pipeline parallelism: stacked-stage scan over the pp mesh axis.
+
+This is the TPU-native answer to the reference's interceptor/1F1B machinery
+(fleet_executor + pipeline_parallel.py schedules — SURVEY.md §7.3 names this
+the riskiest novel design). The idiom (GSPMD pipelining, as used by praxis /
+the scaling-book recipe): make stages homogeneous, stack their weights on a
+leading dim sharded over the ``pp`` axis, and run a ``lax.scan`` whose step
+does one stage-compute and one ``lax.ppermute`` shift. Every device runs the
+same program (SPMD), XLA overlaps the permute with compute, and the bubble is
+the classic (S-1)/(M+S-1).
+
+``pipeline_spmd(stage_fn, stacked_params, microbatches, ...)`` is the raw
+functional engine; autograd-capable through the framework tape (it is one
+apply_op over a pure jax function).
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ....autograd.engine import apply_op
+
+
+def pipeline_spmd(
+    stage_fn,
+    stacked_params,
+    microbatches,
+    mesh,
+    pp_axis: str = "pp",
+):
+    """Run ``num_micro`` microbatches through ``num_stages`` pipeline stages.
+
+    Args:
+      stage_fn: pure fn ``(params_one_stage, x) -> y`` with y.shape == x.shape
+        (homogeneous stages — the transformer-decoder case).
+      stacked_params: pytree whose leaves have leading dim ``num_stages``,
+        (logically) sharded over ``pp_axis``.
+      microbatches: array ``[num_micro, mb, ...]`` (stage-0 inputs).
+      mesh: jax.sharding.Mesh containing ``pp_axis``.
+
+    Returns: array ``[num_micro, mb, ...]`` of last-stage outputs, replicated.
+    """
+    num_stages = mesh.shape[pp_axis]
+
+    def pure(params, mbs):
+        num_micro = mbs.shape[0]
+        total = num_micro + num_stages - 1
+
+        def per_device(p_local, mbs_local):
+            stage = lax.axis_index(pp_axis)
+            p_one = jax.tree.map(lambda a: a[0], p_local)
+            last = num_stages - 1
+
+            def step(carry, t):
+                acts = carry  # [mb, ...] activation arriving at this stage
+                # stage 0 ingests microbatch t (clipped; masked later)
+                x0 = mbs_local[jnp.clip(t, 0, num_micro - 1)]
+                x = jnp.where(stage == 0, x0, acts)
+                y = stage_fn(p_one, x)
+                # shift forward along the ring; stage s -> s+1
+                perm = [(i, (i + 1) % num_stages) for i in range(num_stages)]
+                y_shift = lax.ppermute(y, pp_axis, perm)
+                # collect: only last stage's y at valid times is output
+                valid = jnp.logical_and(t - last >= 0, t - last < num_micro)
+                out_t = jnp.where(
+                    jnp.logical_and(stage == last, valid), y, jnp.zeros_like(y)
+                )
+                # replicate the output across stages so out_specs can be P()
+                out_t = lax.psum(out_t, pp_axis)
+                return y_shift, out_t
+
+            init = jnp.zeros_like(mbs_local[0])
+            # the carry becomes device-varying after the ppermute; mark the
+            # initial value accordingly (jax>=0.8 varying-manual-axes check)
+            init = lax.pcast(init, (pp_axis,), to="varying")
+            _, outs = lax.scan(step, init, jnp.arange(total))
+            return outs  # [total, mb, ...] replicated
+
+        shard = jax.shard_map(
+            per_device,
+            mesh=mesh,
+            in_specs=(
+                jax.tree.map(lambda _: P(pp_axis), params),
+                P(),  # microbatches replicated (only stage 0 reads them)
+            ),
+            out_specs=P(),
+        )
+        outs = shard(params, mbs)
+        return outs[num_stages - 1 : num_stages - 1 + num_micro]
+
+    return apply_op("pipeline_spmd", pure, stacked_params, microbatches)
+
+
+def stack_stage_params(param_trees):
+    """Stack per-stage parameter pytrees into one leading-stage-dim tree."""
+    return jax.tree.map(lambda *leaves: jnp.stack(leaves, axis=0), *param_trees)
+
+
+def shard_stacked_params(stacked, mesh, pp_axis: str = "pp"):
+    """Place stacked params so stage s's slice lives on pp rank s."""
+    def place(a):
+        spec = P(pp_axis, *([None] * (a.ndim - 1)))
+        return jax.device_put(a, NamedSharding(mesh, spec))
+
+    return jax.tree.map(place, stacked)

@@ -1,0 +1,175 @@
+"""Mixture-of-Experts with expert parallelism.
+
+Reference: incubate/distributed/models/moe/moe_layer.py:263 (MoELayer),
+gates :119-190 (NaiveGate/SwitchGate/GShardGate), expert dispatch via
+global_scatter/global_gather all-to-all ops (fluid/operators/collective/
+global_scatter_op*, python moe_utils.py:20).
+
+TPU-native: experts are ONE stacked parameter tree with leading dim
+``num_experts`` sharded over the ``ep`` mesh axis. Token dispatch/combine are
+einsums against a capacity-bounded one-hot dispatch mask (the GShard
+formulation); with tokens sharded over dp/sep and experts over ep, XLA lowers
+the dispatch einsum to exactly the all-to-all the reference implements as
+global_scatter — but fused and overlapped over ICI.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ....autograd.engine import apply_op
+from ....nn import Layer
+from ...auto_parallel.api import shard_tensor
+from ...auto_parallel.placement import Replicate, Shard
+
+
+def _ep_mesh_and_axis(group=None):
+    from . import _get_hcg
+    from ...mesh import ProcessMesh, get_mesh
+
+    mesh = get_mesh()
+    if mesh is not None and "ep" in mesh.dim_names:
+        return mesh, mesh.dim_names.index("ep")
+    hcg = _get_hcg()
+    if hcg is not None and "mp" in hcg.process_mesh.dim_names:
+        m = hcg.process_mesh
+        return m, m.dim_names.index("mp")
+    import jax as _jax
+
+    n = len(_jax.devices())
+    return ProcessMesh(np.arange(n), ["ep"]), 0
+
+
+def _positions_in_expert(mask, offset=None):
+    """Per-token slot index within its chosen expert's capacity buffer.
+
+    ``mask`` is a one-hot-per-token [N, E] selection; returns [N] positions
+    (0-based order of arrival at that expert). ``offset`` [E] shifts the
+    numbering (used so top-2 slots come after all top-1 slots)."""
+    ranks = jnp.cumsum(mask, axis=0)
+    if offset is not None:
+        ranks = ranks + offset[None, :]
+    return (ranks * mask).sum(axis=-1) - 1.0
+
+
+def _combine_one(gate, mask, pos, capacity):
+    keep = (pos >= 0) & (pos < capacity)
+    mask = mask * keep[:, None].astype(mask.dtype)
+    slots = jnp.clip(pos, 0, capacity - 1).astype(jnp.int32)
+    oh = jax.nn.one_hot(slots, capacity) * keep[:, None]
+    return (gate * keep)[:, None, None] * mask[:, :, None] * oh[:, None, :]
+
+
+def top2_gating(logits, capacity):
+    """GShard top-2 gating (reference GShardGate): returns combine weights
+    [N, E, C], dispatch mask [N, E, C], and the load-balancing aux loss."""
+    n_tokens, n_experts = logits.shape
+    probs = jax.nn.softmax(logits, axis=-1)
+
+    mask1 = jax.nn.one_hot(jnp.argmax(probs, axis=-1), n_experts)
+    probs_wo1 = probs * (1.0 - mask1)
+    mask2 = jax.nn.one_hot(jnp.argmax(probs_wo1, axis=-1), n_experts)
+
+    # aux loss: fraction of tokens per expert x mean prob per expert
+    aux_loss = jnp.sum(mask1.mean(axis=0) * probs.mean(axis=0)) * n_experts
+
+    pos1 = _positions_in_expert(mask1)
+    pos2 = _positions_in_expert(mask2, offset=mask1.sum(axis=0))
+
+    g1 = (probs * mask1).sum(axis=-1)
+    g2 = (probs * mask2).sum(axis=-1)
+    denom = jnp.maximum(g1 + g2, 1e-9)
+    combine = _combine_one(g1 / denom, mask1, pos1, capacity) + _combine_one(
+        g2 / denom, mask2, pos2, capacity
+    )
+    dispatch = (combine > 0).astype(logits.dtype)
+    return combine, dispatch, aux_loss
+
+
+def top1_gating(logits, capacity):
+    """Switch-transformer gating (reference SwitchGate)."""
+    n_tokens, n_experts = logits.shape
+    probs = jax.nn.softmax(logits, axis=-1)
+    mask = jax.nn.one_hot(jnp.argmax(probs, axis=-1), n_experts)
+    aux_loss = jnp.sum(mask.mean(axis=0) * probs.mean(axis=0)) * n_experts
+    pos = _positions_in_expert(mask)
+    gate = (probs * mask).sum(axis=-1)
+    combine = _combine_one(gate, mask, pos, capacity)
+    dispatch = (combine > 0).astype(logits.dtype)
+    return combine, dispatch, aux_loss
+
+
+class MoELayer(Layer):
+    """Capacity-bounded MoE FFN block.
+
+    Args follow the reference MoELayer (:263): d_model, experts given as a
+    per-expert hidden size, gate config dict with type/top_k. Expert weights
+    are stacked [E, ...] and sharded over the ep axis.
+    """
+
+    def __init__(
+        self,
+        d_model: int,
+        d_hidden: int,
+        num_experts: int,
+        gate: str | dict = "gshard",
+        top_k: int = 2,
+        capacity_factor: float = 1.25,
+        group=None,
+        recompute_interval: int = 0,
+        name=None,
+    ):
+        super().__init__()
+        if isinstance(gate, dict):
+            top_k = gate.get("top_k", top_k)
+            gate = gate.get("type", "gshard")
+        self.gate_type = gate
+        self.top_k = 1 if gate == "switch" else top_k
+        self.num_experts = num_experts
+        self.capacity_factor = capacity_factor
+        mesh, axis = _ep_mesh_and_axis(group)
+        self._mesh, self._axis = mesh, axis
+
+        def ep_place(dim0_shard):
+            return [
+                Shard(0) if i == axis else Replicate() for i in range(mesh.ndim)
+            ] if dim0_shard else [Replicate()] * mesh.ndim
+
+        self.gate_weight = self.create_parameter([d_model, num_experts])
+        w1 = self.create_parameter([num_experts, d_model, d_hidden])
+        b1 = self.create_parameter([num_experts, d_hidden], is_bias=True)
+        w2 = self.create_parameter([num_experts, d_hidden, d_model])
+        b2 = self.create_parameter([num_experts, d_model], is_bias=True)
+        self.w1 = shard_tensor(w1, mesh, ep_place(True))
+        self.b1 = shard_tensor(b1, mesh, ep_place(True))
+        self.w2 = shard_tensor(w2, mesh, ep_place(True))
+        self.b2 = shard_tensor(b2, mesh, ep_place(True))
+        self.aux_loss = None
+
+    def forward(self, x):
+        gating = top1_gating if self.gate_type == "switch" else top2_gating
+        cap_factor = self.capacity_factor
+
+        def pure(xv, gate_w, w1, b1, w2, b2):
+            orig_shape = xv.shape
+            d = orig_shape[-1]
+            tokens = xv.reshape(-1, d)
+            n = tokens.shape[0]
+            capacity = max(int(cap_factor * n * 1.0 / w1.shape[0]) * (2 if gating is top2_gating else 1), 4)
+            logits = tokens @ gate_w
+            combine, dispatch, aux = gating(logits, capacity)
+            # dispatch: [N,E,C] x [N,d] -> [E,C,d]  (the "global_scatter")
+            expert_in = jnp.einsum("nec,nd->ecd", dispatch, tokens)
+            h = jax.nn.gelu(jnp.einsum("ecd,edh->ech", expert_in, w1) + b1[:, None, :])
+            expert_out = jnp.einsum("ech,ehd->ecd", h, w2) + b2[:, None, :]
+            # combine: [N,E,C] x [E,C,d] -> [N,d]  (the "global_gather")
+            out = jnp.einsum("nec,ecd->nd", combine, expert_out)
+            return out.reshape(orig_shape), aux
+
+        out, aux = apply_op(
+            "moe_layer", pure, x, self.gate_weight, self.w1, self.b1, self.w2, self.b2
+        )
+        self.aux_loss = aux
+        return out

@@ -1,0 +1,222 @@
+"""Megatron-style tensor-parallel layers.
+
+Reference: fleet/layers/mpu/mp_layers.py — VocabParallelEmbedding (:47),
+ColumnParallelLinear (:333), RowParallelLinear (:540), ParallelCrossEntropy
+(:741), and the comm primitives _c_identity/_c_concat/_c_split/_mp_allreduce
+(mpu/mp_ops.py:83-700).
+
+TPU-native design: a TP layer stores its weight as ONE logical (global) tensor
+sharded over the mp mesh axis (Shard(1) for column, Shard(0) for row). Forward
+is the plain dense math on the global view — XLA's GSPMD partitioner emits the
+identity/all-reduce/all-gather collectives the reference codes by hand in
+mp_ops.py, and fuses them with the matmuls. ``gather_output`` /
+``input_is_parallel`` map to output/input reshard annotations.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ....autograd.engine import apply_op
+from ....nn import Layer
+from ....nn import functional as F
+from ...auto_parallel.api import reshard, shard_tensor
+from ...auto_parallel.placement import Replicate, Shard
+from ..topology import HybridCommunicateGroup
+
+
+def _mp_mesh_and_axis(mp_group=None):
+    """The (mesh, axis-index) a TP layer shards over: the fleet mesh if fleet
+    is initialised, else a private 1-D mesh over the mp group ranks."""
+    from . import _get_hcg
+
+    hcg = _get_hcg()
+    if hcg is not None:
+        mesh = hcg.process_mesh
+        return mesh, mesh.dim_names.index("mp")
+    from ...mesh import ProcessMesh, get_mesh
+
+    mesh = get_mesh()
+    if mesh is not None and "mp" in mesh.dim_names:
+        return mesh, mesh.dim_names.index("mp")
+    import jax
+
+    n = len(jax.devices())
+    return ProcessMesh(np.arange(n), ["mp"]), 0
+
+
+def _placements(mesh, axis_index, shard_dim):
+    return [
+        Shard(shard_dim) if i == axis_index else Replicate()
+        for i in range(mesh.ndim)
+    ]
+
+
+class VocabParallelEmbedding(Layer):
+    """Embedding with the vocab dim sharded across mp ranks (mp_layers.py:47).
+
+    The reference masks out-of-range ids per rank and all-reduces the partial
+    lookups; here the sharded gather + reduction is emitted by XLA from the
+    Shard(0) annotation on the table.
+    """
+
+    def __init__(
+        self,
+        num_embeddings: int,
+        embedding_dim: int,
+        weight_attr=None,
+        mp_group=None,
+        name=None,
+    ):
+        super().__init__()
+        mesh, axis = _mp_mesh_and_axis(mp_group)
+        self._size = [num_embeddings, embedding_dim]
+        w = self.create_parameter(self._size, attr=weight_attr)
+        self.weight = shard_tensor(w, mesh, _placements(mesh, axis, 0))
+        self._mesh, self._axis = mesh, axis
+
+    def forward(self, x):
+        return F.embedding(x, self.weight)
+
+
+class ColumnParallelLinear(Layer):
+    """Linear with the OUTPUT dim sharded (mp_layers.py:333).
+
+    gather_output=True reshards the output to replicated (reference:
+    _c_concat); False leaves it mp-sharded for a following RowParallelLinear.
+    """
+
+    def __init__(
+        self,
+        in_features: int,
+        out_features: int,
+        weight_attr=None,
+        has_bias: bool = True,
+        gather_output: bool = True,
+        fuse_matmul_bias: bool = False,
+        mp_group=None,
+        name=None,
+    ):
+        super().__init__()
+        mesh, axis = _mp_mesh_and_axis(mp_group)
+        self._mesh, self._axis = mesh, axis
+        self.gather_output = gather_output
+        w = self.create_parameter([in_features, out_features], attr=weight_attr)
+        self.weight = shard_tensor(w, mesh, _placements(mesh, axis, 1))
+        if has_bias:
+            b = self.create_parameter([out_features], is_bias=True)
+            self.bias = shard_tensor(b, mesh, _placements(mesh, axis, 0))
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, self.bias)
+        if self.gather_output:
+            out = reshard(
+                out, self._mesh, [Replicate() for _ in range(self._mesh.ndim)]
+            )
+        return out
+
+
+class RowParallelLinear(Layer):
+    """Linear with the INPUT dim sharded (mp_layers.py:540).
+
+    input_is_parallel=True means the incoming activation is already sharded on
+    its last dim (the ColumnParallel→RowParallel sandwich); the partial matmul
+    results are summed — XLA emits that all-reduce from the contraction over a
+    sharded dim.
+    """
+
+    def __init__(
+        self,
+        in_features: int,
+        out_features: int,
+        weight_attr=None,
+        has_bias: bool = True,
+        input_is_parallel: bool = False,
+        fuse_matmul_bias: bool = False,
+        mp_group=None,
+        name=None,
+    ):
+        super().__init__()
+        mesh, axis = _mp_mesh_and_axis(mp_group)
+        self._mesh, self._axis = mesh, axis
+        self.input_is_parallel = input_is_parallel
+        w = self.create_parameter([in_features, out_features], attr=weight_attr)
+        self.weight = shard_tensor(w, mesh, _placements(mesh, axis, 0))
+        if has_bias:
+            # bias is applied once after the reduction -> replicated
+            b = self.create_parameter([out_features], is_bias=True)
+            self.bias = shard_tensor(
+                b, mesh, [Replicate() for _ in range(mesh.ndim)]
+            )
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        return F.linear(x, self.weight, self.bias)
+
+
+class ParallelCrossEntropy(Layer):
+    """Softmax cross-entropy over mp-sharded logits (mp_layers.py:741).
+
+    The reference computes per-rank partial logsumexp + label lookups and
+    all-reduces; with the class dim sharded, XLA derives the same comm from
+    the plain softmax_cross_entropy graph.
+    """
+
+    def __init__(self, mp_group=None, name=None, ignore_index: int = -100):
+        super().__init__()
+        self._ignore_index = ignore_index
+
+    def forward(self, input, label):
+        return F.cross_entropy(
+            input, label, reduction="none", ignore_index=self._ignore_index
+        )
+
+
+def _c_identity(tensor, group=None):
+    """Forward identity, backward all-reduce (mp_ops.py:83). With global-view
+    autograd both directions are identity at the framework level; XLA inserts
+    the grad reduction where shardings demand it."""
+    return tensor
+
+
+def _c_concat(tensor, group=None):
+    """Gather the mp-sharded last dim to replicated (mp_ops.py)."""
+    mesh, axis = _mp_mesh_and_axis(group)
+    return reshard(tensor, mesh, [Replicate() for _ in range(mesh.ndim)])
+
+
+def _c_split(tensor, group=None):
+    """Split the last dim across mp ranks (mp_ops.py)."""
+    mesh, axis = _mp_mesh_and_axis(group)
+    return reshard(tensor, mesh, _placements(mesh, axis, tensor.ndim - 1))
+
+
+def _mp_allreduce(tensor, group=None, use_calc_stream=True, use_model_parallel=True):
+    from ...collective import all_reduce
+
+    return all_reduce(tensor, group=group)
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True, weight_attr=None, bias_attr=None, inner_rank=None):
+    """paddle.distributed.split parity (mp_ops.py:700): build a parallel
+    embedding/linear layer directly."""
+    if operation == "embedding":
+        layer = VocabParallelEmbedding(size[0], size[1], weight_attr=weight_attr)
+        return layer(x)
+    if operation == "linear":
+        if axis == 0:
+            layer = RowParallelLinear(
+                size[0], size[1], weight_attr=weight_attr,
+                has_bias=bias_attr is not False, input_is_parallel=not gather_out,
+            )
+        else:
+            layer = ColumnParallelLinear(
+                size[0], size[1], weight_attr=weight_attr,
+                has_bias=bias_attr is not False, gather_output=gather_out,
+            )
+        return layer(x)
+    raise ValueError(f"unknown operation {operation!r}")

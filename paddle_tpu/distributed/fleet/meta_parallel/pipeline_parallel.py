@@ -1,0 +1,192 @@
+"""Pipeline-parallel runtime: micro-batched training over a PipelineLayer.
+
+Reference: fleet/meta_parallel/pipeline_parallel.py — PipelineParallel (:150),
+forward_backward_pipeline 1F1B (:440), train_batch (:657),
+PipelineParallelWithInterleave/VPP (:906), with P2P activation handshakes
+(pp_utils/p2p_communication.py:313).
+
+TPU-native mapping (SURVEY.md §7.3 "Pipeline parallelism on TPU"): the 1F1B /
+interleave schedules exist to bound activation memory and overlap stage
+compute with P2P transport on a multi-process GPU cluster. Under a
+single-controller XLA program the same two goals are met by (a) micro-batch
+accumulation — identical math to 1F1B: per-microbatch forward+backward with
+grad accumulation, activations of at most one microbatch segment live at a
+time — and (b) the compiled stacked-stage scan (gspmd_pipeline.py) whose
+collective-permute edges XLA overlaps with stage compute. train_batch here
+implements (a) with exact reference semantics (loss = mean over microbatches,
+scaler/optimizer integration); schedule_mode is accepted and recorded for
+parity but does not change the math — as in the reference, where FThenB/1F1B
+produce bit-identical results and differ only in memory/overlap.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ....nn import Layer
+from ....tensor.tensor import Tensor
+from .pp_layers import PipelineLayer
+
+
+class PipelineParallel(Layer):
+    def __init__(self, layers: PipelineLayer, hcg=None, strategy=None):
+        super().__init__()
+        if not isinstance(layers, PipelineLayer):
+            raise TypeError(
+                "PipelineParallel expects a PipelineLayer (reference "
+                "pipeline_parallel.py:150 asserts the same)"
+            )
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+        cfg = getattr(strategy, "pipeline_configs", {}) if strategy else {}
+        self.accumulate_steps = int(cfg.get("accumulate_steps", 1))
+        self.micro_batch_size = int(cfg.get("micro_batch_size", 1))
+        self.schedule_mode = cfg.get("schedule_mode", "1F1B")
+        self.num_stages = layers.get_num_stages()
+        self.stage_id = hcg.get_stage_id() if hcg else 0
+        self.total_loss = None
+
+    def is_pipeline_first_stage(self):
+        return self.stage_id == 0
+
+    def is_pipeline_last_stage(self):
+        return self.stage_id == self.num_stages - 1
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    # --- reference train_batch surface (pipeline_parallel.py:657) ---
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        self.train()
+        loss = self.forward_backward_pipeline(data, scaler)
+        optimizer.step()
+        optimizer.clear_grad()
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return loss
+
+    def eval_batch(self, data, compute_loss=True):
+        self.eval()
+        inputs, labels = self._load_micro_batches(data)
+        losses = []
+        for x, y in zip(inputs, labels):
+            out = self._layers(x)
+            losses.append(self._compute_loss(out, y))
+        return _mean_losses(losses)
+
+    def forward_backward_pipeline(self, data, scaler=None, static_scheduler=False):
+        """Micro-batched forward+backward with grad accumulation — the exact
+        math of the reference's 1F1B walk (forward_backward_pipeline :440)."""
+        inputs, labels = self._load_micro_batches(data)
+        n = len(inputs)
+        losses = []
+        for x, y in zip(inputs, labels):
+            out = self._layers(x)
+            loss = self._compute_loss(out, y)
+            losses.append(loss)
+            step_loss = loss * (1.0 / n)
+            if scaler is not None:
+                step_loss = scaler.scale(step_loss)
+            step_loss.backward()  # grads accumulate across micro-steps
+        self._layers.allreduce_shared_weight_gradients()
+        self.total_loss = _mean_losses(losses)
+        return self.total_loss
+
+    def _compute_loss(self, output, label):
+        loss_fn = self._layers._loss_fn
+        if loss_fn is not None:
+            return loss_fn(output, label) if label is not None else loss_fn(output)
+        if label is not None:
+            raise ValueError("PipelineLayer has no loss_fn but labels were given")
+        return output
+
+    def _load_micro_batches(self, data):
+        if isinstance(data, (tuple, list)) and len(data) == 2:
+            x, y = data
+        else:
+            x, y = data, None
+        n = self.accumulate_steps
+        return _split_micro(x, n), _split_micro(y, n)
+
+
+def _split_micro(t, n):
+    if t is None:
+        return [None] * n
+    if isinstance(t, (list, tuple)):
+        parts = [_split_micro(v, n) for v in t]
+        return [type(t)(p[i] for p in parts) for i in range(n)]
+    if not isinstance(t, Tensor):
+        t = Tensor(np.asarray(t))
+    if n == 1:
+        return [t]
+    if t.shape[0] % n != 0:
+        raise ValueError(
+            f"batch dim {t.shape[0]} not divisible by accumulate_steps {n}"
+        )
+    m = t.shape[0] // n
+    return [t[i * m : (i + 1) * m] for i in range(n)]
+
+
+def _mean_losses(losses):
+    total = losses[0]
+    for l in losses[1:]:
+        total = total + l
+    return total / float(len(losses))
+
+
+class PipelineParallelWithInterleave(PipelineParallel):
+    """VPP (reference :906): same math, finer-grained virtual stages. The
+    virtual-stage split matters for the compiled scan path's bubble fraction
+    (gspmd_pipeline circular schedule); train_batch math is unchanged."""
+
+    def __init__(self, layers, hcg=None, strategy=None):
+        super().__init__(layers, hcg, strategy)
+        self._virtual_pp_degree = getattr(layers, "_num_virtual", 1)
+
+
+class SegmentParallel(Layer):
+    """sep-axis wrapper (reference meta_parallel/segment_parallel.py:26):
+    broadcasts params over the sep group; grads sync over dp∪sep. Both are
+    structural under global-view autograd — the wrapper shards the sequence
+    dim of inputs over the sep axis."""
+
+    def __init__(self, layers, hcg=None, **kwargs):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+
+    def forward(self, *args, **kwargs):
+        from ...auto_parallel.api import shard_tensor
+        from ...auto_parallel.placement import Replicate, Shard
+
+        hcg = self._hcg
+        if hcg is not None and hcg.get_sep_parallel_world_size() > 1:
+            mesh = hcg.process_mesh
+            sep_idx = mesh.dim_names.index("sep")
+
+            def shard_seq(x):
+                if isinstance(x, Tensor) and x.ndim >= 2 and not x.is_dist:
+                    placements = [
+                        Shard(1) if i == sep_idx else Replicate()
+                        for i in range(mesh.ndim)
+                    ]
+                    return shard_tensor(x, mesh, placements, stop_gradient=x.stop_gradient)
+                return x
+
+            args = tuple(shard_seq(a) for a in args)
+            kwargs = {k: shard_seq(v) for k, v in kwargs.items()}
+        return self._layers(*args, **kwargs)
+
+
+class TensorParallel(Layer):
+    """TP wrapper (reference meta_parallel/tensor_parallel.py): broadcasts
+    inputs/params over the mp group — structural here; kept for
+    fleet.distributed_model parity."""
+
+    def __init__(self, layers, hcg=None, **kwargs):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)

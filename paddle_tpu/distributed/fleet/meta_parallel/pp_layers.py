@@ -1,0 +1,217 @@
+"""Pipeline stage partitioning: LayerDesc / SharedLayerDesc / PipelineLayer.
+
+Reference: fleet/meta_parallel/parallel_layers/pp_layers.py — LayerDesc,
+SharedLayerDesc (tied embeddings), SegmentLayers (uniform / by-layer
+partition, :56-237), PipelineLayer.
+
+TPU-native: the stage partition is a *logical* structure. Execution does not
+scatter stages across processes — the global-view program contains all
+stages, and the pipeline schedule (1F1B microbatching) is applied by
+PipelineParallel.train_batch; the compiled fast path additionally maps
+homogeneous stages onto the pp mesh axis via a stacked-weight shard_map scan
+(see gspmd_pipeline.py), which is how GSPMD expresses pipelining.
+"""
+from __future__ import annotations
+
+import math
+import re
+
+from ....nn import Layer
+
+
+class LayerDesc:
+    def __init__(self, layer_cls, *inputs, **kwargs):
+        self.layer_cls = layer_cls
+        self.inputs = inputs
+        self.kwargs = kwargs
+        if not issubclass(layer_cls, Layer):
+            raise TypeError(f"{layer_cls} must be a paddle_tpu.nn.Layer subclass")
+
+    def build_layer(self):
+        return self.layer_cls(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_cls.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    """A layer whose parameters are shared between stages (tied embeddings).
+
+    Reference pp_layers.py: shared_weight_attr names the tied parameter;
+    forward_func adapts the call on re-use sites.
+    """
+
+    def __init__(
+        self,
+        key,
+        layer_cls,
+        *inputs,
+        forward_func=None,
+        shared_weight_attr="weight",
+        **kwargs,
+    ):
+        super().__init__(layer_cls, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class SegmentLayers:
+    """Partition N layers into ``num_parts`` stages (reference :150)."""
+
+    def __init__(self, layers_desc, num_parts, method="uniform", num_virtual_pipeline_stage=None):
+        self._desc = layers_desc
+        self.num_items = len(layers_desc)
+        self.num_parts = num_parts
+        self.method = method
+        if self.num_items < self.num_parts:
+            raise ValueError("layer number should be greater than number of segments")
+
+    def do_segment(self):
+        if self.method == "uniform":
+            return self.uniform(self.num_items, self.num_parts)
+        if self.method.startswith("layer:"):
+            # segment so layers matching the name pattern are distributed evenly
+            pat = self.method.split(":", 1)[1]
+            weights = [0] * self.num_items
+            for i, d in enumerate(self._desc):
+                name = (
+                    d.layer_cls.__name__
+                    if isinstance(d, LayerDesc)
+                    else d.__class__.__name__
+                )
+                if re.search(pat, name):
+                    weights[i] = 1
+            total = sum(weights)
+            if total == 0:
+                return self.uniform(self.num_items, self.num_parts)
+            per = total / self.num_parts
+            result = [0]
+            acc = 0.0
+            target = per
+            for i, w in enumerate(weights):
+                acc += w
+                if acc >= target - 1e-9 and len(result) < self.num_parts:
+                    result.append(i + 1)
+                    target += per
+            while len(result) < self.num_parts + 1:
+                result.append(self.num_items)
+            result[-1] = self.num_items
+            return result
+        raise ValueError(f"unknown segment method {self.method!r}")
+
+    @staticmethod
+    def uniform(num_items, num_parts):
+        result = [0] * (num_parts + 1)
+        part_size = math.floor(num_items / num_parts)
+        extra = num_items % num_parts
+        for i in range(1, num_parts + 1):
+            result[i] = result[i - 1] + part_size + (1 if i <= extra else 0)
+        return result
+
+
+class PipelineLayer(Layer):
+    """A model expressed as a flat layer list + a stage partition.
+
+    Reference signature: PipelineLayer(layers=descs, num_stages=..,
+    topology=.., seg_method="uniform", loss_fn=..,
+    num_virtual_pipeline_stages=..).
+    """
+
+    def __init__(
+        self,
+        layers,
+        num_stages=None,
+        topology=None,
+        loss_fn=None,
+        seg_method="uniform",
+        recompute_interval=0,
+        num_virtual_pipeline_stages=None,
+        **kwargs,
+    ):
+        super().__init__()
+        self._layers_desc = list(layers)
+        self._loss_fn = loss_fn
+        self._topo = topology
+        self._recompute_interval = recompute_interval
+        if num_stages is None and topology is not None:
+            num_stages = topology.get_dim("pipe")
+        self._num_stages = num_stages or 1
+        self._num_virtual = num_virtual_pipeline_stages or 1
+
+        seg = SegmentLayers(
+            self._layers_desc, self._num_stages, method=seg_method
+        )
+        self.segment_parts = seg.do_segment()
+
+        # build ALL layers (global view — every device sees the whole program;
+        # stage locality is a sharding/schedule concern, not a construction one)
+        self._shared = {}
+        self.run_function = []
+        self._stage_of = []
+        for idx, d in enumerate(self._layers_desc):
+            stage = self._stage_for_index(idx)
+            if isinstance(d, SharedLayerDesc):
+                if d.layer_name not in self._shared:
+                    self._shared[d.layer_name] = (d.build_layer(), d)
+                base, _ = self._shared[d.layer_name]
+                fwd = d.forward_func
+                if fwd is not None:
+                    layer = _SharedCall(base, fwd, d.shared_weight_attr)
+                else:
+                    layer = base
+            elif isinstance(d, LayerDesc):
+                layer = d.build_layer()
+            elif isinstance(d, Layer):
+                layer = d
+            elif callable(d):
+                layer = d
+            else:
+                raise TypeError(f"unsupported layer desc {d!r}")
+            if isinstance(layer, Layer):
+                self.add_sublayer(str(idx), layer)
+            self.run_function.append(layer)
+            self._stage_of.append(stage)
+
+    def _stage_for_index(self, idx):
+        for s in range(self._num_stages):
+            if self.segment_parts[s] <= idx < self.segment_parts[s + 1]:
+                return s
+        return self._num_stages - 1
+
+    def get_num_stages(self):
+        return self._num_stages
+
+    def get_stage_layers(self, stage_id):
+        lo, hi = self.segment_parts[stage_id], self.segment_parts[stage_id + 1]
+        return self.run_function[lo:hi]
+
+    def allreduce_shared_weight_gradients(self):
+        """Tied-weight grad sync across stages: structural in global view."""
+        return None
+
+    def forward(self, input):
+        x = input
+        for i, fn in enumerate(self.run_function):
+            if (
+                self._recompute_interval > 0
+                and i % self._recompute_interval == 0
+                and not isinstance(x, tuple)
+            ):
+                from ..utils import recompute
+
+                x = recompute(fn, x)
+            else:
+                x = fn(*x) if isinstance(x, tuple) else fn(x)
+        return x
+
+
+class _SharedCall(Layer):
+    def __init__(self, base, forward_func, shared_weight_attr):
+        super().__init__()
+        self._base = base  # note: registered in parent already
+        self._fwd = forward_func
+        self._attr = shared_weight_attr
+
+    def forward(self, x):
+        return self._fwd(x, getattr(self._base, self._attr))

@@ -1,0 +1,90 @@
+"""Per-mesh-axis RNG state tracking.
+
+Reference: fleet/layers/mpu/random.py — RNGStatesTracker (:34) and
+model_parallel_random_seed (:103): dropout inside TP regions must use a
+DIFFERENT stream per mp rank (activations are sharded) while dropout outside
+must be IDENTICAL across mp ranks (activations replicated).
+
+TPU-native: in the single-controller global view there is one logical dropout
+mask per tensor — sharded tensors get sharded masks automatically, replicated
+tensors replicated masks — so cross-rank consistency is structural. The
+tracker therefore only has to provide *named, checkpointable streams* with
+paddle's API shape.
+"""
+from __future__ import annotations
+
+import contextlib
+
+from ....framework.random import Generator
+
+MODEL_PARALLEL_RNG = "model_parallel_rng"
+
+
+class RNGStatesTracker:
+    def __init__(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def reset(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def add(self, name, seed):
+        if seed in self.seeds_:
+            raise ValueError(f"seed {seed} already exists")
+        if name in self.states_:
+            raise ValueError(f"state {name} already exists")
+        self.seeds_.add(seed)
+        self.states_[name] = Generator(seed)
+
+    def get_states_tracker(self):
+        return {name: gen.get_state() for name, gen in self.states_.items()}
+
+    def set_states_tracker(self, states):
+        for name, state in states.items():
+            self.states_.setdefault(name, Generator(0)).set_state(state)
+
+    @contextlib.contextmanager
+    def rng_state(self, name=MODEL_PARALLEL_RNG):
+        if name not in self.states_:
+            raise ValueError(f"state {name} does not exist")
+        from ....framework import random as rmod
+
+        prev = rmod.default_generator
+        rmod.default_generator = self.states_[name]
+        try:
+            yield
+        finally:
+            rmod.default_generator = prev
+
+
+_RNG_STATE_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    return _RNG_STATE_TRACKER
+
+
+def model_parallel_random_seed(seed: int = 0):
+    """Reference random.py:103: seed the global stream identically everywhere
+    and the model-parallel stream distinctly. Single-controller: one process,
+    so both are plain named streams; distinctness across ranks is structural
+    (masks follow tensor shardings)."""
+    import paddle_tpu
+
+    global_seed = seed
+    local_seed = seed + 1024
+    _RNG_STATE_TRACKER.reset()
+    _RNG_STATE_TRACKER.add(MODEL_PARALLEL_RNG, local_seed)
+    paddle_tpu.seed(global_seed)
+
+
+def determinate_seed(rng_name):
+    gen = _RNG_STATE_TRACKER.states_.get(rng_name)
+    return gen.initial_seed() if gen else 0
+
+
+@contextlib.contextmanager
+def get_rng_state(name=MODEL_PARALLEL_RNG):
+    with _RNG_STATE_TRACKER.rng_state(name):
+        yield

@@ -1,0 +1,235 @@
+"""Hybrid-parallel topology: cartesian rank coordinates over named axes.
+
+Reference: python/paddle/distributed/fleet/base/topology.py —
+CommunicateTopology (:61) and HybridCommunicateGroup (:174) with axes
+["dp","pp","sharding","sep","mp"] and fused groups (dp∪sep :242, "check"
+groups for global-norm clip).
+
+TPU-native: the topology IS a ProcessMesh; every axis group is a mesh axis.
+Groups returned here are `collective.Group` objects bound to that axis name,
+so collectives on them ride ICI via XLA (SURVEY.md §5.8).
+"""
+from __future__ import annotations
+
+import itertools
+
+import numpy as np
+
+from ..collective import Group, new_group
+from ..mesh import ProcessMesh
+
+
+class ParallelMode:
+    """Reference: topology.py:33."""
+
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+    SEGMENT_PARALLEL = 4
+
+
+class CommunicateTopology:
+    def __init__(
+        self,
+        hybrid_group_names=("data", "pipe", "sharding", "sep", "model"),
+        dims=(1, 1, 1, 1, 1),
+    ):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(int(d) for d in dims)
+        self._world_size = int(np.prod(self._dims))
+        self._coord_map = {}  # coord tuple -> rank
+        self._rank_map = {}  # rank -> coord tuple
+        ranges = [range(d) for d in self._dims]
+        for rank, coord in enumerate(itertools.product(*ranges)):
+            self._coord_map[coord] = rank
+            self._rank_map[rank] = coord
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self):
+        return self._world_size
+
+    def get_rank(self, **kwargs):
+        coord = tuple(kwargs[name] for name in self._parallel_names)
+        return self._coord_map[coord]
+
+    def get_coord(self, rank):
+        return self._rank_map[rank]
+
+    def get_axis_list(self, axis_name, index):
+        """All ranks whose coordinate on ``axis_name`` equals ``index``."""
+        axis = self._parallel_names.index(axis_name)
+        return sorted(r for r, c in self._rank_map.items() if c[axis] == index)
+
+    def get_comm_list(self, axis_name):
+        """Partition ranks into groups that vary only along ``axis_name``."""
+        axis = self._parallel_names.index(axis_name)
+        other = [i for i in range(len(self._dims)) if i != axis]
+        groups = {}
+        for rank, coord in sorted(self._rank_map.items()):
+            key = tuple(coord[i] for i in other)
+            groups.setdefault(key, []).append(rank)
+        return [sorted(v) for _, v in sorted(groups.items())]
+
+    def get_rank_from_stage(self, global_rank, **kwargs):
+        coord = list(self._rank_map[global_rank])
+        for k, v in kwargs.items():
+            coord[self._parallel_names.index(k)] = v
+        return self._coord_map[tuple(coord)]
+
+
+class HybridCommunicateGroup:
+    """Axis groups + the ProcessMesh they live on.
+
+    The paddle axis order is ["dp","pp","sharding","sep","mp"] (fleet.py:631);
+    groups for the current rank are created for each axis plus the fused
+    dp∪sep group (topology.py:242) and "check" groups.
+    """
+
+    def __init__(self, topology: CommunicateTopology):
+        self._topo = topology
+        names = topology.get_hybrid_group_names()
+        self._dp_degree = topology.get_dim("data")
+        self._mp_degree = topology.get_dim("model")
+        self._pp_degree = topology.get_dim("pipe")
+        self._sharding_degree = topology.get_dim("sharding")
+        self._sep_degree = topology.get_dim("sep") if "sep" in names else 1
+        self.global_rank = _current_rank()
+        self.nranks = topology.world_size()
+
+        # the mesh: axes in topology order, only the full cartesian product
+        dims = [topology.get_dim(n) for n in names]
+        axis_alias = {"data": "dp", "pipe": "pp", "model": "mp"}
+        mesh_names = [axis_alias.get(n, n) for n in names]
+        self._mesh = ProcessMesh(
+            np.arange(int(np.prod(dims))).reshape(dims), mesh_names
+        )
+
+        self._groups = {}
+        for name, alias in zip(names, mesh_names):
+            comm_list = topology.get_comm_list(name)
+            my = next(
+                (g for g in comm_list if self.global_rank in g), comm_list[0]
+            )
+            self._groups[alias] = new_group(my, axis_name=alias)
+
+        # fused dp∪sep group (grad sync domain, topology.py:242-244)
+        if self._sep_degree > 1:
+            dp_sep = sorted(
+                set(self._groups["dp"].ranks) | set(self._groups["sep"].ranks)
+            )
+            self._dp_sep_group = new_group(dp_sep, axis_name="dp_sep")
+        else:
+            self._dp_sep_group = self._groups["dp"]
+
+        # "check" group: everything but pp — used by hybrid grad clip
+        self._check_group = self._groups["dp"]
+
+    # --- mesh / degrees ---
+    @property
+    def process_mesh(self) -> ProcessMesh:
+        return self._mesh
+
+    def topology(self):
+        return self._topo
+
+    def get_parallel_mode(self):
+        if self._pp_degree > 1:
+            return ParallelMode.PIPELINE_PARALLEL
+        if self._sharding_degree > 1:
+            return ParallelMode.SHARDING_PARALLEL
+        if self._mp_degree > 1:
+            return ParallelMode.TENSOR_PARALLEL
+        if self._sep_degree > 1:
+            return ParallelMode.SEGMENT_PARALLEL
+        return ParallelMode.DATA_PARALLEL
+
+    # --- per-axis accessors (reference get_*_parallel_* surface) ---
+    def get_data_parallel_world_size(self):
+        return self._dp_degree
+
+    def get_model_parallel_world_size(self):
+        return self._mp_degree
+
+    def get_pipe_parallel_world_size(self):
+        return self._pp_degree
+
+    def get_sharding_parallel_world_size(self):
+        return self._sharding_degree
+
+    def get_sep_parallel_world_size(self):
+        return self._sep_degree
+
+    def get_data_parallel_rank(self):
+        return self._axis_rank("data")
+
+    def get_model_parallel_rank(self):
+        return self._axis_rank("model")
+
+    def get_stage_id(self):
+        return self._axis_rank("pipe")
+
+    def get_sharding_parallel_rank(self):
+        return self._axis_rank("sharding")
+
+    def get_sep_parallel_rank(self):
+        return self._axis_rank("sep")
+
+    def _axis_rank(self, name):
+        coord = self._topo.get_coord(self.global_rank)
+        return coord[self._topo.get_hybrid_group_names().index(name)]
+
+    def get_data_parallel_group(self) -> Group:
+        return self._groups["dp"]
+
+    def get_model_parallel_group(self) -> Group:
+        return self._groups["mp"]
+
+    def get_pipe_parallel_group(self) -> Group:
+        return self._groups["pp"]
+
+    def get_sharding_parallel_group(self) -> Group:
+        return self._groups["sharding"]
+
+    def get_sep_parallel_group(self) -> Group:
+        return self._groups.get("sep", self._groups["dp"])
+
+    def get_dp_sep_parallel_group(self) -> Group:
+        return self._dp_sep_group
+
+    def get_check_parallel_group(self, *a) -> Group:
+        return self._check_group
+
+    def get_data_parallel_group_src_rank(self):
+        return self._groups["dp"].ranks[0]
+
+    def get_model_parallel_group_src_rank(self):
+        return self._groups["mp"].ranks[0]
+
+    # pipeline neighbours (p2p_communication parity)
+    def is_first_stage(self):
+        return self.get_stage_id() == 0
+
+    def is_last_stage(self):
+        return self.get_stage_id() == self._pp_degree - 1
+
+    def get_p2p_groups(self):
+        return None
+
+    def get_rank_from_stage(self, stage_id, **kwargs):
+        return self._topo.get_rank_from_stage(
+            self.global_rank, pipe=stage_id, **kwargs
+        )
+
+
+def _current_rank() -> int:
+    from .. import get_rank
+
+    return get_rank()

@@ -1,0 +1,61 @@
+"""fleet.utils: recompute, hybrid-parallel helpers, sequence parallel.
+
+Reference: python/paddle/distributed/fleet/utils/__init__.py (recompute),
+hybrid_parallel_util.py (fused_allreduce_gradients :241).
+"""
+from __future__ import annotations
+
+from . import sequence_parallel_utils  # noqa: F401
+
+
+def recompute(function, *args, **kwargs):
+    """Activation rematerialisation (reference fleet/utils recompute →
+    fleet/recompute/recompute.py). TPU-native: ``jax.checkpoint`` on the pure
+    function — backward recomputes the segment instead of storing residuals,
+    the HBM-for-FLOPs trade the reference implements with a custom PyLayer.
+    """
+    import jax
+
+    from ....autograd.engine import apply_op
+    from ....nn import Layer
+    from ....tensor.tensor import Tensor
+
+    kwargs.pop("use_reentrant", None)
+    kwargs.pop("preserve_rng_state", None)
+
+    # The layer's parameters must be EXPLICIT inputs of the checkpointed pure
+    # function (closure captures would be constants — no grads would flow).
+    params = (
+        [p for p in function.parameters() if not p.stop_gradient]
+        if isinstance(function, Layer)
+        else []
+    )
+
+    def raw_fn(param_datas, *raw_args, **raw_kwargs):
+        def rewrap(x):
+            return Tensor(x, stop_gradient=False) if hasattr(x, "dtype") else x
+
+        olds = [p._data for p in params]
+        for p, d in zip(params, param_datas):
+            p._data = d
+        try:
+            a = [rewrap(x) for x in raw_args]
+            kw = {k: rewrap(v) for k, v in raw_kwargs.items()}
+            out = function(*a, **kw)
+        finally:
+            for p, o in zip(params, olds):
+                p._data = o
+        return jax.tree.map(
+            lambda t: t._data if isinstance(t, Tensor) else t,
+            out,
+            is_leaf=lambda t: isinstance(t, Tensor),
+        )
+
+    return apply_op("recompute", jax.checkpoint(raw_fn), params, *args, **kwargs)
+
+
+def fused_allreduce_gradients(parameter_list, hcg):
+    """Reference hybrid_parallel_util.py:241: allreduce dp(∪sep) grads at step
+    end. Structural on TPU (vjp over replicated params yields reduced grads);
+    kept for API parity."""
+    return None

@@ -1,0 +1,160 @@
+"""Megatron sequence parallelism utilities.
+
+Reference: fleet/utils/sequence_parallel_utils.py — ScatterOp/GatherOp/
+AllGatherOp/ReduceScatterOp PyLayers (:85-127), ColumnSequenceParallelLinear
+(:230), RowSequenceParallelLinear (:340), mark_as_sequence_parallel_parameter
+(:148,:192).
+
+TPU-native: "sequence parallel" = the activation's sequence dim is sharded
+over the mp axis between TP regions. The four PyLayers are reshard
+annotations; XLA emits the all-gather (fwd of AllGatherOp / bwd of
+ReduceScatterOp) and reduce-scatter pairs, fusing them with the adjacent
+matmuls — the comm/compute overlap the reference builds by hand.
+"""
+from __future__ import annotations
+
+from ....nn import Layer
+from ....nn import functional as F
+from ...auto_parallel.api import reshard
+from ...auto_parallel.placement import Replicate, Shard
+from ..meta_parallel.mp_layers import _mp_mesh_and_axis, _placements
+
+
+def _seq_dim(x):
+    # activations are [s, b, h] in the reference's SP convention
+    return 0
+
+
+def scatter(x, group=None):
+    """Split the sequence dim across mp ranks (ScatterOp fwd)."""
+    mesh, axis = _mp_mesh_and_axis(group)
+    return reshard(x, mesh, _placements(mesh, axis, _seq_dim(x)))
+
+
+def all_gather(x, group=None):
+    """Gather the sequence dim from mp ranks (AllGatherOp fwd)."""
+    mesh, _ = _mp_mesh_and_axis(group)
+    return reshard(x, mesh, [Replicate() for _ in range(mesh.ndim)])
+
+
+def reduce_scatter(x, group=None):
+    """Sum partials and split the sequence dim (ReduceScatterOp fwd)."""
+    mesh, axis = _mp_mesh_and_axis(group)
+    return reshard(x, mesh, _placements(mesh, axis, _seq_dim(x)))
+
+
+class ScatterOp:
+    @staticmethod
+    def apply(x, group=None):
+        return scatter(x, group)
+
+
+class GatherOp:
+    @staticmethod
+    def apply(x, group=None):
+        return all_gather(x, group)
+
+
+class AllGatherOp:
+    @staticmethod
+    def apply(x, group=None):
+        return all_gather(x, group)
+
+
+class ReduceScatterOp:
+    @staticmethod
+    def apply(x, group=None):
+        return reduce_scatter(x, group)
+
+
+def mark_as_sequence_parallel_parameter(parameter):
+    """Reference :148: tags LN/bias params living in the SP region so their
+    grads get all-reduced over mp. Global-view autograd already produces the
+    reduced grad; keep the tag for API parity and checkpoint tooling."""
+    parameter.sequence_parallel = True
+
+
+def is_sequence_parallel_parameter(parameter):
+    return getattr(parameter, "sequence_parallel", False)
+
+
+def register_sequence_parallel_allreduce_hooks(model, accumulation_steps=1, fuse_sequence_parallel_allreduce=False):
+    """No-op on TPU (grad reduction is structural); kept for API parity."""
+    return None
+
+
+class ColumnSequenceParallelLinear(Layer):
+    """SP variant of ColumnParallelLinear (:230): input arrives
+    sequence-sharded, is all-gathered for the matmul, output leaves
+    mp-sharded on the feature dim."""
+
+    def __init__(
+        self,
+        in_features,
+        out_features,
+        weight_attr=None,
+        has_bias=True,
+        gather_output=False,
+        fuse_matmul_bias=False,
+        mp_group=None,
+        name=None,
+    ):
+        super().__init__()
+        from ...auto_parallel.api import shard_tensor
+
+        mesh, axis = _mp_mesh_and_axis(mp_group)
+        self._mesh, self._axis = mesh, axis
+        self.gather_output = gather_output
+        w = self.create_parameter([in_features, out_features], attr=weight_attr)
+        self.weight = shard_tensor(w, mesh, _placements(mesh, axis, 1))
+        if has_bias:
+            b = self.create_parameter([out_features], is_bias=True)
+            self.bias = shard_tensor(b, mesh, _placements(mesh, axis, 0))
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        # gather sequence shards (fwd allgather / bwd reduce-scatter)
+        x = all_gather(x)
+        out = F.linear(x, self.weight, self.bias)
+        if self.gather_output:
+            out = reshard(out, self._mesh, [Replicate()] * self._mesh.ndim)
+        return out
+
+
+class RowSequenceParallelLinear(Layer):
+    """SP variant of RowParallelLinear (:340): input is feature-sharded, the
+    reduced output is scattered over the sequence dim (reduce-scatter)."""
+
+    def __init__(
+        self,
+        in_features,
+        out_features,
+        weight_attr=None,
+        has_bias=True,
+        input_is_parallel=True,
+        fuse_matmul_bias=False,
+        mp_group=None,
+        name=None,
+    ):
+        super().__init__()
+        from ...auto_parallel.api import shard_tensor
+
+        mesh, axis = _mp_mesh_and_axis(mp_group)
+        self._mesh, self._axis = mesh, axis
+        w = self.create_parameter([in_features, out_features], attr=weight_attr)
+        self.weight = shard_tensor(w, mesh, _placements(mesh, axis, 0))
+        if has_bias:
+            b = self.create_parameter([out_features], is_bias=True)
+            self.bias = shard_tensor(b, mesh, [Replicate()] * mesh.ndim)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, self.bias)
+        # reduce partials + scatter sequence dim in one annotation
+        return reduce_scatter(out)
+
+
+def create_fused_allreduce_gradient_hooks(*a, **k):
+    return None

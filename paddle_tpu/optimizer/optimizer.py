@@ -19,6 +19,31 @@ from ..tensor.tensor import Tensor
 from .lr import LRScheduler
 
 
+def _co_place(tree):
+    """Promote single-device leaves to mesh-replicated when any leaf lives on
+    a multi-device mesh (ZeRO-sharded states force this: jit refuses to mix
+    single-device and mesh-committed arguments)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    leaves = [l for l in jax.tree.leaves(tree) if hasattr(l, "sharding")]
+    target = None
+    for l in leaves:
+        sh = l.sharding
+        if isinstance(sh, NamedSharding) and len(sh.mesh.devices.flatten()) > 1:
+            target = NamedSharding(sh.mesh, PartitionSpec())
+            break
+    if target is None:
+        return tree
+    ndev = len(target.mesh.devices.flatten())
+
+    def put(l):
+        if hasattr(l, "sharding") and len(getattr(l, "devices", lambda: [0])()) < ndev:
+            return jax.device_put(l, target)
+        return l
+
+    return jax.tree.map(put, tree)
+
+
 class Optimizer:
     # subclasses list their accumulator names, e.g. ("moment1", "moment2")
     _accumulator_names: tuple = ()
@@ -154,9 +179,10 @@ class Optimizer:
         masters = [self._master_weights.get(id(p)) for p in params]
         wds = [jnp.asarray(self._param_decay_coeff(p), jnp.float32) for p in params]
         lr_scales = [jnp.asarray(self._param_lr_scale(p), jnp.float32) for p in params]
-        new_params, new_states, new_masters = self._jit_update(
-            lr, [p._data for p in params], grads, states, masters, wds, lr_scales
+        args = _co_place(
+            (lr, [p._data for p in params], grads, states, masters, wds, lr_scales)
         )
+        new_params, new_states, new_masters = self._jit_update(*args)
         for p, np_, st, mw in zip(params, new_params, new_states, new_masters):
             p._data = np_
             self._accumulators[id(p)] = st
