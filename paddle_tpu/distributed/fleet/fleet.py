@@ -53,8 +53,13 @@ def init(role_maker=None, is_collective: bool = True, strategy: DistributedStrat
     specified = int(np.prod(list(degrees.values())))
     if specified <= 1:
         degrees["dp"] = n  # pure DP default (reference: dp fills the rest)
-    elif n % specified == 0 and n // specified > 1:
+    elif n % specified == 0:
         degrees["dp"] *= n // specified
+    else:
+        raise ValueError(
+            f"hybrid parallel degrees {degrees} multiply to {specified}, "
+            f"which does not divide the device count {n}"
+        )
 
     order = list(strategy.hybrid_parallel_order)
     name_of = {"dp": "data", "pp": "pipe", "sharding": "sharding", "sep": "sep", "mp": "model"}

@@ -47,13 +47,19 @@ class HybridParallelOptimizer:
         if self._sharding_enabled:
             stage = 1
             if strategy is not None:
-                stage = int(
-                    getattr(strategy, "hybrid_configs", {}).get(
-                        "sharding_configs", {}
-                    ).get("stage", 1)
-                    if isinstance(getattr(strategy, "hybrid_configs", {}), dict)
-                    else 1
-                )
+                # stage lives in strategy.sharding_configs (reference:
+                # DistributedStrategy.sharding_configs proto field); a value
+                # nested under hybrid_configs (config-dict users) wins.
+                cfg = {}
+                sc = getattr(strategy, "sharding_configs", None)
+                if isinstance(sc, dict):
+                    cfg.update(sc)
+                hybrid = getattr(strategy, "hybrid_configs", {}) or {}
+                if isinstance(hybrid, dict) and isinstance(
+                    hybrid.get("sharding_configs"), dict
+                ):
+                    cfg.update(hybrid["sharding_configs"])
+                stage = int(cfg.get("stage", 1))
             cls = GroupShardedOptimizerStage2 if stage >= 2 else DygraphShardingOptimizer
             self._inner_opt = cls(optimizer, hcg=hcg)
         clip = getattr(optimizer, "_grad_clip", None)

@@ -29,6 +29,13 @@ def _ep_mesh_and_axis(group=None):
     from . import _get_hcg
     from ...mesh import ProcessMesh, get_mesh
 
+    if group is not None:
+        hcg_ = _get_hcg()
+        ambient = hcg_.process_mesh if hcg_ is not None else get_mesh()
+        ax = getattr(group, "axis_name", None)
+        if ambient is not None and ax in (ambient.dim_names or []):
+            return ambient, ambient.dim_names.index(ax)
+        return ProcessMesh(np.asarray(group.ranks), ["ep"]), 0
     mesh = get_mesh()
     if mesh is not None and "ep" in mesh.dim_names:
         return mesh, mesh.dim_names.index("ep")

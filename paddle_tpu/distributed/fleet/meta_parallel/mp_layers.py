@@ -27,19 +27,25 @@ from ..topology import HybridCommunicateGroup
 
 
 def _mp_mesh_and_axis(mp_group=None):
-    """The (mesh, axis-index) a TP layer shards over: the fleet mesh if fleet
-    is initialised, else a private 1-D mesh over the mp group ranks."""
+    """The (mesh, axis-index) a TP layer shards over: the explicit group if
+    given, else the fleet mesh, else a private 1-D mesh over all devices."""
+    from ...mesh import ProcessMesh, get_mesh
     from . import _get_hcg
 
     hcg = _get_hcg()
-    if hcg is not None:
-        mesh = hcg.process_mesh
-        return mesh, mesh.dim_names.index("mp")
-    from ...mesh import ProcessMesh, get_mesh
-
-    mesh = get_mesh()
-    if mesh is not None and "mp" in mesh.dim_names:
-        return mesh, mesh.dim_names.index("mp")
+    ambient = hcg.process_mesh if hcg is not None else get_mesh()
+    if mp_group is not None:
+        # An explicit group overrides the ambient topology (reference: every
+        # mp layer takes mp_group and falls back to the HCG's group). If the
+        # group is an axis of the ambient mesh, shard over that axis of the
+        # FULL mesh so dp/pp replication is preserved; a foreign group gets a
+        # private 1-D mesh over its ranks.
+        ax = getattr(mp_group, "axis_name", None)
+        if ambient is not None and ax in (ambient.dim_names or []):
+            return ambient, ambient.dim_names.index(ax)
+        return ProcessMesh(np.asarray(mp_group.ranks), ["mp"]), 0
+    if ambient is not None and "mp" in ambient.dim_names:
+        return ambient, ambient.dim_names.index("mp")
     import jax
 
     n = len(jax.devices())

@@ -59,7 +59,13 @@ class PipelineParallel(Layer):
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
         self.train()
         loss = self.forward_backward_pipeline(data, scaler)
-        optimizer.step()
+        if scaler is not None:
+            # unscale + inf-skip + dynamic-scale update (reference train_batch
+            # delegates to HybridParallelGradScaler)
+            scaler.step(optimizer)
+            scaler.update()
+        else:
+            optimizer.step()
         optimizer.clear_grad()
         if lr_scheduler is not None:
             lr_scheduler.step()

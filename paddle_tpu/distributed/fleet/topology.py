@@ -70,8 +70,14 @@ class CommunicateTopology:
 
     def get_comm_list(self, axis_name):
         """Partition ranks into groups that vary only along ``axis_name``."""
-        axis = self._parallel_names.index(axis_name)
-        other = [i for i in range(len(self._dims)) if i != axis]
+        return self.get_fused_comm_list([axis_name])
+
+    def get_fused_comm_list(self, axis_names):
+        """Partition ranks into groups varying only along ``axis_names`` — the
+        cartesian block spanned by those axes (reference: fused dp-sep group
+        topology.py:242, 'check' groups over all non-pp axes)."""
+        axes = [self._parallel_names.index(a) for a in axis_names]
+        other = [i for i in range(len(self._dims)) if i not in axes]
         groups = {}
         for rank, coord in sorted(self._rank_map.items()):
             key = tuple(coord[i] for i in other)
@@ -120,17 +126,20 @@ class HybridCommunicateGroup:
             )
             self._groups[alias] = new_group(my, axis_name=alias)
 
-        # fused dp∪sep group (grad sync domain, topology.py:242-244)
+        # fused dp×sep group (grad sync domain, topology.py:242-244): the
+        # cartesian block spanned by both axes, not the set union.
         if self._sep_degree > 1:
-            dp_sep = sorted(
-                set(self._groups["dp"].ranks) | set(self._groups["sep"].ranks)
-            )
-            self._dp_sep_group = new_group(dp_sep, axis_name="dp_sep")
+            fused = topology.get_fused_comm_list(["data", "sep"])
+            my = next(g for g in fused if self.global_rank in g)
+            self._dp_sep_group = new_group(my, axis_name="dp_sep")
         else:
             self._dp_sep_group = self._groups["dp"]
 
         # "check" group: everything but pp — used by hybrid grad clip
-        self._check_group = self._groups["dp"]
+        non_pp = [n for n in names if n != "pipe"]
+        check_list = topology.get_fused_comm_list(non_pp)
+        my_check = next(g for g in check_list if self.global_rank in g)
+        self._check_group = new_group(my_check, axis_name="check")
 
     # --- mesh / degrees ---
     @property

@@ -1,0 +1,39 @@
+"""paddle_tpu.jit — dynamic-to-static compilation.
+
+Parity target: paddle.jit (reference: python/paddle/jit/api.py:171 to_static,
+dy2static/program_translator.py:325 StaticFunction concrete-program cache,
+dy2static/partial_program.py:151 PartialProgramLayer, jit/sot/translate.py:31
+bytecode JIT with guards).
+
+TPU-native design (SURVEY.md §7.2 L4): tracing IS the static converter. Every
+framework op is a pure jax function, so running the python callable on jax
+tracers yields the whole program; ``jax.jit``'s (shape, dtype) cache keys ARE
+the SOT guards (guard.py parity: re-trace on spec change); XLA is CINN. The
+compiled subgraph participates in autograd as ONE tape node (PartialProgramLayer
+parity: run_program_ad_func, fluid/eager/to_static/run_program_op_func.h:136).
+"""
+from .api import (
+    InputSpec,
+    StaticFunction,
+    TranslatedLayer,
+    enable_to_static,
+    functional_call,
+    ignore_module,
+    load,
+    not_to_static,
+    save,
+    to_static,
+)
+
+__all__ = [
+    "InputSpec",
+    "StaticFunction",
+    "TranslatedLayer",
+    "enable_to_static",
+    "functional_call",
+    "ignore_module",
+    "load",
+    "not_to_static",
+    "save",
+    "to_static",
+]
