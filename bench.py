@@ -1,0 +1,108 @@
+"""Benchmark: flagship GPT training throughput on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Methodology: the full fused train step (forward + backward + momentum-SGD
+update, bf16 weights / fp32 loss) compiled once; K steps chained in a single
+device dispatch via ``lax.scan`` so host<->device round-trips (the axon tunnel
+adds ~70ms RTT per dispatch) don't pollute the measurement; one device->host
+sync at the end. tokens/sec = K * batch * seq / elapsed. The reference
+publishes no absolute numbers (BASELINE.md), so vs_baseline reports measured
+MFU vs chip peak — the honest utilization signal.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import sys
+
+    if "--cpu" in sys.argv:
+        # sitecustomize force-sets jax_platforms="axon,cpu"; config overrides it
+        import jax as _j
+
+        _j.config.update("jax_platforms", "cpu")
+    import paddle_tpu  # noqa: F401  framework config (x64, matmul precision)
+    import jax
+
+    # Benchmark path: 32-bit index types (x64 costs ~25% on this step)
+    jax.config.update("jax_enable_x64", False)
+    import jax.numpy as jnp
+    from jax import lax
+
+    from paddle_tpu.models import gpt_spmd
+    from paddle_tpu.models.gpt import GPTConfig
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+
+    cfg = GPTConfig(
+        vocab_size=50304, hidden_size=768, num_layers=12, num_heads=12,
+        max_seq_len=1024,
+    )  # gpt3-125m
+    batch, seq = (8, 1024) if on_tpu else (2, 128)
+    K = 20 if on_tpu else 2
+    lr, momentum, num_micro = 1e-4, 0.9, 1
+
+    mesh = gpt_spmd.make_mesh(1)
+    dtype = jnp.bfloat16 if on_tpu else jnp.float32
+    params = gpt_spmd.init_params(cfg, mesh, dtype=dtype)
+    mom = jax.tree.map(jnp.zeros_like, params)
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)), jnp.int32)
+    labels = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)), jnp.int32)
+
+    def one_step(p, m, ids_, labels_):
+        loss, grads = jax.value_and_grad(gpt_spmd.loss_fn)(
+            p, ids_, labels_, cfg, mesh, num_micro
+        )
+        m2 = jax.tree.map(lambda a, g: momentum * a + g.astype(a.dtype), m, grads)
+        p2 = jax.tree.map(lambda a, b: a - lr * b, p, m2)
+        return p2, m2, loss
+
+    def many(params, mom, ids, labels):
+        def body(carry, _):
+            p, m = carry
+            p, m, loss = one_step(p, m, ids, labels)
+            return (p, m), loss
+
+        (params, mom), losses = lax.scan(body, (params, mom), None, length=K)
+        return params, mom, losses
+
+    with jax.set_mesh(mesh):
+        many_jit = jax.jit(many, donate_argnums=(0, 1))
+        params, mom, losses = many_jit(params, mom, ids, labels)  # compile+warmup
+        first_losses = np.asarray(losses)  # sync
+        t0 = time.perf_counter()
+        params, mom, losses = many_jit(params, mom, ids, labels)
+        _ = np.asarray(losses)  # sync
+        elapsed = time.perf_counter() - t0
+
+    tokens = K * batch * seq
+    tps = tokens / elapsed
+
+    n_params = cfg.num_params()
+    l, h, s = cfg.num_layers, cfg.hidden_size, seq
+    flops_per_token = 6 * n_params + 6 * l * h * s  # matmuls + causal attention
+    peak = 459e12 if on_tpu else 1e12  # v5p bf16 peak
+    mfu = tps * flops_per_token / peak
+
+    assert np.all(np.isfinite(first_losses)), "non-finite training loss"
+    print(
+        json.dumps(
+            {
+                "metric": f"gpt3-125m fused train step tokens/sec/chip (bs{batch} seq{seq}, {platform})",
+                "value": round(tps, 1),
+                "unit": "tokens/s",
+                "vs_baseline": round(mfu, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

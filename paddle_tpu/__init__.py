@@ -83,6 +83,7 @@ def __getattr__(name):
         "static",
         "distributed",
         "metric",
+        "models",
         "device",
         "vision",
         "distribution",

@@ -1,0 +1,27 @@
+"""paddle_tpu.models — flagship model families.
+
+The reference ships its model zoo outside the framework repo (PaddleNLP /
+PaddleClas); the in-repo parity points are the fleet hybrid-parallel test
+models (test/collective/fleet/hybrid_parallel_*_model.py) and test/book.
+These built-in families are the benchmark/flagship configurations named in
+BASELINE.md (GPT-3 sizes, ResNet for config 1, BERT for config 2).
+"""
+from .gpt import (
+    GPT_CONFIGS,
+    GPTConfig,
+    GPTDecoderLayer,
+    GPTEmbeddings,
+    GPTForCausalLM,
+    GPTModel,
+    GPTPretrainingCriterion,
+)
+
+__all__ = [
+    "GPT_CONFIGS",
+    "GPTConfig",
+    "GPTDecoderLayer",
+    "GPTEmbeddings",
+    "GPTForCausalLM",
+    "GPTModel",
+    "GPTPretrainingCriterion",
+]

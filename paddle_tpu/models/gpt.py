@@ -1,0 +1,297 @@
+"""GPT model family — the flagship benchmark model.
+
+Architecture parity: the reference's fleet GPT test models
+(test/collective/fleet/hybrid_parallel_pp_transformer.py,
+hybrid_parallel_mp_model.py) and the GPT-3 paper sizes named in BASELINE.md.
+Pre-LN decoder blocks, learned positional embeddings, GELU MLP (4x), causal
+self-attention through ``F.scaled_dot_product_attention`` (flash-attention
+Pallas kernel on TPU when available).
+
+Tensor parallelism: with ``mp_degree > 1`` (or fleet initialised), qkv/out and
+mlp projections become Column/RowParallelLinear and the token embedding
+VocabParallelEmbedding — the Megatron layout (reference: fleet/layers/mpu/
+mp_layers.py:47,:333,:540) where GSPMD emits the collectives.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..framework.param_attr import ParamAttr
+from ..nn import Layer, functional as F
+from ..nn.initializer import Normal
+from ..nn.layer.common import Dropout, Embedding, Linear
+from ..nn.layer.container import LayerList
+from ..nn.layer.norm import LayerNorm
+from ..tensor.creation import arange
+from ..tensor.manipulation import concat, reshape
+from ..tensor.math import matmul
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    max_seq_len: int = 1024
+    intermediate_size: int | None = None  # default 4*hidden
+    hidden_dropout: float = 0.0
+    attn_dropout: float = 0.0
+    layer_norm_eps: float = 1e-5
+    initializer_range: float = 0.02
+    tie_word_embeddings: bool = True
+    use_flash_attention: bool = True
+    # parallel knobs
+    tensor_parallel: bool = False  # force TP layers even without fleet
+
+    @property
+    def ffn_size(self) -> int:
+        return self.intermediate_size or 4 * self.hidden_size
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    def num_params(self) -> int:
+        h, v, l = self.hidden_size, self.vocab_size, self.num_layers
+        per_layer = 4 * h * h + 4 * h + 2 * h * self.ffn_size + h + self.ffn_size + 4 * h
+        emb = v * h + self.max_seq_len * h
+        return emb + l * per_layer + 2 * h
+
+
+# GPT-3 paper table 2.1 sizes (the BASELINE.md benchmark ladder).
+GPT_CONFIGS: dict[str, GPTConfig] = {
+    "gpt3-tiny": GPTConfig(vocab_size=1024, hidden_size=128, num_layers=2, num_heads=4, max_seq_len=128),
+    "gpt3-125m": GPTConfig(hidden_size=768, num_layers=12, num_heads=12),
+    "gpt3-350m": GPTConfig(hidden_size=1024, num_layers=24, num_heads=16),
+    "gpt3-760m": GPTConfig(hidden_size=1536, num_layers=24, num_heads=16),
+    "gpt3-1.3b": GPTConfig(hidden_size=2048, num_layers=24, num_heads=32, max_seq_len=2048),
+    "gpt3-2.7b": GPTConfig(hidden_size=2560, num_layers=32, num_heads=32, max_seq_len=2048),
+    "gpt3-6.7b": GPTConfig(hidden_size=4096, num_layers=32, num_heads=32, max_seq_len=2048),
+    "gpt3-13b": GPTConfig(hidden_size=5120, num_layers=40, num_heads=40, max_seq_len=2048),
+}
+
+
+def _w(config: GPTConfig) -> ParamAttr:
+    """GPT init: N(0, initializer_range) on all weight matrices (the paper's
+    scheme; the reference test models use Normal(std=0.02) likewise)."""
+    return ParamAttr(initializer=Normal(mean=0.0, std=config.initializer_range))
+
+
+def _tp_enabled(config: GPTConfig) -> bool:
+    if config.tensor_parallel:
+        return True
+    from ..distributed.fleet.meta_parallel import _get_hcg
+
+    hcg = _get_hcg()
+    return hcg is not None and hcg.get_model_parallel_world_size() > 1
+
+
+class GPTEmbeddings(Layer):
+    """Token + learned position embeddings with dropout."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        if _tp_enabled(config):
+            from ..distributed.fleet.meta_parallel.mp_layers import VocabParallelEmbedding
+
+            self.word_embeddings = VocabParallelEmbedding(
+                config.vocab_size, config.hidden_size, weight_attr=_w(config)
+            )
+        else:
+            self.word_embeddings = Embedding(
+                config.vocab_size, config.hidden_size, weight_attr=_w(config)
+            )
+        self.position_embeddings = Embedding(
+            config.max_seq_len, config.hidden_size, weight_attr=_w(config)
+        )
+        self.dropout = Dropout(config.hidden_dropout)
+
+    def forward(self, input_ids, position_ids=None, past_len: int = 0):
+        if position_ids is None:
+            seq_len = input_ids.shape[-1]
+            position_ids = arange(past_len, past_len + seq_len, dtype="int64")
+        return self.dropout(
+            self.word_embeddings(input_ids)
+            + self.position_embeddings(position_ids)
+        )
+
+
+class GPTAttention(Layer):
+    """Causal multi-head self-attention (fused qkv projection)."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        h = config.hidden_size
+        if _tp_enabled(config):
+            from ..distributed.fleet.meta_parallel.mp_layers import (
+                ColumnParallelLinear,
+                RowParallelLinear,
+            )
+
+            self.qkv_proj = ColumnParallelLinear(
+                h, 3 * h, weight_attr=_w(config), gather_output=False
+            )
+            self.out_proj = RowParallelLinear(
+                h, h, weight_attr=_w(config), input_is_parallel=True
+            )
+        else:
+            self.qkv_proj = Linear(h, 3 * h, weight_attr=_w(config))
+            self.out_proj = Linear(h, h, weight_attr=_w(config))
+        self.attn_dropout = config.attn_dropout
+        self.resid_dropout = Dropout(config.hidden_dropout)
+
+    def forward(self, x, attn_mask=None, cache=None):
+        cfg = self.config
+        b, s = x.shape[0], x.shape[1]
+        qkv = self.qkv_proj(x)  # [b, s, 3h]
+        qkv = reshape(qkv, [b, s, 3, cfg.num_heads, cfg.head_dim])
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [b, s, nh, hd]
+        new_cache = None
+        past_len = 0
+        if cache is not None:
+            k_past, v_past = cache
+            if k_past is not None:
+                past_len = k_past.shape[1]
+                k = concat([k_past, k], axis=1)
+                v = concat([v_past, v], axis=1)
+            new_cache = (k, v)
+        # causal handles the cached-prefix case too: _sdpa_ref offsets the
+        # tril by (k_len - q_len), i.e. query t attends keys <= past_len + t.
+        causal = attn_mask is None and s > 1
+        out = F.scaled_dot_product_attention(
+            q, k, v,
+            attn_mask=attn_mask,
+            is_causal=causal,
+            dropout_p=self.attn_dropout if self.training else 0.0,
+        )  # [b, s, nh, hd]
+        out = reshape(out, [b, s, cfg.num_heads * cfg.head_dim])
+        out = self.resid_dropout(self.out_proj(out))
+        if cache is not None:
+            return out, new_cache
+        return out
+
+
+class GPTMLP(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        h, f = config.hidden_size, config.ffn_size
+        if _tp_enabled(config):
+            from ..distributed.fleet.meta_parallel.mp_layers import (
+                ColumnParallelLinear,
+                RowParallelLinear,
+            )
+
+            self.fc1 = ColumnParallelLinear(
+                h, f, weight_attr=_w(config), gather_output=False
+            )
+            self.fc2 = RowParallelLinear(
+                f, h, weight_attr=_w(config), input_is_parallel=True
+            )
+        else:
+            self.fc1 = Linear(h, f, weight_attr=_w(config))
+            self.fc2 = Linear(f, h, weight_attr=_w(config))
+        self.dropout = Dropout(config.hidden_dropout)
+
+    def forward(self, x):
+        return self.dropout(self.fc2(F.gelu(self.fc1(x), approximate=True)))
+
+
+class GPTDecoderLayer(Layer):
+    """Pre-LN transformer decoder block."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.ln_1 = LayerNorm(config.hidden_size, epsilon=config.layer_norm_eps)
+        self.attn = GPTAttention(config)
+        self.ln_2 = LayerNorm(config.hidden_size, epsilon=config.layer_norm_eps)
+        self.mlp = GPTMLP(config)
+
+    def forward(self, x, attn_mask=None, cache=None):
+        if cache is not None:
+            a, new_cache = self.attn(self.ln_1(x), attn_mask=attn_mask, cache=cache)
+            x = x + a
+            x = x + self.mlp(self.ln_2(x))
+            return x, new_cache
+        x = x + self.attn(self.ln_1(x), attn_mask=attn_mask)
+        x = x + self.mlp(self.ln_2(x))
+        return x
+
+
+class GPTModel(Layer):
+    """Embeddings + decoder stack + final LN."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.embeddings = GPTEmbeddings(config)
+        self.layers = LayerList([GPTDecoderLayer(config) for _ in range(config.num_layers)])
+        self.ln_f = LayerNorm(config.hidden_size, epsilon=config.layer_norm_eps)
+
+    def forward(self, input_ids, position_ids=None, attn_mask=None, caches=None):
+        past_len = 0
+        if caches is not None and caches[0][0] is not None:
+            past_len = caches[0][0].shape[1]
+        x = self.embeddings(input_ids, position_ids, past_len=past_len)
+        new_caches = [] if caches is not None else None
+        for i, layer in enumerate(self.layers):
+            if caches is not None:
+                x, c = layer(x, attn_mask=attn_mask, cache=caches[i])
+                new_caches.append(c)
+            else:
+                x = layer(x, attn_mask=attn_mask)
+        x = self.ln_f(x)
+        if caches is not None:
+            return x, new_caches
+        return x
+
+
+class GPTPretrainingCriterion(Layer):
+    """Shifted next-token cross-entropy (mean over tokens)."""
+
+    def forward(self, logits, labels):
+        # logits [b, s, v], labels [b, s]
+        loss = F.cross_entropy(
+            reshape(logits, [-1, logits.shape[-1]]),
+            reshape(labels, [-1]),
+            reduction="mean",
+        )
+        return loss
+
+
+class GPTForCausalLM(Layer):
+    """GPTModel + LM head (weight-tied by default) + optional loss."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.gpt = GPTModel(config)
+        if not config.tie_word_embeddings:
+            self.lm_head = Linear(
+                config.hidden_size, config.vocab_size,
+                weight_attr=_w(config), bias_attr=False,
+            )
+        self.criterion = GPTPretrainingCriterion()
+
+    def _logits(self, hidden):
+        if self.config.tie_word_embeddings:
+            w = self.gpt.embeddings.word_embeddings.weight  # [v, h]
+            return matmul(hidden, w, transpose_y=True)
+        return self.lm_head(hidden)
+
+    def forward(self, input_ids, labels=None, position_ids=None, attn_mask=None, caches=None):
+        if caches is not None:
+            hidden, new_caches = self.gpt(
+                input_ids, position_ids=position_ids, attn_mask=attn_mask, caches=caches
+            )
+            return self._logits(hidden), new_caches
+        hidden = self.gpt(input_ids, position_ids=position_ids, attn_mask=attn_mask)
+        logits = self._logits(hidden)
+        if labels is None:
+            return logits
+        # standard LM shift: predict token t+1 from prefix ..t
+        shift_logits = logits[:, :-1, :]
+        shift_labels = labels[:, 1:]
+        return self.criterion(shift_logits, shift_labels)

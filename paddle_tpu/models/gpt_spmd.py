@@ -1,0 +1,351 @@
+"""GPT SPMD training step: dp × pp × mp (+sequence-parallel) over one mesh.
+
+This is the compiled hybrid-parallel path — the TPU-native equivalent of the
+reference's fleet hybrid engine (SURVEY.md §3.3: CommunicateTopology +
+PipelineParallel 1F1B + Megatron TP + sequence parallel), expressed the XLA
+way:
+
+- **dp**: batch dim sharded over ``dp``; gradient all-reduce emitted by GSPMD
+  (params replicated over dp).
+- **mp (TP)**: Megatron column/row sharding on qkv/mlp weights + vocab-sharded
+  embedding (reference mp_layers.py:47,:333,:540); collectives emitted by
+  GSPMD from the weight shardings + activation constraints.
+- **sp**: between attention/mlp regions activations are sharded over ``mp`` on
+  the *sequence* dim (reference sequence_parallel_utils.py) via sharding
+  constraints — GSPMD turns the row-linear all-reduce into
+  reduce-scatter + all-gather exactly like the reference's SP layers.
+- **pp**: stacked-stage GSPMD pipelining (stage weights stacked on a leading
+  dim sharded over ``pp``): a partial-manual ``shard_map`` (manual over pp
+  only) runs the microbatch ring with ``lax.ppermute`` — the 1F1B-equivalent
+  schedule with bubble (S-1)/(M+S-1).
+
+Everything is a pure function over a params pytree -> works under jit, grad,
+and donation; the single entry is :func:`build_spmd_train_step`.
+"""
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .gpt import GPTConfig
+
+
+def choose_mesh_shape(n_devices: int) -> dict[str, int]:
+    """Factor n into (dp, pp, mp) — pp and mp first (they need >=2 to be
+    exercised), dp absorbs the rest."""
+    n = n_devices
+    mp = 2 if n % 2 == 0 else 1
+    pp = 2 if (n // mp) % 2 == 0 else 1
+    dp = n // (mp * pp)
+    return {"dp": dp, "pp": pp, "mp": mp}
+
+
+def make_mesh(n_devices: int | None = None) -> Mesh:
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    shape = choose_mesh_shape(n)
+    arr = np.array(devs[:n]).reshape(shape["dp"], shape["pp"], shape["mp"])
+    return Mesh(arr, ("dp", "pp", "mp"))
+
+
+# ---------------------------------------------------------------------------
+# Parameter init + shardings
+# ---------------------------------------------------------------------------
+
+
+def init_params(config: GPTConfig, mesh: Mesh, seed: int = 0, dtype=jnp.float32):
+    pp = mesh.shape["pp"]
+    assert config.num_layers % pp == 0, "num_layers must divide pp"
+    lps = config.num_layers // pp
+    h, f, v, s = config.hidden_size, config.ffn_size, config.vocab_size, config.max_seq_len
+    std = config.initializer_range
+    key = jax.random.PRNGKey(seed)
+    ks = iter(jax.random.split(key, 16))
+
+    def norm(k, shape):
+        return (jax.random.normal(k, shape, jnp.float32) * std).astype(dtype)
+
+    params = {
+        "tok_emb": norm(next(ks), (v, h)),
+        "pos_emb": norm(next(ks), (s, h)),
+        "stages": {
+            "ln1_g": jnp.ones((pp, lps, h), dtype),
+            "ln1_b": jnp.zeros((pp, lps, h), dtype),
+            "wqkv": norm(next(ks), (pp, lps, h, 3 * h)),
+            "bqkv": jnp.zeros((pp, lps, 3 * h), dtype),
+            "wo": norm(next(ks), (pp, lps, h, h)),
+            "bo": jnp.zeros((pp, lps, h), dtype),
+            "ln2_g": jnp.ones((pp, lps, h), dtype),
+            "ln2_b": jnp.zeros((pp, lps, h), dtype),
+            "w1": norm(next(ks), (pp, lps, h, f)),
+            "b1": jnp.zeros((pp, lps, f), dtype),
+            "w2": norm(next(ks), (pp, lps, f, h)),
+            "b2": jnp.zeros((pp, lps, h), dtype),
+        },
+        "lnf_g": jnp.ones((h,), dtype),
+        "lnf_b": jnp.zeros((h,), dtype),
+    }
+    return params
+
+
+def param_specs() -> dict:
+    """PartitionSpecs: pp stacks stages, mp is the Megatron dim."""
+    return {
+        "tok_emb": P("mp", None),  # vocab-parallel embedding
+        "pos_emb": P(),
+        "stages": {
+            "ln1_g": P("pp", None, None),
+            "ln1_b": P("pp", None, None),
+            "wqkv": P("pp", None, None, "mp"),   # column parallel
+            "bqkv": P("pp", None, "mp"),
+            "wo": P("pp", None, "mp", None),     # row parallel
+            "bo": P("pp", None, None),
+            "ln2_g": P("pp", None, None),
+            "ln2_b": P("pp", None, None),
+            "w1": P("pp", None, None, "mp"),
+            "b1": P("pp", None, "mp"),
+            "w2": P("pp", None, "mp", None),
+            "b2": P("pp", None, None),
+        },
+        "lnf_g": P(),
+        "lnf_b": P(),
+    }
+
+
+def param_shardings(mesh: Mesh):
+    return jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec),
+        param_specs(),
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Model math (pure, global-view except the pp ring)
+# ---------------------------------------------------------------------------
+
+
+def _layer_norm(x, g, b, eps):
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mu) * lax.rsqrt(var + eps) * g + b
+
+
+def _mk_cs(mesh: Mesh):
+    # Plain PartitionSpecs resolve against the context mesh (jax.set_mesh),
+    # which inside a partial-manual shard_map is the manual-adjusted abstract
+    # mesh — concrete NamedShardings would mismatch there.
+    def cs(x, spec):
+        return lax.with_sharding_constraint(x, spec)
+
+    return cs
+
+
+def _block(p, x, config: GPTConfig, mesh: Mesh):
+    """One decoder block on [mb, s, h] with TP/SP sharding constraints."""
+    nh, hd = config.num_heads, config.head_dim
+    mb, s, h = x.shape
+    cs = _mk_cs(mesh)
+
+    # SP region: sequence sharded over mp
+    x = cs(x, P("dp", "mp", None))
+    y = _layer_norm(x, p["ln1_g"], p["ln1_b"], config.layer_norm_eps)
+    qkv = y @ p["wqkv"] + p["bqkv"]           # column-parallel -> [mb,s,3h]/mp
+    qkv = cs(qkv, P("dp", None, "mp"))
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+
+    def heads(t):  # [mb, s, h] -> [mb, nh, s, hd], heads sharded over mp
+        t = t.reshape(mb, s, nh, hd).transpose(0, 2, 1, 3)
+        return cs(t, P("dp", "mp", None, None))
+
+    use_flash = (
+        jax.default_backend() == "tpu"
+        and mesh.shape["mp"] == 1
+        and s % 128 == 0
+    )
+    if use_flash:
+        # fused Pallas kernel: no S x S residuals in fwd or bwd
+        from ..ops.pallas.flash_attention import flash_attention
+
+        qh = q.reshape(mb, s, nh, hd)
+        kh = k.reshape(mb, s, nh, hd)
+        vh = v.reshape(mb, s, nh, hd)
+        o = flash_attention(qh, kh, vh, causal=True).reshape(mb, s, h)
+    else:
+        q, k, v = heads(q), heads(k), heads(v)
+        scores = jnp.einsum("bnqd,bnkd->bnqk", q, k) / math.sqrt(hd)
+        causal = jnp.tril(jnp.ones((s, s), bool))
+        scores = jnp.where(causal, scores, -1e30)
+        attn = jax.nn.softmax(scores, axis=-1)
+        o = jnp.einsum("bnqk,bnkd->bnqd", attn, v)
+        o = o.transpose(0, 2, 1, 3).reshape(mb, s, h)
+    o = o @ p["wo"] + p["bo"]                  # row-parallel
+    x = x + cs(o, P("dp", "mp", None))         # reduce-scatter onto SP layout
+
+    y = _layer_norm(x, p["ln2_g"], p["ln2_b"], config.layer_norm_eps)
+    y = jax.nn.gelu(y @ p["w1"] + p["b1"], approximate=True)
+    y = cs(y, P("dp", None, "mp"))
+    y = y @ p["w2"] + p["b2"]
+    x = x + cs(y, P("dp", "mp", None))
+    return x
+
+
+def _stage_fn(p_stage, x, config: GPTConfig, mesh: Mesh):
+    """Apply this pp rank's layers (scan over the layer-in-stage dim)."""
+
+    def body(carry, p_layer):
+        return _block(p_layer, carry, config, mesh), None
+
+    x, _ = lax.scan(body, x, p_stage)
+    return x
+
+
+def _pipeline(stages, mbs, mesh: Mesh, config: GPTConfig):
+    """Microbatch ring over the pp axis (GSPMD-pipelined stacked stages).
+
+    stages: pytree with leading [pp, lps, ...] dims. mbs: [M, mb, s, h].
+    Returns [M, mb, s, h] (last-stage outputs, replicated over pp).
+    """
+    num_stages = mesh.shape["pp"]
+    num_micro = mbs.shape[0]
+    if num_stages == 1:
+        p_one = jax.tree.map(lambda a: a[0], stages)
+
+        def one(mb):
+            return _stage_fn(p_one, mb, config, mesh)
+
+        return jax.lax.map(one, mbs)
+
+    total = num_micro + num_stages - 1
+    last = num_stages - 1
+
+    def per_device(p_local, mbs_):
+        stage = lax.axis_index("pp")
+        p_one = jax.tree.map(lambda a: a[0], p_local)
+
+        def step(carry, t):
+            acts = carry
+            x0 = mbs_[jnp.clip(t, 0, num_micro - 1)]
+            x = jnp.where(stage == 0, x0, acts)
+            y = _stage_fn(p_one, x, config, mesh)
+            perm = [(i, (i + 1) % num_stages) for i in range(num_stages)]
+            y_shift = lax.ppermute(y, "pp", perm)
+            valid = jnp.logical_and(t - last >= 0, t - last < num_micro)
+            out_t = jnp.where(
+                jnp.logical_and(stage == last, valid), y, jnp.zeros_like(y)
+            )
+            out_t = lax.psum(out_t, "pp")
+            return y_shift, out_t
+
+        init = jnp.zeros_like(mbs_[0])
+        init = lax.pcast(init, ("pp",), to="varying")
+        _, outs = lax.scan(step, init, jnp.arange(total))
+        return outs
+
+    shard = jax.shard_map(
+        per_device,
+        mesh=mesh,
+        in_specs=(jax.tree.map(lambda _: P("pp"), stages), P()),
+        out_specs=P(),
+        axis_names={"pp"},  # manual over pp; dp/mp stay GSPMD-auto
+        check_vma=False,
+    )
+    outs = shard(stages, mbs)
+    return outs[last : last + num_micro]
+
+
+def loss_fn(params, ids, labels, config: GPTConfig, mesh: Mesh, num_micro: int):
+    # MXU-native matmul precision: the framework default is "highest" (true
+    # fp32 semantics for user-facing float32 ops), which would emulate even
+    # bf16 matmuls with multi-pass fp32 — 6x slower. The training path wants
+    # native bf16 MXU passes; loss math below is explicitly fp32.
+    with jax.default_matmul_precision("default"):
+        return _loss_fn_inner(params, ids, labels, config, mesh, num_micro)
+
+
+def _loss_fn_inner(params, ids, labels, config: GPTConfig, mesh: Mesh, num_micro: int):
+    cs = _mk_cs(mesh)
+    b, s = ids.shape
+    x = jnp.take(params["tok_emb"], ids, axis=0) + params["pos_emb"][:s]
+    x = cs(x, P("dp", None, None))
+    mb = b // num_micro
+    mbs = x.reshape(num_micro, mb, s, x.shape[-1])
+    y = _pipeline(params["stages"], mbs, mesh, config)
+    y = y.reshape(b, s, -1)
+    y = _layer_norm(y, params["lnf_g"], params["lnf_b"], config.layer_norm_eps)
+    logits = y @ params["tok_emb"].T  # tied head, vocab-sharded over mp
+    logits = cs(logits, P("dp", None, "mp"))
+    # shifted next-token CE in fp32
+    lg = logits[:, :-1].astype(jnp.float32)
+    lb = labels[:, 1:]
+    lg = lg - jax.scipy.special.logsumexp(lg, axis=-1, keepdims=True)
+    nll = -jnp.take_along_axis(lg, lb[..., None], axis=-1)[..., 0]
+    return jnp.mean(nll)
+
+
+# ---------------------------------------------------------------------------
+# Train step
+# ---------------------------------------------------------------------------
+
+
+def sgd_init(params):
+    return jax.tree.map(jnp.zeros_like, params)
+
+
+def build_spmd_train_step(
+    config: GPTConfig,
+    mesh: Mesh,
+    batch_size: int,
+    seq_len: int,
+    num_micro: int | None = None,
+    lr: float = 1e-3,
+    momentum: float = 0.9,
+):
+    """Returns (jitted step, params, opt_state, example (ids, labels)).
+
+    The step is jit-compiled over the mesh with full in/out shardings and
+    donated state: ``step(params, momentum, ids, labels) -> (params, momentum,
+    loss)``.
+    """
+    num_micro = num_micro or max(1, 2 * mesh.shape["pp"])
+    assert batch_size % num_micro == 0
+
+    params = init_params(config, mesh)
+    p_shard = param_shardings(mesh)
+    params = jax.device_put(params, p_shard)
+    mom = jax.device_put(sgd_init(params), p_shard)
+    data_shard = NamedSharding(mesh, P("dp", None))
+
+    def step(params, mom, ids, labels):
+        loss, grads = jax.value_and_grad(loss_fn)(
+            params, ids, labels, config, mesh, num_micro
+        )
+        mom2 = jax.tree.map(lambda m, g: momentum * m + g, mom, grads)
+        params2 = jax.tree.map(lambda p, m: p - lr * m, params, mom2)
+        return params2, mom2, loss
+
+    jitted_inner = jax.jit(
+        step,
+        in_shardings=(p_shard, p_shard, data_shard, data_shard),
+        out_shardings=(p_shard, p_shard, NamedSharding(mesh, P())),
+        donate_argnums=(0, 1),
+    )
+
+    def jitted(*args):
+        with jax.set_mesh(mesh):
+            return jitted_inner(*args)
+
+    jitted.lower = lambda *a: jitted_inner.lower(*a)
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, config.vocab_size, (batch_size, seq_len)), jnp.int32)
+    labels = jnp.asarray(rng.randint(0, config.vocab_size, (batch_size, seq_len)), jnp.int32)
+    ids = jax.device_put(ids, data_shard)
+    labels = jax.device_put(labels, data_shard)
+    return jitted, params, mom, (ids, labels)

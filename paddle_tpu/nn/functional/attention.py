@@ -58,11 +58,15 @@ def scaled_dot_product_attention(
     from ...framework.random import default_generator
 
     dkey = default_generator.next_key() if (dropout_p > 0.0 and training) else None
-    use_flash = _flash_usable(query)
+    use_flash = (
+        _flash_usable(query)
+        and query.shape[1] == key.shape[1]
+        and query.shape[2] == key.shape[2]  # no GQA in the kernel yet
+    )
 
     def fn(q, k, v, *rest):
         mask = rest[0] if rest else None
-        if use_flash and mask is None:
+        if use_flash and mask is None and dkey is None:
             from ...ops.pallas.flash_attention import flash_attention
 
             return flash_attention(q, k, v, causal=is_causal)
@@ -87,7 +91,7 @@ def _flash_usable(query) -> bool:
         return False
     d = query._data.shape[-1] if hasattr(query, "_data") else query.shape[-1]
     s = query._data.shape[1] if hasattr(query, "_data") else query.shape[1]
-    return d % 128 == 0 and s % 128 == 0
+    return d % 64 == 0 and s % 128 == 0
 
 
 def flash_attention(

@@ -1,0 +1,6 @@
+"""paddle_tpu.ops — custom TPU kernels (Pallas).
+
+The TPU-native answer to phi/kernels custom CUDA (SURVEY.md L5): the few ops
+where XLA fusion is not enough get hand-written Pallas kernels; everything
+else lowers through jnp/lax.
+"""

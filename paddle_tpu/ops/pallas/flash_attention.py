@@ -1,0 +1,276 @@
+"""Flash attention — Pallas TPU kernel with custom VJP.
+
+Parity target: the reference's FlashAttention GPU kernel surface
+(paddle/phi/kernels/gpu/flash_attn_kernel.cu:128 FlashAttnKernel, registered
+:245, backward flash_attn_grad_kernel.cu) which dispatches to external
+libflashattn. Here the kernel is implemented directly: online-softmax tiling
+(the FlashAttention-2 recurrence) over KV blocks, fp32 accumulators, causal
+masking, and a two-kernel backward (dq; dk/dv) from the saved (out, lse)
+residuals — no S×S materialization in either direction.
+
+Layout: public entry takes paddle layout [batch, seq, heads, head_dim] and
+computes in [batch, heads, seq, head_dim]. K/V live in VMEM per (batch, head)
+program — fine up to ~16k tokens at head_dim 128; longer sequences should use
+the ring/blockwise path (distributed sequence parallelism) on top.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+# Block sizes: 128 divides every gated shape (caller guarantees seq % 128 ==
+# 0 and head_dim % 64 == 0; head_dim is never blocked) and match the MXU tile.
+BLOCK_Q = 128
+BLOCK_K = 128
+NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal):
+    i = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale  # [bq, d]
+    seq = k_ref.shape[1]
+    num_k = seq // BLOCK_K
+    bq, d = q.shape
+
+    row_ids = i * BLOCK_Q + jax.lax.broadcasted_iota(jnp.int32, (bq, BLOCK_K), 0)
+
+    def body(j, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(j * BLOCK_K, BLOCK_K), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * BLOCK_K, BLOCK_K), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [bq, bk]
+        if causal:
+            col_ids = j * BLOCK_K + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, BLOCK_K), 1
+            )
+            s = jnp.where(row_ids >= col_ids, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jnp.dot(p, v, preferred_element_type=jnp.float32)
+        return m_new, l, acc
+
+    # int32 loop bounds: the framework runs with jax_enable_x64, and int64
+    # scalars are not lowerable inside Mosaic kernels.
+    if causal:
+        upper = jnp.minimum(num_k, (i + 1) * BLOCK_Q // BLOCK_K).astype(jnp.int32)
+    else:
+        upper = jnp.int32(num_k)
+    m0 = jnp.full((bq, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(jnp.int32(0), upper, body, (m0, l0, acc0))
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+    lse_ref[0, 0, :] = (m + jnp.log(l))[:, 0]
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, scale, causal):
+    i = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)  # [bq, d]
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, 0, :][:, None]  # [bq, 1]
+    delta = delta_ref[0, 0, :][:, None]
+    seq = k_ref.shape[1]
+    num_k = seq // BLOCK_K
+    bq, d = q.shape
+    row_ids = i * BLOCK_Q + jax.lax.broadcasted_iota(jnp.int32, (bq, BLOCK_K), 0)
+
+    def body(j, dq):
+        k = k_ref[0, pl.ds(j * BLOCK_K, BLOCK_K), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * BLOCK_K, BLOCK_K), :].astype(jnp.float32)
+        s = scale * jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        if causal:
+            col_ids = j * BLOCK_K + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, BLOCK_K), 1
+            )
+            s = jnp.where(row_ids >= col_ids, s, NEG_INF)
+        p = jnp.exp(s - lse)  # [bq, bk]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta)
+        return dq + scale * jnp.dot(ds, k, preferred_element_type=jnp.float32)
+
+    if causal:
+        upper = jnp.minimum(num_k, (i + 1) * BLOCK_Q // BLOCK_K).astype(jnp.int32)
+    else:
+        upper = jnp.int32(num_k)
+    dq = jax.lax.fori_loop(jnp.int32(0), upper, body, jnp.zeros((bq, d), jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *, scale, causal):
+    j = pl.program_id(1)
+    k = k_ref[0].astype(jnp.float32)  # [bk, d]
+    v = v_ref[0].astype(jnp.float32)
+    seq = q_ref.shape[1]
+    num_q = seq // BLOCK_Q
+    bk, d = k.shape
+    col_ids = j * BLOCK_K + jax.lax.broadcasted_iota(jnp.int32, (BLOCK_Q, bk), 1)
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(i * BLOCK_Q, BLOCK_Q), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(i * BLOCK_Q, BLOCK_Q), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(i * BLOCK_Q, BLOCK_Q)][:, None]
+        delta = delta_ref[0, 0, pl.ds(i * BLOCK_Q, BLOCK_Q)][:, None]
+        s = scale * jnp.dot(q, k.T, preferred_element_type=jnp.float32)  # [bq, bk]
+        if causal:
+            row_ids = i * BLOCK_Q + jax.lax.broadcasted_iota(
+                jnp.int32, (BLOCK_Q, bk), 0
+            )
+            s = jnp.where(row_ids >= col_ids, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dv = dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dk = dk + scale * jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return dk, dv
+
+    if causal:
+        lower = ((j * BLOCK_K) // BLOCK_Q).astype(jnp.int32)
+    else:
+        lower = jnp.int32(0)
+    z = jnp.zeros((bk, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(lower, jnp.int32(num_q), body, (z, z))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _bhsd_specs(seq, d, blocked: bool):
+    """BlockSpec for [bh, seq, d] arrays: per-program either one seq-block or
+    the full sequence (K/V)."""
+    if blocked:
+        return pl.BlockSpec((1, BLOCK_Q, d), lambda bh, i: (bh, i, 0))
+    return pl.BlockSpec((1, seq, d), lambda bh, i: (bh, 0, 0))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash(q, k, v, scale, causal):
+    out, _ = _flash_fwd_impl(q, k, v, scale, causal)
+    return out
+
+
+def _flash_fwd_impl(q, k, v, scale, causal):
+    bh, seq, d = q.shape
+    grid = (bh, seq // BLOCK_Q)
+    # Trace kernels in 32-bit mode: the framework enables jax_enable_x64 and
+    # int64 scalars are unlowerable in Mosaic.
+    with jax.enable_x64(False):
+        out, lse = pl.pallas_call(
+            functools.partial(_fwd_kernel, scale=scale, causal=causal),
+            grid=grid,
+            in_specs=[
+            _bhsd_specs(seq, d, True),
+            _bhsd_specs(seq, d, False),
+            _bhsd_specs(seq, d, False),
+            ],
+            out_specs=[
+            _bhsd_specs(seq, d, True),
+            pl.BlockSpec((1, 1, BLOCK_Q), lambda b, i: (b, 0, i)),
+            ],
+            out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((bh, 1, seq), jnp.float32),
+            ],
+            interpret=_interpret(),
+        )(q, k, v)
+    return out, lse
+
+
+def _flash_fwd(q, k, v, scale, causal):
+    out, lse = _flash_fwd_impl(q, k, v, scale, causal)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(scale, causal, res, g):
+    q, k, v, out, lse = res
+    bh, seq, d = q.shape
+    delta = jnp.sum(
+        g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1, keepdims=False
+    )[:, None, :]  # [bh, 1, seq]
+
+    lse_spec_blocked = pl.BlockSpec((1, 1, BLOCK_Q), lambda b, i: (b, 0, i))
+    lse_spec_full = pl.BlockSpec((1, 1, seq), lambda b, i: (b, 0, 0))
+
+    with jax.enable_x64(False):
+        dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal),
+        grid=(bh, seq // BLOCK_Q),
+        in_specs=[
+            _bhsd_specs(seq, d, True),   # q block
+            _bhsd_specs(seq, d, False),  # k full
+            _bhsd_specs(seq, d, False),  # v full
+            _bhsd_specs(seq, d, True),   # do block
+            lse_spec_blocked,
+            lse_spec_blocked,
+        ],
+            out_specs=_bhsd_specs(seq, d, True),
+            out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+            interpret=_interpret(),
+        )(q, k, v, g, lse, delta)
+
+    kv_block = pl.BlockSpec((1, BLOCK_K, d), lambda bh_, j: (bh_, j, 0))
+    q_full = pl.BlockSpec((1, seq, d), lambda bh_, j: (bh_, 0, 0))
+    with jax.enable_x64(False):
+        dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, causal=causal),
+        grid=(bh, seq // BLOCK_K),
+        in_specs=[
+            q_full,          # q full
+            kv_block,        # k block
+            kv_block,        # v block
+            q_full,          # do full
+            lse_spec_full,
+            lse_spec_full,
+        ],
+            out_specs=[kv_block, kv_block],
+            out_shape=[
+                jax.ShapeDtypeStruct(k.shape, k.dtype),
+                jax.ShapeDtypeStruct(v.shape, v.dtype),
+            ],
+            interpret=_interpret(),
+        )(q, k, v, g, lse, delta)
+    return dq, dk, dv
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, causal: bool = False, scale: float | None = None):
+    """Flash attention over paddle-layout arrays [batch, seq, heads, head_dim].
+
+    Raw-array API (used from nn.functional.scaled_dot_product_attention which
+    handles the framework tape). Differentiable via the Pallas backward
+    kernels. No mask/dropout — callers fall back to the reference path for
+    those (matching the reference kernel's unsupported-feature fallbacks).
+    """
+    b, sq, h, d = q.shape
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    # [b, s, h, d] -> [b*h, s, d]
+    def to_bhsd(x):
+        return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * x.shape[2], x.shape[1], d)
+
+    qt, kt, vt = to_bhsd(q), to_bhsd(k), to_bhsd(v)
+    out = _flash(qt, kt, vt, float(scale), bool(causal))
+    return jnp.transpose(out.reshape(b, h, sq, d), (0, 2, 1, 3))

@@ -1,0 +1,134 @@
+"""Flagship model family tests: eager, jit, and SPMD hybrid-parallel paths."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import GPTForCausalLM, GPT_CONFIGS
+from paddle_tpu.models.gpt import GPTConfig
+
+
+@pytest.fixture
+def tiny_cfg():
+    return GPT_CONFIGS["gpt3-tiny"]
+
+
+class TestGPTEager:
+    def test_forward_loss_backward(self, tiny_cfg, rng):
+        paddle.seed(0)
+        m = GPTForCausalLM(tiny_cfg)
+        ids = paddle.to_tensor(
+            rng.randint(0, tiny_cfg.vocab_size, (2, 32)), dtype="int64"
+        )
+        loss = m(ids, labels=ids)
+        # init loss ~= ln(vocab)
+        assert abs(float(loss.numpy()) - np.log(tiny_cfg.vocab_size)) < 0.5
+        loss.backward()
+        assert all(p.grad is not None for p in m.parameters())
+
+    def test_decode_with_cache_matches_full(self, tiny_cfg, rng):
+        paddle.seed(1)
+        m = GPTForCausalLM(tiny_cfg)
+        m.eval()
+        ids = paddle.to_tensor(
+            rng.randint(0, tiny_cfg.vocab_size, (1, 8)), dtype="int64"
+        )
+        full_logits = m(ids).numpy()
+        caches = [(None, None)] * tiny_cfg.num_layers
+        outs = []
+        for t in range(8):
+            lg, caches = m(ids[:, t : t + 1], caches=caches)
+            outs.append(lg.numpy())
+        step_logits = np.concatenate(outs, axis=1)
+        np.testing.assert_allclose(step_logits, full_logits, rtol=1e-4, atol=1e-5)
+
+    def test_trains(self, tiny_cfg, rng):
+        paddle.seed(2)
+        m = GPTForCausalLM(tiny_cfg)
+        opt = paddle.optimizer.Adam(learning_rate=1e-3, parameters=m.parameters())
+        ids = paddle.to_tensor(
+            rng.randint(0, tiny_cfg.vocab_size, (2, 32)), dtype="int64"
+        )
+        losses = []
+        for _ in range(5):
+            loss = m(ids, labels=ids)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0]
+
+
+class TestGPTJit:
+    def test_to_static_parity(self, tiny_cfg, rng):
+        paddle.seed(3)
+        m = GPTForCausalLM(tiny_cfg)
+        ids = paddle.to_tensor(
+            rng.randint(0, tiny_cfg.vocab_size, (2, 16)), dtype="int64"
+        )
+        eager = m(ids).numpy()
+        paddle.jit.to_static(m)
+        static = m(ids).numpy()
+        np.testing.assert_allclose(static, eager, rtol=1e-4, atol=1e-5)
+
+
+class TestGPTSpmd:
+    def test_3d_parallel_train_step(self):
+        import jax
+
+        from paddle_tpu.models.gpt_spmd import build_spmd_train_step, make_mesh
+
+        cfg = GPTConfig(
+            vocab_size=128, hidden_size=32, num_layers=2, num_heads=2, max_seq_len=32
+        )
+        mesh = make_mesh(8)
+        assert dict(mesh.shape) == {"dp": 2, "pp": 2, "mp": 2}
+        step, params, mom, (ids, labels) = build_spmd_train_step(
+            cfg, mesh, batch_size=4, seq_len=16, num_micro=2, lr=0.05
+        )
+        losses = []
+        for _ in range(3):
+            params, mom, loss = step(params, mom, ids, labels)
+            losses.append(float(loss))
+        assert all(np.isfinite(l) for l in losses)
+        assert losses[-1] < losses[0]
+
+    def test_spmd_matches_single_device(self):
+        """dp2/pp2/mp2 must compute the same loss as a 1-device mesh."""
+        import jax
+
+        from paddle_tpu.models.gpt_spmd import (
+            build_spmd_train_step,
+            init_params,
+            loss_fn,
+            make_mesh,
+        )
+        import jax.numpy as jnp
+
+        cfg = GPTConfig(
+            vocab_size=64, hidden_size=32, num_layers=2, num_heads=2, max_seq_len=16
+        )
+        rng = np.random.RandomState(0)
+        ids = jnp.asarray(rng.randint(0, 64, (4, 16)), jnp.int32)
+        labels = jnp.asarray(rng.randint(0, 64, (4, 16)), jnp.int32)
+
+        mesh8 = make_mesh(8)
+        mesh1 = make_mesh(1)
+        p8 = init_params(cfg, mesh8, seed=7)
+        p1 = init_params(cfg, mesh1, seed=7)
+        # same seed -> same global params modulo the pp stacking (pp=2 vs 1):
+        # compare via the 8-dev run against a manual single-mesh eval with the
+        # SAME stacked layout re-flattened
+        with jax.set_mesh(mesh8):
+            l8 = float(jax.jit(
+                lambda p: loss_fn(p, ids, labels, cfg, mesh8, 2)
+            )(p8))
+        # restack p8's stages [2, 1, ...] -> [1, 2, ...] for the 1-dev mesh
+        restacked = dict(p8)
+        restacked["stages"] = jax.tree.map(
+            lambda a: a.reshape(1, -1, *a.shape[2:]), p8["stages"]
+        )
+        with jax.set_mesh(mesh1):
+            l1 = float(jax.jit(
+                lambda p: loss_fn(p, ids, labels, cfg, mesh1, 1)
+            )(restacked))
+        np.testing.assert_allclose(l8, l1, rtol=1e-5)
