@@ -88,6 +88,8 @@ _FLAT = {
     "shard_layer": ".auto_parallel.api",
     "shard_optimizer": ".auto_parallel.api",
     "shard_dataloader": ".auto_parallel.api",
+    "save_state_dict": ".checkpoint",
+    "load_state_dict": ".checkpoint",
     "ShardDataloader": ".auto_parallel.api",
     "unshard_dtensor": ".auto_parallel.api",
     # collectives

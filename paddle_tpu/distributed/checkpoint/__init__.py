@@ -1,0 +1,18 @@
+"""Distributed checkpoint: shard-wise save + resharding load.
+
+Parity: paddle.distributed.{save_state_dict, load_state_dict} (reference:
+python/paddle/distributed/checkpoint/save_state_dict.py:104 — per-rank local
+shards + global metadata plan, dedup of replicated tensors :76;
+load_state_dict.py:377 — rank->file map :65, shard-box overlap computation
+:247, reshard-on-load so training on N ranks can resume on M).
+
+TPU-native: a DistTensor is a jax.Array with a NamedSharding; its
+``addressable_shards`` carry (index, replica_id, data) — dedup = "write only
+replica 0 of each shard box", the metadata plan is the per-tensor list of
+shard boxes, and resharding load = assemble the overlapping boxes and
+``jax.device_put`` onto the new mesh/placements (XLA moves the bytes).
+"""
+from .save_state_dict import save_state_dict
+from .load_state_dict import load_state_dict
+
+__all__ = ["save_state_dict", "load_state_dict"]

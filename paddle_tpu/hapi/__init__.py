@@ -1,0 +1,16 @@
+"""paddle_tpu.hapi (parity: python/paddle/hapi/)."""
+from . import callbacks
+from .callbacks import Callback, EarlyStopping, LRScheduler, ModelCheckpoint, ProgBarLogger
+from .model import Model
+from .model_summary import summary
+
+__all__ = [
+    "callbacks",
+    "Callback",
+    "EarlyStopping",
+    "LRScheduler",
+    "ModelCheckpoint",
+    "ProgBarLogger",
+    "Model",
+    "summary",
+]

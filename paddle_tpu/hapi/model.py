@@ -1,0 +1,227 @@
+"""paddle.Model — the Keras-like train loop.
+
+Parity: python/paddle/hapi/model.py (prepare :1676, fit :1756, evaluate,
+predict, save/load :1054, train_batch/eval_batch). Dynamic-mode
+implementation; the jit path comes from wrapping the network with
+paddle.jit.to_static before constructing the Model.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from .. import framework_io
+from ..io import DataLoader
+from ..metric import Metric
+from ..tensor.tensor import Tensor
+from .callbacks import Callback, CallbackList, ModelCheckpoint, ProgBarLogger
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self.stop_training = False
+
+    # --- configuration -----------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        for m in _to_list(metrics):
+            if not isinstance(m, Metric):
+                raise TypeError(f"metric must be paddle.metric.Metric, got {type(m)}")
+        self._metrics = _to_list(metrics)
+
+    # --- single-batch ops --------------------------------------------------
+    def _forward(self, inputs):
+        ins = _to_list(inputs)
+        ins = [x if isinstance(x, Tensor) else Tensor(np.asarray(x)) for x in ins]
+        outs = self.network(*ins)
+        return _to_list(outs)
+
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        outputs = self._forward(inputs)
+        labels_t = [
+            y if isinstance(y, Tensor) else Tensor(np.asarray(y))
+            for y in _to_list(labels)
+        ]
+        losses = _to_list(self._loss(*(outputs + labels_t)))
+        total = losses[0]
+        for extra in losses[1:]:
+            total = total + extra
+        total.backward()
+        if update:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        metrics = []
+        for m in self._metrics:
+            m.update(*[t.numpy() if isinstance(t, Tensor) else t for t in m.compute(*(outputs + labels_t))])
+            metrics.append(m.accumulate())
+        out = [float(l.numpy()) for l in losses]
+        return (out, metrics) if metrics else out
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        from ..autograd import no_grad
+
+        with no_grad():
+            outputs = self._forward(inputs)
+            labels_t = [
+                y if isinstance(y, Tensor) else Tensor(np.asarray(y))
+                for y in _to_list(labels)
+            ]
+            losses = (
+                _to_list(self._loss(*(outputs + labels_t))) if self._loss else []
+            )
+            metrics = []
+            for m in self._metrics:
+                m.update(*[t.numpy() if isinstance(t, Tensor) else t for t in m.compute(*(outputs + labels_t))])
+                metrics.append(m.accumulate())
+        out = [float(l.numpy()) for l in losses]
+        return (out, metrics) if metrics else out
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        from ..autograd import no_grad
+
+        with no_grad():
+            outputs = self._forward(inputs)
+        return [o.numpy() for o in outputs]
+
+    # --- loops -------------------------------------------------------------
+    def _make_loader(self, data, batch_size, shuffle):
+        if data is None or isinstance(data, DataLoader):
+            return data
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle)
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        loader = self._make_loader(train_data, batch_size, shuffle)
+        eval_loader = self._make_loader(eval_data, batch_size, False)
+        cbks = _to_list(callbacks) or [ProgBarLogger(log_freq, verbose=verbose)]
+        if save_dir:
+            cbks.append(ModelCheckpoint(save_freq, save_dir))
+        cb = CallbackList(cbks)
+        cb.set_model(self)
+        cb.set_params({"epochs": epochs, "steps": len(loader), "verbose": verbose})
+        self.stop_training = False
+
+        cb.on_train_begin()
+        for epoch in range(epochs):
+            cb.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            for step, batch in enumerate(loader):
+                if num_iters is not None and step >= num_iters:
+                    break
+                cb.on_train_batch_begin(step)
+                ins, labs = self._split_batch(batch)
+                update = (step + 1) % accumulate_grad_batches == 0
+                res = self.train_batch(ins, labs, update=update)
+                logs = self._logs_from(res)
+                cb.on_train_batch_end(step, logs)
+                if self.stop_training:
+                    break
+            cb.on_epoch_end(epoch, logs)
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                self._run_eval(eval_loader, cb)
+            if self.stop_training:
+                break
+        cb.on_train_end(logs)
+
+    def _split_batch(self, batch):
+        if isinstance(batch, (list, tuple)) and len(batch) >= 2:
+            return batch[:-1], batch[-1:]
+        return batch, None
+
+    def _logs_from(self, res):
+        logs = {}
+        if isinstance(res, tuple):
+            losses, metrics = res
+            logs["loss"] = losses
+            for m, v in zip(self._metrics, metrics):
+                names = m.name() if isinstance(m.name(), (list, tuple)) else [m.name()]
+                vals = v if isinstance(v, (list, tuple)) else [v]
+                for n, val in zip(names, vals):
+                    logs[n] = val
+        else:
+            logs["loss"] = res
+        return logs
+
+    def _run_eval(self, loader, cb):
+        cb.on_eval_begin()
+        for m in self._metrics:
+            m.reset()
+        logs = {}
+        for step, batch in enumerate(loader):
+            cb.on_eval_batch_begin(step)
+            ins, labs = self._split_batch(batch)
+            res = self.eval_batch(ins, labs)
+            logs = self._logs_from(res)
+            cb.on_eval_batch_end(step, logs)
+        cb.on_eval_end(logs)
+        return logs
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_iters=None):
+        loader = self._make_loader(eval_data, batch_size, False)
+        cb = CallbackList(_to_list(callbacks) or [ProgBarLogger(log_freq, verbose=verbose)])
+        cb.set_model(self)
+        cb.set_params({"steps": len(loader), "verbose": verbose})
+        return self._run_eval(loader, cb)
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False, callbacks=None, verbose=1):
+        loader = self._make_loader(test_data, batch_size, False)
+        outputs = []
+        for batch in loader:
+            ins = batch[0] if isinstance(batch, (list, tuple)) else batch
+            outputs.append(self.predict_batch([ins]))
+        if stack_outputs:
+            n_out = len(outputs[0])
+            return [np.concatenate([o[i] for o in outputs]) for i in range(n_out)]
+        return outputs
+
+    # --- persistence -------------------------------------------------------
+    def save(self, path, training=True):
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        if training:
+            framework_io.save(self.network.state_dict(), path + ".pdparams")
+            if self._optimizer is not None:
+                framework_io.save(self._optimizer.state_dict(), path + ".pdopt")
+        else:
+            from ..jit import save as jit_save
+            from ..jit.api import InputSpec
+
+            specs = self._inputs
+            jit_save(self.network, path, input_spec=specs)
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        state = framework_io.load(path + ".pdparams")
+        self.network.set_state_dict(state)
+        if not reset_optimizer and self._optimizer is not None and os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(framework_io.load(path + ".pdopt"))
+
+    def parameters(self, *a, **k):
+        return self.network.parameters(*a, **k)
+
+    def summary(self, input_size=None, dtype=None):
+        from .model_summary import summary
+
+        return summary(self.network, input_size, dtypes=dtype)

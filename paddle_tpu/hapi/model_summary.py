@@ -1,0 +1,64 @@
+"""Model summary (parity: python/paddle/hapi/model_summary.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..nn import Layer
+from ..tensor.tensor import Tensor
+
+
+def summary(net: Layer, input_size=None, dtypes=None, input=None):
+    """Print a per-layer table (name, output shape, params) and return
+    {'total_params', 'trainable_params'}."""
+    rows = []
+    hooks = []
+
+    def register(layer, prefix):
+        def hook(l, inputs, outputs):
+            outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+            shape = [list(o.shape) for o in outs if isinstance(o, Tensor)]
+            n_params = sum(int(np.prod(p.shape)) for p in l._parameters.values())
+            rows.append((prefix or l.__class__.__name__, shape, n_params))
+
+        hooks.append(layer.register_forward_post_hook(hook))
+
+    for name, sub in net.named_sublayers():
+        register(sub, name)
+
+    if input is not None:
+        x = input if isinstance(input, (list, tuple)) else [input]
+    elif input_size is not None:
+        sizes = input_size if isinstance(input_size, list) and isinstance(input_size[0], (list, tuple)) else [input_size]
+        dts = dtypes if isinstance(dtypes, (list, tuple)) else [dtypes] * len(sizes)
+        x = [
+            Tensor(np.zeros([d if d is not None else 1 for d in s], (dt or "float32")))
+            for s, dt in zip(sizes, dts)
+        ]
+    else:
+        raise ValueError("summary needs input_size or input")
+
+    was_training = net.training
+    net.eval()
+    try:
+        net(*x)
+    finally:
+        if was_training:
+            net.train()
+        for h in hooks:
+            h.remove()
+
+    total = sum(int(np.prod(p.shape)) for p in net.parameters())
+    trainable = sum(
+        int(np.prod(p.shape)) for p in net.parameters() if not p.stop_gradient
+    )
+    line = "-" * 72
+    print(line)
+    print(f"{'Layer (type)':<32}{'Output Shape':<24}{'Param #':<12}")
+    print(line)
+    for name, shape, n in rows:
+        print(f"{name:<32}{str(shape):<24}{n:<12}")
+    print(line)
+    print(f"Total params: {total:,}")
+    print(f"Trainable params: {trainable:,}")
+    print(line)
+    return {"total_params": total, "trainable_params": trainable}

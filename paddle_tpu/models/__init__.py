@@ -6,6 +6,13 @@ models (test/collective/fleet/hybrid_parallel_*_model.py) and test/book.
 These built-in families are the benchmark/flagship configurations named in
 BASELINE.md (GPT-3 sizes, ResNet for config 1, BERT for config 2).
 """
+from .bert import (
+    BERT_CONFIGS,
+    BertConfig,
+    BertForPretraining,
+    BertForSequenceClassification,
+    BertModel,
+)
 from .gpt import (
     GPT_CONFIGS,
     GPTConfig,
@@ -17,6 +24,11 @@ from .gpt import (
 )
 
 __all__ = [
+    "BERT_CONFIGS",
+    "BertConfig",
+    "BertForPretraining",
+    "BertForSequenceClassification",
+    "BertModel",
     "GPT_CONFIGS",
     "GPTConfig",
     "GPTDecoderLayer",

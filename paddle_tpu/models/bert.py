@@ -1,0 +1,205 @@
+"""BERT model family — BASELINE config 2 (BERT-base pretraining via jit).
+
+Architecture parity: the reference's transformer encoder surface
+(python/paddle/nn/layer/transformer.py TransformerEncoder) as configured by
+the standard bert-base/large checkpoints; pretraining heads = MLM + NSP.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..framework.param_attr import ParamAttr
+from ..nn import Layer, functional as F
+from ..nn.initializer import Normal
+from ..nn.layer.common import Dropout, Embedding, Linear
+from ..nn.layer.container import LayerList
+from ..nn.layer.norm import LayerNorm
+from ..tensor.creation import arange, zeros
+from ..tensor.manipulation import reshape
+from ..tensor.math import matmul, tanh
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    hidden_dropout: float = 0.1
+    attn_dropout: float = 0.1
+    layer_norm_eps: float = 1e-12
+    initializer_range: float = 0.02
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+
+BERT_CONFIGS = {
+    "bert-base": BertConfig(),
+    "bert-large": BertConfig(hidden_size=1024, num_layers=24, num_heads=16, intermediate_size=4096),
+    "bert-tiny": BertConfig(vocab_size=1024, hidden_size=128, num_layers=2, num_heads=2, intermediate_size=512, max_position_embeddings=128),
+}
+
+
+def _w(config):
+    return ParamAttr(initializer=Normal(mean=0.0, std=config.initializer_range))
+
+
+class BertEmbeddings(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.word_embeddings = Embedding(config.vocab_size, config.hidden_size, weight_attr=_w(config))
+        self.position_embeddings = Embedding(config.max_position_embeddings, config.hidden_size, weight_attr=_w(config))
+        self.token_type_embeddings = Embedding(config.type_vocab_size, config.hidden_size, weight_attr=_w(config))
+        self.layer_norm = LayerNorm(config.hidden_size, epsilon=config.layer_norm_eps)
+        self.dropout = Dropout(config.hidden_dropout)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        s = input_ids.shape[-1]
+        if position_ids is None:
+            position_ids = arange(0, s, dtype="int64")
+        if token_type_ids is None:
+            token_type_ids = zeros(list(input_ids.shape), dtype="int64")
+        x = (
+            self.word_embeddings(input_ids)
+            + self.position_embeddings(position_ids)
+            + self.token_type_embeddings(token_type_ids)
+        )
+        return self.dropout(self.layer_norm(x))
+
+
+class BertSelfAttention(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        h = config.hidden_size
+        self.config = config
+        self.qkv = Linear(h, 3 * h, weight_attr=_w(config))
+        self.out = Linear(h, h, weight_attr=_w(config))
+        self.dropout = Dropout(config.hidden_dropout)
+        self.attn_dropout = config.attn_dropout
+
+    def forward(self, x, attn_mask=None):
+        cfg = self.config
+        b, s = x.shape[0], x.shape[1]
+        qkv = reshape(self.qkv(x), [b, s, 3, cfg.num_heads, cfg.head_dim])
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        o = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask, is_causal=False,
+            dropout_p=self.attn_dropout if self.training else 0.0,
+        )
+        o = reshape(o, [b, s, cfg.num_heads * cfg.head_dim])
+        return self.dropout(self.out(o))
+
+
+class BertLayer(Layer):
+    """Post-LN encoder block (original BERT)."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.attention = BertSelfAttention(config)
+        self.ln1 = LayerNorm(config.hidden_size, epsilon=config.layer_norm_eps)
+        self.fc1 = Linear(config.hidden_size, config.intermediate_size, weight_attr=_w(config))
+        self.fc2 = Linear(config.intermediate_size, config.hidden_size, weight_attr=_w(config))
+        self.ln2 = LayerNorm(config.hidden_size, epsilon=config.layer_norm_eps)
+        self.dropout = Dropout(config.hidden_dropout)
+
+    def forward(self, x, attn_mask=None):
+        x = self.ln1(x + self.attention(x, attn_mask))
+        y = self.dropout(self.fc2(F.gelu(self.fc1(x), approximate=False)))
+        return self.ln2(x + y)
+
+
+class BertPooler(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.dense = Linear(config.hidden_size, config.hidden_size, weight_attr=_w(config))
+
+    def forward(self, hidden):
+        return tanh(self.dense(hidden[:, 0]))
+
+
+class BertModel(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        self.embeddings = BertEmbeddings(config)
+        self.encoder = LayerList([BertLayer(config) for _ in range(config.num_layers)])
+        self.pooler = BertPooler(config)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None, attention_mask=None):
+        if attention_mask is not None:
+            # [b, s] 1/0 -> additive [b, 1, 1, s]
+            m = (1.0 - attention_mask.astype("float32")) * -1e9
+            attention_mask = reshape(m, [m.shape[0], 1, 1, m.shape[-1]])
+        x = self.embeddings(input_ids, token_type_ids, position_ids)
+        for layer in self.encoder:
+            x = layer(x, attention_mask)
+        return x, self.pooler(x)
+
+
+class BertPretrainingHeads(Layer):
+    def __init__(self, config: BertConfig, embedding_weights=None):
+        super().__init__()
+        self.transform = Linear(config.hidden_size, config.hidden_size, weight_attr=_w(config))
+        self.layer_norm = LayerNorm(config.hidden_size, epsilon=config.layer_norm_eps)
+        self._tied = embedding_weights  # [vocab, hidden]
+        self.decoder_bias = self.create_parameter(
+            [config.vocab_size], is_bias=True
+        )
+        self.seq_relationship = Linear(config.hidden_size, 2, weight_attr=_w(config))
+
+    def forward(self, sequence_output, pooled_output):
+        x = self.layer_norm(F.gelu(self.transform(sequence_output), approximate=False))
+        mlm_logits = matmul(x, self._tied, transpose_y=True) + self.decoder_bias
+        nsp_logits = self.seq_relationship(pooled_output)
+        return mlm_logits, nsp_logits
+
+
+class BertForPretraining(Layer):
+    """MLM + NSP pretraining objective."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        self.bert = BertModel(config)
+        self.cls = BertPretrainingHeads(
+            config, embedding_weights=self.bert.embeddings.word_embeddings.weight
+        )
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                masked_lm_labels=None, next_sentence_label=None):
+        seq, pooled = self.bert(input_ids, token_type_ids, attention_mask=attention_mask)
+        mlm_logits, nsp_logits = self.cls(seq, pooled)
+        if masked_lm_labels is None:
+            return mlm_logits, nsp_logits
+        mlm_loss = F.cross_entropy(
+            reshape(mlm_logits, [-1, self.config.vocab_size]),
+            reshape(masked_lm_labels, [-1]),
+            ignore_index=-100,
+            reduction="mean",
+        )
+        loss = mlm_loss
+        if next_sentence_label is not None:
+            loss = loss + F.cross_entropy(
+                nsp_logits, reshape(next_sentence_label, [-1]), reduction="mean"
+            )
+        return loss
+
+
+class BertForSequenceClassification(Layer):
+    def __init__(self, config: BertConfig, num_classes: int = 2):
+        super().__init__()
+        self.bert = BertModel(config)
+        self.dropout = Dropout(config.hidden_dropout)
+        self.classifier = Linear(config.hidden_size, num_classes, weight_attr=_w(config))
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None, labels=None):
+        _, pooled = self.bert(input_ids, token_type_ids, attention_mask=attention_mask)
+        logits = self.classifier(self.dropout(pooled))
+        if labels is None:
+            return logits
+        return F.cross_entropy(logits, labels, reduction="mean")

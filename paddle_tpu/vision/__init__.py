@@ -1,0 +1,16 @@
+"""paddle_tpu.vision (parity: python/paddle/vision/)."""
+from . import datasets, models, transforms
+from .models import LeNet, ResNet, resnet18, resnet34, resnet50, resnet101, resnet152
+
+__all__ = [
+    "datasets",
+    "models",
+    "transforms",
+    "LeNet",
+    "ResNet",
+    "resnet18",
+    "resnet34",
+    "resnet50",
+    "resnet101",
+    "resnet152",
+]

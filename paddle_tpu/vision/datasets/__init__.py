@@ -1,0 +1,107 @@
+"""Vision datasets (parity: python/paddle/vision/datasets/).
+
+Zero-egress build: dataset classes read local archives when present
+(``download=False``-style); ``FakeData`` provides the in-repo synthetic
+fixture used by tests and benchmarks (the reference tests likewise run on
+small locally generated data rather than real downloads in CI).
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import tarfile
+
+import numpy as np
+
+from ...io.dataset import Dataset
+
+
+class FakeData(Dataset):
+    """Synthetic labeled images, deterministic per index."""
+
+    def __init__(self, num_samples=1000, shape=(3, 32, 32), num_classes=10, transform=None, seed=0):
+        self.num_samples = num_samples
+        self.shape = tuple(shape)
+        self.num_classes = num_classes
+        self.transform = transform
+        self.seed = seed
+
+    def __getitem__(self, idx):
+        rng = np.random.RandomState(self.seed + idx)
+        img = rng.rand(*self.shape).astype("float32")
+        label = rng.randint(0, self.num_classes)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.int64(label)
+
+    def __len__(self):
+        return self.num_samples
+
+
+class Cifar10(Dataset):
+    """CIFAR-10 from a local ``cifar-10-python.tar.gz`` (no download)."""
+
+    def __init__(self, data_file=None, mode="train", transform=None, download=False, backend="cv2"):
+        if download:
+            raise RuntimeError(
+                "this build has no network egress; place cifar-10-python.tar.gz "
+                "locally and pass data_file="
+            )
+        if data_file is None or not os.path.exists(data_file):
+            raise FileNotFoundError(f"CIFAR archive not found: {data_file}")
+        self.transform = transform
+        self.mode = mode
+        names = (
+            [f"data_batch_{i}" for i in range(1, 6)] if mode == "train" else ["test_batch"]
+        )
+        xs, ys = [], []
+        with tarfile.open(data_file) as tf:
+            for m in tf.getmembers():
+                base = os.path.basename(m.name)
+                if base in names:
+                    d = pickle.load(tf.extractfile(m), encoding="bytes")
+                    xs.append(d[b"data"])
+                    ys.extend(d[b"labels"])
+        self.data = np.concatenate(xs).reshape(-1, 3, 32, 32).astype("float32") / 255.0
+        self.labels = np.asarray(ys, "int64")
+
+    def __getitem__(self, idx):
+        img = self.data[idx]
+        if self.transform is not None:
+            img = self.transform(img.transpose(1, 2, 0)).astype("float32")
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class MNIST(Dataset):
+    """MNIST from local idx-gz files (no download)."""
+
+    def __init__(self, image_path=None, label_path=None, mode="train", transform=None, download=False, backend="cv2"):
+        if download:
+            raise RuntimeError("no network egress; provide image_path/label_path")
+        for p in (image_path, label_path):
+            if p is None or not os.path.exists(p):
+                raise FileNotFoundError(f"MNIST file not found: {p}")
+        with gzip.open(image_path, "rb") as f:
+            buf = f.read()
+            self.images = (
+                np.frombuffer(buf, np.uint8, offset=16).reshape(-1, 1, 28, 28).astype("float32") / 255.0
+            )
+        with gzip.open(label_path, "rb") as f:
+            self.labels = np.frombuffer(f.read(), np.uint8, offset=8).astype("int64")
+        self.transform = transform
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img.transpose(1, 2, 0)).astype("float32")
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+__all__ = ["FakeData", "Cifar10", "MNIST"]

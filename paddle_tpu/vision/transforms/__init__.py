@@ -1,0 +1,25 @@
+"""Vision transforms (parity: python/paddle/vision/transforms/ — the
+numpy/CHW subset used by the in-repo tests; PIL-specific paths are served by
+the same numpy implementations)."""
+from .transforms import (
+    BaseTransform,
+    CenterCrop,
+    Compose,
+    Normalize,
+    Pad,
+    RandomCrop,
+    RandomHorizontalFlip,
+    RandomVerticalFlip,
+    Resize,
+    ToTensor,
+    Transpose,
+    normalize,
+    resize,
+    to_tensor,
+)
+
+__all__ = [
+    "BaseTransform", "CenterCrop", "Compose", "Normalize", "Pad",
+    "RandomCrop", "RandomHorizontalFlip", "RandomVerticalFlip", "Resize",
+    "ToTensor", "Transpose", "normalize", "resize", "to_tensor",
+]

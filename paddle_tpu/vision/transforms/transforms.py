@@ -1,0 +1,181 @@
+"""Transform implementations over numpy HWC arrays (the dataset-native
+format), mirroring the reference's functional semantics
+(python/paddle/vision/transforms/transforms.py / functional.py)."""
+from __future__ import annotations
+
+import numbers
+import random
+
+import numpy as np
+
+
+def _as_hwc(img) -> np.ndarray:
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    return arr
+
+
+def resize(img, size, interpolation="bilinear"):
+    """Nearest/bilinear resize of an HWC numpy image."""
+    arr = _as_hwc(img)
+    if isinstance(size, int):
+        h, w = arr.shape[:2]
+        if h <= w:
+            oh, ow = size, max(1, int(size * w / h))
+        else:
+            oh, ow = max(1, int(size * h / w)), size
+    else:
+        oh, ow = size
+    h, w = arr.shape[:2]
+    if (h, w) == (oh, ow):
+        return arr
+    ys = np.linspace(0, h - 1, oh)
+    xs = np.linspace(0, w - 1, ow)
+    if interpolation == "nearest":
+        return arr[np.round(ys).astype(int)[:, None], np.round(xs).astype(int)[None, :]]
+    y0 = np.floor(ys).astype(int); y1 = np.minimum(y0 + 1, h - 1)
+    x0 = np.floor(xs).astype(int); x1 = np.minimum(x0 + 1, w - 1)
+    wy = (ys - y0)[:, None, None]
+    wx = (xs - x0)[None, :, None]
+    a = arr.astype(np.float32)
+    top = a[y0[:, None], x0[None, :]] * (1 - wx) + a[y0[:, None], x1[None, :]] * wx
+    bot = a[y1[:, None], x0[None, :]] * (1 - wx) + a[y1[:, None], x1[None, :]] * wx
+    out = top * (1 - wy) + bot * wy
+    return out.astype(arr.dtype) if np.issubdtype(arr.dtype, np.integer) else out
+
+
+def to_tensor(img, data_format="CHW"):
+    arr = _as_hwc(img).astype(np.float32)
+    if arr.dtype == np.float32 and arr.max() > 1.5:
+        arr = arr / 255.0
+    if data_format == "CHW":
+        arr = arr.transpose(2, 0, 1)
+    return arr
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    arr = np.asarray(img, np.float32)
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+    if data_format == "CHW":
+        return (arr - mean[:, None, None]) / std[:, None, None]
+    return (arr - mean) / std
+
+
+class BaseTransform:
+    def __call__(self, img):
+        return self._apply_image(img)
+
+    def _apply_image(self, img):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, img):
+        for t in self.transforms:
+            img = t(img)
+        return img
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        self.size = size
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        return resize(img, self.size, self.interpolation)
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size, keys=None):
+        self.size = (size, size) if isinstance(size, numbers.Number) else size
+
+    def _apply_image(self, img):
+        arr = _as_hwc(img)
+        h, w = arr.shape[:2]
+        th, tw = self.size
+        i = max(0, (h - th) // 2)
+        j = max(0, (w - tw) // 2)
+        return arr[i : i + th, j : j + tw]
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None, pad_if_needed=False, keys=None):
+        self.size = (size, size) if isinstance(size, numbers.Number) else size
+        self.padding = padding
+
+    def _apply_image(self, img):
+        arr = _as_hwc(img)
+        if self.padding:
+            p = self.padding if isinstance(self.padding, (list, tuple)) else [self.padding] * 4
+            arr = np.pad(arr, ((p[1], p[3]), (p[0], p[2]), (0, 0)))
+        h, w = arr.shape[:2]
+        th, tw = self.size
+        i = random.randint(0, max(0, h - th))
+        j = random.randint(0, max(0, w - tw))
+        return arr[i : i + th, j : j + tw]
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if random.random() < self.prob:
+            return _as_hwc(img)[:, ::-1]
+        return _as_hwc(img)
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if random.random() < self.prob:
+            return _as_hwc(img)[::-1]
+        return _as_hwc(img)
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        self.padding = padding if isinstance(padding, (list, tuple)) else [padding] * 4
+        self.fill = fill
+
+    def _apply_image(self, img):
+        p = self.padding
+        return np.pad(
+            _as_hwc(img), ((p[1], p[3]), (p[0], p[2]), (0, 0)),
+            constant_values=self.fill,
+        )
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1), keys=None):
+        self.order = order
+
+    def _apply_image(self, img):
+        return _as_hwc(img).transpose(self.order)
+
+
+class ToTensor(BaseTransform):
+    def __init__(self, data_format="CHW", keys=None):
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        return to_tensor(img, self.data_format)
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False, keys=None):
+        if isinstance(mean, numbers.Number):
+            mean = [mean] * 3
+        if isinstance(std, numbers.Number):
+            std = [std] * 3
+        self.mean, self.std, self.data_format = mean, std, data_format
+
+    def _apply_image(self, img):
+        return normalize(img, self.mean, self.std, self.data_format)

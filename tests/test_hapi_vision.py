@@ -1,0 +1,108 @@
+"""hapi Model + vision zoo tests (reference: test/legacy_test/test_model.py,
+test/book end-to-end small models)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.hapi import EarlyStopping, Model
+from paddle_tpu.metric import Accuracy
+from paddle_tpu.vision.datasets import FakeData
+from paddle_tpu.vision.models import LeNet, resnet18
+from paddle_tpu.vision.transforms import Compose, Normalize, Resize
+
+
+class TestVisionModels:
+    def test_resnet18_forward_backward(self, rng):
+        paddle.seed(0)
+        net = resnet18(num_classes=10)
+        x = paddle.to_tensor(rng.randn(2, 3, 32, 32).astype("float32"))
+        out = net(x)
+        assert list(out.shape) == [2, 10]
+        out.mean().backward()
+        assert net.conv1.weight.grad is not None
+
+    def test_resnet50_shapes(self, rng):
+        paddle.seed(0)
+        net = paddle.vision.models.resnet50(num_classes=7)
+        x = paddle.to_tensor(rng.randn(1, 3, 64, 64).astype("float32"))
+        assert list(net(x).shape) == [1, 7]
+
+    def test_lenet(self, rng):
+        net = LeNet()
+        x = paddle.to_tensor(rng.randn(2, 1, 28, 28).astype("float32"))
+        assert list(net(x).shape) == [2, 10]
+
+
+class TestTransforms:
+    def test_compose_resize_normalize(self, rng):
+        t = Compose([
+            Resize((16, 16)),
+            Normalize(mean=[0.5, 0.5, 0.5], std=[0.5, 0.5, 0.5], data_format="HWC"),
+        ])
+        img = rng.rand(32, 32, 3).astype("float32")
+        out = t(img)
+        assert out.shape == (16, 16, 3)
+        assert abs(float(out.mean())) < 1.2
+
+
+class TestHapiModel:
+    def _fit_small(self, callbacks=None, epochs=2):
+        paddle.seed(0)
+        net = LeNet()
+        model = Model(net)
+        model.prepare(
+            optimizer=paddle.optimizer.Adam(
+                learning_rate=1e-3, parameters=net.parameters()
+            ),
+            loss=nn.CrossEntropyLoss(),
+            metrics=Accuracy(),
+        )
+        data = FakeData(num_samples=32, shape=(1, 28, 28), num_classes=10)
+        model.fit(data, epochs=epochs, batch_size=8, verbose=0, callbacks=callbacks)
+        return model, data
+
+    def test_fit_evaluate_predict(self):
+        model, data = self._fit_small()
+        logs = model.evaluate(data, batch_size=8, verbose=0)
+        assert "loss" in logs and "acc" in logs
+        preds = model.predict(data, batch_size=8, stack_outputs=True)
+        assert preds[0].shape == (32, 10)
+
+    def test_save_load(self, tmp_path):
+        model, data = self._fit_small(epochs=1)
+        path = str(tmp_path / "ck" / "model")
+        model.save(path)
+        w = model.network.features[0].weight.numpy().copy()
+        # perturb then restore
+        model.network.features[0].weight.set_value(
+            paddle.to_tensor(np.zeros_like(w))
+        )
+        model.load(path)
+        np.testing.assert_allclose(model.network.features[0].weight.numpy(), w)
+
+    def test_train_batch_loss_decreases(self):
+        paddle.seed(1)
+        net = LeNet()
+        model = Model(net)
+        model.prepare(
+            optimizer=paddle.optimizer.Adam(
+                learning_rate=1e-3, parameters=net.parameters()
+            ),
+            loss=nn.CrossEntropyLoss(),
+        )
+        rng = np.random.RandomState(0)
+        x = rng.randn(16, 1, 28, 28).astype("float32")
+        y = rng.randint(0, 10, (16, 1)).astype("int64")
+        first = model.train_batch([x], [y])[0]
+        for _ in range(10):
+            last = model.train_batch([x], [y])[0]
+        assert last < first
+
+    def test_summary(self):
+        net = LeNet()
+        info = paddle.summary(net, (1, 1, 28, 28))
+        assert info["total_params"] > 0
+        assert info["total_params"] == sum(
+            int(np.prod(p.shape)) for p in net.parameters()
+        )
