@@ -52,6 +52,23 @@ def _assemble_global(meta, files: _FileCache) -> np.ndarray:
     return out
 
 
+def _set_by_path(state_dict: dict, dotted: str, value) -> None:
+    """Assign into the nested dict at a `a.b.c` flat key (objects only —
+    Tensors are filled in place through their handle instead)."""
+    def walk(d, prefix=""):
+        for k, v in list(d.items()):
+            key = f"{prefix}.{k}" if prefix else str(k)
+            if key == dotted:
+                d[k] = value
+                return True
+            if isinstance(v, dict) and dotted.startswith(key + "."):
+                if walk(v, key):
+                    return True
+        return False
+
+    walk(state_dict)
+
+
 def load_state_dict(state_dict: dict, path: str, process_group=None, coordinator_rank: int = 0) -> None:
     """Fill ``state_dict`` IN PLACE from checkpoint ``path``.
 
@@ -71,7 +88,11 @@ def load_state_dict(state_dict: dict, path: str, process_group=None, coordinator
     for name, dst in flat.items():
         meta = plan[name]
         if meta.get("kind") == "object":
-            continue  # scalars/hyperparams keep their constructed values
+            # restore scalars/hyperparams (LR last_epoch, step counters) by
+            # writing back into the nested container that owns the key
+            stored = files.get(meta.get("file", "data_0.pkl"))[meta.get("key", name)]
+            _set_by_path(state_dict, name, stored)
+            continue
         global_np = _assemble_global(meta, files)
         if isinstance(dst, Tensor):
             arr = dst._data

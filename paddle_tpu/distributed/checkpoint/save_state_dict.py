@@ -58,7 +58,8 @@ def save_state_dict(state_dict: dict, path: str, process_group=None, coordinator
         arr = _as_array(value)
         if not isinstance(arr, jax.Array):
             # python scalar / numpy / opt hyperparam: coordinator writes it
-            plan[name] = {"kind": "object"}
+            plan[name] = {"kind": "object", "file": f"data_{proc}.pkl",
+                          "key": name}
             payload[name] = np.asarray(arr) if isinstance(arr, np.ndarray) else arr
             continue
         shards_meta = []

@@ -1,0 +1,33 @@
+"""paddle.distribution parity: probability distributions over jnp densities.
+
+Parity target: python/paddle/distribution/__init__.py (18 families +
+transforms + KL registry). See distribution.py / families.py / transform.py /
+kl.py for the TPU-native design notes.
+"""
+from . import transform
+from .distribution import Distribution, ExponentialFamily
+from .families import (
+    Bernoulli, Beta, Binomial, Categorical, Cauchy, ContinuousBernoulli,
+    Dirichlet, Exponential, Gamma, Geometric, Gumbel, Laplace, LogNormal,
+    Multinomial, MultivariateNormal, Normal, Poisson, Uniform,
+)
+from .kl import kl_divergence, register_kl
+from .transform import (
+    AbsTransform, AffineTransform, ChainTransform, ExpTransform,
+    IndependentTransform, PowerTransform, ReshapeTransform, SigmoidTransform,
+    SoftmaxTransform, StackTransform, StickBreakingTransform, TanhTransform,
+    Transform,
+)
+from .transformed_distribution import Independent, TransformedDistribution
+
+__all__ = [
+    "Distribution", "ExponentialFamily", "Bernoulli", "Beta", "Binomial",
+    "Categorical", "Cauchy", "ContinuousBernoulli", "Dirichlet", "Exponential",
+    "Gamma", "Geometric", "Gumbel", "Independent", "Laplace", "LogNormal",
+    "Multinomial", "MultivariateNormal", "Normal", "Poisson",
+    "TransformedDistribution", "Uniform", "kl_divergence", "register_kl",
+    "transform", "Transform", "AbsTransform", "AffineTransform",
+    "ChainTransform", "ExpTransform", "IndependentTransform", "PowerTransform",
+    "ReshapeTransform", "SigmoidTransform", "SoftmaxTransform",
+    "StackTransform", "StickBreakingTransform", "TanhTransform",
+]

@@ -1,0 +1,210 @@
+"""Distribution base classes.
+
+Parity target: paddle.distribution.Distribution / ExponentialFamily
+(reference: python/paddle/distribution/distribution.py:46,
+exponential_family.py:22). TPU-native design: every density is a pure
+jnp function of its parameters, so distributions compose with jit/vmap/grad
+for free; sampling draws keys from the framework Generator (traced-key aware),
+and ExponentialFamily entropy uses the Bregman identity with jax.grad on the
+log-normalizer instead of hand-derived formulas.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import config
+from ..framework.random import default_generator
+from ..tensor.tensor import Tensor
+
+__all__ = ["Distribution", "ExponentialFamily"]
+
+
+def _as_jnp(x, dtype=None):
+    """Coerce Tensor / array / python scalar to a jnp array (float default)."""
+    if isinstance(x, Tensor):
+        x = x._data
+    if isinstance(x, (jax.Array, jax.core.Tracer)):
+        return x if dtype is None else x.astype(dtype)
+    arr = np.asarray(x)
+    if dtype is None and arr.dtype in (np.float64, np.int64, np.int32):
+        if np.issubdtype(arr.dtype, np.floating) or np.issubdtype(arr.dtype, np.integer):
+            dtype = config.get_default_dtype()
+    return jnp.asarray(arr, dtype=dtype)
+
+
+def _wrap(x) -> Tensor:
+    return Tensor(x)
+
+
+def _next_key():
+    return default_generator.next_key()
+
+
+# Methods auto-wrapped so gradients flow to Tensor-valued ctor params (and to
+# Tensor `value` args). The density formulas stay raw-jnp; the wrapper swaps
+# traced parameter values in via _set_params under one recorded GradNode.
+_GRAPHED_METHODS = ("rsample", "sample", "log_prob", "prob", "entropy",
+                    "cdf", "icdf")
+
+
+def _graph_wrap(method):
+    import functools
+
+    @functools.wraps(method)
+    def wrapper(self, *args, **kwargs):
+        orig = getattr(self, "_orig_params", None)
+        # Reentrancy guard: inside a graphed call the params are already the
+        # traced values; nested wrapped methods must run plain (e.g.
+        # LogNormal.log_prob -> super().log_prob).
+        if not orig or getattr(self, "_in_graph_call", False):
+            return method.__get__(self)(*args, **kwargs)
+        from ..autograd.engine import apply_op
+
+        names = list(orig)
+        ctr_box = {}
+
+        def pure(vals, *call_args, **call_kwargs):
+            # Re-traces (higher-order grad) must redraw identical noise:
+            # pin the generator counter to its value at first entry.
+            if "ctr" not in ctr_box:
+                ctr_box["ctr"] = default_generator._counter
+            default_generator._counter = ctr_box["ctr"]
+            saved = {n: getattr(self, n) for n in self._swap_attrs()}
+            try:
+                self._in_graph_call = True
+                self._set_params(**dict(zip(names, vals)))
+                out = method.__get__(self)(*call_args, **call_kwargs)
+                return out._data if isinstance(out, Tensor) else out
+            finally:
+                self._in_graph_call = False
+                for n, v in saved.items():
+                    setattr(self, n, v)
+
+        return apply_op(
+            f"{type(self).__name__}.{method.__name__}", pure,
+            tuple(orig.values()), *args, **kwargs)
+
+    wrapper._graphed = True
+    return wrapper
+
+
+class Distribution:
+    """Abstract base. Subclasses implement sample/log_prob/entropy over jnp."""
+
+    # attribute names assigned by _set_params (default: the ctor param names)
+    _PARAM_ATTRS: tuple = ()
+
+    def __init_subclass__(cls, **kwargs):
+        super().__init_subclass__(**kwargs)
+        for name in _GRAPHED_METHODS:
+            fn = cls.__dict__.get(name)
+            if callable(fn) and not getattr(fn, "_graphed", False):
+                setattr(cls, name, _graph_wrap(fn))
+
+    def _store_params(self, **ctor_args):
+        """Record differentiable Tensor ctor args for graph-aware methods."""
+        diff = {k: v for k, v in ctor_args.items()
+                if isinstance(v, Tensor) and not v.stop_gradient}
+        if diff:
+            self._orig_params = diff
+
+    def _swap_attrs(self):
+        return self._PARAM_ATTRS or tuple(getattr(self, "_orig_params", {}))
+
+    def _set_params(self, **vals):
+        for k, v in vals.items():
+            setattr(self, k, v)
+
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(int(d) for d in batch_shape)
+        self._event_shape = tuple(int(d) for d in event_shape)
+
+    @property
+    def batch_shape(self):
+        return list(self._batch_shape)
+
+    @property
+    def event_shape(self):
+        return list(self._event_shape)
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    @property
+    def stddev(self):
+        return _wrap(jnp.sqrt(_as_jnp(self.variance)))
+
+    def _extend_shape(self, sample_shape):
+        return tuple(sample_shape) + self._batch_shape + self._event_shape
+
+    def sample(self, shape=()):
+        """Draw (non-differentiable) samples of shape + batch + event."""
+        return _wrap(jax.lax.stop_gradient(_as_jnp(self.rsample(shape))))
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return _wrap(jnp.exp(_as_jnp(self.log_prob(value))))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def cdf(self, value):
+        raise NotImplementedError
+
+    def icdf(self, value):
+        raise NotImplementedError
+
+    def kl_divergence(self, other: "Distribution"):
+        from .kl import kl_divergence
+
+        return kl_divergence(self, other)
+
+    def _validate_value(self, value):
+        return _as_jnp(value)
+
+    def __repr__(self):
+        return f"{type(self).__name__}(batch_shape={self.batch_shape}, event_shape={self.event_shape})"
+
+
+class ExponentialFamily(Distribution):
+    """p(x) = h(x) exp(<eta, T(x)> - A(eta)).
+
+    Entropy falls out of the Bregman identity
+    H = A(eta) - <eta, grad A(eta)> + E[log h(x)] — computed with jax.grad on
+    `_log_normalizer` (reference derives this by hand per family;
+    exponential_family.py:40 uses autograd the same way).
+    """
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        raise NotImplementedError
+
+    def entropy(self):
+        nparams = [_as_jnp(p) for p in self._natural_parameters]
+        # grad of sum(A) is elementwise-correct because A is separable per batch
+        grads = jax.grad(lambda ps: jnp.sum(self._log_normalizer(*ps)))(tuple(nparams))
+        ent = -self._mean_carrier_measure + self._log_normalizer(*nparams)
+        for p, g in zip(nparams, grads):
+            ent = ent - p * g
+        return _wrap(ent)

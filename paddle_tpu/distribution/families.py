@@ -1,0 +1,857 @@
+"""Concrete distribution families.
+
+Parity target: the per-family modules under python/paddle/distribution/
+(normal.py, uniform.py, bernoulli.py, categorical.py, beta.py, dirichlet.py,
+exponential.py, gamma.py, geometric.py, gumbel.py, laplace.py, lognormal.py,
+multinomial.py, multivariate_normal.py, poisson.py, binomial.py, cauchy.py,
+continuous_bernoulli.py). TPU-native: densities are jnp formulas (jit/vmap
+composable), sampling uses jax.random with keys from the framework Generator,
+reparameterized rsample wherever the underlying sampler is differentiable.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.scipy import special as jsp
+
+from .distribution import Distribution, ExponentialFamily, _as_jnp, _next_key, _wrap
+
+__all__ = [
+    "Normal", "Uniform", "Bernoulli", "ContinuousBernoulli", "Categorical",
+    "Beta", "Binomial", "Cauchy", "Dirichlet", "Exponential", "Gamma",
+    "Geometric", "Gumbel", "Laplace", "LogNormal", "Multinomial",
+    "MultivariateNormal", "Poisson",
+]
+
+
+def _broadcast_shapes(*arrs):
+    return jnp.broadcast_shapes(*[jnp.shape(a) for a in arrs])
+
+
+class Normal(ExponentialFamily):
+    _PARAM_ATTRS = ("loc", "scale")
+
+    def __init__(self, loc, scale, name=None):
+        self._store_params(loc=loc, scale=scale)
+        self.loc = _as_jnp(loc)
+        self.scale = _as_jnp(scale)
+        super().__init__(batch_shape=_broadcast_shapes(self.loc, self.scale))
+
+    @property
+    def mean(self):
+        return _wrap(jnp.broadcast_to(self.loc, self._batch_shape))
+
+    @property
+    def variance(self):
+        return _wrap(jnp.broadcast_to(self.scale**2, self._batch_shape))
+
+    def rsample(self, shape=()):
+        eps = jax.random.normal(_next_key(), self._extend_shape(shape), self.loc.dtype)
+        return _wrap(self.loc + self.scale * eps)
+
+    def log_prob(self, value):
+        v = self._validate_value(value)
+        var = self.scale**2
+        return _wrap(-((v - self.loc) ** 2) / (2 * var)
+                     - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def entropy(self):
+        h = 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale)
+        return _wrap(jnp.broadcast_to(h, self._batch_shape))
+
+    def cdf(self, value):
+        v = self._validate_value(value)
+        return _wrap(0.5 * (1 + jsp.erf((v - self.loc) / (self.scale * math.sqrt(2)))))
+
+    def icdf(self, value):
+        v = self._validate_value(value)
+        return _wrap(self.loc + self.scale * math.sqrt(2) * jsp.erfinv(2 * v - 1))
+
+    @property
+    def _natural_parameters(self):
+        return (self.loc / (self.scale**2), -0.5 / (self.scale**2))
+
+    def _log_normalizer(self, x, y):
+        return -0.25 * x**2 / y + 0.5 * jnp.log(-math.pi / y)
+
+    @property
+    def _mean_carrier_measure(self):
+        return 0.0
+
+    def probs(self, value):  # paddle legacy alias
+        return self.prob(value)
+
+
+class LogNormal(Normal):
+    """exp(Normal(loc, scale)); shares Normal's base measure via transform."""
+
+    @property
+    def mean(self):
+        return _wrap(jnp.broadcast_to(jnp.exp(self.loc + self.scale**2 / 2),
+                                      self._batch_shape))
+
+    @property
+    def variance(self):
+        s2 = self.scale**2
+        return _wrap(jnp.broadcast_to((jnp.exp(s2) - 1) * jnp.exp(2 * self.loc + s2),
+                                      self._batch_shape))
+
+    def rsample(self, shape=()):
+        return _wrap(jnp.exp(_as_jnp(super().rsample(shape))))
+
+    def log_prob(self, value):
+        v = self._validate_value(value)
+        logv = jnp.log(v)
+        return _wrap(_as_jnp(super().log_prob(logv)) - logv)
+
+    def entropy(self):
+        return _wrap(_as_jnp(super().entropy()) + self.loc)
+
+    def cdf(self, value):
+        return super().cdf(jnp.log(self._validate_value(value)))
+
+
+class Uniform(Distribution):
+    _PARAM_ATTRS = ("low", "high")
+
+    def __init__(self, low, high, name=None):
+        self._store_params(low=low, high=high)
+        self.low = _as_jnp(low)
+        self.high = _as_jnp(high)
+        super().__init__(batch_shape=_broadcast_shapes(self.low, self.high))
+
+    @property
+    def mean(self):
+        return _wrap(jnp.broadcast_to((self.low + self.high) / 2, self._batch_shape))
+
+    @property
+    def variance(self):
+        return _wrap(jnp.broadcast_to((self.high - self.low) ** 2 / 12, self._batch_shape))
+
+    def rsample(self, shape=()):
+        u = jax.random.uniform(_next_key(), self._extend_shape(shape), self.low.dtype)
+        return _wrap(self.low + (self.high - self.low) * u)
+
+    def log_prob(self, value):
+        v = self._validate_value(value)
+        inside = (v >= self.low) & (v < self.high)
+        lp = -jnp.log(self.high - self.low)
+        return _wrap(jnp.where(inside, lp, -jnp.inf))
+
+    def entropy(self):
+        return _wrap(jnp.broadcast_to(jnp.log(self.high - self.low), self._batch_shape))
+
+    def cdf(self, value):
+        v = self._validate_value(value)
+        return _wrap(jnp.clip((v - self.low) / (self.high - self.low), 0.0, 1.0))
+
+    def icdf(self, value):
+        v = self._validate_value(value)
+        return _wrap(self.low + v * (self.high - self.low))
+
+
+class Bernoulli(ExponentialFamily):
+    _PARAM_ATTRS = ("probs", "logits")
+
+    def __init__(self, probs=None, logits=None, name=None):
+        if (probs is None) == (logits is None):
+            raise ValueError("pass exactly one of probs/logits")
+        if probs is not None:
+            self._store_params(probs=probs)
+            self._set_params(probs=_as_jnp(probs))
+        else:
+            self._store_params(logits=logits)
+            self._set_params(logits=_as_jnp(logits))
+        super().__init__(batch_shape=jnp.shape(self.probs))
+
+    def _set_params(self, probs=None, logits=None):
+        if probs is not None:
+            self.probs = probs
+            self.logits = jnp.log(probs) - jnp.log1p(-probs)
+        else:
+            self.logits = logits
+            self.probs = jax.nn.sigmoid(logits)
+
+    @property
+    def mean(self):
+        return _wrap(self.probs)
+
+    @property
+    def variance(self):
+        return _wrap(self.probs * (1 - self.probs))
+
+    def sample(self, shape=()):
+        s = jax.random.bernoulli(_next_key(), self.probs, self._extend_shape(shape))
+        return _wrap(s.astype(self.probs.dtype))
+
+    def rsample(self, shape=(), temperature=1.0):
+        """Gumbel-softmax relaxation (reference bernoulli.py rsample)."""
+        u = jax.random.uniform(
+            _next_key(), self._extend_shape(shape), self.probs.dtype,
+            minval=1e-6, maxval=1.0 - 1e-6)
+        logistic = jnp.log(u) - jnp.log1p(-u)
+        return _wrap(jax.nn.sigmoid((self.logits + logistic) / temperature))
+
+    def log_prob(self, value):
+        v = self._validate_value(value).astype(self.probs.dtype)
+        # -softplus(-logits)*v - softplus(logits)*(1-v), numerically stable
+        return _wrap(v * -jax.nn.softplus(-self.logits)
+                     + (1 - v) * -jax.nn.softplus(self.logits))
+
+    def entropy(self):
+        p = self.probs
+        return _wrap(-(jnp.where(p > 0, p * jnp.log(p), 0.0)
+                       + jnp.where(p < 1, (1 - p) * jnp.log1p(-p), 0.0)))
+
+    def cdf(self, value):
+        v = self._validate_value(value)
+        out = jnp.where(v < 0, 0.0, jnp.where(v < 1, 1 - self.probs, 1.0))
+        return _wrap(out.astype(self.probs.dtype))
+
+    @property
+    def _natural_parameters(self):
+        return (self.logits,)
+
+    def _log_normalizer(self, x):
+        return jax.nn.softplus(x)
+
+    @property
+    def _mean_carrier_measure(self):
+        return 0.0
+
+
+class ContinuousBernoulli(Distribution):
+    """CB(lambda) — continuous relaxation on [0,1] (reference continuous_bernoulli.py)."""
+
+    _PARAM_ATTRS = ("probs",)
+
+    def __init__(self, probs, lims=(0.499, 0.501), name=None):
+        self._store_params(probs=probs)
+        self.probs = _as_jnp(probs)
+        self._lims = lims
+        super().__init__(batch_shape=jnp.shape(self.probs))
+
+    def _outside(self):
+        lo, hi = self._lims
+        return (self.probs < lo) | (self.probs > hi)
+
+    def _cut_probs(self):
+        lo, hi = self._lims
+        return jnp.where(self._outside(), self.probs, lo * jnp.ones_like(self.probs))
+
+    @property
+    def mean(self):
+        cp = self._cut_probs()
+        m = cp / (2 * cp - 1) + 1 / (2 * jnp.arctanh(1 - 2 * cp))
+        return _wrap(jnp.where(self._outside(), m, 0.5 + (self.probs - 0.5) / 3))
+
+    @property
+    def variance(self):
+        cp = self._cut_probs()
+        v = cp * (cp - 1) / (1 - 2 * cp) ** 2 + 1 / (2 * jnp.arctanh(1 - 2 * cp)) ** 2
+        return _wrap(jnp.where(self._outside(), v, 1 / 12 - (self.probs - 0.5) ** 2 / 3))
+
+    def rsample(self, shape=()):
+        u = jax.random.uniform(_next_key(), self._extend_shape(shape),
+                               self.probs.dtype, minval=1e-6, maxval=1 - 1e-6)
+        return self.icdf(u)
+
+    def log_prob(self, value):
+        v = self._validate_value(value)
+        bern = v * jnp.log(jnp.clip(self.probs, 1e-6)) \
+            + (1 - v) * jnp.log(jnp.clip(1 - self.probs, 1e-6))
+        return _wrap(bern + self._log_const())
+
+    def _log_const(self):
+        cp = self._cut_probs()
+        out = jnp.log(2 * jnp.abs(jnp.arctanh(1 - 2 * cp)) / jnp.abs(1 - 2 * cp))
+        taylor = math.log(2.0) + 4 / 3 * (self.probs - 0.5) ** 2 \
+            + 104 / 45 * (self.probs - 0.5) ** 4
+        return jnp.where(self._outside(), out, taylor)
+
+    def cdf(self, value):
+        v = self._validate_value(value)
+        cp = self._cut_probs()
+        unnorm = (cp**v * (1 - cp) ** (1 - v) + cp - 1) / (2 * cp - 1)
+        return _wrap(jnp.clip(jnp.where(self._outside(), unnorm, v), 0.0, 1.0))
+
+    def icdf(self, value):
+        v = self._validate_value(value)
+        cp = self._cut_probs()
+        num = jnp.log1p(v * (2 * cp - 1) / (1 - cp))
+        den = jnp.log(cp) - jnp.log1p(-cp)
+        return _wrap(jnp.where(self._outside(), num / den, v))
+
+    def entropy(self):
+        # E[-log p(x)] = -(lambda-dependent closed form); use mean identity
+        m = _as_jnp(self.mean)
+        p = jnp.clip(self.probs, 1e-6, 1 - 1e-6)
+        return _wrap(-(m * jnp.log(p) + (1 - m) * jnp.log1p(-p)) - self._log_const())
+
+
+class Categorical(Distribution):
+    _PARAM_ATTRS = ("logits", "probs")
+
+    def __init__(self, logits=None, probs=None, name=None):
+        # paddle's Categorical(logits) accepts unnormalized nonneg weights OR logits;
+        # we follow the reference: the first positional arg is `logits`.
+        if logits is not None:
+            self._store_params(logits=logits)
+            self._set_params(logits=_as_jnp(logits))
+        else:
+            self._store_params(probs=probs)
+            self._set_params(probs=_as_jnp(probs))
+        super().__init__(batch_shape=jnp.shape(self.probs)[:-1])
+        self._num_events = jnp.shape(self.probs)[-1]
+
+    def _set_params(self, logits=None, probs=None):
+        if logits is not None:
+            self.logits = logits
+            self.probs = jax.nn.softmax(logits, axis=-1)
+        else:
+            self.probs = probs / jnp.sum(probs, -1, keepdims=True)
+            self.logits = jnp.log(jnp.clip(self.probs, 1e-38))
+
+    @property
+    def mean(self):
+        raise NotImplementedError("Categorical has no mean")
+
+    def sample(self, shape=()):
+        out_shape = tuple(shape) + self._batch_shape
+        s = jax.random.categorical(_next_key(), self.logits, axis=-1, shape=out_shape)
+        return _wrap(s.astype(jnp.int64 if jax.config.jax_enable_x64 else jnp.int32))
+
+    def log_prob(self, value):
+        v = self._validate_value(value).astype(jnp.int32)
+        logp = jax.nn.log_softmax(self.logits, axis=-1)
+        return _wrap(jnp.take_along_axis(
+            jnp.broadcast_to(logp, jnp.shape(v) + (self._num_events,)),
+            v[..., None], axis=-1)[..., 0])
+
+    def probs_of(self, value):
+        return _wrap(jnp.exp(_as_jnp(self.log_prob(value))))
+
+    def entropy(self):
+        logp = jax.nn.log_softmax(self.logits, axis=-1)
+        return _wrap(-jnp.sum(jnp.exp(logp) * logp, axis=-1))
+
+
+class Beta(ExponentialFamily):
+    _PARAM_ATTRS = ("alpha", "beta")
+
+    def __init__(self, alpha, beta, name=None):
+        self._store_params(alpha=alpha, beta=beta)
+        self.alpha = _as_jnp(alpha)
+        self.beta = _as_jnp(beta)
+        super().__init__(batch_shape=_broadcast_shapes(self.alpha, self.beta))
+
+    @property
+    def mean(self):
+        return _wrap(self.alpha / (self.alpha + self.beta))
+
+    @property
+    def variance(self):
+        s = self.alpha + self.beta
+        return _wrap(self.alpha * self.beta / (s**2 * (s + 1)))
+
+    def rsample(self, shape=()):
+        return _wrap(jax.random.beta(_next_key(), self.alpha, self.beta,
+                                     self._extend_shape(shape)))
+
+    def log_prob(self, value):
+        v = self._validate_value(value)
+        return _wrap((self.alpha - 1) * jnp.log(v) + (self.beta - 1) * jnp.log1p(-v)
+                     - _log_beta(self.alpha, self.beta))
+
+    def entropy(self):
+        a, b = self.alpha, self.beta
+        return _wrap(_log_beta(a, b) - (a - 1) * jsp.digamma(a)
+                     - (b - 1) * jsp.digamma(b)
+                     + (a + b - 2) * jsp.digamma(a + b))
+
+
+def _log_beta(a, b):
+    return jsp.gammaln(a) + jsp.gammaln(b) - jsp.gammaln(a + b)
+
+
+class Dirichlet(ExponentialFamily):
+    _PARAM_ATTRS = ("concentration",)
+
+    def __init__(self, concentration, name=None):
+        self._store_params(concentration=concentration)
+        self.concentration = _as_jnp(concentration)
+        shape = jnp.shape(self.concentration)
+        super().__init__(batch_shape=shape[:-1], event_shape=shape[-1:])
+
+    @property
+    def mean(self):
+        return _wrap(self.concentration
+                     / jnp.sum(self.concentration, -1, keepdims=True))
+
+    @property
+    def variance(self):
+        a0 = jnp.sum(self.concentration, -1, keepdims=True)
+        m = self.concentration / a0
+        return _wrap(m * (1 - m) / (a0 + 1))
+
+    def rsample(self, shape=()):
+        # jax.random.dirichlet broadcasts alpha over leading sample dims
+        out = jax.random.dirichlet(
+            _next_key(), self.concentration,
+            tuple(shape) + self._batch_shape)
+        return _wrap(out)
+
+    def log_prob(self, value):
+        v = self._validate_value(value)
+        a = self.concentration
+        return _wrap(jnp.sum((a - 1) * jnp.log(v), -1)
+                     + jsp.gammaln(jnp.sum(a, -1))
+                     - jnp.sum(jsp.gammaln(a), -1))
+
+    def entropy(self):
+        a = self.concentration
+        a0 = jnp.sum(a, -1)
+        k = a.shape[-1]
+        return _wrap(jnp.sum(jsp.gammaln(a), -1) - jsp.gammaln(a0)
+                     + (a0 - k) * jsp.digamma(a0)
+                     - jnp.sum((a - 1) * jsp.digamma(a), -1))
+
+
+class Exponential(ExponentialFamily):
+    _PARAM_ATTRS = ("rate",)
+
+    def __init__(self, rate, name=None):
+        self._store_params(rate=rate)
+        self.rate = _as_jnp(rate)
+        super().__init__(batch_shape=jnp.shape(self.rate))
+
+    @property
+    def mean(self):
+        return _wrap(1.0 / self.rate)
+
+    @property
+    def variance(self):
+        return _wrap(1.0 / self.rate**2)
+
+    def rsample(self, shape=()):
+        e = jax.random.exponential(_next_key(), self._extend_shape(shape),
+                                   self.rate.dtype)
+        return _wrap(e / self.rate)
+
+    def log_prob(self, value):
+        v = self._validate_value(value)
+        return _wrap(jnp.log(self.rate) - self.rate * v)
+
+    def entropy(self):
+        return _wrap(1.0 - jnp.log(self.rate))
+
+    def cdf(self, value):
+        v = self._validate_value(value)
+        return _wrap(-jnp.expm1(-self.rate * v))
+
+    def icdf(self, value):
+        v = self._validate_value(value)
+        return _wrap(-jnp.log1p(-v) / self.rate)
+
+
+class Gamma(ExponentialFamily):
+    _PARAM_ATTRS = ("concentration", "rate")
+
+    def __init__(self, concentration, rate, name=None):
+        self._store_params(concentration=concentration, rate=rate)
+        self.concentration = _as_jnp(concentration)
+        self.rate = _as_jnp(rate)
+        super().__init__(batch_shape=_broadcast_shapes(self.concentration, self.rate))
+
+    @property
+    def mean(self):
+        return _wrap(self.concentration / self.rate)
+
+    @property
+    def variance(self):
+        return _wrap(self.concentration / self.rate**2)
+
+    def rsample(self, shape=()):
+        g = jax.random.gamma(_next_key(), self.concentration,
+                             self._extend_shape(shape))
+        return _wrap(g / self.rate)
+
+    def log_prob(self, value):
+        v = self._validate_value(value)
+        a, b = self.concentration, self.rate
+        return _wrap(a * jnp.log(b) + (a - 1) * jnp.log(v) - b * v - jsp.gammaln(a))
+
+    def entropy(self):
+        a, b = self.concentration, self.rate
+        return _wrap(a - jnp.log(b) + jsp.gammaln(a) + (1 - a) * jsp.digamma(a))
+
+
+class Geometric(Distribution):
+    """P(X=k) = (1-p)^k p, k in {0,1,2,...} (reference geometric.py)."""
+
+    _PARAM_ATTRS = ("probs",)
+
+    def __init__(self, probs, name=None):
+        self._store_params(probs=probs)
+        self.probs = _as_jnp(probs)
+        super().__init__(batch_shape=jnp.shape(self.probs))
+
+    @property
+    def mean(self):
+        return _wrap((1 - self.probs) / self.probs)
+
+    @property
+    def variance(self):
+        return _wrap((1 - self.probs) / self.probs**2)
+
+    @property
+    def stddev(self):
+        return _wrap(jnp.sqrt((1 - self.probs)) / self.probs)
+
+    def sample(self, shape=()):
+        u = jax.random.uniform(_next_key(), self._extend_shape(shape),
+                               self.probs.dtype, minval=1e-7)
+        return _wrap(jnp.floor(jnp.log(u) / jnp.log1p(-self.probs)))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = self._validate_value(value)
+        return _wrap(v * jnp.log1p(-self.probs) + jnp.log(self.probs))
+
+    def pmf(self, k):
+        return _wrap(jnp.exp(_as_jnp(self.log_prob(k))))
+
+    def log_pmf(self, k):
+        return self.log_prob(k)
+
+    def entropy(self):
+        p = self.probs
+        return _wrap(-(p * jnp.log(p) + (1 - p) * jnp.log1p(-p)) / p)
+
+    def cdf(self, value):
+        v = self._validate_value(value)
+        return _wrap(1 - jnp.power(1 - self.probs, jnp.floor(v) + 1))
+
+
+class Gumbel(Distribution):
+    _PARAM_ATTRS = ("loc", "scale")
+
+    def __init__(self, loc, scale, name=None):
+        self._store_params(loc=loc, scale=scale)
+        self.loc = _as_jnp(loc)
+        self.scale = _as_jnp(scale)
+        super().__init__(batch_shape=_broadcast_shapes(self.loc, self.scale))
+
+    @property
+    def mean(self):
+        return _wrap(self.loc + self.scale * jnp.euler_gamma)
+
+    @property
+    def variance(self):
+        return _wrap(math.pi**2 / 6 * self.scale**2)
+
+    @property
+    def stddev(self):
+        return _wrap(math.pi / math.sqrt(6) * self.scale)
+
+    def rsample(self, shape=()):
+        g = jax.random.gumbel(_next_key(), self._extend_shape(shape), self.loc.dtype)
+        return _wrap(self.loc + self.scale * g)
+
+    def log_prob(self, value):
+        v = self._validate_value(value)
+        z = (v - self.loc) / self.scale
+        return _wrap(-(z + jnp.exp(-z)) - jnp.log(self.scale))
+
+    def entropy(self):
+        return _wrap(jnp.log(self.scale) + 1 + jnp.euler_gamma
+                     + jnp.zeros(self._batch_shape))
+
+    def cdf(self, value):
+        v = self._validate_value(value)
+        return _wrap(jnp.exp(-jnp.exp(-(v - self.loc) / self.scale)))
+
+
+class Laplace(Distribution):
+    _PARAM_ATTRS = ("loc", "scale")
+
+    def __init__(self, loc, scale, name=None):
+        self._store_params(loc=loc, scale=scale)
+        self.loc = _as_jnp(loc)
+        self.scale = _as_jnp(scale)
+        super().__init__(batch_shape=_broadcast_shapes(self.loc, self.scale))
+
+    @property
+    def mean(self):
+        return _wrap(jnp.broadcast_to(self.loc, self._batch_shape))
+
+    @property
+    def variance(self):
+        return _wrap(2 * self.scale**2)
+
+    @property
+    def stddev(self):
+        return _wrap(math.sqrt(2) * self.scale)
+
+    def rsample(self, shape=()):
+        l = jax.random.laplace(_next_key(), self._extend_shape(shape), self.loc.dtype)
+        return _wrap(self.loc + self.scale * l)
+
+    def log_prob(self, value):
+        v = self._validate_value(value)
+        return _wrap(-jnp.abs(v - self.loc) / self.scale
+                     - jnp.log(2 * self.scale))
+
+    def entropy(self):
+        return _wrap(1 + jnp.log(2 * self.scale) + jnp.zeros(self._batch_shape))
+
+    def cdf(self, value):
+        v = self._validate_value(value)
+        z = (v - self.loc) / self.scale
+        return _wrap(0.5 - 0.5 * jnp.sign(z) * jnp.expm1(-jnp.abs(z)))
+
+    def icdf(self, value):
+        v = self._validate_value(value)
+        t = v - 0.5
+        return _wrap(self.loc - self.scale * jnp.sign(t) * jnp.log1p(-2 * jnp.abs(t)))
+
+
+class Cauchy(Distribution):
+    _PARAM_ATTRS = ("loc", "scale")
+
+    def __init__(self, loc, scale, name=None):
+        self._store_params(loc=loc, scale=scale)
+        self.loc = _as_jnp(loc)
+        self.scale = _as_jnp(scale)
+        super().__init__(batch_shape=_broadcast_shapes(self.loc, self.scale))
+
+    @property
+    def mean(self):
+        raise ValueError("Cauchy has no mean")
+
+    @property
+    def variance(self):
+        raise ValueError("Cauchy has no variance")
+
+    def rsample(self, shape=()):
+        c = jax.random.cauchy(_next_key(), self._extend_shape(shape), self.loc.dtype)
+        return _wrap(self.loc + self.scale * c)
+
+    def log_prob(self, value):
+        v = self._validate_value(value)
+        return _wrap(-math.log(math.pi) - jnp.log(self.scale)
+                     - jnp.log1p(((v - self.loc) / self.scale) ** 2))
+
+    def entropy(self):
+        return _wrap(jnp.log(4 * math.pi * self.scale) + jnp.zeros(self._batch_shape))
+
+    def cdf(self, value):
+        v = self._validate_value(value)
+        return _wrap(jnp.arctan((v - self.loc) / self.scale) / math.pi + 0.5)
+
+    def icdf(self, value):
+        v = self._validate_value(value)
+        return _wrap(self.loc + self.scale * jnp.tan(math.pi * (v - 0.5)))
+
+
+class Poisson(ExponentialFamily):
+    _PARAM_ATTRS = ("rate",)
+
+    def __init__(self, rate, name=None):
+        self._store_params(rate=rate)
+        self.rate = _as_jnp(rate)
+        super().__init__(batch_shape=jnp.shape(self.rate))
+
+    @property
+    def mean(self):
+        return _wrap(self.rate)
+
+    @property
+    def variance(self):
+        return _wrap(self.rate)
+
+    def sample(self, shape=()):
+        s = jax.random.poisson(_next_key(), self.rate, self._extend_shape(shape))
+        return _wrap(s.astype(self.rate.dtype))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = self._validate_value(value)
+        return _wrap(v * jnp.log(self.rate) - self.rate - jsp.gammaln(v + 1))
+
+    def entropy(self):
+        # series approximation consistent with reference (moment expansion)
+        r = self.rate
+        return _wrap(0.5 * jnp.log(2 * math.pi * math.e * r)
+                     - 1 / (12 * r) - 1 / (24 * r**2))
+
+
+class Binomial(Distribution):
+    _PARAM_ATTRS = ("probs",)
+
+    def __init__(self, total_count, probs, name=None):
+        self._store_params(probs=probs)
+        self.total_count = _as_jnp(total_count)
+        self.probs = _as_jnp(probs)
+        super().__init__(batch_shape=_broadcast_shapes(self.total_count, self.probs))
+
+    @property
+    def mean(self):
+        return _wrap(self.total_count * self.probs)
+
+    @property
+    def variance(self):
+        return _wrap(self.total_count * self.probs * (1 - self.probs))
+
+    def sample(self, shape=()):
+        s = jax.random.binomial(_next_key(), self.total_count, self.probs,
+                                shape=self._extend_shape(shape))
+        return _wrap(s.astype(self.probs.dtype))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = self._validate_value(value)
+        n, p = self.total_count, self.probs
+        log_comb = (jsp.gammaln(n + 1) - jsp.gammaln(v + 1)
+                    - jsp.gammaln(n - v + 1))
+        return _wrap(log_comb + v * jnp.log(p) + (n - v) * jnp.log1p(-p))
+
+    def entropy(self):
+        # exact by enumeration over support (total_count must be concrete)
+        n = int(jnp.max(self.total_count))
+        k = jnp.arange(n + 1, dtype=self.probs.dtype)
+        k = k.reshape((n + 1,) + (1,) * len(self._batch_shape))
+        lp = _as_jnp(self.log_prob(k))
+        valid = k <= self.total_count
+        return _wrap(-jnp.sum(jnp.where(valid, jnp.exp(lp) * lp, 0.0), axis=0))
+
+
+class Multinomial(Distribution):
+    _PARAM_ATTRS = ("probs",)
+
+    def __init__(self, total_count, probs, name=None):
+        self._store_params(probs=probs)
+        self.total_count = int(total_count)
+        self._set_params(probs=_as_jnp(probs))
+        shape = jnp.shape(self.probs)
+        super().__init__(batch_shape=shape[:-1], event_shape=shape[-1:])
+
+    def _set_params(self, probs):
+        self.probs = probs / jnp.sum(probs, -1, keepdims=True)
+
+    @property
+    def mean(self):
+        return _wrap(self.total_count * self.probs)
+
+    @property
+    def variance(self):
+        return _wrap(self.total_count * self.probs * (1 - self.probs))
+
+    def sample(self, shape=()):
+        logits = jnp.log(jnp.clip(self.probs, 1e-38))
+        k = self._event_shape[0]
+        draws = jax.random.categorical(
+            _next_key(), logits, axis=-1,
+            shape=(self.total_count,) + tuple(shape) + self._batch_shape)
+        onehot = jax.nn.one_hot(draws, k, dtype=self.probs.dtype)
+        return _wrap(jnp.sum(onehot, axis=0))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = self._validate_value(value)
+        logits = jnp.log(jnp.clip(self.probs, 1e-38))
+        return _wrap(jsp.gammaln(jnp.sum(v, -1) + 1)
+                     - jnp.sum(jsp.gammaln(v + 1), -1)
+                     + jnp.sum(v * logits, -1))
+
+    def entropy(self):
+        # upper-bound via sum of binomial marginal entropies (exact enumeration
+        # per category; the joint correction term is omitted as in practice)
+        p = jnp.clip(self.probs, 1e-9, 1 - 1e-9)
+        b = Binomial(jnp.full(p.shape, self.total_count, p.dtype), p)
+        return _wrap(jnp.sum(_as_jnp(b.entropy()), -1))
+
+
+class MultivariateNormal(Distribution):
+    _PARAM_ATTRS = ("loc", "_scale_tril")
+
+    def __init__(self, loc, covariance_matrix=None, precision_matrix=None,
+                 scale_tril=None, name=None):
+        if sum(x is not None for x in
+               (covariance_matrix, precision_matrix, scale_tril)) != 1:
+            raise ValueError("pass exactly one of covariance_matrix/"
+                             "precision_matrix/scale_tril")
+        self._mvn_form = ("scale_tril" if scale_tril is not None else
+                          "cov" if covariance_matrix is not None else "prec")
+        mat = (scale_tril if scale_tril is not None else
+               covariance_matrix if covariance_matrix is not None
+               else precision_matrix)
+        self._store_params(loc=loc, _mvn_mat=mat)
+        self._set_params(loc=_as_jnp(loc), _mvn_mat=_as_jnp(mat))
+        d = jnp.shape(self.loc)[-1]
+        batch = jnp.broadcast_shapes(jnp.shape(self.loc)[:-1],
+                                     jnp.shape(self._scale_tril)[:-2])
+        super().__init__(batch_shape=batch, event_shape=(d,))
+
+    def _set_params(self, loc=None, _mvn_mat=None):
+        if loc is not None:
+            self.loc = loc
+        if _mvn_mat is not None:
+            if self._mvn_form == "scale_tril":
+                self._scale_tril = _mvn_mat
+            elif self._mvn_form == "cov":
+                self._scale_tril = jnp.linalg.cholesky(_mvn_mat)
+            else:
+                self._scale_tril = jnp.linalg.cholesky(jnp.linalg.inv(_mvn_mat))
+
+    @property
+    def scale_tril(self):
+        return _wrap(self._scale_tril)
+
+    @property
+    def covariance_matrix(self):
+        L = self._scale_tril
+        return _wrap(L @ jnp.swapaxes(L, -1, -2))
+
+    @property
+    def precision_matrix(self):
+        return _wrap(jnp.linalg.inv(_as_jnp(self.covariance_matrix)))
+
+    @property
+    def mean(self):
+        return _wrap(jnp.broadcast_to(self.loc, self._batch_shape + self._event_shape))
+
+    @property
+    def variance(self):
+        var = jnp.sum(self._scale_tril**2, axis=-1)
+        return _wrap(jnp.broadcast_to(var, self._batch_shape + self._event_shape))
+
+    def rsample(self, shape=()):
+        eps = jax.random.normal(_next_key(), self._extend_shape(shape),
+                                self.loc.dtype)
+        return _wrap(self.loc + jnp.einsum("...ij,...j->...i", self._scale_tril, eps))
+
+    def log_prob(self, value):
+        v = self._validate_value(value)
+        diff = v - self.loc
+        y = jax.scipy.linalg.solve_triangular(
+            self._scale_tril, diff[..., None], lower=True)[..., 0]
+        half_log_det = jnp.sum(
+            jnp.log(jnp.diagonal(self._scale_tril, axis1=-2, axis2=-1)), -1)
+        d = self._event_shape[0]
+        return _wrap(-0.5 * jnp.sum(y**2, -1) - half_log_det
+                     - 0.5 * d * math.log(2 * math.pi))
+
+    def entropy(self):
+        half_log_det = jnp.sum(
+            jnp.log(jnp.diagonal(self._scale_tril, axis1=-2, axis2=-1)), -1)
+        d = self._event_shape[0]
+        return _wrap(jnp.broadcast_to(
+            0.5 * d * (1 + math.log(2 * math.pi)) + half_log_det,
+            self._batch_shape))

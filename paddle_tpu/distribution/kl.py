@@ -1,0 +1,204 @@
+"""KL divergence registry.
+
+Parity target: python/paddle/distribution/kl.py (register_kl + kl_divergence
+with MRO-based dispatch; _kl_expfamily_expfamily computes the Bregman
+divergence with autograd — here via jax.grad on the log-normalizer).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.scipy import special as jsp
+
+from .distribution import Distribution, ExponentialFamily, _as_jnp, _wrap
+from .families import (
+    Bernoulli, Beta, Categorical, Dirichlet, Exponential, Gamma, Geometric,
+    Gumbel, Laplace, LogNormal, Normal, Poisson, Uniform,
+)
+
+__all__ = ["register_kl", "kl_divergence"]
+
+_REGISTRY: dict[tuple[type, type], callable] = {}
+
+
+def register_kl(cls_p, cls_q):
+    def decorator(fn):
+        _REGISTRY[(cls_p, cls_q)] = fn
+        return fn
+
+    return decorator
+
+
+def _dispatch(type_p, type_q):
+    matches = [
+        (p, q) for (p, q) in _REGISTRY
+        if issubclass(type_p, p) and issubclass(type_q, q)
+    ]
+    if not matches:
+        return None
+    # most-derived match wins: smallest MRO index on both sides
+    def score(pq):
+        p, q = pq
+        return (type_p.__mro__.index(p), type_q.__mro__.index(q))
+    return _REGISTRY[min(matches, key=score)]
+
+
+def kl_divergence(p: Distribution, q: Distribution):
+    fn = _dispatch(type(p), type(q))
+    if fn is None:
+        raise NotImplementedError(
+            f"no KL registered for ({type(p).__name__}, {type(q).__name__})")
+    orig_p = getattr(p, "_orig_params", None) or {}
+    orig_q = getattr(q, "_orig_params", None) or {}
+    if not (orig_p or orig_q):
+        return fn(p, q)
+
+    # Record one GradNode so gradients flow to Tensor-valued params of either
+    # side (same swap mechanism as Distribution._graph_wrap).
+    from ..autograd.engine import apply_op
+    from ..tensor.tensor import Tensor
+
+    def pure(pvals, qvals):
+        saved_p = {n: getattr(p, n) for n in p._swap_attrs()} if orig_p else {}
+        saved_q = {n: getattr(q, n) for n in q._swap_attrs()} if orig_q else {}
+        try:
+            if orig_p:
+                p._in_graph_call = True
+                p._set_params(**dict(zip(orig_p, pvals)))
+            if orig_q:
+                q._in_graph_call = True
+                q._set_params(**dict(zip(orig_q, qvals)))
+            out = fn(p, q)
+            return out._data if isinstance(out, Tensor) else out
+        finally:
+            for obj, saved in ((p, saved_p), (q, saved_q)):
+                obj._in_graph_call = False
+                for n, v in saved.items():
+                    setattr(obj, n, v)
+
+    return apply_op(
+        f"kl_{type(p).__name__}_{type(q).__name__}", pure,
+        tuple(orig_p.values()), tuple(orig_q.values()))
+
+
+@register_kl(Normal, Normal)
+def _kl_normal_normal(p, q):
+    var_ratio = (p.scale / q.scale) ** 2
+    t1 = ((p.loc - q.loc) / q.scale) ** 2
+    return _wrap(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+
+
+@register_kl(LogNormal, LogNormal)
+def _kl_lognormal(p, q):
+    return _kl_normal_normal(p, q)
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform_uniform(p, q):
+    result = jnp.log((q.high - q.low) / (p.high - p.low))
+    outside = (q.low > p.low) | (q.high < p.high)
+    return _wrap(jnp.where(outside, jnp.inf, result))
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli(p, q):
+    pp, qq = p.probs, q.probs
+    t1 = jnp.where(pp > 0, pp * (jnp.log(pp) - jnp.log(qq)), 0.0)
+    t2 = jnp.where(pp < 1, (1 - pp) * (jnp.log1p(-pp) - jnp.log1p(-qq)), 0.0)
+    return _wrap(t1 + t2)
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical(p, q):
+    logp = jax.nn.log_softmax(p.logits, -1)
+    logq = jax.nn.log_softmax(q.logits, -1)
+    return _wrap(jnp.sum(jnp.exp(logp) * (logp - logq), -1))
+
+
+@register_kl(Geometric, Geometric)
+def _kl_geometric(p, q):
+    # -H(p) - E_p[X] log(1-q) - log q, with E_p[X] = (1-p)/p
+    return _wrap(-_as_jnp(p.entropy())
+                 - (1 - p.probs) / p.probs * jnp.log1p(-q.probs)
+                 - jnp.log(q.probs))
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exponential(p, q):
+    ratio = q.rate / p.rate
+    return _wrap(jnp.log(1 / ratio) + ratio - 1)
+
+
+@register_kl(Gamma, Gamma)
+def _kl_gamma_gamma(p, q):
+    t1 = q.concentration * (jnp.log(p.rate) - jnp.log(q.rate))
+    t2 = jsp.gammaln(q.concentration) - jsp.gammaln(p.concentration)
+    t3 = (p.concentration - q.concentration) * jsp.digamma(p.concentration)
+    t4 = (q.rate - p.rate) * p.concentration / p.rate
+    return _wrap(t1 + t2 + t3 + t4)
+
+
+@register_kl(Beta, Beta)
+def _kl_beta_beta(p, q):
+    from .families import _log_beta
+
+    sp = p.alpha + p.beta
+    t1 = _log_beta(q.alpha, q.beta) - _log_beta(p.alpha, p.beta)
+    t2 = (p.alpha - q.alpha) * jsp.digamma(p.alpha)
+    t3 = (p.beta - q.beta) * jsp.digamma(p.beta)
+    t4 = (q.alpha - p.alpha + q.beta - p.beta) * jsp.digamma(sp)
+    return _wrap(t1 + t2 + t3 + t4)
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet(p, q):
+    a, b = p.concentration, q.concentration
+    a0 = jnp.sum(a, -1)
+    t1 = jsp.gammaln(a0) - jnp.sum(jsp.gammaln(a), -1)
+    t2 = -jsp.gammaln(jnp.sum(b, -1)) + jnp.sum(jsp.gammaln(b), -1)
+    t3 = jnp.sum((a - b) * (jsp.digamma(a) - jsp.digamma(a0[..., None])), -1)
+    return _wrap(t1 + t2 + t3)
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace(p, q):
+    scale_ratio = p.scale / q.scale
+    loc_abs = jnp.abs(p.loc - q.loc)
+    return _wrap(-jnp.log(scale_ratio) + loc_abs / q.scale
+                 + scale_ratio * jnp.exp(-loc_abs / p.scale) - 1)
+
+
+@register_kl(Poisson, Poisson)
+def _kl_poisson(p, q):
+    return _wrap(p.rate * (jnp.log(p.rate) - jnp.log(q.rate))
+                 - (p.rate - q.rate))
+
+
+@register_kl(Gumbel, Gumbel)
+def _kl_gumbel(p, q):
+    beta_ratio = p.scale / q.scale
+    loc_diff = (p.loc - q.loc) / q.scale
+    # KL = log(b2/b1) + g(b1/b2-1) + (m1-m2)/b2 + e^{(m2-m1)/b2} G(1+b1/b2) - 1
+    return _wrap(jnp.log(q.scale) - jnp.log(p.scale)
+                 + jnp.euler_gamma * (beta_ratio - 1)
+                 + loc_diff
+                 + jnp.exp(-loc_diff + jsp.gammaln(1 + beta_ratio)) - 1)
+
+
+@register_kl(ExponentialFamily, ExponentialFamily)
+def _kl_expfamily_expfamily(p, q):
+    """Bregman divergence of the log-normalizers (via jax.grad)."""
+    if type(p) is not type(q):
+        raise NotImplementedError(
+            "exp-family KL fallback requires matching families")
+    p_nat = tuple(_as_jnp(x) for x in p._natural_parameters)
+    q_nat = tuple(_as_jnp(x) for x in q._natural_parameters)
+    grads = jax.grad(lambda ps: jnp.sum(p._log_normalizer(*ps)))(p_nat)
+    lg_p_elem = p._log_normalizer(*p_nat)
+    lg_q_elem = q._log_normalizer(*q_nat)
+    kl = lg_q_elem - lg_p_elem
+    for pn, qn, g in zip(p_nat, q_nat, grads):
+        kl = kl - (qn - pn) * g
+    return _wrap(kl)

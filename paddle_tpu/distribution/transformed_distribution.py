@@ -1,0 +1,95 @@
+"""TransformedDistribution + Independent wrappers.
+
+Parity target: python/paddle/distribution/transformed_distribution.py,
+independent.py.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .distribution import Distribution, _as_jnp, _wrap
+from .transform import ChainTransform, Type
+
+__all__ = ["TransformedDistribution", "Independent"]
+
+
+class TransformedDistribution(Distribution):
+    def __init__(self, base, transforms):
+        if not isinstance(transforms, (list, tuple)):
+            transforms = [transforms]
+        self.base = base
+        self.transforms = list(transforms)
+        chain = ChainTransform(self.transforms)
+        base_shape = tuple(base.batch_shape) + tuple(base.event_shape)
+        out_shape = tuple(chain.forward_shape(base_shape))
+        event_dim = max(chain._codomain_event_dim, len(base.event_shape))
+        cut = len(out_shape) - event_dim
+        super().__init__(batch_shape=out_shape[:cut], event_shape=out_shape[cut:])
+        self._chain = chain
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)
+        return self._chain.forward(x)
+
+    def rsample(self, shape=()):
+        x = self.base.rsample(shape)
+        return self._chain.forward(x)
+
+    def log_prob(self, value):
+        if not Type.is_injective(self._chain._type):
+            raise TypeError("log_prob undefined for non-injective transforms")
+        y = _as_jnp(value)
+        lp = 0.0
+        event_dim = len(self._event_shape)
+        for t in reversed(self.transforms):
+            x = t._inverse(y)
+            ldj = t._forward_log_det_jacobian(x)
+            extra = event_dim - t._codomain_event_dim
+            if extra > 0:
+                ldj = jnp.sum(ldj, axis=tuple(range(-extra, 0)))
+            lp = lp - ldj
+            event_dim = event_dim - t._codomain_event_dim + t._domain_event_dim
+            y = x
+        base_lp = _as_jnp(self.base.log_prob(y))
+        extra = event_dim - len(self.base.event_shape)
+        if extra > 0:
+            base_lp = jnp.sum(base_lp, axis=tuple(range(-extra, 0)))
+        return _wrap(lp + base_lp)
+
+
+class Independent(Distribution):
+    """Reinterpret trailing batch dims of `base` as event dims."""
+
+    def __init__(self, base, reinterpreted_batch_rank: int):
+        self.base = base
+        self.reinterpreted_batch_rank = int(reinterpreted_batch_rank)
+        b = tuple(base.batch_shape)
+        k = self.reinterpreted_batch_rank
+        if k > len(b):
+            raise ValueError("reinterpreted_batch_rank exceeds base batch rank")
+        super().__init__(batch_shape=b[:len(b) - k],
+                         event_shape=b[len(b) - k:] + tuple(base.event_shape))
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+    @property
+    def variance(self):
+        return self.base.variance
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    def _reduce(self, x):
+        axes = tuple(range(-self.reinterpreted_batch_rank, 0))
+        return jnp.sum(_as_jnp(x), axis=axes) if axes else _as_jnp(x)
+
+    def log_prob(self, value):
+        return _wrap(self._reduce(self.base.log_prob(value)))
+
+    def entropy(self):
+        return _wrap(self._reduce(self.base.entropy()))

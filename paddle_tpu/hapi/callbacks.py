@@ -143,6 +143,12 @@ class EarlyStopping(Callback):
         self.wait = 0
         self.best = None
         self.stop_training = False
+        self.save_dir = None  # filled from fit(save_dir=...) via set_params
+
+    def set_params(self, params):
+        super().set_params(params)
+        if isinstance(params, dict) and params.get("save_dir"):
+            self.save_dir = params["save_dir"]
 
     def _better(self, cur, best):
         if best is None:
@@ -159,6 +165,8 @@ class EarlyStopping(Callback):
         if self._better(cur, self.best):
             self.best = cur
             self.wait = 0
+            if self.save_best_model and self.save_dir is not None:
+                self.model.save(os.path.join(self.save_dir, "best_model"))
         else:
             self.wait += 1
             if self.wait >= self.patience:

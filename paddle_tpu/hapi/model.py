@@ -101,26 +101,33 @@ class Model:
         return [o.numpy() for o in outputs]
 
     # --- loops -------------------------------------------------------------
-    def _make_loader(self, data, batch_size, shuffle):
+    def _make_loader(self, data, batch_size, shuffle, drop_last=False,
+                     num_workers=0):
         if data is None or isinstance(data, DataLoader):
             return data
-        return DataLoader(data, batch_size=batch_size, shuffle=shuffle)
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                          drop_last=drop_last, num_workers=num_workers)
 
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
             accumulate_grad_batches=1, num_iters=None):
-        loader = self._make_loader(train_data, batch_size, shuffle)
-        eval_loader = self._make_loader(eval_data, batch_size, False)
+        loader = self._make_loader(train_data, batch_size, shuffle,
+                                   drop_last=drop_last,
+                                   num_workers=num_workers)
+        eval_loader = self._make_loader(eval_data, batch_size, False,
+                                        num_workers=num_workers)
         cbks = _to_list(callbacks) or [ProgBarLogger(log_freq, verbose=verbose)]
         if save_dir:
             cbks.append(ModelCheckpoint(save_freq, save_dir))
         cb = CallbackList(cbks)
         cb.set_model(self)
-        cb.set_params({"epochs": epochs, "steps": len(loader), "verbose": verbose})
+        cb.set_params({"epochs": epochs, "steps": len(loader),
+                       "verbose": verbose, "save_dir": save_dir})
         self.stop_training = False
 
         cb.on_train_begin()
+        logs = {}
         for epoch in range(epochs):
             cb.on_epoch_begin(epoch)
             for m in self._metrics:

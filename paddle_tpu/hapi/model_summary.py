@@ -22,6 +22,9 @@ def summary(net: Layer, input_size=None, dtypes=None, input=None):
 
         hooks.append(layer.register_forward_post_hook(hook))
 
+    if input is None and input_size is None:
+        raise ValueError("summary needs input_size or input")
+
     for name, sub in net.named_sublayers():
         register(sub, name)
 

@@ -1,0 +1,100 @@
+"""paddle.utils parity: small host-side helpers.
+
+Parity target: python/paddle/utils/ (reference: deprecated.py, flops.py,
+unique_name.py, dlpack.py, install_check.py, lazy_import.py). TPU-native
+notes: dlpack rides jax's zero-copy dlpack exchange; install_check runs a
+tiny matmul+grad on the default device.
+"""
+from __future__ import annotations
+
+import functools
+import importlib
+import warnings
+
+from . import flops as _flops_mod
+from . import unique_name
+from .flops import flops
+
+__all__ = ["deprecated", "try_import", "unique_name", "flops", "run_check",
+           "to_dlpack", "from_dlpack"]
+
+
+def deprecated(update_to: str = "", since: str = "", reason: str = "", level: int = 0):
+    """Decorator emitting a DeprecationWarning on first call.
+
+    Parity: paddle.utils.deprecated (reference python/paddle/utils/deprecated.py).
+    """
+
+    def decorator(func):
+        msg = f"API '{func.__module__}.{func.__name__}' is deprecated"
+        if since:
+            msg += f" since {since}"
+        if update_to:
+            msg += f", use '{update_to}' instead"
+        if reason:
+            msg += f". Reason: {reason}"
+        if level == 2:
+            raise RuntimeError(msg)
+
+        @functools.wraps(func)
+        def wrapper(*args, **kwargs):
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return func(*args, **kwargs)
+
+        wrapper.__deprecated_message__ = msg
+        return wrapper
+
+    return decorator
+
+
+def try_import(module_name: str, err_msg: str | None = None):
+    """Import an optional dependency with a friendly error (lazy_import.py parity)."""
+    try:
+        return importlib.import_module(module_name)
+    except ImportError as e:
+        raise ImportError(
+            err_msg or f"Optional dependency {module_name!r} is required for this API"
+        ) from e
+
+
+def to_dlpack(tensor):
+    """Export a Tensor as a DLPack-capable object (dlpack.py parity; zero-copy).
+
+    Returns the underlying buffer exposing ``__dlpack__``/``__dlpack_device__``
+    (the modern DLPack exchange protocol) rather than a bare capsule, so any
+    consumer (numpy, torch, jax) can import it.
+    """
+    from ..tensor.tensor import Tensor
+
+    return tensor._data if isinstance(tensor, Tensor) else tensor
+
+
+def from_dlpack(capsule):
+    """Import a DLPack capsule as a Tensor."""
+    import jax.numpy as jnp
+
+    from ..tensor.tensor import Tensor
+
+    return Tensor(jnp.from_dlpack(capsule))
+
+
+def run_check():
+    """Install check: run a tiny matmul + backward on the default device.
+
+    Parity: paddle.utils.run_check (reference install_check.py) — prints the
+    device it verified.
+    """
+    import jax
+
+    import paddle_tpu as paddle
+
+    x = paddle.randn([4, 8])
+    x.stop_gradient = False
+    w = paddle.randn([8, 8])
+    w.stop_gradient = False
+    y = paddle.matmul(x, w).sum()
+    y.backward()
+    assert w.grad is not None and tuple(w.grad.shape) == (8, 8)
+    dev = jax.devices()[0]
+    print(f"paddle_tpu is installed successfully! Verified on {dev.platform}:{dev.id}.")
+    return True

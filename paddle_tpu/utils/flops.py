@@ -1,0 +1,148 @@
+"""Per-op FLOPs calculator (parity: python/paddle/utils/flops.py:27 `flops`).
+
+Registry of `op_type -> fn(input_shapes, attrs) -> int`. Used by the profiler
+summary and the bench MFU calculation. Shapes are plain lists; everything is
+host-side arithmetic.
+"""
+from __future__ import annotations
+
+import math
+
+_FLOPS_COMPUTERS: dict[str, callable] = {}
+
+
+def prod(s) -> int:
+    out = 1
+    for v in s:
+        out *= int(v)
+    return out
+
+
+def register_flops(op_type: str):
+    def decorator(fn):
+        _FLOPS_COMPUTERS[op_type] = fn
+        return fn
+
+    return decorator
+
+
+def flops(op_type: str, input_shapes: dict, attrs: dict | None = None) -> int:
+    """FLOPs of one op call. Returns 0 for unregistered ops (parity behavior)."""
+    fn = _FLOPS_COMPUTERS.get(op_type)
+    if fn is None:
+        return 0
+    return int(fn(input_shapes, attrs or {}))
+
+
+def _first(input_shapes, *keys):
+    for k in keys:
+        v = input_shapes.get(k)
+        if v:
+            return v[0] if isinstance(v[0], (list, tuple)) else v
+    return []
+
+
+@register_flops("matmul")
+@register_flops("matmul_v2")
+def _matmul_flops(input_shapes, attrs):
+    x = list(_first(input_shapes, "X", "x"))
+    y = list(_first(input_shapes, "Y", "y"))
+    if not x or not y:
+        return 0
+    if attrs.get("transpose_X") or attrs.get("trans_x"):
+        x[-1], x[-2] = x[-2], x[-1]
+    if attrs.get("transpose_Y") or attrs.get("trans_y"):
+        y[-1], y[-2] = y[-2], y[-1]
+    # batched [..., M, K] @ [..., K, N]: 2*M*K*N per batch element
+    batch = prod(x[:-2]) if len(x) > 2 else (prod(y[:-2]) if len(y) > 2 else 1)
+    m = x[-2] if len(x) >= 2 else 1
+    k = x[-1]
+    n = y[-1] if len(y) >= 2 else 1
+    return 2 * batch * m * k * n
+
+
+@register_flops("conv2d")
+def _conv2d_flops(input_shapes, attrs):
+    x = _first(input_shapes, "Input", "x")  # NCHW
+    w = _first(input_shapes, "Filter", "weight")  # OIHW
+    if len(x) != 4 or len(w) != 4:
+        return 0
+    strides = attrs.get("strides", [1, 1])
+    paddings = attrs.get("paddings", [0, 0])
+    dilations = attrs.get("dilations", [1, 1])
+    groups = attrs.get("groups", 1)
+    n, _, h, wd = x
+    co, ci_g, kh, kw = w
+    ho = (h + 2 * paddings[0] - dilations[0] * (kh - 1) - 1) // strides[0] + 1
+    wo = (wd + 2 * paddings[-1] - dilations[-1] * (kw - 1) - 1) // strides[-1] + 1
+    return 2 * n * co * ho * wo * ci_g * kh * kw // max(groups // groups, 1)
+
+
+@register_flops("c_embedding")
+@register_flops("embedding")
+def _embedding_flops(input_shapes, attrs):
+    return 0  # gather: no MACs
+
+
+@register_flops("layer_norm")
+def _layer_norm_flops(input_shapes, attrs):
+    x = _first(input_shapes, "X", "x")
+    return 8 * prod(x) if x else 0
+
+
+@register_flops("softmax")
+def _softmax_flops(input_shapes, attrs):
+    x = _first(input_shapes, "X", "x")
+    return 5 * prod(x) if x else 0
+
+
+@register_flops("gelu")
+def _gelu_flops(input_shapes, attrs):
+    x = _first(input_shapes, "X", "x")
+    return 8 * prod(x) if x else 0
+
+
+def _elementwise(input_shapes, attrs):
+    x = _first(input_shapes, "X", "x")
+    y = _first(input_shapes, "Y", "y")
+    if not x:
+        return prod(y) if y else 0
+    if not y:
+        return prod(x)
+    out = [max(a, b) for a, b in zip(
+        [1] * (max(len(x), len(y)) - len(x)) + list(x),
+        [1] * (max(len(x), len(y)) - len(y)) + list(y))]
+    return prod(out)
+
+
+for _name in ("elementwise_add", "elementwise_mul", "elementwise_div",
+              "elementwise_sub", "relu", "relu6", "elu", "leaky_relu",
+              "prelu", "silu", "sigmoid", "tanh", "dropout"):
+    register_flops(_name)(_elementwise)
+
+
+@register_flops("flash_attention")
+def _flash_attention_flops(input_shapes, attrs):
+    q = _first(input_shapes, "q", "Q")
+    if len(q) != 4:
+        return 0
+    b, s, h, d = q
+    causal = attrs.get("causal", False)
+    f = 4 * b * h * s * s * d  # QK^T + PV
+    return f // 2 if causal else f
+
+
+def attention_flops(batch: int, seq: int, heads: int, head_dim: int,
+                    causal: bool = True) -> int:
+    """Helper for MFU math in bench/profiler."""
+    f = 4 * batch * heads * seq * seq * head_dim
+    return f // 2 if causal else f
+
+
+def transformer_flops(batch: int, seq: int, hidden: int, layers: int,
+                      vocab: int, ffn_mult: int = 4, causal: bool = True) -> int:
+    """Approximate fwd FLOPs of a GPT block stack + LM head (6ND-style)."""
+    per_layer = 2 * seq * (4 * hidden * hidden + 2 * ffn_mult * hidden * hidden)
+    attn = 4 * seq * seq * hidden * (0.5 if causal else 1.0)
+    head = 2 * seq * hidden * vocab
+    return int(batch * (layers * (per_layer + attn) + head))

@@ -1,0 +1,44 @@
+"""Unique name generator (parity: python/paddle/utils/unique_name.py).
+
+Host-side only: names label parameters/layers; they never enter compiled
+programs, so a plain counter map is the whole design.
+"""
+from __future__ import annotations
+
+import contextlib
+
+
+class UniqueNameGenerator:
+    def __init__(self, prefix: str = ""):
+        self._prefix = prefix
+        self._ids: dict[str, int] = {}
+
+    def __call__(self, key: str) -> str:
+        n = self._ids.get(key, 0)
+        self._ids[key] = n + 1
+        return "_".join(filter(None, [self._prefix, key, str(n)]))
+
+
+_generator = UniqueNameGenerator()
+
+
+def generate(key: str) -> str:
+    return _generator(key)
+
+
+def switch(new_generator: UniqueNameGenerator | None = None) -> UniqueNameGenerator:
+    global _generator
+    old = _generator
+    _generator = new_generator or UniqueNameGenerator()
+    return old
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    if isinstance(new_generator, str):
+        new_generator = UniqueNameGenerator(new_generator)
+    old = switch(new_generator)
+    try:
+        yield
+    finally:
+        switch(old)

@@ -46,8 +46,11 @@ def resize(img, size, interpolation="bilinear"):
 
 
 def to_tensor(img, data_format="CHW"):
-    arr = _as_hwc(img).astype(np.float32)
-    if arr.dtype == np.float32 and arr.max() > 1.5:
+    arr = _as_hwc(img)
+    # rescale only integer (pixel-valued) input, never float (paddle parity)
+    rescale = np.issubdtype(arr.dtype, np.integer) or arr.dtype == np.bool_
+    arr = arr.astype(np.float32)
+    if rescale:
         arr = arr / 255.0
     if data_format == "CHW":
         arr = arr.transpose(2, 0, 1)
@@ -107,6 +110,7 @@ class RandomCrop(BaseTransform):
     def __init__(self, size, padding=None, pad_if_needed=False, keys=None):
         self.size = (size, size) if isinstance(size, numbers.Number) else size
         self.padding = padding
+        self.pad_if_needed = pad_if_needed
 
     def _apply_image(self, img):
         arr = _as_hwc(img)
@@ -115,6 +119,14 @@ class RandomCrop(BaseTransform):
             arr = np.pad(arr, ((p[1], p[3]), (p[0], p[2]), (0, 0)))
         h, w = arr.shape[:2]
         th, tw = self.size
+        if h < th or w < tw:
+            if not self.pad_if_needed:
+                raise ValueError(
+                    f"image size ({h}, {w}) smaller than crop size ({th}, {tw}); "
+                    "pass pad_if_needed=True")
+            ph, pw = max(0, th - h), max(0, tw - w)
+            arr = np.pad(arr, ((0, ph), (0, pw), (0, 0)))
+            h, w = arr.shape[:2]
         i = random.randint(0, max(0, h - th))
         j = random.randint(0, max(0, w - tw))
         return arr[i : i + th, j : j + tw]

@@ -1,0 +1,329 @@
+"""paddle.distribution parity tests (model: test/distribution/ in reference —
+log_prob/entropy vs scipy, KL vs closed forms, sample moments)."""
+import numpy as np
+import pytest
+import scipy.stats as st
+
+import paddle_tpu as paddle
+from paddle_tpu import distribution as D
+
+
+def npt(x):
+    return np.asarray(x)
+
+
+class TestNormal:
+    def test_log_prob_entropy_cdf(self):
+        loc, scale = np.array([0.0, 1.0]), np.array([1.0, 2.0])
+        d = D.Normal(loc, scale)
+        v = np.array([0.5, -1.0])
+        ref = st.norm(loc, scale)
+        np.testing.assert_allclose(npt(d.log_prob(v)), ref.logpdf(v), rtol=1e-5)
+        np.testing.assert_allclose(npt(d.entropy()), ref.entropy(), rtol=1e-5)
+        np.testing.assert_allclose(npt(d.cdf(v)), ref.cdf(v), rtol=1e-5)
+        np.testing.assert_allclose(npt(d.icdf(np.array([0.3, 0.7]))),
+                                   ref.ppf([0.3, 0.7]), rtol=1e-4)
+
+    def test_sample_moments(self):
+        paddle.seed(0)
+        d = D.Normal(2.0, 3.0)
+        s = npt(d.sample([20000]))
+        assert abs(s.mean() - 2.0) < 0.1
+        assert abs(s.std() - 3.0) < 0.1
+
+    def test_rsample_grad(self):
+        loc = paddle.to_tensor(1.0, stop_gradient=False)
+        d = D.Normal(loc, 1.0)
+        s = d.rsample([16])
+        s.sum().backward()
+        assert loc.grad is not None
+
+    def test_expfamily_entropy_matches(self):
+        d = D.Normal(np.array([0.0, 2.0]), np.array([1.0, 0.5]))
+        closed = npt(d.entropy())
+        bregman = npt(D.ExponentialFamily.entropy(d))
+        np.testing.assert_allclose(closed, bregman, rtol=1e-5)
+
+
+class TestFamilies:
+    def test_uniform(self):
+        d = D.Uniform(1.0, 3.0)
+        np.testing.assert_allclose(npt(d.entropy()), np.log(2.0), rtol=1e-6)
+        np.testing.assert_allclose(npt(d.log_prob(2.0)), -np.log(2.0), rtol=1e-6)
+        assert npt(d.log_prob(4.0)) == -np.inf
+        np.testing.assert_allclose(npt(d.mean), 2.0)
+
+    def test_bernoulli(self):
+        d = D.Bernoulli(probs=np.array([0.3, 0.7]))
+        ref = st.bernoulli([0.3, 0.7])
+        np.testing.assert_allclose(npt(d.log_prob(np.array([1.0, 0.0]))),
+                                   ref.logpmf([1, 0]), rtol=1e-5)
+        np.testing.assert_allclose(npt(d.entropy()), ref.entropy(), rtol=1e-5)
+
+    def test_categorical(self):
+        logits = np.log(np.array([0.2, 0.3, 0.5]))
+        d = D.Categorical(logits)
+        np.testing.assert_allclose(npt(d.log_prob(np.array([2]))),
+                                   [np.log(0.5)], rtol=1e-5)
+        np.testing.assert_allclose(npt(d.entropy()),
+                                   st.entropy([0.2, 0.3, 0.5]), rtol=1e-5)
+        paddle.seed(1)
+        s = npt(d.sample([5000]))
+        freq = np.bincount(s, minlength=3) / 5000
+        np.testing.assert_allclose(freq, [0.2, 0.3, 0.5], atol=0.03)
+
+    def test_beta_gamma_dirichlet(self):
+        b = D.Beta(2.0, 3.0)
+        np.testing.assert_allclose(npt(b.log_prob(0.4)),
+                                   st.beta(2, 3).logpdf(0.4), rtol=1e-5)
+        np.testing.assert_allclose(npt(b.entropy()), st.beta(2, 3).entropy(),
+                                   rtol=1e-5)
+        g = D.Gamma(2.0, 0.5)
+        np.testing.assert_allclose(npt(g.log_prob(3.0)),
+                                   st.gamma(2, scale=2.0).logpdf(3.0), rtol=1e-5)
+        np.testing.assert_allclose(npt(g.entropy()),
+                                   st.gamma(2, scale=2.0).entropy(), rtol=1e-5)
+        dd = D.Dirichlet(np.array([1.0, 2.0, 3.0]))
+        np.testing.assert_allclose(
+            npt(dd.log_prob(np.array([0.2, 0.3, 0.5]))),
+            st.dirichlet([1.0, 2.0, 3.0]).logpdf([0.2, 0.3, 0.5]), rtol=1e-5)
+        np.testing.assert_allclose(npt(dd.entropy()),
+                                   st.dirichlet([1.0, 2.0, 3.0]).entropy(),
+                                   rtol=1e-5)
+
+    def test_exponential_laplace_gumbel_cauchy(self):
+        e = D.Exponential(2.0)
+        np.testing.assert_allclose(npt(e.log_prob(1.5)),
+                                   st.expon(scale=0.5).logpdf(1.5), rtol=1e-5)
+        np.testing.assert_allclose(npt(e.cdf(1.5)),
+                                   st.expon(scale=0.5).cdf(1.5), rtol=1e-5)
+        l = D.Laplace(1.0, 2.0)
+        np.testing.assert_allclose(npt(l.log_prob(0.0)),
+                                   st.laplace(1, 2).logpdf(0.0), rtol=1e-5)
+        np.testing.assert_allclose(npt(l.icdf(0.8)),
+                                   st.laplace(1, 2).ppf(0.8), rtol=1e-5)
+        g = D.Gumbel(0.5, 2.0)
+        np.testing.assert_allclose(npt(g.log_prob(1.0)),
+                                   st.gumbel_r(0.5, 2).logpdf(1.0), rtol=1e-5)
+        np.testing.assert_allclose(npt(g.mean), st.gumbel_r(0.5, 2).mean(),
+                                   rtol=1e-5)
+        c = D.Cauchy(0.0, 1.0)
+        np.testing.assert_allclose(npt(c.log_prob(1.0)),
+                                   st.cauchy().logpdf(1.0), rtol=1e-5)
+        np.testing.assert_allclose(npt(c.cdf(1.0)), st.cauchy().cdf(1.0),
+                                   rtol=1e-5)
+
+    def test_discrete_counts(self):
+        p = D.Poisson(3.0)
+        np.testing.assert_allclose(npt(p.log_prob(2.0)),
+                                   st.poisson(3.0).logpmf(2), rtol=1e-5)
+        b = D.Binomial(10.0, 0.3)
+        np.testing.assert_allclose(npt(b.log_prob(4.0)),
+                                   st.binom(10, 0.3).logpmf(4), rtol=1e-5)
+        np.testing.assert_allclose(npt(b.entropy()),
+                                   st.binom(10, 0.3).entropy(), rtol=1e-4)
+        g = D.Geometric(0.4)
+        # paddle Geometric counts failures (support {0,1,...}); scipy's counts
+        # trials (support {1,...})
+        np.testing.assert_allclose(npt(g.log_prob(3.0)),
+                                   st.geom(0.4).logpmf(4), rtol=1e-5)
+        m = D.Multinomial(5, np.array([0.2, 0.3, 0.5]))
+        np.testing.assert_allclose(
+            npt(m.log_prob(np.array([1.0, 2.0, 2.0]))),
+            st.multinomial(5, [0.2, 0.3, 0.5]).logpmf([1, 2, 2]), rtol=1e-5)
+        paddle.seed(3)
+        s = npt(m.sample([100]))
+        assert s.shape == (100, 3)
+        np.testing.assert_array_equal(s.sum(-1), np.full(100, 5.0))
+
+    def test_lognormal(self):
+        d = D.LogNormal(0.5, 0.8)
+        ref = st.lognorm(s=0.8, scale=np.exp(0.5))
+        np.testing.assert_allclose(npt(d.log_prob(2.0)), ref.logpdf(2.0),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(npt(d.mean), ref.mean(), rtol=1e-5)
+        np.testing.assert_allclose(npt(d.variance), ref.var(), rtol=1e-4)
+
+    def test_multivariate_normal(self):
+        cov = np.array([[2.0, 0.5], [0.5, 1.0]])
+        loc = np.array([1.0, -1.0])
+        d = D.MultivariateNormal(loc, covariance_matrix=cov)
+        ref = st.multivariate_normal(loc, cov)
+        v = np.array([0.3, 0.3])
+        np.testing.assert_allclose(npt(d.log_prob(v)), ref.logpdf(v), rtol=1e-5)
+        np.testing.assert_allclose(npt(d.entropy()), ref.entropy(), rtol=1e-5)
+        paddle.seed(7)
+        s = npt(d.sample([20000]))
+        np.testing.assert_allclose(s.mean(0), loc, atol=0.05)
+        np.testing.assert_allclose(np.cov(s.T), cov, atol=0.08)
+
+    def test_continuous_bernoulli(self):
+        d = D.ContinuousBernoulli(np.array([0.3]))
+        lp = npt(d.log_prob(np.array([0.5])))
+        # density integrates to ~1 on [0,1]
+        xs = np.linspace(1e-4, 1 - 1e-4, 2001)
+        dens = np.exp(npt(D.ContinuousBernoulli(np.array([0.3])).log_prob(
+            xs.reshape(-1, 1))))[:, 0]
+        assert abs(np.trapezoid(dens, xs) - 1.0) < 1e-2
+        assert np.isfinite(lp).all()
+
+
+class TestKL:
+    def test_normal_normal(self):
+        p, q = D.Normal(0.0, 1.0), D.Normal(1.0, 2.0)
+        expect = np.log(2.0) + (1 + 1) / (2 * 4) - 0.5
+        np.testing.assert_allclose(npt(D.kl_divergence(p, q)), expect, rtol=1e-5)
+
+    def test_categorical_bernoulli(self):
+        p = D.Categorical(np.log(np.array([0.3, 0.7])))
+        q = D.Categorical(np.log(np.array([0.5, 0.5])))
+        expect = 0.3 * np.log(0.3 / 0.5) + 0.7 * np.log(0.7 / 0.5)
+        np.testing.assert_allclose(npt(D.kl_divergence(p, q)), expect, rtol=1e-5)
+        pb, qb = D.Bernoulli(0.3), D.Bernoulli(0.5)
+        np.testing.assert_allclose(npt(D.kl_divergence(pb, qb)), expect,
+                                   rtol=1e-5)
+
+    def test_montecarlo_agreement(self):
+        """Closed-form KLs vs Monte-Carlo estimates."""
+        paddle.seed(11)
+        for p, q in [
+            (D.Beta(2.0, 3.0), D.Beta(4.0, 2.0)),
+            (D.Gamma(2.0, 1.5), D.Gamma(3.0, 1.0)),
+            (D.Laplace(0.0, 1.0), D.Laplace(1.0, 2.0)),
+            (D.Gumbel(0.0, 1.0), D.Gumbel(0.5, 1.5)),
+            (D.Exponential(1.0), D.Exponential(2.0)),
+            (D.Geometric(0.5), D.Geometric(0.3)),
+            (D.Poisson(3.0), D.Poisson(4.0)),
+        ]:
+            s = p.sample([200000])
+            mc = (npt(p.log_prob(s)) - npt(q.log_prob(s))).mean()
+            closed = float(npt(D.kl_divergence(p, q)))
+            assert abs(mc - closed) < max(0.05, 0.05 * abs(closed)), \
+                f"{type(p).__name__}: mc={mc} closed={closed}"
+
+    def test_expfamily_fallback_consistency(self):
+        p, q = D.Normal(0.0, 1.0), D.Normal(1.0, 2.0)
+        from paddle_tpu.distribution.kl import _kl_expfamily_expfamily
+        np.testing.assert_allclose(npt(_kl_expfamily_expfamily(p, q)),
+                                   npt(D.kl_divergence(p, q)), rtol=1e-5)
+
+    def test_register_custom(self):
+        class MyDist(D.Normal):
+            pass
+
+        @D.register_kl(MyDist, MyDist)
+        def _kl_my(p, q):
+            return paddle.to_tensor(42.0)
+
+        assert float(D.kl_divergence(MyDist(0.0, 1.0), MyDist(0.0, 1.0)).numpy()) == 42.0
+
+
+class TestTransforms:
+    def test_roundtrip_and_ldj(self):
+        import jax
+        import jax.numpy as jnp
+
+        x = np.array([0.3, -1.2, 2.0])
+        for t in [D.ExpTransform(), D.TanhTransform(), D.SigmoidTransform(),
+                  D.AffineTransform(1.0, 2.5), D.PowerTransform(3.0)]:
+            xs = np.abs(x) + 0.1 if isinstance(t, D.PowerTransform) else x
+            y = npt(t.forward(xs))
+            np.testing.assert_allclose(npt(t.inverse(y)), xs, rtol=1e-4,
+                                       atol=1e-5)
+            # ldj vs autodiff
+            ldj = npt(t.forward_log_det_jacobian(xs))
+            for i, xi in enumerate(xs):
+                g = jax.grad(lambda v: t._forward(v))(jnp.float32(xi))
+                np.testing.assert_allclose(ldj[i], np.log(abs(float(g))),
+                                           rtol=1e-3, atol=1e-5)
+            np.testing.assert_allclose(
+                npt(t.inverse_log_det_jacobian(y)), -ldj, rtol=1e-4, atol=1e-5)
+
+    def test_stickbreaking(self):
+        t = D.StickBreakingTransform()
+        x = np.array([0.2, -0.5, 1.0])
+        y = npt(t.forward(x))
+        assert y.shape == (4,)
+        np.testing.assert_allclose(y.sum(), 1.0, rtol=1e-5)
+        np.testing.assert_allclose(npt(t.inverse(y)), x, rtol=1e-4, atol=1e-5)
+        assert t.forward_shape([3]) == [4]
+
+    def test_chain_reshape_stack(self):
+        chain = D.ChainTransform([D.AffineTransform(0.0, 2.0), D.ExpTransform()])
+        x = np.array([0.5])
+        y = npt(chain.forward(x))
+        np.testing.assert_allclose(y, np.exp(2 * 0.5), rtol=1e-5)
+        np.testing.assert_allclose(npt(chain.inverse(y)), x, rtol=1e-5)
+        np.testing.assert_allclose(npt(chain.forward_log_det_jacobian(x)),
+                                   np.log(2.0) + 2 * 0.5, rtol=1e-5)
+        r = D.ReshapeTransform((2, 3), (6,))
+        z = np.arange(6, dtype=np.float32).reshape(2, 3)
+        assert npt(r.forward(z)).shape == (6,)
+        assert npt(r.inverse(np.arange(6.0))).shape == (2, 3)
+        s = D.StackTransform([D.ExpTransform(), D.AffineTransform(0.0, 3.0)], axis=0)
+        v = np.array([[1.0], [2.0]])
+        out = npt(s.forward(v))
+        np.testing.assert_allclose(out[0], np.exp(1.0), rtol=1e-5)
+        np.testing.assert_allclose(out[1], 6.0, rtol=1e-5)
+
+
+class TestComposed:
+    def test_transformed_distribution_lognormal(self):
+        paddle.seed(5)
+        td = D.TransformedDistribution(D.Normal(0.5, 0.8), [D.ExpTransform()])
+        ln = D.LogNormal(0.5, 0.8)
+        v = np.array([0.7, 2.0])
+        np.testing.assert_allclose(npt(td.log_prob(v)), npt(ln.log_prob(v)),
+                                   rtol=1e-5)
+        s = npt(td.sample([4]))
+        assert (s > 0).all()
+
+    def test_independent(self):
+        base = D.Normal(np.zeros((3, 2)), np.ones((3, 2)))
+        ind = D.Independent(base, 1)
+        assert ind.batch_shape == [3] and ind.event_shape == [2]
+        v = np.ones((3, 2))
+        np.testing.assert_allclose(npt(ind.log_prob(v)),
+                                   npt(base.log_prob(v)).sum(-1), rtol=1e-5)
+        np.testing.assert_allclose(npt(ind.entropy()),
+                                   npt(base.entropy()).sum(-1), rtol=1e-5)
+
+
+class TestUtils:
+    def test_flops(self):
+        from paddle_tpu.utils import flops
+
+        n = flops("matmul", {"X": [[4, 8]], "Y": [[8, 16]]}, {})
+        assert n == 2 * 4 * 8 * 16
+        assert flops("unknown_op", {}, {}) == 0
+
+    def test_unique_name(self):
+        from paddle_tpu.utils import unique_name
+
+        with unique_name.guard("t"):
+            a = unique_name.generate("fc")
+            b = unique_name.generate("fc")
+        assert a != b and a.startswith("t")
+
+    def test_deprecated_and_dlpack(self):
+        import warnings
+
+        from paddle_tpu.utils import deprecated, from_dlpack, to_dlpack
+
+        @deprecated(update_to="new_api", since="2.0")
+        def old():
+            return 1
+
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            assert old() == 1
+            assert any(issubclass(x.category, DeprecationWarning) for x in w)
+
+        t = paddle.to_tensor([1.0, 2.0])
+        t2 = from_dlpack(to_dlpack(t))
+        np.testing.assert_allclose(t2.numpy(), [1.0, 2.0])
+
+    def test_run_check(self):
+        from paddle_tpu.utils import run_check
+
+        assert run_check() is True
