@@ -206,8 +206,8 @@ def apply_op(name: str, fn: Callable, *args, **kwargs):
         out_treedef_box[0] = out_td
         return tuple(out_leaves)
 
-    if op_profile_hook is not None:
-        op_profile_hook(name)
+    # hook returns an end-callback closing the dispatch range (or None)
+    end_profile = op_profile_hook(name) if op_profile_hook is not None else None
 
     node = None
     if diff_pos:
@@ -217,6 +217,9 @@ def apply_op(name: str, fn: Callable, *args, **kwargs):
         node = GradNode(name, vjp_fn, pure_fn, [leaves[p] for p in diff_pos], out_avals)
     else:
         out_flat = pure_fn()
+
+    if end_profile is not None:
+        end_profile()
 
     if flags.flag("check_nan_inf"):
         _check_nan_inf(name, out_flat)

@@ -1,0 +1,23 @@
+"""paddle.profiler parity.
+
+Parity target: python/paddle/profiler/ (profiler.py:346 Profiler with
+make_scheduler state machine :117, export_chrome_tracing :215, summary :849;
+utils.py:38 RecordEvent; timer.py:349 Benchmark ips timer). TPU-native design
+(SURVEY.md §5.1): host-side RecordEvent ranges + per-op ranges hooked into the
+autograd engine feed the summary tables and the Chrome trace; device-side
+profiling delegates to jax.profiler (XLA/PJRT xplane traces, viewable in
+TensorBoard/Perfetto) when a trace_dir is given.
+"""
+from .profiler import (
+    Profiler, ProfilerState, ProfilerTarget, export_chrome_tracing,
+    export_protobuf, make_scheduler,
+)
+from .profiler_statistic import SortedKeys
+from .timer import Benchmark, benchmark
+from .utils import RecordEvent, load_profiler_result
+
+__all__ = [
+    "Profiler", "ProfilerState", "ProfilerTarget", "make_scheduler",
+    "export_chrome_tracing", "export_protobuf", "RecordEvent", "Benchmark",
+    "benchmark", "SortedKeys", "load_profiler_result",
+]

@@ -1,0 +1,223 @@
+"""Profiler facade (parity: python/paddle/profiler/profiler.py).
+
+State machine scheduler (make_scheduler :117 — CLOSED/READY/RECORD/
+RECORD_AND_RETURN), Profiler (:346) with start/stop/step and on_trace_ready
+exporters (export_chrome_tracing :215, export_protobuf :268). Device-side
+(TPU) tracing is jax.profiler: when `timer_only=False` and a trace dir is
+configured, a PJRT xplane trace is captured alongside host events.
+"""
+from __future__ import annotations
+
+import json
+import os
+from enum import Enum
+
+from .record import install_op_hook, recorder, uninstall_op_hook
+from .timer import benchmark as _benchmark
+
+
+class ProfilerState(Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3  # record and return the collected result
+
+
+class ProfilerTarget(Enum):
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    CUSTOM_DEVICE = 3
+    TPU = 4
+
+
+def make_scheduler(*, closed: int, ready: int, record: int, repeat: int = 0,
+                   skip_first: int = 0):
+    """Return fn(step)->ProfilerState cycling CLOSED^closed READY^ready
+    RECORD^(record-1) RECORD_AND_RETURN, repeated `repeat` times (0 = forever),
+    after `skip_first` skipped steps. Reference: profiler.py:117."""
+    num_cycle = closed + ready + record
+
+    def scheduler(step: int) -> ProfilerState:
+        assert step >= 0
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        step -= skip_first
+        if repeat > 0 and step // num_cycle >= repeat:
+            return ProfilerState.CLOSED
+        pos = step % num_cycle
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos < num_cycle - 1:
+            return ProfilerState.RECORD
+        return ProfilerState.RECORD_AND_RETURN
+
+    return scheduler
+
+
+def _default_state_scheduler(step: int) -> ProfilerState:
+    return ProfilerState.RECORD
+
+
+def export_chrome_tracing(dir_name: str, worker_name: str | None = None):
+    """on_trace_ready callback writing a Chrome trace JSON per trace window."""
+
+    def handle(prof: "Profiler"):
+        os.makedirs(dir_name, exist_ok=True)
+        name = worker_name or f"host_{os.getpid()}"
+        path = os.path.join(dir_name, f"{name}_step{prof.step_num}.json")
+        prof._export_chrome(path)
+        prof._last_export = path
+
+    return handle
+
+
+def export_protobuf(dir_name: str, worker_name: str | None = None):
+    """Parity shim: the TPU build's interchange format is the Chrome/Perfetto
+    JSON (plus the jax xplane dump); protobuf export writes the same events as
+    JSON with a .pb.json suffix."""
+
+    def handle(prof: "Profiler"):
+        os.makedirs(dir_name, exist_ok=True)
+        name = worker_name or f"host_{os.getpid()}"
+        path = os.path.join(dir_name, f"{name}_step{prof.step_num}.pb.json")
+        prof._export_chrome(path)
+        prof._last_export = path
+
+    return handle
+
+
+class Profiler:
+    def __init__(self, *, targets=None, scheduler=None, on_trace_ready=None,
+                 timer_only: bool = False, record_shapes: bool = False,
+                 profile_memory: bool = False, with_flops: bool = False,
+                 emit_nvtx: bool = False, custom_device_types=None):
+        self.targets = targets or [ProfilerTarget.CPU]
+        if isinstance(scheduler, (tuple, list)):
+            start, end = scheduler
+            self._scheduler = make_scheduler(
+                closed=max(start - 1, 0), ready=1 if start > 0 else 0,
+                record=end - start, repeat=1)
+            if start == 0:
+                self._scheduler = lambda s: (
+                    ProfilerState.RECORD_AND_RETURN if s == end - 1
+                    else ProfilerState.RECORD if s < end
+                    else ProfilerState.CLOSED)
+        else:
+            self._scheduler = scheduler or _default_state_scheduler
+        self.on_trace_ready = on_trace_ready
+        self.timer_only = timer_only
+        self.with_flops = with_flops
+        self.step_num = 0
+        self.current_state = ProfilerState.CLOSED
+        self._collected: list = []
+        self._last_export = None
+        self._device_trace_dir = None
+        self._device_tracing = False
+
+    # -- lifecycle ---------------------------------------------------------
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    def start(self):
+        _benchmark().begin()
+        if self.timer_only:
+            return
+        self.current_state = self._scheduler(self.step_num)
+        if self.current_state in (ProfilerState.RECORD,
+                                  ProfilerState.RECORD_AND_RETURN):
+            self._enable()
+
+    def stop(self):
+        _benchmark().end()
+        if self.timer_only:
+            return
+        if recorder.enabled:
+            self._disable()
+            if self.current_state == ProfilerState.RECORD_AND_RETURN or \
+                    self.current_state == ProfilerState.RECORD:
+                if self.on_trace_ready:
+                    self.on_trace_ready(self)
+        self.current_state = ProfilerState.CLOSED
+
+    def step(self, num_samples: int | None = None):
+        """Advance the scheduler one step (call once per train iteration)."""
+        _benchmark().step(num_samples)
+        if self.timer_only:
+            self.step_num += 1
+            return
+        prev = self.current_state
+        self.step_num += 1
+        new = self._scheduler(self.step_num)
+        if prev in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN):
+            if prev == ProfilerState.RECORD_AND_RETURN or \
+                    new == ProfilerState.CLOSED:
+                self._disable()
+                if self.on_trace_ready:
+                    self.on_trace_ready(self)
+                recorder.clear()
+        if new in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN) \
+                and not recorder.enabled:
+            self._enable()
+        self.current_state = new
+
+    def step_info(self, unit=None):
+        return _benchmark().step_info(unit)
+
+    def _enable(self):
+        recorder.enabled = True
+        install_op_hook()
+        if ProfilerTarget.TPU in self.targets or \
+                ProfilerTarget.GPU in self.targets:
+            # device tracing via jax/PJRT xplane capture
+            import jax
+
+            self._device_trace_dir = self._device_trace_dir or \
+                os.path.join(os.getcwd(), "profiler_xplane")
+            try:
+                jax.profiler.start_trace(self._device_trace_dir)
+                self._device_tracing = True
+            except Exception:
+                self._device_tracing = False
+
+    def _disable(self):
+        recorder.enabled = False
+        uninstall_op_hook()
+        if self._device_tracing:
+            import jax
+
+            try:
+                jax.profiler.stop_trace()
+            finally:
+                self._device_tracing = False
+
+    # -- export / summary --------------------------------------------------
+    def _export_chrome(self, path: str):
+        events = []
+        for ev in recorder.events:
+            events.append({
+                "name": ev.name, "ph": "X", "pid": os.getpid(),
+                "tid": ev.tid % 2**31, "ts": ev.start_ns / 1e3,
+                "dur": (ev.end_ns - ev.start_ns) / 1e3,
+                "cat": ev.category,
+            })
+        with open(path, "w") as f:
+            json.dump({"traceEvents": events,
+                       "displayTimeUnit": "ms"}, f)
+
+    def export(self, path: str, format: str = "json"):
+        self._export_chrome(path)
+
+    def summary(self, sorted_by=None, op_detail: bool = True,
+                thread_sep: bool = False, time_unit: str = "ms"):
+        from .profiler_statistic import gen_summary_tables
+
+        print(gen_summary_tables(recorder.events, time_unit=time_unit,
+                                 sorted_by=sorted_by))

@@ -1,0 +1,74 @@
+"""Host event recording core.
+
+The single in-process event buffer every surface feeds: RecordEvent ranges,
+per-op ranges (hooked into autograd.engine.op_profile_hook), and framework
+ranges (dataloader, optimizer). Equivalent of the reference's
+HostEventRecorder lock-free buffers (platform/profiler/host_event_recorder.h)
+— here a plain list per thread is enough because the GIL already serializes
+appends, and the hot path (op dispatch) appends one tuple.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+
+class HostEvent:
+    __slots__ = ("name", "start_ns", "end_ns", "tid", "category")
+
+    def __init__(self, name, start_ns, end_ns, tid, category):
+        self.name = name
+        self.start_ns = start_ns
+        self.end_ns = end_ns
+        self.tid = tid
+        self.category = category
+
+
+class EventRecorder:
+    def __init__(self):
+        self.events: list[HostEvent] = []
+        self.enabled = False
+        self._lock = threading.Lock()
+
+    def clear(self):
+        with self._lock:
+            self.events = []
+
+    def record(self, name, start_ns, end_ns, category="op"):
+        if not self.enabled:
+            return
+        ev = HostEvent(name, start_ns, end_ns, threading.get_ident(), category)
+        with self._lock:
+            self.events.append(ev)
+
+
+recorder = EventRecorder()
+
+
+def now_ns() -> int:
+    return time.perf_counter_ns()
+
+
+def _op_hook(name: str):
+    """Installed as autograd.engine.op_profile_hook while profiling: returns
+    an end-callback so the engine can close the dispatch range."""
+    if not recorder.enabled:
+        return None
+    start = now_ns()
+
+    def end():
+        recorder.record(name, start, now_ns(), category="op")
+
+    return end
+
+
+def install_op_hook():
+    from ..autograd import engine
+
+    engine.op_profile_hook = _op_hook
+
+
+def uninstall_op_hook():
+    from ..autograd import engine
+
+    engine.op_profile_hook = None

@@ -1,0 +1,57 @@
+"""RecordEvent instrumentation range (parity: profiler/utils.py:38)."""
+from __future__ import annotations
+
+import functools
+import json
+
+from .record import now_ns, recorder
+
+__all__ = ["RecordEvent", "load_profiler_result", "in_profiler_mode"]
+
+
+def in_profiler_mode() -> bool:
+    return recorder.enabled
+
+
+class RecordEvent:
+    """Context manager / decorator marking a named host range.
+
+    Usage parity with paddle: ``with RecordEvent("stage"): ...`` or explicit
+    ``begin()``/``end()``.
+    """
+
+    def __init__(self, name: str, event_type=None):
+        self.name = name
+        self.event_type = event_type
+        self._start = None
+
+    def begin(self):
+        self._start = now_ns()
+
+    def end(self):
+        if self._start is not None:
+            recorder.record(self.name, self._start, now_ns(), category="user")
+            self._start = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+    def __call__(self, func):
+        @functools.wraps(func)
+        def wrapper(*args, **kwargs):
+            with RecordEvent(self.name or func.__name__):
+                return func(*args, **kwargs)
+
+        return wrapper
+
+
+def load_profiler_result(filename: str):
+    """Load an exported Chrome trace back as a list of event dicts."""
+    with open(filename) as f:
+        data = json.load(f)
+    return data.get("traceEvents", data)
