@@ -210,16 +210,19 @@ def apply_op(name: str, fn: Callable, *args, **kwargs):
     end_profile = op_profile_hook(name) if op_profile_hook is not None else None
 
     node = None
-    if diff_pos:
-        diff_datas = [leaves[p]._data for p in diff_pos]
-        out_flat, vjp_fn = jax.vjp(pure_fn, *diff_datas)
-        out_avals = [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in out_flat]
-        node = GradNode(name, vjp_fn, pure_fn, [leaves[p] for p in diff_pos], out_avals)
-    else:
-        out_flat = pure_fn()
-
-    if end_profile is not None:
-        end_profile()
+    try:
+        if diff_pos:
+            diff_datas = [leaves[p]._data for p in diff_pos]
+            out_flat, vjp_fn = jax.vjp(pure_fn, *diff_datas)
+            out_avals = [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in out_flat]
+            node = GradNode(name, vjp_fn, pure_fn, [leaves[p] for p in diff_pos], out_avals)
+        else:
+            out_flat = pure_fn()
+    finally:
+        # record the range even when dispatch raises — the failing op is
+        # exactly the one worth seeing in the trace
+        if end_profile is not None:
+            end_profile()
 
     if flags.flag("check_nan_inf"):
         _check_nan_inf(name, out_flat)

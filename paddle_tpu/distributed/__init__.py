@@ -123,6 +123,11 @@ _FLAT = {
     # parallel env
     "ParallelEnv": ".parallel",
     "DataParallel": ".parallel",
+    # context parallelism (ring / Ulysses) — TPU-native long-context path
+    "ring_attention": "..ops.ring_attention",
+    "ring_attention_local": "..ops.ring_attention",
+    "ulysses_attention": "..ops.ring_attention",
+    "ulysses_attention_local": "..ops.ring_attention",
 }
 
 

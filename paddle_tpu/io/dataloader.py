@@ -153,12 +153,29 @@ class DataLoader:
 
     def __iter__(self):
         if self._iterable_mode:
-            return self._iter_iterable()
-        if self.batch_sampler is None:
-            return (self._collate_one(self.dataset[i]) for i in range(len(self.dataset)))
-        if self.num_workers == 0:
-            return self._iter_single()
-        return self._iter_multiprocess()
+            it = self._iter_iterable()
+        elif self.batch_sampler is None:
+            it = (self._collate_one(self.dataset[i]) for i in range(len(self.dataset)))
+        elif self.num_workers == 0:
+            it = self._iter_single()
+        else:
+            it = self._iter_multiprocess()
+        return self._timed(it)
+
+    @staticmethod
+    def _timed(it):
+        """Mark read spans on the global Benchmark timer (reader_cost)."""
+        from ..profiler.timer import benchmark
+
+        bench = benchmark()
+        while True:
+            bench.before_reader()
+            try:
+                batch = next(it)
+            except StopIteration:
+                return
+            bench.after_reader()
+            yield batch
 
     def _collate_one(self, sample):
         fn = self.collate_fn or default_collate_fn

@@ -204,11 +204,22 @@ def _flash_fwd(q, k, v, scale, causal):
 
 def _flash_bwd(scale, causal, res, g):
     q, k, v, out, lse = res
-    bh, seq, d = q.shape
     delta = jnp.sum(
         g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1, keepdims=False
     )[:, None, :]  # [bh, 1, seq]
+    return flash_bwd_impl(q, k, v, g, lse, delta, scale, causal)
 
+
+def flash_bwd_impl(q, k, v, g, lse, delta, scale, causal):
+    """dq/dk/dv pallas kernels from explicit (lse, delta) residuals.
+
+    ``lse``/``delta`` are [bh, 1, seq] fp32. Exposed separately so the ring
+    (context-parallel) backward can drive the same kernels per KV chunk with
+    the *globally* combined lse and delta — the blockwise-attention identity
+    p = exp(s - lse_global) makes chunk backward exact without per-chunk
+    renormalization.
+    """
+    bh, seq, d = q.shape
     lse_spec_blocked = pl.BlockSpec((1, 1, BLOCK_Q), lambda b, i: (b, 0, i))
     lse_spec_full = pl.BlockSpec((1, 1, seq), lambda b, i: (b, 0, 0))
 

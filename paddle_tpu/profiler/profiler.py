@@ -36,6 +36,10 @@ def make_scheduler(*, closed: int, ready: int, record: int, repeat: int = 0,
     """Return fn(step)->ProfilerState cycling CLOSED^closed READY^ready
     RECORD^(record-1) RECORD_AND_RETURN, repeated `repeat` times (0 = forever),
     after `skip_first` skipped steps. Reference: profiler.py:117."""
+    if record < 1:
+        raise ValueError(f"record must be >= 1, got {record}")
+    if closed < 0 or ready < 0 or skip_first < 0 or repeat < 0:
+        raise ValueError("closed/ready/skip_first/repeat must be >= 0")
     num_cycle = closed + ready + record
 
     def scheduler(step: int) -> ProfilerState:
@@ -97,14 +101,13 @@ class Profiler:
         self.targets = targets or [ProfilerTarget.CPU]
         if isinstance(scheduler, (tuple, list)):
             start, end = scheduler
+            if end <= start or start < 0:
+                raise ValueError(
+                    f"scheduler ({start}, {end}) needs 0 <= start < end"
+                )
             self._scheduler = make_scheduler(
                 closed=max(start - 1, 0), ready=1 if start > 0 else 0,
                 record=end - start, repeat=1)
-            if start == 0:
-                self._scheduler = lambda s: (
-                    ProfilerState.RECORD_AND_RETURN if s == end - 1
-                    else ProfilerState.RECORD if s < end
-                    else ProfilerState.CLOSED)
         else:
             self._scheduler = scheduler or _default_state_scheduler
         self.on_trace_ready = on_trace_ready
@@ -145,6 +148,7 @@ class Profiler:
                     self.current_state == ProfilerState.RECORD:
                 if self.on_trace_ready:
                     self.on_trace_ready(self)
+            self._collected = list(recorder.events)  # keep for summary()
         self.current_state = ProfilerState.CLOSED
 
     def step(self, num_samples: int | None = None):
@@ -162,6 +166,7 @@ class Profiler:
                 self._disable()
                 if self.on_trace_ready:
                     self.on_trace_ready(self)
+                self._collected = list(recorder.events)  # keep for summary()
                 recorder.clear()
         if new in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN) \
                 and not recorder.enabled:
@@ -172,6 +177,7 @@ class Profiler:
         return _benchmark().step_info(unit)
 
     def _enable(self):
+        recorder.clear()  # a new trace window must not inherit old events
         recorder.enabled = True
         install_op_hook()
         if ProfilerTarget.TPU in self.targets or \
@@ -219,5 +225,6 @@ class Profiler:
                 thread_sep: bool = False, time_unit: str = "ms"):
         from .profiler_statistic import gen_summary_tables
 
-        print(gen_summary_tables(recorder.events, time_unit=time_unit,
+        events = recorder.events or self._collected
+        print(gen_summary_tables(events, time_unit=time_unit,
                                  sorted_by=sorted_by))

@@ -1,0 +1,144 @@
+"""Profiler facade: scheduler states, trace windows, export, timer, summary."""
+import json
+import os
+
+import paddle_tpu as paddle
+from paddle_tpu import profiler as prof_mod
+from paddle_tpu.profiler import (
+    Benchmark,
+    Profiler,
+    ProfilerState,
+    RecordEvent,
+    SortedKeys,
+    export_chrome_tracing,
+    load_profiler_result,
+    make_scheduler,
+)
+from paddle_tpu.profiler.record import recorder
+
+
+def test_make_scheduler_states():
+    sch = make_scheduler(closed=1, ready=1, record=2, repeat=1, skip_first=1)
+    states = [sch(i) for i in range(6)]
+    assert states == [
+        ProfilerState.CLOSED,  # skip_first
+        ProfilerState.CLOSED,
+        ProfilerState.READY,
+        ProfilerState.RECORD,
+        ProfilerState.RECORD_AND_RETURN,
+        ProfilerState.CLOSED,  # repeat exhausted
+    ]
+
+
+def test_profiler_records_ops_and_exports(tmp_path):
+    p = Profiler(
+        scheduler=(0, 2), on_trace_ready=export_chrome_tracing(str(tmp_path))
+    )
+    p.start()
+    with RecordEvent("forward"):
+        x = paddle.randn([4, 4])
+        y = (x @ x).sum()
+    p.step()
+    _ = paddle.randn([2, 2]) + 1.0
+    p.step()  # closes the window -> export
+    p.stop()
+    files = list(tmp_path.iterdir())
+    assert files, "no chrome trace exported"
+    events = load_profiler_result(str(files[0]))
+    names = {e["name"] for e in events}
+    assert "forward" in names
+    assert any(n not in ("forward",) for n in names), "no op events recorded"
+    assert not recorder.enabled
+
+
+def test_profiler_windows_do_not_leak_events(tmp_path):
+    """A second session must not re-export events from the first."""
+    for i in range(2):
+        p = Profiler(
+            scheduler=(0, 1),
+            on_trace_ready=export_chrome_tracing(str(tmp_path), f"w{i}"),
+        )
+        p.start()
+        with RecordEvent(f"span{i}"):
+            pass
+        p.step()
+        p.stop()
+    second = [f for f in os.listdir(tmp_path) if f.startswith("w1")]
+    assert second
+    events = load_profiler_result(str(tmp_path / second[0]))
+    names = {e["name"] for e in events}
+    assert "span0" not in names
+
+
+def test_summary_tables(capsys):
+    p = Profiler()
+    p.start()
+    with RecordEvent("stage"):
+        _ = paddle.ones([3]) * 2
+    p.stop()
+    p.summary(sorted_by=SortedKeys.CPUTotal)
+    out = capsys.readouterr().out
+    assert "Overview Summary" in out and "stage" in out
+
+
+def test_benchmark_timer():
+    b = Benchmark()
+    b.begin()
+    b.before_reader()
+    b.after_reader()
+    b.step(num_samples=32)
+    b.step(num_samples=32)
+    assert b.speed() > 0
+    info = b.step_info()
+    assert "avg_batch_cost" in info and "avg_ips" in info
+    b.end()
+    # window reset by step_info
+    assert b.batch.get_average() == 0.0
+
+
+def test_profiler_module_importable():
+    assert hasattr(prof_mod, "Profiler")
+    assert hasattr(prof_mod, "benchmark")
+
+
+def test_summary_available_after_scheduled_window(capsys):
+    p = Profiler(scheduler=(0, 1))
+    p.start()
+    with RecordEvent("windowed"):
+        pass
+    p.step()  # closes + clears the shared recorder
+    p.stop()
+    p.summary()
+    out = capsys.readouterr().out
+    assert "windowed" in out
+
+
+def test_scheduler_validation():
+    import pytest
+
+    with pytest.raises(ValueError):
+        make_scheduler(closed=0, ready=0, record=0)
+    with pytest.raises(ValueError):
+        Profiler(scheduler=(2, 2))
+
+
+def test_dataloader_marks_reader_cost():
+    import numpy as np
+
+    from paddle_tpu.io import DataLoader, Dataset
+    from paddle_tpu.profiler.timer import benchmark
+
+    class DS(Dataset):
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, i):
+            return np.zeros((2,), np.float32)
+
+    b = benchmark()
+    b.__init__()  # reset global state
+    b.begin()
+    for batch in DataLoader(DS(), batch_size=4):
+        b.step(num_samples=4)
+    assert b.reader.total > 0.0
+    b.end()
