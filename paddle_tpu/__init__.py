@@ -116,9 +116,24 @@ def __getattr__(name):
 # expose it last under the paddle spelling.
 bool = bool_  # noqa: A001
 
-disable_static = lambda *a, **k: None  # dygraph is the only mode; parity no-op
-enable_static = lambda *a, **k: None
-in_dynamic_mode = lambda: True
+def enable_static():
+    """Enter static graph mode: ops record into the default main Program
+    (executed later by paddle_tpu.static.Executor as one XLA step)."""
+    from .static import program as _static_program
+
+    _static_program.enable_static()
+
+
+def disable_static():
+    from .static import program as _static_program
+
+    _static_program.disable_static()
+
+
+def in_dynamic_mode() -> bool:
+    from .static import program as _static_program
+
+    return not _static_program.in_static_mode()
 
 
 def is_grad_enabled_():

@@ -28,6 +28,12 @@ amp_cast_hook: Callable | None = None
 # Hook installed by the profiler to wrap op execution in RecordEvent ranges.
 op_profile_hook: Callable | None = None
 
+# Hook installed by paddle_tpu.static while a Program is recording: called as
+# hook(name, fn, treedef, leaves, out_tensors) after each op executes so the
+# Program can append a replayable statement (define-by-run becomes
+# record-and-replay; SURVEY.md §2.3 ProgramDesc parity).
+static_record_hook: Callable | None = None
+
 # Ops whose outputs are never differentiable (comparisons, index producers,
 # predicates). Skipping the vjp for these avoids residual construction and
 # dead GradNode allocation in hot training loops.
@@ -237,6 +243,9 @@ def apply_op(name: str, fn: Callable, *args, **kwargs):
         else:
             t = Tensor(data, stop_gradient=True)
         out_tensors.append(t)
+
+    if static_record_hook is not None:
+        static_record_hook(name, fn, treedef, leaves, out_tensors)
 
     result = jax.tree.unflatten(out_treedef_box[0], out_tensors)
     return result

@@ -1,0 +1,190 @@
+"""Rendezvous store (TCPStore parity) over the native C++ service.
+
+Reference surface: paddle.distributed.TCPStore / Store
+(phi/core/distributed/store/tcp_store.h:121; python bound via pybind
+BindDistributed) with set/get/add/wait semantics plus barrier built on them;
+init_parallel_env rendezvouses through a process-global store
+(parallel.py:1097 create_or_get_global_tcp_store).
+
+The server is the C++ ``native/tcp_store.cc`` service; every process —
+including the host of the server — talks to it through a client socket, so
+the semantics are identical regardless of rank.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+
+from ..native import load_library
+
+
+def _lib():
+    lib = load_library("tcp_store")
+    if not getattr(lib, "_configured", False):
+        lib.pd_store_server_start.restype = ctypes.c_void_p
+        lib.pd_store_server_start.argtypes = [ctypes.c_int]
+        lib.pd_store_server_port.restype = ctypes.c_int
+        lib.pd_store_server_port.argtypes = [ctypes.c_void_p]
+        lib.pd_store_server_stop.argtypes = [ctypes.c_void_p]
+        lib.pd_store_client_new.restype = ctypes.c_void_p
+        lib.pd_store_client_new.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
+        lib.pd_store_client_free.argtypes = [ctypes.c_void_p]
+        lib.pd_store_set.restype = ctypes.c_int
+        lib.pd_store_set.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int]
+        lib.pd_store_get.restype = ctypes.c_int
+        lib.pd_store_get.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.POINTER(ctypes.c_int), ctypes.c_int]
+        lib.pd_store_add.restype = ctypes.c_longlong
+        lib.pd_store_add.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_longlong]
+        lib.pd_store_wait.restype = ctypes.c_int
+        lib.pd_store_wait.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
+        lib.pd_store_check.restype = ctypes.c_int
+        lib.pd_store_check.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.pd_store_free_buf.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
+        lib._configured = True
+    return lib
+
+
+class TCPStore:
+    """Distributed KV store with blocking get/wait, counters, and barrier.
+
+    Args mirror the reference: ``host``/``port`` of the master, ``is_master``
+    starts the in-process server, ``world_size`` sizes barriers, ``timeout``
+    (seconds) bounds connect and blocking reads.
+    """
+
+    def __init__(self, host="127.0.0.1", port=0, is_master=False,
+                 world_size=1, timeout=300):
+        self._lib = _lib()
+        self._server = None
+        self._world_size = world_size
+        self._timeout_ms = int(timeout * 1000)
+        self._barrier_rounds: dict = {}
+        if is_master:
+            self._server = self._lib.pd_store_server_start(port)
+            if not self._server:
+                raise RuntimeError(f"TCPStore: failed to bind port {port}")
+            port = self._lib.pd_store_server_port(self._server)
+        self.host, self.port = host, port
+        self._client = self._lib.pd_store_client_new(
+            host.encode(), port, self._timeout_ms)
+        if not self._client:
+            if self._server:
+                self._lib.pd_store_server_stop(self._server)
+                self._server = None
+            raise RuntimeError(f"TCPStore: cannot connect to {host}:{port}")
+        self._closed = False
+
+    def set(self, key: str, value) -> None:
+        if isinstance(value, str):
+            value = value.encode()
+        buf = (ctypes.c_uint8 * len(value)).from_buffer_copy(value or b"\0")
+        if self._lib.pd_store_set(self._client, key.encode(), buf,
+                                  len(value)) != 0:
+            raise RuntimeError(f"TCPStore.set({key!r}) failed")
+
+    def get(self, key: str) -> bytes:
+        """Blocking read: waits until the key is published (reference
+        TCPStore::Get semantics), raising on timeout."""
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        out_len = ctypes.c_int(0)
+        status = self._lib.pd_store_get(
+            self._client, key.encode(), ctypes.byref(out),
+            ctypes.byref(out_len), self._timeout_ms)
+        if status == -1:
+            raise TimeoutError(f"TCPStore.get({key!r}) timed out")
+        if status != 0:
+            raise RuntimeError(f"TCPStore.get({key!r}) connection error")
+        try:
+            return bytes(bytearray(out[: out_len.value])) if out_len.value else b""
+        finally:
+            if out:
+                self._lib.pd_store_free_buf(out)
+
+    def add(self, key: str, amount: int = 1) -> int:
+        result = self._lib.pd_store_add(self._client, key.encode(), amount)
+        if result == -(2 ** 63):
+            raise RuntimeError(f"TCPStore.add({key!r}) failed")
+        return int(result)
+
+    def wait(self, keys, timeout=None) -> None:
+        if isinstance(keys, str):
+            keys = [keys]
+        tmo = self._timeout_ms if timeout is None else int(timeout * 1000)
+        for key in keys:
+            status = self._lib.pd_store_wait(self._client, key.encode(), tmo)
+            if status == -1:
+                raise TimeoutError(f"TCPStore.wait({key!r}) timed out")
+            if status != 0:
+                raise RuntimeError(f"TCPStore.wait({key!r}) connection error")
+
+    def check(self, key: str) -> bool:
+        status = self._lib.pd_store_check(self._client, key.encode())
+        if status < 0:
+            raise RuntimeError(f"TCPStore.check({key!r}) failed")
+        return bool(status)
+
+    def barrier(self, tag: str | None = None) -> None:
+        """All `world_size` participants rendezvous. Built on add+wait: the
+        last arriver publishes the release key (reference barriers are the
+        same construction over the store). A per-tag local round counter
+        makes repeated barriers on the same tag fresh rendezvous points
+        (every rank's Nth call on a tag pairs with the others' Nth call)."""
+        tag = "default" if tag is None else tag
+        round_ = self._barrier_rounds.get(tag, 0)
+        self._barrier_rounds[tag] = round_ + 1
+        count_key = f"/_barrier/{tag}/{round_}/count"
+        release_key = f"/_barrier/{tag}/{round_}/release"
+        if self.add(count_key, 1) == self._world_size:
+            self.set(release_key, b"1")
+        self.wait([release_key])
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        if self._client:
+            self._lib.pd_store_client_free(self._client)
+            self._client = None
+        if self._server:
+            self._lib.pd_store_server_stop(self._server)
+            self._server = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+_global_store: TCPStore | None = None
+_global_lock = threading.Lock()
+
+
+def create_or_get_global_tcp_store() -> TCPStore:
+    """Process-global rendezvous store from the launcher env (reference
+    parallel.py:1097). Master = rank 0 at PADDLE_MASTER (or the first
+    trainer endpoint)."""
+    global _global_store
+    with _global_lock:
+        if _global_store is not None:
+            return _global_store
+        rank = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+        world = int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+        master = os.environ.get("PADDLE_MASTER", "")
+        if not master:
+            eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "127.0.0.1:0")
+            master = eps.split(",")[0]
+        host, _, port = master.rpartition(":")
+        _global_store = TCPStore(
+            host or "127.0.0.1", int(port or 0), is_master=(rank == 0),
+            world_size=world)
+        return _global_store

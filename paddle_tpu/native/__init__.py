@@ -1,0 +1,48 @@
+"""Native (C++) runtime components.
+
+The reference framework's control-plane runtime is C++ (TCPStore rendezvous,
+allocators, executors — SURVEY.md §2.6/§2.9). The TPU build keeps the same
+split: JAX/XLA/Pallas own the compute path, while host-side runtime services
+live here as C++ shared libraries loaded through ctypes.
+
+Libraries are compiled on demand with g++ into ``native/build/`` and cached;
+a source-mtime check rebuilds after edits. No pybind11 — the C ABI plus
+ctypes keeps the binding layer dependency-free.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_BUILD_DIR = os.path.join(_HERE, "build")
+_lock = threading.Lock()
+_cache: dict[str, ctypes.CDLL] = {}
+
+_CXXFLAGS = ["-O2", "-std=c++17", "-fPIC", "-shared", "-pthread", "-Wall"]
+
+
+def load_library(name: str) -> ctypes.CDLL:
+    """Compile (if needed) and dlopen ``native/<name>.cc`` -> ``lib<name>.so``."""
+    with _lock:
+        if name in _cache:
+            return _cache[name]
+        src = os.path.join(_HERE, name + ".cc")
+        if not os.path.exists(src):
+            raise FileNotFoundError(src)
+        os.makedirs(_BUILD_DIR, exist_ok=True)
+        so = os.path.join(_BUILD_DIR, f"lib{name}.so")
+        if not os.path.exists(so) or os.path.getmtime(so) < os.path.getmtime(src):
+            tmp = so + f".tmp{os.getpid()}"
+            cmd = ["g++", *_CXXFLAGS, "-o", tmp, src]
+            proc = subprocess.run(cmd, capture_output=True, text=True)
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"native build failed: {' '.join(cmd)}\n{proc.stderr}"
+                )
+            os.replace(tmp, so)  # atomic vs concurrent builders
+        lib = ctypes.CDLL(so)
+        _cache[name] = lib
+        return lib

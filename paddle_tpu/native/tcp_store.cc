@@ -1,0 +1,463 @@
+// TCP key-value coordination store (native runtime component).
+//
+// Parity target: the reference's TCPStore rendezvous service
+// (phi/core/distributed/store/tcp_store.h:121, tcp_utils.cc) — a
+// set/get/add/wait KV store used to bootstrap distributed jobs. The TPU
+// build uses it the same way: rank-0 hosts the server, every process
+// (including rank-0) talks to it through a client socket to exchange
+// coordinator addresses, barrier, and publish per-rank metadata before
+// jax.distributed / mesh construction exists.
+//
+// Design: blocking threads, not an event loop. One acceptor thread plus one
+// detached handler thread per client connection, all sharing a
+// mutex-protected map with a condition variable for WAIT/GET blocking.
+// This is a control-plane service (O(ranks) connections, O(keys) traffic),
+// so per-connection threads are simpler and plenty fast.
+//
+// Wire protocol (little-endian, length-prefixed):
+//   request:  u8 cmd | u32 keylen | key bytes | payload
+//     cmd 0 SET:   payload = u32 vallen | val
+//     cmd 1 GET:   payload = i32 timeout_ms   (blocks until key exists)
+//     cmd 2 ADD:   payload = i64 delta        (creates key at 0 first)
+//     cmd 3 WAIT:  payload = i32 timeout_ms
+//     cmd 4 CHECK: no payload
+//   response:
+//     SET   -> u8 ok
+//     GET   -> i32 status | u32 vallen | val   (status 0 ok, -1 timeout)
+//     ADD   -> i64 new_value
+//     WAIT  -> i32 status
+//     CHECK -> u8 exists
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <set>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+bool send_all(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t w = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (w <= 0) {
+      if (w < 0 && (errno == EINTR)) continue;
+      return false;
+    }
+    p += w;
+    n -= static_cast<size_t>(w);
+  }
+  return true;
+}
+
+bool recv_all(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) {
+      if (r < 0 && errno == EINTR) continue;
+      return false;
+    }
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+class StoreServer {
+ public:
+  explicit StoreServer(int port) : port_(port) {}
+
+  bool Start() {
+    listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd_ < 0) return false;
+    int one = 1;
+    ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons(static_cast<uint16_t>(port_));
+    if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) <
+        0) {
+      ::close(listen_fd_);
+      listen_fd_ = -1;
+      return false;
+    }
+    if (port_ == 0) {
+      socklen_t len = sizeof(addr);
+      ::getsockname(listen_fd_, reinterpret_cast<sockaddr*>(&addr), &len);
+      port_ = ntohs(addr.sin_port);
+    }
+    if (::listen(listen_fd_, 128) < 0) {
+      ::close(listen_fd_);
+      listen_fd_ = -1;
+      return false;
+    }
+    acceptor_ = std::thread([this] { AcceptLoop(); });
+    return true;
+  }
+
+  void Stop() {
+    stop_.store(true);
+    if (listen_fd_ >= 0) ::shutdown(listen_fd_, SHUT_RDWR);
+    if (acceptor_.joinable()) acceptor_.join();
+    if (listen_fd_ >= 0) ::close(listen_fd_);
+    listen_fd_ = -1;
+    // Unblock every handler: shutdown their sockets (breaks recv_all) and wake
+    // cv waiters, then join so no thread outlives this object.
+    {
+      std::lock_guard<std::mutex> lk(clients_mu_);
+      for (int fd : client_fds_) ::shutdown(fd, SHUT_RDWR);
+    }
+    cv_.notify_all();
+    std::vector<std::thread> to_join;
+    {
+      std::lock_guard<std::mutex> lk(clients_mu_);
+      to_join.swap(handlers_);
+    }
+    for (auto& t : to_join)
+      if (t.joinable()) t.join();
+  }
+
+  int port() const { return port_; }
+
+  ~StoreServer() { Stop(); }
+
+ private:
+  void AcceptLoop() {
+    while (!stop_.load()) {
+      sockaddr_in peer{};
+      socklen_t len = sizeof(peer);
+      int fd = ::accept(listen_fd_, reinterpret_cast<sockaddr*>(&peer), &len);
+      if (fd < 0) {
+        if (stop_.load()) break;
+        if (errno == EINTR) continue;
+        break;
+      }
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      std::lock_guard<std::mutex> lk(clients_mu_);
+      if (stop_.load()) {
+        ::close(fd);
+        break;
+      }
+      client_fds_.insert(fd);
+      handlers_.emplace_back([this, fd] { HandleClient(fd); });
+    }
+  }
+
+  void HandleClient(int fd) {
+    while (!stop_.load()) {
+      uint8_t cmd;
+      uint32_t keylen;
+      if (!recv_all(fd, &cmd, 1) || !recv_all(fd, &keylen, 4)) break;
+      if (keylen > (1u << 20)) break;  // malformed
+      std::string key(keylen, '\0');
+      if (!recv_all(fd, key.data(), keylen)) break;
+      bool ok = true;
+      switch (cmd) {
+        case 0: {  // SET
+          uint32_t vallen;
+          if (!recv_all(fd, &vallen, 4) || vallen > (1u << 30)) {
+            ok = false;
+            break;
+          }
+          std::string val(vallen, '\0');
+          if (!recv_all(fd, val.data(), vallen)) {
+            ok = false;
+            break;
+          }
+          {
+            std::lock_guard<std::mutex> lk(mu_);
+            data_[key] = std::move(val);
+          }
+          cv_.notify_all();
+          uint8_t resp = 1;
+          ok = send_all(fd, &resp, 1);
+          break;
+        }
+        case 1: {  // GET (blocking)
+          int32_t timeout_ms;
+          if (!recv_all(fd, &timeout_ms, 4)) {
+            ok = false;
+            break;
+          }
+          std::string val;
+          int32_t status = WaitFor(key, timeout_ms, &val);
+          uint32_t vallen = static_cast<uint32_t>(val.size());
+          ok = send_all(fd, &status, 4) && send_all(fd, &vallen, 4) &&
+               (vallen == 0 || send_all(fd, val.data(), vallen));
+          break;
+        }
+        case 2: {  // ADD
+          int64_t delta;
+          if (!recv_all(fd, &delta, 8)) {
+            ok = false;
+            break;
+          }
+          int64_t result;
+          {
+            std::lock_guard<std::mutex> lk(mu_);
+            int64_t cur = 0;
+            auto it = data_.find(key);
+            if (it != data_.end() && !it->second.empty()) {
+              cur = std::strtoll(it->second.c_str(), nullptr, 10);
+            }
+            result = cur + delta;
+            data_[key] = std::to_string(result);
+          }
+          cv_.notify_all();
+          ok = send_all(fd, &result, 8);
+          break;
+        }
+        case 3: {  // WAIT
+          int32_t timeout_ms;
+          if (!recv_all(fd, &timeout_ms, 4)) {
+            ok = false;
+            break;
+          }
+          int32_t status = WaitFor(key, timeout_ms, nullptr);
+          ok = send_all(fd, &status, 4);
+          break;
+        }
+        case 4: {  // CHECK
+          uint8_t exists;
+          {
+            std::lock_guard<std::mutex> lk(mu_);
+            exists = data_.count(key) ? 1 : 0;
+          }
+          ok = send_all(fd, &exists, 1);
+          break;
+        }
+        default:
+          ok = false;
+      }
+      if (!ok) break;
+    }
+    {
+      std::lock_guard<std::mutex> lk(clients_mu_);
+      client_fds_.erase(fd);
+    }
+    ::close(fd);
+  }
+
+  // Block until `key` exists (or timeout; <0 = infinite). Copies the value
+  // out under the lock when `out` is non-null. Returns 0 ok, -1 timeout.
+  int32_t WaitFor(const std::string& key, int32_t timeout_ms,
+                  std::string* out) {
+    std::unique_lock<std::mutex> lk(mu_);
+    auto pred = [&] { return stop_.load() || data_.count(key) > 0; };
+    if (timeout_ms < 0) {
+      cv_.wait(lk, pred);
+    } else if (!cv_.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                             pred)) {
+      return -1;
+    }
+    if (!data_.count(key)) return -1;  // woken by stop
+    if (out) *out = data_[key];
+    return 0;
+  }
+
+  int port_;
+  int listen_fd_ = -1;
+  std::atomic<bool> stop_{false};
+  std::thread acceptor_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::unordered_map<std::string, std::string> data_;
+  std::mutex clients_mu_;
+  std::set<int> client_fds_;
+  std::vector<std::thread> handlers_;
+};
+
+class StoreClient {
+ public:
+  bool Connect(const char* host, int port, int timeout_ms) {
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::milliseconds(timeout_ms);
+    addrinfo hints{};
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    addrinfo* res = nullptr;
+    if (::getaddrinfo(host, std::to_string(port).c_str(), &hints, &res) != 0)
+      return false;
+    // Retry until deadline: the server rank may come up later than us.
+    while (true) {
+      for (addrinfo* ai = res; ai; ai = ai->ai_next) {
+        int fd = ::socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+        if (fd < 0) continue;
+        if (::connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) {
+          int one = 1;
+          ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+          fd_ = fd;
+          ::freeaddrinfo(res);
+          return true;
+        }
+        ::close(fd);
+      }
+      if (std::chrono::steady_clock::now() >= deadline) break;
+      std::this_thread::sleep_for(std::chrono::milliseconds(50));
+    }
+    ::freeaddrinfo(res);
+    return false;
+  }
+
+  ~StoreClient() {
+    if (fd_ >= 0) ::close(fd_);
+  }
+
+  bool SendReq(uint8_t cmd, const std::string& key, const void* payload,
+               size_t payload_len) {
+    std::lock_guard<std::mutex> lk(mu_);
+    uint32_t keylen = static_cast<uint32_t>(key.size());
+    return send_all(fd_, &cmd, 1) && send_all(fd_, &keylen, 4) &&
+           send_all(fd_, key.data(), keylen) &&
+           (payload_len == 0 || send_all(fd_, payload, payload_len));
+  }
+
+  int fd() const { return fd_; }
+  std::mutex mu_;  // serialize request/response pairs across threads
+
+ private:
+  int fd_ = -1;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* pd_store_server_start(int port) {
+  auto* s = new StoreServer(port);
+  if (!s->Start()) {
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+int pd_store_server_port(void* h) {
+  return static_cast<StoreServer*>(h)->port();
+}
+
+void pd_store_server_stop(void* h) { delete static_cast<StoreServer*>(h); }
+
+void* pd_store_client_new(const char* host, int port, int timeout_ms) {
+  auto* c = new StoreClient();
+  if (!c->Connect(host, port, timeout_ms)) {
+    delete c;
+    return nullptr;
+  }
+  return c;
+}
+
+void pd_store_client_free(void* h) { delete static_cast<StoreClient*>(h); }
+
+int pd_store_set(void* h, const char* key, const uint8_t* val, int len) {
+  auto* c = static_cast<StoreClient*>(h);
+  std::string k(key);
+  std::vector<char> payload(4 + len);
+  uint32_t vallen = static_cast<uint32_t>(len);
+  std::memcpy(payload.data(), &vallen, 4);
+  if (len) std::memcpy(payload.data() + 4, val, len);
+  std::unique_lock<std::mutex> lk(c->mu_);
+  uint8_t cmd = 0;
+  uint32_t keylen = static_cast<uint32_t>(k.size());
+  if (!send_all(c->fd(), &cmd, 1) || !send_all(c->fd(), &keylen, 4) ||
+      !send_all(c->fd(), k.data(), keylen) ||
+      !send_all(c->fd(), payload.data(), payload.size()))
+    return -1;
+  uint8_t resp;
+  return recv_all(c->fd(), &resp, 1) && resp == 1 ? 0 : -1;
+}
+
+// On success *out is malloc'd (caller frees with pd_store_free_buf).
+int pd_store_get(void* h, const char* key, uint8_t** out, int* out_len,
+                 int timeout_ms) {
+  auto* c = static_cast<StoreClient*>(h);
+  std::string k(key);
+  std::unique_lock<std::mutex> lk(c->mu_);
+  uint8_t cmd = 1;
+  uint32_t keylen = static_cast<uint32_t>(k.size());
+  int32_t tmo = timeout_ms;
+  if (!send_all(c->fd(), &cmd, 1) || !send_all(c->fd(), &keylen, 4) ||
+      !send_all(c->fd(), k.data(), keylen) || !send_all(c->fd(), &tmo, 4))
+    return -2;
+  int32_t status;
+  uint32_t vallen;
+  if (!recv_all(c->fd(), &status, 4) || !recv_all(c->fd(), &vallen, 4))
+    return -2;
+  if (vallen > 0) {
+    uint8_t* buf = static_cast<uint8_t*>(std::malloc(vallen));
+    if (!recv_all(c->fd(), buf, vallen)) {
+      std::free(buf);
+      return -2;
+    }
+    *out = buf;
+  } else {
+    *out = nullptr;
+  }
+  *out_len = static_cast<int>(vallen);
+  return status;
+}
+
+long long pd_store_add(void* h, const char* key, long long delta) {
+  auto* c = static_cast<StoreClient*>(h);
+  std::string k(key);
+  std::unique_lock<std::mutex> lk(c->mu_);
+  uint8_t cmd = 2;
+  uint32_t keylen = static_cast<uint32_t>(k.size());
+  int64_t d = delta;
+  if (!send_all(c->fd(), &cmd, 1) || !send_all(c->fd(), &keylen, 4) ||
+      !send_all(c->fd(), k.data(), keylen) || !send_all(c->fd(), &d, 8))
+    return INT64_MIN;
+  int64_t result;
+  if (!recv_all(c->fd(), &result, 8)) return INT64_MIN;
+  return result;
+}
+
+int pd_store_wait(void* h, const char* key, int timeout_ms) {
+  auto* c = static_cast<StoreClient*>(h);
+  std::string k(key);
+  std::unique_lock<std::mutex> lk(c->mu_);
+  uint8_t cmd = 3;
+  uint32_t keylen = static_cast<uint32_t>(k.size());
+  int32_t tmo = timeout_ms;
+  if (!send_all(c->fd(), &cmd, 1) || !send_all(c->fd(), &keylen, 4) ||
+      !send_all(c->fd(), k.data(), keylen) || !send_all(c->fd(), &tmo, 4))
+    return -2;
+  int32_t status;
+  if (!recv_all(c->fd(), &status, 4)) return -2;
+  return status;
+}
+
+int pd_store_check(void* h, const char* key) {
+  auto* c = static_cast<StoreClient*>(h);
+  std::string k(key);
+  std::unique_lock<std::mutex> lk(c->mu_);
+  uint8_t cmd = 4;
+  uint32_t keylen = static_cast<uint32_t>(k.size());
+  if (!send_all(c->fd(), &cmd, 1) || !send_all(c->fd(), &keylen, 4) ||
+      !send_all(c->fd(), k.data(), keylen))
+    return -2;
+  uint8_t exists;
+  if (!recv_all(c->fd(), &exists, 1)) return -2;
+  return exists;
+}
+
+void pd_store_free_buf(uint8_t* p) { std::free(p); }
+
+}  // extern "C"

@@ -200,6 +200,16 @@ class Optimizer:
     clear_gradients = clear_grad
 
     def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        # Static mode: attach this optimizer to the recording Program so the
+        # Executor compiles forward+backward+update into one XLA step
+        # (reference: append_backward + optimizer ops in the main program).
+        vid = getattr(loss, "_static_vid", None)
+        if vid is not None:
+            from ..static import program as static_program
+
+            if static_program.is_recording():
+                vid[0]._set_optimizer(self, loss)
+                return None, None
         loss.backward()
         self.step()
         return None, None

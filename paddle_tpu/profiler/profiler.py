@@ -207,7 +207,9 @@ class Profiler:
     # -- export / summary --------------------------------------------------
     def _export_chrome(self, path: str):
         events = []
-        for ev in recorder.events:
+        # same fallback as summary(): a closed RECORD window moves events
+        # into _collected and clears the live recorder
+        for ev in (recorder.events or self._collected):
             events.append({
                 "name": ev.name, "ph": "X", "pid": os.getpid(),
                 "tid": ev.tid % 2**31, "ts": ev.start_ns / 1e3,

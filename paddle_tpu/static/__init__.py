@@ -1,0 +1,30 @@
+"""paddle.static parity package (SURVEY.md §2.3): Program, Executor,
+program_guard, data, save/load + inference-model export. Design notes in
+``program.py`` — static graph = record once, replay under jax.jit.
+"""
+from ..jit.api import InputSpec
+from . import nn
+from .executor import CompiledProgram, Executor
+from .io import (
+    load,
+    load_inference_model,
+    save,
+    save_inference_model,
+)
+from .program import (
+    Program,
+    data,
+    default_main_program,
+    default_startup_program,
+    disable_static,
+    enable_static,
+    in_static_mode,
+    program_guard,
+)
+
+__all__ = [
+    "InputSpec", "nn", "CompiledProgram", "Executor", "Program", "data",
+    "default_main_program", "default_startup_program", "disable_static",
+    "enable_static", "in_static_mode", "program_guard", "load",
+    "load_inference_model", "save", "save_inference_model",
+]

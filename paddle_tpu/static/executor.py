@@ -1,0 +1,191 @@
+"""Static graph Executor: replay a Program as one compiled XLA step.
+
+Reference: paddle.static.Executor.run (base/executor.py:1608 →
+_StandaloneExecutor:816) over the C++ StandaloneExecutor/PirInterpreter
+instruction scheduler (SURVEY.md §3.4). The TPU-native executor has no
+instruction-level scheduler to write: the whole program (forward + backward +
+optimizer update when attached) is replayed into one pure JAX function and
+``jax.jit``-compiled — XLA's scheduler is the interpreter, its fusion is the
+pass pipeline, and the executable cache keyed on (program version, feed
+shapes, fetch set) is the `_ExecutorCache` (executor.py:854) equivalent.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..autograd.grad_mode import no_grad
+from ..framework import dtype as dtype_mod
+from ..tensor.tensor import Tensor
+from .program import Program, default_main_program
+
+
+class CompiledProgram:
+    """API-parity wrapper (reference: paddle.static.CompiledProgram). XLA
+    compiles every program; this just tags build options."""
+
+    def __init__(self, program: Program, build_strategy=None):
+        self._program = program
+        self._build_strategy = build_strategy
+
+
+def _program_of(p) -> Program:
+    return p._program if isinstance(p, CompiledProgram) else p
+
+
+class Executor:
+    def __init__(self, place=None):
+        self.place = place
+        self._cache: dict[tuple, Any] = {}
+
+    # -- public API --------------------------------------------------------
+    def run(self, program=None, feed=None, fetch_list=None,
+            return_numpy=True, **kwargs):
+        program = _program_of(program) if program is not None else (
+            default_main_program())
+        feed = dict(feed or {})
+        fetch_list = list(fetch_list or [])
+
+        fetch_vids = []
+        for f in fetch_list:
+            vid = getattr(f, "_static_vid", None)
+            if vid is None or vid[0] is not program._origin:
+                raise ValueError(
+                    f"fetch target {f!r} was not produced by this Program")
+            fetch_vids.append(vid[1])
+
+        if not fetch_vids and program._optimizer is None:
+            return []  # startup-program run: params initialized eagerly
+
+        feed_arrays = {}
+        for name, value in feed.items():
+            if isinstance(value, Tensor):
+                value = value._data
+            spec = program._feed_specs.get(name)
+            jdt = dtype_mod.to_jax_dtype(spec[1]) if spec else None
+            feed_arrays[name] = jnp.asarray(value, jdt)
+
+        key = (
+            id(program), program._version, tuple(fetch_vids),
+            tuple(sorted((n, a.shape, str(a.dtype))
+                         for n, a in feed_arrays.items())),
+        )
+        entry = self._cache.get(key)
+        if entry is None:
+            entry = self._build(program, fetch_vids)
+            self._cache[key] = entry
+        return entry(feed_arrays, return_numpy)
+
+    # -- compilation -------------------------------------------------------
+    def _build(self, program: Program, fetch_vids: list[int]):
+        opt = program._optimizer
+        with_opt = opt is not None
+        targets = set(fetch_vids)
+        if with_opt:
+            targets.add(program._loss_vid)
+        stmts = program.slice_for(targets)
+
+        pnames = sorted({ref for st in stmts
+                         for kind, ref in st.leaf_refs if kind == "p"})
+        params = {n: program._params[n] for n in pnames}
+        # feed vids the slice actually consumes
+        produced = {v for st in stmts for v in st.out_vids}
+        consumed = {ref for st in stmts
+                    for kind, ref in st.leaf_refs if kind == "v"}
+        needed_feeds = {name: vid for name, vid in program._feeds.items()
+                        if vid in (consumed | targets) and vid not in produced}
+
+        def replay(env, pvals):
+            for st in stmts:
+                leaf_vals = []
+                for kind, ref in st.leaf_refs:
+                    if kind == "v":
+                        leaf_vals.append(env[ref])
+                    elif kind == "p":
+                        leaf_vals.append(pvals[ref])
+                    else:
+                        leaf_vals.append(ref)
+                a, kw = jax.tree.unflatten(st.treedef, leaf_vals)
+                out = st.fn(*a, **kw)
+                for vid, val in zip(st.out_vids, jax.tree.flatten(out)[0]):
+                    env[vid] = val
+            return env
+
+        def seed_env(feed_arrays):
+            env = {}
+            for name, vid in needed_feeds.items():
+                if name not in feed_arrays:
+                    raise KeyError(
+                        f"Executor.run: program needs feed '{name}'")
+                env[vid] = feed_arrays[name]
+            return env
+
+        if not with_opt:
+            @jax.jit
+            def fwd(feed_arrays, pvals):
+                env = replay(seed_env(feed_arrays), pvals)
+                return [env[v] for v in fetch_vids]
+
+            def entry(feed_arrays, return_numpy):
+                pvals = {n: p._data for n, p in params.items()}
+                outs = fwd(feed_arrays, pvals)
+                return [np.asarray(o) if return_numpy else Tensor(o)
+                        for o in outs]
+
+            return entry
+
+        # training step: forward + grad + optimizer update, one executable
+        loss_vid = program._loss_vid
+        train_names = [n for n in pnames if not params[n].stop_gradient]
+        frozen_names = [n for n in pnames if params[n].stop_gradient]
+        train_params = [params[n] for n in train_names]
+        for p in train_params:
+            opt._ensure_state(p)
+        wds = [jnp.asarray(opt._param_decay_coeff(p), jnp.float32)
+               for p in train_params]
+        lr_scales = [jnp.asarray(opt._param_lr_scale(p), jnp.float32)
+                     for p in train_params]
+        grad_clip = opt._grad_clip
+
+        @jax.jit
+        def step(feed_arrays, train_arrays, frozen_arrays, lr, states,
+                 masters):
+            def loss_fn(train_arrays):
+                pvals = {**frozen_arrays, **train_arrays}
+                env = replay(seed_env(feed_arrays), pvals)
+                return env[loss_vid], [env[v] for v in fetch_vids]
+
+            (_, fetches), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(train_arrays)
+            plist = [train_arrays[n] for n in train_names]
+            glist = [grads[n] for n in train_names]
+            if grad_clip is not None:
+                with no_grad():
+                    pairs = [(Tensor(p), Tensor(g))
+                             for p, g in zip(plist, glist)]
+                    glist = [g._data for _, g in grad_clip(pairs)]
+            new_p, new_st, new_m = opt._batch_update(
+                lr, plist, glist, states, masters, wds, lr_scales)
+            return fetches, new_p, new_st, new_m
+
+        def entry(feed_arrays, return_numpy):
+            train_arrays = {n: params[n]._data for n in train_names}
+            frozen_arrays = {n: params[n]._data for n in frozen_names}
+            lr = jnp.asarray(opt.get_lr(), jnp.float32)
+            states = [opt._accumulators[id(p)] for p in train_params]
+            masters = [opt._master_weights.get(id(p)) for p in train_params]
+            fetches, new_p, new_st, new_m = step(
+                feed_arrays, train_arrays, frozen_arrays, lr, states, masters)
+            for p, pa, st, mw in zip(train_params, new_p, new_st, new_m):
+                p._data = pa
+                opt._accumulators[id(p)] = st
+                if mw is not None:
+                    opt._master_weights[id(p)] = mw
+            opt._after_step()
+            return [np.asarray(o) if return_numpy else Tensor(o)
+                    for o in fetches]
+
+        return entry

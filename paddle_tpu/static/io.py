@@ -1,0 +1,163 @@
+"""Static graph save/load: parameters and inference-model export.
+
+Reference: paddle.static.save/load (static/io.py state of a Program) and
+save_inference_model/load_inference_model producing deployable artifacts.
+TPU-native artifact = serialized StableHLO via ``jax.export`` (parameters
+baked or sided as .npz), the same format as paddle_tpu.jit.save, so the
+inference Predictor consumes both.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor.tensor import Tensor
+from .executor import Executor
+from .program import Program
+
+
+def save(program: Program, path: str) -> None:
+    """Save all parameters (and nothing else — the statement list is code,
+    re-created by re-running the construction; reference static.save saves
+    the param scope the same way)."""
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    arrays = {n: np.asarray(p._data) for n, p in program._params.items()}
+    with open(path + ".pdparams", "wb") as f:
+        pickle.dump(arrays, f)
+
+
+def load(program: Program, path: str, executor=None, var_list=None) -> None:
+    with open(path + ".pdparams", "rb") as f:
+        arrays = pickle.load(f)
+    for n, p in program._params.items():
+        if n in arrays:
+            p._data = jnp.asarray(arrays[n], p._data.dtype)
+
+
+def save_inference_model(path_prefix: str, feed_vars, fetch_vars,
+                         executor=None, program=None, **kwargs) -> None:
+    """Export the fetch-slice of a Program as StableHLO + weights.
+
+    Reference: paddle.static.save_inference_model prunes the program to the
+    feed→fetch slice and saves model+params; here the slice is replayed into
+    a pure function of the feeds (parameters passed as inputs so the .npz
+    stays separate and editable) and exported with dynamic leading dims.
+    """
+    from jax import export as jax_export
+
+    feed_vars = feed_vars if isinstance(feed_vars, (list, tuple)) else [feed_vars]
+    fetch_vars = fetch_vars if isinstance(fetch_vars, (list, tuple)) else [fetch_vars]
+    if program is None:
+        vid = getattr(fetch_vars[0], "_static_vid", None)
+        if vid is None:
+            raise ValueError("fetch_vars must come from a static Program")
+        program = vid[0]
+
+    fetch_vids = [f._static_vid[1] for f in fetch_vars]
+    stmts = program.slice_for(set(fetch_vids))
+    pnames = sorted({ref for st in stmts
+                     for kind, ref in st.leaf_refs if kind == "p"})
+    param_arrays = {n: program._params[n]._data for n in pnames}
+
+    feed_names, feed_vids = [], []
+    for v in feed_vars:
+        vid = v._static_vid[1]
+        name = next((n for n, fv in program._feeds.items() if fv == vid), None)
+        if name is None:
+            raise ValueError(f"feed var {v!r} is not a static.data placeholder")
+        feed_names.append(name)
+        feed_vids.append(vid)
+
+    def pure(params, *feed_datas):
+        env = dict(zip(feed_vids, feed_datas))
+        for st in stmts:
+            leaf_vals = []
+            for kind, ref in st.leaf_refs:
+                if kind == "v":
+                    leaf_vals.append(env[ref])
+                elif kind == "p":
+                    leaf_vals.append(params[ref])
+                else:
+                    leaf_vals.append(ref)
+            a, kw = jax.tree.unflatten(st.treedef, leaf_vals)
+            out = st.fn(*a, **kw)
+            for vid_, val in zip(st.out_vids, jax.tree.flatten(out)[0]):
+                env[vid_] = val
+        return tuple(env[v] for v in fetch_vids)
+
+    # Export with a shared symbolic batch dim wherever the declared spec had
+    # a dynamic dim; other dims use the declared static sizes.
+    scope = jax_export.SymbolicScope()
+    counter = [0]
+    arg_shapes = []
+    for name in feed_names:
+        shape, dtype = program._feed_specs[name]
+        dims = []
+        for d in shape:
+            if d is None or d == -1:
+                counter[0] += 1
+                dims.append(f"_dyn{counter[0]}")
+            else:
+                dims.append(str(int(d)))
+        from ..framework import dtype as dtype_mod
+
+        if any(d.startswith("_dyn") for d in dims):
+            sym = jax_export.symbolic_shape(", ".join(dims), scope=scope)
+            arg_shapes.append(
+                jax.ShapeDtypeStruct(sym, dtype_mod.to_jax_dtype(dtype)))
+        else:
+            arg_shapes.append(jax.ShapeDtypeStruct(
+                tuple(int(d) for d in shape), dtype_mod.to_jax_dtype(dtype)))
+
+    param_shapes = {n: jax.ShapeDtypeStruct(a.shape, a.dtype)
+                    for n, a in param_arrays.items()}
+    exported = jax_export.export(jax.jit(pure))(param_shapes, *arg_shapes)
+
+    d = os.path.dirname(path_prefix)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        f.write(exported.serialize())
+    np.savez(path_prefix + ".pdiparams.npz",
+             **{n: np.asarray(a) for n, a in param_arrays.items()})
+    with open(path_prefix + ".pdmeta", "wb") as f:
+        pickle.dump({"feed_names": feed_names,
+                     "param_names": pnames,
+                     "format": "stablehlo-static-v1"}, f)
+
+
+class _LoadedInferenceProgram:
+    """Stands in for the pruned inference Program after load; Executor.run
+    accepts it via duck typing in load_inference_model's returned closure."""
+
+    def __init__(self, exported, params, feed_names):
+        self._exported = exported
+        self._params = params
+        self._feed_names = feed_names
+
+    def run(self, feed: dict):
+        datas = [jnp.asarray(feed[n]) for n in self._feed_names]
+        return [np.asarray(o) for o in self._exported.call(self._params, *datas)]
+
+
+def load_inference_model(path_prefix: str, executor=None):
+    """Returns [program, feed_target_names, fetch_targets] like the
+    reference; fetch_targets are opaque handles — pass them (or not) to
+    ``executor.run``-style calls on the returned program."""
+    from jax import export as jax_export
+
+    with open(path_prefix + ".pdmodel", "rb") as f:
+        exported = jax_export.deserialize(f.read())
+    params = {n: jnp.asarray(a)
+              for n, a in np.load(path_prefix + ".pdiparams.npz").items()}
+    with open(path_prefix + ".pdmeta", "rb") as f:
+        meta = pickle.load(f)
+    prog = _LoadedInferenceProgram(exported, params, meta["feed_names"])
+    fetch_targets = list(range(len(exported.out_avals)))
+    return [prog, meta["feed_names"], fetch_targets]

@@ -1,0 +1,58 @@
+"""paddle.static.nn — thin functional wrappers over the nn layers.
+
+Reference: python/paddle/static/nn/common.py (fc, embedding, batch_norm…)
+which append ops + parameters to the current program. Here the nn.Layer
+machinery already records through the engine hook while a Program is
+recording, so these wrappers just construct a layer once and apply it.
+"""
+from __future__ import annotations
+
+from .. import nn
+from ..tensor.tensor import Tensor
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    in_features = 1
+    for d in x.shape[num_flatten_dims:]:
+        in_features *= int(d)
+    layer = nn.Linear(in_features, size,
+                      weight_attr=weight_attr, bias_attr=bias_attr)
+    # -1 keeps the leading (batch) extent symbolic so the recorded reshape
+    # replays at any feed batch size
+    if num_flatten_dims == 1:
+        flat = x.reshape([-1, in_features])
+    else:
+        flat = x.reshape(list(x.shape[:num_flatten_dims]) + [in_features])
+    out = layer(flat)
+    if activation:
+        out = getattr(nn.functional, activation)(out)
+    return out
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None,
+              param_attr=None, dtype="float32"):
+    layer = nn.Embedding(size[0], size[1], padding_idx=padding_idx,
+                         weight_attr=param_attr)
+    return layer(input)
+
+
+def batch_norm(input, is_test=False, momentum=0.9, epsilon=1e-05,
+               param_attr=None, bias_attr=None, data_layout="NCHW", **kw):
+    num = int(input.shape[1 if data_layout == "NCHW" else -1])
+    layer = nn.BatchNorm2D(num, momentum=momentum, epsilon=epsilon,
+                           weight_attr=param_attr, bias_attr=bias_attr,
+                           data_format=data_layout)
+    if is_test:
+        layer.eval()
+    return layer(input)
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, data_format="NCHW"):
+    in_channels = int(input.shape[1 if data_format == "NCHW" else -1])
+    layer = nn.Conv2D(in_channels, num_filters, filter_size, stride=stride,
+                      padding=padding, dilation=dilation, groups=groups,
+                      weight_attr=param_attr, bias_attr=bias_attr,
+                      data_format=data_format)
+    return layer(input)

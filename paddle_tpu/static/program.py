@@ -1,0 +1,268 @@
+"""Static graph Program: record-and-replay over the eager engine.
+
+Reference: ProgramDesc / Block / Operator protobuf graphs plus the python
+mirror (framework/framework.proto:267, base/framework.py) that
+``paddle.static`` users build under ``program_guard`` and run with an
+Executor (SURVEY.md §2.3).
+
+TPU-native design ("one IR", SURVEY.md §7.1): there is no separate op-desc
+IR. Graph construction *executes eagerly once* (define-by-run), and while a
+Program is recording, every op that flows through the autograd engine's
+``apply_op`` appends a replayable statement ``(pure_fn, input refs, output
+ids)``. The Executor replays the statement list as a pure JAX function of
+(feeds, parameters) and hands it to ``jax.jit`` — the compiled XLA
+executable is the static graph. Benefits over a translated ProgramDesc:
+construction-time python control flow is baked exactly like the reference's
+static mode, shapes stay polymorphic until compile, and dead statements
+(e.g. initializer ops that belong in the reference's startup program) are
+pruned by the backward slice from the fetch targets.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Any, Callable
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..autograd import engine
+from ..framework import dtype as dtype_mod
+from ..tensor.tensor import Parameter, Tensor
+
+_vid_counter = itertools.count(1)
+
+
+class Statement:
+    """One recorded op: replayable pure function + argument references.
+
+    ``leaf_refs`` mirrors the flattened (args, kwargs) pytree; each entry is
+    ``("v", vid)`` for a produced-in-program variable, ``("p", name)`` for a
+    Parameter (lives in the scope, updatable between runs), or
+    ``("c", value)`` for a captured constant / python literal.
+    """
+
+    __slots__ = ("name", "fn", "treedef", "leaf_refs", "out_vids")
+
+    def __init__(self, name, fn, treedef, leaf_refs, out_vids):
+        self.name = name
+        self.fn = fn
+        self.treedef = treedef
+        self.leaf_refs = leaf_refs
+        self.out_vids = out_vids
+
+
+class Program:
+    """A recorded computation: feed placeholders -> statements -> variables.
+
+    API parity: ``paddle.static.Program`` (global_block/parameters/clone);
+    the op container role of Block collapses into the flat statement list
+    (control flow is baked at construction, like reference static mode with
+    the AST transformer resolved).
+    """
+
+    def __init__(self):
+        self._origin = self  # clones share identity for var ownership checks
+        self._statements: list[Statement] = []
+        self._feeds: dict[str, int] = {}
+        self._feed_specs: dict[str, tuple] = {}
+        self._params: dict[str, Parameter] = {}
+        self._optimizer = None
+        self._loss_vid: int | None = None
+        self._version = 0
+        self._var_names: dict[int, str] = {}
+        self.random_seed = None
+
+    # -- recording ---------------------------------------------------------
+    def _record(self, name, fn, treedef, leaves, out_tensors):
+        leaf_refs = []
+        for leaf in leaves:
+            if isinstance(leaf, Parameter):
+                pname = leaf.name
+                self._params[pname] = leaf
+                leaf_refs.append(("p", pname))
+            elif isinstance(leaf, Tensor):
+                vid = getattr(leaf, "_static_vid", None)
+                if vid is not None and vid[0] is self._origin:
+                    leaf_refs.append(("v", vid[1]))
+                else:
+                    leaf_refs.append(("c", leaf._data))
+            else:
+                leaf_refs.append(("c", leaf))
+        out_vids = []
+        for t in out_tensors:
+            vid = next(_vid_counter)
+            t._static_vid = (self, vid)
+            out_vids.append(vid)
+        self._statements.append(
+            Statement(name, fn, treedef, leaf_refs, out_vids))
+        self._version += 1
+
+    def _add_feed(self, name, tensor, shape, dtype):
+        vid = next(_vid_counter)
+        tensor._static_vid = (self, vid)
+        self._feeds[name] = vid
+        self._feed_specs[name] = (tuple(shape), dtype)
+        self._var_names[vid] = name
+        self._version += 1
+
+    def _set_optimizer(self, optimizer, loss):
+        vid = getattr(loss, "_static_vid", None)
+        if vid is None or vid[0] is not self._origin:
+            raise ValueError(
+                "minimize(loss): loss was not produced inside this Program")
+        self._optimizer = optimizer
+        self._loss_vid = vid[1]
+        self._version += 1
+
+    # -- introspection -----------------------------------------------------
+    def parameters(self):
+        return list(self._params.values())
+
+    def all_parameters(self):
+        return self.parameters()
+
+    def global_block(self):
+        return self  # Block/Program collapse; `vars` access via feeds
+
+    def list_vars(self):
+        return list(self._feeds)
+
+    def num_ops(self):
+        return len(self._statements)
+
+    def clone(self, for_test: bool = False):
+        """Share the recorded graph (reference Program.clone shares params).
+
+        ``for_test=True`` parity note: the reference strips optimizer ops;
+        here the Executor only replays the slice needed for the requested
+        fetches and skips the optimizer unless it was attached AND the run
+        asks for training, so the clone can share everything.
+        """
+        p = Program.__new__(Program)
+        p.__dict__.update(self.__dict__)
+        if for_test:
+            p._optimizer = None
+            p._loss_vid = None
+        return p
+
+    # -- slicing for execution --------------------------------------------
+    def slice_for(self, target_vids: set[int]) -> list[Statement]:
+        """Backward slice: the statements (in order) needed to compute the
+        targets from feeds/params/constants. Prunes initializer ops and any
+        construction-time side computation (startup-program parity)."""
+        needed: set[int] = set(target_vids)
+        keep: list[Statement] = []
+        for stmt in reversed(self._statements):
+            if any(v in needed for v in stmt.out_vids):
+                keep.append(stmt)
+                for kind, ref in stmt.leaf_refs:
+                    if kind == "v":
+                        needed.add(ref)
+        keep.reverse()
+        return keep
+
+    def __repr__(self):
+        return (f"Program(feeds={list(self._feeds)}, "
+                f"ops={len(self._statements)}, params={len(self._params)})")
+
+
+# ---------------------------------------------------------------------------
+# default programs + guards (reference: base/framework.py program stack)
+# ---------------------------------------------------------------------------
+
+_state = threading.local()
+
+
+def _tls():
+    if not hasattr(_state, "main"):
+        _state.main = Program()
+        _state.startup = Program()
+        _state.recording = False
+    return _state
+
+
+def default_main_program() -> Program:
+    return _tls().main
+
+
+def default_startup_program() -> Program:
+    return _tls().startup
+
+
+def _install_hook():
+    tls = _tls()
+    engine.static_record_hook = tls.main._record
+    tls.recording = True
+
+
+def _uninstall_hook():
+    engine.static_record_hook = None
+    _tls().recording = False
+
+
+def is_recording() -> bool:
+    return getattr(_state, "recording", False)
+
+
+class program_guard:
+    """``with program_guard(main, startup):`` — ops record into ``main``.
+
+    The startup program is accepted for API parity; parameter initialization
+    runs eagerly at layer construction (its ops are pruned from the main
+    slice), so startup replay is a no-op.
+    """
+
+    def __init__(self, main_program: Program, startup_program: Program | None = None):
+        self._main = main_program
+        self._startup = startup_program
+
+    def __enter__(self):
+        tls = _tls()
+        self._saved = (tls.main, tls.startup, engine.static_record_hook,
+                       tls.recording)
+        tls.main = self._main
+        if self._startup is not None:
+            tls.startup = self._startup
+        _install_hook()
+        return self
+
+    def __exit__(self, *exc):
+        tls = _tls()
+        tls.main, tls.startup, engine.static_record_hook, tls.recording = (
+            self._saved)
+        return False
+
+
+def data(name: str, shape, dtype="float32", lod_level=0) -> Tensor:
+    """Feed placeholder (reference: paddle.static.data). Dynamic dims
+    (None/-1) are concretized to 1 for the construction pass; the Executor
+    re-traces per concrete feed shape (guard-keyed jit cache), so any batch
+    size can be fed at run time."""
+    del lod_level
+    if not is_recording():
+        raise RuntimeError(
+            "paddle.static.data() must be called under paddle.enable_static()"
+            " or program_guard")
+    concrete = tuple(1 if (d is None or d == -1) else int(d) for d in shape)
+    jdt = dtype_mod.to_jax_dtype(dtype)
+    t = Tensor(jnp.zeros(concrete, jdt), stop_gradient=True)
+    t.name = name
+    default_main_program()._add_feed(name, t, shape, dtype)
+    return t
+
+
+def enable_static():
+    """Switch to static graph mode: subsequent ops record into the default
+    main program (reference: paddle.enable_static — idempotent; the default
+    programs persist across enable/disable cycles like the reference's
+    module-level program stack)."""
+    _install_hook()
+
+
+def disable_static():
+    _uninstall_hook()
+
+
+def in_static_mode() -> bool:
+    return is_recording()

@@ -1,0 +1,182 @@
+"""paddle.static parity: Program recording, Executor replay+jit, training
+step with minimize, batch-size polymorphism, save/load, inference export.
+
+Mirrors the reference's test/standalone_executor + static API tests
+(SURVEY.md §4): numeric oracle is the eager run of the same layers.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, static
+from paddle_tpu.optimizer import SGD, Adam
+
+
+@pytest.fixture(autouse=True)
+def _always_dynamic_after():
+    yield
+    paddle.disable_static()
+
+
+def test_program_record_and_run(rng):
+    main = static.Program()
+    startup = static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, 4], "float32")
+        layer = nn.Linear(4, 3)
+        y = layer(x)
+        out = paddle.nn.functional.relu(y)
+    assert main.num_ops() >= 2
+    assert "x" in main.list_vars()
+
+    exe = static.Executor()
+    exe.run(startup)
+    feed_x = rng.randn(5, 4).astype("float32")
+    (got,) = exe.run(main, feed={"x": feed_x}, fetch_list=[out])
+
+    w = np.asarray(layer.weight._data)
+    b = np.asarray(layer.bias._data)
+    want = np.maximum(feed_x @ w + b, 0)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+    assert got.shape == (5, 4)[:1] + (3,)
+
+
+def test_batch_size_polymorphic(rng):
+    paddle.enable_static()
+    x = static.data("x", [None, 8], "float32")
+    y = (x * 2.0).sum(axis=1)
+    exe = static.Executor()
+    for bs in (1, 7):
+        arr = rng.randn(bs, 8).astype("float32")
+        (got,) = exe.run(static.default_main_program(),
+                         feed={"x": arr}, fetch_list=[y])
+        np.testing.assert_allclose(got, (arr * 2).sum(1), rtol=1e-5)
+        assert got.shape == (bs,)
+    paddle.disable_static()
+
+
+def test_training_with_minimize(rng):
+    """Full static train loop: loss decreases and matches an eager twin."""
+    paddle.seed(7)
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 4], "float32")
+        label = static.data("label", [None, 1], "float32")
+        layer = nn.Linear(4, 1)
+        pred = layer(x)
+        loss = ((pred - label) ** 2).mean()
+        opt = SGD(learning_rate=0.1, parameters=layer.parameters())
+        opt.minimize(loss)
+
+    # eager twin with identical init
+    paddle.seed(7)
+    twin = nn.Linear(4, 1)
+    topt = SGD(learning_rate=0.1, parameters=twin.parameters())
+    np.testing.assert_allclose(np.asarray(layer.weight._data),
+                               np.asarray(twin.weight._data))
+
+    exe = static.Executor()
+    xs = rng.randn(16, 4).astype("float32")
+    ys = (xs @ rng.randn(4, 1) + 0.3).astype("float32")
+    losses = []
+    for _ in range(5):
+        (lv,) = exe.run(main, feed={"x": xs, "label": ys},
+                        fetch_list=[loss])
+        losses.append(float(lv))
+        # twin step
+        tp = twin(paddle.to_tensor(xs))
+        tl = ((tp - paddle.to_tensor(ys)) ** 2).mean()
+        tl.backward()
+        topt.step()
+        topt.clear_grad()
+    assert losses[-1] < losses[0]
+    np.testing.assert_allclose(np.asarray(layer.weight._data),
+                               np.asarray(twin.weight._data), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_adam_training_step(rng):
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 6], "float32")
+        layer = nn.Linear(6, 2)
+        loss = layer(x).square().mean()
+        opt = Adam(learning_rate=0.01, parameters=layer.parameters())
+        opt.minimize(loss)
+    exe = static.Executor()
+    arr = rng.randn(8, 6).astype("float32")
+    first = float(exe.run(main, feed={"x": arr}, fetch_list=[loss])[0])
+    for _ in range(10):
+        last = float(exe.run(main, feed={"x": arr}, fetch_list=[loss])[0])
+    assert last < first
+
+
+def test_clone_for_test_drops_optimizer(rng):
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 3], "float32")
+        layer = nn.Linear(3, 3)
+        loss = layer(x).mean()
+        SGD(learning_rate=0.1, parameters=layer.parameters()).minimize(loss)
+    test_prog = main.clone(for_test=True)
+    exe = static.Executor()
+    w_before = np.asarray(layer.weight._data).copy()
+    exe.run(test_prog, feed={"x": rng.randn(2, 3).astype("float32")},
+            fetch_list=[loss])
+    np.testing.assert_array_equal(np.asarray(layer.weight._data), w_before)
+
+
+def test_save_load_params(tmp_path, rng):
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 4], "float32")
+        layer = nn.Linear(4, 2)
+        out = layer(x)
+    static.save(main, str(tmp_path / "ckpt"))
+    orig = np.asarray(layer.weight._data).copy()
+    layer.weight._data = layer.weight._data * 0
+    static.load(main, str(tmp_path / "ckpt"))
+    np.testing.assert_array_equal(np.asarray(layer.weight._data), orig)
+
+
+def test_save_load_inference_model(tmp_path, rng):
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 4], "float32")
+        layer = nn.Linear(4, 3)
+        out = paddle.nn.functional.softmax(layer(x))
+    exe = static.Executor()
+    prefix = str(tmp_path / "infer")
+    static.save_inference_model(prefix, [x], [out], exe)
+
+    prog, feed_names, fetch_targets = static.load_inference_model(prefix)
+    assert feed_names == ["x"]
+    arr = rng.randn(6, 4).astype("float32")
+    (got,) = prog.run({"x": arr})
+    (want,) = exe.run(main, feed={"x": arr}, fetch_list=[out])
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_static_nn_fc(rng):
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 5], "float32")
+        out = static.nn.fc(x, 4, activation="relu")
+    exe = static.Executor()
+    (got,) = exe.run(main, feed={"x": rng.randn(3, 5).astype("float32")},
+                     fetch_list=[out])
+    assert got.shape == (3, 4)
+    assert (got >= 0).all()
+
+
+def test_enable_disable_static_mode():
+    assert paddle.in_dynamic_mode()
+    paddle.enable_static()
+    assert not paddle.in_dynamic_mode()
+    x = static.data("x", [2, 2], "float32")
+    y = x + 1.0
+    paddle.disable_static()
+    assert paddle.in_dynamic_mode()
+    # eager still works after
+    t = paddle.to_tensor(np.ones((2, 2), np.float32))
+    assert float((t + 1).sum()) == 8.0

@@ -1,0 +1,107 @@
+"""Native TCPStore: single-process semantics + real multi-process rendezvous.
+
+Mirrors the reference's store tests (distributed bootstrap is always real
+processes over localhost — SURVEY.md §4), scaled to the unit level: one
+server, N client processes, set/get/add/wait/barrier cross-checked.
+"""
+import multiprocessing as mp
+import os
+
+import pytest
+
+from paddle_tpu.distributed.store import TCPStore
+
+
+def test_set_get_roundtrip():
+    store = TCPStore(is_master=True, world_size=1)
+    try:
+        store.set("alpha", b"hello")
+        assert store.get("alpha") == b"hello"
+        store.set("alpha", "rewritten")  # str accepted
+        assert store.get("alpha") == b"rewritten"
+        assert store.check("alpha")
+        assert not store.check("missing")
+    finally:
+        store.close()
+
+
+def test_add_counter_and_empty_value():
+    store = TCPStore(is_master=True, world_size=1)
+    try:
+        assert store.add("ctr", 3) == 3
+        assert store.add("ctr", -1) == 2
+        store.set("empty", b"")
+        assert store.get("empty") == b""
+    finally:
+        store.close()
+
+
+def test_get_timeout():
+    store = TCPStore(is_master=True, world_size=1, timeout=0.2)
+    try:
+        with pytest.raises(TimeoutError):
+            store.get("never-set")
+        with pytest.raises(TimeoutError):
+            store.wait(["never-set"], timeout=0.2)
+    finally:
+        store.close()
+
+
+def _worker(rank, world, port, q):
+    try:
+        store = TCPStore("127.0.0.1", port, is_master=False,
+                         world_size=world, timeout=20)
+        store.set(f"rank/{rank}", f"payload-{rank}")
+        store.barrier("publish")
+        peers = sorted(
+            store.get(f"rank/{r}").decode() for r in range(world))
+        total = store.add("sum", rank + 1)
+        store.barrier("done")
+        final = int(store.get("sum"))
+        q.put((rank, peers, final, total <= final))
+        store.close()
+    except Exception as e:  # pragma: no cover - surfaced via queue
+        q.put((rank, "ERROR", repr(e), False))
+
+
+def test_multiprocess_rendezvous():
+    world = 4
+    master = TCPStore(is_master=True, world_size=world, timeout=20)
+    try:
+        ctx = mp.get_context("spawn")
+        q = ctx.Queue()
+        procs = [
+            ctx.Process(target=_worker, args=(r, world, master.port, q))
+            for r in range(world)
+        ]
+        for p in procs:
+            p.start()
+        results = [q.get(timeout=60) for _ in range(world)]
+        for p in procs:
+            p.join(timeout=30)
+        expect_peers = sorted(f"payload-{r}" for r in range(world))
+        expect_sum = sum(range(1, world + 1))
+        for rank, peers, final, mono in results:
+            assert peers != "ERROR", f"rank {rank}: {final}"
+            assert peers == expect_peers
+            assert final == expect_sum
+            assert mono
+    finally:
+        master.close()
+
+
+def test_global_store_from_env(monkeypatch):
+    import paddle_tpu.distributed.store as store_mod
+
+    monkeypatch.setattr(store_mod, "_global_store", None)
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "0")
+    monkeypatch.setenv("PADDLE_TRAINERS_NUM", "1")
+    monkeypatch.setenv("PADDLE_MASTER", "127.0.0.1:0")
+    s = store_mod.create_or_get_global_tcp_store()
+    try:
+        assert store_mod.create_or_get_global_tcp_store() is s
+        s.set("k", b"v")
+        assert s.get("k") == b"v"
+    finally:
+        s.close()
+        store_mod._global_store = None
