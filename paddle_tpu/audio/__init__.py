@@ -1,0 +1,9 @@
+"""paddle.audio parity (SURVEY.md §2.8): features + functional + wav IO.
+
+Reference: python/paddle/audio (features/layers.py, functional/, backends/
+— soundfile-backed load/save). The backend here is the stdlib ``wave``
+module (PCM16/PCM32), keeping the build dependency-free.
+"""
+from . import backends, features, functional
+
+__all__ = ["features", "functional", "backends"]

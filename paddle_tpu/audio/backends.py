@@ -1,0 +1,63 @@
+"""WAV load/save (reference: paddle.audio.backends wave_backend.py —
+stdlib-wave PCM IO with normalize semantics)."""
+from __future__ import annotations
+
+import wave
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor.tensor import Tensor
+
+
+class AudioInfo:
+    def __init__(self, sample_rate, num_samples, num_channels, bits_per_sample):
+        self.sample_rate = sample_rate
+        self.num_frames = num_samples
+        self.num_channels = num_channels
+        self.bits_per_sample = bits_per_sample
+
+
+def info(filepath: str) -> AudioInfo:
+    with wave.open(filepath, "rb") as f:
+        return AudioInfo(f.getframerate(), f.getnframes(), f.getnchannels(),
+                         f.getsampwidth() * 8)
+
+
+def load(filepath: str, frame_offset: int = 0, num_frames: int = -1,
+         normalize: bool = True, channels_first: bool = True):
+    """Returns (Tensor [C, T] (or [T, C]), sample_rate)."""
+    with wave.open(filepath, "rb") as f:
+        sr = f.getframerate()
+        n_channels = f.getnchannels()
+        width = f.getsampwidth()
+        f.setpos(frame_offset)
+        n = f.getnframes() - frame_offset if num_frames < 0 else num_frames
+        raw = f.readframes(n)
+    dtype = {1: np.uint8, 2: np.int16, 4: np.int32}[width]
+    data = np.frombuffer(raw, dtype=dtype).reshape(-1, n_channels)
+    if width == 1:
+        data = data.astype(np.int16) - 128  # 8-bit wav is unsigned
+    if normalize:
+        full_scale = {1: 128.0, 2: 32768.0, 4: 2147483648.0}[width]
+        data = data.astype(np.float32) / full_scale
+    arr = data.T if channels_first else data
+    return Tensor(jnp.asarray(arr)), sr
+
+
+def save(filepath: str, src: Tensor, sample_rate: int,
+         channels_first: bool = True, encoding: str = "PCM_16",
+         bits_per_sample: int = 16):
+    data = np.asarray(src._data if isinstance(src, Tensor) else src)
+    if channels_first:
+        data = data.T  # -> [T, C]
+    if data.ndim == 1:
+        data = data[:, None]
+    if data.dtype in (np.float32, np.float64):
+        data = np.clip(data, -1.0, 1.0)
+        data = (data * 32767).astype(np.int16)
+    with wave.open(filepath, "wb") as f:
+        f.setnchannels(data.shape[1])
+        f.setsampwidth(2)
+        f.setframerate(sample_rate)
+        f.writeframes(data.astype("<i2").tobytes())

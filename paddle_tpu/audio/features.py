@@ -1,0 +1,133 @@
+"""paddle.audio.features parity: Spectrogram / MelSpectrogram /
+LogMelSpectrogram / MFCC Layers.
+
+Reference: python/paddle/audio/features/layers.py. STFT framed as an XLA
+conv-free gather + rfft: frames are gathered with a strided window, the
+windowed frames go through jnp.fft.rfft — everything jits onto TPU.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..autograd.engine import apply_op
+from ..nn.layer.layers import Layer
+from ..tensor.tensor import Tensor
+from .functional import (
+    compute_fbank_matrix,
+    create_dct,
+    get_window,
+    power_to_db,
+)
+
+
+def _stft(x, n_fft, hop_length, win_length, window, center, pad_mode):
+    """x: [..., T] -> complex [..., n_fft//2+1, frames]."""
+    if center:
+        pad = [(0, 0)] * (x.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+        x = jnp.pad(x, pad, mode=pad_mode)
+    T = x.shape[-1]
+    n_frames = 1 + (T - n_fft) // hop_length
+    idx = (jnp.arange(n_frames)[:, None] * hop_length
+           + jnp.arange(n_fft)[None, :])
+    frames = x[..., idx]  # [..., frames, n_fft]
+    w = window
+    if win_length < n_fft:
+        lpad = (n_fft - win_length) // 2
+        w = jnp.pad(window, (lpad, n_fft - win_length - lpad))
+    spec = jnp.fft.rfft(frames * w, n=n_fft, axis=-1)
+    return jnp.moveaxis(spec, -1, -2)  # [..., freq, frames]
+
+
+class Spectrogram(Layer):
+    def __init__(self, n_fft: int = 512, hop_length: int | None = None,
+                 win_length: int | None = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", dtype="float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        self.window = get_window(window, self.win_length)._data
+
+    def forward(self, x: Tensor) -> Tensor:
+        def fn(v):
+            spec = _stft(v, self.n_fft, self.hop_length, self.win_length,
+                         self.window, self.center, self.pad_mode)
+            return jnp.abs(spec) ** self.power
+
+        return apply_op("spectrogram", fn, x)
+
+
+class MelSpectrogram(Layer):
+    def __init__(self, sr: int = 22050, n_fft: int = 512,
+                 hop_length: int | None = None, win_length: int | None = None,
+                 window: str = "hann", power: float = 2.0,
+                 center: bool = True, pad_mode: str = "reflect",
+                 n_mels: int = 64, f_min: float = 50.0,
+                 f_max: float | None = None, htk: bool = False,
+                 norm: str = "slaney", dtype="float32"):
+        super().__init__()
+        self._spectrogram = Spectrogram(n_fft, hop_length, win_length,
+                                        window, power, center, pad_mode)
+        self.fbank = compute_fbank_matrix(
+            sr, n_fft, n_mels, f_min, f_max, htk, norm)._data
+
+    def forward(self, x: Tensor) -> Tensor:
+        spec = self._spectrogram(x)
+
+        def fn(s):
+            return jnp.einsum("mf,...ft->...mt", self.fbank, s)
+
+        return apply_op("mel_spectrogram", fn, spec)
+
+
+class LogMelSpectrogram(Layer):
+    def __init__(self, sr: int = 22050, n_fft: int = 512,
+                 hop_length: int | None = None, win_length: int | None = None,
+                 window: str = "hann", power: float = 2.0,
+                 center: bool = True, pad_mode: str = "reflect",
+                 n_mels: int = 64, f_min: float = 50.0,
+                 f_max: float | None = None, htk: bool = False,
+                 norm: str = "slaney", ref_value: float = 1.0,
+                 amin: float = 1e-10, top_db: float | None = None,
+                 dtype="float32"):
+        super().__init__()
+        self._melspectrogram = MelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x: Tensor) -> Tensor:
+        mel = self._melspectrogram(x)
+        return power_to_db(mel, self.ref_value, self.amin, self.top_db)
+
+
+class MFCC(Layer):
+    def __init__(self, sr: int = 22050, n_mfcc: int = 40, n_fft: int = 512,
+                 hop_length: int | None = None, win_length: int | None = None,
+                 window: str = "hann", power: float = 2.0,
+                 center: bool = True, pad_mode: str = "reflect",
+                 n_mels: int = 64, f_min: float = 50.0,
+                 f_max: float | None = None, htk: bool = False,
+                 norm: str = "slaney", ref_value: float = 1.0,
+                 amin: float = 1e-10, top_db: float | None = None,
+                 dtype="float32"):
+        super().__init__()
+        self._log_melspectrogram = LogMelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm, ref_value, amin,
+            top_db)
+        self.dct = create_dct(n_mfcc, n_mels)._data
+
+    def forward(self, x: Tensor) -> Tensor:
+        logmel = self._log_melspectrogram(x)
+
+        def fn(m):
+            return jnp.einsum("mk,...mt->...kt", self.dct, m)
+
+        return apply_op("mfcc", fn, logmel)

@@ -1,0 +1,163 @@
+"""paddle.audio.functional parity: windows, mel filterbanks, DCT, dB.
+
+Reference: python/paddle/audio/functional/{window.py,functional.py}. All
+pure jnp — these feed the feature Layers which run under jit on TPU.
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+from ..autograd.engine import apply_op
+from ..tensor.tensor import Tensor
+
+
+def _window_array(window: str, win_length: int, fftbins: bool = True,
+                  **kwargs):
+    N = win_length if fftbins else win_length - 1
+    n = jnp.arange(win_length, dtype=jnp.float32)
+    if window in ("hann", "hanning"):
+        return 0.5 - 0.5 * jnp.cos(2 * math.pi * n / N)
+    if window in ("hamming",):
+        return 0.54 - 0.46 * jnp.cos(2 * math.pi * n / N)
+    if window in ("blackman",):
+        return (0.42 - 0.5 * jnp.cos(2 * math.pi * n / N)
+                + 0.08 * jnp.cos(4 * math.pi * n / N))
+    if window in ("bartlett", "triang"):
+        return 1 - jnp.abs(2 * n / N - 1)
+    if window in ("rect", "ones", "boxcar"):
+        return jnp.ones(win_length, jnp.float32)
+    if window == "gaussian":
+        std = kwargs.get("std", 7.0)
+        return jnp.exp(-0.5 * ((n - N / 2) / std) ** 2)
+    if window == "exponential":
+        tau = kwargs.get("tau", 1.0)
+        return jnp.exp(-jnp.abs(n - N / 2) / tau)
+    if window == "taylor":
+        # 4-term Taylor window, 30 dB sidelobe (reference default)
+        nbar, sll = 4, 30.0
+        B = 10 ** (sll / 20)
+        A = jnp.arccosh(B) / math.pi
+        s2 = nbar ** 2 / (A ** 2 + (nbar - 0.5) ** 2)
+        ma = jnp.arange(1, nbar, dtype=jnp.float32)
+        Fm = []
+        for mi in range(1, nbar):
+            numer = (-1) ** (mi + 1) * jnp.prod(
+                1 - mi ** 2 / s2 / (A ** 2 + (ma - 0.5) ** 2))
+            denom = 2 * jnp.prod(
+                jnp.where(ma != mi, 1 - mi ** 2 / ma ** 2, 1.0))
+            Fm.append(numer / denom)
+        Fm = jnp.stack(Fm)
+        x = (n - (win_length - 1) / 2) / win_length
+        w = jnp.ones(win_length)
+        for mi in range(1, nbar):
+            w = w + 2 * Fm[mi - 1] * jnp.cos(2 * math.pi * mi * x)
+        return w / w.max()
+    raise ValueError(f"unsupported window: {window}")
+
+
+def get_window(window, win_length: int, fftbins: bool = True) -> Tensor:
+    if isinstance(window, tuple):
+        name, param = window[0], window[1]
+        kw = ({"std": param} if name == "gaussian"
+              else {"tau": param} if name == "exponential" else {})
+        return Tensor(_window_array(name, win_length, fftbins, **kw))
+    return Tensor(_window_array(window, win_length, fftbins))
+
+
+def hz_to_mel(freq, htk: bool = False):
+    scalar = not isinstance(freq, Tensor)
+    f = jnp.asarray(freq._data if isinstance(freq, Tensor) else freq,
+                    jnp.float32)
+    if htk:
+        mel = 2595.0 * jnp.log10(1.0 + f / 700.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        mel = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        mel = jnp.where(f >= min_log_hz,
+                        min_log_mel + jnp.log(jnp.maximum(f, 1e-10) / min_log_hz) / logstep,
+                        mel)
+    return float(mel) if scalar else Tensor(mel)
+
+
+def mel_to_hz(mel, htk: bool = False):
+    scalar = not isinstance(mel, Tensor)
+    m = jnp.asarray(mel._data if isinstance(mel, Tensor) else mel,
+                    jnp.float32)
+    if htk:
+        hz = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        hz = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        hz = jnp.where(m >= min_log_mel,
+                       min_log_hz * jnp.exp(logstep * (m - min_log_mel)), hz)
+    return float(hz) if scalar else Tensor(hz)
+
+
+def compute_fbank_matrix(sr: int, n_fft: int, n_mels: int = 64,
+                         f_min: float = 0.0, f_max: float | None = None,
+                         htk: bool = False, norm: str = "slaney",
+                         dtype="float32") -> Tensor:
+    """[n_mels, n_fft//2+1] triangular mel filterbank (reference:
+    audio/functional/functional.py compute_fbank_matrix)."""
+    f_max = f_max or sr / 2.0
+    fft_freqs = jnp.linspace(0, sr / 2, n_fft // 2 + 1)
+    mel_min = hz_to_mel(f_min, htk)
+    mel_max = hz_to_mel(f_max, htk)
+    mel_pts = jnp.linspace(mel_min, mel_max, n_mels + 2)
+    hz_pts = jnp.asarray([mel_to_hz(float(m), htk) for m in mel_pts])
+    fdiff = jnp.diff(hz_pts)
+    ramps = hz_pts[:, None] - fft_freqs[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    fb = jnp.maximum(0, jnp.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (hz_pts[2: n_mels + 2] - hz_pts[:n_mels])
+        fb = fb * enorm[:, None]
+    return Tensor(fb.astype(dtype))
+
+
+def create_dct(n_mfcc: int, n_mels: int, norm: str | None = "ortho",
+               dtype="float32") -> Tensor:
+    """[n_mels, n_mfcc] DCT-II basis (reference: create_dct)."""
+    n = jnp.arange(n_mels, dtype=jnp.float32)
+    k = jnp.arange(n_mfcc, dtype=jnp.float32)[None, :]
+    dct = jnp.cos(math.pi / n_mels * (n[:, None] + 0.5) * k)
+    if norm == "ortho":
+        dct = dct * math.sqrt(2.0 / n_mels)
+        dct = dct.at[:, 0].set(dct[:, 0] / math.sqrt(2.0))
+    else:
+        dct = dct * 2.0
+    return Tensor(dct.astype(dtype))
+
+
+def power_to_db(spect: Tensor, ref_value: float = 1.0, amin: float = 1e-10,
+                top_db: float | None = 80.0) -> Tensor:
+    def fn(x):
+        db = 10.0 * jnp.log10(jnp.maximum(amin, x))
+        db = db - 10.0 * jnp.log10(jnp.maximum(amin, ref_value))
+        if top_db is not None:
+            db = jnp.maximum(db, db.max() - top_db)
+        return db
+
+    return apply_op("power_to_db", fn, spect)
+
+
+def fft_frequencies(sr: int, n_fft: int, dtype="float32") -> Tensor:
+    return Tensor(jnp.linspace(0, sr / 2, n_fft // 2 + 1).astype(dtype))
+
+
+def mel_frequencies(n_mels: int = 64, f_min: float = 0.0,
+                    f_max: float = 11025.0, htk: bool = False,
+                    dtype="float32") -> Tensor:
+    mel_pts = jnp.linspace(hz_to_mel(f_min, htk), hz_to_mel(f_max, htk),
+                           n_mels)
+    return Tensor(jnp.asarray([mel_to_hz(float(m), htk)
+                               for m in mel_pts]).astype(dtype))

@@ -1,0 +1,20 @@
+"""Parallel-config auto-tuner (reference: paddle.distributed.auto_tuner —
+tuner.py:21 Tuner, search.py GridSearch, prune.py rule registry,
+cost_model.py memory estimate, recorder.py history; SURVEY.md §2.7).
+
+Searches {dp, mp, pp, sharding stage/degree, micro-batch, recompute} for a
+given chip count + model shape, prunes by a transformer memory model, and
+records trial metrics. The trial runner is injected (the reference
+re-launches `paddle.distributed.launch` per trial; here any callable —
+typically one compiled dry-run step over a virtual mesh — reports the
+metric).
+"""
+from .prune import DEFAULT_PRUNES, prune_by_memory, prune_invalid
+from .recorder import HistoryRecorder
+from .search import GridSearch, all_candidates
+from .tuner import AutoTuneConfig, Tuner, tune
+
+__all__ = [
+    "Tuner", "tune", "AutoTuneConfig", "GridSearch", "all_candidates",
+    "HistoryRecorder", "DEFAULT_PRUNES", "prune_by_memory", "prune_invalid",
+]

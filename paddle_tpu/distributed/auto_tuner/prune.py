@@ -1,0 +1,76 @@
+"""Prune rules (reference: auto_tuner/prune.py @register_prune functions +
+cost_model.py memory estimation).
+
+Each rule: (candidate, context) -> falsy (keep) or a reason string (prune).
+Context keys used: num_layers, hidden_size, num_heads, vocab_size,
+seq_length, memory_limit_gb (per chip), global_batch_size.
+"""
+from __future__ import annotations
+
+
+def prune_invalid(cand, ctx) -> str | None:
+    ctx = ctx or {}
+    hidden = ctx.get("hidden_size")
+    heads = ctx.get("num_heads")
+    layers = ctx.get("num_layers")
+    if hidden and hidden % cand.mp_degree != 0:
+        return f"hidden_size {hidden} not divisible by mp {cand.mp_degree}"
+    if heads and heads % cand.mp_degree != 0:
+        return f"num_heads {heads} not divisible by mp {cand.mp_degree}"
+    if layers and layers % cand.pp_degree != 0:
+        return f"num_layers {layers} not divisible by pp {cand.pp_degree}"
+    vocab = ctx.get("vocab_size")
+    if vocab and vocab % cand.mp_degree != 0:
+        return f"vocab {vocab} not divisible by mp {cand.mp_degree}"
+    if cand.sharding_degree > 1 and cand.sharding_stage == 3 and \
+            cand.pp_degree > 1:
+        return "sharding stage 3 incompatible with pipeline parallel"
+    return None
+
+
+def estimate_memory_gb(cand, ctx) -> float:
+    """Transformer training footprint per chip (cost_model.py parity):
+    params/grads/optimizer-state sharded by (mp*pp*sharding), activations by
+    (dp via micro-batch, mp, recompute)."""
+    ctx = ctx or {}
+    L = ctx.get("num_layers", 24)
+    H = ctx.get("hidden_size", 1024)
+    V = ctx.get("vocab_size", 50304)
+    S = ctx.get("seq_length", 2048)
+    params = 12 * L * H * H + V * H  # weights incl. embeddings
+    param_shard = cand.mp_degree * cand.pp_degree
+    # bf16 weights+grads (2+2) replicated over dp unless sharded;
+    # fp32 optimizer states (moment1+moment2+master = 12 bytes) shard with
+    # sharding_degree on stage>=1, grads too on stage>=2, weights on 3
+    p_local = params / param_shard
+    bytes_weights = 2 * p_local / (cand.sharding_degree
+                                   if cand.sharding_stage >= 3 else 1)
+    bytes_grads = 2 * p_local / (cand.sharding_degree
+                                 if cand.sharding_stage >= 2 else 1)
+    bytes_opt = 12 * p_local / cand.sharding_degree
+    # activations per micro-batch per layer ~ s*b*h*(34 + 5*s*a/h) (Korthikanti
+    # et al. style estimate); recompute keeps only layer inputs
+    b = cand.micro_batch_size
+    a = ctx.get("num_heads", 16)
+    act_per_layer = S * b * H * (34 + 5 * S * a / H) / cand.mp_degree
+    if cand.use_recompute:
+        act_per_layer = S * b * H * 2
+    layers_local = L / cand.pp_degree
+    # pipeline keeps pp in-flight microbatches of activations
+    bytes_act = act_per_layer * layers_local * max(1, cand.pp_degree)
+    total = bytes_weights + bytes_grads + bytes_opt + bytes_act
+    return total / (1024 ** 3)
+
+
+def prune_by_memory(cand, ctx) -> str | None:
+    ctx = ctx or {}
+    limit = ctx.get("memory_limit_gb")
+    if not limit:
+        return None
+    est = estimate_memory_gb(cand, ctx)
+    if est > limit:
+        return f"estimated {est:.1f}GB > limit {limit}GB"
+    return None
+
+
+DEFAULT_PRUNES = (prune_invalid, prune_by_memory)

@@ -1,0 +1,53 @@
+"""Trial history (reference: auto_tuner/recorder.py — sort by metric,
+store/load csv)."""
+from __future__ import annotations
+
+import csv
+import math
+
+
+class HistoryRecorder:
+    def __init__(self, metric_name: str = "throughput",
+                 higher_is_better: bool = True):
+        self.metric_name = metric_name
+        self.higher = higher_is_better
+        self.history: list[dict] = []
+
+    def add_cfg(self, **kwargs):
+        self.history.append(dict(kwargs))
+
+    def sort_metric(self):
+        def keyfn(rec):
+            v = rec.get(self.metric_name)
+            if v is None or (isinstance(v, float) and math.isnan(v)):
+                return -math.inf if self.higher else math.inf
+            return v
+
+        self.history.sort(key=keyfn, reverse=self.higher)
+
+    def get_best(self) -> dict | None:
+        self.sort_metric()
+        for rec in self.history:
+            if rec.get(self.metric_name) is not None and not rec.get("error"):
+                return rec
+        return None
+
+    def store_history(self, path: str):
+        if not self.history:
+            return
+        keys = sorted({k for rec in self.history for k in rec})
+        with open(path, "w", newline="") as f:
+            w = csv.DictWriter(f, fieldnames=keys)
+            w.writeheader()
+            w.writerows(self.history)
+
+    def load_history(self, path: str):
+        with open(path, newline="") as f:
+            for row in csv.DictReader(f):
+                parsed = {}
+                for k, v in row.items():
+                    try:
+                        parsed[k] = float(v) if "." in str(v) else int(v)
+                    except (TypeError, ValueError):
+                        parsed[k] = v
+                self.history.append(parsed)

@@ -1,0 +1,75 @@
+"""Candidate enumeration (reference: auto_tuner/search.py GridSearch over
+the strategy dims; utils.py divisor helpers)."""
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class Candidate:
+    dp_degree: int
+    mp_degree: int
+    pp_degree: int
+    sharding_degree: int
+    sharding_stage: int
+    micro_batch_size: int
+    use_recompute: bool
+
+    def as_dict(self):
+        return dict(self.__dict__)
+
+
+def _divisors(n: int):
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+def all_candidates(num_devices: int, global_batch_size: int,
+                   sharding_stages=(1, 2, 3),
+                   micro_batch_sizes=None,
+                   recompute_options=(False, True)) -> list[Candidate]:
+    """dp*mp*pp = devices; sharding partitions the dp group; micro batch
+    divides the per-dp-rank batch."""
+    out = []
+    for mp in _divisors(num_devices):
+        for pp in _divisors(num_devices // mp):
+            dp = num_devices // (mp * pp)
+            if global_batch_size % dp != 0:
+                continue
+            local_bs = global_batch_size // dp
+            mbs_opts = (micro_batch_sizes if micro_batch_sizes is not None
+                        else _divisors(local_bs))
+            for sharding in _divisors(dp):
+                stages = sharding_stages if sharding > 1 else (1,)
+                for stage in stages:
+                    for mbs in mbs_opts:
+                        if local_bs % mbs != 0:
+                            continue
+                        for rc in recompute_options:
+                            out.append(Candidate(dp, mp, pp, sharding,
+                                                 stage, mbs, rc))
+    return out
+
+
+class GridSearch:
+    """Iterates candidates in a stable order, skipping pruned ones
+    (reference GridSearch.search_once)."""
+
+    def __init__(self, candidates, prunes=()):
+        self._iter = iter(candidates)
+        self._prunes = list(prunes)
+        self.explored: list = []
+
+    def search_once(self, context=None):
+        for cand in self._iter:
+            reason = None
+            for prune in self._prunes:
+                reason = prune(cand, context)
+                if reason:
+                    break
+            if reason:
+                self.explored.append((cand, f"pruned: {reason}"))
+                continue
+            self.explored.append((cand, "run"))
+            return cand
+        return None

@@ -1,0 +1,75 @@
+"""Tuner driver (reference: auto_tuner/tuner.py Tuner — get_cfg_from_
+search, run trial, record, next; integrated into launch --auto_tuner_json).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from .prune import DEFAULT_PRUNES
+from .recorder import HistoryRecorder
+from .search import GridSearch, all_candidates
+
+
+@dataclass
+class AutoTuneConfig:
+    num_devices: int = 8
+    global_batch_size: int = 32
+    model: dict = field(default_factory=dict)  # hidden_size, num_layers, ...
+    memory_limit_gb: float | None = None
+    max_trials: int = 0  # 0 = unbounded
+    metric: str = "throughput"
+    higher_is_better: bool = True
+
+
+class Tuner:
+    def __init__(self, config: AutoTuneConfig, prunes=DEFAULT_PRUNES):
+        self.config = config
+        ctx = dict(config.model)
+        if config.memory_limit_gb:
+            ctx["memory_limit_gb"] = config.memory_limit_gb
+        ctx["global_batch_size"] = config.global_batch_size
+        self._ctx = ctx
+        cands = all_candidates(config.num_devices, config.global_batch_size)
+        self._search = GridSearch(cands, prunes)
+        self.recorder = HistoryRecorder(config.metric,
+                                        config.higher_is_better)
+        self._trials = 0
+
+    @property
+    def context(self):
+        return self._ctx
+
+    def search_once(self):
+        if self.config.max_trials and self._trials >= self.config.max_trials:
+            return None
+        cand = self._search.search_once(self._ctx)
+        if cand is not None:
+            self._trials += 1
+        return cand
+
+    def add_cfg(self, cand, metric_value=None, error=None):
+        rec = cand.as_dict()
+        rec[self.config.metric] = metric_value
+        if error:
+            rec["error"] = str(error)
+        self.recorder.add_cfg(**rec)
+
+    def get_best_cfg(self):
+        return self.recorder.get_best()
+
+
+def tune(config: AutoTuneConfig, run_trial, prunes=DEFAULT_PRUNES):
+    """Full loop: enumerate -> prune -> run_trial(candidate)->metric ->
+    best. run_trial may raise; the failure is recorded and the search
+    continues (reference tuner catches per-trial OOM/launch errors)."""
+    tuner = Tuner(config, prunes)
+    while True:
+        cand = tuner.search_once()
+        if cand is None:
+            break
+        try:
+            metric = run_trial(cand)
+            tuner.add_cfg(cand, metric_value=metric)
+        except Exception as e:  # noqa: BLE001 - trial errors are data
+            tuner.add_cfg(cand, error=e)
+    return tuner.get_best_cfg(), tuner.recorder

@@ -1,0 +1,13 @@
+"""Distributed launcher (reference: python -m paddle.distributed.launch,
+launch/main.py:20 + controllers/collective.py:37 build_pod +
+controllers/watcher.py + elastic restart — SURVEY.md §5.3).
+
+TPU-native mapping: one process per host (JAX owns all local chips), the
+rendezvous master is the native TCPStore (rank 0), and worker env carries
+PADDLE_* variables plus the JAX coordination address so
+``jax.distributed.initialize`` can form the multi-host mesh. Elastic
+behavior: the watcher restarts the pod on worker failure up to
+``--max_restart`` times (elastic_level 1 parity: in-place restart with the
+same membership).
+"""
+from .main import launch, main  # noqa: F401

@@ -1,0 +1,200 @@
+"""Launcher implementation: pod build, watcher, elastic restart.
+
+Reference call path: launch/main.py -> CollectiveController.build_pod
+(controllers/collective.py:37: per-rank env assembly) -> Watcher monitoring
+(controllers/watcher.py) -> restart/elastic logic (collective.py:254
+CollectiveElasticController; fleet/elastic/manager.py). The heavy pieces the
+reference needs (etcd membership, gloo barriers) collapse onto the native
+TCPStore: nodes register under /nodes/<rank>, barrier, and watch a restart
+epoch counter.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+
+def _parse_args(argv=None):
+    parser = argparse.ArgumentParser("paddle_tpu.distributed.launch")
+    parser.add_argument("--nnodes", type=str, default="1",
+                        help="node count or range 'N' / 'N:M' (elastic)")
+    parser.add_argument("--nproc_per_node", type=int, default=1,
+                        help="worker processes on this node (TPU: usually 1 "
+                             "process owning all local chips)")
+    parser.add_argument("--master", type=str, default=None,
+                        help="rendezvous endpoint ip:port (rank-0 node)")
+    parser.add_argument("--rank", type=int, default=-1,
+                        help="node rank; -1 = from env PADDLE_NODE_RANK or 0")
+    parser.add_argument("--job_id", type=str, default="default")
+    parser.add_argument("--log_dir", type=str, default="log")
+    parser.add_argument("--max_restart", type=int, default=3)
+    parser.add_argument("--elastic_level", type=int, default=-1)
+    parser.add_argument("--elastic_timeout", type=int, default=30)
+    parser.add_argument("--devices", type=str, default=None)
+    parser.add_argument("training_script", type=str)
+    parser.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return parser.parse_args(argv)
+
+
+class Pod:
+    """The local worker group: spawn, watch, restart (build_pod parity)."""
+
+    def __init__(self, args, node_rank: int, nnodes: int, master: str):
+        self.args = args
+        self.node_rank = node_rank
+        self.nnodes = nnodes
+        self.master = master
+        self.procs: list[subprocess.Popen] = []
+        self.logs = []
+
+    def worker_env(self, local_rank: int) -> dict:
+        nproc = self.args.nproc_per_node
+        world = self.nnodes * nproc
+        rank = self.node_rank * nproc + local_rank
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_LOCAL_RANK": str(local_rank),
+            "PADDLE_TRAINERS_NUM": str(world),
+            "PADDLE_NNODES": str(self.nnodes),
+            "PADDLE_NODE_RANK": str(self.node_rank),
+            "PADDLE_MASTER": self.master,
+            "PADDLE_JOB_ID": self.args.job_id,
+            # jax.distributed.initialize reads these in-process
+            "JAX_COORDINATOR_ADDRESS": self.master,
+            "JAX_NUM_PROCESSES": str(world),
+            "JAX_PROCESS_ID": str(rank),
+        })
+        if self.args.devices:
+            env["PADDLE_SELECTED_DEVICES"] = self.args.devices
+        return env
+
+    def start(self):
+        os.makedirs(self.args.log_dir, exist_ok=True)
+        self.stop()
+        self.procs, self.logs = [], []
+        for lr in range(self.args.nproc_per_node):
+            rank = self.node_rank * self.args.nproc_per_node + lr
+            log = open(os.path.join(self.args.log_dir,
+                                    f"workerlog.{rank}"), "ab")
+            cmd = [sys.executable, "-u", self.args.training_script,
+                   *self.args.training_script_args]
+            p = subprocess.Popen(cmd, env=self.worker_env(lr), stdout=log,
+                                 stderr=subprocess.STDOUT)
+            self.procs.append(p)
+            self.logs.append(log)
+
+    def poll(self):
+        """Returns 'running' | 'done' | ('failed', rank)."""
+        codes = [p.poll() for p in self.procs]
+        if any(c not in (0, None) for c in codes):
+            bad = next(i for i, c in enumerate(codes) if c not in (0, None))
+            return ("failed", self.node_rank * self.args.nproc_per_node + bad)
+        if all(c == 0 for c in codes):
+            return "done"
+        return "running"
+
+    def stop(self, sig=signal.SIGTERM):
+        for p in self.procs:
+            if p.poll() is None:
+                try:
+                    p.send_signal(sig)
+                except OSError:
+                    pass
+        deadline = time.time() + 10
+        for p in self.procs:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+        for log in self.logs:
+            log.close()
+        self.procs, self.logs = [], []
+
+
+def launch(argv=None) -> int:
+    """Run the launcher; returns the exit code (0 = all workers succeeded).
+
+    Watcher loop parity: poll workers; on failure stop the pod and restart
+    (all ranks restart together via the store's restart-epoch key) up to
+    max_restart times.
+    """
+    args = _parse_args(argv)
+    nnodes = int(str(args.nnodes).split(":")[0])
+    node_rank = args.rank if args.rank >= 0 else int(
+        os.environ.get("PADDLE_NODE_RANK", 0))
+
+    store = None
+    worker_master = args.master
+    if args.master is None:
+        if nnodes > 1:
+            raise ValueError("--master is required for multi-node jobs")
+        # single node: reserve a free port for the WORKERS' rendezvous store
+        # (worker rank 0 hosts it — the launcher must not bind it itself)
+        import socket
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            worker_master = f"127.0.0.1:{s.getsockname()[1]}"
+    elif nnodes > 1:
+        # launcher-level membership store lives on <port>; the trainers'
+        # rendezvous store (hosted by worker rank 0) gets <port>+1
+        from ..store import TCPStore
+
+        host, _, port = args.master.rpartition(":")
+        store = TCPStore(host, int(port), is_master=(node_rank == 0),
+                         world_size=nnodes, timeout=args.elastic_timeout)
+        store.set(f"/nodes/{node_rank}", str(os.getpid()))
+        store.barrier("launch")
+        worker_master = f"{host}:{int(port) + 1}"
+
+    pod = Pod(args, node_rank, nnodes, worker_master)
+    restarts = 0
+    pod.start()
+    try:
+        while True:
+            status = pod.poll()
+            if status == "done":
+                return 0
+            if isinstance(status, tuple):  # failed
+                _, bad_rank = status
+                print(f"[launch] worker rank {bad_rank} failed "
+                      f"(restart {restarts}/{args.max_restart})",
+                      file=sys.stderr)
+                pod.stop()
+                if restarts >= args.max_restart:
+                    return 1
+                restarts += 1
+                if store is not None and nnodes > 1:
+                    # publish the restart epoch so every node restarts its pod
+                    store.add("/restart_epoch", 1)
+                pod.start()
+            if store is not None and nnodes > 1:
+                # follow restarts initiated by other nodes (check() is
+                # non-blocking; get() would stall the watch loop)
+                epoch = 0
+                if store.check("/restart_epoch"):
+                    epoch = int(store.get("/restart_epoch") or 0)
+                if epoch > restarts:
+                    pod.stop()
+                    restarts = epoch
+                    if restarts > args.max_restart:
+                        return 1
+                    pod.start()
+            time.sleep(0.5)
+    finally:
+        pod.stop()
+        if store is not None:
+            store.close()
+
+
+def main():
+    sys.exit(launch())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,81 @@
+"""paddle.incubate parity (SURVEY.md §2.8): experimental fused layers/ops.
+
+Subset shipped: fused transformer layers (nn), fused functional ops,
+softmax_mask_fuse, segment ops. The reference's incubate also carries asp/
+autograd-prim/jit-inference experiments — their stable equivalents live in
+the main packages here (XLA handles decomposition; jit is paddle_tpu.jit).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..autograd.engine import apply_op
+from . import nn
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """softmax(x + mask) in one kernel (reference:
+    incubate/operators/softmax_mask_fuse.py)."""
+    return apply_op("softmax_mask_fuse",
+                    lambda a, m: jax.nn.softmax(a + m, axis=-1), x, mask)
+
+
+def softmax_mask_fuse_upper_triangle(x):
+    """Causal-masked softmax without materializing the mask (reference:
+    softmax_mask_fuse_upper_triangle)."""
+
+    def fn(a):
+        S = a.shape[-1]
+        row = jnp.arange(S)[:, None]
+        col = jnp.arange(S)[None, :]
+        return jax.nn.softmax(jnp.where(col <= row, a, -jnp.inf), axis=-1)
+
+    return apply_op("softmax_mask_fuse_upper_triangle", fn, x)
+
+
+def segment_sum(data, segment_ids, name=None):
+    return _segment("segment_sum", jax.ops.segment_sum, data, segment_ids)
+
+
+def segment_mean(data, segment_ids, name=None):
+    def fn(d, ids):
+        num = int(jnp.max(ids)) + 1 if ids.size else 0
+        s = jax.ops.segment_sum(d, ids, num_segments=num)
+        cnt = jax.ops.segment_sum(jnp.ones_like(d), ids, num_segments=num)
+        return s / jnp.maximum(cnt, 1)
+
+    return apply_op("segment_mean", fn, data, segment_ids)
+
+
+def segment_min(data, segment_ids, name=None):
+    return _segment("segment_min", jax.ops.segment_min, data, segment_ids)
+
+
+def segment_max(data, segment_ids, name=None):
+    return _segment("segment_max", jax.ops.segment_max, data, segment_ids)
+
+
+def _segment(name, jfn, data, segment_ids):
+    def fn(d, ids):
+        num = int(jnp.max(ids)) + 1 if ids.size else 0
+        return jfn(d, ids, num_segments=num)
+
+    return apply_op(name, fn, data, segment_ids)
+
+
+def identity_loss(x, reduction="none"):
+    from ..tensor.tensor import Tensor
+
+    if reduction == "mean":
+        return x.mean()
+    if reduction == "sum":
+        return x.sum()
+    return x
+
+
+__all__ = [
+    "nn", "softmax_mask_fuse", "softmax_mask_fuse_upper_triangle",
+    "segment_sum", "segment_mean", "segment_min", "segment_max",
+    "identity_loss",
+]

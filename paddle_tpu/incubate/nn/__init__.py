@@ -1,0 +1,201 @@
+"""paddle.incubate.nn — fused transformer layers.
+
+Reference: python/paddle/incubate/nn/layer/fused_transformer.py
+(FusedMultiHeadAttention :patterned on fused_attention op, FusedFeedForward,
+FusedTransformerEncoderLayer, FusedMultiTransformer) — single-kernel
+transformer blocks. On TPU each block body is one apply_op of fused jax ops
+(flash attention via the Pallas kernel through
+nn.functional.scaled_dot_product_attention), so XLA emits the fused
+schedule the reference hand-writes in CUDA.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...nn.initializer import XavierUniform
+from ...nn.layer.layers import Layer
+from ...tensor.tensor import Tensor
+from . import functional
+from .functional import (
+    fused_bias_dropout_residual_layer_norm,
+    fused_dropout_add,
+    fused_layer_norm,
+    fused_linear,
+    fused_rms_norm,
+    fused_rotary_position_embedding,
+    memory_efficient_attention,
+    swiglu,
+)
+
+
+class FusedMultiHeadAttention(Layer):
+    """Pre/post-LN multi-head self-attention block with fused residual+LN
+    (reference: fused_transformer.py FusedMultiHeadAttention)."""
+
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, kdim=None, vdim=None,
+                 normalize_before=False, need_weights=False,
+                 qkv_weight_attr=None, qkv_bias_attr=None,
+                 linear_weight_attr=None, linear_bias_attr=None,
+                 pre_ln_scale_attr=None, pre_ln_bias_attr=None,
+                 ln_scale_attr=None, ln_bias_attr=None, epsilon=1e-5,
+                 nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        assert embed_dim % num_heads == 0
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.normalize_before = normalize_before
+        self._dropout = dropout_rate
+        self._attn_dropout = attn_dropout_rate
+        self._epsilon = epsilon
+        self.qkv_weight = self.create_parameter(
+            [embed_dim, 3 * embed_dim], attr=qkv_weight_attr,
+            default_initializer=XavierUniform())
+        self.qkv_bias = self.create_parameter(
+            [3 * embed_dim], attr=qkv_bias_attr, is_bias=True)
+        self.linear_weight = self.create_parameter(
+            [embed_dim, embed_dim], attr=linear_weight_attr,
+            default_initializer=XavierUniform())
+        self.linear_bias = self.create_parameter(
+            [embed_dim], attr=linear_bias_attr, is_bias=True)
+        self.pre_ln_scale = self.create_parameter(
+            [embed_dim], attr=pre_ln_scale_attr, default_initializer=None,
+            is_bias=False)
+        self.pre_ln_scale.set_value(jnp.ones([embed_dim], jnp.float32))
+        self.pre_ln_bias = self.create_parameter(
+            [embed_dim], attr=pre_ln_bias_attr, is_bias=True)
+        self.ln_scale = self.create_parameter(
+            [embed_dim], attr=ln_scale_attr, is_bias=False)
+        self.ln_scale.set_value(jnp.ones([embed_dim], jnp.float32))
+        self.ln_bias = self.create_parameter(
+            [embed_dim], attr=ln_bias_attr, is_bias=True)
+
+    def forward(self, query, key=None, value=None, attn_mask=None,
+                cache=None):
+        from ...nn.functional.attention import scaled_dot_product_attention
+
+        x = query
+        residual = x
+        if self.normalize_before:
+            x = fused_layer_norm(x, self.pre_ln_scale, self.pre_ln_bias,
+                                 self._epsilon)
+        qkv = fused_linear(x, self.qkv_weight, self.qkv_bias)
+        B, S, _ = qkv.shape
+        qkv = qkv.reshape([B, S, 3, self.num_heads, self.head_dim])
+        q, k, v = (qkv[:, :, i] for i in range(3))  # [B, S, H, D]
+        out = scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask, dropout_p=self._attn_dropout,
+            training=self.training)
+        out = out.reshape([B, S, self.embed_dim])
+        out = fused_linear(out, self.linear_weight)
+        out = fused_bias_dropout_residual_layer_norm(
+            out, residual, self.linear_bias,
+            None if self.normalize_before else self.ln_scale,
+            None if self.normalize_before else self.ln_bias,
+            dropout_rate=self._dropout, epsilon=self._epsilon,
+            training=self.training) if not self.normalize_before else (
+            fused_dropout_add(
+                out + self.linear_bias, residual, p=self._dropout,
+                training=self.training))
+        return out
+
+
+class FusedFeedForward(Layer):
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 epsilon=1e-05, activation="relu", act_dropout_rate=None,
+                 normalize_before=False, linear1_weight_attr=None,
+                 linear1_bias_attr=None, linear2_weight_attr=None,
+                 linear2_bias_attr=None, ln1_scale_attr=None,
+                 ln1_bias_attr=None, ln2_scale_attr=None,
+                 ln2_bias_attr=None, nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        self._normalize_before = normalize_before
+        self._epsilon = epsilon
+        self._act = activation
+        self._dropout = dropout_rate
+        self._act_dropout = dropout_rate if act_dropout_rate is None else act_dropout_rate
+        self.linear1_weight = self.create_parameter(
+            [d_model, dim_feedforward], attr=linear1_weight_attr,
+            default_initializer=XavierUniform())
+        self.linear1_bias = self.create_parameter(
+            [dim_feedforward], attr=linear1_bias_attr, is_bias=True)
+        self.linear2_weight = self.create_parameter(
+            [dim_feedforward, d_model], attr=linear2_weight_attr,
+            default_initializer=XavierUniform())
+        self.linear2_bias = self.create_parameter(
+            [d_model], attr=linear2_bias_attr, is_bias=True)
+        self.ln_scale = self.create_parameter([d_model], is_bias=False)
+        self.ln_scale.set_value(jnp.ones([d_model], jnp.float32))
+        self.ln_bias = self.create_parameter([d_model], is_bias=True)
+
+    def forward(self, src, cache=None):
+        residual = src
+        x = src
+        if self._normalize_before:
+            x = fused_layer_norm(x, self.ln_scale, self.ln_bias,
+                                 self._epsilon)
+        x = functional.fused_linear_activation(
+            x, self.linear1_weight, self.linear1_bias,
+            activation="gelu" if self._act == "gelu" else "relu")
+        x = fused_linear(x, self.linear2_weight)
+        if self._normalize_before:
+            return fused_dropout_add(x + self.linear2_bias, residual,
+                                     p=self._dropout, training=self.training)
+        return fused_bias_dropout_residual_layer_norm(
+            x, residual, self.linear2_bias, self.ln_scale, self.ln_bias,
+            dropout_rate=self._dropout, epsilon=self._epsilon,
+            training=self.training)
+
+
+class FusedTransformerEncoderLayer(Layer):
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None,
+                 act_dropout_rate=None, normalize_before=False, **kw):
+        super().__init__()
+        attn_dropout_rate = dropout_rate if attn_dropout_rate is None else attn_dropout_rate
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead, dropout_rate=dropout_rate,
+            attn_dropout_rate=attn_dropout_rate,
+            normalize_before=normalize_before)
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate=dropout_rate,
+            activation=activation, act_dropout_rate=act_dropout_rate,
+            normalize_before=normalize_before)
+
+    def forward(self, src, src_mask=None, cache=None):
+        out = self.fused_attn(src, attn_mask=src_mask)
+        return self.ffn(out)
+
+
+class FusedMultiTransformer(Layer):
+    """N stacked decoder blocks with shared config (reference:
+    FusedMultiTransformer — the serving-path stack with per-layer weight
+    lists and KV cache support)."""
+
+    def __init__(self, embed_dim, num_heads, dim_feedforward,
+                 dropout_rate=0.0, activation="gelu",
+                 normalize_before=True, num_layers=1, epsilon=1e-5, **kw):
+        super().__init__()
+        self.num_layers = num_layers
+        self.layers = [
+            FusedTransformerEncoderLayer(
+                embed_dim, num_heads, dim_feedforward,
+                dropout_rate=dropout_rate, activation=activation,
+                normalize_before=normalize_before)
+            for _ in range(num_layers)
+        ]
+        for i, l in enumerate(self.layers):
+            self.add_sublayer(f"layer_{i}", l)
+
+    def forward(self, src, attn_mask=None, caches=None, **kw):
+        x = src
+        for l in self.layers:
+            x = l(x, src_mask=attn_mask)
+        return x
+
+
+__all__ = [
+    "FusedMultiHeadAttention", "FusedFeedForward",
+    "FusedTransformerEncoderLayer", "FusedMultiTransformer", "functional",
+]

@@ -1,0 +1,232 @@
+"""paddle.incubate.nn.functional — fused op surface.
+
+Reference: python/paddle/incubate/nn/functional (fused_multi_head_attention,
+fused_feedforward, fused_rotary_position_embedding, fused_dropout_add,
+fused_rms_norm, fused_layer_norm, fused_linear,
+variable_length_memory_efficient_attention…) backed by phi fusion kernels
+(phi/kernels/fusion/gpu/ — fused_rope, fused_layernorm, fused attention).
+
+TPU stance: "fused" means "expressed so XLA fuses it" — each function is a
+single apply_op whose jaxpr XLA tiles into one kernel (elementwise chains
+fold into the matmul epilogues); flash attention uses the Pallas kernel.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...autograd.engine import apply_op
+from ...tensor.tensor import Tensor
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False):
+    def fn(x_, w, b):
+        w_ = w.T if transpose_weight else w
+        y = x_ @ w_
+        return y + b if b is not None else y
+
+    return apply_op("fused_linear", fn, x, weight, bias)
+
+
+def fused_linear_activation(x, weight, bias=None, activation="gelu"):
+    act = {"gelu": jax.nn.gelu, "relu": jax.nn.relu,
+           "none": lambda v: v}[activation]
+
+    def fn(x_, w, b):
+        y = x_ @ w
+        if b is not None:
+            y = y + b
+        return act(y)
+
+    return apply_op("fused_linear_activation", fn, x, weight, bias)
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
+                      seed=None, name=None):
+    """out = dropout(x) + y in one kernel (reference:
+    fused_dropout_add op)."""
+    from ...framework.random import default_generator
+
+    if not training or p == 0.0:
+        return apply_op("fused_dropout_add", lambda a, b: a + b, x, y)
+    key = (default_generator.next_key() if seed is None
+           else jax.random.PRNGKey(seed))
+    keep = 1.0 - p
+
+    def fn(a, b):
+        mask = jax.random.bernoulli(key, keep, a.shape)
+        if mode == "upscale_in_train":
+            return jnp.where(mask, a / keep, 0.0) + b
+        return jnp.where(mask, a, 0.0) + b
+
+    return apply_op("fused_dropout_add", fn, x, y)
+
+
+def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
+                   begin_norm_axis=-1, **kw):
+    def fn(x_, w, b):
+        var = jnp.mean(jnp.square(x_.astype(jnp.float32)), axis=-1,
+                       keepdims=True)
+        y = (x_.astype(jnp.float32) * jax.lax.rsqrt(var + epsilon)).astype(
+            x_.dtype)
+        y = y * w
+        return y + b if b is not None else y
+
+    return apply_op("fused_rms_norm", fn, x, norm_weight, norm_bias)
+
+
+def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5,
+                     begin_norm_axis=-1, **kw):
+    def fn(x_, w, b):
+        xf = x_.astype(jnp.float32)
+        mean = xf.mean(-1, keepdims=True)
+        var = xf.var(-1, keepdims=True)
+        y = ((xf - mean) * jax.lax.rsqrt(var + epsilon)).astype(x_.dtype)
+        if w is not None:
+            y = y * w
+        if b is not None:
+            y = y + b
+        return y
+
+    return apply_op("fused_layer_norm", fn, x, norm_weight, norm_bias)
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None, use_neox_rotary_style=True):
+    """RoPE applied to q/k (v passthrough) — reference: fused_rope kernel
+    (phi/kernels/fusion/gpu/fused_rope*). Shapes [B, S, H, D]."""
+
+    def rope_one(x, sin_, cos_):
+        if x is None:
+            return None
+        if use_neox_rotary_style:
+            half = x.shape[-1] // 2
+            x1, x2 = x[..., :half], x[..., half:]
+            rot = jnp.concatenate([-x2, x1], axis=-1)
+        else:
+            x1 = x[..., 0::2]
+            x2 = x[..., 1::2]
+            rot = jnp.stack([-x2, x1], axis=-1).reshape(x.shape)
+        return x * cos_ + rot * sin_
+
+    def fn(q_, k_, v_, sin_, cos_):
+        S, D = q_.shape[1], q_.shape[-1]
+        if sin_ is None:
+            inv = 1.0 / (10000.0 ** (jnp.arange(0, D, 2, dtype=jnp.float32) / D))
+            t = jnp.arange(S, dtype=jnp.float32)
+            freqs = jnp.outer(t, inv)
+            if use_neox_rotary_style:
+                emb = jnp.concatenate([freqs, freqs], axis=-1)
+            else:
+                emb = jnp.repeat(freqs, 2, axis=-1)
+            sin_, cos_ = jnp.sin(emb), jnp.cos(emb)
+        # accept [S, D] or the broadcast form [1, S, 1, D]; canonicalize
+        sin2d = sin_.reshape(-1, D).astype(q_.dtype)
+        cos2d = cos_.reshape(-1, D).astype(q_.dtype)
+        if position_ids is not None:
+            pid = jnp.asarray(position_ids._data if isinstance(
+                position_ids, Tensor) else position_ids)  # [B, S]
+            sin_b = sin2d[pid][:, :, None, :]  # [B, S, 1, D]
+            cos_b = cos2d[pid][:, :, None, :]
+        else:
+            sin_b = sin2d.reshape(1, S, 1, D)
+            cos_b = cos2d.reshape(1, S, 1, D)
+        outs = tuple(rope_one(t_, sin_b, cos_b) if t_ is not None else None
+                     for t_ in (q_, k_))
+        return outs + ((v_,) if v_ is not None else (None,))
+
+    out = apply_op("fused_rope", fn, q, k, v,
+                   sin._data if isinstance(sin, Tensor) else sin,
+                   cos._data if isinstance(cos, Tensor) else cos)
+    return out
+
+
+def fused_bias_dropout_residual_layer_norm(x, residual, bias=None,
+                                           ln_scale=None, ln_bias=None,
+                                           dropout_rate=0.0, epsilon=1e-5,
+                                           training=True, **kw):
+    from ...framework.random import default_generator
+
+    key = (default_generator.next_key()
+           if training and dropout_rate > 0.0 else None)
+    keep = 1.0 - dropout_rate
+
+    def fn(x_, res, b, w, lb):
+        y = x_ + b if b is not None else x_
+        if key is not None:
+            mask = jax.random.bernoulli(key, keep, y.shape)
+            y = jnp.where(mask, y / keep, 0.0).astype(y.dtype)
+        y = y + res
+        xf = y.astype(jnp.float32)
+        mean = xf.mean(-1, keepdims=True)
+        var = xf.var(-1, keepdims=True)
+        out = ((xf - mean) * jax.lax.rsqrt(var + epsilon)).astype(y.dtype)
+        if w is not None:
+            out = out * w
+        if lb is not None:
+            out = out + lb
+        return out
+
+    return apply_op("fused_bias_dropout_residual_ln", fn, x, residual, bias,
+                    ln_scale, ln_bias)
+
+
+def memory_efficient_attention(query, key, value, attn_bias=None, p=0.0,
+                               scale=None, training=True):
+    """Reference: incubate/nn/memory_efficient_attention.py (xformers-style).
+    On TPU this IS flash attention (same blockwise-softmax trick); inputs
+    [B, S, H, D]."""
+    from ...nn.functional.attention import scaled_dot_product_attention
+
+    return scaled_dot_product_attention(
+        query, key, value, attn_mask=attn_bias, dropout_p=p,
+        training=training, scale=scale)
+
+
+def variable_length_memory_efficient_attention(query, key, value, seq_lens,
+                                               kv_seq_lens, mask=None,
+                                               scale=None, causal=False):
+    """Variable-length batched attention: positions past each sequence's
+    length are masked out (reference: phi fused
+    variable_length_memory_efficient_attention; q [B,H,S,D])."""
+
+    def fn(q_, k_, v_, sl, kvl, m):
+        B, H, S, D = q_.shape
+        s = scale if scale is not None else 1.0 / jnp.sqrt(D).astype(q_.dtype)
+        scores = jnp.einsum("bhsd,bhtd->bhst", q_, k_) * s
+        kv_pos = jnp.arange(k_.shape[2])
+        key_mask = kv_pos[None, :] < kvl.reshape(-1, 1)  # [B, T]
+        scores = jnp.where(key_mask[:, None, None, :], scores, -jnp.inf)
+        if causal:
+            q_pos = jnp.arange(S)
+            scores = jnp.where(
+                q_pos[:, None] >= kv_pos[None, :], scores, -jnp.inf)
+        if m is not None:
+            scores = scores + m
+        p_ = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("bhst,bhtd->bhsd", p_, v_)
+        q_mask = jnp.arange(S)[None, :] < sl.reshape(-1, 1)
+        return jnp.where(q_mask[:, None, :, None], out, 0.0)
+
+    return apply_op("varlen_mem_efficient_attention", fn, query, key, value,
+                    seq_lens, kv_seq_lens, mask)
+
+
+def swiglu(x, y=None):
+    """SwiGLU activation (reference: incubate fused swiglu): if y is None, x
+    splits in half on the last dim."""
+
+    def fn(x_, y_):
+        if y_ is None:
+            x_, y_ = jnp.split(x_, 2, axis=-1)
+        return jax.nn.silu(x_) * y_
+
+    return apply_op("swiglu", fn, x, y)
+
+
+__all__ = [
+    "fused_linear", "fused_linear_activation", "fused_dropout_add",
+    "fused_rms_norm", "fused_layer_norm", "fused_rotary_position_embedding",
+    "fused_bias_dropout_residual_layer_norm", "memory_efficient_attention",
+    "variable_length_memory_efficient_attention", "swiglu",
+]

@@ -1,0 +1,167 @@
+"""paddle.inference parity: Config + Predictor over saved StableHLO.
+
+Reference: python/paddle/inference Config/Predictor wrapping the C++
+AnalysisPredictor (inference/api/analysis_predictor.h:100) — load model,
+run IR analysis passes, zero-copy run. TPU-native serving path
+(SURVEY.md §7.2 L9): artifacts are the serialized-StableHLO programs
+written by ``paddle_tpu.jit.save`` / ``paddle_tpu.static.save_inference_model``;
+"analysis passes" are XLA's compile pipeline at first run; zero-copy handles
+are device arrays with host staging only at copy_from/to_cpu.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class Config:
+    """Predictor configuration (reference: paddle.inference.Config).
+    ``prog_file``/``params_file`` accept the artifact prefix produced by
+    jit.save / static.save_inference_model."""
+
+    def __init__(self, prog_file: str | None = None,
+                 params_file: str | None = None):
+        if prog_file and prog_file.endswith(".pdmodel"):
+            prog_file = prog_file[: -len(".pdmodel")]
+        self._prefix = prog_file
+        self._device = "tpu"
+        self._memory_pool_mb = None
+        self._ir_optim = True
+        self._glog_info = False
+
+    def set_prog_file(self, path: str):
+        self._prefix = path[:-len(".pdmodel")] if path.endswith(".pdmodel") else path
+
+    def prog_file(self):
+        return (self._prefix or "") + ".pdmodel"
+
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        self._device = "tpu"  # accelerator alias; compute stays on TPU
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def use_gpu(self):
+        return self._device == "tpu"
+
+    def switch_ir_optim(self, flag: bool = True):
+        self._ir_optim = flag
+
+    def disable_glog_info(self):
+        self._glog_info = False
+
+    def enable_memory_optim(self, flag: bool = True):
+        pass  # XLA buffer assignment already does liveness-based reuse
+
+    def summary(self) -> str:
+        return (f"Config(prefix={self._prefix!r}, device={self._device}, "
+                f"ir_optim={self._ir_optim})")
+
+
+class Tensor_:
+    """Input/output handle (reference: paddle.inference.Tensor — zero-copy
+    handles onto executor buffers)."""
+
+    def __init__(self, name: str):
+        self._name = name
+        self._array = None
+
+    def name(self):
+        return self._name
+
+    def copy_from_cpu(self, data: np.ndarray):
+        self._array = jnp.asarray(data)
+
+    def reshape(self, shape):
+        if self._array is not None:
+            self._array = self._array.reshape(shape)
+
+    def shape(self):
+        return list(self._array.shape) if self._array is not None else []
+
+    def copy_to_cpu(self) -> np.ndarray:
+        return np.asarray(self._array)
+
+
+class Predictor:
+    """Loads the artifact and runs the compiled program (reference:
+    create_predictor -> AnalysisPredictor::Run)."""
+
+    def __init__(self, config: Config):
+        from jax import export as jax_export
+
+        prefix = config._prefix
+        if prefix is None:
+            raise ValueError("Config needs a model path prefix")
+        if not os.path.exists(prefix + ".pdmodel"):
+            raise FileNotFoundError(prefix + ".pdmodel")
+        with open(prefix + ".pdmodel", "rb") as f:
+            self._exported = jax_export.deserialize(f.read())
+        self._params = {n: jnp.asarray(a) for n, a in
+                        np.load(prefix + ".pdiparams.npz").items()}
+        with open(prefix + ".pdmeta", "rb") as f:
+            self._meta = pickle.load(f)
+        if "feed_names" in self._meta:  # static.save_inference_model artifact
+            self._input_names = list(self._meta["feed_names"])
+        else:  # jit.save artifact: positional specs
+            self._input_names = [
+                (s[2] or f"input_{i}")
+                for i, s in enumerate(self._meta.get("specs", []))]
+        self._inputs = {n: Tensor_(n) for n in self._input_names}
+        n_out = len(self._exported.out_avals)
+        self._output_names = [f"output_{i}" for i in range(n_out)]
+        self._outputs = {n: Tensor_(n) for n in self._output_names}
+
+    def get_input_names(self):
+        return list(self._input_names)
+
+    def get_input_handle(self, name: str) -> Tensor_:
+        return self._inputs[name]
+
+    def get_output_names(self):
+        return list(self._output_names)
+
+    def get_output_handle(self, name: str) -> Tensor_:
+        return self._outputs[name]
+
+    def run(self, inputs: list | None = None):
+        """Execute. With ``inputs`` (list of numpy arrays, reference's
+        Predictor.run(list) overload) returns the outputs directly."""
+        if inputs is not None:
+            for n, a in zip(self._input_names, inputs):
+                self._inputs[n].copy_from_cpu(np.asarray(a))
+        datas = [self._inputs[n]._array for n in self._input_names]
+        if any(d is None for d in datas):
+            missing = [n for n in self._input_names
+                       if self._inputs[n]._array is None]
+            raise RuntimeError(f"inputs not set: {missing}")
+        outs = self._exported.call(self._params, *datas)
+        for n, o in zip(self._output_names, outs):
+            self._outputs[n]._array = o
+        if inputs is not None:
+            return [np.asarray(o) for o in outs]
+        return None
+
+    def clone(self):
+        import copy
+
+        return copy.copy(self)
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)
+
+
+def get_version() -> str:
+    return "paddle-tpu-0.1"
+
+
+PrecisionType = type("PrecisionType", (), {"Float32": 0, "Half": 1, "Int8": 2})
+PlaceType = type("PlaceType", (), {"CPU": 0, "GPU": 1, "XPU": 2, "CUSTOM": 3})
+
+__all__ = ["Config", "Predictor", "Tensor_", "create_predictor",
+           "get_version", "PrecisionType", "PlaceType"]

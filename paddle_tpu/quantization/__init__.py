@@ -1,0 +1,236 @@
+"""paddle.quantization parity (SURVEY.md §2.8): QuantConfig + QAT/PTQ
+drivers, observers, quanters, and quanted layer wrappers.
+
+Reference layout: python/paddle/quantization/{config.py,qat.py,ptq.py,
+observers/,quanters/} + nn/quant layers. Workflow parity:
+
+    q_config = QuantConfig(activation=quanter, weight=quanter)
+    qat = QAT(q_config); q_model = qat.quantize(model)   # train with fake quant
+    ptq = PTQ(q_config); q_model = ptq.quantize(model)   # run calibration data
+    final = qat.convert(q_model)                          # freeze scales
+
+TPU stance: "int8 inference" on TPU = XLA int8 dot with dequant epilogue;
+the QAT/PTQ phase is numerically identical to the reference (fake
+quant-dequant in fp), so convert() freezes scales into the layer for the
+serving path rather than rewriting to a separate int8 op set.
+"""
+from __future__ import annotations
+
+import copy
+
+import jax.numpy as jnp
+
+from ..nn.layer.layers import Layer
+from ..tensor.tensor import Tensor
+from . import observers, quanters
+from .observers import AbsmaxObserver, BaseObserver, EMAObserver, HistObserver
+from .quanters import (
+    BaseQuanter,
+    FakeQuanterChannelWiseAbsMaxObserver,
+    FakeQuanterWithAbsMaxObserver,
+    fake_quant_dequant,
+)
+
+
+class QuantConfig:
+    """Maps layers/types to (activation, weight) quanter factories
+    (reference: quantization/config.py — add_layer_config/add_type_config/
+    add_name_config with global default)."""
+
+    def __init__(self, activation=None, weight=None):
+        self._global = (activation, weight)
+        self._type_cfg: dict[type, tuple] = {}
+        self._layer_cfg: dict[int, tuple] = {}
+        self._name_cfg: dict[str, tuple] = {}
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        types = layer_type if isinstance(layer_type, (list, tuple)) else [layer_type]
+        for t in types:
+            self._type_cfg[t] = (activation, weight)
+
+    def add_layer_config(self, layer, activation=None, weight=None):
+        layers = layer if isinstance(layer, (list, tuple)) else [layer]
+        for l in layers:
+            self._layer_cfg[id(l)] = (activation, weight)
+
+    def add_name_config(self, layer_name, activation=None, weight=None):
+        names = layer_name if isinstance(layer_name, (list, tuple)) else [layer_name]
+        for n in names:
+            self._name_cfg[n] = (activation, weight)
+
+    def _config_for(self, name: str, layer: Layer):
+        if id(layer) in self._layer_cfg:
+            return self._layer_cfg[id(layer)]
+        if name in self._name_cfg:
+            return self._name_cfg[name]
+        for t, cfg in self._type_cfg.items():
+            if isinstance(layer, t):
+                return cfg
+        return self._global
+
+    def _make(self, factory):
+        if factory is None:
+            return None
+        if isinstance(factory, type):
+            return factory()
+        if isinstance(factory, Layer):
+            return copy.deepcopy(factory)
+        return factory()  # callable factory
+
+
+class QuantedLinear(Layer):
+    """Linear with fake-quanted weight + activation (reference:
+    nn/quant/qat/linear.py QuantedLinear)."""
+
+    def __init__(self, linear, activation_quanter, weight_quanter):
+        super().__init__()
+        self._linear = linear
+        self.weight = linear.weight
+        self.bias = linear.bias
+        self.activation_quanter = activation_quanter
+        self.weight_quanter = weight_quanter
+
+    def forward(self, x):
+        from ..nn import functional as F
+
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        w = self.weight
+        if self.weight_quanter is not None:
+            w = self.weight_quanter(w)
+        return F.linear(x, w, self.bias)
+
+
+class QuantedConv2D(Layer):
+    def __init__(self, conv, activation_quanter, weight_quanter):
+        super().__init__()
+        self._conv = conv
+        self.weight = conv.weight
+        self.bias = conv.bias
+        self.activation_quanter = activation_quanter
+        self.weight_quanter = weight_quanter
+
+    def forward(self, x):
+        from ..nn import functional as F
+
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        w = self.weight
+        if self.weight_quanter is not None:
+            w = self.weight_quanter(w)
+        c = self._conv
+        return F.conv2d(x, w, self.bias, stride=c._stride,
+                        padding=c._padding, dilation=c._dilation,
+                        groups=c._groups, data_format=c._data_format)
+
+
+def _swap(model: Layer, config: QuantConfig, observer_mode: bool):
+    """Replace quantizable sublayers with quanted wrappers, in place on a
+    deep copy (reference QAT.quantize walks full_name->layer)."""
+    from ..nn import Conv2D, Linear
+
+    # the root itself may be a bare quantizable layer
+    a_factory, w_factory = config._config_for("", model)
+    if isinstance(model, Linear) and (a_factory or w_factory):
+        return QuantedLinear(model, config._make(a_factory),
+                             config._make(w_factory))
+    if isinstance(model, Conv2D) and (a_factory or w_factory):
+        return QuantedConv2D(model, config._make(a_factory),
+                             config._make(w_factory))
+
+    def visit(parent):
+        for attr_name, child in list(parent._sub_layers.items()):
+            a_factory, w_factory = config._config_for(attr_name, child)
+            if isinstance(child, Linear) and (a_factory or w_factory):
+                parent._sub_layers[attr_name] = QuantedLinear(
+                    child, config._make(a_factory), config._make(w_factory))
+            elif isinstance(child, Conv2D) and (a_factory or w_factory):
+                parent._sub_layers[attr_name] = QuantedConv2D(
+                    child, config._make(a_factory), config._make(w_factory))
+            else:
+                visit(child)
+
+    visit(model)
+    return model
+
+
+class QAT:
+    """Quantization-aware training driver (reference: quantization/qat.py)."""
+
+    def __init__(self, config: QuantConfig):
+        self._config = config
+
+    def quantize(self, model: Layer, inplace: bool = False) -> Layer:
+        target = model if inplace else copy.deepcopy(model)
+        target.train()
+        return _swap(target, self._config, observer_mode=False)
+
+    def convert(self, model: Layer, inplace: bool = False) -> Layer:
+        """Freeze: quanters stop updating (eval mode) and scales become
+        attributes for export."""
+        target = model if inplace else copy.deepcopy(model)
+        target.eval()
+        return target
+
+
+class PTQ:
+    """Post-training quantization driver (reference: quantization/ptq.py):
+    insert observers, run calibration batches, then convert to frozen
+    fake-quant using observed scales."""
+
+    def __init__(self, config: QuantConfig):
+        self._config = config
+
+    def quantize(self, model: Layer, inplace: bool = False) -> Layer:
+        target = model if inplace else copy.deepcopy(model)
+        target.eval()
+        return _swap(target, self._config, observer_mode=True)
+
+    def convert(self, model: Layer, inplace: bool = False) -> Layer:
+        """Replace observers with frozen fake quant-dequant at the observed
+        scale."""
+        target = model if inplace else copy.deepcopy(model)
+
+        def freeze_one(child):
+            obs = child.activation_quanter
+            if isinstance(obs, BaseObserver):
+                child.activation_quanter = _FrozenQuant(
+                    obs.scales(), obs.bit_length())
+            wobs = child.weight_quanter
+            if isinstance(wobs, BaseObserver):
+                child.weight_quanter = _FrozenQuant(
+                    wobs.scales(), wobs.bit_length())
+
+        def freeze(parent):
+            for child in parent._sub_layers.values():
+                if isinstance(child, (QuantedLinear, QuantedConv2D)):
+                    freeze_one(child)
+                else:
+                    freeze(child)
+
+        if isinstance(target, (QuantedLinear, QuantedConv2D)):
+            freeze_one(target)  # root-level bare layer case
+        else:
+            freeze(target)
+        return target
+
+
+class _FrozenQuant(Layer):
+    def __init__(self, scale: Tensor, bits: int):
+        super().__init__()
+        self._scale = scale
+        self._bits = bits
+
+    def scales(self):
+        return self._scale
+
+    def forward(self, x):
+        return fake_quant_dequant(x, self._scale, self._bits)
+
+
+__all__ = [
+    "QuantConfig", "QAT", "PTQ", "QuantedLinear", "QuantedConv2D",
+    "observers", "quanters", "BaseObserver", "AbsmaxObserver", "EMAObserver",
+    "HistObserver", "BaseQuanter", "FakeQuanterWithAbsMaxObserver",
+    "FakeQuanterChannelWiseAbsMaxObserver", "fake_quant_dequant",
+]

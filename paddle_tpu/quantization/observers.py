@@ -1,0 +1,122 @@
+"""PTQ observers: watch activations/weights during calibration and derive
+quantization scales.
+
+Reference: python/paddle/quantization/observers (AbsmaxObserver etc.) — an
+observer is a Layer inserted into the model that records statistics on
+forward and later reports scales()/zero_points().
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..nn.layer.layers import Layer
+from ..tensor.tensor import Tensor
+
+
+class BaseObserver(Layer):
+    """Records statistics on every forward; forward is identity."""
+
+    def __init__(self, quant_bits: int = 8):
+        super().__init__()
+        self._quant_bits = quant_bits
+
+    def bit_length(self):
+        return self._quant_bits
+
+    def quant_axis(self):
+        return -1
+
+    def scales(self) -> Tensor:
+        raise NotImplementedError
+
+    def zero_points(self):
+        return Tensor(jnp.zeros((), jnp.float32))
+
+    def _observe(self, x: Tensor):
+        raise NotImplementedError
+
+    def forward(self, x):
+        self._observe(x)
+        return x
+
+
+class AbsmaxObserver(BaseObserver):
+    """Per-tensor abs-max scale (reference:
+    quantization/observers/abs_max.py)."""
+
+    def __init__(self, quant_bits: int = 8):
+        super().__init__(quant_bits)
+        self._max = 1e-9
+
+    def _observe(self, x: Tensor):
+        self._max = max(self._max, float(jnp.abs(x._data).max()))
+
+    def scales(self) -> Tensor:
+        return Tensor(jnp.asarray(self._max, jnp.float32))
+
+
+class EMAObserver(BaseObserver):
+    """Moving-average abs-max (reference: mse/ema observers family)."""
+
+    def __init__(self, quant_bits: int = 8, moving_rate: float = 0.9):
+        super().__init__(quant_bits)
+        self._rate = moving_rate
+        self._state = None
+
+    def _observe(self, x: Tensor):
+        cur = float(jnp.abs(x._data).max())
+        self._state = cur if self._state is None else (
+            self._rate * self._state + (1 - self._rate) * cur)
+
+    def scales(self) -> Tensor:
+        return Tensor(jnp.asarray(self._state or 1e-9, jnp.float32))
+
+
+class HistObserver(BaseObserver):
+    """Histogram percentile observer (reference:
+    quantization/observers/hist.py): scale = the percentile of |x| so
+    outliers don't blow up the range."""
+
+    def __init__(self, quant_bits: int = 8, percent: float = 0.999,
+                 bins_count: int = 2048):
+        super().__init__(quant_bits)
+        self._percent = percent
+        self._bins = bins_count
+        self._hist = None
+        self._range = 1e-9
+
+    def _observe(self, x: Tensor):
+        a = np.abs(np.asarray(x._data)).ravel()
+        mx = float(a.max()) if a.size else 0.0
+        if self._hist is None:
+            self._range = max(mx, self._range)
+            self._hist = np.histogram(a, bins=self._bins,
+                                      range=(0, self._range))[0].astype(np.float64)
+            return
+        if mx > self._range:
+            # widen: redistribute accumulated counts into the new bin grid
+            # (uniform within each old bin), preserving history
+            old_edges = np.linspace(0, self._range, self._bins + 1)
+            self._range = mx
+            new_hist = np.zeros(self._bins, np.float64)
+            new_width = self._range / self._bins
+            for i, cnt in enumerate(self._hist):
+                if cnt == 0:
+                    continue
+                lo, hi = old_edges[i], old_edges[i + 1]
+                lo_bin = int(lo / new_width)
+                hi_bin = min(int(np.ceil(hi / new_width)), self._bins)
+                span = max(hi_bin - lo_bin, 1)
+                new_hist[lo_bin:lo_bin + span] += cnt / span
+            self._hist = new_hist
+        self._hist += np.histogram(a, bins=self._bins,
+                                   range=(0, self._range))[0]
+
+    def scales(self) -> Tensor:
+        if self._hist is None:
+            return Tensor(jnp.asarray(1e-9, jnp.float32))
+        cdf = np.cumsum(self._hist) / max(self._hist.sum(), 1)
+        idx = int(np.searchsorted(cdf, self._percent))
+        scale = (idx + 1) / self._bins * self._range
+        return Tensor(jnp.asarray(max(scale, 1e-9), jnp.float32))

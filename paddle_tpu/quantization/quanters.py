@@ -1,0 +1,98 @@
+"""QAT fake quanters: quantize-dequantize in forward, straight-through
+estimator in backward.
+
+Reference: python/paddle/quantization/quanters/abs_max.py
+(FakeQuanterWithAbsMaxObserver — moving-average scale learned during QAT,
+quant-dequant with STE gradient so training sees quantization error but
+gradients flow as identity inside the clip range).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..autograd.engine import apply_op
+from ..nn.layer.layers import Layer
+from ..tensor.tensor import Tensor
+
+
+def fake_quant_dequant(x: Tensor, scale, quant_bits: int = 8) -> Tensor:
+    """q = round(clip(x)/step); out = q*step. Gradient: identity where
+    |x| <= scale, 0 outside (clipped STE)."""
+    qmax = float(2 ** (quant_bits - 1) - 1)
+
+    def fn(x_, scale_):
+        s = jnp.maximum(scale_, 1e-9)
+        step = s / qmax
+        clipped = jnp.clip(x_, -s, s)
+        qdq = jnp.round(clipped / step) * step
+        # STE: forward value is qdq, gradient is d(clipped)/dx
+        return clipped + jax.lax.stop_gradient(qdq - clipped)
+
+    return apply_op("fake_quant_dequant", fn, x, scale)
+
+
+class BaseQuanter(Layer):
+    pass
+
+
+class FakeQuanterWithAbsMaxObserver(BaseQuanter):
+    """Moving-average abs-max scale + fake quant-dequant (QAT training
+    collects the scale; eval uses the frozen moving average)."""
+
+    def __init__(self, moving_rate: float = 0.9, quant_bits: int = 8,
+                 dtype="float32", name=None):
+        super().__init__()
+        self._rate = moving_rate
+        self._quant_bits = quant_bits
+        self._scale = 1e-9
+
+    def bit_length(self):
+        return self._quant_bits
+
+    def quant_axis(self):
+        return -1
+
+    def scales(self) -> Tensor:
+        return Tensor(jnp.asarray(self._scale, jnp.float32))
+
+    def zero_points(self):
+        return Tensor(jnp.zeros((), jnp.float32))
+
+    def forward(self, x):
+        if self.training:
+            cur = float(jnp.abs(x._data).max())
+            self._scale = (self._rate * self._scale
+                           + (1 - self._rate) * cur) if self._scale > 1e-9 else cur
+        return fake_quant_dequant(x, self.scales(), self._quant_bits)
+
+
+class FakeQuanterChannelWiseAbsMaxObserver(BaseQuanter):
+    """Per-output-channel abs-max fake quant for weights (reference:
+    channel-wise weight quanter; axis 0 = output channels)."""
+
+    def __init__(self, quant_bits: int = 8, quant_axis: int = 0, **kw):
+        super().__init__()
+        self._quant_bits = quant_bits
+        self._axis = quant_axis
+
+    def bit_length(self):
+        return self._quant_bits
+
+    def quant_axis(self):
+        return self._axis
+
+    def forward(self, x):
+        qmax = float(2 ** (self._quant_bits - 1) - 1)
+        axis = self._axis
+
+        def fn(x_):
+            reduce_axes = tuple(i for i in range(x_.ndim) if i != axis)
+            s = jnp.maximum(jnp.abs(x_).max(axis=reduce_axes, keepdims=True),
+                            1e-9)
+            step = s / qmax
+            clipped = jnp.clip(x_, -s, s)
+            qdq = jnp.round(clipped / step) * step
+            return clipped + jax.lax.stop_gradient(qdq - clipped)
+
+        return apply_op("fake_channel_quant_dequant", fn, x)

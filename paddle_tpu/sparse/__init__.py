@@ -1,0 +1,298 @@
+"""paddle.sparse parity package (SURVEY.md §2.8: COO/CSR tensor API +
+sparse nn backed by phi/kernels/sparse).
+
+TPU-native design: a sparse tensor is (index arrays + a dense values
+Tensor). The values Tensor is an ordinary autograd Tensor, so every sparse
+op that is "dense math on values" (unary ops, add of same-pattern tensors,
+scaling) differentiates through the existing engine for free; ops that
+change sparsity pattern (to_dense, matmul against dense) lower to XLA
+scatter/gather + matmul — on TPU the MXU wants dense tiles, so compute
+canonicalizes to dense blocks instead of the reference's per-backend sparse
+CUDA kernels (phi/kernels/sparse/). The structural arrays (indices/crows/
+cols) are non-differentiable by construction.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..autograd.engine import apply_op
+from ..tensor.tensor import Tensor
+from . import nn
+from .binary import add, divide, masked_matmul, matmul, multiply, subtract
+from .unary import (
+    abs,
+    cast,
+    deg2rad,
+    expm1,
+    log1p,
+    neg,
+    pow,
+    rad2deg,
+    relu,
+    relu6,
+    sin,
+    sinh,
+    softmax,
+    sqrt,
+    square,
+    tan,
+    tanh,
+)
+
+
+def _as_tensor(x, dtype=None):
+    if isinstance(x, Tensor):
+        return x if dtype is None else Tensor(x._data.astype(dtype))
+    return Tensor(jnp.asarray(x, dtype))
+
+
+class SparseCooTensor:
+    """COO sparse tensor: ``indices`` [sparse_dim, nnz] int64, ``values``
+    [nnz, *dense_dims] (reference: phi/core/sparse_coo_tensor.h)."""
+
+    is_sparse_coo = True
+    is_sparse_csr = False
+
+    def __init__(self, indices: Tensor, values: Tensor, shape, coalesced=False):
+        self.indices_ = _as_tensor(indices, jnp.int64)
+        self.values_ = _as_tensor(values)
+        self.shape = list(int(d) for d in shape)
+        self._coalesced = coalesced
+
+    # -- accessors (paddle Tensor method parity) --
+    def indices(self) -> Tensor:
+        return self.indices_
+
+    def values(self) -> Tensor:
+        return self.values_
+
+    def nnz(self) -> int:
+        return int(self.indices_.shape[1])
+
+    @property
+    def dtype(self):
+        return self.values_.dtype
+
+    @property
+    def stop_gradient(self):
+        return self.values_.stop_gradient
+
+    @stop_gradient.setter
+    def stop_gradient(self, v):
+        self.values_.stop_gradient = v
+
+    @property
+    def grad(self):
+        return self.values_.grad
+
+    def backward(self, *a, **k):
+        return self.values_.backward(*a, **k)
+
+    def sparse_dim(self) -> int:
+        return int(self.indices_.shape[0])
+
+    def dense_dim(self) -> int:
+        return len(self.shape) - self.sparse_dim()
+
+    def to_dense(self) -> Tensor:
+        sd = self.sparse_dim()
+        shape = tuple(self.shape)
+
+        def fn(idx, vals):
+            out = jnp.zeros(shape, vals.dtype)
+            return out.at[tuple(idx[i] for i in range(sd))].add(vals)
+
+        return apply_op("sparse_to_dense", fn, self.indices_, self.values_)
+
+    def to_sparse_csr(self) -> "SparseCsrTensor":
+        if self.sparse_dim() != 2 or self.dense_dim() != 0:
+            raise ValueError("to_sparse_csr supports 2-D COO only")
+        coo = self.coalesce()
+        rows = np.asarray(coo.indices_._data[0])
+        n_rows = self.shape[0]
+        crows = np.zeros(n_rows + 1, np.int64)
+        np.add.at(crows, rows + 1, 1)
+        crows = np.cumsum(crows)
+        return SparseCsrTensor(
+            Tensor(jnp.asarray(crows)), Tensor(coo.indices_._data[1]),
+            coo.values_, self.shape)
+
+    def coalesce(self) -> "SparseCooTensor":
+        """Sum duplicate coordinates (reference: sparse coalesce kernel).
+        Runs on host for the index bookkeeping; values reduction is an XLA
+        segment-sum so gradients flow."""
+        if self._coalesced:
+            return self
+        idx = np.asarray(self.indices_._data)
+        flat = np.ravel_multi_index(
+            idx, tuple(self.shape[: self.sparse_dim()]))
+        uniq, inverse = np.unique(flat, return_inverse=True)
+        new_idx = np.stack(np.unravel_index(
+            uniq, tuple(self.shape[: self.sparse_dim()])))
+        num = len(uniq)
+        inv = jnp.asarray(inverse)
+
+        def fn(vals):
+            return jax.ops.segment_sum(vals, inv, num_segments=num)
+
+        new_vals = apply_op("sparse_coalesce", fn, self.values_)
+        return SparseCooTensor(Tensor(jnp.asarray(new_idx)), new_vals,
+                               self.shape, coalesced=True)
+
+    def is_coalesced(self) -> bool:
+        return self._coalesced
+
+    def astype(self, dtype):
+        return SparseCooTensor(self.indices_, self.values_.astype(dtype),
+                               self.shape, self._coalesced)
+
+    cast = astype
+
+    def transpose(self, perm):
+        if self.dense_dim() != 0:
+            raise ValueError("transpose supports pure sparse dims only")
+        new_idx = self.indices_._data[jnp.asarray(perm)]
+        return SparseCooTensor(
+            Tensor(new_idx), self.values_,
+            [self.shape[p] for p in perm])
+
+    def __add__(self, other):
+        return add(self, other)
+
+    def __sub__(self, other):
+        return subtract(self, other)
+
+    def __mul__(self, other):
+        return multiply(self, other)
+
+    def __truediv__(self, other):
+        return divide(self, other)
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
+
+
+class SparseCsrTensor:
+    """CSR sparse matrix: crows [rows+1], cols [nnz], values [nnz]
+    (reference: phi/core/sparse_csr_tensor.h)."""
+
+    is_sparse_coo = False
+    is_sparse_csr = True
+
+    def __init__(self, crows: Tensor, cols: Tensor, values: Tensor, shape):
+        self.crows_ = _as_tensor(crows, jnp.int64)
+        self.cols_ = _as_tensor(cols, jnp.int64)
+        self.values_ = _as_tensor(values)
+        self.shape = list(int(d) for d in shape)
+
+    def crows(self) -> Tensor:
+        return self.crows_
+
+    def cols(self) -> Tensor:
+        return self.cols_
+
+    def values(self) -> Tensor:
+        return self.values_
+
+    def nnz(self) -> int:
+        return int(self.cols_.shape[0])
+
+    @property
+    def dtype(self):
+        return self.values_.dtype
+
+    @property
+    def stop_gradient(self):
+        return self.values_.stop_gradient
+
+    @stop_gradient.setter
+    def stop_gradient(self, v):
+        self.values_.stop_gradient = v
+
+    @property
+    def grad(self):
+        return self.values_.grad
+
+    def _row_indices(self):
+        crows = np.asarray(self.crows_._data)
+        return np.repeat(np.arange(len(crows) - 1), np.diff(crows))
+
+    def to_sparse_coo(self, sparse_dim: int = 2) -> SparseCooTensor:
+        rows = jnp.asarray(self._row_indices())
+        idx = jnp.stack([rows, self.cols_._data])
+        return SparseCooTensor(Tensor(idx), self.values_, self.shape,
+                               coalesced=True)
+
+    def to_dense(self) -> Tensor:
+        return self.to_sparse_coo().to_dense()
+
+    def astype(self, dtype):
+        return SparseCsrTensor(self.crows_, self.cols_,
+                               self.values_.astype(dtype), self.shape)
+
+    cast = astype
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
+
+
+# ---------------------------------------------------------------------------
+# creation API (reference: python/paddle/sparse/creation.py)
+# ---------------------------------------------------------------------------
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      place=None, stop_gradient=True) -> SparseCooTensor:
+    idx = _as_tensor(indices, jnp.int64)
+    vals = _as_tensor(values, dtype)
+    if shape is None:
+        maxes = np.asarray(idx._data).max(axis=1) + 1
+        shape = list(maxes) + list(vals.shape[1:])
+    vals.stop_gradient = stop_gradient
+    return SparseCooTensor(idx, vals, shape)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
+                      place=None, stop_gradient=True) -> SparseCsrTensor:
+    vals = _as_tensor(values, dtype)
+    vals.stop_gradient = stop_gradient
+    return SparseCsrTensor(_as_tensor(crows, jnp.int64),
+                           _as_tensor(cols, jnp.int64), vals, shape)
+
+
+def to_sparse_coo(x: Tensor, sparse_dim: int) -> SparseCooTensor:
+    """Dense -> COO over the leading sparse_dim dims (paddle
+    Tensor.to_sparse_coo)."""
+    arr = np.asarray(x._data)
+    reduced = arr
+    if arr.ndim > sparse_dim:
+        reduced = np.abs(arr).sum(axis=tuple(range(sparse_dim, arr.ndim)))
+    nz = np.nonzero(reduced)
+    idx = np.stack(nz)
+
+    def fn(dense):
+        return dense[tuple(jnp.asarray(i) for i in nz)]
+
+    vals = apply_op("dense_to_sparse", fn, x)
+    return SparseCooTensor(Tensor(jnp.asarray(idx)), vals, x.shape,
+                           coalesced=True)
+
+
+def to_sparse_csr(x: Tensor) -> SparseCsrTensor:
+    return to_sparse_coo(x, 2).to_sparse_csr()
+
+
+is_sparse = lambda x: getattr(x, "is_sparse_coo", False) or getattr(
+    x, "is_sparse_csr", False)
+
+__all__ = [
+    "SparseCooTensor", "SparseCsrTensor", "sparse_coo_tensor",
+    "sparse_csr_tensor", "to_sparse_coo", "to_sparse_csr", "is_sparse",
+    "nn", "add", "subtract", "multiply", "divide", "matmul",
+    "masked_matmul", "relu", "relu6", "tanh", "sin", "sinh", "tan", "sqrt",
+    "square", "abs", "pow", "neg", "log1p", "expm1", "deg2rad", "rad2deg",
+    "cast", "softmax",
+]

@@ -1,0 +1,124 @@
+"""Sparse binary + matmul ops.
+
+Reference: phi/kernels/sparse/elementwise_kernel.h (same-pattern fast path,
+union-pattern general path) and matmul_kernel.h (spmm / sddmm a.k.a.
+masked_matmul). On TPU the matmuls canonicalize to dense MXU matmuls with
+gather/scatter at the edges — XLA fuses the scatter into the epilogue.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..autograd.engine import apply_op
+from ..tensor.tensor import Tensor
+
+
+def _same_pattern(x, y) -> bool:
+    if x.is_sparse_coo and y.is_sparse_coo:
+        return x.indices_.shape == y.indices_.shape and bool(
+            np.array_equal(np.asarray(x.indices_._data),
+                           np.asarray(y.indices_._data)))
+    if x.is_sparse_csr and y.is_sparse_csr:
+        return bool(
+            np.array_equal(np.asarray(x.crows_._data), np.asarray(y.crows_._data))
+            and np.array_equal(np.asarray(x.cols_._data), np.asarray(y.cols_._data)))
+    return False
+
+
+def _ewise(name, fn, x, y):
+    from . import SparseCooTensor, to_sparse_coo
+
+    if _same_pattern(x, y):
+        vals = apply_op(f"sparse_{name}", fn, x.values(), y.values())
+        if x.is_sparse_coo:
+            return SparseCooTensor(x.indices_, vals, x.shape,
+                                   getattr(x, "_coalesced", False))
+        from . import SparseCsrTensor
+
+        return SparseCsrTensor(x.crows_, x.cols_, vals, x.shape)
+    # union pattern: go through dense (gradient-correct; XLA fuses)
+    dense = apply_op(f"sparse_{name}_dense", fn, x.to_dense(), y.to_dense())
+    out = to_sparse_coo(dense, len(x.shape))
+    return out if x.is_sparse_coo else out.to_sparse_csr()
+
+
+def add(x, y):
+    return _ewise("add", jnp.add, x, y)
+
+
+def subtract(x, y):
+    return _ewise("subtract", jnp.subtract, x, y)
+
+
+def multiply(x, y):
+    if isinstance(y, (int, float)):
+        from .unary import _unary
+
+        return _unary("scale", lambda v: v * y)(x)
+    return _ewise("multiply", jnp.multiply, x, y)
+
+
+def divide(x, y):
+    if isinstance(y, (int, float)):
+        from .unary import _unary
+
+        return _unary("scale_div", lambda v: v / y)(x)
+    if _same_pattern(x, y):
+        return _ewise("divide", jnp.divide, x, y)
+    # differing patterns: restrict to x's pattern — 0/y = 0 stays implicit,
+    # x/0 at an x-stored site is a genuine inf; a dense/dense fallback would
+    # instead store inf/nan at EVERY unstored site (nnz ~ numel blowup)
+    from . import SparseCooTensor
+
+    coo = x if x.is_sparse_coo else x.to_sparse_coo()
+    sd = coo.sparse_dim()
+    nz = tuple(coo.indices_._data[i] for i in range(sd))
+
+    def fn(vals, ydense):
+        return vals / ydense[nz]
+
+    vals = apply_op("sparse_divide_sampled", fn, coo.values(), y.to_dense())
+    out = SparseCooTensor(coo.indices_, vals, coo.shape,
+                          getattr(coo, "_coalesced", False))
+    return out if x.is_sparse_coo else out.to_sparse_csr()
+
+
+def matmul(x, y: Tensor) -> Tensor:
+    """sparse @ dense -> dense (SpMM). COO path: gather-scatter matmul so
+    only stored entries contribute; values gradient flows through vjp."""
+    if getattr(x, "is_sparse_csr", False):
+        x = x.to_sparse_coo()
+    if getattr(x, "is_sparse_coo", False):
+        if x.sparse_dim() != 2 or x.dense_dim() != 0:
+            raise ValueError("sparse matmul expects a 2-D sparse matrix")
+        n_rows = x.shape[0]
+        rows = x.indices_._data[0]
+        cols = x.indices_._data[1]
+
+        def fn(vals, dense):
+            import jax
+
+            gathered = dense[cols] * vals[:, None]  # [nnz, N]
+            return jax.ops.segment_sum(gathered, rows, num_segments=n_rows)
+
+        return apply_op("sparse_matmul", fn, x.values(), y)
+    raise ValueError("matmul expects a sparse lhs")
+
+
+def masked_matmul(x: Tensor, y: Tensor, mask):
+    """SDDMM: (x @ y) sampled at mask's sparsity pattern -> sparse with
+    mask's pattern (reference: phi sparse masked_matmul)."""
+    from . import SparseCsrTensor
+
+    if not getattr(mask, "is_sparse_csr", False):
+        raise ValueError("masked_matmul mask must be SparseCsrTensor")
+    rows = jnp.asarray(mask._row_indices())
+    cols = mask.cols_._data
+
+    def fn(a, b):
+        # only compute the sampled dot products: [nnz, K] x [nnz, K] -> [nnz]
+        return (a[rows] * b[:, cols].T).sum(-1)
+
+    vals = apply_op("sparse_sddmm", fn, x, y)
+    return SparseCsrTensor(mask.crows_, mask.cols_, vals, mask.shape)

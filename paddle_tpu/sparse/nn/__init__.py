@@ -1,0 +1,138 @@
+"""paddle.sparse.nn parity: sparse layers + functional attention.
+
+Reference: python/paddle/sparse/nn (Conv3D/SubmConv3D over phi sparse conv
+kernels, BatchNorm, ReLU, MaxPool3D) and sparse attention
+(phi/kernels/sparse/gpu/sparse_attention). TPU stance: sparse 3-D point
+clouds compute as dense blocks (the MXU has no gather-matmul path worth
+hand-rolling at this density regime); SubmConv3D preserves the input
+pattern by sampling the dense result at the input's coordinates, which is
+exactly the submanifold definition.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ... import nn as dense_nn
+from ...autograd.engine import apply_op
+from ...nn import functional as dense_F
+from ...nn.layer.layers import Layer
+from ...tensor.tensor import Tensor
+from . import functional
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        from .. import relu
+
+        return relu(x)
+
+
+class Softmax(Layer):
+    def __init__(self, axis=-1):
+        super().__init__()
+        self._axis = axis
+
+    def forward(self, x):
+        from ..unary import softmax
+
+        return softmax(x, self._axis)
+
+
+class _SparseConvBase(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, subm=False,
+                 bias_attr=None, data_format="NDHWC"):
+        super().__init__()
+        if data_format != "NDHWC":
+            raise ValueError("sparse conv3d expects NDHWC (reference layout)")
+        self._subm = subm
+        self._stride = stride
+        self._padding = padding
+        self._dilation = dilation
+        self._groups = groups
+        self._dense = dense_nn.Conv3D(
+            in_channels, out_channels, kernel_size, stride=stride,
+            padding=padding, dilation=dilation, groups=groups,
+            bias_attr=bias_attr, data_format="NDHWC")
+        self.weight = self._dense.weight
+        self.bias = self._dense.bias
+
+    def forward(self, x):
+        from .. import SparseCooTensor, to_sparse_coo
+
+        dense_in = x.to_dense()
+        out = self._dense(dense_in)
+        if not self._subm:
+            return to_sparse_coo(out, 4)  # N,D,H,W sparse; C dense
+        # submanifold: output pattern == input pattern
+        idx = x.indices_
+        nz = tuple(idx._data[i] for i in range(4))
+
+        def sample(dense):
+            return dense[nz]
+
+        vals = apply_op("subm_sample", sample, out)
+        return SparseCooTensor(idx, vals, list(out.shape), coalesced=True)
+
+
+class Conv3D(_SparseConvBase):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NDHWC"):
+        super().__init__(in_channels, out_channels, kernel_size, stride,
+                         padding, dilation, groups, subm=False,
+                         bias_attr=bias_attr, data_format=data_format)
+
+
+class SubmConv3D(_SparseConvBase):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 key=None, weight_attr=None, bias_attr=None,
+                 data_format="NDHWC"):
+        super().__init__(in_channels, out_channels, kernel_size, stride,
+                         padding, dilation, groups, subm=True,
+                         bias_attr=bias_attr, data_format=data_format)
+
+
+class BatchNorm(Layer):
+    """Sparse batch norm: normalizes over stored values per channel
+    (reference: sparse/nn/layer/norm.py — statistics over nnz, not the
+    implicit zeros)."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-05,
+                 weight_attr=None, bias_attr=None, data_format="NDHWC"):
+        super().__init__()
+        self._bn = dense_nn.BatchNorm1D(
+            num_features, momentum=momentum, epsilon=epsilon,
+            weight_attr=weight_attr, bias_attr=bias_attr)
+        self.weight = self._bn.weight
+        self.bias = self._bn.bias
+
+    def forward(self, x):
+        from .. import SparseCooTensor
+
+        vals = self._bn(x.values())  # [nnz, C]
+        return SparseCooTensor(x.indices_, vals, x.shape,
+                               getattr(x, "_coalesced", False))
+
+
+class MaxPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NDHWC"):
+        super().__init__()
+        self._k = kernel_size
+        self._s = stride
+        self._p = padding
+
+    def forward(self, x):
+        from .. import to_sparse_coo
+
+        dense = x.to_dense()  # NDHWC
+        nchw = dense.transpose([0, 4, 1, 2, 3])
+        out = dense_F.max_pool3d(nchw, self._k, self._s, self._p)
+        out = out.transpose([0, 2, 3, 4, 1])
+        return to_sparse_coo(out, 4)
+
+
+__all__ = ["ReLU", "Softmax", "Conv3D", "SubmConv3D", "BatchNorm",
+           "MaxPool3D", "functional"]

@@ -1,0 +1,73 @@
+"""Sparse functional ops incl. attention.
+
+Reference: paddle.sparse.nn.functional (relu/conv3d/subm_conv3d/attention —
+phi/kernels/sparse/gpu/sparse_attention kernels). The attention here is the
+CSR-masked variant: scores computed only where the mask stores entries,
+row-softmax over stored entries, then SpMM against V.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...autograd.engine import apply_op
+from ...tensor.tensor import Tensor
+
+
+def relu(x):
+    from .. import relu as _relu
+
+    return _relu(x)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+           groups=1, data_format="NDHWC"):
+    from ...nn import functional as dense_F
+    from .. import to_sparse_coo
+
+    dense = x.to_dense().transpose([0, 4, 1, 2, 3])
+    out = dense_F.conv3d(dense, weight, bias, stride, padding, dilation,
+                         groups)
+    return to_sparse_coo(out.transpose([0, 2, 3, 4, 1]), 4)
+
+
+def attention(query: Tensor, key: Tensor, value: Tensor, sparse_mask,
+              key_padding_mask=None, attn_mask=None):
+    """Sparse-mask attention: Q,K,V are [B, H, L, D] dense; sparse_mask is a
+    [B*H, L, L]-patterned CSR batch (reference sparse attention contract:
+    one CSR per batch*head with identical pattern allowed). Returns dense
+    [B, H, L, D]."""
+    import numpy as np
+
+    B, H, L, D = (int(s) for s in query.shape)
+    rows = jnp.asarray(sparse_mask._row_indices())  # over flattened [B*H*L]
+    cols = sparse_mask.cols_._data
+    # rows index into B*H*L row space; recover (bh, l)
+    bh = rows // L
+    qrow = rows % L
+    scale = 1.0 / float(np.sqrt(D))
+    n_rows = B * H * L
+
+    def fn(q, k, v, kpm, am):
+        import jax
+
+        qf = q.reshape(B * H, L, D)
+        kf = k.reshape(B * H, L, D)
+        vf = v.reshape(B * H, L, D)
+        # sampled scores at stored (row, col) positions
+        scores = (qf[bh, qrow] * kf[bh, cols]).sum(-1) * scale
+        b_idx = bh // H  # batch of each stored entry
+        # reference contract: both masks are 0/1, 0 = masked out
+        if kpm is not None:  # key_padding_mask [B, L]
+            scores = jnp.where(kpm[b_idx, cols] != 0, scores, -1e9)
+        if am is not None:  # attn_mask [L, L]
+            scores = jnp.where(am[qrow, cols] != 0, scores, -1e9)
+        row_max = jax.ops.segment_max(scores, rows, num_segments=n_rows)
+        p = jnp.exp(scores - row_max[rows])
+        denom = jax.ops.segment_sum(p, rows, num_segments=n_rows)
+        p = p / jnp.maximum(denom[rows], 1e-20)
+        out = jax.ops.segment_sum(p[:, None] * vf[bh, cols], rows,
+                                  num_segments=n_rows)
+        return out.reshape(B, H, L, D)
+
+    return apply_op("sparse_attention", fn, query, key, value,
+                    key_padding_mask, attn_mask)

@@ -1,0 +1,82 @@
+"""Sparse unary ops: dense math on the values array, pattern unchanged.
+
+Reference: paddle/phi/kernels/sparse/unary_kernel.h — the op set is exactly
+the zero-preserving functions (f(0)=0), so applying f to values alone is
+the whole kernel. Gradients flow through the values Tensor via the engine.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..autograd.engine import apply_op
+
+
+def _unary(name, fn):
+    def op(x):
+        vals = apply_op(name, fn, x.values())
+        if x.is_sparse_coo:
+            from . import SparseCooTensor
+
+            return SparseCooTensor(x.indices_, vals, x.shape, x._coalesced)
+        from . import SparseCsrTensor
+
+        return SparseCsrTensor(x.crows_, x.cols_, vals, x.shape)
+
+    op.__name__ = f"sparse_{name}"
+    return op
+
+
+relu = _unary("relu", lambda v: jnp.maximum(v, 0))
+relu6 = _unary("relu6", lambda v: jnp.clip(v, 0, 6))
+tanh = _unary("tanh", jnp.tanh)
+sin = _unary("sin", jnp.sin)
+sinh = _unary("sinh", jnp.sinh)
+tan = _unary("tan", jnp.tan)
+asin = _unary("asin", jnp.arcsin)
+asinh = _unary("asinh", jnp.arcsinh)
+atan = _unary("atan", jnp.arctan)
+atanh = _unary("atanh", jnp.arctanh)
+sqrt = _unary("sqrt", jnp.sqrt)
+square = _unary("square", jnp.square)
+abs = _unary("abs", jnp.abs)
+neg = _unary("neg", jnp.negative)
+log1p = _unary("log1p", jnp.log1p)
+expm1 = _unary("expm1", jnp.expm1)
+deg2rad = _unary("deg2rad", jnp.deg2rad)
+rad2deg = _unary("rad2deg", jnp.rad2deg)
+
+
+def pow(x, factor):
+    return _unary("pow", lambda v: jnp.power(v, factor))(x)
+
+
+def cast(x, index_dtype=None, value_dtype=None):
+    out = x.astype(value_dtype) if value_dtype is not None else x
+    return out
+
+
+def softmax(x, axis=-1):
+    """Sparse softmax over the last axis of a 2-D CSR matrix: softmax within
+    each row's stored entries (reference:
+    phi/kernels/sparse/softmax_kernel.h — zeros stay zero; probability mass
+    is distributed over stored positions only)."""
+    if not x.is_sparse_csr:
+        raise ValueError("sparse softmax expects a SparseCsrTensor")
+    if axis not in (-1, len(x.shape) - 1):
+        raise ValueError("sparse softmax supports the last axis only")
+    import jax
+    import numpy as np
+
+    rows = jnp.asarray(x._row_indices())
+    n_rows = x.shape[0]
+
+    def fn(vals):
+        row_max = jax.ops.segment_max(vals, rows, num_segments=n_rows)
+        shifted = jnp.exp(vals - row_max[rows])
+        denom = jax.ops.segment_sum(shifted, rows, num_segments=n_rows)
+        return shifted / denom[rows]
+
+    vals = apply_op("sparse_softmax", fn, x.values())
+    from . import SparseCsrTensor
+
+    return SparseCsrTensor(x.crows_, x.cols_, vals, x.shape)

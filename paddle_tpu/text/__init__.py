@@ -1,0 +1,96 @@
+"""paddle.text parity (SURVEY.md §2.8 datasets/text row): ViterbiDecoder +
+dataset loaders.
+
+Reference: python/paddle/text — viterbi_decode op (phi viterbi_decode
+kernel) and legacy dataset loaders. Decoding is a lax.scan max-product
+forward pass + backtrack, fully jittable on TPU.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..autograd.engine import apply_op
+from ..nn.layer.layers import Layer
+from ..tensor.tensor import Tensor
+from . import datasets
+
+
+def viterbi_decode(potentials: Tensor, transition_params: Tensor,
+                   lengths: Tensor, include_bos_eos_tag: bool = True,
+                   name=None):
+    """Batch Viterbi decoding (reference: paddle.text.viterbi_decode).
+
+    potentials [B, L, C] emission scores; transition_params [C, C];
+    lengths [B] valid steps per sequence. With include_bos_eos_tag, tag C-2
+    is BOS and C-1 is EOS (reference contract): step 0 adds
+    transition[BOS, :], the last valid step adds transition[:, EOS].
+    Returns (scores [B], paths [B, L_max_valid]).
+    """
+
+    def fn(pots, trans, lens):
+        B, L, C = pots.shape
+        if include_bos_eos_tag:
+            alpha0 = pots[:, 0] + trans[C - 2][None, :]
+        else:
+            alpha0 = pots[:, 0]
+
+        def step(carry, t):
+            alpha = carry  # [B, C]
+            # scores[b, i, j] = alpha[b, i] + trans[i, j] + pots[b, t, j]
+            scores = alpha[:, :, None] + trans[None, :, :]
+            best_prev = jnp.argmax(scores, axis=1)  # [B, C]
+            new_alpha = jnp.max(scores, axis=1) + pots[:, t]
+            if include_bos_eos_tag:
+                # at each sequence's last step, add the EOS transition; we
+                # apply it lazily below by tracking per-step alphas
+                pass
+            # freeze alphas past each sequence's length
+            active = (t < lens)[:, None]
+            new_alpha = jnp.where(active, new_alpha, alpha)
+            best_prev = jnp.where(active, best_prev,
+                                  jnp.arange(C)[None, :])
+            return new_alpha, (new_alpha, best_prev)
+
+        alpha_final, (alphas, backptrs) = jax.lax.scan(
+            step, alpha0, jnp.arange(1, L))
+        if include_bos_eos_tag:
+            alpha_final = alpha_final + trans[:, C - 1][None, :]
+        scores = jnp.max(alpha_final, axis=1)
+        last_tag = jnp.argmax(alpha_final, axis=1)  # [B]
+
+        # backtrack from each sequence's end
+        def back(carry, t_rev):
+            tag = carry  # [B]
+            ptrs = backptrs[t_rev]  # [B, C] for step t_rev+1
+            prev_tag = jnp.take_along_axis(
+                ptrs, tag[:, None], axis=1)[:, 0]
+            active = (t_rev + 1) < lens
+            prev_tag = jnp.where(active, prev_tag, tag)
+            return prev_tag, tag
+
+        _, path_rev = jax.lax.scan(back, last_tag,
+                                   jnp.arange(L - 2, -1, -1))
+        first = _  # tag at t=0
+        path = jnp.concatenate([first[None], path_rev[::-1]], axis=0).T
+        return scores, path.astype(jnp.int64)
+
+    return apply_op("viterbi_decode", fn, potentials, transition_params,
+                    lengths)
+
+
+class ViterbiDecoder(Layer):
+    """Layer wrapper (reference: paddle.text.ViterbiDecoder)."""
+
+    def __init__(self, transitions: Tensor, include_bos_eos_tag: bool = True,
+                 name=None):
+        super().__init__()
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)
+
+
+__all__ = ["viterbi_decode", "ViterbiDecoder", "datasets"]

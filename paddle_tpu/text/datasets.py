@@ -1,0 +1,143 @@
+"""paddle.text.datasets parity: loaders for the classic corpora.
+
+Reference: python/paddle/text/datasets (UCIHousing, Imdb, Imikolov,
+Movielens, Conll05st, WMT14/16) — each downloads an archive then parses it.
+This environment has no egress, so every loader takes ``data_file`` (a
+local copy of the reference's archive/file) and parses the same formats;
+with no file present a clear DownloadUnavailable error explains what to
+fetch. UCIHousing additionally accepts plain whitespace-separated rows.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import tarfile
+
+import numpy as np
+
+from ..io.dataset import Dataset
+
+
+class DownloadUnavailable(RuntimeError):
+    def __init__(self, name, url_hint):
+        super().__init__(
+            f"{name}: automatic download is disabled in this build "
+            f"(no network egress). Pass data_file= with a local copy "
+            f"of {url_hint}.")
+
+
+class UCIHousing(Dataset):
+    """506x13 housing regression (reference: text/datasets/uci_housing.py,
+    80/20 train/test split, feature-wise max-min normalization)."""
+
+    FEATURE_NUM = 13
+
+    def __init__(self, data_file=None, mode="train", download=False):
+        if data_file is None or not os.path.exists(data_file):
+            raise DownloadUnavailable(
+                "UCIHousing", "housing.data (UCI archive)")
+        raw = np.loadtxt(data_file).astype("float32")
+        feats = raw[:, :-1]
+        maxs, mins = feats.max(0), feats.min(0)
+        avgs = feats.mean(0)
+        feats = (feats - avgs) / (maxs - mins + 1e-12)
+        data = np.concatenate([feats, raw[:, -1:]], 1)
+        split = int(len(data) * 0.8)
+        self.data = data[:split] if mode == "train" else data[split:]
+
+    def __getitem__(self, idx):
+        row = self.data[idx]
+        return row[:-1], row[-1:]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Imdb(Dataset):
+    """IMDB sentiment (reference: text/datasets/imdb.py — builds a word
+    dict from the tarball, tokenizes by whitespace, label from path)."""
+
+    def __init__(self, data_file=None, mode="train", cutoff=150,
+                 download=False):
+        if data_file is None or not os.path.exists(data_file):
+            raise DownloadUnavailable("Imdb", "aclImdb_v1.tar.gz")
+        self.mode = mode
+        docs, labels = [], []
+        # vocabulary spans BOTH splits (reference build_work_dict reads the
+        # whole archive) so train/test token ids are consistent
+        freq: dict[str, int] = {}
+        with tarfile.open(data_file) as tf:
+            for member in tf.getmembers():
+                n = member.name
+                if not n.endswith(".txt") or not (
+                        n.startswith("aclImdb/train") or
+                        n.startswith("aclImdb/test")):
+                    continue
+                if "/pos/" in n:
+                    label = 0
+                elif "/neg/" in n:
+                    label = 1
+                else:
+                    continue
+                text = tf.extractfile(member).read().decode(
+                    "utf-8", "ignore").lower()
+                toks = text.split()
+                for t in toks:
+                    freq[t] = freq.get(t, 0) + 1
+                if n.startswith(f"aclImdb/{mode}"):
+                    docs.append(toks)
+                    labels.append(label)
+        vocab = [w for w, c in sorted(freq.items(),
+                                      key=lambda kv: (-kv[1], kv[0]))
+                 if c > cutoff]
+        self.word_idx = {w: i for i, w in enumerate(vocab)}
+        self.word_idx["<unk>"] = len(self.word_idx)
+        unk = self.word_idx["<unk>"]
+        self.docs = [np.array([self.word_idx.get(t, unk) for t in d],
+                              np.int64) for d in docs]
+        self.labels = np.array(labels, np.int64)
+
+    def __getitem__(self, idx):
+        return self.docs[idx], self.labels[idx]
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class Imikolov(Dataset):
+    """PTB n-gram dataset (reference: text/datasets/imikolov.py)."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_freq=50, download=False):
+        if data_file is None or not os.path.exists(data_file):
+            raise DownloadUnavailable("Imikolov", "simple-examples.tgz")
+        fname = f"./simple-examples/data/ptb.{'train' if mode == 'train' else 'valid'}.txt"
+        freq: dict[str, int] = {}
+        lines = []
+        with tarfile.open(data_file) as tf:
+            with tf.extractfile(fname) as f:
+                for line in f:
+                    toks = line.decode().strip().split()
+                    lines.append(toks)
+                    for t in toks:
+                        freq[t] = freq.get(t, 0) + 1
+        vocab = sorted((w for w, c in freq.items()
+                        if c >= min_word_freq and w != "<unk>"))
+        self.word_idx = {w: i for i, w in enumerate(vocab)}
+        unk = self.word_idx.setdefault("<unk>", len(self.word_idx))
+        self.data = []
+        for toks in lines:
+            ids = [self.word_idx.get(t, unk) for t in ["<s>"] * (window_size - 1) + toks + ["<e>"]
+                   if True]
+            for i in range(window_size, len(ids) + 1):
+                self.data.append(np.array(ids[i - window_size: i], np.int64))
+
+    def __getitem__(self, idx):
+        row = self.data[idx]
+        return tuple(row)
+
+    def __len__(self):
+        return len(self.data)
+
+
+__all__ = ["UCIHousing", "Imdb", "Imikolov", "DownloadUnavailable"]

@@ -1,0 +1,142 @@
+"""audio features vs scipy-free oracles; wav IO round-trip; viterbi decode
+vs brute-force path enumeration; dataset loaders on synthesized archives."""
+import math
+import os
+import tarfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import audio, text
+from paddle_tpu.audio import features, functional as AF
+
+
+def test_get_window_hann():
+    w = np.asarray(AF.get_window("hann", 8)._data)
+    n = np.arange(8)
+    want = 0.5 - 0.5 * np.cos(2 * math.pi * n / 8)
+    np.testing.assert_allclose(w, want, rtol=1e-6)
+
+
+def test_hz_mel_roundtrip():
+    for hz in (100.0, 440.0, 4000.0):
+        back = AF.mel_to_hz(AF.hz_to_mel(hz))
+        np.testing.assert_allclose(back, hz, rtol=1e-4)
+    # htk variant
+    np.testing.assert_allclose(AF.mel_to_hz(AF.hz_to_mel(440.0, htk=True),
+                                            htk=True), 440.0, rtol=1e-4)
+
+
+def test_fbank_matrix_shape_and_coverage():
+    fb = np.asarray(AF.compute_fbank_matrix(16000, 512, n_mels=40)._data)
+    assert fb.shape == (40, 257)
+    assert (fb >= 0).all()
+    assert (fb.sum(1) > 0).all()  # every filter covers some bins
+
+
+def test_spectrogram_sine_peak(rng):
+    sr, n_fft = 16000, 512
+    t = np.arange(sr, dtype=np.float32) / sr
+    freq = 1000.0
+    x = paddle.to_tensor(np.sin(2 * math.pi * freq * t)[None])
+    spec = np.asarray(features.Spectrogram(n_fft=n_fft)(x)._data)
+    peak_bin = spec.mean(-1)[0].argmax()
+    expect_bin = round(freq * n_fft / sr)
+    assert abs(int(peak_bin) - expect_bin) <= 1
+
+
+def test_mfcc_shapes(rng):
+    x = paddle.to_tensor(rng.randn(2, 8000).astype("float32"))
+    out = features.MFCC(sr=16000, n_mfcc=13, n_fft=512)(x)
+    assert out.shape[0] == 2 and out.shape[1] == 13
+
+
+def test_wav_save_load_roundtrip(tmp_path, rng):
+    sr = 8000
+    x = np.sin(np.linspace(0, 40 * math.pi, sr)).astype("float32")[None]
+    path = str(tmp_path / "t.wav")
+    audio.backends.save(path, paddle.to_tensor(x), sr)
+    info = audio.backends.info(path)
+    assert info.sample_rate == sr and info.num_channels == 1
+    loaded, sr2 = audio.backends.load(path)
+    assert sr2 == sr
+    np.testing.assert_allclose(np.asarray(loaded._data)[0], x[0], atol=1e-3)
+
+
+def _brute_viterbi(pots, trans, length, bos, eos):
+    import itertools
+
+    C = pots.shape[-1]
+    best, best_path = -np.inf, None
+    for path in itertools.product(range(C), repeat=length):
+        s = trans[bos, path[0]] + pots[0, path[0]]
+        for t in range(1, length):
+            s += trans[path[t - 1], path[t]] + pots[t, path[t]]
+        s += trans[path[-1], eos]
+        if s > best:
+            best, best_path = s, path
+    return best, list(best_path)
+
+
+def test_viterbi_decode_vs_bruteforce(rng):
+    B, L, C = 2, 4, 5  # tags: 3 real + BOS(3) + EOS(4)
+    pots = rng.randn(B, L, C).astype("float32")
+    trans = rng.randn(C, C).astype("float32")
+    lengths = np.array([4, 3], np.int64)
+    scores, paths = text.viterbi_decode(
+        paddle.to_tensor(pots), paddle.to_tensor(trans),
+        paddle.to_tensor(lengths))
+    scores = np.asarray(scores._data)
+    paths = np.asarray(paths._data)
+    for b in range(B):
+        want_s, want_p = _brute_viterbi(pots[b], trans, int(lengths[b]),
+                                        C - 2, C - 1)
+        np.testing.assert_allclose(scores[b], want_s, rtol=1e-5)
+        assert list(paths[b][: int(lengths[b])]) == want_p
+
+
+def test_viterbi_decoder_layer(rng):
+    C = 4
+    dec = text.ViterbiDecoder(paddle.to_tensor(rng.randn(C, C).astype("float32")),
+                              include_bos_eos_tag=False)
+    pots = paddle.to_tensor(rng.randn(1, 3, C).astype("float32"))
+    scores, path = dec(pots, paddle.to_tensor(np.array([3], np.int64)))
+    assert path.shape == [1, 3]
+
+
+def test_uci_housing_loader(tmp_path, rng):
+    rows = np.hstack([rng.rand(50, 13), rng.rand(50, 1) * 50])
+    f = tmp_path / "housing.data"
+    np.savetxt(f, rows)
+    train = text.datasets.UCIHousing(data_file=str(f), mode="train")
+    test = text.datasets.UCIHousing(data_file=str(f), mode="test")
+    assert len(train) == 40 and len(test) == 10
+    x, y = train[0]
+    assert x.shape == (13,) and y.shape == (1,)
+
+
+def test_imdb_loader(tmp_path):
+    tar = tmp_path / "aclImdb.tar.gz"
+    with tarfile.open(tar, "w:gz") as tf:
+        for i, (split, lab, textv) in enumerate([
+                ("train", "pos", b"good great good movie"),
+                ("train", "neg", b"bad awful bad movie"),
+        ]):
+            data = textv
+            import io
+
+            ti = tarfile.TarInfo(f"aclImdb/{split}/{lab}/{i}.txt")
+            ti.size = len(data)
+            tf.addfile(ti, io.BytesIO(data))
+    ds = text.datasets.Imdb(data_file=str(tar), mode="train", cutoff=0)
+    assert len(ds) == 2
+    doc, label = ds[0]
+    assert doc.dtype == np.int64
+    assert set(np.asarray([label, ds[1][1]])) == {0, 1}
+
+
+def test_download_unavailable_error():
+    with pytest.raises(text.datasets.DownloadUnavailable) as ei:
+        text.datasets.UCIHousing()
+    assert "data_file" in str(ei.value)

@@ -1,0 +1,97 @@
+"""auto_tuner: candidate enumeration invariants, prune rules, memory model
+monotonicity, full tune loop with a synthetic cost surface, history IO."""
+import numpy as np
+
+from paddle_tpu.distributed.auto_tuner import (
+    AutoTuneConfig,
+    GridSearch,
+    HistoryRecorder,
+    Tuner,
+    all_candidates,
+    prune_invalid,
+    tune,
+)
+from paddle_tpu.distributed.auto_tuner.prune import estimate_memory_gb
+
+
+def test_candidates_cover_device_factorizations():
+    cands = all_candidates(8, 16, recompute_options=(False,),
+                           micro_batch_sizes=[1])
+    combos = {(c.dp_degree, c.mp_degree, c.pp_degree) for c in cands}
+    for dp, mp, pp in combos:
+        assert dp * mp * pp == 8
+        assert 16 % dp == 0
+    assert (8, 1, 1) in combos and (1, 8, 1) in combos and (2, 2, 2) in combos
+
+
+def test_sharding_only_within_dp():
+    cands = all_candidates(4, 8, micro_batch_sizes=[1],
+                           recompute_options=(False,))
+    for c in cands:
+        assert c.dp_degree % c.sharding_degree == 0
+        if c.sharding_degree == 1:
+            assert c.sharding_stage == 1
+
+
+def test_prune_invalid_divisibility():
+    cands = all_candidates(8, 8, micro_batch_sizes=[1],
+                           recompute_options=(False,))
+    ctx = {"hidden_size": 512, "num_heads": 6, "num_layers": 24}
+    bad = [c for c in cands if c.mp_degree == 4]
+    assert all(prune_invalid(c, ctx) for c in bad)  # 6 heads % 4 != 0
+    ok = [c for c in cands if c.mp_degree == 2
+          and not (c.sharding_stage == 3 and c.pp_degree > 1)]
+    assert ok and all(not prune_invalid(c, ctx) for c in ok)
+
+
+def test_memory_model_monotonic():
+    from paddle_tpu.distributed.auto_tuner.search import Candidate
+
+    ctx = {"num_layers": 24, "hidden_size": 2048, "num_heads": 16,
+           "vocab_size": 51200, "seq_length": 2048}
+    base = Candidate(8, 1, 1, 1, 1, 4, False)
+    sharded = Candidate(8, 1, 1, 8, 2, 4, False)
+    recomputed = Candidate(8, 1, 1, 1, 1, 4, True)
+    assert estimate_memory_gb(sharded, ctx) < estimate_memory_gb(base, ctx)
+    assert estimate_memory_gb(recomputed, ctx) < estimate_memory_gb(base, ctx)
+
+
+def test_tune_loop_finds_best_and_records_errors():
+    cfg = AutoTuneConfig(num_devices=4, global_batch_size=8,
+                         model={"hidden_size": 64, "num_heads": 4,
+                                "num_layers": 4})
+
+    def run_trial(c):
+        if c.pp_degree == 4:
+            raise RuntimeError("synthetic OOM")
+        # synthetic surface: favors dp=2, mp=2, mbs=2
+        return (10.0 - abs(c.dp_degree - 2) - abs(c.mp_degree - 2)
+                - abs(c.micro_batch_size - 2) - 0.5 * c.use_recompute)
+
+    best, recorder = tune(cfg, run_trial)
+    assert best["dp_degree"] == 2 and best["mp_degree"] == 2
+    assert best["micro_batch_size"] == 2
+    errors = [r for r in recorder.history if r.get("error")]
+    assert errors and all("OOM" in r["error"] for r in errors)
+
+
+def test_recorder_store_load(tmp_path):
+    r = HistoryRecorder("throughput")
+    r.add_cfg(dp_degree=2, throughput=5.0)
+    r.add_cfg(dp_degree=4, throughput=9.0)
+    r.add_cfg(dp_degree=8, throughput=None, error="boom")
+    path = str(tmp_path / "hist.csv")
+    r.store_history(path)
+    r2 = HistoryRecorder("throughput")
+    r2.load_history(path)
+    assert len(r2.history) == 3
+    assert r.get_best()["dp_degree"] == 4
+
+
+def test_max_trials_bound():
+    cfg = AutoTuneConfig(num_devices=8, global_batch_size=32, max_trials=5)
+    t = Tuner(cfg)
+    seen = 0
+    while t.search_once() is not None:
+        seen += 1
+    assert seen == 5

@@ -1,0 +1,139 @@
+"""paddle.incubate parity: fused functional ops vs composed-op oracles,
+fused transformer layers (shape + gradient + eval determinism), segment ops,
+RoPE vs manual rotation."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import incubate
+from paddle_tpu.incubate.nn import (
+    FusedFeedForward,
+    FusedMultiHeadAttention,
+    FusedMultiTransformer,
+    FusedTransformerEncoderLayer,
+    functional as FF,
+)
+
+
+def test_fused_rms_norm_matches_composed(rng):
+    x = paddle.to_tensor(rng.randn(2, 5, 8).astype("float32"))
+    w = paddle.to_tensor(rng.rand(8).astype("float32"))
+    out = FF.fused_rms_norm(x, w)
+    xv = np.asarray(x._data)
+    want = xv / np.sqrt((xv ** 2).mean(-1, keepdims=True) + 1e-6) * np.asarray(w._data)
+    np.testing.assert_allclose(np.asarray(out._data), want, rtol=1e-5)
+
+
+def test_fused_layer_norm_gradient(rng):
+    x = paddle.to_tensor(rng.randn(3, 6).astype("float32"))
+    x.stop_gradient = False
+    w = paddle.to_tensor(np.ones(6, np.float32))
+    b = paddle.to_tensor(np.zeros(6, np.float32))
+    FF.fused_layer_norm(x, w, b).sum().backward()
+    assert x.grad is not None
+    # LN output sums to ~0 per row -> grad of sum is ~0
+    np.testing.assert_allclose(np.asarray(x.grad._data), 0, atol=1e-5)
+
+
+def test_fused_dropout_add_eval_and_train(rng):
+    x = paddle.to_tensor(rng.randn(4, 4).astype("float32"))
+    y = paddle.to_tensor(rng.randn(4, 4).astype("float32"))
+    out = FF.fused_dropout_add(x, y, p=0.5, training=False)
+    np.testing.assert_allclose(np.asarray(out._data),
+                               np.asarray(x._data) + np.asarray(y._data))
+    out_t = FF.fused_dropout_add(x, y, p=0.5, training=True)
+    assert out_t.shape == [4, 4]
+
+
+def test_fused_rope_rotates_q_and_k(rng):
+    B, S, H, D = 2, 6, 2, 8
+    q = paddle.to_tensor(rng.randn(B, S, H, D).astype("float32"))
+    k = paddle.to_tensor(rng.randn(B, S, H, D).astype("float32"))
+    out_q, out_k, _ = FF.fused_rotary_position_embedding(q, k)
+    # manual neox-style rope oracle
+    inv = 1.0 / (10000.0 ** (np.arange(0, D, 2) / D))
+    freqs = np.outer(np.arange(S), inv)
+    emb = np.concatenate([freqs, freqs], -1)
+    sin, cos = np.sin(emb), np.cos(emb)
+    qv = np.asarray(q._data)
+    rot = np.concatenate([-qv[..., D // 2:], qv[..., :D // 2]], -1)
+    want = qv * cos[None, :, None, :] + rot * sin[None, :, None, :]
+    np.testing.assert_allclose(np.asarray(out_q._data), want, rtol=1e-4,
+                               atol=1e-5)
+    # position 0 is identity
+    np.testing.assert_allclose(np.asarray(out_q._data)[:, 0],
+                               qv[:, 0], rtol=1e-5)
+
+
+def test_swiglu_split(rng):
+    x = paddle.to_tensor(rng.randn(2, 8).astype("float32"))
+    out = FF.swiglu(x)
+    xv = np.asarray(x._data)
+    a, b = xv[:, :4], xv[:, 4:]
+    silu = a / (1 + np.exp(-a)) * b
+    np.testing.assert_allclose(np.asarray(out._data), silu, rtol=1e-5)
+
+
+def test_fused_mha_forward_backward(rng):
+    paddle.seed(3)
+    mha = FusedMultiHeadAttention(32, 4, dropout_rate=0.0,
+                                  attn_dropout_rate=0.0)
+    mha.eval()
+    x = paddle.to_tensor(rng.randn(2, 6, 32).astype("float32"))
+    out = mha(x)
+    assert out.shape == [2, 6, 32]
+    out2 = mha(x)
+    np.testing.assert_allclose(np.asarray(out._data), np.asarray(out2._data))
+    mha.train()
+    x.stop_gradient = False
+    mha(x).mean().backward()
+    assert mha.qkv_weight.grad is not None
+
+
+def test_fused_ffn_and_encoder_layer(rng):
+    paddle.seed(5)
+    ffn = FusedFeedForward(16, 64, dropout_rate=0.0)
+    ffn.eval()
+    x = paddle.to_tensor(rng.randn(2, 4, 16).astype("float32"))
+    assert ffn(x).shape == [2, 4, 16]
+
+    enc = FusedTransformerEncoderLayer(16, 2, 64, dropout_rate=0.0)
+    enc.eval()
+    assert enc(x).shape == [2, 4, 16]
+
+    stack = FusedMultiTransformer(16, 2, 64, num_layers=3)
+    stack.eval()
+    assert stack(x).shape == [2, 4, 16]
+    assert len(stack.parameters()) == 3 * len(enc.parameters())
+
+
+def test_softmax_mask_fuse_upper_triangle(rng):
+    x = paddle.to_tensor(rng.randn(1, 1, 4, 4).astype("float32"))
+    out = np.asarray(incubate.softmax_mask_fuse_upper_triangle(x)._data)
+    # row 0 attends only to col 0
+    np.testing.assert_allclose(out[0, 0, 0], [1, 0, 0, 0], atol=1e-6)
+    np.testing.assert_allclose(out.sum(-1), 1.0, rtol=1e-5)
+
+
+def test_segment_ops():
+    data = paddle.to_tensor(np.array([1., 2., 3., 4.], np.float32))
+    ids = paddle.to_tensor(np.array([0, 0, 1, 1]))
+    np.testing.assert_allclose(
+        np.asarray(incubate.segment_sum(data, ids)._data), [3, 7])
+    np.testing.assert_allclose(
+        np.asarray(incubate.segment_mean(data, ids)._data), [1.5, 3.5])
+    np.testing.assert_allclose(
+        np.asarray(incubate.segment_max(data, ids)._data), [2, 4])
+
+
+def test_varlen_attention_masks_tail(rng):
+    B, H, S, D = 2, 2, 4, 8
+    q = paddle.to_tensor(rng.randn(B, H, S, D).astype("float32"))
+    k = paddle.to_tensor(rng.randn(B, H, S, D).astype("float32"))
+    v = paddle.to_tensor(rng.randn(B, H, S, D).astype("float32"))
+    sl = paddle.to_tensor(np.array([2, 4], np.int32))
+    out = FF.variable_length_memory_efficient_attention(q, k, v, sl, sl)
+    arr = np.asarray(out._data)
+    # batch 0 rows past seq_len 2 are zeroed
+    np.testing.assert_allclose(arr[0, :, 2:], 0.0)
+    assert not np.allclose(arr[1, :, 2:], 0.0)

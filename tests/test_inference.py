@@ -1,0 +1,74 @@
+"""paddle.inference Predictor over both artifact flavors (jit.save and
+static.save_inference_model), handle-based and list-based run APIs."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import inference, nn, static
+from paddle_tpu.jit.api import InputSpec
+
+
+def _make_static_artifact(tmp_path, rng):
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 8], "float32")
+        layer = nn.Linear(8, 3)
+        out = paddle.nn.functional.softmax(layer(x))
+    exe = static.Executor()
+    prefix = str(tmp_path / "model")
+    static.save_inference_model(prefix, [x], [out], exe)
+    return prefix, layer
+
+
+def test_predictor_static_artifact(tmp_path, rng):
+    prefix, layer = _make_static_artifact(tmp_path, rng)
+    config = inference.Config(prefix)
+    predictor = inference.create_predictor(config)
+    assert predictor.get_input_names() == ["x"]
+
+    arr = rng.randn(4, 8).astype("float32")
+    h = predictor.get_input_handle("x")
+    h.copy_from_cpu(arr)
+    predictor.run()
+    out = predictor.get_output_handle(
+        predictor.get_output_names()[0]).copy_to_cpu()
+
+    w = np.asarray(layer.weight._data)
+    b = np.asarray(layer.bias._data)
+    logits = arr @ w + b
+    want = np.exp(logits - logits.max(-1, keepdims=True))
+    want /= want.sum(-1, keepdims=True)
+    np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-6)
+
+
+def test_predictor_run_list_api(tmp_path, rng):
+    prefix, _ = _make_static_artifact(tmp_path, rng)
+    predictor = inference.create_predictor(inference.Config(prefix))
+    arr = rng.randn(2, 8).astype("float32")
+    outs = predictor.run([arr])
+    assert len(outs) == 1 and outs[0].shape == (2, 3)
+    np.testing.assert_allclose(outs[0].sum(-1), 1.0, rtol=1e-5)
+
+
+def test_predictor_jit_artifact(tmp_path, rng):
+    paddle.seed(11)
+    layer = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    layer.eval()
+    prefix = str(tmp_path / "jit_model")
+    paddle.jit.save(layer, prefix,
+                    input_spec=[InputSpec([None, 4], "float32", "feat")])
+    predictor = inference.create_predictor(inference.Config(prefix))
+    assert predictor.get_input_names() == ["feat"]
+    arr = rng.randn(5, 4).astype("float32")
+    (out,) = predictor.run([arr])
+    want = np.asarray(layer(paddle.to_tensor(arr))._data)
+    np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-6)
+
+
+def test_predictor_missing_input_errors(tmp_path, rng):
+    prefix, _ = _make_static_artifact(tmp_path, rng)
+    predictor = inference.create_predictor(inference.Config(prefix))
+    try:
+        predictor.run()
+        assert False, "should raise on unset inputs"
+    except RuntimeError as e:
+        assert "x" in str(e)

@@ -1,0 +1,74 @@
+"""Launcher: env wiring, success path, failure + restart path, log capture.
+
+Mirrors the reference's launcher tests (test/collective fleet launch tests
+run real subprocesses; SURVEY.md §4 'distributed is always real processes').
+Worker scripts are tiny and jax-free so the test stays fast.
+"""
+import os
+import sys
+import textwrap
+
+from paddle_tpu.distributed.launch import launch
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _write(tmp_path, name, body):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(body))
+    return str(p)
+
+
+def test_launch_success_and_env(tmp_path):
+    script = _write(tmp_path, "ok.py", """
+        import os, json
+        rank = os.environ["PADDLE_TRAINER_ID"]
+        info = {k: os.environ[k] for k in (
+            "PADDLE_TRAINER_ID", "PADDLE_TRAINERS_NUM", "PADDLE_MASTER",
+            "PADDLE_LOCAL_RANK", "JAX_PROCESS_ID", "JAX_NUM_PROCESSES")}
+        open(os.path.join(os.environ["OUT_DIR"], f"r{rank}.json"), "w").write(
+            json.dumps(info))
+    """)
+    os.environ["OUT_DIR"] = str(tmp_path)
+    try:
+        code = launch(["--nproc_per_node", "2", "--log_dir",
+                       str(tmp_path / "log"), script])
+    finally:
+        del os.environ["OUT_DIR"]
+    assert code == 0
+    import json
+
+    r0 = json.loads((tmp_path / "r0.json").read_text())
+    r1 = json.loads((tmp_path / "r1.json").read_text())
+    assert r0["PADDLE_TRAINERS_NUM"] == "2"
+    assert {r0["PADDLE_TRAINER_ID"], r1["PADDLE_TRAINER_ID"]} == {"0", "1"}
+    assert r0["JAX_NUM_PROCESSES"] == "2"
+    assert ":" in r0["PADDLE_MASTER"]
+
+
+def test_launch_restarts_then_succeeds(tmp_path):
+    # worker fails until a sentinel file accumulates 2 attempts
+    script = _write(tmp_path, "flaky.py", """
+        import os, sys
+        marker = os.path.join(os.environ["OUT_DIR"], "attempts")
+        n = int(open(marker).read()) if os.path.exists(marker) else 0
+        open(marker, "w").write(str(n + 1))
+        sys.exit(0 if n >= 2 else 1)
+    """)
+    os.environ["OUT_DIR"] = str(tmp_path)
+    try:
+        code = launch(["--nproc_per_node", "1", "--max_restart", "3",
+                       "--log_dir", str(tmp_path / "log"), script])
+    finally:
+        del os.environ["OUT_DIR"]
+    assert code == 0
+    assert (tmp_path / "attempts").read_text() == "3"
+
+
+def test_launch_exhausts_restarts(tmp_path):
+    script = _write(tmp_path, "bad.py", "import sys; sys.exit(7)\n")
+    code = launch(["--nproc_per_node", "1", "--max_restart", "1",
+                   "--log_dir", str(tmp_path / "log"), script])
+    assert code == 1
+    log = (tmp_path / "log" / "workerlog.0").read_bytes()
+    assert log is not None  # log file exists (may be empty for instant exit)

@@ -1,0 +1,122 @@
+"""paddle.quantization parity: fake quant-dequant numerics + STE gradient,
+observers, QAT layer swap + trainability, PTQ calibrate->convert flow
+(reference test model: test/quantization)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.quantization import (
+    QAT,
+    PTQ,
+    AbsmaxObserver,
+    FakeQuanterWithAbsMaxObserver,
+    HistObserver,
+    QuantConfig,
+    QuantedLinear,
+    fake_quant_dequant,
+)
+
+
+def test_fake_quant_dequant_numerics():
+    x = paddle.to_tensor(np.array([0.0, 0.5, 1.0, -1.0, 2.0], np.float32))
+    out = np.asarray(fake_quant_dequant(x, paddle.to_tensor(1.0), 8)._data)
+    step = 1.0 / 127
+    np.testing.assert_allclose(out[0], 0)
+    np.testing.assert_allclose(out[1], round(0.5 / step) * step, rtol=1e-6)
+    np.testing.assert_allclose(out[4], 1.0, rtol=1e-6)  # clipped to scale
+
+
+def test_ste_gradient_clipped():
+    x = paddle.to_tensor(np.array([0.3, 5.0, -5.0], np.float32))
+    x.stop_gradient = False
+    out = fake_quant_dequant(x, paddle.to_tensor(1.0), 8)
+    out.sum().backward()
+    g = np.asarray(x.grad._data)
+    np.testing.assert_allclose(g, [1.0, 0.0, 0.0])  # identity inside range
+
+
+def test_absmax_and_hist_observers(rng):
+    obs = AbsmaxObserver()
+    obs(paddle.to_tensor(np.array([1.0, -3.0], np.float32)))
+    obs(paddle.to_tensor(np.array([2.0], np.float32)))
+    assert float(obs.scales()._data) == 3.0
+
+    h = HistObserver(percent=1.0)
+    h(paddle.to_tensor(rng.randn(1000).astype("float32")))
+    s = float(h.scales()._data)
+    assert s > 0
+
+
+def test_qat_quantize_swaps_and_trains(rng):
+    model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2))
+    q_config = QuantConfig(activation=FakeQuanterWithAbsMaxObserver,
+                           weight=FakeQuanterWithAbsMaxObserver)
+    qat = QAT(q_config)
+    q_model = qat.quantize(model)
+    subs = list(q_model._sub_layers.values())
+    assert isinstance(subs[0], QuantedLinear)
+    assert isinstance(subs[2], QuantedLinear)
+    # original untouched
+    assert isinstance(list(model._sub_layers.values())[0], nn.Linear)
+
+    x = paddle.to_tensor(rng.randn(4, 8).astype("float32"))
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=q_model.parameters())
+    w_before = np.asarray(subs[0].weight._data).copy()
+    loss = q_model(x).square().mean()
+    loss.backward()
+    opt.step()
+    assert not np.allclose(np.asarray(subs[0].weight._data), w_before)
+
+
+def test_qat_output_is_quantized(rng):
+    lin = nn.Linear(4, 4)
+    q = QAT(QuantConfig(activation=None,
+                        weight=FakeQuanterWithAbsMaxObserver)).quantize(lin)
+    x = paddle.to_tensor(rng.randn(2, 4).astype("float32"))
+    out_q = np.asarray(q(x)._data)
+    out_f = np.asarray(lin(x)._data)
+    # quantization introduces (small) error vs float layer
+    assert not np.array_equal(out_q, out_f)
+    np.testing.assert_allclose(out_q, out_f, atol=0.1)
+
+
+def test_ptq_calibrate_convert(rng):
+    model = nn.Sequential(nn.Linear(6, 6))
+    ptq = PTQ(QuantConfig(activation=AbsmaxObserver, weight=AbsmaxObserver))
+    calib = ptq.quantize(model)
+    for _ in range(3):
+        calib(paddle.to_tensor(rng.randn(8, 6).astype("float32")))
+    final = ptq.convert(calib)
+    ql = list(final._sub_layers.values())[0]
+    scale = float(ql.activation_quanter.scales()._data)
+    assert scale > 1.0  # saw randn data, absmax over 24 samples
+    x = paddle.to_tensor(rng.randn(2, 6).astype("float32"))
+    out = np.asarray(final(x)._data)
+    ref = np.asarray(model(x)._data)
+    np.testing.assert_allclose(out, ref, atol=0.2)
+
+
+def test_type_config_selective(rng):
+    model = nn.Sequential(nn.Linear(4, 4), nn.Conv2D(1, 1, 3))
+    cfg = QuantConfig()
+    cfg.add_type_config(nn.Linear, activation=FakeQuanterWithAbsMaxObserver,
+                        weight=FakeQuanterWithAbsMaxObserver)
+    q = QAT(cfg).quantize(model)
+    subs = list(q._sub_layers.values())
+    assert isinstance(subs[0], QuantedLinear)
+    assert isinstance(subs[1], nn.Conv2D)  # untouched
+
+
+def test_ptq_convert_root_level_layer(rng):
+    # regression: convert must freeze observers when the root IS the
+    # quanted layer
+    lin = nn.Linear(4, 4)
+    ptq = PTQ(QuantConfig(activation=AbsmaxObserver, weight=AbsmaxObserver))
+    calib = ptq.quantize(lin)
+    calib(paddle.to_tensor(rng.randn(8, 4).astype("float32") * 3))
+    final = ptq.convert(calib)
+    from paddle_tpu.quantization import _FrozenQuant
+
+    assert isinstance(final.activation_quanter, _FrozenQuant)
+    assert float(final.activation_quanter.scales()._data) > 1.0

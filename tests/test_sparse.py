@@ -1,0 +1,193 @@
+"""paddle.sparse parity tests: creation/conversion round-trips, elementwise
+with matching and differing patterns, SpMM/SDDMM vs dense oracle, gradients
+through sparse values, sparse nn layers, sparse attention vs dense-masked
+oracle (reference test model: test/legacy_test sparse op tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import sparse
+
+
+def _rand_coo(rng, shape=(4, 5), nnz=6):
+    idx = np.stack([rng.randint(0, shape[0], nnz),
+                    rng.randint(0, shape[1], nnz)])
+    vals = rng.randn(nnz).astype("float32")
+    return idx, vals
+
+
+def test_coo_create_to_dense_roundtrip(rng):
+    idx, vals = _rand_coo(rng)
+    st = sparse.sparse_coo_tensor(idx, vals, [4, 5])
+    dense = np.zeros((4, 5), np.float32)
+    np.add.at(dense, (idx[0], idx[1]), vals)
+    np.testing.assert_allclose(np.asarray(st.to_dense()._data), dense,
+                               rtol=1e-6)
+    back = sparse.to_sparse_coo(st.to_dense(), 2)
+    np.testing.assert_allclose(np.asarray(back.to_dense()._data), dense,
+                               rtol=1e-6)
+
+
+def test_csr_roundtrip(rng):
+    dense = np.array([[0, 1, 0], [2, 0, 3], [0, 0, 0]], np.float32)
+    csr = sparse.to_sparse_csr(paddle.to_tensor(dense))
+    np.testing.assert_array_equal(np.asarray(csr.crows()._data), [0, 1, 3, 3])
+    np.testing.assert_array_equal(np.asarray(csr.cols()._data), [1, 0, 2])
+    np.testing.assert_allclose(np.asarray(csr.values()._data), [1, 2, 3])
+    np.testing.assert_allclose(np.asarray(csr.to_dense()._data), dense)
+    coo = csr.to_sparse_coo()
+    np.testing.assert_allclose(np.asarray(coo.to_dense()._data), dense)
+
+
+def test_coalesce_sums_duplicates():
+    idx = np.array([[0, 0, 1], [1, 1, 2]])
+    st = sparse.sparse_coo_tensor(idx, [1.0, 2.0, 5.0], [2, 3])
+    co = st.coalesce()
+    assert co.nnz() == 2
+    dense = np.asarray(co.to_dense()._data)
+    assert dense[0, 1] == 3.0 and dense[1, 2] == 5.0
+
+
+def test_unary_and_same_pattern_add(rng):
+    idx, vals = _rand_coo(rng)
+    a = sparse.sparse_coo_tensor(idx, vals, [4, 5])
+    b = sparse.sparse_coo_tensor(idx, vals * 2, [4, 5])
+    out = sparse.add(a, b)
+    np.testing.assert_allclose(np.asarray(out.to_dense()._data),
+                               np.asarray(a.to_dense()._data) * 3, rtol=1e-6)
+    r = sparse.relu(a)
+    np.testing.assert_allclose(np.asarray(r.values()._data),
+                               np.maximum(vals, 0))
+
+
+def test_union_pattern_add(rng):
+    a = sparse.sparse_coo_tensor([[0], [0]], [1.0], [2, 2])
+    b = sparse.sparse_coo_tensor([[1], [1]], [2.0], [2, 2])
+    out = sparse.add(a, b)
+    dense = np.asarray(out.to_dense()._data)
+    np.testing.assert_allclose(dense, [[1, 0], [0, 2]])
+
+
+def test_spmm_matches_dense(rng):
+    idx, vals = _rand_coo(rng, (4, 5), 7)
+    st = sparse.sparse_coo_tensor(idx, vals, [4, 5])
+    y = paddle.to_tensor(rng.randn(5, 3).astype("float32"))
+    out = sparse.matmul(st, y)
+    want = np.asarray(st.to_dense()._data) @ np.asarray(y._data)
+    np.testing.assert_allclose(np.asarray(out._data), want, rtol=1e-5)
+
+
+def test_spmm_gradient(rng):
+    idx = np.array([[0, 1], [1, 0]])
+    st = sparse.sparse_coo_tensor(idx, [1.0, 2.0], [2, 2],
+                                  stop_gradient=False)
+    y = paddle.to_tensor(np.eye(2, dtype=np.float32))
+    out = sparse.matmul(st, y)
+    out.sum().backward()
+    assert st.grad is not None
+    np.testing.assert_allclose(np.asarray(st.grad._data), [1.0, 1.0])
+
+
+def test_sddmm_masked_matmul(rng):
+    x = paddle.to_tensor(rng.randn(4, 6).astype("float32"))
+    y = paddle.to_tensor(rng.randn(6, 4).astype("float32"))
+    mask_dense = (rng.rand(4, 4) > 0.5).astype("float32")
+    mask = sparse.to_sparse_csr(paddle.to_tensor(mask_dense))
+    out = sparse.masked_matmul(x, y, mask)
+    want = (np.asarray(x._data) @ np.asarray(y._data)) * mask_dense
+    np.testing.assert_allclose(np.asarray(out.to_dense()._data), want,
+                               rtol=1e-5)
+
+
+def test_csr_softmax_rows():
+    dense = np.array([[1.0, 2.0, 0], [0, 3.0, 0], [0, 0, 0]], np.float32)
+    csr = sparse.to_sparse_csr(paddle.to_tensor(dense))
+    sm = sparse.softmax(csr)
+    out = np.asarray(sm.to_dense()._data)
+    e = np.exp([1.0, 2.0])
+    np.testing.assert_allclose(out[0, :2], e / e.sum(), rtol=1e-6)
+    np.testing.assert_allclose(out[1, 1], 1.0)
+
+
+def test_sparse_nn_relu_batchnorm(rng):
+    from paddle_tpu.sparse import nn as snn
+
+    idx = np.stack([np.zeros(5, np.int64), np.arange(5), np.arange(5),
+                    np.zeros(5, np.int64)])
+    vals = rng.randn(5, 3).astype("float32")
+    st = sparse.sparse_coo_tensor(idx, vals, [1, 8, 8, 8, 3])
+    r = snn.ReLU()(st)
+    np.testing.assert_allclose(np.asarray(r.values()._data),
+                               np.maximum(vals, 0))
+    bn = snn.BatchNorm(3)
+    out = bn(st)
+    assert out.values().shape == [5, 3]
+
+
+def test_sparse_subm_conv3d(rng):
+    from paddle_tpu.sparse import nn as snn
+
+    idx = np.stack([np.zeros(4, np.int64), rng.randint(0, 6, 4),
+                    rng.randint(0, 6, 4), rng.randint(0, 6, 4)])
+    vals = rng.randn(4, 2).astype("float32")
+    st = sparse.sparse_coo_tensor(idx, vals, [1, 6, 6, 6, 2]).coalesce()
+    conv = snn.SubmConv3D(2, 4, kernel_size=3, padding=1)
+    out = conv(st)
+    # submanifold: pattern preserved
+    np.testing.assert_array_equal(np.asarray(out.indices_._data),
+                                  np.asarray(st.indices_._data))
+    assert out.values().shape[-1] == 4
+
+
+def test_sparse_attention_vs_dense(rng):
+    from paddle_tpu.sparse.nn import functional as sF
+
+    B, H, L, D = 1, 2, 4, 8
+    q = rng.randn(B, H, L, D).astype("float32")
+    k = rng.randn(B, H, L, D).astype("float32")
+    v = rng.randn(B, H, L, D).astype("float32")
+    # full mask -> must match dense softmax attention
+    full = np.ones((B * H * L, L), np.float32).reshape(B * H * L, L)
+    mask = sparse.to_sparse_csr(paddle.to_tensor(full))
+    out = sF.attention(paddle.to_tensor(q), paddle.to_tensor(k),
+                       paddle.to_tensor(v), mask)
+    scores = q.reshape(B * H, L, D) @ k.reshape(B * H, L, D).transpose(0, 2, 1)
+    scores /= np.sqrt(D)
+    p = np.exp(scores - scores.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    want = (p @ v.reshape(B * H, L, D)).reshape(B, H, L, D)
+    np.testing.assert_allclose(np.asarray(out._data), want, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_union_pattern_divide_stays_sparse(rng):
+    # regression: differing-pattern divide must not blow up to dense inf/nan
+    a = sparse.sparse_coo_tensor([[0], [0]], [4.0], [3, 3])
+    b = sparse.sparse_coo_tensor([[0, 1], [0, 1]], [2.0, 5.0], [3, 3])
+    out = sparse.divide(a, b)
+    assert out.nnz() == 1
+    dense = np.asarray(out.to_dense()._data)
+    assert dense[0, 0] == 2.0
+    assert np.isfinite(dense).all()
+
+
+def test_sparse_attention_key_padding_mask(rng):
+    from paddle_tpu.sparse.nn import functional as sF
+
+    B, H, L, D = 1, 1, 4, 4
+    q = paddle.to_tensor(rng.randn(B, H, L, D).astype("float32"))
+    k = paddle.to_tensor(rng.randn(B, H, L, D).astype("float32"))
+    v = paddle.to_tensor(rng.randn(B, H, L, D).astype("float32"))
+    full = np.ones((B * H * L, L), np.float32)
+    mask = sparse.to_sparse_csr(paddle.to_tensor(full))
+    kpm = np.array([[1, 1, 0, 0]], np.float32)  # keys 2,3 are padding
+    out = sF.attention(q, k, v, mask,
+                       key_padding_mask=paddle.to_tensor(kpm))
+    # oracle: dense attention over first 2 keys only
+    scores = (np.asarray(q._data)[0, 0] @ np.asarray(k._data)[0, 0, :2].T
+              / np.sqrt(D))
+    p = np.exp(scores - scores.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    want = p @ np.asarray(v._data)[0, 0, :2]
+    np.testing.assert_allclose(np.asarray(out._data)[0, 0], want, rtol=1e-4,
+                               atol=1e-5)
