@@ -53,11 +53,23 @@ def save(filepath: str, src: Tensor, sample_rate: int,
         data = data.T  # -> [T, C]
     if data.ndim == 1:
         data = data[:, None]
+    if bits_per_sample not in (16, 32):
+        raise ValueError("bits_per_sample must be 16 or 32")
+    width = bits_per_sample // 8
     if data.dtype in (np.float32, np.float64):
         data = np.clip(data, -1.0, 1.0)
-        data = (data * 32767).astype(np.int16)
+        full = 32767 if width == 2 else 2147483647
+        data = (data * full).astype(f"<i{width}")
+    elif data.dtype == np.int16:
+        data = (data.astype(np.int32) << 16).astype("<i4") if width == 4 \
+            else data.astype("<i2")
+    elif data.dtype == np.int32:
+        # rescale, don't wrap: int32 samples to 16-bit drop the low bits
+        data = (data >> 16).astype("<i2") if width == 2 else data.astype("<i4")
+    else:
+        raise ValueError(f"unsupported sample dtype {data.dtype}")
     with wave.open(filepath, "wb") as f:
         f.setnchannels(data.shape[1])
-        f.setsampwidth(2)
+        f.setsampwidth(width)
         f.setframerate(sample_rate)
-        f.writeframes(data.astype("<i2").tobytes())
+        f.writeframes(data.tobytes())

@@ -104,7 +104,8 @@ class TCPStore:
         if status != 0:
             raise RuntimeError(f"TCPStore.get({key!r}) connection error")
         try:
-            return bytes(bytearray(out[: out_len.value])) if out_len.value else b""
+            return (ctypes.string_at(out, out_len.value)
+                    if out_len.value else b"")
         finally:
             if out:
                 self._lib.pd_store_free_buf(out)

@@ -147,9 +147,14 @@ class Predictor:
         return None
 
     def clone(self):
+        """Independent handles over the shared compiled program (reference:
+        AnalysisPredictor::Clone gives each thread its own IO buffers)."""
         import copy
 
-        return copy.copy(self)
+        c = copy.copy(self)
+        c._inputs = {n: Tensor_(n) for n in self._input_names}
+        c._outputs = {n: Tensor_(n) for n in self._output_names}
+        return c
 
 
 def create_predictor(config: Config) -> Predictor:

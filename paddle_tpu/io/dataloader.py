@@ -98,7 +98,17 @@ def _worker_loop(dataset, index_queue, data_queue, collate_fn, worker_id, num_wo
             data = collate_fn(samples)
             data_queue.put((batch_id, data, None))
         except Exception as e:  # propagate to main process
-            data_queue.put((batch_id, None, e))
+            try:
+                data_queue.put((batch_id, None, e))
+            except Exception:
+                # the exception itself may be unpicklable — send its repr so
+                # the main process still gets a diagnostic instead of hanging
+                try:
+                    data_queue.put((batch_id, None, RuntimeError(
+                        f"worker {worker_id}: {type(e).__name__}: {e!r} "
+                        "(original exception was unpicklable)")))
+                except Exception:
+                    break  # transport closed during shutdown — just exit
 
 
 class DataLoader:
@@ -126,6 +136,7 @@ class DataLoader:
         self.collate_fn = collate_fn
         self.prefetch_factor = prefetch_factor
         self.worker_init_fn = worker_init_fn
+        self.use_shared_memory = use_shared_memory
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             self.batch_size = batch_size
@@ -204,7 +215,20 @@ class DataLoader:
 
         seed = default_generator.initial_seed()
         index_queues = []
-        data_queue = ctx.Queue()
+        # use_shared_memory: batches travel through the native shm ring
+        # (one memcpy per side, no pipe) — reference DataLoader's
+        # use_shared_memory path over C++ BlockingQueue + shm segments.
+        # The MAP_SHARED mapping is inherited by forked workers, so the
+        # same ring object works on both sides.
+        ring = None
+        if self.use_shared_memory:
+            try:
+                from .shm_ring import ShmRing
+
+                ring = ShmRing(capacity=128 << 20)
+            except Exception:
+                ring = None  # no native toolchain: pipe transport fallback
+        data_queue = ring if ring is not None else ctx.Queue()
         workers = []
         collate = _np_collate if self.collate_fn is None else self.collate_fn
         for wid in range(self.num_workers):
@@ -250,7 +274,11 @@ class DataLoader:
         finally:
             for iq in index_queues:
                 iq.put(None)
+            if ring is not None:
+                ring.close()  # unblocks any worker mid-put
             for w in workers:
                 w.join(timeout=1)
                 if w.is_alive():
                     w.terminate()
+            if ring is not None:
+                ring.free()

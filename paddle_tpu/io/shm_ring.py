@@ -1,0 +1,120 @@
+"""Python wrapper for the native shared-memory ring (DataLoader transport).
+
+Reference: the use_shared_memory DataLoader path (C++ BlockingQueue + shm
+tensor segments). A worker process attaches by name and ``put``s pickled
+batches; the main process ``get``s them — one memcpy per side, no pipe.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import pickle
+
+from ..native import load_library
+
+
+def _lib():
+    lib = load_library("shm_ring")
+    if not getattr(lib, "_configured", False):
+        lib.pd_ring_create.restype = ctypes.c_void_p
+        lib.pd_ring_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+        lib.pd_ring_attach.restype = ctypes.c_void_p
+        lib.pd_ring_attach.argtypes = [ctypes.c_char_p]
+        lib.pd_ring_put.restype = ctypes.c_int
+        # c_char_p: bytes pass zero-copy (length is explicit, NULs fine)
+        lib.pd_ring_put.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64, ctypes.c_int]
+        lib.pd_ring_get.restype = ctypes.c_int
+        lib.pd_ring_get.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.POINTER(ctypes.c_uint64), ctypes.c_int]
+        lib.pd_ring_size.restype = ctypes.c_int
+        lib.pd_ring_size.argtypes = [ctypes.c_void_p]
+        lib.pd_ring_close.argtypes = [ctypes.c_void_p]
+        lib.pd_ring_set_owner.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.pd_ring_free.argtypes = [ctypes.c_void_p]
+        lib.pd_ring_free_buf.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
+        lib._configured = True
+    return lib
+
+
+class ShmRing:
+    """Blocking MPMC byte-message ring over POSIX shared memory."""
+
+    def __init__(self, name: str | None = None, capacity: int = 64 << 20,
+                 create: bool = True):
+        self._lib = _lib()
+        if name is None:
+            name = f"/pd_ring_{os.getpid()}_{id(self):x}"
+        self.name = name
+        if create:
+            self._h = self._lib.pd_ring_create(name.encode(), capacity)
+        else:
+            self._h = self._lib.pd_ring_attach(name.encode())
+        if not self._h:
+            raise RuntimeError(f"ShmRing: cannot {'create' if create else 'attach'} {name}")
+        self._closed = False
+        # only the creating PROCESS may unlink; fork-inherited copies of a
+        # creator ring must not tear the segment down when they finalize
+        self._creator_pid = os.getpid() if create else None
+
+    @classmethod
+    def attach(cls, name: str) -> "ShmRing":
+        return cls(name, create=False)
+
+    def put_bytes(self, data: bytes, timeout: float | None = None) -> None:
+        tmo = -1 if timeout is None else int(timeout * 1000)
+        rc = self._lib.pd_ring_put(self._h, data, len(data), tmo)
+        if rc == -1:
+            raise TimeoutError("ShmRing.put timed out")
+        if rc == -3:
+            raise ValueError(
+                f"message of {len(data)} bytes exceeds ring capacity")
+        if rc != 0:
+            raise RuntimeError("ShmRing closed")
+
+    def get_bytes(self, timeout: float | None = None) -> bytes:
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        out_len = ctypes.c_uint64(0)
+        tmo = -1 if timeout is None else int(timeout * 1000)
+        rc = self._lib.pd_ring_get(self._h, ctypes.byref(out),
+                                   ctypes.byref(out_len), tmo)
+        if rc == -1:
+            raise TimeoutError("ShmRing.get timed out")
+        if rc != 0:
+            raise RuntimeError("ShmRing closed")
+        try:
+            # string_at = one memcpy; slicing the pointer would build a
+            # python list of ints (catastrophic for MB payloads)
+            return ctypes.string_at(out, out_len.value) if out_len.value else b""
+        finally:
+            self._lib.pd_ring_free_buf(out)
+
+    def put(self, obj, timeout: float | None = None) -> None:
+        self.put_bytes(pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL),
+                       timeout)
+
+    def get(self, timeout: float | None = None):
+        return pickle.loads(self.get_bytes(timeout))
+
+    def qsize_bytes(self) -> int:
+        return max(self._lib.pd_ring_size(self._h), 0)
+
+    def close(self) -> None:
+        if not self._closed and self._h:
+            self._lib.pd_ring_close(self._h)
+            self._closed = True
+
+    def free(self) -> None:
+        if self._h:
+            if (self._creator_pid is not None
+                    and os.getpid() != self._creator_pid):
+                self._lib.pd_ring_set_owner(self._h, 0)
+            self._lib.pd_ring_free(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.free()
+        except Exception:
+            pass

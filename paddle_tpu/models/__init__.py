@@ -13,6 +13,19 @@ from .bert import (
     BertForSequenceClassification,
     BertModel,
 )
+from .ernie import (
+    ERNIE_CONFIGS,
+    ErnieConfig,
+    ErnieForPretraining,
+    ErnieForSequenceClassification,
+    ErnieModel,
+)
+from .llama import (
+    LLAMA_CONFIGS,
+    LlamaConfig,
+    LlamaForCausalLM,
+    LlamaModel,
+)
 from .gpt import (
     GPT_CONFIGS,
     GPTConfig,

@@ -1,0 +1,214 @@
+"""ERNIE model family (ERNIE-3.0-class encoder).
+
+Architecture parity: the ERNIE encoder the reference ecosystem trains (the
+BASELINE.md ERNIE-3.0 config ladder): BERT-style post-LN transformer
+encoder with word/position/token-type/task-type embeddings (task-type being
+ERNIE's addition), GELU MLP, pooled [CLS] head, plus MLM/NSP pretraining
+heads. Attention via F.scaled_dot_product_attention (flash attention on
+TPU).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..framework.param_attr import ParamAttr
+from ..nn import Layer, functional as F
+from ..nn.initializer import Normal
+from ..nn.layer.common import Dropout, Embedding, Linear
+from ..nn.layer.container import LayerList
+from ..nn.layer.norm import LayerNorm
+from ..tensor.creation import arange, zeros_like
+from ..tensor.manipulation import reshape
+from ..tensor.math import matmul
+
+
+@dataclass
+class ErnieConfig:
+    vocab_size: int = 40000
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 2048
+    type_vocab_size: int = 4
+    task_type_vocab_size: int = 16
+    use_task_id: bool = True
+    hidden_dropout: float = 0.0
+    attn_dropout: float = 0.0
+    layer_norm_eps: float = 1e-12
+    initializer_range: float = 0.02
+
+
+ERNIE_CONFIGS: dict[str, ErnieConfig] = {
+    "ernie-tiny": ErnieConfig(vocab_size=1024, hidden_size=128, num_layers=2,
+                              num_heads=4, intermediate_size=512,
+                              max_position_embeddings=128),
+    "ernie-3.0-base": ErnieConfig(),
+    "ernie-3.0-medium": ErnieConfig(num_layers=6),
+    "ernie-3.0-xbase": ErnieConfig(hidden_size=1024, num_layers=20,
+                                   num_heads=16, intermediate_size=4096),
+}
+
+
+def _w(config: ErnieConfig) -> ParamAttr:
+    return ParamAttr(initializer=Normal(mean=0.0,
+                                        std=config.initializer_range))
+
+
+class ErnieEmbeddings(Layer):
+    def __init__(self, config: ErnieConfig):
+        super().__init__()
+        self.word_embeddings = Embedding(config.vocab_size,
+                                         config.hidden_size,
+                                         weight_attr=_w(config))
+        self.position_embeddings = Embedding(config.max_position_embeddings,
+                                             config.hidden_size,
+                                             weight_attr=_w(config))
+        self.token_type_embeddings = Embedding(config.type_vocab_size,
+                                               config.hidden_size,
+                                               weight_attr=_w(config))
+        self.task_type_embeddings = (
+            Embedding(config.task_type_vocab_size, config.hidden_size,
+                      weight_attr=_w(config)) if config.use_task_id else None)
+        self.layer_norm = LayerNorm(config.hidden_size,
+                                    epsilon=config.layer_norm_eps)
+        self.dropout = Dropout(config.hidden_dropout)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                task_type_ids=None):
+        S = input_ids.shape[1]
+        if position_ids is None:
+            position_ids = arange(0, S, dtype="int64").unsqueeze(0)
+        if token_type_ids is None:
+            token_type_ids = zeros_like(input_ids)
+        x = (self.word_embeddings(input_ids)
+             + self.position_embeddings(position_ids)
+             + self.token_type_embeddings(token_type_ids))
+        if self.task_type_embeddings is not None:
+            if task_type_ids is None:
+                task_type_ids = zeros_like(input_ids)
+            x = x + self.task_type_embeddings(task_type_ids)
+        return self.dropout(self.layer_norm(x))
+
+
+class ErnieSelfAttention(Layer):
+    def __init__(self, config: ErnieConfig):
+        super().__init__()
+        self.config = config
+        h = config.hidden_size
+        self.qkv = Linear(h, 3 * h, weight_attr=_w(config))
+        self.out = Linear(h, h, weight_attr=_w(config))
+
+    def forward(self, x, attn_mask=None):
+        cfg = self.config
+        B, S, _ = x.shape
+        hd = cfg.hidden_size // cfg.num_heads
+        qkv = reshape(self.qkv(x), [B, S, 3, cfg.num_heads, hd])
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask,
+            dropout_p=cfg.attn_dropout, training=self.training)
+        return self.out(reshape(out, [B, S, cfg.hidden_size]))
+
+
+class ErnieEncoderLayer(Layer):
+    """Post-LN block (BERT/ERNIE convention)."""
+
+    def __init__(self, config: ErnieConfig):
+        super().__init__()
+        h = config.hidden_size
+        self.self_attn = ErnieSelfAttention(config)
+        self.norm1 = LayerNorm(h, epsilon=config.layer_norm_eps)
+        self.linear1 = Linear(h, config.intermediate_size,
+                              weight_attr=_w(config))
+        self.linear2 = Linear(config.intermediate_size, h,
+                              weight_attr=_w(config))
+        self.norm2 = LayerNorm(h, epsilon=config.layer_norm_eps)
+        self.dropout = Dropout(config.hidden_dropout)
+
+    def forward(self, x, attn_mask=None):
+        x = self.norm1(x + self.dropout(self.self_attn(x, attn_mask)))
+        mlp = self.linear2(F.gelu(self.linear1(x)))
+        return self.norm2(x + self.dropout(mlp))
+
+
+class ErniePooler(Layer):
+    def __init__(self, config: ErnieConfig):
+        super().__init__()
+        self.dense = Linear(config.hidden_size, config.hidden_size,
+                            weight_attr=_w(config))
+
+    def forward(self, hidden):
+        return F.tanh(self.dense(hidden[:, 0]))
+
+
+class ErnieModel(Layer):
+    def __init__(self, config: ErnieConfig):
+        super().__init__()
+        self.config = config
+        self.embeddings = ErnieEmbeddings(config)
+        self.encoder = LayerList(
+            [ErnieEncoderLayer(config) for _ in range(config.num_layers)])
+        self.pooler = ErniePooler(config)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None, task_type_ids=None):
+        if attention_mask is not None and attention_mask.ndim == 2:
+            # [B, S] 1/0 -> additive [B, 1, 1, S]
+            attention_mask = (
+                (1.0 - attention_mask.astype("float32")) * -1e4
+            ).unsqueeze(1).unsqueeze(2)
+        x = self.embeddings(input_ids, token_type_ids, position_ids,
+                            task_type_ids)
+        for layer in self.encoder:
+            x = layer(x, attention_mask)
+        return x, self.pooler(x)
+
+
+class ErnieForSequenceClassification(Layer):
+    def __init__(self, config: ErnieConfig, num_classes: int = 2):
+        super().__init__()
+        self.ernie = ErnieModel(config)
+        self.dropout = Dropout(config.hidden_dropout)
+        self.classifier = Linear(config.hidden_size, num_classes,
+                                 weight_attr=_w(config))
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None, labels=None):
+        _, pooled = self.ernie(input_ids, token_type_ids, position_ids,
+                               attention_mask)
+        logits = self.classifier(self.dropout(pooled))
+        if labels is not None:
+            return F.cross_entropy(logits, labels), logits
+        return logits
+
+
+class ErnieForPretraining(Layer):
+    """MLM + NSP heads (ERNIE pretraining objective; MLM projection tied to
+    the word embedding)."""
+
+    def __init__(self, config: ErnieConfig):
+        super().__init__()
+        self.ernie = ErnieModel(config)
+        h = config.hidden_size
+        self.transform = Linear(h, h, weight_attr=_w(config))
+        self.transform_norm = LayerNorm(h, epsilon=config.layer_norm_eps)
+        self.nsp_head = Linear(h, 2, weight_attr=_w(config))
+
+    def forward(self, input_ids, token_type_ids=None, masked_positions=None,
+                labels=None, next_sentence_labels=None, **kw):
+        seq, pooled = self.ernie(input_ids, token_type_ids)
+        x = self.transform_norm(F.gelu(self.transform(seq)))
+        mlm_logits = matmul(x, self.ernie.embeddings.word_embeddings.weight,
+                            transpose_y=True)
+        nsp_logits = self.nsp_head(pooled)
+        if labels is not None:
+            mlm_loss = F.cross_entropy(
+                reshape(mlm_logits, [-1, mlm_logits.shape[-1]]),
+                reshape(labels, [-1]), ignore_index=-100)
+            loss = mlm_loss
+            if next_sentence_labels is not None:
+                loss = loss + F.cross_entropy(nsp_logits,
+                                              next_sentence_labels)
+            return loss, mlm_logits, nsp_logits
+        return mlm_logits, nsp_logits

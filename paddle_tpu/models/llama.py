@@ -1,0 +1,222 @@
+"""LLaMA model family.
+
+Architecture parity: the reference's auto-parallel llama end-to-end tests
+(test/auto_parallel/hybrid_strategy/semi_auto_llama.py — dp/mp/pp configs
+with acc-alignment oracles) — RMSNorm pre-norm, rotary position embeddings,
+SwiGLU MLP, optional grouped-query attention (GQA). Attention runs through
+``F.scaled_dot_product_attention`` (Pallas flash attention on TPU); RoPE is
+the fused incubate op so XLA folds it into the attention prologue.
+
+Tensor parallelism mirrors the GPT family: Column/RowParallelLinear +
+VocabParallelEmbedding when a model-parallel group is active (Megatron
+layout, reference mp_layers.py:47/:333/:540). The mp degree must divide
+both num_heads and num_kv_heads (construction raises otherwise).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..framework.param_attr import ParamAttr
+from ..nn import Layer, functional as F
+from ..nn.initializer import Normal
+from ..nn.layer.common import Embedding, Linear
+from ..nn.layer.container import LayerList
+from ..nn.layer.norm import RMSNorm
+from ..tensor.manipulation import reshape
+from ..tensor.math import matmul
+
+
+@dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int | None = None  # None = MHA; < num_heads = GQA
+    max_seq_len: int = 2048
+    rms_norm_eps: float = 1e-6
+    initializer_range: float = 0.02
+    rope_theta: float = 10000.0
+    tie_word_embeddings: bool = False
+    use_flash_attention: bool = True
+    tensor_parallel: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @property
+    def kv_heads(self) -> int:
+        return self.num_kv_heads or self.num_heads
+
+
+LLAMA_CONFIGS: dict[str, LlamaConfig] = {
+    "llama-tiny": LlamaConfig(vocab_size=1024, hidden_size=128,
+                              intermediate_size=352, num_layers=2,
+                              num_heads=4, num_kv_heads=2, max_seq_len=128),
+    "llama-7b": LlamaConfig(),
+    "llama-13b": LlamaConfig(hidden_size=5120, intermediate_size=13824,
+                             num_layers=40, num_heads=40),
+    "llama2-70b": LlamaConfig(hidden_size=8192, intermediate_size=28672,
+                              num_layers=80, num_heads=64, num_kv_heads=8,
+                              max_seq_len=4096),
+}
+
+
+def _w(config: LlamaConfig) -> ParamAttr:
+    return ParamAttr(initializer=Normal(mean=0.0,
+                                        std=config.initializer_range))
+
+
+def _tp_enabled(config: LlamaConfig) -> bool:
+    if config.tensor_parallel:
+        return True
+    from ..distributed.fleet import fleet
+
+    hcg = getattr(fleet, "_hcg", None)
+    return hcg is not None and hcg.get_model_parallel_world_size() > 1
+
+
+def _linear(config, in_f, out_f, kind):
+    """kind: 'col' (shard output dim) | 'row' (shard input dim) | 'plain'."""
+    if _tp_enabled(config) and kind != "plain":
+        from ..distributed.fleet.meta_parallel.mp_layers import (
+            ColumnParallelLinear,
+            RowParallelLinear,
+        )
+
+        if kind == "col":
+            return ColumnParallelLinear(in_f, out_f, weight_attr=_w(config),
+                                        has_bias=False, gather_output=False)
+        return RowParallelLinear(in_f, out_f, weight_attr=_w(config),
+                                 has_bias=False,
+                                 input_is_parallel=True)
+    return Linear(in_f, out_f, weight_attr=_w(config), bias_attr=False)
+
+
+class LlamaAttention(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        h, hd = config.hidden_size, config.head_dim
+        if _tp_enabled(config):
+            from ..distributed.fleet import fleet
+
+            ws = fleet._hcg.get_model_parallel_world_size()
+            if config.num_heads % ws != 0 or config.kv_heads % ws != 0:
+                raise ValueError(
+                    f"tensor parallel degree {ws} must divide num_heads "
+                    f"{config.num_heads} and num_kv_heads {config.kv_heads} "
+                    "(KV-head replication across the mp group is not "
+                    "implemented — pick mp_degree | num_kv_heads)")
+        self.q_proj = _linear(config, h, config.num_heads * hd, "col")
+        self.k_proj = _linear(config, h, config.kv_heads * hd, "col")
+        self.v_proj = _linear(config, h, config.kv_heads * hd, "col")
+        self.o_proj = _linear(config, config.num_heads * hd, h, "row")
+
+    def forward(self, x, position_ids=None):
+        from ..incubate.nn.functional import fused_rotary_position_embedding
+
+        cfg = self.config
+        B, S, _ = x.shape
+        nh, nkv, hd = cfg.num_heads, cfg.kv_heads, cfg.head_dim
+        if _tp_enabled(cfg):
+            from ..distributed.fleet import fleet
+
+            ws = fleet._hcg.get_model_parallel_world_size()
+            nh, nkv = nh // ws, nkv // ws  # divisibility checked in __init__
+        q = reshape(self.q_proj(x), [B, S, nh, hd])
+        k = reshape(self.k_proj(x), [B, S, nkv, hd])
+        v = reshape(self.v_proj(x), [B, S, nkv, hd])
+        q, k, _ = fused_rotary_position_embedding(
+            q, k, None, position_ids=position_ids)
+        if nkv < nh:  # GQA: repeat kv heads to match query heads
+            rep = nh // nkv
+            k = k.unsqueeze(3).expand([B, S, nkv, rep, hd]).reshape(
+                [B, S, nh, hd])
+            v = v.unsqueeze(3).expand([B, S, nkv, rep, hd]).reshape(
+                [B, S, nh, hd])
+        out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+        return self.o_proj(reshape(out, [B, S, nh * hd]))
+
+
+class LlamaMLP(Layer):
+    """SwiGLU: down(silu(gate(x)) * up(x))."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        h, i = config.hidden_size, config.intermediate_size
+        self.gate_proj = _linear(config, h, i, "col")
+        self.up_proj = _linear(config, h, i, "col")
+        self.down_proj = _linear(config, i, h, "row")
+
+    def forward(self, x):
+        return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
+
+
+class LlamaDecoderLayer(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.input_layernorm = RMSNorm(config.hidden_size,
+                                       epsilon=config.rms_norm_eps)
+        self.self_attn = LlamaAttention(config)
+        self.post_attention_layernorm = RMSNorm(config.hidden_size,
+                                                epsilon=config.rms_norm_eps)
+        self.mlp = LlamaMLP(config)
+
+    def forward(self, x, position_ids=None):
+        x = x + self.self_attn(self.input_layernorm(x), position_ids)
+        return x + self.mlp(self.post_attention_layernorm(x))
+
+
+class LlamaModel(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        if _tp_enabled(config):
+            from ..distributed.fleet.meta_parallel.mp_layers import (
+                VocabParallelEmbedding,
+            )
+
+            self.embed_tokens = VocabParallelEmbedding(
+                config.vocab_size, config.hidden_size, weight_attr=_w(config))
+        else:
+            self.embed_tokens = Embedding(config.vocab_size,
+                                          config.hidden_size,
+                                          weight_attr=_w(config))
+        self.layers = LayerList(
+            [LlamaDecoderLayer(config) for _ in range(config.num_layers)])
+        self.norm = RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
+
+    def forward(self, input_ids, position_ids=None):
+        x = self.embed_tokens(input_ids)
+        for layer in self.layers:
+            x = layer(x, position_ids)
+        return self.norm(x)
+
+
+class LlamaForCausalLM(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.llama = LlamaModel(config)
+        if config.tie_word_embeddings:
+            self.lm_head = None
+        else:
+            self.lm_head = _linear(config, config.hidden_size,
+                                   config.vocab_size, "plain")
+
+    def forward(self, input_ids, position_ids=None, labels=None):
+        hidden = self.llama(input_ids, position_ids)
+        if self.lm_head is not None:
+            logits = self.lm_head(hidden)
+        else:
+            logits = matmul(hidden, self.llama.embed_tokens.weight,
+                            transpose_y=True)
+        if labels is not None:
+            loss = F.cross_entropy(
+                reshape(logits, [-1, logits.shape[-1]]),
+                reshape(labels, [-1]))
+            return loss, logits
+        return logits

@@ -1,0 +1,284 @@
+// Shared-memory ring buffer: the native DataLoader transport.
+//
+// Parity target: the reference's shared-memory DataLoader path
+// (use_shared_memory=True — workers place batch tensors in shm segments and
+// pass descriptors through the C++ BlockingQueue, fluid/operators/reader/
+// blocking_queue.h + core._convert_to_tensor_list shm machinery). Python
+// multiprocessing.Queue pickles through a pipe — one extra copy and a
+// syscall per message; this ring keeps payloads in one mmap'd segment with
+// process-shared pthread synchronization, so a worker->main handoff is a
+// single memcpy each side.
+//
+// Layout: [Header | data bytes]; records are [u32 len | payload] with
+// wrap-around (a record never straddles the end: if the tail gap is too
+// small, a 0xFFFFFFFF wrap marker is written and writing resumes at 0).
+// Multi-producer/multi-consumer safe via the shared mutex.
+
+#include <errno.h>
+#include <fcntl.h>
+#include <pthread.h>
+#include <stdint.h>
+#include <string.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <cstdlib>
+#include <string>
+
+namespace {
+
+constexpr uint32_t kWrapMarker = 0xFFFFFFFFu;
+
+struct Header {
+  pthread_mutex_t mu;
+  pthread_cond_t not_empty;
+  pthread_cond_t not_full;
+  uint64_t capacity;   // data area size
+  uint64_t head;       // read offset
+  uint64_t tail;       // write offset
+  uint64_t used;       // bytes in flight (records + markers)
+  uint32_t closed;
+  uint32_t magic;
+};
+
+struct Ring {
+  Header* h;
+  uint8_t* data;
+  size_t map_size;
+  std::string name;
+  bool owner;
+};
+
+timespec deadline_from_ms(int timeout_ms) {
+  timespec ts;
+  clock_gettime(CLOCK_REALTIME, &ts);
+  ts.tv_sec += timeout_ms / 1000;
+  ts.tv_nsec += (timeout_ms % 1000) * 1000000L;
+  if (ts.tv_nsec >= 1000000000L) {
+    ts.tv_sec += 1;
+    ts.tv_nsec -= 1000000000L;
+  }
+  return ts;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* pd_ring_create(const char* name, uint64_t capacity) {
+  size_t map_size = sizeof(Header) + capacity;
+  ::shm_unlink(name);  // stale segment from a crashed run
+  int fd = ::shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  if (::ftruncate(fd, static_cast<off_t>(map_size)) != 0) {
+    ::close(fd);
+    ::shm_unlink(name);
+    return nullptr;
+  }
+  void* mem = ::mmap(nullptr, map_size, PROT_READ | PROT_WRITE, MAP_SHARED,
+                     fd, 0);
+  ::close(fd);
+  if (mem == MAP_FAILED) {
+    ::shm_unlink(name);
+    return nullptr;
+  }
+  auto* h = static_cast<Header*>(mem);
+  pthread_mutexattr_t ma;
+  pthread_mutexattr_init(&ma);
+  pthread_mutexattr_setpshared(&ma, PTHREAD_PROCESS_SHARED);
+  // robust: if a worker dies holding the lock, the main process recovers
+  // instead of deadlocking
+  pthread_mutexattr_setrobust(&ma, PTHREAD_MUTEX_ROBUST);
+  pthread_mutex_init(&h->mu, &ma);
+  pthread_condattr_t ca;
+  pthread_condattr_init(&ca);
+  pthread_condattr_setpshared(&ca, PTHREAD_PROCESS_SHARED);
+  pthread_cond_init(&h->not_empty, &ca);
+  pthread_cond_init(&h->not_full, &ca);
+  h->capacity = capacity;
+  h->head = h->tail = h->used = 0;
+  h->closed = 0;
+  h->magic = 0x52494e47;  // "RING"
+  auto* r = new Ring{h, static_cast<uint8_t*>(mem) + sizeof(Header),
+                     map_size, name, true};
+  return r;
+}
+
+void* pd_ring_attach(const char* name) {
+  int fd = ::shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (::fstat(fd, &st) != 0) {
+    ::close(fd);
+    return nullptr;
+  }
+  void* mem = ::mmap(nullptr, static_cast<size_t>(st.st_size),
+                     PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  ::close(fd);
+  if (mem == MAP_FAILED) return nullptr;
+  auto* h = static_cast<Header*>(mem);
+  if (h->magic != 0x52494e47) {
+    ::munmap(mem, static_cast<size_t>(st.st_size));
+    return nullptr;
+  }
+  auto* r = new Ring{h, static_cast<uint8_t*>(mem) + sizeof(Header),
+                     static_cast<size_t>(st.st_size), name, false};
+  return r;
+}
+
+static int lock_robust(Header* h) {
+  int rc = pthread_mutex_lock(&h->mu);
+  if (rc == EOWNERDEAD) {
+    pthread_mutex_consistent(&h->mu);
+    rc = 0;
+  }
+  return rc;
+}
+
+// 0 ok, -1 timeout, -2 closed/error, -3 message larger than capacity
+//
+// Placement must be CONTIGUOUS-space aware, not just total-free aware: the
+// free bytes are [tail, end)+[0, head) when tail >= head, or [tail, head)
+// otherwise. A record goes either at tail (if the region there fits it) or
+// wraps to offset 0 (only legal when the [0, head) region fits it) — never
+// on top of unread data.
+int pd_ring_put(void* rv, const uint8_t* buf, uint64_t len, int timeout_ms) {
+  auto* r = static_cast<Ring*>(rv);
+  Header* h = r->h;
+  uint64_t need = 4 + len;
+  if (need > h->capacity) return -3;
+  timespec ts = deadline_from_ms(timeout_ms);
+  if (lock_robust(h) != 0) return -2;
+  for (;;) {
+    if (h->closed) {
+      pthread_mutex_unlock(&h->mu);
+      return -2;
+    }
+    if (h->used == 0) h->head = h->tail = 0;  // empty: maximize contiguity
+    uint64_t head = h->head, tail = h->tail;
+    bool full = h->used > 0 && tail == head;
+    uint64_t cont_tail = 0, cont_zero = 0;
+    if (!full) {
+      if (tail > head || h->used == 0) {
+        cont_tail = h->capacity - tail;
+        cont_zero = head;
+      } else {  // tail < head
+        cont_tail = head - tail;
+      }
+    }
+    if (cont_tail >= need) {
+      uint32_t len32 = static_cast<uint32_t>(len);
+      memcpy(r->data + tail, &len32, 4);
+      if (len) memcpy(r->data + tail + 4, buf, len);
+      h->tail = (tail + need) % h->capacity;
+      h->used += need;
+      break;
+    }
+    if (cont_zero >= need) {  // wrap: mark the tail gap consumed
+      if (cont_tail >= 4) memcpy(r->data + tail, &kWrapMarker, 4);
+      h->used += cont_tail;
+      uint32_t len32 = static_cast<uint32_t>(len);
+      memcpy(r->data, &len32, 4);
+      if (len) memcpy(r->data + 4, buf, len);
+      h->tail = need % h->capacity;
+      h->used += need;
+      break;
+    }
+    int rc = timeout_ms < 0
+                 ? pthread_cond_wait(&h->not_full, &h->mu)
+                 : pthread_cond_timedwait(&h->not_full, &h->mu, &ts);
+    if (rc == ETIMEDOUT) {
+      pthread_mutex_unlock(&h->mu);
+      return -1;
+    }
+  }
+  pthread_cond_signal(&h->not_empty);
+  pthread_mutex_unlock(&h->mu);
+  return 0;
+}
+
+// 0 ok (out malloc'd), -1 timeout, -2 closed-and-empty/error
+int pd_ring_get(void* rv, uint8_t** out, uint64_t* out_len, int timeout_ms) {
+  auto* r = static_cast<Ring*>(rv);
+  Header* h = r->h;
+  timespec ts = deadline_from_ms(timeout_ms);
+  if (lock_robust(h) != 0) return -2;
+  for (;;) {
+    while (h->used == 0) {
+      if (h->closed) {
+        pthread_mutex_unlock(&h->mu);
+        return -2;
+      }
+      int rc = timeout_ms < 0
+                   ? pthread_cond_wait(&h->not_empty, &h->mu)
+                   : pthread_cond_timedwait(&h->not_empty, &h->mu, &ts);
+      if (rc == ETIMEDOUT) {
+        pthread_mutex_unlock(&h->mu);
+        return -1;
+      }
+    }
+    uint64_t head = h->head;
+    uint64_t room_to_end = h->capacity - head;
+    uint32_t len32;
+    if (room_to_end < 4) {
+      // unreachable gap smaller than a marker: skip to 0
+      h->used -= room_to_end;
+      h->head = 0;
+      continue;
+    }
+    memcpy(&len32, r->data + head, 4);
+    if (len32 == kWrapMarker) {
+      h->used -= room_to_end;
+      h->head = 0;
+      continue;
+    }
+    uint8_t* buf = static_cast<uint8_t*>(std::malloc(len32 ? len32 : 1));
+    memcpy(buf, r->data + head + 4, len32);
+    h->head = (head + 4 + len32) % h->capacity;
+    h->used -= 4 + len32;
+    pthread_cond_signal(&h->not_full);
+    pthread_mutex_unlock(&h->mu);
+    *out = buf;
+    *out_len = len32;
+    return 0;
+  }
+}
+
+int pd_ring_size(void* rv) {
+  auto* r = static_cast<Ring*>(rv);
+  if (lock_robust(r->h) != 0) return -1;
+  int used = static_cast<int>(r->h->used);
+  pthread_mutex_unlock(&r->h->mu);
+  return used;
+}
+
+void pd_ring_close(void* rv) {
+  auto* r = static_cast<Ring*>(rv);
+  if (lock_robust(r->h) == 0) {
+    r->h->closed = 1;
+    pthread_cond_broadcast(&r->h->not_empty);
+    pthread_cond_broadcast(&r->h->not_full);
+    pthread_mutex_unlock(&r->h->mu);
+  }
+}
+
+// Drop unlink responsibility (fork-inherited copies must not unlink the
+// creator's segment when they finalize).
+void pd_ring_set_owner(void* rv, int owner) {
+  static_cast<Ring*>(rv)->owner = owner != 0;
+}
+
+void pd_ring_free(void* rv) {
+  auto* r = static_cast<Ring*>(rv);
+  bool owner = r->owner;
+  std::string name = r->name;
+  ::munmap(r->h, r->map_size);
+  if (owner) ::shm_unlink(name.c_str());
+  delete r;
+}
+
+void pd_ring_free_buf(uint8_t* p) { std::free(p); }
+
+}  // extern "C"

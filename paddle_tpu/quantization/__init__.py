@@ -58,15 +58,34 @@ class QuantConfig:
         for n in names:
             self._name_cfg[n] = (activation, weight)
 
-    def _config_for(self, name: str, layer: Layer):
+    def _config_for(self, name: str, layer: Layer, path_cfg=None):
+        # id() matches only un-copied layers; quantize() resolves ids to
+        # dotted paths BEFORE its deepcopy and passes them as path_cfg
         if id(layer) in self._layer_cfg:
             return self._layer_cfg[id(layer)]
-        if name in self._name_cfg:
-            return self._name_cfg[name]
+        if path_cfg and name in path_cfg:
+            return path_cfg[name]
+        if name in self._name_cfg or name.split(".")[-1] in self._name_cfg:
+            return self._name_cfg.get(name) or self._name_cfg[
+                name.split(".")[-1]]
         for t, cfg in self._type_cfg.items():
             if isinstance(layer, t):
                 return cfg
         return self._global
+
+    def _resolve_layer_paths(self, model: Layer) -> dict:
+        """Map dotted sublayer paths to their add_layer_config entries so
+        the config survives the deepcopy in quantize()."""
+        out = {}
+
+        def visit(layer, prefix):
+            if id(layer) in self._layer_cfg:
+                out[prefix] = self._layer_cfg[id(layer)]
+            for n, child in layer._sub_layers.items():
+                visit(child, f"{prefix}.{n}" if prefix else n)
+
+        visit(model, "")
+        return out
 
     def _make(self, factory):
         if factory is None:
@@ -124,13 +143,16 @@ class QuantedConv2D(Layer):
                         groups=c._groups, data_format=c._data_format)
 
 
-def _swap(model: Layer, config: QuantConfig, observer_mode: bool):
+def _swap(model: Layer, config: QuantConfig, observer_mode: bool,
+          path_cfg=None):
     """Replace quantizable sublayers with quanted wrappers, in place on a
-    deep copy (reference QAT.quantize walks full_name->layer)."""
+    deep copy (reference QAT.quantize walks full_name->layer). path_cfg
+    carries add_layer_config entries resolved to dotted paths on the
+    pre-copy model."""
     from ..nn import Conv2D, Linear
 
     # the root itself may be a bare quantizable layer
-    a_factory, w_factory = config._config_for("", model)
+    a_factory, w_factory = config._config_for("", model, path_cfg)
     if isinstance(model, Linear) and (a_factory or w_factory):
         return QuantedLinear(model, config._make(a_factory),
                              config._make(w_factory))
@@ -138,9 +160,10 @@ def _swap(model: Layer, config: QuantConfig, observer_mode: bool):
         return QuantedConv2D(model, config._make(a_factory),
                              config._make(w_factory))
 
-    def visit(parent):
+    def visit(parent, prefix):
         for attr_name, child in list(parent._sub_layers.items()):
-            a_factory, w_factory = config._config_for(attr_name, child)
+            path = f"{prefix}.{attr_name}" if prefix else attr_name
+            a_factory, w_factory = config._config_for(path, child, path_cfg)
             if isinstance(child, Linear) and (a_factory or w_factory):
                 parent._sub_layers[attr_name] = QuantedLinear(
                     child, config._make(a_factory), config._make(w_factory))
@@ -148,9 +171,9 @@ def _swap(model: Layer, config: QuantConfig, observer_mode: bool):
                 parent._sub_layers[attr_name] = QuantedConv2D(
                     child, config._make(a_factory), config._make(w_factory))
             else:
-                visit(child)
+                visit(child, path)
 
-    visit(model)
+    visit(model, "")
     return model
 
 
@@ -161,9 +184,11 @@ class QAT:
         self._config = config
 
     def quantize(self, model: Layer, inplace: bool = False) -> Layer:
+        path_cfg = self._config._resolve_layer_paths(model)
         target = model if inplace else copy.deepcopy(model)
         target.train()
-        return _swap(target, self._config, observer_mode=False)
+        return _swap(target, self._config, observer_mode=False,
+                     path_cfg=path_cfg)
 
     def convert(self, model: Layer, inplace: bool = False) -> Layer:
         """Freeze: quanters stop updating (eval mode) and scales become
@@ -182,9 +207,11 @@ class PTQ:
         self._config = config
 
     def quantize(self, model: Layer, inplace: bool = False) -> Layer:
+        path_cfg = self._config._resolve_layer_paths(model)
         target = model if inplace else copy.deepcopy(model)
         target.eval()
-        return _swap(target, self._config, observer_mode=True)
+        return _swap(target, self._config, observer_mode=True,
+                     path_cfg=path_cfg)
 
     def convert(self, model: Layer, inplace: bool = False) -> Layer:
         """Replace observers with frozen fake quant-dequant at the observed

@@ -1,5 +1,32 @@
 """Vision model zoo (parity: python/paddle/vision/models/__init__.py)."""
 from .lenet import LeNet
+from .misc import (
+    AlexNet,
+    DenseNet,
+    ShuffleNetV2,
+    SqueezeNet,
+    alexnet,
+    densenet121,
+    densenet161,
+    densenet169,
+    densenet201,
+    shufflenet_v2_x0_5,
+    shufflenet_v2_x1_0,
+    shufflenet_v2_x1_5,
+    shufflenet_v2_x2_0,
+    squeezenet1_0,
+    squeezenet1_1,
+)
+from .mobilenet import (
+    MobileNetV1,
+    MobileNetV2,
+    MobileNetV3,
+    mobilenet_v1,
+    mobilenet_v2,
+    mobilenet_v3_large,
+    mobilenet_v3_small,
+)
+from .vgg import VGG, vgg11, vgg13, vgg16, vgg19
 from .resnet import (
     BasicBlock,
     BottleneckBlock,
@@ -15,6 +42,15 @@ from .resnet import (
 
 __all__ = [
     "LeNet",
+    "AlexNet", "alexnet",
+    "VGG", "vgg11", "vgg13", "vgg16", "vgg19",
+    "MobileNetV1", "MobileNetV2", "MobileNetV3",
+    "mobilenet_v1", "mobilenet_v2", "mobilenet_v3_large",
+    "mobilenet_v3_small",
+    "SqueezeNet", "squeezenet1_0", "squeezenet1_1",
+    "DenseNet", "densenet121", "densenet161", "densenet169", "densenet201",
+    "ShuffleNetV2", "shufflenet_v2_x0_5", "shufflenet_v2_x1_0",
+    "shufflenet_v2_x1_5", "shufflenet_v2_x2_0",
     "BasicBlock",
     "BottleneckBlock",
     "ResNet",

@@ -1,0 +1,213 @@
+"""MobileNet v1/v2/v3 (parity: python/paddle/vision/models/
+mobilenetv1.py, mobilenetv2.py, mobilenetv3.py)."""
+from __future__ import annotations
+
+from ... import nn
+
+
+def _make_divisible(v, divisor=8, min_value=None):
+    min_value = min_value or divisor
+    new_v = max(min_value, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+class ConvBNLayer(nn.Layer):
+    def __init__(self, in_c, out_c, k, stride=1, padding=0, groups=1,
+                 act="relu"):
+        super().__init__()
+        self.conv = nn.Conv2D(in_c, out_c, k, stride=stride, padding=padding,
+                              groups=groups, bias_attr=False)
+        self.bn = nn.BatchNorm2D(out_c)
+        self.act = {"relu": nn.ReLU(), "relu6": nn.ReLU6(),
+                    "hardswish": nn.Hardswish(), None: None}[act]
+
+    def forward(self, x):
+        x = self.bn(self.conv(x))
+        return self.act(x) if self.act is not None else x
+
+
+class MobileNetV1(nn.Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        s = lambda c: int(c * scale)
+        cfg = [  # (in, out, stride) depthwise-separable stacks
+            (32, 64, 1), (64, 128, 2), (128, 128, 1), (128, 256, 2),
+            (256, 256, 1), (256, 512, 2), *[(512, 512, 1)] * 5,
+            (512, 1024, 2), (1024, 1024, 1),
+        ]
+        layers = [ConvBNLayer(3, s(32), 3, stride=2, padding=1)]
+        for in_c, out_c, stride in cfg:
+            layers.append(ConvBNLayer(s(in_c), s(in_c), 3, stride=stride,
+                                      padding=1, groups=s(in_c)))  # dw
+            layers.append(ConvBNLayer(s(in_c), s(out_c), 1))  # pw
+        self.features = nn.Sequential(*layers)
+        self.with_pool = with_pool
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        self.fc = nn.Linear(s(1024), num_classes) if num_classes > 0 else None
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.fc is not None:
+            x = self.fc(x.flatten(1))
+        return x
+
+
+class InvertedResidual(nn.Layer):
+    def __init__(self, in_c, out_c, stride, expand_ratio):
+        super().__init__()
+        self.use_res = stride == 1 and in_c == out_c
+        hidden = int(round(in_c * expand_ratio))
+        layers = []
+        if expand_ratio != 1:
+            layers.append(ConvBNLayer(in_c, hidden, 1, act="relu6"))
+        layers += [
+            ConvBNLayer(hidden, hidden, 3, stride=stride, padding=1,
+                        groups=hidden, act="relu6"),
+            ConvBNLayer(hidden, out_c, 1, act=None),
+        ]
+        self.conv = nn.Sequential(*layers)
+
+    def forward(self, x):
+        return x + self.conv(x) if self.use_res else self.conv(x)
+
+
+class MobileNetV2(nn.Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        cfg = [  # t, c, n, s
+            (1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+            (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1),
+        ]
+        in_c = _make_divisible(32 * scale)
+        last_c = _make_divisible(1280 * max(1.0, scale))
+        layers = [ConvBNLayer(3, in_c, 3, stride=2, padding=1, act="relu6")]
+        for t, c, n, s in cfg:
+            out_c = _make_divisible(c * scale)
+            for i in range(n):
+                layers.append(InvertedResidual(in_c, out_c,
+                                               s if i == 0 else 1, t))
+                in_c = out_c
+        layers.append(ConvBNLayer(in_c, last_c, 1, act="relu6"))
+        self.features = nn.Sequential(*layers)
+        self.with_pool = with_pool
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        self.classifier = (nn.Sequential(nn.Dropout(0.2),
+                                         nn.Linear(last_c, num_classes))
+                           if num_classes > 0 else None)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.classifier is not None:
+            x = self.classifier(x.flatten(1))
+        return x
+
+
+class SqueezeExcitation(nn.Layer):
+    def __init__(self, c, squeeze_c):
+        super().__init__()
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.fc1 = nn.Conv2D(c, squeeze_c, 1)
+        self.fc2 = nn.Conv2D(squeeze_c, c, 1)
+
+    def forward(self, x):
+        s = self.pool(x)
+        s = nn.functional.relu(self.fc1(s))
+        s = nn.functional.hardsigmoid(self.fc2(s))
+        return x * s
+
+
+class _V3Block(nn.Layer):
+    def __init__(self, in_c, exp_c, out_c, k, stride, use_se, act):
+        super().__init__()
+        self.use_res = stride == 1 and in_c == out_c
+        layers = []
+        if exp_c != in_c:
+            layers.append(ConvBNLayer(in_c, exp_c, 1, act=act))
+        layers.append(ConvBNLayer(exp_c, exp_c, k, stride=stride,
+                                  padding=k // 2, groups=exp_c, act=act))
+        if use_se:
+            layers.append(SqueezeExcitation(exp_c,
+                                            _make_divisible(exp_c // 4)))
+        layers.append(ConvBNLayer(exp_c, out_c, 1, act=None))
+        self.block = nn.Sequential(*layers)
+
+    def forward(self, x):
+        return x + self.block(x) if self.use_res else self.block(x)
+
+
+_V3_LARGE = [  # k, exp, out, se, act, stride
+    (3, 16, 16, False, "relu", 1), (3, 64, 24, False, "relu", 2),
+    (3, 72, 24, False, "relu", 1), (5, 72, 40, True, "relu", 2),
+    (5, 120, 40, True, "relu", 1), (5, 120, 40, True, "relu", 1),
+    (3, 240, 80, False, "hardswish", 2), (3, 200, 80, False, "hardswish", 1),
+    (3, 184, 80, False, "hardswish", 1), (3, 184, 80, False, "hardswish", 1),
+    (3, 480, 112, True, "hardswish", 1), (3, 672, 112, True, "hardswish", 1),
+    (5, 672, 160, True, "hardswish", 2), (5, 960, 160, True, "hardswish", 1),
+    (5, 960, 160, True, "hardswish", 1),
+]
+_V3_SMALL = [
+    (3, 16, 16, True, "relu", 2), (3, 72, 24, False, "relu", 2),
+    (3, 88, 24, False, "relu", 1), (5, 96, 40, True, "hardswish", 2),
+    (5, 240, 40, True, "hardswish", 1), (5, 240, 40, True, "hardswish", 1),
+    (5, 120, 48, True, "hardswish", 1), (5, 144, 48, True, "hardswish", 1),
+    (5, 288, 96, True, "hardswish", 2), (5, 576, 96, True, "hardswish", 1),
+    (5, 576, 96, True, "hardswish", 1),
+]
+
+
+class MobileNetV3(nn.Layer):
+    def __init__(self, cfg, last_c, scale=1.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        in_c = _make_divisible(16 * scale)
+        layers = [ConvBNLayer(3, in_c, 3, stride=2, padding=1,
+                              act="hardswish")]
+        for k, exp, out, se, act, s in cfg:
+            exp_c = _make_divisible(exp * scale)
+            out_c = _make_divisible(out * scale)
+            layers.append(_V3Block(in_c, exp_c, out_c, k, s, se, act))
+            in_c = out_c
+        final_exp = _make_divisible(cfg[-1][1] * scale)
+        layers.append(ConvBNLayer(in_c, final_exp, 1, act="hardswish"))
+        self.features = nn.Sequential(*layers)
+        self.with_pool = with_pool
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Linear(final_exp, last_c), nn.Hardswish(),
+                nn.Dropout(0.2), nn.Linear(last_c, num_classes))
+        else:
+            self.classifier = None
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.classifier is not None:
+            x = self.classifier(x.flatten(1))
+        return x
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV1(scale=scale, **kwargs)
+
+
+def mobilenet_v2(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV2(scale=scale, **kwargs)
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV3(_V3_LARGE, 1280, scale=scale, **kwargs)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV3(_V3_SMALL, 1024, scale=scale, **kwargs)

@@ -1,0 +1,150 @@
+"""Model families: LLaMA (RoPE/GQA/SwiGLU), ERNIE (task embeddings, MLM),
+vision zoo forward shapes + one gradient step each (reference: test/book
+end-to-end small models + auto_parallel llama tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.ernie import (
+    ERNIE_CONFIGS,
+    ErnieForPretraining,
+    ErnieForSequenceClassification,
+    ErnieModel,
+)
+from paddle_tpu.models.llama import LLAMA_CONFIGS, LlamaForCausalLM
+
+
+def test_llama_forward_and_loss(rng):
+    paddle.seed(0)
+    cfg = LLAMA_CONFIGS["llama-tiny"]
+    model = LlamaForCausalLM(cfg)
+    ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (2, 16)), "int64")
+    labels = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (2, 16)),
+                              "int64")
+    logits = model(ids)
+    assert logits.shape == [2, 16, cfg.vocab_size]
+    loss, _ = model(ids, labels=labels)
+    assert float(loss._data) > 0
+
+
+def test_llama_gqa_heads_differ_from_mha(rng):
+    cfg = LLAMA_CONFIGS["llama-tiny"]
+    assert cfg.num_kv_heads == 2 and cfg.num_heads == 4  # GQA active
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    # k_proj output dim is kv_heads * head_dim, not hidden
+    assert model.llama.layers[0].self_attn.k_proj.weight.shape[1] == \
+        cfg.kv_heads * cfg.head_dim
+
+
+def test_llama_trains(rng):
+    paddle.seed(1)
+    cfg = LLAMA_CONFIGS["llama-tiny"]
+    model = LlamaForCausalLM(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+    ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (2, 16)), "int64")
+    labels = paddle.to_tensor(np.roll(np.asarray(ids._data), -1, 1), "int64")
+    first = None
+    for _ in range(5):
+        loss, _ = model(ids, labels=labels)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        if first is None:
+            first = float(loss._data)
+    assert float(loss._data) < first
+
+
+def test_llama_causality(rng):
+    """Changing a future token must not affect earlier logits."""
+    paddle.seed(2)
+    cfg = LLAMA_CONFIGS["llama-tiny"]
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    ids = rng.randint(0, cfg.vocab_size, (1, 8))
+    ids2 = ids.copy()
+    ids2[0, -1] = (ids2[0, -1] + 1) % cfg.vocab_size
+    l1 = np.asarray(model(paddle.to_tensor(ids, "int64"))._data)
+    l2 = np.asarray(model(paddle.to_tensor(ids2, "int64"))._data)
+    np.testing.assert_allclose(l1[0, :7], l2[0, :7], atol=1e-5)
+    assert not np.allclose(l1[0, 7], l2[0, 7])
+
+
+def test_ernie_forward_pooled_and_mask(rng):
+    paddle.seed(0)
+    cfg = ERNIE_CONFIGS["ernie-tiny"]
+    model = ErnieModel(cfg)
+    model.eval()
+    ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (2, 10)), "int64")
+    seq, pooled = model(ids)
+    assert seq.shape == [2, 10, cfg.hidden_size]
+    assert pooled.shape == [2, cfg.hidden_size]
+    # padding mask changes outputs
+    mask = np.ones((2, 10), np.float32)
+    mask[:, 5:] = 0
+    seq2, _ = model(ids, attention_mask=paddle.to_tensor(mask))
+    assert not np.allclose(np.asarray(seq._data), np.asarray(seq2._data))
+
+
+def test_ernie_task_embeddings_used(rng):
+    cfg = ERNIE_CONFIGS["ernie-tiny"]
+    paddle.seed(0)
+    model = ErnieModel(cfg)
+    model.eval()
+    ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (1, 6)), "int64")
+    t0 = np.asarray(model(ids, task_type_ids=paddle.to_tensor(
+        np.zeros((1, 6), np.int64)))[0]._data)
+    t1 = np.asarray(model(ids, task_type_ids=paddle.to_tensor(
+        np.ones((1, 6), np.int64)))[0]._data)
+    assert not np.allclose(t0, t1)
+
+
+def test_ernie_classification_and_pretraining(rng):
+    cfg = ERNIE_CONFIGS["ernie-tiny"]
+    paddle.seed(0)
+    cls = ErnieForSequenceClassification(cfg, num_classes=3)
+    ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (2, 8)), "int64")
+    labels = paddle.to_tensor(np.array([0, 2]), "int64")
+    loss, logits = cls(ids, labels=labels)
+    assert logits.shape == [2, 3] and float(loss._data) > 0
+
+    pre = ErnieForPretraining(cfg)
+    mlm_labels = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (2, 8)),
+                                  "int64")
+    nsp = paddle.to_tensor(np.array([0, 1]), "int64")
+    loss, mlm_logits, nsp_logits = pre(ids, labels=mlm_labels,
+                                       next_sentence_labels=nsp)
+    assert mlm_logits.shape == [2, 8, cfg.vocab_size]
+    assert nsp_logits.shape == [2, 2]
+
+
+@pytest.mark.parametrize("builder,size", [
+    ("alexnet", 64), ("vgg11", 32), ("mobilenet_v1", 32),
+    ("mobilenet_v2", 32), ("mobilenet_v3_small", 32),
+    ("squeezenet1_1", 64), ("densenet121", 32), ("shufflenet_v2_x1_0", 32),
+])
+def test_vision_zoo_forward(builder, size, rng):
+    from paddle_tpu.vision import models as M
+
+    paddle.seed(0)
+    model = getattr(M, builder)(num_classes=10)
+    model.eval()
+    x = paddle.to_tensor(rng.randn(1, 3, size, size).astype("float32"))
+    out = model(x)
+    assert out.shape == [1, 10]
+
+
+def test_vision_zoo_one_gradient_step(rng):
+    from paddle_tpu.vision import models as M
+
+    paddle.seed(0)
+    model = M.mobilenet_v2(num_classes=4)
+    opt = paddle.optimizer.SGD(learning_rate=0.01,
+                               parameters=model.parameters())
+    x = paddle.to_tensor(rng.randn(2, 3, 32, 32).astype("float32"))
+    y = paddle.to_tensor(np.array([1, 3]), "int64")
+    loss = paddle.nn.functional.cross_entropy(model(x), y)
+    loss.backward()
+    opt.step()
+    assert np.isfinite(float(loss._data))
