@@ -96,6 +96,7 @@ def __getattr__(name):
         "quantization",
         "audio",
         "text",
+        "onnx",
     }
     if name in _subpackages:
         return _importlib.import_module(f".{name}", __name__)

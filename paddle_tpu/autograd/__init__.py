@@ -1,6 +1,7 @@
 from . import backward as backward_mode
 from .backward import grad, run_backward
 from .engine import GradNode, apply_op, make_op
+from .functional import Hessian, Jacobian, hessian, jacobian, jvp, vjp
 from .grad_mode import enable_grad, is_grad_enabled, no_grad, set_grad_enabled
 
 __all__ = [
@@ -15,6 +16,12 @@ __all__ = [
     "set_grad_enabled",
     "PyLayer",
     "PyLayerContext",
+    "jacobian",
+    "hessian",
+    "jvp",
+    "vjp",
+    "Jacobian",
+    "Hessian",
 ]
 
 from .py_layer import PyLayer, PyLayerContext  # noqa: E402

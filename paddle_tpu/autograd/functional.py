@@ -1,0 +1,190 @@
+"""Functional higher-order autograd: jacobian / hessian / jvp / vjp.
+
+Reference: paddle.autograd.jacobian/hessian (autograd/autograd.py, lazy
+row-evaluated Jacobian) and paddle.incubate.autograd.{jvp,vjp,Jacobian,
+Hessian} (incubate/autograd/functional.py). On TPU these are direct
+jax.jacfwd/jacrev/jvp/vjp over the functionalized computation — one trace,
+compiled, instead of the reference's per-row double-grad graphs.
+"""
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor.tensor import Tensor
+from .grad_mode import no_grad
+
+
+def _unwrap(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _wrap_tree(tree):
+    return jax.tree.map(lambda a: Tensor(a), tree)
+
+
+def _functionalize(func: Callable):
+    """Wrap a Tensor->Tensor function as an array->array function (tape-free
+    inside: jax traces through apply_op on tracer-backed Tensors)."""
+
+    def fn(*arrays):
+        with no_grad():
+            out = func(*[Tensor(a) for a in arrays])
+        if isinstance(out, (tuple, list)):
+            return tuple(_unwrap(o) for o in out)
+        return _unwrap(out)
+
+    return fn
+
+
+def jacobian(func: Callable, xs, batch_axis=None):
+    """J[i][j] = d func(xs)[i] / d xs[j] (reference:
+    paddle.autograd.jacobian). Single input/output returns one Tensor;
+    otherwise a (tuple of) tuple(s). ``batch_axis=0`` computes per-sample
+    jacobians (reference batch semantics) via vmap."""
+    single_x = not isinstance(xs, (tuple, list))
+    xs_list = [xs] if single_x else list(xs)
+    arrays = [_unwrap(x) for x in xs_list]
+    fn = _functionalize(func if not single_x else (lambda x: func(x)))
+
+    def call(*a):
+        return fn(*a)
+
+    if batch_axis is None:
+        jac = jax.jacrev(call, argnums=tuple(range(len(arrays))))(*arrays)
+    elif batch_axis == 0:
+        per_sample = jax.vmap(
+            lambda *row: jax.jacrev(call, argnums=tuple(
+                range(len(arrays))))(*row))
+        jac = per_sample(*arrays)
+    else:
+        raise ValueError("batch_axis must be None or 0")
+    if single_x and isinstance(jac, tuple) and len(jac) == 1:
+        jac = jac[0]
+    return _wrap_tree(jac)
+
+
+def hessian(func: Callable, xs, batch_axis=None):
+    """H = d^2 func / dxs^2 for scalar-output func (reference:
+    paddle.autograd.hessian)."""
+    single_x = not isinstance(xs, (tuple, list))
+    xs_list = [xs] if single_x else list(xs)
+    arrays = [_unwrap(x) for x in xs_list]
+    fn = _functionalize(func)
+
+    def scalar_fn(*a):
+        out = fn(*a)
+        out = out[0] if isinstance(out, tuple) else out
+        return out.reshape(())  # must be scalar
+
+    argnums = tuple(range(len(arrays)))
+    if batch_axis is None:
+        hes = jax.hessian(scalar_fn, argnums=argnums)(*arrays)
+    elif batch_axis == 0:
+        hes = jax.vmap(lambda *row: jax.hessian(
+            scalar_fn, argnums=argnums)(*row))(*arrays)
+    else:
+        raise ValueError("batch_axis must be None or 0")
+    if single_x:
+        hes = hes[0][0] if isinstance(hes, tuple) else hes
+    return _wrap_tree(hes)
+
+
+def jvp(func: Callable, xs, v=None):
+    """Forward-mode: returns (func(xs), J @ v) (reference:
+    paddle.incubate.autograd.jvp)."""
+    single_x = not isinstance(xs, (tuple, list))
+    xs_list = [xs] if single_x else list(xs)
+    arrays = tuple(_unwrap(x) for x in xs_list)
+    if v is None:
+        tangents = tuple(jnp.ones_like(a) for a in arrays)
+    else:
+        v_list = [v] if not isinstance(v, (tuple, list)) else list(v)
+        tangents = tuple(_unwrap(t) for t in v_list)
+    fn = _functionalize(func)
+    out, tangent_out = jax.jvp(fn, arrays, tangents)
+    return _wrap_tree(out), _wrap_tree(tangent_out)
+
+
+def vjp(func: Callable, xs, v=None):
+    """Reverse-mode: returns (func(xs), v^T @ J) (reference:
+    paddle.incubate.autograd.vjp)."""
+    single_x = not isinstance(xs, (tuple, list))
+    xs_list = [xs] if single_x else list(xs)
+    arrays = tuple(_unwrap(x) for x in xs_list)
+    fn = _functionalize(func)
+    out, vjp_fn = jax.vjp(fn, *arrays)
+    if v is None:
+        cot = jax.tree.map(jnp.ones_like, out)
+    else:
+        v_list = [v] if not isinstance(v, (tuple, list)) else list(v)
+        cot = tuple(_unwrap(t) for t in v_list)
+        if not isinstance(out, tuple):
+            cot = cot[0]
+    grads = vjp_fn(cot)
+    if single_x:
+        grads = grads[0]
+    return _wrap_tree(out), _wrap_tree(grads)
+
+
+def _as_matrix(t: Tensor, in_shape, batched: bool) -> Tensor:
+    """Flatten a jacfwd/jacrev result to the paddle-documented 2-D matrix
+    [out_numel, in_numel] (batched: [B, out_numel, in_numel])."""
+    arr = t._data
+    in_numel = 1
+    for d in in_shape:
+        in_numel *= int(d)
+    if batched:
+        B = arr.shape[0]
+        return Tensor(arr.reshape(B, -1, in_numel))
+    return Tensor(arr.reshape(-1, in_numel))
+
+
+class Jacobian:
+    """Jacobian matrix view (reference: paddle.autograd.Jacobian — 2-D
+    [out_numel, in_numel], supports J[:], J[i, j] slicing; materialized
+    once, compiled)."""
+
+    def __init__(self, func, xs, is_batched=False):
+        if isinstance(xs, (tuple, list)):
+            raise TypeError(
+                "Jacobian wraps a single input; call jacobian() directly "
+                "for multi-input functions")
+        in_shape = (xs.shape[1:] if is_batched else xs.shape)
+        self._jac = _as_matrix(
+            jacobian(func, xs, batch_axis=0 if is_batched else None),
+            in_shape, is_batched)
+
+    def __getitem__(self, idx):
+        return Tensor(self._jac._data[idx])
+
+    @property
+    def shape(self):
+        return self._jac.shape
+
+
+class Hessian:
+    """Hessian matrix view: 2-D [in_numel, in_numel] (batched: [B, n, n]),
+    matching the reference's flattened contract."""
+
+    def __init__(self, func, xs, is_batched=False):
+        if isinstance(xs, (tuple, list)):
+            raise TypeError(
+                "Hessian wraps a single input; call hessian() directly "
+                "for multi-input functions")
+        in_shape = (xs.shape[1:] if is_batched else xs.shape)
+        self._hes = _as_matrix(
+            hessian(func, xs, batch_axis=0 if is_batched else None),
+            in_shape, is_batched)
+
+    def __getitem__(self, idx):
+        return Tensor(self._hes._data[idx])
+
+    @property
+    def shape(self):
+        return self._hes.shape
+
+
+__all__ = ["jacobian", "hessian", "jvp", "vjp", "Jacobian", "Hessian"]

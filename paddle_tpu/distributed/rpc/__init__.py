@@ -1,0 +1,272 @@
+"""paddle.distributed.rpc parity.
+
+Reference: brpc-based RPC agent (fluid/distributed/rpc/rpc_agent.cc,
+python_rpc_handler.cc; python distributed/rpc/__init__.py — init_rpc,
+rpc_sync, rpc_async, shutdown, WorkerInfo). SURVEY.md §2.6.
+
+TPU-native mapping: the control plane needs no brpc — rendezvous runs over
+the native TCPStore (each worker publishes name/ip/port under /rpc/<rank>),
+and the data plane is a per-worker TCP server executing pickled
+(fn, args, kwargs) requests on a thread pool. Connections to peers are
+cached; every request gets its own logical reply (length-prefixed frames),
+and remote exceptions re-raise at the caller like the reference.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import threading
+from collections import namedtuple
+from concurrent.futures import Future, ThreadPoolExecutor
+
+WorkerInfo = namedtuple("WorkerInfo", ["name", "rank", "ip", "port"])
+
+_agent = None
+_DEFAULT_TIMEOUT = 180.0
+
+
+def _send_frame(sock: socket.socket, payload: bytes) -> None:
+    sock.sendall(struct.pack("<Q", len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    while n > 0:
+        chunk = sock.recv(n)
+        if not chunk:
+            raise ConnectionError("rpc peer closed connection")
+        chunks.append(chunk)
+        n -= len(chunk)
+    return b"".join(chunks)
+
+
+def _recv_frame(sock: socket.socket) -> bytes:
+    (length,) = struct.unpack("<Q", _recv_exact(sock, 8))
+    return _recv_exact(sock, length)
+
+
+class _Agent:
+    def __init__(self, name: str, rank: int, world_size: int,
+                 master_endpoint: str):
+        from ..store import TCPStore
+
+        self.name = name
+        self.rank = rank
+        self.world_size = world_size
+        host, _, port = master_endpoint.rpartition(":")
+        self._store = TCPStore(host or "127.0.0.1", int(port),
+                               is_master=(rank == 0),
+                               world_size=world_size, timeout=60)
+        # serve on an ephemeral port; publish it
+        self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._server.bind(("0.0.0.0", 0))
+        self._server.listen(64)
+        my_port = self._server.getsockname()[1]
+        my_ip = os.environ.get("POD_IP", "127.0.0.1")
+        self._store.set(f"/rpc/{rank}",
+                        pickle.dumps(WorkerInfo(name, rank, my_ip, my_port)))
+        self._pool = ThreadPoolExecutor(max_workers=8,
+                                        thread_name_prefix="rpc-exec")
+        self._stop = False
+        self._accept_thread = threading.Thread(target=self._accept_loop,
+                                               daemon=True)
+        self._accept_thread.start()
+        # discover all peers (blocking get = store-side wait)
+        self.workers: dict[str, WorkerInfo] = {}
+        for r in range(world_size):
+            info = pickle.loads(self._store.get(f"/rpc/{r}"))
+            self.workers[info.name] = info
+        self._conns: dict[str, socket.socket] = {}
+        self._conn_locks: dict[str, threading.Lock] = {}
+        self._conns_mu = threading.Lock()
+        self._seq = 0
+        self._store.barrier("rpc_init")
+
+    # -- server side -------------------------------------------------------
+    def _accept_loop(self):
+        while not self._stop:
+            try:
+                conn, _ = self._server.accept()
+            except OSError:
+                break
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True).start()
+
+    def _serve_conn(self, conn: socket.socket):
+        try:
+            while not self._stop:
+                req = _recv_frame(conn)
+                seq, fn, args, kwargs = pickle.loads(req)
+                fut = self._pool.submit(self._run_one, fn, args, kwargs)
+
+                def reply(f, seq=seq, conn=conn):
+                    try:
+                        payload = pickle.dumps((seq, f.result()))
+                    except Exception as e:
+                        # result/exception unpicklable: still answer, with a
+                        # serializable error, so the caller never hangs
+                        payload = pickle.dumps(
+                            (seq, ("err", RuntimeError(
+                                f"rpc result not serializable: {e!r}"))))
+                    try:
+                        _send_frame(conn, payload)
+                    except OSError:
+                        pass  # caller gone; nothing to deliver to
+
+                fut.add_done_callback(reply)
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    @staticmethod
+    def _run_one(fn, args, kwargs):
+        try:
+            return ("ok", fn(*(args or ()), **(kwargs or {})))
+        except Exception as e:  # serialize the failure to the caller
+            return ("err", e)
+
+    # -- client side -------------------------------------------------------
+    def _connect(self, to: str) -> tuple[socket.socket, threading.Lock]:
+        if to not in self.workers:
+            raise ValueError(f"unknown rpc worker {to!r}; known: "
+                             f"{sorted(self.workers)}")
+        with self._conns_mu:
+            if to not in self._conns:
+                info = self.workers[to]
+                sock = socket.create_connection((info.ip, info.port),
+                                                timeout=30)
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                self._conns[to] = sock
+                self._conn_locks[to] = threading.Lock()
+            return self._conns[to], self._conn_locks[to]
+
+    def _drop_conn(self, to: str, sock: socket.socket) -> None:
+        """After a timeout/IO error the stream position is unknown (a late
+        reply may still arrive) — poison the connection so the next call
+        starts on a fresh socket instead of reading a stale frame."""
+        with self._conns_mu:
+            if self._conns.get(to) is sock:
+                del self._conns[to]
+                del self._conn_locks[to]
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+    def call(self, to: str, fn, args, kwargs, timeout) -> "object":
+        sock, lock = self._connect(to)
+        with self._conns_mu:
+            self._seq += 1
+            seq = self._seq
+        payload = pickle.dumps((seq, fn, args, kwargs))
+        with lock:  # one in-flight request per connection; replies in order
+            old = sock.gettimeout()
+            sock.settimeout(timeout if timeout and timeout > 0 else None)
+            try:
+                _send_frame(sock, payload)
+                resp = _recv_frame(sock)
+            except (OSError, ConnectionError, socket.timeout):
+                self._drop_conn(to, sock)
+                raise
+            finally:
+                try:
+                    sock.settimeout(old)
+                except OSError:
+                    pass
+        rseq, (status, value) = pickle.loads(resp)
+        if rseq != seq:  # cannot happen on a fresh stream; fail loudly
+            self._drop_conn(to, sock)
+            raise RuntimeError(
+                f"rpc reply out of sync (expected seq {seq}, got {rseq})")
+        if status == "err":
+            raise value
+        return value
+
+    def shutdown(self):
+        self._store.barrier("rpc_shutdown")
+        self._stop = True
+        try:
+            self._server.close()
+        except OSError:
+            pass
+        for sock in self._conns.values():
+            try:
+                sock.close()
+            except OSError:
+                pass
+        self._pool.shutdown(wait=False)
+        self._store.close()
+
+
+def init_rpc(name: str, rank: int | None = None,
+             world_size: int | None = None,
+             master_endpoint: str | None = None) -> None:
+    """Start this process's RPC agent and rendezvous with the others
+    (reference: distributed/rpc/__init__.py init_rpc)."""
+    global _agent
+    if _agent is not None:
+        raise RuntimeError("rpc already initialized")
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", 0)) if rank is None else rank
+    world_size = (int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+                  if world_size is None else world_size)
+    master_endpoint = master_endpoint or os.environ.get(
+        "PADDLE_MASTER", "127.0.0.1:0")
+    _agent = _Agent(name, rank, world_size, master_endpoint)
+
+
+def _require_agent() -> _Agent:
+    if _agent is None:
+        raise RuntimeError("call init_rpc first")
+    return _agent
+
+
+def rpc_sync(to: str, fn, args=None, kwargs=None,
+             timeout=_DEFAULT_TIMEOUT):
+    """Blocking remote call; remote exceptions re-raise here."""
+    return _require_agent().call(to, fn, args, kwargs, timeout)
+
+
+def rpc_async(to: str, fn, args=None, kwargs=None,
+              timeout=_DEFAULT_TIMEOUT) -> Future:
+    """Non-blocking remote call returning a Future (.wait()/.result())."""
+    agent = _require_agent()
+    fut = Future()
+
+    def run():
+        try:
+            fut.set_result(agent.call(to, fn, args, kwargs, timeout))
+        except Exception as e:
+            fut.set_exception(e)
+
+    threading.Thread(target=run, daemon=True).start()
+    fut.wait = fut.result  # reference FutureWrapper API
+    return fut
+
+
+def get_worker_info(name: str) -> WorkerInfo:
+    return _require_agent().workers[name]
+
+
+def get_all_worker_infos() -> list[WorkerInfo]:
+    return sorted(_require_agent().workers.values(), key=lambda w: w.rank)
+
+
+def get_current_worker_info() -> WorkerInfo:
+    agent = _require_agent()
+    return agent.workers[agent.name]
+
+
+def shutdown() -> None:
+    global _agent
+    if _agent is not None:
+        _agent.shutdown()
+        _agent = None
+
+
+__all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
+           "get_worker_info", "get_all_worker_infos",
+           "get_current_worker_info", "WorkerInfo"]

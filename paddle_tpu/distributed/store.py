@@ -13,6 +13,7 @@ the semantics are identical regardless of rank.
 from __future__ import annotations
 
 import ctypes
+import time
 import os
 import threading
 
@@ -26,6 +27,8 @@ def _lib():
         lib.pd_store_server_start.argtypes = [ctypes.c_int]
         lib.pd_store_server_port.restype = ctypes.c_int
         lib.pd_store_server_port.argtypes = [ctypes.c_void_p]
+        lib.pd_store_server_active_clients.restype = ctypes.c_int
+        lib.pd_store_server_active_clients.argtypes = [ctypes.c_void_p]
         lib.pd_store_server_stop.argtypes = [ctypes.c_void_p]
         lib.pd_store_client_new.restype = ctypes.c_void_p
         lib.pd_store_client_new.argtypes = [
@@ -148,7 +151,7 @@ class TCPStore:
             self.set(release_key, b"1")
         self.wait([release_key])
 
-    def close(self) -> None:
+    def close(self, linger: float = 5.0) -> None:
         if self._closed:
             return
         self._closed = True
@@ -156,6 +159,15 @@ class TCPStore:
             self._lib.pd_store_client_free(self._client)
             self._client = None
         if self._server:
+            # Linger until the other participants' connections drop: a peer
+            # may still be reading the ack of its final op (e.g. the last
+            # barrier arriver's release-set); closing now would cut it off
+            # mid-read. Our own client connection is already gone, so the
+            # target is zero active clients.
+            deadline = time.monotonic() + linger
+            while (self._lib.pd_store_server_active_clients(self._server) > 0
+                   and time.monotonic() < deadline):
+                time.sleep(0.01)
             self._lib.pd_store_server_stop(self._server)
             self._server = None
 

@@ -135,6 +135,11 @@ class StoreServer {
 
   int port() const { return port_; }
 
+  int ActiveClients() {
+    std::lock_guard<std::mutex> lk(clients_mu_);
+    return static_cast<int>(client_fds_.size());
+  }
+
   ~StoreServer() { Stop(); }
 
  private:
@@ -351,6 +356,10 @@ void* pd_store_server_start(int port) {
 
 int pd_store_server_port(void* h) {
   return static_cast<StoreServer*>(h)->port();
+}
+
+int pd_store_server_active_clients(void* h) {
+  return static_cast<StoreServer*>(h)->ActiveClients();
 }
 
 void pd_store_server_stop(void* h) { delete static_cast<StoreServer*>(h); }

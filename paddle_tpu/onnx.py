@@ -1,0 +1,29 @@
+"""paddle.onnx parity surface.
+
+Reference: python/paddle/onnx/export.py — a thin wrapper delegating to the
+external ``paddle2onnx`` package. This environment ships no onnx runtime or
+exporter (and has no network egress to fetch one), so ``export`` gates with
+a clear error pointing at the portable serving format this framework does
+ship: serialized StableHLO via ``paddle_tpu.jit.save`` /
+``paddle_tpu.static.save_inference_model`` (consumed by
+``paddle_tpu.inference.Predictor`` and any StableHLO-speaking runtime).
+"""
+from __future__ import annotations
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    try:
+        import onnx  # noqa: F401
+    except ImportError:
+        raise RuntimeError(
+            "paddle_tpu.onnx.export requires the 'onnx' package, which is "
+            "not available in this build. Use paddle_tpu.jit.save(layer, "
+            "path, input_spec=...) to produce a portable serialized-"
+            "StableHLO program instead (loadable by paddle_tpu.inference."
+            "Predictor or any StableHLO runtime).")
+    raise NotImplementedError(
+        "ONNX graph emission is not implemented; export via jit.save "
+        "(StableHLO) for deployment.")
+
+
+__all__ = ["export"]

@@ -104,4 +104,121 @@ class MNIST(Dataset):
         return len(self.images)
 
 
-__all__ = ["FakeData", "Cifar10", "MNIST"]
+class Cifar100(Cifar10):
+    """CIFAR-100 from a local ``cifar-100-python.tar.gz``: same layout with
+    'train'/'test' members and b'fine_labels'."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend="cv2"):
+        if download:
+            raise RuntimeError(
+                "this build has no network egress; place "
+                "cifar-100-python.tar.gz locally and pass data_file=")
+        if data_file is None or not os.path.exists(data_file):
+            raise FileNotFoundError(f"CIFAR archive not found: {data_file}")
+        self.transform = transform
+        self.mode = mode
+        want = "train" if mode == "train" else "test"
+        xs, ys = [], []
+        with tarfile.open(data_file) as tf:
+            for m in tf.getmembers():
+                if os.path.basename(m.name) == want:
+                    d = pickle.load(tf.extractfile(m), encoding="bytes")
+                    xs.append(d[b"data"])
+                    ys.extend(d[b"fine_labels"])
+        self.data = np.concatenate(xs).reshape(-1, 3, 32, 32).astype(
+            "float32") / 255.0
+        self.labels = np.asarray(ys, "int64")
+
+
+class FashionMNIST(MNIST):
+    """Same idx-gz format as MNIST (reference: vision/datasets/mnist.py
+    FashionMNIST subclass)."""
+
+
+IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".pgm", ".tif",
+                  ".tiff", ".webp", ".npy")
+
+
+def _load_image(path: str):
+    if path.endswith(".npy"):
+        return np.load(path)
+    from PIL import Image  # pillow ships with the baked environment
+
+    with Image.open(path) as img:
+        return np.asarray(img.convert("RGB"))
+
+
+def _scan_files(root, extensions, is_valid_file):
+    """Sorted recursive walk filtered by extension/predicate (shared by
+    DatasetFolder and ImageFolder)."""
+    for dirpath, _, files in sorted(os.walk(root)):
+        for fname in sorted(files):
+            path = os.path.join(dirpath, fname)
+            ok = (is_valid_file(path) if is_valid_file
+                  else fname.lower().endswith(extensions))
+            if ok:
+                yield path
+
+
+class DatasetFolder(Dataset):
+    """class-per-subdirectory image tree (reference:
+    vision/datasets/folder.py DatasetFolder): root/<class_x>/xxx.png."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.loader = loader or _load_image
+        self.transform = transform
+        extensions = extensions or IMG_EXTENSIONS
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        if not classes:
+            raise RuntimeError(f"no class folders under {root}")
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            for path in _scan_files(os.path.join(root, c), extensions,
+                                    is_valid_file):
+                self.samples.append((path, self.class_to_idx[c]))
+        if not self.samples:
+            raise RuntimeError(f"no valid files under {root}")
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        sample = self.loader(path)
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return sample, np.int64(target)
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(Dataset):
+    """Unlabeled flat/recursive image list (reference: folder.py
+    ImageFolder)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.loader = loader or _load_image
+        self.transform = transform
+        extensions = extensions or IMG_EXTENSIONS
+        self.samples = list(_scan_files(root, extensions, is_valid_file))
+        if not self.samples:
+            raise RuntimeError(f"no valid files under {root}")
+
+    def __getitem__(self, idx):
+        sample = self.loader(self.samples[idx])
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return [sample]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+__all__ = ["FakeData", "Cifar10", "Cifar100", "MNIST", "FashionMNIST",
+           "DatasetFolder", "ImageFolder"]

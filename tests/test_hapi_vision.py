@@ -106,3 +106,49 @@ class TestHapiModel:
         assert info["total_params"] == sum(
             int(np.prod(p.shape)) for p in net.parameters()
         )
+
+
+def test_dataset_folder_and_image_folder(tmp_path):
+    import os
+
+    from paddle_tpu.vision.datasets import DatasetFolder, ImageFolder
+
+    for c in ("cat", "dog"):
+        os.makedirs(tmp_path / c, exist_ok=True)
+        for i in range(3):
+            np.save(str(tmp_path / c / f"{i}.npy"),
+                    np.full((8, 8, 3), i, np.uint8))
+    ds = DatasetFolder(str(tmp_path))
+    assert len(ds) == 6
+    assert ds.classes == ["cat", "dog"]
+    img, label = ds[5]
+    assert img.shape == (8, 8, 3) and int(label) == 1
+    flat = ImageFolder(str(tmp_path))
+    assert len(flat) == 6
+
+
+def test_fashion_mnist_reads_idx_gz(tmp_path):
+    import gzip
+
+    from paddle_tpu.vision.datasets import FashionMNIST
+
+    imgs = np.random.randint(0, 255, (4, 28, 28), dtype=np.uint8)
+    labels = np.array([0, 1, 2, 3], np.uint8)
+    ip, lp = str(tmp_path / "im.gz"), str(tmp_path / "lb.gz")
+    with gzip.open(ip, "wb") as f:
+        f.write(b"\x00" * 16 + imgs.tobytes())
+    with gzip.open(lp, "wb") as f:
+        f.write(b"\x00" * 8 + labels.tobytes())
+    ds = FashionMNIST(image_path=ip, label_path=lp)
+    assert len(ds) == 4
+    x, y = ds[2]
+    assert x.shape == (1, 28, 28) and y == 2
+
+
+def test_onnx_export_gates_with_guidance():
+    import pytest
+
+    import paddle_tpu
+
+    with pytest.raises(RuntimeError, match="jit.save"):
+        paddle_tpu.onnx.export(None, "/tmp/x")

@@ -1,0 +1,79 @@
+"""paddle.distributed.rpc: multi-process sync/async calls, remote
+exceptions, worker info discovery (reference: test/rpc)."""
+import multiprocessing as mp
+
+import pytest
+
+
+def _sq(x):
+    return x * x
+
+
+def _boom():
+    raise ValueError("remote boom")
+
+
+def _concat(a, b, sep="-"):
+    return f"{a}{sep}{b}"
+
+
+def _rpc_worker(rank, world, port, q):
+    try:
+        from paddle_tpu.distributed import rpc
+
+        rpc.init_rpc(f"worker{rank}", rank=rank, world_size=world,
+                     master_endpoint=f"127.0.0.1:{port}")
+        results = {}
+        peer = f"worker{(rank + 1) % world}"
+        results["sync"] = rpc.rpc_sync(peer, _sq, args=(rank + 2,))
+        fut = rpc.rpc_async(peer, _concat, args=("a", "b"),
+                            kwargs={"sep": "+"})
+        results["async"] = fut.wait()
+        results["self"] = rpc.rpc_sync(f"worker{rank}", _sq, args=(3,))
+        try:
+            rpc.rpc_sync(peer, _boom)
+            results["exc"] = "no-raise"
+        except ValueError as e:
+            results["exc"] = str(e)
+        infos = rpc.get_all_worker_infos()
+        results["names"] = [w.name for w in infos]
+        results["me"] = rpc.get_current_worker_info().name
+        rpc.shutdown()
+        q.put((rank, results))
+    except Exception as e:  # pragma: no cover
+        q.put((rank, {"error": repr(e)}))
+
+
+def test_rpc_multiprocess():
+    world = 3
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    # reserve a rendezvous port
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    procs = [ctx.Process(target=_rpc_worker, args=(r, world, port, q))
+             for r in range(world)]
+    for p in procs:
+        p.start()
+    results = dict(q.get(timeout=90) for _ in range(world))
+    for p in procs:
+        p.join(timeout=30)
+    for rank in range(world):
+        res = results[rank]
+        assert "error" not in res, res
+        assert res["sync"] == (rank + 2) ** 2
+        assert res["async"] == "a+b"
+        assert res["self"] == 9
+        assert res["exc"] == "remote boom"
+        assert res["names"] == [f"worker{r}" for r in range(world)]
+        assert res["me"] == f"worker{rank}"
+
+
+def test_rpc_requires_init():
+    from paddle_tpu.distributed import rpc
+
+    with pytest.raises(RuntimeError):
+        rpc.rpc_sync("nobody", _sq, args=(1,))
