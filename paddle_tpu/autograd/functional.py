@@ -39,6 +39,21 @@ def _functionalize(func: Callable):
     return fn
 
 
+def _per_sample(fn: Callable) -> Callable:
+    """Per-sample view of a batched function for batch_axis=0: the sample is
+    re-expanded to a size-1 batch so ``func`` still sees its expected batch
+    dim (reference batched-jacobian contract), and the output's batch dim is
+    squeezed away."""
+
+    def one(*rows):
+        out = fn(*[r[None] for r in rows])
+        if isinstance(out, tuple):
+            return tuple(o[0] for o in out)
+        return out[0]
+
+    return one
+
+
 def jacobian(func: Callable, xs, batch_axis=None):
     """J[i][j] = d func(xs)[i] / d xs[j] (reference:
     paddle.autograd.jacobian). Single input/output returns one Tensor;
@@ -47,18 +62,13 @@ def jacobian(func: Callable, xs, batch_axis=None):
     single_x = not isinstance(xs, (tuple, list))
     xs_list = [xs] if single_x else list(xs)
     arrays = [_unwrap(x) for x in xs_list]
-    fn = _functionalize(func if not single_x else (lambda x: func(x)))
-
-    def call(*a):
-        return fn(*a)
+    fn = _functionalize(func)
 
     if batch_axis is None:
-        jac = jax.jacrev(call, argnums=tuple(range(len(arrays))))(*arrays)
+        jac = jax.jacrev(fn, argnums=tuple(range(len(arrays))))(*arrays)
     elif batch_axis == 0:
-        per_sample = jax.vmap(
-            lambda *row: jax.jacrev(call, argnums=tuple(
-                range(len(arrays))))(*row))
-        jac = per_sample(*arrays)
+        jac = jax.vmap(jax.jacrev(_per_sample(fn),
+                                  argnums=tuple(range(len(arrays)))))(*arrays)
     else:
         raise ValueError("batch_axis must be None or 0")
     if single_x and isinstance(jac, tuple) and len(jac) == 1:
@@ -74,17 +84,23 @@ def hessian(func: Callable, xs, batch_axis=None):
     arrays = [_unwrap(x) for x in xs_list]
     fn = _functionalize(func)
 
-    def scalar_fn(*a):
-        out = fn(*a)
-        out = out[0] if isinstance(out, tuple) else out
-        return out.reshape(())  # must be scalar
-
     argnums = tuple(range(len(arrays)))
     if batch_axis is None:
+        def scalar_fn(*a):
+            out = fn(*a)
+            out = out[0] if isinstance(out, tuple) else out
+            return out.reshape(())  # must be scalar
+
         hes = jax.hessian(scalar_fn, argnums=argnums)(*arrays)
     elif batch_axis == 0:
-        hes = jax.vmap(lambda *row: jax.hessian(
-            scalar_fn, argnums=argnums)(*row))(*arrays)
+        per = _per_sample(fn)
+
+        def scalar_row(*row):
+            out = per(*row)
+            out = out[0] if isinstance(out, tuple) else out
+            return out.reshape(())  # per-sample scalar
+
+        hes = jax.vmap(jax.hessian(scalar_row, argnums=argnums))(*arrays)
     else:
         raise ValueError("batch_axis must be None or 0")
     if single_x:

@@ -93,3 +93,79 @@ def stream_guard(stream):
         yield
 
     return guard()
+
+
+# ---------------------------------------------------------------------------
+# memory stats (reference: paddle.device.cuda.memory_allocated etc. over the
+# C++ allocator stats — fluid/memory/; here PJRT's per-device memory_stats)
+# ---------------------------------------------------------------------------
+
+def _device_of(device=None):
+    devs = jax.devices()
+    if device is None:
+        return devs[0]
+    if isinstance(device, int):
+        return devs[device]
+    if isinstance(device, str) and ":" in device:
+        return devs[int(device.rsplit(":", 1)[1])]
+    return devs[0]
+
+
+def _stat(device, key) -> int:
+    stats = _device_of(device).memory_stats() or {}
+    return int(stats.get(key, 0))
+
+
+def memory_allocated(device=None) -> int:
+    return _stat(device, "bytes_in_use")
+
+
+def max_memory_allocated(device=None) -> int:
+    return _stat(device, "peak_bytes_in_use")
+
+
+def memory_reserved(device=None) -> int:
+    stats = _device_of(device).memory_stats() or {}
+    return int(stats.get("bytes_reserved", stats.get("bytes_in_use", 0)))
+
+
+def max_memory_reserved(device=None) -> int:
+    return max_memory_allocated(device)
+
+
+def empty_cache():
+    """XLA's allocator reuses buffers internally; nothing to flush (parity
+    no-op, like the reference on non-auto-growth strategies)."""
+
+
+class _CudaNamespace:
+    """paddle.device.cuda API alias: the accelerator here is the TPU chip,
+    but the method surface is kept so reference code runs unchanged."""
+
+    Stream = Stream
+    Event = Event
+
+    @staticmethod
+    def synchronize(device=None):
+        return synchronize(device)
+
+    @staticmethod
+    def device_count():
+        return device_count()
+
+    memory_allocated = staticmethod(memory_allocated)
+    max_memory_allocated = staticmethod(max_memory_allocated)
+    memory_reserved = staticmethod(memory_reserved)
+    max_memory_reserved = staticmethod(max_memory_reserved)
+    empty_cache = staticmethod(empty_cache)
+
+    @staticmethod
+    def current_stream(device=None):
+        return current_stream(device)
+
+    @staticmethod
+    def stream_guard(stream):
+        return stream_guard(stream)
+
+
+cuda = _CudaNamespace()

@@ -23,14 +23,11 @@ from ...tensor.tensor import Parameter, Tensor
 from ..mesh import ProcessMesh, get_mesh
 from .placement import Partial, Placement, Replicate, Shard, placements_to_spec
 
-_TENSOR_MESH: "weakref.WeakKeyDictionary" = None  # populated lazily
-import weakref
-
-_TENSOR_MESH = weakref.WeakKeyDictionary()
-
 
 def _mesh_of(t: Tensor) -> ProcessMesh | None:
-    return _TENSOR_MESH.get(t)
+    # stored as an attribute: a WeakKeyDictionary would hash/compare Tensor
+    # keys, and Tensor.__eq__ is elementwise — bucket collisions then raise
+    return getattr(t, "_dist_mesh", None)
 
 
 def _normalize_placements(mesh: ProcessMesh, placements):
@@ -71,7 +68,7 @@ def shard_tensor(data, mesh: ProcessMesh, placements, dtype=None, stop_gradient=
     if stop_gradient is not None:
         out.stop_gradient = stop_gradient
     out._placements = placements
-    _TENSOR_MESH[out] = mesh
+    out._dist_mesh = mesh
     return out
 
 
@@ -94,7 +91,7 @@ def reshard(t: Tensor, mesh: ProcessMesh, placements):
 
     out = apply_op("reshard", lambda x: jax.device_put(x, sharding), t)
     out._placements = placements
-    _TENSOR_MESH[out] = mesh
+    out._dist_mesh = mesh
     return out
 
 

@@ -4,6 +4,7 @@ program_guard, data, save/load + inference-model export. Design notes in
 """
 from ..jit.api import InputSpec
 from . import nn
+from .backward import append_backward, gradients
 from .executor import CompiledProgram, Executor
 from .io import (
     load,
@@ -27,4 +28,5 @@ __all__ = [
     "default_main_program", "default_startup_program", "disable_static",
     "enable_static", "in_static_mode", "program_guard", "load",
     "load_inference_model", "save", "save_inference_model",
+    "gradients", "append_backward",
 ]

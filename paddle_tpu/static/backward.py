@@ -1,0 +1,141 @@
+"""Static-mode gradients: paddle.static.gradients / append_backward.
+
+Reference: paddle.static.append_backward (base/backward.py — appends grad
+ops to the main program) and paddle.static.gradients. In the
+record-and-replay design the "appended backward" is ONE recorded statement
+whose pure function replays the loss slice and takes jax.grad — the
+Executor then compiles it like any other op, so fetching a gradient
+variable costs one fused XLA program, not a hand-built grad-op graph.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..autograd.engine import apply_op
+from ..tensor.tensor import Parameter, Tensor
+from .program import Program
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """d targets / d inputs as new program variables.
+
+    ``inputs`` must be feed placeholders (static.data) or Parameters —
+    gradients w.r.t. intermediate activations are not part of the v1
+    surface (the reference's main uses are these two).
+    """
+    if no_grad_set:
+        raise NotImplementedError(
+            "gradients(no_grad_set=...) is not supported; mark tensors with "
+            "stop_gradient=True before recording instead")
+    targets = targets if isinstance(targets, (list, tuple)) else [targets]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    target_vids = []
+    for t in targets:
+        tv = getattr(t, "_static_vid", None)
+        if tv is None:
+            raise ValueError(
+                "gradients(): targets must be static Program vars")
+        target_vids.append(tv[1])
+    prog: Program = getattr(targets[0], "_static_vid")[0]
+    stmts = prog.slice_for(set(target_vids))
+
+    produced = {v for st in stmts for v in st.out_vids}
+    consumed = {ref for st in stmts
+                for kind, ref in st.leaf_refs if kind == "v"}
+    pnames = sorted({ref for st in stmts
+                     for kind, ref in st.leaf_refs if kind == "p"})
+    feed_names = [n for n, fv in prog._feeds.items()
+                  if fv in (consumed | set(target_vids))
+                  and fv not in produced]
+    feed_vids = [prog._feeds[n] for n in feed_names]
+
+    # fixed argument order: feeds then params
+    arg_tensors = [prog._feed_tensors[n] for n in feed_names] + [
+        prog._params[n] for n in pnames]
+
+    diff_idx = []
+    for x in inputs:
+        xv = getattr(x, "_static_vid", None)
+        if isinstance(x, Parameter) and x.name in pnames:
+            diff_idx.append(len(feed_names) + pnames.index(x.name))
+        elif xv is not None and xv[1] in feed_vids:
+            diff_idx.append(feed_vids.index(xv[1]))
+        else:
+            raise ValueError(
+                f"gradients(): input {x!r} is neither a feed placeholder "
+                "nor a Parameter used by the targets")
+
+    tgs = [None] * len(targets)
+    if target_gradients is not None:
+        tgl = (target_gradients
+               if isinstance(target_gradients, (list, tuple))
+               else [target_gradients])
+        if len(tgl) != len(targets):
+            raise ValueError(
+                "target_gradients must match targets in length")
+        tgs = [t._data if isinstance(t, Tensor)
+               else (jnp.asarray(t) if t is not None else None)
+               for t in tgl]
+
+    def fn(*arrays):
+        feeds = dict(zip(feed_vids, arrays[: len(feed_names)]))
+        pvals = dict(zip(pnames, arrays[len(feed_names):]))
+
+        def scalar_loss(diff_arrays):
+            local_feeds = dict(feeds)
+            local_p = dict(pvals)
+            for pos, a in zip(diff_idx, diff_arrays):
+                if pos < len(feed_names):
+                    local_feeds[feed_vids[pos]] = a
+                else:
+                    local_p[pnames[pos - len(feed_names)]] = a
+            env = dict(local_feeds)
+            for st in stmts:
+                leaf_vals = []
+                for kind, ref in st.leaf_refs:
+                    if kind == "v":
+                        leaf_vals.append(env[ref])
+                    elif kind == "p":
+                        leaf_vals.append(local_p[ref])
+                    else:
+                        leaf_vals.append(ref)
+                a_, kw = jax.tree.unflatten(st.treedef, leaf_vals)
+                out = st.fn(*a_, **kw)
+                for v, val in zip(st.out_vids, jax.tree.flatten(out)[0]):
+                    env[v] = val
+            # reference semantics: grads sum over all targets, each with an
+            # implicit all-ones cotangent unless target_gradients given
+            total = 0.0
+            for tvid, tg in zip(target_vids, tgs):
+                out = env[tvid]
+                total = total + (jnp.sum(out * tg) if tg is not None
+                                 else jnp.sum(out))
+            return total
+
+        diff_arrays = [arrays[i] for i in diff_idx]
+        return tuple(jax.grad(scalar_loss)(diff_arrays))
+
+    grads = apply_op("gradients", fn, *arg_tensors)
+    return list(grads) if isinstance(grads, (tuple, list)) else [grads]
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None):
+    """Returns [(param, grad_var)] (reference: paddle.static.append_backward
+    return contract)."""
+    vid = getattr(loss, "_static_vid", None)
+    if vid is None:
+        raise ValueError("append_backward(): loss must be a static var")
+    prog: Program = vid[0]
+    stmts = prog.slice_for({vid[1]})
+    pnames = sorted({ref for st in stmts
+                     for kind, ref in st.leaf_refs if kind == "p"})
+    params = [prog._params[n] for n in pnames
+              if not prog._params[n].stop_gradient]
+    if parameter_list is not None:
+        wanted = {p.name if isinstance(p, Tensor) else p
+                  for p in parameter_list}
+        params = [p for p in params if p.name in wanted]
+    grads = gradients(loss, params)
+    return list(zip(params, grads))

@@ -66,6 +66,7 @@ class Program:
         self._statements: list[Statement] = []
         self._feeds: dict[str, int] = {}
         self._feed_specs: dict[str, tuple] = {}
+        self._feed_tensors: dict[str, Tensor] = {}
         self._params: dict[str, Parameter] = {}
         self._optimizer = None
         self._loss_vid: int | None = None
@@ -104,6 +105,7 @@ class Program:
         self._feeds[name] = vid
         self._feed_specs[name] = (tuple(shape), dtype)
         self._var_names[vid] = name
+        self._feed_tensors[name] = tensor  # for gradients()/append_backward
         self._version += 1
 
     def _set_optimizer(self, optimizer, loss):

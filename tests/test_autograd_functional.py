@@ -104,3 +104,15 @@ def test_hessian_class_flattens(rng):
     assert list(H.shape) == [6, 6]
     np.testing.assert_allclose(np.asarray(H[:]._data), 2 * np.eye(6),
                                rtol=1e-5)
+
+
+def test_batched_jacobian_sees_full_batch(rng):
+    """Regression: func uses the batch dim; per-sample rows must be fed as
+    size-1 batches, not rank-reduced rows."""
+    def f(x):
+        return x.reshape([x.shape[0], -1]).sum(-1)
+
+    x = paddle.to_tensor(rng.randn(5, 3).astype("float32"))
+    J = jacobian(f, x, batch_axis=0)
+    np.testing.assert_allclose(np.asarray(J._data), np.ones((5, 3)),
+                               rtol=1e-6)

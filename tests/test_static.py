@@ -180,3 +180,74 @@ def test_enable_disable_static_mode():
     # eager still works after
     t = paddle.to_tensor(np.ones((2, 2), np.float32))
     assert float((t + 1).sum()) == 8.0
+
+
+def test_gradients_wrt_feed_and_param(rng):
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 3], "float32")
+        layer = nn.Linear(3, 1, bias_attr=False)
+        loss = layer(x).sum()
+        (gx,) = static.gradients(loss, [x])
+        (gw,) = static.gradients(loss, [layer.weight])
+    exe = static.Executor()
+    arr = rng.randn(4, 3).astype("float32")
+    gx_v, gw_v = exe.run(main, feed={"x": arr}, fetch_list=[gx, gw])
+    w = np.asarray(layer.weight._data)
+    # d(sum(xW))/dx = broadcast of W^T rows; d/dW = sum_i x_i outer
+    np.testing.assert_allclose(gx_v, np.tile(w.T, (4, 1)), rtol=1e-5)
+    np.testing.assert_allclose(gw_v, arr.sum(0)[:, None], rtol=1e-5)
+
+
+def test_append_backward_pairs(rng):
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 4], "float32")
+        layer = nn.Linear(4, 2)
+        loss = (layer(x) ** 2).mean()
+        pairs = static.append_backward(loss)
+    names = sorted(p.name for p, _ in pairs)
+    assert len(pairs) == 2  # weight + bias
+    exe = static.Executor()
+    arr = rng.randn(3, 4).astype("float32")
+    fetches = exe.run(main, feed={"x": arr},
+                      fetch_list=[g for _, g in pairs])
+    for g in fetches:
+        assert np.isfinite(g).all()
+
+
+def test_gradients_with_target_gradients(rng):
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [2, 2], "float32")
+        y = x * x
+        (gx,) = static.gradients(y, [x],
+                                 target_gradients=paddle.to_tensor(
+                                     np.array([[1., 0.], [0., 2.]],
+                                              np.float32)))
+    exe = static.Executor()
+    arr = np.array([[3., 4.], [5., 6.]], np.float32)
+    (gv,) = exe.run(main, feed={"x": arr}, fetch_list=[gx])
+    np.testing.assert_allclose(gv, 2 * arr * [[1, 0], [0, 2]], rtol=1e-6)
+
+
+def test_gradients_multi_target_sums(rng):
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [2], "float32")
+        t1 = (x * x).sum()
+        t2 = (x * 3.0).sum()
+        (gx,) = static.gradients([t1, t2], [x])
+    exe = static.Executor()
+    arr = np.array([1.0, 2.0], np.float32)
+    (gv,) = exe.run(main, feed={"x": arr}, fetch_list=[gx])
+    np.testing.assert_allclose(gv, 2 * arr + 3.0)  # sum over both targets
+
+
+def test_gradients_rejects_no_grad_set():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [2], "float32")
+        y = x.sum()
+        with pytest.raises(NotImplementedError):
+            static.gradients(y, [x], no_grad_set={x})
