@@ -130,7 +130,9 @@ def memory_reserved(device=None) -> int:
 
 
 def max_memory_reserved(device=None) -> int:
-    return max_memory_allocated(device)
+    stats = _device_of(device).memory_stats() or {}
+    return int(stats.get("peak_bytes_reserved",
+                         stats.get("peak_bytes_in_use", 0)))
 
 
 def empty_cache():

@@ -43,6 +43,8 @@ class GPTConfig:
     use_flash_attention: bool = True
     # parallel knobs
     tensor_parallel: bool = False  # force TP layers even without fleet
+    recompute: bool = False  # rematerialize blocks in backward (activation
+    # memory ~O(layers*s*h) instead of O(layers*s*4h stacks))
 
     @property
     def ffn_size(self) -> int:
@@ -236,10 +238,17 @@ class GPTModel(Layer):
             past_len = caches[0][0].shape[1]
         x = self.embeddings(input_ids, position_ids, past_len=past_len)
         new_caches = [] if caches is not None else None
+        use_recompute = (getattr(self.config, "recompute", False)
+                         and self.training and caches is None)
+        if use_recompute:
+            from ..distributed.fleet.utils import recompute
+
         for i, layer in enumerate(self.layers):
             if caches is not None:
                 x, c = layer(x, attn_mask=attn_mask, cache=caches[i])
                 new_caches.append(c)
+            elif use_recompute:
+                x = recompute(layer, x, attn_mask=attn_mask)
             else:
                 x = layer(x, attn_mask=attn_mask)
         x = self.ln_f(x)

@@ -4,9 +4,11 @@ Parity target: the reference's FlashAttention GPU kernel surface
 (paddle/phi/kernels/gpu/flash_attn_kernel.cu:128 FlashAttnKernel, registered
 :245, backward flash_attn_grad_kernel.cu) which dispatches to external
 libflashattn. Here the kernel is implemented directly: online-softmax tiling
-(the FlashAttention-2 recurrence) over KV blocks, fp32 accumulators, causal
-masking, and a two-kernel backward (dq; dk/dv) from the saved (out, lse)
-residuals — no S×S materialization in either direction.
+(the FlashAttention-2 recurrence) over KV blocks, bf16 MXU matmuls with fp32
+accumulators, causal masking, and ONE fused backward kernel producing
+dq/dk/dv from the saved (out, lse) residuals (dq lives as a VMEM-resident
+accumulator across k-block grid steps) — no S×S materialization in either
+direction.
 
 Layout: public entry takes paddle layout [batch, seq, heads, head_dim] and
 computes in [batch, heads, seq, head_dim]. K/V live in VMEM per (batch, head)
@@ -34,10 +36,23 @@ BLOCK_Q = 128
 BLOCK_K = 128
 NEG_INF = -1e30
 
+# Explicit DEFAULT precision keeps bf16 operands on the native MXU pass
+# (f32 accumulate via preferred_element_type). Inheriting the framework's
+# global "highest" would force multi-pass fp32 emulation — ~6x slower — and
+# this environment's Mosaic toolchain rejects bf16 dots at non-default
+# contract precision outright.
+_MXU = jax.lax.Precision.DEFAULT
+
+
+def _dotf32(a, b, dims):
+    return jax.lax.dot_general(a, b, (dims, ((), ())),
+                               preferred_element_type=jnp.float32,
+                               precision=_MXU)
+
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal):
     i = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale  # [bq, d]
+    q = q_ref[0]  # [bq, d] kept in input dtype: MXU wants bf16 operands
     seq = k_ref.shape[1]
     num_k = seq // BLOCK_K
     bq, d = q.shape
@@ -46,11 +61,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal):
 
     def body(j, carry):
         m, l, acc = carry
-        k = k_ref[0, pl.ds(j * BLOCK_K, BLOCK_K), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(j * BLOCK_K, BLOCK_K), :].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # [bq, bk]
+        k = k_ref[0, pl.ds(j * BLOCK_K, BLOCK_K), :]
+        v = v_ref[0, pl.ds(j * BLOCK_K, BLOCK_K), :]
+        s = _dotf32(q, k, (((1,), (1,)))) * scale  # [bq, bk] f32
         if causal:
             col_ids = j * BLOCK_K + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, BLOCK_K), 1
@@ -60,7 +73,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal):
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
         l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc = acc * alpha + jnp.dot(p, v, preferred_element_type=jnp.float32)
+        # p cast to the value dtype so the second matmul also rides the MXU
+        acc = acc * alpha + _dotf32(p.astype(v.dtype), v, ((1,), (0,)))
         return m_new, l, acc
 
     # int32 loop bounds: the framework runs with jax_enable_x64, and int64
@@ -75,85 +89,6 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal):
     m, l, acc = jax.lax.fori_loop(jnp.int32(0), upper, body, (m0, l0, acc0))
     o_ref[0] = (acc / l).astype(o_ref.dtype)
     lse_ref[0, 0, :] = (m + jnp.log(l))[:, 0]
-
-
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, scale, causal):
-    i = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)  # [bq, d]
-    do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0, 0, :][:, None]  # [bq, 1]
-    delta = delta_ref[0, 0, :][:, None]
-    seq = k_ref.shape[1]
-    num_k = seq // BLOCK_K
-    bq, d = q.shape
-    row_ids = i * BLOCK_Q + jax.lax.broadcasted_iota(jnp.int32, (bq, BLOCK_K), 0)
-
-    def body(j, dq):
-        k = k_ref[0, pl.ds(j * BLOCK_K, BLOCK_K), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(j * BLOCK_K, BLOCK_K), :].astype(jnp.float32)
-        s = scale * jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        if causal:
-            col_ids = j * BLOCK_K + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, BLOCK_K), 1
-            )
-            s = jnp.where(row_ids >= col_ids, s, NEG_INF)
-        p = jnp.exp(s - lse)  # [bq, bk]
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        ds = p * (dp - delta)
-        return dq + scale * jnp.dot(ds, k, preferred_element_type=jnp.float32)
-
-    if causal:
-        upper = jnp.minimum(num_k, (i + 1) * BLOCK_Q // BLOCK_K).astype(jnp.int32)
-    else:
-        upper = jnp.int32(num_k)
-    dq = jax.lax.fori_loop(jnp.int32(0), upper, body, jnp.zeros((bq, d), jnp.float32))
-    dq_ref[0] = dq.astype(dq_ref.dtype)
-
-
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *, scale, causal):
-    j = pl.program_id(1)
-    k = k_ref[0].astype(jnp.float32)  # [bk, d]
-    v = v_ref[0].astype(jnp.float32)
-    seq = q_ref.shape[1]
-    num_q = seq // BLOCK_Q
-    bk, d = k.shape
-    col_ids = j * BLOCK_K + jax.lax.broadcasted_iota(jnp.int32, (BLOCK_Q, bk), 1)
-
-    def body(i, carry):
-        dk, dv = carry
-        q = q_ref[0, pl.ds(i * BLOCK_Q, BLOCK_Q), :].astype(jnp.float32)
-        do = do_ref[0, pl.ds(i * BLOCK_Q, BLOCK_Q), :].astype(jnp.float32)
-        lse = lse_ref[0, 0, pl.ds(i * BLOCK_Q, BLOCK_Q)][:, None]
-        delta = delta_ref[0, 0, pl.ds(i * BLOCK_Q, BLOCK_Q)][:, None]
-        s = scale * jnp.dot(q, k.T, preferred_element_type=jnp.float32)  # [bq, bk]
-        if causal:
-            row_ids = i * BLOCK_Q + jax.lax.broadcasted_iota(
-                jnp.int32, (BLOCK_Q, bk), 0
-            )
-            s = jnp.where(row_ids >= col_ids, s, NEG_INF)
-        p = jnp.exp(s - lse)
-        dv = dv + jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
-        ds = p * (dp - delta)
-        dk = dk + scale * jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        return dk, dv
-
-    if causal:
-        lower = ((j * BLOCK_K) // BLOCK_Q).astype(jnp.int32)
-    else:
-        lower = jnp.int32(0)
-    z = jnp.zeros((bk, d), jnp.float32)
-    dk, dv = jax.lax.fori_loop(lower, jnp.int32(num_q), body, (z, z))
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
 def _bhsd_specs(seq, d, blocked: bool):
@@ -210,58 +145,98 @@ def _flash_bwd(scale, causal, res, g):
     return flash_bwd_impl(q, k, v, g, lse, delta, scale, causal)
 
 
+def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dq_ref, dk_ref, dv_ref, *, scale, causal):
+    """One kernel for dq/dk/dv. Grid (bh, k-block); dq's block is the FULL
+    [seq, d] fp32 accumulator, whose index map ignores the k-block dim, so
+    Mosaic keeps it VMEM-resident across the inner grid steps and each step
+    accumulates its k-block's contribution (classic TPU FA backward layout;
+    halves the kernel count AND the s/p recomputation of a split dq/dkv
+    pass)."""
+    j = pl.program_id(1)
+    k = k_ref[0]  # [bk, d]
+    v = v_ref[0]
+    seq = q_ref.shape[1]
+    num_q = seq // BLOCK_Q
+    bk, d = k.shape
+    col_ids = j * BLOCK_K + jax.lax.broadcasted_iota(jnp.int32, (BLOCK_Q, bk), 1)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_ref[...] = jnp.zeros_like(dq_ref)
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(i * BLOCK_Q, BLOCK_Q), :]
+        do = do_ref[0, pl.ds(i * BLOCK_Q, BLOCK_Q), :]
+        lse = lse_ref[0, 0, pl.ds(i * BLOCK_Q, BLOCK_Q)][:, None]
+        delta = delta_ref[0, 0, pl.ds(i * BLOCK_Q, BLOCK_Q)][:, None]
+        s = scale * _dotf32(q, k, ((1,), (1,)))  # [bq, bk] f32
+        if causal:
+            row_ids = i * BLOCK_Q + jax.lax.broadcasted_iota(
+                jnp.int32, (BLOCK_Q, bk), 0
+            )
+            s = jnp.where(row_ids >= col_ids, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        pc = p.astype(do.dtype)
+        dv = dv + _dotf32(pc, do, ((0,), (0,)))
+        dp = _dotf32(do, v, ((1,), (1,)))
+        ds = (p * (dp - delta)).astype(q.dtype)
+        dk = dk + scale * _dotf32(ds, q, ((0,), (0,)))
+        dq_blk = dq_ref[0, pl.ds(i * BLOCK_Q, BLOCK_Q), :]
+        dq_ref[0, pl.ds(i * BLOCK_Q, BLOCK_Q), :] = (
+            dq_blk + scale * _dotf32(ds, k, ((1,), (0,))))
+        return dk, dv
+
+    if causal:
+        lower = ((j * BLOCK_K) // BLOCK_Q).astype(jnp.int32)
+    else:
+        lower = jnp.int32(0)
+    z = jnp.zeros((bk, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(lower, jnp.int32(num_q), body, (z, z))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
 def flash_bwd_impl(q, k, v, g, lse, delta, scale, causal):
-    """dq/dk/dv pallas kernels from explicit (lse, delta) residuals.
+    """Fused dq/dk/dv pallas kernel from explicit (lse, delta) residuals.
 
     ``lse``/``delta`` are [bh, 1, seq] fp32. Exposed separately so the ring
-    (context-parallel) backward can drive the same kernels per KV chunk with
+    (context-parallel) backward can drive the same kernel per KV chunk with
     the *globally* combined lse and delta — the blockwise-attention identity
     p = exp(s - lse_global) makes chunk backward exact without per-chunk
     renormalization.
     """
     bh, seq, d = q.shape
-    lse_spec_blocked = pl.BlockSpec((1, 1, BLOCK_Q), lambda b, i: (b, 0, i))
-    lse_spec_full = pl.BlockSpec((1, 1, seq), lambda b, i: (b, 0, 0))
-
-    with jax.enable_x64(False):
-        dq = pl.pallas_call(
-        functools.partial(_dq_kernel, scale=scale, causal=causal),
-        grid=(bh, seq // BLOCK_Q),
-        in_specs=[
-            _bhsd_specs(seq, d, True),   # q block
-            _bhsd_specs(seq, d, False),  # k full
-            _bhsd_specs(seq, d, False),  # v full
-            _bhsd_specs(seq, d, True),   # do block
-            lse_spec_blocked,
-            lse_spec_blocked,
-        ],
-            out_specs=_bhsd_specs(seq, d, True),
-            out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
-            interpret=_interpret(),
-        )(q, k, v, g, lse, delta)
-
+    lse_spec_full = pl.BlockSpec((1, 1, seq), lambda b, j: (b, 0, 0))
     kv_block = pl.BlockSpec((1, BLOCK_K, d), lambda bh_, j: (bh_, j, 0))
     q_full = pl.BlockSpec((1, seq, d), lambda bh_, j: (bh_, 0, 0))
+
     with jax.enable_x64(False):
-        dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, scale=scale, causal=causal),
-        grid=(bh, seq // BLOCK_K),
-        in_specs=[
-            q_full,          # q full
-            kv_block,        # k block
-            kv_block,        # v block
-            q_full,          # do full
-            lse_spec_full,
-            lse_spec_full,
-        ],
-            out_specs=[kv_block, kv_block],
+        dq, dk, dv = pl.pallas_call(
+            functools.partial(_bwd_fused_kernel, scale=scale, causal=causal),
+            grid=(bh, seq // BLOCK_K),
+            in_specs=[
+                q_full,          # q full
+                kv_block,        # k block
+                kv_block,        # v block
+                q_full,          # do full
+                lse_spec_full,
+                lse_spec_full,
+            ],
+            out_specs=[
+                q_full,          # dq accumulator: full seq, j-invariant
+                kv_block,
+                kv_block,
+            ],
             out_shape=[
+                jax.ShapeDtypeStruct(q.shape, jnp.float32),
                 jax.ShapeDtypeStruct(k.shape, k.dtype),
                 jax.ShapeDtypeStruct(v.shape, v.dtype),
             ],
             interpret=_interpret(),
         )(q, k, v, g, lse, delta)
-    return dq, dk, dv
+    return dq.astype(q.dtype), dk, dv
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)

@@ -170,3 +170,24 @@ class TestBert:
         mask = paddle.to_tensor(np.ones((2, 16), "int64"))
         logits = m(ids, attention_mask=mask)
         assert list(logits.shape) == [2, 3]
+
+
+def test_gpt_eager_recompute_matches_plain(rng):
+    """GPTConfig.recompute on the eager model: same numerics, grads flow."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    base = dict(vocab_size=128, hidden_size=64, num_layers=2, num_heads=4,
+                max_seq_len=32)
+    paddle.seed(0)
+    plain = GPTForCausalLM(GPTConfig(**base))
+    paddle.seed(0)
+    rc = GPTForCausalLM(GPTConfig(recompute=True, **base))
+    ids = paddle.to_tensor(rng.randint(0, 128, (2, 16)), "int64")
+    lp = plain(ids, labels=ids)
+    lr = rc(ids, labels=ids)
+    np.testing.assert_allclose(float(lp._data), float(lr._data), rtol=1e-5)
+    lr.backward()
+    assert rc.gpt.layers[0].mlp.fc1.weight.grad is not None
