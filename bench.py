@@ -99,14 +99,18 @@ def main():
     # driver actually provides — this tunnel exposes a v5e)
     peaks = {"TPU v5 lite": 197e12, "TPU v5e": 197e12, "TPU v5p": 459e12,
              "TPU v4": 275e12, "TPU v6 lite": 918e12}
-    peak = next((v for k, v in peaks.items() if k in kind), 197e12) if on_tpu else 1e12
+    matched = next((k for k in peaks if k in kind), None) if on_tpu else None
+    peak = peaks[matched] if matched else (197e12 if on_tpu else 1e12)
+    # surface the denominator in the metric so an unmatched device_kind
+    # (silent v5e fallback) is auditable from the output alone
+    chip = matched or (f"unknown:{kind}" if on_tpu else "cpu")
     mfu = tps * flops_per_token / peak
 
     assert np.all(np.isfinite(first_losses)), "non-finite training loss"
     print(
         json.dumps(
             {
-                "metric": f"gpt3-125m fused train step tokens/sec/chip (bs{batch} seq{seq}, {platform})",
+                "metric": f"gpt3-125m fused train step tokens/sec/chip (bs{batch} seq{seq}, {chip})",
                 "value": round(tps, 1),
                 "unit": "tokens/s",
                 "vs_baseline": round(mfu, 4),

@@ -97,6 +97,8 @@ def __getattr__(name):
         "audio",
         "text",
         "onnx",
+        "signal",
+        "geometric",
     }
     if name in _subpackages:
         return _importlib.import_module(f".{name}", __name__)

@@ -1,0 +1,82 @@
+"""paddle.geometric parity: graph message passing + segment ops.
+
+Reference: python/paddle/geometric (send_u_recv, send_ue_recv,
+send_uv, segment_sum/mean/max/min, reindex_graph, sample_neighbors).
+TPU-native: message passing is gather + segment-reduce — XLA scatter
+kernels; the segment ops re-export the incubate implementations.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..autograd.engine import apply_op
+from ..incubate import segment_max, segment_mean, segment_min, segment_sum
+from ..tensor.tensor import Tensor
+
+_REDUCERS = {
+    "sum": jax.ops.segment_sum,
+    "mean": None,  # handled specially
+    "max": jax.ops.segment_max,
+    "min": jax.ops.segment_min,
+}
+
+
+def _segment_reduce(vals, dst, num, pool_type):
+    if pool_type == "mean":
+        s = jax.ops.segment_sum(vals, dst, num_segments=num)
+        cnt = jax.ops.segment_sum(jnp.ones((vals.shape[0],) + (1,) * (vals.ndim - 1),
+                                           vals.dtype), dst, num_segments=num)
+        return s / jnp.maximum(cnt, 1)
+    red = _REDUCERS[pool_type]
+    out = red(vals, dst, num_segments=num)
+    if pool_type in ("max", "min"):
+        # empty segments produce +-inf; the reference zero-fills them
+        out = jnp.where(jnp.isfinite(out), out, 0.0)
+    return out
+
+
+def send_u_recv(x: Tensor, src_index: Tensor, dst_index: Tensor,
+                reduce_op: str = "sum", out_size=None, name=None):
+    """Gather x[src] along edges, reduce at dst (reference:
+    geometric/message_passing/send_recv.py send_u_recv)."""
+    num = int(out_size) if out_size is not None else int(x.shape[0])
+
+    def fn(xd, src, dst):
+        return _segment_reduce(xd[src], dst, num, reduce_op)
+
+    return apply_op("send_u_recv", fn, x, src_index, dst_index)
+
+
+def send_ue_recv(x: Tensor, y: Tensor, src_index: Tensor, dst_index: Tensor,
+                 message_op: str = "add", reduce_op: str = "sum",
+                 out_size=None, name=None):
+    """Combine node features x[src] with edge features y, reduce at dst."""
+    num = int(out_size) if out_size is not None else int(x.shape[0])
+    combine = {
+        "add": jnp.add, "sub": jnp.subtract,
+        "mul": jnp.multiply, "div": jnp.divide,
+    }[message_op]
+
+    def fn(xd, yd, src, dst):
+        return _segment_reduce(combine(xd[src], yd), dst, num, reduce_op)
+
+    return apply_op("send_ue_recv", fn, x, y, src_index, dst_index)
+
+
+def send_uv(x: Tensor, y: Tensor, src_index: Tensor, dst_index: Tensor,
+            message_op: str = "add", name=None):
+    """Per-edge message x[src] op y[dst] (reference send_uv)."""
+    combine = {
+        "add": jnp.add, "sub": jnp.subtract,
+        "mul": jnp.multiply, "div": jnp.divide,
+    }[message_op]
+
+    def fn(xd, yd, src, dst):
+        return combine(xd[src], yd[dst])
+
+    return apply_op("send_uv", fn, x, y, src_index, dst_index)
+
+
+__all__ = ["send_u_recv", "send_ue_recv", "send_uv", "segment_sum",
+           "segment_mean", "segment_max", "segment_min"]

@@ -1,5 +1,5 @@
 """paddle.nn parity namespace."""
-from . import functional, initializer
+from . import functional, initializer, utils
 from .clip import (
     ClipGradByGlobalNorm,
     ClipGradByNorm,

@@ -1,0 +1,174 @@
+"""paddle.nn.utils parity: grad clipping helpers, parameter vectorization,
+weight/spectral norm.
+
+Reference: python/paddle/nn/utils/{clip_grad_norm_.py,
+clip_grad_value_.py, transform_parameters.py, weight_norm_hook.py,
+spectral_norm_hook.py}.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..autograd.grad_mode import no_grad
+from ..tensor.tensor import Tensor
+
+
+@no_grad()
+def clip_grad_norm_(parameters, max_norm: float, norm_type: float = 2.0,
+                    error_if_nonfinite: bool = False):
+    """In-place global-norm gradient clip; returns the total norm."""
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    grads = [p.grad for p in parameters if p.grad is not None]
+    if not grads:
+        return Tensor(jnp.zeros((), jnp.float32))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack(
+            [jnp.abs(g._data).max() for g in grads]))
+    else:
+        total = jnp.sum(jnp.stack(
+            [jnp.sum(jnp.abs(g._data.astype(jnp.float32)) ** norm_type)
+             for g in grads])) ** (1.0 / norm_type)
+    if error_if_nonfinite and not bool(jnp.isfinite(total)):
+        raise RuntimeError(
+            f"the total norm of {norm_type}-order is non-finite")
+    scale = jnp.clip(max_norm / (total + 1e-6), a_max=1.0)
+    for g in grads:
+        g._data = (g._data.astype(jnp.float32) * scale).astype(g._data.dtype)
+    return Tensor(total)
+
+
+@no_grad()
+def clip_grad_value_(parameters, clip_value: float):
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    for p in parameters:
+        if p.grad is not None:
+            p.grad._data = jnp.clip(p.grad._data, -clip_value, clip_value)
+
+
+@no_grad()
+def parameters_to_vector(parameters, name=None) -> Tensor:
+    return Tensor(jnp.concatenate(
+        [p._data.reshape(-1) for p in parameters]))
+
+
+@no_grad()
+def vector_to_parameters(vec: Tensor, parameters, name=None):
+    offset = 0
+    for p in parameters:
+        n = int(p._data.size)
+        p._data = vec._data[offset: offset + n].reshape(p._data.shape).astype(
+            p._data.dtype)
+        offset += n
+
+
+def _l2_normalize(v, eps=1e-12):
+    return v / (jnp.linalg.norm(v) + eps)
+
+
+def weight_norm(layer, name: str = "weight", dim: int = 0):
+    """Reparametrize ``layer.<name>`` as g * v/||v|| (reference
+    weight_norm_hook). Adds <name>_g and <name>_v parameters and a
+    pre-forward hook recomputing the weight."""
+    from .layer.layers import Layer
+
+    assert isinstance(layer, Layer)
+    w = getattr(layer, name)
+    axes = tuple(i for i in range(w._data.ndim) if i != dim)
+    g0 = jnp.sqrt(jnp.sum(jnp.square(w._data.astype(jnp.float32)),
+                          axis=axes, keepdims=True))
+    from ..tensor.tensor import Parameter
+
+    g = Parameter(g0.astype(w._data.dtype), name=f"{w.name}_g")
+    v = Parameter(w._data, name=f"{w.name}_v")
+    layer.add_parameter(f"{name}_g", g)
+    layer.add_parameter(f"{name}_v", v)
+    if name in layer._parameters:
+        del layer._parameters[name]
+
+    def recompute(l, inputs):
+        from ..autograd.engine import apply_op
+
+        def fn(gd, vd):
+            norm = jnp.sqrt(jnp.sum(
+                jnp.square(vd.astype(jnp.float32)), axis=axes,
+                keepdims=True)) + 1e-12
+            return (vd.astype(jnp.float32) / norm * gd.astype(jnp.float32)
+                    ).astype(vd.dtype)
+
+        setattr(l, name, apply_op("weight_norm", fn, g, v))
+        return inputs
+
+    handle = layer.register_forward_pre_hook(recompute)
+    layer._weight_norm_hook = handle
+    recompute(layer, ())
+    return layer
+
+
+def remove_weight_norm(layer, name: str = "weight"):
+    handle = getattr(layer, "_weight_norm_hook", None)
+    if handle is not None:
+        handle.remove()
+    w = getattr(layer, name)
+    from ..tensor.tensor import Parameter
+
+    layer.add_parameter(name, Parameter(w._data, name=name))
+    for suffix in ("_g", "_v"):
+        layer._parameters.pop(f"{name}{suffix}", None)
+    return layer
+
+
+def spectral_norm(layer, name: str = "weight", n_power_iterations: int = 1,
+                  eps: float = 1e-12, dim: int | None = None):
+    """Reparametrize weight as W / sigma_max(W), sigma estimated by power
+    iteration (reference spectral_norm_hook)."""
+    from .layer.layers import Layer
+
+    assert isinstance(layer, Layer)
+    w = getattr(layer, name)
+    if dim is None:
+        dim = 0
+    wm = jnp.moveaxis(w._data, dim, 0).reshape(w._data.shape[dim], -1)
+    import numpy as np
+
+    rng = np.random.RandomState(0)
+    state = {
+        "u": _l2_normalize(jnp.asarray(
+            rng.randn(wm.shape[0]), jnp.float32)),
+    }
+
+    def recompute(l, inputs):
+        from ..autograd.engine import apply_op
+
+        wt = getattr(l, f"{name}_orig")
+
+        def fn(wd):
+            m = jnp.moveaxis(wd.astype(jnp.float32), dim, 0)
+            m2 = m.reshape(m.shape[0], -1)
+            u = state["u"]
+            for _ in range(n_power_iterations):
+                v = _l2_normalize(m2.T @ u, eps)
+                u = _l2_normalize(m2 @ v, eps)
+            sigma = u @ (m2 @ v)
+            return (wd.astype(jnp.float32) / sigma).astype(wd.dtype)
+
+        setattr(l, name, apply_op("spectral_norm", fn, wt))
+        return inputs
+
+    from ..tensor.tensor import Parameter
+
+    layer.add_parameter(f"{name}_orig", Parameter(w._data,
+                                                  name=f"{w.name}_orig"))
+    if name in layer._parameters:
+        del layer._parameters[name]
+    handle = layer.register_forward_pre_hook(recompute)
+    layer._spectral_norm_hook = handle
+    recompute(layer, ())
+    return layer
+
+
+__all__ = ["clip_grad_norm_", "clip_grad_value_", "parameters_to_vector",
+           "vector_to_parameters", "weight_norm", "remove_weight_norm",
+           "spectral_norm"]

@@ -12,8 +12,11 @@ direction.
 
 Layout: public entry takes paddle layout [batch, seq, heads, head_dim] and
 computes in [batch, heads, seq, head_dim]. K/V live in VMEM per (batch, head)
-program — fine up to ~16k tokens at head_dim 128; longer sequences should use
-the ring/blockwise path (distributed sequence parallelism) on top.
+program; the fused backward additionally keeps full-seq q, do, and an fp32 dq
+accumulator resident (~16.5MB at seq 16k, head_dim 128), so backward bounds
+the practical single-kernel length at ~8-12k tokens at head_dim 128; longer
+sequences should use the ring/blockwise path (distributed sequence
+parallelism) on top.
 """
 from __future__ import annotations
 

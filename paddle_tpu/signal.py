@@ -1,0 +1,121 @@
+"""paddle.signal parity: frame / overlap_add / stft / istft.
+
+Reference: python/paddle/signal.py (stft returns complex
+[..., n_fft//2+1, num_frames] with center padding; istft inverts with
+window-envelope normalization). All jnp — jits onto TPU; the framing is a
+strided gather like audio.features, shared contract with the reference.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .autograd.engine import apply_op
+from .tensor.tensor import Tensor
+
+
+def frame(x: Tensor, frame_length: int, hop_length: int, axis: int = -1):
+    """Slice into overlapping frames: [..., T] -> [..., frame_length,
+    num_frames] (axis=-1, reference default)."""
+
+    def fn(v):
+        T = v.shape[-1]
+        n = 1 + (T - frame_length) // hop_length
+        idx = (jnp.arange(n)[:, None] * hop_length
+               + jnp.arange(frame_length)[None, :])
+        frames = v[..., idx]  # [..., n, frame_length]
+        return jnp.swapaxes(frames, -1, -2)  # [..., frame_length, n]
+
+    return apply_op("frame", fn, x)
+
+
+def overlap_add(x: Tensor, hop_length: int, axis: int = -1):
+    """Inverse of frame: [..., frame_length, n] -> [..., T]."""
+
+    def fn(v):
+        fl, n = v.shape[-2], v.shape[-1]
+        T = (n - 1) * hop_length + fl
+        out_shape = v.shape[:-2] + (T,)
+        out = jnp.zeros(out_shape, v.dtype)
+        idx = (jnp.arange(n)[:, None] * hop_length
+               + jnp.arange(fl)[None, :])  # [n, fl]
+        return out.at[..., idx].add(jnp.swapaxes(v, -1, -2))
+
+    return apply_op("overlap_add", fn, x)
+
+
+def stft(x: Tensor, n_fft: int, hop_length: int | None = None,
+         win_length: int | None = None, window: Tensor | None = None,
+         center: bool = True, pad_mode: str = "reflect",
+         normalized: bool = False, onesided: bool = True, name=None):
+    """[..., T] -> complex [..., freq, frames] (reference signal.stft)."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    wdata = window._data if isinstance(window, Tensor) else window
+
+    def fn(v, w):
+        if center:
+            pad = [(0, 0)] * (v.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+            v = jnp.pad(v, pad, mode=pad_mode)
+        T = v.shape[-1]
+        n = 1 + (T - n_fft) // hop_length
+        idx = (jnp.arange(n)[:, None] * hop_length
+               + jnp.arange(n_fft)[None, :])
+        frames = v[..., idx]  # [..., n, n_fft]
+        if w is None:
+            w = jnp.ones(win_length, v.dtype)
+        if win_length < n_fft:
+            lpad = (n_fft - win_length) // 2
+            w = jnp.pad(w, (lpad, n_fft - win_length - lpad))
+        spec = (jnp.fft.rfft(frames * w, n=n_fft, axis=-1) if onesided
+                else jnp.fft.fft(frames * w, n=n_fft, axis=-1))
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        return jnp.moveaxis(spec, -1, -2)  # [..., freq, frames]
+
+    return apply_op("stft", fn, x, wdata)
+
+
+def istft(x: Tensor, n_fft: int, hop_length: int | None = None,
+          win_length: int | None = None, window: Tensor | None = None,
+          center: bool = True, normalized: bool = False,
+          onesided: bool = True, length: int | None = None,
+          return_complex: bool = False, name=None):
+    """Inverse STFT with window-envelope normalization (reference
+    signal.istft)."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    wdata = window._data if isinstance(window, Tensor) else window
+
+    def fn(spec, w):
+        spec = jnp.moveaxis(spec, -2, -1)  # [..., frames, freq]
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        frames = (jnp.fft.irfft(spec, n=n_fft, axis=-1) if onesided
+                  else jnp.fft.ifft(spec, n=n_fft, axis=-1).real)
+        if w is None:
+            w = jnp.ones(win_length, frames.dtype)
+        if win_length < n_fft:
+            lpad = (n_fft - win_length) // 2
+            w = jnp.pad(w, (lpad, n_fft - win_length - lpad))
+        frames = frames * w
+        n = frames.shape[-2]
+        T = (n - 1) * hop_length + n_fft
+        idx = (jnp.arange(n)[:, None] * hop_length
+               + jnp.arange(n_fft)[None, :])
+        out = jnp.zeros(frames.shape[:-2] + (T,), frames.dtype)
+        out = out.at[..., idx].add(frames)
+        # window-envelope normalization (COLA division)
+        env = jnp.zeros((T,), frames.dtype)
+        env = env.at[idx.reshape(-1)].add(jnp.tile(w * w, n))
+        out = out / jnp.maximum(env, 1e-11)
+        if center:
+            out = out[..., n_fft // 2: T - n_fft // 2]
+        if length is not None:
+            out = out[..., :length]
+        return out
+
+    return apply_op("istft", fn, x, wdata)
+
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]

@@ -1,0 +1,189 @@
+"""paddle.vision.ops parity: detection-model operators.
+
+Reference: python/paddle/vision/ops.py (nms, roi_align, roi_pool, box
+utilities) over phi detection kernels. TPU stance: NMS is an
+O(N^2)-mask + sequential-suppression lax.while; RoI ops are bilinear
+gathers — all static-shaped, jittable.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..autograd.engine import apply_op
+from ..tensor.tensor import Tensor
+
+
+def box_iou(boxes1, boxes2):
+    """Pairwise IoU for [N,4] / [M,4] xyxy boxes -> [N, M]."""
+
+    def fn(b1, b2):
+        area1 = (b1[:, 2] - b1[:, 0]) * (b1[:, 3] - b1[:, 1])
+        area2 = (b2[:, 2] - b2[:, 0]) * (b2[:, 3] - b2[:, 1])
+        lt = jnp.maximum(b1[:, None, :2], b2[None, :, :2])
+        rb = jnp.minimum(b1[:, None, 2:], b2[None, :, 2:])
+        wh = jnp.clip(rb - lt, 0)
+        inter = wh[..., 0] * wh[..., 1]
+        return inter / (area1[:, None] + area2[None, :] - inter + 1e-10)
+
+    return apply_op("box_iou", fn, boxes1, boxes2)
+
+
+def nms(boxes, iou_threshold: float = 0.3, scores=None,
+        category_idxs=None, categories=None, top_k: int | None = None):
+    """Greedy NMS (reference: vision/ops.py nms). Returns kept indices
+    sorted by score. With category_idxs, suppression is per-category
+    (batched NMS trick: offset boxes per class so classes never overlap).
+    """
+    import numpy as np
+
+    def fn(b, s, cat):
+        n = b.shape[0]
+        if s is None:
+            order = jnp.arange(n)
+        else:
+            order = jnp.argsort(-s)
+        bb = b[order]
+        if cat is not None:
+            # shift each category into its own coordinate island
+            span = jnp.max(bb) - jnp.min(bb) + 1.0
+            offs = cat[order].astype(bb.dtype)[:, None] * span
+            bb = bb + offs
+        area = (bb[:, 2] - bb[:, 0]) * (bb[:, 3] - bb[:, 1])
+        lt = jnp.maximum(bb[:, None, :2], bb[None, :, :2])
+        rb = jnp.minimum(bb[:, None, 2:], bb[None, :, 2:])
+        wh = jnp.clip(rb - lt, 0)
+        inter = wh[..., 0] * wh[..., 1]
+        iou = inter / (area[:, None] + area[None, :] - inter + 1e-10)
+
+        def body(i, keep):
+            # suppress i if any still-kept higher-score box overlaps it
+            sup = jnp.any((iou[i, :] > iou_threshold)
+                          & keep & (jnp.arange(n) < i))
+            return keep.at[i].set(~sup)
+
+        keep = jax.lax.fori_loop(0, n, body, jnp.ones(n, bool))
+        return order, keep
+
+    b = boxes._data if isinstance(boxes, Tensor) else jnp.asarray(boxes)
+    s = scores._data if isinstance(scores, Tensor) else scores
+    c = (category_idxs._data if isinstance(category_idxs, Tensor)
+         else category_idxs)
+    order, keep = fn(b, None if s is None else jnp.asarray(s),
+                     None if c is None else jnp.asarray(c))
+    # keep refers to sorted positions; map back to original indices
+    kept = np.asarray(order)[np.asarray(keep)]
+    if top_k is not None:
+        kept = kept[:top_k]
+    return Tensor(jnp.asarray(kept, jnp.int64))
+
+
+def _bilinear_sample(feat, y, x):
+    """feat [C, H, W]; y/x arbitrary same-shaped grids -> [C, *grid]."""
+    H, W = feat.shape[-2:]
+    y0 = jnp.clip(jnp.floor(y), 0, H - 1)
+    x0 = jnp.clip(jnp.floor(x), 0, W - 1)
+    y1 = jnp.clip(y0 + 1, 0, H - 1)
+    x1 = jnp.clip(x0 + 1, 0, W - 1)
+    wy = jnp.clip(y - y0, 0, 1)
+    wx = jnp.clip(x - x0, 0, 1)
+    y0i, y1i, x0i, x1i = (a.astype(jnp.int32) for a in (y0, y1, x0, x1))
+    v00 = feat[:, y0i, x0i]
+    v01 = feat[:, y0i, x1i]
+    v10 = feat[:, y1i, x0i]
+    v11 = feat[:, y1i, x1i]
+    return (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx
+            + v10 * wy * (1 - wx) + v11 * wy * wx)
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale: float = 1.0,
+              sampling_ratio: int = -1, aligned: bool = True):
+    """RoIAlign (reference: vision/ops.py roi_align). x [N,C,H,W]; boxes
+    [R,4] xyxy in input coords; boxes_num [N] rois per image. Returns
+    [R, C, out_h, out_w]."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+    ratio = 2 if sampling_ratio <= 0 else sampling_ratio
+
+    def fn(feat, rois, rois_num):
+        # map each roi to its batch index from boxes_num
+        R = rois.shape[0]
+        starts = jnp.cumsum(rois_num) - rois_num
+        batch_idx = jnp.sum(
+            (jnp.arange(R)[:, None] >= starts[None, :]).astype(jnp.int32),
+            axis=1) - 1
+
+        offset = 0.5 if aligned else 0.0
+
+        def one(roi, bi):
+            x1, y1, x2, y2 = (roi * spatial_scale) - offset
+            rw = jnp.maximum(x2 - x1, 1e-3)
+            rh = jnp.maximum(y2 - y1, 1e-3)
+            bin_h, bin_w = rh / oh, rw / ow
+            # sampling grid: ratio x ratio points per bin, averaged
+            gy = (y1 + (jnp.arange(oh * ratio) + 0.5) * bin_h / ratio)
+            gx = (x1 + (jnp.arange(ow * ratio) + 0.5) * bin_w / ratio)
+            yy, xx = jnp.meshgrid(gy, gx, indexing="ij")
+            sampled = _bilinear_sample(feat[bi], yy, xx)  # [C, oh*r, ow*r]
+            C = sampled.shape[0]
+            pooled = sampled.reshape(C, oh, ratio, ow, ratio).mean((2, 4))
+            return pooled
+
+        return jax.vmap(one)(rois, batch_idx)
+
+    return apply_op("roi_align", fn, x, boxes, boxes_num)
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale: float = 1.0):
+    """RoIPool: max over each bin (reference roi_pool). Approximated on a
+    dense sampling grid (4x4 per bin) for static shapes."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+    ratio = 4
+
+    def fn(feat, rois, rois_num):
+        R = rois.shape[0]
+        starts = jnp.cumsum(rois_num) - rois_num
+        batch_idx = jnp.sum(
+            (jnp.arange(R)[:, None] >= starts[None, :]).astype(jnp.int32),
+            axis=1) - 1
+
+        def one(roi, bi):
+            x1, y1, x2, y2 = roi * spatial_scale
+            rw = jnp.maximum(x2 - x1, 1e-3)
+            rh = jnp.maximum(y2 - y1, 1e-3)
+            gy = y1 + (jnp.arange(oh * ratio) + 0.5) * rh / (oh * ratio)
+            gx = x1 + (jnp.arange(ow * ratio) + 0.5) * rw / (ow * ratio)
+            yy, xx = jnp.meshgrid(gy, gx, indexing="ij")
+            sampled = _bilinear_sample(feat[bi], yy, xx)
+            C = sampled.shape[0]
+            return sampled.reshape(C, oh, ratio, ow, ratio).max((2, 4))
+
+        return jax.vmap(one)(rois, batch_idx)
+
+    return apply_op("roi_pool", fn, x, boxes, boxes_num)
+
+
+class RoIAlign:
+    def __init__(self, output_size, spatial_scale=1.0):
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return roi_align(x, boxes, boxes_num, self._output_size,
+                         self._spatial_scale)
+
+
+class RoIPool:
+    def __init__(self, output_size, spatial_scale=1.0):
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self._output_size,
+                        self._spatial_scale)
+
+
+__all__ = ["box_iou", "nms", "roi_align", "roi_pool", "RoIAlign", "RoIPool"]

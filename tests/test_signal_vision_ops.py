@@ -1,0 +1,170 @@
+"""signal (stft/istft round-trip vs oracle), vision.ops (nms vs brute
+force, roi_align properties), nn.utils (clip/vector/weight/spectral norm),
+geometric message passing."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import geometric, nn, signal
+from paddle_tpu.vision import ops as vops
+
+
+def test_frame_overlap_add_roundtrip(rng):
+    x = paddle.to_tensor(rng.randn(2, 64).astype("float32"))
+    f = signal.frame(x, frame_length=16, hop_length=16)  # non-overlapping
+    assert f.shape == [2, 16, 4]
+    back = signal.overlap_add(f, hop_length=16)
+    np.testing.assert_allclose(np.asarray(back._data),
+                               np.asarray(x._data), rtol=1e-6)
+
+
+def test_stft_matches_numpy(rng):
+    x = rng.randn(128).astype("float32")
+    out = signal.stft(paddle.to_tensor(x[None]), n_fft=32, hop_length=8,
+                      center=False)
+    # numpy oracle with matching hann window... default window is None=ones
+    frames = np.stack([x[i * 8: i * 8 + 32]
+                       for i in range(1 + (128 - 32) // 8)])
+    want = np.fft.rfft(frames, axis=-1).T  # [freq, frames]
+    got = np.asarray(out._data)[0]
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_stft_istft_roundtrip(rng):
+    x = rng.randn(1, 512).astype("float32")
+    from paddle_tpu.audio.functional import get_window
+
+    w = get_window("hann", 64)
+    spec = signal.stft(paddle.to_tensor(x), n_fft=64, hop_length=16,
+                       window=w, center=True)
+    back = signal.istft(spec, n_fft=64, hop_length=16, window=w,
+                        center=True, length=512)
+    np.testing.assert_allclose(np.asarray(back._data), x, atol=1e-4)
+
+
+def _brute_nms(boxes, scores, thr):
+    order = np.argsort(-scores)
+    keep = []
+    sup = np.zeros(len(boxes), bool)
+    for i in order:
+        if sup[i]:
+            continue
+        keep.append(i)
+        for j in order:
+            if sup[j] or j == i:
+                continue
+            # iou
+            lt = np.maximum(boxes[i, :2], boxes[j, :2])
+            rb = np.minimum(boxes[i, 2:], boxes[j, 2:])
+            wh = np.clip(rb - lt, 0, None)
+            inter = wh[0] * wh[1]
+            a1 = np.prod(boxes[i, 2:] - boxes[i, :2])
+            a2 = np.prod(boxes[j, 2:] - boxes[j, :2])
+            if inter / (a1 + a2 - inter + 1e-10) > thr:
+                sup[j] = True
+    return keep
+
+
+def test_nms_matches_bruteforce(rng):
+    boxes = rng.rand(20, 4).astype("float32") * 50
+    boxes[:, 2:] = boxes[:, :2] + 5 + rng.rand(20, 2).astype("float32") * 20
+    scores = rng.rand(20).astype("float32")
+    got = np.asarray(vops.nms(paddle.to_tensor(boxes), 0.4,
+                              scores=paddle.to_tensor(scores))._data)
+    want = _brute_nms(boxes, scores, 0.4)
+    assert list(got) == want
+
+
+def test_box_iou_identity(rng):
+    b = np.array([[0, 0, 10, 10], [5, 5, 15, 15]], np.float32)
+    iou = np.asarray(vops.box_iou(paddle.to_tensor(b),
+                                  paddle.to_tensor(b))._data)
+    np.testing.assert_allclose(np.diag(iou), 1.0, rtol=1e-5)
+    np.testing.assert_allclose(iou[0, 1], 25 / 175, rtol=1e-4)
+
+
+def test_roi_align_constant_feature(rng):
+    # constant feature map -> every pooled value equals the constant
+    feat = paddle.to_tensor(np.full((1, 3, 16, 16), 7.0, np.float32))
+    boxes = paddle.to_tensor(np.array([[2, 2, 10, 10]], np.float32))
+    num = paddle.to_tensor(np.array([1], np.int32))
+    out = vops.roi_align(feat, boxes, num, output_size=4)
+    assert out.shape == [1, 3, 4, 4]
+    np.testing.assert_allclose(np.asarray(out._data), 7.0, rtol=1e-5)
+
+
+def test_roi_pool_takes_max(rng):
+    feat_np = np.zeros((1, 1, 8, 8), np.float32)
+    feat_np[0, 0, 5, 5] = 9.0  # on the 4x-oversampling grid for out=1
+    out = vops.roi_pool(paddle.to_tensor(feat_np),
+                        paddle.to_tensor(np.array([[0, 0, 8, 8]], np.float32)),
+                        paddle.to_tensor(np.array([1], np.int32)),
+                        output_size=1)
+    assert float(out._data.max()) > 5.0  # bilinear-sampled near-peak max
+
+
+def test_clip_grad_norm_(rng):
+    p = paddle.to_tensor(rng.randn(4, 4).astype("float32"))
+    p.stop_gradient = False
+    (p * 100).sum().backward()
+    total = nn.utils.clip_grad_norm_([p], max_norm=1.0)
+    gnorm = float(np.linalg.norm(np.asarray(p.grad._data)))
+    assert abs(gnorm - 1.0) < 1e-3
+    assert float(total._data) > 1.0  # pre-clip norm was large
+
+
+def test_parameters_vector_roundtrip(rng):
+    layer = nn.Linear(3, 5)
+    vec = nn.utils.parameters_to_vector(layer.parameters())
+    assert vec.shape == [3 * 5 + 5]
+    doubled = paddle.to_tensor(np.asarray(vec._data) * 2)
+    nn.utils.vector_to_parameters(doubled, layer.parameters())
+    np.testing.assert_allclose(np.asarray(
+        nn.utils.parameters_to_vector(layer.parameters())._data),
+        np.asarray(vec._data) * 2, rtol=1e-6)
+
+
+def test_weight_norm_preserves_forward(rng):
+    paddle.seed(0)
+    layer = nn.Linear(6, 4)
+    x = paddle.to_tensor(rng.randn(2, 6).astype("float32"))
+    want = np.asarray(layer(x)._data)
+    nn.utils.weight_norm(layer, "weight")
+    got = np.asarray(layer(x)._data)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+    assert any(n.endswith("weight_g") for n, _ in layer.named_parameters())
+    nn.utils.remove_weight_norm(layer, "weight")
+    np.testing.assert_allclose(np.asarray(layer(x)._data), want, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_spectral_norm_bounds_sigma(rng):
+    paddle.seed(0)
+    layer = nn.Linear(8, 8)
+    layer.weight.set_value(np.asarray(layer.weight._data) * 10)
+    nn.utils.spectral_norm(layer, "weight", n_power_iterations=5)
+    x = paddle.to_tensor(rng.randn(2, 8).astype("float32"))
+    layer(x)  # triggers recompute
+    sigma = np.linalg.svd(np.asarray(layer.weight._data), compute_uv=False)[0]
+    np.testing.assert_allclose(sigma, 1.0, rtol=0.05)
+
+
+def test_geometric_send_u_recv(rng):
+    x = paddle.to_tensor(np.array([[1.0], [2.0], [3.0]], np.float32))
+    src = paddle.to_tensor(np.array([0, 1, 2, 0]))
+    dst = paddle.to_tensor(np.array([1, 2, 1, 0]))
+    out = np.asarray(geometric.send_u_recv(x, src, dst, "sum")._data)
+    np.testing.assert_allclose(out, [[1.0], [4.0], [2.0]])
+    out_max = np.asarray(geometric.send_u_recv(x, src, dst, "max")._data)
+    np.testing.assert_allclose(out_max, [[1.0], [3.0], [2.0]])
+
+
+def test_geometric_send_ue_recv_and_uv(rng):
+    x = paddle.to_tensor(np.array([[1.0], [2.0]], np.float32))
+    e = paddle.to_tensor(np.array([[10.0], [20.0]], np.float32))
+    src = paddle.to_tensor(np.array([0, 1]))
+    dst = paddle.to_tensor(np.array([1, 0]))
+    out = np.asarray(geometric.send_ue_recv(x, e, src, dst, "add", "sum")._data)
+    np.testing.assert_allclose(out, [[22.0], [11.0]])
+    uv = np.asarray(geometric.send_uv(x, x, src, dst, "mul")._data)
+    np.testing.assert_allclose(uv, [[2.0], [2.0]])
