@@ -24,6 +24,30 @@ _cache: dict[str, ctypes.CDLL] = {}
 _CXXFLAGS = ["-O2", "-std=c++17", "-fPIC", "-shared", "-pthread", "-Wall"]
 
 
+def compile_shared_lib(sources, so: str, extra_flags=(), verbose=False):
+    """g++-compile ``sources`` into ``so`` if any source is newer.
+
+    Shared by the built-in native services and the custom-op extension
+    builder (utils/cpp_extension). Concurrency-safe across processes: the
+    tmp file is pid-suffixed and os.replace is atomic, so parallel builders
+    each produce a complete .so and the last replace wins.
+    """
+    sources = [sources] if isinstance(sources, str) else list(sources)
+    newest = max(os.path.getmtime(s) for s in sources)
+    if os.path.exists(so) and os.path.getmtime(so) >= newest:
+        return so
+    tmp = so + f".tmp{os.getpid()}"
+    cmd = ["g++", *_CXXFLAGS, *extra_flags, "-o", tmp, *sources]
+    if verbose:
+        print(" ".join(cmd))
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"native build failed: {' '.join(cmd)}\n{proc.stderr}")
+    os.replace(tmp, so)  # atomic vs concurrent builders
+    return so
+
+
 def load_library(name: str) -> ctypes.CDLL:
     """Compile (if needed) and dlopen ``native/<name>.cc`` -> ``lib<name>.so``."""
     with _lock:
@@ -34,15 +58,7 @@ def load_library(name: str) -> ctypes.CDLL:
             raise FileNotFoundError(src)
         os.makedirs(_BUILD_DIR, exist_ok=True)
         so = os.path.join(_BUILD_DIR, f"lib{name}.so")
-        if not os.path.exists(so) or os.path.getmtime(so) < os.path.getmtime(src):
-            tmp = so + f".tmp{os.getpid()}"
-            cmd = ["g++", *_CXXFLAGS, "-o", tmp, src]
-            proc = subprocess.run(cmd, capture_output=True, text=True)
-            if proc.returncode != 0:
-                raise RuntimeError(
-                    f"native build failed: {' '.join(cmd)}\n{proc.stderr}"
-                )
-            os.replace(tmp, so)  # atomic vs concurrent builders
+        compile_shared_lib([src], so)
         lib = ctypes.CDLL(so)
         _cache[name] = lib
         return lib

@@ -1,0 +1,54 @@
+/* Custom-op C ABI (reference parity: paddle's custom operator C API —
+ * phi/capi/include/c_tensor.h + fluid/framework/custom_operator.cc, the
+ * out-of-tree op plugin mechanism, SURVEY.md §2.1).
+ *
+ * A custom op library exports, per op:
+ *   void <name>_forward(const PD_CTensor* ins, int n_in,
+ *                       PD_CTensor* outs, int n_out);
+ * and optionally
+ *   void <name>_backward(const PD_CTensor* ins, int n_in,
+ *                        PD_CTensor* outs, int n_out);
+ * where backward receives [forward inputs..., forward outputs...,
+ * output grads...] and writes grads for the FLOATING-dtype forward inputs
+ * only, in input order (integer/bool inputs are non-differentiable and get
+ * no grad buffer).
+ *
+ * Buffers are allocated by the framework (shapes from the python-side
+ * InferShape), row-major contiguous. dtype codes below.
+ */
+#ifndef PD_CUSTOM_OP_H_
+#define PD_CUSTOM_OP_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+enum PD_CDType {
+  PD_FLOAT32 = 0,
+  PD_FLOAT64 = 1,
+  PD_INT32 = 2,
+  PD_INT64 = 3,
+  PD_BOOL = 4,
+  PD_UINT8 = 5,
+};
+
+typedef struct {
+  void* data;
+  int64_t ndim;
+  const int64_t* shape;
+  int32_t dtype; /* PD_CDType */
+} PD_CTensor;
+
+static inline int64_t pd_numel(const PD_CTensor* t) {
+  int64_t n = 1;
+  for (int64_t i = 0; i < t->ndim; ++i) n *= t->shape[i];
+  return n;
+}
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* PD_CUSTOM_OP_H_ */

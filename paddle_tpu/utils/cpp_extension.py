@@ -1,0 +1,201 @@
+"""Custom C++ op extension (reference: paddle.utils.cpp_extension — JIT
+`load(sources)` building a custom-op .so; SURVEY.md §2.1 custom-op C API,
+test/custom_op, test/cpp_extension).
+
+TPU-native contract: a custom C++ op is a HOST op. It plugs into the
+framework through ``jax.pure_callback`` so it composes with jit/vmap-free
+tracing, and into autograd through the engine's custom-vjp machinery when
+the library exports a ``<name>_backward``. Device-side custom kernels are
+Pallas (python), not C++ — this API covers the reference's CPU custom-op
+surface (IO codecs, samplers, CPU reference kernels).
+
+ABI: see native/pd_custom_op.h.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_HEADER_DIR = os.path.join(os.path.dirname(_HERE), "native")
+_lock = threading.Lock()
+
+_DTYPE_CODES = {
+    np.dtype(np.float32): 0, np.dtype(np.float64): 1,
+    np.dtype(np.int32): 2, np.dtype(np.int64): 3,
+    np.dtype(np.bool_): 4, np.dtype(np.uint8): 5,
+}
+
+
+class _CTensor(ctypes.Structure):
+    _fields_ = [
+        ("data", ctypes.c_void_p),
+        ("ndim", ctypes.c_int64),
+        ("shape", ctypes.POINTER(ctypes.c_int64)),
+        ("dtype", ctypes.c_int32),
+    ]
+
+
+def _as_ctensor(arr: np.ndarray, holders: list) -> _CTensor:
+    arr = np.ascontiguousarray(arr)
+    shape = (ctypes.c_int64 * arr.ndim)(*arr.shape)
+    holders.append((arr, shape))  # keep alive for the call
+    return _CTensor(
+        arr.ctypes.data_as(ctypes.c_void_p), arr.ndim, shape,
+        _DTYPE_CODES[arr.dtype])
+
+
+def get_build_directory() -> str:
+    d = os.environ.get("PADDLE_EXTENSION_DIR",
+                       os.path.expanduser("~/.cache/paddle_tpu_extensions"))
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+class CppExtensionLibrary:
+    """A loaded custom-op library; ``get_op`` returns framework ops."""
+
+    def __init__(self, name: str, so_path: str):
+        self.name = name
+        self._lib = ctypes.CDLL(so_path)
+
+    def _fn(self, symbol: str):
+        fn = getattr(self._lib, symbol)
+        fn.restype = None
+        fn.argtypes = [ctypes.POINTER(_CTensor), ctypes.c_int,
+                       ctypes.POINTER(_CTensor), ctypes.c_int]
+        return fn
+
+    def has(self, symbol: str) -> bool:
+        try:
+            getattr(self._lib, symbol)
+            return True
+        except AttributeError:
+            return False
+
+    def _invoke(self, symbol, in_arrays, out_specs):
+        """Run the C function on host numpy buffers; returns outputs."""
+        fn = self._fn(symbol)
+        holders: list = []
+        ins = (_CTensor * len(in_arrays))(
+            *[_as_ctensor(np.asarray(a), holders) for a in in_arrays])
+        outs_np = [np.zeros(s.shape, dtype=s.dtype) for s in out_specs]
+        outs = (_CTensor * len(outs_np))(
+            *[_as_ctensor(o, holders) for o in outs_np])
+        fn(ins, len(in_arrays), outs, len(outs_np))
+        # _as_ctensor may copy for contiguity; read back via the holders
+        return tuple(h[0] for h in holders[len(in_arrays):])
+
+    def get_op(self, op_name: str, infer_shape, infer_dtype=None):
+        """Build a framework op from ``<op_name>_forward`` (+ optional
+        ``_backward``).
+
+        infer_shape(*input_shapes) -> list of output shapes;
+        infer_dtype(*input_dtypes) -> list of output dtypes (defaults to
+        the first input's dtype for every output) — exactly the
+        reference's InferShapeFn/InferDtypeFn registration contract.
+        """
+        from ..autograd.engine import apply_op
+
+        fwd_symbol = f"{op_name}_forward"
+        bwd_symbol = f"{op_name}_backward"
+        has_bwd = self.has(bwd_symbol)
+
+        def _check_dtypes(args):
+            for a in args:
+                if np.dtype(a.dtype) not in _DTYPE_CODES:
+                    raise TypeError(
+                        f"custom op '{op_name}': dtype {a.dtype} is not "
+                        "supported by the custom-op C ABI (supported: "
+                        "float32/float64/int32/int64/bool/uint8; cast "
+                        "bf16/fp16 tensors at the boundary)")
+
+        def out_specs_for(args):
+            shapes = infer_shape(*[tuple(a.shape) for a in args])
+            if infer_dtype is not None:
+                dtypes = infer_dtype(*[a.dtype for a in args])
+            else:
+                dtypes = [args[0].dtype] * len(shapes)
+            return [jax.ShapeDtypeStruct(tuple(s), jnp.dtype(d))
+                    for s, d in zip(shapes, dtypes)]
+
+        def host_forward(*arrays):
+            specs = out_specs_for(arrays)
+            return jax.pure_callback(
+                lambda *a: self._invoke(fwd_symbol, a, specs),
+                tuple(specs), *arrays)
+
+        # Always a custom_vjp: apply_op eagerly linearizes through jax.vjp
+        # when any input requires grad, and a bare pure_callback has no JVP
+        # rule — without custom rules even the FORWARD pass of a
+        # grad-enabled input would crash.
+        @jax.custom_vjp
+        def fn(*arrays):
+            out = host_forward(*arrays)
+            return out if len(out) > 1 else out[0]
+
+        def fwd(*arrays):
+            out = host_forward(*arrays)
+            return (out if len(out) > 1 else out[0]), (arrays, out)
+
+        def bwd(res, g):
+            if not has_bwd:
+                raise RuntimeError(
+                    f"custom op '{op_name}' has no backward registered "
+                    f"(export {bwd_symbol} from the extension library)")
+            arrays, outs = res
+            gs = g if isinstance(g, tuple) else (g,)
+            # C backward fills grads for FLOATING inputs only (in input
+            # order); integer/bool primals get symbolic float0 cotangents
+            diff = [jnp.issubdtype(jnp.dtype(a.dtype), jnp.floating)
+                    for a in arrays]
+            grad_specs = [jax.ShapeDtypeStruct(a.shape, a.dtype)
+                          for a, d in zip(arrays, diff) if d]
+            all_ins = tuple(arrays) + tuple(outs) + tuple(gs)
+            grads = jax.pure_callback(
+                lambda *a: self._invoke(bwd_symbol, a, grad_specs),
+                tuple(grad_specs), *all_ins)
+            out_grads, gi = [], 0
+            for a, d in zip(arrays, diff):
+                if d:
+                    out_grads.append(grads[gi])
+                    gi += 1
+                else:
+                    out_grads.append(
+                        np.zeros(a.shape, dtype=jax.dtypes.float0))
+            return tuple(out_grads)
+
+        fn.defvjp(fwd, bwd)
+
+        def op(*tensors):
+            _check_dtypes([t._data if hasattr(t, "_data") else t
+                           for t in tensors])
+            return apply_op(op_name, fn, *tensors)
+
+        return op
+
+
+def load(name: str, sources, extra_cxx_flags=None, extra_ldflags=None,
+         build_directory=None, verbose: bool = False) -> CppExtensionLibrary:
+    """Compile ``sources`` into lib<name>.so and load it (reference:
+    cpp_extension.load — the JIT build path)."""
+    from ..native import compile_shared_lib
+
+    build_dir = build_directory or get_build_directory()
+    so = os.path.join(build_dir, f"lib{name}.so")
+    with _lock:
+        compile_shared_lib(
+            sources, so,
+            extra_flags=[f"-I{_HEADER_DIR}", *(extra_cxx_flags or []),
+                         *(extra_ldflags or [])],
+            verbose=verbose)
+    return CppExtensionLibrary(name, so)
+
+
+__all__ = ["load", "CppExtensionLibrary", "get_build_directory"]
