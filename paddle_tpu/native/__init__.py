@@ -24,20 +24,24 @@ _cache: dict[str, ctypes.CDLL] = {}
 _CXXFLAGS = ["-O2", "-std=c++17", "-fPIC", "-shared", "-pthread", "-Wall"]
 
 
-def compile_shared_lib(sources, so: str, extra_flags=(), verbose=False):
-    """g++-compile ``sources`` into ``so`` if any source is newer.
+def compile_shared_lib(sources, so: str, extra_flags=(), ldflags=(),
+                       deps=(), verbose=False):
+    """g++-compile ``sources`` into ``so`` if any source/dep is newer.
 
     Shared by the built-in native services and the custom-op extension
-    builder (utils/cpp_extension). Concurrency-safe across processes: the
-    tmp file is pid-suffixed and os.replace is atomic, so parallel builders
+    builder (utils/cpp_extension). ``deps`` are additional freshness
+    dependencies (included headers) that trigger a rebuild without being
+    compiled; ``ldflags`` go AFTER the sources (GNU ld resolves -l
+    libraries left-to-right). Concurrency-safe across processes: the tmp
+    file is pid-suffixed and os.replace is atomic, so parallel builders
     each produce a complete .so and the last replace wins.
     """
     sources = [sources] if isinstance(sources, str) else list(sources)
-    newest = max(os.path.getmtime(s) for s in sources)
+    newest = max(os.path.getmtime(p) for p in [*sources, *deps])
     if os.path.exists(so) and os.path.getmtime(so) >= newest:
         return so
     tmp = so + f".tmp{os.getpid()}"
-    cmd = ["g++", *_CXXFLAGS, *extra_flags, "-o", tmp, *sources]
+    cmd = ["g++", *_CXXFLAGS, *extra_flags, "-o", tmp, *sources, *ldflags]
     if verbose:
         print(" ".join(cmd))
     proc = subprocess.run(cmd, capture_output=True, text=True)

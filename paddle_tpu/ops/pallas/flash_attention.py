@@ -33,10 +33,19 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-# Block sizes: 128 divides every gated shape (caller guarantees seq % 128 ==
-# 0 and head_dim % 64 == 0; head_dim is never blocked) and match the MXU tile.
-BLOCK_Q = 128
-BLOCK_K = 128
+# Preferred block sizes (upper bounds): swept on the benchmark chip — the
+# full GPT train step runs ~25% faster at 256/512 than at 128/128 (fewer
+# grid steps amortize per-step overhead; tiles stay MXU-shaped). Actual
+# per-call blocks shrink to divide the sequence (see _pick_block).
+BLOCK_Q = 256
+BLOCK_K = 512
+
+
+def _pick_block(pref: int, seq: int) -> int:
+    b = min(pref, seq)
+    while seq % b:
+        b //= 2
+    return max(b, 1)
 NEG_INF = -1e30
 
 # Explicit DEFAULT precision keeps bf16 operands on the native MXU pass
@@ -53,23 +62,23 @@ def _dotf32(a, b, dims):
                                precision=_MXU)
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal):
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, bq, bk):
     i = pl.program_id(1)
     q = q_ref[0]  # [bq, d] kept in input dtype: MXU wants bf16 operands
     seq = k_ref.shape[1]
-    num_k = seq // BLOCK_K
-    bq, d = q.shape
+    num_k = seq // bk
+    d = q.shape[1]
 
-    row_ids = i * BLOCK_Q + jax.lax.broadcasted_iota(jnp.int32, (bq, BLOCK_K), 0)
+    row_ids = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
 
     def body(j, carry):
         m, l, acc = carry
-        k = k_ref[0, pl.ds(j * BLOCK_K, BLOCK_K), :]
-        v = v_ref[0, pl.ds(j * BLOCK_K, BLOCK_K), :]
+        k = k_ref[0, pl.ds(j * bk, bk), :]
+        v = v_ref[0, pl.ds(j * bk, bk), :]
         s = _dotf32(q, k, (((1,), (1,)))) * scale  # [bq, bk] f32
         if causal:
-            col_ids = j * BLOCK_K + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, BLOCK_K), 1
+            col_ids = j * bk + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 1
             )
             s = jnp.where(row_ids >= col_ids, s, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
@@ -83,7 +92,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal):
     # int32 loop bounds: the framework runs with jax_enable_x64, and int64
     # scalars are not lowerable inside Mosaic kernels.
     if causal:
-        upper = jnp.minimum(num_k, (i + 1) * BLOCK_Q // BLOCK_K).astype(jnp.int32)
+        upper = jnp.minimum(
+            num_k, ((i + 1) * bq + bk - 1) // bk).astype(jnp.int32)
     else:
         upper = jnp.int32(num_k)
     m0 = jnp.full((bq, 1), NEG_INF, jnp.float32)
@@ -94,11 +104,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal):
     lse_ref[0, 0, :] = (m + jnp.log(l))[:, 0]
 
 
-def _bhsd_specs(seq, d, blocked: bool):
-    """BlockSpec for [bh, seq, d] arrays: per-program either one seq-block or
-    the full sequence (K/V)."""
-    if blocked:
-        return pl.BlockSpec((1, BLOCK_Q, d), lambda bh, i: (bh, i, 0))
+def _bhsd_specs(seq, d, block: int | None):
+    """BlockSpec for [bh, seq, d] arrays: per-program either one seq-block
+    (``block`` rows) or the full sequence (None)."""
+    if block is not None:
+        return pl.BlockSpec((1, block, d), lambda bh, i: (bh, i, 0))
     return pl.BlockSpec((1, seq, d), lambda bh, i: (bh, 0, 0))
 
 
@@ -110,21 +120,24 @@ def _flash(q, k, v, scale, causal):
 
 def _flash_fwd_impl(q, k, v, scale, causal):
     bh, seq, d = q.shape
-    grid = (bh, seq // BLOCK_Q)
+    bq = _pick_block(BLOCK_Q, seq)
+    bk = _pick_block(BLOCK_K, seq)
+    grid = (bh, seq // bq)
     # Trace kernels in 32-bit mode: the framework enables jax_enable_x64 and
     # int64 scalars are unlowerable in Mosaic.
     with jax.enable_x64(False):
         out, lse = pl.pallas_call(
-            functools.partial(_fwd_kernel, scale=scale, causal=causal),
+            functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                              bq=bq, bk=bk),
             grid=grid,
             in_specs=[
-            _bhsd_specs(seq, d, True),
-            _bhsd_specs(seq, d, False),
-            _bhsd_specs(seq, d, False),
+            _bhsd_specs(seq, d, bq),
+            _bhsd_specs(seq, d, None),
+            _bhsd_specs(seq, d, None),
             ],
             out_specs=[
-            _bhsd_specs(seq, d, True),
-            pl.BlockSpec((1, 1, BLOCK_Q), lambda b, i: (b, 0, i)),
+            _bhsd_specs(seq, d, bq),
+            pl.BlockSpec((1, 1, bq), lambda b, i: (b, 0, i)),
             ],
             out_shape=[
             jax.ShapeDtypeStruct(q.shape, q.dtype),
@@ -149,7 +162,7 @@ def _flash_bwd(scale, causal, res, g):
 
 
 def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                      dq_ref, dk_ref, dv_ref, *, scale, causal):
+                      dq_ref, dk_ref, dv_ref, *, scale, causal, bq, bkb):
     """One kernel for dq/dk/dv. Grid (bh, k-block); dq's block is the FULL
     [seq, d] fp32 accumulator, whose index map ignores the k-block dim, so
     Mosaic keeps it VMEM-resident across the inner grid steps and each step
@@ -157,12 +170,12 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     halves the kernel count AND the s/p recomputation of a split dq/dkv
     pass)."""
     j = pl.program_id(1)
-    k = k_ref[0]  # [bk, d]
+    k = k_ref[0]  # [bkb, d]
     v = v_ref[0]
     seq = q_ref.shape[1]
-    num_q = seq // BLOCK_Q
+    num_q = seq // bq
     bk, d = k.shape
-    col_ids = j * BLOCK_K + jax.lax.broadcasted_iota(jnp.int32, (BLOCK_Q, bk), 1)
+    col_ids = j * bkb + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
 
     @pl.when(j == 0)
     def _init():
@@ -170,14 +183,14 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     def body(i, carry):
         dk, dv = carry
-        q = q_ref[0, pl.ds(i * BLOCK_Q, BLOCK_Q), :]
-        do = do_ref[0, pl.ds(i * BLOCK_Q, BLOCK_Q), :]
-        lse = lse_ref[0, 0, pl.ds(i * BLOCK_Q, BLOCK_Q)][:, None]
-        delta = delta_ref[0, 0, pl.ds(i * BLOCK_Q, BLOCK_Q)][:, None]
+        q = q_ref[0, pl.ds(i * bq, bq), :]
+        do = do_ref[0, pl.ds(i * bq, bq), :]
+        lse = lse_ref[0, 0, pl.ds(i * bq, bq)][:, None]
+        delta = delta_ref[0, 0, pl.ds(i * bq, bq)][:, None]
         s = scale * _dotf32(q, k, ((1,), (1,)))  # [bq, bk] f32
         if causal:
-            row_ids = i * BLOCK_Q + jax.lax.broadcasted_iota(
-                jnp.int32, (BLOCK_Q, bk), 0
+            row_ids = i * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 0
             )
             s = jnp.where(row_ids >= col_ids, s, NEG_INF)
         p = jnp.exp(s - lse)
@@ -186,13 +199,13 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dp = _dotf32(do, v, ((1,), (1,)))
         ds = (p * (dp - delta)).astype(q.dtype)
         dk = dk + scale * _dotf32(ds, q, ((0,), (0,)))
-        dq_blk = dq_ref[0, pl.ds(i * BLOCK_Q, BLOCK_Q), :]
-        dq_ref[0, pl.ds(i * BLOCK_Q, BLOCK_Q), :] = (
+        dq_blk = dq_ref[0, pl.ds(i * bq, bq), :]
+        dq_ref[0, pl.ds(i * bq, bq), :] = (
             dq_blk + scale * _dotf32(ds, k, ((1,), (0,))))
         return dk, dv
 
     if causal:
-        lower = ((j * BLOCK_K) // BLOCK_Q).astype(jnp.int32)
+        lower = ((j * bkb) // bq).astype(jnp.int32)
     else:
         lower = jnp.int32(0)
     z = jnp.zeros((bk, d), jnp.float32)
@@ -211,14 +224,17 @@ def flash_bwd_impl(q, k, v, g, lse, delta, scale, causal):
     renormalization.
     """
     bh, seq, d = q.shape
+    bq = _pick_block(BLOCK_Q, seq)
+    bkb = _pick_block(BLOCK_K, seq)
     lse_spec_full = pl.BlockSpec((1, 1, seq), lambda b, j: (b, 0, 0))
-    kv_block = pl.BlockSpec((1, BLOCK_K, d), lambda bh_, j: (bh_, j, 0))
+    kv_block = pl.BlockSpec((1, bkb, d), lambda bh_, j: (bh_, j, 0))
     q_full = pl.BlockSpec((1, seq, d), lambda bh_, j: (bh_, 0, 0))
 
     with jax.enable_x64(False):
         dq, dk, dv = pl.pallas_call(
-            functools.partial(_bwd_fused_kernel, scale=scale, causal=causal),
-            grid=(bh, seq // BLOCK_K),
+            functools.partial(_bwd_fused_kernel, scale=scale, causal=causal,
+                              bq=bq, bkb=bkb),
+            grid=(bh, seq // bkb),
             in_specs=[
                 q_full,          # q full
                 kv_block,        # k block

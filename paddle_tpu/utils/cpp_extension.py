@@ -122,10 +122,15 @@ class CppExtensionLibrary:
                 dtypes = infer_dtype(*[a.dtype for a in args])
             else:
                 dtypes = [args[0].dtype] * len(shapes)
+            _check_dtypes([jnp.zeros((), jnp.dtype(d)) for d in dtypes])
             return [jax.ShapeDtypeStruct(tuple(s), jnp.dtype(d))
                     for s, d in zip(shapes, dtypes)]
 
         def host_forward(*arrays):
+            # validated HERE (trace time, after AMP's cast hook has run on
+            # the inputs) so an O2 bf16 auto-cast fails with the clear
+            # TypeError, not a KeyError inside the XLA callback
+            _check_dtypes(arrays)
             specs = out_specs_for(arrays)
             return jax.pure_callback(
                 lambda *a: self._invoke(fwd_symbol, a, specs),
@@ -174,8 +179,6 @@ class CppExtensionLibrary:
         fn.defvjp(fwd, bwd)
 
         def op(*tensors):
-            _check_dtypes([t._data if hasattr(t, "_data") else t
-                           for t in tensors])
             return apply_op(op_name, fn, *tensors)
 
         return op
@@ -184,17 +187,29 @@ class CppExtensionLibrary:
 def load(name: str, sources, extra_cxx_flags=None, extra_ldflags=None,
          build_directory=None, verbose: bool = False) -> CppExtensionLibrary:
     """Compile ``sources`` into lib<name>.so and load it (reference:
-    cpp_extension.load — the JIT build path)."""
+    cpp_extension.load — the JIT build path).
+
+    The cache filename includes a digest of the absolute source paths and
+    flags, so two extensions sharing a ``name`` but built from different
+    sources never collide in the shared cache dir; mtimes of sources AND
+    the ABI header govern rebuilds.
+    """
+    import hashlib
+
     from ..native import compile_shared_lib
 
+    sources = [sources] if isinstance(sources, str) else list(sources)
+    cxx = [f"-I{_HEADER_DIR}", *(extra_cxx_flags or [])]
+    ld = list(extra_ldflags or [])
+    digest = hashlib.sha1("\x00".join(
+        [os.path.abspath(s) for s in sources] + cxx + ld
+    ).encode()).hexdigest()[:10]
     build_dir = build_directory or get_build_directory()
-    so = os.path.join(build_dir, f"lib{name}.so")
+    so = os.path.join(build_dir, f"lib{name}-{digest}.so")
+    header = os.path.join(_HEADER_DIR, "pd_custom_op.h")
     with _lock:
-        compile_shared_lib(
-            sources, so,
-            extra_flags=[f"-I{_HEADER_DIR}", *(extra_cxx_flags or []),
-                         *(extra_ldflags or [])],
-            verbose=verbose)
+        compile_shared_lib(sources, so, extra_flags=cxx, ldflags=ld,
+                           deps=[header], verbose=verbose)
     return CppExtensionLibrary(name, so)
 
 

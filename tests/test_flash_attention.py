@@ -79,3 +79,34 @@ def test_head_dim_64(rng):
     out = flash_attention(q, k, v, causal=True)
     ref = naive_attention(q, k, v, True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_mixed_block_sizes_seq512(causal, rng):
+    """seq 512 exercises bq=256 != bk=512 (the swept default blocks):
+    forward AND gradient vs naive."""
+    b, s, h, d = 1, 512, 1, 64
+    q = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+    k = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+    v = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+    out = flash_attention(q, k, v, causal=causal)
+    ref = naive_attention(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+    gf = jax.grad(lambda q: jnp.sum(
+        flash_attention(q, k, v, causal=causal) ** 2))(q)
+    gn = jax.grad(lambda q: jnp.sum(
+        naive_attention(q, k, v, causal) ** 2))(q)
+    np.testing.assert_allclose(np.asarray(gf), np.asarray(gn),
+                               rtol=5e-3, atol=5e-3)
+
+
+def test_odd_seq_picks_smaller_block(rng):
+    """seq 192 (not divisible by 256): _pick_block must fall back to a
+    dividing block and stay correct."""
+    b, s, h, d = 1, 192, 2, 64
+    q = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+    out = flash_attention(q, q, q, causal=True)
+    ref = naive_attention(q, q, q, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
