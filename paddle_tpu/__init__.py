@@ -113,6 +113,8 @@ def __getattr__(name):
         return _importlib.import_module(".hapi", __name__).Model
     if name == "summary":
         return _importlib.import_module(".hapi", __name__).summary
+    if name == "flops":
+        return _importlib.import_module(".hapi", __name__).flops
     raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")
 
 # `bool` dtype alias must not shadow the builtin during module definition;

@@ -2,7 +2,7 @@
 from . import callbacks
 from .callbacks import Callback, EarlyStopping, LRScheduler, ModelCheckpoint, ProgBarLogger
 from .model import Model
-from .model_summary import summary
+from .model_summary import flops, summary
 
 __all__ = [
     "callbacks",
@@ -13,4 +13,5 @@ __all__ = [
     "ProgBarLogger",
     "Model",
     "summary",
+    "flops",
 ]

@@ -3,6 +3,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from ..framework import dtype as dtype_mod
 from ..nn import Layer
 from ..tensor.tensor import Tensor
 
@@ -65,3 +66,63 @@ def summary(net: Layer, input_size=None, dtypes=None, input=None):
     print(f"Trainable params: {trainable:,}")
     print(line)
     return {"total_params": total, "trainable_params": trainable}
+
+
+def flops(net, input_size, dtype="float32", custom_ops=None,
+          print_detail=False):
+    """Model FLOPs estimate via forward hooks (reference:
+    paddle.flops / hapi/dynamic_flops.py). Counts multiply-accumulates as
+    2 FLOPs for Linear/Conv; norms/activations count one pass."""
+    from .. import nn
+
+    total = [0]
+    detail = []
+    custom_ops = custom_ops or {}
+
+    def count(layer, inputs, output):
+        t = type(layer)
+        n = 0
+        out = output[0] if isinstance(output, (tuple, list)) else output
+        out_numel = int(np.prod(out.shape)) if hasattr(out, "shape") else 0
+        if t in custom_ops:
+            n = custom_ops[t](layer, inputs, output)
+        elif isinstance(layer, nn.Linear):
+            n = 2 * out_numel * layer.in_features
+        elif isinstance(layer, (nn.Conv2D, nn.Conv3D, nn.Conv1D)):
+            w = layer.weight
+            k_numel = int(np.prod(w.shape[1:]))  # cin/groups * prod(k)
+            n = 2 * out_numel * k_numel
+        elif isinstance(layer, (nn.BatchNorm1D, nn.BatchNorm2D,
+                                nn.BatchNorm3D, nn.LayerNorm)):
+            n = 2 * out_numel
+        elif isinstance(layer, (nn.ReLU, nn.GELU, nn.Sigmoid, nn.Tanh)):
+            n = out_numel
+        if n:
+            total[0] += n
+            detail.append((layer.full_name() if hasattr(layer, "full_name")
+                           else type(layer).__name__, n))
+
+    handles = []
+    # include_self: a bare layer (no sublayers) must count itself
+    for _, sub in net.named_sublayers(include_self=True):
+        handles.append(sub.register_forward_post_hook(count))
+    try:
+        import jax.numpy as jnp
+
+        x = Tensor(jnp.zeros(tuple(input_size),
+                             dtype_mod.to_jax_dtype(dtype)))
+        was_training = net.training
+        net.eval()
+        try:
+            net(x)
+        finally:
+            if was_training:
+                net.train()
+    finally:
+        for h in handles:
+            h.remove()
+    if print_detail:
+        for name, n in detail:
+            print(f"{name:<40s} {n:>16,d}")
+        print(f"{'Total':<40s} {total[0]:>16,d}")
+    return total[0]

@@ -286,3 +286,72 @@ def bilinear(x1, x2, weight, bias=None, name=None):
 
     args = (x1, x2, weight) + ((bias,) if bias is not None else ())
     return apply_op("bilinear", fn, *args)
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """Sample x [N,C,H,W] at normalized grid [N,Hg,Wg,2] locations
+    (reference: nn/functional/vision.py grid_sample — the STN sampler).
+    Grid coords in [-1, 1]; modes bilinear/nearest; padding zeros/border/
+    reflection."""
+    if mode not in ("bilinear", "nearest"):
+        raise ValueError(f"grid_sample mode must be bilinear|nearest, got {mode!r}")
+    if padding_mode not in ("zeros", "border", "reflection"):
+        raise ValueError(
+            f"padding_mode must be zeros|border|reflection, got {padding_mode!r}")
+
+    def fn(x_, g):
+        N, C, H, W = x_.shape
+        gx, gy = g[..., 0], g[..., 1]
+        if align_corners:
+            fx = (gx + 1) * 0.5 * (W - 1)
+            fy = (gy + 1) * 0.5 * (H - 1)
+        else:
+            fx = ((gx + 1) * W - 1) * 0.5
+            fy = ((gy + 1) * H - 1) * 0.5
+
+        if padding_mode == "reflection":
+            def reflect(f, size):
+                if size == 1:
+                    return jnp.zeros_like(f)
+                period = 2.0 * (size - 1)
+                f = jnp.abs(jnp.mod(f, period))
+                return jnp.where(f > size - 1, period - f, f)
+
+            fx = reflect(fx, W)
+            fy = reflect(fy, H)
+
+        def sample_nearest(feat, fy_, fx_):
+            ix = jnp.round(fx_).astype(jnp.int32)
+            iy = jnp.round(fy_).astype(jnp.int32)
+            valid = (ix >= 0) & (ix < W) & (iy >= 0) & (iy < H)
+            ixc = jnp.clip(ix, 0, W - 1)
+            iyc = jnp.clip(iy, 0, H - 1)
+            out = feat[:, iyc, ixc]
+            if padding_mode == "zeros":
+                out = jnp.where(valid[None], out, 0.0)
+            return out
+
+        def sample_bilinear(feat, fy_, fx_):
+            x0 = jnp.floor(fx_)
+            y0 = jnp.floor(fy_)
+            wx = fx_ - x0
+            wy = fy_ - y0
+            out = 0.0
+            for dy, dx, w in ((0, 0, (1 - wy) * (1 - wx)),
+                              (0, 1, (1 - wy) * wx),
+                              (1, 0, wy * (1 - wx)),
+                              (1, 1, wy * wx)):
+                ix = (x0 + dx).astype(jnp.int32)
+                iy = (y0 + dy).astype(jnp.int32)
+                valid = (ix >= 0) & (ix < W) & (iy >= 0) & (iy < H)
+                v = feat[:, jnp.clip(iy, 0, H - 1), jnp.clip(ix, 0, W - 1)]
+                if padding_mode == "zeros":
+                    v = jnp.where(valid[None], v, 0.0)
+                out = out + v * w[None]
+            return out
+
+        sampler = sample_nearest if mode == "nearest" else sample_bilinear
+        return jax.vmap(sampler)(x_, fy, fx)
+
+    return apply_op("grid_sample", fn, x, grid)

@@ -405,3 +405,42 @@ def take(x, index, mode="raise", name=None):
         return jnp.take(flat, i, mode=jmode)
 
     return apply_op("take", fn, x, index)
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    """Trapezoidal-rule integral (reference: paddle.trapezoid)."""
+
+    def fn(y_, x_):
+        if x_ is not None:
+            return jnp.trapezoid(y_, x=x_, axis=axis)
+        return jnp.trapezoid(y_, dx=1.0 if dx is None else dx, axis=axis)
+
+    return apply_op("trapezoid", fn, y, x)
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    """Cumulative trapezoidal integral (reference:
+    paddle.cumulative_trapezoid)."""
+
+    def fn(y_, x_):
+        yl = jnp.moveaxis(y_, axis, -1)
+        if x_ is not None:
+            # move x into the same layout BEFORE broadcasting against yl
+            xl = (jnp.moveaxis(x_, axis, -1) if x_.ndim == y_.ndim else x_)
+            widths = jnp.diff(jnp.broadcast_to(xl, yl.shape), axis=-1)
+        else:
+            widths = 1.0 if dx is None else dx
+        avg = (yl[..., 1:] + yl[..., :-1]) / 2.0
+        out = jnp.cumsum(avg * widths, axis=-1)
+        return jnp.moveaxis(out, -1, axis)
+
+    return apply_op("cumulative_trapezoid", fn, y, x)
+
+
+def vander(x, n=None, increasing=False, name=None):
+    """Vandermonde matrix (reference: paddle.vander)."""
+
+    def fn(x_):
+        return jnp.vander(x_, N=n, increasing=increasing)
+
+    return apply_op("vander", fn, x)

@@ -152,3 +152,80 @@ def test_onnx_export_gates_with_guidance():
 
     with pytest.raises(RuntimeError, match="jit.save"):
         paddle_tpu.onnx.export(None, "/tmp/x")
+
+
+def test_paddle_flops_counts_linear_and_conv():
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+
+    net = nn.Sequential(nn.Conv2D(3, 8, 3, padding=1), nn.ReLU(),
+                        nn.Flatten(), nn.Linear(8 * 8 * 8, 10))
+    n = paddle.flops(net, [1, 3, 8, 8])
+    # conv: 2*out_numel*(3*3*3) = 2*8*64*27 = 27648; relu 512;
+    # linear 2*10*512 = 10240
+    assert n == 27648 + 512 + 10240
+
+
+def test_grid_sample_identity(rng):
+    import paddle_tpu as paddle
+
+    x = paddle.to_tensor(rng.randn(1, 2, 5, 5).astype("float32"))
+    # identity grid
+    ys, xs = np.meshgrid(np.linspace(-1, 1, 5), np.linspace(-1, 1, 5),
+                         indexing="ij")
+    grid = paddle.to_tensor(
+        np.stack([xs, ys], -1)[None].astype("float32"))
+    out = paddle.nn.functional.grid_sample(x, grid, align_corners=True)
+    np.testing.assert_allclose(np.asarray(out._data),
+                               np.asarray(x._data), atol=1e-5)
+
+
+def test_trapezoid_and_vander(rng):
+    import paddle_tpu as paddle
+
+    y = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+    np.testing.assert_allclose(float(paddle.trapezoid(y)._data), 4.0)
+    v = paddle.vander(paddle.to_tensor(np.array([1.0, 2.0, 3.0],
+                                                np.float32)), n=3)
+    np.testing.assert_allclose(np.asarray(v._data),
+                               np.vander([1, 2, 3], 3), rtol=1e-6)
+
+
+def test_grid_sample_reflection_and_validation(rng):
+    import paddle_tpu as paddle
+    import pytest
+
+    x = paddle.to_tensor(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    # a grid just past the right edge: reflection must fold back inside
+    grid = paddle.to_tensor(np.array(
+        [[[[1.5, 0.0]]]], np.float32))  # fx = 1.5 -> reflect
+    out_ref = paddle.nn.functional.grid_sample(
+        x, grid, padding_mode="reflection")
+    out_border = paddle.nn.functional.grid_sample(
+        x, grid, padding_mode="border")
+    assert not np.allclose(np.asarray(out_ref._data),
+                           np.asarray(out_border._data))
+    with pytest.raises(ValueError, match="padding_mode"):
+        paddle.nn.functional.grid_sample(x, grid, padding_mode="wrap")
+    with pytest.raises(ValueError, match="mode"):
+        paddle.nn.functional.grid_sample(x, grid, mode="bicubic")
+
+
+def test_flops_counts_bare_layer():
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+
+    assert paddle.flops(nn.Linear(10, 10), [1, 10]) == 200
+
+
+def test_cumulative_trapezoid_axis0(rng):
+    import paddle_tpu as paddle
+
+    y = rng.rand(4, 3).astype("float32")
+    x = rng.rand(4, 3).astype("float32").cumsum(0)
+    got = np.asarray(paddle.tensor.math.cumulative_trapezoid(
+        paddle.to_tensor(y), x=paddle.to_tensor(x), axis=0)._data)
+    import scipy.integrate as si
+
+    want = si.cumulative_trapezoid(y, x=x, axis=0)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
