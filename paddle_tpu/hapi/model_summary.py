@@ -68,8 +68,8 @@ def summary(net: Layer, input_size=None, dtypes=None, input=None):
     return {"total_params": total, "trainable_params": trainable}
 
 
-def flops(net, input_size, dtype="float32", custom_ops=None,
-          print_detail=False):
+def flops(net, input_size, custom_ops=None, print_detail=False, *,
+          dtype="float32"):
     """Model FLOPs estimate via forward hooks (reference:
     paddle.flops / hapi/dynamic_flops.py). Counts multiply-accumulates as
     2 FLOPs for Linear/Conv; norms/activations count one pass."""

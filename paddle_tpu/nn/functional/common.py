@@ -314,9 +314,16 @@ def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
             def reflect(f, size):
                 if size == 1:
                     return jnp.zeros_like(f)
-                period = 2.0 * (size - 1)
-                f = jnp.abs(jnp.mod(f, period))
-                return jnp.where(f > size - 1, period - f, f)
+                if align_corners:
+                    # fold about pixel CENTERS: [0, size-1], period 2(size-1)
+                    period = 2.0 * (size - 1)
+                    f = jnp.abs(jnp.mod(f, period))
+                    return jnp.where(f > size - 1, period - f, f)
+                # fold about pixel EDGES: [-0.5, size-0.5], period 2*size
+                period = 2.0 * size
+                g = jnp.abs(jnp.mod(f + 0.5, period))
+                g = jnp.where(g > size, period - g, g)
+                return jnp.clip(g - 0.5, 0, size - 1)
 
             fx = reflect(fx, W)
             fy = reflect(fy, H)

@@ -56,3 +56,71 @@ def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
                       weight_attr=param_attr, bias_attr=bias_attr,
                       data_format=data_format)
     return layer(input)
+
+
+def _as_py_bool(v) -> bool:
+    import numpy as np
+
+    from ..tensor.tensor import Tensor
+
+    return bool(np.asarray(v._data)) if isinstance(v, Tensor) else bool(v)
+
+
+def _as_py_int(v) -> int:
+    import numpy as np
+
+    from ..tensor.tensor import Tensor
+
+    return int(np.asarray(v._data)) if isinstance(v, Tensor) else int(v)
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None, return_names=None):
+    """Conditional execution (reference: paddle.static.nn.cond).
+
+    Dygraph semantics: the predicate is evaluated and the chosen branch
+    runs. Under Program recording the same applies — construction-time
+    control flow is baked into the recorded graph (see static/program.py
+    design notes); a feed-dependent predicate should instead be expressed
+    with tensor ops (paddle.where) or traced via jit.to_static, where
+    lax.cond handles it.
+    """
+    if _as_py_bool(pred):
+        return true_fn() if true_fn is not None else None
+    return false_fn() if false_fn is not None else None
+
+
+def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
+    """paddle.static.nn.while_loop parity with dygraph semantics: iterate
+    body_fn while cond_fn holds (concrete evaluation per iteration; under
+    jit.to_static the python loop unrolls at trace time on concrete
+    shapes)."""
+    vars_ = list(loop_vars)
+    while True:
+        if not _as_py_bool(cond_fn(*vars_)):
+            break
+        out = body_fn(*vars_)
+        vars_ = list(out) if isinstance(out, (list, tuple)) else [out]
+    return vars_
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """First-match conditional chain (reference: paddle.static.nn.case —
+    with no default, the LAST pair's fn is the implicit fallback)."""
+    for pred, fn_ in pred_fn_pairs:
+        if _as_py_bool(pred):
+            return fn_()
+    if default is not None:
+        return default()
+    return pred_fn_pairs[-1][1]()  # reference implicit-default contract
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """Index-dispatched branch (reference: paddle.static.nn.switch_case —
+    with no default, the fn of the LARGEST key is the implicit fallback)."""
+    idx = _as_py_int(branch_index)
+    fns = dict(branch_fns) if not isinstance(branch_fns, dict) else branch_fns
+    if idx in fns:
+        return fns[idx]()
+    if default is not None:
+        return default()
+    return fns[max(fns)]()  # reference implicit-default contract

@@ -229,3 +229,19 @@ def test_cumulative_trapezoid_axis0(rng):
 
     want = si.cumulative_trapezoid(y, x=x, axis=0)
     np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_grid_sample_reflection_align_corners_false():
+    import paddle_tpu as paddle
+
+    # gx=1.0 -> fx=3.5; edge reflection keeps 3.5, clamped to col 3.
+    # gy=0.25 -> fy=2.0 (row 2). Sample = x[2, 3] = 11.0
+    x = paddle.to_tensor(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    grid = paddle.to_tensor(np.array([[[[1.0, 0.25]]]], np.float32))
+    out = paddle.nn.functional.grid_sample(
+        x, grid, padding_mode="reflection", align_corners=False)
+    np.testing.assert_allclose(float(out._data[0, 0, 0, 0]), 11.0, atol=1e-5)
+    # center-fold (align_corners=True) differs: fx=3.0 exactly in range
+    out_ac = paddle.nn.functional.grid_sample(
+        x, grid, padding_mode="reflection", align_corners=True)
+    assert np.isfinite(float(out_ac._data[0, 0, 0, 0]))

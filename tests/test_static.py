@@ -251,3 +251,35 @@ def test_gradients_rejects_no_grad_set():
         y = x.sum()
         with pytest.raises(NotImplementedError):
             static.gradients(y, [x], no_grad_set={x})
+
+
+def test_control_flow_ops(rng):
+    x = paddle.to_tensor(np.array(3.0, np.float32))
+
+    out = static.nn.cond(x > 2, lambda: x * 10, lambda: x)
+    assert float(out._data) == 30.0
+
+    i = paddle.to_tensor(np.array(0.0, np.float32))
+    (final,) = static.nn.while_loop(
+        lambda v: v < 5, lambda v: (v + 2,), [i])
+    assert float(final._data) == 6.0
+
+    got = static.nn.case(
+        [(x > 10, lambda: x * 0), (x > 2, lambda: x + 1)],
+        default=lambda: x)
+    assert float(got._data) == 4.0
+
+    got2 = static.nn.switch_case(
+        paddle.to_tensor(np.array(1)), {0: lambda: x, 1: lambda: x * 2})
+    assert float(got2._data) == 6.0
+
+
+def test_control_flow_implicit_defaults():
+    x = paddle.to_tensor(np.array(3.0, np.float32))
+    # case: no match, no default -> last pair's fn
+    got = static.nn.case([(x > 10, lambda: x * 0), (x > 20, lambda: x + 7)])
+    assert float(got._data) == 10.0
+    # switch_case: missing index, no default -> largest key's fn
+    got2 = static.nn.switch_case(paddle.to_tensor(np.array(9)),
+                                 {0: lambda: x, 2: lambda: x * 5})
+    assert float(got2._data) == 15.0
