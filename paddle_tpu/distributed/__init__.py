@@ -92,6 +92,9 @@ _FLAT = {
     "load_state_dict": ".checkpoint",
     "ShardDataloader": ".auto_parallel.api",
     "unshard_dtensor": ".auto_parallel.api",
+    "to_static": ".auto_parallel.dist_model",
+    "DistModel": ".auto_parallel.dist_model",
+    "Strategy": ".auto_parallel.dist_model",
     # collectives
     "ReduceOp": ".collective",
     "Group": ".collective",

@@ -4,6 +4,7 @@ See SURVEY.md §2.7 "Semi-auto (dygraph)" row for the reference map.
 """
 from ..mesh import ProcessMesh, get_mesh, set_mesh
 from .placement import Partial, Placement, ReduceType, Replicate, Shard
+from .dist_model import DistModel, Strategy, to_static
 from .api import (
     ShardDataloader,
     dtensor_from_fn,
@@ -16,6 +17,9 @@ from .api import (
 )
 
 __all__ = [
+    "DistModel",
+    "Strategy",
+    "to_static",
     "ProcessMesh",
     "get_mesh",
     "set_mesh",

@@ -1,0 +1,205 @@
+"""Auto-parallel static engine: dist.to_static -> DistModel.
+
+Reference: python/paddle/distributed/auto_parallel/api.py (to_static :983,
+DistModel :1411) over the static Engine (static/engine.py) whose pipeline
+is Completer -> Partitioner -> Resharder -> pass pipeline (SURVEY.md §2.7
+"Auto-parallel (static) engine" row).
+
+TPU-native collapse: the whole pipeline IS XLA's GSPMD partitioner. The
+layer's DistTensor parameters already carry NamedShardings; jitting the
+full train step (forward + loss + backward + optimizer update) over them
+makes XLA do completion (sharding propagation), partitioning (per-device
+programs) and resharding (collective insertion) in one compile. DistModel
+keeps the reference's contract: calling it executes ONE step of the
+compiled program in the current mode (train/eval/predict).
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...tensor.tensor import Tensor
+
+
+class Strategy:
+    """Config tree parity (reference: auto_parallel/strategy.py — nested
+    sharding/amp/gradient_merge/pipeline sub-configs, toggled by `enable`).
+    Consumed where the TPU build has an equivalent knob; carried
+    (introspectable) otherwise."""
+
+    class _Sub:
+        def __init__(self, **defaults):
+            self.enable = False
+            self.__dict__.update(defaults)
+
+    def __init__(self):
+        self.sharding = Strategy._Sub(stage=1, degree=-1)
+        self.amp = Strategy._Sub(dtype="bfloat16", level="O2")
+        self.gradient_merge = Strategy._Sub(k_steps=1, avg=True)
+        self.pipeline = Strategy._Sub(schedule_mode="1F1B",
+                                      accumulate_steps=1)
+        self.fused_passes = Strategy._Sub(fused_passes_list=[])
+
+
+class DistModel:
+    """A layer + optimizer + loss compiled as one SPMD step program.
+
+    Modes (reference DistModel contract): ``train()`` -> __call__(\\*data)
+    runs forward+backward+update and returns the loss; ``eval()`` ->
+    forward+loss; ``predict()`` -> forward only. Each distinct input
+    shape set compiles once (executable cache).
+    """
+
+    def __init__(self, layer, loader=None, loss=None, optimizer=None,
+                 strategy=None, metrics=None, shard_fn=None):
+        from ...jit.api import _named_state
+
+        self._layer = layer
+        self._loss = loss
+        self._optimizer = optimizer
+        self._shard_fn = shard_fn  # from a wrapping shard_optimizer
+        self._strategy = strategy or Strategy()
+        self._mode = "train" if optimizer is not None else (
+            "eval" if loss is not None else "predict")
+        self._state_names = sorted(_named_state(layer))
+        self._cache: dict[tuple, Any] = {}
+
+    # -- mode switches -----------------------------------------------------
+    def train(self):
+        if self._loss is None or self._optimizer is None:
+            raise RuntimeError(
+                "DistModel.train() needs both loss and optimizer")
+        self._mode = "train"
+        self._layer.train()
+        return self
+
+    def eval(self):
+        if self._loss is None:
+            raise RuntimeError("DistModel.eval() needs a loss")
+        self._mode = "eval"
+        self._layer.eval()
+        return self
+
+    def predict(self):
+        self._mode = "predict"
+        self._layer.eval()
+        return self
+
+    def dist_main_program(self, mode=None):
+        """Introspection parity: the compiled callable for the mode (the
+        reference returns the partitioned Program)."""
+        return self._cache
+
+    def state_dict(self, mode="all"):
+        return self._layer.state_dict()
+
+    def set_state_dict(self, state_dict):
+        return self._layer.set_state_dict(state_dict)
+
+    # -- execution ---------------------------------------------------------
+    def _functional_forward(self, with_loss: bool):
+        from ...jit.api import functional_call
+
+        layer, loss_fn = self._layer, self._loss
+
+        def forward(state, *in_datas):
+            tensors = [Tensor(d) for d in in_datas]
+            if not with_loss:  # predict: every input feeds the layer
+                out = functional_call(layer, state, *tensors)
+                leaves = jax.tree.flatten(
+                    out, is_leaf=lambda x: isinstance(x, Tensor))[0]
+                return tuple(l._data if isinstance(l, Tensor) else l
+                             for l in leaves)
+            out = functional_call(layer, state, *tensors[:-1])
+            l = loss_fn(out, tensors[-1])
+            return l._data if isinstance(l, Tensor) else l
+
+        return forward
+
+    def _build(self):
+        from ...autograd.grad_mode import no_grad
+        from ...jit.api import _named_state
+
+        state_t = _named_state(self._layer)
+        forward = self._functional_forward(with_loss=self._mode != "predict")
+
+        if self._mode in ("predict", "eval"):
+            fn = jax.jit(lambda state, *d: forward(state, *d))
+            predict = self._mode == "predict"
+
+            def run(datas_):
+                state = {n: state_t[n]._data for n in self._state_names}
+                out = fn(state, *datas_)
+                if not predict:
+                    return Tensor(out)
+                outs = [Tensor(o) for o in out]
+                return outs[0] if len(outs) == 1 else outs
+
+            return run
+
+        # train: forward + grad + clip + optimizer update, one executable
+        opt = self._optimizer
+        trainable = [n for n in self._state_names
+                     if not state_t[n].stop_gradient]
+        frozen = [n for n in self._state_names if n not in trainable]
+        train_params = [state_t[n] for n in trainable]
+        _, _, _, wds, lrs = opt._gather_update_args(train_params)
+
+        @jax.jit
+        def step(train_state, frozen_state, lr, states, masters, *d):
+            def loss_of(ts):
+                return forward({**frozen_state, **ts}, *d)
+
+            loss, grads = jax.value_and_grad(loss_of)(train_state)
+            plist = [train_state[n] for n in trainable]
+            glist = [grads[n] for n in trainable]
+            with no_grad():
+                glist = opt._clip_grad_arrays(train_params, glist)
+            new_p, new_st, new_m = opt._batch_update(
+                lr, plist, glist, states, masters, wds, lrs)
+            return loss, new_p, new_st, new_m
+
+        def run(datas_):
+            train_state = {n: state_t[n]._data for n in trainable}
+            frozen_state = {n: state_t[n]._data for n in frozen}
+            lr, states, masters, _, _ = opt._gather_update_args(train_params)
+            loss, new_p, new_st, new_m = step(
+                train_state, frozen_state, lr, states, masters, *datas_)
+            opt._write_back(train_params, new_p, new_st, new_m)
+            if self._shard_fn is not None:
+                # _ShardOptimizer parity: reshard accumulator state
+                for key_, st in list(opt._accumulators.items()):
+                    new = self._shard_fn(key_, st)
+                    if new is not None:
+                        opt._accumulators[key_] = new
+            return Tensor(loss)
+
+        return run
+
+    def __call__(self, *data):
+        datas = tuple(d._data if isinstance(d, Tensor) else jnp.asarray(d)
+                      for d in data)
+        key = (self._mode, tuple((d.shape, str(d.dtype)) for d in datas))
+        run = self._cache.get(key)
+        if run is None:
+            run = self._build()
+            self._cache[key] = run
+        return run(datas)
+
+
+def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None,
+              input_spec=None) -> DistModel:
+    """Compile a (possibly dist-sharded) layer into a DistModel
+    (reference: dist.to_static api.py:983). The unwrapped optimizer is
+    accepted either bare or wrapped by shard_optimizer."""
+    from .api import _ShardOptimizer
+
+    shard_fn = None
+    if isinstance(optimizer, _ShardOptimizer):
+        shard_fn = optimizer._shard_fn  # preserve ZeRO state placement
+        optimizer = optimizer._inner
+    return DistModel(layer, loader, loss, optimizer, strategy,
+                     shard_fn=shard_fn)

@@ -157,6 +157,41 @@ class Optimizer:
         attr = getattr(p, "optimize_attr", None)
         return float(attr.get("learning_rate", 1.0)) if attr else 1.0
 
+    # --- shared update bookkeeping (used by step(), the static Executor,
+    # and DistModel's compiled train steps) ---
+    def _gather_update_args(self, params):
+        """Ensure state exists and collect (lr, states, masters, wds,
+        lr_scales) for a fixed param order."""
+        for p in params:
+            self._ensure_state(p)
+        lr = jnp.asarray(self.get_lr(), jnp.float32)
+        states = [self._accumulators[id(p)] for p in params]
+        masters = [self._master_weights.get(id(p)) for p in params]
+        wds = [jnp.asarray(self._param_decay_coeff(p), jnp.float32)
+               for p in params]
+        lr_scales = [jnp.asarray(self._param_lr_scale(p), jnp.float32)
+                     for p in params]
+        return lr, states, masters, wds, lr_scales
+
+    def _write_back(self, params, new_params, new_states, new_masters):
+        for p, np_, st, mw in zip(params, new_params, new_states,
+                                  new_masters):
+            p._data = np_
+            self._accumulators[id(p)] = st
+            if mw is not None:
+                self._master_weights[id(p)] = mw
+        self._after_step()
+
+    def _clip_grad_arrays(self, params, grad_arrays):
+        """Apply this optimizer's grad_clip to raw arrays (tracer-safe:
+        wraps them as Tensors and runs the clip ops, which trace under
+        jit)."""
+        if self._grad_clip is None:
+            return grad_arrays
+        pairs = [(Tensor(p._data) if not isinstance(p, Tensor) else p,
+                  Tensor(g)) for p, g in zip(params, grad_arrays)]
+        return [g._data for _, g in self._grad_clip(pairs)]
+
     # --- public api ---
     @no_grad()
     def step(self):
@@ -171,24 +206,13 @@ class Optimizer:
         if self._grad_clip is not None:
             params_grads = self._grad_clip(params_grads)
         params = [p for p, _ in params_grads]
-        for p in params:
-            self._ensure_state(p)
-        lr = jnp.asarray(self.get_lr(), jnp.float32)
         grads = [g._data for _, g in params_grads]
-        states = [self._accumulators[id(p)] for p in params]
-        masters = [self._master_weights.get(id(p)) for p in params]
-        wds = [jnp.asarray(self._param_decay_coeff(p), jnp.float32) for p in params]
-        lr_scales = [jnp.asarray(self._param_lr_scale(p), jnp.float32) for p in params]
+        lr, states, masters, wds, lr_scales = self._gather_update_args(params)
         args = _co_place(
             (lr, [p._data for p in params], grads, states, masters, wds, lr_scales)
         )
         new_params, new_states, new_masters = self._jit_update(*args)
-        for p, np_, st, mw in zip(params, new_params, new_states, new_masters):
-            p._data = np_
-            self._accumulators[id(p)] = st
-            if mw is not None:
-                self._master_weights[id(p)] = mw
-        self._after_step()
+        self._write_back(params, new_params, new_states, new_masters)
 
     def _after_step(self):
         self._step_count += 1
