@@ -145,6 +145,18 @@ def shard_layer(layer, process_mesh: ProcessMesh, shard_fn=None, input_fn=None, 
     return layer
 
 
+def apply_state_shard_fn(optimizer, shard_fn) -> None:
+    """Reshard accumulator state through a shard_optimizer shard_fn (the
+    ZeRO state-placement contract, shared by _ShardOptimizer.step and
+    DistModel's compiled train path)."""
+    if shard_fn is None:
+        return
+    for key, state in list(optimizer._accumulators.items()):
+        new = shard_fn(key, state)
+        if new is not None:
+            optimizer._accumulators[key] = new
+
+
 class _ShardOptimizer:
     """Wraps an optimizer so accumulator state is created sharded like its
     parameter (ZeRO-style state placement comes free: pass shard_fn to place
@@ -163,11 +175,7 @@ class _ShardOptimizer:
 
     def step(self):
         self._inner.step()
-        if self._shard_fn is not None:
-            for key, state in list(self._inner._accumulators.items()):
-                new = self._shard_fn(key, state)
-                if new is not None:
-                    self._inner._accumulators[key] = new
+        apply_state_shard_fn(self._inner, self._shard_fn)
 
     def clear_grad(self, *a, **k):
         self._inner.clear_grad(*a, **k)

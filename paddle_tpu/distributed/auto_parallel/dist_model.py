@@ -62,8 +62,11 @@ class DistModel:
         self._optimizer = optimizer
         self._shard_fn = shard_fn  # from a wrapping shard_optimizer
         self._strategy = strategy or Strategy()
-        self._mode = "train" if optimizer is not None else (
-            "eval" if loss is not None else "predict")
+        # train needs BOTH loss and optimizer; optimizer alone still lands
+        # in predict so the misconfiguration surfaces as the guarded
+        # RuntimeError from .train(), not a TypeError inside the jit trace
+        self._mode = ("train" if optimizer is not None and loss is not None
+                      else "eval" if loss is not None else "predict")
         self._state_names = sorted(_named_state(layer))
         self._cache: dict[tuple, Any] = {}
 
@@ -162,19 +165,23 @@ class DistModel:
                 lr, plist, glist, states, masters, wds, lrs)
             return loss, new_p, new_st, new_m
 
+        from ...optimizer.optimizer import _co_place
+        from .api import apply_state_shard_fn
+
         def run(datas_):
             train_state = {n: state_t[n]._data for n in trainable}
             frozen_state = {n: state_t[n]._data for n in frozen}
-            lr, states, masters, _, _ = opt._gather_update_args(train_params)
-            loss, new_p, new_st, new_m = step(
-                train_state, frozen_state, lr, states, masters, *datas_)
+            # hot path: only the per-step pieces (lr may change via
+            # scheduler; states/masters were replaced by the last step);
+            # wds/lrs are per-param constants captured at build
+            lr = jnp.asarray(opt.get_lr(), jnp.float32)
+            states = [opt._accumulators[id(p)] for p in train_params]
+            masters = [opt._master_weights.get(id(p)) for p in train_params]
+            args = _co_place(
+                (train_state, frozen_state, lr, states, masters, *datas_))
+            loss, new_p, new_st, new_m = step(*args)
             opt._write_back(train_params, new_p, new_st, new_m)
-            if self._shard_fn is not None:
-                # _ShardOptimizer parity: reshard accumulator state
-                for key_, st in list(opt._accumulators.items()):
-                    new = self._shard_fn(key_, st)
-                    if new is not None:
-                        opt._accumulators[key_] = new
+            apply_state_shard_fn(opt, self._shard_fn)
             return Tensor(loss)
 
         return run

@@ -1,0 +1,164 @@
+"""End-to-end small models (reference: test/book — fit_a_line,
+recognize_digits, word2vec, understand_sentiment…). Each exercises a
+different API stack to convergence: static graph, hapi, eager+jit, RNN.
+These are the reference's classic acceptance models, scaled to run in
+seconds on the virtual mesh."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, static
+from paddle_tpu.io import DataLoader
+from paddle_tpu.vision.datasets import FakeData
+
+
+def test_fit_a_line_static(rng):
+    """Linear regression through the static graph stack (book ch.1)."""
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 13], "float32")
+        y = static.data("y", [None, 1], "float32")
+        pred = static.nn.fc(x, 1)
+        loss = paddle.nn.functional.mse_loss(pred, y)
+        opt = paddle.optimizer.SGD(
+            learning_rate=0.05,
+            parameters=main.parameters())
+        opt.minimize(loss)
+    exe = static.Executor()
+    W = rng.randn(13, 1).astype("float32")
+    losses = []
+    for i in range(60):
+        xs = rng.randn(32, 13).astype("float32")
+        ys = xs @ W + 0.1
+        (lv,) = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+        losses.append(float(lv))
+    assert losses[-1] < losses[0] * 0.05, (losses[0], losses[-1])
+
+
+def test_recognize_digits_hapi(rng):
+    """LeNet on synthetic digits through Model.fit (book ch.2 via hapi)."""
+    from paddle_tpu.vision.models import LeNet
+
+    paddle.seed(0)
+
+    class Digits(FakeData):
+        def __getitem__(self, idx):
+            rng_ = np.random.RandomState(idx)
+            label = idx % 10
+            img = np.zeros((1, 28, 28), np.float32)
+            img[0, 2 + label * 2: 4 + label * 2, 4:24] = 1.0  # class stripe
+            img += rng_.randn(1, 28, 28).astype("float32") * 0.05
+            return img, np.int64(label)
+
+    ds = Digits(num_samples=200, shape=(1, 28, 28))
+    model = paddle.Model(LeNet(num_classes=10))
+    model.prepare(
+        paddle.optimizer.Adam(learning_rate=0.002,
+                              parameters=model.network.parameters()),
+        nn.CrossEntropyLoss(),
+        paddle.metric.Accuracy())
+    model.fit(DataLoader(ds, batch_size=32, shuffle=True), epochs=3,
+              verbose=0)
+    res = model.evaluate(DataLoader(ds, batch_size=64), verbose=0)
+    assert res["acc"] > 0.9, res
+
+
+def test_word2vec_eager_jit(rng):
+    """Skip-gram-style embedding trained eager, then the SAME layer served
+    through jit.to_static (book ch.5)."""
+    paddle.seed(0)
+    V, E = 50, 16
+    # synthetic corpus: word i co-occurs with (i +- 1) mod V
+    centers = rng.randint(0, V, 2000)
+    contexts = (centers + rng.choice([-1, 1], 2000)) % V
+
+    class SkipGram(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.emb_in = nn.Embedding(V, E)
+            self.emb_out = nn.Embedding(V, E)
+
+        def forward(self, center, context):
+            ei = self.emb_in(center)
+            eo = self.emb_out(context)
+            return (ei * eo).sum(axis=-1)
+
+    net = SkipGram()
+    opt = paddle.optimizer.Adam(learning_rate=0.05,
+                                parameters=net.parameters())
+    first = last = None
+    for i in range(0, 2000, 200):
+        c = paddle.to_tensor(centers[i:i + 200], "int64")
+        t = paddle.to_tensor(contexts[i:i + 200], "int64")
+        neg = paddle.to_tensor(rng.randint(0, V, 200), "int64")
+        pos_logit = net(c, t)
+        neg_logit = net(c, neg)
+        loss = (nn.functional.binary_cross_entropy_with_logits(
+                    pos_logit, paddle.ones_like(pos_logit))
+                + nn.functional.binary_cross_entropy_with_logits(
+                    neg_logit, paddle.zeros_like(neg_logit)))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        if first is None:
+            first = float(loss._data)
+        last = float(loss._data)
+    assert last < first * 0.7
+
+    # neighbors should be closer than random words in embedding space
+    emb = np.asarray(net.emb_in.weight._data)
+    emb = emb / np.linalg.norm(emb, axis=1, keepdims=True)
+    neighbor_sim = np.mean([emb[i] @ emb[(i + 1) % V] for i in range(V)])
+    far_sim = np.mean([emb[i] @ emb[(i + V // 2) % V] for i in range(V)])
+    assert neighbor_sim > far_sim
+
+    jf = paddle.jit.to_static(lambda c, t: net(c, t))
+    out = jf(paddle.to_tensor([1], "int64"), paddle.to_tensor([2], "int64"))
+    np.testing.assert_allclose(
+        np.asarray(out._data),
+        np.asarray(net(paddle.to_tensor([1], "int64"),
+                       paddle.to_tensor([2], "int64"))._data), rtol=1e-5)
+
+
+def test_understand_sentiment_rnn(rng):
+    """LSTM sentiment classifier (book ch.6): learn whether a sequence
+    contains the 'positive' token."""
+    paddle.seed(0)
+    V, E, H, L = 30, 16, 32, 12
+    POS = 7
+
+    class SentimentLSTM(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.emb = nn.Embedding(V, E)
+            self.lstm = nn.LSTM(E, H)
+            self.fc = nn.Linear(H, 2)
+
+        def forward(self, ids):
+            x = self.emb(ids)
+            out, _ = self.lstm(x)
+            return self.fc(out[:, -1])
+
+    def make_batch(n):
+        ids = rng.randint(0, V, (n, L))
+        ids[ids == POS] = POS + 1  # scrub
+        labels = rng.randint(0, 2, n)
+        for row, lab in enumerate(labels):
+            if lab:
+                ids[row, rng.randint(0, L)] = POS
+        return ids.astype("int64"), labels.astype("int64")
+
+    net = SentimentLSTM()
+    opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                parameters=net.parameters())
+    for i in range(40):
+        ids, labels = make_batch(32)
+        loss = nn.functional.cross_entropy(
+            net(paddle.to_tensor(ids)), paddle.to_tensor(labels))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    ids, labels = make_batch(128)
+    pred = np.asarray(net(paddle.to_tensor(ids))._data).argmax(-1)
+    acc = (pred == labels).mean()
+    assert acc > 0.85, acc

@@ -419,3 +419,17 @@ class TestDistModel:
         # with lr=1 and huge grads, only the clip can keep weights ~static
         np.testing.assert_allclose(np.asarray(layer.weight._data), w_before,
                                    atol=1e-4)
+
+    def test_dist_model_optimizer_without_loss_guarded(self):
+        import paddle_tpu as paddle
+        import paddle_tpu.distributed as dist
+        from paddle_tpu import nn
+        import pytest
+
+        layer = nn.Linear(2, 2)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=layer.parameters())
+        m = dist.to_static(layer, optimizer=opt)  # no loss
+        assert m._mode == "predict"  # not silently train
+        with pytest.raises(RuntimeError, match="loss"):
+            m.train()
