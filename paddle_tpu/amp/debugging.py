@@ -48,3 +48,75 @@ def check_numerics(tensor: Tensor, op_type: str = "", var_name: str = "", debug_
             f"check_numerics: {op_type}/{var_name} has {n_nan} NaN, {n_inf} Inf"
         )
     return Tensor(jnp.asarray(n_nan)), Tensor(jnp.asarray(n_inf)), Tensor(jnp.asarray(n_zero))
+
+
+_stats_ctx = None
+
+
+def enable_operator_stats_collection():
+    """Begin counting low-precision op calls (reference:
+    debugging.enable_operator_stats_collection — the paired-call form of
+    collect_operator_stats)."""
+    global _stats_ctx
+    if _stats_ctx is not None:
+        raise RuntimeError("operator stats collection already enabled")
+    _stats_ctx = collect_operator_stats()
+    _stats_ctx.__enter__()
+
+
+def disable_operator_stats_collection():
+    """Stop collection and print the op table."""
+    global _stats_ctx
+    if _stats_ctx is None:
+        raise RuntimeError("operator stats collection was not enabled")
+    ctx, _stats_ctx = _stats_ctx, None
+    ctx.__exit__(None, None, None)
+
+
+def compare_accuracy(dump_path: str, another_dump_path: str,
+                     output_filename: str, loss_scale: float = 1.0,
+                     dump_all_tensors: bool = False):
+    """Compare two runs' tensor dumps (reference:
+    amp/accuracy_compare.py — workbook of fp32-vs-fp16 op outputs).
+
+    Dumps are directories of .npy files with matching names (the
+    TensorCheckerConfig dump format here); writes a CSV report of
+    per-tensor max-abs and relative differences. ``loss_scale`` descales
+    the SECOND dump (the scaled low-precision run) before diffing;
+    tensors present in only one dump get explicit missing rows so an
+    incomplete run cannot read as a clean comparison.
+    """
+    import csv
+    import os
+
+    import numpy as np
+
+    if dump_all_tensors:
+        raise NotImplementedError(
+            "dump_all_tensors is a dump-phase option in the reference; "
+            "this comparator reads already-dumped directories")
+
+    a_files = {f for f in os.listdir(dump_path) if f.endswith(".npy")}
+    b_files = {f for f in os.listdir(another_dump_path) if f.endswith(".npy")}
+    rows = []
+    for name in sorted(a_files - b_files):
+        rows.append([name, "missing-in-second", "", "", "", ""])
+    for name in sorted(b_files - a_files):
+        rows.append([name, "missing-in-first", "", "", "", ""])
+    for name in sorted(a_files & b_files):
+        a = np.load(os.path.join(dump_path, name)).astype(np.float64)
+        b = np.load(os.path.join(another_dump_path, name)).astype(np.float64)
+        b = b / loss_scale
+        if a.shape != b.shape:
+            rows.append([name, "shape-mismatch", a.shape, b.shape, "", ""])
+            continue
+        diff = np.abs(a - b)
+        denom = np.maximum(np.abs(a), 1e-12)
+        rows.append([name, "ok", a.shape, b.shape,
+                     float(diff.max()), float((diff / denom).max())])
+    with open(output_filename, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["tensor", "status", "shape_a", "shape_b",
+                    "max_abs_diff", "max_rel_diff"])
+        w.writerows(rows)
+    return rows

@@ -246,3 +246,46 @@ class TestInitializers:
 
         q = np.asarray(I.Orthogonal()([6, 4], "float32"))
         np.testing.assert_allclose(q.T @ q, np.eye(4), atol=1e-5)
+
+
+def test_amp_operator_stats_paired_calls(rng):
+    import paddle_tpu as paddle
+    from paddle_tpu.amp import debugging as D
+
+    D.enable_operator_stats_collection()
+    with paddle.amp.auto_cast(level="O1"):
+        x = paddle.to_tensor(rng.randn(4, 4).astype("float32"))
+        (x @ x).sum()
+    D.disable_operator_stats_collection()
+    with pytest.raises(RuntimeError):
+        D.disable_operator_stats_collection()  # not enabled anymore
+
+
+def test_amp_compare_accuracy(tmp_path, rng):
+    from paddle_tpu.amp import debugging as D
+
+    a, b = tmp_path / "a", tmp_path / "b"
+    a.mkdir(); b.mkdir()
+    np.save(a / "t.npy", np.ones(4, np.float32))
+    np.save(b / "t.npy", np.ones(4, np.float32) * 2)
+    rows = D.compare_accuracy(str(a), str(b), str(tmp_path / "out.csv"))
+    assert rows[0][4] == 1.0  # max abs diff
+    assert (tmp_path / "out.csv").exists()
+
+
+def test_amp_compare_accuracy_missing_and_scale(tmp_path):
+    from paddle_tpu.amp import debugging as D
+
+    a, b = tmp_path / "a", tmp_path / "b"
+    a.mkdir(); b.mkdir()
+    np.save(a / "shared.npy", np.ones(3, np.float32))
+    np.save(b / "shared.npy", np.ones(3, np.float32) * 128)  # scaled run
+    np.save(a / "only_a.npy", np.ones(2, np.float32))
+    rows = D.compare_accuracy(str(a), str(b), str(tmp_path / "r.csv"),
+                              loss_scale=128.0)
+    by_name = {r[0]: r for r in rows}
+    assert by_name["only_a.npy"][1] == "missing-in-second"
+    assert by_name["shared.npy"][4] == 0.0  # descaled -> identical
+    with pytest.raises(NotImplementedError):
+        D.compare_accuracy(str(a), str(b), str(tmp_path / "r2.csv"),
+                           dump_all_tensors=True)
