@@ -198,10 +198,17 @@ def apply_op(name: str, fn: Callable, *args, **kwargs):
     out_treedef_box = [None]
 
     def rebuild(diff_datas):
+        from ..framework.random import RngKey
+
         rebuilt = list(leaves)
         for p, d in zip(diff_pos, diff_datas):
             rebuilt[p] = d
-        rebuilt = [l._data if isinstance(l, Tensor) else l for l in rebuilt]
+        rebuilt = [
+            l._data if isinstance(l, Tensor)
+            else l.key if isinstance(l, RngKey)
+            else l
+            for l in rebuilt
+        ]
         a, kw = jax.tree.unflatten(treedef, rebuilt)
         return a, kw
 

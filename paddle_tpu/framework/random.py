@@ -56,7 +56,30 @@ class Generator:
         self._base_key = None
 
 
+class RngKey:
+    """Marker for a PRNG key passed as an op argument.
+
+    Random ops pass ``rng_arg()`` through ``apply_op`` instead of closing
+    over a concrete key. The autograd engine unwraps the marker before
+    calling the op's pure function; the static recorder replaces it with a
+    per-program rng slot so every ``Executor.run`` folds a fresh base key in
+    and replays a *new* mask (reference: the dropout op's seed attribute is
+    resolved per-run from the DeviceContext generator, not baked into the
+    ProgramDesc — phi/kernels/funcs/dropout_impl.cu.h seed_offset handling).
+    """
+
+    __slots__ = ("key",)
+
+    def __init__(self, key):
+        self.key = key
+
+
 default_generator = Generator(seed=np.random.randint(0, 2**31 - 1))
+
+
+def rng_arg() -> RngKey:
+    """A fresh key from the default generator, wrapped for op-arg passing."""
+    return RngKey(default_generator.next_key())
 
 
 def seed(value: int) -> Generator:

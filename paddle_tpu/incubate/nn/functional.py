@@ -45,21 +45,22 @@ def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
                       seed=None, name=None):
     """out = dropout(x) + y in one kernel (reference:
     fused_dropout_add op)."""
-    from ...framework.random import default_generator
+    from ...framework.random import rng_arg
 
     if not training or p == 0.0:
         return apply_op("fused_dropout_add", lambda a, b: a + b, x, y)
-    key = (default_generator.next_key() if seed is None
-           else jax.random.PRNGKey(seed))
     keep = 1.0 - p
 
-    def fn(a, b):
+    def fn(a, b, key):
         mask = jax.random.bernoulli(key, keep, a.shape)
         if mode == "upscale_in_train":
             return jnp.where(mask, a / keep, 0.0) + b
         return jnp.where(mask, a, 0.0) + b
 
-    return apply_op("fused_dropout_add", fn, x, y)
+    # explicit seed stays a baked constant (deterministic, reference parity);
+    # generator-drawn keys go through rng_arg so static replays re-randomize
+    karg = rng_arg() if seed is None else jax.random.PRNGKey(seed)
+    return apply_op("fused_dropout_add", fn, x, y, karg)
 
 
 def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
@@ -145,13 +146,12 @@ def fused_bias_dropout_residual_layer_norm(x, residual, bias=None,
                                            ln_scale=None, ln_bias=None,
                                            dropout_rate=0.0, epsilon=1e-5,
                                            training=True, **kw):
-    from ...framework.random import default_generator
+    from ...framework.random import rng_arg
 
-    key = (default_generator.next_key()
-           if training and dropout_rate > 0.0 else None)
+    with_dropout = training and dropout_rate > 0.0
     keep = 1.0 - dropout_rate
 
-    def fn(x_, res, b, w, lb):
+    def fn(x_, res, b, w, lb, key=None):
         y = x_ + b if b is not None else x_
         if key is not None:
             mask = jax.random.bernoulli(key, keep, y.shape)
@@ -168,7 +168,8 @@ def fused_bias_dropout_residual_layer_norm(x, residual, bias=None,
         return out
 
     return apply_op("fused_bias_dropout_residual_ln", fn, x, residual, bias,
-                    ln_scale, ln_bias)
+                    ln_scale, ln_bias,
+                    **({"key": rng_arg()} if with_dropout else {}))
 
 
 def memory_efficient_attention(query, key, value, attn_bias=None, p=0.0,
@@ -196,17 +197,23 @@ def variable_length_memory_efficient_attention(query, key, value, seq_lens,
         scores = jnp.einsum("bhsd,bhtd->bhst", q_, k_) * s
         kv_pos = jnp.arange(k_.shape[2])
         key_mask = kv_pos[None, :] < kvl.reshape(-1, 1)  # [B, T]
-        scores = jnp.where(key_mask[:, None, None, :], scores, -jnp.inf)
+        # finite fill: -inf would make a fully-masked row (kv_seq_len == 0)
+        # produce NaN through softmax that survives the final q-mask
+        neg = jnp.asarray(-1e30, scores.dtype)
+        scores = jnp.where(key_mask[:, None, None, :], scores, neg)
         if causal:
             q_pos = jnp.arange(S)
             scores = jnp.where(
-                q_pos[:, None] >= kv_pos[None, :], scores, -jnp.inf)
+                q_pos[:, None] >= kv_pos[None, :], scores, neg)
         if m is not None:
             scores = scores + m
         p_ = jax.nn.softmax(scores, axis=-1)
         out = jnp.einsum("bhst,bhtd->bhsd", p_, v_)
         q_mask = jnp.arange(S)[None, :] < sl.reshape(-1, 1)
-        return jnp.where(q_mask[:, None, :, None], out, 0.0)
+        out = jnp.where(q_mask[:, None, :, None], out, 0.0)
+        # rows with no valid key at all contribute zeros, not a uniform avg
+        any_key = key_mask.any(axis=-1)[:, None, None, None]
+        return jnp.where(any_key, out, 0.0)
 
     return apply_op("varlen_mem_efficient_attention", fn, query, key, value,
                     seq_lens, kv_seq_lens, mask)

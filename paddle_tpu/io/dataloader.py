@@ -84,10 +84,28 @@ def _rewrap(obj):
     return obj
 
 
-def _worker_loop(dataset, index_queue, data_queue, collate_fn, worker_id, num_workers, seed):
+# Sentinel shipped through the shm ring when a batch is too large for it;
+# the real payload travels on the sidecar pipe queue instead.
+_VIA_PIPE = "__pd_batch_via_pipe__"
+
+
+def _worker_loop(dataset, index_queue, data_queue, collate_fn, worker_id,
+                 num_workers, seed, side_queue=None):
     global _worker_info
     _worker_info = WorkerInfo(worker_id, num_workers, dataset, seed)
     np.random.seed((seed + worker_id) & 0x7FFFFFFF)
+
+    def put(msg):
+        try:
+            data_queue.put(msg)
+        except ValueError:
+            # shm ring: message exceeds ring capacity — fall back to the
+            # pipe for this batch (marker through the ring keeps ordering)
+            if side_queue is None:
+                raise
+            side_queue.put(msg)
+            data_queue.put((msg[0], _VIA_PIPE, None))
+
     while True:
         task = index_queue.get()
         if task is None:
@@ -96,15 +114,15 @@ def _worker_loop(dataset, index_queue, data_queue, collate_fn, worker_id, num_wo
         try:
             samples = [dataset[i] for i in indices]
             data = collate_fn(samples)
-            data_queue.put((batch_id, data, None))
+            put((batch_id, data, None))
         except Exception as e:  # propagate to main process
             try:
-                data_queue.put((batch_id, None, e))
+                put((batch_id, None, e))
             except Exception:
                 # the exception itself may be unpicklable — send its repr so
                 # the main process still gets a diagnostic instead of hanging
                 try:
-                    data_queue.put((batch_id, None, RuntimeError(
+                    put((batch_id, None, RuntimeError(
                         f"worker {worker_id}: {type(e).__name__}: {e!r} "
                         "(original exception was unpicklable)")))
                 except Exception:
@@ -229,13 +247,16 @@ class DataLoader:
             except Exception:
                 ring = None  # no native toolchain: pipe transport fallback
         data_queue = ring if ring is not None else ctx.Queue()
+        # sidecar pipe for batches that exceed the ring capacity
+        side_queue = ctx.Queue() if ring is not None else None
         workers = []
         collate = _np_collate if self.collate_fn is None else self.collate_fn
         for wid in range(self.num_workers):
             iq = ctx.Queue()
             w = ctx.Process(
                 target=_worker_loop,
-                args=(self.dataset, iq, data_queue, collate, wid, self.num_workers, seed),
+                args=(self.dataset, iq, data_queue, collate, wid,
+                      self.num_workers, seed, side_queue),
                 daemon=True,
             )
             w.start()
@@ -257,6 +278,9 @@ class DataLoader:
                 n_dispatched += 1
             while n_received < n_dispatched:
                 bid, data, err = data_queue.get()
+                if isinstance(data, str) and data == _VIA_PIPE:
+                    # oversized batch: payload came through the sidecar pipe
+                    bid, data, err = side_queue.get()
                 n_received += 1
                 if err is not None:
                     raise err

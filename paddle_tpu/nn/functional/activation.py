@@ -100,16 +100,14 @@ def prelu(x, weight, data_format="NCHW", name=None):
 
 
 def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=True, name=None):
-    from ...framework.random import default_generator
+    from ...framework.random import rng_arg
 
     if training:
-        key = default_generator.next_key()
-
-        def fn(v):
+        def fn(v, key):
             alpha = jax.random.uniform(key, v.shape, v.dtype, lower, upper)
             return jnp.where(v >= 0, v, alpha * v)
 
-        return apply_op("rrelu", fn, x)
+        return apply_op("rrelu", fn, x, rng_arg())
     mid = (lower + upper) / 2.0
     return apply_op("rrelu", lambda v: jnp.where(v >= 0, v, mid * v), x)
 
@@ -143,11 +141,9 @@ def log_softmax(x, axis=-1, dtype=None, name=None):
 
 
 def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
-    from ...framework.random import default_generator
+    from ...framework.random import rng_arg
 
-    key = default_generator.next_key()
-
-    def fn(v):
+    def fn(v, key):
         g = -jnp.log(-jnp.log(jax.random.uniform(key, v.shape) + 1e-20) + 1e-20)
         y = jax.nn.softmax((v + g) / temperature, axis=axis)
         if hard:
@@ -157,7 +153,7 @@ def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
             y = one_hot + y - jax.lax.stop_gradient(y)
         return y
 
-    return apply_op("gumbel_softmax", fn, x)
+    return apply_op("gumbel_softmax", fn, x, rng_arg())
 
 
 def maxout(x, groups, axis=1, name=None):

@@ -11,7 +11,7 @@ import jax
 import jax.numpy as jnp
 
 from ...autograd.engine import apply_op
-from ...framework.random import default_generator
+from ...framework.random import default_generator, rng_arg
 from ...tensor.tensor import Tensor
 
 
@@ -25,9 +25,8 @@ def linear(x, weight, bias=None, name=None):
 def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
     if not training or p == 0.0:
         return x.clone() if isinstance(x, Tensor) else x
-    key = default_generator.next_key()
 
-    def fn(v):
+    def fn(v, key):
         shape = list(v.shape)
         if axis is not None:
             axes = [axis] if isinstance(axis, int) else list(axis)
@@ -39,7 +38,7 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=No
             return jnp.where(keep, v / (1.0 - p), jnp.zeros((), v.dtype)).astype(v.dtype)
         return jnp.where(keep, v, jnp.zeros((), v.dtype))
 
-    return apply_op("dropout", fn, x)
+    return apply_op("dropout", fn, x, rng_arg())
 
 
 def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
@@ -55,18 +54,17 @@ def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
 def alpha_dropout(x, p=0.5, training=True, name=None):
     if not training or p == 0.0:
         return x.clone()
-    key = default_generator.next_key()
     alpha = 1.6732632423543772848170429916717
     scale = 1.0507009873554804934193349852946
     alpha_p = -alpha * scale
 
-    def fn(v):
+    def fn(v, key):
         keep = jax.random.bernoulli(key, 1.0 - p, v.shape)
         a = (1.0 / np.sqrt((1.0 - p) * (1.0 + p * alpha_p**2))).astype(np.float32)
         b = -a * alpha_p * p
         return (a * jnp.where(keep, v, jnp.asarray(alpha_p, v.dtype)) + b).astype(v.dtype)
 
-    return apply_op("alpha_dropout", fn, x)
+    return apply_op("alpha_dropout", fn, x, rng_arg())
 
 
 def embedding(x, weight, padding_idx=None, sparse=False, name=None):

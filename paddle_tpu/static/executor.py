@@ -69,7 +69,7 @@ class Executor:
             feed_arrays[name] = jnp.asarray(value, jdt)
 
         key = (
-            id(program), program._version, tuple(fetch_vids),
+            program._uid, program._version, tuple(fetch_vids),
             tuple(sorted((n, a.shape, str(a.dtype))
                          for n, a in feed_arrays.items())),
         )
@@ -98,7 +98,7 @@ class Executor:
         needed_feeds = {name: vid for name, vid in program._feeds.items()
                         if vid in (consumed | targets) and vid not in produced}
 
-        def replay(env, pvals):
+        def replay(env, pvals, rng_key):
             for st in stmts:
                 leaf_vals = []
                 for kind, ref in st.leaf_refs:
@@ -106,6 +106,9 @@ class Executor:
                         leaf_vals.append(env[ref])
                     elif kind == "p":
                         leaf_vals.append(pvals[ref])
+                    elif kind == "rng":
+                        # fresh per-run key per rng slot: replays re-randomize
+                        leaf_vals.append(jax.random.fold_in(rng_key, ref))
                     else:
                         leaf_vals.append(ref)
                 a, kw = jax.tree.unflatten(st.treedef, leaf_vals)
@@ -123,15 +126,32 @@ class Executor:
                 env[vid] = feed_arrays[name]
             return env
 
+        has_rng = any(kind == "rng" for st in stmts
+                      for kind, _ in st.leaf_refs)
+
+        def run_key():
+            """Per-run base key. program.random_seed pins it (reference: a
+            seeded program replays identical masks); otherwise draw from the
+            global generator so paddle.seed reproducibility holds. Programs
+            without random ops must not consume a generator tick (it would
+            perturb eager sampling sequences interleaved with runs)."""
+            if not has_rng:
+                return jax.random.key(0)
+            if program.random_seed is not None:
+                return jax.random.key(int(program.random_seed))
+            from ..framework.random import default_generator
+
+            return default_generator.next_key()
+
         if not with_opt:
             @jax.jit
-            def fwd(feed_arrays, pvals):
-                env = replay(seed_env(feed_arrays), pvals)
+            def fwd(feed_arrays, pvals, rng_key):
+                env = replay(seed_env(feed_arrays), pvals, rng_key)
                 return [env[v] for v in fetch_vids]
 
             def entry(feed_arrays, return_numpy):
                 pvals = {n: p._data for n, p in params.items()}
-                outs = fwd(feed_arrays, pvals)
+                outs = fwd(feed_arrays, pvals, run_key())
                 return [np.asarray(o) if return_numpy else Tensor(o)
                         for o in outs]
 
@@ -152,10 +172,10 @@ class Executor:
 
         @jax.jit
         def step(feed_arrays, train_arrays, frozen_arrays, lr, states,
-                 masters):
+                 masters, rng_key):
             def loss_fn(train_arrays):
                 pvals = {**frozen_arrays, **train_arrays}
-                env = replay(seed_env(feed_arrays), pvals)
+                env = replay(seed_env(feed_arrays), pvals, rng_key)
                 return env[loss_vid], [env[v] for v in fetch_vids]
 
             (_, fetches), grads = jax.value_and_grad(
@@ -178,7 +198,8 @@ class Executor:
             states = [opt._accumulators[id(p)] for p in train_params]
             masters = [opt._master_weights.get(id(p)) for p in train_params]
             fetches, new_p, new_st, new_m = step(
-                feed_arrays, train_arrays, frozen_arrays, lr, states, masters)
+                feed_arrays, train_arrays, frozen_arrays, lr, states, masters,
+                run_key())
             for p, pa, st, mw in zip(train_params, new_p, new_st, new_m):
                 p._data = pa
                 opt._accumulators[id(p)] = st

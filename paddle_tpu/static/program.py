@@ -28,9 +28,11 @@ import numpy as np
 
 from ..autograd import engine
 from ..framework import dtype as dtype_mod
+from ..framework.random import RngKey
 from ..tensor.tensor import Parameter, Tensor
 
 _vid_counter = itertools.count(1)
+_program_uid = itertools.count(1)
 
 
 class Statement:
@@ -38,7 +40,9 @@ class Statement:
 
     ``leaf_refs`` mirrors the flattened (args, kwargs) pytree; each entry is
     ``("v", vid)`` for a produced-in-program variable, ``("p", name)`` for a
-    Parameter (lives in the scope, updatable between runs), or
+    Parameter (lives in the scope, updatable between runs), ``("rng", slot)``
+    for a PRNG key the Executor re-derives per run (so dropout/random ops
+    re-randomize on replay instead of baking the recorded mask), or
     ``("c", value)`` for a captured constant / python literal.
     """
 
@@ -63,6 +67,7 @@ class Program:
 
     def __init__(self):
         self._origin = self  # clones share identity for var ownership checks
+        self._uid = next(_program_uid)  # unique even across GC'd id() reuse
         self._statements: list[Statement] = []
         self._feeds: dict[str, int] = {}
         self._feed_specs: dict[str, tuple] = {}
@@ -70,9 +75,20 @@ class Program:
         self._params: dict[str, Parameter] = {}
         self._optimizer = None
         self._loss_vid: int | None = None
-        self._version = 0
+        # Shared mutable cells so clones see recordings into the origin, the
+        # Executor cache can't serve a stale compiled entry, and rng slot
+        # numbers stay unique across the shared statement list.
+        self._version_cell = [0]
+        self._rng_cell = [0]
         self._var_names: dict[int, str] = {}
         self.random_seed = None
+
+    @property
+    def _version(self) -> int:
+        return self._version_cell[0]
+
+    def _bump_version(self):
+        self._version_cell[0] += 1
 
     # -- recording ---------------------------------------------------------
     def _record(self, name, fn, treedef, leaves, out_tensors):
@@ -88,6 +104,9 @@ class Program:
                     leaf_refs.append(("v", vid[1]))
                 else:
                     leaf_refs.append(("c", leaf._data))
+            elif isinstance(leaf, RngKey):
+                leaf_refs.append(("rng", self._rng_cell[0]))
+                self._rng_cell[0] += 1
             else:
                 leaf_refs.append(("c", leaf))
         out_vids = []
@@ -97,7 +116,7 @@ class Program:
             out_vids.append(vid)
         self._statements.append(
             Statement(name, fn, treedef, leaf_refs, out_vids))
-        self._version += 1
+        self._bump_version()
 
     def _add_feed(self, name, tensor, shape, dtype):
         vid = next(_vid_counter)
@@ -106,7 +125,7 @@ class Program:
         self._feed_specs[name] = (tuple(shape), dtype)
         self._var_names[vid] = name
         self._feed_tensors[name] = tensor  # for gradients()/append_backward
-        self._version += 1
+        self._bump_version()
 
     def _set_optimizer(self, optimizer, loss):
         vid = getattr(loss, "_static_vid", None)
@@ -115,7 +134,7 @@ class Program:
                 "minimize(loss): loss was not produced inside this Program")
         self._optimizer = optimizer
         self._loss_vid = vid[1]
-        self._version += 1
+        self._bump_version()
 
     # -- introspection -----------------------------------------------------
     def parameters(self):
@@ -143,6 +162,7 @@ class Program:
         """
         p = Program.__new__(Program)
         p.__dict__.update(self.__dict__)
+        p._uid = next(_program_uid)  # own cache identity; version cell shared
         if for_test:
             p._optimizer = None
             p._loss_vid = None

@@ -2,7 +2,11 @@
 
 Parity: python/paddle/tensor/random.py. All draws consume keys from the global
 default_generator (framework/random.py) so seeding/reproducibility matches
-paddle.seed semantics, and jit tracing can thread keys as inputs.
+paddle.seed semantics. Keys are passed through ``apply_op`` as ``RngKey``
+arguments (not closed over), so jit tracing threads them as inputs and the
+static recorder replaces them with per-run rng slots — an ``Executor.run``
+replay re-draws like the reference's gaussian_random/uniform_random ops do
+per execution (phi/kernels/gpu/gaussian_kernel.cu seed handling).
 """
 from __future__ import annotations
 
@@ -14,7 +18,7 @@ import jax.numpy as jnp
 from ..autograd.engine import apply_op
 from ..framework import config
 from ..framework import dtype as dtype_mod
-from ..framework.random import default_generator
+from ..framework.random import default_generator, rng_arg
 from .creation import _shape_list
 from .tensor import Tensor
 
@@ -26,13 +30,15 @@ def _resolve(dtype):
 
 
 def rand(shape, dtype=None, name=None) -> Tensor:
-    key = default_generator.next_key()
-    return Tensor(jax.random.uniform(key, _shape_list(shape), _resolve(dtype)))
+    shape, jdt = _shape_list(shape), _resolve(dtype)
+    return apply_op(
+        "uniform", lambda key: jax.random.uniform(key, shape, jdt), rng_arg())
 
 
 def randn(shape, dtype=None, name=None) -> Tensor:
-    key = default_generator.next_key()
-    return Tensor(jax.random.normal(key, _shape_list(shape), _resolve(dtype)))
+    shape, jdt = _shape_list(shape), _resolve(dtype)
+    return apply_op(
+        "gaussian", lambda key: jax.random.normal(key, shape, jdt), rng_arg())
 
 
 def standard_normal(shape, dtype=None, name=None) -> Tensor:
@@ -40,67 +46,77 @@ def standard_normal(shape, dtype=None, name=None) -> Tensor:
 
 
 def normal(mean=0.0, std=1.0, shape=None, name=None) -> Tensor:
-    key = default_generator.next_key()
     if isinstance(mean, Tensor) or isinstance(std, Tensor):
-        m = mean._data if isinstance(mean, Tensor) else mean
-        s = std._data if isinstance(std, Tensor) else std
-        out_shape = np.broadcast_shapes(
-            np.shape(m) if not isinstance(m, jax.Array) else m.shape,
-            np.shape(s) if not isinstance(s, jax.Array) else s.shape,
-        )
-        return Tensor(jax.random.normal(key, out_shape) * s + m)
-    shape = _shape_list(shape) if shape is not None else []
-    return Tensor(jax.random.normal(key, shape) * std + mean)
+        def fn(m, s, key):
+            out_shape = np.broadcast_shapes(jnp.shape(m), jnp.shape(s))
+            return jax.random.normal(key, out_shape) * s + m
+
+        return apply_op("gaussian", fn, mean, std, rng_arg())
+    out_shape = _shape_list(shape) if shape is not None else []
+    return apply_op(
+        "gaussian",
+        lambda key: jax.random.normal(key, out_shape) * std + mean,
+        rng_arg())
 
 
 def gaussian(shape, mean=0.0, std=1.0, seed=0, dtype=None, name=None) -> Tensor:
-    key = default_generator.next_key() if seed == 0 else jax.random.key(seed)
-    return Tensor(jax.random.normal(key, _shape_list(shape), _resolve(dtype)) * std + mean)
+    shape, jdt = _shape_list(shape), _resolve(dtype)
+    karg = rng_arg() if seed == 0 else jax.random.key(seed)
+    return apply_op(
+        "gaussian",
+        lambda key: jax.random.normal(key, shape, jdt) * std + mean, karg)
 
 
 def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None) -> Tensor:
-    key = default_generator.next_key() if seed == 0 else jax.random.key(seed)
-    return Tensor(
-        jax.random.uniform(key, _shape_list(shape), _resolve(dtype), minval=min, maxval=max)
-    )
+    shape, jdt = _shape_list(shape), _resolve(dtype)
+    karg = rng_arg() if seed == 0 else jax.random.key(seed)
+    return apply_op(
+        "uniform",
+        lambda key: jax.random.uniform(key, shape, jdt, minval=min, maxval=max),
+        karg)
 
 
 def randint(low=0, high=None, shape=[1], dtype="int64", name=None) -> Tensor:
     if high is None:
         low, high = 0, low
-    key = default_generator.next_key()
-    return Tensor(
-        jax.random.randint(key, _shape_list(shape), low, high).astype(
-            dtype_mod.to_jax_dtype(dtype)
-        )
-    )
+    shape, jdt = _shape_list(shape), dtype_mod.to_jax_dtype(dtype)
+    return apply_op(
+        "randint",
+        lambda key: jax.random.randint(key, shape, low, high).astype(jdt),
+        rng_arg())
 
 
 def randint_like(x, low=0, high=None, dtype=None, name=None) -> Tensor:
     if high is None:
         low, high = 0, low
-    key = default_generator.next_key()
     want = dtype_mod.to_jax_dtype(dtype) if dtype is not None else x._data.dtype
-    return Tensor(jax.random.randint(key, x._data.shape, low, high).astype(want))
+    shape = x._data.shape
+    return apply_op(
+        "randint",
+        lambda key: jax.random.randint(key, shape, low, high).astype(want),
+        rng_arg())
 
 
 def randperm(n, dtype="int64", name=None) -> Tensor:
-    key = default_generator.next_key()
-    return Tensor(jax.random.permutation(key, n).astype(dtype_mod.to_jax_dtype(dtype)))
+    jdt = dtype_mod.to_jax_dtype(dtype)
+    return apply_op(
+        "randperm",
+        lambda key: jax.random.permutation(key, n).astype(jdt), rng_arg())
 
 
 def shuffle(x, axis=0):
-    key = default_generator.next_key()
-    return apply_op("shuffle", lambda v: jax.random.permutation(key, v, axis=axis), x)
+    return apply_op(
+        "shuffle",
+        lambda v, key: jax.random.permutation(key, v, axis=axis),
+        x, rng_arg())
 
 
 def bernoulli(x, name=None) -> Tensor:
-    key = default_generator.next_key()
     return apply_op(
         "bernoulli",
-        lambda p: jax.random.bernoulli(key, p.astype(jnp.float32)).astype(p.dtype),
-        x,
-    )
+        lambda p, key: jax.random.bernoulli(
+            key, p.astype(jnp.float32)).astype(p.dtype),
+        x, rng_arg())
 
 
 def bernoulli_(x, p=0.5, name=None):
@@ -110,10 +126,11 @@ def bernoulli_(x, p=0.5, name=None):
 
 
 def poisson(x, name=None) -> Tensor:
-    key = default_generator.next_key()
     return apply_op(
-        "poisson", lambda lam: jax.random.poisson(key, lam.astype(jnp.float32)).astype(lam.dtype), x
-    )
+        "poisson",
+        lambda lam, key: jax.random.poisson(
+            key, lam.astype(jnp.float32)).astype(lam.dtype),
+        x, rng_arg())
 
 
 def multinomial(x, num_samples=1, replacement=False, name=None) -> Tensor:
@@ -137,15 +154,17 @@ def multinomial(x, num_samples=1, replacement=False, name=None) -> Tensor:
 
 
 def rand_like(x, dtype=None, name=None):
-    key = default_generator.next_key()
     want = dtype_mod.to_jax_dtype(dtype) if dtype is not None else x._data.dtype
-    return Tensor(jax.random.uniform(key, x._data.shape, want))
+    shape = x._data.shape
+    return apply_op(
+        "uniform", lambda key: jax.random.uniform(key, shape, want), rng_arg())
 
 
 def randn_like(x, dtype=None, name=None):
-    key = default_generator.next_key()
     want = dtype_mod.to_jax_dtype(dtype) if dtype is not None else x._data.dtype
-    return Tensor(jax.random.normal(key, x._data.shape, want))
+    shape = x._data.shape
+    return apply_op(
+        "gaussian", lambda key: jax.random.normal(key, shape, want), rng_arg())
 
 
 def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
@@ -167,10 +186,8 @@ def exponential_(x, lam=1.0, name=None):
 
 
 def binomial(count, prob, name=None):
-    key = default_generator.next_key()
     return apply_op(
         "binomial",
-        lambda n, p: jax.random.binomial(key, n.astype(jnp.float32), p.astype(jnp.float32)).astype(jnp.int64),
-        count,
-        prob,
-    )
+        lambda n, p, key: jax.random.binomial(
+            key, n.astype(jnp.float32), p.astype(jnp.float32)).astype(jnp.int64),
+        count, prob, rng_arg())

@@ -137,3 +137,19 @@ def test_varlen_attention_masks_tail(rng):
     # batch 0 rows past seq_len 2 are zeroed
     np.testing.assert_allclose(arr[0, :, 2:], 0.0)
     assert not np.allclose(arr[1, :, 2:], 0.0)
+
+
+def test_varlen_attention_zero_length_row_no_nan(rng):
+    """A batch row with kv_seq_len == 0 must produce zeros, not NaN (every
+    score masked -> softmax NaN would survive the q-mask otherwise)."""
+    B, H, S, D = 2, 2, 4, 8
+    q = paddle.to_tensor(rng.randn(B, H, S, D).astype("float32"))
+    k = paddle.to_tensor(rng.randn(B, H, S, D).astype("float32"))
+    v = paddle.to_tensor(rng.randn(B, H, S, D).astype("float32"))
+    sl = paddle.to_tensor(np.array([4, 4], np.int32))
+    kvl = paddle.to_tensor(np.array([0, 4], np.int32))
+    out = FF.variable_length_memory_efficient_attention(q, k, v, sl, kvl)
+    arr = np.asarray(out._data)
+    assert np.isfinite(arr).all(), "NaN leaked from fully-masked row"
+    np.testing.assert_allclose(arr[0], 0.0)
+    assert not np.allclose(arr[1], 0.0)

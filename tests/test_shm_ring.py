@@ -171,3 +171,39 @@ def test_wrap_never_overruns_unread_data():
         assert ring.get_bytes(timeout=5) == b"c" * 47
     finally:
         ring.free()
+
+
+def test_dataloader_oversized_batch_falls_back_to_pipe():
+    """A collated batch bigger than the ring capacity must still arrive
+    (sidecar pipe transport), not raise ValueError in the worker."""
+    from paddle_tpu.io import DataLoader
+    from paddle_tpu.io import dataloader as dl_mod
+    from paddle_tpu.io.dataset import Dataset
+
+    class BigDs(Dataset):
+        def __getitem__(self, i):
+            # one sample ~1MB; batch of 4 > 2MB test ring
+            return np.full((256 * 1024,), i, np.float32)
+
+        def __len__(self):
+            return 8
+
+    real_ring = dl_mod.ShmRing if hasattr(dl_mod, "ShmRing") else None
+    import paddle_tpu.io.shm_ring as ring_mod
+
+    orig_init = ring_mod.ShmRing.__init__
+
+    def tiny_init(self, name=None, capacity=128 << 20, create=True):
+        orig_init(self, name=name, capacity=2 << 20, create=create)
+
+    ring_mod.ShmRing.__init__ = tiny_init
+    try:
+        loader = DataLoader(BigDs(), batch_size=4, num_workers=2,
+                            shuffle=False, use_shared_memory=True)
+        seen = []
+        for x in loader:
+            seen.append(np.asarray(x._data)[:, 0].astype(int).tolist())
+        got = sorted(v for batch in seen for v in batch)
+        assert got == list(range(8))
+    finally:
+        ring_mod.ShmRing.__init__ = orig_init

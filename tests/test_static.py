@@ -283,3 +283,102 @@ def test_control_flow_implicit_defaults():
     got2 = static.nn.switch_case(paddle.to_tensor(np.array(9)),
                                  {0: lambda: x, 2: lambda: x * 5})
     assert float(got2._data) == 15.0
+
+
+def test_static_dropout_rerandomizes_per_run(rng):
+    """Replay must fold a fresh key per run: a recorded dropout may not bake
+    the record-time mask (reference: dropout seed resolved per-run from the
+    generator, not stored in the ProgramDesc)."""
+    main = static.Program()
+    with static.program_guard(main, static.Program()):
+        x = static.data("x", [None, 64], "float32")
+        y = paddle.nn.functional.dropout(x, p=0.5, training=True)
+    exe = static.Executor()
+    feed_x = np.ones((4, 64), "float32")
+    (a,) = exe.run(main, feed={"x": feed_x}, fetch_list=[y])
+    (b,) = exe.run(main, feed={"x": feed_x}, fetch_list=[y])
+    assert not np.array_equal(a, b), "dropout mask identical across runs"
+    # upscale_in_train semantics on the kept entries
+    kept = a[a != 0]
+    np.testing.assert_allclose(kept, 2.0, rtol=1e-6)
+
+
+def test_static_dropout_seeded_program_reproducible(rng):
+    """program.random_seed pins the per-run key: runs become identical."""
+    main = static.Program()
+    with static.program_guard(main, static.Program()):
+        x = static.data("x", [None, 64], "float32")
+        y = paddle.nn.functional.dropout(x, p=0.5, training=True)
+    main.random_seed = 42
+    exe = static.Executor()
+    feed_x = np.ones((4, 64), "float32")
+    (a,) = exe.run(main, feed={"x": feed_x}, fetch_list=[y])
+    (b,) = exe.run(main, feed={"x": feed_x}, fetch_list=[y])
+    np.testing.assert_array_equal(a, b)
+
+
+def test_static_random_creation_rerandomizes(rng):
+    """paddle.randn recorded in a program re-draws per run (reference:
+    gaussian_random executes per run, it is not a baked constant)."""
+    main = static.Program()
+    with static.program_guard(main, static.Program()):
+        x = static.data("x", [None, 8], "float32")
+        noise = paddle.randn([8])
+        y = x + noise
+    exe = static.Executor()
+    feed_x = np.zeros((1, 8), "float32")
+    (a,) = exe.run(main, feed={"x": feed_x}, fetch_list=[y])
+    (b,) = exe.run(main, feed={"x": feed_x}, fetch_list=[y])
+    assert not np.array_equal(a, b), "recorded randn was baked as a constant"
+
+
+def test_clone_then_record_invalidates_cache(rng):
+    """Recording into the origin after clone() must not serve the clone's
+    stale compiled entry (shared version cell; uid-keyed cache)."""
+    main = static.Program()
+    with static.program_guard(main, static.Program()):
+        x = static.data("x", [None, 4], "float32")
+        y = x * 2.0
+    test_prog = main.clone(for_test=True)
+    exe = static.Executor()
+    feed_x = np.ones((2, 4), "float32")
+    (got1,) = exe.run(test_prog, feed={"x": feed_x}, fetch_list=[y])
+    np.testing.assert_allclose(got1, 2.0)
+    # record more ops into the origin; the clone shares the statement list
+    with static.program_guard(main):
+        z = y + 1.0
+    (got2,) = exe.run(test_prog, feed={"x": feed_x}, fetch_list=[z])
+    np.testing.assert_allclose(got2, 3.0)
+
+
+def test_rng_slots_unique_across_clone(rng):
+    """Recording into origin and clone (shared statement list) must not
+    reuse rng slot numbers — correlated masks otherwise."""
+    main = static.Program()
+    with static.program_guard(main, static.Program()):
+        x = static.data("x", [None, 32], "float32")
+        y1 = paddle.nn.functional.dropout(x, p=0.5, training=True)
+    test_prog = main.clone()
+    with static.program_guard(main):
+        y2 = paddle.nn.functional.dropout(x, p=0.5, training=True)
+    with static.program_guard(test_prog):
+        y3 = paddle.nn.functional.dropout(x, p=0.5, training=True)
+    slots = [ref for st in main._statements for kind, ref in st.leaf_refs
+             if kind == "rng"]
+    assert len(slots) == len(set(slots)), f"duplicate rng slots: {slots}"
+
+
+def test_run_without_random_ops_preserves_generator(rng):
+    """Executor.run on a deterministic program must not consume a generator
+    tick (eager sampling sequences stay reproducible around static runs)."""
+    main = static.Program()
+    with static.program_guard(main, static.Program()):
+        x = static.data("x", [None, 4], "float32")
+        y = x * 3.0
+    exe = static.Executor()
+    paddle.seed(123)
+    a = paddle.randn([4]).numpy()
+    paddle.seed(123)
+    exe.run(main, feed={"x": np.ones((1, 4), "float32")}, fetch_list=[y])
+    b = paddle.randn([4]).numpy()
+    np.testing.assert_array_equal(a, b)
