@@ -41,6 +41,9 @@ class GPTConfig:
     initializer_range: float = 0.02
     tie_word_embeddings: bool = True
     use_flash_attention: bool = True
+    # run the Pallas kernel in interpret mode off-TPU too (CPU-mesh tests of
+    # the sharded kernel path; never set in production configs)
+    force_flash: bool = False
     # parallel knobs
     tensor_parallel: bool = False  # force TP layers even without fleet
     recompute: bool = False  # rematerialize blocks in backward (activation

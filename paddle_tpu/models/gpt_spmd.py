@@ -165,19 +165,38 @@ def _block(p, x, config: GPTConfig, mesh: Mesh):
         t = t.reshape(mb, s, nh, hd).transpose(0, 2, 1, 3)
         return cs(t, P("dp", "mp", None, None))
 
-    use_flash = (
-        jax.default_backend() == "tpu"
-        and mesh.shape["mp"] == 1
-        and s % 128 == 0
-    )
+    if jax.default_backend() == "tpu":
+        use_flash = config.use_flash_attention and s % 128 == 0
+    else:
+        use_flash = config.force_flash  # interpret-mode kernel for CPU tests
     if use_flash:
-        # fused Pallas kernel: no S x S residuals in fwd or bwd
+        # fused Pallas kernel: no S x S residuals in fwd or bwd. Under TP the
+        # kernel runs per-device via shard_map over the mp-sharded head dim
+        # (and dp-sharded batch): heads are embarrassingly parallel in flash
+        # attention, so no collectives are needed inside the region —
+        # reference never runs flash under mp>1 shards a head *across*
+        # devices either (mp_layers.py splits by whole heads).
         from ..ops.pallas.flash_attention import flash_attention
 
         qh = q.reshape(mb, s, nh, hd)
         kh = k.reshape(mb, s, nh, hd)
         vh = v.reshape(mb, s, nh, hd)
-        o = flash_attention(qh, kh, vh, causal=True).reshape(mb, s, h)
+        if mesh.shape["mp"] > 1 or mesh.shape["dp"] > 1:
+            spec = P("dp", None, "mp", None)
+
+            def local_flash(qs, ks, vs):
+                return flash_attention(qs, ks, vs, causal=True)
+
+            o = jax.shard_map(
+                local_flash,
+                in_specs=(spec, spec, spec),
+                out_specs=spec,
+                axis_names={"dp", "mp"},
+                check_vma=False,
+            )(qh, kh, vh)
+        else:
+            o = flash_attention(qh, kh, vh, causal=True)
+        o = o.reshape(mb, s, h)
     else:
         q, k, v = heads(q), heads(k), heads(v)
         scores = jnp.einsum("bnqd,bnkd->bnqk", q, k) / math.sqrt(hd)
@@ -290,14 +309,34 @@ def _loss_fn_inner(params, ids, labels, config: GPTConfig, mesh: Mesh, num_micro
     y = _pipeline(params["stages"], mbs, mesh, config)
     y = y.reshape(b, s, -1)
     y = _layer_norm(y, params["lnf_g"], params["lnf_b"], config.layer_norm_eps)
-    logits = y @ params["tok_emb"].T  # tied head, vocab-sharded over mp
-    logits = cs(logits, P("dp", None, "mp"))
-    # shifted next-token CE in fp32
-    lg = logits[:, :-1].astype(jnp.float32)
-    lb = labels[:, 1:]
-    lg = lg - jax.scipy.special.logsumexp(lg, axis=-1, keepdims=True)
-    nll = -jnp.take_along_axis(lg, lb[..., None], axis=-1)[..., 0]
-    return jnp.mean(nll)
+
+    # Shifted next-token CE, chunked over the sequence with remat: the full
+    # [b, s, vocab] fp32 logits (3.2 GB at bs16/seq1024/50k vocab) never
+    # materialize — each chunk's logits are recomputed in backward. Costs one
+    # extra head matmul pass (~2hv/token, ~8% of step FLOPs at 125M) and
+    # buys 2-4x batch on a 16 GB chip, a clear MFU win.
+    emb = params["tok_emb"]
+    # shift labels left; the last position has no target (masked below)
+    lb = jnp.concatenate([labels[:, 1:], labels[:, :1]], axis=1)
+    chunk = s
+    while chunk > 128 or s % chunk:
+        chunk //= 2
+    nchunks = s // chunk
+    yc = y.reshape(b, nchunks, chunk, -1).transpose(1, 0, 2, 3)
+    lbc = lb.reshape(b, nchunks, chunk).transpose(1, 0, 2)
+
+    def chunk_nll(args):
+        y_ch, lb_ch = args
+        lg = (y_ch @ emb.T).astype(jnp.float32)  # [b, chunk, v]
+        lg = cs(lg, P("dp", None, "mp"))  # vocab-sharded over mp (tied head)
+        lse = jax.scipy.special.logsumexp(lg, axis=-1)
+        tgt = jnp.take_along_axis(lg, lb_ch[..., None], axis=-1)[..., 0]
+        return lse - tgt  # [b, chunk]
+
+    nll = lax.map(jax.checkpoint(chunk_nll), (yc, lbc))  # [nchunks, b, chunk]
+    nll = nll.transpose(1, 0, 2).reshape(b, s)
+    valid = (jnp.arange(s) < s - 1).astype(jnp.float32)
+    return jnp.sum(nll * valid) / (b * (s - 1))
 
 
 # ---------------------------------------------------------------------------

@@ -10,6 +10,8 @@ from __future__ import annotations
 
 import math
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 
@@ -53,41 +55,59 @@ def scaled_dot_product_attention(
     is_causal=False,
     training=True,
     name=None,
+    scale=None,
 ):
     """Inputs [batch, seq, heads, head_dim] (paddle layout)."""
-    from ...framework.random import default_generator
+    from ...framework.random import rng_arg
 
-    dkey = default_generator.next_key() if (dropout_p > 0.0 and training) else None
+    with_dropout = dropout_p > 0.0 and training
     use_flash = (
         _flash_usable(query)
         and query.shape[1] == key.shape[1]
-        and query.shape[2] == key.shape[2]  # no GQA in the kernel yet
+        and query.shape[2] % key.shape[2] == 0  # GQA rides the kernel
     )
+    if use_flash and attn_mask is not None:
+        # mask streams into the kernel block-wise only for broadcastable
+        # shapes; anything else (e.g. singleton sk) takes the reference path
+        from ...ops.pallas.flash_attention import mask_kernel_compatible
 
-    def fn(q, k, v, *rest):
+        ms = tuple(attn_mask.shape)
+        if len(ms) == 2:
+            ms = (1, 1) + ms
+        elif len(ms) == 3:
+            ms = (ms[0], 1) + ms[1:]
+        use_flash = mask_kernel_compatible(
+            ms, query.shape[0], query.shape[2], query.shape[1], key.shape[1])
+
+    def fn(q, k, v, *rest, dkey=None):
         mask = rest[0] if rest else None
-        if use_flash and mask is None and dkey is None:
+        if use_flash and dkey is None:
             from ...ops.pallas.flash_attention import flash_attention
 
-            return flash_attention(q, k, v, causal=is_causal)
+            return flash_attention(q, k, v, causal=is_causal, scale=scale,
+                                   mask=mask)
         return _sdpa_ref(
-            q, k, v, mask=mask, causal=is_causal,
+            q, k, v, mask=mask, causal=is_causal, scale=scale,
             dropout_p=dropout_p if training else 0.0, dropout_key=dkey,
         )
 
     args = [query, key, value] + ([attn_mask] if attn_mask is not None else [])
-    return apply_op("scaled_dot_product_attention", fn, *args)
+    kwargs = {"dkey": rng_arg()} if with_dropout else {}
+    return apply_op("scaled_dot_product_attention", fn, *args, **kwargs)
+
+
+def _kernel_backend_ok() -> bool:
+    import jax as _jax
+
+    try:
+        return _jax.devices()[0].platform == "tpu"
+    except RuntimeError:
+        return False
 
 
 def _flash_usable(query) -> bool:
     """Pallas flash attention needs TPU + aligned head dims."""
-    import jax as _jax
-
-    try:
-        platform = _jax.devices()[0].platform
-    except RuntimeError:
-        return False
-    if platform not in ("tpu",):
+    if not _kernel_backend_ok():
         return False
     d = query._data.shape[-1] if hasattr(query, "_data") else query.shape[-1]
     s = query._data.shape[1] if hasattr(query, "_data") else query.shape[1]
@@ -111,12 +131,47 @@ def flash_attn_unpadded(
     query, key, value, cu_seqlens_q, cu_seqlens_k, max_seqlen_q, max_seqlen_k,
     scale=None, dropout=0.0, causal=False, return_softmax=False, training=True, name=None,
 ):
-    """Varlen flash attention: [total_tokens, H, D] with cumulative seqlens.
+    """Varlen flash attention: [total_tokens, H, D] with cumulative seqlens
+    (reference: FlashAttnUnpaddedKernel, flash_attn_kernel.cu:235).
 
-    XLA fallback: segment-masked attention over the packed batch.
+    TPU-native path: scatter the packed tokens into the static padded layout
+    [b, max_seqlen, H, D] (XLA wants static shapes — a true ragged kernel
+    would defeat tiling), run the varlen Pallas kernel (per-batch lengths in
+    SMEM; padding costs no FLOPs), gather back. Off-TPU fallback:
+    segment-masked attention over the packed batch.
     """
+    b = int((cu_seqlens_q.shape if hasattr(cu_seqlens_q, "shape")
+             else np.shape(cu_seqlens_q))[0]) - 1
+    d_head = (query._data.shape[-1] if hasattr(query, "_data")
+              else query.shape[-1])
+    use_kernel = (
+        _kernel_backend_ok()
+        and d_head % 64 == 0
+        and int(max_seqlen_q) % 128 == 0
+        and int(max_seqlen_k) % 128 == 0
+    )
 
-    def fn(q, k, v, cu_q, cu_k):
+    def kernel_fn(q, k, v, cu_q, cu_k):
+        from ...ops.pallas.flash_attention import flash_attention
+
+        h, d = q.shape[-2], q.shape[-1]
+        q_lens = (cu_q[1:] - cu_q[:-1]).astype(jnp.int32)
+        k_lens = (cu_k[1:] - cu_k[:-1]).astype(jnp.int32)
+        seg_q = jnp.searchsorted(cu_q, jnp.arange(q.shape[0]), side="right") - 1
+        pos_q = jnp.arange(q.shape[0]) - jnp.take(cu_q, seg_q)
+        seg_k = jnp.searchsorted(cu_k, jnp.arange(k.shape[0]), side="right") - 1
+        pos_k = jnp.arange(k.shape[0]) - jnp.take(cu_k, seg_k)
+        qp = jnp.zeros((b, int(max_seqlen_q), h, d), q.dtype
+                       ).at[seg_q, pos_q].set(q)
+        kp = jnp.zeros((b, int(max_seqlen_k), h, d), k.dtype
+                       ).at[seg_k, pos_k].set(k)
+        vp = jnp.zeros((b, int(max_seqlen_k), h, d), v.dtype
+                       ).at[seg_k, pos_k].set(v)
+        out = flash_attention(qp, kp, vp, causal=causal, scale=scale,
+                              q_seqlens=q_lens, kv_seqlens=k_lens)
+        return out[seg_q, pos_q]
+
+    def fallback_fn(q, k, v, cu_q, cu_k):
         total_q = q.shape[0]
         seg_q = jnp.searchsorted(cu_q, jnp.arange(total_q), side="right") - 1
         total_k = k.shape[0]
@@ -134,5 +189,6 @@ def flash_attn_unpadded(
         probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
         return jnp.einsum("hqk,khd->qhd", probs, v)
 
+    fn = kernel_fn if use_kernel else fallback_fn
     out = apply_op("flash_attn_unpadded", fn, query, key, value, cu_seqlens_q, cu_seqlens_k)
     return out, None

@@ -2,16 +2,31 @@
 
 Parity target: the reference's FlashAttention GPU kernel surface
 (paddle/phi/kernels/gpu/flash_attn_kernel.cu:128 FlashAttnKernel, registered
-:245, backward flash_attn_grad_kernel.cu) which dispatches to external
-libflashattn. Here the kernel is implemented directly: online-softmax tiling
-(the FlashAttention-2 recurrence) over KV blocks, bf16 MXU matmuls with fp32
-accumulators, causal masking, and ONE fused backward kernel producing
-dq/dk/dv from the saved (out, lse) residuals (dq lives as a VMEM-resident
-accumulator across k-block grid steps) — no S×S materialization in either
-direction.
+:245, varlen entry :235, backward flash_attn_grad_kernel.cu) which dispatches
+to external libflashattn. Here the kernel is implemented directly:
+online-softmax tiling (the FlashAttention-2 recurrence) over KV blocks, bf16
+MXU matmuls with fp32 accumulators, causal masking, and ONE fused backward
+kernel producing dq/dk/dv from the saved (out, lse) residuals (dq lives as a
+VMEM-resident accumulator across k-block grid steps) — no S×S materialization
+in either direction.
+
+Feature parity with the reference kernel surface:
+
+- **GQA** (flash_attn_kernel.cu num_heads_k < num_heads): kv heads are read
+  through the BlockSpec index map (``bh // group``) — no repeat/materialize;
+  backward computes per-q-head dk/dv and group-sums outside the kernel.
+- **attention mask** (flash_attn_kernel.cu:128 attn_mask): additive
+  [b, 1|h, sq, sk] bias streamed block-wise into the scores (fwd and bwd
+  recompute); the mask gets no gradient (reference parity).
+- **varlen** (flash_attn_kernel.cu:235 FlashAttnUnpaddedKernel): per-batch
+  q/kv lengths ride in scalar-prefetch SMEM; masked-out rows produce zeros
+  (lse pinned high so backward contributions vanish), and the kv loop upper
+  bound is clamped by the actual length, so padding costs no FLOPs. The
+  packed (cu_seqlens) public API scatters to the padded layout — TPU wants
+  static shapes; see nn/functional/attention.py flash_attn_unpadded.
 
 Layout: public entry takes paddle layout [batch, seq, heads, head_dim] and
-computes in [batch, heads, seq, head_dim]. K/V live in VMEM per (batch, head)
+computes in [batch*heads, seq, head_dim]. K/V live in VMEM per (batch, head)
 program; the fused backward additionally keeps full-seq q, do, and an fp32 dq
 accumulator resident (~16.5MB at seq 16k, head_dim 128), so backward bounds
 the practical single-kernel length at ~8-12k tokens at head_dim 128; longer
@@ -22,6 +37,8 @@ from __future__ import annotations
 
 import functools
 import math
+
+import numpy as np
 
 import jax
 import jax.numpy as jnp
@@ -46,7 +63,10 @@ def _pick_block(pref: int, seq: int) -> int:
     while seq % b:
         b //= 2
     return max(b, 1)
+
+
 NEG_INF = -1e30
+LSE_INVALID = 1e30  # lse for rows with no valid key: exp(s - BIG) == 0 in bwd
 
 # Explicit DEFAULT precision keeps bf16 operands on the native MXU pass
 # (f32 accumulate via preferred_element_type). Inheriting the framework's
@@ -62,7 +82,16 @@ def _dotf32(a, b, dims):
                                precision=_MXU)
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, bq, bk):
+def _fwd_kernel(*refs, scale, causal, bq, bk, hq, has_mask, has_lens):
+    idx = 0
+    if has_lens:
+        lens_ref = refs[0]  # SMEM [2, b] int32: (qlens; kvlens)
+        idx = 1
+    q_ref, k_ref, v_ref = refs[idx:idx + 3]
+    idx += 3
+    mask_ref = refs[idx] if has_mask else None
+    o_ref, lse_ref = refs[-2:]
+
     i = pl.program_id(1)
     q = q_ref[0]  # [bq, d] kept in input dtype: MXU wants bf16 operands
     seq = k_ref.shape[1]
@@ -70,17 +99,25 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, bq, bk):
     d = q.shape[1]
 
     row_ids = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    if has_lens:
+        bi = pl.program_id(0) // hq
+        qlen = lens_ref[0, bi]
+        kvlen = lens_ref[1, bi]
 
     def body(j, carry):
         m, l, acc = carry
         k = k_ref[0, pl.ds(j * bk, bk), :]
         v = v_ref[0, pl.ds(j * bk, bk), :]
         s = _dotf32(q, k, (((1,), (1,)))) * scale  # [bq, bk] f32
+        col_ids = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
         if causal:
-            col_ids = j * bk + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, bk), 1
-            )
             s = jnp.where(row_ids >= col_ids, s, NEG_INF)
+        if has_lens:
+            s = jnp.where(col_ids < kvlen, s, NEG_INF)
+        if has_mask:
+            # singleton-sq masks (key-padding [b,1,1,sk]) broadcast over rows
+            mrow = mask_ref[0, 0, :, pl.ds(j * bk, bk)].astype(jnp.float32)
+            s = s + mrow  # [bq or 1, bk] broadcasts against [bq, bk]
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
@@ -96,79 +133,118 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, bq, bk):
             num_k, ((i + 1) * bq + bk - 1) // bk).astype(jnp.int32)
     else:
         upper = jnp.int32(num_k)
+    if has_lens:
+        # padding costs no FLOPs: stop at the last block holding a valid key
+        upper = jnp.minimum(upper, (kvlen + bk - 1) // bk).astype(jnp.int32)
     m0 = jnp.full((bq, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((bq, 1), jnp.float32)
     acc0 = jnp.zeros((bq, d), jnp.float32)
     m, l, acc = jax.lax.fori_loop(jnp.int32(0), upper, body, (m0, l0, acc0))
-    o_ref[0] = (acc / l).astype(o_ref.dtype)
-    lse_ref[0, 0, :] = (m + jnp.log(l))[:, 0]
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    out = acc / l_safe
+    lse = jnp.where(l[:, 0] == 0.0, LSE_INVALID, (m + jnp.log(l))[:, 0])
+    if has_lens:
+        rows = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
+        out = jnp.where(rows < qlen, out, 0.0)
+        lse = jnp.where(rows[:, 0] < qlen, lse, LSE_INVALID)
+    o_ref[0] = out.astype(o_ref.dtype)
+    lse_ref[0, 0, :] = lse
 
 
-def _bhsd_specs(seq, d, block: int | None):
+def _bhsd_specs(seq, d, block: int | None, group: int = 1):
     """BlockSpec for [bh, seq, d] arrays: per-program either one seq-block
-    (``block`` rows) or the full sequence (None)."""
+    (``block`` rows) or the full sequence (None). ``group`` > 1 maps GQA
+    q-head programs onto their shared kv head (bh // group) — no repeat."""
     if block is not None:
-        return pl.BlockSpec((1, block, d), lambda bh, i: (bh, i, 0))
-    return pl.BlockSpec((1, seq, d), lambda bh, i: (bh, 0, 0))
+        return pl.BlockSpec((1, block, d), lambda bh, i, *_: (bh, i, 0))
+    if group > 1:
+        return pl.BlockSpec((1, seq, d), lambda bh, i, *_: (bh // group, 0, 0))
+    return pl.BlockSpec((1, seq, d), lambda bh, i, *_: (bh, 0, 0))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _flash(q, k, v, scale, causal):
-    out, _ = _flash_fwd_impl(q, k, v, scale, causal)
-    return out
+def _mask_spec_fwd(hq, bm, hm, sqm, bq, seq_k):
+    """Mask [bm, hm, sqm, sk] (bm/hm/sqm may be 1 = broadcast): one q-block
+    row band per program (the whole singleton row when sqm == 1)."""
+    def imap(bh, i, *_):
+        return (0 if bm == 1 else bh // hq, 0 if hm == 1 else bh % hq,
+                0 if sqm == 1 else i, 0)
+
+    return pl.BlockSpec((1, 1, 1 if sqm == 1 else bq, seq_k), imap)
 
 
-def _flash_fwd_impl(q, k, v, scale, causal):
-    bh, seq, d = q.shape
+def _mask_spec_bwd(hq, bm, hm, sqm, seq_q, bkb):
+    """Mask [bm, hm, sqm, sk]: one k-block column band per program."""
+    def imap(bh, j, *_):
+        return (0 if bm == 1 else bh // hq, 0 if hm == 1 else bh % hq, 0, j)
+
+    return pl.BlockSpec((1, 1, 1 if sqm == 1 else seq_q, bkb), imap)
+
+
+def _flash_fwd_impl(q, k, v, mask, lens, scale, causal, hq):
+    bhq, seq, d = q.shape
+    group = bhq // k.shape[0]
     bq = _pick_block(BLOCK_Q, seq)
-    bk = _pick_block(BLOCK_K, seq)
-    grid = (bh, seq // bq)
+    bk = _pick_block(BLOCK_K, k.shape[1])
+    grid = (bhq, seq // bq)
+    has_mask = mask is not None
+    has_lens = lens is not None
+    in_specs = [
+        _bhsd_specs(seq, d, bq),
+        _bhsd_specs(k.shape[1], d, None, group),
+        _bhsd_specs(k.shape[1], d, None, group),
+    ]
+    args = [q, k, v]
+    if has_mask:
+        in_specs.append(
+            _mask_spec_fwd(hq, mask.shape[0], mask.shape[1], mask.shape[2],
+                           bq, k.shape[1]))
+        args.append(mask)
+    out_specs = [
+        _bhsd_specs(seq, d, bq),
+        pl.BlockSpec((1, 1, bq), lambda b, i, *_: (b, 0, i)),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct(q.shape, q.dtype),
+        jax.ShapeDtypeStruct((bhq, 1, seq), jnp.float32),
+    ]
+    kern = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, bq=bq, bk=bk, hq=hq,
+        has_mask=has_mask, has_lens=has_lens)
     # Trace kernels in 32-bit mode: the framework enables jax_enable_x64 and
     # int64 scalars are unlowerable in Mosaic.
     with jax.enable_x64(False):
-        out, lse = pl.pallas_call(
-            functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                              bq=bq, bk=bk),
-            grid=grid,
-            in_specs=[
-            _bhsd_specs(seq, d, bq),
-            _bhsd_specs(seq, d, None),
-            _bhsd_specs(seq, d, None),
-            ],
-            out_specs=[
-            _bhsd_specs(seq, d, bq),
-            pl.BlockSpec((1, 1, bq), lambda b, i: (b, 0, i)),
-            ],
-            out_shape=[
-            jax.ShapeDtypeStruct(q.shape, q.dtype),
-            jax.ShapeDtypeStruct((bh, 1, seq), jnp.float32),
-            ],
-            interpret=_interpret(),
-        )(q, k, v)
+        if has_lens:
+            grid_spec = pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1, grid=grid, in_specs=in_specs,
+                out_specs=out_specs)
+            out, lse = pl.pallas_call(
+                kern, grid_spec=grid_spec, out_shape=out_shape,
+                interpret=_interpret(),
+            )(lens.astype(jnp.int32), *args)
+        else:
+            out, lse = pl.pallas_call(
+                kern, grid=grid, in_specs=in_specs, out_specs=out_specs,
+                out_shape=out_shape, interpret=_interpret(),
+            )(*args)
     return out, lse
 
 
-def _flash_fwd(q, k, v, scale, causal):
-    out, lse = _flash_fwd_impl(q, k, v, scale, causal)
-    return out, (q, k, v, out, lse)
-
-
-def _flash_bwd(scale, causal, res, g):
-    q, k, v, out, lse = res
-    delta = jnp.sum(
-        g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1, keepdims=False
-    )[:, None, :]  # [bh, 1, seq]
-    return flash_bwd_impl(q, k, v, g, lse, delta, scale, causal)
-
-
-def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                      dq_ref, dk_ref, dv_ref, *, scale, causal, bq, bkb):
+def _bwd_fused_kernel(*refs, scale, causal, bq, bkb, hq, has_mask, has_lens):
     """One kernel for dq/dk/dv. Grid (bh, k-block); dq's block is the FULL
     [seq, d] fp32 accumulator, whose index map ignores the k-block dim, so
     Mosaic keeps it VMEM-resident across the inner grid steps and each step
     accumulates its k-block's contribution (classic TPU FA backward layout;
     halves the kernel count AND the s/p recomputation of a split dq/dkv
     pass)."""
+    idx = 0
+    if has_lens:
+        lens_ref = refs[0]
+        idx = 1
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = refs[idx:idx + 6]
+    idx += 6
+    mask_ref = refs[idx] if has_mask else None
+    dq_ref, dk_ref, dv_ref = refs[-3:]
+
     j = pl.program_id(1)
     k = k_ref[0]  # [bkb, d]
     v = v_ref[0]
@@ -176,6 +252,9 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     num_q = seq // bq
     bk, d = k.shape
     col_ids = j * bkb + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    if has_lens:
+        bi = pl.program_id(0) // hq
+        kvlen = lens_ref[1, bi]
 
     @pl.when(j == 0)
     def _init():
@@ -193,6 +272,14 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 jnp.int32, (bq, bk), 0
             )
             s = jnp.where(row_ids >= col_ids, s, NEG_INF)
+        if has_lens:
+            s = jnp.where(col_ids < kvlen, s, NEG_INF)
+        if has_mask:
+            if mask_ref.shape[2] == 1:  # singleton-sq: broadcast over rows
+                s = s + mask_ref[0, 0, :, :].astype(jnp.float32)
+            else:
+                s = s + mask_ref[0, 0, pl.ds(i * bq, bq), :].astype(jnp.float32)
+        # invalid q rows carry lse == LSE_INVALID -> p == 0 -> no gradient
         p = jnp.exp(s - lse)
         pc = p.astype(do.dtype)
         dv = dv + _dotf32(pc, do, ((0,), (0,)))
@@ -214,7 +301,8 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
-def flash_bwd_impl(q, k, v, g, lse, delta, scale, causal):
+def flash_bwd_impl(q, k, v, g, lse, delta, scale, causal,
+                   mask=None, lens=None, hq=1):
     """Fused dq/dk/dv pallas kernel from explicit (lse, delta) residuals.
 
     ``lse``/``delta`` are [bh, 1, seq] fp32. Exposed separately so the ring
@@ -222,60 +310,150 @@ def flash_bwd_impl(q, k, v, g, lse, delta, scale, causal):
     the *globally* combined lse and delta — the blockwise-attention identity
     p = exp(s - lse_global) makes chunk backward exact without per-chunk
     renormalization.
-    """
-    bh, seq, d = q.shape
-    bq = _pick_block(BLOCK_Q, seq)
-    bkb = _pick_block(BLOCK_K, seq)
-    lse_spec_full = pl.BlockSpec((1, 1, seq), lambda b, j: (b, 0, 0))
-    kv_block = pl.BlockSpec((1, bkb, d), lambda bh_, j: (bh_, j, 0))
-    q_full = pl.BlockSpec((1, seq, d), lambda bh_, j: (bh_, 0, 0))
 
+    GQA: dk/dv are returned at q-head granularity [bhq, sk, d]; the caller
+    group-sums them to kv heads (plain XLA reshape+sum).
+    """
+    bhq, seq, d = q.shape
+    group = bhq // k.shape[0]
+    seq_k = k.shape[1]
+    bq = _pick_block(BLOCK_Q, seq)
+    bkb = _pick_block(BLOCK_K, seq_k)
+    has_mask = mask is not None
+    has_lens = lens is not None
+    lse_spec_full = pl.BlockSpec((1, 1, seq), lambda b, j, *_: (b, 0, 0))
+    kv_block = (
+        pl.BlockSpec((1, bkb, d), lambda bh_, j, *_: (bh_ // group, j, 0))
+        if group > 1 else
+        pl.BlockSpec((1, bkb, d), lambda bh_, j, *_: (bh_, j, 0)))
+    dkv_block = pl.BlockSpec((1, bkb, d), lambda bh_, j, *_: (bh_, j, 0))
+    q_full = pl.BlockSpec((1, seq, d), lambda bh_, j, *_: (bh_, 0, 0))
+
+    in_specs = [q_full, kv_block, kv_block, q_full, lse_spec_full,
+                lse_spec_full]
+    args = [q, k, v, g, lse, delta]
+    if has_mask:
+        in_specs.append(
+            _mask_spec_bwd(hq, mask.shape[0], mask.shape[1], mask.shape[2],
+                           seq, bkb))
+        args.append(mask)
+    out_specs = [
+        q_full,          # dq accumulator: full seq, j-invariant
+        dkv_block,       # per-q-head dk (group-summed by the caller)
+        dkv_block,
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct(q.shape, jnp.float32),
+        jax.ShapeDtypeStruct((bhq, seq_k, d), k.dtype),
+        jax.ShapeDtypeStruct((bhq, seq_k, d), v.dtype),
+    ]
+    kern = functools.partial(
+        _bwd_fused_kernel, scale=scale, causal=causal, bq=bq, bkb=bkb,
+        hq=hq, has_mask=has_mask, has_lens=has_lens)
     with jax.enable_x64(False):
-        dq, dk, dv = pl.pallas_call(
-            functools.partial(_bwd_fused_kernel, scale=scale, causal=causal,
-                              bq=bq, bkb=bkb),
-            grid=(bh, seq // bkb),
-            in_specs=[
-                q_full,          # q full
-                kv_block,        # k block
-                kv_block,        # v block
-                q_full,          # do full
-                lse_spec_full,
-                lse_spec_full,
-            ],
-            out_specs=[
-                q_full,          # dq accumulator: full seq, j-invariant
-                kv_block,
-                kv_block,
-            ],
-            out_shape=[
-                jax.ShapeDtypeStruct(q.shape, jnp.float32),
-                jax.ShapeDtypeStruct(k.shape, k.dtype),
-                jax.ShapeDtypeStruct(v.shape, v.dtype),
-            ],
-            interpret=_interpret(),
-        )(q, k, v, g, lse, delta)
+        if has_lens:
+            grid_spec = pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1, grid=(bhq, seq_k // bkb),
+                in_specs=in_specs, out_specs=out_specs)
+            dq, dk, dv = pl.pallas_call(
+                kern, grid_spec=grid_spec, out_shape=out_shape,
+                interpret=_interpret(),
+            )(lens.astype(jnp.int32), *args)
+        else:
+            dq, dk, dv = pl.pallas_call(
+                kern, grid=(bhq, seq_k // bkb), in_specs=in_specs,
+                out_specs=out_specs, out_shape=out_shape,
+                interpret=_interpret(),
+            )(*args)
+    if group > 1:
+        bkv = k.shape[0]
+        dk = dk.reshape(bkv, group, seq_k, d).sum(axis=1).astype(k.dtype)
+        dv = dv.reshape(bkv, group, seq_k, d).sum(axis=1).astype(v.dtype)
     return dq.astype(q.dtype), dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def _flash(q, k, v, mask, lens, scale, causal, hq):
+    out, _ = _flash_fwd_impl(q, k, v, mask, lens, scale, causal, hq)
+    return out
+
+
+def _flash_fwd(q, k, v, mask, lens, scale, causal, hq):
+    out, lse = _flash_fwd_impl(q, k, v, mask, lens, scale, causal, hq)
+    return out, (q, k, v, mask, lens, out, lse)
+
+
+def _flash_bwd(scale, causal, hq, res, g):
+    q, k, v, mask, lens, out, lse = res
+    delta = jnp.sum(
+        g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1, keepdims=False
+    )[:, None, :]  # [bh, 1, seq]
+    dq, dk, dv = flash_bwd_impl(q, k, v, g, lse, delta, scale, causal,
+                                mask=mask, lens=lens, hq=hq)
+    dmask = (None if mask is None
+             else jnp.zeros_like(mask))  # mask gets no grad (reference parity)
+    dlens = (None if lens is None
+             else np.zeros(lens.shape, jax.dtypes.float0))
+    return dq, dk, dv, dmask, dlens
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-def flash_attention(q, k, v, causal: bool = False, scale: float | None = None):
+def mask_kernel_compatible(mask_shape, b, hq, sq, sk) -> bool:
+    """Whether a (normalized, 4-D) additive mask can stream into the kernel:
+    every dim broadcastable (1 or full), except sk which must be full."""
+    if len(mask_shape) != 4:
+        return False
+    mb, mh, msq, msk = mask_shape
+    return (mb in (1, b) and mh in (1, hq) and msq in (1, sq) and msk == sk)
+
+
+def flash_attention(q, k, v, causal: bool = False, scale: float | None = None,
+                    mask=None, q_seqlens=None, kv_seqlens=None):
     """Flash attention over paddle-layout arrays [batch, seq, heads, head_dim].
 
     Raw-array API (used from nn.functional.scaled_dot_product_attention which
     handles the framework tape). Differentiable via the Pallas backward
-    kernels. No mask/dropout — callers fall back to the reference path for
-    those (matching the reference kernel's unsupported-feature fallbacks).
+    kernels.
+
+    - GQA: ``k``/``v`` may have fewer heads than ``q`` (divisible).
+    - ``mask``: additive bias [b, 1|hq, sq, sk] streamed into the kernel.
+    - ``q_seqlens``/``kv_seqlens``: [b] int per-sequence valid lengths
+      (padded varlen); rows past the length produce zeros and no grads.
+    No dropout — callers fall back to the reference path for that (matching
+    the reference kernel's unsupported-feature fallbacks).
     """
-    b, sq, h, d = q.shape
+    b, sq, hq, d = q.shape
+    hkv = k.shape[2]
+    assert hq % hkv == 0, f"GQA needs q heads {hq} divisible by kv heads {hkv}"
     if scale is None:
         scale = 1.0 / math.sqrt(d)
-    # [b, s, h, d] -> [b*h, s, d]
+
     def to_bhsd(x):
-        return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * x.shape[2], x.shape[1], d)
+        h = x.shape[2]
+        return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, x.shape[1], d)
 
     qt, kt, vt = to_bhsd(q), to_bhsd(k), to_bhsd(v)
-    out = _flash(qt, kt, vt, float(scale), bool(causal))
-    return jnp.transpose(out.reshape(b, h, sq, d), (0, 2, 1, 3))
+    lens = None
+    if q_seqlens is not None or kv_seqlens is not None:
+        ql = (jnp.full((b,), sq, jnp.int32) if q_seqlens is None
+              else q_seqlens.astype(jnp.int32))
+        kl = (jnp.full((b,), k.shape[1], jnp.int32) if kv_seqlens is None
+              else kv_seqlens.astype(jnp.int32))
+        lens = jnp.stack([ql, kl])  # [2, b]
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            mask = jnp.where(mask, 0.0, NEG_INF).astype(q.dtype)
+        if mask.ndim == 2:  # [sq, sk]
+            mask = mask[None, None]
+        elif mask.ndim == 3:  # [b, sq, sk]
+            mask = mask[:, None]
+        if not mask_kernel_compatible(mask.shape, b, hq, sq, k.shape[1]):
+            raise ValueError(
+                f"flash_attention: mask shape {mask.shape} not supported "
+                f"in-kernel (want broadcastable [{{1|{b}}}, {{1|{hq}}}, "
+                f"{{1|{sq}}}, {k.shape[1]}]); use the reference attention "
+                "path for other shapes")
+    out = _flash(qt, kt, vt, mask, lens, float(scale), bool(causal), hq)
+    return jnp.transpose(out.reshape(b, hq, sq, d), (0, 2, 1, 3))

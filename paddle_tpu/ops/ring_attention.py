@@ -57,7 +57,7 @@ def _use_pallas(q, k) -> bool:
 def _chunk_fwd(q, k, v, scale, causal):
     """(out fp32 [bh,sq,d], lse fp32 [bh,sq]) for one KV chunk."""
     if _use_pallas(q, k):
-        out, lse = _flash_fwd_impl(q, k, v, scale, causal)
+        out, lse = _flash_fwd_impl(q, k, v, None, None, scale, causal, 1)
         return out.astype(jnp.float32), lse[:, 0, :]
     qf = q.astype(jnp.float32) * scale
     s = jnp.einsum("bqd,bkd->bqk", qf, k.astype(jnp.float32))
@@ -299,7 +299,7 @@ def ulysses_attention_local(q, k, v, axis_name, causal=False, scale=None):
     if _use_pallas(qt, kt):
         from .pallas.flash_attention import _flash
 
-        out = _flash(qt, kt, vt, float(scale), bool(causal))
+        out = _flash(qt, kt, vt, None, None, float(scale), bool(causal), 1)
     else:
         o32, _ = _chunk_fwd(qt, kt, vt, float(scale), bool(causal))
         out = o32.astype(qt.dtype)
