@@ -47,7 +47,9 @@ def _reduce_pool(x, kernel, stride, pad, n, channel_last, init, op, name):
             pads = [(0, 0)] + padding + [(0, 0)]
         else:
             pads = [(0, 0), (0, 0)] + padding
-        return jax.lax.reduce_window(v, jnp.asarray(init, v.dtype), op, dims, strides, pads)
+        # init must stay a host literal: a traced jnp constant prevents jax
+        # from recognizing the max/add monoid, killing reverse-mode under jit
+        return jax.lax.reduce_window(v, np.asarray(init, v.dtype), op, dims, strides, pads)
 
     return apply_op(name, fn, x)
 
