@@ -3,79 +3,17 @@
 Parity: python/paddle/amp/amp_lists.py (WHITE_LIST/BLACK_LIST). Op names here
 are the engine's apply_op names. bf16 is the TPU-native low precision; fp16 is
 supported for API parity.
+
+Both lists are DERIVED from the single-source op registry
+(framework/op_registry.py ``amp`` column) — to change an op's AMP class,
+edit its registry row, not this module.
 """
+from ..framework.op_registry import amp_black_list, amp_white_list
 
 # Ops that are numerically safe and fast in low precision (MXU ops).
-WHITE_LIST = {
-    "matmul",
-    "mm",
-    "bmm",
-    "mv",
-    "linear",
-    "conv1d",
-    "conv2d",
-    "conv3d",
-    "conv1d_transpose",
-    "conv2d_transpose",
-    "conv3d_transpose",
-    "einsum",
-    "addmm",
-    "scaled_dot_product_attention",
-    "flash_attn_unpadded",
-}
+WHITE_LIST = amp_white_list()
 
 # Ops that must stay fp32 (reductions / exp / norms — precision-sensitive).
-BLACK_LIST = {
-    "exp",
-    "square",
-    "log",
-    "log2",
-    "log10",
-    "log1p",
-    "mean",
-    "sum",
-    "prod",
-    "softmax",
-    "log_softmax",
-    "cross_entropy",
-    "softmax_with_cross_entropy",
-    "nll_loss",
-    "binary_cross_entropy",
-    "bce_with_logits",
-    "kl_div",
-    "cosine_similarity",
-    "layer_norm",
-    "rms_norm",
-    "batch_norm",
-    "instance_norm",
-    "group_norm",
-    "local_response_norm",
-    "cumsum",
-    "cumprod",
-    "logsumexp",
-    "logcumsumexp",
-    "norm",
-    "vector_norm",
-    "matrix_norm",
-    "dist",
-    "erfinv",
-    "pow",
-    "std",
-    "var",
-    "sigmoid_focal_loss",
-    "ctc_loss",
-    "svd",
-    "qr",
-    "eig",
-    "eigh",
-    "cholesky",
-    "solve",
-    "inv",
-    "det",
-    "slogdet",
-    "lstsq",
-    "pinv",
-    "matrix_power",
-}
+BLACK_LIST = amp_black_list()
 
 # Everything else runs in whatever dtype its inputs already have.

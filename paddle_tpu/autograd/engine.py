@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 
 from ..framework import flags
+from ..framework import op_registry as _op_registry
 from .grad_mode import is_grad_enabled
 
 # Hook installed by paddle_tpu.amp to auto-cast inputs per-op (O1/O2).
@@ -36,18 +37,11 @@ static_record_hook: Callable | None = None
 
 # Ops whose outputs are never differentiable (comparisons, index producers,
 # predicates). Skipping the vjp for these avoids residual construction and
-# dead GradNode allocation in hot training loops.
-NON_DIFF_OPS = frozenset(
-    {
-        "equal", "not_equal", "greater_than", "greater_equal", "less_than",
-        "less_equal", "logical_and", "logical_or", "logical_xor", "logical_not",
-        "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not",
-        "bitwise_left_shift", "bitwise_right_shift", "equal_all", "isclose",
-        "allclose", "argmax", "argmin", "argsort", "isfinite", "isinf",
-        "isnan", "isreal", "isneginf", "isposinf", "count_nonzero",
-        "searchsorted", "bucketize", "one_hot", "exponent",
-    }
-)
+# dead GradNode allocation in hot training loops. Derived from the
+# single-source op registry (framework/op_registry.py) — add ops THERE.
+from ..framework.op_registry import non_diff_ops as _non_diff_ops
+
+NON_DIFF_OPS = _non_diff_ops()
 
 
 def _is_tensor(x) -> bool:
@@ -180,6 +174,11 @@ def apply_op(name: str, fn: Callable, *args, **kwargs):
     from ..tensor.tensor import Tensor
 
     leaves, treedef = jax.tree.flatten((args, kwargs), is_leaf=_is_tensor)
+
+    if _op_registry.STRICT[0] and name not in _op_registry.OP_TABLE:
+        raise AssertionError(
+            f"op '{name}' dispatched via apply_op without a registry row — "
+            "add it to framework/op_registry.py (single source of truth)")
 
     if amp_cast_hook is not None:
         leaves = amp_cast_hook(name, leaves)

@@ -8,6 +8,12 @@ from __future__ import annotations
 
 import os
 
+from .comm_watchdog import (  # noqa: F401
+    CommPeerFailure,
+    CommTimeout,
+    CommWatchdog,
+)
+
 
 def get_rank(group=None) -> int:
     if group is not None:

@@ -1,0 +1,201 @@
+"""Communication watchdog: per-collective timeout + cross-rank error
+propagation over the rendezvous store.
+
+Reference: phi/core/distributed/comm_task_manager.h:37 (CommTaskManager's
+watchdog loop) + comm_task.h:127 (per-task timeout/error state). The
+reference watches NCCL kernels; here the multi-process communication
+substrate is the TCPStore (XLA collectives inside a compiled program are
+checked by XLA itself), so the watchdog instruments the store-backed
+cross-process operations:
+
+- every monitored collective gets a (group, op, seq) identity and marks this
+  rank's ARRIVAL in the store;
+- on timeout, the failing rank lists exactly which peers never arrived and
+  broadcasts an error record through the store;
+- every subsequent monitored operation on any rank FAILS FAST with the
+  origin rank/op/seq named (error-propagation parity: a hung cluster turns
+  into an immediate, attributable exception instead of a silent stall);
+- an optional daemon thread polls for peer errors between collectives
+  (the reference's watchdog-thread shape) and trips an Event.
+"""
+from __future__ import annotations
+
+import pickle
+import threading
+import time
+from contextlib import contextmanager
+
+
+class CommError(RuntimeError):
+    """Base for watchdog-raised communication failures."""
+
+
+class CommTimeout(CommError):
+    """This rank's collective timed out (peers missing)."""
+
+
+class CommPeerFailure(CommError):
+    """A peer rank reported a failed/timed-out collective."""
+
+
+class CommWatchdog:
+    """Monitors store-backed collectives of one process group.
+
+    Args:
+      store: TCPStore (or compatible: set/get/check/add/wait).
+      rank / world_size: this rank's identity in the monitored group.
+      default_timeout: seconds a monitored collective may take.
+      group_tag: namespaces the watchdog keys per group.
+    """
+
+    def __init__(self, store, rank: int, world_size: int,
+                 default_timeout: float = 30.0, group_tag: str = "default"):
+        self.store = store
+        self.rank = int(rank)
+        self.world_size = int(world_size)
+        self.default_timeout = float(default_timeout)
+        self.group_tag = group_tag
+        self._seq = 0
+        self._monitor: threading.Thread | None = None
+        self._stop = threading.Event()
+        self.peer_failed = threading.Event()
+        self.last_error: CommError | None = None
+
+    # -- keys --------------------------------------------------------------
+    def _err_key(self) -> str:
+        return f"/_comm_watchdog/{self.group_tag}/error"
+
+    def _base(self, op: str, seq: int) -> str:
+        return f"/_comm_watchdog/{self.group_tag}/{op}/{seq}"
+
+    # -- error propagation -------------------------------------------------
+    def check_peer_errors(self) -> None:
+        """Raise CommPeerFailure if any rank has broadcast a failure."""
+        if self.store.check(self._err_key()):
+            rec = pickle.loads(self.store.get(self._err_key()))
+            err = CommPeerFailure(
+                f"[rank {self.rank}] peer rank {rec['rank']} reported "
+                f"failure of collective '{rec['op']}' (seq {rec['seq']}, "
+                f"group '{self.group_tag}'): {rec['message']}")
+            self.last_error = err
+            self.peer_failed.set()
+            raise err
+
+    def _broadcast_error(self, op: str, seq: int, message: str) -> None:
+        rec = {"rank": self.rank, "op": op, "seq": seq,
+               "message": message, "time": time.time()}
+        try:
+            self.store.set(self._err_key(), pickle.dumps(rec))
+        except Exception:
+            pass  # peers will still time out on their own deadline
+
+    # -- the per-collective guard -------------------------------------------
+    @contextmanager
+    def task(self, op: str, timeout: float | None = None):
+        """Guard one collective: arrival marking, timeout enrichment, error
+        broadcast. Usage::
+
+            with watchdog.task("all_gather_object") as t:
+                ...blocking store ops, bounded by t.timeout...
+        """
+        self.check_peer_errors()
+        seq = self._seq
+        self._seq += 1
+        tmo = self.default_timeout if timeout is None else float(timeout)
+        base = self._base(op, seq)
+        self.store.set(f"{base}/arrived/{self.rank}", b"1")
+
+        class _Task:
+            def __init__(self, timeout):
+                self.timeout = timeout
+                self.op = op
+                self.seq = seq
+
+        t0 = time.time()
+        try:
+            yield _Task(tmo)
+        except (TimeoutError, CommTimeout) as e:
+            missing = self.missing_ranks(op, seq)
+            msg = (
+                f"[rank {self.rank}] collective '{op}' (seq {seq}, group "
+                f"'{self.group_tag}') timed out after {time.time() - t0:.1f}s"
+                f"; ranks never arrived: {missing or 'unknown'}")
+            self._broadcast_error(op, seq, msg)
+            err = CommTimeout(msg)
+            self.last_error = err
+            raise err from e
+
+    def missing_ranks(self, op: str, seq: int) -> list[int]:
+        base = self._base(op, seq)
+        out = []
+        for r in range(self.world_size):
+            try:
+                if not self.store.check(f"{base}/arrived/{r}"):
+                    out.append(r)
+            except Exception:
+                out.append(r)
+        return out
+
+    # -- monitored collectives over the store --------------------------------
+    def barrier(self, timeout: float | None = None) -> None:
+        """Store barrier with watchdog semantics: bounded, attributable."""
+        with self.task("barrier", timeout) as t:
+            seq = t.seq
+            count_key = f"{self._base('barrier', seq)}/count"
+            release_key = f"{self._base('barrier', seq)}/release"
+            if self.store.add(count_key, 1) == self.world_size:
+                self.store.set(release_key, b"1")
+            deadline = time.time() + t.timeout
+            while not self.store.check(release_key):
+                self.check_peer_errors()
+                if time.time() > deadline:
+                    raise TimeoutError(f"barrier release after {t.timeout}s")
+                time.sleep(0.02)
+
+    def all_gather_object(self, obj, timeout: float | None = None) -> list:
+        """Cross-process object all-gather through the store, monitored."""
+        with self.task("all_gather_object", timeout) as t:
+            seq = t.seq
+            base = self._base("all_gather_object", seq)
+            self.store.set(f"{base}/obj/{self.rank}", pickle.dumps(obj))
+            out = []
+            deadline = time.time() + t.timeout
+            for r in range(self.world_size):
+                key = f"{base}/obj/{r}"
+                while not self.store.check(key):
+                    self.check_peer_errors()
+                    if time.time() > deadline:
+                        raise TimeoutError(
+                            f"waiting for rank {r}'s object after "
+                            f"{t.timeout}s")
+                    time.sleep(0.02)
+                out.append(pickle.loads(self.store.get(key)))
+            return out
+
+    # -- background monitor (reference watchdog-thread shape) ----------------
+    def start_monitor(self, interval: float = 1.0) -> None:
+        if self._monitor is not None:
+            return
+
+        def loop():
+            while not self._stop.wait(interval):
+                try:
+                    if self.store.check(self._err_key()):
+                        rec = pickle.loads(self.store.get(self._err_key()))
+                        self.last_error = CommPeerFailure(
+                            f"[rank {self.rank}] peer rank {rec['rank']} "
+                            f"reported failure of '{rec['op']}' "
+                            f"(seq {rec['seq']}): {rec['message']}")
+                        self.peer_failed.set()
+                        return
+                except Exception:
+                    return  # store gone (shutdown)
+
+        self._monitor = threading.Thread(target=loop, daemon=True)
+        self._monitor.start()
+
+    def stop_monitor(self) -> None:
+        self._stop.set()
+        if self._monitor is not None:
+            self._monitor.join(timeout=2.0)
+            self._monitor = None

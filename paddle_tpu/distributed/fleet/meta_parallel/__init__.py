@@ -35,7 +35,14 @@ from .pipeline_parallel import (  # noqa: E402
     TensorParallel,
 )
 from .moe_layer import MoELayer, top1_gating, top2_gating  # noqa: E402
-from .gspmd_pipeline import pipeline_spmd, shard_stacked_params, stack_stage_params  # noqa: E402
+from .gspmd_pipeline import (  # noqa: E402
+    bubble_fraction,
+    interleave_stage_params,
+    pipeline_spmd,
+    pipeline_spmd_interleaved,
+    shard_stacked_params,
+    stack_stage_params,
+)
 
 __all__ = [
     "ColumnParallelLinear",
@@ -55,6 +62,9 @@ __all__ = [
     "TensorParallel",
     "MoELayer",
     "pipeline_spmd",
+    "pipeline_spmd_interleaved",
+    "interleave_stage_params",
+    "bubble_fraction",
     "stack_stage_params",
     "shard_stacked_params",
 ]

@@ -107,3 +107,127 @@ def shard_stacked_params(stacked, mesh, pp_axis: str = "pp"):
         return jax.device_put(a, NamedSharding(mesh, spec))
 
     return jax.tree.map(place, stacked)
+
+
+def interleave_stage_params(chunk_trees, num_stages: int):
+    """Stack per-chunk param pytrees for the circular (VPP) schedule.
+
+    ``chunk_trees`` lists V*S chunks in LAYER order (chunk c holds layers
+    [c*lpc, (c+1)*lpc)). The circular placement assigns chunk c to device
+    c % S with local lap index c // S (reference VPP:
+    pipeline_parallel.py:906 virtual groups); contiguous pp-sharding of the
+    stacked dim then gives device d exactly its laps, in lap order."""
+    vs = len(chunk_trees)
+    if vs % num_stages:
+        raise ValueError(f"{vs} chunks not divisible by {num_stages} stages")
+    v = vs // num_stages
+    # stacked index d*V + r must hold global chunk r*S + d
+    reordered = [None] * vs
+    for d in range(num_stages):
+        for r in range(v):
+            reordered[d * v + r] = chunk_trees[r * num_stages + d]
+    return stack_stage_params(reordered)
+
+
+def pipeline_spmd_interleaved(
+    stage_fn,
+    stacked_params,
+    microbatches,
+    mesh,
+    num_virtual: int,
+    pp_axis: str = "pp",
+):
+    """Interleaved (VPP / circular) pipeline schedule over the pp axis.
+
+    Reference: PipelineParallelWithInterleave (pipeline_parallel.py:906) /
+    interleaved 1F1B (pipeline_scheduler_pass.py:465). Each device owns
+    ``num_virtual`` chunks placed round-robin (chunk c -> device c % S), so
+    an activation rides the ring V times; per chunk-step bubble drops from
+    V*(S-1) to (S-1): fraction (S-1)/(V*M + S - 1) vs (S-1)/(M + S - 1).
+
+    stacked_params: leaves with leading dim V*S in *circular-stacked* order
+    (use :func:`interleave_stage_params`), sharded over ``pp_axis``.
+    Requires num_micro >= num_stages (the lap return must not overtake the
+    injection schedule — same constraint as praxis' circular pipeline).
+    """
+    num_stages = mesh.shape[pp_axis]
+    V = num_virtual
+    if V == 1:
+        return pipeline_spmd(stage_fn, stacked_params, microbatches, mesh,
+                             pp_axis)
+
+    def pure(params, mbs):
+        M = mbs.shape[0]
+        if M < num_stages:
+            raise ValueError(
+                f"interleaved pipeline needs num_micro ({M}) >= num_stages "
+                f"({num_stages})")
+        total = V * M + num_stages - 1
+        last = num_stages - 1
+
+        def per_device(p_local, mbs_local):
+            d = lax.axis_index(pp_axis)
+            # p_local leading dim = V laps for this device
+            perm = [(i, (i + 1) % num_stages) for i in range(num_stages)]
+
+            def step(carry, n):
+                slot, buf = carry  # slot: ring activation; buf: [M, ...]
+                k = n - d          # this device's schedule clock
+                r = jnp.clip(k // M, 0, V - 1)   # lap (chunk) index
+                m = jnp.mod(jnp.clip(k, 0, V * M - 1), M)  # microbatch
+                valid = jnp.logical_and(k >= 0, k < V * M)
+                # stage-0 input: fresh microbatch (lap 0) or buffered return
+                x0 = jnp.where(r == 0, mbs_local[m], buf[m])
+                x = jnp.where(d == 0, x0, slot)
+                p_one = jax.tree.map(lambda a: a[r], p_local)
+                y = stage_fn(p_one, x)
+                y = jnp.where(valid, y, jnp.zeros_like(y))
+                y_shift = lax.ppermute(y, pp_axis, perm)
+                # device 0 banks the arriving lap return for its microbatch
+                ka = n - last  # clock of the stage that produced the arrival
+                ma = jnp.mod(jnp.clip(ka, 0, V * M - 1), M)
+                arrived = jnp.logical_and(ka >= 0, ka < (V - 1) * M)
+                buf = jnp.where(
+                    jnp.logical_and(d == 0, arrived),
+                    buf.at[ma].set(y_shift),
+                    buf,
+                )
+                # collect finished activations (device last, final lap)
+                done = jnp.logical_and(ka >= (V - 1) * M, ka < V * M)
+                out_t = jnp.where(
+                    jnp.logical_and(d == last, done), y, jnp.zeros_like(y))
+                out_t = lax.psum(out_t, pp_axis)
+                return (y_shift, buf), out_t
+
+            init_slot = jnp.zeros_like(mbs_local[0])
+            init_slot = lax.pcast(init_slot, (pp_axis,), to="varying")
+            init_buf = jnp.zeros_like(mbs_local)
+            init_buf = lax.pcast(init_buf, (pp_axis,), to="varying")
+            (_, _), outs = lax.scan(step, (init_slot, init_buf),
+                                    jnp.arange(total))
+            return outs
+
+        shard = jax.shard_map(
+            per_device,
+            mesh=mesh,
+            in_specs=(
+                jax.tree.map(lambda _: P(pp_axis), params),
+                P(),
+            ),
+            out_specs=P(),
+        )
+        outs = shard(params, mbs)
+        # microbatch m finishes at n = (V-1)*M + m + (S-1)
+        start = (V - 1) * M + num_stages - 1
+        return outs[start:start + M]
+
+    return apply_op("pipeline_spmd_interleaved", pure, stacked_params,
+                    microbatches)
+
+
+def bubble_fraction(num_stages: int, num_micro: int,
+                    num_virtual: int = 1) -> float:
+    """Analytic pipeline bubble fraction for the compiled schedules
+    (reference: the 1F1B/VPP memory-bubble tradeoff tables)."""
+    s, m, v = num_stages, num_micro, num_virtual
+    return (s - 1) / (v * m + s - 1)

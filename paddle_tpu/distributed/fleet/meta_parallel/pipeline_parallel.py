@@ -81,22 +81,54 @@ class PipelineParallel(Layer):
         return _mean_losses(losses)
 
     def forward_backward_pipeline(self, data, scaler=None, static_scheduler=False):
-        """Micro-batched forward+backward with grad accumulation — the exact
-        math of the reference's 1F1B walk (forward_backward_pipeline :440)."""
+        """Micro-batched forward+backward with grad accumulation.
+
+        ``schedule_mode`` changes the execution order with the reference's
+        memory semantics (pipeline_parallel.py:440 vs FThenB):
+
+        - ``"1F1B"``: each microbatch's backward runs immediately after its
+          forward — at most ONE microbatch's activation graph is live
+          (the reason 1F1B exists).
+        - ``"FThenB"``: all forwards first (every microbatch's graph held
+          live, activation memory O(num_micro)), then all backwards.
+
+        Both produce identical grads (the reference's schedules are
+        bit-identical too); tests pin loss equality and the live-graph
+        difference."""
         inputs, labels = self._load_micro_batches(data)
         n = len(inputs)
         losses = []
-        for x, y in zip(inputs, labels):
+
+        def fwd(x, y):
             out = self._layers(x)
-            loss = self._compute_loss(out, y)
-            losses.append(loss)
+            return self._compute_loss(out, y)
+
+        def bwd(loss):
             step_loss = loss * (1.0 / n)
             if scaler is not None:
                 step_loss = scaler.scale(step_loss)
             step_loss.backward()  # grads accumulate across micro-steps
+
+        if self.schedule_mode == "FThenB":
+            for x, y in zip(inputs, labels):
+                losses.append(fwd(x, y))
+            for loss in losses:
+                bwd(loss)
+        else:  # 1F1B (default): bounded activation lifetime
+            for x, y in zip(inputs, labels):
+                loss = fwd(x, y)
+                losses.append(loss)
+                bwd(loss)
         self._layers.allreduce_shared_weight_gradients()
         self.total_loss = _mean_losses(losses)
         return self.total_loss
+
+    def bubble_fraction(self) -> float:
+        """Analytic bubble of the compiled schedule this config maps to."""
+        from .gspmd_pipeline import bubble_fraction
+
+        v = getattr(self, "_virtual_pp_degree", 1)
+        return bubble_fraction(self.num_stages, self.accumulate_steps, v)
 
     def _compute_loss(self, output, label):
         loss_fn = self._layers._loss_fn

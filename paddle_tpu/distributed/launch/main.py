@@ -51,6 +51,13 @@ class Pod:
         self.procs: list[subprocess.Popen] = []
         self.logs = []
 
+    def reconfigure(self, node_rank: int, nnodes: int, master: str):
+        """Re-env for a new membership epoch (elastic rank rebuild —
+        reference: elastic/manager.py:126 _update_hosts + restart)."""
+        self.node_rank = node_rank
+        self.nnodes = nnodes
+        self.master = master
+
     def worker_env(self, local_rank: int) -> dict:
         nproc = self.args.nproc_per_node
         world = self.nnodes * nproc
@@ -116,15 +123,141 @@ class Pod:
         self.procs, self.logs = [], []
 
 
+class ElasticController:
+    """Membership watch + scale-up/down over the TCPStore.
+
+    Reference: launch/controllers/master.py:186 (ETCDMaster's alive-node
+    watch) + fleet/elastic/manager.py:126 (host-list update and restart).
+    Each launcher heartbeats ``/elastic/hb/<uid>``; the master launcher
+    (which hosts the store) computes the active set every tick and, when it
+    changes within ``[min_nodes, max_nodes]``, publishes a new membership
+    epoch. Every launcher follows epochs: stop pod, recompute node rank from
+    the member list (master first, the rest in uid order), re-env, restart.
+    The master launcher must stay alive — it IS the store (the reference has
+    the same constraint on its etcd endpoint)."""
+
+    HB_INTERVAL = 0.5
+    HB_STALE = 3.0
+
+    def __init__(self, store, uid: str, is_master: bool, min_nodes: int,
+                 max_nodes: int, master_host: str, base_port: int):
+        import threading
+
+        self.store = store
+        self.uid = uid
+        self.is_master = is_master
+        self.min_nodes = min_nodes
+        self.max_nodes = max_nodes
+        self.master_host = master_host
+        self.base_port = base_port
+        self.epoch = 0
+        self.members: list[str] = []
+        self._stop = threading.Event()
+        self._hb = threading.Thread(target=self._heartbeat_loop, daemon=True)
+        self._hb.start()
+
+    def _heartbeat_loop(self):
+        while not self._stop.wait(self.HB_INTERVAL):
+            try:
+                self.store.set(f"/elastic/hb/{self.uid}", repr(time.time()))
+            except Exception:
+                return
+
+    def _roster(self) -> list[str]:
+        """Every uid that ever announced (atomic slot-claim via add)."""
+        n = int(self.store.add("/elastic/join_count", 0))
+        out = []
+        for i in range(1, n + 1):
+            key = f"/elastic/join_name/{i}"
+            if self.store.check(key):
+                u = self.store.get(key).decode()
+                if u not in out:
+                    out.append(u)
+        return out
+
+    def _active_uids(self) -> list[str]:
+        out = []
+        now = time.time()
+        for u in self._roster():
+            try:
+                ts = float(self.store.get(f"/elastic/hb/{u}").decode())
+            except Exception:
+                continue
+            if now - ts < self.HB_STALE:
+                out.append(u)
+        return out
+
+    def register(self):
+        self.store.set(f"/elastic/hb/{self.uid}", repr(time.time()))
+        slot = int(self.store.add("/elastic/join_count", 1))
+        self.store.set(f"/elastic/join_name/{slot}", self.uid)
+
+    def rejoin(self):
+        """Leave under the old identity and re-register fresh (local worker
+        failure: scale-down past us, then scale-up back in — reference
+        elastic restart semantics)."""
+        old = self.uid
+        gen = int(old.rsplit("#", 1)[1]) + 1 if "#" in old else 1
+        self.uid = f"{old.split('#', 1)[0]}#{gen}"
+        try:
+            self.store.set(f"/elastic/hb/{old}", repr(0.0))  # instantly stale
+        except Exception:
+            pass
+        self.register()
+
+    def manage(self):
+        """Master tick: publish a new epoch when the active set changed and
+        is within bounds."""
+        if not self.is_master:
+            return
+        active = self._active_uids()
+        # master first, others in stable uid order (keeps worker rank 0 — and
+        # the workers' rendezvous host — on the store's node)
+        ordered = ([self.uid] if self.uid in active else []) + sorted(
+            u for u in active if u != self.uid)
+        if len(ordered) < self.min_nodes:
+            return  # wait for quorum (scale-up may re-add nodes)
+        if len(ordered) > self.max_nodes:
+            ordered = ordered[:self.max_nodes]
+        if ordered != self.members or self.epoch == 0:
+            self.epoch += 1
+            self.members = ordered
+            self.store.set(f"/elastic/members/{self.epoch}", ",".join(ordered))
+            self.store.set("/elastic/epoch", str(self.epoch))
+
+    def poll_epoch(self):
+        """Returns (epoch, members) currently published (may be stale)."""
+        if not self.store.check("/elastic/epoch"):
+            return 0, []
+        e = int(self.store.get("/elastic/epoch").decode())
+        m = self.store.get(f"/elastic/members/{e}").decode().split(",")
+        return e, m
+
+    def worker_master_for(self, epoch: int) -> str:
+        # fresh workers' rendezvous store per epoch (old ones may linger)
+        return f"{self.master_host}:{self.base_port + 1 + epoch}"
+
+    def stop(self):
+        self._stop.set()
+
+
 def launch(argv=None) -> int:
     """Run the launcher; returns the exit code (0 = all workers succeeded).
 
     Watcher loop parity: poll workers; on failure stop the pod and restart
     (all ranks restart together via the store's restart-epoch key) up to
-    max_restart times.
+    max_restart times. With ``--nnodes N:M`` the launcher becomes elastic:
+    node leave/join within [N, M] re-ranks and restarts the job instead of
+    failing it.
     """
     args = _parse_args(argv)
-    nnodes = int(str(args.nnodes).split(":")[0])
+    spec = str(args.nnodes)
+    elastic = ":" in spec and args.master is not None
+    nnodes = int(spec.split(":")[0])
+    if elastic:
+        min_nodes = int(spec.split(":")[0])
+        max_nodes = int(spec.split(":")[1])
+        return _launch_elastic(args, min_nodes, max_nodes)
     node_rank = args.rank if args.rank >= 0 else int(
         os.environ.get("PADDLE_NODE_RANK", 0))
 
@@ -190,6 +323,106 @@ def launch(argv=None) -> int:
         pod.stop()
         if store is not None:
             store.close()
+
+
+def _launch_elastic(args, min_nodes: int, max_nodes: int) -> int:
+    """Elastic control loop: follow membership epochs, restart the pod with
+    re-ranked env on every change; complete when the pod finishes."""
+    from ..store import TCPStore
+
+    host, _, port_s = args.master.rpartition(":")
+    port = int(port_s)
+    node_rank0 = args.rank if args.rank >= 0 else int(
+        os.environ.get("PADDLE_NODE_RANK", 0))
+    is_master = node_rank0 == 0
+    uid = f"{node_rank0}-{os.getpid()}"
+    store = TCPStore(host, port, is_master=is_master, world_size=1,
+                     timeout=max(args.elastic_timeout, 10))
+    ctrl = ElasticController(store, uid, is_master, min_nodes, max_nodes,
+                             host, port)
+    ctrl.register()
+    pod = None
+    cur_epoch = 0
+    deadline = time.time() + args.elastic_timeout + 60
+    def finish_ok() -> int:
+        # publish our completion; the master lingers so peers can keep using
+        # the store until their own pods drain
+        try:
+            store.set(f"/elastic/done/{ctrl.uid}", b"1")
+        except Exception:
+            pass
+        if is_master:
+            cap = time.time() + 30
+            while time.time() < cap:
+                try:
+                    _, members = ctrl.poll_epoch()
+                    if all(store.check(f"/elastic/done/{m}")
+                           for m in members):
+                        break
+                except Exception:
+                    break
+                time.sleep(0.3)
+        return 0
+
+    try:
+        while True:
+            try:
+                ctrl.manage()
+                epoch, members = ctrl.poll_epoch()
+            except Exception:
+                # the master (store host) is gone: finish coordinator-less —
+                # wait out the local pod and report its result
+                if pod is not None:
+                    for p in pod.procs:
+                        p.wait()
+                    status = pod.poll()
+                    return 0 if status == "done" else 1
+                return 1
+            if epoch > cur_epoch:
+                if ctrl.uid not in members:
+                    print(f"[launch-elastic] epoch {epoch}: this node "
+                          f"({ctrl.uid}) not in members {members}; exiting",
+                          file=sys.stderr)
+                    if pod is not None:
+                        pod.stop()
+                    # dropped from membership (scale-down past us): exit ok
+                    if len(members) >= min_nodes:
+                        return 0
+                    return 1
+                if pod is not None:
+                    pod.stop()
+                cur_epoch = epoch
+                my_rank = members.index(ctrl.uid)
+                wm = ctrl.worker_master_for(epoch)
+                print(f"[launch-elastic] epoch {epoch}: {len(members)} "
+                      f"nodes, this node rank {my_rank}", file=sys.stderr)
+                pod = Pod(args, my_rank, len(members), wm)
+                pod.start()
+            if pod is not None:
+                status = pod.poll()
+                if status == "done":
+                    return finish_ok()
+                if isinstance(status, tuple):
+                    # local worker failure: leave membership under the old
+                    # identity and re-register fresh — peers see a leave+join
+                    # and everyone restarts on the new epoch
+                    _, bad = status
+                    print(f"[launch-elastic] worker rank {bad} failed; "
+                          "rejoining", file=sys.stderr)
+                    pod.stop()
+                    pod = None
+                    ctrl.rejoin()
+                    deadline = time.time() + args.elastic_timeout + 60
+            elif time.time() > deadline:
+                print("[launch-elastic] no quorum before timeout",
+                      file=sys.stderr)
+                return 1
+            time.sleep(0.3)
+    finally:
+        ctrl.stop()
+        if pod is not None:
+            pod.stop()
+        store.close(linger=0)
 
 
 def main():

@@ -1,0 +1,203 @@
+"""Single-source op registry — the YAML equivalent.
+
+Reference: the reference generates its API surface, autograd, AMP behavior
+and op metadata from ONE source of truth (`paddle/phi/api/yaml/ops.yaml`,
+292 ops, plus `generator/api_gen.py`); SURVEY §7.1 called that "the piece
+worth keeping conceptually". This module is that piece for the TPU build:
+every op dispatched through ``apply_op``/``make_op`` has exactly one
+``OpSpec`` row here, and the previously hand-maintained tables are now
+*derived views* of this table:
+
+- ``autograd.engine.NON_DIFF_OPS``      <- ``non_diff_ops()``
+- ``amp.amp_lists.WHITE_LIST/BLACK_LIST`` <- ``amp_white_list()/amp_black_list()``
+- ``utils.flops`` computers              <- ``flops_fn`` attached per row
+
+``tests/test_op_registry.py`` scans the package source for every op name
+used with ``apply_op``/``make_op`` and fails if any is missing a row — op
+#351 cannot be added without registering it (the four-places-to-forget
+problem the round-1 verdict flagged).
+
+Columns (mirroring the YAML's fields under the one-IR design):
+``amp``      "white" = run in low precision under AMP O1 (MXU ops),
+             "black" = force fp32 (precision-sensitive), None = passthrough.
+``non_diff`` outputs never differentiable (comparisons, index producers) —
+             the engine skips vjp construction for these.
+``flops_fn`` analytic FLOPs fn(input_shapes, attrs) -> int, registered by
+             utils/flops.py decorators into this table.
+``notes``    sparse/spmd/layout notes for the row (free text).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable
+
+
+@dataclass
+class OpSpec:
+    name: str
+    amp: str | None = None        # "white" | "black" | None
+    non_diff: bool = False
+    flops_fn: Callable | None = None
+    notes: str = ""
+
+
+OP_TABLE: dict[str, OpSpec] = {}
+
+# Strict mode (enabled by the test suite's conftest): the dispatch engine
+# asserts every op name has a registry row, so dynamically-named ops (helper
+# families dispatching via a ``name`` variable) cannot bypass the
+# source-scan completeness gate.
+STRICT = [False]
+
+
+def set_strict(on: bool) -> None:
+    STRICT[0] = bool(on)
+
+
+def register_op(name: str, *, amp: str | None = None, non_diff: bool = False,
+                notes: str = "") -> OpSpec:
+    """Add (or fetch) the registry row for ``name``."""
+    spec = OP_TABLE.get(name)
+    if spec is None:
+        spec = OpSpec(name=name, amp=amp, non_diff=non_diff, notes=notes)
+        OP_TABLE[name] = spec
+    return spec
+
+
+def _bulk(names, **kw):
+    for n in names:
+        register_op(n, **kw)
+
+
+# -- MXU ops: numerically safe and fast in low precision (AMP white) --------
+_bulk([
+    "addmm", "bmm", "conv1d", "conv1d_transpose", "conv2d",
+    "conv2d_transpose", "conv3d", "conv3d_transpose", "einsum",
+    "flash_attn_unpadded", "linear", "matmul", "mm", "mv",
+    "scaled_dot_product_attention",
+    "weight_only_linear",
+], amp="white")
+
+# -- precision-sensitive: forced fp32 under AMP (reductions/exp/norms) ------
+_bulk([
+    "batch_norm", "bce_with_logits", "binary_cross_entropy", "cholesky",
+    "cosine_similarity", "cross_entropy", "ctc_loss", "cumprod", "cumsum",
+    "det", "dist", "eig", "eigh", "erfinv", "exp", "group_norm",
+    "instance_norm", "inv", "kl_div", "layer_norm", "local_response_norm",
+    "log", "log10", "log1p", "log2", "log_softmax", "logcumsumexp",
+    "logsumexp", "lstsq", "matrix_norm", "matrix_power", "mean", "nll_loss",
+    "norm", "pinv", "pow", "prod", "qr", "rms_norm", "sigmoid_focal_loss",
+    "slogdet", "softmax", "softmax_with_cross_entropy", "solve", "square",
+    "std", "sum", "svd", "var", "vector_norm",
+    "margin_cross_entropy",
+], amp="black")
+
+# -- outputs never differentiable (comparisons, index producers, predicates)
+_bulk([
+    "allclose", "argmax", "argmin", "argsort", "bitwise_and",
+    "bitwise_left_shift", "bitwise_not", "bitwise_or", "bitwise_right_shift",
+    "bitwise_xor", "bucketize", "count_nonzero", "equal", "equal_all",
+    "exponent", "greater_equal", "greater_than", "isclose", "isfinite",
+    "isinf", "isnan", "isneginf", "isposinf", "isreal", "less_equal",
+    "less_than", "logical_and", "logical_not", "logical_or", "logical_xor",
+    "not_equal", "one_hot", "searchsorted",
+    "gather_tree", "class_center_sample", "top_p_sampling", "weight_quantize",
+    "matrix_nms", "generate_proposals", "distribute_fpn_proposals",
+], non_diff=True)
+
+# -- passthrough ops: run in the input dtype, differentiable ----------------
+_bulk([
+    "abs", "acos", "acosh", "angle", "asin", "asinh", "atan", "atanh", "ceil", "conj", "cos", "cosh", "deg2rad", "digamma", "erf", "expm1", "floor", "frac", "i0", "i0e", "i1", "i1e", "imag", "lgamma", "neg", "rad2deg", "real", "reciprocal", "rsqrt", "scale_div", "sign", "sin", "sinh", "sqrt", "tan", "trunc",
+    "rnn_LSTM", "rnn_GRU", "rnn_RNN_TANH", "rnn_RNN_RELU",
+    "max_pool1d", "max_pool2d", "max_pool3d", "avg_pool1d", "avg_pool2d",
+    "avg_pool3d", "lp_pool1d", "lp_pool2d", "pipeline_spmd_interleaved",
+    "renorm", "weight_dequantize",
+    "prior_box", "box_coder", "yolo_box", "yolo_loss", "psroi_pool",
+    "max_unpool1d", "max_unpool2d", "max_unpool3d", "fractional_max_pool2d", "fractional_max_pool3d",
+    "affine_grid", "temporal_shift", "edit_distance", "rnnt_loss",
+    "adaptive_avg_pool1d", "adaptive_avg_pool2d", "adaptive_avg_pool3d",
+    "adaptive_max_pool1d", "adaptive_max_pool2d", "adaptive_max_pool3d",
+    "add", "all", "all_gather", "all_gather_slice", "all_reduce_avg",
+    "all_reduce_max", "all_reduce_min", "all_reduce_prod", "all_reduce_sum",
+    "alltoall", "alltoall_single", "alpha_dropout", "any", "as_complex",
+    "as_real", "as_strided", "assign", "atan2", "atleast_1d", "atleast_2d",
+    "atleast_3d", "bernoulli", "bilinear", "binomial", "box_iou",
+    "broadcast", "broadcast_tensors", "broadcast_to", "cast", "celu",
+    "channel_shuffle", "cholesky_solve", "clip", "clone", "complex",
+    "concat", "cond", "copysign", "corrcoef", "cosine_embedding_loss", "cov",
+    "crop", "cross", "cummax", "cummin", "cumulative_trapezoid",
+    "dense_to_sparse", "diag", "diag_embed", "diagflat", "diagonal", "diff",
+    "divide", "dot", "dropout", "eigvals", "eigvalsh", "elu", "embedding",
+    "expand", "expand_as", "fake_channel_quant_dequant",
+    "fake_quant_dequant", "fftshift", "flatten", "flip", "floor_divide",
+    "fmax", "fmin", "fold", "frame", "fused_bias_dropout_residual_ln",
+    "fused_dropout_add", "fused_layer_norm", "fused_linear",
+    "fused_linear_activation", "fused_rms_norm", "fused_rope", "gather",
+    "gather_nd", "gather_slice", "gaussian", "gcd", "gelu", "getitem", "glu",
+    "gradients", "grid_sample", "gru_cell", "gumbel_softmax", "hardshrink",
+    "hardsigmoid", "hardswish", "hardtanh", "heaviside",
+    "hinge_embedding_loss", "householder_product", "huber_loss", "hypot",
+    "ifftshift", "increment", "index_add", "index_fill", "index_put",
+    "index_sample", "index_select", "inner", "interpolate", "istft",
+    "jit_loaded_program", "jit_program", "kron", "kthvalue", "l1_loss",
+    "label_smooth", "lcm", "ldexp", "leaky_relu", "lerp", "log_loss",
+    "log_sigmoid", "logaddexp", "logit", "lstm_cell", "lu", "lu_unpack",
+    "margin_ranking_loss", "masked_fill", "masked_scatter", "masked_select",
+    "matrix_rank", "max", "maximum", "maxout", "median", "mel_spectrogram",
+    "meshgrid", "mfcc", "min", "minimum", "mish", "mod", "mode", "moe_layer",
+    "moveaxis", "mse_loss", "multi_dot", "multi_label_soft_margin_loss",
+    "multiplex", "multiply", "nan_to_num", "nanmean", "nanmedian",
+    "nanquantile", "nansum", "nextafter", "normalize", "outer",
+    "overlap_add", "p2p_push", "pad", "pipeline_spmd", "pixel_shuffle",
+    "pixel_unshuffle", "poisson", "polygamma", "power_to_db", "prelu",
+    "put_along_axis", "quantile", "randint", "randperm", "rank_slice",
+    "recompute", "reduce_avg", "reduce_max", "reduce_min", "reduce_prod",
+    "reduce_scatter_avg", "reduce_scatter_max", "reduce_scatter_min",
+    "reduce_scatter_prod", "reduce_scatter_sum", "reduce_sum", "relu",
+    "relu6", "repeat_interleave", "reshape", "reshard", "rint", "rnn_gru",
+    "rnn_lstm", "rnn_rnn", "rnn_simple_rnn_relu", "rnn_simple_rnn_tanh",
+    "roi_align", "roi_pool", "roll", "rot90", "round", "rrelu", "scale",
+    "scatter", "scatter_nd_add", "segment_mean", "selu", "send_u_recv",
+    "send_ue_recv", "send_uv", "setitem", "shuffle", "sigmoid", "silu",
+    "simple_rnn_cell", "slice", "smooth_l1_loss", "soft_margin_loss",
+    "softmax_mask_fuse", "softmax_mask_fuse_upper_triangle", "softplus",
+    "softshrink", "softsign", "sort", "sparse_add", "sparse_add_dense",
+    "sparse_attention", "sparse_coalesce", "sparse_divide",
+    "sparse_divide_dense", "sparse_divide_sampled", "sparse_matmul",
+    "sparse_maximum", "sparse_maximum_dense", "sparse_minimum",
+    "sparse_minimum_dense", "sparse_multiply", "sparse_multiply_dense",
+    "sparse_sddmm", "sparse_softmax", "sparse_subtract",
+    "sparse_subtract_dense", "sparse_to_dense", "spectral_norm",
+    "spectrogram", "split", "square_error_cost", "squeeze", "stack", "stanh",
+    "stft", "strided_slice", "subm_sample", "subtract", "svdvals",
+    "swapaxes", "swiglu", "t", "take", "take_along_axis", "tanh",
+    "tanhshrink", "tensordot", "thresholded_relu", "tile", "topk", "trace",
+    "transpose", "transpose_all", "transpose_last2", "trapezoid",
+    "triangular_solve", "tril", "triplet_margin_loss", "triu", "unbind",
+    "unfold", "uniform", "unsqueeze", "unsqueeze_last", "vander",
+    "varlen_mem_efficient_attention", "viterbi_decode", "weight_norm",
+    "where",
+])
+
+
+# -- derived views ----------------------------------------------------------
+
+def non_diff_ops() -> frozenset:
+    return frozenset(n for n, s in OP_TABLE.items() if s.non_diff)
+
+
+def amp_white_list() -> set:
+    return {n for n, s in OP_TABLE.items() if s.amp == "white"}
+
+
+def amp_black_list() -> set:
+    return {n for n, s in OP_TABLE.items() if s.amp == "black"}
+
+
+def attach_flops(name: str, fn: Callable) -> None:
+    register_op(name).flops_fn = fn
+
+
+def flops_fn(name: str) -> Callable | None:
+    spec = OP_TABLE.get(name)
+    return spec.flops_fn if spec else None

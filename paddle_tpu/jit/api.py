@@ -217,10 +217,17 @@ class StaticFunction:
     """
 
     def __init__(self, function: Callable, input_spec=None, layer: Layer | None = None, full_graph=True):
-        self._function = function
+        self._raw_function = function
+        # AST-convert tensor-dependent control flow (dy2static parity); the
+        # converted fn dispatches at runtime, so it also serves eager calls
+        from .dy2static import convert_to_static
+
+        self._function = convert_to_static(function)
         self._input_spec = input_spec
         self._layer = layer
+        self._full_graph = full_graph
         self._programs: dict = {}
+        self._fallback_keys: set = set()
         self.__name__ = getattr(function, "__name__", "static_fn")
         self.__wrapped__ = function
 
@@ -249,13 +256,19 @@ class StaticFunction:
             self._programs[key] = prog
         return prog, leaves
 
+    def _run_eager(self, *args, **kwargs):
+        return self._function(*args, **kwargs)
+
     def __call__(self, *args, **kwargs):
         if not _TO_STATIC_ENABLED or getattr(
-            self._function, "_paddle_tpu_not_to_static", False
+            self._raw_function, "_paddle_tpu_not_to_static", False
         ):
-            return self._function(*args, **kwargs)
+            return self._run_eager(*args, **kwargs)
 
         prog, leaves = self.get_concrete_program(*args, **kwargs)
+        key = id(prog)
+        if key in self._fallback_keys:
+            return self._run_eager(*args, **kwargs)
         state = _named_state(self._layer) if self._layer is not None else {}
         names = sorted(state)
         param_args = {n: state[n] for n in names}
@@ -263,7 +276,24 @@ class StaticFunction:
             leaves[i] if isinstance(leaves[i], Tensor) else Tensor(jnp.asarray(leaves[i]))
             for i in prog.tensor_pos
         ]
-        outs = apply_op("jit_program", prog.fn, param_args, *tensor_args)
+        try:
+            outs = apply_op("jit_program", prog.fn, param_args, *tensor_args)
+        except Exception as e:
+            if getattr(prog, "_ran_ok", False):
+                raise  # post-compile runtime failure: a real error, surface it
+            # graph break: tracing/compiling this program failed — run eager
+            # (reference SOT guarantee: "always runs, worst case eager",
+            # sot/translate.py:31). A genuine user bug re-raises from the
+            # eager run with a clean python traceback.
+            import warnings
+
+            warnings.warn(
+                f"to_static: tracing '{self.__name__}' failed "
+                f"({type(e).__name__}: {e}); falling back to eager "
+                "execution for these inputs", stacklevel=2)
+            self._fallback_keys.add(key)
+            return self._run_eager(*args, **kwargs)
+        prog._ran_ok = True
         out_td, arr_pos, const_out = prog.out_info[0]
         if not isinstance(outs, (tuple, list)):
             outs = (outs,)

@@ -1,0 +1,19 @@
+"""dy2static: AST conversion of tensor-dependent python control flow.
+
+Parity: python/paddle/jit/dy2static/ (transformers/ + convert_operators.py).
+``convert_to_static`` rewrites ``if``/``while``/``and``/``or``/``not``/
+ternaries into runtime dispatchers that become ``lax.cond``/``lax.while_loop``
+when the predicate is traced — so ``@to_static`` functions with
+data-dependent branches compile into ONE XLA graph instead of erroring.
+Anything outside the converted subset falls back to eager with a warning
+(SOT graph-break parity, see jit/api.py)."""
+from .convert_operators import (  # noqa: F401
+    UndefinedVar,
+    convert_ifelse,
+    convert_ifexp,
+    convert_logical_and,
+    convert_logical_not,
+    convert_logical_or,
+    convert_while_loop,
+)
+from .transformer import convert_to_static  # noqa: F401

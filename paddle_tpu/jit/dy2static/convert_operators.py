@@ -1,0 +1,200 @@
+"""Runtime conversion operators for dy2static.
+
+Parity: python/paddle/jit/dy2static/convert_operators.py (convert_ifelse,
+convert_while_loop, convert_logical_*). The AST transformer
+(transformer.py) rewrites tensor-dependent python control flow into calls
+here; each helper dispatches on whether the predicate is a traced Tensor:
+
+- traced  -> ``lax.cond`` / ``lax.while_loop`` (XLA control flow, one graph)
+- python  -> the original python semantics (zero overhead, exact behavior)
+
+TPU-native stance: this IS the reference's convert layer with the op-level
+targets swapped (cond_op/while_op ProgramDesc blocks -> lax primitives).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...tensor.tensor import Tensor
+
+
+class UndefinedVar:
+    """Placeholder for a name unbound before a converted branch (parity:
+    dy2static UndefinedVar)."""
+
+    __slots__ = ("name",)
+
+    def __init__(self, name: str = "?"):
+        self.name = name
+
+    def __repr__(self):
+        return f"UndefinedVar({self.name})"
+
+
+_UNDEF = UndefinedVar
+
+
+def ld(f):
+    """Capture a possibly-unbound local for branch plumbing."""
+    try:
+        return f()
+    except (NameError, UnboundLocalError):
+        return UndefinedVar()
+
+
+def _is_traced(x) -> bool:
+    if isinstance(x, Tensor):
+        x = x._data
+    return isinstance(x, jax.core.Tracer)
+
+
+def _to_bool(x):
+    if isinstance(x, Tensor):
+        return bool(x._data)
+    return bool(x)
+
+
+def _unwrap(tree):
+    return jax.tree.map(
+        lambda l: l._data if isinstance(l, Tensor) else l, tree,
+        is_leaf=lambda l: isinstance(l, Tensor))
+
+
+def _pred_data(pred):
+    d = pred._data if isinstance(pred, Tensor) else pred
+    return jnp.reshape(jnp.asarray(d), ()).astype(bool)
+
+
+def convert_ifelse(pred, true_fn, false_fn, union_vars):
+    """(v1, ..., vn) = convert_ifelse(cond, tfn, ffn, (v1, ..., vn)).
+
+    Tensor/tracer ``pred`` -> lax.cond over both branches; both must produce
+    structurally identical outputs (a variable bound in only one branch of a
+    tensor-dependent ``if`` is an error, like the reference's static cond).
+    Python ``pred`` -> run the taken branch only.
+
+    Only Tensor/array leaves thread through the cond operands; python-value
+    leaves (ints, strings, UndefinedVar placeholders) are closed over from
+    the call site — they are trace-time constants, exactly like the
+    reference bakes python attrs into the ProgramDesc."""
+    if not _is_traced(pred):
+        return (true_fn if _to_bool(pred) else false_fn)(union_vars)
+
+    is_leaf = lambda x: isinstance(x, (Tensor, UndefinedVar))  # noqa: E731
+    leaves, treedef = jax.tree.flatten(union_vars, is_leaf=is_leaf)
+    tensor_pos = [i for i, l in enumerate(leaves)
+                  if isinstance(l, (Tensor, jax.Array))]
+    operands = tuple(
+        leaves[i]._data if isinstance(leaves[i], Tensor) else leaves[i]
+        for i in tensor_pos)
+
+    def wrap(fn):
+        def run(ops):
+            rebuilt = list(leaves)
+            for pos, d in zip(tensor_pos, ops):
+                rebuilt[pos] = Tensor(d)
+            out = fn(jax.tree.unflatten(treedef, rebuilt))
+            out_leaves = jax.tree.leaves(out, is_leaf=is_leaf)
+            if any(isinstance(l, UndefinedVar) for l in out_leaves):
+                raise ValueError(
+                    "to_static: a variable used after a tensor-dependent "
+                    "`if` is only defined in one branch; define it before "
+                    "the `if` or in both branches")
+            return _unwrap(out)
+
+        return run
+
+    out = lax.cond(_pred_data(pred), wrap(true_fn), wrap(false_fn), operands)
+    return jax.tree.map(
+        lambda l: Tensor(l, stop_gradient=True)
+        if isinstance(l, jax.Array) else l, out)
+
+
+def convert_while_loop(cond_fn, body_fn, loop_vars):
+    """Tensor-valued condition -> lax.while_loop; python -> plain while.
+
+    Forward-only under a tensor condition: XLA cannot reverse-differentiate
+    a dynamic-trip-count loop (the adjoint needs a dynamic activation stack;
+    the reference's GPU while_op backward uses growable TensorArrays, which
+    have no static-shape equivalent). Gradients flow through everything
+    OUTSIDE the loop; differentiating THROUGH it raises jax's
+    while-transpose error. Data-dependent *bounded* iteration that needs
+    gradients should use ``lax.scan`` semantics (python ``for`` over a
+    static range, which traces unrolled/scanned and differentiates fine)."""
+    first = cond_fn(loop_vars)
+    if not _is_traced(first):
+        while _to_bool(cond_fn(loop_vars)):
+            loop_vars = body_fn(loop_vars)
+        return loop_vars
+
+    def rewrap_like(template, flat):
+        return jax.tree.map(
+            lambda t, l: Tensor(l, stop_gradient=True)
+            if isinstance(t, Tensor) else l,
+            template, flat,
+            is_leaf=lambda t: isinstance(t, Tensor))
+
+    template = loop_vars
+
+    def cond(carry):
+        return _pred_data(cond_fn(rewrap_like(template, carry)))
+
+    def body(carry):
+        return _unwrap(body_fn(rewrap_like(template, carry)))
+
+    # numeric python leaves must become arrays (the carry is traced)
+    init = jax.tree.map(
+        lambda l: l._data if isinstance(l, Tensor)
+        else jnp.asarray(l) if isinstance(l, (int, float, bool)) else l,
+        loop_vars, is_leaf=lambda l: isinstance(l, Tensor))
+    out = lax.while_loop(cond, body, init)
+    return jax.tree.map(
+        lambda t, l: Tensor(l, stop_gradient=True)
+        if isinstance(t, Tensor) or isinstance(l, jax.Array) else l,
+        template, out, is_leaf=lambda t: isinstance(t, Tensor))
+
+
+def convert_logical_and(lhs_fn, rhs_fn):
+    lhs = lhs_fn()
+    if _is_traced(lhs):
+        from ...tensor.logic import logical_and
+
+        rhs = rhs_fn()
+        return logical_and(_as_tensor(lhs), _as_tensor(rhs))
+    return lhs and rhs_fn()  # python short-circuit
+
+
+def convert_logical_or(lhs_fn, rhs_fn):
+    lhs = lhs_fn()
+    if _is_traced(lhs):
+        from ...tensor.logic import logical_or
+
+        rhs = rhs_fn()
+        return logical_or(_as_tensor(lhs), _as_tensor(rhs))
+    return lhs or rhs_fn()
+
+
+def convert_logical_not(x):
+    if _is_traced(x):
+        from ...tensor.logic import logical_not
+
+        return logical_not(_as_tensor(x))
+    return not x
+
+
+def convert_ifexp(pred, true_fn, false_fn):
+    """Ternary ``x if c else y``."""
+    if not _is_traced(pred):
+        return (true_fn if _to_bool(pred) else false_fn)()
+    out = lax.cond(_pred_data(pred),
+                   lambda _: _unwrap(true_fn()),
+                   lambda _: _unwrap(false_fn()), ())
+    return jax.tree.map(
+        lambda l: Tensor(l, stop_gradient=True)
+        if isinstance(l, jax.Array) else l, out)
+
+
+def _as_tensor(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))

@@ -1,0 +1,284 @@
+"""AST transformer: tensor-dependent python control flow -> converted calls.
+
+Parity: python/paddle/jit/dy2static/transformers/ (ifelse_transformer.py,
+loop_transformer.py, logical_transformer.py). The rewrite is source-level:
+
+    if cond: A            _v = _jst.ld(lambda: _v)         # per captured var
+    else:    B       =>   def __tfn(vs): (..) = vs; A; return (..)
+                          def __ffn(vs): (..) = vs; B; return (..)
+                          (..) = _jst.convert_ifelse(cond, __tfn, __ffn, (..))
+
+with the same shape for ``while`` (cond/body closures through
+``convert_while_loop``), ``and``/``or``/``not`` through convert_logical_*,
+and ternaries through convert_ifexp. The converted callables dispatch at
+RUNTIME on whether the predicate is traced, so one converted function serves
+both eager and compiled execution.
+
+Conservative scope (graph-break-and-fallback covers the rest, api.py):
+- ``if``/``while`` containing return/break/continue are left untouched —
+  a traced predicate there falls back to eager with a warning.
+- names are captured only if they are locals of the enclosing function
+  (params or stored somewhere in its body); globals/builtins pass through.
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+from typing import Callable
+
+_JST = "_jst_ops__"
+
+
+class _NameCollector(ast.NodeVisitor):
+    def __init__(self):
+        self.stores: set[str] = set()
+        self.loads: set[str] = set()
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, ast.Store):
+            self.stores.add(node.id)
+        else:
+            self.loads.add(node.id)
+
+    def visit_FunctionDef(self, node):
+        self.stores.add(node.name)  # nested defs bind a local name
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        pass  # separate scope
+
+
+def _names(nodes) -> tuple[set, set]:
+    c = _NameCollector()
+    for n in nodes:
+        c.visit(n)
+    return c.stores, c.loads
+
+
+def _has_flow_escape(nodes) -> bool:
+    """return/break/continue anywhere in these statements (not crossing into
+    nested function scopes)."""
+
+    class V(ast.NodeVisitor):
+        found = False
+
+        def visit_Return(self, n):
+            self.found = True
+
+        def visit_Break(self, n):
+            self.found = True
+
+        def visit_Continue(self, n):
+            self.found = True
+
+        def visit_FunctionDef(self, n):
+            pass
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_Lambda(self, n):
+            pass
+
+    v = V()
+    for n in nodes:
+        v.visit(n)
+    return v.found
+
+
+def _tuple_of(names, ctx):
+    return ast.Tuple(elts=[ast.Name(id=n, ctx=ctx()) for n in names],
+                     ctx=ctx())
+
+
+def _jst_call(fn_name, args):
+    return ast.Call(
+        func=ast.Attribute(value=ast.Name(id=_JST, ctx=ast.Load()),
+                           attr=fn_name, ctx=ast.Load()),
+        args=args, keywords=[])
+
+
+class ControlFlowTransformer(ast.NodeTransformer):
+    def __init__(self, local_names: set[str]):
+        self.locals = local_names
+        self.counter = 0
+
+    # -- helpers -----------------------------------------------------------
+    def _fresh(self, base):
+        self.counter += 1
+        return f"__{base}_{self.counter}"
+
+    def _captured(self, nodes) -> list[str]:
+        stores, loads = _names(nodes)
+        cap = (stores | (loads & self.locals)) & self.locals | stores
+        return sorted(cap)
+
+    def _ld_preamble(self, names):
+        out = []
+        for n in names:
+            # n = _jst.ld(lambda: n) — UNDEF sentinel when unbound
+            out.append(ast.Assign(
+                targets=[ast.Name(id=n, ctx=ast.Store())],
+                value=_jst_call("ld", [ast.Lambda(
+                    args=ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                                       kw_defaults=[], defaults=[]),
+                    body=ast.Name(id=n, ctx=ast.Load()))])))
+        return out
+
+    def _branch_fn(self, name, names, body_stmts):
+        vars_arg = "__vars"
+        header = ast.Assign(
+            targets=[_tuple_of(names, ast.Store)],
+            value=ast.Name(id=vars_arg, ctx=ast.Load()))
+        ret = ast.Return(value=_tuple_of(names, ast.Load))
+        return ast.FunctionDef(
+            name=name,
+            args=ast.arguments(
+                posonlyargs=[],
+                args=[ast.arg(arg=vars_arg)],
+                kwonlyargs=[], kw_defaults=[], defaults=[]),
+            body=[header] + body_stmts + [ret],
+            decorator_list=[])
+
+    # -- statements --------------------------------------------------------
+    def visit_If(self, node):
+        node = self.generic_visit(node)
+        if _has_flow_escape(node.body + node.orelse):
+            return node
+        names = self._captured(node.body + node.orelse)
+        if not names:
+            return node
+        tname = self._fresh("true_fn")
+        fname = self._fresh("false_fn")
+        tfn = self._branch_fn(tname, names, node.body)
+        ffn = self._branch_fn(
+            fname, names, node.orelse or [ast.Pass()])
+        call = ast.Assign(
+            targets=[_tuple_of(names, ast.Store)],
+            value=_jst_call("convert_ifelse", [
+                node.test,
+                ast.Name(id=tname, ctx=ast.Load()),
+                ast.Name(id=fname, ctx=ast.Load()),
+                _tuple_of(names, ast.Load)]))
+        return self._ld_preamble(names) + [tfn, ffn, call]
+
+    def visit_While(self, node):
+        node = self.generic_visit(node)
+        if node.orelse or _has_flow_escape(node.body):
+            return node
+        # Carry ONLY the names the body stores. Read-only locals resolve via
+        # closure over the enclosing scope — keeping them out of the
+        # lax.while_loop carry means gradients to them (used outside the
+        # loop) do not route through the non-transposable while primitive.
+        stores, _ = _names(node.body)
+        names = sorted(stores & self.locals | stores)
+        if not names:
+            return node
+        cname = self._fresh("while_cond")
+        bname = self._fresh("while_body")
+        vars_arg = "__vars"
+        header = ast.Assign(targets=[_tuple_of(names, ast.Store)],
+                            value=ast.Name(id=vars_arg, ctx=ast.Load()))
+        cfn = ast.FunctionDef(
+            name=cname,
+            args=ast.arguments(posonlyargs=[], args=[ast.arg(arg=vars_arg)],
+                               kwonlyargs=[], kw_defaults=[], defaults=[]),
+            body=[header, ast.Return(value=node.test)],
+            decorator_list=[])
+        bfn = self._branch_fn(bname, names, node.body)
+        call = ast.Assign(
+            targets=[_tuple_of(names, ast.Store)],
+            value=_jst_call("convert_while_loop", [
+                ast.Name(id=cname, ctx=ast.Load()),
+                ast.Name(id=bname, ctx=ast.Load()),
+                _tuple_of(names, ast.Load)]))
+        return self._ld_preamble(names) + [cfn, bfn, call]
+
+    # -- expressions -------------------------------------------------------
+    def visit_BoolOp(self, node):
+        node = self.generic_visit(node)
+        fn = ("convert_logical_and" if isinstance(node.op, ast.And)
+              else "convert_logical_or")
+        out = node.values[-1]
+        for v in reversed(node.values[:-1]):
+            out = _jst_call(fn, [_lambda(v), _lambda(out)])
+        return out
+
+    def visit_UnaryOp(self, node):
+        node = self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            return _jst_call("convert_logical_not", [node.operand])
+        return node
+
+    def visit_IfExp(self, node):
+        node = self.generic_visit(node)
+        return _jst_call("convert_ifexp", [
+            node.test, _lambda(node.body), _lambda(node.orelse)])
+
+
+def _lambda(expr):
+    return ast.Lambda(
+        args=ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                           kw_defaults=[], defaults=[]),
+        body=expr)
+
+
+@functools.lru_cache(maxsize=512)
+def _convert_code(code, filename, fname):
+    tree = ast.parse(code)
+    fn_def = tree.body[0]
+    fn_def.decorator_list = []  # strip @to_static etc.
+    # local names: params + every stored name in the body
+    params = {a.arg for a in (fn_def.args.posonlyargs + fn_def.args.args
+                              + fn_def.args.kwonlyargs)}
+    if fn_def.args.vararg:
+        params.add(fn_def.args.vararg.arg)
+    if fn_def.args.kwarg:
+        params.add(fn_def.args.kwarg.arg)
+    stores, _ = _names(fn_def.body)
+    tr = ControlFlowTransformer(params | stores)
+    new = tr.visit(tree)
+    ast.fix_missing_locations(new)
+    return compile(new, filename, "exec")
+
+
+def convert_to_static(fn: Callable) -> Callable:
+    """Return a control-flow-converted version of ``fn`` (or ``fn`` itself
+    when its source is unavailable / has nothing to convert). Parity:
+    dy2static program_translator convert_to_static."""
+    if inspect.ismethod(fn):
+        import types
+
+        conv = convert_to_static(fn.__func__)
+        if conv is fn.__func__:
+            return fn
+        return types.MethodType(conv, fn.__self__)
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        compiled = _convert_code(src, inspect.getfile(fn), fn.__name__)
+    except (OSError, TypeError, SyntaxError):
+        return fn
+    from . import convert_operators
+
+    glb = dict(fn.__globals__)
+    glb[_JST] = convert_operators
+    # Rebuild closure cells by name — the converted function is exec'd at
+    # module level, so its frees resolve as globals. Closure values MUST
+    # override same-named module globals (python scoping); the snapshot is
+    # taken at conversion time (later cell mutations are not observed —
+    # acceptable for the to_static use, which converts at decoration).
+    if fn.__closure__:
+        for name, cell in zip(fn.__code__.co_freevars, fn.__closure__):
+            try:
+                glb[name] = cell.cell_contents
+            except ValueError:
+                pass
+    ns: dict = {}
+    exec(compiled, glb, ns)
+    new_fn = ns[fn.__name__]
+    new_fn.__wrapped__ = fn
+    new_fn.__defaults__ = fn.__defaults__
+    new_fn.__kwdefaults__ = fn.__kwdefaults__
+    return new_fn

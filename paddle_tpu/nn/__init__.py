@@ -53,3 +53,4 @@ from .layer.transformer import (
 )
 
 F = functional
+from . import quant  # noqa: F401

@@ -24,3 +24,12 @@ from .norm import (  # noqa: F401
     rms_norm,
 )
 from .pooling import *  # noqa: F401,F403
+from .extension import (  # noqa: F401
+    affine_grid,
+    class_center_sample,
+    edit_distance,
+    gather_tree,
+    margin_cross_entropy,
+    rnnt_loss,
+    temporal_shift,
+)

@@ -55,18 +55,24 @@ def _reduce_pool(x, kernel, stride, pad, n, channel_last, init, op, name):
 
 
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, name=None):
-    out = _reduce_pool(x, kernel_size, stride, padding, 1, False, -np.inf, jax.lax.max, "max_pool1d")
-    return (out, None) if return_mask else out
+    if return_mask:
+        return _maxpool_nd_with_mask(x, kernel_size, stride, padding, 1,
+                                     False, "max_pool1d")
+    return _reduce_pool(x, kernel_size, stride, padding, 1, False, -np.inf, jax.lax.max, "max_pool1d")
 
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, return_mask=False, data_format="NCHW", name=None):
-    out = _reduce_pool(x, kernel_size, stride, padding, 2, data_format == "NHWC", -np.inf, jax.lax.max, "max_pool2d")
-    return (out, None) if return_mask else out
+    if return_mask:
+        return _maxpool_nd_with_mask(x, kernel_size, stride, padding, 2,
+                                     data_format == "NHWC", "max_pool2d")
+    return _reduce_pool(x, kernel_size, stride, padding, 2, data_format == "NHWC", -np.inf, jax.lax.max, "max_pool2d")
 
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False, return_mask=False, data_format="NCDHW", name=None):
-    out = _reduce_pool(x, kernel_size, stride, padding, 3, data_format == "NDHWC", -np.inf, jax.lax.max, "max_pool3d")
-    return (out, None) if return_mask else out
+    if return_mask:
+        return _maxpool_nd_with_mask(x, kernel_size, stride, padding, 3,
+                                     data_format == "NDHWC", "max_pool3d")
+    return _reduce_pool(x, kernel_size, stride, padding, 3, data_format == "NDHWC", -np.inf, jax.lax.max, "max_pool3d")
 
 
 def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True, ceil_mode=False, name=None):
@@ -120,18 +126,42 @@ def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
 
 
 def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
-    out = _adaptive(x, output_size, 1, "max")
-    return (out, None) if return_mask else out
+    if return_mask:
+        return _adaptive_max_with_mask(x, output_size, 1)
+    return _adaptive(x, output_size, 1, "max")
 
 
 def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
-    out = _adaptive(x, output_size, 2, "max")
-    return (out, None) if return_mask else out
+    if return_mask:
+        return _adaptive_max_with_mask(x, output_size, 2)
+    return _adaptive(x, output_size, 2, "max")
 
 
 def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
-    out = _adaptive(x, output_size, 3, "max")
-    return (out, None) if return_mask else out
+    if return_mask:
+        return _adaptive_max_with_mask(x, output_size, 3)
+    return _adaptive(x, output_size, 3, "max")
+
+
+def _adaptive_max_with_mask(x, output_size, n):
+    out_sizes = _tuple(output_size, n)
+
+    def fn(v):
+        S = v.shape[2:]
+        starts_list, ends_list, kmax = [], [], []
+        for d in range(n):
+            want = out_sizes[d] if out_sizes[d] is not None else S[d]
+            i = np.arange(want)
+            starts = np.floor(i * S[d] / want).astype(np.int32)
+            ends = np.ceil((i + 1) * S[d] / want).astype(np.int32)
+            starts_list.append(jnp.asarray(starts))
+            ends_list.append(jnp.asarray(ends))
+            kmax.append(int((ends - starts).max()))
+        pooled, mask = _max_pool_with_mask(v, starts_list, tuple(kmax),
+                                           ends_list)
+        return pooled, mask.astype(jnp.int32)
+
+    return apply_op(f"adaptive_max_pool{n}d", fn, x)
 
 
 def _adaptive(x, output_size, n, mode, channel_last=False):
@@ -156,3 +186,214 @@ def _adaptive(x, output_size, n, mode, channel_last=False):
         return out
 
     return apply_op(f"adaptive_{mode}_pool{n}d", fn, x)
+
+
+# ---------------------------------------------------------------------------
+# max pool with argmax mask, unpool, fractional pools
+# (reference: phi/kernels/funcs/pooling.h MaxPoolWithIndex/FractionalMaxPool,
+#  phi/kernels/gpu/unpool_kernel.cu)
+# ---------------------------------------------------------------------------
+
+
+def _gather_windows(v, starts_list, kernel, ends_list=None):
+    """Gather pooling windows via advanced indexing.
+
+    v: [N, C, *S]. starts_list[d]: [out_d] window start coords (may be
+    traced, e.g. fractional pooling). Returns (windows [N, C, *out, *kernel],
+    valid mask broadcastable to windows). ``ends_list`` optionally bounds
+    each window (variable-size regions); defaults to start + kernel."""
+    n = len(starts_list)
+    S = v.shape[2:]
+    coords = []
+    valids = []
+    for d in range(n):
+        starts = starts_list[d]
+        offs = jnp.arange(kernel[d])
+        c = starts[:, None] + offs[None, :]  # [out_d, k_d]
+        hi = (ends_list[d][:, None] if ends_list is not None
+              else starts[:, None] + kernel[d])
+        valid = (c >= 0) & (c < S[d]) & (c < hi)
+        # reshape for broadcasting: dim d occupies axes (2+d) and (2+n+d)
+        shape = [1] * (2 * n)
+        shape[d] = c.shape[0]
+        shape[n + d] = c.shape[1]
+        coords.append(jnp.clip(c, 0, S[d] - 1).reshape(shape))
+        valids.append(valid.reshape(shape))
+    windows = v[(slice(None), slice(None), *coords)]
+    valid = valids[0]
+    for m in valids[1:]:
+        valid = valid & m
+    return windows, valid, coords
+
+
+def _max_pool_with_mask(v, starts_list, kernel, ends_list=None):
+    """(pooled, flat-input-spatial argmax indices) for [N, C, *S] input."""
+    n = len(starts_list)
+    S = v.shape[2:]
+    windows, valid, coords = _gather_windows(v, starts_list, kernel,
+                                             ends_list)
+    neg = jnp.asarray(-np.inf if jnp.issubdtype(v.dtype, jnp.floating)
+                      else jnp.iinfo(v.dtype).min, v.dtype)
+    windows = jnp.where(valid, windows, neg)
+    N, C = v.shape[:2]
+    out_sizes = tuple(s.shape[0] for s in starts_list)
+    K = int(np.prod(kernel))
+    flat = windows.reshape(N, C, *out_sizes, K)
+    kidx = jnp.argmax(flat, axis=-1)
+    pooled = jnp.max(flat, axis=-1)
+    # decompose kidx -> per-dim offsets -> input coords -> flat spatial idx
+    flat_idx = jnp.zeros_like(kidx)
+    rem = kidx
+    for d in range(n):
+        kstride = int(np.prod(kernel[d + 1:]))
+        off = rem // kstride
+        rem = rem % kstride
+        # coords[d] has out_d at axis d of a 2n-dim layout; rebuild per-out
+        starts = starts_list[d]
+        shape = [1, 1] + [1] * n
+        shape[2 + d] = starts.shape[0]
+        coord_d = starts.reshape(shape) + off
+        sstride = int(np.prod(S[d + 1:]))
+        flat_idx = flat_idx + coord_d * sstride
+    return pooled, flat_idx
+
+
+def _maxpool_nd_with_mask(x, kernel_size, stride, padding, n, channel_last,
+                          name):
+    kernel = _tuple(kernel_size, n)
+    stride_t = _tuple(stride, n) if stride is not None else kernel
+    padding_pairs = _pool_pad(padding, n)
+    if isinstance(padding_pairs, str):
+        raise ValueError(
+            f"{name}: string padding unsupported with return_mask=True")
+
+    def fn(v):
+        if channel_last:
+            perm = (0, n + 1) + tuple(range(1, n + 1))
+            v = jnp.transpose(v, perm)
+        S = v.shape[2:]
+        starts_list = []
+        for d in range(n):
+            p0 = padding_pairs[d][0]
+            out_d = (S[d] + padding_pairs[d][0] + padding_pairs[d][1]
+                     - kernel[d]) // stride_t[d] + 1
+            starts_list.append(jnp.arange(out_d) * stride_t[d] - p0)
+        pooled, mask = _max_pool_with_mask(v, starts_list, kernel)
+        if channel_last:
+            perm_back = (0,) + tuple(range(2, n + 2)) + (1,)
+            pooled = jnp.transpose(pooled, perm_back)
+            mask = jnp.transpose(mask, perm_back)
+        return pooled, mask.astype(jnp.int32)
+
+    return apply_op(name, fn, x)
+
+
+def _unpool_nd(x, indices, kernel_size, stride, padding, output_size, n,
+               channel_last, name):
+    kernel = _tuple(kernel_size, n)
+    stride_t = _tuple(stride, n) if stride is not None else kernel
+    pad_t = _tuple(padding, n)
+
+    def fn(v, idx):
+        if channel_last:
+            perm = (0, n + 1) + tuple(range(1, n + 1))
+            v = jnp.transpose(v, perm)
+            idx = jnp.transpose(idx, perm)
+        N, C = v.shape[:2]
+        S = v.shape[2:]
+        if output_size is not None:
+            out_sizes = tuple(int(s) for s in output_size)[-n:]
+        else:
+            out_sizes = tuple(
+                (S[d] - 1) * stride_t[d] - 2 * pad_t[d] + kernel[d]
+                for d in range(n))
+        flat_out = int(np.prod(out_sizes))
+        out = jnp.zeros((N, C, flat_out), v.dtype)
+        vi = v.reshape(N, C, -1)
+        ii = idx.reshape(N, C, -1).astype(jnp.int32)
+        bidx = jnp.arange(N)[:, None, None]
+        cidx = jnp.arange(C)[None, :, None]
+        out = out.at[bidx, cidx, ii].set(vi)
+        out = out.reshape(N, C, *out_sizes)
+        if channel_last:
+            perm_back = (0,) + tuple(range(2, n + 2)) + (1,)
+            out = jnp.transpose(out, perm_back)
+        return out
+
+    return apply_op(name, fn, x, indices)
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    return _unpool_nd(x, indices, kernel_size, stride, padding, output_size,
+                      1, data_format == "NLC", "max_unpool1d")
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    return _unpool_nd(x, indices, kernel_size, stride, padding, output_size,
+                      2, data_format == "NHWC", "max_unpool2d")
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    return _unpool_nd(x, indices, kernel_size, stride, padding, output_size,
+                      3, data_format == "NDHWC", "max_unpool3d")
+
+
+def _fractional_pool(x, output_size, kernel_size, random_u, return_mask, n,
+                     name):
+    """Fractional max pooling (Graham 2014; reference FractionalMaxPool in
+    phi/kernels/funcs/pooling.h): region edges ceil(alpha*(i+u)) with a
+    (pseudo)random u in (0,1); fixed ``kernel_size`` overrides region ends."""
+    from ...framework.random import rng_arg
+
+    out_sizes = _tuple(output_size, n)
+
+    def fn(v, u):
+        S = v.shape[2:]
+        if u is None:
+            raise AssertionError  # handled by wrapper
+        starts_list, ends_list = [], []
+        for d in range(n):
+            out_d = out_sizes[d]
+            alpha = S[d] / out_d
+            i = jnp.arange(out_d + 1, dtype=jnp.float32)
+            edges = jnp.ceil(alpha * (i + u)) - jnp.ceil(alpha * u)
+            edges = jnp.clip(edges.astype(jnp.int32), 0, S[d])
+            starts_list.append(edges[:-1])
+            if kernel_size is not None:
+                k = _tuple(kernel_size, n)[d]
+                ends_list.append(jnp.minimum(edges[:-1] + k, S[d]))
+            else:
+                ends_list.append(edges[1:])
+        kmax = tuple(
+            (_tuple(kernel_size, n)[d] if kernel_size is not None
+             else int(np.ceil(S[d] / out_sizes[d])) + 1)
+            for d in range(n))
+        pooled, mask = _max_pool_with_mask(v, starts_list, kmax, ends_list)
+        return pooled, mask.astype(jnp.int32)
+
+    if random_u is None:
+        karg = rng_arg()
+
+        def fn_rand(v, key):
+            u = jax.random.uniform(key, (), jnp.float32, 1e-3, 1.0 - 1e-3)
+            return fn(v, u)
+
+        out, mask = apply_op(name, fn_rand, x, karg)
+    else:
+        out, mask = apply_op(name, lambda v: fn(v, jnp.float32(random_u)), x)
+    return (out, mask) if return_mask else out
+
+
+def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    return _fractional_pool(x, output_size, kernel_size, random_u,
+                            return_mask, 2, "fractional_max_pool2d")
+
+
+def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    return _fractional_pool(x, output_size, kernel_size, random_u,
+                            return_mask, 3, "fractional_max_pool3d")

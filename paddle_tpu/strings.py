@@ -1,0 +1,133 @@
+"""String tensors + string kernels.
+
+Reference: phi/core/string_tensor.h (pstring-based StringTensor),
+phi/kernels/strings/ (strings_lower_upper_kernel.h, unicode/case utils).
+
+TPU-native stance: strings are HOST data — XLA has no string dtype, and the
+reference runs its string kernels on CPU too (the GPU "strings" kernels
+round-trip through pinned host memory). A ``StringTensor`` is a shaped
+numpy object array of python ``str``; string kernels are vectorized host
+ops. The bridge to device-land is the tokenizer (text/tokenizer.py), which
+turns ragged strings into padded int32 arrays — the only representation the
+MXU ever sees.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["StringTensor", "to_string_tensor", "lower", "upper", "strip",
+           "join", "equal", "empty", "split"]
+
+
+class StringTensor:
+    """A shaped container of strings (reference: StringTensor over pstring).
+
+    Supports arbitrary rank; elements are python str (unicode). Host-only.
+    """
+
+    def __init__(self, data, name: str | None = None):
+        if isinstance(data, StringTensor):
+            self._data = data._data.copy()
+        else:
+            arr = np.asarray(data, dtype=object)
+            # normalize bytes -> str
+            flat = arr.reshape(-1)
+            for i, v in enumerate(flat):
+                if isinstance(v, bytes):
+                    flat[i] = v.decode("utf-8")
+                elif not isinstance(v, str):
+                    flat[i] = str(v)
+            self._data = arr
+        self.name = name or "string_tensor"
+
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    def numpy(self):
+        return self._data
+
+    def tolist(self):
+        return self._data.tolist()
+
+    def __len__(self):
+        return self._data.shape[0] if self._data.ndim else 1
+
+    def __getitem__(self, idx):
+        out = self._data[idx]
+        if isinstance(out, str):
+            return out
+        return StringTensor(out)
+
+    def __eq__(self, other):
+        return equal(self, other)
+
+    def __repr__(self):
+        return f"StringTensor(shape={self.shape}, {self._data!r})"
+
+
+def to_string_tensor(data, name=None) -> StringTensor:
+    return StringTensor(data, name)
+
+
+def _elementwise(fn, x: StringTensor) -> StringTensor:
+    arr = np.asarray(x._data, dtype=object).copy()
+    flat = arr.reshape(-1)
+    for i, v in enumerate(flat):
+        flat[i] = fn(v)
+    return StringTensor(arr)
+
+
+def lower(x, use_utf8_encoding: bool = True, name=None) -> StringTensor:
+    """Reference: strings_lower_upper_kernel.h StringLower (utf8 flag kept
+    for API parity; python str.lower is unicode-correct either way)."""
+    return _elementwise(str.lower, StringTensor(x))
+
+
+def upper(x, use_utf8_encoding: bool = True, name=None) -> StringTensor:
+    return _elementwise(str.upper, StringTensor(x))
+
+
+def strip(x, chars: str | None = None) -> StringTensor:
+    return _elementwise(lambda s: s.strip(chars), StringTensor(x))
+
+
+def split(x, sep: str | None = None):
+    """Ragged split: returns a python list (of lists ...) of tokens."""
+    arr = StringTensor(x)._data
+
+    def rec(a):
+        if isinstance(a, str):
+            return a.split(sep)
+        return [rec(v) for v in a]
+
+    return rec(arr.tolist() if isinstance(arr, np.ndarray) else arr)
+
+
+def join(x, sep: str = "") -> str:
+    return sep.join(StringTensor(x)._data.reshape(-1).tolist())
+
+
+def equal(x, y):
+    """Elementwise equality -> framework bool Tensor (device-friendly)."""
+    from .tensor.tensor import Tensor
+
+    xa = StringTensor(x)._data
+    ya = StringTensor(y)._data if not isinstance(y, str) else y
+    if isinstance(ya, str):
+        out = np.asarray([v == ya for v in xa.reshape(-1)], bool).reshape(xa.shape)
+    else:
+        out = np.asarray(
+            [a == b for a, b in zip(xa.reshape(-1), ya.reshape(-1))],
+            bool).reshape(xa.shape)
+    return Tensor(out)
+
+
+def empty(shape, name=None) -> StringTensor:
+    arr = np.empty(shape, dtype=object)
+    arr.reshape(-1)[:] = ""
+    return StringTensor(arr)

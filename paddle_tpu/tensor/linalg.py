@@ -266,6 +266,3 @@ def householder_product(x, tau, name=None):
 
     return apply_op("householder_product", fn, x, tau)
 
-
-def triangular_matmul(*a, **k):  # placeholder for API table completeness
-    raise NotImplementedError

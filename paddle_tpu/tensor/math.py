@@ -444,3 +444,17 @@ def vander(x, n=None, increasing=False, name=None):
         return jnp.vander(x_, N=n, increasing=increasing)
 
     return apply_op("vander", fn, x)
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    """Renormalize sub-tensors along ``axis`` so each slice's p-norm is at
+    most ``max_norm`` (reference: phi/kernels/impl/renorm_impl.h)."""
+
+    def fn(v):
+        ax = axis % v.ndim
+        red = tuple(i for i in range(v.ndim) if i != ax)
+        norms = jnp.sum(jnp.abs(v) ** p, axis=red, keepdims=True) ** (1.0 / p)
+        scale = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+        return v * scale
+
+    return apply_op("renorm", fn, x)

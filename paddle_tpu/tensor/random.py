@@ -191,3 +191,35 @@ def binomial(count, prob, name=None):
         lambda n, p, key: jax.random.binomial(
             key, n.astype(jnp.float32), p.astype(jnp.float32)).astype(jnp.int64),
         count, prob, rng_arg())
+
+
+def top_p_sampling(x, ps, threshold=None, topp_seed=None, seed=-1,
+                   k=0, mode="truncated", return_top=False, name=None):
+    """Nucleus (top-p) sampling (reference: phi top_p_sampling kernel).
+
+    x [bsz, vocab] probabilities, ps [bsz] per-row cutoff. Keeps the
+    smallest prefix of the descending-sorted probs whose mass reaches p,
+    renormalizes, samples one token per row. Returns (scores [bsz, 1],
+    ids [bsz, 1])."""
+    if threshold is not None or k not in (0, None) or mode != "truncated" \
+            or return_top:
+        raise NotImplementedError(
+            "top_p_sampling: only the default truncated top-p mode is "
+            "implemented (threshold/k/mode/return_top unsupported)")
+    karg = (jax.random.key(seed) if seed not in (-1, None)
+            else rng_arg())
+
+    def fn(probs, p, key):
+        sort_idx = jnp.argsort(-probs, axis=-1)
+        sorted_p = jnp.take_along_axis(probs, sort_idx, axis=-1)
+        cum = jnp.cumsum(sorted_p, axis=-1)
+        # keep tokens whose PRECEDING mass < p (always keep the first)
+        keep = (cum - sorted_p) < p[:, None]
+        trunc = jnp.where(keep, sorted_p, 0.0)
+        trunc = trunc / jnp.sum(trunc, axis=-1, keepdims=True)
+        pick = jax.random.categorical(key, jnp.log(trunc + 1e-30), axis=-1)
+        ids = jnp.take_along_axis(sort_idx, pick[:, None], axis=-1)
+        scores = jnp.take_along_axis(probs, ids, axis=-1)
+        return scores, ids.astype(jnp.int64)
+
+    return apply_op("top_p_sampling", fn, x, ps, karg)

@@ -174,7 +174,13 @@ class Tensor:
         run_backward([self], [grad_tensor], retain_graph=retain_graph)
 
     def detach(self) -> "Tensor":
-        out = Tensor(self._data, stop_gradient=True)
+        import jax
+
+        # lax.stop_gradient in addition to the tape-level flag: under an
+        # outer jax transformation (to_static / functional training steps the
+        # tape is off for), detach must cut the jax graph too — otherwise
+        # grads silently flow through "detached" values.
+        out = Tensor(jax.lax.stop_gradient(self._data), stop_gradient=True)
         out._placements = self._placements
         return out
 

@@ -94,3 +94,5 @@ class ViterbiDecoder(Layer):
 
 
 __all__ = ["viterbi_decode", "ViterbiDecoder", "datasets"]
+
+from .tokenizer import BertTokenizer, FasterTokenizer, faster_tokenizer  # noqa: F401,E402

@@ -1,0 +1,148 @@
+"""FasterTokenizer parity: host-side BERT wordpiece tokenization producing
+padded device arrays.
+
+Reference: the faster_tokenizer custom op family
+(paddle/phi/kernels/funcs/string_tensor helpers + the external
+PaddleNLP FasterTokenizer op that fuses basic+wordpiece tokenization into
+the graph). TPU-native: tokenization is host work (ragged strings never
+touch the chip); the op's contract — StringTensor in, padded
+(input_ids, token_type_ids) out — is preserved so text datasets feed BERT
+end-to-end without leaving the framework.
+"""
+from __future__ import annotations
+
+import unicodedata
+
+import numpy as np
+
+from ..strings import StringTensor
+from ..tensor.tensor import Tensor
+
+__all__ = ["BertTokenizer", "FasterTokenizer", "faster_tokenizer"]
+
+
+def _is_punct(ch: str) -> bool:
+    cp = ord(ch)
+    if (33 <= cp <= 47) or (58 <= cp <= 64) or (91 <= cp <= 96) or (123 <= cp <= 126):
+        return True
+    return unicodedata.category(ch).startswith("P")
+
+
+def _basic_tokenize(text: str, do_lower_case: bool) -> list[str]:
+    if do_lower_case:
+        text = text.lower()
+    out: list[str] = []
+    buf = []
+    for ch in text:
+        if ch.isspace():
+            if buf:
+                out.append("".join(buf))
+                buf = []
+        elif _is_punct(ch):
+            if buf:
+                out.append("".join(buf))
+                buf = []
+            out.append(ch)
+        else:
+            buf.append(ch)
+    if buf:
+        out.append("".join(buf))
+    return out
+
+
+class BertTokenizer:
+    """Greedy-longest-match wordpiece over a vocab dict (BERT convention:
+    continuation pieces prefixed '##'; unknown words -> [UNK])."""
+
+    def __init__(self, vocab: dict[str, int], do_lower_case: bool = True,
+                 unk_token: str = "[UNK]", cls_token: str = "[CLS]",
+                 sep_token: str = "[SEP]", pad_token: str = "[PAD]",
+                 max_input_chars_per_word: int = 100):
+        self.vocab = dict(vocab)
+        self.do_lower_case = do_lower_case
+        self.unk_token = unk_token
+        self.cls_token = cls_token
+        self.sep_token = sep_token
+        self.pad_token = pad_token
+        self.max_chars = max_input_chars_per_word
+        for tok in (unk_token, cls_token, sep_token, pad_token):
+            if tok not in self.vocab:
+                raise ValueError(f"vocab is missing special token {tok!r}")
+
+    @classmethod
+    def from_vocab_file(cls, path: str, **kw) -> "BertTokenizer":
+        vocab = {}
+        with open(path, encoding="utf-8") as f:
+            for i, line in enumerate(f):
+                vocab[line.rstrip("\n")] = i
+        return cls(vocab, **kw)
+
+    def wordpiece(self, word: str) -> list[str]:
+        if len(word) > self.max_chars:
+            return [self.unk_token]
+        pieces, start = [], 0
+        while start < len(word):
+            end = len(word)
+            cur = None
+            while start < end:
+                sub = word[start:end]
+                if start > 0:
+                    sub = "##" + sub
+                if sub in self.vocab:
+                    cur = sub
+                    break
+                end -= 1
+            if cur is None:
+                return [self.unk_token]
+            pieces.append(cur)
+            start = end
+        return pieces
+
+    def tokenize(self, text: str) -> list[str]:
+        out = []
+        for word in _basic_tokenize(text, self.do_lower_case):
+            out.extend(self.wordpiece(word))
+        return out
+
+    def __call__(self, text, text_pair=None, max_seq_len: int = 128,
+                 pad_to_max_seq_len: bool = True):
+        """Encode a batch: StringTensor/list[str] -> dict of device Tensors
+        (input_ids, token_type_ids) padded to ``max_seq_len`` — the
+        faster_tokenizer op contract."""
+        if isinstance(text, StringTensor):
+            text = text.numpy().reshape(-1).tolist()
+        elif isinstance(text, str):
+            text = [text]
+        if isinstance(text_pair, StringTensor):
+            text_pair = text_pair.numpy().reshape(-1).tolist()
+        elif isinstance(text_pair, str):
+            text_pair = [text_pair]
+        B = len(text)
+        ids = np.full((B, max_seq_len), self.vocab[self.pad_token], np.int64)
+        segs = np.zeros((B, max_seq_len), np.int64)
+        for b in range(B):
+            toks = [self.cls_token] + self.tokenize(text[b]) + [self.sep_token]
+            seg = [0] * len(toks)
+            if text_pair is not None:
+                pair = self.tokenize(text_pair[b]) + [self.sep_token]
+                toks += pair
+                seg += [1] * len(pair)
+            toks = toks[:max_seq_len]
+            seg = seg[:max_seq_len]
+            row = [self.vocab.get(t, self.vocab[self.unk_token]) for t in toks]
+            ids[b, :len(row)] = row
+            segs[b, :len(seg)] = seg
+        return {"input_ids": Tensor(ids), "token_type_ids": Tensor(segs)}
+
+
+# op-shaped alias (reference: the fused faster_tokenizer op)
+FasterTokenizer = BertTokenizer
+
+
+def faster_tokenizer(vocab: dict[str, int], text, text_pair=None,
+                     do_lower_case: bool = True, max_seq_len: int = 128):
+    """Functional form of the faster_tokenizer op: returns
+    (input_ids, token_type_ids) Tensors."""
+    tok = BertTokenizer(vocab, do_lower_case=do_lower_case)
+    out = tok(text, text_pair, max_seq_len=max_seq_len)
+    return out["input_ids"], out["token_type_ids"]

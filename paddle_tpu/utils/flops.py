@@ -8,7 +8,7 @@ from __future__ import annotations
 
 import math
 
-_FLOPS_COMPUTERS: dict[str, callable] = {}
+from ..framework.op_registry import attach_flops, flops_fn
 
 
 def prod(s) -> int:
@@ -19,8 +19,11 @@ def prod(s) -> int:
 
 
 def register_flops(op_type: str):
+    """Attach an analytic FLOPs fn to the op's registry row
+    (framework/op_registry.py — the single source of truth)."""
+
     def decorator(fn):
-        _FLOPS_COMPUTERS[op_type] = fn
+        attach_flops(op_type, fn)
         return fn
 
     return decorator
@@ -28,7 +31,7 @@ def register_flops(op_type: str):
 
 def flops(op_type: str, input_shapes: dict, attrs: dict | None = None) -> int:
     """FLOPs of one op call. Returns 0 for unregistered ops (parity behavior)."""
-    fn = _FLOPS_COMPUTERS.get(op_type)
+    fn = flops_fn(op_type)
     if fn is None:
         return 0
     return int(fn(input_shapes, attrs or {}))

@@ -187,3 +187,563 @@ class RoIPool:
 
 
 __all__ = ["box_iou", "nms", "roi_align", "roi_pool", "RoIAlign", "RoIPool"]
+
+
+# ---------------------------------------------------------------------------
+# Detection tail ops (round-1 verdict item 5). Reference kernels:
+# phi/kernels/impl/box_coder.h, gpu/prior_box_kernel.cu, gpu/yolo_box_kernel.cu,
+# cpu/yolo_loss_kernel.cc, gpu/matrix_nms_kernel.cu, gpu/psroi_pool_kernel.cu,
+# gpu/generate_proposals_kernel.cu, gpu/distribute_fpn_proposals_kernel.cu.
+# TPU stance: everything static-shaped; "variable-count" outputs are padded
+# arrays + explicit counts (XLA cannot do data-dependent shapes).
+# ---------------------------------------------------------------------------
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5,
+              min_max_aspect_ratios_order=False, name=None):
+    """SSD prior boxes over a feature map (reference: prior_box_kernel.cu).
+    Returns (boxes [H, W, P, 4], variances [H, W, P, 4]) normalized xyxy."""
+    import numpy as np
+
+    min_sizes = [float(m) for m in np.atleast_1d(min_sizes)]
+    max_sizes = [float(m) for m in np.atleast_1d(max_sizes)] if max_sizes else []
+    ars = [1.0]
+    for ar in np.atleast_1d(aspect_ratios):
+        ar = float(ar)
+        if not any(abs(ar - e) < 1e-6 for e in ars):
+            ars.append(ar)
+            if flip:
+                ars.append(1.0 / ar)
+
+    def fn(feat, img):
+        H, W = feat.shape[2], feat.shape[3]
+        imH, imW = img.shape[2], img.shape[3]
+        step_w = float(steps[0]) or imW / W
+        step_h = float(steps[1]) or imH / H
+        cx = (jnp.arange(W) + offset) * step_w  # [W]
+        cy = (jnp.arange(H) + offset) * step_h  # [H]
+        whs = []  # per-prior (w, h) in pixels
+        for k, ms in enumerate(min_sizes):
+            def ar_whs():
+                return [(ms * float(np.sqrt(a)), ms / float(np.sqrt(a)))
+                        for a in ars if abs(a - 1.0) >= 1e-6]
+
+            whs.append((ms, ms))
+            if min_max_aspect_ratios_order:
+                if max_sizes:
+                    bs = float(np.sqrt(ms * max_sizes[k]))
+                    whs.append((bs, bs))
+                whs.extend(ar_whs())
+            else:
+                whs.extend(ar_whs())
+                if max_sizes:
+                    bs = float(np.sqrt(ms * max_sizes[k]))
+                    whs.append((bs, bs))
+        wh = jnp.asarray(whs, jnp.float32)  # [P, 2]
+        P = wh.shape[0]
+        shape = (H, W, P)
+        boxes = jnp.stack([
+            jnp.broadcast_to((cx[None, :, None] - wh[None, None, :, 0] / 2) / imW, shape),
+            jnp.broadcast_to((cy[:, None, None] - wh[None, None, :, 1] / 2) / imH, shape),
+            jnp.broadcast_to((cx[None, :, None] + wh[None, None, :, 0] / 2) / imW, shape),
+            jnp.broadcast_to((cy[:, None, None] + wh[None, None, :, 1] / 2) / imH, shape),
+        ], axis=-1)  # [H, W, P, 4]
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        var = jnp.broadcast_to(jnp.asarray(variance, jnp.float32),
+                               (H, W, P, 4))
+        return boxes, var
+
+    return apply_op("prior_box", fn, input, image)
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True, axis=0,
+              name=None):
+    """Encode/decode boxes against priors (reference: box_coder.h)."""
+    import numpy as np
+
+    def split_prior(p):
+        norm = 0.0 if box_normalized else 1.0
+        pw = p[..., 2] - p[..., 0] + norm
+        ph = p[..., 3] - p[..., 1] + norm
+        pcx = p[..., 0] + pw / 2
+        pcy = p[..., 1] + ph / 2
+        return pw, ph, pcx, pcy
+
+    def fn(p, pv, t):
+        if pv is not None and pv.ndim == 1:
+            pv = pv[None, :]
+        if code_type == "encode_center_size":
+            # t [N,4] targets vs p [M,4] priors -> [N, M, 4]
+            pw, ph, pcx, pcy = split_prior(p)  # [M]
+            norm = 0.0 if box_normalized else 1.0
+            tw = t[:, 2] - t[:, 0] + norm
+            th = t[:, 3] - t[:, 1] + norm
+            tcx = t[:, 0] + tw / 2
+            tcy = t[:, 1] + th / 2
+            ox = (tcx[:, None] - pcx[None, :]) / pw[None, :]
+            oy = (tcy[:, None] - pcy[None, :]) / ph[None, :]
+            ow = jnp.log(tw[:, None] / pw[None, :])
+            oh = jnp.log(th[:, None] / ph[None, :])
+            out = jnp.stack([ox, oy, ow, oh], axis=-1)
+            if pv is not None:
+                out = out / pv[None, :, :]
+            return out
+        # decode_center_size: t [N, M, 4] deltas; priors broadcast on `axis`
+        pw, ph, pcx, pcy = split_prior(p)
+        ex = (None, slice(None)) if axis == 0 else (slice(None), None)
+        d = t
+        if pv is not None:
+            d = d * (pv[ex[0], ex[1], :] if pv.ndim == 2 else pv)
+        dcx = d[..., 0] * pw[ex] + pcx[ex]
+        dcy = d[..., 1] * ph[ex] + pcy[ex]
+        dw = jnp.exp(d[..., 2]) * pw[ex]
+        dh = jnp.exp(d[..., 3]) * ph[ex]
+        norm = 0.0 if box_normalized else 1.0
+        return jnp.stack([
+            dcx - dw / 2, dcy - dh / 2,
+            dcx + dw / 2 - norm, dcy + dh / 2 - norm,
+        ], axis=-1)
+
+    return apply_op("box_coder", fn, prior_box, prior_box_var, target_box)
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox=True, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5, name=None):
+    """Decode YOLOv3 head predictions (reference: yolo_box_kernel.cu).
+    x [N, an*(5+C), H, W] (plus `an` iou channels first when iou_aware);
+    returns (boxes [N, an*H*W, 4] image-pixel xyxy, scores [N, an*H*W, C]).
+    Box layout is anchor-major over (an, H, W)."""
+    an = len(anchors) // 2
+
+    def fn(v, imgs):
+        N, _, H, W = v.shape
+        if iou_aware:
+            iou_pred = jax.nn.sigmoid(v[:, :an].reshape(N, an, 1, H, W))
+            v = v[:, an:]
+        v = v.reshape(N, an, 5 + class_num, H, W)
+        aw = jnp.asarray(anchors[0::2], jnp.float32).reshape(1, an, 1, 1)
+        ah = jnp.asarray(anchors[1::2], jnp.float32).reshape(1, an, 1, 1)
+        gx = jnp.arange(W, dtype=jnp.float32).reshape(1, 1, 1, W)
+        gy = jnp.arange(H, dtype=jnp.float32).reshape(1, 1, H, 1)
+        bias = 0.5 * (scale_x_y - 1.0)
+        bx = (jax.nn.sigmoid(v[:, :, 0]) * scale_x_y - bias + gx) / W
+        by = (jax.nn.sigmoid(v[:, :, 1]) * scale_x_y - bias + gy) / H
+        bw = jnp.exp(v[:, :, 2]) * aw / (W * downsample_ratio)
+        bh = jnp.exp(v[:, :, 3]) * ah / (H * downsample_ratio)
+        conf = jax.nn.sigmoid(v[:, :, 4])
+        if iou_aware:
+            conf = conf ** (1.0 - iou_aware_factor) * (
+                iou_pred[:, :, 0] ** iou_aware_factor)
+        cls = jax.nn.sigmoid(v[:, :, 5:])  # [N, an, C, H, W]
+        img_h = imgs[:, 0].astype(jnp.float32).reshape(N, 1, 1, 1)
+        img_w = imgs[:, 1].astype(jnp.float32).reshape(N, 1, 1, 1)
+        x1 = (bx - bw / 2) * img_w
+        y1 = (by - bh / 2) * img_h
+        x2 = (bx + bw / 2) * img_w
+        y2 = (by + bh / 2) * img_h
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0.0, img_w - 1)
+            y1 = jnp.clip(y1, 0.0, img_h - 1)
+            x2 = jnp.clip(x2, 0.0, img_w - 1)
+            y2 = jnp.clip(y2, 0.0, img_h - 1)
+        valid = conf >= conf_thresh
+        boxes = jnp.stack([x1, y1, x2, y2], axis=-1)  # [N, an, H, W, 4]
+        boxes = jnp.where(valid[..., None], boxes, 0.0)
+        scores = conf[:, :, None] * cls  # [N, an, C, H, W]
+        scores = jnp.where(valid[:, :, None], scores, 0.0)
+        boxes = boxes.reshape(N, an * H * W, 4)
+        scores = jnp.moveaxis(scores, 2, -1).reshape(N, an * H * W, class_num)
+        return boxes, scores
+
+    return apply_op("yolo_box", fn, x, img_size)
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, scale_x_y=1.0, name=None):
+    """YOLOv3 loss for one detection scale (reference: yolo_loss_kernel.cc).
+
+    x [N, am*(5+C), H, W]; gt_box [N, B, 4] normalized (cx, cy, w, h);
+    gt_label [N, B] int. Positives: each gt is assigned to its best anchor
+    (by wh-IoU over the FULL anchor list); only anchors in ``anchor_mask``
+    produce loss, at the gt's center cell. Objectness negatives with best-gt
+    IoU above ``ignore_thresh`` are ignored. Returns loss [N]."""
+    import numpy as np
+
+    am = list(anchor_mask)
+    n_mask = len(am)
+    all_aw = np.asarray(anchors[0::2], np.float32)
+    all_ah = np.asarray(anchors[1::2], np.float32)
+
+    def bce(logit, label):
+        return jnp.maximum(logit, 0) - logit * label + jnp.log1p(
+            jnp.exp(-jnp.abs(logit)))
+
+    def fn(v, gb, gl, gs):
+        N, _, H, W = v.shape
+        B = gb.shape[1]
+        v = v.reshape(N, n_mask, 5 + class_num, H, W)
+        in_w = W * downsample_ratio
+        in_h = H * downsample_ratio
+
+        # --- ignore mask: pred-box IoU vs every gt -----------------------
+        aw = jnp.asarray(all_aw[am]).reshape(1, n_mask, 1, 1)
+        ah = jnp.asarray(all_ah[am]).reshape(1, n_mask, 1, 1)
+        gx = jnp.arange(W, dtype=jnp.float32).reshape(1, 1, 1, W)
+        gy = jnp.arange(H, dtype=jnp.float32).reshape(1, 1, H, 1)
+        px = (jax.nn.sigmoid(v[:, :, 0]) + gx) / W
+        py = (jax.nn.sigmoid(v[:, :, 1]) + gy) / H
+        pw = jnp.exp(v[:, :, 2]) * aw / in_w
+        ph = jnp.exp(v[:, :, 3]) * ah / in_h
+        # corners, normalized
+        p1 = jnp.stack([px - pw / 2, py - ph / 2], -1)
+        p2 = jnp.stack([px + pw / 2, py + ph / 2], -1)
+        g1 = jnp.stack([gb[..., 0] - gb[..., 2] / 2,
+                        gb[..., 1] - gb[..., 3] / 2], -1)  # [N, B, 2]
+        g2 = jnp.stack([gb[..., 0] + gb[..., 2] / 2,
+                        gb[..., 1] + gb[..., 3] / 2], -1)
+        lt = jnp.maximum(p1[:, :, :, :, None, :], g1[:, None, None, None])
+        rb = jnp.minimum(p2[:, :, :, :, None, :], g2[:, None, None, None])
+        wh = jnp.clip(rb - lt, 0.0)
+        inter = wh[..., 0] * wh[..., 1]
+        parea = (pw * ph)[..., None]
+        garea = (gb[..., 2] * gb[..., 3])[:, None, None, None, :]
+        iou = inter / (parea + garea - inter + 1e-10)  # [N,a,H,W,B]
+        gvalid = (gb[..., 2] > 0)[:, None, None, None, :]
+        best_iou = jnp.max(jnp.where(gvalid, iou, 0.0), axis=-1)
+        ignore = best_iou > ignore_thresh
+
+        # --- positive assignment ----------------------------------------
+        # best anchor per gt by wh-IoU at origin over the FULL anchor list
+        gw = gb[..., 2] * in_w  # pixels
+        gh = gb[..., 3] * in_h
+        awf = jnp.asarray(all_aw).reshape(1, 1, -1)
+        ahf = jnp.asarray(all_ah).reshape(1, 1, -1)
+        inter_a = (jnp.minimum(gw[..., None], awf)
+                   * jnp.minimum(gh[..., None], ahf))
+        union_a = gw[..., None] * gh[..., None] + awf * ahf - inter_a
+        best_a = jnp.argmax(inter_a / (union_a + 1e-10), axis=-1)  # [N,B]
+        # local index within this scale's mask (or -1)
+        local = jnp.full_like(best_a, -1)
+        for li, a in enumerate(am):
+            local = jnp.where(best_a == a, li, local)
+        valid = (local >= 0) & (gb[..., 2] > 0)
+        gi = jnp.clip((gb[..., 0] * W).astype(jnp.int32), 0, W - 1)
+        gj = jnp.clip((gb[..., 1] * H).astype(jnp.int32), 0, H - 1)
+
+        # scatter targets: [N, a, H, W] planes
+        score_w = gs if gs is not None else jnp.ones_like(gb[..., 0])
+        tx = gb[..., 0] * W - gi
+        ty = gb[..., 1] * H - gj
+        aw_g = jnp.take(jnp.asarray(all_aw), jnp.clip(best_a, 0))
+        ah_g = jnp.take(jnp.asarray(all_ah), jnp.clip(best_a, 0))
+        tw = jnp.log(jnp.clip(gw / aw_g, 1e-9))
+        th = jnp.log(jnp.clip(gh / ah_g, 1e-9))
+        box_w = (2.0 - gb[..., 2] * gb[..., 3]) * score_w  # paddle scale
+
+        nidx = jnp.arange(N)[:, None].repeat(B, 1)
+        li = jnp.clip(local, 0)
+
+        def plane(vals):
+            p = jnp.zeros((N, n_mask, H, W), jnp.float32)
+            return p.at[nidx, li, gj, gi].set(
+                jnp.where(valid, vals, 0.0), mode="drop")
+
+        obj_t = plane(jnp.ones_like(tx))
+        objw_t = plane(score_w)
+        tx_t, ty_t = plane(tx), plane(ty)
+        tw_t, th_t = plane(tw), plane(th)
+        bw_t = plane(box_w)
+        # class one-hot targets [N, a, H, W, C]
+        smooth = 1.0 / class_num if (use_label_smooth and class_num > 1) else 0.0
+        onehot = jax.nn.one_hot(gl, class_num)
+        if smooth:
+            onehot = onehot * (1.0 - smooth) + smooth * 0.5  # paddle-ish
+        cls_t = jnp.zeros((N, n_mask, H, W, class_num), jnp.float32)
+        cls_t = cls_t.at[nidx, li, gj, gi].set(
+            jnp.where(valid[..., None], onehot, 0.0), mode="drop")
+
+        pos = obj_t > 0
+        lx = bw_t * bce(v[:, :, 0], tx_t) * pos
+        ly = bw_t * bce(v[:, :, 1], ty_t) * pos
+        lw = bw_t * jnp.abs(v[:, :, 2] - tw_t) * pos
+        lh = bw_t * jnp.abs(v[:, :, 3] - th_t) * pos
+        obj_logit = v[:, :, 4]
+        lobj = (objw_t * bce(obj_logit, jnp.ones_like(obj_logit)) * pos
+                + bce(obj_logit, jnp.zeros_like(obj_logit))
+                * (~pos) * (~ignore))
+        lcls = (bce(jnp.moveaxis(v[:, :, 5:], 2, -1), cls_t)
+                * pos[..., None]).sum(-1)
+        per_im = (lx + ly + lw + lh + lobj + lcls).sum(axis=(1, 2, 3))
+        return per_im
+
+    return apply_op("yolo_loss", fn, x, gt_box, gt_label, gt_score)
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
+               nms_top_k=400, keep_top_k=200, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0, normalized=True,
+               return_index=False, return_rois_num=True, name=None):
+    """Matrix NMS (SOLOv2; reference: matrix_nms_kernel.cu). Decay-based,
+    no sequential suppression — TPU-friendly closed form.
+
+    bboxes [N, M, 4], scores [N, C, M]. Static-shape output: padded
+    [N, keep_top_k, 6] (label, score, xyxy), invalid rows -1; plus index
+    [N, keep_top_k] and rois_num [N]."""
+
+    def fn(bb, sc):
+        N, C, M = sc.shape
+        K = min(nms_top_k, M)
+
+        def one_image(b, s):
+            # mask out background + below-threshold
+            cls_ids = jnp.arange(C)
+            keep_cls = cls_ids != background_label
+            s = jnp.where(keep_cls[:, None], s, -1.0)
+            s = jnp.where(s >= score_threshold, s, -1.0)
+            # flatten (class, box), take top nms_top_k
+            flat = s.reshape(-1)
+            top_s, top_i = jax.lax.top_k(flat, K)
+            top_c = top_i // M
+            top_b = top_i % M
+            boxes_k = b[top_b]
+            # IoU among selected (same-class only suppresses)
+            area = ((boxes_k[:, 2] - boxes_k[:, 0])
+                    * (boxes_k[:, 3] - boxes_k[:, 1]))
+            lt = jnp.maximum(boxes_k[:, None, :2], boxes_k[None, :, :2])
+            rb = jnp.minimum(boxes_k[:, None, 2:], boxes_k[None, :, 2:])
+            wh = jnp.clip(rb - lt, 0.0)
+            inter = wh[..., 0] * wh[..., 1]
+            iou = inter / (area[:, None] + area[None, :] - inter + 1e-10)
+            same_cls = top_c[:, None] == top_c[None, :]
+            higher = (jnp.arange(K)[None, :] < jnp.arange(K)[:, None])
+            iou_h = jnp.where(same_cls & higher, iou, 0.0)  # [i, j<i]
+            iou_max = jnp.max(iou_h, axis=1)  # compensation per i... per j
+            # decay_j = min_i f(iou_ij) / f(iou_max_i) over higher-scored i
+            if use_gaussian:
+                f = lambda x: jnp.exp(-(x ** 2) / gaussian_sigma)
+            else:
+                f = lambda x: 1.0 - x
+            comp = f(jnp.where(same_cls & higher, iou, 0.0))
+            # entry [t, j]: suppressor j (higher-ranked) decays target t,
+            # normalized by the suppressor's OWN max overlap f(iou_max[j])
+            comp_norm = jnp.broadcast_to(f(iou_max)[None, :], (K, K))
+            decay = jnp.where(same_cls & higher,
+                              comp / jnp.maximum(comp_norm, 1e-10), 1.0)
+            decay = jnp.min(decay, axis=1)
+            new_s = jnp.where(top_s > 0, top_s * decay, -1.0)
+            new_s = jnp.where(new_s >= post_threshold, new_s, -1.0)
+            KK = min(keep_top_k, K)
+            fin_s, fin_i = jax.lax.top_k(new_s, KK)
+            out = jnp.concatenate([
+                top_c[fin_i][:, None].astype(jnp.float32),
+                fin_s[:, None],
+                boxes_k[fin_i],
+            ], axis=1)
+            valid = fin_s > 0
+            out = jnp.where(valid[:, None], out, -1.0)
+            idx = jnp.where(valid, top_b[fin_i], -1)
+            return out, idx, valid.sum()
+
+        outs, idxs, nums = jax.vmap(one_image)(bb, sc)
+        return outs, idxs, nums.astype(jnp.int32)
+
+    out, idx, num = apply_op("matrix_nms", fn, bboxes, scores)
+    res = [out]
+    if return_index:
+        res.append(idx)
+    if return_rois_num:
+        res.append(num)
+    return tuple(res) if len(res) > 1 else out
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """Position-sensitive RoI average pooling (reference:
+    psroi_pool_kernel.cu). x [N, C, H, W] with C = out_c * k * k;
+    boxes [M, 4]; returns [M, out_c, k, k]."""
+    import numpy as np
+
+    k = output_size if isinstance(output_size, int) else output_size[0]
+
+    def fn(feat, rois, rois_num):
+        N, C, H, W = feat.shape
+        out_c = C // (k * k)
+        M = rois.shape[0]
+        # map each roi to its image by boxes_num counts
+        cum = jnp.cumsum(rois_num)
+        img_of = jnp.searchsorted(cum, jnp.arange(M), side="right")
+
+        def one(roi, bi):
+            x1 = roi[0] * spatial_scale
+            y1 = roi[1] * spatial_scale
+            x2 = roi[2] * spatial_scale
+            y2 = roi[3] * spatial_scale
+            rw = jnp.maximum(x2 - x1, 0.1)
+            rh = jnp.maximum(y2 - y1, 0.1)
+            bin_w = rw / k
+            bin_h = rh / k
+            fm = feat[bi].reshape(out_c, k, k, H, W)
+            ys = jnp.arange(H, dtype=jnp.float32)
+            xs = jnp.arange(W, dtype=jnp.float32)
+
+            def bin_val(ph, pw):
+                hs = jnp.floor(y1 + ph * bin_h)
+                he = jnp.ceil(y1 + (ph + 1) * bin_h)
+                ws = jnp.floor(x1 + pw * bin_w)
+                we = jnp.ceil(x1 + (pw + 1) * bin_w)
+                mask_y = (ys >= hs) & (ys < he) & (ys >= 0) & (ys < H)
+                mask_x = (xs >= ws) & (xs < we) & (xs >= 0) & (xs < W)
+                m2 = mask_y[:, None] & mask_x[None, :]
+                cnt = jnp.maximum(m2.sum(), 1)
+                plane = fm[:, ph, pw]  # [out_c, H, W]
+                return jnp.where(m2[None], plane, 0.0).sum((1, 2)) / cnt
+
+            vals = jnp.stack([
+                jnp.stack([bin_val(ph, pw) for pw in range(k)], -1)
+                for ph in range(k)], -2)  # [out_c, k, k]
+            return vals
+
+        return jax.vmap(one)(rois, img_of)
+
+    return apply_op("psroi_pool", fn, x, boxes, boxes_num)
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False, rois_num=None,
+                             name=None):
+    """Assign RoIs to FPN levels (reference:
+    distribute_fpn_proposals_kernel.cu). Static-shape: per-level outputs are
+    [M, 4] padded arrays with a count each; restore_ind maps the
+    concatenated per-level order back to the input order."""
+    import numpy as np
+
+    n_levels = max_level - min_level + 1
+
+    def fn(rois):
+        M = rois.shape[0]
+        off = 1.0 if pixel_offset else 0.0
+        w = rois[:, 2] - rois[:, 0] + off
+        h = rois[:, 3] - rois[:, 1] + off
+        scale = jnp.sqrt(jnp.clip(w * h, 0.0))
+        lvl = jnp.floor(jnp.log2(scale / refer_scale + 1e-8)) + refer_level
+        lvl = jnp.clip(lvl, min_level, max_level).astype(jnp.int32)
+        outs = []
+        counts = []
+        order_parts = []
+        for L in range(min_level, max_level + 1):
+            m = lvl == L
+            # stable partition: indices of this level first, padded
+            key = jnp.where(m, jnp.arange(M), M + jnp.arange(M))
+            perm = jnp.argsort(key)
+            sel = rois[perm]
+            cnt = m.sum()
+            valid = jnp.arange(M) < cnt
+            outs.append(jnp.where(valid[:, None], sel, -1.0))
+            counts.append(cnt)
+            order_parts.append(jnp.where(valid, perm, -1))
+        # restore index: position of each original roi in the concatenated
+        # per-level output
+        restore = jnp.zeros((M,), jnp.int32)
+        base = 0
+        for i, part in enumerate(order_parts):
+            pos = jnp.arange(M) + base
+            restore = restore.at[jnp.clip(part, 0)].set(
+                jnp.where(part >= 0, pos, restore[jnp.clip(part, 0)]).astype(jnp.int32))
+            base = base + counts[i]
+        return (*outs, restore, jnp.stack(counts).astype(jnp.int32))
+
+    if rois_num is not None:
+        raise NotImplementedError(
+            "distribute_fpn_proposals: per-image rois_num bookkeeping is not "
+            "implemented; pass the flat RoI tensor (level assignment is "
+            "per-RoI and image-independent)")
+    res = apply_op("distribute_fpn_proposals", fn, fpn_rois)
+    multi_rois = list(res[:n_levels])
+    restore_ind = res[n_levels]
+    nums = res[n_levels + 1]
+    return multi_rois, restore_ind, nums
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=True, name=None):
+    """RPN proposal generation (reference: generate_proposals_kernel.cu).
+    scores [N, A, H, W], bbox_deltas [N, 4A, H, W], anchors [H, W, A, 4]
+    (or flattened), variances like anchors. Static-shape: returns
+    rois [N, post_nms_top_n, 4] padded, roi_probs, rois_num [N]."""
+
+    def fn(sc, deltas, imgs, anc, var):
+        N, A, H, W = sc.shape
+        anc = anc.reshape(-1, 4)
+        var_f = var.reshape(-1, 4) if var is not None else None
+        M = anc.shape[0]  # H*W*A with anchor-minor layout [H, W, A]
+        K1 = min(pre_nms_top_n, M)
+        K2 = min(post_nms_top_n, K1)
+        off = 1.0 if pixel_offset else 0.0
+
+        def one(s, d, im):
+            # layouts: scores [A, H, W] -> [H, W, A] flat; deltas [4A, H, W]
+            sf = jnp.moveaxis(s, 0, -1).reshape(-1)
+            df = jnp.moveaxis(d.reshape(A, 4, H, W), (2, 3), (0, 1)
+                              ).reshape(-1, 4)
+            # decode (anchor + delta * var), center-size form
+            aw = anc[:, 2] - anc[:, 0] + off
+            ah = anc[:, 3] - anc[:, 1] + off
+            acx = anc[:, 0] + aw / 2
+            acy = anc[:, 1] + ah / 2
+            dd = df * var_f if var_f is not None else df
+            cx = dd[:, 0] * aw + acx
+            cy = dd[:, 1] * ah + acy
+            bw = jnp.exp(jnp.clip(dd[:, 2], -10, 10)) * aw
+            bh = jnp.exp(jnp.clip(dd[:, 3], -10, 10)) * ah
+            boxes = jnp.stack([cx - bw / 2, cy - bh / 2,
+                               cx + bw / 2 - off, cy + bh / 2 - off], -1)
+            imh, imw = im[0], im[1]
+            boxes = jnp.stack([
+                jnp.clip(boxes[:, 0], 0, imw - off),
+                jnp.clip(boxes[:, 1], 0, imh - off),
+                jnp.clip(boxes[:, 2], 0, imw - off),
+                jnp.clip(boxes[:, 3], 0, imh - off)], -1)
+            ww = boxes[:, 2] - boxes[:, 0] + off
+            hh = boxes[:, 3] - boxes[:, 1] + off
+            ok = (ww >= min_size) & (hh >= min_size)
+            sf = jnp.where(ok, sf, -1e30)
+            top_s, top_i = jax.lax.top_k(sf, K1)
+            bb = boxes[top_i]
+            # greedy nms over K1 sorted boxes
+            area = (bb[:, 2] - bb[:, 0] + off) * (bb[:, 3] - bb[:, 1] + off)
+            lt = jnp.maximum(bb[:, None, :2], bb[None, :, :2])
+            rb = jnp.minimum(bb[:, None, 2:], bb[None, :, 2:])
+            wh = jnp.clip(rb - lt + off, 0.0)
+            inter = wh[..., 0] * wh[..., 1]
+            iou = inter / (area[:, None] + area[None, :] - inter + 1e-10)
+
+            def body(i, keep):
+                sup = jnp.any((iou[i] > nms_thresh) & keep
+                              & (jnp.arange(K1) < i))
+                return keep.at[i].set(keep[i] & ~sup)
+
+            keep0 = top_s > -1e29
+            keep = jax.lax.fori_loop(0, K1, body, keep0)
+            key = jnp.where(keep, -top_s, 1e30 + jnp.arange(K1, dtype=jnp.float32))
+            perm = jnp.argsort(key)[:K2]
+            valid = keep[perm]
+            rois = jnp.where(valid[:, None], bb[perm], 0.0)
+            probs = jnp.where(valid, top_s[perm], 0.0)
+            return rois, probs, valid.sum()
+
+        rois, probs, nums = jax.vmap(one)(sc, deltas, imgs.astype(jnp.float32))
+        return rois, probs, nums.astype(jnp.int32)
+
+    out = apply_op("generate_proposals", fn, scores, bbox_deltas, img_size,
+                   anchors, variances)
+    if return_rois_num:
+        return out
+    return out[0], out[1]

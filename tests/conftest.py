@@ -34,3 +34,14 @@ def _seeded():
 
     paddle_tpu.seed(1234)
     yield
+
+
+@pytest.fixture(autouse=True, scope="session")
+def _strict_op_registry():
+    """Every op dispatched anywhere in the suite must have a registry row
+    (catches dynamically-named ops the source scan cannot see)."""
+    from paddle_tpu.framework import op_registry
+
+    op_registry.set_strict(True)
+    yield
+    op_registry.set_strict(False)

@@ -1,0 +1,59 @@
+"""Single-source op registry (framework/op_registry.py) — the YAML
+equivalent (reference: phi/api/yaml/ops.yaml + generator/api_gen.py).
+
+The completeness gate scans package source for every op name dispatched via
+apply_op/make_op and fails when one lacks a registry row, so new ops cannot
+skip registration (round-1 verdict: four-places-to-forget)."""
+import glob
+import os
+import re
+
+import pytest
+
+from paddle_tpu.framework import op_registry
+
+PKG = os.path.join(os.path.dirname(__file__), "..", "paddle_tpu")
+
+
+def _source_op_names():
+    names = set()
+    for p in glob.glob(os.path.join(PKG, "**", "*.py"), recursive=True):
+        src = open(p).read()
+        for m in re.finditer(r'(?:apply_op|make_op)\(\s*[fF]?"([a-z0-9_{}]+)"',
+                             src):
+            n = m.group(1)
+            if "{" not in n:
+                names.add(n)
+    return names
+
+
+def test_every_dispatched_op_is_registered():
+    missing = sorted(_source_op_names() - set(op_registry.OP_TABLE))
+    assert not missing, (
+        f"ops dispatched via apply_op/make_op without a registry row: "
+        f"{missing} — add them to framework/op_registry.py (the single "
+        "source of truth)")
+
+
+def test_derived_views_consistent():
+    from paddle_tpu.amp.amp_lists import BLACK_LIST, WHITE_LIST
+    from paddle_tpu.autograd.engine import NON_DIFF_OPS
+
+    assert WHITE_LIST == op_registry.amp_white_list()
+    assert BLACK_LIST == op_registry.amp_black_list()
+    assert NON_DIFF_OPS == op_registry.non_diff_ops()
+    assert not (WHITE_LIST & BLACK_LIST)
+
+
+def test_flops_attach_through_registry():
+    from paddle_tpu.utils.flops import flops
+
+    n = flops("matmul", {"X": [[4, 8]], "Y": [[8, 16]]}, {})
+    assert n == 2 * 4 * 8 * 16
+    assert op_registry.flops_fn("matmul") is not None
+    assert flops("not_a_real_op", {}, {}) == 0
+
+
+def test_registry_scale():
+    # the registry must actually drive the surface (round-1: >=350 ops)
+    assert len(op_registry.OP_TABLE) >= 350
