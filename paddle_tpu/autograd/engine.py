@@ -175,7 +175,7 @@ def apply_op(name: str, fn: Callable, *args, **kwargs):
 
     leaves, treedef = jax.tree.flatten((args, kwargs), is_leaf=_is_tensor)
 
-    if _op_registry.STRICT[0] and name not in _op_registry.OP_TABLE:
+    if _op_registry.STRICT[0] and not _op_registry.is_registered(name):
         raise AssertionError(
             f"op '{name}' dispatched via apply_op without a registry row — "
             "add it to framework/op_registry.py (single source of truth)")

@@ -17,6 +17,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..framework import config
+from ..framework import op_registry
 from ..framework.random import default_generator
 from ..tensor.tensor import Tensor
 
@@ -84,9 +85,11 @@ def _graph_wrap(method):
                 for n, v in saved.items():
                     setattr(self, n, v)
 
-        return apply_op(
-            f"{type(self).__name__}.{method.__name__}", pure,
-            tuple(orig.values()), *args, **kwargs)
+        op_name = f"{type(self).__name__}.{method.__name__}"
+        # Dynamically-formed name (one per concrete distribution class):
+        # register the row here so the strict dispatch gate stays sound.
+        op_registry.register_op(op_name, notes="distribution graphed method")
+        return apply_op(op_name, pure, tuple(orig.values()), *args, **kwargs)
 
     wrapper._graphed = True
     return wrapper

@@ -54,6 +54,26 @@ def set_strict(on: bool) -> None:
     STRICT[0] = bool(on)
 
 
+def is_registered(name: str) -> bool:
+    """True when ``name`` has a row, directly or as a derived name.
+
+    Derived names the engine itself forms are legitimate without their own
+    row: ``<op>_grad`` (and ``_grad_grad`` … for higher-order backward) is
+    dispatched by ``GradNode.run_vjp_recorded`` for every differentiable op,
+    so the base row covers the whole derivative tower (the reference's
+    backward ops are likewise generated from the forward YAML row,
+    paddle/phi/api/yaml/backward.yaml).
+    """
+    if name in OP_TABLE:
+        return True
+    base = name
+    while base.endswith("_grad"):
+        base = base[: -len("_grad")]
+        if base in OP_TABLE:
+            return True
+    return False
+
+
 def register_op(name: str, *, amp: str | None = None, non_diff: bool = False,
                 notes: str = "") -> OpSpec:
     """Add (or fetch) the registry row for ``name``."""

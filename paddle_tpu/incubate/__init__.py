@@ -57,6 +57,10 @@ def segment_max(data, segment_ids, name=None):
 
 
 def _segment(name, jfn, data, segment_ids):
+    from ..framework.op_registry import register_op
+
+    register_op(name, notes="geometric segment reduction")
+
     def fn(d, ids):
         num = int(jnp.max(ids)) + 1 if ids.size else 0
         return jfn(d, ids, num_segments=num)

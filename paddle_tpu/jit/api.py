@@ -157,25 +157,41 @@ class _ConcreteProgram:
         n_leaves = treedef.num_leaves
         out_info = self.out_info
 
-        def pure(param_arrays: dict, *tensor_datas):
+        def pure(rng_key, param_arrays: dict, *tensor_datas):
             rebuilt = [None] * n_leaves
             for pos, val in const_leaves.items():
                 rebuilt[pos] = val
             for pos, d in zip(tensor_pos, tensor_datas):
                 rebuilt[pos] = Tensor(d)
             args, kwargs = jax.tree.unflatten(treedef, rebuilt)
-            with no_grad():
-                if layer is not None:
-                    was_training = layer.training
-                    (layer.train if train else layer.eval)()
-                    try:
-                        out = functional_call(
-                            layer, param_arrays, *args, _forward=function, **kwargs
-                        )
-                    finally:
-                        (layer.train if was_training else layer.eval)()
-                else:
-                    out = function(*args, **kwargs)
+            # Randomness is threaded as a per-call input: install the traced
+            # key as the generator's trace key so every rng_arg()/next_key()
+            # inside the program folds in from it. Without this, keys drawn
+            # during tracing are baked as constants and a @to_static dropout
+            # replays the identical mask every call (reference dy2static/SOT
+            # re-draws per run via the DeviceContext generator).
+            from ..framework.random import default_generator
+
+            saved_tk = default_generator._trace_key
+            saved_ctr = default_generator._counter
+            default_generator._trace_key = rng_key
+            default_generator._counter = 0
+            try:
+                with no_grad():
+                    if layer is not None:
+                        was_training = layer.training
+                        (layer.train if train else layer.eval)()
+                        try:
+                            out = functional_call(
+                                layer, param_arrays, *args, _forward=function, **kwargs
+                            )
+                        finally:
+                            (layer.train if was_training else layer.eval)()
+                    else:
+                        out = function(*args, **kwargs)
+            finally:
+                default_generator._trace_key = saved_tk
+                default_generator._counter = saved_ctr
             out_leaves, out_td = jax.tree.flatten(out, is_leaf=lambda x: isinstance(x, Tensor))
             # Non-array leaves (aux python values: strs, ints, None-likes)
             # bypass the compiled program and are reattached at unflatten time,
@@ -276,11 +292,30 @@ class StaticFunction:
             leaves[i] if isinstance(leaves[i], Tensor) else Tensor(jnp.asarray(leaves[i]))
             for i in prog.tensor_pos
         ]
+        from ..framework.random import rng_arg
+
         try:
-            outs = apply_op("jit_program", prog.fn, param_args, *tensor_args)
+            outs = apply_op("jit_program", prog.fn, rng_arg(), param_args, *tensor_args)
         except Exception as e:
             if getattr(prog, "_ran_ok", False):
                 raise  # post-compile runtime failure: a real error, surface it
+            if isinstance(e, jax.errors.JaxRuntimeError):
+                # Backend failure on the FIRST run — could be a transient
+                # execution OOM (retryable) or a deterministic XLA/Mosaic
+                # compile rejection (both surface as JaxRuntimeError). Run
+                # eager NOW (SOT "always runs" guarantee) but only pin these
+                # inputs to eager permanently after repeated failures, so a
+                # transient OOM doesn't disable compilation forever.
+                import warnings
+
+                prog._rt_failures = getattr(prog, "_rt_failures", 0) + 1
+                if prog._rt_failures >= 3:
+                    self._fallback_keys.add(key)
+                warnings.warn(
+                    f"to_static: running '{self.__name__}' compiled failed "
+                    f"({type(e).__name__}: {e}); falling back to eager for "
+                    "this call", stacklevel=2)
+                return self._run_eager(*args, **kwargs)
             # graph break: tracing/compiling this program failed — run eager
             # (reference SOT guarantee: "always runs, worst case eager",
             # sot/translate.py:31). A genuine user bug re-raises from the

@@ -22,7 +22,12 @@ from ...tensor.tensor import Tensor
 
 class UndefinedVar:
     """Placeholder for a name unbound before a converted branch (parity:
-    dy2static UndefinedVar)."""
+    dy2static UndefinedVar).
+
+    Any actual *use* raises NameError, preserving eager semantics: a name
+    that stays unassigned on the taken branch of a converted if/while would
+    raise UnboundLocalError in plain python, so the placeholder must not
+    silently flow into arithmetic or calls."""
 
     __slots__ = ("name",)
 
@@ -31,6 +36,27 @@ class UndefinedVar:
 
     def __repr__(self):
         return f"UndefinedVar({self.name})"
+
+    def _use(self, *_a, **_k):
+        raise NameError(
+            f"local variable '{self.name}' referenced before assignment — "
+            "it was not assigned on the taken branch of a converted "
+            "if/while (eager would raise UnboundLocalError)")
+
+    __bool__ = __call__ = __iter__ = __len__ = __getitem__ = _use
+    __int__ = __float__ = __index__ = __neg__ = __pos__ = __abs__ = _use
+    __add__ = __radd__ = __sub__ = __rsub__ = __mul__ = __rmul__ = _use
+    __truediv__ = __rtruediv__ = __floordiv__ = __rfloordiv__ = _use
+    __mod__ = __rmod__ = __pow__ = __rpow__ = __matmul__ = __rmatmul__ = _use
+    __lt__ = __le__ = __gt__ = __ge__ = __eq__ = __ne__ = _use
+    __hash__ = object.__hash__  # keep hashable despite __eq__ override
+
+    def __getattr__(self, attr):
+        if attr.startswith("__") and attr.endswith("__"):
+            # library probes (hasattr/getattr-with-default/deepcopy) expect
+            # AttributeError for missing dunders, not a use-error
+            raise AttributeError(attr)
+        self._use()
 
 
 _UNDEF = UndefinedVar

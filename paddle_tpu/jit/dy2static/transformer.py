@@ -229,6 +229,12 @@ def _lambda(expr):
 def _convert_code(code, filename, fname):
     tree = ast.parse(code)
     fn_def = tree.body[0]
+    if not isinstance(fn_def, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        # Lambda / assignment sources (``f = to_static(lambda ...)``): a
+        # lambda body cannot contain statements, so there is no control flow
+        # to convert. Signal "nothing to do" — convert_to_static catches
+        # TypeError and uses the original function.
+        raise TypeError(f"source of {fname!r} is not a function definition")
     fn_def.decorator_list = []  # strip @to_static etc.
     # local names: params + every stored name in the body
     params = {a.arg for a in (fn_def.args.posonlyargs + fn_def.args.args

@@ -29,24 +29,63 @@ def _pool_pad(padding, n):
     return [tuple(x) for x in padding]
 
 
-def _reduce_pool(x, kernel, stride, pad, n, channel_last, init, op, name):
+def _ceil_out_extra(L, k, s, p0, p1, ceil_mode):
+    """(output length, extra right padding) for one spatial dim.
+
+    ceil_mode uses ceil instead of floor division (reference
+    pooling.py _update_padding semantics / phi pooling infermeta), with the
+    constraint that the last window must start inside input + left padding.
+    """
+    span = L + p0 + p1 - k
+    if not ceil_mode:
+        return span // s + 1, 0
+    out = -(-span // s) + 1
+    if (out - 1) * s >= L + p0:
+        out -= 1
+    return out, max(0, (out - 1) * s + k - (L + p0 + p1))
+
+
+def _ceil_spatial(padding, v, n, kernel, stride, channel_last):
+    """Per-dim (left, right+extra) pad pairs implementing ceil_mode."""
+    S = v.shape[1:1 + n] if channel_last else v.shape[2:2 + n]
+    return [
+        (p0, p1 + _ceil_out_extra(S[d], kernel[d], stride[d], p0, p1, True)[1])
+        for d, (p0, p1) in enumerate(padding)]
+
+
+def _window_config(v, kernel, stride, padding, n, channel_last, ceil_mode):
+    """(dims, strides, pads) for lax.reduce_window — shared by the max and
+    avg paths so padding semantics cannot diverge between them."""
+    if isinstance(padding, str):
+        if ceil_mode and padding == "VALID":
+            raise ValueError(
+                'When padding is "VALID", ceil_mode must be False '
+                "(reference: _update_padding_nd)")
+        spatial = padding
+    elif ceil_mode:
+        spatial = _ceil_spatial(padding, v, n, kernel, stride, channel_last)
+    else:
+        spatial = padding
+    if channel_last:
+        dims = (1,) + kernel + (1,)
+        strides = (1,) + stride + (1,)
+        pads = spatial if isinstance(spatial, str) else [(0, 0)] + spatial + [(0, 0)]
+    else:
+        dims = (1, 1) + kernel
+        strides = (1, 1) + stride
+        pads = spatial if isinstance(spatial, str) else [(0, 0), (0, 0)] + spatial
+    return dims, strides, pads
+
+
+def _reduce_pool(x, kernel, stride, pad, n, channel_last, init, op, name,
+                 ceil_mode=False):
     kernel = _tuple(kernel, n)
     stride = _tuple(stride, n) if stride is not None else kernel
     padding = _pool_pad(pad, n)
 
     def fn(v):
-        if channel_last:
-            dims = (1,) + kernel + (1,)
-            strides = (1,) + stride + (1,)
-        else:
-            dims = (1, 1) + kernel
-            strides = (1, 1) + stride
-        if isinstance(padding, str):
-            pads = padding
-        elif channel_last:
-            pads = [(0, 0)] + padding + [(0, 0)]
-        else:
-            pads = [(0, 0), (0, 0)] + padding
+        dims, strides, pads = _window_config(
+            v, kernel, stride, padding, n, channel_last, ceil_mode)
         # init must stay a host literal: a traced jnp constant prevents jax
         # from recognizing the max/add monoid, killing reverse-mode under jit
         return jax.lax.reduce_window(v, np.asarray(init, v.dtype), op, dims, strides, pads)
@@ -57,50 +96,46 @@ def _reduce_pool(x, kernel, stride, pad, n, channel_last, init, op, name):
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, name=None):
     if return_mask:
         return _maxpool_nd_with_mask(x, kernel_size, stride, padding, 1,
-                                     False, "max_pool1d")
-    return _reduce_pool(x, kernel_size, stride, padding, 1, False, -np.inf, jax.lax.max, "max_pool1d")
+                                     False, "max_pool1d", ceil_mode)
+    return _reduce_pool(x, kernel_size, stride, padding, 1, False, -np.inf, jax.lax.max, "max_pool1d", ceil_mode)
 
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, return_mask=False, data_format="NCHW", name=None):
     if return_mask:
         return _maxpool_nd_with_mask(x, kernel_size, stride, padding, 2,
-                                     data_format == "NHWC", "max_pool2d")
-    return _reduce_pool(x, kernel_size, stride, padding, 2, data_format == "NHWC", -np.inf, jax.lax.max, "max_pool2d")
+                                     data_format == "NHWC", "max_pool2d",
+                                     ceil_mode)
+    return _reduce_pool(x, kernel_size, stride, padding, 2, data_format == "NHWC", -np.inf, jax.lax.max, "max_pool2d", ceil_mode)
 
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False, return_mask=False, data_format="NCDHW", name=None):
     if return_mask:
         return _maxpool_nd_with_mask(x, kernel_size, stride, padding, 3,
-                                     data_format == "NDHWC", "max_pool3d")
-    return _reduce_pool(x, kernel_size, stride, padding, 3, data_format == "NDHWC", -np.inf, jax.lax.max, "max_pool3d")
+                                     data_format == "NDHWC", "max_pool3d",
+                                     ceil_mode)
+    return _reduce_pool(x, kernel_size, stride, padding, 3, data_format == "NDHWC", -np.inf, jax.lax.max, "max_pool3d", ceil_mode)
 
 
 def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True, ceil_mode=False, name=None):
-    return _avg_pool(x, kernel_size, stride, padding, 1, False, exclusive, "avg_pool1d")
+    return _avg_pool(x, kernel_size, stride, padding, 1, False, exclusive, "avg_pool1d", ceil_mode=ceil_mode)
 
 
 def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True, divisor_override=None, data_format="NCHW", name=None):
-    return _avg_pool(x, kernel_size, stride, padding, 2, data_format == "NHWC", exclusive, "avg_pool2d", divisor_override)
+    return _avg_pool(x, kernel_size, stride, padding, 2, data_format == "NHWC", exclusive, "avg_pool2d", divisor_override, ceil_mode=ceil_mode)
 
 
 def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True, divisor_override=None, data_format="NCDHW", name=None):
-    return _avg_pool(x, kernel_size, stride, padding, 3, data_format == "NDHWC", exclusive, "avg_pool3d", divisor_override)
+    return _avg_pool(x, kernel_size, stride, padding, 3, data_format == "NDHWC", exclusive, "avg_pool3d", divisor_override, ceil_mode=ceil_mode)
 
 
-def _avg_pool(x, kernel, stride, pad, n, channel_last, exclusive, name, divisor_override=None):
+def _avg_pool(x, kernel, stride, pad, n, channel_last, exclusive, name, divisor_override=None, ceil_mode=False):
     kernel = _tuple(kernel, n)
     stride = _tuple(stride, n) if stride is not None else kernel
     padding = _pool_pad(pad, n)
 
     def fn(v):
-        if channel_last:
-            dims = (1,) + kernel + (1,)
-            strides = (1,) + stride + (1,)
-            pads = padding if isinstance(padding, str) else [(0, 0)] + padding + [(0, 0)]
-        else:
-            dims = (1, 1) + kernel
-            strides = (1, 1) + stride
-            pads = padding if isinstance(padding, str) else [(0, 0), (0, 0)] + padding
+        dims, strides, pads = _window_config(
+            v, kernel, stride, padding, n, channel_last, ceil_mode)
         summed = jax.lax.reduce_window(v, jnp.asarray(0, v.dtype), jax.lax.add, dims, strides, pads)
         if divisor_override:
             return summed / divisor_override
@@ -259,7 +294,7 @@ def _max_pool_with_mask(v, starts_list, kernel, ends_list=None):
 
 
 def _maxpool_nd_with_mask(x, kernel_size, stride, padding, n, channel_last,
-                          name):
+                          name, ceil_mode=False):
     kernel = _tuple(kernel_size, n)
     stride_t = _tuple(stride, n) if stride is not None else kernel
     padding_pairs = _pool_pad(padding, n)
@@ -275,8 +310,8 @@ def _maxpool_nd_with_mask(x, kernel_size, stride, padding, n, channel_last,
         starts_list = []
         for d in range(n):
             p0 = padding_pairs[d][0]
-            out_d = (S[d] + padding_pairs[d][0] + padding_pairs[d][1]
-                     - kernel[d]) // stride_t[d] + 1
+            out_d, _ = _ceil_out_extra(S[d], kernel[d], stride_t[d],
+                                       p0, padding_pairs[d][1], ceil_mode)
             starts_list.append(jnp.arange(out_d) * stride_t[d] - p0)
         pooled, mask = _max_pool_with_mask(v, starts_list, kernel)
         if channel_last:

@@ -82,7 +82,7 @@ def _dotf32(a, b, dims):
                                precision=_MXU)
 
 
-def _fwd_kernel(*refs, scale, causal, bq, bk, hq, has_mask, has_lens):
+def _fwd_kernel(*refs, scale, causal, bq, bk, hq, has_mask, has_lens, off):
     idx = 0
     if has_lens:
         lens_ref = refs[0]  # SMEM [2, b] int32: (qlens; kvlens)
@@ -103,6 +103,12 @@ def _fwd_kernel(*refs, scale, causal, bq, bk, hq, has_mask, has_lens):
         bi = pl.program_id(0) // hq
         qlen = lens_ref[0, bi]
         kvlen = lens_ref[1, bi]
+        # Bottom-right causal alignment (FA2 semantics): the LAST query row
+        # lines up with the LAST valid key, so row r attends cols
+        # <= r + (kvlen - qlen). Per-sequence under varlen.
+        coff = kvlen - qlen
+    else:
+        coff = off  # static: seq_k - seq_q (0 for self-attention)
 
     def body(j, carry):
         m, l, acc = carry
@@ -111,7 +117,7 @@ def _fwd_kernel(*refs, scale, causal, bq, bk, hq, has_mask, has_lens):
         s = _dotf32(q, k, (((1,), (1,)))) * scale  # [bq, bk] f32
         col_ids = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
         if causal:
-            s = jnp.where(row_ids >= col_ids, s, NEG_INF)
+            s = jnp.where(row_ids + coff >= col_ids, s, NEG_INF)
         if has_lens:
             s = jnp.where(col_ids < kvlen, s, NEG_INF)
         if has_mask:
@@ -129,8 +135,8 @@ def _fwd_kernel(*refs, scale, causal, bq, bk, hq, has_mask, has_lens):
     # int32 loop bounds: the framework runs with jax_enable_x64, and int64
     # scalars are not lowerable inside Mosaic kernels.
     if causal:
-        upper = jnp.minimum(
-            num_k, ((i + 1) * bq + bk - 1) // bk).astype(jnp.int32)
+        upper = jnp.clip(
+            ((i + 1) * bq + coff + bk - 1) // bk, 0, num_k).astype(jnp.int32)
     else:
         upper = jnp.int32(num_k)
     if has_lens:
@@ -140,9 +146,14 @@ def _fwd_kernel(*refs, scale, causal, bq, bk, hq, has_mask, has_lens):
     l0 = jnp.zeros((bq, 1), jnp.float32)
     acc0 = jnp.zeros((bq, d), jnp.float32)
     m, l, acc = jax.lax.fori_loop(jnp.int32(0), upper, body, (m0, l0, acc0))
-    l_safe = jnp.where(l == 0.0, 1.0, l)
-    out = acc / l_safe
-    lse = jnp.where(l[:, 0] == 0.0, LSE_INVALID, (m + jnp.log(l))[:, 0])
+    # Rows whose running max never left NEG_INF saw no valid key (fully
+    # causal-masked, e.g. rows before the bottom-right diagonal when
+    # qlen > kvlen): their p was exp(NEG_INF - NEG_INF) = 1 garbage — zero
+    # them, matching rows the loop never visited (l == 0).
+    invalid = (m <= NEG_INF * 0.5) | (l == 0.0)
+    l_safe = jnp.where(invalid, 1.0, l)
+    out = jnp.where(invalid, 0.0, acc / l_safe)
+    lse = jnp.where(invalid[:, 0], LSE_INVALID, (m + jnp.log(l_safe))[:, 0])
     if has_lens:
         rows = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
         out = jnp.where(rows < qlen, out, 0.0)
@@ -209,7 +220,7 @@ def _flash_fwd_impl(q, k, v, mask, lens, scale, causal, hq):
     ]
     kern = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, bq=bq, bk=bk, hq=hq,
-        has_mask=has_mask, has_lens=has_lens)
+        has_mask=has_mask, has_lens=has_lens, off=k.shape[1] - seq)
     # Trace kernels in 32-bit mode: the framework enables jax_enable_x64 and
     # int64 scalars are unlowerable in Mosaic.
     with jax.enable_x64(False):
@@ -229,7 +240,8 @@ def _flash_fwd_impl(q, k, v, mask, lens, scale, causal, hq):
     return out, lse
 
 
-def _bwd_fused_kernel(*refs, scale, causal, bq, bkb, hq, has_mask, has_lens):
+def _bwd_fused_kernel(*refs, scale, causal, bq, bkb, hq, has_mask, has_lens,
+                      off):
     """One kernel for dq/dk/dv. Grid (bh, k-block); dq's block is the FULL
     [seq, d] fp32 accumulator, whose index map ignores the k-block dim, so
     Mosaic keeps it VMEM-resident across the inner grid steps and each step
@@ -254,7 +266,11 @@ def _bwd_fused_kernel(*refs, scale, causal, bq, bkb, hq, has_mask, has_lens):
     col_ids = j * bkb + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
     if has_lens:
         bi = pl.program_id(0) // hq
+        qlen = lens_ref[0, bi]
         kvlen = lens_ref[1, bi]
+        coff = kvlen - qlen  # bottom-right causal alignment (match fwd)
+    else:
+        coff = off
 
     @pl.when(j == 0)
     def _init():
@@ -271,7 +287,7 @@ def _bwd_fused_kernel(*refs, scale, causal, bq, bkb, hq, has_mask, has_lens):
             row_ids = i * bq + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, bk), 0
             )
-            s = jnp.where(row_ids >= col_ids, s, NEG_INF)
+            s = jnp.where(row_ids + coff >= col_ids, s, NEG_INF)
         if has_lens:
             s = jnp.where(col_ids < kvlen, s, NEG_INF)
         if has_mask:
@@ -292,7 +308,8 @@ def _bwd_fused_kernel(*refs, scale, causal, bq, bkb, hq, has_mask, has_lens):
         return dk, dv
 
     if causal:
-        lower = ((j * bkb) // bq).astype(jnp.int32)
+        # first q row attending this k block: row >= col - coff
+        lower = (jnp.maximum(j * bkb - coff, 0) // bq).astype(jnp.int32)
     else:
         lower = jnp.int32(0)
     z = jnp.zeros((bk, d), jnp.float32)
@@ -349,7 +366,7 @@ def flash_bwd_impl(q, k, v, g, lse, delta, scale, causal,
     ]
     kern = functools.partial(
         _bwd_fused_kernel, scale=scale, causal=causal, bq=bq, bkb=bkb,
-        hq=hq, has_mask=has_mask, has_lens=has_lens)
+        hq=hq, has_mask=has_mask, has_lens=has_lens, off=seq_k - seq)
     with jax.enable_x64(False):
         if has_lens:
             grid_spec = pltpu.PrefetchScalarGridSpec(

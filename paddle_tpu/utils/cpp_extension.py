@@ -102,6 +102,11 @@ class CppExtensionLibrary:
         reference's InferShapeFn/InferDtypeFn registration contract.
         """
         from ..autograd.engine import apply_op
+        from ..framework.op_registry import register_op
+
+        # Custom-op names are user-defined at load time — register the row
+        # here (the creation site) so the strict dispatch gate stays sound.
+        register_op(op_name, notes="custom C++ op (utils.cpp_extension)")
 
         fwd_symbol = f"{op_name}_forward"
         bwd_symbol = f"{op_name}_backward"

@@ -126,8 +126,20 @@ def naive_attention_full(q, k, v, causal=False, mask=None, q_lens=None,
     s = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) / jnp.sqrt(d)
     sk = s.shape[-1]
     if causal:
-        cm = jnp.tril(jnp.ones((sq, sk), bool))
-        s = jnp.where(cm, s, -1e30)
+        # Bottom-right alignment (FA2 semantics, matching the reference's
+        # libflashattn): row r attends cols <= r + (kvlen - qlen).
+        rows = jnp.arange(sq)[None, :, None]
+        cols = jnp.arange(sk)[None, None, :]
+        if q_lens is not None or kv_lens is not None:
+            ql = (q_lens if q_lens is not None
+                  else jnp.full((b,), sq, jnp.int32))
+            kl = (kv_lens if kv_lens is not None
+                  else jnp.full((b,), sk, jnp.int32))
+            off = (kl - ql)[:, None, None]
+        else:
+            off = sk - sq
+        cm = rows + off >= cols
+        s = jnp.where(cm[:, None, :, :], s, -1e30)
     if kv_lens is not None:
         km = jnp.arange(sk)[None, :] < kv_lens[:, None]
         s = jnp.where(km[:, None, None, :], s, -1e30)
@@ -135,9 +147,10 @@ def naive_attention_full(q, k, v, causal=False, mask=None, q_lens=None,
         s = s + mask.astype(jnp.float32)
     p = jax.nn.softmax(s, axis=-1)
     o = jnp.einsum("bhqk,bhkd->bhqd", p, vt)
-    if kv_lens is not None:
-        any_k = (kv_lens > 0)[:, None, None, None]
-        o = jnp.where(any_k, o, 0.0)
+    # rows with no attendable key (kvlen==0, or causal rows before the
+    # bottom-right diagonal) produce zeros, matching the kernel's l==0 path
+    fully_masked = jnp.max(s, axis=-1, keepdims=True) <= -1e29
+    o = jnp.where(fully_masked, 0.0, o)
     if q_lens is not None:
         qm = jnp.arange(sq)[None, :] < q_lens[:, None]
         o = jnp.where(qm[:, None, :, None], o, 0.0)
@@ -271,3 +284,29 @@ def test_incompatible_mask_shape_raises(rng):
     bad = jnp.zeros((b, h, s, 1), jnp.float32)  # singleton sk unsupported
     with pytest.raises(ValueError, match="mask shape"):
         flash_attention(q, q, q, mask=bad)
+
+
+def test_causal_bottom_right_unequal_seqlens(rng):
+    """Dense causal with seq_q != seq_k is bottom-right aligned (FA2
+    semantics — the reference's libflashattn aligns the LAST query with the
+    LAST key when lengths differ), fwd and bwd."""
+    b, h, d = 2, 2, 64
+    sq, sk = 64, 128
+    q = jnp.asarray(rng.randn(b, sq, h, d), jnp.float32)
+    k = jnp.asarray(rng.randn(b, sk, h, d), jnp.float32)
+    v = jnp.asarray(rng.randn(b, sk, h, d), jnp.float32)
+    out = flash_attention(q, k, v, causal=True)
+    ref = naive_attention_full(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+    g = jnp.asarray(rng.randn(b, sq, h, d), jnp.float32)
+
+    def take(f):
+        return jax.grad(lambda q_, k_, v_: jnp.sum(f(q_, k_, v_) * g),
+                        argnums=(0, 1, 2))(q, k, v)
+
+    gf = take(lambda *a: flash_attention(*a, causal=True))
+    gn = take(lambda *a: naive_attention_full(*a, causal=True))
+    for a, b_ in zip(gf, gn):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=5e-3, atol=5e-3)

@@ -165,3 +165,22 @@ class TestSaveLoad:
         loss.backward()
         grads = [p.grad for p in loaded.parameters()]
         assert all(g is not None for g in grads)
+
+    def test_dropout_rerandomizes_per_call(self):
+        """A @to_static program must NOT bake PRNG keys as compile-time
+        constants: two calls draw different dropout masks (reference
+        dy2static/SOT re-draws per run from the DeviceContext generator)."""
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(8, 8), nn.Dropout(0.5))
+        net.train()
+        jf = paddle.jit.to_static(net)
+        x = paddle.ones([16, 8])
+        a = jf(x).numpy()
+        b = jf(x).numpy()
+        assert not np.array_equal(a, b), "identical dropout masks across calls"
+        # seed reset reproduces the sequence (paddle.seed contract)
+        paddle.seed(0)
+        net2 = nn.Sequential(nn.Linear(8, 8), nn.Dropout(0.5))
+        net2.train()
+        jf2 = paddle.jit.to_static(net2)
+        np.testing.assert_allclose(jf2(x).numpy(), a)

@@ -289,3 +289,29 @@ def test_amp_compare_accuracy_missing_and_scale(tmp_path):
     with pytest.raises(NotImplementedError):
         D.compare_accuracy(str(a), str(b), str(tmp_path / "r2.csv"),
                            dump_all_tensors=True)
+
+
+@pytest.mark.parametrize("ceil", [False, True])
+def test_pool2d_ceil_mode_matches_torch(ceil, rng):
+    """ceil_mode output sizing and values vs the torch oracle, incl. the
+    return_mask path and exclusive avg counting (reference: pool ceil_mode
+    in phi pooling infermeta / test_pool2d_op.py)."""
+    torch = pytest.importorskip("torch")
+    x = rng.randn(2, 3, 17, 23).astype("float32")
+    for k, s, p in [(3, 2, 1), (2, 2, 0), (3, 3, 1)]:
+        ref = torch.nn.functional.max_pool2d(
+            torch.tensor(x), k, s, p, ceil_mode=ceil).numpy()
+        out = F.max_pool2d(paddle.to_tensor(x), k, s, p,
+                           ceil_mode=ceil).numpy()
+        assert out.shape == ref.shape
+        np.testing.assert_allclose(out, ref, rtol=1e-6)
+        o2, _mask = F.max_pool2d(paddle.to_tensor(x), k, s, p,
+                                 ceil_mode=ceil, return_mask=True)
+        np.testing.assert_allclose(o2.numpy(), ref, rtol=1e-6)
+        ref = torch.nn.functional.avg_pool2d(
+            torch.tensor(x), k, s, p, ceil_mode=ceil,
+            count_include_pad=False).numpy()
+        out = F.avg_pool2d(paddle.to_tensor(x), k, s, p, ceil_mode=ceil,
+                           exclusive=True).numpy()
+        assert out.shape == ref.shape
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
