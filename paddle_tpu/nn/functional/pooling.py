@@ -432,3 +432,38 @@ def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
                           return_mask=False, name=None):
     return _fractional_pool(x, output_size, kernel_size, random_u,
                             return_mask, 3, "fractional_max_pool3d")
+
+
+def _lp_pool(x, norm_type, kernel, stride, pad, n, channel_last, ceil_mode,
+             name):
+    """LP pooling: (sum |x|^p over window)^(1/p); p=inf -> max pool
+    (reference: nn/functional/pooling.py lp_pool1d/2d)."""
+    p = float(norm_type)
+    kernel = _tuple(kernel, n)
+    stride = _tuple(stride, n) if stride is not None else kernel
+    padding = _pool_pad(pad, n)
+    if np.isinf(p):
+        return _reduce_pool(x, kernel, stride, pad, n, channel_last,
+                            -np.inf, jax.lax.max, name, ceil_mode)
+
+    def fn(v):
+        dims, strides, pads = _window_config(
+            v, kernel, stride, padding, n, channel_last, ceil_mode)
+        powed = jnp.abs(v) ** p
+        s = jax.lax.reduce_window(
+            powed, np.asarray(0, v.dtype), jax.lax.add, dims, strides, pads)
+        return s ** (1.0 / p)
+
+    return apply_op(name, fn, x)
+
+
+def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCL", name=None):
+    return _lp_pool(x, norm_type, kernel_size, stride, padding, 1,
+                    data_format == "NLC", ceil_mode, "lp_pool1d")
+
+
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCHW", name=None):
+    return _lp_pool(x, norm_type, kernel_size, stride, padding, 2,
+                    data_format == "NHWC", ceil_mode, "lp_pool2d")

@@ -31,3 +31,5 @@ AdaptiveAvgPool3D = _make("AdaptiveAvgPool3D", "adaptive_avg_pool3d", ["output_s
 AdaptiveMaxPool1D = _make("AdaptiveMaxPool1D", "adaptive_max_pool1d", ["output_size", "return_mask"])
 AdaptiveMaxPool2D = _make("AdaptiveMaxPool2D", "adaptive_max_pool2d", ["output_size", "return_mask"])
 AdaptiveMaxPool3D = _make("AdaptiveMaxPool3D", "adaptive_max_pool3d", ["output_size", "return_mask"])
+LPPool1D = _make("LPPool1D", "lp_pool1d", ["norm_type", "kernel_size", "stride", "padding", "ceil_mode", "data_format"])
+LPPool2D = _make("LPPool2D", "lp_pool2d", ["norm_type", "kernel_size", "stride", "padding", "ceil_mode", "data_format"])
