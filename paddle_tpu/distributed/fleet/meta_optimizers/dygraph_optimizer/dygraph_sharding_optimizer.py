@@ -35,16 +35,21 @@ def _sharding_mesh(hcg=None, group=None):
     return g.to_jax_mesh(), g.axis_name
 
 
-def _shard_leading(arr, mesh, axis_name):
+def _shard_leading(arr, mesh, axis_name, memory_kind=None):
     """Place an array sharded on dim 0 over the axis if divisible, else
     replicated (small params stay replicated — the reference assigns whole
-    params to ranks; leading-dim sharding is the XLA-friendly equivalent)."""
+    params to ranks; leading-dim sharding is the XLA-friendly equivalent).
+
+    ``memory_kind="pinned_host"`` additionally offloads the storage to host
+    memory (the reference's ZeRO CPU-offload, group_sharded_stage3.py
+    offload=True); XLA streams it to device on use."""
     n = mesh.shape[axis_name]
     if arr.ndim >= 1 and arr.shape[0] % n == 0 and arr.shape[0] > 0:
         spec = P(axis_name, *([None] * (arr.ndim - 1)))
     else:
         spec = P()
-    return jax.device_put(arr, NamedSharding(mesh, spec))
+    return jax.device_put(
+        arr, NamedSharding(mesh, spec, memory_kind=memory_kind))
 
 
 class DygraphShardingOptimizer:
@@ -54,19 +59,23 @@ class DygraphShardingOptimizer:
     tensor-fusion options are accepted and ignored — XLA owns fusion/overlap.
     """
 
-    def __init__(self, optimizer, hcg=None, group=None, **kwargs):
+    def __init__(self, optimizer, hcg=None, group=None, offload=False, **kwargs):
         self._inner_opt = optimizer
         self._mesh, self._axis = _sharding_mesh(hcg, group)
+        # offload: optimizer states live in host memory (reference ZeRO
+        # CPU-offload); XLA streams shards to device inside the update
+        self._memory_kind = "pinned_host" if offload else None
         self._install_state_placement(optimizer)
         self._param_shardings = {}
 
     def _install_state_placement(self, optimizer):
         orig_create = optimizer._create_accumulators
-        mesh, axis = self._mesh, self._axis
+        mesh, axis, mk = self._mesh, self._axis, self._memory_kind
 
         def create(p):
             state = orig_create(p)
-            return {k: _shard_leading(v, mesh, axis) for k, v in state.items()}
+            return {k: _shard_leading(v, mesh, axis, mk)
+                    for k, v in state.items()}
 
         optimizer._create_accumulators = create
         # master weights are optimizer state too (ZeRO shards them)
@@ -76,7 +85,7 @@ class DygraphShardingOptimizer:
             st = orig_ensure(p)
             mw = optimizer._master_weights.get(id(p))
             if mw is not None and not _is_placed(mw, axis):
-                optimizer._master_weights[id(p)] = _shard_leading(mw, mesh, axis)
+                optimizer._master_weights[id(p)] = _shard_leading(mw, mesh, axis, mk)
             return st
 
         optimizer._ensure_state = ensure
@@ -94,10 +103,34 @@ class DygraphShardingOptimizer:
     def _pre_step(self):
         pass
 
+    def _move_states(self, memory_kind):
+        """Retarget every optimizer state array (accumulators + master
+        weights) to ``memory_kind`` (None = device). The offload round-trip:
+        host -> device before the update, back after — the reference's
+        offload=True does the same cpu<->gpu copy per step
+        (group_sharded_utils.py cpu offload)."""
+        opt = self._inner_opt
+
+        def move(a):
+            kind = memory_kind or "device"
+            return jax.device_put(a, a.sharding.with_memory_kind(kind))
+
+        for state in opt._accumulators.values():
+            for k in state:
+                state[k] = move(state[k])
+        for pid in list(opt._master_weights):
+            opt._master_weights[pid] = move(opt._master_weights[pid])
+
     def step(self):
         self._snapshot_param_placements()
         self._pre_step()
+        if self._memory_kind is not None:
+            for p in self._inner_opt._parameter_list:
+                self._inner_opt._ensure_state(p)  # create before staging
+            self._move_states(None)  # stage host states onto device
         self._inner_opt.step()
+        if self._memory_kind is not None:
+            self._move_states(self._memory_kind)  # evict back to host
         # params keep their logical placement (reference: post-step broadcast
         # of updated params back to all ranks)
         self._restore_param_placements()

@@ -100,6 +100,33 @@ def stack_stage_params(param_trees):
     return jax.tree.map(lambda *leaves: jnp.stack(leaves, axis=0), *param_trees)
 
 
+def stack_chunked_tensors(per_name_lists, num_stages: int, num_virtual: int,
+                          per_chunk: int):
+    """Framework-Tensor stacking for ``PipelineParallel.compiled_forward``.
+
+    Each per-name layer list (length S*V*per_chunk, layer order) becomes one
+    [S*V, per_chunk, ...] Tensor: layers grouped into chunks of
+    ``per_chunk``, chunks placed circularly for VPP (stacked index d*V + r
+    holds global chunk r*S + d — :func:`interleave_stage_params` order).
+    Stacking goes THROUGH the tape (``paddle.stack``) so gradients flow back
+    to each stage layer's own Parameter."""
+    import paddle_tpu as paddle
+
+    out = []
+    vs = num_stages * num_virtual
+    for ts in per_name_lists:
+        chunks = [paddle.stack(ts[c * per_chunk:(c + 1) * per_chunk], axis=0)
+                  for c in range(vs)]
+        if num_virtual > 1:
+            reordered = [None] * vs
+            for d in range(num_stages):
+                for r in range(num_virtual):
+                    reordered[d * num_virtual + r] = chunks[r * num_stages + d]
+            chunks = reordered
+        out.append(paddle.stack(chunks, axis=0))
+    return out
+
+
 def shard_stacked_params(stacked, mesh, pp_axis: str = "pp"):
     """Place stacked params so stage s's slice lives on pp rank s."""
     def place(a):

@@ -130,6 +130,84 @@ class PipelineParallel(Layer):
         v = getattr(self, "_virtual_pp_degree", 1)
         return bubble_fraction(self.num_stages, self.accumulate_steps, v)
 
+    # --- compiled (GSPMD) schedule over heterogeneous stages -------------
+    def compiled_forward(self, x, mesh=None, num_micro=None, num_virtual=None):
+        """Run the PipelineLayer through the compiled stacked-stage scan.
+
+        Heterogeneous stages supported the GSPMD way (reference case:
+        SharedLayerDesc-tied embedding/head, pp_layers.py:56-237): the
+        maximal homogeneous middle run (the transformer blocks) becomes the
+        stacked ``pipeline_spmd`` scan over the pp mesh axis; the pre-
+        (embedding) and post- (final norm / tied head) segments execute on
+        the tape around it, so tied weights are literally the same Parameter
+        and their gradients accumulate without an explicit allreduce
+        (reference allreduce_shared_weight_gradients).
+
+        ``num_virtual > 1`` selects the circular (VPP) schedule
+        (``pipeline_spmd_interleaved``), which genuinely changes the
+        compiled schedule — bubble (S-1)/(VM+S-1) vs (S-1)/(M+S-1).
+        """
+        from .gspmd_pipeline import (
+            pipeline_spmd,
+            pipeline_spmd_interleaved,
+            stack_chunked_tensors,
+        )
+
+        if mesh is None:
+            mesh = getattr(self._hcg, "jax_mesh", None)
+        if mesh is None:
+            raise ValueError("compiled_forward needs a jax Mesh with a 'pp' axis")
+        num_micro = num_micro or self.accumulate_steps
+        num_virtual = (num_virtual
+                       if num_virtual is not None
+                       else getattr(self, "_virtual_pp_degree", 1))
+
+        pre, mid, post = self._layers.split_segments()
+        S = mesh.shape["pp"]
+        if not mid:
+            raise ValueError(
+                "no homogeneous middle segment found; the compiled pipeline "
+                "needs >= 2 repeated blocks (identical parameter shapes)")
+        if len(mid) % (S * num_virtual):
+            raise ValueError(
+                f"{len(mid)} homogeneous middle layers not divisible by "
+                f"pp ({S}) x virtual ({num_virtual})")
+        per_chunk = len(mid) // (S * num_virtual)
+
+        for fn in pre:
+            x = fn(*x) if isinstance(x, tuple) else fn(x)
+
+        from ....jit.api import _named_state, functional_call
+        import paddle_tpu as paddle
+
+        template = mid[0]
+        names = sorted(_named_state(template))
+        stacked = stack_chunked_tensors(
+            [[_named_state(l)[n] for l in mid] for n in names],
+            S, num_virtual, per_chunk)
+
+        def stage_fn(p_one, xa):
+            # p_one leaves are [per_chunk, ...]: apply the chunk's layers
+            out = paddle.Tensor(xa)
+            for j in range(per_chunk):
+                state = {n: p[j] for n, p in zip(names, p_one)}
+                out = functional_call(template, state, out)
+            return out._data if hasattr(out, "_data") else out
+
+        b = x.shape[0]
+        if b % num_micro:
+            raise ValueError(f"batch {b} not divisible by num_micro {num_micro}")
+        mbs = x.reshape([num_micro, b // num_micro, *x.shape[1:]])
+        if num_virtual > 1:
+            y = pipeline_spmd_interleaved(
+                stage_fn, stacked, mbs, mesh, num_virtual)
+        else:
+            y = pipeline_spmd(stage_fn, stacked, mbs, mesh)
+        y = y.reshape([b, *y.shape[2:]])
+        for fn in post:
+            y = fn(*y) if isinstance(y, tuple) else fn(y)
+        return y
+
     def _compute_loss(self, output, label):
         loss_fn = self._layers._loss_fn
         if loss_fn is not None:

@@ -182,6 +182,43 @@ class PipelineLayer(Layer):
     def get_num_stages(self):
         return self._num_stages
 
+    def split_segments(self):
+        """(pre, mid, post): the maximal contiguous homogeneous middle run
+        (identical parameter shape signature — the transformer blocks) plus
+        the heterogeneous prefix (embedding) and suffix (norm / tied head).
+
+        This is how heterogeneous reference models (distinct
+        embedding/head stages, SharedLayerDesc) map onto the compiled
+        stacked-stage scan: pre/post run on the tape around it."""
+        from collections import Counter
+
+        from ....jit.api import _named_state
+
+        def sig(l):
+            if not isinstance(l, Layer):
+                return None
+            st = _named_state(l)
+            if not st:
+                return None
+            return tuple(sorted(
+                (n, tuple(t.shape), str(t.dtype)) for n, t in st.items()))
+
+        sigs = [sig(l) for l in self.run_function]
+        counts = Counter(s for s in sigs if s is not None)
+        if not counts:
+            return list(self.run_function), [], []
+        mid_sig, n = counts.most_common(1)[0]
+        if n < 2:
+            return list(self.run_function), [], []
+        idxs = [i for i, s in enumerate(sigs) if s == mid_sig]
+        lo, hi = min(idxs), max(idxs) + 1
+        if idxs != list(range(lo, hi)):
+            raise ValueError(
+                "homogeneous middle layers are not contiguous; compiled "
+                "pipeline needs blocks adjacent in the layer list")
+        rf = self.run_function
+        return list(rf[:lo]), list(rf[lo:hi]), list(rf[hi:])
+
     def get_stage_layers(self, stage_id):
         lo, hi = self.segment_parts[stage_id], self.segment_parts[stage_id + 1]
         return self.run_function[lo:hi]

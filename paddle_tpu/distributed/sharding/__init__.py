@@ -21,7 +21,19 @@ from ..fleet.meta_optimizers.dygraph_optimizer.dygraph_sharding_optimizer import
 class GroupShardedStage3:
     """Stage-3 (p_g_os): parameters stored sharded over the sharding axis;
     XLA all-gathers them at each use (FSDP). Reference
-    group_sharded_stage3.py:85 codes the gather/release by hand."""
+    group_sharded_stage3.py:85 codes the gather/release by hand (pre-forward
+    allgather, post-use release, segment buffers).
+
+    Why no hand-coded gather/release here: under XLA the gather-on-use and
+    release-after-use ARE the compiler's liveness scheduling — the
+    all-gathered full parameter is a temporary whose buffer dies at its last
+    use inside the fused step program, so the resident footprint is the
+    sharded 1/N storage plus transient gathered working set, exactly what
+    the reference's segment machinery reconstructs manually. This is not
+    just asserted: ``tests/test_fleet.py::test_zero3_memory_bound`` compiles
+    the same train step with replicated vs stage-3 placements and checks
+    XLA's own memory analysis (per-device argument bytes shrink ~1/N and
+    peak temp stays bounded)."""
 
     @staticmethod
     def apply(model, hcg=None, group=None):
@@ -47,20 +59,21 @@ def group_sharded_parallel(
     dp_group=None,
     exclude_layer=None,
 ):
-    """Wrap (model, optimizer, scaler) for ZeRO level ∈ os | os_g | p_g_os."""
+    """Wrap (model, optimizer, scaler) for ZeRO level ∈ os | os_g | p_g_os.
+
+    ``offload=True`` places optimizer states (incl. master weights) in host
+    memory via jax memory kinds ("pinned_host") — the reference's ZeRO
+    CPU-offload (group_sharded_utils/stage3 offload path); XLA streams the
+    shards device-side inside the update."""
     if level not in ("os", "os_g", "p_g_os"):
         raise ValueError("level must be one of 'os', 'os_g', 'p_g_os'")
-    if offload:
-        # CPU offload: states pinned to host memory. Gated until the host
-        # placement path lands; the reference gates similarly on capability.
-        raise NotImplementedError("offload is not supported on the TPU backend yet")
     if level == "os":
-        optimizer = DygraphShardingOptimizer(optimizer, group=group)
+        optimizer = DygraphShardingOptimizer(optimizer, group=group, offload=offload)
     elif level == "os_g":
-        optimizer = GroupShardedOptimizerStage2(optimizer, group=group)
+        optimizer = GroupShardedOptimizerStage2(optimizer, group=group, offload=offload)
     else:  # p_g_os
         model = GroupShardedStage3.apply(model, group=group)
-        optimizer = GroupShardedOptimizerStage2(optimizer, group=group)
+        optimizer = GroupShardedOptimizerStage2(optimizer, group=group, offload=offload)
     return model, optimizer, scaler
 
 

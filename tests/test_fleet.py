@@ -549,3 +549,152 @@ class TestScheduleModes:
             order[mode] = "".join(events)
         assert order["1F1B"].startswith("FBFB")
         assert order["FThenB"].startswith("FFFFB")
+
+
+class TestCompiledHeterogeneousPipeline:
+    """GPT with distinct embedding/head stages through the compiled
+    stacked-stage scan (reference case: SharedLayerDesc tied weights,
+    pp_layers.py:56-237 + PipelineParallelWithInterleave :906)."""
+
+    def _build(self, V=12, H=16, L=4):
+        from paddle_tpu.distributed.fleet.meta_parallel import (
+            LayerDesc, PipelineLayer, SharedLayerDesc)
+
+        class Block(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.ln = nn.LayerNorm(H)
+                self.fc = nn.Linear(H, H)
+
+            def forward(self, x):
+                return x + self.fc(self.ln(x)).tanh()
+
+        def head_fwd(x, w):  # tied head: logits against the embedding table
+            return paddle.matmul(x, w, transpose_y=True)
+
+        paddle.seed(42)
+        descs = [
+            SharedLayerDesc("embed", nn.Embedding, V, H),
+            *[LayerDesc(Block) for _ in range(L)],
+            SharedLayerDesc("embed", nn.Embedding, V, H,
+                            forward_func=head_fwd),
+        ]
+        return PipelineLayer(layers=descs, num_stages=2)
+
+    def test_split_segments_finds_hetero_pre_post(self):
+        pl = self._build()
+        pre, mid, post = pl.split_segments()
+        assert len(pre) == 1 and len(mid) == 4 and len(post) == 1
+
+    @pytest.mark.parametrize("vpp", [1, 2])
+    def test_compiled_matches_eager_and_trains_tied_head(self, vpp, rng):
+        import jax
+        from jax.sharding import Mesh
+        from paddle_tpu.distributed.fleet.meta_parallel import PipelineParallel
+
+        pl = self._build()
+        pp_rt = PipelineParallel(pl)
+        mesh = Mesh(np.array(jax.devices()[:2]), ("pp",))
+        ids = paddle.to_tensor(rng.randint(0, 12, (4, 6)).astype("int64"))
+
+        ref = pl(ids)  # plain sequential forward (eager oracle)
+        out = pp_rt.compiled_forward(ids, mesh=mesh, num_micro=2,
+                                     num_virtual=vpp)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-4,
+                                   atol=1e-5)
+
+        # gradient parity for the TIED embedding/head weight
+        emb = pl.run_function[0]
+        loss = (out * out).mean()
+        loss.backward()
+        g_compiled = np.asarray(emb.weight.grad.numpy())
+        emb.weight.clear_grad()
+        for p in pl.parameters():
+            p.clear_grad()
+        loss_ref = (pl(ids) ** 2).mean()
+        loss_ref.backward()
+        g_eager = np.asarray(emb.weight.grad.numpy())
+        np.testing.assert_allclose(g_compiled, g_eager, rtol=2e-3, atol=1e-5)
+
+    def test_interleave_changes_bubble(self):
+        """VPP must genuinely change the schedule: the circular schedule's
+        analytic bubble shrinks with num_virtual."""
+        from paddle_tpu.distributed.fleet.meta_parallel.gspmd_pipeline import (
+            bubble_fraction)
+
+        assert bubble_fraction(2, 4, 2) < bubble_fraction(2, 4, 1)
+        assert bubble_fraction(4, 8, 4) == pytest.approx(3 / 35)
+
+
+class TestZeroOffloadAndMemory:
+    def test_offload_states_live_on_host(self, rng):
+        """offload=True: optimizer states (incl. master weights) are stored
+        in host memory via jax memory kinds; training still converges
+        (reference: group_sharded CPU-offload)."""
+        from paddle_tpu.distributed.sharding import group_sharded_parallel
+
+        paddle.seed(31)
+        m = nn.Linear(16, 16)
+        opt = paddle.optimizer.Adam(learning_rate=5e-2,
+                                    parameters=m.parameters())
+        m, opt, _ = group_sharded_parallel(m, opt, level="os", offload=True)
+        x = paddle.to_tensor(rng.randn(8, 16).astype(np.float32))
+        losses = []
+        for _ in range(5):
+            loss = (m(x) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0]
+        inner = opt._inner_opt
+        st = inner._ensure_state(m.weight)
+        kinds = {v.sharding.memory_kind for v in st.values()}
+        assert kinds == {"pinned_host"}, kinds
+
+    def test_zero3_memory_bound(self):
+        """XLA's own memory analysis proves the stage-3 placement contract:
+        per-device parameter+state bytes shrink vs the replicated baseline,
+        and the gathered working set stays a bounded temp (the compiler's
+        liveness release == reference stage3 gather/release,
+        group_sharded_stage3.py:85)."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        mesh = Mesh(np.array(jax.devices()), ("dp",))
+        H = 256
+        W = {f"w{i}": jnp.zeros((H, H), jnp.float32) for i in range(4)}
+        M = {f"w{i}": jnp.zeros((H, H), jnp.float32) for i in range(4)}
+        x = jnp.zeros((8 * len(jax.devices()), H), jnp.float32)
+
+        def step(params, mom, x):
+            def loss_fn(params):
+                h = x
+                for k in sorted(params):
+                    h = jnp.tanh(h @ params[k])
+                return (h**2).mean()
+
+            loss, g = jax.value_and_grad(loss_fn)(params)
+            mom2 = jax.tree.map(lambda m_, g_: 0.9 * m_ + g_, mom, g)
+            p2 = jax.tree.map(lambda p_, m_: p_ - 0.1 * m_, params, mom2)
+            return p2, mom2, loss
+
+        data_sh = NamedSharding(mesh, P("dp", None))
+
+        def analyze(spec):
+            sh = {k: NamedSharding(mesh, spec) for k in W}
+            c = jax.jit(step, in_shardings=(sh, sh, data_sh),
+                        out_shardings=(sh, sh, NamedSharding(mesh, P()))
+                        ).lower(W, M, x).compile()
+            ma = c.memory_analysis()
+            return ma.argument_size_in_bytes, ma.temp_size_in_bytes
+
+        rep_arg, rep_tmp = analyze(P())
+        z3_arg, z3_tmp = analyze(P("dp", None))
+        ndev = len(jax.devices())
+        # params+momentum arguments shrink ~1/ndev per device
+        assert z3_arg < rep_arg / (ndev / 2), (z3_arg, rep_arg)
+        # gathered temporaries stay bounded: well under the replicated
+        # resident state the sharding saved
+        assert z3_tmp < rep_arg, (z3_tmp, rep_arg)
