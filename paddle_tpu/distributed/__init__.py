@@ -44,8 +44,41 @@ def is_initialized() -> bool:
     return _parallel_env_initialized
 
 
+def _maybe_init_jax_distributed() -> None:
+    """Form the multi-process runtime when the launcher env says nnodes>1.
+
+    Reference: init_parallel_env's store + ProcessGroup bootstrap
+    (python/paddle/distributed/parallel.py:1097). Here the runtime IS
+    jax.distributed: the coordinator address/world size/rank the launch CLI
+    exported become ``jax.distributed.initialize`` args, after which
+    ``jax.devices()`` spans every host and compiled collectives ride the
+    global mesh. Must run before the jax backend initializes; a no-op for
+    single-process (world size 1) or when already initialized.
+    """
+    world = int(os.environ.get(
+        "JAX_NUM_PROCESSES", os.environ.get("PADDLE_TRAINERS_NUM", "1")))
+    if world <= 1:
+        return
+    import jax
+
+    if jax._src.distributed.global_state.client is not None:
+        return  # already formed (idempotent re-init)
+    coord = (os.environ.get("JAX_COORDINATOR_ADDRESS")
+             or os.environ.get("PADDLE_MASTER"))
+    if not coord:
+        raise RuntimeError(
+            f"multi-process run (world={world}) needs a coordinator: set "
+            "PADDLE_MASTER/JAX_COORDINATOR_ADDRESS (the launch CLI does)")
+    pid = int(os.environ.get(
+        "JAX_PROCESS_ID", os.environ.get("PADDLE_TRAINER_ID", "0")))
+    jax.distributed.initialize(
+        coordinator_address=coord, num_processes=world, process_id=pid)
+
+
 def init_parallel_env():
     global _parallel_env_initialized
+    if not _parallel_env_initialized:
+        _maybe_init_jax_distributed()
     from .collective import _init_default_group
 
     _init_default_group()

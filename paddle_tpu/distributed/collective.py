@@ -21,6 +21,14 @@ TPU-native design — one API, two execution paths:
    Each eager collective is one ``jit``-cached XLA executable per
    (op, shape, dtype, group) — the "cached single-collective executables"
    design called out in SURVEY.md §5.8.
+
+   Multi-host boundary: on a multi-process runtime
+   (``jax.distributed.initialize`` via init_parallel_env — see
+   tests/test_multiprocess.py) the rank-major global view must be formed
+   with process-local shards (``jax.make_array_from_single_device_arrays``),
+   NOT host numpy concatenation; the compiled path (1) is the supported
+   cross-host route and is what DataParallel/fleet use. Eager collectives on
+   host-local arrays remain single-controller (all addressable devices).
 """
 from __future__ import annotations
 

@@ -1,0 +1,122 @@
+"""Two-process runtime formation: launch 2 CPU procs, form ONE global mesh,
+run a DP step, compare to the single-process result.
+
+Reference: init_parallel_env's store+ProcessGroup bootstrap
+(python/paddle/distributed/parallel.py:1097) and the 2-proc pattern of
+test_collective_api_base.py:198. Here `init_parallel_env` calls
+`jax.distributed.initialize` from the env the launch CLI exports, the two
+procs contribute one CPU device each, and a compiled DP step (batch sharded
+over dp=2, params replicated, grad all-reduce by GSPMD) must produce the
+same loss as the same step computed locally.
+"""
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+_WORKER = r"""
+import os, sys
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+
+dist.init_parallel_env()   # reads PADDLE_MASTER/TRAINER_ID/TRAINERS_NUM
+assert jax.process_count() == 2, jax.process_count()
+rank = jax.process_index()
+
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+devs = jax.devices()
+assert len(devs) == 2, devs
+mesh = Mesh(np.array(devs), ("dp",))
+
+# deterministic global batch; each proc owns its dp shard
+X = np.arange(8 * 3, dtype="float32").reshape(8, 3) / 10.0
+Y = (X @ np.array([[1.0], [-2.0], [0.5]], "float32")).astype("float32")
+w0 = np.full((3, 1), 0.1, "float32")
+
+xsh = NamedSharding(mesh, P("dp", None))
+wsh = NamedSharding(mesh, P())
+my_dev = next(d for d in devs if d.process_index == rank)
+my_row = next(i for i, d in enumerate(mesh.devices) if d == my_dev)
+local = slice(my_row * 4, (my_row + 1) * 4)
+x = jax.make_array_from_single_device_arrays(
+    X.shape, xsh, [jax.device_put(X[local], my_dev)])
+y = jax.make_array_from_single_device_arrays(
+    Y.shape, xsh, [jax.device_put(Y[local], my_dev)])
+w = jax.device_put(jnp.asarray(w0), wsh)
+
+
+@jax.jit
+def step(w, x, y):
+    def loss_fn(w):
+        return jnp.mean((x @ w - y) ** 2)
+    loss, g = jax.value_and_grad(loss_fn)(w)
+    return w - 0.1 * g, loss
+
+
+w2, loss = step(w, x, y)
+print(f"RANK{rank} LOSS {float(loss):.8f} W0 {float(np.asarray(jax.device_get(w2))[0,0]):.8f}", flush=True)
+"""
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def test_two_process_dp_step(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER)
+    port = _free_port()
+    procs = []
+    for rank in range(2):
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)  # force-disables the TPU tunnel
+        env["XLA_FLAGS"] = " ".join(
+            f for f in env.get("XLA_FLAGS", "").split()
+            if "host_platform_device_count" not in f)
+        repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "PADDLE_MASTER": f"127.0.0.1:{port}",
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": "2",
+            "PYTHONPATH": repo_root + os.pathsep + env.get("PYTHONPATH", ""),
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, str(script)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=240)
+        outs.append(out)
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {rank} failed:\n{out[-3000:]}"
+
+    # single-process oracle
+    X = np.arange(8 * 3, dtype="float32").reshape(8, 3) / 10.0
+    Y = (X @ np.array([[1.0], [-2.0], [0.5]], "float32")).astype("float32")
+    w0 = np.full((3, 1), 0.1, "float32")
+    pred = X @ w0 - Y
+    loss_ref = float(np.mean(pred**2))
+    g = 2 * X.T @ pred / X.shape[0]
+    w_ref = w0 - 0.1 * g
+
+    for rank, out in enumerate(outs):
+        line = [l for l in out.splitlines() if l.startswith(f"RANK{rank}")]
+        assert line, f"no result line from rank {rank}:\n{out[-2000:]}"
+        toks = line[0].split()
+        loss, w00 = float(toks[2]), float(toks[4])
+        np.testing.assert_allclose(loss, loss_ref, rtol=1e-5)
+        np.testing.assert_allclose(w00, w_ref[0, 0], rtol=1e-5)
