@@ -25,30 +25,59 @@ def _read_plan(path: str) -> dict:
 
 
 class _FileCache:
+    """Lazy access to stored shard payloads.
+
+    ``.npz`` files (format v2) are zip archives of one ``.npy`` member per
+    shard: ``get(file)[key]`` reads ONLY that member from disk. Legacy
+    pickle payloads (v1) load whole-file (kept for old checkpoints)."""
+
     def __init__(self, path):
         self.path = path
         self.cache: dict = {}
 
     def get(self, fname):
         if fname not in self.cache:
-            with open(os.path.join(self.path, fname), "rb") as f:
-                self.cache[fname] = pickle.load(f)
+            full = os.path.join(self.path, fname)
+            if fname.endswith(".npz"):
+                self.cache[fname] = np.load(full)  # lazy per-member
+            else:
+                with open(full, "rb") as f:
+                    self.cache[fname] = pickle.load(f)
         return self.cache[fname]
 
 
-def _assemble_global(meta, files: _FileCache) -> np.ndarray:
-    """Reconstruct the global ndarray from its stored shard boxes.
+def _box_overlap(a, b):
+    """Intersection of two boxes ([[lo, hi], ...]); None if empty.
 
-    The reference computes the overlap of each stored box with each *wanted*
-    box and moves only that; assembling the global array subsumes every
-    overlap case (the wanted sharding is applied by device_put afterwards) at
-    the cost of one host-RAM copy — acceptable on a single-controller host,
-    and the box math here is the same compute_overlap logic.
-    """
-    out = np.empty(meta["global_shape"], dtype=np.dtype(meta["dtype"]))
+    The reference's compute_overlap (load_state_dict.py:247)."""
+    out = []
+    for (alo, ahi), (blo, bhi) in zip(a, b):
+        lo, hi = max(alo, blo), min(ahi, bhi)
+        if lo >= hi:
+            return None
+        out.append((lo, hi))
+    return out
+
+
+def _assemble_box(meta, files: _FileCache, box) -> np.ndarray:
+    """Materialize ONLY the wanted ``box`` of a stored tensor.
+
+    For each stored shard, copy just the stored∩wanted overlap — host peak
+    memory is one wanted shard, never the global tensor (the reference moves
+    exactly these overlaps point-to-point; here they move via lazy npz
+    member reads)."""
+    out = np.empty([hi - lo for lo, hi in box], dtype=np.dtype(meta["dtype"]))
     for sh in meta["shards"]:
-        idx = tuple(slice(lo, hi) for lo, hi in sh["box"])
-        out[idx] = files.get(sh["file"])[sh["key"]]
+        ov = _box_overlap(box, sh["box"])
+        if ov is None:
+            continue
+        src_idx = tuple(
+            slice(lo - slo, hi - slo)
+            for (lo, hi), (slo, _) in zip(ov, sh["box"]))
+        dst_idx = tuple(
+            slice(lo - wlo, hi - wlo)
+            for (lo, hi), (wlo, _) in zip(ov, box))
+        out[dst_idx] = files.get(sh["file"])[sh["key"]][src_idx]
     return out
 
 
@@ -90,20 +119,50 @@ def load_state_dict(state_dict: dict, path: str, process_group=None, coordinator
         if meta.get("kind") == "object":
             # restore scalars/hyperparams (LR last_epoch, step counters) by
             # writing back into the nested container that owns the key
-            stored = files.get(meta.get("file", "data_0.pkl"))[meta.get("key", name)]
+            stored = files.get(meta.get("file", "objects_0.pkl"))[meta.get("key", name)]
             _set_by_path(state_dict, name, stored)
             continue
-        global_np = _assemble_global(meta, files)
         if isinstance(dst, Tensor):
             arr = dst._data
-            if tuple(arr.shape) != tuple(global_np.shape):
+            if tuple(arr.shape) != tuple(meta["global_shape"]):
                 raise ValueError(
-                    f"{name}: stored shape {global_np.shape} != wanted {arr.shape}"
+                    f"{name}: stored shape {tuple(meta['global_shape'])} != "
+                    f"wanted {tuple(arr.shape)}"
                 )
             sharding = arr.sharding
-            dst._data = jax.device_put(
-                global_np.astype(arr.dtype), sharding
-            )
+            shape = tuple(arr.shape)
+            dtype = arr.dtype
+            # Incremental per-device assembly: each wanted shard is built
+            # from its stored∩wanted overlaps, device_put, and the host
+            # buffer dropped before the next — host peak is ONE shard (the
+            # reference's point-to-point read granularity), never the
+            # global tensor.
+            dev_boxes = []
+            for dev, index in sharding.addressable_devices_indices_map(
+                    shape).items():
+                box = tuple(
+                    (0 if s.start is None else int(s.start),
+                     shape[d] if s.stop is None else int(s.stop))
+                    for d, s in enumerate(index)
+                )
+                dev_boxes.append((dev, box))
+            # assemble each DISTINCT box once (replicated shardings repeat
+            # the same box per device — re-reading it N times would undo the
+            # lazy-npz I/O win); drop each assembled array after its last use
+            remaining: dict = {}
+            for _, box in dev_boxes:
+                remaining[box] = remaining.get(box, 0) + 1
+            assembled: dict = {}
+            singles = []
+            for dev, box in dev_boxes:
+                if box not in assembled:
+                    assembled[box] = _assemble_box(meta, files, box).astype(dtype)
+                singles.append(jax.device_put(assembled[box], dev))
+                remaining[box] -= 1
+                if remaining[box] == 0:
+                    del assembled[box]
+            dst._data = jax.make_array_from_single_device_arrays(
+                shape, sharding, singles)
         elif isinstance(dst, jax.Array):
             # caller must re-fetch from the returned dict for raw arrays —
             # in-place assignment needs a Tensor handle

@@ -53,14 +53,15 @@ def save_state_dict(state_dict: dict, path: str, process_group=None, coordinator
     proc = _proc_index()
 
     plan: dict = {}
-    payload: dict = {}
+    payload: dict = {}      # array shards -> data_<proc>.npz (lazy-loadable)
+    obj_payload: dict = {}  # python objects -> objects_<proc>.pkl
     for name, value in flat.items():
         arr = _as_array(value)
         if not isinstance(arr, jax.Array):
             # python scalar / numpy / opt hyperparam: coordinator writes it
-            plan[name] = {"kind": "object", "file": f"data_{proc}.pkl",
+            plan[name] = {"kind": "object", "file": f"objects_{proc}.pkl",
                           "key": name}
-            payload[name] = np.asarray(arr) if isinstance(arr, np.ndarray) else arr
+            obj_payload[name] = np.asarray(arr) if isinstance(arr, np.ndarray) else arr
             continue
         shards_meta = []
         for shard in arr.addressable_shards:
@@ -76,7 +77,7 @@ def save_state_dict(state_dict: dict, path: str, process_group=None, coordinator
             ]
             key = f"{name}@{proc}@{len(shards_meta)}"
             payload[key] = np.asarray(shard.data)
-            shards_meta.append({"box": box, "file": f"data_{proc}.pkl", "key": key})
+            shards_meta.append({"box": box, "file": f"data_{proc}.npz", "key": key})
         plan[name] = {
             "kind": "array",
             "global_shape": [int(d) for d in arr.shape],
@@ -84,8 +85,12 @@ def save_state_dict(state_dict: dict, path: str, process_group=None, coordinator
             "shards": shards_meta,
         }
 
-    with open(os.path.join(path, f"data_{proc}.pkl"), "wb") as f:
-        pickle.dump(payload, f, protocol=4)
+    # npz (a zip of .npy members) loads lazily per key — the load side reads
+    # only the shard members it needs, never the whole payload (the
+    # reference's point-to-point read granularity, but via the filesystem).
+    np.savez(os.path.join(path, f"data_{proc}.npz"), **payload)
+    with open(os.path.join(path, f"objects_{proc}.pkl"), "wb") as f:
+        pickle.dump(obj_payload, f, protocol=4)
 
     # metadata merge: multi-process would gather plans via the store; the
     # single-controller runtime sees every shard, so proc 0 writes the plan.
