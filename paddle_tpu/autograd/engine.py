@@ -221,15 +221,34 @@ def apply_op(name: str, fn: Callable, *args, **kwargs):
     # hook returns an end-callback closing the dispatch range (or None)
     end_profile = op_profile_hook(name) if op_profile_hook is not None else None
 
+    # The framework default is matmul precision "highest" (true-fp32
+    # semantics for user-facing float32). For HALF-precision ops that
+    # default makes XLA emulate bf16 matmuls with multi-pass passes — 3-6x
+    # slower and never what a user who cast to bf16 wants. When every
+    # floating input is half precision, trace the op under native MXU
+    # precision; fp32 ops keep the accurate default.
+    low_prec = None
+    for leaf in leaves:
+        if isinstance(leaf, Tensor) and _is_diff_dtype(leaf._data.dtype):
+            if leaf._data.dtype in (jnp.bfloat16, jnp.float16):
+                low_prec = True if low_prec is None else low_prec
+            else:
+                low_prec = False
+    import contextlib
+
+    prec_ctx = (jax.default_matmul_precision("default") if low_prec
+                else contextlib.nullcontext())
+
     node = None
     try:
-        if diff_pos:
-            diff_datas = [leaves[p]._data for p in diff_pos]
-            out_flat, vjp_fn = jax.vjp(pure_fn, *diff_datas)
-            out_avals = [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in out_flat]
-            node = GradNode(name, vjp_fn, pure_fn, [leaves[p] for p in diff_pos], out_avals)
-        else:
-            out_flat = pure_fn()
+        with prec_ctx:
+            if diff_pos:
+                diff_datas = [leaves[p]._data for p in diff_pos]
+                out_flat, vjp_fn = jax.vjp(pure_fn, *diff_datas)
+                out_avals = [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in out_flat]
+                node = GradNode(name, vjp_fn, pure_fn, [leaves[p] for p in diff_pos], out_avals)
+            else:
+                out_flat = pure_fn()
     finally:
         # record the range even when dispatch raises — the failing op is
         # exactly the one worth seeing in the trace

@@ -112,7 +112,8 @@ class _swap_state:
             t._data = self._saved[n]
 
 
-def functional_call(layer: Layer, state_arrays: dict[str, Any], *args, _forward=None, **kwargs):
+def functional_call(layer: Layer, state_arrays: dict[str, Any], *args,
+                    _forward=None, return_state=False, **kwargs):
     """Run ``layer`` with parameters/buffers substituted by ``state_arrays``
     (name -> jax array or tracer), restoring the originals afterwards.
 
@@ -120,11 +121,23 @@ def functional_call(layer: Layer, state_arrays: dict[str, Any], *args, _forward=
     jax.grad / shard_map can transform. ``_forward`` overrides the callable
     (used by StaticFunction to reach the pre-conversion forward and avoid
     re-entering itself).
+
+    ``return_state=True`` additionally returns ``{name: data}`` captured
+    AFTER the forward but before restoration — this is how in-place buffer
+    mutation (BatchNorm running stats in train mode) becomes functional
+    state that a jit/scan caller can thread through its carry.
     """
-    with _swap_state(layer, state_arrays):
+    sw = _swap_state(layer, state_arrays)
+    with sw:
         if _forward is not None:
-            return _forward(*args, **kwargs)
-        return layer(*args, **kwargs)
+            out = _forward(*args, **kwargs)
+        else:
+            out = layer(*args, **kwargs)
+        if return_state:
+            new_state = {n: t._data for n, t in sw._targets.items()}
+    if return_state:
+        return out, new_state
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -156,6 +169,13 @@ class _ConcreteProgram:
         function = static._function
         n_leaves = treedef.num_leaves
         out_info = self.out_info
+        # Train-mode buffer mutation (BatchNorm running stats) becomes
+        # functional state: the traced program returns updated buffers and
+        # __call__ writes them back (reference: buffers are program outputs
+        # in dy2static partial programs too).
+        buf_names = ([n for n, _ in layer.named_buffers()]
+                     if (layer is not None and train) else [])
+        self.buf_names = buf_names
 
         def pure(rng_key, param_arrays: dict, *tensor_datas):
             rebuilt = [None] * n_leaves
@@ -176,14 +196,17 @@ class _ConcreteProgram:
             saved_ctr = default_generator._counter
             default_generator._trace_key = rng_key
             default_generator._counter = 0
+            new_state = {}
             try:
                 with no_grad():
                     if layer is not None:
                         was_training = layer.training
                         (layer.train if train else layer.eval)()
                         try:
-                            out = functional_call(
-                                layer, param_arrays, *args, _forward=function, **kwargs
+                            out, new_state = functional_call(
+                                layer, param_arrays, *args,
+                                _forward=function, return_state=True,
+                                **kwargs
                             )
                         finally:
                             (layer.train if was_training else layer.eval)()
@@ -199,7 +222,10 @@ class _ConcreteProgram:
             arr_pos = [i for i, l in enumerate(out_leaves) if _is_arraylike(l)]
             const_out = {i: l for i, l in enumerate(out_leaves) if not _is_arraylike(l)}
             out_info[0] = (out_td, arr_pos, const_out)
-            return tuple(_leaf_data(out_leaves[i]) for i in arr_pos)
+            main = tuple(_leaf_data(out_leaves[i]) for i in arr_pos)
+            bufs = tuple(
+                _leaf_data(new_state[n]) for n in buf_names if n in new_state)
+            return main + bufs
 
         self.fn = jax.jit(pure)
 
@@ -332,6 +358,13 @@ class StaticFunction:
         out_td, arr_pos, const_out = prog.out_info[0]
         if not isinstance(outs, (tuple, list)):
             outs = (outs,)
+        if prog.buf_names:
+            # write updated buffers (BN running stats) back into the layer
+            buf_outs = outs[len(arr_pos):]
+            outs = outs[:len(arr_pos)]
+            for n, t in zip(prog.buf_names, buf_outs):
+                target = state[n]
+                target._data = t._data.astype(target._data.dtype)
         leaves_out = [None] * (len(arr_pos) + len(const_out))
         for i, t in zip(arr_pos, outs):
             leaves_out[i] = t

@@ -184,3 +184,30 @@ class TestSaveLoad:
         net2.train()
         jf2 = paddle.jit.to_static(net2)
         np.testing.assert_allclose(jf2(x).numpy(), a)
+
+    def test_train_mode_bn_updates_running_stats(self):
+        """to_static in train mode must update BatchNorm running stats like
+        eager (buffers become program outputs written back per call) —
+        reference: BN stat updates inside dy2static partial programs."""
+        paddle.seed(0)
+        net_e = nn.Sequential(nn.Conv2D(3, 4, 3, padding=1), nn.BatchNorm2D(4))
+        paddle.seed(0)
+        net_j = nn.Sequential(nn.Conv2D(3, 4, 3, padding=1), nn.BatchNorm2D(4))
+        net_e.train()
+        net_j.train()
+        jf = paddle.jit.to_static(net_j)
+        rng = np.random.RandomState(1)
+        for _ in range(3):
+            x = paddle.to_tensor(rng.randn(2, 3, 8, 8).astype("float32"))
+            oe = net_e(x)
+            oj = jf(x)
+        np.testing.assert_allclose(oe.numpy(), oj.numpy(), rtol=1e-4,
+                                   atol=1e-5)
+        bufs_e = {n: np.asarray(b.numpy()) for n, b in net_e.named_buffers()}
+        bufs_j = {n: np.asarray(b.numpy()) for n, b in net_j.named_buffers()}
+        assert bufs_e, "expected BN buffers"
+        for n in bufs_e:
+            np.testing.assert_allclose(bufs_e[n], bufs_j[n], rtol=1e-4,
+                                       atol=1e-5, err_msg=n)
+        # and the stats actually moved off their init values
+        assert abs(bufs_j["1._variance"] - 1.0).max() > 1e-3
