@@ -35,6 +35,11 @@ def _parse_args(argv=None):
     parser.add_argument("--elastic_level", type=int, default=-1)
     parser.add_argument("--elastic_timeout", type=int, default=30)
     parser.add_argument("--devices", type=str, default=None)
+    parser.add_argument("--auto_tuner_json", type=str, default=None,
+                        help="auto-tuner mode: JSON config describing the "
+                             "search (model dims, max trials, metric); each "
+                             "candidate runs the training script as one "
+                             "trial (reference: launch --auto_tuner_json)")
     parser.add_argument("training_script", type=str)
     parser.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return parser.parse_args(argv)
@@ -241,6 +246,74 @@ class ElasticController:
         self._stop.set()
 
 
+def _launch_auto_tuner(args) -> int:
+    """Trial loop (reference: auto_tuner/tuner.py:21 driven from launch
+    main.py): search -> prune (validity + memory model) -> run the training
+    script once per surviving candidate -> record its metric -> emit
+    ``best_cfg.json`` and ``history.csv``.
+
+    Trial contract: each trial process receives the candidate as JSON in
+    ``PADDLE_AUTO_TUNER_TRIAL`` and writes ``{"<metric>": value}`` to the
+    path in ``PADDLE_AUTO_TUNER_RESULT`` (the reference greps trial logs for
+    the metric; a result file is the explicit version of that contract).
+    """
+    import json
+
+    from ..auto_tuner.tuner import AutoTuneConfig, Tuner
+
+    with open(args.auto_tuner_json) as f:
+        tj = json.load(f)
+    cfg = AutoTuneConfig(
+        num_devices=int(tj.get("num_devices", 8)),
+        global_batch_size=int(tj.get("global_batch_size", 32)),
+        model=tj.get("model", {}),
+        memory_limit_gb=tj.get("memory_limit_gb"),
+        max_trials=int(tj.get("max_trials", 0)),
+        metric=tj.get("metric", "throughput"),
+        higher_is_better=bool(tj.get("higher_is_better", True)),
+    )
+    tuner = Tuner(cfg)
+    tdir = os.path.join(args.log_dir, "auto_tuner")
+    os.makedirs(tdir, exist_ok=True)
+
+    k = 0
+    while True:
+        cand = tuner.search_once()
+        if cand is None:
+            break
+        res_path = os.path.join(tdir, f"trial_{k}.json")
+        env = dict(os.environ)
+        env["PADDLE_AUTO_TUNER_TRIAL"] = json.dumps(cand.as_dict())
+        env["PADDLE_AUTO_TUNER_RESULT"] = res_path
+        log_path = os.path.join(tdir, f"trial_{k}.log")
+        with open(log_path, "ab") as log:
+            proc = subprocess.Popen(
+                [sys.executable, "-u", args.training_script,
+                 *args.training_script_args],
+                env=env, stdout=log, stderr=subprocess.STDOUT)
+            rc = proc.wait()
+        metric_value, err = None, None
+        if rc == 0 and os.path.exists(res_path):
+            with open(res_path) as f:
+                metric_value = json.load(f).get(cfg.metric)
+        elif rc != 0:
+            err = f"trial exited rc={rc} (OOM or failure; see {log_path})"
+        tuner.add_cfg(cand, metric_value, error=err)
+        print(f"[auto-tuner] trial {k}: {cand.as_dict()} -> "
+              f"{cfg.metric}={metric_value} err={err}", file=sys.stderr)
+        k += 1
+
+    tuner.recorder.store_history(os.path.join(tdir, "history.csv"))
+    best = tuner.get_best_cfg()
+    if best is not None:
+        with open(os.path.join(tdir, "best_cfg.json"), "w") as f:
+            json.dump(best, f, indent=1)
+        print(json.dumps({"best_cfg": best}))
+        return 0
+    print(json.dumps({"best_cfg": None, "trials": k}))
+    return 1
+
+
 def launch(argv=None) -> int:
     """Run the launcher; returns the exit code (0 = all workers succeeded).
 
@@ -251,6 +324,8 @@ def launch(argv=None) -> int:
     failing it.
     """
     args = _parse_args(argv)
+    if args.auto_tuner_json:
+        return _launch_auto_tuner(args)
     spec = str(args.nnodes)
     elastic = ":" in spec and args.master is not None
     nnodes = int(spec.split(":")[0])
