@@ -45,7 +45,16 @@ def synchronize(device=None):
 
 
 class Stream:
-    """Streams are an XLA-internal concept; the facade exists for API parity."""
+    """API-parity facade over XLA's single ordered execution stream.
+
+    XLA owns scheduling: there is exactly ONE logical stream per device, all
+    dispatched work is ordered on it, and overlap (compute/collective/DMA)
+    is decided by the compiler, not by user streams (reference
+    core/stream.py maps to per-device CUDA streams). Consequently
+    ``wait_stream``/``wait_event`` ARE correct as ordering no-ops — the
+    ordering they would establish already holds. The operations with real
+    semantics (synchronize, event query/elapsed-time) do real work below.
+    """
 
     def __init__(self, device=None, priority=2):
         self.device = device
@@ -54,27 +63,79 @@ class Stream:
         synchronize()
 
     def wait_stream(self, stream):
-        pass
+        # single ordered stream: cross-stream ordering always holds
+        return None
 
     def record_event(self, event=None):
-        return event or Event()
+        ev = event or Event()
+        ev.record(self)
+        return ev
 
     def wait_event(self, event):
-        pass
+        # single ordered stream: event's work is already ordered before
+        # anything dispatched after this call
+        return None
 
 
 class Event:
+    """Marks a point in the dispatch order.
+
+    ``record`` captures a token after currently-queued work; ``query``
+    reports whether that work completed (non-blocking); ``synchronize``
+    blocks on it; ``elapsed_time`` between two recorded events times the
+    device work between them (reference core/event.py semantics, minus
+    sub-stream granularity XLA does not expose).
+    """
+
     def __init__(self, enable_timing=False, blocking=False, interprocess=False):
-        pass
+        self._marker = None
+        self._time = None
 
     def record(self, stream=None):
-        pass
+        # a tiny device op AFTER queued work: its readiness == "everything
+        # recorded before this point is done". Non-blocking — dispatch is
+        # async, so query() can genuinely observe a pending state.
+        self._marker = jax.device_put(0) + 0
+        self._time = None
 
-    def query(self):
-        return True
+    def query(self) -> bool:
+        if self._marker is None:
+            return True
+        try:
+            return self._marker.is_ready()
+        except AttributeError:
+            self._marker.block_until_ready()
+            return True
 
     def synchronize(self):
+        import time as _time
+
+        if self._marker is not None:
+            self._marker.block_until_ready()
+            if self._time is None:
+                self._time = _time.perf_counter()
         synchronize()
+
+    def _completion_time(self):
+        import time as _time
+
+        if self._marker is not None and self._time is None:
+            self._marker.block_until_ready()
+            self._time = _time.perf_counter()
+        return self._time
+
+    def elapsed_time(self, end_event) -> float:
+        """Milliseconds between this event's completion and ``end_event``'s.
+
+        Completion is observed host-side at the first query/synchronize/
+        elapsed_time touching the event (XLA exposes no device timestamps),
+        so the value is an upper-bound-ish host measurement; events whose
+        completion was observed out of order clamp to 0 rather than report
+        a negative interval."""
+        t0, t1 = self._completion_time(), end_event._completion_time()
+        if t0 is None or t1 is None:
+            raise RuntimeError("both events must be recorded first")
+        return max(0.0, (t1 - t0) * 1000.0)
 
 
 def current_stream(device=None):

@@ -35,13 +35,47 @@ class Model:
         self.stop_training = False
 
     # --- configuration -----------------------------------------------------
-    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        """Configure the model (reference hapi/model.py:1295 prepare).
+
+        Distributed-aware (reference model.py:225 init context): when the
+        parallel env is initialized with world size > 1 the network is
+        wrapped in DataParallel so fit/train_batch sync gradients.
+
+        Static-graph-aware (reference's static-mode adapter): when
+        ``paddle.enable_static()`` is active, forward/loss execute through
+        ONE compiled program per input signature via jit.to_static — the
+        TPU-native equivalent of the reference's static _run path.
+        """
         self._optimizer = optimizer
         self._loss = loss
         for m in _to_list(metrics):
             if not isinstance(m, Metric):
                 raise TypeError(f"metric must be paddle.metric.Metric, got {type(m)}")
         self._metrics = _to_list(metrics)
+
+        import paddle_tpu as paddle
+        from ..distributed import is_initialized
+
+        if is_initialized():
+            # nranks = the default group's extent (devices on the
+            # single-controller runtime, processes×devices on multi-host) —
+            # the reference keys on ParallelEnv().nranks the same way
+            from ..distributed.collective import _init_default_group
+            from ..distributed.parallel import DataParallel
+
+            try:
+                nranks = _init_default_group().nranks
+            except Exception:
+                nranks = 1
+            if nranks > 1 and not isinstance(self.network, DataParallel):
+                self.network = DataParallel(self.network)
+        if not paddle.in_dynamic_mode():
+            from ..jit import to_static
+
+            if not getattr(self.network.forward, "__wrapped__", None):
+                self.network = to_static(self.network)
 
     # --- single-batch ops --------------------------------------------------
     def _forward(self, inputs):

@@ -11,8 +11,9 @@ import jax
 import jax.numpy as jnp
 
 from ..autograd.engine import apply_op
-from . import creation, einsum, linalg, logic, manipulation, math, random, search, stat
+from . import array, creation, einsum, linalg, logic, manipulation, math, random, search, stat
 from .tensor import Parameter, Tensor, register_tensor_method
+from .array import array_length, array_read, array_write, create_array
 
 __all__ = [
     "Tensor",

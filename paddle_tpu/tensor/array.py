@@ -1,0 +1,76 @@
+"""TensorArray API: create_array / array_write / array_read / array_length.
+
+Reference: python/paddle/tensor/array.py (dynamic mode: a plain python list;
+static mode: a LOD_TENSOR_ARRAY variable backed by phi TensorArray,
+paddle/phi/core/tensor_array.h). TPU-native: the dynamic-mode list IS the
+representation everywhere — under trace-based to_static / the record-replay
+Program, list indices are python ints at trace time (XLA has no growable
+array type; bounded loops that need gradients scan over a stacked axis
+instead, which is what ``lax.scan`` gives the converted control flow).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .tensor import Tensor
+
+__all__ = ["array_length", "array_read", "array_write", "create_array"]
+
+
+def _as_index(i) -> int:
+    if isinstance(i, Tensor):
+        return int(np.asarray(i.numpy()).reshape(-1)[0])
+    return int(i)
+
+
+def create_array(dtype="float32", initialized_list=None):
+    """New TensorArray (a python list of Tensors).
+
+    ``initialized_list`` seeds the array (reference create_array
+    initialized_list arg)."""
+    arr = []
+    if initialized_list is not None:
+        for v in initialized_list:
+            if not isinstance(v, Tensor):
+                raise TypeError(
+                    f"initialized_list items must be Tensors, got {type(v)}")
+            arr.append(v)
+    return arr
+
+
+def array_write(x, i, array=None):
+    """Write ``x`` at index ``i``; appends when ``i == len(array)``.
+
+    Returns the array (reference semantics: the written-to array)."""
+    if array is None:
+        array = create_array()
+    if not isinstance(array, list):
+        raise TypeError("array must be a TensorArray (python list)")
+    idx = _as_index(i)
+    if idx < 0 or idx > len(array):
+        raise IndexError(
+            f"array_write index {idx} out of range for length {len(array)}")
+    if idx == len(array):
+        array.append(x)
+    else:
+        array[idx] = x
+    return array
+
+
+def array_read(array, i):
+    """Read the Tensor at index ``i``."""
+    if not isinstance(array, list):
+        raise TypeError("array must be a TensorArray (python list)")
+    idx = _as_index(i)
+    if idx < 0 or idx >= len(array):
+        raise IndexError(
+            f"array_read index {idx} out of range for length {len(array)}")
+    return array[idx]
+
+
+def array_length(array):
+    """Length of the array as a python int (dynamic-mode reference returns
+    the same)."""
+    if not isinstance(array, list):
+        raise TypeError("array must be a TensorArray (python list)")
+    return len(array)

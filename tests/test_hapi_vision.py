@@ -245,3 +245,73 @@ def test_grid_sample_reflection_align_corners_false():
     out_ac = paddle.nn.functional.grid_sample(
         x, grid, padding_mode="reflection", align_corners=True)
     assert np.isfinite(float(out_ac._data[0, 0, 0, 0]))
+
+
+def test_hapi_prepare_distributed_and_static(rng):
+    """prepare() wraps in DataParallel when the parallel env is up, and
+    routes through a compiled program under static mode (reference
+    hapi/model.py:225 distributed init + static _run adapter)."""
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed.parallel import DataParallel
+
+    dist.init_parallel_env()
+    net = nn.Sequential(nn.Flatten(), nn.Linear(16, 4))
+    model = paddle.Model(net)
+    model.prepare(
+        optimizer=paddle.optimizer.SGD(0.1, parameters=net.parameters()),
+        loss=nn.CrossEntropyLoss())
+    assert isinstance(model.network, DataParallel)
+    x = rng.randn(8, 4, 4).astype("float32")
+    y = rng.randint(0, 4, (8, 1)).astype("int64")
+    out = model.train_batch([x], [y])
+    assert np.isfinite(out[0]).all()
+
+    # static mode: forward becomes a StaticFunction (compiled program)
+    paddle.enable_static()
+    try:
+        net2 = nn.Sequential(nn.Flatten(), nn.Linear(16, 4))
+        m2 = paddle.Model(net2)
+        m2.prepare(
+            optimizer=paddle.optimizer.SGD(0.1, parameters=net2.parameters()),
+            loss=nn.CrossEntropyLoss())
+        from paddle_tpu.jit.api import StaticFunction
+
+        fwd = getattr(m2.network, "forward", None)
+        assert isinstance(fwd, StaticFunction) or isinstance(
+            m2.network, StaticFunction)
+        out = m2.eval_batch([x], [y])
+        assert np.isfinite(out[0]).all()
+    finally:
+        paddle.disable_static()
+
+
+def test_tensor_array_api():
+    """create_array/array_write/array_read/array_length (reference
+    tensor/array.py dynamic mode; phi TensorArray equivalent)."""
+    arr = paddle.tensor.create_array("float32")
+    x = paddle.full([3, 3], 5.0)
+    i = paddle.zeros([1], dtype="int32")
+    arr = paddle.tensor.array_write(x, i, array=arr)
+    assert paddle.tensor.array_length(arr) == 1
+    got = paddle.tensor.array_read(arr, 0)
+    np.testing.assert_allclose(got.numpy(), 5.0)
+    arr = paddle.tensor.array_write(x * 2, 1, array=arr)
+    assert paddle.tensor.array_length(arr) == 2
+    with pytest.raises(IndexError):
+        paddle.tensor.array_read(arr, 5)
+
+
+def test_stream_event_semantics():
+    """Events record real completion points; elapsed_time times device work
+    (reference core/stream.py / core/event.py, minus sub-stream granularity
+    XLA does not expose)."""
+    from paddle_tpu import device
+
+    e1 = device.Event()
+    e1.record()
+    s = device.current_stream()
+    _ = paddle.matmul(paddle.ones([64, 64]), paddle.ones([64, 64]))
+    e2 = s.record_event()
+    e2.synchronize()
+    assert e2.query() is True
+    assert e1.elapsed_time(e2) >= 0.0
