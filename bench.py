@@ -246,7 +246,7 @@ def bench_bert_jit(on_tpu):
     from paddle_tpu.models import BertForPretraining
     from paddle_tpu.models.bert import BertConfig
 
-    batch, seq = (32, 128) if on_tpu else (2, 32)
+    batch, seq = (128, 128) if on_tpu else (2, 32)
     K = 10 if on_tpu else 2
     cfg = BertConfig(hidden_dropout=0.0, attn_dropout=0.0)  # bert-base
     paddle.seed(0)

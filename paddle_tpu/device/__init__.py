@@ -77,19 +77,31 @@ class Stream:
         return None
 
 
+import weakref as _weakref
+
+_EVENT_ORDER: list = []  # weakrefs to recorded events, record order
+_EVENT_SERIAL = [0]
+
+
 class Event:
     """Marks a point in the dispatch order.
 
     ``record`` captures a token after currently-queued work; ``query``
     reports whether that work completed (non-blocking); ``synchronize``
     blocks on it; ``elapsed_time`` between two recorded events times the
-    device work between them (reference core/event.py semantics, minus
-    sub-stream granularity XLA does not expose).
+    device work between them. Because XLA exposes no device timestamps,
+    completion times are observed HOST-side; observation resolves events in
+    record order (XLA's single stream guarantees earlier events complete
+    first), so timing is accurate while the device is still busy and
+    degrades to ~0 only when measurement happens after all work drained
+    (reference core/event.py has device timestamps; this is the closest
+    single-stream approximation).
     """
 
     def __init__(self, enable_timing=False, blocking=False, interprocess=False):
         self._marker = None
         self._time = None
+        self._serial = None
 
     def record(self, stream=None):
         # a tiny device op AFTER queued work: its readiness == "everything
@@ -97,6 +109,9 @@ class Event:
         # async, so query() can genuinely observe a pending state.
         self._marker = jax.device_put(0) + 0
         self._time = None
+        _EVENT_SERIAL[0] += 1
+        self._serial = _EVENT_SERIAL[0]
+        _EVENT_ORDER.append(_weakref.ref(self))
 
     def query(self) -> bool:
         if self._marker is None:
@@ -107,31 +122,35 @@ class Event:
             self._marker.block_until_ready()
             return True
 
-    def synchronize(self):
-        import time as _time
-
-        if self._marker is not None:
-            self._marker.block_until_ready()
-            if self._time is None:
-                self._time = _time.perf_counter()
-        synchronize()
-
-    def _completion_time(self):
+    def _stamp(self):
         import time as _time
 
         if self._marker is not None and self._time is None:
             self._marker.block_until_ready()
             self._time = _time.perf_counter()
+
+    def _completion_time(self):
+        # resolve every earlier-recorded live event first: the single
+        # ordered stream means their completion precedes ours, so stamps
+        # stay monotone in record order
+        if self._serial is not None and self._time is None:
+            for ref in list(_EVENT_ORDER):
+                ev = ref()
+                if ev is None or ev._serial > self._serial:
+                    if ev is None:
+                        _EVENT_ORDER.remove(ref)
+                    continue
+                ev._stamp()
+        self._stamp()
         return self._time
 
-    def elapsed_time(self, end_event) -> float:
-        """Milliseconds between this event's completion and ``end_event``'s.
+    def synchronize(self):
+        self._completion_time()
+        synchronize()
 
-        Completion is observed host-side at the first query/synchronize/
-        elapsed_time touching the event (XLA exposes no device timestamps),
-        so the value is an upper-bound-ish host measurement; events whose
-        completion was observed out of order clamp to 0 rather than report
-        a negative interval."""
+    def elapsed_time(self, end_event) -> float:
+        """Milliseconds between this event's completion and
+        ``end_event``'s (host-observed; see class docstring for limits)."""
         t0, t1 = self._completion_time(), end_event._completion_time()
         if t0 is None or t1 is None:
             raise RuntimeError("both events must be recorded first")

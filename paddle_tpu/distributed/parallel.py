@@ -81,12 +81,18 @@ class DataParallel(Layer):
         self._mesh = mesh
         self._dp_axis = dp_axis if dp_axis in mesh.dim_names else mesh.dim_names[0]
         # Replicate parameters across the mesh (reference: param broadcast at
-        # wrap time, parallel.py:202).
+        # wrap time, parallel.py:202). IN PLACE — parameter object identity
+        # must survive wrapping, because optimizers built from
+        # net.parameters() BEFORE the wrap hold references to these objects
+        # (replacing them would silently freeze training).
         replicated = [Replicate() for _ in range(mesh.ndim)]
         for _, sub in layers.named_sublayers(include_self=True):
             for name, param in list(sub._parameters.items()):
                 if param is not None and not param.is_dist:
-                    sub._parameters[name] = shard_tensor(param, mesh, replicated)
+                    placed = shard_tensor(param, mesh, replicated)
+                    param._data = placed._data
+                    param._placements = placed._placements
+                    param._dist_mesh = placed._dist_mesh
 
     def _shard_input(self, x):
         if isinstance(x, Tensor) and not x.is_dist and x.ndim >= 1:

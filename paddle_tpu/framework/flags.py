@@ -69,6 +69,10 @@ define_flag("check_nan_inf", False, "check outputs of every op for nan/inf")
 define_flag("check_nan_inf_level", 0, "0: abort on nan/inf; >=1: log only")
 define_flag("low_precision_op_list", 0, "collect low-precision op call stats")
 define_flag("use_stride_kernel", True, "enable view/stride ops where possible")
+define_flag("flash_attention_min_seq", 512,
+            "min sequence length to route attention onto the Pallas flash "
+            "kernel; shorter sequences use the fused XLA path (faster below "
+            "this, measured on v5e)")
 define_flag("benchmark", False, "synchronize after every op for timing")
 define_flag("eager_delete_tensor_gb", 0.0, "(ignored; XLA manages memory)")
 define_flag("allocator_strategy", "auto_growth", "(informational on TPU)")

@@ -65,10 +65,7 @@ class Model:
             from ..distributed.collective import _init_default_group
             from ..distributed.parallel import DataParallel
 
-            try:
-                nranks = _init_default_group().nranks
-            except Exception:
-                nranks = 1
+            nranks = _init_default_group().nranks
             if nranks > 1 and not isinstance(self.network, DataParallel):
                 self.network = DataParallel(self.network)
         if not paddle.in_dynamic_mode():

@@ -65,6 +65,131 @@ def _pick_block(pref: int, seq: int) -> int:
     return max(b, 1)
 
 
+# ---------------------------------------------------------------------------
+# Block autotune cache (reference: phi/kernels/autotune/cache.h — per-op
+# algorithm cache keyed by shape signature, persisted across runs). Keys are
+# (seq_q, seq_k, head_dim, dtype); values are swept (bq, bk). The sweep runs
+# only from :func:`autotune` (an explicit eager call — block sizes are
+# trace-time constants, so they cannot be switched inside a compiled
+# program); `_blocks_for` consults the cache at every trace.
+# ---------------------------------------------------------------------------
+
+_AUTOTUNE_CACHE: dict = {}
+_AUTOTUNE_LOADED = [False]
+
+
+def _cache_path():
+    import os
+
+    return os.environ.get(
+        "PADDLE_TPU_FLASH_AUTOTUNE",
+        os.path.join(os.path.expanduser("~"), ".paddle_tpu_flash_autotune.json"))
+
+
+def _load_cache():
+    if _AUTOTUNE_LOADED[0]:
+        return
+    _AUTOTUNE_LOADED[0] = True
+    import json
+    import os
+
+    p = _cache_path()
+    if os.path.exists(p):
+        try:
+            with open(p) as f:
+                _AUTOTUNE_CACHE.update(json.load(f))
+        except Exception:
+            pass
+
+
+def _save_cache():
+    import json
+
+    try:
+        with open(_cache_path(), "w") as f:
+            json.dump(_AUTOTUNE_CACHE, f, indent=1)
+    except OSError:
+        pass
+
+
+def _sig(seq_q, seq_k, d, dtype, which="fwd") -> str:
+    # normalize dtype classes AND array dtypes to one canonical name
+    return f"{seq_q}x{seq_k}x{d}:{jnp.dtype(dtype).name}:{which}"
+
+
+def _blocks_for(seq_q, seq_k, d, dtype, which="fwd"):
+    _load_cache()
+    hit = _AUTOTUNE_CACHE.get(_sig(seq_q, seq_k, d, dtype, which))
+    if hit:
+        return _pick_block(hit[0], seq_q), _pick_block(hit[1], seq_k)
+    return _pick_block(BLOCK_Q, seq_q), _pick_block(BLOCK_K, seq_k)
+
+
+def autotune(batch_heads, seq_q, seq_k, d, dtype=jnp.bfloat16,
+             causal=True, candidates=(128, 256, 512), iters=3):
+    """Sweep (bq, bk) for this shape signature on the current device and
+    cache the winner (in process + on disk). Returns (bq, bk).
+
+    The sweep times the FULL fwd+bwd step — the backward kernel has a
+    different VMEM profile (full-seq dq accumulator), so a forward-only
+    winner could regress training. Run once eagerly before compiling the
+    training step; subsequent traces with matching shapes pick the tuned
+    blocks.
+    """
+    import time
+
+    if _interpret():
+        return _blocks_for(seq_q, seq_k, d, dtype)
+    _load_cache()
+    key = jax.random.PRNGKey(0)
+    q = jax.random.normal(key, (batch_heads, seq_q, d), dtype)
+    k = jax.random.normal(key, (batch_heads, seq_k, d), dtype)
+    v = jax.random.normal(key, (batch_heads, seq_k, d), dtype)
+    sig_f = _sig(seq_q, seq_k, d, dtype, "fwd")
+    sig_b = _sig(seq_q, seq_k, d, dtype, "bwd")
+    saved = (_AUTOTUNE_CACHE.get(sig_f), _AUTOTUNE_CACHE.get(sig_b))
+    best, best_t = None, float("inf")
+    scale = 1.0 / math.sqrt(d)
+    for bq in candidates:
+        if seq_q % min(bq, seq_q):
+            continue
+        for bk in candidates:
+            if seq_k % min(bk, seq_k):
+                continue
+            cand = [min(bq, seq_q), min(bk, seq_k)]
+            _AUTOTUNE_CACHE[sig_f] = cand
+            _AUTOTUNE_CACHE[sig_b] = cand
+            try:
+                # fresh closure per candidate: jit caches on function
+                # identity, and the blocks are read from the cache at trace
+                step = jax.jit(lambda q, k, v: jax.value_and_grad(
+                    lambda q_: jnp.sum(
+                        _flash(q_, k, v, None, None, scale, causal, 1)
+                        .astype(jnp.float32)))(q))
+                loss, g = step(q, k, v)
+                g.block_until_ready()  # compile + warmup
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    loss, g = step(q, k, v)
+                g.block_until_ready()
+                t = time.perf_counter() - t0
+            except Exception:
+                continue
+            if t < best_t:
+                best, best_t = (bq, bk), t
+    if best is not None:
+        _AUTOTUNE_CACHE[sig_f] = list(best)
+        _AUTOTUNE_CACHE[sig_b] = list(best)
+        _save_cache()
+        return best
+    for s, val in zip((sig_f, sig_b), saved):  # no candidate ran: restore
+        if val is None:
+            _AUTOTUNE_CACHE.pop(s, None)
+        else:
+            _AUTOTUNE_CACHE[s] = val
+    return _blocks_for(seq_q, seq_k, d, dtype)
+
+
 NEG_INF = -1e30
 LSE_INVALID = 1e30  # lse for rows with no valid key: exp(s - BIG) == 0 in bwd
 
@@ -191,11 +316,10 @@ def _mask_spec_bwd(hq, bm, hm, sqm, seq_q, bkb):
     return pl.BlockSpec((1, 1, 1 if sqm == 1 else seq_q, bkb), imap)
 
 
-def _flash_fwd_impl(q, k, v, mask, lens, scale, causal, hq):
+def _flash_fwd_impl(q, k, v, mask, lens, scale, causal, hq, blocks=None):
     bhq, seq, d = q.shape
     group = bhq // k.shape[0]
-    bq = _pick_block(BLOCK_Q, seq)
-    bk = _pick_block(BLOCK_K, k.shape[1])
+    bq, bk = blocks or _blocks_for(seq, k.shape[1], d, q.dtype, 'fwd')
     grid = (bhq, seq // bq)
     has_mask = mask is not None
     has_lens = lens is not None
@@ -334,8 +458,7 @@ def flash_bwd_impl(q, k, v, g, lse, delta, scale, causal,
     bhq, seq, d = q.shape
     group = bhq // k.shape[0]
     seq_k = k.shape[1]
-    bq = _pick_block(BLOCK_Q, seq)
-    bkb = _pick_block(BLOCK_K, seq_k)
+    bq, bkb = _blocks_for(seq, seq_k, d, q.dtype, 'bwd')
     has_mask = mask is not None
     has_lens = lens is not None
     lse_spec_full = pl.BlockSpec((1, 1, seq), lambda b, j, *_: (b, 0, 0))

@@ -14,13 +14,21 @@ from ..autograd.engine import apply_op
 from . import array, creation, einsum, linalg, logic, manipulation, math, random, search, stat
 from .tensor import Parameter, Tensor, register_tensor_method
 from .array import array_length, array_read, array_write, create_array
+from .selected_rows import SelectedRows, merge_selected_rows
 
 __all__ = [
     "Tensor",
     "Parameter",
+    "SelectedRows",
+    "array",
+    "array_length",
+    "array_read",
+    "array_write",
+    "create_array",
     "creation",
     "math",
     "manipulation",
+    "merge_selected_rows",
     "logic",
     "linalg",
     "search",

@@ -1,0 +1,84 @@
+"""SelectedRows: sparse row-slice gradients (embedding updates).
+
+Reference: paddle/phi/core/selected_rows.h:27 — a TensorBase holding
+(rows, value, height) where ``value[i]`` is the data for global row
+``rows[i]``; produced by sparse embedding backward and consumed by
+merge_add / sgd-on-selected-rows kernels.
+
+TPU-native: XLA has no sparse-row buffer type — the idiomatic equivalent is
+(indices, values) pairs with ``segment_sum`` merges and ``scatter-add``
+application, which is exactly what this class wraps. The framework's
+embedding backward stays dense (XLA turns the one-hot matmul into a
+scatter), so SelectedRows here serves the API surface: row-slice
+accumulation, merge, and dense materialization.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .tensor import Tensor
+
+__all__ = ["SelectedRows", "merge_selected_rows"]
+
+
+def _data(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+class SelectedRows:
+    """(rows, value, height): value[i] is global row rows[i] of a
+    [height, *value.shape[1:]] dense tensor. Rows may repeat (unmerged
+    gradient contributions — reference merge_add semantics)."""
+
+    def __init__(self, rows, value, height: int):
+        self.rows = jnp.asarray(_data(rows), jnp.int32)
+        self.value = _data(value)
+        if self.rows.ndim != 1:
+            raise ValueError(f"rows must be 1-D, got {self.rows.shape}")
+        if self.value.shape[0] != self.rows.shape[0]:
+            raise ValueError(
+                f"value rows {self.value.shape[0]} != len(rows) "
+                f"{self.rows.shape[0]}")
+        self.height = int(height)
+
+    @property
+    def shape(self):
+        return (self.height, *self.value.shape[1:])
+
+    def has_duplicates(self) -> bool:
+        return bool(jnp.unique(self.rows).shape[0] < self.rows.shape[0])
+
+    def merge(self) -> "SelectedRows":
+        """Sum duplicate row contributions (reference
+        phi::funcs::MergeAdd). Rows come out sorted and unique."""
+        uniq, inv = jnp.unique(self.rows, return_inverse=True)
+        merged = jax.ops.segment_sum(
+            self.value, inv, num_segments=uniq.shape[0])
+        return SelectedRows(uniq, merged, self.height)
+
+    def to_dense(self) -> Tensor:
+        """Materialize the [height, ...] dense tensor (scatter-add)."""
+        dense = jnp.zeros(self.shape, self.value.dtype)
+        return Tensor(dense.at[self.rows].add(self.value))
+
+    def apply_to(self, param, lr: float = 1.0) -> Tensor:
+        """param - lr * grad for a SelectedRows grad — the reference's
+        sgd-on-selected-rows kernel (touches only the listed rows)."""
+        p = _data(param)
+        return Tensor(p.at[self.rows].add(-lr * self.value))
+
+    def __repr__(self):
+        return (f"SelectedRows(height={self.height}, "
+                f"nnz_rows={int(self.rows.shape[0])}, "
+                f"row_dim={self.value.shape[1:]})")
+
+
+def merge_selected_rows(x: SelectedRows) -> SelectedRows:
+    """Functional alias of :meth:`SelectedRows.merge` (reference
+    paddle.incubate merge_selected_rows op)."""
+    if not isinstance(x, SelectedRows):
+        raise TypeError(f"expected SelectedRows, got {type(x)}")
+    return x.merge()

@@ -310,3 +310,28 @@ def test_causal_bottom_right_unequal_seqlens(rng):
     for a, b_ in zip(gf, gn):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
                                    rtol=5e-3, atol=5e-3)
+
+
+def test_autotune_cache_roundtrip(tmp_path, monkeypatch):
+    """The block autotune cache persists per shape signature and
+    _blocks_for consults it at trace time (reference: phi autotune
+    cache.h). The sweep itself needs a real device; here the cache
+    plumbing is exercised directly."""
+    from paddle_tpu.ops.pallas import flash_attention as fa
+
+    monkeypatch.setenv("PADDLE_TPU_FLASH_AUTOTUNE",
+                       str(tmp_path / "cache.json"))
+    monkeypatch.setattr(fa, "_AUTOTUNE_CACHE", {})
+    monkeypatch.setattr(fa, "_AUTOTUNE_LOADED", [False])
+    # default (no cache entry)
+    assert fa._blocks_for(512, 512, 64, "bfloat16") == (
+        fa._pick_block(fa.BLOCK_Q, 512), fa._pick_block(fa.BLOCK_K, 512))
+    # write an entry, force a reload from disk, and see it honored
+    fa._AUTOTUNE_CACHE[fa._sig(512, 512, 64, "bfloat16", "fwd")] = [128, 512]
+    fa._save_cache()
+    monkeypatch.setattr(fa, "_AUTOTUNE_CACHE", {})
+    monkeypatch.setattr(fa, "_AUTOTUNE_LOADED", [False])
+    assert fa._blocks_for(512, 512, 64, "bfloat16") == (128, 512)
+    # cached preference shrinks to divide shorter sequences
+    assert fa._blocks_for(256, 256, 64, "bfloat16") == (
+        fa._pick_block(fa.BLOCK_Q, 256), fa._pick_block(fa.BLOCK_K, 256))

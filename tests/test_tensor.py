@@ -259,3 +259,28 @@ class TestTensorSurface:
         assert x.numpy().sum() == 4
         x.fill_(3.0)
         assert x.numpy().sum() == 12
+
+
+def test_selected_rows_merge_dense_apply(rng):
+    """SelectedRows (reference phi/core/selected_rows.h): duplicate-row
+    merge (MergeAdd), dense materialization, and row-sliced sgd apply."""
+    from paddle_tpu.tensor import SelectedRows, merge_selected_rows
+
+    rows = np.array([3, 1, 3, 0], "int32")
+    vals = rng.randn(4, 5).astype("float32")
+    sr = SelectedRows(rows, vals, height=6)
+    assert sr.shape == (6, 5)
+    assert sr.has_duplicates()
+    m = merge_selected_rows(sr)
+    assert not m.has_duplicates()
+    dense = np.zeros((6, 5), "float32")
+    for r, v in zip(rows, vals):
+        dense[r] += v
+    np.testing.assert_allclose(np.asarray(m.to_dense().numpy()), dense,
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(sr.to_dense().numpy()), dense,
+                               rtol=1e-6)
+    p = paddle.ones([6, 5])
+    out = sr.apply_to(p, lr=0.5)
+    np.testing.assert_allclose(np.asarray(out.numpy()), 1.0 - 0.5 * dense,
+                               rtol=1e-6)
