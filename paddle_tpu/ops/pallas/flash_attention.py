@@ -135,6 +135,13 @@ def autotune(batch_heads, seq_q, seq_k, d, dtype=jnp.bfloat16,
     winner could regress training. Run once eagerly before compiling the
     training step; subsequent traces with matching shapes pick the tuned
     blocks.
+
+    Caveat (measured, v5e): an ISOLATED-attention winner can still lose
+    inside a full train step where the kernel competes with surrounding
+    fusion/remat for VMEM — e.g. GPT-125M's isolated sweep picked
+    (256, 128) but the full step runs 12% faster at the hand-swept default
+    (256, 512). Treat autotune as a starting point and confirm against the
+    end-to-end step; delete the cache file to revert to defaults.
     """
     import time
 

@@ -173,3 +173,29 @@ def test_launch_auto_tuner_e2e(tmp_path):
     # every trial produced a record: metric or explicit error
     hist = (tdir / "history.csv").read_text()
     assert len(hist.strip().splitlines()) >= 2  # header + >=1 rows
+
+
+def test_memory_model_vs_measured_oom_boundary():
+    """The prune memory model must classify the two single-chip boundaries
+    measured on the real 16 GB v5e (bench.py round 3): GPT-760M bs8+remat
+    trains; GPT-1.3B bs4+remat exhausts memory without donated (single-
+    buffered) state. A model that misses either boundary would prune
+    runnable configs or schedule OOMing ones."""
+    from paddle_tpu.distributed.auto_tuner.prune import estimate_memory_gb
+    from paddle_tpu.distributed.auto_tuner.search import Candidate
+
+    single_chip = dict(dp_degree=1, mp_degree=1, pp_degree=1,
+                      sharding_degree=1, sharding_stage=1, use_recompute=True)
+    cfg_760m = {"num_layers": 24, "hidden_size": 1536, "vocab_size": 50304,
+                "seq_length": 1024, "num_heads": 12}
+    cfg_13b = {"num_layers": 24, "hidden_size": 2048, "vocab_size": 50304,
+               "seq_length": 1024, "num_heads": 16}
+    est_760m = estimate_memory_gb(
+        Candidate(micro_batch_size=8, **single_chip), cfg_760m)
+    est_13b = estimate_memory_gb(
+        Candidate(micro_batch_size=4, **single_chip), cfg_13b)
+    # measured: 760M fits a 16 GB chip, 1.3B does not (without donation)
+    assert est_760m < 16.0, f"model predicts {est_760m:.1f}GB for a config that runs"
+    assert est_13b > 16.0, f"model predicts {est_13b:.1f}GB for a config that OOMs"
+    # and the model is monotone in micro-batch between them
+    assert est_13b > est_760m
