@@ -1,5 +1,6 @@
 """fleet.meta_optimizers (dygraph subset — static meta-optimizers collapse
 into strategy-driven wrappers on TPU; SURVEY.md §2.7 meta-optimizer row)."""
+from .dgc_optimizer import DGCMomentumOptimizer
 from .dygraph_optimizer import (
     DygraphShardingOptimizer,
     GroupShardedOptimizerStage2,
@@ -8,6 +9,7 @@ from .dygraph_optimizer import (
 )
 
 __all__ = [
+    "DGCMomentumOptimizer",
     "DygraphShardingOptimizer",
     "GroupShardedOptimizerStage2",
     "HybridParallelOptimizer",

@@ -1,0 +1,116 @@
+"""DGC — deep gradient compression momentum optimizer.
+
+Reference: fleet/meta_optimizers/dgc_optimizer.py:32 (DGCMomentumOptimizer)
+and the dgc op pair (paddle/fluid/operators/dgc_op.h): local gradient
+accumulation with momentum correction (u, v buffers), top-k selection by
+magnitude threshold, momentum factor masking, residual kept locally, ramped
+sparsity schedule.
+
+TPU-native: the reference gates DGC to static-graph CUDA; here the SAME
+math runs define-by-run on any backend. The sparse all-reduce becomes a
+dense masked tensor (XLA collectives have no sparse encoding — on ICI the
+dense all-reduce of a mostly-zero tensor is bandwidth-equivalent to the
+reference's gather of (index, value) pairs at DGC's typical 99.9% sparsity
+only on slow networks, which is DGC's target regime; the MATH — what
+converges or not — is preserved exactly, and that is what the tests pin).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+
+class DGCMomentumOptimizer:
+    """Momentum SGD with deep gradient compression.
+
+    Before ``rampup_begin_step``: vanilla momentum. After: per-parameter
+    (u, v) accumulators implement momentum correction; only the top-k
+    largest-|v| entries (k from the ramped sparsity schedule) are applied
+    each step, the rest stay in v (residual accumulation); u and v are
+    masked at the selected positions (momentum factor masking).
+    """
+
+    def __init__(self, learning_rate, momentum, rampup_begin_step,
+                 rampup_step=1, sparsity=(0.999,), parameter_list=None,
+                 parameters=None, use_nesterov=False, grad_clip=None,
+                 num_trainers=None, regularization=None, name=None):
+        self._lr = learning_rate
+        self._momentum = float(momentum)
+        self._rampup_begin = int(rampup_begin_step)
+        self._rampup_step = max(1, int(rampup_step))
+        self._sparsity = list(sparsity)
+        self._params = list(parameters or parameter_list or [])
+        self._use_nesterov = bool(use_nesterov)
+        self._grad_clip = grad_clip
+        self._step = 0
+        self._u: dict = {}
+        self._v: dict = {}
+
+    def _current_sparsity(self) -> float:
+        if self._step < self._rampup_begin:
+            return 0.0
+        i = (self._step - self._rampup_begin) // self._rampup_step
+        return float(self._sparsity[min(i, len(self._sparsity) - 1)])
+
+    def step(self):
+        self._step += 1
+        lr = float(self._lr() if callable(self._lr) else self._lr)
+        sparsity = self._current_sparsity()
+        grads = {id(p): p.grad._data for p in self._params
+                 if p.grad is not None}
+        if self._grad_clip is not None and grads:
+            # clip operates on (param, grad Tensor) pairs (ClipGradBase
+            # contract) and returns the same structure
+            from ....tensor.tensor import Tensor as _T
+
+            pairs = [(p, _T(grads[id(p)])) for p in self._params
+                     if id(p) in grads]
+            for p, g_t in self._grad_clip(pairs):
+                grads[id(p)] = g_t._data
+        for p in self._params:
+            if id(p) not in grads:
+                continue
+            g = grads[id(p)]
+            u = self._u.get(id(p))
+            if u is None:
+                u = jnp.zeros_like(g)
+                self._v[id(p)] = jnp.zeros_like(g)
+            v = self._v[id(p)]
+            if sparsity <= 0.0:  # pre-rampup: plain momentum SGD
+                u = self._momentum * u + g
+                upd = (g + self._momentum * u) if self._use_nesterov else u
+                p._data = p._data - lr * upd
+                self._u[id(p)] = u
+                continue
+            # momentum correction: accumulate momentum locally, then the
+            # residual buffer v collects what has not been applied yet
+            u = self._momentum * u + g
+            if self._use_nesterov:
+                # nesterov correction feeds the residual the lookahead
+                # update (reference dgc_op.h use_nesterov branch)
+                v = v + g + self._momentum * u
+            else:
+                v = v + u
+            k = max(1, int(round(v.size * (1.0 - sparsity))))
+            absv = jnp.abs(v).reshape(-1)
+            thr = jnp.sort(absv)[-k]
+            mask = (jnp.abs(v) >= thr).astype(v.dtype)
+            applied = v * mask
+            # momentum factor masking: selected positions reset in u AND v
+            u = u * (1.0 - mask)
+            v = v * (1.0 - mask)
+            p._data = p._data - lr * applied
+            self._u[id(p)] = u
+            self._v[id(p)] = v
+
+    def clear_grad(self):
+        for p in self._params:
+            p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, *a, **k):
+        loss.backward()
+        self.step()
+        return None, None

@@ -69,6 +69,11 @@ define_flag("check_nan_inf", False, "check outputs of every op for nan/inf")
 define_flag("check_nan_inf_level", 0, "0: abort on nan/inf; >=1: log only")
 define_flag("low_precision_op_list", 0, "collect low-precision op call stats")
 define_flag("use_stride_kernel", True, "enable view/stride ops where possible")
+define_flag("eager_op_cache", False,
+            "cache ONE jitted executable per (op, signature) for eager "
+            "dispatch: composite ops cost one device dispatch instead of "
+            "one per jnp call; backward recomputes forward inside the "
+            "cached vjp (remat semantics)")
 define_flag("flash_attention_min_seq", 512,
             "min sequence length to route attention onto the Pallas flash "
             "kernel; shorter sequences use the fused XLA path (faster below "

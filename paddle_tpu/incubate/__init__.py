@@ -1,9 +1,10 @@
 """paddle.incubate parity (SURVEY.md §2.8): experimental fused layers/ops.
 
 Subset shipped: fused transformer layers (nn), fused functional ops,
-softmax_mask_fuse, segment ops. The reference's incubate also carries asp/
-autograd-prim/jit-inference experiments — their stable equivalents live in
-the main packages here (XLA handles decomposition; jit is paddle_tpu.jit).
+softmax_mask_fuse, segment ops, asp (n:m structured sparsity). The
+reference's incubate also carries autograd-prim/jit-inference experiments —
+their stable equivalents live in the main packages here (XLA handles
+decomposition; jit is paddle_tpu.jit).
 """
 from __future__ import annotations
 
@@ -11,7 +12,7 @@ import jax
 import jax.numpy as jnp
 
 from ..autograd.engine import apply_op
-from . import nn
+from . import asp, nn
 
 
 def softmax_mask_fuse(x, mask, name=None):

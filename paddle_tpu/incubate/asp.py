@@ -1,0 +1,121 @@
+"""ASP — automatic structured (n:m) sparsity.
+
+Reference: python/paddle/incubate/asp/asp.py (prune_model :302, decorate
+:216, set/reset_excluded_layers :40/:127) + utils.py mask kernels
+(get_mask_1d :184, check_mask_1d :134).
+
+TPU-native: the n:m mask is computed with one vectorized top-n-per-group
+select (no python loop over groups), masks are applied by elementwise
+multiply (dense math — the MXU has no sparse path, so as with the
+reference's non-sparse-kernel fallback the benefit is model compression /
+accuracy research, not FLOPs), and the decorated optimizer re-applies each
+parameter's mask after every step (the reference's OpRole.Optimize masking
+pass).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..tensor.tensor import Tensor
+
+_EXCLUDED: set = set()
+_MASKS: dict = {}  # id(param) -> (param, mask jnp array)
+
+
+def set_excluded_layers(param_names, main_program=None):
+    """Exclude parameters (by name) from pruning (reference :40)."""
+    _EXCLUDED.update(param_names)
+
+
+def reset_excluded_layers(main_program=None):
+    _EXCLUDED.clear()
+
+
+def get_mask_1d(mat, n: int, m: int):
+    """n:m mask along the last axis: keep the n largest |values| of every
+    group of m (reference utils.py:184, vectorized)."""
+    arr = jnp.asarray(mat._data if isinstance(mat, Tensor) else mat)
+    shape = arr.shape
+    flat = arr.reshape(-1, m)
+    order = jnp.argsort(jnp.abs(flat), axis=-1)  # ascending
+    ranks = jnp.argsort(order, axis=-1)  # rank of each element
+    mask = (ranks >= (m - n)).astype(arr.dtype)
+    return mask.reshape(shape)
+
+
+def check_mask_1d(mat, n: int, m: int) -> bool:
+    """True when every group of m along the last axis has <= n nonzeros
+    (reference utils.py:134)."""
+    arr = np.asarray(mat._data if isinstance(mat, Tensor) else mat)
+    if arr.size % m:
+        return False
+    nnz = (arr.reshape(-1, m) != 0).sum(axis=-1)
+    return bool((nnz <= n).all())
+
+
+def calculate_density(mat) -> float:
+    arr = np.asarray(mat._data if isinstance(mat, Tensor) else mat)
+    return float((arr != 0).mean())
+
+
+def _prunable(name: str, p, m: int) -> bool:
+    if name in _EXCLUDED or any(name.endswith(e) for e in _EXCLUDED):
+        return False
+    d = p._data
+    # reference prunes 2-D multiplicand weights with n:m-compatible cols;
+    # the LAST axis must divide m so groups never straddle rows
+    return d.ndim == 2 and d.shape[-1] % m == 0 and not p.stop_gradient
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """Apply n:m masks to every prunable weight IN PLACE and register the
+    masks so :func:`decorate`'d optimizers keep sparsity (reference :302).
+    Returns {param_name: mask}."""
+    if mask_algo not in ("mask_1d", "mask_2d_greedy", "mask_2d_best"):
+        raise ValueError(f"unknown mask_algo {mask_algo!r}")
+    masks = {}
+    for name, p in model.named_parameters():
+        if not _prunable(name, p, m):
+            continue
+        mask = get_mask_1d(p, n, m)
+        p._data = p._data * mask
+        if with_mask:
+            _MASKS[id(p)] = (p, mask)
+        masks[name] = Tensor(mask)
+    return masks
+
+
+def clear_masks():
+    """Drop all registered masks (e.g. between models in one process) —
+    also releases the strong parameter references they hold."""
+    _MASKS.clear()
+
+
+class ASPOptimizer:
+    """Mask-preserving optimizer wrapper (reference OptimizerWithSparsity
+    via asp.decorate :216): after every inner step, re-applies each pruned
+    parameter's mask so updates cannot resurrect pruned weights."""
+
+    def __init__(self, optimizer):
+        self._inner_opt = optimizer
+
+    def step(self):
+        self._inner_opt.step()
+        for p, mask in _MASKS.values():
+            p._data = p._data * mask
+
+    def minimize(self, loss, *a, **k):
+        # must route through OUR step (the inner minimize would call the
+        # inner step and skip mask re-application)
+        loss.backward()
+        self.step()
+        return None, None
+
+    def __getattr__(self, name):
+        return getattr(self._inner_opt, name)
+
+
+def decorate(optimizer):
+    return ASPOptimizer(optimizer)

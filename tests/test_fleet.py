@@ -698,3 +698,61 @@ class TestZeroOffloadAndMemory:
         # gathered temporaries stay bounded: well under the replicated
         # resident state the sharding saved
         assert z3_tmp < rep_arg, (z3_tmp, rep_arg)
+
+
+class TestDGCAndASP:
+    def test_dgc_momentum_math_and_residual(self, rng):
+        """DGC (reference dgc_optimizer.py:32 + dgc_op.h): pre-rampup is
+        plain momentum; post-rampup applies only top-k of the residual
+        buffer, keeps the rest, and masks u/v at selected positions — no
+        gradient information is lost, just deferred."""
+        from paddle_tpu.distributed.fleet.meta_optimizers import (
+            DGCMomentumOptimizer)
+
+        paddle.seed(77)
+        w = paddle.to_tensor(rng.randn(16, 8).astype("float32"))
+        w.stop_gradient = False
+        opt = DGCMomentumOptimizer(
+            learning_rate=0.05, momentum=0.9, rampup_begin_step=2,
+            sparsity=[0.75], parameters=[w])
+        x = paddle.to_tensor(rng.randn(32, 16).astype("float32"))
+        tgt = paddle.to_tensor(rng.randn(32, 8).astype("float32"))
+        losses = []
+        prev = np.asarray(w.numpy()).copy()
+        for i in range(12):
+            loss = ((paddle.matmul(x, w) - tgt) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+            cur = np.asarray(w.numpy())
+            delta = cur - prev
+            if i >= 2:  # post-rampup: sparse updates (~25% of entries)
+                frac = (np.abs(delta) > 0).mean()
+                assert frac <= 0.30, f"step {i}: update density {frac}"
+            prev = cur.copy()
+        assert losses[-1] < losses[0], losses  # converges despite sparsity
+
+    def test_asp_2_4_pruning_and_mask_preserving_step(self, rng):
+        """ASP (reference incubate/asp): 2:4 mask along the input dim,
+        density 0.5, and the decorated optimizer cannot resurrect pruned
+        weights."""
+        from paddle_tpu.incubate import asp
+
+        paddle.seed(78)
+        net = nn.Linear(16, 8)
+        masks = asp.prune_model(net, n=2, m=4)
+        assert masks, "no parameters pruned"
+        wname = next(iter(masks))
+        assert asp.check_mask_1d(net.weight, 2, 4)
+        assert abs(asp.calculate_density(net.weight) - 0.5) < 1e-6
+        opt = asp.decorate(paddle.optimizer.SGD(
+            0.1, parameters=net.parameters()))
+        x = paddle.to_tensor(rng.randn(4, 16).astype("float32"))
+        loss = (net(x) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        # sparsity survived the update
+        assert asp.check_mask_1d(net.weight, 2, 4)
+        asp.reset_excluded_layers()
