@@ -250,7 +250,8 @@ def apply_op(name: str, fn: Callable, *args, **kwargs):
             isinstance(l._data, jax.core.Tracer) for l in leaves
             if isinstance(l, Tensor))
         if not tracer:
-            entry, arg_pos = _cached_entry(name, fn, leaves, treedef, diff_pos)
+            entry, arg_pos, cache_key = _cached_entry(
+                name, fn, leaves, treedef, diff_pos)
             cache_hit = entry is not None
 
     node = None
@@ -262,31 +263,43 @@ def apply_op(name: str, fn: Callable, *args, **kwargs):
                     else leaves[p].key
                     for p in arg_pos
                 ]
-                out_flat = entry.fwd(arg_datas)
-                out_treedef_box[0] = entry.out_treedef
-                if diff_pos:
-                    out_avals = [jax.ShapeDtypeStruct(o.shape, o.dtype)
-                                 for o in out_flat]
-                    didx = entry.diff_arg_idx
+                try:
+                    out_flat = entry.fwd(arg_datas)
+                except (jax.errors.TracerArrayConversionError,
+                        jax.errors.ConcretizationTypeError,
+                        jax.errors.TracerIntegerConversionError,
+                        jax.errors.TracerBoolConversionError,
+                        jax.errors.NonConcreteBooleanIndexError):
+                    # op body needs concrete values (data-dependent shapes /
+                    # host math): blacklist this signature, run uncached
+                    _EAGER_CACHE[cache_key] = False
+                    cache_hit = False
+                if cache_hit:
+                    out_treedef_box[0] = entry.out_treedef
+                    if diff_pos:
+                        out_avals = [jax.ShapeDtypeStruct(o.shape, o.dtype)
+                                     for o in out_flat]
+                        didx = entry.diff_arg_idx
 
-                    def vjp_fn(cots, _e=entry, _a=arg_datas):
-                        return _e.vjp(_a, list(cots))
+                        def vjp_fn(cots, _e=entry, _a=arg_datas):
+                            return _e.vjp(_a, list(cots))
 
-                    def pure_fn_c(*diff_datas, _e=entry, _a=arg_datas,
-                                  _d=didx):
-                        full = list(_a)
-                        for j, d in zip(_d, diff_datas):
-                            full[j] = d
-                        return _e.fwd(full)
+                        def pure_fn_c(*diff_datas, _e=entry, _a=arg_datas,
+                                      _d=didx):
+                            full = list(_a)
+                            for j, d in zip(_d, diff_datas):
+                                full[j] = d
+                            return _e.fwd(full)
 
-                    node = GradNode(name, vjp_fn, pure_fn_c,
-                                    [leaves[p] for p in diff_pos], out_avals)
-            elif diff_pos:
+                        node = GradNode(name, vjp_fn, pure_fn_c,
+                                        [leaves[p] for p in diff_pos],
+                                        out_avals)
+            if not cache_hit and diff_pos:
                 diff_datas = [leaves[p]._data for p in diff_pos]
                 out_flat, vjp_fn = jax.vjp(pure_fn, *diff_datas)
                 out_avals = [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in out_flat]
                 node = GradNode(name, vjp_fn, pure_fn, [leaves[p] for p in diff_pos], out_avals)
-            else:
+            elif not cache_hit:
                 out_flat = pure_fn()
     finally:
         # record the range even when dispatch raises — the failing op is
@@ -371,17 +384,93 @@ def _leaf_sig(leaves, diff_set):
     return tuple(sig)
 
 
+def _fn_sig(fn, depth=0):
+    """Identity of ``fn``'s BEHAVIOR: its code object plus the values it
+    closes over. Op wrappers build a fresh closure per call (``x[idx]``,
+    conv with stride/padding) — the closed-over config MUST be part of the
+    cache key or two calls with equal tensor signatures but different
+    config would share one compiled program. Unhashable cell contents
+    (arrays) disable caching; nested function cells key by their own
+    behavior signature (depth-limited)."""
+    import types
+
+    if not isinstance(fn, types.FunctionType):
+        # bound methods, functools.partial, jax custom_vjp wrappers: key by
+        # identity when hashable (stable for module-level callables)
+        try:
+            hash(fn)
+        except TypeError:
+            return None
+        return ("obj", fn)
+
+    def canon(v, d=0):
+        # canonicalize common config containers (conv padding is a list of
+        # tuples, interpolate sizes are lists) into hashable tuples
+        if isinstance(v, types.FunctionType):
+            if d >= 2:
+                return None
+            sub = _fn_sig(v, d + 1)
+            return None if sub is None else ("F", sub)
+        if isinstance(v, (list, tuple)):
+            items = []
+            for it in v:
+                ci = canon(it, d + 1)
+                if ci is None and it is not None:
+                    return None
+                items.append(ci)
+            return ("L", tuple(items))
+        if isinstance(v, dict):
+            try:
+                entries = sorted(v.items())
+            except TypeError:
+                return None
+            out = []
+            for k, it in entries:
+                ci = canon(it, d + 1)
+                if ci is None and it is not None:
+                    return None
+                out.append((k, ci))
+            return ("D", tuple(out))
+        try:
+            hash(v)
+        except TypeError:
+            return None
+        return v
+
+    cells = []
+    if fn.__closure__:
+        for c in fn.__closure__:
+            try:
+                v = c.cell_contents
+            except ValueError:
+                return None  # unfilled cell
+            cv = canon(v)
+            if cv is None and v is not None:
+                return None
+            cells.append(cv)
+    return (fn.__code__, tuple(cells))
+
+
 def _cached_entry(name, fn, leaves, treedef, diff_pos):
-    """Build (or fetch) the jitted fwd/vjp executables for this signature."""
+    """(entry, arg positions, cache key) for this signature — or Nones."""
     from ..framework.random import RngKey
     from ..tensor.tensor import Tensor
 
     diff_set = frozenset(diff_pos)
     sig = _leaf_sig(leaves, diff_set)
     if sig is None:
-        return None, None
-    key = (name, treedef, sig)
+        return None, None, None
+    fsig = _fn_sig(fn)
+    if fsig is None:
+        return None, None, None
+    key = (name, fsig, treedef, sig)
     entry = _EAGER_CACHE.get(key)
+    if entry is False:  # blacklisted: op body needs concrete values
+        return None, None, None
+    if entry is None and len(_EAGER_CACHE) >= 4096:
+        # bounded cache: drop the oldest entries (insertion order)
+        for old in list(_EAGER_CACHE)[:1024]:
+            del _EAGER_CACHE[old]
     arg_pos = [i for i, l in enumerate(leaves)
                if isinstance(l, (Tensor, RngKey))]
     if entry is None:
@@ -418,4 +507,4 @@ def _cached_entry(name, fn, leaves, treedef, diff_pos):
 
             entry.vjp = jax.jit(vjp_all)
         _EAGER_CACHE[key] = entry
-    return entry, arg_pos
+    return entry, arg_pos, key

@@ -1,29 +1,51 @@
 """paddle.onnx parity surface.
 
 Reference: python/paddle/onnx/export.py — a thin wrapper delegating to the
-external ``paddle2onnx`` package. This environment ships no onnx runtime or
-exporter (and has no network egress to fetch one), so ``export`` gates with
-a clear error pointing at the portable serving format this framework does
-ship: serialized StableHLO via ``paddle_tpu.jit.save`` /
-``paddle_tpu.static.save_inference_model`` (consumed by
-``paddle_tpu.inference.Predictor`` and any StableHLO-speaking runtime).
+external ``paddle2onnx`` package. This environment ships no onnx package
+(and has no egress to fetch one), so ``export`` produces the portable
+serving artifact this framework DOES ship — serialized StableHLO via
+``paddle_tpu.jit.save`` (consumed by ``paddle_tpu.inference.Predictor``
+and any StableHLO-speaking runtime) — and says so loudly. Pass
+``fallback_format=None`` to get a hard error instead of the fallback.
 """
 from __future__ import annotations
 
+import warnings
 
-def export(layer, path, input_spec=None, opset_version=9, **configs):
+
+def export(layer, path, input_spec=None, opset_version=9,
+           fallback_format="stablehlo", **configs):
+    """Export ``layer`` for serving.
+
+    With the ``onnx`` package absent (this build), writes the StableHLO
+    program + weights at ``path`` (same artifact as ``jit.save``) and
+    returns the path prefix; the produced files load with
+    ``paddle_tpu.jit.load`` / ``inference.Predictor``.
+    """
     try:
         import onnx  # noqa: F401
+        have_onnx = True
     except ImportError:
+        have_onnx = False
+    if have_onnx:
+        raise NotImplementedError(
+            "ONNX graph emission is not implemented; export via jit.save "
+            "(StableHLO) for deployment.")
+    if fallback_format != "stablehlo":
         raise RuntimeError(
             "paddle_tpu.onnx.export requires the 'onnx' package, which is "
-            "not available in this build. Use paddle_tpu.jit.save(layer, "
-            "path, input_spec=...) to produce a portable serialized-"
-            "StableHLO program instead (loadable by paddle_tpu.inference."
-            "Predictor or any StableHLO runtime).")
-    raise NotImplementedError(
-        "ONNX graph emission is not implemented; export via jit.save "
-        "(StableHLO) for deployment.")
+            "not available in this build, and fallback_format=None disabled "
+            "the StableHLO fallback. Use paddle_tpu.jit.save directly.")
+    warnings.warn(
+        "onnx package unavailable: paddle_tpu.onnx.export is writing the "
+        "portable serialized-StableHLO artifact instead (load with "
+        "paddle_tpu.jit.load / inference.Predictor)", stacklevel=2)
+    from .jit import save as jit_save
+
+    if path.endswith(".onnx"):
+        path = path[: -len(".onnx")]
+    jit_save(layer, path, input_spec=input_spec)
+    return path
 
 
 __all__ = ["export"]

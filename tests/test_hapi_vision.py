@@ -150,8 +150,9 @@ def test_onnx_export_gates_with_guidance():
 
     import paddle_tpu
 
+    # fallback disabled -> gating error naming the alternative
     with pytest.raises(RuntimeError, match="jit.save"):
-        paddle_tpu.onnx.export(None, "/tmp/x")
+        paddle_tpu.onnx.export(None, "/tmp/x", fallback_format=None)
 
 
 def test_paddle_flops_counts_linear_and_conv():
