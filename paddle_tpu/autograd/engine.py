@@ -380,7 +380,10 @@ def _leaf_sig(leaves, diff_set):
                 hash(l)
             except TypeError:
                 return None  # unhashable python leaf: fall back to uncached
-            sig.append(("P", l))
+            # type(l) is part of the key: 0 == 0.0 == False under dict
+            # lookup, but full(shape, 1) and full(shape, True) trace to
+            # different dtypes (jax.jit keys weak-typed scalars the same way)
+            sig.append(("P", type(l), l))
     return tuple(sig)
 
 
@@ -406,6 +409,14 @@ def _fn_sig(fn, depth=0):
     def canon(v, d=0):
         # canonicalize common config containers (conv padding is a list of
         # tuples, interpolate sizes are lists) into hashable tuples
+        from ..tensor.tensor import Tensor
+
+        if isinstance(v, Tensor):
+            # Tensor hashes by identity but its _data can be mutated in
+            # place (optimizer update, set_value) after the executable baked
+            # the traced value as a constant — caching would serve stale
+            # results. Disable caching for Tensor-capturing closures.
+            return None
         if isinstance(v, types.FunctionType):
             if d >= 2:
                 return None
@@ -435,7 +446,9 @@ def _fn_sig(fn, depth=0):
             hash(v)
         except TypeError:
             return None
-        return v
+        # wrap with the concrete type so 2 / 2.0 / True closure configs do
+        # not collide under dict ==-lookup (same rationale as _leaf_sig)
+        return ("V", type(v), v)
 
     cells = []
     if fn.__closure__:

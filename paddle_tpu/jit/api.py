@@ -223,8 +223,13 @@ class _ConcreteProgram:
             const_out = {i: l for i, l in enumerate(out_leaves) if not _is_arraylike(l)}
             out_info[0] = (out_td, arr_pos, const_out)
             main = tuple(_leaf_data(out_leaves[i]) for i in arr_pos)
-            bufs = tuple(
-                _leaf_data(new_state[n]) for n in buf_names if n in new_state)
+            # Record the EMITTED buffer list (pure filters to names present
+            # in new_state): __call__ zips buf_names against the program's
+            # buffer outputs, so the two must be the same list or writeback
+            # silently lands on the wrong buffers.
+            emitted = [n for n in buf_names if n in new_state]
+            self.buf_names = emitted
+            bufs = tuple(_leaf_data(new_state[n]) for n in emitted)
             return main + bufs
 
         self.fn = jax.jit(pure)
@@ -362,6 +367,15 @@ class StaticFunction:
             # write updated buffers (BN running stats) back into the layer
             buf_outs = outs[len(arr_pos):]
             outs = outs[:len(arr_pos)]
+            if len(buf_outs) != len(prog.buf_names):
+                # buf_names comes from the LAST retrace; a program whose
+                # emitted-buffer set varies across shape signatures would
+                # misalign writeback — fail loudly instead
+                raise RuntimeError(
+                    f"to_static: program emitted {len(buf_outs)} buffer "
+                    f"outputs but the last trace recorded "
+                    f"{len(prog.buf_names)} buffer names; buffer emission "
+                    "must be trace-invariant")
             for n, t in zip(prog.buf_names, buf_outs):
                 target = state[n]
                 target._data = t._data.astype(target._data.dtype)

@@ -45,12 +45,19 @@ def _ceil_out_extra(L, k, s, p0, p1, ceil_mode):
     return out, max(0, (out - 1) * s + k - (L + p0 + p1))
 
 
+def _ceil_extras(S, kernel, stride, padding):
+    """Per-dim extra right padding a ceil_mode window grid needs beyond the
+    user padding — the single source for both the window pads and the
+    include-pad divisor (which must NOT count the extra)."""
+    return [_ceil_out_extra(S[d], kernel[d], stride[d], p0, p1, True)[1]
+            for d, (p0, p1) in enumerate(padding)]
+
+
 def _ceil_spatial(padding, v, n, kernel, stride, channel_last):
     """Per-dim (left, right+extra) pad pairs implementing ceil_mode."""
     S = v.shape[1:1 + n] if channel_last else v.shape[2:2 + n]
-    return [
-        (p0, p1 + _ceil_out_extra(S[d], kernel[d], stride[d], p0, p1, True)[1])
-        for d, (p0, p1) in enumerate(padding)]
+    extras = _ceil_extras(S, kernel, stride, padding)
+    return [(p0, p1 + e) for (p0, p1), e in zip(padding, extras)]
 
 
 def _window_config(v, kernel, stride, padding, n, channel_last, ceil_mode):
@@ -142,6 +149,25 @@ def _avg_pool(x, kernel, stride, pad, n, channel_last, exclusive, name, divisor_
         if exclusive:
             ones = jnp.ones_like(v)
             counts = jax.lax.reduce_window(ones, jnp.asarray(0, v.dtype), jax.lax.add, dims, strides, pads)
+            return summed / counts
+        if ceil_mode and not isinstance(padding, str):
+            # include-pad counts cover input + USER padding but not the ceil
+            # extra (reference phi pooling clips include-pad windows to the
+            # user-padded extent): pad a ones tensor over the user padding
+            # and reduce with only the ceil extras as window padding.
+            S = v.shape[1:1 + n] if channel_last else v.shape[2:2 + n]
+            extras = _ceil_extras(S, kernel, stride, padding)
+            z = [(0, 0)]
+            ep = [(0, e) for e in extras]
+            if channel_last:
+                widths, epads = z + list(padding) + z, z + ep + z
+            else:
+                widths, epads = z + z + list(padding), z + z + ep
+            ones = jnp.ones(
+                [s + a + b for s, (a, b) in zip(v.shape, widths)], v.dtype)
+            counts = jax.lax.reduce_window(
+                ones, jnp.asarray(0, v.dtype), jax.lax.add, dims, strides,
+                epads)
             return summed / counts
         return summed / np.prod(kernel)
 

@@ -2,11 +2,11 @@
 
 Reference: python/paddle/onnx/export.py — a thin wrapper delegating to the
 external ``paddle2onnx`` package. This environment ships no onnx package
-(and has no egress to fetch one), so ``export`` produces the portable
-serving artifact this framework DOES ship — serialized StableHLO via
+(and has no egress to fetch one), so ``export`` hard-errors by default.
+Passing ``fallback_format="stablehlo"`` opts in to the portable serving
+artifact this framework DOES ship — serialized StableHLO via
 ``paddle_tpu.jit.save`` (consumed by ``paddle_tpu.inference.Predictor``
-and any StableHLO-speaking runtime) — and says so loudly. Pass
-``fallback_format=None`` to get a hard error instead of the fallback.
+and any StableHLO-speaking runtime).
 """
 from __future__ import annotations
 
@@ -14,13 +14,16 @@ import warnings
 
 
 def export(layer, path, input_spec=None, opset_version=9,
-           fallback_format="stablehlo", **configs):
+           fallback_format=None, **configs):
     """Export ``layer`` for serving.
 
-    With the ``onnx`` package absent (this build), writes the StableHLO
-    program + weights at ``path`` (same artifact as ``jit.save``) and
-    returns the path prefix; the produced files load with
-    ``paddle_tpu.jit.load`` / ``inference.Predictor``.
+    With the ``onnx`` package absent (this build), raises by default — a
+    downstream ONNX consumer handed .pdmodel/.pdiparams.npz files would
+    fail much later with a worse error. Pass
+    ``fallback_format="stablehlo"`` to opt in to writing the StableHLO
+    program + weights at ``path`` (same artifact as ``jit.save``); the
+    produced files load with ``paddle_tpu.jit.load`` /
+    ``inference.Predictor``.
     """
     try:
         import onnx  # noqa: F401
@@ -34,8 +37,9 @@ def export(layer, path, input_spec=None, opset_version=9,
     if fallback_format != "stablehlo":
         raise RuntimeError(
             "paddle_tpu.onnx.export requires the 'onnx' package, which is "
-            "not available in this build, and fallback_format=None disabled "
-            "the StableHLO fallback. Use paddle_tpu.jit.save directly.")
+            "not available in this build. Pass fallback_format='stablehlo' "
+            "to write the serialized-StableHLO serving artifact instead, "
+            "or use paddle_tpu.jit.save directly.")
     warnings.warn(
         "onnx package unavailable: paddle_tpu.onnx.export is writing the "
         "portable serialized-StableHLO artifact instead (load with "

@@ -75,24 +75,25 @@ def test_predictor_missing_input_errors(tmp_path, rng):
 
 
 def test_onnx_export_fallback_artifact(tmp_path, rng):
-    """onnx.export without the onnx package writes the StableHLO artifact
-    (reference delegates to the external paddle2onnx the same way this
-    delegates to jit.save) and warns; the result loads and matches."""
+    """onnx.export without the onnx package hard-errors by DEFAULT (a
+    downstream ONNX consumer would fail much later on StableHLO files);
+    opting in via fallback_format='stablehlo' writes the jit.save artifact
+    with a warning, and the result loads and matches."""
     import warnings
 
     import pytest
 
     paddle.seed(4)
     net = nn.Linear(4, 2)
+    with pytest.raises(RuntimeError, match="stablehlo"):
+        paddle.onnx.export(net, str(tmp_path / "m2.onnx"),
+                           input_spec=[InputSpec([3, 4], "float32")])
     with warnings.catch_warnings(record=True) as w:
         warnings.simplefilter("always")
         p = paddle.onnx.export(net, str(tmp_path / "m.onnx"),
-                               input_spec=[InputSpec([3, 4], "float32")])
+                               input_spec=[InputSpec([3, 4], "float32")],
+                               fallback_format="stablehlo")
         assert any("StableHLO" in str(x.message) for x in w)
     loaded = paddle.jit.load(p)
     x = paddle.to_tensor(rng.randn(3, 4).astype("float32"))
     np.testing.assert_allclose(loaded(x).numpy(), net(x).numpy(), rtol=1e-5)
-    with pytest.raises(RuntimeError):
-        paddle.onnx.export(net, str(tmp_path / "m2.onnx"),
-                           input_spec=[InputSpec([3, 4], "float32")],
-                           fallback_format=None)

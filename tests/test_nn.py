@@ -315,3 +315,12 @@ def test_pool2d_ceil_mode_matches_torch(ceil, rng):
                            exclusive=True).numpy()
         assert out.shape == ref.shape
         np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+        # include-pad counting: user padding counts, the ceil extra must not
+        # (advisor r3: edge windows divided by prod(kernel) came out small)
+        ref = torch.nn.functional.avg_pool2d(
+            torch.tensor(x), k, s, p, ceil_mode=ceil,
+            count_include_pad=True).numpy()
+        out = F.avg_pool2d(paddle.to_tensor(x), k, s, p, ceil_mode=ceil,
+                           exclusive=False).numpy()
+        assert out.shape == ref.shape
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
