@@ -51,11 +51,22 @@ class DistributedStrategy:
         # gradient merge
         self.gradient_merge = False
         self.gradient_merge_configs = {"k_steps": 1, "avg": True}
-        # misc meta-optimizer toggles (static fleet parity)
+        # meta-optimizer toggles — every flag here is CONSUMED by
+        # fleet.distributed_optimizer's factory (meta_optimizer_factory.py);
+        # config dicts mirror the reference proto fields
         self.lamb = False
+        self.lamb_configs = {"lamb_weight_decay": 0.01,
+                             "exclude_from_weight_decay": []}
         self.lars = False
+        self.lars_configs = {"lars_coeff": 0.001,
+                             "lars_weight_decay": 0.0005,
+                             "epsilon": 0.0,
+                             "exclude_from_weight_decay": []}
         self.dgc = False
+        self.dgc_configs = {"rampup_begin_step": 0, "rampup_step": 1,
+                            "sparsity": [0.999]}
         self.localsgd = False
+        self.localsgd_configs = {"k_steps": 1, "begin_step": 1}
         self.fp16_allreduce = False
         self.find_unused_parameters = False
         self.tensor_parallel = False

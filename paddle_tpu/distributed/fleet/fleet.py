@@ -118,11 +118,19 @@ def distributed_model(model):
 
 
 def distributed_optimizer(optimizer, strategy=None):
+    """Compose strategy-selected meta-optimizers, then wrap for the hybrid
+    mesh (reference fleet.py distributed_optimizer → MetaOptimizerFactory;
+    every optimizer-level strategy flag is consumed or raises — no silent
+    ignores)."""
     hcg = _fleet_state["hcg"]
     if hcg is None:
         init(strategy=strategy)
         hcg = _fleet_state["hcg"]
-    return HybridParallelOptimizer(optimizer, hcg=hcg, strategy=_strategy())
+    from .meta_optimizers import apply_meta_optimizers
+
+    strat = strategy if strategy is not None else _strategy()
+    optimizer = apply_meta_optimizers(optimizer, strat, hcg=hcg)
+    return HybridParallelOptimizer(optimizer, hcg=hcg, strategy=strat)
 
 
 def distributed_scaler(scaler):

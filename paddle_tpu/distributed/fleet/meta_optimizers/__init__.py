@@ -1,5 +1,5 @@
-"""fleet.meta_optimizers (dygraph subset — static meta-optimizers collapse
-into strategy-driven wrappers on TPU; SURVEY.md §2.7 meta-optimizer row)."""
+"""fleet.meta_optimizers — strategy-driven wrappers picked by the factory
+(meta_optimizer_factory.apply_meta_optimizers; SURVEY.md §2.7 row)."""
 from .dgc_optimizer import DGCMomentumOptimizer
 from .dygraph_optimizer import (
     DygraphShardingOptimizer,
@@ -7,11 +7,21 @@ from .dygraph_optimizer import (
     HybridParallelGradScaler,
     HybridParallelOptimizer,
 )
+from .fp16_allreduce_optimizer import FP16AllReduceOptimizer
+from .gradient_merge_optimizer import GradientMergeOptimizer
+from .lars_optimizer import LarsMomentumOptimizer
+from .localsgd_optimizer import LocalSGDOptimizer
+from .meta_optimizer_factory import apply_meta_optimizers
 
 __all__ = [
     "DGCMomentumOptimizer",
     "DygraphShardingOptimizer",
+    "FP16AllReduceOptimizer",
+    "GradientMergeOptimizer",
     "GroupShardedOptimizerStage2",
     "HybridParallelOptimizer",
     "HybridParallelGradScaler",
+    "LarsMomentumOptimizer",
+    "LocalSGDOptimizer",
+    "apply_meta_optimizers",
 ]

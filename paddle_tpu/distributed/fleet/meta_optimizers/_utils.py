@@ -1,0 +1,30 @@
+"""Shared meta-optimizer helpers."""
+from __future__ import annotations
+
+
+def island_rows(p, group) -> int:
+    """nranks when ``p`` is laid out RANK-MAJOR over ``group``'s mesh axis
+    ("parameter islands": dim 0 = dp rank, placement Shard(0)), else 0.
+
+    Replicated global-view parameters return 0 — they are structurally in
+    sync (XLA already reduced their grads inside the compiled backward), so
+    island-only comm transforms (LocalSGD averaging, DGC sparse exchange)
+    must not touch them.
+    """
+    if group is None:
+        return 0
+    mesh = getattr(p, "_dist_mesh", None)
+    placements = getattr(p, "_placements", None)
+    if mesh is None or placements is None:
+        return 0
+    names = list(getattr(mesh, "dim_names", []) or [])
+    if group.axis_name not in names:
+        return 0
+    pl = placements[names.index(group.axis_name)]
+    is_shard = getattr(pl, "is_shard", None)
+    if is_shard is None or not is_shard(0):
+        return 0
+    data = getattr(p, "_data", None)
+    if data is None or data.ndim < 1 or data.shape[0] != group.nranks:
+        return 0
+    return group.nranks

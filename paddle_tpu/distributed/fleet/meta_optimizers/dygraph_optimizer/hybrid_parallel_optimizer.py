@@ -62,9 +62,19 @@ class HybridParallelOptimizer:
                 stage = int(cfg.get("stage", 1))
             cls = GroupShardedOptimizerStage2 if stage >= 2 else DygraphShardingOptimizer
             self._inner_opt = cls(optimizer, hcg=hcg)
-        clip = getattr(optimizer, "_grad_clip", None)
+        # Install the mesh-aware clip on the optimizer that OWNS _grad_clip:
+        # meta-optimizer wrappers (GradientMerge/LocalSGD/FP16AllReduce)
+        # forward reads via __getattr__, so a setattr on the wrapper would
+        # shadow the name while the inner step() kept the raw clip.
+        base = optimizer
+        while not hasattr(type(base), "step") or "_grad_clip" not in vars(base):
+            inner = getattr(base, "_inner_opt", None)
+            if inner is None:
+                break
+            base = inner
+        clip = getattr(base, "_grad_clip", None)
         if isinstance(clip, ClipGradByGlobalNorm):
-            optimizer._grad_clip = HybridParallelClipGrad(clip, hcg)
+            base._grad_clip = HybridParallelClipGrad(clip, hcg)
 
     def step(self):
         # dp(∪sep) grad allreduce (reference :475) is structural on TPU

@@ -1,0 +1,66 @@
+"""Gradient merge — accumulate k micro-step gradients, apply once.
+
+Reference: fleet/meta_optimizers/gradient_merge_optimizer.py (wraps the
+GradientMergeOptimizer of python/paddle/incubate/optimizer — accumulate
+``k_steps`` backward passes into persistent buffers, run the inner
+optimizer on the (optionally averaged) merged gradient, zero the buffers).
+
+TPU-native: the accumulation buffers are plain device arrays; the inner
+optimizer's fused jit update only runs on apply steps, so k merged steps
+cost k backwards + one update (the reference's skip is a cond in the
+program; here it is host control flow — eager dispatch, not inside jit).
+"""
+from __future__ import annotations
+
+
+class GradientMergeOptimizer:
+    def __init__(self, optimizer, k_steps: int = 1, avg: bool = True):
+        self._inner_opt = optimizer
+        self._k_steps = max(1, int(k_steps))
+        self._avg = bool(avg)
+        self._acc: dict = {}
+        self._micro = 0
+
+    def step(self):
+        from ....tensor.tensor import Tensor
+
+        self._micro += 1
+        params = self._inner_opt._parameter_list
+        for p in params:
+            if p.grad is None:
+                continue
+            buf = self._acc.get(id(p))
+            self._acc[id(p)] = p.grad._data if buf is None else buf + p.grad._data
+        if self._micro < self._k_steps:
+            # not an apply step: drop the per-step grads so the training
+            # loop's clear_grad/backward cycle keeps accumulating into _acc
+            for p in params:
+                p.clear_grad()
+            return
+        for p in params:
+            buf = self._acc.get(id(p))
+            if buf is None:
+                continue
+            p.grad = Tensor(buf / self._k_steps if self._avg else buf)
+        self._inner_opt.step()
+        self._acc.clear()
+        self._micro = 0
+
+    def clear_grad(self, *a, **k):
+        self._inner_opt.clear_grad(*a, **k)
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, *a, **k):
+        loss.backward()
+        self.step()
+        return None, None
+
+    def state_dict(self):
+        return self._inner_opt.state_dict()
+
+    def set_state_dict(self, sd):
+        return self._inner_opt.set_state_dict(sd)
+
+    def __getattr__(self, name):
+        return getattr(self._inner_opt, name)

@@ -1,0 +1,99 @@
+"""LocalSGD — periodic parameter averaging over the data-parallel group.
+
+Reference: fleet/meta_optimizers/localsgd_optimizer.py:24 (LocalSGDOptimizer;
+SGD/Momentum inner only). Schedule semantics (minimize_impl :92-210): every
+step up to and including ``begin_step`` the workers synchronize (plain
+data-parallel warmup); after that, each worker takes ``k_steps`` local inner
+steps between parameter averagings (snapshot + allreduce(delta)/nranks,
+algebraically = averaging the parameters when snapshots agree — which they
+do right after every sync).
+
+TPU-native: local-vs-synced state is expressed in the global view as
+"parameter islands" — a rank-major layout (dim 0 = dp rank, Shard(0) over
+the dp axis) where each row is one worker's replica taking local steps
+with local grads. The periodic sync averages the rows (plain global-view
+mean over dim 0; XLA derives the cross-device reduce from the sharding) —
+comm every k steps instead of every step, which is LocalSGD's entire
+point. Replicated (non-island) parameters are structurally in sync
+already (their grads were reduced inside the compiled backward), so the
+sync is the identity for them.
+"""
+from __future__ import annotations
+
+
+class LocalSGDOptimizer:
+    def __init__(self, optimizer, k_steps: int = 1, begin_step: int = 1,
+                 hcg=None):
+        from ....optimizer import SGD, Momentum
+
+        base = optimizer
+        while hasattr(base, "_inner_opt"):  # unwrap meta-optimizer chain
+            base = base._inner_opt
+        if not isinstance(base, (SGD, Momentum)):
+            raise TypeError(
+                "localsgd requires the inner optimizer to be SGD or "
+                f"Momentum, got {type(base).__name__} (reference "
+                "LocalSGDOptimizer._can_apply)")
+        self._inner_opt = optimizer
+        self._k_steps = max(1, int(k_steps))
+        self._begin_step = int(begin_step)
+        self._hcg = hcg
+        self._step_num = 0
+        self._last_sync = 0
+
+    def _dp_group(self):
+        if self._hcg is not None:
+            return self._hcg.get_data_parallel_group()
+        from ...collective import _init_default_group
+
+        return _init_default_group()
+
+    def _sync_params(self):
+        """Average island rows across the dp group (replicated params are
+        already in sync — identity)."""
+        import jax.numpy as jnp
+
+        from ._utils import island_rows
+
+        group = self._dp_group()
+        if group is None or group.nranks <= 1:
+            return
+        for p in self._inner_opt._parameter_list:
+            n = island_rows(p, group)
+            if not n:
+                continue
+            flat = p._data.reshape(n, -1)
+            p._data = jnp.broadcast_to(
+                flat.mean(0, keepdims=True), flat.shape).reshape(
+                    p._data.shape)
+
+    def step(self):
+        self._inner_opt.step()
+        self._step_num += 1
+        if self._step_num <= self._begin_step:
+            # warmup: synchronous data parallel (reference cond(step >
+            # begin_step, begin_localsgd, communicate))
+            self._sync_params()
+            self._last_sync = self._step_num
+        elif self._step_num - self._last_sync >= self._k_steps:
+            self._sync_params()
+            self._last_sync = self._step_num
+
+    def clear_grad(self, *a, **k):
+        self._inner_opt.clear_grad(*a, **k)
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, *a, **k):
+        loss.backward()
+        self.step()
+        return None, None
+
+    def state_dict(self):
+        return self._inner_opt.state_dict()
+
+    def set_state_dict(self, sd):
+        return self._inner_opt.set_state_dict(sd)
+
+    def __getattr__(self, name):
+        return getattr(self._inner_opt, name)

@@ -171,6 +171,15 @@ class Lamb(Optimizer):
         self._exclude_fn = exclude_from_weight_decay_fn
         super().__init__(learning_rate, parameters, None, grad_clip, multi_precision, name)
 
+    def _create_accumulators(self, p):
+        st = super()._create_accumulators(p)
+        # exclude_from_weight_decay_fn decides PER PARAM; the coefficient
+        # rides the state pytree into the fused jit update
+        wd = (0.0 if (self._exclude_fn is not None and self._exclude_fn(p))
+              else self._lamb_wd)
+        st["lamb_wd"] = jnp.asarray(wd, jnp.float32)
+        return st
+
     def _update_rule(self, param, grad, state, lr_):
         t = state["_step"]
         m = self._beta1 * state["moment1"] + (1 - self._beta1) * grad
@@ -178,7 +187,7 @@ class Lamb(Optimizer):
         state["moment1"], state["moment2"] = m, v
         m_hat = m / (1 - self._beta1**t)
         v_hat = v / (1 - self._beta2**t)
-        r = m_hat / (jnp.sqrt(v_hat) + self._eps) + self._lamb_wd * param
+        r = m_hat / (jnp.sqrt(v_hat) + self._eps) + state["lamb_wd"] * param
         w_norm = jnp.sqrt(jnp.sum(param * param))
         r_norm = jnp.sqrt(jnp.sum(r * r))
         trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
