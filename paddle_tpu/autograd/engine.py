@@ -243,7 +243,8 @@ def apply_op(name: str, fn: Callable, *args, **kwargs):
     # Only outside tracing (inside jit the surrounding trace fuses anyway)
     # and outside Program recording.
     cache_hit = False
-    if (flags.flag("eager_op_cache") and static_record_hook is None):
+    if (flags.flag("eager_op_cache") and static_record_hook is None
+            and name not in _EAGER_CACHE_SKIP):
         from ..framework.random import RngKey
 
         tracer = any(
@@ -354,6 +355,18 @@ def make_op(name: str, fn: Callable) -> Callable:
 
 _EAGER_CACHE: dict = {}
 
+# Ops that must NEVER dispatch through the cache: placement ops whose point
+# is the output SHARDING (a cached executable would bake/ignore it), and ops
+# that consult hidden global state inside their body (distribution samplers
+# drawing from the default generator — caching would freeze the noise and
+# leak traced keys into the generator).
+_EAGER_CACHE_SKIP: set = {"reshard"}
+
+
+def never_eager_cache(name: str):
+    """Register ``name`` as uncacheable for eager dispatch."""
+    _EAGER_CACHE_SKIP.add(name)
+
 
 class _CachedOp:
     __slots__ = ("fwd", "vjp", "out_treedef", "diff_arg_idx")
@@ -461,7 +474,21 @@ def _fn_sig(fn, depth=0):
             if cv is None and v is not None:
                 return None
             cells.append(cv)
-    return (fn.__code__, tuple(cells))
+    # Default args are config too: ``lambda v, i=i: ...`` stores i in
+    # __defaults__, NOT the closure — two such lambdas share a code object
+    # and must not share an executable.
+    defaults = []
+    for v in (fn.__defaults__ or ()):
+        cv = canon(v)
+        if cv is None and v is not None:
+            return None
+        defaults.append(cv)
+    for k, v in sorted((fn.__kwdefaults__ or {}).items()):
+        cv = canon(v)
+        if cv is None and v is not None:
+            return None
+        defaults.append((k, cv))
+    return (fn.__code__, tuple(cells), tuple(defaults))
 
 
 def _cached_entry(name, fn, leaves, treedef, diff_pos):

@@ -89,6 +89,13 @@ def _graph_wrap(method):
         # Dynamically-formed name (one per concrete distribution class):
         # register the row here so the strict dispatch gate stays sound.
         op_registry.register_op(op_name, notes="distribution graphed method")
+        if method.__name__ in ("sample", "rsample"):
+            # samplers draw from the global generator INSIDE the body; a
+            # cached executable would freeze the noise (and leak traced
+            # keys into the generator state)
+            from ..autograd.engine import never_eager_cache
+
+            never_eager_cache(op_name)
         return apply_op(op_name, pure, tuple(orig.values()), *args, **kwargs)
 
     wrapper._graphed = True

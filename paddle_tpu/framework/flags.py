@@ -69,11 +69,13 @@ define_flag("check_nan_inf", False, "check outputs of every op for nan/inf")
 define_flag("check_nan_inf_level", 0, "0: abort on nan/inf; >=1: log only")
 define_flag("low_precision_op_list", 0, "collect low-precision op call stats")
 define_flag("use_stride_kernel", True, "enable view/stride ops where possible")
-define_flag("eager_op_cache", False,
+define_flag("eager_op_cache", True,
             "cache ONE jitted executable per (op, signature) for eager "
             "dispatch: composite ops cost one device dispatch instead of "
             "one per jnp call; backward recomputes forward inside the "
-            "cached vjp (remat semantics)")
+            "cached vjp (remat semantics). Default ON since round 4 (the "
+            "full suite is green in both states; set FLAGS_eager_op_cache=0 "
+            "for the uncached leg)")
 define_flag("flash_attention_min_seq", 512,
             "min sequence length to route attention onto the Pallas flash "
             "kernel; shorter sequences use the fused XLA path (faster below "

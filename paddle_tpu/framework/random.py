@@ -37,7 +37,13 @@ class Generator:
 
     def _ensure_base(self):
         if self._base_key is None:
-            self._base_key = jax.random.key(self._seed)
+            key = jax.random.key(self._seed)
+            if isinstance(key, jax.core.Tracer):
+                # First draw happened inside someone's trace: use the traced
+                # key for this call but do NOT persist it (a stored tracer
+                # escapes its trace and poisons every later draw).
+                return key
+            self._base_key = key
         return self._base_key
 
     def next_key(self):

@@ -143,12 +143,15 @@ def _avg_pool(x, kernel, stride, pad, n, channel_last, exclusive, name, divisor_
     def fn(v):
         dims, strides, pads = _window_config(
             v, kernel, stride, padding, n, channel_last, ceil_mode)
-        summed = jax.lax.reduce_window(v, jnp.asarray(0, v.dtype), jax.lax.add, dims, strides, pads)
+        # init must stay a HOST literal (np, not jnp): a traced constant
+        # hides the add monoid from jax and kills reverse-mode under jit
+        # (the eager-cache executable jits this body)
+        summed = jax.lax.reduce_window(v, np.asarray(0, v.dtype), jax.lax.add, dims, strides, pads)
         if divisor_override:
             return summed / divisor_override
         if exclusive:
             ones = jnp.ones_like(v)
-            counts = jax.lax.reduce_window(ones, jnp.asarray(0, v.dtype), jax.lax.add, dims, strides, pads)
+            counts = jax.lax.reduce_window(ones, np.asarray(0, v.dtype), jax.lax.add, dims, strides, pads)
             return summed / counts
         if ceil_mode and not isinstance(padding, str):
             # include-pad counts cover input + USER padding but not the ceil
@@ -166,7 +169,7 @@ def _avg_pool(x, kernel, stride, pad, n, channel_last, exclusive, name, divisor_
             ones = jnp.ones(
                 [s + a + b for s, (a, b) in zip(v.shape, widths)], v.dtype)
             counts = jax.lax.reduce_window(
-                ones, jnp.asarray(0, v.dtype), jax.lax.add, dims, strides,
+                ones, np.asarray(0, v.dtype), jax.lax.add, dims, strides,
                 epads)
             return summed / counts
         return summed / np.prod(kernel)

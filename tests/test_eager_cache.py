@@ -115,3 +115,12 @@ def test_cache_reuses_entries(eager_cache):
     for _ in range(3):
         paddle.nn.functional.softmax(x)
     assert len(eager_cache) == n  # same signature -> no new entries
+
+
+def test_fn_sig_distinguishes_default_args():
+    """``lambda v, i=i: ...`` keeps i in __defaults__, not the closure —
+    two such lambdas share a code object and must not share an executable
+    (bit the eager all_gather slice loop)."""
+    fns = [(lambda v, i=i: v + i) for i in range(3)]
+    sigs = {engine._fn_sig(f) for f in fns}
+    assert len(sigs) == 3
