@@ -88,6 +88,11 @@ def pipeline_spmd(
                 P(),  # microbatches replicated (only stage 0 reads them)
             ),
             out_specs=P(),
+            # manual ONLY over pp: any other mesh axes (dp/mp on a hybrid
+            # mesh) stay GSPMD-automatic inside the stage body, so TP weight
+            # shardings and dp batch shardings keep partitioning the stage
+            # compute instead of being forcibly replicated
+            axis_names=frozenset({pp_axis}),
         )
         outs = shard(params, mbs)
         return outs[num_stages - 1 : num_stages - 1 + num_micro]
@@ -242,6 +247,7 @@ def pipeline_spmd_interleaved(
                 P(),
             ),
             out_specs=P(),
+            axis_names=frozenset({pp_axis}),  # non-pp axes stay GSPMD-auto
         )
         outs = shard(params, mbs)
         # microbatch m finishes at n = (V-1)*M + m + (S-1)

@@ -94,7 +94,15 @@ class PipelineParallel(Layer):
 
         Both produce identical grads (the reference's schedules are
         bit-identical too); tests pin loss equality and the live-graph
-        difference."""
+        difference.
+
+        When a pp mesh (pipe world size > 1) is available and the layer
+        structure supports the stacked-stage scan, this routes to the
+        COMPILED schedule (``compiled_forward`` — the TPU answer to the
+        reference's interleaved 1F1B with live P2P); the sequential
+        microbatch loop is only the single-stage / non-stackable fallback."""
+        if self._can_compile_schedule():
+            return self._compiled_forward_backward(data, scaler)
         inputs, labels = self._load_micro_batches(data)
         n = len(inputs)
         losses = []
@@ -122,6 +130,43 @@ class PipelineParallel(Layer):
         self._layers.allreduce_shared_weight_gradients()
         self.total_loss = _mean_losses(losses)
         return self.total_loss
+
+    def _can_compile_schedule(self) -> bool:
+        """True when the pp mesh exists and the PipelineLayer's middle run
+        stacks (homogeneous blocks divisible by pp x virtual)."""
+        hcg = self._hcg
+        if hcg is None or hcg.get_pipe_parallel_world_size() <= 1:
+            return False
+        try:
+            _, mid, _ = self._layers.split_segments()
+        except Exception:
+            return False
+        S = hcg.get_pipe_parallel_world_size()
+        v = getattr(self, "_virtual_pp_degree", 1)
+        return bool(mid) and len(mid) % (S * v) == 0
+
+    def _compiled_forward_backward(self, data, scaler=None):
+        """One batch through the compiled stacked-stage schedule: forward
+        via ``compiled_forward`` (circular VPP when _virtual_pp_degree > 1),
+        then the SAME per-microbatch loss semantics as the sequential path
+        (mean over microbatch losses — for a sum-style loss_fn that is NOT
+        the full-batch loss), one backward through the scanned graph."""
+        if isinstance(data, (tuple, list)) and len(data) == 2:
+            x, y = data
+        else:
+            x, y = data, None
+        n = self.accumulate_steps
+        mesh = self._hcg.process_mesh.to_jax()
+        out = self.compiled_forward(
+            x, mesh=mesh, num_micro=n,
+            num_virtual=getattr(self, "_virtual_pp_degree", 1))
+        losses = [self._compute_loss(o, yb)
+                  for o, yb in zip(_split_micro(out, n), _split_micro(y, n))]
+        loss = _mean_losses(losses)
+        (scaler.scale(loss) if scaler is not None else loss).backward()
+        self._layers.allreduce_shared_weight_gradients()
+        self.total_loss = loss
+        return loss
 
     def bubble_fraction(self) -> float:
         """Analytic bubble of the compiled schedule this config maps to."""

@@ -1,0 +1,40 @@
+"""Shared Megatron-style TP wiring for the model families (llama/ernie).
+
+One home for the ambient-mp detection and the col/row/plain linear choice
+(reference mp_layers.py:47/:333/:540) so TP behavior changes apply to every
+model family at once.
+"""
+from __future__ import annotations
+
+
+def mp_degree() -> int:
+    """Ambient model-parallel degree from the fleet HCG (0 when absent)."""
+    from ..distributed.fleet.meta_parallel import _get_hcg
+
+    hcg = _get_hcg()
+    return hcg.get_model_parallel_world_size() if hcg is not None else 0
+
+
+def tp_enabled(config) -> bool:
+    """TP is on when the config forces it or an mp>1 fleet mesh is live."""
+    return bool(getattr(config, "tensor_parallel", False)) or mp_degree() > 1
+
+
+def tp_linear(config, in_f, out_f, kind, weight_attr, has_bias):
+    """kind: 'col' (shard output dim) | 'row' (shard input dim) | 'plain'."""
+    from ..nn.layer.common import Linear
+
+    if tp_enabled(config) and kind != "plain":
+        from ..distributed.fleet.meta_parallel.mp_layers import (
+            ColumnParallelLinear,
+            RowParallelLinear,
+        )
+
+        if kind == "col":
+            return ColumnParallelLinear(in_f, out_f, weight_attr=weight_attr,
+                                        has_bias=has_bias,
+                                        gather_output=False)
+        return RowParallelLinear(in_f, out_f, weight_attr=weight_attr,
+                                 has_bias=has_bias, input_is_parallel=True)
+    return Linear(in_f, out_f, weight_attr=weight_attr,
+                  bias_attr=None if has_bias else False)

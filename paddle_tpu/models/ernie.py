@@ -6,6 +6,12 @@ encoder with word/position/token-type/task-type embeddings (task-type being
 ERNIE's addition), GELU MLP, pooled [CLS] head, plus MLM/NSP pretraining
 heads. Attention via F.scaled_dot_product_attention (flash attention on
 TPU).
+
+Tensor parallelism mirrors the llama/GPT families (the reference trains
+ERNIE under fleet hybrid parallel the same way): fused qkv and MLP-in are
+column-parallel, attention-out and MLP-out are row-parallel, and the word
+embedding is vocab-parallel when an mp group is active (Megatron layout,
+reference mp_layers.py:47/:333/:540).
 """
 from __future__ import annotations
 
@@ -20,6 +26,7 @@ from ..nn.layer.norm import LayerNorm
 from ..tensor.creation import arange, zeros_like
 from ..tensor.manipulation import reshape
 from ..tensor.math import matmul
+from ._tp import mp_degree as _mp_degree, tp_enabled as _tp_enabled
 
 
 @dataclass
@@ -37,6 +44,7 @@ class ErnieConfig:
     attn_dropout: float = 0.0
     layer_norm_eps: float = 1e-12
     initializer_range: float = 0.02
+    tensor_parallel: bool = False
 
 
 ERNIE_CONFIGS: dict[str, ErnieConfig] = {
@@ -55,12 +63,28 @@ def _w(config: ErnieConfig) -> ParamAttr:
                                         std=config.initializer_range))
 
 
+def _linear(config, in_f, out_f, kind):
+    """kind: 'col' (shard output dim) | 'row' (shard input dim) | 'plain'.
+    ERNIE linears keep their biases (BERT lineage)."""
+    from ._tp import tp_linear
+
+    return tp_linear(config, in_f, out_f, kind, _w(config), has_bias=True)
+
+
 class ErnieEmbeddings(Layer):
     def __init__(self, config: ErnieConfig):
         super().__init__()
-        self.word_embeddings = Embedding(config.vocab_size,
-                                         config.hidden_size,
-                                         weight_attr=_w(config))
+        if _tp_enabled(config):
+            from ..distributed.fleet.meta_parallel.mp_layers import (
+                VocabParallelEmbedding,
+            )
+
+            self.word_embeddings = VocabParallelEmbedding(
+                config.vocab_size, config.hidden_size, weight_attr=_w(config))
+        else:
+            self.word_embeddings = Embedding(config.vocab_size,
+                                             config.hidden_size,
+                                             weight_attr=_w(config))
         self.position_embeddings = Embedding(config.max_position_embeddings,
                                              config.hidden_size,
                                              weight_attr=_w(config))
@@ -96,8 +120,14 @@ class ErnieSelfAttention(Layer):
         super().__init__()
         self.config = config
         h = config.hidden_size
-        self.qkv = Linear(h, 3 * h, weight_attr=_w(config))
-        self.out = Linear(h, h, weight_attr=_w(config))
+        if _tp_enabled(config):
+            ws = max(_mp_degree(), 1)
+            if config.num_heads % ws:
+                raise ValueError(
+                    f"tensor parallel degree {ws} must divide num_heads "
+                    f"{config.num_heads}")
+        self.qkv = _linear(config, h, 3 * h, "col")
+        self.out = _linear(config, h, h, "row")
 
     def forward(self, x, attn_mask=None):
         cfg = self.config
@@ -119,10 +149,8 @@ class ErnieEncoderLayer(Layer):
         h = config.hidden_size
         self.self_attn = ErnieSelfAttention(config)
         self.norm1 = LayerNorm(h, epsilon=config.layer_norm_eps)
-        self.linear1 = Linear(h, config.intermediate_size,
-                              weight_attr=_w(config))
-        self.linear2 = Linear(config.intermediate_size, h,
-                              weight_attr=_w(config))
+        self.linear1 = _linear(config, h, config.intermediate_size, "col")
+        self.linear2 = _linear(config, config.intermediate_size, h, "row")
         self.norm2 = LayerNorm(h, epsilon=config.layer_norm_eps)
         self.dropout = Dropout(config.hidden_dropout)
 

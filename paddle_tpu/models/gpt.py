@@ -83,13 +83,14 @@ def _w(config: GPTConfig) -> ParamAttr:
     return ParamAttr(initializer=Normal(mean=0.0, std=config.initializer_range))
 
 
-def _tp_enabled(config: GPTConfig) -> bool:
-    if config.tensor_parallel:
-        return True
-    from ..distributed.fleet.meta_parallel import _get_hcg
+from ._tp import tp_enabled as _tp_enabled  # noqa: E402 (shared TP wiring)
 
-    hcg = _get_hcg()
-    return hcg is not None and hcg.get_model_parallel_world_size() > 1
+
+def _linear(config, in_f, out_f, kind):
+    """kind: 'col' | 'row' | 'plain' — GPT linears keep their biases."""
+    from ._tp import tp_linear
+
+    return tp_linear(config, in_f, out_f, kind, _w(config), has_bias=True)
 
 
 class GPTEmbeddings(Layer):
@@ -130,21 +131,8 @@ class GPTAttention(Layer):
         super().__init__()
         self.config = config
         h = config.hidden_size
-        if _tp_enabled(config):
-            from ..distributed.fleet.meta_parallel.mp_layers import (
-                ColumnParallelLinear,
-                RowParallelLinear,
-            )
-
-            self.qkv_proj = ColumnParallelLinear(
-                h, 3 * h, weight_attr=_w(config), gather_output=False
-            )
-            self.out_proj = RowParallelLinear(
-                h, h, weight_attr=_w(config), input_is_parallel=True
-            )
-        else:
-            self.qkv_proj = Linear(h, 3 * h, weight_attr=_w(config))
-            self.out_proj = Linear(h, h, weight_attr=_w(config))
+        self.qkv_proj = _linear(config, h, 3 * h, "col")
+        self.out_proj = _linear(config, h, h, "row")
         self.attn_dropout = config.attn_dropout
         self.resid_dropout = Dropout(config.hidden_dropout)
 
@@ -183,21 +171,8 @@ class GPTMLP(Layer):
     def __init__(self, config: GPTConfig):
         super().__init__()
         h, f = config.hidden_size, config.ffn_size
-        if _tp_enabled(config):
-            from ..distributed.fleet.meta_parallel.mp_layers import (
-                ColumnParallelLinear,
-                RowParallelLinear,
-            )
-
-            self.fc1 = ColumnParallelLinear(
-                h, f, weight_attr=_w(config), gather_output=False
-            )
-            self.fc2 = RowParallelLinear(
-                f, h, weight_attr=_w(config), input_is_parallel=True
-            )
-        else:
-            self.fc1 = Linear(h, f, weight_attr=_w(config))
-            self.fc2 = Linear(f, h, weight_attr=_w(config))
+        self.fc1 = _linear(config, h, f, "col")
+        self.fc2 = _linear(config, f, h, "row")
         self.dropout = Dropout(config.hidden_dropout)
 
     def forward(self, x):

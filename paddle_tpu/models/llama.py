@@ -19,11 +19,12 @@ from dataclasses import dataclass
 from ..framework.param_attr import ParamAttr
 from ..nn import Layer, functional as F
 from ..nn.initializer import Normal
-from ..nn.layer.common import Embedding, Linear
+from ..nn.layer.common import Embedding
 from ..nn.layer.container import LayerList
 from ..nn.layer.norm import RMSNorm
 from ..tensor.manipulation import reshape
 from ..tensor.math import matmul
+from ._tp import mp_degree as _mp_degree, tp_enabled as _tp_enabled
 
 
 @dataclass
@@ -69,30 +70,12 @@ def _w(config: LlamaConfig) -> ParamAttr:
                                         std=config.initializer_range))
 
 
-def _tp_enabled(config: LlamaConfig) -> bool:
-    if config.tensor_parallel:
-        return True
-    from ..distributed.fleet import fleet
-
-    hcg = getattr(fleet, "_hcg", None)
-    return hcg is not None and hcg.get_model_parallel_world_size() > 1
-
-
 def _linear(config, in_f, out_f, kind):
-    """kind: 'col' (shard output dim) | 'row' (shard input dim) | 'plain'."""
-    if _tp_enabled(config) and kind != "plain":
-        from ..distributed.fleet.meta_parallel.mp_layers import (
-            ColumnParallelLinear,
-            RowParallelLinear,
-        )
+    """kind: 'col' (shard output dim) | 'row' (shard input dim) | 'plain'.
+    LLaMA projections carry no bias."""
+    from ._tp import tp_linear
 
-        if kind == "col":
-            return ColumnParallelLinear(in_f, out_f, weight_attr=_w(config),
-                                        has_bias=False, gather_output=False)
-        return RowParallelLinear(in_f, out_f, weight_attr=_w(config),
-                                 has_bias=False,
-                                 input_is_parallel=True)
-    return Linear(in_f, out_f, weight_attr=_w(config), bias_attr=False)
+    return tp_linear(config, in_f, out_f, kind, _w(config), has_bias=False)
 
 
 class LlamaAttention(Layer):
@@ -101,9 +84,7 @@ class LlamaAttention(Layer):
         self.config = config
         h, hd = config.hidden_size, config.head_dim
         if _tp_enabled(config):
-            from ..distributed.fleet import fleet
-
-            ws = fleet._hcg.get_model_parallel_world_size()
+            ws = max(_mp_degree(), 1)
             if config.num_heads % ws != 0 or config.kv_heads % ws != 0:
                 raise ValueError(
                     f"tensor parallel degree {ws} must divide num_heads "
@@ -120,12 +101,12 @@ class LlamaAttention(Layer):
 
         cfg = self.config
         B, S, _ = x.shape
+        # Global view: TP sharding lives on the WEIGHTS (Shard annotations);
+        # activations keep their GLOBAL shapes — the head split is an XLA
+        # partitioning decision, not a python-visible division. (The
+        # divisibility check in __init__ guarantees the partitioner can
+        # split heads evenly across the mp axis.)
         nh, nkv, hd = cfg.num_heads, cfg.kv_heads, cfg.head_dim
-        if _tp_enabled(cfg):
-            from ..distributed.fleet import fleet
-
-            ws = fleet._hcg.get_model_parallel_world_size()
-            nh, nkv = nh // ws, nkv // ws  # divisibility checked in __init__
         q = reshape(self.q_proj(x), [B, S, nh, hd])
         k = reshape(self.k_proj(x), [B, S, nkv, hd])
         v = reshape(self.v_proj(x), [B, S, nkv, hd])
