@@ -293,3 +293,35 @@ class TestEndToEnd:
         logits = net(paddle.to_tensor(X))
         acc = (logits.numpy().argmax(-1) == y).mean()
         assert acc > 0.9
+
+
+def test_lamb_exclude_from_weight_decay_fn(rng):
+    """exclude_from_weight_decay_fn must actually zero the decay for
+    matching params (consumed inside the fused update via the state
+    pytree)."""
+    import jax.numpy as jnp
+    from paddle_tpu.tensor.tensor import Parameter, Tensor
+
+    w0 = rng.randn(4, 4).astype("float32")
+    g0 = rng.randn(4, 4).astype("float32")
+
+    def run(exclude):
+        p = Parameter(jnp.asarray(w0.copy()), name="layer_norm_0.w_0")
+        opt = paddle.optimizer.Lamb(
+            learning_rate=0.1, lamb_weight_decay=0.5, parameters=[p],
+            exclude_from_weight_decay_fn=(
+                (lambda q: "layer_norm" in q.name) if exclude else None))
+        p.grad = Tensor(jnp.asarray(g0))
+        opt.step()
+        return np.asarray(p.numpy())
+
+    with_decay = run(False)
+    without_decay = run(True)
+    assert not np.allclose(with_decay, without_decay)
+    # oracle for the excluded case: wd = 0
+    m = 0.1 * g0
+    v = 0.001 * g0 * g0
+    r = (m / 0.1) / (np.sqrt(v / 0.001) + 1e-6)
+    w_n, r_n = np.linalg.norm(w0), np.linalg.norm(r)
+    np.testing.assert_allclose(
+        without_decay, w0 - 0.1 * (w_n / r_n) * r, rtol=1e-4, atol=1e-5)
