@@ -90,7 +90,11 @@ def bench_gpt(label, hidden, layers, heads, batch, seq, K, recompute,
         # donate=True trades the tunnel's donation penalty for HALF the
         # resident state (params+mom single-buffered) — what lets 1.3B fit
         # the 16 GB chip at all; smaller configs skip it (4-7x step cost).
-        many_jit = (jax.jit(many, donate_argnums=(0, 1)) if donate
+        # donate="mom" single-buffers ONLY the momentum (params stay
+        # double-buffered): 3x(p) instead of 4x(p) resident, probing whether
+        # the tunnel penalty follows every donated carry or just params.
+        donate_idx = {True: (0, 1), "mom": (1,), False: ()}.get(donate, ())
+        many_jit = (jax.jit(many, donate_argnums=donate_idx) if donate_idx
                     else jax.jit(many))
         p_cur, m_cur = params, mom
         p_cur, m_cur, losses = many_jit(p_cur, m_cur, ids, labels)  # compile+warmup
@@ -363,6 +367,21 @@ def main():
                               "value": 0, "unit": "tokens/s",
                               "vs_baseline": 0.0,
                               "error": f"{type(e).__name__}: {e}"[:300]}))
+    if "--exp13b" in sys.argv:
+        # BASELINE config-3 de-noising experiments (round-4 verdict #6):
+        # which buffers must be donated for 1.3B to fit, and what each
+        # donation mode costs through the tunnel.
+        for mode in (False, "mom", True):
+            try:
+                r = bench_gpt(f"gpt3-1.3b(donate={mode})", 2048, 24, 16, 4,
+                              1024, 5, True, on_tpu, donate=mode)
+            except Exception as e:
+                r = {"metric": f"gpt3-1.3b(donate={mode})", "value": 0,
+                     "unit": "tokens/s", "vs_baseline": 0.0,
+                     "error": f"{type(e).__name__}: {e}"[:200]}
+            print(json.dumps(r))
+        return
+
     # flagship line LAST (the driver reads one line; keep it the final one)
     print(json.dumps(bench_gpt("gpt3-760m(+remat)", 1536, 24, 12, 8, 1024,
                                10, True, on_tpu)))

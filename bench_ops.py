@@ -24,12 +24,7 @@ import argparse
 import json
 import sys
 import time
-
-
-def _median(xs):
-    xs = sorted(xs)
-    n = len(xs)
-    return xs[n // 2] if n % 2 else 0.5 * (xs[n // 2 - 1] + xs[n // 2])
+from statistics import median as _median
 
 
 def build_cases():

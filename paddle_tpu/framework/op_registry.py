@@ -120,7 +120,7 @@ _bulk([
     "exponent", "greater_equal", "greater_than", "isclose", "isfinite",
     "isinf", "isnan", "isneginf", "isposinf", "isreal", "less_equal",
     "less_than", "logical_and", "logical_not", "logical_or", "logical_xor",
-    "not_equal", "one_hot", "searchsorted",
+    "not_equal", "one_hot", "searchsorted", "sequence_mask",
     "gather_tree", "class_center_sample", "top_p_sampling", "weight_quantize",
     "matrix_nms", "generate_proposals", "distribute_fpn_proposals",
 ], non_diff=True)
@@ -146,6 +146,7 @@ _bulk([
     "channel_shuffle", "cholesky_solve", "clip", "clone", "complex",
     "concat", "cond", "copysign", "corrcoef", "cosine_embedding_loss", "cov",
     "crop", "cross", "cummax", "cummin", "cumulative_trapezoid",
+    "deform_conv2d",
     "dense_to_sparse", "diag", "diag_embed", "diagflat", "diagonal", "diff",
     "divide", "dot", "dropout", "eigvals", "eigvalsh", "elu", "embedding",
     "expand", "expand_as", "fake_channel_quant_dequant",

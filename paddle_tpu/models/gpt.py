@@ -48,6 +48,11 @@ class GPTConfig:
     tensor_parallel: bool = False  # force TP layers even without fleet
     recompute: bool = False  # rematerialize blocks in backward (activation
     # memory ~O(layers*s*h) instead of O(layers*s*4h stacks))
+    # perf-attribution ablations (perf_breakdown.py only — differential
+    # timing of step phases; never set in training configs): any of
+    # {"attn", "mlp", "ce"} ("ce" keeps the lm-head matmul, drops the
+    # softmax-CE math)
+    ablate: tuple = ()
 
     @property
     def ffn_size(self) -> int:

@@ -202,6 +202,8 @@ def _block(p, x, config: GPTConfig, mesh: Mesh):
 
     # SP region: sequence sharded over mp
     x = cs(x, P("dp", "mp", None))
+    if "attn" in config.ablate:  # perf attribution: skip the whole branch
+        return _block_mlp(p, x, config, cs)
     y = _layer_norm(x, p["ln1_g"], p["ln1_b"], config.layer_norm_eps)
     qkv = y @ p["wqkv"] + p["bqkv"]           # column-parallel -> [mb,s,3h]/mp
     qkv = cs(qkv, P("dp", None, "mp"))
@@ -253,7 +255,12 @@ def _block(p, x, config: GPTConfig, mesh: Mesh):
         o = o.transpose(0, 2, 1, 3).reshape(mb, s, h)
     o = o @ p["wo"] + p["bo"]                  # row-parallel
     x = x + cs(o, P("dp", "mp", None))         # reduce-scatter onto SP layout
+    return _block_mlp(p, x, config, cs)
 
+
+def _block_mlp(p, x, config: GPTConfig, cs):
+    if "mlp" in config.ablate:  # perf attribution: skip the whole branch
+        return x
     y = _layer_norm(x, p["ln2_g"], p["ln2_b"], config.layer_norm_eps)
     y = jax.nn.gelu(y @ p["w1"] + p["b1"], approximate=True)
     y = cs(y, P("dp", None, "mp"))
@@ -375,6 +382,10 @@ def _loss_fn_inner(params, ids, labels, config: GPTConfig, mesh: Mesh, num_micro
         y_ch, lb_ch = args
         lg = (y_ch @ emb.T).astype(jnp.float32)  # [b, chunk, v]
         lg = cs(lg, P("dp", None, "mp"))  # vocab-sharded over mp (tied head)
+        if "ce" in config.ablate:
+            # perf attribution: keep the head matmul (and the chunked remat
+            # structure), drop the softmax-CE math
+            return jnp.sum(lg, axis=-1) * 1e-9
         lse = jax.scipy.special.logsumexp(lg, axis=-1)
         tgt = jnp.take_along_axis(lg, lb_ch[..., None], axis=-1)[..., 0]
         return lse - tgt  # [b, chunk]

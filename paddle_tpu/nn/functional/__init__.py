@@ -31,5 +31,6 @@ from .extension import (  # noqa: F401
     gather_tree,
     margin_cross_entropy,
     rnnt_loss,
+    sequence_mask,
     temporal_shift,
 )

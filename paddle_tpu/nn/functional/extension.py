@@ -31,7 +31,31 @@ from ...tensor.tensor import Tensor
 __all__ = [
     "affine_grid", "temporal_shift", "gather_tree", "edit_distance",
     "rnnt_loss", "class_center_sample", "margin_cross_entropy",
+    "sequence_mask",
 ]
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    """y[..., j] = j < x[...] — the classic length→mask op.
+
+    Reference: python/paddle/nn/functional/extension.py:43 (sequence_mask,
+    SequenceMaskScalarInferMeta in phi/infermeta/unary.cc). When ``maxlen``
+    is None the reference sizes the mask from max(x) — a data-dependent
+    output shape, so it is resolved EAGERLY here (one host sync) and the
+    op body stays static-shape for XLA.
+    """
+    from ...framework.dtype import to_jax_dtype  # local: avoid cycles
+
+    xv = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    if maxlen is None:
+        maxlen = int(jnp.max(xv))
+    ml = int(maxlen)
+
+    def fn(v):
+        mask = jnp.arange(ml) < v[..., None]
+        return mask.astype(to_jax_dtype(dtype))
+
+    return apply_op("sequence_mask", fn, x)
 
 
 def affine_grid(theta, out_shape, align_corners=True, name=None):

@@ -186,7 +186,117 @@ class RoIPool:
                         self._spatial_scale)
 
 
-__all__ = ["box_iou", "nms", "roi_align", "roi_pool", "RoIAlign", "RoIPool"]
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """2-D deformable convolution (v1 when ``mask is None``, else v2).
+
+    Reference: python/paddle/vision/ops.py:753 (deform_conv2d) over the phi
+    kernel paddle/phi/kernels/impl/deformable_conv_kernel_impl.h
+    (modulated_deformable_im2col + GEMM). TPU-native: the im2col with
+    learned offsets becomes one vectorized bilinear gather producing
+    [N, C, kHkW, Ho, Wo] columns (XLA gathers), and the contraction with the
+    kernel is one einsum that lands on the MXU — no per-position CUDA
+    sampling kernel.
+
+    Layouts (reference): x [N, C, H, W]; weight [M, C/groups, kH, kW];
+    offset [N, 2*dg*kH*kW, Ho, Wo] with channel order (dg, kH*kW, {dy,dx});
+    mask [N, dg*kH*kW, Ho, Wo]. Zero padding outside the input extent.
+    """
+    s = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    p = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    d = (dilation, dilation) if isinstance(dilation, int) else tuple(dilation)
+    dg, g = int(deformable_groups), int(groups)
+
+    def fn(xv, off, w, b, m):
+        N, C, H, W = xv.shape
+        M, Cg, kH, kW = w.shape
+        K = kH * kW
+        Ho = (H + 2 * p[0] - (d[0] * (kH - 1) + 1)) // s[0] + 1
+        Wo = (W + 2 * p[1] - (d[1] * (kW - 1) + 1)) // s[1] + 1
+        # base sampling grid per kernel tap: [K, Ho] / [K, Wo]
+        ky, kx = jnp.meshgrid(jnp.arange(kH), jnp.arange(kW), indexing="ij")
+        base_y = (jnp.arange(Ho) * s[0] - p[0])[None, :] + (
+            ky.reshape(-1) * d[0])[:, None]                  # [K, Ho]
+        base_x = (jnp.arange(Wo) * s[1] - p[1])[None, :] + (
+            kx.reshape(-1) * d[1])[:, None]                  # [K, Wo]
+        off = off.reshape(N, dg, K, 2, Ho, Wo)
+        ys = base_y[None, None, :, :, None] + off[:, :, :, 0]  # [N,dg,K,Ho,Wo]
+        xs = base_x[None, None, :, None, :] + off[:, :, :, 1]
+
+        Cd = C // dg
+        xp = xv.reshape(N, dg, Cd, H * W)
+        y0 = jnp.floor(ys)
+        x0 = jnp.floor(xs)
+        cols = 0.0
+        for yy, wy in ((y0, 1.0 - (ys - y0)), (y0 + 1.0, ys - y0)):
+            for xx, wx in ((x0, 1.0 - (xs - x0)), (x0 + 1.0, xs - x0)):
+                valid = ((yy >= 0) & (yy <= H - 1)
+                         & (xx >= 0) & (xx <= W - 1))
+                lin = (jnp.clip(yy, 0, H - 1).astype(jnp.int32) * W
+                       + jnp.clip(xx, 0, W - 1).astype(jnp.int32))
+                vals = jnp.take_along_axis(
+                    xp, lin.reshape(N, dg, 1, K * Ho * Wo), axis=3)
+                wgt = (wy * wx * valid).reshape(N, dg, 1, K * Ho * Wo)
+                cols = cols + vals * wgt.astype(xv.dtype)
+        cols = cols.reshape(N, dg, Cd, K, Ho, Wo)
+        if m is not None:
+            cols = cols * m.reshape(N, dg, 1, K, Ho, Wo).astype(xv.dtype)
+        # group conv as one contraction: [N,g,Cg,K,P] x [g,Mg,Cg,K]
+        cols = cols.reshape(N, g, C // g, K, Ho * Wo)
+        wg = w.reshape(g, M // g, Cg, K)
+        out = jnp.einsum("ngckp,gmck->ngmp", cols, wg)
+        out = out.reshape(N, M, Ho, Wo)
+        if b is not None:
+            out = out + b.reshape(1, M, 1, 1)
+        return out
+
+    return apply_op("deform_conv2d", fn, x, offset, weight, bias, mask)
+
+
+def _make_deform_conv2d_layer():
+    # deferred so vision.ops does not import nn at module load (cycle)
+    from ..nn import Layer
+
+    class DeformConv2D(Layer):
+        """paddle.vision.ops.DeformConv2D layer parity (reference
+        ops.py:927). A real Layer: its weight/bias register with parent
+        models (parameters()/state_dict()) like any sublayer."""
+
+        def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                     padding=0, dilation=1, deformable_groups=1, groups=1,
+                     weight_attr=None, bias_attr=None):
+            super().__init__()
+            kh, kw = ((kernel_size, kernel_size)
+                      if isinstance(kernel_size, int) else tuple(kernel_size))
+            self._cfg = dict(stride=stride, padding=padding,
+                             dilation=dilation,
+                             deformable_groups=deformable_groups,
+                             groups=groups)
+            self.weight = self.create_parameter(
+                [out_channels, in_channels // groups, kh, kw],
+                attr=weight_attr)
+            self.bias = (None if bias_attr is False else
+                         self.create_parameter([out_channels],
+                                               attr=bias_attr, is_bias=True))
+
+        def forward(self, x, offset, mask=None):
+            return deform_conv2d(x, offset, self.weight, self.bias,
+                                 mask=mask, **self._cfg)
+
+    return DeformConv2D
+
+
+def __getattr__(name):
+    if name == "DeformConv2D":
+        cls = _make_deform_conv2d_layer()
+        globals()["DeformConv2D"] = cls
+        return cls
+    raise AttributeError(name)
+
+
+__all__ = ["box_iou", "nms", "roi_align", "roi_pool", "RoIAlign", "RoIPool",
+           "deform_conv2d", "DeformConv2D"]
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,208 @@
+"""Seeded statistical checks for the stochastic registry ops.
+
+Reference analogue: OpTest's stochastic handling (test/legacy_test/
+op_test.py:420 — seeded runs with distributional asserts instead of exact
+goldens). Every op gets: (a) a reproducibility check (same paddle.seed →
+identical output), (b) a distribution check at fixed seed — moments, bounds,
+or a one-sample Kolmogorov–Smirnov statistic against the target CDF.
+
+Sample sizes are chosen so the asserted tolerances hold with large margin
+(KS critical value at n=20000, alpha=1e-6 is ~0.012; we assert < 0.02).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+def _seeded(fn, seed=77):
+    paddle.seed(seed)
+    a = fn()
+    paddle.seed(seed)
+    b = fn()
+    return a, b
+
+
+def _ks(samples, cdf):
+    """One-sample KS statistic sup|ecdf - cdf|."""
+    s = np.sort(np.asarray(samples).ravel())
+    n = len(s)
+    c = cdf(s)
+    ecdf_hi = np.arange(1, n + 1) / n
+    ecdf_lo = np.arange(0, n) / n
+    return max(np.max(np.abs(ecdf_hi - c)), np.max(np.abs(ecdf_lo - c)))
+
+
+N = 20000
+
+
+def test_gaussian_moments_and_ks():
+    from math import erf
+
+    a, b = _seeded(lambda: paddle.randn([N]).numpy())
+    np.testing.assert_array_equal(a, b)  # seeded reproducibility
+    assert abs(a.mean()) < 0.03 and abs(a.std() - 1.0) < 0.03
+    norm_cdf = np.vectorize(lambda v: 0.5 * (1 + erf(v / np.sqrt(2))))
+    assert _ks(a, norm_cdf) < 0.02
+
+
+def test_uniform_bounds_and_ks():
+    a, b = _seeded(lambda: paddle.rand([N]).numpy())
+    np.testing.assert_array_equal(a, b)
+    assert a.min() >= 0.0 and a.max() < 1.0
+    assert abs(a.mean() - 0.5) < 0.02
+    assert _ks(a, lambda v: np.clip(v, 0, 1)) < 0.02
+
+
+def test_bernoulli_mean():
+    p = 0.3
+    probs = paddle.full([N], p, dtype="float32")
+    a, b = _seeded(lambda: paddle.bernoulli(probs).numpy())
+    np.testing.assert_array_equal(a, b)
+    assert set(np.unique(a)) <= {0.0, 1.0}
+    assert abs(a.mean() - p) < 0.02
+
+
+def test_binomial_moments():
+    n_tr, p = 10, 0.4
+    count = paddle.full([N], n_tr, dtype="int64")
+    prob = paddle.full([N], p, dtype="float32")
+    a, b = _seeded(lambda: paddle.binomial(count, prob).numpy())
+    np.testing.assert_array_equal(a, b)
+    assert a.min() >= 0 and a.max() <= n_tr
+    assert abs(a.mean() - n_tr * p) < 0.1
+    assert abs(a.var() - n_tr * p * (1 - p)) < 0.15
+
+
+def test_poisson_moments():
+    lam = 3.5
+    x = paddle.full([N], lam, dtype="float32")
+    a, b = _seeded(lambda: paddle.poisson(x).numpy())
+    np.testing.assert_array_equal(a, b)
+    assert abs(a.mean() - lam) < 0.1
+    assert abs(a.var() - lam) < 0.25
+
+
+def test_randint_uniform_histogram():
+    lo, hi = 2, 12
+    a, b = _seeded(lambda: paddle.randint(lo, hi, [N]).numpy())
+    np.testing.assert_array_equal(a, b)
+    assert a.min() >= lo and a.max() < hi
+    counts = np.bincount(a - lo, minlength=hi - lo) / N
+    np.testing.assert_allclose(counts, 1.0 / (hi - lo), atol=0.02)
+
+
+def test_randperm_is_uniform_permutation():
+    n = 64
+    a, b = _seeded(lambda: paddle.randperm(n).numpy())
+    np.testing.assert_array_equal(a, b)
+    np.testing.assert_array_equal(np.sort(a), np.arange(n))
+    # positional uniformity: over many draws, E[value at slot 0] ~ (n-1)/2
+    paddle.seed(5)
+    firsts = np.array([paddle.randperm(n).numpy()[0] for _ in range(300)])
+    assert abs(firsts.mean() - (n - 1) / 2) < 5.0
+    assert len(np.unique(firsts)) > n // 3  # actually varies
+
+
+def test_shuffle_preserves_multiset():
+    x = paddle.to_tensor(np.arange(512).astype("int64"))
+    a, b = _seeded(lambda: paddle.tensor.random.shuffle(x).numpy())
+    np.testing.assert_array_equal(a, b)
+    np.testing.assert_array_equal(np.sort(a), np.arange(512))
+    assert not np.array_equal(a, np.arange(512))  # actually shuffled
+
+
+def test_dropout_zero_fraction_and_scaling():
+    p = 0.25
+    x = paddle.to_tensor(np.full((N,), 2.0, np.float32))
+    a, b = _seeded(lambda: F.dropout(x, p=p, training=True).numpy())
+    np.testing.assert_array_equal(a, b)
+    zero_frac = (a == 0).mean()
+    assert abs(zero_frac - p) < 0.02
+    kept = a[a != 0]
+    np.testing.assert_allclose(kept, 2.0 / (1 - p), rtol=1e-5)  # upscale
+    # eval mode: identity
+    np.testing.assert_allclose(
+        F.dropout(x, p=p, training=False).numpy(), 2.0)
+
+
+def test_alpha_dropout_preserves_moments():
+    paddle.seed(3)
+    x = paddle.randn([N])
+    a, b = _seeded(lambda: F.alpha_dropout(x, p=0.1, training=True).numpy())
+    np.testing.assert_array_equal(a, b)
+    # alpha dropout's defining property: mean/var approximately preserved
+    assert abs(a.mean() - x.numpy().mean()) < 0.05
+    assert abs(a.std() - x.numpy().std()) < 0.08
+
+
+def test_rrelu_slope_distribution():
+    lower, upper = 1 / 8, 1 / 3
+    x = paddle.to_tensor(np.full((N,), -1.0, np.float32))
+    a, b = _seeded(lambda: F.rrelu(x, lower, upper, training=True).numpy())
+    np.testing.assert_array_equal(a, b)
+    slopes = -a  # x = -1 -> output = -alpha
+    assert slopes.min() >= lower - 1e-6 and slopes.max() <= upper + 1e-6
+    assert abs(slopes.mean() - (lower + upper) / 2) < 0.01
+    width = upper - lower
+    assert _ks(slopes, lambda v: np.clip((v - lower) / width, 0, 1)) < 0.02
+    # eval mode: deterministic mid slope
+    ev = F.rrelu(x, lower, upper, training=False).numpy()
+    np.testing.assert_allclose(-ev, (lower + upper) / 2, rtol=1e-6)
+
+
+def test_gumbel_softmax_category_frequencies():
+    logits = np.array([0.5, 1.5, -0.5, 0.0], np.float32)
+    x = paddle.to_tensor(np.tile(logits, (8192, 1)))
+    a, b = _seeded(lambda: F.gumbel_softmax(x, temperature=0.1,
+                                            hard=True).numpy())
+    np.testing.assert_array_equal(a, b)
+    # hard=True: one-hots (straight-through adds y - sg(y), exactly zero in
+    # value up to float round-off)
+    np.testing.assert_allclose(a.sum(-1), 1.0, rtol=1e-5)
+    assert np.all((np.abs(a) < 1e-5) | (np.abs(a - 1.0) < 1e-5))
+    # at low temperature the argmax distribution -> softmax(logits)
+    freq = (a > 0.5).mean(0)
+    target = np.exp(logits) / np.exp(logits).sum()
+    np.testing.assert_allclose(freq, target, atol=0.03)
+
+
+def test_top_p_sampling_nucleus_support_and_freq():
+    probs = np.array([0.5, 0.3, 0.15, 0.05], np.float32)
+    x = paddle.to_tensor(np.tile(probs, (8192, 1)))
+    ps = paddle.to_tensor(np.full((8192,), 0.8, np.float32))
+
+    def draw():
+        s, ids = paddle.tensor.random.top_p_sampling(x, ps)
+        return ids.numpy()
+
+    a, b = _seeded(draw)
+    np.testing.assert_array_equal(a, b)
+    # nucleus at p=0.8 = {0, 1} (0.5+0.3); token 2 enters only via the
+    # keep-first rule boundary -> support must exclude 3
+    assert set(np.unique(a)) <= {0, 1, 2}
+    freq0 = (a == 0).mean()
+    # renormalized {0.5, 0.3} + boundary token: P(0) in [0.5/0.95, 0.5/0.8]
+    assert 0.48 < freq0 < 0.68
+
+
+def test_class_center_sample_contract():
+    # positives (<=10 unique) must fit inside num_samples=16 (the reference
+    # asserts num_samples >= the positive class count the same way)
+    labels = np.random.RandomState(0).randint(0, 10, (64,)).astype("int64")
+    lt = paddle.to_tensor(labels)
+
+    def draw():
+        remapped, sampled = F.class_center_sample(lt, 40, 16)
+        return remapped.numpy(), sampled.numpy()
+
+    (r1, s1), (r2, s2) = _seeded(draw)
+    np.testing.assert_array_equal(s1, s2)
+    np.testing.assert_array_equal(r1, r2)
+    # every positive class appears in the sampled set; remapped labels
+    # point at the right sampled slot
+    pos = np.unique(labels)
+    assert set(pos) <= set(s1.tolist())
+    lookup = {c: i for i, c in enumerate(s1.tolist())}
+    np.testing.assert_array_equal(r1, np.array([lookup[c] for c in labels]))
