@@ -39,7 +39,7 @@ def _chip_peak(jax, on_tpu):
 
 
 def bench_gpt(label, hidden, layers, heads, batch, seq, K, recompute,
-              on_tpu, donate=False):
+              on_tpu, donate=False, flash=True):
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -50,6 +50,7 @@ def bench_gpt(label, hidden, layers, heads, batch, seq, K, recompute,
     cfg = GPTConfig(
         vocab_size=50304, hidden_size=hidden, num_layers=layers,
         num_heads=heads, max_seq_len=seq, recompute=recompute,
+        use_flash_attention=flash,
     )
     if not on_tpu:
         batch, seq, K = 2, 128, 2
@@ -100,10 +101,16 @@ def bench_gpt(label, hidden, layers, heads, batch, seq, K, recompute,
         p_cur, m_cur, losses = many_jit(p_cur, m_cur, ids, labels)  # compile+warmup
         first_losses = np.asarray(losses)  # sync
         t0 = time.perf_counter()
-        if donate:
+        if donate is True:
             # donated buffers are consumed: the timed call continues from
             # the returned state (the steady-state training pattern)
             p_cur, m_cur, losses = many_jit(p_cur, m_cur, ids, labels)
+        elif donate == "mom":
+            # params are NOT donated: replay the ORIGINAL params buffer
+            # (feeding the warmup call's params output back would add the
+            # relayout pathology this mode exists to isolate); momentum WAS
+            # consumed, so continue from the returned buffer
+            p_cur, m_cur, losses = many_jit(params, m_cur, ids, labels)
         else:
             # replay the ORIGINAL inputs: feeding a jit output back as input
             # relayouts per execution on this tunnel (see note above)

@@ -24,7 +24,7 @@ import time
 
 import numpy as np
 
-from bench import PEAKS, _chip_peak  # shared chip table / methodology
+from bench import _chip_peak  # shared chip table / methodology
 
 
 def _step_time(cfg, mesh, batch, seq, K, mode):
@@ -167,6 +167,67 @@ def matmul_roofline(cfg, batch, seq, K):
     return per_step, total
 
 
+def attention_ab(batch, nh, seq, hd, K=16):
+    """Isolated fwd+bwd A/B: Pallas flash kernel vs XLA fused attention at
+    one (batch, heads, seq, head_dim) shape, bf16, causal. Returns ms/step
+    for each — the direct evidence for where the flash routing threshold
+    belongs at this shape."""
+    import math
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from paddle_tpu.ops.pallas.flash_attention import flash_attention
+
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(batch, seq, nh, hd), jnp.bfloat16)
+    k = jnp.asarray(rng.randn(batch, seq, nh, hd), jnp.bfloat16)
+    v = jnp.asarray(rng.randn(batch, seq, nh, hd), jnp.bfloat16)
+
+    def xla_attn(q, k, v):
+        qt, kt, vt = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+        s = jnp.einsum("bhqd,bhkd->bhqk", qt, kt,
+                       preferred_element_type=jnp.float32)
+        s = s / math.sqrt(hd)
+        s = jnp.where(jnp.tril(jnp.ones((seq, seq), bool)), s, -1e30)
+        p = jax.nn.softmax(s, axis=-1).astype(vt.dtype)
+        o = jnp.einsum("bhqk,bhkd->bhqd", p, vt)
+        return o.transpose(0, 2, 1, 3)
+
+    def run(fn):
+        # grad wrt ALL of (q, k, v): XLA would DCE the dk/dv einsums of the
+        # reference attention otherwise, while the fused Pallas backward
+        # always computes them — a q-only grad would bias the A/B
+        def loss(q, k, v):
+            return jnp.sum(fn(q, k, v).astype(jnp.float32)) * 1e-30
+
+        def many(q):
+            def body(carry, _):
+                gq, gk, gv = jax.grad(loss, argnums=(0, 1, 2))(
+                    q + carry.astype(q.dtype), k, v)
+                s = (jnp.sum(gq) + jnp.sum(gk)
+                     + jnp.sum(gv)).astype(jnp.float32)
+                return carry + s * 1e-30, None
+
+            out, _ = lax.scan(body, jnp.zeros((), jnp.float32), None,
+                              length=K)
+            return out
+
+        with jax.default_matmul_precision("default"):
+            jit = jax.jit(many)
+            np.asarray(jit(q))
+            t0 = time.perf_counter()
+            np.asarray(jit(q))
+            return (time.perf_counter() - t0) / K * 1e3
+
+    return {
+        "shape": f"b{batch} h{nh} s{seq} d{hd} bf16 causal",
+        "flash_ms": round(run(lambda q, k, v: flash_attention(
+            q, k, v, causal=True)), 3),
+        "xla_ms": round(run(xla_attn), 3),
+    }
+
+
 def main():
     import jax
 
@@ -176,9 +237,21 @@ def main():
     ap.add_argument("-K", type=int, default=8)
     ap.add_argument("--cpu", action="store_true",
                     help="force the CPU backend (skip the TPU tunnel)")
+    ap.add_argument("--attn", action="store_true",
+                    help="isolated flash-vs-XLA attention A/B only")
     args = ap.parse_args()
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
+    if args.attn:
+        on_tpu = jax.default_backend() == "tpu"
+        shapes = ((12, 128), (12, 64)) if on_tpu else ((4, 64),)
+        seqs = (512, 1024, 2048) if on_tpu else (256,)
+        b = 8 if on_tpu else 2
+        for nh, hd in shapes:
+            for seq in seqs:
+                print(json.dumps(attention_ab(b, nh, seq, hd,
+                                              K=16 if on_tpu else 2)))
+        return
 
     from paddle_tpu.models import gpt_spmd
     from paddle_tpu.models.gpt import GPTConfig

@@ -1,6 +1,9 @@
 """auto_tuner: candidate enumeration invariants, prune rules, memory model
 monotonicity, full tune loop with a synthetic cost surface, history IO."""
 import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow  # auto-tuner e2e trial loop (~1 min)
 
 from paddle_tpu.distributed.auto_tuner import (
     AutoTuneConfig,

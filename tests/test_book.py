@@ -6,6 +6,8 @@ seconds on the virtual mesh."""
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # end-to-end book examples (~1 min)
+
 import paddle_tpu as paddle
 from paddle_tpu import nn, static
 from paddle_tpu.io import DataLoader

@@ -6,6 +6,8 @@ import time
 
 import pytest
 
+pytestmark = pytest.mark.dist
+
 from paddle_tpu.distributed.comm_watchdog import (
     CommPeerFailure, CommTimeout, CommWatchdog,
 )

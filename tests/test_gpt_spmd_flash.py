@@ -7,6 +7,8 @@ and (b) that the pallas kernel actually appears in the traced step."""
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # full hybrid flash parity (~0.5 min)
+
 import jax
 import jax.numpy as jnp
 

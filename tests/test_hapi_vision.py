@@ -3,6 +3,8 @@ test/book end-to-end small models)."""
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # vision model fits (~1 min)
+
 import paddle_tpu as paddle
 from paddle_tpu import nn
 from paddle_tpu.hapi import EarlyStopping, Model

@@ -5,6 +5,9 @@ run real subprocesses; SURVEY.md §4 'distributed is always real processes').
 Worker scripts are tiny and jax-free so the test stays fast.
 """
 import os
+import pytest
+
+pytestmark = pytest.mark.dist
 import sys
 import textwrap
 

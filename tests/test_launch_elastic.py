@@ -13,6 +13,8 @@ import time
 
 import pytest
 
+pytestmark = [pytest.mark.slow, pytest.mark.dist]  # elastic relaunch with real waits (~1.5 min)
+
 SCRIPT = """
 import os, sys, time
 fail_dir = os.environ.get("FAIL_ONCE_DIR")

@@ -4,6 +4,8 @@ end-to-end small models + auto_parallel llama tests)."""
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # model-zoo sweeps (~4.5 min)
+
 import paddle_tpu as paddle
 from paddle_tpu.models.ernie import (
     ERNIE_CONFIGS,

@@ -17,6 +17,8 @@ import sys
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.dist
+
 _WORKER = r"""
 import os, sys
 import numpy as np

@@ -4,6 +4,8 @@ import multiprocessing as mp
 
 import pytest
 
+pytestmark = pytest.mark.dist
+
 
 def _sq(x):
     return x * x

@@ -9,6 +9,8 @@ import os
 
 import pytest
 
+pytestmark = pytest.mark.dist
+
 from paddle_tpu.distributed.store import TCPStore
 
 
