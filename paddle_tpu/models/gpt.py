@@ -48,6 +48,10 @@ class GPTConfig:
     tensor_parallel: bool = False  # force TP layers even without fleet
     recompute: bool = False  # rematerialize blocks in backward (activation
     # memory ~O(layers*s*h) instead of O(layers*s*4h stacks))
+    remat_save_attn: bool = True  # under recompute, also save the flash
+    # kernel's o/lse (backward skips the attention re-forward for
+    # ~layers*s*h*2B extra residency); memory-edge configs (1.3B on 16 GB)
+    # set False to keep the smaller footprint
     # perf-attribution ablations (perf_breakdown.py only — differential
     # timing of step phases; never set in training configs): any of
     # {"attn", "mlp", "ce"} ("ce" keeps the lm-head matmul, drops the

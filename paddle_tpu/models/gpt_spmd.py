@@ -283,7 +283,14 @@ def _stage_fn(p_stage, x, config: GPTConfig, mesh: Mesh):
         return _block(p_layer, carry, config, mesh), None
 
     if getattr(config, "recompute", False):
+        # weight-GEMM outputs AND (by default) the flash kernel's o/lse are
+        # saved; the backward recomputes only elementwise/LN (cheap) —
+        # remat trades the minimum FLOPs for the activation-memory win
         policy = jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims
+        if getattr(config, "remat_save_attn", True):
+            policy = jax.checkpoint_policies.save_from_both_policies(
+                policy,
+                jax.checkpoint_policies.save_only_these_names("flash_out"))
         body = jax.checkpoint(body, policy=policy)
     x, _ = lax.scan(body, x, p_stage)
     return x

@@ -76,6 +76,10 @@ def _pick_block(pref: int, seq: int) -> int:
 
 _AUTOTUNE_CACHE: dict = {}
 _AUTOTUNE_LOADED = [False]
+# entries that came from the packaged defaults, with their packaged values:
+# excluded from _save_cache unless re-swept (a persisted snapshot would
+# permanently shadow future packaged updates)
+_PACKAGED_SNAPSHOT: dict = {}
 
 
 def _cache_path():
@@ -108,7 +112,9 @@ def _load_cache():
         try:
             with open(pkg) as f:
                 for k, v in json.load(f).items():
-                    _AUTOTUNE_CACHE.setdefault(k, v)
+                    if k not in _AUTOTUNE_CACHE:
+                        _AUTOTUNE_CACHE[k] = v
+                        _PACKAGED_SNAPSHOT[k] = list(v)
         except Exception:
             pass
 
@@ -116,9 +122,13 @@ def _load_cache():
 def _save_cache():
     import json
 
+    # persist only user-swept entries (packaged defaults that were not
+    # re-swept stay in the package, so package updates keep taking effect)
+    out = {k: v for k, v in _AUTOTUNE_CACHE.items()
+           if _PACKAGED_SNAPSHOT.get(k) != list(v)}
     try:
         with open(_cache_path(), "w") as f:
-            json.dump(_AUTOTUNE_CACHE, f, indent=1)
+            json.dump(out, f, indent=1)
     except OSError:
         pass
 
@@ -538,6 +548,14 @@ def _flash(q, k, v, mask, lens, scale, causal, hq):
 
 def _flash_fwd(q, k, v, mask, lens, scale, causal, hq):
     out, lse = _flash_fwd_impl(q, k, v, mask, lens, scale, causal, hq)
+    # checkpoint_name tags make BOTH residuals saveable under jax.checkpoint
+    # (gpt_spmd's remat policy lists "flash_out"): with o and lse stored and
+    # q/k/v already saved as weight-GEMM outputs, the rematerialized
+    # backward DCEs the forward pallas call instead of re-running it.
+    from jax.ad_checkpoint import checkpoint_name
+
+    out = checkpoint_name(out, "flash_out")
+    lse = checkpoint_name(lse, "flash_out")
     return out, (q, k, v, mask, lens, out, lse)
 
 

@@ -335,3 +335,30 @@ def test_autotune_cache_roundtrip(tmp_path, monkeypatch):
     # cached preference shrinks to divide shorter sequences
     assert fa._blocks_for(256, 256, 64, "bfloat16") == (
         fa._pick_block(fa.BLOCK_Q, 256), fa._pick_block(fa.BLOCK_K, 256))
+
+
+def test_remat_policy_saves_flash_forward():
+    """The train-step remat policy must NOT re-run the flash forward kernel
+    in backward: o/lse are checkpoint_name-tagged saveables, q/k/v are
+    saved weight-GEMM outputs, so the rematerialized backward DCEs the
+    forward pallas call. Pin: grad jaxpr holds exactly 2 pallas calls
+    (fwd kernel in the forward scan, fused bwd kernel in the backward
+    scan) — 3 would mean the re-forward crept back."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.models import gpt_spmd
+    from paddle_tpu.models.gpt import GPTConfig
+
+    cfg = GPTConfig(vocab_size=128, hidden_size=64, num_layers=4,
+                    num_heads=4, max_seq_len=128, recompute=True,
+                    force_flash=True)
+    mesh = gpt_spmd.make_mesh(1)
+    params = gpt_spmd.init_params(cfg, mesh)
+    ids = jnp.zeros((2, 128), jnp.int32)
+    with jax.set_mesh(mesh):
+        jaxpr = jax.make_jaxpr(
+            lambda p: jax.grad(
+                lambda p_: gpt_spmd.loss_fn(p_, ids, ids, cfg, mesh, 1))(p)
+        )(params)
+    assert str(jaxpr).count("pallas_call") == 2

@@ -182,8 +182,6 @@ def check_grad(name, sp, args):
         n_probe = min(6, flat.size)
         idx = rng.choice(flat.size, size=n_probe, replace=False)
         for j in idx:
-            for sgn, store in ((1, "p"), (-1, "m")):
-                pass
             fp = flat.copy(); fp[j] += eps
             fm = flat.copy(); fm[j] -= eps
             a_p = [x if k != i else fp.reshape(base.shape) for k, x in enumerate(args)]
@@ -573,7 +571,6 @@ spec("searchsorted", paddle.searchsorted, lambda rng: [
     oracle=np.searchsorted)
 spec("bucketize", paddle.bucketize, lambda rng: [
     rng.randn(5), np.sort(rng.randn(8))], grad=False, bf16=False)
-spec("histogram", None, None) if False else None
 
 # ---------------------------------------------------------------------------
 # linalg
@@ -759,10 +756,6 @@ spec("strided_slice", lambda x: paddle.strided_slice(
     x, [0], [0], [4], [2]), u(shape=(5, 3)), oracle=lambda x: x[0:4:2])
 spec("getitem", lambda x: x[1:, :2], u(shape=(3, 4)),
      oracle=lambda x: x[1:, :2])
-spec("setitem", lambda x, v: paddle.tensor.manipulation._setitem_impl(
-    x, (slice(0, 2),), v) if hasattr(paddle.tensor, "manipulation")
-    else None, None) if False else None
-spec("chunk", None, None) if False else None
 spec("unfold", lambda x: paddle.unfold(x, 0, 2, 1), u(shape=(4, 3)))
 
 spec("one_hot", lambda i: F.one_hot(i, 5),
@@ -847,7 +840,6 @@ spec("rnnt_loss", F.rnnt_loss if hasattr(F, "rnnt_loss") else None,
                   np.array([6, 6], "int32"), np.array([3, 3], "int32")],
     diff=[0], grad=False, f64=False, bf16=False)
 spec("cosine_similarity", F.cosine_similarity, u2())
-spec("npair_loss", None, None) if False else None
 
 # ---------------------------------------------------------------------------
 # nn forward ops
@@ -974,8 +966,6 @@ spec("stft", lambda x: paddle.real(paddle.signal.stft(x, 8, 4)),
 spec("istft", lambda x: paddle.signal.istft(
     paddle.signal.stft(x, 8, 4), 8, 4), u(shape=(32,)), f64=False,
     grad=False)
-spec("spectrogram", lambda x: paddle.audio.functional.get_window(
-    "hann", 8) if False else None, None) if False else None
 
 # ---------------------------------------------------------------------------
 # skip list — every remaining row must have a reason
