@@ -7,6 +7,8 @@ gathers — all static-shaped, jittable.
 """
 from __future__ import annotations
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 
@@ -287,16 +289,108 @@ def _make_deform_conv2d_layer():
     return DeformConv2D
 
 
+def _make_psroi_pool_layer():
+    from ..nn import Layer
+
+    class PSRoIPool(Layer):
+        """paddle.vision.ops.PSRoIPool layer parity (reference ops.py)."""
+
+        def __init__(self, output_size, spatial_scale=1.0):
+            super().__init__()
+            self._size = output_size
+            self._scale = spatial_scale
+
+        def forward(self, x, boxes, boxes_num):
+            return psroi_pool(x, boxes, boxes_num, self._size, self._scale)
+
+    return PSRoIPool
+
+
+def _make_conv_norm_activation():
+    from ..nn import BatchNorm2D, Conv2D, ReLU, Sequential
+
+    class ConvNormActivation(Sequential):
+        """Conv → Norm → Activation block (reference ops.py:1810; the
+        torchvision misc block the paddle zoo models compose from)."""
+
+        def __init__(self, in_channels, out_channels, kernel_size=3,
+                     stride=1, padding=None, groups=1,
+                     norm_layer=BatchNorm2D, activation_layer=ReLU,
+                     dilation=1, bias=None):
+            if padding is None:
+                ks = ((kernel_size, kernel_size)
+                      if isinstance(kernel_size, int) else tuple(kernel_size))
+                ds = ((dilation, dilation)
+                      if isinstance(dilation, int) else tuple(dilation))
+                padding = [(k - 1) // 2 * d for k, d in zip(ks, ds)]
+                if padding[0] == padding[1]:
+                    padding = padding[0]
+            if bias is None:
+                bias = norm_layer is None
+            layers = [Conv2D(in_channels, out_channels, kernel_size, stride,
+                             padding, dilation=dilation, groups=groups,
+                             bias_attr=None if bias else False)]
+            if norm_layer is not None:
+                layers.append(norm_layer(out_channels))
+            if activation_layer is not None:
+                layers.append(activation_layer())
+            super().__init__(*layers)
+
+    return ConvNormActivation
+
+
+_LAZY_LAYERS = {
+    "DeformConv2D": _make_deform_conv2d_layer,
+    "PSRoIPool": _make_psroi_pool_layer,
+    "ConvNormActivation": _make_conv_norm_activation,
+}
+
+
 def __getattr__(name):
-    if name == "DeformConv2D":
-        cls = _make_deform_conv2d_layer()
-        globals()["DeformConv2D"] = cls
+    factory = _LAZY_LAYERS.get(name)
+    if factory is not None:
+        cls = factory()
+        globals()[name] = cls
         return cls
     raise AttributeError(name)
 
 
+def read_file(path, name=None):
+    """Raw file bytes as a 1-D uint8 Tensor (reference ops.py read_file)."""
+    with open(path, "rb") as f:
+        data = f.read()
+    return Tensor(jnp.asarray(np.frombuffer(data, np.uint8)))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """Decode a JPEG byte Tensor to [C, H, W] uint8 (reference ops.py
+    decode_jpeg — a CPU host op there too; served by pillow here)."""
+    import io
+
+    from PIL import Image
+
+    raw = bytes(np.asarray(x._data if isinstance(x, Tensor) else x,
+                           np.uint8).tobytes())
+    if mode not in ("unchanged", "gray", "rgb", "RGB"):
+        raise ValueError(
+            f"decode_jpeg: mode must be 'unchanged'|'gray'|'rgb', got "
+            f"{mode!r}")
+    img = Image.open(io.BytesIO(raw))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode in ("rgb", "RGB"):
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(jnp.asarray(arr))
+
+
 __all__ = ["box_iou", "nms", "roi_align", "roi_pool", "RoIAlign", "RoIPool",
-           "deform_conv2d", "DeformConv2D"]
+           "deform_conv2d", "DeformConv2D", "PSRoIPool",
+           "ConvNormActivation", "read_file", "decode_jpeg"]
 
 
 # ---------------------------------------------------------------------------

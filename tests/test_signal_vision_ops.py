@@ -168,3 +168,42 @@ def test_geometric_send_ue_recv_and_uv(rng):
     np.testing.assert_allclose(out, [[22.0], [11.0]])
     uv = np.asarray(geometric.send_uv(x, x, src, dst, "mul")._data)
     np.testing.assert_allclose(uv, [[2.0], [2.0]])
+
+
+def test_vision_ops_surface_round4(tmp_path, rng):
+    """PSRoIPool / ConvNormActivation layers + read_file / decode_jpeg IO
+    ops (reference vision/ops.py surface audit)."""
+    import io
+
+    from PIL import Image
+
+    from paddle_tpu.vision.ops import (
+        ConvNormActivation, PSRoIPool, decode_jpeg, read_file)
+
+    # ConvNormActivation: conv->bn->relu with auto 'same'-style padding
+    blk = ConvNormActivation(3, 8, kernel_size=3)
+    x = paddle.to_tensor(rng.randn(2, 3, 8, 8).astype("float32"))
+    out = blk(x)
+    assert tuple(out.shape) == (2, 8, 8, 8)
+    assert float(out.numpy().min()) >= 0.0  # relu applied
+    assert len(blk.parameters()) >= 3  # conv w + bn gamma/beta
+
+    # PSRoIPool layer wraps psroi_pool
+    feat = paddle.to_tensor(rng.randn(1, 8, 10, 10).astype("float32"))
+    boxes = paddle.to_tensor(
+        np.array([[1.0, 1.0, 8.0, 8.0]], np.float32))
+    bn = paddle.to_tensor(np.array([1], np.int32))
+    pooled = PSRoIPool(2, 1.0)(feat, boxes, bn)
+    assert tuple(pooled.shape) == (1, 2, 2, 2)
+
+    # read_file + decode_jpeg round-trip through a real JPEG
+    img = Image.fromarray(
+        (rng.rand(6, 5, 3) * 255).astype("uint8"), "RGB")
+    p = tmp_path / "t.jpg"
+    img.save(p, "JPEG")
+    raw = read_file(str(p))
+    assert raw.dtype == paddle.uint8 and raw.ndim == 1
+    chw = decode_jpeg(raw, mode="rgb")
+    assert tuple(chw.shape) == (3, 6, 5)
+    gray = decode_jpeg(raw, mode="gray")
+    assert tuple(gray.shape) == (1, 6, 5)
