@@ -64,6 +64,7 @@ from .tensor.stat import *  # noqa: F401,F403
 from .tensor.random import *  # noqa: F401,F403
 from .tensor.einsum import einsum
 from .tensor import linalg
+from .tensor.linalg import cdist  # top-level paddle.cdist parity
 from . import fft
 
 # Subpackages (populated as layers come online; see SURVEY.md §7.2 build order).

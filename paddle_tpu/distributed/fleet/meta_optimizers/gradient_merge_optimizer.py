@@ -57,9 +57,25 @@ class GradientMergeOptimizer:
         return None, None
 
     def state_dict(self):
-        return self._inner_opt.state_dict()
+        """Inner state + the in-flight merge buffers (a checkpoint taken
+        mid-accumulation must not drop k-1 microbatches of gradient — the
+        reference's @GRAD@MERGED vars are persistable program state too)."""
+        from ....tensor.tensor import Tensor
+
+        sd = self._inner_opt.state_dict()
+        sd["gm_micro"] = self._micro
+        for p in self._inner_opt._parameter_list:
+            buf = self._acc.get(id(p))
+            if buf is not None:
+                sd[f"{p.name}_gm_acc"] = Tensor(buf)
+        return sd
 
     def set_state_dict(self, sd):
+        self._micro = int(sd.get("gm_micro", 0))
+        for p in self._inner_opt._parameter_list:
+            buf = sd.get(f"{p.name}_gm_acc")
+            if buf is not None:
+                self._acc[id(p)] = getattr(buf, "_data", buf)
         return self._inner_opt.set_state_dict(sd)
 
     def __getattr__(self, name):

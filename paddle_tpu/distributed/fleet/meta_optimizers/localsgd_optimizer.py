@@ -90,9 +90,16 @@ class LocalSGDOptimizer:
         return None, None
 
     def state_dict(self):
-        return self._inner_opt.state_dict()
+        """Inner state + the sync schedule position (resume must not re-run
+        the warmup phase or shift the every-k cadence)."""
+        sd = self._inner_opt.state_dict()
+        sd["localsgd_step"] = self._step_num
+        sd["localsgd_last_sync"] = self._last_sync
+        return sd
 
     def set_state_dict(self, sd):
+        self._step_num = int(sd.get("localsgd_step", self._step_num))
+        self._last_sync = int(sd.get("localsgd_last_sync", self._last_sync))
         return self._inner_opt.set_state_dict(sd)
 
     def __getattr__(self, name):

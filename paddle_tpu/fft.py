@@ -4,21 +4,28 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from .autograd.engine import apply_op
+from .framework.op_registry import register_op
 
 
-def _wrap(name, fn):
+def _wrap(op_name, fn):
+    # NB: the public kwarg is ``name`` (paddle signature) — the op's
+    # registry name must NOT be shadowed by it
     def op(x, n=None, axis=-1, norm="backward", name=None):
-        return apply_op(name, lambda v: fn(v, n=n, axis=axis, norm=norm), x)
+        return apply_op(op_name,
+                        lambda v: fn(v, n=n, axis=axis, norm=norm), x)
 
-    op.__name__ = name
+    op.__name__ = op_name
+    register_op(op_name)
     return op
 
 
-def _wrap_nd(name, fn):
+def _wrap_nd(op_name, fn):
     def op(x, s=None, axes=None, norm="backward", name=None):
-        return apply_op(name, lambda v: fn(v, s=s, axes=axes, norm=norm), x)
+        return apply_op(op_name,
+                        lambda v: fn(v, s=s, axes=axes, norm=norm), x)
 
-    op.__name__ = name
+    op.__name__ = op_name
+    register_op(op_name)
     return op
 
 
@@ -56,3 +63,28 @@ def fftshift(x, axes=None, name=None):
 
 def ifftshift(x, axes=None, name=None):
     return apply_op("ifftshift", lambda v: jnp.fft.ifftshift(v, axes=axes), x)
+
+
+def _swap_norm(norm):
+    # hfft-family identities flip the transform direction, so the
+    # normalization mode flips with it (numpy/torch convention)
+    return {"backward": "forward", "forward": "backward"}.get(norm, norm)
+
+
+def _hfftn_impl(v, s, axes, norm):
+    # hfft identity: real output of a Hermitian input == irfftn of the
+    # conjugate with the normalization direction flipped; jnp applies the
+    # numpy/torch axes defaults (last len(s) dims when s is given)
+    return jnp.fft.irfftn(jnp.conj(v), s=s, axes=axes, norm=_swap_norm(norm))
+
+
+def _ihfftn_impl(v, s, axes, norm):
+    return jnp.conj(jnp.fft.rfftn(v, s=s, axes=axes, norm=_swap_norm(norm)))
+
+
+hfft2 = _wrap_nd("hfft2", lambda v, s, axes, norm: _hfftn_impl(
+    v, s, axes or (-2, -1), norm))
+ihfft2 = _wrap_nd("ihfft2", lambda v, s, axes, norm: _ihfftn_impl(
+    v, s, axes or (-2, -1), norm))
+hfftn = _wrap_nd("hfftn", _hfftn_impl)
+ihfftn = _wrap_nd("ihfftn", _ihfftn_impl)

@@ -145,8 +145,8 @@ _bulk([
     "broadcast", "broadcast_tensors", "broadcast_to", "cast", "celu",
     "channel_shuffle", "cholesky_solve", "clip", "clone", "complex",
     "concat", "cond", "copysign", "corrcoef", "cosine_embedding_loss", "cov",
-    "crop", "cross", "cummax", "cummin", "cumulative_trapezoid",
-    "deform_conv2d",
+    "cdist", "crop", "cross", "cummax", "cummin", "cumulative_trapezoid",
+    "deform_conv2d", "matrix_exp", "pca_lowrank",
     "dense_to_sparse", "diag", "diag_embed", "diagflat", "diagonal", "diff",
     "divide", "dot", "dropout", "eigvals", "eigvalsh", "elu", "embedding",
     "expand", "expand_as", "fake_channel_quant_dequant",

@@ -266,3 +266,57 @@ def householder_product(x, tau, name=None):
 
     return apply_op("householder_product", fn, x, tau)
 
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
+          name=None):
+    """Batched pairwise p-norm distances [*,P,M] x [*,R,M] -> [*,P,R]
+    (reference tensor/linalg.py cdist over the phi dist kernels). The
+    euclidean case contracts on the MXU (||a-b||^2 = ||a||^2 + ||b||^2
+    - 2ab) matching the reference's use_mm compute mode."""
+
+    use_mm = compute_mode in ("use_mm_for_euclid_dist_if_necessary",
+                              "use_mm_for_euclid_dist")
+
+    def fn(a, b):
+        if p == 2.0 and use_mm:
+            a2 = jnp.sum(a * a, axis=-1)[..., :, None]
+            b2 = jnp.sum(b * b, axis=-1)[..., None, :]
+            ab = jnp.matmul(a, jnp.swapaxes(b, -1, -2))
+            d2 = jnp.clip(a2 + b2 - 2 * ab, 0.0)
+            # zero distances: sqrt'(0) is inf — define the grad as 0 there
+            # (torch convention) via a masked sqrt
+            pos = d2 > 0
+            return jnp.where(pos, jnp.sqrt(jnp.where(pos, d2, 1.0)), 0.0)
+        diff = jnp.abs(a[..., :, None, :] - b[..., None, :, :])
+        if p == 0:
+            return jnp.sum((diff != 0).astype(a.dtype), axis=-1)
+        if jnp.isinf(p):
+            return jnp.max(diff, axis=-1)
+        return jnp.sum(diff ** p, axis=-1) ** (1.0 / p)
+
+    return apply_op("cdist", fn, x, y)
+
+
+def matrix_exp(x, name=None):
+    """Matrix exponential (reference linalg.matrix_exp over phi)."""
+    return apply_op("matrix_exp",
+                    lambda v: jax.scipy.linalg.expm(v), x)
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Low-rank PCA: (U, S, V) with q components (reference
+    linalg.pca_lowrank — the torch-style randomized algorithm; computed
+    here via the exact thin SVD, which the TPU's MXU-backed jnp SVD makes
+    affordable at these ranks and is a strict-accuracy superset of the
+    randomized reference)."""
+    if q is None:
+        q = min(6, x.shape[-2], x.shape[-1])
+
+    def fn(v):
+        a = v - jnp.mean(v, axis=-2, keepdims=True) if center else v
+        u, s, vh = jnp.linalg.svd(a, full_matrices=False)
+        return (u[..., :, :q], s[..., :q],
+                jnp.swapaxes(vh, -1, -2)[..., :, :q])
+
+    return apply_op("pca_lowrank", fn, x)

@@ -1,0 +1,100 @@
+"""paddle.fft oracle tests vs numpy (and torch for the hfft family).
+
+This suite exists because the fft wrappers previously dispatched with a
+shadowed (None) op name — no strict-registry test ever exercised them.
+Every public transform gets a numpy-oracle check; the Hermitian 2-D/N-D
+family (implemented via the conj/irfftn identity with a flipped norm) is
+additionally cross-checked against torch.fft.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _t(a):
+    return paddle.to_tensor(a)
+
+
+@pytest.mark.parametrize("norm", ["backward", "ortho", "forward"])
+def test_1d_family_vs_numpy(norm, rng):
+    x = rng.randn(16).astype("float32")
+    c = (rng.randn(16) + 1j * rng.randn(16)).astype("complex64")
+    for pf, nf, arg in [
+        (paddle.fft.fft, np.fft.fft, c),
+        (paddle.fft.ifft, np.fft.ifft, c),
+        (paddle.fft.rfft, np.fft.rfft, x),
+        (paddle.fft.hfft, np.fft.hfft, c[:9]),
+        (paddle.fft.ihfft, np.fft.ihfft, x),
+    ]:
+        got = pf(_t(arg), norm=norm).numpy()
+        np.testing.assert_allclose(got, nf(arg, norm=norm), rtol=1e-4,
+                                   atol=1e-5)
+    got = paddle.fft.irfft(_t(np.fft.rfft(x).astype("complex64")),
+                           n=16, norm=norm).numpy()
+    np.testing.assert_allclose(
+        got, np.fft.irfft(np.fft.rfft(x), n=16, norm=norm), rtol=1e-4,
+        atol=1e-5)
+
+
+@pytest.mark.parametrize("norm", ["backward", "ortho", "forward"])
+def test_nd_family_vs_numpy(norm, rng):
+    x = rng.randn(4, 6).astype("float32")
+    c = (rng.randn(4, 6) + 1j * rng.randn(4, 6)).astype("complex64")
+    for pf, nf, arg in [
+        (paddle.fft.fft2, np.fft.fft2, c),
+        (paddle.fft.ifft2, np.fft.ifft2, c),
+        (paddle.fft.rfft2, np.fft.rfft2, x),
+        (paddle.fft.fftn, np.fft.fftn, c),
+        (paddle.fft.ifftn, np.fft.ifftn, c),
+        (paddle.fft.rfftn, np.fft.rfftn, x),
+    ]:
+        got = pf(_t(arg), norm=norm).numpy()
+        np.testing.assert_allclose(got, nf(arg, norm=norm), rtol=1e-4,
+                                   atol=1e-5)
+
+
+@pytest.mark.parametrize("norm", ["backward", "ortho", "forward"])
+def test_hermitian_nd_vs_torch(norm, rng):
+    torch = pytest.importorskip("torch")
+    x = rng.randn(4, 6).astype("float32")
+    c = (rng.randn(4, 4) + 1j * rng.randn(4, 4)).astype("complex64")
+
+    got = paddle.fft.ihfft2(_t(x), norm=norm).numpy()
+    ref = torch.fft.ihfft2(torch.tensor(x), norm=norm).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+    got = paddle.fft.ihfftn(_t(x), norm=norm).numpy()
+    ref = torch.fft.ihfftn(torch.tensor(x), norm=norm).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+    got = paddle.fft.hfft2(_t(c), norm=norm).numpy()
+    ref = torch.fft.hfft2(torch.tensor(c), norm=norm).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+    got = paddle.fft.hfftn(_t(c), norm=norm).numpy()
+    ref = torch.fft.hfftn(torch.tensor(c), norm=norm).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_fft_differentiable(rng):
+    x = paddle.to_tensor(rng.randn(8).astype("float32"))
+    x.stop_gradient = False
+    y = paddle.fft.rfft(x)
+    loss = (y.real() ** 2 + y.imag() ** 2).sum()
+    loss.backward()
+    assert x.grad is not None
+    # Parseval: d/dx sum|rfft(x)|^2 relates linearly to x — check numerics
+    # by finite difference on one coordinate
+    eps = 1e-3
+    xp = x.numpy().copy()
+    xp[3] += eps
+    xm = x.numpy().copy()
+    xm[3] -= eps
+
+    def f(v):
+        yy = np.fft.rfft(v)
+        return float((np.abs(yy) ** 2).sum())
+
+    fd = (f(xp) - f(xm)) / (2 * eps)
+    np.testing.assert_allclose(float(x.grad.numpy()[3]), fd, rtol=5e-2)

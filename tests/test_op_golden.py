@@ -988,6 +988,11 @@ _SKIP_GROUPS = {
         "pipeline_spmd", "pipeline_spmd_interleaved", "moe_layer",
         "transpose_all", "transpose_last2", "unsqueeze_last",
     ],
+    "fft family (complex dtypes; oracle-checked against numpy/torch in tests/test_fft.py)": [
+        "fft", "ifft", "rfft", "irfft", "hfft", "ihfft", "fft2", "ifft2",
+        "rfft2", "irfft2", "fftn", "ifftn", "rfftn", "irfftn", "hfft2",
+        "ihfft2", "hfftn", "ihfftn",
+    ],
     "graph-capture/structural op (covered by tests/test_jit.py, test_static.py, test_autograd.py)": [
         "jit_program", "jit_loaded_program", "gradients", "recompute",
     ],
@@ -1126,6 +1131,25 @@ spec("deform_conv2d",
      lambda rng: [rng.randn(1, 2, 5, 5), 0.5 * rng.randn(1, 2 * 9, 5, 5),
                   rng.randn(3, 2, 3, 3)],
      oracle=_deform_conv2d_oracle, grad_rtol=5e-3, grad_atol=5e-4)
+
+spec("cdist", lambda x, y: paddle.cdist(x, y), lambda rng: [
+    rng.randn(3, 4), rng.randn(5, 4)],
+    oracle=lambda x, y: np.sqrt(
+        ((x[:, None, :] - y[None, :, :]) ** 2).sum(-1)),
+    grad_rtol=5e-3, grad_atol=5e-4)
+try:
+    from scipy.linalg import expm as _scipy_expm
+except ImportError:  # spec-level skip: no oracle when scipy is absent
+    _scipy_expm = None
+spec("matrix_exp", lambda x: paddle.linalg.matrix_exp(x), lambda rng: [
+    0.3 * rng.randn(4, 4)], oracle=_scipy_expm, grad=False)
+spec("pca_lowrank",
+     lambda x: paddle.linalg.pca_lowrank(x, q=2)[1],  # singular values
+     lambda rng: [rng.randn(8, 5)],
+     oracle=lambda x: np.linalg.svd(
+         x - x.mean(0, keepdims=True), compute_uv=False)[:2],
+     grad=False)
+
 
 spec("sequence_mask",
      lambda x: F.sequence_mask(x, maxlen=6),
