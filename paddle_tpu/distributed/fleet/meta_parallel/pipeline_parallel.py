@@ -72,13 +72,23 @@ class PipelineParallel(Layer):
         return loss
 
     def eval_batch(self, data, compute_loss=True):
+        from ....autograd.grad_mode import no_grad
+
         self.eval()
-        inputs, labels = self._load_micro_batches(data)
-        losses = []
-        for x, y in zip(inputs, labels):
-            out = self._layers(x)
-            losses.append(self._compute_loss(out, y))
-        return _mean_losses(losses)
+        with no_grad():  # evaluation holds no autodiff residuals
+            if self._can_compile_schedule():
+                out, losses = self._compiled_batch(data)
+                return _mean_losses(losses) if compute_loss else out
+            inputs, labels = self._load_micro_batches(data)
+            outs, losses = [], []
+            for x, y in zip(inputs, labels):
+                out = self._layers(x)
+                outs.append(out)
+                if compute_loss:
+                    losses.append(self._compute_loss(out, y))
+            if compute_loss:
+                return _mean_losses(losses)
+            return outs[0] if len(outs) == 1 else outs
 
     def forward_backward_pipeline(self, data, scaler=None, static_scheduler=False):
         """Micro-batched forward+backward with grad accumulation.
@@ -145,12 +155,12 @@ class PipelineParallel(Layer):
         v = getattr(self, "_virtual_pp_degree", 1)
         return bool(mid) and len(mid) % (S * v) == 0
 
-    def _compiled_forward_backward(self, data, scaler=None):
-        """One batch through the compiled stacked-stage schedule: forward
-        via ``compiled_forward`` (circular VPP when _virtual_pp_degree > 1),
-        then the SAME per-microbatch loss semantics as the sequential path
-        (mean over microbatch losses — for a sum-style loss_fn that is NOT
-        the full-batch loss), one backward through the scanned graph."""
+    def _compiled_batch(self, data):
+        """One batch through the compiled stacked-stage schedule. Returns
+        (full output, per-microbatch losses) — the SAME per-microbatch loss
+        semantics as the sequential path (mean over microbatch losses; for
+        a sum-style loss_fn that is NOT the full-batch loss). Shared by
+        train and eval so the calling convention cannot diverge."""
         if isinstance(data, (tuple, list)) and len(data) == 2:
             x, y = data
         else:
@@ -162,6 +172,12 @@ class PipelineParallel(Layer):
             num_virtual=getattr(self, "_virtual_pp_degree", 1))
         losses = [self._compute_loss(o, yb)
                   for o, yb in zip(_split_micro(out, n), _split_micro(y, n))]
+        return out, losses
+
+    def _compiled_forward_backward(self, data, scaler=None):
+        """Compiled forward (circular VPP when _virtual_pp_degree > 1) +
+        one backward through the scanned pipeline graph."""
+        _, losses = self._compiled_batch(data)
         loss = _mean_losses(losses)
         (scaler.scale(loss) if scaler is not None else loss).backward()
         self._layers.allreduce_shared_weight_gradients()

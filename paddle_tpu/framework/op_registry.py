@@ -154,7 +154,9 @@ _bulk([
     "fmax", "fmin", "fold", "frame", "fused_bias_dropout_residual_ln",
     "fused_dropout_add", "fused_layer_norm", "fused_linear",
     "fused_linear_activation", "fused_rms_norm", "fused_rope", "gather",
-    "gather_nd", "gather_slice", "gaussian", "gcd", "gelu", "getitem", "glu",
+    "gather_nd", "gather_slice", "gaussian", "gaussian_nll_loss", "gcd",
+    "gelu", "getitem", "glu", "hsigmoid_loss", "multi_margin_loss",
+    "poisson_nll_loss", "triplet_margin_with_distance_loss", "unflatten",
     "gradients", "grid_sample", "gru_cell", "gumbel_softmax", "hardshrink",
     "hardsigmoid", "hardswish", "hardtanh", "heaviside",
     "hinge_embedding_loss", "householder_product", "huber_loss", "hypot",

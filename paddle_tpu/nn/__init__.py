@@ -30,6 +30,7 @@ from .layer.norm import (
     LayerNorm,
     LocalResponseNorm,
     RMSNorm,
+    SpectralNorm,
     SyncBatchNorm,
 )
 from .layer.pooling import *  # noqa: F401,F403

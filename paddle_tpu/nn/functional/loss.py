@@ -370,3 +370,131 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0, reduction
         return loss
 
     return apply_op("ctc_loss", fn, log_probs, labels, input_lengths, label_lengths)
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    """Gaussian negative log likelihood (reference nn/functional/loss.py
+    gaussian_nll_loss over phi): 0.5*(log(max(var,eps)) + (x-y)^2/max(var,
+    eps)), + 0.5*log(2*pi) when full."""
+    import math
+
+    def fn(x, y, var):
+        v = jnp.clip(var, epsilon)
+        loss = 0.5 * (jnp.log(v) + jnp.square(x - y) / v)
+        if full:
+            loss = loss + 0.5 * math.log(2 * math.pi)
+        return _reduce(loss, reduction)
+
+    return apply_op("gaussian_nll_loss", fn, input, label, variance)
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False,
+                     epsilon=1e-8, reduction="mean", name=None):
+    """Poisson NLL (reference loss.py poisson_nll_loss): exp(x) - y*x when
+    log_input else x - y*log(x+eps); Stirling term for y > 1 when full."""
+
+    def fn(x, y):
+        if log_input:
+            loss = jnp.exp(x) - y * x
+        else:
+            loss = x - y * jnp.log(x + epsilon)
+        if full:
+            stirling = y * jnp.log(y) - y + 0.5 * jnp.log(2 * jnp.pi * y)
+            loss = loss + jnp.where(y > 1, stirling, 0.0)
+        return _reduce(loss, reduction)
+
+    return apply_op("poisson_nll_loss", fn, input, label)
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    """Multi-class margin loss (reference loss.py multi_margin_loss):
+    mean_j!=y max(0, margin - x_y + x_j)^p / C, optionally scaled by
+    weight[y]."""
+
+    def fn(x, y, *rest):
+        n, c = x.shape
+        xy = jnp.take_along_axis(x, y[:, None], axis=1)  # [n, 1]
+        m = jnp.maximum(0.0, margin - xy + x) ** p
+        m = m * (1.0 - jax.nn.one_hot(y, c, dtype=x.dtype))  # drop j == y
+        if rest:
+            m = m * rest[0][y][:, None]
+        return _reduce(jnp.sum(m, axis=1) / c, reduction)
+
+    args = [input, label] + ([weight] if weight is not None else [])
+    return apply_op("multi_margin_loss", fn, *args)
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean",
+                                      name=None):
+    """Triplet loss with a user distance (reference loss.py
+    triplet_margin_with_distance_loss; default distance = pairwise L2)."""
+    dist = distance_function or (
+        lambda a, b: jnp.sqrt(jnp.clip(jnp.sum(jnp.square(a - b), -1),
+                                       1e-12)))
+
+    def fn(a, pos, neg):
+        dp = dist(a, pos)
+        dn = dist(a, neg)
+        if swap:
+            dn = jnp.minimum(dn, dist(pos, neg))
+        return _reduce(jnp.maximum(0.0, dp - dn + margin), reduction)
+
+    return apply_op("triplet_margin_with_distance_loss", fn, input,
+                    positive, negative)
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid loss (reference loss.py hsigmoid_loss over
+    phi/kernels/funcs/matrix_bit_code.h SimpleCode): default complete
+    binary tree — class c encodes as c + num_classes, internal node for
+    bit j is (code >> (j+1)) - 1, the binary target is bit j of the code;
+    per-sample loss = sum over the path of BCE-with-logits. Custom trees
+    ride (path_table, path_code). Returns [N, 1] like the reference."""
+    import numpy as np
+
+    C = int(num_classes)
+    max_len = int(np.ceil(np.log2(max(C, 2)))) + 1
+
+    def fn(x, y, w, *rest):
+        b = rest[0] if rest else None
+        code = y.astype(jnp.int32) + C
+        # length = position of the leading 1 (floor(log2(code)))
+        lengths = jnp.floor(
+            jnp.log2(code.astype(jnp.float32) + 0.5)).astype(jnp.int32)
+        total = jnp.zeros(x.shape[0], jnp.float32)
+        for j in range(max_len):
+            active = j < lengths
+            idx = jnp.clip((code >> (j + 1)) - 1, 0, w.shape[0] - 1)
+            bit = ((code >> j) & 1).astype(jnp.float32)
+            logit = jnp.sum(x * w[idx], axis=-1)
+            if b is not None:
+                logit = logit + b[idx]
+            # BCE with logits on target=bit: softplus(logit) - bit*logit
+            loss_j = jax.nn.softplus(logit) - bit * logit
+            total = total + jnp.where(active, loss_j.astype(jnp.float32),
+                                      0.0)
+        return total[:, None]
+
+    def fn_custom(x, table, code_bits, w, *rest):
+        b = rest[0] if rest else None
+        valid = table >= 0
+        idx = jnp.clip(table, 0, w.shape[0] - 1)
+        logit = jnp.einsum("nd,nld->nl", x, w[idx])
+        if b is not None:
+            logit = logit + b[idx]
+        bit = code_bits.astype(logit.dtype)
+        loss = jax.nn.softplus(logit) - bit * logit
+        return jnp.sum(jnp.where(valid, loss, 0.0), axis=1)[:, None]
+
+    if path_table is not None and path_code is not None:
+        args = [input, path_table, path_code, weight]
+        args += [bias] if bias is not None else []
+        return apply_op("hsigmoid_loss", fn_custom, *args)
+    args = [input, label, weight] + ([bias] if bias is not None else [])
+    return apply_op("hsigmoid_loss", fn, *args)

@@ -252,3 +252,16 @@ class Fold(Layer):
 
     def forward(self, x):
         return F.fold(x, *self.args)
+
+
+class Unflatten(Layer):
+    """Split one axis into a shape (reference common.py Unflatten)."""
+
+    def __init__(self, axis, shape, name=None):
+        super().__init__()
+        self.axis, self.shape = axis, shape
+
+    def forward(self, x):
+        from ...tensor.manipulation import unflatten
+
+        return unflatten(x, self.axis, self.shape)

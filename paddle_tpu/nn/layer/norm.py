@@ -188,6 +188,68 @@ class LocalResponseNorm(Layer):
 
 
 class SpectralNorm(Layer):
-    def __init__(self, weight_shape, dim=0, power_iters=1, epsilon=1e-12, dtype="float32"):
+    """Spectral normalization as a LAYER over a weight tensor (reference
+    nn/layer/norm.py SpectralNorm / phi spectral_norm kernel): power
+    iteration estimates the largest singular value of the weight reshaped
+    to [dim, -1]; forward returns weight / sigma. The u/v estimates are
+    persistent buffers (reference keeps them as persistable vars)."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12,
+                 epsilon=None, dtype="float32", name=None):
+        if epsilon is not None:  # reference kwarg spelling
+            eps = epsilon
         super().__init__()
-        raise NotImplementedError("SpectralNorm layer: use paddle_tpu.nn.utils.spectral_norm")
+        import numpy as np
+
+        import jax.numpy as jnp
+
+        self._dim = int(dim)
+        self._power_iters = int(power_iters)
+        self._eps = float(eps)
+        h = int(weight_shape[self._dim])
+        w = 1
+        for i, s in enumerate(weight_shape):
+            if i != self._dim:
+                w *= int(s)
+        rng = np.random.RandomState(0)
+        from ...tensor.tensor import Tensor
+
+        self.weight_u = Tensor(jnp.asarray(
+            rng.randn(h).astype("float32")))
+        self.weight_v = Tensor(jnp.asarray(
+            rng.randn(w).astype("float32")))
+        self.register_buffer("weight_u", self.weight_u)
+        self.register_buffer("weight_v", self.weight_v)
+
+    def forward(self, weight):
+        from ...autograd.engine import apply_op
+
+        dim, iters, eps = self._dim, self._power_iters, self._eps
+
+        def fn(w, u, v):
+            import jax
+            import jax.numpy as jnp
+
+            perm = (dim,) + tuple(i for i in range(w.ndim) if i != dim)
+            m = jnp.transpose(w, perm).reshape(w.shape[dim], -1)
+
+            def it(_, uv):
+                u_, v_ = uv
+                v_ = m.T @ u_
+                v_ = v_ / (jnp.linalg.norm(v_) + eps)
+                u_ = m @ v_
+                u_ = u_ / (jnp.linalg.norm(u_) + eps)
+                return u_, v_
+
+            u_, v_ = jax.lax.fori_loop(0, iters, it, (u, v))
+            u_ = jax.lax.stop_gradient(u_)
+            v_ = jax.lax.stop_gradient(v_)
+            sigma = u_ @ (m @ v_)
+            return w / sigma, u_, v_
+
+        out, u_new, v_new = apply_op("spectral_norm", fn, weight,
+                                     self.weight_u, self.weight_v)
+        # persist the power-iteration state (buffers, not differentiable)
+        self.weight_u._data = u_new._data
+        self.weight_v._data = v_new._data
+        return out

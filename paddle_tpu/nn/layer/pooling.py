@@ -33,3 +33,35 @@ AdaptiveMaxPool2D = _make("AdaptiveMaxPool2D", "adaptive_max_pool2d", ["output_s
 AdaptiveMaxPool3D = _make("AdaptiveMaxPool3D", "adaptive_max_pool3d", ["output_size", "return_mask"])
 LPPool1D = _make("LPPool1D", "lp_pool1d", ["norm_type", "kernel_size", "stride", "padding", "ceil_mode", "data_format"])
 LPPool2D = _make("LPPool2D", "lp_pool2d", ["norm_type", "kernel_size", "stride", "padding", "ceil_mode", "data_format"])
+
+FractionalMaxPool2D = _make(
+    "FractionalMaxPool2D", "fractional_max_pool2d",
+    ["output_size", "kernel_size", "random_u", "return_mask"])
+FractionalMaxPool3D = _make(
+    "FractionalMaxPool3D", "fractional_max_pool3d",
+    ["output_size", "kernel_size", "random_u", "return_mask"])
+
+
+def _make_unpool(cls_name, fn_name, data_format_default):
+    import paddle_tpu.nn.functional as F
+
+    class _UnPool(Layer):
+        def __init__(self, kernel_size, stride=None, padding=0,
+                     data_format=data_format_default, output_size=None,
+                     name=None):
+            super().__init__()
+            self._args = (kernel_size, stride, padding, data_format,
+                          output_size)
+
+        def forward(self, x, indices):
+            k, s, p, df, out = self._args
+            return getattr(F, fn_name)(x, indices, k, stride=s, padding=p,
+                                       data_format=df, output_size=out)
+
+    _UnPool.__name__ = cls_name
+    return _UnPool
+
+
+MaxUnPool1D = _make_unpool("MaxUnPool1D", "max_unpool1d", "NCL")
+MaxUnPool2D = _make_unpool("MaxUnPool2D", "max_unpool2d", "NCHW")
+MaxUnPool3D = _make_unpool("MaxUnPool3D", "max_unpool3d", "NCDHW")

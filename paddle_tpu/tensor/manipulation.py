@@ -79,6 +79,16 @@ def flatten(x, start_axis=0, stop_axis=-1, name=None):
     return apply_op("flatten", fn, x)
 
 
+def unflatten(x, axis, shape, name=None):
+    """Split ``axis`` into ``shape`` (reference manipulation.py unflatten).
+    One -1 entry in ``shape`` is inferred."""
+    def fn(v):
+        a = axis % v.ndim
+        return jnp.reshape(v, v.shape[:a] + tuple(shape) + v.shape[a + 1:])
+
+    return apply_op("unflatten", fn, x)
+
+
 def squeeze(x, axis=None, name=None):
     def fn(v):
         if axis is None:

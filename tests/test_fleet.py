@@ -1319,3 +1319,46 @@ def test_meta_wrapper_checkpoint_roundtrip(rng):
                             k_steps=3, begin_step=1)
     ls2.set_state_dict(sd)
     assert ls2._step_num == 5 and ls2._last_sync == 4
+
+
+def test_eval_batch_routes_to_compiled_schedule(rng, monkeypatch):
+    """eval_batch rides the compiled stacked-stage schedule when the pp
+    mesh is available (same routing contract as train_batch)."""
+    from paddle_tpu.distributed.fleet.meta_parallel import (
+        LayerDesc, PipelineLayer, PipelineParallel, gspmd_pipeline)
+
+    class Block(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(8, 8)
+
+        def forward(self, x):
+            return x + self.fc(x).tanh()
+
+    strat = fleet.DistributedStrategy()
+    strat.hybrid_configs = {"dp_degree": 1, "pp_degree": 2}
+    strat.pipeline_configs = {"accumulate_steps": 2, "micro_batch_size": 2}
+    fleet.init(is_collective=True, strategy=strat)
+    hcg = fleet.get_hybrid_communicate_group()
+
+    calls = {"n": 0}
+    orig = gspmd_pipeline.pipeline_spmd
+
+    def spy(*a, **k):
+        calls["n"] += 1
+        return orig(*a, **k)
+
+    monkeypatch.setattr(gspmd_pipeline, "pipeline_spmd", spy)
+    paddle.seed(13)
+    pl = PipelineLayer(
+        layers=[nn.Embedding(16, 8), *[LayerDesc(Block) for _ in range(4)],
+                nn.Linear(8, 4)],
+        num_stages=2, loss_fn=lambda out, y: ((out - y) ** 2).mean())
+    pp_rt = PipelineParallel(pl, hcg=hcg, strategy=strat)
+    ids = paddle.to_tensor(rng.randint(0, 16, (4, 6)).astype("int64"))
+    y = paddle.to_tensor(rng.randn(4, 6, 4).astype("float32"))
+    loss = pp_rt.eval_batch([ids, y])
+    assert calls["n"] >= 1
+    ref = ((pl(ids) - y) ** 2).mean()
+    np.testing.assert_allclose(float(loss.numpy()), float(ref.numpy()),
+                               rtol=2e-4, atol=1e-5)

@@ -324,3 +324,133 @@ def test_pool2d_ceil_mode_matches_torch(ceil, rng):
                            exclusive=False).numpy()
         assert out.shape == ref.shape
         np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+class TestRound4LossAndLayerSurface:
+    """New losses vs the torch oracles + the new layer wrappers
+    (reference nn/functional/loss.py + nn/layer surface audit)."""
+
+    def test_gaussian_nll_loss_vs_torch(self, rng):
+        torch = pytest.importorskip("torch")
+        x = rng.randn(8, 5).astype("float32")
+        y = rng.randn(8, 5).astype("float32")
+        var = (rng.rand(8, 5).astype("float32") + 0.1)
+        for full in (False, True):
+            for red in ("mean", "sum", "none"):
+                got = F.gaussian_nll_loss(
+                    paddle.to_tensor(x), paddle.to_tensor(y),
+                    paddle.to_tensor(var), full=full, reduction=red).numpy()
+                ref = torch.nn.functional.gaussian_nll_loss(
+                    torch.tensor(x), torch.tensor(y), torch.tensor(var),
+                    full=full, reduction=red).numpy()
+                np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+    def test_poisson_nll_loss_vs_torch(self, rng):
+        torch = pytest.importorskip("torch")
+        x = rng.randn(8, 5).astype("float32")
+        y = rng.poisson(3.0, (8, 5)).astype("float32")
+        for log_input in (True, False):
+            xx = x if log_input else np.abs(x) + 0.1
+            for full in (False, True):
+                got = F.poisson_nll_loss(
+                    paddle.to_tensor(xx), paddle.to_tensor(y),
+                    log_input=log_input, full=full).numpy()
+                ref = torch.nn.functional.poisson_nll_loss(
+                    torch.tensor(xx), torch.tensor(y),
+                    log_input=log_input, full=full).numpy()
+                np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+    def test_multi_margin_loss_vs_torch(self, rng):
+        torch = pytest.importorskip("torch")
+        x = rng.randn(6, 5).astype("float32")
+        y = rng.randint(0, 5, (6,)).astype("int64")
+        w = rng.rand(5).astype("float32")
+        for p in (1, 2):
+            got = F.multi_margin_loss(
+                paddle.to_tensor(x), paddle.to_tensor(y), p=p,
+                margin=0.7, weight=paddle.to_tensor(w)).numpy()
+            ref = torch.nn.functional.multi_margin_loss(
+                torch.tensor(x), torch.tensor(y), p=p, margin=0.7,
+                weight=torch.tensor(w)).numpy()
+            np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+    def test_triplet_margin_with_distance_loss_vs_torch(self, rng):
+        torch = pytest.importorskip("torch")
+        a = rng.randn(6, 8).astype("float32")
+        p_ = rng.randn(6, 8).astype("float32")
+        n = rng.randn(6, 8).astype("float32")
+        for swap in (False, True):
+            got = F.triplet_margin_with_distance_loss(
+                paddle.to_tensor(a), paddle.to_tensor(p_),
+                paddle.to_tensor(n), margin=0.8, swap=swap).numpy()
+            ref = torch.nn.functional.triplet_margin_with_distance_loss(
+                torch.tensor(a), torch.tensor(p_), torch.tensor(n),
+                margin=0.8, swap=swap).numpy()
+            np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+    def test_hsigmoid_loss_small_tree_oracle(self, rng):
+        """4-class default tree: hand-computed SimpleCode paths
+        (phi matrix_bit_code.h: code = label + C, node = (code>>(j+1))-1,
+        bit = (code>>j)&1, j < floor(log2(code)))."""
+        C, D, N = 4, 3, 5
+        x = rng.randn(N, D).astype("float32")
+        y = rng.randint(0, C, (N,)).astype("int64")
+        w = rng.randn(C - 1, D).astype("float32")
+        b = rng.randn(C - 1).astype("float32")
+        got = F.hsigmoid_loss(
+            paddle.to_tensor(x), paddle.to_tensor(y), C,
+            paddle.to_tensor(w), paddle.to_tensor(b)).numpy()
+
+        def softplus(v):
+            return np.log1p(np.exp(-np.abs(v))) + np.maximum(v, 0)
+
+        ref = np.zeros((N, 1), np.float32)
+        for i in range(N):
+            code = int(y[i]) + C
+            length = int(np.floor(np.log2(code)))
+            s = 0.0
+            for j in range(length):
+                idx = (code >> (j + 1)) - 1
+                bit = (code >> j) & 1
+                logit = float(x[i] @ w[idx] + b[idx])
+                s += softplus(logit) - bit * logit
+            ref[i, 0] = s
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+        # grads flow to the tree weights through the layer form
+        layer = paddle.nn.HSigmoidLoss(D, C)
+        out = layer(paddle.to_tensor(x), paddle.to_tensor(y))
+        out.sum().backward()
+        assert layer.weight.grad is not None
+
+    def test_unflatten_and_softmax2d(self, rng):
+        x = rng.randn(2, 12, 4).astype("float32")
+        out = paddle.unflatten(paddle.to_tensor(x), 1, (3, 4))
+        assert tuple(out.shape) == (2, 3, 4, 4)
+        np.testing.assert_allclose(out.numpy(), x.reshape(2, 3, 4, 4))
+        out2 = paddle.nn.Unflatten(1, (3, 4))(paddle.to_tensor(x))
+        np.testing.assert_allclose(out2.numpy(), out.numpy())
+
+        img = rng.randn(2, 3, 4, 4).astype("float32")
+        sm = paddle.nn.Softmax2D()(paddle.to_tensor(img)).numpy()
+        np.testing.assert_allclose(sm.sum(1), 1.0, rtol=1e-5)
+
+    def test_spectral_norm_layer(self, rng):
+        w = rng.randn(6, 4).astype("float32")
+        sn = paddle.nn.SpectralNorm(w.shape, dim=0, power_iters=20)
+        out = sn(paddle.to_tensor(w)).numpy()
+        # spectral norm of the output ~ 1
+        s = np.linalg.svd(out, compute_uv=False)[0]
+        np.testing.assert_allclose(s, 1.0, rtol=1e-3)
+
+    def test_unpool_and_fractional_layers(self, rng):
+        x = rng.randn(1, 2, 6, 6).astype("float32")
+        pooled, mask = F.max_pool2d(paddle.to_tensor(x), 2, 2,
+                                    return_mask=True)
+        un = paddle.nn.MaxUnPool2D(2, 2)(pooled, mask)
+        assert tuple(un.shape) == (1, 2, 6, 6)
+        # unpooled values at argmax positions reproduce the pooled maxima
+        np.testing.assert_allclose(np.sort(un.numpy()[un.numpy() != 0]),
+                                   np.sort(pooled.numpy().ravel()), rtol=1e-6)
+        fr = paddle.nn.FractionalMaxPool2D(output_size=3)(
+            paddle.to_tensor(x))
+        assert tuple(fr.shape) == (1, 2, 3, 3)

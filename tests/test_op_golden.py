@@ -1132,6 +1132,41 @@ spec("deform_conv2d",
                   rng.randn(3, 2, 3, 3)],
      oracle=_deform_conv2d_oracle, grad_rtol=5e-3, grad_atol=5e-4)
 
+spec("gaussian_nll_loss",
+     lambda x, y, v: F.gaussian_nll_loss(x, y, v, reduction="mean"),
+     lambda rng: [rng.randn(4, 3), rng.randn(4, 3),
+                  rng.rand(4, 3) + 0.2],
+     oracle=lambda x, y, v: 0.5 * (np.log(np.maximum(v, 1e-6))
+                                   + (x - y) ** 2
+                                   / np.maximum(v, 1e-6)).mean())
+spec("poisson_nll_loss",
+     lambda x, y: F.poisson_nll_loss(x, y),
+     lambda rng: [rng.randn(4, 3),
+                  rng.poisson(2.0, (4, 3)).astype("float64")],
+     oracle=lambda x, y: (np.exp(x) - y * x).mean())
+spec("multi_margin_loss",
+     lambda x, y: F.multi_margin_loss(x, y),
+     lambda rng: [rng.randn(4, 5),
+                  rng.randint(0, 5, (4,)).astype("int64")],
+     oracle=lambda x, y: np.mean([
+         sum(max(0.0, 1.0 - x[i, y[i]] + x[i, j])
+             for j in range(5) if j != y[i]) / 5
+         for i in range(4)]))
+spec("triplet_margin_with_distance_loss",
+     lambda a, p_, n: F.triplet_margin_with_distance_loss(a, p_, n),
+     lambda rng: [rng.randn(4, 6), rng.randn(4, 6), rng.randn(4, 6)],
+     oracle=lambda a, p_, n: np.maximum(
+         0.0, np.sqrt(((a - p_) ** 2).sum(-1))
+         - np.sqrt(((a - n) ** 2).sum(-1)) + 1.0).mean(),
+     grad_rtol=5e-3)
+spec("hsigmoid_loss",
+     lambda x, y, w, b: F.hsigmoid_loss(x, y, 6, w, b),
+     lambda rng: [rng.randn(4, 3),
+                  rng.randint(0, 6, (4,)).astype("int64"),
+                  rng.randn(5, 3), rng.randn(5)])
+spec("unflatten", lambda x: paddle.unflatten(x, 1, (2, 3)),
+     lambda rng: [rng.randn(4, 6)],
+     oracle=lambda x: x.reshape(4, 2, 3))
 spec("cdist", lambda x, y: paddle.cdist(x, y), lambda rng: [
     rng.randn(3, 4), rng.randn(5, 4)],
     oracle=lambda x, y: np.sqrt(
