@@ -122,6 +122,7 @@ _FLAT = {
     "Partial": ".auto_parallel.placement",
     "ReduceType": ".auto_parallel.placement",
     "shard_tensor": ".auto_parallel.api",
+    "DistAttr": ".auto_parallel.api",
     "dtensor_from_fn": ".auto_parallel.api",
     "reshard": ".auto_parallel.api",
     "shard_layer": ".auto_parallel.api",
@@ -148,6 +149,12 @@ _FLAT = {
     "reduce": ".collective",
     "reduce_scatter": ".collective",
     "scatter": ".collective",
+    "scatter_object_list": ".collective",
+    "destroy_process_group": ".collective",
+    "get_backend": ".collective",
+    "wait": ".collective",
+    "split": ".parallel",
+    "ParallelMode": ".fleet.topology",
     "alltoall": ".collective",
     "alltoall_single": ".collective",
     "all_to_all": ".collective",

@@ -30,7 +30,46 @@ def _mesh_of(t: Tensor) -> ProcessMesh | None:
     return getattr(t, "_dist_mesh", None)
 
 
+class DistAttr:
+    """Sharding-spec spelling of placements (reference
+    auto_parallel/api.py DistAttr): ``sharding_specs[i]`` names the mesh
+    dim tensor-dim i shards over (None = replicated on that tensor dim)."""
+
+    def __init__(self, mesh: ProcessMesh, sharding_specs):
+        self.process_mesh = mesh
+        self.sharding_specs = list(sharding_specs)
+
+    def to_placements(self):
+        names = list(self.process_mesh.dim_names)
+        placements = [Replicate() for _ in names]
+        seen = set()
+        for tdim, spec in enumerate(self.sharding_specs):
+            if spec is None:
+                continue
+            if spec not in names:
+                raise ValueError(
+                    f"sharding_specs[{tdim}]={spec!r} is not a mesh dim "
+                    f"of {names}")
+            if spec in seen:
+                raise ValueError(
+                    f"sharding_specs uses mesh dim {spec!r} for more than "
+                    "one tensor dim (the reference rejects this too)")
+            seen.add(spec)
+            placements[names.index(spec)] = Shard(tdim)
+        return placements
+
+
+def _resolve_dist_attr(mesh, placements):
+    """A DistAttr carries its OWN mesh — it wins over the positional mesh
+    argument (reference: shard_tensor takes the mesh from dist_attr)."""
+    if isinstance(placements, DistAttr):
+        return placements.process_mesh, placements.to_placements()
+    return mesh, placements
+
+
 def _normalize_placements(mesh: ProcessMesh, placements):
+    if isinstance(placements, DistAttr):
+        mesh, placements = _resolve_dist_attr(mesh, placements)
     if placements is None:
         placements = [Replicate() for _ in range(mesh.ndim)]
     placements = list(placements)
@@ -53,7 +92,9 @@ def shard_tensor(data, mesh: ProcessMesh, placements, dtype=None, stop_gradient=
     ``data`` is the GLOBAL (logical) value; each device materialises only its
     shard. Partial placements record pending-reduction metadata; the stored
     array always holds the reduced global view (single-controller semantics).
+    ``placements`` may be a DistAttr (its mesh wins).
     """
+    mesh, placements = _resolve_dist_attr(mesh, placements)
     placements = _normalize_placements(mesh, placements)
     src = data if isinstance(data, Tensor) else Tensor(data, dtype=dtype)
     sharding = _target_sharding(mesh, placements)
@@ -84,6 +125,7 @@ def reshard(t: Tensor, mesh: ProcessMesh, placements):
     the collective. Differentiable: recorded on the autograd tape (resharding
     the primal implies resharding the cotangent on the way back).
     """
+    mesh, placements = _resolve_dist_attr(mesh, placements)
     placements = _normalize_placements(mesh, placements)
     sharding = _target_sharding(mesh, placements)
 

@@ -498,3 +498,58 @@ def _object_to_tensor(obj):
 
 def _tensor_to_object(t, size):
     return pickle.loads(np.asarray(t._data)[:size].tobytes())
+
+
+# --- group lifecycle / misc surface (communication/group.py parity) --------
+
+def destroy_process_group(group=None):
+    """Release group resources (communication/group.py:157). XLA holds no
+    persistent communicators — this clears the registry entries so stale
+    handles cannot be resolved again."""
+    global _default_group
+    if group is None:
+        _groups.clear()
+        _default_group = None
+        return
+    _groups.pop(group.id, None)
+    if _default_group is not None and group.id == _default_group.id:
+        _default_group = None
+
+
+def get_backend(group=None):
+    """Backend name (communication/group.py:350). One comm stack here:
+    XLA collectives over ICI/DCN."""
+    _resolve_group(group)
+    return "XCCL"
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    """Block until the tensor's producing work completes
+    (communication/group.py:258) — device sync in the XLA model."""
+    data = tensor._data if isinstance(tensor, Tensor) else tensor
+    if hasattr(data, "block_until_ready"):
+        data.block_until_ready()
+    return tensor
+
+
+def scatter_object_list(out_object_list, in_object_list, src=0, group=None):
+    """Scatter one python object per rank (communication/scatter.py:74).
+    Single-controller view: this rank receives its slot of the source
+    list (``src``'s list is the one every rank sees here). The reference's
+    contract is enforced: the input list length must equal the group
+    size and the caller must be a group member."""
+    from . import get_rank
+
+    g = _resolve_group(group)
+    if len(in_object_list or []) != g.nranks:
+        raise ValueError(
+            f"scatter_object_list: in_object_list has "
+            f"{len(in_object_list or [])} entries for a {g.nranks}-rank "
+            "group (must match)")
+    rank = g.get_group_rank(get_rank())
+    if rank < 0:
+        raise ValueError(
+            "scatter_object_list: current rank is not a member of the "
+            "group")
+    out_object_list.append(in_object_list[rank])
+    return out_object_list

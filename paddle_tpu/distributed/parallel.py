@@ -130,3 +130,57 @@ class DataParallel(Layer):
             return super().__getattr__(name)
         except AttributeError:
             return getattr(self._layers, name)
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """Model-parallel sharded linear/embedding (reference
+    fleet/layers/mpu/mp_ops.py:700 split). Creates the parallel weight and
+    applies the op — the reference uses this while BUILDING a (static)
+    program, so per-call parameter creation is the intended semantic; under
+    our record-replay Program the call happens once at trace time the same
+    way.
+
+    operation='linear': size=(in, out); axis=1 shards the output columns
+    (ColumnParallel), axis=0 the input rows (RowParallel).
+    operation='embedding': size=(vocab, emb), vocab-sharded table.
+    """
+    from .fleet.meta_parallel import _get_hcg
+    from .fleet.meta_parallel.mp_layers import (
+        ColumnParallelLinear,
+        RowParallelLinear,
+        VocabParallelEmbedding,
+    )
+
+    hcg = _get_hcg()
+    mp = hcg.get_model_parallel_world_size() if hcg is not None else 1
+    if num_partitions not in (1, mp):
+        raise ValueError(
+            f"split: num_partitions={num_partitions} must equal the model-"
+            f"parallel world size ({mp}) — the reference asserts the same")
+    if bias_attr not in (None, False):
+        raise NotImplementedError(
+            "split: custom bias_attr ParamAttr is not supported (pass "
+            "False to disable the bias, or build the parallel layer "
+            "directly)")
+    if operation == "embedding":
+        layer = VocabParallelEmbedding(size[0], size[1],
+                                       weight_attr=weight_attr)
+        return layer(x)
+    if operation != "linear":
+        raise ValueError(
+            f"split: operation must be 'linear' or 'embedding', got "
+            f"{operation!r}")
+    if axis == 1:
+        layer = ColumnParallelLinear(size[0], size[1],
+                                     weight_attr=weight_attr,
+                                     has_bias=bias_attr is not False,
+                                     gather_output=gather_out)
+    elif axis == 0:
+        layer = RowParallelLinear(size[0], size[1],
+                                  weight_attr=weight_attr,
+                                  has_bias=bias_attr is not False,
+                                  input_is_parallel=False)
+    else:
+        raise ValueError(f"split: axis must be 0 or 1, got {axis}")
+    return layer(x)

@@ -433,3 +433,52 @@ class TestDistModel:
         assert m._mode == "predict"  # not silently train
         with pytest.raises(RuntimeError, match="loss"):
             m.train()
+
+
+class TestRound4Surface:
+    """Group-lifecycle + DistAttr + dist.split surface (reference
+    communication/group.py, auto_parallel DistAttr, mpu/mp_ops.py:700)."""
+
+    def test_backend_wait_scatter_objects(self):
+        assert dist.get_backend() == "XCCL"
+        t = paddle.to_tensor(np.ones((2, 2), np.float32))
+        assert dist.wait(t) is t
+        out = []
+        dist.scatter_object_list(out, list("abcdefgh"))
+        assert len(out) == 1 and out[0] in "abcdefgh"
+
+    def test_dist_attr_maps_to_placements(self):
+        mesh = dist.ProcessMesh(np.arange(NDEV).reshape(2, 4), ["x", "y"])
+        da = dist.DistAttr(mesh=mesh, sharding_specs=["y", None, "x"])
+        t = dist.shard_tensor(
+            paddle.to_tensor(np.zeros((8, 3, 4), np.float32)), mesh, da)
+        assert t.placements[mesh.dim_names.index("y")].is_shard(0)
+        assert t.placements[mesh.dim_names.index("x")].is_shard(2)
+        import pytest
+
+        with pytest.raises(ValueError, match="not a mesh dim"):
+            dist.DistAttr(mesh=mesh, sharding_specs=["z"]).to_placements()
+
+    def test_split_linear_and_embedding(self, rng):
+        from paddle_tpu.distributed import fleet
+
+        strat = fleet.DistributedStrategy()
+        strat.hybrid_configs = {"dp_degree": 1, "mp_degree": NDEV,
+                                "pp_degree": 1}
+        fleet.init(is_collective=True, strategy=strat)
+        x = rng.randn(4, 8).astype("float32")
+        y = dist.split(paddle.to_tensor(x), (8, 16), operation="linear",
+                       axis=1, gather_out=True)
+        assert tuple(y.shape) == (4, 16)
+        ids = rng.randint(0, 16, (4, 5)).astype("int64")
+        e = dist.split(paddle.to_tensor(ids), (16, 8),
+                       operation="embedding")
+        assert tuple(e.shape) == (4, 5, 8)
+
+    def test_destroy_process_group(self):
+        g = dist.new_group(list(range(2)))
+        dist.destroy_process_group(g)
+        import pytest
+
+        with pytest.raises(KeyError):
+            dist.get_group(g.id)
