@@ -120,7 +120,7 @@ _bulk([
     "exponent", "greater_equal", "greater_than", "isclose", "isfinite",
     "isinf", "isnan", "isneginf", "isposinf", "isreal", "less_equal",
     "less_than", "logical_and", "logical_not", "logical_or", "logical_xor",
-    "not_equal", "one_hot", "searchsorted", "sequence_mask",
+    "not_equal", "one_hot", "searchsorted", "sequence_mask", "signbit",
     "gather_tree", "class_center_sample", "top_p_sampling", "weight_quantize",
     "matrix_nms", "generate_proposals", "distribute_fpn_proposals",
 ], non_diff=True)
@@ -157,6 +157,9 @@ _bulk([
     "gather_nd", "gather_slice", "gaussian", "gaussian_nll_loss", "gcd",
     "gelu", "getitem", "glu", "hsigmoid_loss", "multi_margin_loss",
     "poisson_nll_loss", "triplet_margin_with_distance_loss", "unflatten",
+    "add_n", "frexp", "gammaln", "multigammaln", "polar",
+    "shard_index", "tensor_split", "diagonal_scatter", "select_scatter",
+    "slice_scatter",
     "gradients", "grid_sample", "gru_cell", "gumbel_softmax", "hardshrink",
     "hardsigmoid", "hardswish", "hardtanh", "heaviside",
     "hinge_embedding_loss", "householder_product", "huber_loss", "hypot",

@@ -191,10 +191,89 @@ _METHOD_SOURCES = [
     (random, "bernoulli_ uniform_ normal_ exponential_ multinomial"),
 ]
 
+_METHOD_SOURCES += [
+    (math, """frexp gammaln multigammaln signbit shard_index
+     i0 i0e i1 i1e polygamma trapezoid cumulative_trapezoid renorm sgn
+     vander"""),
+    (manipulation, """atleast_1d atleast_2d atleast_3d
+     broadcast_tensors concat stack tensor_split hsplit vsplit dsplit
+     reverse diagonal_scatter select_scatter slice_scatter unflatten
+     view"""),
+    (creation, "as_complex as_real is_tensor"),
+    (logic, "is_complex is_floating_point is_integer"),
+    (linalg, """cdist cov eigvalsh multi_dot householder_product
+     pca_lowrank"""),
+    (search, "histogramdd"),
+    (random, "top_p_sampling"),
+]
+
 for module, names in _METHOD_SOURCES:
     for n in names.split():
         fn = getattr(module, n)
         register_tensor_method(n, fn)
+
+
+# signal transforms bind late (signal.py imports the tensor package)
+def _stft_method(self, *a, **k):
+    from ..signal import stft
+
+    return stft(self, *a, **k)
+
+
+def _istft_method(self, *a, **k):
+    from ..signal import istft
+
+    return istft(self, *a, **k)
+
+
+register_tensor_method("stft", _stft_method)
+register_tensor_method("istft", _istft_method)
+
+
+# --- generated in-place variants (reference tensor_patch_methods: every
+# elementwise op has an `op_` spelling that rebinds the handle) -------------
+_INPLACE_BASES = [
+    (math, """acos acosh asin asinh atan atanh ceil cos cosh cumprod cumsum
+     digamma erfinv floor floor_divide floor_mod frac gcd hypot lcm ldexp
+     lerp lgamma log log10 log1p log2 neg pow reciprocal round sigmoid sin
+     sinh tan trunc copysign gammaln i0 renorm"""),
+    (logic, """bitwise_and bitwise_or bitwise_xor bitwise_not
+     bitwise_left_shift bitwise_right_shift logical_and logical_or
+     logical_xor logical_not equal not_equal greater_equal greater_than
+     less_equal less_than"""),
+]
+
+
+def _make_inplace(fn):
+    def method(self, *args, **kwargs):
+        return manipulation._inplace(self, fn(self, *args, **kwargs))
+
+    return method
+
+
+for _mod, _names in _INPLACE_BASES:
+    for _n in _names.split():
+        _f = getattr(_mod, _n, None)
+        if _f is not None:
+            register_tensor_method(_n + "_", _make_inplace(_f))
+
+
+def _cast_(self, dtype):
+    return manipulation._inplace(self, self.cast(dtype))
+
+
+register_tensor_method("cast_", _cast_)
+register_tensor_method("add_n", math.add_n)
+register_tensor_method("where_", search.where_)
+
+
+def _zero_(self):
+    # literal zeros, NOT v*0: IEEE inf*0 == nan would survive the reset
+    return manipulation._inplace(
+        self, apply_op("scale", lambda v: jnp.zeros_like(v), self))
+
+
+register_tensor_method("zero_", _zero_)
 
 # A few spelling aliases paddle exposes as methods.
 register_tensor_method("mod_", lambda self, y, name=None: manipulation._inplace(self, math.mod(self, y)))

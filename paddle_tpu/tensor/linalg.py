@@ -246,9 +246,14 @@ def corrcoef(x, rowvar=True, name=None):
 
 
 def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
-    return apply_op(
-        "cov", lambda v: jnp.cov(v, rowvar=rowvar, ddof=1 if ddof else 0), x
-    )
+    def fn(v, *ws):
+        fw = ws[0] if fweights is not None else None
+        aw = ws[-1] if aweights is not None else None
+        return jnp.cov(v, rowvar=rowvar, ddof=1 if ddof else 0,
+                       fweights=fw, aweights=aw)
+
+    args = [x] + [w for w in (fweights, aweights) if w is not None]
+    return apply_op("cov", fn, *args)
 
 
 def householder_product(x, tau, name=None):

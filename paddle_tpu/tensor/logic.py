@@ -55,3 +55,17 @@ def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None) -> Tensor
 
 def is_empty(x, name=None) -> Tensor:
     return Tensor(jnp.asarray(x._data.size == 0))
+
+
+# --- dtype predicates (reference tensor/attribute.py parity) ---------------
+
+def is_complex(x):
+    return jnp.issubdtype(x._data.dtype, jnp.complexfloating)
+
+
+def is_floating_point(x):
+    return jnp.issubdtype(x._data.dtype, jnp.floating)
+
+
+def is_integer(x):
+    return jnp.issubdtype(x._data.dtype, jnp.integer)

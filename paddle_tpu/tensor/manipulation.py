@@ -470,3 +470,75 @@ def unfold(x, axis, size, step, name=None):
         return jnp.moveaxis(out, (0, 1), (axis, v.ndim))
 
     return apply_op("unfold", fn, x)
+
+
+# --- round-4 tensor-surface tail (reference manipulation.py parity) --------
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    """Split into (possibly unequal) sections — unlike ``split``, the
+    sections need not divide the axis (reference manipulation.py
+    tensor_split / numpy semantics)."""
+    def fn(v):
+        return tuple(jnp.array_split(v, num_or_indices, axis=axis))
+
+    return apply_op("tensor_split", fn, x)
+
+
+def hsplit(x, num_or_indices, name=None):
+    def fn(v):
+        ax = 0 if v.ndim == 1 else 1
+        return tuple(jnp.array_split(v, num_or_indices, axis=ax))
+
+    return apply_op("tensor_split", fn, x)
+
+
+def vsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=0)
+
+
+def dsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=2)
+
+
+def reverse(x, axis, name=None):
+    """Deprecated-in-reference alias of flip (manipulation.py reverse)."""
+    return flip(x, axis)
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    """Write ``y`` onto the selected diagonal (reference manipulation.py
+    diagonal_scatter). ``y``'s last dim is the diagonal (the shape
+    ``x.diagonal(offset, axis1, axis2)`` returns)."""
+    def fn(v, src):
+        a1, a2 = axis1 % v.ndim, axis2 % v.ndim
+        vm = jnp.moveaxis(v, (a1, a2), (-2, -1))
+        i = jnp.arange(src.shape[-1])
+        r = i + max(-offset, 0)
+        c = i + max(offset, 0)
+        out = vm.at[..., r, c].set(src.astype(v.dtype))
+        return jnp.moveaxis(out, (-2, -1), (a1, a2))
+
+    return apply_op("diagonal_scatter", fn, x, y)
+
+
+def select_scatter(x, values, axis, index, name=None):
+    """Write ``values`` into position ``index`` along ``axis`` (reference
+    manipulation.py select_scatter)."""
+    def fn(v, src):
+        sel = [_py_slice(None)] * v.ndim
+        sel[axis] = index
+        return v.at[tuple(sel)].set(src.astype(v.dtype))
+
+    return apply_op("select_scatter", fn, x, values)
+
+
+def slice_scatter(x, value, axes, starts, ends, strides, name=None):
+    """Write ``value`` into the strided slice (reference manipulation.py
+    slice_scatter)."""
+    def fn(v, src):
+        sel = [_py_slice(None)] * v.ndim
+        for ax, s_, e_, st in zip(axes, starts, ends, strides):
+            sel[ax] = _py_slice(s_, e_, st)
+        return v.at[tuple(sel)].set(src.astype(v.dtype))
+
+    return apply_op("slice_scatter", fn, x, value)

@@ -458,3 +458,81 @@ def renorm(x, p, axis, max_norm, name=None):
         return v * scale
 
     return apply_op("renorm", fn, x)
+
+
+# --- round-4 tensor-surface tail (reference tensor/math.py parity) ---------
+
+def add_n(inputs, name=None):
+    """Elementwise sum of a tensor list (reference math.py add_n). Always
+    returns a FRESH tensor (never aliases an input)."""
+    if isinstance(inputs, Tensor):
+        inputs = [inputs]
+
+    def fn(*vs):
+        out = vs[0]
+        for v in vs[1:]:
+            out = out + v
+        return out
+
+    return apply_op("add_n", fn, *inputs)
+
+
+def frexp(x, name=None):
+    """(mantissa, exponent) with x = m * 2**e, 0.5 <= |m| < 1 (reference
+    math.py frexp). Exponent returned in x's dtype (reference behavior)."""
+    def fn(v):
+        m, e = jnp.frexp(v)
+        return m, e.astype(v.dtype)
+
+    return apply_op("frexp", fn, x)
+
+
+def gammaln(x, name=None):
+    """log|Gamma(x)| (reference math.py gammaln)."""
+    return apply_op("gammaln", lambda v: jsp.gammaln(v), x)
+
+
+def multigammaln(x, p, name=None):
+    """Log multivariate gamma (reference math.py multigammaln)."""
+    def fn(v):
+        import math as _m
+
+        c = 0.25 * p * (p - 1) * _m.log(_m.pi)
+        terms = [jsp.gammaln(v - 0.5 * i) for i in range(p)]
+        out = c
+        for t_ in terms:
+            out = out + t_
+        return out
+
+    return apply_op("multigammaln", fn, x)
+
+
+def signbit(x, name=None):
+    """True where the sign bit is set (reference math.py signbit)."""
+    return apply_op("signbit", lambda v: jnp.signbit(v), x)
+
+
+def polar(abs, angle, name=None):
+    """Complex from magnitude and phase (reference creation.py polar)."""
+    def fn(r, theta):
+        return (r * jnp.cos(theta)) + 1j * (r * jnp.sin(theta))
+
+    return apply_op("polar", fn, abs, angle)
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1,
+                name=None):
+    """Recompute global ids into shard-local ids (reference math.py
+    shard_index, the sharded-embedding helper): ids inside this shard map
+    to id - shard_id*shard_size, others to ignore_value."""
+    if not 0 <= shard_id < nshards:
+        raise ValueError(
+            f"shard_id {shard_id} must be in [0, {nshards})")
+    size = (index_num + nshards - 1) // nshards
+
+    def fn(v):
+        lo = shard_id * size
+        inside = (v >= lo) & (v < lo + size)
+        return jnp.where(inside, v - lo, ignore_value)
+
+    return apply_op("shard_index", fn, input)

@@ -1132,6 +1132,44 @@ spec("deform_conv2d",
                   rng.randn(3, 2, 3, 3)],
      oracle=_deform_conv2d_oracle, grad_rtol=5e-3, grad_atol=5e-4)
 
+spec("add_n", lambda a, b, c: paddle.add_n([a, b, c]),
+     lambda rng: [rng.randn(3, 4), rng.randn(3, 4), rng.randn(3, 4)],
+     oracle=lambda a, b, c: a + b + c)
+spec("frexp", lambda x: paddle.frexp(x)[0] * 2.0 ** paddle.frexp(x)[1],
+     lambda rng: [rng.randn(8) * 10], oracle=lambda x: x, grad=False)
+spec("gammaln", lambda x: paddle.gammaln(x),
+     lambda rng: [np.abs(rng.randn(8)) + 0.5],
+     oracle=lambda x: __import__("scipy.special",
+                                 fromlist=["gammaln"]).gammaln(x))
+spec("multigammaln", lambda x: paddle.multigammaln(x, 3),
+     lambda rng: [np.abs(rng.randn(6)) + 3.0],
+     oracle=lambda x: __import__("scipy.special",
+                                 fromlist=["multigammaln"]).multigammaln(
+                                     x, 3))
+spec("signbit", lambda x: paddle.signbit(x), lambda rng: [rng.randn(8)],
+     oracle=lambda x: np.signbit(x), grad=False, bf16=False)
+spec("polar", lambda r, t_: paddle.polar(r, t_),
+     lambda rng: [np.abs(rng.randn(6)), rng.randn(6)],
+     oracle=lambda r, t_: r * np.exp(1j * t_), grad=False, bf16=False)
+spec("shard_index",
+     lambda x: paddle.shard_index(x, 16, 4, 1),
+     lambda rng: [rng.randint(0, 16, (8,)).astype("int64")],
+     oracle=lambda x: np.where((x >= 4) & (x < 8), x - 4, -1),
+     grad=False, bf16=False)
+spec("tensor_split", lambda x: paddle.tensor_split(x, [2, 5])[1],
+     lambda rng: [rng.randn(8, 3)], oracle=lambda x: x[2:5])
+spec("diagonal_scatter",
+     lambda x, y: paddle.diagonal_scatter(x, y),
+     lambda rng: [rng.randn(4, 4), rng.randn(4)],
+     oracle=lambda x, y: x - np.diag(np.diag(x)) + np.diag(y))
+spec("select_scatter",
+     lambda x, v: paddle.select_scatter(x, v, 0, 1),
+     lambda rng: [rng.randn(3, 4), rng.randn(4)],
+     oracle=lambda x, v: np.concatenate([x[:1], v[None], x[2:]]))
+spec("slice_scatter",
+     lambda x, v: paddle.slice_scatter(x, v, [0], [1], [3], [1]),
+     lambda rng: [rng.randn(5, 4), rng.randn(2, 4)],
+     oracle=lambda x, v: np.concatenate([x[:1], v, x[3:]]))
 spec("gaussian_nll_loss",
      lambda x, y, v: F.gaussian_nll_loss(x, y, v, reduction="mean"),
      lambda rng: [rng.randn(4, 3), rng.randn(4, 3),

@@ -1,0 +1,105 @@
+"""Tensor method-surface completeness (reference tensor/__init__.py method
+tables): the round-4 audit closed 127 missing methods — this pins the
+bindings, the generated in-place variants' rebind semantics, and the new
+function tails.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+@pytest.fixture
+def t(rng):
+    return paddle.to_tensor(rng.randn(4, 4).astype("float32"))
+
+
+REFERENCE_METHODS = """
+add_n frexp gammaln multigammaln signbit shard_index i0 i0e i1 i1e
+polygamma trapezoid cumulative_trapezoid renorm sgn vander as_complex
+as_real atleast_1d atleast_2d atleast_3d broadcast_tensors concat stack
+tensor_split hsplit vsplit dsplit reverse diagonal_scatter select_scatter
+slice_scatter unflatten view is_complex is_floating_point is_integer
+is_tensor cdist cov eigvalsh multi_dot householder_product pca_lowrank
+histogramdd top_p_sampling stft istft
+acos_ acosh_ asin_ asinh_ atan_ atanh_ ceil_ cos_ cosh_ cumprod_ cumsum_
+digamma_ erfinv_ floor_ floor_divide_ frac_ gcd_ hypot_ lcm_ ldexp_ lerp_
+lgamma_ log_ log10_ log1p_ log2_ neg_ pow_ reciprocal_ round_ sigmoid_
+sin_ sinh_ tan_ trunc_ copysign_ bitwise_and_ bitwise_or_ bitwise_xor_
+bitwise_not_ logical_and_ logical_or_ logical_xor_ logical_not_ equal_
+not_equal_ greater_equal_ greater_than_ less_equal_ less_than_ where_
+cast_ zero_ gammaln_ i0_ renorm_
+""".split()
+
+
+def test_method_surface_complete(t):
+    missing = [m for m in REFERENCE_METHODS if not hasattr(t, m)]
+    assert not missing, missing
+
+
+def test_inplace_rebinds_handle(rng):
+    x = paddle.to_tensor(np.abs(rng.randn(8)).astype("float32") + 0.5)
+    before = x.numpy().copy()
+    ret = x.log_()
+    assert ret is x
+    np.testing.assert_allclose(x.numpy(), np.log(before), rtol=1e-6)
+    x.zero_()
+    assert (x.numpy() == 0).all()
+    y = paddle.to_tensor(np.ones(8, np.float32))
+    y.cast_("int64")
+    assert y.dtype == paddle.int64
+
+
+def test_inplace_keeps_autograd(rng):
+    """In-place variants rebind the grad node: gradients still flow."""
+    x = paddle.to_tensor(rng.rand(6).astype("float32") + 0.5)
+    x.stop_gradient = False
+    y = x * 2.0
+    y.sigmoid_()
+    y.sum().backward()
+    assert x.grad is not None
+    g = 2 * (lambda s: s * (1 - s))(1 / (1 + np.exp(-2 * x.numpy())))
+    np.testing.assert_allclose(x.grad.numpy(), g, rtol=1e-4, atol=1e-6)
+
+
+def test_dtype_predicates(rng):
+    f = paddle.to_tensor(rng.randn(2).astype("float32"))
+    i = paddle.to_tensor(np.array([1, 2], np.int64))
+    c = paddle.as_complex(paddle.to_tensor(rng.randn(2, 2).astype("float32")))
+    assert f.is_floating_point() and not f.is_integer() and not f.is_complex()
+    assert i.is_integer() and not i.is_floating_point()
+    assert c.is_complex()
+
+
+def test_split_family(rng):
+    x = paddle.to_tensor(rng.randn(6, 4, 4).astype("float32"))
+    assert [tuple(p.shape) for p in x.vsplit(3)] == [(2, 4, 4)] * 3
+    assert [tuple(p.shape) for p in x.hsplit(2)] == [(6, 2, 4)] * 2
+    assert [tuple(p.shape) for p in x.dsplit(2)] == [(6, 4, 2)] * 2
+    parts = x.tensor_split([2, 3])
+    assert [tuple(p.shape) for p in parts] == [(2, 4, 4), (1, 4, 4),
+                                               (3, 4, 4)]
+    np.testing.assert_allclose(x.reverse([0]).numpy(), x.numpy()[::-1])
+
+
+def test_scatter_family(rng):
+    x = paddle.to_tensor(rng.randn(4, 4).astype("float32"))
+    d = paddle.to_tensor(np.arange(4, dtype=np.float32))
+    out = x.diagonal_scatter(d).numpy()
+    np.testing.assert_allclose(np.diag(out), np.arange(4))
+    off = x.diagonal_scatter(paddle.to_tensor(
+        np.arange(3, dtype=np.float32)), offset=1).numpy()
+    np.testing.assert_allclose(np.diag(off, k=1), np.arange(3))
+    row = paddle.to_tensor(np.full(4, 9.0, np.float32))
+    np.testing.assert_allclose(x.select_scatter(row, 0, 2).numpy()[2], 9.0)
+    blk = paddle.to_tensor(np.zeros((2, 4), np.float32))
+    out = x.slice_scatter(blk, [0], [1], [3], [1]).numpy()
+    np.testing.assert_allclose(out[1:3], 0.0)
+
+
+def test_signal_methods_roundtrip(rng):
+    x = paddle.to_tensor(rng.randn(64).astype("float32"))
+    spec = x.stft(16, 8, center=True)
+    back = spec.istft(16, 8, center=True, length=64)
+    np.testing.assert_allclose(back.numpy(), x.numpy(), rtol=1e-3,
+                               atol=1e-4)
