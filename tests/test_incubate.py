@@ -153,3 +153,131 @@ def test_varlen_attention_zero_length_row_no_nan(rng):
     assert np.isfinite(arr).all(), "NaN leaked from fully-masked row"
     np.testing.assert_allclose(arr[0], 0.0)
     assert not np.allclose(arr[1], 0.0)
+
+
+class TestFusedServingFamily:
+    """Round-4 fused-transformer serving ops (reference
+    incubate/nn/functional/fused_transformer.py family)."""
+
+    def test_fused_matmul_bias(self, rng):
+        from paddle_tpu.incubate.nn.functional import fused_matmul_bias
+
+        x = rng.randn(4, 6).astype("float32")
+        y = rng.randn(6, 3).astype("float32")
+        b = rng.randn(3).astype("float32")
+        out = fused_matmul_bias(paddle.to_tensor(x), paddle.to_tensor(y),
+                                paddle.to_tensor(b))
+        np.testing.assert_allclose(out.numpy(), x @ y + b, rtol=1e-5)
+        out = fused_matmul_bias(paddle.to_tensor(x.T), paddle.to_tensor(y),
+                                transpose_x=True)
+        np.testing.assert_allclose(out.numpy(), x @ y, rtol=1e-5)
+
+    def test_fused_feedforward_matches_unfused(self, rng):
+        from paddle_tpu.incubate.nn.functional import fused_feedforward
+
+        x = rng.randn(2, 5, 8).astype("float32")
+        w1 = rng.randn(8, 16).astype("float32")
+        w2 = rng.randn(16, 8).astype("float32")
+        g = rng.rand(8).astype("float32") + 0.5
+        b = rng.randn(8).astype("float32")
+        out = fused_feedforward(
+            paddle.to_tensor(x), paddle.to_tensor(w1), paddle.to_tensor(w2),
+            ln1_scale=paddle.to_tensor(g), ln1_bias=paddle.to_tensor(b),
+            dropout1_rate=0.0, dropout2_rate=0.0, activation="gelu",
+            pre_layer_norm=True, training=False)
+        mu = x.mean(-1, keepdims=True)
+        ln = (x - mu) / np.sqrt(x.var(-1, keepdims=True) + 1e-5) * g + b
+        from scipy.special import erf
+        h = ln @ w1
+        h = 0.5 * h * (1 + erf(h / np.sqrt(2)))
+        ref = h @ w2 + x
+        np.testing.assert_allclose(out.numpy(), ref, rtol=2e-4, atol=1e-5)
+
+    def test_fused_mha_matches_sdpa(self, rng):
+        from paddle_tpu.incubate.nn.functional import (
+            fused_multi_head_attention)
+
+        B, S, nh, hd = 2, 6, 2, 4
+        E = nh * hd
+        x = rng.randn(B, S, E).astype("float32")
+        wq = rng.randn(3, nh, hd, E).astype("float32")
+        wo = rng.randn(E, E).astype("float32")
+        out = fused_multi_head_attention(
+            paddle.to_tensor(x), paddle.to_tensor(wq), paddle.to_tensor(wo),
+            pre_layer_norm=True, dropout_rate=0.0, attn_dropout_rate=0.0,
+            training=False)
+        # numpy oracle (pre-LN with gamma=1/beta=0 — the fused contract
+        # normalizes even without affine params)
+        import math
+        xn = (x - x.mean(-1, keepdims=True)) / np.sqrt(
+            x.var(-1, keepdims=True) + 1e-5)
+        q3 = np.einsum("bse,cnde->bscnd", xn, wq)
+        q, k, v = q3[:, :, 0], q3[:, :, 1], q3[:, :, 2]  # [B,S,nh,hd]
+        qt, kt, vt = (a.transpose(0, 2, 1, 3) for a in (q, k, v))
+        s = np.einsum("bnqd,bnkd->bnqk", qt, kt) / math.sqrt(hd)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p = p / p.sum(-1, keepdims=True)
+        ctx = np.einsum("bnqk,bnkd->bnqd", p, vt).transpose(0, 2, 1, 3)
+        ref = ctx.reshape(B, S, E) @ wo + x
+        np.testing.assert_allclose(out.numpy(), ref, rtol=2e-4, atol=1e-5)
+
+    def test_masked_mha_decode_matches_full_attention(self, rng):
+        """Decoding one token with the cache must equal full attention
+        over the prefix + new token."""
+        from paddle_tpu.incubate.nn.functional import (
+            masked_multihead_attention)
+        import math
+
+        B, nh, hd, max_len, past = 2, 2, 4, 8, 3
+        kpast = rng.randn(B, nh, past, hd).astype("float32")
+        vpast = rng.randn(B, nh, past, hd).astype("float32")
+        cache = np.zeros((2, B, nh, max_len, hd), np.float32)
+        cache[0, :, :, :past] = kpast
+        cache[1, :, :, :past] = vpast
+        x = rng.randn(B, 3 * nh * hd).astype("float32")
+        lens = np.full((B,), past, np.int32)
+        out, new_cache = masked_multihead_attention(
+            paddle.to_tensor(x), paddle.to_tensor(cache),
+            sequence_lengths=paddle.to_tensor(lens))
+        qkv = x.reshape(B, 3, nh, hd)
+        q, kn, vn = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+        k_all = np.concatenate([kpast, kn[:, :, None]], axis=2)
+        v_all = np.concatenate([vpast, vn[:, :, None]], axis=2)
+        s = np.einsum("bnd,bnld->bnl", q, k_all) / math.sqrt(hd)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p = p / p.sum(-1, keepdims=True)
+        ref = np.einsum("bnl,bnld->bnd", p, v_all).reshape(B, nh * hd)
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+        # cache updated at position `past`
+        np.testing.assert_allclose(
+            new_cache.numpy()[0, :, :, past], kn, rtol=1e-6)
+
+    def test_fused_multi_transformer_runs_and_caches(self, rng):
+        from paddle_tpu.incubate.nn.functional import fused_multi_transformer
+
+        B, S, nh, hd, L = 2, 4, 2, 4, 2
+        E = nh * hd
+        t = lambda *s: paddle.to_tensor(rng.randn(*s).astype("float32"))
+        ones = lambda *s: paddle.to_tensor(np.ones(s, np.float32))
+        cache = [paddle.to_tensor(np.zeros((2, B, nh, 0, hd), np.float32))
+                 for _ in range(L)]
+        out, caches = fused_multi_transformer(
+            t(B, S, E),
+            ln_scales=[ones(E) for _ in range(L)],
+            ln_biases=[paddle.to_tensor(np.zeros(E, np.float32))
+                       for _ in range(L)],
+            qkv_weights=[t(3, nh, hd, E) for _ in range(L)],
+            qkv_biases=None,
+            linear_weights=[t(E, E) for _ in range(L)],
+            linear_biases=None,
+            ffn_ln_scales=[ones(E) for _ in range(L)],
+            ffn_ln_biases=None,
+            ffn1_weights=[t(E, 2 * E) for _ in range(L)],
+            ffn1_biases=None,
+            ffn2_weights=[t(2 * E, E) for _ in range(L)],
+            ffn2_biases=None,
+            cache_kvs=cache, training=False)
+        assert tuple(out.shape) == (B, S, E)
+        assert len(caches) == L
+        assert tuple(caches[0].shape) == (2, B, nh, S, hd)
+        assert np.isfinite(out.numpy()).all()

@@ -999,6 +999,10 @@ _SKIP_GROUPS = {
     "geometric message-passing op (covered by tests/test_incubate.py)": [
         "send_u_recv", "send_ue_recv", "send_uv", "segment_mean",
     ],
+    "fused serving op (oracle-tested in tests/test_incubate.py TestFusedServingFamily)": [
+        "fused_matmul_bias", "fused_qkv", "fused_cache_concat",
+        "masked_multihead_attention",
+    ],
     "sparse op (COO/CSR formats; covered by tests/test_sparse.py)": [
         "sparse_add", "sparse_add_dense", "sparse_attention",
         "sparse_coalesce", "sparse_divide", "sparse_divide_dense",
