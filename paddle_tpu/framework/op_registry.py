@@ -155,7 +155,8 @@ _bulk([
     "fused_dropout_add", "fused_layer_norm", "fused_linear",
     "fused_linear_activation", "fused_rms_norm", "fused_rope",
     "fused_matmul_bias", "fused_qkv", "fused_cache_concat",
-    "masked_multihead_attention", "gather",
+    "masked_multihead_attention", "fused_ec_moe", "fused_gate_attention",
+    "block_multihead_attention", "gather",
     "gather_nd", "gather_slice", "gaussian", "gaussian_nll_loss", "gcd",
     "gelu", "getitem", "glu", "hsigmoid_loss", "multi_margin_loss",
     "poisson_nll_loss", "triplet_margin_with_distance_loss", "unflatten",

@@ -238,7 +238,8 @@ __all__ = [
     "variable_length_memory_efficient_attention", "swiglu",
     "fused_matmul_bias", "fused_dot_product_attention", "fused_feedforward",
     "fused_multi_head_attention", "masked_multihead_attention",
-    "fused_multi_transformer",
+    "fused_multi_transformer", "fused_ec_moe", "fused_gate_attention",
+    "block_multihead_attention",
 ]
 
 
@@ -424,17 +425,22 @@ def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
     hd = cache_kv.shape[4]
     max_len = cache_kv.shape[3]
 
+    has_bias = bias is not None
+    has_mask = src_mask is not None
+    has_lens = sequence_lengths is not None
+    has_rot = rotary_tensor is not None
+
     def fn(xv, cache, *rest):
         b = xv.shape[0]
         ri = 0
         bias_v = mask_v = lens_v = rot_v = None
-        if bias is not None:
+        if has_bias:
             bias_v = rest[ri]; ri += 1
-        if src_mask is not None:
+        if has_mask:
             mask_v = rest[ri]; ri += 1
-        if sequence_lengths is not None:
+        if has_lens:
             lens_v = rest[ri]; ri += 1
-        if rotary_tensor is not None:
+        if has_rot:
             rot_v = rest[ri]; ri += 1
         qkv = xv.reshape(b, 3, nh, hd)
         if bias_v is not None:
@@ -444,7 +450,7 @@ def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
             pos = jnp.zeros((b,), jnp.int32)
         else:
             pos = lens_v.reshape(b).astype(jnp.int32)
-        if rot_v is not None and rotary_emb_dims > 0:
+        if has_rot and rotary_emb_dims > 0:
             # rotary_tensor [b, 1, 1, max_len, hd] (cos/sin packed per
             # reference); apply at the current position, GPT-NeoX or
             # interleaved style
@@ -556,3 +562,213 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
     if cache_kvs is not None:
         return out, new_caches
     return out
+
+
+def fused_ec_moe(x, gate, bmm0_weight, bmm0_bias, bmm1_weight, bmm1_bias,
+                 act_type):
+    """Expert-choice MoE: every token is routed through EVERY expert's
+    FFN weighted by the softmax gate (reference fused_ec_moe.py:18 — the
+    sm75+ fused kernel computes exactly this dense mixture). Weights
+    [e, d_model, d_ff] / [e, d_ff, d_model] per the reference layout."""
+    if act_type not in ("gelu", "relu"):
+        raise ValueError(f"fused_ec_moe: act_type must be gelu|relu, got "
+                         f"{act_type!r}")
+
+    def fn(xv, g, w0, b0, w1, b1):
+        probs = jax.nn.softmax(g, axis=-1)              # [b, s, e]
+        h = jnp.einsum("bsd,edf->bsef", xv, w0) + b0[:, 0]
+        h = jax.nn.gelu(h) if act_type == "gelu" else jax.nn.relu(h)
+        eo = jnp.einsum("bsef,efd->bsed", h, w1) + b1[:, 0]
+        return jnp.einsum("bsed,bse->bsd", eo, probs)
+
+    return apply_op("fused_ec_moe", fn, x, gate, bmm0_weight, bmm0_bias,
+                    bmm1_weight, bmm1_bias)
+
+
+def fused_gate_attention(query, key=None, query_weight=None, key_weight=None,
+                         value_weight=None, qkv_weight=None,
+                         gate_linear_weight=None, gate_linear_bias=None,
+                         out_linear_weight=None, out_linear_bias=None,
+                         nonbatched_bias=None, attn_mask=None,
+                         has_gating=True, merge_qkv=True,
+                         use_flash_attn=False):
+    """AlphaFold-style gated attention over [b, msa, res, dim] inputs
+    (reference fused_gate_attention.py:19; einsum pseudo-code in its
+    docstring is the contract implemented here)."""
+    if merge_qkv and qkv_weight is None:
+        raise ValueError("fused_gate_attention: merge_qkv=True needs "
+                         "qkv_weight")
+    if not merge_qkv and any(
+            w is None for w in (query_weight, key_weight, value_weight)):
+        raise ValueError("fused_gate_attention: merge_qkv=False needs "
+                         "query_weight, key_weight and value_weight")
+    if has_gating and (gate_linear_weight is None
+                      or gate_linear_bias is None):
+        raise ValueError("fused_gate_attention: has_gating=True needs "
+                         "gate_linear_weight and gate_linear_bias")
+    if out_linear_weight is None:
+        raise ValueError("fused_gate_attention: out_linear_weight is "
+                         "required")
+    has_key = key is not None
+    has_mask = attn_mask is not None
+    has_nb = nonbatched_bias is not None
+    has_ob = out_linear_bias is not None
+
+    def fn(*args):
+        it = iter(args)
+        q_data = next(it)
+        m_data = next(it) if has_key else q_data
+        if merge_qkv:
+            qkv_w = next(it)  # [3, h, d, a]: contract over a
+            q3 = jnp.einsum("nbqa,chda->cnbqhd", q_data, qkv_w)
+            q, k, v = q3[0], q3[1], q3[2]
+        else:
+            qw, kw, vw = next(it), next(it), next(it)
+            q = jnp.einsum("nbqa,ahc->nbqhc", q_data, qw)
+            k = jnp.einsum("nbka,ahc->nbkhc", m_data, kw)
+            v = jnp.einsum("nbka,ahc->nbkhc", m_data, vw)
+        hd = q.shape[-1]
+        q = q * (hd ** -0.5)
+        logits = jnp.einsum("nbqhc,nbkhc->nbhqk", q, k)
+        if has_mask:
+            logits = logits + next(it)
+        if has_nb:
+            nb = next(it)  # [n, h, q, k] (or already [n, 1, h, q, k])
+            logits = logits + (nb if nb.ndim == 5 else nb[:, None])
+        w = jax.nn.softmax(logits, axis=-1)
+        out = jnp.einsum("nbhqk,nbkhc->nbqhc", w, v)
+        if has_gating:
+            gw, gb = next(it), next(it)
+            gate = jax.nn.sigmoid(
+                jnp.einsum("nbqa,ahc->nbqhc", q_data, gw) + gb)
+            out = out * gate
+        ow = next(it)
+        res = jnp.einsum("nbqhc,hco->nbqo", out, ow)
+        if has_ob:
+            res = res + next(it)
+        return res
+
+    args = [query]
+    if has_key:
+        args.append(key)
+    if merge_qkv:
+        args.append(qkv_weight)
+    else:
+        args += [query_weight, key_weight, value_weight]
+    if has_mask:
+        args.append(attn_mask)
+    if has_nb:
+        args.append(nonbatched_bias)
+    if has_gating:
+        args += [gate_linear_weight, gate_linear_bias]
+    args.append(out_linear_weight)
+    if has_ob:
+        args.append(out_linear_bias)
+    return apply_op("fused_gate_attention", fn, *args)
+
+
+def block_multihead_attention(qkv, key_cache, value_cache, seq_lens_encoder,
+                              seq_lens_decoder, seq_lens_this_time,
+                              padding_offsets, cum_offsets, cu_seqlens_q,
+                              cu_seqlens_k, block_tables, pre_key_cache=None,
+                              pre_value_cache=None, cache_k_quant_scales=None,
+                              cache_v_quant_scales=None,
+                              cache_k_dequant_scales=None,
+                              cache_v_dequant_scales=None, qkv_out_scale=None,
+                              qkv_bias=None, out_shift=None, out_smooth=None,
+                              max_enc_len_this_time=None,
+                              max_dec_len_this_time=None, rope_emb=None,
+                              mask=None, tgt_mask=None, max_seq_len=-1,
+                              block_size=64, use_neox_style=False):
+    """Paged-KV attention for serving batches (reference
+    block_multihead_attention — the vLLM-style paged kernel). TPU-native
+    form: the per-sequence block table gathers the paged cache into a
+    contiguous view (one XLA gather), then masked attention runs per
+    sequence; decode steps append at ``seq_lens_decoder``. The int8
+    cache-quant arguments are not supported."""
+    if any(a is not None for a in (cache_k_quant_scales, cache_v_quant_scales,
+                                   cache_k_dequant_scales,
+                                   cache_v_dequant_scales, qkv_out_scale,
+                                   out_shift, out_smooth, pre_key_cache,
+                                   pre_value_cache)):
+        raise NotImplementedError(
+            "block_multihead_attention: int8 cache quantization / "
+            "pre-caches are not supported on the TPU build")
+    if rope_emb is not None or mask is not None or tgt_mask is not None:
+        raise NotImplementedError(
+            "block_multihead_attention: in-kernel rope_emb/mask/tgt_mask "
+            "are not supported on the TPU build — apply rotary before the "
+            "call (silently skipping them would corrupt every decode)")
+    import math as _m
+
+    import numpy as _np
+
+    nh = key_cache.shape[1]
+    hd = key_cache.shape[3]
+    # the TPU build handles the uniform-batch packing only: validate
+    # EAGERLY against seq_lens_this_time rather than misassigning tokens
+    lens_np = _np.asarray(
+        seq_lens_this_time._data if hasattr(seq_lens_this_time, "_data")
+        else seq_lens_this_time)
+    if lens_np.size and not (lens_np == lens_np.reshape(-1)[0]).all():
+        raise NotImplementedError(
+            "block_multihead_attention: varlen-packed batches (unequal "
+            "seq_lens_this_time) are not supported on the TPU build")
+    has_qkv_bias = qkv_bias is not None
+
+    def fn(qkv_v, kc, vc, enc_lens, dec_lens, this_lens, bt, *rest):
+        bias_v = rest[0] if has_qkv_bias else None
+        # qkv_v: [token_num, 3*nh*hd] varlen-packed; this build handles the
+        # uniform-batch layout (token_num = bsz * s_this_time)
+        bsz = bt.shape[0]
+        s = qkv_v.shape[0] // bsz
+        q3 = qkv_v.reshape(bsz, s, 3, nh, hd)
+        if bias_v is not None:
+            q3 = q3 + bias_v.reshape(1, 1, 3, nh, hd)
+        q, k_new, v_new = q3[:, :, 0], q3[:, :, 1], q3[:, :, 2]
+        # gather each sequence's paged cache into a contiguous view
+        max_blocks = bt.shape[1]
+        bt_safe = jnp.clip(bt, 0, kc.shape[0] - 1)
+        k_pages = kc[bt_safe]          # [bsz, max_blocks, nh, bs, hd]
+        v_pages = vc[bt_safe]
+        k_lin = k_pages.transpose(0, 2, 1, 3, 4).reshape(
+            bsz, nh, max_blocks * block_size, hd)
+        v_lin = v_pages.transpose(0, 2, 1, 3, 4).reshape(
+            bsz, nh, max_blocks * block_size, hd)
+        past = dec_lens.reshape(bsz)  # decode: tokens already cached
+        # append the new tokens after the cached prefix
+        pos = past[:, None] + jnp.arange(s)[None, :]        # [bsz, s]
+        bidx = jnp.arange(bsz)[:, None]
+        # separated advanced indices put the broadcast dims first: the
+        # selected shape is [bsz, s, nh, hd], matching k_new/v_new
+        k_lin = k_lin.at[bidx, :, pos].set(k_new)
+        v_lin = v_lin.at[bidx, :, pos].set(v_new)
+        total = past + s
+        scores = jnp.einsum("bqnd,bnld->bnql",
+                            q, k_lin) / _m.sqrt(hd)
+        l_ids = jnp.arange(k_lin.shape[2])
+        valid = l_ids[None, None, None, :] < total[:, None, None, None]
+        causal = (l_ids[None, None, None, :]
+                  <= pos[:, None, :, None])
+        scores = jnp.where(valid & causal, scores, -1e30)
+        p = jax.nn.softmax(scores, axis=-1)
+        ctx = jnp.einsum("bnql,bnld->bqnd", p, v_lin)
+        out = ctx.reshape(bsz * s, nh * hd)
+        # write the updated pages back (scatter the linear view into pages)
+        k_pages_new = k_lin.reshape(
+            bsz, nh, max_blocks, block_size, hd).transpose(0, 2, 1, 3, 4)
+        v_pages_new = v_lin.reshape(
+            bsz, nh, max_blocks, block_size, hd).transpose(0, 2, 1, 3, 4)
+        # padding block-table entries (< 0) must NOT write back: their
+        # gathered copy of block 0 is stale, and duplicate scatter indices
+        # are nondeterministic — route them out of bounds and drop
+        bt_write = jnp.where(bt >= 0, bt, kc.shape[0])
+        kc_new = kc.at[bt_write].set(k_pages_new, mode="drop")
+        vc_new = vc.at[bt_write].set(v_pages_new, mode="drop")
+        return out, kc_new, vc_new
+
+    args = [qkv, key_cache, value_cache, seq_lens_encoder, seq_lens_decoder,
+            seq_lens_this_time, block_tables]
+    if qkv_bias is not None:
+        args.append(qkv_bias)
+    return apply_op("block_multihead_attention", fn, *args)

@@ -281,3 +281,122 @@ class TestFusedServingFamily:
         assert len(caches) == L
         assert tuple(caches[0].shape) == (2, B, nh, S, hd)
         assert np.isfinite(out.numpy()).all()
+
+
+class TestFusedServingFamilyPart2:
+    def test_fused_ec_moe_matches_dense_mixture(self, rng):
+        from paddle_tpu.incubate.nn.functional import fused_ec_moe
+
+        B, S, D, F_, E = 2, 3, 4, 8, 3
+        x = rng.randn(B, S, D).astype("float32")
+        g = rng.randn(B, S, E).astype("float32")
+        w0 = rng.randn(E, D, F_).astype("float32")
+        b0 = rng.randn(E, 1, F_).astype("float32")
+        w1 = rng.randn(E, F_, D).astype("float32")
+        b1 = rng.randn(E, 1, D).astype("float32")
+        out = fused_ec_moe(*map(paddle.to_tensor, (x, g, w0, b0, w1, b1)),
+                           act_type="relu")
+        probs = np.exp(g - g.max(-1, keepdims=True))
+        probs /= probs.sum(-1, keepdims=True)
+        ref = np.zeros_like(x)
+        for e in range(E):
+            h = np.maximum(x @ w0[e] + b0[e, 0], 0)
+            ref += (h @ w1[e] + b1[e, 0]) * probs[..., e:e + 1]
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+    def test_fused_gate_attention_matches_einsum_oracle(self, rng):
+        from paddle_tpu.incubate.nn.functional import fused_gate_attention
+
+        n, b, q_len, a, h, d = 2, 3, 4, 8, 2, 4
+        qd = rng.randn(n, b, q_len, a).astype("float32")
+        qkv_w = rng.randn(3, h, d, a).astype("float32")
+        gw = rng.randn(a, h, d).astype("float32")
+        gb = rng.randn(h, d).astype("float32")
+        ow = rng.randn(h, d, a).astype("float32")
+        ob = rng.randn(a).astype("float32")
+        out = fused_gate_attention(
+            paddle.to_tensor(qd), qkv_weight=paddle.to_tensor(qkv_w),
+            gate_linear_weight=paddle.to_tensor(gw),
+            gate_linear_bias=paddle.to_tensor(gb),
+            out_linear_weight=paddle.to_tensor(ow),
+            out_linear_bias=paddle.to_tensor(ob))
+        # reference docstring pseudo-code oracle
+        q3 = np.einsum("nbqa,chda->cnbqhd", qd, qkv_w)
+        q, k, v = q3
+        q = q * (d ** -0.5)
+        logits = np.einsum("nbqhc,nbkhc->nbhqk", q, k)
+        w = np.exp(logits - logits.max(-1, keepdims=True))
+        w /= w.sum(-1, keepdims=True)
+        o = np.einsum("nbhqk,nbkhc->nbqhc", w, v)
+        gate = 1 / (1 + np.exp(-(np.einsum("nbqa,ahc->nbqhc", qd, gw) + gb)))
+        ref = np.einsum("nbqhc,hco->nbqo", o * gate, ow) + ob
+        np.testing.assert_allclose(out.numpy(), ref, rtol=2e-4, atol=1e-5)
+
+    def test_block_multihead_attention_decode(self, rng):
+        """One decode step over a paged cache equals dense attention over
+        the gathered prefix + the new token."""
+        import math
+
+        from paddle_tpu.incubate.nn.functional import (
+            block_multihead_attention)
+
+        bsz, nh, hd, bs = 2, 2, 4, 4
+        num_blocks, blocks_per_seq = 6, 2
+        max_len = blocks_per_seq * bs
+        past = np.array([3, 5], np.int32)
+        kc = rng.randn(num_blocks, nh, bs, hd).astype("float32")
+        vc = rng.randn(num_blocks, nh, bs, hd).astype("float32")
+        bt = np.array([[0, 2], [1, 4]], np.int32)
+        qkv = rng.randn(bsz * 1, 3 * nh * hd).astype("float32")
+        z = lambda: paddle.to_tensor(np.zeros((bsz,), np.int32))
+        out, kc2, vc2 = block_multihead_attention(
+            paddle.to_tensor(qkv), paddle.to_tensor(kc),
+            paddle.to_tensor(vc), z(), paddle.to_tensor(past),
+            paddle.to_tensor(np.ones(bsz, np.int32)), None, None, None,
+            None, paddle.to_tensor(bt), block_size=bs)
+        q3 = qkv.reshape(bsz, 1, 3, nh, hd)
+        for b in range(bsz):
+            k_lin = kc[bt[b]].transpose(1, 0, 2, 3).reshape(nh, max_len, hd)
+            v_lin = vc[bt[b]].transpose(1, 0, 2, 3).reshape(nh, max_len, hd)
+            k_lin[:, past[b]] = q3[b, 0, 1]
+            v_lin[:, past[b]] = q3[b, 0, 2]
+            q = q3[b, 0, 0]
+            s = np.einsum("nd,nld->nl", q, k_lin[:, :past[b] + 1])
+            s = s / math.sqrt(hd)
+            p = np.exp(s - s.max(-1, keepdims=True))
+            p /= p.sum(-1, keepdims=True)
+            ref = np.einsum("nl,nld->nd", p, v_lin[:, :past[b] + 1])
+            np.testing.assert_allclose(out.numpy()[b].reshape(nh, hd), ref,
+                                       rtol=1e-4, atol=1e-5)
+        # cache pages got the new token written back
+        blk, off = divmod(int(past[0]), bs)
+        np.testing.assert_allclose(
+            kc2.numpy()[bt[0, blk], :, off], q3[0, 0, 1], rtol=1e-6)
+
+    def test_block_attention_padding_blocks_do_not_corrupt(self, rng):
+        """-1 padding entries in the block table are dropped on write-back
+        (a clipped scatter would overwrite block 0 with stale data)."""
+        from paddle_tpu.incubate.nn.functional import (
+            block_multihead_attention)
+
+        bsz, nh, hd, bs = 2, 1, 4, 4
+        kc = rng.randn(4, nh, bs, hd).astype("float32")
+        vc = rng.randn(4, nh, bs, hd).astype("float32")
+        # seq 0 owns block 0; seq 1 owns block 2 with a PADDING entry
+        bt = np.array([[0, 1], [2, -1]], np.int32)
+        past = np.array([1, 1], np.int32)
+        qkv = rng.randn(2, 3 * nh * hd).astype("float32")
+        z = lambda: paddle.to_tensor(np.zeros((bsz,), np.int32))
+        out, kc2, vc2 = block_multihead_attention(
+            paddle.to_tensor(qkv), paddle.to_tensor(kc),
+            paddle.to_tensor(vc), z(), paddle.to_tensor(past),
+            paddle.to_tensor(np.ones(bsz, np.int32)), None, None, None,
+            None, paddle.to_tensor(bt), block_size=bs)
+        # block 0 position `past[0]` holds seq 0's NEW k, not seq 1's
+        # stale gathered copy
+        q3 = qkv.reshape(bsz, 1, 3, nh, hd)
+        np.testing.assert_allclose(kc2.numpy()[0, :, 1], q3[0, 0, 1],
+                                   rtol=1e-6)
+        # untouched rows of block 0 are preserved
+        np.testing.assert_allclose(kc2.numpy()[0, :, 0], kc[0, :, 0],
+                                   rtol=1e-6)

@@ -1001,7 +1001,8 @@ _SKIP_GROUPS = {
     ],
     "fused serving op (oracle-tested in tests/test_incubate.py TestFusedServingFamily)": [
         "fused_matmul_bias", "fused_qkv", "fused_cache_concat",
-        "masked_multihead_attention",
+        "masked_multihead_attention", "fused_ec_moe",
+        "fused_gate_attention", "block_multihead_attention",
     ],
     "sparse op (COO/CSR formats; covered by tests/test_sparse.py)": [
         "sparse_add", "sparse_add_dense", "sparse_attention",
