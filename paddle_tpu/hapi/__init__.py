@@ -1,6 +1,8 @@
 """paddle_tpu.hapi (parity: python/paddle/hapi/)."""
 from . import callbacks
-from .callbacks import Callback, EarlyStopping, LRScheduler, ModelCheckpoint, ProgBarLogger
+from .callbacks import (Callback, EarlyStopping, LRScheduler,
+                        ModelCheckpoint, ProgBarLogger, ReduceLROnPlateau,
+                        VisualDL, WandbCallback)
 from .model import Model
 from .model_summary import flops, summary
 

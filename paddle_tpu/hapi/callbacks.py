@@ -172,3 +172,125 @@ class EarlyStopping(Callback):
             if self.wait >= self.patience:
                 self.stop_training = True
                 self.model.stop_training = True
+
+
+class ReduceLROnPlateau(Callback):
+    """Reduce the optimizer LR when the monitored metric plateaus
+    (reference hapi/callbacks.py ReduceLROnPlateau)."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10, verbose=1,
+                 mode="auto", min_delta=1e-4, cooldown=0, min_lr=0):
+        self.monitor = monitor
+        self.factor = float(factor)
+        self.patience = patience
+        self.verbose = verbose
+        self.min_delta = abs(min_delta)
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        if mode == "auto":
+            mode = "max" if "acc" in monitor else "min"
+        self.mode = mode
+        self.best = None
+        self.wait = 0
+        self.cooldown_counter = 0
+
+    def _better(self, cur, best):
+        if best is None:
+            return True
+        if self.mode == "min":
+            return cur < best - self.min_delta
+        return cur > best + self.min_delta
+
+    def _current(self, logs):
+        cur = (logs or {}).get(self.monitor)
+        if cur is None:
+            return None
+        if not isinstance(cur, numbers.Number):
+            cur = float(np.ravel(cur)[0])
+        return float(cur)
+
+    def on_eval_end(self, logs=None):
+        cur = self._current(logs)
+        if cur is None:
+            return
+        if self.cooldown_counter > 0:
+            self.cooldown_counter -= 1
+            self.wait = 0
+        if self._better(cur, self.best):
+            self.best = cur
+            self.wait = 0
+            return
+        if self.cooldown_counter > 0:
+            return
+        self.wait += 1
+        if self.wait >= self.patience:
+            opt = self.model._optimizer
+            from ..optimizer.lr import LRScheduler as Sched
+
+            lr = getattr(opt, "_learning_rate", None)
+            if isinstance(lr, Sched):
+                self.wait = 0  # scheduler owns the lr; reference skips too
+                return
+            old = float(lr)
+            new = max(old * self.factor, self.min_lr)
+            if new < old:
+                opt.set_lr(new)
+                if self.verbose:
+                    print(f"ReduceLROnPlateau: lr {old:.3e} -> {new:.3e}")
+            self.wait = 0
+            self.cooldown_counter = self.cooldown
+
+
+class VisualDL(Callback):
+    """VisualDL scalar logging (reference hapi/callbacks.py VisualDL).
+    The visualdl package is not in this build — the callback degrades to a
+    JSONL metric log at the same path (loadable by any dashboard)."""
+
+    def __init__(self, log_dir):
+        self.log_dir = log_dir
+        self._step = 0
+
+    def _write(self, tag, logs):
+        import json
+
+        os.makedirs(self.log_dir, exist_ok=True)
+        rec = {"tag": tag, "step": self._step}
+        for k, v in (logs or {}).items():
+            if isinstance(v, numbers.Number):
+                rec[k] = float(v)
+            else:
+                try:
+                    rec[k] = float(np.ravel(v)[0])
+                except Exception:
+                    continue
+        with open(os.path.join(self.log_dir, "scalars.jsonl"), "a") as f:
+            f.write(json.dumps(rec) + "\n")
+
+    def on_train_batch_end(self, step, logs=None):
+        self._step += 1
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._write("train", logs)
+
+    def on_eval_end(self, logs=None):
+        self._write("eval", logs)
+
+
+class WandbCallback(Callback):
+    """Weights & Biases logging (reference hapi/callbacks.py
+    WandbCallback). Requires the external wandb package; raises with
+    guidance when absent (no silent no-op)."""
+
+    def __init__(self, project=None, dir=None, **kwargs):
+        try:
+            import wandb
+        except ImportError as e:
+            raise ImportError(
+                "WandbCallback requires the 'wandb' package, which is not "
+                "available in this build — use the VisualDL callback's "
+                "JSONL output or a custom Callback instead") from e
+        self._run = wandb.init(project=project, dir=dir, **kwargs)
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._run.log({k: v for k, v in (logs or {}).items()
+                       if isinstance(v, numbers.Number)})

@@ -598,6 +598,10 @@ def fused_gate_attention(query, key=None, query_weight=None, key_weight=None,
     if merge_qkv and qkv_weight is None:
         raise ValueError("fused_gate_attention: merge_qkv=True needs "
                          "qkv_weight")
+    if merge_qkv and key is not None:
+        raise ValueError(
+            "fused_gate_attention: merge_qkv=True is self-attention — "
+            "pass key=None (a distinct key needs merge_qkv=False)")
     if not merge_qkv and any(
             w is None for w in (query_weight, key_weight, value_weight)):
         raise ValueError("fused_gate_attention: merge_qkv=False needs "
@@ -714,6 +718,15 @@ def block_multihead_attention(qkv, key_cache, value_cache, seq_lens_encoder,
         raise NotImplementedError(
             "block_multihead_attention: varlen-packed batches (unequal "
             "seq_lens_this_time) are not supported on the TPU build")
+    bsz_bt = (block_tables.shape[0] if hasattr(block_tables, "shape")
+              else len(block_tables))
+    s_decl = int(lens_np.reshape(-1)[0]) if lens_np.size else 0
+    tok = qkv.shape[0]
+    if s_decl and tok != bsz_bt * s_decl:
+        raise ValueError(
+            f"block_multihead_attention: qkv packs {tok} tokens but "
+            f"seq_lens_this_time declares {s_decl} per sequence x "
+            f"{bsz_bt} sequences = {bsz_bt * s_decl}")
     has_qkv_bias = qkv_bias is not None
 
     def fn(qkv_v, kc, vc, enc_lens, dec_lens, this_lens, bt, *rest):

@@ -22,6 +22,35 @@ from .program import (
     in_static_mode,
     program_guard,
 )
+from .misc import (
+    BuildStrategy,
+    ExecutionStrategy,
+    ExponentialMovingAverage,
+    Print,
+    Variable,
+    WeightNormParamAttr,
+    accuracy,
+    auc,
+    cpu_places,
+    create_global_var,
+    create_parameter,
+    cuda_places,
+    deserialize_persistables,
+    deserialize_program,
+    device_guard,
+    global_scope,
+    load_from_file,
+    load_program_state,
+    name_scope,
+    normalize_program,
+    py_func,
+    save_to_file,
+    scope_guard,
+    serialize_persistables,
+    serialize_program,
+    set_program_state,
+    xpu_places,
+)
 
 __all__ = [
     "InputSpec", "nn", "CompiledProgram", "Executor", "Program", "data",
@@ -29,4 +58,12 @@ __all__ = [
     "enable_static", "in_static_mode", "program_guard", "load",
     "load_inference_model", "save", "save_inference_model",
     "gradients", "append_backward",
+    "BuildStrategy", "ExecutionStrategy", "ExponentialMovingAverage",
+    "Print", "Variable", "WeightNormParamAttr", "accuracy", "auc",
+    "cpu_places", "create_global_var", "create_parameter", "cuda_places",
+    "deserialize_persistables", "deserialize_program", "device_guard",
+    "global_scope", "load_from_file", "load_program_state", "name_scope",
+    "normalize_program", "py_func", "save_to_file", "scope_guard",
+    "serialize_persistables", "serialize_program", "set_program_state",
+    "xpu_places",
 ]

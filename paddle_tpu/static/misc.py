@@ -1,0 +1,359 @@
+"""Static-graph auxiliary surface (reference python/paddle/static/*):
+scopes, guards, program state, serialization helpers, static metrics,
+EMA. The record-replay Program design collapses most of these to thin
+shims — documented per item.
+"""
+from __future__ import annotations
+
+import contextlib
+import pickle
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor.tensor import Parameter, Tensor
+from .program import Program, default_main_program, default_startup_program
+
+# Variable: the reference's static-graph tensor handle; the one-IR design
+# uses Tensor everywhere (SURVEY §2.3), so the name is an alias.
+Variable = Tensor
+
+
+@contextlib.contextmanager
+def name_scope(prefix=None):
+    """Name prefix scope (reference static/nn/common.py name_scope): names
+    generated inside carry the prefix (a fresh prefixed unique_name
+    generator, the same mechanism the reference pushes)."""
+    from ..utils import unique_name as _un
+
+    with _un.guard(prefix or ""):
+        yield
+
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    """Reference device_guard pins ops to a device inside a program; XLA
+    owns placement under the one-IR design, so this is a documented no-op
+    scope (kept so reference programs run unchanged)."""
+    yield
+
+
+class _Scope:
+    """Reference Scope: a variable name -> value store. The record-replay
+    Executor keeps state on the Program itself; this scope view exposes
+    the same lookup surface."""
+
+    def __init__(self):
+        self._vars = {}
+
+    def var(self, name):
+        return self._vars.setdefault(name, _ScopeVar())
+
+    def find_var(self, name):
+        return self._vars.get(name)
+
+
+class _ScopeVar:
+    def __init__(self):
+        self._value = None
+
+    def get_tensor(self):
+        return self._value
+
+    def set(self, value, place=None):
+        self._value = value
+
+
+_GLOBAL_SCOPE = _Scope()
+
+
+def global_scope():
+    return _GLOBAL_SCOPE
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    global _GLOBAL_SCOPE
+    old, _GLOBAL_SCOPE = _GLOBAL_SCOPE, scope
+    try:
+        yield
+    finally:
+        _GLOBAL_SCOPE = old
+
+
+def cpu_places(device_count=None):
+    from ..device import CPUPlace
+
+    n = device_count or 1
+    return [CPUPlace() for _ in range(n)]
+
+
+def cuda_places(device_ids=None):
+    # CUDA does not exist here; the accelerator places are the TPU chips
+    from ..device import CustomPlace
+
+    ids = device_ids if device_ids is not None else range(
+        len(jax.devices()))
+    return [CustomPlace("tpu", int(i)) for i in ids]
+
+
+xpu_places = cuda_places
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    """A persistable var in the program (reference creates a var in the
+    global block; here: a Parameter-like persistent Tensor)."""
+    from ..framework.dtype import to_jax_dtype
+
+    t = Parameter(jnp.full(list(shape), value, to_jax_dtype(dtype)),
+                  trainable=False, name=name)
+    t.persistable = bool(persistable)
+    return t
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """Reference static.create_parameter — a trainable parameter outside
+    any Layer."""
+    from ..nn.initializer import XavierUniform
+
+    init = default_initializer or (attr.initializer if attr is not None
+                                   and getattr(attr, "initializer", None)
+                                   else XavierUniform())
+    from ..framework.dtype import to_jax_dtype
+
+    data = init(list(shape), to_jax_dtype(dtype))
+    param = Parameter(data, name=name or (attr.name if attr else None))
+    # register with the recording program (reference: parameters live in
+    # the program's global block) so Program.parameters()/save see it
+    from .program import default_main_program, is_recording
+
+    if is_recording():
+        default_main_program()._params[param.name] = param
+    return param
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=True, print_phase="both"):
+    """Reference static.Print op: passthrough + host-side debug print via
+    jax.debug.print (works inside jit, matching the op semantics)."""
+    from ..autograd.engine import apply_op
+
+    msg = message or ""
+
+    def fn(v):
+        jax.debug.print(msg + " {}", v)
+        return v
+
+    return apply_op("print", fn, input)
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Reference static.py_func: run host python inside the graph. Under
+    jax this is pure_callback (forward) with an optional custom backward."""
+    from ..autograd.engine import apply_op
+
+    if backward_func is not None:
+        raise NotImplementedError(
+            "py_func: backward_func is not supported — wrap the host "
+            "function with autograd.PyLayer for a custom gradient")
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    shapes = [jax.ShapeDtypeStruct(tuple(o.shape), o._data.dtype)
+              for o in outs]
+
+    def fn(*vals):
+        res = jax.pure_callback(
+            lambda *a: func(*[np.asarray(v) for v in a]), shapes, *vals)
+        return res if len(shapes) > 1 else res[0]
+
+    return apply_op("py_func", fn, *xs)
+
+
+def serialize_program(feed_vars, fetch_vars, program=None):
+    """Program -> bytes (reference serialize_program pickles the
+    ProgramDesc proto; the record-replay Program serializes through
+    jit.save's StableHLO path for real deployment — this byte form covers
+    the reference's in-memory round-trip use)."""
+    prog = program or default_main_program()
+    return pickle.dumps({
+        "num_ops": prog.num_ops(),
+        "feeds": [getattr(v, "name", str(i))
+                  for i, v in enumerate(feed_vars or [])],
+        "fetches": [getattr(v, "name", str(i))
+                    for i, v in enumerate(fetch_vars or [])],
+    })
+
+
+def deserialize_program(data):
+    meta = pickle.loads(data)
+    prog = Program()
+    prog._serialized_meta = meta
+    return prog
+
+
+def serialize_persistables(feed_vars, fetch_vars, program=None):
+    prog = program or default_main_program()
+    state = {p.name: np.asarray(p.numpy()) for p in prog.parameters()}
+    return pickle.dumps(state)
+
+
+def deserialize_persistables(program, data, executor=None):
+    state = pickle.loads(data)
+    for p in program.parameters():
+        if p.name in state:
+            p._data = jnp.asarray(state[p.name])
+    return state
+
+
+def save_to_file(path, content):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def load_program_state(model_path, var_list=None):
+    """Reference load_program_state: {name: ndarray} from a static.save
+    artifact (io.save writes <path>.pdparams pickle)."""
+    with open(model_path + ".pdparams", "rb") as f:
+        return pickle.load(f)
+
+
+def set_program_state(program, state_dict):
+    for p in program.parameters():
+        if p.name in state_dict:
+            p._data = jnp.asarray(state_dict[p.name])
+
+
+def normalize_program(program, feed_vars, fetch_vars, **kwargs):
+    """Reference: prune + inline feed/fetch for export. Record-replay
+    programs are already minimal per (feed, fetch) signature."""
+    return program
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """Static metric op (reference static/nn/metric.py accuracy)."""
+    from ..autograd.engine import apply_op
+
+    def fn(logits, y):
+        topk = jnp.argsort(-logits, axis=-1)[..., :k]
+        hit = (topk == y.reshape(-1, 1)).any(axis=-1)
+        return jnp.mean(hit.astype(jnp.float32))
+
+    return apply_op("accuracy", fn, input, label)
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1, ins_tag_weight=None):
+    """Static AUC op (reference static/nn/metric.py auc): histogram
+    approximation with num_thresholds bins. Only the ROC curve is
+    implemented (PR would silently return the wrong metric)."""
+    if curve != "ROC":
+        raise NotImplementedError(
+            f"auc: curve={curve!r} is not supported (ROC only)")
+    from ..autograd.engine import apply_op
+
+    def fn(probs, y):
+        pos_prob = probs[:, 1] if probs.ndim == 2 else probs.reshape(-1)
+        yb = y.reshape(-1).astype(jnp.float32)
+        bins = jnp.clip((pos_prob * num_thresholds).astype(jnp.int32), 0,
+                        num_thresholds)
+        pos_hist = jnp.zeros(num_thresholds + 1).at[bins].add(yb)
+        neg_hist = jnp.zeros(num_thresholds + 1).at[bins].add(1.0 - yb)
+        # sweep thresholds high->low accumulating TP/FP
+        tp = jnp.cumsum(pos_hist[::-1])
+        fp = jnp.cumsum(neg_hist[::-1])
+        tot_p = tp[-1]
+        tot_n = fp[-1]
+        tpr = tp / jnp.maximum(tot_p, 1.0)
+        fpr = fp / jnp.maximum(tot_n, 1.0)
+        return jnp.trapezoid(tpr, fpr)
+
+    return apply_op("auc", fn, input, label)
+
+
+class ExponentialMovingAverage:
+    """EMA of parameters (reference static/ema.py): update() folds the
+    current parameter values in; apply()/restore() swap the averages into
+    the parameters around evaluation."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = float(decay)
+        self._tracked: dict = {}  # name -> (param ref, ema array)
+        self._backup: dict = {}
+        self._step = 0
+
+    def update(self, parameters=None):
+        params = parameters or default_main_program().parameters()
+        self._step += 1
+        for p in params:
+            prev = self._tracked.get(p.name)
+            cur = p._data
+            ema = (cur if prev is None else
+                   self._decay * prev[1] + (1.0 - self._decay) * cur)
+            self._tracked[p.name] = (p, ema)
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        for name, (p, ema) in self._tracked.items():
+            self._backup[name] = p._data
+            p._data = ema
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        for name, (p, _e) in self._tracked.items():
+            if name in self._backup:
+                p._data = self._backup.pop(name)
+
+
+class BuildStrategy:
+    """Reference BuildStrategy: fusion/memory-pass toggles consumed by the
+    ParallelExecutor. XLA owns those passes under the one-IR design; the
+    class keeps the attribute surface so reference configs parse."""
+
+    def __init__(self):
+        self.enable_inplace = True
+        self.fuse_elewise_add_act_ops = True
+        self.fuse_bn_act_ops = True
+        self.memory_optimize = True
+        self.reduce_strategy = 0
+        self.build_cinn_pass = False
+
+
+class ExecutionStrategy:
+    """Reference ExecutionStrategy (thread pools, iteration drop): the
+    Executor compiles one XLA program — attributes kept for config
+    parity."""
+
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 10
+        self.num_iteration_per_run = 1
+
+
+class WeightNormParamAttr:
+    """Reference WeightNormParamAttr — weight-norm reparameterization via
+    ParamAttr. The dygraph path uses nn.utils.weight_norm; this attr
+    carries (dim, name/initializer) so static builders accept it."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        self.dim = dim
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.trainable = trainable

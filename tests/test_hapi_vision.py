@@ -318,3 +318,39 @@ def test_stream_event_semantics():
     e2.synchronize()
     assert e2.query() is True
     assert e1.elapsed_time(e2) >= 0.0
+
+
+def test_round4_callbacks(tmp_path, rng):
+    """ReduceLROnPlateau halves the lr after patience; VisualDL degrades
+    to JSONL scalars; WandbCallback raises with guidance (wandb absent)."""
+    import json
+
+    import paddle_tpu as paddle
+    from paddle_tpu.hapi.callbacks import (ReduceLROnPlateau, VisualDL,
+                                           WandbCallback)
+
+    class FakeModel:
+        pass
+
+    m = FakeModel()
+    m._optimizer = paddle.optimizer.SGD(
+        0.1, parameters=[paddle.to_tensor(np.zeros(2, np.float32))])
+    cb = ReduceLROnPlateau(monitor="loss", factor=0.5, patience=2,
+                           verbose=0)
+    cb.model = m
+    for loss in (1.0, 1.0, 1.0, 1.0):
+        cb.on_eval_end({"loss": loss})
+    assert abs(m._optimizer.get_lr() - 0.05) < 1e-9
+
+    vd = VisualDL(str(tmp_path / "vdl"))
+    vd.model = m
+    vd.on_epoch_end(0, {"loss": 0.5, "acc": np.array([0.9])})
+    vd.on_eval_end({"loss": 0.4})
+    lines = [json.loads(l) for l in
+             open(tmp_path / "vdl" / "scalars.jsonl")]
+    assert lines[0]["loss"] == 0.5 and lines[1]["tag"] == "eval"
+
+    import pytest
+
+    with pytest.raises(ImportError, match="wandb"):
+        WandbCallback(project="x")

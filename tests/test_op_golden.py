@@ -995,6 +995,7 @@ _SKIP_GROUPS = {
     ],
     "graph-capture/structural op (covered by tests/test_jit.py, test_static.py, test_autograd.py)": [
         "jit_program", "jit_loaded_program", "gradients", "recompute",
+        "print", "py_func", "accuracy", "auc",
     ],
     "geometric message-passing op (covered by tests/test_incubate.py)": [
         "send_u_recv", "send_ue_recv", "send_uv", "segment_mean",

@@ -382,3 +382,94 @@ def test_run_without_random_ops_preserves_generator(rng):
     exe.run(main, feed={"x": np.ones((1, 4), "float32")}, fetch_list=[y])
     b = paddle.randn([4]).numpy()
     np.testing.assert_array_equal(a, b)
+
+
+class TestStaticMiscSurface:
+    """Round-4 static auxiliary surface (reference python/paddle/static)."""
+
+    def test_scopes_places_and_guards(self):
+        import paddle_tpu.static as st
+
+        sc = st.global_scope()
+        sc.var("x").set(np.ones(3))
+        with st.scope_guard(st._Scope() if hasattr(st, "_Scope")
+                            else st.global_scope()):
+            pass
+        assert st.cpu_places(2) and st.cuda_places([0])
+        with st.name_scope("blk"):
+            pass
+        with st.device_guard("gpu:0"):
+            pass
+
+    def test_static_metrics(self, rng):
+        import paddle_tpu.static as st
+
+        logits = rng.randn(32, 5).astype("float32")
+        labels = rng.randint(0, 5, (32, 1)).astype("int64")
+        acc = st.accuracy(paddle.to_tensor(logits),
+                          paddle.to_tensor(labels), k=1)
+        ref = (logits.argmax(-1) == labels.ravel()).mean()
+        np.testing.assert_allclose(float(acc.numpy()), ref, rtol=1e-6)
+        # AUC of a perfect ranking -> ~1, of an inverted ranking -> ~0
+        pos = np.linspace(0, 1, 64).astype("float32")
+        probs = np.stack([1 - pos, pos], -1)
+        y = (pos > 0.5).astype("int64").reshape(-1, 1)
+        auc_hi = float(st.auc(paddle.to_tensor(probs),
+                              paddle.to_tensor(y)).numpy())
+        auc_lo = float(st.auc(paddle.to_tensor(probs[::-1].copy()),
+                              paddle.to_tensor(y)).numpy())
+        assert auc_hi > 0.95 and auc_lo < 0.1
+
+    def test_program_state_roundtrip(self, tmp_path, rng):
+        import paddle_tpu.static as st
+
+        paddle.enable_static()
+        try:
+            prog = st.Program()
+            with st.program_guard(prog):
+                x = st.data("x", [4, 3], "float32")
+                w = st.create_parameter([3, 2], "float32")
+                y = paddle.matmul(x, w)
+            blob = st.serialize_persistables([x], [y], prog)
+            w0 = np.asarray(w.numpy()).copy()
+            w._data = w._data * 0
+            st.deserialize_persistables(prog, blob)
+            np.testing.assert_allclose(np.asarray(w.numpy()), w0)
+            pb = st.serialize_program([x], [y], prog)
+            st.save_to_file(str(tmp_path / "m.bin"), pb)
+            assert st.load_from_file(str(tmp_path / "m.bin")) == pb
+            prog2 = st.deserialize_program(pb)
+            assert st.normalize_program(prog2, [x], [y]) is prog2
+        finally:
+            paddle.disable_static()
+
+    def test_ema_apply_restore(self, rng):
+        import paddle_tpu.static as st
+
+        paddle.enable_static()
+        try:
+            prog = st.Program()
+            with st.program_guard(prog):
+                w = st.create_parameter([4], "float32")
+            ema = st.ExponentialMovingAverage(decay=0.5)
+            w._data = w._data * 0 + 1.0
+            ema.update(prog.parameters())
+            w._data = w._data * 0 + 3.0
+            ema.update(prog.parameters())
+            # ema = 0.5*1 + 0.5*3 = 2
+            with ema.apply():
+                np.testing.assert_allclose(np.asarray(w.numpy()), 2.0)
+            np.testing.assert_allclose(np.asarray(w.numpy()), 3.0)
+        finally:
+            paddle.disable_static()
+
+    def test_py_func_and_print(self, rng):
+        import paddle_tpu.static as st
+
+        x = paddle.to_tensor(rng.randn(3, 2).astype("float32"))
+        out_spec = paddle.to_tensor(np.zeros((3, 2), np.float32))
+        res = st.py_func(lambda a: a * 2 + 1, x, out_spec)
+        np.testing.assert_allclose(res.numpy(), x.numpy() * 2 + 1,
+                                   rtol=1e-6)
+        out = st.Print(x, message="dbg")
+        np.testing.assert_allclose(out.numpy(), x.numpy())
