@@ -121,7 +121,7 @@ _bulk([
     "isinf", "isnan", "isneginf", "isposinf", "isreal", "less_equal",
     "less_than", "logical_and", "logical_not", "logical_or", "logical_xor",
     "not_equal", "one_hot", "searchsorted", "sequence_mask", "signbit",
-    "accuracy", "auc",
+    "accuracy", "auc", "py_func",
     "gather_tree", "class_center_sample", "top_p_sampling", "weight_quantize",
     "matrix_nms", "generate_proposals", "distribute_fpn_proposals",
 ], non_diff=True)
@@ -163,7 +163,7 @@ _bulk([
     "poisson_nll_loss", "triplet_margin_with_distance_loss", "unflatten",
     "add_n", "frexp", "gammaln", "multigammaln", "polar",
     "shard_index", "tensor_split", "diagonal_scatter", "select_scatter",
-    "slice_scatter", "print", "py_func",
+    "slice_scatter", "print",
     "gradients", "grid_sample", "gru_cell", "gumbel_softmax", "hardshrink",
     "hardsigmoid", "hardswish", "hardtanh", "heaviside",
     "hinge_embedding_loss", "householder_product", "huber_loss", "hypot",

@@ -128,6 +128,23 @@ class LRScheduler(Callback):
             s.step()
 
 
+def _monitor_value(logs, monitor):
+    cur = (logs or {}).get(monitor)
+    if cur is None:
+        return None
+    if not isinstance(cur, numbers.Number):
+        cur = float(np.ravel(cur)[0])
+    return float(cur)
+
+
+def _is_better(cur, best, mode, min_delta):
+    if best is None:
+        return True
+    if mode == "min":
+        return cur < best - min_delta
+    return cur > best + min_delta
+
+
 class EarlyStopping(Callback):
     def __init__(self, monitor="loss", mode="auto", patience=0, verbose=1,
                  min_delta=0, baseline=None, save_best_model=True):
@@ -151,17 +168,12 @@ class EarlyStopping(Callback):
             self.save_dir = params["save_dir"]
 
     def _better(self, cur, best):
-        if best is None:
-            return True
-        if self.mode == "min":
-            return cur < best - self.min_delta
-        return cur > best + self.min_delta
+        return _is_better(cur, best, self.mode, self.min_delta)
 
     def on_eval_end(self, logs=None):
-        cur = (logs or {}).get(self.monitor)
+        cur = _monitor_value(logs, self.monitor)
         if cur is None:
             return
-        cur = float(np.ravel(cur)[0]) if not isinstance(cur, numbers.Number) else float(cur)
         if self._better(cur, self.best):
             self.best = cur
             self.wait = 0
@@ -195,22 +207,10 @@ class ReduceLROnPlateau(Callback):
         self.cooldown_counter = 0
 
     def _better(self, cur, best):
-        if best is None:
-            return True
-        if self.mode == "min":
-            return cur < best - self.min_delta
-        return cur > best + self.min_delta
-
-    def _current(self, logs):
-        cur = (logs or {}).get(self.monitor)
-        if cur is None:
-            return None
-        if not isinstance(cur, numbers.Number):
-            cur = float(np.ravel(cur)[0])
-        return float(cur)
+        return _is_better(cur, best, self.mode, self.min_delta)
 
     def on_eval_end(self, logs=None):
-        cur = self._current(logs)
+        cur = _monitor_value(logs, self.monitor)
         if cur is None:
             return
         if self.cooldown_counter > 0:

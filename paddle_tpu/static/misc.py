@@ -147,7 +147,8 @@ def Print(input, first_n=-1, message=None, summarize=20,
     msg = message or ""
 
     def fn(v):
-        jax.debug.print(msg + " {}", v)
+        # message passed as DATA, not format string: user braces are safe
+        jax.debug.print("{m} {v}", m=msg, v=v)
         return v
 
     return apply_op("print", fn, input)
@@ -288,18 +289,26 @@ class ExponentialMovingAverage:
 
     def __init__(self, decay=0.999, thres_steps=None, name=None):
         self._decay = float(decay)
+        self._thres_steps = thres_steps  # truthy: ramp the decay in
         self._tracked: dict = {}  # name -> (param ref, ema array)
         self._backup: dict = {}
         self._step = 0
 
+    def _decay_t(self):
+        # reference ramp: min(decay, (1+step)/(10+step)) when thres_steps
+        if self._thres_steps is None:
+            return self._decay
+        return min(self._decay, (1.0 + self._step) / (10.0 + self._step))
+
     def update(self, parameters=None):
         params = parameters or default_main_program().parameters()
         self._step += 1
+        d = self._decay_t()
         for p in params:
             prev = self._tracked.get(p.name)
             cur = p._data
             ema = (cur if prev is None else
-                   self._decay * prev[1] + (1.0 - self._decay) * cur)
+                   d * prev[1] + (1.0 - d) * cur)
             self._tracked[p.name] = (p, ema)
 
     @contextlib.contextmanager
