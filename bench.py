@@ -344,7 +344,71 @@ def bench_bert_jit(on_tpu):
     }
 
 
+FLAGSHIP_METRIC = "gpt3-760m(+remat) fused train step tokens/sec/chip"
+
+
+def _error_line(msg, metric=FLAGSHIP_METRIC):
+    """Driver-contract JSON line for a failed run (value 0, error recorded)."""
+    return json.dumps({
+        "metric": metric, "value": 0, "unit": "tokens/s",
+        "vs_baseline": 0.0, "error": msg[:300],
+    })
+
+
+def _run_shielded(timeout=1500):
+    """Re-exec the bench in a killable child; emit error JSON if it dies.
+
+    When the TPU tunnel is down, ``jax.devices()`` (and any dispatch) HANGS
+    rather than raising — round 4 lost its entire bench evidence to exactly
+    this (rc=1 traceback / rc=124 driver timeout, no JSON). A short-timeout
+    probe child fails fast on a dead tunnel (~2 min, far under any driver
+    budget); the full bench then runs in its own killable child so mid-run
+    hangs also become one structured line. The parent never touches jax.
+    """
+    import os
+    import subprocess
+    import sys
+
+    timeout = float(os.environ.get("_BENCH_SHIELD_TIMEOUT", timeout))
+    probe_timeout = float(os.environ.get("_BENCH_PROBE_TIMEOUT", 180))
+    try:
+        subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            timeout=probe_timeout, check=True,
+        )
+    except subprocess.TimeoutExpired:
+        print(_error_line("backend_unavailable: device probe timed out "
+                          "(tunnel hang)"))
+        return
+    except subprocess.CalledProcessError as e:
+        print(_error_line(f"backend_unavailable: device probe rc={e.returncode}"))
+        return
+
+    # -u: line-buffer the child through the pipe so a later kill can't
+    # swallow already-printed JSON lines
+    env = dict(os.environ, _BENCH_CHILD="1")
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-u", os.path.abspath(__file__), *sys.argv[1:]],
+            stdout=subprocess.PIPE, stderr=sys.stderr, text=True,
+            timeout=timeout, env=env,
+        )
+        out, rc = proc.stdout, proc.returncode
+    except subprocess.TimeoutExpired as e:
+        out = e.output or ""
+        out = out if isinstance(out, str) else out.decode(errors="replace")
+        rc = None
+    if out:
+        sys.stdout.write(out if out.endswith("\n") else out + "\n")
+    if rc != 0:
+        why = ("backend_unavailable: bench child timed out (tunnel hang)"
+               if rc is None else f"bench child failed rc={rc}")
+        print(_error_line(why))
+
+
 def main():
+    import os
     import sys
 
     if "--cpu" in sys.argv:
@@ -352,6 +416,8 @@ def main():
         import jax as _j
 
         _j.config.update("jax_platforms", "cpu")
+    elif not os.environ.get("_BENCH_CHILD"):
+        return _run_shielded()
     import paddle_tpu  # noqa: F401  framework config (x64, matmul precision)
     import jax
 
@@ -376,10 +442,8 @@ def main():
                                        16, 4, 1024, 5, True, on_tpu,
                                        donate=True, save_attn=False)))
         except Exception as e:  # OOM must not kill the flagship line below
-            print(json.dumps({"metric": "gpt3-1.3b tokens/sec/chip",
-                              "value": 0, "unit": "tokens/s",
-                              "vs_baseline": 0.0,
-                              "error": f"{type(e).__name__}: {e}"[:300]}))
+            print(_error_line(f"{type(e).__name__}: {e}",
+                              metric="gpt3-1.3b tokens/sec/chip"))
     if "--exp13b" in sys.argv:
         # BASELINE config-3 de-noising experiments (round-4 verdict #6):
         # which buffers must be donated for 1.3B to fit, and what each
@@ -389,9 +453,8 @@ def main():
                 r = bench_gpt(f"gpt3-1.3b(donate={mode})", 2048, 24, 16, 4,
                               1024, 5, True, on_tpu, donate=mode)
             except Exception as e:
-                r = {"metric": f"gpt3-1.3b(donate={mode})", "value": 0,
-                     "unit": "tokens/s", "vs_baseline": 0.0,
-                     "error": f"{type(e).__name__}: {e}"[:200]}
+                r = json.loads(_error_line(f"{type(e).__name__}: {e}",
+                                           metric=f"gpt3-1.3b(donate={mode})"))
             print(json.dumps(r))
         return
 
@@ -411,4 +474,13 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception as e:  # last line must stay parseable for the driver
+        import sys
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        print(_error_line(f"{type(e).__name__}: {e}"))
+        # exit 0: the driver contract is "parseable JSON, rc 0"; the shielded
+        # parent passes this line through without adding a duplicate
