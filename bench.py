@@ -101,9 +101,16 @@ def bench_gpt(label, hidden, layers, heads, batch, seq, K, recompute,
         donate_idx = {True: (0, 1), "mom": (1,), False: ()}.get(donate, ())
         many_jit = (jax.jit(many, donate_argnums=donate_idx) if donate_idx
                     else jax.jit(many))
-        p_cur, m_cur = params, mom
-        p_cur, m_cur, losses = many_jit(p_cur, m_cur, ids, labels)  # compile+warmup
+        p_cur, m_cur, losses = many_jit(params, mom, ids, labels)  # compile+warmup
         first_losses = np.asarray(losses)  # sync
+        if donate is False:
+            # the timed run replays the ORIGINAL inputs; holding the warmup
+            # outputs (a full params+momentum copy, ~3 GB at 760M) through it
+            # is pure waste and is what pushes save_attn over the 16 GB edge
+            del p_cur, m_cur
+        elif donate == "mom":
+            del p_cur  # timed call replays original params; warmup copy dead
+        del losses
         t0 = time.perf_counter()
         if donate is True:
             # donated buffers are consumed: the timed call continues from
@@ -444,18 +451,40 @@ def main():
         except Exception as e:  # OOM must not kill the flagship line below
             print(_error_line(f"{type(e).__name__}: {e}",
                               metric="gpt3-1.3b tokens/sec/chip"))
+    one = next((a for a in sys.argv if a.startswith("--exp13b-one=")), None)
+    if one:
+        mode = {"False": False, "mom": "mom", "True": True}[one.split("=")[1]]
+        # save_attn=False: the memory-edge config keeps the proven-fit
+        # footprint (with save_attn on, ALL modes OOM — measured r5)
+        try:
+            r = bench_gpt(f"gpt3-1.3b(donate={mode})", 2048, 24, 16, 4,
+                          1024, 5, True, on_tpu, donate=mode,
+                          save_attn=False)
+        except Exception as e:
+            r = json.loads(_error_line(f"{type(e).__name__}: {e}",
+                                       metric=f"gpt3-1.3b(donate={mode})"))
+        print(json.dumps(r))
+        return
     if "--exp13b" in sys.argv:
         # BASELINE config-3 de-noising experiments (round-4 verdict #6):
         # which buffers must be donated for 1.3B to fit, and what each
-        # donation mode costs through the tunnel.
-        for mode in (False, "mom", True):
-            try:
-                r = bench_gpt(f"gpt3-1.3b(donate={mode})", 2048, 24, 16, 4,
-                              1024, 5, True, on_tpu, donate=mode)
-            except Exception as e:
-                r = json.loads(_error_line(f"{type(e).__name__}: {e}",
-                                           metric=f"gpt3-1.3b(donate={mode})"))
-            print(json.dumps(r))
+        # donation mode costs through the tunnel. One SUBPROCESS per mode:
+        # an OOM'd attempt leaves the chip unable to fit the next mode in
+        # the same process (measured r5 — donate=True alone fits, but fails
+        # after a donate=False OOM), so isolation is part of the method.
+        import subprocess
+
+        for mode in ("False", "mom", "True"):
+            proc = subprocess.run(
+                [sys.executable, "-u", os.path.abspath(__file__),
+                 f"--exp13b-one={mode}"],
+                stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+                timeout=900, env=dict(os.environ, _BENCH_CHILD="1"),
+            )
+            out = proc.stdout.strip()
+            print(out if out else _error_line(
+                f"exp13b child rc={proc.returncode}",
+                metric=f"gpt3-1.3b(donate={mode})"))
         return
 
     # flagship line LAST (the driver reads one line; keep it the final one).
@@ -463,13 +492,23 @@ def main():
     # re-forward for ~0.6 GB extra residency); if a memory regression ever
     # trips it, fall back to the proven-fit policy rather than losing the
     # flagship line.
+    out = err = None
     try:
         out = bench_gpt("gpt3-760m(+remat)", 1536, 24, 12, 8, 1024,
                         10, True, on_tpu)
     except Exception as e:
+        err = f"{type(e).__name__}: {e}"[:200]
+        # drop the traceback's frame refs NOW: while a handler runs, the
+        # in-flight exception (sys.exc_info) pins bench_gpt's device buffers,
+        # so the fallback must run OUTSIDE the except block, after collection
+        e.__traceback__ = None
+    if out is None:
+        import gc
+
+        gc.collect()
         out = bench_gpt("gpt3-760m(+remat,reforward)", 1536, 24, 12, 8,
                         1024, 10, True, on_tpu, save_attn=False)
-        out["save_attn_error"] = f"{type(e).__name__}: {e}"[:200]
+        out["save_attn_error"] = err
     print(json.dumps(out))
 
 

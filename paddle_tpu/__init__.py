@@ -62,10 +62,49 @@ from .tensor.logic import *  # noqa: F401,F403
 from .tensor.search import *  # noqa: F401,F403
 from .tensor.stat import *  # noqa: F401,F403
 from .tensor.random import *  # noqa: F401,F403
+from .tensor.inplace import *  # noqa: F401,F403  module-level op_ spellings
 from .tensor.einsum import einsum
 from .tensor import linalg
-from .tensor.linalg import cdist  # top-level paddle.cdist parity
+from .tensor.linalg import cdist, cross, dist  # top-level parity re-exports
+from .tensor.tensor import set_printoptions
+from .framework.dtype import DType as dtype, finfo, iinfo  # noqa: A001
+from .framework.param_attr import ParamAttr
+from .batch_reader import batch
 from . import fft
+
+
+def pdist(x, p=2.0, name=None):
+    """Top-level re-export of nn.functional.pdist (reference exports both)."""
+    from .nn.functional import pdist as _pdist
+
+    return _pdist(x, p=p, name=name)
+
+
+# CUDA-compat aliases: the reference exports these at top level; on the TPU
+# backend the device RNG/state is singular, so the cuda-spelled entry points
+# are honest aliases of the device-generic ones (SURVEY §1: one device axis).
+def get_cuda_rng_state():
+    return get_rng_state()
+
+
+def set_cuda_rng_state(state):
+    return set_rng_state(state)
+
+
+def disable_signal_handler():
+    """Reference parity (paddle.disable_signal_handler): the reference
+    uninstalls its C++ fault handlers. This runtime installs none, so there
+    is nothing to disable — documented no-op."""
+
+
+class CUDAPlace(TPUPlace):
+    """Compat alias: reference code says CUDAPlace(n); the accelerator here
+    is the TPU, so this is the TPU place under the CUDA-compat name."""
+
+
+class CUDAPinnedPlace(CPUPlace):
+    """Compat alias: pinned-host memory staging place; host memory on this
+    runtime is the CPU place."""
 
 # Subpackages (populated as layers come online; see SURVEY.md §7.2 build order).
 # Imported lazily-but-eagerly here; each block is enabled as the layer lands.
@@ -116,6 +155,11 @@ def __getattr__(name):
         return _importlib.import_module(".hapi", __name__).summary
     if name == "flops":
         return _importlib.import_module(".hapi", __name__).flops
+    if name == "create_parameter":
+        return _importlib.import_module(".static.misc", __name__).create_parameter
+    if name == "LazyGuard":
+        return _importlib.import_module(
+            ".nn.initializer.lazy_init", __name__).LazyGuard
     raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")
 
 # `bool` dtype alias must not shadow the builtin during module definition;

@@ -1,0 +1,29 @@
+"""paddle.batch: combine a sample reader into a mini-batch reader
+(reference python/paddle/batch.py:18)."""
+from __future__ import annotations
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Create a batched reader from a per-sample generator factory.
+
+    Args:
+        reader: callable returning an iterator over samples.
+        batch_size: samples per emitted batch.
+        drop_last: drop the final short batch if True.
+    Returns:
+        A callable returning an iterator over lists of samples.
+    """
+    if batch_size <= 0:
+        raise ValueError(f"batch_size should be positive, got {batch_size}")
+
+    def batch_reader():
+        buf = []
+        for instance in reader():
+            buf.append(instance)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batch_reader

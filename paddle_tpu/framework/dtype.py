@@ -141,3 +141,67 @@ def promote_types(a, b) -> DType:
             return DType._registry["bfloat16"]
         return other if other.is_floating or other.is_complex else DType._registry["bfloat16"]
     return convert_dtype(np.promote_types(da.np_dtype, db.np_dtype))
+
+
+class finfo:
+    """Floating-point type properties (reference framework/dtype.py:84).
+
+    Backed by numpy/ml_dtypes finfo so bfloat16/float16 report their true
+    machine limits. Attribute set matches the reference: min, max, eps,
+    resolution, smallest_normal, tiny, bits, dtype.
+    """
+
+    __slots__ = ("min", "max", "eps", "resolution", "smallest_normal",
+                 "tiny", "bits", "dtype")
+
+    def __init__(self, dtype):
+        d = convert_dtype(dtype)
+        if d.is_complex:
+            # numpy/torch/reference parity: complex reports the COMPONENT
+            # type's limits AND bits (np.finfo(complex64).bits == 32)
+            comp = {"complex64": "float32", "complex128": "float64"}[d.name]
+            info = np.finfo(np.dtype(comp))
+            self.bits = int(info.bits)
+        elif d.name == "bfloat16":
+            info = ml_dtypes.finfo(ml_dtypes.bfloat16)
+            self.bits = int(info.bits)
+        elif d.is_floating:
+            info = np.finfo(d.np_dtype)
+            self.bits = int(info.bits)
+        else:
+            raise ValueError(
+                f"paddle.finfo expects a floating or complex dtype, got {d.name}")
+        self.min = float(info.min)
+        self.max = float(info.max)
+        self.eps = float(info.eps)
+        self.resolution = float(info.resolution)
+        self.smallest_normal = float(info.smallest_normal)
+        self.tiny = float(info.smallest_normal)
+        self.dtype = d.name
+
+    def __repr__(self):
+        return (f"paddle.finfo(min={self.min}, max={self.max}, eps={self.eps}, "
+                f"resolution={self.resolution}, "
+                f"smallest_normal={self.smallest_normal}, bits={self.bits}, "
+                f"dtype={self.dtype})")
+
+
+class iinfo:
+    """Integer type properties (reference framework/dtype.py:43)."""
+
+    __slots__ = ("min", "max", "bits", "dtype")
+
+    def __init__(self, dtype):
+        d = convert_dtype(dtype)
+        if not d.is_integer:
+            raise ValueError(
+                f"paddle.iinfo expects an integer dtype, got {d.name}")
+        info = np.iinfo(d.np_dtype)
+        self.min = int(info.min)
+        self.max = int(info.max)
+        self.bits = int(info.bits)
+        self.dtype = d.name
+
+    def __repr__(self):
+        return (f"paddle.iinfo(min={self.min}, max={self.max}, "
+                f"bits={self.bits}, dtype={self.dtype})")

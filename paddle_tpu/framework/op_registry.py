@@ -146,7 +146,8 @@ _bulk([
     "broadcast", "broadcast_tensors", "broadcast_to", "cast", "celu",
     "channel_shuffle", "cholesky_solve", "clip", "clone", "complex",
     "concat", "cond", "copysign", "corrcoef", "cosine_embedding_loss", "cov",
-    "cdist", "crop", "cross", "cummax", "cummin", "cumulative_trapezoid",
+    "cdist", "combinations", "crop", "cross", "cummax", "cummin",
+    "cumulative_trapezoid", "pdist", "standard_gamma",
     "deform_conv2d", "matrix_exp", "pca_lowrank",
     "dense_to_sparse", "diag", "diag_embed", "diagflat", "diagonal", "diff",
     "divide", "dot", "dropout", "eigvals", "eigvalsh", "elu", "embedding",

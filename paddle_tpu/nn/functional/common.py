@@ -360,3 +360,33 @@ def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
         return jax.vmap(sampler)(x_, fy, fx)
 
     return apply_op("grid_sample", fn, x, grid)
+
+
+def pdist(x, p=2.0, name=None):
+    """p-norm distance between every pair of row vectors (reference
+    nn/functional/distance.py:111). Output shape N*(N-1)/2.
+
+    TPU formulation: the upper-triangle index set is static given N, so it is
+    built host-side and the device does a dense pairwise-distance einsum plus
+    one static gather — no boolean masked_select (dynamic shapes defeat XLA).
+    """
+    if len(x.shape) != 2:
+        raise ValueError(f"pdist expects a 2-D tensor, got shape {x.shape}")
+    if p < 0:
+        raise ValueError(f"pdist: p must be non-negative, got {p}")
+    n = int(x.shape[0])
+    iu = np.triu_indices(n, k=1)
+    rows, cols = jnp.asarray(iu[0]), jnp.asarray(iu[1])
+
+    def fn(v):
+        diff = v[rows] - v[cols]  # (n*(n-1)/2, M): only needed pairs
+        absd = jnp.abs(diff)
+        if p == 0:
+            return jnp.sum((absd != 0).astype(v.dtype), axis=-1)
+        if p == float("inf"):
+            return jnp.max(absd, axis=-1)
+        if p == 2.0:
+            return jnp.sqrt(jnp.sum(diff * diff, axis=-1))
+        return jnp.sum(absd ** p, axis=-1) ** (1.0 / p)
+
+    return apply_op("pdist", fn, x)

@@ -1,0 +1,59 @@
+"""Lazy parameter initialization (reference nn/initializer/lazy_init.py:18).
+
+``with LazyGuard(): model = Net()`` builds the module tree WITHOUT allocating
+parameter values; each Parameter carries its initializer thunk and an abstract
+``jax.ShapeDtypeStruct`` placeholder (shape/dtype are queryable, data is not).
+``param.initialize()`` materializes one parameter; ``materialize(layer)`` does
+the whole tree. The TPU-native purpose matches the reference's: build a
+multi-billion-parameter model cheaply, decide placement/sharding, THEN allocate
+— here the natural follow-up is initializing directly into a NamedSharding.
+"""
+from __future__ import annotations
+
+import jax
+
+
+class _LazyState:
+    active = False
+
+
+def in_lazy_mode() -> bool:
+    return _LazyState.active
+
+
+class LazyGuard:
+    """Context manager entering lazy-init mode (reference lazy_init.py:93)."""
+
+    def __enter__(self):
+        self._prev = _LazyState.active
+        _LazyState.active = True
+        return self
+
+    def __exit__(self, *exc):
+        _LazyState.active = self._prev
+        return False
+
+
+def make_lazy_data(init, shape, dtype):
+    """The placeholder a lazily-created Parameter holds: an abstract aval.
+
+    Shape/dtype/size queries work; any compute on it raises, which is exactly
+    the reference's "used an uninitialized lazy parameter" failure mode.
+    """
+    from ...framework import dtype as dtype_mod
+
+    return jax.ShapeDtypeStruct(tuple(int(s) for s in shape),
+                                dtype_mod.to_jax_dtype(dtype))
+
+
+def materialize(layer_or_param, device=None):
+    """Initialize every lazy parameter under ``layer_or_param`` in place."""
+    from ...tensor.tensor import Parameter
+
+    if isinstance(layer_or_param, Parameter):
+        layer_or_param.initialize()
+        return layer_or_param
+    for p in layer_or_param.parameters():
+        if getattr(p, "_lazy_init", None) is not None:
+            p.initialize()
+    return layer_or_param

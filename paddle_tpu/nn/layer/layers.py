@@ -116,8 +116,16 @@ class Layer:
                 init = attr
         if init is None:
             init = Constant(0.0) if is_bias else XavierUniform()
-        data = init(shape, dtype)
-        p = Parameter(data, dtype=dtype, name=name)
+        from ..initializer import lazy_init
+
+        if lazy_init.in_lazy_mode():
+            # LazyGuard: no allocation — the Parameter holds an abstract aval
+            # and its initializer thunk until .initialize()
+            p = Parameter(lazy_init.make_lazy_data(init, shape, dtype),
+                          dtype=dtype, name=name)
+            p._lazy_init = (init, list(shape), dtype)
+        else:
+            p = Parameter(init(shape, dtype), dtype=dtype, name=name)
         p.optimize_attr = {"learning_rate": learning_rate}
         return p
 

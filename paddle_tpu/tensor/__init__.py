@@ -188,7 +188,8 @@ _METHOD_SOURCES = [
      searchsorted bucketize unique unique_consecutive histogram bincount"""),
     (stat, "std var median nanmedian quantile nanquantile"),
     (creation, "tril triu diag diagflat diag_embed numel"),
-    (random, "bernoulli_ uniform_ normal_ exponential_ multinomial"),
+    (random, """bernoulli_ uniform_ normal_ exponential_ multinomial
+     cauchy_ geometric_"""),
 ]
 
 _METHOD_SOURCES += [
@@ -236,11 +237,15 @@ _INPLACE_BASES = [
     (math, """acos acosh asin asinh atan atanh ceil cos cosh cumprod cumsum
      digamma erfinv floor floor_divide floor_mod frac gcd hypot lcm ldexp
      lerp lgamma log log10 log1p log2 neg pow reciprocal round sigmoid sin
-     sinh tan trunc copysign gammaln i0 renorm"""),
+     sinh tan trunc copysign gammaln i0 renorm
+     erf expm1 square logit multigammaln polygamma nan_to_num remainder
+     addmm"""),
     (logic, """bitwise_and bitwise_or bitwise_xor bitwise_not
      bitwise_left_shift bitwise_right_shift logical_and logical_or
      logical_xor logical_not equal not_equal greater_equal greater_than
      less_equal less_than"""),
+    (manipulation, "index_add index_put masked_scatter t"),
+    (creation, "tril triu"),
 ]
 
 

@@ -1,0 +1,44 @@
+"""Module-level in-place op spellings (``paddle.abs_(x)`` etc.).
+
+The reference exports every Tensor in-place method as a top-level function
+too (python/paddle/__init__.py __all__: abs_, acos_, ... trunc_). The Tensor
+methods are generated in tensor/__init__.py (_INPLACE_BASES); this module
+lifts each one to a module function so the top-level surface matches.
+"""
+from __future__ import annotations
+
+from .tensor import Tensor
+
+# every name here must exist as a Tensor method by the time the wrapper is
+# CALLED (binding is late), which tensor/__init__.py guarantees at import
+_INPLACE_NAMES = """
+abs_ acos_ acosh_ addmm_ asin_ asinh_ atan_ atanh_ bitwise_and_
+bitwise_left_shift_ bitwise_not_ bitwise_or_ bitwise_right_shift_
+bitwise_xor_ cast_ ceil_ clip_ copysign_ cos_ cosh_ cumprod_ cumsum_
+digamma_ divide_ equal_ erf_ erfinv_ exp_ expm1_ floor_ floor_divide_
+floor_mod_ frac_ gammaln_ gcd_ greater_equal_ greater_than_ hypot_ i0_
+index_add_ index_put_ lcm_ ldexp_ lerp_ less_equal_ less_than_ lgamma_
+log10_ log1p_ log2_ log_ logical_and_ logical_not_ logical_or_
+logical_xor_ logit_ masked_fill_ masked_scatter_ mod_ multigammaln_
+multiply_ nan_to_num_ neg_ not_equal_ polygamma_ pow_ reciprocal_
+remainder_ renorm_ round_ rsqrt_ scale_ sigmoid_ sin_ sinh_ sqrt_
+square_ subtract_ t_ tan_ tanh_ tril_ triu_ trunc_ where_ zero_
+""".split()
+
+__all__ = list(_INPLACE_NAMES)
+
+
+def _make_module_inplace(method_name):
+    def fn(x, *args, **kwargs):
+        return getattr(x, method_name)(*args, **kwargs)
+
+    fn.__name__ = method_name
+    fn.__qualname__ = method_name
+    fn.__doc__ = (f"In-place variant: ``paddle.{method_name}(x, ...)`` == "
+                  f"``x.{method_name}(...)`` (rebinds x's data in place).")
+    return fn
+
+
+for _n in _INPLACE_NAMES:
+    globals()[_n] = _make_module_inplace(_n)
+del _n

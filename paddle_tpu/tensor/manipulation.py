@@ -542,3 +542,52 @@ def slice_scatter(x, value, axes, starts, ends, strides, name=None):
         return v.at[tuple(sel)].set(src.astype(v.dtype))
 
     return apply_op("slice_scatter", fn, x, value)
+
+
+# --- numpy-style stack family (reference manipulation.py:2100-2360) ---
+def _as_tensors(x):
+    return [t if isinstance(t, Tensor) else Tensor(np.asarray(t)) for t in x]
+
+
+def hstack(x, name=None):
+    """Stack along axis 1 (axis 0 for 1-D inputs) — reference
+    python/paddle/tensor/manipulation.py:2100 (np.hstack semantics)."""
+    tensors = [atleast_1d(t) for t in _as_tensors(x)]
+    axis = 0 if all(t._data.ndim == 1 for t in tensors) else 1
+    return concat(tensors, axis=axis)
+
+
+def vstack(x, name=None):
+    """Stack along axis 0 after promoting 1-D rows to (1, N) — reference
+    python/paddle/tensor/manipulation.py:2161 (np.vstack semantics)."""
+    return concat([atleast_2d(t) for t in _as_tensors(x)], axis=0)
+
+
+row_stack = vstack
+
+
+def dstack(x, name=None):
+    """Stack along the third axis, promoting to 3-D first — reference
+    python/paddle/tensor/manipulation.py:2210 (np.dstack semantics)."""
+    return concat([atleast_3d(t) for t in _as_tensors(x)], axis=2)
+
+
+def column_stack(x, name=None):
+    """Stack 1-D tensors as columns of a 2-D result — reference
+    python/paddle/tensor/manipulation.py:2276 (np.column_stack semantics)."""
+    cols = [reshape(t, [-1, 1]) if t._data.ndim < 2 else t
+            for t in _as_tensors(x)]
+    return concat(cols, axis=1)
+
+
+def cast(x, dtype, name=None):
+    """paddle.cast: dtype conversion as a differentiable op (reference
+    python/paddle/tensor/manipulation.py cast). The in-place spellings
+    (cast_, masked_scatter_, ...) live in tensor/inplace.py."""
+    return x.astype(dtype)
+
+
+def tolist(x, name=None):
+    """paddle.tolist: nested python list of the tensor's values (reference
+    tensor/manipulation.py tolist)."""
+    return x.tolist()

@@ -536,3 +536,30 @@ def shard_index(input, index_num, nshards, shard_id, ignore_value=-1,
         return jnp.where(inside, v - lo, ignore_value)
 
     return apply_op("shard_index", fn, input)
+
+
+def combinations(x, r=2, with_replacement=False, name=None):
+    """Length-``r`` combinations of a 1-D tensor (reference math.py:7448).
+
+    The index set depends only on the STATIC length and ``r``, so it is built
+    host-side with itertools and the device does one static-shape gather —
+    no masked_select dynamic shapes (XLA-friendly, unlike the reference's
+    meshgrid+mask formulation which materializes n**r intermediates).
+    """
+    import itertools
+
+    if len(x.shape) != 1:
+        raise TypeError(f"Expect a 1-D vector, but got x shape {x.shape}")
+    if not isinstance(r, int) or r < 0:
+        raise ValueError(f"Expect a non-negative int, but got r={r}")
+    from .creation import empty
+
+    if r == 0:
+        return empty([0], dtype=x.dtype)
+    n = int(x.shape[0])
+    if (r > n and not with_replacement) or (n == 0 and with_replacement):
+        return empty([0, r], dtype=x.dtype)
+    combine = (itertools.combinations_with_replacement if with_replacement
+               else itertools.combinations)
+    idx = np.asarray(list(combine(range(n), r)), dtype=np.int64)
+    return apply_op("combinations", lambda v: jnp.take(v, idx, axis=0), x)

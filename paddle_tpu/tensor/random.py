@@ -223,3 +223,71 @@ def top_p_sampling(x, ps, threshold=None, topp_seed=None, seed=-1,
         return scores, ids.astype(jnp.int64)
 
     return apply_op("top_p_sampling", fn, x, ps, karg)
+
+
+def standard_gamma(x, name=None) -> Tensor:
+    """Sample Gamma(alpha=x, scale=1) elementwise (reference random.py:219).
+
+    Differentiable w.r.t. the concentration via jax.random.gamma's implicit
+    reparameterization (same property the reference's kernel exposes).
+    """
+    if not x.dtype.is_floating:
+        raise TypeError(
+            f"standard_gamma expects a floating dtype, got {x.dtype.name}")
+
+    def fn(v, key):
+        # sample at >= f32 precision; half dtypes round-trip through f32
+        calc = v.dtype if v.dtype == jnp.float64 else jnp.float32
+        return jax.random.gamma(key, v.astype(calc)).astype(v.dtype)
+
+    return apply_op("standard_gamma", fn, x, rng_arg())
+
+
+def cauchy_(x, loc=0, scale=1, name=None):
+    """Fill ``x`` in place with Cauchy(loc, scale) samples (reference
+    creation.py:2842)."""
+    key = default_generator.next_key()
+    x._data = (jax.random.cauchy(key, x._data.shape) * scale + loc).astype(
+        x._data.dtype)
+    return x
+
+
+def geometric_(x, probs, name=None):
+    """Fill ``x`` in place with Geometric(probs) samples — number of Bernoulli
+    trials to first success, support {1, 2, ...} (reference creation.py:2876)."""
+    from .tensor import Tensor as _T
+
+    p = probs._data if isinstance(probs, _T) else jnp.asarray(probs)
+    if np.any(np.asarray(p) <= 0) or np.any(np.asarray(p) > 1):
+        raise ValueError("geometric_: probs must be in (0, 1]")
+    key = default_generator.next_key()
+    u = jax.random.uniform(key, x._data.shape, jnp.float32,
+                           minval=jnp.finfo(jnp.float32).tiny, maxval=1.0)
+    # p == 1: log1p(-1) = -inf gives ratio -0.0; the maximum pins the
+    # degenerate case to its correct constant sample of 1
+    samples = jnp.maximum(jnp.ceil(jnp.log(u) / jnp.log1p(-p)), 1.0)
+    x._data = samples.astype(x._data.dtype)
+    return x
+
+
+def check_shape(shape):
+    """Validate a shape argument before fill_constant-style creation ops
+    (reference base/data_feeder.py check_shape, exported as paddle.check_shape)."""
+    from .tensor import Tensor as _T
+
+    if isinstance(shape, _T):
+        if shape.dtype.name not in ("int32", "int64"):
+            raise TypeError(
+                "Shape tensor dtype must be int32 or int64, got "
+                f"{shape.dtype.name}")
+        return
+    for ele in shape:
+        if not isinstance(ele, _T):
+            if not isinstance(ele, (int, np.integer)):
+                raise TypeError(
+                    "All elements in ``shape`` must be integers when it's a "
+                    "list or tuple")
+            if ele < 0:
+                raise ValueError(
+                    "All elements in ``shape`` must be positive when it's a "
+                    "list or tuple")

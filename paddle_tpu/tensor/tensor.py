@@ -18,9 +18,57 @@ from ..framework import dtype as dtype_mod
 from ..framework.place import CPUPlace, Place, TPUPlace, _expected_place
 
 
+class _PrintOptions:
+    """Process-wide tensor print options (reference tensor/to_string.py:25)."""
+
+    precision = 8
+    threshold = 1000
+    edgeitems = 3
+    linewidth = 80
+    sci_mode = False
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """Set Tensor printing options (reference tensor/to_string.py:35)."""
+    for name, value, kind in (("precision", precision, int),
+                              ("threshold", threshold, int),
+                              ("edgeitems", edgeitems, int),
+                              ("linewidth", linewidth, int),
+                              ("sci_mode", sci_mode, bool)):
+        if value is not None:
+            if not isinstance(value, kind):
+                raise TypeError(
+                    f"set_printoptions: {name} must be {kind.__name__}, "
+                    f"got {type(value).__name__}")
+            setattr(_PrintOptions, name, value)
+
+
+def _format_array(arr) -> str:
+    o = _PrintOptions
+    kwargs = dict(precision=o.precision, threshold=o.threshold,
+                  edgeitems=o.edgeitems, linewidth=o.linewidth,
+                  suppress=not o.sci_mode)
+    if o.sci_mode and arr.dtype.kind in "fc":
+        def _sci(v):
+            return np.format_float_scientific(v, precision=o.precision)
+
+        kwargs["formatter"] = {
+            "float_kind": _sci,
+            "complex_kind": lambda v: f"{_sci(v.real)}+{_sci(v.imag)}j",
+        }
+        kwargs.pop("suppress")
+    with np.printoptions(**kwargs):
+        return str(arr)
+
+
 def _coerce_data(data, dtype=None):
     if isinstance(data, Tensor):
         data = data._data
+    if isinstance(data, jax.ShapeDtypeStruct):
+        # lazy-init placeholder (nn/initializer/lazy_init.py): abstract aval,
+        # shape/dtype queries work, compute raises until .initialize()
+        return data
     if isinstance(data, (jax.Array, jax.core.Tracer)):
         if dtype is not None:
             want = dtype_mod.to_jax_dtype(dtype)
@@ -127,6 +175,11 @@ class Tensor:
 
     # --- conversions ---
     def numpy(self) -> np.ndarray:
+        if isinstance(self._data, jax.ShapeDtypeStruct):
+            raise RuntimeError(
+                f"Tensor {self.name!r} was created under LazyGuard and has no "
+                "value yet — call .initialize() (or lazy_init.materialize on "
+                "the layer) before reading it")
         return np.asarray(self._data)
 
     def __array__(self, dtype=None):
@@ -273,6 +326,11 @@ class Tensor:
 
     def __repr__(self):
         grad_part = "" if self.stop_gradient else ", stop_gradient=False"
+        if isinstance(self._data, jax.ShapeDtypeStruct):
+            return (
+                f"Tensor(shape={self.shape}, dtype={self.dtype.name}"
+                f"{grad_part}, lazy=uninitialized (LazyGuard))"
+            )
         if isinstance(self._data, jax.core.Tracer):
             return (
                 f"Tensor(shape={self.shape}, dtype={self.dtype.name}{grad_part}, "
@@ -280,7 +338,8 @@ class Tensor:
             )
         return (
             f"Tensor(shape={self.shape}, dtype={self.dtype.name}, "
-            f"place={self.place}{grad_part},\n       {self.numpy()})"
+            f"place={self.place}{grad_part},\n       "
+            f"{_format_array(np.asarray(self.numpy()))})"
         )
 
     def __format__(self, spec):
@@ -364,6 +423,17 @@ class Parameter(Tensor):
         super().__init__(data, dtype=dtype, stop_gradient=not trainable, name=name)
         self.persistable = True
         self.trainable = trainable
+        self._lazy_init = None  # (init_fn, shape, dtype) under LazyGuard
+
+    def initialize(self):
+        """Materialize a lazily-created parameter (reference EagerParamBase
+        initialize under LazyGuard). No-op if already materialized."""
+        if self._lazy_init is None:
+            return self
+        init, shape, dtype = self._lazy_init
+        self._lazy_init = None
+        self._data = _coerce_data(init(shape, dtype), None)
+        return self
 
     @property
     def trainable(self):

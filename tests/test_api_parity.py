@@ -1,0 +1,110 @@
+"""Top-level export parity gate vs the reference's ``paddle.__all__``.
+
+The 402-name snapshot below is the reference's python/paddle/__init__.py
+``__all__`` (extracted by ast.literal_eval; round-5 verdict task #3). Every
+name must resolve on paddle_tpu — via module attribute or the PEP 562 lazy
+__getattr__ — so the API tail cannot silently regrow. The skip list is for
+justified exclusions only and must stay < 10 (currently EMPTY).
+"""
+import pytest
+
+import paddle_tpu as paddle
+
+REFERENCE_ALL = [
+    "CPUPlace", "CUDAPinnedPlace", "CUDAPlace", "DataParallel", "LazyGuard",
+    "Model", "ParamAttr", "Tensor", "abs", "abs_", "acos", "acos_", "acosh",
+    "add", "add_n", "addmm", "addmm_", "all", "allclose", "amax", "amin",
+    "angle", "any", "arange", "argmax", "argmin", "argsort", "as_complex",
+    "as_real", "as_strided", "asin", "asinh", "assign", "atan", "atan2",
+    "atan_", "atanh", "atleast_1d", "atleast_2d", "atleast_3d", "batch",
+    "bernoulli", "bfloat16", "bincount", "binomial", "bitwise_and",
+    "bitwise_and_", "bitwise_left_shift", "bitwise_left_shift_",
+    "bitwise_not", "bitwise_not_", "bitwise_or", "bitwise_or_",
+    "bitwise_right_shift", "bitwise_right_shift_", "bitwise_xor",
+    "bitwise_xor_", "bmm", "bool", "broadcast_shape", "broadcast_tensors",
+    "broadcast_to", "bucketize", "cast", "cast_", "cauchy_", "cdist", "ceil",
+    "check_shape", "chunk", "clip", "clone", "column_stack", "combinations",
+    "complex", "complex128", "complex64", "concat", "conj", "copysign",
+    "copysign_", "cos", "cos_", "cosh", "count_nonzero", "create_parameter",
+    "crop", "cross", "cummax", "cummin", "cumprod", "cumprod_", "cumsum",
+    "cumsum_", "cumulative_trapezoid", "deg2rad", "diag", "diag_embed",
+    "diagflat", "diagonal", "diagonal_scatter", "diff", "digamma",
+    "digamma_", "disable_signal_handler", "disable_static", "dist", "divide",
+    "divide_", "dot", "dsplit", "dstack", "dtype", "einsum", "empty",
+    "empty_like", "enable_grad", "enable_static", "equal", "equal_",
+    "equal_all", "erf", "erf_", "erfinv", "exp", "expand", "expand_as",
+    "expm1", "expm1_", "eye", "finfo", "flatten", "flip", "float16",
+    "float32", "float64", "floor", "floor_divide", "floor_divide_",
+    "floor_mod", "floor_mod_", "flops", "fmax", "fmin", "frac", "frac_",
+    "frexp", "full", "full_like", "gammaln", "gammaln_", "gather",
+    "gather_nd", "gcd", "gcd_", "geometric_", "get_cuda_rng_state",
+    "get_default_dtype", "get_flags", "get_rng_state", "grad",
+    "greater_equal", "greater_equal_", "greater_than", "greater_than_",
+    "heaviside", "histogram", "histogramdd", "hsplit", "hstack", "hypot",
+    "hypot_", "i0", "i0_", "i0e", "i1", "i1e", "iinfo", "imag",
+    "in_dynamic_mode", "increment", "index_add", "index_add_", "index_fill",
+    "index_fill_", "index_put", "index_put_", "index_sample", "index_select",
+    "inner", "int16", "int32", "int64", "int8", "is_complex", "is_empty",
+    "is_floating_point", "is_grad_enabled", "is_integer", "is_tensor",
+    "isclose", "isfinite", "isinf", "isnan", "kron", "kthvalue", "lcm",
+    "lcm_", "ldexp", "ldexp_", "lerp", "less_equal", "less_equal_",
+    "less_than", "less_than_", "lgamma", "lgamma_", "linspace", "load",
+    "log", "log10", "log10_", "log1p", "log2", "log2_", "log_", "logaddexp",
+    "logcumsumexp", "logical_and", "logical_and_", "logical_not",
+    "logical_not_", "logical_or", "logical_or_", "logical_xor", "logit",
+    "logit_", "logspace", "logsumexp", "masked_fill", "masked_fill_",
+    "masked_scatter", "masked_scatter_", "masked_select", "matmul", "max",
+    "maximum", "mean", "median", "meshgrid", "min", "minimum", "mm", "mod",
+    "mod_", "mode", "moveaxis", "multigammaln", "multigammaln_",
+    "multinomial", "multiplex", "multiply", "multiply_", "mv", "nan_to_num",
+    "nan_to_num_", "nanmean", "nanmedian", "nanquantile", "nansum", "neg",
+    "neg_", "nextafter", "no_grad", "nonzero", "normal", "normal_",
+    "not_equal", "numel", "ones", "ones_like", "outer", "pdist", "poisson",
+    "polar", "polygamma", "polygamma_", "pow", "pow_", "prod",
+    "put_along_axis", "quantile", "rad2deg", "rand", "randint",
+    "randint_like", "randn", "randperm", "rank", "real", "reciprocal",
+    "remainder", "remainder_", "renorm", "renorm_", "repeat_interleave",
+    "reshape", "reshape_", "reverse", "roll", "rot90", "round", "row_stack",
+    "rsqrt", "save", "scale", "scatter", "scatter_", "scatter_nd",
+    "scatter_nd_add", "searchsorted", "seed", "select_scatter",
+    "set_cuda_rng_state", "set_default_dtype", "set_flags",
+    "set_grad_enabled", "set_printoptions", "set_rng_state", "sgn", "shape",
+    "shard_index", "sign", "signbit", "sin", "sin_", "sinh", "sinh_",
+    "slice", "slice_scatter", "sort", "split", "sqrt", "square", "square_",
+    "squeeze", "squeeze_", "stack", "standard_gamma", "standard_normal",
+    "stanh", "std", "strided_slice", "subtract", "sum", "summary", "t", "t_",
+    "take", "take_along_axis", "tan", "tan_", "tanh", "tanh_",
+    "tensor_split", "tensordot", "tile", "to_tensor", "tolist", "topk",
+    "trace", "transpose", "transpose_", "trapezoid", "tril", "tril_",
+    "tril_indices", "triu", "triu_", "triu_indices", "trunc", "trunc_",
+    "uint8", "unbind", "unflatten", "unfold", "uniform", "unique",
+    "unique_consecutive", "unsqueeze", "unsqueeze_", "unstack", "vander",
+    "var", "view", "view_as", "vsplit", "vstack", "where", "where_", "zeros",
+    "zeros_like",]
+
+# Justified exclusions (reference-only names with no honest TPU equivalent).
+# Keep < 10 with a reason each; currently every reference name resolves.
+SKIP = {}
+
+
+def test_snapshot_is_the_reference_size():
+    assert len(REFERENCE_ALL) == 402
+    assert len(set(REFERENCE_ALL)) == 402
+
+
+def test_every_reference_name_resolves():
+    missing = []
+    for name in REFERENCE_ALL:
+        if name in SKIP:
+            continue
+        try:
+            getattr(paddle, name)
+        except AttributeError:
+            missing.append(name)
+    assert not missing, f"top-level API tail regrew: {missing}"
+
+
+def test_skip_list_small_and_justified():
+    assert len(SKIP) < 10
+    for name, reason in SKIP.items():
+        assert isinstance(reason, str) and len(reason) > 10

@@ -1,0 +1,248 @@
+"""Behavior tests for the round-5 API-tail closures (verdict Missing #1):
+stack family, combinations, pdist, finfo/iinfo, set_printoptions,
+standard_gamma, cauchy_/geometric_, module-level in-place spellings,
+LazyGuard, paddle.batch, top-level re-exports."""
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+# --- numpy-style stack family --------------------------------------------
+@pytest.mark.parametrize("fn,npfn", [
+    ("hstack", np.hstack), ("vstack", np.vstack), ("dstack", np.dstack),
+    ("column_stack", np.column_stack), ("row_stack", np.vstack),
+])
+@pytest.mark.parametrize("shapes", [
+    [(3,), (3,)], [(2, 3), (2, 3)], [(4,), (4,), (4,)],
+])
+def test_stack_family_matches_numpy(fn, npfn, shapes):
+    rng = np.random.RandomState(0)
+    arrs = [rng.randn(*s).astype("float32") for s in shapes]
+    got = getattr(paddle, fn)([paddle.to_tensor(a) for a in arrs]).numpy()
+    np.testing.assert_allclose(got, npfn(arrs), rtol=1e-6)
+
+
+def test_hstack_gradient_flows():
+    x = paddle.to_tensor(np.ones(3, "float32"), stop_gradient=False)
+    y = paddle.to_tensor(np.ones(3, "float32"), stop_gradient=False)
+    out = paddle.hstack([x, y]).sum()
+    out.backward()
+    np.testing.assert_allclose(x.grad.numpy(), np.ones(3))
+
+
+# --- combinations ---------------------------------------------------------
+@pytest.mark.parametrize("r,wr", [(2, False), (3, False), (2, True), (0, False)])
+def test_combinations(r, wr):
+    x = np.array([3, 1, 4, 1], dtype="int32")
+    got = paddle.combinations(paddle.to_tensor(x), r, wr).numpy()
+    src = itertools.combinations_with_replacement if wr else itertools.combinations
+    want = np.array([list(c) for c in src(x.tolist(), r)], dtype="int32")
+    if r == 0:
+        assert got.shape == (0,)
+    else:
+        np.testing.assert_array_equal(got, want)
+
+
+def test_combinations_r_exceeds_n():
+    out = paddle.combinations(paddle.to_tensor([1, 2]), r=5)
+    assert out.shape == [0, 5]
+
+
+# --- pdist ----------------------------------------------------------------
+@pytest.mark.parametrize("p", [0.0, 1.0, 2.0, 3.5, float("inf")])
+def test_pdist(p):
+    rng = np.random.RandomState(1)
+    a = rng.randn(5, 4).astype("float32")
+    got = paddle.pdist(paddle.to_tensor(a), p=p).numpy()
+    want = []
+    for i in range(5):
+        for j in range(i + 1, 5):
+            d = np.abs(a[i] - a[j])
+            if p == 0:
+                want.append((d != 0).sum())
+            elif p == float("inf"):
+                want.append(d.max())
+            else:
+                want.append((d ** p).sum() ** (1.0 / p))
+    np.testing.assert_allclose(got, np.array(want, "float32"), rtol=1e-5)
+
+
+# --- finfo / iinfo --------------------------------------------------------
+def test_finfo_float32():
+    fi = paddle.finfo(paddle.float32)
+    assert fi.bits == 32 and fi.dtype == "float32"
+    assert fi.eps == np.finfo(np.float32).eps
+    assert fi.tiny == fi.smallest_normal
+
+
+def test_finfo_bfloat16():
+    fi = paddle.finfo("bfloat16")
+    assert fi.bits == 16 and fi.eps == 0.0078125
+
+
+def test_finfo_rejects_int():
+    with pytest.raises(ValueError):
+        paddle.finfo("int32")
+
+
+def test_iinfo():
+    ii = paddle.iinfo(paddle.uint8)
+    assert (ii.min, ii.max, ii.bits, ii.dtype) == (0, 255, 8, "uint8")
+    with pytest.raises(ValueError):
+        paddle.iinfo("float32")
+
+
+# --- set_printoptions -----------------------------------------------------
+def test_set_printoptions_precision():
+    try:
+        paddle.set_printoptions(precision=2)
+        s = repr(paddle.to_tensor([0.123456]))
+        assert "0.12" in s and "0.1234" not in s
+    finally:
+        paddle.set_printoptions(precision=8)
+
+
+def test_set_printoptions_rejects_bad_type():
+    with pytest.raises(TypeError):
+        paddle.set_printoptions(precision="high")
+
+
+# --- random tail ----------------------------------------------------------
+def test_standard_gamma_moments():
+    paddle.seed(7)
+    alpha = 4.0
+    x = paddle.full([20000], alpha, dtype="float32")
+    s = paddle.standard_gamma(x).numpy()
+    assert abs(s.mean() - alpha) < 0.15  # Gamma(a,1): mean a, var a
+    assert abs(s.var() - alpha) < 0.5
+
+
+def test_cauchy_fills_inplace():
+    paddle.seed(3)
+    t = paddle.zeros([1000], dtype="float32")
+    out = paddle.cauchy_(t, loc=1.0, scale=2.0)
+    assert out is t
+    assert abs(np.median(t.numpy()) - 1.0) < 0.3  # median = loc
+
+def test_geometric_support():
+    paddle.seed(5)
+    t = paddle.zeros([5000], dtype="float32")
+    paddle.geometric_(t, 0.4)
+    v = t.numpy()
+    assert v.min() >= 1 and np.all(v == np.round(v))
+    assert abs(v.mean() - 1 / 0.4) < 0.2  # E = 1/p
+
+
+# --- module-level in-place spellings -------------------------------------
+def test_module_level_inplace_mutates():
+    t = paddle.to_tensor([1.0, 4.0, 9.0])
+    out = paddle.sqrt_(t)
+    assert out is t
+    np.testing.assert_allclose(t.numpy(), [1.0, 2.0, 3.0])
+
+
+def test_tril_triu_inplace():
+    a = paddle.ones([3, 3])
+    paddle.tril_(a)
+    np.testing.assert_allclose(a.numpy(), np.tril(np.ones((3, 3))))
+    b = paddle.ones([3, 3])
+    paddle.triu_(b, 1)
+    np.testing.assert_allclose(b.numpy(), np.triu(np.ones((3, 3)), 1))
+
+
+def test_nan_to_num_inplace():
+    t = paddle.to_tensor([np.nan, np.inf, 2.0])
+    paddle.nan_to_num_(t)
+    got = t.numpy()
+    assert got[2] == 2.0 and np.isfinite(got).all()
+
+
+def test_masked_scatter_inplace():
+    x = paddle.zeros([4])
+    mask = paddle.to_tensor([True, False, True, False])
+    paddle.masked_scatter_(x, mask, paddle.to_tensor([5.0, 6.0]))
+    np.testing.assert_allclose(x.numpy(), [5.0, 0.0, 6.0, 0.0])
+
+
+def test_cast_and_cast_():
+    x = paddle.to_tensor([1.7, 2.2])
+    y = paddle.cast(x, "int32")
+    assert y.dtype.name == "int32"
+    paddle.cast_(x, "int64")
+    assert x.dtype.name == "int64"
+
+
+def test_t_inplace():
+    x = paddle.to_tensor(np.arange(6, dtype="float32").reshape(2, 3))
+    paddle.t_(x)
+    assert x.shape == [3, 2]
+
+
+# --- LazyGuard ------------------------------------------------------------
+def test_lazy_guard_defers_then_materializes():
+    import paddle_tpu.nn as nn
+    from paddle_tpu.nn.initializer.lazy_init import materialize
+
+    with paddle.LazyGuard():
+        layer = nn.Linear(8, 4)
+    w = layer.weight
+    assert w._lazy_init is not None
+    assert list(w.shape) == [8, 4]  # shape queryable without allocation
+    materialize(layer)
+    assert w._lazy_init is None
+    assert np.isfinite(w.numpy()).all()
+    # normal (non-lazy) construction unaffected
+    eager = nn.Linear(3, 3)
+    assert eager.weight._lazy_init is None
+
+
+def test_lazy_param_initialize_idempotent():
+    import paddle_tpu.nn as nn
+
+    with paddle.LazyGuard():
+        layer = nn.Linear(4, 4)
+    layer.weight.initialize()
+    first = layer.weight.numpy().copy()
+    layer.weight.initialize()  # no-op
+    np.testing.assert_array_equal(first, layer.weight.numpy())
+
+
+# --- batch / tolist / check_shape / compat aliases ------------------------
+def test_batch_reader():
+    def reader():
+        yield from range(10)
+
+    got = list(paddle.batch(reader, batch_size=3)())
+    assert got == [[0, 1, 2], [3, 4, 5], [6, 7, 8], [9]]
+    got = list(paddle.batch(reader, batch_size=3, drop_last=True)())
+    assert got[-1] == [6, 7, 8]
+
+
+def test_tolist_top_level():
+    assert paddle.tolist(paddle.to_tensor([[1, 2], [3, 4]])) == [[1, 2], [3, 4]]
+
+
+def test_check_shape():
+    paddle.check_shape([2, 3])
+    with pytest.raises(ValueError):
+        paddle.check_shape([-2, 3])
+    with pytest.raises(TypeError):
+        paddle.check_shape([2.5])
+
+
+def test_cuda_compat_aliases():
+    st = paddle.get_cuda_rng_state()
+    paddle.set_cuda_rng_state(st)
+    assert isinstance(paddle.CUDAPlace(0), paddle.TPUPlace)
+    paddle.disable_signal_handler()  # documented no-op
+
+
+def test_top_level_reexports():
+    a = paddle.to_tensor([1.0, 0.0, 0.0])
+    b = paddle.to_tensor([0.0, 1.0, 0.0])
+    np.testing.assert_allclose(paddle.cross(a, b).numpy(), [0.0, 0.0, 1.0])
+    assert float(paddle.dist(a, b)) > 0
+    assert paddle.dtype is paddle.framework.dtype.DType

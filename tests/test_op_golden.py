@@ -976,6 +976,7 @@ _SKIP_GROUPS = {
         "bernoulli", "binomial", "dropout", "alpha_dropout", "gaussian",
         "uniform", "randint", "randperm", "poisson", "shuffle", "rrelu",
         "gumbel_softmax", "class_center_sample", "top_p_sampling",
+        "standard_gamma",
     ],
     "distributed collective/SPMD op (covered by tests/test_distributed.py, test_fleet.py on the virtual mesh)": [
         "all_gather", "all_gather_slice", "all_reduce_avg",
@@ -1228,6 +1229,23 @@ spec("pca_lowrank",
      oracle=lambda x: np.linalg.svd(
          x - x.mean(0, keepdims=True), compute_uv=False)[:2],
      grad=False)
+
+
+spec("combinations",
+     lambda x: paddle.combinations(x, 2),
+     lambda rng: [rng.randn(5)],
+     oracle=lambda x: np.array(
+         [[x[i], x[j]] for i in range(5) for j in range(i + 1, 5)]),
+     grad=False, bf16=False)
+
+
+spec("pdist",
+     lambda x: paddle.pdist(x),
+     lambda rng: [rng.randn(4, 3)],
+     oracle=lambda x: np.array(
+         [np.sqrt(((x[i] - x[j]) ** 2).sum())
+          for i in range(4) for j in range(i + 1, 4)]),
+     grad_rtol=5e-3, grad_atol=5e-4)
 
 
 spec("sequence_mask",

@@ -206,3 +206,17 @@ def test_class_center_sample_contract():
     assert set(pos) <= set(s1.tolist())
     lookup = {c: i for i, c in enumerate(s1.tolist())}
     np.testing.assert_array_equal(r1, np.array([lookup[c] for c in labels]))
+
+
+def test_standard_gamma_moments_and_reparam_grad():
+    alpha = 3.0
+    a, b = _seeded(lambda: paddle.standard_gamma(
+        paddle.full([N], alpha, dtype="float32")).numpy())
+    np.testing.assert_array_equal(a, b)  # seeded reproducibility
+    assert abs(a.mean() - alpha) < 0.1   # Gamma(a,1): mean a
+    assert abs(a.var() - alpha) < 0.4    # var a
+    # implicit reparameterization: d E[sample]/d alpha == 1
+    x = paddle.full([N], alpha, dtype="float32")
+    x.stop_gradient = False
+    paddle.standard_gamma(x).sum().backward()
+    assert abs(x.grad.numpy().mean() - 1.0) < 0.1
