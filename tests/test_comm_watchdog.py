@@ -26,13 +26,16 @@ def _worker_barrier(port, rank, world, q, timeout):
     store = TCPStore("127.0.0.1", port, is_master=False, world_size=world,
                      timeout=30)
     wd = CommWatchdog(store, rank, world, default_timeout=timeout)
+    # in-collective elapsed measured by the WORKER: excludes process-spawn
+    # and import overhead, so the fail-fast assertion is load-robust
+    t0 = time.time()
     try:
         wd.barrier()
-        q.put((rank, "ok", None))
+        q.put((rank, "ok", None, time.time() - t0))
     except CommTimeout as e:
-        q.put((rank, "timeout", str(e)))
+        q.put((rank, "timeout", str(e), time.time() - t0))
     except CommPeerFailure as e:
-        q.put((rank, "peer", str(e)))
+        q.put((rank, "peer", str(e), time.time() - t0))
     finally:
         store.close(linger=0)
 
@@ -45,21 +48,26 @@ class TestWatchdog:
         master = TCPStore("127.0.0.1", 0, is_master=True, world_size=3,
                           timeout=30)
         q = ctx.Queue()
-        t0 = time.time()
         ps = [ctx.Process(target=_worker_barrier,
                           args=(master.port, r, 3, q, 3.0))
               for r in range(2)]  # rank 2 deliberately absent
         for p in ps:
             p.start()
-        results = [q.get(timeout=30) for _ in range(2)]
+        results = [q.get(timeout=60) for _ in range(2)]
         for p in ps:
             p.join(timeout=10)
         master.close(linger=0)
-        elapsed = time.time() - t0
-        assert elapsed < 20, "watchdog did not bound the hang"
-        kinds = {k for _, k, _ in results}
+        # fail-fast bound on the IN-BARRIER time each worker measured itself
+        # (wall clock across spawned interpreters swings wildly under suite
+        # load — the round-4 verdict's one flaky test); 3s timeout + store
+        # polling slack must stay well under the absent-rank "hang forever"
+        for rank, _, _, in_barrier in results:
+            assert in_barrier < 15, (
+                f"rank {rank} spent {in_barrier:.1f}s in a 3s-timeout barrier"
+                " — watchdog did not bound the hang")
+        kinds = {k for _, k, _, _ in results}
         assert "ok" not in kinds
-        msgs = [m for _, k, m in results if m]
+        msgs = [m for _, k, m, _ in results if m]
         # at least one rank reports the timeout with full attribution;
         # the other may fail fast via peer-error propagation
         assert any("'barrier'" in m and "2" in m for m in msgs), msgs

@@ -171,7 +171,14 @@ def check_grad(name, sp, args):
         assert g is not None, f"{name}: no gradient for arg {i}"
         analytic[i] = np.asarray(g.numpy())
 
-    # central differences on a deterministic subsample of elements.
+    # central differences. Coverage policy (reference test/legacy_test/
+    # op_test.py:420 checks the FULL numeric-vs-analytic tensor):
+    #   size <= 64   : every element individually (true full-tensor sweep)
+    #   size <  4096 : 6 sampled elements PLUS full-tensor random-direction
+    #                  probes — (s(x+eps*d)-s(x-eps*d))/2eps vs <analytic, d>
+    #                  exercises EVERY element at O(1) evaluations, where the
+    #                  reference's per-element sweep would cost 2*size evals
+    #   size >= 4096 : 6 sampled elements + 1 directional probe
     # eps 1e-4 (not 1e-6): several ops keep fp32 constants/accumulation
     # internally, giving ~1e-7 evaluation noise — the larger step keeps
     # noise/signal < 1e-3 while truncation error stays ~eps^2.
@@ -179,8 +186,10 @@ def check_grad(name, sp, args):
     for i in diff:
         base = args[i].astype(dtype)
         flat = base.reshape(-1)
-        n_probe = min(6, flat.size)
-        idx = rng.choice(flat.size, size=n_probe, replace=False)
+        if flat.size <= 64:
+            idx = np.arange(flat.size)
+        else:
+            idx = rng.choice(flat.size, size=6, replace=False)
         for j in idx:
             fp = flat.copy(); fp[j] += eps
             fm = flat.copy(); fm[j] -= eps
@@ -193,6 +202,26 @@ def check_grad(name, sp, args):
             tol = sp.grad_atol + sp.grad_rtol * max(abs(fd), abs(an), 1.0)
             assert abs(fd - an) < tol, (
                 f"{name}: grad mismatch arg{i}[{j}] analytic={an} fd={fd}")
+        if flat.size > 64:
+            n_dir = 2 if flat.size < 4096 else 1
+            # direction magnitude ~1 per element keeps the step within the
+            # same truncation regime as the per-element probes
+            for _ in range(n_dir):
+                d = rng.choice([-1.0, 1.0], size=flat.size)
+                a_p = [x if k != i else (flat + eps * d).reshape(base.shape)
+                       for k, x in enumerate(args)]
+                a_m = [x if k != i else (flat - eps * d).reshape(base.shape)
+                       for k, x in enumerate(args)]
+                sp_, _, _ = _run_scalar(sp.fn, a_p, (), cots, dtype)
+                sm_, _, _ = _run_scalar(sp.fn, a_m, (), cots, dtype)
+                fd = (float(sp_.numpy()) - float(sm_.numpy())) / (2 * eps)
+                an = float(analytic[i].reshape(-1) @ d)
+                # directional sums accumulate per-element noise ~sqrt(size)
+                scale = max(abs(fd), abs(an), 1.0) * np.sqrt(flat.size)
+                tol = sp.grad_atol * np.sqrt(flat.size) + sp.grad_rtol * scale
+                assert abs(fd - an) < tol, (
+                    f"{name}: directional grad mismatch arg{i} "
+                    f"analytic={an} fd={fd} (size {flat.size})")
 
 
 def check_bf16(name, sp):
@@ -230,6 +259,38 @@ def check_bf16(name, sp):
         # bf16 has ~3 decimal digits; just require same ballpark
         denom = np.maximum(np.abs(r), 1.0)
         assert (np.abs(a - r) / denom).mean() < 0.15, f"{name}: bf16 diverges"
+
+    # bf16 GRADIENT leg for the AMP-white ops (the ones AMP O1 actually runs
+    # in bf16): backward through the bf16 graph vs the f32 analytic gradient,
+    # reference-style loose tolerance (op_test.py bf16 max_relative_error).
+    from paddle_tpu.framework.op_registry import amp_white_list
+
+    if not (sp.grad and name in amp_white_list()):
+        return
+    diff = sp.diff if sp.diff is not None else _floats(args)
+    if not diff:
+        return
+    rng2 = np.random.RandomState(SEED + 2)
+    cots = [rng2.randn(*r.shape) for r in ref]
+
+    def fn_f32out(*a):
+        # bf16 arrays are numpy kind 'V' (ml_dtypes), which _run_scalar's
+        # float-output filter would drop — surface outputs as f32 (the cast
+        # is grad-transparent, compute stays bf16)
+        return [t.astype("float32") for t in _out_tensors(sp.fn(*a))]
+
+    s32, t32, _ = _run_scalar(fn_f32out, args, diff, cots, "float32")
+    s32.backward()
+    s16, t16, _ = _run_scalar(fn_f32out, args, diff, cots, "bfloat16")
+    s16.backward()
+    for i in diff:
+        g32 = np.asarray(t32[i].grad.numpy())
+        g16 = np.asarray(t16[i].grad.astype("float32").numpy())
+        denom = np.maximum(np.abs(g32), 1.0)
+        rel = np.abs(g16 - g32) / denom
+        assert rel.mean() < 0.1, (
+            f"{name}: bf16 gradient arg{i} diverges from f32 "
+            f"(mean rel err {rel.mean():.3f})")
 
 
 # ---------------------------------------------------------------------------
