@@ -173,9 +173,11 @@ def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None)
 
 
 def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean", pos_weight=None, name=None):
+    has_pw, has_w = pos_weight is not None, weight is not None  # cacheable
+
     def fn(z, y, *rest):
         i = 0
-        if pos_weight is not None:
+        if has_pw:
             pw = rest[i]
             i += 1
             log_sig = jax.nn.log_sigmoid(z)
@@ -183,7 +185,7 @@ def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean"
             loss = -(pw * y * log_sig + (1 - y) * log_one_minus)
         else:
             loss = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
-        if weight is not None:
+        if has_w:
             loss = loss * rest[i]
         return _reduce(loss, reduction)
 

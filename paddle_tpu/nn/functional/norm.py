@@ -16,6 +16,11 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05, name=
     if isinstance(normalized_shape, int):
         normalized_shape = [normalized_shape]
     n_axes = len(list(normalized_shape))
+    # close over BOOLEANS, not the weight/bias Tensors: a Tensor in a closure
+    # cell disables the eager executable cache (mutation hazard), which made
+    # every eager layer_norm pay full uncached dispatch (~4 ms vs 125 us
+    # through the tunnel, BENCH_OPS r5); the values themselves flow via rest
+    has_w, has_b = weight is not None, bias is not None
 
     def fn(v, *rest):
         axes = tuple(range(v.ndim - n_axes, v.ndim))
@@ -25,10 +30,10 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05, name=
         out = (x32 - mean) / jnp.sqrt(var + epsilon)
         out = out.astype(v.dtype)
         i = 0
-        if weight is not None:
+        if has_w:
             out = out * rest[i]
             i += 1
-        if bias is not None:
+        if has_b:
             out = out + rest[i]
         return out
 
@@ -64,6 +69,7 @@ def batch_norm(
 ):
     channel_axis = 1 if data_format.startswith("NC") else x._data.ndim - 1
     use_batch_stats = training and not use_global_stats
+    has_w, has_b = weight is not None, bias is not None  # cacheable closure
 
     def fn(v, rm, rv, *rest):
         axes = tuple(i for i in range(v.ndim) if i != channel_axis)
@@ -78,10 +84,10 @@ def batch_norm(
         out = (x32 - mean.reshape(shape)) / jnp.sqrt(var.reshape(shape) + epsilon)
         out = out.astype(v.dtype)
         i = 0
-        if weight is not None:
+        if has_w:
             out = out * rest[i].reshape(shape)
             i += 1
-        if bias is not None:
+        if has_b:
             out = out + rest[i].reshape(shape)
         return out, mean, var
 
@@ -103,6 +109,8 @@ def batch_norm(
 
 
 def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None, use_input_stats=True, momentum=0.9, eps=1e-05, data_format="NCHW", name=None):
+    has_w, has_b = weight is not None, bias is not None  # cacheable closure
+
     def fn(v, *rest):
         axes = tuple(range(2, v.ndim))
         x32 = v.astype(jnp.float32)
@@ -111,10 +119,10 @@ def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None
         out = ((x32 - mean) / jnp.sqrt(var + eps)).astype(v.dtype)
         shape = [1, v.shape[1]] + [1] * (v.ndim - 2)
         i = 0
-        if weight is not None:
+        if has_w:
             out = out * rest[i].reshape(shape)
             i += 1
-        if bias is not None:
+        if has_b:
             out = out + rest[i].reshape(shape)
         return out
 
@@ -123,6 +131,8 @@ def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None
 
 
 def group_norm(x, num_groups, epsilon=1e-05, weight=None, bias=None, data_format="NCHW", name=None):
+    has_w, has_b = weight is not None, bias is not None  # cacheable closure
+
     def fn(v, *rest):
         if data_format == "NCHW" or v.ndim == 2:
             n, c = v.shape[0], v.shape[1]
@@ -145,10 +155,10 @@ def group_norm(x, num_groups, epsilon=1e-05, weight=None, bias=None, data_format
             out = ((x32 - mean) / jnp.sqrt(var + epsilon)).astype(v.dtype).reshape(v.shape)
             shape = [1] * (v.ndim - 1) + [c]
         i = 0
-        if weight is not None:
+        if has_w:
             out = out * rest[i].reshape(shape)
             i += 1
-        if bias is not None:
+        if has_b:
             out = out + rest[i].reshape(shape)
         return out
 

@@ -218,6 +218,82 @@ def autotune(batch_heads, seq_q, seq_k, d, dtype=jnp.bfloat16,
     return _blocks_for(seq_q, seq_k, d, dtype)
 
 
+def autotune_split(batch_heads, seq_q, seq_k, d, dtype=jnp.bfloat16,
+                   causal=True, candidates=(128, 256, 512), iters=3):
+    """Independent (bq, bk) sweeps for the FORWARD and BACKWARD kernels.
+
+    The joint ``autotune`` ties both signatures to one winner, but the two
+    kernels have different VMEM/grid profiles: fwd iterates k-blocks per
+    q-block row; bwd grids over k-blocks with a full-seq fp32 dq accumulator
+    resident and fori-loops q-blocks (``_bwd_fused_kernel``). Phase 1 times
+    the forward alone; phase 2 times fwd+bwd with the forward pinned at its
+    winner, so the bwd signature is chosen on its own merits (round-4
+    verdict: the backward had no TPU-tuned autotune of its own).
+    Returns ((fwd_bq, fwd_bk), (bwd_bq, bwd_bk)).
+    """
+    import time
+
+    if _interpret():
+        b = _blocks_for(seq_q, seq_k, d, dtype)
+        return b, b
+    _load_cache()
+    key = jax.random.PRNGKey(0)
+    q = jax.random.normal(key, (batch_heads, seq_q, d), dtype)
+    k = jax.random.normal(key, (batch_heads, seq_k, d), dtype)
+    v = jax.random.normal(key, (batch_heads, seq_k, d), dtype)
+    scale = 1.0 / math.sqrt(d)
+    sig_f = _sig(seq_q, seq_k, d, dtype, "fwd")
+    sig_b = _sig(seq_q, seq_k, d, dtype, "bwd")
+
+    def _time(fn, *args):
+        out = jax.block_until_ready(fn(*args))  # compile + warmup
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        return time.perf_counter() - t0
+
+    def _sweep(sig, make_step):
+        saved = _AUTOTUNE_CACHE.get(sig)
+        best, best_t = None, float("inf")
+        for bq in candidates:
+            if seq_q % min(bq, seq_q):
+                continue
+            for bk in candidates:
+                if seq_k % min(bk, seq_k):
+                    continue
+                _AUTOTUNE_CACHE[sig] = [min(bq, seq_q), min(bk, seq_k)]
+                try:
+                    t = _time(make_step(), q, k, v)
+                except Exception:
+                    continue
+                if t < best_t:
+                    best, best_t = (bq, bk), t
+        if best is None:  # no candidate ran: restore prior state
+            if saved is None:
+                _AUTOTUNE_CACHE.pop(sig, None)
+            else:
+                _AUTOTUNE_CACHE[sig] = saved
+        else:
+            _AUTOTUNE_CACHE[sig] = list(best)
+        return best
+
+    def fwd_step():
+        return jax.jit(lambda q, k, v: _flash(q, k, v, None, None, scale,
+                                              causal, 1))
+
+    def full_step():
+        return jax.jit(lambda q, k, v: jax.grad(
+            lambda q_: jnp.sum(_flash(q_, k, v, None, None, scale, causal, 1)
+                               .astype(jnp.float32)))(q))
+
+    best_f = _sweep(sig_f, fwd_step)     # phase 1: forward alone
+    best_b = _sweep(sig_b, full_step)    # phase 2: bwd varies, fwd pinned
+    _save_cache()
+    return (best_f or _blocks_for(seq_q, seq_k, d, dtype, "fwd"),
+            best_b or _blocks_for(seq_q, seq_k, d, dtype, "bwd"))
+
+
 NEG_INF = -1e30
 LSE_INVALID = 1e30  # lse for rows with no valid key: exp(s - BIG) == 0 in bwd
 

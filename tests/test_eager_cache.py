@@ -124,3 +124,37 @@ def test_fn_sig_distinguishes_default_args():
     fns = [(lambda v, i=i: v + i) for i in range(3)]
     sigs = {engine._fn_sig(f) for f in fns}
     assert len(sigs) == 3
+
+
+def test_hot_functionals_are_cacheable(eager_cache):
+    """Round-5 regression: layer_norm (and friends) captured their optional
+    weight/bias TENSORS in the op closure just to None-test them, which
+    disabled caching (every eager call paid full uncached dispatch — 4 ms vs
+    125 us through the TPU tunnel, BENCH_OPS r5). The hot functionals must
+    close over booleans and stay cacheable."""
+    import paddle_tpu.nn.functional as F
+
+    x = paddle.to_tensor(np.random.randn(2, 8, 16).astype("float32"))
+    g = paddle.to_tensor(np.ones(16, np.float32))
+    b = paddle.to_tensor(np.zeros(16, np.float32))
+    xi = paddle.to_tensor(np.random.randn(2, 4, 6, 6).astype("float32"))
+    rm = paddle.to_tensor(np.zeros(4, np.float32))
+    rv = paddle.to_tensor(np.ones(4, np.float32))
+    w = paddle.to_tensor(np.random.randn(16, 8).astype("float32"))
+
+    cases = {
+        "layer_norm": lambda: F.layer_norm(x, 16, weight=g, bias=b),
+        "batch_norm": lambda: F.batch_norm(xi, rm, rv, training=True),
+        "group_norm": lambda: F.group_norm(xi, 2),
+        "instance_norm": lambda: F.instance_norm(xi),
+        "bce_with_logits": lambda: F.binary_cross_entropy_with_logits(
+            x, (x > 0).astype("float32")),
+        "linear": lambda: F.linear(x, w),
+    }
+    for name, call in cases.items():
+        call()  # prime
+        n = len(eager_cache)
+        call()
+        call()
+        assert len(eager_cache) == n and n > 0, (
+            f"{name} is not eager-cacheable (closure captured a Tensor?)")
