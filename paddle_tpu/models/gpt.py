@@ -52,6 +52,9 @@ class GPTConfig:
     # kernel's o/lse (backward skips the attention re-forward for
     # ~layers*s*h*2B extra residency); memory-edge configs (1.3B on 16 GB)
     # set False to keep the smaller footprint
+    remat_save_ln: bool = False  # under recompute, also save both LN
+    # outputs per layer (2*layers*s*h*2B extra residency, ~1.2 GB at 760M
+    # bs8): backward skips the LN re-forward (mean/var/normalize passes)
     # perf-attribution ablations (perf_breakdown.py only — differential
     # timing of step phases; never set in training configs): any of
     # {"attn", "mlp", "ce"} ("ce" keeps the lm-head matmul, drops the

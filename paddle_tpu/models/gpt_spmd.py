@@ -205,6 +205,10 @@ def _block(p, x, config: GPTConfig, mesh: Mesh):
     if "attn" in config.ablate:  # perf attribution: skip the whole branch
         return _block_mlp(p, x, config, cs)
     y = _layer_norm(x, p["ln1_g"], p["ln1_b"], config.layer_norm_eps)
+    if getattr(config, "remat_save_ln", False):
+        from jax.ad_checkpoint import checkpoint_name
+
+        y = checkpoint_name(y, "ln_out")
     qkv = y @ p["wqkv"] + p["bqkv"]           # column-parallel -> [mb,s,3h]/mp
     qkv = cs(qkv, P("dp", None, "mp"))
     q, k, v = jnp.split(qkv, 3, axis=-1)
@@ -262,6 +266,10 @@ def _block_mlp(p, x, config: GPTConfig, cs):
     if "mlp" in config.ablate:  # perf attribution: skip the whole branch
         return x
     y = _layer_norm(x, p["ln2_g"], p["ln2_b"], config.layer_norm_eps)
+    if getattr(config, "remat_save_ln", False):
+        from jax.ad_checkpoint import checkpoint_name
+
+        y = checkpoint_name(y, "ln_out")
     y = jax.nn.gelu(y @ p["w1"] + p["b1"], approximate=True)
     y = cs(y, P("dp", None, "mp"))
     y = y @ p["w2"] + p["b2"]
@@ -287,10 +295,15 @@ def _stage_fn(p_stage, x, config: GPTConfig, mesh: Mesh):
         # saved; the backward recomputes only elementwise/LN (cheap) —
         # remat trades the minimum FLOPs for the activation-memory win
         policy = jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims
+        names = []
         if getattr(config, "remat_save_attn", True):
+            names.append("flash_out")
+        if getattr(config, "remat_save_ln", False):
+            names.append("ln_out")
+        if names:
             policy = jax.checkpoint_policies.save_from_both_policies(
                 policy,
-                jax.checkpoint_policies.save_only_these_names("flash_out"))
+                jax.checkpoint_policies.save_only_these_names(*names))
         body = jax.checkpoint(body, policy=policy)
     x, _ = lax.scan(body, x, p_stage)
     return x
