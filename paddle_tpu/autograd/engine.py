@@ -505,10 +505,19 @@ def _cached_entry(name, fn, leaves, treedef, diff_pos):
         return None, None, None
     key = (name, fsig, treedef, sig)
     entry = _EAGER_CACHE.get(key)
-    if entry is False:  # blacklisted: op body needs concrete values
-        return None, None, None
-    if entry is None and len(_EAGER_CACHE) >= 4096:
-        # bounded cache: drop the oldest entries (insertion order)
+    if entry is not None:
+        # LRU: a hit refreshes recency (plain dicts iterate in insertion
+        # order; re-inserting moves the key to the back). FIFO eviction was
+        # round-4 weak #9: a long-running mixed workload evicted its HOTTEST
+        # executables first once the cache filled. Blacklist markers (False)
+        # refresh too — evicting a hot marker would re-pay the failed trace
+        # that created it on the next call.
+        del _EAGER_CACHE[key]
+        _EAGER_CACHE[key] = entry
+        if entry is False:  # blacklisted: op body needs concrete values
+            return None, None, None
+    elif len(_EAGER_CACHE) >= 4096:
+        # bounded cache: drop the least-recently-used quarter
         for old in list(_EAGER_CACHE)[:1024]:
             del _EAGER_CACHE[old]
     arg_pos = [i for i, l in enumerate(leaves)

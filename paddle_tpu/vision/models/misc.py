@@ -6,6 +6,13 @@ from __future__ import annotations
 from ... import nn
 from ...tensor.manipulation import concat, reshape, split, transpose
 
+_ACTS = {"relu": nn.ReLU, "swish": nn.Swish}
+
+
+def _no_pretrained(pretrained):
+    if pretrained:
+        raise ValueError("pretrained weights are not bundled in this build")
+
 
 class AlexNet(nn.Layer):
     def __init__(self, num_classes=1000):
@@ -33,6 +40,7 @@ class AlexNet(nn.Layer):
 
 
 def alexnet(pretrained=False, **kwargs):
+    _no_pretrained(pretrained)
     return AlexNet(**kwargs)
 
 
@@ -85,10 +93,12 @@ class SqueezeNet(nn.Layer):
 
 
 def squeezenet1_0(pretrained=False, **kwargs):
+    _no_pretrained(pretrained)
     return SqueezeNet("1.0", **kwargs)
 
 
 def squeezenet1_1(pretrained=False, **kwargs):
+    _no_pretrained(pretrained)
     return SqueezeNet("1.1", **kwargs)
 
 
@@ -163,18 +173,22 @@ class DenseNet(nn.Layer):
 
 
 def densenet121(pretrained=False, **kwargs):
+    _no_pretrained(pretrained)
     return DenseNet(121, **kwargs)
 
 
 def densenet161(pretrained=False, **kwargs):
+    _no_pretrained(pretrained)
     return DenseNet(161, **kwargs)
 
 
 def densenet169(pretrained=False, **kwargs):
+    _no_pretrained(pretrained)
     return DenseNet(169, **kwargs)
 
 
 def densenet201(pretrained=False, **kwargs):
+    _no_pretrained(pretrained)
     return DenseNet(201, **kwargs)
 
 
@@ -186,9 +200,10 @@ def _channel_shuffle(x, groups):
 
 
 class _ShuffleUnit(nn.Layer):
-    def __init__(self, in_c, out_c, stride):
+    def __init__(self, in_c, out_c, stride, act="relu"):
         super().__init__()
         self.stride = stride
+        Act = _ACTS[act]
         branch_c = out_c // 2
         if stride > 1:
             self.branch1 = nn.Sequential(
@@ -196,19 +211,19 @@ class _ShuffleUnit(nn.Layer):
                           groups=in_c, bias_attr=False),
                 nn.BatchNorm2D(in_c),
                 nn.Conv2D(in_c, branch_c, 1, bias_attr=False),
-                nn.BatchNorm2D(branch_c), nn.ReLU())
+                nn.BatchNorm2D(branch_c), Act())
             b2_in = in_c
         else:
             self.branch1 = None
             b2_in = in_c // 2
         self.branch2 = nn.Sequential(
             nn.Conv2D(b2_in, branch_c, 1, bias_attr=False),
-            nn.BatchNorm2D(branch_c), nn.ReLU(),
+            nn.BatchNorm2D(branch_c), Act(),
             nn.Conv2D(branch_c, branch_c, 3, stride=stride, padding=1,
                       groups=branch_c, bias_attr=False),
             nn.BatchNorm2D(branch_c),
             nn.Conv2D(branch_c, branch_c, 1, bias_attr=False),
-            nn.BatchNorm2D(branch_c), nn.ReLU())
+            nn.BatchNorm2D(branch_c), Act())
 
     def forward(self, x):
         if self.stride > 1:
@@ -220,31 +235,37 @@ class _ShuffleUnit(nn.Layer):
 
 
 _SHUFFLE_CFG = {
+    0.25: (24, 48, 96, 512), 0.33: (32, 64, 128, 512),
     0.5: (48, 96, 192, 1024), 1.0: (116, 232, 464, 1024),
     1.5: (176, 352, 704, 1024), 2.0: (244, 488, 976, 2048),
 }
 
 
 class ShuffleNetV2(nn.Layer):
-    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True,
+                 act="relu"):
         super().__init__()
         c1, c2, c3, last_c = _SHUFFLE_CFG[scale]
+        if act not in _ACTS:
+            raise ValueError(f"ShuffleNetV2 act must be one of {sorted(_ACTS)},"
+                             f" got {act!r}")
+        Act = _ACTS[act]
         self.conv1 = nn.Sequential(
             nn.Conv2D(3, 24, 3, stride=2, padding=1, bias_attr=False),
-            nn.BatchNorm2D(24), nn.ReLU())
+            nn.BatchNorm2D(24), Act())
         self.maxpool = nn.MaxPool2D(3, 2, padding=1)
         stages = []
         in_c = 24
         for out_c, repeats in zip((c1, c2, c3), (4, 8, 4)):
-            units = [_ShuffleUnit(in_c, out_c, 2)]
-            units += [_ShuffleUnit(out_c, out_c, 1)
+            units = [_ShuffleUnit(in_c, out_c, 2, act)]
+            units += [_ShuffleUnit(out_c, out_c, 1, act)
                       for _ in range(repeats - 1)]
             stages.append(nn.Sequential(*units))
             in_c = out_c
         self.stages = nn.Sequential(*stages)
         self.conv5 = nn.Sequential(
             nn.Conv2D(in_c, last_c, 1, bias_attr=False),
-            nn.BatchNorm2D(last_c), nn.ReLU())
+            nn.BatchNorm2D(last_c), Act())
         self.with_pool = with_pool
         if with_pool:
             self.pool = nn.AdaptiveAvgPool2D(1)
@@ -260,20 +281,259 @@ class ShuffleNetV2(nn.Layer):
 
 
 def shufflenet_v2_x0_25(pretrained=False, **kwargs):
-    return ShuffleNetV2(scale=0.5, **kwargs)  # smallest published ladder step
+    _no_pretrained(pretrained)
+    return ShuffleNetV2(scale=0.25, **kwargs)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kwargs):
+    _no_pretrained(pretrained)
+    return ShuffleNetV2(scale=0.33, **kwargs)
+
+
+def shufflenet_v2_swish(pretrained=False, **kwargs):
+    """x1.0 ladder with swish activations (reference shufflenetv2.py
+    shufflenet_v2_swish)."""
+    _no_pretrained(pretrained)
+    return ShuffleNetV2(scale=1.0, act="swish", **kwargs)
 
 
 def shufflenet_v2_x0_5(pretrained=False, **kwargs):
+    _no_pretrained(pretrained)
     return ShuffleNetV2(scale=0.5, **kwargs)
 
 
 def shufflenet_v2_x1_0(pretrained=False, **kwargs):
+    _no_pretrained(pretrained)
     return ShuffleNetV2(scale=1.0, **kwargs)
 
 
 def shufflenet_v2_x1_5(pretrained=False, **kwargs):
+    _no_pretrained(pretrained)
     return ShuffleNetV2(scale=1.5, **kwargs)
 
 
 def shufflenet_v2_x2_0(pretrained=False, **kwargs):
+    _no_pretrained(pretrained)
     return ShuffleNetV2(scale=2.0, **kwargs)
+
+
+def densenet264(pretrained=False, **kwargs):
+    _no_pretrained(pretrained)
+    return DenseNet(264, **kwargs)
+
+
+# --- GoogLeNet (Inception v1) ---------------------------------------------
+def _cconv(i, o, k, s=1):
+    return nn.Sequential(
+        nn.Conv2D(i, o, k, stride=s, padding=k // 2), nn.ReLU())
+
+
+class _Incept(nn.Layer):
+    """One inception-v1 cell: 1x1 / 1x1->3x3 / 1x1->5x5 / pool->1x1 branches
+    concatenated (reference googlenet.py Inception)."""
+
+    def __init__(self, in_c, f1, f3r, f3, f5r, f5, proj):
+        super().__init__()
+        self.b1 = _cconv(in_c, f1, 1)
+        self.b3 = nn.Sequential(_cconv(in_c, f3r, 1), _cconv(f3r, f3, 3))
+        self.b5 = nn.Sequential(_cconv(in_c, f5r, 1), _cconv(f5r, f5, 5))
+        self.bp = nn.Sequential(nn.MaxPool2D(3, 1, padding=1),
+                                _cconv(in_c, proj, 1))
+
+    def forward(self, x):
+        return concat([self.b1(x), self.b3(x), self.b5(x), self.bp(x)],
+                      axis=1)
+
+
+class GoogLeNet(nn.Layer):
+    """GoogLeNet / Inception v1 (reference vision/models/googlenet.py:107).
+
+    forward returns ``(out, out1, out2)``: the main head plus the two
+    auxiliary heads over the 4a and 4d cells, matching the reference's
+    training contract.
+    """
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            _cconv(3, 64, 7, 2), nn.MaxPool2D(3, 2, padding=1),
+            _cconv(64, 64, 1), _cconv(64, 192, 3),
+            nn.MaxPool2D(3, 2, padding=1))
+        self.i3a = _Incept(192, 64, 96, 128, 16, 32, 32)
+        self.i3b = _Incept(256, 128, 128, 192, 32, 96, 64)
+        self.pool3 = nn.MaxPool2D(3, 2, padding=1)
+        self.i4a = _Incept(480, 192, 96, 208, 16, 48, 64)
+        self.i4b = _Incept(512, 160, 112, 224, 24, 64, 64)
+        self.i4c = _Incept(512, 128, 128, 256, 24, 64, 64)
+        self.i4d = _Incept(512, 112, 144, 288, 32, 64, 64)
+        self.i4e = _Incept(528, 256, 160, 320, 32, 128, 128)
+        self.pool4 = nn.MaxPool2D(3, 2, padding=1)
+        self.i5a = _Incept(832, 256, 160, 320, 32, 128, 128)
+        self.i5b = _Incept(832, 384, 192, 384, 48, 128, 128)
+        if with_pool:
+            self.gap = nn.AdaptiveAvgPool2D(1)
+            self.aux_pool = nn.AdaptiveAvgPool2D(4)
+        if num_classes > 0:
+            self.drop = nn.Dropout(0.4)
+            self.head = nn.Linear(1024, num_classes)
+            # aux heads (4a: 512 ch, 4d: 528 ch)
+            self.aux1_conv = nn.Sequential(nn.Conv2D(512, 128, 1), nn.ReLU())
+            self.aux1_fc = nn.Sequential(
+                nn.Linear(128 * 16, 1024), nn.ReLU(), nn.Dropout(0.7),
+                nn.Linear(1024, num_classes))
+            self.aux2_conv = nn.Sequential(nn.Conv2D(528, 128, 1), nn.ReLU())
+            self.aux2_fc = nn.Sequential(
+                nn.Linear(128 * 16, 1024), nn.ReLU(), nn.Dropout(0.7),
+                nn.Linear(1024, num_classes))
+
+    def forward(self, x):
+        x = self.pool3(self.i3b(self.i3a(self.stem(x))))
+        a4a = self.i4a(x)
+        a4d = self.i4d(self.i4c(self.i4b(a4a)))
+        x = self.pool4(self.i4e(a4d))
+        out = self.i5b(self.i5a(x))
+        out1, out2 = a4a, a4d
+        if self.with_pool:
+            out = self.gap(out)
+            out1 = self.aux_pool(out1)
+            out2 = self.aux_pool(out2)
+        if self.num_classes > 0:
+            out = self.head(self.drop(out).flatten(1))
+            out1 = self.aux1_fc(self.aux1_conv(out1).flatten(1))
+            out2 = self.aux2_fc(self.aux2_conv(out2).flatten(1))
+        return out, out1, out2
+
+
+def googlenet(pretrained=False, **kwargs):
+    _no_pretrained(pretrained)
+    return GoogLeNet(**kwargs)
+
+
+# --- Inception v3 ----------------------------------------------------------
+def _cbn(i, o, k, s=1, p=0):
+    return nn.Sequential(
+        nn.Conv2D(i, o, k, stride=s, padding=p, bias_attr=False),
+        nn.BatchNorm2D(o), nn.ReLU())
+
+
+class _InceptA(nn.Layer):
+    def __init__(self, in_c, pool_c):
+        super().__init__()
+        self.b1 = _cbn(in_c, 64, 1)
+        self.b5 = nn.Sequential(_cbn(in_c, 48, 1), _cbn(48, 64, 5, p=2))
+        self.b3 = nn.Sequential(_cbn(in_c, 64, 1), _cbn(64, 96, 3, p=1),
+                                _cbn(96, 96, 3, p=1))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, 1, padding=1),
+                                _cbn(in_c, pool_c, 1))
+
+    def forward(self, x):
+        return concat([self.b1(x), self.b5(x), self.b3(x), self.bp(x)], 1)
+
+
+class _InceptB(nn.Layer):
+    def __init__(self, in_c):
+        super().__init__()
+        self.b3 = _cbn(in_c, 384, 3, s=2)
+        self.b3d = nn.Sequential(_cbn(in_c, 64, 1), _cbn(64, 96, 3, p=1),
+                                 _cbn(96, 96, 3, s=2))
+        self.pool = nn.MaxPool2D(3, 2)
+
+    def forward(self, x):
+        return concat([self.b3(x), self.b3d(x), self.pool(x)], 1)
+
+
+class _InceptC(nn.Layer):
+    def __init__(self, in_c, c7):
+        super().__init__()
+        self.b1 = _cbn(in_c, 192, 1)
+        self.b7 = nn.Sequential(
+            _cbn(in_c, c7, 1), _cbn(c7, c7, (1, 7), p=(0, 3)),
+            _cbn(c7, 192, (7, 1), p=(3, 0)))
+        self.b7d = nn.Sequential(
+            _cbn(in_c, c7, 1), _cbn(c7, c7, (7, 1), p=(3, 0)),
+            _cbn(c7, c7, (1, 7), p=(0, 3)), _cbn(c7, c7, (7, 1), p=(3, 0)),
+            _cbn(c7, 192, (1, 7), p=(0, 3)))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, 1, padding=1),
+                                _cbn(in_c, 192, 1))
+
+    def forward(self, x):
+        return concat([self.b1(x), self.b7(x), self.b7d(x), self.bp(x)], 1)
+
+
+class _InceptD(nn.Layer):
+    def __init__(self, in_c):
+        super().__init__()
+        self.b3 = nn.Sequential(_cbn(in_c, 192, 1), _cbn(192, 320, 3, s=2))
+        self.b7 = nn.Sequential(
+            _cbn(in_c, 192, 1), _cbn(192, 192, (1, 7), p=(0, 3)),
+            _cbn(192, 192, (7, 1), p=(3, 0)), _cbn(192, 192, 3, s=2))
+        self.pool = nn.MaxPool2D(3, 2)
+
+    def forward(self, x):
+        return concat([self.b3(x), self.b7(x), self.pool(x)], 1)
+
+
+class _InceptE(nn.Layer):
+    def __init__(self, in_c):
+        super().__init__()
+        self.b1 = _cbn(in_c, 320, 1)
+        self.b3_stem = _cbn(in_c, 384, 1)
+        self.b3_a = _cbn(384, 384, (1, 3), p=(0, 1))
+        self.b3_b = _cbn(384, 384, (3, 1), p=(1, 0))
+        self.b3d_stem = nn.Sequential(_cbn(in_c, 448, 1),
+                                      _cbn(448, 384, 3, p=1))
+        self.b3d_a = _cbn(384, 384, (1, 3), p=(0, 1))
+        self.b3d_b = _cbn(384, 384, (3, 1), p=(1, 0))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, 1, padding=1),
+                                _cbn(in_c, 192, 1))
+
+    def forward(self, x):
+        s3 = self.b3_stem(x)
+        s3d = self.b3d_stem(x)
+        return concat([
+            self.b1(x),
+            concat([self.b3_a(s3), self.b3_b(s3)], 1),
+            concat([self.b3d_a(s3d), self.b3d_b(s3d)], 1),
+            self.bp(x)], 1)
+
+
+class InceptionV3(nn.Layer):
+    """Inception v3 (reference vision/models/inceptionv3.py InceptionV3):
+    5x A/B/C/D/E inception stages over a 299x299 stem."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            _cbn(3, 32, 3, s=2), _cbn(32, 32, 3), _cbn(32, 64, 3, p=1),
+            nn.MaxPool2D(3, 2), _cbn(64, 80, 1), _cbn(80, 192, 3),
+            nn.MaxPool2D(3, 2))
+        self.blocks = nn.Sequential(
+            _InceptA(192, 32), _InceptA(256, 64), _InceptA(288, 64),
+            _InceptB(288),
+            _InceptC(768, 128), _InceptC(768, 160), _InceptC(768, 160),
+            _InceptC(768, 192),
+            _InceptD(768),
+            _InceptE(1280), _InceptE(2048))
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.dropout = nn.Dropout(0.5)
+            self.fc = nn.Linear(2048, num_classes)
+        else:
+            self.fc = None
+
+    def forward(self, x):
+        x = self.blocks(self.stem(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.fc is not None:
+            x = self.fc(self.dropout(x).flatten(1))
+        return x
+
+
+def inception_v3(pretrained=False, **kwargs):
+    _no_pretrained(pretrained)
+    return InceptionV3(**kwargs)

@@ -211,3 +211,21 @@ def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
 
 def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
     return MobileNetV3(_V3_SMALL, 1024, scale=scale, **kwargs)
+
+
+class MobileNetV3Small(MobileNetV3):
+    """MobileNetV3-Small as a class export (reference mobilenetv3.py
+    MobileNetV3Small; the functional spelling is mobilenet_v3_small)."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_V3_SMALL, 1024, scale=scale,
+                         num_classes=num_classes, with_pool=with_pool)
+
+
+class MobileNetV3Large(MobileNetV3):
+    """MobileNetV3-Large as a class export (reference mobilenetv3.py
+    MobileNetV3Large)."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_V3_LARGE, 1280, scale=scale,
+                         num_classes=num_classes, with_pool=with_pool)

@@ -158,3 +158,35 @@ def wide_resnet50_2(pretrained=False, **kwargs):
 def wide_resnet101_2(pretrained=False, **kwargs):
     kwargs["width"] = 128
     return _resnet(BottleneckBlock, 101, pretrained, **kwargs)
+
+
+def _resnext(depth, groups, width, pretrained=False, **kwargs):
+    kwargs["groups"] = groups
+    kwargs["width"] = width
+    return _resnet(BottleneckBlock, depth, pretrained, **kwargs)
+
+
+def resnext50_32x4d(pretrained=False, **kwargs):
+    """ResNeXt-50 32x4d (reference vision/models/resnet.py resnext50_32x4d):
+    grouped 3x3 bottlenecks, 32 groups x 4-wide."""
+    return _resnext(50, 32, 4, pretrained, **kwargs)
+
+
+def resnext50_64x4d(pretrained=False, **kwargs):
+    return _resnext(50, 64, 4, pretrained, **kwargs)
+
+
+def resnext101_32x4d(pretrained=False, **kwargs):
+    return _resnext(101, 32, 4, pretrained, **kwargs)
+
+
+def resnext101_64x4d(pretrained=False, **kwargs):
+    return _resnext(101, 64, 4, pretrained, **kwargs)
+
+
+def resnext152_32x4d(pretrained=False, **kwargs):
+    return _resnext(152, 32, 4, pretrained, **kwargs)
+
+
+def resnext152_64x4d(pretrained=False, **kwargs):
+    return _resnext(152, 64, 4, pretrained, **kwargs)

@@ -158,3 +158,19 @@ def test_hot_functionals_are_cacheable(eager_cache):
         call()
         assert len(eager_cache) == n and n > 0, (
             f"{name} is not eager-cacheable (closure captured a Tensor?)")
+
+
+def test_cache_eviction_is_lru(eager_cache):
+    """A hit must refresh recency so eviction drops cold entries, not the
+    hottest executable (round-4 weak #9: FIFO dropped the oldest-INSERTED)."""
+    x = paddle.to_tensor(np.ones((2, 2), np.float32))
+    paddle.nn.functional.softmax(x)  # hot entry, inserted FIRST
+    hot = next(iter(eager_cache))
+    # fill with colder entries
+    for i in range(3):
+        paddle.scale(x, float(i))
+    paddle.nn.functional.softmax(x)  # touch the hot entry
+    assert next(iter(eager_cache)) != hot  # recency refreshed: no longer LRU
+    # simulate the eviction sweep: the dropped quarter excludes the hot key
+    order = list(eager_cache)
+    assert hot == order[-1]
