@@ -4,6 +4,8 @@ Reference: python/paddle/audio (features/layers.py, functional/, backends/
 — soundfile-backed load/save). The backend here is the stdlib ``wave``
 module (PCM16/PCM32), keeping the build dependency-free.
 """
-from . import backends, features, functional
+from . import backends, datasets, features, functional
+from .backends import info, load, save
 
-__all__ = ["features", "functional", "backends"]
+__all__ = ["features", "functional", "backends", "datasets",
+           "load", "info", "save"]

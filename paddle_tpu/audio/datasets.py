@@ -1,0 +1,125 @@
+"""Audio classification datasets (reference python/paddle/audio/datasets/:
+AudioClassificationDataset base + TESS + ESC50).
+
+Zero-egress build: the reference downloads its archives; here the data
+directory must already exist locally (``data_dir=``) — construction raises a
+pointed error otherwise, the file-walk/fold-split/label contracts match.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..io import Dataset
+from .backends import load as _load
+
+
+class AudioClassificationDataset(Dataset):
+    """Base class: (audio-or-feature, label) pairs over a file list
+    (reference datasets/dataset.py:29)."""
+
+    def __init__(self, files, labels, feat_type="raw", sample_rate=None,
+                 **feat_kwargs):
+        super().__init__()
+        if feat_type not in ("raw", "melspectrogram", "mfcc",
+                             "logmelspectrogram", "spectrogram"):
+            raise RuntimeError(f"Unknown feat_type: {feat_type}")
+        self.files = list(files)
+        self.labels = list(labels)
+        self.feat_type = feat_type
+        self.sample_rate = sample_rate
+        self.feat_kwargs = feat_kwargs
+
+    def _extract(self, waveform, sr):
+        from ..tensor.tensor import Tensor
+
+        if self.feat_type == "raw":
+            return waveform
+        from . import features
+
+        x = Tensor(waveform[None, :])
+        if self.feat_type == "melspectrogram":
+            out = features.MelSpectrogram(sr=sr, **self.feat_kwargs)(x)
+        elif self.feat_type == "logmelspectrogram":
+            out = features.LogMelSpectrogram(sr=sr, **self.feat_kwargs)(x)
+        elif self.feat_type == "spectrogram":
+            out = features.Spectrogram(**self.feat_kwargs)(x)
+        else:
+            out = features.MFCC(sr=sr, **self.feat_kwargs)(x)
+        return np.asarray(out.numpy())[0]
+
+    def __getitem__(self, idx):
+        wav, sr = _load(self.files[idx])
+        waveform = np.asarray(wav.numpy())[0]  # mono channel 0
+        return self._extract(waveform, sr), self.labels[idx]
+
+    def __len__(self):
+        return len(self.files)
+
+
+def _require_dir(data_dir, cls, url):
+    if data_dir is None or not os.path.isdir(data_dir):
+        raise RuntimeError(
+            f"{cls} needs a local data_dir (this build has no network "
+            f"egress; the reference downloads {url}). Pass "
+            f"data_dir=<extracted archive path>.")
+
+
+class TESS(AudioClassificationDataset):
+    """Toronto emotional speech set (reference datasets/tess.py:26): 2800
+    wavs over 7 emotions; n-fold split by file order."""
+
+    label_list = ["angry", "disgust", "fear", "happy", "neutral", "ps",
+                  "sad"]
+    archive_url = "TESS_Toronto_emotional_speech_set.zip"
+
+    def __init__(self, mode="train", n_folds=5, split=1, feat_type="raw",
+                 data_dir=None, **kwargs):
+        if not 1 <= split <= n_folds:
+            raise ValueError(f"split must be in [1, {n_folds}], got {split}")
+        _require_dir(data_dir, "TESS", self.archive_url)
+        files, labels = [], []
+        for root, _, names in sorted(os.walk(data_dir)):
+            for name in sorted(names):
+                if not name.lower().endswith(".wav"):
+                    continue
+                emo = name.rsplit("_", 1)[-1][:-4].lower()
+                if emo not in self.label_list:
+                    continue
+                files.append(os.path.join(root, name))
+                labels.append(self.label_list.index(emo))
+        folds = [i % n_folds + 1 for i in range(len(files))]
+        keep = [(f != split) if mode == "train" else (f == split)
+                for f in folds]
+        files = [f for f, k in zip(files, keep) if k]
+        labels = [l for l, k in zip(labels, keep) if k]
+        super().__init__(files, labels, feat_type, **kwargs)
+
+
+class ESC50(AudioClassificationDataset):
+    """ESC-50 environmental sound dataset (reference datasets/esc50.py:26):
+    2000 wavs, 50 classes, official 5-fold split encoded in filenames
+    (fold-target: ``{fold}-{clip}-{take}-{target}.wav``)."""
+
+    archive_url = "ESC-50-master.zip"
+
+    def __init__(self, mode="train", split=1, feat_type="raw",
+                 data_dir=None, **kwargs):
+        _require_dir(data_dir, "ESC50", self.archive_url)
+        files, labels = [], []
+        for root, _, names in sorted(os.walk(data_dir)):
+            for name in sorted(names):
+                if not name.lower().endswith(".wav"):
+                    continue
+                parts = name[:-4].split("-")
+                if len(parts) != 4:
+                    continue
+                fold, target = int(parts[0]), int(parts[3])
+                if (fold != split) if mode == "train" else (fold == split):
+                    files.append(os.path.join(root, name))
+                    labels.append(target)
+        super().__init__(files, labels, feat_type, **kwargs)
+
+
+__all__ = ["AudioClassificationDataset", "TESS", "ESC50"]

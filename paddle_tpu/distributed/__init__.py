@@ -98,6 +98,7 @@ def spawn(func, args=(), nprocs=-1, **kwargs):
 _LAZY = {
     # submodules
     "fleet": ".fleet",
+    "io": ".io",
     "collective": ".collective",
     "auto_parallel": ".auto_parallel",
     "checkpoint": ".checkpoint",
@@ -194,3 +195,39 @@ def __getattr__(name):
         mod = importlib.import_module(_FLAT[name], __name__)
         return getattr(mod, name)
     raise AttributeError(f"module 'paddle_tpu.distributed' has no attribute {name!r}")
+
+
+# --- gloo-compat surface (reference distributed/parallel.py gloo_*): the
+# reference's CPU-side rendezvous/barrier backend; here the TCPStore-backed
+# barrier IS the CPU coordination path, so these alias onto it -------------
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    """Initialize CPU-side coordination (reference parallel.py
+    gloo_init_parallel_env). Maps onto init_parallel_env + the TCPStore
+    rendezvous at ``server_endpoint``."""
+    import os
+
+    host, _, port = str(server_endpoint).partition(":")
+    os.environ.setdefault("PADDLE_MASTER", f"{host}:{port}" if port
+                          else str(server_endpoint))
+    os.environ.setdefault("PADDLE_TRAINER_ID", str(rank_id))
+    os.environ.setdefault("PADDLE_TRAINERS_NUM", str(rank_num))
+    return init_parallel_env()
+
+
+def gloo_barrier():
+    """CPU barrier over the store rendezvous (reference parallel.py
+    gloo_barrier)."""
+    from .collective import barrier
+
+    return barrier()
+
+
+def gloo_release():
+    """Release CPU coordination resources (reference parallel.py
+    gloo_release). The default group is process-lifetime state here (XLA
+    owns the collectives); releasing resets it so a later
+    gloo_init_parallel_env can re-rendezvous."""
+    from . import collective
+
+    collective._default_group = None
+    collective._groups.pop(0, None)

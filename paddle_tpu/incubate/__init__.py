@@ -84,3 +84,12 @@ __all__ = [
     "segment_sum", "segment_mean", "segment_min", "segment_max",
     "identity_loss",
 ]
+
+
+from .graph_ops import (  # noqa: F401,E402
+    graph_khop_sampler,
+    graph_reindex,
+    graph_sample_neighbors,
+    graph_send_recv,
+)
+from .optimizer import LookAhead, ModelAverage  # noqa: F401,E402

@@ -1,0 +1,126 @@
+"""Graph sampling ops (reference incubate/operators/{graph_send_recv,
+graph_khop_sampler,graph_sample_neighbors,graph_reindex}.py).
+
+Sampling/reindex are HOST ops by nature (data-dependent output sizes — the
+reference runs them as non-XLA-shaped kernels too); they operate on numpy
+views and return Tensors, feeding the XLA-side message passing ops
+(geometric.send_u_recv) whose shapes are then static per batch.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..tensor.tensor import Tensor
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum", out_size=None,
+                    name=None):
+    """Legacy spelling of geometric.send_u_recv (reference
+    graph_send_recv.py:39)."""
+    from ..geometric import send_u_recv
+
+    return send_u_recv(x, src_index, dst_index, reduce_op=pool_type,
+                       out_size=out_size)
+
+
+def _np(x):
+    return np.asarray(x.numpy() if isinstance(x, Tensor) else x)
+
+
+def graph_sample_neighbors(row, colptr, input_nodes, eids=None,
+                           perm_buffer=None, sample_size=-1,
+                           return_eids=False, flag_perm_buffer=False,
+                           name=None):
+    """Uniformly sample up to ``sample_size`` in-neighbors of each input
+    node from a CSC graph (reference graph_sample_neighbors.py:28).
+    Returns (neighbors, count[, eids])."""
+    row_np, colptr_np, nodes = _np(row), _np(colptr), _np(input_nodes)
+    eids_np = _np(eids) if eids is not None else None
+    rng = np.random.default_rng()
+    out_n, out_c, out_e = [], [], []
+    for n in nodes.reshape(-1):
+        start, end = int(colptr_np[n]), int(colptr_np[n + 1])
+        neigh = row_np[start:end]
+        ids = (eids_np[start:end] if eids_np is not None
+               else np.arange(start, end))
+        if sample_size > 0 and len(neigh) > sample_size:
+            pick = rng.choice(len(neigh), size=sample_size, replace=False)
+            neigh, ids = neigh[pick], ids[pick]
+        out_n.append(neigh)
+        out_e.append(ids)
+        out_c.append(len(neigh))
+    neighbors = Tensor(jnp.asarray(np.concatenate(out_n) if out_n
+                                   else np.zeros(0, row_np.dtype)))
+    count = Tensor(jnp.asarray(np.asarray(out_c, np.int32)))
+    if return_eids:
+        if eids_np is None:
+            raise ValueError("return_eids=True requires eids")
+        return neighbors, count, Tensor(jnp.asarray(np.concatenate(out_e)))
+    return neighbors, count
+
+
+def graph_reindex(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  flag_buffer_hashtable=False, name=None):
+    """Reindex (x, neighbors) onto a compact id space: x first, then unseen
+    neighbors in appearance order (reference graph_reindex.py:28).
+    Returns (reindex_src, reindex_dst, out_nodes)."""
+    x_np, neigh, cnt = _np(x).reshape(-1), _np(neighbors).reshape(-1), _np(count).reshape(-1)
+    mapping = {int(n): i for i, n in enumerate(x_np)}
+    for n in neigh:
+        n = int(n)
+        if n not in mapping:
+            mapping[n] = len(mapping)
+    reindex_src = np.asarray([mapping[int(n)] for n in neigh], np.int64)
+    reindex_dst = np.repeat(np.arange(len(x_np), dtype=np.int64), cnt)
+    out_nodes = np.fromiter(mapping.keys(), np.int64, len(mapping))
+    return (Tensor(jnp.asarray(reindex_src)), Tensor(jnp.asarray(reindex_dst)),
+            Tensor(jnp.asarray(out_nodes)))
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """Multi-hop neighbor sampling + reindex in one call (reference
+    graph_khop_sampler.py:21). Returns (edge_src, edge_dst, sample_index,
+    reindex_nodes[, edge_eids])."""
+    frontier = _np(input_nodes).reshape(-1)
+    all_src, all_dst, all_eids = [], [], []
+    seen = list(frontier)
+    seen_set = set(int(n) for n in frontier)
+    for size in sample_sizes:
+        res = graph_sample_neighbors(row, colptr, Tensor(jnp.asarray(frontier)),
+                                     eids=sorted_eids,
+                                     sample_size=size,
+                                     return_eids=return_eids)
+        if return_eids:
+            neigh, cnt, eids = res
+            all_eids.append(_np(eids))
+        else:
+            neigh, cnt = res
+        neigh_np, cnt_np = _np(neigh), _np(cnt)
+        all_src.append(neigh_np)
+        all_dst.append(np.repeat(frontier, cnt_np))
+        nxt = []
+        for n in neigh_np:
+            if int(n) not in seen_set:
+                seen_set.add(int(n))
+                seen.append(n)
+                nxt.append(n)
+        frontier = np.asarray(nxt, dtype=neigh_np.dtype) if nxt \
+            else np.zeros(0, neigh_np.dtype)
+    src = np.concatenate(all_src) if all_src else np.zeros(0, np.int64)
+    dst = np.concatenate(all_dst) if all_dst else np.zeros(0, np.int64)
+    nodes = np.asarray(seen, np.int64)
+    mapping = {int(n): i for i, n in enumerate(nodes)}
+    edge_src = Tensor(jnp.asarray(
+        np.asarray([mapping[int(n)] for n in src], np.int64)))
+    edge_dst = Tensor(jnp.asarray(
+        np.asarray([mapping[int(n)] for n in dst], np.int64)))
+    sample_index = Tensor(jnp.asarray(nodes))
+    reindex_nodes = Tensor(jnp.asarray(
+        np.arange(len(_np(input_nodes).reshape(-1)), dtype=np.int64)))
+    if return_eids:
+        eids = Tensor(jnp.asarray(np.concatenate(all_eids)))
+        return edge_src, edge_dst, sample_index, reindex_nodes, eids
+    return edge_src, edge_dst, sample_index, reindex_nodes

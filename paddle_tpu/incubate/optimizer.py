@@ -1,0 +1,126 @@
+"""Incubate optimizers: LookAhead + ModelAverage (reference
+python/paddle/incubate/optimizer/{lookahead.py:27,modelaverage.py:28})."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..autograd import no_grad
+from ..tensor.tensor import Tensor
+
+
+class LookAhead:
+    """Lookahead wrapper (reference lookahead.py:27): run the inner
+    optimizer's fast steps; every ``k`` steps pull the slow weights
+    ``slow += alpha * (fast - slow)`` and reset the fast weights to them."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError(f"alpha should be in [0, 1], got {alpha}")
+        if not (isinstance(k, int) and k > 0):
+            raise ValueError(f"k should be a positive integer, got {k}")
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self._params = list(inner_optimizer._parameter_list)
+        self._slow = [jnp.asarray(p._data) for p in self._params]
+        self._k_count = 0
+
+    def step(self, closure=None):
+        self.inner_optimizer.step()
+        self._k_count += 1
+        if self._k_count % self.k == 0:
+            with no_grad():
+                for i, p in enumerate(self._params):
+                    slow = (self._slow[i]
+                            + self.alpha * (p._data - self._slow[i]))
+                    self._slow[i] = slow
+                    p._data = slow.astype(p._data.dtype)
+
+    def clear_grad(self):
+        self.inner_optimizer.clear_grad()
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+    def state_dict(self):
+        sd = self.inner_optimizer.state_dict()
+        sd["lookahead_slow"] = [jnp.asarray(s) for s in self._slow]
+        sd["lookahead_k_count"] = self._k_count
+        return sd
+
+    def set_state_dict(self, sd):
+        sd = dict(sd)
+        slow = sd.pop("lookahead_slow", None)
+        self._k_count = int(sd.pop("lookahead_k_count", 0))
+        if slow is not None:
+            self._slow = [jnp.asarray(s) for s in slow]
+        self.inner_optimizer.set_state_dict(sd)
+
+
+class ModelAverage:
+    """Running parameter average applied at eval time (reference
+    modelaverage.py:28): ``step()`` accumulates after each optimizer
+    update; ``apply()`` swaps the averaged weights in (optionally as a
+    context manager), ``restore()`` swaps training weights back."""
+
+    def __init__(self, average_window_rate, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        if parameters is None:
+            raise ValueError("parameters must be provided (dygraph mode)")
+        self.rate = float(average_window_rate)
+        self.min_window = int(min_average_window)
+        self.max_window = int(max_average_window)
+        self._params = list(parameters)
+        self._sum = [jnp.zeros_like(p._data) for p in self._params]
+        self._num = 0
+        self._backup = None
+
+    def step(self):
+        if self._num >= self.max_window \
+                and self._num >= max(self.min_window,
+                                     int(self._num * self.rate)):
+            # window full: restart accumulation from the current weights
+            self._sum = [jnp.asarray(p._data) for p in self._params]
+            self._num = 1
+        else:
+            self._sum = [s + p._data for s, p in zip(self._sum, self._params)]
+            self._num += 1
+
+    def minimize(self, loss=None, **kw):
+        self.step()
+
+    def apply(self, executor=None, need_restore=True):
+        if self._num == 0:
+            raise RuntimeError("ModelAverage.apply before any step()")
+        self._backup = [jnp.asarray(p._data) for p in self._params]
+        with no_grad():
+            for p, s in zip(self._params, self._sum):
+                p._data = (s / self._num).astype(p._data.dtype)
+        return _RestoreCtx(self) if need_restore else None
+
+    def restore(self, executor=None):
+        if self._backup is None:
+            return
+        with no_grad():
+            for p, b in zip(self._params, self._backup):
+                p._data = b
+        self._backup = None
+
+
+class _RestoreCtx:
+    def __init__(self, ma):
+        self._ma = ma
+
+    def __enter__(self):
+        return self._ma
+
+    def __exit__(self, *exc):
+        self._ma.restore()
+        return False

@@ -37,3 +37,36 @@ __all__ = [
     "save",
     "to_static",
 ]
+
+
+# --- dy2static logging controls (reference jit/dy2static/logging_utils.py:
+# set_verbosity:187, set_code_level:226) -------------------------------------
+_VERBOSITY = [0]
+_CODE_LEVEL = [-1]
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    """Set the dy2static transform log verbosity (0 = silent). Mirrors the
+    reference's env-overridable knob (TRANSLATOR_VERBOSITY)."""
+    import os
+
+    _VERBOSITY[0] = int(os.environ.get("TRANSLATOR_VERBOSITY", level))
+    return _VERBOSITY[0]
+
+
+def get_verbosity():
+    return _VERBOSITY[0]
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """Print transformed code up to AST-pass ``level`` (reference
+    TRANSLATOR_CODE_LEVEL). The dy2static rewriter consults this when
+    emitting its transformed source."""
+    import os
+
+    _CODE_LEVEL[0] = int(os.environ.get("TRANSLATOR_CODE_LEVEL", level))
+    return _CODE_LEVEL[0]
+
+
+def get_code_level():
+    return _CODE_LEVEL[0]

@@ -41,9 +41,11 @@ from .layer.rnn import (
     BiRNN,
     GRUCell,
     LSTMCell,
+    RNNCellBase,
     SimpleRNN,
     SimpleRNNCell,
 )
+from .decode import BeamSearchDecoder, dynamic_decode
 from .layer.transformer import (
     MultiHeadAttention,
     Transformer,

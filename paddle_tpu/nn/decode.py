@@ -1,0 +1,168 @@
+"""Seq2seq decoding: BeamSearchDecoder + dynamic_decode (reference
+python/paddle/nn/decode.py — the rnn.py re-exports). Eager host loop over a
+step-jittable cell, mirroring the reference's while-loop semantics."""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..tensor.manipulation import concat, gather, reshape, stack
+from ..tensor.tensor import Tensor
+
+
+class Decoder:
+    """Decoder protocol (reference decode.py Decoder): initialize/step/
+    finalize over a time loop driven by dynamic_decode."""
+
+    def initialize(self, inits):
+        raise NotImplementedError
+
+    def step(self, time, inputs, states, **kwargs):
+        raise NotImplementedError
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        return outputs, final_states
+
+    @property
+    def tracks_own_finished(self):
+        return False
+
+
+class BeamSearchDecoder(Decoder):
+    """Beam-search decoding over an RNN cell (reference decode.py
+    BeamSearchDecoder): expands each batch item to ``beam_size`` hypotheses,
+    advances all beams through the cell, and keeps the top-k continuations
+    by accumulated log-probability; finished beams absorb with their score
+    frozen.
+    """
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    # --- helpers over [batch * beam, ...] flat layout ---
+    def _merge(self, t):
+        return reshape(t, [-1] + list(t.shape[2:]))
+
+    def _split(self, t, batch):
+        return reshape(t, [batch, self.beam_size] + list(t.shape[1:]))
+
+    def _tile_beam(self, t):
+        """[batch, ...] -> [batch * beam, ...] (tile_beam_merge_with_batch)."""
+        data = jnp.repeat(t._data[:, None], self.beam_size, axis=1)
+        return Tensor(data.reshape((-1,) + t._data.shape[1:]))
+
+    tile_beam_merge_with_batch = _tile_beam
+
+    def initialize(self, initial_cell_states):
+        states = [self._tile_beam(s) for s in _as_list(initial_cell_states)]
+        batch = states[0].shape[0] // self.beam_size
+        ids = np.full((batch * self.beam_size,), self.start_token, np.int64)
+        # only beam 0 is live at t=0 (others -inf so duplicates don't win)
+        logp = np.full((batch, self.beam_size), -1e9, np.float32)
+        logp[:, 0] = 0.0
+        init = {
+            "log_probs": Tensor(jnp.asarray(logp)),
+            "finished": Tensor(jnp.zeros((batch, self.beam_size), jnp.bool_)),
+            "lengths": Tensor(jnp.zeros((batch, self.beam_size), jnp.int64)),
+            "cell_states": states,
+        }
+        return Tensor(jnp.asarray(ids)), init, init["finished"]
+
+    def step(self, time, inputs, states, **kwargs):
+        batch = states["log_probs"].shape[0]
+        if self.embedding_fn is not None:
+            inputs = self.embedding_fn(inputs)
+        cell_out, next_cell_states = self.cell(inputs, states["cell_states"],
+                                               **kwargs)
+        if self.output_fn is not None:
+            cell_out = self.output_fn(cell_out)
+        vocab = int(cell_out.shape[-1])
+        import jax
+
+        logits = cell_out._data.reshape(batch, self.beam_size, vocab)
+        step_logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        prev = states["log_probs"]._data[:, :, None]
+        fin = states["finished"]._data
+        # finished beams: only end_token continues (score unchanged)
+        freeze = jnp.full((vocab,), -1e9, jnp.float32).at[self.end_token].set(0.0)
+        step_logp = jnp.where(fin[:, :, None], freeze[None, None, :], step_logp)
+        total = (prev + step_logp).reshape(batch, self.beam_size * vocab)
+        top_logp, top_idx = jax.lax.top_k(total, self.beam_size)
+        beam_idx = (top_idx // vocab).astype(jnp.int64)   # [batch, beam]
+        token_idx = (top_idx % vocab).astype(jnp.int64)
+        new_fin = jnp.take_along_axis(fin, beam_idx, axis=1) \
+            | (token_idx == self.end_token)
+        lengths = jnp.take_along_axis(states["lengths"]._data, beam_idx, axis=1)
+        lengths = lengths + (~new_fin).astype(jnp.int64)
+        flat_parent = (jnp.arange(batch)[:, None] * self.beam_size
+                       + beam_idx).reshape(-1)
+        next_states = {
+            "log_probs": Tensor(top_logp),
+            "finished": Tensor(new_fin),
+            "lengths": Tensor(lengths),
+            "cell_states": [
+                gather(s, Tensor(flat_parent), axis=0)
+                for s in _as_list(next_cell_states)],
+            "parent_idx": Tensor(beam_idx),
+        }
+        outputs = {"token": Tensor(token_idx), "parent": Tensor(beam_idx),
+                   "log_probs": Tensor(top_logp)}
+        next_inputs = Tensor(token_idx.reshape(-1))
+        return outputs, next_states, next_inputs, Tensor(new_fin)
+
+    @property
+    def tracks_own_finished(self):
+        return True
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        """Backtrack the beam parents into explicit token sequences
+        [batch, beam, time]."""
+        tokens = np.stack([np.asarray(o["token"].numpy()) for o in outputs], 0)
+        parents = np.stack([np.asarray(o["parent"].numpy()) for o in outputs], 0)
+        T, batch, beam = tokens.shape
+        seqs = np.zeros((T, batch, beam), np.int64)
+        cur = np.tile(np.arange(beam), (batch, 1))
+        for t in range(T - 1, -1, -1):
+            seqs[t] = np.take_along_axis(tokens[t], cur, axis=1)
+            cur = np.take_along_axis(parents[t], cur, axis=1)
+        out = Tensor(jnp.asarray(seqs.transpose(1, 2, 0)))  # [batch, beam, T]
+        return out, final_states
+
+
+def _as_list(states):
+    if isinstance(states, (list, tuple)):
+        return list(states)
+    return [states]
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None,
+                   output_time_major=False, impute_finished=False,
+                   is_test=False, return_length=False, **kwargs):
+    """Run ``decoder`` until every sequence finishes or ``max_step_num``
+    (reference decode.py dynamic_decode). Returns (outputs, final_states)
+    (+ lengths when return_length)."""
+    inputs, states, finished = decoder.initialize(inits)
+    outputs = []
+    step = 0
+    limit = max_step_num if max_step_num is not None else 256
+    while step < limit:
+        out, states, inputs, finished = decoder.step(step, inputs, states,
+                                                     **kwargs)
+        outputs.append(out)
+        step += 1
+        if bool(np.asarray(finished.numpy()).all()):
+            break
+    lengths = states.get("lengths") if isinstance(states, dict) else None
+    final, states = decoder.finalize(outputs, states, lengths)
+    if output_time_major and isinstance(final, Tensor) and final._data.ndim >= 3:
+        final = Tensor(jnp.moveaxis(final._data, -1, 0))
+    if return_length:
+        return final, states, lengths
+    return final, states

@@ -34,3 +34,36 @@ from .extension import (  # noqa: F401
     sequence_mask,
     temporal_shift,
 )
+
+from .common import pairwise_distance, pdist  # noqa: F401
+from .activation import hardtanh_, leaky_relu_, thresholded_relu_  # noqa: F401
+from .loss import dice_loss, npair_loss  # noqa: F401
+
+
+def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
+                     key_padding_mask=None, attn_mask=None, name=None):
+    """Block-sparse attention with an explicit CSR pattern (reference
+    nn/functional/sparse_attention.py:19). Routed to the sparse package's
+    attention kernel; offset/columns describe one [B*H*L] CSR batch."""
+    from ...sparse import SparseCsrTensor
+    from ...tensor.tensor import Tensor as _T
+    import jax.numpy as _jnp
+
+    B, H, L, D = (int(s) for s in query.shape)
+    crows = sparse_csr_offset if isinstance(sparse_csr_offset, _T) else _T(sparse_csr_offset)
+    cols = sparse_csr_columns if isinstance(sparse_csr_columns, _T) else _T(sparse_csr_columns)
+    # reference passes [B, H, L+1]/[B, H, nnz]; flatten to the one-batch form
+    if crows._data.ndim == 3:
+        nnz_per = crows._data[:, :, -1]
+        base = _jnp.cumsum(nnz_per.reshape(-1)) - nnz_per.reshape(-1)
+        crows_flat = (crows._data.reshape(B * H, -1)[:, :-1]
+                      + base[:, None]).reshape(-1)
+        crows_flat = _jnp.append(crows_flat, base[-1] + nnz_per.reshape(-1)[-1])
+        cols_flat = cols._data.reshape(-1)
+        crows, cols = _T(crows_flat), _T(cols_flat)
+    vals = _T(_jnp.ones(cols._data.shape, query._data.dtype))
+    pattern = SparseCsrTensor(crows, cols, vals, [B * H * L, L])
+    from ...sparse.nn.functional import attention as _sp_attn
+
+    return _sp_attn(query, key, value, pattern,
+                    key_padding_mask=key_padding_mask, attn_mask=attn_mask)

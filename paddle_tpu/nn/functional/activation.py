@@ -186,3 +186,22 @@ def tanh_(x, name=None):
     from ...tensor.manipulation import _inplace
 
     return _inplace(x, tanh(x))
+
+
+def hardtanh_(x, min=-1.0, max=1.0, name=None):
+    """In-place hardtanh (reference exports the op_ spelling)."""
+    from ...tensor.manipulation import _inplace
+
+    return _inplace(x, hardtanh(x, min, max))
+
+
+def leaky_relu_(x, negative_slope=0.01, name=None):
+    from ...tensor.manipulation import _inplace
+
+    return _inplace(x, leaky_relu(x, negative_slope))
+
+
+def thresholded_relu_(x, threshold=1.0, value=0.0, name=None):
+    from ...tensor.manipulation import _inplace
+
+    return _inplace(x, thresholded_relu(x, threshold, value))

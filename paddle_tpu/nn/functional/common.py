@@ -390,3 +390,20 @@ def pdist(x, p=2.0, name=None):
         return jnp.sum(absd ** p, axis=-1) ** (1.0 / p)
 
     return apply_op("pdist", fn, x)
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    """p-norm distance between paired rows of x and y (reference
+    nn/functional/distance.py pairwise_distance; the PairwiseDistance layer
+    wraps this)."""
+    def fn(a, b):
+        d = a - b + epsilon
+        if p == float("inf"):
+            out = jnp.max(jnp.abs(d), axis=-1, keepdims=keepdim)
+        elif p == 0:
+            out = jnp.sum((d != 0).astype(a.dtype), axis=-1, keepdims=keepdim)
+        else:
+            out = jnp.sum(jnp.abs(d) ** p, axis=-1, keepdims=keepdim) ** (1.0 / p)
+        return out
+
+    return apply_op("pairwise_distance", fn, x, y)

@@ -500,3 +500,43 @@ def hsigmoid_loss(input, label, num_classes, weight, bias=None,
         return apply_op("hsigmoid_loss", fn_custom, *args)
     args = [input, label, weight] + ([bias] if bias is not None else [])
     return apply_op("hsigmoid_loss", fn, *args)
+
+
+def dice_loss(input, label, epsilon=0.00001, name=None):
+    """Dice loss over sigmoid/softmax predictions vs integer labels
+    (reference nn/functional/loss.py:39): 1 - 2*intersection/total, averaged
+    over the batch."""
+    if len(input.shape) < 2 or len(input.shape) != len(label.shape):
+        raise ValueError(
+            "dice_loss: input rank must be >= 2 and match label rank, got "
+            f"{len(input.shape)} vs {len(label.shape)}")
+    if label.shape[-1] != 1:
+        raise ValueError("dice_loss: label's last dim must be 1")
+    n_classes = int(input.shape[-1])
+    axes = tuple(range(1, len(input.shape)))
+
+    def fn(p, y):
+        onehot = jax.nn.one_hot(jnp.squeeze(y, -1), n_classes, dtype=p.dtype)
+        inter = jnp.sum(p * onehot, axis=axes)
+        denom = jnp.sum(p, axis=axes) + jnp.sum(onehot, axis=axes)
+        return jnp.mean(1 - 2 * inter / (denom + epsilon))
+
+    return apply_op("dice_loss", fn, input, label)
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
+    """N-pair loss (reference nn/functional/loss.py:305): softmax CE over
+    anchor@positive.T similarities with label-equality targets, plus an L2
+    term on the embeddings."""
+    def fn(a, p, y):
+        n = y.shape[0]
+        eq = (y[:, None] == y[None, :]).astype(a.dtype)
+        targets = eq / jnp.sum(eq, axis=1, keepdims=True)
+        l2 = (jnp.mean(jnp.sum(jnp.square(a), 1))
+              + jnp.mean(jnp.sum(jnp.square(p), 1))) * l2_reg * 0.25
+        sim = a @ p.T
+        logp = jax.nn.log_softmax(sim, axis=1)
+        ce = jnp.mean(jnp.sum(-targets * logp, axis=1))
+        return ce + l2
+
+    return apply_op("npair_loss", fn, anchor, positive, labels)

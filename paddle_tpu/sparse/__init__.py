@@ -22,6 +22,10 @@ from ..tensor.tensor import Tensor
 from . import nn
 from .binary import add, divide, masked_matmul, matmul, multiply, subtract
 from .unary import (
+    asin,
+    asinh,
+    atan,
+    atanh,
     abs,
     cast,
     deg2rad,
@@ -295,4 +299,123 @@ __all__ = [
     "masked_matmul", "relu", "relu6", "tanh", "sin", "sinh", "tan", "sqrt",
     "square", "abs", "pow", "neg", "log1p", "expm1", "deg2rad", "rad2deg",
     "cast", "softmax",
+]
+
+
+# --- round-5 module-level tail (reference python/paddle/sparse/__init__.py:
+# transpose/sum/reshape/slice/coalesce/is_same_shape/mv/addmm/pca_lowrank/
+# isnan) ---------------------------------------------------------------------
+from .unary import _unary as _sparse_unary
+
+isnan = _sparse_unary("isnan", jnp.isnan)
+
+
+def transpose(x, perm, name=None):
+    """Permute sparse dims (reference sparse/unary.py transpose)."""
+    return x.transpose(perm)
+
+
+def coalesce(x, name=None):
+    """Merge duplicate COO indices (reference sparse/unary.py coalesce)."""
+    return x.coalesce()
+
+
+def is_same_shape(x, y) -> bool:
+    return list(x.shape) == list(y.shape)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    """Sum of a sparse tensor's stored values along ``axis`` (reference
+    sparse/unary.py sum). axis=None returns a dense 0-D total; otherwise the
+    result is computed on the dense equivalent and re-sparsified, which on
+    XLA is the same segment-reduce the reference's kernel performs."""
+    import builtins
+
+    from ..tensor import math as _math
+
+    if axis is None:
+        total = _math.sum(x.values())
+        return total.astype(dtype) if dtype is not None else total
+    dense = x.to_dense()
+    out = _math.sum(dense, axis=axis, keepdim=keepdim)
+    if dtype is not None:
+        out = out.astype(dtype)
+    if x.is_sparse_coo:
+        return to_sparse_coo(out, builtins.max(out._data.ndim, 1))
+    return out
+
+
+def reshape(x, shape, name=None):
+    """Reshape a sparse COO tensor by recomputing linear indices host-side
+    (reference sparse/unary.py reshape)."""
+    import numpy as _np
+
+    old_shape = list(x.shape)
+    shape = list(shape)
+    n = int(_np.prod(old_shape))
+    if -1 in shape:
+        known = int(_np.prod([s for s in shape if s != -1]))
+        shape[shape.index(-1)] = n // known
+    idx = _np.asarray(x.indices().numpy())
+    linear = _np.ravel_multi_index(tuple(idx), tuple(old_shape))
+    new_idx = _np.stack(_np.unravel_index(linear, tuple(shape)))
+    return SparseCooTensor(Tensor(jnp.asarray(new_idx)), x.values(), shape,
+                           coalesced=False)
+
+
+def slice(x, axes, starts, ends, name=None):
+    """Slice a sparse COO tensor along ``axes`` (reference sparse slice):
+    keep stored entries inside the window, shift their indices."""
+    import numpy as _np
+
+    idx = _np.asarray(x.indices().numpy())
+    shape = list(x.shape)
+    keep = _np.ones(idx.shape[1], bool)
+    new_shape = list(shape)
+    offsets = _np.zeros(len(shape), _np.int64)
+    for a, st, en in zip(axes, starts, ends):
+        dim = shape[a]
+        st = st + dim if st < 0 else builtins_min(st, dim)
+        en = en + dim if en < 0 else builtins_min(en, dim)
+        keep &= (idx[a] >= st) & (idx[a] < en)
+        offsets[a] = st
+        new_shape[a] = en - st
+    sel = _np.nonzero(keep)[0]
+    new_idx = idx[:, sel] - offsets[:, None]
+    from ..tensor.manipulation import gather as _gather
+
+    vals = _gather(x.values(), Tensor(jnp.asarray(sel)), axis=0)
+    return SparseCooTensor(Tensor(jnp.asarray(new_idx)), vals, new_shape,
+                           coalesced=False)
+
+
+def builtins_min(a, b):
+    return a if a < b else b
+
+
+def mv(x, vec, name=None):
+    """Sparse matrix x dense vector (reference sparse/binary.py mv)."""
+    from ..tensor.manipulation import reshape as _reshape
+
+    return _reshape(matmul(x, _reshape(vec, [-1, 1])), [-1])
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """beta * input + alpha * (x @ y) with sparse ``x`` (reference
+    sparse/binary.py addmm)."""
+    return input * beta + matmul(x, y) * alpha
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """PCA of a sparse matrix via its dense equivalent (reference
+    sparse pca_lowrank; on TPU the randomized-SVD runs on the dense XLA
+    path — sparsity is a storage property here, not a compute path)."""
+    from ..tensor import linalg as _linalg
+
+    return _linalg.pca_lowrank(x.to_dense(), q=q, center=center, niter=niter)
+
+
+__all__ += [
+    "asin", "asinh", "atan", "atanh", "isnan", "transpose", "coalesce",
+    "is_same_shape", "sum", "reshape", "slice", "mv", "addmm", "pca_lowrank",
 ]

@@ -67,3 +67,45 @@ __all__ = [
     "serialize_persistables", "serialize_program", "set_program_state",
     "xpu_places",
 ]
+
+
+# --- IPU surface (reference static/__init__.py exports these; a reference
+# build without IPU support raises on use — identical behavior here, where
+# the accelerator is the TPU) ------------------------------------------------
+def _no_ipu(name):
+    raise RuntimeError(
+        f"paddle.static.{name} requires the IPU backend; this build targets "
+        "TPU (XLA). Same behavior as a reference build compiled without "
+        "IPU support.")
+
+
+def ipu_shard_guard(index=-1, stage=-1):
+    _no_ipu("ipu_shard_guard")
+
+
+def set_ipu_shard(call_func, index=-1, stage=-1):
+    _no_ipu("set_ipu_shard")
+
+
+class IpuStrategy:
+    def __init__(self, *a, **k):
+        _no_ipu("IpuStrategy")
+
+
+class IpuCompiledProgram:
+    def __init__(self, *a, **k):
+        _no_ipu("IpuCompiledProgram")
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None):
+    """CTR metric bundle (reference static/__init__ export; the metric
+    itself is parameter-server infra — SURVEY §7.4 exclusion). The
+    streaming AUC it feeds is served by paddle_tpu.metric.Auc."""
+    raise NotImplementedError(
+        "ctr_metric_bundle is parameter-server infrastructure (out of the "
+        "TPU build's scope; SURVEY §7.4). Use paddle_tpu.metric.Auc for "
+        "streaming AUC.")
+
+
+__all__ += ["ipu_shard_guard", "set_ipu_shard", "IpuStrategy",
+            "IpuCompiledProgram", "ctr_metric_bundle"]

@@ -93,6 +93,17 @@ class ViterbiDecoder(Layer):
                               self.include_bos_eos_tag)
 
 
-__all__ = ["viterbi_decode", "ViterbiDecoder", "datasets"]
+__all__ = ["viterbi_decode", "ViterbiDecoder", "datasets",
+           "Conll05st", "Imdb", "Imikolov", "Movielens", "UCIHousing",
+           "WMT14", "WMT16"]
 
 from .tokenizer import BertTokenizer, FasterTokenizer, faster_tokenizer  # noqa: F401,E402
+from .datasets import (  # noqa: F401,E402  top-level reference spellings
+    Conll05st,
+    Imdb,
+    Imikolov,
+    Movielens,
+    UCIHousing,
+    WMT14,
+    WMT16,
+)

@@ -141,3 +141,257 @@ class Imikolov(Dataset):
 
 
 __all__ = ["UCIHousing", "Imdb", "Imikolov", "DownloadUnavailable"]
+
+
+class Movielens(Dataset):
+    """MovieLens-1M ratings (reference text/datasets/movielens.py): yields
+    (user_id, gender_id, age_id, job_id, movie_id, category_ids, title_ids,
+    rating) per rating row, parsed from the ml-1m archive's
+    users/movies/ratings .dat files."""
+
+    AGES = [1, 18, 25, 35, 45, 50, 56]
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0, download=False):
+        import zipfile
+
+        if data_file is None or not os.path.exists(data_file):
+            raise DownloadUnavailable("Movielens", "ml-1m.zip")
+        users, movies, ratings = {}, {}, []
+        categories, title_vocab = {}, {}
+        with zipfile.ZipFile(data_file) as zf:
+            base = next(n for n in zf.namelist() if n.endswith("users.dat"))
+            root = base[: -len("users.dat")]
+            with zf.open(root + "users.dat") as f:
+                for line in f.read().decode("latin1").splitlines():
+                    uid, gender, age, job, _ = line.strip().split("::")
+                    users[int(uid)] = (0 if gender == "M" else 1,
+                                       self.AGES.index(int(age)), int(job))
+            with zf.open(root + "movies.dat") as f:
+                for line in f.read().decode("latin1").splitlines():
+                    mid, title, cats = line.strip().split("::")
+                    cat_ids = []
+                    for c in cats.split("|"):
+                        cat_ids.append(categories.setdefault(c, len(categories)))
+                    tit_ids = []
+                    for w in title.lower().split():
+                        tit_ids.append(title_vocab.setdefault(w, len(title_vocab)))
+                    movies[int(mid)] = (cat_ids, tit_ids)
+            with zf.open(root + "ratings.dat") as f:
+                for line in f.read().decode("latin1").splitlines():
+                    uid, mid, rating, _ = line.strip().split("::")
+                    uid, mid = int(uid), int(mid)
+                    if uid in users and mid in movies:
+                        ratings.append((uid, mid, float(rating)))
+        rng = np.random.RandomState(rand_seed)
+        mask = rng.rand(len(ratings)) < (1.0 - test_ratio)
+        keep = mask if mode == "train" else ~mask
+        self._rows = [r for r, k in zip(ratings, keep) if k]
+        self._users, self._movies = users, movies
+        self.categories_dict, self.movie_title_dict = categories, title_vocab
+
+    def __getitem__(self, idx):
+        uid, mid, rating = self._rows[idx]
+        gender, age, job = self._users[uid]
+        cats, title = self._movies[mid]
+        return (np.int64(uid), np.int64(gender), np.int64(age),
+                np.int64(job), np.int64(mid),
+                np.asarray(cats, np.int64), np.asarray(title, np.int64),
+                np.float32(rating))
+
+    def __len__(self):
+        return len(self._rows)
+
+
+class _ParallelCorpus(Dataset):
+    """Shared WMT14/WMT16 machinery: parallel src/trg lines from a tarball,
+    frequency-cut vocabularies with <s>/<e>/<unk> reserved ids 0/1/2, yields
+    (src_ids, trg_ids[:-1], trg_ids[1:]) (reference text/datasets/wmt14.py
+    contract)."""
+
+    BOS, EOS, UNK = 0, 1, 2
+
+    def __init__(self, data_file, members, dict_size, name, url_hint):
+        if data_file is None or not os.path.exists(data_file):
+            raise DownloadUnavailable(name, url_hint)
+        src_lines, trg_lines = self._read_pairs(data_file, members)
+        self.src_dict = self._build_dict(src_lines, dict_size)
+        self.trg_dict = self._build_dict(trg_lines, dict_size)
+        self.data = []
+        for s, t in zip(src_lines, trg_lines):
+            sid = [self.src_dict.get(w, self.UNK) for w in s.split()]
+            tid = ([self.BOS]
+                   + [self.trg_dict.get(w, self.UNK) for w in t.split()]
+                   + [self.EOS])
+            if sid and len(tid) > 2:
+                self.data.append((np.asarray(sid, np.int64),
+                                  np.asarray(tid[:-1], np.int64),
+                                  np.asarray(tid[1:], np.int64)))
+
+    @staticmethod
+    def _read_pairs(data_file, members):
+        src_lines, trg_lines = [], []
+        with tarfile.open(data_file) as tf:
+            names = tf.getnames()
+            src_m = next((n for n in names if n.endswith(members[0])), None)
+            trg_m = next((n for n in names if n.endswith(members[1])), None)
+            if src_m is None or trg_m is None:
+                raise ValueError(
+                    f"archive lacks parallel members {members}; has {names[:8]}")
+            with tf.extractfile(src_m) as f:
+                src_lines = f.read().decode("utf-8", "replace").splitlines()
+            with tf.extractfile(trg_m) as f:
+                trg_lines = f.read().decode("utf-8", "replace").splitlines()
+        return src_lines, trg_lines
+
+    def _build_dict(self, lines, dict_size):
+        freq: dict[str, int] = {}
+        for line in lines:
+            for w in line.split():
+                freq[w] = freq.get(w, 0) + 1
+        ranked = sorted(freq.items(), key=lambda kv: (-kv[1], kv[0]))
+        vocab = {"<s>": self.BOS, "<e>": self.EOS, "<unk>": self.UNK}
+        for w, _ in ranked[: max(dict_size - 3, 0)]:
+            vocab.setdefault(w, len(vocab))
+        return vocab
+
+    def __getitem__(self, idx):
+        return self.data[idx]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class WMT14(_ParallelCorpus):
+    """WMT14 en->fr (reference text/datasets/wmt14.py)."""
+
+    def __init__(self, data_file=None, mode="train", dict_size=30000,
+                 download=False):
+        part = {"train": "train", "test": "test", "gen": "gen"}[mode]
+        super().__init__(data_file, (f"{part}.en", f"{part}.fr"),
+                         dict_size, "WMT14", "wmt14 parallel corpus tarball")
+
+
+class WMT16(_ParallelCorpus):
+    """WMT16 en<->de with selectable language direction (reference
+    text/datasets/wmt16.py)."""
+
+    def __init__(self, data_file=None, mode="train", src_dict_size=30000,
+                 trg_dict_size=30000, lang="en", download=False):
+        part = {"train": "train", "test": "test", "val": "val"}[mode]
+        other = "de" if lang == "en" else "en"
+        self._sizes = (src_dict_size, trg_dict_size)
+        super().__init__(data_file, (f"{part}.{lang}", f"{part}.{other}"),
+                         max(src_dict_size, trg_dict_size), "WMT16",
+                         "wmt16 en-de tarball")
+
+
+class Conll05st(Dataset):
+    """CoNLL-2005 semantic-role-labeling dataset (reference
+    text/datasets/conll05.py): per (sentence, predicate) pair yields the
+    word/context/mark feature ids + the BIO label ids. The archive must
+    contain the words file and the props file (one token per line, blank
+    line between sentences — the release's test.wsj layout)."""
+
+    def __init__(self, data_file=None, word_dict_file=None,
+                 verb_dict_file=None, target_dict_file=None, emb_file=None,
+                 mode="test", download=False):
+        if data_file is None or not os.path.exists(data_file):
+            raise DownloadUnavailable(
+                "Conll05st", "conll05st-tests.tar.gz (words + props files)")
+        words_txt, props_txt = self._extract(data_file)
+        sentences = self._split_blank(words_txt)
+        props = self._split_blank(props_txt)
+        self.word_dict = self._vocab(w for s in sentences for w in s)
+        samples = []
+        for sent, prop in zip(sentences, props):
+            cols = [p.split() for p in prop]
+            if not cols:
+                continue
+            n_preds = len(cols[0]) - 1
+            preds = [c[0] for c in cols]
+            for k in range(n_preds):
+                tags = self._bio([c[1 + k] for c in cols])
+                verb_idx = next((i for i, p in enumerate(preds)
+                                 if p != "-"), 0)
+                samples.append((sent, verb_idx, tags))
+        self.verb_dict = self._vocab(s[0][s[1]] for s in samples)
+        self.label_dict = self._vocab(t for s in samples for t in s[2])
+        self._samples = samples
+
+    @staticmethod
+    def _extract(data_file):
+        with tarfile.open(data_file) as tf:
+            names = tf.getnames()
+            wname = next((n for n in names if "words" in n), None)
+            pname = next((n for n in names if "props" in n), None)
+            if wname is None or pname is None:
+                raise ValueError(
+                    f"archive lacks words/props members; has {names[:8]}")
+
+            def read(n):
+                with tf.extractfile(n) as f:
+                    data = f.read()
+                if n.endswith(".gz"):
+                    import gzip
+
+                    data = gzip.decompress(data)
+                return data.decode("utf-8", "replace")
+
+            return read(wname), read(pname)
+
+    @staticmethod
+    def _split_blank(text):
+        groups, cur = [], []
+        for line in text.splitlines():
+            if line.strip():
+                cur.append(line.strip())
+            elif cur:
+                groups.append(cur)
+                cur = []
+        if cur:
+            groups.append(cur)
+        return groups
+
+    @staticmethod
+    def _vocab(tokens):
+        vocab: dict[str, int] = {}
+        for t in tokens:
+            vocab.setdefault(t, len(vocab))
+        return vocab
+
+    @staticmethod
+    def _bio(col):
+        """Expand the CoNLL star-bracket spans into B-/I-/O tags."""
+        tags, cur = [], None
+        for tok in col:
+            if tok.startswith("("):
+                cur = tok.strip("()*")
+                tags.append(f"B-{cur}")
+            elif cur is not None:
+                tags.append(f"I-{cur}")
+            else:
+                tags.append("O")
+            if tok.endswith(")"):
+                cur = None
+        return tags
+
+    def __getitem__(self, idx):
+        sent, verb_idx, tags = self._samples[idx]
+        unk = len(self.word_dict)
+        word_ids = np.asarray(
+            [self.word_dict.get(w, unk) for w in sent], np.int64)
+        mark = np.zeros(len(sent), np.int64)
+        mark[verb_idx] = 1
+        verb_id = np.int64(self.verb_dict.get(sent[verb_idx], 0))
+        labels = np.asarray([self.label_dict[t] for t in tags], np.int64)
+        return word_ids, verb_id, mark, labels
+
+    def __len__(self):
+        return len(self._samples)
+
+    def get_dict(self):
+        return self.word_dict, self.verb_dict, self.label_dict
+
+
+__all__ += ["Movielens", "WMT14", "WMT16", "Conll05st"]

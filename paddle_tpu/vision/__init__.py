@@ -14,3 +14,43 @@ __all__ = [
     "resnet101",
     "resnet152",
 ]
+
+
+# --- image backend knobs (reference vision/image.py) ------------------------
+_IMAGE_BACKEND = ["pil"]
+
+
+def set_image_backend(backend):
+    """Select the dataset image-decoding backend (reference vision/image.py
+    set_image_backend). This build decodes through numpy ('cv2'-style HWC
+    arrays); both names are accepted, PIL objects are coerced on use."""
+    if backend not in ("pil", "cv2", "tensor"):
+        raise ValueError(
+            f"Invalid backend: {backend!r}. Expected 'pil', 'cv2' or 'tensor'")
+    _IMAGE_BACKEND[0] = backend
+
+
+def get_image_backend():
+    return _IMAGE_BACKEND[0]
+
+
+def image_load(path, backend=None):
+    """Load an image file (reference vision/image.py image_load): a PIL
+    Image for the 'pil' backend, an HWC uint8 ndarray for 'cv2', a CHW
+    uint8 Tensor for 'tensor'."""
+    import numpy as np
+
+    from PIL import Image
+
+    backend = backend or _IMAGE_BACKEND[0]
+    img = Image.open(path)
+    if backend == "pil":
+        return img
+    arr = np.asarray(img)
+    if backend == "cv2":
+        return arr if arr.ndim == 3 else arr[:, :, None]
+    from .ops import Tensor  # tensor backend: CHW like decode_jpeg
+    import jax.numpy as jnp
+
+    chw = arr.transpose(2, 0, 1) if arr.ndim == 3 else arr[None]
+    return Tensor(jnp.asarray(chw))

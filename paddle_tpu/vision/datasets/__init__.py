@@ -221,4 +221,114 @@ class ImageFolder(Dataset):
 
 
 __all__ = ["FakeData", "Cifar10", "Cifar100", "MNIST", "FashionMNIST",
-           "DatasetFolder", "ImageFolder"]
+           "DatasetFolder", "ImageFolder", "Flowers", "VOC2012"]
+
+
+class Flowers(Dataset):
+    """Flowers102 (reference vision/datasets/flowers.py:41): images tarball
+    + imagelabels.mat + setid.mat; train/valid/test index splits.
+
+    Zero-egress build: all three files must be given locally (the reference
+    downloads them)."""
+
+    _SPLIT_KEY = {"train": "trnid", "valid": "valid", "test": "tstid"}
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=False, backend=None):
+        import tarfile
+
+        for path, what in ((data_file, "102flowers.tgz"),
+                           (label_file, "imagelabels.mat"),
+                           (setid_file, "setid.mat")):
+            if path is None or not os.path.exists(path):
+                raise RuntimeError(
+                    f"Flowers needs a local {what} (no network egress in "
+                    "this build; the reference downloads it)")
+        from scipy.io import loadmat
+
+        self.labels = loadmat(label_file)["labels"][0]  # 1-based per image
+        setid = loadmat(setid_file)
+        self.indexes = setid[self._SPLIT_KEY[mode]][0]  # 1-based image ids
+        self.transform = transform
+        self.backend = backend or "cv2"
+        self._tar = tarfile.open(data_file)
+        self._members = {os.path.basename(n): n
+                         for n in self._tar.getnames()
+                         if n.endswith(".jpg")}
+
+    def __getitem__(self, idx):
+        import io as _io
+
+        from PIL import Image
+
+        img_id = int(self.indexes[idx])
+        name = f"image_{img_id:05d}.jpg"
+        with self._tar.extractfile(self._members[name]) as f:
+            img = Image.open(_io.BytesIO(f.read()))
+            img.load()
+        label = np.array([int(self.labels[img_id - 1])], np.int64)
+        out = img if self.backend == "pil" else np.asarray(img)
+        if self.transform is not None:
+            out = self.transform(out)
+        return out, label
+
+    def __len__(self):
+        return len(self.indexes)
+
+
+class VOC2012(Dataset):
+    """Pascal VOC2012 segmentation pairs (reference
+    vision/datasets/voc2012.py:39): (image, label-mask) over the
+    ImageSets/Segmentation split lists inside the VOCtrainval tarball."""
+
+    _SPLIT_FILE = {"train": "train.txt", "valid": "val.txt",
+                   "test": "val.txt"}
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        import tarfile
+
+        if data_file is None or not os.path.exists(data_file):
+            raise RuntimeError(
+                "VOC2012 needs a local VOCtrainval tarball (no network "
+                "egress in this build; the reference downloads it)")
+        self.transform = transform
+        self.backend = backend or "cv2"
+        self._tar = tarfile.open(data_file)
+        names = self._tar.getnames()
+        split_suffix = ("ImageSets/Segmentation/"
+                        + self._SPLIT_FILE[mode])
+        split_member = next((n for n in names if n.endswith(split_suffix)),
+                            None)
+        if split_member is None:
+            raise ValueError(f"archive lacks {split_suffix}")
+        with self._tar.extractfile(split_member) as f:
+            ids = [l.strip() for l in f.read().decode().splitlines()
+                   if l.strip()]
+        self._jpeg = {os.path.basename(n)[:-4]: n for n in names
+                      if n.endswith(".jpg")}
+        self._png = {os.path.basename(n)[:-4]: n for n in names
+                     if n.endswith(".png") and "SegmentationClass" in n}
+        self.ids = [i for i in ids if i in self._jpeg and i in self._png]
+
+    def _read(self, member):
+        import io as _io
+
+        from PIL import Image
+
+        with self._tar.extractfile(member) as f:
+            img = Image.open(_io.BytesIO(f.read()))
+            img.load()
+        return img
+
+    def __getitem__(self, idx):
+        img = self._read(self._jpeg[self.ids[idx]])
+        mask = self._read(self._png[self.ids[idx]])
+        if self.backend != "pil":
+            img, mask = np.asarray(img), np.asarray(mask)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, mask
+
+    def __len__(self):
+        return len(self.ids)

@@ -1,0 +1,469 @@
+"""Geometric + photometric transforms over numpy HWC images — the tail of
+the reference's transform set (python/paddle/vision/transforms/transforms.py:
+RandomResizedCrop, ColorJitter family, affine/rotate/perspective, Grayscale,
+RandomErasing; functional.py: hflip/vflip/crop/center_crop/pad/adjust_*/
+rotate/affine/perspective/to_grayscale/erase)."""
+from __future__ import annotations
+
+import math
+import numbers
+import random
+
+import numpy as np
+
+from .transforms import BaseTransform, _as_hwc, resize
+from .transforms import Pad as _PadTransform
+
+_LUMA = np.array([0.299, 0.587, 0.114], np.float32)
+
+
+# --- functional: flips / crops / pad ---------------------------------------
+def hflip(img):
+    """Horizontal flip (reference functional.py hflip)."""
+    return _as_hwc(img)[:, ::-1]
+
+
+def vflip(img):
+    """Vertical flip (reference functional.py vflip)."""
+    return _as_hwc(img)[::-1]
+
+
+def crop(img, top, left, height, width):
+    return _as_hwc(img)[top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    arr = _as_hwc(img)
+    if isinstance(output_size, numbers.Number):
+        output_size = (int(output_size), int(output_size))
+    oh, ow = output_size
+    h, w = arr.shape[:2]
+    return crop(arr, max((h - oh) // 2, 0), max((w - ow) // 2, 0), oh, ow)
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    """Functional spelling of the Pad transform (reference functional.py
+    pad)."""
+    return _PadTransform(padding, fill, padding_mode)(img)
+
+
+# --- functional: photometric ------------------------------------------------
+def _blend(a, b, factor):
+    out = a.astype(np.float32) * factor + b.astype(np.float32) * (1 - factor)
+    return _like(out, a)
+
+
+def _like(out, ref):
+    if np.issubdtype(np.asarray(ref).dtype, np.integer):
+        return np.clip(out, 0, 255).astype(np.asarray(ref).dtype)
+    return out
+
+
+def adjust_brightness(img, brightness_factor):
+    arr = _as_hwc(img)
+    return _blend(arr, np.zeros_like(arr), brightness_factor)
+
+
+def adjust_contrast(img, contrast_factor):
+    arr = _as_hwc(img)
+    mean = (arr.astype(np.float32) @ _LUMA[: arr.shape[2]]).mean() \
+        if arr.shape[2] == 3 else arr.astype(np.float32).mean()
+    return _blend(arr, np.full_like(arr, mean, dtype=np.float32), contrast_factor)
+
+
+def adjust_saturation(img, saturation_factor):
+    arr = _as_hwc(img)
+    gray = (arr.astype(np.float32) @ _LUMA[: arr.shape[2]])[:, :, None]
+    return _blend(arr, np.broadcast_to(gray, arr.shape), saturation_factor)
+
+
+def adjust_hue(img, hue_factor):
+    """Shift hue by ``hue_factor`` (in [-0.5, 0.5] turns) via RGB->HSV->RGB
+    (reference functional.py adjust_hue)."""
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError(f"hue_factor {hue_factor} is not in [-0.5, 0.5]")
+    arr = _as_hwc(img)
+    scale = 255.0 if np.issubdtype(arr.dtype, np.integer) else 1.0
+    rgb = arr.astype(np.float32) / scale
+    r, g, b = rgb[..., 0], rgb[..., 1], rgb[..., 2]
+    maxc = rgb.max(-1)
+    minc = rgb.min(-1)
+    v = maxc
+    delta = maxc - minc
+    s = np.where(maxc > 0, delta / np.maximum(maxc, 1e-12), 0.0)
+    dz = np.maximum(delta, 1e-12)
+    h = np.where(maxc == r, (g - b) / dz % 6,
+                 np.where(maxc == g, (b - r) / dz + 2, (r - g) / dz + 4)) / 6
+    h = np.where(delta == 0, 0.0, h)
+    h = (h + hue_factor) % 1.0
+    i = np.floor(h * 6)
+    f = h * 6 - i
+    p, q, t = v * (1 - s), v * (1 - s * f), v * (1 - s * (1 - f))
+    i = (i.astype(np.int32) % 6)[..., None]
+    out = np.select(
+        [i == 0, i == 1, i == 2, i == 3, i == 4, i == 5],
+        [np.stack([v, t, p], -1), np.stack([q, v, p], -1),
+         np.stack([p, v, t], -1), np.stack([p, q, v], -1),
+         np.stack([t, p, v], -1), np.stack([v, p, q], -1)])
+    return _like(out * scale, arr)
+
+
+def to_grayscale(img, num_output_channels=1):
+    arr = _as_hwc(img)
+    gray = arr.astype(np.float32) @ _LUMA[: arr.shape[2]] \
+        if arr.shape[2] == 3 else arr.astype(np.float32)[..., 0]
+    out = np.repeat(gray[:, :, None], num_output_channels, axis=2)
+    return _like(out, arr)
+
+
+# --- functional: geometric (inverse-mapped affine sampling) ----------------
+def _inverse_sample(arr, inv, out_h, out_w, interpolation, fill):
+    """Sample arr at inv @ [x_out, y_out, 1] (pixel-center coords)."""
+    ys, xs = np.meshgrid(np.arange(out_h), np.arange(out_w), indexing="ij")
+    ones = np.ones_like(xs)
+    coords = np.stack([xs, ys, ones], 0).reshape(3, -1).astype(np.float64)
+    src = inv @ coords
+    sx = src[0] / src[2]
+    sy = src[1] / src[2]
+    h, w = arr.shape[:2]
+    a = arr.astype(np.float32)
+    fill_px = np.broadcast_to(
+        np.asarray(fill, np.float32), (arr.shape[2],))
+
+    def sample_nearest(sx, sy):
+        xi = np.round(sx).astype(np.int64)
+        yi = np.round(sy).astype(np.int64)
+        valid = (xi >= 0) & (xi < w) & (yi >= 0) & (yi < h)
+        out = np.empty((sx.size, arr.shape[2]), np.float32)
+        out[:] = fill_px
+        out[valid] = a[yi[valid], xi[valid]]
+        return out
+
+    if interpolation == "nearest":
+        out = sample_nearest(sx, sy)
+    else:  # bilinear with fill outside
+        x0 = np.floor(sx).astype(np.int64)
+        y0 = np.floor(sy).astype(np.int64)
+        wx = (sx - x0).astype(np.float32)[:, None]
+        wy = (sy - y0).astype(np.float32)[:, None]
+
+        def at(yi, xi):
+            valid = (xi >= 0) & (xi < w) & (yi >= 0) & (yi < h)
+            px = np.empty((xi.size, arr.shape[2]), np.float32)
+            px[:] = fill_px
+            px[valid] = a[yi[valid], xi[valid]]
+            return px
+
+        out = (at(y0, x0) * (1 - wx) * (1 - wy) + at(y0, x0 + 1) * wx * (1 - wy)
+               + at(y0 + 1, x0) * (1 - wx) * wy + at(y0 + 1, x0 + 1) * wx * wy)
+    out = out.reshape(out_h, out_w, arr.shape[2])
+    return _like(out, arr)
+
+
+def _affine_matrix(angle, translate, scale, shear, center):
+    rot = math.radians(angle)
+    sx, sy = (math.radians(s) for s in shear)
+    cx, cy = center
+    # M = T(center) @ T(translate) @ R(angle) @ Shear @ Scale @ T(-center)
+    a = math.cos(rot - sy) / math.cos(sy)
+    b = -math.cos(rot - sy) * math.tan(sx) / math.cos(sy) - math.sin(rot)
+    c = math.sin(rot - sy) / math.cos(sy)
+    d = -math.sin(rot - sy) * math.tan(sx) / math.cos(sy) + math.cos(rot)
+    m = np.array([[a * scale, b * scale, 0.0],
+                  [c * scale, d * scale, 0.0],
+                  [0.0, 0.0, 1.0]], np.float64)
+    m[0, 2] = cx + translate[0] - (m[0, 0] * cx + m[0, 1] * cy)
+    m[1, 2] = cy + translate[1] - (m[1, 0] * cx + m[1, 1] * cy)
+    return m
+
+
+def affine(img, angle, translate, scale, shear, interpolation="nearest",
+           fill=0, center=None):
+    """Affine transform (reference functional.py affine)."""
+    arr = _as_hwc(img)
+    h, w = arr.shape[:2]
+    if isinstance(shear, numbers.Number):
+        shear = (shear, 0.0)
+    if center is None:
+        center = ((w - 1) * 0.5, (h - 1) * 0.5)
+    # positive angle = counter-clockwise (PIL/reference convention); in
+    # y-down image coordinates that is a negative math-convention rotation
+    m = _affine_matrix(-angle, translate, scale, shear, center)
+    return _inverse_sample(arr, np.linalg.inv(m), h, w, interpolation, fill)
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    """Rotate counter-clockwise by ``angle`` degrees (reference
+    functional.py rotate)."""
+    arr = _as_hwc(img)
+    h, w = arr.shape[:2]
+    if center is None:
+        center = ((w - 1) * 0.5, (h - 1) * 0.5)
+    m = _affine_matrix(-angle, (0, 0), 1.0, (0.0, 0.0), center)  # CCW, see affine
+    out_h, out_w = h, w
+    if expand:
+        corners = np.array([[0, 0, 1], [w - 1, 0, 1], [0, h - 1, 1],
+                            [w - 1, h - 1, 1]], np.float64).T
+        mapped = m @ corners
+        xs, ys = mapped[0], mapped[1]
+        out_w = int(math.ceil(xs.max() - xs.min() + 1))
+        out_h = int(math.ceil(ys.max() - ys.min() + 1))
+        shift = np.eye(3)
+        shift[0, 2] = -xs.min()
+        shift[1, 2] = -ys.min()
+        m = shift @ m
+    return _inverse_sample(arr, np.linalg.inv(m), out_h, out_w,
+                           interpolation, fill)
+
+
+def _homography(src_pts, dst_pts):
+    """3x3 perspective matrix mapping src -> dst (4 point pairs)."""
+    A, b = [], []
+    for (x, y), (u, v) in zip(src_pts, dst_pts):
+        A.append([x, y, 1, 0, 0, 0, -u * x, -u * y])
+        A.append([0, 0, 0, x, y, 1, -v * x, -v * y])
+        b += [u, v]
+    coef = np.linalg.solve(np.asarray(A, np.float64),
+                           np.asarray(b, np.float64))
+    return np.append(coef, 1.0).reshape(3, 3)
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest", fill=0):
+    """Perspective transform taking ``startpoints`` to ``endpoints``
+    (reference functional.py perspective)."""
+    arr = _as_hwc(img)
+    h, w = arr.shape[:2]
+    m = _homography(startpoints, endpoints)
+    return _inverse_sample(arr, np.linalg.inv(m), h, w, interpolation, fill)
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    """Erase the region at (i, j, h, w) with value ``v`` (reference
+    functional.py erase). Accepts HWC arrays or CHW tensors-as-arrays
+    (channel-count heuristic matches ToTensor's output)."""
+    from ...tensor.tensor import Tensor
+
+    is_tensor = isinstance(img, Tensor)
+    arr = np.array(img, copy=not inplace)
+    chw = arr.ndim == 3 and arr.shape[0] in (1, 3) and arr.shape[2] not in (1, 3)
+    if chw:
+        arr[:, i:i + h, j:j + w] = v
+    else:
+        arr[i:i + h, j:j + w] = v
+    if is_tensor:
+        return Tensor(arr)
+    return arr
+
+
+# --- class transforms -------------------------------------------------------
+class RandomResizedCrop(BaseTransform):
+    """Random area/aspect crop then resize (reference transforms.py
+    RandomResizedCrop)."""
+
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3.0 / 4, 4.0 / 3),
+                 interpolation="bilinear", keys=None):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.scale = scale
+        self.ratio = ratio
+        self.interpolation = interpolation
+
+    def __call__(self, img):
+        arr = _as_hwc(img)
+        h, w = arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = random.uniform(*self.scale) * area
+            log_ratio = (math.log(self.ratio[0]), math.log(self.ratio[1]))
+            ar = math.exp(random.uniform(*log_ratio))
+            cw = int(round(math.sqrt(target * ar)))
+            ch = int(round(math.sqrt(target / ar)))
+            if 0 < cw <= w and 0 < ch <= h:
+                top = random.randint(0, h - ch)
+                left = random.randint(0, w - cw)
+                return resize(crop(arr, top, left, ch, cw), self.size,
+                              self.interpolation)
+        return resize(center_crop(arr, min(h, w)), self.size,
+                      self.interpolation)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.value = float(value)
+
+    def __call__(self, img):
+        if self.value == 0:
+            return img
+        return adjust_brightness(img, random.uniform(max(0, 1 - self.value),
+                                                     1 + self.value))
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        if value < 0:
+            raise ValueError("contrast value should be non-negative")
+        self.value = float(value)
+
+    def __call__(self, img):
+        if self.value == 0:
+            return img
+        return adjust_contrast(img, random.uniform(max(0, 1 - self.value),
+                                                   1 + self.value))
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.value = float(value)
+
+    def __call__(self, img):
+        if self.value == 0:
+            return img
+        return adjust_saturation(img, random.uniform(max(0, 1 - self.value),
+                                                     1 + self.value))
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        if not 0 <= value <= 0.5:
+            raise ValueError("hue value should be in [0, 0.5]")
+        self.value = float(value)
+
+    def __call__(self, img):
+        if self.value == 0:
+            return img
+        return adjust_hue(img, random.uniform(-self.value, self.value))
+
+
+class ColorJitter(BaseTransform):
+    """Randomly-ordered brightness/contrast/saturation/hue jitter
+    (reference transforms.py ColorJitter)."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 keys=None):
+        self.ts = [BrightnessTransform(brightness),
+                   ContrastTransform(contrast),
+                   SaturationTransform(saturation), HueTransform(hue)]
+
+    def __call__(self, img):
+        order = list(range(4))
+        random.shuffle(order)
+        for i in order:
+            img = self.ts[i](img)
+        return img
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0, keys=None):
+        if isinstance(degrees, numbers.Number):
+            degrees = (-degrees, degrees)
+        self.degrees = degrees
+        self.interpolation = interpolation
+        self.expand = expand
+        self.center = center
+        self.fill = fill
+
+    def __call__(self, img):
+        angle = random.uniform(*self.degrees)
+        return rotate(img, angle, self.interpolation, self.expand,
+                      self.center, self.fill)
+
+
+class RandomAffine(BaseTransform):
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None, keys=None):
+        if isinstance(degrees, numbers.Number):
+            degrees = (-degrees, degrees)
+        self.degrees = degrees
+        self.translate = translate
+        self.scale = scale
+        self.shear = shear
+        self.interpolation = interpolation
+        self.fill = fill
+        self.center = center
+
+    def __call__(self, img):
+        arr = _as_hwc(img)
+        h, w = arr.shape[:2]
+        angle = random.uniform(*self.degrees)
+        tx = ty = 0.0
+        if self.translate is not None:
+            tx = random.uniform(-self.translate[0], self.translate[0]) * w
+            ty = random.uniform(-self.translate[1], self.translate[1]) * h
+        scale = random.uniform(*self.scale) if self.scale else 1.0
+        if self.shear is None:
+            shear = (0.0, 0.0)
+        elif isinstance(self.shear, numbers.Number):
+            shear = (random.uniform(-self.shear, self.shear), 0.0)
+        else:
+            lo, hi = self.shear[0], self.shear[1]
+            shear = (random.uniform(lo, hi), 0.0)
+            if len(self.shear) == 4:
+                shear = (shear[0], random.uniform(self.shear[2], self.shear[3]))
+        return affine(arr, angle, (tx, ty), scale, shear,
+                      self.interpolation, self.fill, self.center)
+
+
+class RandomPerspective(BaseTransform):
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0, keys=None):
+        self.prob = prob
+        self.distortion_scale = distortion_scale
+        self.interpolation = interpolation
+        self.fill = fill
+
+    def __call__(self, img):
+        if random.random() >= self.prob:
+            return img
+        arr = _as_hwc(img)
+        h, w = arr.shape[:2]
+        d = self.distortion_scale
+        half_h, half_w = int(h * d / 2), int(w * d / 2)
+        start = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
+        end = [(random.randint(0, half_w), random.randint(0, half_h)),
+               (w - 1 - random.randint(0, half_w), random.randint(0, half_h)),
+               (w - 1 - random.randint(0, half_w),
+                h - 1 - random.randint(0, half_h)),
+               (random.randint(0, half_w), h - 1 - random.randint(0, half_h))]
+        return perspective(arr, start, end, self.interpolation, self.fill)
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        self.num_output_channels = num_output_channels
+
+    def __call__(self, img):
+        return to_grayscale(img, self.num_output_channels)
+
+
+class RandomErasing(BaseTransform):
+    """Random cutout over CHW tensors or HWC arrays (reference
+    transforms.py RandomErasing)."""
+
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False, keys=None):
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+        self.inplace = inplace
+
+    def __call__(self, img):
+        if random.random() >= self.prob:
+            return img
+        arr = np.asarray(img)
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3) \
+            and arr.shape[2] not in (1, 3)
+        h, w = (arr.shape[1], arr.shape[2]) if chw else arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = random.uniform(*self.scale) * area
+            ar = math.exp(random.uniform(math.log(self.ratio[0]),
+                                         math.log(self.ratio[1])))
+            eh = int(round(math.sqrt(target * ar)))
+            ew = int(round(math.sqrt(target / ar)))
+            if eh < h and ew < w and eh > 0 and ew > 0:
+                i = random.randint(0, h - eh)
+                j = random.randint(0, w - ew)
+                return erase(img, i, j, eh, ew, self.value, self.inplace)
+        return img
