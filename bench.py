@@ -3,7 +3,12 @@
 Prints ONE JSON line (driver contract): the flagship GPT-760M fused train
 step. ``--all`` additionally benches the north-star-shaped secondary configs
 (BASELINE.md): GPT-125M, ResNet-50 eager (config 1), BERT-base via jit
-(config 2) — one JSON line each, flagship line last.
+(config 2) — one JSON line each, flagship line last. Every ``--all`` line
+also carries the in-era ideal-GEMM anchor (:func:`gemm_anchor`) so
+cross-era tunnel variance can be divided out of round-over-round deltas.
+``--fused-mlp`` flips the GPT configs onto the fused MLP-block Pallas
+kernels (ops/pallas/fused_mlp) — same metric names, same contract; run
+with and without for the kernel A/B.
 
 Methodology: the full fused train step (forward + backward + momentum-SGD
 update, bf16 weights / fp32 loss) compiled once; K steps chained in a single
@@ -39,7 +44,8 @@ def _chip_peak(jax, on_tpu):
 
 
 def bench_gpt(label, hidden, layers, heads, batch, seq, K, recompute,
-              on_tpu, donate=False, flash=True, save_attn=True):
+              on_tpu, donate=False, flash=True, save_attn=True,
+              fused_mlp=False):
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -55,6 +61,10 @@ def bench_gpt(label, hidden, layers, heads, batch, seq, K, recompute,
         vocab_size=50304, hidden_size=hidden, num_layers=layers,
         num_heads=heads, max_seq_len=seq, recompute=recompute,
         use_flash_attention=flash, remat_save_attn=save_attn,
+        # --fused-mlp A/B: same metric name, same driver contract — only the
+        # block's elementwise implementation flips (fused Pallas kernels vs
+        # XLA). Off-TPU the kernels need interpret mode forced.
+        fused_mlp=fused_mlp, force_fused_mlp=fused_mlp and not on_tpu,
     )
     if not on_tpu:
         batch, seq, K = 2, 128, 2
@@ -135,12 +145,58 @@ def bench_gpt(label, hidden, layers, heads, batch, seq, K, recompute,
     chip, peak = _chip_peak(jax, on_tpu)
     mfu = tps * flops_per_token / peak
     assert np.all(np.isfinite(first_losses)), "non-finite training loss"
-    return {
+    out = {
         "metric": f"{label} fused train step tokens/sec/chip "
                   f"(bs{batch} seq{seq}, {chip})",
         "value": round(tps, 1),
         "unit": "tokens/s",
         "vs_baseline": round(mfu, 4),
+    }
+    if fused_mlp:
+        out["fused_mlp"] = True
+    return out
+
+
+def gemm_anchor(on_tpu, n=4096, iters=24):
+    """In-era normalization anchor: a fixed-shape bf16 matmul chain timed
+    the same way as the benches (one compiled dispatch, lax.scan inside,
+    one sync). Emitted alongside every ``--all`` config's JSON so the
+    ±8% cross-era tunnel variance (VERDICT Weak #3) can be divided out:
+    a config move that tracks the anchor's move is era noise, not a
+    regression. Fixed probe = fixed FLOPs; ``anchor_frac_peak`` is the
+    era's achievable fraction of chip peak on ideal GEMM content."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    if not on_tpu:
+        n, iters = 256, 2
+    dtype = jnp.bfloat16 if on_tpu else jnp.float32
+    rng = np.random.RandomState(0)
+    a = jnp.asarray(rng.randn(n, n) * 0.02, dtype)
+    b = jnp.asarray(rng.randn(n, n) * 0.02, dtype)
+
+    def chain(a, b):
+        # data-dependent chain: no two matmuls can run concurrently and
+        # none can be DCE'd; 0.02 scale keeps bf16 values finite
+        def body(c, _):
+            return a @ c, None
+
+        c, _ = lax.scan(body, b, None, length=iters)
+        return c
+
+    with jax.default_matmul_precision("default"):
+        f = jax.jit(chain)
+        f(a, b).block_until_ready()  # compile + warmup
+        t0 = time.perf_counter()
+        f(a, b).block_until_ready()
+        elapsed = time.perf_counter() - t0
+    flops = 2 * n ** 3 * iters
+    chip, peak = _chip_peak(jax, on_tpu)
+    return {
+        "anchor_gemm": f"{n}x{n}x{n}x{iters} {jnp.dtype(dtype).name} ({chip})",
+        "anchor_tflops": round(flops / elapsed / 1e12, 2),
+        "anchor_frac_peak": round(flops / elapsed / peak, 4),
     }
 
 
@@ -432,22 +488,36 @@ def main():
     jax.config.update("jax_enable_x64", False)
 
     on_tpu = jax.devices()[0].platform == "tpu"
+    fused_mlp = "--fused-mlp" in sys.argv
+
+    # In-era anchor: measured ONCE per --all run, merged into every line so
+    # each config's JSON carries the era's ideal-GEMM throughput next to it.
+    anchor = None
+    if "--all" in sys.argv or "--anchor" in sys.argv:
+        try:
+            anchor = gemm_anchor(on_tpu)
+        except Exception as e:
+            anchor = {"anchor_error": f"{type(e).__name__}: {e}"[:120]}
+
+    def emit(d):
+        print(json.dumps({**d, **anchor} if anchor else d))
 
     if "--all" in sys.argv:
-        print(json.dumps(bench_gpt("gpt3-125m", 768, 12, 12, 8, 1024, 20,
-                                   False, on_tpu)))
-        print(json.dumps(bench_resnet_eager(on_tpu)))
-        print(json.dumps(bench_resnet_jit(on_tpu)))
-        print(json.dumps(bench_bert_jit(on_tpu)))
+        emit(bench_gpt("gpt3-125m", 768, 12, 12, 8, 1024, 20,
+                       False, on_tpu, fused_mlp=fused_mlp))
+        emit(bench_resnet_eager(on_tpu))
+        emit(bench_resnet_jit(on_tpu))
+        emit(bench_bert_jit(on_tpu))
         try:
             # BASELINE config 3 (single-chip line): donation halves resident
             # state so 1.3B + momentum fits 16 GB; ZeRO/DP scaling of this
             # config is exercised on the virtual mesh (dryrun_multichip)
             # save_attn=False: the memory-edge config keeps its proven-fit
             # footprint (the attention re-forward costs less than an OOM)
-            print(json.dumps(bench_gpt("gpt3-1.3b(+remat,donated)", 2048, 24,
-                                       16, 4, 1024, 5, True, on_tpu,
-                                       donate=True, save_attn=False)))
+            emit(bench_gpt("gpt3-1.3b(+remat,donated)", 2048, 24,
+                           16, 4, 1024, 5, True, on_tpu,
+                           donate=True, save_attn=False,
+                           fused_mlp=fused_mlp))
         except Exception as e:  # OOM must not kill the flagship line below
             print(_error_line(f"{type(e).__name__}: {e}",
                               metric="gpt3-1.3b tokens/sec/chip"))
@@ -475,12 +545,21 @@ def main():
         import subprocess
 
         for mode in ("False", "mom", "True"):
-            proc = subprocess.run(
-                [sys.executable, "-u", os.path.abspath(__file__),
-                 f"--exp13b-one={mode}"],
-                stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
-                timeout=900, env=dict(os.environ, _BENCH_CHILD="1"),
-            )
+            try:
+                proc = subprocess.run(
+                    [sys.executable, "-u", os.path.abspath(__file__),
+                     f"--exp13b-one={mode}"],
+                    stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+                    text=True, timeout=900,
+                    env=dict(os.environ, _BENCH_CHILD="1"),
+                )
+            except subprocess.TimeoutExpired:
+                # one hung mode (dead tunnel mid-sweep) must not abort the
+                # remaining modes — mirror _run_shielded's structured line
+                print(_error_line(
+                    "backend_unavailable: exp13b child timed out "
+                    "(tunnel hang)", metric=f"gpt3-1.3b(donate={mode})"))
+                continue
             out = proc.stdout.strip()
             print(out if out else _error_line(
                 f"exp13b child rc={proc.returncode}",
@@ -495,7 +574,7 @@ def main():
     out = err = None
     try:
         out = bench_gpt("gpt3-760m(+remat)", 1536, 24, 12, 8, 1024,
-                        10, True, on_tpu)
+                        10, True, on_tpu, fused_mlp=fused_mlp)
     except Exception as e:
         err = f"{type(e).__name__}: {e}"[:200]
         # drop the traceback's frame refs NOW: while a handler runs, the
@@ -507,9 +586,10 @@ def main():
 
         gc.collect()
         out = bench_gpt("gpt3-760m(+remat,reforward)", 1536, 24, 12, 8,
-                        1024, 10, True, on_tpu, save_attn=False)
+                        1024, 10, True, on_tpu, save_attn=False,
+                        fused_mlp=fused_mlp)
         out["save_attn_error"] = err
-    print(json.dumps(out))
+    emit(out)
 
 
 if __name__ == "__main__":

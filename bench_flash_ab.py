@@ -1,0 +1,222 @@
+"""Kernel A/B harness: this repo's Pallas flash attention vs the canonical
+TPU alternatives, at the flagship attention shape.
+
+Reproduces BASELINE.md's three-way table (b8 h12 s1024 d128 bf16 causal,
+fwd+bwd, ms/layer, one era — the 2.4x headline):
+
+- ``ours``       — paddle_tpu/ops/pallas/flash_attention (fused bwd kernel,
+                   persisted block autotune)
+- ``jax-flash``  — jax.experimental.pallas.ops.tpu.flash_attention (the
+                   reference TPU flash kernel)
+- ``jax-splash`` — jax splash attention (production long-context kernel)
+- ``xla-sdpa``   — jax.nn.dot_product_attention (XLA fused attention,
+                   materialized scores)
+
+Methodology (same contract as bench.py): each implementation runs
+``--iters`` chained fwd+bwd layers inside ONE compiled dispatch (lax.scan;
+the carry perturbs q/k/v by their grads so no iteration can be DCE'd or
+overlapped), one device->host sync; ms/layer = elapsed / iters. All four
+see identical inputs. Output: one JSON line per implementation plus a
+summary line with the ours-vs-jax-flash speedup — append to BASELINE.md's
+evidence, or diff across eras next to bench.py's gemm anchor.
+
+Off-TPU every implementation (except interpret-capable ``ours`` under
+``--smoke``) emits a structured ``error`` JSON line instead of crashing —
+the harness is always runnable, rc 0 (driver contract).
+"""
+from __future__ import annotations
+
+import json
+import math
+import time
+
+import numpy as np
+
+SHAPE = dict(batch=8, heads=12, seq=1024, head_dim=128)
+ITERS = 20
+
+
+def _inputs(batch, heads, seq, head_dim, dtype):
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(0)
+
+    def t(*shape):
+        return jnp.asarray(rng.randn(*shape) * 0.02, dtype)
+
+    # canonical layout here is [b, h, s, d]; adapters transpose per impl
+    return (t(batch, heads, seq, head_dim), t(batch, heads, seq, head_dim),
+            t(batch, heads, seq, head_dim))
+
+
+def _time_fwd_bwd(attn_fn, q, k, v, iters):
+    """Chained fwd+bwd layers in one dispatch; returns ms/layer.
+
+    attn_fn: (q, k, v) -> out, all [b, h, s, d]."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    def loss(q_, k_, v_):
+        return jnp.sum(attn_fn(q_, k_, v_).astype(jnp.float32))
+
+    grad3 = jax.grad(loss, argnums=(0, 1, 2))
+
+    def many(q, k, v):
+        def body(carry, _):
+            q_, k_, v_ = carry
+            dq, dk, dv = grad3(q_, k_, v_)
+            # grad-perturbed carry: data dependency between iterations
+            eps = 1e-3
+            return (q_ + eps * dq.astype(q_.dtype),
+                    k_ + eps * dk.astype(k_.dtype),
+                    v_ + eps * dv.astype(v_.dtype)), None
+
+        (q, k, v), _ = lax.scan(body, (q, k, v), None, length=iters)
+        return q
+
+    with jax.default_matmul_precision("default"):
+        f = jax.jit(many)
+        f(q, k, v).block_until_ready()  # compile + warmup
+        t0 = time.perf_counter()
+        out = f(q, k, v)
+        out.block_until_ready()
+        elapsed = time.perf_counter() - t0
+    assert bool(jnp.isfinite(out).all()), "non-finite A/B chain output"
+    return elapsed / iters * 1e3
+
+
+# ---------------------------------------------------------------------------
+# Implementations (adapters from the canonical [b, h, s, d] layout)
+# ---------------------------------------------------------------------------
+
+
+def _ours(q, k, v, scale):
+    from paddle_tpu.ops.pallas.flash_attention import flash_attention
+
+    def attn(q_, k_, v_):
+        out = flash_attention(
+            q_.transpose(0, 2, 1, 3), k_.transpose(0, 2, 1, 3),
+            v_.transpose(0, 2, 1, 3), causal=True, scale=scale)
+        return out.transpose(0, 2, 1, 3)
+
+    return attn
+
+
+def _jax_flash(q, k, v, scale):
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        flash_attention as jf)
+
+    def attn(q_, k_, v_):
+        return jf(q_, k_, v_, causal=True, sm_scale=scale)
+
+    return attn
+
+
+def _jax_splash(q, k, v, scale):
+    import jax
+    from jax.experimental.pallas.ops.tpu.splash_attention import (
+        splash_attention_kernel as sk, splash_attention_mask as sm)
+
+    heads, seq = q.shape[1], q.shape[2]
+    mask = sm.MultiHeadMask(
+        [sm.CausalMask((seq, seq)) for _ in range(heads)])
+    kernel = sk.make_splash_mha(mask, head_shards=1, q_seq_shards=1)
+
+    def attn(q_, k_, v_):
+        # splash takes pre-scaled q, per-batch [h, s, d]
+        return jax.vmap(kernel)(q_ * scale, k_, v_)
+
+    return attn
+
+
+def _xla_sdpa(q, k, v, scale):
+    import jax
+
+    def attn(q_, k_, v_):
+        # jax.nn layout is [b, s, h, d]
+        out = jax.nn.dot_product_attention(
+            q_.transpose(0, 2, 1, 3), k_.transpose(0, 2, 1, 3),
+            v_.transpose(0, 2, 1, 3), scale=scale, is_causal=True)
+        return out.transpose(0, 2, 1, 3)
+
+    return attn
+
+
+IMPLS = [("ours", _ours), ("jax-flash", _jax_flash),
+         ("jax-splash", _jax_splash), ("xla-sdpa", _xla_sdpa)]
+
+
+def main():
+    import sys
+
+    if "--cpu" in sys.argv:
+        import jax as _j
+
+        _j.config.update("jax_platforms", "cpu")
+
+    import paddle_tpu  # noqa: F401  framework config; also ours' kernel path
+    import jax
+    import jax.numpy as jnp
+
+    jax.config.update("jax_enable_x64", False)
+    on_tpu = jax.devices()[0].platform == "tpu"
+    smoke = "--smoke" in sys.argv
+
+    # smoke CI leg defaults to a tiny shape and 1 iter (interpret-capable
+    # impls only); explicit --batch/--heads/--seq/--head_dim/--iters still win
+    if smoke and not on_tpu:
+        shape, iters = dict(batch=1, heads=2, seq=128, head_dim=64), 1
+    else:
+        shape, iters = dict(SHAPE), ITERS
+    for a in sys.argv:
+        for key in shape:
+            if a.startswith(f"--{key}="):
+                shape[key] = int(a.split("=")[1])
+        if a.startswith("--iters="):
+            iters = int(a.split("=")[1])
+
+    dtype = jnp.bfloat16 if on_tpu else jnp.float32
+    scale = 1.0 / math.sqrt(shape["head_dim"])
+    q, k, v = _inputs(dtype=dtype, **shape)
+    desc = (f"b{shape['batch']} h{shape['heads']} s{shape['seq']} "
+            f"d{shape['head_dim']} {jnp.dtype(dtype).name} causal fwd+bwd")
+
+    results = {}
+    for name, make in IMPLS:
+        line = {"impl": name, "shape": desc, "iters": iters}
+        runnable = on_tpu or (smoke and name in ("ours", "xla-sdpa"))
+        if not runnable:
+            line["error"] = "backend_unavailable: TPU-only kernel (run on " \
+                            "chip, or --smoke for the interpret leg)"
+        else:
+            try:
+                ms = _time_fwd_bwd(make(q, k, v, scale), q, k, v, iters)
+                line["ms_per_layer"] = round(ms, 3)
+                results[name] = ms
+            except Exception as e:  # one impl failing must not kill the A/B
+                line["error"] = f"{type(e).__name__}: {e}"[:300]
+        print(json.dumps(line))
+
+    summary = {
+        "metric": f"flash A/B ours vs jax-flash speedup ({desc})",
+        "value": (round(results["jax-flash"] / results["ours"], 3)
+                  if {"ours", "jax-flash"} <= results.keys() else 0),
+        "unit": "x",
+        "vs_baseline": 2.4,  # BASELINE.md headline this harness reproduces
+    }
+    if not {"ours", "jax-flash"} <= results.keys():
+        summary["error"] = "backend_unavailable: A/B needs both kernels on TPU"
+    print(json.dumps(summary))
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except Exception as e:  # keep rc 0 + parseable output (driver contract)
+        import sys
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        print(json.dumps({"metric": "flash A/B harness", "value": 0,
+                          "unit": "x", "error": f"{type(e).__name__}: {e}"}))

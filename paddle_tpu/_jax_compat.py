@@ -1,0 +1,66 @@
+"""Forward-compat shims: run newer-jax spellings on older jax releases.
+
+The codebase targets current jax (``jax.set_mesh``, ``jax.shard_map``,
+``lax.pcast``); older releases carry the same machinery under experimental
+names. Each shim installs ONLY when the attribute is missing, so on a
+current release :func:`install` is a no-op — and the shims reproduce the
+exact call-site semantics this repo uses, not the full new API surface:
+
+- ``jax.set_mesh(mesh)``: the legacy mesh context — ``Mesh`` is itself a
+  context manager whose resource env bare ``PartitionSpec``s resolve
+  against, which is precisely what ``with jax.set_mesh(mesh):`` provides.
+- ``jax.shard_map(f, mesh=?, in_specs=, out_specs=, axis_names=?,
+  check_vma=?)``: maps onto ``jax.experimental.shard_map.shard_map`` with
+  ``auto = mesh axes - axis_names`` (partial-manual) and
+  ``check_rep = check_vma``; ``mesh=None`` resolves from the active mesh
+  context like the new API does.
+- ``lax.pcast(x, axes, to="varying")``: replication-tracking cast; with
+  replication checking off (every repo call site pairs it with
+  ``check_vma=False``) it is the identity on the array value.
+"""
+from __future__ import annotations
+
+
+def _context_mesh():
+    from jax._src.mesh import thread_resources
+
+    mesh = thread_resources.env.physical_mesh
+    if mesh.empty:
+        raise ValueError(
+            "shard_map with mesh=None needs an active mesh context "
+            "(jax.set_mesh)")
+    return mesh
+
+
+def install() -> None:
+    import jax
+    from jax import lax
+
+    if not hasattr(jax, "set_mesh"):
+        def set_mesh(mesh):
+            return mesh  # Mesh.__enter__ IS the legacy mesh context
+
+        jax.set_mesh = set_mesh
+
+    if not hasattr(jax, "shard_map"):
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        def shard_map(f, mesh=None, in_specs=None, out_specs=None,
+                      axis_names=None, check_vma=None, **kw):
+            m = mesh if mesh is not None else _context_mesh()
+            auto = frozenset()
+            if axis_names is not None:
+                auto = frozenset(m.axis_names) - frozenset(axis_names)
+            return _shard_map(
+                f, m, in_specs=in_specs, out_specs=out_specs,
+                check_rep=bool(check_vma) if check_vma is not None else False,
+                auto=auto)
+
+        jax.shard_map = shard_map
+
+    if not hasattr(lax, "pcast"):
+        def pcast(x, axis_name, to=None):
+            del axis_name, to
+            return x
+
+        lax.pcast = pcast

@@ -155,8 +155,9 @@ _bulk([
     "expand", "expand_as", "fake_channel_quant_dequant",
     "fake_quant_dequant", "fftshift", "flatten", "flip", "floor_divide",
     "fmax", "fmin", "fold", "frame", "fused_bias_dropout_residual_ln",
-    "fused_dropout_add", "fused_layer_norm", "fused_linear",
-    "fused_linear_activation", "fused_rms_norm", "fused_rope",
+    "fused_bias_gelu", "fused_dropout_add", "fused_layer_norm",
+    "fused_linear", "fused_linear_activation", "fused_ln_residual",
+    "fused_rms_norm", "fused_rope",
     "fused_matmul_bias", "fused_qkv", "fused_cache_concat",
     "masked_multihead_attention", "fused_ec_moe", "fused_gate_attention",
     "block_multihead_attention", "gather",

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import numpy as np
 
+import jax
 import jax.numpy as jnp
 
 from ..tensor.tensor import Tensor
@@ -29,6 +30,18 @@ def _np(x):
     return np.asarray(x.numpy() if isinstance(x, Tensor) else x)
 
 
+def _host_rng():
+    """Host-side RNG seeded from the framework default_generator, so
+    ``paddle.seed`` makes neighbor sampling reproducible like every other
+    stochastic op (each call draws a fresh key — repeated sampling still
+    varies, replaying from the same seed replays the samples)."""
+    from ..framework.random import default_generator
+
+    key = default_generator.next_key()
+    words = np.asarray(jax.random.key_data(key), np.uint32).reshape(-1)
+    return np.random.default_rng(np.random.SeedSequence(words.tolist()))
+
+
 def graph_sample_neighbors(row, colptr, input_nodes, eids=None,
                            perm_buffer=None, sample_size=-1,
                            return_eids=False, flag_perm_buffer=False,
@@ -38,7 +51,7 @@ def graph_sample_neighbors(row, colptr, input_nodes, eids=None,
     Returns (neighbors, count[, eids])."""
     row_np, colptr_np, nodes = _np(row), _np(colptr), _np(input_nodes)
     eids_np = _np(eids) if eids is not None else None
-    rng = np.random.default_rng()
+    rng = _host_rng()
     out_n, out_c, out_e = [], [], []
     for n in nodes.reshape(-1):
         start, end = int(colptr_np[n]), int(colptr_np[n + 1])

@@ -77,8 +77,18 @@ def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
 
 
 def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5,
-                     begin_norm_axis=-1, **kw):
+                     begin_norm_axis=-1, use_pallas=None, **kw):
+    """Fused LayerNorm. On TPU (or with ``use_pallas=True`` — interpret
+    mode off-TPU) the single-pass Pallas kernel
+    (ops/pallas/fused_mlp.fused_layer_norm) runs fwd AND custom-VJP bwd;
+    otherwise one XLA-fused jnp composite."""
+    from ...ops.pallas import fused_mlp as _fm
+
     def fn(x_, w, b):
+        if w is not None and b is not None:
+            # gate + reference fallback live in the kernel module
+            return _fm.fused_layer_norm(x_, w, b, eps=epsilon,
+                                        use_kernel=use_pallas)
         xf = x_.astype(jnp.float32)
         mean = xf.mean(-1, keepdims=True)
         var = xf.var(-1, keepdims=True)
@@ -90,6 +100,33 @@ def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5,
         return y
 
     return apply_op("fused_layer_norm", fn, x, norm_weight, norm_bias)
+
+
+def fused_ln_residual(x, residual, norm_weight, norm_bias, epsilon=1e-5,
+                      use_pallas=None):
+    """Residual-in/residual-out fused LayerNorm:
+    ``s = x + residual; y = LN(s)``; returns ``(y, s)`` — the pre-LN
+    transformer block's residual + norm in ONE kernel (Pallas on TPU,
+    jnp composite elsewhere)."""
+    from ...ops.pallas import fused_mlp as _fm
+
+    def fn(x_, r, w, b):
+        return _fm.fused_ln_residual(x_, r, w, b, eps=epsilon,
+                                     use_kernel=use_pallas)
+
+    return apply_op("fused_ln_residual", fn, x, residual, norm_weight,
+                    norm_bias)
+
+
+def fused_bias_gelu(x, bias=None, use_pallas=None):
+    """``gelu(x + bias)`` epilogue (tanh approximation) — the GEMM epilogue
+    fused into one Pallas kernel on TPU (jnp composite elsewhere)."""
+    from ...ops.pallas import fused_mlp as _fm
+
+    def fn(x_, b):
+        return _fm.fused_bias_gelu(x_, b, use_kernel=use_pallas)
+
+    return apply_op("fused_bias_gelu", fn, x, bias)
 
 
 def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
@@ -233,7 +270,8 @@ def swiglu(x, y=None):
 
 __all__ = [
     "fused_linear", "fused_linear_activation", "fused_dropout_add",
-    "fused_rms_norm", "fused_layer_norm", "fused_rotary_position_embedding",
+    "fused_rms_norm", "fused_layer_norm", "fused_ln_residual",
+    "fused_bias_gelu", "fused_rotary_position_embedding",
     "fused_bias_dropout_residual_layer_norm", "memory_efficient_attention",
     "variable_length_memory_efficient_attention", "swiglu",
     "fused_matmul_bias", "fused_dot_product_attention", "fused_feedforward",

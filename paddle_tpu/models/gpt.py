@@ -44,6 +44,14 @@ class GPTConfig:
     # run the Pallas kernel in interpret mode off-TPU too (CPU-mesh tests of
     # the sharded kernel path; never set in production configs)
     force_flash: bool = False
+    # fused MLP-block Pallas kernels (ops/pallas/fused_mlp): single-pass
+    # LN (+ residual-in/out) and bias+gelu epilogues replace the XLA
+    # elementwise chains in the decoder block — the round-5 roofline's
+    # ~20 ms/step of LN/gelu/residual HBM round-trips. bench.py flips this
+    # via --fused-mlp; off by default until the on-chip A/B confirms it.
+    fused_mlp: bool = False
+    # run the fused MLP kernels in interpret mode off-TPU too (CPU tests)
+    force_fused_mlp: bool = False
     # parallel knobs
     tensor_parallel: bool = False  # force TP layers even without fleet
     recompute: bool = False  # rematerialize blocks in backward (activation
@@ -182,13 +190,28 @@ class GPTAttention(Layer):
 class GPTMLP(Layer):
     def __init__(self, config: GPTConfig):
         super().__init__()
+        self.config = config
         h, f = config.hidden_size, config.ffn_size
         self.fc1 = _linear(config, h, f, "col")
         self.fc2 = _linear(config, f, h, "row")
         self.dropout = Dropout(config.hidden_dropout)
 
     def forward(self, x):
+        if _fused_mlp_on(self.config):
+            from ..incubate.nn import functional as FI
+
+            # bias+gelu ride ONE Pallas epilogue kernel after the GEMM
+            y = FI.fused_bias_gelu(
+                matmul(x, self.fc1.weight), self.fc1.bias,
+                use_pallas=True if self.config.force_fused_mlp else None)
+            return self.dropout(self.fc2(y))
         return self.dropout(self.fc2(F.gelu(self.fc1(x), approximate=True)))
+
+
+def _fused_mlp_on(config: GPTConfig) -> bool:
+    # under TP the block runs global-view with mp-sharded weights; GSPMD
+    # cannot partition a pallas_call, so the fused path is single-shard only
+    return getattr(config, "fused_mlp", False) and not _tp_enabled(config)
 
 
 class GPTDecoderLayer(Layer):
@@ -196,12 +219,15 @@ class GPTDecoderLayer(Layer):
 
     def __init__(self, config: GPTConfig):
         super().__init__()
+        self.config = config
         self.ln_1 = LayerNorm(config.hidden_size, epsilon=config.layer_norm_eps)
         self.attn = GPTAttention(config)
         self.ln_2 = LayerNorm(config.hidden_size, epsilon=config.layer_norm_eps)
         self.mlp = GPTMLP(config)
 
     def forward(self, x, attn_mask=None, cache=None):
+        if _fused_mlp_on(self.config):
+            return self._forward_fused(x, attn_mask=attn_mask, cache=cache)
         if cache is not None:
             a, new_cache = self.attn(self.ln_1(x), attn_mask=attn_mask, cache=cache)
             x = x + a
@@ -209,6 +235,28 @@ class GPTDecoderLayer(Layer):
             return x, new_cache
         x = x + self.attn(self.ln_1(x), attn_mask=attn_mask)
         x = x + self.mlp(self.ln_2(x))
+        return x
+
+    def _forward_fused(self, x, attn_mask=None, cache=None):
+        """Fused-kernel block: LN1 single-pass, then the attention branch's
+        residual add + LN2 in ONE residual-in/residual-out kernel."""
+        from ..incubate.nn import functional as FI
+
+        cfg = self.config
+        uk = True if cfg.force_fused_mlp else None
+        y1 = FI.fused_layer_norm(x, self.ln_1.weight, self.ln_1.bias,
+                                 epsilon=cfg.layer_norm_eps, use_pallas=uk)
+        new_cache = None
+        if cache is not None:
+            a, new_cache = self.attn(y1, attn_mask=attn_mask, cache=cache)
+        else:
+            a = self.attn(y1, attn_mask=attn_mask)
+        # s = x + a (residual-out) and y2 = LN(s), one kernel
+        y2, s = FI.fused_ln_residual(a, x, self.ln_2.weight, self.ln_2.bias,
+                                     epsilon=cfg.layer_norm_eps, use_pallas=uk)
+        x = s + self.mlp(y2)
+        if cache is not None:
+            return x, new_cache
         return x
 
 

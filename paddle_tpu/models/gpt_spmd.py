@@ -184,6 +184,22 @@ def _layer_norm(x, g, b, eps):
     return (x - mu) * lax.rsqrt(var + eps) * g + b
 
 
+def _fused_mlp_on(config: GPTConfig, mesh: Mesh) -> bool:
+    """Whether the fused MLP-block Pallas kernels (ops/pallas/fused_mlp)
+    replace the XLA elementwise chains in this block. GSPMD cannot
+    partition a pallas_call, so the fused path is single-shard only —
+    exactly the flagship 1-chip config it targets. Off-TPU the kernels run
+    in interpret mode and only when ``force_fused_mlp`` asks for them
+    (CPU tests); a compiled CPU run would pay interpreter dispatch."""
+    if not getattr(config, "fused_mlp", False):
+        return False
+    if math.prod(mesh.shape.values()) != 1:
+        return False
+    if jax.default_backend() != "tpu":
+        return bool(getattr(config, "force_fused_mlp", False))
+    return True
+
+
 def _mk_cs(mesh: Mesh):
     # Plain PartitionSpecs resolve against the context mesh (jax.set_mesh),
     # which inside a partial-manual shard_map is the manual-adjusted abstract
@@ -200,12 +216,21 @@ def _block(p, x, config: GPTConfig, mesh: Mesh):
     mb, s, h = x.shape
     cs = _mk_cs(mesh)
 
+    fused = _fused_mlp_on(config, mesh)
     # SP region: sequence sharded over mp
     x = cs(x, P("dp", "mp", None))
     if "attn" in config.ablate:  # perf attribution: skip the whole branch
         return _block_mlp(p, x, config, cs)
-    y = _layer_norm(x, p["ln1_g"], p["ln1_b"], config.layer_norm_eps)
-    if getattr(config, "remat_save_ln", False):
+    if fused:
+        from ..ops.pallas import fused_mlp as _fm
+
+        # single-pass LN kernel (fp32 stats, mean/rstd saved for backward;
+        # tags its outputs "ln_out" so remat_save_ln keeps working)
+        y = _fm.fused_layer_norm(x, p["ln1_g"], p["ln1_b"],
+                                 eps=config.layer_norm_eps, use_kernel=True)
+    else:
+        y = _layer_norm(x, p["ln1_g"], p["ln1_b"], config.layer_norm_eps)
+    if not fused and getattr(config, "remat_save_ln", False):
         from jax.ad_checkpoint import checkpoint_name
 
         y = checkpoint_name(y, "ln_out")
@@ -258,8 +283,25 @@ def _block(p, x, config: GPTConfig, mesh: Mesh):
         o = jnp.einsum("bnqk,bnkd->bnqd", attn, v)
         o = o.transpose(0, 2, 1, 3).reshape(mb, s, h)
     o = o @ p["wo"] + p["bo"]                  # row-parallel
+    if fused:
+        return _block_mlp_fused(p, x, o, config)
     x = x + cs(o, P("dp", "mp", None))         # reduce-scatter onto SP layout
     return _block_mlp(p, x, config, cs)
+
+
+def _block_mlp_fused(p, x, branch, config: GPTConfig):
+    """Fused-kernel MLP half (single shard): the attention branch's residual
+    add + LN2 ride ONE residual-in/residual-out kernel (one HBM round-trip
+    instead of three), and fc1's bias+gelu ride one epilogue kernel after
+    the GEMM — the round-5 roofline's ~1.3 ms/layer of elementwise traffic."""
+    from ..ops.pallas import fused_mlp as _fm
+
+    if "mlp" in config.ablate:  # perf attribution: skip the whole branch
+        return x + branch
+    y, s = _fm.fused_ln_residual(branch, x, p["ln2_g"], p["ln2_b"],
+                                 eps=config.layer_norm_eps, use_kernel=True)
+    y = _fm.fused_bias_gelu(y @ p["w1"], p["b1"], use_kernel=True)
+    return s + (y @ p["w2"] + p["b2"])
 
 
 def _block_mlp(p, x, config: GPTConfig, cs):

@@ -151,7 +151,10 @@ def dynamic_decode(decoder, inits=None, max_step_num=None,
     inputs, states, finished = decoder.initialize(inits)
     outputs = []
     step = 0
-    limit = max_step_num if max_step_num is not None else 256
+    # max_step_num=None means "until every sequence finishes" (reference
+    # semantics) — NOT an implicit cap. A host-loop failsafe still bounds a
+    # decoder that never emits end tokens, but hitting it is loud.
+    limit = max_step_num if max_step_num is not None else 100_000
     while step < limit:
         out, states, inputs, finished = decoder.step(step, inputs, states,
                                                      **kwargs)
@@ -159,6 +162,12 @@ def dynamic_decode(decoder, inits=None, max_step_num=None,
         step += 1
         if bool(np.asarray(finished.numpy()).all()):
             break
+    else:
+        if max_step_num is None:
+            raise RuntimeError(
+                f"dynamic_decode: {limit} steps without all sequences "
+                "finishing and no max_step_num given — the decoder never "
+                "emits its end token; pass max_step_num to bound decoding")
     lengths = states.get("lengths") if isinstance(states, dict) else None
     final, states = decoder.finalize(outputs, states, lengths)
     if output_time_major and isinstance(final, Tensor) and final._data.ndim >= 3:

@@ -66,71 +66,15 @@ def _pick_block(pref: int, seq: int) -> int:
 
 
 # ---------------------------------------------------------------------------
-# Block autotune cache (reference: phi/kernels/autotune/cache.h — per-op
-# algorithm cache keyed by shape signature, persisted across runs). Keys are
+# Block autotune cache: persistence lives in the shared autotune_cache
+# module (one JSON file for the whole Pallas kernel family). Keys here are
 # (seq_q, seq_k, head_dim, dtype); values are swept (bq, bk). The sweep runs
 # only from :func:`autotune` (an explicit eager call — block sizes are
 # trace-time constants, so they cannot be switched inside a compiled
 # program); `_blocks_for` consults the cache at every trace.
 # ---------------------------------------------------------------------------
 
-_AUTOTUNE_CACHE: dict = {}
-_AUTOTUNE_LOADED = [False]
-# entries that came from the packaged defaults, with their packaged values:
-# excluded from _save_cache unless re-swept (a persisted snapshot would
-# permanently shadow future packaged updates)
-_PACKAGED_SNAPSHOT: dict = {}
-
-
-def _cache_path():
-    import os
-
-    return os.environ.get(
-        "PADDLE_TPU_FLASH_AUTOTUNE",
-        os.path.join(os.path.expanduser("~"), ".paddle_tpu_flash_autotune.json"))
-
-
-def _load_cache():
-    if _AUTOTUNE_LOADED[0]:
-        return
-    _AUTOTUNE_LOADED[0] = True
-    import json
-    import os
-
-    p = _cache_path()
-    if os.path.exists(p):
-        try:
-            with open(p) as f:
-                _AUTOTUNE_CACHE.update(json.load(f))
-        except Exception:
-            pass
-    # Factory defaults swept on the benchmark chip ride the package (fresh
-    # containers have no user cache); user-swept entries take precedence.
-    pkg = os.path.join(os.path.dirname(__file__),
-                       "flash_autotune_defaults.json")
-    if os.path.exists(pkg):
-        try:
-            with open(pkg) as f:
-                for k, v in json.load(f).items():
-                    if k not in _AUTOTUNE_CACHE:
-                        _AUTOTUNE_CACHE[k] = v
-                        _PACKAGED_SNAPSHOT[k] = list(v)
-        except Exception:
-            pass
-
-
-def _save_cache():
-    import json
-
-    # persist only user-swept entries (packaged defaults that were not
-    # re-swept stay in the package, so package updates keep taking effect)
-    out = {k: v for k, v in _AUTOTUNE_CACHE.items()
-           if _PACKAGED_SNAPSHOT.get(k) != list(v)}
-    try:
-        with open(_cache_path(), "w") as f:
-            json.dump(out, f, indent=1)
-    except OSError:
-        pass
+from . import autotune_cache as _atc
 
 
 def _sig(seq_q, seq_k, d, dtype, which="fwd") -> str:
@@ -139,8 +83,8 @@ def _sig(seq_q, seq_k, d, dtype, which="fwd") -> str:
 
 
 def _blocks_for(seq_q, seq_k, d, dtype, which="fwd"):
-    _load_cache()
-    hit = _AUTOTUNE_CACHE.get(_sig(seq_q, seq_k, d, dtype, which))
+    _atc.load()
+    hit = _atc.CACHE.get(_sig(seq_q, seq_k, d, dtype, which))
     if hit:
         return _pick_block(hit[0], seq_q), _pick_block(hit[1], seq_k)
     return _pick_block(BLOCK_Q, seq_q), _pick_block(BLOCK_K, seq_k)
@@ -168,14 +112,14 @@ def autotune(batch_heads, seq_q, seq_k, d, dtype=jnp.bfloat16,
 
     if _interpret():
         return _blocks_for(seq_q, seq_k, d, dtype)
-    _load_cache()
+    _atc.load()
     key = jax.random.PRNGKey(0)
     q = jax.random.normal(key, (batch_heads, seq_q, d), dtype)
     k = jax.random.normal(key, (batch_heads, seq_k, d), dtype)
     v = jax.random.normal(key, (batch_heads, seq_k, d), dtype)
     sig_f = _sig(seq_q, seq_k, d, dtype, "fwd")
     sig_b = _sig(seq_q, seq_k, d, dtype, "bwd")
-    saved = (_AUTOTUNE_CACHE.get(sig_f), _AUTOTUNE_CACHE.get(sig_b))
+    saved = (_atc.CACHE.get(sig_f), _atc.CACHE.get(sig_b))
     best, best_t = None, float("inf")
     scale = 1.0 / math.sqrt(d)
     for bq in candidates:
@@ -185,8 +129,8 @@ def autotune(batch_heads, seq_q, seq_k, d, dtype=jnp.bfloat16,
             if seq_k % min(bk, seq_k):
                 continue
             cand = [min(bq, seq_q), min(bk, seq_k)]
-            _AUTOTUNE_CACHE[sig_f] = cand
-            _AUTOTUNE_CACHE[sig_b] = cand
+            _atc.CACHE[sig_f] = cand
+            _atc.CACHE[sig_b] = cand
             try:
                 # fresh closure per candidate: jit caches on function
                 # identity, and the blocks are read from the cache at trace
@@ -206,15 +150,15 @@ def autotune(batch_heads, seq_q, seq_k, d, dtype=jnp.bfloat16,
             if t < best_t:
                 best, best_t = (bq, bk), t
     if best is not None:
-        _AUTOTUNE_CACHE[sig_f] = list(best)
-        _AUTOTUNE_CACHE[sig_b] = list(best)
-        _save_cache()
+        _atc.CACHE[sig_f] = list(best)
+        _atc.CACHE[sig_b] = list(best)
+        _atc.save()
         return best
     for s, val in zip((sig_f, sig_b), saved):  # no candidate ran: restore
         if val is None:
-            _AUTOTUNE_CACHE.pop(s, None)
+            _atc.CACHE.pop(s, None)
         else:
-            _AUTOTUNE_CACHE[s] = val
+            _atc.CACHE[s] = val
     return _blocks_for(seq_q, seq_k, d, dtype)
 
 
@@ -236,7 +180,7 @@ def autotune_split(batch_heads, seq_q, seq_k, d, dtype=jnp.bfloat16,
     if _interpret():
         b = _blocks_for(seq_q, seq_k, d, dtype)
         return b, b
-    _load_cache()
+    _atc.load()
     key = jax.random.PRNGKey(0)
     q = jax.random.normal(key, (batch_heads, seq_q, d), dtype)
     k = jax.random.normal(key, (batch_heads, seq_k, d), dtype)
@@ -254,7 +198,7 @@ def autotune_split(batch_heads, seq_q, seq_k, d, dtype=jnp.bfloat16,
         return time.perf_counter() - t0
 
     def _sweep(sig, make_step):
-        saved = _AUTOTUNE_CACHE.get(sig)
+        saved = _atc.CACHE.get(sig)
         best, best_t = None, float("inf")
         for bq in candidates:
             if seq_q % min(bq, seq_q):
@@ -262,7 +206,7 @@ def autotune_split(batch_heads, seq_q, seq_k, d, dtype=jnp.bfloat16,
             for bk in candidates:
                 if seq_k % min(bk, seq_k):
                     continue
-                _AUTOTUNE_CACHE[sig] = [min(bq, seq_q), min(bk, seq_k)]
+                _atc.CACHE[sig] = [min(bq, seq_q), min(bk, seq_k)]
                 try:
                     t = _time(make_step(), q, k, v)
                 except Exception:
@@ -271,11 +215,11 @@ def autotune_split(batch_heads, seq_q, seq_k, d, dtype=jnp.bfloat16,
                     best, best_t = (bq, bk), t
         if best is None:  # no candidate ran: restore prior state
             if saved is None:
-                _AUTOTUNE_CACHE.pop(sig, None)
+                _atc.CACHE.pop(sig, None)
             else:
-                _AUTOTUNE_CACHE[sig] = saved
+                _atc.CACHE[sig] = saved
         else:
-            _AUTOTUNE_CACHE[sig] = list(best)
+            _atc.CACHE[sig] = list(best)
         return best
 
     def fwd_step():
@@ -289,7 +233,7 @@ def autotune_split(batch_heads, seq_q, seq_k, d, dtype=jnp.bfloat16,
 
     best_f = _sweep(sig_f, fwd_step)     # phase 1: forward alone
     best_b = _sweep(sig_b, full_step)    # phase 2: bwd varies, fwd pinned
-    _save_cache()
+    _atc.save()
     return (best_f or _blocks_for(seq_q, seq_k, d, dtype, "fwd"),
             best_b or _blocks_for(seq_q, seq_k, d, dtype, "bwd"))
 
@@ -451,7 +395,7 @@ def _flash_fwd_impl(q, k, v, mask, lens, scale, causal, hq, blocks=None):
         has_mask=has_mask, has_lens=has_lens, off=k.shape[1] - seq)
     # Trace kernels in 32-bit mode: the framework enables jax_enable_x64 and
     # int64 scalars are unlowerable in Mosaic.
-    with jax.enable_x64(False):
+    with _atc.x64_off():
         if has_lens:
             grid_spec = pltpu.PrefetchScalarGridSpec(
                 num_scalar_prefetch=1, grid=grid, in_specs=in_specs,
@@ -594,7 +538,7 @@ def flash_bwd_impl(q, k, v, g, lse, delta, scale, causal,
     kern = functools.partial(
         _bwd_fused_kernel, scale=scale, causal=causal, bq=bq, bkb=bkb,
         hq=hq, has_mask=has_mask, has_lens=has_lens, off=seq_k - seq)
-    with jax.enable_x64(False):
+    with _atc.x64_off():
         if has_lens:
             grid_spec = pltpu.PrefetchScalarGridSpec(
                 num_scalar_prefetch=1, grid=(bhq, seq_k // bkb),

@@ -316,25 +316,46 @@ def test_autotune_cache_roundtrip(tmp_path, monkeypatch):
     """The block autotune cache persists per shape signature and
     _blocks_for consults it at trace time (reference: phi autotune
     cache.h). The sweep itself needs a real device; here the cache
-    plumbing is exercised directly."""
+    plumbing (shared ops/pallas/autotune_cache module) is exercised
+    directly — for both the flash and fused-MLP kernel families."""
+    from paddle_tpu.ops.pallas import autotune_cache as atc
     from paddle_tpu.ops.pallas import flash_attention as fa
+    from paddle_tpu.ops.pallas import fused_mlp as fm
 
-    monkeypatch.setenv("PADDLE_TPU_FLASH_AUTOTUNE",
+    monkeypatch.setenv("PADDLE_TPU_PALLAS_AUTOTUNE",
                        str(tmp_path / "cache.json"))
-    monkeypatch.setattr(fa, "_AUTOTUNE_CACHE", {})
-    monkeypatch.setattr(fa, "_AUTOTUNE_LOADED", [False])
+    monkeypatch.setattr(atc, "CACHE", {})
+    monkeypatch.setattr(atc, "_LOADED", [False])
     # default (no cache entry)
     assert fa._blocks_for(512, 512, 64, "bfloat16") == (
         fa._pick_block(fa.BLOCK_Q, 512), fa._pick_block(fa.BLOCK_K, 512))
-    # write an entry, force a reload from disk, and see it honored
-    fa._AUTOTUNE_CACHE[fa._sig(512, 512, 64, "bfloat16", "fwd")] = [128, 512]
-    fa._save_cache()
-    monkeypatch.setattr(fa, "_AUTOTUNE_CACHE", {})
-    monkeypatch.setattr(fa, "_AUTOTUNE_LOADED", [False])
+    # write entries (one per kernel family), force a reload from disk,
+    # and see them honored
+    atc.CACHE[fa._sig(512, 512, 64, "bfloat16", "fwd")] = [128, 512]
+    atc.CACHE[fm._sig("ln", 4096, 768, "bfloat16", "fwd")] = [256]
+    atc.save()
+    monkeypatch.setattr(atc, "CACHE", {})
+    monkeypatch.setattr(atc, "_LOADED", [False])
     assert fa._blocks_for(512, 512, 64, "bfloat16") == (128, 512)
-    # cached preference shrinks to divide shorter sequences
+    assert fm._rows_for("ln", 4096, 768, "bfloat16") == 256
+    # cached preference shrinks to divide shorter sequences / fewer rows
     assert fa._blocks_for(256, 256, 64, "bfloat16") == (
         fa._pick_block(fa.BLOCK_Q, 256), fa._pick_block(fa.BLOCK_K, 256))
+    assert fm._rows_for("ln", 128, 768, "bfloat16") == 128
+
+
+def test_autotune_legacy_env_var(tmp_path, monkeypatch):
+    """The legacy PADDLE_TPU_FLASH_AUTOTUNE spelling still locates the
+    cache file (persisted caches from earlier rounds keep working)."""
+    from paddle_tpu.ops.pallas import autotune_cache as atc
+
+    monkeypatch.delenv("PADDLE_TPU_PALLAS_AUTOTUNE", raising=False)
+    monkeypatch.setenv("PADDLE_TPU_FLASH_AUTOTUNE",
+                       str(tmp_path / "legacy.json"))
+    assert atc.cache_path() == str(tmp_path / "legacy.json")
+    monkeypatch.setenv("PADDLE_TPU_PALLAS_AUTOTUNE",
+                       str(tmp_path / "new.json"))
+    assert atc.cache_path() == str(tmp_path / "new.json")
 
 
 def test_remat_policy_saves_flash_forward():

@@ -1,0 +1,250 @@
+"""Fused MLP-block Pallas kernels vs jnp reference (interpret mode on CPU).
+
+Golden tests for ops/pallas/fused_mlp: forward AND custom-VJP backward of
+the single-pass LayerNorm (plain + residual-in/residual-out) and the
+gelu/bias+gelu epilogue, fp32 and bf16 legs, plus the model-path wiring
+(models/gpt.py fused decoder block and the gpt_spmd flagship branch) —
+fused and unfused must be the same function."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.pallas import fused_mlp as fm
+
+F32 = dict(dtype=jnp.float32, rtol=1e-5, atol=1e-5, grtol=1e-4, gatol=1e-4)
+BF16 = dict(dtype=jnp.bfloat16, rtol=2e-2, atol=2e-2, grtol=5e-2, gatol=5e-2)
+
+
+def _t(rng, shape, dtype):
+    return jnp.asarray(rng.randn(*shape), dtype)
+
+
+@pytest.mark.parametrize("leg", [F32, BF16], ids=["fp32", "bf16"])
+@pytest.mark.parametrize("shape", [(128, 256), (2, 64, 128)])
+def test_layer_norm_forward(rng, leg, shape):
+    x = _t(rng, shape, leg["dtype"])
+    g = _t(rng, shape[-1:], leg["dtype"])
+    b = _t(rng, shape[-1:], leg["dtype"])
+    out = fm.fused_layer_norm(x, g, b, eps=1e-5, use_kernel=True)
+    ref = fm.ln_reference(x, g, b, eps=1e-5)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               rtol=leg["rtol"], atol=leg["atol"])
+
+
+@pytest.mark.parametrize("leg", [F32, BF16], ids=["fp32", "bf16"])
+def test_layer_norm_grads(rng, leg):
+    x = _t(rng, (64, 128), leg["dtype"])
+    g = _t(rng, (128,), leg["dtype"])
+    b = _t(rng, (128,), leg["dtype"])
+
+    def loss(fn):
+        return lambda x_, g_, b_: jnp.sum(
+            fn(x_, g_, b_).astype(jnp.float32) ** 2)
+
+    gk = jax.grad(loss(lambda *a: fm.fused_layer_norm(
+        *a, eps=1e-5, use_kernel=True)), argnums=(0, 1, 2))(x, g, b)
+    gr = jax.grad(loss(lambda *a: fm.ln_reference(*a, eps=1e-5)),
+                  argnums=(0, 1, 2))(x, g, b)
+    for a, r in zip(gk, gr):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(r, np.float32),
+                                   rtol=leg["grtol"], atol=leg["gatol"])
+
+
+@pytest.mark.parametrize("leg", [F32, BF16], ids=["fp32", "bf16"])
+def test_ln_residual_forward_and_grads(rng, leg):
+    """Residual-in/residual-out: y = LN(x + r), s = x + r — and the backward
+    must route BOTH cotangents (dy and the downstream use of s)."""
+    x = _t(rng, (2, 32, 128), leg["dtype"])
+    r = _t(rng, (2, 32, 128), leg["dtype"])
+    g = _t(rng, (128,), leg["dtype"])
+    b = _t(rng, (128,), leg["dtype"])
+
+    y, s = fm.fused_ln_residual(x, r, g, b, eps=1e-5, use_kernel=True)
+    s_ref = x + r
+    y_ref = fm.ln_reference(s_ref, g, b, eps=1e-5)
+    np.testing.assert_allclose(np.asarray(y, np.float32),
+                               np.asarray(y_ref, np.float32),
+                               rtol=leg["rtol"], atol=leg["atol"])
+    np.testing.assert_allclose(np.asarray(s, np.float32),
+                               np.asarray(s_ref, np.float32),
+                               rtol=leg["rtol"], atol=leg["atol"])
+
+    def loss_k(x_, r_, g_, b_):
+        y_, s_ = fm.fused_ln_residual(x_, r_, g_, b_, eps=1e-5,
+                                      use_kernel=True)
+        # both outputs used: exercises the fused ds_out + dLN/ds backward
+        return jnp.sum(y_.astype(jnp.float32) ** 2) + \
+            jnp.sum(jnp.sin(s_.astype(jnp.float32)))
+
+    def loss_r(x_, r_, g_, b_):
+        s_ = x_ + r_
+        y_ = fm.ln_reference(s_, g_, b_, eps=1e-5)
+        return jnp.sum(y_.astype(jnp.float32) ** 2) + \
+            jnp.sum(jnp.sin(s_.astype(jnp.float32)))
+
+    gk = jax.grad(loss_k, argnums=(0, 1, 2, 3))(x, r, g, b)
+    gr = jax.grad(loss_r, argnums=(0, 1, 2, 3))(x, r, g, b)
+    for a, ref in zip(gk, gr):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(ref, np.float32),
+                                   rtol=leg["grtol"], atol=leg["gatol"])
+
+
+@pytest.mark.parametrize("leg", [F32, BF16], ids=["fp32", "bf16"])
+@pytest.mark.parametrize("with_bias", [False, True])
+def test_gelu_forward_and_grads(rng, leg, with_bias):
+    x = _t(rng, (64, 256), leg["dtype"])
+    b = _t(rng, (256,), leg["dtype"]) if with_bias else None
+
+    if with_bias:
+        k_fn = lambda x_, b_: fm.fused_bias_gelu(x_, b_, use_kernel=True)  # noqa: E731
+        r_fn = fm.gelu_reference
+        args = (x, b)
+    else:
+        k_fn = lambda x_: fm.fused_gelu(x_, use_kernel=True)  # noqa: E731
+        r_fn = lambda x_: fm.gelu_reference(x_)  # noqa: E731
+        args = (x,)
+
+    out = k_fn(*args)
+    ref = r_fn(*args)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               rtol=leg["rtol"], atol=leg["atol"])
+
+    argnums = tuple(range(len(args)))
+    gk = jax.grad(lambda *a: jnp.sum(k_fn(*a).astype(jnp.float32) ** 2),
+                  argnums=argnums)(*args)
+    gr = jax.grad(lambda *a: jnp.sum(r_fn(*a).astype(jnp.float32) ** 2),
+                  argnums=argnums)(*args)
+    for a, ref_g in zip(gk, gr):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(ref_g, np.float32),
+                                   rtol=leg["grtol"], atol=leg["gatol"])
+
+
+def test_odd_rows_fall_back_to_reference(rng):
+    """Shapes the compiled kernel cannot tile (h % 128, odd rows) silently
+    ride the reference path under auto policy — never an error."""
+    x = _t(rng, (3, 100), jnp.float32)  # h=100 not 128-divisible
+    g = jnp.ones((100,), jnp.float32)
+    b = jnp.zeros((100,), jnp.float32)
+    out = fm.fused_layer_norm(x, g, b)  # use_kernel=None: auto
+    ref = fm.ln_reference(x, g, b)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-6)
+
+
+def test_incubate_functional_pallas_flag(rng):
+    """incubate.nn.functional wrappers: use_pallas=True runs the interpret
+    kernel through the framework tape (forward + backward)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.incubate.nn import functional as FF
+
+    x = paddle.to_tensor(rng.randn(8, 128).astype("float32"))
+    x.stop_gradient = False
+    w = paddle.to_tensor(rng.randn(128).astype("float32"))
+    b = paddle.to_tensor(rng.randn(128).astype("float32"))
+    out = FF.fused_layer_norm(x, w, b, use_pallas=True)
+    ref = FF.fused_layer_norm(x, w, b, use_pallas=False)
+    np.testing.assert_allclose(np.asarray(out.numpy()),
+                               np.asarray(ref.numpy()), rtol=1e-5, atol=1e-5)
+    (out ** 2).sum().backward()
+    assert x.grad is not None
+    assert np.isfinite(np.asarray(x.grad.numpy())).all()
+
+    y = paddle.to_tensor(rng.randn(4, 64).astype("float32"))
+    res = paddle.to_tensor(rng.randn(4, 64).astype("float32"))
+    yk, sk = FF.fused_ln_residual(y, res, w[:64], b[:64], use_pallas=True)
+    yr, sr = FF.fused_ln_residual(y, res, w[:64], b[:64], use_pallas=False)
+    np.testing.assert_allclose(np.asarray(yk.numpy()),
+                               np.asarray(yr.numpy()), rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(sk.numpy()),
+                               np.asarray(sr.numpy()), rtol=1e-6)
+
+    z = paddle.to_tensor(rng.randn(4, 64).astype("float32"))
+    bias = paddle.to_tensor(rng.randn(64).astype("float32"))
+    gk = FF.fused_bias_gelu(z, bias, use_pallas=True)
+    gref = FF.fused_bias_gelu(z, bias, use_pallas=False)
+    np.testing.assert_allclose(np.asarray(gk.numpy()),
+                               np.asarray(gref.numpy()), rtol=1e-5, atol=1e-5)
+
+
+def test_gpt_block_fused_matches_plain(rng):
+    """models/gpt.py decoder block: force_fused_mlp=True is the same
+    function as the plain block (loss + grads flow)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    base = dict(vocab_size=128, hidden_size=64, num_layers=2, num_heads=4,
+                max_seq_len=32, hidden_dropout=0.0, attn_dropout=0.0)
+    paddle.seed(0)
+    plain = GPTForCausalLM(GPTConfig(**base))
+    paddle.seed(0)
+    fused = GPTForCausalLM(GPTConfig(fused_mlp=True, force_fused_mlp=True,
+                                     **base))
+    ids = paddle.to_tensor(rng.randint(0, 128, (2, 16)), "int64")
+    lp = plain(ids, labels=ids)
+    lf = fused(ids, labels=ids)
+    np.testing.assert_allclose(float(lf._data), float(lp._data), rtol=1e-5)
+    lf.backward()
+    assert fused.gpt.layers[0].mlp.fc1.weight.grad is not None
+    assert fused.gpt.layers[0].ln_2.weight.grad is not None
+
+
+def test_gpt_spmd_fused_matches_plain(rng):
+    """gpt_spmd flagship branch: config.fused_mlp (forced interpret on CPU)
+    must match the XLA block — loss and every grad leaf."""
+    from paddle_tpu.models import gpt_spmd
+    from paddle_tpu.models.gpt import GPTConfig
+
+    base = dict(vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
+                max_seq_len=64)
+    mesh = gpt_spmd.make_mesh(1)
+    ids = jnp.asarray(rng.randint(0, 256, (2, 64)), jnp.int32)
+    with jax.set_mesh(mesh):
+        cfg_a = GPTConfig(**base)
+        params = gpt_spmd.init_params(cfg_a, mesh)
+        la, ga = jax.value_and_grad(gpt_spmd.loss_fn)(
+            params, ids, ids, cfg_a, mesh, 1)
+        cfg_b = GPTConfig(fused_mlp=True, force_fused_mlp=True, **base)
+        lb, gb = jax.value_and_grad(gpt_spmd.loss_fn)(
+            params, ids, ids, cfg_b, mesh, 1)
+    np.testing.assert_allclose(float(la), float(lb), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(ga), jax.tree.leaves(gb)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4,
+                                   atol=1e-5)
+
+
+def test_gpt_spmd_fused_with_recompute(rng):
+    """fused_mlp composes with the flagship's remat policy (recompute=True):
+    same loss, grads finite — the exact flagship bench configuration."""
+    from paddle_tpu.models import gpt_spmd
+    from paddle_tpu.models.gpt import GPTConfig
+
+    base = dict(vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
+                max_seq_len=64)
+    mesh = gpt_spmd.make_mesh(1)
+    ids = jnp.asarray(rng.randint(0, 256, (2, 64)), jnp.int32)
+    with jax.set_mesh(mesh):
+        cfg_a = GPTConfig(recompute=True, **base)
+        params = gpt_spmd.init_params(cfg_a, mesh)
+        la, _ = jax.value_and_grad(gpt_spmd.loss_fn)(
+            params, ids, ids, cfg_a, mesh, 1)
+        cfg_b = GPTConfig(recompute=True, fused_mlp=True,
+                          force_fused_mlp=True, **base)
+        lb, gb = jax.value_and_grad(gpt_spmd.loss_fn)(
+            params, ids, ids, cfg_b, mesh, 1)
+    np.testing.assert_allclose(float(la), float(lb), rtol=1e-5)
+    for leaf in jax.tree.leaves(gb):
+        assert bool(jnp.isfinite(leaf).all())
+
+
+def test_autotune_mlp_interpret_roundtrip():
+    """autotune_mlp off-TPU is a no-op returning current row-block choices
+    (the sweep needs a real device)."""
+    out = fm.autotune_mlp(1024, 256, jnp.float32)
+    assert set(out) == {"ln", "gelu"}
+    assert all(1024 % b == 0 for b in out.values())

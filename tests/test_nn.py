@@ -454,3 +454,32 @@ class TestRound4LossAndLayerSurface:
         fr = paddle.nn.FractionalMaxPool2D(output_size=3)(
             paddle.to_tensor(x))
         assert tuple(fr.shape) == (1, 2, 3, 3)
+
+
+def test_dynamic_decode_runs_past_256_steps():
+    """max_step_num=None means "until every sequence finishes" — the old
+    implicit 256-step cap silently truncated long decodes."""
+    from paddle_tpu.nn.decode import dynamic_decode
+
+    class SlowDecoder:
+        """Finishes every sequence at step 300."""
+
+        def initialize(self, inits):
+            z = paddle.to_tensor(np.zeros((1,), "int64"))
+            return z, {"steps": 0}, paddle.to_tensor(np.array([False]))
+
+        def step(self, time, inputs, states, **kw):
+            done = paddle.to_tensor(np.array([time >= 299]))
+            out = paddle.to_tensor(np.array([time], "int64"))
+            return out, {"steps": time + 1}, inputs, done
+
+        def finalize(self, outputs, states, lengths):
+            return paddle.to_tensor(
+                np.array([len(outputs)], "int64")), states
+
+    final, states = dynamic_decode(SlowDecoder())
+    assert int(final.numpy()[0]) == 300  # not truncated at 256
+    assert states["steps"] == 300
+    # an explicit cap still caps (intended truncation, no error)
+    final, _ = dynamic_decode(SlowDecoder(), max_step_num=10)
+    assert int(final.numpy()[0]) == 10

@@ -1082,6 +1082,9 @@ _SKIP_GROUPS = {
         "weight_quantize", "weight_dequantize", "weight_only_linear",
         
     ],
+    "fused MLP-block Pallas kernel op (fwd+bwd golden-tested vs the jnp reference, fp32 and bf16 legs, in tests/test_fused_mlp.py — interpret mode on CPU)": [
+        "fused_bias_gelu", "fused_ln_residual",
+    ],
     "fused/incubate op (covered by tests/test_incubate.py)": [
         "fused_bias_dropout_residual_ln", "fused_dropout_add",
         "fused_layer_norm", "fused_linear", "fused_linear_activation",
@@ -1265,6 +1268,44 @@ spec("triplet_margin_with_distance_loss",
          0.0, np.sqrt(((a - p_) ** 2).sum(-1))
          - np.sqrt(((a - n) ** 2).sum(-1)) + 1.0).mean(),
      grad_rtol=5e-3)
+def _dice_oracle(p, y):
+    onehot = np.eye(p.shape[-1])[y[:, 0]]
+    inter = (p * onehot).sum(1)
+    denom = p.sum(1) + onehot.sum(1)
+    return (1.0 - 2.0 * inter / (denom + 1e-5)).mean()
+
+
+spec("dice_loss",
+     lambda x, y: F.dice_loss(x, y),
+     lambda rng: [rng.rand(4, 5) + 0.1,
+                  rng.randint(0, 5, (4, 1)).astype("int64")],
+     oracle=_dice_oracle)
+
+
+def _npair_oracle(a, p, y):
+    eq = (y[:, None] == y[None, :]).astype(a.dtype)
+    targets = eq / eq.sum(1, keepdims=True)
+    l2 = ((a ** 2).sum(1).mean() + (p ** 2).sum(1).mean()) * 0.002 * 0.25
+    sim = a @ p.T
+    sim = sim - sim.max(1, keepdims=True)
+    logp = sim - np.log(np.exp(sim).sum(1, keepdims=True))
+    return (-targets * logp).sum(1).mean() + l2
+
+
+spec("npair_loss",
+     lambda a, p_, y: F.npair_loss(a, p_, y),
+     lambda rng: [rng.randn(4, 6), rng.randn(4, 6),
+                  rng.randint(0, 3, (4,)).astype("int64")],
+     oracle=_npair_oracle, grad_rtol=5e-3)
+
+
+spec("pairwise_distance",
+     lambda x, y: F.pairwise_distance(x, y),
+     lambda rng: [rng.randn(4, 5), rng.randn(4, 5)],
+     oracle=lambda x, y: np.sqrt(((x - y + 1e-6) ** 2).sum(-1)),
+     grad_rtol=5e-3, grad_atol=5e-4)
+
+
 spec("hsigmoid_loss",
      lambda x, y, w, b: F.hsigmoid_loss(x, y, 6, w, b),
      lambda rng: [rng.randn(4, 3),
