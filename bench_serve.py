@@ -1,0 +1,177 @@
+"""Serving benchmark: paged-KV autoregressive decode throughput + latency.
+
+The round-7 serving metric, joining the bench trajectory next to bench.py's
+training lines. Drives the continuous-batching ServingPredictor (paged KV
+cache + fixed-shape decode jit) through a steady-state decode phase and
+emits ONE JSON line per implementation (same schema/contract as bench.py —
+the flagship paged-kernel line LAST):
+
+- ``value``/``unit``: decode tokens/sec/chip (batch * steps / elapsed)
+- ``vs_baseline``: paged Pallas kernel speedup over the jnp gather-based
+  reference attention (the XLA implementation a non-paged runtime would
+  use) — the serving A/B this round introduces
+- ``p50_ms``/``p99_ms``: per-token latency percentiles over the timed
+  decode steps (each step produces one token for every running sequence)
+- ``decode_retraces``: times the decode step traced during the timed phase
+  — MUST stay 1 (compile once, replay fixed-shape; the no-retrace gate)
+
+Methodology: admit ``--batch`` sequences with ``--prompt``-token prompts
+(prefill excluded from the timing — it is a one-off per request; the
+steady-state serving cost is decode), 3 warmup steps (compile + cache), then
+``--steps`` timed scheduler steps, one host sync per step (the per-step sync
+IS the serving pattern — each token returns to the user).
+
+``--smoke``: tiny CPU config, kernel in interpret mode — always runnable
+(CI leg, rc 0). Off-TPU without ``--smoke`` each leg emits a structured
+``error`` line instead of crashing (driver contract, like bench_flash_ab).
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+FLAGSHIP_METRIC = "paged-decode serving tokens/sec/chip"
+
+
+def _error_line(msg, metric=FLAGSHIP_METRIC):
+    return json.dumps({"metric": metric, "error": msg})
+
+
+def _percentile(xs, q):
+    return float(np.percentile(np.asarray(xs), q))
+
+
+def bench_decode(*, hidden, layers, heads, vocab, batch, prompt,
+                 steps, page_size, use_kernel, on_tpu, dtype=None):
+    """One serving leg. Returns (tokens/s, p50_ms, p99_ms, retraces)."""
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.inference import ServingPredictor
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    max_len = prompt + steps + 8
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=vocab, hidden_size=hidden, num_layers=layers,
+                    num_heads=heads, max_seq_len=max_len)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    sp = ServingPredictor(
+        model, max_batch=batch, page_size=page_size, max_seq_len=max_len,
+        use_kernel=use_kernel,
+        dtype=jnp.bfloat16 if (on_tpu and dtype is None) else dtype)
+    rng = np.random.RandomState(0)
+    for _ in range(batch):
+        sp.add_request(rng.randint(0, vocab, (prompt,)),
+                       max_new_tokens=steps + 16)
+    # warmup: admission + prefill compile + decode compile
+    for _ in range(3):
+        sp.step()
+    traces_before = sp.decode_trace_count
+    lat = []
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        t1 = time.perf_counter()
+        produced = sp.step()
+        # per-step host sync: each produced token crosses to the host —
+        # that IS serving's latency path (sp.step already converts).
+        # explicit raise (not assert): python -O must not let a drained
+        # batch silently inflate the tokens/s line
+        if not produced:
+            raise RuntimeError("decode batch drained mid-bench")
+        lat.append((time.perf_counter() - t1) * 1e3)
+    elapsed = time.perf_counter() - t0
+    retraces = sp.decode_trace_count - traces_before + 1
+    tps = batch * steps / elapsed
+    return tps, _percentile(lat, 50), _percentile(lat, 99), retraces
+
+
+def main():
+    import sys
+
+    smoke = "--smoke" in sys.argv
+
+    def arg(name, default):
+        pre = f"--{name}="
+        v = next((a[len(pre):] for a in sys.argv if a.startswith(pre)), None)
+        return int(v) if v is not None else default
+
+    if smoke:
+        # CPU-runnable CI leg: interpret-mode kernel, tiny shapes
+        import jax as _j
+
+        _j.config.update("jax_platforms", "cpu")
+    import paddle_tpu  # noqa: F401  (framework config)
+    import jax
+
+    # serving path: 32-bit index types, same policy as bench.py
+    jax.config.update("jax_enable_x64", False)
+    on_tpu = jax.devices()[0].platform == "tpu"
+
+    if smoke:
+        shape = dict(hidden=64, layers=2, heads=4, vocab=128,
+                     batch=arg("batch", 4), prompt=arg("prompt", 16),
+                     steps=arg("steps", 8), page_size=arg("page-size", 8))
+    else:
+        # flagship: gpt3-125m geometry at the acceptance shape (bs >= 8,
+        # context >= 1024 by the end of the decode phase)
+        shape = dict(hidden=768, layers=12, heads=12, vocab=50304,
+                     batch=arg("batch", 8), prompt=arg("prompt", 1024),
+                     steps=arg("steps", 64), page_size=arg("page-size", 0)
+                     or None)
+    label = (f"smoke bs{shape['batch']}" if smoke
+             else f"gpt3-125m bs{shape['batch']}")
+    chip = (jax.devices()[0].device_kind if on_tpu else "cpu")
+    runnable = on_tpu or smoke
+
+    legs = [("gather-ref", False), ("paged-kernel", True if smoke or not on_tpu
+                                    else None)]
+    results = {}
+    for name, use_kernel in legs:
+        metric = (f"{FLAGSHIP_METRIC} ({label} prompt{shape['prompt']}"
+                  f"+{shape['steps']} steps, {chip}) [{name}]")
+        if not runnable:
+            print(_error_line(
+                "backend_unavailable: paged decode needs a TPU chip, or "
+                "--smoke for the interpret leg", metric=metric))
+            continue
+        try:
+            tps, p50, p99, retraces = bench_decode(
+                on_tpu=on_tpu, use_kernel=use_kernel, **shape)
+        except Exception as e:  # one failed leg must not kill the other
+            print(_error_line(f"{type(e).__name__}: {e}"[:200],
+                              metric=metric))
+            continue
+        results[name] = dict(metric=metric, value=round(tps, 1),
+                             unit="tokens/s", p50_ms=round(p50, 2),
+                             p99_ms=round(p99, 2),
+                             decode_retraces=retraces)
+
+    # flagship line LAST: the paged-kernel leg, vs_baseline = speedup over
+    # the gather reference (ratio > 1 = the Pallas kernel wins the A/B)
+    if "gather-ref" in results:
+        ref = results["gather-ref"]
+        ref["vs_baseline"] = 1.0
+        print(json.dumps(ref))
+    if "paged-kernel" in results:
+        out = results["paged-kernel"]
+        if "gather-ref" in results and results["gather-ref"]["value"]:
+            out["vs_baseline"] = round(
+                out["value"] / results["gather-ref"]["value"], 3)
+        else:
+            out["vs_baseline"] = 0.0
+        print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except Exception as e:  # last line must stay parseable for the driver
+        import sys
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        print(_error_line(f"{type(e).__name__}: {e}"[:200]))
+        sys.exit(0)

@@ -3,6 +3,7 @@ from .backward import grad, run_backward
 from .engine import GradNode, apply_op, make_op
 from .functional import Hessian, Jacobian, hessian, jacobian, jvp, vjp
 from .grad_mode import enable_grad, is_grad_enabled, no_grad, set_grad_enabled
+from .saved_tensors_hooks import saved_tensors_hooks
 
 __all__ = [
     "grad",
@@ -22,6 +23,7 @@ __all__ = [
     "vjp",
     "Jacobian",
     "Hessian",
+    "saved_tensors_hooks",
 ]
 
 from .py_layer import PyLayer, PyLayerContext  # noqa: E402

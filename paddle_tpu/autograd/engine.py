@@ -20,6 +20,7 @@ import jax.numpy as jnp
 
 from ..framework import flags
 from ..framework import op_registry as _op_registry
+from . import saved_tensors_hooks as _saved_hooks
 from .grad_mode import is_grad_enabled
 
 # Hook installed by paddle_tpu.amp to auto-cast inputs per-op (O1/O2).
@@ -76,6 +77,10 @@ class GradNode:
         "out_avals",
         "out_tensor_refs",
         "released",
+        "saved_packed",
+        "unpack_hook",
+        "saved_low_prec",
+        "unpin_closure",
         "__weakref__",
     )
 
@@ -84,6 +89,14 @@ class GradNode:
         self.vjp_fn = vjp_fn
         self.pure_fn = pure_fn
         self.input_tensors = input_tensors  # strong refs, like TensorWrapper
+        self.saved_packed = None  # saved_tensors_hooks storage (pack output)
+        self.unpack_hook = None
+        self.saved_low_prec = False
+        # set by apply_op NEXT TO the closure it releases: drops the
+        # closure's pinned copies of the saved (diff) inputs — they are
+        # re-supplied as call arguments, so after a saved_tensors_hooks
+        # pack they are dead weight holding device memory
+        self.unpin_closure = None
         self.out_avals = out_avals
         self.out_tensor_refs: list = [None] * len(out_avals)
         self.released = False
@@ -100,7 +113,36 @@ class GradNode:
         self.vjp_fn = None
         self.pure_fn = None
         self.input_tensors = None
+        self.saved_packed = None
+        self.unpack_hook = None
+        self.unpin_closure = None  # captures the op's input buffers
         self.released = True
+
+    def attach_saved_hooks(self, pack_hook, unpack_hook):
+        """saved_tensors_hooks capture: pack every saved input, drop the
+        node's strong refs AND the eager vjp closure (its residuals pin
+        device memory); backward unpacks and re-derives the vjp through
+        ``pure_fn`` — one recomputed forward, remat-style. Backward ALWAYS
+        sees the pack->unpack round trip (reference contract: lossy pairs
+        like quantization must flow through). Intermediates truly unpin;
+        LEAF inputs stay alive through their grad-accumulation edge
+        (``input_edges``), so offloading a leaf saves no device memory —
+        inherent to grad accumulation, not to the hooks."""
+        if any(isinstance(t._data, jax.core.Tracer)
+               for t in self.input_tensors):
+            return  # under jit/static tracing hooks are inert (eager-only)
+        with _saved_hooks.hooks_suspended():
+            self.saved_packed = [pack_hook(t) for t in self.input_tensors]
+        self.unpack_hook = unpack_hook
+        self.input_tensors = None
+        self.vjp_fn = None
+
+    def _unpack_one(self, packed):
+        from ..tensor.tensor import Tensor
+
+        with _saved_hooks.hooks_suspended():
+            v = self.unpack_hook(packed)
+        return v if isinstance(v, Tensor) else Tensor(jnp.asarray(v))
 
     def zero_cotangents(self):
         cots = []
@@ -118,6 +160,24 @@ class GradNode:
                 f"GradNode for op '{self.name}' has been released. "
                 "Call backward(retain_graph=True) to backward a graph twice."
             )
+        if self.saved_packed is not None:
+            # saved_tensors_hooks path: re-derive the vjp through the saved
+            # pure function over the pack->unpack ROUND TRIP of every saved
+            # input — always, never a live-buffer shortcut: a lossy hook
+            # pair (quantized offload) must shape the gradients, and the
+            # packed copy is immune to in-place mutation of the original
+            datas = [self._unpack_one(p)._data for p in self.saved_packed]
+            import contextlib
+
+            # replay the forward's matmul-precision context: a half-
+            # precision op captured under DEFAULT must not recompute its
+            # vjp under the framework-global "highest" (3-6x emulation
+            # cost and numerics that diverge from the non-hooked path)
+            prec = (jax.default_matmul_precision("default")
+                    if self.saved_low_prec else contextlib.nullcontext())
+            with prec:
+                _, vjp_fn = jax.vjp(self.pure_fn, *datas)
+                return vjp_fn(tuple(cotangents))
         return self.vjp_fn(tuple(cotangents))
 
     def run_vjp_recorded(self, cotangent_tensors):
@@ -129,7 +189,26 @@ class GradNode:
                 "create_graph over a released graph."
             )
         pure_fn = self.pure_fn
-        n_in = len(self.input_tensors)
+        if self.saved_packed is not None:
+            # intermediates: unpack (round-trip contract) and RESURRECT the
+            # producer identity recorded in input_edges, so the
+            # d(grad)/d(earlier) path through a dead intermediate is not
+            # silently severed. Leaves: the original tensor (its edge is
+            # where grad-of-grad must accumulate; it is alive by the edge
+            # pin) — create_graph keeps leaf identity over lossy replay.
+            input_tensors = []
+            for i, packed in enumerate(self.saved_packed):
+                kind, *rest = self.input_edges[i]
+                if kind == "leaf":
+                    input_tensors.append(rest[0])
+                    continue
+                t = self._unpack_one(packed)
+                t.stop_gradient = False
+                t._grad_node, t._out_index = rest
+                input_tensors.append(t)
+        else:
+            input_tensors = self.input_tensors
+        n_in = len(input_tensors)
         non_diff = [not _is_diff_dtype(a.dtype) for a in self.out_avals]
         avals = self.out_avals
 
@@ -149,7 +228,7 @@ class GradNode:
             return vjp_fn(tuple(full))
 
         diff_cots = [c for c, nd in zip(cotangent_tensors, non_diff) if not nd]
-        return apply_op(self.name + "_grad", grad_fn, *self.input_tensors, *diff_cots)
+        return apply_op(self.name + "_grad", grad_fn, *input_tensors, *diff_cots)
 
 
 def _check_nan_inf(name, arrays):
@@ -295,11 +374,25 @@ def apply_op(name: str, fn: Callable, *args, **kwargs):
                         node = GradNode(name, vjp_fn, pure_fn_c,
                                         [leaves[p] for p in diff_pos],
                                         out_avals)
+
+                        def _unpin(_a=arg_datas, _d=didx):
+                            for j in _d:
+                                _a[j] = None
+
+                        node.unpin_closure = _unpin
             if not cache_hit and diff_pos:
                 diff_datas = [leaves[p]._data for p in diff_pos]
                 out_flat, vjp_fn = jax.vjp(pure_fn, *diff_datas)
                 out_avals = [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in out_flat]
                 node = GradNode(name, vjp_fn, pure_fn, [leaves[p] for p in diff_pos], out_avals)
+
+                def _unpin():
+                    # pure_fn rebuilds from ``leaves``; diff rows are
+                    # re-supplied as call arguments
+                    for p in diff_pos:
+                        leaves[p] = None
+
+                node.unpin_closure = _unpin
             elif not cache_hit:
                 out_flat = pure_fn()
     finally:
@@ -307,6 +400,16 @@ def apply_op(name: str, fn: Callable, *args, **kwargs):
         # exactly the one worth seeing in the trace
         if end_profile is not None:
             end_profile()
+
+    if node is not None and static_record_hook is None:
+        # saved_tensors_hooks capture: pack the node's saved inputs (eager
+        # only — attach_saved_hooks is a no-op on tracer inputs)
+        _hooks = _saved_hooks.current_hooks()
+        if _hooks is not None:
+            node.attach_saved_hooks(*_hooks)
+            node.saved_low_prec = bool(low_prec)
+            if node.saved_packed is not None and node.unpin_closure:
+                node.unpin_closure()
 
     if flags.flag("check_nan_inf"):
         _check_nan_inf(name, out_flat)

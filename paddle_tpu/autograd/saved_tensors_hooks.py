@@ -1,0 +1,92 @@
+"""paddle.autograd.saved_tensors_hooks — user hooks over saved activations.
+
+Reference: python/paddle/autograd/saved_tensors_hooks.py — a context
+manager whose ``pack_hook(tensor) -> obj`` runs when an op saves a tensor
+for backward and ``unpack_hook(obj) -> tensor`` runs when backward needs it
+back. The canonical use is CPU offload: pack copies the activation to host
+memory, unpack brings it back, trading transfer time for device HBM.
+
+TPU-native integration (autograd/engine.py): the tape's GradNode saves the
+op's differentiable INPUT tensors (TensorWrapper parity). Under an active
+hook pair the node
+
+- packs each saved input at capture time and drops both the per-node
+  strong input refs and the eager ``jax.vjp`` closure — the residuals'
+  device buffers are no longer pinned by the node; the hook's storage is
+  authoritative;
+- at backward, unpacks the inputs and re-derives the vjp through the op's
+  saved pure function (recompute-from-inputs, the remat trade: the op
+  forward reruns once inside backward).
+
+Hooks are an EAGER memory feature: ops traced under jit/static recording
+skip them (the surrounding trace owns residual placement there), matching
+the reference's dygraph-only support. Known exclusion: ``PyLayer``
+``ctx.save_for_backward`` keeps its own strong refs and does NOT route
+through these hooks — activations saved inside a custom PyLayer are not
+offloaded.
+"""
+from __future__ import annotations
+
+import contextlib
+
+_HOOK_STACK: list = []
+_SUSPENDED = [False]
+
+
+def current_hooks():
+    """The innermost active (pack_hook, unpack_hook), or None. Always None
+    while a pack/unpack hook is itself running — ops the hooks call (e.g.
+    ``t.astype`` inside a bf16 pack) must not re-enter the hooks, which
+    would recurse without bound."""
+    if _SUSPENDED[0]:
+        return None
+    return _HOOK_STACK[-1] if _HOOK_STACK else None
+
+
+@contextlib.contextmanager
+def hooks_suspended():
+    """Run pack/unpack hook bodies with hook capture off (reentrancy
+    guard)."""
+    prev = _SUSPENDED[0]
+    _SUSPENDED[0] = True
+    try:
+        yield
+    finally:
+        _SUSPENDED[0] = prev
+
+
+class saved_tensors_hooks:
+    """Context manager registering a pack/unpack hook pair.
+
+    Example (CPU offload round trip)::
+
+        def pack(t):            # device -> host
+            return np.asarray(t.numpy())
+
+        def unpack(arr):        # host -> device
+            return paddle.to_tensor(arr)
+
+        with paddle.autograd.saved_tensors_hooks(pack, unpack):
+            y = model(x)        # activations saved through pack
+        y.sum().backward()      # unpack runs here
+
+    Nestable; the innermost pair wins for ops recorded inside it.
+    """
+
+    def __init__(self, pack_hook, unpack_hook):
+        if not callable(pack_hook) or not callable(unpack_hook):
+            raise TypeError("saved_tensors_hooks needs two callables "
+                            "(pack_hook, unpack_hook)")
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        _HOOK_STACK.append((self.pack_hook, self.unpack_hook))
+        return self
+
+    def __exit__(self, *exc):
+        _HOOK_STACK.pop()
+        return False
+
+
+__all__ = ["saved_tensors_hooks", "current_hooks", "hooks_suspended"]

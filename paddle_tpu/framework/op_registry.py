@@ -124,6 +124,9 @@ _bulk([
     "accuracy", "auc", "py_func",
     "gather_tree", "class_center_sample", "top_p_sampling", "weight_quantize",
     "matrix_nms", "generate_proposals", "distribute_fpn_proposals",
+    # decode-only serving attention (no VJP: inference path, the Pallas
+    # kernel defines no backward — round-7 paged serving subsystem)
+    "paged_attention",
 ], non_diff=True)
 
 # -- passthrough ops: run in the input dtype, differentiable ----------------

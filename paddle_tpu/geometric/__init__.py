@@ -78,5 +78,31 @@ def send_uv(x: Tensor, y: Tensor, src_index: Tensor, dst_index: Tensor,
     return apply_op("send_uv", fn, x, y, src_index, dst_index)
 
 
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size=-1, eids=None, return_eids=False,
+                              name=None):
+    """Weight-biased neighbor sampling from a CSC graph (reference:
+    geometric/sampling/neighbor_sample.py weighted_sample_neighbors):
+    sample up to ``sample_size`` in-neighbors of each input node WITHOUT
+    replacement, picking each neighbor with probability proportional to
+    its ``edge_weight`` (A-ExpJ reservoir in the reference kernel — the
+    same weighted-without-replacement distribution drawn here on the
+    host). Returns (neighbors, count[, eids]).
+
+    Host op like ``graph_sample_neighbors`` (data-dependent output size),
+    seeded from the framework generator so ``paddle.seed`` replays the
+    samples; both ride the shared CSC sampler in ``incubate.graph_ops``.
+    """
+    from ..incubate.graph_ops import sample_csc_neighbors
+
+    neighbors, count, picked = sample_csc_neighbors(
+        row, colptr, input_nodes, sample_size=sample_size, eids=eids,
+        return_eids=return_eids, edge_weight=edge_weight)
+    if return_eids:
+        return neighbors, count, picked
+    return neighbors, count
+
+
 __all__ = ["send_u_recv", "send_ue_recv", "send_uv", "segment_sum",
-           "segment_mean", "segment_max", "segment_min"]
+           "segment_mean", "segment_max", "segment_min",
+           "weighted_sample_neighbors"]

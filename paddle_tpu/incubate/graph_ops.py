@@ -42,15 +42,30 @@ def _host_rng():
     return np.random.default_rng(np.random.SeedSequence(words.tolist()))
 
 
-def graph_sample_neighbors(row, colptr, input_nodes, eids=None,
-                           perm_buffer=None, sample_size=-1,
-                           return_eids=False, flag_perm_buffer=False,
-                           name=None):
-    """Uniformly sample up to ``sample_size`` in-neighbors of each input
-    node from a CSC graph (reference graph_sample_neighbors.py:28).
-    Returns (neighbors, count[, eids])."""
+def sample_csc_neighbors(row, colptr, input_nodes, *, sample_size=-1,
+                         eids=None, return_eids=False, edge_weight=None):
+    """Shared CSC neighbor sampler behind ``graph_sample_neighbors``
+    (uniform) and ``geometric.weighted_sample_neighbors`` (weight-biased):
+    up to ``sample_size`` in-neighbors per input node WITHOUT replacement,
+    drawn from the framework-seeded host RNG. With ``edge_weight`` the
+    draw is Efraimidis–Spirakis exponential keys ``log(u)/w`` — equivalent
+    to successive weight-proportional draws without replacement (the
+    reference kernel's A-ExpJ distribution); zero-weight edges lose to
+    every positive-weight edge and fill remaining slots uniformly.
+    Returns (neighbors, count, eids_or_None)."""
     row_np, colptr_np, nodes = _np(row), _np(colptr), _np(input_nodes)
     eids_np = _np(eids) if eids is not None else None
+    if return_eids and eids_np is None:
+        raise ValueError("return_eids=True requires eids")
+    w_np = None
+    if edge_weight is not None:
+        w_np = _np(edge_weight).reshape(-1).astype(np.float64)
+        if w_np.shape[0] != row_np.reshape(-1).shape[0]:
+            raise ValueError(
+                f"edge_weight has {w_np.shape[0]} entries for "
+                f"{row_np.reshape(-1).shape[0]} edges")
+        if np.any(w_np < 0):
+            raise ValueError("edge_weight must be non-negative")
     rng = _host_rng()
     out_n, out_c, out_e = [], [], []
     for n in nodes.reshape(-1):
@@ -59,7 +74,18 @@ def graph_sample_neighbors(row, colptr, input_nodes, eids=None,
         ids = (eids_np[start:end] if eids_np is not None
                else np.arange(start, end))
         if sample_size > 0 and len(neigh) > sample_size:
-            pick = rng.choice(len(neigh), size=sample_size, replace=False)
+            if w_np is None:
+                pick = rng.choice(len(neigh), size=sample_size,
+                                  replace=False)
+            else:
+                # pre-permute so ties among zero-weight keys (-inf) break
+                # uniformly instead of by index order
+                perm = rng.permutation(len(neigh))
+                u = rng.random(len(neigh))
+                w = w_np[start:end][perm]
+                with np.errstate(divide="ignore"):
+                    keys = np.where(w > 0, np.log(u) / w, -np.inf)
+                pick = perm[np.argsort(keys)[::-1][:sample_size]]
             neigh, ids = neigh[pick], ids[pick]
         out_n.append(neigh)
         out_e.append(ids)
@@ -67,10 +93,24 @@ def graph_sample_neighbors(row, colptr, input_nodes, eids=None,
     neighbors = Tensor(jnp.asarray(np.concatenate(out_n) if out_n
                                    else np.zeros(0, row_np.dtype)))
     count = Tensor(jnp.asarray(np.asarray(out_c, np.int32)))
+    picked_eids = (Tensor(jnp.asarray(np.concatenate(out_e) if out_e
+                                      else np.zeros(0, np.int64)))
+                   if return_eids else None)
+    return neighbors, count, picked_eids
+
+
+def graph_sample_neighbors(row, colptr, input_nodes, eids=None,
+                           perm_buffer=None, sample_size=-1,
+                           return_eids=False, flag_perm_buffer=False,
+                           name=None):
+    """Uniformly sample up to ``sample_size`` in-neighbors of each input
+    node from a CSC graph (reference graph_sample_neighbors.py:28).
+    Returns (neighbors, count[, eids])."""
+    neighbors, count, picked = sample_csc_neighbors(
+        row, colptr, input_nodes, sample_size=sample_size, eids=eids,
+        return_eids=return_eids)
     if return_eids:
-        if eids_np is None:
-            raise ValueError("return_eids=True requires eids")
-        return neighbors, count, Tensor(jnp.asarray(np.concatenate(out_e)))
+        return neighbors, count, picked
     return neighbors, count
 
 

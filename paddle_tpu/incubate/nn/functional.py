@@ -256,6 +256,27 @@ def variable_length_memory_efficient_attention(query, key, value, seq_lens,
                     seq_lens, kv_seq_lens, mask)
 
 
+def paged_attention(q, k_pages, v_pages, page_table, seq_lens, scale=None,
+                    use_kernel=None):
+    """Paged decode attention over the block-paged KV cache (round-7
+    serving path; reference surface: the block_multihead_attention family's
+    decode step, vLLM page-table layout). One query token per sequence:
+    ``q`` [b, num_q_heads, head_dim] attends its slot's cached prefix read
+    through ``page_table`` [b, pages_per_slot] from the page pools
+    [num_pages, page_size, kv_heads, head_dim]; ``seq_lens`` [b] are the
+    ragged context lengths (0 = empty slot -> zero output). Pallas kernel
+    on TPU (``use_kernel=True`` forces interpret mode off-TPU), jnp gather
+    reference elsewhere. Decode-only: not differentiable."""
+    from ...ops.pallas import paged_attention as _pa
+
+    def fn(q_, kp, vp, pt, lens):
+        return _pa.paged_attention(q_, kp, vp, pt, lens, scale=scale,
+                                   use_kernel=use_kernel)
+
+    return apply_op("paged_attention", fn, q, k_pages, v_pages, page_table,
+                    seq_lens)
+
+
 def swiglu(x, y=None):
     """SwiGLU activation (reference: incubate fused swiglu): if y is None, x
     splits in half on the last dim."""
@@ -277,7 +298,7 @@ __all__ = [
     "fused_matmul_bias", "fused_dot_product_attention", "fused_feedforward",
     "fused_multi_head_attention", "masked_multihead_attention",
     "fused_multi_transformer", "fused_ec_moe", "fused_gate_attention",
-    "block_multihead_attention",
+    "block_multihead_attention", "paged_attention",
 ]
 
 

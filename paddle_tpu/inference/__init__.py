@@ -168,5 +168,20 @@ def get_version() -> str:
 PrecisionType = type("PrecisionType", (), {"Float32": 0, "Half": 1, "Int8": 2})
 PlaceType = type("PlaceType", (), {"CPU": 0, "GPU": 1, "XPU": 2, "CUSTOM": 3})
 
+
+def __getattr__(name):
+    # round-7 serving subsystem: lazy so importing paddle_tpu.inference for
+    # the StableHLO Predictor never pulls the models package
+    if name in ("ServingPredictor", "Request", "KVCacheManager"):
+        import importlib
+
+        mod = importlib.import_module(
+            ".kv_cache" if name == "KVCacheManager" else ".serving",
+            __name__)
+        return getattr(mod, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
 __all__ = ["Config", "Predictor", "Tensor_", "create_predictor",
-           "get_version", "PrecisionType", "PlaceType"]
+           "get_version", "PrecisionType", "PlaceType",
+           "ServingPredictor", "Request", "KVCacheManager"]

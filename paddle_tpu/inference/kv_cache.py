@@ -1,0 +1,210 @@
+"""Paged KV cache manager — the serving cache behind paged decode attention.
+
+Reference shape: the vLLM-style block manager behind the reference's
+``block_multihead_attention`` serving path, TPU-native: the cache is a POOL
+of fixed-size pages ``[num_layers, num_pages, page_size, kv_heads,
+head_dim]`` (one stacked array per K and V so the decode jit sees ONE
+pytree leaf each), and each admitted sequence owns a list of pages through
+a per-slot page table. Admission/eviction move pages between the free list
+and slots without copying K/V — fragmentation-free continuous batching.
+
+Split of responsibilities:
+
+- **host side (this class)**: page free list, slot free list, admission
+  (can the prompt + headroom fit?), per-step growth (allocate a page when a
+  sequence crosses a page boundary), eviction. All O(pages) numpy/python —
+  never inside a compiled program.
+- **device side (pure functions below)**: the scatters that write prefill
+  K/V and per-step decode K/V into the page pool. They are shape-stable
+  jnp functions traced INTO the prefill/decode jits (models/gpt.py), so the
+  cache arrays never round-trip through the host.
+
+Page-table convention (shared with ops/pallas/paged_attention):
+``page_table[slot, i]`` is the pool index of the slot's i-th page, ``-1``
+when unallocated; ``seq_lens[slot]`` counts tokens already written (0 =
+empty slot). Writes to unallocated/out-of-range positions are routed out of
+bounds and dropped (``mode="drop"``) rather than corrupting page 0.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+import jax.numpy as jnp
+
+
+def pages_needed(length: int, page_size: int) -> int:
+    """Pages a ``length``-token sequence occupies (>= 1) — the ONE spelling
+    of the ceil-div every pool-sizing site shares."""
+    return math.ceil(max(length, 1) / page_size)
+
+
+# ---------------------------------------------------------------------------
+# device-side pure scatter helpers (traced into the prefill/decode jits)
+# ---------------------------------------------------------------------------
+
+
+def paged_write_tokens(pages, tok, page_table, positions, page_size):
+    """Write ONE token per slot into the page pool (the decode-step write).
+
+    pages: [num_pages, page_size, kv_heads, head_dim]; tok: [batch,
+    kv_heads, head_dim]; page_table: [batch, pages_per_slot] int32;
+    positions: [batch] int32 write position per slot (< 0 = inactive slot,
+    dropped). Returns the updated pool.
+    """
+    num_pages = pages.shape[0]
+    b = tok.shape[0]
+    pos = jnp.maximum(positions, 0)
+    pg = page_table[jnp.arange(b), pos // page_size]
+    # inactive slots and unallocated (-1) entries route out of bounds
+    pg = jnp.where((positions >= 0) & (pg >= 0), pg, num_pages)
+    return pages.at[pg, pos % page_size].set(tok, mode="drop")
+
+
+def paged_write_prefill(pages, seq, pages_for_slot, length, page_size):
+    """Scatter one slot's prompt K/V into its pages (copy-on-prefill).
+
+    pages: [num_pages, page_size, kv_heads, head_dim]; seq: [s_pad,
+    kv_heads, head_dim] (positions >= length are padding and dropped);
+    pages_for_slot: [pages_per_slot] int32 (-1 unallocated); length: scalar.
+    """
+    num_pages = pages.shape[0]
+    s_pad = seq.shape[0]
+    i = jnp.arange(s_pad)
+    pg = pages_for_slot[jnp.minimum(i // page_size,
+                                    pages_for_slot.shape[0] - 1)]
+    pg = jnp.where((i < length) & (pg >= 0), pg, num_pages)
+    return pages.at[pg, i % page_size].set(seq, mode="drop")
+
+
+# ---------------------------------------------------------------------------
+# host-side manager
+# ---------------------------------------------------------------------------
+
+
+class KVCacheManager:
+    """Owns the page pool + page table + free lists for one model.
+
+    ``num_pages`` bounds total cached tokens (``num_pages * page_size``);
+    ``max_batch`` bounds concurrent sequences (decode-step batch — the
+    FIXED jit shape); ``max_seq_len`` bounds per-sequence length (page-table
+    width). ``page_size=None`` consults the autotuned
+    :func:`~paddle_tpu.ops.pallas.paged_attention.preferred_page_size`.
+    """
+
+    def __init__(self, num_layers, num_kv_heads, head_dim, *, num_pages,
+                 max_batch, max_seq_len, page_size=None, num_q_heads=None,
+                 dtype=jnp.float32):
+        from ..ops.pallas.paged_attention import preferred_page_size
+
+        if page_size is None:
+            page_size = preferred_page_size(
+                num_q_heads or num_kv_heads, num_kv_heads, head_dim, dtype)
+        self.num_layers = num_layers
+        self.num_kv_heads = num_kv_heads
+        self.head_dim = head_dim
+        self.page_size = int(page_size)
+        self.num_pages = int(num_pages)
+        self.max_batch = int(max_batch)
+        self.max_seq_len = int(max_seq_len)
+        self.pages_per_slot = math.ceil(self.max_seq_len / self.page_size)
+        shape = (num_layers, self.num_pages, self.page_size,
+                 num_kv_heads, head_dim)
+        self.k_pages = jnp.zeros(shape, dtype)
+        self.v_pages = jnp.zeros(shape, dtype)
+        # host-side bookkeeping (numpy; uploaded per step as small arrays)
+        self._page_table = np.full(
+            (self.max_batch, self.pages_per_slot), -1, np.int32)
+        self._seq_lens = np.zeros((self.max_batch,), np.int32)
+        self._free_pages = list(range(self.num_pages - 1, -1, -1))  # pop()
+        self._free_slots = list(range(self.max_batch - 1, -1, -1))
+
+    # -- capacity ----------------------------------------------------------
+
+    @property
+    def free_page_count(self) -> int:
+        return len(self._free_pages)
+
+    @property
+    def free_slot_count(self) -> int:
+        return len(self._free_slots)
+
+    def pages_needed(self, length: int) -> int:
+        return pages_needed(length, self.page_size)
+
+    def can_admit(self, prompt_len: int) -> bool:
+        return (bool(self._free_slots)
+                and prompt_len <= self.max_seq_len
+                and self.pages_needed(prompt_len) <= len(self._free_pages))
+
+    # -- admission / growth / eviction ------------------------------------
+
+    def admit(self, prompt_len: int) -> int:
+        """Claim a slot + the pages the prompt needs; returns the slot id.
+        Raises RuntimeError when out of slots/pages (the scheduler checks
+        :meth:`can_admit` and queues instead)."""
+        if prompt_len > self.max_seq_len:
+            raise RuntimeError(
+                f"prompt of {prompt_len} tokens exceeds max_seq_len "
+                f"{self.max_seq_len}")
+        if not self._free_slots:
+            raise RuntimeError("no free decode slots")
+        need = self.pages_needed(prompt_len)
+        if need > len(self._free_pages):
+            raise RuntimeError(
+                f"cache exhausted: need {need} pages, "
+                f"{len(self._free_pages)} free")
+        slot = self._free_slots.pop()
+        for i in range(need):
+            self._page_table[slot, i] = self._free_pages.pop()
+        self._seq_lens[slot] = prompt_len
+        return slot
+
+    def ensure_capacity(self, slot: int, new_len: int) -> bool:
+        """Allocate pages so ``slot`` can hold ``new_len`` tokens. Returns
+        False (allocating nothing) when the pool cannot satisfy it — the
+        scheduler then evicts or stalls the sequence."""
+        if new_len > self.max_seq_len:
+            return False
+        have = int((self._page_table[slot] >= 0).sum())
+        need = self.pages_needed(new_len)
+        if need <= have:
+            return True
+        if need - have > len(self._free_pages):
+            return False
+        for i in range(have, need):
+            self._page_table[slot, i] = self._free_pages.pop()
+        return True
+
+    def advance(self, slot: int, n: int = 1) -> None:
+        self._seq_lens[slot] += n
+
+    def free(self, slot: int) -> None:
+        """Evict: return the slot's pages to the pool, park the slot."""
+        for i in range(self.pages_per_slot):
+            pg = int(self._page_table[slot, i])
+            if pg >= 0:
+                self._free_pages.append(pg)
+            self._page_table[slot, i] = -1
+        self._seq_lens[slot] = 0
+        self._free_slots.append(slot)
+
+    # -- device views ------------------------------------------------------
+
+    def page_table_device(self) -> jnp.ndarray:
+        return jnp.asarray(self._page_table)
+
+    def seq_lens_device(self) -> jnp.ndarray:
+        return jnp.asarray(self._seq_lens)
+
+    def seq_len(self, slot: int) -> int:
+        return int(self._seq_lens[slot])
+
+    def slot_pages(self, slot: int) -> jnp.ndarray:
+        return jnp.asarray(self._page_table[slot])
+
+    def update_pages(self, k_pages, v_pages) -> None:
+        """Adopt the pools returned by a jitted prefill/decode step."""
+        self.k_pages = k_pages
+        self.v_pages = v_pages

@@ -1,0 +1,353 @@
+"""Continuous-batching autoregressive serving over the paged KV cache.
+
+The round-7 serving front end: the classic continuous-batching loop
+(Orca/vLLM; reference surface: the fused-transformer serving family that
+``block_multihead_attention`` feeds) on top of
+
+- :class:`~paddle_tpu.inference.kv_cache.KVCacheManager` — page pool,
+  admission, eviction;
+- ``models/gpt.py`` ``build_prefill`` / ``build_decode_step`` — one jit for
+  each prompt-length bucket, ONE fixed-shape jit for the decode step.
+
+Request lifecycle: WAITING (queued) -> RUNNING (owns a decode slot + pages)
+-> FINISHED (eos / max_new_tokens). Between decode steps the scheduler
+admits waiting requests into free slots (prefilling their prompts straight
+into their pages) and frees finished ones — sequences join and leave the
+batch WITHOUT restarting it, so short requests never wait for long ones and
+the decode jit's batch lanes (``max_batch``) stay the fixed compile shape
+with empty lanes masked by ``seq_len == 0``.
+
+Capacity pressure: when a running sequence cannot grow (page pool
+exhausted) the YOUNGEST running request is preempted back to the waiting
+queue — its pages are freed and its prompt + generated prefix re-prefills
+on the next admission (vLLM's recompute-mode preemption, the policy that
+needs no swap space).
+
+Knobs: ``max_batch`` (decode lanes), ``num_pages``/``page_size`` (pool
+geometry = max cached tokens), ``max_seq_len`` (page-table width).
+"""
+from __future__ import annotations
+
+from collections import deque
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from .kv_cache import KVCacheManager, pages_needed
+
+WAITING, RUNNING, FINISHED = "waiting", "running", "finished"
+
+
+class Request:
+    """One generation request; ``output_ids`` fills as decode steps land."""
+
+    _next_id = [0]
+
+    def __init__(self, prompt_ids, max_new_tokens=32, eos_token_id=None):
+        self.req_id = Request._next_id[0]
+        Request._next_id[0] += 1
+        self.prompt_ids = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
+        if not self.prompt_ids:
+            raise ValueError("empty prompt")
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_token_id = eos_token_id
+        self.output_ids: list[int] = []
+        self.state = WAITING
+        self.preempt_count = 0
+        self.truncated = False  # stopped by the max_seq_len ceiling
+
+    @property
+    def done(self) -> bool:
+        if self.truncated:
+            return True
+        if len(self.output_ids) >= self.max_new_tokens:
+            return True
+        return (self.eos_token_id is not None and self.output_ids
+                and self.output_ids[-1] == self.eos_token_id)
+
+    def _context_ids(self) -> list[int]:
+        """Prompt + generated-so-far — what a re-prefill after preemption
+        replays (all but the LAST token go through prefill; the last one is
+        the next decode step's input)."""
+        return self.prompt_ids + self.output_ids
+
+
+class ServingPredictor:
+    """Continuous-batching decode predictor for a GPT model.
+
+    ``add_request`` enqueues; ``step`` runs one decode step for every
+    running sequence (admitting/evicting around it); ``generate`` is the
+    batch convenience that drives ``step`` until a set of prompts finishes.
+    """
+
+    def __init__(self, model, *, max_batch=8, num_pages=None, page_size=None,
+                 max_seq_len=None, use_kernel=None, prefill_bucket=16,
+                 dtype=None):
+        from ..models.gpt import (_serving_params_cached, build_decode_step,
+                                  build_prefill, serving_params)
+
+        gpt = model.gpt if hasattr(model, "gpt") else model
+        self.config = gpt.config
+        cfg = self.config
+        if dtype is None:
+            # share the weak-keyed extraction with generate() — a second
+            # predictor (or generate call) on one model reuses the stacks
+            self.params = _serving_params_cached(model)
+        else:
+            import jax
+
+            self.params = jax.tree.map(lambda a: a.astype(dtype),
+                                       serving_params(model))
+        # the model's position table bounds every context
+        self.max_seq_len = min(int(max_seq_len or cfg.max_seq_len),
+                               cfg.max_seq_len)
+        self.max_batch = int(max_batch)
+        self.prefill_bucket = int(prefill_bucket)
+        kv_dtype = self.params["tok_emb"].dtype
+        if num_pages is None:
+            # default pool: every lane can reach max_seq_len
+            from ..ops.pallas.paged_attention import preferred_page_size
+
+            ps = page_size or preferred_page_size(
+                cfg.num_heads, cfg.num_heads, cfg.head_dim, kv_dtype)
+            num_pages = self.max_batch * pages_needed(self.max_seq_len, ps)
+        self.cache = KVCacheManager(
+            cfg.num_layers, cfg.num_heads, cfg.head_dim,
+            num_pages=num_pages, max_batch=self.max_batch,
+            max_seq_len=self.max_seq_len, page_size=page_size,
+            num_q_heads=cfg.num_heads, dtype=kv_dtype)
+        self._decode = build_decode_step(cfg, self.cache.page_size,
+                                         use_kernel=use_kernel)
+        # one jitted prefill; jax.jit caches one executable per prompt
+        # bucket shape (prompts are padded to _bucket multiples)
+        self._prefill = build_prefill(cfg, self.cache.page_size)
+        self.waiting: deque[Request] = deque()
+        self.running: dict[int, Request] = {}   # slot -> request
+        self._next_token = np.zeros((self.max_batch,), np.int32)
+        self.steps = 0
+
+    # -- queue API ---------------------------------------------------------
+
+    def add_request(self, prompt_ids, max_new_tokens=32,
+                    eos_token_id=None) -> Request:
+        req = Request(prompt_ids, max_new_tokens, eos_token_id)
+        if len(req.prompt_ids) > self.max_seq_len:
+            raise ValueError(
+                f"prompt of {len(req.prompt_ids)} tokens exceeds "
+                f"max_seq_len {self.max_seq_len}")
+        self.waiting.append(req)
+        return req
+
+    @property
+    def decode_trace_count(self) -> int:
+        """Times the decode step has been (re)traced — the no-retrace gate
+        asserts this stays constant after warmup."""
+        return self._decode.trace_count[0]
+
+    # -- internals ---------------------------------------------------------
+
+    def _bucket(self, n: int) -> int:
+        b = self.prefill_bucket
+        return max(b, ((n + b - 1) // b) * b)
+
+    def _admit_one(self, req: Request) -> bool:
+        """Claim a slot + pages and prefill ``req``'s context into them."""
+        ctx = req._context_ids()
+        prefix, last = ctx[:-1], ctx[-1]
+        # all but the LAST context token prefill; the last token becomes
+        # the next decode step's input, and that step produces its
+        # successor. A 1-token context has no prefix to split: prefill the
+        # token itself and take the prefill's greedy argmax as the first
+        # output instead.
+        if not prefix:
+            prefix, last = ctx, None
+        need_len = len(prefix)
+        # vLLM-style watermark: with other sequences running, keep one
+        # free page of growth headroom past the prompt's own need —
+        # an exactly-fitting admission would be preempted (its whole
+        # prefill discarded) by the same step's growth pass
+        headroom = 1 if self.running else 0
+        if (not self.cache.can_admit(need_len)
+                or self.cache.free_page_count
+                < self.cache.pages_needed(need_len) + headroom):
+            return False
+        if len(ctx) > self.max_seq_len:
+            raise ValueError(
+                f"request {req.req_id}: context {len(ctx)} exceeds "
+                f"max_seq_len {self.max_seq_len}")
+        slot = self.cache.admit(need_len)
+        # bucket rounding must not push the prefill shape past the model's
+        # position table (max_seq_len need not be a bucket multiple)
+        padded = min(self._bucket(need_len), self.config.max_seq_len)
+        ids = np.zeros((1, padded), np.int32)
+        ids[0, :need_len] = prefix
+        next_ids, _, kp, vp = self._prefill(
+            self.params, jnp.asarray(ids),
+            jnp.asarray([need_len], jnp.int32),
+            self.cache.k_pages, self.cache.v_pages,
+            self.cache.slot_pages(slot)[None])
+        self.cache.update_pages(kp, vp)
+        if last is None:
+            # 1-token context: the prefill's greedy token IS the first
+            # generated token; decode continues from it
+            tok = int(np.asarray(next_ids)[0])
+            req.output_ids.append(tok)
+            self._next_token[slot] = tok
+        else:
+            # multi-token context (fresh prompt or preemption replay):
+            # the last context token enters the next decode step, which
+            # produces its not-yet-recorded successor
+            self._next_token[slot] = last
+        req.state = RUNNING
+        self.running[slot] = req
+        return True
+
+    def _admit_waiting(self) -> None:
+        while self.waiting and self.cache.free_slot_count:
+            req = self.waiting[0]
+            # a request finished by its prefill token alone never decodes
+            if req.done:
+                self.waiting.popleft()
+                req.state = FINISHED
+                continue
+            if len(req._context_ids()) > self.max_seq_len:
+                # preempted while sitting AT the length ceiling (its own
+                # truncation check never ran that round): finish it as
+                # truncated, same as the in-loop ceiling stop
+                self.waiting.popleft()
+                req.truncated = True
+                req.state = FINISHED
+                continue
+            if not self._admit_one(req):
+                # head-of-line blocking keeps FIFO order — but if nothing
+                # is running and the whole pool is free, this request can
+                # NEVER fit: fail with the real cause instead of letting
+                # generate() spin empty steps into its budget error
+                if (not self.running and self.cache.free_page_count
+                        == self.cache.num_pages):
+                    need = self.cache.pages_needed(
+                        len(req._context_ids()) - 1)
+                    raise RuntimeError(
+                        f"request {req.req_id}: context of "
+                        f"{len(req._context_ids())} tokens needs {need} "
+                        f"pages but the pool only has "
+                        f"{self.cache.num_pages} — raise num_pages or "
+                        "page_size")
+                break
+            self.waiting.popleft()
+
+    def _preempt_youngest(self) -> bool:
+        """Free the youngest running request back to the waiting queue."""
+        if not self.running:
+            return False
+        slot = max(self.running,
+                   key=lambda s: self.running[s].req_id)
+        req = self.running.pop(slot)
+        self.cache.free(slot)
+        req.state = WAITING
+        req.preempt_count += 1
+        self.waiting.appendleft(req)
+        return True
+
+    def _retire_finished(self) -> None:
+        for slot in [s for s, r in self.running.items() if r.done]:
+            req = self.running.pop(slot)
+            self.cache.free(slot)
+            req.state = FINISHED
+
+    # -- the step ----------------------------------------------------------
+
+    def step(self) -> dict[int, int]:
+        """One scheduler round: retire finished, admit waiting, grow pages
+        (preempting under pressure), ONE fixed-shape decode step. Returns
+        ``{req_id: token}`` for the tokens produced this step."""
+        self._retire_finished()
+        # admit/retire to fixpoint: a fresh prompt whose prefill token
+        # already satisfies done (budget 1, or prefill token == eos) must
+        # retire BEFORE the decode step — it would otherwise collect a
+        # second token past its contract — and its freed lane can admit
+        # the next waiting request within this same round
+        while True:
+            self._admit_waiting()
+            if not any(r.done for r in self.running.values()):
+                break
+            self._retire_finished()
+        if not self.running:
+            return {}
+        # growth: every running sequence needs room for one more token.
+        # sorted() snapshots the slots — a preemption further down this
+        # loop removes entries, and a freed slot must not re-enter the
+        # capacity path (it would allocate pages into a parked page table)
+        for slot in sorted(self.running):
+            if slot not in self.running:
+                continue
+            if self.cache.seq_len(slot) + 1 > self.max_seq_len:
+                # hit the length ceiling: stop the sequence NOW (truncation-
+                # stop, flagged on the request) and park its lane before the
+                # decode would write past the page-table width
+                req = self.running.pop(slot)
+                req.truncated = True
+                self.cache.free(slot)
+                req.state = FINISHED
+                continue
+            while not self.cache.ensure_capacity(
+                    slot, self.cache.seq_len(slot) + 1):
+                # page pressure: shed the youngest request (never this one
+                # unless it IS the youngest and alone — then it cannot run)
+                victim_is_self = (max(self.running,
+                                      key=lambda s: self.running[s].req_id)
+                                  == slot)
+                if victim_is_self and len(self.running) == 1:
+                    raise RuntimeError(
+                        f"slot {slot}: cannot grow to "
+                        f"{self.cache.seq_len(slot) + 1} tokens — page pool "
+                        "too small for a single sequence")
+                self._preempt_youngest()
+                if slot not in self.running:  # preempted itself
+                    break
+        ids = jnp.asarray(self._next_token)
+        next_ids, _, kp, vp = self._decode(
+            self.params, ids, self.cache.seq_lens_device(),
+            self.cache.k_pages, self.cache.v_pages,
+            self.cache.page_table_device())
+        self.cache.update_pages(kp, vp)
+        self.steps += 1
+        out = np.asarray(next_ids)
+        produced = {}
+        for slot, req in self.running.items():
+            tok = int(out[slot])
+            req.output_ids.append(tok)
+            self._next_token[slot] = tok
+            self.cache.advance(slot)
+            produced[req.req_id] = tok
+        return produced
+
+    def has_work(self) -> bool:
+        return bool(self.waiting or self.running)
+
+    # -- convenience -------------------------------------------------------
+
+    def generate(self, prompts, max_new_tokens=32, eos_token_id=None,
+                 max_steps=None):
+        """Enqueue ``prompts`` (list of id lists) and drive steps until all
+        finish. Returns a list of output-id lists, in prompt order."""
+        reqs = [self.add_request(p, max_new_tokens, eos_token_id)
+                for p in prompts]
+        limit = max_steps or (len(prompts) * (max_new_tokens + 2)
+                              * (self.max_batch + 1))
+        n = 0
+        while any(r.state != FINISHED for r in reqs):
+            self.step()
+            # a drained scheduler with unfinished requests means they can
+            # never be admitted (oversized); surface rather than spin
+            if not self.has_work():
+                break
+            n += 1
+            if n > limit:
+                raise RuntimeError("serving loop exceeded step budget "
+                                   f"({limit}) — scheduler stuck")
+        return [list(r.output_ids) for r in reqs]
+
+
+__all__ = ["Request", "ServingPredictor", "WAITING", "RUNNING", "FINISHED"]

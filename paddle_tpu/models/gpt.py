@@ -14,6 +14,7 @@ mp_layers.py:47,:333,:540) where GSPMD emits the collectives.
 """
 from __future__ import annotations
 
+import math
 from dataclasses import dataclass
 
 from ..framework.param_attr import ParamAttr
@@ -294,6 +295,11 @@ class GPTModel(Layer):
             return x, new_caches
         return x
 
+    def generate(self, input_ids, max_new_tokens=20, **kw):
+        """Greedy decoding over the paged KV cache with the tied-embedding
+        LM head — see :func:`generate_paged`."""
+        return generate_paged(self, input_ids, max_new_tokens, **kw)
+
 
 class GPTPretrainingCriterion(Layer):
     """Shifted next-token cross-entropy (mean over tokens)."""
@@ -342,3 +348,413 @@ class GPTForCausalLM(Layer):
         shift_logits = logits[:, :-1, :]
         shift_labels = labels[:, 1:]
         return self.criterion(shift_logits, shift_labels)
+
+    def generate(self, input_ids, max_new_tokens=20, **kw):
+        """Greedy autoregressive decoding over the paged KV cache — see
+        :func:`generate_paged`."""
+        return generate_paged(self, input_ids, max_new_tokens, **kw)
+
+
+# ---------------------------------------------------------------------------
+# Round-7 serving path: paged KV cache + fixed-shape decode step.
+#
+# The autoregressive analog of gpt_spmd's training step: pure functions over
+# a params pytree EXTRACTED from the Layer model (one-time, zero-copy on the
+# underlying arrays), so prefill compiles as ONE jit and every decode step
+# replays ONE fixed-shape jit — no per-token Python dispatch, no retrace
+# (MPK's whole-step-as-one-program argument, arxiv 2512.22219). K/V live in
+# the paged pool managed by inference.kv_cache.KVCacheManager and attention
+# over the ragged batch runs the Pallas paged decode kernel
+# (ops/pallas/paged_attention, arxiv 2604.15464).
+# ---------------------------------------------------------------------------
+
+
+# the ONE per-layer weight table: serving_params' stacks AND the params
+# cache's staleness walk both derive from it, so adding a per-layer weight
+# cannot desync the cache oracle from the extraction
+_SRV_LAYER_WEIGHTS = (
+    ("ln1_g", lambda l: l.ln_1.weight), ("ln1_b", lambda l: l.ln_1.bias),
+    ("wqkv", lambda l: l.attn.qkv_proj.weight),
+    ("bqkv", lambda l: l.attn.qkv_proj.bias),
+    ("wo", lambda l: l.attn.out_proj.weight),
+    ("bo", lambda l: l.attn.out_proj.bias),
+    ("ln2_g", lambda l: l.ln_2.weight), ("ln2_b", lambda l: l.ln_2.bias),
+    ("w1", lambda l: l.mlp.fc1.weight), ("b1", lambda l: l.mlp.fc1.bias),
+    ("w2", lambda l: l.mlp.fc2.weight), ("b2", lambda l: l.mlp.fc2.bias),
+)
+
+
+def _srv_nonlayer_weights(model):
+    gpt = model.gpt if hasattr(model, "gpt") else model
+    ws = [("tok_emb", gpt.embeddings.word_embeddings.weight),
+          ("pos_emb", gpt.embeddings.position_embeddings.weight),
+          ("lnf_g", gpt.ln_f.weight), ("lnf_b", gpt.ln_f.bias)]
+    if getattr(model, "lm_head", None) is not None:
+        ws.append(("lm_head", model.lm_head.weight))
+    return ws
+
+
+def _serving_weight_buffers(model):
+    """The model's live weight buffers — buffer identity is the staleness
+    key for the per-model params cache (an optimizer step rebinds
+    ``._data``, so stale ids mean re-extract)."""
+    gpt = model.gpt if hasattr(model, "gpt") else model
+    bufs = [t._data for _, t in _srv_nonlayer_weights(model)]
+    for l in gpt.layers:
+        bufs += [get(l)._data for _, get in _SRV_LAYER_WEIGHTS]
+    return bufs
+
+
+def serving_params(model):
+    """Extract the serving params pytree from a GPTForCausalLM / GPTModel.
+
+    Per-layer weights stack on a leading [L, ...] dim so the blocks run
+    under ``lax.scan`` (one compiled block, not L unrolled copies). The
+    stacks are device COPIES (~1x extra weight memory while they live);
+    the embeddings / final-LN / lm-head leaves are views of the live
+    buffers. ``generate_paged`` caches the extraction per model (see
+    :func:`_serving_params_cached`) so repeated calls don't re-stack.
+    """
+    import jax.numpy as jnp
+
+    gpt = model.gpt if hasattr(model, "gpt") else model
+    cfg = gpt.config
+    if _tp_enabled(cfg):
+        raise NotImplementedError(
+            "the paged serving path is single-shard (GSPMD cannot partition "
+            "the pallas decode kernel); run without tensor parallelism")
+
+    params = {k: t._data for k, t in _srv_nonlayer_weights(model)}
+    params["layers"] = {
+        k: jnp.stack([get(l)._data for l in gpt.layers])
+        for k, get in _SRV_LAYER_WEIGHTS
+    }
+    return params  # lm_head (when untied) rides _srv_nonlayer_weights
+
+
+# NOTE: _srv_ln/_srv_mlp/the prefill block are the serving-side pure
+# spellings of the decoder block — keep their math in lockstep with the
+# eager Layer classes above AND gpt_spmd's _layer_norm/_block_mlp (same
+# params-dict key schema); a drift in eps/gelu/LN-stat handling makes
+# generate() disagree with the trained model. The fp32 LN statistics here
+# are intentional (decode runs the weights' dtype, stats stay fp32).
+def _srv_ln(x, g, b, eps):
+    import jax
+
+    import jax.numpy as jnp
+
+    xf = x.astype(jnp.float32)
+    mu = xf.mean(-1, keepdims=True)
+    var = xf.var(-1, keepdims=True)
+    return (((xf - mu) * jax.lax.rsqrt(var + eps)) * g + b).astype(x.dtype)
+
+
+def _srv_logits(params, h):
+    """h [..., hidden] -> logits [..., vocab] (tied head unless lm_head)."""
+    import jax.numpy as jnp
+
+    if "lm_head" in params:
+        return h @ params["lm_head"]
+    return jnp.einsum("...h,vh->...v", h, params["tok_emb"])
+
+
+def _srv_mlp(p, y):
+    import jax
+
+    return (jax.nn.gelu(y @ p["w1"] + p["b1"], approximate=True)
+            @ p["w2"] + p["b2"])
+
+
+def build_prefill(config: GPTConfig, page_size: int):
+    """One-jit prefill: forward the (right-padded) prompts, scatter each
+    slot's K/V into its pages, return the next-token ids + logits at each
+    prompt's last valid position.
+
+    Signature: ``fn(params, ids[b,s], lengths[b], k_pages, v_pages,
+    pages[b,pps]) -> (next_ids[b], logits[b,v], k_pages, v_pages)``.
+    Ragged prompts ride right-padding: causal masking keeps padded columns
+    out of every valid row's softmax, and the page scatter drops positions
+    past each length.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..inference.kv_cache import paged_write_prefill
+
+    cfg = config
+    eps = cfg.layer_norm_eps
+
+    def prefill(params, ids, lengths, k_pages, v_pages, pages):
+        # MXU-native matmul precision (gpt_spmd.loss_fn convention): the
+        # framework-global "highest" would emulate bf16 serving matmuls
+        # multi-pass, 3-6x slower; attention scores stay explicit fp32
+        with jax.default_matmul_precision("default"):
+            return _prefill_inner(params, ids, lengths, k_pages, v_pages,
+                                  pages)
+
+    def _prefill_inner(params, ids, lengths, k_pages, v_pages, pages):
+        b, s = ids.shape
+        nh, hd = cfg.num_heads, cfg.head_dim
+        x = (jnp.take(params["tok_emb"], ids, axis=0)
+             + params["pos_emb"][:s])
+
+        def block(x, p):
+            y = _srv_ln(x, p["ln1_g"], p["ln1_b"], eps)
+            qkv = (y @ p["wqkv"] + p["bqkv"]).reshape(b, s, 3, nh, hd)
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+            s_ = jnp.einsum("bqnd,bknd->bnqk", q.astype(jnp.float32),
+                            k.astype(jnp.float32)) / math.sqrt(hd)
+            causal = jnp.tril(jnp.ones((s, s), bool))
+            s_ = jnp.where(causal[None, None], s_, -1e30)
+            a = jnp.einsum("bnqk,bknd->bqnd",
+                           jax.nn.softmax(s_, axis=-1),
+                           v.astype(jnp.float32)).astype(x.dtype)
+            x = x + a.reshape(b, s, nh * hd) @ p["wo"] + p["bo"]
+            x = x + _srv_mlp(p, _srv_ln(x, p["ln2_g"], p["ln2_b"], eps))
+            return x, (k, v)
+
+        x, (ks, vs) = jax.lax.scan(block, x, params["layers"])
+        x = _srv_ln(x, params["lnf_g"], params["lnf_b"], eps)
+        h_last = x[jnp.arange(b), jnp.maximum(lengths - 1, 0)]
+        logits = _srv_logits(params, h_last).astype(jnp.float32)
+        next_ids = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+        # copy-on-prefill: scatter every slot's K/V into its pages.
+        # ks: [L, b, s, nh, hd] -> per (layer, slot) writes, vmapped over L
+        def write_all(pool, seqs):
+            for bi in range(b):  # b is static; unrolls into b scatters
+                pool = jax.vmap(
+                    paged_write_prefill, in_axes=(0, 0, None, None, None)
+                )(pool, seqs[:, bi], pages[bi], lengths[bi], page_size)
+            return pool
+
+        k_pages = write_all(k_pages, ks)
+        v_pages = write_all(v_pages, vs)
+        return next_ids, logits, k_pages, v_pages
+
+    # donate the pools like the decode step: every admission threads the
+    # full cache through this jit, and an un-donated scatter would copy it
+    return jax.jit(prefill, donate_argnums=(3, 4))
+
+
+def build_decode_step(config: GPTConfig, page_size: int,
+                      use_kernel: bool | None = None):
+    """The fixed-shape decode step, compiled once per (batch, cache
+    geometry): embed the incoming token, write its K/V into the pages,
+    paged-attend over every layer, emit the greedy next token.
+
+    Signature: ``fn(params, ids[b], lengths[b], k_pages, v_pages,
+    page_table[b,pps]) -> (next_ids[b], logits[b,v], k_pages, v_pages)``.
+    ``lengths`` counts tokens already cached per slot (0 = empty slot —
+    its lane computes masked garbage and writes nothing). Every array
+    argument keeps its shape step over step, so after the first call the
+    loop replays one compiled program — ``fn.trace_count[0]`` exposes the
+    trace count for the no-retrace gate.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..inference.kv_cache import paged_write_tokens
+    from ..ops.pallas.paged_attention import paged_attention
+
+    cfg = config
+    eps = cfg.layer_norm_eps
+    trace_count = [0]
+
+    def step(params, ids, lengths, k_pages, v_pages, page_table):
+        # MXU-native matmul precision — see build_prefill
+        with jax.default_matmul_precision("default"):
+            return _step_inner(params, ids, lengths, k_pages, v_pages,
+                               page_table)
+
+    def _step_inner(params, ids, lengths, k_pages, v_pages, page_table):
+        trace_count[0] += 1
+        b = ids.shape[0]
+        nh, hd = cfg.num_heads, cfg.head_dim
+        active = lengths > 0
+        pos = jnp.where(active, lengths, -1)  # write position = current len
+        pos_emb_idx = jnp.clip(jnp.maximum(lengths, 0),
+                               0, params["pos_emb"].shape[0] - 1)
+        x = (jnp.take(params["tok_emb"], jnp.maximum(ids, 0), axis=0)
+             + params["pos_emb"][pos_emb_idx])          # [b, h]
+        ctx = jnp.where(active, lengths + 1, 0).astype(jnp.int32)
+
+        def block(x, layer):
+            p, kp, vp = layer
+            y = _srv_ln(x, p["ln1_g"], p["ln1_b"], eps)
+            qkv = (y @ p["wqkv"] + p["bqkv"]).reshape(b, 3, nh, hd)
+            q, k_tok, v_tok = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+            kp = paged_write_tokens(kp, k_tok, page_table, pos, page_size)
+            vp = paged_write_tokens(vp, v_tok, page_table, pos, page_size)
+            a = paged_attention(q, kp, vp, page_table, ctx,
+                                use_kernel=use_kernel)  # [b, nh, hd]
+            x = x + a.reshape(b, nh * hd) @ p["wo"] + p["bo"]
+            x = x + _srv_mlp(p, _srv_ln(x, p["ln2_g"], p["ln2_b"], eps))
+            return x, (kp, vp)
+
+        x, (k_pages, v_pages) = jax.lax.scan(
+            block, x, (params["layers"], k_pages, v_pages))
+        x = _srv_ln(x, params["lnf_g"], params["lnf_b"], eps)
+        logits = _srv_logits(params, x).astype(jnp.float32)
+        next_ids = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return next_ids, logits, k_pages, v_pages
+
+    # donate the page pools: the step rewrites them, and double-buffering
+    # the cache (the biggest serving allocation) would halve capacity
+    jitted = jax.jit(step, donate_argnums=(3, 4))
+    jitted.trace_count = trace_count
+    return jitted
+
+
+# generate_paged's compiled programs, keyed by (config fields, page_size,
+# use_kernel): repeated generate() calls replay the same jit instead of
+# re-tracing + re-compiling the whole model each call. ServingPredictor
+# holds its own per-instance pair (its trace counter is a per-predictor
+# gate), so only the convenience path shares.
+_SERVING_JIT_CACHE: dict = {}
+
+# per-model extracted params (the [L, ...] stacks are device copies):
+# weak-keyed so a collected model drops its stacks, id-validated so an
+# optimizer step (which rebinds every ._data) forces re-extraction
+import weakref as _weakref  # noqa: E402
+
+_SERVING_PARAMS_CACHE = _weakref.WeakKeyDictionary()
+
+
+def _serving_params_cached(model):
+    # staleness check by buffer IDENTITY against WEAKLY-held capture-time
+    # buffers: identity comparison is immune to CPython id reuse, and the
+    # weakrefs mean an optimizer step's rebinding doesn't leave ~1x model
+    # weights of dead buffers pinned by the cache key (a dead ref simply
+    # reads as stale)
+    bufs = _serving_weight_buffers(model)
+    hit = _SERVING_PARAMS_CACHE.get(model)
+    if (hit is not None and len(hit[0]) == len(bufs)
+            and all(ref() is cur for ref, cur in zip(hit[0], bufs))):
+        return hit[1]
+    params = serving_params(model)
+    try:
+        _SERVING_PARAMS_CACHE[model] = (
+            [_weakref.ref(b) for b in bufs], params)
+    except TypeError:
+        pass  # un-weakrefable model object: just skip the cache
+    return params
+
+
+def _serving_fns(config: GPTConfig, page_size: int, use_kernel):
+    import dataclasses
+
+    key = (tuple((f.name, getattr(config, f.name))
+                 for f in dataclasses.fields(config)),
+           page_size, use_kernel)
+    hit = _SERVING_JIT_CACHE.get(key)
+    if hit is None:
+        # bounded LRU (same policy as the engine's eager-op cache): a
+        # process sweeping geometries must not pin executables forever
+        while len(_SERVING_JIT_CACHE) >= 32:
+            _SERVING_JIT_CACHE.pop(next(iter(_SERVING_JIT_CACHE)))
+        hit = (build_prefill(config, page_size),
+               build_decode_step(config, page_size, use_kernel=use_kernel))
+    else:
+        _SERVING_JIT_CACHE.pop(key)  # refresh recency
+    _SERVING_JIT_CACHE[key] = hit
+    return hit
+
+
+def generate_paged(model, input_ids, max_new_tokens=20, *, page_size=None,
+                   num_pages=None, use_kernel=None, eos_token_id=None):
+    """Greedy autoregressive generation over the paged KV cache.
+
+    ``input_ids``: [batch, prompt_len] (Tensor or array). Returns an int64
+    Tensor [batch, <= max_new_tokens] of generated ids (prefill as one jit,
+    then one fixed-shape decode jit per token — no retrace after warmup).
+    With ``eos_token_id``, a row that stops early frees its cache pages,
+    its lane goes inert, and its remaining columns pad with the eos id.
+    """
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from ..inference.kv_cache import KVCacheManager, pages_needed
+    from ..tensor.tensor import Tensor
+
+    cfg = (model.gpt if hasattr(model, "gpt") else model).config
+    ids_np = np.asarray(input_ids.numpy() if isinstance(input_ids, Tensor)
+                        else input_ids).astype(np.int32)
+    b, s = ids_np.shape
+    if s == 0:
+        raise ValueError("empty prompt")
+    if max_new_tokens <= 0:
+        generate_paged.last_decode_trace_count = 0
+        return Tensor(jnp.zeros((b, 0), jnp.int64))
+    total = s + max_new_tokens
+    if total > cfg.max_seq_len:
+        raise ValueError(
+            f"prompt {s} + max_new_tokens {max_new_tokens} exceeds "
+            f"max_seq_len {cfg.max_seq_len}")
+    params = _serving_params_cached(model)
+    dtype = params["tok_emb"].dtype
+    if page_size is None:
+        from ..ops.pallas.paged_attention import preferred_page_size
+
+        page_size = preferred_page_size(cfg.num_heads, cfg.num_heads,
+                                        cfg.head_dim, dtype)
+    mgr = KVCacheManager(
+        cfg.num_layers, cfg.num_heads, cfg.head_dim,
+        num_pages=num_pages or b * pages_needed(total, page_size),
+        max_batch=b, max_seq_len=total, page_size=page_size, dtype=dtype)
+    slots = [mgr.admit(s) for _ in range(b)]
+
+    prefill, decode = _serving_fns(cfg, mgr.page_size, use_kernel)
+    traces_at_entry = decode.trace_count[0]
+    next_ids, _, kp, vp = prefill(
+        params, jnp.asarray(ids_np), jnp.full((b,), s, jnp.int32),
+        mgr.k_pages, mgr.v_pages,
+        jnp.stack([mgr.slot_pages(sl) for sl in slots]))
+    mgr.update_pages(kp, vp)
+
+    out = [np.asarray(next_ids)]
+    done = np.zeros((b,), bool)
+    if eos_token_id is not None:
+        done |= out[0] == eos_token_id
+    cur = next_ids
+    for _ in range(max_new_tokens - 1):
+        if done.all():
+            break
+        # free ALL eos lanes first (seq_len 0 parks the decode lane — no
+        # writes, zero attention), THEN grow the live ones: a tight pool
+        # must see the reclaimed pages before any capacity check can fail
+        for i, sl in enumerate(slots):
+            if done[i] and sl is not None:
+                mgr.free(sl)
+                slots[i] = None
+        for i, sl in enumerate(slots):
+            if done[i]:
+                continue
+            if not mgr.ensure_capacity(sl, mgr.seq_len(sl) + 1):
+                # an undersized pool must fail loudly: the dropped K/V
+                # write would otherwise silently corrupt every later token
+                raise RuntimeError(
+                    f"KV cache exhausted growing slot {sl} to "
+                    f"{mgr.seq_len(sl) + 1} tokens — pass a larger "
+                    "num_pages (or use ServingPredictor, which preempts)")
+        cur, _, kp, vp = decode(
+            params, cur, mgr.seq_lens_device(), mgr.k_pages, mgr.v_pages,
+            mgr.page_table_device())
+        mgr.update_pages(kp, vp)
+        for i, sl in enumerate(slots):
+            if sl is not None and not done[i]:
+                mgr.advance(sl)
+        tok = np.asarray(cur)
+        if eos_token_id is not None:
+            # finished rows pad with eos (their inert lane's argmax is
+            # meaningless)
+            tok = np.where(done, eos_token_id, tok).astype(tok.dtype)
+        out.append(tok)
+        if eos_token_id is not None:
+            done |= tok == eos_token_id
+    # traces THIS call added: 1 on a cold shape, 0 when the cached jit
+    # already compiled it — never per-token (the no-retrace gate)
+    generate_paged.last_decode_trace_count = (decode.trace_count[0]
+                                              - traces_at_entry)
+    return Tensor(jnp.asarray(np.stack(out, axis=1), jnp.int64))

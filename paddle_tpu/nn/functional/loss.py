@@ -469,7 +469,11 @@ def hsigmoid_loss(input, label, num_classes, weight, bias=None,
         # length = position of the leading 1 (floor(log2(code)))
         lengths = jnp.floor(
             jnp.log2(code.astype(jnp.float32) + 0.5)).astype(jnp.int32)
-        total = jnp.zeros(x.shape[0], jnp.float32)
+        # accumulate at the INPUT precision when it exceeds fp32 — an fp32
+        # accumulator under float64 inputs truncates the forward enough to
+        # fail finite-difference gradient checks (~1e-3 relative)
+        acc_dt = jnp.float64 if x.dtype == jnp.float64 else jnp.float32
+        total = jnp.zeros(x.shape[0], acc_dt)
         for j in range(max_len):
             active = j < lengths
             idx = jnp.clip((code >> (j + 1)) - 1, 0, w.shape[0] - 1)
@@ -479,8 +483,7 @@ def hsigmoid_loss(input, label, num_classes, weight, bias=None,
                 logit = logit + b[idx]
             # BCE with logits on target=bit: softplus(logit) - bit*logit
             loss_j = jax.nn.softplus(logit) - bit * logit
-            total = total + jnp.where(active, loss_j.astype(jnp.float32),
-                                      0.0)
+            total = total + jnp.where(active, loss_j.astype(acc_dt), 0.0)
         return total[:, None]
 
     def fn_custom(x, table, code_bits, w, *rest):

@@ -1,0 +1,301 @@
+"""Paged decode attention — Pallas TPU kernel over a block-paged KV cache.
+
+The serving-side sibling of ``flash_attention.py``: one query token per
+sequence attends over that sequence's K/V prefix, which lives in a POOL of
+fixed-size pages (``[num_pages, page_size, kv_heads, head_dim]``) indexed by
+a per-sequence page table — the vLLM/Ragged-Paged-Attention memory layout
+(arxiv 2604.15464) that lets a continuous-batching scheduler admit/evict
+sequences without copying or fragmenting the cache.
+
+Kernel shape (TPU-idiomatic, following the flash kernel's conventions):
+
+- grid ``(batch, kv_heads, pages_per_seq)``; the page table and the ragged
+  per-sequence lengths ride in scalar-prefetch SMEM, and the K/V BlockSpec
+  index maps read them to DMA exactly the pages each sequence owns —
+  the page-table indirection costs no gather/materialization, and Pallas's
+  grid pipeline double-buffers the page fetches automatically.
+- GQA: q is viewed as ``[batch, kv_heads, group, head_dim]``; each program
+  computes all ``group`` q-heads sharing one kv head (group padded to >= 8
+  rows so the dot rides the MXU sublane tiling).
+- online softmax across pages: m/l and the running (normalized) output are
+  carried in outputs whose index maps ignore the page grid dim, so Mosaic
+  keeps them VMEM-resident across the inner steps (same revisiting pattern
+  as the flash backward's dq accumulator).
+- ragged occupancy: a sequence's page loop is masked by its length; pages
+  past the last valid one skip compute entirely (``pl.when``) and their DMA
+  is clamped onto the last valid page. ``length == 0`` marks an empty slot
+  (output rows zero) — the scheduler parks evicted slots that way.
+
+Decode is inference-only: no VJP (the op registers as non-differentiable).
+Interpret-capable on CPU like the other Pallas kernels; the jnp
+gather-based :func:`paged_attention_reference` is both the numerical oracle
+and the non-TPU fallback. Page-size autotune rides the shared
+``autotune_cache`` (the page size IS the kernel's kv block size, fixed at
+cache construction — see :func:`autotune_page_size`).
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from . import autotune_cache as _atc
+
+NEG_INF = -1e30
+
+# MXU note (see flash_attention.py): explicit DEFAULT precision keeps bf16
+# operands on the native MXU pass under the framework's "highest" default.
+_MXU = jax.lax.Precision.DEFAULT
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _dotf32(a, b, dims):
+    return jax.lax.dot_general(a, b, (dims, ((), ())),
+                               preferred_element_type=jnp.float32,
+                               precision=_MXU)
+
+
+# ---------------------------------------------------------------------------
+# kernel
+# ---------------------------------------------------------------------------
+
+
+def _decode_kernel(lens_ref, pt_ref, q_ref, k_ref, v_ref,
+                   o_ref, m_ref, l_ref, *, page_size, scale):
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    length = lens_ref[b]
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        o_ref[...] = jnp.zeros_like(o_ref)
+
+    @pl.when(j * page_size < length)
+    def _accumulate():
+        q = q_ref[...]           # [G8, d] input dtype (MXU wants bf16)
+        k = k_ref[...]           # [page_size, d] (None block dims dropped)
+        v = v_ref[...]
+        s = _dotf32(q, k, ((1,), (1,))) * scale          # [G8, ps] f32
+        col = j * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        s = jnp.where(col < length, s, NEG_INF)
+        m_prev = m_ref[...]                               # [G8, 1]
+        l_prev = l_ref[...]
+        m_next = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_next)
+        p = jnp.exp(s - m_next)
+        l_next = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        l_safe = jnp.where(l_next == 0.0, 1.0, l_next)
+        # running NORMALIZED output (jax paged-attention kernel recurrence):
+        # no final rescale pass needed after the last page
+        pv = _dotf32(p.astype(v.dtype), v, ((1,), (0,)))  # [G8, d]
+        o_ref[...] = ((o_ref[...] * (l_prev * alpha) + pv) / l_safe
+                      ).astype(o_ref.dtype)
+        m_ref[...] = m_next
+        l_ref[...] = l_next
+
+
+def _kernel_impl(q4, k_pages, v_pages, page_table, lengths, scale):
+    """q4: [b, kv_heads, G8, d] (group padded); returns [b, kv_heads, G8, d]
+    fp32."""
+    b, hkv, g8, d = q4.shape
+    num_pages, page_size = k_pages.shape[0], k_pages.shape[1]
+    pps = page_table.shape[1]
+    grid = (b, hkv, pps)
+
+    def kv_imap(bi, h, j, lens_ref, pt_ref):
+        # pages past the sequence's last valid one re-fetch the last valid
+        # page (their compute is skipped); empty slots / unallocated (-1)
+        # entries clamp to page 0. All-int32 arithmetic: weak python-int
+        # constants would promote to i64 under the framework's x64 mode.
+        ps = jnp.int32(page_size)
+        last = jnp.maximum(
+            jax.lax.div(lens_ref[bi] + ps - jnp.int32(1), ps) - jnp.int32(1),
+            jnp.int32(0))
+        page = pt_ref[bi, jnp.minimum(jnp.int32(j), last)]
+        return (jnp.clip(page, 0, num_pages - 1), 0, h, 0)
+
+    q_spec = pl.BlockSpec((None, None, g8, d), lambda bi, h, j, *_: (bi, h, 0, 0))
+    kv_spec = pl.BlockSpec((None, page_size, None, d), kv_imap)
+    o_spec = pl.BlockSpec((None, None, g8, d), lambda bi, h, j, *_: (bi, h, 0, 0))
+    ml_spec = pl.BlockSpec((None, None, g8, 1), lambda bi, h, j, *_: (bi, h, 0, 0))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=grid,
+        in_specs=[q_spec, kv_spec, kv_spec],
+        out_specs=[o_spec, ml_spec, ml_spec],
+    )
+    out_shape = [
+        jax.ShapeDtypeStruct((b, hkv, g8, d), jnp.float32),
+        jax.ShapeDtypeStruct((b, hkv, g8, 1), jnp.float32),
+        jax.ShapeDtypeStruct((b, hkv, g8, 1), jnp.float32),
+    ]
+    kern = functools.partial(_decode_kernel, page_size=page_size, scale=scale)
+    with _atc.x64_off():
+        out, _, _ = pl.pallas_call(
+            kern, grid_spec=grid_spec, out_shape=out_shape,
+            compiler_params=pltpu.TPUCompilerParams(
+                dimension_semantics=("parallel", "arbitrary", "arbitrary")),
+            interpret=_interpret(),
+        )(lengths.astype(jnp.int32), page_table.astype(jnp.int32),
+          q4, k_pages, v_pages)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# jnp gather-based reference (oracle + non-TPU fallback + bench baseline)
+# ---------------------------------------------------------------------------
+
+
+def paged_attention_reference(q, k_pages, v_pages, page_table, lengths,
+                              scale=None):
+    """Gather the paged cache into a contiguous view and run masked decode
+    attention — what a non-paged XLA implementation would do (one gather of
+    ``pages_per_seq * page_size`` positions per sequence, materialized in
+    HBM). Numerically the oracle for the kernel; also the measured baseline
+    ``bench_serve.py`` compares the kernel against.
+
+    q: [b, num_q_heads, d]; k/v_pages: [num_pages, page_size, kv_heads, d];
+    page_table: [b, pages_per_seq] int; lengths: [b] int (0 = empty slot).
+    Returns [b, num_q_heads, d] in q's dtype.
+    """
+    b, hq, d = q.shape
+    num_pages, page_size, hkv, _ = k_pages.shape
+    pps = page_table.shape[1]
+    group = hq // hkv
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    pt = jnp.clip(page_table, 0, num_pages - 1)
+    # [b, pps, ps, hkv, d] -> [b, S, hkv, d]
+    k = k_pages[pt].reshape(b, pps * page_size, hkv, d)
+    v = v_pages[pt].reshape(b, pps * page_size, hkv, d)
+    qg = q.reshape(b, hkv, group, d)
+    s = jnp.einsum("bhgd,bshd->bhgs", qg.astype(jnp.float32),
+                   k.astype(jnp.float32),
+                   precision=_MXU) * scale
+    valid = (jnp.arange(pps * page_size)[None, :]
+             < lengths.reshape(-1, 1))            # [b, S]
+    s = jnp.where(valid[:, None, None, :], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    # empty slots (length 0): all-masked softmax is uniform garbage — zero it
+    p = jnp.where((lengths > 0).reshape(-1, 1, 1, 1), p, 0.0)
+    out = jnp.einsum("bhgs,bshd->bhgd", p, v.astype(jnp.float32),
+                     precision=_MXU)
+    return out.reshape(b, hq, d).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# public entry
+# ---------------------------------------------------------------------------
+
+
+def use_kernel_default() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+def paged_attention(q, k_pages, v_pages, page_table, lengths, scale=None,
+                    use_kernel: bool | None = None):
+    """Decode attention over the paged KV cache.
+
+    ``use_kernel``: None = Pallas kernel on TPU, jnp reference elsewhere;
+    True forces the kernel (interpret mode off-TPU — CPU tests); False
+    forces the reference. See :func:`paged_attention_reference` for shapes.
+    """
+    b, hq, d = q.shape
+    hkv = k_pages.shape[2]
+    assert hq % hkv == 0, f"GQA needs q heads {hq} divisible by kv {hkv}"
+    assert k_pages.shape == v_pages.shape
+    assert page_table.shape[0] == b and lengths.shape == (b,)
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    if use_kernel is None:
+        use_kernel = use_kernel_default()
+    if not use_kernel:
+        return paged_attention_reference(q, k_pages, v_pages, page_table,
+                                         lengths, scale=scale)
+    group = hq // hkv
+    # pad the GQA group to >= 8 rows (MXU sublane tile); padded q rows are
+    # zeros — they compute garbage that the final slice drops
+    g8 = max(8, ((group + 7) // 8) * 8)
+    q4 = q.reshape(b, hkv, group, d)
+    if g8 != group:
+        q4 = jnp.pad(q4, ((0, 0), (0, 0), (0, g8 - group), (0, 0)))
+    out = _kernel_impl(q4, k_pages, v_pages, page_table, lengths,
+                       float(scale))
+    out = out[:, :, :group, :].reshape(b, hq, d)
+    return out.astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# page-size autotune (rides the shared autotune cache)
+# ---------------------------------------------------------------------------
+
+PAGE_SIZE_DEFAULT = 64
+
+
+def _sig(hq, hkv, d, dtype) -> str:
+    return f"paged:{hq}h{hkv}x{d}:{jnp.dtype(dtype).name}:page_size"
+
+
+def preferred_page_size(hq, hkv, d, dtype=jnp.bfloat16) -> int:
+    """The autotuned page size for this head geometry (or the default).
+    ``KVCacheManager(page_size=None)`` consults this, so a swept winner
+    changes the cache layout the next time a cache is built."""
+    hit = _atc.lookup(_sig(hq, hkv, d, dtype))
+    return int(hit[0]) if hit else PAGE_SIZE_DEFAULT
+
+
+def autotune_page_size(batch, hq, hkv, d, max_len=2048, dtype=jnp.bfloat16,
+                       candidates=(16, 32, 64, 128), iters=5):
+    """Sweep the cache page size on the current device and persist the
+    winner (process + disk via the shared autotune cache).
+
+    Page size is a TRACE-TIME cache-layout constant (it shapes the page
+    pool and the kernel's kv block), so like the flash block sweep this is
+    an explicit eager call to run once before building caches; the winner
+    then flows through :func:`preferred_page_size`. Returns the page size.
+    """
+    import time
+
+    if _interpret():
+        return preferred_page_size(hq, hkv, d, dtype)
+    _atc.load()
+    sig = _sig(hq, hkv, d, dtype)
+    key = jax.random.PRNGKey(0)
+    q = jax.random.normal(key, (batch, hq, d), dtype)
+    best, best_t = None, float("inf")
+    for ps in candidates:
+        pps = (max_len + ps - 1) // ps
+        num_pages = batch * pps + 1
+        kp = jax.random.normal(key, (num_pages, ps, hkv, d), dtype)
+        vp = jax.random.normal(key, (num_pages, ps, hkv, d), dtype)
+        pt = jnp.arange(batch * pps, dtype=jnp.int32).reshape(batch, pps)
+        lens = jnp.full((batch,), max_len, jnp.int32)
+        try:
+            step = jax.jit(functools.partial(paged_attention,
+                                             use_kernel=True))
+            step(q, kp, vp, pt, lens).block_until_ready()  # compile+warmup
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = step(q, kp, vp, pt, lens)
+            out.block_until_ready()
+            t = time.perf_counter() - t0
+        except Exception:
+            continue
+        if t < best_t:
+            best, best_t = ps, t
+    if best is not None:
+        _atc.CACHE[sig] = [int(best)]
+        _atc.save()
+        return best
+    return preferred_page_size(hq, hkv, d, dtype)

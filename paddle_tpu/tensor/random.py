@@ -186,11 +186,16 @@ def exponential_(x, lam=1.0, name=None):
 
 
 def binomial(count, prob, name=None):
-    return apply_op(
-        "binomial",
-        lambda n, p, key: jax.random.binomial(
-            key, n.astype(jnp.float32), p.astype(jnp.float32)).astype(jnp.int64),
-        count, prob, rng_arg())
+    def fn(n, p, key):
+        # jax 0.4.37's binomial sampler builds weak-typed float constants
+        # that promote to f64 under jax_enable_x64 while the operand stays
+        # f32 (lax.clamp dtype mismatch); sampling in the x64-matched dtype
+        # keeps its internals consistent in both eager and jit.
+        calc = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+        return jax.random.binomial(
+            key, n.astype(calc), p.astype(calc)).astype(jnp.int64)
+
+    return apply_op("binomial", fn, count, prob, rng_arg())
 
 
 def top_p_sampling(x, ps, threshold=None, topp_seed=None, seed=-1,
@@ -253,8 +258,15 @@ def cauchy_(x, loc=0, scale=1, name=None):
 
 
 def geometric_(x, probs, name=None):
-    """Fill ``x`` in place with Geometric(probs) samples — number of Bernoulli
-    trials to first success, support {1, 2, ...} (reference creation.py:2876)."""
+    """Fill ``x`` in place with Geometric(probs) samples (reference
+    creation.py:2876).
+
+    Reference parity: the raw CONTINUOUS inversion ``log(u) / log1p(-p)``
+    — an Exponential(rate=-log(1-p)) variate whose ceiling would be the
+    integer trial count. The reference returns the un-ceiled values, so a
+    discrete support {1, 2, ...} here (the previous ceil+clamp) diverged
+    from it; ``ceil`` the result for the textbook discrete geometric.
+    """
     from .tensor import Tensor as _T
 
     p = probs._data if isinstance(probs, _T) else jnp.asarray(probs)
@@ -263,9 +275,9 @@ def geometric_(x, probs, name=None):
     key = default_generator.next_key()
     u = jax.random.uniform(key, x._data.shape, jnp.float32,
                            minval=jnp.finfo(jnp.float32).tiny, maxval=1.0)
-    # p == 1: log1p(-1) = -inf gives ratio -0.0; the maximum pins the
-    # degenerate case to its correct constant sample of 1
-    samples = jnp.maximum(jnp.ceil(jnp.log(u) / jnp.log1p(-p)), 1.0)
+    # p == 1: log1p(-1) = -inf gives ratio +0.0 — the degenerate
+    # success-on-first-trial case collapses to 0, matching the reference
+    samples = jnp.log(u) / jnp.log1p(-p)
     x._data = samples.astype(x._data.dtype)
     return x
 

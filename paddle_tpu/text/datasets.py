@@ -215,8 +215,12 @@ class _ParallelCorpus(Dataset):
         if data_file is None or not os.path.exists(data_file):
             raise DownloadUnavailable(name, url_hint)
         src_lines, trg_lines = self._read_pairs(data_file, members)
-        self.src_dict = self._build_dict(src_lines, dict_size)
-        self.trg_dict = self._build_dict(trg_lines, dict_size)
+        # dict_size: one int for both sides (WMT14), or a (src, trg) pair —
+        # WMT16 exposes independent src/trg vocabulary budgets
+        src_size, trg_size = (dict_size if isinstance(dict_size, (tuple, list))
+                              else (dict_size, dict_size))
+        self.src_dict = self._build_dict(src_lines, src_size)
+        self.trg_dict = self._build_dict(trg_lines, trg_size)
         self.data = []
         for s, t in zip(src_lines, trg_lines):
             sid = [self.src_dict.get(w, self.UNK) for w in s.split()]
@@ -282,7 +286,7 @@ class WMT16(_ParallelCorpus):
         other = "de" if lang == "en" else "en"
         self._sizes = (src_dict_size, trg_dict_size)
         super().__init__(data_file, (f"{part}.{lang}", f"{part}.{other}"),
-                         max(src_dict_size, trg_dict_size), "WMT16",
+                         (src_dict_size, trg_dict_size), "WMT16",
                          "wmt16 en-de tarball")
 
 

@@ -132,8 +132,14 @@ def test_geometric_support():
     t = paddle.zeros([5000], dtype="float32")
     paddle.geometric_(t, 0.4)
     v = t.numpy()
-    assert v.min() >= 1 and np.all(v == np.round(v))
-    assert abs(v.mean() - 1 / 0.4) < 0.2  # E = 1/p
+    # reference parity (creation.py geometric_): the RAW continuous
+    # log(u)/log1p(-p) values — Exponential(rate=-log(1-p)), positive and
+    # NOT integer-snapped; mean = 1/rate
+    assert v.min() > 0
+    assert not np.all(v == np.round(v))
+    assert abs(v.mean() - 1 / -np.log1p(-0.4)) < 0.1
+    # its ceiling IS the discrete geometric: E[ceil] = 1/p
+    assert abs(np.ceil(v).mean() - 1 / 0.4) < 0.2
 
 
 # --- module-level in-place spellings -------------------------------------

@@ -140,3 +140,28 @@ def test_download_unavailable_error():
     with pytest.raises(text.datasets.DownloadUnavailable) as ei:
         text.datasets.UCIHousing()
     assert "data_file" in str(ei.value)
+
+
+def test_wmt16_independent_dict_sizes(tmp_path):
+    """WMT16 builds src and trg vocabularies with their OWN size budgets
+    (round-7 satellite: both sides used max(src, trg) before)."""
+    import io
+    import tarfile
+
+    src = b"a a a b b c d e\na b f g h\n"
+    trg = b"x x x y y z\nx y z w\n"
+    tar = tmp_path / "wmt16.tar.gz"
+    with tarfile.open(tar, "w:gz") as tf:
+        for name, data in [("wmt16/train.en", src), ("wmt16/train.de", trg)]:
+            ti = tarfile.TarInfo(name)
+            ti.size = len(data)
+            tf.addfile(ti, io.BytesIO(data))
+    ds = text.datasets.WMT16(data_file=str(tar), mode="train",
+                             src_dict_size=6, trg_dict_size=4, lang="en")
+    # 3 specials (<s>/<e>/<unk>) + top-(size-3) words per side
+    assert len(ds.src_dict) == 6
+    assert len(ds.trg_dict) == 4
+    assert "a" in ds.src_dict and "x" in ds.trg_dict
+    # trg budget of 4 keeps only the single most frequent real word
+    assert "z" not in ds.trg_dict
+    assert len(ds) == 2

@@ -274,3 +274,147 @@ class TestNumericalGradient:
             ym = op(paddle.to_tensor(xm.reshape(x_np.shape).astype(np.float32), stop_gradient=True))
             fd.reshape(-1)[i] = (float(yp.numpy()) - float(ym.numpy())) / (2 * eps)
         np.testing.assert_allclose(x.grad.numpy(), fd, atol=2e-2, rtol=2e-2)
+
+
+class TestSavedTensorsHooks:
+    """paddle.autograd.saved_tensors_hooks (round-7 satellite; reference
+    python/paddle/autograd/saved_tensors_hooks.py): pack runs at save
+    time, unpack at backward, and the CPU-offload round trip preserves
+    gradients exactly."""
+
+    def test_cpu_offload_round_trip(self):
+        packed, unpacked = [], []
+
+        def pack(t):
+            # force a REAL host copy: on the CPU backend t.numpy() is a
+            # zero-copy view that would keep the device buffer alive
+            arr = np.array(t.numpy(), copy=True)
+            packed.append(arr)
+            return arr
+
+        def unpack(arr):
+            unpacked.append(arr)
+            return paddle.to_tensor(arr)
+
+        x_np = np.array([[1.0, -2.0], [3.0, 0.5]], np.float32)
+        x = paddle.to_tensor(x_np, stop_gradient=False)
+        with paddle.autograd.saved_tensors_hooks(pack, unpack):
+            y = paddle.tanh(x * 2.0)
+        assert packed and not unpacked  # pack at capture, unpack lazily
+        y.sum().backward()
+        assert unpacked
+        want = 2.0 * (1.0 - np.tanh(2.0 * x_np) ** 2)
+        np.testing.assert_allclose(x.grad.numpy(), want, rtol=1e-4,
+                                   atol=1e-6)
+
+    def test_scope_ends_at_exit(self):
+        calls = []
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        with paddle.autograd.saved_tensors_hooks(
+                lambda t: calls.append(1) or t, lambda t: t):
+            y = x * 3.0
+        n_in_scope = len(calls)
+        assert n_in_scope > 0
+        z = y * 2.0  # outside the context: no packing
+        assert len(calls) == n_in_scope
+        z.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [6.0, 6.0])
+
+    def test_non_callable_hooks_rejected(self):
+        with pytest.raises(TypeError):
+            paddle.autograd.saved_tensors_hooks(None, lambda t: t)
+
+    def test_create_graph_through_hooks(self):
+        """Double backward re-derives the vjp from the unpacked inputs."""
+        x = paddle.to_tensor([0.3, -0.7], stop_gradient=False)
+        with paddle.autograd.saved_tensors_hooks(
+                lambda t: np.array(t.numpy(), copy=True),
+                lambda a: paddle.to_tensor(a)):
+            y = paddle.tanh(x)
+        (g,) = paddle.grad(y.sum(), x, create_graph=True)
+        g.sum().backward()
+        t = np.tanh(np.asarray([0.3, -0.7]))
+        want = -2.0 * t * (1.0 - t ** 2)
+        np.testing.assert_allclose(x.grad.numpy(), want, rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_inplace_mutation_after_pack_uses_original_values(self):
+        """An in-place op between forward and backward must not corrupt
+        the hook-saved activation: the packed copy holds the originals."""
+        x = paddle.to_tensor([0.5], stop_gradient=False)
+        with paddle.autograd.saved_tensors_hooks(
+                lambda t: np.array(t.numpy(), copy=True),
+                lambda a: paddle.to_tensor(a)):
+            y = paddle.tanh(x)
+        paddle.tensor.random.exponential_(x, 2.0)  # rebinds x._data
+        y.sum().backward()
+        want = 1.0 - np.tanh(0.5) ** 2
+        np.testing.assert_allclose(x.grad.numpy(), [want], rtol=1e-5)
+
+    def test_create_graph_dead_intermediate_keeps_second_order(self):
+        """A packed intermediate that died after the forward must re-enter
+        the create_graph backward CONNECTED to its producer, or part of
+        the second-order gradient silently vanishes."""
+        x_np = np.array([0.3, -0.7], np.float32)
+
+        def double_grad(use_hooks):
+            x = paddle.to_tensor(x_np, stop_gradient=False)
+            if use_hooks:
+                with paddle.autograd.saved_tensors_hooks(
+                        lambda t: np.array(t.numpy(), copy=True),
+                        lambda a: paddle.to_tensor(a)):
+                    y = paddle.tanh(x * x)  # x*x dies after this scope
+            else:
+                y = paddle.tanh(x * x)
+            (g,) = paddle.grad(y.sum(), x, create_graph=True)
+            g.sum().backward()
+            return x.grad.numpy()
+
+        np.testing.assert_allclose(double_grad(True), double_grad(False),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_hooks_using_framework_ops_do_not_recurse(self):
+        """pack/unpack hooks that themselves call framework ops (the bf16
+        offload pattern: astype before .numpy()) must not re-enter hook
+        capture and recurse."""
+        x = paddle.cast(paddle.to_tensor([[0.5, -1.0]]), "bfloat16")
+        x.stop_gradient = False
+        w = paddle.cast(paddle.to_tensor([[1.5], [0.25]]), "bfloat16")
+        with paddle.autograd.saved_tensors_hooks(
+                lambda t: np.array(t.astype("float32").numpy(), copy=True),
+                lambda a: paddle.cast(paddle.to_tensor(a), "bfloat16")):
+            y = paddle.matmul(x, w)
+        y.sum().backward()
+        assert x.grad is not None and x.grad.dtype == x.dtype
+        np.testing.assert_allclose(x.grad.astype("float32").numpy(),
+                                   [[1.5, 0.25]], rtol=1e-2)
+
+    def test_lossy_hooks_shape_gradients(self):
+        """The contract: backward always sees the pack->unpack round trip
+        — a lossy pair (e.g. quantized offload) must shape the gradients
+        even while the original buffer is still alive."""
+        x = paddle.to_tensor([2.0, 3.0], stop_gradient=False)
+        with paddle.autograd.saved_tensors_hooks(
+                lambda t: np.zeros_like(t.numpy()),
+                lambda a: paddle.to_tensor(a)):
+            y = x * x
+        y.sum().backward()
+        # d(x*x)/dx through the zeroed replay = 2 * 0, not 2 * x
+        np.testing.assert_allclose(x.grad.numpy(), [0.0, 0.0])
+
+    def test_released_node_frees_input_buffers(self):
+        """release() must drop every field that pins op input buffers —
+        including the unpin closure — so activations free after backward
+        even while the output tensor stays alive."""
+        import gc
+        import weakref
+
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        h = paddle.tanh(x * 2.0)
+        ref = weakref.ref(h._data)
+        z = paddle.tanh(h)
+        z.sum().backward()
+        del h
+        gc.collect()
+        assert ref() is None, "released node still pins the activation"
+        _ = z  # output alive the whole time

@@ -1085,6 +1085,9 @@ _SKIP_GROUPS = {
     "fused MLP-block Pallas kernel op (fwd+bwd golden-tested vs the jnp reference, fp32 and bf16 legs, in tests/test_fused_mlp.py — interpret mode on CPU)": [
         "fused_bias_gelu", "fused_ln_residual",
     ],
+    "paged decode-attention Pallas kernel op (golden-tested vs the jnp gather reference across ragged lengths/page sizes/GQA in tests/test_paged_attention.py — interpret mode on CPU; decode-only, no grad)": [
+        "paged_attention",
+    ],
     "fused/incubate op (covered by tests/test_incubate.py)": [
         "fused_bias_dropout_residual_ln", "fused_dropout_add",
         "fused_layer_norm", "fused_linear", "fused_linear_activation",

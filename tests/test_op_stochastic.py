@@ -245,3 +245,76 @@ def test_graph_sample_neighbors_seeded():
     paddle.seed(123)
     draws = {tuple(np.asarray(draw()[0]).tolist()) for _ in range(8)}
     assert len(draws) > 1
+
+
+def test_weighted_sample_neighbors():
+    """geometric.weighted_sample_neighbors (round-7 satellite — the one
+    geometric sampling op with no implementation anywhere): seeded
+    reproducibility, weight-proportional bias, full-neighborhood
+    passthrough, and eids plumbing."""
+    from paddle_tpu.geometric import weighted_sample_neighbors
+
+    # CSC graph: node 0 has in-neighbors 1..6, nodes 1/2 have one, node 3
+    # has none
+    row = paddle.to_tensor(np.array([1, 2, 3, 4, 5, 6, 0, 0], "int64"))
+    colptr = paddle.to_tensor(np.array([0, 6, 7, 8, 8], "int64"))
+    w = paddle.to_tensor(
+        np.array([100.0, 100.0, 100.0, 1e-6, 1e-6, 1e-6, 1.0, 1.0], "float32"))
+    nodes = paddle.to_tensor(np.array([0, 1, 3], "int64"))
+
+    def draw():
+        n, c = weighted_sample_neighbors(row, colptr, w, nodes,
+                                         sample_size=3)
+        return n.numpy(), c.numpy()
+
+    (n1, c1), (n2, c2) = _seeded(draw)
+    np.testing.assert_array_equal(np.asarray(n1), np.asarray(n2))
+    np.testing.assert_array_equal(np.asarray(c1), np.asarray(c2))
+    np.testing.assert_array_equal(np.asarray(c1), [3, 1, 0])
+
+    # bias: neighbors 1..3 carry ~all the mass; across repeated draws the
+    # near-zero-weight neighbors 4..6 must essentially never win a slot
+    paddle.seed(5)
+    heavy = 0
+    for _ in range(20):
+        n, _ = draw()
+        heavy += int(np.isin(np.asarray(n)[:3], [1, 2, 3]).sum())
+    assert heavy >= 58  # 60 slots total; binom(60, ~3e-8) ~ 0 misses
+
+    # sample_size >= degree returns the whole neighborhood (no sampling)
+    n_all, c_all = weighted_sample_neighbors(row, colptr, w, nodes,
+                                             sample_size=-1)
+    np.testing.assert_array_equal(np.asarray(c_all.numpy()), [6, 1, 0])
+    np.testing.assert_array_equal(np.sort(np.asarray(n_all.numpy())[:6]),
+                                  [1, 2, 3, 4, 5, 6])
+
+    # eids ride along with the picked edges
+    eids = paddle.to_tensor(np.arange(10, 18, dtype="int64"))
+    paddle.seed(9)
+    n, c, e = weighted_sample_neighbors(row, colptr, w, nodes,
+                                        sample_size=3, eids=eids,
+                                        return_eids=True)
+    n_np, e_np = np.asarray(n.numpy()), np.asarray(e.numpy())
+    # row[i] pairs with eid 10 + i: neighbor value v at node 0 sits at
+    # row index v - 1
+    np.testing.assert_array_equal(e_np[:3], 10 + (n_np[:3] - 1))
+    with pytest.raises(ValueError, match="eids"):
+        weighted_sample_neighbors(row, colptr, w, nodes, return_eids=True)
+
+
+def test_weighted_sample_neighbors_zero_weight_edges():
+    """Mixed zero/positive weights must not crash: positive-weight edges
+    win first, zero-weight edges fill the remaining slots."""
+    from paddle_tpu.geometric import weighted_sample_neighbors
+
+    row = paddle.to_tensor(np.array([1, 2, 3, 4], "int64"))
+    colptr = paddle.to_tensor(np.array([0, 4], "int64"))
+    w = paddle.to_tensor(np.array([1.0, 0.0, 0.0, 0.0], "float32"))
+    paddle.seed(3)
+    n, c = weighted_sample_neighbors(
+        row, colptr, w, paddle.to_tensor(np.array([0], "int64")),
+        sample_size=3)
+    n_np = np.asarray(n.numpy())
+    assert int(c.numpy()[0]) == 3
+    assert 1 in n_np  # the only positive-weight neighbor always wins
+    assert len(set(n_np.tolist())) == 3  # without replacement

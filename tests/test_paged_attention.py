@@ -1,0 +1,163 @@
+"""Paged decode-attention Pallas kernel vs the jnp gather reference
+(interpret mode on CPU): ragged lengths, page sizes, GQA groups, bf16 leg,
+empty slots, and the incubate.nn.functional surface.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.pallas import paged_attention as pa
+
+
+def _case(rng, b, hq, hkv, d, page_size, pps, dtype=jnp.float32,
+          num_extra_pages=3):
+    num_pages = b * pps + num_extra_pages
+
+    def t(*shape):
+        return jnp.asarray(rng.randn(*shape) * 0.5, dtype)
+
+    q = t(b, hq, d)
+    kp = t(num_pages, page_size, hkv, d)
+    vp = t(num_pages, page_size, hkv, d)
+    # non-trivial page table: a random permutation of the pool, so a bug
+    # that reads pages in pool order (ignoring the table) cannot pass
+    pt = jnp.asarray(rng.permutation(num_pages)[:b * pps].reshape(b, pps),
+                     jnp.int32)
+    return q, kp, vp, pt
+
+
+@pytest.mark.parametrize("hq,hkv", [(4, 4), (8, 2), (16, 1)],
+                         ids=["mha", "gqa4", "mqa"])
+@pytest.mark.parametrize("page_size", [8, 16, 32])
+def test_kernel_matches_reference(rng, hq, hkv, page_size):
+    b, d, pps = 4, 64, 5
+    q, kp, vp, pt = _case(rng, b, hq, hkv, d, page_size, pps)
+    max_len = page_size * pps
+    # ragged occupancy: empty slot, single token, mid-page, page-aligned,
+    # full — clipped to batch size
+    lens_all = [0, 1, page_size + 3, 2 * page_size, max_len]
+    lens = jnp.asarray(lens_all[:b], jnp.int32)
+    ref = pa.paged_attention_reference(q, kp, vp, pt, lens)
+    out = pa.paged_attention(q, kp, vp, pt, lens, use_kernel=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_kernel_matches_reference_bf16(rng):
+    b, hq, hkv, d, page_size, pps = 4, 8, 4, 64, 16, 4
+    q, kp, vp, pt = _case(rng, b, hq, hkv, d, page_size, pps,
+                          dtype=jnp.bfloat16)
+    lens = jnp.asarray([5, 64, 33, 17], jnp.int32)
+    ref = pa.paged_attention_reference(q, kp, vp, pt, lens)
+    out = pa.paged_attention(q, kp, vp, pt, lens, use_kernel=True)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               rtol=3e-2, atol=3e-2)
+
+
+def test_empty_slots_produce_zeros(rng):
+    b, hq, hkv, d, page_size, pps = 3, 4, 4, 32, 8, 3
+    q, kp, vp, pt = _case(rng, b, hq, hkv, d, page_size, pps)
+    lens = jnp.asarray([0, 10, 0], jnp.int32)
+    for uk in (False, True):
+        out = np.asarray(pa.paged_attention(q, kp, vp, pt, lens,
+                                            use_kernel=uk))
+        assert np.all(out[0] == 0) and np.all(out[2] == 0)
+        assert np.any(out[1] != 0)
+
+
+def test_unallocated_page_entries_are_safe(rng):
+    """-1 (unallocated) page-table entries past each length must not read
+    out of bounds or poison the output."""
+    b, hq, hkv, d, page_size, pps = 2, 4, 4, 32, 8, 4
+    q, kp, vp, pt = _case(rng, b, hq, hkv, d, page_size, pps)
+    lens = jnp.asarray([9, 3], jnp.int32)  # uses 2 pages / 1 page
+    pt = np.asarray(pt).copy()
+    pt[0, 2:] = -1
+    pt[1, 1:] = -1
+    pt = jnp.asarray(pt)
+    ref = pa.paged_attention_reference(q, kp, vp, pt, lens)
+    out = pa.paged_attention(q, kp, vp, pt, lens, use_kernel=True)
+    assert np.isfinite(np.asarray(out)).all()
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_reference_matches_dense_attention(rng):
+    """The gather reference itself vs plain dense softmax attention over
+    the linearized cache — anchors both implementations to first
+    principles."""
+    import math
+
+    b, hq, hkv, d, page_size, pps = 2, 6, 2, 16, 4, 4
+    q, kp, vp, pt = _case(rng, b, hq, hkv, d, page_size, pps)
+    lens_np = np.asarray([13, 7])
+    lens = jnp.asarray(lens_np, jnp.int32)
+    out = np.asarray(pa.paged_attention_reference(q, kp, vp, pt, lens))
+    group = hq // hkv
+    for bi in range(b):
+        L = int(lens_np[bi])
+        pages = np.asarray(pt)[bi]
+        k_lin = np.asarray(kp)[pages].reshape(-1, hkv, d)[:L]
+        v_lin = np.asarray(vp)[pages].reshape(-1, hkv, d)[:L]
+        for h in range(hq):
+            kv_h = h // group
+            s = (k_lin[:, kv_h] @ np.asarray(q)[bi, h]) / math.sqrt(d)
+            p = np.exp(s - s.max())
+            p /= p.sum()
+            want = p @ v_lin[:, kv_h]
+            np.testing.assert_allclose(out[bi, h], want, rtol=1e-5,
+                                       atol=1e-5)
+
+
+def test_incubate_functional_surface(rng):
+    """paddle.incubate.nn.functional.paged_attention: Tensor in/out, output
+    is non-differentiable (decode-only op)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.incubate.nn import functional as FI
+
+    b, hq, hkv, d, page_size, pps = 2, 4, 2, 16, 8, 2
+    q, kp, vp, pt = _case(rng, b, hq, hkv, d, page_size, pps)
+    lens = jnp.asarray([10, 4], jnp.int32)
+    out = FI.paged_attention(
+        paddle.to_tensor(np.asarray(q)), paddle.to_tensor(np.asarray(kp)),
+        paddle.to_tensor(np.asarray(vp)),
+        paddle.to_tensor(np.asarray(pt)),
+        paddle.to_tensor(np.asarray(lens)))
+    assert out.stop_gradient  # registered non-diff
+    ref = pa.paged_attention_reference(q, kp, vp, pt, lens)
+    np.testing.assert_allclose(out.numpy(), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_page_size_autotune_cache_plumbing(tmp_path, monkeypatch):
+    """preferred_page_size: default off-cache, cache hit wins; the CPU
+    autotune is a no-op returning the preference (sweeps are TPU-only)."""
+    from paddle_tpu.ops.pallas import autotune_cache as atc
+
+    assert pa.preferred_page_size(8, 8, 64) == pa.PAGE_SIZE_DEFAULT
+    sig = pa._sig(8, 8, 64, jnp.float32)
+    atc.load()
+    monkeypatch.setitem(atc.CACHE, sig, [32])
+    assert pa.preferred_page_size(8, 8, 64, jnp.float32) == 32
+    assert pa.autotune_page_size(2, 8, 8, 64, dtype=jnp.float32) == 32
+
+
+def test_scale_override(rng):
+    b, hq, hkv, d, page_size, pps = 2, 4, 4, 16, 8, 2
+    q, kp, vp, pt = _case(rng, b, hq, hkv, d, page_size, pps)
+    lens = jnp.asarray([9, 12], jnp.int32)
+    for uk in (False, True):
+        a = np.asarray(pa.paged_attention(q, kp, vp, pt, lens, scale=0.5,
+                                          use_kernel=uk))
+        b_ = np.asarray(pa.paged_attention(q, kp, vp, pt, lens, scale=0.05,
+                                           use_kernel=uk))
+        assert np.abs(a - b_).max() > 1e-4  # scale actually flows through
+    k_ref = pa.paged_attention_reference(q, kp, vp, pt, lens, scale=0.5)
+    k_out = pa.paged_attention(q, kp, vp, pt, lens, scale=0.5,
+                               use_kernel=True)
+    np.testing.assert_allclose(np.asarray(k_out), np.asarray(k_ref),
+                               rtol=2e-5, atol=2e-5)

@@ -1,0 +1,482 @@
+"""Round-7 serving subsystem: paged-cache greedy generate vs the no-cache
+full-forward oracle, KVCacheManager admission/eviction, the
+continuous-batching ServingPredictor, and the bench_serve.py --smoke
+contract. CPU suite: the Pallas kernel runs the jnp reference path here
+(kernel parity is tests/test_paged_attention.py's job); these tests pin the
+cache/scheduler/jit plumbing around it.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.inference import KVCacheManager, Request, ServingPredictor
+from paddle_tpu.inference.serving import FINISHED, RUNNING, WAITING
+from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+TINY = dict(vocab_size=97, hidden_size=32, num_layers=2, num_heads=4,
+            max_seq_len=96)
+
+
+def _tiny_model(**over):
+    paddle.seed(7)
+    cfg = GPTConfig(**{**TINY, **over})
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    return model
+
+
+def _oracle_greedy(model, ids_np, max_new_tokens):
+    """No-cache oracle: full forward over the growing context, argmax at
+    the last position — the token-for-token golden for generate."""
+    ctx = ids_np.copy()
+    out = []
+    for _ in range(max_new_tokens):
+        logits = model(paddle.to_tensor(ctx)).numpy()
+        nxt = np.argmax(logits[:, -1, :], axis=-1).astype(ctx.dtype)
+        out.append(nxt)
+        ctx = np.concatenate([ctx, nxt[:, None]], axis=1)
+    return np.stack(out, axis=1)
+
+
+# -- generate: golden parity + jit-shape policy -----------------------------
+
+
+def test_generate_matches_full_forward_oracle(rng):
+    model = _tiny_model()
+    ids = rng.randint(0, TINY["vocab_size"], (2, 11)).astype(np.int64)
+    want = _oracle_greedy(model, ids, 8)
+    got = model.generate(paddle.to_tensor(ids), max_new_tokens=8).numpy()
+    np.testing.assert_array_equal(got, want)
+
+
+def test_generate_kernel_leg_matches_oracle(rng):
+    """Same golden with the Pallas kernel forced (interpret mode on CPU) —
+    the acceptance-criteria path."""
+    model = _tiny_model()
+    ids = rng.randint(0, TINY["vocab_size"], (2, 5)).astype(np.int64)
+    want = _oracle_greedy(model, ids, 6)
+    got = model.generate(paddle.to_tensor(ids), max_new_tokens=6,
+                         use_kernel=True, page_size=8).numpy()
+    np.testing.assert_array_equal(got, want)
+
+
+def test_generate_no_per_token_retrace(rng):
+    """The decode step compiles at most ONCE per call (0 when the shared
+    jit cache already holds the shape); every token replays it."""
+    from paddle_tpu.models.gpt import generate_paged
+
+    model = _tiny_model()
+    ids = rng.randint(0, TINY["vocab_size"], (2, 4)).astype(np.int64)
+    model.generate(paddle.to_tensor(ids), max_new_tokens=10)
+    assert generate_paged.last_decode_trace_count <= 1
+    # second call, same geometry: the cached jit replays with ZERO traces
+    model.generate(paddle.to_tensor(ids), max_new_tokens=10)
+    assert generate_paged.last_decode_trace_count == 0
+
+
+def test_generate_on_gptmodel_and_eos(rng):
+    """GPTModel (no LM head) generates through the tied embedding; eos
+    stops early."""
+    model = _tiny_model()
+    ids = rng.randint(0, TINY["vocab_size"], (1, 6)).astype(np.int64)
+    out = model.gpt.generate(paddle.to_tensor(ids), max_new_tokens=5).numpy()
+    assert out.shape == (1, 5)
+    eos = int(out[0, 1])
+    stopped = model.generate(paddle.to_tensor(ids), max_new_tokens=5,
+                             eos_token_id=eos).numpy()
+    assert stopped.shape[1] <= 5
+    assert eos in stopped[0]
+
+
+def test_generate_rejects_overlong(rng):
+    model = _tiny_model()
+    ids = rng.randint(0, TINY["vocab_size"], (1, 90)).astype(np.int64)
+    with pytest.raises(ValueError, match="max_seq_len"):
+        model.generate(paddle.to_tensor(ids), max_new_tokens=32)
+
+
+# -- KVCacheManager: pages, slots, admission, eviction ----------------------
+
+
+def _mgr(**over):
+    kw = dict(num_layers=2, num_kv_heads=4, head_dim=8, num_pages=8,
+              max_batch=3, max_seq_len=32, page_size=8)
+    kw.update(over)
+    return KVCacheManager(**kw)
+
+
+def test_cache_admit_allocates_pages():
+    m = _mgr()
+    slot = m.admit(10)  # 10 tokens @ page_size 8 -> 2 pages
+    assert m.seq_len(slot) == 10
+    assert m.free_page_count == 6
+    assert int((np.asarray(m._page_table[slot]) >= 0).sum()) == 2
+
+
+def test_cache_free_returns_pages_and_slot():
+    m = _mgr()
+    s0, s1 = m.admit(8), m.admit(9)
+    pages_held = 1 + 2
+    assert m.free_page_count == 8 - pages_held
+    m.free(s0)
+    assert m.free_page_count == 6
+    assert m.free_slot_count == 2
+    assert m.seq_len(s0) == 0
+    # the freed slot is reusable and gets fresh pages
+    s2 = m.admit(24)
+    assert s2 == s0
+    assert m.free_page_count == 6 - 3
+    m.free(s1), m.free(s2)
+    assert m.free_page_count == 8 and m.free_slot_count == 3
+
+
+def test_cache_growth_and_exhaustion():
+    m = _mgr(num_pages=3)
+    slot = m.admit(8)  # 1 page, exactly full
+    assert m.ensure_capacity(slot, 9)  # crosses into page 2
+    assert m.free_page_count == 1
+    assert m.ensure_capacity(slot, 16)  # still page 2
+    assert m.ensure_capacity(slot, 17)  # page 3
+    assert m.free_page_count == 0
+    assert not m.ensure_capacity(slot, 25)  # pool dry
+    assert not m.ensure_capacity(slot, 99)  # beyond max_seq_len
+
+
+def test_cache_admit_raises_when_full():
+    m = _mgr(max_batch=1, num_pages=2)
+    m.admit(16)
+    assert not m.can_admit(1)
+    with pytest.raises(RuntimeError, match="slot"):
+        m.admit(1)
+    m2 = _mgr(num_pages=1)
+    with pytest.raises(RuntimeError, match="exhausted"):
+        m2.admit(9)
+
+
+# -- ServingPredictor: continuous batching ----------------------------------
+
+
+def test_predictor_matches_generate(rng):
+    """Continuous-batching outputs == the plain paged generate, per prompt,
+    even when prompts outnumber decode lanes (slot reuse across waves)."""
+    model = _tiny_model()
+    prompts = [rng.randint(0, TINY["vocab_size"], (n,)).tolist()
+               for n in (3, 7, 5, 9, 4)]
+    sp = ServingPredictor(model, max_batch=2, max_seq_len=48, page_size=8)
+    got = sp.generate(prompts, max_new_tokens=6)
+    for p, g in zip(prompts, got):
+        ids = np.asarray([p], np.int64)
+        want = model.generate(paddle.to_tensor(ids), max_new_tokens=6,
+                              page_size=8).numpy()[0]
+        np.testing.assert_array_equal(np.asarray(g), want)
+
+
+def test_predictor_admit_evict_lifecycle(rng):
+    """WAITING -> RUNNING -> FINISHED; finished slots free mid-flight and
+    waiting requests join the running batch without restarting it."""
+    model = _tiny_model()
+    sp = ServingPredictor(model, max_batch=2, max_seq_len=48, page_size=8)
+    short = sp.add_request([5, 6], max_new_tokens=2)
+    long = sp.add_request([7, 8, 9], max_new_tokens=8)
+    queued = sp.add_request([1, 2, 3, 4], max_new_tokens=3)
+    assert [r.state for r in (short, long, queued)] == [WAITING] * 3
+    sp.step()
+    assert short.state == RUNNING and long.state == RUNNING
+    assert queued.state == WAITING  # both lanes busy
+    while short.state != FINISHED:
+        sp.step()
+    # short's slot must be recycled into queued WITHOUT long stopping
+    assert long.state == RUNNING
+    while any(r.state != FINISHED for r in (long, queued)):
+        sp.step()
+    assert len(short.output_ids) == 2
+    assert len(long.output_ids) == 8
+    assert len(queued.output_ids) == 3
+    assert not sp.has_work()
+    assert sp.cache.free_slot_count == sp.max_batch
+
+
+def test_predictor_decode_fixed_shape(rng):
+    """One trace for the decode step across admissions/evictions — the
+    continuous batch never changes the compiled shape."""
+    model = _tiny_model()
+    sp = ServingPredictor(model, max_batch=2, max_seq_len=48, page_size=8)
+    sp.generate([[3, 1], [4, 1, 5], [9, 2], [6]], max_new_tokens=4)
+    assert sp.decode_trace_count == 1
+
+
+def test_predictor_preemption_under_page_pressure(rng):
+    """A pool too small for all admitted sequences preempts the youngest
+    back to WAITING (recompute mode) and still finishes everything with
+    the right token streams."""
+    model = _tiny_model()
+    prompts = [rng.randint(0, TINY["vocab_size"], (6,)).tolist()
+               for _ in range(3)]
+    # 5 pages of 8 tokens = 40 cached tokens; each sequence peaks at 15
+    # cached tokens = 2 pages, so 3 concurrent need 6 pages — growth must
+    # preempt the youngest at least once
+    sp = ServingPredictor(model, max_batch=3, max_seq_len=24, page_size=8,
+                          num_pages=5)
+    reqs = [sp.add_request(p, max_new_tokens=10) for p in prompts]
+    while sp.has_work():
+        sp.step()
+    # the geometry above cannot finish without preempting: 3 seqs * 16
+    # tokens peak > the 48-token pool while all three run
+    assert sum(r.preempt_count for r in reqs) >= 1
+    for p, r in zip(prompts, reqs):
+        assert r.state == FINISHED
+        ids = np.asarray([p], np.int64)
+        want = model.generate(paddle.to_tensor(ids), max_new_tokens=10,
+                              page_size=8).numpy()[0]
+        np.testing.assert_array_equal(np.asarray(r.output_ids), want)
+
+
+def test_predictor_rejects_oversized_prompt():
+    model = _tiny_model()
+    sp = ServingPredictor(model, max_batch=1, max_seq_len=16, page_size=8)
+    with pytest.raises(ValueError, match="max_seq_len"):
+        sp.add_request(list(range(17)))
+
+
+def test_request_done_logic():
+    r = Request([1, 2], max_new_tokens=2, eos_token_id=9)
+    assert not r.done
+    r.output_ids.append(3)
+    assert not r.done
+    r.output_ids.append(9)
+    assert r.done  # eos
+    r2 = Request([1], max_new_tokens=1)
+    r2.output_ids.append(4)
+    assert r2.done  # budget
+
+
+# -- bench_serve.py --smoke: the tier-1-adjacent CI leg ---------------------
+
+
+def test_bench_serve_smoke_schema():
+    """bench_serve.py --smoke must run green on CPU and emit bench.py's
+    one-line JSON schema with the serving fields, flagship line last."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, "bench_serve.py", "--smoke", "--steps=4",
+         "--batch=2", "--prompt=8"],
+        cwd=root, capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [l for l in proc.stdout.strip().splitlines() if l.strip()]
+    assert len(lines) == 2, proc.stdout
+    for line in lines:
+        rec = json.loads(line)
+        assert "error" not in rec, rec
+        assert rec["unit"] == "tokens/s" and rec["value"] > 0
+        assert rec["p50_ms"] > 0 and rec["p99_ms"] >= rec["p50_ms"]
+        assert rec["decode_retraces"] == 1  # the no-retrace gate
+        assert "vs_baseline" in rec
+    assert "[paged-kernel]" in json.loads(lines[-1])["metric"]
+
+
+def test_predictor_tight_pool_serializes_instead_of_livelock(rng):
+    """A pool that can only hold ONE growing sequence must serve requests
+    one at a time (preempt + re-admit), not livelock evicting everybody:
+    the growth loop skips slots already freed mid-iteration."""
+    model = _tiny_model()
+    sp = ServingPredictor(model, max_batch=2, max_seq_len=16, page_size=4,
+                          num_pages=2)
+    prompts = [[3, 1, 4, 1], [5, 9, 2, 6]]
+    got = sp.generate(prompts, max_new_tokens=5)
+    for p, g in zip(prompts, got):
+        ids = np.asarray([p], np.int64)
+        want = model.generate(paddle.to_tensor(ids), max_new_tokens=5,
+                              page_size=4).numpy()[0]
+        np.testing.assert_array_equal(np.asarray(g), want)
+    # no page leaked into a parked slot's table across all the churn
+    assert sp.cache.free_page_count == 2
+    assert (np.asarray(sp.cache._page_table) == -1).all()
+
+
+def test_generate_raises_on_undersized_pool(rng):
+    """generate with a num_pages too small for the decode growth must fail
+    loudly, not silently drop K/V writes and emit wrong tokens."""
+    model = _tiny_model()
+    ids = rng.randint(0, TINY["vocab_size"], (1, 8)).astype(np.int64)
+    with pytest.raises(RuntimeError, match="exhausted"):
+        model.generate(paddle.to_tensor(ids), max_new_tokens=12,
+                       page_size=4, num_pages=2)
+
+
+def test_predictor_prefill_finished_request_never_decodes(rng):
+    """A request whose prefill token already exhausts its budget (or hits
+    eos) must retire with exactly that token — no extra decode step."""
+    model = _tiny_model()
+    sp = ServingPredictor(model, max_batch=2, max_seq_len=32, page_size=8)
+    got = sp.generate([[5]], max_new_tokens=1)
+    assert len(got[0]) == 1
+    want = model.generate(paddle.to_tensor(np.array([[5]], np.int64)),
+                          max_new_tokens=1, page_size=8).numpy()[0]
+    np.testing.assert_array_equal(np.asarray(got[0]), want)
+    # eos produced BY the prefill: nothing may follow it
+    eos = int(want[0])
+    sp2 = ServingPredictor(model, max_batch=2, max_seq_len=32, page_size=8)
+    got2 = sp2.generate([[5]], max_new_tokens=8, eos_token_id=eos)
+    assert got2[0] == [eos]
+
+
+def test_predictor_bucket_rounding_capped_at_model_max(rng):
+    """Prompts near a max_seq_len that is not a bucket multiple must
+    prefill (bucket padding clamps to the model's position table)."""
+    model = _tiny_model(max_seq_len=90)
+    sp = ServingPredictor(model, max_batch=1, max_seq_len=90, page_size=8,
+                          prefill_bucket=16)
+    prompt = rng.randint(0, TINY["vocab_size"], (86,)).tolist()
+    got = sp.generate([prompt], max_new_tokens=3)
+    ids = np.asarray([prompt], np.int64)
+    want = model.generate(paddle.to_tensor(ids), max_new_tokens=3,
+                          page_size=8).numpy()[0]
+    np.testing.assert_array_equal(np.asarray(got[0]), want)
+
+
+def test_generate_eos_frees_pages_and_pads(rng):
+    """A row that hits eos frees its cache pages mid-generate and its
+    remaining columns pad with the eos id."""
+    model = _tiny_model()
+    ids = rng.randint(0, TINY["vocab_size"], (2, 6)).astype(np.int64)
+    free_run = model.generate(paddle.to_tensor(ids), max_new_tokens=8,
+                              page_size=8).numpy()
+    eos = int(free_run[0, 2])  # row 0 stops at step 3; row 1 may not
+    out = model.generate(paddle.to_tensor(ids), max_new_tokens=8,
+                         page_size=8, eos_token_id=eos).numpy()
+    row = out[0]
+    hit = int(np.argmax(row == eos))
+    assert row[hit] == eos
+    assert (row[hit:] == eos).all()  # eos padding, not garbage decode
+    # rows agree with the unconstrained run up to their eos
+    np.testing.assert_array_equal(row[:hit + 1], free_run[0, :hit + 1])
+
+
+def test_generate_params_cache_tracks_weight_updates(rng):
+    """Repeated generate reuses the extracted params; rebinding a weight
+    buffer (an optimizer step) invalidates the per-model cache."""
+    from paddle_tpu.models.gpt import _SERVING_PARAMS_CACHE
+
+    model = _tiny_model()
+    ids = rng.randint(0, TINY["vocab_size"], (1, 5)).astype(np.int64)
+    a = model.generate(paddle.to_tensor(ids), max_new_tokens=4).numpy()
+    cached = _SERVING_PARAMS_CACHE.get(model)
+    assert cached is not None
+    b = model.generate(paddle.to_tensor(ids), max_new_tokens=4).numpy()
+    assert _SERVING_PARAMS_CACHE.get(model)[1] is cached[1]  # reused
+    np.testing.assert_array_equal(a, b)
+    # "train": rebind one layer weight buffer -> fresh extraction
+    w = model.gpt.layers[0].mlp.fc1.weight
+    w.set_value(paddle.to_tensor(np.asarray(w.numpy()) + 0.5))
+    c = model.generate(paddle.to_tensor(ids), max_new_tokens=4).numpy()
+    assert _SERVING_PARAMS_CACHE.get(model)[1] is not cached[1]
+    ctx = ids.copy()
+    for _ in range(4):
+        logits = model(paddle.to_tensor(ctx)).numpy()
+        nxt = np.argmax(logits[:, -1, :], -1).astype(np.int64)
+        ctx = np.concatenate([ctx, nxt[:, None]], 1)
+    np.testing.assert_array_equal(c, ctx[:, 5:])  # new weights served
+
+
+def test_predictor_fails_fast_on_never_admittable_request(rng):
+    """A prompt that can never fit the page pool raises the real cause
+    immediately instead of spinning empty scheduler steps."""
+    model = _tiny_model()
+    sp = ServingPredictor(model, max_batch=1, max_seq_len=32, page_size=4,
+                          num_pages=2)  # pool holds 8 tokens total
+    sp.add_request(list(rng.randint(0, TINY["vocab_size"], (20,))),
+                   max_new_tokens=4)
+    with pytest.raises(RuntimeError, match="num_pages"):
+        sp.step()
+
+
+def test_generate_zero_budget_returns_empty(rng):
+    model = _tiny_model()
+    ids = rng.randint(0, TINY["vocab_size"], (2, 4)).astype(np.int64)
+    out = model.generate(paddle.to_tensor(ids), max_new_tokens=0)
+    assert tuple(out.shape) == (2, 0)
+
+
+def test_predictor_truncation_flag_preserves_budget(rng):
+    """The length-ceiling stop flags the request as truncated without
+    corrupting its original max_new_tokens."""
+    model = _tiny_model()
+    sp = ServingPredictor(model, max_batch=1, max_seq_len=8, page_size=4)
+    req = sp.add_request([1, 2, 3, 4, 5], max_new_tokens=50)
+    while sp.has_work():
+        sp.step()
+    assert req.state == FINISHED
+    assert req.truncated
+    assert req.max_new_tokens == 50  # caller's budget untouched
+    assert len(req.output_ids) < 50
+
+
+def test_predictor_readmission_at_length_ceiling_truncates(rng):
+    """A request preempted while sitting exactly at max_seq_len re-enters
+    the queue with context = max_seq_len + 1; admission must finish it as
+    truncated instead of raising and killing the serving loop."""
+    model = _tiny_model()
+    sp = ServingPredictor(model, max_batch=2, max_seq_len=8, page_size=4)
+    stuck = sp.add_request([1, 2, 3], max_new_tokens=20)
+    stuck.output_ids = [4, 5, 6, 7, 8, 9]  # 3 + 6 = max_seq_len + 1
+    other = sp.add_request([2, 1], max_new_tokens=3)
+    while sp.has_work():
+        sp.step()
+    assert stuck.state == FINISHED and stuck.truncated
+    assert other.state == FINISHED and len(other.output_ids) == 3
+
+
+def test_generate_eos_reclaim_feeds_tight_pool(rng):
+    """Pages freed by an eos lane must be visible to another lane's growth
+    in the SAME step — grow-before-free would raise a spurious
+    cache-exhausted error."""
+    model = _tiny_model()
+    ids = rng.randint(0, TINY["vocab_size"], (2, 6)).astype(np.int64)
+    free_run = model.generate(paddle.to_tensor(ids), max_new_tokens=10,
+                              page_size=4).numpy()
+    eos = int(free_run[0, 1])  # lane 0 finishes after 2 tokens
+    # pool: lane 0 peaks at 7 cached tokens (2 pages), lane 1 needs 4
+    # pages for its full 15 — 5 pages only works if lane 0's free lands
+    # before lane 1's growth check
+    out = model.generate(paddle.to_tensor(ids), max_new_tokens=10,
+                         page_size=4, num_pages=5,
+                         eos_token_id=eos).numpy()
+    hit1 = int(np.argmax(out[1] == eos)) if eos in out[1] else len(out[1])
+    np.testing.assert_array_equal(out[1][:hit1], free_run[1][:hit1])
+
+
+def test_generate_rejects_empty_prompt(rng):
+    model = _tiny_model()
+    with pytest.raises(ValueError, match="empty prompt"):
+        model.generate(paddle.to_tensor(np.zeros((2, 0), np.int64)),
+                       max_new_tokens=3)
+
+
+def test_predictor_admission_keeps_growth_headroom(rng):
+    """With sequences running, admission leaves one free page of growth
+    headroom — an exactly-fitting admission would be preempted (prefill
+    discarded) by the same step's growth pass."""
+    model = _tiny_model()
+    sp = ServingPredictor(model, max_batch=2, max_seq_len=24, page_size=4,
+                          num_pages=3)
+    a = sp.add_request([1, 2, 3, 4, 5], max_new_tokens=4)  # prefix 4 -> 1pg
+    sp.step()
+    assert a.state == RUNNING
+    # 2 pages free, b's prefix needs 2 — exactly fits, but zero headroom:
+    # must wait rather than admit-then-preempt
+    b = sp.add_request([6, 7, 8, 9, 1, 2, 3, 4, 5], max_new_tokens=2)
+    sp.step()
+    assert b.state == WAITING and b.preempt_count == 0
+    while sp.has_work():
+        sp.step()
+    assert a.state == FINISHED and b.state == FINISHED
+    assert b.preempt_count == 0  # never admitted into a doomed fit
+    assert len(b.output_ids) == 2
