@@ -500,7 +500,11 @@ def main():
             anchor = {"anchor_error": f"{type(e).__name__}: {e}"[:120]}
 
     def emit(d):
-        print(json.dumps({**d, **anchor} if anchor else d))
+        # schema-checked emit (tpulint BL001 contract): a malformed line
+        # fails HERE, not two rounds later as a silently skewed delta
+        from paddle_tpu.analysis.bench_schema import checked_line
+
+        print(checked_line({**d, **anchor} if anchor else d))
 
     if "--all" in sys.argv:
         emit(bench_gpt("gpt3-125m", 768, 12, 12, 8, 1024, 20,

@@ -182,9 +182,15 @@ def main():
     desc = (f"b{shape['batch']} h{shape['heads']} s{shape['seq']} "
             f"d{shape['head_dim']} {jnp.dtype(dtype).name} causal fwd+bwd")
 
+    from paddle_tpu.analysis.bench_schema import checked_line
+
     results = {}
     for name, make in IMPLS:
-        line = {"impl": name, "shape": desc, "iters": iters}
+        # per-impl lines speak the same {metric, value, unit} driver
+        # contract as every other bench line (tpulint BL001): value is
+        # ms/layer, 0 + error when the leg cannot run
+        line = {"metric": f"flash A/B {name} ms/layer ({desc})",
+                "value": 0, "unit": "ms", "impl": name, "iters": iters}
         runnable = on_tpu or (smoke and name in ("ours", "xla-sdpa"))
         if not runnable:
             line["error"] = "backend_unavailable: TPU-only kernel (run on " \
@@ -192,11 +198,11 @@ def main():
         else:
             try:
                 ms = _time_fwd_bwd(make(q, k, v, scale), q, k, v, iters)
-                line["ms_per_layer"] = round(ms, 3)
+                line["value"] = round(ms, 3)
                 results[name] = ms
             except Exception as e:  # one impl failing must not kill the A/B
                 line["error"] = f"{type(e).__name__}: {e}"[:300]
-        print(json.dumps(line))
+        print(checked_line(line))
 
     summary = {
         "metric": f"flash A/B ours vs jax-flash speedup ({desc})",
@@ -207,7 +213,7 @@ def main():
     }
     if not {"ours", "jax-flash"} <= results.keys():
         summary["error"] = "backend_unavailable: A/B needs both kernels on TPU"
-    print(json.dumps(summary))
+    print(checked_line(summary))
 
 
 if __name__ == "__main__":

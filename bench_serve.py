@@ -36,7 +36,11 @@ FLAGSHIP_METRIC = "paged-decode serving tokens/sec/chip"
 
 
 def _error_line(msg, metric=FLAGSHIP_METRIC):
-    return json.dumps({"metric": metric, "error": msg})
+    # full driver contract even on errors (value 0 + unit): a keys-missing
+    # error line would silently drop out of round-over-round deltas — the
+    # exact failure mode the round-8 bench schema lint exists to stop
+    return json.dumps({"metric": metric, "value": 0, "unit": "tokens/s",
+                       "vs_baseline": 0.0, "error": msg[:300]})
 
 
 def _percentile(xs, q):
@@ -151,10 +155,12 @@ def main():
 
     # flagship line LAST: the paged-kernel leg, vs_baseline = speedup over
     # the gather reference (ratio > 1 = the Pallas kernel wins the A/B)
+    from paddle_tpu.analysis.bench_schema import checked_line
+
     if "gather-ref" in results:
         ref = results["gather-ref"]
         ref["vs_baseline"] = 1.0
-        print(json.dumps(ref))
+        print(checked_line(ref))
     if "paged-kernel" in results:
         out = results["paged-kernel"]
         if "gather-ref" in results and results["gather-ref"]["value"]:
@@ -162,7 +168,7 @@ def main():
                 out["value"] / results["gather-ref"]["value"], 3)
         else:
             out["vs_baseline"] = 0.0
-        print(json.dumps(out))
+        print(checked_line(out))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,61 @@
+"""tpulint — trace-level + AST-level static analysis over paddle_tpu.
+
+Three passes and one CI gate (round 8):
+
+- **source** — :mod:`.astlint` AST rules (AL*) over the package source;
+- **trace** — :mod:`.jaxpr_checks` jaxpr rules (JX*) + the eager op-dtype
+  AMP cross-check (TR001) over the flagship callables in :mod:`.targets`;
+- **registry** — :mod:`.registry_audit` rules (RA*) over the op table;
+- **bench** — :mod:`.bench_schema` BL001 over checked-in bench artifacts.
+
+Findings compare against ``analysis/baseline.json`` by fingerprint;
+``python -m paddle_tpu.analysis`` (and the tier-1 ``tests/test_analysis.py``)
+fail on any non-baselined finding. ``--write-baseline`` accepts the current
+set. See ARCHITECTURE.md round-8 for the rule catalog.
+"""
+from __future__ import annotations
+
+from .findings import (RULES, Finding, diff_against_baseline, load_baseline,
+                       rule, write_baseline)
+
+PASSES = ("source", "trace", "registry", "bench")
+
+#: rule-id prefix -> owning pass (fingerprints start with the rule id, so a
+#: partial --write-baseline can preserve the passes that did not run)
+RULE_PASS = {"AL": "source", "JX": "trace", "TR": "trace",
+             "RA": "registry", "BL": "bench"}
+
+
+def pass_of_fingerprint(fp: str) -> str | None:
+    return RULE_PASS.get(fp[:2])
+
+
+def run_pass(name: str, amp_probe_ops=None) -> list[Finding]:
+    if name == "source":
+        from .astlint import lint_package
+
+        return lint_package()
+    if name == "trace":
+        from .targets import analyze_flagships
+
+        return analyze_flagships()
+    if name == "registry":
+        from .registry_audit import audit_registry
+
+        return audit_registry(amp_probe_ops=amp_probe_ops)
+    if name == "bench":
+        from .bench_schema import lint_artifacts
+
+        return lint_artifacts()
+    raise ValueError(f"unknown pass {name!r}; one of {PASSES}")
+
+
+def run_all(passes=PASSES, amp_probe_ops=None) -> list[Finding]:
+    out: list[Finding] = []
+    for p in passes:
+        out.extend(run_pass(p, amp_probe_ops=amp_probe_ops))
+    return out
+
+
+__all__ = ["Finding", "RULES", "rule", "PASSES", "run_pass", "run_all",
+           "load_baseline", "write_baseline", "diff_against_baseline"]

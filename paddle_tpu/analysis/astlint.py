@@ -1,0 +1,384 @@
+"""tpulint AST rules — static source lint over ``paddle_tpu/`` itself.
+
+Source-level sibling of the jaxpr walker: the hazard classes every review
+round of this repo has caught by hand, encoded as AST rules so a gate (not a
+reviewer) catches op #351. Rules fire on the *idiom*, not the formatting;
+suppress a reviewed instance with an inline pragma on the offending line or
+its enclosing ``def``::
+
+    for i in range(b):  # tpulint: disable=AL003
+
+Rule catalog:
+
+- **AL001 rng-key-reuse** — the same PRNG key variable feeds two or more
+  ``jax.random`` samplers without a reassignment between them: the draws are
+  IDENTICAL streams (q == k in an attention bench), the classic correlated-
+  data bug the round-6/7 autotune harnesses shipped.
+- **AL002 host-sync-in-jit** — ``.item()`` / ``np.asarray`` / ``int()/
+  float()/bool()`` on non-shape values inside a function handed to
+  ``jax.jit``: concretizes a tracer (TracerArrayConversionError at best, a
+  silent host round-trip at worst).
+- **AL003 loop-over-dim-in-jit** — a Python ``for`` over ``range(x.shape
+  [...])`` / ``range(<name>.size)`` inside a jitted function unrolls the
+  trace once per element; ``lax.scan``/``vmap`` keep the program O(1).
+- **AL004 tile-misaligned** — integer literals in a ``pl.BlockSpec`` block
+  shape that cannot land on the TPU register tiling: the minor-most dim must
+  be a multiple of 128 and the second-minor a multiple of 8 (the fp32 tile;
+  16/32 for bf16/int8 are stricter, so 8 is the weakest necessary check).
+  Literal 1 (and None) block dims are squeezed/revisited dims — exempt.
+- **AL005 unregistered-op** — a string-literal op name dispatched through
+  ``apply_op``/``make_op`` with no ``framework/op_registry.py`` row (the
+  source-scan gate of ``tests/test_op_registry.py``, generalized so the CLI
+  reports it with file/line instead of one assert blob).
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from .findings import Finding, rule
+
+AL001 = rule("AL001", "same RNG key feeds multiple jax.random samplers")
+AL002 = rule("AL002", "host sync (.item()/np.asarray/int()) inside a jitted fn")
+AL003 = rule("AL003", "Python for-loop over a tensor dim inside a jitted fn")
+AL004 = rule("AL004", "pl.BlockSpec tile constant not (8,128)-aligned")
+AL005 = rule("AL005", "apply_op/make_op name with no op-registry row")
+
+_SAMPLERS = {
+    "normal", "uniform", "bernoulli", "randint", "truncated_normal",
+    "gamma", "beta", "poisson", "categorical", "gumbel", "exponential",
+    "laplace", "choice", "permutation", "bits", "rademacher", "cauchy",
+    "dirichlet", "multivariate_normal", "orthogonal", "t", "ball",
+}
+
+_PRAGMA = re.compile(r"#\s*tpulint:\s*disable=([A-Z0-9,\s]+)")
+
+
+def _pragmas(src: str) -> dict[int, set[str]]:
+    """line -> set of rule ids disabled on that line."""
+    out: dict[int, set[str]] = {}
+    for i, text in enumerate(src.splitlines(), start=1):
+        m = _PRAGMA.search(text)
+        if m:
+            out[i] = {r.strip() for r in m.group(1).split(",") if r.strip()}
+    return out
+
+
+def _dotted(node: ast.AST) -> str:
+    """Best-effort dotted name of a call target ('jax.random.normal')."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _assigned_names(node: ast.AST):
+    """Names (re)bound by an assignment-ish statement."""
+    targets = []
+    if isinstance(node, ast.Assign):
+        targets = node.targets
+    elif isinstance(node, (ast.AugAssign, ast.AnnAssign, ast.For)):
+        targets = [node.target]
+    elif isinstance(node, ast.withitem) and node.optional_vars is not None:
+        targets = [node.optional_vars]
+    out = set()
+    for t in targets:
+        for n in ast.walk(t):
+            if isinstance(n, ast.Name):
+                out.add(n.id)
+    return out
+
+
+class _FileLint(ast.NodeVisitor):
+    def __init__(self, path: str, src: str, registry_names=None):
+        self.path = path
+        self.src = src
+        self.pragmas = _pragmas(src)
+        self.registry_names = registry_names
+        self.findings: list[Finding] = []
+        self.tree = ast.parse(src)
+        # EVERY def node — rules iterate this list, so a second method with
+        # a repeated name (two classes both defining `forward`) is analyzed
+        # like the first; the by-name dict is only for jax.jit(name) call-
+        # site resolution, where first-def-wins is the best static guess
+        self.all_defs: list = []
+        self.defs: dict[str, ast.FunctionDef] = {}
+        for n in ast.walk(self.tree):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.all_defs.append(n)
+                self.defs.setdefault(n.name, n)
+        self.jitted = self._jitted_functions()
+
+    # -- plumbing -----------------------------------------------------------
+
+    def _suppressed(self, rule_id: str, node: ast.AST, fn=None) -> bool:
+        lines = {getattr(node, "lineno", None)}
+        if fn is not None:
+            lines.add(fn.lineno)
+        for ln in lines:
+            if ln is not None and rule_id in self.pragmas.get(ln, set()):
+                return True
+        return False
+
+    def _emit(self, rule_id, detail, message, node, fn=None):
+        if self._suppressed(rule_id, node, fn):
+            return
+        self.findings.append(Finding(
+            rule=rule_id, target=self.path, detail=detail, message=message,
+            line=getattr(node, "lineno", None)))
+
+    _JIT_NAMES = ("jax.jit", "jit", "pjit", "jax.pmap")
+
+    def _is_jit_decorator(self, dec) -> bool:
+        """@jax.jit, @jit, @partial(jax.jit, ...), @jax.jit(...) forms."""
+        if _dotted(dec) in self._JIT_NAMES:
+            return True
+        if isinstance(dec, ast.Call):
+            dn = _dotted(dec.func)
+            if dn in self._JIT_NAMES:
+                return True
+            if dn in ("partial", "functools.partial") and dec.args:
+                return _dotted(dec.args[0]) in self._JIT_NAMES
+        return False
+
+    def _jitted_functions(self):
+        """def nodes reachable from a ``jax.jit(...)`` call site (direct
+        name args), a jit decorator, or nested inside either — the traced
+        closure."""
+        roots = set()
+        for n in ast.walk(self.tree):
+            if isinstance(n, ast.Call) and _dotted(n.func) in self._JIT_NAMES:
+                for arg in list(n.args[:1]) + [
+                        kw.value for kw in n.keywords if kw.arg == "fun"]:
+                    if isinstance(arg, ast.Name) and arg.id in self.defs:
+                        roots.add(self.defs[arg.id])
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) and any(
+                    self._is_jit_decorator(d) for d in n.decorator_list):
+                roots.add(n)
+        jitted = set()
+        for root in roots:
+            for n in ast.walk(root):
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    jitted.add(n)
+        return jitted
+
+    # -- AL001 rng key reuse ------------------------------------------------
+
+    @staticmethod
+    def _own_nodes(fn):
+        """Nodes of ``fn``'s own body, NOT descending into nested defs or
+        lambdas — each inner scope binds its own key parameter and is
+        analyzed (or exempted) on its own."""
+        stack = list(ast.iter_child_nodes(fn))
+        while stack:
+            n = stack.pop()
+            yield n
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                continue
+            stack.extend(ast.iter_child_nodes(n))
+
+    def check_rng_reuse(self):
+        for fn in self.all_defs:
+            # sampler uses per key-variable name, in source order
+            uses: dict[str, list[ast.Call]] = {}
+            assigns: dict[str, list[int]] = {}
+            for n in self._own_nodes(fn):
+                ln = getattr(n, "lineno", None)
+                if ln is not None:
+                    for name in _assigned_names(n):
+                        assigns.setdefault(name, []).append(ln)
+                if isinstance(n, ast.Call):
+                    dn = _dotted(n.func)
+                    if (dn.split(".")[-1] in _SAMPLERS
+                            and ("random" in dn or dn.split(".")[-1]
+                                 in ("bits",))
+                            and n.args
+                            and isinstance(n.args[0], ast.Name)):
+                        uses.setdefault(n.args[0].id, []).append(n)
+            for key, calls in uses.items():
+                if len(calls) < 2:
+                    continue
+                calls = sorted(calls, key=lambda c: c.lineno)
+                first, last = calls[0].lineno, calls[-1].lineno
+                rebound = any(first < ln <= last
+                              for ln in assigns.get(key, []))
+                if rebound:
+                    continue
+                self._emit(
+                    AL001, f"{fn.name}:{key}",
+                    f"PRNG key '{key}' feeds {len(calls)} jax.random "
+                    f"samplers in '{fn.name}' with no split/fold_in between "
+                    "— the draws are identical streams "
+                    "(jax.random.split the key per consumer)",
+                    calls[-1], fn)
+
+    # -- AL002 / AL003 inside jitted fns ------------------------------------
+
+    _HOST_CASTS = {"int", "float", "bool"}
+
+    def _is_shapey(self, node: ast.AST) -> bool:
+        """Expressions that are static at trace time: shapes/ndim/len()."""
+        for n in ast.walk(node):
+            if isinstance(n, ast.Attribute) and n.attr in (
+                    "shape", "ndim", "size", "dtype"):
+                return True
+            if (isinstance(n, ast.Call) and isinstance(n.func, ast.Name)
+                    and n.func.id == "len"):
+                return True
+            if isinstance(n, ast.Constant):
+                return True
+        return False
+
+    def check_jitted_bodies(self):
+        for fn in self.jitted:
+            for n in ast.walk(fn):
+                if isinstance(n, ast.Call):
+                    dn = _dotted(n.func)
+                    if (isinstance(n.func, ast.Attribute)
+                            and n.func.attr == "item"):
+                        self._emit(
+                            AL002, f"{fn.name}:item",
+                            f"'.item()' inside jitted '{fn.name}' "
+                            "concretizes a traced value (host sync)",
+                            n, fn)
+                    elif dn in ("np.asarray", "np.array", "numpy.asarray",
+                                "numpy.array"):
+                        self._emit(
+                            AL002, f"{fn.name}:{dn}",
+                            f"'{dn}' inside jitted '{fn.name}' forces a "
+                            "device->host transfer of a traced value",
+                            n, fn)
+                    elif (isinstance(n.func, ast.Name)
+                          and n.func.id in self._HOST_CASTS and n.args
+                          and not self._is_shapey(n.args[0])):
+                        self._emit(
+                            AL002, f"{fn.name}:{n.func.id}",
+                            f"'{n.func.id}(...)' on a non-shape value "
+                            f"inside jitted '{fn.name}' concretizes a "
+                            "tracer",
+                            n, fn)
+                if isinstance(n, ast.For):
+                    it = n.iter
+                    if (isinstance(it, ast.Call)
+                            and isinstance(it.func, ast.Name)
+                            and it.func.id == "range" and it.args):
+                        arg = it.args[-1] if len(it.args) > 1 else it.args[0]
+                        hit = any(
+                            isinstance(s, ast.Attribute)
+                            and s.attr in ("shape", "size")
+                            for s in ast.walk(arg))
+                        if hit:
+                            self._emit(
+                                AL003, f"{fn.name}:for-range-shape",
+                                f"Python for over range(...shape...) inside "
+                                f"jitted '{fn.name}' unrolls the trace per "
+                                "element — use lax.scan / vmap",
+                                n, fn)
+
+    # -- AL004 BlockSpec tile constants -------------------------------------
+
+    def check_blockspec_tiles(self):
+        for n in ast.walk(self.tree):
+            if not (isinstance(n, ast.Call)
+                    and _dotted(n.func).endswith("BlockSpec")):
+                continue
+            shapes = [a for a in n.args if isinstance(a, ast.Tuple)]
+            shapes += [kw.value for kw in n.keywords
+                       if kw.arg == "block_shape"
+                       and isinstance(kw.value, ast.Tuple)]
+            for tup in shapes:
+                dims = tup.elts
+                if len(dims) < 2:
+                    continue
+                consts = [d.value if isinstance(d, ast.Constant)
+                          and isinstance(d.value, int) else None
+                          for d in dims]
+                minor, second = consts[-1], consts[-2]
+                bad = []
+                if minor is not None and minor > 1 and minor % 128:
+                    bad.append(f"minor dim {minor} % 128 != 0")
+                if second is not None and second > 1 and second % 8:
+                    bad.append(f"second-minor dim {second} % 8 != 0")
+                if bad:
+                    self._emit(
+                        AL004, f"blockspec:{minor}x{second}",
+                        "BlockSpec block shape constant off the TPU tile "
+                        f"grid ({'; '.join(bad)}): blocks must land on "
+                        "(8,128) fp32 / (16,128) bf16 register tiles",
+                        tup)
+
+    # -- AL005 unregistered op names ----------------------------------------
+
+    _OPNAME = re.compile(r"^[a-z0-9_.]+$")
+
+    def check_unregistered_ops(self):
+        if self.registry_names is None:
+            return
+        from ..framework.op_registry import is_registered
+
+        for n in ast.walk(self.tree):
+            if not isinstance(n, ast.Call):
+                continue
+            dn = _dotted(n.func)
+            if dn.split(".")[-1] not in ("apply_op", "make_op"):
+                continue
+            if not (n.args and isinstance(n.args[0], ast.Constant)
+                    and isinstance(n.args[0].value, str)):
+                continue  # dynamic names: conftest's STRICT mode covers them
+            name = n.args[0].value
+            if not self._OPNAME.match(name):
+                continue
+            if name not in self.registry_names and not is_registered(name):
+                self._emit(
+                    AL005, name,
+                    f"op '{name}' dispatched via {dn.split('.')[-1]} has no "
+                    "registry row — add it to framework/op_registry.py",
+                    n)
+
+    def run(self):
+        self.check_rng_reuse()
+        self.check_jitted_bodies()
+        self.check_blockspec_tiles()
+        self.check_unregistered_ops()
+        return self.findings
+
+
+def lint_source(text: str, path: str = "<string>",
+                registry_names=None) -> list[Finding]:
+    """Lint one source string (the fixture-test entry)."""
+    return _FileLint(path, text, registry_names=registry_names).run()
+
+
+def lint_file(path: str, root: str | None = None,
+              registry_names=None) -> list[Finding]:
+    with open(path) as f:
+        src = f.read()
+    rel = os.path.relpath(path, root) if root else path
+    try:
+        return _FileLint(rel, src, registry_names=registry_names).run()
+    except SyntaxError as e:
+        return [Finding(rule="AL000", target=rel, detail="syntax-error",
+                        message=f"could not parse: {e}", line=e.lineno)]
+
+
+def lint_package(pkg_dir: str | None = None) -> list[Finding]:
+    """Lint every .py under ``paddle_tpu/`` (the repo gate entry)."""
+    if pkg_dir is None:
+        pkg_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    root = os.path.dirname(pkg_dir)
+    from ..framework.op_registry import OP_TABLE
+
+    names = set(OP_TABLE)
+    out: list[Finding] = []
+    for dirpath, _dirnames, filenames in os.walk(pkg_dir):
+        for fname in sorted(filenames):
+            if fname.endswith(".py"):
+                out.extend(lint_file(os.path.join(dirpath, fname), root,
+                                     registry_names=names))
+    return out

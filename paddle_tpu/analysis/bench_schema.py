@@ -1,0 +1,117 @@
+"""Bench JSON-line schema lint (tpulint BL rules).
+
+Every bench driver in this repo (bench.py, bench_serve.py,
+bench_flash_ab.py) speaks one-line JSON records with the driver contract
+``{"metric": str, "value": number, "unit": str, ...}``; round-over-round
+deltas (BASELINE.md, the VERDICT tables) are computed off those lines. A
+malformed line — a NaN value, a unit typo, a metric renamed mid-era —
+silently drops out of the delta and skews the comparison instead of
+failing. This module is the loud failure:
+
+- :func:`validate_line` — the schema check the emitters call at print time
+  (a bad line raises at the bench, not two rounds later in a diff).
+- :func:`lint_artifacts` — **BL001**: sweep the checked-in ``BENCH_*.json``
+  driver artifacts, re-validating every JSON line embedded in their
+  ``tail`` transcripts.
+"""
+from __future__ import annotations
+
+import glob
+import json
+import math
+import os
+
+from .findings import Finding, rule
+
+BL001 = rule("BL001", "malformed bench JSON line in a checked-in artifact")
+
+#: required keys -> type predicate
+_REQUIRED = {
+    "metric": lambda v: isinstance(v, str) and v.strip(),
+    "value": lambda v: isinstance(v, (int, float))
+    and not isinstance(v, bool) and math.isfinite(v),
+    "unit": lambda v: isinstance(v, str) and v.strip(),
+}
+_OPTIONAL_NUMERIC = ("vs_baseline", "p50_ms", "p99_ms", "anchor_tflops",
+                     "anchor_frac_peak")
+
+
+def validate_line(obj) -> list[str]:
+    """Problems with one bench JSON record (empty list == valid).
+
+    Error lines (``value == 0`` with an ``error`` string) are part of the
+    driver contract and validate like any other line.
+    """
+    if not isinstance(obj, dict):
+        return [f"bench line must be a JSON object, got {type(obj).__name__}"]
+    problems = []
+    for key, ok in _REQUIRED.items():
+        if key not in obj:
+            problems.append(f"missing required key '{key}'")
+        elif not ok(obj[key]):
+            problems.append(f"key '{key}' malformed: {obj[key]!r}")
+    for key in _OPTIONAL_NUMERIC:
+        if key in obj and not (
+                isinstance(obj[key], (int, float))
+                and not isinstance(obj[key], bool)
+                and math.isfinite(obj[key])):
+            problems.append(f"key '{key}' must be a finite number, "
+                            f"got {obj[key]!r}")
+    if "error" in obj and not isinstance(obj["error"], str):
+        problems.append(f"key 'error' must be a string, got {obj['error']!r}")
+    return problems
+
+
+def checked_line(obj) -> str:
+    """json.dumps with the schema enforced — the emitter entry: a malformed
+    bench line fails AT THE BENCH instead of silently skewing deltas."""
+    problems = validate_line(obj)
+    if problems:
+        raise ValueError(
+            f"malformed bench line {obj!r}: {'; '.join(problems)}")
+    return json.dumps(obj)
+
+
+def _iter_tail_json_lines(text: str):
+    """Complete JSON-looking lines inside a driver-artifact tail transcript
+    (tails are tail-truncated, so a clipped first line is skipped)."""
+    for line in text.splitlines():
+        line = line.strip()
+        if line.startswith("{") and line.endswith("}"):
+            yield line
+
+
+def lint_artifacts(root: str | None = None) -> list[Finding]:
+    """BL001 over the repo-root BENCH_*.json driver artifacts."""
+    if root is None:
+        root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+    findings = []
+    for path in sorted(glob.glob(os.path.join(root, "BENCH_r*.json"))):
+        rel = os.path.basename(path)
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            findings.append(Finding(
+                rule=BL001, target=rel, detail="artifact-parse",
+                message=f"driver artifact is not valid JSON: {e}"))
+            continue
+        tail = doc.get("tail", "")
+        if not isinstance(tail, str):
+            continue
+        for line in _iter_tail_json_lines(tail):
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError:
+                findings.append(Finding(
+                    rule=BL001, target=rel, detail="line-parse",
+                    message=f"unparseable JSON line in tail: {line[:80]}"))
+                continue
+            problems = validate_line(obj)
+            if problems:
+                findings.append(Finding(
+                    rule=BL001, target=rel,
+                    detail=str(obj.get("metric", "?"))[:60],
+                    message=f"bench line fails schema: {'; '.join(problems)}"))
+    return findings

@@ -1,0 +1,123 @@
+"""tpulint finding model + baseline workflow.
+
+A :class:`Finding` is one structured hazard report from any analysis pass
+(AST lint, jaxpr walk, registry audit, bench-schema lint). Findings are
+compared against a checked-in ``analysis/baseline.json`` by FINGERPRINT —
+``rule::file-or-target::detail`` — deliberately excluding line numbers and
+message prose, so unrelated edits do not churn the baseline while a *new*
+instance of a known hazard class still gates.
+
+Baseline contract (the round-8 CI gate):
+
+- ``python -m paddle_tpu.analysis`` exits non-zero on any finding whose
+  fingerprint is not baselined (tier-1 runs the same check in
+  ``tests/test_analysis.py``).
+- ``--write-baseline`` rewrites the baseline to exactly the current finding
+  set — the reviewable "we accept these, here is why" artifact. Fingerprints
+  that no longer fire are dropped on rewrite (stale entries are reported as
+  ``fixed`` by :func:`diff_against_baseline` in the meantime).
+
+This module is import-cheap on purpose (no jax): the AST linter and the CLI
+plumbing must not pay backend init to lint source.
+"""
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+
+# rule id -> one-line description; populated by each pass module at import
+# (the rule catalog ARCHITECTURE.md documents)
+RULES: dict[str, str] = {}
+
+
+def rule(rule_id: str, description: str) -> str:
+    """Register a rule id in the catalog (idempotent; returns the id)."""
+    RULES.setdefault(rule_id, description)
+    return rule_id
+
+
+@dataclass
+class Finding:
+    """One structured hazard report.
+
+    ``rule``    catalog id (AL*/JX*/TR*/RA*/BL*).
+    ``target``  file path (source rules) or analysis target name (trace
+                rules) or table name (registry rules).
+    ``detail``  rule-specific stable key: op name / variable name / eqn
+                primitive — what makes this instance THIS instance.
+    ``message`` human diagnosis (free prose; not part of the fingerprint).
+    ``line``    1-based source line when known (not fingerprinted: line
+                drift must not churn the baseline).
+    """
+
+    rule: str
+    target: str
+    detail: str
+    message: str
+    line: int | None = None
+    data: dict = field(default_factory=dict)
+
+    @property
+    def fingerprint(self) -> str:
+        return f"{self.rule}::{self.target}::{self.detail}"
+
+    def to_json(self) -> dict:
+        d = {"rule": self.rule, "target": self.target, "detail": self.detail,
+             "message": self.message, "fingerprint": self.fingerprint}
+        if self.line is not None:
+            d["line"] = self.line
+        if self.data:
+            d["data"] = self.data
+        return d
+
+    def __str__(self) -> str:
+        loc = f"{self.target}:{self.line}" if self.line else self.target
+        return f"[{self.rule}] {loc}: {self.message}"
+
+
+BASELINE_PATH = os.path.join(os.path.dirname(__file__), "baseline.json")
+
+
+def load_baseline(path: str | None = None) -> set[str]:
+    """The baselined fingerprint set (empty when no baseline exists)."""
+    p = path or BASELINE_PATH
+    if not os.path.exists(p):
+        return set()
+    with open(p) as f:
+        doc = json.load(f)
+    return set(doc.get("findings", []))
+
+
+def write_baseline(findings: list[Finding], path: str | None = None,
+                   keep: set[str] | None = None) -> dict:
+    """Rewrite the baseline to exactly ``findings`` (sorted, deduped).
+
+    ``keep`` preserves additional fingerprints verbatim — the CLI passes the
+    entries owned by passes that did NOT run, so a partial
+    ``--passes source --write-baseline`` cannot silently drop the accepted
+    trace/registry/bench findings.
+    """
+    doc = {
+        "comment": ("tpulint accepted findings — every fingerprint here is "
+                    "a reviewed, knowingly-accepted hazard. Regenerate with "
+                    "python -m paddle_tpu.analysis --write-baseline."),
+        "findings": sorted({f.fingerprint for f in findings} | (keep or set())),
+    }
+    with open(path or BASELINE_PATH, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return doc
+
+
+def diff_against_baseline(findings: list[Finding],
+                          baseline: set[str] | None = None):
+    """(new, accepted, fixed): findings not in the baseline, findings in it,
+    and baselined fingerprints that no longer fire (stale — a rewrite drops
+    them)."""
+    base = load_baseline() if baseline is None else baseline
+    new = [f for f in findings if f.fingerprint not in base]
+    accepted = [f for f in findings if f.fingerprint in base]
+    fired = {f.fingerprint for f in findings}
+    fixed = sorted(base - fired)
+    return new, accepted, fixed

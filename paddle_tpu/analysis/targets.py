@@ -1,0 +1,135 @@
+"""tpulint flagship analysis targets.
+
+The concrete callables the CI gate analyzes every round — small-config
+builds of exactly the programs that carry the repo's numbers:
+
+- ``gpt-eager``   GPTForCausalLM forward + loss through the framework tape
+                  (op-dtype trace -> TR001 AMP cross-check);
+- ``bert-eager``  BertModel forward, same trace;
+- ``gpt-spmd``    the hybrid-parallel train step (jaxpr walk + donation);
+- ``serving``     build_prefill / build_decode_step jits (jaxpr walk +
+                  donation of the KV page pools).
+
+Configs are tiny (seconds on CPU; the analysis is abstract — eval_shape /
+make_jaxpr, no FLOPs run) but structurally identical to the flagship
+shapes: every scan/remat/constraint/donation the real programs use is in
+the traced jaxpr.
+"""
+from __future__ import annotations
+
+from .findings import Finding
+from .jaxpr_checks import (OpDtypeTrace, analyze_jaxpr, check_donation,
+                           trace_callable)
+
+
+def analyze_gpt_eager() -> list[Finding]:
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from ..models.gpt import GPTConfig, GPTForCausalLM
+
+    cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                    num_heads=2, max_seq_len=32)
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg)
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(0, 128, (2, 8)).astype(np.int64))
+    with OpDtypeTrace() as tr:
+        loss = model(ids, labels=ids)
+        del loss
+    return tr.findings("gpt-eager")
+
+
+def analyze_bert_eager() -> list[Finding]:
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from ..models.bert import BERT_CONFIGS, BertModel
+
+    paddle.seed(0)
+    model = BertModel(BERT_CONFIGS["bert-tiny"])
+    model.eval()  # dropout off: audit the inference dtype flow
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(0, 1024, (2, 8)).astype(np.int64))
+    with OpDtypeTrace() as tr:
+        model(ids)
+    return tr.findings("bert-eager")
+
+
+def analyze_gpt_spmd() -> list[Finding]:
+    import jax
+
+    from ..models.gpt import GPTConfig
+    from ..models.gpt_spmd import build_spmd_train_step, make_mesh
+
+    cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                    num_heads=2, max_seq_len=32)
+    mesh = make_mesh(len(jax.devices()))
+    step, params, mom, (ids, labels) = build_spmd_train_step(
+        cfg, mesh, batch_size=4, seq_len=32)
+    closed = trace_callable(step, params, mom, ids, labels)
+    findings = analyze_jaxpr(closed, "gpt-spmd-step")
+    # the builder donates (params, momentum); both must alias outputs
+    findings += check_donation(step, (params, mom, ids, labels), (0, 1),
+                               "gpt-spmd-step")
+    return findings
+
+
+def analyze_serving() -> list[Finding]:
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from ..inference.kv_cache import KVCacheManager
+    from ..models.gpt import (GPTConfig, GPTForCausalLM, build_decode_step,
+                              build_prefill, serving_params)
+
+    cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                    num_heads=2, max_seq_len=32)
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg)
+    params = serving_params(model)
+    page_size, b, s = 8, 2, 8
+    mgr = KVCacheManager(cfg.num_layers, cfg.num_heads, cfg.head_dim,
+                         num_pages=2 * b * (cfg.max_seq_len // page_size),
+                         max_batch=b, max_seq_len=cfg.max_seq_len,
+                         page_size=page_size, dtype=jnp.float32)
+    rng = np.random.RandomState(0)
+    ids2d = jnp.asarray(rng.randint(0, 128, (b, s)), jnp.int32)
+    lengths = jnp.full((b,), s, jnp.int32)
+    slots = [mgr.admit(s) for _ in range(b)]
+    pages = jnp.stack([mgr.slot_pages(sl) for sl in slots])
+
+    findings: list[Finding] = []
+    prefill = build_prefill(cfg, page_size)
+    pre_args = (params, ids2d, lengths, mgr.k_pages, mgr.v_pages, pages)
+    findings += analyze_jaxpr(trace_callable(prefill, *pre_args),
+                              "serving-prefill")
+    findings += check_donation(prefill, pre_args, (3, 4), "serving-prefill")
+
+    decode = build_decode_step(cfg, page_size)
+    dec_args = (params, jnp.zeros((b,), jnp.int32), lengths,
+                mgr.k_pages, mgr.v_pages,
+                jnp.stack([mgr.slot_pages(sl) for sl in slots]))
+    findings += analyze_jaxpr(trace_callable(decode, *dec_args),
+                              "serving-decode")
+    findings += check_donation(decode, dec_args, (3, 4), "serving-decode")
+    return findings
+
+
+TARGETS = {
+    "gpt-eager": analyze_gpt_eager,
+    "bert-eager": analyze_bert_eager,
+    "gpt-spmd": analyze_gpt_spmd,
+    "serving": analyze_serving,
+}
+
+
+def analyze_flagships(names=None) -> list[Finding]:
+    out: list[Finding] = []
+    for name, fn in TARGETS.items():
+        if names is not None and name not in names:
+            continue
+        out.extend(fn())
+    return out

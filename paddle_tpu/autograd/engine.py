@@ -30,6 +30,11 @@ amp_cast_hook: Callable | None = None
 # Hook installed by the profiler to wrap op execution in RecordEvent ranges.
 op_profile_hook: Callable | None = None
 
+# Hook installed by paddle_tpu.analysis (tpulint TR001) to observe per-op
+# input/output dtypes during a trace run. Signature:
+# op_dtype_hook(op_name, in_dtypes, out_dtypes)
+op_dtype_hook: Callable | None = None
+
 # Hook installed by paddle_tpu.static while a Program is recording: called as
 # hook(name, fn, treedef, leaves, out_tensors) after each op executes so the
 # Program can append a replayable statement (define-by-run becomes
@@ -300,6 +305,12 @@ def apply_op(name: str, fn: Callable, *args, **kwargs):
     # hook returns an end-callback closing the dispatch range (or None)
     end_profile = op_profile_hook(name) if op_profile_hook is not None else None
 
+    # capture input dtypes NOW: the saved-tensors-hooks path nulls the diff
+    # leaves (unpin_closure) before dispatch returns, which would drop
+    # exactly the float inputs from the TR001 dtype cross-check
+    dtype_hook_ins = ([l._data.dtype for l in leaves if isinstance(l, Tensor)]
+                      if op_dtype_hook is not None else None)
+
     # The framework default is matmul precision "highest" (true-fp32
     # semantics for user-facing float32). For HALF-precision ops that
     # default makes XLA emulate bf16 matmuls with multi-pass passes — 3-6x
@@ -410,6 +421,9 @@ def apply_op(name: str, fn: Callable, *args, **kwargs):
             node.saved_low_prec = bool(low_prec)
             if node.saved_packed is not None and node.unpin_closure:
                 node.unpin_closure()
+
+    if op_dtype_hook is not None:
+        op_dtype_hook(name, dtype_hook_ins, [o.dtype for o in out_flat])
 
     if flags.flag("check_nan_inf"):
         _check_nan_inf(name, out_flat)

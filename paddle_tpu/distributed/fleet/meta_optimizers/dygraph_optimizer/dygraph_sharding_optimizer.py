@@ -35,6 +35,21 @@ def _sharding_mesh(hcg=None, group=None):
     return g.to_jax_mesh(), g.axis_name
 
 
+def host_memory_kind():
+    """The backend's host memory kind for offloaded state: "pinned_host"
+    where the device supports it (TPU/GPU), else the backend's plain host
+    space ("unpinned_host" on the CPU backend, whose devices cannot address
+    pinned host memory at all)."""
+    try:
+        kinds = {m.kind for m in jax.devices()[0].addressable_memories()}
+    except Exception:
+        return "pinned_host"
+    for k in ("pinned_host", "unpinned_host"):
+        if k in kinds:
+            return k
+    return "pinned_host"
+
+
 def _shard_leading(arr, mesh, axis_name, memory_kind=None):
     """Place an array sharded on dim 0 over the axis if divisible, else
     replicated (small params stay replicated — the reference assigns whole
@@ -64,7 +79,7 @@ class DygraphShardingOptimizer:
         self._mesh, self._axis = _sharding_mesh(hcg, group)
         # offload: optimizer states live in host memory (reference ZeRO
         # CPU-offload); XLA streams shards to device inside the update
-        self._memory_kind = "pinned_host" if offload else None
+        self._memory_kind = host_memory_kind() if offload else None
         self._install_state_placement(optimizer)
         self._param_shardings = {}
 
@@ -112,7 +127,12 @@ class DygraphShardingOptimizer:
         opt = self._inner_opt
 
         def move(a):
-            kind = memory_kind or "device"
+            # "device" is the default memory space where the backend has one;
+            # on the CPU backend the only addressable space IS host memory,
+            # so staging/evicting degenerates to a no-op move
+            kind = memory_kind or jax.devices()[0].default_memory().kind
+            if a.sharding.memory_kind == kind:
+                return a
             return jax.device_put(a, a.sharding.with_memory_kind(kind))
 
         for state in opt._accumulators.values():

@@ -5,9 +5,10 @@ This is the TPU-native answer to the reference's interceptor/1F1B machinery
 the riskiest novel design). The idiom (GSPMD pipelining, as used by praxis /
 the scaling-book recipe): make stages homogeneous, stack their weights on a
 leading dim sharded over the ``pp`` axis, and run a ``lax.scan`` whose step
-does one stage-compute and one ``lax.ppermute`` shift. Every device runs the
-same program (SPMD), XLA overlaps the permute with compute, and the bubble is
-the classic (S-1)/(M+S-1).
+computes every stage in parallel (``vmap`` over the stacked dim) and shifts
+the ring with ``jnp.roll`` on it — GSPMD emits the collective-permute, every
+device runs the same program (SPMD), XLA overlaps the permute with compute,
+and the bubble is the classic (S-1)/(M+S-1).
 
 ``pipeline_spmd(stage_fn, stacked_params, microbatches, ...)`` is the raw
 functional engine; autograd-capable through the framework tape (it is one
@@ -15,7 +16,6 @@ apply_op over a pure jax function).
 """
 from __future__ import annotations
 
-from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -46,56 +46,38 @@ def pipeline_spmd(
     """
     num_stages = mesh.shape[pp_axis]
 
+    def _stage_spec(ndim):
+        # stacked/carry arrays: leading dim is the stage dim over pp; every
+        # other mesh axis (dp/mp on a hybrid mesh) stays GSPMD-automatic so
+        # TP weight shardings keep partitioning the stage compute
+        return NamedSharding(mesh, P(pp_axis, *([None] * (ndim - 1))))
+
     def pure(params, mbs):
         num_micro = mbs.shape[0]
         total = num_micro + num_stages - 1
+        last = num_stages - 1
+        stage_v = jax.vmap(stage_fn)
 
-        def per_device(p_local, mbs_local):
-            stage = lax.axis_index(pp_axis)
-            p_one = jax.tree.map(lambda a: a[0], p_local)
-            last = num_stages - 1
+        # Roll formulation (praxis-style GSPMD pipelining): all stages
+        # compute in parallel under vmap over the pp-sharded stacked dim and
+        # the ring shift is jnp.roll on that dim — GSPMD emits the
+        # collective-permute itself. The earlier partial-manual shard_map
+        # ring (axis_index + ppermute with auto dp/mp) lowers through
+        # PartitionId / manual-subgroup shardings the jax-0.4.x SPMD
+        # partitioner rejects.
+        def step(carry, t):
+            # stage 0 ingests microbatch t (clipped past the schedule; the
+            # recycled garbage is never collected)
+            acts = carry.at[0].set(mbs[jnp.clip(t, 0, num_micro - 1)])
+            acts = lax.with_sharding_constraint(acts, _stage_spec(acts.ndim))
+            y = stage_v(params, acts)
+            # shift forward: stage s's output becomes stage s+1's next input
+            return jnp.roll(y, 1, axis=0), y[last]
 
-            def step(carry, t):
-                acts = carry  # [mb, ...] activation arriving at this stage
-                # stage 0 ingests microbatch t (clipped; masked later)
-                x0 = mbs_local[jnp.clip(t, 0, num_micro - 1)]
-                x = jnp.where(stage == 0, x0, acts)
-                y = stage_fn(p_one, x)
-                # shift forward along the ring; stage s -> s+1
-                perm = [(i, (i + 1) % num_stages) for i in range(num_stages)]
-                y_shift = lax.ppermute(y, pp_axis, perm)
-                # collect: only last stage's y at valid times is output
-                valid = jnp.logical_and(t - last >= 0, t - last < num_micro)
-                out_t = jnp.where(
-                    jnp.logical_and(stage == last, valid), y, jnp.zeros_like(y)
-                )
-                # replicate the output across stages so out_specs can be P()
-                out_t = lax.psum(out_t, pp_axis)
-                return y_shift, out_t
-
-            init = jnp.zeros_like(mbs_local[0])
-            # the carry becomes device-varying after the ppermute; mark the
-            # initial value accordingly (jax>=0.8 varying-manual-axes check)
-            init = lax.pcast(init, (pp_axis,), to="varying")
-            _, outs = lax.scan(step, init, jnp.arange(total))
-            return outs  # [total, mb, ...] replicated
-
-        shard = jax.shard_map(
-            per_device,
-            mesh=mesh,
-            in_specs=(
-                jax.tree.map(lambda _: P(pp_axis), params),
-                P(),  # microbatches replicated (only stage 0 reads them)
-            ),
-            out_specs=P(),
-            # manual ONLY over pp: any other mesh axes (dp/mp on a hybrid
-            # mesh) stay GSPMD-automatic inside the stage body, so TP weight
-            # shardings and dp batch shardings keep partitioning the stage
-            # compute instead of being forcibly replicated
-            axis_names=frozenset({pp_axis}),
-        )
-        outs = shard(params, mbs)
-        return outs[num_stages - 1 : num_stages - 1 + num_micro]
+        init = jnp.zeros((num_stages,) + mbs.shape[1:], mbs.dtype)
+        _, outs = lax.scan(step, init, jnp.arange(total, dtype=jnp.int32))
+        # microbatch m reaches the last stage at t = m + (S-1)
+        return outs[last : last + num_micro]
 
     return apply_op("pipeline_spmd", pure, stacked_params, microbatches)
 
@@ -194,64 +176,52 @@ def pipeline_spmd_interleaved(
             raise ValueError(
                 f"interleaved pipeline needs num_micro ({M}) >= num_stages "
                 f"({num_stages})")
-        total = V * M + num_stages - 1
-        last = num_stages - 1
+        S = num_stages
+        total = V * M + S - 1
+        last = S - 1
+        # circular-stacked leading dim S*V (index d*V + r holds chunk
+        # r*S + d) -> [S, V, ...] so stage d dynamically picks lap r
+        params_sv = jax.tree.map(
+            lambda a: a.reshape((S, V) + a.shape[1:]), params)
+        # all schedule arithmetic in int32: under the framework's x64 mode
+        # mixed s64/s32 scatter indices trip the HLO verifier in the scan
+        # transpose (dynamic_update_slice bound compare)
+        sidx = jnp.arange(S, dtype=jnp.int32)
 
-        def per_device(p_local, mbs_local):
-            d = lax.axis_index(pp_axis)
-            # p_local leading dim = V laps for this device
-            perm = [(i, (i + 1) % num_stages) for i in range(num_stages)]
+        def _stage_spec(ndim):
+            return NamedSharding(mesh, P(pp_axis, *([None] * (ndim - 1))))
 
-            def step(carry, n):
-                slot, buf = carry  # slot: ring activation; buf: [M, ...]
-                k = n - d          # this device's schedule clock
-                r = jnp.clip(k // M, 0, V - 1)   # lap (chunk) index
-                m = jnp.mod(jnp.clip(k, 0, V * M - 1), M)  # microbatch
-                valid = jnp.logical_and(k >= 0, k < V * M)
-                # stage-0 input: fresh microbatch (lap 0) or buffered return
-                x0 = jnp.where(r == 0, mbs_local[m], buf[m])
-                x = jnp.where(d == 0, x0, slot)
-                p_one = jax.tree.map(lambda a: a[r], p_local)
-                y = stage_fn(p_one, x)
-                y = jnp.where(valid, y, jnp.zeros_like(y))
-                y_shift = lax.ppermute(y, pp_axis, perm)
-                # device 0 banks the arriving lap return for its microbatch
-                ka = n - last  # clock of the stage that produced the arrival
-                ma = jnp.mod(jnp.clip(ka, 0, V * M - 1), M)
-                arrived = jnp.logical_and(ka >= 0, ka < (V - 1) * M)
-                buf = jnp.where(
-                    jnp.logical_and(d == 0, arrived),
-                    buf.at[ma].set(y_shift),
-                    buf,
-                )
-                # collect finished activations (device last, final lap)
-                done = jnp.logical_and(ka >= (V - 1) * M, ka < V * M)
-                out_t = jnp.where(
-                    jnp.logical_and(d == last, done), y, jnp.zeros_like(y))
-                out_t = lax.psum(out_t, pp_axis)
-                return (y_shift, buf), out_t
+        def one_stage(p_v, x, r):
+            return stage_fn(jax.tree.map(lambda a: a[r], p_v), x)
 
-            init_slot = jnp.zeros_like(mbs_local[0])
-            init_slot = lax.pcast(init_slot, (pp_axis,), to="varying")
-            init_buf = jnp.zeros_like(mbs_local)
-            init_buf = lax.pcast(init_buf, (pp_axis,), to="varying")
-            (_, _), outs = lax.scan(step, (init_slot, init_buf),
-                                    jnp.arange(total))
-            return outs
+        stage_v = jax.vmap(one_stage)
 
-        shard = jax.shard_map(
-            per_device,
-            mesh=mesh,
-            in_specs=(
-                jax.tree.map(lambda _: P(pp_axis), params),
-                P(),
-            ),
-            out_specs=P(),
-            axis_names=frozenset({pp_axis}),  # non-pp axes stay GSPMD-auto
-        )
-        outs = shard(params, mbs)
+        # Roll formulation (see pipeline_spmd): stages compute in parallel
+        # under vmap over the pp-sharded stacked dim; the ring shift is
+        # jnp.roll; stage 0 banks arriving lap returns in a replicated buf.
+        def step(carry, n):
+            acts, buf = carry
+            r = jnp.clip((n - sidx) // M, 0, V - 1)  # [S] lap per stage
+            # stage-0 input: fresh microbatch (lap 0) or buffered return
+            m0 = jnp.mod(jnp.clip(n, 0, V * M - 1), M)
+            x0 = jnp.where(r[0] == 0, mbs[m0], buf[m0])
+            acts = acts.at[0].set(x0)
+            acts = lax.with_sharding_constraint(acts, _stage_spec(acts.ndim))
+            y = stage_v(params_sv, acts, r)
+            y_last = y[last]
+            # bank the lap return arriving at stage 0 for its microbatch
+            ka = n - last  # clock of the stage that produced the arrival
+            ma = jnp.mod(jnp.clip(ka, 0, V * M - 1), M)
+            arrived = jnp.logical_and(ka >= 0, ka < (V - 1) * M)
+            buf = buf.at[ma].set(jnp.where(arrived, y_last, buf[ma]))
+            return (jnp.roll(y, 1, axis=0), buf), y_last
+
+        init_acts = jnp.zeros((S,) + mbs.shape[1:], mbs.dtype)
+        init_buf = jnp.zeros_like(mbs)
+        (_, _), outs = lax.scan(step, (init_acts, init_buf),
+                                jnp.arange(total, dtype=jnp.int32))
         # microbatch m finishes at n = (V-1)*M + m + (S-1)
-        start = (V - 1) * M + num_stages - 1
+        start = (V - 1) * M + S - 1
         return outs[start:start + M]
 
     return apply_op("pipeline_spmd_interleaved", pure, stacked_params,

@@ -15,9 +15,10 @@ way:
   constraints — GSPMD turns the row-linear all-reduce into
   reduce-scatter + all-gather exactly like the reference's SP layers.
 - **pp**: stacked-stage GSPMD pipelining (stage weights stacked on a leading
-  dim sharded over ``pp``): a partial-manual ``shard_map`` (manual over pp
-  only) runs the microbatch ring with ``lax.ppermute`` — the 1F1B-equivalent
-  schedule with bubble (S-1)/(M+S-1).
+  dim sharded over ``pp``): all stages compute in parallel under ``vmap``
+  over the stacked dim and the microbatch ring shifts via ``jnp.roll`` on it
+  (GSPMD emits the collective-permute) — the 1F1B-equivalent schedule with
+  bubble (S-1)/(M+S-1), with every mesh axis staying GSPMD-automatic.
 
 Everything is a pure function over a params pytree -> works under jit, grad,
 and donation; the single entry is :func:`build_spmd_train_step`.
@@ -25,7 +26,6 @@ and donation; the single entry is :func:`build_spmd_train_step`.
 from __future__ import annotations
 
 import math
-from functools import partial
 
 import numpy as np
 
@@ -202,8 +202,8 @@ def _fused_mlp_on(config: GPTConfig, mesh: Mesh) -> bool:
 
 def _mk_cs(mesh: Mesh):
     # Plain PartitionSpecs resolve against the context mesh (jax.set_mesh),
-    # which inside a partial-manual shard_map is the manual-adjusted abstract
-    # mesh — concrete NamedShardings would mismatch there.
+    # so the same constraints hold inside vmapped/scanned bodies where a
+    # concrete NamedSharding's rank could mismatch the batched view.
     def cs(x, spec):
         return lax.with_sharding_constraint(x, spec)
 
@@ -352,10 +352,19 @@ def _stage_fn(p_stage, x, config: GPTConfig, mesh: Mesh):
 
 
 def _pipeline(stages, mbs, mesh: Mesh, config: GPTConfig):
-    """Microbatch ring over the pp axis (GSPMD-pipelined stacked stages).
+    """Microbatch pipeline over the pp axis (GSPMD-pipelined stacked stages).
 
     stages: pytree with leading [pp, lps, ...] dims. mbs: [M, mb, s, h].
     Returns [M, mb, s, h] (last-stage outputs, replicated over pp).
+
+    Roll formulation (praxis-style GSPMD pipelining): every stage computes
+    in parallel under ``vmap`` over the pp-sharded stacked dim, and the ring
+    shift is ``jnp.roll`` on that dim — GSPMD emits the collective-permute
+    itself and every mesh axis stays automatic. The earlier partial-manual
+    ``shard_map`` ring is gone: ``lax.axis_index``/``lax.ppermute`` inside a
+    partially-auto manual region lower through PartitionId / mismatched
+    manual-subgroup shardings that the jax-0.4.x SPMD partitioner rejects
+    (CPU: hard UNIMPLEMENTED / partitioner check failure).
     """
     num_stages = mesh.shape["pp"]
     num_micro = mbs.shape[0]
@@ -369,39 +378,22 @@ def _pipeline(stages, mbs, mesh: Mesh, config: GPTConfig):
 
     total = num_micro + num_stages - 1
     last = num_stages - 1
+    cs = _mk_cs(mesh)
 
-    def per_device(p_local, mbs_):
-        stage = lax.axis_index("pp")
-        p_one = jax.tree.map(lambda a: a[0], p_local)
+    stage_v = jax.vmap(lambda p, x: _stage_fn(p, x, config, mesh))
 
-        def step(carry, t):
-            acts = carry
-            x0 = mbs_[jnp.clip(t, 0, num_micro - 1)]
-            x = jnp.where(stage == 0, x0, acts)
-            y = _stage_fn(p_one, x, config, mesh)
-            perm = [(i, (i + 1) % num_stages) for i in range(num_stages)]
-            y_shift = lax.ppermute(y, "pp", perm)
-            valid = jnp.logical_and(t - last >= 0, t - last < num_micro)
-            out_t = jnp.where(
-                jnp.logical_and(stage == last, valid), y, jnp.zeros_like(y)
-            )
-            out_t = lax.psum(out_t, "pp")
-            return y_shift, out_t
+    def step(carry, t):
+        # inject microbatch t into stage 0 (clipped past the schedule; the
+        # recycled garbage is never collected), run ALL stages in parallel,
+        # shift stage s's output to stage s+1's next input via the roll
+        acts = carry.at[0].set(mbs[jnp.clip(t, 0, num_micro - 1)])
+        acts = cs(acts, P("pp", "dp", None, None))
+        y = stage_v(stages, acts)
+        return jnp.roll(y, 1, axis=0), y[last]
 
-        init = jnp.zeros_like(mbs_[0])
-        init = lax.pcast(init, ("pp",), to="varying")
-        _, outs = lax.scan(step, init, jnp.arange(total))
-        return outs
-
-    shard = jax.shard_map(
-        per_device,
-        mesh=mesh,
-        in_specs=(jax.tree.map(lambda _: P("pp"), stages), P()),
-        out_specs=P(),
-        axis_names={"pp"},  # manual over pp; dp/mp stay GSPMD-auto
-        check_vma=False,
-    )
-    outs = shard(stages, mbs)
+    init = jnp.zeros((num_stages,) + mbs.shape[1:], mbs.dtype)
+    _, outs = lax.scan(step, init, jnp.arange(total, dtype=jnp.int32))
+    # microbatch m reaches the last stage at t = m + (S-1)
     return outs[last : last + num_micro]
 
 

@@ -113,10 +113,12 @@ def autotune(batch_heads, seq_q, seq_k, d, dtype=jnp.bfloat16,
     if _interpret():
         return _blocks_for(seq_q, seq_k, d, dtype)
     _atc.load()
-    key = jax.random.PRNGKey(0)
-    q = jax.random.normal(key, (batch_heads, seq_q, d), dtype)
-    k = jax.random.normal(key, (batch_heads, seq_k, d), dtype)
-    v = jax.random.normal(key, (batch_heads, seq_k, d), dtype)
+    # one subkey per operand: a shared key makes q/k/v IDENTICAL streams
+    # (q == k when seq_q == seq_k), degenerating the softmax the sweep times
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(kq, (batch_heads, seq_q, d), dtype)
+    k = jax.random.normal(kk, (batch_heads, seq_k, d), dtype)
+    v = jax.random.normal(kv, (batch_heads, seq_k, d), dtype)
     sig_f = _sig(seq_q, seq_k, d, dtype, "fwd")
     sig_b = _sig(seq_q, seq_k, d, dtype, "bwd")
     saved = (_atc.CACHE.get(sig_f), _atc.CACHE.get(sig_b))
@@ -181,10 +183,12 @@ def autotune_split(batch_heads, seq_q, seq_k, d, dtype=jnp.bfloat16,
         b = _blocks_for(seq_q, seq_k, d, dtype)
         return b, b
     _atc.load()
-    key = jax.random.PRNGKey(0)
-    q = jax.random.normal(key, (batch_heads, seq_q, d), dtype)
-    k = jax.random.normal(key, (batch_heads, seq_k, d), dtype)
-    v = jax.random.normal(key, (batch_heads, seq_k, d), dtype)
+    # one subkey per operand: a shared key makes q/k/v IDENTICAL streams
+    # (q == k when seq_q == seq_k), degenerating the softmax the sweep times
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(kq, (batch_heads, seq_q, d), dtype)
+    k = jax.random.normal(kk, (batch_heads, seq_k, d), dtype)
+    v = jax.random.normal(kv, (batch_heads, seq_k, d), dtype)
     scale = 1.0 / math.sqrt(d)
     sig_f = _sig(seq_q, seq_k, d, dtype, "fwd")
     sig_b = _sig(seq_q, seq_k, d, dtype, "bwd")

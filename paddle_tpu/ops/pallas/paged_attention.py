@@ -271,14 +271,16 @@ def autotune_page_size(batch, hq, hkv, d, max_len=2048, dtype=jnp.bfloat16,
         return preferred_page_size(hq, hkv, d, dtype)
     _atc.load()
     sig = _sig(hq, hkv, d, dtype)
-    key = jax.random.PRNGKey(0)
-    q = jax.random.normal(key, (batch, hq, d), dtype)
+    # one subkey per operand: a shared key makes q/k/v correlated streams,
+    # degenerating the softmax the sweep times
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(kq, (batch, hq, d), dtype)
     best, best_t = None, float("inf")
     for ps in candidates:
         pps = (max_len + ps - 1) // ps
         num_pages = batch * pps + 1
-        kp = jax.random.normal(key, (num_pages, ps, hkv, d), dtype)
-        vp = jax.random.normal(key, (num_pages, ps, hkv, d), dtype)
+        kp = jax.random.normal(kk, (num_pages, ps, hkv, d), dtype)
+        vp = jax.random.normal(kv, (num_pages, ps, hkv, d), dtype)
         pt = jnp.arange(batch * pps, dtype=jnp.int32).reshape(batch, pps)
         lens = jnp.full((batch,), max_len, jnp.int32)
         try:

@@ -64,21 +64,166 @@ def _matmul_flops(input_shapes, attrs):
     return 2 * batch * m * k * n
 
 
+@register_flops("mm")
+@register_flops("bmm")
+def _mm_flops(input_shapes, attrs):
+    return _matmul_flops(input_shapes, attrs)
+
+
+@register_flops("addmm")
+def _addmm_flops(input_shapes, attrs):
+    # input + alpha * (x @ y): the GEMM dominates; + out adds per element
+    x = list(_first(input_shapes, "X", "x"))
+    y = list(_first(input_shapes, "Y", "y"))
+    if len(x) < 2 or len(y) < 2:
+        return 0
+    return 2 * x[-2] * x[-1] * y[-1] + x[-2] * y[-1]
+
+
+@register_flops("mv")
+def _mv_flops(input_shapes, attrs):
+    x = _first(input_shapes, "X", "x")
+    return 2 * prod(x) if x else 0  # [m, k] @ [k] = 2mk
+
+
+@register_flops("linear")
+@register_flops("fused_linear")
+def _linear_flops(input_shapes, attrs):
+    # x [..., k] @ w [k, n] (+ bias)
+    x = _first(input_shapes, "Input", "x", "X")
+    w = _first(input_shapes, "W", "weight", "Y", "y")
+    if not x or len(w) < 2:
+        return 0
+    return 2 * prod(x[:-1]) * x[-1] * w[-1] + prod(x[:-1]) * w[-1]
+
+
+@register_flops("weight_only_linear")
+def _weight_only_linear_flops(input_shapes, attrs):
+    # dequant epilogue rides the GEMM: count the GEMM MACs
+    return _linear_flops(input_shapes, attrs)
+
+
+def _conv_flops_nd(input_shapes, attrs, nd):
+    """MACs of an N-d convolution (NC<spatial> x, OI<spatial> filter)."""
+    x = _first(input_shapes, "Input", "x")
+    w = _first(input_shapes, "Filter", "weight")
+    if len(x) != nd + 2 or len(w) != nd + 2:
+        return 0
+    strides = list(attrs.get("strides", [1] * nd)) or [1] * nd
+    paddings = list(attrs.get("paddings", [0] * nd)) or [0] * nd
+    dilations = list(attrs.get("dilations", [1] * nd)) or [1] * nd
+    if len(strides) < nd:
+        strides = strides * nd
+    if len(paddings) < nd:
+        paddings = paddings * nd
+    if len(dilations) < nd:
+        dilations = dilations * nd
+    n = x[0]
+    co, ci_g = w[0], w[1]
+    out_spatial = 1
+    for i in range(nd):
+        size = (x[2 + i] + 2 * paddings[i]
+                - dilations[i] * (w[2 + i] - 1) - 1) // strides[i] + 1
+        out_spatial *= max(size, 0)
+    return 2 * n * co * out_spatial * ci_g * prod(w[2:])
+
+
+@register_flops("conv1d")
+def _conv1d_flops(input_shapes, attrs):
+    return _conv_flops_nd(input_shapes, attrs, 1)
+
+
 @register_flops("conv2d")
 def _conv2d_flops(input_shapes, attrs):
-    x = _first(input_shapes, "Input", "x")  # NCHW
-    w = _first(input_shapes, "Filter", "weight")  # OIHW
-    if len(x) != 4 or len(w) != 4:
+    return _conv_flops_nd(input_shapes, attrs, 2)
+
+
+@register_flops("conv3d")
+def _conv3d_flops(input_shapes, attrs):
+    return _conv_flops_nd(input_shapes, attrs, 3)
+
+
+def _conv_transpose_flops(input_shapes, attrs):
+    """Transposed conv: one MAC per input position per filter tap — the
+    gradient-of-conv identity, independent of output padding arithmetic."""
+    x = _first(input_shapes, "Input", "x")
+    w = _first(input_shapes, "Filter", "weight")
+    if not x or len(w) < 3:
         return 0
-    strides = attrs.get("strides", [1, 1])
-    paddings = attrs.get("paddings", [0, 0])
-    dilations = attrs.get("dilations", [1, 1])
-    groups = attrs.get("groups", 1)
-    n, _, h, wd = x
-    co, ci_g, kh, kw = w
-    ho = (h + 2 * paddings[0] - dilations[0] * (kh - 1) - 1) // strides[0] + 1
-    wo = (wd + 2 * paddings[-1] - dilations[-1] * (kw - 1) - 1) // strides[-1] + 1
-    return 2 * n * co * ho * wo * ci_g * kh * kw // max(groups // groups, 1)
+    # x [n, ci, *sp], w [ci, co_g, *k]
+    return 2 * prod(x) * w[1] * prod(w[2:])
+
+
+for _name in ("conv1d_transpose", "conv2d_transpose", "conv3d_transpose"):
+    register_flops(_name)(_conv_transpose_flops)
+
+
+@register_flops("einsum")
+def _einsum_flops(input_shapes, attrs):
+    """2 * prod(distinct dim sizes) of the contraction — exact for any
+    single-contraction einsum (matmul, attention scores), an upper bound
+    for multi-operand chains. An equation/shape mismatch (broadcast
+    ellipsis, rank drift) returns 0: a partial product would silently skew
+    MFU numbers, an exact-0 reads as "unaccounted"."""
+    eq = attrs.get("equation", "")
+    operands = input_shapes.get("Operands") or input_shapes.get("operands") \
+        or [v[0] if v and isinstance(v[0], (list, tuple)) else v
+            for v in input_shapes.values()]
+    if not eq or not operands:
+        return 0
+    lhs = eq.replace(" ", "").split("->")[0].split(",")
+    if len(lhs) != len(operands):
+        return 0
+    sizes = {}
+    for labels, shape in zip(lhs, operands):
+        labels = labels.replace("...", "")
+        if len(labels) != len(shape):
+            return 0  # ellipsis/rank mismatch: no partial products
+        for ch, sz in zip(labels, shape):
+            sizes[ch] = max(sizes.get(ch, 1), int(sz))
+    if not sizes:
+        return 0
+    return 2 * prod(sizes.values())
+
+
+def _attn_flops(b, heads, s_q, s_k, d, causal):
+    f = 4 * b * heads * s_q * s_k * d  # QK^T + PV
+    return f // 2 if causal else f
+
+
+@register_flops("scaled_dot_product_attention")
+def _sdpa_flops(input_shapes, attrs):
+    # shares the analytic core with flash_attention/flash_attn_unpadded so
+    # the three attention spellings cannot drift apart
+    q = _first(input_shapes, "q", "Q", "query", "x")
+    k = _first(input_shapes, "k", "K", "key")
+    if len(q) != 4:
+        return 0
+    b, s_q, h, d = q
+    s_k = k[1] if len(k) == 4 else s_q
+    causal = attrs.get("causal", attrs.get("is_causal", False))
+    return _attn_flops(b, h, s_q, s_k, d, causal)
+
+
+@register_flops("flash_attn_unpadded")
+def _flash_unpadded_flops(input_shapes, attrs):
+    """Varlen (packed) flash attention: q is [total_tokens, H, D]. With
+    ``max_seqlen_k`` in attrs this is the padded-layout upper bound
+    (total_tokens rows each attending <= max_seqlen_k keys); without it the
+    packed batch is treated as one sequence (k total_tokens long)."""
+    q = _first(input_shapes, "q", "Q", "query", "x")
+    k = _first(input_shapes, "k", "K", "key")
+    causal = attrs.get("causal", attrs.get("is_causal", False))
+    if len(q) == 4:  # already-padded spelling
+        b, s_q, h, d = q
+        s_k = k[1] if len(k) == 4 else s_q
+        return _attn_flops(b, h, s_q, s_k, d, causal)
+    if len(q) != 3:
+        return 0
+    total, h, d = q
+    s_k = int(attrs.get("max_seqlen_k", 0)) or (
+        k[0] if len(k) == 3 else total)
+    return _attn_flops(1, h, total, s_k, d, causal)
 
 
 @register_flops("c_embedding")
