@@ -1,29 +1,32 @@
-"""Serving benchmark: paged-KV autoregressive decode throughput + latency.
+"""Serving benchmark: unified ragged serving step vs the legacy two-jit path.
 
-The round-7 serving metric, joining the bench trajectory next to bench.py's
-training lines. Drives the continuous-batching ServingPredictor (paged KV
-cache + fixed-shape decode jit) through a steady-state decode phase and
-emits ONE JSON line per implementation (same schema/contract as bench.py —
-the flagship paged-kernel line LAST):
+The round-9 serving A/B, joining the bench trajectory next to bench.py's
+training lines. Drives the continuous-batching ServingPredictor through a
+two-wave workload (admit half the lanes, then admit the SAME prompts into
+the remaining lanes while the first wave decodes — the prefix-cache +
+chunked-prefill steady state) and emits ONE JSON line per leg (same
+schema/contract as bench.py — the flagship unified line LAST):
 
-- ``value``/``unit``: decode tokens/sec/chip (batch * steps / elapsed)
-- ``vs_baseline``: paged Pallas kernel speedup over the jnp gather-based
-  reference attention (the XLA implementation a non-paged runtime would
-  use) — the serving A/B this round introduces
-- ``p50_ms``/``p99_ms``: per-token latency percentiles over the timed
-  decode steps (each step produces one token for every running sequence)
-- ``decode_retraces``: times the decode step traced during the timed phase
-  — MUST stay 1 (compile once, replay fixed-shape; the no-retrace gate)
+- ``value``/``unit``: decode tokens/sec/chip over the timed steady phase
+- ``vs_baseline``: unified-step speedup over the legacy round-7 two-jit
+  path (bucketed batch-1 prefill jit + fixed-shape decode jit)
+- ``p50_ms``/``p99_ms``: per-step latency percentiles (timed phase)
+- ``ttft_p50_ms``/``ttft_p99_ms``: time-to-first-token percentiles over
+  the SECOND wave (warm executables — steady-state serving TTFT; wave-2
+  admissions on the legacy path pay a full head-of-line prompt forward,
+  on the unified path chunked prefill interleaves with decode)
+- ``prefix_hit_rate``: fraction of admitted context tokens served from
+  the prefix cache (0.0 on the legacy leg — it has no prefix cache)
+- ``decode_retraces``: decode/unified-step traces during the timed phase
+  + 1 — MUST stay 1 (compile once, replay fixed-shape)
+- ``prefill_retraces``: prefill executables compiled over the WHOLE leg —
+  the bucketed-prefill compile count the two-jit split hides (one per
+  prompt-length bucket); the unified step has no prefill jit: always 0
 
-Methodology: admit ``--batch`` sequences with ``--prompt``-token prompts
-(prefill excluded from the timing — it is a one-off per request; the
-steady-state serving cost is decode), 3 warmup steps (compile + cache), then
-``--steps`` timed scheduler steps, one host sync per step (the per-step sync
-IS the serving pattern — each token returns to the user).
-
-``--smoke``: tiny CPU config, kernel in interpret mode — always runnable
-(CI leg, rc 0). Off-TPU without ``--smoke`` each leg emits a structured
-``error`` line instead of crashing (driver contract, like bench_flash_ab).
+``--smoke``: tiny CPU config — always runnable (CI leg, rc 0; gather
+reference attention keeps it fast, kernel parity is the test suite's
+job). Off-TPU without ``--smoke`` each leg emits a structured ``error``
+line instead of crashing (driver contract, like bench_flash_ab).
 """
 from __future__ import annotations
 
@@ -47,16 +50,27 @@ def _percentile(xs, q):
     return float(np.percentile(np.asarray(xs), q))
 
 
-def bench_decode(*, hidden, layers, heads, vocab, batch, prompt,
-                 steps, page_size, use_kernel, on_tpu, dtype=None):
-    """One serving leg. Returns (tokens/s, p50_ms, p99_ms, retraces)."""
+def bench_serving(*, hidden, layers, heads, vocab, batch, prompt, steps,
+                  gen_len, page_size, chunk, unified, use_kernel, on_tpu,
+                  dtype=None):
+    """One serving leg. Returns a dict of the emitted metrics.
+
+    Workload: CONTINUOUS arrivals — ``batch`` concurrent requests drawn
+    round-robin from a small prompt pool (production repeated-system-
+    prompt traffic: prefix hits for the unified leg); every finished
+    request is immediately replaced, so the timed window mixes admissions,
+    chunked prefill and decode the way a serving fleet does. This is the
+    regime the round-9 tentpole targets — the legacy leg pays a full
+    head-of-line prompt forward per admission, the unified leg interleaves
+    chunks under the token budget and skips re-prefilling cached prefixes.
+    """
     import jax.numpy as jnp
 
     import paddle_tpu as paddle
     from paddle_tpu.inference import ServingPredictor
     from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
 
-    max_len = prompt + steps + 8
+    max_len = prompt + gen_len + 32
     paddle.seed(0)
     cfg = GPTConfig(vocab_size=vocab, hidden_size=hidden, num_layers=layers,
                     num_heads=heads, max_seq_len=max_len)
@@ -64,32 +78,65 @@ def bench_decode(*, hidden, layers, heads, vocab, batch, prompt,
     model.eval()
     sp = ServingPredictor(
         model, max_batch=batch, page_size=page_size, max_seq_len=max_len,
-        use_kernel=use_kernel,
+        use_kernel=use_kernel, unified=unified, chunk=chunk,
         dtype=jnp.bfloat16 if (on_tpu and dtype is None) else dtype)
     rng = np.random.RandomState(0)
-    for _ in range(batch):
-        sp.add_request(rng.randint(0, vocab, (prompt,)),
-                       max_new_tokens=steps + 16)
-    # warmup: admission + prefill compile + decode compile
-    for _ in range(3):
+    pool = [rng.randint(0, vocab, (prompt,)) for _ in range(max(2, batch // 2))]
+    arrivals = [0]
+    reqs = []
+
+    def top_up():
+        # keep the lanes full: every finished request is replaced by a
+        # fresh one on the NEXT pool prompt (round-robin -> prefix reuse)
+        live = sum(1 for r in reqs if r.state != "finished")
+        while live < batch:
+            reqs.append(sp.add_request(pool[arrivals[0] % len(pool)],
+                                       max_new_tokens=gen_len))
+            arrivals[0] += 1
+            live += 1
+
+    # warmup: fill the lanes and run until every first-wave request has
+    # produced (compiles every shape: admission buckets, decode/unified)
+    top_up()
+    first_wave = list(reqs)
+    while any(not r.output_ids for r in first_wave):
         sp.step()
-    traces_before = sp.decode_trace_count
+
+    # timed churn phase: one host sync per step (each produced token
+    # crosses to the host — that IS serving's latency path)
+    decode_before = sp.decode_trace_count
+    timed_from = len(reqs)
+    produced_total = 0
     lat = []
     t0 = time.perf_counter()
     for _ in range(steps):
+        top_up()
         t1 = time.perf_counter()
         produced = sp.step()
-        # per-step host sync: each produced token crosses to the host —
-        # that IS serving's latency path (sp.step already converts).
-        # explicit raise (not assert): python -O must not let a drained
-        # batch silently inflate the tokens/s line
-        if not produced:
-            raise RuntimeError("decode batch drained mid-bench")
+        produced_total += len(produced)
         lat.append((time.perf_counter() - t1) * 1e3)
     elapsed = time.perf_counter() - t0
-    retraces = sp.decode_trace_count - traces_before + 1
-    tps = batch * steps / elapsed
-    return tps, _percentile(lat, 50), _percentile(lat, 99), retraces
+    # explicit raise (not assert): python -O must not let a dead scheduler
+    # emit a zero-looking-valid line
+    if not produced_total:
+        raise RuntimeError("no tokens produced over the timed phase")
+    # TTFT over requests ADMITTED during the timed churn (warm
+    # executables, steady state); falls back to the warmup wave when the
+    # window was too short for any churn admission to produce
+    ttfts = [r.ttft * 1e3 for r in reqs[timed_from:] if r.ttft is not None]
+    if not ttfts:
+        ttfts = [r.ttft * 1e3 for r in first_wave]
+    return dict(
+        value=round(produced_total / elapsed, 1),
+        unit="tokens/s",
+        p50_ms=round(_percentile(lat, 50), 2),
+        p99_ms=round(_percentile(lat, 99), 2),
+        ttft_p50_ms=round(_percentile(ttfts, 50), 2),
+        ttft_p99_ms=round(_percentile(ttfts, 99), 2),
+        prefix_hit_rate=round(sp.prefix_hit_rate, 3),
+        decode_retraces=sp.decode_trace_count - decode_before + 1,
+        prefill_retraces=sp.prefill_trace_count,
+    )
 
 
 def main():
@@ -103,7 +150,7 @@ def main():
         return int(v) if v is not None else default
 
     if smoke:
-        # CPU-runnable CI leg: interpret-mode kernel, tiny shapes
+        # CPU-runnable CI leg: tiny shapes, gather reference attention
         import jax as _j
 
         _j.config.update("jax_platforms", "cpu")
@@ -117,23 +164,25 @@ def main():
     if smoke:
         shape = dict(hidden=64, layers=2, heads=4, vocab=128,
                      batch=arg("batch", 4), prompt=arg("prompt", 16),
-                     steps=arg("steps", 8), page_size=arg("page-size", 8))
+                     steps=arg("steps", 12), gen_len=arg("gen-len", 4),
+                     page_size=arg("page-size", 8), chunk=arg("chunk", 8))
     else:
         # flagship: gpt3-125m geometry at the acceptance shape (bs >= 8,
-        # context >= 1024 by the end of the decode phase)
+        # 1024-token contexts churning through the lanes)
         shape = dict(hidden=768, layers=12, heads=12, vocab=50304,
                      batch=arg("batch", 8), prompt=arg("prompt", 1024),
-                     steps=arg("steps", 64), page_size=arg("page-size", 0)
-                     or None)
+                     steps=arg("steps", 64), gen_len=arg("gen-len", 32),
+                     page_size=arg("page-size", 0) or None,
+                     chunk=arg("chunk", 0) or None)
     label = (f"smoke bs{shape['batch']}" if smoke
              else f"gpt3-125m bs{shape['batch']}")
     chip = (jax.devices()[0].device_kind if on_tpu else "cpu")
     runnable = on_tpu or smoke
+    use_kernel = None if on_tpu else False
 
-    legs = [("gather-ref", False), ("paged-kernel", True if smoke or not on_tpu
-                                    else None)]
+    legs = [("legacy-two-jit", False), ("unified-step", True)]
     results = {}
-    for name, use_kernel in legs:
+    for name, unified in legs:
         metric = (f"{FLAGSHIP_METRIC} ({label} prompt{shape['prompt']}"
                   f"+{shape['steps']} steps, {chip}) [{name}]")
         if not runnable:
@@ -142,30 +191,28 @@ def main():
                 "--smoke for the interpret leg", metric=metric))
             continue
         try:
-            tps, p50, p99, retraces = bench_decode(
-                on_tpu=on_tpu, use_kernel=use_kernel, **shape)
+            out = bench_serving(on_tpu=on_tpu, unified=unified,
+                                use_kernel=use_kernel, **shape)
         except Exception as e:  # one failed leg must not kill the other
             print(_error_line(f"{type(e).__name__}: {e}"[:200],
                               metric=metric))
             continue
-        results[name] = dict(metric=metric, value=round(tps, 1),
-                             unit="tokens/s", p50_ms=round(p50, 2),
-                             p99_ms=round(p99, 2),
-                             decode_retraces=retraces)
+        results[name] = dict(metric=metric, **out)
 
-    # flagship line LAST: the paged-kernel leg, vs_baseline = speedup over
-    # the gather reference (ratio > 1 = the Pallas kernel wins the A/B)
+    # flagship line LAST: the unified step, vs_baseline = speedup over the
+    # legacy two-jit path (ratio > 1 = the unified serving step wins)
     from paddle_tpu.analysis.bench_schema import checked_line
 
-    if "gather-ref" in results:
-        ref = results["gather-ref"]
+    if "legacy-two-jit" in results:
+        ref = results["legacy-two-jit"]
         ref["vs_baseline"] = 1.0
         print(checked_line(ref))
-    if "paged-kernel" in results:
-        out = results["paged-kernel"]
-        if "gather-ref" in results and results["gather-ref"]["value"]:
+    if "unified-step" in results:
+        out = results["unified-step"]
+        if ("legacy-two-jit" in results
+                and results["legacy-two-jit"]["value"]):
             out["vs_baseline"] = round(
-                out["value"] / results["gather-ref"]["value"], 3)
+                out["value"] / results["legacy-two-jit"]["value"], 3)
         else:
             out["vs_baseline"] = 0.0
         print(checked_line(out))

@@ -33,7 +33,9 @@ _REQUIRED = {
     "unit": lambda v: isinstance(v, str) and v.strip(),
 }
 _OPTIONAL_NUMERIC = ("vs_baseline", "p50_ms", "p99_ms", "anchor_tflops",
-                     "anchor_frac_peak")
+                     "anchor_frac_peak", "ttft_p50_ms", "ttft_p99_ms",
+                     "prefix_hit_rate", "decode_retraces",
+                     "prefill_retraces")
 
 
 def validate_line(obj) -> list[str]:

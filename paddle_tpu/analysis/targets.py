@@ -8,7 +8,10 @@ builds of exactly the programs that carry the repo's numbers:
 - ``bert-eager``  BertModel forward, same trace;
 - ``gpt-spmd``    the hybrid-parallel train step (jaxpr walk + donation);
 - ``serving``     build_prefill / build_decode_step jits (jaxpr walk +
-                  donation of the KV page pools).
+                  donation of the KV page pools);
+- ``serving-unified``  the round-9 unified ragged prefill+decode step jit
+                  (jaxpr walk + donation audit of the page pools —
+                  the ONE program the flagship serving path replays).
 
 Configs are tiny (seconds on CPU; the analysis is abstract — eval_shape /
 make_jaxpr, no FLOPs run) but structurally identical to the flagship
@@ -118,11 +121,63 @@ def analyze_serving() -> list[Finding]:
     return findings
 
 
+def analyze_serving_unified() -> list[Finding]:
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from ..inference.kv_cache import KVCacheManager
+    from ..models.gpt import (GPTConfig, GPTForCausalLM, build_unified_step,
+                              serving_params)
+
+    cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                    num_heads=2, max_seq_len=32)
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg)
+    params = serving_params(model)
+    page_size, chunk, b = 8, 4, 2
+    budget = b + chunk
+    mgr = KVCacheManager(cfg.num_layers, cfg.num_heads, cfg.head_dim,
+                         num_pages=2 * b * (cfg.max_seq_len // page_size),
+                         max_batch=b, max_seq_len=cfg.max_seq_len,
+                         page_size=page_size, dtype=jnp.float32,
+                         enable_prefix_cache=True)
+    rng = np.random.RandomState(0)
+    for _ in range(b):
+        mgr.admit_prefix([int(x) for x in rng.randint(0, 128, (8,))])
+    # a mixed step: slot 0 decodes 1 token, slot 1 feeds a prefill chunk
+    tok_ids = jnp.asarray(rng.randint(0, 128, (budget,)), jnp.int32)
+    tok_slot = jnp.asarray([0] + [1] * chunk + [-1] * (budget - 1 - chunk),
+                           jnp.int32)
+    tok_pos = jnp.asarray([0] + list(range(chunk))
+                          + [0] * (budget - 1 - chunk), jnp.int32)
+    q_lens = jnp.asarray([1, chunk], jnp.int32)
+    kv_lens = mgr.seq_lens_device() * 0
+    last_idx = jnp.asarray([0, chunk], jnp.int32)
+    no_cow = jnp.full((b,), mgr.num_pages, jnp.int32)
+    keys = jnp.zeros((b, 2), jnp.uint32)
+    temp = jnp.asarray([0.0, 0.8], jnp.float32)
+    top_k = jnp.asarray([0, 40], jnp.int32)
+    top_p = jnp.asarray([1.0, 0.9], jnp.float32)
+
+    step = build_unified_step(cfg, page_size, chunk)
+    args = (params, tok_ids, tok_slot, tok_pos, q_lens, kv_lens, last_idx,
+            mgr.k_pages, mgr.v_pages, mgr.page_table_device(), no_cow,
+            no_cow, keys, temp, top_k, top_p)
+    findings = analyze_jaxpr(trace_callable(step, *args),
+                             "serving-unified-step")
+    # the builder donates the K/V page pools; both must alias outputs
+    findings += check_donation(step, args, (7, 8), "serving-unified-step")
+    return findings
+
+
 TARGETS = {
     "gpt-eager": analyze_gpt_eager,
     "bert-eager": analyze_bert_eager,
     "gpt-spmd": analyze_gpt_spmd,
     "serving": analyze_serving,
+    "serving-unified": analyze_serving_unified,
 }
 
 

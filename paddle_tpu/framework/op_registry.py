@@ -125,8 +125,9 @@ _bulk([
     "gather_tree", "class_center_sample", "top_p_sampling", "weight_quantize",
     "matrix_nms", "generate_proposals", "distribute_fpn_proposals",
     # decode-only serving attention (no VJP: inference path, the Pallas
-    # kernel defines no backward — round-7 paged serving subsystem)
-    "paged_attention",
+    # kernel defines no backward — round-7 paged serving subsystem; the
+    # round-9 ragged sibling serves mixed prefill chunks + decode tokens)
+    "paged_attention", "ragged_paged_attention",
 ], non_diff=True)
 
 # -- passthrough ops: run in the input dtype, differentiable ----------------

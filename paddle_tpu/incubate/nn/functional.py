@@ -277,6 +277,28 @@ def paged_attention(q, k_pages, v_pages, page_table, seq_lens, scale=None,
                     seq_lens)
 
 
+def ragged_paged_attention(q, k_pages, v_pages, page_table, kv_lens, q_lens,
+                           scale=None, use_kernel=None):
+    """Ragged prefill+decode attention over the block-paged KV cache (the
+    round-9 unified serving step's kernel; Ragged Paged Attention, arxiv
+    2604.15464). Each slot contributes ``q_lens`` (0..chunk) query tokens
+    — ``q`` [b, chunk, num_q_heads, head_dim] right-padded — causal within
+    its chunk, attending its whole paged context of ``kv_lens`` tokens
+    (chunk included; its K/V must already be written). Rows past
+    ``q_lens`` are unspecified. Pallas kernel on TPU (``use_kernel=True``
+    forces interpret mode off-TPU), jnp gather reference elsewhere.
+    Decode-only: not differentiable."""
+    from ...ops.pallas import paged_attention as _pa
+
+    def fn(q_, kp, vp, pt, kl, ql):
+        return _pa.ragged_paged_attention(q_, kp, vp, pt, kl, ql,
+                                          scale=scale,
+                                          use_kernel=use_kernel)
+
+    return apply_op("ragged_paged_attention", fn, q, k_pages, v_pages,
+                    page_table, kv_lens, q_lens)
+
+
 def swiglu(x, y=None):
     """SwiGLU activation (reference: incubate fused swiglu): if y is None, x
     splits in half on the last dim."""
@@ -299,6 +321,7 @@ __all__ = [
     "fused_multi_head_attention", "masked_multihead_attention",
     "fused_multi_transformer", "fused_ec_moe", "fused_gate_attention",
     "block_multihead_attention", "paged_attention",
+    "ragged_paged_attention",
 ]
 
 

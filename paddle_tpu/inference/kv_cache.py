@@ -24,10 +24,26 @@ Page-table convention (shared with ops/pallas/paged_attention):
 when unallocated; ``seq_lens[slot]`` counts tokens already written (0 =
 empty slot). Writes to unallocated/out-of-range positions are routed out of
 bounds and dropped (``mode="drop"``) rather than corrupting page 0.
+
+Round 9 adds PREFIX CACHING (vLLM automatic-prefix-caching shape, page
+granularity): prompt pages are registered under a content CHAIN HASH
+(page i's key folds page i-1's key, so a key names the whole prefix up to
+and including that page) once their prefill lands. A later admission walks
+its prompt's chain and attaches every matching page read-only
+(refcount += 1) instead of re-prefilling it; the final page may match a
+registered PARTIAL fill (the key records the token count). Refcounted
+pages are PINNED (never reallocated); a registered page whose refcount
+drops to 0 parks on an LRU and keeps serving hits until the free list runs
+dry, at which point the LRU tail is evicted (unregistered) and reused.
+Divergence is handled copy-on-write: a slot about to write into a page
+with refcount >= 2 gets a fresh copy via :meth:`prepare_write` — the
+device-side page copy is traced into the unified step (cow_src/cow_dst
+lanes), so shared immutable pages are never mutated.
 """
 from __future__ import annotations
 
 import math
+from collections import OrderedDict
 
 import numpy as np
 
@@ -78,6 +94,41 @@ def paged_write_prefill(pages, seq, pages_for_slot, length, page_size):
     return pages.at[pg, i % page_size].set(seq, mode="drop")
 
 
+def paged_write_packed(pages, toks, page_table, tok_slot, tok_pos,
+                       page_size):
+    """Write a PACKED token stream into the page pool in one scatter (the
+    unified-step write: the step's dense dims run over the flat token
+    budget, each token carrying its owning slot + absolute position).
+
+    pages: [num_pages, page_size, kv_heads, head_dim]; toks: [budget,
+    kv_heads, head_dim]; page_table: [batch, pages_per_slot] int32;
+    tok_slot: [budget] int32 owning slot (< 0 = padding, dropped);
+    tok_pos: [budget] int32 absolute write position. Returns the pool.
+    """
+    num_pages = pages.shape[0]
+    b = page_table.shape[0]
+    slot_c = jnp.clip(tok_slot, 0, b - 1)
+    pos = jnp.maximum(tok_pos, 0)
+    pg = page_table[slot_c,
+                    jnp.clip(pos // page_size, 0, page_table.shape[1] - 1)]
+    valid = (tok_slot >= 0) & (tok_pos >= 0) & (pg >= 0)
+    pg = jnp.where(valid, pg, num_pages)             # invalid -> dropped
+    return pages.at[pg, pos % page_size].set(toks, mode="drop")
+
+
+def paged_copy_pages(pages, src, dst):
+    """Copy-on-write page copies, traced into the unified step.
+
+    pages: [num_layers, num_pages, page_size, kv_heads, head_dim] (the
+    stacked pool as the jits see it); src/dst: [batch] int32 pool indices,
+    ``dst == num_pages`` (the host's no-op sentinel) drops the copy. Each
+    active lane duplicates one page across every layer.
+    """
+    num_pages = pages.shape[1]
+    src_c = jnp.clip(src, 0, num_pages - 1)
+    return pages.at[:, dst].set(pages[:, src_c], mode="drop")
+
+
 # ---------------------------------------------------------------------------
 # host-side manager
 # ---------------------------------------------------------------------------
@@ -95,7 +146,7 @@ class KVCacheManager:
 
     def __init__(self, num_layers, num_kv_heads, head_dim, *, num_pages,
                  max_batch, max_seq_len, page_size=None, num_q_heads=None,
-                 dtype=jnp.float32):
+                 dtype=jnp.float32, enable_prefix_cache=False):
         from ..ops.pallas.paged_attention import preferred_page_size
 
         if page_size is None:
@@ -119,12 +170,28 @@ class KVCacheManager:
         self._seq_lens = np.zeros((self.max_batch,), np.int32)
         self._free_pages = list(range(self.num_pages - 1, -1, -1))  # pop()
         self._free_slots = list(range(self.max_batch - 1, -1, -1))
+        # prefix cache state: per-page slot refcounts, the content-key
+        # registry, and the LRU of zero-ref registered pages (evictable,
+        # still serving hits until reused)
+        self.enable_prefix_cache = bool(enable_prefix_cache)
+        self._refcount = np.zeros((self.num_pages,), np.int32)
+        self._page_key: dict[int, bytes] = {}    # page -> chain key
+        self._prefix_pages: dict[bytes, int] = {}  # chain key -> page
+        self._lru: OrderedDict[int, None] = OrderedDict()
+        self.prefix_hit_tokens = 0
+        self.prefix_query_tokens = 0
 
     # -- capacity ----------------------------------------------------------
 
     @property
     def free_page_count(self) -> int:
         return len(self._free_pages)
+
+    @property
+    def available_page_count(self) -> int:
+        """Pages an allocation may claim: truly free + evictable (zero-ref
+        registered prefix pages on the LRU)."""
+        return len(self._free_pages) + len(self._lru)
 
     @property
     def free_slot_count(self) -> int:
@@ -136,7 +203,30 @@ class KVCacheManager:
     def can_admit(self, prompt_len: int) -> bool:
         return (bool(self._free_slots)
                 and prompt_len <= self.max_seq_len
-                and self.pages_needed(prompt_len) <= len(self._free_pages))
+                and self.pages_needed(prompt_len)
+                <= self.available_page_count)
+
+    def _alloc_page(self) -> int:
+        """Claim one page: the free list first, then evict the LRU tail of
+        the zero-ref registered pages (unregistering it)."""
+        if self._free_pages:
+            return self._free_pages.pop()
+        if self._lru:
+            page, _ = self._lru.popitem(last=False)   # oldest
+            del self._prefix_pages[self._page_key.pop(page)]
+            return page
+        raise RuntimeError("cache exhausted: no free or evictable pages")
+
+    def _release_page(self, page: int) -> None:
+        """Drop one slot's reference; a zero-ref page parks on the LRU if
+        registered (it keeps serving prefix hits), else frees."""
+        self._refcount[page] -= 1
+        assert self._refcount[page] >= 0, f"refcount underflow on {page}"
+        if self._refcount[page] == 0:
+            if page in self._page_key:
+                self._lru[page] = None        # MRU end
+            else:
+                self._free_pages.append(page)
 
     # -- admission / growth / eviction ------------------------------------
 
@@ -151,13 +241,15 @@ class KVCacheManager:
         if not self._free_slots:
             raise RuntimeError("no free decode slots")
         need = self.pages_needed(prompt_len)
-        if need > len(self._free_pages):
+        if need > self.available_page_count:
             raise RuntimeError(
                 f"cache exhausted: need {need} pages, "
-                f"{len(self._free_pages)} free")
+                f"{self.available_page_count} free")
         slot = self._free_slots.pop()
         for i in range(need):
-            self._page_table[slot, i] = self._free_pages.pop()
+            page = self._alloc_page()
+            self._page_table[slot, i] = page
+            self._refcount[page] = 1
         self._seq_lens[slot] = prompt_len
         return slot
 
@@ -171,24 +263,185 @@ class KVCacheManager:
         need = self.pages_needed(new_len)
         if need <= have:
             return True
-        if need - have > len(self._free_pages):
+        if need - have > self.available_page_count:
             return False
         for i in range(have, need):
-            self._page_table[slot, i] = self._free_pages.pop()
+            page = self._alloc_page()
+            self._page_table[slot, i] = page
+            self._refcount[page] = 1
         return True
 
     def advance(self, slot: int, n: int = 1) -> None:
         self._seq_lens[slot] += n
 
     def free(self, slot: int) -> None:
-        """Evict: return the slot's pages to the pool, park the slot."""
+        """Evict: drop the slot's page references (shared pages survive in
+        other slots / the prefix LRU), park the slot."""
         for i in range(self.pages_per_slot):
             pg = int(self._page_table[slot, i])
             if pg >= 0:
-                self._free_pages.append(pg)
+                self._release_page(pg)
             self._page_table[slot, i] = -1
         self._seq_lens[slot] = 0
         self._free_slots.append(slot)
+
+    # -- prefix cache ------------------------------------------------------
+
+    def _chain_key(self, prev: bytes, tokens) -> bytes:
+        """Content chain key: page i's key folds page i-1's, so one key
+        names the whole prefix up to and including this page's tokens
+        (count included — a 4-token partial and an 8-token full fill hash
+        differently)."""
+        import hashlib
+
+        h = hashlib.sha1(prev)
+        h.update(np.asarray(tokens, np.int64).tobytes())
+        return h.digest()
+
+    def _match_prefix(self, tokens):
+        """Longest registered prefix of ``tokens`` at page granularity
+        (the final page may match a partial fill). The returned
+        ``matched_len`` is capped at ``len(tokens)-1`` so at least one
+        token is left to feed (the cache stores K/V, not logits) — on a
+        full-prompt hit the re-fed token overwrites its own identical K/V
+        (deterministic in token+position), CoW-guarded when the page is
+        shared. Returns (pages, matched_len)."""
+        ps = self.page_size
+        n = len(tokens)
+        pages: list[int] = []
+        matched = 0
+        h = b""
+        while matched + ps <= n:
+            nxt = self._chain_key(h, tokens[matched:matched + ps])
+            page = self._prefix_pages.get(nxt)
+            if page is None:
+                break
+            pages.append(page)
+            matched += ps
+            h = nxt
+        # partial tail: longest registered partial fill of the next page
+        for t in range(min(ps - 1, n - matched), 0, -1):
+            nxt = self._chain_key(h, tokens[matched:matched + t])
+            page = self._prefix_pages.get(nxt)
+            if page is not None:
+                pages.append(page)
+                matched += t
+                break
+        return pages, min(matched, n - 1)
+
+    def admit_prefix(self, tokens, *, headroom=0, soft=False):
+        """Admit a sequence whose context is ``tokens``: attach every
+        registered prefix page read-only (refcount += 1), allocate fresh
+        pages for the rest of the context, set the slot's written length to
+        the matched token count. Returns ``(slot, cached_len)`` — the
+        scheduler feeds ``tokens[cached_len:]`` through prefill chunks.
+
+        ``headroom`` demands that many extra allocatable pages beyond the
+        admission's own need (the scheduler's growth watermark). On
+        pressure (or no free slot), ``soft=True`` returns None with
+        NOTHING mutated instead of raising — the one owner of the
+        can-this-fit accounting, so the check can never diverge from the
+        allocation it guards.
+        """
+        n = len(tokens)
+        if n > self.max_seq_len:
+            raise RuntimeError(
+                f"prompt of {n} tokens exceeds max_seq_len "
+                f"{self.max_seq_len}")
+        if not self._free_slots:
+            if soft:
+                return None
+            raise RuntimeError("no free decode slots")
+        shared, matched = (self._match_prefix(tokens)
+                           if self.enable_prefix_cache else ([], 0))
+        need_total = self.pages_needed(n)
+        need_fresh = need_total - len(shared)
+        # matched pages sitting on the LRU are about to be re-pinned by
+        # THIS admission: they cannot also serve the fresh allocations
+        lru_matched = sum(1 for p in shared if p in self._lru)
+        available = self.available_page_count - lru_matched
+        if need_fresh + headroom > available:
+            if soft:
+                return None
+            raise RuntimeError(
+                f"cache exhausted: need {need_fresh} pages, "
+                f"{available} free")
+        self.prefix_query_tokens += n
+        self.prefix_hit_tokens += matched
+        slot = self._free_slots.pop()
+        for i, page in enumerate(shared):
+            self._page_table[slot, i] = page
+            if self._refcount[page] == 0:
+                self._lru.pop(page, None)     # re-pinned off the LRU
+            self._refcount[page] += 1
+        for i in range(len(shared), need_total):
+            page = self._alloc_page()
+            self._page_table[slot, i] = page
+            self._refcount[page] = 1
+        self._seq_lens[slot] = matched
+        return slot, matched
+
+    def register_prefix(self, slot: int, tokens, include_tail=True) -> None:
+        """Register ``slot``'s pages holding ``tokens`` (a prefilled
+        prompt, or its prefilled-so-far prefix) in the prefix registry:
+        every full page, plus the partial tail when ``include_tail`` (only
+        pass True once the WHOLE prompt has landed — a mid-prompt partial
+        key would pin the page's one key slot on a transient fill). Pages
+        already registered (or whose key another page already serves) are
+        skipped — one page, one key — so progressive per-step calls are
+        idempotent."""
+        if not self.enable_prefix_cache:
+            return
+        ps = self.page_size
+        h = b""
+        pos = 0
+        i = 0
+        while pos < len(tokens):
+            t = min(ps, len(tokens) - pos)
+            if t < ps and not include_tail:
+                break
+            h = self._chain_key(h, tokens[pos:pos + t])
+            page = int(self._page_table[slot, i])
+            if page < 0:
+                break
+            if page not in self._page_key and h not in self._prefix_pages:
+                self._page_key[page] = h
+                self._prefix_pages[h] = page
+            pos += t
+            i += 1
+
+    @property
+    def prefix_hit_rate(self) -> float:
+        """Fraction of admitted context tokens served from the prefix
+        cache (0.0 when nothing was admitted)."""
+        if not self.prefix_query_tokens:
+            return 0.0
+        return self.prefix_hit_tokens / self.prefix_query_tokens
+
+    # -- copy-on-write -----------------------------------------------------
+
+    def needs_cow(self, slot: int, pos: int) -> bool:
+        """True when writing position ``pos`` would touch a page some
+        OTHER reference also holds (refcount >= 2) — the write must go to
+        a private copy."""
+        page = int(self._page_table[slot, pos // self.page_size])
+        return page >= 0 and int(self._refcount[page]) >= 2
+
+    def prepare_write(self, slot: int, pos: int):
+        """Make ``slot``'s page at ``pos`` privately writable. Returns
+        ``None`` when it already is, else ``(src, dst)`` pool indices for
+        the device-side copy (:func:`paged_copy_pages`) the caller must
+        thread through its next step. The shared source page keeps its
+        registration and remaining references; the copy is owned."""
+        i = pos // self.page_size
+        page = int(self._page_table[slot, i])
+        if page < 0 or int(self._refcount[page]) < 2:
+            return None
+        dst = self._alloc_page()
+        self._refcount[dst] = 1
+        self._page_table[slot, i] = dst
+        self._refcount[page] -= 1   # >= 1 left: stays pinned, registered
+        return page, dst
 
     # -- device views ------------------------------------------------------
 

@@ -1,33 +1,44 @@
 """Continuous-batching autoregressive serving over the paged KV cache.
 
-The round-7 serving front end: the classic continuous-batching loop
-(Orca/vLLM; reference surface: the fused-transformer serving family that
-``block_multihead_attention`` feeds) on top of
+Round 9: the serving front end schedules a UNIFIED ragged step — ONE
+fixed-shape jit (``models/gpt.py build_unified_step``) serves decode tokens
+and chunked-prefill tokens in the same program, driven by a per-step token
+budget. The round-7 two-jit path (bucketed batch-1 prefill + fixed-shape
+decode) is kept behind ``unified=False`` as the A/B baseline and the
+token-for-token equivalence oracle until a later PR deletes it.
 
-- :class:`~paddle_tpu.inference.kv_cache.KVCacheManager` — page pool,
-  admission, eviction;
-- ``models/gpt.py`` ``build_prefill`` / ``build_decode_step`` — one jit for
-  each prompt-length bucket, ONE fixed-shape jit for the decode step.
+Scheduling (the Ragged-Paged-Attention / chunked-prefill shape, PAPERS.md):
 
-Request lifecycle: WAITING (queued) -> RUNNING (owns a decode slot + pages)
--> FINISHED (eos / max_new_tokens). Between decode steps the scheduler
-admits waiting requests into free slots (prefilling their prompts straight
-into their pages) and frees finished ones — sequences join and leave the
-batch WITHOUT restarting it, so short requests never wait for long ones and
-the decode jit's batch lanes (``max_batch``) stay the fixed compile shape
-with empty lanes masked by ``seq_len == 0``.
+- every running slot with exactly one context token left to feed is a
+  DECODE lane — those pack first, one token each, so admission never
+  head-of-line-blocks the decode batch behind a full prompt forward;
+- the remaining token budget fills with PREFILL CHUNKS (FIFO by request
+  age, up to ``chunk`` tokens per slot per step) from admitting or
+  preemption-replaying sequences;
+- a chunk that reaches the end of its context yields that slot's next
+  token (greedy argmax bit-identical to round 7, or the fused seeded
+  temperature/top-k/top-p epilogue).
 
-Capacity pressure: when a running sequence cannot grow (page pool
-exhausted) the YOUNGEST running request is preempted back to the waiting
-queue — its pages are freed and its prompt + generated prefix re-prefills
-on the next admission (vLLM's recompute-mode preemption, the policy that
-needs no swap space).
+Prefix caching: admission matches the prompt against the page-granular
+content-hash registry (``KVCacheManager.admit_prefix``) and skips the
+prefill compute for every hit page; fully-prefilled prompts register their
+pages for later requests. Divergent writes into shared pages ride the
+step's copy-on-write lanes.
 
-Knobs: ``max_batch`` (decode lanes), ``num_pages``/``page_size`` (pool
-geometry = max cached tokens), ``max_seq_len`` (page-table width).
+Request lifecycle: WAITING (queued) -> RUNNING (owns a slot + pages;
+prefilling until its context is fully fed, then decoding) -> FINISHED
+(eos / max_new_tokens / length ceiling). Capacity pressure preempts the
+YOUNGEST running request back to the queue (recompute-mode, vLLM policy);
+its replay re-hits its own registered prefix pages.
+
+Knobs: ``max_batch`` (lanes), ``num_pages``/``page_size`` (pool geometry),
+``max_seq_len`` (page-table width), ``chunk`` (per-slot prefill chunk,
+autotuned default), ``token_budget`` (tokens per step, default
+``max_batch + chunk``), ``prefix_cache`` (on by default when unified).
 """
 from __future__ import annotations
 
+import time
 from collections import deque
 
 import numpy as np
@@ -40,11 +51,12 @@ WAITING, RUNNING, FINISHED = "waiting", "running", "finished"
 
 
 class Request:
-    """One generation request; ``output_ids`` fills as decode steps land."""
+    """One generation request; ``output_ids`` fills as steps land."""
 
     _next_id = [0]
 
-    def __init__(self, prompt_ids, max_new_tokens=32, eos_token_id=None):
+    def __init__(self, prompt_ids, max_new_tokens=32, eos_token_id=None,
+                 temperature=0.0, top_k=0, top_p=1.0, seed=None):
         self.req_id = Request._next_id[0]
         Request._next_id[0] += 1
         self.prompt_ids = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
@@ -52,10 +64,22 @@ class Request:
             raise ValueError("empty prompt")
         self.max_new_tokens = int(max_new_tokens)
         self.eos_token_id = eos_token_id
+        # sampling params (temperature == 0 -> greedy argmax, bit-identical
+        # to round 7); seed defaults to the request id so replays after
+        # preemption re-sample the SAME stream (keyed by tokens produced)
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        self.seed = self.req_id if seed is None else int(seed)
         self.output_ids: list[int] = []
         self.state = WAITING
         self.preempt_count = 0
         self.truncated = False  # stopped by the max_seq_len ceiling
+        # serving metrics: time-to-first-token + prefix-cache hit size
+        self.submit_time = time.perf_counter()
+        self.first_token_time: float | None = None
+        self.cached_prefix_len = 0   # tokens served from the prefix cache
+        self._registered = False     # prompt pages in the prefix registry
 
     @property
     def done(self) -> bool:
@@ -66,26 +90,36 @@ class Request:
         return (self.eos_token_id is not None and self.output_ids
                 and self.output_ids[-1] == self.eos_token_id)
 
+    @property
+    def ttft(self) -> float | None:
+        """Seconds from submission to the first generated token."""
+        if self.first_token_time is None:
+            return None
+        return self.first_token_time - self.submit_time
+
     def _context_ids(self) -> list[int]:
         """Prompt + generated-so-far — what a re-prefill after preemption
-        replays (all but the LAST token go through prefill; the last one is
-        the next decode step's input)."""
+        replays."""
         return self.prompt_ids + self.output_ids
 
 
 class ServingPredictor:
-    """Continuous-batching decode predictor for a GPT model.
+    """Continuous-batching predictor for a GPT model.
 
-    ``add_request`` enqueues; ``step`` runs one decode step for every
-    running sequence (admitting/evicting around it); ``generate`` is the
-    batch convenience that drives ``step`` until a set of prompts finishes.
+    ``add_request`` enqueues; ``step`` runs one scheduler round (admit /
+    grow / preempt around ONE unified-step launch); ``generate`` drives
+    ``step`` until a set of prompts finishes. ``unified=False`` falls back
+    to the round-7 two-jit path (per-bucket prefill at admission + decode
+    step) — the A/B baseline.
     """
 
     def __init__(self, model, *, max_batch=8, num_pages=None, page_size=None,
                  max_seq_len=None, use_kernel=None, prefill_bucket=16,
-                 dtype=None):
+                 dtype=None, unified=True, chunk=None, token_budget=None,
+                 prefix_cache=None):
         from ..models.gpt import (_serving_params_cached, build_decode_step,
-                                  build_prefill, serving_params)
+                                  build_prefill, build_unified_step,
+                                  serving_params)
 
         gpt = model.gpt if hasattr(model, "gpt") else model
         self.config = gpt.config
@@ -104,34 +138,57 @@ class ServingPredictor:
                                cfg.max_seq_len)
         self.max_batch = int(max_batch)
         self.prefill_bucket = int(prefill_bucket)
+        self.unified = bool(unified)
         kv_dtype = self.params["tok_emb"].dtype
+        from ..ops.pallas.paged_attention import (preferred_chunk_size,
+                                                  preferred_page_size)
+
         if num_pages is None:
             # default pool: every lane can reach max_seq_len
-            from ..ops.pallas.paged_attention import preferred_page_size
-
             ps = page_size or preferred_page_size(
                 cfg.num_heads, cfg.num_heads, cfg.head_dim, kv_dtype)
             num_pages = self.max_batch * pages_needed(self.max_seq_len, ps)
+        if prefix_cache is None:
+            prefix_cache = self.unified
         self.cache = KVCacheManager(
             cfg.num_layers, cfg.num_heads, cfg.head_dim,
             num_pages=num_pages, max_batch=self.max_batch,
             max_seq_len=self.max_seq_len, page_size=page_size,
-            num_q_heads=cfg.num_heads, dtype=kv_dtype)
-        self._decode = build_decode_step(cfg, self.cache.page_size,
-                                         use_kernel=use_kernel)
-        # one jitted prefill; jax.jit caches one executable per prompt
-        # bucket shape (prompts are padded to _bucket multiples)
-        self._prefill = build_prefill(cfg, self.cache.page_size)
+            num_q_heads=cfg.num_heads, dtype=kv_dtype,
+            enable_prefix_cache=prefix_cache)
+        self.chunk = int(chunk or preferred_chunk_size(
+            cfg.num_heads, cfg.num_heads, cfg.head_dim, kv_dtype))
+        self.token_budget = int(token_budget or
+                                (self.max_batch + self.chunk))
+        if self.unified:
+            self._unified = build_unified_step(
+                cfg, self.cache.page_size, self.chunk,
+                use_kernel=use_kernel)
+            self._prefill = self._decode = None
+        else:
+            self._unified = None
+            self._decode = build_decode_step(cfg, self.cache.page_size,
+                                             use_kernel=use_kernel)
+            # one jitted prefill; jax.jit caches one executable per prompt
+            # bucket shape (prompts are padded to _bucket multiples)
+            self._prefill = build_prefill(cfg, self.cache.page_size)
         self.waiting: deque[Request] = deque()
         self.running: dict[int, Request] = {}   # slot -> request
         self._next_token = np.zeros((self.max_batch,), np.int32)
+        self._no_cow = jnp.full((self.max_batch,), self.cache.num_pages,
+                                jnp.int32)
+        self._zero_keys = np.zeros((self.max_batch, 2), np.uint32)
+        self._base_keys: dict[int, np.ndarray] = {}   # req_id -> PRNGKey
         self.steps = 0
 
     # -- queue API ---------------------------------------------------------
 
-    def add_request(self, prompt_ids, max_new_tokens=32,
-                    eos_token_id=None) -> Request:
-        req = Request(prompt_ids, max_new_tokens, eos_token_id)
+    def add_request(self, prompt_ids, max_new_tokens=32, eos_token_id=None,
+                    temperature=0.0, top_k=0, top_p=1.0,
+                    seed=None) -> Request:
+        req = Request(prompt_ids, max_new_tokens, eos_token_id,
+                      temperature=temperature, top_k=top_k, top_p=top_p,
+                      seed=seed)
         if len(req.prompt_ids) > self.max_seq_len:
             raise ValueError(
                 f"prompt of {len(req.prompt_ids)} tokens exceeds "
@@ -141,17 +198,290 @@ class ServingPredictor:
 
     @property
     def decode_trace_count(self) -> int:
-        """Times the decode step has been (re)traced — the no-retrace gate
-        asserts this stays constant after warmup."""
-        return self._decode.trace_count[0]
+        """Times the serving step has been (re)traced — the no-retrace
+        gate asserts this stays constant after warmup. Unified mode counts
+        the ONE unified step; legacy counts the decode jit."""
+        fn = self._unified if self.unified else self._decode
+        return fn.trace_count[0]
 
-    # -- internals ---------------------------------------------------------
+    @property
+    def prefill_trace_count(self) -> int:
+        """Times a prefill program was traced. The unified step has NO
+        separate prefill jit (always 0); the legacy path compiles one
+        executable per prompt-length bucket — this makes that count
+        visible (bench_serve reports + gates it)."""
+        return 0 if self.unified else self._prefill.trace_count[0]
+
+    @property
+    def prefix_hit_rate(self) -> float:
+        return self.cache.prefix_hit_rate
+
+    # -- shared scheduler internals ----------------------------------------
+
+    def _preempt_youngest(self) -> bool:
+        """Free the youngest running request back to the waiting queue."""
+        if not self.running:
+            return False
+        slot = max(self.running,
+                   key=lambda s: self.running[s].req_id)
+        req = self.running.pop(slot)
+        self.cache.free(slot)
+        req.state = WAITING
+        req.preempt_count += 1
+        req._registered = False   # fresh pages on replay; re-register
+        self.waiting.appendleft(req)
+        return True
+
+    def _retire_finished(self) -> None:
+        for slot in [s for s, r in self.running.items() if r.done]:
+            req = self.running.pop(slot)
+            self.cache.free(slot)
+            req.state = FINISHED
+            self._base_keys.pop(req.req_id, None)
+
+    def _finish_waiting_unservable(self, req: Request) -> bool:
+        """Queue-head checks shared by both admission paths. Returns True
+        when the request was consumed (finished) off the queue."""
+        if req.done:
+            # finished while waiting (e.g. budget satisfied by its prefill
+            # token before a preemption parked it)
+            self.waiting.popleft()
+            req.state = FINISHED
+            return True
+        if len(req._context_ids()) > self.max_seq_len:
+            # preempted while sitting AT the length ceiling (its own
+            # truncation check never ran that round): finish it as
+            # truncated, same as the in-loop ceiling stop
+            self.waiting.popleft()
+            req.truncated = True
+            req.state = FINISHED
+            return True
+        return False
+
+    def _raise_never_admittable(self, req: Request, need: int) -> None:
+        raise RuntimeError(
+            f"request {req.req_id}: context of "
+            f"{len(req._context_ids())} tokens needs {need} "
+            f"pages but the pool only has "
+            f"{self.cache.num_pages} — raise num_pages or "
+            "page_size")
+
+    def has_work(self) -> bool:
+        return bool(self.waiting or self.running)
+
+    # -- unified path ------------------------------------------------------
+
+    def _admit_one_unified(self, req: Request) -> bool:
+        """Claim a slot + pages (prefix-cache hits attach shared pages);
+        the context feeds through chunks in subsequent steps."""
+        # vLLM-style watermark: with other sequences running, keep one
+        # free page of growth headroom — an exactly-fitting admission
+        # would be preempted (its prefill work discarded) by the same
+        # step's growth pass
+        headroom = 1 if self.running else 0
+        hit = self.cache.admit_prefix(req._context_ids(),
+                                      headroom=headroom, soft=True)
+        if hit is None:
+            return False
+        slot, cached = hit
+        req.cached_prefix_len = cached
+        req.state = RUNNING
+        self.running[slot] = req
+        return True
+
+    def _admit_waiting_unified(self) -> None:
+        while self.waiting and self.cache.free_slot_count:
+            req = self.waiting[0]
+            if self._finish_waiting_unservable(req):
+                continue
+            if not self._admit_one_unified(req):
+                # head-of-line blocking keeps FIFO order — but if nothing
+                # is running and the whole pool is free, this request can
+                # NEVER fit: fail with the real cause
+                if (not self.running and self.cache.available_page_count
+                        == self.cache.num_pages):
+                    self._raise_never_admittable(
+                        req, self.cache.pages_needed(
+                            len(req._context_ids())))
+                break
+            self.waiting.popleft()
+
+    def _req_key(self, req: Request) -> np.ndarray:
+        """Per-request base PRNG key; the per-token key folds in the count
+        of tokens produced, so a preemption replay re-samples the same
+        stream."""
+        hit = self._base_keys.get(req.req_id)
+        if hit is None:
+            import jax
+
+            hit = np.asarray(jax.random.PRNGKey(req.seed), np.uint32)
+            self._base_keys[req.req_id] = hit
+        return hit
+
+    def _step_unified(self) -> dict[int, int]:
+        self._retire_finished()
+        self._admit_waiting_unified()
+        if not self.running:
+            return {}
+        cache = self.cache
+        # -- token-budget packing: decode lanes first, then prefill chunks
+        budget = self.token_budget
+        sched: dict[int, int] = {}          # slot -> tokens this step
+        decode_slots = []
+        prefill_slots = []
+        for slot in sorted(self.running):
+            req = self.running[slot]
+            remaining = len(req._context_ids()) - cache.seq_len(slot)
+            (decode_slots if remaining == 1 else prefill_slots).append(slot)
+        for slot in decode_slots:
+            if budget <= 0:
+                break
+            sched[slot] = 1
+            budget -= 1
+        # prefill fills the remainder, FIFO by request age
+        for slot in sorted(prefill_slots,
+                           key=lambda s: self.running[s].req_id):
+            if budget <= 0:
+                break
+            req = self.running[slot]
+            remaining = len(req._context_ids()) - cache.seq_len(slot)
+            n = min(self.chunk, remaining, budget)
+            if n > 0:
+                sched[slot] = n
+                budget -= n
+        # -- capacity: ceiling stops, page growth, CoW page claims -------
+        cows: dict[int, tuple[int, int]] = {}
+        for slot in sorted(sched):
+            if slot not in self.running:
+                continue
+            req = self.running[slot]
+            written = cache.seq_len(slot)
+            if written + 1 > self.max_seq_len:
+                # length ceiling: stop NOW (truncation-stop) before any
+                # write past the page-table width
+                del sched[slot]
+                self.running.pop(slot)
+                req.truncated = True
+                cache.free(slot)
+                req.state = FINISHED
+                continue
+            n = min(sched[slot], self.max_seq_len - written)
+            sched[slot] = n
+            while True:
+                # prepare_write ALLOCATES the copy's destination page
+                # right here, so a later slot's CoW can never race this
+                # one for the last page — the claim IS the reservation
+                if cache.ensure_capacity(slot, written + n) and (
+                        not cache.needs_cow(slot, written)
+                        or cache.available_page_count >= 1):
+                    cow = cache.prepare_write(slot, written)
+                    if cow is not None:
+                        cows[slot] = cow
+                    break
+                # page pressure: shed the youngest request
+                victim_is_self = (max(self.running,
+                                      key=lambda s: self.running[s].req_id)
+                                  == slot)
+                if victim_is_self and len(self.running) == 1:
+                    raise RuntimeError(
+                        f"slot {slot}: cannot grow to {written + n} "
+                        "tokens — page pool too small for a single "
+                        "sequence")
+                self._preempt_youngest()
+                if slot not in self.running:  # preempted itself
+                    break
+            if slot not in self.running:
+                sched.pop(slot, None)
+        # a preemption may have freed slots mid-loop; drop stale schedule
+        sched = {s: n for s, n in sched.items() if s in self.running}
+        if not sched:
+            return {}
+        cow_src = np.full((self.max_batch,), self.cache.num_pages, np.int32)
+        cow_dst = cow_src.copy()
+        for slot, (src, dst) in cows.items():
+            if slot in sched:
+                cow_src[slot], cow_dst[slot] = src, dst
+        # -- build the fixed-shape packed step arrays --------------------
+        b, t = self.max_batch, self.token_budget
+        tok_ids = np.zeros((t,), np.int32)
+        tok_slot = np.full((t,), -1, np.int32)
+        tok_pos = np.zeros((t,), np.int32)
+        last_idx = np.full((b,), t, np.int32)   # idle-lane sentinel
+        q_lens = np.zeros((b,), np.int32)
+        temp = np.zeros((b,), np.float32)
+        top_k = np.zeros((b,), np.int32)
+        top_p = np.ones((b,), np.float32)
+        keys = self._zero_keys
+        completing = []
+        w = 0
+        for slot in sorted(sched):
+            n = sched[slot]
+            req = self.running[slot]
+            written = cache.seq_len(slot)
+            ctx = req._context_ids()
+            tok_ids[w:w + n] = ctx[written:written + n]
+            tok_slot[w:w + n] = slot
+            tok_pos[w:w + n] = np.arange(written, written + n)
+            last_idx[slot] = w + n - 1
+            q_lens[slot] = n
+            w += n
+            if written + n == len(ctx):
+                completing.append(slot)
+                temp[slot] = req.temperature
+                top_k[slot] = req.top_k
+                top_p[slot] = req.top_p
+                if req.temperature > 0:
+                    import jax
+
+                    if keys is self._zero_keys:
+                        keys = self._zero_keys.copy()
+                    keys[slot] = np.asarray(jax.random.fold_in(
+                        jnp.asarray(self._req_key(req)),
+                        len(req.output_ids)), np.uint32)
+        next_ids, _, kp, vp = self._unified(
+            self.params, jnp.asarray(tok_ids), jnp.asarray(tok_slot),
+            jnp.asarray(tok_pos), jnp.asarray(q_lens),
+            cache.seq_lens_device(), jnp.asarray(last_idx),
+            cache.k_pages, cache.v_pages, cache.page_table_device(),
+            jnp.asarray(cow_src), jnp.asarray(cow_dst), jnp.asarray(keys),
+            jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(top_p))
+        cache.update_pages(kp, vp)
+        self.steps += 1
+        for slot, n in sched.items():
+            cache.advance(slot, n)
+        produced: dict[int, int] = {}
+        out = np.asarray(next_ids) if completing else None
+        for slot in completing:
+            req = self.running[slot]
+            tok = int(out[slot])
+            req.output_ids.append(tok)
+            if req.first_token_time is None:
+                req.first_token_time = time.perf_counter()
+            produced[req.req_id] = tok
+        # register prompt prefills in the prefix cache PROGRESSIVELY —
+        # full pages as their chunks land (a request arriving one step
+        # later already hits them), the partial tail once the whole prompt
+        # is in (its K/V writes have been issued to the device pool)
+        for slot, req in self.running.items():
+            if req._registered:
+                continue
+            plen = len(req.prompt_ids)
+            written = min(cache.seq_len(slot), plen)
+            if written >= plen:
+                cache.register_prefix(slot, req.prompt_ids)
+                req._registered = True
+            elif written >= cache.page_size:
+                cache.register_prefix(slot, req.prompt_ids[:written],
+                                      include_tail=False)
+        return produced
+
+    # -- legacy (round-7 two-jit) path -------------------------------------
 
     def _bucket(self, n: int) -> int:
         b = self.prefill_bucket
         return max(b, ((n + b - 1) // b) * b)
 
-    def _admit_one(self, req: Request) -> bool:
+    def _admit_one_legacy(self, req: Request) -> bool:
         """Claim a slot + pages and prefill ``req``'s context into them."""
         ctx = req._context_ids()
         prefix, last = ctx[:-1], ctx[-1]
@@ -163,13 +493,9 @@ class ServingPredictor:
         if not prefix:
             prefix, last = ctx, None
         need_len = len(prefix)
-        # vLLM-style watermark: with other sequences running, keep one
-        # free page of growth headroom past the prompt's own need —
-        # an exactly-fitting admission would be preempted (its whole
-        # prefill discarded) by the same step's growth pass
         headroom = 1 if self.running else 0
         if (not self.cache.can_admit(need_len)
-                or self.cache.free_page_count
+                or self.cache.available_page_count
                 < self.cache.pages_needed(need_len) + headroom):
             return False
         if len(ctx) > self.max_seq_len:
@@ -193,6 +519,8 @@ class ServingPredictor:
             # generated token; decode continues from it
             tok = int(np.asarray(next_ids)[0])
             req.output_ids.append(tok)
+            if req.first_token_time is None:
+                req.first_token_time = time.perf_counter()
             self._next_token[slot] = tok
         else:
             # multi-token context (fresh prompt or preemption replay):
@@ -203,65 +531,21 @@ class ServingPredictor:
         self.running[slot] = req
         return True
 
-    def _admit_waiting(self) -> None:
+    def _admit_waiting_legacy(self) -> None:
         while self.waiting and self.cache.free_slot_count:
             req = self.waiting[0]
-            # a request finished by its prefill token alone never decodes
-            if req.done:
-                self.waiting.popleft()
-                req.state = FINISHED
+            if self._finish_waiting_unservable(req):
                 continue
-            if len(req._context_ids()) > self.max_seq_len:
-                # preempted while sitting AT the length ceiling (its own
-                # truncation check never ran that round): finish it as
-                # truncated, same as the in-loop ceiling stop
-                self.waiting.popleft()
-                req.truncated = True
-                req.state = FINISHED
-                continue
-            if not self._admit_one(req):
-                # head-of-line blocking keeps FIFO order — but if nothing
-                # is running and the whole pool is free, this request can
-                # NEVER fit: fail with the real cause instead of letting
-                # generate() spin empty steps into its budget error
-                if (not self.running and self.cache.free_page_count
+            if not self._admit_one_legacy(req):
+                if (not self.running and self.cache.available_page_count
                         == self.cache.num_pages):
-                    need = self.cache.pages_needed(
-                        len(req._context_ids()) - 1)
-                    raise RuntimeError(
-                        f"request {req.req_id}: context of "
-                        f"{len(req._context_ids())} tokens needs {need} "
-                        f"pages but the pool only has "
-                        f"{self.cache.num_pages} — raise num_pages or "
-                        "page_size")
+                    self._raise_never_admittable(
+                        req, self.cache.pages_needed(
+                            len(req._context_ids()) - 1))
                 break
             self.waiting.popleft()
 
-    def _preempt_youngest(self) -> bool:
-        """Free the youngest running request back to the waiting queue."""
-        if not self.running:
-            return False
-        slot = max(self.running,
-                   key=lambda s: self.running[s].req_id)
-        req = self.running.pop(slot)
-        self.cache.free(slot)
-        req.state = WAITING
-        req.preempt_count += 1
-        self.waiting.appendleft(req)
-        return True
-
-    def _retire_finished(self) -> None:
-        for slot in [s for s, r in self.running.items() if r.done]:
-            req = self.running.pop(slot)
-            self.cache.free(slot)
-            req.state = FINISHED
-
-    # -- the step ----------------------------------------------------------
-
-    def step(self) -> dict[int, int]:
-        """One scheduler round: retire finished, admit waiting, grow pages
-        (preempting under pressure), ONE fixed-shape decode step. Returns
-        ``{req_id: token}`` for the tokens produced this step."""
+    def _step_legacy(self) -> dict[int, int]:
         self._retire_finished()
         # admit/retire to fixpoint: a fresh prompt whose prefill token
         # already satisfies done (budget 1, or prefill token == eos) must
@@ -269,7 +553,7 @@ class ServingPredictor:
         # second token past its contract — and its freed lane can admit
         # the next waiting request within this same round
         while True:
-            self._admit_waiting()
+            self._admit_waiting_legacy()
             if not any(r.done for r in self.running.values()):
                 break
             self._retire_finished()
@@ -283,9 +567,7 @@ class ServingPredictor:
             if slot not in self.running:
                 continue
             if self.cache.seq_len(slot) + 1 > self.max_seq_len:
-                # hit the length ceiling: stop the sequence NOW (truncation-
-                # stop, flagged on the request) and park its lane before the
-                # decode would write past the page-table width
+                # hit the length ceiling: stop the sequence NOW
                 req = self.running.pop(slot)
                 req.truncated = True
                 self.cache.free(slot)
@@ -293,8 +575,6 @@ class ServingPredictor:
                 continue
             while not self.cache.ensure_capacity(
                     slot, self.cache.seq_len(slot) + 1):
-                # page pressure: shed the youngest request (never this one
-                # unless it IS the youngest and alone — then it cannot run)
                 victim_is_self = (max(self.running,
                                       key=lambda s: self.running[s].req_id)
                                   == slot)
@@ -318,23 +598,39 @@ class ServingPredictor:
         for slot, req in self.running.items():
             tok = int(out[slot])
             req.output_ids.append(tok)
+            if req.first_token_time is None:
+                req.first_token_time = time.perf_counter()
             self._next_token[slot] = tok
             self.cache.advance(slot)
             produced[req.req_id] = tok
         return produced
 
-    def has_work(self) -> bool:
-        return bool(self.waiting or self.running)
+    # -- the step ----------------------------------------------------------
+
+    def step(self) -> dict[int, int]:
+        """One scheduler round. Returns ``{req_id: token}`` for the tokens
+        produced this step (a unified round that only advanced prefill
+        chunks produces none)."""
+        if self.unified:
+            return self._step_unified()
+        return self._step_legacy()
 
     # -- convenience -------------------------------------------------------
 
     def generate(self, prompts, max_new_tokens=32, eos_token_id=None,
-                 max_steps=None):
+                 max_steps=None, **sampling):
         """Enqueue ``prompts`` (list of id lists) and drive steps until all
-        finish. Returns a list of output-id lists, in prompt order."""
-        reqs = [self.add_request(p, max_new_tokens, eos_token_id)
+        finish. Returns a list of output-id lists, in prompt order.
+        ``sampling`` forwards temperature/top_k/top_p/seed to every
+        request."""
+        reqs = [self.add_request(p, max_new_tokens, eos_token_id, **sampling)
                 for p in prompts]
-        limit = max_steps or (len(prompts) * (max_new_tokens + 2)
+        # budget covers the chunked-prefill rounds too: EVERY prompt feeds
+        # ceil(len/chunk) chunks before its first token (prompts can
+        # serialize through one lane, so the rounds sum, not max)
+        pre_rounds = sum(len(r.prompt_ids) // self.chunk + 1 for r in reqs)
+        limit = max_steps or ((len(prompts) * (max_new_tokens + 2)
+                               + pre_rounds)
                               * (self.max_batch + 1))
         n = 0
         while any(r.state != FINISHED for r in reqs):

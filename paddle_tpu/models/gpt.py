@@ -483,6 +483,7 @@ def build_prefill(config: GPTConfig, page_size: int):
 
     cfg = config
     eps = cfg.layer_norm_eps
+    trace_count = [0]
 
     def prefill(params, ids, lengths, k_pages, v_pages, pages):
         # MXU-native matmul precision (gpt_spmd.loss_fn convention): the
@@ -493,6 +494,7 @@ def build_prefill(config: GPTConfig, page_size: int):
                                   pages)
 
     def _prefill_inner(params, ids, lengths, k_pages, v_pages, pages):
+        trace_count[0] += 1
         b, s = ids.shape
         nh, hd = cfg.num_heads, cfg.head_dim
         x = (jnp.take(params["tok_emb"], ids, axis=0)
@@ -534,7 +536,11 @@ def build_prefill(config: GPTConfig, page_size: int):
 
     # donate the pools like the decode step: every admission threads the
     # full cache through this jit, and an un-donated scatter would copy it
-    return jax.jit(prefill, donate_argnums=(3, 4))
+    jitted = jax.jit(prefill, donate_argnums=(3, 4))
+    # one executable per prompt-length bucket: the counter makes the
+    # bucketed-prefill compile count visible (bench_serve prefill_retraces)
+    jitted.trace_count = trace_count
+    return jitted
 
 
 def build_decode_step(config: GPTConfig, page_size: int,
@@ -606,6 +612,163 @@ def build_decode_step(config: GPTConfig, page_size: int,
     return jitted
 
 
+def _sample_epilogue(logits, keys, temperature, top_k, top_p):
+    """Seeded temperature / top-k / top-p sampling, fused into the unified
+    step (one [batch, vocab] sort + categorical — no host round-trip).
+
+    logits: [b, v] fp32; keys: [b, 2] uint32 per-lane PRNG keys;
+    temperature/top_p: [b] f32; top_k: [b] i32 (<= 0 disables the k
+    filter, top_p outside (0, 1) disables the p filter). Ties at the k-th
+    /p-th value all stay in the candidate set. Returns sampled ids [b]
+    int32 — the caller selects argmax instead wherever temperature == 0.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    v = logits.shape[-1]
+    t = jnp.maximum(temperature, 1e-6).astype(jnp.float32)
+    scaled = (logits / t[:, None]).astype(jnp.float32)
+    sorted_desc = -jnp.sort(-scaled, axis=-1)                 # [b, v]
+    k = jnp.clip(jnp.where(top_k > 0, top_k, v), 1, v).astype(jnp.int32)
+    kth = jnp.take_along_axis(sorted_desc, (k - 1)[:, None], axis=1)
+    keep = scaled >= kth
+    probs = jax.nn.softmax(sorted_desc, axis=-1)
+    cum_exclusive = jnp.cumsum(probs, axis=-1) - probs
+    p_active = (top_p > 0.0) & (top_p < 1.0)
+    # tokens whose preceding cumulative mass is < p stay (>= 1 survivor)
+    n_keep = jnp.maximum(
+        jnp.sum((cum_exclusive < top_p[:, None]).astype(jnp.int32),
+                axis=-1), 1)
+    n_keep = jnp.where(p_active, n_keep, v).astype(jnp.int32)
+    pth = jnp.take_along_axis(sorted_desc, (n_keep - 1)[:, None], axis=1)
+    keep &= scaled >= pth
+    masked = jnp.where(keep, scaled, jnp.float32(-1e30))
+    sampled = jax.vmap(jax.random.categorical)(keys, masked)
+    return sampled.astype(jnp.int32)
+
+
+def build_unified_step(config: GPTConfig, page_size: int, chunk: int,
+                       use_kernel: bool | None = None):
+    """ONE fixed-shape serving step for mixed ragged prefill + decode,
+    driven by a per-step TOKEN BUDGET.
+
+    The round-9 replacement for the prefill/decode jit split. The step's
+    dense compute (embeddings, qkv/out/mlp matmuls, LNs, logits) runs over
+    a PACKED token stream — ``tok_ids[budget]`` with per-token owning slot
+    and absolute position — so a step that decodes 7 lanes and prefills a
+    9-token chunk spends exactly 16 tokens of matmul, not
+    ``batch * chunk``. Only the paged-attention kernel sees the per-slot
+    ``[batch, chunk]`` chunk blocks (queries scatter in, outputs gather
+    back); every slot contributes 0..chunk tokens per step, causal within
+    its chunk, so admission never head-of-line-blocks decode behind a full
+    prompt forward.
+
+    Signature::
+
+        fn(params, tok_ids[t], tok_slot[t], tok_pos[t],
+           q_lens[b], kv_lens[b], last_idx[b], k_pages, v_pages,
+           page_table[b,pps], cow_src[b], cow_dst[b], keys[b,2],
+           temperature[b], top_k[b], top_p[b])
+        -> (next_ids[b], logits[b,v], k_pages, v_pages)
+
+    ``tok_slot < 0`` marks padding tokens (their writes drop, their rows
+    compute garbage nothing reads). ``kv_lens`` counts tokens already
+    cached per slot BEFORE this step; ``q_lens`` the tokens it feeds now;
+    ``last_idx[b]`` indexes each slot's LAST packed token (sentinel ``t``
+    when idle) — the position whose logits become the slot's next-token
+    decision, meaningful only when the chunk reaches the end of the
+    slot's context (the scheduler knows). Copy-on-write lanes duplicate
+    page ``cow_src -> cow_dst`` across every layer before any write
+    (``cow_dst == num_pages`` is the no-op sentinel). Greedy lanes
+    (``temperature == 0``) take the same argmax as the round-7 decode
+    step, bit-identical; sampling lanes run the fused seeded epilogue.
+    Every array argument keeps its shape step over step: one trace, one
+    executable (``fn.trace_count[0]`` is the gate).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..inference.kv_cache import paged_copy_pages, paged_write_packed
+    from ..ops.pallas.paged_attention import ragged_paged_attention
+
+    cfg = config
+    eps = cfg.layer_norm_eps
+    trace_count = [0]
+
+    def step(params, tok_ids, tok_slot, tok_pos, q_lens, kv_lens, last_idx,
+             k_pages, v_pages, page_table, cow_src, cow_dst, keys,
+             temperature, top_k, top_p):
+        # MXU-native matmul precision — see build_prefill
+        with jax.default_matmul_precision("default"):
+            return _step_inner(params, tok_ids, tok_slot, tok_pos, q_lens,
+                               kv_lens, last_idx, k_pages, v_pages,
+                               page_table, cow_src, cow_dst, keys,
+                               temperature, top_k, top_p)
+
+    def _step_inner(params, tok_ids, tok_slot, tok_pos, q_lens, kv_lens,
+                    last_idx, k_pages, v_pages, page_table, cow_src,
+                    cow_dst, keys, temperature, top_k, top_p):
+        trace_count[0] += 1
+        t = tok_ids.shape[0]
+        b = q_lens.shape[0]
+        nh, hd = cfg.num_heads, cfg.head_dim
+        # copy-on-write BEFORE any write: diverging lanes get a private
+        # copy of their shared tail page across every layer
+        k_pages = paged_copy_pages(k_pages, cow_src, cow_dst)
+        v_pages = paged_copy_pages(v_pages, cow_src, cow_dst)
+        x = (jnp.take(params["tok_emb"], jnp.maximum(tok_ids, 0), axis=0)
+             + params["pos_emb"][
+                 jnp.clip(tok_pos, 0, params["pos_emb"].shape[0] - 1)])
+        ctx = (kv_lens + q_lens).astype(jnp.int32)
+        # packed <-> chunk-block index plumbing (shared by every layer):
+        # each token's row in the attention kernel's [b, chunk] blocks
+        valid = tok_slot >= 0
+        slot_c = jnp.clip(tok_slot, 0, b - 1)
+        off = tok_pos - kv_lens[slot_c]              # position in chunk
+        off_c = jnp.clip(off, 0, chunk - 1)
+        scatter_b = jnp.where(valid, tok_slot, b)    # b = dropped row
+
+        def block(x, layer):
+            p, kp, vp = layer
+            y = _srv_ln(x, p["ln1_g"], p["ln1_b"], eps)
+            qkv = (y @ p["wqkv"] + p["bqkv"]).reshape(t, 3, nh, hd)
+            q, k_t, v_t = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+            kp = paged_write_packed(kp, k_t, page_table, tok_slot, tok_pos,
+                                    page_size)
+            vp = paged_write_packed(vp, v_t, page_table, tok_slot, tok_pos,
+                                    page_size)
+            qb = jnp.zeros((b, chunk, nh, hd), q.dtype
+                           ).at[scatter_b, off_c].set(q, mode="drop")
+            ab = ragged_paged_attention(qb, kp, vp, page_table, ctx, q_lens,
+                                        use_kernel=use_kernel)
+            a = ab[slot_c, off_c]                    # back to packed [t]
+            x = x + a.reshape(t, nh * hd) @ p["wo"] + p["bo"]
+            x = x + _srv_mlp(p, _srv_ln(x, p["ln2_g"], p["ln2_b"], eps))
+            return x, (kp, vp)
+
+        x, (k_pages, v_pages) = jax.lax.scan(
+            block, x, (params["layers"], k_pages, v_pages))
+        x = _srv_ln(x, params["lnf_g"], params["lnf_b"], eps)
+        # each slot's LAST packed token yields its next-token decision
+        h_last = x[jnp.clip(last_idx, 0, t - 1)]                  # [b, h]
+        logits = _srv_logits(params, h_last).astype(jnp.float32)
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        # the epilogue's [b, vocab] sort/softmax/cumsum only EXECUTES on
+        # steps where some lane actually samples — all-greedy steps (the
+        # flagship greedy serving loop) pay just the argmax + predicate
+        sampled = jax.lax.cond(
+            jnp.any(temperature > 0.0),
+            lambda: _sample_epilogue(logits, keys, temperature, top_k,
+                                     top_p),
+            lambda: greedy)
+        next_ids = jnp.where(temperature > 0.0, sampled, greedy)
+        return next_ids, logits, k_pages, v_pages
+
+    jitted = jax.jit(step, donate_argnums=(7, 8))
+    jitted.trace_count = trace_count
+    return jitted
+
+
 # generate_paged's compiled programs, keyed by (config fields, page_size,
 # use_kernel): repeated generate() calls replay the same jit instead of
 # re-tracing + re-compiling the whole model each call. ServingPredictor
@@ -641,38 +804,62 @@ def _serving_params_cached(model):
     return params
 
 
-def _serving_fns(config: GPTConfig, page_size: int, use_kernel):
-    import dataclasses
-
-    key = (tuple((f.name, getattr(config, f.name))
-                 for f in dataclasses.fields(config)),
-           page_size, use_kernel)
+def _jit_cache_get(key, build):
     hit = _SERVING_JIT_CACHE.get(key)
     if hit is None:
         # bounded LRU (same policy as the engine's eager-op cache): a
         # process sweeping geometries must not pin executables forever
         while len(_SERVING_JIT_CACHE) >= 32:
             _SERVING_JIT_CACHE.pop(next(iter(_SERVING_JIT_CACHE)))
-        hit = (build_prefill(config, page_size),
-               build_decode_step(config, page_size, use_kernel=use_kernel))
+        hit = build()
     else:
         _SERVING_JIT_CACHE.pop(key)  # refresh recency
     _SERVING_JIT_CACHE[key] = hit
     return hit
 
 
-def generate_paged(model, input_ids, max_new_tokens=20, *, page_size=None,
-                   num_pages=None, use_kernel=None, eos_token_id=None):
-    """Greedy autoregressive generation over the paged KV cache.
+def _cfg_key(config: GPTConfig):
+    import dataclasses
 
-    ``input_ids``: [batch, prompt_len] (Tensor or array). Returns an int64
-    Tensor [batch, <= max_new_tokens] of generated ids (prefill as one jit,
-    then one fixed-shape decode jit per token — no retrace after warmup).
+    return tuple((f.name, getattr(config, f.name))
+                 for f in dataclasses.fields(config))
+
+
+def _serving_fns(config: GPTConfig, page_size: int, use_kernel):
+    return _jit_cache_get(
+        ("legacy", _cfg_key(config), page_size, use_kernel),
+        lambda: (build_prefill(config, page_size),
+                 build_decode_step(config, page_size,
+                                   use_kernel=use_kernel)))
+
+
+def _unified_fn(config: GPTConfig, page_size: int, chunk: int, use_kernel):
+    return _jit_cache_get(
+        ("unified", _cfg_key(config), page_size, chunk, use_kernel),
+        lambda: build_unified_step(config, page_size, chunk,
+                                   use_kernel=use_kernel))
+
+
+def generate_paged(model, input_ids, max_new_tokens=20, *, page_size=None,
+                   num_pages=None, use_kernel=None, eos_token_id=None,
+                   chunk=None, temperature=0.0, top_k=0, top_p=1.0,
+                   seed=0):
+    """Autoregressive generation over the paged KV cache — round 9: ONE
+    unified-step jit serves prefill chunks and decode tokens alike.
+
+    ``input_ids``: [batch, prompt_len] (Tensor or array). Prompts feed in
+    ``chunk``-token ragged chunks (autotuned default), then every decode
+    token replays the SAME fixed-shape program — no per-bucket prefill
+    executables, no retrace after warmup. Greedy (``temperature == 0``,
+    the default) is bit-identical to the round-7 two-jit path and the
+    full-forward oracle. ``temperature > 0`` runs the fused seeded
+    temperature/top-k/top-p epilogue (``seed`` makes it reproducible).
     With ``eos_token_id``, a row that stops early frees its cache pages,
     its lane goes inert, and its remaining columns pad with the eos id.
     """
     import numpy as np
 
+    import jax
     import jax.numpy as jnp
 
     from ..inference.kv_cache import KVCacheManager, pages_needed
@@ -694,67 +881,120 @@ def generate_paged(model, input_ids, max_new_tokens=20, *, page_size=None,
             f"max_seq_len {cfg.max_seq_len}")
     params = _serving_params_cached(model)
     dtype = params["tok_emb"].dtype
-    if page_size is None:
-        from ..ops.pallas.paged_attention import preferred_page_size
+    if page_size is None or chunk is None:
+        from ..ops.pallas.paged_attention import (preferred_chunk_size,
+                                                  preferred_page_size)
 
-        page_size = preferred_page_size(cfg.num_heads, cfg.num_heads,
-                                        cfg.head_dim, dtype)
+        if page_size is None:
+            page_size = preferred_page_size(cfg.num_heads, cfg.num_heads,
+                                            cfg.head_dim, dtype)
+        if chunk is None:
+            chunk = preferred_chunk_size(cfg.num_heads, cfg.num_heads,
+                                         cfg.head_dim, dtype)
     mgr = KVCacheManager(
         cfg.num_layers, cfg.num_heads, cfg.head_dim,
         num_pages=num_pages or b * pages_needed(total, page_size),
         max_batch=b, max_seq_len=total, page_size=page_size, dtype=dtype)
-    slots = [mgr.admit(s) for _ in range(b)]
+    contexts = [[int(t) for t in row] for row in ids_np]
+    slots: list = []
+    for ctx in contexts:
+        slot, _ = mgr.admit_prefix(ctx)   # no prefix sharing here: the
+        slots.append(slot)                # ServingPredictor owns that path
 
-    prefill, decode = _serving_fns(cfg, mgr.page_size, use_kernel)
-    traces_at_entry = decode.trace_count[0]
-    next_ids, _, kp, vp = prefill(
-        params, jnp.asarray(ids_np), jnp.full((b,), s, jnp.int32),
-        mgr.k_pages, mgr.v_pages,
-        jnp.stack([mgr.slot_pages(sl) for sl in slots]))
-    mgr.update_pages(kp, vp)
+    step = _unified_fn(cfg, mgr.page_size, int(chunk), use_kernel)
+    traces_at_entry = step.trace_count[0]
+    chunk = int(chunk)
+    # token budget: every row can feed a full chunk each round (generate
+    # drives all rows in lockstep; the budget-packed scheduler lives in
+    # ServingPredictor). constant per-call sampling plumbing; generate
+    # never shares pages, so copy-on-write stays on the no-op sentinel
+    t_budget = b * chunk
+    no_cow = jnp.full((b,), mgr.num_pages, jnp.int32)
+    temp_arr = jnp.full((b,), float(temperature), jnp.float32)
+    topk_arr = jnp.full((b,), int(top_k), jnp.int32)
+    topp_arr = jnp.full((b,), float(top_p), jnp.float32)
+    zero_keys = np.zeros((b, 2), np.uint32)
+    base_key = jax.random.PRNGKey(int(seed)) if temperature > 0 else None
 
-    out = [np.asarray(next_ids)]
+    out: list[np.ndarray] = []
     done = np.zeros((b,), bool)
-    if eos_token_id is not None:
-        done |= out[0] == eos_token_id
-    cur = next_ids
-    for _ in range(max_new_tokens - 1):
-        if done.all():
-            break
-        # free ALL eos lanes first (seq_len 0 parks the decode lane — no
-        # writes, zero attention), THEN grow the live ones: a tight pool
-        # must see the reclaimed pages before any capacity check can fail
+    step_no = 0
+    while len(out) < max_new_tokens and not done.all():
+        # free ALL finished lanes first (their lane goes inert), THEN grow
+        # the live ones: a tight pool must see the reclaimed pages before
+        # any capacity check can fail
         for i, sl in enumerate(slots):
             if done[i] and sl is not None:
                 mgr.free(sl)
                 slots[i] = None
+        q_lens = np.zeros((b,), np.int32)
+        tok_ids = np.zeros((t_budget,), np.int32)
+        tok_slot = np.full((t_budget,), -1, np.int32)
+        tok_pos = np.zeros((t_budget,), np.int32)
+        last_idx = np.full((b,), t_budget, np.int32)   # idle sentinel
+        w = 0
         for i, sl in enumerate(slots):
-            if done[i]:
+            if sl is None or done[i]:
                 continue
-            if not mgr.ensure_capacity(sl, mgr.seq_len(sl) + 1):
+            written = mgr.seq_len(sl)
+            n = min(chunk, len(contexts[i]) - written)
+            if not mgr.ensure_capacity(sl, written + n):
                 # an undersized pool must fail loudly: the dropped K/V
                 # write would otherwise silently corrupt every later token
                 raise RuntimeError(
                     f"KV cache exhausted growing slot {sl} to "
-                    f"{mgr.seq_len(sl) + 1} tokens — pass a larger "
+                    f"{written + n} tokens — pass a larger "
                     "num_pages (or use ServingPredictor, which preempts)")
-        cur, _, kp, vp = decode(
-            params, cur, mgr.seq_lens_device(), mgr.k_pages, mgr.v_pages,
-            mgr.page_table_device())
+            q_lens[sl] = n
+            tok_ids[w:w + n] = contexts[i][written:written + n]
+            tok_slot[w:w + n] = sl
+            tok_pos[w:w + n] = np.arange(written, written + n)
+            last_idx[sl] = w + n - 1
+            w += n
+        if temperature > 0:
+            keys = np.stack([
+                np.asarray(jax.random.fold_in(
+                    jax.random.fold_in(base_key, i), step_no), np.uint32)
+                for i in range(b)])
+        else:
+            keys = zero_keys
+        next_ids, _, kp, vp = step(
+            params, jnp.asarray(tok_ids), jnp.asarray(tok_slot),
+            jnp.asarray(tok_pos), jnp.asarray(q_lens),
+            mgr.seq_lens_device(), jnp.asarray(last_idx),
+            mgr.k_pages, mgr.v_pages, mgr.page_table_device(),
+            no_cow, no_cow, jnp.asarray(keys),
+            temp_arr, topk_arr, topp_arr)
         mgr.update_pages(kp, vp)
+        step_no += 1
+        toks = None
+        produced = False
         for i, sl in enumerate(slots):
-            if sl is not None and not done[i]:
-                mgr.advance(sl)
-        tok = np.asarray(cur)
+            if sl is None or q_lens[sl] == 0:
+                continue
+            mgr.advance(sl, int(q_lens[sl]))
+            if mgr.seq_len(sl) == len(contexts[i]):
+                # the chunk reached the end of this row's context: its
+                # sampled/greedy token is the next generated one
+                if toks is None:
+                    toks = np.asarray(next_ids)
+                contexts[i].append(int(toks[sl]))
+                produced = True
+        if not produced:
+            continue   # mid-prefill round: nothing emitted yet
+        # equal prompt lengths keep the rows in lockstep: every live row
+        # produces in the same round; finished rows pad with eos
+        col = np.zeros((b,), np.int64)
+        for i in range(b):
+            if done[i]:
+                col[i] = eos_token_id
+            else:
+                col[i] = contexts[i][-1]
+        out.append(col)
         if eos_token_id is not None:
-            # finished rows pad with eos (their inert lane's argmax is
-            # meaningless)
-            tok = np.where(done, eos_token_id, tok).astype(tok.dtype)
-        out.append(tok)
-        if eos_token_id is not None:
-            done |= tok == eos_token_id
+            done |= col == eos_token_id
     # traces THIS call added: 1 on a cold shape, 0 when the cached jit
     # already compiled it — never per-token (the no-retrace gate)
-    generate_paged.last_decode_trace_count = (decode.trace_count[0]
+    generate_paged.last_decode_trace_count = (step.trace_count[0]
                                               - traces_at_entry)
     return Tensor(jnp.asarray(np.stack(out, axis=1), jnp.int64))

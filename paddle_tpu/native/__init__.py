@@ -62,7 +62,11 @@ def load_library(name: str) -> ctypes.CDLL:
             raise FileNotFoundError(src)
         os.makedirs(_BUILD_DIR, exist_ok=True)
         so = os.path.join(_BUILD_DIR, f"lib{name}.so")
-        compile_shared_lib([src], so)
+        # glibc < 2.34 keeps shm_open/sem_* in librt; -shared links fine
+        # without it but dlopen then fails with an undefined symbol unless
+        # some other module happened to pull librt in first (import-order
+        # flake). Explicit -lrt is a no-op stub on newer glibc.
+        compile_shared_lib([src], so, ldflags=("-lrt",))
         lib = ctypes.CDLL(so)
         _cache[name] = lib
         return lib

@@ -32,6 +32,17 @@ gather-based :func:`paged_attention_reference` is both the numerical oracle
 and the non-TPU fallback. Page-size autotune rides the shared
 ``autotune_cache`` (the page size IS the kernel's kv block size, fixed at
 cache construction — see :func:`autotune_page_size`).
+
+Round 9 adds the RAGGED sibling :func:`ragged_paged_attention` — the
+unified-step kernel (Ragged Paged Attention, arxiv 2604.15464): each
+sequence contributes 1..chunk query tokens per step (decode lanes feed 1,
+prefill chunks feed up to ``chunk``), causal within the chunk, online
+softmax across that sequence's pages. Query rows for one (sequence,
+kv-head) program are laid out ``[chunk * group, head_dim]`` (chunk-major,
+GQA group minor) so one MXU dot serves the whole chunk; the per-row causal
+limit is ``kv_start + row // group + 1``. The per-step chunk size is a
+trace-time constant autotuned on the shared cache
+(:func:`preferred_chunk_size` / :func:`autotune_chunk_size`).
 """
 from __future__ import annotations
 
@@ -237,6 +248,174 @@ def paged_attention(q, k_pages, v_pages, page_table, lengths, scale=None,
 
 
 # ---------------------------------------------------------------------------
+# ragged kernel: 1..chunk query tokens per sequence, causal within the chunk
+# ---------------------------------------------------------------------------
+
+
+def _ragged_kernel(lens_ref, qlens_ref, pt_ref, q_ref, k_ref, v_ref,
+                   o_ref, m_ref, l_ref, *, page_size, group, scale):
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    kv_len = lens_ref[b]     # context INCLUDING this chunk's tokens
+    q_len = qlens_ref[b]     # valid query tokens this step (0 = idle lane)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        o_ref[...] = jnp.zeros_like(o_ref)
+
+    @pl.when((j * page_size < kv_len) & (q_len > 0))
+    def _accumulate():
+        q = q_ref[...]           # [R, d] rows = chunk-major * group-minor
+        k = k_ref[...]           # [page_size, d]
+        v = v_ref[...]
+        s = _dotf32(q, k, ((1,), (1,))) * scale          # [R, ps] f32
+        col = j * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        # row r serves query token r // group: it may attend every key up
+        # to and including its own position kv_start + r // group
+        qi = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) // group
+        limit = (kv_len - q_len) + qi + jnp.int32(1)
+        s = jnp.where(col < jnp.minimum(limit, kv_len), s, NEG_INF)
+        m_prev = m_ref[...]                               # [R, 1]
+        l_prev = l_ref[...]
+        m_next = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_next)
+        p = jnp.exp(s - m_next)
+        l_next = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        l_safe = jnp.where(l_next == 0.0, 1.0, l_next)
+        pv = _dotf32(p.astype(v.dtype), v, ((1,), (0,)))  # [R, d]
+        o_ref[...] = ((o_ref[...] * (l_prev * alpha) + pv) / l_safe
+                      ).astype(o_ref.dtype)
+        m_ref[...] = m_next
+        l_ref[...] = l_next
+
+
+def _ragged_kernel_impl(q4, k_pages, v_pages, page_table, kv_lens, q_lens,
+                        group, scale):
+    """q4: [b, kv_heads, R, d] with R = chunk*group padded to the sublane
+    tile; returns [b, kv_heads, R, d] fp32."""
+    b, hkv, r8, d = q4.shape
+    num_pages, page_size = k_pages.shape[0], k_pages.shape[1]
+    pps = page_table.shape[1]
+    grid = (b, hkv, pps)
+
+    def kv_imap(bi, h, j, lens_ref, qlens_ref, pt_ref):
+        # identical clamping to the decode kernel: pages past the last
+        # valid one re-fetch it (their compute is skipped)
+        ps = jnp.int32(page_size)
+        last = jnp.maximum(
+            jax.lax.div(lens_ref[bi] + ps - jnp.int32(1), ps) - jnp.int32(1),
+            jnp.int32(0))
+        page = pt_ref[bi, jnp.minimum(jnp.int32(j), last)]
+        return (jnp.clip(page, 0, num_pages - 1), 0, h, 0)
+
+    q_spec = pl.BlockSpec((None, None, r8, d), lambda bi, h, j, *_: (bi, h, 0, 0))
+    kv_spec = pl.BlockSpec((None, page_size, None, d), kv_imap)
+    o_spec = pl.BlockSpec((None, None, r8, d), lambda bi, h, j, *_: (bi, h, 0, 0))
+    ml_spec = pl.BlockSpec((None, None, r8, 1), lambda bi, h, j, *_: (bi, h, 0, 0))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=grid,
+        in_specs=[q_spec, kv_spec, kv_spec],
+        out_specs=[o_spec, ml_spec, ml_spec],
+    )
+    out_shape = [
+        jax.ShapeDtypeStruct((b, hkv, r8, d), jnp.float32),
+        jax.ShapeDtypeStruct((b, hkv, r8, 1), jnp.float32),
+        jax.ShapeDtypeStruct((b, hkv, r8, 1), jnp.float32),
+    ]
+    kern = functools.partial(_ragged_kernel, page_size=page_size,
+                             group=group, scale=scale)
+    with _atc.x64_off():
+        out, _, _ = pl.pallas_call(
+            kern, grid_spec=grid_spec, out_shape=out_shape,
+            compiler_params=pltpu.TPUCompilerParams(
+                dimension_semantics=("parallel", "arbitrary", "arbitrary")),
+            interpret=_interpret(),
+        )(kv_lens.astype(jnp.int32), q_lens.astype(jnp.int32),
+          page_table.astype(jnp.int32), q4, k_pages, v_pages)
+    return out
+
+
+def ragged_paged_attention_reference(q, k_pages, v_pages, page_table,
+                                     kv_lens, q_lens, scale=None):
+    """Gather-based oracle for the ragged kernel (and the non-TPU path).
+
+    q: [b, chunk, num_q_heads, d] right-padded query chunks; kv_lens: [b]
+    context length per slot INCLUDING this chunk; q_lens: [b] valid query
+    rows (0 = idle lane — its output rows are zero). Query token t of slot
+    b sits at absolute position ``kv_lens[b] - q_lens[b] + t`` and attends
+    all keys at positions <= its own. Returns [b, chunk, num_q_heads, d].
+    """
+    b, c, hq, d = q.shape
+    num_pages, page_size, hkv, _ = k_pages.shape
+    pps = page_table.shape[1]
+    group = hq // hkv
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    pt = jnp.clip(page_table, 0, num_pages - 1)
+    k = k_pages[pt].reshape(b, pps * page_size, hkv, d)
+    v = v_pages[pt].reshape(b, pps * page_size, hkv, d)
+    qg = q.reshape(b, c, hkv, group, d)
+    s = jnp.einsum("bchgd,bshd->bhgcs", qg.astype(jnp.float32),
+                   k.astype(jnp.float32), precision=_MXU) * scale
+    kv_start = (kv_lens - q_lens).reshape(-1, 1, 1)              # [b,1,1]
+    limit = kv_start + jnp.arange(c).reshape(1, -1, 1) + 1       # [b,c,1]
+    col = jnp.arange(pps * page_size).reshape(1, 1, -1)
+    valid = ((col < jnp.minimum(limit, kv_lens.reshape(-1, 1, 1)))
+             & (jnp.arange(c).reshape(1, -1, 1) < q_lens.reshape(-1, 1, 1)))
+    s = jnp.where(valid[:, None, None], s, NEG_INF)              # [b,h,g,c,s]
+    p = jax.nn.softmax(s, axis=-1)
+    # fully-masked rows (idle lanes / padding past q_lens): softmax is
+    # uniform garbage — zero them
+    p = jnp.where(valid[:, None, None], p, 0.0)
+    out = jnp.einsum("bhgcs,bshd->bchgd", p, v.astype(jnp.float32),
+                     precision=_MXU)
+    return out.reshape(b, c, hq, d).astype(q.dtype)
+
+
+def ragged_paged_attention(q, k_pages, v_pages, page_table, kv_lens, q_lens,
+                           scale=None, use_kernel: bool | None = None):
+    """Ragged prefill+decode attention over the paged KV cache.
+
+    The unified-step entry: each slot contributes ``q_lens[b]`` (0..chunk)
+    query tokens this step, causal within the chunk, attending that slot's
+    whole paged context (``kv_lens[b]`` tokens, chunk included — the
+    chunk's K/V must already be written to the pages). ``use_kernel`` as in
+    :func:`paged_attention`. Rows past ``q_lens`` are garbage the caller
+    must ignore (their page writes drop; the reference zeroes them).
+    """
+    b, c, hq, d = q.shape
+    hkv = k_pages.shape[2]
+    assert hq % hkv == 0, f"GQA needs q heads {hq} divisible by kv {hkv}"
+    assert k_pages.shape == v_pages.shape
+    assert page_table.shape[0] == b
+    assert kv_lens.shape == (b,) and q_lens.shape == (b,)
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    if use_kernel is None:
+        use_kernel = use_kernel_default()
+    if not use_kernel:
+        return ragged_paged_attention_reference(
+            q, k_pages, v_pages, page_table, kv_lens, q_lens, scale=scale)
+    group = hq // hkv
+    # rows = chunk-major, group-minor: [b, c, hkv, g, d] -> [b, hkv, c*g, d]
+    q4 = q.reshape(b, c, hkv, group, d).transpose(0, 2, 1, 3, 4)
+    q4 = q4.reshape(b, hkv, c * group, d)
+    r8 = max(8, ((c * group + 7) // 8) * 8)
+    if r8 != c * group:
+        q4 = jnp.pad(q4, ((0, 0), (0, 0), (0, r8 - c * group), (0, 0)))
+    out = _ragged_kernel_impl(q4, k_pages, v_pages, page_table, kv_lens,
+                              q_lens, group, float(scale))
+    out = out[:, :, :c * group, :].reshape(b, hkv, c, group, d)
+    out = out.transpose(0, 2, 1, 3, 4).reshape(b, c, hq, d)
+    return out.astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
 # page-size autotune (rides the shared autotune cache)
 # ---------------------------------------------------------------------------
 
@@ -301,3 +480,72 @@ def autotune_page_size(batch, hq, hkv, d, max_len=2048, dtype=jnp.bfloat16,
         _atc.save()
         return best
     return preferred_page_size(hq, hkv, d, dtype)
+
+
+# ---------------------------------------------------------------------------
+# chunk-size autotune (the unified step's per-slot query-chunk width)
+# ---------------------------------------------------------------------------
+
+CHUNK_DEFAULT = 16
+
+
+def _chunk_sig(hq, hkv, d, dtype) -> str:
+    return f"ragged:{hq}h{hkv}x{d}:{jnp.dtype(dtype).name}:chunk"
+
+
+def preferred_chunk_size(hq, hkv, d, dtype=jnp.bfloat16) -> int:
+    """The autotuned unified-step chunk size for this head geometry (or the
+    default). Chunk is a TRACE-TIME shape constant of the unified step jit
+    (its [batch, chunk] query block), so like the page size it is consulted
+    once when the serving step is built."""
+    hit = _atc.lookup(_chunk_sig(hq, hkv, d, dtype))
+    return int(hit[0]) if hit else CHUNK_DEFAULT
+
+
+def autotune_chunk_size(batch, hq, hkv, d, max_len=2048, page_size=None,
+                        dtype=jnp.bfloat16, candidates=(8, 16, 32, 64),
+                        iters=5):
+    """Sweep the ragged kernel's chunk width on the current device and
+    persist the winner on the shared autotune cache. The sweep times a
+    mixed step (half the lanes decode 1 token, half prefill a full chunk —
+    the steady-state unified-step shape). Returns the chunk size."""
+    import time
+
+    if _interpret():
+        return preferred_chunk_size(hq, hkv, d, dtype)
+    _atc.load()
+    sig = _chunk_sig(hq, hkv, d, dtype)
+    ps = page_size or preferred_page_size(hq, hkv, d, dtype)
+    pps = (max_len + ps - 1) // ps
+    num_pages = batch * pps + 1
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
+    kp = jax.random.normal(kk, (num_pages, ps, hkv, d), dtype)
+    vp = jax.random.normal(kv, (num_pages, ps, hkv, d), dtype)
+    pt = jnp.arange(batch * pps, dtype=jnp.int32).reshape(batch, pps)
+    best, best_t = None, float("inf")
+    for chunk in candidates:
+        q = jax.random.normal(kq, (batch, chunk, hq, d), dtype)
+        # mixed ragged step: even lanes decode (1 token), odd lanes carry a
+        # full prefill chunk
+        q_lens = jnp.where(jnp.arange(batch) % 2 == 0, 1, chunk
+                           ).astype(jnp.int32)
+        kv_lens = jnp.full((batch,), max_len, jnp.int32)
+        try:
+            step = jax.jit(functools.partial(ragged_paged_attention,
+                                             use_kernel=True))
+            step(q, kp, vp, pt, kv_lens, q_lens).block_until_ready()
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = step(q, kp, vp, pt, kv_lens, q_lens)
+            out.block_until_ready()
+            # normalize per useful token: bigger chunks do more work/step
+            t = (time.perf_counter() - t0) / float(q_lens.sum())
+        except Exception:
+            continue
+        if t < best_t:
+            best, best_t = chunk, t
+    if best is not None:
+        _atc.CACHE[sig] = [int(best)]
+        _atc.save()
+        return best
+    return preferred_chunk_size(hq, hkv, d, dtype)

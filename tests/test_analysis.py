@@ -659,6 +659,14 @@ class TestHazardRegressions:
             fs = astlint.lint_file(os.path.join(REPO, rel), REPO)
             assert [f for f in fs if f.rule == "AL001"] == [], rel
 
+    def test_unified_step_jit_is_clean_and_donates(self):
+        """The round-9 unified serving step: jaxpr walk + donation audit
+        of the K/V page pools come back with ZERO findings (the baseline
+        stays empty)."""
+        from paddle_tpu.analysis.targets import analyze_serving_unified
+
+        assert analyze_serving_unified() == []
+
     def test_serving_jits_donate_consumed_buffers(self):
         """The decode/prefill page-pool donation must keep aliasing outputs
         (JX005 clean) — a silently wasted donation doubles cache memory."""

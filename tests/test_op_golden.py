@@ -1086,7 +1086,7 @@ _SKIP_GROUPS = {
         "fused_bias_gelu", "fused_ln_residual",
     ],
     "paged decode-attention Pallas kernel op (golden-tested vs the jnp gather reference across ragged lengths/page sizes/GQA in tests/test_paged_attention.py — interpret mode on CPU; decode-only, no grad)": [
-        "paged_attention",
+        "paged_attention", "ragged_paged_attention",
     ],
     "fused/incubate op (covered by tests/test_incubate.py)": [
         "fused_bias_dropout_residual_ln", "fused_dropout_add",

@@ -1,0 +1,225 @@
+"""Round-9 prefix cache: page-granular content-hash registry on
+KVCacheManager — refcount/ownership property test under randomized
+admit/evict/preempt churn, plus targeted unit tests for matching,
+registration, LRU eviction and copy-on-write.
+"""
+import numpy as np
+import pytest
+
+from paddle_tpu.inference.kv_cache import KVCacheManager
+
+
+def _mgr(**over):
+    kw = dict(num_layers=2, num_kv_heads=2, head_dim=8, num_pages=12,
+              max_batch=4, max_seq_len=64, page_size=8,
+              enable_prefix_cache=True)
+    kw.update(over)
+    return KVCacheManager(**kw)
+
+
+# -- unit behavior ----------------------------------------------------------
+
+
+def test_identical_prompt_hits_all_but_one_token():
+    m = _mgr()
+    toks = list(range(20))
+    s0, c0 = m.admit_prefix(toks)
+    assert c0 == 0
+    m.register_prefix(s0, toks)
+    s1, c1 = m.admit_prefix(toks)
+    # full pages + partial tail all hit; one token is left to feed (the
+    # cache stores K/V, not logits)
+    assert c1 == 19
+    assert (m._page_table[s0][:3] == m._page_table[s1][:3]).all()
+    m.free(s0), m.free(s1)
+
+
+def test_partial_prefix_hit_at_page_granularity():
+    m = _mgr()
+    toks = list(range(20))
+    s0, _ = m.admit_prefix(toks)
+    m.register_prefix(s0, toks)
+    m.free(s0)
+    # shares the first full page only (diverges at token 8)
+    other = list(range(8)) + [99] * 8
+    s1, c1 = m.admit_prefix(other)
+    assert c1 == 8
+    m.free(s1)
+    # diverges inside page 1: no hit (page granularity)
+    s2, c2 = m.admit_prefix([0, 1, 2, 99, 4, 5, 6, 7, 8, 9])
+    assert c2 == 0
+    m.free(s2)
+
+
+def test_zero_ref_registered_pages_survive_on_lru_until_pressure():
+    m = _mgr(num_pages=6)
+    toks = list(range(16))
+    s0, _ = m.admit_prefix(toks)
+    m.register_prefix(s0, toks)
+    m.free(s0)
+    assert m.free_page_count == 4 and m.available_page_count == 6
+    # hit survives the free
+    s1, c1 = m.admit_prefix(toks)
+    assert c1 == 15
+    m.free(s1)
+    # pool pressure evicts the LRU tail and reuses it
+    big = [[1000 + i * 100 + j for j in range(16)] for i in range(3)]
+    slots = [m.admit_prefix(t)[0] for t in big]
+    assert m.free_page_count == 0
+    for s in slots:
+        m.free(s)
+    # original prefix was (at least partly) evicted: hit shrinks or dies
+    s2, c2 = m.admit_prefix(toks)
+    assert c2 < 15
+    m.free(s2)
+
+
+def test_cow_on_divergent_write_into_shared_page():
+    m = _mgr()
+    toks = list(range(12))        # page 0 full, page 1 partial (4 tokens)
+    s0, _ = m.admit_prefix(toks)
+    m.register_prefix(s0, toks)
+    s1, c1 = m.admit_prefix(toks)
+    assert c1 == 11
+    shared = int(m._page_table[s1][1])
+    assert m._refcount[shared] == 2
+    assert m.needs_cow(s1, 11)    # next write lands in the shared tail
+    src, dst = m.prepare_write(s1, 11)
+    assert src == shared and dst != shared
+    assert int(m._page_table[s1][1]) == dst
+    assert int(m._page_table[s0][1]) == shared   # owner untouched
+    assert m._refcount[shared] == 1 and m._refcount[dst] == 1
+    assert not m.needs_cow(s1, 11)
+    # owner writing its own (now refcount-1) page needs no copy
+    assert not m.needs_cow(s0, 11)
+    m.free(s0), m.free(s1)
+
+
+def test_pinned_pages_never_evicted():
+    """Refcounted prefix pages are pinned: allocation pressure must raise
+    rather than steal them."""
+    m = _mgr(num_pages=2, max_batch=3)
+    toks = list(range(16))
+    s0, _ = m.admit_prefix(toks)
+    m.register_prefix(s0, toks)
+    s1, c1 = m.admit_prefix(toks)   # shares both pages (cap at 15)
+    assert c1 == 15
+    with pytest.raises(RuntimeError, match="exhausted"):
+        m.admit_prefix([7] * 8)
+    # the shared pages are still intact in both tables
+    assert (m._page_table[s0][:2] == m._page_table[s1][:2]).all()
+    m.free(s0), m.free(s1)
+
+
+def test_admission_does_not_double_count_matched_lru_pages():
+    """A matched page sitting on the LRU is about to be re-pinned by the
+    admission itself — it must NOT also count as allocatable for the
+    fresh-page need (double-count -> mid-admission alloc failure with
+    partially mutated state)."""
+    m = _mgr(num_pages=3, max_batch=2, max_seq_len=24)
+    shared16 = list(range(16))
+    s0, _ = m.admit_prefix(shared16)
+    m.register_prefix(s0, shared16)
+    m.free(s0)                        # both pages park on the LRU
+    s1, _ = m.admit_prefix([99] * 8)  # pins the one remaining page
+    assert m.free_page_count == 0 and m.available_page_count == 2
+    # 20-token prompt: matches both LRU pages, needs ONE fresh page —
+    # which doesn't exist once the match re-pins the LRU
+    free_slots = m.free_slot_count
+    assert m.admit_prefix(shared16 + [7] * 4, soft=True) is None
+    assert m.free_slot_count == free_slots          # nothing mutated
+    assert len(m._lru) == 2                         # LRU untouched
+    with pytest.raises(RuntimeError, match="exhausted"):
+        m.admit_prefix(shared16 + [7] * 4)
+    _check_invariants(m)
+    m.free(s1)
+
+
+# -- the 1k-churn property test ---------------------------------------------
+
+
+def _check_invariants(m: KVCacheManager):
+    num_pages = m.num_pages
+    free = set(m._free_pages)
+    lru = set(m._lru)
+    # refcounts recomputed from the tables must match the incremental ones
+    counts = np.zeros((num_pages,), np.int64)
+    for row in m._page_table:
+        for p in row:
+            if p >= 0:
+                counts[p] += 1
+    assert (counts == m._refcount).all(), "refcount drifted from tables"
+    held = {p for p in range(num_pages) if counts[p] > 0}
+    # every page in EXACTLY one of: free, LRU (zero-ref registered), held
+    assert not (free & lru) and not (free & held) and not (lru & held)
+    assert free | lru | held == set(range(num_pages)), "page leaked"
+    # LRU pages are registered; free pages are not
+    for p in lru:
+        assert p in m._page_key
+    for p in free:
+        assert p not in m._page_key
+    # registry is a bijection page <-> key
+    assert len(m._prefix_pages) == len(m._page_key)
+    for page, key in m._page_key.items():
+        assert m._prefix_pages[key] == page
+
+
+def test_prefix_refcounts_survive_1k_churn_steps(rng):
+    """Randomized admit / chunk-write (CoW-guarded) / grow / preempt /
+    evict churn: after every op no page is leaked, refcounts match the
+    tables, and no write ever targets a page with refcount >= 2 (shared
+    pages are immutable)."""
+    m = _mgr(num_pages=10, max_batch=3, max_seq_len=48, page_size=4)
+    # a small prompt pool with heavy shared prefixes drives real hits
+    base = [int(x) for x in rng.randint(0, 50, (8,))]
+    prompts = [base[:4] + [int(x) for x in rng.randint(50, 99, (k,))]
+               for k in (1, 3, 5, 8)] + [base, base[:6]]
+    active: dict[int, list[int]] = {}       # slot -> context
+    registered: dict[int, list[int]] = {}   # slot -> prompt it must register
+    for step in range(1000):
+        op = rng.rand()
+        if op < 0.35 and m.free_slot_count:
+            ctx = list(prompts[rng.randint(len(prompts))])
+            need = m.pages_needed(len(ctx))
+            if need <= m.available_page_count:
+                slot, cached = m.admit_prefix(ctx)
+                assert 0 <= cached <= len(ctx) - 1
+                active[slot] = ctx
+                registered[slot] = list(ctx)
+        elif op < 0.70 and active:
+            # feed a chunk: grow, CoW-guard the first write page, advance
+            slot = list(active)[rng.randint(len(active))]
+            written = m.seq_len(slot)
+            n = int(rng.randint(1, 5))
+            n = min(n, m.max_seq_len - written)
+            if n > 0 and m.ensure_capacity(slot, written + n):
+                cow = m.prepare_write(slot, written)
+                if cow is not None:
+                    src, dst = cow
+                    assert m._refcount[dst] == 1
+                # THE immutability invariant: every page the chunk writes
+                # now has exactly one reference
+                for ppos in range(written, written + n):
+                    page = int(m._page_table[slot, ppos // m.page_size])
+                    assert page >= 0
+                    assert m._refcount[page] == 1, \
+                        f"write into shared page {page} (step {step})"
+                m.advance(slot, n)
+                ctx = active[slot]
+                while len(ctx) < m.seq_len(slot):
+                    ctx.append(int(rng.randint(0, 99)))   # generated
+                if (slot in registered
+                        and m.seq_len(slot) >= len(registered[slot])):
+                    m.register_prefix(slot, registered.pop(slot))
+        elif active:
+            # preempt/finish: free the slot outright
+            slot = list(active)[rng.randint(len(active))]
+            m.free(slot)
+            del active[slot]
+            registered.pop(slot, None)
+        _check_invariants(m)
+    for slot in list(active):
+        m.free(slot)
+    _check_invariants(m)
+    assert m.available_page_count == m.num_pages  # zero pages leaked
+    assert m.prefix_hit_rate > 0.0                # the churn actually hit

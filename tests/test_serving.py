@@ -257,16 +257,224 @@ def test_request_done_logic():
     assert r2.done  # budget
 
 
+# -- round 9: unified step, prefix caching, fused sampling ------------------
+
+
+def test_unified_vs_legacy_token_for_token(rng):
+    """THE equivalence gate: the unified ragged step must reproduce the
+    round-7 two-jit path token-for-token on the same workload (greedy),
+    so the legacy path can be deleted in a later PR without losing the
+    oracle. Mixed prompt lengths exercise chunked prefill + decode packing
+    in the same steps."""
+    model = _tiny_model()
+    prompts = [rng.randint(0, TINY["vocab_size"], (n,)).tolist()
+               for n in (3, 19, 7, 1, 12)]
+    legacy = ServingPredictor(model, max_batch=3, max_seq_len=48,
+                              page_size=8, unified=False)
+    unified = ServingPredictor(model, max_batch=3, max_seq_len=48,
+                               page_size=8, unified=True, chunk=8)
+    want = legacy.generate(prompts, max_new_tokens=6)
+    got = unified.generate(prompts, max_new_tokens=6)
+    for p, w, g in zip(prompts, want, got):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+    # the unified path used ONE executable for everything; the legacy path
+    # needed its decode jit plus one prefill executable per bucket
+    assert unified.decode_trace_count == 1
+    assert unified.prefill_trace_count == 0
+    assert legacy.prefill_trace_count >= 1
+
+
+def test_unified_prefix_cache_hits_preserve_tokens(rng):
+    """A repeated prompt must serve from the prefix cache (hit rate up,
+    prefill work skipped) and still emit exactly the same greedy tokens."""
+    model = _tiny_model()
+    prompt = rng.randint(0, TINY["vocab_size"], (17,)).tolist()
+    sp = ServingPredictor(model, max_batch=2, max_seq_len=48, page_size=8,
+                          chunk=8)
+    first = sp.generate([prompt], max_new_tokens=5)[0]
+    assert sp.prefix_hit_rate == 0.0
+    second_req = sp.add_request(prompt, max_new_tokens=5)
+    while sp.has_work():
+        sp.step()
+    assert second_req.cached_prefix_len >= 16   # both full pages + tail
+    assert sp.prefix_hit_rate > 0.0
+    np.testing.assert_array_equal(np.asarray(second_req.output_ids),
+                                  np.asarray(first))
+
+
+def test_unified_shared_prefix_divergence_cow(rng):
+    """Two prompts sharing a long prefix: the second attaches the shared
+    pages and copy-on-writes at divergence — outputs must equal a
+    cache-disabled run for BOTH, and the first request's pages must not
+    be corrupted by the second's writes (they decode concurrently)."""
+    model = _tiny_model()
+    shared = rng.randint(0, TINY["vocab_size"], (12,)).tolist()
+    prompts = [shared + [1, 2], shared + [3, 4, 5]]
+    plain = ServingPredictor(model, max_batch=2, max_seq_len=48,
+                             page_size=8, prefix_cache=False, chunk=8)
+    want = plain.generate(prompts, max_new_tokens=6)
+    cached = ServingPredictor(model, max_batch=2, max_seq_len=48,
+                              page_size=8, chunk=8)
+    r0 = cached.add_request(prompts[0], max_new_tokens=6)
+    # finish r0 so its prompt registers, then run r1 + r0b CONCURRENTLY:
+    # r0b re-hits r0's pages while r1 CoWs off the shared prefix
+    while cached.has_work():
+        cached.step()
+    r1 = cached.add_request(prompts[1], max_new_tokens=6)
+    r0b = cached.add_request(prompts[0], max_new_tokens=6)
+    while cached.has_work():
+        cached.step()
+    np.testing.assert_array_equal(np.asarray(r0.output_ids),
+                                  np.asarray(want[0]))
+    np.testing.assert_array_equal(np.asarray(r1.output_ids),
+                                  np.asarray(want[1]))
+    np.testing.assert_array_equal(np.asarray(r0b.output_ids),
+                                  np.asarray(want[0]))
+    assert r1.cached_prefix_len >= 8    # the shared full page hit
+    assert r0b.cached_prefix_len >= 12
+
+
+def test_unified_two_cow_claims_one_free_page_preempts_not_crashes(rng):
+    """Two lanes hitting the same shared tail page both need copy-on-write
+    in one step with a single allocatable page left: the first claim must
+    RESERVE it and the second must fall into the preemption path — not
+    crash out of step() with a mid-prep pool-exhausted error."""
+    model = _tiny_model()
+    prompt = rng.randint(0, TINY["vocab_size"], (7,)).tolist()  # 2 pages
+    # register the prompt's pages (full page + 3-token partial tail)
+    sp = ServingPredictor(model, max_batch=2, max_seq_len=16, page_size=4,
+                          num_pages=3, chunk=4)
+    want = sp.generate([prompt], max_new_tokens=3)[0]
+    # both pages now parked on the LRU, registered. Admit TWO copies of
+    # the prompt: each matches both pages (2 shared + 1 free page left);
+    # both diverge into the shared tail page on their first feed step
+    r1 = sp.add_request(prompt, max_new_tokens=3)
+    r2 = sp.add_request(prompt, max_new_tokens=3)
+    while sp.has_work():
+        sp.step()   # must never raise
+    np.testing.assert_array_equal(np.asarray(r1.output_ids),
+                                  np.asarray(want))
+    np.testing.assert_array_equal(np.asarray(r2.output_ids),
+                                  np.asarray(want))
+    assert r2.preempt_count >= 1   # the loser of the last page backed off
+
+
+def test_unified_progressive_registration_hits_inflight_prefill(rng):
+    """Full prompt pages register as their chunks land, NOT only at prompt
+    completion: a same-prompt request arriving while the first is still
+    mid-prefill hits the already-written pages."""
+    model = _tiny_model()
+    prompt = rng.randint(0, TINY["vocab_size"], (33,)).tolist()
+    # chunk 8 + budget 8: the 33-token prompt needs 5 prefill rounds
+    sp = ServingPredictor(model, max_batch=2, max_seq_len=64, page_size=8,
+                          chunk=8, token_budget=8)
+    first = sp.add_request(prompt, max_new_tokens=4)
+    sp.step()   # admits + feeds the first 8-token chunk (page 1 full)
+    late = sp.add_request(prompt, max_new_tokens=4)
+    while sp.has_work():
+        sp.step()
+    assert late.cached_prefix_len >= 8   # hit the in-flight prefix
+    np.testing.assert_array_equal(np.asarray(late.output_ids),
+                                  np.asarray(first.output_ids))
+
+
+def test_unified_seeded_top_p_determinism(rng):
+    """Seeded temperature/top-k/top-p on the CPU interpret (kernel) path:
+    same seed -> identical streams, different seed -> different streams,
+    and temperature=0 lanes stay bit-identical to greedy."""
+    model = _tiny_model()
+    prompt = rng.randint(0, TINY["vocab_size"], (9,)).tolist()
+
+    def run(seed, temperature=0.8):
+        sp = ServingPredictor(model, max_batch=2, max_seq_len=48,
+                              page_size=8, chunk=8, use_kernel=True)
+        return sp.generate([prompt], max_new_tokens=8,
+                           temperature=temperature, top_p=0.9, top_k=40,
+                           seed=seed)[0]
+
+    a, b, c = run(123), run(123), run(321)
+    assert a == b                      # seeded: reproducible
+    assert a != c                      # seed actually flows
+    greedy = run(0, temperature=0.0)
+    ids = np.asarray([prompt], np.int64)
+    oracle = model.generate(paddle.to_tensor(ids), max_new_tokens=8,
+                            page_size=8, use_kernel=True).numpy()[0]
+    np.testing.assert_array_equal(np.asarray(greedy), oracle)
+
+
+def test_unified_sampling_survives_preemption_replay(rng):
+    """The per-request sample stream is keyed by tokens-produced, so a
+    preempted-and-replayed request samples the SAME continuation."""
+    model = _tiny_model()
+    prompts = [rng.randint(0, TINY["vocab_size"], (6,)).tolist()
+               for _ in range(3)]
+    roomy = ServingPredictor(model, max_batch=3, max_seq_len=24,
+                             page_size=8, chunk=8)
+    want = [roomy.generate([p], max_new_tokens=10, temperature=0.7,
+                           top_p=0.95, seed=77)[0] for p in prompts]
+    tight = ServingPredictor(model, max_batch=3, max_seq_len=24,
+                             page_size=8, num_pages=5, chunk=8)
+    reqs = [tight.add_request(p, max_new_tokens=10, temperature=0.7,
+                              top_p=0.95, seed=77) for p in prompts]
+    while tight.has_work():
+        tight.step()
+    assert sum(r.preempt_count for r in reqs) >= 1
+    for r, w in zip(reqs, want):
+        np.testing.assert_array_equal(np.asarray(r.output_ids),
+                                      np.asarray(w))
+
+
+def test_unified_no_head_of_line_blocking(rng):
+    """A long admitting prompt must NOT stall running decodes: with
+    chunked prefill the decode lane keeps producing every step while the
+    long prompt prefills over several chunks."""
+    model = _tiny_model()
+    sp = ServingPredictor(model, max_batch=2, max_seq_len=90, page_size=8,
+                          chunk=4, token_budget=6)
+    short = sp.add_request(rng.randint(0, TINY["vocab_size"],
+                                       (3,)).tolist(), max_new_tokens=30)
+    sp.step()   # short admitted + prefilled (3 <= chunk+budget)
+    while not short.output_ids:
+        sp.step()
+    long = sp.add_request(rng.randint(0, TINY["vocab_size"],
+                                      (40,)).tolist(), max_new_tokens=2)
+    stalls = 0
+    before = len(short.output_ids)
+    while not long.output_ids and sp.has_work():
+        produced = sp.step()
+        if short.req_id not in produced and short.state == RUNNING:
+            stalls += 1
+    # the 40-token prompt needs ceil(40/4) = 10 chunk rounds; the decode
+    # lane must have produced on every one of them
+    assert len(short.output_ids) - before >= 9
+    assert stalls == 0
+    while sp.has_work():
+        sp.step()
+    assert long.state == FINISHED and len(long.output_ids) == 2
+
+
+def test_unified_ttft_recorded(rng):
+    model = _tiny_model()
+    sp = ServingPredictor(model, max_batch=2, max_seq_len=48, page_size=8)
+    req = sp.add_request(rng.randint(0, TINY["vocab_size"], (5,)).tolist(),
+                         max_new_tokens=3)
+    assert req.ttft is None
+    while sp.has_work():
+        sp.step()
+    assert req.ttft is not None and req.ttft >= 0.0
+
+
 # -- bench_serve.py --smoke: the tier-1-adjacent CI leg ---------------------
 
 
 def test_bench_serve_smoke_schema():
     """bench_serve.py --smoke must run green on CPU and emit bench.py's
-    one-line JSON schema with the serving fields, flagship line last."""
+    one-line JSON schema with the round-9 serving fields (TTFT, prefix
+    hit rate, prefill/decode retrace gates), flagship unified line last."""
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     proc = subprocess.run(
-        [sys.executable, "bench_serve.py", "--smoke", "--steps=4",
-         "--batch=2", "--prompt=8"],
+        [sys.executable, "bench_serve.py", "--smoke", "--steps=6",
+         "--batch=2", "--prompt=8", "--gen-len=3"],
         cwd=root, capture_output=True, text=True, timeout=300,
         env={**os.environ, "JAX_PLATFORMS": "cpu"})
     assert proc.returncode == 0, proc.stderr[-2000:]
@@ -277,9 +485,22 @@ def test_bench_serve_smoke_schema():
         assert "error" not in rec, rec
         assert rec["unit"] == "tokens/s" and rec["value"] > 0
         assert rec["p50_ms"] > 0 and rec["p99_ms"] >= rec["p50_ms"]
+        assert rec["ttft_p50_ms"] > 0
+        assert rec["ttft_p99_ms"] >= rec["ttft_p50_ms"]
         assert rec["decode_retraces"] == 1  # the no-retrace gate
-        assert "vs_baseline" in rec
-    assert "[paged-kernel]" in json.loads(lines[-1])["metric"]
+        assert "vs_baseline" in rec and "prefix_hit_rate" in rec
+    legacy, unified = (json.loads(l) for l in lines)
+    assert "[legacy-two-jit]" in legacy["metric"]
+    assert "[unified-step]" in unified["metric"]   # flagship line LAST
+    # the retrace satellite gates: the legacy path's bucketed prefill
+    # compiles >= 1 executable (now visible); the unified step has NO
+    # prefill jit and exactly one executable for everything
+    assert legacy["prefill_retraces"] >= 1
+    assert unified["prefill_retraces"] == 0
+    # prefix caching only exists on the unified leg, and the churn
+    # workload (repeated prompts) must actually hit it
+    assert legacy["prefix_hit_rate"] == 0.0
+    assert unified["prefix_hit_rate"] > 0.0
 
 
 def test_predictor_tight_pool_serializes_instead_of_livelock(rng):
@@ -296,8 +517,9 @@ def test_predictor_tight_pool_serializes_instead_of_livelock(rng):
         want = model.generate(paddle.to_tensor(ids), max_new_tokens=5,
                               page_size=4).numpy()[0]
         np.testing.assert_array_equal(np.asarray(g), want)
-    # no page leaked into a parked slot's table across all the churn
-    assert sp.cache.free_page_count == 2
+    # no page leaked into a parked slot's table across all the churn —
+    # every page is free or parked on the prefix-cache LRU (evictable)
+    assert sp.cache.available_page_count == 2
     assert (np.asarray(sp.cache._page_table) == -1).all()
 
 

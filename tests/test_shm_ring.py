@@ -117,7 +117,10 @@ def test_dataloader_shared_memory_path():
 
 def test_ring_faster_than_pipe_for_large_payloads():
     """Sanity (not a strict perf gate): 4MB messages through the ring vs a
-    multiprocessing pipe queue, same process pair."""
+    multiprocessing pipe queue, same process pair. Best-of-3 trials per
+    side: a single trial's wall time is dominated by Process.start() and
+    flakes under CI load (the round-8 'shm-ring perf flake'), the best
+    trial is the medium-invariant number the bound is really about."""
     payload = os.urandom(4 << 20)
     N = 10
     ring = ShmRing(capacity=64 << 20)
@@ -128,27 +131,28 @@ def test_ring_faster_than_pipe_for_large_payloads():
             for _ in range(N):
                 ring.put_bytes(payload)
 
-        p = ctx.Process(target=ring_prod)
-        t0 = time.perf_counter()
-        p.start()
-        for _ in range(N):
-            ring.get_bytes(timeout=30)
-        ring_t = time.perf_counter() - t0
-        p.join()
-
-        q = ctx.Queue()
-
-        def q_prod():
+        def q_prod(q):
             for _ in range(N):
                 q.put(payload)
 
-        p2 = ctx.Process(target=q_prod)
-        t0 = time.perf_counter()
-        p2.start()
-        for _ in range(N):
-            q.get(timeout=30)
-        queue_t = time.perf_counter() - t0
-        p2.join()
+        ring_t = queue_t = float("inf")
+        for _ in range(3):
+            p = ctx.Process(target=ring_prod)
+            t0 = time.perf_counter()
+            p.start()
+            for _ in range(N):
+                ring.get_bytes(timeout=30)
+            ring_t = min(ring_t, time.perf_counter() - t0)
+            p.join()
+
+            q = ctx.Queue()
+            p2 = ctx.Process(target=q_prod, args=(q,))
+            t0 = time.perf_counter()
+            p2.start()
+            for _ in range(N):
+                q.get(timeout=30)
+            queue_t = min(queue_t, time.perf_counter() - t0)
+            p2.join()
         # the ring should never be an order of magnitude slower; typically
         # it wins on large payloads
         assert ring_t < queue_t * 3, (ring_t, queue_t)
