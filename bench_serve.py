@@ -1,11 +1,13 @@
-"""Serving benchmark: unified ragged serving step vs the legacy two-jit path.
+"""Serving benchmark: unified ragged serving step vs the legacy two-jit path,
+plus the round-10 quantized A/B legs (fp vs int8-weights vs
+int8-weights + int8-KV).
 
 The round-9 serving A/B, joining the bench trajectory next to bench.py's
 training lines. Drives the continuous-batching ServingPredictor through a
 two-wave workload (admit half the lanes, then admit the SAME prompts into
 the remaining lanes while the first wave decodes — the prefix-cache +
 chunked-prefill steady state) and emits ONE JSON line per leg (same
-schema/contract as bench.py — the flagship unified line LAST):
+schema/contract as bench.py — the flagship quantized line LAST):
 
 - ``value``/``unit``: decode tokens/sec/chip over the timed steady phase
 - ``vs_baseline``: unified-step speedup over the legacy round-7 two-jit
@@ -22,6 +24,10 @@ schema/contract as bench.py — the flagship unified line LAST):
 - ``prefill_retraces``: prefill executables compiled over the WHOLE leg —
   the bucketed-prefill compile count the two-jit split hides (one per
   prompt-length bucket); the unified step has no prefill jit: always 0
+- ``hbm_bytes_per_token``: analytic HBM bytes a steady-state decode token
+  reads (weights amortized over the batch + that token's KV context,
+  scale planes included) — the quantity the round-10 weight-only int8 /
+  int4 and int8-KV legs shrink (2-4x), decode being bandwidth-bound
 
 ``--smoke``: tiny CPU config — always runnable (CI leg, rc 0; gather
 reference attention keeps it fast, kernel parity is the test suite's
@@ -50,9 +56,28 @@ def _percentile(xs, q):
     return float(np.percentile(np.asarray(xs), q))
 
 
+def _hbm_bytes_per_token(sp, batch, avg_ctx):
+    """Analytic steady-state HBM read bytes per decode token: every weight
+    byte once per step (amortized over the batch's lanes) + the token's
+    own KV context (int8 pools count 1 byte/elt + their fp32 scale
+    planes)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.inference.quantize import serving_weight_bytes
+
+    cache = sp.cache
+    wb = serving_weight_bytes(sp.params) / max(batch, 1)
+    elt = jnp.dtype(cache.k_pages.dtype).itemsize
+    kv = (2 * cache.num_layers * avg_ctx
+          * cache.num_kv_heads * cache.head_dim * elt)
+    if cache.quantize_kv:
+        kv += 2 * cache.num_layers * avg_ctx * cache.num_kv_heads * 4
+    return int(wb + kv)
+
+
 def bench_serving(*, hidden, layers, heads, vocab, batch, prompt, steps,
                   gen_len, page_size, chunk, unified, use_kernel, on_tpu,
-                  dtype=None):
+                  dtype=None, weight_dtype=None, kv_cache_dtype=None):
     """One serving leg. Returns a dict of the emitted metrics.
 
     Workload: CONTINUOUS arrivals — ``batch`` concurrent requests drawn
@@ -73,7 +98,9 @@ def bench_serving(*, hidden, layers, heads, vocab, batch, prompt, steps,
     max_len = prompt + gen_len + 32
     paddle.seed(0)
     cfg = GPTConfig(vocab_size=vocab, hidden_size=hidden, num_layers=layers,
-                    num_heads=heads, max_seq_len=max_len)
+                    num_heads=heads, max_seq_len=max_len,
+                    weight_dtype=weight_dtype,
+                    kv_cache_dtype=kv_cache_dtype)
     model = GPTForCausalLM(cfg)
     model.eval()
     sp = ServingPredictor(
@@ -136,6 +163,8 @@ def bench_serving(*, hidden, layers, heads, vocab, batch, prompt, steps,
         prefix_hit_rate=round(sp.prefix_hit_rate, 3),
         decode_retraces=sp.decode_trace_count - decode_before + 1,
         prefill_retraces=sp.prefill_trace_count,
+        hbm_bytes_per_token=_hbm_bytes_per_token(
+            sp, batch, prompt + gen_len // 2),
     )
 
 
@@ -180,9 +209,18 @@ def main():
     runnable = on_tpu or smoke
     use_kernel = None if on_tpu else False
 
-    legs = [("legacy-two-jit", False), ("unified-step", True)]
+    # the round-10 quantized A/B: fp unified vs int8-weights vs
+    # int8-weights + int8-KV (each leg rebuilds the model from the same
+    # seed, so the quantizers see identical fp weights)
+    legs = [
+        ("legacy-two-jit", dict(unified=False)),
+        ("unified-step", dict(unified=True)),
+        ("unified-int8w", dict(unified=True, weight_dtype="int8")),
+        ("unified-int8w-int8kv", dict(unified=True, weight_dtype="int8",
+                                      kv_cache_dtype="int8")),
+    ]
     results = {}
-    for name, unified in legs:
+    for name, over in legs:
         metric = (f"{FLAGSHIP_METRIC} ({label} prompt{shape['prompt']}"
                   f"+{shape['steps']} steps, {chip}) [{name}]")
         if not runnable:
@@ -191,31 +229,37 @@ def main():
                 "--smoke for the interpret leg", metric=metric))
             continue
         try:
-            out = bench_serving(on_tpu=on_tpu, unified=unified,
-                                use_kernel=use_kernel, **shape)
-        except Exception as e:  # one failed leg must not kill the other
+            out = bench_serving(on_tpu=on_tpu, use_kernel=use_kernel,
+                                **shape, **over)
+        except Exception as e:  # one failed leg must not kill the others
             print(_error_line(f"{type(e).__name__}: {e}"[:200],
                               metric=metric))
             continue
         results[name] = dict(metric=metric, **out)
 
-    # flagship line LAST: the unified step, vs_baseline = speedup over the
-    # legacy two-jit path (ratio > 1 = the unified serving step wins)
+    # line order = leg order, flagship (quantized unified) LAST.
+    # vs_baseline: unified-step over the legacy two-jit path (the round-9
+    # contract), each quantized leg over the FP UNIFIED step (> 1 = the
+    # HBM bytes bought back turned into tokens/s)
     from paddle_tpu.analysis.bench_schema import checked_line
 
-    if "legacy-two-jit" in results:
-        ref = results["legacy-two-jit"]
-        ref["vs_baseline"] = 1.0
-        print(checked_line(ref))
-    if "unified-step" in results:
-        out = results["unified-step"]
-        if ("legacy-two-jit" in results
-                and results["legacy-two-jit"]["value"]):
+    def _emit(name, base):
+        if name not in results:
+            return
+        out = results[name]
+        if base is None:
+            out["vs_baseline"] = 1.0
+        elif base in results and results[base]["value"]:
             out["vs_baseline"] = round(
-                out["value"] / results["legacy-two-jit"]["value"], 3)
+                out["value"] / results[base]["value"], 3)
         else:
             out["vs_baseline"] = 0.0
         print(checked_line(out))
+
+    _emit("legacy-two-jit", None)
+    _emit("unified-step", "legacy-two-jit")
+    _emit("unified-int8w", "unified-step")
+    _emit("unified-int8w-int8kv", "unified-step")
 
 
 if __name__ == "__main__":

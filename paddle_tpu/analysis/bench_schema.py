@@ -35,7 +35,7 @@ _REQUIRED = {
 _OPTIONAL_NUMERIC = ("vs_baseline", "p50_ms", "p99_ms", "anchor_tflops",
                      "anchor_frac_peak", "ttft_p50_ms", "ttft_p99_ms",
                      "prefix_hit_rate", "decode_retraces",
-                     "prefill_retraces")
+                     "prefill_retraces", "hbm_bytes_per_token")
 
 
 def validate_line(obj) -> list[str]:

@@ -11,7 +11,11 @@ builds of exactly the programs that carry the repo's numbers:
                   donation of the KV page pools);
 - ``serving-unified``  the round-9 unified ragged prefill+decode step jit
                   (jaxpr walk + donation audit of the page pools —
-                  the ONE program the flagship serving path replays).
+                  the ONE program the flagship serving path replays);
+- ``serving-quant``  the round-10 quantized serving jits: int8-weight
+                  prefill/decode + the int8-weight/int8-KV unified step
+                  (jaxpr walk incl. the JX001 scale-promotion audit,
+                  donation of pools AND scale planes).
 
 Configs are tiny (seconds on CPU; the analysis is abstract — eval_shape /
 make_jaxpr, no FLOPs run) but structurally identical to the flagship
@@ -172,12 +176,96 @@ def analyze_serving_unified() -> list[Finding]:
     return findings
 
 
+def analyze_serving_quant() -> list[Finding]:
+    """Round-10 quantized serving: the int8-weight prefill/decode jits and
+    the int8-weight + int8-KV unified step. The jaxpr walk's JX001 leg is
+    the scale-promotion audit — per-group scales multiplying into the
+    compute must never widen it to f64 (and the donation audit covers the
+    int8 pools AND their scale planes)."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from ..inference.kv_cache import KVCacheManager
+    from ..inference.quantize import quantize_serving_params
+    from ..models.gpt import (GPTConfig, GPTForCausalLM, build_decode_step,
+                              build_prefill, build_unified_step,
+                              serving_params)
+
+    cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                    num_heads=2, max_seq_len=32)
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg)
+    params = quantize_serving_params(serving_params(model), "int8",
+                                     group_size=16)
+    page_size, chunk, b, s = 8, 4, 2, 8
+    budget = b + chunk
+    rng = np.random.RandomState(0)
+    findings: list[Finding] = []
+
+    # weight-quantized prefill + decode (fp KV pools)
+    mgr = KVCacheManager(cfg.num_layers, cfg.num_heads, cfg.head_dim,
+                         num_pages=2 * b * (cfg.max_seq_len // page_size),
+                         max_batch=b, max_seq_len=cfg.max_seq_len,
+                         page_size=page_size, dtype=jnp.float32)
+    ids2d = jnp.asarray(rng.randint(0, 128, (b, s)), jnp.int32)
+    lengths = jnp.full((b,), s, jnp.int32)
+    slots = [mgr.admit(s) for _ in range(b)]
+    pages = jnp.stack([mgr.slot_pages(sl) for sl in slots])
+    prefill = build_prefill(cfg, page_size)
+    pre_args = (params, ids2d, lengths, mgr.k_pages, mgr.v_pages, pages)
+    findings += analyze_jaxpr(trace_callable(prefill, *pre_args),
+                              "serving-quant-prefill")
+    findings += check_donation(prefill, pre_args, (3, 4),
+                               "serving-quant-prefill")
+    decode = build_decode_step(cfg, page_size)
+    dec_args = (params, jnp.zeros((b,), jnp.int32), lengths,
+                mgr.k_pages, mgr.v_pages, pages)
+    findings += analyze_jaxpr(trace_callable(decode, *dec_args),
+                              "serving-quant-decode")
+    findings += check_donation(decode, dec_args, (3, 4),
+                               "serving-quant-decode")
+
+    # int8-weight + int8-KV unified step (quantize-on-write + scale planes)
+    qmgr = KVCacheManager(cfg.num_layers, cfg.num_heads, cfg.head_dim,
+                          num_pages=2 * b * (cfg.max_seq_len // page_size),
+                          max_batch=b, max_seq_len=cfg.max_seq_len,
+                          page_size=page_size, dtype=jnp.float32,
+                          quantize_kv=True)
+    tok_ids = jnp.asarray(rng.randint(0, 128, (budget,)), jnp.int32)
+    tok_slot = jnp.asarray([0] + [1] * chunk + [-1] * (budget - 1 - chunk),
+                           jnp.int32)
+    tok_pos = jnp.asarray([0] + list(range(chunk))
+                          + [0] * (budget - 1 - chunk), jnp.int32)
+    q_lens = jnp.asarray([1, chunk], jnp.int32)
+    kv_lens = qmgr.seq_lens_device()
+    last_idx = jnp.asarray([0, chunk], jnp.int32)
+    no_cow = jnp.full((b,), qmgr.num_pages, jnp.int32)
+    keys = jnp.zeros((b, 2), jnp.uint32)
+    temp = jnp.asarray([0.0, 0.8], jnp.float32)
+    top_k = jnp.asarray([0, 40], jnp.int32)
+    top_p = jnp.asarray([1.0, 0.9], jnp.float32)
+    step = build_unified_step(cfg, page_size, chunk, kv_quant=True)
+    args = (params, tok_ids, tok_slot, tok_pos, q_lens, kv_lens, last_idx,
+            qmgr.k_pages, qmgr.v_pages, qmgr.k_scales, qmgr.v_scales,
+            qmgr.page_table_device(), no_cow, no_cow, keys, temp, top_k,
+            top_p)
+    findings += analyze_jaxpr(trace_callable(step, *args),
+                              "serving-quant-unified-step")
+    # pools AND scale planes donate; all four must alias outputs
+    findings += check_donation(step, args, (7, 8, 9, 10),
+                               "serving-quant-unified-step")
+    return findings
+
+
 TARGETS = {
     "gpt-eager": analyze_gpt_eager,
     "bert-eager": analyze_bert_eager,
     "gpt-spmd": analyze_gpt_spmd,
     "serving": analyze_serving,
     "serving-unified": analyze_serving_unified,
+    "serving-quant": analyze_serving_quant,
 }
 
 

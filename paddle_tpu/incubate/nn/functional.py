@@ -278,25 +278,43 @@ def paged_attention(q, k_pages, v_pages, page_table, seq_lens, scale=None,
 
 
 def ragged_paged_attention(q, k_pages, v_pages, page_table, kv_lens, q_lens,
-                           scale=None, use_kernel=None):
+                           scale=None, use_kernel=None, k_scales=None,
+                           v_scales=None):
     """Ragged prefill+decode attention over the block-paged KV cache (the
     round-9 unified serving step's kernel; Ragged Paged Attention, arxiv
     2604.15464). Each slot contributes ``q_lens`` (0..chunk) query tokens
     — ``q`` [b, chunk, num_q_heads, head_dim] right-padded — causal within
     its chunk, attending its whole paged context of ``kv_lens`` tokens
     (chunk included; its K/V must already be written). Rows past
-    ``q_lens`` are unspecified. Pallas kernel on TPU (``use_kernel=True``
-    forces interpret mode off-TPU), jnp gather reference elsewhere.
-    Decode-only: not differentiable."""
+    ``q_lens`` are unspecified. With ``k_scales``/``v_scales``
+    ([num_pages, page_size, kv_heads]) the page pools are int8 (round-10
+    quantized KV cache) and dequantize inside the kernel's page loop.
+    Pallas kernel on TPU (``use_kernel=True`` forces interpret mode
+    off-TPU), jnp gather reference elsewhere. Decode-only: not
+    differentiable."""
     from ...ops.pallas import paged_attention as _pa
 
-    def fn(q_, kp, vp, pt, kl, ql):
+    def fn(q_, kp, vp, pt, kl, ql, ks, vs):
         return _pa.ragged_paged_attention(q_, kp, vp, pt, kl, ql,
                                           scale=scale,
-                                          use_kernel=use_kernel)
+                                          use_kernel=use_kernel,
+                                          k_scales=ks, v_scales=vs)
 
     return apply_op("ragged_paged_attention", fn, q, k_pages, v_pages,
-                    page_table, kv_lens, q_lens)
+                    page_table, kv_lens, q_lens, k_scales, v_scales)
+
+
+def quant_matmul(x, qweight, scales, bias=None, use_kernel=None):
+    """Fused weight-only quantized GEMM (round-10 serving weight path):
+    ``y = x @ dequant(qweight) + bias`` with ``qweight`` int8 ``[in,
+    out]`` or nibble-packed int4 ``[in/2, out]`` staying quantized in HBM
+    and per-channel (``[out]``) / per-group (``[groups, out]``) scales
+    applied tile-by-tile inside the Pallas kernel. ``use_kernel`` as in
+    :func:`paged_attention`. (One implementation — this re-exports the
+    ``nn.quant`` op.)"""
+    from ...nn.quant import quant_matmul as _impl
+
+    return _impl(x, qweight, scales, bias=bias, use_kernel=use_kernel)
 
 
 def swiglu(x, y=None):
@@ -321,7 +339,7 @@ __all__ = [
     "fused_multi_head_attention", "masked_multihead_attention",
     "fused_multi_transformer", "fused_ec_moe", "fused_gate_attention",
     "block_multihead_attention", "paged_attention",
-    "ragged_paged_attention",
+    "ragged_paged_attention", "quant_matmul",
 ]
 
 

@@ -172,16 +172,21 @@ PlaceType = type("PlaceType", (), {"CPU": 0, "GPU": 1, "XPU": 2, "CUSTOM": 3})
 def __getattr__(name):
     # round-7 serving subsystem: lazy so importing paddle_tpu.inference for
     # the StableHLO Predictor never pulls the models package
-    if name in ("ServingPredictor", "Request", "KVCacheManager"):
+    lazy = {"ServingPredictor": ".serving", "Request": ".serving",
+            "KVCacheManager": ".kv_cache",
+            # round-10 quantized serving conversion
+            "quantize_serving_params": ".quantize",
+            "quantize_weight": ".quantize",
+            "serving_weight_bytes": ".quantize"}
+    if name in lazy:
         import importlib
 
-        mod = importlib.import_module(
-            ".kv_cache" if name == "KVCacheManager" else ".serving",
-            __name__)
-        return getattr(mod, name)
+        return getattr(importlib.import_module(lazy[name], __name__), name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 __all__ = ["Config", "Predictor", "Tensor_", "create_predictor",
            "get_version", "PrecisionType", "PlaceType",
-           "ServingPredictor", "Request", "KVCacheManager"]
+           "ServingPredictor", "Request", "KVCacheManager",
+           "quantize_serving_params", "quantize_weight",
+           "serving_weight_bytes"]

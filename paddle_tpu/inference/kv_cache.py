@@ -56,6 +56,21 @@ def pages_needed(length: int, page_size: int) -> int:
     return math.ceil(max(length, 1) / page_size)
 
 
+def kv_cache_quantized(kv_cache_dtype) -> bool:
+    """Map a ``kv_cache_dtype`` config value to the pool-quantization flag
+    — the ONE validation every consumer (generate_paged, ServingPredictor)
+    shares, so an unsupported value fails loudly instead of silently
+    serving a full-precision cache."""
+    if kv_cache_dtype in (None, "none"):
+        return False
+    if kv_cache_dtype == "int8":
+        return True
+    raise ValueError(
+        f"kv_cache_dtype must be None or 'int8', got {kv_cache_dtype!r} "
+        "(int4 KV is not supported — sub-byte pages would halve the "
+        "scatter granularity; weight_dtype='int4' is the 4x lever)")
+
+
 # ---------------------------------------------------------------------------
 # device-side pure scatter helpers (traced into the prefill/decode jits)
 # ---------------------------------------------------------------------------
@@ -94,6 +109,20 @@ def paged_write_prefill(pages, seq, pages_for_slot, length, page_size):
     return pages.at[pg, i % page_size].set(seq, mode="drop")
 
 
+def _packed_dest(page_table, tok_slot, tok_pos, page_size, num_pages):
+    """The packed-write scatter destination shared by the fp and quantized
+    writes: per-token (page, row) with padding (< 0 slot/pos) and
+    unallocated (-1) entries routed to the out-of-bounds ``num_pages``
+    sentinel (``mode="drop"``). Returns ``(pg, row)``."""
+    b = page_table.shape[0]
+    slot_c = jnp.clip(tok_slot, 0, b - 1)
+    pos = jnp.maximum(tok_pos, 0)
+    pg = page_table[slot_c,
+                    jnp.clip(pos // page_size, 0, page_table.shape[1] - 1)]
+    valid = (tok_slot >= 0) & (tok_pos >= 0) & (pg >= 0)
+    return jnp.where(valid, pg, num_pages), pos % page_size
+
+
 def paged_write_packed(pages, toks, page_table, tok_slot, tok_pos,
                        page_size):
     """Write a PACKED token stream into the page pool in one scatter (the
@@ -105,15 +134,34 @@ def paged_write_packed(pages, toks, page_table, tok_slot, tok_pos,
     tok_slot: [budget] int32 owning slot (< 0 = padding, dropped);
     tok_pos: [budget] int32 absolute write position. Returns the pool.
     """
-    num_pages = pages.shape[0]
-    b = page_table.shape[0]
-    slot_c = jnp.clip(tok_slot, 0, b - 1)
-    pos = jnp.maximum(tok_pos, 0)
-    pg = page_table[slot_c,
-                    jnp.clip(pos // page_size, 0, page_table.shape[1] - 1)]
-    valid = (tok_slot >= 0) & (tok_pos >= 0) & (pg >= 0)
-    pg = jnp.where(valid, pg, num_pages)             # invalid -> dropped
-    return pages.at[pg, pos % page_size].set(toks, mode="drop")
+    pg, row = _packed_dest(page_table, tok_slot, tok_pos, page_size,
+                           pages.shape[0])
+    return pages.at[pg, row].set(toks, mode="drop")
+
+
+def paged_write_packed_quant(pages, scales, toks, page_table, tok_slot,
+                             tok_pos, page_size):
+    """Quantize-on-write for the int8 KV cache: the packed write
+    (:func:`paged_write_packed`) with a per-token-per-head symmetric int8
+    quantization fused in front of the scatter.
+
+    pages: [num_pages, page_size, kv_heads, head_dim] **int8**; scales:
+    [num_pages, page_size, kv_heads] fp32 (the per-page scale plane — page
+    granularity keeps it travelling with the page through CoW copies,
+    prefix sharing and eviction); toks: [budget, kv_heads, head_dim] float.
+    Each token row quantizes against its own per-head absmax
+    (``scale = absmax / 127``), so pages never need rescaling as later
+    tokens land. Returns ``(pages, scales)``.
+    """
+    pg, row = _packed_dest(page_table, tok_slot, tok_pos, page_size,
+                           pages.shape[0])
+    tf = toks.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(tf), axis=-1)           # [budget, kv_heads]
+    s = jnp.maximum(absmax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(tf / s[..., None]), -127, 127).astype(jnp.int8)
+    pages = pages.at[pg, row].set(q, mode="drop")
+    scales = scales.at[pg, row].set(s.astype(scales.dtype), mode="drop")
+    return pages, scales
 
 
 def paged_copy_pages(pages, src, dst):
@@ -146,7 +194,8 @@ class KVCacheManager:
 
     def __init__(self, num_layers, num_kv_heads, head_dim, *, num_pages,
                  max_batch, max_seq_len, page_size=None, num_q_heads=None,
-                 dtype=jnp.float32, enable_prefix_cache=False):
+                 dtype=jnp.float32, enable_prefix_cache=False,
+                 quantize_kv=False):
         from ..ops.pallas.paged_attention import preferred_page_size
 
         if page_size is None:
@@ -162,8 +211,21 @@ class KVCacheManager:
         self.pages_per_slot = math.ceil(self.max_seq_len / self.page_size)
         shape = (num_layers, self.num_pages, self.page_size,
                  num_kv_heads, head_dim)
-        self.k_pages = jnp.zeros(shape, dtype)
-        self.v_pages = jnp.zeros(shape, dtype)
+        # int8 KV (round 10): pages store int8 with a per-page fp32 scale
+        # plane [L, P, page_size, kv_heads] — the scale travels WITH its
+        # page (CoW copies, prefix sharing, eviction all stay page-local).
+        # ``dtype`` remains the COMPUTE dtype (page-size autotune key).
+        self.quantize_kv = bool(quantize_kv)
+        pool_dtype = jnp.int8 if self.quantize_kv else dtype
+        self.k_pages = jnp.zeros(shape, pool_dtype)
+        self.v_pages = jnp.zeros(shape, pool_dtype)
+        if self.quantize_kv:
+            sshape = (num_layers, self.num_pages, self.page_size,
+                      num_kv_heads)
+            self.k_scales = jnp.zeros(sshape, jnp.float32)
+            self.v_scales = jnp.zeros(sshape, jnp.float32)
+        else:
+            self.k_scales = self.v_scales = None
         # host-side bookkeeping (numpy; uploaded per step as small arrays)
         self._page_table = np.full(
             (self.max_batch, self.pages_per_slot), -1, np.int32)
@@ -457,7 +519,13 @@ class KVCacheManager:
     def slot_pages(self, slot: int) -> jnp.ndarray:
         return jnp.asarray(self._page_table[slot])
 
-    def update_pages(self, k_pages, v_pages) -> None:
-        """Adopt the pools returned by a jitted prefill/decode step."""
+    def update_pages(self, k_pages, v_pages, k_scales=None,
+                     v_scales=None) -> None:
+        """Adopt the pools returned by a jitted prefill/decode step (scale
+        planes too on the int8-KV path)."""
         self.k_pages = k_pages
         self.v_pages = v_pages
+        if k_scales is not None:
+            self.k_scales = k_scales
+        if v_scales is not None:
+            self.v_scales = v_scales

@@ -1,0 +1,128 @@
+"""PTQ serving conversion — fp serving params -> weight-only quantized.
+
+The round-10 bridge between the training-side quantization surface
+(``paddle_tpu.quantization`` QuantConfig/PTQ, ``nn.quant.weight_quantize``)
+and the serving stack: :func:`quantize_serving_params` turns the pytree
+``models.gpt.serving_params`` extracts (or a loaded checkpoint restacked to
+that schema) into a QUANTIZED pytree the serving jits consume directly —
+each per-layer matmul weight stack ``[L, K, N]`` becomes
+``{"q": int8 [L, K, N] | packed int4 [L, K/2, N], "s": [L, G, N]}`` and
+the fused Pallas GEMM (``ops.pallas.quant_matmul``) dequantizes it
+tile-by-tile inside the kernel.
+
+What quantizes: the four decoder matmul weights (``wqkv``, ``wo``, ``w1``,
+``w2``) — the HBM traffic a decode step is bound on. What stays fp:
+biases, LayerNorm affines (tiny), the token/position embeddings and the
+LM head (the logits matmul is precision-critical and the embedding table
+doubles as a gather source). The per-tensor math routes through
+``nn.quant.weight_quantize`` — the reference's PTQ weight path — so the
+serving conversion and the QAT/PTQ drivers share one quantizer.
+
+Wired through ``GPTConfig.weight_dtype`` ("int8"/"int4") +
+``GPTConfig.weight_quant_group_size``: ``generate_paged`` and
+``ServingPredictor`` quantize at params-extraction time, so a GPT
+checkpoint serves quantized with a one-line config change.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+#: the per-layer stacks that quantize (the decode-bound matmul weights)
+QUANT_LAYER_KEYS = ("wqkv", "wo", "w1", "w2")
+
+
+def _algo(weight_dtype: str) -> str:
+    if weight_dtype not in ("int8", "int4"):
+        raise ValueError(
+            f"weight_dtype must be 'int8' or 'int4', got {weight_dtype!r}")
+    return f"weight_only_{weight_dtype}"
+
+
+def quantize_weight(w, weight_dtype="int8", group_size=-1):
+    """Quantize ONE ``[K, N]`` weight through the nn.quant PTQ surface.
+    Returns ``{"q": int8 [K, N] | packed [K/2, N], "s": [G, N]}`` (jnp
+    arrays — ready to ride a serving pytree)."""
+    from ..nn.quant import weight_quantize
+    from ..tensor.tensor import Tensor
+
+    t = w if isinstance(w, Tensor) else Tensor(jnp.asarray(w))
+    q, s = weight_quantize(t, algo=_algo(weight_dtype),
+                           group_size=group_size)
+    s2 = s._data
+    if s2.ndim == 1:
+        s2 = s2.reshape(1, -1)
+    return {"q": q._data, "s": s2.astype(jnp.float32)}
+
+
+def _quantize_stack(stack, weight_dtype, group_size):
+    """Quantize one ``[L, K, N]`` layer stack in a SINGLE batched pass:
+    ``jax.vmap`` of the nn.quant quantizer body over the layer axis (one
+    traced op per stack, not L eager dispatches + a restack)."""
+    import functools
+
+    import jax
+
+    from ..nn.quant import _qmax, _weight_quantize_fn
+
+    fn = functools.partial(
+        _weight_quantize_fn, qmax=_qmax(_algo(weight_dtype)),
+        int4=weight_dtype == "int4", group_size=group_size)
+    q, s = jax.vmap(fn)(stack)
+    if s.ndim == 2:                                # per-channel: [L, N]
+        s = s[:, None, :]
+    return {"q": q, "s": s.astype(jnp.float32)}
+
+
+def quantize_serving_params(params, weight_dtype="int8", group_size=-1,
+                            config=None):
+    """Quantize a serving-params pytree (``models.gpt.serving_params``
+    schema) for the fused weight-only GEMM path.
+
+    ``config``: optional :class:`paddle_tpu.quantization.QuantConfig`
+    whose ``add_name_config`` entries RESTRICT which layer stacks
+    quantize (names from :data:`QUANT_LAYER_KEYS`); None quantizes all
+    four. A config naming NONE of the serving keys raises — silently
+    quantizing everything would invert the requested restriction.
+    Returns a NEW pytree — fp leaves are shared, quantized stacks are
+    fresh device arrays.
+    """
+    _algo(weight_dtype)  # validate early
+    keys = set(QUANT_LAYER_KEYS)
+    if config is not None:
+        named = set(getattr(config, "_name_cfg", {}))
+        keys = named & keys
+        if not keys:
+            raise ValueError(
+                f"QuantConfig names {sorted(named)} match no serving "
+                f"layer stack — restrict with names from "
+                f"{sorted(QUANT_LAYER_KEYS)}")
+    out = dict(params)
+    layers = dict(params["layers"])
+    for key in sorted(keys):
+        layers[key] = _quantize_stack(layers[key], weight_dtype, group_size)
+    out["layers"] = layers
+    return out
+
+
+def is_quantized_params(params) -> bool:
+    """Whether a serving pytree carries quantized weight stacks."""
+    return any(isinstance(params["layers"].get(k), dict)
+               for k in QUANT_LAYER_KEYS)
+
+
+def serving_weight_bytes(params) -> int:
+    """HBM bytes a decode step reads in WEIGHTS (per token batch): every
+    per-layer stack leaf + the non-layer leaves — the quantity weight-only
+    quantization shrinks (the bench's hbm-bytes-per-token numerator)."""
+    total = 0
+
+    def visit(leaf):
+        nonlocal total
+        if isinstance(leaf, dict):
+            for v in leaf.values():
+                visit(v)
+        elif hasattr(leaf, "dtype"):
+            total += leaf.size * jnp.dtype(leaf.dtype).itemsize
+
+    visit(params)
+    return int(total)

@@ -45,7 +45,7 @@ import numpy as np
 
 import jax.numpy as jnp
 
-from .kv_cache import KVCacheManager, pages_needed
+from .kv_cache import KVCacheManager, kv_cache_quantized, pages_needed
 
 WAITING, RUNNING, FINISHED = "waiting", "running", "finished"
 
@@ -116,7 +116,7 @@ class ServingPredictor:
     def __init__(self, model, *, max_batch=8, num_pages=None, page_size=None,
                  max_seq_len=None, use_kernel=None, prefill_bucket=16,
                  dtype=None, unified=True, chunk=None, token_budget=None,
-                 prefix_cache=None):
+                 prefix_cache=None, kv_cache_dtype=None):
         from ..models.gpt import (_serving_params_cached, build_decode_step,
                                   build_prefill, build_unified_step,
                                   serving_params)
@@ -127,18 +127,31 @@ class ServingPredictor:
         if dtype is None:
             # share the weak-keyed extraction with generate() — a second
             # predictor (or generate call) on one model reuses the stacks
+            # (quantized per cfg.weight_dtype inside the cache)
             self.params = _serving_params_cached(model)
         else:
             import jax
 
             self.params = jax.tree.map(lambda a: a.astype(dtype),
                                        serving_params(model))
+            if cfg.weight_dtype is not None:
+                from .quantize import quantize_serving_params
+
+                self.params = quantize_serving_params(
+                    self.params, cfg.weight_dtype,
+                    cfg.weight_quant_group_size)
         # the model's position table bounds every context
         self.max_seq_len = min(int(max_seq_len or cfg.max_seq_len),
                                cfg.max_seq_len)
         self.max_batch = int(max_batch)
         self.prefill_bucket = int(prefill_bucket)
         self.unified = bool(unified)
+        self.kv_quant = kv_cache_quantized(kv_cache_dtype
+                                           or cfg.kv_cache_dtype)
+        if self.kv_quant and not self.unified:
+            raise ValueError(
+                "int8 KV cache rides the unified step's quantize-on-write "
+                "lanes; the legacy two-jit path serves fp only")
         kv_dtype = self.params["tok_emb"].dtype
         from ..ops.pallas.paged_attention import (preferred_chunk_size,
                                                   preferred_page_size)
@@ -155,7 +168,7 @@ class ServingPredictor:
             num_pages=num_pages, max_batch=self.max_batch,
             max_seq_len=self.max_seq_len, page_size=page_size,
             num_q_heads=cfg.num_heads, dtype=kv_dtype,
-            enable_prefix_cache=prefix_cache)
+            enable_prefix_cache=prefix_cache, quantize_kv=self.kv_quant)
         self.chunk = int(chunk or preferred_chunk_size(
             cfg.num_heads, cfg.num_heads, cfg.head_dim, kv_dtype))
         self.token_budget = int(token_budget or
@@ -163,7 +176,7 @@ class ServingPredictor:
         if self.unified:
             self._unified = build_unified_step(
                 cfg, self.cache.page_size, self.chunk,
-                use_kernel=use_kernel)
+                use_kernel=use_kernel, kv_quant=self.kv_quant)
             self._prefill = self._decode = None
         else:
             self._unified = None
@@ -438,14 +451,21 @@ class ServingPredictor:
                     keys[slot] = np.asarray(jax.random.fold_in(
                         jnp.asarray(self._req_key(req)),
                         len(req.output_ids)), np.uint32)
-        next_ids, _, kp, vp = self._unified(
-            self.params, jnp.asarray(tok_ids), jnp.asarray(tok_slot),
-            jnp.asarray(tok_pos), jnp.asarray(q_lens),
-            cache.seq_lens_device(), jnp.asarray(last_idx),
-            cache.k_pages, cache.v_pages, cache.page_table_device(),
-            jnp.asarray(cow_src), jnp.asarray(cow_dst), jnp.asarray(keys),
-            jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(top_p))
-        cache.update_pages(kp, vp)
+        head = (self.params, jnp.asarray(tok_ids), jnp.asarray(tok_slot),
+                jnp.asarray(tok_pos), jnp.asarray(q_lens),
+                cache.seq_lens_device(), jnp.asarray(last_idx))
+        tail = (cache.page_table_device(), jnp.asarray(cow_src),
+                jnp.asarray(cow_dst), jnp.asarray(keys), jnp.asarray(temp),
+                jnp.asarray(top_k), jnp.asarray(top_p))
+        if self.kv_quant:
+            next_ids, _, kp, vp, ks, vs = self._unified(
+                *head, cache.k_pages, cache.v_pages, cache.k_scales,
+                cache.v_scales, *tail)
+            cache.update_pages(kp, vp, ks, vs)
+        else:
+            next_ids, _, kp, vp = self._unified(
+                *head, cache.k_pages, cache.v_pages, *tail)
+            cache.update_pages(kp, vp)
         self.steps += 1
         for slot, n in sched.items():
             cache.advance(slot, n)

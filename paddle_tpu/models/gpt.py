@@ -69,6 +69,16 @@ class GPTConfig:
     # {"attn", "mlp", "ce"} ("ce" keeps the lm-head matmul, drops the
     # softmax-CE math)
     ablate: tuple = ()
+    # round-10 quantized serving: "int8"/"int4" quantizes the decoder
+    # matmul weight stacks at serving-params extraction (fused weight-only
+    # Pallas GEMM keeps them quantized in HBM); None serves fp. Group size
+    # -1 = per-output-channel scales, > 0 = per-group along the in-dim.
+    weight_dtype: str | None = None
+    weight_quant_group_size: int = -1
+    # "int8" stores the paged KV cache int8 with per-(page-slot, head)
+    # scales: quantize-on-write in the unified step, dequant fused in the
+    # ragged attention kernel. None keeps the compute-dtype pools.
+    kv_cache_dtype: str | None = None
 
     @property
     def ffn_size(self) -> int:
@@ -458,14 +468,30 @@ def _srv_logits(params, h):
     return jnp.einsum("...h,vh->...v", h, params["tok_emb"])
 
 
-def _srv_mlp(p, y):
+def _srv_mm(y, w, use_kernel=None):
+    """The serving matmul: fp weights ride the plain dot; quantized stacks
+    (``{"q": int8|packed-int4, "s": scales}`` — see inference/quantize.py)
+    ride the fused weight-only Pallas GEMM, staying quantized in HBM.
+    ``use_kernel`` follows the paged-attention contract (None = kernel on
+    TPU / jnp oracle elsewhere; True forces interpret mode — CPU tests;
+    False forces the dequant-matmul reference)."""
+    if isinstance(w, dict):
+        from ..ops.pallas.quant_matmul import quant_matmul
+
+        return quant_matmul(y, w["q"], w["s"], use_kernel=use_kernel)
+    return y @ w
+
+
+def _srv_mlp(p, y, use_kernel=None):
     import jax
 
-    return (jax.nn.gelu(y @ p["w1"] + p["b1"], approximate=True)
-            @ p["w2"] + p["b2"])
+    return (_srv_mm(jax.nn.gelu(_srv_mm(y, p["w1"], use_kernel) + p["b1"],
+                                approximate=True), p["w2"], use_kernel)
+            + p["b2"])
 
 
-def build_prefill(config: GPTConfig, page_size: int):
+def build_prefill(config: GPTConfig, page_size: int,
+                  use_kernel: bool | None = None):
     """One-jit prefill: forward the (right-padded) prompts, scatter each
     slot's K/V into its pages, return the next-token ids + logits at each
     prompt's last valid position.
@@ -502,7 +528,8 @@ def build_prefill(config: GPTConfig, page_size: int):
 
         def block(x, p):
             y = _srv_ln(x, p["ln1_g"], p["ln1_b"], eps)
-            qkv = (y @ p["wqkv"] + p["bqkv"]).reshape(b, s, 3, nh, hd)
+            qkv = (_srv_mm(y, p["wqkv"], use_kernel)
+                   + p["bqkv"]).reshape(b, s, 3, nh, hd)
             q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
             s_ = jnp.einsum("bqnd,bknd->bnqk", q.astype(jnp.float32),
                             k.astype(jnp.float32)) / math.sqrt(hd)
@@ -511,8 +538,10 @@ def build_prefill(config: GPTConfig, page_size: int):
             a = jnp.einsum("bnqk,bknd->bqnd",
                            jax.nn.softmax(s_, axis=-1),
                            v.astype(jnp.float32)).astype(x.dtype)
-            x = x + a.reshape(b, s, nh * hd) @ p["wo"] + p["bo"]
-            x = x + _srv_mlp(p, _srv_ln(x, p["ln2_g"], p["ln2_b"], eps))
+            x = x + _srv_mm(a.reshape(b, s, nh * hd), p["wo"],
+                            use_kernel) + p["bo"]
+            x = x + _srv_mlp(p, _srv_ln(x, p["ln2_g"], p["ln2_b"], eps),
+                             use_kernel)
             return x, (k, v)
 
         x, (ks, vs) = jax.lax.scan(block, x, params["layers"])
@@ -588,14 +617,17 @@ def build_decode_step(config: GPTConfig, page_size: int,
         def block(x, layer):
             p, kp, vp = layer
             y = _srv_ln(x, p["ln1_g"], p["ln1_b"], eps)
-            qkv = (y @ p["wqkv"] + p["bqkv"]).reshape(b, 3, nh, hd)
+            qkv = (_srv_mm(y, p["wqkv"], use_kernel)
+                   + p["bqkv"]).reshape(b, 3, nh, hd)
             q, k_tok, v_tok = qkv[:, 0], qkv[:, 1], qkv[:, 2]
             kp = paged_write_tokens(kp, k_tok, page_table, pos, page_size)
             vp = paged_write_tokens(vp, v_tok, page_table, pos, page_size)
             a = paged_attention(q, kp, vp, page_table, ctx,
                                 use_kernel=use_kernel)  # [b, nh, hd]
-            x = x + a.reshape(b, nh * hd) @ p["wo"] + p["bo"]
-            x = x + _srv_mlp(p, _srv_ln(x, p["ln2_g"], p["ln2_b"], eps))
+            x = x + _srv_mm(a.reshape(b, nh * hd), p["wo"],
+                            use_kernel) + p["bo"]
+            x = x + _srv_mlp(p, _srv_ln(x, p["ln2_g"], p["ln2_b"], eps),
+                             use_kernel)
             return x, (kp, vp)
 
         x, (k_pages, v_pages) = jax.lax.scan(
@@ -648,7 +680,8 @@ def _sample_epilogue(logits, keys, temperature, top_k, top_p):
 
 
 def build_unified_step(config: GPTConfig, page_size: int, chunk: int,
-                       use_kernel: bool | None = None):
+                       use_kernel: bool | None = None,
+                       kv_quant: bool = False):
     """ONE fixed-shape serving step for mixed ragged prefill + decode,
     driven by a per-step TOKEN BUDGET.
 
@@ -684,11 +717,25 @@ def build_unified_step(config: GPTConfig, page_size: int, chunk: int,
     step, bit-identical; sampling lanes run the fused seeded epilogue.
     Every array argument keeps its shape step over step: one trace, one
     executable (``fn.trace_count[0]`` is the gate).
+
+    ``kv_quant=True`` (round 10) stores the page pools int8: the signature
+    gains ``k_scales``/``v_scales`` (the per-(page-slot, head) fp32 scale
+    planes, donated alongside the pools and returned updated), K/V
+    quantize on write inside the step (per-token-per-head symmetric) and
+    dequantize inside the ragged attention kernel — pages stay int8
+    end-to-end, composing with CoW (the copy lanes duplicate scale planes
+    too) and prefix caching (a shared page's scales travel with it)::
+
+        fn(params, tok_ids, tok_slot, tok_pos, q_lens, kv_lens, last_idx,
+           k_pages, v_pages, k_scales, v_scales, page_table, cow_src,
+           cow_dst, keys, temperature, top_k, top_p)
+        -> (next_ids, logits, k_pages, v_pages, k_scales, v_scales)
     """
     import jax
     import jax.numpy as jnp
 
-    from ..inference.kv_cache import paged_copy_pages, paged_write_packed
+    from ..inference.kv_cache import (paged_copy_pages, paged_write_packed,
+                                      paged_write_packed_quant)
     from ..ops.pallas.paged_attention import ragged_paged_attention
 
     cfg = config
@@ -701,21 +748,36 @@ def build_unified_step(config: GPTConfig, page_size: int, chunk: int,
         # MXU-native matmul precision — see build_prefill
         with jax.default_matmul_precision("default"):
             return _step_inner(params, tok_ids, tok_slot, tok_pos, q_lens,
-                               kv_lens, last_idx, k_pages, v_pages,
-                               page_table, cow_src, cow_dst, keys,
+                               kv_lens, last_idx, k_pages, v_pages, None,
+                               None, page_table, cow_src, cow_dst, keys,
                                temperature, top_k, top_p)
 
+    def step_quant(params, tok_ids, tok_slot, tok_pos, q_lens, kv_lens,
+                   last_idx, k_pages, v_pages, k_scales, v_scales,
+                   page_table, cow_src, cow_dst, keys, temperature, top_k,
+                   top_p):
+        with jax.default_matmul_precision("default"):
+            return _step_inner(params, tok_ids, tok_slot, tok_pos, q_lens,
+                               kv_lens, last_idx, k_pages, v_pages,
+                               k_scales, v_scales, page_table, cow_src,
+                               cow_dst, keys, temperature, top_k, top_p)
+
     def _step_inner(params, tok_ids, tok_slot, tok_pos, q_lens, kv_lens,
-                    last_idx, k_pages, v_pages, page_table, cow_src,
-                    cow_dst, keys, temperature, top_k, top_p):
+                    last_idx, k_pages, v_pages, k_scales, v_scales,
+                    page_table, cow_src, cow_dst, keys, temperature, top_k,
+                    top_p):
         trace_count[0] += 1
         t = tok_ids.shape[0]
         b = q_lens.shape[0]
         nh, hd = cfg.num_heads, cfg.head_dim
         # copy-on-write BEFORE any write: diverging lanes get a private
-        # copy of their shared tail page across every layer
+        # copy of their shared tail page across every layer (scale planes
+        # are page-keyed, so they ride the same copy lanes)
         k_pages = paged_copy_pages(k_pages, cow_src, cow_dst)
         v_pages = paged_copy_pages(v_pages, cow_src, cow_dst)
+        if kv_quant:
+            k_scales = paged_copy_pages(k_scales, cow_src, cow_dst)
+            v_scales = paged_copy_pages(v_scales, cow_src, cow_dst)
         x = (jnp.take(params["tok_emb"], jnp.maximum(tok_ids, 0), axis=0)
              + params["pos_emb"][
                  jnp.clip(tok_pos, 0, params["pos_emb"].shape[0] - 1)])
@@ -729,25 +791,44 @@ def build_unified_step(config: GPTConfig, page_size: int, chunk: int,
         scatter_b = jnp.where(valid, tok_slot, b)    # b = dropped row
 
         def block(x, layer):
-            p, kp, vp = layer
+            if kv_quant:
+                p, kp, vp, ks, vs = layer
+            else:
+                p, kp, vp = layer
+                ks = vs = None
             y = _srv_ln(x, p["ln1_g"], p["ln1_b"], eps)
-            qkv = (y @ p["wqkv"] + p["bqkv"]).reshape(t, 3, nh, hd)
+            qkv = (_srv_mm(y, p["wqkv"], use_kernel)
+                   + p["bqkv"]).reshape(t, 3, nh, hd)
             q, k_t, v_t = qkv[:, 0], qkv[:, 1], qkv[:, 2]
-            kp = paged_write_packed(kp, k_t, page_table, tok_slot, tok_pos,
-                                    page_size)
-            vp = paged_write_packed(vp, v_t, page_table, tok_slot, tok_pos,
-                                    page_size)
+            if kv_quant:
+                kp, ks = paged_write_packed_quant(
+                    kp, ks, k_t, page_table, tok_slot, tok_pos, page_size)
+                vp, vs = paged_write_packed_quant(
+                    vp, vs, v_t, page_table, tok_slot, tok_pos, page_size)
+            else:
+                kp = paged_write_packed(kp, k_t, page_table, tok_slot,
+                                        tok_pos, page_size)
+                vp = paged_write_packed(vp, v_t, page_table, tok_slot,
+                                        tok_pos, page_size)
             qb = jnp.zeros((b, chunk, nh, hd), q.dtype
                            ).at[scatter_b, off_c].set(q, mode="drop")
             ab = ragged_paged_attention(qb, kp, vp, page_table, ctx, q_lens,
-                                        use_kernel=use_kernel)
+                                        use_kernel=use_kernel,
+                                        k_scales=ks, v_scales=vs)
             a = ab[slot_c, off_c]                    # back to packed [t]
-            x = x + a.reshape(t, nh * hd) @ p["wo"] + p["bo"]
-            x = x + _srv_mlp(p, _srv_ln(x, p["ln2_g"], p["ln2_b"], eps))
-            return x, (kp, vp)
+            x = x + _srv_mm(a.reshape(t, nh * hd), p["wo"],
+                            use_kernel) + p["bo"]
+            x = x + _srv_mlp(p, _srv_ln(x, p["ln2_g"], p["ln2_b"], eps),
+                             use_kernel)
+            return x, ((kp, vp, ks, vs) if kv_quant else (kp, vp))
 
-        x, (k_pages, v_pages) = jax.lax.scan(
-            block, x, (params["layers"], k_pages, v_pages))
+        if kv_quant:
+            x, (k_pages, v_pages, k_scales, v_scales) = jax.lax.scan(
+                block, x, (params["layers"], k_pages, v_pages, k_scales,
+                           v_scales))
+        else:
+            x, (k_pages, v_pages) = jax.lax.scan(
+                block, x, (params["layers"], k_pages, v_pages))
         x = _srv_ln(x, params["lnf_g"], params["lnf_b"], eps)
         # each slot's LAST packed token yields its next-token decision
         h_last = x[jnp.clip(last_idx, 0, t - 1)]                  # [b, h]
@@ -762,9 +843,14 @@ def build_unified_step(config: GPTConfig, page_size: int, chunk: int,
                                      top_p),
             lambda: greedy)
         next_ids = jnp.where(temperature > 0.0, sampled, greedy)
+        if kv_quant:
+            return (next_ids, logits, k_pages, v_pages, k_scales, v_scales)
         return next_ids, logits, k_pages, v_pages
 
-    jitted = jax.jit(step, donate_argnums=(7, 8))
+    if kv_quant:
+        jitted = jax.jit(step_quant, donate_argnums=(7, 8, 9, 10))
+    else:
+        jitted = jax.jit(step, donate_argnums=(7, 8))
     jitted.trace_count = trace_count
     return jitted
 
@@ -784,21 +870,37 @@ import weakref as _weakref  # noqa: E402
 _SERVING_PARAMS_CACHE = _weakref.WeakKeyDictionary()
 
 
+def _quant_sig(cfg: GPTConfig):
+    """The config fields that change what _serving_params_cached extracts
+    (a flipped weight_dtype must invalidate the cached fp pytree even
+    though the underlying buffers are unchanged)."""
+    return (getattr(cfg, "weight_dtype", None),
+            getattr(cfg, "weight_quant_group_size", -1))
+
+
 def _serving_params_cached(model):
     # staleness check by buffer IDENTITY against WEAKLY-held capture-time
     # buffers: identity comparison is immune to CPython id reuse, and the
     # weakrefs mean an optimizer step's rebinding doesn't leave ~1x model
     # weights of dead buffers pinned by the cache key (a dead ref simply
     # reads as stale)
+    cfg = (model.gpt if hasattr(model, "gpt") else model).config
+    qsig = _quant_sig(cfg)
     bufs = _serving_weight_buffers(model)
     hit = _SERVING_PARAMS_CACHE.get(model)
     if (hit is not None and len(hit[0]) == len(bufs)
+            and hit[2] == qsig
             and all(ref() is cur for ref, cur in zip(hit[0], bufs))):
         return hit[1]
     params = serving_params(model)
+    if cfg.weight_dtype is not None:
+        from ..inference.quantize import quantize_serving_params
+
+        params = quantize_serving_params(
+            params, cfg.weight_dtype, cfg.weight_quant_group_size)
     try:
         _SERVING_PARAMS_CACHE[model] = (
-            [_weakref.ref(b) for b in bufs], params)
+            [_weakref.ref(b) for b in bufs], params, qsig)
     except TypeError:
         pass  # un-weakrefable model object: just skip the cache
     return params
@@ -828,16 +930,20 @@ def _cfg_key(config: GPTConfig):
 def _serving_fns(config: GPTConfig, page_size: int, use_kernel):
     return _jit_cache_get(
         ("legacy", _cfg_key(config), page_size, use_kernel),
-        lambda: (build_prefill(config, page_size),
+        lambda: (build_prefill(config, page_size,
+                               use_kernel=use_kernel),
                  build_decode_step(config, page_size,
                                    use_kernel=use_kernel)))
 
 
-def _unified_fn(config: GPTConfig, page_size: int, chunk: int, use_kernel):
+def _unified_fn(config: GPTConfig, page_size: int, chunk: int, use_kernel,
+                kv_quant=False):
     return _jit_cache_get(
-        ("unified", _cfg_key(config), page_size, chunk, use_kernel),
+        ("unified", _cfg_key(config), page_size, chunk, use_kernel,
+         kv_quant),
         lambda: build_unified_step(config, page_size, chunk,
-                                   use_kernel=use_kernel))
+                                   use_kernel=use_kernel,
+                                   kv_quant=kv_quant))
 
 
 def generate_paged(model, input_ids, max_new_tokens=20, *, page_size=None,
@@ -856,13 +962,21 @@ def generate_paged(model, input_ids, max_new_tokens=20, *, page_size=None,
     temperature/top-k/top-p epilogue (``seed`` makes it reproducible).
     With ``eos_token_id``, a row that stops early frees its cache pages,
     its lane goes inert, and its remaining columns pad with the eos id.
+
+    Round 10: ``config.weight_dtype`` ("int8"/"int4") serves the decoder
+    matmuls through the fused weight-only Pallas GEMM (weights stay
+    quantized in HBM), and ``config.kv_cache_dtype == "int8"`` stores the
+    page pools int8 with quantize-on-write + in-kernel dequant — greedy
+    decoding then matches the fp oracle to within quantization noise
+    (>= 99% of tokens in the smoke config) rather than bit-exactly.
     """
     import numpy as np
 
     import jax
     import jax.numpy as jnp
 
-    from ..inference.kv_cache import KVCacheManager, pages_needed
+    from ..inference.kv_cache import (KVCacheManager, kv_cache_quantized,
+                                      pages_needed)
     from ..tensor.tensor import Tensor
 
     cfg = (model.gpt if hasattr(model, "gpt") else model).config
@@ -891,17 +1005,20 @@ def generate_paged(model, input_ids, max_new_tokens=20, *, page_size=None,
         if chunk is None:
             chunk = preferred_chunk_size(cfg.num_heads, cfg.num_heads,
                                          cfg.head_dim, dtype)
+    kv_quant = kv_cache_quantized(cfg.kv_cache_dtype)
     mgr = KVCacheManager(
         cfg.num_layers, cfg.num_heads, cfg.head_dim,
         num_pages=num_pages or b * pages_needed(total, page_size),
-        max_batch=b, max_seq_len=total, page_size=page_size, dtype=dtype)
+        max_batch=b, max_seq_len=total, page_size=page_size, dtype=dtype,
+        quantize_kv=kv_quant)
     contexts = [[int(t) for t in row] for row in ids_np]
     slots: list = []
     for ctx in contexts:
         slot, _ = mgr.admit_prefix(ctx)   # no prefix sharing here: the
         slots.append(slot)                # ServingPredictor owns that path
 
-    step = _unified_fn(cfg, mgr.page_size, int(chunk), use_kernel)
+    step = _unified_fn(cfg, mgr.page_size, int(chunk), use_kernel,
+                       kv_quant=kv_quant)
     traces_at_entry = step.trace_count[0]
     chunk = int(chunk)
     # token budget: every row can feed a full chunk each round (generate
@@ -958,14 +1075,20 @@ def generate_paged(model, input_ids, max_new_tokens=20, *, page_size=None,
                 for i in range(b)])
         else:
             keys = zero_keys
-        next_ids, _, kp, vp = step(
-            params, jnp.asarray(tok_ids), jnp.asarray(tok_slot),
-            jnp.asarray(tok_pos), jnp.asarray(q_lens),
-            mgr.seq_lens_device(), jnp.asarray(last_idx),
-            mgr.k_pages, mgr.v_pages, mgr.page_table_device(),
-            no_cow, no_cow, jnp.asarray(keys),
-            temp_arr, topk_arr, topp_arr)
-        mgr.update_pages(kp, vp)
+        packed = (params, jnp.asarray(tok_ids), jnp.asarray(tok_slot),
+                  jnp.asarray(tok_pos), jnp.asarray(q_lens),
+                  mgr.seq_lens_device(), jnp.asarray(last_idx))
+        tail = (mgr.page_table_device(), no_cow, no_cow,
+                jnp.asarray(keys), temp_arr, topk_arr, topp_arr)
+        if kv_quant:
+            next_ids, _, kp, vp, ks, vs = step(
+                *packed, mgr.k_pages, mgr.v_pages, mgr.k_scales,
+                mgr.v_scales, *tail)
+            mgr.update_pages(kp, vp, ks, vs)
+        else:
+            next_ids, _, kp, vp = step(*packed, mgr.k_pages, mgr.v_pages,
+                                       *tail)
+            mgr.update_pages(kp, vp)
         step_no += 1
         toks = None
         produced = False

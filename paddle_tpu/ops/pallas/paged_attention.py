@@ -253,7 +253,17 @@ def paged_attention(q, k_pages, v_pages, page_table, lengths, scale=None,
 
 
 def _ragged_kernel(lens_ref, qlens_ref, pt_ref, q_ref, k_ref, v_ref,
-                   o_ref, m_ref, l_ref, *, page_size, group, scale):
+                   *refs, page_size, group, scale, quant=False):
+    """``quant=False``: refs = (o, m, l) and K/V tiles arrive in the
+    compute dtype. ``quant=True`` (round-10 int8 KV): refs = (ks, vs, o,
+    m, l) — the page tiles arrive int8 with their per-(slot, head) scale
+    columns ([page_size, 1] blocks of the scale plane) and dequantize in
+    VMEM on the way into the two dots; the online-softmax recurrence is
+    IDENTICAL (one body, so the paths cannot drift)."""
+    if quant:
+        ks_ref, vs_ref, o_ref, m_ref, l_ref = refs
+    else:
+        o_ref, m_ref, l_ref = refs
     b = pl.program_id(0)
     j = pl.program_id(2)
     kv_len = lens_ref[b]     # context INCLUDING this chunk's tokens
@@ -270,6 +280,9 @@ def _ragged_kernel(lens_ref, qlens_ref, pt_ref, q_ref, k_ref, v_ref,
         q = q_ref[...]           # [R, d] rows = chunk-major * group-minor
         k = k_ref[...]           # [page_size, d]
         v = v_ref[...]
+        if quant:
+            k = (k.astype(jnp.float32) * ks_ref[...]).astype(q.dtype)
+            v = (v.astype(jnp.float32) * vs_ref[...]).astype(q.dtype)
         s = _dotf32(q, k, ((1,), (1,))) * scale          # [R, ps] f32
         col = j * page_size + jax.lax.broadcasted_iota(
             jnp.int32, s.shape, 1)
@@ -293,15 +306,17 @@ def _ragged_kernel(lens_ref, qlens_ref, pt_ref, q_ref, k_ref, v_ref,
 
 
 def _ragged_kernel_impl(q4, k_pages, v_pages, page_table, kv_lens, q_lens,
-                        group, scale):
+                        group, scale, k_scales=None, v_scales=None):
     """q4: [b, kv_heads, R, d] with R = chunk*group padded to the sublane
-    tile; returns [b, kv_heads, R, d] fp32."""
+    tile; returns [b, kv_heads, R, d] fp32. ``k_scales``/``v_scales``
+    ([num_pages, page_size, kv_heads] or None) flip the int8-KV kernel."""
     b, hkv, r8, d = q4.shape
     num_pages, page_size = k_pages.shape[0], k_pages.shape[1]
     pps = page_table.shape[1]
     grid = (b, hkv, pps)
+    quant = k_scales is not None
 
-    def kv_imap(bi, h, j, lens_ref, qlens_ref, pt_ref):
+    def kv_page(bi, h, j, lens_ref, qlens_ref, pt_ref):
         # identical clamping to the decode kernel: pages past the last
         # valid one re-fetch it (their compute is skipped)
         ps = jnp.int32(page_size)
@@ -309,17 +324,29 @@ def _ragged_kernel_impl(q4, k_pages, v_pages, page_table, kv_lens, q_lens,
             jax.lax.div(lens_ref[bi] + ps - jnp.int32(1), ps) - jnp.int32(1),
             jnp.int32(0))
         page = pt_ref[bi, jnp.minimum(jnp.int32(j), last)]
-        return (jnp.clip(page, 0, num_pages - 1), 0, h, 0)
+        return jnp.clip(page, 0, num_pages - 1)
+
+    def kv_imap(bi, h, j, *refs):
+        return (kv_page(bi, h, j, *refs), 0, h, 0)
+
+    def scale_imap(bi, h, j, *refs):
+        return (kv_page(bi, h, j, *refs), 0, h)
 
     q_spec = pl.BlockSpec((None, None, r8, d), lambda bi, h, j, *_: (bi, h, 0, 0))
     kv_spec = pl.BlockSpec((None, page_size, None, d), kv_imap)
+    sc_spec = pl.BlockSpec((None, page_size, 1), scale_imap)
     o_spec = pl.BlockSpec((None, None, r8, d), lambda bi, h, j, *_: (bi, h, 0, 0))
     ml_spec = pl.BlockSpec((None, None, r8, 1), lambda bi, h, j, *_: (bi, h, 0, 0))
 
+    in_specs = [q_spec, kv_spec, kv_spec]
+    args = [q4, k_pages, v_pages]
+    if quant:
+        in_specs += [sc_spec, sc_spec]
+        args += [k_scales.astype(jnp.float32), v_scales.astype(jnp.float32)]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=grid,
-        in_specs=[q_spec, kv_spec, kv_spec],
+        in_specs=in_specs,
         out_specs=[o_spec, ml_spec, ml_spec],
     )
     out_shape = [
@@ -328,7 +355,7 @@ def _ragged_kernel_impl(q4, k_pages, v_pages, page_table, kv_lens, q_lens,
         jax.ShapeDtypeStruct((b, hkv, r8, 1), jnp.float32),
     ]
     kern = functools.partial(_ragged_kernel, page_size=page_size,
-                             group=group, scale=scale)
+                             group=group, scale=scale, quant=quant)
     with _atc.x64_off():
         out, _, _ = pl.pallas_call(
             kern, grid_spec=grid_spec, out_shape=out_shape,
@@ -336,19 +363,22 @@ def _ragged_kernel_impl(q4, k_pages, v_pages, page_table, kv_lens, q_lens,
                 dimension_semantics=("parallel", "arbitrary", "arbitrary")),
             interpret=_interpret(),
         )(kv_lens.astype(jnp.int32), q_lens.astype(jnp.int32),
-          page_table.astype(jnp.int32), q4, k_pages, v_pages)
+          page_table.astype(jnp.int32), *args)
     return out
 
 
 def ragged_paged_attention_reference(q, k_pages, v_pages, page_table,
-                                     kv_lens, q_lens, scale=None):
+                                     kv_lens, q_lens, scale=None,
+                                     k_scales=None, v_scales=None):
     """Gather-based oracle for the ragged kernel (and the non-TPU path).
 
     q: [b, chunk, num_q_heads, d] right-padded query chunks; kv_lens: [b]
     context length per slot INCLUDING this chunk; q_lens: [b] valid query
     rows (0 = idle lane — its output rows are zero). Query token t of slot
     b sits at absolute position ``kv_lens[b] - q_lens[b] + t`` and attends
-    all keys at positions <= its own. Returns [b, chunk, num_q_heads, d].
+    all keys at positions <= its own. With ``k_scales``/``v_scales``
+    ([num_pages, page_size, kv_heads]) the pages are int8 and dequantize
+    after the gather. Returns [b, chunk, num_q_heads, d].
     """
     b, c, hq, d = q.shape
     num_pages, page_size, hkv, _ = k_pages.shape
@@ -359,6 +389,11 @@ def ragged_paged_attention_reference(q, k_pages, v_pages, page_table,
     pt = jnp.clip(page_table, 0, num_pages - 1)
     k = k_pages[pt].reshape(b, pps * page_size, hkv, d)
     v = v_pages[pt].reshape(b, pps * page_size, hkv, d)
+    if k_scales is not None:
+        k = (k.astype(jnp.float32)
+             * k_scales[pt].reshape(b, pps * page_size, hkv)[..., None])
+        v = (v.astype(jnp.float32)
+             * v_scales[pt].reshape(b, pps * page_size, hkv)[..., None])
     qg = q.reshape(b, c, hkv, group, d)
     s = jnp.einsum("bchgd,bshd->bhgcs", qg.astype(jnp.float32),
                    k.astype(jnp.float32), precision=_MXU) * scale
@@ -378,7 +413,8 @@ def ragged_paged_attention_reference(q, k_pages, v_pages, page_table,
 
 
 def ragged_paged_attention(q, k_pages, v_pages, page_table, kv_lens, q_lens,
-                           scale=None, use_kernel: bool | None = None):
+                           scale=None, use_kernel: bool | None = None,
+                           k_scales=None, v_scales=None):
     """Ragged prefill+decode attention over the paged KV cache.
 
     The unified-step entry: each slot contributes ``q_lens[b]`` (0..chunk)
@@ -387,6 +423,10 @@ def ragged_paged_attention(q, k_pages, v_pages, page_table, kv_lens, q_lens,
     chunk's K/V must already be written to the pages). ``use_kernel`` as in
     :func:`paged_attention`. Rows past ``q_lens`` are garbage the caller
     must ignore (their page writes drop; the reference zeroes them).
+    ``k_scales``/``v_scales`` ([num_pages, page_size, kv_heads]) mark the
+    pools int8 (round-10 quantized KV); dequantization fuses into the
+    kernel's page loop (or the gathered reference) — pages stay int8
+    end-to-end in HBM.
     """
     b, c, hq, d = q.shape
     hkv = k_pages.shape[2]
@@ -394,13 +434,15 @@ def ragged_paged_attention(q, k_pages, v_pages, page_table, kv_lens, q_lens,
     assert k_pages.shape == v_pages.shape
     assert page_table.shape[0] == b
     assert kv_lens.shape == (b,) and q_lens.shape == (b,)
+    assert (k_scales is None) == (v_scales is None)
     if scale is None:
         scale = 1.0 / math.sqrt(d)
     if use_kernel is None:
         use_kernel = use_kernel_default()
     if not use_kernel:
         return ragged_paged_attention_reference(
-            q, k_pages, v_pages, page_table, kv_lens, q_lens, scale=scale)
+            q, k_pages, v_pages, page_table, kv_lens, q_lens, scale=scale,
+            k_scales=k_scales, v_scales=v_scales)
     group = hq // hkv
     # rows = chunk-major, group-minor: [b, c, hkv, g, d] -> [b, hkv, c*g, d]
     q4 = q.reshape(b, c, hkv, group, d).transpose(0, 2, 1, 3, 4)
@@ -409,7 +451,8 @@ def ragged_paged_attention(q, k_pages, v_pages, page_table, kv_lens, q_lens,
     if r8 != c * group:
         q4 = jnp.pad(q4, ((0, 0), (0, 0), (0, r8 - c * group), (0, 0)))
     out = _ragged_kernel_impl(q4, k_pages, v_pages, page_table, kv_lens,
-                              q_lens, group, float(scale))
+                              q_lens, group, float(scale),
+                              k_scales=k_scales, v_scales=v_scales)
     out = out[:, :, :c * group, :].reshape(b, hkv, c, group, d)
     out = out.transpose(0, 2, 1, 3, 4).reshape(b, c, hq, d)
     return out.astype(q.dtype)

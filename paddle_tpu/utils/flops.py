@@ -103,6 +103,32 @@ def _weight_only_linear_flops(input_shapes, attrs):
     return _linear_flops(input_shapes, attrs)
 
 
+@register_flops("quant_matmul")
+def _quant_matmul_flops(input_shapes, attrs):
+    # fused weight-only GEMM: x [..., K] @ dequant(q [K|K/2, N]) — the
+    # in-kernel dequant rides the GEMM MACs; K comes from x (the weight
+    # may be nibble-packed int4, so its own in-dim can be K/2)
+    x = _first(input_shapes, "Input", "x", "X")
+    w = _first(input_shapes, "W", "weight", "qweight", "Y", "y")
+    if not x or len(w) < 2:
+        return 0
+    return 2 * prod(x[:-1]) * x[-1] * w[-1]
+
+
+@register_flops("weight_quantize")
+def _weight_quantize_flops(input_shapes, attrs):
+    # absmax reduce + scale divide + round/clip: ~4 passes over [K, N]
+    w = _first(input_shapes, "X", "x", "w")
+    return 4 * prod(w) if w else 0
+
+
+@register_flops("weight_dequantize")
+def _weight_dequantize_flops(input_shapes, attrs):
+    # one widen-and-scale pass over the [K, N] weight
+    w = _first(input_shapes, "X", "x", "w")
+    return 2 * prod(w) if w else 0
+
+
 def _conv_flops_nd(input_shapes, attrs, nd):
     """MACs of an N-d convolution (NC<spatial> x, OI<spatial> filter)."""
     x = _first(input_shapes, "Input", "x")

@@ -674,6 +674,16 @@ class TestHazardRegressions:
 
         assert [f for f in analyze_serving() if f.rule == "JX005"] == []
 
+    def test_serving_quant_jits_are_clean_and_donate(self):
+        """The round-10 quantized serving jits (int8-weight prefill/decode
+        + int8-weight/int8-KV unified step): jaxpr walk — incl. JX001,
+        so per-group scales can never widen the compute to f64 — and the
+        donation audit of pools AND scale planes come back with ZERO
+        findings (the baseline stays empty)."""
+        from paddle_tpu.analysis.targets import analyze_serving_quant
+
+        assert analyze_serving_quant() == []
+
 
 # ---------------------------------------------------------------------------
 # the gate: the repo itself, against the checked-in baseline

@@ -1032,6 +1032,51 @@ spec("istft", lambda x: paddle.signal.istft(
 # skip list — every remaining row must have a reason
 # ---------------------------------------------------------------------------
 
+# ---------------------------------------------------------------------------
+# weight-only quantization family (round 10): quantize/dequantize round the
+# same f32 math as the numpy oracles; quant_matmul runs the jnp dequant
+# oracle path on CPU (kernel parity is tests/test_quant_matmul.py's job).
+# f64=False on the quantizers: their internal math is fp32 by contract, and
+# an f64 oracle could round the .5 boundaries differently.
+# ---------------------------------------------------------------------------
+
+from paddle_tpu.nn import quant as _nnq  # noqa: E402
+
+
+def _wq_oracle(w):
+    absmax = np.maximum(np.abs(w.astype(np.float32)).max(0), 1e-8)
+    scale = absmax / 127.0
+    q = np.clip(np.round(w.astype(np.float32) / scale[None]),
+                -127, 127).astype(np.int8)
+    return q, scale.astype(w.dtype)
+
+
+spec("weight_quantize", _nnq.weight_quantize,
+     lambda rng: [rng.randn(16, 8).astype("float32")],
+     oracle=_wq_oracle, grad=False, f64=False)
+
+spec("weight_dequantize", _nnq.weight_dequantize,
+     lambda rng: [
+         rng.randint(-127, 128, (16, 8)).astype("int8"),
+         (0.01 + rng.rand(8)).astype("float32"),
+     ],
+     oracle=lambda q, s: (q.astype(np.float32) * s[None]).astype(s.dtype),
+     grad=False, f64=False, bf16=False)
+
+# diff only the activation (+ bias): the op's contract treats the frozen
+# PTQ scales as constants (the fused kernel's VJP returns zero for them)
+spec("quant_matmul",
+     lambda x, q, s, b: _nnq.quant_matmul(x, q, s, b),
+     lambda rng: [
+         rng.randn(3, 16).astype("float32"),
+         rng.randint(-127, 128, (16, 8)).astype("int8"),
+         (0.01 + rng.rand(8)).astype("float32"),
+         rng.randn(8).astype("float32"),
+     ],
+     oracle=lambda x, q, s, b: x @ (q.astype(x.dtype) * s[None]) + b,
+     diff=(0, 3))
+
+
 _SKIP_GROUPS = {
     "stochastic op (seeded reproducibility + distribution checks in tests/test_op_stochastic.py)": [
         "bernoulli", "binomial", "dropout", "alpha_dropout", "gaussian",
@@ -1079,8 +1124,9 @@ _SKIP_GROUPS = {
     ],
     "quantization op (covered by tests/test_quantization.py)": [
         "fake_quant_dequant", "fake_channel_quant_dequant",
-        "weight_quantize", "weight_dequantize", "weight_only_linear",
-        
+    ],
+    "weight-only serving linear (fused-kernel parity + fp-oracle tolerance in tests/test_quant_matmul.py + test_tail_ops.py; weight_quantize/dequantize/quant_matmul have golden specs)": [
+        "weight_only_linear",
     ],
     "fused MLP-block Pallas kernel op (fwd+bwd golden-tested vs the jnp reference, fp32 and bf16 legs, in tests/test_fused_mlp.py — interpret mode on CPU)": [
         "fused_bias_gelu", "fused_ln_residual",

@@ -288,3 +288,83 @@ def test_scale_override(rng):
                                use_kernel=True)
     np.testing.assert_allclose(np.asarray(k_out), np.asarray(k_ref),
                                rtol=2e-5, atol=2e-5)
+
+
+# -- round 10: int8-KV ragged attention (fused in-kernel dequant) -----------
+
+
+def _quant_pools(kp, vp):
+    """Per-token-per-head symmetric int8 of fp pools + fp32 scale planes
+    (the paged_write_packed_quant layout)."""
+    def one(p):
+        pf = np.asarray(p, np.float32)
+        am = np.maximum(np.abs(pf).max(-1), 1e-8)
+        s = (am / 127.0).astype(np.float32)
+        q = np.clip(np.round(pf / s[..., None]), -127, 127).astype(np.int8)
+        return jnp.asarray(q), jnp.asarray(s)
+
+    kq, ks = one(kp)
+    vq, vs = one(vp)
+    return kq, ks, vq, vs
+
+
+@pytest.mark.parametrize("hq,hkv", [(4, 4), (8, 2)], ids=["mha", "gqa4"])
+def test_ragged_int8_kv_kernel_matches_reference(rng, hq, hkv):
+    """The int8-KV kernel (scale blocks dequantized in VMEM) against the
+    gather-dequant reference, mixed decode/prefill/idle lanes."""
+    b, c, d, page_size, pps = 3, 4, 16, 8, 3
+    q, kp, vp, pt = _ragged_case(rng, b, c, hq, hkv, d, page_size, pps)
+    kq, ks, vq, vs = _quant_pools(kp, vp)
+    kv_lens = jnp.asarray([17, 1, 0], jnp.int32)
+    q_lens = jnp.asarray([4, 1, 0], jnp.int32)
+    ref = pa.ragged_paged_attention_reference(
+        q, kq, vq, pt, kv_lens, q_lens, k_scales=ks, v_scales=vs)
+    out = pa.ragged_paged_attention(
+        q, kq, vq, pt, kv_lens, q_lens, use_kernel=True,
+        k_scales=ks, v_scales=vs)
+    # rows past q_lens are unspecified kernel garbage: compare valid only
+    for i in range(b):
+        n = int(q_lens[i])
+        np.testing.assert_allclose(np.asarray(out)[i, :n],
+                                   np.asarray(ref)[i, :n],
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_ragged_int8_kv_close_to_fp(rng):
+    """int8 quantization error bound vs the fp attention (the serving
+    accuracy contract's attention leg)."""
+    b, c, hq, hkv, d, page_size, pps = 2, 4, 4, 4, 16, 8, 2
+    q, kp, vp, pt = _ragged_case(rng, b, c, hq, hkv, d, page_size, pps)
+    kq, ks, vq, vs = _quant_pools(kp, vp)
+    kv_lens = jnp.asarray([13, 8], jnp.int32)
+    q_lens = jnp.asarray([4, 4], jnp.int32)
+    fp = pa.ragged_paged_attention_reference(q, kp, vp, pt, kv_lens, q_lens)
+    q8 = pa.ragged_paged_attention(q, kq, vq, pt, kv_lens, q_lens,
+                                   use_kernel=True, k_scales=ks,
+                                   v_scales=vs)
+    assert np.abs(np.asarray(q8) - np.asarray(fp)).max() < 0.05
+
+
+def test_paged_write_packed_quant_roundtrip(rng):
+    """Quantize-on-write: the scattered int8 rows dequantize back to the
+    written tokens within the per-head absmax/127 bound; padding and
+    unallocated positions drop."""
+    from paddle_tpu.inference.kv_cache import paged_write_packed_quant
+
+    num_pages, page_size, h, d = 4, 4, 2, 8
+    pages = jnp.zeros((num_pages, page_size, h, d), jnp.int8)
+    scales = jnp.zeros((num_pages, page_size, h), jnp.float32)
+    pt = jnp.asarray([[0, 2], [3, -1]], jnp.int32)
+    toks = jnp.asarray(rng.randn(3, h, d), jnp.float32)
+    tok_slot = jnp.asarray([0, 0, -1], jnp.int32)   # last = padding
+    tok_pos = jnp.asarray([1, 5, 0], jnp.int32)     # page 0 row 1, page 2 row 1
+    pages, scales = paged_write_packed_quant(pages, scales, toks, pt,
+                                             tok_slot, tok_pos, page_size)
+    got0 = np.asarray(pages)[0, 1] * np.asarray(scales)[0, 1][:, None]
+    got1 = np.asarray(pages)[2, 1] * np.asarray(scales)[2, 1][:, None]
+    for got, want in ((got0, np.asarray(toks)[0]),
+                      (got1, np.asarray(toks)[1])):
+        bound = np.abs(want).max(-1, keepdims=True) / 127 + 1e-6
+        assert (np.abs(got - want) <= bound).all()
+    # padding token wrote nowhere: only the two target rows are nonzero
+    assert int((np.asarray(scales) != 0).sum()) == 2 * h

@@ -1,0 +1,275 @@
+"""Round-10 fused weight-only GEMM: the Pallas kernel (interpret mode on
+CPU) vs the jnp dequantize-then-matmul oracle across dtypes, bit widths,
+scale groupings and odd shapes; int4 nibble packing round-trip + the true-4x
+weight-bytes contract; the custom VJP; and the nn.quant surface routing.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.ops.pallas.quant_matmul import (
+    dequantize_weight, pack_int4, quant_matmul, quant_matmul_reference,
+    unpack_int4)
+
+
+def _quantize(w, bits=8, group=-1):
+    """Host-side symmetric quantizer (the oracle's own math)."""
+    qmax = 127.0 if bits == 8 else 7.0
+    k, n = w.shape
+    if group in (-1, None):
+        absmax = np.maximum(np.abs(w).max(0), 1e-8)
+        s = (absmax / qmax).astype(np.float32)[None]          # [1, n]
+    else:
+        absmax = np.maximum(
+            np.abs(w).reshape(k // group, group, n).max(1), 1e-8)
+        s = (absmax / qmax).astype(np.float32)                # [g, n]
+    q = np.clip(np.round(w / np.repeat(s, k // s.shape[0], 0)),
+                -qmax, qmax).astype(np.int8)
+    if bits == 4:
+        return np.asarray(pack_int4(jnp.asarray(q))), s
+    return q, s
+
+
+# -- packing ----------------------------------------------------------------
+
+
+def test_pack_int4_roundtrip(rng):
+    q = rng.randint(-7, 8, (32, 12)).astype(np.int8)
+    packed = pack_int4(jnp.asarray(q))
+    assert packed.shape == (16, 12)
+    np.testing.assert_array_equal(np.asarray(unpack_int4(packed)), q)
+
+
+def test_pack_int4_full_nibble_range():
+    """Every representable nibble value [-8, 7] survives the round trip
+    (sign extension of the two's-complement nibbles)."""
+    q = np.arange(-8, 8, dtype=np.int8).reshape(16, 1)
+    np.testing.assert_array_equal(
+        np.asarray(unpack_int4(pack_int4(jnp.asarray(q)))), q)
+
+
+def test_pack_int4_rejects_odd_rows():
+    with pytest.raises(ValueError):
+        pack_int4(jnp.zeros((3, 4), jnp.int8))
+
+
+def test_int4_true_4x_weight_bytes(rng):
+    """The acceptance contract: packed int4 storage is 4x smaller than the
+    bf16 weight it replaces (and 2x smaller than int8)."""
+    w = rng.randn(128, 64).astype(np.float32)
+    q8, _ = _quantize(w, bits=8)
+    q4, _ = _quantize(w, bits=4)
+    bf16_bytes = w.size * 2
+    assert q8.nbytes * 2 == bf16_bytes      # int8: 2x
+    assert q4.nbytes * 4 == bf16_bytes      # packed int4: true 4x
+    assert q4.nbytes * 2 == q8.nbytes
+
+
+# -- kernel vs oracle -------------------------------------------------------
+
+
+@pytest.mark.parametrize("bits", [8, 4])
+@pytest.mark.parametrize("group", [-1, 16])
+@pytest.mark.parametrize("shape", [(4, 64, 48), (3, 96, 33), (1, 32, 8),
+                                   (7, 160, 128)])
+def test_kernel_matches_oracle(rng, bits, group, shape):
+    m, k, n = shape
+    w = rng.randn(k, n).astype(np.float32) * 0.2
+    q, s = _quantize(w, bits=bits, group=group)
+    x = jnp.asarray(rng.randn(m, k), jnp.float32)
+    ref = quant_matmul_reference(x, jnp.asarray(q), jnp.asarray(s))
+    got = quant_matmul(x, jnp.asarray(q), jnp.asarray(s), use_kernel=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-6, atol=2e-6)
+
+
+def test_kernel_bit_matches_oracle_single_k_block(rng):
+    """With the whole K extent in one k tile the kernel IS
+    dequantize-tile + one MXU dot — bit-identical to the oracle (the
+    acceptance criterion's interpret-mode bit-match)."""
+    m, k, n = 4, 64, 32                     # k=64 < default bk: one tile
+    w = rng.randn(k, n).astype(np.float32)
+    q, s = _quantize(w, bits=8)
+    x = jnp.asarray(rng.randn(m, k), jnp.float32)
+    ref = quant_matmul_reference(x, jnp.asarray(q), jnp.asarray(s))
+    got = quant_matmul(x, jnp.asarray(q), jnp.asarray(s), use_kernel=True)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+    # int4: the split-half packing contracts as TWO half-dots summed, so
+    # the last-ulp reduction order differs from the oracle's one full-K
+    # dot — tight allclose instead of bitwise
+    q4, s4 = _quantize(w, bits=4)
+    ref4 = quant_matmul_reference(x, jnp.asarray(q4), jnp.asarray(s4))
+    got4 = quant_matmul(x, jnp.asarray(q4), jnp.asarray(s4),
+                        use_kernel=True)
+    np.testing.assert_allclose(np.asarray(got4), np.asarray(ref4),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_kernel_bf16_and_leading_dims(rng):
+    m, k, n = 2, 64, 32
+    w = rng.randn(k, n).astype(np.float32)
+    q, s = _quantize(w, bits=8)
+    x = jnp.asarray(rng.randn(m, 3, k), jnp.bfloat16)
+    b = jnp.asarray(rng.randn(n), jnp.float32)
+    got = quant_matmul(x, jnp.asarray(q), jnp.asarray(s), bias=b,
+                       use_kernel=True)
+    ref = quant_matmul_reference(x, jnp.asarray(q), jnp.asarray(s), bias=b)
+    assert got.dtype == jnp.bfloat16 and got.shape == (m, 3, n)
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(ref, np.float32),
+                               rtol=0.05, atol=0.05)
+
+
+def test_kernel_accuracy_vs_fp(rng):
+    """End-to-end quantization error bound vs the fp matmul (the int8
+    contract the serving path relies on)."""
+    m, k, n = 8, 128, 64
+    w = rng.randn(k, n).astype(np.float32) * 0.1
+    x = jnp.asarray(rng.randn(m, k), jnp.float32)
+    want = np.asarray(x) @ w
+    for bits, tol in ((8, 0.05), (4, 0.6)):
+        q, s = _quantize(w, bits=bits, group=32)
+        got = np.asarray(quant_matmul(x, jnp.asarray(q), jnp.asarray(s),
+                                      use_kernel=True))
+        assert np.abs(got - want).max() < tol, (bits, np.abs(got - want).max())
+
+
+def test_dequantize_weight_layouts(rng):
+    w = rng.randn(64, 16).astype(np.float32)
+    for bits in (8, 4):
+        for group in (-1, 16):
+            q, s = _quantize(w, bits=bits, group=group)
+            deq = np.asarray(dequantize_weight(jnp.asarray(q),
+                                               jnp.asarray(s), k=64))
+            qmax = 127.0 if bits == 8 else 7.0
+            assert np.abs(deq - w).max() <= np.abs(w).max() / qmax + 1e-5
+
+
+# -- custom VJP -------------------------------------------------------------
+
+
+@pytest.mark.parametrize("bits", [8, 4])
+def test_vjp_matches_reference_grad(rng, bits):
+    m, k, n = 5, 64, 32
+    w = rng.randn(k, n).astype(np.float32)
+    q, s = _quantize(w, bits=bits, group=16)
+    x = jnp.asarray(rng.randn(m, k), jnp.float32)
+    cot = jnp.asarray(rng.randn(m, n), jnp.float32)
+
+    def loss_k(v):
+        return jnp.sum(quant_matmul(v, jnp.asarray(q), jnp.asarray(s),
+                                    use_kernel=True) * cot)
+
+    def loss_r(v):
+        return jnp.sum(quant_matmul_reference(
+            v, jnp.asarray(q), jnp.asarray(s)) * cot)
+
+    np.testing.assert_allclose(np.asarray(jax.grad(loss_k)(x)),
+                               np.asarray(jax.grad(loss_r)(x)),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_vjp_scales_treated_constant(rng):
+    """The kernel VJP's scale cotangent is zero (frozen PTQ scales)."""
+    m, k, n = 2, 32, 8
+    w = rng.randn(k, n).astype(np.float32)
+    q, s = _quantize(w, bits=8)
+    x = jnp.asarray(rng.randn(m, k), jnp.float32)
+    ds = jax.grad(lambda sv: jnp.sum(quant_matmul(
+        x, jnp.asarray(q), sv, use_kernel=True)))(jnp.asarray(s))
+    np.testing.assert_array_equal(np.asarray(ds), 0.0)
+
+
+# -- jit + autotune plumbing ------------------------------------------------
+
+
+def test_kernel_inside_jit_no_retrace(rng):
+    m, k, n = 4, 64, 32
+    w = rng.randn(k, n).astype(np.float32)
+    q, s = _quantize(w, bits=8)
+    qj, sj = jnp.asarray(q), jnp.asarray(s)
+    calls = [0]
+
+    @jax.jit
+    def f(v):
+        calls[0] += 1
+        return quant_matmul(v, qj, sj, use_kernel=True)
+
+    x = jnp.asarray(rng.randn(m, k), jnp.float32)
+    a = f(x)
+    b = f(x + 1.0)
+    assert calls[0] == 1                       # one trace, replayed
+    assert a.shape == b.shape == (m, n)
+
+
+def test_autotune_noop_off_tpu():
+    from paddle_tpu.ops.pallas.quant_matmul import autotune_quant_matmul
+
+    bm, bn, bk = autotune_quant_matmul(8, 128, 64)
+    assert 128 % bk == 0 and 64 % bn == 0 and 8 % bm == 0
+
+
+# -- nn.quant surface -------------------------------------------------------
+
+
+def test_weight_only_linear_kernel_vs_oracle(rng):
+    from paddle_tpu.nn import quant
+
+    x = rng.randn(4, 64).astype("float32")
+    w = rng.randn(64, 32).astype("float32")
+    b = rng.randn(32).astype("float32")
+    qw, scale = quant.weight_quantize(paddle.to_tensor(w))
+    y_or = quant.weight_only_linear(
+        paddle.to_tensor(x), qw, paddle.to_tensor(b), scale,
+        use_kernel=False)
+    y_kr = quant.weight_only_linear(
+        paddle.to_tensor(x), qw, paddle.to_tensor(b), scale,
+        use_kernel=True)
+    np.testing.assert_allclose(y_kr.numpy(), y_or.numpy(),
+                               rtol=2e-6, atol=2e-6)
+    np.testing.assert_allclose(y_kr.numpy(), x @ w + b, rtol=0.05, atol=0.3)
+
+
+def test_weight_only_linear_int4_grouped(rng):
+    from paddle_tpu.nn import quant
+
+    x = rng.randn(3, 64).astype("float32")
+    w = rng.randn(64, 16).astype("float32")
+    qw, scale = quant.weight_quantize(paddle.to_tensor(w),
+                                      algo="weight_only_int4",
+                                      group_size=16)
+    assert np.asarray(qw._data).shape == (32, 16)    # nibble-packed
+    y = quant.weight_only_linear(paddle.to_tensor(x), qw, None, scale,
+                                 use_kernel=True)
+    # int4 is coarse: just bound the error against the kernel's own oracle
+    y_or = quant.weight_only_linear(paddle.to_tensor(x), qw, None, scale,
+                                    use_kernel=False)
+    np.testing.assert_allclose(y.numpy(), y_or.numpy(), rtol=2e-6,
+                               atol=2e-6)
+    assert np.abs(y.numpy() - x @ w).max() < 2.5
+
+
+def test_weight_quantize_group_scales_shape(rng):
+    from paddle_tpu.nn import quant
+
+    w = rng.randn(64, 8).astype("float32")
+    _, scale = quant.weight_quantize(paddle.to_tensor(w), group_size=16)
+    assert np.asarray(scale._data).shape == (4, 8)
+    deq_close = quant.weight_dequantize(
+        quant.weight_quantize(paddle.to_tensor(w), group_size=16)[0],
+        scale)
+    np.testing.assert_allclose(deq_close.numpy(), w, atol=np.abs(w).max() / 127 + 1e-6)
+
+
+def test_incubate_quant_matmul_surface(rng):
+    import paddle_tpu.incubate.nn.functional as FI
+    from paddle_tpu.nn import quant
+
+    x = rng.randn(2, 32).astype("float32")
+    w = rng.randn(32, 8).astype("float32")
+    qw, scale = quant.weight_quantize(paddle.to_tensor(w))
+    y = FI.quant_matmul(paddle.to_tensor(x), qw, scale, use_kernel=True)
+    np.testing.assert_allclose(y.numpy(), x @ w, rtol=0.05, atol=0.3)

@@ -470,16 +470,18 @@ def test_unified_ttft_recorded(rng):
 def test_bench_serve_smoke_schema():
     """bench_serve.py --smoke must run green on CPU and emit bench.py's
     one-line JSON schema with the round-9 serving fields (TTFT, prefix
-    hit rate, prefill/decode retrace gates), flagship unified line last."""
+    hit rate, prefill/decode retrace gates) plus the round-10 quantized
+    A/B legs (fp vs int8-weights vs int8-weights+int8-KV) with the
+    hbm-bytes-per-token accounting, flagship quantized line last."""
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     proc = subprocess.run(
         [sys.executable, "bench_serve.py", "--smoke", "--steps=6",
          "--batch=2", "--prompt=8", "--gen-len=3"],
-        cwd=root, capture_output=True, text=True, timeout=300,
+        cwd=root, capture_output=True, text=True, timeout=600,
         env={**os.environ, "JAX_PLATFORMS": "cpu"})
     assert proc.returncode == 0, proc.stderr[-2000:]
     lines = [l for l in proc.stdout.strip().splitlines() if l.strip()]
-    assert len(lines) == 2, proc.stdout
+    assert len(lines) == 4, proc.stdout
     for line in lines:
         rec = json.loads(line)
         assert "error" not in rec, rec
@@ -489,18 +491,27 @@ def test_bench_serve_smoke_schema():
         assert rec["ttft_p99_ms"] >= rec["ttft_p50_ms"]
         assert rec["decode_retraces"] == 1  # the no-retrace gate
         assert "vs_baseline" in rec and "prefix_hit_rate" in rec
-    legacy, unified = (json.loads(l) for l in lines)
+        assert rec["hbm_bytes_per_token"] > 0
+    legacy, unified, int8w, int8kv = (json.loads(l) for l in lines)
     assert "[legacy-two-jit]" in legacy["metric"]
-    assert "[unified-step]" in unified["metric"]   # flagship line LAST
+    assert "[unified-step]" in unified["metric"]
+    assert "[unified-int8w]" in int8w["metric"]
+    assert "[unified-int8w-int8kv]" in int8kv["metric"]  # flagship LAST
     # the retrace satellite gates: the legacy path's bucketed prefill
     # compiles >= 1 executable (now visible); the unified step has NO
     # prefill jit and exactly one executable for everything
     assert legacy["prefill_retraces"] >= 1
-    assert unified["prefill_retraces"] == 0
-    # prefix caching only exists on the unified leg, and the churn
+    for rec in (unified, int8w, int8kv):
+        assert rec["prefill_retraces"] == 0
+    # prefix caching only exists on the unified legs, and the churn
     # workload (repeated prompts) must actually hit it
     assert legacy["prefix_hit_rate"] == 0.0
     assert unified["prefix_hit_rate"] > 0.0
+    assert int8kv["prefix_hit_rate"] > 0.0
+    # the round-10 memory contract: each quantization leg strictly cuts
+    # HBM bytes per decode token (weights 2x+, then the KV context)
+    assert int8w["hbm_bytes_per_token"] < unified["hbm_bytes_per_token"]
+    assert int8kv["hbm_bytes_per_token"] < int8w["hbm_bytes_per_token"]
 
 
 def test_predictor_tight_pool_serializes_instead_of_livelock(rng):
@@ -702,3 +713,165 @@ def test_predictor_admission_keeps_growth_headroom(rng):
     assert a.state == FINISHED and b.state == FINISHED
     assert b.preempt_count == 0  # never admitted into a doomed fit
     assert len(b.output_ids) == 2
+
+
+# -- round 10: quantized serving (int8/int4 weights + int8 KV cache) --------
+
+
+def _token_match_rate(got, want):
+    got, want = np.asarray(got), np.asarray(want)
+    return float((got == want).mean())
+
+
+def test_quantized_generate_matches_fp_oracle(rng):
+    """The acceptance gate: generate_paged with int8 weights + int8 KV
+    matches the fp greedy oracle on >= 99% of tokens in the smoke config
+    (quantization noise may flip near-tie argmaxes — the explicit
+    tolerance), and the unified-step retrace gate is unchanged."""
+    from paddle_tpu.models.gpt import generate_paged
+
+    model = _tiny_model()
+    ids = rng.randint(0, TINY["vocab_size"], (2, 11)).astype(np.int64)
+    want = _oracle_greedy(model, ids, 16)
+    model.config.weight_dtype = "int8"
+    model.config.kv_cache_dtype = "int8"
+    try:
+        got = model.generate(paddle.to_tensor(ids), max_new_tokens=16).numpy()
+        assert _token_match_rate(got, want) >= 0.99
+        # ONE trace for the quantized unified step, never per-token
+        assert generate_paged.last_decode_trace_count <= 1
+    finally:
+        model.config.weight_dtype = None
+        model.config.kv_cache_dtype = None
+
+
+def test_quantized_generate_int4_grouped(rng):
+    """int4 nibble-packed weights with per-group scales serve through the
+    same path (coarser: the group scales keep argmax flips rare)."""
+    model = _tiny_model()
+    ids = rng.randint(0, TINY["vocab_size"], (2, 7)).astype(np.int64)
+    want = _oracle_greedy(model, ids, 10)
+    model.config.weight_dtype = "int4"
+    model.config.weight_quant_group_size = 8
+    try:
+        got = model.generate(paddle.to_tensor(ids), max_new_tokens=10).numpy()
+        assert _token_match_rate(got, want) >= 0.9
+    finally:
+        model.config.weight_dtype = None
+        model.config.weight_quant_group_size = -1
+
+
+def test_quantized_weight_only_generate_exactness_unaffected_by_cache(rng):
+    """Flipping weight_dtype on one model must re-extract the serving
+    params (the cache cannot serve the fp pytree to the quantized config)
+    and flipping back must restore bit-exact fp serving."""
+    model = _tiny_model()
+    ids = rng.randint(0, TINY["vocab_size"], (1, 6)).astype(np.int64)
+    want = _oracle_greedy(model, ids, 6)
+    got_fp = model.generate(paddle.to_tensor(ids), max_new_tokens=6).numpy()
+    np.testing.assert_array_equal(got_fp, want)
+    model.config.weight_dtype = "int8"
+    try:
+        from paddle_tpu.inference.quantize import is_quantized_params
+        from paddle_tpu.models.gpt import _serving_params_cached
+
+        assert is_quantized_params(_serving_params_cached(model))
+    finally:
+        model.config.weight_dtype = None
+    got_fp2 = model.generate(paddle.to_tensor(ids), max_new_tokens=6).numpy()
+    np.testing.assert_array_equal(got_fp2, want)
+
+
+def test_quantized_predictor_matches_fp_and_no_retrace(rng):
+    """ServingPredictor with int8 weights + int8 KV: >= 99% token match
+    vs the fp predictor over continuous batching, prefix caching still
+    composes, and the unified step compiles exactly ONCE."""
+    model = _tiny_model()
+    prompts = [rng.randint(0, TINY["vocab_size"], (n,)).tolist()
+               for n in (9, 5, 13)]
+    sp_fp = ServingPredictor(model, max_batch=3, page_size=8,
+                             max_seq_len=64)
+    fp_out = sp_fp.generate(prompts, max_new_tokens=10)
+    model.config.weight_dtype = "int8"
+    model.config.kv_cache_dtype = "int8"
+    try:
+        sp_q = ServingPredictor(model, max_batch=3, page_size=8,
+                                max_seq_len=64)
+        q_out = sp_q.generate(prompts, max_new_tokens=10)
+        toks = [(a, b) for ao, bo in zip(fp_out, q_out)
+                for a, b in zip(ao, bo)]
+        match = np.mean([a == b for a, b in toks])
+        assert match >= 0.99, f"token match {match}"
+        assert sp_q.decode_trace_count == 1     # retrace gate unchanged
+        # second wave: prefix pages (stored int8 WITH their scales) hit
+        sp_q.generate(prompts, max_new_tokens=4)
+        assert sp_q.prefix_hit_rate > 0.0
+        assert sp_q.decode_trace_count == 1
+    finally:
+        model.config.weight_dtype = None
+        model.config.kv_cache_dtype = None
+
+
+def test_quantized_kv_requires_unified_step(rng):
+    model = _tiny_model()
+    model.config.kv_cache_dtype = "int8"
+    try:
+        with pytest.raises(ValueError):
+            ServingPredictor(model, max_batch=2, unified=False)
+    finally:
+        model.config.kv_cache_dtype = None
+
+
+def test_int8_kv_cache_pools_are_int8(rng):
+    """The memory contract: pools live int8 end-to-end with per-(page,
+    slot, head) fp32 scale planes — 2x KV bytes saved (scales ~1/head_dim
+    overhead)."""
+    model = _tiny_model()
+    model.config.kv_cache_dtype = "int8"
+    try:
+        sp = ServingPredictor(model, max_batch=2, page_size=8,
+                              max_seq_len=32)
+        r = sp.add_request(rng.randint(0, 97, (9,)).tolist(),
+                           max_new_tokens=3)
+        while sp.has_work():
+            sp.step()
+        assert sp.cache.k_pages.dtype == jnp.int8
+        assert sp.cache.v_pages.dtype == jnp.int8
+        assert sp.cache.k_scales.shape == (2, sp.cache.num_pages, 8, 4)
+        assert len(r.output_ids) == 3
+    finally:
+        model.config.kv_cache_dtype = None
+
+
+def test_unsupported_kv_cache_dtype_fails_loudly(rng):
+    """An unsupported kv_cache_dtype must raise, not silently serve a
+    full-precision cache (the config claims quantized memory)."""
+    model = _tiny_model()
+    model.config.kv_cache_dtype = "int4"
+    try:
+        with pytest.raises(ValueError, match="kv_cache_dtype"):
+            ServingPredictor(model, max_batch=2)
+        with pytest.raises(ValueError, match="kv_cache_dtype"):
+            model.generate(paddle.to_tensor(
+                rng.randint(0, 97, (1, 4)).astype(np.int64)),
+                max_new_tokens=2)
+    finally:
+        model.config.kv_cache_dtype = None
+
+
+def test_quantized_generate_kernel_leg_matches_oracle(rng):
+    """use_kernel=True drives the fused quant GEMM + int8-KV ragged
+    attention kernels in interpret mode INSIDE the serving jit (the
+    use_kernel contract threads into _srv_mm, not just attention)."""
+    model = _tiny_model()
+    ids = rng.randint(0, TINY["vocab_size"], (2, 5)).astype(np.int64)
+    want = _oracle_greedy(model, ids, 6)
+    model.config.weight_dtype = "int8"
+    model.config.kv_cache_dtype = "int8"
+    try:
+        got = model.generate(paddle.to_tensor(ids), max_new_tokens=6,
+                             use_kernel=True, page_size=8).numpy()
+        assert _token_match_rate(got, want) >= 0.99
+    finally:
+        model.config.weight_dtype = None
+        model.config.kv_cache_dtype = None

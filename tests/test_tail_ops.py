@@ -571,10 +571,17 @@ class TestWeightOnlyQuant:
         # int8 quantization error bound
         np.testing.assert_allclose(got, want, rtol=0.05, atol=0.3)
 
-    def test_int4_range(self, rng):
+    def test_int4_packed_range_and_bytes(self, rng):
+        """Round 10: int4 is NIBBLE-PACKED two per byte — the unpacked
+        values stay in [-7, 7] and the stored array is half the rows (a
+        true 4x over bf16)."""
         from paddle_tpu.nn import quant
+        from paddle_tpu.ops.pallas.quant_matmul import unpack_int4
         w = rng.randn(16, 8).astype("float32")
         qw, _ = quant.weight_quantize(paddle.to_tensor(w),
                                       algo="weight_only_int4")
-        q = np.asarray(qw._data)
+        packed = np.asarray(qw._data)
+        assert packed.shape == (8, 8)      # two nibbles per byte
+        q = np.asarray(unpack_int4(qw._data))
+        assert q.shape == (16, 8)
         assert q.min() >= -7 and q.max() <= 7
