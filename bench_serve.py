@@ -28,6 +28,12 @@ schema/contract as bench.py — the flagship quantized line LAST):
   reads (weights amortized over the batch + that token's KV context,
   scale planes included) — the quantity the round-10 weight-only int8 /
   int4 and int8-KV legs shrink (2-4x), decode being bandwidth-bound
+- ``mesh_chips``/``mesh_shape``/``tokens_per_s_per_chip``: the round-11
+  mesh scaling leg (``unified-spmd``) runs the SAME churn workload with
+  the unified step tensor-parallel over ``Mesh(("mp",))`` — the mp=1 vs
+  mp=N A/B; every leg stamps its mesh so round-over-round deltas compare
+  like against like (per-chip throughput is the roofline that matters:
+  N chips buy aggregate bandwidth, the psums spend some of it back)
 
 ``--smoke``: tiny CPU config — always runnable (CI leg, rc 0; gather
 reference attention keeps it fast, kernel parity is the test suite's
@@ -57,27 +63,34 @@ def _percentile(xs, q):
 
 
 def _hbm_bytes_per_token(sp, batch, avg_ctx):
-    """Analytic steady-state HBM read bytes per decode token: every weight
-    byte once per step (amortized over the batch's lanes) + the token's
-    own KV context (int8 pools count 1 byte/elt + their fp32 scale
-    planes)."""
+    """Analytic steady-state HBM read bytes PER CHIP per decode token:
+    every weight byte once per step (amortized over the batch's lanes) +
+    the token's own KV context (int8 pools count 1 byte/elt + their fp32
+    scale planes). Under an mp mesh the layer stacks and the KV pages are
+    head/column-sharded — each chip reads 1/mp of them — while the
+    embeddings/LM head/LN leaves are replicated and read whole: exactly
+    the per-chip bandwidth the round-11 tensor-parallel leg buys down."""
     import jax.numpy as jnp
 
     from paddle_tpu.inference.quantize import serving_weight_bytes
 
     cache = sp.cache
-    wb = serving_weight_bytes(sp.params) / max(batch, 1)
+    mp = 1 if sp.mesh is None else int(sp.mesh.shape["mp"])
+    layer_b = serving_weight_bytes({"layers": sp.params["layers"]})
+    repl_b = serving_weight_bytes(sp.params) - layer_b
+    wb = (layer_b / mp + repl_b) / max(batch, 1)
     elt = jnp.dtype(cache.k_pages.dtype).itemsize
     kv = (2 * cache.num_layers * avg_ctx
-          * cache.num_kv_heads * cache.head_dim * elt)
+          * cache.num_kv_heads * cache.head_dim * elt) / mp
     if cache.quantize_kv:
-        kv += 2 * cache.num_layers * avg_ctx * cache.num_kv_heads * 4
+        kv += 2 * cache.num_layers * avg_ctx * cache.num_kv_heads * 4 / mp
     return int(wb + kv)
 
 
 def bench_serving(*, hidden, layers, heads, vocab, batch, prompt, steps,
                   gen_len, page_size, chunk, unified, use_kernel, on_tpu,
-                  dtype=None, weight_dtype=None, kv_cache_dtype=None):
+                  dtype=None, weight_dtype=None, kv_cache_dtype=None,
+                  mesh_chips=1):
     """One serving leg. Returns a dict of the emitted metrics.
 
     Workload: CONTINUOUS arrivals — ``batch`` concurrent requests drawn
@@ -103,10 +116,16 @@ def bench_serving(*, hidden, layers, heads, vocab, batch, prompt, steps,
                     kv_cache_dtype=kv_cache_dtype)
     model = GPTForCausalLM(cfg)
     model.eval()
+    mesh = None
+    if mesh_chips > 1:
+        from paddle_tpu.distributed.mesh import make_serving_mesh
+
+        mesh = make_serving_mesh(mesh_chips)
     sp = ServingPredictor(
         model, max_batch=batch, page_size=page_size, max_seq_len=max_len,
         use_kernel=use_kernel, unified=unified, chunk=chunk,
-        dtype=jnp.bfloat16 if (on_tpu and dtype is None) else dtype)
+        dtype=jnp.bfloat16 if (on_tpu and dtype is None) else dtype,
+        mesh=mesh)
     rng = np.random.RandomState(0)
     pool = [rng.randint(0, vocab, (prompt,)) for _ in range(max(2, batch // 2))]
     arrivals = [0]
@@ -153,8 +172,9 @@ def bench_serving(*, hidden, layers, heads, vocab, batch, prompt, steps,
     ttfts = [r.ttft * 1e3 for r in reqs[timed_from:] if r.ttft is not None]
     if not ttfts:
         ttfts = [r.ttft * 1e3 for r in first_wave]
+    value = round(produced_total / elapsed, 1)
     return dict(
-        value=round(produced_total / elapsed, 1),
+        value=value,
         unit="tokens/s",
         p50_ms=round(_percentile(lat, 50), 2),
         p99_ms=round(_percentile(lat, 99), 2),
@@ -165,6 +185,9 @@ def bench_serving(*, hidden, layers, heads, vocab, batch, prompt, steps,
         prefill_retraces=sp.prefill_trace_count,
         hbm_bytes_per_token=_hbm_bytes_per_token(
             sp, batch, prompt + gen_len // 2),
+        mesh_chips=mesh_chips,
+        mesh_shape=f"mp{mesh_chips}",
+        tokens_per_s_per_chip=round(value / mesh_chips, 1),
     )
 
 
@@ -179,7 +202,16 @@ def main():
         return int(v) if v is not None else default
 
     if smoke:
-        # CPU-runnable CI leg: tiny shapes, gather reference attention
+        # CPU-runnable CI leg: tiny shapes, gather reference attention.
+        # The mesh scaling leg needs >= 2 devices: force virtual host
+        # devices BEFORE the backend initializes (no-op when the caller —
+        # e.g. the pytest conftest — already forced a device count)
+        import os
+
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=2")
         import jax as _j
 
         _j.config.update("jax_platforms", "cpu")
@@ -209,12 +241,21 @@ def main():
     runnable = on_tpu or smoke
     use_kernel = None if on_tpu else False
 
-    # the round-10 quantized A/B: fp unified vs int8-weights vs
-    # int8-weights + int8-KV (each leg rebuilds the model from the same
-    # seed, so the quantizers see identical fp weights)
+    # round-10 quantized A/B (fp unified vs int8-weights vs int8-weights +
+    # int8-KV) + the round-11 mesh scaling leg: the unified step
+    # tensor-parallel over every chip (mp=1 vs mp=N on the same churn).
+    # Each leg rebuilds the model from the same seed, so the quantizers
+    # and the sharder see identical fp weights.
+    # mp must divide BOTH the head count and the ffn width (heads/columns
+    # shard whole): the largest such divisor within the device budget —
+    # e.g. 12 heads on an 8-chip pod serves mp=6, not an error line
+    cap = len(jax.devices()) if on_tpu else min(2, len(jax.devices()))
+    n_mp = max(d for d in range(1, cap + 1)
+               if shape["heads"] % d == 0 and 4 * shape["hidden"] % d == 0)
     legs = [
         ("legacy-two-jit", dict(unified=False)),
         ("unified-step", dict(unified=True)),
+        ("unified-spmd", dict(unified=True, mesh_chips=n_mp)),
         ("unified-int8w", dict(unified=True, weight_dtype="int8")),
         ("unified-int8w-int8kv", dict(unified=True, weight_dtype="int8",
                                       kv_cache_dtype="int8")),
@@ -256,8 +297,11 @@ def main():
             out["vs_baseline"] = 0.0
         print(checked_line(out))
 
+    # mesh leg baselines the fp unified step (mp=1): its vs_baseline IS
+    # the mesh scaling factor on aggregate tokens/s
     _emit("legacy-two-jit", None)
     _emit("unified-step", "legacy-two-jit")
+    _emit("unified-spmd", "unified-step")
     _emit("unified-int8w", "unified-step")
     _emit("unified-int8w-int8kv", "unified-step")
 

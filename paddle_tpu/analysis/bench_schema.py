@@ -35,7 +35,9 @@ _REQUIRED = {
 _OPTIONAL_NUMERIC = ("vs_baseline", "p50_ms", "p99_ms", "anchor_tflops",
                      "anchor_frac_peak", "ttft_p50_ms", "ttft_p99_ms",
                      "prefix_hit_rate", "decode_retraces",
-                     "prefill_retraces", "hbm_bytes_per_token")
+                     "prefill_retraces", "hbm_bytes_per_token",
+                     "mesh_chips", "tokens_per_s_per_chip")
+_OPTIONAL_STRING = ("mesh_shape",)
 
 
 def validate_line(obj) -> list[str]:
@@ -58,6 +60,10 @@ def validate_line(obj) -> list[str]:
                 and not isinstance(obj[key], bool)
                 and math.isfinite(obj[key])):
             problems.append(f"key '{key}' must be a finite number, "
+                            f"got {obj[key]!r}")
+    for key in _OPTIONAL_STRING:
+        if key in obj and not (isinstance(obj[key], str) and obj[key].strip()):
+            problems.append(f"key '{key}' must be a non-empty string, "
                             f"got {obj[key]!r}")
     if "error" in obj and not isinstance(obj["error"], str):
         problems.append(f"key 'error' must be a string, got {obj['error']!r}")

@@ -15,7 +15,12 @@ builds of exactly the programs that carry the repo's numbers:
 - ``serving-quant``  the round-10 quantized serving jits: int8-weight
                   prefill/decode + the int8-weight/int8-KV unified step
                   (jaxpr walk incl. the JX001 scale-promotion audit,
-                  donation of pools AND scale planes).
+                  donation of pools AND scale planes);
+- ``serving-spmd``  the round-11 mesh-sharded serving jits over
+                  ``Mesh(("mp",))``: tensor-parallel prefill/decode + the
+                  sharded quantized unified step (jaxpr walk through the
+                  shard_map body, JX005 donation audit over the
+                  head-sharded pools and scale planes).
 
 Configs are tiny (seconds on CPU; the analysis is abstract — eval_shape /
 make_jaxpr, no FLOPs run) but structurally identical to the flagship
@@ -259,6 +264,97 @@ def analyze_serving_quant() -> list[Finding]:
     return findings
 
 
+def analyze_serving_spmd() -> list[Finding]:
+    """Round-11 multi-chip SPMD serving: the mesh-sharded prefill/decode
+    jits (fp params head-sharded over ``Mesh(("mp",))``) and the sharded
+    int8-weight + int8-KV unified step. The jaxpr walk recurses the
+    shard_map body (collectives included); the JX005 donation audit
+    covers the HEAD-SHARDED pools AND scale planes — a sharded donation
+    that stops aliasing would double per-chip cache memory exactly where
+    capacity is tightest."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from ..distributed.mesh import make_serving_mesh
+    from ..inference.kv_cache import KVCacheManager
+    from ..inference.quantize import quantize_serving_params
+    from ..models.gpt import (GPTConfig, GPTForCausalLM, build_decode_step,
+                              build_prefill, build_unified_step,
+                              serving_params, shard_serving_params)
+
+    cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                    num_heads=2, max_seq_len=32)
+    mesh = make_serving_mesh(2 if len(jax.devices()) >= 2 else 1)
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg)
+    fp_params = shard_serving_params(serving_params(model), mesh, cfg)
+    page_size, chunk, b, s = 8, 4, 2, 8
+    budget = b + chunk
+    rng = np.random.RandomState(0)
+    findings: list[Finding] = []
+
+    # mesh-sharded prefill + decode (fp params, fp pools)
+    mgr = KVCacheManager(cfg.num_layers, cfg.num_heads, cfg.head_dim,
+                         num_pages=2 * b * (cfg.max_seq_len // page_size),
+                         max_batch=b, max_seq_len=cfg.max_seq_len,
+                         page_size=page_size, dtype=jnp.float32, mesh=mesh)
+    ids2d = jnp.asarray(rng.randint(0, 128, (b, s)), jnp.int32)
+    lengths = jnp.full((b,), s, jnp.int32)
+    slots = [mgr.admit(s) for _ in range(b)]
+    pages = jnp.stack([mgr.slot_pages(sl) for sl in slots])
+    prefill = build_prefill(cfg, page_size, mesh=mesh)
+    pre_args = (fp_params, ids2d, lengths, mgr.k_pages, mgr.v_pages, pages)
+    findings += analyze_jaxpr(trace_callable(prefill, *pre_args),
+                              "serving-spmd-prefill")
+    findings += check_donation(prefill, pre_args, (3, 4),
+                               "serving-spmd-prefill")
+    decode = build_decode_step(cfg, page_size, mesh=mesh)
+    dec_args = (fp_params, jnp.zeros((b,), jnp.int32), lengths,
+                mgr.k_pages, mgr.v_pages, pages)
+    findings += analyze_jaxpr(trace_callable(decode, *dec_args),
+                              "serving-spmd-decode")
+    findings += check_donation(decode, dec_args, (3, 4),
+                               "serving-spmd-decode")
+
+    # sharded int8-weight + int8-KV unified step: head-sharded pools AND
+    # scale planes through the donation audit
+    q_params = shard_serving_params(
+        quantize_serving_params(serving_params(model), "int8",
+                                group_size=16), mesh, cfg)
+    qmgr = KVCacheManager(cfg.num_layers, cfg.num_heads, cfg.head_dim,
+                          num_pages=2 * b * (cfg.max_seq_len // page_size),
+                          max_batch=b, max_seq_len=cfg.max_seq_len,
+                          page_size=page_size, dtype=jnp.float32,
+                          quantize_kv=True, mesh=mesh)
+    tok_ids = jnp.asarray(rng.randint(0, 128, (budget,)), jnp.int32)
+    tok_slot = jnp.asarray([0] + [1] * chunk + [-1] * (budget - 1 - chunk),
+                           jnp.int32)
+    tok_pos = jnp.asarray([0] + list(range(chunk))
+                          + [0] * (budget - 1 - chunk), jnp.int32)
+    q_lens = jnp.asarray([1, chunk], jnp.int32)
+    kv_lens = qmgr.seq_lens_device()
+    last_idx = jnp.asarray([0, chunk], jnp.int32)
+    no_cow = jnp.full((b,), qmgr.num_pages, jnp.int32)
+    keys = jnp.zeros((b, 2), jnp.uint32)
+    temp = jnp.asarray([0.0, 0.8], jnp.float32)
+    top_k = jnp.asarray([0, 40], jnp.int32)
+    top_p = jnp.asarray([1.0, 0.9], jnp.float32)
+    step = build_unified_step(cfg, page_size, chunk, kv_quant=True,
+                              mesh=mesh)
+    args = (q_params, tok_ids, tok_slot, tok_pos, q_lens, kv_lens, last_idx,
+            qmgr.k_pages, qmgr.v_pages, qmgr.k_scales, qmgr.v_scales,
+            qmgr.page_table_device(), no_cow, no_cow, keys, temp, top_k,
+            top_p)
+    findings += analyze_jaxpr(trace_callable(step, *args),
+                              "serving-spmd-unified-step")
+    findings += check_donation(step, args, (7, 8, 9, 10),
+                               "serving-spmd-unified-step")
+    return findings
+
+
 TARGETS = {
     "gpt-eager": analyze_gpt_eager,
     "bert-eager": analyze_bert_eager,
@@ -266,6 +362,7 @@ TARGETS = {
     "serving": analyze_serving,
     "serving-unified": analyze_serving_unified,
     "serving-quant": analyze_serving_quant,
+    "serving-spmd": analyze_serving_spmd,
 }
 
 

@@ -131,6 +131,73 @@ class ProcessMesh:
         return self._jax_mesh
 
 
+# ---------------------------------------------------------------------------
+# jax mesh construction — the ONE mesh-shape heuristic (training) and the
+# serving tensor-parallel mesh. Factored here (round 11) from
+# models/gpt_spmd.py so training and serving share a single spelling.
+# ---------------------------------------------------------------------------
+
+
+def choose_mesh_shape(n_devices: int) -> dict[str, int]:
+    """Factor n into (dp, pp, mp) — pp and mp first (they need >=2 to be
+    exercised), dp absorbs the rest."""
+    n = n_devices
+    mp = 2 if n % 2 == 0 else 1
+    pp = 2 if (n // mp) % 2 == 0 else 1
+    dp = n // (mp * pp)
+    return {"dp": dp, "pp": pp, "mp": mp}
+
+
+def make_training_mesh(n_devices: int | None = None) -> Mesh:
+    """The dp x pp x mp training mesh over the first ``n_devices`` chips
+    (all visible devices by default) — ``gpt_spmd.make_mesh``'s home."""
+    devs = _all_devices()
+    n = n_devices or len(devs)
+    shape = choose_mesh_shape(n)
+    arr = np.array(devs[:n]).reshape(shape["dp"], shape["pp"], shape["mp"])
+    return Mesh(arr, ("dp", "pp", "mp"))
+
+
+def make_serving_mesh(mp: int | None = None) -> Mesh:
+    """The 1-D tensor-parallel serving mesh ``Mesh(("mp",))`` over the
+    first ``mp`` devices (all visible devices by default). Serving shards
+    heads/ffn columns over this one axis; there is no dp/pp — continuous
+    batching IS the serving batch axis and the KV pools pin layers to
+    their chips."""
+    devs = _all_devices()
+    mp = len(devs) if mp is None else int(mp)
+    if mp < 1 or mp > len(devs):
+        raise ValueError(
+            f"serving mesh of {mp} chips needs 1..{len(devs)} devices")
+    return Mesh(np.array(devs[:mp]), ("mp",))
+
+
+def as_serving_mesh(mesh) -> Mesh | None:
+    """Normalize a serving ``mesh`` argument: None passes through (the
+    single-chip unsharded path), an int builds ``make_serving_mesh(n)``,
+    a ``jax.sharding.Mesh`` must carry the ``"mp"`` axis."""
+    if mesh is None:
+        return None
+    if isinstance(mesh, Mesh):
+        if "mp" not in mesh.axis_names:
+            raise ValueError(
+                f"serving mesh needs an 'mp' axis, got {mesh.axis_names}")
+        return mesh
+    return make_serving_mesh(int(mesh))
+
+
+def mesh_signature(mesh) -> tuple | None:
+    """Hashable signature of a jax Mesh — axis names + sizes PLUS the
+    device ids it covers — what the serving params cache and the
+    per-geometry jit caches key on (None for the unsharded path). The
+    device ids matter: two same-shape meshes over different device sets
+    must not share cached device_put params or a compiled executable."""
+    if mesh is None:
+        return None
+    return (tuple((name, int(mesh.shape[name])) for name in mesh.axis_names)
+            + (("devices", tuple(int(d.id) for d in mesh.devices.flat)),))
+
+
 _global_mesh: ProcessMesh | None = None
 
 

@@ -195,12 +195,16 @@ class KVCacheManager:
     def __init__(self, num_layers, num_kv_heads, head_dim, *, num_pages,
                  max_batch, max_seq_len, page_size=None, num_q_heads=None,
                  dtype=jnp.float32, enable_prefix_cache=False,
-                 quantize_kv=False):
+                 quantize_kv=False, mesh=None):
         from ..ops.pallas.paged_attention import preferred_page_size
 
         if page_size is None:
             page_size = preferred_page_size(
                 num_q_heads or num_kv_heads, num_kv_heads, head_dim, dtype)
+        if mesh is not None and num_kv_heads % int(mesh.shape["mp"]):
+            raise ValueError(
+                f"the mp mesh size {int(mesh.shape['mp'])} must divide "
+                f"kv heads {num_kv_heads} (pages shard by whole head)")
         self.num_layers = num_layers
         self.num_kv_heads = num_kv_heads
         self.head_dim = head_dim
@@ -226,6 +230,22 @@ class KVCacheManager:
             self.v_scales = jnp.zeros(sshape, jnp.float32)
         else:
             self.k_scales = self.v_scales = None
+        # round 11: under a serving mesh the pools (and scale planes) live
+        # SHARDED on the head axis — each chip owns its heads' pages end
+        # to end; the sharded serving jits return them sharded, so the
+        # pool never materializes whole on one chip
+        self.mesh = mesh
+        if mesh is not None:
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            kv_sh = NamedSharding(mesh, P(None, None, None, "mp", None))
+            self.k_pages = jax.device_put(self.k_pages, kv_sh)
+            self.v_pages = jax.device_put(self.v_pages, kv_sh)
+            if self.quantize_kv:
+                sc_sh = NamedSharding(mesh, P(None, None, None, "mp"))
+                self.k_scales = jax.device_put(self.k_scales, sc_sh)
+                self.v_scales = jax.device_put(self.v_scales, sc_sh)
         # host-side bookkeeping (numpy; uploaded per step as small arrays)
         self._page_table = np.full(
             (self.max_batch, self.pages_per_slot), -1, np.int32)

@@ -104,6 +104,45 @@ def quantize_serving_params(params, weight_dtype="int8", group_size=-1,
     return out
 
 
+#: the row-parallel (K-sharded under the serving mp mesh) layer stacks
+ROW_PARALLEL_KEYS = ("wo", "w2")
+
+
+def assert_quant_shardable(layers, mp: int, weight_dtype=None) -> None:
+    """Validate that the quantized stacks of a serving ``layers`` dict can
+    shard over an ``mp``-way tensor-parallel mesh (round 11).
+
+    Column stacks always shard (the output dim splits with its scales).
+    Row stacks shard their K dim, so grouped scales must tile the mesh
+    (``mp | groups``) — otherwise a chip's K shard would straddle a scale
+    group and the fused kernel's local ``K/groups`` group size would lie.
+    int4 is rejected outright: split-half nibble packing stores rows ``i``
+    and ``K/2 + i`` in one byte, so a contiguous shard of the packed dim
+    owns two INTERLEAVED half-ranges of K — not the contiguous head-major
+    activation shard the row-parallel psum contract needs.
+    """
+    if mp <= 1:
+        return
+    quantized = any(isinstance(layers.get(k), dict)
+                    for k in QUANT_LAYER_KEYS)
+    if quantized and weight_dtype == "int4":
+        raise ValueError(
+            "int4 split-half packing interleaves the K rows of the "
+            "row-parallel stacks — int4 weights serve single-chip only "
+            "(use weight_dtype='int8' under an mp mesh)")
+    for key in ROW_PARALLEL_KEYS:
+        leaf = layers.get(key)
+        if not isinstance(leaf, dict):
+            continue
+        groups = leaf["s"].shape[-2]
+        if groups > 1 and groups % mp:
+            raise ValueError(
+                f"serving stack '{key}': {groups} scale groups are not "
+                f"divisible by the mp mesh size {mp} — choose a "
+                "weight_quant_group_size that makes the group count a "
+                "multiple of mp")
+
+
 def is_quantized_params(params) -> bool:
     """Whether a serving pytree carries quantized weight stacks."""
     return any(isinstance(params["layers"].get(k), dict)

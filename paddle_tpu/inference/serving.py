@@ -116,19 +116,25 @@ class ServingPredictor:
     def __init__(self, model, *, max_batch=8, num_pages=None, page_size=None,
                  max_seq_len=None, use_kernel=None, prefill_bucket=16,
                  dtype=None, unified=True, chunk=None, token_budget=None,
-                 prefix_cache=None, kv_cache_dtype=None):
+                 prefix_cache=None, kv_cache_dtype=None, mesh=None):
+        from ..distributed.mesh import as_serving_mesh
         from ..models.gpt import (_serving_params_cached, build_decode_step,
                                   build_prefill, build_unified_step,
-                                  serving_params)
+                                  serving_params, shard_serving_params)
 
         gpt = model.gpt if hasattr(model, "gpt") else model
         self.config = gpt.config
         cfg = self.config
+        # round 11: mesh (None | int mp degree | Mesh(("mp",))) serves the
+        # steps tensor-parallel — params + KV pools sharded by head, the
+        # scheduler and page/slot/prefix bookkeeping below stay host-global
+        self.mesh = as_serving_mesh(mesh)
         if dtype is None:
             # share the weak-keyed extraction with generate() — a second
             # predictor (or generate call) on one model reuses the stacks
-            # (quantized per cfg.weight_dtype inside the cache)
-            self.params = _serving_params_cached(model)
+            # (quantized per cfg.weight_dtype, sharded per mesh signature,
+            # inside the cache)
+            self.params = _serving_params_cached(model, mesh=self.mesh)
         else:
             import jax
 
@@ -140,6 +146,9 @@ class ServingPredictor:
                 self.params = quantize_serving_params(
                     self.params, cfg.weight_dtype,
                     cfg.weight_quant_group_size)
+            if self.mesh is not None:
+                self.params = shard_serving_params(self.params, self.mesh,
+                                                   cfg)
         # the model's position table bounds every context
         self.max_seq_len = min(int(max_seq_len or cfg.max_seq_len),
                                cfg.max_seq_len)
@@ -168,7 +177,8 @@ class ServingPredictor:
             num_pages=num_pages, max_batch=self.max_batch,
             max_seq_len=self.max_seq_len, page_size=page_size,
             num_q_heads=cfg.num_heads, dtype=kv_dtype,
-            enable_prefix_cache=prefix_cache, quantize_kv=self.kv_quant)
+            enable_prefix_cache=prefix_cache, quantize_kv=self.kv_quant,
+            mesh=self.mesh)
         self.chunk = int(chunk or preferred_chunk_size(
             cfg.num_heads, cfg.num_heads, cfg.head_dim, kv_dtype))
         self.token_budget = int(token_budget or
@@ -176,15 +186,18 @@ class ServingPredictor:
         if self.unified:
             self._unified = build_unified_step(
                 cfg, self.cache.page_size, self.chunk,
-                use_kernel=use_kernel, kv_quant=self.kv_quant)
+                use_kernel=use_kernel, kv_quant=self.kv_quant,
+                mesh=self.mesh)
             self._prefill = self._decode = None
         else:
             self._unified = None
             self._decode = build_decode_step(cfg, self.cache.page_size,
-                                             use_kernel=use_kernel)
+                                             use_kernel=use_kernel,
+                                             mesh=self.mesh)
             # one jitted prefill; jax.jit caches one executable per prompt
             # bucket shape (prompts are padded to _bucket multiples)
-            self._prefill = build_prefill(cfg, self.cache.page_size)
+            self._prefill = build_prefill(cfg, self.cache.page_size,
+                                          mesh=self.mesh)
         self.waiting: deque[Request] = deque()
         self.running: dict[int, Request] = {}   # slot -> request
         self._next_token = np.zeros((self.max_batch,), np.int32)

@@ -431,8 +431,10 @@ def serving_params(model):
     cfg = gpt.config
     if _tp_enabled(cfg):
         raise NotImplementedError(
-            "the paged serving path is single-shard (GSPMD cannot partition "
-            "the pallas decode kernel); run without tensor parallelism")
+            "serving params extract from a single-shard eager model; for "
+            "multi-chip serving pass mesh=... to generate_paged / "
+            "ServingPredictor (round-11 SPMD serving) instead of enabling "
+            "the eager TP layers")
 
     params = {k: t._data for k, t in _srv_nonlayer_weights(model)}
     params["layers"] = {
@@ -482,16 +484,155 @@ def _srv_mm(y, w, use_kernel=None):
     return y @ w
 
 
-def _srv_mlp(p, y, use_kernel=None):
+def _srv_psum(x, axis):
+    """The serving collective hook: under the mp mesh the row-parallel
+    matmul partials all-reduce here; single-chip (axis None) it is the
+    identity — ONE spelling of the block math serves both paths."""
     import jax
 
-    return (_srv_mm(jax.nn.gelu(_srv_mm(y, p["w1"], use_kernel) + p["b1"],
-                                approximate=True), p["w2"], use_kernel)
+    return jax.lax.psum(x, axis) if axis else x
+
+
+def _srv_mlp(p, y, use_kernel=None, axis=None):
+    import jax
+
+    return (_srv_psum(
+        _srv_mm(jax.nn.gelu(_srv_mm(y, p["w1"], use_kernel) + p["b1"],
+                            approximate=True), p["w2"], use_kernel), axis)
             + p["b2"])
 
 
+def _split_qkv(qkv, nh, hd, head_major):
+    """[..., 3*nh*hd] -> (q, k, v) each [..., nh, hd]. The eager layout
+    orders the fused projection's columns [3, nh, hd]; the mesh layout is
+    HEAD-MAJOR [nh, 3, hd] (``shard_serving_params`` permutes the columns)
+    so a contiguous mp shard owns whole heads. Both splits read the same
+    dot products — bit-identical outputs, only column order moves."""
+    lead = qkv.shape[:-1]
+    if head_major:
+        q4 = qkv.reshape(*lead, nh, 3, hd)
+        return q4[..., 0, :], q4[..., 1, :], q4[..., 2, :]
+    q4 = qkv.reshape(*lead, 3, nh, hd)
+    return q4[..., 0, :, :], q4[..., 1, :, :], q4[..., 2, :, :]
+
+
+# ---------------------------------------------------------------------------
+# Round-11 multi-chip SPMD serving: Megatron tensor-parallel layout for the
+# serving pytree over a Mesh(("mp",)). Column-parallel stacks (wqkv, w1 —
+# qkv permuted head-major first) shard their output dim, row-parallel
+# stacks (wo, w2) their input dim; embeddings / LM head / LN / row biases
+# stay replicated. The KV page pools and their int8 scale planes shard on
+# the HEAD axis (each chip owns its heads' pages end to end — zero KV
+# bytes on the wire); the only collectives in a serving step are the two
+# row-parallel psums per layer (_srv_psum).
+# ---------------------------------------------------------------------------
+
+
+def _head_major_perm(nh, hd):
+    """Column permutation taking the fused qkv projection's [3, nh, hd]
+    output order to [nh, 3, hd] — whole heads become contiguous so the mp
+    axis shards them (a contiguous chunk of the eager layout would split
+    the q/k/v thirds, not the heads)."""
+    import numpy as np
+
+    return np.arange(3 * nh * hd).reshape(3, nh, hd).transpose(
+        1, 0, 2).reshape(-1)
+
+
+def serving_param_specs(params, axis="mp"):
+    """PartitionSpec tree mirroring a serving params pytree (fp or
+    quantized) — the serving twin of ``gpt_spmd.param_specs``. Quantized
+    ``{"q", "s"}`` stacks shard with their weight: column scales follow
+    the output dim; row (K-sharded) group scales shard over the group dim,
+    per-channel row scales replicate (each chip's partial product scales
+    by the same output-channel factor before the psum)."""
+    from jax.sharding import PartitionSpec as P
+
+    col = {"wqkv", "w1"}
+    row = {"wo", "w2"}
+    cbias = {"bqkv", "b1"}
+
+    def stack_spec(key, leaf):
+        if key in col:
+            if isinstance(leaf, dict):
+                return {"q": P(None, None, axis), "s": P(None, None, axis)}
+            return P(None, None, axis)
+        if key in row:
+            if isinstance(leaf, dict):
+                s_spec = (P(None, axis, None) if leaf["s"].shape[1] > 1
+                          else P())
+                return {"q": P(None, axis, None), "s": s_spec}
+            return P(None, axis, None)
+        if key in cbias:
+            return P(None, axis)
+        return P()
+
+    out = {k: P() for k in params if k != "layers"}
+    out["layers"] = {k: stack_spec(k, v)
+                     for k, v in params["layers"].items()}
+    return out
+
+
+def shard_serving_params(params, mesh, config):
+    """Lay a serving params pytree (fp or quantized) out over the mp mesh:
+    permute wqkv/bqkv head-major, validate divisibility, and device_put
+    every leaf under :func:`serving_param_specs`. Returns a NEW pytree of
+    committed sharded arrays (the unsharded source stays usable)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..inference.quantize import assert_quant_shardable
+
+    mp = int(mesh.shape["mp"])
+    nh, hd = config.num_heads, config.head_dim
+    if nh % mp:
+        raise ValueError(
+            f"the mp mesh size {mp} must divide num_heads {nh} "
+            "(heads shard whole)")
+    if config.ffn_size % mp:
+        raise ValueError(
+            f"the mp mesh size {mp} must divide ffn_size {config.ffn_size}")
+    assert_quant_shardable(params["layers"], mp,
+                           getattr(config, "weight_dtype", None))
+    perm = jnp.asarray(_head_major_perm(nh, hd))
+
+    def permute(leaf):
+        if isinstance(leaf, dict):
+            return {"q": leaf["q"][..., perm], "s": leaf["s"][..., perm]}
+        return leaf[..., perm]
+
+    layers = dict(params["layers"])
+    layers["wqkv"] = permute(layers["wqkv"])
+    layers["bqkv"] = layers["bqkv"][..., perm]
+    out = dict(params)
+    out["layers"] = layers
+    specs = serving_param_specs(out)
+    shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                             is_leaf=lambda x: isinstance(x, P))
+    return jax.device_put(out, shardings)
+
+
+def _mesh_mp(mesh):
+    """(mp degree, psum axis name or None) for a serving mesh argument."""
+    if mesh is None:
+        return 1, None
+    return int(mesh.shape["mp"]), "mp"
+
+
+# KV pool / scale-plane PartitionSpecs under the serving mesh: pools are
+# [L, num_pages, page_size, kv_heads, head_dim] (scales drop the trailing
+# head_dim) — the HEAD axis shards, so every chip owns its heads' pages
+# (and their scales) end to end: quantize-on-write, CoW copies and prefix
+# reuse all stay chip-local, zero KV bytes cross the interconnect.
+def _kv_specs():
+    from jax.sharding import PartitionSpec as P
+
+    return P(None, None, None, "mp", None), P(None, None, None, "mp")
+
+
 def build_prefill(config: GPTConfig, page_size: int,
-                  use_kernel: bool | None = None):
+                  use_kernel: bool | None = None, mesh=None):
     """One-jit prefill: forward the (right-padded) prompts, scatter each
     slot's K/V into its pages, return the next-token ids + logits at each
     prompt's last valid position.
@@ -501,6 +642,12 @@ def build_prefill(config: GPTConfig, page_size: int,
     Ragged prompts ride right-padding: causal masking keeps padded columns
     out of every valid row's softmax, and the page scatter drops positions
     past each length.
+
+    ``mesh`` (round 11): a ``Mesh(("mp",))`` shards the step — params per
+    :func:`serving_param_specs` (head-major qkv), pools on the head axis —
+    via ``shard_map``; attention/K-V writes run chip-local over each
+    chip's heads and only the row-parallel matmuls psum. The signature,
+    donation and trace-count contract are unchanged.
     """
     import jax
     import jax.numpy as jnp
@@ -510,27 +657,18 @@ def build_prefill(config: GPTConfig, page_size: int,
     cfg = config
     eps = cfg.layer_norm_eps
     trace_count = [0]
-
-    def prefill(params, ids, lengths, k_pages, v_pages, pages):
-        # MXU-native matmul precision (gpt_spmd.loss_fn convention): the
-        # framework-global "highest" would emulate bf16 serving matmuls
-        # multi-pass, 3-6x slower; attention scores stay explicit fp32
-        with jax.default_matmul_precision("default"):
-            return _prefill_inner(params, ids, lengths, k_pages, v_pages,
-                                  pages)
+    mp, axis = _mesh_mp(mesh)
+    nh_l, hd = cfg.num_heads // mp, cfg.head_dim
 
     def _prefill_inner(params, ids, lengths, k_pages, v_pages, pages):
-        trace_count[0] += 1
         b, s = ids.shape
-        nh, hd = cfg.num_heads, cfg.head_dim
         x = (jnp.take(params["tok_emb"], ids, axis=0)
              + params["pos_emb"][:s])
 
         def block(x, p):
             y = _srv_ln(x, p["ln1_g"], p["ln1_b"], eps)
-            qkv = (_srv_mm(y, p["wqkv"], use_kernel)
-                   + p["bqkv"]).reshape(b, s, 3, nh, hd)
-            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+            qkv = _srv_mm(y, p["wqkv"], use_kernel) + p["bqkv"]
+            q, k, v = _split_qkv(qkv, nh_l, hd, head_major=mesh is not None)
             s_ = jnp.einsum("bqnd,bknd->bnqk", q.astype(jnp.float32),
                             k.astype(jnp.float32)) / math.sqrt(hd)
             causal = jnp.tril(jnp.ones((s, s), bool))
@@ -538,10 +676,10 @@ def build_prefill(config: GPTConfig, page_size: int,
             a = jnp.einsum("bnqk,bknd->bqnd",
                            jax.nn.softmax(s_, axis=-1),
                            v.astype(jnp.float32)).astype(x.dtype)
-            x = x + _srv_mm(a.reshape(b, s, nh * hd), p["wo"],
-                            use_kernel) + p["bo"]
+            x = x + _srv_psum(_srv_mm(a.reshape(b, s, nh_l * hd), p["wo"],
+                                      use_kernel), axis) + p["bo"]
             x = x + _srv_mlp(p, _srv_ln(x, p["ln2_g"], p["ln2_b"], eps),
-                             use_kernel)
+                             use_kernel, axis)
             return x, (k, v)
 
         x, (ks, vs) = jax.lax.scan(block, x, params["layers"])
@@ -563,6 +701,26 @@ def build_prefill(config: GPTConfig, page_size: int,
         v_pages = write_all(v_pages, vs)
         return next_ids, logits, k_pages, v_pages
 
+    def prefill(params, ids, lengths, k_pages, v_pages, pages):
+        trace_count[0] += 1
+        if mesh is not None:
+            from jax.sharding import PartitionSpec as P
+
+            kv_spec, _ = _kv_specs()
+            body = jax.shard_map(
+                _prefill_inner, mesh=mesh,
+                in_specs=(serving_param_specs(params), P(), P(), kv_spec,
+                          kv_spec, P()),
+                out_specs=(P(), P(), kv_spec, kv_spec),
+                check_vma=False)
+        else:
+            body = _prefill_inner
+        # MXU-native matmul precision (gpt_spmd.loss_fn convention): the
+        # framework-global "highest" would emulate bf16 serving matmuls
+        # multi-pass, 3-6x slower; attention scores stay explicit fp32
+        with jax.default_matmul_precision("default"):
+            return body(params, ids, lengths, k_pages, v_pages, pages)
+
     # donate the pools like the decode step: every admission threads the
     # full cache through this jit, and an un-donated scatter would copy it
     jitted = jax.jit(prefill, donate_argnums=(3, 4))
@@ -573,7 +731,7 @@ def build_prefill(config: GPTConfig, page_size: int,
 
 
 def build_decode_step(config: GPTConfig, page_size: int,
-                      use_kernel: bool | None = None):
+                      use_kernel: bool | None = None, mesh=None):
     """The fixed-shape decode step, compiled once per (batch, cache
     geometry): embed the incoming token, write its K/V into the pages,
     paged-attend over every layer, emit the greedy next token.
@@ -585,6 +743,11 @@ def build_decode_step(config: GPTConfig, page_size: int,
     argument keeps its shape step over step, so after the first call the
     loop replays one compiled program — ``fn.trace_count[0]`` exposes the
     trace count for the no-retrace gate.
+
+    ``mesh`` (round 11): shard over ``Mesh(("mp",))`` — the paged
+    attention kernel runs per chip over its own heads' pages (shard_map;
+    GSPMD never sees the pallas_call), psums only on the row-parallel
+    matmuls. Same signature/donation/trace contract.
     """
     import jax
     import jax.numpy as jnp
@@ -595,17 +758,11 @@ def build_decode_step(config: GPTConfig, page_size: int,
     cfg = config
     eps = cfg.layer_norm_eps
     trace_count = [0]
-
-    def step(params, ids, lengths, k_pages, v_pages, page_table):
-        # MXU-native matmul precision — see build_prefill
-        with jax.default_matmul_precision("default"):
-            return _step_inner(params, ids, lengths, k_pages, v_pages,
-                               page_table)
+    mp, axis = _mesh_mp(mesh)
+    nh_l, hd = cfg.num_heads // mp, cfg.head_dim
 
     def _step_inner(params, ids, lengths, k_pages, v_pages, page_table):
-        trace_count[0] += 1
         b = ids.shape[0]
-        nh, hd = cfg.num_heads, cfg.head_dim
         active = lengths > 0
         pos = jnp.where(active, lengths, -1)  # write position = current len
         pos_emb_idx = jnp.clip(jnp.maximum(lengths, 0),
@@ -617,17 +774,17 @@ def build_decode_step(config: GPTConfig, page_size: int,
         def block(x, layer):
             p, kp, vp = layer
             y = _srv_ln(x, p["ln1_g"], p["ln1_b"], eps)
-            qkv = (_srv_mm(y, p["wqkv"], use_kernel)
-                   + p["bqkv"]).reshape(b, 3, nh, hd)
-            q, k_tok, v_tok = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+            qkv = _srv_mm(y, p["wqkv"], use_kernel) + p["bqkv"]
+            q, k_tok, v_tok = _split_qkv(qkv, nh_l, hd,
+                                         head_major=mesh is not None)
             kp = paged_write_tokens(kp, k_tok, page_table, pos, page_size)
             vp = paged_write_tokens(vp, v_tok, page_table, pos, page_size)
             a = paged_attention(q, kp, vp, page_table, ctx,
-                                use_kernel=use_kernel)  # [b, nh, hd]
-            x = x + _srv_mm(a.reshape(b, nh * hd), p["wo"],
-                            use_kernel) + p["bo"]
+                                use_kernel=use_kernel)  # [b, nh_l, hd]
+            x = x + _srv_psum(_srv_mm(a.reshape(b, nh_l * hd), p["wo"],
+                                      use_kernel), axis) + p["bo"]
             x = x + _srv_mlp(p, _srv_ln(x, p["ln2_g"], p["ln2_b"], eps),
-                             use_kernel)
+                             use_kernel, axis)
             return x, (kp, vp)
 
         x, (k_pages, v_pages) = jax.lax.scan(
@@ -636,6 +793,24 @@ def build_decode_step(config: GPTConfig, page_size: int,
         logits = _srv_logits(params, x).astype(jnp.float32)
         next_ids = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         return next_ids, logits, k_pages, v_pages
+
+    def step(params, ids, lengths, k_pages, v_pages, page_table):
+        trace_count[0] += 1
+        if mesh is not None:
+            from jax.sharding import PartitionSpec as P
+
+            kv_spec, _ = _kv_specs()
+            body = jax.shard_map(
+                _step_inner, mesh=mesh,
+                in_specs=(serving_param_specs(params), P(), P(), kv_spec,
+                          kv_spec, P()),
+                out_specs=(P(), P(), kv_spec, kv_spec),
+                check_vma=False)
+        else:
+            body = _step_inner
+        # MXU-native matmul precision — see build_prefill
+        with jax.default_matmul_precision("default"):
+            return body(params, ids, lengths, k_pages, v_pages, page_table)
 
     # donate the page pools: the step rewrites them, and double-buffering
     # the cache (the biggest serving allocation) would halve capacity
@@ -681,7 +856,7 @@ def _sample_epilogue(logits, keys, temperature, top_k, top_p):
 
 def build_unified_step(config: GPTConfig, page_size: int, chunk: int,
                        use_kernel: bool | None = None,
-                       kv_quant: bool = False):
+                       kv_quant: bool = False, mesh=None):
     """ONE fixed-shape serving step for mixed ragged prefill + decode,
     driven by a per-step TOKEN BUDGET.
 
@@ -730,6 +905,16 @@ def build_unified_step(config: GPTConfig, page_size: int, chunk: int,
            k_pages, v_pages, k_scales, v_scales, page_table, cow_src,
            cow_dst, keys, temperature, top_k, top_p)
         -> (next_ids, logits, k_pages, v_pages, k_scales, v_scales)
+
+    ``mesh`` (round 11) shards the whole step over ``Mesh(("mp",))`` via
+    ``shard_map``: params per :func:`serving_param_specs` (qkv head-major
+    — see :func:`shard_serving_params`), pools AND scale planes on the
+    head axis, so quantize-on-write, the CoW lanes and the ragged
+    attention kernel all run chip-local over each chip's heads — the only
+    wire traffic is the two row-parallel psums per layer. Embeddings/LM
+    head/logits/sampling replicate (every chip computes the identical
+    epilogue). Signature, donation of all pools + scale planes, and the
+    one-trace-per-geometry guarantee are unchanged.
     """
     import jax
     import jax.numpy as jnp
@@ -741,35 +926,68 @@ def build_unified_step(config: GPTConfig, page_size: int, chunk: int,
     cfg = config
     eps = cfg.layer_norm_eps
     trace_count = [0]
+    mp, axis = _mesh_mp(mesh)
+    nh_l, hd = cfg.num_heads // mp, cfg.head_dim
+
+    def _fp_body(params, tok_ids, tok_slot, tok_pos, q_lens, kv_lens,
+                 last_idx, k_pages, v_pages, page_table, cow_src, cow_dst,
+                 keys, temperature, top_k, top_p):
+        return _step_inner(params, tok_ids, tok_slot, tok_pos, q_lens,
+                           kv_lens, last_idx, k_pages, v_pages, None, None,
+                           page_table, cow_src, cow_dst, keys, temperature,
+                           top_k, top_p)
 
     def step(params, tok_ids, tok_slot, tok_pos, q_lens, kv_lens, last_idx,
              k_pages, v_pages, page_table, cow_src, cow_dst, keys,
              temperature, top_k, top_p):
+        trace_count[0] += 1
+        body = _fp_body
+        if mesh is not None:
+            from jax.sharding import PartitionSpec as P
+
+            kv_spec, _ = _kv_specs()
+            rep = P()
+            body = jax.shard_map(
+                _fp_body, mesh=mesh,
+                in_specs=(serving_param_specs(params),) + (rep,) * 6
+                + (kv_spec, kv_spec) + (rep,) * 7,
+                out_specs=(rep, rep, kv_spec, kv_spec),
+                check_vma=False)
         # MXU-native matmul precision — see build_prefill
         with jax.default_matmul_precision("default"):
-            return _step_inner(params, tok_ids, tok_slot, tok_pos, q_lens,
-                               kv_lens, last_idx, k_pages, v_pages, None,
-                               None, page_table, cow_src, cow_dst, keys,
-                               temperature, top_k, top_p)
+            return body(params, tok_ids, tok_slot, tok_pos, q_lens,
+                        kv_lens, last_idx, k_pages, v_pages, page_table,
+                        cow_src, cow_dst, keys, temperature, top_k, top_p)
 
     def step_quant(params, tok_ids, tok_slot, tok_pos, q_lens, kv_lens,
                    last_idx, k_pages, v_pages, k_scales, v_scales,
                    page_table, cow_src, cow_dst, keys, temperature, top_k,
                    top_p):
+        trace_count[0] += 1
+        body = _step_inner
+        if mesh is not None:
+            from jax.sharding import PartitionSpec as P
+
+            kv_spec, sc_spec = _kv_specs()
+            rep = P()
+            body = jax.shard_map(
+                _step_inner, mesh=mesh,
+                in_specs=(serving_param_specs(params),) + (rep,) * 6
+                + (kv_spec, kv_spec, sc_spec, sc_spec) + (rep,) * 7,
+                out_specs=(rep, rep, kv_spec, kv_spec, sc_spec, sc_spec),
+                check_vma=False)
         with jax.default_matmul_precision("default"):
-            return _step_inner(params, tok_ids, tok_slot, tok_pos, q_lens,
-                               kv_lens, last_idx, k_pages, v_pages,
-                               k_scales, v_scales, page_table, cow_src,
-                               cow_dst, keys, temperature, top_k, top_p)
+            return body(params, tok_ids, tok_slot, tok_pos, q_lens,
+                        kv_lens, last_idx, k_pages, v_pages, k_scales,
+                        v_scales, page_table, cow_src, cow_dst, keys,
+                        temperature, top_k, top_p)
 
     def _step_inner(params, tok_ids, tok_slot, tok_pos, q_lens, kv_lens,
                     last_idx, k_pages, v_pages, k_scales, v_scales,
                     page_table, cow_src, cow_dst, keys, temperature, top_k,
                     top_p):
-        trace_count[0] += 1
         t = tok_ids.shape[0]
         b = q_lens.shape[0]
-        nh, hd = cfg.num_heads, cfg.head_dim
         # copy-on-write BEFORE any write: diverging lanes get a private
         # copy of their shared tail page across every layer (scale planes
         # are page-keyed, so they ride the same copy lanes)
@@ -797,9 +1015,9 @@ def build_unified_step(config: GPTConfig, page_size: int, chunk: int,
                 p, kp, vp = layer
                 ks = vs = None
             y = _srv_ln(x, p["ln1_g"], p["ln1_b"], eps)
-            qkv = (_srv_mm(y, p["wqkv"], use_kernel)
-                   + p["bqkv"]).reshape(t, 3, nh, hd)
-            q, k_t, v_t = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+            qkv = _srv_mm(y, p["wqkv"], use_kernel) + p["bqkv"]
+            q, k_t, v_t = _split_qkv(qkv, nh_l, hd,
+                                     head_major=mesh is not None)
             if kv_quant:
                 kp, ks = paged_write_packed_quant(
                     kp, ks, k_t, page_table, tok_slot, tok_pos, page_size)
@@ -810,16 +1028,16 @@ def build_unified_step(config: GPTConfig, page_size: int, chunk: int,
                                         tok_pos, page_size)
                 vp = paged_write_packed(vp, v_t, page_table, tok_slot,
                                         tok_pos, page_size)
-            qb = jnp.zeros((b, chunk, nh, hd), q.dtype
+            qb = jnp.zeros((b, chunk, nh_l, hd), q.dtype
                            ).at[scatter_b, off_c].set(q, mode="drop")
             ab = ragged_paged_attention(qb, kp, vp, page_table, ctx, q_lens,
                                         use_kernel=use_kernel,
                                         k_scales=ks, v_scales=vs)
             a = ab[slot_c, off_c]                    # back to packed [t]
-            x = x + _srv_mm(a.reshape(t, nh * hd), p["wo"],
-                            use_kernel) + p["bo"]
+            x = x + _srv_psum(_srv_mm(a.reshape(t, nh_l * hd), p["wo"],
+                                      use_kernel), axis) + p["bo"]
             x = x + _srv_mlp(p, _srv_ln(x, p["ln2_g"], p["ln2_b"], eps),
-                             use_kernel)
+                             use_kernel, axis)
             return x, ((kp, vp, ks, vs) if kv_quant else (kp, vp))
 
         if kv_quant:
@@ -878,32 +1096,45 @@ def _quant_sig(cfg: GPTConfig):
             getattr(cfg, "weight_quant_group_size", -1))
 
 
-def _serving_params_cached(model):
+def _serving_params_cached(model, mesh=None):
     # staleness check by buffer IDENTITY against WEAKLY-held capture-time
     # buffers: identity comparison is immune to CPython id reuse, and the
     # weakrefs mean an optimizer step's rebinding doesn't leave ~1x model
     # weights of dead buffers pinned by the cache key (a dead ref simply
-    # reads as stale)
+    # reads as stale). Round 11: the cached value is a per-MESH-SIGNATURE
+    # dict (None = the unsharded extraction; every sharded layout derives
+    # from it), so two mesh sizes neither collide nor evict each other.
+    from ..distributed.mesh import mesh_signature
+
     cfg = (model.gpt if hasattr(model, "gpt") else model).config
     qsig = _quant_sig(cfg)
+    msig = mesh_signature(mesh)
     bufs = _serving_weight_buffers(model)
     hit = _SERVING_PARAMS_CACHE.get(model)
     if (hit is not None and len(hit[0]) == len(bufs)
             and hit[2] == qsig
             and all(ref() is cur for ref, cur in zip(hit[0], bufs))):
-        return hit[1]
-    params = serving_params(model)
-    if cfg.weight_dtype is not None:
-        from ..inference.quantize import quantize_serving_params
+        by_mesh = hit[1]
+    else:
+        by_mesh = {}
+        try:
+            _SERVING_PARAMS_CACHE[model] = (
+                [_weakref.ref(b) for b in bufs], by_mesh, qsig)
+        except TypeError:
+            pass  # un-weakrefable model object: just skip the cache
+    if None not in by_mesh:
+        params = serving_params(model)
+        if cfg.weight_dtype is not None:
+            from ..inference.quantize import quantize_serving_params
 
-        params = quantize_serving_params(
-            params, cfg.weight_dtype, cfg.weight_quant_group_size)
-    try:
-        _SERVING_PARAMS_CACHE[model] = (
-            [_weakref.ref(b) for b in bufs], params, qsig)
-    except TypeError:
-        pass  # un-weakrefable model object: just skip the cache
-    return params
+            params = quantize_serving_params(
+                params, cfg.weight_dtype, cfg.weight_quant_group_size)
+        by_mesh[None] = params
+    if msig is None:
+        return by_mesh[None]
+    if msig not in by_mesh:
+        by_mesh[msig] = shard_serving_params(by_mesh[None], mesh, cfg)
+    return by_mesh[msig]
 
 
 def _jit_cache_get(key, build):
@@ -927,29 +1158,36 @@ def _cfg_key(config: GPTConfig):
                  for f in dataclasses.fields(config))
 
 
-def _serving_fns(config: GPTConfig, page_size: int, use_kernel):
+def _serving_fns(config: GPTConfig, page_size: int, use_kernel, mesh=None):
+    from ..distributed.mesh import mesh_signature
+
     return _jit_cache_get(
-        ("legacy", _cfg_key(config), page_size, use_kernel),
+        ("legacy", _cfg_key(config), page_size, use_kernel,
+         mesh_signature(mesh)),
         lambda: (build_prefill(config, page_size,
-                               use_kernel=use_kernel),
+                               use_kernel=use_kernel, mesh=mesh),
                  build_decode_step(config, page_size,
-                                   use_kernel=use_kernel)))
+                                   use_kernel=use_kernel, mesh=mesh)))
 
 
 def _unified_fn(config: GPTConfig, page_size: int, chunk: int, use_kernel,
-                kv_quant=False):
+                kv_quant=False, mesh=None):
+    # the mesh SIGNATURE keys the cache (satellite of round 11): two mesh
+    # sizes get two entries — neither collides with nor retraces the other
+    from ..distributed.mesh import mesh_signature
+
     return _jit_cache_get(
         ("unified", _cfg_key(config), page_size, chunk, use_kernel,
-         kv_quant),
+         kv_quant, mesh_signature(mesh)),
         lambda: build_unified_step(config, page_size, chunk,
                                    use_kernel=use_kernel,
-                                   kv_quant=kv_quant))
+                                   kv_quant=kv_quant, mesh=mesh))
 
 
 def generate_paged(model, input_ids, max_new_tokens=20, *, page_size=None,
                    num_pages=None, use_kernel=None, eos_token_id=None,
                    chunk=None, temperature=0.0, top_k=0, top_p=1.0,
-                   seed=0):
+                   seed=0, mesh=None):
     """Autoregressive generation over the paged KV cache — round 9: ONE
     unified-step jit serves prefill chunks and decode tokens alike.
 
@@ -962,6 +1200,12 @@ def generate_paged(model, input_ids, max_new_tokens=20, *, page_size=None,
     temperature/top-k/top-p epilogue (``seed`` makes it reproducible).
     With ``eos_token_id``, a row that stops early frees its cache pages,
     its lane goes inert, and its remaining columns pad with the eos id.
+
+    Round 11: ``mesh`` (None, an int mp degree, or a ``Mesh(("mp",))``)
+    serves the step tensor-parallel — params head/column-sharded, the KV
+    pools and scale planes sharded by head — through the SAME scheduler
+    loop; the host-side page/slot bookkeeping stays global. ``mesh=1``
+    runs the sharded program on one chip, bit-identical to ``mesh=None``.
 
     Round 10: ``config.weight_dtype`` ("int8"/"int4") serves the decoder
     matmuls through the fused weight-only Pallas GEMM (weights stay
@@ -979,6 +1223,9 @@ def generate_paged(model, input_ids, max_new_tokens=20, *, page_size=None,
                                       pages_needed)
     from ..tensor.tensor import Tensor
 
+    from ..distributed.mesh import as_serving_mesh
+
+    mesh = as_serving_mesh(mesh)
     cfg = (model.gpt if hasattr(model, "gpt") else model).config
     ids_np = np.asarray(input_ids.numpy() if isinstance(input_ids, Tensor)
                         else input_ids).astype(np.int32)
@@ -993,7 +1240,7 @@ def generate_paged(model, input_ids, max_new_tokens=20, *, page_size=None,
         raise ValueError(
             f"prompt {s} + max_new_tokens {max_new_tokens} exceeds "
             f"max_seq_len {cfg.max_seq_len}")
-    params = _serving_params_cached(model)
+    params = _serving_params_cached(model, mesh=mesh)
     dtype = params["tok_emb"].dtype
     if page_size is None or chunk is None:
         from ..ops.pallas.paged_attention import (preferred_chunk_size,
@@ -1010,7 +1257,7 @@ def generate_paged(model, input_ids, max_new_tokens=20, *, page_size=None,
         cfg.num_layers, cfg.num_heads, cfg.head_dim,
         num_pages=num_pages or b * pages_needed(total, page_size),
         max_batch=b, max_seq_len=total, page_size=page_size, dtype=dtype,
-        quantize_kv=kv_quant)
+        quantize_kv=kv_quant, mesh=mesh)
     contexts = [[int(t) for t in row] for row in ids_np]
     slots: list = []
     for ctx in contexts:
@@ -1018,7 +1265,7 @@ def generate_paged(model, input_ids, max_new_tokens=20, *, page_size=None,
         slots.append(slot)                # ServingPredictor owns that path
 
     step = _unified_fn(cfg, mgr.page_size, int(chunk), use_kernel,
-                       kv_quant=kv_quant)
+                       kv_quant=kv_quant, mesh=mesh)
     traces_at_entry = step.trace_count[0]
     chunk = int(chunk)
     # token budget: every row can feed a full chunk each round (generate

@@ -37,22 +37,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .gpt import GPTConfig
 
 
-def choose_mesh_shape(n_devices: int) -> dict[str, int]:
-    """Factor n into (dp, pp, mp) — pp and mp first (they need >=2 to be
-    exercised), dp absorbs the rest."""
-    n = n_devices
-    mp = 2 if n % 2 == 0 else 1
-    pp = 2 if (n // mp) % 2 == 0 else 1
-    dp = n // (mp * pp)
-    return {"dp": dp, "pp": pp, "mp": mp}
-
-
-def make_mesh(n_devices: int | None = None) -> Mesh:
-    devs = jax.devices()
-    n = n_devices or len(devs)
-    shape = choose_mesh_shape(n)
-    arr = np.array(devs[:n]).reshape(shape["dp"], shape["pp"], shape["mp"])
-    return Mesh(arr, ("dp", "pp", "mp"))
+# the ONE mesh-shape heuristic lives in distributed.mesh (round 11 —
+# serving shares it); these names stay importable from here
+from ..distributed.mesh import (choose_mesh_shape,  # noqa: F401
+                                make_training_mesh as make_mesh)
 
 
 # ---------------------------------------------------------------------------

@@ -43,6 +43,15 @@ GQA group minor) so one MXU dot serves the whole chunk; the per-row causal
 limit is ``kv_start + row // group + 1``. The per-step chunk size is a
 trace-time constant autotuned on the shared cache
 (:func:`preferred_chunk_size` / :func:`autotune_chunk_size`).
+
+SPMD contract (round 11): under the multi-chip serving mesh these kernels
+run PER CHIP inside a fully-manual ``shard_map`` over ``Mesh(("mp",))`` —
+the caller hands in its chip's head shard of q and the head-sharded page
+pools / scale planes, and the grid's ``kv_heads`` dim is simply the local
+head count. Heads are embarrassingly parallel in paged attention (each
+(slot, head) program reads only its own pages), so no collectives exist
+at this level and GSPMD never has to partition the ``pallas_call`` — the
+same per-shard discipline as the flash kernel under TP training.
 """
 from __future__ import annotations
 

@@ -47,6 +47,60 @@ class TestProcessMesh:
         assert m2.shape == [2, 2]
 
 
+class TestMeshConstruction:
+    """Round 11: the ONE mesh-shape heuristic (distributed.mesh) shared by
+    training (gpt_spmd) and serving."""
+
+    def test_choose_mesh_shape_factors(self):
+        from paddle_tpu.distributed.mesh import choose_mesh_shape
+
+        for n in (1, 2, 3, 4, 6, 8, 12, 16):
+            s = choose_mesh_shape(n)
+            assert s["dp"] * s["pp"] * s["mp"] == n
+            assert min(s.values()) >= 1
+        # pp and mp claim factors of 2 first (they need >= 2 to be
+        # exercised); dp absorbs the rest
+        assert choose_mesh_shape(8) == {"dp": 2, "pp": 2, "mp": 2}
+        assert choose_mesh_shape(4) == {"dp": 1, "pp": 2, "mp": 2}
+        assert choose_mesh_shape(2) == {"dp": 1, "pp": 1, "mp": 2}
+        assert choose_mesh_shape(1) == {"dp": 1, "pp": 1, "mp": 1}
+
+    def test_training_mesh_is_gpt_spmd_mesh(self):
+        """gpt_spmd.make_mesh IS distributed.mesh.make_training_mesh —
+        one heuristic, no drift."""
+        from paddle_tpu.distributed.mesh import make_training_mesh
+        from paddle_tpu.models import gpt_spmd
+
+        assert gpt_spmd.make_mesh is make_training_mesh
+        m = make_training_mesh(4)
+        assert m.axis_names == ("dp", "pp", "mp")
+        assert dict(m.shape) == {"dp": 1, "pp": 2, "mp": 2}
+
+    def test_serving_mesh(self):
+        from paddle_tpu.distributed.mesh import (as_serving_mesh,
+                                                 make_serving_mesh,
+                                                 mesh_signature)
+
+        m = make_serving_mesh(2)
+        assert m.axis_names == ("mp",) and dict(m.shape) == {"mp": 2}
+        assert mesh_signature(m) == (("mp", 2), ("devices", (0, 1)))
+        assert mesh_signature(None) is None
+        # same shape over a DIFFERENT device set must not share a
+        # signature (cached sharded params / executables would collide)
+        other = jax.sharding.Mesh(np.array(jax.devices()[2:4]), ("mp",))
+        assert mesh_signature(other) != mesh_signature(m)
+        assert as_serving_mesh(None) is None
+        assert as_serving_mesh(2).shape == m.shape
+        assert as_serving_mesh(m) is m
+        # default spans every visible device
+        assert dict(make_serving_mesh().shape) == {"mp": NDEV}
+        with pytest.raises(ValueError, match="devices"):
+            make_serving_mesh(NDEV + 1)
+        with pytest.raises(ValueError, match="mp"):
+            as_serving_mesh(jax.sharding.Mesh(
+                np.array(jax.devices()[:2]), ("x",)))
+
+
 class TestShardTensor:
     def test_shard_and_gather_roundtrip(self, rng):
         mesh = dist.ProcessMesh(list(range(NDEV)), dim_names=["x"])

@@ -470,9 +470,11 @@ def test_unified_ttft_recorded(rng):
 def test_bench_serve_smoke_schema():
     """bench_serve.py --smoke must run green on CPU and emit bench.py's
     one-line JSON schema with the round-9 serving fields (TTFT, prefix
-    hit rate, prefill/decode retrace gates) plus the round-10 quantized
+    hit rate, prefill/decode retrace gates), the round-10 quantized
     A/B legs (fp vs int8-weights vs int8-weights+int8-KV) with the
-    hbm-bytes-per-token accounting, flagship quantized line last."""
+    hbm-bytes-per-token accounting, and the round-11 mesh scaling leg
+    (mp=1 vs mp=N unified step) with per-chip throughput; flagship
+    quantized line last."""
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     proc = subprocess.run(
         [sys.executable, "bench_serve.py", "--smoke", "--steps=6",
@@ -481,7 +483,7 @@ def test_bench_serve_smoke_schema():
         env={**os.environ, "JAX_PLATFORMS": "cpu"})
     assert proc.returncode == 0, proc.stderr[-2000:]
     lines = [l for l in proc.stdout.strip().splitlines() if l.strip()]
-    assert len(lines) == 4, proc.stdout
+    assert len(lines) == 5, proc.stdout
     for line in lines:
         rec = json.loads(line)
         assert "error" not in rec, rec
@@ -492,22 +494,33 @@ def test_bench_serve_smoke_schema():
         assert rec["decode_retraces"] == 1  # the no-retrace gate
         assert "vs_baseline" in rec and "prefix_hit_rate" in rec
         assert rec["hbm_bytes_per_token"] > 0
-    legacy, unified, int8w, int8kv = (json.loads(l) for l in lines)
+        # round 11: every leg stamps its mesh geometry
+        assert rec["mesh_shape"] == f"mp{rec['mesh_chips']}"
+        assert rec["tokens_per_s_per_chip"] == pytest.approx(
+            rec["value"] / rec["mesh_chips"], rel=0.01)
+    legacy, unified, spmd, int8w, int8kv = (json.loads(l) for l in lines)
     assert "[legacy-two-jit]" in legacy["metric"]
     assert "[unified-step]" in unified["metric"]
+    assert "[unified-spmd]" in spmd["metric"]
     assert "[unified-int8w]" in int8w["metric"]
     assert "[unified-int8w-int8kv]" in int8kv["metric"]  # flagship LAST
     # the retrace satellite gates: the legacy path's bucketed prefill
     # compiles >= 1 executable (now visible); the unified step has NO
     # prefill jit and exactly one executable for everything
     assert legacy["prefill_retraces"] >= 1
-    for rec in (unified, int8w, int8kv):
+    for rec in (unified, spmd, int8w, int8kv):
         assert rec["prefill_retraces"] == 0
     # prefix caching only exists on the unified legs, and the churn
     # workload (repeated prompts) must actually hit it
     assert legacy["prefix_hit_rate"] == 0.0
     assert unified["prefix_hit_rate"] > 0.0
     assert int8kv["prefix_hit_rate"] > 0.0
+    # the round-11 mesh A/B: the spmd leg ran tensor-parallel (the test
+    # env forces >= 2 host devices) on the same churn, and its analytic
+    # per-chip HBM bytes dropped below the mp=1 leg's (sharded stacks +
+    # sharded KV pages; replicated embeddings keep it above value/mp)
+    assert spmd["mesh_chips"] >= 2
+    assert spmd["hbm_bytes_per_token"] < unified["hbm_bytes_per_token"]
     # the round-10 memory contract: each quantization leg strictly cuts
     # HBM bytes per decode token (weights 2x+, then the KV context)
     assert int8w["hbm_bytes_per_token"] < unified["hbm_bytes_per_token"]
@@ -857,6 +870,186 @@ def test_unsupported_kv_cache_dtype_fails_loudly(rng):
                 max_new_tokens=2)
     finally:
         model.config.kv_cache_dtype = None
+
+
+# -- round 11: multi-chip SPMD serving over a Mesh(("mp",)) -----------------
+
+
+def _need_devices(n):
+    """Skip-with-reason when the forced multi-device CPU mesh is missing
+    (conftest sets XLA_FLAGS=--xla_force_host_platform_device_count=8; a
+    bare run without it only sees one host device)."""
+    import jax
+
+    if len(jax.devices()) < n:
+        pytest.skip(f"needs >= {n} devices (set XLA_FLAGS="
+                    "--xla_force_host_platform_device_count=2)")
+
+
+def test_spmd_mesh1_token_identical_to_single_chip(rng):
+    """THE mesh=1 equivalence gate: the sharded unified step (head-major
+    qkv layout, shard_map over a 1-chip mesh, size-1 psums) reproduces
+    the single-chip step token-for-token on mixed prefill+decode packing,
+    and compiles exactly once."""
+    model = _tiny_model()
+    prompts = [rng.randint(0, TINY["vocab_size"], (n,)).tolist()
+               for n in (3, 19, 7, 1, 12)]
+    plain = ServingPredictor(model, max_batch=3, max_seq_len=48,
+                             page_size=8, chunk=8)
+    want = plain.generate(prompts, max_new_tokens=6)
+    mesh1 = ServingPredictor(model, max_batch=3, max_seq_len=48,
+                             page_size=8, chunk=8, mesh=1)
+    got = mesh1.generate(prompts, max_new_tokens=6)
+    for w, g in zip(want, got):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+    assert mesh1.decode_trace_count == 1
+    assert mesh1.prefill_trace_count == 0
+
+
+def test_spmd_generate_mesh2_matches_oracle(rng):
+    """The acceptance gate: greedy generate over a 2-chip mp mesh matches
+    the full-forward oracle token-for-token, one trace per geometry, zero
+    on replay."""
+    from paddle_tpu.models.gpt import generate_paged
+
+    _need_devices(2)
+    model = _tiny_model()
+    ids = rng.randint(0, TINY["vocab_size"], (2, 11)).astype(np.int64)
+    want = _oracle_greedy(model, ids, 8)
+    got = model.generate(paddle.to_tensor(ids), max_new_tokens=8,
+                         mesh=2).numpy()
+    np.testing.assert_array_equal(got, want)
+    assert generate_paged.last_decode_trace_count <= 1
+    model.generate(paddle.to_tensor(ids), max_new_tokens=8, mesh=2)
+    assert generate_paged.last_decode_trace_count == 0
+
+
+def test_spmd_predictor_mesh2_continuous_batching(rng):
+    """ServingPredictor over a 2-chip mesh: continuous batching with
+    chunked prefill, prefix caching and CoW — the page pools stay
+    head-sharded on device while the host scheduler stays global — and
+    every request matches the single-chip outputs."""
+    import jax
+
+    _need_devices(2)
+    model = _tiny_model()
+    shared = rng.randint(0, TINY["vocab_size"], (12,)).tolist()
+    prompts = [shared + [1, 2], shared + [3, 4, 5],
+               rng.randint(0, TINY["vocab_size"], (7,)).tolist()]
+    plain = ServingPredictor(model, max_batch=2, max_seq_len=48,
+                             page_size=8, chunk=8)
+    want = plain.generate(prompts, max_new_tokens=6)
+    sp = ServingPredictor(model, max_batch=2, max_seq_len=48, page_size=8,
+                          chunk=8, mesh=2)
+    got = sp.generate(prompts, max_new_tokens=6)
+    for w, g in zip(want, got):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+    assert sp.decode_trace_count == 1
+    # the pools live sharded on the head axis end to end
+    spec = sp.cache.k_pages.sharding.spec
+    assert "mp" in tuple(spec)
+    assert len(sp.cache.k_pages.sharding.mesh.devices.flat) == 2
+    # second wave re-hits the prefix pages (sharded pages register/share)
+    sp.generate(prompts[:2], max_new_tokens=3)
+    assert sp.prefix_hit_rate > 0.0
+    assert sp.decode_trace_count == 1
+    del jax
+
+
+def test_spmd_mesh2_kernel_leg_matches_oracle(rng):
+    """use_kernel=True at mesh=2: the ragged Pallas kernel runs per chip
+    over its own heads' pages INSIDE shard_map (interpret mode on CPU) —
+    the layout GSPMD could never partition."""
+    _need_devices(2)
+    model = _tiny_model()
+    ids = rng.randint(0, TINY["vocab_size"], (2, 5)).astype(np.int64)
+    want = _oracle_greedy(model, ids, 6)
+    got = model.generate(paddle.to_tensor(ids), max_new_tokens=6,
+                         use_kernel=True, page_size=8, mesh=2).numpy()
+    np.testing.assert_array_equal(got, want)
+
+
+def test_spmd_mesh2_quantized_token_match(rng):
+    """int8 weights + int8 KV over a 2-chip mesh: the quantized stacks
+    shard by output column / K rows, the scale PLANES shard with their
+    head pages, and greedy decoding still matches the fp oracle on
+    >= 99% of tokens with the retrace gate intact."""
+    _need_devices(2)
+    model = _tiny_model()
+    prompts = [rng.randint(0, TINY["vocab_size"], (n,)).tolist()
+               for n in (9, 5, 13)]
+    sp_fp = ServingPredictor(model, max_batch=3, page_size=8,
+                             max_seq_len=64)
+    fp_out = sp_fp.generate(prompts, max_new_tokens=10)
+    model.config.weight_dtype = "int8"
+    model.config.kv_cache_dtype = "int8"
+    try:
+        sp_q = ServingPredictor(model, max_batch=3, page_size=8,
+                                max_seq_len=64, mesh=2)
+        q_out = sp_q.generate(prompts, max_new_tokens=10)
+        toks = [(a, b) for ao, bo in zip(fp_out, q_out)
+                for a, b in zip(ao, bo)]
+        assert np.mean([a == b for a, b in toks]) >= 0.99
+        assert sp_q.decode_trace_count == 1
+        assert sp_q.cache.k_pages.dtype == jnp.int8
+        assert "mp" in tuple(sp_q.cache.k_scales.sharding.spec)
+    finally:
+        model.config.weight_dtype = None
+        model.config.kv_cache_dtype = None
+
+
+def test_spmd_params_cache_and_jits_keyed_by_mesh(rng):
+    """The satellite gate: the per-model params cache and the jit cache
+    key on the MESH SIGNATURE alongside the quant signature — two mesh
+    sizes neither collide (distinct sharded pytrees from one extraction)
+    nor retrace each other (replays at both sizes stay at zero traces)."""
+    import jax
+
+    from paddle_tpu.models.gpt import (_SERVING_PARAMS_CACHE,
+                                       generate_paged)
+
+    _need_devices(2)
+    model = _tiny_model()
+    ids = rng.randint(0, TINY["vocab_size"], (1, 5)).astype(np.int64)
+    a = model.generate(paddle.to_tensor(ids), max_new_tokens=4).numpy()
+    b = model.generate(paddle.to_tensor(ids), max_new_tokens=4,
+                       mesh=1).numpy()
+    c = model.generate(paddle.to_tensor(ids), max_new_tokens=4,
+                       mesh=2).numpy()
+    np.testing.assert_array_equal(a, b)
+    np.testing.assert_array_equal(a, c)
+    from paddle_tpu.distributed.mesh import (make_serving_mesh,
+                                             mesh_signature)
+
+    sig1 = mesh_signature(make_serving_mesh(1))
+    sig2 = mesh_signature(make_serving_mesh(2))
+    by_mesh = _SERVING_PARAMS_CACHE.get(model)[1]
+    assert set(by_mesh) == {None, sig1, sig2}
+    # one base extraction, one sharded derivation per signature — and the
+    # sharded trees are distinct objects over distinct device sets
+    assert by_mesh[sig1] is not by_mesh[sig2]
+    # interleaved replays: every geometry's unified jit is already
+    # compiled; switching meshes must not retrace any of them
+    for mesh in (2, None, 1, 2, None):
+        model.generate(paddle.to_tensor(ids), max_new_tokens=4, mesh=mesh)
+        assert generate_paged.last_decode_trace_count == 0
+    del jax
+
+
+def test_spmd_mesh_validation_errors(rng):
+    """Indivisible geometries and int4 row stacks fail loudly at build
+    time, not as garbage tokens."""
+    model = _tiny_model()  # 4 heads
+    ids = rng.randint(0, TINY["vocab_size"], (1, 4)).astype(np.int64)
+    _need_devices(3)
+    with pytest.raises(ValueError, match="num_heads"):
+        model.generate(paddle.to_tensor(ids), max_new_tokens=2, mesh=3)
+    model.config.weight_dtype = "int4"
+    try:
+        with pytest.raises(ValueError, match="int4"):
+            model.generate(paddle.to_tensor(ids), max_new_tokens=2, mesh=2)
+    finally:
+        model.config.weight_dtype = None
 
 
 def test_quantized_generate_kernel_leg_matches_oracle(rng):
