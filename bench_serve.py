@@ -34,6 +34,13 @@ schema/contract as bench.py — the flagship quantized line LAST):
   mp=N A/B; every leg stamps its mesh so round-over-round deltas compare
   like against like (per-chip throughput is the roofline that matters:
   N chips buy aggregate bandwidth, the psums spend some of it back)
+- ``accepted_tokens_per_step``/``draft_acceptance_rate``: the round-12
+  speculative A/B (``unified-spec-base`` vs ``unified-spec-k4``) on a
+  repetitive-prompt churn — tokens emitted per completing decode
+  lane-step (1.0 = plain decode; > 1.0 = each weight-read amortized over
+  accepted drafts + the bonus token) and the fraction of proposed drafts
+  the verify pass accepted; the k4 leg's ``vs_baseline`` over the
+  spec-off leg is the effective speculation speedup
 
 ``--smoke``: tiny CPU config — always runnable (CI leg, rc 0; gather
 reference attention keeps it fast, kernel parity is the test suite's
@@ -90,7 +97,7 @@ def _hbm_bytes_per_token(sp, batch, avg_ctx):
 def bench_serving(*, hidden, layers, heads, vocab, batch, prompt, steps,
                   gen_len, page_size, chunk, unified, use_kernel, on_tpu,
                   dtype=None, weight_dtype=None, kv_cache_dtype=None,
-                  mesh_chips=1):
+                  mesh_chips=1, spec_decode_k=0, spec_workload=False):
     """One serving leg. Returns a dict of the emitted metrics.
 
     Workload: CONTINUOUS arrivals — ``batch`` concurrent requests drawn
@@ -101,6 +108,15 @@ def bench_serving(*, hidden, layers, heads, vocab, batch, prompt, steps,
     regime the round-9 tentpole targets — the legacy leg pays a full
     head-of-line prompt forward per admission, the unified leg interleaves
     chunks under the token budget and skips re-prefilling cached prefixes.
+
+    ``spec_workload`` (round 12): the speculative A/B legs run a
+    REPETITIVE-prompt churn — tiled short motifs (multi-turn / templated
+    traffic, the regime prompt-lookup drafting targets) with enough decode
+    steps per request (gen_len >= 12) for the per-request n-gram table to
+    capture the model's repetition. ``spec_decode_k`` > 0 turns on the
+    draft–verify–accept loop; the leg reports ``accepted_tokens_per_step``
+    (tokens emitted per completing decode lane-step — 1.0 = plain decode)
+    and ``draft_acceptance_rate``.
     """
     import jax.numpy as jnp
 
@@ -108,6 +124,8 @@ def bench_serving(*, hidden, layers, heads, vocab, batch, prompt, steps,
     from paddle_tpu.inference import ServingPredictor
     from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
 
+    if spec_workload:
+        gen_len = max(gen_len, 12)
     max_len = prompt + gen_len + 32
     paddle.seed(0)
     cfg = GPTConfig(vocab_size=vocab, hidden_size=hidden, num_layers=layers,
@@ -125,9 +143,16 @@ def bench_serving(*, hidden, layers, heads, vocab, batch, prompt, steps,
         model, max_batch=batch, page_size=page_size, max_seq_len=max_len,
         use_kernel=use_kernel, unified=unified, chunk=chunk,
         dtype=jnp.bfloat16 if (on_tpu and dtype is None) else dtype,
-        mesh=mesh)
+        mesh=mesh, spec_decode_k=spec_decode_k)
     rng = np.random.RandomState(0)
-    pool = [rng.randint(0, vocab, (prompt,)) for _ in range(max(2, batch // 2))]
+    if spec_workload:
+        # tiled 4-token motifs: every prompt internally repetitive
+        pool = [np.tile(rng.randint(0, vocab, (4,)),
+                        (prompt + 3) // 4)[:prompt]
+                for _ in range(max(2, batch // 2))]
+    else:
+        pool = [rng.randint(0, vocab, (prompt,))
+                for _ in range(max(2, batch // 2))]
     arrivals = [0]
     reqs = []
 
@@ -149,19 +174,20 @@ def bench_serving(*, hidden, layers, heads, vocab, batch, prompt, steps,
         sp.step()
 
     # timed churn phase: one host sync per step (each produced token
-    # crosses to the host — that IS serving's latency path)
+    # crosses to the host — that IS serving's latency path). Throughput
+    # counts EMITTED tokens (a speculative step can emit several per lane)
     decode_before = sp.decode_trace_count
     timed_from = len(reqs)
-    produced_total = 0
+    emitted_before = sp.tokens_emitted
     lat = []
     t0 = time.perf_counter()
     for _ in range(steps):
         top_up()
         t1 = time.perf_counter()
-        produced = sp.step()
-        produced_total += len(produced)
+        sp.step()
         lat.append((time.perf_counter() - t1) * 1e3)
     elapsed = time.perf_counter() - t0
+    produced_total = sp.tokens_emitted - emitted_before
     # explicit raise (not assert): python -O must not let a dead scheduler
     # emit a zero-looking-valid line
     if not produced_total:
@@ -173,7 +199,7 @@ def bench_serving(*, hidden, layers, heads, vocab, batch, prompt, steps,
     if not ttfts:
         ttfts = [r.ttft * 1e3 for r in first_wave]
     value = round(produced_total / elapsed, 1)
-    return dict(
+    out = dict(
         value=value,
         unit="tokens/s",
         p50_ms=round(_percentile(lat, 50), 2),
@@ -189,6 +215,13 @@ def bench_serving(*, hidden, layers, heads, vocab, batch, prompt, steps,
         mesh_shape=f"mp{mesh_chips}",
         tokens_per_s_per_chip=round(value / mesh_chips, 1),
     )
+    if spec_workload:
+        # the round-12 speculation A/B metrics: the spec-off leg anchors
+        # accepted_tokens_per_step at exactly 1.0 on the same workload
+        out["accepted_tokens_per_step"] = round(
+            sp.accepted_tokens_per_step, 3)
+        out["draft_acceptance_rate"] = round(sp.draft_acceptance_rate, 3)
+    return out
 
 
 def main():
@@ -256,6 +289,11 @@ def main():
         ("legacy-two-jit", dict(unified=False)),
         ("unified-step", dict(unified=True)),
         ("unified-spmd", dict(unified=True, mesh_chips=n_mp)),
+        # round-12 speculation A/B: the SAME repetitive-prompt churn with
+        # drafting off (the 1.0-tokens/lane-step anchor) vs k=4
+        ("unified-spec-base", dict(unified=True, spec_workload=True)),
+        ("unified-spec-k4", dict(unified=True, spec_workload=True,
+                                 spec_decode_k=4)),
         ("unified-int8w", dict(unified=True, weight_dtype="int8")),
         ("unified-int8w-int8kv", dict(unified=True, weight_dtype="int8",
                                       kv_cache_dtype="int8")),
@@ -298,10 +336,14 @@ def main():
         print(checked_line(out))
 
     # mesh leg baselines the fp unified step (mp=1): its vs_baseline IS
-    # the mesh scaling factor on aggregate tokens/s
+    # the mesh scaling factor on aggregate tokens/s; the spec leg
+    # baselines the spec-off run of its OWN (repetitive) workload, so its
+    # vs_baseline is the effective speculation speedup
     _emit("legacy-two-jit", None)
     _emit("unified-step", "legacy-two-jit")
     _emit("unified-spmd", "unified-step")
+    _emit("unified-spec-base", None)
+    _emit("unified-spec-k4", "unified-spec-base")
     _emit("unified-int8w", "unified-step")
     _emit("unified-int8w-int8kv", "unified-step")
 

@@ -36,7 +36,8 @@ _OPTIONAL_NUMERIC = ("vs_baseline", "p50_ms", "p99_ms", "anchor_tflops",
                      "anchor_frac_peak", "ttft_p50_ms", "ttft_p99_ms",
                      "prefix_hit_rate", "decode_retraces",
                      "prefill_retraces", "hbm_bytes_per_token",
-                     "mesh_chips", "tokens_per_s_per_chip")
+                     "mesh_chips", "tokens_per_s_per_chip",
+                     "accepted_tokens_per_step", "draft_acceptance_rate")
 _OPTIONAL_STRING = ("mesh_shape",)
 
 

@@ -20,7 +20,13 @@ builds of exactly the programs that carry the repo's numbers:
                   ``Mesh(("mp",))``: tensor-parallel prefill/decode + the
                   sharded quantized unified step (jaxpr walk through the
                   shard_map body, JX005 donation audit over the
-                  head-sharded pools and scale planes).
+                  head-sharded pools and scale planes);
+- ``serving-spec``  the round-12 speculative unified step
+                  (``spec_k > 0``: verify rows + fused accept epilogue),
+                  fp and int8-weight/int8-KV variants — jaxpr walk of the
+                  draft-token verify/accept program and the JX005
+                  donation audit over the pools and scale planes at their
+                  SHIFTED positions (the spec_len input precedes them).
 
 Configs are tiny (seconds on CPU; the analysis is abstract — eval_shape /
 make_jaxpr, no FLOPs run) but structurally identical to the flagship
@@ -355,6 +361,94 @@ def analyze_serving_spmd() -> list[Finding]:
     return findings
 
 
+def analyze_serving_spec() -> list[Finding]:
+    """Round-12 speculative serving: the unified step built with
+    ``spec_k > 0`` — a decode lane feeding its last context token plus
+    draft tokens as verify rows, the fused accept epilogue emitting
+    ``out_ids[b, k+1]`` / ``n_emit[b]``. Both the fp and the
+    int8-weight + int8-KV variants walk through the jaxpr checks, and the
+    JX005 donation audit covers the pools (and scale planes) at their
+    spec-shifted argument positions — a speculative step that silently
+    stopped aliasing its pools would double cache memory exactly when the
+    verify rows make the step its largest."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from ..inference.kv_cache import KVCacheManager
+    from ..inference.quantize import quantize_serving_params
+    from ..models.gpt import (GPTConfig, GPTForCausalLM, build_unified_step,
+                              serving_params)
+
+    cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                    num_heads=2, max_seq_len=32)
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg)
+    fp_params = serving_params(model)
+    q_params = quantize_serving_params(serving_params(model), "int8",
+                                       group_size=16)
+    page_size, chunk, b, spec_k = 8, 8, 2, 3
+    budget = b * (1 + spec_k) + chunk
+    rng = np.random.RandomState(0)
+    findings: list[Finding] = []
+
+    def spec_args(params, mgr):
+        for _ in range(b):
+            mgr.admit_prefix([int(x) for x in rng.randint(0, 128, (8,))])
+        # a mixed step: slot 0 decodes with 3 verify rows (1 + 2 drafts),
+        # slot 1 feeds a plain prefill chunk
+        tok_ids = jnp.asarray(rng.randint(0, 128, (budget,)), jnp.int32)
+        tok_slot = jnp.asarray(
+            [0] * 3 + [1] * chunk + [-1] * (budget - 3 - chunk), jnp.int32)
+        tok_pos = jnp.asarray(
+            list(range(8, 11)) + list(range(chunk))
+            + [0] * (budget - 3 - chunk), jnp.int32)
+        q_lens = jnp.asarray([3, chunk], jnp.int32)
+        kv_lens = jnp.asarray([8, 0], jnp.int32)
+        last_idx = jnp.asarray([0, 3 + chunk - 1], jnp.int32)
+        spec_len = jnp.asarray([2, 0], jnp.int32)
+        no_cow = jnp.full((b,), mgr.num_pages, jnp.int32)
+        keys = jnp.zeros((b, spec_k + 1, 2), jnp.uint32)
+        temp = jnp.asarray([0.0, 0.8], jnp.float32)
+        top_k = jnp.asarray([0, 40], jnp.int32)
+        top_p = jnp.asarray([1.0, 0.9], jnp.float32)
+        pools = ((mgr.k_pages, mgr.v_pages, mgr.k_scales, mgr.v_scales)
+                 if mgr.quantize_kv else (mgr.k_pages, mgr.v_pages))
+        return (params, tok_ids, tok_slot, tok_pos, q_lens, kv_lens,
+                last_idx, spec_len) + pools + (
+                    mgr.page_table_device(), no_cow, no_cow, keys, temp,
+                    top_k, top_p)
+
+    # fp speculative step: pools donate at the spec-shifted (8, 9)
+    mgr = KVCacheManager(cfg.num_layers, cfg.num_heads, cfg.head_dim,
+                         num_pages=2 * b * (cfg.max_seq_len // page_size),
+                         max_batch=b, max_seq_len=cfg.max_seq_len,
+                         page_size=page_size, dtype=jnp.float32,
+                         enable_prefix_cache=True)
+    step = build_unified_step(cfg, page_size, chunk, spec_k=spec_k)
+    args = spec_args(fp_params, mgr)
+    findings += analyze_jaxpr(trace_callable(step, *args),
+                              "serving-spec-step")
+    findings += check_donation(step, args, (8, 9), "serving-spec-step")
+
+    # int8-weight + int8-KV speculative step: pools AND scale planes
+    # donate at (8, 9, 10, 11)
+    qmgr = KVCacheManager(cfg.num_layers, cfg.num_heads, cfg.head_dim,
+                          num_pages=2 * b * (cfg.max_seq_len // page_size),
+                          max_batch=b, max_seq_len=cfg.max_seq_len,
+                          page_size=page_size, dtype=jnp.float32,
+                          quantize_kv=True, enable_prefix_cache=True)
+    qstep = build_unified_step(cfg, page_size, chunk, kv_quant=True,
+                               spec_k=spec_k)
+    qargs = spec_args(q_params, qmgr)
+    findings += analyze_jaxpr(trace_callable(qstep, *qargs),
+                              "serving-spec-quant-step")
+    findings += check_donation(qstep, qargs, (8, 9, 10, 11),
+                               "serving-spec-quant-step")
+    return findings
+
+
 TARGETS = {
     "gpt-eager": analyze_gpt_eager,
     "bert-eager": analyze_bert_eager,
@@ -363,6 +457,7 @@ TARGETS = {
     "serving-unified": analyze_serving_unified,
     "serving-quant": analyze_serving_quant,
     "serving-spmd": analyze_serving_spmd,
+    "serving-spec": analyze_serving_spec,
 }
 
 

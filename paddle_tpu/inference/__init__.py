@@ -174,6 +174,8 @@ def __getattr__(name):
     # the StableHLO Predictor never pulls the models package
     lazy = {"ServingPredictor": ".serving", "Request": ".serving",
             "KVCacheManager": ".kv_cache",
+            # round-12 speculative decoding draft source
+            "DraftProposer": ".draft",
             # round-10 quantized serving conversion
             "quantize_serving_params": ".quantize",
             "quantize_weight": ".quantize",
@@ -188,5 +190,5 @@ def __getattr__(name):
 __all__ = ["Config", "Predictor", "Tensor_", "create_predictor",
            "get_version", "PrecisionType", "PlaceType",
            "ServingPredictor", "Request", "KVCacheManager",
-           "quantize_serving_params", "quantize_weight",
+           "DraftProposer", "quantize_serving_params", "quantize_weight",
            "serving_weight_bytes"]

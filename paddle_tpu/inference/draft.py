@@ -1,0 +1,153 @@
+"""N-gram / prompt-lookup draft proposer for speculative decoding.
+
+Round 12: the host-side half of the draft–verify–accept loop. Each request
+owns one :class:`DraftProposer`; the serving scheduler feeds it the
+request's context ids (prompt + generated so far — exactly what the
+scheduler already tracks for preemption replay) and asks for up to ``k``
+draft tokens per decode step. The unified step then verifies the drafts in
+one ragged pass (1 + k query rows for the lane, per-row causal limits) and
+the fused accept epilogue keeps the longest matching prefix plus one bonus
+token — see ``models/gpt.py build_unified_step(spec_k=...)``.
+
+Proposal scheme (prompt-lookup decoding, arxiv 2402.xxxx shape): find the
+longest trailing n-gram of the context (``max_ngram`` down to 1) that also
+occurred EARLIER in the context, preferring the MOST RECENT earlier match,
+and copy the tokens that followed it. Lookups chain: copied tokens extend a
+virtual context and the lookup repeats until ``k`` drafts are gathered or
+no match remains — a period-1 repetition (the common greedy-decode
+attractor) therefore fills all ``k`` slots from a single-token match.
+
+The index is incremental and DETERMINISTIC in the context: n-grams ending
+strictly before the last context token map to their latest start position,
+extended as the context grows (``_synced`` high-water mark). A preemption
+replay re-feeds the identical context, so the table — and every proposal —
+replays identically (the same property the seeded sample streams rely on).
+
+Adaptive k: acceptance feedback (``update(proposed, accepted)``) drives an
+EMA; the effective ``k`` scales monotonically with the EMA down to 0
+(plain decode — speculation priced off when the workload doesn't repeat).
+While backed off to 0, a cooldown of plain-decode steps re-arms a probe so
+a workload that turns repetitive later gets re-tried.
+"""
+from __future__ import annotations
+
+__all__ = ["DraftProposer"]
+
+
+class DraftProposer:
+    """Per-request n-gram draft source with adaptive speculation length.
+
+    ``max_k``: the ceiling on drafts per step (the unified step's build
+    geometry — the scheduler may clamp lower per step for budget/capacity).
+    ``max_ngram``: longest trailing n-gram tried first. ``alpha``: EMA
+    weight of the newest acceptance sample. ``min_ema``: EMA below which
+    speculation disables (k = 0). ``retry_after``: plain-decode steps spent
+    disabled before the EMA re-arms to ``probe_ema``.
+    """
+
+    def __init__(self, max_k: int, *, max_ngram: int = 3, alpha: float = 0.5,
+                 min_ema: float = 0.2, retry_after: int = 16,
+                 probe_ema: float = 0.5):
+        if max_k < 1:
+            raise ValueError(f"max_k must be >= 1, got {max_k}")
+        if max_ngram < 1:
+            raise ValueError(f"max_ngram must be >= 1, got {max_ngram}")
+        self.max_k = int(max_k)
+        self.max_ngram = int(max_ngram)
+        self.alpha = float(alpha)
+        self.min_ema = float(min_ema)
+        self.retry_after = int(retry_after)
+        self.probe_ema = float(probe_ema)
+        self._ema = 1.0          # optimistic start: speculate until priced
+        self._cool = 0
+        # n-gram (as tuple) -> latest start position, over context n-grams
+        # ending STRICTLY before the last token (the tail n-gram itself must
+        # never shadow its earlier occurrences)
+        self._index: dict[tuple, int] = {}
+        self._synced = 0         # context positions whose n-grams are indexed
+
+    # -- adaptive k --------------------------------------------------------
+
+    @property
+    def k(self) -> int:
+        """Current speculation length, monotone in the acceptance EMA:
+        full ``max_k`` at EMA 1.0, 0 (plain decode) below ``min_ema``."""
+        if self._ema < self.min_ema:
+            return 0
+        return min(self.max_k, int(self._ema * (self.max_k + 1)))
+
+    def update(self, proposed: int, accepted: int) -> None:
+        """Feed one decode step's outcome. ``proposed == 0`` (nothing
+        drafted — disabled, no match, or no budget) leaves the EMA alone
+        but ticks the re-arm cooldown while disabled."""
+        if proposed <= 0:
+            if self.k == 0:
+                self._cool += 1
+                if self._cool >= self.retry_after:
+                    self._ema = self.probe_ema
+                    self._cool = 0
+            return
+        accepted = max(0, min(int(accepted), int(proposed)))
+        self._ema = ((1.0 - self.alpha) * self._ema
+                     + self.alpha * (accepted / proposed))
+        self._cool = 0
+
+    # -- the n-gram table --------------------------------------------------
+
+    def _sync(self, context) -> None:
+        """Index the n-grams of ``context`` ending at positions <= len-2
+        (monotone high-water mark: a preemption replay with the identical
+        context is a no-op)."""
+        n_ctx = len(context)
+        # positions are n-gram END indices; the final token's n-grams stay
+        # out so the tail lookup finds its latest EARLIER occurrence
+        for end in range(self._synced, n_ctx - 1):
+            for n in range(1, self.max_ngram + 1):
+                start = end - n + 1
+                if start < 0:
+                    break
+                self._index[tuple(context[start:end + 1])] = start
+        self._synced = max(self._synced, n_ctx - 1)
+
+    def propose(self, context, budget: int) -> list[int]:
+        """Up to ``min(self.k, budget)`` draft tokens continuing
+        ``context``. Empty when the context is too short (< 2 tokens), the
+        adaptive k backed off, or no trailing n-gram recurs."""
+        k = min(self.k, int(budget))
+        if k <= 0 or len(context) < 2:
+            return []
+        self._sync(context)
+        drafts: list[int] = []
+        v = list(context)
+        # chained-lookup overlay: n-grams ending inside the drafted
+        # extension (later than anything in the main index, so it wins)
+        overlay: dict[tuple, int] = {}
+
+        def extend_overlay(upto):
+            # index n-grams ending at position upto-2 (the new interior)
+            end = upto - 2
+            for n in range(1, self.max_ngram + 1):
+                start = end - n + 1
+                if start < 0:
+                    break
+                overlay[tuple(v[start:end + 1])] = start
+
+        while len(drafts) < k:
+            match = None
+            for n in range(min(self.max_ngram, len(v) - 1), 0, -1):
+                key = tuple(v[-n:])
+                p = overlay.get(key, self._index.get(key))
+                if p is not None and p + n < len(v):
+                    match = (p, n)
+                    break
+            if match is None:
+                break
+            p, n = match
+            take = v[p + n:p + n + (k - len(drafts))]
+            if not take:
+                break
+            for t in take:
+                drafts.append(t)
+                v.append(t)
+                extend_overlay(len(v))
+        return drafts

@@ -356,6 +356,78 @@ class KVCacheManager:
     def advance(self, slot: int, n: int = 1) -> None:
         self._seq_lens[slot] += n
 
+    def draft_allowance(self, slot: int, reserve: int = 0) -> int:
+        """Draft tokens ``slot`` may feed this step beyond its base
+        decode token using only its own pages plus strictly-FREE pages,
+        AFTER reserving the base token's own growth page, (when the
+        write position is shared) its CoW destination, and ``reserve``
+        further pages the caller has promised elsewhere (the scheduler
+        passes the plain-token page needs of every OTHER slot still
+        scheduled this step). Speculation is opportunistic: a rejected
+        draft must never cost a registered prefix page its spot (LRU
+        eviction) or preempt a running request — this is the claim the
+        scheduler re-checks in its capacity loop right before
+        allocating, so slots consuming the free list in the same step
+        shrink the drafts instead of pushing ANY slot's allocation into
+        the eviction/preemption paths a plain step would never enter.
+        (Drafts inside already-reserved pages are always free: they
+        cost no extra page.)"""
+        written = int(self._seq_lens[slot])
+        if written >= self.max_seq_len:
+            return 0     # at the ceiling: the truncation-stop owns it
+        have = int((self._page_table[slot] >= 0).sum())
+        base_need = max(0, self.pages_needed(written + 1) - have)
+        cow_need = 1 if self.needs_cow(slot, written) else 0
+        spare = max(0, len(self._free_pages) - base_need - cow_need
+                    - max(0, int(reserve)))
+        cap = min((have + base_need + spare) * self.page_size,
+                  self.max_seq_len)
+        return max(0, cap - written - 1)
+
+    def plain_step_page_need(self, slot: int, n_tokens: int) -> int:
+        """Pages ``slot`` will claim this step to write ``n_tokens``
+        plain (non-draft) tokens from its current length: growth pages
+        plus a CoW destination when the first write position is shared —
+        the per-slot reservation the scheduler charges against other
+        slots' draft allowances."""
+        written = int(self._seq_lens[slot])
+        if written >= self.max_seq_len:
+            return 0     # at the ceiling: the truncation-stop owns it
+        have = int((self._page_table[slot] >= 0).sum())
+        grow = max(0, self.pages_needed(
+            min(written + max(1, n_tokens), self.max_seq_len)) - have)
+        return grow + (1 if self.needs_cow(slot, written) else 0)
+
+    def trim_pages(self, slot: int) -> int:
+        """Release ``slot``'s pages beyond what ``seq_len`` needs — the
+        host half of speculative-draft rollback. A spec step allocates for
+        ``written + 1 + k`` tokens up front; when only ``m < k`` drafts
+        are accepted, ``advance(slot, 1 + m)`` moves the valid watermark
+        and this returns the over-allocated tail pages to the pool, so the
+        page accounting is IDENTICAL to a never-speculated run (trimmed
+        pages are always fresh refcount-1 allocations: shared/registered
+        prefix pages live below the watermark by construction, and a
+        shared tail was CoW'd by ``prepare_write`` before any draft KV
+        landed in it). Rejected-draft K/V left INSIDE kept pages sits
+        above ``seq_len`` — never read (the ragged kernel masks by
+        context length) and overwritten by the next step's writes.
+        Returns the number of pages released."""
+        keep = self.pages_needed(int(self._seq_lens[slot]))
+        have = int((self._page_table[slot] >= 0).sum())
+        freed = 0
+        # release high indices first: alloc pops the free-list tail, so
+        # reverse-order release restores the exact pre-speculation order
+        # (allocation is index-contiguous, so the scan is bounded by the
+        # pages actually held — not the full page-table width)
+        for i in range(have - 1, keep - 1, -1):
+            page = int(self._page_table[slot, i])
+            if page < 0:
+                continue
+            self._page_table[slot, i] = -1
+            self._release_page(page)
+            freed += 1
+        return freed
+
     def free(self, slot: int) -> None:
         """Evict: drop the slot's page references (shared pages survive in
         other slots / the prefix LRU), park the slot."""

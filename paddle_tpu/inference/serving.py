@@ -31,10 +31,25 @@ prefilling until its context is fully fed, then decoding) -> FINISHED
 YOUNGEST running request back to the queue (recompute-mode, vLLM policy);
 its replay re-hits its own registered prefix pages.
 
+Round 12 adds SPECULATIVE DECODING on the unified step
+(``spec_decode_k``): every decode lane consults its request's n-gram /
+prompt-lookup draft proposer (``inference/draft.py``, per-request table
+fed from the already-tracked context ids, adaptive k backing off to plain
+decode on low acceptance) and packs ``1 + k`` verify rows into the SAME
+token budget (decode lanes first, prefill chunks still fill the
+remainder — no new geometry). The step's fused accept epilogue emits the
+accepted prefix + one bonus token (greedy bit-identical to plain decode;
+sampled rows ride the per-request seeded streams keyed by
+tokens-produced), and rejected drafts' over-allocated pages roll back
+host-side (``KVCacheManager.trim_pages``) so page/refcount accounting
+stays identical to a never-speculated run.
+
 Knobs: ``max_batch`` (lanes), ``num_pages``/``page_size`` (pool geometry),
 ``max_seq_len`` (page-table width), ``chunk`` (per-slot prefill chunk,
 autotuned default), ``token_budget`` (tokens per step, default
-``max_batch + chunk``), ``prefix_cache`` (on by default when unified).
+``max_batch * (1 + spec_k) + chunk``), ``prefix_cache`` (on by default
+when unified), ``spec_decode_k`` (speculation build geometry, default
+``config.spec_decode_k``).
 """
 from __future__ import annotations
 
@@ -116,7 +131,8 @@ class ServingPredictor:
     def __init__(self, model, *, max_batch=8, num_pages=None, page_size=None,
                  max_seq_len=None, use_kernel=None, prefill_bucket=16,
                  dtype=None, unified=True, chunk=None, token_budget=None,
-                 prefix_cache=None, kv_cache_dtype=None, mesh=None):
+                 prefix_cache=None, kv_cache_dtype=None, mesh=None,
+                 spec_decode_k=None):
         from ..distributed.mesh import as_serving_mesh
         from ..models.gpt import (_serving_params_cached, build_decode_step,
                                   build_prefill, build_unified_step,
@@ -181,13 +197,31 @@ class ServingPredictor:
             mesh=self.mesh)
         self.chunk = int(chunk or preferred_chunk_size(
             cfg.num_heads, cfg.num_heads, cfg.head_dim, kv_dtype))
-        self.token_budget = int(token_budget or
-                                (self.max_batch + self.chunk))
+        # round 12: speculative decoding — build geometry for the verify
+        # rows ([b, k+1] outputs); per-request adaptive k only varies the
+        # spec_len values, so one executable serves every k <= spec_k
+        self.spec_k = int(spec_decode_k if spec_decode_k is not None
+                          else getattr(cfg, "spec_decode_k", 0) or 0)
+        if self.spec_k < 0:
+            raise ValueError(f"spec_decode_k must be >= 0, got "
+                             f"{self.spec_k}")
+        if self.spec_k and not self.unified:
+            raise ValueError(
+                "speculative decoding rides the unified step's verify "
+                "rows; the legacy two-jit path serves plain decode only")
+        if self.spec_k and self.spec_k >= self.chunk:
+            raise ValueError(
+                f"spec_decode_k {self.spec_k} needs 1 + k <= chunk "
+                f"{self.chunk} (verify rows ride the per-slot chunk "
+                "block)")
+        self.token_budget = int(
+            token_budget
+            or (self.max_batch * (1 + self.spec_k) + self.chunk))
         if self.unified:
             self._unified = build_unified_step(
                 cfg, self.cache.page_size, self.chunk,
                 use_kernel=use_kernel, kv_quant=self.kv_quant,
-                mesh=self.mesh)
+                mesh=self.mesh, spec_k=self.spec_k)
             self._prefill = self._decode = None
         else:
             self._unified = None
@@ -203,8 +237,19 @@ class ServingPredictor:
         self._next_token = np.zeros((self.max_batch,), np.int32)
         self._no_cow = jnp.full((self.max_batch,), self.cache.num_pages,
                                 jnp.int32)
-        self._zero_keys = np.zeros((self.max_batch, 2), np.uint32)
+        self._zero_keys = (
+            np.zeros((self.max_batch, self.spec_k + 1, 2), np.uint32)
+            if self.spec_k else np.zeros((self.max_batch, 2), np.uint32))
         self._base_keys: dict[int, np.ndarray] = {}   # req_id -> PRNGKey
+        # req_id -> DraftProposer (kept across preemption — the request's
+        # context replays identically, so the table stays consistent)
+        self._drafts: dict[int, object] = {}
+        # speculative metrics: per completing DECODE lane-step
+        self.spec_lane_steps = 0     # decode lane-steps while spec is on
+        self.spec_emitted = 0        # tokens actually emitted by them
+        self.spec_proposed = 0       # draft tokens proposed
+        self.spec_accepted = 0       # draft tokens accepted by verify
+        self.tokens_emitted = 0      # every token emitted (all paths)
         self.steps = 0
 
     # -- queue API ---------------------------------------------------------
@@ -242,6 +287,23 @@ class ServingPredictor:
     def prefix_hit_rate(self) -> float:
         return self.cache.prefix_hit_rate
 
+    @property
+    def accepted_tokens_per_step(self) -> float:
+        """Tokens emitted per completing decode lane-step — the
+        speculation multiplier (1.0 = plain decode: one token per lane
+        per step; > 1.0 = accepted drafts amortizing each weight-read
+        over multiple tokens)."""
+        if not self.spec_lane_steps:
+            return 1.0
+        return self.spec_emitted / self.spec_lane_steps
+
+    @property
+    def draft_acceptance_rate(self) -> float:
+        """Fraction of proposed draft tokens the verify pass accepted."""
+        if not self.spec_proposed:
+            return 0.0
+        return self.spec_accepted / self.spec_proposed
+
     # -- shared scheduler internals ----------------------------------------
 
     def _preempt_youngest(self) -> bool:
@@ -258,12 +320,19 @@ class ServingPredictor:
         self.waiting.appendleft(req)
         return True
 
+    def _finish(self, req: Request) -> None:
+        """Mark FINISHED and drop per-request scheduler state — EVERY
+        finish path must come through here (a retained n-gram table or
+        PRNG key would leak per request over a long-lived predictor)."""
+        req.state = FINISHED
+        self._base_keys.pop(req.req_id, None)
+        self._drafts.pop(req.req_id, None)
+
     def _retire_finished(self) -> None:
         for slot in [s for s, r in self.running.items() if r.done]:
             req = self.running.pop(slot)
             self.cache.free(slot)
-            req.state = FINISHED
-            self._base_keys.pop(req.req_id, None)
+            self._finish(req)
 
     def _finish_waiting_unservable(self, req: Request) -> bool:
         """Queue-head checks shared by both admission paths. Returns True
@@ -272,7 +341,7 @@ class ServingPredictor:
             # finished while waiting (e.g. budget satisfied by its prefill
             # token before a preemption parked it)
             self.waiting.popleft()
-            req.state = FINISHED
+            self._finish(req)
             return True
         if len(req._context_ids()) > self.max_seq_len:
             # preempted while sitting AT the length ceiling (its own
@@ -280,7 +349,7 @@ class ServingPredictor:
             # truncated, same as the in-loop ceiling stop
             self.waiting.popleft()
             req.truncated = True
-            req.state = FINISHED
+            self._finish(req)
             return True
         return False
 
@@ -344,7 +413,27 @@ class ServingPredictor:
             self._base_keys[req.req_id] = hit
         return hit
 
-    def _step_unified(self) -> dict[int, int]:
+    def _draft_propose(self, slot, req, budget_room: int) -> list:
+        """Draft tokens for a decode lane, clamped so speculation stays
+        opportunistic: the token budget, the per-slot chunk block, the
+        request's remaining output budget, the length ceiling, and —
+        via ``draft_allowance`` — pages claimable WITHOUT evicting prefix
+        pages or preempting anyone (rejected drafts must cost nothing).
+        The allowance is re-checked at claim time in the capacity loop:
+        this propose-time clamp only avoids wasted table lookups."""
+        from .draft import DraftProposer
+
+        prop = self._drafts.get(req.req_id)
+        if prop is None:
+            prop = self._drafts[req.req_id] = DraftProposer(self.spec_k)
+        written = self.cache.seq_len(slot)
+        room = min(budget_room, self.spec_k, self.chunk - 1,
+                   req.max_new_tokens - len(req.output_ids) - 1,
+                   self.max_seq_len - written - 1,
+                   self.cache.draft_allowance(slot))
+        return prop.propose(req._context_ids(), room) if room > 0 else []
+
+    def _step_unified(self) -> dict[int, list[int]]:
         self._retire_finished()
         self._admit_waiting_unified()
         if not self.running:
@@ -353,17 +442,28 @@ class ServingPredictor:
         # -- token-budget packing: decode lanes first, then prefill chunks
         budget = self.token_budget
         sched: dict[int, int] = {}          # slot -> tokens this step
+        drafts: dict[int, list] = {}        # slot -> draft tokens
         decode_slots = []
         prefill_slots = []
         for slot in sorted(self.running):
             req = self.running[slot]
             remaining = len(req._context_ids()) - cache.seq_len(slot)
             (decode_slots if remaining == 1 else prefill_slots).append(slot)
-        for slot in decode_slots:
+        for idx, slot in enumerate(decode_slots):
             if budget <= 0:
                 break
-            sched[slot] = 1
-            budget -= 1
+            # drafts may only spend budget left after EVERY decode lane
+            # still to pack has its base token reserved — one lane's
+            # speculation must not starve another lane's plain decode
+            # (a tight custom token_budget would otherwise skip the same
+            # trailing lanes every step)
+            room = budget - 1 - (len(decode_slots) - idx - 1)
+            d = (self._draft_propose(slot, self.running[slot], room)
+                 if self.spec_k else [])
+            if d:
+                drafts[slot] = d
+            sched[slot] = 1 + len(d)
+            budget -= 1 + len(d)
         # prefill fills the remainder, FIFO by request age
         for slot in sorted(prefill_slots,
                            key=lambda s: self.running[s].req_id):
@@ -376,8 +476,21 @@ class ServingPredictor:
                 sched[slot] = n
                 budget -= n
         # -- capacity: ceiling stops, page growth, CoW page claims -------
+        # pages every scheduled slot will claim for its PLAIN tokens
+        # (chunk growth + CoW): charged against draft allowances so a
+        # draft can never consume a free page a later prefill chunk in
+        # this same step needs (which would push IT into LRU eviction or
+        # preemption — costs a plain step never pays). Only drafted
+        # steps pay the bookkeeping: its one consumer is the draft clamp
+        plain_need: dict[int, int] = {}
+        pending_need = 0
+        if drafts:
+            plain_need = {s: cache.plain_step_page_need(
+                s, sched[s] - len(drafts.get(s, []))) for s in sched}
+            pending_need = sum(plain_need.values())
         cows: dict[int, tuple[int, int]] = {}
         for slot in sorted(sched):
+            pending_need -= plain_need.pop(slot, 0)
             if slot not in self.running:
                 continue
             req = self.running[slot]
@@ -389,9 +502,25 @@ class ServingPredictor:
                 self.running.pop(slot)
                 req.truncated = True
                 cache.free(slot)
-                req.state = FINISHED
+                self._finish(req)
                 continue
             n = min(sched[slot], self.max_seq_len - written)
+            if slot in drafts:
+                # AUTHORITATIVE draft clamp, at claim time: earlier slots
+                # in this loop may have consumed the free pages counted
+                # at propose time, and slots still to come have their
+                # plain needs reserved (pending_need) — shrink the drafts
+                # (ceiling included) rather than let anyone's growth
+                # evict prefix pages or preempt (costs plain decode
+                # never pays)
+                keep = max(0, min(len(drafts[slot]), n - 1,
+                                  cache.draft_allowance(
+                                      slot, reserve=pending_need)))
+                if keep < len(drafts[slot]):
+                    drafts[slot] = drafts[slot][:keep]
+                if not drafts[slot]:
+                    del drafts[slot]
+                n = 1 + keep
             sched[slot] = n
             while True:
                 # prepare_write ALLOCATES the copy's destination page
@@ -433,64 +562,125 @@ class ServingPredictor:
         tok_slot = np.full((t,), -1, np.int32)
         tok_pos = np.zeros((t,), np.int32)
         last_idx = np.full((b,), t, np.int32)   # idle-lane sentinel
+        spec_len = np.zeros((b,), np.int32)
         q_lens = np.zeros((b,), np.int32)
         temp = np.zeros((b,), np.float32)
         top_k = np.zeros((b,), np.int32)
         top_p = np.ones((b,), np.float32)
         keys = self._zero_keys
         completing = []
+        sample_lanes = []   # (slot, base key, tokens produced)
         w = 0
         for slot in sorted(sched):
             n = sched[slot]
             req = self.running[slot]
             written = cache.seq_len(slot)
             ctx = req._context_ids()
-            tok_ids[w:w + n] = ctx[written:written + n]
+            d = drafts.get(slot, [])
+            # a speculating decode lane feeds its last context token then
+            # its draft tokens at the following positions; everyone else
+            # feeds the next n context tokens (decode or prefill chunk)
+            tok_ids[w:w + n] = (([ctx[written]] + d) if d
+                                else ctx[written:written + n])
             tok_slot[w:w + n] = slot
             tok_pos[w:w + n] = np.arange(written, written + n)
-            last_idx[slot] = w + n - 1
+            # the row whose logits decide the lane's next token: the
+            # FIRST verify row when speculating, else the last fed row
+            last_idx[slot] = w + n - 1 - len(d)
+            spec_len[slot] = len(d)
             q_lens[slot] = n
             w += n
-            if written + n == len(ctx):
+            if written + n - len(d) == len(ctx):
                 completing.append(slot)
                 temp[slot] = req.temperature
                 top_k[slot] = req.top_k
                 top_p[slot] = req.top_p
                 if req.temperature > 0:
-                    import jax
+                    sample_lanes.append((slot, self._req_key(req),
+                                         len(req.output_ids)))
+        if sample_lanes:
+            # ONE vectorized fold for every sampling lane (and, under
+            # speculation, every verify row): per-row scalar fold_in
+            # dispatches would put O(lanes * k) host round-trips on the
+            # per-step latency path. Row j of a lane folds tokens-
+            # produced + j — bit-identical to the scalar folds (vmapped
+            # threefry), so the per-request streams are unchanged.
+            import jax
 
-                    if keys is self._zero_keys:
-                        keys = self._zero_keys.copy()
-                    keys[slot] = np.asarray(jax.random.fold_in(
-                        jnp.asarray(self._req_key(req)),
-                        len(req.output_ids)), np.uint32)
+            keys = self._zero_keys.copy()
+            k1 = self.spec_k + 1 if self.spec_k else 1
+            bases = np.repeat(np.stack([b for _, b, _ in sample_lanes]),
+                              k1, axis=0)
+            offs = np.concatenate(
+                [np.arange(p, p + k1) for _, _, p in sample_lanes])
+            folded = np.asarray(
+                jax.vmap(jax.random.fold_in)(jnp.asarray(bases),
+                                             jnp.asarray(offs)), np.uint32)
+            for i, (slot, _, _) in enumerate(sample_lanes):
+                keys[slot] = (folded[i * k1:(i + 1) * k1] if self.spec_k
+                              else folded[i])
         head = (self.params, jnp.asarray(tok_ids), jnp.asarray(tok_slot),
                 jnp.asarray(tok_pos), jnp.asarray(q_lens),
                 cache.seq_lens_device(), jnp.asarray(last_idx))
+        if self.spec_k:
+            head = head + (jnp.asarray(spec_len),)
         tail = (cache.page_table_device(), jnp.asarray(cow_src),
                 jnp.asarray(cow_dst), jnp.asarray(keys), jnp.asarray(temp),
                 jnp.asarray(top_k), jnp.asarray(top_p))
-        if self.kv_quant:
-            next_ids, _, kp, vp, ks, vs = self._unified(
-                *head, cache.k_pages, cache.v_pages, cache.k_scales,
-                cache.v_scales, *tail)
-            cache.update_pages(kp, vp, ks, vs)
+        pools = ((cache.k_pages, cache.v_pages, cache.k_scales,
+                  cache.v_scales) if self.kv_quant
+                 else (cache.k_pages, cache.v_pages))
+        res = self._unified(*head, *pools, *tail)
+        if self.spec_k:
+            # a speculating lane always completes, so a prefill-only
+            # round (completing empty) can skip the host sync entirely —
+            # same latency contract as the plain build
+            out = np.asarray(res[0]) if completing else None
+            ne = np.asarray(res[1]) if completing else None
+            cache.update_pages(*res[3:])
         else:
-            next_ids, _, kp, vp = self._unified(
-                *head, cache.k_pages, cache.v_pages, *tail)
-            cache.update_pages(kp, vp)
+            out, ne = (np.asarray(res[0]) if completing else None), None
+            cache.update_pages(*res[2:])
         self.steps += 1
+        decode_set = set(decode_slots)
         for slot, n in sched.items():
-            cache.advance(slot, n)
-        produced: dict[int, int] = {}
-        out = np.asarray(next_ids) if completing else None
+            if spec_len[slot]:
+                # speculative lane: the context token + accepted drafts
+                # are the valid K/V; rejected drafts' over-allocated
+                # pages roll back to the pool (refcounts/free lists end
+                # identical to a never-speculated run)
+                cache.advance(slot, int(ne[slot]))
+                cache.trim_pages(slot)
+            else:
+                cache.advance(slot, n)
+        produced: dict[int, list[int]] = {}
         for slot in completing:
             req = self.running[slot]
-            tok = int(out[slot])
-            req.output_ids.append(tok)
-            if req.first_token_time is None:
-                req.first_token_time = time.perf_counter()
-            produced[req.req_id] = tok
+            if self.spec_k:
+                m = int(ne[slot]) if spec_len[slot] else 1
+                toks = [int(x) for x in out[slot, :m]]
+            else:
+                toks = [int(out[slot])]
+            emitted = 0
+            for tok in toks:
+                if req.done:
+                    break   # budget/eos hit mid-batch: drop the overhang
+                req.output_ids.append(tok)
+                emitted += 1
+                if req.first_token_time is None:
+                    req.first_token_time = time.perf_counter()
+                produced.setdefault(req.req_id, []).append(tok)
+            self.tokens_emitted += emitted
+            if self.spec_k and slot in decode_set:
+                k_i = int(spec_len[slot])
+                acc = int(ne[slot]) - 1 if k_i else 0
+                self.spec_lane_steps += 1
+                self.spec_emitted += emitted
+                self.spec_proposed += k_i
+                self.spec_accepted += acc
+                prop = self._drafts.get(req.req_id)
+                if prop is not None:
+                    prop.update(k_i, acc)
         # register prompt prefills in the prefix cache PROGRESSIVELY —
         # full pages as their chunks land (a request arriving one step
         # later already hits them), the partial tail once the whole prompt
@@ -552,6 +742,7 @@ class ServingPredictor:
             # generated token; decode continues from it
             tok = int(np.asarray(next_ids)[0])
             req.output_ids.append(tok)
+            self.tokens_emitted += 1
             if req.first_token_time is None:
                 req.first_token_time = time.perf_counter()
             self._next_token[slot] = tok
@@ -578,7 +769,7 @@ class ServingPredictor:
                 break
             self.waiting.popleft()
 
-    def _step_legacy(self) -> dict[int, int]:
+    def _step_legacy(self) -> dict[int, list[int]]:
         self._retire_finished()
         # admit/retire to fixpoint: a fresh prompt whose prefill token
         # already satisfies done (budget 1, or prefill token == eos) must
@@ -604,7 +795,7 @@ class ServingPredictor:
                 req = self.running.pop(slot)
                 req.truncated = True
                 self.cache.free(slot)
-                req.state = FINISHED
+                self._finish(req)
                 continue
             while not self.cache.ensure_capacity(
                     slot, self.cache.seq_len(slot) + 1):
@@ -631,19 +822,22 @@ class ServingPredictor:
         for slot, req in self.running.items():
             tok = int(out[slot])
             req.output_ids.append(tok)
+            self.tokens_emitted += 1
             if req.first_token_time is None:
                 req.first_token_time = time.perf_counter()
             self._next_token[slot] = tok
             self.cache.advance(slot)
-            produced[req.req_id] = tok
+            produced[req.req_id] = [tok]
         return produced
 
     # -- the step ----------------------------------------------------------
 
-    def step(self) -> dict[int, int]:
-        """One scheduler round. Returns ``{req_id: token}`` for the tokens
-        produced this step (a unified round that only advanced prefill
-        chunks produces none)."""
+    def step(self) -> dict[int, list[int]]:
+        """One scheduler round. Returns ``{req_id: [tokens]}`` for the
+        tokens produced this step, in emission order — a speculative
+        decode lane can emit several (accepted drafts + bonus) in one
+        round; a unified round that only advanced prefill chunks
+        produces none."""
         if self.unified:
             return self._step_unified()
         return self._step_legacy()

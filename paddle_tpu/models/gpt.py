@@ -79,6 +79,13 @@ class GPTConfig:
     # scales: quantize-on-write in the unified step, dequant fused in the
     # ragged attention kernel. None keeps the compute-dtype pools.
     kv_cache_dtype: str | None = None
+    # round-12 speculative decoding: > 0 verifies up to this many n-gram
+    # draft tokens per decode lane per unified step (1 + k query rows
+    # through the ragged attention, fused in-jit accept epilogue emitting
+    # the accepted prefix + one bonus token). 0 = plain decode. The value
+    # is BUILD geometry (the step's output is [batch, k + 1]); per-request
+    # adaptive k varies only the spec_len inputs, never the shape.
+    spec_decode_k: int = 0
 
     @property
     def ffn_size(self) -> int:
@@ -856,7 +863,8 @@ def _sample_epilogue(logits, keys, temperature, top_k, top_p):
 
 def build_unified_step(config: GPTConfig, page_size: int, chunk: int,
                        use_kernel: bool | None = None,
-                       kv_quant: bool = False, mesh=None):
+                       kv_quant: bool = False, mesh=None,
+                       spec_k: int = 0):
     """ONE fixed-shape serving step for mixed ragged prefill + decode,
     driven by a per-step TOKEN BUDGET.
 
@@ -915,6 +923,33 @@ def build_unified_step(config: GPTConfig, page_size: int, chunk: int,
     head/logits/sampling replicate (every chip computes the identical
     epilogue). Signature, donation of all pools + scale planes, and the
     one-trace-per-geometry guarantee are unchanged.
+
+    ``spec_k > 0`` (round 12) builds the SPECULATIVE step: a decode lane
+    may feed ``1 + spec_len[slot]`` packed rows — its last context token
+    followed by n-gram draft tokens (``inference/draft.py``) at the next
+    positions — and the step verifies them all in the ONE ragged pass
+    (per-row causal limits make row i attend the just-written K/V of rows
+    < i). The signature gains ``spec_len[b]`` after ``last_idx`` (0 = the
+    lane speculates nothing this step — adaptive k varies VALUES, never
+    the shape), ``last_idx`` becomes the lane's FIRST verify row (for a
+    plain/prefill lane that is its last packed row, unchanged meaning),
+    and ``keys`` widens to ``[b, spec_k+1, 2]`` (row j of a lane samples
+    token #produced+j of its stream — the per-request seeded streams stay
+    bit-identical to plain decode). The fused accept epilogue computes
+    logits at rows ``last_idx .. last_idx+spec_k``, samples each (greedy
+    argmax on temperature-0 lanes, bit-identical to the plain step), and
+    accepts drafts while ``draft[i] == sampled[i-1]`` — returning::
+
+        -> (out_ids[b, spec_k+1], n_emit[b], logits[b,v], k_pages,
+            v_pages[, k_scales, v_scales])
+
+    where each lane's first ``n_emit`` tokens of ``out_ids`` are its
+    emissions this step (accepted prefix + one bonus token; always >= 1
+    for a completing lane). Rejected drafts' K/V sits above the advanced
+    watermark — the scheduler rolls their pages back host-side
+    (``KVCacheManager.trim_pages``). ``spec_k`` is geometry: one trace
+    per (budget, batch, spec_k), composing with ``kv_quant`` and ``mesh``
+    (the epilogue replicates; donation covers the same pools).
     """
     import jax
     import jax.numpy as jnp
@@ -929,63 +964,49 @@ def build_unified_step(config: GPTConfig, page_size: int, chunk: int,
     mp, axis = _mesh_mp(mesh)
     nh_l, hd = cfg.num_heads // mp, cfg.head_dim
 
-    def _fp_body(params, tok_ids, tok_slot, tok_pos, q_lens, kv_lens,
-                 last_idx, k_pages, v_pages, page_table, cow_src, cow_dst,
-                 keys, temperature, top_k, top_p):
-        return _step_inner(params, tok_ids, tok_slot, tok_pos, q_lens,
-                           kv_lens, last_idx, k_pages, v_pages, None, None,
-                           page_table, cow_src, cow_dst, keys, temperature,
-                           top_k, top_p)
+    # argument layout (shared by the wrappers, shard_map specs and the
+    # donation indices): params + 6 packed/lane arrays [+ spec_len], then
+    # the donated pools [+ scale planes], then the 7-array tail
+    n_lead = 8 if spec_k else 7
+    n_pool = 4 if kv_quant else 2
+    n_out_lead = 3 if spec_k else 2
 
-    def step(params, tok_ids, tok_slot, tok_pos, q_lens, kv_lens, last_idx,
-             k_pages, v_pages, page_table, cow_src, cow_dst, keys,
-             temperature, top_k, top_p):
+    def _body(*args):
+        lead = args[:n_lead]
+        pools = args[n_lead:n_lead + n_pool]
+        (page_table, cow_src, cow_dst, keys, temperature, top_k,
+         top_p) = args[n_lead + n_pool:]
+        spec_len = lead[7] if spec_k else None
+        k_scales, v_scales = (pools[2], pools[3]) if kv_quant else (None,
+                                                                    None)
+        return _step_inner(*lead[:7], spec_len, pools[0], pools[1],
+                           k_scales, v_scales, page_table, cow_src,
+                           cow_dst, keys, temperature, top_k, top_p)
+
+    def step(*args):
         trace_count[0] += 1
-        body = _fp_body
-        if mesh is not None:
-            from jax.sharding import PartitionSpec as P
-
-            kv_spec, _ = _kv_specs()
-            rep = P()
-            body = jax.shard_map(
-                _fp_body, mesh=mesh,
-                in_specs=(serving_param_specs(params),) + (rep,) * 6
-                + (kv_spec, kv_spec) + (rep,) * 7,
-                out_specs=(rep, rep, kv_spec, kv_spec),
-                check_vma=False)
-        # MXU-native matmul precision — see build_prefill
-        with jax.default_matmul_precision("default"):
-            return body(params, tok_ids, tok_slot, tok_pos, q_lens,
-                        kv_lens, last_idx, k_pages, v_pages, page_table,
-                        cow_src, cow_dst, keys, temperature, top_k, top_p)
-
-    def step_quant(params, tok_ids, tok_slot, tok_pos, q_lens, kv_lens,
-                   last_idx, k_pages, v_pages, k_scales, v_scales,
-                   page_table, cow_src, cow_dst, keys, temperature, top_k,
-                   top_p):
-        trace_count[0] += 1
-        body = _step_inner
+        body = _body
         if mesh is not None:
             from jax.sharding import PartitionSpec as P
 
             kv_spec, sc_spec = _kv_specs()
             rep = P()
+            pool_specs = ((kv_spec, kv_spec, sc_spec, sc_spec) if kv_quant
+                          else (kv_spec, kv_spec))
             body = jax.shard_map(
-                _step_inner, mesh=mesh,
-                in_specs=(serving_param_specs(params),) + (rep,) * 6
-                + (kv_spec, kv_spec, sc_spec, sc_spec) + (rep,) * 7,
-                out_specs=(rep, rep, kv_spec, kv_spec, sc_spec, sc_spec),
+                _body, mesh=mesh,
+                in_specs=(serving_param_specs(args[0]),)
+                + (rep,) * (n_lead - 1) + pool_specs + (rep,) * 7,
+                out_specs=(rep,) * n_out_lead + pool_specs,
                 check_vma=False)
+        # MXU-native matmul precision — see build_prefill
         with jax.default_matmul_precision("default"):
-            return body(params, tok_ids, tok_slot, tok_pos, q_lens,
-                        kv_lens, last_idx, k_pages, v_pages, k_scales,
-                        v_scales, page_table, cow_src, cow_dst, keys,
-                        temperature, top_k, top_p)
+            return body(*args)
 
     def _step_inner(params, tok_ids, tok_slot, tok_pos, q_lens, kv_lens,
-                    last_idx, k_pages, v_pages, k_scales, v_scales,
-                    page_table, cow_src, cow_dst, keys, temperature, top_k,
-                    top_p):
+                    last_idx, spec_len, k_pages, v_pages, k_scales,
+                    v_scales, page_table, cow_src, cow_dst, keys,
+                    temperature, top_k, top_p):
         t = tok_ids.shape[0]
         b = q_lens.shape[0]
         # copy-on-write BEFORE any write: diverging lanes get a private
@@ -1048,6 +1069,45 @@ def build_unified_step(config: GPTConfig, page_size: int, chunk: int,
             x, (k_pages, v_pages) = jax.lax.scan(
                 block, x, (params["layers"], k_pages, v_pages))
         x = _srv_ln(x, params["lnf_g"], params["lnf_b"], eps)
+        if spec_k:
+            # -- speculative verify + fused accept epilogue --------------
+            # rows last_idx .. last_idx+spec_k are the lane's verify rows
+            # (its last context token, then its packed draft tokens); a
+            # non-speculating lane has spec_len 0 and only row 0 matters
+            k1 = spec_k + 1
+            rows = last_idx[:, None] + jnp.arange(k1)[None]     # [b, k1]
+            rows_c = jnp.clip(rows, 0, t - 1)
+            h_rows = x[rows_c]                                  # [b,k1,h]
+            logits_rows = _srv_logits(params, h_rows).astype(jnp.float32)
+            greedy = jnp.argmax(logits_rows, -1).astype(jnp.int32)
+            v = logits_rows.shape[-1]
+
+            def _samp():
+                # row j of a lane samples with its own key (the host keys
+                # it by tokens-produced + j, so the per-request stream is
+                # bit-identical to plain seeded decode)
+                rep = lambda a: jnp.repeat(a, k1)  # noqa: E731
+                return _sample_epilogue(
+                    logits_rows.reshape(b * k1, v),
+                    keys.reshape(b * k1, 2), rep(temperature), rep(top_k),
+                    rep(top_p)).reshape(b, k1)
+
+            sampled = jax.lax.cond(jnp.any(temperature > 0.0), _samp,
+                                   lambda: greedy)
+            out_ids = jnp.where((temperature > 0.0)[:, None], sampled,
+                                greedy)
+            # accept while draft i matches the token the model actually
+            # emits at its position: drafts ride the packed token stream
+            drafts = tok_ids[jnp.clip(rows[:, 1:], 0, t - 1)]   # [b, k]
+            ok = ((drafts == out_ids[:, :spec_k])
+                  & (jnp.arange(spec_k)[None] < spec_len[:, None]))
+            n_emit = 1 + jnp.cumprod(ok.astype(jnp.int32), axis=1).sum(1)
+            if kv_quant:
+                return (out_ids, n_emit.astype(jnp.int32),
+                        logits_rows[:, 0], k_pages, v_pages, k_scales,
+                        v_scales)
+            return (out_ids, n_emit.astype(jnp.int32), logits_rows[:, 0],
+                    k_pages, v_pages)
         # each slot's LAST packed token yields its next-token decision
         h_last = x[jnp.clip(last_idx, 0, t - 1)]                  # [b, h]
         logits = _srv_logits(params, h_last).astype(jnp.float32)
@@ -1065,10 +1125,8 @@ def build_unified_step(config: GPTConfig, page_size: int, chunk: int,
             return (next_ids, logits, k_pages, v_pages, k_scales, v_scales)
         return next_ids, logits, k_pages, v_pages
 
-    if kv_quant:
-        jitted = jax.jit(step_quant, donate_argnums=(7, 8, 9, 10))
-    else:
-        jitted = jax.jit(step, donate_argnums=(7, 8))
+    jitted = jax.jit(step,
+                     donate_argnums=tuple(range(n_lead, n_lead + n_pool)))
     jitted.trace_count = trace_count
     return jitted
 
@@ -1171,23 +1229,26 @@ def _serving_fns(config: GPTConfig, page_size: int, use_kernel, mesh=None):
 
 
 def _unified_fn(config: GPTConfig, page_size: int, chunk: int, use_kernel,
-                kv_quant=False, mesh=None):
+                kv_quant=False, mesh=None, spec_k=0):
     # the mesh SIGNATURE keys the cache (satellite of round 11): two mesh
-    # sizes get two entries — neither collides with nor retraces the other
+    # sizes get two entries — neither collides with nor retraces the other.
+    # spec_k is build GEOMETRY (the [b, k+1] output): two k values get two
+    # executables, each compiled once; adaptive per-request k never keys
     from ..distributed.mesh import mesh_signature
 
     return _jit_cache_get(
         ("unified", _cfg_key(config), page_size, chunk, use_kernel,
-         kv_quant, mesh_signature(mesh)),
+         kv_quant, mesh_signature(mesh), spec_k),
         lambda: build_unified_step(config, page_size, chunk,
                                    use_kernel=use_kernel,
-                                   kv_quant=kv_quant, mesh=mesh))
+                                   kv_quant=kv_quant, mesh=mesh,
+                                   spec_k=spec_k))
 
 
 def generate_paged(model, input_ids, max_new_tokens=20, *, page_size=None,
                    num_pages=None, use_kernel=None, eos_token_id=None,
                    chunk=None, temperature=0.0, top_k=0, top_p=1.0,
-                   seed=0, mesh=None):
+                   seed=0, mesh=None, spec_decode_k=None):
     """Autoregressive generation over the paged KV cache — round 9: ONE
     unified-step jit serves prefill chunks and decode tokens alike.
 
@@ -1213,6 +1274,17 @@ def generate_paged(model, input_ids, max_new_tokens=20, *, page_size=None,
     page pools int8 with quantize-on-write + in-kernel dequant — greedy
     decoding then matches the fp oracle to within quantization noise
     (>= 99% of tokens in the smoke config) rather than bit-exactly.
+
+    Round 12: ``spec_decode_k`` (default ``config.spec_decode_k``; > 0
+    enables) runs the draft–verify–accept speculative loop: each row owns
+    an n-gram/prompt-lookup :class:`~paddle_tpu.inference.draft.
+    DraftProposer`, decode rounds feed ``1 + k`` verify rows through the
+    SAME unified step (``spec_k`` build geometry) and emit the accepted
+    prefix + one bonus token per round. Greedy output stays token-for-
+    token identical to plain decode (the accept rule only keeps drafts
+    the plain stream would have produced); rejected drafts' pages roll
+    back via ``KVCacheManager.trim_pages``. Sampled rows key row j by
+    (row, tokens-produced + j) so a seed reproduces the stream across k.
     """
     import numpy as np
 
@@ -1264,26 +1336,48 @@ def generate_paged(model, input_ids, max_new_tokens=20, *, page_size=None,
         slot, _ = mgr.admit_prefix(ctx)   # no prefix sharing here: the
         slots.append(slot)                # ServingPredictor owns that path
 
-    step = _unified_fn(cfg, mgr.page_size, int(chunk), use_kernel,
-                       kv_quant=kv_quant, mesh=mesh)
-    traces_at_entry = step.trace_count[0]
     chunk = int(chunk)
+    spec_k = int(cfg.spec_decode_k if spec_decode_k is None
+                 else (spec_decode_k or 0))
+    if spec_k < 0:
+        raise ValueError(f"spec_decode_k must be >= 0, got {spec_k}")
+    if spec_k and spec_k >= chunk:
+        raise ValueError(
+            f"spec_decode_k {spec_k} needs 1 + k <= chunk {chunk} (the "
+            "verify rows ride the per-slot chunk block)")
+    proposers = None
+    if spec_k:
+        from ..inference.draft import DraftProposer
+
+        proposers = [DraftProposer(spec_k) for _ in range(b)]
+    step = _unified_fn(cfg, mgr.page_size, chunk, use_kernel,
+                       kv_quant=kv_quant, mesh=mesh, spec_k=spec_k)
+    traces_at_entry = step.trace_count[0]
     # token budget: every row can feed a full chunk each round (generate
     # drives all rows in lockstep; the budget-packed scheduler lives in
     # ServingPredictor). constant per-call sampling plumbing; generate
     # never shares pages, so copy-on-write stays on the no-op sentinel
     t_budget = b * chunk
+    k1 = spec_k + 1
     no_cow = jnp.full((b,), mgr.num_pages, jnp.int32)
     temp_arr = jnp.full((b,), float(temperature), jnp.float32)
     topk_arr = jnp.full((b,), int(top_k), jnp.int32)
     topp_arr = jnp.full((b,), float(top_p), jnp.float32)
-    zero_keys = np.zeros((b, 2), np.uint32)
-    base_key = jax.random.PRNGKey(int(seed)) if temperature > 0 else None
+    zero_keys = (np.zeros((b, k1, 2), np.uint32) if spec_k
+                 else np.zeros((b, 2), np.uint32))
+    row_keys = None
+    if temperature > 0:
+        # one vectorized fold per call for the per-row base keys, and one
+        # per step for the per-token keys below (vmapped threefry is
+        # bit-identical to scalar fold_in) — never per-row dispatches
+        base_key = jax.random.PRNGKey(int(seed))
+        row_keys = np.asarray(jax.vmap(jax.random.fold_in,
+                                       in_axes=(None, 0))(
+            base_key, jnp.arange(b)), np.uint32)
 
-    out: list[np.ndarray] = []
+    outs: list[list[int]] = [[] for _ in range(b)]
     done = np.zeros((b,), bool)
-    step_no = 0
-    while len(out) < max_new_tokens and not done.all():
+    while not done.all():
         # free ALL finished lanes first (their lane goes inert), THEN grow
         # the live ones: a tight pool must see the reclaimed pages before
         # any capacity check can fail
@@ -1296,12 +1390,37 @@ def generate_paged(model, input_ids, max_new_tokens=20, *, page_size=None,
         tok_slot = np.full((t_budget,), -1, np.int32)
         tok_pos = np.zeros((t_budget,), np.int32)
         last_idx = np.full((b,), t_budget, np.int32)   # idle sentinel
+        spec_len = np.zeros((b,), np.int32)
+        if spec_k:
+            # pages every live row will claim for its PLAIN tokens this
+            # round, charged against draft allowances (the serving-path
+            # reservation): drafts stay opportunistic — a pool an eos-
+            # stopping plain run fits must never crash under speculation
+            plain_need = {
+                sl: mgr.plain_step_page_need(
+                    sl, min(chunk, len(contexts[i]) - mgr.seq_len(sl)))
+                for i, sl in enumerate(slots)
+                if sl is not None and not done[i]}
+            pending_need = sum(plain_need.values())
         w = 0
         for i, sl in enumerate(slots):
             if sl is None or done[i]:
                 continue
+            if spec_k:
+                pending_need -= plain_need.pop(sl, 0)
             written = mgr.seq_len(sl)
-            n = min(chunk, len(contexts[i]) - written)
+            remaining = len(contexts[i]) - written
+            d: list[int] = []
+            if spec_k and remaining == 1:
+                # decode round: draft up to k tokens, clamped so emission
+                # can't overshoot the output budget (a lane one token from
+                # done drafts nothing) and so drafts only claim pages no
+                # live row needs for its plain tokens
+                room = min(spec_k, max_new_tokens - len(outs[i]) - 1,
+                           mgr.draft_allowance(sl, reserve=pending_need))
+                if room > 0:
+                    d = proposers[i].propose(contexts[i], room)
+            n = (1 + len(d)) if d else min(chunk, remaining)
             if not mgr.ensure_capacity(sl, written + n):
                 # an undersized pool must fail loudly: the dropped K/V
                 # write would otherwise silently corrupt every later token
@@ -1310,61 +1429,79 @@ def generate_paged(model, input_ids, max_new_tokens=20, *, page_size=None,
                     f"{written + n} tokens — pass a larger "
                     "num_pages (or use ServingPredictor, which preempts)")
             q_lens[sl] = n
-            tok_ids[w:w + n] = contexts[i][written:written + n]
+            tok_ids[w:w + n] = (([contexts[i][written]] + d) if d
+                                else contexts[i][written:written + n])
             tok_slot[w:w + n] = sl
             tok_pos[w:w + n] = np.arange(written, written + n)
-            last_idx[sl] = w + n - 1
+            # the row whose logits decide the next token: the FIRST verify
+            # row for a speculating lane, the last fed row otherwise
+            last_idx[sl] = w + n - 1 - len(d)
+            spec_len[sl] = len(d)
             w += n
         if temperature > 0:
-            keys = np.stack([
-                np.asarray(jax.random.fold_in(
-                    jax.random.fold_in(base_key, i), step_no), np.uint32)
-                for i in range(b)])
+            # row (i, j) samples token #produced+j of row i's stream —
+            # keying by tokens PRODUCED (the ServingPredictor convention)
+            # makes the sampled stream identical across every spec k,
+            # including k = 0: speculation changes cost, never output
+            offs = np.concatenate(
+                [np.arange(len(o), len(o) + k1) for o in outs])
+            keys = np.asarray(jax.vmap(jax.random.fold_in)(
+                jnp.asarray(np.repeat(row_keys, k1, axis=0)),
+                jnp.asarray(offs)), np.uint32)
+            keys = keys.reshape(b, k1, 2) if spec_k else keys
         else:
             keys = zero_keys
         packed = (params, jnp.asarray(tok_ids), jnp.asarray(tok_slot),
                   jnp.asarray(tok_pos), jnp.asarray(q_lens),
                   mgr.seq_lens_device(), jnp.asarray(last_idx))
+        if spec_k:
+            packed = packed + (jnp.asarray(spec_len),)
         tail = (mgr.page_table_device(), no_cow, no_cow,
                 jnp.asarray(keys), temp_arr, topk_arr, topp_arr)
-        if kv_quant:
-            next_ids, _, kp, vp, ks, vs = step(
-                *packed, mgr.k_pages, mgr.v_pages, mgr.k_scales,
-                mgr.v_scales, *tail)
-            mgr.update_pages(kp, vp, ks, vs)
+        pools = ((mgr.k_pages, mgr.v_pages, mgr.k_scales, mgr.v_scales)
+                 if kv_quant else (mgr.k_pages, mgr.v_pages))
+        res = step(*packed, *pools, *tail)
+        if spec_k:
+            out_ids, n_emit = np.asarray(res[0]), np.asarray(res[1])
+            mgr.update_pages(*res[3:])
         else:
-            next_ids, _, kp, vp = step(*packed, mgr.k_pages, mgr.v_pages,
-                                       *tail)
-            mgr.update_pages(kp, vp)
-        step_no += 1
-        toks = None
-        produced = False
+            out_ids, n_emit = np.asarray(res[0]), None
+            mgr.update_pages(*res[2:])
         for i, sl in enumerate(slots):
             if sl is None or q_lens[sl] == 0:
                 continue
-            mgr.advance(sl, int(q_lens[sl]))
-            if mgr.seq_len(sl) == len(contexts[i]):
-                # the chunk reached the end of this row's context: its
-                # sampled/greedy token is the next generated one
-                if toks is None:
-                    toks = np.asarray(next_ids)
-                contexts[i].append(int(toks[sl]))
-                produced = True
-        if not produced:
-            continue   # mid-prefill round: nothing emitted yet
-        # equal prompt lengths keep the rows in lockstep: every live row
-        # produces in the same round; finished rows pad with eos
-        col = np.zeros((b,), np.int64)
-        for i in range(b):
-            if done[i]:
-                col[i] = eos_token_id
+            if spec_len[sl]:
+                # speculative round: 1 + accepted tokens are valid; the
+                # rejected drafts' over-allocated pages roll back
+                m = int(n_emit[sl])
+                mgr.advance(sl, m)
+                mgr.trim_pages(sl)
+                emitted = [int(t) for t in out_ids[sl, :m]]
+                proposers[i].update(int(spec_len[sl]), m - 1)
             else:
-                col[i] = contexts[i][-1]
-        out.append(col)
-        if eos_token_id is not None:
-            done |= col == eos_token_id
+                mgr.advance(sl, int(q_lens[sl]))
+                if mgr.seq_len(sl) < len(contexts[i]):
+                    continue   # mid-prefill round: nothing emitted yet
+                emitted = [int(out_ids[sl, 0] if spec_k else out_ids[sl])]
+                if spec_k:
+                    proposers[i].update(0, 0)
+            for tok in emitted:
+                if done[i]:
+                    break   # budget/eos hit mid-batch: drop the overhang
+                outs[i].append(tok)
+                contexts[i].append(tok)
+                if eos_token_id is not None and tok == eos_token_id:
+                    done[i] = True
+                if len(outs[i]) >= max_new_tokens:
+                    done[i] = True
     # traces THIS call added: 1 on a cold shape, 0 when the cached jit
     # already compiled it — never per-token (the no-retrace gate)
     generate_paged.last_decode_trace_count = (step.trace_count[0]
                                               - traces_at_entry)
-    return Tensor(jnp.asarray(np.stack(out, axis=1), jnp.int64))
+    # rows that stopped early (eos) pad with the eos id, as before
+    n_cols = max(len(o) for o in outs)
+    pad = eos_token_id if eos_token_id is not None else 0
+    arr = np.full((b, n_cols), pad, np.int64)
+    for i, o in enumerate(outs):
+        arr[i, :len(o)] = o
+    return Tensor(jnp.asarray(arr, jnp.int64))

@@ -684,6 +684,16 @@ class TestHazardRegressions:
 
         assert analyze_serving_quant() == []
 
+    def test_serving_spec_step_is_clean_and_donates(self):
+        """The round-12 speculative unified step (fp + int8w/int8kv):
+        jaxpr walk of the verify/accept program and the JX005 donation
+        audit over the pools and scale planes at their spec-shifted
+        argument positions come back with ZERO findings (the baseline
+        stays empty)."""
+        from paddle_tpu.analysis.targets import analyze_serving_spec
+
+        assert analyze_serving_spec() == []
+
 
 # ---------------------------------------------------------------------------
 # the gate: the repo itself, against the checked-in baseline

@@ -1,0 +1,117 @@
+"""Round-12 n-gram / prompt-lookup draft proposer (inference/draft.py):
+lookup edge cases (empty/short contexts, most-recent-match preference,
+chained copying), determinism across preemption replay, and adaptive-k
+backoff monotonicity. Host-only — no model, no jit.
+"""
+import numpy as np
+import pytest
+
+from paddle_tpu.inference.draft import DraftProposer
+
+
+def test_validation():
+    with pytest.raises(ValueError, match="max_k"):
+        DraftProposer(0)
+    with pytest.raises(ValueError, match="max_ngram"):
+        DraftProposer(4, max_ngram=0)
+
+
+def test_empty_and_short_contexts_propose_nothing():
+    p = DraftProposer(4)
+    assert p.propose([], 4) == []
+    assert p.propose([7], 4) == []          # 1 token: no earlier match
+    assert p.propose([7, 8], 0) == []       # zero budget
+    assert p.propose([7, 8], -1) == []
+    # two distinct tokens: nothing recurs
+    assert p.propose([7, 8], 4) == []
+
+
+def test_lookup_copies_continuation_of_earlier_match():
+    # ... A B C x y A B C -> the trailing "A B C" matched earlier, copy
+    # what followed it: x y
+    p = DraftProposer(4, max_ngram=3)
+    ctx = [1, 2, 3, 50, 60, 1, 2, 3]
+    assert p.propose(ctx, 2) == [50, 60]
+
+
+def test_repeated_ngrams_pick_most_recent_match():
+    # "A B" occurs twice earlier with different continuations: the MOST
+    # RECENT one (-> 77) must win, not the older (-> 66)
+    p = DraftProposer(1, max_ngram=2)
+    ctx = [1, 2, 66, 9, 1, 2, 77, 9, 1, 2]
+    assert p.propose(ctx, 1) == [77]
+
+
+def test_longest_ngram_preferred():
+    # trailing "B C" has a 2-gram match (-> 88) but the longer "A B C"
+    # also matches (-> 99): the longer context wins
+    p = DraftProposer(1, max_ngram=3)
+    ctx = [5, 2, 3, 88, 1, 2, 3, 99, 4, 1, 2, 3]
+    assert p.propose(ctx, 1) == [99]
+
+
+def test_chained_lookup_fills_k_on_short_period():
+    # the greedy-decode attractor: a period-1 tail. The most recent
+    # 1-gram match only has ONE following token in the real context; the
+    # chained lookup extends through its own drafts to fill the budget
+    p = DraftProposer(6, max_ngram=3)
+    ctx = [9, 4, 7, 7, 7]
+    assert p.propose(ctx, 6) == [7] * 6
+    # period-2 tail chains the alternation forward
+    p2 = DraftProposer(6, max_ngram=3)
+    ctx2 = [9, 1, 2, 1, 2, 1, 2]
+    assert p2.propose(ctx2, 4) == [1, 2, 1, 2]
+
+
+def test_table_survives_preemption_replay():
+    """A preemption replay re-feeds the identical context: the proposer
+    (its index high-water mark included) must produce the identical
+    drafts — the draft-side twin of the seeded sample streams."""
+    rng = np.random.RandomState(0)
+    base = [int(x) for x in rng.randint(0, 50, (24,))]
+    ctx = base + base[:8]            # long self-repetition
+    p = DraftProposer(4)
+    first = p.propose(ctx, 4)
+    assert first == p.propose(ctx, 4)     # replay: same table, same drafts
+    # growing the context keeps earlier entries consistent (incremental
+    # sync must equal a fresh proposer's full sync)
+    grown = ctx + base[8:12]
+    fresh = DraftProposer(4)
+    assert p.propose(grown, 4) == fresh.propose(grown, 4)
+
+
+def test_adaptive_k_backoff_monotone_and_recovers():
+    """Backoff monotonicity: under a stream of total rejections k never
+    increases and reaches 0 (speculation priced off); under acceptances
+    it never decreases back at full k; while disabled, the cooldown
+    re-arms a probe so a workload that turns repetitive gets retried."""
+    p = DraftProposer(4, retry_after=3)
+    assert p.k == 4                  # optimistic start
+    ks = [p.k]
+    for _ in range(12):
+        p.update(4, 0)               # every draft rejected
+        ks.append(p.k)
+    assert all(a >= b for a, b in zip(ks, ks[1:]))   # monotone backoff
+    assert ks[-1] == 0
+    # disabled: plain-decode steps tick the cooldown, then a probe re-arms
+    for _ in range(2):
+        p.update(0, 0)
+        assert p.k == 0
+    p.update(0, 0)
+    assert p.k > 0                   # probe re-armed
+    # full acceptance: k climbs monotonically back to max
+    ks = [p.k]
+    for _ in range(12):
+        p.update(ks[-1] or 1, ks[-1] or 1)
+        ks.append(p.k)
+    assert all(a <= b for a, b in zip(ks, ks[1:]))
+    assert ks[-1] == 4
+
+
+def test_propose_respects_adaptive_k_and_budget():
+    p = DraftProposer(4)
+    ctx = [3, 7, 7, 7, 7]
+    assert len(p.propose(ctx, 2)) == 2     # budget clamps
+    while p.k > 0:
+        p.update(4, 0)
+    assert p.propose(ctx, 4) == []         # backed off: plain decode

@@ -472,9 +472,11 @@ def test_bench_serve_smoke_schema():
     one-line JSON schema with the round-9 serving fields (TTFT, prefix
     hit rate, prefill/decode retrace gates), the round-10 quantized
     A/B legs (fp vs int8-weights vs int8-weights+int8-KV) with the
-    hbm-bytes-per-token accounting, and the round-11 mesh scaling leg
-    (mp=1 vs mp=N unified step) with per-chip throughput; flagship
-    quantized line last."""
+    hbm-bytes-per-token accounting, the round-11 mesh scaling leg
+    (mp=1 vs mp=N unified step) with per-chip throughput, and the
+    round-12 speculative A/B (spec off vs k=4 on a repetitive-prompt
+    churn) with accepted-tokens-per-step > 1.0; flagship quantized line
+    last."""
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     proc = subprocess.run(
         [sys.executable, "bench_serve.py", "--smoke", "--steps=6",
@@ -483,7 +485,7 @@ def test_bench_serve_smoke_schema():
         env={**os.environ, "JAX_PLATFORMS": "cpu"})
     assert proc.returncode == 0, proc.stderr[-2000:]
     lines = [l for l in proc.stdout.strip().splitlines() if l.strip()]
-    assert len(lines) == 5, proc.stdout
+    assert len(lines) == 7, proc.stdout
     for line in lines:
         rec = json.loads(line)
         assert "error" not in rec, rec
@@ -498,18 +500,29 @@ def test_bench_serve_smoke_schema():
         assert rec["mesh_shape"] == f"mp{rec['mesh_chips']}"
         assert rec["tokens_per_s_per_chip"] == pytest.approx(
             rec["value"] / rec["mesh_chips"], rel=0.01)
-    legacy, unified, spmd, int8w, int8kv = (json.loads(l) for l in lines)
+    (legacy, unified, spmd, specb, speck, int8w,
+     int8kv) = (json.loads(l) for l in lines)
     assert "[legacy-two-jit]" in legacy["metric"]
     assert "[unified-step]" in unified["metric"]
     assert "[unified-spmd]" in spmd["metric"]
+    assert "[unified-spec-base]" in specb["metric"]
+    assert "[unified-spec-k4]" in speck["metric"]
     assert "[unified-int8w]" in int8w["metric"]
     assert "[unified-int8w-int8kv]" in int8kv["metric"]  # flagship LAST
     # the retrace satellite gates: the legacy path's bucketed prefill
     # compiles >= 1 executable (now visible); the unified step has NO
     # prefill jit and exactly one executable for everything
     assert legacy["prefill_retraces"] >= 1
-    for rec in (unified, spmd, int8w, int8kv):
+    for rec in (unified, spmd, specb, speck, int8w, int8kv):
         assert rec["prefill_retraces"] == 0
+    # the round-12 speculation gates: the spec-off leg anchors exactly
+    # 1.0 token per decode lane-step on the same repetitive workload;
+    # the k=4 leg must ACTUALLY accept drafts — more than one token per
+    # weight-read — with a real acceptance rate behind it
+    assert specb["accepted_tokens_per_step"] == 1.0
+    assert specb["draft_acceptance_rate"] == 0.0
+    assert speck["accepted_tokens_per_step"] > 1.0
+    assert 0.0 < speck["draft_acceptance_rate"] <= 1.0
     # prefix caching only exists on the unified legs, and the churn
     # workload (repeated prompts) must actually hit it
     assert legacy["prefix_hit_rate"] == 0.0
@@ -1050,6 +1063,467 @@ def test_spmd_mesh_validation_errors(rng):
             model.generate(paddle.to_tensor(ids), max_new_tokens=2, mesh=2)
     finally:
         model.config.weight_dtype = None
+
+
+# -- round 12: speculative decoding on the unified step ---------------------
+
+
+def test_spec_generate_matches_oracle_at_k124(rng):
+    """THE acceptance gate: greedy speculative decoding is token-for-token
+    identical to the full-forward oracle at k in {1, 2, 4} — the accept
+    rule only keeps drafts the plain greedy stream would have produced,
+    so speculation can never change the output, only its cost."""
+    from paddle_tpu.models.gpt import generate_paged
+
+    model = _tiny_model()
+    ids = rng.randint(0, TINY["vocab_size"], (2, 11)).astype(np.int64)
+    want = _oracle_greedy(model, ids, 16)
+    for k in (1, 2, 4):
+        got = model.generate(paddle.to_tensor(ids), max_new_tokens=16,
+                             spec_decode_k=k, chunk=8, page_size=8).numpy()
+        np.testing.assert_array_equal(got, want)
+        assert generate_paged.last_decode_trace_count <= 1
+
+
+def test_spec_generate_kernel_leg_matches_oracle(rng):
+    """Same golden with the ragged Pallas kernel forced (interpret mode on
+    CPU): the verify rows ride the kernel's per-row causal limits."""
+    model = _tiny_model()
+    ids = rng.randint(0, TINY["vocab_size"], (2, 5)).astype(np.int64)
+    want = _oracle_greedy(model, ids, 8)
+    got = model.generate(paddle.to_tensor(ids), max_new_tokens=8,
+                         spec_decode_k=3, use_kernel=True, chunk=8,
+                         page_size=8).numpy()
+    np.testing.assert_array_equal(got, want)
+
+
+def test_spec_predictor_matches_plain_and_counts_acceptance(rng):
+    """Speculative continuous batching: token-for-token identical to the
+    plain unified predictor across mixed prompt lengths (chunked prefill
+    + spec decode packing in the same steps), ONE trace, and the tiny
+    model's repetition attractor drives real draft acceptance."""
+    model = _tiny_model()
+    prompts = [rng.randint(0, TINY["vocab_size"], (n,)).tolist()
+               for n in (3, 19, 7, 1, 12)]
+    plain = ServingPredictor(model, max_batch=3, max_seq_len=48,
+                             page_size=8, chunk=8)
+    want = plain.generate(prompts, max_new_tokens=10)
+    spec = ServingPredictor(model, max_batch=3, max_seq_len=48,
+                            page_size=8, chunk=8, spec_decode_k=4)
+    got = spec.generate(prompts, max_new_tokens=10)
+    for w, g in zip(want, got):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+    assert spec.decode_trace_count == 1      # one executable for all of it
+    assert spec.prefill_trace_count == 0
+    # the workload's greedy repetition must actually be captured
+    assert spec.spec_proposed > 0
+    assert spec.accepted_tokens_per_step > 1.0
+    assert 0.0 < spec.draft_acceptance_rate <= 1.0
+    # rollback left nothing behind: every page free or parked on the LRU
+    assert spec.cache.available_page_count == spec.cache.num_pages
+
+
+def test_spec_sampled_stream_identical_to_plain(rng):
+    """Seeded sampling through the verify rows: row j samples token
+    #produced+j of the request's stream, so the speculative output is
+    BIT-identical to the plain seeded predictor — speculation is exact
+    for sampling too, not just greedy."""
+    model = _tiny_model()
+    prompts = [rng.randint(0, TINY["vocab_size"], (n,)).tolist()
+               for n in (9, 5)]
+    plain = ServingPredictor(model, max_batch=2, max_seq_len=48,
+                             page_size=8, chunk=8)
+    want = plain.generate(prompts, max_new_tokens=8, temperature=0.8,
+                          top_p=0.9, top_k=40, seed=123)
+    spec = ServingPredictor(model, max_batch=2, max_seq_len=48,
+                            page_size=8, chunk=8, spec_decode_k=3)
+    got = spec.generate(prompts, max_new_tokens=8, temperature=0.8,
+                        top_p=0.9, top_k=40, seed=123)
+    assert got == want
+
+
+def test_spec_generate_sampled_stream_identical_across_k(rng):
+    """Seeded sampled generate is BIT-identical at every spec k,
+    INCLUDING k=0: both paths key row j of lane i by (i, tokens-produced
+    + j), so turning speculation on changes only cost, never output."""
+    model = _tiny_model()
+    ids = rng.randint(0, TINY["vocab_size"], (2, 7)).astype(np.int64)
+
+    def run(k):
+        return model.generate(paddle.to_tensor(ids), max_new_tokens=10,
+                              temperature=0.8, top_k=40, top_p=0.9,
+                              seed=7, chunk=8, page_size=8,
+                              spec_decode_k=k).numpy()
+
+    base = run(0)
+    for k in (1, 3):
+        np.testing.assert_array_equal(run(k), base)
+
+
+def test_spec_retraces_only_on_geometry_change(rng):
+    """Adaptive/varying per-request k changes only spec_len VALUES — zero
+    retraces; changing the BUILD spec_k is a new geometry: one fresh
+    trace, then replays from the shared jit cache at every k."""
+    from paddle_tpu.models.gpt import generate_paged
+
+    model = _tiny_model()
+    ids = rng.randint(0, TINY["vocab_size"], (1, 6)).astype(np.int64)
+    model.generate(paddle.to_tensor(ids), max_new_tokens=10,
+                   spec_decode_k=2, chunk=8, page_size=8)
+    assert generate_paged.last_decode_trace_count == 1
+    # same geometry replays (the run mixes draft lengths 0..k already)
+    model.generate(paddle.to_tensor(ids), max_new_tokens=10,
+                   spec_decode_k=2, chunk=8, page_size=8)
+    assert generate_paged.last_decode_trace_count == 0
+    # k=4 is a different [b, k+1] geometry: exactly one new trace
+    model.generate(paddle.to_tensor(ids), max_new_tokens=10,
+                   spec_decode_k=4, chunk=8, page_size=8)
+    assert generate_paged.last_decode_trace_count == 1
+    # interleaving the two geometries never retraces either again
+    for k in (2, 4, 2):
+        model.generate(paddle.to_tensor(ids), max_new_tokens=10,
+                       spec_decode_k=k, chunk=8, page_size=8)
+        assert generate_paged.last_decode_trace_count == 0
+
+
+def test_spec_quantized_token_match(rng):
+    """int8 weights + int8 KV under speculation: drafts quantize-on-write
+    like any token, rejected pages roll back, and greedy output matches
+    the fp oracle on >= 99% of tokens (the round-10 tolerance) with the
+    retrace gate intact."""
+    model = _tiny_model()
+    prompts = [rng.randint(0, TINY["vocab_size"], (n,)).tolist()
+               for n in (9, 5, 13)]
+    sp_fp = ServingPredictor(model, max_batch=3, page_size=8,
+                             max_seq_len=64)
+    fp_out = sp_fp.generate(prompts, max_new_tokens=10)
+    model.config.weight_dtype = "int8"
+    model.config.kv_cache_dtype = "int8"
+    try:
+        sp_q = ServingPredictor(model, max_batch=3, page_size=8,
+                                max_seq_len=64, chunk=8, spec_decode_k=4)
+        q_out = sp_q.generate(prompts, max_new_tokens=10)
+        toks = [(a, b) for ao, bo in zip(fp_out, q_out)
+                for a, b in zip(ao, bo)]
+        assert np.mean([a == b for a, b in toks]) >= 0.99
+        assert sp_q.decode_trace_count == 1
+        assert sp_q.cache.k_pages.dtype == jnp.int8
+    finally:
+        model.config.weight_dtype = None
+        model.config.kv_cache_dtype = None
+
+
+def test_spec_mesh2_matches_oracle(rng):
+    """The mesh gate: speculative greedy generate over a 2-chip mp mesh
+    (verify rows through the shard_map'd step, accept epilogue
+    replicated) matches the full-forward oracle token-for-token."""
+    _need_devices(2)
+    model = _tiny_model()
+    ids = rng.randint(0, TINY["vocab_size"], (2, 11)).astype(np.int64)
+    want = _oracle_greedy(model, ids, 10)
+    got = model.generate(paddle.to_tensor(ids), max_new_tokens=10,
+                         spec_decode_k=4, chunk=8, page_size=8,
+                         mesh=2).numpy()
+    np.testing.assert_array_equal(got, want)
+
+
+def test_spec_composes_with_prefix_cache_and_preemption(rng):
+    """Speculation under page pressure: shared prefixes, CoW divergence
+    and preemption replay all compose — outputs still match the plain
+    predictor and no page leaks (drafts are opportunistic: they never
+    evict prefix pages or preempt anyone)."""
+    model = _tiny_model()
+    shared = rng.randint(0, TINY["vocab_size"], (12,)).tolist()
+    prompts = [shared + [1, 2], shared + [3, 4, 5],
+               rng.randint(0, TINY["vocab_size"], (6,)).tolist()]
+    plain = ServingPredictor(model, max_batch=3, max_seq_len=24,
+                             page_size=8, chunk=8, prefix_cache=False)
+    want = plain.generate(prompts, max_new_tokens=8)
+    tight = ServingPredictor(model, max_batch=3, max_seq_len=24,
+                             page_size=8, num_pages=7, chunk=8,
+                             spec_decode_k=4)
+    reqs = [tight.add_request(p, max_new_tokens=8) for p in prompts]
+    while tight.has_work():
+        tight.step()
+    for r, w in zip(reqs, want):
+        np.testing.assert_array_equal(np.asarray(r.output_ids),
+                                      np.asarray(w))
+    assert tight.cache.available_page_count == tight.cache.num_pages
+
+
+def test_spec_validation_errors(rng):
+    model = _tiny_model()
+    with pytest.raises(ValueError, match="unified"):
+        ServingPredictor(model, max_batch=2, unified=False,
+                         spec_decode_k=2)
+    with pytest.raises(ValueError, match="chunk"):
+        ServingPredictor(model, max_batch=2, chunk=4, spec_decode_k=4)
+    ids = rng.randint(0, TINY["vocab_size"], (1, 4)).astype(np.int64)
+    with pytest.raises(ValueError, match="chunk"):
+        model.generate(paddle.to_tensor(ids), max_new_tokens=2,
+                       spec_decode_k=8, chunk=8)
+
+
+def test_spec_request_state_dropped_on_every_finish_path(rng):
+    """Per-request proposer tables and PRNG keys must drop on EVERY
+    finish path — the ceiling-truncation stop and the waiting-queue
+    finishes included, not just the ordinary retire (a retained n-gram
+    table per request is an unbounded leak on a long-lived predictor)."""
+    model = _tiny_model()
+    sp = ServingPredictor(model, max_batch=1, max_seq_len=8, page_size=4,
+                          chunk=4, spec_decode_k=2)
+    req = sp.add_request([1, 2, 3], max_new_tokens=50,
+                         temperature=0.5, seed=3)
+    while sp.has_work():
+        sp.step()
+    assert req.state == FINISHED and req.truncated   # ceiling stop
+    assert sp._drafts == {} and sp._base_keys == {}
+    # finished-while-waiting path: a parked request whose budget is
+    # already met must also drop its state
+    sp2 = ServingPredictor(model, max_batch=1, max_seq_len=16,
+                           page_size=4, chunk=4, spec_decode_k=2)
+    r2 = sp2.add_request([4, 5], max_new_tokens=4, temperature=0.5)
+    while not r2.output_ids:
+        sp2.step()
+    sp2._preempt_youngest()
+    r2.output_ids.extend(r2.output_ids[-1:] * 4)   # budget met while parked
+    while sp2.has_work():
+        sp2.step()
+    assert r2.state == FINISHED
+    assert sp2._drafts == {} and sp2._base_keys == {}
+
+
+def test_spec_generate_eos_tight_pool_matches_plain(rng):
+    """A pool an eos-stopping plain run fits must not crash under
+    speculation: draft room clamps to the pages no live row needs, so
+    generate stays opportunistic and emits the identical tokens."""
+    model = _tiny_model()
+    ids = rng.randint(0, TINY["vocab_size"], (2, 6)).astype(np.int64)
+    free_run = model.generate(paddle.to_tensor(ids), max_new_tokens=10,
+                              page_size=4).numpy()
+    eos = int(free_run[0, 1])
+    plain = model.generate(paddle.to_tensor(ids), max_new_tokens=10,
+                           page_size=4, num_pages=5, chunk=8,
+                           eos_token_id=eos).numpy()
+    spec = model.generate(paddle.to_tensor(ids), max_new_tokens=10,
+                          page_size=4, num_pages=5, chunk=8,
+                          eos_token_id=eos, spec_decode_k=4).numpy()
+    np.testing.assert_array_equal(spec, plain)
+
+
+def test_spec_tight_token_budget_never_starves_decode_lanes(rng):
+    """Drafts spend only the budget left after EVERY decode lane still
+    to pack has its base token reserved: with a tight custom
+    token_budget, one lane's speculation must not skip the trailing
+    lanes (deterministic packing order would starve the same lanes
+    every step — requests that never finish)."""
+    model = _tiny_model()
+    prompts = [rng.randint(0, TINY["vocab_size"], (3,)).tolist()
+               for _ in range(3)]
+    plain = ServingPredictor(model, max_batch=3, max_seq_len=48,
+                             page_size=8, chunk=8)
+    want = plain.generate(prompts, max_new_tokens=8)
+    # budget 5 = 3 base decode tokens + 2 tokens of draft room
+    sp = ServingPredictor(model, max_batch=3, max_seq_len=48, page_size=8,
+                          chunk=8, spec_decode_k=4, token_budget=5)
+    got = sp.generate(prompts, max_new_tokens=8)
+    for w, g in zip(want, got):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+
+
+def test_spec_drafts_never_preempt_scheduled_prefill(rng):
+    """A decode lane's drafts must not consume the free pages a LATER
+    slot's prefill chunk needs in the same step: the capacity loop
+    charges every scheduled slot's plain page needs against the draft
+    allowance, so a tight pool serves speculation + concurrent prefill
+    with ZERO preemptions (exactly like plain decode on the same
+    geometry) and identical outputs."""
+    model = _tiny_model()
+    a_prompt = rng.randint(0, TINY["vocab_size"], (3,)).tolist()
+    b_prompt = rng.randint(0, TINY["vocab_size"], (14,)).tolist()
+
+    def run(spec_k):
+        sp = ServingPredictor(model, max_batch=2, max_seq_len=24,
+                              page_size=4, num_pages=8, chunk=5,
+                              spec_decode_k=spec_k)
+        ra = sp.add_request(a_prompt, max_new_tokens=9)
+        while not ra.output_ids:     # A reaches decode before B arrives
+            sp.step()
+        rb = sp.add_request(b_prompt, max_new_tokens=2)
+        while sp.has_work():
+            sp.step()
+        return ra, rb
+
+    ra0, rb0 = run(0)
+    assert ra0.preempt_count == 0 and rb0.preempt_count == 0
+    ra, rb = run(4)
+    # speculating A decodes while B's chunks prefill through the tight
+    # pool: drafts yield the pages, nobody gets preempted
+    assert ra.preempt_count == 0 and rb.preempt_count == 0
+    assert ra.output_ids == ra0.output_ids
+    assert rb.output_ids == rb0.output_ids
+
+
+def test_draft_allowance_reserves_base_growth_and_cow():
+    """Drafts may only claim strictly-free pages AFTER the base decode
+    token's own growth page and (when the write position is shared) its
+    CoW destination are reserved — the claim-time clamp that keeps a
+    rejected draft from ever evicting a prefix page or preempting."""
+    m = KVCacheManager(num_layers=1, num_kv_heads=2, head_dim=4,
+                       num_pages=4, max_batch=2, max_seq_len=32,
+                       page_size=4, enable_prefix_cache=True)
+    slot, _ = m.admit_prefix([1, 2, 3, 4])   # 1 page, 3 free
+    m.advance(slot, 4)
+    # base token needs a growth page (page boundary): 1 reserved, 2 spare
+    # -> cap (1 + 1 + 2) * 4 = 16 tokens, minus written+1
+    assert m.draft_allowance(slot) == 16 - 5
+    # free list dry: drafts may still fill the base token's OWN page
+    # (they cost no extra page), nothing beyond
+    m2 = KVCacheManager(num_layers=1, num_kv_heads=2, head_dim=4,
+                        num_pages=1, max_batch=1, max_seq_len=32,
+                        page_size=4, enable_prefix_cache=True)
+    s2 = m2.admit(1)                          # page allocated, 0 free
+    assert m2.draft_allowance(s2) == 4 - 2    # in-page rows only
+    # CoW reservation: a shared write page costs one more free page
+    m3 = KVCacheManager(num_layers=1, num_kv_heads=2, head_dim=4,
+                        num_pages=4, max_batch=2, max_seq_len=32,
+                        page_size=4, enable_prefix_cache=True)
+    toks = list(range(6))                     # page 0 full, page 1 partial
+    s0, _ = m3.admit_prefix(toks)
+    m3.advance(s0, 6)
+    m3.register_prefix(s0, toks)
+    s1, c1 = m3.admit_prefix(toks)            # shares both pages
+    assert c1 == 5 and m3.needs_cow(s1, 5)
+    # 2 free pages, write page shared: 1 reserved for the CoW copy,
+    # base token fits the (about-to-be-copied) page -> 1 spare page
+    have = 2
+    assert m3.draft_allowance(s1) == (have + 1) * 4 - 6
+
+
+def _spec_rollback_sim(spec_mgr, plain_mgr, rng, steps=1000):
+    """Mirror a speculating and a never-speculating run over two managers:
+    identical admissions/registrations/frees; decode steps speculate k
+    drafts with m <= k accepted on the spec manager (ensure_capacity for
+    1 + k, ONE prepare_write, advance 1 + m, trim) vs the plain manager
+    emitting the same m + 1 tokens one step at a time."""
+    base = [int(x) for x in rng.randint(0, 50, (8,))]
+    prompts = [base[:4] + [int(x) for x in rng.randint(50, 99, (k,))]
+               for k in (1, 3, 5, 8)] + [base, base[:6]]
+    active: dict[int, list[int]] = {}
+    registered: dict[int, list[int]] = {}
+
+    def canon(m):
+        """Canonical cache state, invariant to the page-ID permutation a
+        one-shot (grow k, then CoW) allocation order introduces vs the
+        plain run's interleaved per-token pops: per-slot (refcount,
+        registration-key) at every table index, the LRU as its key
+        sequence, the registry keyed by content with each page's
+        refcount + LRU membership, and the free-pool size. Equal canon =
+        every refcount, every pin and every free page accounted — a
+        leaked draft page or a stolen pin cannot hide in a renaming."""
+        rows = tuple(
+            tuple((int(m._refcount[p]), m._page_key.get(int(p)))
+                  if p >= 0 else None for p in row)
+            for row in m._page_table)
+        lru_keys = tuple(m._page_key[p] for p in m._lru)
+        reg = {key: (int(m._refcount[p]), p in m._lru)
+               for key, p in m._prefix_pages.items()}
+        return (tuple(int(x) for x in m._seq_lens), rows,
+                len(m._free_pages), lru_keys, reg)
+
+    def check_mirror():
+        assert canon(spec_mgr) == canon(plain_mgr)
+
+    for step in range(steps):
+        op = rng.rand()
+        if op < 0.3 and spec_mgr.free_slot_count:
+            ctx = list(prompts[rng.randint(len(prompts))])
+            if spec_mgr.pages_needed(len(ctx)) <= \
+                    spec_mgr.available_page_count:
+                slot, cached = spec_mgr.admit_prefix(ctx)
+                slot_p, cached_p = plain_mgr.admit_prefix(ctx)
+                assert (slot, cached) == (slot_p, cached_p)
+                active[slot] = ctx
+                registered[slot] = list(ctx)
+        elif op < 0.75 and active:
+            slot = list(active)[rng.randint(len(active))]
+            written = spec_mgr.seq_len(slot)
+            ctx = active[slot]
+            if written < len(ctx) - 1:
+                # prefill chunk: identical on both managers
+                n = min(int(rng.randint(1, 5)), len(ctx) - 1 - written)
+                if not spec_mgr.ensure_capacity(slot, written + n):
+                    continue
+                assert plain_mgr.ensure_capacity(slot, written + n)
+                cow_s = spec_mgr.prepare_write(slot, written)
+                cow_p = plain_mgr.prepare_write(slot, written)
+                assert (cow_s is None) == (cow_p is None)
+                spec_mgr.advance(slot, n)
+                plain_mgr.advance(slot, n)
+            else:
+                # decode: speculate k, accept m — vs m+1 plain steps
+                k = int(rng.randint(0, 5))
+                k = max(0, min(k, spec_mgr.draft_allowance(slot)))
+                if written + 1 > spec_mgr.max_seq_len or not \
+                        spec_mgr.ensure_capacity(slot, written + 1 + k):
+                    spec_mgr.free(slot)
+                    plain_mgr.free(slot)
+                    del active[slot]
+                    registered.pop(slot, None)
+                    continue
+                spec_mgr.prepare_write(slot, written)
+                # the spec-step immutability invariant: every verify-row
+                # write position owns its page exclusively
+                for pos in range(written, written + 1 + k):
+                    pg = int(spec_mgr._page_table[slot,
+                                                  pos // spec_mgr.page_size])
+                    assert pg >= 0 and spec_mgr._refcount[pg] == 1
+                m = int(rng.randint(0, k + 1))
+                spec_mgr.advance(slot, 1 + m)
+                spec_mgr.trim_pages(slot)
+                for _ in range(1 + m):
+                    w = plain_mgr.seq_len(slot)
+                    assert plain_mgr.ensure_capacity(slot, w + 1)
+                    plain_mgr.prepare_write(slot, w)
+                    plain_mgr.advance(slot, 1)
+                while len(ctx) < spec_mgr.seq_len(slot) + 1:
+                    ctx.append(int(rng.randint(0, 99)))   # "emitted"
+            if (slot in registered
+                    and spec_mgr.seq_len(slot) >= len(registered[slot])):
+                spec_mgr.register_prefix(slot, registered[slot])
+                plain_mgr.register_prefix(slot, registered.pop(slot))
+        elif active:
+            slot = list(active)[rng.randint(len(active))]
+            spec_mgr.free(slot)
+            plain_mgr.free(slot)
+            del active[slot]
+            registered.pop(slot, None)
+        check_mirror()
+    for slot in list(active):
+        spec_mgr.free(slot)
+        plain_mgr.free(slot)
+    check_mirror()
+
+
+def test_spec_rollback_1k_churn_identical_to_never_speculated(rng):
+    """THE rollback property gate: 1k random admit / prefill / speculate
+    (random accept/reject) / preempt churn leaves page refcounts, free
+    lists and prefix-cache pins IDENTICAL to a mirrored never-speculated
+    run (up to the pool's page-ID renaming — see ``canon``): rejected
+    drafts cost exactly nothing."""
+    from test_prefix_cache import _check_invariants
+
+    def mk():
+        return KVCacheManager(num_layers=2, num_kv_heads=2, head_dim=8,
+                              num_pages=10, max_batch=3, max_seq_len=48,
+                              page_size=4, enable_prefix_cache=True)
+
+    spec_mgr, plain_mgr = mk(), mk()
+    _spec_rollback_sim(spec_mgr, plain_mgr, rng, steps=1000)
+    _check_invariants(spec_mgr)
+    _check_invariants(plain_mgr)
+    assert spec_mgr.available_page_count == spec_mgr.num_pages
+    assert spec_mgr.prefix_hit_rate > 0.0    # the churn actually shared
 
 
 def test_quantized_generate_kernel_leg_matches_oracle(rng):
