@@ -1,6 +1,6 @@
 """Serving benchmark: unified ragged serving step vs the legacy two-jit path,
 plus the round-10 quantized A/B legs (fp vs int8-weights vs
-int8-weights + int8-KV).
+int8-weights + int8-KV) and the round-13 sync-vs-async engine A/B.
 
 The round-9 serving A/B, joining the bench trajectory next to bench.py's
 training lines. Drives the continuous-batching ServingPredictor through a
@@ -41,6 +41,20 @@ schema/contract as bench.py — the flagship quantized line LAST):
   accepted drafts + the bonus token) and the fraction of proposed drafts
   the verify pass accepted; the k4 leg's ``vs_baseline`` over the
   spec-off leg is the effective speculation speedup
+- ``step_gap_frac``/``host_ms_per_step``/``async_emissions_match``: the
+  round-13 engine A/B (``unified-step`` vs ``unified-async``) — the
+  no-step-in-flight wall-clock fraction (host-observable upper bound on
+  device idle between steps), host scheduling ms outside blocking waits,
+  and the greedy emission bit-identity gate of the async leg against the
+  sync leg. The pair is measured as ONE run with their timed windows
+  INTERLEAVED (sync, async, sync, ...) and per-leg MEDIANS reported, so
+  machine drift on a small CI box (GC, neighbors, cpufreq) hits both
+  engines alike instead of inverting a strict single-window comparison;
+  the paired sync stats ride the async line (``sync_tokens_per_s`` /
+  ``sync_step_gap_frac``) and its ``vs_baseline`` self-baselines on
+  them, so the strict gates never compare across workloads (the pair
+  floors gen_len/batch/prompt — a 2-3 token output budget would leave
+  no deferral headroom to measure).
 
 ``--smoke``: tiny CPU config — always runnable (CI leg, rc 0; gather
 reference attention keeps it fast, kernel parity is the test suite's
@@ -94,134 +108,214 @@ def _hbm_bytes_per_token(sp, batch, avg_ctx):
     return int(wb + kv)
 
 
-def bench_serving(*, hidden, layers, heads, vocab, batch, prompt, steps,
-                  gen_len, page_size, chunk, unified, use_kernel, on_tpu,
-                  dtype=None, weight_dtype=None, kv_cache_dtype=None,
-                  mesh_chips=1, spec_decode_k=0, spec_workload=False):
-    """One serving leg. Returns a dict of the emitted metrics.
-
-    Workload: CONTINUOUS arrivals — ``batch`` concurrent requests drawn
-    round-robin from a small prompt pool (production repeated-system-
-    prompt traffic: prefix hits for the unified leg); every finished
-    request is immediately replaced, so the timed window mixes admissions,
-    chunked prefill and decode the way a serving fleet does. This is the
-    regime the round-9 tentpole targets — the legacy leg pays a full
-    head-of-line prompt forward per admission, the unified leg interleaves
-    chunks under the token budget and skips re-prefilling cached prefixes.
-
-    ``spec_workload`` (round 12): the speculative A/B legs run a
-    REPETITIVE-prompt churn — tiled short motifs (multi-turn / templated
-    traffic, the regime prompt-lookup drafting targets) with enough decode
-    steps per request (gen_len >= 12) for the per-request n-gram table to
-    capture the model's repetition. ``spec_decode_k`` > 0 turns on the
-    draft–verify–accept loop; the leg reports ``accepted_tokens_per_step``
-    (tokens emitted per completing decode lane-step — 1.0 = plain decode)
-    and ``draft_acceptance_rate``.
+class _ChurnLeg:
+    """One continuous-arrival churn over one predictor: ``batch``
+    concurrent requests drawn round-robin from a small prompt pool
+    (production repeated-system-prompt traffic — prefix hits for the
+    unified legs); every finished request is immediately replaced, so a
+    timed window mixes admissions, chunked prefill and decode the way a
+    serving fleet does. ``window(steps)`` times one measurement window
+    (flush INSIDE the timing, so deferred async emissions count);
+    ``report()`` aggregates per-window MEDIANS into the JSON-line dict.
     """
-    import jax.numpy as jnp
 
-    import paddle_tpu as paddle
-    from paddle_tpu.inference import ServingPredictor
-    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    def __init__(self, *, hidden, layers, heads, vocab, batch, prompt,
+                 gen_len, page_size, chunk, unified, use_kernel, on_tpu,
+                 dtype=None, weight_dtype=None, kv_cache_dtype=None,
+                 mesh_chips=1, spec_decode_k=0, spec_workload=False,
+                 async_engine=False):
+        import jax.numpy as jnp
 
-    if spec_workload:
-        gen_len = max(gen_len, 12)
-    max_len = prompt + gen_len + 32
-    paddle.seed(0)
-    cfg = GPTConfig(vocab_size=vocab, hidden_size=hidden, num_layers=layers,
-                    num_heads=heads, max_seq_len=max_len,
-                    weight_dtype=weight_dtype,
-                    kv_cache_dtype=kv_cache_dtype)
-    model = GPTForCausalLM(cfg)
-    model.eval()
-    mesh = None
-    if mesh_chips > 1:
-        from paddle_tpu.distributed.mesh import make_serving_mesh
+        import paddle_tpu as paddle
+        from paddle_tpu.inference import ServingPredictor
+        from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
 
-        mesh = make_serving_mesh(mesh_chips)
-    sp = ServingPredictor(
-        model, max_batch=batch, page_size=page_size, max_seq_len=max_len,
-        use_kernel=use_kernel, unified=unified, chunk=chunk,
-        dtype=jnp.bfloat16 if (on_tpu and dtype is None) else dtype,
-        mesh=mesh, spec_decode_k=spec_decode_k)
-    rng = np.random.RandomState(0)
-    if spec_workload:
-        # tiled 4-token motifs: every prompt internally repetitive
-        pool = [np.tile(rng.randint(0, vocab, (4,)),
-                        (prompt + 3) // 4)[:prompt]
-                for _ in range(max(2, batch // 2))]
-    else:
-        pool = [rng.randint(0, vocab, (prompt,))
-                for _ in range(max(2, batch // 2))]
-    arrivals = [0]
-    reqs = []
+        if spec_workload:
+            gen_len = max(gen_len, 12)
+        self.batch, self.prompt, self.gen_len = batch, prompt, gen_len
+        self.mesh_chips = mesh_chips
+        self.spec_workload = spec_workload
+        max_len = prompt + gen_len + 32
+        paddle.seed(0)
+        cfg = GPTConfig(vocab_size=vocab, hidden_size=hidden,
+                        num_layers=layers, num_heads=heads,
+                        max_seq_len=max_len, weight_dtype=weight_dtype,
+                        kv_cache_dtype=kv_cache_dtype)
+        model = GPTForCausalLM(cfg)
+        model.eval()
+        mesh = None
+        if mesh_chips > 1:
+            from paddle_tpu.distributed.mesh import make_serving_mesh
 
-    def top_up():
+            mesh = make_serving_mesh(mesh_chips)
+        self.sp = ServingPredictor(
+            model, max_batch=batch, page_size=page_size,
+            max_seq_len=max_len, use_kernel=use_kernel, unified=unified,
+            chunk=chunk,
+            dtype=jnp.bfloat16 if (on_tpu and dtype is None) else dtype,
+            mesh=mesh, spec_decode_k=spec_decode_k,
+            async_engine=async_engine)
+        rng = np.random.RandomState(0)
+        if spec_workload:
+            # tiled 4-token motifs: every prompt internally repetitive
+            self.pool = [np.tile(rng.randint(0, vocab, (4,)),
+                                 (prompt + 3) // 4)[:prompt]
+                         for _ in range(max(2, batch // 2))]
+        else:
+            self.pool = [rng.randint(0, vocab, (prompt,))
+                         for _ in range(max(2, batch // 2))]
+        self.arrivals = 0
+        self.reqs = []
+        self.lat = []
+        self.win_vals, self.win_gaps, self.win_host = [], [], []
+        self.first_wave = None
+        self.timed_from = 0
+        self.decode_before = 0
+        self.emitted_before = 0
+
+    def top_up(self):
         # keep the lanes full: every finished request is replaced by a
         # fresh one on the NEXT pool prompt (round-robin -> prefix reuse)
-        live = sum(1 for r in reqs if r.state != "finished")
-        while live < batch:
-            reqs.append(sp.add_request(pool[arrivals[0] % len(pool)],
-                                       max_new_tokens=gen_len))
-            arrivals[0] += 1
+        live = sum(1 for r in self.reqs if r.state != "finished")
+        while live < self.batch:
+            self.reqs.append(self.sp.add_request(
+                self.pool[self.arrivals % len(self.pool)],
+                max_new_tokens=self.gen_len))
+            self.arrivals += 1
             live += 1
 
-    # warmup: fill the lanes and run until every first-wave request has
-    # produced (compiles every shape: admission buckets, decode/unified)
-    top_up()
-    first_wave = list(reqs)
-    while any(not r.output_ids for r in first_wave):
-        sp.step()
+    def warm(self):
+        """Fill the lanes and run until every first-wave request has
+        produced (compiles every shape: admission buckets, the unified /
+        decode executables), then drain any async deferrals."""
+        self.top_up()
+        self.first_wave = list(self.reqs)
+        while any(not r.output_ids for r in self.first_wave):
+            self.sp.step()
+        self.sp.flush()
+        self.decode_before = self.sp.decode_trace_count
+        self.timed_from = len(self.reqs)
+        self.emitted_before = self.sp.tokens_emitted
 
-    # timed churn phase: one host sync per step (each produced token
-    # crosses to the host — that IS serving's latency path). Throughput
-    # counts EMITTED tokens (a speculative step can emit several per lane)
-    decode_before = sp.decode_trace_count
-    timed_from = len(reqs)
-    emitted_before = sp.tokens_emitted
-    lat = []
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        top_up()
-        t1 = time.perf_counter()
-        sp.step()
-        lat.append((time.perf_counter() - t1) * 1e3)
-    elapsed = time.perf_counter() - t0
-    produced_total = sp.tokens_emitted - emitted_before
-    # explicit raise (not assert): python -O must not let a dead scheduler
-    # emit a zero-looking-valid line
-    if not produced_total:
-        raise RuntimeError("no tokens produced over the timed phase")
-    # TTFT over requests ADMITTED during the timed churn (warm
-    # executables, steady state); falls back to the warmup wave when the
-    # window was too short for any churn admission to produce
-    ttfts = [r.ttft * 1e3 for r in reqs[timed_from:] if r.ttft is not None]
-    if not ttfts:
-        ttfts = [r.ttft * 1e3 for r in first_wave]
-    value = round(produced_total / elapsed, 1)
-    out = dict(
-        value=value,
-        unit="tokens/s",
-        p50_ms=round(_percentile(lat, 50), 2),
-        p99_ms=round(_percentile(lat, 99), 2),
-        ttft_p50_ms=round(_percentile(ttfts, 50), 2),
-        ttft_p99_ms=round(_percentile(ttfts, 99), 2),
-        prefix_hit_rate=round(sp.prefix_hit_rate, 3),
-        decode_retraces=sp.decode_trace_count - decode_before + 1,
-        prefill_retraces=sp.prefill_trace_count,
-        hbm_bytes_per_token=_hbm_bytes_per_token(
-            sp, batch, prompt + gen_len // 2),
-        mesh_chips=mesh_chips,
-        mesh_shape=f"mp{mesh_chips}",
-        tokens_per_s_per_chip=round(value / mesh_chips, 1),
-    )
-    if spec_workload:
-        # the round-12 speculation A/B metrics: the spec-off leg anchors
-        # accepted_tokens_per_step at exactly 1.0 on the same workload
-        out["accepted_tokens_per_step"] = round(
-            sp.accepted_tokens_per_step, 3)
-        out["draft_acceptance_rate"] = round(sp.draft_acceptance_rate, 3)
-    return out
+    def window(self, steps):
+        """One timed measurement window. The sync engine pays one host
+        sync per step; the async engine dispatches ahead and reconciles
+        behind-by-one / at the closing flush."""
+        sp = self.sp
+        sp.reset_perf_stats()
+        w_emitted = sp.tokens_emitted
+        tw = time.perf_counter()
+        for _ in range(steps):
+            self.top_up()
+            t1 = time.perf_counter()
+            sp.step()
+            self.lat.append((time.perf_counter() - t1) * 1e3)
+        sp.flush()
+        dw = time.perf_counter() - tw
+        self.win_vals.append((sp.tokens_emitted - w_emitted) / dw)
+        self.win_gaps.append(sp.step_gap_frac)
+        self.win_host.append(sp.host_ms_per_step)
+
+    def report(self):
+        """The emitted-metrics dict (medians over the measured windows —
+        robust to one GC pause / CI-neighbor burst per window)."""
+        sp = self.sp
+        produced_total = sp.tokens_emitted - self.emitted_before
+        # explicit raise (not assert): python -O must not let a dead
+        # scheduler emit a zero-looking-valid line
+        if not produced_total:
+            raise RuntimeError("no tokens produced over the timed phase")
+        # TTFT over requests ADMITTED during the timed churn (warm
+        # executables, steady state); falls back to the warmup wave when
+        # the window was too short for any churn admission to produce
+        ttfts = [r.ttft * 1e3 for r in self.reqs[self.timed_from:]
+                 if r.ttft is not None]
+        if not ttfts:
+            ttfts = [r.ttft * 1e3 for r in self.first_wave]
+        value = round(float(np.median(self.win_vals)), 1)
+        out = dict(
+            value=value,
+            unit="tokens/s",
+            p50_ms=round(_percentile(self.lat, 50), 2),
+            p99_ms=round(_percentile(self.lat, 99), 2),
+            ttft_p50_ms=round(_percentile(ttfts, 50), 2),
+            ttft_p99_ms=round(_percentile(ttfts, 99), 2),
+            prefix_hit_rate=round(sp.prefix_hit_rate, 3),
+            decode_retraces=sp.decode_trace_count - self.decode_before + 1,
+            prefill_retraces=sp.prefill_trace_count,
+            hbm_bytes_per_token=_hbm_bytes_per_token(
+                sp, self.batch, self.prompt + self.gen_len // 2),
+            mesh_chips=self.mesh_chips,
+            mesh_shape=f"mp{self.mesh_chips}",
+            tokens_per_s_per_chip=round(value / self.mesh_chips, 1),
+            # round 13: the host-bubble metrics the async engine buys down
+            step_gap_frac=round(float(np.median(self.win_gaps)), 4),
+            host_ms_per_step=round(float(np.median(self.win_host)), 3),
+        )
+        # per-arrival-index greedy emission streams + finished flag (NOT
+        # part of the JSON line): main() compares the async leg's streams
+        # against the sync leg's for the bit-identity gate — FULL
+        # equality for requests finished in both legs, prefix equality
+        # for in-progress tails
+        out["_streams"] = {i: (r.state == "finished", list(r.output_ids))
+                           for i, r in enumerate(self.reqs)}
+        if self.spec_workload:
+            # the round-12 speculation A/B metrics: the spec-off leg
+            # anchors accepted_tokens_per_step at exactly 1.0
+            out["accepted_tokens_per_step"] = round(
+                sp.accepted_tokens_per_step, 3)
+            out["draft_acceptance_rate"] = round(
+                sp.draft_acceptance_rate, 3)
+        return out
+
+
+class _gc_frozen:
+    """Collect once, then hold GC off across the timed windows: a cyclic
+    collection landing inside one leg's window is the single biggest
+    single-window distortion on a small CI box."""
+
+    def __enter__(self):
+        import gc
+
+        gc.collect()
+        self._was = gc.isenabled()
+        gc.disable()
+
+    def __exit__(self, *exc):
+        import gc
+
+        if self._was:
+            gc.enable()
+        return False
+
+
+def bench_serving(*, steps, windows=1, **leg_kw):
+    """One serving leg (see :class:`_ChurnLeg` for the workload).
+    Returns a dict of the emitted metrics; ``windows > 1`` reports
+    per-leg medians over several timed windows."""
+    leg = _ChurnLeg(**leg_kw)
+    leg.warm()
+    with _gc_frozen():
+        for _ in range(windows):
+            leg.window(steps)
+    return leg.report()
+
+
+def bench_serving_ab(*, steps, windows, **leg_kw):
+    """The round-13 sync-vs-async pair as ONE measurement: two engines
+    over identical churns, their timed windows INTERLEAVED (sync w0,
+    async w0, sync w1, ...) so slow machine drift hits both legs alike,
+    each leg reporting its median window. Returns (sync_out, async_out).
+    """
+    sync_leg = _ChurnLeg(async_engine=False, **leg_kw)
+    async_leg = _ChurnLeg(async_engine=True, **leg_kw)
+    sync_leg.warm()
+    async_leg.warm()
+    with _gc_frozen():
+        for _ in range(windows):
+            sync_leg.window(steps)
+            async_leg.window(steps)
+    return sync_leg.report(), async_leg.report()
 
 
 def main():
@@ -285,9 +379,27 @@ def main():
     cap = len(jax.devices()) if on_tpu else min(2, len(jax.devices()))
     n_mp = max(d for d in range(1, cap + 1)
                if shape["heads"] % d == 0 and 4 * shape["hidden"] % d == 0)
+    # the round-13 sync-vs-async pair is SELF-CONTAINED: both engines
+    # run the same floored workload (a 2-3 token output budget would make
+    # every step an emission boundary — no deferral headroom to measure —
+    # and a 6-step window is all noise) with their windows interleaved,
+    # and the PAIRED sync stats ride the async line (sync_tokens_per_s /
+    # sync_step_gap_frac) so its strict gates never compare across
+    # workloads. The emitted unified-step leg keeps the SHARED shape and
+    # stays the like-for-like baseline for the legacy/spmd/quant ratios.
+    ab_kw = dict(steps=max(12, shape["steps"]), windows=7)
+    ab_shape = dict({k: v for k, v in shape.items() if k != "steps"},
+                    gen_len=max(16, shape["gen_len"]),
+                    batch=max(4, shape["batch"]),
+                    prompt=max(16, shape["prompt"]))
     legs = [
         ("legacy-two-jit", dict(unified=False)),
         ("unified-step", dict(unified=True)),
+        # round-13 A/B: the SAME churn through the sync engine and the
+        # async double-buffered engine — dispatch-ahead + deferred
+        # reconcile vs one blocking sync per step; measured as one
+        # interleaved pair, greedy emissions bit-identical
+        ("unified-async", None),
         ("unified-spmd", dict(unified=True, mesh_chips=n_mp)),
         # round-12 speculation A/B: the SAME repetitive-prompt churn with
         # drafting off (the 1.0-tokens/lane-step anchor) vs k=4
@@ -299,22 +411,65 @@ def main():
                                       kv_cache_dtype="int8")),
     ]
     results = {}
+
+    def metric_for(name):
+        return (f"{FLAGSHIP_METRIC} ({label} prompt{shape['prompt']}"
+                f"+{shape['steps']} steps, {chip}) [{name}]")
+
     for name, over in legs:
-        metric = (f"{FLAGSHIP_METRIC} ({label} prompt{shape['prompt']}"
-                  f"+{shape['steps']} steps, {chip}) [{name}]")
         if not runnable:
             print(_error_line(
                 "backend_unavailable: paged decode needs a TPU chip, or "
-                "--smoke for the interpret leg", metric=metric))
+                "--smoke for the interpret leg", metric=metric_for(name)))
             continue
         try:
-            out = bench_serving(on_tpu=on_tpu, use_kernel=use_kernel,
-                                **shape, **over)
+            if name == "unified-async":
+                sync_out, async_out = bench_serving_ab(
+                    unified=True, on_tpu=on_tpu, use_kernel=use_kernel,
+                    **ab_shape, **ab_kw)
+                # the pair runs the FLOORED workload: its metric label
+                # must say so, not inherit the shared shape's
+                ab_metric = (
+                    f"{FLAGSHIP_METRIC} (smoke bs{ab_shape['batch']}"
+                    if smoke else
+                    f"{FLAGSHIP_METRIC} (gpt3-125m bs{ab_shape['batch']}"
+                ) + (f" prompt{ab_shape['prompt']}+{ab_kw['steps']}x"
+                     f"{ab_kw['windows']} steps, {chip}) [{name}]")
+                out = dict(metric=ab_metric, **async_out)
+                # the paired sync stats ride the async line — its strict
+                # gates (tokens/s higher, gap lower, streams identical)
+                # compare within the interleaved pair, one workload
+                out["sync_tokens_per_s"] = sync_out["value"]
+                out["sync_step_gap_frac"] = sync_out["step_gap_frac"]
+                out["vs_baseline"] = (
+                    round(out["value"] / sync_out["value"], 3)
+                    if sync_out["value"] else 0.0)
+                a, b = async_out["_streams"], sync_out["_streams"]
+
+                def _same(i):
+                    (af, at), (bf, bt) = a[i], b[i]
+                    if af and bf:
+                        # finished in BOTH legs: the streams must be
+                        # bit-identical INCLUDING length (a dropped
+                        # trailing token must fail the gate)
+                        return at == bt
+                    n = min(len(at), len(bt))
+                    return at[:n] == bt[:n]
+
+                common = set(a) & set(b)
+                out["async_emissions_match"] = float(
+                    bool(common) and all(_same(i) for i in common))
+                results[name] = out
+            else:
+                out = bench_serving(on_tpu=on_tpu, use_kernel=use_kernel,
+                                    steps=shape["steps"],
+                                    **{k: v for k, v in shape.items()
+                                       if k != "steps"}, **over)
+                results[name] = dict(metric=metric_for(name), **out)
         except Exception as e:  # one failed leg must not kill the others
             print(_error_line(f"{type(e).__name__}: {e}"[:200],
-                              metric=metric))
+                              metric=metric_for(name)))
             continue
-        results[name] = dict(metric=metric, **out)
 
     # line order = leg order, flagship (quantized unified) LAST.
     # vs_baseline: unified-step over the legacy two-jit path (the round-9
@@ -326,7 +481,10 @@ def main():
         if name not in results:
             return
         out = results[name]
-        if base is None:
+        out.pop("_streams", None)
+        if "vs_baseline" in out:
+            pass   # self-baselined (the async pair)
+        elif base is None:
             out["vs_baseline"] = 1.0
         elif base in results and results[base]["value"]:
             out["vs_baseline"] = round(
@@ -338,9 +496,11 @@ def main():
     # mesh leg baselines the fp unified step (mp=1): its vs_baseline IS
     # the mesh scaling factor on aggregate tokens/s; the spec leg
     # baselines the spec-off run of its OWN (repetitive) workload, so its
-    # vs_baseline is the effective speculation speedup
+    # vs_baseline is the effective speculation speedup; the async leg
+    # baselines the sync engine on the SAME interleaved churn
     _emit("legacy-two-jit", None)
     _emit("unified-step", "legacy-two-jit")
+    _emit("unified-async", None)
     _emit("unified-spmd", "unified-step")
     _emit("unified-spec-base", None)
     _emit("unified-spec-k4", "unified-spec-base")

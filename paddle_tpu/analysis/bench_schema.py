@@ -37,7 +37,16 @@ _OPTIONAL_NUMERIC = ("vs_baseline", "p50_ms", "p99_ms", "anchor_tflops",
                      "prefix_hit_rate", "decode_retraces",
                      "prefill_retraces", "hbm_bytes_per_token",
                      "mesh_chips", "tokens_per_s_per_chip",
-                     "accepted_tokens_per_step", "draft_acceptance_rate")
+                     "accepted_tokens_per_step", "draft_acceptance_rate",
+                     # round 13: sync-vs-async serving A/B — the
+                     # no-step-in-flight wall-clock fraction (device-idle
+                     # upper bound), host scheduling ms outside blocking
+                     # waits, and the greedy emission-identity gate of
+                     # the async leg against the sync leg (1.0 = every
+                     # common request's stream bit-identical)
+                     "step_gap_frac", "host_ms_per_step",
+                     "async_emissions_match", "sync_tokens_per_s",
+                     "sync_step_gap_frac")
 _OPTIONAL_STRING = ("mesh_shape",)
 
 

@@ -26,7 +26,16 @@ builds of exactly the programs that carry the repo's numbers:
                   fp and int8-weight/int8-KV variants — jaxpr walk of the
                   draft-token verify/accept program and the JX005
                   donation audit over the pools and scale planes at their
-                  SHIFTED positions (the spec_len input precedes them).
+                  SHIFTED positions (the spec_len input precedes them);
+- ``serving-async``  the round-13 feedback-coupled unified step as the
+                  async double-buffered engine drives it: a LIVE
+                  ``feedback`` mask routing a decode lane's input token
+                  from the previous step's ``prev_toks`` carry, the
+                  on-device sample-key fold, and the JX005 donation
+                  audit at the feedback-shifted pool positions — a
+                  dispatch-ahead step that silently stopped aliasing its
+                  pools would double cache memory exactly when two steps
+                  are in flight.
 
 Configs are tiny (seconds on CPU; the analysis is abstract — eval_shape /
 make_jaxpr, no FLOPs run) but structurally identical to the flagship
@@ -171,6 +180,10 @@ def analyze_serving_unified() -> list[Finding]:
     kv_lens = mgr.seq_lens_device() * 0
     last_idx = jnp.asarray([0, chunk], jnp.int32)
     no_cow = jnp.full((b,), mgr.num_pages, jnp.int32)
+    feedback = jnp.zeros((budget,), jnp.int32)
+    prev_toks = jnp.zeros((b,), jnp.int32)
+    emit = jnp.asarray([1, 0], jnp.int32)
+    produced = jnp.zeros((b,), jnp.int32)
     keys = jnp.zeros((b, 2), jnp.uint32)
     temp = jnp.asarray([0.0, 0.8], jnp.float32)
     top_k = jnp.asarray([0, 40], jnp.int32)
@@ -178,12 +191,13 @@ def analyze_serving_unified() -> list[Finding]:
 
     step = build_unified_step(cfg, page_size, chunk)
     args = (params, tok_ids, tok_slot, tok_pos, q_lens, kv_lens, last_idx,
+            feedback, prev_toks, emit, produced,
             mgr.k_pages, mgr.v_pages, mgr.page_table_device(), no_cow,
             no_cow, keys, temp, top_k, top_p)
     findings = analyze_jaxpr(trace_callable(step, *args),
                              "serving-unified-step")
     # the builder donates the K/V page pools; both must alias outputs
-    findings += check_donation(step, args, (7, 8), "serving-unified-step")
+    findings += check_donation(step, args, (11, 12), "serving-unified-step")
     return findings
 
 
@@ -253,19 +267,24 @@ def analyze_serving_quant() -> list[Finding]:
     kv_lens = qmgr.seq_lens_device()
     last_idx = jnp.asarray([0, chunk], jnp.int32)
     no_cow = jnp.full((b,), qmgr.num_pages, jnp.int32)
+    feedback = jnp.zeros((budget,), jnp.int32)
+    prev_toks = jnp.zeros((b,), jnp.int32)
+    emit = jnp.asarray([1, 0], jnp.int32)
+    produced = jnp.zeros((b,), jnp.int32)
     keys = jnp.zeros((b, 2), jnp.uint32)
     temp = jnp.asarray([0.0, 0.8], jnp.float32)
     top_k = jnp.asarray([0, 40], jnp.int32)
     top_p = jnp.asarray([1.0, 0.9], jnp.float32)
     step = build_unified_step(cfg, page_size, chunk, kv_quant=True)
     args = (params, tok_ids, tok_slot, tok_pos, q_lens, kv_lens, last_idx,
+            feedback, prev_toks, emit, produced,
             qmgr.k_pages, qmgr.v_pages, qmgr.k_scales, qmgr.v_scales,
             qmgr.page_table_device(), no_cow, no_cow, keys, temp, top_k,
             top_p)
     findings += analyze_jaxpr(trace_callable(step, *args),
                               "serving-quant-unified-step")
     # pools AND scale planes donate; all four must alias outputs
-    findings += check_donation(step, args, (7, 8, 9, 10),
+    findings += check_donation(step, args, (11, 12, 13, 14),
                                "serving-quant-unified-step")
     return findings
 
@@ -344,6 +363,10 @@ def analyze_serving_spmd() -> list[Finding]:
     kv_lens = qmgr.seq_lens_device()
     last_idx = jnp.asarray([0, chunk], jnp.int32)
     no_cow = jnp.full((b,), qmgr.num_pages, jnp.int32)
+    feedback = jnp.zeros((budget,), jnp.int32)
+    prev_toks = jnp.zeros((b,), jnp.int32)
+    emit = jnp.asarray([1, 0], jnp.int32)
+    produced = jnp.zeros((b,), jnp.int32)
     keys = jnp.zeros((b, 2), jnp.uint32)
     temp = jnp.asarray([0.0, 0.8], jnp.float32)
     top_k = jnp.asarray([0, 40], jnp.int32)
@@ -351,12 +374,13 @@ def analyze_serving_spmd() -> list[Finding]:
     step = build_unified_step(cfg, page_size, chunk, kv_quant=True,
                               mesh=mesh)
     args = (q_params, tok_ids, tok_slot, tok_pos, q_lens, kv_lens, last_idx,
+            feedback, prev_toks, emit, produced,
             qmgr.k_pages, qmgr.v_pages, qmgr.k_scales, qmgr.v_scales,
             qmgr.page_table_device(), no_cow, no_cow, keys, temp, top_k,
             top_p)
     findings += analyze_jaxpr(trace_callable(step, *args),
                               "serving-spmd-unified-step")
-    findings += check_donation(step, args, (7, 8, 9, 10),
+    findings += check_donation(step, args, (11, 12, 13, 14),
                                "serving-spmd-unified-step")
     return findings
 
@@ -409,18 +433,23 @@ def analyze_serving_spec() -> list[Finding]:
         last_idx = jnp.asarray([0, 3 + chunk - 1], jnp.int32)
         spec_len = jnp.asarray([2, 0], jnp.int32)
         no_cow = jnp.full((b,), mgr.num_pages, jnp.int32)
-        keys = jnp.zeros((b, spec_k + 1, 2), jnp.uint32)
+        feedback = jnp.zeros((budget,), jnp.int32)
+        prev_toks = jnp.zeros((b,), jnp.int32)
+        emit = jnp.asarray([1, 1], jnp.int32)
+        produced = jnp.zeros((b,), jnp.int32)
+        keys = jnp.zeros((b, 2), jnp.uint32)
         temp = jnp.asarray([0.0, 0.8], jnp.float32)
         top_k = jnp.asarray([0, 40], jnp.int32)
         top_p = jnp.asarray([1.0, 0.9], jnp.float32)
         pools = ((mgr.k_pages, mgr.v_pages, mgr.k_scales, mgr.v_scales)
                  if mgr.quantize_kv else (mgr.k_pages, mgr.v_pages))
         return (params, tok_ids, tok_slot, tok_pos, q_lens, kv_lens,
-                last_idx, spec_len) + pools + (
+                last_idx, spec_len, feedback, prev_toks, emit,
+                produced) + pools + (
                     mgr.page_table_device(), no_cow, no_cow, keys, temp,
                     top_k, top_p)
 
-    # fp speculative step: pools donate at the spec-shifted (8, 9)
+    # fp speculative step: pools donate at the spec-shifted (12, 13)
     mgr = KVCacheManager(cfg.num_layers, cfg.num_heads, cfg.head_dim,
                          num_pages=2 * b * (cfg.max_seq_len // page_size),
                          max_batch=b, max_seq_len=cfg.max_seq_len,
@@ -430,10 +459,10 @@ def analyze_serving_spec() -> list[Finding]:
     args = spec_args(fp_params, mgr)
     findings += analyze_jaxpr(trace_callable(step, *args),
                               "serving-spec-step")
-    findings += check_donation(step, args, (8, 9), "serving-spec-step")
+    findings += check_donation(step, args, (12, 13), "serving-spec-step")
 
     # int8-weight + int8-KV speculative step: pools AND scale planes
-    # donate at (8, 9, 10, 11)
+    # donate at (12, 13, 14, 15)
     qmgr = KVCacheManager(cfg.num_layers, cfg.num_heads, cfg.head_dim,
                           num_pages=2 * b * (cfg.max_seq_len // page_size),
                           max_batch=b, max_seq_len=cfg.max_seq_len,
@@ -444,8 +473,71 @@ def analyze_serving_spec() -> list[Finding]:
     qargs = spec_args(q_params, qmgr)
     findings += analyze_jaxpr(trace_callable(qstep, *qargs),
                               "serving-spec-quant-step")
-    findings += check_donation(qstep, qargs, (8, 9, 10, 11),
+    findings += check_donation(qstep, qargs, (12, 13, 14, 15),
                                "serving-spec-quant-step")
+    return findings
+
+
+def analyze_serving_async() -> list[Finding]:
+    """Round-13 async serving: the unified step with the device-resident
+    feedback path LIVE — a decode lane reading its input token from the
+    previous step's ``prev_toks`` carry through the ``feedback`` mask,
+    and a sampling lane folding its keys on-device from (base key,
+    produced). Jaxpr walk + the JX005 donation audit of the pools at
+    their feedback-shifted positions: the async engine threads the pools
+    through back-to-back in-flight steps, so a lost donation would
+    double-buffer the largest serving allocation."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from ..inference.kv_cache import KVCacheManager
+    from ..models.gpt import (GPTConfig, GPTForCausalLM, build_unified_step,
+                              serving_params)
+
+    cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                    num_heads=2, max_seq_len=32)
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg)
+    params = serving_params(model)
+    page_size, chunk, b = 8, 4, 2
+    budget = b + chunk
+    mgr = KVCacheManager(cfg.num_layers, cfg.num_heads, cfg.head_dim,
+                         num_pages=2 * b * (cfg.max_seq_len // page_size),
+                         max_batch=b, max_seq_len=cfg.max_seq_len,
+                         page_size=page_size, dtype=jnp.float32,
+                         enable_prefix_cache=True)
+    rng = np.random.RandomState(0)
+    for _ in range(b):
+        mgr.admit_prefix([int(x) for x in rng.randint(0, 128, (8,))])
+    # the steady async shape: slot 0 decodes its IN-FLIGHT token (the
+    # feedback lane — tok_ids carries a placeholder the step overrides
+    # with prev_toks[0]), slot 1 samples a completing decode token
+    tok_ids = jnp.asarray(rng.randint(0, 128, (budget,)), jnp.int32)
+    tok_slot = jnp.asarray([0, 1] + [-1] * (budget - 2), jnp.int32)
+    tok_pos = jnp.asarray([8, 8] + [0] * (budget - 2), jnp.int32)
+    q_lens = jnp.asarray([1, 1], jnp.int32)
+    kv_lens = jnp.asarray([8, 8], jnp.int32)
+    last_idx = jnp.asarray([0, 1], jnp.int32)
+    feedback = jnp.asarray([1, 0] + [0] * (budget - 2), jnp.int32)
+    prev_toks = jnp.asarray(rng.randint(0, 128, (b,)), jnp.int32)
+    emit = jnp.ones((b,), jnp.int32)
+    produced = jnp.asarray([3, 5], jnp.int32)
+    no_cow = jnp.full((b,), mgr.num_pages, jnp.int32)
+    keys = jnp.asarray(rng.randint(0, 2**31, (b, 2)), jnp.uint32)
+    temp = jnp.asarray([0.0, 0.8], jnp.float32)
+    top_k = jnp.asarray([0, 40], jnp.int32)
+    top_p = jnp.asarray([1.0, 0.9], jnp.float32)
+
+    step = build_unified_step(cfg, page_size, chunk)
+    args = (params, tok_ids, tok_slot, tok_pos, q_lens, kv_lens, last_idx,
+            feedback, prev_toks, emit, produced,
+            mgr.k_pages, mgr.v_pages, mgr.page_table_device(), no_cow,
+            no_cow, keys, temp, top_k, top_p)
+    findings = analyze_jaxpr(trace_callable(step, *args),
+                             "serving-async-step")
+    findings += check_donation(step, args, (11, 12), "serving-async-step")
     return findings
 
 
@@ -458,6 +550,7 @@ TARGETS = {
     "serving-quant": analyze_serving_quant,
     "serving-spmd": analyze_serving_spmd,
     "serving-spec": analyze_serving_spec,
+    "serving-async": analyze_serving_async,
 }
 
 

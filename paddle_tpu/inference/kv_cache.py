@@ -246,10 +246,18 @@ class KVCacheManager:
                 sc_sh = NamedSharding(mesh, P(None, None, None, "mp"))
                 self.k_scales = jax.device_put(self.k_scales, sc_sh)
                 self.v_scales = jax.device_put(self.v_scales, sc_sh)
-        # host-side bookkeeping (numpy; uploaded per step as small arrays)
+        # host-side bookkeeping (numpy; uploaded per step as small arrays).
+        # the device views are REVISION-CACHED: every mutator bumps its
+        # revision and the upload happens only when a view is stale — a
+        # steady decode step whose lanes stay inside their pages re-serves
+        # the same device page table with zero H2D traffic (round 13)
         self._page_table = np.full(
             (self.max_batch, self.pages_per_slot), -1, np.int32)
         self._seq_lens = np.zeros((self.max_batch,), np.int32)
+        self._pt_rev = 0
+        self._sl_rev = 0
+        self._pt_dev: tuple[int, jnp.ndarray | None] = (-1, None)
+        self._sl_dev: tuple[int, jnp.ndarray | None] = (-1, None)
         self._free_pages = list(range(self.num_pages - 1, -1, -1))  # pop()
         self._free_slots = list(range(self.max_batch - 1, -1, -1))
         # prefix cache state: per-page slot refcounts, the content-key
@@ -333,6 +341,8 @@ class KVCacheManager:
             self._page_table[slot, i] = page
             self._refcount[page] = 1
         self._seq_lens[slot] = prompt_len
+        self._pt_rev += 1
+        self._sl_rev += 1
         return slot
 
     def ensure_capacity(self, slot: int, new_len: int) -> bool:
@@ -351,10 +361,12 @@ class KVCacheManager:
             page = self._alloc_page()
             self._page_table[slot, i] = page
             self._refcount[page] = 1
+        self._pt_rev += 1
         return True
 
     def advance(self, slot: int, n: int = 1) -> None:
         self._seq_lens[slot] += n
+        self._sl_rev += 1
 
     def draft_allowance(self, slot: int, reserve: int = 0) -> int:
         """Draft tokens ``slot`` may feed this step beyond its base
@@ -426,6 +438,8 @@ class KVCacheManager:
             self._page_table[slot, i] = -1
             self._release_page(page)
             freed += 1
+        if freed:
+            self._pt_rev += 1
         return freed
 
     def free(self, slot: int) -> None:
@@ -437,6 +451,8 @@ class KVCacheManager:
                 self._release_page(pg)
             self._page_table[slot, i] = -1
         self._seq_lens[slot] = 0
+        self._pt_rev += 1
+        self._sl_rev += 1
         self._free_slots.append(slot)
 
     # -- prefix cache ------------------------------------------------------
@@ -533,6 +549,8 @@ class KVCacheManager:
             self._page_table[slot, i] = page
             self._refcount[page] = 1
         self._seq_lens[slot] = matched
+        self._pt_rev += 1
+        self._sl_rev += 1
         return slot, matched
 
     def register_prefix(self, slot: int, tokens, include_tail=True) -> None:
@@ -594,16 +612,29 @@ class KVCacheManager:
         dst = self._alloc_page()
         self._refcount[dst] = 1
         self._page_table[slot, i] = dst
+        self._pt_rev += 1
         self._refcount[page] -= 1   # >= 1 left: stays pinned, registered
         return page, dst
 
     # -- device views ------------------------------------------------------
 
     def page_table_device(self) -> jnp.ndarray:
-        return jnp.asarray(self._page_table)
+        # upload from a PRIVATE copy: the async engine mutates the live
+        # numpy bookkeeping (advance/growth) right after dispatch, while
+        # the dispatched step's H2D transfer may still be in flight — an
+        # aliased buffer would race the device read
+        rev, dev = self._pt_dev
+        if rev != self._pt_rev:
+            dev = jnp.asarray(self._page_table.copy())
+            self._pt_dev = (self._pt_rev, dev)
+        return dev
 
     def seq_lens_device(self) -> jnp.ndarray:
-        return jnp.asarray(self._seq_lens)
+        rev, dev = self._sl_dev
+        if rev != self._sl_rev:
+            dev = jnp.asarray(self._seq_lens.copy())
+            self._sl_dev = (self._sl_rev, dev)
+        return dev
 
     def seq_len(self, slot: int) -> int:
         return int(self._seq_lens[slot])

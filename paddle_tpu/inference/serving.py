@@ -44,12 +44,38 @@ tokens-produced), and rejected drafts' over-allocated pages roll back
 host-side (``KVCacheManager.trim_pages``) so page/refcount accounting
 stays identical to a never-speculated run.
 
+Round 13 adds the ASYNC DOUBLE-BUFFERED ENGINE (``async_engine=True``):
+``step()`` packs and DISPATCHES step N, then reconciles step N-1's
+deferred results — the host scheduler and the device execute
+concurrently (JAX async dispatch), so the TPU never idles through the
+pack/bookkeeping gap the synchronous loop pays between steps (the
+inter-step host bubble MPK diagnoses). The enabler is device-resident
+sampled-token feedback: the unified step returns a per-lane ``next_toks``
+carry that the next step consumes as a traced input (``feedback`` mask +
+``prev_toks``), so decode lanes advance WITHOUT materializing the token
+on the host. Host bookkeeping that only needs token COUNTS (page growth,
+capacity, admission, budget-retirement, prefix registration) runs at
+pack time; bookkeeping that needs token VALUES (``output_ids``, eos
+detection, TTFT, preemption-replay contexts, spec drafts/rollback)
+reconciles one step behind on the deferred results. The hard host syncs
+are exactly the emission boundaries: a step whose emissions could finish
+a request (eos configured / output budget reachable) reconciles
+behind-by-one; steps that cannot complete anything defer up to
+``max_inflight_steps`` and drain in one batched materialization
+(``flush()``). Greedy output is bit-identical and seeded sampling
+stream-identical to the synchronous engine (per-request streams are
+batch-order invariant); ``async_engine=False`` (default) keeps the
+synchronous engine as the oracle — both drive the SAME pack/capacity
+code, the sync engine simply reconciles at pipeline depth zero.
+
 Knobs: ``max_batch`` (lanes), ``num_pages``/``page_size`` (pool geometry),
 ``max_seq_len`` (page-table width), ``chunk`` (per-slot prefill chunk,
 autotuned default), ``token_budget`` (tokens per step, default
 ``max_batch * (1 + spec_k) + chunk``), ``prefix_cache`` (on by default
 when unified), ``spec_decode_k`` (speculation build geometry, default
-``config.spec_decode_k``).
+``config.spec_decode_k``), ``async_engine`` (the round-13 pipelined
+engine) + ``max_inflight_steps`` (deferral bound for steps that cannot
+complete any request).
 """
 from __future__ import annotations
 
@@ -87,6 +113,11 @@ class Request:
         self.top_p = float(top_p)
         self.seed = self.req_id if seed is None else int(seed)
         self.output_ids: list[int] = []
+        # tokens the async engine has dispatched for this request but not
+        # yet materialized on the host (always 0 in the sync engine once
+        # a step returns): they count toward the output budget and the
+        # context length, their VALUES land at reconcile
+        self._pending_n = 0
         self.state = WAITING
         self.preempt_count = 0
         self.truncated = False  # stopped by the max_seq_len ceiling
@@ -100,10 +131,16 @@ class Request:
     def done(self) -> bool:
         if self.truncated:
             return True
-        if len(self.output_ids) >= self.max_new_tokens:
+        if len(self.output_ids) + self._pending_n >= self.max_new_tokens:
             return True
         return (self.eos_token_id is not None and self.output_ids
                 and self.output_ids[-1] == self.eos_token_id)
+
+    @property
+    def _ctx_len(self) -> int:
+        """Context length INCLUDING dispatched-unmaterialized tokens —
+        what the scheduler's count-based packing sees."""
+        return len(self.prompt_ids) + len(self.output_ids) + self._pending_n
 
     @property
     def ttft(self) -> float | None:
@@ -118,6 +155,25 @@ class Request:
         return self.prompt_ids + self.output_ids
 
 
+class _Pending:
+    """One dispatched-but-unreconciled unified step — an entry of the
+    async engine's in-flight ring. Holds the DEVICE handles of the step's
+    emission outputs (unmaterialized jax arrays) plus the host records
+    needed to land them one step behind: materializing ``out``/``ne`` is
+    the engine's ONE hard sync."""
+
+    __slots__ = ("out", "ne", "completing", "spec", "spec_slots",
+                 "must_sync")
+
+    def __init__(self, out, ne, completing, spec, spec_slots, must_sync):
+        self.out = out                 # device next_toks / out_ids
+        self.ne = ne                   # device n_emit (spec builds)
+        self.completing = completing   # [(slot, req, k_i, was_decode)]
+        self.spec = spec
+        self.spec_slots = spec_slots   # lanes advancing by n_emit + trim
+        self.must_sync = must_sync     # some emission could finish a req
+
+
 class ServingPredictor:
     """Continuous-batching predictor for a GPT model.
 
@@ -125,14 +181,19 @@ class ServingPredictor:
     grow / preempt around ONE unified-step launch); ``generate`` drives
     ``step`` until a set of prompts finishes. ``unified=False`` falls back
     to the round-7 two-jit path (per-bucket prefill at admission + decode
-    step) — the A/B baseline.
+    step) — the A/B baseline. ``async_engine=True`` (round 13) overlaps
+    host scheduling with device execution: ``step()`` dispatches round N
+    and reconciles round N-1's deferred emissions (see the module
+    docstring for the sync-boundary contract); ``flush()`` drains the
+    in-flight ring.
     """
 
     def __init__(self, model, *, max_batch=8, num_pages=None, page_size=None,
                  max_seq_len=None, use_kernel=None, prefill_bucket=16,
                  dtype=None, unified=True, chunk=None, token_budget=None,
                  prefix_cache=None, kv_cache_dtype=None, mesh=None,
-                 spec_decode_k=None):
+                 spec_decode_k=None, async_engine=False,
+                 max_inflight_steps=4):
         from ..distributed.mesh import as_serving_mesh
         from ..models.gpt import (_serving_params_cached, build_decode_step,
                                   build_prefill, build_unified_step,
@@ -232,15 +293,50 @@ class ServingPredictor:
             # bucket shape (prompts are padded to _bucket multiples)
             self._prefill = build_prefill(cfg, self.cache.page_size,
                                           mesh=self.mesh)
+        # round 13: the async double-buffered engine — dispatch-ahead on
+        # the unified step's device-resident token feedback; the sync
+        # engine is the same pack/capacity code at pipeline depth zero
+        self.async_engine = bool(async_engine)
+        self.max_inflight_steps = max(1, int(max_inflight_steps))
+        if self.async_engine and not self.unified:
+            raise ValueError(
+                "the async engine rides the unified step's device-resident "
+                "token feedback; the legacy two-jit path serves sync only")
+        self._inflight: deque[_Pending] = deque()
+        self.hard_syncs = 0      # step()/flush() calls that materialized
+        self._did_sync = False   # set by _reconcile_one, charged per call
         self.waiting: deque[Request] = deque()
         self.running: dict[int, Request] = {}   # slot -> request
         self._next_token = np.zeros((self.max_batch,), np.int32)
         self._no_cow = jnp.full((self.max_batch,), self.cache.num_pages,
                                 jnp.int32)
-        self._zero_keys = (
-            np.zeros((self.max_batch, self.spec_k + 1, 2), np.uint32)
-            if self.spec_k else np.zeros((self.max_batch, 2), np.uint32))
+        # feedback plumbing: the carry chains device-side step to step in
+        # the async engine; the sync engine pins the all-zero constants
+        # (no per-step upload, the in-jit where() degenerates to identity)
+        self._no_feedback = jnp.zeros((self.token_budget,), jnp.int32)
+        self._zero_prev = jnp.zeros((self.max_batch,), jnp.int32)
+        self._carry = None       # device next_toks of the LAST dispatch
+        # per-lane base PRNG keys ([b, 2], content-cached upload: rows
+        # only change on admission) — the in-jit fold keys row j by
+        # tokens-produced (+ j under speculation)
+        self._lane_keys = np.zeros((self.max_batch, 2), np.uint32)
+        # slowly-changing host arrays -> cached device uploads
+        self._feed_cache: dict[str, tuple[np.ndarray, object]] = {}
+        # steady-decode pack cache (async): previous step's device arrays
+        # re-served while the schedule signature holds
+        self._steady: dict | None = None
+        self.steady_hits = 0
         self._base_keys: dict[int, np.ndarray] = {}   # req_id -> PRNGKey
+        # perf accounting (bench_serve step_gap_frac / host_ms_per_step):
+        # wall-clock intervals with NO dispatched-unmaterialized step are
+        # the host-observable upper bound on device idle between steps
+        self._span_start = None
+        self._last_event = None
+        self._idle_since = None
+        self._gap_time = 0.0
+        self._step_time = 0.0
+        self._sync_time = 0.0
+        self._perf_steps = 0
         # req_id -> DraftProposer (kept across preemption — the request's
         # context replays identically, so the table stays consistent)
         self._drafts: dict[int, object] = {}
@@ -304,6 +400,64 @@ class ServingPredictor:
             return 0.0
         return self.spec_accepted / self.spec_proposed
 
+    # -- perf accounting (the round-13 bench metrics) ----------------------
+
+    def _mark_dispatch(self) -> None:
+        """A step was dispatched: any interval since the pipeline last
+        drained was a host-side bubble the device could not fill."""
+        now = time.perf_counter()
+        if self._span_start is None:
+            self._span_start = now
+        if self._idle_since is not None:
+            self._gap_time += now - self._idle_since
+            self._idle_since = None
+        self._last_event = now
+
+    def _mark_drained(self) -> None:
+        """No dispatched-unmaterialized work remains: the device has
+        nothing of ours to run until the next dispatch."""
+        now = time.perf_counter()
+        self._idle_since = now
+        self._last_event = now
+
+    @property
+    def step_gap_frac(self) -> float:
+        """Fraction of the measured window with NO step in flight — the
+        host-observable upper bound on the device-idle gap between steps
+        (the sync engine's pack/bookkeeping bubble; ~0 for the async
+        engine, which always has the next step dispatched before it
+        materializes the previous one). Window starts at the first
+        dispatch after :meth:`reset_perf_stats`."""
+        if self._span_start is None or self._last_event is None:
+            return 0.0
+        span = self._last_event - self._span_start
+        if span <= 0:
+            return 0.0
+        return min(1.0, self._gap_time / span)
+
+    @property
+    def host_ms_per_step(self) -> float:
+        """Host milliseconds spent per ``step()`` OUTSIDE the blocking
+        device waits — the scheduling/bookkeeping cost the async engine
+        overlaps with device execution."""
+        if not self._perf_steps:
+            return 0.0
+        return max(0.0, (self._step_time - self._sync_time) * 1e3
+                   / self._perf_steps)
+
+    def reset_perf_stats(self) -> None:
+        """Start a fresh measurement window (bench: call after warmup)."""
+        self._span_start = None
+        self._last_event = None
+        self._idle_since = None if self._inflight else time.perf_counter()
+        if self._idle_since is not None:
+            self._span_start = self._idle_since
+            self._last_event = self._idle_since
+        self._gap_time = 0.0
+        self._step_time = 0.0
+        self._sync_time = 0.0
+        self._perf_steps = 0
+
     # -- shared scheduler internals ----------------------------------------
 
     def _preempt_youngest(self) -> bool:
@@ -343,7 +497,7 @@ class ServingPredictor:
             self.waiting.popleft()
             self._finish(req)
             return True
-        if len(req._context_ids()) > self.max_seq_len:
+        if req._ctx_len > self.max_seq_len:
             # preempted while sitting AT the length ceiling (its own
             # truncation check never ran that round): finish it as
             # truncated, same as the in-loop ceiling stop
@@ -362,7 +516,7 @@ class ServingPredictor:
             "page_size")
 
     def has_work(self) -> bool:
-        return bool(self.waiting or self.running)
+        return bool(self.waiting or self.running or self._inflight)
 
     # -- unified path ------------------------------------------------------
 
@@ -433,11 +587,179 @@ class ServingPredictor:
                    self.cache.draft_allowance(slot))
         return prop.propose(req._context_ids(), room) if room > 0 else []
 
+    @staticmethod
+    def _merge_produced(dst: dict, src: dict) -> None:
+        for rid, toks in src.items():
+            dst.setdefault(rid, []).extend(toks)
+
+    @staticmethod
+    def _landed_done(req: Request) -> bool:
+        """``done`` over MATERIALIZED tokens only — the emission drop
+        rule. Deliberately ignores pending counts (they are what is being
+        landed) and the truncation flag (a truncation decision at pack
+        N+1 must not discard the legitimate token step N produced —
+        matching the sync engine, where that token landed a step before
+        the truncation check ran)."""
+        if len(req.output_ids) >= req.max_new_tokens:
+            return True
+        return (req.eos_token_id is not None and req.output_ids
+                and req.output_ids[-1] == req.eos_token_id)
+
+    def _put_cached(self, name: str, arr: np.ndarray):
+        """Content-keyed device-upload cache for slowly-changing per-step
+        arrays (sampling params, per-lane base keys): a steady greedy
+        churn re-serves the same device array with zero H2D traffic."""
+        import jax
+
+        hit = self._feed_cache.get(name)
+        if hit is not None and np.array_equal(hit[0], arr):
+            return hit[1]
+        host = arr.copy()   # private: the caller's buffer may mutate
+        dev = jax.device_put(host)
+        self._feed_cache[name] = (host, dev)
+        return dev
+
+    def flush(self) -> dict[int, list[int]]:
+        """Materialize every in-flight step (the async engine's OUTPUT
+        FLUSH — a hard sync boundary). Returns the landed tokens merged
+        in emission order; no-op for the sync engine / legacy path."""
+        t0 = time.perf_counter()
+        self._did_sync = False
+        try:
+            return self._reconcile_all()
+        finally:
+            if self._did_sync:
+                self.hard_syncs += 1
+            self._step_time += time.perf_counter() - t0
+
+    def _reconcile_all(self) -> dict[int, list[int]]:
+        produced: dict[int, list[int]] = {}
+        while self._inflight:
+            self._merge_produced(produced, self._reconcile_one())
+        return produced
+
+    def _reconcile_one(self) -> dict[int, list[int]]:
+        """Land the OLDEST in-flight step's deferred results: materialize
+        its emission outputs (the hard sync), append tokens / TTFT /
+        metrics, and settle the value-dependent cache accounting
+        (speculative advance + rollback). Count-based accounting (page
+        growth, plain advance, prefix registration) already ran at pack
+        time — this is the reconcile-behind half of the contract."""
+        e = self._inflight.popleft()
+        cache = self.cache
+        out = ne = None
+        if e.completing:
+            t0 = time.perf_counter()
+            out = np.asarray(e.out)
+            if e.spec:
+                ne = np.asarray(e.ne)
+            self._sync_time += time.perf_counter() - t0
+            self._did_sync = True
+        if not self._inflight:
+            self._mark_drained()
+        for slot in e.spec_slots:
+            # speculative lane: the context token + accepted drafts are
+            # the valid K/V; rejected drafts' over-allocated pages roll
+            # back to the pool (refcounts/free lists end identical to a
+            # never-speculated run)
+            cache.advance(slot, int(ne[slot]))
+            cache.trim_pages(slot)
+        produced: dict[int, list[int]] = {}
+        for slot, req, k_i, was_decode in e.completing:
+            if e.spec:
+                m = int(ne[slot]) if k_i else 1
+                toks = [int(x) for x in out[slot, :m]]
+            else:
+                toks = [int(out[slot])]
+            emitted = 0
+            for tok in toks:
+                if self._landed_done(req):
+                    break   # budget/eos hit mid-batch: drop the overhang
+                req.output_ids.append(tok)
+                emitted += 1
+                if req.first_token_time is None:
+                    req.first_token_time = time.perf_counter()
+                produced.setdefault(req.req_id, []).append(tok)
+            if not e.spec:
+                # the pack charged ONE pending token per completing
+                # plain lane; it just landed (or dropped as overhang)
+                req._pending_n = max(0, req._pending_n - 1)
+            self.tokens_emitted += emitted
+            if self.spec_k and was_decode:
+                acc = int(ne[slot]) - 1 if k_i else 0
+                self.spec_lane_steps += 1
+                self.spec_emitted += emitted
+                self.spec_proposed += k_i
+                self.spec_accepted += acc
+                prop = self._drafts.get(req.req_id)
+                if prop is not None:
+                    prop.update(k_i, acc)
+        return produced
+
     def _step_unified(self) -> dict[int, list[int]]:
+        produced: dict[int, list[int]] = {}
+        # value barrier: admission replays a preempted request's context
+        # (token VALUES), so a waiting request with pending tokens forces
+        # a full reconcile before the admission pass
+        if self._inflight and any(r._pending_n for r in self.waiting):
+            self._merge_produced(produced, self._reconcile_all())
         self._retire_finished()
         self._admit_waiting_unified()
         if not self.running:
-            return {}
+            self._merge_produced(produced, self._reconcile_all())
+            return produced
+        entry = self._pack_dispatch()
+        if entry is None:
+            self._merge_produced(produced, self._reconcile_all())
+            return produced
+        self._inflight.append(entry)
+        self.steps += 1
+        if not self.async_engine or self.spec_k:
+            # sync engine — and the speculative build, whose drafts and
+            # n_emit page accounting are host-value-dependent: pipeline
+            # depth zero, reconcile the step just dispatched
+            self._merge_produced(produced, self._reconcile_all())
+        else:
+            # the double-buffer contract: reconcile BEHIND-BY-ONE while
+            # an emission boundary (a step whose tokens could finish a
+            # request) is in the ring; steps that cannot complete
+            # anything defer — up to max_inflight_steps — and drain in
+            # one batched materialization later (the general
+            # no-completion-possible fast path)
+            while self._inflight and (
+                    len(self._inflight) > self.max_inflight_steps
+                    or (len(self._inflight) > 1
+                        and any(p.must_sync
+                                for p in list(self._inflight)[:-1]))):
+                self._merge_produced(produced, self._reconcile_one())
+        self._register_prefixes()
+        return produced
+
+    def _register_prefixes(self) -> None:
+        """Register prompt prefills in the prefix cache PROGRESSIVELY —
+        full pages as their chunks land (a request arriving one step
+        later already hits them), the partial tail once the whole prompt
+        is in (its K/V writes have been issued to the device pool).
+        Prompt-progress only (token counts + prompt values the host owns)
+        — runs after the step's cache accounting settles."""
+        cache = self.cache
+        for slot, req in self.running.items():
+            if req._registered:
+                continue
+            plen = len(req.prompt_ids)
+            written = min(cache.seq_len(slot), plen)
+            if written >= plen:
+                cache.register_prefix(slot, req.prompt_ids)
+                req._registered = True
+            elif written >= cache.page_size:
+                cache.register_prefix(slot, req.prompt_ids[:written],
+                                      include_tail=False)
+
+    def _pack_dispatch(self) -> _Pending | None:
+        """Pack the token budget, run capacity/CoW, build the step arrays
+        and DISPATCH the unified step — everything that only needs token
+        COUNTS. Returns the in-flight entry (None when nothing was
+        scheduled). Does not materialize any device value."""
         cache = self.cache
         # -- token-budget packing: decode lanes first, then prefill chunks
         budget = self.token_budget
@@ -447,7 +769,7 @@ class ServingPredictor:
         prefill_slots = []
         for slot in sorted(self.running):
             req = self.running[slot]
-            remaining = len(req._context_ids()) - cache.seq_len(slot)
+            remaining = req._ctx_len - cache.seq_len(slot)
             (decode_slots if remaining == 1 else prefill_slots).append(slot)
         for idx, slot in enumerate(decode_slots):
             if budget <= 0:
@@ -470,7 +792,7 @@ class ServingPredictor:
             if budget <= 0:
                 break
             req = self.running[slot]
-            remaining = len(req._context_ids()) - cache.seq_len(slot)
+            remaining = req._ctx_len - cache.seq_len(slot)
             n = min(self.chunk, remaining, budget)
             if n > 0:
                 sched[slot] = n
@@ -550,153 +872,186 @@ class ServingPredictor:
         # a preemption may have freed slots mid-loop; drop stale schedule
         sched = {s: n for s, n in sched.items() if s in self.running}
         if not sched:
-            return {}
-        cow_src = np.full((self.max_batch,), self.cache.num_pages, np.int32)
-        cow_dst = cow_src.copy()
-        for slot, (src, dst) in cows.items():
-            if slot in sched:
-                cow_src[slot], cow_dst[slot] = src, dst
-        # -- build the fixed-shape packed step arrays --------------------
-        b, t = self.max_batch, self.token_budget
-        tok_ids = np.zeros((t,), np.int32)
-        tok_slot = np.full((t,), -1, np.int32)
-        tok_pos = np.zeros((t,), np.int32)
-        last_idx = np.full((b,), t, np.int32)   # idle-lane sentinel
-        spec_len = np.zeros((b,), np.int32)
-        q_lens = np.zeros((b,), np.int32)
-        temp = np.zeros((b,), np.float32)
-        top_k = np.zeros((b,), np.int32)
-        top_p = np.ones((b,), np.float32)
-        keys = self._zero_keys
-        completing = []
-        sample_lanes = []   # (slot, base key, tokens produced)
-        w = 0
-        for slot in sorted(sched):
-            n = sched[slot]
-            req = self.running[slot]
-            written = cache.seq_len(slot)
-            ctx = req._context_ids()
-            d = drafts.get(slot, [])
-            # a speculating decode lane feeds its last context token then
-            # its draft tokens at the following positions; everyone else
-            # feeds the next n context tokens (decode or prefill chunk)
-            tok_ids[w:w + n] = (([ctx[written]] + d) if d
-                                else ctx[written:written + n])
-            tok_slot[w:w + n] = slot
-            tok_pos[w:w + n] = np.arange(written, written + n)
-            # the row whose logits decide the lane's next token: the
-            # FIRST verify row when speculating, else the last fed row
-            last_idx[slot] = w + n - 1 - len(d)
-            spec_len[slot] = len(d)
-            q_lens[slot] = n
-            w += n
-            if written + n - len(d) == len(ctx):
-                completing.append(slot)
-                temp[slot] = req.temperature
-                top_k[slot] = req.top_k
-                top_p[slot] = req.top_p
-                if req.temperature > 0:
-                    sample_lanes.append((slot, self._req_key(req),
-                                         len(req.output_ids)))
-        if sample_lanes:
-            # ONE vectorized fold for every sampling lane (and, under
-            # speculation, every verify row): per-row scalar fold_in
-            # dispatches would put O(lanes * k) host round-trips on the
-            # per-step latency path. Row j of a lane folds tokens-
-            # produced + j — bit-identical to the scalar folds (vmapped
-            # threefry), so the per-request streams are unchanged.
-            import jax
+            return None
+        import jax
 
-            keys = self._zero_keys.copy()
-            k1 = self.spec_k + 1 if self.spec_k else 1
-            bases = np.repeat(np.stack([b for _, b, _ in sample_lanes]),
-                              k1, axis=0)
-            offs = np.concatenate(
-                [np.arange(p, p + k1) for _, _, p in sample_lanes])
-            folded = np.asarray(
-                jax.vmap(jax.random.fold_in)(jnp.asarray(bases),
-                                             jnp.asarray(offs)), np.uint32)
-            for i, (slot, _, _) in enumerate(sample_lanes):
-                keys[slot] = (folded[i * k1:(i + 1) * k1] if self.spec_k
-                              else folded[i])
-        head = (self.params, jnp.asarray(tok_ids), jnp.asarray(tok_slot),
-                jnp.asarray(tok_pos), jnp.asarray(q_lens),
-                cache.seq_lens_device(), jnp.asarray(last_idx))
+        b, t = self.max_batch, self.token_budget
+        spec_len = np.zeros((b,), np.int32)
+        # -- steady-decode fast path (async only) ------------------------
+        # when EVERY scheduled lane is a feedback decode lane (its input
+        # token rides the device carry) and the schedule matches the
+        # previous step's, the packed arrays are CONTENT-FREE on the host
+        # side: tok_ids is overridden by feedback, and tok_slot / q_lens /
+        # last_idx / emit_mask / feedback are unchanged — so the host
+        # re-serves the previous step's device arrays and uploads only
+        # the advancing positions (+ produced counts for the in-jit key
+        # folds). The sync engine can never take this path: it must ship
+        # the token VALUES every step.
+        steady_sig = None
+        if (self.async_engine and not drafts and not cows
+                and all(n == 1 for n in sched.values())
+                and all(self.running[s]._pending_n > 0 for s in sched)):
+            steady_sig = tuple((s, self.running[s].req_id)
+                               for s in sorted(sched))
+        st = self._steady
+        if steady_sig is not None and st is not None \
+                and st["sig"] == steady_sig:
+            self.steady_hits += 1
+            completing = st["completing"]
+            tok_pos = np.zeros((t,), np.int32)
+            produced_n = np.zeros((b,), np.int32)
+            for w_i, (slot, req, _, _) in enumerate(completing):
+                tok_pos[w_i] = cache.seq_len(slot)
+                produced_n[slot] = len(req.output_ids) + req._pending_n
+            d_pos, d_prod = jax.device_put((tok_pos, produced_n))
+            d_ids, d_slot, d_qlens, d_last, d_fb, d_emit = (
+                st["d_ids"], st["d_slot"], st["d_qlens"], st["d_last"],
+                st["d_fb"], st["d_emit"])
+            d_spec = None
+            d_cow_src = d_cow_dst = self._no_cow
+            temp, top_k, top_p = st["temp"], st["top_k"], st["top_p"]
+        else:
+            cow_src = np.full((b,), self.cache.num_pages, np.int32)
+            cow_dst = cow_src.copy()
+            live_cows = False
+            for slot, (src, dst) in cows.items():
+                if slot in sched:
+                    cow_src[slot], cow_dst[slot] = src, dst
+                    live_cows = True
+            # -- build the fixed-shape packed step arrays ----------------
+            tok_ids = np.zeros((t,), np.int32)
+            tok_slot = np.full((t,), -1, np.int32)
+            tok_pos = np.zeros((t,), np.int32)
+            feedback = np.zeros((t,), np.int32)
+            last_idx = np.full((b,), t, np.int32)   # idle-lane sentinel
+            q_lens = np.zeros((b,), np.int32)
+            emit_mask = np.zeros((b,), np.int32)
+            produced_n = np.zeros((b,), np.int32)
+            temp = np.zeros((b,), np.float32)
+            top_k = np.zeros((b,), np.int32)
+            top_p = np.ones((b,), np.float32)
+            decode_set = set(decode_slots)
+            completing = []   # (slot, req, k_i, was_decode)
+            w = 0
+            for slot in sorted(sched):
+                n = sched[slot]
+                req = self.running[slot]
+                written = cache.seq_len(slot)
+                ctx = req._context_ids()
+                d = drafts.get(slot, [])
+                # a speculating decode lane feeds its last context token
+                # then its draft tokens at the following positions;
+                # everyone else feeds the next n context tokens (decode
+                # or prefill chunk). A decode lane whose input token is
+                # still IN FLIGHT (async deferral) reads it from the
+                # device-side carry instead — the host never
+                # materialized it.
+                if d:
+                    tok_ids[w:w + n] = [ctx[written]] + d
+                elif req._pending_n:
+                    # pending > 0 only ever holds for pure decode lanes
+                    # (prefill/replay contexts are value-barriered), and
+                    # only the final context token can be pending —
+                    # exactly the one token this lane feeds
+                    feedback[w] = 1
+                else:
+                    tok_ids[w:w + n] = ctx[written:written + n]
+                tok_slot[w:w + n] = slot
+                tok_pos[w:w + n] = np.arange(written, written + n)
+                # the row whose logits decide the lane's next token: the
+                # FIRST verify row when speculating, else the last fed
+                last_idx[slot] = w + n - 1 - len(d)
+                spec_len[slot] = len(d)
+                q_lens[slot] = n
+                w += n
+                if written + n - len(d) == req._ctx_len:
+                    emit_mask[slot] = 1
+                    produced_n[slot] = (len(req.output_ids)
+                                        + req._pending_n)
+                    temp[slot] = req.temperature
+                    top_k[slot] = req.top_k
+                    top_p[slot] = req.top_p
+                    if req.temperature > 0:
+                        self._lane_keys[slot] = self._req_key(req)
+                    completing.append((slot, req, len(d),
+                                       slot in decode_set))
+            # -- batched upload -----------------------------------------
+            # ONE device_put for the per-step volatile arrays (replacing
+            # ~10 separate jnp.asarray transfers on the latency path);
+            # sampling params and base keys ride the content-keyed cache,
+            # the CoW sentinel and feedback constants never re-upload
+            volatile = [tok_ids, tok_slot, tok_pos, q_lens, last_idx,
+                        feedback, emit_mask, produced_n]
+            if self.spec_k:
+                volatile.append(spec_len)
+            if live_cows:
+                volatile += [cow_src, cow_dst]
+            dev = jax.device_put(tuple(volatile))
+            (d_ids, d_slot, d_pos, d_qlens, d_last, d_fb, d_emit,
+             d_prod) = dev[:8]
+            rest = list(dev[8:])
+            d_spec = rest.pop(0) if self.spec_k else None
+            d_cow_src, d_cow_dst = ((rest[0], rest[1]) if live_cows
+                                    else (self._no_cow, self._no_cow))
+            # prime the steady-decode cache for the next step
+            self._steady = (dict(sig=steady_sig, completing=completing,
+                                 d_ids=d_ids, d_slot=d_slot,
+                                 d_qlens=d_qlens, d_last=d_last,
+                                 d_fb=d_fb, d_emit=d_emit, temp=temp,
+                                 top_k=top_k, top_p=top_p)
+                            if steady_sig is not None else None)
+        # could any of this step's emissions FINISH a request? (the async
+        # engine's sync-boundary predicate: eos configured, or the output
+        # budget reachable by this emission) — recomputed on the steady
+        # path too: the output budget closes in as pending grows
+        must_sync = any(
+            req.eos_token_id is not None
+            or len(req.output_ids) + req._pending_n + 1
+            >= req.max_new_tokens
+            for _, req, _, _ in completing)
+        if not self.spec_k:
+            for _, req, _, _ in completing:
+                req._pending_n += 1
+        prev = (self._carry
+                if (self.async_engine and self._carry is not None)
+                else self._zero_prev)
+        head = (self.params, d_ids, d_slot, d_pos, d_qlens,
+                cache.seq_lens_device(), d_last)
         if self.spec_k:
-            head = head + (jnp.asarray(spec_len),)
-        tail = (cache.page_table_device(), jnp.asarray(cow_src),
-                jnp.asarray(cow_dst), jnp.asarray(keys), jnp.asarray(temp),
-                jnp.asarray(top_k), jnp.asarray(top_p))
+            head = head + (d_spec,)
+        head = head + (d_fb, prev, d_emit, d_prod)
+        tail = (cache.page_table_device(), d_cow_src, d_cow_dst,
+                self._put_cached("keys", self._lane_keys),
+                self._put_cached("temp", temp),
+                self._put_cached("top_k", top_k),
+                self._put_cached("top_p", top_p))
         pools = ((cache.k_pages, cache.v_pages, cache.k_scales,
                   cache.v_scales) if self.kv_quant
                  else (cache.k_pages, cache.v_pages))
         res = self._unified(*head, *pools, *tail)
+        self._mark_dispatch()
         if self.spec_k:
-            # a speculating lane always completes, so a prefill-only
-            # round (completing empty) can skip the host sync entirely —
-            # same latency contract as the plain build
-            out = np.asarray(res[0]) if completing else None
-            ne = np.asarray(res[1]) if completing else None
-            cache.update_pages(*res[3:])
+            out_dev, ne_dev, carry = res[0], res[1], res[2]
+            cache.update_pages(*res[4:])
         else:
-            out, ne = (np.asarray(res[0]) if completing else None), None
+            out_dev, ne_dev, carry = res[0], None, res[0]
             cache.update_pages(*res[2:])
-        self.steps += 1
-        decode_set = set(decode_slots)
+        self._carry = carry
+        # count-based cache accounting at pack time: plain lanes advance
+        # by what they fed; speculative lanes advance at reconcile (their
+        # watermark is n_emit, a device value)
         for slot, n in sched.items():
-            if spec_len[slot]:
-                # speculative lane: the context token + accepted drafts
-                # are the valid K/V; rejected drafts' over-allocated
-                # pages roll back to the pool (refcounts/free lists end
-                # identical to a never-speculated run)
-                cache.advance(slot, int(ne[slot]))
-                cache.trim_pages(slot)
-            else:
+            if not spec_len[slot]:
                 cache.advance(slot, n)
-        produced: dict[int, list[int]] = {}
-        for slot in completing:
-            req = self.running[slot]
-            if self.spec_k:
-                m = int(ne[slot]) if spec_len[slot] else 1
-                toks = [int(x) for x in out[slot, :m]]
-            else:
-                toks = [int(out[slot])]
-            emitted = 0
-            for tok in toks:
-                if req.done:
-                    break   # budget/eos hit mid-batch: drop the overhang
-                req.output_ids.append(tok)
-                emitted += 1
-                if req.first_token_time is None:
-                    req.first_token_time = time.perf_counter()
-                produced.setdefault(req.req_id, []).append(tok)
-            self.tokens_emitted += emitted
-            if self.spec_k and slot in decode_set:
-                k_i = int(spec_len[slot])
-                acc = int(ne[slot]) - 1 if k_i else 0
-                self.spec_lane_steps += 1
-                self.spec_emitted += emitted
-                self.spec_proposed += k_i
-                self.spec_accepted += acc
-                prop = self._drafts.get(req.req_id)
-                if prop is not None:
-                    prop.update(k_i, acc)
-        # register prompt prefills in the prefix cache PROGRESSIVELY —
-        # full pages as their chunks land (a request arriving one step
-        # later already hits them), the partial tail once the whole prompt
-        # is in (its K/V writes have been issued to the device pool)
-        for slot, req in self.running.items():
-            if req._registered:
-                continue
-            plen = len(req.prompt_ids)
-            written = min(cache.seq_len(slot), plen)
-            if written >= plen:
-                cache.register_prefix(slot, req.prompt_ids)
-                req._registered = True
-            elif written >= cache.page_size:
-                cache.register_prefix(slot, req.prompt_ids[:written],
-                                      include_tail=False)
-        return produced
+        spec_slots = [s for s in sched if spec_len[s]]
+        # a speculating lane always completes, so a prefill-only round
+        # (completing empty) carries nothing to materialize — the entry
+        # still occupies the ring so the gap accounting knows the device
+        # has work
+        return _Pending(out_dev if completing else None,
+                        ne_dev if (completing and self.spec_k) else None,
+                        completing, bool(self.spec_k), spec_slots,
+                        must_sync)
 
     # -- legacy (round-7 two-jit) path -------------------------------------
 
@@ -815,9 +1170,14 @@ class ServingPredictor:
             self.params, ids, self.cache.seq_lens_device(),
             self.cache.k_pages, self.cache.v_pages,
             self.cache.page_table_device())
+        self._mark_dispatch()
         self.cache.update_pages(kp, vp)
         self.steps += 1
+        t_sync = time.perf_counter()
         out = np.asarray(next_ids)
+        self._sync_time += time.perf_counter() - t_sync
+        self._did_sync = True
+        self._mark_drained()
         produced = {}
         for slot, req in self.running.items():
             tok = int(out[slot])
@@ -837,10 +1197,23 @@ class ServingPredictor:
         tokens produced this step, in emission order — a speculative
         decode lane can emit several (accepted drafts + bonus) in one
         round; a unified round that only advanced prefill chunks
-        produces none."""
-        if self.unified:
-            return self._step_unified()
-        return self._step_legacy()
+        produces none. The async engine returns the tokens RECONCILED by
+        this call (one step behind the dispatch; drain with
+        :meth:`flush`)."""
+        t0 = time.perf_counter()
+        self._did_sync = False
+        try:
+            if self.unified:
+                return self._step_unified()
+            return self._step_legacy()
+        finally:
+            if self._did_sync:
+                # ONE hard sync per step()/flush() call no matter how
+                # many ring entries it landed: a drain materializes the
+                # oldest (blocking) and the rest are already resident
+                self.hard_syncs += 1
+            self._step_time += time.perf_counter() - t0
+            self._perf_steps += 1
 
     # -- convenience -------------------------------------------------------
 
@@ -870,6 +1243,9 @@ class ServingPredictor:
             if n > limit:
                 raise RuntimeError("serving loop exceeded step budget "
                                    f"({limit}) — scheduler stuck")
+        # a request can finish by COUNT with its final tokens still in
+        # flight (async deferral): drain before reading the outputs
+        self.flush()
         return [list(r.output_ids) for r in reqs]
 
 

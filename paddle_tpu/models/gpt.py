@@ -882,10 +882,12 @@ def build_unified_step(config: GPTConfig, page_size: int, chunk: int,
     Signature::
 
         fn(params, tok_ids[t], tok_slot[t], tok_pos[t],
-           q_lens[b], kv_lens[b], last_idx[b], k_pages, v_pages,
-           page_table[b,pps], cow_src[b], cow_dst[b], keys[b,2],
+           q_lens[b], kv_lens[b], last_idx[b],
+           feedback[t], prev_toks[b], emit_mask[b], produced[b],
+           k_pages, v_pages,
+           page_table[b,pps], cow_src[b], cow_dst[b], base_keys[b,2],
            temperature[b], top_k[b], top_p[b])
-        -> (next_ids[b], logits[b,v], k_pages, v_pages)
+        -> (next_toks[b], logits[b,v], k_pages, v_pages)
 
     ``tok_slot < 0`` marks padding tokens (their writes drop, their rows
     compute garbage nothing reads). ``kv_lens`` counts tokens already
@@ -901,6 +903,24 @@ def build_unified_step(config: GPTConfig, page_size: int, chunk: int,
     Every array argument keeps its shape step over step: one trace, one
     executable (``fn.trace_count[0]`` is the gate).
 
+    DEVICE-RESIDENT FEEDBACK (round 13, the async engine's enabler):
+    ``feedback[t]`` marks packed tokens whose id the HOST DOES NOT KNOW
+    YET — the step reads them from ``prev_toks[tok_slot]`` instead of
+    ``tok_ids``, where ``prev_toks`` is the previous step's ``next_toks``
+    output passed back UNMATERIALIZED. ``next_toks`` is a per-lane CARRY:
+    lanes with ``emit_mask[b] != 0`` (the scheduler's completing lanes)
+    update it to the token decided this step, everyone else passes
+    ``prev_toks`` through — so a lane that skips a step (budget) still
+    feeds its latest token next time. The synchronous engine passes
+    all-zero ``feedback``/``prev_toks`` and the step degenerates to the
+    round-9 behavior bit-for-bit. Sample keys moved ON-DEVICE with the
+    same round: the host sends each lane's BASE PRNG key (``base_keys``,
+    constant per request) + its tokens-produced count (``produced``) and
+    the sampling branch folds them in-jit (vmapped threefry — bit-
+    identical to the host-side ``fold_in`` it replaces), so a sampling
+    step uploads two tiny arrays instead of deriving per-token keys on
+    the host latency path.
+
     ``kv_quant=True`` (round 10) stores the page pools int8: the signature
     gains ``k_scales``/``v_scales`` (the per-(page-slot, head) fp32 scale
     planes, donated alongside the pools and returned updated), K/V
@@ -910,9 +930,10 @@ def build_unified_step(config: GPTConfig, page_size: int, chunk: int,
     too) and prefix caching (a shared page's scales travel with it)::
 
         fn(params, tok_ids, tok_slot, tok_pos, q_lens, kv_lens, last_idx,
+           feedback, prev_toks, emit_mask, produced,
            k_pages, v_pages, k_scales, v_scales, page_table, cow_src,
-           cow_dst, keys, temperature, top_k, top_p)
-        -> (next_ids, logits, k_pages, v_pages, k_scales, v_scales)
+           cow_dst, base_keys, temperature, top_k, top_p)
+        -> (next_toks, logits, k_pages, v_pages, k_scales, v_scales)
 
     ``mesh`` (round 11) shards the whole step over ``Mesh(("mp",))`` via
     ``shard_map``: params per :func:`serving_param_specs` (qkv head-major
@@ -931,17 +952,21 @@ def build_unified_step(config: GPTConfig, page_size: int, chunk: int,
     (per-row causal limits make row i attend the just-written K/V of rows
     < i). The signature gains ``spec_len[b]`` after ``last_idx`` (0 = the
     lane speculates nothing this step — adaptive k varies VALUES, never
-    the shape), ``last_idx`` becomes the lane's FIRST verify row (for a
-    plain/prefill lane that is its last packed row, unchanged meaning),
-    and ``keys`` widens to ``[b, spec_k+1, 2]`` (row j of a lane samples
-    token #produced+j of its stream — the per-request seeded streams stay
-    bit-identical to plain decode). The fused accept epilogue computes
-    logits at rows ``last_idx .. last_idx+spec_k``, samples each (greedy
-    argmax on temperature-0 lanes, bit-identical to the plain step), and
-    accepts drafts while ``draft[i] == sampled[i-1]`` — returning::
+    the shape) and ``last_idx`` becomes the lane's FIRST verify row (for
+    a plain/prefill lane that is its last packed row, unchanged meaning).
+    ``base_keys`` stays ``[b, 2]``: verify row j folds ``produced + j``
+    in-jit, so the per-request seeded streams stay bit-identical to
+    plain decode. The fused accept epilogue computes logits at rows
+    ``last_idx .. last_idx+spec_k``, samples each (greedy argmax on
+    temperature-0 lanes, bit-identical to the plain step), and accepts
+    drafts while ``draft[i] == sampled[i-1]`` — returning::
 
-        -> (out_ids[b, spec_k+1], n_emit[b], logits[b,v], k_pages,
-            v_pages[, k_scales, v_scales])
+        -> (out_ids[b, spec_k+1], n_emit[b], next_toks[b], logits[b,v],
+            k_pages, v_pages[, k_scales, v_scales])
+
+    where ``next_toks`` is the same per-lane carry as the plain build
+    (an emitting lane carries its LAST emitted token,
+    ``out_ids[b, n_emit-1]``).
 
     where each lane's first ``n_emit`` tokens of ``out_ids`` are its
     emissions this step (accepted prefix + one bonus token; always >= 1
@@ -965,23 +990,27 @@ def build_unified_step(config: GPTConfig, page_size: int, chunk: int,
     nh_l, hd = cfg.num_heads // mp, cfg.head_dim
 
     # argument layout (shared by the wrappers, shard_map specs and the
-    # donation indices): params + 6 packed/lane arrays [+ spec_len], then
-    # the donated pools [+ scale planes], then the 7-array tail
-    n_lead = 8 if spec_k else 7
+    # donation indices): params + 6 packed/lane arrays [+ spec_len] + the
+    # 4 feedback arrays (feedback mask, prev_toks carry, emit_mask,
+    # produced), then the donated pools [+ scale planes], then the
+    # 7-array tail
+    n_lead = 12 if spec_k else 11
     n_pool = 4 if kv_quant else 2
-    n_out_lead = 3 if spec_k else 2
+    n_out_lead = 4 if spec_k else 2
 
     def _body(*args):
         lead = args[:n_lead]
         pools = args[n_lead:n_lead + n_pool]
-        (page_table, cow_src, cow_dst, keys, temperature, top_k,
+        (page_table, cow_src, cow_dst, base_keys, temperature, top_k,
          top_p) = args[n_lead + n_pool:]
         spec_len = lead[7] if spec_k else None
+        feedback, prev_toks, emit_mask, produced = lead[n_lead - 4:]
         k_scales, v_scales = (pools[2], pools[3]) if kv_quant else (None,
                                                                     None)
-        return _step_inner(*lead[:7], spec_len, pools[0], pools[1],
+        return _step_inner(*lead[:7], spec_len, feedback, prev_toks,
+                           emit_mask, produced, pools[0], pools[1],
                            k_scales, v_scales, page_table, cow_src,
-                           cow_dst, keys, temperature, top_k, top_p)
+                           cow_dst, base_keys, temperature, top_k, top_p)
 
     def step(*args):
         trace_count[0] += 1
@@ -1004,9 +1033,10 @@ def build_unified_step(config: GPTConfig, page_size: int, chunk: int,
             return body(*args)
 
     def _step_inner(params, tok_ids, tok_slot, tok_pos, q_lens, kv_lens,
-                    last_idx, spec_len, k_pages, v_pages, k_scales,
-                    v_scales, page_table, cow_src, cow_dst, keys,
-                    temperature, top_k, top_p):
+                    last_idx, spec_len, feedback, prev_toks, emit_mask,
+                    produced, k_pages, v_pages, k_scales, v_scales,
+                    page_table, cow_src, cow_dst, base_keys, temperature,
+                    top_k, top_p):
         t = tok_ids.shape[0]
         b = q_lens.shape[0]
         # copy-on-write BEFORE any write: diverging lanes get a private
@@ -1017,14 +1047,19 @@ def build_unified_step(config: GPTConfig, page_size: int, chunk: int,
         if kv_quant:
             k_scales = paged_copy_pages(k_scales, cow_src, cow_dst)
             v_scales = paged_copy_pages(v_scales, cow_src, cow_dst)
+        valid = tok_slot >= 0
+        slot_c = jnp.clip(tok_slot, 0, b - 1)
+        # device-resident feedback: tokens the host scheduled before
+        # materializing their value read the previous step's carry —
+        # the async engine's device-side half of the pipeline
+        tok_ids = jnp.where((feedback > 0) & valid, prev_toks[slot_c],
+                            tok_ids)
         x = (jnp.take(params["tok_emb"], jnp.maximum(tok_ids, 0), axis=0)
              + params["pos_emb"][
                  jnp.clip(tok_pos, 0, params["pos_emb"].shape[0] - 1)])
         ctx = (kv_lens + q_lens).astype(jnp.int32)
         # packed <-> chunk-block index plumbing (shared by every layer):
         # each token's row in the attention kernel's [b, chunk] blocks
-        valid = tok_slot >= 0
-        slot_c = jnp.clip(tok_slot, 0, b - 1)
         off = tok_pos - kv_lens[slot_c]              # position in chunk
         off_c = jnp.clip(off, 0, chunk - 1)
         scatter_b = jnp.where(valid, tok_slot, b)    # b = dropped row
@@ -1083,9 +1118,15 @@ def build_unified_step(config: GPTConfig, page_size: int, chunk: int,
             v = logits_rows.shape[-1]
 
             def _samp():
-                # row j of a lane samples with its own key (the host keys
-                # it by tokens-produced + j, so the per-request stream is
-                # bit-identical to plain seeded decode)
+                # row j of a lane samples with the base key folded by
+                # tokens-produced + j — the on-device spelling of the
+                # former host-side fold_in (vmapped threefry, bit-
+                # identical), so the per-request stream matches plain
+                # seeded decode
+                keys = jax.vmap(
+                    lambda bk, p: jax.vmap(jax.random.fold_in,
+                                           in_axes=(None, 0))(
+                        bk, p + jnp.arange(k1)))(base_keys, produced)
                 rep = lambda a: jnp.repeat(a, k1)  # noqa: E731
                 return _sample_epilogue(
                     logits_rows.reshape(b * k1, v),
@@ -1101,29 +1142,41 @@ def build_unified_step(config: GPTConfig, page_size: int, chunk: int,
             drafts = tok_ids[jnp.clip(rows[:, 1:], 0, t - 1)]   # [b, k]
             ok = ((drafts == out_ids[:, :spec_k])
                   & (jnp.arange(spec_k)[None] < spec_len[:, None]))
-            n_emit = 1 + jnp.cumprod(ok.astype(jnp.int32), axis=1).sum(1)
+            n_emit = (1 + jnp.cumprod(ok.astype(jnp.int32), axis=1).sum(1)
+                      ).astype(jnp.int32)
+            # per-lane carry: an emitting lane's LAST emitted token
+            last_emit = jnp.take_along_axis(
+                out_ids, jnp.maximum(n_emit - 1, 0)[:, None], axis=1)[:, 0]
+            next_toks = jnp.where(emit_mask > 0, last_emit, prev_toks)
             if kv_quant:
-                return (out_ids, n_emit.astype(jnp.int32),
-                        logits_rows[:, 0], k_pages, v_pages, k_scales,
-                        v_scales)
-            return (out_ids, n_emit.astype(jnp.int32), logits_rows[:, 0],
+                return (out_ids, n_emit, next_toks, logits_rows[:, 0],
+                        k_pages, v_pages, k_scales, v_scales)
+            return (out_ids, n_emit, next_toks, logits_rows[:, 0],
                     k_pages, v_pages)
         # each slot's LAST packed token yields its next-token decision
         h_last = x[jnp.clip(last_idx, 0, t - 1)]                  # [b, h]
         logits = _srv_logits(params, h_last).astype(jnp.float32)
         greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        # the epilogue's [b, vocab] sort/softmax/cumsum only EXECUTES on
-        # steps where some lane actually samples — all-greedy steps (the
-        # flagship greedy serving loop) pay just the argmax + predicate
-        sampled = jax.lax.cond(
-            jnp.any(temperature > 0.0),
-            lambda: _sample_epilogue(logits, keys, temperature, top_k,
-                                     top_p),
-            lambda: greedy)
+        # the epilogue's [b, vocab] sort/softmax/cumsum (and the key
+        # folds) only EXECUTE on steps where some lane actually samples —
+        # all-greedy steps (the flagship greedy serving loop) pay just
+        # the argmax + predicate
+        def _samp():
+            keys = jax.vmap(jax.random.fold_in)(base_keys, produced)
+            return _sample_epilogue(logits, keys, temperature, top_k,
+                                    top_p)
+
+        sampled = jax.lax.cond(jnp.any(temperature > 0.0), _samp,
+                               lambda: greedy)
         next_ids = jnp.where(temperature > 0.0, sampled, greedy)
+        # per-lane carry: emitting lanes refresh, everyone else passes
+        # the previous token through (a lane skipped by the budget still
+        # feeds its latest token through feedback next step)
+        next_toks = jnp.where(emit_mask > 0, next_ids, prev_toks)
         if kv_quant:
-            return (next_ids, logits, k_pages, v_pages, k_scales, v_scales)
-        return next_ids, logits, k_pages, v_pages
+            return (next_toks, logits, k_pages, v_pages, k_scales,
+                    v_scales)
+        return next_toks, logits, k_pages, v_pages
 
     jitted = jax.jit(step,
                      donate_argnums=tuple(range(n_lead, n_lead + n_pool)))
@@ -1358,22 +1411,23 @@ def generate_paged(model, input_ids, max_new_tokens=20, *, page_size=None,
     # ServingPredictor). constant per-call sampling plumbing; generate
     # never shares pages, so copy-on-write stays on the no-op sentinel
     t_budget = b * chunk
-    k1 = spec_k + 1
     no_cow = jnp.full((b,), mgr.num_pages, jnp.int32)
     temp_arr = jnp.full((b,), float(temperature), jnp.float32)
     topk_arr = jnp.full((b,), int(top_k), jnp.int32)
     topp_arr = jnp.full((b,), float(top_p), jnp.float32)
-    zero_keys = (np.zeros((b, k1, 2), np.uint32) if spec_k
-                 else np.zeros((b, 2), np.uint32))
-    row_keys = None
+    # the synchronous convenience loop never defers emission: feedback
+    # stays all-zero and the carry input is a constant (no upload)
+    no_feedback = jnp.zeros((t_budget,), jnp.int32)
+    zero_prev = jnp.zeros((b,), jnp.int32)
+    base_keys = jnp.zeros((b, 2), jnp.uint32)
     if temperature > 0:
-        # one vectorized fold per call for the per-row base keys, and one
-        # per step for the per-token keys below (vmapped threefry is
-        # bit-identical to scalar fold_in) — never per-row dispatches
+        # one vectorized fold per CALL for the per-row base keys; the
+        # per-token keys fold IN-JIT from (base key, tokens produced) —
+        # vmapped threefry, bit-identical to the former host-side folds
         base_key = jax.random.PRNGKey(int(seed))
-        row_keys = np.asarray(jax.vmap(jax.random.fold_in,
-                                       in_axes=(None, 0))(
-            base_key, jnp.arange(b)), np.uint32)
+        base_keys = jnp.asarray(np.asarray(
+            jax.vmap(jax.random.fold_in, in_axes=(None, 0))(
+                base_key, jnp.arange(b)), np.uint32))
 
     outs: list[list[int]] = [[] for _ in range(b)]
     done = np.zeros((b,), bool)
@@ -1391,6 +1445,8 @@ def generate_paged(model, input_ids, max_new_tokens=20, *, page_size=None,
         tok_pos = np.zeros((t_budget,), np.int32)
         last_idx = np.full((b,), t_budget, np.int32)   # idle sentinel
         spec_len = np.zeros((b,), np.int32)
+        emit_mask = np.zeros((b,), np.int32)
+        produced = np.zeros((b,), np.int32)
         if spec_k:
             # pages every live row will claim for its PLAIN tokens this
             # round, charged against draft allowances (the serving-path
@@ -1437,33 +1493,31 @@ def generate_paged(model, input_ids, max_new_tokens=20, *, page_size=None,
             # row for a speculating lane, the last fed row otherwise
             last_idx[sl] = w + n - 1 - len(d)
             spec_len[sl] = len(d)
+            if written + n - len(d) == len(contexts[i]):
+                # this chunk reaches the context end: the lane emits.
+                # sampling row j folds (base key, produced + j) IN-JIT —
+                # keying by tokens PRODUCED (the ServingPredictor
+                # convention) makes the sampled stream identical across
+                # every spec k, including k = 0: speculation changes
+                # cost, never output
+                emit_mask[sl] = 1
+                produced[sl] = len(outs[i])
             w += n
-        if temperature > 0:
-            # row (i, j) samples token #produced+j of row i's stream —
-            # keying by tokens PRODUCED (the ServingPredictor convention)
-            # makes the sampled stream identical across every spec k,
-            # including k = 0: speculation changes cost, never output
-            offs = np.concatenate(
-                [np.arange(len(o), len(o) + k1) for o in outs])
-            keys = np.asarray(jax.vmap(jax.random.fold_in)(
-                jnp.asarray(np.repeat(row_keys, k1, axis=0)),
-                jnp.asarray(offs)), np.uint32)
-            keys = keys.reshape(b, k1, 2) if spec_k else keys
-        else:
-            keys = zero_keys
         packed = (params, jnp.asarray(tok_ids), jnp.asarray(tok_slot),
                   jnp.asarray(tok_pos), jnp.asarray(q_lens),
                   mgr.seq_lens_device(), jnp.asarray(last_idx))
         if spec_k:
             packed = packed + (jnp.asarray(spec_len),)
-        tail = (mgr.page_table_device(), no_cow, no_cow,
-                jnp.asarray(keys), temp_arr, topk_arr, topp_arr)
+        packed = packed + (no_feedback, zero_prev, jnp.asarray(emit_mask),
+                           jnp.asarray(produced))
+        tail = (mgr.page_table_device(), no_cow, no_cow, base_keys,
+                temp_arr, topk_arr, topp_arr)
         pools = ((mgr.k_pages, mgr.v_pages, mgr.k_scales, mgr.v_scales)
                  if kv_quant else (mgr.k_pages, mgr.v_pages))
         res = step(*packed, *pools, *tail)
         if spec_k:
             out_ids, n_emit = np.asarray(res[0]), np.asarray(res[1])
-            mgr.update_pages(*res[3:])
+            mgr.update_pages(*res[4:])
         else:
             out_ids, n_emit = np.asarray(res[0]), None
             mgr.update_pages(*res[2:])

@@ -694,6 +694,17 @@ class TestHazardRegressions:
 
         assert analyze_serving_spec() == []
 
+    def test_serving_async_step_is_clean_and_donates(self):
+        """The round-13 feedback-coupled unified step (a LIVE feedback
+        lane reading prev_toks + the on-device sample-key fold): jaxpr
+        walk and the JX005 donation audit at the feedback-shifted pool
+        positions come back with ZERO findings — a dispatch-ahead step
+        that stopped aliasing its pools would double-buffer the largest
+        serving allocation exactly when two steps are in flight."""
+        from paddle_tpu.analysis.targets import analyze_serving_async
+
+        assert analyze_serving_async() == []
+
 
 # ---------------------------------------------------------------------------
 # the gate: the repo itself, against the checked-in baseline

@@ -485,7 +485,7 @@ def test_bench_serve_smoke_schema():
         env={**os.environ, "JAX_PLATFORMS": "cpu"})
     assert proc.returncode == 0, proc.stderr[-2000:]
     lines = [l for l in proc.stdout.strip().splitlines() if l.strip()]
-    assert len(lines) == 7, proc.stdout
+    assert len(lines) == 8, proc.stdout
     for line in lines:
         rec = json.loads(line)
         assert "error" not in rec, rec
@@ -500,10 +500,11 @@ def test_bench_serve_smoke_schema():
         assert rec["mesh_shape"] == f"mp{rec['mesh_chips']}"
         assert rec["tokens_per_s_per_chip"] == pytest.approx(
             rec["value"] / rec["mesh_chips"], rel=0.01)
-    (legacy, unified, spmd, specb, speck, int8w,
+    (legacy, unified, uasync, spmd, specb, speck, int8w,
      int8kv) = (json.loads(l) for l in lines)
     assert "[legacy-two-jit]" in legacy["metric"]
     assert "[unified-step]" in unified["metric"]
+    assert "[unified-async]" in uasync["metric"]
     assert "[unified-spmd]" in spmd["metric"]
     assert "[unified-spec-base]" in specb["metric"]
     assert "[unified-spec-k4]" in speck["metric"]
@@ -513,8 +514,21 @@ def test_bench_serve_smoke_schema():
     # compiles >= 1 executable (now visible); the unified step has NO
     # prefill jit and exactly one executable for everything
     assert legacy["prefill_retraces"] >= 1
-    for rec in (unified, spmd, specb, speck, int8w, int8kv):
+    for rec in (unified, uasync, spmd, specb, speck, int8w, int8kv):
         assert rec["prefill_retraces"] == 0
+    # the round-13 sync-vs-async A/B, gated in the checked schema: the
+    # async engine must close the inter-step host bubble (strictly lower
+    # no-step-in-flight fraction), turn that into throughput (strictly
+    # higher decode tokens/s than the sync engine), and emit
+    # bit-identical greedy streams while doing it — all compared WITHIN
+    # the interleaved pair (the paired sync stats ride the async line)
+    assert uasync["step_gap_frac"] < uasync["sync_step_gap_frac"]
+    assert uasync["value"] > uasync["sync_tokens_per_s"]
+    assert uasync["vs_baseline"] > 1.0
+    assert uasync["async_emissions_match"] == 1.0
+    for rec in (legacy, unified, uasync):
+        assert 0.0 <= rec["step_gap_frac"] <= 1.0
+        assert rec["host_ms_per_step"] >= 0.0
     # the round-12 speculation gates: the spec-off leg anchors exactly
     # 1.0 token per decode lane-step on the same repetitive workload;
     # the k=4 leg must ACTUALLY accept drafts — more than one token per
@@ -1542,3 +1556,345 @@ def test_quantized_generate_kernel_leg_matches_oracle(rng):
     finally:
         model.config.weight_dtype = None
         model.config.kv_cache_dtype = None
+
+
+# -- round 13: async double-buffered engine ---------------------------------
+
+
+def _cache_state(mgr):
+    """Snapshot of the manager's page/refcount/prefix-pin accounting —
+    everything the deferred-reconciliation property compares."""
+    return dict(
+        page_table=np.asarray(mgr._page_table).copy(),
+        seq_lens=np.asarray(mgr._seq_lens).copy(),
+        refcount=np.asarray(mgr._refcount).copy(),
+        free_pages=sorted(mgr._free_pages),
+        free_slots=sorted(mgr._free_slots),
+        lru=list(mgr._lru),
+        prefix_keys=set(mgr._prefix_pages),
+    )
+
+
+def _assert_cache_consistent(mgr):
+    """Conservation invariants that must hold after EVERY step: refcounts
+    mirror slot references, free/LRU/referenced partition the pool, and
+    registered pages never sit on the free list."""
+    refs = np.zeros((mgr.num_pages,), np.int64)
+    for slot in range(mgr.max_batch):
+        for pg in mgr._page_table[slot]:
+            if pg >= 0:
+                refs[int(pg)] += 1
+    np.testing.assert_array_equal(refs, mgr._refcount)
+    free = set(mgr._free_pages)
+    lru = set(mgr._lru)
+    held = {p for p in range(mgr.num_pages) if mgr._refcount[p] > 0}
+    assert not free & lru and not free & held and not lru & held
+    assert len(free) + len(lru) + len(held) == mgr.num_pages
+    assert not any(p in mgr._page_key for p in free)
+    for p in lru:
+        assert p in mgr._page_key   # LRU pages stay registered (pinned)
+
+
+def _churn_prompts(rng, n, max_len=20):
+    return [rng.randint(0, TINY["vocab_size"],
+                        (int(rng.randint(1, max_len)),)).tolist()
+            for _ in range(n)]
+
+
+def _drive_churn(sp, prompts, gen_len, lockstep=None, **sampling):
+    """Continuous-arrival churn: keep the lanes full from ``prompts`` in
+    arrival order, step until all finish + flush. Returns per-arrival
+    output streams; ``lockstep`` (a callback) runs after every step."""
+    queued = list(prompts)
+    reqs = []
+    live = lambda: sum(  # noqa: E731
+        1 for r in reqs if r.state != FINISHED)
+    steps = 0
+    while queued or sp.has_work():
+        while queued and live() < sp.max_batch:
+            reqs.append(sp.add_request(queued.pop(0), gen_len, **sampling))
+        sp.step()
+        steps += 1
+        if lockstep is not None:
+            lockstep()
+        assert steps < 20000, "churn stuck"
+    sp.flush()
+    return [list(r.output_ids) for r in reqs], steps
+
+
+def test_async_matches_sync_1k_churn_greedy_and_sampled(rng):
+    """THE round-13 identity gate: the async double-buffered engine must
+    reproduce the synchronous engine token-for-token over a 1k-step
+    continuous-arrival churn (mixed prompt lengths, admissions/
+    retirements every few steps) — greedy AND seeded sampling (streams
+    keyed by tokens-produced are batch-order invariant)."""
+    model = _tiny_model()
+    prompts = _churn_prompts(rng, 220)
+    kw = dict(max_batch=3, max_seq_len=48, page_size=8, chunk=8)
+    eos = None
+    for sampling in (dict(),
+                     dict(temperature=0.8, top_k=12, top_p=0.9, seed=3),
+                     "eos"):
+        if sampling == "eos":
+            # third leg: eos configured — the subtlest reconcile path
+            # (eos discovered one step behind the dispatch, the wasted
+            # post-eos lane-step dropped as overhang, retirement one
+            # step late). eos is a frequently-EMITTED token from the
+            # greedy leg, so many requests genuinely stop early.
+            sampling = dict(eos_token_id=eos)
+        sp_sync = ServingPredictor(model, **kw)
+        want, steps_sync = _drive_churn(sp_sync, prompts, 5, **sampling)
+        sp_async = ServingPredictor(model, async_engine=True, **kw)
+        got, steps_async = _drive_churn(sp_async, prompts, 5, **sampling)
+        assert steps_sync >= 300   # a real churn, not a toy trace
+        for i, (w, g) in enumerate(zip(want, got)):
+            assert g == w, f"request {i} diverged ({sampling})"
+        # same ONE executable, no retrace (the async feedback inputs are
+        # geometry-stable)
+        assert sp_async.decode_trace_count == 1
+        if eos is None:
+            flat = [t for w in want for t in w]
+            eos = int(np.bincount(np.asarray(flat)).argmax())
+    assert any(len(w) < 5 for w in want)   # eos really stopped requests
+
+
+def test_async_no_completion_fast_path_defers_all_syncs(rng):
+    """Satellite: a step that cannot complete any request (no eos
+    configured, output budget unreachable) must not hard-sync at all —
+    the general no-completion-possible fast path. The whole run defers
+    until the ring fills / the final flush."""
+    model = _tiny_model()
+    prompt = rng.randint(0, TINY["vocab_size"], (6,)).tolist()
+    sp = ServingPredictor(model, max_batch=1, max_seq_len=64, page_size=8,
+                          chunk=8, async_engine=True,
+                          max_inflight_steps=64)
+    req = sp.add_request(prompt, max_new_tokens=30)
+    for _ in range(12):
+        sp.step()
+    # prefill round + 11 decode dispatches, none reconciled: no token
+    # has crossed to the host, no hard sync has happened
+    assert sp.hard_syncs == 0
+    assert req.output_ids == []
+    assert req._pending_n > 0
+    # and the steady-decode pack cache served most of those dispatches
+    # (all-feedback steps re-serve the previous step's device arrays)
+    assert sp.steady_hits >= 8
+    sp.flush()
+    # ONE batched materialization landed everything dispatched so far
+    assert sp.hard_syncs == 1
+    assert len(req.output_ids) == req._pending_n + len(req.output_ids)
+    got_prefix = list(req.output_ids)
+    while sp.has_work():
+        sp.step()
+    sp.flush()
+    want = model.generate(
+        paddle.to_tensor(np.asarray([prompt], np.int64)),
+        max_new_tokens=30, page_size=8).numpy()[0]
+    np.testing.assert_array_equal(np.asarray(req.output_ids), want)
+    assert req.output_ids[:len(got_prefix)] == got_prefix
+    # an eos-configured request is an emission boundary EVERY decode
+    # step: the engine reconciles behind-by-one instead of deferring
+    sp2 = ServingPredictor(model, max_batch=1, max_seq_len=64, page_size=8,
+                           chunk=8, async_engine=True,
+                           max_inflight_steps=64)
+    sp2.add_request(prompt, max_new_tokens=8, eos_token_id=int(want[0]))
+    sp2.step()   # prefill (+ first decode dispatch)
+    syncs0 = sp2.hard_syncs
+    for _ in range(3):
+        sp2.step()
+    assert sp2.hard_syncs > syncs0   # behind-by-one, not deferred
+
+
+def test_async_deferred_reconciliation_accounting_matches_sync(rng):
+    """Satellite property test: on an eos-free churn the async engine's
+    scheduling is COUNT-driven and therefore step-for-step identical to
+    the sync engine — after every step the page table, seq lens,
+    refcounts, free lists, prefix registry and LRU pins must equal the
+    sync run's, and the conservation invariants must hold throughout
+    (deferral moves token VALUES, never page accounting)."""
+    model = _tiny_model()
+    prompts = _churn_prompts(rng, 40, max_len=24)
+    kw = dict(max_batch=3, max_seq_len=48, page_size=8, chunk=8,
+              num_pages=14)   # tight pool: preemption + LRU eviction
+    sp_sync = ServingPredictor(model, **kw)
+    sp_async = ServingPredictor(model, async_engine=True, **kw)
+    queued_s, queued_a = list(prompts), list(prompts)
+    reqs_s, reqs_a = [], []
+
+    def admit(sp, queued, reqs):
+        while queued and sum(1 for r in reqs
+                             if r.state != FINISHED) < sp.max_batch:
+            reqs.append(sp.add_request(queued.pop(0), 5))
+
+    steps = 0
+    while (queued_s or sp_sync.has_work()
+           or queued_a or sp_async.has_work()):
+        admit(sp_sync, queued_s, reqs_s)
+        admit(sp_async, queued_a, reqs_a)
+        sp_sync.step()
+        sp_async.step()
+        _assert_cache_consistent(sp_async.cache)
+        a, b = _cache_state(sp_sync.cache), _cache_state(sp_async.cache)
+        for key in a:
+            if isinstance(a[key], np.ndarray):
+                np.testing.assert_array_equal(a[key], b[key], err_msg=key)
+            else:
+                assert a[key] == b[key], f"{key} diverged at step {steps}"
+        steps += 1
+        assert steps < 5000, "churn stuck"
+    sp_async.flush()
+    for w, g in zip(reqs_s, reqs_a):
+        assert g.output_ids == w.output_ids
+    # quiesced: both pools fully released (prefix LRU pages may persist)
+    assert (sp_async.cache.available_page_count
+            == sp_sync.cache.available_page_count)
+
+
+def test_async_spec_k4_composition(rng):
+    """spec-decode k=4 under the async engine: drafts/rollback are
+    host-value-dependent, so the engine reconciles in-step — output and
+    rollback accounting must match the sync spec engine exactly."""
+    model = _tiny_model()
+    motifs = [np.tile(rng.randint(0, TINY["vocab_size"], (4,)),
+                      6).tolist() for _ in range(5)]
+    kw = dict(max_batch=2, max_seq_len=96, page_size=8, chunk=8,
+              spec_decode_k=4)
+    sp_s = ServingPredictor(model, **kw)
+    want = sp_s.generate(motifs, max_new_tokens=10)
+    sp_a = ServingPredictor(model, async_engine=True, **kw)
+    got = sp_a.generate(motifs, max_new_tokens=10)
+    for w, g in zip(want, got):
+        assert g == w
+    assert sp_a.accepted_tokens_per_step == pytest.approx(
+        sp_s.accepted_tokens_per_step)
+    assert sp_a.cache.available_page_count == sp_s.cache.available_page_count
+
+
+def test_async_quantized_int8w_int8kv_composition(rng):
+    """int8 weights + int8 KV under the async engine: bit-identical to
+    the sync quantized engine (same numerics, deferred emission)."""
+    model = _tiny_model()
+    prompts = _churn_prompts(rng, 8, max_len=14)
+    model.config.weight_dtype = "int8"
+    model.config.kv_cache_dtype = "int8"
+    try:
+        kw = dict(max_batch=3, page_size=8, max_seq_len=64)
+        want = ServingPredictor(model, **kw).generate(
+            prompts, max_new_tokens=8)
+        got = ServingPredictor(model, async_engine=True, **kw).generate(
+            prompts, max_new_tokens=8)
+        for w, g in zip(want, got):
+            assert g == w
+    finally:
+        model.config.weight_dtype = None
+        model.config.kv_cache_dtype = None
+
+
+def test_async_mesh2_composition(rng):
+    """mesh=2 SPMD serving under the async engine: the replicated
+    emission outputs defer like single-chip ones; token streams match
+    the sync mesh engine."""
+    _need_devices(2)
+    model = _tiny_model()
+    prompts = _churn_prompts(rng, 6, max_len=12)
+    kw = dict(max_batch=2, max_seq_len=48, page_size=8, chunk=8, mesh=2)
+    want = ServingPredictor(model, **kw).generate(prompts,
+                                                  max_new_tokens=6)
+    got = ServingPredictor(model, async_engine=True, **kw).generate(
+        prompts, max_new_tokens=6)
+    for w, g in zip(want, got):
+        assert g == w
+
+
+def test_async_steady_pack_cache_identity_greedy_and_sampled(rng):
+    """The steady-decode pack cache (all-feedback steps re-serving the
+    previous step's device arrays) must trigger on long decode runs and
+    stay token-identical to the sync engine — greedy AND seeded sampling
+    (the in-jit key folds read the freshly-uploaded produced counts)."""
+    model = _tiny_model()
+    prompts = [rng.randint(0, TINY["vocab_size"], (n,)).tolist()
+               for n in (5, 9)]
+    kw = dict(max_batch=2, max_seq_len=64, page_size=8, chunk=8)
+    for sampling in (dict(),
+                     dict(temperature=0.7, top_k=20, top_p=0.9, seed=11)):
+        want = ServingPredictor(model, **kw).generate(
+            prompts, max_new_tokens=20, **sampling)
+        sp = ServingPredictor(model, async_engine=True, **kw)
+        got = sp.generate(prompts, max_new_tokens=20, **sampling)
+        assert got == want, f"steady-path divergence ({sampling})"
+        assert sp.steady_hits > 5
+
+
+def test_async_requires_unified():
+    model = _tiny_model()
+    with pytest.raises(ValueError, match="async"):
+        ServingPredictor(model, unified=False, async_engine=True)
+
+
+def test_async_preemption_replay_flushes_pending(rng):
+    """A preempted request re-admits with its full context — the engine
+    must flush in-flight tokens before the replay (the value barrier).
+    Under page pressure the async streams still match the per-prompt
+    oracle."""
+    model = _tiny_model()
+    prompts = [rng.randint(0, TINY["vocab_size"], (6,)).tolist()
+               for _ in range(3)]
+    sp = ServingPredictor(model, max_batch=3, max_seq_len=24, page_size=8,
+                          num_pages=5, async_engine=True)
+    reqs = [sp.add_request(p, max_new_tokens=10) for p in prompts]
+    while sp.has_work():
+        sp.step()
+    sp.flush()
+    assert sum(r.preempt_count for r in reqs) >= 1
+    for p, r in zip(prompts, reqs):
+        want = model.generate(
+            paddle.to_tensor(np.asarray([p], np.int64)),
+            max_new_tokens=10, page_size=8).numpy()[0]
+        np.testing.assert_array_equal(np.asarray(r.output_ids), want)
+
+
+def test_device_view_caches_skip_unchanged_uploads():
+    """Satellite: the manager's device views re-serve the SAME array
+    until the backing bookkeeping mutates (page table stays put over
+    steady decode inside a page; seq lens invalidate on advance)."""
+    m = _mgr()
+    slot = m.admit(4)
+    pt0 = m.page_table_device()
+    sl0 = m.seq_lens_device()
+    assert m.page_table_device() is pt0
+    assert m.seq_lens_device() is sl0
+    m.advance(slot, 1)             # within the page: seq lens only
+    assert m.seq_lens_device() is not sl0
+    assert m.page_table_device() is pt0
+    assert m.ensure_capacity(slot, 9)   # crosses into a second page
+    assert m.page_table_device() is not pt0
+    # the views are snapshots: mutating the live numpy bookkeeping must
+    # never reach an already-returned device array (the async engine
+    # mutates right after dispatch)
+    dev = m.page_table_device()
+    snapshot = np.asarray(dev).copy()
+    m.free(slot)
+    np.testing.assert_array_equal(np.asarray(dev), snapshot)
+
+
+def test_async_step_returns_tokens_one_behind(rng):
+    """step() returns the tokens RECONCILED by the call: behind-by-one
+    for emission-boundary steps, and the union over a flush — the sum
+    over all step()/flush() returns equals every request's stream."""
+    model = _tiny_model()
+    prompts = _churn_prompts(rng, 6, max_len=10)
+    sp = ServingPredictor(model, max_batch=2, max_seq_len=48, page_size=8,
+                          chunk=8, async_engine=True)
+    collected: dict[int, list[int]] = {}
+    queued = list(prompts)
+    reqs = []
+    while queued or sp.has_work():
+        while queued and sum(1 for r in reqs
+                             if r.state != FINISHED) < sp.max_batch:
+            reqs.append(sp.add_request(queued.pop(0), 4))
+        for rid, toks in sp.step().items():
+            collected.setdefault(rid, []).extend(toks)
+    for rid, toks in sp.flush().items():
+        collected.setdefault(rid, []).extend(toks)
+    for r in reqs:
+        assert collected.get(r.req_id, []) == r.output_ids
