@@ -407,6 +407,86 @@ def bench_bert_jit(on_tpu):
     }
 
 
+def bench_dp_quant(on_tpu):
+    """Round-14 dp=2 gradient-sync A/B: implicit GSPMD fp allreduce vs the
+    int8 quantized ring (``distributed.compressed_collectives`` behind
+    ``build_spmd_train_step(comm_quant="int8")``).
+
+    One JSON line: the int8 leg's throughput (``vs_baseline`` = speedup
+    over the fp leg — ~1.0 on the CPU smoke where the virtual-device
+    "wire" is memcpy; the wire-byte model is what the metric carries),
+    ``bytes_on_the_wire``/``bytes_on_the_wire_fp``/``wire_reduction`` from
+    the analytic per-replica ring model, ``loss_parity_delta`` (max
+    relative deviation of the int8 loss trajectory vs the fp oracle over
+    the benched steps — both runs deterministic, same init/data), and
+    ``replicas_bit_identical`` (params after the int8 steps byte-equal
+    across the dp replicas' shards). Needs >= 2 devices (main() forces 2
+    virtual host devices off-TPU, like bench_serve's spmd leg)."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.distributed.compressed_collectives import bytes_on_the_wire
+    from paddle_tpu.models.gpt import GPTConfig
+    from paddle_tpu.models.gpt_spmd import build_spmd_train_step
+    from jax.sharding import Mesh
+
+    if len(jax.devices()) < 2:
+        raise RuntimeError("dp-quant A/B needs >= 2 devices")
+    if on_tpu:
+        hidden, layers, heads, batch, seq, steps = 768, 12, 12, 8, 1024, 8
+    else:
+        hidden, layers, heads, batch, seq, steps = 64, 2, 4, 8, 64, 6
+    cfg = GPTConfig(vocab_size=256, hidden_size=hidden, num_layers=layers,
+                    num_heads=heads, max_seq_len=seq)
+    mesh = Mesh(np.array(jax.devices()[:2]).reshape(2, 1, 1),
+                ("dp", "pp", "mp"))
+
+    def run(comm_quant):
+        step, params, mom, (ids, labels) = build_spmd_train_step(
+            cfg, mesh, batch_size=batch, seq_len=seq, comm_quant=comm_quant)
+        # warmup = step 1 of the deterministic trajectory (params/mom are
+        # donated, so training continues from the returned state); only
+        # the post-compile steps are timed
+        params, mom, loss = step(params, mom, ids, labels)
+        losses = [float(loss)]
+        t0 = time.perf_counter()
+        for _ in range(steps - 1):
+            params, mom, loss = step(params, mom, ids, labels)
+            losses.append(float(loss))
+        elapsed = time.perf_counter() - t0
+        return losses, params, (steps - 1) * batch * seq / elapsed
+
+    fp_losses, _, fp_tps = run(None)
+    q_losses, q_params, q_tps = run("int8")
+    parity = max(abs(a - b) / max(abs(a), 1e-9)
+                 for a, b in zip(fp_losses, q_losses))
+    bit_identical = 1.0
+    for leaf in jax.tree.leaves(q_params):
+        shards = [np.asarray(s.data) for s in leaf.addressable_shards]
+        full = [s for s in shards if s.shape == leaf.shape]
+        if any(not np.array_equal(full[0], s) for s in full[1:]):
+            bit_identical = 0.0
+    n_elems = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(q_params))
+    elem_bytes = jnp.dtype(jax.tree.leaves(q_params)[0].dtype).itemsize
+    wire_fp = bytes_on_the_wire(n_elems, 2, elem_bytes=elem_bytes)
+    wire_q = bytes_on_the_wire(n_elems, 2, elem_bytes=elem_bytes,
+                               quant="int8")
+    chip, _ = _chip_peak(jax, on_tpu)
+    return {
+        "metric": f"gpt dp2 int8-quantized gradient allreduce train step "
+                  f"tokens/sec/chip (bs{batch} seq{seq}, {chip})",
+        "value": round(q_tps, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(q_tps / fp_tps, 4),
+        "comm_quant": "int8",
+        "bytes_on_the_wire": wire_q,
+        "bytes_on_the_wire_fp": wire_fp,
+        "wire_reduction": round(wire_fp / wire_q, 4),
+        "loss_parity_delta": parity,
+        "replicas_bit_identical": bit_identical,
+    }
+
+
 FLAGSHIP_METRIC = "gpt3-760m(+remat) fused train step tokens/sec/chip"
 
 
@@ -474,6 +554,14 @@ def main():
     import os
     import sys
 
+    if "--dpquant" in sys.argv:
+        # the dp=2 A/B needs two devices: force virtual host devices
+        # BEFORE the backend initializes (CPU backend only — a real TPU
+        # pod ignores the host-platform flag), like bench_serve --smoke
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=2")
     if "--cpu" in sys.argv:
         # sitecustomize force-sets jax_platforms="axon,cpu"; config overrides it
         import jax as _j
@@ -489,6 +577,18 @@ def main():
 
     on_tpu = jax.devices()[0].platform == "tpu"
     fused_mlp = "--fused-mlp" in sys.argv
+
+    if "--dpquant" in sys.argv:
+        # round-14 standalone mode (the tier-1 gate in
+        # tests/test_distributed.py drives it): ONE schema-checked line
+        from paddle_tpu.analysis.bench_schema import checked_line
+
+        metric = "gpt dp2 int8-quantized gradient allreduce tokens/sec/chip"
+        try:
+            print(checked_line(bench_dp_quant(on_tpu)))
+        except Exception as e:
+            print(_error_line(f"{type(e).__name__}: {e}", metric=metric))
+        return
 
     # In-era anchor: measured ONCE per --all run, merged into every line so
     # each config's JSON carries the era's ideal-GEMM throughput next to it.

@@ -124,6 +124,11 @@ class _ChurnLeg:
                  dtype=None, weight_dtype=None, kv_cache_dtype=None,
                  mesh_chips=1, spec_decode_k=0, spec_workload=False,
                  async_engine=False):
+        # async_engine stays EXPLICIT here (default False = the sync
+        # baseline leg) even though round 14 flipped the predictor's own
+        # default to async: the legacy/quant/spec/spmd legs are the
+        # like-for-like round-over-round baselines, and the round-13
+        # interleaved sync-vs-async pair is the one engine A/B
         import jax.numpy as jnp
 
         import paddle_tpu as paddle

@@ -46,8 +46,19 @@ _OPTIONAL_NUMERIC = ("vs_baseline", "p50_ms", "p99_ms", "anchor_tflops",
                      # common request's stream bit-identical)
                      "step_gap_frac", "host_ms_per_step",
                      "async_emissions_match", "sync_tokens_per_s",
-                     "sync_step_gap_frac")
-_OPTIONAL_STRING = ("mesh_shape",)
+                     "sync_step_gap_frac",
+                     # round 14: quantized dp gradient allreduce A/B —
+                     # analytic per-replica wire bytes of one gradient
+                     # sync (int8 leg / fp oracle leg), their ratio, the
+                     # max relative loss-trajectory deviation of the int8
+                     # leg vs the fp oracle over the N benched steps, and
+                     # the bit-equality gate of the synced params across
+                     # dp replicas (1.0 = every leaf's device shards
+                     # byte-identical)
+                     "bytes_on_the_wire", "bytes_on_the_wire_fp",
+                     "wire_reduction", "loss_parity_delta",
+                     "replicas_bit_identical")
+_OPTIONAL_STRING = ("mesh_shape", "comm_quant")
 
 
 def validate_line(obj) -> list[str]:

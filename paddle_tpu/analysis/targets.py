@@ -27,6 +27,14 @@ builds of exactly the programs that carry the repo's numbers:
                   draft-token verify/accept program and the JX005
                   donation audit over the pools and scale planes at their
                   SHIFTED positions (the spec_len input precedes them);
+- ``train-dpquant``  the round-14 comm-quant dp train step: per-replica
+                  gradients stacked under vmap, the int8 quantized ring
+                  allreduce (quantize -> GSPMD-roll hop -> deterministic
+                  requantization) replacing the implicit fp allreduce —
+                  jaxpr walk incl. the JX001 scale-promotion audit on the
+                  dequant path (block scales multiplying into the decode
+                  must never widen it to f64) + the JX005 donation audit
+                  of (params, momentum);
 - ``serving-async``  the round-13 feedback-coupled unified step as the
                   async double-buffered engine drives it: a LIVE
                   ``feedback`` mask routing a decode lane's input token
@@ -99,6 +107,46 @@ def analyze_gpt_spmd() -> list[Finding]:
     # the builder donates (params, momentum); both must alias outputs
     findings += check_donation(step, (params, mom, ids, labels), (0, 1),
                                "gpt-spmd-step")
+    return findings
+
+
+def analyze_train_dpquant() -> list[Finding]:
+    """Round-14 quantized-dp training: the train step with the implicit
+    GSPMD gradient allreduce replaced by the explicit int8 quantized ring
+    (``build_spmd_train_step(comm_quant="int8")`` over a dp=2 mesh). The
+    jaxpr walk covers the stacked per-replica grad computation, every
+    quantize/roll/dequantize hop and the int8 distribution phase — JX001
+    is the scale-promotion audit (fp32 block scales multiplying into the
+    decode must never widen the chain to f64) and JX005 the donation
+    audit of (params, momentum) through the new step body."""
+    import numpy as np
+
+    import jax
+    from jax.sharding import Mesh
+
+    from ..models.gpt import GPTConfig
+    from ..models.gpt_spmd import build_spmd_train_step
+
+    cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                    num_heads=2, max_seq_len=32)
+    if len(jax.devices()) < 2:
+        # comm_quant is INERT at dp=1 (build_spmd_train_step only takes
+        # the quantized path for dp > 1): a dp=1 fallback would audit the
+        # plain fp step and report a false-green empty baseline. The CLI
+        # gate and the test suite both force an 8-device virtual mesh.
+        raise RuntimeError(
+            "train-dpquant needs >= 2 devices (the quantized ring is "
+            "inert at dp=1); run under the forced virtual CPU mesh like "
+            "the `python -m paddle_tpu.analysis` gate")
+    mesh = Mesh(np.array(jax.devices()[:2]).reshape(2, 1, 1),
+                ("dp", "pp", "mp"))
+    step, params, mom, (ids, labels) = build_spmd_train_step(
+        cfg, mesh, batch_size=4, seq_len=32, comm_quant="int8")
+    closed = trace_callable(step, params, mom, ids, labels)
+    findings = analyze_jaxpr(closed, "train-dpquant-step")
+    # the builder donates (params, momentum); both must alias outputs
+    findings += check_donation(step, (params, mom, ids, labels), (0, 1),
+                               "train-dpquant-step")
     return findings
 
 
@@ -545,6 +593,7 @@ TARGETS = {
     "gpt-eager": analyze_gpt_eager,
     "bert-eager": analyze_bert_eager,
     "gpt-spmd": analyze_gpt_spmd,
+    "train-dpquant": analyze_train_dpquant,
     "serving": analyze_serving,
     "serving-unified": analyze_serving_unified,
     "serving-quant": analyze_serving_quant,

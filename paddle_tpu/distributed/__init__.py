@@ -100,6 +100,7 @@ _LAZY = {
     "fleet": ".fleet",
     "io": ".io",
     "collective": ".collective",
+    "compressed_collectives": ".compressed_collectives",
     "auto_parallel": ".auto_parallel",
     "checkpoint": ".checkpoint",
     "launch": ".launch",
@@ -136,6 +137,11 @@ _FLAT = {
     "to_static": ".auto_parallel.dist_model",
     "DistModel": ".auto_parallel.dist_model",
     "Strategy": ".auto_parallel.dist_model",
+    # quantized (compressed) collectives — round 14
+    "CommQuantConfig": ".compressed_collectives",
+    "bytes_on_the_wire": ".compressed_collectives",
+    "quantized_all_reduce_stacked": ".compressed_collectives",
+    "quantized_reduce_scatter_stacked": ".compressed_collectives",
     # collectives
     "ReduceOp": ".collective",
     "Group": ".collective",

@@ -64,6 +64,22 @@ _REDUCERS = {
 }
 
 
+def _validate_reduce_op(op, *, quant=None, where="all_reduce"):
+    """Loud validation of (op, quant): an unknown op name or an
+    op/quant combination the quantized path cannot serve raises HERE with
+    the op named, instead of a bare KeyError (or a silent fp fallback)
+    deep in the lowering."""
+    if op not in _REDUCERS:
+        raise ValueError(
+            f"{where}: unsupported reduce op {op!r} (expected one of "
+            f"{sorted(_REDUCERS)})")
+    if quant is not None and op not in (ReduceOp.SUM, ReduceOp.AVG):
+        raise ValueError(
+            f"{where}: reduce op {op!r} cannot run quantized — per-chunk "
+            "int8 requantization is only deterministic for sum/avg; drop "
+            f"quant={quant!r} or use ReduceOp.SUM/AVG")
+
+
 class Group:
     """A communicator: an ordered set of ranks bound to a mesh axis.
 
@@ -162,14 +178,28 @@ def is_available() -> bool:
 # collectives
 # ---------------------------------------------------------------------------
 
-def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True, quant=None):
     """SUM/MAX/... across the group.
 
     SPMD path: per-rank local value in, reduced value out (lax.psum).
     Eager path: rank-major ``[nranks, *S]`` in, ``[nranks, *S]`` out with every
     rank slot holding the reduction (paddle semantics: in-place on each rank).
+
+    ``quant="int8"`` (sum/avg only) routes the gradient-sized payload
+    through ``compressed_collectives``: per-chunk symmetric int8 + fp32
+    block scales, deterministic requantization so every rank decodes the
+    bit-identical result. Inside SPMD regions this lowers to a quantized
+    reduce-scatter (``all_to_all`` of each rank's int8 chunks + a local
+    deterministic decode-sum of the owned chunk) followed by an
+    ``all_gather`` of the requantized reduced chunks — per-rank wire is
+    ``~2 * (world-1)/world * N`` int8 bytes + scales, the same
+    ``bytes_on_the_wire`` model as the GSPMD-roll ring; the eager
+    rank-major path runs the ring math in global view.
     """
     g = _resolve_group(group)
+    _validate_reduce_op(op, quant=quant, where="all_reduce")
+    if quant is not None:
+        return _all_reduce_quant(tensor, op, g, quant)
     if in_spmd_region(g.axis_name):
         _, pred = _REDUCERS[op]
         if pred is None:
@@ -186,12 +216,65 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     return out
 
 
+def _all_reduce_quant(tensor, op, g: Group, quant):
+    """The int8 route of :func:`all_reduce` (op already validated)."""
+    from .compressed_collectives import (as_comm_quant_config,
+                                         dequantize_blocks, quantize_blocks,
+                                         quantized_all_reduce_stacked)
+
+    cfg = as_comm_quant_config(quant)
+    mean = op == ReduceOp.AVG
+    if in_spmd_region(g.axis_name):
+        block = int(cfg.block_size)
+        world = g.nranks
+
+        def fn(x):
+            # quantized reduce-scatter + all-gather, per-rank: quantize
+            # the local tensor in WORLD chunks, all_to_all so rank r
+            # receives every rank's version of chunk r (int8 + scales on
+            # the wire), decode-sum the owned chunk in rank order
+            # (deterministic), requantize ONCE, all-gather the reduced
+            # int8 chunks — everyone decodes the same bytes, so the
+            # result is bit-identical across ranks, at the ring's
+            # ~2*(world-1)/world*N int8 wire bytes per rank
+            flat = x.reshape(-1).astype(jnp.float32)
+            c = -(-flat.size // (world * block)) * block
+            padded = jnp.pad(flat, (0, world * c - flat.size))
+            q, s = quantize_blocks(padded.reshape(world, c), block)
+            qt = lax.all_to_all(q, g.axis_name, split_axis=0,
+                                concat_axis=0, tiled=False)
+            st = lax.all_to_all(s, g.axis_name, split_axis=0,
+                                concat_axis=0, tiled=False)
+            owned = jnp.sum(dequantize_blocks(qt, st), axis=0)  # [c]
+            q2, s2 = quantize_blocks(owned[None], block)
+            qg = lax.all_gather(q2[0], g.axis_name, axis=0, tiled=False)
+            sg = lax.all_gather(s2[0], g.axis_name, axis=0, tiled=False)
+            total = dequantize_blocks(qg, sg).reshape(world * c)
+            total = total[:flat.size].reshape(x.shape)
+            if mean:
+                total = total / world
+            return total.astype(x.dtype)
+
+        return apply_op(f"all_reduce_{op}_int8", fn, tensor)
+
+    def fn(x):  # eager rank-major [n, *S]: the ring math in global view
+        return quantized_all_reduce_stacked(x, mesh=None, cfg=cfg, mean=mean)
+
+    out = apply_op(f"all_reduce_{op}_int8", fn, tensor)
+    if isinstance(tensor, Tensor):
+        tensor._data = out._data  # paddle all_reduce is in-place
+    return out
+
+
 def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
     """Like all_reduce but only rank ``dst`` holds the result (others keep
     their input — eager rank-major emulation updates only the dst slot)."""
     g = _resolve_group(group)
+    _validate_reduce_op(op, where="reduce")
     if in_spmd_region(g.axis_name):
         _, pred = _REDUCERS[op]
+        if pred is None:
+            raise NotImplementedError(f"reduce op {op} inside SPMD region")
         return apply_op(f"reduce_{op}", lambda x: pred(x, g.axis_name), tensor)
     dst_idx = g.get_group_rank(dst) if dst in g.ranks else dst
     red, _ = _REDUCERS[op]
@@ -288,10 +371,19 @@ def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None, sync_o
     per-rank stacked contributions) or functional [n, *S] where S splits n-ways
     on dim 1 -> out [n, *S/n]: out[r] = sum_r' in[r'] chunk r."""
     g = _resolve_group(group)
+    _validate_reduce_op(op, where="reduce_scatter")
     if in_spmd_region(g.axis_name):
+        if op not in (ReduceOp.SUM, ReduceOp.AVG):
+            # psum_scatter only sums — anything else used to SILENTLY
+            # come back as a sum; fail with the op named instead
+            raise NotImplementedError(
+                f"reduce_scatter op {op!r} inside SPMD region (XLA "
+                "reduce-scatter sums; use SUM/AVG or an eager collective)")
+        scale = (lambda v: v / g.nranks) if op == ReduceOp.AVG else (lambda v: v)
         return apply_op(
             f"reduce_scatter_{op}",
-            lambda v: lax.psum_scatter(v, g.axis_name, scatter_dimension=0, tiled=True),
+            lambda v: scale(lax.psum_scatter(
+                v, g.axis_name, scatter_dimension=0, tiled=True)),
             tensor if tensor_list is None else tensor_list,
         )
     x = tensor if tensor_list is None else tensor_list

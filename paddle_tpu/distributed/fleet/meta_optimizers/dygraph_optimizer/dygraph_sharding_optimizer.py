@@ -74,12 +74,21 @@ class DygraphShardingOptimizer:
     tensor-fusion options are accepted and ignored — XLA owns fusion/overlap.
     """
 
-    def __init__(self, optimizer, hcg=None, group=None, offload=False, **kwargs):
+    def __init__(self, optimizer, hcg=None, group=None, offload=False,
+                 comm_quant=None, **kwargs):
+        from ....compressed_collectives import as_comm_quant_config
+
         self._inner_opt = optimizer
         self._mesh, self._axis = _sharding_mesh(hcg, group)
         # offload: optimizer states live in host memory (reference ZeRO
         # CPU-offload); XLA streams shards to device inside the update
         self._memory_kind = host_memory_kind() if offload else None
+        # comm_quant ("int8" / CommQuantConfig): stage >= 2 passes each
+        # gradient through the compressed-collectives block quantizer
+        # before the sharded placement — the same quantization surface
+        # the quantized dp allreduce applies on the wire (stage 1 has no
+        # gradient flow; the knob is inert there)
+        self._comm_quant = as_comm_quant_config(comm_quant)
         self._install_state_placement(optimizer)
         self._param_shardings = {}
 
@@ -181,13 +190,36 @@ class DygraphShardingOptimizer:
 
 class GroupShardedOptimizerStage2(DygraphShardingOptimizer):
     """Stage-2: + gradients sharded before the update (reference
-    group_sharded_optimizer_stage2.py:53)."""
+    group_sharded_optimizer_stage2.py:53). With ``comm_quant`` the
+    gradient passes through the compressed-collectives int8 block
+    quantize/dequantize first — the same deterministic per-leaf block
+    surface (absmax/127 fp32 scales) as the quantized dp ring, so every
+    rank's shards decode identical bytes (per-leaf blocking, not the
+    ring's bucketed per-hop requantization)."""
 
     def _pre_step(self):
         mesh, axis = self._mesh, self._axis
+        cq = self._comm_quant
         for p in self._inner_opt._parameter_list:
             if p.grad is not None:
-                p.grad._data = _shard_leading(p.grad._data, mesh, axis)
+                g = p.grad._data
+                if cq is not None:
+                    g = quant_dequant_blocks(g, cq.block_size)
+                p.grad._data = _shard_leading(g, mesh, axis)
+
+
+def quant_dequant_blocks(a, block_size: int):
+    """Deterministic int8 round-trip of ``a`` through the compressed-
+    collectives block surface (pad -> quantize -> dequantize -> slice):
+    the stage-2 gradient numerics match what the quantized dp ring
+    decodes from the wire."""
+    from ....compressed_collectives import dequantize_blocks, quantize_blocks
+
+    flat = a.reshape(-1).astype(jnp.float32)
+    pad = (-flat.size) % int(block_size)
+    q, s = quantize_blocks(jnp.pad(flat, (0, pad)), int(block_size))
+    out = dequantize_blocks(q, s)[:flat.size]
+    return out.reshape(a.shape).astype(a.dtype)
 
 
 def _is_placed(arr, axis_name):

@@ -140,8 +140,16 @@ class ProcessMesh:
 
 def choose_mesh_shape(n_devices: int) -> dict[str, int]:
     """Factor n into (dp, pp, mp) — pp and mp first (they need >=2 to be
-    exercised), dp absorbs the rest."""
+    exercised), dp absorbs the rest. Prime counts degrade gracefully to
+    pure dp (a prime has no factor of 2 to give pp/mp)."""
     n = n_devices
+    if not isinstance(n, (int, np.integer)) or isinstance(n, bool):
+        raise ValueError(
+            f"choose_mesh_shape: n_devices must be an int, got "
+            f"{type(n_devices).__name__} {n_devices!r}")
+    if n < 1:
+        raise ValueError(
+            f"choose_mesh_shape: n_devices must be >= 1, got {n}")
     mp = 2 if n % 2 == 0 else 1
     pp = 2 if (n // mp) % 2 == 0 else 1
     dp = n // (mp * pp)
@@ -150,10 +158,16 @@ def choose_mesh_shape(n_devices: int) -> dict[str, int]:
 
 def make_training_mesh(n_devices: int | None = None) -> Mesh:
     """The dp x pp x mp training mesh over the first ``n_devices`` chips
-    (all visible devices by default) — ``gpt_spmd.make_mesh``'s home."""
+    (all visible devices by default) — ``gpt_spmd.make_mesh``'s home.
+    Asking for more chips than are visible fails loudly here (a silent
+    ``devs[:n]`` clip used to surface as a cryptic numpy reshape error)."""
     devs = _all_devices()
-    n = n_devices or len(devs)
-    shape = choose_mesh_shape(n)
+    n = len(devs) if n_devices is None else n_devices
+    shape = choose_mesh_shape(n)  # validates n is a positive int
+    if n > len(devs):
+        raise ValueError(
+            f"training mesh of {n} chips needs 1..{len(devs)} devices "
+            f"(visible: {len(devs)})")
     arr = np.array(devs[:n]).reshape(shape["dp"], shape["pp"], shape["mp"])
     return Mesh(arr, ("dp", "pp", "mp"))
 

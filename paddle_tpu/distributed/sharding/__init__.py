@@ -58,22 +58,37 @@ def group_sharded_parallel(
     sync_comm: bool = False,
     dp_group=None,
     exclude_layer=None,
+    comm_quant=None,
 ):
     """Wrap (model, optimizer, scaler) for ZeRO level ∈ os | os_g | p_g_os.
 
     ``offload=True`` places optimizer states (incl. master weights) in host
     memory via jax memory kinds ("pinned_host") — the reference's ZeRO
     CPU-offload (group_sharded_utils/stage3 offload path); XLA streams the
-    shards device-side inside the update."""
+    shards device-side inside the update.
+
+    ``comm_quant="int8"`` (levels os_g / p_g_os — the stages that move
+    gradients): each gradient round-trips through the SAME deterministic
+    int8 block-quantization surface as the quantized dp allreduce
+    (``distributed.compressed_collectives``) before the sharded
+    placement — same absmax/127 scales, same block discipline, bit-equal
+    across ranks. (Not bit-equal to a RING-synced run of the same
+    gradients: the ring buckets leaves into one flat buffer and
+    requantizes partial sums per hop, so block boundaries and error
+    accumulation differ between the two paths.)"""
     if level not in ("os", "os_g", "p_g_os"):
         raise ValueError("level must be one of 'os', 'os_g', 'p_g_os'")
     if level == "os":
         optimizer = DygraphShardingOptimizer(optimizer, group=group, offload=offload)
     elif level == "os_g":
-        optimizer = GroupShardedOptimizerStage2(optimizer, group=group, offload=offload)
+        optimizer = GroupShardedOptimizerStage2(optimizer, group=group,
+                                                offload=offload,
+                                                comm_quant=comm_quant)
     else:  # p_g_os
         model = GroupShardedStage3.apply(model, group=group)
-        optimizer = GroupShardedOptimizerStage2(optimizer, group=group, offload=offload)
+        optimizer = GroupShardedOptimizerStage2(optimizer, group=group,
+                                                offload=offload,
+                                                comm_quant=comm_quant)
     return model, optimizer, scaler
 
 

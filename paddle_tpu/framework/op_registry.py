@@ -143,7 +143,8 @@ _bulk([
     "adaptive_avg_pool1d", "adaptive_avg_pool2d", "adaptive_avg_pool3d",
     "adaptive_max_pool1d", "adaptive_max_pool2d", "adaptive_max_pool3d",
     "add", "all", "all_gather", "all_gather_slice", "all_reduce_avg",
-    "all_reduce_max", "all_reduce_min", "all_reduce_prod", "all_reduce_sum",
+    "all_reduce_avg_int8", "all_reduce_max", "all_reduce_min",
+    "all_reduce_prod", "all_reduce_sum", "all_reduce_sum_int8",
     "alltoall", "alltoall_single", "alpha_dropout", "any", "as_complex",
     "as_real", "as_strided", "assign", "atan2", "atleast_1d", "atleast_2d",
     "atleast_3d", "bernoulli", "bilinear", "binomial", "box_iou",

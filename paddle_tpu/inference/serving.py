@@ -64,9 +64,10 @@ behind-by-one; steps that cannot complete anything defer up to
 ``max_inflight_steps`` and drain in one batched materialization
 (``flush()``). Greedy output is bit-identical and seeded sampling
 stream-identical to the synchronous engine (per-request streams are
-batch-order invariant); ``async_engine=False`` (default) keeps the
-synchronous engine as the oracle — both drive the SAME pack/capacity
-code, the sync engine simply reconciles at pipeline depth zero.
+batch-order invariant); round 14 makes async the DEFAULT on the unified
+path (PR 8 soaked green) — ``async_engine=False`` keeps the synchronous
+engine as the oracle — both drive the SAME pack/capacity code, the sync
+engine simply reconciles at pipeline depth zero.
 
 Knobs: ``max_batch`` (lanes), ``num_pages``/``page_size`` (pool geometry),
 ``max_seq_len`` (page-table width), ``chunk`` (per-slot prefill chunk,
@@ -181,18 +182,19 @@ class ServingPredictor:
     grow / preempt around ONE unified-step launch); ``generate`` drives
     ``step`` until a set of prompts finishes. ``unified=False`` falls back
     to the round-7 two-jit path (per-bucket prefill at admission + decode
-    step) — the A/B baseline. ``async_engine=True`` (round 13) overlaps
-    host scheduling with device execution: ``step()`` dispatches round N
-    and reconciles round N-1's deferred emissions (see the module
-    docstring for the sync-boundary contract); ``flush()`` drains the
-    in-flight ring.
+    step) — the A/B baseline. ``async_engine`` (round 13; the DEFAULT on
+    the unified path since round 14) overlaps host scheduling with device
+    execution: ``step()`` dispatches round N and reconciles round N-1's
+    deferred emissions (see the module docstring for the sync-boundary
+    contract); ``flush()`` drains the in-flight ring; ``False`` selects
+    the synchronous oracle engine.
     """
 
     def __init__(self, model, *, max_batch=8, num_pages=None, page_size=None,
                  max_seq_len=None, use_kernel=None, prefill_bucket=16,
                  dtype=None, unified=True, chunk=None, token_budget=None,
                  prefix_cache=None, kv_cache_dtype=None, mesh=None,
-                 spec_decode_k=None, async_engine=False,
+                 spec_decode_k=None, async_engine=None,
                  max_inflight_steps=4):
         from ..distributed.mesh import as_serving_mesh
         from ..models.gpt import (_serving_params_cached, build_decode_step,
@@ -295,7 +297,13 @@ class ServingPredictor:
                                           mesh=self.mesh)
         # round 13: the async double-buffered engine — dispatch-ahead on
         # the unified step's device-resident token feedback; the sync
-        # engine is the same pack/capacity code at pipeline depth zero
+        # engine is the same pack/capacity code at pipeline depth zero.
+        # round 14: async is the DEFAULT on the unified path (PR 8 soaked:
+        # greedy bit-identical + seeded stream-identical to sync); pass
+        # async_engine=False for the explicit sync baseline, and the
+        # legacy two-jit path stays sync (it has no feedback carry)
+        if async_engine is None:
+            async_engine = self.unified
         self.async_engine = bool(async_engine)
         self.max_inflight_steps = max(1, int(max_inflight_steps))
         if self.async_engine and not self.unified:

@@ -1086,8 +1086,9 @@ _SKIP_GROUPS = {
     ],
     "distributed collective/SPMD op (covered by tests/test_distributed.py, test_fleet.py on the virtual mesh)": [
         "all_gather", "all_gather_slice", "all_reduce_avg",
-        "all_reduce_max", "all_reduce_min", "all_reduce_prod",
-        "all_reduce_sum", "alltoall", "alltoall_single", "broadcast",
+        "all_reduce_avg_int8", "all_reduce_max", "all_reduce_min",
+        "all_reduce_prod", "all_reduce_sum", "all_reduce_sum_int8",
+        "alltoall", "alltoall_single", "broadcast",
         "reduce_avg", "reduce_max", "reduce_min", "reduce_prod",
         "reduce_sum", "reduce_scatter_avg", "reduce_scatter_max",
         "reduce_scatter_min", "reduce_scatter_prod", "reduce_scatter_sum",

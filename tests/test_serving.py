@@ -1831,6 +1831,27 @@ def test_async_requires_unified():
         ServingPredictor(model, unified=False, async_engine=True)
 
 
+def test_async_engine_is_the_default(rng):
+    """Round 14 (ROADMAP item-3 follow-up): the soaked PR-8 async engine
+    is the default on the unified path; the legacy two-jit path resolves
+    to sync (it has no feedback carry), and async_engine=False still
+    selects the sync oracle explicitly."""
+    model = _tiny_model()
+    assert ServingPredictor(model, max_batch=2).async_engine is True
+    assert ServingPredictor(model, max_batch=2,
+                            async_engine=False).async_engine is False
+    assert ServingPredictor(model, max_batch=2,
+                            unified=False).async_engine is False
+    # the default engine still matches the explicit sync oracle
+    prompts = [rng.randint(0, TINY["vocab_size"], (5,)).tolist()
+               for _ in range(2)]
+    kw = dict(max_batch=2, max_seq_len=32, page_size=8)
+    want = ServingPredictor(model, async_engine=False, **kw).generate(
+        prompts, max_new_tokens=6)
+    got = ServingPredictor(model, **kw).generate(prompts, max_new_tokens=6)
+    assert got == want
+
+
 def test_async_preemption_replay_flushes_pending(rng):
     """A preempted request re-admits with its full context — the engine
     must flush in-flight tokens before the replay (the value barrier).
