@@ -430,6 +430,8 @@ def bench_dp_quant(on_tpu):
     from paddle_tpu.models.gpt_spmd import build_spmd_train_step
     from jax.sharding import Mesh
 
+    from paddle_tpu.observability import default_registry
+
     if len(jax.devices()) < 2:
         raise RuntimeError("dp-quant A/B needs >= 2 devices")
     if on_tpu:
@@ -456,8 +458,17 @@ def bench_dp_quant(on_tpu):
         elapsed = time.perf_counter() - t0
         return losses, params, (steps - 1) * batch * seq / elapsed
 
-    fp_losses, _, fp_tps = run(None)
-    q_losses, q_params, q_tps = run("int8")
+    # round 15: the library-wide metrics registry records both legs'
+    # train-step counters + the analytic wire bytes actually charged per
+    # step (labeled fp vs int8) — the snapshot rides the emitted line
+    default_registry.reset()
+    default_registry.enable()
+    try:
+        fp_losses, _, fp_tps = run(None)
+        q_losses, q_params, q_tps = run("int8")
+    finally:
+        default_registry.disable()
+    telemetry = default_registry.snapshot_flat()
     parity = max(abs(a - b) / max(abs(a), 1e-9)
                  for a, b in zip(fp_losses, q_losses))
     bit_identical = 1.0
@@ -484,6 +495,7 @@ def bench_dp_quant(on_tpu):
         "wire_reduction": round(wire_fp / wire_q, 4),
         "loss_parity_delta": parity,
         "replicas_bit_identical": bit_identical,
+        "telemetry": telemetry,
     }
 
 

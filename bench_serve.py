@@ -56,6 +56,16 @@ schema/contract as bench.py — the flagship quantized line LAST):
   floors gen_len/batch/prompt — a 2-3 token output budget would leave
   no deferral headroom to measure).
 
+- ``telemetry``/``obs_off_tokens_per_s``/``trace_events``: round 15 —
+  every leg carries the schema-checked flat snapshot of its serving
+  metrics registry (``ServingPredictor.telemetry()``: steps, syncs,
+  preemptions, prefix/CoW/eviction counters, draft rollback pages, TTFT
+  histogram stats), and the ``unified-obs`` interleaved pair measures
+  the SAME churn with host tracing off vs on — its ``vs_baseline`` is
+  the observability overhead ratio the smoke test gates near 1.0
+  (the disabled path is one flag check; the traced path records
+  pack_dispatch/reconcile spans + per-request lanes every step).
+
 ``--smoke``: tiny CPU config — always runnable (CI leg, rc 0; gather
 reference attention keeps it fast, kernel parity is the test suite's
 job). Off-TPU without ``--smoke`` each leg emits a structured ``error``
@@ -123,7 +133,7 @@ class _ChurnLeg:
                  gen_len, page_size, chunk, unified, use_kernel, on_tpu,
                  dtype=None, weight_dtype=None, kv_cache_dtype=None,
                  mesh_chips=1, spec_decode_k=0, spec_workload=False,
-                 async_engine=False):
+                 async_engine=False, observability=False):
         # async_engine stays EXPLICIT here (default False = the sync
         # baseline leg) even though round 14 flipped the predictor's own
         # default to async: the legacy/quant/spec/spmd legs are the
@@ -177,6 +187,13 @@ class _ChurnLeg:
         self.timed_from = 0
         self.decode_before = 0
         self.emitted_before = 0
+        # round 15: observability=True runs the timed windows with host
+        # tracing ENABLED (pack_dispatch/reconcile spans + per-request
+        # lanes recorded into the profiler buffer) — the traced half of
+        # the overhead A/B; the metrics registry is per-predictor and
+        # always on (its counters ARE these bench metrics)
+        self.observability = bool(observability)
+        self.trace_events = 0
 
     def top_up(self):
         # keep the lanes full: every finished request is replaced by a
@@ -205,18 +222,31 @@ class _ChurnLeg:
     def window(self, steps):
         """One timed measurement window. The sync engine pays one host
         sync per step; the async engine dispatches ahead and reconciles
-        behind-by-one / at the closing flush."""
+        behind-by-one / at the closing flush. ``observability=True``
+        windows run with the recorder open (spans + request lanes land in
+        the profiler buffer, drained per window so memory stays flat)."""
+        from paddle_tpu.profiler.record import recorder
+
         sp = self.sp
         sp.reset_perf_stats()
         w_emitted = sp.tokens_emitted
-        tw = time.perf_counter()
-        for _ in range(steps):
-            self.top_up()
-            t1 = time.perf_counter()
-            sp.step()
-            self.lat.append((time.perf_counter() - t1) * 1e3)
-        sp.flush()
-        dw = time.perf_counter() - tw
+        if self.observability:
+            recorder.enabled = True
+        try:
+            tw = time.perf_counter()
+            for _ in range(steps):
+                self.top_up()
+                t1 = time.perf_counter()
+                sp.step()
+                self.lat.append((time.perf_counter() - t1) * 1e3)
+            sp.flush()
+            dw = time.perf_counter() - tw
+        finally:
+            if self.observability:
+                recorder.enabled = False
+                self.trace_events += (len(recorder.events)
+                                      + len(recorder.aux))
+                recorder.clear()
         self.win_vals.append((sp.tokens_emitted - w_emitted) / dw)
         self.win_gaps.append(sp.step_gap_frac)
         self.win_host.append(sp.host_ms_per_step)
@@ -256,7 +286,17 @@ class _ChurnLeg:
             # round 13: the host-bubble metrics the async engine buys down
             step_gap_frac=round(float(np.median(self.win_gaps)), 4),
             host_ms_per_step=round(float(np.median(self.win_host)), 3),
+            # round 15: the schema-checked telemetry snapshot — the
+            # serving-stack registry (predictor + KV cache) flat export,
+            # so a per-RUN regression in e.g. prefix hits, preemptions or
+            # draft rollback pages is visible in the line itself
+            telemetry=sp.telemetry(),
         )
+        if self.observability:
+            # traced leg: how many host events the windows recorded
+            # (spans + request-lane phases — 0 would mean the tracing
+            # leg silently measured nothing)
+            out["trace_events"] = self.trace_events
         # per-arrival-index greedy emission streams + finished flag (NOT
         # part of the JSON line): main() compares the async leg's streams
         # against the sync leg's for the bit-identity gate — FULL
@@ -321,6 +361,35 @@ def bench_serving_ab(*, steps, windows, **leg_kw):
             sync_leg.window(steps)
             async_leg.window(steps)
     return sync_leg.report(), async_leg.report()
+
+
+def bench_serving_obs_ab(*, steps, windows, **leg_kw):
+    """The round-15 observability-overhead pair: the SAME churn with host
+    tracing OFF (the disabled-path baseline — spans are one flag check)
+    vs ON (spans + per-request lanes recorded every step), windows
+    interleaved like the engine A/B so machine drift hits both alike.
+    Returns ``(off_out, on_out, ratio)`` where ``ratio`` is the median of
+    the PAIRED per-window on/off ratios — pairing adjacent windows
+    cancels slow drift a ratio-of-medians would alias. The smoke gate
+    holds it near 1.0 as the gross-regression guard; the strict 2%
+    disabled-path contract is deterministic-gated in
+    tests/test_observability.py (an end-to-end 2% tokens/s assertion is
+    below the A/A noise floor of a small shared CI box)."""
+    # the ASYNC engine (the round-14 production default): host-side span/
+    # counter cost matters precisely where host scheduling is the
+    # overlapped resource — tracing must not re-open the host bubble
+    off_leg = _ChurnLeg(observability=False, async_engine=True, **leg_kw)
+    on_leg = _ChurnLeg(observability=True, async_engine=True, **leg_kw)
+    off_leg.warm()
+    on_leg.warm()
+    with _gc_frozen():
+        for _ in range(windows):
+            off_leg.window(steps)
+            on_leg.window(steps)
+    paired = [a / b for a, b in zip(on_leg.win_vals, off_leg.win_vals)
+              if b > 0]
+    ratio = round(float(np.median(paired)), 3) if paired else 0.0
+    return off_leg.report(), on_leg.report(), ratio
 
 
 def main():
@@ -405,6 +474,9 @@ def main():
         # reconcile vs one blocking sync per step; measured as one
         # interleaved pair, greedy emissions bit-identical
         ("unified-async", None),
+        # round-15 A/B: the SAME churn with host tracing off vs on —
+        # the observability overhead contract, measured interleaved
+        ("unified-obs", None),
         ("unified-spmd", dict(unified=True, mesh_chips=n_mp)),
         # round-12 speculation A/B: the SAME repetitive-prompt churn with
         # drafting off (the 1.0-tokens/lane-step anchor) vs k=4
@@ -421,6 +493,15 @@ def main():
         return (f"{FLAGSHIP_METRIC} ({label} prompt{shape['prompt']}"
                 f"+{shape['steps']} steps, {chip}) [{name}]")
 
+    def ab_metric_for(name):
+        # the interleaved A/B pairs run the FLOORED workload: their
+        # metric label must say so, not inherit the shared shape's
+        return ((f"{FLAGSHIP_METRIC} (smoke bs{ab_shape['batch']}"
+                 if smoke else
+                 f"{FLAGSHIP_METRIC} (gpt3-125m bs{ab_shape['batch']}")
+                + (f" prompt{ab_shape['prompt']}+{ab_kw['steps']}x"
+                   f"{ab_kw['windows']} steps, {chip}) [{name}]"))
+
     for name, over in legs:
         if not runnable:
             print(_error_line(
@@ -432,15 +513,7 @@ def main():
                 sync_out, async_out = bench_serving_ab(
                     unified=True, on_tpu=on_tpu, use_kernel=use_kernel,
                     **ab_shape, **ab_kw)
-                # the pair runs the FLOORED workload: its metric label
-                # must say so, not inherit the shared shape's
-                ab_metric = (
-                    f"{FLAGSHIP_METRIC} (smoke bs{ab_shape['batch']}"
-                    if smoke else
-                    f"{FLAGSHIP_METRIC} (gpt3-125m bs{ab_shape['batch']}"
-                ) + (f" prompt{ab_shape['prompt']}+{ab_kw['steps']}x"
-                     f"{ab_kw['windows']} steps, {chip}) [{name}]")
-                out = dict(metric=ab_metric, **async_out)
+                out = dict(metric=ab_metric_for(name), **async_out)
                 # the paired sync stats ride the async line — its strict
                 # gates (tokens/s higher, gap lower, streams identical)
                 # compare within the interleaved pair, one workload
@@ -464,6 +537,18 @@ def main():
                 common = set(a) & set(b)
                 out["async_emissions_match"] = float(
                     bool(common) and all(_same(i) for i in common))
+                results[name] = out
+            elif name == "unified-obs":
+                off_out, on_out, ratio = bench_serving_obs_ab(
+                    unified=True, on_tpu=on_tpu, use_kernel=use_kernel,
+                    **ab_shape, **ab_kw)
+                out = dict(metric=ab_metric_for(name), **on_out)
+                # the untraced partner rides the traced line; vs_baseline
+                # IS the overhead ratio (paired-window median — the
+                # round-15 contract holds it near 1.0: tracing must not
+                # buy back the async wins)
+                out["obs_off_tokens_per_s"] = off_out["value"]
+                out["vs_baseline"] = ratio
                 results[name] = out
             else:
                 out = bench_serving(on_tpu=on_tpu, use_kernel=use_kernel,
@@ -506,6 +591,7 @@ def main():
     _emit("legacy-two-jit", None)
     _emit("unified-step", "legacy-two-jit")
     _emit("unified-async", None)
+    _emit("unified-obs", None)
     _emit("unified-spmd", "unified-step")
     _emit("unified-spec-base", None)
     _emit("unified-spec-k4", "unified-spec-base")

@@ -132,6 +132,7 @@ def __getattr__(name):
         "vision",
         "distribution",
         "incubate",
+        "observability",
         "profiler",
         "sparse",
         "hapi",

@@ -30,6 +30,13 @@ Rule catalog:
   ``apply_op``/``make_op`` with no ``framework/op_registry.py`` row (the
   source-scan gate of ``tests/test_op_registry.py``, generalized so the CLI
   reports it with file/line instead of one assert blob).
+- **AL006 raw-timing** — ``time.perf_counter()`` / ``perf_counter_ns()``
+  in ``paddle_tpu/inference/`` or ``paddle_tpu/distributed/`` outside the
+  observability layer: hot-path timing belongs to
+  ``observability.monotonic()`` (and the span API) so instrumented
+  durations, trace timestamps and bench windows share ONE clock — the
+  round-15 rule that keeps ad-hoc ``_t0 = time.perf_counter()`` fields
+  from re-accreting in the serving/collective hot paths.
 """
 from __future__ import annotations
 
@@ -44,6 +51,8 @@ AL002 = rule("AL002", "host sync (.item()/np.asarray/int()) inside a jitted fn")
 AL003 = rule("AL003", "Python for-loop over a tensor dim inside a jitted fn")
 AL004 = rule("AL004", "pl.BlockSpec tile constant not (8,128)-aligned")
 AL005 = rule("AL005", "apply_op/make_op name with no op-registry row")
+AL006 = rule("AL006", "raw time.perf_counter timing outside the "
+                      "observability layer")
 
 _SAMPLERS = {
     "normal", "uniform", "bernoulli", "randint", "truncated_normal",
@@ -341,11 +350,39 @@ class _FileLint(ast.NodeVisitor):
                     "registry row — add it to framework/op_registry.py",
                     n)
 
+    # -- AL006 raw timing in the serving/distributed hot paths ---------------
+
+    #: directories whose timing must route through observability.monotonic
+    #: (trailing slash: a sibling like inference_tools.py is NOT fenced)
+    _TIMED_DIRS = ("paddle_tpu/inference/", "paddle_tpu/distributed/")
+    _TIMING_CALLS = ("time.perf_counter", "time.perf_counter_ns",
+                     "perf_counter", "perf_counter_ns")
+
+    def check_raw_timing(self):
+        path = self.path.replace(os.sep, "/")
+        if not any(path.startswith(d) for d in self._TIMED_DIRS):
+            return
+        if "/observability/" in path:
+            return   # the one layer that OWNS the clock
+        for n in ast.walk(self.tree):
+            if not isinstance(n, ast.Call):
+                continue
+            dn = _dotted(n.func)
+            if dn in self._TIMING_CALLS:
+                self._emit(
+                    AL006, dn,
+                    f"raw '{dn}()' in {path}: hot-path timing routes "
+                    "through paddle_tpu.observability (monotonic()/span()) "
+                    "so durations, traces and bench windows share one "
+                    "clock",
+                    n)
+
     def run(self):
         self.check_rng_reuse()
         self.check_jitted_bodies()
         self.check_blockspec_tiles()
         self.check_unregistered_ops()
+        self.check_raw_timing()
         return self.findings
 
 

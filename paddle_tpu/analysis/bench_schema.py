@@ -57,7 +57,12 @@ _OPTIONAL_NUMERIC = ("vs_baseline", "p50_ms", "p99_ms", "anchor_tflops",
                      # byte-identical)
                      "bytes_on_the_wire", "bytes_on_the_wire_fp",
                      "wire_reduction", "loss_parity_delta",
-                     "replicas_bit_identical")
+                     "replicas_bit_identical",
+                     # round 15: the observability A/B — tokens/s of the
+                     # untraced (observability-disabled) interleaved
+                     # partner riding the traced leg's line, and the
+                     # host trace events the traced windows recorded
+                     "obs_off_tokens_per_s", "trace_events")
 _OPTIONAL_STRING = ("mesh_shape", "comm_quant")
 
 
@@ -88,6 +93,28 @@ def validate_line(obj) -> list[str]:
                             f"got {obj[key]!r}")
     if "error" in obj and not isinstance(obj["error"], str):
         problems.append(f"key 'error' must be a string, got {obj['error']!r}")
+    # round 15: the telemetry snapshot sub-object (the flat
+    # MetricsRegistry.snapshot_flat() export riding bench lines) — a
+    # non-finite counter or a non-numeric value fails at the bench, so a
+    # regression in e.g. prefix hits or wire bytes stays machine-diffable
+    if "telemetry" in obj:
+        problems.extend(_telemetry_problems(obj["telemetry"]))
+    return problems
+
+
+def _telemetry_problems(tel) -> list[str]:
+    if not isinstance(tel, dict) or not tel:
+        return [f"key 'telemetry' must be a non-empty flat object, "
+                f"got {tel!r}"]
+    problems = []
+    for k, v in tel.items():
+        if not isinstance(k, str) or not k.strip():
+            problems.append(f"telemetry key {k!r} must be a non-empty "
+                            "string")
+        if not (isinstance(v, (int, float)) and not isinstance(v, bool)
+                and math.isfinite(v)):
+            problems.append(f"telemetry['{k}'] must be a finite number, "
+                            f"got {v!r}")
     return problems
 
 

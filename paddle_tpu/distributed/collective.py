@@ -42,6 +42,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..observability import default_registry
 from ..tensor.tensor import Tensor
 from ..autograd.engine import apply_op
 from .mesh import in_spmd_region
@@ -178,6 +179,36 @@ def is_available() -> bool:
 # collectives
 # ---------------------------------------------------------------------------
 
+
+def _count_wire(op_name: str, tensor, g, quant=None) -> None:
+    """Round-15 telemetry: analytic per-rank wire bytes of one gradient-
+    sized collective (the round-14 ``bytes_on_the_wire`` ring model) onto
+    the library-wide observability registry — off by default, one flag
+    check when disabled. Host-side counting only: the eager path counts
+    per call; a collective traced inside an SPMD region counts once per
+    TRACE (the compiled program's wire cost, not per execution)."""
+    if not default_registry.enabled or g.nranks <= 1:
+        return
+    data = tensor._data if hasattr(tensor, "_data") else tensor
+    try:
+        n = int(np.prod(data.shape))
+        eb = jnp.dtype(data.dtype).itemsize
+    except Exception:
+        return   # shapeless input: the op itself will diagnose
+    if not in_spmd_region(g.axis_name):
+        n = max(1, n // g.nranks)   # eager rank-major stack: per-rank N
+    from .compressed_collectives import bytes_on_the_wire
+
+    wire = bytes_on_the_wire(n, g.nranks, elem_bytes=eb, quant=quant)
+    default_registry.counter(
+        "collective_wire_bytes", "analytic per-rank wire bytes",
+        labels=("op", "quant")).labels(
+            op=op_name, quant="int8" if quant else "fp").inc(wire)
+    default_registry.counter(
+        "collective_calls", "monitored collective invocations",
+        labels=("op",)).labels(op=op_name).inc()
+
+
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True, quant=None):
     """SUM/MAX/... across the group.
 
@@ -198,6 +229,7 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True, quant=None):
     """
     g = _resolve_group(group)
     _validate_reduce_op(op, quant=quant, where="all_reduce")
+    _count_wire("all_reduce", tensor, g, quant)
     if quant is not None:
         return _all_reduce_quant(tensor, op, g, quant)
     if in_spmd_region(g.axis_name):

@@ -16,7 +16,12 @@ cross-process operations:
   origin rank/op/seq named (error-propagation parity: a hung cluster turns
   into an immediate, attributable exception instead of a silent stall);
 - an optional daemon thread polls for peer errors between collectives
-  (the reference's watchdog-thread shape) and trips an Event.
+  (the reference's watchdog-thread shape) and trips an Event;
+- round 15: every arrival/timeout/peer-failure feeds the observability
+  metrics registry (counters labeled by group/op — ``metrics=`` defaults
+  to the library-wide ``observability.default_registry``, off until
+  ``enable_metrics()``), so a fleet dashboard sees WHICH collective of
+  WHICH group is timing out without parsing exception strings.
 """
 from __future__ import annotations
 
@@ -24,6 +29,8 @@ import pickle
 import threading
 import time
 from contextlib import contextmanager
+
+from ..observability import default_registry
 
 
 class CommError(RuntimeError):
@@ -49,7 +56,8 @@ class CommWatchdog:
     """
 
     def __init__(self, store, rank: int, world_size: int,
-                 default_timeout: float = 30.0, group_tag: str = "default"):
+                 default_timeout: float = 30.0, group_tag: str = "default",
+                 metrics=None):
         self.store = store
         self.rank = int(rank)
         self.world_size = int(world_size)
@@ -60,6 +68,37 @@ class CommWatchdog:
         self._stop = threading.Event()
         self.peer_failed = threading.Event()
         self.last_error: CommError | None = None
+        # round-15 telemetry: labeled counters on the observability
+        # registry (default: the library-wide one, off until enabled)
+        self.metrics = metrics if metrics is not None else default_registry
+        labels = ("group", "op")
+        self._m_arrivals = self.metrics.counter(
+            "comm_watchdog_arrivals", "monitored collectives entered",
+            labels=labels)
+        self._m_timeouts = self.metrics.counter(
+            "comm_watchdog_timeouts", "collectives that timed out here",
+            labels=labels)
+        self._m_peer_failures = self.metrics.counter(
+            "comm_watchdog_peer_failures",
+            "distinct peer-broadcast errors observed by this watchdog",
+            labels=labels)
+        # (rank, op, seq) of peer errors already counted: the broadcast
+        # record persists in the store and every subsequent collective
+        # (and the monitor thread) re-reads it — the counter tracks
+        # DISTINCT origin events, not re-observations
+        self._counted_errs: set[tuple] = set()
+        self._err_lock = threading.Lock()   # monitor thread vs foreground
+
+    def _count(self, family, op: str) -> None:
+        family.labels(group=self.group_tag, op=op).inc()
+
+    def _count_peer_failure(self, rec: dict) -> None:
+        key = (rec.get("rank"), rec.get("op"), rec.get("seq"))
+        with self._err_lock:
+            if key in self._counted_errs:
+                return
+            self._counted_errs.add(key)
+        self._count(self._m_peer_failures, str(rec.get("op", "?")))
 
     # -- keys --------------------------------------------------------------
     def _err_key(self) -> str:
@@ -79,6 +118,8 @@ class CommWatchdog:
                 f"group '{self.group_tag}'): {rec['message']}")
             self.last_error = err
             self.peer_failed.set()
+            # attribute the fail-fast to the ORIGIN collective, once
+            self._count_peer_failure(rec)
             raise err
 
     def _broadcast_error(self, op: str, seq: int, message: str) -> None:
@@ -104,6 +145,7 @@ class CommWatchdog:
         tmo = self.default_timeout if timeout is None else float(timeout)
         base = self._base(op, seq)
         self.store.set(f"{base}/arrived/{self.rank}", b"1")
+        self._count(self._m_arrivals, op)
 
         class _Task:
             def __init__(self, timeout):
@@ -121,6 +163,7 @@ class CommWatchdog:
                 f"'{self.group_tag}') timed out after {time.time() - t0:.1f}s"
                 f"; ranks never arrived: {missing or 'unknown'}")
             self._broadcast_error(op, seq, msg)
+            self._count(self._m_timeouts, op)
             err = CommTimeout(msg)
             self.last_error = err
             raise err from e
@@ -187,6 +230,11 @@ class CommWatchdog:
                             f"reported failure of '{rec['op']}' "
                             f"(seq {rec['seq']}): {rec['message']}")
                         self.peer_failed.set()
+                        # the monitor thread counts too (cross-thread
+                        # safe via the registry lock), deduped against
+                        # the foreground path's observation of the same
+                        # origin event
+                        self._count_peer_failure(rec)
                         return
                 except Exception:
                     return  # store gone (shutdown)

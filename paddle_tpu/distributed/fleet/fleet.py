@@ -191,12 +191,11 @@ def collective_perf(comm_type: str = "allreduce", round: int = 50, size_and_time
     Reference fleet.py:367-603 sweeps 1MB→1GB with thresholds; this is the
     measurement tool for BASELINE's collective table.
     """
-    import time
-
     import jax
     import jax.numpy as jnp
 
     from ..collective import ReduceOp, _init_default_group, all_reduce
+    from ...observability import monotonic
     from ...tensor.tensor import Tensor
 
     g = _init_default_group()
@@ -207,9 +206,9 @@ def collective_perf(comm_type: str = "allreduce", round: int = 50, size_and_time
         x = Tensor(jnp.ones((g.nranks, max(n_elem // g.nranks, 1)), jnp.float32))
         all_reduce(x, group=g)  # warmup + compile
         jax.block_until_ready(x._data)
-        t0 = time.perf_counter()
+        t0 = monotonic()
         for _ in range(round):
             all_reduce(x, group=g)
         jax.block_until_ready(x._data)
-        results[size] = (time.perf_counter() - t0) / round
+        results[size] = (monotonic() - t0) / round
     return results

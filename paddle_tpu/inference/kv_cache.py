@@ -195,7 +195,7 @@ class KVCacheManager:
     def __init__(self, num_layers, num_kv_heads, head_dim, *, num_pages,
                  max_batch, max_seq_len, page_size=None, num_q_heads=None,
                  dtype=jnp.float32, enable_prefix_cache=False,
-                 quantize_kv=False, mesh=None):
+                 quantize_kv=False, mesh=None, metrics=None):
         from ..ops.pallas.paged_attention import preferred_page_size
 
         if page_size is None:
@@ -268,8 +268,54 @@ class KVCacheManager:
         self._page_key: dict[int, bytes] = {}    # page -> chain key
         self._prefix_pages: dict[bytes, int] = {}  # chain key -> page
         self._lru: OrderedDict[int, None] = OrderedDict()
-        self.prefix_hit_tokens = 0
-        self.prefix_query_tokens = 0
+        # round 15: pool telemetry — occupancy gauges + prefix/eviction/
+        # CoW counters on the observability registry (the serving
+        # predictor shares its registry so one snapshot covers the stack)
+        from ..observability import MetricsRegistry
+
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
+        if not self.metrics.enabled:
+            # prefix_hit_tokens/prefix_query_tokens read through these
+            # counters — a disabled registry silently zeroes them
+            raise ValueError(
+                "KVCacheManager requires an enabled metrics registry; "
+                "the one passed is disabled")
+        m = self.metrics
+        self._m_pages_free = m.gauge(
+            "kv_pages_free", "strictly-free pool pages")
+        self._m_pages_evictable = m.gauge(
+            "kv_pages_evictable", "zero-ref registered pages on the LRU")
+        self._m_slots_free = m.gauge(
+            "kv_slots_free", "unoccupied decode slots")
+        self._m_prefix_hit = m.counter(
+            "kv_prefix_hit_tokens", "admitted tokens served from the cache")
+        self._m_prefix_query = m.counter(
+            "kv_prefix_query_tokens", "admitted tokens queried")
+        self._m_evictions = m.counter(
+            "kv_prefix_evictions", "registered pages evicted off the LRU")
+        self._m_cow = m.counter(
+            "kv_cow_copies", "copy-on-write page copies prepared")
+        self._m_trimmed = m.counter(
+            "kv_pages_trimmed", "pages released by draft rollback")
+        self._note_occupancy()
+
+    def _note_occupancy(self) -> None:
+        """Refresh the pool-occupancy gauges (called by every public
+        mutator — page events per step are few, so three gauge sets are
+        noise next to the allocation work itself)."""
+        self._m_pages_free.set(len(self._free_pages))
+        self._m_pages_evictable.set(len(self._lru))
+        self._m_slots_free.set(len(self._free_slots))
+
+    # -- back-compat metric reads (pre-round-15 attribute surface) ---------
+
+    @property
+    def prefix_hit_tokens(self) -> int:
+        return int(self._m_prefix_hit.value)
+
+    @property
+    def prefix_query_tokens(self) -> int:
+        return int(self._m_prefix_query.value)
 
     # -- capacity ----------------------------------------------------------
 
@@ -304,6 +350,7 @@ class KVCacheManager:
         if self._lru:
             page, _ = self._lru.popitem(last=False)   # oldest
             del self._prefix_pages[self._page_key.pop(page)]
+            self._m_evictions.inc()
             return page
         raise RuntimeError("cache exhausted: no free or evictable pages")
 
@@ -343,6 +390,7 @@ class KVCacheManager:
         self._seq_lens[slot] = prompt_len
         self._pt_rev += 1
         self._sl_rev += 1
+        self._note_occupancy()
         return slot
 
     def ensure_capacity(self, slot: int, new_len: int) -> bool:
@@ -362,6 +410,7 @@ class KVCacheManager:
             self._page_table[slot, i] = page
             self._refcount[page] = 1
         self._pt_rev += 1
+        self._note_occupancy()
         return True
 
     def advance(self, slot: int, n: int = 1) -> None:
@@ -440,6 +489,8 @@ class KVCacheManager:
             freed += 1
         if freed:
             self._pt_rev += 1
+            self._m_trimmed.inc(freed)
+            self._note_occupancy()
         return freed
 
     def free(self, slot: int) -> None:
@@ -454,6 +505,7 @@ class KVCacheManager:
         self._pt_rev += 1
         self._sl_rev += 1
         self._free_slots.append(slot)
+        self._note_occupancy()
 
     # -- prefix cache ------------------------------------------------------
 
@@ -536,8 +588,8 @@ class KVCacheManager:
             raise RuntimeError(
                 f"cache exhausted: need {need_fresh} pages, "
                 f"{available} free")
-        self.prefix_query_tokens += n
-        self.prefix_hit_tokens += matched
+        self._m_prefix_query.inc(n)
+        self._m_prefix_hit.inc(matched)
         slot = self._free_slots.pop()
         for i, page in enumerate(shared):
             self._page_table[slot, i] = page
@@ -551,6 +603,7 @@ class KVCacheManager:
         self._seq_lens[slot] = matched
         self._pt_rev += 1
         self._sl_rev += 1
+        self._note_occupancy()
         return slot, matched
 
     def register_prefix(self, slot: int, tokens, include_tail=True) -> None:
@@ -614,6 +667,8 @@ class KVCacheManager:
         self._page_table[slot, i] = dst
         self._pt_rev += 1
         self._refcount[page] -= 1   # >= 1 left: stays pinned, registered
+        self._m_cow.inc()
+        self._note_occupancy()
         return page, dst
 
     # -- device views ------------------------------------------------------

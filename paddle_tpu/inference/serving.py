@@ -80,13 +80,16 @@ complete any request).
 """
 from __future__ import annotations
 
-import time
 from collections import deque
 
 import numpy as np
 
 import jax.numpy as jnp
 
+from ..observability import (MetricsRegistry, counter_event, monotonic,
+                             request_begin, request_end, request_event,
+                             span, tracing_active)
+from ..profiler.record import recorder as _recorder
 from .kv_cache import KVCacheManager, kv_cache_quantized, pages_needed
 
 WAITING, RUNNING, FINISHED = "waiting", "running", "finished"
@@ -123,7 +126,7 @@ class Request:
         self.preempt_count = 0
         self.truncated = False  # stopped by the max_seq_len ceiling
         # serving metrics: time-to-first-token + prefix-cache hit size
-        self.submit_time = time.perf_counter()
+        self.submit_time = monotonic()
         self.first_token_time: float | None = None
         self.cached_prefix_len = 0   # tokens served from the prefix cache
         self._registered = False     # prompt pages in the prefix registry
@@ -195,7 +198,7 @@ class ServingPredictor:
                  dtype=None, unified=True, chunk=None, token_budget=None,
                  prefix_cache=None, kv_cache_dtype=None, mesh=None,
                  spec_decode_k=None, async_engine=None,
-                 max_inflight_steps=4):
+                 max_inflight_steps=4, metrics=None):
         from ..distributed.mesh import as_serving_mesh
         from ..models.gpt import (_serving_params_cached, build_decode_step,
                                   build_prefill, build_unified_step,
@@ -204,6 +207,22 @@ class ServingPredictor:
         gpt = model.gpt if hasattr(model, "gpt") else model
         self.config = gpt.config
         cfg = self.config
+        # round 15: the structured metrics registry — every counter/timer
+        # this predictor used to keep as ad-hoc attributes lives here
+        # (always-enabled by default: these ARE the bench metrics), shared
+        # with the KV cache manager so ONE snapshot covers the serving
+        # stack; back-compat read properties keep the round-13/14 surface
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
+        if not self.metrics.enabled:
+            # these counters BACK the behavioral read surface
+            # (tokens_emitted/steps/TTFT/step_gap_frac/telemetry): a
+            # disabled registry would silently report zeros — fail loud
+            # (the library-wide default_registry is off by default; pass
+            # a dedicated MetricsRegistry() or enable it first)
+            raise ValueError(
+                "ServingPredictor requires an enabled metrics registry; "
+                "the one passed is disabled")
+        self._init_instruments()
         # round 11: mesh (None | int mp degree | Mesh(("mp",))) serves the
         # steps tensor-parallel — params + KV pools sharded by head, the
         # scheduler and page/slot/prefix bookkeeping below stay host-global
@@ -257,7 +276,7 @@ class ServingPredictor:
             max_seq_len=self.max_seq_len, page_size=page_size,
             num_q_heads=cfg.num_heads, dtype=kv_dtype,
             enable_prefix_cache=prefix_cache, quantize_kv=self.kv_quant,
-            mesh=self.mesh)
+            mesh=self.mesh, metrics=self.metrics)
         self.chunk = int(chunk or preferred_chunk_size(
             cfg.num_heads, cfg.num_heads, cfg.head_dim, kv_dtype))
         # round 12: speculative decoding — build geometry for the verify
@@ -311,7 +330,6 @@ class ServingPredictor:
                 "the async engine rides the unified step's device-resident "
                 "token feedback; the legacy two-jit path serves sync only")
         self._inflight: deque[_Pending] = deque()
-        self.hard_syncs = 0      # step()/flush() calls that materialized
         self._did_sync = False   # set by _reconcile_one, charged per call
         self.waiting: deque[Request] = deque()
         self.running: dict[int, Request] = {}   # slot -> request
@@ -333,28 +351,114 @@ class ServingPredictor:
         # steady-decode pack cache (async): previous step's device arrays
         # re-served while the schedule signature holds
         self._steady: dict | None = None
-        self.steady_hits = 0
         self._base_keys: dict[int, np.ndarray] = {}   # req_id -> PRNGKey
         # perf accounting (bench_serve step_gap_frac / host_ms_per_step):
         # wall-clock intervals with NO dispatched-unmaterialized step are
-        # the host-observable upper bound on device idle between steps
+        # the host-observable upper bound on device idle between steps;
+        # the accumulated durations live on the registry, the window marks
+        # (reset_perf_stats) stay plain timestamps
         self._span_start = None
         self._last_event = None
         self._idle_since = None
-        self._gap_time = 0.0
-        self._step_time = 0.0
-        self._sync_time = 0.0
-        self._perf_steps = 0
+        self._w_marks = {"step_s": 0.0, "sync_s": 0.0, "gap_s": 0.0,
+                         "calls": 0.0}
         # req_id -> DraftProposer (kept across preemption — the request's
         # context replays identically, so the table stays consistent)
         self._drafts: dict[int, object] = {}
-        # speculative metrics: per completing DECODE lane-step
-        self.spec_lane_steps = 0     # decode lane-steps while spec is on
-        self.spec_emitted = 0        # tokens actually emitted by them
-        self.spec_proposed = 0       # draft tokens proposed
-        self.spec_accepted = 0       # draft tokens accepted by verify
-        self.tokens_emitted = 0      # every token emitted (all paths)
-        self.steps = 0
+        # req_id -> recorder generation of its recorded lane 'b' (tracing
+        # only): a lane is OPEN iff its generation matches the recorder's
+        # CURRENT one — a window clear discards recorded begins, so a
+        # stale entry means "re-open before emitting" (each RECORD window
+        # must be self-consistent: no 'n'/'e' without an in-window 'b')
+        self._traced_reqs: dict[int, int] = {}
+
+    def _init_instruments(self):
+        """Declare this predictor's registry instruments (round 15). The
+        names are the snapshot/telemetry schema ARCHITECTURE.md documents;
+        the back-compat properties below read them."""
+        m = self.metrics
+        self._m_steps = m.counter(
+            "serving_steps", "scheduler rounds that dispatched a step")
+        self._m_step_calls = m.counter(
+            "serving_step_calls", "step() invocations (perf-window unit)")
+        self._m_tokens = m.counter(
+            "serving_tokens_emitted", "tokens emitted, all paths")
+        self._m_hard_syncs = m.counter(
+            "serving_hard_syncs", "step()/flush() calls that materialized")
+        self._m_steady = m.counter(
+            "serving_steady_hits", "async steady-decode pack-cache hits")
+        self._m_preempt = m.counter(
+            "serving_preemptions", "requests preempted back to the queue")
+        self._m_admitted = m.counter(
+            "serving_requests_admitted", "admissions incl. replay")
+        self._m_finished = m.counter(
+            "serving_requests_finished", "requests reaching FINISHED")
+        self._m_step_s = m.counter(
+            "serving_step_seconds", "host wall seconds inside step()/flush()")
+        self._m_sync_s = m.counter(
+            "serving_sync_seconds", "seconds blocked materializing outputs")
+        self._m_gap_s = m.counter(
+            "serving_gap_seconds", "wall seconds with no step in flight")
+        self._m_ttft = m.histogram(
+            "serving_ttft_ms", "submit -> first generated token",
+            buckets=(1, 2, 5, 10, 25, 50, 100, 250, 1000, 5000))
+        self._m_inflight = m.gauge(
+            "serving_inflight_depth", "dispatched-unreconciled steps")
+        self._m_running = m.gauge(
+            "serving_running_lanes", "slots in RUNNING after a step")
+        self._m_waiting = m.gauge(
+            "serving_waiting_requests", "queued requests after a step")
+        # speculative decoding: per completing DECODE lane-step
+        self._m_spec_lane_steps = m.counter(
+            "serving_spec_lane_steps", "decode lane-steps while spec is on")
+        self._m_spec_emitted = m.counter(
+            "serving_spec_tokens_emitted", "tokens emitted by spec lanes")
+        self._m_draft_proposed = m.counter(
+            "serving_draft_proposed", "draft tokens proposed")
+        self._m_draft_accepted = m.counter(
+            "serving_draft_accepted", "draft tokens accepted by verify")
+        self._m_draft_rollback = m.counter(
+            "serving_draft_rollback_pages", "over-allocated pages trimmed")
+
+    # -- back-compat metric reads (pre-round-15 attribute surface) ---------
+
+    @property
+    def steps(self) -> int:
+        return int(self._m_steps.value)
+
+    @property
+    def tokens_emitted(self) -> int:
+        return int(self._m_tokens.value)
+
+    @property
+    def hard_syncs(self) -> int:
+        return int(self._m_hard_syncs.value)
+
+    @property
+    def steady_hits(self) -> int:
+        return int(self._m_steady.value)
+
+    @property
+    def spec_lane_steps(self) -> int:
+        return int(self._m_spec_lane_steps.value)
+
+    @property
+    def spec_emitted(self) -> int:
+        return int(self._m_spec_emitted.value)
+
+    @property
+    def spec_proposed(self) -> int:
+        return int(self._m_draft_proposed.value)
+
+    @property
+    def spec_accepted(self) -> int:
+        return int(self._m_draft_accepted.value)
+
+    def telemetry(self) -> dict[str, float]:
+        """Flat snapshot of the serving-stack registry (predictor + KV
+        cache instruments) — the ``telemetry`` sub-object bench_serve
+        rides on its JSON lines."""
+        return self.metrics.snapshot_flat()
 
     # -- queue API ---------------------------------------------------------
 
@@ -413,20 +517,25 @@ class ServingPredictor:
     def _mark_dispatch(self) -> None:
         """A step was dispatched: any interval since the pipeline last
         drained was a host-side bubble the device could not fill."""
-        now = time.perf_counter()
+        now = monotonic()
         if self._span_start is None:
             self._span_start = now
         if self._idle_since is not None:
-            self._gap_time += now - self._idle_since
+            self._m_gap_s.inc(now - self._idle_since)
             self._idle_since = None
         self._last_event = now
 
     def _mark_drained(self) -> None:
         """No dispatched-unmaterialized work remains: the device has
         nothing of ours to run until the next dispatch."""
-        now = time.perf_counter()
+        now = monotonic()
         self._idle_since = now
         self._last_event = now
+
+    def _window(self, key: str, counter) -> float:
+        """A duration counter's accumulation since the last
+        :meth:`reset_perf_stats` (the bench measurement window)."""
+        return max(0.0, counter.value - self._w_marks[key])
 
     @property
     def step_gap_frac(self) -> float:
@@ -438,33 +547,37 @@ class ServingPredictor:
         dispatch after :meth:`reset_perf_stats`."""
         if self._span_start is None or self._last_event is None:
             return 0.0
-        span = self._last_event - self._span_start
-        if span <= 0:
+        window = self._last_event - self._span_start
+        if window <= 0:
             return 0.0
-        return min(1.0, self._gap_time / span)
+        return min(1.0, self._window("gap_s", self._m_gap_s) / window)
 
     @property
     def host_ms_per_step(self) -> float:
         """Host milliseconds spent per ``step()`` OUTSIDE the blocking
         device waits — the scheduling/bookkeeping cost the async engine
         overlaps with device execution."""
-        if not self._perf_steps:
+        calls = self._window("calls", self._m_step_calls)
+        if not calls:
             return 0.0
-        return max(0.0, (self._step_time - self._sync_time) * 1e3
-                   / self._perf_steps)
+        busy = (self._window("step_s", self._m_step_s)
+                - self._window("sync_s", self._m_sync_s))
+        return max(0.0, busy * 1e3 / calls)
 
     def reset_perf_stats(self) -> None:
-        """Start a fresh measurement window (bench: call after warmup)."""
+        """Start a fresh measurement window (bench: call after warmup).
+        The registry counters are monotonic; the window is their delta
+        against the marks taken here."""
         self._span_start = None
         self._last_event = None
-        self._idle_since = None if self._inflight else time.perf_counter()
+        self._idle_since = None if self._inflight else monotonic()
         if self._idle_since is not None:
             self._span_start = self._idle_since
             self._last_event = self._idle_since
-        self._gap_time = 0.0
-        self._step_time = 0.0
-        self._sync_time = 0.0
-        self._perf_steps = 0
+        self._w_marks = {"step_s": self._m_step_s.value,
+                         "sync_s": self._m_sync_s.value,
+                         "gap_s": self._m_gap_s.value,
+                         "calls": self._m_step_calls.value}
 
     # -- shared scheduler internals ----------------------------------------
 
@@ -480,6 +593,9 @@ class ServingPredictor:
         req.preempt_count += 1
         req._registered = False   # fresh pages on replay; re-register
         self.waiting.appendleft(req)
+        self._m_preempt.inc()
+        self._req_event(req.req_id, "preempt",
+                        args={"count": req.preempt_count})
         return True
 
     def _finish(self, req: Request) -> None:
@@ -489,6 +605,15 @@ class ServingPredictor:
         req.state = FINISHED
         self._base_keys.pop(req.req_id, None)
         self._drafts.pop(req.req_id, None)
+        self._m_finished.inc()
+        if tracing_active():
+            # close the request's async trace lane (admit -> ... -> eos);
+            # _req_event (re-)opens it if this window has no 'b' yet
+            self._req_event(req.req_id, "eos" if not req.truncated
+                            else "truncated",
+                            args={"outputs": len(req.output_ids)})
+            request_end(req.req_id)
+        self._traced_reqs.pop(req.req_id, None)
 
     def _retire_finished(self) -> None:
         for slot in [s for s, r in self.running.items() if r.done]:
@@ -544,7 +669,35 @@ class ServingPredictor:
         req.cached_prefix_len = cached
         req.state = RUNNING
         self.running[slot] = req
+        self._note_admit(req, slot, cached)
         return True
+
+    def _note_admit(self, req, slot, cached) -> None:
+        """Telemetry for one (re-)admission: counter + the request's
+        async trace lane ('b' once per window; replays get an instant)."""
+        self._m_admitted.inc()
+        if not tracing_active():
+            return
+        already_open = self._lane_open(req.req_id)
+        self._req_event(req.req_id, "readmit" if already_open else "admit",
+                        args={"slot": slot, "cached_prefix": int(cached)})
+
+    def _lane_open(self, req_id) -> bool:
+        return self._traced_reqs.get(req_id) == _recorder.generation
+
+    def _req_event(self, req_id, name, args=None) -> None:
+        """An instant on one request's trace lane. The lane must be open
+        IN THE CURRENT RECORDER WINDOW — a 'b' recorded before a window
+        clear is gone from the buffer, and an 'n'/'e' without its 'b'
+        renders as an unmatched phase — so a stale (or absent) lane is
+        (re-)opened here first: every window's trace is self-consistent
+        and a request spanning windows appears in each of them."""
+        if not tracing_active():
+            return
+        if not self._lane_open(req_id):
+            if request_begin(req_id, args={"req_id": req_id}):
+                self._traced_reqs[req_id] = _recorder.generation
+        request_event(req_id, name, args=args)
 
     def _admit_waiting_unified(self) -> None:
         while self.waiting and self.cache.free_slot_count:
@@ -631,14 +784,15 @@ class ServingPredictor:
         """Materialize every in-flight step (the async engine's OUTPUT
         FLUSH — a hard sync boundary). Returns the landed tokens merged
         in emission order; no-op for the sync engine / legacy path."""
-        t0 = time.perf_counter()
+        t0 = monotonic()
         self._did_sync = False
         try:
-            return self._reconcile_all()
+            with span("flush"):
+                return self._reconcile_all()
         finally:
             if self._did_sync:
-                self.hard_syncs += 1
-            self._step_time += time.perf_counter() - t0
+                self._m_hard_syncs.inc()
+            self._m_step_s.inc(monotonic() - t0)
 
     def _reconcile_all(self) -> dict[int, list[int]]:
         produced: dict[int, list[int]] = {}
@@ -653,15 +807,28 @@ class ServingPredictor:
         (speculative advance + rollback). Count-based accounting (page
         growth, plain advance, prefix registration) already ran at pack
         time — this is the reconcile-behind half of the contract."""
+        with span("reconcile"):
+            return self._reconcile_one_impl()
+
+    def _note_first_token(self, req: Request) -> None:
+        req.first_token_time = monotonic()
+        self._m_ttft.observe((req.first_token_time - req.submit_time) * 1e3)
+        self._req_event(req.req_id, "first_token")
+
+    def _reconcile_one_impl(self) -> dict[int, list[int]]:
         e = self._inflight.popleft()
+        self._m_inflight.set(len(self._inflight))
+        # sample the ring-depth track on the way DOWN too — a trace of a
+        # drain (flush) must show the ring emptying, not stuck at max
+        counter_event("inflight_steps", len(self._inflight))
         cache = self.cache
         out = ne = None
         if e.completing:
-            t0 = time.perf_counter()
+            t0 = monotonic()
             out = np.asarray(e.out)
             if e.spec:
                 ne = np.asarray(e.ne)
-            self._sync_time += time.perf_counter() - t0
+            self._m_sync_s.inc(monotonic() - t0)
             self._did_sync = True
         if not self._inflight:
             self._mark_drained()
@@ -671,7 +838,7 @@ class ServingPredictor:
             # back to the pool (refcounts/free lists end identical to a
             # never-speculated run)
             cache.advance(slot, int(ne[slot]))
-            cache.trim_pages(slot)
+            self._m_draft_rollback.inc(cache.trim_pages(slot))
         produced: dict[int, list[int]] = {}
         for slot, req, k_i, was_decode in e.completing:
             if e.spec:
@@ -686,19 +853,22 @@ class ServingPredictor:
                 req.output_ids.append(tok)
                 emitted += 1
                 if req.first_token_time is None:
-                    req.first_token_time = time.perf_counter()
+                    self._note_first_token(req)
                 produced.setdefault(req.req_id, []).append(tok)
             if not e.spec:
                 # the pack charged ONE pending token per completing
                 # plain lane; it just landed (or dropped as overhang)
                 req._pending_n = max(0, req._pending_n - 1)
-            self.tokens_emitted += emitted
+            self._m_tokens.inc(emitted)
             if self.spec_k and was_decode:
                 acc = int(ne[slot]) - 1 if k_i else 0
-                self.spec_lane_steps += 1
-                self.spec_emitted += emitted
-                self.spec_proposed += k_i
-                self.spec_accepted += acc
+                self._m_spec_lane_steps.inc()
+                self._m_spec_emitted.inc(emitted)
+                self._m_draft_proposed.inc(k_i)
+                self._m_draft_accepted.inc(acc)
+                if k_i:
+                    self._req_event(req.req_id, "spec_accept",
+                                    args={"proposed": k_i, "accepted": acc})
                 prop = self._drafts.get(req.req_id)
                 if prop is not None:
                     prop.update(k_i, acc)
@@ -716,12 +886,15 @@ class ServingPredictor:
         if not self.running:
             self._merge_produced(produced, self._reconcile_all())
             return produced
-        entry = self._pack_dispatch()
+        with span("pack_dispatch"):
+            entry = self._pack_dispatch()
         if entry is None:
             self._merge_produced(produced, self._reconcile_all())
             return produced
         self._inflight.append(entry)
-        self.steps += 1
+        self._m_inflight.set(len(self._inflight))
+        counter_event("inflight_steps", len(self._inflight))
+        self._m_steps.inc()
         if not self.async_engine or self.spec_k:
             # sync engine — and the speculative build, whose drafts and
             # n_emit page accounting are host-value-dependent: pipeline
@@ -904,7 +1077,7 @@ class ServingPredictor:
         st = self._steady
         if steady_sig is not None and st is not None \
                 and st["sig"] == steady_sig:
-            self.steady_hits += 1
+            self._m_steady.inc()
             completing = st["completing"]
             tok_pos = np.zeros((t,), np.int32)
             produced_n = np.zeros((b,), np.int32)
@@ -1036,7 +1209,19 @@ class ServingPredictor:
         pools = ((cache.k_pages, cache.v_pages, cache.k_scales,
                   cache.v_scales) if self.kv_quant
                  else (cache.k_pages, cache.v_pages))
-        res = self._unified(*head, *pools, *tail)
+        # per-lane trace instants on the request lanes (tracing only):
+        # what kind of work each scheduled request got this step
+        if tracing_active():
+            dset = set(decode_slots)
+            for slot, n in sched.items():
+                req = self.running.get(slot)
+                if req is None:
+                    continue
+                kind = (("spec_verify" if spec_len[slot] else "decode")
+                        if slot in dset else "prefill_chunk")
+                self._req_event(req.req_id, kind, args={"tokens": int(n)})
+        with span("dispatch"):
+            res = self._unified(*head, *pools, *tail)
         self._mark_dispatch()
         if self.spec_k:
             out_dev, ne_dev, carry = res[0], res[1], res[2]
@@ -1089,6 +1274,7 @@ class ServingPredictor:
                 f"request {req.req_id}: context {len(ctx)} exceeds "
                 f"max_seq_len {self.max_seq_len}")
         slot = self.cache.admit(need_len)
+        self._note_admit(req, slot, 0)
         # bucket rounding must not push the prefill shape past the model's
         # position table (max_seq_len need not be a bucket multiple)
         padded = min(self._bucket(need_len), self.config.max_seq_len)
@@ -1105,9 +1291,9 @@ class ServingPredictor:
             # generated token; decode continues from it
             tok = int(np.asarray(next_ids)[0])
             req.output_ids.append(tok)
-            self.tokens_emitted += 1
+            self._m_tokens.inc()
             if req.first_token_time is None:
-                req.first_token_time = time.perf_counter()
+                self._note_first_token(req)
             self._next_token[slot] = tok
         else:
             # multi-token context (fresh prompt or preemption replay):
@@ -1174,25 +1360,26 @@ class ServingPredictor:
                 if slot not in self.running:  # preempted itself
                     break
         ids = jnp.asarray(self._next_token)
-        next_ids, _, kp, vp = self._decode(
-            self.params, ids, self.cache.seq_lens_device(),
-            self.cache.k_pages, self.cache.v_pages,
-            self.cache.page_table_device())
+        with span("dispatch"):
+            next_ids, _, kp, vp = self._decode(
+                self.params, ids, self.cache.seq_lens_device(),
+                self.cache.k_pages, self.cache.v_pages,
+                self.cache.page_table_device())
         self._mark_dispatch()
         self.cache.update_pages(kp, vp)
-        self.steps += 1
-        t_sync = time.perf_counter()
+        self._m_steps.inc()
+        t_sync = monotonic()
         out = np.asarray(next_ids)
-        self._sync_time += time.perf_counter() - t_sync
+        self._m_sync_s.inc(monotonic() - t_sync)
         self._did_sync = True
         self._mark_drained()
         produced = {}
         for slot, req in self.running.items():
             tok = int(out[slot])
             req.output_ids.append(tok)
-            self.tokens_emitted += 1
+            self._m_tokens.inc()
             if req.first_token_time is None:
-                req.first_token_time = time.perf_counter()
+                self._note_first_token(req)
             self._next_token[slot] = tok
             self.cache.advance(slot)
             produced[req.req_id] = [tok]
@@ -1208,7 +1395,7 @@ class ServingPredictor:
         produces none. The async engine returns the tokens RECONCILED by
         this call (one step behind the dispatch; drain with
         :meth:`flush`)."""
-        t0 = time.perf_counter()
+        t0 = monotonic()
         self._did_sync = False
         try:
             if self.unified:
@@ -1219,9 +1406,11 @@ class ServingPredictor:
                 # ONE hard sync per step()/flush() call no matter how
                 # many ring entries it landed: a drain materializes the
                 # oldest (blocking) and the rest are already resident
-                self.hard_syncs += 1
-            self._step_time += time.perf_counter() - t0
-            self._perf_steps += 1
+                self._m_hard_syncs.inc()
+            self._m_step_s.inc(monotonic() - t0)
+            self._m_step_calls.inc()
+            self._m_running.set(len(self.running))
+            self._m_waiting.set(len(self.waiting))
 
     # -- convenience -------------------------------------------------------
 

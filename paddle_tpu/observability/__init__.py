@@ -1,0 +1,35 @@
+"""paddle_tpu.observability — runtime telemetry (round 15).
+
+Two halves, one import surface:
+
+- :mod:`.metrics` — the structured metrics registry: labeled
+  Counter/Gauge/Histogram families with a near-zero-cost disabled path,
+  thread-safe mutation (the async serving engine's dispatch/reconcile
+  split, the watchdog monitor thread), and ``snapshot()`` /
+  ``snapshot_flat()`` export — the schema-checked ``telemetry``
+  sub-object riding the bench JSON lines.
+- :mod:`.tracing` — host spans + per-request async lanes + counter
+  tracks recorded into the profiler's event buffer and exported through
+  ``profiler.export_chrome_tracing``; ``monotonic()``/``monotonic_ns()``
+  are THE timing clock for ``inference/`` and ``distributed/`` (tpulint
+  AL006 fences raw ``time.perf_counter()`` there to this layer).
+
+Cost contract: with observability disabled (no profiler window open,
+``default_registry`` off) every instrument call is one flag check and an
+immediate return — the churn-smoke bench gates the end-to-end overhead
+(see ARCHITECTURE.md round 15).
+"""
+from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
+                      default_registry, disable_metrics, enable_metrics,
+                      merge_snapshots, metrics_enabled)
+from .tracing import (REQUEST_SPAN, counter_event, device_annotation,
+                      monotonic, monotonic_ns, request_begin, request_end,
+                      request_event, span, tracing_active)
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "default_registry",
+    "enable_metrics", "disable_metrics", "metrics_enabled",
+    "merge_snapshots", "span", "request_begin", "request_event",
+    "request_end", "counter_event", "tracing_active", "monotonic",
+    "monotonic_ns", "device_annotation", "REQUEST_SPAN",
+]

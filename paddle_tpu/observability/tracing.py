@@ -1,0 +1,156 @@
+"""Host-span tracing API — the serving/training timeline half of
+``paddle_tpu/observability`` (round 15).
+
+Thin, hot-path-safe wrappers over the profiler's one in-process event
+buffer (``profiler/record.py``):
+
+- :func:`span` — a named host range (``with span("pack_dispatch"): ...``)
+  recorded as a Chrome ``X`` duration event when a profiler RECORD window
+  is open, and nested inside a ``jax.profiler.TraceAnnotation`` so the
+  host range lines up with device activity in an xplane/TensorBoard
+  capture (host/device correlation). When no window is open the call
+  returns a shared no-op context manager — one flag check, no allocation.
+- :func:`request_begin` / :func:`request_event` / :func:`request_end` —
+  per-request ASYNC span lanes (Chrome ``b``/``n``/``e`` phases matched
+  by ``(category, id, name)``): one lane per request showing its whole
+  lifecycle (admit → prefill chunks → decode/spec steps → preemption /
+  replay → eos) across the scheduler steps that interleave it.
+- :func:`counter_event` — a Chrome counter track (``C`` phase): scalar
+  series over time (the async engine's in-flight ring depth).
+- :func:`monotonic` / :func:`monotonic_ns` — THE timing clock for
+  ``paddle_tpu/inference`` and ``paddle_tpu/distributed`` (tpulint AL006
+  flags raw ``time.perf_counter()`` there; timing belongs to this layer
+  so instrumented durations and trace timestamps share one clock).
+
+Everything exports through the existing profiler facade: run under
+``profiler.Profiler`` (or anything that flips ``recorder.enabled``) and
+``export_chrome_tracing`` writes one trace with the op ranges, the
+serving spans and the request lanes together.
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+
+from ..profiler.record import now_ns, recorder
+
+__all__ = [
+    "span", "request_begin", "request_event", "request_end",
+    "counter_event", "tracing_active", "monotonic", "monotonic_ns",
+    "device_annotation", "set_device_tracing",
+]
+
+monotonic = time.perf_counter
+monotonic_ns = time.perf_counter_ns
+
+
+def tracing_active() -> bool:
+    """True while a profiler RECORD window is open (spans are recorded)."""
+    return recorder.enabled
+
+
+#: shared no-op context manager — the disabled fast path (re-enterable;
+#: no caller binds the span value)
+_NULL = contextlib.nullcontext()
+
+
+#: flipped by the profiler facade while a jax/PJRT xplane capture is live;
+#: spans only pay the TraceAnnotation (C++ TraceMe) when a device trace
+#: can actually consume it — host-only tracing stays append-cheap
+_DEVICE_TRACING = [False]
+
+
+def set_device_tracing(active: bool) -> None:
+    _DEVICE_TRACING[0] = bool(active)
+
+
+def device_annotation(name: str):
+    """``jax.profiler.TraceAnnotation`` while a device (xplane) capture is
+    live — host ranges then correlate with device lanes in the capture
+    viewed next to the chrome trace; the shared no-op otherwise."""
+    if not _DEVICE_TRACING[0]:
+        return _NULL
+    try:
+        import jax
+
+        return jax.profiler.TraceAnnotation(name)
+    except Exception:
+        return _NULL
+
+
+class _Span:
+    __slots__ = ("name", "category", "_start", "_ann")
+
+    def __init__(self, name, category):
+        self.name = name
+        self.category = category
+        self._start = None
+        self._ann = None
+
+    def __enter__(self):
+        self._ann = device_annotation(self.name)
+        self._ann.__enter__()
+        self._start = now_ns()
+        return self
+
+    def __exit__(self, *exc):
+        end = now_ns()
+        if self._start is not None:
+            recorder.record(self.name, self._start, end,
+                            category=self.category)
+            self._start = None
+        ann, self._ann = self._ann, None
+        if ann is not None:
+            ann.__exit__(*exc)
+        return False
+
+
+def span(name: str, category: str = "serving"):
+    """A named host range. One flag check + shared no-op when no profiler
+    window is open; a recorded ``X`` event (and a device-side
+    TraceAnnotation) when one is."""
+    if not recorder.enabled:
+        return _NULL
+    return _Span(name, category)
+
+
+# -- per-request async lanes -------------------------------------------------
+
+#: async lane name shared by every request span; Chrome matches b/n/e
+#: phases on (category, id, name), so the id (req_id) is the lane key
+REQUEST_SPAN = "request"
+_REQ_CAT = "request"
+
+
+def request_begin(req_id, args=None) -> bool:
+    """Open the async lifecycle lane of one request. Returns whether the
+    begin was recorded — the caller gates matching ``request_end`` on it
+    (an ``e`` with no ``b`` renders as an unmatched phase)."""
+    if not recorder.enabled:
+        return False
+    recorder.record_raw(REQUEST_SPAN, "b", id=req_id, category=_REQ_CAT,
+                        args=args)
+    return True
+
+
+def request_event(req_id, name: str, args=None) -> None:
+    """An instant on one request's lane (admit / prefill_chunk / decode /
+    preempt / spec_accept / eos ...)."""
+    if not recorder.enabled:
+        return
+    recorder.record_raw(name, "n", id=req_id, category=_REQ_CAT, args=args)
+
+
+def request_end(req_id, args=None) -> None:
+    if not recorder.enabled:
+        return
+    recorder.record_raw(REQUEST_SPAN, "e", id=req_id, category=_REQ_CAT,
+                        args=args)
+
+
+def counter_event(name: str, value) -> None:
+    """One sample on a Chrome counter track (``C`` phase)."""
+    if not recorder.enabled:
+        return
+    recorder.record_raw(name, "C", category="counter",
+                        args={"value": float(value)})

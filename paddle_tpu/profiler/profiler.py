@@ -116,6 +116,7 @@ class Profiler:
         self.step_num = 0
         self.current_state = ProfilerState.CLOSED
         self._collected: list = []
+        self._collected_aux: list = []
         self._last_export = None
         self._device_trace_dir = None
         self._device_tracing = False
@@ -149,6 +150,7 @@ class Profiler:
                 if self.on_trace_ready:
                     self.on_trace_ready(self)
             self._collected = list(recorder.events)  # keep for summary()
+            self._collected_aux = list(recorder.aux)
         self.current_state = ProfilerState.CLOSED
 
     def step(self, num_samples: int | None = None):
@@ -167,6 +169,7 @@ class Profiler:
                 if self.on_trace_ready:
                     self.on_trace_ready(self)
                 self._collected = list(recorder.events)  # keep for summary()
+                self._collected_aux = list(recorder.aux)
                 recorder.clear()
         if new in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN) \
                 and not recorder.enabled:
@@ -187,9 +190,16 @@ class Profiler:
 
             self._device_trace_dir = self._device_trace_dir or \
                 os.path.join(os.getcwd(), "profiler_xplane")
+            # spans wrap device-side TraceAnnotations so host ranges line
+            # up with device lanes in the xplane capture; import BEFORE
+            # start_trace — a failure after a successful start would be
+            # swallowed below with the capture left open forever
+            from ..observability.tracing import set_device_tracing
+
             try:
                 jax.profiler.start_trace(self._device_trace_dir)
                 self._device_tracing = True
+                set_device_tracing(True)
             except Exception:
                 self._device_tracing = False
 
@@ -199,23 +209,48 @@ class Profiler:
         if self._device_tracing:
             import jax
 
+            from ..observability.tracing import set_device_tracing
+
             try:
                 jax.profiler.stop_trace()
             finally:
                 self._device_tracing = False
+                set_device_tracing(False)
 
     # -- export / summary --------------------------------------------------
     def _export_chrome(self, path: str):
         events = []
+        pid = os.getpid()
         # same fallback as summary(): a closed RECORD window moves events
-        # into _collected and clears the live recorder
-        for ev in (recorder.events or self._collected):
+        # into _collected(_aux) and clears the live recorder. The
+        # live-vs-collected decision is made ONCE for both buffers — an
+        # empty live aux buffer is a legitimate state (a window with no
+        # request lanes), and falling back per-buffer would resurrect the
+        # PREVIOUS window's aux events into this window's trace
+        live = bool(recorder.events or recorder.aux)
+        host_events = recorder.events if live else self._collected
+        aux_events = recorder.aux if live else self._collected_aux
+        for ev in host_events:
             events.append({
-                "name": ev.name, "ph": "X", "pid": os.getpid(),
+                "name": ev.name, "ph": "X", "pid": pid,
                 "tid": ev.tid % 2**31, "ts": ev.start_ns / 1e3,
                 "dur": (ev.end_ns - ev.start_ns) / 1e3,
                 "cat": ev.category,
             })
+        # round 15: async request-lifecycle phases (b/n/e, matched by
+        # (cat, id, name)) and counter tracks (C) from the observability
+        # span API ride the same trace file
+        for ev in aux_events:
+            rec = {
+                "name": ev.name, "ph": ev.ph, "pid": pid,
+                "tid": ev.tid % 2**31, "ts": ev.ts_ns / 1e3,
+                "cat": ev.category,
+            }
+            if ev.id is not None:
+                rec["id"] = str(ev.id)
+            if ev.args is not None:
+                rec["args"] = ev.args
+            events.append(rec)
         with open(path, "w") as f:
             json.dump({"traceEvents": events,
                        "displayTimeUnit": "ms"}, f)

@@ -24,15 +24,42 @@ class HostEvent:
         self.category = category
 
 
+class TraceEvent:
+    """A raw non-duration Chrome trace event (round 15): async-span
+    begin/instant/end phases (``ph`` in ``b``/``n``/``e``, matched by
+    ``(category, id, name)`` — the serving per-request lifecycle lanes)
+    and counter tracks (``ph == "C"``, ``args`` carries the series — the
+    in-flight ring depth). Kept on a separate buffer (``recorder.aux``)
+    so the summary tables keep iterating duration events only."""
+
+    __slots__ = ("name", "ph", "ts_ns", "id", "tid", "category", "args")
+
+    def __init__(self, name, ph, ts_ns, id, tid, category, args):
+        self.name = name
+        self.ph = ph
+        self.ts_ns = ts_ns
+        self.id = id
+        self.tid = tid
+        self.category = category
+        self.args = args
+
+
 class EventRecorder:
     def __init__(self):
         self.events: list[HostEvent] = []
+        self.aux: list[TraceEvent] = []
         self.enabled = False
+        #: bumped on every clear(): an async-lane 'b' recorded in an
+        #: earlier generation is GONE from this buffer, so lane owners
+        #: (serving's per-request spans) key their open-lane state on it
+        self.generation = 0
         self._lock = threading.Lock()
 
     def clear(self):
         with self._lock:
             self.events = []
+            self.aux = []
+            self.generation += 1
 
     def record(self, name, start_ns, end_ns, category="op"):
         if not self.enabled:
@@ -40,6 +67,17 @@ class EventRecorder:
         ev = HostEvent(name, start_ns, end_ns, threading.get_ident(), category)
         with self._lock:
             self.events.append(ev)
+
+    def record_raw(self, name, ph, *, ts_ns=None, id=None, category="trace",
+                   args=None):
+        """Append one non-duration event (async phase / instant / counter);
+        see :class:`TraceEvent`. No-op while disabled, like :meth:`record`."""
+        if not self.enabled:
+            return
+        ev = TraceEvent(name, ph, now_ns() if ts_ns is None else ts_ns,
+                        id, threading.get_ident(), category, args)
+        with self._lock:
+            self.aux.append(ev)
 
 
 recorder = EventRecorder()

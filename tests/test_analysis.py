@@ -302,6 +302,44 @@ class TestASTRules:
         """)
         assert "AL001" not in _rules(fs)
 
+    # -- AL006: raw perf_counter timing in the fenced hot-path dirs ---------
+
+    _TIMING_SRC = """
+        import time
+        from time import perf_counter
+
+        def f():
+            t0 = time.perf_counter()
+            t1 = perf_counter()
+            t2 = time.perf_counter_ns()
+            return t0, t1, t2
+    """
+
+    def test_al006_fires_in_inference_and_distributed(self):
+        for where in ("paddle_tpu/inference/serving.py",
+                      "paddle_tpu/distributed/fleet/fleet.py"):
+            fs = astlint.lint_source(textwrap.dedent(self._TIMING_SRC),
+                                     where)
+            al006 = [f for f in fs if f.rule == "AL006"]
+            assert len(al006) == 3, (where, fs)   # all three spellings
+
+    def test_al006_silent_outside_fenced_dirs_and_in_observability(self):
+        for where in ("paddle_tpu/models/gpt.py",     # timing allowed
+                      "paddle_tpu/observability/tracing.py",  # owns clock
+                      "fixture.py"):
+            fs = astlint.lint_source(textwrap.dedent(self._TIMING_SRC),
+                                     where)
+            assert "AL006" not in _rules(fs), where
+
+    def test_al006_pragma_suppresses(self):
+        fs = astlint.lint_source(textwrap.dedent("""
+            import time
+
+            def f():
+                return time.perf_counter()  # tpulint: disable=AL006
+        """), "paddle_tpu/inference/serving.py")
+        assert "AL006" not in _rules(fs)
+
 
 # ---------------------------------------------------------------------------
 # JX rules — seeded positive + negative per rule
@@ -624,6 +662,35 @@ class TestBenchSchema:
             {"metric": "m", "value": 1.0, "unit": "x"})
         assert json.loads(out)["value"] == 1.0
 
+    def test_telemetry_subobject_round15(self):
+        """The telemetry snapshot riding bench lines is schema-gated:
+        flat {str: finite number} only."""
+        base = {"metric": "m", "value": 1.0, "unit": "x"}
+        good = dict(base, telemetry={"serving_steps": 12,
+                                     "kv_pages_free": 3.0,
+                                     "serving_ttft_ms_p50": 1.25})
+        assert bench_schema.validate_line(good) == []
+        bad = [
+            dict(base, telemetry={}),                       # empty
+            dict(base, telemetry=[1, 2]),                   # not an object
+            dict(base, telemetry={"a": float("nan")}),      # non-finite
+            dict(base, telemetry={"a": "12"}),              # stringly
+            dict(base, telemetry={"a": True}),              # bool
+            dict(base, telemetry={"": 1.0}),                # empty key
+            dict(base, telemetry={"a": {"nested": 1}}),     # not flat
+        ]
+        for obj in bad:
+            assert bench_schema.validate_line(obj), obj
+        # a live registry snapshot passes the gate end to end
+        from paddle_tpu.observability import MetricsRegistry
+
+        reg = MetricsRegistry()
+        reg.counter("steps").inc(4)
+        reg.histogram("lat", buckets=(1, 10)).observe(2.0)
+        line = dict(base, telemetry=reg.snapshot_flat())
+        assert bench_schema.validate_line(line) == []
+        json.loads(bench_schema.checked_line(line))
+
     def test_lint_artifacts_flags_malformed_tail_line(self, tmp_path):
         art = {"n": 1, "cmd": "python bench.py", "rc": 0,
                "tail": 'noise\n{"metric": "m", "value": "oops", '
@@ -717,9 +784,9 @@ class TestRepoGate:
         from paddle_tpu.analysis import (astlint, bench_schema,  # noqa: F401
                                          jaxpr_checks, registry_audit)
 
-        for rid in ("AL001", "AL002", "AL003", "AL004", "AL005", "JX001",
-                    "JX002", "JX003", "JX004", "JX005", "JX006", "TR001",
-                    "RA001", "RA002", "RA003", "BL001"):
+        for rid in ("AL001", "AL002", "AL003", "AL004", "AL005", "AL006",
+                    "JX001", "JX002", "JX003", "JX004", "JX005", "JX006",
+                    "TR001", "RA001", "RA002", "RA003", "BL001"):
             assert rid in RULES, f"rule {rid} missing from the catalog"
 
     def test_repo_is_clean_against_baseline(self):

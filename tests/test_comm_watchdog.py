@@ -107,6 +107,51 @@ class TestWatchdog:
         assert mine == [{"rank": 0}, {"rank": 1}]
         assert other[1] == mine
 
+    def test_metrics_registry_counts_events(self):
+        """Round 15: arrival/timeout/peer-failure events feed the
+        observability registry, labeled by group/op — timeout attribution
+        without exception-string parsing. The default (library-wide)
+        registry is off, so an unmetered run pays one flag check."""
+        from paddle_tpu.observability import MetricsRegistry
+
+        reg = MetricsRegistry()
+        master = TCPStore("127.0.0.1", 0, is_master=True, world_size=2,
+                          timeout=30)
+        a = CommWatchdog(master, 0, 2, default_timeout=0.5,
+                         group_tag="g0", metrics=reg)
+        b = CommWatchdog(master, 1, 2, default_timeout=30.0,
+                         group_tag="g0", metrics=reg)
+        with pytest.raises(CommTimeout):
+            a.barrier()  # rank 1 never joins -> timeout + broadcast
+        with pytest.raises(CommPeerFailure):
+            b.all_gather_object({"x": 1})  # fails fast on a's error
+        with pytest.raises(CommPeerFailure):
+            b.barrier()  # same persistent record re-read: must NOT recount
+        master.close(linger=0)
+        flat = reg.snapshot_flat()
+        assert flat["comm_watchdog_arrivals{group=g0,op=barrier}"] == 1
+        assert flat["comm_watchdog_timeouts{group=g0,op=barrier}"] == 1
+        # b's fail-fast is attributed to the ORIGIN collective (barrier),
+        # not the one it was about to run — and counted ONCE per origin
+        # event, however many later collectives re-observe the record
+        assert flat["comm_watchdog_peer_failures{group=g0,op=barrier}"] == 1
+        # b never marked arrival: check_peer_errors raised first
+        assert "comm_watchdog_arrivals{group=g0,op=all_gather_object}" \
+            not in flat
+
+    def test_default_registry_disabled_counts_nothing(self):
+        from paddle_tpu.observability import default_registry
+
+        master = TCPStore("127.0.0.1", 0, is_master=True, world_size=1,
+                          timeout=30)
+        wd = CommWatchdog(master, 0, 1, default_timeout=5.0,
+                          group_tag="solo")
+        wd.barrier()   # world of one: completes immediately
+        master.close(linger=0)
+        flat = default_registry.snapshot_flat()
+        assert flat.get("comm_watchdog_arrivals{group=solo,op=barrier}",
+                        0) == 0
+
     def test_monitor_thread_trips_event(self):
         master = TCPStore("127.0.0.1", 0, is_master=True, world_size=2,
                           timeout=30)

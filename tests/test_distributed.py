@@ -852,6 +852,22 @@ def test_bench_dpquant_smoke_schema():
     assert line["loss_parity_delta"] <= 1e-4
     assert line["replicas_bit_identical"] == 1.0
     assert line["value"] > 0
+    # round 15: the telemetry snapshot rides the line — both legs' train
+    # steps counted, and the int8 leg's analytic wire bytes charged per
+    # step line up with the line's own bytes_on_the_wire model
+    tel = line["telemetry"]
+    assert tel["train_steps"] == 12     # 6 fp + 6 int8 bench steps
+    assert tel["train_dispatch_seconds"] > 0
+    # per-leaf ring accounting vs the line's whole-pytree model: the fp
+    # path's ceil-div drift is sub-percent; the int8 path pays per-leaf
+    # block padding, so it sits between the ideal and the fp spend
+    import pytest as _pytest
+
+    assert tel["train_wire_bytes{quant=fp}"] == _pytest.approx(
+        6 * line["bytes_on_the_wire_fp"], rel=0.01)
+    assert 6 * line["bytes_on_the_wire"] <= \
+        tel["train_wire_bytes{quant=int8}"] < \
+        tel["train_wire_bytes{quant=fp}"]
 
 
 class TestRound4Surface:

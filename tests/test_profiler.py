@@ -120,6 +120,72 @@ def test_scheduler_validation():
         make_scheduler(closed=0, ready=0, record=0)
     with pytest.raises(ValueError):
         Profiler(scheduler=(2, 2))
+    with pytest.raises(ValueError):
+        make_scheduler(closed=-1, ready=0, record=1)
+    with pytest.raises(ValueError):
+        make_scheduler(closed=0, ready=0, record=1, skip_first=-1)
+
+
+def test_make_scheduler_repeat_forever_and_edges():
+    """Round-15 edge coverage of the cycle state machine: repeat=0 cycles
+    forever; closed=0/ready=0 degenerate phases; record=1 jumps straight
+    to RECORD_AND_RETURN; skip_first offsets the whole cycle."""
+    # repeat=0: the cycle must continue indefinitely (probe deep in)
+    sch = make_scheduler(closed=1, ready=1, record=2, repeat=0)
+    cycle = [ProfilerState.CLOSED, ProfilerState.READY,
+             ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN]
+    for step in range(40):
+        assert sch(step) == cycle[step % 4], step
+    # no closed, no ready phase: every cycle is pure recording
+    sch = make_scheduler(closed=0, ready=0, record=1, repeat=0)
+    assert [sch(i) for i in range(3)] == [
+        ProfilerState.RECORD_AND_RETURN] * 3
+    # record=1 with warmup phases
+    sch = make_scheduler(closed=2, ready=1, record=1, repeat=1)
+    assert [sch(i) for i in range(5)] == [
+        ProfilerState.CLOSED, ProfilerState.CLOSED, ProfilerState.READY,
+        ProfilerState.RECORD_AND_RETURN, ProfilerState.CLOSED]
+    # skip_first shifts the first cycle only
+    sch = make_scheduler(closed=0, ready=1, record=1, repeat=2,
+                         skip_first=3)
+    assert [sch(i) for i in range(8)] == [
+        ProfilerState.CLOSED, ProfilerState.CLOSED, ProfilerState.CLOSED,
+        ProfilerState.READY, ProfilerState.RECORD_AND_RETURN,
+        ProfilerState.READY, ProfilerState.RECORD_AND_RETURN,
+        ProfilerState.CLOSED]
+
+
+def test_chrome_export_round_trips_aux_events(tmp_path):
+    """Round 15: async request phases + counter tracks recorded through
+    the observability span API ride the chrome export and json.load back
+    with their phase/id/args intact."""
+    from paddle_tpu.observability import (counter_event, request_begin,
+                                          request_end, request_event, span)
+
+    p = Profiler(on_trace_ready=export_chrome_tracing(str(tmp_path), "aux"))
+    p.start()
+    with span("pack_dispatch"):
+        pass
+    assert request_begin(7, args={"req_id": 7})
+    request_event(7, "admit", args={"slot": 0})
+    counter_event("inflight_steps", 2)
+    request_end(7)
+    p.stop()
+    events = load_profiler_result(str(p._last_export))
+    by_ph = {}
+    for e in events:
+        by_ph.setdefault(e["ph"], []).append(e)
+    assert any(e["name"] == "pack_dispatch" for e in by_ph["X"])
+    assert [e["name"] for e in by_ph["b"]] == ["request"]
+    assert by_ph["b"][0]["id"] == "7" and by_ph["b"][0]["cat"] == "request"
+    assert by_ph["e"][0]["id"] == "7"
+    admits = [e for e in by_ph["n"] if e["name"] == "admit"]
+    assert admits and admits[0]["args"] == {"slot": 0}
+    counters = by_ph["C"]
+    assert counters[0]["name"] == "inflight_steps"
+    assert counters[0]["args"] == {"value": 2.0}
+    # timestamps are µs floats ordered begin <= end
+    assert by_ph["b"][0]["ts"] <= by_ph["e"][0]["ts"]
 
 
 def test_dataloader_marks_reader_cost():

@@ -476,7 +476,17 @@ def test_bench_serve_smoke_schema():
     (mp=1 vs mp=N unified step) with per-chip throughput, and the
     round-12 speculative A/B (spec off vs k=4 on a repetitive-prompt
     churn) with accepted-tokens-per-step > 1.0; flagship quantized line
-    last."""
+    last. Best-of-2: the strict within-pair perf gates (async tokens/s
+    > paired sync) sit near a loaded CI box's noise floor — one retry
+    shields the load spike without weakening a deterministic failure
+    (same idiom as the round-7 shm-ring best-of-3)."""
+    try:
+        _bench_serve_smoke_once()
+    except AssertionError:
+        _bench_serve_smoke_once()
+
+
+def _bench_serve_smoke_once():
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     proc = subprocess.run(
         [sys.executable, "bench_serve.py", "--smoke", "--steps=6",
@@ -485,7 +495,7 @@ def test_bench_serve_smoke_schema():
         env={**os.environ, "JAX_PLATFORMS": "cpu"})
     assert proc.returncode == 0, proc.stderr[-2000:]
     lines = [l for l in proc.stdout.strip().splitlines() if l.strip()]
-    assert len(lines) == 8, proc.stdout
+    assert len(lines) == 9, proc.stdout
     for line in lines:
         rec = json.loads(line)
         assert "error" not in rec, rec
@@ -500,11 +510,24 @@ def test_bench_serve_smoke_schema():
         assert rec["mesh_shape"] == f"mp{rec['mesh_chips']}"
         assert rec["tokens_per_s_per_chip"] == pytest.approx(
             rec["value"] / rec["mesh_chips"], rel=0.01)
-    (legacy, unified, uasync, spmd, specb, speck, int8w,
+        # round 15: the schema-checked telemetry snapshot rides EVERY
+        # leg — the serving registry's counters must be live and agree
+        # with the line's own accounting
+        tel = rec["telemetry"]
+        assert tel["serving_steps"] > 0
+        assert tel["serving_tokens_emitted"] > 0
+        # (requests_finished can legitimately be 0 on a leg whose output
+        # budget exceeds its short smoke window — e.g. spec-base at 1
+        # token/lane-step — so it is not gated per-line)
+        assert tel["serving_requests_admitted"] > 0
+        assert tel["serving_ttft_ms_count"] > 0
+        assert tel["kv_pages_free"] >= 0
+    (legacy, unified, uasync, uobs, spmd, specb, speck, int8w,
      int8kv) = (json.loads(l) for l in lines)
     assert "[legacy-two-jit]" in legacy["metric"]
     assert "[unified-step]" in unified["metric"]
     assert "[unified-async]" in uasync["metric"]
+    assert "[unified-obs]" in uobs["metric"]
     assert "[unified-spmd]" in spmd["metric"]
     assert "[unified-spec-base]" in specb["metric"]
     assert "[unified-spec-k4]" in speck["metric"]
@@ -514,8 +537,23 @@ def test_bench_serve_smoke_schema():
     # compiles >= 1 executable (now visible); the unified step has NO
     # prefill jit and exactly one executable for everything
     assert legacy["prefill_retraces"] >= 1
-    for rec in (unified, uasync, spmd, specb, speck, int8w, int8kv):
+    for rec in (unified, uasync, uobs, spmd, specb, speck, int8w, int8kv):
         assert rec["prefill_retraces"] == 0
+    # the round-15 observability A/B, measured as an interleaved pair on
+    # the same churn: vs_baseline is the paired-window median of traced/
+    # untraced tokens/s. This end-to-end gate is the GROSS-regression
+    # guard (e.g. a hot span accidentally re-growing a per-call jax
+    # TraceAnnotation showed up here as ~6%); the strict 2% disabled-path
+    # contract is gated deterministically in test_observability.py —
+    # this box's A/A churn noise floor (~±7%) swamps a 2% tokens/s
+    # assertion. The traced leg must also have actually recorded events
+    # (a silently-no-op tracing leg must fail, not pass).
+    assert uobs["vs_baseline"] >= 0.9, uobs
+    assert uobs["obs_off_tokens_per_s"] > 0
+    assert uobs["trace_events"] > 0
+    # prefix/preemption/draft counters ride the spec legs' telemetry
+    assert speck["telemetry"]["serving_draft_proposed"] > 0
+    assert speck["telemetry"]["serving_draft_accepted"] > 0
     # the round-13 sync-vs-async A/B, gated in the checked schema: the
     # async engine must close the inter-step host bubble (strictly lower
     # no-step-in-flight fraction), turn that into throughput (strictly
