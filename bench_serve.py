@@ -97,10 +97,26 @@ def _hbm_bytes_per_token(sp, batch, avg_ctx):
     """Analytic steady-state HBM read bytes PER CHIP per decode token:
     every weight byte once per step (amortized over the batch's lanes) +
     the token's own KV context (int8 pools count 1 byte/elt + their fp32
-    scale planes). Under an mp mesh the layer stacks and the KV pages are
+    scale planes) + the INTER-KERNEL ACTIVATION round-trips (round 16).
+    Under an mp mesh the layer stacks and the KV pages are
     head/column-sharded — each chip reads 1/mp of them — while the
     embeddings/LM head/LN leaves are replicated and read whole: exactly
-    the per-chip bandwidth the round-11 tensor-parallel leg buys down."""
+    the per-chip bandwidth the round-11 tensor-parallel leg buys down.
+
+    Activation accounting (the quantity the megakernel buys down): the
+    per-op layer chain writes-then-reads every intermediate between its
+    kernels — LN1 out (h) -> qkv (3h) -> attention out (h) -> output-GEMM
+    out (h) -> residual (h) -> LN2 out (h) -> MLP hidden and gelu out
+    (4h each) -> MLP out (h): 17h elements per token per layer crossing
+    HBM twice. Under mp only the head/column-sharded intermediates (qkv
+    3h, attention out h, MLP hidden + gelu out 8h = 12h) shrink per chip;
+    the LN outs, the residual, and the post-psum wo/MLP outputs (5h) are
+    full-width on every chip. The megakernelized path (chip-local by
+    contract) pins all of that in VMEM; the only activations crossing HBM
+    between its two kernels are the attention side's (y2, s) pair — 2h
+    elements (the emitted new K/V rows exist in both paths and ride the
+    KV term). Kernel-internal scratch blocks are written once and never
+    re-read — not counted for either path."""
     import jax.numpy as jnp
 
     from paddle_tpu.inference.quantize import serving_weight_bytes
@@ -115,7 +131,14 @@ def _hbm_bytes_per_token(sp, batch, avg_ctx):
           * cache.num_kv_heads * cache.head_dim * elt) / mp
     if cache.quantize_kv:
         kv += 2 * cache.num_layers * avg_ctx * cache.num_kv_heads * 4 / mp
-    return int(wb + kv)
+    h = cache.num_kv_heads * cache.head_dim
+    act_elt = jnp.dtype(sp.params["tok_emb"].dtype).itemsize
+    if getattr(sp, "mega_decode", False):
+        act_per_layer = 2 * h  # mega is chip-local (mp == 1 enforced)
+    else:
+        act_per_layer = 12 * h / mp + 5 * h
+    act = 2 * cache.num_layers * act_per_layer * act_elt
+    return int(wb + kv + act)
 
 
 class _ChurnLeg:
@@ -133,7 +156,8 @@ class _ChurnLeg:
                  gen_len, page_size, chunk, unified, use_kernel, on_tpu,
                  dtype=None, weight_dtype=None, kv_cache_dtype=None,
                  mesh_chips=1, spec_decode_k=0, spec_workload=False,
-                 async_engine=False, observability=False):
+                 async_engine=False, observability=False,
+                 mega_decode=False):
         # async_engine stays EXPLICIT here (default False = the sync
         # baseline leg) even though round 14 flipped the predictor's own
         # default to async: the legacy/quant/spec/spmd legs are the
@@ -169,7 +193,7 @@ class _ChurnLeg:
             chunk=chunk,
             dtype=jnp.bfloat16 if (on_tpu and dtype is None) else dtype,
             mesh=mesh, spec_decode_k=spec_decode_k,
-            async_engine=async_engine)
+            async_engine=async_engine, mega_decode=mega_decode)
         rng = np.random.RandomState(0)
         if spec_workload:
             # tiled 4-token motifs: every prompt internally repetitive
@@ -183,6 +207,7 @@ class _ChurnLeg:
         self.reqs = []
         self.lat = []
         self.win_vals, self.win_gaps, self.win_host = [], [], []
+        self.win_dev = []
         self.first_wave = None
         self.timed_from = 0
         self.decode_before = 0
@@ -230,6 +255,7 @@ class _ChurnLeg:
         sp = self.sp
         sp.reset_perf_stats()
         w_emitted = sp.tokens_emitted
+        w_steps = sp.steps
         if self.observability:
             recorder.enabled = True
         try:
@@ -250,6 +276,13 @@ class _ChurnLeg:
         self.win_vals.append((sp.tokens_emitted - w_emitted) / dw)
         self.win_gaps.append(sp.step_gap_frac)
         self.win_host.append(sp.host_ms_per_step)
+        # wall ms per dispatched step with work IN FLIGHT — the
+        # host-observable per-step device-time proxy the round-16
+        # megakernel leg shrinks (the gap fraction subtracts the
+        # host-only bubbles, so this never credits scheduler stalls
+        # to the device)
+        self.win_dev.append(dw * (1.0 - sp.step_gap_frac) * 1e3
+                            / max(1, sp.steps - w_steps))
 
     def report(self):
         """The emitted-metrics dict (medians over the measured windows —
@@ -286,6 +319,9 @@ class _ChurnLeg:
             # round 13: the host-bubble metrics the async engine buys down
             step_gap_frac=round(float(np.median(self.win_gaps)), 4),
             host_ms_per_step=round(float(np.median(self.win_host)), 3),
+            # round 16: per-step wall time with work in flight — the
+            # megakernel A/B's device-time metric
+            device_ms_per_step=round(float(np.median(self.win_dev)), 3),
             # round 15: the schema-checked telemetry snapshot — the
             # serving-stack registry (predictor + KV cache) flat export,
             # so a per-RUN regression in e.g. prefix hits, preemptions or
@@ -392,6 +428,27 @@ def bench_serving_obs_ab(*, steps, windows, **leg_kw):
     return off_leg.report(), on_leg.report(), ratio
 
 
+def bench_serving_mega_ab(*, steps, windows, **leg_kw):
+    """The round-16 megakernel pair: the SAME int8w+int8kv churn with the
+    decode hot loop per-op (mega off — the round-15 baseline) vs routed
+    through the fused per-layer megakernels (mega on), windows
+    interleaved like the engine A/B so machine drift hits both legs
+    alike. Both legs run the production async engine. Returns
+    ``(off_out, on_out)``; the emitted mega-on line carries the paired
+    off-leg stats (tokens/s, hbm bytes, device ms) and the greedy
+    emission bit-identity gate — the megakernel must only move WHERE the
+    math runs, never what it emits."""
+    off_leg = _ChurnLeg(mega_decode=False, async_engine=True, **leg_kw)
+    on_leg = _ChurnLeg(mega_decode=True, async_engine=True, **leg_kw)
+    off_leg.warm()
+    on_leg.warm()
+    with _gc_frozen():
+        for _ in range(windows):
+            off_leg.window(steps)
+            on_leg.window(steps)
+    return off_leg.report(), on_leg.report()
+
+
 def main():
     import sys
 
@@ -422,6 +479,23 @@ def main():
     # serving path: 32-bit index types, same policy as bench.py
     jax.config.update("jax_enable_x64", False)
     on_tpu = jax.devices()[0].platform == "tpu"
+
+    # round 16: --legs=a,b,c runs (and emits) only the named legs — the
+    # tier-1 smoke gate selects its gated subset instead of paying every
+    # leg's churn; names validate against the schema's known-legs enum so
+    # a typo fails HERE, not as a silently-missing line two rounds later
+    legs_arg = next((a[len("--legs="):] for a in sys.argv
+                     if a.startswith("--legs=")), None)
+    selected = None
+    if legs_arg is not None:
+        from paddle_tpu.analysis.bench_schema import KNOWN_LEGS
+
+        selected = [s.strip() for s in legs_arg.split(",") if s.strip()]
+        unknown = sorted(set(selected) - KNOWN_LEGS)
+        if unknown:
+            raise SystemExit(
+                f"--legs: unknown leg(s): {', '.join(unknown)} (known: "
+                f"{', '.join(sorted(KNOWN_LEGS))})")
 
     if smoke:
         shape = dict(hidden=64, layers=2, heads=4, vocab=128,
@@ -486,8 +560,33 @@ def main():
         ("unified-int8w", dict(unified=True, weight_dtype="int8")),
         ("unified-int8w-int8kv", dict(unified=True, weight_dtype="int8",
                                       kv_cache_dtype="int8")),
+        # round-16 A/B: the SAME int8w+int8kv churn with the decode hot
+        # loop per-op vs megakernelized (fused per-layer Pallas kernels,
+        # activations pinned in VMEM) — measured interleaved, greedy
+        # emissions bit-identical; the new flagship line
+        ("unified-mega", None),
     ]
+    if selected is not None:
+        keep = set(selected)
+        legs = [(n, o) for n, o in legs if n in keep]
     results = {}
+
+    def _streams_match(a, b):
+        # per-arrival greedy emission bit-identity across an interleaved
+        # pair: FULL equality for requests finished in both legs, prefix
+        # equality for in-progress tails (shared by the async + mega A/Bs)
+        def _same(i):
+            (af, at), (bf, bt) = a[i], b[i]
+            if af and bf:
+                # finished in BOTH legs: the streams must be
+                # bit-identical INCLUDING length (a dropped
+                # trailing token must fail the gate)
+                return at == bt
+            n = min(len(at), len(bt))
+            return at[:n] == bt[:n]
+
+        common = set(a) & set(b)
+        return float(bool(common) and all(_same(i) for i in common))
 
     def metric_for(name):
         return (f"{FLAGSHIP_METRIC} ({label} prompt{shape['prompt']}"
@@ -522,21 +621,28 @@ def main():
                 out["vs_baseline"] = (
                     round(out["value"] / sync_out["value"], 3)
                     if sync_out["value"] else 0.0)
-                a, b = async_out["_streams"], sync_out["_streams"]
-
-                def _same(i):
-                    (af, at), (bf, bt) = a[i], b[i]
-                    if af and bf:
-                        # finished in BOTH legs: the streams must be
-                        # bit-identical INCLUDING length (a dropped
-                        # trailing token must fail the gate)
-                        return at == bt
-                    n = min(len(at), len(bt))
-                    return at[:n] == bt[:n]
-
-                common = set(a) & set(b)
-                out["async_emissions_match"] = float(
-                    bool(common) and all(_same(i) for i in common))
+                out["async_emissions_match"] = _streams_match(
+                    async_out["_streams"], sync_out["_streams"])
+                results[name] = out
+            elif name == "unified-mega":
+                off_out, on_out = bench_serving_mega_ab(
+                    unified=True, on_tpu=on_tpu, use_kernel=use_kernel,
+                    weight_dtype="int8", kv_cache_dtype="int8",
+                    **ab_shape, **ab_kw)
+                out = dict(metric=ab_metric_for(name), **on_out)
+                # the paired mega-off stats ride the mega-on line: its
+                # strict gates (hbm bytes strictly lower, emissions
+                # bit-identical) compare within the interleaved pair
+                out["mega_off_tokens_per_s"] = off_out["value"]
+                out["mega_off_hbm_bytes_per_token"] = (
+                    off_out["hbm_bytes_per_token"])
+                out["mega_off_device_ms_per_step"] = (
+                    off_out["device_ms_per_step"])
+                out["vs_baseline"] = (
+                    round(out["value"] / off_out["value"], 3)
+                    if off_out["value"] else 0.0)
+                out["mega_emissions_match"] = _streams_match(
+                    on_out["_streams"], off_out["_streams"])
                 results[name] = out
             elif name == "unified-obs":
                 off_out, on_out, ratio = bench_serving_obs_ab(
@@ -572,6 +678,7 @@ def main():
             return
         out = results[name]
         out.pop("_streams", None)
+        out["leg"] = name   # schema-checked against the known-legs enum
         if "vs_baseline" in out:
             pass   # self-baselined (the async pair)
         elif base is None:
@@ -579,6 +686,11 @@ def main():
         elif base in results and results[base]["value"]:
             out["vs_baseline"] = round(
                 out["value"] / results[base]["value"], 3)
+        elif selected is not None and base not in selected:
+            # the baseline leg was excluded by --legs, not dead: a
+            # partial run has no comparison to make — omit the (schema-
+            # optional) ratio rather than emit the 0.0 error signal
+            pass
         else:
             out["vs_baseline"] = 0.0
         print(checked_line(out))
@@ -597,6 +709,9 @@ def main():
     _emit("unified-spec-k4", "unified-spec-base")
     _emit("unified-int8w", "unified-step")
     _emit("unified-int8w-int8kv", "unified-step")
+    # round-16 flagship LAST: the megakernelized int8w+int8kv decode A/B
+    # (self-baselined on its interleaved mega-off partner)
+    _emit("unified-mega", None)
 
 
 if __name__ == "__main__":

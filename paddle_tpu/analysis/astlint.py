@@ -31,12 +31,14 @@ Rule catalog:
   source-scan gate of ``tests/test_op_registry.py``, generalized so the CLI
   reports it with file/line instead of one assert blob).
 - **AL006 raw-timing** — ``time.perf_counter()`` / ``perf_counter_ns()``
-  in ``paddle_tpu/inference/`` or ``paddle_tpu/distributed/`` outside the
-  observability layer: hot-path timing belongs to
-  ``observability.monotonic()`` (and the span API) so instrumented
-  durations, trace timestamps and bench windows share ONE clock — the
-  round-15 rule that keeps ad-hoc ``_t0 = time.perf_counter()`` fields
-  from re-accreting in the serving/collective hot paths.
+  in ``paddle_tpu/inference/``, ``paddle_tpu/distributed/`` or
+  ``paddle_tpu/ops/pallas/`` (round 16: the kernel autotune sweeps time
+  candidates too) outside the observability layer: hot-path timing
+  belongs to ``observability.monotonic()`` (and the span API) so
+  instrumented durations, trace timestamps and bench windows share ONE
+  clock — the round-15 rule that keeps ad-hoc ``_t0 =
+  time.perf_counter()`` fields from re-accreting in the serving/
+  collective/autotune hot paths.
 """
 from __future__ import annotations
 
@@ -354,7 +356,8 @@ class _FileLint(ast.NodeVisitor):
 
     #: directories whose timing must route through observability.monotonic
     #: (trailing slash: a sibling like inference_tools.py is NOT fenced)
-    _TIMED_DIRS = ("paddle_tpu/inference/", "paddle_tpu/distributed/")
+    _TIMED_DIRS = ("paddle_tpu/inference/", "paddle_tpu/distributed/",
+                   "paddle_tpu/ops/pallas/")
     _TIMING_CALLS = ("time.perf_counter", "time.perf_counter_ns",
                      "perf_counter", "perf_counter_ns")
 

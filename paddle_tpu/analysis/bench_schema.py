@@ -62,8 +62,28 @@ _OPTIONAL_NUMERIC = ("vs_baseline", "p50_ms", "p99_ms", "anchor_tflops",
                      # untraced (observability-disabled) interleaved
                      # partner riding the traced leg's line, and the
                      # host trace events the traced windows recorded
-                     "obs_off_tokens_per_s", "trace_events")
+                     "obs_off_tokens_per_s", "trace_events",
+                     # round 16: the megakernel A/B — wall ms per
+                     # dispatched step with work in flight (the host-
+                     # observable device-time proxy), the mega-off
+                     # interleaved partner's stats riding the mega-on
+                     # line, and the greedy emission bit-identity gate
+                     # of the pair
+                     "device_ms_per_step", "mega_off_tokens_per_s",
+                     "mega_off_hbm_bytes_per_token",
+                     "mega_off_device_ms_per_step", "mega_emissions_match")
 _OPTIONAL_STRING = ("mesh_shape", "comm_quant")
+
+#: the bench_serve leg-name enum (round 16): every serving line carries
+#: ``leg`` and it must be one of these — a typo'd leg name used to pass
+#: the schema silently (the name only lived inside the metric string) and
+#: drop out of round-over-round deltas exactly like the malformed lines
+#: this module exists to stop.
+KNOWN_LEGS = frozenset((
+    "legacy-two-jit", "unified-step", "unified-async", "unified-obs",
+    "unified-spmd", "unified-spec-base", "unified-spec-k4",
+    "unified-int8w", "unified-int8w-int8kv", "unified-mega",
+))
 
 
 def validate_line(obj) -> list[str]:
@@ -93,6 +113,18 @@ def validate_line(obj) -> list[str]:
                             f"got {obj[key]!r}")
     if "error" in obj and not isinstance(obj["error"], str):
         problems.append(f"key 'error' must be a string, got {obj['error']!r}")
+    # round 16: serving lines name their leg — and the name must be real
+    if "leg" in obj:
+        leg = obj["leg"]
+        if leg not in KNOWN_LEGS:
+            problems.append(
+                f"key 'leg' {leg!r} is not a known bench_serve leg "
+                f"(known: {', '.join(sorted(KNOWN_LEGS))})")
+        elif (isinstance(obj.get("metric"), str)
+              and f"[{leg}]" not in obj["metric"]):
+            problems.append(
+                f"key 'leg' {leg!r} does not match the metric suffix "
+                f"in {obj['metric']!r}")
     # round 15: the telemetry snapshot sub-object (the flat
     # MetricsRegistry.snapshot_flat() export riding bench lines) — a
     # non-finite counter or a non-numeric value fails at the bench, so a

@@ -35,6 +35,12 @@ builds of exactly the programs that carry the repo's numbers:
                   dequant path (block scales multiplying into the decode
                   must never widen it to f64) + the JX005 donation audit
                   of (params, momentum);
+- ``serving-mega``  the round-16 megakernelized decode step
+                  (``build_unified_step(mega=True)`` at chunk-1 decode
+                  geometry): the fused per-layer Pallas kernels with
+                  inline dequant and in-kernel KV quantize-on-write, fp
+                  and int8-weight/int8-KV variants — JX001 audits the
+                  scale math, JX005 the pool/scale-plane donation;
 - ``serving-async``  the round-13 feedback-coupled unified step as the
                   async double-buffered engine drives it: a LIVE
                   ``feedback`` mask routing a decode lane's input token
@@ -589,6 +595,101 @@ def analyze_serving_async() -> list[Finding]:
     return findings
 
 
+def analyze_serving_mega() -> list[Finding]:
+    """Round-16 megakernelized decode: the unified step built with
+    ``mega=True`` at its decode geometry (chunk = 1 row per lane, budget
+    = batch) — the per-layer chain replaced by the two fused Pallas
+    megakernels of ``ops/pallas/mega_decode``, with the kernel-quantized
+    new K/V rows scattering through ``paged_write_packed_prequant``. Both
+    the fp and the int8-weight + int8-KV variants walk through the jaxpr
+    checks — JX001 is the scale-promotion audit of the inline dequant
+    (weight scale rows multiplying into the MXU feed) and quantize-on-
+    write (absmax/127 scale math) paths, and JX005 the donation audit of
+    the pools (and scale planes): a megakernel step that silently stopped
+    aliasing its pools would double cache memory on every all-decode
+    round, exactly the rounds the kernel exists to accelerate."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from ..inference.kv_cache import KVCacheManager
+    from ..inference.quantize import quantize_serving_params
+    from ..models.gpt import (GPTConfig, GPTForCausalLM, build_unified_step,
+                              serving_params)
+
+    cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                    num_heads=2, max_seq_len=32, mega_decode=True)
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg)
+    fp_params = serving_params(model)
+    q_params = quantize_serving_params(serving_params(model), "int8",
+                                       group_size=16)
+    page_size, chunk, b = 8, 1, 2
+    budget = b * chunk
+    rng = np.random.RandomState(0)
+    findings: list[Finding] = []
+
+    def mega_args(params, mgr):
+        for _ in range(b):
+            mgr.admit_prefix([int(x) for x in rng.randint(0, 128, (8,))])
+        # the all-decode round the scheduler routes here: every lane
+        # feeds exactly one token at its context end
+        tok_ids = jnp.asarray(rng.randint(0, 128, (budget,)), jnp.int32)
+        tok_slot = jnp.arange(b, dtype=jnp.int32)
+        tok_pos = jnp.full((budget,), 8, jnp.int32)
+        q_lens = jnp.ones((b,), jnp.int32)
+        kv_lens = jnp.full((b,), 8, jnp.int32)
+        last_idx = jnp.arange(b, dtype=jnp.int32)
+        no_cow = jnp.full((b,), mgr.num_pages, jnp.int32)
+        feedback = jnp.zeros((budget,), jnp.int32)
+        prev_toks = jnp.zeros((b,), jnp.int32)
+        emit = jnp.ones((b,), jnp.int32)
+        produced = jnp.zeros((b,), jnp.int32)
+        keys = jnp.zeros((b, 2), jnp.uint32)
+        temp = jnp.asarray([0.0, 0.8], jnp.float32)
+        top_k = jnp.asarray([0, 40], jnp.int32)
+        top_p = jnp.asarray([1.0, 0.9], jnp.float32)
+        pools = ((mgr.k_pages, mgr.v_pages, mgr.k_scales, mgr.v_scales)
+                 if mgr.quantize_kv else (mgr.k_pages, mgr.v_pages))
+        return (params, tok_ids, tok_slot, tok_pos, q_lens, kv_lens,
+                last_idx, feedback, prev_toks, emit, produced) + pools + (
+                    mgr.page_table_device(), no_cow, no_cow, keys, temp,
+                    top_k, top_p)
+
+    # fp megakernel step: pools donate at (11, 12)
+    mgr = KVCacheManager(cfg.num_layers, cfg.num_heads, cfg.head_dim,
+                         num_pages=2 * b * (cfg.max_seq_len // page_size),
+                         max_batch=b, max_seq_len=cfg.max_seq_len,
+                         page_size=page_size, dtype=jnp.float32,
+                         enable_prefix_cache=True)
+    step = build_unified_step(cfg, page_size, chunk, mega=True)
+    args = mega_args(fp_params, mgr)
+    findings += analyze_jaxpr(trace_callable(step, *args),
+                              "serving-mega-step")
+    findings += check_donation(step, args, (11, 12), "serving-mega-step")
+
+    # int8-weight + int8-KV megakernel step (inline dequant + in-kernel
+    # quantize-on-write): pools AND scale planes donate at (11..14)
+    qmgr = KVCacheManager(cfg.num_layers, cfg.num_heads, cfg.head_dim,
+                          num_pages=2 * b * (cfg.max_seq_len // page_size),
+                          max_batch=b, max_seq_len=cfg.max_seq_len,
+                          page_size=page_size, dtype=jnp.float32,
+                          quantize_kv=True, enable_prefix_cache=True)
+    qcfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                     num_heads=2, max_seq_len=32, mega_decode=True,
+                     weight_dtype="int8", weight_quant_group_size=16,
+                     kv_cache_dtype="int8")
+    qstep = build_unified_step(qcfg, page_size, chunk, kv_quant=True,
+                               mega=True)
+    qargs = mega_args(q_params, qmgr)
+    findings += analyze_jaxpr(trace_callable(qstep, *qargs),
+                              "serving-mega-quant-step")
+    findings += check_donation(qstep, qargs, (11, 12, 13, 14),
+                               "serving-mega-quant-step")
+    return findings
+
+
 TARGETS = {
     "gpt-eager": analyze_gpt_eager,
     "bert-eager": analyze_bert_eager,
@@ -600,6 +701,7 @@ TARGETS = {
     "serving-spmd": analyze_serving_spmd,
     "serving-spec": analyze_serving_spec,
     "serving-async": analyze_serving_async,
+    "serving-mega": analyze_serving_mega,
 }
 
 

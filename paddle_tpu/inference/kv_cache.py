@@ -164,6 +164,24 @@ def paged_write_packed_quant(pages, scales, toks, page_table, tok_slot,
     return pages, scales
 
 
+def paged_write_packed_prequant(pages, scales, q_toks, s_toks, page_table,
+                                tok_slot, tok_pos, page_size):
+    """Scatter ALREADY-QUANTIZED packed K/V rows + their scale rows into
+    the int8 pool — the round-16 megakernel write path: the fused layer
+    kernel quantizes the new token's K/V inline in VMEM (the exact
+    :func:`paged_write_packed_quant` formula) and emits int8 payloads
+    ``q_toks [budget, kv_heads, head_dim]`` with per-row-per-head scales
+    ``s_toks [budget, kv_heads]``; this is just the scatter half.
+    Returns ``(pages, scales)``.
+    """
+    pg, row = _packed_dest(page_table, tok_slot, tok_pos, page_size,
+                           pages.shape[0])
+    pages = pages.at[pg, row].set(q_toks.astype(pages.dtype), mode="drop")
+    scales = scales.at[pg, row].set(s_toks.astype(scales.dtype),
+                                    mode="drop")
+    return pages, scales
+
+
 def paged_copy_pages(pages, src, dst):
     """Copy-on-write page copies, traced into the unified step.
 

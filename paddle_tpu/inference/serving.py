@@ -76,7 +76,11 @@ autotuned default), ``token_budget`` (tokens per step, default
 when unified), ``spec_decode_k`` (speculation build geometry, default
 ``config.spec_decode_k``), ``async_engine`` (the round-13 pipelined
 engine) + ``max_inflight_steps`` (deferral bound for steps that cannot
-complete any request).
+complete any request), ``mega_decode`` (round 16, default
+``config.mega_decode``: all-decode rounds route through the fused
+per-layer Pallas megakernels of ``ops/pallas/mega_decode`` — activations
+pinned in VMEM — while mixed rounds keep the per-op step; emissions are
+bit-identical either way).
 """
 from __future__ import annotations
 
@@ -198,7 +202,7 @@ class ServingPredictor:
                  dtype=None, unified=True, chunk=None, token_budget=None,
                  prefix_cache=None, kv_cache_dtype=None, mesh=None,
                  spec_decode_k=None, async_engine=None,
-                 max_inflight_steps=4, metrics=None):
+                 max_inflight_steps=4, metrics=None, mega_decode=None):
         from ..distributed.mesh import as_serving_mesh
         from ..models.gpt import (_serving_params_cached, build_decode_step,
                                   build_prefill, build_unified_step,
@@ -299,13 +303,40 @@ class ServingPredictor:
         self.token_budget = int(
             token_budget
             or (self.max_batch * (1 + self.spec_k) + self.chunk))
+        # round 16: the megakernelized decode build — ALL-DECODE rounds
+        # (no prefill chunk packed) route through the fused per-layer
+        # Pallas kernels at their own decode geometry (chunk = 1 + spec_k
+        # rows per lane, budget = max_batch lanes); mixed rounds keep the
+        # per-op unified step. Both programs are fixed-shape, compiled
+        # once, and donate the same pools. mega_decode=False (or a config
+        # with the flag off) is bit-identical to round-15 behavior.
+        self.mega_decode = bool(
+            getattr(cfg, "mega_decode", False) if mega_decode is None
+            else mega_decode)
+        if self.mega_decode and not self.unified:
+            raise ValueError(
+                "mega_decode rides the unified step's packed layout; the "
+                "legacy two-jit path serves the per-op chain only")
         if self.unified:
             self._unified = build_unified_step(
                 cfg, self.cache.page_size, self.chunk,
                 use_kernel=use_kernel, kv_quant=self.kv_quant,
                 mesh=self.mesh, spec_k=self.spec_k)
             self._prefill = self._decode = None
+            if self.mega_decode:
+                # build-time validation (int4 weights, mp > 1) raises
+                # HERE — a predictor must fail loudly at construction,
+                # not on its first all-decode round
+                self._mega_chunk = 1 + self.spec_k
+                self._mega_budget = self.max_batch * self._mega_chunk
+                self._mega = build_unified_step(
+                    cfg, self.cache.page_size, self._mega_chunk,
+                    use_kernel=use_kernel, kv_quant=self.kv_quant,
+                    mesh=self.mesh, spec_k=self.spec_k, mega=True)
+            else:
+                self._mega = None
         else:
+            self._mega = None
             self._unified = None
             self._decode = build_decode_step(cfg, self.cache.page_size,
                                              use_kernel=use_kernel,
@@ -481,7 +512,12 @@ class ServingPredictor:
         gate asserts this stays constant after warmup. Unified mode counts
         the ONE unified step; legacy counts the decode jit."""
         fn = self._unified if self.unified else self._decode
-        return fn.trace_count[0]
+        n = fn.trace_count[0]
+        if self._mega is not None:
+            # the mega build is a second routed program with its own
+            # one-time trace: the no-retrace gate covers BOTH
+            n += self._mega.trace_count[0]
+        return n
 
     @property
     def prefill_trace_count(self) -> int:
@@ -1056,7 +1092,17 @@ class ServingPredictor:
             return None
         import jax
 
-        b, t = self.max_batch, self.token_budget
+        b = self.max_batch
+        # round-16 route: an ALL-DECODE round (every scheduled slot is a
+        # decode lane — no prefill chunk packed) runs the megakernelized
+        # build at its decode geometry; anything feeding a prefill chunk
+        # keeps the per-op unified step. Both fixed-shape, both traced
+        # once; the packed arrays below size to the routed budget.
+        decode_set = set(decode_slots)
+        use_mega = (self._mega is not None
+                    and all(s in decode_set for s in sched))
+        t = self._mega_budget if use_mega else self.token_budget
+        step_fn = self._mega if use_mega else self._unified
         spec_len = np.zeros((b,), np.int32)
         # -- steady-decode fast path (async only) ------------------------
         # when EVERY scheduled lane is a feedback decode lane (its input
@@ -1072,8 +1118,11 @@ class ServingPredictor:
         if (self.async_engine and not drafts and not cows
                 and all(n == 1 for n in sched.values())
                 and all(self.running[s]._pending_n > 0 for s in sched)):
-            steady_sig = tuple((s, self.running[s].req_id)
-                               for s in sorted(sched))
+            # the route rides the signature: a mega round's cached device
+            # arrays are mega-budget-shaped and must never serve a per-op
+            # round (or vice versa)
+            steady_sig = (use_mega,) + tuple(
+                (s, self.running[s].req_id) for s in sorted(sched))
         st = self._steady
         if steady_sig is not None and st is not None \
                 and st["sig"] == steady_sig:
@@ -1111,7 +1160,6 @@ class ServingPredictor:
             temp = np.zeros((b,), np.float32)
             top_k = np.zeros((b,), np.int32)
             top_p = np.ones((b,), np.float32)
-            decode_set = set(decode_slots)
             completing = []   # (slot, req, k_i, was_decode)
             w = 0
             for slot in sorted(sched):
@@ -1212,16 +1260,15 @@ class ServingPredictor:
         # per-lane trace instants on the request lanes (tracing only):
         # what kind of work each scheduled request got this step
         if tracing_active():
-            dset = set(decode_slots)
             for slot, n in sched.items():
                 req = self.running.get(slot)
                 if req is None:
                     continue
                 kind = (("spec_verify" if spec_len[slot] else "decode")
-                        if slot in dset else "prefill_chunk")
+                        if slot in decode_set else "prefill_chunk")
                 self._req_event(req.req_id, kind, args={"tokens": int(n)})
         with span("dispatch"):
-            res = self._unified(*head, *pools, *tail)
+            res = step_fn(*head, *pools, *tail)
         self._mark_dispatch()
         if self.spec_k:
             out_dev, ne_dev, carry = res[0], res[1], res[2]

@@ -86,6 +86,17 @@ class GPTConfig:
     # is BUILD geometry (the step's output is [batch, k + 1]); per-request
     # adaptive k varies only the spec_len inputs, never the shape.
     spec_decode_k: int = 0
+    # round-16 megakernel decode: route ALL-DECODE serving rounds through
+    # the fused per-layer Pallas megakernels (ops/pallas/mega_decode —
+    # LN1 -> QKV -> inline KV quantize -> ragged paged attention -> output
+    # GEMM -> residual+LN2 in ONE kernel, then the fused MLP kernel) with
+    # intermediate activations pinned in VMEM instead of the per-op chain
+    # XLA stitches through HBM. Mixed prefill+decode rounds keep the
+    # per-op unified step; greedy mega output matches the full-forward
+    # oracle token-for-token and mega=False is bit-identical to round 15.
+    # Serves mesh size 1/None, fp or int8 weights (int4 rejected loudly),
+    # fp or int8 KV.
+    mega_decode: bool = False
 
     @property
     def ffn_size(self) -> int:
@@ -864,7 +875,7 @@ def _sample_epilogue(logits, keys, temperature, top_k, top_p):
 def build_unified_step(config: GPTConfig, page_size: int, chunk: int,
                        use_kernel: bool | None = None,
                        kv_quant: bool = False, mesh=None,
-                       spec_k: int = 0):
+                       spec_k: int = 0, mega: bool = False):
     """ONE fixed-shape serving step for mixed ragged prefill + decode,
     driven by a per-step TOKEN BUDGET.
 
@@ -975,11 +986,31 @@ def build_unified_step(config: GPTConfig, page_size: int, chunk: int,
     (``KVCacheManager.trim_pages``). ``spec_k`` is geometry: one trace
     per (budget, batch, spec_k), composing with ``kv_quant`` and ``mesh``
     (the epilogue replicates; donation covers the same pools).
+
+    ``mega=True`` (round 16) builds the MEGAKERNELIZED step: the per-op
+    layer chain (qkv quant-GEMM -> ragged paged attention -> output GEMM
+    -> fused MLP, each a separate kernel with activations round-tripping
+    HBM between them) is replaced by the two persistent per-layer Pallas
+    kernels of ``ops/pallas/mega_decode`` — ``mega_attn_layer`` (LN1 +
+    QKV projection + inline int8 quantize of the new K/V rows + ragged
+    paged attention + output GEMM + residual + LN2, activations pinned in
+    VMEM) and ``mega_mlp`` (GEMM1 + gelu + GEMM2 + residual, the 4h
+    hidden state never materializing in HBM). The new K/V rows the
+    attention kernel emits (int8 payloads + scale rows on the quantized
+    path — quantized IN-KERNEL with the exact ``paged_write_packed_quant``
+    formula) scatter into the donated pools via
+    ``paged_write_packed(_prequant)``. Signature, donation, feedback,
+    spec verify rows and the one-trace-per-geometry contract are all
+    UNCHANGED; callers build it at DECODE geometry (``chunk = 1 +
+    spec_k``) and route only all-decode rounds here — mixed rounds keep
+    the per-op build. ``validate_mega_config`` rejects int4 weights and
+    mp > 1 meshes at build time.
     """
     import jax
     import jax.numpy as jnp
 
     from ..inference.kv_cache import (paged_copy_pages, paged_write_packed,
+                                      paged_write_packed_prequant,
                                       paged_write_packed_quant)
     from ..ops.pallas.paged_attention import ragged_paged_attention
 
@@ -988,6 +1019,13 @@ def build_unified_step(config: GPTConfig, page_size: int, chunk: int,
     trace_count = [0]
     mp, axis = _mesh_mp(mesh)
     nh_l, hd = cfg.num_heads // mp, cfg.head_dim
+    if mega:
+        from ..ops.pallas.mega_decode import (mega_attn_layer, mega_mlp,
+                                              validate_mega_config)
+
+        validate_mega_config(getattr(cfg, "weight_dtype", None),
+                             getattr(cfg, "weight_quant_group_size", -1),
+                             hd, mp)
 
     # argument layout (shared by the wrappers, shard_map specs and the
     # donation indices): params + 6 packed/lane arrays [+ spec_len] + the
@@ -1096,13 +1134,66 @@ def build_unified_step(config: GPTConfig, page_size: int, chunk: int,
                              use_kernel, axis)
             return x, ((kp, vp, ks, vs) if kv_quant else (kp, vp))
 
+        def mega_block(xb, layer):
+            # the round-16 fused layer: the whole attention side is ONE
+            # kernel over the [b, chunk] lane blocks (attention reads the
+            # pool at kv_lens and handles this step's rows in-register —
+            # same math as write-then-attend at ctx), the MLP side one
+            # more; only the emitted new K/V rows touch HBM between them
+            if kv_quant:
+                p, kp, vp, ks, vs = layer
+            else:
+                p, kp, vp = layer
+                ks = vs = None
+            h = xb.shape[-1]
+            res = mega_attn_layer(xb, p, kp, vp, page_table, kv_lens,
+                                  q_lens, eps=eps, k_scales=ks,
+                                  v_scales=vs,
+                                  head_major=mesh is not None,
+                                  use_kernel=use_kernel)
+            if kv_quant:
+                y2, s, k_new, v_new, k_sc, v_sc = res
+                # the kernel quantized inline — scatter the int8 payloads
+                # and their scale rows (the packed gather reads each
+                # token's row out of its lane block)
+                kp, ks = paged_write_packed_prequant(
+                    kp, ks, k_new[slot_c, off_c], k_sc[slot_c, off_c],
+                    page_table, tok_slot, tok_pos, page_size)
+                vp, vs = paged_write_packed_prequant(
+                    vp, vs, v_new[slot_c, off_c], v_sc[slot_c, off_c],
+                    page_table, tok_slot, tok_pos, page_size)
+            else:
+                y2, s, k_new, v_new = res
+                kp = paged_write_packed(kp, k_new[slot_c, off_c],
+                                        page_table, tok_slot, tok_pos,
+                                        page_size)
+                vp = paged_write_packed(vp, v_new[slot_c, off_c],
+                                        page_table, tok_slot, tok_pos,
+                                        page_size)
+            out = mega_mlp(y2.reshape(b * chunk, h),
+                           s.reshape(b * chunk, h), p,
+                           use_kernel=use_kernel)
+            return (out.reshape(b, chunk, h),
+                    ((kp, vp, ks, vs) if kv_quant else (kp, vp)))
+
+        if mega:
+            # lane-block layout for the fused layers: packed tokens
+            # scatter into their [b, chunk] rows once, stay blocked
+            # through every layer, and gather back for the epilogue
+            carry0 = jnp.zeros((b, chunk, x.shape[-1]), x.dtype
+                               ).at[scatter_b, off_c].set(x, mode="drop")
+            body = mega_block
+        else:
+            carry0, body = x, block
         if kv_quant:
             x, (k_pages, v_pages, k_scales, v_scales) = jax.lax.scan(
-                block, x, (params["layers"], k_pages, v_pages, k_scales,
-                           v_scales))
+                body, carry0, (params["layers"], k_pages, v_pages,
+                               k_scales, v_scales))
         else:
             x, (k_pages, v_pages) = jax.lax.scan(
-                block, x, (params["layers"], k_pages, v_pages))
+                body, carry0, (params["layers"], k_pages, v_pages))
+        if mega:
+            x = x[slot_c, off_c]                     # back to packed [t]
         x = _srv_ln(x, params["lnf_g"], params["lnf_b"], eps)
         if spec_k:
             # -- speculative verify + fused accept epilogue --------------
@@ -1282,20 +1373,22 @@ def _serving_fns(config: GPTConfig, page_size: int, use_kernel, mesh=None):
 
 
 def _unified_fn(config: GPTConfig, page_size: int, chunk: int, use_kernel,
-                kv_quant=False, mesh=None, spec_k=0):
+                kv_quant=False, mesh=None, spec_k=0, mega=False):
     # the mesh SIGNATURE keys the cache (satellite of round 11): two mesh
     # sizes get two entries — neither collides with nor retraces the other.
     # spec_k is build GEOMETRY (the [b, k+1] output): two k values get two
-    # executables, each compiled once; adaptive per-request k never keys
+    # executables, each compiled once; adaptive per-request k never keys.
+    # mega (round 16) keys too: the megakernelized decode build and the
+    # per-op build coexist — the scheduler routes rounds between them
     from ..distributed.mesh import mesh_signature
 
     return _jit_cache_get(
         ("unified", _cfg_key(config), page_size, chunk, use_kernel,
-         kv_quant, mesh_signature(mesh), spec_k),
+         kv_quant, mesh_signature(mesh), spec_k, mega),
         lambda: build_unified_step(config, page_size, chunk,
                                    use_kernel=use_kernel,
                                    kv_quant=kv_quant, mesh=mesh,
-                                   spec_k=spec_k))
+                                   spec_k=spec_k, mega=mega))
 
 
 def generate_paged(model, input_ids, max_new_tokens=20, *, page_size=None,
@@ -1405,7 +1498,19 @@ def generate_paged(model, input_ids, max_new_tokens=20, *, page_size=None,
         proposers = [DraftProposer(spec_k) for _ in range(b)]
     step = _unified_fn(cfg, mgr.page_size, chunk, use_kernel,
                        kv_quant=kv_quant, mesh=mesh, spec_k=spec_k)
-    traces_at_entry = step.trace_count[0]
+    # round 16: with mega_decode on, ALL-DECODE rounds route through the
+    # megakernelized build at its own decode geometry (chunk = 1 + spec_k
+    # rows per lane); rounds still feeding prefill chunks keep the per-op
+    # step above — two fixed-shape programs, each compiled once
+    step_mega = None
+    if getattr(cfg, "mega_decode", False):
+        mega_chunk = 1 + spec_k
+        step_mega = _unified_fn(cfg, mgr.page_size, mega_chunk, use_kernel,
+                                kv_quant=kv_quant, mesh=mesh,
+                                spec_k=spec_k, mega=True)
+        t_mega = b * mega_chunk
+    traces_at_entry = step.trace_count[0] + (
+        step_mega.trace_count[0] if step_mega is not None else 0)
     # token budget: every row can feed a full chunk each round (generate
     # drives all rows in lockstep; the budget-packed scheduler lives in
     # ServingPredictor). constant per-call sampling plumbing; generate
@@ -1418,6 +1523,8 @@ def generate_paged(model, input_ids, max_new_tokens=20, *, page_size=None,
     # the synchronous convenience loop never defers emission: feedback
     # stays all-zero and the carry input is a constant (no upload)
     no_feedback = jnp.zeros((t_budget,), jnp.int32)
+    no_feedback_mega = (jnp.zeros((t_mega,), jnp.int32)
+                        if step_mega is not None else None)
     zero_prev = jnp.zeros((b,), jnp.int32)
     base_keys = jnp.zeros((b, 2), jnp.uint32)
     if temperature > 0:
@@ -1439,11 +1546,21 @@ def generate_paged(model, input_ids, max_new_tokens=20, *, page_size=None,
             if done[i] and sl is not None:
                 mgr.free(sl)
                 slots[i] = None
+        # round-16 routing: a round where EVERY live lane decodes (one
+        # context token left) runs the megakernel build at its decode
+        # geometry; any round still feeding prefill chunks stays per-op
+        live = [(i, sl) for i, sl in enumerate(slots)
+                if sl is not None and not done[i]]
+        decode_round = (step_mega is not None and all(
+            len(contexts[i]) - mgr.seq_len(sl) == 1 for i, sl in live))
+        t_route = t_mega if decode_round else t_budget
+        fn = step_mega if decode_round else step
+        fb = no_feedback_mega if decode_round else no_feedback
         q_lens = np.zeros((b,), np.int32)
-        tok_ids = np.zeros((t_budget,), np.int32)
-        tok_slot = np.full((t_budget,), -1, np.int32)
-        tok_pos = np.zeros((t_budget,), np.int32)
-        last_idx = np.full((b,), t_budget, np.int32)   # idle sentinel
+        tok_ids = np.zeros((t_route,), np.int32)
+        tok_slot = np.full((t_route,), -1, np.int32)
+        tok_pos = np.zeros((t_route,), np.int32)
+        last_idx = np.full((b,), t_route, np.int32)   # idle sentinel
         spec_len = np.zeros((b,), np.int32)
         emit_mask = np.zeros((b,), np.int32)
         produced = np.zeros((b,), np.int32)
@@ -1508,13 +1625,13 @@ def generate_paged(model, input_ids, max_new_tokens=20, *, page_size=None,
                   mgr.seq_lens_device(), jnp.asarray(last_idx))
         if spec_k:
             packed = packed + (jnp.asarray(spec_len),)
-        packed = packed + (no_feedback, zero_prev, jnp.asarray(emit_mask),
+        packed = packed + (fb, zero_prev, jnp.asarray(emit_mask),
                            jnp.asarray(produced))
         tail = (mgr.page_table_device(), no_cow, no_cow, base_keys,
                 temp_arr, topk_arr, topp_arr)
         pools = ((mgr.k_pages, mgr.v_pages, mgr.k_scales, mgr.v_scales)
                  if kv_quant else (mgr.k_pages, mgr.v_pages))
-        res = step(*packed, *pools, *tail)
+        res = fn(*packed, *pools, *tail)
         if spec_k:
             out_ids, n_emit = np.asarray(res[0]), np.asarray(res[1])
             mgr.update_pages(*res[4:])
@@ -1548,10 +1665,12 @@ def generate_paged(model, input_ids, max_new_tokens=20, *, page_size=None,
                     done[i] = True
                 if len(outs[i]) >= max_new_tokens:
                     done[i] = True
-    # traces THIS call added: 1 on a cold shape, 0 when the cached jit
-    # already compiled it — never per-token (the no-retrace gate)
-    generate_paged.last_decode_trace_count = (step.trace_count[0]
-                                              - traces_at_entry)
+    # traces THIS call added: 1 on a cold shape (per routed program — the
+    # mega path adds its own one-time trace), 0 when the cached jits
+    # already compiled them — never per-token (the no-retrace gate)
+    traces_now = step.trace_count[0] + (
+        step_mega.trace_count[0] if step_mega is not None else 0)
+    generate_paged.last_decode_trace_count = traces_now - traces_at_entry
     # rows that stopped early (eos) pad with the eos id, as before
     n_cols = max(len(o) for o in outs)
     pad = eos_token_id if eos_token_id is not None else 0

@@ -108,7 +108,7 @@ def autotune(batch_heads, seq_q, seq_k, d, dtype=jnp.bfloat16,
     (256, 512). Treat autotune as a starting point and confirm against the
     end-to-end step; delete the cache file to revert to defaults.
     """
-    import time
+    from ...observability import monotonic
 
     if _interpret():
         return _blocks_for(seq_q, seq_k, d, dtype)
@@ -142,11 +142,11 @@ def autotune(batch_heads, seq_q, seq_k, d, dtype=jnp.bfloat16,
                         .astype(jnp.float32)))(q))
                 loss, g = step(q, k, v)
                 g.block_until_ready()  # compile + warmup
-                t0 = time.perf_counter()
+                t0 = monotonic()
                 for _ in range(iters):
                     loss, g = step(q, k, v)
                 g.block_until_ready()
-                t = time.perf_counter() - t0
+                t = monotonic() - t0
             except Exception:
                 continue
             if t < best_t:
@@ -177,7 +177,7 @@ def autotune_split(batch_heads, seq_q, seq_k, d, dtype=jnp.bfloat16,
     verdict: the backward had no TPU-tuned autotune of its own).
     Returns ((fwd_bq, fwd_bk), (bwd_bq, bwd_bk)).
     """
-    import time
+    from ...observability import monotonic
 
     if _interpret():
         b = _blocks_for(seq_q, seq_k, d, dtype)
@@ -195,11 +195,11 @@ def autotune_split(batch_heads, seq_q, seq_k, d, dtype=jnp.bfloat16,
 
     def _time(fn, *args):
         out = jax.block_until_ready(fn(*args))  # compile + warmup
-        t0 = time.perf_counter()
+        t0 = monotonic()
         for _ in range(iters):
             out = fn(*args)
         jax.block_until_ready(out)
-        return time.perf_counter() - t0
+        return monotonic() - t0
 
     def _sweep(sig, make_step):
         saved = _atc.CACHE.get(sig)

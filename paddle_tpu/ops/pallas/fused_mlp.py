@@ -473,7 +473,7 @@ def autotune_mlp(rows, h, dtype=jnp.bfloat16, kinds=("ln", "gelu"),
     device and persist the winners (fwd and bwd share one block — they run
     back-to-back in training and compete for the same VMEM). Returns
     ``{kind: rows_block}``. No-op (returns current choices) off-TPU."""
-    import time
+    from ...observability import monotonic
 
     out = {}
     if _interpret():
@@ -510,11 +510,11 @@ def autotune_mlp(rows, h, dtype=jnp.bfloat16, kinds=("ln", "gelu"),
             try:
                 step = make_step()  # fresh closure: blocks read at trace
                 step(x).block_until_ready()  # compile + warmup
-                t0 = time.perf_counter()
+                t0 = monotonic()
                 for _ in range(iters):
                     r = step(x)
                 r.block_until_ready()
-                t = time.perf_counter() - t0
+                t = monotonic() - t0
             except Exception:
                 continue
             if t < best_t:

@@ -496,7 +496,7 @@ def autotune_page_size(batch, hq, hkv, d, max_len=2048, dtype=jnp.bfloat16,
     an explicit eager call to run once before building caches; the winner
     then flows through :func:`preferred_page_size`. Returns the page size.
     """
-    import time
+    from ...observability import monotonic
 
     if _interpret():
         return preferred_page_size(hq, hkv, d, dtype)
@@ -518,11 +518,11 @@ def autotune_page_size(batch, hq, hkv, d, max_len=2048, dtype=jnp.bfloat16,
             step = jax.jit(functools.partial(paged_attention,
                                              use_kernel=True))
             step(q, kp, vp, pt, lens).block_until_ready()  # compile+warmup
-            t0 = time.perf_counter()
+            t0 = monotonic()
             for _ in range(iters):
                 out = step(q, kp, vp, pt, lens)
             out.block_until_ready()
-            t = time.perf_counter() - t0
+            t = monotonic() - t0
         except Exception:
             continue
         if t < best_t:
@@ -561,7 +561,7 @@ def autotune_chunk_size(batch, hq, hkv, d, max_len=2048, page_size=None,
     persist the winner on the shared autotune cache. The sweep times a
     mixed step (half the lanes decode 1 token, half prefill a full chunk —
     the steady-state unified-step shape). Returns the chunk size."""
-    import time
+    from ...observability import monotonic
 
     if _interpret():
         return preferred_chunk_size(hq, hkv, d, dtype)
@@ -586,12 +586,12 @@ def autotune_chunk_size(batch, hq, hkv, d, max_len=2048, page_size=None,
             step = jax.jit(functools.partial(ragged_paged_attention,
                                              use_kernel=True))
             step(q, kp, vp, pt, kv_lens, q_lens).block_until_ready()
-            t0 = time.perf_counter()
+            t0 = monotonic()
             for _ in range(iters):
                 out = step(q, kp, vp, pt, kv_lens, q_lens)
             out.block_until_ready()
             # normalize per useful token: bigger chunks do more work/step
-            t = (time.perf_counter() - t0) / float(q_lens.sum())
+            t = (monotonic() - t0) / float(q_lens.sum())
         except Exception:
             continue
         if t < best_t:

@@ -278,7 +278,7 @@ def autotune_quant_matmul(m, k, n, bits=8, group_size=-1,
                           iters=10):
     """Sweep (bm, bn, bk) for this GEMM signature on the current device and
     persist the winner on the shared autotune cache. No-op off-TPU."""
-    import time
+    from ...observability import monotonic
 
     if _interpret():
         return _blocks_for(m, k, n, bits, _group(group_size, k), dtype)
@@ -299,11 +299,11 @@ def autotune_quant_matmul(m, k, n, bits=8, group_size=-1,
         try:
             step = jax.jit(functools.partial(quant_matmul, use_kernel=True))
             step(x, qw, s).block_until_ready()
-            t0 = time.perf_counter()
+            t0 = monotonic()
             for _ in range(iters):
                 out = step(x, qw, s)
             out.block_until_ready()
-            t = time.perf_counter() - t0
+            t = monotonic() - t0
         except Exception:
             continue
         if t < best_t:

@@ -1,0 +1,239 @@
+"""Round-16 megakernelized decode layer: the fused per-layer Pallas
+kernels (ops/pallas/mega_decode) against their composed jnp oracles —
+the per-op references chained in the megakernel's exact stage order —
+across fp/int8-weight/int8-KV geometries, in interpret mode on CPU (the
+real kernel bodies run; TPU-compiled parity is the on-chip bench's job).
+The serving-level gates (greedy mega == full-forward oracle, mega-off
+bit-identity) live in tests/test_serving.py's round-16 block.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu  # noqa: F401  (framework config: x64 off, cpu)
+from paddle_tpu.inference.quantize import quantize_weight
+from paddle_tpu.ops.pallas.mega_decode import (
+    mega_attn_layer, mega_attn_layer_reference, mega_mlp,
+    mega_mlp_reference, preferred_mega_blocks, validate_mega_config)
+
+H, HD, F = 32, 8, 64          # 4 heads, 2x ffn — tiny but MXU-shaped
+PAGE = 8
+
+
+def _layer(rng, h=H, f=F, quant=None, group=-1, head_major=False):
+    def w(*s):
+        return jnp.asarray(rng.randn(*s) * 0.05, jnp.float32)
+
+    wqkv, bqkv = w(h, 3 * h), w(3 * h) * 0.1
+    if head_major:
+        # the mesh layout: qkv columns permuted [3, nh, hd] -> [nh, 3, hd]
+        nh = h // HD
+        perm = np.arange(3 * h).reshape(3, nh, HD).transpose(1, 0, 2
+                                                             ).reshape(-1)
+        wqkv, bqkv = wqkv[:, perm], bqkv[perm]
+    p = {
+        "ln1_g": jnp.ones((h,), jnp.float32), "ln1_b": w(h) * 0.1,
+        "ln2_g": jnp.ones((h,), jnp.float32), "ln2_b": w(h) * 0.1,
+        "wqkv": wqkv, "bqkv": bqkv,
+        "wo": w(h, h), "bo": w(h) * 0.1,
+        "w1": w(h, f), "b1": w(f) * 0.1,
+        "w2": w(f, h), "b2": w(h) * 0.1,
+    }
+    if quant:
+        for k in ("wqkv", "wo", "w1", "w2"):
+            p[k] = quantize_weight(p[k], quant, group_size=group)
+    return p
+
+
+def _pools(rng, num_pages, nh, kv_quant):
+    if kv_quant:
+        kq = jnp.asarray(rng.randint(-127, 128,
+                                     (num_pages, PAGE, nh, HD)), jnp.int8)
+        vq = jnp.asarray(rng.randint(-127, 128,
+                                     (num_pages, PAGE, nh, HD)), jnp.int8)
+        ks = jnp.asarray(np.abs(rng.randn(num_pages, PAGE, nh)) * 0.01
+                         + 1e-3, jnp.float32)
+        vs = jnp.asarray(np.abs(rng.randn(num_pages, PAGE, nh)) * 0.01
+                         + 1e-3, jnp.float32)
+        return kq, vq, ks, vs
+    kq = jnp.asarray(rng.randn(num_pages, PAGE, nh, HD), jnp.float32)
+    vq = jnp.asarray(rng.randn(num_pages, PAGE, nh, HD), jnp.float32)
+    return kq, vq, None, None
+
+
+def _geometry(rng, b=3, chunk=2, pps=3, kv_quant=False):
+    """A ragged decode-round geometry: lane 0 deep-context single token,
+    lane 1 idle (q_len 0), lane 2 fresh-context multi-row (the spec
+    verify-rows shape) — plus one lane at ctx 0 when b > 3."""
+    nh = H // HD
+    num_pages = b * pps + 2
+    pools = _pools(rng, num_pages, nh, kv_quant)
+    pt = np.full((b, pps), -1, np.int32)
+    ctx = np.zeros((b,), np.int32)
+    qlens = np.zeros((b,), np.int32)
+    ctx[0], qlens[0] = 13, 1
+    ctx[2], qlens[2] = 5, chunk
+    if b > 3:
+        ctx[3], qlens[3] = 0, 1        # first-token lane: empty pool ctx
+    used = iter(range(num_pages))
+    for i in range(b):
+        need = -(-int(ctx[i] + qlens[i]) // PAGE) if qlens[i] else 0
+        for j in range(need):
+            pt[i, j] = next(used)
+    xb = jnp.asarray(rng.randn(b, chunk, H), jnp.float32)
+    return (xb, pools, jnp.asarray(pt), jnp.asarray(ctx),
+            jnp.asarray(qlens))
+
+
+def _assert_close(ref, ker, qlens, chunk, tol=2e-3):
+    valid = np.asarray(qlens)[:, None] > np.arange(chunk)[None]
+    for r, k in zip(ref, ker):
+        rv, kv = np.asarray(r, np.float32), np.asarray(k, np.float32)
+        m = np.broadcast_to(
+            valid.reshape(valid.shape + (1,) * (rv.ndim - 2)), rv.shape)
+        assert np.abs(np.where(m, rv - kv, 0)).max() <= tol
+
+
+@pytest.mark.parametrize("quant,group,kv_quant", [
+    (None, -1, False),
+    ("int8", -1, False),        # per-channel weight scales
+    ("int8", 16, False),        # grouped scales (2 groups over h)
+    (None, -1, True),           # int8 KV pools, fp weights
+    ("int8", 16, True),         # the flagship int8w+int8kv leg
+])
+def test_mega_attn_kernel_matches_composed_oracle(rng, quant, group,
+                                                  kv_quant):
+    p = _layer(rng, quant=quant, group=group)
+    xb, (kp, vp, ks, vs), pt, ctx, qlens = _geometry(rng, b=4,
+                                                     kv_quant=kv_quant)
+    ref = mega_attn_layer_reference(xb, p, kp, vp, pt, ctx, qlens,
+                                    k_scales=ks, v_scales=vs)
+    ker = mega_attn_layer(xb, p, kp, vp, pt, ctx, qlens, k_scales=ks,
+                          v_scales=vs, use_kernel=True)
+    assert len(ref) == len(ker) == (6 if kv_quant else 4)
+    _assert_close(ref, ker, qlens, xb.shape[1])
+    if kv_quant:
+        # the emitted K/V payloads are int8 and BIT-identical: kernel and
+        # oracle share the exact paged_write_packed_quant formula
+        assert ker[2].dtype == jnp.int8 and ker[3].dtype == jnp.int8
+        q0 = int(qlens[0])
+        np.testing.assert_array_equal(np.asarray(ker[2])[0, :q0],
+                                      np.asarray(ref[2])[0, :q0])
+
+
+def test_mega_attn_head_major_layout(rng):
+    """The mesh (head-major) qkv column order — same dots, permuted
+    columns — must produce the same layer outputs as the eager layout."""
+    rng2 = np.random.RandomState(rng.randint(1 << 30))
+    p = _layer(rng2, head_major=True)
+    xb, (kp, vp, _, _), pt, ctx, qlens = _geometry(rng2)
+    ref = mega_attn_layer_reference(xb, p, kp, vp, pt, ctx, qlens,
+                                    head_major=True)
+    ker = mega_attn_layer(xb, p, kp, vp, pt, ctx, qlens, head_major=True,
+                          use_kernel=True)
+    _assert_close(ref, ker, qlens, xb.shape[1])
+
+
+def test_mega_attn_chunk_padding(rng):
+    """A chunk that is not a multiple of the 8-row sublane tile pads
+    in-kernel; rows past each lane's q_len are never compared (garbage by
+    contract — nothing downstream reads them)."""
+    p = _layer(rng)
+    xb, (kp, vp, _, _), pt, ctx, qlens = _geometry(rng, chunk=5, pps=4)
+    ref = mega_attn_layer_reference(xb, p, kp, vp, pt, ctx, qlens)
+    ker = mega_attn_layer(xb, p, kp, vp, pt, ctx, qlens, use_kernel=True)
+    _assert_close(ref, ker, qlens, 5)
+
+
+@pytest.mark.parametrize("quant,group", [
+    (None, -1), ("int8", -1), ("int8", 16),
+])
+def test_mega_mlp_matches_composed_oracle(rng, quant, group):
+    p = _layer(rng, quant=quant, group=group)
+    t = 6
+    y2 = jnp.asarray(rng.randn(t, H), jnp.float32)
+    sres = jnp.asarray(rng.randn(t, H), jnp.float32)
+    ref = mega_mlp_reference(y2, sres, p)
+    ker = mega_mlp(y2, sres, p, use_kernel=True)
+    np.testing.assert_allclose(np.asarray(ker), np.asarray(ref),
+                               atol=2e-3, rtol=0)
+
+
+def test_mega_mlp_row_padding(rng):
+    """Token counts off the 8-row tile pad and strip transparently."""
+    p = _layer(rng)
+    for t in (1, 3, 9):
+        y2 = jnp.asarray(rng.randn(t, H), jnp.float32)
+        sres = jnp.asarray(rng.randn(t, H), jnp.float32)
+        np.testing.assert_allclose(
+            np.asarray(mega_mlp(y2, sres, p, use_kernel=True)),
+            np.asarray(mega_mlp_reference(y2, sres, p)), atol=2e-3)
+
+
+def test_validate_mega_config_rejections():
+    """The build-time gate: int4 weights, mp > 1 meshes and head-dim-
+    straddling scale groups are rejected LOUDLY (callers stay per-op);
+    servable geometries pass silently."""
+    validate_mega_config(None, -1, 16)
+    validate_mega_config("int8", -1, 16)
+    validate_mega_config("int8", 16, 16)     # group == head_dim
+    validate_mega_config("int8", 8, 16)      # two groups per head tile
+    validate_mega_config("int8", 32, 16)     # one group spans two tiles
+    with pytest.raises(ValueError, match="int4"):
+        validate_mega_config("int4", -1, 16)
+    with pytest.raises(ValueError, match="chip-local"):
+        validate_mega_config(None, -1, 16, mp=2)
+    with pytest.raises(ValueError, match="group"):
+        validate_mega_config("int8", 24, 16)  # 16 % 24 and 24 % 16 != 0
+
+
+def test_mega_mlp_grouped_scale_tile_branches(rng):
+    """Both grouped-w2-scale tile shapes stay correct AND the autotuned
+    width survives grouping: bn >= group serves MULTIPLE scale rows per
+    tile (reshape branch, tile a multiple of the group — not collapsed
+    to it), bn < group spans one scale row across tiles (index branch).
+    The cache is seeded to force each branch deterministically."""
+    from paddle_tpu.ops.pallas import autotune_cache as atc
+    from paddle_tpu.ops.pallas.mega_decode import _mega_sig, _mlp_bn
+
+    p = _layer(rng, quant="int8", group=16)   # w2: K=F=64, 4 groups gs=16
+    t = 6
+    y2 = jnp.asarray(rng.randn(t, H), jnp.float32)
+    sres = jnp.asarray(rng.randn(t, H), jnp.float32)
+    ref = mega_mlp_reference(y2, sres, p)
+    sig = _mega_sig(H, F, jnp.float32)
+    saved = atc.CACHE.get(sig)
+    try:
+        for bn_pref, want_bn in ((32, 32), (8, 8)):
+            atc.CACHE[sig] = [64, bn_pref, H]
+            assert _mlp_bn(F, 4, H, jnp.float32) == want_bn
+            np.testing.assert_allclose(
+                np.asarray(mega_mlp(y2, sres, p, use_kernel=True)),
+                np.asarray(ref), atol=2e-3, rtol=0)
+    finally:
+        if saved is None:
+            atc.CACHE.pop(sig, None)
+        else:
+            atc.CACHE[sig] = saved
+
+
+def test_preferred_mega_blocks_default_and_cache_roundtrip():
+    """The sweep's persisted winner must be READ BACK by the serve-time
+    lookup — writer and reader derive the SAME signature (a key the
+    lookup cannot reconstruct is a cache that never hits)."""
+    from paddle_tpu.ops.pallas import autotune_cache as atc
+    from paddle_tpu.ops.pallas.mega_decode import _mega_sig
+
+    bm, bn, bk = preferred_mega_blocks(H, F, jnp.float32)
+    assert bm > 0 and bn > 0 and bk == H
+    sig = _mega_sig(H, F, jnp.float32)
+    saved = atc.CACHE.get(sig)
+    try:
+        atc.CACHE[sig] = [16, 32, H]
+        assert preferred_mega_blocks(H, F, jnp.float32) == (16, 32, H)
+    finally:
+        if saved is None:
+            atc.CACHE.pop(sig, None)
+        else:
+            atc.CACHE[sig] = saved

@@ -486,19 +486,33 @@ def test_bench_serve_smoke_schema():
         _bench_serve_smoke_once()
 
 
+_SMOKE_LEGS = ("legacy-two-jit,unified-step,unified-async,unified-obs,"
+               "unified-spmd,unified-spec-base,unified-spec-k4,"
+               "unified-int8w,unified-int8w-int8kv")
+
+
 def _bench_serve_smoke_once():
+    # round 16: the tier-1 smoke runs its gated subset through the
+    # --legs selector (the round-16 mega leg has its own gated test —
+    # test_bench_serve_mega_leg_gates — so the pair's churn is not paid
+    # twice here)
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     proc = subprocess.run(
         [sys.executable, "bench_serve.py", "--smoke", "--steps=6",
-         "--batch=2", "--prompt=8", "--gen-len=3"],
+         "--batch=2", "--prompt=8", "--gen-len=3",
+         f"--legs={_SMOKE_LEGS}"],
         cwd=root, capture_output=True, text=True, timeout=600,
         env={**os.environ, "JAX_PLATFORMS": "cpu"})
     assert proc.returncode == 0, proc.stderr[-2000:]
     lines = [l for l in proc.stdout.strip().splitlines() if l.strip()]
     assert len(lines) == 9, proc.stdout
-    for line in lines:
+    for line, want_leg in zip(lines, _SMOKE_LEGS.split(",")):
         rec = json.loads(line)
         assert "error" not in rec, rec
+        # round 16: every serving line names its leg (enum-checked by
+        # the schema) and it matches the emit order
+        assert rec["leg"] == want_leg
+        assert rec["device_ms_per_step"] > 0
         assert rec["unit"] == "tokens/s" and rec["value"] > 0
         assert rec["p50_ms"] > 0 and rec["p99_ms"] >= rec["p50_ms"]
         assert rec["ttft_p50_ms"] > 0
@@ -1957,3 +1971,202 @@ def test_async_step_returns_tokens_one_behind(rng):
         collected.setdefault(rid, []).extend(toks)
     for r in reqs:
         assert collected.get(r.req_id, []) == r.output_ids
+
+
+# -- round 16: megakernelized decode hot loop -------------------------------
+# GPTConfig.mega_decode routes ALL-DECODE serving rounds through the fused
+# per-layer Pallas megakernels (ops/pallas/mega_decode) at their own decode
+# geometry; mixed prefill+decode rounds keep the per-op unified step. The
+# gates here: greedy mega == the full-forward oracle token-for-token, the
+# mega-on engine emits BIT-IDENTICAL greedy/sampled streams to mega-off
+# (which is itself the unchanged round-15 code path — the mega-off
+# equivalence contract), and the spec/quant/mesh/async compositions hold.
+
+
+def test_mega_generate_matches_full_forward_oracle(rng):
+    """Greedy generate with mega_decode on == the no-cache full-forward
+    oracle token-for-token — reference path AND interpret-kernel leg."""
+    model = _tiny_model(mega_decode=True)
+    ids = rng.randint(0, TINY["vocab_size"], (2, 11)).astype(np.int64)
+    want = _oracle_greedy(model, ids, 8)
+    got = model.generate(paddle.to_tensor(ids), max_new_tokens=8,
+                         page_size=8, chunk=4).numpy()
+    np.testing.assert_array_equal(got, want)
+    # the interpret-kernel leg: the REAL megakernel bodies on CPU
+    got_k = model.generate(paddle.to_tensor(ids), max_new_tokens=8,
+                           page_size=8, chunk=4, use_kernel=True).numpy()
+    np.testing.assert_array_equal(got_k, want)
+
+
+def test_mega_generate_no_per_token_retrace(rng):
+    """The mega route adds ONE more fixed-shape program (the decode-
+    geometry build), never a per-token or per-round trace."""
+    from paddle_tpu.models.gpt import generate_paged
+
+    model = _tiny_model(mega_decode=True)
+    ids = rng.randint(0, TINY["vocab_size"], (2, 9)).astype(np.int64)
+    model.generate(paddle.to_tensor(ids), max_new_tokens=8, page_size=8,
+                   chunk=4)
+    assert generate_paged.last_decode_trace_count <= 2  # per-op + mega
+    model.generate(paddle.to_tensor(ids), max_new_tokens=8, page_size=8,
+                   chunk=4)
+    assert generate_paged.last_decode_trace_count == 0
+
+
+def test_mega_predictor_bit_identical_to_mega_off_async_churn(rng):
+    """THE round-16 equivalence gate: the mega-on predictor (async
+    engine, the production default) reproduces the mega-off predictor —
+    the UNCHANGED round-15 code path — token-for-token over a continuous
+    churn mixing admissions, chunked prefill, decode and retirement;
+    greedy and seeded-sampled streams alike."""
+    prompts = _churn_prompts(rng, 24)
+    for sampling in ({}, dict(temperature=0.8, top_k=12, seed=11)):
+        model = _tiny_model(mega_decode=True)
+        sp_on = ServingPredictor(model, max_batch=3, max_seq_len=96,
+                                 page_size=8, chunk=4)
+        on, _ = _drive_churn(sp_on, prompts, 6, **sampling)
+        model_off = _tiny_model()
+        sp_off = ServingPredictor(model_off, max_batch=3, max_seq_len=96,
+                                  page_size=8, chunk=4)
+        off, _ = _drive_churn(sp_off, prompts, 6, **sampling)
+        assert on == off
+    # the mega route actually ran: both programs traced exactly once
+    assert sp_on.decode_trace_count == 2
+    assert sp_off.decode_trace_count == 1
+
+
+def test_mega_spec_depth_zero_identical(rng):
+    """Speculative decoding composes: mega routes the 1 + k verify rows
+    through the fused kernel's in-register causal block — emissions match
+    the per-op speculative engine (which already reconciles depth-zero)
+    and the spec-off oracle stream."""
+    prompts = [np.tile(rng.randint(0, TINY["vocab_size"], (3,)), 6)
+               .tolist() for _ in range(6)]
+    model = _tiny_model(mega_decode=True)
+    sp_on = ServingPredictor(model, max_batch=3, max_seq_len=96,
+                             page_size=8, chunk=8, spec_decode_k=2)
+    on, _ = _drive_churn(sp_on, prompts, 6)
+    model_off = _tiny_model()
+    sp_off = ServingPredictor(model_off, max_batch=3, max_seq_len=96,
+                              page_size=8, chunk=8, spec_decode_k=2)
+    off, _ = _drive_churn(sp_off, prompts, 6)
+    assert on == off
+    # speculation actually accepted drafts on the mega route
+    assert sp_on.spec_accepted > 0
+
+
+def test_mega_quantized_int8w_int8kv_matches_mega_off(rng):
+    """The flagship quantized composition: int8 weights (grouped scales,
+    dequant fused tile-by-tile in the megakernel) + int8 KV (quantize-on-
+    write IN-KERNEL, scatter via paged_write_packed_prequant) — greedy
+    emissions identical to the mega-off int8w+int8kv path, and the pools
+    stay int8."""
+    quant = dict(weight_dtype="int8", weight_quant_group_size=8,
+                 kv_cache_dtype="int8")
+    prompts = _churn_prompts(rng, 12)
+    model = _tiny_model(mega_decode=True, **quant)
+    sp_on = ServingPredictor(model, max_batch=3, max_seq_len=96,
+                             page_size=8, chunk=4)
+    on, _ = _drive_churn(sp_on, prompts, 5)
+    model_off = _tiny_model(**quant)
+    sp_off = ServingPredictor(model_off, max_batch=3, max_seq_len=96,
+                              page_size=8, chunk=4)
+    off, _ = _drive_churn(sp_off, prompts, 5)
+    assert on == off
+    assert sp_on.cache.k_pages.dtype == jnp.int8
+    assert sp_on.cache.k_scales is not None
+
+
+def test_mega_mesh1_token_identical(rng):
+    """mesh=1 (the sharded program on one chip, head-major params) with
+    mega on is token-identical to mesh=None mega — and to plain."""
+    model = _tiny_model(mega_decode=True)
+    ids = rng.randint(0, TINY["vocab_size"], (2, 7)).astype(np.int64)
+    want = _oracle_greedy(model, ids, 6)
+    got = model.generate(paddle.to_tensor(ids), max_new_tokens=6,
+                         page_size=8, chunk=4, mesh=1).numpy()
+    np.testing.assert_array_equal(got, want)
+
+
+def test_mega_rejections_are_loud(rng):
+    """int4 weights and mp > 1 meshes cannot be served by the megakernel:
+    the predictor fails at CONSTRUCTION with the real reason, and the
+    legacy two-jit path refuses the flag."""
+    model = _tiny_model(mega_decode=True, weight_dtype="int4")
+    with pytest.raises(ValueError, match="int4"):
+        ServingPredictor(model, max_batch=2, max_seq_len=96, page_size=8)
+    model2 = _tiny_model(mega_decode=True)
+    with pytest.raises(ValueError, match="legacy"):
+        ServingPredictor(model2, max_batch=2, max_seq_len=96, page_size=8,
+                         unified=False)
+    import jax
+
+    if len(jax.devices()) >= 2:
+        with pytest.raises(ValueError, match="chip-local"):
+            ServingPredictor(model2, max_batch=2, max_seq_len=96,
+                             page_size=8, mesh=2)
+
+
+def test_bench_serve_mega_leg_gates():
+    """The round-16 bench acceptance (via --legs, the tier-1 smoke
+    subset selector): the int8w+int8kv mega leg's analytic
+    hbm_bytes_per_token sits STRICTLY below its interleaved mega-off
+    partner's (the per-op activation round-trips bought back), greedy
+    emissions are bit-identical across the pair, and the device-time
+    metric is live on the schema-checked line."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, "bench_serve.py", "--smoke", "--steps=6",
+         "--batch=2", "--prompt=8", "--gen-len=3",
+         "--legs=unified-mega"],
+        cwd=root, capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [l for l in proc.stdout.strip().splitlines() if l.strip()]
+    assert len(lines) == 1, proc.stdout
+    rec = json.loads(lines[0])
+    assert "error" not in rec, rec
+    assert rec["leg"] == "unified-mega"
+    assert rec["value"] > 0 and rec["mega_off_tokens_per_s"] > 0
+    assert rec["decode_retraces"] == 1            # both routed programs
+    assert rec["mega_emissions_match"] == 1.0
+    assert rec["device_ms_per_step"] > 0
+    assert rec["mega_off_device_ms_per_step"] > 0
+    # the acceptance criterion: the megakernel leg's per-token HBM bytes
+    # strictly below the per-op leg's on the same quantized churn
+    assert (rec["hbm_bytes_per_token"]
+            < rec["mega_off_hbm_bytes_per_token"])
+
+
+def test_bench_serve_legs_filtered_baseline_omits_ratio():
+    """--legs selecting a leg WITHOUT its baseline leg must omit the
+    (schema-optional) vs_baseline rather than emit the 0.0 dead-baseline
+    error signal on a healthy partial run."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, "bench_serve.py", "--smoke", "--steps=6",
+         "--batch=2", "--prompt=8", "--gen-len=3",
+         "--legs=unified-int8w"],
+        cwd=root, capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [l for l in proc.stdout.strip().splitlines() if l.strip()]
+    assert len(lines) == 1, proc.stdout
+    rec = json.loads(lines[0])
+    assert "error" not in rec, rec
+    assert rec["leg"] == "unified-int8w"
+    assert rec["value"] > 0
+    assert "vs_baseline" not in rec, rec
+
+
+def test_bench_serve_legs_selector_rejects_typo():
+    """A typo'd leg name fails AT THE CLI (the known-legs enum), not as a
+    silently-missing line two rounds later."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, "bench_serve.py", "--smoke",
+         "--legs=unified-stpe"],
+        cwd=root, capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode != 0
+    assert "unknown leg" in (proc.stderr + proc.stdout)
