@@ -157,7 +157,7 @@ class _ChurnLeg:
                  dtype=None, weight_dtype=None, kv_cache_dtype=None,
                  mesh_chips=1, spec_decode_k=0, spec_workload=False,
                  async_engine=False, observability=False,
-                 mega_decode=False):
+                 mega_decode=False, slo=None):
         # async_engine stays EXPLICIT here (default False = the sync
         # baseline leg) even though round 14 flipped the predictor's own
         # default to async: the legacy/quant/spec/spmd legs are the
@@ -193,7 +193,7 @@ class _ChurnLeg:
             chunk=chunk,
             dtype=jnp.bfloat16 if (on_tpu and dtype is None) else dtype,
             mesh=mesh, spec_decode_k=spec_decode_k,
-            async_engine=async_engine, mega_decode=mega_decode)
+            async_engine=async_engine, mega_decode=mega_decode, slo=slo)
         rng = np.random.RandomState(0)
         if spec_workload:
             # tiled 4-token motifs: every prompt internally repetitive
@@ -222,8 +222,10 @@ class _ChurnLeg:
 
     def top_up(self):
         # keep the lanes full: every finished request is replaced by a
-        # fresh one on the NEXT pool prompt (round-robin -> prefix reuse)
-        live = sum(1 for r in self.reqs if r.state != "finished")
+        # fresh one on the NEXT pool prompt (round-robin -> prefix reuse);
+        # terminal means FINISHED or (round 17) FAILED
+        live = sum(1 for r in self.reqs
+                   if r.state not in ("finished", "failed"))
         while live < self.batch:
             self.reqs.append(self.sp.add_request(
                 self.pool[self.arrivals % len(self.pool)],
@@ -348,6 +350,95 @@ class _ChurnLeg:
             out["draft_acceptance_rate"] = round(
                 sp.draft_acceptance_rate, 3)
         return out
+
+
+class _OverloadLeg(_ChurnLeg):
+    """The round-17 overload churn: arrivals deliberately exceed capacity
+    (``overload``x the lane count stays live, so the bounded waiting
+    queue overflows every round and the armed SLO sheds), and every
+    ``deadline_every``-th arrival carries an already-expired deadline
+    (``deadline_s=0.0`` — the queue-TTL sweep fails it deterministically
+    at the next scheduler round; the rest get a generous deadline that
+    never fires). The predictor keeps serving the admitted lanes
+    throughout — ``value`` stays a real tokens/s — while the leg reports
+    the shed / deadline-miss / terminal-failure accounting the fleet
+    router consumes. ``overload=1`` with no expired deadlines is the
+    nominal-load partner whose rates the gate holds at exactly zero."""
+
+    def __init__(self, *, overload=3, deadline_every=0, **kw):
+        from paddle_tpu.inference import SLOConfig
+
+        super().__init__(slo=SLOConfig(max_waiting=kw["batch"] + 2), **kw)
+        self.target_live = self.batch * overload
+        self.deadline_every = deadline_every
+
+    def _add_one(self):
+        n = self.arrivals
+        deadline = (0.0 if self.deadline_every
+                    and n % self.deadline_every == 0 else 60.0)
+        self.reqs.append(self.sp.add_request(
+            self.pool[n % len(self.pool)], max_new_tokens=self.gen_len,
+            deadline_s=deadline))
+        self.arrivals += 1
+        return self.reqs[-1]
+
+    def top_up(self):
+        # flood: submit until target_live requests are non-terminal, but
+        # at most target_live attempts per round — a shed admission comes
+        # back terminal instantly and must not trigger an unbounded
+        # resubmit storm within one scheduler round
+        live = sum(1 for r in self.reqs
+                   if r.state not in ("finished", "failed"))
+        for _ in range(self.target_live):
+            if live >= self.target_live:
+                break
+            if self._add_one().state != "failed":
+                live += 1
+
+    def warm(self):
+        # the base warm-up waits for every first-wave request to produce
+        # — under overload some of the first wave is shed or TTL-expired
+        # and never will: wait for produced-or-terminal instead
+        self.top_up()
+        self.first_wave = list(self.reqs)
+        while any(r.state not in ("finished", "failed")
+                  and not r.output_ids for r in self.first_wave):
+            self.sp.step()
+        self.sp.flush()
+        self.decode_before = self.sp.decode_trace_count
+        self.timed_from = len(self.reqs)
+        self.emitted_before = self.sp.tokens_emitted
+
+    def report(self):
+        out = super().report()
+        flat = self.sp.telemetry()
+        arrivals = max(1, self.arrivals)
+        out["shed_rate"] = round(flat["serving_requests_shed"] / arrivals, 4)
+        out["deadline_miss_rate"] = round(
+            flat["serving_deadline_misses"] / arrivals, 4)
+        out["failed_requests"] = int(flat["serving_requests_failed"])
+        return out
+
+
+def bench_serving_overload(*, steps, windows, **leg_kw):
+    """The round-17 resilience pair: the SAME churn shape at overload
+    (3x arrivals, bounded queue, expired-deadline stragglers — the SLO
+    sheds every round) vs nominal load (the armed-but-quiet partner),
+    windows interleaved like the engine A/B. Returns
+    ``(overload_out, nominal_out)``; the emitted overload line carries
+    the nominal partner's rates — the schema-gated contract is
+    ``shed_rate > 0`` under overload and ``== 0`` at nominal load."""
+    over_leg = _OverloadLeg(overload=3, deadline_every=3,
+                            async_engine=True, **leg_kw)
+    nom_leg = _OverloadLeg(overload=1, deadline_every=0,
+                           async_engine=True, **leg_kw)
+    over_leg.warm()
+    nom_leg.warm()
+    with _gc_frozen():
+        for _ in range(windows):
+            over_leg.window(steps)
+            nom_leg.window(steps)
+    return over_leg.report(), nom_leg.report()
 
 
 class _gc_frozen:
@@ -560,6 +651,11 @@ def main():
         ("unified-int8w", dict(unified=True, weight_dtype="int8")),
         ("unified-int8w-int8kv", dict(unified=True, weight_dtype="int8",
                                       kv_cache_dtype="int8")),
+        # round-17 resilience A/B: the SAME churn shape flooded past
+        # capacity (bounded queue + expired-deadline stragglers, SLO
+        # armed) vs nominal load — shed/deadline/failure accounting on
+        # the line, nominal partner's rates riding it at exactly zero
+        ("unified-overload", None),
         # round-16 A/B: the SAME int8w+int8kv churn with the decode hot
         # loop per-op vs megakernelized (fused per-layer Pallas kernels,
         # activations pinned in VMEM) — measured interleaved, greedy
@@ -644,6 +740,21 @@ def main():
                 out["mega_emissions_match"] = _streams_match(
                     on_out["_streams"], off_out["_streams"])
                 results[name] = out
+            elif name == "unified-overload":
+                over_out, nom_out = bench_serving_overload(
+                    unified=True, on_tpu=on_tpu, use_kernel=use_kernel,
+                    **ab_shape, **ab_kw)
+                out = dict(metric=ab_metric_for(name), **over_out)
+                # the nominal partner's rates ride the overload line: the
+                # schema-gated contract is shed_rate > 0 under overload,
+                # exactly 0 at nominal load (same predictor config)
+                out["nominal_shed_rate"] = nom_out["shed_rate"]
+                out["nominal_deadline_miss_rate"] = (
+                    nom_out["deadline_miss_rate"])
+                out["vs_baseline"] = (
+                    round(out["value"] / nom_out["value"], 3)
+                    if nom_out["value"] else 0.0)
+                results[name] = out
             elif name == "unified-obs":
                 off_out, on_out, ratio = bench_serving_obs_ab(
                     unified=True, on_tpu=on_tpu, use_kernel=use_kernel,
@@ -709,6 +820,10 @@ def main():
     _emit("unified-spec-k4", "unified-spec-base")
     _emit("unified-int8w", "unified-step")
     _emit("unified-int8w-int8kv", "unified-step")
+    # round-17 resilience leg (self-baselined on its interleaved
+    # nominal-load partner: vs_baseline = overload/nominal tokens/s —
+    # how much throughput the shed storm costs the served lanes)
+    _emit("unified-overload", None)
     # round-16 flagship LAST: the megakernelized int8w+int8kv decode A/B
     # (self-baselined on its interleaved mega-off partner)
     _emit("unified-mega", None)

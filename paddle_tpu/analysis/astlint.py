@@ -39,6 +39,15 @@ Rule catalog:
   clock — the round-15 rule that keeps ad-hoc ``_t0 =
   time.perf_counter()`` fields from re-accreting in the serving/
   collective/autotune hot paths.
+- **AL007 swallowed-exception** — a bare ``except:`` or broad ``except
+  Exception/BaseException:`` whose whole body is ``pass`` (or ``...``)
+  in ``paddle_tpu/inference/`` or ``paddle_tpu/distributed/``: the
+  round-17 resilience layer's contract is that failures are COUNTED,
+  recorded on the request, retried or re-raised — a silently-swallowed
+  exception in the serving/collective hot paths is exactly the failure
+  mode the FAILED state and the step-retry machinery exist to make
+  loud. Narrow exception types, and handlers that log / count /
+  re-raise, do not fire.
 """
 from __future__ import annotations
 
@@ -55,6 +64,8 @@ AL004 = rule("AL004", "pl.BlockSpec tile constant not (8,128)-aligned")
 AL005 = rule("AL005", "apply_op/make_op name with no op-registry row")
 AL006 = rule("AL006", "raw time.perf_counter timing outside the "
                       "observability layer")
+AL007 = rule("AL007", "swallowed exception (except [Exception]: pass) in "
+                      "a serving/distributed hot path")
 
 _SAMPLERS = {
     "normal", "uniform", "bernoulli", "randint", "truncated_normal",
@@ -380,12 +391,64 @@ class _FileLint(ast.NodeVisitor):
                     "clock",
                     n)
 
+    # -- AL007 swallowed exceptions in the serving/distributed hot paths ----
+
+    #: directories where a silently-swallowed broad exception is fenced
+    #: (trailing slash, same convention as AL006): the round-17 resilience
+    #: contract — failures are counted/recorded/retried/re-raised, never
+    #: dropped on the floor
+    _SWALLOW_DIRS = ("paddle_tpu/inference/", "paddle_tpu/distributed/")
+    _BROAD_EXCS = ("Exception", "BaseException", "builtins.Exception",
+                   "builtins.BaseException")
+
+    def _is_broad_handler(self, handler: ast.ExceptHandler) -> bool:
+        t = handler.type
+        if t is None:
+            return True          # bare except:
+        if isinstance(t, ast.Tuple):
+            return any(_dotted(e) in self._BROAD_EXCS for e in t.elts)
+        return _dotted(t) in self._BROAD_EXCS
+
+    @staticmethod
+    def _is_swallow_body(body) -> bool:
+        """True when the handler body does NOTHING: only pass / bare
+        ``...`` expression statements."""
+        for stmt in body:
+            if isinstance(stmt, ast.Pass):
+                continue
+            if (isinstance(stmt, ast.Expr)
+                    and isinstance(stmt.value, ast.Constant)
+                    and stmt.value.value is Ellipsis):
+                continue
+            return False
+        return True
+
+    def check_swallowed_exceptions(self):
+        path = self.path.replace(os.sep, "/")
+        if not any(path.startswith(d) for d in self._SWALLOW_DIRS):
+            return
+        for n in ast.walk(self.tree):
+            if not isinstance(n, ast.ExceptHandler):
+                continue
+            if self._is_broad_handler(n) and self._is_swallow_body(n.body):
+                what = ("bare except" if n.type is None
+                        else f"except {_dotted(n.type) or '...'}")
+            else:
+                continue
+            self._emit(
+                AL007, what,
+                f"{what}: pass in {path} swallows every failure silently "
+                "— count it, record it on the request, retry or re-raise "
+                "(narrow the type if the drop is deliberate)",
+                n)
+
     def run(self):
         self.check_rng_reuse()
         self.check_jitted_bodies()
         self.check_blockspec_tiles()
         self.check_unregistered_ops()
         self.check_raw_timing()
+        self.check_swallowed_exceptions()
         return self.findings
 
 

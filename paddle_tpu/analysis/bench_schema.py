@@ -71,7 +71,15 @@ _OPTIONAL_NUMERIC = ("vs_baseline", "p50_ms", "p99_ms", "anchor_tflops",
                      # of the pair
                      "device_ms_per_step", "mega_off_tokens_per_s",
                      "mega_off_hbm_bytes_per_token",
-                     "mega_off_device_ms_per_step", "mega_emissions_match")
+                     "mega_off_device_ms_per_step", "mega_emissions_match",
+                     # round 17: the overload/resilience leg — admissions
+                     # shed by the SLO policy and deadline misses as
+                     # fractions of attempted arrivals, terminal FAILED
+                     # requests, and the interleaved nominal-load
+                     # partner's rates riding the overload line (the
+                     # shed_rate == 0 at-nominal-load half of the gate)
+                     "shed_rate", "deadline_miss_rate", "failed_requests",
+                     "nominal_shed_rate", "nominal_deadline_miss_rate")
 _OPTIONAL_STRING = ("mesh_shape", "comm_quant")
 
 #: the bench_serve leg-name enum (round 16): every serving line carries
@@ -83,6 +91,7 @@ KNOWN_LEGS = frozenset((
     "legacy-two-jit", "unified-step", "unified-async", "unified-obs",
     "unified-spmd", "unified-spec-base", "unified-spec-k4",
     "unified-int8w", "unified-int8w-int8kv", "unified-mega",
+    "unified-overload",
 ))
 
 

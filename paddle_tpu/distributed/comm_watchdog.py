@@ -127,7 +127,7 @@ class CommWatchdog:
                "message": message, "time": time.time()}
         try:
             self.store.set(self._err_key(), pickle.dumps(rec))
-        except Exception:
+        except (OSError, RuntimeError):
             pass  # peers will still time out on their own deadline
 
     # -- the per-collective guard -------------------------------------------

@@ -174,8 +174,8 @@ class TCPStore:
     def __del__(self):
         try:
             self.close()
-        except Exception:
-            pass
+        except Exception:  # tpulint: disable=AL007
+            pass  # __del__ must never raise (interpreter shutdown)
 
 
 _global_store: TCPStore | None = None

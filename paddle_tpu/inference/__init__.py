@@ -174,6 +174,10 @@ def __getattr__(name):
     # the StableHLO Predictor never pulls the models package
     lazy = {"ServingPredictor": ".serving", "Request": ".serving",
             "KVCacheManager": ".kv_cache",
+            # round-17 resilience layer: SLO shedding + fault injection
+            "SLOConfig": ".serving",
+            "FaultPlan": ".faults",
+            "InjectedFault": ".faults",
             # round-12 speculative decoding draft source
             "DraftProposer": ".draft",
             # round-10 quantized serving conversion
@@ -190,5 +194,6 @@ def __getattr__(name):
 __all__ = ["Config", "Predictor", "Tensor_", "create_predictor",
            "get_version", "PrecisionType", "PlaceType",
            "ServingPredictor", "Request", "KVCacheManager",
+           "SLOConfig", "FaultPlan", "InjectedFault",
            "DraftProposer", "quantize_serving_params", "quantize_weight",
            "serving_weight_bytes"]

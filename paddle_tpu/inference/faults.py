@@ -1,0 +1,157 @@
+"""Deterministic fault injection for the serving engine (round 17).
+
+The resilience layer's test harness: a seeded :class:`FaultPlan` arms
+named failure seams inside ``ServingPredictor``'s hot paths and fires
+them from ONE ``numpy.random.RandomState`` stream, so a chaos run is
+exactly reproducible from its seed. The plan is **context-manager
+scoped** (``with FaultPlan(seed=7, dispatch=0.02): ...``) and the
+disarmed path is one module-global ``None`` check per seam — a predictor
+running without an armed plan pays nothing.
+
+Seams (the names ``ServingPredictor`` calls :func:`fault_point` with):
+
+- ``pool`` — pool-pressure squeeze: withholds ``squeeze_pages``
+  strictly-free pages from the KV cache manager for ``squeeze_steps``
+  scheduler rounds (via :meth:`KVCacheManager.withhold_pages`), forcing
+  the capacity loop through its preemption / draft-clamp / grow-failure
+  paths under transient pressure. Hit at the top of EVERY ``step()``
+  call (not inside the pack, which an empty-running round skips — the
+  squeeze must keep expiring while its withheld pages are exactly what
+  blocks the next admission). Pages return to the free list when the
+  squeeze expires (and unconditionally at plan exit) — accounting stays
+  exact.
+- ``h2d`` — raises :class:`InjectedFault` where the step's packed host
+  arrays upload to the device (the batched ``jax.device_put``).
+- ``dispatch`` — raises :class:`InjectedFault` where the unified step
+  would launch.
+- ``slow_step`` — sleeps ``slow_step_s`` before the launch (straggler /
+  latency injection; exercises the deadline machinery, never corrupts
+  state).
+- ``reconcile`` — raises :class:`InjectedFault` where an in-flight
+  entry's emissions would materialize (the async engine's hard sync) —
+  the model of a device error surfacing at block time.
+
+Raising seams model CRASHES, so they raise **before** the operation they
+name (a half-applied operation is the scheduler's job to make
+impossible, not the plan's). ``plan.fired`` counts firings per seam for
+test assertions; the predictor separately counts observed injected
+faults on its metrics registry (``serving_faults_injected{seam=...}``).
+"""
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+__all__ = ["FaultPlan", "InjectedFault", "SEAMS", "active_plan",
+           "fault_point"]
+
+#: the named seams a plan may arm (a typo'd rate kwarg fails at __init__)
+SEAMS = ("pool", "h2d", "dispatch", "slow_step", "reconcile")
+
+#: the armed plan; None = disarmed (the zero-cost fast path)
+_PLAN: "FaultPlan | None" = None
+
+
+class InjectedFault(RuntimeError):
+    """A deliberately-injected failure; carries its seam name so the
+    recovery path can attribute it (``serving_faults_injected``)."""
+
+    def __init__(self, seam: str):
+        super().__init__(f"injected fault at seam '{seam}'")
+        self.seam = seam
+
+
+def active_plan() -> "FaultPlan | None":
+    return _PLAN
+
+
+def fault_point(seam: str, cache=None) -> None:
+    """The seam hook the serving engine calls. Disarmed cost is this one
+    module-global check."""
+    if _PLAN is not None:
+        _PLAN.hit(seam, cache=cache)
+
+
+class FaultPlan:
+    """One seeded chaos schedule over the named seams.
+
+    ``dispatch`` / ``h2d`` / ``reconcile`` / ``slow_step`` /
+    ``pool_squeeze`` are independent per-hit firing probabilities in
+    ``[0, 1]``. All draws come from one ``RandomState(seed)`` in seam-hit
+    order, so a deterministic scheduler replays the identical fault
+    sequence. Not re-entrant (one armed plan per process) and not
+    thread-aware — the serving engine drives every seam from the
+    scheduler thread.
+    """
+
+    def __init__(self, seed: int = 0, *, dispatch: float = 0.0,
+                 h2d: float = 0.0, reconcile: float = 0.0,
+                 slow_step: float = 0.0, slow_step_s: float = 0.001,
+                 pool_squeeze: float = 0.0, squeeze_pages: int = 2,
+                 squeeze_steps: int = 2):
+        rates = {"dispatch": dispatch, "h2d": h2d, "reconcile": reconcile,
+                 "slow_step": slow_step, "pool": pool_squeeze}
+        for name, p in rates.items():
+            if not 0.0 <= float(p) <= 1.0:
+                raise ValueError(f"{name} rate must be in [0, 1], got {p}")
+        self.rates = {k: float(v) for k, v in rates.items()}
+        self.rng = np.random.RandomState(seed)
+        self.slow_step_s = float(slow_step_s)
+        self.squeeze_pages = int(squeeze_pages)
+        self.squeeze_steps = int(squeeze_steps)
+        self.fired: dict[str, int] = {s: 0 for s in SEAMS}
+        # one active squeeze at a time: (cache, rounds_left)
+        self._squeeze: tuple[object, int] | None = None
+
+    # -- arming -------------------------------------------------------------
+
+    def __enter__(self) -> "FaultPlan":
+        global _PLAN
+        if _PLAN is not None:
+            raise RuntimeError("a FaultPlan is already armed")
+        _PLAN = self
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        global _PLAN
+        assert _PLAN is self
+        _PLAN = None
+        self._release_squeeze()
+        return False
+
+    def _release_squeeze(self) -> None:
+        if self._squeeze is not None:
+            cache, _ = self._squeeze
+            cache.restore_withheld()
+            self._squeeze = None
+
+    # -- the seams ----------------------------------------------------------
+
+    def hit(self, seam: str, cache=None) -> None:
+        if seam == "pool":
+            # expire a running squeeze first so pressure is bounded
+            if self._squeeze is not None:
+                cache_held, left = self._squeeze
+                if left <= 1:
+                    self._release_squeeze()
+                else:
+                    self._squeeze = (cache_held, left - 1)
+            elif (cache is not None and self.rates["pool"]
+                    and self.rng.rand() < self.rates["pool"]):
+                if cache.withhold_pages(self.squeeze_pages):
+                    self.fired["pool"] += 1
+                    self._squeeze = (cache, self.squeeze_steps)
+            return
+        if seam == "slow_step":
+            if self.rates["slow_step"] \
+                    and self.rng.rand() < self.rates["slow_step"]:
+                self.fired["slow_step"] += 1
+                time.sleep(self.slow_step_s)
+            return
+        if seam not in self.rates:
+            raise ValueError(f"unknown fault seam {seam!r} "
+                             f"(known: {', '.join(SEAMS)})")
+        if self.rates[seam] and self.rng.rand() < self.rates[seam]:
+            self.fired[seam] += 1
+            raise InjectedFault(seam)

@@ -278,6 +278,10 @@ class KVCacheManager:
         self._sl_dev: tuple[int, jnp.ndarray | None] = (-1, None)
         self._free_pages = list(range(self.num_pages - 1, -1, -1))  # pop()
         self._free_slots = list(range(self.max_batch - 1, -1, -1))
+        # round 17: pages temporarily withheld from circulation (fault
+        # injection's pool-pressure squeeze / reserved headroom) — out of
+        # every free/available count until restored
+        self._withheld: list[int] = []
         # prefix cache state: per-page slot refcounts, the content-key
         # registry, and the LRU of zero-ref registered pages (evictable,
         # still serving hits until reused)
@@ -315,6 +319,8 @@ class KVCacheManager:
             "kv_cow_copies", "copy-on-write page copies prepared")
         self._m_trimmed = m.counter(
             "kv_pages_trimmed", "pages released by draft rollback")
+        self._m_withheld = m.gauge(
+            "kv_pages_withheld", "pages withheld from circulation")
         self._note_occupancy()
 
     def _note_occupancy(self) -> None:
@@ -324,6 +330,7 @@ class KVCacheManager:
         self._m_pages_free.set(len(self._free_pages))
         self._m_pages_evictable.set(len(self._lru))
         self._m_slots_free.set(len(self._free_slots))
+        self._m_withheld.set(len(self._withheld))
 
     # -- back-compat metric reads (pre-round-15 attribute surface) ---------
 
@@ -350,6 +357,10 @@ class KVCacheManager:
     @property
     def free_slot_count(self) -> int:
         return len(self._free_slots)
+
+    @property
+    def withheld_page_count(self) -> int:
+        return len(self._withheld)
 
     def pages_needed(self, length: int) -> int:
         return pages_needed(length, self.page_size)
@@ -476,6 +487,33 @@ class KVCacheManager:
         grow = max(0, self.pages_needed(
             min(written + max(1, n_tokens), self.max_seq_len)) - have)
         return grow + (1 if self.needs_cow(slot, written) else 0)
+
+    def withhold_pages(self, n: int) -> int:
+        """Take up to ``n`` strictly-FREE pages out of circulation (they
+        leave every free/available count until :meth:`restore_withheld`)
+        — the fault-injection pool-pressure squeeze. SINGLE-HOLDER: there
+        is one withheld set and ``restore_withheld`` returns all of it,
+        so two concurrent holders (e.g. a router headroom reservation
+        alongside an armed squeeze) would release each other's pages.
+        Never touches referenced or prefix-LRU pages, so sequence and
+        registry state are unaffected. Returns how many were actually
+        withheld."""
+        take = min(max(0, int(n)), len(self._free_pages))
+        for _ in range(take):
+            self._withheld.append(self._free_pages.pop())
+        if take:
+            self._note_occupancy()
+        return take
+
+    def restore_withheld(self) -> int:
+        """Return every withheld page to the free list (LIFO, restoring
+        the pre-withhold pop order). Returns how many came back."""
+        n = len(self._withheld)
+        while self._withheld:
+            self._free_pages.append(self._withheld.pop())
+        if n:
+            self._note_occupancy()
+        return n
 
     def trim_pages(self, slot: int) -> int:
         """Release ``slot``'s pages beyond what ``seq_len`` needs — the

@@ -69,6 +69,33 @@ path (PR 8 soaked green) — ``async_engine=False`` keeps the synchronous
 engine as the oracle — both drive the SAME pack/capacity code, the sync
 engine simply reconciles at pipeline depth zero.
 
+Round 17 adds the RESILIENCE LAYER. Requests gain a terminal ``FAILED``
+state with a per-request ``error`` record ``{"code", "message"}`` —
+never-admittable prompts, retry-exhausted step failures, shed
+admissions and missed deadlines fail INDIVIDUALLY while the predictor
+keeps serving everyone else. ``deadline_s`` gives a request a wall-clock
+budget (expired WAITING requests shed as ``deadline_exceeded`` at the
+next scheduler round — the queue TTL; RUNNING requests past deadline
+retire at the next round's reconcile point). ``slo=SLOConfig(...)``
+arms admission control at ``add_request``: a bounded waiting queue plus
+SLO-aware load shedding off the round-15 telemetry signals (pool
+occupancy, in-flight ring depth, TTFT-p99 EMA); the verdicts
+(:meth:`ServingPredictor.admission_verdict`) and the
+:meth:`~ServingPredictor.healthz` snapshot are the load-signal surface
+the fleet router consumes. Step execution is CRASH-CONSISTENT: an
+exception inside ``_pack_dispatch`` (pack, H2D upload, launch) or
+``_reconcile_one`` (materialization) drops the failed in-flight entry,
+un-charges its dispatched-unmaterialized tokens, and requeues every
+affected lane through the existing preemption-replay path (already
+value-barriered and bit-identical on replay) with bounded retry +
+exponential backoff before the affected requests FAIL — page / slot /
+refcount / prefix-pin accounting is exact after any failure.
+``inference/faults.py`` injects deterministic seeded faults at the
+named seams (pool squeeze, h2d, dispatch, slow_step, reconcile);
+disarmed, every seam is one module-global check. With no faults armed,
+no deadlines set and shedding off, the engine is bit-identical to the
+round-16 engine.
+
 Knobs: ``max_batch`` (lanes), ``num_pages``/``page_size`` (pool geometry),
 ``max_seq_len`` (page-table width), ``chunk`` (per-slot prefill chunk,
 autotuned default), ``token_budget`` (tokens per step, default
@@ -84,6 +111,7 @@ bit-identical either way).
 """
 from __future__ import annotations
 
+import time
 from collections import deque
 
 import numpy as np
@@ -94,9 +122,11 @@ from ..observability import (MetricsRegistry, counter_event, monotonic,
                              request_begin, request_end, request_event,
                              span, tracing_active)
 from ..profiler.record import recorder as _recorder
+from .faults import InjectedFault, fault_point
 from .kv_cache import KVCacheManager, kv_cache_quantized, pages_needed
 
-WAITING, RUNNING, FINISHED = "waiting", "running", "finished"
+WAITING, RUNNING, FINISHED, FAILED = ("waiting", "running", "finished",
+                                      "failed")
 
 
 class Request:
@@ -105,7 +135,8 @@ class Request:
     _next_id = [0]
 
     def __init__(self, prompt_ids, max_new_tokens=32, eos_token_id=None,
-                 temperature=0.0, top_k=0, top_p=1.0, seed=None):
+                 temperature=0.0, top_k=0, top_p=1.0, seed=None,
+                 deadline_s=None):
         self.req_id = Request._next_id[0]
         Request._next_id[0] += 1
         self.prompt_ids = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
@@ -113,6 +144,17 @@ class Request:
             raise ValueError("empty prompt")
         self.max_new_tokens = int(max_new_tokens)
         self.eos_token_id = eos_token_id
+        # round 17: wall-clock budget (seconds from submission; None =
+        # no deadline) and the terminal-failure record — a FAILED request
+        # carries {"code", "message"} in ``error``
+        self.deadline_s = None if deadline_s is None else float(deadline_s)
+        if self.deadline_s is not None and self.deadline_s < 0:
+            raise ValueError(f"deadline_s must be >= 0, got {deadline_s}")
+        self.error: dict | None = None
+        # failure-driven requeues (NOT ordinary preemptions): bounded by
+        # the predictor's max_step_retries before the request FAILS
+        self.retry_count = 0
+        self._finish_counted = False
         # sampling params (temperature == 0 -> greedy argmax, bit-identical
         # to round 7); seed defaults to the request id so replays after
         # preemption re-sample the SAME stream (keyed by tokens produced)
@@ -162,6 +204,61 @@ class Request:
         replays."""
         return self.prompt_ids + self.output_ids
 
+    def past_deadline(self, now=None) -> bool:
+        if self.deadline_s is None:
+            return False
+        return (monotonic() if now is None else now) \
+            >= self.submit_time + self.deadline_s
+
+
+class SLOConfig:
+    """Admission-control / load-shedding policy for one predictor
+    (round 17). ``slo=None`` (the default) disables shedding entirely;
+    an armed config sheds at :meth:`ServingPredictor.add_request` — the
+    request comes back terminal FAILED with a ``shed_*`` error code
+    instead of queueing into an overload the SLO can never recover from.
+
+    - ``max_waiting`` — the bounded waiting queue (always enforced once
+      armed; ``shed_queue_full``).
+    - ``max_pool_occupancy`` — shed while the KV pool's claimed fraction
+      (1 - available/total) is at/above this AND a backlog exists
+      (``shed_pool_pressure``).
+    - ``max_inflight_depth`` — shed while the async in-flight ring sits
+      at/above this depth with a backlog (``shed_inflight_depth``).
+    - ``ttft_p99_slo_ms`` — shed while the TTFT-p99 EMA (an EMA over the
+      registry histogram's p99 estimate, updated per first token) is
+      above the SLO with a backlog (``shed_ttft_slo``).
+
+    The thresholds other than ``max_waiting`` default to None (off) so a
+    config can arm exactly the signals its deployment trusts.
+    """
+
+    def __init__(self, *, max_waiting=256, max_pool_occupancy=None,
+                 max_inflight_depth=None, ttft_p99_slo_ms=None,
+                 ema_alpha=0.2):
+        self.max_waiting = None if max_waiting is None else int(max_waiting)
+        if self.max_waiting is not None and self.max_waiting < 1:
+            raise ValueError(f"max_waiting must be >= 1, got {max_waiting}")
+        self.max_pool_occupancy = (None if max_pool_occupancy is None
+                                   else float(max_pool_occupancy))
+        if self.max_pool_occupancy is not None \
+                and not 0.0 < self.max_pool_occupancy <= 1.0:
+            raise ValueError(f"max_pool_occupancy is a fraction in (0, 1], "
+                             f"got {max_pool_occupancy}")
+        self.max_inflight_depth = (None if max_inflight_depth is None
+                                   else int(max_inflight_depth))
+        if self.max_inflight_depth is not None and self.max_inflight_depth < 0:
+            raise ValueError(f"max_inflight_depth must be >= 0, "
+                             f"got {max_inflight_depth}")
+        self.ttft_p99_slo_ms = (None if ttft_p99_slo_ms is None
+                                else float(ttft_p99_slo_ms))
+        if self.ttft_p99_slo_ms is not None and self.ttft_p99_slo_ms <= 0:
+            raise ValueError(f"ttft_p99_slo_ms must be > 0, "
+                             f"got {ttft_p99_slo_ms}")
+        self.ema_alpha = float(ema_alpha)
+        if not 0.0 < self.ema_alpha <= 1.0:
+            raise ValueError(f"ema_alpha must be in (0, 1], got {ema_alpha}")
+
 
 class _Pending:
     """One dispatched-but-unreconciled unified step — an entry of the
@@ -202,7 +299,8 @@ class ServingPredictor:
                  dtype=None, unified=True, chunk=None, token_budget=None,
                  prefix_cache=None, kv_cache_dtype=None, mesh=None,
                  spec_decode_k=None, async_engine=None,
-                 max_inflight_steps=4, metrics=None, mega_decode=None):
+                 max_inflight_steps=4, metrics=None, mega_decode=None,
+                 slo=None, max_step_retries=3, retry_backoff_s=0.02):
         from ..distributed.mesh import as_serving_mesh
         from ..models.gpt import (_serving_params_cached, build_decode_step,
                                   build_prefill, build_unified_step,
@@ -393,6 +491,22 @@ class ServingPredictor:
         self._idle_since = None
         self._w_marks = {"step_s": 0.0, "sync_s": 0.0, "gap_s": 0.0,
                          "calls": 0.0}
+        # round 17: resilience knobs — SLO-aware admission control (off
+        # when slo is None), bounded step retry + exponential backoff,
+        # and the deadline sweep (armed lazily by the first deadlined
+        # request so the disarmed path pays one bool check)
+        if slo is not None and not isinstance(slo, SLOConfig):
+            raise ValueError(f"slo must be an SLOConfig or None, "
+                             f"got {type(slo).__name__}")
+        self.slo = slo
+        self.max_step_retries = int(max_step_retries)
+        if self.max_step_retries < 0:
+            raise ValueError(f"max_step_retries must be >= 0, "
+                             f"got {max_step_retries}")
+        self.retry_backoff_s = float(retry_backoff_s)
+        self._deadlines_armed = False
+        self._consec_failures = 0
+        self._ttft_ema_ms: float | None = None
         # req_id -> DraftProposer (kept across preemption — the request's
         # context replays identically, so the table stays consistent)
         self._drafts: dict[int, object] = {}
@@ -450,6 +564,23 @@ class ServingPredictor:
             "serving_draft_accepted", "draft tokens accepted by verify")
         self._m_draft_rollback = m.counter(
             "serving_draft_rollback_pages", "over-allocated pages trimmed")
+        # round 17: resilience — shed / deadline / fault / retry counters
+        self._m_failed = m.counter(
+            "serving_requests_failed", "requests reaching terminal FAILED")
+        self._m_fail_reasons = m.counter(
+            "serving_fail_reasons", "terminal failures by error code",
+            labels=("reason",))
+        self._m_shed = m.counter(
+            "serving_requests_shed", "admissions shed by the SLO policy")
+        self._m_deadline = m.counter(
+            "serving_deadline_misses", "requests failed past their deadline")
+        self._m_step_failures = m.counter(
+            "serving_step_failures", "pack/dispatch/reconcile exceptions")
+        self._m_retries = m.counter(
+            "serving_step_retries", "lane requeues after a failed step")
+        self._m_faults = m.counter(
+            "serving_faults_injected", "injected faults observed, by seam",
+            labels=("seam",))
 
     # -- back-compat metric reads (pre-round-15 attribute surface) ---------
 
@@ -494,17 +625,96 @@ class ServingPredictor:
     # -- queue API ---------------------------------------------------------
 
     def add_request(self, prompt_ids, max_new_tokens=32, eos_token_id=None,
-                    temperature=0.0, top_k=0, top_p=1.0,
-                    seed=None) -> Request:
+                    temperature=0.0, top_k=0, top_p=1.0, seed=None,
+                    deadline_s=None) -> Request:
         req = Request(prompt_ids, max_new_tokens, eos_token_id,
                       temperature=temperature, top_k=top_k, top_p=top_p,
-                      seed=seed)
+                      seed=seed, deadline_s=deadline_s)
         if len(req.prompt_ids) > self.max_seq_len:
             raise ValueError(
                 f"prompt of {len(req.prompt_ids)} tokens exceeds "
                 f"max_seq_len {self.max_seq_len}")
+        if self.slo is not None:
+            verdict = self.admission_verdict()
+            if verdict is not None:
+                # shed: the request comes back terminal FAILED with a
+                # loud error record instead of queueing into an overload
+                self._m_shed.inc()
+                self._fail(req, "shed_" + verdict,
+                           f"admission shed under load ({verdict}): "
+                           f"{len(self.waiting)} waiting, "
+                           f"{len(self.running)} running")
+                return req
+        if req.deadline_s is not None:
+            self._deadlines_armed = True
         self.waiting.append(req)
         return req
+
+    # -- round 17: load-signal surface (the fleet router's view) -----------
+
+    @property
+    def pool_occupancy(self) -> float:
+        """Claimed fraction of the KV page pool (evictable prefix-LRU
+        pages count as available)."""
+        cache = self.cache
+        return 1.0 - cache.available_page_count / max(1, cache.num_pages)
+
+    @property
+    def ttft_p99_ema_ms(self) -> float:
+        """EMA over the TTFT histogram's p99 estimate (0.0 before the
+        first token) — the SLO shedding signal."""
+        return 0.0 if self._ttft_ema_ms is None else self._ttft_ema_ms
+
+    def admission_verdict(self) -> str | None:
+        """Would :meth:`add_request` shed right now? ``None`` admits;
+        otherwise the shed reason (``queue_full`` / ``pool_pressure`` /
+        ``inflight_depth`` / ``ttft_slo``). Pure read — the fleet router
+        polls this (and :meth:`healthz`) to steer traffic before paying
+        a request submission."""
+        slo = self.slo
+        if slo is None:
+            return None
+        if (slo.max_waiting is not None
+                and len(self.waiting) >= slo.max_waiting):
+            return "queue_full"
+        # backlog-gated signals: a full pool with an empty queue is the
+        # healthy steady state of a saturated batch, not an overload
+        if self.waiting:
+            if (slo.max_pool_occupancy is not None
+                    and self.pool_occupancy >= slo.max_pool_occupancy):
+                return "pool_pressure"
+            if (slo.max_inflight_depth is not None
+                    and len(self._inflight) >= slo.max_inflight_depth):
+                return "inflight_depth"
+            if (slo.ttft_p99_slo_ms is not None
+                    and self.ttft_p99_ema_ms > slo.ttft_p99_slo_ms):
+                return "ttft_slo"
+        return None
+
+    def healthz(self) -> dict:
+        """One JSON-able health/load snapshot — the per-predictor surface
+        the fleet router consumes (schema locked by
+        tests/test_observability.py)."""
+        verdict = self.admission_verdict()
+        cache = self.cache
+        return {
+            "status": "shedding" if verdict is not None else "ok",
+            "shed_reason": verdict,
+            "waiting": len(self.waiting),
+            "running": len(self.running),
+            "inflight_steps": len(self._inflight),
+            "free_slots": cache.free_slot_count,
+            "pool_occupancy": round(self.pool_occupancy, 4),
+            "withheld_pages": cache.withheld_page_count,
+            "ttft_p99_ema_ms": round(self.ttft_p99_ema_ms, 3),
+            "steps": self.steps,
+            "tokens_emitted": self.tokens_emitted,
+            "requests_shed": int(self._m_shed.value),
+            "deadline_misses": int(self._m_deadline.value),
+            "requests_failed": int(self._m_failed.value),
+            "step_failures": int(self._m_step_failures.value),
+            "step_retries": int(self._m_retries.value),
+        }
 
     @property
     def decode_trace_count(self) -> int:
@@ -634,22 +844,48 @@ class ServingPredictor:
                         args={"count": req.preempt_count})
         return True
 
-    def _finish(self, req: Request) -> None:
-        """Mark FINISHED and drop per-request scheduler state — EVERY
-        finish path must come through here (a retained n-gram table or
-        PRNG key would leak per request over a long-lived predictor)."""
-        req.state = FINISHED
+    def _close_request(self, req: Request, event: str, args) -> None:
+        """Terminal teardown shared by BOTH terminal paths: drop
+        per-request scheduler state (a retained n-gram table or PRNG key
+        would leak per request over a long-lived predictor) and close the
+        request's async trace lane (_req_event (re-)opens it if this
+        window has no 'b' yet)."""
         self._base_keys.pop(req.req_id, None)
         self._drafts.pop(req.req_id, None)
-        self._m_finished.inc()
         if tracing_active():
-            # close the request's async trace lane (admit -> ... -> eos);
-            # _req_event (re-)opens it if this window has no 'b' yet
-            self._req_event(req.req_id, "eos" if not req.truncated
-                            else "truncated",
-                            args={"outputs": len(req.output_ids)})
+            self._req_event(req.req_id, event, args=args)
             request_end(req.req_id)
         self._traced_reqs.pop(req.req_id, None)
+
+    def _count_finished(self, req: Request) -> None:
+        """Increment the finished counter once per request, and only once
+        its emissions are VALUE-final (no dispatched-unmaterialized
+        tokens): a count-finished request whose final tokens are lost
+        with a dropped ring entry re-opens for replay, and its eventual
+        terminal state may be FAILED — counting early would make
+        finished + failed overshoot the requests submitted."""
+        if not req._finish_counted and req._pending_n == 0:
+            req._finish_counted = True
+            self._m_finished.inc()
+
+    def _finish(self, req: Request) -> None:
+        """Mark FINISHED — EVERY finish path must come through here."""
+        req.state = FINISHED
+        self._count_finished(req)
+        self._close_request(req, "eos" if not req.truncated
+                            else "truncated",
+                            {"outputs": len(req.output_ids)})
+
+    def _fail(self, req: Request, code: str, message) -> None:
+        """Terminal FAILED with a loud error record — EVERY failure path
+        (shed, deadline, never-admittable, retry-exhausted, stuck) comes
+        through here; the predictor keeps serving everyone else. The
+        caller releases any slot/pages the request held FIRST."""
+        req.state = FAILED
+        req.error = {"code": code, "message": str(message)[:300]}
+        self._m_failed.inc()
+        self._m_fail_reasons.labels(reason=code).inc()
+        self._close_request(req, "failed", dict(req.error))
 
     def _retire_finished(self) -> None:
         for slot in [s for s, r in self.running.items() if r.done]:
@@ -676,13 +912,44 @@ class ServingPredictor:
             return True
         return False
 
-    def _raise_never_admittable(self, req: Request, need: int) -> None:
-        raise RuntimeError(
-            f"request {req.req_id}: context of "
-            f"{len(req._context_ids())} tokens needs {need} "
-            f"pages but the pool only has "
-            f"{self.cache.num_pages} — raise num_pages or "
-            "page_size")
+    def _fail_never_admittable(self, req: Request, need: int) -> None:
+        """A context that can NEVER fit the pool fails individually (loud
+        error record) instead of poisoning the predictor for everyone
+        (the pre-round-17 behavior raised out of step()). The caller has
+        already popped ``req`` off the waiting queue."""
+        self._fail(req, "never_admittable",
+                   f"context of {len(req._context_ids())} tokens needs "
+                   f"{need} pages but the pool only has "
+                   f"{self.cache.num_pages} — raise num_pages or "
+                   "page_size")
+
+    def _shed_expired(self) -> None:
+        """The deadline sweep (one scheduler round granularity): expired
+        WAITING requests shed off the queue (the queue TTL); RUNNING
+        requests past deadline retire — both terminal FAILED
+        ``deadline_exceeded``. Runs only once a deadlined request has
+        ever been submitted."""
+        now = monotonic()
+        if any(r.deadline_s is not None for r in self.waiting):
+            keep: deque[Request] = deque()
+            while self.waiting:
+                req = self.waiting.popleft()
+                if req.past_deadline(now):
+                    self._m_deadline.inc()
+                    self._fail(req, "deadline_exceeded",
+                               f"queued past its {req.deadline_s}s "
+                               "deadline")
+                else:
+                    keep.append(req)
+            self.waiting = keep
+        for slot in [s for s, r in self.running.items()
+                     if r.past_deadline(now)]:
+            req = self.running.pop(slot)
+            self.cache.free(slot)
+            self._m_deadline.inc()
+            self._fail(req, "deadline_exceeded",
+                       f"still running past its {req.deadline_s}s "
+                       f"deadline with {len(req.output_ids)} tokens out")
 
     def has_work(self) -> bool:
         return bool(self.waiting or self.running or self._inflight)
@@ -743,12 +1010,15 @@ class ServingPredictor:
             if not self._admit_one_unified(req):
                 # head-of-line blocking keeps FIFO order — but if nothing
                 # is running and the whole pool is free, this request can
-                # NEVER fit: fail with the real cause
+                # NEVER fit: fail IT (not the predictor) with the real
+                # cause and keep admitting behind it
                 if (not self.running and self.cache.available_page_count
                         == self.cache.num_pages):
-                    self._raise_never_admittable(
+                    self.waiting.popleft()
+                    self._fail_never_admittable(
                         req, self.cache.pages_needed(
                             len(req._context_ids())))
+                    continue
                 break
             self.waiting.popleft()
 
@@ -832,8 +1102,15 @@ class ServingPredictor:
 
     def _reconcile_all(self) -> dict[int, list[int]]:
         produced: dict[int, list[int]] = {}
-        while self._inflight:
+        # bounded by the ring depth at entry (round 17): every iteration
+        # pops exactly one entry (or a failure recovery clears the ring),
+        # so a drain can never spin past the work that existed when it
+        # started
+        for _ in range(len(self._inflight)):
+            if not self._inflight:
+                break
             self._merge_produced(produced, self._reconcile_one())
+        assert not self._inflight, "reconcile drain left ring entries"
         return produced
 
     def _reconcile_one(self) -> dict[int, list[int]]:
@@ -842,24 +1119,46 @@ class ServingPredictor:
         metrics, and settle the value-dependent cache accounting
         (speculative advance + rollback). Count-based accounting (page
         growth, plain advance, prefix registration) already ran at pack
-        time — this is the reconcile-behind half of the contract."""
+        time — this is the reconcile-behind half of the contract.
+
+        Exception-safe (round 17): a materialization failure drops the
+        popped entry AND everything younger (they consumed its device
+        carry), un-charges their dispatched-unmaterialized tokens, and
+        requeues every affected lane through the preemption-replay path
+        — see :meth:`_recover_reconcile_failure`."""
         with span("reconcile"):
-            return self._reconcile_one_impl()
+            e = self._inflight.popleft()
+            self._m_inflight.set(len(self._inflight))
+            # sample the ring-depth track on the way DOWN too — a trace
+            # of a drain (flush) must show the ring emptying
+            counter_event("inflight_steps", len(self._inflight))
+            try:
+                return self._reconcile_one_impl(e)
+            except Exception as exc:
+                # EVERY Exception is owned by the recovery (a host-side
+                # code bug is indistinguishable from a device fault here;
+                # the bounded retry keeps either from looping forever and
+                # the error record carries repr(exc) for attribution)
+                self._recover_reconcile_failure(e, exc)
+                return {}
 
     def _note_first_token(self, req: Request) -> None:
         req.first_token_time = monotonic()
         self._m_ttft.observe((req.first_token_time - req.submit_time) * 1e3)
+        # TTFT-p99 EMA (the round-17 shedding signal): smooth the
+        # histogram's p99 estimate so one straggler neither trips nor
+        # un-trips the SLO verdict on its own
+        a = self.slo.ema_alpha if self.slo is not None else 0.2
+        p99 = self._m_ttft.quantile(0.99)
+        self._ttft_ema_ms = (p99 if self._ttft_ema_ms is None
+                             else (1 - a) * self._ttft_ema_ms + a * p99)
         self._req_event(req.req_id, "first_token")
 
-    def _reconcile_one_impl(self) -> dict[int, list[int]]:
-        e = self._inflight.popleft()
-        self._m_inflight.set(len(self._inflight))
-        # sample the ring-depth track on the way DOWN too — a trace of a
-        # drain (flush) must show the ring emptying, not stuck at max
-        counter_event("inflight_steps", len(self._inflight))
+    def _reconcile_one_impl(self, e: _Pending) -> dict[int, list[int]]:
         cache = self.cache
         out = ne = None
         if e.completing:
+            fault_point("reconcile")
             t0 = monotonic()
             out = np.asarray(e.out)
             if e.spec:
@@ -884,8 +1183,11 @@ class ServingPredictor:
                 toks = [int(out[slot])]
             emitted = 0
             for tok in toks:
-                if self._landed_done(req):
-                    break   # budget/eos hit mid-batch: drop the overhang
+                if req.state == FAILED or self._landed_done(req):
+                    # budget/eos hit mid-batch (drop the overhang), or
+                    # the request failed with tokens in flight (deadline
+                    # retire): its late emissions are discarded
+                    break
                 req.output_ids.append(tok)
                 emitted += 1
                 if req.first_token_time is None:
@@ -895,6 +1197,10 @@ class ServingPredictor:
                 # the pack charged ONE pending token per completing
                 # plain lane; it just landed (or dropped as overhang)
                 req._pending_n = max(0, req._pending_n - 1)
+                if req.state == FINISHED:
+                    # a count-finished request's deferred finished-counter
+                    # lands with its final token values
+                    self._count_finished(req)
             self._m_tokens.inc(emitted)
             if self.spec_k and was_decode:
                 acc = int(ne[slot]) - 1 if k_i else 0
@@ -910,8 +1216,125 @@ class ServingPredictor:
                     prop.update(k_i, acc)
         return produced
 
+    # -- round 17: crash-consistent step retry -----------------------------
+
+    def _note_step_failure(self, exc) -> None:
+        self._m_step_failures.inc()
+        self._consec_failures += 1
+        if isinstance(exc, InjectedFault):
+            self._m_faults.labels(seam=exc.seam).inc()
+
+    def _after_failure_backoff(self) -> None:
+        """Exponential backoff after a failed step (consecutive failures
+        double it, capped at 1s); a successful dispatch resets it. Only
+        ever runs on the failure path."""
+        if self.retry_backoff_s > 0:
+            time.sleep(min(
+                self.retry_backoff_s * (2 ** (self._consec_failures - 1)),
+                1.0))
+
+    def _requeue_req(self, req: Request, exc, code: str) -> None:
+        """THE bounded-retry policy (one site): bump the request's
+        failure-requeue count, FAIL it past ``max_step_retries``,
+        otherwise send it back through the value-barriered
+        preemption-replay path. The caller has already released any
+        slot/pages/ring charge the request held."""
+        req._registered = False
+        req.retry_count += 1
+        if req.retry_count > self.max_step_retries:
+            self._fail(req, code,
+                       f"step failed {req.retry_count} times over this "
+                       f"request; last: {exc!r}")
+            return
+        req.state = WAITING
+        self._m_retries.inc()
+        self._req_event(req.req_id, "retry",
+                        args={"count": req.retry_count})
+        self.waiting.appendleft(req)
+
+    def _requeue_one(self, slot: int, exc,
+                     code: str = "step_retry_exhausted") -> None:
+        """Requeue one running lane through the preemption-replay path
+        after a failed step: ``free()`` returns its growth/CoW page
+        claims exactly (shared and registered pages stay pinned by their
+        other references), and the replay is value-barriered and
+        bit-identical. Bounded: past ``max_step_retries`` the request
+        FAILS instead."""
+        req = self.running.pop(slot)
+        self.cache.free(slot)
+        if req.done and req._pending_n == 0:
+            # its landed output is already value-final (e.g. eos landed
+            # at an earlier reconcile, retirement hadn't run yet): there
+            # is nothing to replay — retire it instead of spending a
+            # retry (or worse, a spurious terminal FAIL) on a complete,
+            # correct stream
+            self._finish(req)
+            return
+        self._requeue_req(req, exc, code)
+
+    def _requeue_running(self, exc) -> None:
+        # youngest-first appendleft leaves the queue front oldest-first
+        for slot in sorted(self.running,
+                           key=lambda s: -self.running[s].req_id):
+            self._requeue_one(slot, exc)
+
+    def _recover_dispatch_failure(self, exc) -> None:
+        """A failure inside ``_pack_dispatch`` (pack bookkeeping, H2D
+        upload, or the launch itself): the entry never entered the ring
+        and nothing advanced, so the transaction rolls back by requeueing
+        every running lane — page/slot/prefix claims this step made are
+        returned through ``free()``. Older ring entries dispatched
+        healthy and stay; the requeued lanes' pending tokens force the
+        value barrier to land them before any replay admission."""
+        self._note_step_failure(exc)
+        self._requeue_running(exc)
+        self._steady = None
+        self._after_failure_backoff()
+
+    def _recover_reconcile_failure(self, e: _Pending, exc) -> None:
+        """A failure materializing in-flight entry ``e``: its token
+        values are lost and every YOUNGER entry consumed its device
+        carry, so the whole remaining ring is poisoned — drop it all,
+        un-charge the dispatched-unmaterialized tokens each dropped
+        entry charged, re-open count-finished requests whose final
+        tokens were in the dropped entries, and requeue every running
+        lane for bit-identical replay."""
+        self._note_step_failure(exc)
+        dropped = [e] + list(self._inflight)
+        self._inflight.clear()
+        self._m_inflight.set(0)
+        counter_event("inflight_steps", 0)
+        reopen: dict[int, Request] = {}
+        for entry in dropped:
+            if entry.spec:
+                continue   # spec reconciles depth-zero: no pending charge
+            for _slot, req, _k, _decode in entry.completing:
+                req._pending_n = max(0, req._pending_n - 1)
+                if req.state == FINISHED and not req.done:
+                    # finished by COUNT, final token values lost with the
+                    # dropped entry: back to the queue for replay
+                    reopen[req.req_id] = req
+                elif req.state == FINISHED:
+                    # FINISHED and still done after the un-charge (eos
+                    # landed earlier; the dropped token was pure
+                    # overhang): its deferred finished-counter lands
+                    # here — no other path will ever see it again
+                    self._count_finished(req)
+        self._requeue_running(exc)
+        for req in reopen.values():
+            # count-finished with the final token values lost: no slot
+            # to free (retirement already freed it) — straight through
+            # the shared bounded-retry policy
+            self._requeue_req(req, exc, "step_retry_exhausted")
+        self._carry = None
+        self._steady = None
+        self._mark_drained()
+        self._after_failure_backoff()
+
     def _step_unified(self) -> dict[int, list[int]]:
         produced: dict[int, list[int]] = {}
+        if self._deadlines_armed:
+            self._shed_expired()
         # value barrier: admission replays a preempted request's context
         # (token VALUES), so a waiting request with pending tokens forces
         # a full reconcile before the admission pass
@@ -923,10 +1346,17 @@ class ServingPredictor:
             self._merge_produced(produced, self._reconcile_all())
             return produced
         with span("pack_dispatch"):
-            entry = self._pack_dispatch()
+            try:
+                entry = self._pack_dispatch()
+            except Exception as exc:
+                # transactional pack: the recovery requeues every lane
+                # (claims returned exactly) and the next step() retries
+                self._recover_dispatch_failure(exc)
+                return produced
         if entry is None:
             self._merge_produced(produced, self._reconcile_all())
             return produced
+        self._consec_failures = 0
         self._inflight.append(entry)
         self._m_inflight.set(len(self._inflight))
         counter_event("inflight_steps", len(self._inflight))
@@ -976,7 +1406,14 @@ class ServingPredictor:
         """Pack the token budget, run capacity/CoW, build the step arrays
         and DISPATCH the unified step — everything that only needs token
         COUNTS. Returns the in-flight entry (None when nothing was
-        scheduled). Does not materialize any device value."""
+        scheduled). Does not materialize any device value.
+
+        Exception-safe (round 17): every mutation before the launch is a
+        CLAIM (pages, slots, CoW copies) the caller's recovery returns
+        exactly by requeueing the lanes through ``free()`` — see
+        :meth:`_recover_dispatch_failure`. The named fault seams
+        (``pool``/``h2d``/``slow_step``/``dispatch``) cost one
+        module-global check each when no plan is armed."""
         cache = self.cache
         # -- token-budget packing: decode lanes first, then prefill chunks
         budget = self.token_budget
@@ -1077,10 +1514,17 @@ class ServingPredictor:
                                       key=lambda s: self.running[s].req_id)
                                   == slot)
                 if victim_is_self and len(self.running) == 1:
-                    raise RuntimeError(
+                    # even with the pool to itself this sequence cannot
+                    # grow (transient pressure, or a genuinely undersized
+                    # pool): requeue through the bounded retry path —
+                    # transient pressure heals on replay, a permanent
+                    # exhaustion FAILS this one request after
+                    # max_step_retries while the predictor keeps serving
+                    self._requeue_one(slot, RuntimeError(
                         f"slot {slot}: cannot grow to {written + n} "
-                        "tokens — page pool too small for a single "
-                        "sequence")
+                        "tokens — page pool too small for this "
+                        "sequence"), code="pool_exhausted")
+                    break
                 self._preempt_youngest()
                 if slot not in self.running:  # preempted itself
                     break
@@ -1133,6 +1577,7 @@ class ServingPredictor:
             for w_i, (slot, req, _, _) in enumerate(completing):
                 tok_pos[w_i] = cache.seq_len(slot)
                 produced_n[slot] = len(req.output_ids) + req._pending_n
+            fault_point("h2d")
             d_pos, d_prod = jax.device_put((tok_pos, produced_n))
             d_ids, d_slot, d_qlens, d_last, d_fb, d_emit = (
                 st["d_ids"], st["d_slot"], st["d_qlens"], st["d_last"],
@@ -1215,6 +1660,7 @@ class ServingPredictor:
                 volatile.append(spec_len)
             if live_cows:
                 volatile += [cow_src, cow_dst]
+            fault_point("h2d")
             dev = jax.device_put(tuple(volatile))
             (d_ids, d_slot, d_pos, d_qlens, d_last, d_fb, d_emit,
              d_prod) = dev[:8]
@@ -1238,9 +1684,6 @@ class ServingPredictor:
             or len(req.output_ids) + req._pending_n + 1
             >= req.max_new_tokens
             for _, req, _, _ in completing)
-        if not self.spec_k:
-            for _, req, _, _ in completing:
-                req._pending_n += 1
         prev = (self._carry
                 if (self.async_engine and self._carry is not None)
                 else self._zero_prev)
@@ -1267,6 +1710,8 @@ class ServingPredictor:
                 kind = (("spec_verify" if spec_len[slot] else "decode")
                         if slot in decode_set else "prefill_chunk")
                 self._req_event(req.req_id, kind, args={"tokens": int(n)})
+        fault_point("slow_step")
+        fault_point("dispatch")
         with span("dispatch"):
             res = step_fn(*head, *pools, *tail)
         self._mark_dispatch()
@@ -1277,6 +1722,12 @@ class ServingPredictor:
             out_dev, ne_dev, carry = res[0], None, res[0]
             cache.update_pages(*res[2:])
         self._carry = carry
+        # charge the dispatched-unmaterialized token per completing plain
+        # lane only once the launch SUCCEEDED (round 17: a failed launch
+        # must leave no pending to un-charge)
+        if not self.spec_k:
+            for _, req, _, _ in completing:
+                req._pending_n += 1
         # count-based cache accounting at pack time: plain lanes advance
         # by what they fed; speculative lanes advance at reconcile (their
         # watermark is n_emit, a device value)
@@ -1359,13 +1810,17 @@ class ServingPredictor:
             if not self._admit_one_legacy(req):
                 if (not self.running and self.cache.available_page_count
                         == self.cache.num_pages):
-                    self._raise_never_admittable(
+                    self.waiting.popleft()
+                    self._fail_never_admittable(
                         req, self.cache.pages_needed(
                             len(req._context_ids()) - 1))
+                    continue
                 break
             self.waiting.popleft()
 
     def _step_legacy(self) -> dict[int, list[int]]:
+        if self._deadlines_armed:
+            self._shed_expired()
         self._retire_finished()
         # admit/retire to fixpoint: a fresh prompt whose prefill token
         # already satisfies done (budget 1, or prefill token == eos) must
@@ -1399,13 +1854,22 @@ class ServingPredictor:
                                       key=lambda s: self.running[s].req_id)
                                   == slot)
                 if victim_is_self and len(self.running) == 1:
-                    raise RuntimeError(
+                    # round 17: requeue through the bounded retry path
+                    # (FAILS after max_step_retries) instead of poisoning
+                    # the predictor — same policy as the unified path
+                    self._requeue_one(slot, RuntimeError(
                         f"slot {slot}: cannot grow to "
-                        f"{self.cache.seq_len(slot) + 1} tokens — page pool "
-                        "too small for a single sequence")
+                        f"{self.cache.seq_len(slot) + 1} tokens — page "
+                        "pool too small for this sequence"),
+                        code="pool_exhausted")
+                    break
                 self._preempt_youngest()
                 if slot not in self.running:  # preempted itself
                     break
+        if not self.running:
+            # the growth loop requeued/retired every lane (round 17:
+            # pool_exhausted no longer raises): nothing to decode
+            return {}
         ids = jnp.asarray(self._next_token)
         with span("dispatch"):
             next_ids, _, kp, vp = self._decode(
@@ -1444,6 +1908,11 @@ class ServingPredictor:
         :meth:`flush`)."""
         t0 = monotonic()
         self._did_sync = False
+        # the pool-squeeze seam ticks EVERY scheduler round (never
+        # raises): it must sit above the empty-running early returns or
+        # an active squeeze could never expire while its withheld pages
+        # are exactly what blocks the next admission
+        fault_point("pool", cache=self.cache)
         try:
             if self.unified:
                 return self._step_unified()
@@ -1477,7 +1946,7 @@ class ServingPredictor:
                                + pre_rounds)
                               * (self.max_batch + 1))
         n = 0
-        while any(r.state != FINISHED for r in reqs):
+        while any(r.state not in (FINISHED, FAILED) for r in reqs):
             self.step()
             # a drained scheduler with unfinished requests means they can
             # never be admitted (oversized); surface rather than spin
@@ -1485,6 +1954,11 @@ class ServingPredictor:
                 break
             n += 1
             if n > limit:
+                # round 17: mark every straggler terminal FAILED before
+                # raising — no request is ever left non-terminal, and the
+                # predictor stays serviceable for everyone else
+                self._fail_stragglers(
+                    reqs, f"serving loop exceeded step budget ({limit})")
                 raise RuntimeError("serving loop exceeded step budget "
                                    f"({limit}) — scheduler stuck")
         # a request can finish by COUNT with its final tokens still in
@@ -1492,5 +1966,24 @@ class ServingPredictor:
         self.flush()
         return [list(r.output_ids) for r in reqs]
 
+    def _fail_stragglers(self, reqs, message: str) -> None:
+        """Terminal-FAIL every non-terminal request in ``reqs`` with
+        ``scheduler_stuck``, releasing any slot/pages held — the
+        step-budget overflow path must never leave a request in a
+        non-terminal state."""
+        stuck = [r for r in reqs if r.state not in (FINISHED, FAILED)]
+        if not stuck:
+            return
+        ids = {id(r) for r in stuck}
+        for slot in [s for s, r in self.running.items() if id(r) in ids]:
+            self.running.pop(slot)
+            self.cache.free(slot)
+        if any(id(r) in ids for r in self.waiting):
+            self.waiting = deque(r for r in self.waiting
+                                 if id(r) not in ids)
+        for req in stuck:
+            self._fail(req, "scheduler_stuck", message)
 
-__all__ = ["Request", "ServingPredictor", "WAITING", "RUNNING", "FINISHED"]
+
+__all__ = ["Request", "ServingPredictor", "SLOConfig", "WAITING",
+           "RUNNING", "FINISHED", "FAILED"]

@@ -340,6 +340,66 @@ class TestASTRules:
         """), "paddle_tpu/inference/serving.py")
         assert "AL006" not in _rules(fs)
 
+    # -- AL007: swallowed exceptions in the fenced hot-path dirs ------------
+
+    _SWALLOW_SRC = """
+        def f():
+            try:
+                work()
+            except:
+                pass
+            try:
+                work()
+            except Exception:
+                pass
+            try:
+                work()
+            except (ValueError, Exception):
+                ...
+    """
+
+    def test_al007_fires_in_inference_and_distributed(self):
+        for where in ("paddle_tpu/inference/serving.py",
+                      "paddle_tpu/distributed/collective.py"):
+            fs = astlint.lint_source(textwrap.dedent(self._SWALLOW_SRC),
+                                     where)
+            al007 = [f for f in fs if f.rule == "AL007"]
+            # bare, broad, and broad-inside-a-tuple all fire
+            assert len(al007) == 3, (where, fs)
+
+    def test_al007_silent_on_narrow_or_handled_or_outside(self):
+        handled = textwrap.dedent("""
+            def f():
+                try:
+                    work()
+                except KeyError:
+                    pass                      # narrow: deliberate drop
+                try:
+                    work()
+                except Exception as e:
+                    log(e)                    # handled, not swallowed
+                try:
+                    work()
+                except Exception:
+                    raise RuntimeError("x")   # re-raised
+        """)
+        fs = astlint.lint_source(handled, "paddle_tpu/inference/serving.py")
+        assert "AL007" not in _rules(fs)
+        # the fence covers inference/ + distributed/ only
+        fs = astlint.lint_source(textwrap.dedent(self._SWALLOW_SRC),
+                                 "paddle_tpu/models/gpt.py")
+        assert "AL007" not in _rules(fs)
+
+    def test_al007_pragma_suppresses(self):
+        fs = astlint.lint_source(textwrap.dedent("""
+            def f():
+                try:
+                    work()
+                except Exception:  # tpulint: disable=AL007
+                    pass
+        """), "paddle_tpu/inference/serving.py")
+        assert "AL007" not in _rules(fs)
+
 
 # ---------------------------------------------------------------------------
 # JX rules — seeded positive + negative per rule
@@ -785,6 +845,7 @@ class TestRepoGate:
                                          jaxpr_checks, registry_audit)
 
         for rid in ("AL001", "AL002", "AL003", "AL004", "AL005", "AL006",
+                    "AL007",
                     "JX001", "JX002", "JX003", "JX004", "JX005", "JX006",
                     "TR001", "RA001", "RA002", "RA003", "BL001"):
             assert rid in RULES, f"rule {rid} missing from the catalog"

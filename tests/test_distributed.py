@@ -896,19 +896,34 @@ class TestRound4Surface:
 
     def test_split_linear_and_embedding(self, rng):
         from paddle_tpu.distributed import fleet
+        from paddle_tpu.distributed.fleet.fleet import _fleet_state
+        from paddle_tpu.distributed.fleet.meta_parallel import (_get_hcg,
+                                                                _set_hcg)
+        from paddle_tpu.distributed.mesh import get_mesh, set_mesh
 
-        strat = fleet.DistributedStrategy()
-        strat.hybrid_configs = {"dp_degree": 1, "mp_degree": NDEV,
-                                "pp_degree": 1}
-        fleet.init(is_collective=True, strategy=strat)
-        x = rng.randn(4, 8).astype("float32")
-        y = dist.split(paddle.to_tensor(x), (8, 16), operation="linear",
-                       axis=1, gather_out=True)
-        assert tuple(y.shape) == (4, 16)
-        ids = rng.randint(0, 16, (4, 5)).astype("int64")
-        e = dist.split(paddle.to_tensor(ids), (16, 8),
-                       operation="embedding")
-        assert tuple(e.shape) == (4, 5, 8)
+        # fleet.init publishes a GLOBAL mp=NDEV mesh; restore the prior
+        # globals afterwards or every later-collected test that builds a
+        # plain model inherits mp-sharded parameter placement (surfaced
+        # by tests/test_faults.py, which sorts right after this file)
+        prev = (get_mesh(), _get_hcg(), dict(_fleet_state))
+        try:
+            strat = fleet.DistributedStrategy()
+            strat.hybrid_configs = {"dp_degree": 1, "mp_degree": NDEV,
+                                    "pp_degree": 1}
+            fleet.init(is_collective=True, strategy=strat)
+            x = rng.randn(4, 8).astype("float32")
+            y = dist.split(paddle.to_tensor(x), (8, 16), operation="linear",
+                           axis=1, gather_out=True)
+            assert tuple(y.shape) == (4, 16)
+            ids = rng.randint(0, 16, (4, 5)).astype("int64")
+            e = dist.split(paddle.to_tensor(ids), (16, 8),
+                           operation="embedding")
+            assert tuple(e.shape) == (4, 5, 8)
+        finally:
+            set_mesh(prev[0])
+            _set_hcg(prev[1])
+            _fleet_state.clear()
+            _fleet_state.update(prev[2])
 
     def test_destroy_process_group(self):
         g = dist.new_group(list(range(2)))

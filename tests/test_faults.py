@@ -1,0 +1,639 @@
+"""Round-17 serving resilience layer: deterministic fault injection
+(`inference/faults.py`), request deadlines + SLO-aware load shedding,
+crash-consistent step retry — and THE chaos property gate: a 1k-step
+continuous-arrival churn under random seeded faults where every request
+ends terminal, page/slot/refcount/pin accounting stays exact after every
+step, and every request that finishes emits the SAME tokens as a
+fault-free run (retry replays through the preemption path are
+value-barriered and bit-identical).
+
+CPU suite — same jnp-reference serving path as tests/test_serving.py.
+"""
+import numpy as np
+import pytest
+
+from paddle_tpu.inference import (FaultPlan, InjectedFault, KVCacheManager,
+                                  ServingPredictor, SLOConfig)
+from paddle_tpu.inference.faults import SEAMS, active_plan, fault_point
+from paddle_tpu.inference.serving import FAILED, FINISHED, RUNNING, WAITING
+
+from test_serving import TINY, _churn_prompts, _tiny_model
+
+TERMINAL = (FINISHED, FAILED)
+
+
+# -- FaultPlan: arming, seeding, seams --------------------------------------
+
+
+class TestFaultPlan:
+    def test_rate_validation(self):
+        with pytest.raises(ValueError, match="dispatch rate"):
+            FaultPlan(dispatch=1.5)
+        with pytest.raises(ValueError, match="pool rate"):
+            FaultPlan(pool_squeeze=-0.1)
+
+    def test_context_scoping_and_single_arm(self):
+        assert active_plan() is None
+        with FaultPlan(seed=1, dispatch=1.0) as plan:
+            assert active_plan() is plan
+            with pytest.raises(RuntimeError, match="already armed"):
+                FaultPlan().__enter__()
+        assert active_plan() is None
+
+    def test_disarmed_fault_point_is_noop(self):
+        for seam in SEAMS:
+            fault_point(seam)   # no plan armed: must not raise
+
+    def test_unknown_seam_rejected_when_armed(self):
+        with FaultPlan(seed=0, dispatch=0.5):
+            with pytest.raises(ValueError, match="unknown fault seam"):
+                fault_point("warp_core")
+
+    def test_raising_seams_fire_deterministically_from_seed(self):
+        def firing_pattern(seed, hits=40):
+            fired = []
+            with FaultPlan(seed=seed, dispatch=0.3):
+                for _ in range(hits):
+                    try:
+                        fault_point("dispatch")
+                        fired.append(0)
+                    except InjectedFault as e:
+                        assert e.seam == "dispatch"
+                        fired.append(1)
+            return fired
+
+        a, b = firing_pattern(7), firing_pattern(7)
+        assert a == b                    # same seed == same schedule
+        assert 0 < sum(a) < len(a)       # actually probabilistic
+        assert firing_pattern(8) != a    # seed really drives it
+
+    def test_certain_rate_fires_every_hit(self):
+        with FaultPlan(seed=0, h2d=1.0) as plan:
+            for _ in range(3):
+                with pytest.raises(InjectedFault):
+                    fault_point("h2d")
+        assert plan.fired["h2d"] == 3
+
+    def test_pool_squeeze_withholds_and_restores(self):
+        mgr = KVCacheManager(num_layers=1, num_kv_heads=2, head_dim=8,
+                             num_pages=8, max_batch=2, max_seq_len=32,
+                             page_size=4)
+        with FaultPlan(seed=0, pool_squeeze=1.0, squeeze_pages=3,
+                       squeeze_steps=2) as plan:
+            fault_point("pool", cache=mgr)
+            assert plan.fired["pool"] == 1
+            assert mgr.withheld_page_count == 3
+            assert mgr.free_page_count == 5
+            fault_point("pool", cache=mgr)   # round 1 of the squeeze
+            assert mgr.withheld_page_count == 3
+            fault_point("pool", cache=mgr)   # squeeze expires
+            assert mgr.withheld_page_count == 0
+            assert mgr.free_page_count == 8
+        assert mgr.withheld_page_count == 0
+
+    def test_plan_exit_releases_live_squeeze(self):
+        mgr = KVCacheManager(num_layers=1, num_kv_heads=2, head_dim=8,
+                             num_pages=8, max_batch=2, max_seq_len=32,
+                             page_size=4)
+        with FaultPlan(seed=0, pool_squeeze=1.0, squeeze_pages=2,
+                       squeeze_steps=99):
+            fault_point("pool", cache=mgr)
+            assert mgr.withheld_page_count == 2
+        # context exit returns the pages even mid-squeeze
+        assert mgr.withheld_page_count == 0
+        assert mgr.free_page_count == 8
+
+    def test_withhold_never_touches_referenced_pages(self):
+        mgr = KVCacheManager(num_layers=1, num_kv_heads=2, head_dim=8,
+                             num_pages=4, max_batch=2, max_seq_len=32,
+                             page_size=4)
+        slot = mgr.admit(6)          # claims 2 pages
+        assert mgr.withhold_pages(99) == 2   # only the strictly-free ones
+        assert mgr.withheld_page_count == 2
+        assert mgr.seq_len(slot) == 6
+        assert mgr.restore_withheld() == 2
+        assert mgr.free_page_count == 2
+
+
+# -- deadlines --------------------------------------------------------------
+
+
+def test_deadline_validation():
+    model = _tiny_model()
+    sp = ServingPredictor(model, max_batch=1, max_seq_len=32, page_size=8)
+    with pytest.raises(ValueError, match="deadline_s"):
+        sp.add_request([1, 2, 3], deadline_s=-1.0)
+
+
+def test_waiting_request_past_deadline_is_shed_as_ttl(rng):
+    """The queue TTL: an expired WAITING request fails terminal
+    ``deadline_exceeded`` at the next scheduler round and is never
+    dispatched; requests around it are served normally."""
+    model = _tiny_model()
+    sp = ServingPredictor(model, max_batch=2, max_seq_len=48, page_size=8)
+    ok = sp.add_request(rng.randint(0, TINY["vocab_size"], (4,)).tolist(),
+                        max_new_tokens=3)
+    doomed = sp.add_request(
+        rng.randint(0, TINY["vocab_size"], (4,)).tolist(),
+        max_new_tokens=3, deadline_s=0.0)
+    while sp.has_work():
+        sp.step()
+    sp.flush()
+    assert doomed.state == FAILED
+    assert doomed.error["code"] == "deadline_exceeded"
+    assert doomed.output_ids == []
+    assert ok.state == FINISHED and len(ok.output_ids) == 3
+    flat = sp.telemetry()
+    assert flat["serving_deadline_misses"] == 1
+    assert flat["serving_fail_reasons{reason=deadline_exceeded}"] == 1
+
+
+def test_running_request_past_deadline_retires(rng):
+    """A RUNNING request past its wall-clock budget retires at the next
+    round — terminal FAILED, slot and pages returned, late in-flight
+    emissions discarded."""
+    import time
+
+    model = _tiny_model()
+    sp = ServingPredictor(model, max_batch=2, max_seq_len=48, page_size=8)
+    req = sp.add_request(
+        rng.randint(0, TINY["vocab_size"], (4,)).tolist(),
+        max_new_tokens=64, deadline_s=0.05)
+    sp.step()                        # admitted + prefilling/decoding
+    assert req.state not in TERMINAL
+    time.sleep(0.06)
+    for _ in range(4):               # next rounds sweep the deadline
+        sp.step()
+        if req.state == FAILED:
+            break
+    sp.flush()
+    assert req.state == FAILED
+    assert req.error["code"] == "deadline_exceeded"
+    assert "running" in req.error["message"]
+    # the slot and its pages came back: the pool is whole again
+    assert sp.cache.free_slot_count == sp.max_batch
+    assert sp.cache.available_page_count == sp.cache.num_pages
+    # the predictor keeps serving after the retirement
+    ok = sp.add_request(rng.randint(0, TINY["vocab_size"], (4,)).tolist(),
+                        max_new_tokens=2)
+    while sp.has_work():
+        sp.step()
+    sp.flush()
+    assert ok.state == FINISHED and len(ok.output_ids) == 2
+
+
+def test_no_deadline_requests_never_swept(rng):
+    """The disarmed path: without any deadlined request the sweep never
+    arms (one bool check per step) and nothing fails."""
+    model = _tiny_model()
+    sp = ServingPredictor(model, max_batch=2, max_seq_len=48, page_size=8)
+    reqs = [sp.add_request(
+        rng.randint(0, TINY["vocab_size"], (4,)).tolist(), max_new_tokens=3)
+        for _ in range(4)]
+    assert not sp._deadlines_armed
+    while sp.has_work():
+        sp.step()
+    sp.flush()
+    assert all(r.state == FINISHED for r in reqs)
+    assert sp.telemetry()["serving_deadline_misses"] == 0
+
+
+# -- SLO-aware load shedding ------------------------------------------------
+
+
+def test_slo_config_validation():
+    with pytest.raises(ValueError, match="max_waiting"):
+        SLOConfig(max_waiting=0)
+    with pytest.raises(ValueError, match="ema_alpha"):
+        SLOConfig(ema_alpha=0.0)
+    # the percent-vs-fraction typo (0.95 meant, 95 passed) fails loudly
+    # instead of silently never firing
+    with pytest.raises(ValueError, match="fraction"):
+        SLOConfig(max_pool_occupancy=95)
+    with pytest.raises(ValueError, match="max_inflight_depth"):
+        SLOConfig(max_inflight_depth=-1)
+    with pytest.raises(ValueError, match="ttft_p99_slo_ms"):
+        SLOConfig(ttft_p99_slo_ms=0.0)
+    model = _tiny_model()
+    with pytest.raises(ValueError, match="SLOConfig"):
+        ServingPredictor(model, max_batch=1, max_seq_len=32, page_size=8,
+                         slo={"max_waiting": 3})
+
+
+def test_bounded_queue_sheds_and_recovers(rng):
+    """shed_queue_full: past the bounded waiting queue an admission comes
+    back terminal FAILED without queueing; once the backlog drains,
+    admissions flow again."""
+    model = _tiny_model()
+    sp = ServingPredictor(model, max_batch=1, max_seq_len=48, page_size=8,
+                          slo=SLOConfig(max_waiting=2))
+    prompts = [rng.randint(0, TINY["vocab_size"], (4,)).tolist()
+               for _ in range(3)]
+    # no step() has run yet, so both admissions sit in the waiting queue
+    a = sp.add_request(prompts[0], max_new_tokens=2)   # waiting[0]
+    b = sp.add_request(prompts[1], max_new_tokens=2)   # waiting[1]: full
+    assert sp.admission_verdict() == "queue_full"
+    shed = sp.add_request(prompts[2], max_new_tokens=2)
+    assert shed.state == FAILED
+    assert shed.error["code"] == "shed_queue_full"
+    assert shed not in sp.waiting
+    while sp.has_work():
+        sp.step()
+    sp.flush()
+    assert [a.state, b.state] == [FINISHED] * 2
+    assert sp.admission_verdict() is None          # backlog drained
+    late = sp.add_request(prompts[2], max_new_tokens=2)
+    assert late.state == WAITING
+    flat = sp.telemetry()
+    assert flat["serving_requests_shed"] == 1
+    assert flat["serving_fail_reasons{reason=shed_queue_full}"] == 1
+
+
+def test_pool_pressure_shed_requires_backlog(rng):
+    """max_pool_occupancy sheds only with a backlog: a busy pool with an
+    empty queue is a healthy saturated batch, not an overload."""
+    model = _tiny_model()
+    sp = ServingPredictor(
+        model, max_batch=1, max_seq_len=48, page_size=8,
+        slo=SLOConfig(max_waiting=64, max_pool_occupancy=0.01))
+    p = rng.randint(0, TINY["vocab_size"], (8,)).tolist()
+    sp.add_request(p, max_new_tokens=8)
+    sp.step()                        # running: pool occupied, queue empty
+    assert sp.pool_occupancy > 0.01
+    assert sp.admission_verdict() is None      # no backlog: admit
+    sp.add_request(p, max_new_tokens=8)        # now a backlog exists
+    assert sp.admission_verdict() == "pool_pressure"
+    shed = sp.add_request(p, max_new_tokens=8)
+    assert shed.state == FAILED
+    assert shed.error["code"] == "shed_pool_pressure"
+
+
+def test_shedding_off_by_default(rng):
+    """slo=None (the default) never sheds — the disarmed-path contract."""
+    model = _tiny_model()
+    sp = ServingPredictor(model, max_batch=1, max_seq_len=48, page_size=8)
+    assert sp.admission_verdict() is None
+    reqs = [sp.add_request(
+        rng.randint(0, TINY["vocab_size"], (4,)).tolist(), max_new_tokens=2)
+        for _ in range(8)]
+    assert all(r.state == WAITING for r in reqs)
+    assert sp.telemetry()["serving_requests_shed"] == 0
+
+
+# -- crash-consistent step retry --------------------------------------------
+
+
+def _fault_free_run(model, prompts, gen_len, **sp_kw):
+    sp = ServingPredictor(model, **sp_kw)
+    reqs = [sp.add_request(p, max_new_tokens=gen_len) for p in prompts]
+    while sp.has_work():
+        sp.step()
+    sp.flush()
+    assert all(r.state == FINISHED for r in reqs)
+    return [list(r.output_ids) for r in reqs]
+
+
+def test_transient_dispatch_fault_replays_bit_identical(rng):
+    """One injected dispatch crash: the step's claims roll back, the
+    lanes requeue through the preemption-replay path, and the finished
+    streams are BIT-IDENTICAL to a run that never faulted."""
+    model = _tiny_model()
+    kw = dict(max_batch=2, max_seq_len=48, page_size=8,
+              retry_backoff_s=0.0)
+    prompts = [rng.randint(0, TINY["vocab_size"],
+                           (int(rng.randint(2, 10)),)).tolist()
+               for _ in range(4)]
+    want = _fault_free_run(model, prompts, 4, **kw)
+
+    sp = ServingPredictor(model, **kw)
+    reqs = [sp.add_request(p, max_new_tokens=4) for p in prompts]
+    sp.step()                                 # healthy: work in flight
+    with FaultPlan(seed=0, dispatch=1.0) as plan:
+        sp.step()                             # crashes + rolls back
+    assert plan.fired["dispatch"] == 1
+    while sp.has_work():
+        sp.step()
+    sp.flush()
+    assert all(r.state == FINISHED for r in reqs)
+    assert [list(r.output_ids) for r in reqs] == want
+    flat = sp.telemetry()
+    assert flat["serving_step_failures"] == 1
+    assert flat["serving_faults_injected{seam=dispatch}"] == 1
+    assert flat["serving_step_retries"] >= 1
+    assert flat["serving_requests_failed"] == 0
+
+
+def test_transient_reconcile_fault_replays_bit_identical(rng):
+    """One injected reconcile crash on the async engine: the poisoned
+    in-flight ring drops, pending tokens un-charge, and the replayed
+    streams still match the fault-free run token-for-token."""
+    model = _tiny_model()
+    kw = dict(max_batch=2, max_seq_len=48, page_size=8, async_engine=True,
+              retry_backoff_s=0.0)
+    prompts = [rng.randint(0, TINY["vocab_size"], (5,)).tolist()
+               for _ in range(3)]
+    want = _fault_free_run(model, prompts, 5, **kw)
+
+    sp = ServingPredictor(model, **kw)
+    reqs = [sp.add_request(p, max_new_tokens=5) for p in prompts]
+    for _ in range(3):
+        sp.step()                             # build up in-flight work
+    with FaultPlan(seed=0, reconcile=1.0) as plan:
+        sp.flush()                            # materialization crashes
+    assert plan.fired["reconcile"] >= 1
+    while sp.has_work():
+        sp.step()
+    sp.flush()
+    assert all(r.state == FINISHED for r in reqs)
+    assert [list(r.output_ids) for r in reqs] == want
+    assert sp.telemetry()["serving_requests_failed"] == 0
+
+
+def test_eos_finished_request_counted_when_overhang_entry_drops(rng):
+    """Recovery-path counter regression: a request whose eos landed at an
+    earlier reconcile retires FINISHED while its overhang entry (the next
+    dispatched step, pure discard) is still in the ring. If THAT entry's
+    reconcile fails, the drop path is the last code that will ever see
+    the request — its deferred finished-counter must land there, keeping
+    finished + failed == submitted."""
+    model = _tiny_model()
+    prompt = rng.randint(0, TINY["vocab_size"], (5,)).tolist()
+    probe = ServingPredictor(model, max_batch=1, max_seq_len=48, page_size=8)
+    stream = probe.generate([prompt], max_new_tokens=6)[0]
+    eos = int(stream[2])     # greedy: the faulted run emits the same
+    want = stream[:stream.index(eos) + 1]   # stops at the FIRST eos
+
+    sp = ServingPredictor(model, max_batch=1, max_seq_len=48, page_size=8,
+                          async_engine=True, retry_backoff_s=0.0)
+    req = sp.add_request(prompt, max_new_tokens=6, eos_token_id=eos)
+    for _ in range(30):
+        sp.step()
+        if req.done and req.state == RUNNING and sp._inflight:
+            break            # eos landed; the overhang entry is in flight
+    else:
+        pytest.fail("never reached the eos-landed/overhang-in-ring state")
+    with FaultPlan(seed=0, reconcile=1.0) as plan:
+        sp.step()            # retires FINISHED, then the drain crashes
+    assert plan.fired["reconcile"] == 1
+    while sp.has_work():
+        sp.step()
+    sp.flush()
+    assert req.state == FINISHED
+    assert req.output_ids == want
+    flat = sp.telemetry()
+    assert flat["serving_requests_finished"] == 1
+    assert flat["serving_requests_failed"] == 0
+
+
+def test_retry_exhaustion_fails_request_not_predictor(rng):
+    """A persistent fault FAILS the affected requests after
+    max_step_retries (loud ``step_retry_exhausted`` record) — and the
+    predictor serves the next request normally once the fault clears."""
+    model = _tiny_model()
+    sp = ServingPredictor(model, max_batch=1, max_seq_len=48, page_size=8,
+                          max_step_retries=2, retry_backoff_s=0.0)
+    req = sp.add_request(
+        rng.randint(0, TINY["vocab_size"], (4,)).tolist(), max_new_tokens=4)
+    with FaultPlan(seed=0, dispatch=1.0):
+        for _ in range(8):
+            sp.step()                         # every dispatch crashes
+            if req.state == FAILED:
+                break
+    assert req.state == FAILED
+    assert req.error["code"] == "step_retry_exhausted"
+    assert req.retry_count == 3               # bounded: 2 retries + final
+    # accounting is whole and the predictor is still serviceable
+    assert sp.cache.available_page_count == sp.cache.num_pages
+    assert sp.cache.free_slot_count == sp.max_batch
+    ok = sp.add_request(
+        rng.randint(0, TINY["vocab_size"], (4,)).tolist(), max_new_tokens=2)
+    while sp.has_work():
+        sp.step()
+    sp.flush()
+    assert ok.state == FINISHED and len(ok.output_ids) == 2
+
+
+def test_single_sequence_pool_exhaustion_fails_individually(rng):
+    """Round-17 satellite regression: a sequence that cannot grow even
+    with the pool to itself FAILS (``pool_exhausted``) after bounded
+    retries instead of raising out of step() — and the predictor keeps
+    serving requests that fit."""
+    model = _tiny_model()
+    # max_step_retries=0 pins the DIRECT pool_exhausted terminal: with
+    # retries allowed, the requeued context carries the emitted-but-not-
+    # yet-written token, overflows the pool by exactly one, and the
+    # admission pass re-attributes the failure to never_admittable (the
+    # individual-failure contract is identical; that path is pinned in
+    # test_serving's never-admittable regression)
+    sp = ServingPredictor(model, max_batch=1, max_seq_len=96, page_size=4,
+                          num_pages=2, max_step_retries=0,
+                          retry_backoff_s=0.0)   # pool: 8 tokens
+    big = sp.add_request(
+        rng.randint(0, TINY["vocab_size"], (7,)).tolist(),
+        max_new_tokens=8)
+    while sp.has_work():
+        sp.step()
+    sp.flush()
+    assert big.state == FAILED
+    assert big.error["code"] == "pool_exhausted"
+    assert "cannot grow" in big.error["message"]
+    assert sp.cache.available_page_count == sp.cache.num_pages
+    small = sp.add_request(
+        rng.randint(0, TINY["vocab_size"], (3,)).tolist(), max_new_tokens=2)
+    while sp.has_work():
+        sp.step()
+    sp.flush()
+    assert small.state == FINISHED and len(small.output_ids) == 2
+
+
+def test_pool_squeeze_expires_with_no_running_lanes(rng):
+    """Liveness regression: the pool seam ticks at the top of EVERY
+    step() round, so a squeeze whose withheld pages are exactly what
+    blocks the next admission still expires — the request admits and
+    finishes instead of spinning to scheduler_stuck."""
+    model = _tiny_model()
+    # pool: 4 pages x 4 tokens; the squeeze withholds 3 of 4 pages
+    sp = ServingPredictor(model, max_batch=1, max_seq_len=32, page_size=4,
+                          num_pages=4, retry_backoff_s=0.0)
+    with FaultPlan(seed=0, pool_squeeze=1.0, squeeze_pages=3,
+                   squeeze_steps=2) as plan:
+        sp.step()                       # idle round arms the squeeze
+        assert plan.fired["pool"] == 1
+        assert sp.cache.withheld_page_count == 3
+        # a 10-token prompt needs 3 pages: blocked by the squeeze, and
+        # NOTHING is running — only the per-round tick can free it
+        req = sp.add_request(
+            rng.randint(0, TINY["vocab_size"], (10,)).tolist(),
+            max_new_tokens=2)
+        for _ in range(20):
+            sp.step()
+            if req.state == FINISHED:
+                break
+        sp.flush()
+        assert req.state == FINISHED and len(req.output_ids) == 2
+    assert sp.cache.withheld_page_count == 0
+
+
+def test_generate_step_budget_overflow_fails_stragglers(rng, monkeypatch):
+    """Round-17 satellite regression: when generate()'s serving loop
+    exceeds its step budget (a wedged scheduler), every straggler is
+    marked terminal FAILED("scheduler_stuck") BEFORE the raise — no
+    request is ever left non-terminal, and the predictor's queue and
+    pool come back whole."""
+    model = _tiny_model()
+    sp = ServingPredictor(model, max_batch=2, max_seq_len=48, page_size=8)
+    monkeypatch.setattr(sp, "step", lambda: {})   # a scheduler that spins
+    with pytest.raises(RuntimeError, match="scheduler stuck"):
+        sp.generate([rng.randint(0, TINY["vocab_size"], (4,)).tolist()],
+                    max_new_tokens=3)
+    flat = sp.telemetry()
+    assert flat["serving_fail_reasons{reason=scheduler_stuck}"] == 1
+    assert flat["serving_requests_failed"] == 1
+    assert not sp.has_work()                       # nothing non-terminal
+    assert sp.cache.free_slot_count == sp.max_batch
+    assert sp.cache.available_page_count == sp.cache.num_pages
+
+
+# -- THE chaos property gate ------------------------------------------------
+
+
+def _assert_accounting_exact(mgr):
+    """Conservation invariants under fault injection: refcounts mirror
+    slot references; free, withheld, prefix-LRU and referenced pages
+    PARTITION the pool; registered pages never sit on the free list.
+    (The withheld set is the round-17 addition to test_serving's
+    ``_assert_cache_consistent``.)"""
+    refs = np.zeros((mgr.num_pages,), np.int64)
+    for slot in range(mgr.max_batch):
+        for pg in mgr._page_table[slot]:
+            if pg >= 0:
+                refs[int(pg)] += 1
+    np.testing.assert_array_equal(refs, mgr._refcount)
+    free = set(mgr._free_pages)
+    withheld = set(mgr._withheld)
+    lru = set(mgr._lru)
+    held = {p for p in range(mgr.num_pages) if mgr._refcount[p] > 0}
+    groups = [free, withheld, lru, held]
+    for i, a in enumerate(groups):
+        for b in groups[i + 1:]:
+            assert not a & b
+    assert len(free) + len(withheld) + len(lru) + len(held) == mgr.num_pages
+    assert not any(p in mgr._page_key for p in free | withheld)
+
+
+def test_chaos_1k_step_churn_under_seeded_faults(rng):
+    """THE round-17 acceptance gate: a 1k-step continuous-arrival churn
+    under random seeded faults at EVERY seam (dispatch / h2d / reconcile
+    crashes, straggler sleeps, pool-pressure squeezes) where
+
+    - ``step()`` never raises (every failure is owned by the recovery),
+    - page/slot/refcount/pin accounting is exact after EVERY step,
+    - every request ends terminal (FINISHED | FAILED),
+    - every FINISHED stream is bit-identical to the fault-free run
+      (replay through the preemption path is value-barriered), and
+    - the drained pool returns whole — exactly matching the fault-free
+      mirror's end state.
+    """
+    model = _tiny_model()
+    kw = dict(max_batch=3, max_seq_len=48, page_size=8, chunk=8,
+              num_pages=14,                  # tight: real preemptions
+              async_engine=True, max_step_retries=6, retry_backoff_s=0.0)
+    prompts = _churn_prompts(rng, 450)
+
+    def run(eos=None, pool=prompts):
+        sp = ServingPredictor(model, **kw)
+        queued = list(pool)
+        reqs = []
+        steps = 0
+        live = lambda: sum(  # noqa: E731
+            1 for r in reqs if r.state not in TERMINAL)
+        while queued or sp.has_work():
+            while queued and live() < sp.max_batch:
+                reqs.append(sp.add_request(queued.pop(0), max_new_tokens=5,
+                                           eos_token_id=eos))
+            sp.step()
+            steps += 1
+            _assert_accounting_exact(sp.cache)
+            assert steps < 30000, "chaos churn stuck"
+        sp.flush()
+        _assert_accounting_exact(sp.cache)
+        # terminal counters partition the submitted set exactly
+        flat = sp.telemetry()
+        assert (flat["serving_requests_finished"]
+                + flat["serving_requests_failed"] == len(reqs))
+        assert flat["serving_requests_finished"] == sum(
+            1 for r in reqs if r.state == FINISHED)
+        return sp, reqs, steps
+
+    _, want_reqs, _ = run()
+    want = [list(r.output_ids) for r in want_reqs]
+
+    plan = FaultPlan(seed=11, dispatch=0.02, h2d=0.015, reconcile=0.02,
+                     slow_step=0.02, slow_step_s=1e-4,
+                     pool_squeeze=0.05, squeeze_pages=3, squeeze_steps=2)
+    with plan:
+        sp, reqs, steps = run(plan)
+    assert steps >= 1000                       # a real 1k-step churn
+
+    # every seam actually fired under the seeded schedule
+    for seam in ("dispatch", "h2d", "reconcile", "slow_step", "pool"):
+        assert plan.fired[seam] > 0, seam
+    # every request is terminal, and the churn survived well past the
+    # fault load: most requests finished despite ~7% step crash rate
+    assert all(r.state in TERMINAL for r in reqs)
+    finished = [i for i, r in enumerate(reqs) if r.state == FINISHED]
+    assert len(finished) > len(reqs) * 0.5
+    # bit-identity: every finished stream matches the fault-free mirror
+    for i in finished:
+        assert list(reqs[i].output_ids) == want[i], f"request {i} diverged"
+    # failed requests carry loud, attributable error records
+    for r in reqs:
+        if r.state == FAILED:
+            assert r.error is not None and r.error["code"]
+    # the drained pool matches the mirror's end state exactly
+    cache = sp.cache
+    assert cache.available_page_count == cache.num_pages
+    assert cache.free_slot_count == cache.max_batch
+    assert cache.withheld_page_count == 0
+    # observed-fault attribution: every raised injection was counted on
+    # the registry, by seam, and nothing else incremented the counter
+    flat = sp.telemetry()
+    raised = (plan.fired["dispatch"] + plan.fired["h2d"]
+              + plan.fired["reconcile"])
+    assert flat["serving_step_failures"] == raised
+    for seam in ("dispatch", "h2d", "reconcile"):
+        assert (flat[f"serving_faults_injected{{seam={seam}}}"]
+                == plan.fired[seam])
+    assert flat["serving_requests_failed"] == len(reqs) - len(finished)
+
+    # -- the eos leg: early-stopping requests under the same fault load —
+    # exercises the subtlest recovery paths (a done request retired or
+    # still running while its overhang entry drops / a drain fails)
+    eos_pool = prompts[:150]
+    _, reqs0, _ = run(eos=None, pool=eos_pool)
+    eos = int(np.bincount([t for r in reqs0
+                           for t in r.output_ids]).argmax())
+    _, want_eos_reqs, _ = run(eos=eos, pool=eos_pool)
+    want_eos = [list(r.output_ids) for r in want_eos_reqs]
+    assert any(len(w) < 5 for w in want_eos)   # eos really stops early
+    with FaultPlan(seed=23, dispatch=0.02, h2d=0.015, reconcile=0.03,
+                   slow_step=0.02, slow_step_s=1e-4,
+                   pool_squeeze=0.05, squeeze_pages=3, squeeze_steps=2):
+        _, eos_reqs, _ = run(eos=eos, pool=eos_pool)
+    assert all(r.state in TERMINAL for r in eos_reqs)
+    for i, r in enumerate(eos_reqs):
+        if r.state == FINISHED:
+            assert list(r.output_ids) == want_eos[i], f"eos req {i}"
+
+
+def test_disarmed_engine_is_bit_identical_to_pre17(rng):
+    """The disarmed-path contract, stated directly: no plan armed, no
+    deadlines, shedding off — the engine emits exactly what the
+    fault-free oracle emits (the wider equivalence gates live in
+    tests/test_serving.py and pass unchanged)."""
+    model = _tiny_model()
+    kw = dict(max_batch=3, max_seq_len=48, page_size=8, chunk=8)
+    prompts = _churn_prompts(rng, 30)
+    a = _fault_free_run(model, prompts, 5, **kw)
+    b = _fault_free_run(model, prompts, 5, **kw)
+    assert a == b

@@ -385,6 +385,116 @@ class TestServingTelemetry:
         assert got == want
         recorder.clear()
 
+    # -- round 17: the resilience layer's load-signal surface ---------------
+
+    #: the healthz() contract the fleet router consumes — key -> type
+    #: predicate; a key added or dropped fails HERE, not in the router
+    _HEALTHZ_SCHEMA = {
+        "status": lambda v: v in ("ok", "shedding"),
+        "shed_reason": lambda v: v is None or (isinstance(v, str) and v),
+        "waiting": lambda v: isinstance(v, int) and v >= 0,
+        "running": lambda v: isinstance(v, int) and v >= 0,
+        "inflight_steps": lambda v: isinstance(v, int) and v >= 0,
+        "free_slots": lambda v: isinstance(v, int) and v >= 0,
+        "pool_occupancy": lambda v: isinstance(v, float) and 0 <= v <= 1,
+        "withheld_pages": lambda v: isinstance(v, int) and v >= 0,
+        "ttft_p99_ema_ms": lambda v: isinstance(v, float) and v >= 0,
+        "steps": lambda v: isinstance(v, int) and v >= 0,
+        "tokens_emitted": lambda v: isinstance(v, int) and v >= 0,
+        "requests_shed": lambda v: isinstance(v, int) and v >= 0,
+        "deadline_misses": lambda v: isinstance(v, int) and v >= 0,
+        "requests_failed": lambda v: isinstance(v, int) and v >= 0,
+        "step_failures": lambda v: isinstance(v, int) and v >= 0,
+        "step_retries": lambda v: isinstance(v, int) and v >= 0,
+    }
+
+    def _check_healthz(self, hz):
+        assert set(hz) == set(self._HEALTHZ_SCHEMA), (
+            "healthz() schema drifted: the fleet router's surface is "
+            f"locked here (got {sorted(hz)})")
+        for key, ok in self._HEALTHZ_SCHEMA.items():
+            assert ok(hz[key]), f"healthz[{key!r}] malformed: {hz[key]!r}"
+        json.dumps(hz)   # the surface is a JSON endpoint: must serialize
+
+    def test_healthz_snapshot_schema_and_shed_counters(self, rng):
+        """Round-17 satellite: the healthz() snapshot schema is locked,
+        and the shed / deadline / retry / fault counters land on the
+        registry (flat-snapshot keys the bench telemetry gate rides)."""
+        from paddle_tpu.inference import (FaultPlan, ServingPredictor,
+                                          SLOConfig)
+        from paddle_tpu.inference.serving import FAILED
+
+        model = _tiny_model()
+        sp = ServingPredictor(model, max_batch=1, page_size=8,
+                              max_seq_len=64, use_kernel=False,
+                              retry_backoff_s=0.0,
+                              slo=SLOConfig(max_waiting=2))
+        self._check_healthz(sp.healthz())
+        assert sp.healthz()["status"] == "ok"
+        p = rng.randint(0, TINY["vocab_size"], (6,))
+        ok = sp.add_request(p, max_new_tokens=3)
+        filler = sp.add_request(p, max_new_tokens=3)       # queue now full
+        hz = sp.healthz()
+        self._check_healthz(hz)
+        assert hz["status"] == "shedding"
+        assert hz["shed_reason"] == "queue_full"
+        shed = sp.add_request(p, max_new_tokens=3)         # shed terminal
+        assert shed.state == FAILED
+        sp.step()                    # ok admitted: the queue has headroom
+        expired = sp.add_request([1, 2], max_new_tokens=2, deadline_s=0.0)
+        sp.step()                                          # TTL sweep
+        with FaultPlan(seed=0, dispatch=1.0):
+            sp.step()                                      # one injected crash
+        while sp.has_work():
+            sp.step()
+        sp.flush()
+        assert ok.state == "finished" and filler.state == "finished"
+        assert expired.error["code"] == "deadline_exceeded"
+        # every resilience counter is live on the flat snapshot
+        flat = sp.telemetry()
+        assert flat["serving_requests_shed"] == 1
+        assert flat["serving_deadline_misses"] == 1
+        assert flat["serving_step_failures"] == 1
+        assert flat["serving_step_retries"] >= 1
+        assert flat["serving_faults_injected{seam=dispatch}"] == 1
+        assert flat["serving_requests_failed"] == 2        # shed + expired
+        assert flat["serving_fail_reasons{reason=shed_queue_full}"] == 1
+        assert flat["serving_fail_reasons{reason=deadline_exceeded}"] == 1
+        # healthz mirrors the registry after the churn
+        hz = sp.healthz()
+        self._check_healthz(hz)
+        assert hz["requests_shed"] == 1 and hz["deadline_misses"] == 1
+        assert hz["requests_failed"] == 2 and hz["step_failures"] == 1
+        assert hz["status"] == "ok"                        # backlog drained
+
+    def test_deadline_at_nominal_load_emits_zero_sheds(self, rng):
+        """Round-17 satellite: deadlines + an armed SLO at NOMINAL load
+        are free — every request finishes, zero sheds, zero deadline
+        misses, zero failures (the disarmed-path half of the overload
+        bench gate, deterministic here)."""
+        from paddle_tpu.inference import ServingPredictor, SLOConfig
+
+        model = _tiny_model()
+        sp = ServingPredictor(
+            model, max_batch=2, page_size=8, max_seq_len=64,
+            use_kernel=False,
+            slo=SLOConfig(max_waiting=16, max_pool_occupancy=0.95,
+                          max_inflight_depth=8, ttft_p99_slo_ms=6e4))
+        reqs = [sp.add_request(rng.randint(0, TINY["vocab_size"], (6,)),
+                               max_new_tokens=4, deadline_s=60.0)
+                for _ in range(6)]
+        while sp.has_work():
+            sp.step()
+        sp.flush()
+        assert all(r.state == "finished" for r in reqs)
+        flat = sp.telemetry()
+        assert flat["serving_requests_shed"] == 0
+        assert flat["serving_deadline_misses"] == 0
+        assert flat["serving_requests_failed"] == 0
+        hz = sp.healthz()
+        self._check_healthz(hz)
+        assert hz["status"] == "ok" and hz["shed_reason"] is None
+
 
 # ---------------------------------------------------------------------------
 # train-step + collective telemetry (library-wide registry)

@@ -711,16 +711,46 @@ def test_generate_params_cache_tracks_weight_updates(rng):
     np.testing.assert_array_equal(c, ctx[:, 5:])  # new weights served
 
 
-def test_predictor_fails_fast_on_never_admittable_request(rng):
-    """A prompt that can never fit the page pool raises the real cause
-    immediately instead of spinning empty scheduler steps."""
+def test_predictor_fails_never_admittable_request_individually(rng):
+    """Round-17 regression (the pre-17 behavior RAISED out of step() and
+    wedged the predictor for everyone): a prompt that can never fit the
+    page pool fails ONLY that request — terminal FAILED with a loud
+    error record naming the real cause — and the scheduler keeps
+    serving the requests behind it."""
+    from paddle_tpu.inference.serving import FAILED
+
     model = _tiny_model()
     sp = ServingPredictor(model, max_batch=1, max_seq_len=32, page_size=4,
                           num_pages=2)  # pool holds 8 tokens total
-    sp.add_request(list(rng.randint(0, TINY["vocab_size"], (20,))),
-                   max_new_tokens=4)
-    with pytest.raises(RuntimeError, match="num_pages"):
+    doomed = sp.add_request(list(rng.randint(0, TINY["vocab_size"], (20,))),
+                            max_new_tokens=4)
+    ok = sp.add_request(list(rng.randint(0, TINY["vocab_size"], (4,))),
+                        max_new_tokens=3)
+    while sp.has_work():
         sp.step()
+    sp.flush()
+    assert doomed.state == FAILED
+    assert doomed.error["code"] == "never_admittable"
+    assert "num_pages" in doomed.error["message"]
+    assert doomed.output_ids == []
+    # the request QUEUED BEHIND the doomed one was served normally
+    assert ok.state == FINISHED and len(ok.output_ids) == 3
+    flat = sp.telemetry()
+    assert flat["serving_requests_failed"] == 1
+    assert flat["serving_fail_reasons{reason=never_admittable}"] == 1
+    # the same contract on the legacy two-jit path (serving.py:679's
+    # other caller)
+    sp2 = ServingPredictor(model, max_batch=1, max_seq_len=32, page_size=4,
+                           num_pages=2, unified=False)
+    doomed2 = sp2.add_request(
+        list(rng.randint(0, TINY["vocab_size"], (20,))), max_new_tokens=4)
+    ok2 = sp2.add_request(list(rng.randint(0, TINY["vocab_size"], (4,))),
+                          max_new_tokens=3)
+    while sp2.has_work():
+        sp2.step()
+    assert doomed2.state == FAILED
+    assert doomed2.error["code"] == "never_admittable"
+    assert ok2.state == FINISHED and len(ok2.output_ids) == 3
 
 
 def test_generate_zero_budget_returns_empty(rng):
@@ -2136,6 +2166,49 @@ def test_bench_serve_mega_leg_gates():
     # strictly below the per-op leg's on the same quantized churn
     assert (rec["hbm_bytes_per_token"]
             < rec["mega_off_hbm_bytes_per_token"])
+
+
+def test_bench_serve_overload_leg_gates():
+    """The round-17 bench acceptance (via --legs, the tier-1 smoke
+    subset selector): under synthetic overload the armed SLO actually
+    sheds (``shed_rate > 0``) and the expired-deadline stragglers
+    actually miss (``deadline_miss_rate > 0``) while the served lanes
+    keep emitting (``value > 0``, no retrace) — and the interleaved
+    nominal-load partner, same predictor config, sheds and misses
+    EXACTLY nothing (its rates ride the overload line)."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, "bench_serve.py", "--smoke", "--steps=6",
+         "--batch=2", "--prompt=8", "--gen-len=3",
+         "--legs=unified-overload"],
+        cwd=root, capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [l for l in proc.stdout.strip().splitlines() if l.strip()]
+    assert len(lines) == 1, proc.stdout
+    rec = json.loads(lines[0])
+    assert "error" not in rec, rec
+    assert rec["leg"] == "unified-overload"
+    # the overload half: sheds and deadline misses really happened, and
+    # the predictor SURVIVED them serving tokens the whole time
+    assert rec["value"] > 0
+    assert rec["shed_rate"] > 0
+    assert rec["deadline_miss_rate"] > 0
+    assert 0 < rec["failed_requests"]
+    assert rec["decode_retraces"] == 1            # shedding never retraces
+    # failure accounting agrees with the line's own telemetry
+    tel = rec["telemetry"]
+    assert tel["serving_requests_shed"] > 0
+    assert tel["serving_deadline_misses"] > 0
+    assert (rec["failed_requests"]
+            == tel["serving_requests_failed"]
+            >= tel["serving_requests_shed"] + tel["serving_deadline_misses"])
+    # ... and the served lanes really finished requests under the storm
+    assert tel["serving_requests_finished"] > 0
+    # the nominal half: the SAME armed SLO + deadlines at nominal load
+    # shed and miss exactly nothing
+    assert rec["nominal_shed_rate"] == 0.0
+    assert rec["nominal_deadline_miss_rate"] == 0.0
 
 
 def test_bench_serve_legs_filtered_baseline_omits_ratio():
