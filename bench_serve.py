@@ -66,6 +66,14 @@ schema/contract as bench.py — the flagship quantized line LAST):
   (the disabled path is one flag check; the traced path records
   pack_dispatch/reconcile spans + per-request lanes every step).
 
+- ``tokens_per_s_per_replica``/``affinity_hit_rate``/``failover_count``:
+  round 18 — the ``fleet-churn`` leg runs the same churn shape through a
+  two-replica :class:`FleetRouter` with replica churn injected (one
+  deterministic kill + seeded ``replica_stall`` faults): aggregate
+  fleet tokens/s stays live through replica loss, placements split
+  between the prefix-affinity map and power-of-two-choices, and the
+  bounded per-replica SLO sheds the flood (``shed_rate``).
+
 ``--smoke``: tiny CPU config — always runnable (CI leg, rc 0; gather
 reference attention keeps it fast, kernel parity is the test suite's
 job). Off-TPU without ``--smoke`` each leg emits a structured ``error``
@@ -420,6 +428,134 @@ class _OverloadLeg(_ChurnLeg):
         return out
 
 
+class _FleetLeg:
+    """The round-18 fleet-churn leg: N ``ServingPredictor`` replicas
+    behind a :class:`FleetRouter` on the shared round-robin prompt-pool
+    churn — repeated prompts exercise the prefix-affinity map (a
+    submission lands where its chain-keyed pages already live), the
+    flood past fleet capacity exercises the health-gated SLO shedding,
+    and the injected replica churn (one deterministic kill between
+    windows + the seeded ``replica_stall`` seam) exercises failover as a
+    ROUTING EVENT: the leg's tokens/s stays live through replica loss.
+    ``value`` is fleet-aggregate tokens/s (median over windows, flush
+    inside the timing); the checked line carries
+    ``tokens_per_s_per_replica`` / ``affinity_hit_rate`` /
+    ``failover_count`` / ``shed_rate`` and the fleet registry snapshot.
+    """
+
+    def __init__(self, *, hidden, layers, heads, vocab, batch, prompt,
+                 gen_len, page_size, chunk, use_kernel, on_tpu,
+                 num_replicas=2, overload=3):
+        import jax.numpy as jnp
+
+        import paddle_tpu as paddle
+        from paddle_tpu.inference import FleetRouter, SLOConfig
+        from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+        self.batch, self.gen_len = batch, gen_len
+        self.num_replicas = num_replicas
+        max_len = prompt + gen_len + 32
+        paddle.seed(0)
+        cfg = GPTConfig(vocab_size=vocab, hidden_size=hidden,
+                        num_layers=layers, num_heads=heads,
+                        max_seq_len=max_len)
+        model = GPTForCausalLM(cfg)
+        model.eval()
+        self.router = FleetRouter(
+            model, num_replicas=num_replicas, seed=0,
+            replica_kw=dict(
+                max_batch=batch, page_size=page_size, max_seq_len=max_len,
+                use_kernel=use_kernel, chunk=chunk,
+                dtype=jnp.bfloat16 if on_tpu else None,
+                # the bounded queue makes the flood shed deterministically
+                slo=SLOConfig(max_waiting=batch + 2)))
+        rng = np.random.RandomState(0)
+        self.pool = [rng.randint(0, vocab, (prompt,))
+                     for _ in range(max(2, batch // 2))]
+        self.arrivals = 0
+        self.reqs = []
+        self.target_live = num_replicas * batch * overload
+        self.win_vals = []
+
+    def _tokens_total(self):
+        return sum(v for k, v in self.router.telemetry().items()
+                   if k.startswith("fleet_tokens_emitted"))
+
+    def top_up(self):
+        # flood: bounded attempts per round — a shed submission comes
+        # back terminal instantly and must not resubmit unboundedly
+        live = sum(1 for r in self.reqs
+                   if r.state not in ("finished", "failed"))
+        for _ in range(self.target_live):
+            if live >= self.target_live:
+                break
+            r = self.router.submit(
+                self.pool[self.arrivals % len(self.pool)],
+                max_new_tokens=self.gen_len)
+            self.reqs.append(r)
+            self.arrivals += 1
+            if r.state != "failed":
+                live += 1
+
+    def warm(self):
+        self.top_up()
+        first = list(self.reqs)
+        ticks = 0
+        while any(r.state not in ("finished", "failed")
+                  and not r.output_ids for r in first):
+            self.top_up()
+            self.router.tick()
+            ticks += 1
+            if ticks > 10000:
+                raise RuntimeError("fleet warmup stuck")
+        self.router.flush()
+
+    def window(self, steps):
+        t0 = time.perf_counter()
+        w_tokens = self._tokens_total()
+        for _ in range(steps):
+            self.top_up()
+            self.router.tick()
+        self.router.flush()
+        dw = time.perf_counter() - t0
+        self.win_vals.append((self._tokens_total() - w_tokens) / dw)
+
+    def report(self):
+        flat = self.router.telemetry()
+        value = round(float(np.median(self.win_vals)), 1)
+        if not value:
+            raise RuntimeError("no tokens produced over the fleet churn")
+        arrivals = max(1, self.arrivals)
+        return dict(
+            value=value, unit="tokens/s",
+            tokens_per_s_per_replica=round(value / self.num_replicas, 1),
+            affinity_hit_rate=round(self.router.affinity_hit_rate, 3),
+            failover_count=int(flat["fleet_failovers"]),
+            shed_rate=round(flat["fleet_requests_shed"] / arrivals, 4),
+            failed_requests=int(flat["fleet_requests_failed"]),
+            telemetry=flat,
+        )
+
+
+def bench_serving_fleet(*, steps, windows, **leg_kw):
+    """The round-18 fleet churn with replica churn injected mid-run: the
+    seeded ``replica_stall`` seam armed across every timed window, plus
+    ONE deterministic ``kill_replica`` between the first two windows —
+    the failover gate (``failover_count >= 1``) never rides on a
+    probabilistic draw. Faults disarm (plan scope) before report()."""
+    from paddle_tpu.inference import FaultPlan
+
+    leg = _FleetLeg(**leg_kw)
+    leg.warm()
+    with _gc_frozen():
+        with FaultPlan(seed=5, replica_stall=0.05, stall_ticks=2):
+            for w in range(windows):
+                leg.window(steps)
+                if w == 0:
+                    leg.router.kill_replica(0, reason="bench_churn")
+    return leg.report()
+
+
 def bench_serving_overload(*, steps, windows, **leg_kw):
     """The round-17 resilience pair: the SAME churn shape at overload
     (3x arrivals, bounded queue, expired-deadline stragglers — the SLO
@@ -656,6 +792,11 @@ def main():
         # armed) vs nominal load — shed/deadline/failure accounting on
         # the line, nominal partner's rates riding it at exactly zero
         ("unified-overload", None),
+        # round-18 fleet leg: N=2 replicas behind the FleetRouter on the
+        # same churn shape with replica churn injected (one kill +
+        # seeded stalls) — per-replica tokens/s, affinity hit rate,
+        # failover and shed accounting on the checked line
+        ("fleet-churn", None),
         # round-16 A/B: the SAME int8w+int8kv churn with the decode hot
         # loop per-op vs megakernelized (fused per-layer Pallas kernels,
         # activations pinned in VMEM) — measured interleaved, greedy
@@ -755,6 +896,12 @@ def main():
                     round(out["value"] / nom_out["value"], 3)
                     if nom_out["value"] else 0.0)
                 results[name] = out
+            elif name == "fleet-churn":
+                out = bench_serving_fleet(
+                    on_tpu=on_tpu, use_kernel=use_kernel,
+                    steps=shape["steps"], windows=2,
+                    **{k: v for k, v in shape.items() if k != "steps"})
+                results[name] = dict(metric=metric_for(name), **out)
             elif name == "unified-obs":
                 off_out, on_out, ratio = bench_serving_obs_ab(
                     unified=True, on_tpu=on_tpu, use_kernel=use_kernel,
@@ -824,6 +971,9 @@ def main():
     # nominal-load partner: vs_baseline = overload/nominal tokens/s —
     # how much throughput the shed storm costs the served lanes)
     _emit("unified-overload", None)
+    # round-18 fleet leg (no baseline partner: a one-replica fleet IS
+    # the unified-step leg — the line's value is fleet-aggregate)
+    _emit("fleet-churn", None)
     # round-16 flagship LAST: the megakernelized int8w+int8kv decode A/B
     # (self-baselined on its interleaved mega-off partner)
     _emit("unified-mega", None)

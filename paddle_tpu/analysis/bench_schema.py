@@ -79,7 +79,15 @@ _OPTIONAL_NUMERIC = ("vs_baseline", "p50_ms", "p99_ms", "anchor_tflops",
                      # partner's rates riding the overload line (the
                      # shed_rate == 0 at-nominal-load half of the gate)
                      "shed_rate", "deadline_miss_rate", "failed_requests",
-                     "nominal_shed_rate", "nominal_deadline_miss_rate")
+                     "nominal_shed_rate", "nominal_deadline_miss_rate",
+                     # round 18: the multi-replica fleet leg — aggregate
+                     # throughput split per live replica, the fraction of
+                     # placements the prefix-affinity map decided, and the
+                     # request migrations the injected replica churn
+                     # forced (failover as a routing event: the leg's
+                     # tokens/s stays live through them)
+                     "tokens_per_s_per_replica", "affinity_hit_rate",
+                     "failover_count")
 _OPTIONAL_STRING = ("mesh_shape", "comm_quant")
 
 #: the bench_serve leg-name enum (round 16): every serving line carries
@@ -91,7 +99,7 @@ KNOWN_LEGS = frozenset((
     "legacy-two-jit", "unified-step", "unified-async", "unified-obs",
     "unified-spmd", "unified-spec-base", "unified-spec-k4",
     "unified-int8w", "unified-int8w-int8kv", "unified-mega",
-    "unified-overload",
+    "unified-overload", "fleet-churn",
 ))
 
 

@@ -174,6 +174,9 @@ def __getattr__(name):
     # the StableHLO Predictor never pulls the models package
     lazy = {"ServingPredictor": ".serving", "Request": ".serving",
             "KVCacheManager": ".kv_cache",
+            # round-18 fleet layer: router + fleet-side request handle
+            "FleetRouter": ".fleet_serving",
+            "FleetRequest": ".fleet_serving",
             # round-17 resilience layer: SLO shedding + fault injection
             "SLOConfig": ".serving",
             "FaultPlan": ".faults",
@@ -194,6 +197,7 @@ def __getattr__(name):
 __all__ = ["Config", "Predictor", "Tensor_", "create_predictor",
            "get_version", "PrecisionType", "PlaceType",
            "ServingPredictor", "Request", "KVCacheManager",
+           "FleetRouter", "FleetRequest",
            "SLOConfig", "FaultPlan", "InjectedFault",
            "DraftProposer", "quantize_serving_params", "quantize_weight",
            "serving_weight_bytes"]

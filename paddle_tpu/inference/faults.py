@@ -31,6 +31,20 @@ Seams (the names ``ServingPredictor`` calls :func:`fault_point` with):
   entry's emissions would materialize (the async engine's hard sync) —
   the model of a device error surfacing at block time.
 
+Round 18 adds the FLEET seams (``inference/fleet_serving.py`` hits them
+once per replica per tick):
+
+- ``replica_crash`` — raises :class:`InjectedFault` where the fleet
+  router would step a replica: the model of a replica process dying.
+  The router owns the recovery (declare the replica DEAD, migrate its
+  non-terminal requests, restart a fresh predictor into the slot).
+- ``replica_stall`` — a RETURNING seam: when it fires,
+  :func:`fault_point` returns ``stall_ticks`` (the number of scheduler
+  ticks the replica will make no progress — a hung device / wedged host
+  loop) instead of raising; unfired hits return ``None``. The router
+  applies the stall (skips the replica's step) and its health gate
+  observes it through the stale ``snapshot_age_s`` stamp.
+
 Raising seams model CRASHES, so they raise **before** the operation they
 name (a half-applied operation is the scheduler's job to make
 impossible, not the plan's). ``plan.fired`` counts firings per seam for
@@ -47,7 +61,8 @@ __all__ = ["FaultPlan", "InjectedFault", "SEAMS", "active_plan",
            "fault_point"]
 
 #: the named seams a plan may arm (a typo'd rate kwarg fails at __init__)
-SEAMS = ("pool", "h2d", "dispatch", "slow_step", "reconcile")
+SEAMS = ("pool", "h2d", "dispatch", "slow_step", "reconcile",
+         "replica_crash", "replica_stall")
 
 #: the armed plan; None = disarmed (the zero-cost fast path)
 _PLAN: "FaultPlan | None" = None
@@ -66,11 +81,14 @@ def active_plan() -> "FaultPlan | None":
     return _PLAN
 
 
-def fault_point(seam: str, cache=None) -> None:
+def fault_point(seam: str, cache=None):
     """The seam hook the serving engine calls. Disarmed cost is this one
-    module-global check."""
+    module-global check (and the disarmed return is always ``None``).
+    Raising seams raise :class:`InjectedFault`; the ``replica_stall``
+    seam RETURNS its stall-tick count when it fires."""
     if _PLAN is not None:
-        _PLAN.hit(seam, cache=cache)
+        return _PLAN.hit(seam, cache=cache)
+    return None
 
 
 class FaultPlan:
@@ -89,9 +107,12 @@ class FaultPlan:
                  h2d: float = 0.0, reconcile: float = 0.0,
                  slow_step: float = 0.0, slow_step_s: float = 0.001,
                  pool_squeeze: float = 0.0, squeeze_pages: int = 2,
-                 squeeze_steps: int = 2):
+                 squeeze_steps: int = 2, replica_crash: float = 0.0,
+                 replica_stall: float = 0.0, stall_ticks: int = 2):
         rates = {"dispatch": dispatch, "h2d": h2d, "reconcile": reconcile,
-                 "slow_step": slow_step, "pool": pool_squeeze}
+                 "slow_step": slow_step, "pool": pool_squeeze,
+                 "replica_crash": replica_crash,
+                 "replica_stall": replica_stall}
         for name, p in rates.items():
             if not 0.0 <= float(p) <= 1.0:
                 raise ValueError(f"{name} rate must be in [0, 1], got {p}")
@@ -100,6 +121,9 @@ class FaultPlan:
         self.slow_step_s = float(slow_step_s)
         self.squeeze_pages = int(squeeze_pages)
         self.squeeze_steps = int(squeeze_steps)
+        self.stall_ticks = int(stall_ticks)
+        if self.stall_ticks < 1:
+            raise ValueError(f"stall_ticks must be >= 1, got {stall_ticks}")
         self.fired: dict[str, int] = {s: 0 for s in SEAMS}
         # one active squeeze at a time: (cache, rounds_left)
         self._squeeze: tuple[object, int] | None = None
@@ -149,6 +173,14 @@ class FaultPlan:
                 self.fired["slow_step"] += 1
                 time.sleep(self.slow_step_s)
             return
+        if seam == "replica_stall":
+            # the one RETURNING seam: the caller (the fleet router)
+            # applies the stall — this plan only schedules it
+            if self.rates["replica_stall"] \
+                    and self.rng.rand() < self.rates["replica_stall"]:
+                self.fired["replica_stall"] += 1
+                return self.stall_ticks
+            return None
         if seam not in self.rates:
             raise ValueError(f"unknown fault seam {seam!r} "
                              f"(known: {', '.join(SEAMS)})")

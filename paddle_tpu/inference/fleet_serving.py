@@ -1,0 +1,666 @@
+"""Fault-tolerant multi-replica serving fleet (round 18).
+
+"Millions of users means MANY predictors" (ROADMAP item 1): everything
+below one :class:`~paddle_tpu.inference.serving.ServingPredictor` is
+production-grade — this module is the fleet layer above it. A
+:class:`FleetRouter` fronts N predictor replicas (each possibly mesh-TP)
+and makes the headline property true: **replica failure is a routing
+event, not an outage**.
+
+Routing (admission-time placement, no per-token hop):
+
+- **Prefix affinity** — the prompt hashes through the SAME sha1 chain
+  keys the prefix cache computes (``kv_cache.chain_key``; one key per
+  full page, page i folding page i-1). The router keeps a chain-key ->
+  replica map; a submission walks its keys DEEPEST-first and lands on
+  the replica that already served the longest shared prefix — so
+  repeated-system-prompt traffic hits warm pages instead of re-prefilling
+  on a random replica. The map is only sound because independently
+  constructed :class:`~paddle_tpu.inference.kv_cache.KVCacheManager`
+  instances derive identical keys from identical tokens (locked by
+  tests/test_prefix_cache.py).
+- **Power-of-two-choices fallback** — no affinity hit: two seeded-random
+  admittable candidates are drawn and the one with the LOWER load score
+  wins (the classic d=2 balancer: near-best-of-N balance at O(1) probes).
+  The score reads :meth:`ServingPredictor.healthz` — queue + lanes
+  occupied, KV pool occupancy, in-flight ring depth, TTFT-p99 EMA — the
+  round-17 load-signal surface built for exactly this consumer.
+- **Health gating** — a replica admits only while HEALTHY and its
+  :meth:`~ServingPredictor.admission_verdict` is ``None``. The per-tick
+  health refresh marks a replica UNHEALTHY while it is stalled or its
+  ``healthz()["snapshot_age_s"]`` stamp is stale (a stuck replica stops
+  stamping; a merely quiet one, still driven, does not); recovery flips
+  it back. DRAINING (``drain()``/``resume()``, the operator surface)
+  finishes in-flight work but admits nothing. When no healthy replica
+  can admit, submissions queue at the router (``_unrouted``) unless
+  healthy replicas exist and ALL of them shed — then the submission
+  sheds terminally (fleet-level backpressure, same ``shed_*`` codes).
+
+Failover (the crash-consistent half):
+
+- A replica that raises out of its step — or stalls past
+  ``dead_stall_ticks`` — is declared DEAD. Its process state is treated
+  as UNREADABLE (a real crash leaves nothing to inspect): the router
+  migrates every non-terminal request assigned to it using only what it
+  already RECEIVED — the fleet-side ``output_ids`` merged from step
+  results. The re-admit feeds ``prompt + received_outputs`` as the new
+  context (already-emitted tokens are deduplicated by construction:
+  resume from ``len(output_ids)``), carries the remaining output budget,
+  and passes the ORIGINAL ``submit_time`` through
+  ``add_request(submit_time=)`` so the request's absolute deadline never
+  restarts. Greedy continuations are token-identical to an uninterrupted
+  run; tokens a dead replica had dispatched but never reported are
+  simply regenerated — never double-emitted, because a DEAD replica is
+  never stepped or flushed again. Failovers are bounded:
+  ``max_failovers`` migrations, then a terminal ``replica_lost`` FAILED
+  record. A DEAD slot respawns a fresh predictor after ``restart_ticks``
+  (its pages are gone, so its affinity-map entries are purged — routed
+  prefixes rebuild warmth organically).
+
+The chaos gate (tests/test_fleet_serving.py) extends round 17's
+discipline to the fleet: a >= 1k-tick multi-replica churn with the
+``replica_crash`` / ``replica_stall`` seams armed
+(``inference/faults.py``) where after EVERY tick the fleet-wide
+invariant holds — submitted == finished + failed + live, every request
+ends terminal exactly once, no token emitted twice, no request lost —
+and with faults disarmed a single-replica fleet is bit-identical to a
+bare ``ServingPredictor``. Prefill/decode disaggregation (streaming KV
+pages between dedicated prefill and decode replicas) stays explicitly
+out of scope for a follow-up PR.
+"""
+from __future__ import annotations
+
+from collections import deque
+
+import numpy as np
+
+from ..observability import FleetInstruments, monotonic, span
+from .faults import fault_point
+from .kv_cache import prompt_chain_keys
+from .serving import (FAILED, FINISHED, RUNNING, WAITING, ServingPredictor,
+                      deadline_passed, stream_done)
+
+#: replica lifecycle states (the fleet-side state machine; the
+#: per-request one stays serving.py's WAITING/RUNNING/FINISHED/FAILED)
+HEALTHY, UNHEALTHY, DRAINING, DEAD = ("healthy", "unhealthy", "draining",
+                                      "dead")
+
+__all__ = ["FleetRequest", "FleetRouter", "HEALTHY", "UNHEALTHY",
+           "DRAINING", "DEAD"]
+
+
+class FleetRequest:
+    """The router-side request handle: fleet identity, the merged output
+    stream (built ONLY from step/flush results the router actually
+    received — the crash-consistency ledger), and the failover count.
+    ``state`` follows serving.py's request states: WAITING while queued
+    at the router, RUNNING once placed on a replica, then terminal
+    FINISHED / FAILED (``error = {"code", "message"}``)."""
+
+    _next_id = [0]
+
+    def __init__(self, prompt_ids, max_new_tokens=32, eos_token_id=None,
+                 temperature=0.0, top_k=0, top_p=1.0, seed=None,
+                 deadline_s=None):
+        self.fleet_id = FleetRequest._next_id[0]
+        FleetRequest._next_id[0] += 1
+        self.prompt_ids = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
+        if not self.prompt_ids:
+            raise ValueError("empty prompt")
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_token_id = eos_token_id
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        self.seed = seed
+        self.deadline_s = None if deadline_s is None else float(deadline_s)
+        if self.deadline_s is not None and self.deadline_s < 0:
+            raise ValueError(f"deadline_s must be >= 0, got {deadline_s}")
+        # the absolute-deadline anchor: every re-admit passes this stamp
+        # through add_request(submit_time=) so the TTL never restarts
+        self.submit_time = monotonic()
+        self.output_ids: list[int] = []
+        self.state = WAITING
+        self.error: dict | None = None
+        self.truncated = False
+        self.replica_id: int | None = None   # current placement
+        self.failover_count = 0
+        self._inner = None                   # current inner Request
+
+    @property
+    def done(self) -> bool:
+        """Budget/eos satisfied by the RECEIVED stream — what failover
+        consults before spending a re-admit on a complete request. The
+        stop rule is serving.py's ``stream_done`` (one spelling: the
+        dedup here must agree with the predictor's emission-drop rule)."""
+        if self.truncated:
+            return True
+        return stream_done(self.output_ids, self.max_new_tokens,
+                           self.eos_token_id)
+
+    def past_deadline(self, now=None) -> bool:
+        return deadline_passed(self.submit_time, self.deadline_s, now)
+
+
+class _Replica:
+    """One replica slot: the live predictor (``None`` while DEAD — a
+    crashed process is unreadable), its fleet state, the inner-request
+    -> fleet-request map, and the stall/restart tick counters."""
+
+    __slots__ = ("rid", "sp", "state", "by_inner", "stall_ticks",
+                 "stalled_for", "restart_in")
+
+    def __init__(self, rid: int, sp: ServingPredictor):
+        self.rid = rid
+        self.sp = sp
+        self.state = HEALTHY
+        self.by_inner: dict[int, FleetRequest] = {}
+        self.stall_ticks = 0     # ticks of hang still to serve
+        self.stalled_for = 0     # consecutive ticks already hung
+        self.restart_in = 0      # DEAD cooldown until respawn
+
+
+class FleetRouter:
+    """N ``ServingPredictor`` replicas behind one admission surface.
+
+    ``submit()`` places a request (prefix-affinity, then
+    power-of-two-choices on the healthz load signals, health-gated);
+    ``tick()`` drives one fleet scheduler round — every live replica
+    steps once, emissions merge into the fleet-side streams, terminal
+    inner states sweep out, crashed/stalled replicas fail over;
+    ``flush()`` drains the live replicas' in-flight rings. Replica
+    construction kwargs forward to ``ServingPredictor`` via
+    ``replica_kw`` (every replica is built identically — the fleet's
+    page geometry must agree for the affinity keys to mean the same
+    pages everywhere).
+    """
+
+    def __init__(self, model, num_replicas=2, *, seed=0, max_failovers=2,
+                 stale_after_s=5.0, dead_stall_ticks=4, restart_ticks=1,
+                 max_affinity_entries=1 << 16, metrics=None,
+                 replica_kw=None):
+        self.num_replicas = int(num_replicas)
+        if self.num_replicas < 1:
+            raise ValueError(f"num_replicas must be >= 1, "
+                             f"got {num_replicas}")
+        self.max_failovers = int(max_failovers)
+        if self.max_failovers < 0:
+            raise ValueError(f"max_failovers must be >= 0, "
+                             f"got {max_failovers}")
+        self.stale_after_s = float(stale_after_s)
+        if self.stale_after_s <= 0:
+            # a non-positive threshold pins every replica UNHEALTHY
+            # forever (snapshot_age_s >= 0 always) — a config typo must
+            # fail loudly, not as a total routing outage
+            raise ValueError(f"stale_after_s must be > 0, "
+                             f"got {stale_after_s}")
+        self.dead_stall_ticks = int(dead_stall_ticks)
+        if self.dead_stall_ticks < 1:
+            raise ValueError(f"dead_stall_ticks must be >= 1, "
+                             f"got {dead_stall_ticks}")
+        self.restart_ticks = max(1, int(restart_ticks))
+        self._model = model
+        self._replica_kw = dict(replica_kw or {})
+        if "replica_id" in self._replica_kw:
+            raise ValueError("replica_id is assigned by the router")
+        # routing randomness (the two p2c probes) is seeded: a fleet run
+        # is replayable from (seed, submission order, fault plan)
+        self._rng = np.random.RandomState(seed)
+        self.inst = FleetInstruments(metrics)
+        if not self.inst.registry.enabled:
+            # the fleet counters BACK fleet_accounting()/telemetry()
+            # (the chaos gate's partition invariant and the bench line):
+            # a disabled registry would silently report zeros — fail
+            # loud, same contract as ServingPredictor's registry check
+            raise ValueError(
+                "FleetRouter requires an enabled metrics registry; "
+                "the one passed is disabled")
+        self.replicas = [_Replica(rid, self._spawn(rid))
+                         for rid in range(self.num_replicas)]
+        self.page_size = self.replicas[0].sp.cache.page_size
+        self.max_seq_len = self.replicas[0].sp.max_seq_len
+        #: chain key -> replica id (the prefix-affinity map): insertion-
+        #: ordered with re-registration refreshing recency, bounded by
+        #: ``max_affinity_entries`` (oldest evicted — a cold entry only
+        #: costs a p2c placement, never correctness), purged per replica
+        #: on its death
+        self._affinity: dict[bytes, int] = {}
+        self.max_affinity_entries = int(max_affinity_entries)
+        #: submissions with no admittable replica right now — retried at
+        #: the top of every tick, deadline-swept at the router
+        self._unrouted: deque[FleetRequest] = deque()
+        #: fleet_id -> non-terminal request; terminal requests leave the
+        #: router's working set (the caller keeps its handle, counters
+        #: keep the history) — a long-lived router must not grow per
+        #: request served
+        self._live: dict[int, FleetRequest] = {}
+        self.ticks = 0
+
+    # -- construction / lifecycle ------------------------------------------
+
+    def _spawn(self, rid: int) -> ServingPredictor:
+        return ServingPredictor(self._model, replica_id=rid,
+                                **self._replica_kw)
+
+    def _rep(self, rid: int) -> _Replica:
+        for rep in self.replicas:
+            if rep.rid == rid:
+                return rep
+        raise KeyError(f"no replica {rid}")
+
+    def drain(self, rid: int) -> None:
+        """Operator drain: the replica finishes its in-flight work but
+        admits nothing until :meth:`resume`. DEAD replicas stay dead."""
+        rep = self._rep(rid)
+        if rep.state != DEAD:
+            rep.state = DRAINING
+
+    def resume(self, rid: int) -> None:
+        rep = self._rep(rid)
+        if rep.state == DRAINING:
+            rep.state = HEALTHY
+
+    def kill_replica(self, rid: int, reason="operator_kill") -> None:
+        """Declare a replica lost NOW (the operator/chaos surface — the
+        ``replica_crash`` fault seam lands on the same path)."""
+        rep = self._rep(rid)
+        if rep.state != DEAD:
+            self._crash(rep, RuntimeError(
+                f"replica {rid} declared lost: {reason}"))
+
+    # -- routing ------------------------------------------------------------
+
+    def _admittable(self, rep: _Replica) -> bool:
+        return (rep.state == HEALTHY and rep.stall_ticks == 0
+                and rep.sp.admission_verdict() is None)
+
+    def _load_score(self, rep: _Replica) -> float:
+        """The p2c comparison key, off the healthz snapshot: occupied
+        lanes + backlog dominate, pool occupancy breaks near-ties, the
+        in-flight ring depth and the TTFT-p99 EMA push away from a
+        replica that is already running hot."""
+        hz = rep.sp.healthz()
+        return (hz["waiting"] + hz["running"] + hz["pool_occupancy"]
+                + 0.25 * hz["inflight_steps"]
+                + 0.001 * hz["ttft_p99_ema_ms"])
+
+    def _pick_replica(self, keys, exclude=()):
+        """(replica, affinity_hit) for one placement given the context's
+        chain keys; replica is None when nothing admittable exists.
+        Affinity first — DEEPEST registered chain key wins (longest
+        shared prefix) — then two seeded candidates scored by load."""
+        for k in reversed(keys):
+            rid = self._affinity.get(k)
+            if rid is not None and rid not in exclude:
+                rep = self._rep(rid)
+                if self._admittable(rep):
+                    return rep, True
+        cands = [r for r in self.replicas
+                 if r.rid not in exclude and self._admittable(r)]
+        if not cands:
+            return None, False
+        if len(cands) > 2:
+            i, j = self._rng.choice(len(cands), size=2, replace=False)
+            cands = [cands[int(i)], cands[int(j)]]
+        rep = min(cands, key=lambda r: (self._load_score(r), r.rid))
+        return rep, False
+
+    def _healthy_verdicts(self):
+        """The shed decision's evidence: the admission verdicts of every
+        HEALTHY, un-stalled replica (None entries mean 'would admit')."""
+        return [r.sp.admission_verdict() for r in self.replicas
+                if r.state == HEALTHY and r.stall_ticks == 0]
+
+    def _try_route(self, freq: FleetRequest) -> bool:
+        """Place one request (initial submit or failover re-admit).
+        Returns True when it landed on a replica; False leaves it either
+        queued at the router (no healthy capacity — transient) or
+        terminally shed (healthy replicas exist but every one of them
+        sheds — fleet backpressure, not an outage)."""
+        # the context (and so its chain keys) is fixed for the whole
+        # placement attempt: hash once, not per race-retry iteration
+        keys = prompt_chain_keys(freq.prompt_ids + freq.output_ids,
+                                 self.page_size)
+        exclude: set[int] = set()
+        while True:
+            rep, hit = self._pick_replica(keys, exclude)
+            if rep is None:
+                verdicts = self._healthy_verdicts()
+                # SLO shedding is backpressure on NEW ARRIVALS: a
+                # request the fleet already accepted (a failover victim,
+                # or anything with received tokens) queues through the
+                # transient instead — discarding accepted in-flight
+                # work because a crash landed during a backlog spike
+                # would turn one replica's failure into request loss
+                fresh = freq.failover_count == 0 and not freq.output_ids
+                if (fresh and verdicts
+                        and all(v is not None for v in verdicts)):
+                    self.inst.shed.inc()
+                    self._fail(freq, "shed_" + verdicts[0],
+                               f"every healthy replica sheds "
+                               f"({verdicts[0]})")
+                else:
+                    freq.state = WAITING
+                    self._unrouted.append(freq)
+                return False
+            if self._admit_on(freq, rep, keys, hit):
+                return True
+            # the verdict raced between the gate and the admission (the
+            # inner SLO shed it): try the other replicas before queueing
+            exclude.add(rep.rid)
+
+    def _admit_on(self, freq: FleetRequest, rep: _Replica, keys,
+                  hit: bool) -> bool:
+        remaining = freq.max_new_tokens - len(freq.output_ids)
+        inner = rep.sp.add_request(
+            freq.prompt_ids + freq.output_ids, remaining,
+            freq.eos_token_id, temperature=freq.temperature,
+            top_k=freq.top_k, top_p=freq.top_p, seed=freq.seed,
+            deadline_s=freq.deadline_s, submit_time=freq.submit_time)
+        if inner.state == FAILED:
+            return False
+        freq._inner = inner
+        freq.replica_id = rep.rid
+        freq.state = RUNNING
+        rep.by_inner[inner.req_id] = freq
+        self.inst.routed.inc()
+        if hit:
+            self.inst.affinity_hits.inc()
+        for k in keys:
+            if k in self._affinity:
+                del self._affinity[k]        # refresh recency
+            elif len(self._affinity) >= self.max_affinity_entries:
+                self._affinity.pop(next(iter(self._affinity)))
+            self._affinity[k] = rep.rid
+        return True
+
+    @property
+    def affinity_hit_rate(self) -> float:
+        return self.inst.affinity_hit_rate
+
+    # -- submission ---------------------------------------------------------
+
+    def submit(self, prompt_ids, max_new_tokens=32, eos_token_id=None,
+               temperature=0.0, top_k=0, top_p=1.0, seed=None,
+               deadline_s=None) -> FleetRequest:
+        """Admit one request into the fleet. Returns the fleet-side
+        handle; a terminal-FAILED return means the fleet shed it (every
+        healthy replica's SLO said no)."""
+        freq = FleetRequest(prompt_ids, max_new_tokens, eos_token_id,
+                            temperature=temperature, top_k=top_k,
+                            top_p=top_p, seed=seed, deadline_s=deadline_s)
+        # validate against the fleet-wide ceiling BEFORE any accounting:
+        # a caller error must raise HERE (same contract as add_request),
+        # never later out of tick() when a deferred route finally lands
+        # on a replica — and never leave a phantom live request behind
+        if len(freq.prompt_ids) > self.max_seq_len:
+            raise ValueError(
+                f"prompt of {len(freq.prompt_ids)} tokens exceeds "
+                f"max_seq_len {self.max_seq_len}")
+        self._live[freq.fleet_id] = freq
+        self.inst.submitted.inc()
+        if self._unrouted:
+            # requests are already queued at the router: a new arrival
+            # goes BEHIND them (FIFO — routing it now would let it claim
+            # capacity freed since the last tick ahead of older work)
+            freq.state = WAITING
+            self._unrouted.append(freq)
+        else:
+            self._try_route(freq)
+        return freq
+
+    # -- terminal paths -----------------------------------------------------
+
+    def _finish(self, freq: FleetRequest) -> None:
+        freq.state = FINISHED
+        freq.replica_id = None
+        freq._inner = None
+        self._live.pop(freq.fleet_id, None)
+        self.inst.finished.inc()
+
+    def _fail(self, freq: FleetRequest, code: str, message) -> None:
+        freq.state = FAILED
+        freq.error = {"code": code, "message": str(message)[:300]}
+        freq.replica_id = None
+        freq._inner = None
+        self._live.pop(freq.fleet_id, None)
+        self.inst.failed.inc()
+        self.inst.fail_reasons.labels(reason=code).inc()
+
+    # -- failure domain -----------------------------------------------------
+
+    def _crash(self, rep: _Replica, exc) -> None:
+        """Declare ``rep`` lost: its process state is unreadable from
+        here on (never stepped, never flushed — nothing it had in flight
+        can ever be double-reported), its affinity entries are purged
+        (the pages died with it), and every non-terminal request it held
+        migrates using only the fleet-side received streams."""
+        self.inst.crashes.inc()
+        rep.state = DEAD
+        rep.sp = None
+        rep.stall_ticks = 0
+        rep.stalled_for = 0
+        rep.restart_in = self.restart_ticks
+        self._affinity = {k: r for k, r in self._affinity.items()
+                          if r != rep.rid}
+        victims = sorted(rep.by_inner.values(), key=lambda f: f.fleet_id)
+        rep.by_inner = {}
+        for freq in victims:
+            if freq.state in (FINISHED, FAILED):
+                continue
+            self._failover(freq, exc)
+
+    def _failover(self, freq: FleetRequest, exc) -> None:
+        """Migrate one request off a lost replica: resume from the
+        received ``len(output_ids)``, original deadline carried, bounded
+        by ``max_failovers`` before a terminal ``replica_lost``."""
+        freq._inner = None
+        freq.replica_id = None
+        if freq.done:
+            # the received stream already satisfies the contract: the
+            # lost replica only owed us its retirement bookkeeping
+            self._finish(freq)
+            return
+        freq.failover_count += 1
+        if freq.failover_count > self.max_failovers:
+            self._fail(freq, "replica_lost",
+                       f"lost its replica {freq.failover_count} times "
+                       f"({len(freq.output_ids)} tokens received); "
+                       f"last: {exc!r}")
+            return
+        # counted only when a migration actually happens (a finished-in-
+        # place or bound-exhausted victim is not a migration)
+        self.inst.failovers.inc()
+        self._try_route(freq)
+
+    def _restart(self, rep: _Replica) -> None:
+        """A fresh predictor into a DEAD slot (the supervisor restarting
+        the pod): empty pools, same geometry, same replica id. The whole
+        wrapper is replaced — `_Replica.__init__` is the one place that
+        knows a fresh replica's state."""
+        self.replicas[self.replicas.index(rep)] = _Replica(
+            rep.rid, self._spawn(rep.rid))
+        self.inst.restarts.inc()
+
+    # -- the tick -----------------------------------------------------------
+
+    def _step_replica(self, rep: _Replica, produced: dict) -> None:
+        """One replica's scheduler round inside the fleet tick, with the
+        round-18 fault seams in front of it. A stalled replica makes no
+        progress (its snapshot goes stale; past ``dead_stall_ticks`` the
+        router escalates to a crash); a crashed one fails over."""
+        if rep.stall_ticks > 0:
+            rep.stall_ticks -= 1
+            rep.stalled_for += 1
+            if rep.stalled_for >= self.dead_stall_ticks:
+                self._crash(rep, RuntimeError(
+                    f"replica {rep.rid} stalled for {rep.stalled_for} "
+                    "consecutive ticks — declared lost"))
+            return
+        rep.stalled_for = 0
+        stall = fault_point("replica_stall")
+        if stall:
+            self.inst.stalls.inc()
+            rep.stall_ticks = int(stall) - 1   # this tick is the first
+            rep.stalled_for = 1
+            return
+        try:
+            fault_point("replica_crash")
+            out = rep.sp.step()
+        except Exception as exc:
+            # a replica crash is a ROUTING EVENT: the fleet recovery owns
+            # every exception here (the replica's own round-17 machinery
+            # already retried anything retryable before raising)
+            self._crash(rep, exc)
+            return
+        self._merge(rep, out, produced)
+        self._sweep(rep)
+
+    def tick(self) -> dict[int, list[int]]:
+        """One fleet scheduler round. Returns ``{fleet_id: [tokens]}``
+        received this round, in emission order."""
+        self.ticks += 1
+        self.inst.ticks.inc()
+        produced: dict[int, list[int]] = {}
+        with span("fleet_tick"):
+            self._sweep_unrouted()
+            for rep in self.replicas:
+                if rep.state == DEAD:
+                    rep.restart_in -= 1
+                    if rep.restart_in <= 0:
+                        self._restart(rep)
+                    continue
+                self._step_replica(rep, produced)
+            self._refresh_health()
+        self.inst.live_replicas.set(
+            sum(1 for r in self.replicas if r.state != DEAD))
+        self.inst.unrouted.set(len(self._unrouted))
+        return produced
+
+    def flush(self) -> dict[int, list[int]]:
+        """Drain every live replica's in-flight ring and sweep terminal
+        states. A stalled replica cannot be drained — its deferred
+        emissions land once the stall expires (keep ticking)."""
+        produced: dict[int, list[int]] = {}
+        for rep in self.replicas:
+            if rep.state == DEAD or rep.stall_ticks > 0:
+                continue
+            self._merge(rep, rep.sp.flush(), produced)
+            self._sweep(rep)
+        return produced
+
+    def has_work(self) -> bool:
+        return bool(self._live)
+
+    # -- merge / sweep ------------------------------------------------------
+
+    def _merge(self, rep: _Replica, out: dict, produced: dict) -> None:
+        """Land one replica's step/flush results into the fleet-side
+        streams — the ONLY writer of ``FleetRequest.output_ids``, so the
+        received ledger is exactly what failover resumes from."""
+        for inner_id, toks in out.items():
+            freq = rep.by_inner.get(inner_id)
+            if freq is None or freq.state in (FINISHED, FAILED):
+                continue
+            landed = 0
+            for tok in toks:
+                if freq.done:
+                    break   # guard: never exceed the fleet-side contract
+                freq.output_ids.append(int(tok))
+                produced.setdefault(freq.fleet_id, []).append(int(tok))
+                landed += 1
+            if landed:
+                self.inst.tokens.labels(replica=str(rep.rid)).inc(landed)
+
+    def _sweep(self, rep: _Replica) -> None:
+        """Propagate terminal inner states to the fleet requests. An
+        inner request FINISHED by count with values still in flight
+        (async deferral) stays mapped until its pending tokens land —
+        finishing the fleet request early would drop its tail."""
+        for inner_id in list(rep.by_inner):
+            freq = rep.by_inner[inner_id]
+            inner = freq._inner
+            if inner is None or inner.req_id != inner_id:
+                del rep.by_inner[inner_id]   # stale mapping (migrated)
+                continue
+            if inner.state == FINISHED and inner._pending_n == 0:
+                del rep.by_inner[inner_id]
+                freq.truncated = freq.truncated or inner.truncated
+                self._finish(freq)
+            elif inner.state == FAILED:
+                # an intra-replica terminal verdict (deadline, pool
+                # exhaustion, retry exhaustion, shed) is the REQUEST's
+                # failure, not the replica's — it propagates, it does
+                # not fail over (a deadline miss is global; the rest
+                # would recur on any identically-sized replica)
+                del rep.by_inner[inner_id]
+                self._fail(freq, inner.error["code"],
+                           inner.error["message"])
+
+    # -- router-side queue maintenance --------------------------------------
+
+    def _sweep_unrouted(self) -> None:
+        """Retry placement for requests queued at the router, failing
+        the ones past their deadline first (the router-level TTL — an
+        unrouted request never reaches a predictor's own sweep)."""
+        if not self._unrouted:
+            return
+        now = monotonic()
+        pending = list(self._unrouted)
+        self._unrouted.clear()
+        for freq in pending:
+            if freq.state in (FINISHED, FAILED):
+                continue
+            if freq.past_deadline(now):
+                self.inst.deadline_misses.inc()
+                self._fail(freq, "deadline_exceeded",
+                           f"unrouted past its {freq.deadline_s}s "
+                           "deadline (no admittable replica)")
+                continue
+            # re-queues itself via _try_route when still unplaceable
+            self._try_route(freq)
+
+    def _refresh_health(self) -> None:
+        """The health gate's per-tick refresh: HEALTHY <-> UNHEALTHY off
+        the stall state and the healthz staleness stamp. DRAINING and
+        DEAD are sticky (operator / supervisor transitions)."""
+        for rep in self.replicas:
+            if rep.state in (DEAD, DRAINING):
+                continue
+            stale = (rep.stall_ticks > 0
+                     or rep.sp.healthz()["snapshot_age_s"]
+                     > self.stale_after_s)
+            rep.state = UNHEALTHY if stale else HEALTHY
+
+    # -- observability ------------------------------------------------------
+
+    def telemetry(self) -> dict[str, float]:
+        """Flat snapshot of the fleet registry (the bench ``telemetry``
+        object). Per-replica serving registries stay per-replica —
+        :meth:`replica_healthz` is the per-replica surface."""
+        return self.inst.snapshot_flat()
+
+    def replica_healthz(self) -> list[dict]:
+        """Per-replica health: the fleet state machine's view joined
+        with each live replica's own ``healthz()`` snapshot."""
+        out = []
+        for rep in self.replicas:
+            row = {"replica_id": rep.rid, "fleet_state": rep.state,
+                   "stall_ticks": rep.stall_ticks,
+                   "assigned": len(rep.by_inner)}
+            if rep.sp is not None:
+                row["healthz"] = rep.sp.healthz()
+            out.append(row)
+        return out
+
+    def fleet_accounting(self) -> dict[str, int]:
+        """The partition the chaos gate asserts after every tick:
+        ``submitted == finished + failed + live`` (and the counters
+        agree with the request objects)."""
+        snap = self.telemetry()
+        return {
+            "submitted": int(snap["fleet_requests_submitted"]),
+            "finished": int(snap["fleet_requests_finished"]),
+            "failed": int(snap["fleet_requests_failed"]),
+            "live": len(self._live),
+        }

@@ -56,6 +56,38 @@ def pages_needed(length: int, page_size: int) -> int:
     return math.ceil(max(length, 1) / page_size)
 
 
+def chain_key(prev: bytes, tokens) -> bytes:
+    """The prefix cache's sha1 content chain key: page i's key folds page
+    i-1's, so one key names the whole prefix up to and including this
+    page's tokens (count included — a 4-token partial and an 8-token full
+    fill hash differently). Module-level because the key is a CONTRACT
+    shared beyond one manager: the fleet router's prefix-affinity map
+    (``inference/fleet_serving.py``) hashes prompts with the SAME chain so
+    shared-prefix traffic lands on the replica whose pool already holds
+    those pages — which is only sound because independently constructed
+    managers (different replicas, different processes) derive identical
+    keys from identical tokens (locked by tests/test_prefix_cache.py)."""
+    import hashlib
+
+    h = hashlib.sha1(prev)
+    h.update(np.asarray(tokens, np.int64).tobytes())
+    return h.digest()
+
+
+def prompt_chain_keys(tokens, page_size: int) -> list[bytes]:
+    """The chain keys of every FULL page of ``tokens``, shallowest first —
+    the fleet router's affinity walk (deepest registered key wins, so the
+    longest shared prefix decides the replica). Prompts shorter than one
+    page have no stable page-granular identity: empty list."""
+    keys: list[bytes] = []
+    h = b""
+    for i in range(0, len(tokens) - len(tokens) % int(page_size),
+                   int(page_size)):
+        h = chain_key(h, tokens[i:i + page_size])
+        keys.append(h)
+    return keys
+
+
 def kv_cache_quantized(kv_cache_dtype) -> bool:
     """Map a ``kv_cache_dtype`` config value to the pool-quantization flag
     — the ONE validation every consumer (generate_paged, ServingPredictor)
@@ -566,15 +598,10 @@ class KVCacheManager:
     # -- prefix cache ------------------------------------------------------
 
     def _chain_key(self, prev: bytes, tokens) -> bytes:
-        """Content chain key: page i's key folds page i-1's, so one key
-        names the whole prefix up to and including this page's tokens
-        (count included — a 4-token partial and an 8-token full fill hash
-        differently)."""
-        import hashlib
-
-        h = hashlib.sha1(prev)
-        h.update(np.asarray(tokens, np.int64).tobytes())
-        return h.digest()
+        """Content chain key — delegates to the module-level
+        :func:`chain_key` so the registry and the fleet router's affinity
+        map hash the SAME chain (see that function's contract)."""
+        return chain_key(prev, tokens)
 
     def _match_prefix(self, tokens):
         """Longest registered prefix of ``tokens`` at page granularity

@@ -129,6 +129,26 @@ WAITING, RUNNING, FINISHED, FAILED = ("waiting", "running", "finished",
                                       "failed")
 
 
+def stream_done(output_ids, max_new_tokens, eos_token_id) -> bool:
+    """The budget/eos stop rule over a MATERIALIZED output stream — the
+    ONE spelling shared by the async emission-drop rule
+    (:meth:`ServingPredictor._landed_done`) and the fleet router's
+    failover dedup (``FleetRequest.done``): the two deciding the same
+    question from different layers must never drift apart."""
+    if len(output_ids) >= max_new_tokens:
+        return True
+    return (eos_token_id is not None and bool(output_ids)
+            and output_ids[-1] == eos_token_id)
+
+
+def deadline_passed(submit_time, deadline_s, now=None) -> bool:
+    """Absolute-deadline check anchored at the ORIGINAL submission —
+    shared by :class:`Request` and the fleet router's request handle."""
+    if deadline_s is None:
+        return False
+    return (monotonic() if now is None else now) >= submit_time + deadline_s
+
+
 class Request:
     """One generation request; ``output_ids`` fills as steps land."""
 
@@ -136,7 +156,7 @@ class Request:
 
     def __init__(self, prompt_ids, max_new_tokens=32, eos_token_id=None,
                  temperature=0.0, top_k=0, top_p=1.0, seed=None,
-                 deadline_s=None):
+                 deadline_s=None, submit_time=None):
         self.req_id = Request._next_id[0]
         Request._next_id[0] += 1
         self.prompt_ids = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
@@ -171,8 +191,16 @@ class Request:
         self.state = WAITING
         self.preempt_count = 0
         self.truncated = False  # stopped by the max_seq_len ceiling
-        # serving metrics: time-to-first-token + prefix-cache hit size
-        self.submit_time = monotonic()
+        # serving metrics: time-to-first-token + prefix-cache hit size.
+        # round 18: ``submit_time`` may be supplied by a RE-ADMISSION path
+        # (the fleet router's failover re-admit): a request's wall-clock
+        # budget is anchored at its ORIGINAL submission — re-admitting
+        # must never restart the TTL (``past_deadline`` reads
+        # submit_time + deadline_s, so carrying the stamp carries the
+        # absolute deadline). In-predictor preemption replay requeues the
+        # SAME Request object, which preserves the stamp by construction.
+        self.submit_time = (monotonic() if submit_time is None
+                            else float(submit_time))
         self.first_token_time: float | None = None
         self.cached_prefix_len = 0   # tokens served from the prefix cache
         self._registered = False     # prompt pages in the prefix registry
@@ -205,10 +233,7 @@ class Request:
         return self.prompt_ids + self.output_ids
 
     def past_deadline(self, now=None) -> bool:
-        if self.deadline_s is None:
-            return False
-        return (monotonic() if now is None else now) \
-            >= self.submit_time + self.deadline_s
+        return deadline_passed(self.submit_time, self.deadline_s, now)
 
 
 class SLOConfig:
@@ -300,7 +325,8 @@ class ServingPredictor:
                  prefix_cache=None, kv_cache_dtype=None, mesh=None,
                  spec_decode_k=None, async_engine=None,
                  max_inflight_steps=4, metrics=None, mega_decode=None,
-                 slo=None, max_step_retries=3, retry_backoff_s=0.02):
+                 slo=None, max_step_retries=3, retry_backoff_s=0.02,
+                 replica_id=0):
         from ..distributed.mesh import as_serving_mesh
         from ..models.gpt import (_serving_params_cached, build_decode_step,
                                   build_prefill, build_unified_step,
@@ -499,6 +525,16 @@ class ServingPredictor:
             raise ValueError(f"slo must be an SLOConfig or None, "
                              f"got {type(slo).__name__}")
         self.slo = slo
+        # round 18: fleet identity + liveness stamp — ``replica_id``
+        # names this predictor in a fleet's healthz feeds, and
+        # ``_last_round_end`` (bumped every completed step()/flush()
+        # round) is the monotonic progress mark behind healthz's
+        # ``snapshot_age_s``: a STUCK replica's age grows while a merely
+        # QUIET one, still being driven, keeps stamping fresh snapshots
+        self.replica_id = int(replica_id)
+        if self.replica_id < 0:
+            raise ValueError(f"replica_id must be >= 0, got {replica_id}")
+        self._last_round_end = monotonic()
         self.max_step_retries = int(max_step_retries)
         if self.max_step_retries < 0:
             raise ValueError(f"max_step_retries must be >= 0, "
@@ -626,10 +662,11 @@ class ServingPredictor:
 
     def add_request(self, prompt_ids, max_new_tokens=32, eos_token_id=None,
                     temperature=0.0, top_k=0, top_p=1.0, seed=None,
-                    deadline_s=None) -> Request:
+                    deadline_s=None, submit_time=None) -> Request:
         req = Request(prompt_ids, max_new_tokens, eos_token_id,
                       temperature=temperature, top_k=top_k, top_p=top_p,
-                      seed=seed, deadline_s=deadline_s)
+                      seed=seed, deadline_s=deadline_s,
+                      submit_time=submit_time)
         if len(req.prompt_ids) > self.max_seq_len:
             raise ValueError(
                 f"prompt of {len(req.prompt_ids)} tokens exceeds "
@@ -700,6 +737,13 @@ class ServingPredictor:
         return {
             "status": "shedding" if verdict is not None else "ok",
             "shed_reason": verdict,
+            # round 18: fleet identity + staleness — seconds since the
+            # last COMPLETED scheduler round; a router distinguishes a
+            # stale/stuck replica (age grows without bound) from a quiet
+            # one (its driver keeps stepping it, age stays small)
+            "replica_id": self.replica_id,
+            "snapshot_age_s": round(
+                max(0.0, monotonic() - self._last_round_end), 6),
             "waiting": len(self.waiting),
             "running": len(self.running),
             "inflight_steps": len(self._inflight),
@@ -1067,10 +1111,8 @@ class ServingPredictor:
         N+1 must not discard the legitimate token step N produced —
         matching the sync engine, where that token landed a step before
         the truncation check ran)."""
-        if len(req.output_ids) >= req.max_new_tokens:
-            return True
-        return (req.eos_token_id is not None and req.output_ids
-                and req.output_ids[-1] == req.eos_token_id)
+        return stream_done(req.output_ids, req.max_new_tokens,
+                           req.eos_token_id)
 
     def _put_cached(self, name: str, arr: np.ndarray):
         """Content-keyed device-upload cache for slowly-changing per-step
@@ -1099,6 +1141,7 @@ class ServingPredictor:
             if self._did_sync:
                 self._m_hard_syncs.inc()
             self._m_step_s.inc(monotonic() - t0)
+            self._last_round_end = monotonic()
 
     def _reconcile_all(self) -> dict[int, list[int]]:
         produced: dict[int, list[int]] = {}
@@ -1927,6 +1970,7 @@ class ServingPredictor:
             self._m_step_calls.inc()
             self._m_running.set(len(self.running))
             self._m_waiting.set(len(self.waiting))
+            self._last_round_end = monotonic()
 
     # -- convenience -------------------------------------------------------
 

@@ -19,6 +19,7 @@ Cost contract: with observability disabled (no profiler window open,
 immediate return — the churn-smoke bench gates the end-to-end overhead
 (see ARCHITECTURE.md round 15).
 """
+from .fleet import FleetInstruments
 from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
                       default_registry, disable_metrics, enable_metrics,
                       merge_snapshots, metrics_enabled)
@@ -32,4 +33,5 @@ __all__ = [
     "merge_snapshots", "span", "request_begin", "request_event",
     "request_end", "counter_event", "tracing_active", "monotonic",
     "monotonic_ns", "device_annotation", "REQUEST_SPAN",
+    "FleetInstruments",
 ]

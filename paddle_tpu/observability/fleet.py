@@ -1,0 +1,90 @@
+"""Fleet-level telemetry instruments (round 18).
+
+The metrics surface of the multi-replica serving fleet
+(``inference/fleet_serving.py``): one :class:`FleetInstruments` bundle
+declares the router's counters/gauges on a :class:`MetricsRegistry` —
+submission/terminal accounting (the chaos gate's partition invariant
+reads these), routing quality (affinity hits over routed admissions),
+and the failure-domain counters (failovers, crashes, stalls, restarts,
+sheds, deadline misses). Per-replica token emission is ONE labeled
+counter family (``fleet_tokens_emitted{replica=...}``) so the bench's
+per-replica tokens/s falls out of the flat snapshot without the router
+keeping ad-hoc per-replica state.
+
+Same cost contract as the serving instruments: the registry defaults to
+enabled (these counters ARE the fleet bench metrics); a disabled
+registry costs one flag check per mutation.
+"""
+from __future__ import annotations
+
+from .metrics import MetricsRegistry
+
+__all__ = ["FleetInstruments"]
+
+
+class FleetInstruments:
+    """The fleet router's instrument bundle on one registry.
+
+    The names are the flat-snapshot schema ARCHITECTURE.md's round-18
+    section documents; ``bench_serve.py``'s ``fleet-churn`` leg rides
+    :meth:`snapshot_flat` as its schema-checked ``telemetry`` object.
+    """
+
+    def __init__(self, registry: MetricsRegistry | None = None):
+        self.registry = registry if registry is not None \
+            else MetricsRegistry()
+        m = self.registry
+        # -- request accounting: submitted == finished + failed + live --
+        self.submitted = m.counter(
+            "fleet_requests_submitted", "requests accepted by submit()")
+        self.finished = m.counter(
+            "fleet_requests_finished", "fleet requests reaching FINISHED")
+        self.failed = m.counter(
+            "fleet_requests_failed", "fleet requests reaching FAILED")
+        self.fail_reasons = m.counter(
+            "fleet_fail_reasons", "terminal fleet failures by error code",
+            labels=("reason",))
+        self.shed = m.counter(
+            "fleet_requests_shed",
+            "submissions shed because every healthy replica's SLO said no")
+        self.deadline_misses = m.counter(
+            "fleet_deadline_misses",
+            "unrouted requests failed past their deadline at the router")
+        # -- routing ----------------------------------------------------
+        self.routed = m.counter(
+            "fleet_requests_routed", "admissions placed on a replica "
+            "(initial + failover re-admits)")
+        self.affinity_hits = m.counter(
+            "fleet_affinity_hits",
+            "admissions routed by a prefix chain-key map hit")
+        # -- failure domain ---------------------------------------------
+        self.failovers = m.counter(
+            "fleet_failovers", "request migrations off a lost replica")
+        self.crashes = m.counter(
+            "fleet_replica_crashes", "replicas declared DEAD (crash or "
+            "stall escalation)")
+        self.stalls = m.counter(
+            "fleet_replica_stalls", "replica stall events observed")
+        self.restarts = m.counter(
+            "fleet_replica_restarts", "fresh predictors spawned into a "
+            "dead replica's slot")
+        # -- per-replica emission + fleet gauges ------------------------
+        self.tokens = m.counter(
+            "fleet_tokens_emitted", "tokens emitted, by serving replica",
+            labels=("replica",))
+        self.ticks = m.counter(
+            "fleet_ticks", "fleet scheduler rounds driven")
+        self.live_replicas = m.gauge(
+            "fleet_live_replicas", "replicas not DEAD after a tick")
+        self.unrouted = m.gauge(
+            "fleet_unrouted_requests", "requests queued at the router "
+            "waiting for an admittable replica")
+
+    @property
+    def affinity_hit_rate(self) -> float:
+        """Fraction of placements the prefix-affinity map decided."""
+        routed = self.routed.value
+        return self.affinity_hits.value / routed if routed else 0.0
+
+    def snapshot_flat(self) -> dict[str, float]:
+        return self.registry.snapshot_flat()

@@ -400,6 +400,20 @@ class TestASTRules:
         """), "paddle_tpu/inference/serving.py")
         assert "AL007" not in _rules(fs)
 
+    def test_fleet_serving_sits_inside_both_hot_path_fences(self):
+        """Round-18 satellite: the fleet layer
+        (paddle_tpu/inference/fleet_serving.py) is hot-path serving code
+        — the AL006 raw-timing fence AND the AL007 swallowed-exception
+        fence must both cover it (directory fences; this pins the path
+        so a future move out of inference/ fails loudly). The module
+        itself ships clean: the repo gate below holds the baseline
+        EMPTY over the real tree including it."""
+        where = "paddle_tpu/inference/fleet_serving.py"
+        fs = astlint.lint_source(textwrap.dedent(self._TIMING_SRC), where)
+        assert len([f for f in fs if f.rule == "AL006"]) == 3, fs
+        fs = astlint.lint_source(textwrap.dedent(self._SWALLOW_SRC), where)
+        assert len([f for f in fs if f.rule == "AL007"]) == 3, fs
+
 
 # ---------------------------------------------------------------------------
 # JX rules — seeded positive + negative per rule

@@ -114,6 +114,47 @@ class TestFaultPlan:
         assert mgr.restore_withheld() == 2
         assert mgr.free_page_count == 2
 
+    # -- round 18: the fleet seams ------------------------------------------
+
+    def test_replica_crash_seam_raises_and_counts(self):
+        with pytest.raises(ValueError, match="replica_crash rate"):
+            FaultPlan(replica_crash=2.0)
+        with FaultPlan(seed=0, replica_crash=1.0) as plan:
+            for _ in range(3):
+                with pytest.raises(InjectedFault) as e:
+                    fault_point("replica_crash")
+                assert e.value.seam == "replica_crash"
+        assert plan.fired["replica_crash"] == 3
+
+    def test_replica_stall_seam_returns_ticks_instead_of_raising(self):
+        """The one RETURNING seam: a fired hit hands the caller its
+        stall-tick count (the router applies it); unfired hits and the
+        disarmed path return None."""
+        with pytest.raises(ValueError, match="stall_ticks"):
+            FaultPlan(replica_stall=0.5, stall_ticks=0)
+        with FaultPlan(seed=0, replica_stall=1.0, stall_ticks=5) as plan:
+            assert fault_point("replica_stall") == 5
+            assert fault_point("replica_stall") == 5
+        assert plan.fired["replica_stall"] == 2
+        with FaultPlan(seed=0, replica_stall=0.0):
+            assert fault_point("replica_stall") is None
+        assert fault_point("replica_stall") is None      # disarmed
+
+    def test_replica_stall_draws_ride_the_one_seeded_stream(self):
+        """Stall draws come from the SAME RandomState as every other
+        seam, in hit order — a fleet chaos run replays from its seed."""
+        def pattern(seed):
+            out = []
+            with FaultPlan(seed=seed, replica_stall=0.4, stall_ticks=2):
+                for _ in range(30):
+                    out.append(fault_point("replica_stall") is not None)
+            return out
+
+        a = pattern(3)
+        assert a == pattern(3)
+        assert 0 < sum(a) < len(a)
+        assert pattern(4) != a
+
 
 # -- deadlines --------------------------------------------------------------
 
@@ -196,6 +237,43 @@ def test_no_deadline_requests_never_swept(rng):
     sp.flush()
     assert all(r.state == FINISHED for r in reqs)
     assert sp.telemetry()["serving_deadline_misses"] == 0
+
+
+def test_readmission_preserves_absolute_deadline(rng):
+    """Round-18 satellite regression: re-admission must not restart a
+    request's TTL. (a) In-predictor requeues (preemption / retry replay)
+    reuse the SAME Request object, so the ``submit_time`` anchor — and
+    with it the absolute deadline — survives; (b) a failover-style
+    re-admit builds a NEW Request on another predictor and must carry
+    the anchor explicitly through ``add_request(submit_time=)``: the
+    request is expired ON ARRIVAL relative to its original submission
+    even though it was only just admitted."""
+    from paddle_tpu.observability import monotonic
+
+    model = _tiny_model()
+    sp = ServingPredictor(model, max_batch=2, max_seq_len=48, page_size=8)
+    req = sp.add_request(rng.randint(0, TINY["vocab_size"], (4,)).tolist(),
+                         max_new_tokens=8, deadline_s=30.0)
+    sp.step()
+    anchor = req.submit_time
+    sp._preempt_youngest()                       # requeue: same object
+    assert req.submit_time == anchor             # TTL not restarted
+    while sp.has_work():
+        sp.step()
+    sp.flush()
+    assert req.state == FINISHED
+
+    stale = sp.add_request(
+        rng.randint(0, TINY["vocab_size"], (4,)).tolist(),
+        max_new_tokens=8, deadline_s=0.05,
+        submit_time=monotonic() - 0.1)
+    assert stale.past_deadline()
+    while sp.has_work():
+        sp.step()
+    sp.flush()
+    assert stale.state == FAILED
+    assert stale.error["code"] == "deadline_exceeded"
+    assert stale.output_ids == []
 
 
 # -- SLO-aware load shedding ------------------------------------------------

@@ -1,0 +1,530 @@
+"""Round-18 fault-tolerant multi-replica serving fleet
+(`inference/fleet_serving.py`): prefix-affinity + power-of-two-choices
+routing, health-gated admission (UNHEALTHY / DRAINING / DEAD), crash-
+consistent failover with received-token dedup and absolute-deadline
+carry-over — and THE fleet chaos gate: a >= 1k-tick multi-replica churn
+under seeded `replica_crash` / `replica_stall` faults where the fleet
+accounting partitions exactly after every tick, every request ends
+terminal exactly once, no token is emitted twice, no request is lost,
+and the faults-disarmed single-replica fleet is bit-identical to a bare
+ServingPredictor.
+
+CPU suite — same jnp-reference serving path as tests/test_serving.py.
+"""
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.inference import (FaultPlan, FleetRequest, FleetRouter,
+                                  ServingPredictor, SLOConfig)
+from paddle_tpu.inference.fleet_serving import (DEAD, DRAINING, HEALTHY,
+                                                UNHEALTHY)
+from paddle_tpu.inference.serving import FAILED, FINISHED, WAITING
+
+from test_serving import TINY, _churn_prompts, _tiny_model
+
+TERMINAL = (FINISHED, FAILED)
+KW = dict(max_batch=2, page_size=8, max_seq_len=64)
+
+
+def _router(model, n=2, **over):
+    rkw = {**KW, **over.pop("replica_kw", {})}
+    return FleetRouter(model, num_replicas=n, replica_kw=rkw, **over)
+
+
+def _drain(router, cap=5000):
+    ticks = 0
+    while router.has_work():
+        router.tick()
+        ticks += 1
+        assert ticks < cap, "fleet stuck"
+    router.flush()
+    return ticks
+
+
+# -- construction / validation ----------------------------------------------
+
+
+def test_validation():
+    model = _tiny_model()
+    with pytest.raises(ValueError, match="num_replicas"):
+        _router(model, n=0)
+    with pytest.raises(ValueError, match="max_failovers"):
+        _router(model, max_failovers=-1)
+    with pytest.raises(ValueError, match="dead_stall_ticks"):
+        _router(model, dead_stall_ticks=0)
+    with pytest.raises(ValueError, match="stale_after_s"):
+        _router(model, stale_after_s=0.0)   # would pin ALL replicas stale
+    with pytest.raises(ValueError, match="assigned by the router"):
+        _router(model, replica_kw={"replica_id": 7})
+    from paddle_tpu.observability import MetricsRegistry
+    with pytest.raises(ValueError, match="enabled metrics registry"):
+        _router(model, metrics=MetricsRegistry(enabled=False))
+    with pytest.raises(ValueError, match="empty prompt"):
+        FleetRequest([])
+    with pytest.raises(ValueError, match="deadline_s"):
+        FleetRequest([1], deadline_s=-1.0)
+    # an oversized prompt is a CALLER error: it raises at submit() —
+    # before any accounting — never later out of tick() when a deferred
+    # route lands, and it leaves no phantom live request behind
+    router = _router(model)
+    with pytest.raises(ValueError, match="max_seq_len"):
+        router.submit([1] * 100, max_new_tokens=2)
+    assert not router.has_work()
+    assert router.fleet_accounting()["submitted"] == 0
+
+
+def test_replicas_carry_their_fleet_identity():
+    model = _tiny_model()
+    router = _router(model, n=2)
+    ids = [rep.sp.healthz()["replica_id"] for rep in router.replicas]
+    assert ids == [0, 1]
+
+
+# -- routing ----------------------------------------------------------------
+
+
+def test_prefix_affinity_routes_repeat_prompts_to_one_replica(rng):
+    """Two submissions of the same (page-aligned) prompt land on the
+    SAME replica — the second via the chain-key affinity map."""
+    model = _tiny_model()
+    router = _router(model, n=2)
+    prompt = rng.randint(0, TINY["vocab_size"], (16,)).tolist()  # 2 pages
+    a = router.submit(prompt, max_new_tokens=2)
+    b = router.submit(prompt, max_new_tokens=2)
+    assert a.replica_id == b.replica_id
+    assert router.telemetry()["fleet_affinity_hits"] == 1
+    assert router.affinity_hit_rate == pytest.approx(0.5)
+    _drain(router)
+    assert a.state == FINISHED and b.state == FINISHED
+
+
+def test_sub_page_prompts_have_no_affinity_identity(rng):
+    """Prompts shorter than one page carry no chain key: placement is
+    pure load balancing, never an affinity hit."""
+    model = _tiny_model()
+    router = _router(model, n=2)
+    p = rng.randint(0, TINY["vocab_size"], (4,)).tolist()
+    router.submit(p, max_new_tokens=2)
+    router.submit(p, max_new_tokens=2)
+    assert router.telemetry()["fleet_affinity_hits"] == 0
+
+
+def test_power_of_two_choices_balances_fresh_prompts(rng):
+    """With no affinity, a two-replica fleet compares BOTH replicas'
+    load scores: distinct prompts alternate onto the emptier replica."""
+    model = _tiny_model()
+    router = _router(model, n=2)
+    a = router.submit(rng.randint(0, TINY["vocab_size"], (5,)).tolist(),
+                      max_new_tokens=2)
+    b = router.submit(rng.randint(0, TINY["vocab_size"], (5,)).tolist(),
+                      max_new_tokens=2)
+    assert {a.replica_id, b.replica_id} == {0, 1}
+    _drain(router)
+
+
+def test_draining_replica_finishes_work_but_admits_nothing(rng):
+    model = _tiny_model()
+    router = _router(model, n=2)
+    held = router.submit(rng.randint(0, TINY["vocab_size"], (5,)).tolist(),
+                         max_new_tokens=3)
+    rid = held.replica_id
+    router.drain(rid)
+    assert router._rep(rid).state == DRAINING
+    # new traffic avoids the draining replica...
+    for _ in range(3):
+        r = router.submit(
+            rng.randint(0, TINY["vocab_size"], (5,)).tolist(),
+            max_new_tokens=2)
+        assert r.replica_id == 1 - rid
+    # ...while its in-flight work still finishes
+    _drain(router)
+    assert held.state == FINISHED and len(held.output_ids) == 3
+    router.resume(rid)
+    assert router._rep(rid).state == HEALTHY
+
+
+def test_stale_snapshot_marks_unhealthy_and_recovers(rng):
+    """The health gate reads healthz()['snapshot_age_s']: a replica that
+    stopped stamping rounds goes UNHEALTHY (admits nothing) and flips
+    back once it progresses again."""
+    model = _tiny_model()
+    # the default stale_after_s (5s) absorbs a neighbor replica's first-
+    # step compile pause; the backdate below is well past it
+    router = _router(model, n=2)
+    rep = router._rep(0)
+    rep.sp._last_round_end -= 30.0          # a stuck replica's stamp
+    router._refresh_health()
+    assert rep.state == UNHEALTHY
+    r = router.submit(rng.randint(0, TINY["vocab_size"], (5,)).tolist(),
+                      max_new_tokens=2)
+    assert r.replica_id == 1                # gated off the stale replica
+    router.tick()                           # the tick steps it: fresh stamp
+    assert rep.state == HEALTHY
+    _drain(router)
+
+
+def test_all_replicas_shedding_sheds_at_the_fleet(rng):
+    """Healthy replicas whose SLOs ALL say no: the submission sheds
+    terminally at the router (fleet backpressure, same shed_* codes)."""
+    model = _tiny_model()
+    router = _router(model, n=2,
+                     replica_kw=dict(slo=SLOConfig(max_waiting=1)))
+    p = rng.randint(0, TINY["vocab_size"], (5,)).tolist()
+    reqs = []
+    shed = None
+    for _ in range(32):                     # flood both bounded queues
+        r = router.submit(p, max_new_tokens=2)
+        reqs.append(r)
+        if r.state == FAILED:
+            shed = r
+            break
+    assert shed is not None
+    assert shed.error["code"] == "shed_queue_full"
+    flat = router.telemetry()
+    assert flat["fleet_requests_shed"] >= 1
+    assert flat["fleet_fail_reasons{reason=shed_queue_full}"] >= 1
+    _drain(router)
+    assert all(r.state in TERMINAL for r in reqs)
+
+
+def test_no_healthy_replica_queues_at_router_until_restart(rng):
+    """With every replica DEAD the submission queues UNROUTED (not
+    shed); the supervisor restart brings capacity back and the queued
+    request routes and finishes."""
+    model = _tiny_model()
+    router = _router(model, n=1)
+    router.kill_replica(0)
+    r = router.submit(rng.randint(0, TINY["vocab_size"], (5,)).tolist(),
+                      max_new_tokens=2)
+    assert r.state == WAITING and r.replica_id is None
+    _drain(router)
+    assert r.state == FINISHED and len(r.output_ids) == 2
+    flat = router.telemetry()
+    assert flat["fleet_replica_restarts"] == 1
+
+
+# -- failover ---------------------------------------------------------------
+
+
+def test_kill_migrates_and_greedy_streams_stay_identical(rng):
+    """The headline: killing a replica mid-decode is a routing event —
+    every request finishes, and greedy outputs are token-identical to an
+    uninterrupted bare-predictor run (resume from the received tokens
+    deduplicates; nothing is emitted twice, nothing is lost)."""
+    model = _tiny_model()
+    prompts = [rng.randint(0, TINY["vocab_size"],
+                           (int(rng.randint(2, 18)),)).tolist()
+               for _ in range(10)]
+    sp = ServingPredictor(model, **KW)
+    want = sp.generate(prompts, max_new_tokens=5)
+
+    router = _router(model, n=2)
+    reqs = [router.submit(p, max_new_tokens=5) for p in prompts]
+    for _ in range(3):
+        router.tick()
+    router.kill_replica(0, reason="test")
+    assert router._rep(0).state == DEAD
+    assert router._rep(0).sp is None         # nothing of it is readable
+    _drain(router)
+    assert all(r.state == FINISHED for r in reqs)
+    assert [list(r.output_ids) for r in reqs] == want
+    flat = router.telemetry()
+    assert flat["fleet_replica_crashes"] == 1
+    assert flat["fleet_failovers"] >= 1
+    acc = router.fleet_accounting()
+    assert acc["submitted"] == acc["finished"] == len(prompts)
+    assert acc["failed"] == acc["live"] == 0
+
+
+def test_failover_bound_fails_replica_lost(rng):
+    """Past max_failovers migrations the request FAILS with a loud
+    terminal replica_lost record instead of bouncing forever."""
+    model = _tiny_model()
+    router = _router(model, n=2, max_failovers=0, restart_ticks=3)
+    reqs = [router.submit(
+        rng.randint(0, TINY["vocab_size"], (5,)).tolist(),
+        max_new_tokens=32) for _ in range(4)]
+    router.tick()
+    router.kill_replica(0)
+    router.kill_replica(1)
+    lost = [r for r in reqs if r.state == FAILED]
+    assert lost                              # the routed ones died
+    for r in lost:
+        assert r.error["code"] == "replica_lost"
+        assert r.failover_count == 1
+    flat = router.telemetry()
+    assert flat["fleet_fail_reasons{reason=replica_lost}"] == len(lost)
+    _drain(router)                           # restarts serve the rest
+    assert all(r.state in TERMINAL for r in reqs)
+
+
+def test_failover_preserves_absolute_deadline(rng):
+    """Round-18 satellite regression (the serving.py submit_time carry):
+    a migrated request's wall-clock budget is anchored at its ORIGINAL
+    submission — the failover re-admit must not restart the TTL, so a
+    request already past its absolute deadline fails deadline_exceeded
+    on the new replica instead of quietly generating on."""
+    model = _tiny_model()
+    router = _router(model, n=2)
+    victim = router.submit(
+        rng.randint(0, TINY["vocab_size"], (5,)).tolist(),
+        max_new_tokens=500, deadline_s=0.08)
+    router.tick()
+    assert victim.state not in TERMINAL
+    time.sleep(0.1)                          # absolute deadline passes
+    router.kill_replica(victim.replica_id)   # migrate AFTER expiry
+    _drain(router)
+    assert victim.state == FAILED
+    assert victim.error["code"] == "deadline_exceeded"
+
+
+def test_failover_victims_queue_instead_of_shedding(rng):
+    """SLO shedding is backpressure on NEW arrivals only: a request the
+    fleet already accepted (a failover victim) must queue through a
+    backlog spike on the survivors, never be terminally shed — a crash
+    during a busy moment must not turn into request loss."""
+    model = _tiny_model()
+    router = _router(model, n=2,
+                     replica_kw=dict(slo=SLOConfig(max_waiting=1)))
+    victim = router.submit(
+        rng.randint(0, TINY["vocab_size"], (5,)).tolist(),
+        max_new_tokens=6)
+    for _ in range(40):                      # until mid-generation
+        router.tick()
+        if victim.output_ids:
+            break
+    assert victim.output_ids and victim.state not in TERMINAL
+    # fill every replica's bounded queue so each survivor's verdict
+    # says queue_full at migration time
+    fillers = [router.submit(
+        rng.randint(0, TINY["vocab_size"], (5,)).tolist(),
+        max_new_tokens=2) for _ in range(4)]
+    router.kill_replica(victim.replica_id)
+    assert victim.state != FAILED            # queued, NOT shed
+    _drain(router)
+    assert victim.state == FINISHED and len(victim.output_ids) == 6
+    for f in fillers:
+        if f.state == FAILED:                # fresh arrivals may shed
+            assert f.error["code"].startswith("shed_")
+
+
+def test_new_submissions_queue_behind_unrouted_fifo(rng):
+    """A new arrival must not claim capacity ahead of requests already
+    queued at the router: with an unrouted backlog, submit() appends
+    behind it (FIFO) instead of routing immediately."""
+    model = _tiny_model()
+    router = _router(model, n=1, restart_ticks=3)
+    router.kill_replica(0)
+    a = router.submit(rng.randint(0, TINY["vocab_size"], (5,)).tolist(),
+                      max_new_tokens=2)
+    b = router.submit(rng.randint(0, TINY["vocab_size"], (5,)).tolist(),
+                      max_new_tokens=2)
+    assert list(router._unrouted) == [a, b]  # arrival order preserved
+    assert a.state == WAITING and b.state == WAITING
+    _drain(router)
+    assert a.state == FINISHED and b.state == FINISHED
+
+
+def test_unrouted_request_past_deadline_fails_at_router(rng):
+    model = _tiny_model()
+    router = _router(model, n=1, restart_ticks=50)
+    router.kill_replica(0)
+    r = router.submit(rng.randint(0, TINY["vocab_size"], (5,)).tolist(),
+                      max_new_tokens=2, deadline_s=0.01)
+    assert r.state == WAITING
+    time.sleep(0.02)
+    router.tick()
+    assert r.state == FAILED
+    assert r.error["code"] == "deadline_exceeded"
+    assert router.telemetry()["fleet_deadline_misses"] == 1
+
+
+def test_stall_recovers_and_escalates(rng):
+    """A short stall is a health event (the replica resumes, its work
+    finishes in place); a stall past dead_stall_ticks escalates to a
+    crash and the work migrates."""
+    model = _tiny_model()
+    # short stall: recovers in place
+    router = _router(model, n=2, dead_stall_ticks=10)
+    r = router.submit(rng.randint(0, TINY["vocab_size"], (5,)).tolist(),
+                      max_new_tokens=3)
+    with FaultPlan(seed=0, replica_stall=1.0, stall_ticks=3) as plan:
+        router.tick()                        # every live replica stalls
+    assert plan.fired["replica_stall"] >= 1
+    assert router.telemetry()["fleet_replica_stalls"] >= 1
+    assert router._rep(r.replica_id).state == UNHEALTHY
+    _drain(router)
+    assert r.state == FINISHED and len(r.output_ids) == 3
+    assert router.telemetry()["fleet_replica_crashes"] == 0
+
+    # long stall: escalates to a crash, the request migrates and finishes
+    router2 = _router(model, n=2, dead_stall_ticks=2)
+    r2 = router2.submit(rng.randint(0, TINY["vocab_size"], (5,)).tolist(),
+                        max_new_tokens=3)
+    with FaultPlan(seed=0, replica_stall=1.0, stall_ticks=9):
+        router2.tick()
+    _drain(router2)
+    assert r2.state == FINISHED and len(r2.output_ids) == 3
+    assert router2.telemetry()["fleet_replica_crashes"] >= 1
+
+
+# -- the disarmed single-replica equivalence gate ---------------------------
+
+
+def test_single_replica_fleet_bit_identical_to_bare_predictor(rng):
+    """Faults disarmed, one replica: the fleet layer is a pass-through —
+    greedy AND seeded-sampled streams are bit-identical to a bare
+    ServingPredictor over the same churn."""
+    model = _tiny_model()
+    prompts = _churn_prompts(rng, 25)
+    for sampling in (dict(),
+                     dict(temperature=0.8, top_k=7, top_p=0.9, seed=13)):
+        sp = ServingPredictor(model, **KW)
+        want = sp.generate(prompts, max_new_tokens=5, **sampling)
+
+        router = _router(model, n=1)
+        reqs = [router.submit(p, max_new_tokens=5, **sampling)
+                for p in prompts]
+        _drain(router)
+        assert all(r.state == FINISHED for r in reqs)
+        assert [list(r.output_ids) for r in reqs] == want, sampling
+
+
+# -- THE fleet chaos gate ---------------------------------------------------
+
+
+def _run_fleet_churn(model, prompts, *, n=3, gen_len=5, check_every=1):
+    """Drive a continuous-arrival churn through a fleet, asserting the
+    fleet-wide accounting partition after EVERY tick. Returns
+    (router, reqs, ticks)."""
+    router = FleetRouter(
+        model, num_replicas=n, seed=3, max_failovers=4,
+        dead_stall_ticks=3, restart_ticks=2,
+        replica_kw=dict(max_batch=2, page_size=8, max_seq_len=64,
+                        retry_backoff_s=0.0))
+    queued = list(prompts)
+    reqs = []
+    ticks = 0
+    cap = n * router.replicas[0].sp.max_batch
+
+    def live():
+        return sum(1 for r in reqs if r.state not in TERMINAL)
+
+    while queued or router.has_work():
+        while queued and live() < cap:
+            reqs.append(router.submit(queued.pop(0),
+                                      max_new_tokens=gen_len))
+        router.tick()
+        ticks += 1
+        if ticks % check_every == 0:
+            acc = router.fleet_accounting()
+            assert acc["submitted"] == (acc["finished"] + acc["failed"]
+                                        + acc["live"])
+            assert acc["submitted"] == len(reqs)
+            assert acc["finished"] == sum(
+                1 for r in reqs if r.state == FINISHED)
+            assert acc["failed"] == sum(
+                1 for r in reqs if r.state == FAILED)
+        assert ticks < 30000, "fleet chaos churn stuck"
+    router.flush()
+    return router, reqs, ticks
+
+
+def test_chaos_1k_tick_fleet_churn_under_replica_faults(rng):
+    """THE round-18 acceptance gate: a >= 1k-tick three-replica
+    continuous-arrival churn with seeded replica crashes AND stalls
+    (short ones recover, long ones escalate) where
+
+    - ``tick()`` never raises (replica loss is a routing event),
+    - the fleet accounting partitions exactly after EVERY tick
+      (submitted == finished + failed + live),
+    - every request ends terminal exactly once, none is lost,
+    - no token is emitted twice: every FINISHED stream is bit-identical
+      to the fault-free run of the same submission (greedy resume from
+      the received prefix deduplicates), and
+    - the seams, failovers and restarts all actually fired.
+    """
+    model = _tiny_model()
+    prompts = _churn_prompts(rng, 950)
+
+    _, want_reqs, _ = _run_fleet_churn(model, prompts, check_every=50)
+    assert all(r.state == FINISHED for r in want_reqs)
+    want = [list(r.output_ids) for r in want_reqs]
+
+    plan = FaultPlan(seed=29, replica_crash=0.004, replica_stall=0.01,
+                     stall_ticks=2)
+    with plan:
+        router, reqs, ticks = _run_fleet_churn(model, prompts)
+    assert ticks >= 1000, ticks                  # a real 1k-tick churn
+    assert plan.fired["replica_crash"] > 0
+    assert plan.fired["replica_stall"] > 0
+
+    # every request terminal exactly once; the churn survived the faults
+    assert all(r.state in TERMINAL for r in reqs)
+    finished = [i for i, r in enumerate(reqs) if r.state == FINISHED]
+    assert len(finished) > len(reqs) * 0.9
+    # no token emitted twice / none lost: bit-identity with the mirror
+    for i in finished:
+        assert list(reqs[i].output_ids) == want[i], f"request {i} diverged"
+    # failed requests carry loud, attributable records
+    for r in reqs:
+        if r.state == FAILED:
+            assert r.error is not None and r.error["code"] == "replica_lost"
+    flat = router.telemetry()
+    assert flat["fleet_replica_crashes"] >= plan.fired["replica_crash"]
+    assert flat["fleet_replica_restarts"] >= 1
+    assert flat["fleet_failovers"] >= 1
+    assert flat["fleet_requests_finished"] == len(finished)
+    assert flat["fleet_requests_failed"] == len(reqs) - len(finished)
+    # the per-replica emission counters cover every received token
+    assert sum(v for k, v in flat.items()
+               if k.startswith("fleet_tokens_emitted")) == sum(
+        len(r.output_ids) for r in reqs if r.state == FINISHED) + sum(
+        len(r.output_ids) for r in reqs if r.state == FAILED)
+
+
+def test_chaos_churn_with_eos_early_stops(rng):
+    """The eos leg of the fleet gate: early-stopping requests under
+    replica churn still end terminal with mirror-identical finished
+    streams (the subtlest dedup case — a request whose eos landed just
+    before its replica died must NOT be re-run past the eos)."""
+    model = _tiny_model()
+    prompts = _churn_prompts(rng, 90)
+
+    _, probe, _ = _run_fleet_churn(model, prompts, check_every=50)
+    eos = int(np.bincount([t for r in probe
+                           for t in r.output_ids]).argmax())
+
+    def run():
+        router = FleetRouter(
+            model, num_replicas=2, seed=3, max_failovers=4,
+            dead_stall_ticks=3, restart_ticks=2,
+            replica_kw=dict(max_batch=2, page_size=8, max_seq_len=64,
+                            retry_backoff_s=0.0))
+        queued = list(prompts)
+        reqs = []
+        ticks = 0
+        while queued or router.has_work():
+            while queued and sum(1 for r in reqs
+                                 if r.state not in TERMINAL) < 4:
+                reqs.append(router.submit(queued.pop(0), max_new_tokens=5,
+                                          eos_token_id=eos))
+            router.tick()
+            ticks += 1
+            assert ticks < 30000
+        router.flush()
+        return reqs
+
+    want_reqs = run()
+    assert all(r.state == FINISHED for r in want_reqs)
+    want = [list(r.output_ids) for r in want_reqs]
+    assert any(len(w) < 5 for w in want)         # eos really stops early
+    with FaultPlan(seed=31, replica_crash=0.01, replica_stall=0.02,
+                   stall_ticks=2):
+        reqs = run()
+    assert all(r.state in TERMINAL for r in reqs)
+    for i, r in enumerate(reqs):
+        if r.state == FINISHED:
+            assert list(r.output_ids) == want[i], f"eos req {i}"

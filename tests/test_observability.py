@@ -7,6 +7,7 @@ per-request lifecycle lane (admit -> ... -> eos), and the serving
 telemetry snapshot passes the bench schema gate."""
 import json
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -392,6 +393,11 @@ class TestServingTelemetry:
     _HEALTHZ_SCHEMA = {
         "status": lambda v: v in ("ok", "shedding"),
         "shed_reason": lambda v: v is None or (isinstance(v, str) and v),
+        # round 18: fleet identity + the staleness stamp (seconds since
+        # the last COMPLETED scheduler round) — how a router tells a
+        # stale/stuck replica from a merely quiet one
+        "replica_id": lambda v: isinstance(v, int) and v >= 0,
+        "snapshot_age_s": lambda v: isinstance(v, float) and v >= 0,
         "waiting": lambda v: isinstance(v, int) and v >= 0,
         "running": lambda v: isinstance(v, int) and v >= 0,
         "inflight_steps": lambda v: isinstance(v, int) and v >= 0,
@@ -466,6 +472,35 @@ class TestServingTelemetry:
         assert hz["requests_shed"] == 1 and hz["deadline_misses"] == 1
         assert hz["requests_failed"] == 2 and hz["step_failures"] == 1
         assert hz["status"] == "ok"                        # backlog drained
+
+    def test_healthz_replica_identity_and_staleness_stamp(self, rng):
+        """Round-18 satellite: healthz() carries the fleet identity
+        (``replica_id``, a constructor knob) and a monotonic
+        ``snapshot_age_s`` that resets on every completed scheduler
+        round and grows while the replica makes no progress."""
+        from paddle_tpu.inference import ServingPredictor
+
+        model = _tiny_model()
+        sp = ServingPredictor(model, max_batch=1, page_size=8,
+                              max_seq_len=64, use_kernel=False,
+                              replica_id=3)
+        self._check_healthz(sp.healthz())
+        assert sp.healthz()["replica_id"] == 3
+        sp.add_request(rng.randint(0, TINY["vocab_size"], (5,)),
+                       max_new_tokens=2)
+        while sp.has_work():
+            sp.step()
+        sp.flush()
+        fresh = sp.healthz()["snapshot_age_s"]
+        time.sleep(0.05)                 # a stuck replica stops stamping
+        aged = sp.healthz()["snapshot_age_s"]
+        assert aged >= fresh + 0.04
+        sp.step()                        # one driven round: fresh again
+        assert sp.healthz()["snapshot_age_s"] < aged
+        with pytest.raises(ValueError, match="replica_id"):
+            ServingPredictor(model, max_batch=1, page_size=8,
+                             max_seq_len=64, use_kernel=False,
+                             replica_id=-1)
 
     def test_deadline_at_nominal_load_emits_zero_sheds(self, rng):
         """Round-17 satellite: deadlines + an armed SLO at NOMINAL load

@@ -51,6 +51,39 @@ def test_partial_prefix_hit_at_page_granularity():
     m.free(s2)
 
 
+def test_chain_keys_deterministic_across_independent_managers(rng):
+    """Round-18 satellite: the sha1 chain keys are a pure function of
+    (prior chain, tokens) — independently constructed managers derive
+    IDENTICAL chains from identical tokens. This is the fleet router's
+    correctness assumption: its prefix-affinity map hashes prompts with
+    the module-level ``chain_key`` and expects the replica-local
+    registries (different KVCacheManager instances, different pools,
+    potentially different processes) to have registered the same pages
+    under the same keys."""
+    from paddle_tpu.inference.kv_cache import chain_key, prompt_chain_keys
+
+    a, b = _mgr(), _mgr(num_pages=24, max_batch=2)   # different geometry
+    toks = rng.randint(0, 50000, (40,)).tolist()
+    h_a = h_b = b""
+    for i in range(0, 40, 8):
+        h_a = a._chain_key(h_a, toks[i:i + 8])
+        h_b = b._chain_key(h_b, toks[i:i + 8])
+        assert h_a == h_b
+        # ...and the managers' chain IS the module-level chain the
+        # router hashes with
+        assert h_a == prompt_chain_keys(toks[:i + 8], 8)[-1]
+    # the chain binds content AND position: any divergence (content,
+    # order, fill count, prior chain) changes every key downstream
+    assert chain_key(b"", toks[:8]) != chain_key(b"", toks[1:9])
+    assert chain_key(b"", toks[:7]) != chain_key(b"", toks[:8])
+    assert chain_key(b"x", toks[:8]) != chain_key(b"", toks[:8])
+    # numpy vs list token spellings hash identically (the router hashes
+    # host lists; register_prefix sees whatever the request carried)
+    assert chain_key(b"", np.asarray(toks[:8])) == chain_key(b"", toks[:8])
+    # sub-page prompts have no page-granular identity
+    assert prompt_chain_keys(toks[:7], 8) == []
+
+
 def test_zero_ref_registered_pages_survive_on_lru_until_pressure():
     m = _mgr(num_pages=6)
     toks = list(range(16))

@@ -2211,6 +2211,46 @@ def test_bench_serve_overload_leg_gates():
     assert rec["nominal_deadline_miss_rate"] == 0.0
 
 
+def test_bench_serve_fleet_leg_gates():
+    """The round-18 bench acceptance (via --legs, the tier-1 smoke
+    subset selector): the two-replica fleet churn keeps serving tokens
+    through injected replica churn (one deterministic kill + seeded
+    stalls) — ``value > 0`` with ``failover_count >= 1`` — the
+    prefix-affinity map actually decides placements on the
+    round-robin prompt pool (``affinity_hit_rate > 0``), and the
+    health-gated SLO sheds the flood (``shed_rate > 0``), all on the
+    schema-checked line with the fleet registry telemetry riding it."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, "bench_serve.py", "--smoke", "--steps=6",
+         "--batch=2", "--prompt=8", "--gen-len=3",
+         "--legs=fleet-churn"],
+        cwd=root, capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [l for l in proc.stdout.strip().splitlines() if l.strip()]
+    assert len(lines) == 1, proc.stdout
+    rec = json.loads(lines[0])
+    assert "error" not in rec, rec
+    assert rec["leg"] == "fleet-churn"
+    # replica failure was a routing event, not an outage
+    assert rec["value"] > 0
+    assert rec["failover_count"] >= 1
+    assert rec["tokens_per_s_per_replica"] == pytest.approx(
+        rec["value"] / 2, rel=0.01)
+    assert 0 < rec["affinity_hit_rate"] <= 1
+    assert rec["shed_rate"] > 0
+    # the fleet registry rides the line and agrees with it
+    tel = rec["telemetry"]
+    assert tel["fleet_replica_crashes"] >= 1
+    assert tel["fleet_replica_restarts"] >= 1
+    assert tel["fleet_failovers"] == rec["failover_count"]
+    assert tel["fleet_requests_finished"] > 0
+    assert (tel["fleet_requests_submitted"]
+            >= tel["fleet_requests_finished"]
+            + tel["fleet_requests_failed"])
+
+
 def test_bench_serve_legs_filtered_baseline_omits_ratio():
     """--legs selecting a leg WITHOUT its baseline leg must omit the
     (schema-optional) vs_baseline rather than emit the 0.0 dead-baseline
