@@ -165,7 +165,8 @@ class _ChurnLeg:
                  dtype=None, weight_dtype=None, kv_cache_dtype=None,
                  mesh_chips=1, spec_decode_k=0, spec_workload=False,
                  async_engine=False, observability=False,
-                 mega_decode=False, slo=None):
+                 mega_decode=False, slo=None, draft_source=None,
+                 draft_layers=None, spec_report=False):
         # async_engine stays EXPLICIT here (default False = the sync
         # baseline leg) even though round 14 flipped the predictor's own
         # default to async: the legacy/quant/spec/spmd legs are the
@@ -177,11 +178,16 @@ class _ChurnLeg:
         from paddle_tpu.inference import ServingPredictor
         from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
 
-        if spec_workload:
+        if spec_workload or spec_report:
             gen_len = max(gen_len, 12)
         self.batch, self.prompt, self.gen_len = batch, prompt, gen_len
         self.mesh_chips = mesh_chips
         self.spec_workload = spec_workload
+        # round 19: spec_report adds the speculation metrics to the line
+        # WITHOUT the repetitive-motif workload — the model-draft leg's
+        # whole point is acceptance on non-repetitive (random) prompts
+        self.spec_report = bool(spec_report or spec_workload)
+        self.draft_source = draft_source
         max_len = prompt + gen_len + 32
         paddle.seed(0)
         cfg = GPTConfig(vocab_size=vocab, hidden_size=hidden,
@@ -201,7 +207,8 @@ class _ChurnLeg:
             chunk=chunk,
             dtype=jnp.bfloat16 if (on_tpu and dtype is None) else dtype,
             mesh=mesh, spec_decode_k=spec_decode_k,
-            async_engine=async_engine, mega_decode=mega_decode, slo=slo)
+            async_engine=async_engine, mega_decode=mega_decode, slo=slo,
+            draft_source=draft_source, draft_layers=draft_layers)
         rng = np.random.RandomState(0)
         if spec_workload:
             # tiled 4-token motifs: every prompt internally repetitive
@@ -216,6 +223,7 @@ class _ChurnLeg:
         self.lat = []
         self.win_vals, self.win_gaps, self.win_host = [], [], []
         self.win_dev = []
+        self.win_draft = []
         self.first_wave = None
         self.timed_from = 0
         self.decode_before = 0
@@ -286,6 +294,7 @@ class _ChurnLeg:
         self.win_vals.append((sp.tokens_emitted - w_emitted) / dw)
         self.win_gaps.append(sp.step_gap_frac)
         self.win_host.append(sp.host_ms_per_step)
+        self.win_draft.append(sp.draft_overhead_frac)
         # wall ms per dispatched step with work IN FLIGHT — the
         # host-observable per-step device-time proxy the round-16
         # megakernel leg shrinks (the gap fraction subtracts the
@@ -350,13 +359,19 @@ class _ChurnLeg:
         # for in-progress tails
         out["_streams"] = {i: (r.state == "finished", list(r.output_ids))
                            for i, r in enumerate(self.reqs)}
-        if self.spec_workload:
+        if self.spec_report:
             # the round-12 speculation A/B metrics: the spec-off leg
             # anchors accepted_tokens_per_step at exactly 1.0
             out["accepted_tokens_per_step"] = round(
                 sp.accepted_tokens_per_step, 3)
             out["draft_acceptance_rate"] = round(
                 sp.draft_acceptance_rate, 3)
+        if self.draft_source == "model":
+            # round 19: what the truncated-layer draft pass costs against
+            # the accepted tokens it buys (fraction of step() wall time,
+            # median over the timed windows)
+            out["draft_overhead_frac"] = round(
+                float(np.median(self.win_draft)), 4)
         return out
 
 
@@ -626,6 +641,35 @@ def bench_serving_ab(*, steps, windows, **leg_kw):
     return sync_leg.report(), async_leg.report()
 
 
+def bench_serving_spec_model_ab(*, steps, windows, draft_layers,
+                                **leg_kw):
+    """The round-19 model-draft pair: the SAME seeded-random-prompt
+    (NON-repetitive) churn speculating k=4 with the n-gram proposer (the
+    round-12 source — its lookup collapses to plain decode on this
+    workload and the adaptive k prices it off) vs the truncated-layer
+    MODEL draft source, windows interleaved like the engine A/B. Both
+    legs run the production async engine, so the model line's
+    ``step_gap_frac`` is measured with spec_k > 0 dispatching
+    behind-by-one — the async x spec composition the round-19 tentpole
+    unlocks. Returns ``(ngram_out, model_out)``; the emitted model line
+    carries the paired n-gram stats and the cross-proposer greedy
+    emission identity gate (speculation must never change output, so two
+    DIFFERENT draft sources over one workload must emit identical
+    streams)."""
+    ngram_leg = _ChurnLeg(spec_decode_k=4, draft_source="ngram",
+                          async_engine=True, spec_report=True, **leg_kw)
+    model_leg = _ChurnLeg(spec_decode_k=4, draft_source="model",
+                          draft_layers=draft_layers, async_engine=True,
+                          spec_report=True, **leg_kw)
+    ngram_leg.warm()
+    model_leg.warm()
+    with _gc_frozen():
+        for _ in range(windows):
+            ngram_leg.window(steps)
+            model_leg.window(steps)
+    return ngram_leg.report(), model_leg.report()
+
+
 def bench_serving_obs_ab(*, steps, windows, **leg_kw):
     """The round-15 observability-overhead pair: the SAME churn with host
     tracing OFF (the disabled-path baseline — spans are one flag check)
@@ -784,6 +828,12 @@ def main():
         ("unified-spec-base", dict(unified=True, spec_workload=True)),
         ("unified-spec-k4", dict(unified=True, spec_workload=True,
                                  spec_decode_k=4)),
+        # round-19 A/B: the SAME seeded-random (NON-repetitive) churn
+        # speculating k=4 through the n-gram proposer vs the truncated-
+        # layer model draft source, both on the async engine (spec steps
+        # dispatch behind-by-one) — measured interleaved, cross-proposer
+        # greedy emissions bit-identical
+        ("unified-spec-model", None),
         ("unified-int8w", dict(unified=True, weight_dtype="int8")),
         ("unified-int8w-int8kv", dict(unified=True, weight_dtype="int8",
                                       kv_cache_dtype="int8")),
@@ -881,6 +931,27 @@ def main():
                 out["mega_emissions_match"] = _streams_match(
                     on_out["_streams"], off_out["_streams"])
                 results[name] = out
+            elif name == "unified-spec-model":
+                # the truncated self-draft keeps the first quarter of the
+                # stack (>= 1): 12 layers -> 3, the 2-layer smoke -> 1
+                ngram_out, model_out = bench_serving_spec_model_ab(
+                    unified=True, on_tpu=on_tpu, use_kernel=use_kernel,
+                    draft_layers=max(1, ab_shape["layers"] // 4),
+                    **ab_shape, **ab_kw)
+                out = dict(metric=ab_metric_for(name), **model_out)
+                # the paired n-gram stats ride the model line: its strict
+                # gates (accepted/step > 1 on NON-repetitive churn, low
+                # step_gap_frac with spec_k > 0, identical emissions)
+                # compare within the interleaved pair, one workload
+                out["ngram_tokens_per_s"] = ngram_out["value"]
+                out["ngram_accepted_tokens_per_step"] = (
+                    ngram_out["accepted_tokens_per_step"])
+                out["vs_baseline"] = (
+                    round(out["value"] / ngram_out["value"], 3)
+                    if ngram_out["value"] else 0.0)
+                out["spec_emissions_match"] = _streams_match(
+                    model_out["_streams"], ngram_out["_streams"])
+                results[name] = out
             elif name == "unified-overload":
                 over_out, nom_out = bench_serving_overload(
                     unified=True, on_tpu=on_tpu, use_kernel=use_kernel,
@@ -965,6 +1036,11 @@ def main():
     _emit("unified-spmd", "unified-step")
     _emit("unified-spec-base", None)
     _emit("unified-spec-k4", "unified-spec-base")
+    # round-19 model-draft leg (self-baselined on its interleaved n-gram
+    # partner: vs_baseline = model/ngram tokens/s on the SAME
+    # non-repetitive churn — the speedup a drafter that accepts on
+    # realistic traffic buys over one that collapses to plain decode)
+    _emit("unified-spec-model", None)
     _emit("unified-int8w", "unified-step")
     _emit("unified-int8w-int8kv", "unified-step")
     # round-17 resilience leg (self-baselined on its interleaved

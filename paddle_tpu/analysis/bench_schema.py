@@ -87,7 +87,17 @@ _OPTIONAL_NUMERIC = ("vs_baseline", "p50_ms", "p99_ms", "anchor_tflops",
                      # forced (failover as a routing event: the leg's
                      # tokens/s stays live through them)
                      "tokens_per_s_per_replica", "affinity_hit_rate",
-                     "failover_count")
+                     "failover_count",
+                     # round 19: the model-draft speculative leg — the
+                     # fraction of step() wall time the truncated-layer
+                     # draft pass costs, the interleaved n-gram partner's
+                     # stats riding the model line, and the
+                     # cross-proposer greedy emission identity gate
+                     # (speculation never changes output, so two draft
+                     # sources over one churn must emit identically)
+                     "draft_overhead_frac", "ngram_tokens_per_s",
+                     "ngram_accepted_tokens_per_step",
+                     "spec_emissions_match")
 _OPTIONAL_STRING = ("mesh_shape", "comm_quant")
 
 #: the bench_serve leg-name enum (round 16): every serving line carries
@@ -98,8 +108,8 @@ _OPTIONAL_STRING = ("mesh_shape", "comm_quant")
 KNOWN_LEGS = frozenset((
     "legacy-two-jit", "unified-step", "unified-async", "unified-obs",
     "unified-spmd", "unified-spec-base", "unified-spec-k4",
-    "unified-int8w", "unified-int8w-int8kv", "unified-mega",
-    "unified-overload", "fleet-churn",
+    "unified-spec-model", "unified-int8w", "unified-int8w-int8kv",
+    "unified-mega", "unified-overload", "fleet-churn",
 ))
 
 

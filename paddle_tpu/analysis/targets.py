@@ -41,6 +41,15 @@ builds of exactly the programs that carry the repo's numbers:
                   inline dequant and in-kernel KV quantize-on-write, fp
                   and int8-weight/int8-KV variants — JX001 audits the
                   scale math, JX005 the pool/scale-plane donation;
+- ``serving-spec-model``  the round-19 model-draft speculative serving
+                  pair: the truncated-layer SELF-DRAFT jit
+                  (``build_draft_step`` — the first ``draft_layers``
+                  stacks of the same serving params at the chunk-1 chain
+                  geometry, its pools donated like any serving step) and
+                  the spec-async unified step (``spec_k > 0`` with the
+                  feedback carry LIVE on a verify row — the behind-by-one
+                  dispatch shape) with the JX005 donation audit at the
+                  spec-shifted pool positions;
 - ``serving-async``  the round-13 feedback-coupled unified step as the
                   async double-buffered engine drives it: a LIVE
                   ``feedback`` mask routing a decode lane's input token
@@ -595,6 +604,109 @@ def analyze_serving_async() -> list[Finding]:
     return findings
 
 
+def analyze_serving_spec_model() -> list[Finding]:
+    """Round-19 model-draft speculative serving: (1) the truncated-layer
+    self-draft jit — the first ``draft_layers`` scan stacks of the SAME
+    serving params behind the shared embeddings/LM head, built at its
+    chunk-1 decode-chain geometry where the feedback carry threads the
+    autoregressive draft tokens device-side — and (2) the speculative
+    unified step AS THE ASYNC ENGINE DISPATCHES IT behind-by-one: a
+    verify lane whose base token rides the ``prev_toks`` carry (feedback
+    live on its first verify row). JX005 audits the pool donation of
+    both programs — the draft pool threads through every catch-up/chain
+    launch exactly like the main pools thread through in-flight steps."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from ..inference.kv_cache import KVCacheManager
+    from ..models.gpt import (GPTConfig, GPTForCausalLM, build_draft_step,
+                              build_unified_step, draft_serving_params,
+                              serving_params)
+
+    cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                    num_heads=2, max_seq_len=32, spec_draft_layers=1)
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg)
+    params = serving_params(model)
+    rng = np.random.RandomState(0)
+    findings: list[Finding] = []
+
+    # (1) the draft chain jit: truncated stack, chunk-1 geometry, one
+    # packed row per lane — row 0 feeds a live token, row 1 chains
+    # through the feedback carry (the autoregressive draft shape)
+    b = 2
+    d_params = draft_serving_params(params, 1)
+    dmgr = KVCacheManager(1, cfg.num_heads, cfg.head_dim,
+                          num_pages=2 * b * (cfg.max_seq_len // 8),
+                          max_batch=b, max_seq_len=cfg.max_seq_len,
+                          page_size=8, dtype=jnp.float32)
+    for _ in range(b):
+        dmgr.admit_prefix([int(x) for x in rng.randint(0, 128, (8,))])
+    dstep = build_draft_step(cfg, 1, 8, 1)
+    no_cow = jnp.full((b,), dmgr.num_pages, jnp.int32)
+    dargs = (d_params,
+             jnp.asarray(rng.randint(0, 128, (b,)), jnp.int32),
+             jnp.arange(b, dtype=jnp.int32),          # tok_slot
+             jnp.full((b,), 8, jnp.int32),            # tok_pos
+             jnp.ones((b,), jnp.int32),               # q_lens
+             jnp.full((b,), 8, jnp.int32),            # kv_lens
+             jnp.arange(b, dtype=jnp.int32),          # last_idx
+             jnp.asarray([0, 1], jnp.int32),          # feedback: row 1 chains
+             jnp.asarray(rng.randint(0, 128, (b,)), jnp.int32),
+             jnp.ones((b,), jnp.int32),               # emit_mask
+             jnp.zeros((b,), jnp.int32),              # produced
+             dmgr.k_pages, dmgr.v_pages, dmgr.page_table_device(),
+             no_cow, no_cow, jnp.zeros((b, 2), jnp.uint32),
+             jnp.zeros((b,), jnp.float32), jnp.zeros((b,), jnp.int32),
+             jnp.ones((b,), jnp.float32))
+    findings += analyze_jaxpr(trace_callable(dstep, *dargs),
+                              "serving-spec-model-draft-step")
+    findings += check_donation(dstep, dargs, (11, 12),
+                               "serving-spec-model-draft-step")
+
+    # (2) the spec step as the async engine dispatches it behind-by-one:
+    # slot 0 verifies 1 + 2 drafts with its BASE token still in flight
+    # (feedback live on the first verify row), slot 1 a draftless spec
+    # lane riding the carry too
+    page_size, chunk, spec_k = 8, 8, 3
+    budget = b * (1 + spec_k) + chunk
+    mgr = KVCacheManager(cfg.num_layers, cfg.num_heads, cfg.head_dim,
+                         num_pages=2 * b * (cfg.max_seq_len // page_size),
+                         max_batch=b, max_seq_len=cfg.max_seq_len,
+                         page_size=page_size, dtype=jnp.float32,
+                         enable_prefix_cache=True)
+    for _ in range(b):
+        mgr.admit_prefix([int(x) for x in rng.randint(0, 128, (8,))])
+    tok_ids = jnp.asarray(rng.randint(0, 128, (budget,)), jnp.int32)
+    tok_slot = jnp.asarray([0] * 3 + [1] + [-1] * (budget - 4), jnp.int32)
+    tok_pos = jnp.asarray(list(range(8, 11)) + [8] + [0] * (budget - 4),
+                          jnp.int32)
+    q_lens = jnp.asarray([3, 1], jnp.int32)
+    kv_lens = jnp.asarray([8, 8], jnp.int32)
+    last_idx = jnp.asarray([0, 3], jnp.int32)
+    spec_len = jnp.asarray([2, 0], jnp.int32)
+    feedback = jnp.asarray([1, 0, 0, 1] + [0] * (budget - 4), jnp.int32)
+    prev_toks = jnp.asarray(rng.randint(0, 128, (b,)), jnp.int32)
+    no_cow2 = jnp.full((b,), mgr.num_pages, jnp.int32)
+    step = build_unified_step(cfg, page_size, chunk, spec_k=spec_k)
+    args = (params, tok_ids, tok_slot, tok_pos, q_lens, kv_lens, last_idx,
+            spec_len, feedback, prev_toks, jnp.ones((b,), jnp.int32),
+            jnp.asarray([3, 5], jnp.int32),
+            mgr.k_pages, mgr.v_pages, mgr.page_table_device(), no_cow2,
+            no_cow2, jnp.asarray(rng.randint(0, 2**31, (b, 2)),
+                                 jnp.uint32),
+            jnp.asarray([0.0, 0.8], jnp.float32),
+            jnp.asarray([0, 40], jnp.int32),
+            jnp.asarray([1.0, 0.9], jnp.float32))
+    findings += analyze_jaxpr(trace_callable(step, *args),
+                              "serving-spec-model-async-step")
+    findings += check_donation(step, args, (12, 13),
+                               "serving-spec-model-async-step")
+    return findings
+
+
 def analyze_serving_mega() -> list[Finding]:
     """Round-16 megakernelized decode: the unified step built with
     ``mega=True`` at its decode geometry (chunk = 1 row per lane, budget
@@ -700,6 +812,7 @@ TARGETS = {
     "serving-quant": analyze_serving_quant,
     "serving-spmd": analyze_serving_spmd,
     "serving-spec": analyze_serving_spec,
+    "serving-spec-model": analyze_serving_spec_model,
     "serving-async": analyze_serving_async,
     "serving-mega": analyze_serving_mega,
 }

@@ -181,8 +181,11 @@ def __getattr__(name):
             "SLOConfig": ".serving",
             "FaultPlan": ".faults",
             "InjectedFault": ".faults",
-            # round-12 speculative decoding draft source
+            # round-12 speculative decoding draft source (+ the round-19
+            # model-based truncated-layer self-draft)
             "DraftProposer": ".draft",
+            "ModelDraftProposer": ".draft",
+            "ModelDraftEngine": ".draft",
             # round-10 quantized serving conversion
             "quantize_serving_params": ".quantize",
             "quantize_weight": ".quantize",
@@ -199,5 +202,6 @@ __all__ = ["Config", "Predictor", "Tensor_", "create_predictor",
            "ServingPredictor", "Request", "KVCacheManager",
            "FleetRouter", "FleetRequest",
            "SLOConfig", "FaultPlan", "InjectedFault",
-           "DraftProposer", "quantize_serving_params", "quantize_weight",
+           "DraftProposer", "ModelDraftProposer", "ModelDraftEngine",
+           "quantize_serving_params", "quantize_weight",
            "serving_weight_bytes"]

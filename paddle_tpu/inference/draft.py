@@ -28,10 +28,31 @@ EMA; the effective ``k`` scales monotonically with the EMA down to 0
 (plain decode — speculation priced off when the workload doesn't repeat).
 While backed off to 0, a cooldown of plain-decode steps re-arms a probe so
 a workload that turns repetitive later gets re-tried.
+
+Round 19 adds the MODEL-BASED draft source: :class:`ModelDraftProposer`
+(the same adaptive-k EMA surface, per request) backed by a shared
+:class:`ModelDraftEngine` — a truncated-layer SELF-DRAFT of the serving
+model (the first ``spec_draft_layers`` layers of the SAME
+``serving_params`` stack, shared embeddings/LM head — see
+``models/gpt.py draft_serving_params``) running as its own small
+fixed-shape unified-step jit over a DEDICATED paged-KV pool. Unlike the
+n-gram table, the model drafter accepts on non-repetitive text: its
+proposal IS (approximately) what the target would emit, so acceptance
+tracks truncation quality instead of workload repetitiveness. The engine
+batches every proposing lane into ONE k-step decode chain per scheduler
+round, chained DEVICE-SIDE through the unified step's feedback carry
+(intermediate draft tokens never materialize on the host — one sync per
+round lands all of them), and keeps its pool crash-consistent with
+preemption replay by self-healing: each lane records the token ids it
+fed (``_fed``), and a proposal first rolls the draft KV back to the
+longest prefix of the lane's CURRENT context it already holds
+(``KVCacheManager.rollback``) — a preemption replay, a rejected draft
+tail, a clamped proposal or a dropped in-flight step all reconcile
+through the same one comparison.
 """
 from __future__ import annotations
 
-__all__ = ["DraftProposer"]
+__all__ = ["DraftProposer", "ModelDraftProposer", "ModelDraftEngine"]
 
 
 class DraftProposer:
@@ -151,3 +172,320 @@ class DraftProposer:
                 v.append(t)
                 extend_overlay(len(v))
         return drafts
+
+
+class ModelDraftProposer(DraftProposer):
+    """Per-request adaptive-k state for the MODEL-BASED draft source.
+
+    The same ``k``/``update`` EMA-backoff surface as the n-gram proposer
+    (so the scheduler's adaptive clamps, cooldown re-probe and
+    preemption-replay persistence apply unchanged); proposals come from
+    the shared :class:`ModelDraftEngine` instead of an n-gram table. The
+    serving scheduler batches every proposing lane into one engine call
+    per round — :meth:`propose` is the single-lane convenience spelling
+    of the same thing.
+    """
+
+    def __init__(self, max_k: int, engine: "ModelDraftEngine", req_id,
+                 **kw):
+        super().__init__(max_k, **kw)
+        self._engine = engine
+        self._req_id = req_id
+
+    def propose(self, context, budget: int) -> list[int]:
+        k = min(self.k, int(budget))
+        if k <= 0 or not len(context):
+            return []
+        return self._engine.propose(
+            {0: (self._req_id, list(context), k)}).get(0, [])
+
+
+class ModelDraftEngine:
+    """The shared truncated-layer self-draft pass behind every
+    :class:`ModelDraftProposer` of one predictor.
+
+    Owns the DEDICATED draft KV pool (a :class:`KVCacheManager` with
+    ``draft_layers`` layers — same page machinery, same int8-KV support,
+    same head sharding under a serving mesh) and two fixed-shape builds of
+    the truncated unified step (``models/gpt.py build_draft_step``): a
+    CATCH-UP geometry (``chunk`` tokens per lane per call — replaying
+    context the pool does not hold yet) and the CHAIN geometry (chunk 1 —
+    one packed row per lane) that proposes autoregressively: chain step 1
+    feeds each lane's live last context token, steps 2..k feed the
+    previous step's ``next_toks`` carry through the feedback mask, so the
+    intermediate draft tokens stay device-resident and ONE materialization
+    per round lands every lane's k drafts.
+
+    Crash consistency / preemption replay: per request the engine records
+    the exact token ids it fed (``fed``). Every proposal starts by
+    rolling the draft KV back to the longest common prefix of ``fed`` and
+    the lane's CURRENT context (capped at ``len(context) - 1`` so the
+    chain's first feed is always the live last token) — rejected drafts,
+    clamped proposals, preemption replays and dropped in-flight steps all
+    self-heal through that one comparison, with no commit protocol
+    against the target's accept results. Draft capacity is opportunistic
+    like the drafts themselves: a lane the pool cannot hold is evicted
+    (oldest-proposer first) or simply proposes nothing this round.
+    """
+
+    def __init__(self, config, params, draft_layers: int, *, page_size,
+                 chunk, max_batch, max_seq_len, num_pages=None,
+                 use_kernel=None, kv_quant=False, mesh=None, dtype=None,
+                 on_launch=None):
+        from ..models.gpt import (build_draft_step, draft_config,
+                                  draft_serving_params)
+        from ..observability import MetricsRegistry
+        from .kv_cache import KVCacheManager, pages_needed
+
+        import jax.numpy as jnp
+        import numpy as np
+
+        self.draft_layers = int(draft_layers)
+        dcfg = draft_config(config, self.draft_layers)  # validates depth
+        # slice off the UNSHARDED extraction; under a serving mesh the
+        # truncated stacks re-shard with the draft config (same Megatron
+        # layout, head-major qkv permute included)
+        self.params = draft_serving_params(params, self.draft_layers)
+        if mesh is not None:
+            from ..models.gpt import shard_serving_params
+
+            self.params = shard_serving_params(self.params, mesh, dcfg)
+        self.chunk = int(chunk)
+        self.max_batch = int(max_batch)
+        self.max_seq_len = int(max_seq_len)
+        self.kv_quant = bool(kv_quant)
+        self._on_launch = on_launch
+        kv_dtype = dtype if dtype is not None else self.params["tok_emb"].dtype
+        if num_pages is None:
+            # the draft pool mirrors the main pool's TOKEN capacity (the
+            # draft attends over the same contexts); it is "tiny" because
+            # it holds draft_layers layers, not num_layers
+            num_pages = self.max_batch * pages_needed(self.max_seq_len,
+                                                      page_size)
+        # a PRIVATE registry: the manager's kv_* gauge names would
+        # otherwise collide with (and overwrite) the main pool's on the
+        # predictor's shared registry
+        self.cache = KVCacheManager(
+            self.draft_layers, config.num_heads, config.head_dim,
+            num_pages=num_pages, max_batch=self.max_batch,
+            max_seq_len=self.max_seq_len, page_size=page_size,
+            num_q_heads=config.num_heads, dtype=kv_dtype,
+            quantize_kv=self.kv_quant, mesh=mesh,
+            metrics=MetricsRegistry())
+        self._catchup = build_draft_step(
+            config, self.draft_layers, self.cache.page_size, self.chunk,
+            use_kernel=use_kernel, kv_quant=self.kv_quant, mesh=mesh)
+        self._chain = build_draft_step(
+            config, self.draft_layers, self.cache.page_size, 1,
+            use_kernel=use_kernel, kv_quant=self.kv_quant, mesh=mesh)
+        self._t_catchup = self.max_batch * self.chunk
+        b = self.max_batch
+        self._no_cow = jnp.full((b,), self.cache.num_pages, jnp.int32)
+        self._zero_prev = jnp.zeros((b,), jnp.int32)
+        self._zero_keys = jnp.zeros((b, 2), jnp.uint32)
+        self._zero_f32 = jnp.zeros((b,), jnp.float32)
+        self._zero_i32 = jnp.zeros((b,), jnp.int32)
+        self._one_f32 = jnp.ones((b,), jnp.float32)
+        self._np = np
+        self._jnp = jnp
+        # req_id -> {"slot": draft slot, "fed": [token ids written]},
+        # insertion-ordered oldest-proposer-first (the eviction order)
+        from collections import OrderedDict
+
+        self._lanes: "OrderedDict[int, dict]" = OrderedDict()
+        self.model_steps = 0          # draft jit launches (all geometries)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def release(self, req_id) -> None:
+        """Drop a request's draft lane (terminal teardown — the predictor
+        calls this wherever it drops the request's proposer)."""
+        st = self._lanes.pop(req_id, None)
+        if st is not None:
+            self.cache.free(st["slot"])
+
+    def _evict_one(self, keep: set) -> bool:
+        """Free the oldest draft lane not in ``keep``."""
+        for rid in list(self._lanes):
+            if rid not in keep:
+                self.release(rid)
+                return True
+        return False
+
+    def _lane_for(self, req_id, ctx, keep: set):
+        """The request's draft lane, admitted on first use. Returns None
+        when the pool cannot hold this context even after evicting every
+        other idle lane (the lane then proposes nothing this round)."""
+        st = self._lanes.get(req_id)
+        if st is not None:
+            self._lanes.move_to_end(req_id)
+            return st
+        while True:
+            hit = self.cache.admit_prefix(ctx, soft=True)
+            if hit is not None:
+                st = {"slot": hit[0], "fed": [], "rid": req_id}
+                self._lanes[req_id] = st
+                return st
+            if not self._evict_one(keep):
+                return None
+
+    # -- the per-round proposal pass ---------------------------------------
+
+    def _dispatch(self, fn, t, rows, q_lens, last_idx, emit, prev):
+        """One draft-step launch over packed ``rows`` (list of
+        (w, slot, tok, pos) with tok None for feedback rows)."""
+        np, jnp = self._np, self._jnp
+        cache = self.cache
+        b = self.max_batch
+        tok_ids = np.zeros((t,), np.int32)
+        tok_slot = np.full((t,), -1, np.int32)
+        tok_pos = np.zeros((t,), np.int32)
+        feedback = np.zeros((t,), np.int32)
+        for w, slot, tok, pos in rows:
+            tok_slot[w] = slot
+            tok_pos[w] = pos
+            if tok is None:
+                feedback[w] = 1
+            else:
+                tok_ids[w] = tok
+        args = (self.params, jnp.asarray(tok_ids), jnp.asarray(tok_slot),
+                jnp.asarray(tok_pos), jnp.asarray(q_lens),
+                cache.seq_lens_device(), jnp.asarray(last_idx),
+                jnp.asarray(feedback), prev, jnp.asarray(emit),
+                self._zero_i32)
+        pools = ((cache.k_pages, cache.v_pages, cache.k_scales,
+                  cache.v_scales) if self.kv_quant
+                 else (cache.k_pages, cache.v_pages))
+        tail = (cache.page_table_device(), self._no_cow, self._no_cow,
+                self._zero_keys, self._zero_f32, self._zero_i32,
+                self._one_f32)
+        res = fn(*args, *pools, *tail)
+        cache.update_pages(*res[2:])
+        self.model_steps += 1
+        if self._on_launch is not None:
+            self._on_launch()
+        return res[0]                 # next_toks [b] (greedy argmax)
+
+    def propose(self, lanes: dict) -> dict:
+        """Draft for every lane in one batched pass.
+
+        ``lanes``: ``{key: (req_id, context, k)}`` — ``context`` is the
+        lane's VALUE-COMPLETE context (prompt + landed outputs; the
+        scheduler reconciles in-flight tokens before proposing) and ``k``
+        the already-clamped draft count (> 0). Returns ``{key: [ints]}``
+        (a lane the draft pool cannot hold maps to ``[]``).
+        """
+        np = self._np
+        cache = self.cache
+        keep = {rid for rid, _, _ in lanes.values()}
+        active = {}                    # key -> (st, ctx, k)
+        for key, (rid, ctx, k) in lanes.items():
+            st = self._lane_for(rid, ctx, keep)
+            if st is None:
+                continue
+            # self-heal: roll the draft KV back to the longest prefix of
+            # the CURRENT context it holds (capped at len-1: the chain
+            # must feed the live last token itself)
+            fed, limit = st["fed"], len(ctx) - 1
+            p = 0
+            while p < min(len(fed), limit) and fed[p] == ctx[p]:
+                p += 1
+            if len(fed) > p:
+                cache.rollback(st["slot"], p)
+                del fed[p:]
+            active[key] = (st, ctx, int(k))
+        # -- catch-up: replay context the pool does not hold yet ----------
+        while True:
+            rows = []
+            q_lens = np.zeros((self.max_batch,), np.int32)
+            last_idx = np.full((self.max_batch,), self._t_catchup, np.int32)
+            emit = np.zeros((self.max_batch,), np.int32)
+            w = 0
+            drop = []
+            for key, (st, ctx, k) in active.items():
+                need = len(ctx) - 1 - len(st["fed"])
+                if need <= 0:
+                    continue
+                n = min(self.chunk, need, self._t_catchup - w)
+                if n <= 0:
+                    continue
+                if not self._ensure(st, len(st["fed"]) + n, keep):
+                    drop.append(key)
+                    continue
+                base = len(st["fed"])
+                for i in range(n):
+                    rows.append((w + i, st["slot"], ctx[base + i],
+                                 base + i))
+                q_lens[st["slot"]] = n
+                w += n
+            for key in drop:
+                st, _, _ = active.pop(key)
+                self.release(st["rid"])
+            if not rows:
+                break
+            self._dispatch(self._catchup, self._t_catchup, rows, q_lens,
+                           last_idx, emit, self._zero_prev)
+            for key, (st, ctx, k) in active.items():
+                n = int(q_lens[st["slot"]])
+                if n:
+                    cache.advance(st["slot"], n)
+                    st["fed"].extend(ctx[len(st["fed"]):len(st["fed"]) + n])
+        if not active:
+            return {key: [] for key in lanes}
+        # -- the k-step decode chain (device-resident intermediates) ------
+        k_max = max(k for _, _, k in active.values())
+        b = self.max_batch
+        outs = []
+        prev = self._zero_prev
+        alive = dict(active)           # lanes still chaining
+        reach = {key: 0 for key in active}   # chain steps a lane fed
+        for j in range(1, k_max + 1):
+            rows, w = [], 0
+            q_lens = np.zeros((b,), np.int32)
+            last_idx = np.full((b,), b, np.int32)
+            emit = np.zeros((b,), np.int32)
+            for key in list(alive):
+                st, ctx, k = alive[key]
+                L = len(ctx)
+                if k < j or not self._ensure(st, L - 1 + j, keep):
+                    del alive[key]
+                    continue
+                pos = L - 2 + j        # L-1 at step 1, then +1 per step
+                rows.append((w, st["slot"], ctx[-1] if j == 1 else None,
+                             pos))
+                q_lens[st["slot"]] = 1
+                last_idx[st["slot"]] = w
+                emit[st["slot"]] = 1
+                reach[key] = j
+                w += 1
+            if not rows:
+                break
+            prev = self._dispatch(self._chain, b, rows, q_lens, last_idx,
+                                  emit, prev)
+            outs.append(prev)
+            for key in alive:
+                cache.advance(alive[key][0]["slot"], 1)
+        if not outs:
+            return {key: [] for key in lanes}
+        # ONE hard sync lands every lane's whole chain
+        jnp = self._jnp
+        arr = np.asarray(jnp.stack(outs))             # [steps, b]
+        drafts = {key: [] for key in lanes}
+        for key, (st, ctx, k) in active.items():
+            r = reach[key]
+            if r <= 0:
+                continue
+            d = [int(arr[i, st["slot"]]) for i in range(r)]
+            drafts[key] = d
+            # KV now holds ctx[-1] + the first r-1 drafts
+            st["fed"].extend([ctx[-1]] + d[:r - 1])
+        return drafts
+
+    def _ensure(self, st, new_len: int, keep: set) -> bool:
+        """Grow a draft lane, evicting idle lanes under pressure — but
+        never another lane proposing THIS round (``keep``)."""
+        while not self.cache.ensure_capacity(st["slot"], new_len):
+            if new_len > self.max_seq_len or not self._evict_one(
+                    keep | {st["rid"]}):
+                return False
+        return True

@@ -581,6 +581,25 @@ class KVCacheManager:
             self._note_occupancy()
         return freed
 
+    def rollback(self, slot: int, new_len: int) -> int:
+        """Shrink ``slot``'s valid watermark to ``new_len`` tokens and
+        release the pages beyond it (round 19: the draft KV pool's
+        self-heal — a rejected draft's K/V, or a whole stale tail after a
+        preemption replay diverged the context, rolls back to the longest
+        still-valid prefix). ``new_len`` may be 0 (slot keeps its first
+        page — the admission invariant every sequence holds). Only ever
+        valid on pools whose pages are refcount-1 owned (the draft pool
+        never shares/registers pages); returns the pages released."""
+        new_len = max(0, int(new_len))
+        if new_len > int(self._seq_lens[slot]):
+            raise ValueError(
+                f"rollback to {new_len} tokens past slot {slot}'s "
+                f"watermark {int(self._seq_lens[slot])}")
+        if new_len != int(self._seq_lens[slot]):
+            self._seq_lens[slot] = new_len
+            self._sl_rev += 1
+        return self.trim_pages(slot)
+
     def free(self, slot: int) -> None:
         """Evict: drop the slot's page references (shared pages survive in
         other slots / the prefix LRU), park the slot."""

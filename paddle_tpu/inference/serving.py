@@ -96,6 +96,32 @@ disarmed, every seam is one module-global check. With no faults armed,
 no deadlines set and shedding off, the engine is bit-identical to the
 round-16 engine.
 
+Round 19 makes speculation MODEL-BASED and composes it with the async
+engine. ``draft_source="model"`` (or ``config.spec_draft_layers > 0``)
+swaps the n-gram proposer for a truncated-layer SELF-DRAFT: a shared
+:class:`~paddle_tpu.inference.draft.ModelDraftEngine` runs the first
+``draft_layers`` layers of the SAME serving param stacks (shared
+embeddings/LM head — zero extra weights) as its own small fixed-shape
+unified-step jit over a DEDICATED draft KV pool, proposing k tokens per
+decode lane in ONE device-chained pass per scheduler round (catch-up
+prefill chunks + a chunk-1 decode chain threaded through the feedback
+carry; one host sync lands every lane's drafts). Acceptance then tracks
+truncation quality instead of workload repetitiveness — the n-gram
+table's collapse on non-repetitive traffic. Per-request adaptive-k /
+EMA / cooldown state rides the same ``_drafts`` dict (and survives
+preemption replay); the draft pool self-heals against the lane's
+CURRENT context, so replays, rejected drafts and dropped in-flight
+steps all reconcile through one prefix comparison. Async x spec: a
+DRAFTED spec step now dispatches BEHIND-BY-ONE — its n_emit-variable
+advance/rollback reconciles at the START of the next round (every
+completing lane charges one pending token, the guaranteed minimum
+emission) — and DRAFTLESS spec rounds (adaptive k backed off) ride the
+plain engine's deferral + steady-pack cache untouched, so speculation
+and dispatch-ahead multiply instead of excluding each other
+(``serving_spec_async_deferred_steps`` counts both shapes). Greedy and
+seeded emissions stay bit-identical to the sync spec engine, with page
+accounting in lockstep at every drain.
+
 Knobs: ``max_batch`` (lanes), ``num_pages``/``page_size`` (pool geometry),
 ``max_seq_len`` (page-table width), ``chunk`` (per-slot prefill chunk,
 autotuned default), ``token_budget`` (tokens per step, default
@@ -326,7 +352,8 @@ class ServingPredictor:
                  spec_decode_k=None, async_engine=None,
                  max_inflight_steps=4, metrics=None, mega_decode=None,
                  slo=None, max_step_retries=3, retry_backoff_s=0.02,
-                 replica_id=0):
+                 replica_id=0, draft_source=None, draft_layers=None,
+                 draft_num_pages=None):
         from ..distributed.mesh import as_serving_mesh
         from ..models.gpt import (_serving_params_cached, build_decode_step,
                                   build_prefill, build_unified_step,
@@ -361,6 +388,11 @@ class ServingPredictor:
             # (quantized per cfg.weight_dtype, sharded per mesh signature,
             # inside the cache)
             self.params = _serving_params_cached(model, mesh=self.mesh)
+            # the round-19 draft engine slices its truncated stacks off
+            # the UNSHARDED extraction (it re-shards with its own config)
+            params_unsharded = (self.params if self.mesh is None
+                                else _serving_params_cached(model,
+                                                            mesh=None))
         else:
             import jax
 
@@ -372,6 +404,7 @@ class ServingPredictor:
                 self.params = quantize_serving_params(
                     self.params, cfg.weight_dtype,
                     cfg.weight_quant_group_size)
+            params_unsharded = self.params
             if self.mesh is not None:
                 self.params = shard_serving_params(self.params, self.mesh,
                                                    cfg)
@@ -469,6 +502,40 @@ class ServingPredictor:
             # bucket shape (prompts are padded to _bucket multiples)
             self._prefill = build_prefill(cfg, self.cache.page_size,
                                           mesh=self.mesh)
+        # round 19: the draft SOURCE behind spec_decode_k — "ngram" (the
+        # round-12 prompt-lookup table) or "model" (the truncated-layer
+        # self-draft: ModelDraftEngine runs the first draft_layers layers
+        # of the SAME param stacks over a dedicated draft KV pool and
+        # proposes k tokens per decode lane in one device-chained pass
+        # per round). Defaults follow the config: spec_draft_layers > 0
+        # selects the model source.
+        self.draft_layers = int(
+            draft_layers if draft_layers is not None
+            else getattr(cfg, "spec_draft_layers", 0) or 0)
+        if draft_source is None:
+            draft_source = ("model" if (self.spec_k and self.draft_layers)
+                            else "ngram")
+        if draft_source not in ("ngram", "model"):
+            raise ValueError(f"draft_source must be 'ngram' or 'model', "
+                             f"got {draft_source!r}")
+        self.draft_source = draft_source
+        self._draft_engine = None
+        if self.draft_source == "model":
+            if not self.spec_k:
+                raise ValueError(
+                    "draft_source='model' needs spec_decode_k > 0 "
+                    "(there is nothing to draft)")
+            from .draft import ModelDraftEngine
+
+            # draft_config inside the engine rejects draft_layers < 1
+            # and >= num_layers loudly AT CONSTRUCTION
+            self._draft_engine = ModelDraftEngine(
+                cfg, params_unsharded, self.draft_layers,
+                page_size=self.cache.page_size, chunk=self.chunk,
+                max_batch=self.max_batch, max_seq_len=self.max_seq_len,
+                num_pages=draft_num_pages, use_kernel=use_kernel,
+                kv_quant=self.kv_quant, mesh=self.mesh,
+                on_launch=self._note_draft_launch)
         # round 13: the async double-buffered engine — dispatch-ahead on
         # the unified step's device-resident token feedback; the sync
         # engine is the same pack/capacity code at pipeline depth zero.
@@ -516,7 +583,7 @@ class ServingPredictor:
         self._last_event = None
         self._idle_since = None
         self._w_marks = {"step_s": 0.0, "sync_s": 0.0, "gap_s": 0.0,
-                         "calls": 0.0}
+                         "calls": 0.0, "draft_s": 0.0}
         # round 17: resilience knobs — SLO-aware admission control (off
         # when slo is None), bounded step retry + exponential backoff,
         # and the deadline sweep (armed lazily by the first deadlined
@@ -543,6 +610,9 @@ class ServingPredictor:
         self._deadlines_armed = False
         self._consec_failures = 0
         self._ttft_ema_ms: float | None = None
+        # round 19: predictor-level draft-acceptance EMA (healthz exposes
+        # it so the fleet router can score spec-effective replicas)
+        self._accept_ema: float | None = None
         # req_id -> DraftProposer (kept across preemption — the request's
         # context replays identically, so the table stays consistent)
         self._drafts: dict[int, object] = {}
@@ -600,6 +670,19 @@ class ServingPredictor:
             "serving_draft_accepted", "draft tokens accepted by verify")
         self._m_draft_rollback = m.counter(
             "serving_draft_rollback_pages", "over-allocated pages trimmed")
+        # round 19: the model-based draft source + async x spec
+        self._m_draft_model_steps = m.counter(
+            "serving_draft_model_steps",
+            "draft-model jit launches (catch-up chunks + chain steps)")
+        self._m_draft_src = m.counter(
+            "serving_draft_tokens_proposed",
+            "draft tokens proposed, by source", labels=("source",))
+        self._m_spec_deferred = m.counter(
+            "serving_spec_async_deferred_steps",
+            "spec-build dispatches reconciled behind-by-one or deferred")
+        self._m_draft_s = m.counter(
+            "serving_draft_seconds",
+            "host wall seconds inside the draft-model proposal pass")
         # round 17: resilience — shed / deadline / fault / retry counters
         self._m_failed = m.counter(
             "serving_requests_failed", "requests reaching terminal FAILED")
@@ -751,6 +834,9 @@ class ServingPredictor:
             "pool_occupancy": round(self.pool_occupancy, 4),
             "withheld_pages": cache.withheld_page_count,
             "ttft_p99_ema_ms": round(self.ttft_p99_ema_ms, 3),
+            # round 19: the draft-acceptance EMA — a router scoring
+            # replicas can prefer ones whose speculation is paying off
+            "spec_accept_ema": round(self.spec_accept_ema, 4),
             "steps": self.steps,
             "tokens_emitted": self.tokens_emitted,
             "requests_shed": int(self._m_shed.value),
@@ -801,6 +887,31 @@ class ServingPredictor:
         if not self.spec_proposed:
             return 0.0
         return self.spec_accepted / self.spec_proposed
+
+    @property
+    def draft_overhead_frac(self) -> float:
+        """Fraction of the measured window's step() wall time spent in
+        the draft-model proposal pass (0.0 for the n-gram source — its
+        table lookups are noise) — what the model drafter costs against
+        the accepted tokens it buys."""
+        step = self._window("step_s", self._m_step_s)
+        if step <= 0:
+            return 0.0
+        return min(1.0, self._window("draft_s", self._m_draft_s) / step)
+
+    @property
+    def spec_accept_ema(self) -> float:
+        """EMA over per-step draft acceptance fractions (0.0 before any
+        drafted step) — the healthz signal a fleet router scores
+        spec-effective replicas by."""
+        return 0.0 if self._accept_ema is None else self._accept_ema
+
+    def _note_draft_launch(self) -> None:
+        """One draft-engine jit launch: counted, and marked as a dispatch
+        so the gap accounting knows the device has draft work (the chain
+        runs while the host packs the verify step around it)."""
+        self._m_draft_model_steps.inc()
+        self._mark_dispatch()
 
     # -- perf accounting (the round-13 bench metrics) ----------------------
 
@@ -867,7 +978,8 @@ class ServingPredictor:
         self._w_marks = {"step_s": self._m_step_s.value,
                          "sync_s": self._m_sync_s.value,
                          "gap_s": self._m_gap_s.value,
-                         "calls": self._m_step_calls.value}
+                         "calls": self._m_step_calls.value,
+                         "draft_s": self._m_draft_s.value}
 
     # -- shared scheduler internals ----------------------------------------
 
@@ -896,6 +1008,10 @@ class ServingPredictor:
         window has no 'b' yet)."""
         self._base_keys.pop(req.req_id, None)
         self._drafts.pop(req.req_id, None)
+        if self._draft_engine is not None:
+            # the draft KV lane goes with the request (preemption KEEPS
+            # it — the replayed context self-heals against the pool)
+            self._draft_engine.release(req.req_id)
         if tracing_active():
             self._req_event(req.req_id, event, args=args)
             request_end(req.req_id)
@@ -1078,25 +1194,79 @@ class ServingPredictor:
             self._base_keys[req.req_id] = hit
         return hit
 
-    def _draft_propose(self, slot, req, budget_room: int) -> list:
-        """Draft tokens for a decode lane, clamped so speculation stays
-        opportunistic: the token budget, the per-slot chunk block, the
-        request's remaining output budget, the length ceiling, and —
-        via ``draft_allowance`` — pages claimable WITHOUT evicting prefix
-        pages or preempting anyone (rejected drafts must cost nothing).
-        The allowance is re-checked at claim time in the capacity loop:
-        this propose-time clamp only avoids wasted table lookups."""
-        from .draft import DraftProposer
-
+    def _proposer_for(self, req: Request):
+        """The request's draft proposer (created on first use; persists
+        across preemption replay so the adaptive-k EMA AND the cooldown
+        re-probe state survive — round-19 satellite: a replay must resume
+        the backoff where it left off, not restart from the floor)."""
         prop = self._drafts.get(req.req_id)
         if prop is None:
-            prop = self._drafts[req.req_id] = DraftProposer(self.spec_k)
+            from .draft import DraftProposer, ModelDraftProposer
+
+            if self._draft_engine is not None:
+                prop = ModelDraftProposer(self.spec_k, self._draft_engine,
+                                          req.req_id)
+            else:
+                prop = DraftProposer(self.spec_k)
+            self._drafts[req.req_id] = prop
+        return prop
+
+    def _proposer_k(self, req: Request) -> int:
+        """The lane's CURRENT adaptive speculation length without
+        creating a proposer (a fresh request starts optimistic at the
+        build k)."""
+        prop = self._drafts.get(req.req_id)
+        return prop.k if prop is not None else self.spec_k
+
+    def _draft_room(self, slot, req, budget_room: int) -> int:
+        """The per-lane draft clamp shared by both sources: the token
+        budget, the per-slot chunk block, the request's remaining output
+        budget, the length ceiling, and — via ``draft_allowance`` — pages
+        claimable WITHOUT evicting prefix pages or preempting anyone
+        (rejected drafts must cost nothing). Re-checked at claim time in
+        the capacity loop; this propose-time clamp only avoids wasted
+        draft work."""
         written = self.cache.seq_len(slot)
-        room = min(budget_room, self.spec_k, self.chunk - 1,
+        return min(budget_room, self._proposer_k(req), self.chunk - 1,
                    req.max_new_tokens - len(req.output_ids) - 1,
                    self.max_seq_len - written - 1,
                    self.cache.draft_allowance(slot))
+
+    def _draft_propose(self, slot, req, budget_room: int) -> list:
+        """N-gram drafts for one decode lane (the model source batches
+        through :meth:`_propose_model_drafts` instead)."""
+        prop = self._proposer_for(req)
+        room = self._draft_room(slot, req, budget_room)
         return prop.propose(req._context_ids(), room) if room > 0 else []
+
+    def _propose_model_drafts(self, decode_slots, budget: int) -> dict:
+        """ONE batched draft-engine pass for every decode lane that may
+        speculate this round: per-lane rooms follow the n-gram path's
+        sequential budget split (each lane's base token reserved before
+        anyone's drafts), then the engine catch-up + k-step chain runs
+        all lanes together — k draft jit launches per ROUND, not per
+        lane, with the intermediate tokens device-resident. Contexts are
+        value-complete here: the round-start reconcile landed any
+        in-flight token of a lane whose proposer still speculates."""
+        lanes: dict[int, tuple] = {}
+        n_left = len(decode_slots)
+        for slot in decode_slots:
+            n_left -= 1
+            room = budget - 1 - n_left
+            req = self.running[slot]
+            self._proposer_for(req)
+            r = self._draft_room(slot, req, room)
+            budget -= 1
+            if r > 0:
+                lanes[slot] = (req.req_id, req._context_ids(), r)
+                budget -= r
+        if not lanes:
+            return {}
+        t0 = monotonic()
+        try:
+            return self._draft_engine.propose(lanes)
+        finally:
+            self._m_draft_s.inc(monotonic() - t0)
 
     @staticmethod
     def _merge_produced(dst: dict, src: dict) -> None:
@@ -1136,7 +1306,13 @@ class ServingPredictor:
         self._did_sync = False
         try:
             with span("flush"):
-                return self._reconcile_all()
+                out = self._reconcile_all()
+                # round 19: a drained spec advance may complete a prompt
+                # whose tail page registration was one round short (the
+                # behind-by-one dispatch) — finish it so post-flush state
+                # matches the sync engine's exactly
+                self._register_prefixes()
+                return out
         finally:
             if self._did_sync:
                 self._m_hard_syncs.inc()
@@ -1204,7 +1380,9 @@ class ServingPredictor:
             fault_point("reconcile")
             t0 = monotonic()
             out = np.asarray(e.out)
-            if e.spec:
+            if e.spec and e.spec_slots:
+                # n_emit only matters when some lane actually drafted (a
+                # draftless spec round emits exactly 1 per lane)
                 ne = np.asarray(e.ne)
             self._m_sync_s.inc(monotonic() - t0)
             self._did_sync = True
@@ -1236,22 +1414,28 @@ class ServingPredictor:
                 if req.first_token_time is None:
                     self._note_first_token(req)
                 produced.setdefault(req.req_id, []).append(tok)
-            if not e.spec:
-                # the pack charged ONE pending token per completing
-                # plain lane; it just landed (or dropped as overhang)
-                req._pending_n = max(0, req._pending_n - 1)
-                if req.state == FINISHED:
-                    # a count-finished request's deferred finished-counter
-                    # lands with its final token values
-                    self._count_finished(req)
+            # the pack charged ONE pending token per completing lane
+            # (plain AND spec since round 19); it just landed — a spec
+            # lane's extra accepted tokens are a same-instant surplus
+            req._pending_n = max(0, req._pending_n - 1)
+            if req.state == FINISHED:
+                # a count-finished request's deferred finished-counter
+                # lands with its final token values
+                self._count_finished(req)
             self._m_tokens.inc(emitted)
             if self.spec_k and was_decode:
                 acc = int(ne[slot]) - 1 if k_i else 0
                 self._m_spec_lane_steps.inc()
                 self._m_spec_emitted.inc(emitted)
                 self._m_draft_proposed.inc(k_i)
+                self._m_draft_src.labels(source=self.draft_source).inc(k_i)
                 self._m_draft_accepted.inc(acc)
                 if k_i:
+                    # predictor-level acceptance EMA (healthz surface)
+                    frac = acc / k_i
+                    self._accept_ema = (
+                        frac if self._accept_ema is None
+                        else 0.8 * self._accept_ema + 0.2 * frac)
                     self._req_event(req.req_id, "spec_accept",
                                     args={"proposed": k_i, "accepted": acc})
                 prop = self._drafts.get(req.req_id)
@@ -1349,8 +1533,9 @@ class ServingPredictor:
         counter_event("inflight_steps", 0)
         reopen: dict[int, Request] = {}
         for entry in dropped:
-            if entry.spec:
-                continue   # spec reconciles depth-zero: no pending charge
+            # round 19: spec entries charge one pending token per
+            # completing lane too (behind-by-one dispatch) — un-charge
+            # them exactly like plain entries
             for _slot, req, _k, _decode in entry.completing:
                 req._pending_n = max(0, req._pending_n - 1)
                 if req.state == FINISHED and not req.done:
@@ -1376,6 +1561,29 @@ class ServingPredictor:
 
     def _step_unified(self) -> dict[int, list[int]]:
         produced: dict[int, list[int]] = {}
+        # round 19 — the behind-by-one half of async x spec: a DRAFTED
+        # spec step's n_emit-variable advance/rollback (and the proposer
+        # feedback + context values the next proposal depends on) must
+        # land before this round schedules anything — INCLUDING the
+        # deadline sweep, which frees slots the in-flight entry's
+        # value-based advance still references — so a ring holding
+        # drafted entries reconciles HERE, one round after its dispatch,
+        # instead of inside it (the pre-round-19 hard sync). A draftless
+        # spec ring defers like the plain engine and only syncs when a
+        # lane that would draft again has its input token still in
+        # flight (its proposal needs the value-complete context).
+        if self._inflight and self.spec_k and (
+                any(p.spec_slots for p in self._inflight)
+                or any(r._pending_n and self._proposer_k(r) > 0
+                       for r in self.running.values())):
+            self._merge_produced(produced, self._reconcile_all())
+            # the spec advance just landed: a lane whose final prompt
+            # token rode the drained verify step can only NOW register
+            # its partial tail page — complete the registration the
+            # behind-by-one dispatch left one round short (idempotent),
+            # BEFORE this round's admissions walk the registry (the sync
+            # engine registered it last round)
+            self._register_prefixes()
         if self._deadlines_armed:
             self._shed_expired()
         # value barrier: admission replays a preempted request's context
@@ -1404,24 +1612,38 @@ class ServingPredictor:
         self._m_inflight.set(len(self._inflight))
         counter_event("inflight_steps", len(self._inflight))
         self._m_steps.inc()
-        if not self.async_engine or self.spec_k:
-            # sync engine — and the speculative build, whose drafts and
-            # n_emit page accounting are host-value-dependent: pipeline
-            # depth zero, reconcile the step just dispatched
+        if not self.async_engine:
+            # sync engine: pipeline depth zero, reconcile the step just
+            # dispatched (the oracle the async engine is gated against)
             self._merge_produced(produced, self._reconcile_all())
+        elif entry.spec_slots:
+            # round 19: a DRAFTED spec step dispatches BEHIND-BY-ONE —
+            # its value-based advance/rollback reconciles at the START
+            # of the next round (see _step_unified's ring drain), so the
+            # device executes the verify step while the host runs the
+            # next round's bookkeeping instead of blocking right here
+            # (the pre-round-19 behavior: spec forced depth zero)
+            pass
         else:
             # the double-buffer contract: reconcile BEHIND-BY-ONE while
             # an emission boundary (a step whose tokens could finish a
             # request) is in the ring; steps that cannot complete
             # anything defer — up to max_inflight_steps — and drain in
             # one batched materialization later (the general
-            # no-completion-possible fast path)
+            # no-completion-possible fast path). Round 19: DRAFTLESS
+            # spec-build rounds ride this path too — their emission is
+            # count-deterministic (n_emit == 1), exactly a plain step
             while self._inflight and (
                     len(self._inflight) > self.max_inflight_steps
                     or (len(self._inflight) > 1
                         and any(p.must_sync
                                 for p in list(self._inflight)[:-1]))):
                 self._merge_produced(produced, self._reconcile_one())
+        if (self.spec_k and self._inflight
+                and self._inflight[-1] is entry):
+            # a spec-build dispatch whose reconcile outlived this call —
+            # the async x spec multiplier the round-19 bench leg gates
+            self._m_spec_deferred.inc()
         self._register_prefixes()
         return produced
 
@@ -1468,6 +1690,11 @@ class ServingPredictor:
             req = self.running[slot]
             remaining = req._ctx_len - cache.seq_len(slot)
             (decode_slots if remaining == 1 else prefill_slots).append(slot)
+        # round 19: the model draft source proposes every lane in ONE
+        # batched engine pass (k chain launches per round, not per lane)
+        model_drafts: dict[int, list] = {}
+        if self.spec_k and self._draft_engine is not None and decode_slots:
+            model_drafts = self._propose_model_drafts(decode_slots, budget)
         for idx, slot in enumerate(decode_slots):
             if budget <= 0:
                 break
@@ -1477,8 +1704,11 @@ class ServingPredictor:
             # (a tight custom token_budget would otherwise skip the same
             # trailing lanes every step)
             room = budget - 1 - (len(decode_slots) - idx - 1)
-            d = (self._draft_propose(slot, self.running[slot], room)
-                 if self.spec_k else [])
+            if self._draft_engine is not None:
+                d = model_drafts.get(slot, [])[:max(0, room)]
+            else:
+                d = (self._draft_propose(slot, self.running[slot], room)
+                     if self.spec_k else [])
             if d:
                 drafts[slot] = d
             sched[slot] = 1 + len(d)
@@ -1625,7 +1855,9 @@ class ServingPredictor:
             d_ids, d_slot, d_qlens, d_last, d_fb, d_emit = (
                 st["d_ids"], st["d_slot"], st["d_qlens"], st["d_last"],
                 st["d_fb"], st["d_emit"])
-            d_spec = None
+            # round 19: a spec-build steady round re-serves the all-zero
+            # spec_len device array too (steady implies no drafts)
+            d_spec = st["d_spec"]
             d_cow_src = d_cow_dst = self._no_cow
             temp, top_k, top_p = st["temp"], st["top_k"], st["top_p"]
         else:
@@ -1715,18 +1947,19 @@ class ServingPredictor:
             self._steady = (dict(sig=steady_sig, completing=completing,
                                  d_ids=d_ids, d_slot=d_slot,
                                  d_qlens=d_qlens, d_last=d_last,
-                                 d_fb=d_fb, d_emit=d_emit, temp=temp,
-                                 top_k=top_k, top_p=top_p)
+                                 d_fb=d_fb, d_emit=d_emit, d_spec=d_spec,
+                                 temp=temp, top_k=top_k, top_p=top_p)
                             if steady_sig is not None else None)
         # could any of this step's emissions FINISH a request? (the async
         # engine's sync-boundary predicate: eos configured, or the output
-        # budget reachable by this emission) — recomputed on the steady
-        # path too: the output budget closes in as pending grows
+        # budget reachable by this emission — up to 1 + k_i tokens for a
+        # drafted spec lane) — recomputed on the steady path too: the
+        # output budget closes in as pending grows
         must_sync = any(
             req.eos_token_id is not None
-            or len(req.output_ids) + req._pending_n + 1
+            or len(req.output_ids) + req._pending_n + 1 + k_i
             >= req.max_new_tokens
-            for _, req, _, _ in completing)
+            for _, req, k_i, _ in completing)
         prev = (self._carry
                 if (self.async_engine and self._carry is not None)
                 else self._zero_prev)
@@ -1765,12 +1998,15 @@ class ServingPredictor:
             out_dev, ne_dev, carry = res[0], None, res[0]
             cache.update_pages(*res[2:])
         self._carry = carry
-        # charge the dispatched-unmaterialized token per completing plain
-        # lane only once the launch SUCCEEDED (round 17: a failed launch
-        # must leave no pending to un-charge)
-        if not self.spec_k:
-            for _, req, _, _ in completing:
-                req._pending_n += 1
+        # charge the dispatched-unmaterialized token per completing lane
+        # only once the launch SUCCEEDED (round 17: a failed launch must
+        # leave no pending to un-charge). Round 19 generalizes the charge
+        # to SPEC lanes too (n_emit-variable emission): one pending token
+        # is the GUARANTEED minimum — the accepted drafts beyond it land
+        # as a reconcile-time surplus the output budget absorbs exactly
+        # like the sync engine's multi-token emission
+        for _, req, _, _ in completing:
+            req._pending_n += 1
         # count-based cache accounting at pack time: plain lanes advance
         # by what they fed; speculative lanes advance at reconcile (their
         # watermark is n_emit, a device value)

@@ -86,6 +86,15 @@ class GPTConfig:
     # is BUILD geometry (the step's output is [batch, k + 1]); per-request
     # adaptive k varies only the spec_len inputs, never the shape.
     spec_decode_k: int = 0
+    # round-19 model-based speculative drafting: > 0 selects the truncated-
+    # layer SELF-DRAFT proposer for serving (the first spec_draft_layers
+    # layers of the SAME serving stack — shared embeddings/positional
+    # table/final LN/LM head, zero extra weights to load — run as their own
+    # small fixed-shape unified-step jit over a dedicated draft KV pool,
+    # proposing spec_decode_k tokens autoregressively per decode lane).
+    # 0 keeps the round-12 n-gram proposer. Must be < num_layers (a full-
+    # depth "draft" would just run the target twice — rejected loudly).
+    spec_draft_layers: int = 0
     # round-16 megakernel decode: route ALL-DECODE serving rounds through
     # the fused per-layer Pallas megakernels (ops/pallas/mega_decode —
     # LN1 -> QKV -> inline KV quantize -> ragged paged attention -> output
@@ -1389,6 +1398,70 @@ def _unified_fn(config: GPTConfig, page_size: int, chunk: int, use_kernel,
                                    use_kernel=use_kernel,
                                    kv_quant=kv_quant, mesh=mesh,
                                    spec_k=spec_k, mega=mega))
+
+
+# ---------------------------------------------------------------------------
+# Round-19 model-based self-draft: the draft "model" is the first
+# ``draft_layers`` decoder layers of the SAME serving stack (shared
+# embeddings / positional table / final LN / LM head — zero extra weights
+# to load; a distinct EAGLE-style draft param pytree can ride the same
+# surface later by swapping what draft_serving_params returns). The draft
+# pass is just the round-9 unified step built from a truncated config, so
+# it inherits the packed token budget, the paged-KV write/ragged-attention
+# discipline, the device-resident feedback carry (the k-token draft chain
+# never materializes intermediate tokens on the host) and the
+# one-trace-per-geometry contract for free.
+# ---------------------------------------------------------------------------
+
+
+def draft_config(config: GPTConfig, draft_layers: int) -> GPTConfig:
+    """The truncated-stack config the draft jits build from. Rejects
+    degenerate depths loudly: ``draft_layers >= num_layers`` would run the
+    full target as its own drafter (all cost, no speedup) and is always a
+    configuration mistake."""
+    import dataclasses
+
+    draft_layers = int(draft_layers)
+    if draft_layers < 1:
+        raise ValueError(
+            f"spec_draft_layers must be >= 1, got {draft_layers}")
+    if draft_layers >= config.num_layers:
+        raise ValueError(
+            f"spec_draft_layers {draft_layers} must be < num_layers "
+            f"{config.num_layers} (a full-depth draft would run the "
+            "target twice per token instead of a cheap proposer)")
+    # the draft stack serves plain decode only: no nested speculation, no
+    # megakernel routing (its geometry is already minimal)
+    return dataclasses.replace(config, num_layers=draft_layers,
+                               spec_decode_k=0, spec_draft_layers=0,
+                               mega_decode=False)
+
+
+def draft_serving_params(params, draft_layers: int):
+    """Slice a serving params pytree down to the first ``draft_layers``
+    scan stacks. The non-layer leaves (embeddings, final LN, LM head) are
+    SHARED by reference — the self-draft loads zero extra weights; only
+    the truncated layer stacks are (small) device slices. Works on fp and
+    quantized (``{"q", "s"}``) stacks alike."""
+    import jax
+
+    out = {k: v for k, v in params.items() if k != "layers"}
+    out["layers"] = jax.tree.map(lambda a: a[:draft_layers],
+                                 params["layers"])
+    return out
+
+
+def build_draft_step(config: GPTConfig, draft_layers: int, page_size: int,
+                     chunk: int, use_kernel=None, kv_quant: bool = False,
+                     mesh=None):
+    """The draft pass's fixed-shape jit: the unified serving step built
+    from the TRUNCATED config (validated by :func:`draft_config`) — one
+    build serves both the catch-up prefill chunks and the chunk-1 decode
+    chain geometry (the caller picks ``chunk``). Shares the process-wide
+    jit cache, so every predictor with the same draft geometry replays one
+    executable."""
+    return _unified_fn(draft_config(config, draft_layers), page_size,
+                       chunk, use_kernel, kv_quant=kv_quant, mesh=mesh)
 
 
 def generate_paged(model, input_ids, max_new_tokens=20, *, page_size=None,

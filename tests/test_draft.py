@@ -115,3 +115,32 @@ def test_propose_respects_adaptive_k_and_budget():
     while p.k > 0:
         p.update(4, 0)
     assert p.propose(ctx, 4) == []         # backed off: plain decode
+
+
+def test_model_draft_proposer_shares_adaptive_k_surface():
+    """Round 19: ModelDraftProposer keeps the n-gram proposer's
+    adaptive-k / EMA / cooldown machinery verbatim (so the scheduler's
+    clamps and the preemption-replay persistence apply unchanged); only
+    the proposal source changes — it delegates to the shared engine,
+    and a backed-off proposer never consults the engine at all."""
+    from paddle_tpu.inference.draft import ModelDraftProposer
+
+    class FakeEngine:
+        def __init__(self):
+            self.calls = []
+
+        def propose(self, lanes):
+            self.calls.append(lanes)
+            return {k: [1] * min(v[2], 2) for k, v in lanes.items()}
+
+    eng = FakeEngine()
+    p = ModelDraftProposer(4, eng, 7)
+    assert p.k == 4                          # optimistic start, inherited
+    assert p.propose([5, 6, 7], 3) == [1, 1]
+    assert eng.calls[0][0][0] == 7           # req_id threaded through
+    assert eng.calls[0][0][2] == 3           # k clamped by budget
+    for _ in range(12):
+        p.update(4, 0)                       # every draft rejected
+    assert p.k == 0                          # EMA backoff, inherited
+    assert p.propose([5, 6, 7], 3) == []     # backed off: no engine call
+    assert len(eng.calls) == 1

@@ -405,6 +405,10 @@ class TestServingTelemetry:
         "pool_occupancy": lambda v: isinstance(v, float) and 0 <= v <= 1,
         "withheld_pages": lambda v: isinstance(v, int) and v >= 0,
         "ttft_p99_ema_ms": lambda v: isinstance(v, float) and v >= 0,
+        # round 19: the draft-acceptance EMA — a router scoring replicas
+        # can prefer ones whose speculation is paying off
+        "spec_accept_ema": lambda v: (isinstance(v, float)
+                                      and 0 <= v <= 1),
         "steps": lambda v: isinstance(v, int) and v >= 0,
         "tokens_emitted": lambda v: isinstance(v, int) and v >= 0,
         "requests_shed": lambda v: isinstance(v, int) and v >= 0,

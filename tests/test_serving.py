@@ -2283,3 +2283,328 @@ def test_bench_serve_legs_selector_rejects_typo():
         env={**os.environ, "JAX_PLATFORMS": "cpu"})
     assert proc.returncode != 0
     assert "unknown leg" in (proc.stderr + proc.stdout)
+
+
+# -- round 19: model-based self-draft + async x spec ------------------------
+# ServingPredictor(draft_source="model", draft_layers=D) swaps the n-gram
+# proposer for the truncated-layer self-draft (ModelDraftEngine: the first
+# D layers of the SAME serving stacks over a dedicated draft KV pool, one
+# device-chained k-step proposal pass per round), and spec_k > 0 now
+# composes with the async engine: drafted spec steps dispatch BEHIND-BY-ONE
+# (reconciled at the next round's start) and draftless spec rounds ride the
+# plain deferral + steady-pack cache. The gates: model-draft greedy ==
+# plain decode token-for-token (the accept rule is unchanged), seeded
+# streams identical, async spec bit-identical to sync spec with the page
+# accounting in lockstep at every drain barrier, int8/mesh composition, and
+# loud rejection of degenerate draft depths.
+
+
+def test_model_draft_generate_matches_plain_at_k124(rng):
+    """THE round-19 acceptance gate: greedy speculation with the
+    truncated-layer MODEL draft source is token-for-token identical to
+    plain decode at k in {1, 2, 4} — AND it actually accepts on
+    NON-repetitive prompts (the n-gram proposer's blind spot): the
+    1-of-2-layer draft shares the residual stream, so its argmax tracks
+    the target's."""
+    model = _tiny_model()
+    prompts = [rng.randint(0, TINY["vocab_size"], (n,)).tolist()
+               for n in (3, 19, 7, 1, 12)]
+    kw = dict(max_batch=3, max_seq_len=48, page_size=8, chunk=8)
+    want = ServingPredictor(model, **kw).generate(prompts,
+                                                  max_new_tokens=10)
+    for k in (1, 2, 4):
+        sp = ServingPredictor(model, spec_decode_k=k, draft_source="model",
+                              draft_layers=1, **kw)
+        got = sp.generate(prompts, max_new_tokens=10)
+        for w, g in zip(want, got):
+            np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+        assert sp.decode_trace_count == 1     # the verify step: one trace
+        assert sp.spec_proposed > 0
+        assert sp.accepted_tokens_per_step > 1.0
+        assert 0.0 < sp.draft_acceptance_rate <= 1.0
+        # the draft engine ran (catch-up + chain launches) and its
+        # telemetry landed on the predictor registry
+        flat = sp.telemetry()
+        assert flat["serving_draft_model_steps"] > 0
+        assert flat["serving_draft_tokens_proposed{source=model}"] > 0
+        # terminal requests released their draft lanes: the draft pool
+        # drains completely alongside the main pool
+        assert (sp._draft_engine.cache.available_page_count
+                == sp._draft_engine.cache.num_pages)
+        # the healthz acceptance EMA is live (fleet routers score it)
+        assert 0.0 < sp.healthz()["spec_accept_ema"] <= 1.0
+
+
+def test_model_draft_kernel_leg_matches_plain(rng):
+    """Same golden with the Pallas kernels forced (interpret mode on
+    CPU): the draft jit rides the same ragged-attention kernel path."""
+    model = _tiny_model()
+    prompts = [rng.randint(0, TINY["vocab_size"], (n,)).tolist()
+               for n in (5, 9)]
+    kw = dict(max_batch=2, max_seq_len=48, page_size=8, chunk=8,
+              use_kernel=True)
+    want = ServingPredictor(model, **kw).generate(prompts,
+                                                  max_new_tokens=6)
+    got = ServingPredictor(model, spec_decode_k=3, draft_source="model",
+                           draft_layers=1, **kw).generate(
+        prompts, max_new_tokens=6)
+    assert got == want
+
+
+def test_model_draft_sampled_stream_identical_to_plain(rng):
+    """Seeded sampling through the verify rows with MODEL drafts: the
+    accept rule keys row j by tokens-produced + j exactly as the n-gram
+    path does, so the speculative output is BIT-identical to the plain
+    seeded predictor — the draft source changes cost, never output."""
+    model = _tiny_model()
+    prompts = [rng.randint(0, TINY["vocab_size"], (n,)).tolist()
+               for n in (9, 5)]
+    kw = dict(max_batch=2, max_seq_len=48, page_size=8, chunk=8)
+    samp = dict(temperature=0.8, top_p=0.9, top_k=40, seed=123)
+    want = ServingPredictor(model, **kw).generate(
+        prompts, max_new_tokens=8, **samp)
+    got = ServingPredictor(model, spec_decode_k=3, draft_source="model",
+                           draft_layers=1, **kw).generate(
+        prompts, max_new_tokens=8, **samp)
+    assert got == want
+
+
+def test_async_spec_bit_identical_to_sync_spec_1k_churn(rng):
+    """THE round-19 async x spec gate: with spec_k > 0 the async engine
+    (drafted steps dispatching BEHIND-BY-ONE, draftless spec rounds
+    deferring like plain ones) must reproduce the sync spec engine
+    token-for-token over a continuous churn — for BOTH draft sources —
+    with the page/refcount/prefix-pin accounting in LOCKSTEP at every
+    drain barrier and the conservation invariants holding after every
+    async step."""
+    model = _tiny_model()
+    for source, n_prompts, layers, min_steps in (("ngram", 160, None, 200),
+                                                 ("model", 90, 1, 100)):
+        prompts = _churn_prompts(rng, n_prompts)
+        kw = dict(max_batch=3, max_seq_len=48, page_size=8, chunk=8,
+                  spec_decode_k=4, draft_source=source,
+                  draft_layers=layers)
+        sp_s = ServingPredictor(model, async_engine=False, **kw)
+        sp_a = ServingPredictor(model, async_engine=True, **kw)
+        queued_s, queued_a = list(prompts), list(prompts)
+        reqs_s, reqs_a = [], []
+
+        def admit(sp, queued, reqs):
+            while queued and sum(1 for r in reqs
+                                 if r.state != FINISHED) < sp.max_batch:
+                reqs.append(sp.add_request(queued.pop(0), 5))
+
+        steps = 0
+        while (queued_s or sp_s.has_work()
+               or queued_a or sp_a.has_work()):
+            admit(sp_s, queued_s, reqs_s)
+            admit(sp_a, queued_a, reqs_a)
+            sp_s.step()
+            sp_a.step()
+            _assert_cache_consistent(sp_a.cache)
+            steps += 1
+            if steps % 9 == 0:
+                # drain barrier: land the in-flight ring, then the whole
+                # accounting must be in lockstep with the sync run
+                sp_a.flush()
+                a, b = _cache_state(sp_s.cache), _cache_state(sp_a.cache)
+                for key in a:
+                    if isinstance(a[key], np.ndarray):
+                        np.testing.assert_array_equal(
+                            a[key], b[key], err_msg=f"{key} ({source})")
+                    else:
+                        assert a[key] == b[key], (
+                            f"{key} diverged at step {steps} ({source})")
+            assert steps < 20000, "churn stuck"
+        sp_a.flush()
+        # a real churn (the model source legitimately needs FEWER steps:
+        # ~3.8 accepted tokens per lane-step on this workload)
+        assert steps >= min_steps
+        for i, (w, g) in enumerate(zip(reqs_s, reqs_a)):
+            assert g.output_ids == w.output_ids, (
+                f"request {i} diverged ({source})")
+        # identical speculation economics, one executable each
+        assert sp_a.accepted_tokens_per_step == pytest.approx(
+            sp_s.accepted_tokens_per_step)
+        assert sp_a.spec_proposed == sp_s.spec_proposed
+        assert sp_a.decode_trace_count == 1
+        # the async engine really dispatched ahead (behind-by-one or
+        # deferred) instead of forcing depth-zero reconciles
+        assert sp_a.telemetry()["serving_spec_async_deferred_steps"] > 0
+        assert sp_s.telemetry()["serving_spec_async_deferred_steps"] == 0
+
+
+def test_model_draft_quantized_int8w_int8kv_identical_to_plain(rng):
+    """int8 weights + int8 KV with MODEL drafts: the draft pool
+    quantizes-on-write like the main pool, and within the quantized
+    config speculation stays BIT-exact against the plain int8
+    predictor (the accept rule compares the quantized model to
+    itself)."""
+    model = _tiny_model()
+    prompts = [rng.randint(0, TINY["vocab_size"], (n,)).tolist()
+               for n in (9, 5, 13)]
+    model.config.weight_dtype = "int8"
+    model.config.kv_cache_dtype = "int8"
+    try:
+        kw = dict(max_batch=3, page_size=8, max_seq_len=64)
+        want = ServingPredictor(model, **kw).generate(prompts,
+                                                      max_new_tokens=8)
+        sp = ServingPredictor(model, spec_decode_k=3, draft_source="model",
+                              draft_layers=1, **kw)
+        got = sp.generate(prompts, max_new_tokens=8)
+        assert got == want
+        # the draft pool really is int8 (pools follow kv_cache_dtype)
+        assert sp._draft_engine.cache.k_pages.dtype == jnp.int8
+    finally:
+        model.config.weight_dtype = None
+        model.config.kv_cache_dtype = None
+
+
+def test_model_draft_mesh2_matches_plain(rng):
+    """mesh=2 SPMD serving with MODEL drafts: the truncated stacks
+    re-shard Megatron-style with the draft config (head-major qkv), the
+    draft pool head-shards like the main one, and emissions match the
+    plain mesh predictor token-for-token."""
+    _need_devices(2)
+    model = _tiny_model()
+    prompts = _churn_prompts(rng, 6, max_len=12)
+    kw = dict(max_batch=2, max_seq_len=48, page_size=8, chunk=8, mesh=2)
+    want = ServingPredictor(model, **kw).generate(prompts,
+                                                  max_new_tokens=6)
+    got = ServingPredictor(model, spec_decode_k=3, draft_source="model",
+                           draft_layers=1, **kw).generate(
+        prompts, max_new_tokens=6)
+    for w, g in zip(want, got):
+        assert g == w
+
+
+def test_model_draft_tiny_pool_stays_opportunistic(rng):
+    """A draft pool too small for every lane (draft_num_pages=4) evicts
+    idle draft lanes / skips proposing rather than failing — model
+    drafts are as opportunistic as the n-gram ones, and emissions stay
+    identical to plain decode throughout."""
+    model = _tiny_model()
+    prompts = [rng.randint(0, TINY["vocab_size"], (n,)).tolist()
+               for n in (11, 7, 9, 5)]
+    kw = dict(max_batch=3, max_seq_len=48, page_size=8, chunk=8)
+    want = ServingPredictor(model, **kw).generate(prompts,
+                                                  max_new_tokens=8)
+    sp = ServingPredictor(model, spec_decode_k=3, draft_source="model",
+                          draft_layers=1, draft_num_pages=4, **kw)
+    got = sp.generate(prompts, max_new_tokens=8)
+    assert got == want
+    assert sp._draft_engine.cache.num_pages == 4
+
+
+def test_model_draft_rejections_are_loud():
+    """Degenerate draft configs fail AT CONSTRUCTION with the real
+    cause: a full-depth 'draft' (draft_layers >= num_layers), a
+    depth-0 model source, an unknown source name, and a model source
+    with speculation off."""
+    model = _tiny_model()
+    kw = dict(max_batch=2, max_seq_len=48, page_size=8)
+    with pytest.raises(ValueError, match="num_layers"):
+        ServingPredictor(model, spec_decode_k=2, draft_source="model",
+                         draft_layers=TINY["num_layers"], **kw)
+    with pytest.raises(ValueError, match="num_layers"):
+        ServingPredictor(model, spec_decode_k=2, draft_source="model",
+                         draft_layers=TINY["num_layers"] + 3, **kw)
+    with pytest.raises(ValueError, match=">= 1"):
+        ServingPredictor(model, spec_decode_k=2, draft_source="model",
+                         draft_layers=0, **kw)
+    with pytest.raises(ValueError, match="draft_source"):
+        ServingPredictor(model, spec_decode_k=2, draft_source="eagle",
+                         **kw)
+    with pytest.raises(ValueError, match="spec_decode_k"):
+        ServingPredictor(model, draft_source="model", draft_layers=1,
+                         **kw)
+    # the config spelling routes the same way: spec_draft_layers > 0
+    # selects the model source and validates identically
+    model.config.spec_draft_layers = TINY["num_layers"]
+    try:
+        with pytest.raises(ValueError, match="num_layers"):
+            ServingPredictor(model, spec_decode_k=2, **kw)
+    finally:
+        model.config.spec_draft_layers = 0
+
+
+def test_draft_backoff_state_survives_preemption_replay(rng):
+    """Round-19 satellite regression: a preemption replay must RESUME
+    the proposer's adaptive backoff ((ema, cooldown) in
+    ServingPredictor._drafts) — not restart it from the optimistic
+    floor. Pinned for both sources by forcing a preempt/readmit around
+    a proposer parked mid-cooldown."""
+    model = _tiny_model()
+    for source, layers in (("ngram", None), ("model", 1)):
+        sp = ServingPredictor(model, max_batch=2, max_seq_len=48,
+                              page_size=8, chunk=8, spec_decode_k=4,
+                              draft_source=source, draft_layers=layers,
+                              async_engine=False)
+        reqs = [sp.add_request(
+            rng.randint(0, TINY["vocab_size"], (6,)).tolist(),
+            max_new_tokens=12) for _ in range(2)]
+        for _ in range(3):
+            sp.step()
+        victim = reqs[-1]
+        prop = sp._drafts.get(victim.req_id)
+        assert prop is not None, source
+        # park the proposer mid-backoff (rejections drove the EMA under
+        # the floor, two cooldown ticks spent)
+        prop._ema = 0.1
+        prop._cool = 2
+        assert prop.k == 0
+        sp._preempt_youngest()
+        assert victim.state == WAITING and victim.preempt_count == 1
+        seen_replay = False
+        while sp.has_work():
+            sp.step()
+            cur = sp._drafts.get(victim.req_id)
+            if cur is not None and victim.state == RUNNING:
+                # the replay serves the SAME proposer object with the
+                # parked backoff intact: the EMA stays at the parked
+                # 0.1 (the output budget is far too short to reach the
+                # retry_after=16 probe re-arm) and the cooldown only
+                # ever ACCUMULATES from its pre-preemption 2
+                assert cur is prop, f"proposer replaced on replay ({source})"
+                assert cur._ema == pytest.approx(0.1)
+                assert cur._cool >= 2
+                seen_replay = True
+        sp.flush()
+        assert seen_replay, source
+        assert all(r.state == FINISHED for r in reqs)
+
+
+def test_bench_serve_spec_model_leg_gates():
+    """The round-19 bench acceptance (via --legs, the tier-1 smoke
+    subset selector): on the NON-repetitive seeded-random churn the
+    model-draft leg actually speculates (``accepted_tokens_per_step >
+    1.0`` — the ROADMAP item-2 gate), keeps the async engine's
+    dispatch-ahead alive with spec_k > 0 (``step_gap_frac < 0.2``), and
+    emits greedy streams bit-identical to its interleaved n-gram
+    partner (two draft sources, one workload, one output)."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, "bench_serve.py", "--smoke", "--steps=6",
+         "--batch=2", "--prompt=8", "--gen-len=3",
+         "--legs=unified-spec-model"],
+        cwd=root, capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [l for l in proc.stdout.strip().splitlines() if l.strip()]
+    assert len(lines) == 1, proc.stdout
+    rec = json.loads(lines[0])
+    assert "error" not in rec, rec
+    assert rec["leg"] == "unified-spec-model"
+    assert rec["value"] > 0 and rec["ngram_tokens_per_s"] > 0
+    assert rec["decode_retraces"] == 1
+    # the ROADMAP item-2 acceptance gate, on the checked line
+    assert rec["accepted_tokens_per_step"] > 1.0
+    assert 0.0 < rec["draft_acceptance_rate"] <= 1.0
+    assert rec["step_gap_frac"] < 0.2
+    assert rec["spec_emissions_match"] == 1.0
+    assert 0.0 < rec["draft_overhead_frac"] < 1.0
+    # the engine + deferral telemetry is live on the line
+    tel = rec["telemetry"]
+    assert tel["serving_draft_model_steps"] > 0
+    assert tel["serving_draft_tokens_proposed{source=model}"] > 0
+    assert tel["serving_spec_async_deferred_steps"] > 0
