@@ -74,6 +74,18 @@ schema/contract as bench.py — the flagship quantized line LAST):
   between the prefix-affinity map and power-of-two-choices, and the
   bounded per-replica SLO sheds the flood (``shed_rate``).
 
+- ``transfer_bytes_per_token``/``prefill_fallback_count``/...: round 20
+  — the ``fleet-disagg`` leg runs a MIXED churn (short decode-bound
+  prompts + fresh multi-page longs) through a colocated 3-replica fleet
+  vs a 1-prefill + 2-decode disaggregated fleet, windows interleaved:
+  finished KV pages stream prefill -> decode over the checksummed
+  ``kv_transfer`` wire (int8 payloads + scale planes ~4x below the fp
+  partner's figure, per TRANSFERRED token), long-prompt TTFT p99 rides
+  the line against the colocated partner's, and a certainty-armed
+  ``transfer_drop`` chaos pass shows graceful colocated fallback
+  (``fault_free_fallback_count`` exactly 0; ``prefill_fallback_count``
+  > 0 after the pass) — degradation, never an outage.
+
 ``--smoke``: tiny CPU config — always runnable (CI leg, rc 0; gather
 reference attention keeps it fast, kernel parity is the test suite's
 job). Off-TPU without ``--smoke`` each leg emits a structured ``error``
@@ -460,7 +472,8 @@ class _FleetLeg:
 
     def __init__(self, *, hidden, layers, heads, vocab, batch, prompt,
                  gen_len, page_size, chunk, use_kernel, on_tpu,
-                 num_replicas=2, overload=3):
+                 num_replicas=2, overload=3, prefill_replicas=0,
+                 kv_cache_dtype=None, mixed=False, transfer=None):
         import jax.numpy as jnp
 
         import paddle_tpu as paddle
@@ -469,15 +482,34 @@ class _FleetLeg:
 
         self.batch, self.gen_len = batch, gen_len
         self.num_replicas = num_replicas
-        max_len = prompt + gen_len + 32
+        self.vocab = vocab
+        # round 20: mixed churn — mostly short decode-bound prompts with
+        # every 4th arrival a FRESH long (multi-page, partial-tail)
+        # prompt: the prefill-interference workload disaggregation
+        # exists for. Fresh longs keep real prefill work recurring (a
+        # repeated long would serve from the prefix cache on both
+        # sides); the dedicated long-prompt RNG makes the interleaved
+        # colocated/disaggregated legs draw IDENTICAL arrival sequences.
+        self.mixed = bool(mixed)
+        self.long_len = 2 * prompt + max(1, page_size // 2)
+        self._long_rng = np.random.RandomState(7)
+        # live long prompts are capped at one replica's lane count so
+        # the dedicated prefill replica always has headroom — the
+        # fault-free zero-fallback gate must measure the wire, not a
+        # saturated prefill queue (the colocated partner runs the same
+        # cap: same long pressure on both legs)
+        self._long_reqs = []
+        max_len = ((self.long_len if mixed else prompt) + gen_len + 32)
         paddle.seed(0)
         cfg = GPTConfig(vocab_size=vocab, hidden_size=hidden,
                         num_layers=layers, num_heads=heads,
-                        max_seq_len=max_len)
+                        max_seq_len=max_len,
+                        kv_cache_dtype=kv_cache_dtype)
         model = GPTForCausalLM(cfg)
         model.eval()
         self.router = FleetRouter(
             model, num_replicas=num_replicas, seed=0,
+            prefill_replicas=prefill_replicas, transfer=transfer,
             replica_kw=dict(
                 max_batch=batch, page_size=page_size, max_seq_len=max_len,
                 use_kernel=use_kernel, chunk=chunk,
@@ -485,12 +517,14 @@ class _FleetLeg:
                 # the bounded queue makes the flood shed deterministically
                 slo=SLOConfig(max_waiting=batch + 2)))
         rng = np.random.RandomState(0)
-        self.pool = [rng.randint(0, vocab, (prompt,))
+        self.pool = [rng.randint(0, vocab, (max(2, prompt // 2)
+                                            if mixed else prompt,))
                      for _ in range(max(2, batch // 2))]
         self.arrivals = 0
         self.reqs = []
         self.target_live = num_replicas * batch * overload
         self.win_vals = []
+        self.timed_from = 0
 
     def _tokens_total(self):
         return sum(v for k, v in self.router.telemetry().items()
@@ -501,14 +535,22 @@ class _FleetLeg:
         # back terminal instantly and must not resubmit unboundedly
         live = sum(1 for r in self.reqs
                    if r.state not in ("finished", "failed"))
+        live_longs = sum(1 for r in self._long_reqs
+                         if r.state not in ("finished", "failed"))
         for _ in range(self.target_live):
             if live >= self.target_live:
                 break
-            r = self.router.submit(
-                self.pool[self.arrivals % len(self.pool)],
-                max_new_tokens=self.gen_len)
+            take_long = (self.mixed and self.arrivals % 4 == 3
+                         and live_longs < self.batch)
+            p = (self._long_rng.randint(0, self.vocab, (self.long_len,))
+                 if take_long
+                 else self.pool[self.arrivals % len(self.pool)])
+            r = self.router.submit(p, max_new_tokens=self.gen_len)
             self.reqs.append(r)
             self.arrivals += 1
+            if take_long:
+                self._long_reqs.append(r)
+                live_longs += 1
             if r.state != "failed":
                 live += 1
 
@@ -524,8 +566,9 @@ class _FleetLeg:
             if ticks > 10000:
                 raise RuntimeError("fleet warmup stuck")
         self.router.flush()
+        self.timed_from = len(self.reqs)
 
-    def window(self, steps):
+    def window(self, steps, record=True):
         t0 = time.perf_counter()
         w_tokens = self._tokens_total()
         for _ in range(steps):
@@ -533,7 +576,28 @@ class _FleetLeg:
             self.router.tick()
         self.router.flush()
         dw = time.perf_counter() - t0
-        self.win_vals.append((self._tokens_total() - w_tokens) / dw)
+        if record:
+            self.win_vals.append((self._tokens_total() - w_tokens) / dw)
+
+    def ttft_ms(self, longs_only=False, upto=None):
+        """Fleet-side TTFTs (ms) of the timed-phase submissions (falls
+        back to the whole run when a short window admitted none).
+        ``longs_only`` restricts to the long-prompt arrivals — the
+        prefill-INTERFERED class whose tail the disagg leg compares
+        (short decode-bound prompts see the same decode queues either
+        way; the long prompts are where colocated prefill competes with
+        decode for the budget and the lanes). ``upto`` is an arrival-
+        index cutoff: the disagg leg passes its pre-chaos request count
+        so chaos-degraded arrivals never pollute the fault-free TTFT
+        comparison."""
+        def pick(rs):
+            if longs_only:
+                ids = {id(r) for r in self._long_reqs}
+                rs = [r for r in rs if id(r) in ids]
+            return [r.ttft * 1e3 for r in rs if r.ttft is not None]
+
+        return (pick(self.reqs[self.timed_from:upto])
+                or pick(self.reqs[:upto]))
 
     def report(self):
         flat = self.router.telemetry()
@@ -569,6 +633,97 @@ def bench_serving_fleet(*, steps, windows, **leg_kw):
                 if w == 0:
                     leg.router.kill_replica(0, reason="bench_churn")
     return leg.report()
+
+
+def bench_serving_disagg(*, steps, windows, **leg_kw):
+    """The round-20 disaggregated prefill/decode leg: the SAME
+    mixed-churn workload (short decode-bound prompts + fresh multi-page
+    longs every 4th arrival) through a colocated 3-replica fleet vs a
+    1-prefill + 2-decode disaggregated fleet, windows interleaved so
+    machine drift hits both alike — the TTFT-tail workload
+    disaggregation exists for. Both fleets serve int8-KV (the EQuARX-
+    style wire thrift: page payloads 4x cheaper than fp); a short fp
+    partner run supplies the fp wire figure for the ratio. After the
+    fault-free windows (``fault_free_fallback_count`` must be exactly
+    0), a chaos pass arms ``transfer_drop`` at certainty — every
+    transfer exhausts its retries and every affected request DEGRADES
+    to colocated prefill (``prefill_fallback_count > 0``) while the
+    fleet keeps serving: graceful degradation on display, not an
+    outage. Returns ``(colo_out, disagg_out)`` — the partner keys ride
+    the disagg dict."""
+    from paddle_tpu.inference import FaultPlan, TransferConfig
+
+    # tight wire knobs: a failed frame must resolve within the smoke
+    # window (retries are the chaos pass's business, not the gate's)
+    tcfg = TransferConfig(window=4, max_retries=1, timeout_ticks=1)
+    # overload=2 floods the DECODE side (colocated long prompts queue
+    # behind it — the interference the leg measures) while the live
+    # long-prompt cap in _FleetLeg.top_up keeps the dedicated prefill
+    # replica inside its admission bounds, so the fault-free window's
+    # zero-fallback gate never trips on a capacity race (the full-flood
+    # shed exercise is the fleet-churn leg's job)
+    common = dict(num_replicas=3, overload=2, mixed=True,
+                  kv_cache_dtype="int8", **leg_kw)
+    colo = _FleetLeg(prefill_replicas=0, **common)
+    disagg = _FleetLeg(prefill_replicas=1, transfer=tcfg, **common)
+    fp = _FleetLeg(prefill_replicas=1, transfer=tcfg,
+                   **dict(common, kv_cache_dtype=None))
+    colo.warm()
+    disagg.warm()
+    fp.warm()
+    with _gc_frozen():
+        for _ in range(windows):
+            colo.window(steps)
+            disagg.window(steps)
+        fp.window(steps)
+        ff = disagg.router.telemetry()
+        # pre-chaos arrival cutoff: the TTFT population must be
+        # fault-free (same reason the wire bytes snapshot above it is)
+        ff_reqs = len(disagg.reqs)
+        # the chaos pass: certainty-armed frame loss — bounded repeats
+        # until a transfer actually opened and degraded (a tiny window
+        # may admit no long prompt); NOT recorded into the medians
+        with FaultPlan(seed=11, transfer_drop=1.0):
+            for _ in range(6):
+                disagg.window(steps, record=False)
+                flat = disagg.router.telemetry()
+                if (flat["fleet_prefill_fallbacks"]
+                        > ff["fleet_prefill_fallbacks"]):
+                    break
+    colo_out = colo.report()
+    out = disagg.report()
+    flat = disagg.router.telemetry()   # post-chaos totals
+    # the TTFT pair compares the INTERFERED class: long-prompt p99 —
+    # colocated longs share their replica's budget and queue with the
+    # decode flood; disaggregated longs prefill on the dedicated
+    # replica (short prompts see the same decode queues either way)
+    out["ttft_p50_ms"] = round(
+        _percentile(disagg.ttft_ms(longs_only=True, upto=ff_reqs), 50), 2)
+    out["ttft_p99_ms"] = round(
+        _percentile(disagg.ttft_ms(longs_only=True, upto=ff_reqs), 99), 2)
+    out["colocated_tokens_per_s"] = colo_out["value"]
+    out["colocated_ttft_p99_ms"] = round(
+        _percentile(colo.ttft_ms(longs_only=True), 99), 2)
+    out["vs_baseline"] = (round(out["value"] / colo_out["value"], 3)
+                          if colo_out["value"] else 0.0)
+    # wire thrift: bytes per TRANSFERRED KV token (frames + headers
+    # over the tokens their acked frames landed) — invariant to run
+    # length and scheduling, so the fp/int8 ratio is the per-token
+    # frame cost itself (~4x at head_dim 64; 3.1x at the smoke's
+    # head_dim 16, the fp32 scale planes being the difference).
+    # Snapshotted pre-chaos: retransmitted bytes must not skew it.
+    out["transfer_bytes_per_token"] = round(
+        ff["fleet_kv_transfer_bytes"]
+        / max(1.0, ff["fleet_kv_transfer_tokens"]), 1)
+    fp_flat = fp.router.telemetry()
+    out["fp_transfer_bytes_per_token"] = round(
+        fp_flat["fleet_kv_transfer_bytes"]
+        / max(1.0, fp_flat["fleet_kv_transfer_tokens"]), 1)
+    out["kv_transfer_retries"] = int(flat["fleet_kv_transfer_retries"])
+    out["prefill_fallback_count"] = int(flat["fleet_prefill_fallbacks"])
+    out["fault_free_fallback_count"] = int(ff["fleet_prefill_fallbacks"])
+    out["telemetry"] = flat
+    return colo_out, out
 
 
 def bench_serving_overload(*, steps, windows, **leg_kw):
@@ -847,6 +1002,13 @@ def main():
         # seeded stalls) — per-replica tokens/s, affinity hit rate,
         # failover and shed accounting on the checked line
         ("fleet-churn", None),
+        # round-20 disaggregation A/B: the SAME mixed churn (short
+        # decode-bound prompts + fresh multi-page longs) through a
+        # colocated fleet vs 1-prefill + 2-decode with checksummed
+        # KV-page streaming (int8 payloads + scale planes), measured
+        # interleaved; a certainty-armed transfer_drop chaos pass shows
+        # graceful colocated fallback on the same line
+        ("fleet-disagg", None),
         # round-16 A/B: the SAME int8w+int8kv churn with the decode hot
         # loop per-op vs megakernelized (fused per-layer Pallas kernels,
         # activations pinned in VMEM) — measured interleaved, greedy
@@ -973,6 +1135,15 @@ def main():
                     steps=shape["steps"], windows=2,
                     **{k: v for k, v in shape.items() if k != "steps"})
                 results[name] = dict(metric=metric_for(name), **out)
+            elif name == "fleet-disagg":
+                _colo_out, out = bench_serving_disagg(
+                    on_tpu=on_tpu, use_kernel=use_kernel,
+                    steps=shape["steps"], windows=2,
+                    **{k: v for k, v in shape.items() if k != "steps"})
+                # the colocated partner's throughput/TTFT already ride
+                # the disagg line (colocated_* keys; vs_baseline is
+                # disagg/colocated on the interleaved pair)
+                results[name] = dict(metric=metric_for(name), **out)
             elif name == "unified-obs":
                 off_out, on_out, ratio = bench_serving_obs_ab(
                     unified=True, on_tpu=on_tpu, use_kernel=use_kernel,
@@ -1050,6 +1221,10 @@ def main():
     # round-18 fleet leg (no baseline partner: a one-replica fleet IS
     # the unified-step leg — the line's value is fleet-aggregate)
     _emit("fleet-churn", None)
+    # round-20 disaggregation leg (self-baselined on its interleaved
+    # colocated partner: vs_baseline = disagg/colocated tokens/s on the
+    # SAME mixed churn; the TTFT-p99 pair is the headline comparison)
+    _emit("fleet-disagg", None)
     # round-16 flagship LAST: the megakernelized int8w+int8kv decode A/B
     # (self-baselined on its interleaved mega-off partner)
     _emit("unified-mega", None)

@@ -88,6 +88,20 @@ _OPTIONAL_NUMERIC = ("vs_baseline", "p50_ms", "p99_ms", "anchor_tflops",
                      # tokens/s stays live through them)
                      "tokens_per_s_per_replica", "affinity_hit_rate",
                      "failover_count",
+                     # round 20: the disaggregated prefill/decode leg —
+                     # wire bytes per emitted token over the fault-free
+                     # windows (int8-KV payloads + scale planes; the fp
+                     # partner's figure rides the line for the ~4x wire
+                     #-thrift ratio), frame retransmits, colocated-
+                     # fallback degradations (the fault-free figure must
+                     # be exactly 0; the chaos-window total must not
+                     # be), and the interleaved colocated partner's
+                     # throughput/TTFT the no-worse gates compare
+                     # against
+                     "transfer_bytes_per_token",
+                     "fp_transfer_bytes_per_token", "kv_transfer_retries",
+                     "prefill_fallback_count", "fault_free_fallback_count",
+                     "colocated_tokens_per_s", "colocated_ttft_p99_ms",
                      # round 19: the model-draft speculative leg — the
                      # fraction of step() wall time the truncated-layer
                      # draft pass costs, the interleaved n-gram partner's
@@ -109,7 +123,7 @@ KNOWN_LEGS = frozenset((
     "legacy-two-jit", "unified-step", "unified-async", "unified-obs",
     "unified-spmd", "unified-spec-base", "unified-spec-k4",
     "unified-spec-model", "unified-int8w", "unified-int8w-int8kv",
-    "unified-mega", "unified-overload", "fleet-churn",
+    "unified-mega", "unified-overload", "fleet-churn", "fleet-disagg",
 ))
 
 

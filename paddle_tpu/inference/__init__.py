@@ -177,6 +177,10 @@ def __getattr__(name):
             # round-18 fleet layer: router + fleet-side request handle
             "FleetRouter": ".fleet_serving",
             "FleetRequest": ".fleet_serving",
+            # round-20 disaggregated prefill/decode KV-page wire
+            "KVPageTransfer": ".kv_transfer",
+            "TransferConfig": ".kv_transfer",
+            "FrameError": ".kv_transfer",
             # round-17 resilience layer: SLO shedding + fault injection
             "SLOConfig": ".serving",
             "FaultPlan": ".faults",
@@ -201,6 +205,7 @@ __all__ = ["Config", "Predictor", "Tensor_", "create_predictor",
            "get_version", "PrecisionType", "PlaceType",
            "ServingPredictor", "Request", "KVCacheManager",
            "FleetRouter", "FleetRequest",
+           "KVPageTransfer", "TransferConfig", "FrameError",
            "SLOConfig", "FaultPlan", "InjectedFault",
            "DraftProposer", "ModelDraftProposer", "ModelDraftEngine",
            "quantize_serving_params", "quantize_weight",

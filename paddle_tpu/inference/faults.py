@@ -45,6 +45,19 @@ once per replica per tick):
   applies the stall (skips the replica's step) and its health gate
   observes it through the stale ``snapshot_age_s`` stamp.
 
+Round 20 adds the KV TRANSFER WIRE seams (``inference/kv_transfer.py``
+hits them once per frame put on the wire — fresh sends AND
+retransmits):
+
+- ``transfer_drop`` — a RETURNING seam: a fired hit returns ``True``
+  and the sender treats the frame as lost in flight (no delivery, no
+  ack — the per-frame timeout + exponential backoff own recovery).
+- ``transfer_corrupt`` — a RETURNING seam: a fired hit returns ``True``
+  and the sender flips a byte of the ENCODED wire bytes before
+  delivery. The corruption MUST be caught by the frame checksum at the
+  receiver (detected -> nack -> retransmit), never silently ingested —
+  the contract tests/test_kv_transfer.py locks.
+
 Raising seams model CRASHES, so they raise **before** the operation they
 name (a half-applied operation is the scheduler's job to make
 impossible, not the plan's). ``plan.fired`` counts firings per seam for
@@ -62,7 +75,8 @@ __all__ = ["FaultPlan", "InjectedFault", "SEAMS", "active_plan",
 
 #: the named seams a plan may arm (a typo'd rate kwarg fails at __init__)
 SEAMS = ("pool", "h2d", "dispatch", "slow_step", "reconcile",
-         "replica_crash", "replica_stall")
+         "replica_crash", "replica_stall", "transfer_drop",
+         "transfer_corrupt")
 
 #: the armed plan; None = disarmed (the zero-cost fast path)
 _PLAN: "FaultPlan | None" = None
@@ -108,11 +122,15 @@ class FaultPlan:
                  slow_step: float = 0.0, slow_step_s: float = 0.001,
                  pool_squeeze: float = 0.0, squeeze_pages: int = 2,
                  squeeze_steps: int = 2, replica_crash: float = 0.0,
-                 replica_stall: float = 0.0, stall_ticks: int = 2):
+                 replica_stall: float = 0.0, stall_ticks: int = 2,
+                 transfer_drop: float = 0.0,
+                 transfer_corrupt: float = 0.0):
         rates = {"dispatch": dispatch, "h2d": h2d, "reconcile": reconcile,
                  "slow_step": slow_step, "pool": pool_squeeze,
                  "replica_crash": replica_crash,
-                 "replica_stall": replica_stall}
+                 "replica_stall": replica_stall,
+                 "transfer_drop": transfer_drop,
+                 "transfer_corrupt": transfer_corrupt}
         for name, p in rates.items():
             if not 0.0 <= float(p) <= 1.0:
                 raise ValueError(f"{name} rate must be in [0, 1], got {p}")
@@ -174,12 +192,20 @@ class FaultPlan:
                 time.sleep(self.slow_step_s)
             return
         if seam == "replica_stall":
-            # the one RETURNING seam: the caller (the fleet router)
-            # applies the stall — this plan only schedules it
+            # a RETURNING seam: the caller (the fleet router) applies
+            # the stall — this plan only schedules it
             if self.rates["replica_stall"] \
                     and self.rng.rand() < self.rates["replica_stall"]:
                 self.fired["replica_stall"] += 1
                 return self.stall_ticks
+            return None
+        if seam in ("transfer_drop", "transfer_corrupt"):
+            # RETURNING seams: the transfer layer applies the loss /
+            # byte-flip to its own wire bytes (a corrupt frame must
+            # reach the receiver so the checksum DETECTS it)
+            if self.rates[seam] and self.rng.rand() < self.rates[seam]:
+                self.fired[seam] += 1
+                return True
             return None
         if seam not in self.rates:
             raise ValueError(f"unknown fault seam {seam!r} "

@@ -57,16 +57,50 @@ Failover (the crash-consistent half):
   (its pages are gone, so its affinity-map entries are purged — routed
   prefixes rebuild warmth organically).
 
+Disaggregated prefill/decode (round 20, ``prefill_replicas > 0``):
+
+- **Roles.** The first ``prefill_replicas`` slots run PREFILL-role
+  replicas; the rest are DECODE-role. A fresh submission whose prompt
+  spans at least one page lands on the least-loaded healthy prefill
+  replica first (p2c scored on the healthz load signals + the
+  sender-side ``transfer_backlog``), runs its prompt through the
+  ordinary unified step as a 1-token request (prefill chunks + the
+  first generated token), and its registered prompt pages then STREAM
+  to the decode replica the prefix-affinity map names — the replica
+  that will keep serving that prefix — over the
+  ``inference/kv_transfer.py`` wire: checksummed chain-key-addressed
+  frames (int8-KV payloads ride with their fp32 scale planes; partial
+  tails included), a bounded in-flight window, per-frame timeout +
+  exponential backoff + bounded retries, idempotent receive. The
+  decode admission's ``admit_prefix`` walk pins the imported pages
+  exactly like locally-prefilled ones, so the decode replica never
+  re-runs the prompt; its seeded sample stream continues bit-identically
+  through the handoff (``add_request(sample_offset=)``).
+- **Graceful degradation** — the headline robustness property: if no
+  healthy prefill replica exists, the transfer exhausts its retries, a
+  checksum fails terminally, the receiver has no free page, or either
+  endpoint replica dies mid-stream, the request falls back to
+  COLOCATED prefill on the decode fleet (today's path) — counted
+  (``fleet_prefill_fallbacks``), never failed, and never charged
+  against the failover budget: disaggregation existing must never cost
+  a request its life. A FAILED transfer unwinds every page it imported,
+  so the decode-side accounting (free lists, refcounts, LRU, scale
+  planes) is indistinguishable from a colocated run after ANY fault.
+- With ``prefill_replicas=0`` (the default) every replica is
+  colocated-role and the router is bit-identical to round 18.
+
 The chaos gate (tests/test_fleet_serving.py) extends round 17's
 discipline to the fleet: a >= 1k-tick multi-replica churn with the
 ``replica_crash`` / ``replica_stall`` seams armed
-(``inference/faults.py``) where after EVERY tick the fleet-wide
-invariant holds — submitted == finished + failed + live, every request
-ends terminal exactly once, no token emitted twice, no request lost —
-and with faults disarmed a single-replica fleet is bit-identical to a
-bare ``ServingPredictor``. Prefill/decode disaggregation (streaming KV
-pages between dedicated prefill and decode replicas) stays explicitly
-out of scope for a follow-up PR.
+(``inference/faults.py``) — and, disaggregated, the ``transfer_drop``
+/ ``transfer_corrupt`` wire seams on top — where after EVERY tick the
+fleet-wide invariant holds — submitted == finished + failed + live,
+every request ends terminal exactly once, no token emitted twice, no
+request lost, every FINISHED stream bit-identical to a fault-free
+COLOCATED mirror — and with faults disarmed a single-replica fleet is
+bit-identical to a bare ``ServingPredictor`` (and a disaggregated
+fleet's emissions bit-identical, greedy and seeded-sampled, to the
+colocated fleet's).
 """
 from __future__ import annotations
 
@@ -77,6 +111,9 @@ import numpy as np
 from ..observability import FleetInstruments, monotonic, span
 from .faults import fault_point
 from .kv_cache import prompt_chain_keys
+from .kv_transfer import DONE as T_DONE
+from .kv_transfer import SENDING as T_SENDING
+from .kv_transfer import KVPageTransfer, TransferConfig
 from .serving import (FAILED, FINISHED, RUNNING, WAITING, ServingPredictor,
                       deadline_passed, stream_done)
 
@@ -126,6 +163,31 @@ class FleetRequest:
         self.replica_id: int | None = None   # current placement
         self.failover_count = 0
         self._inner = None                   # current inner Request
+        # round 20 (disaggregation): the request's pipeline phase —
+        # ``None`` on a colocated fleet (and for sub-page prompts that
+        # never disaggregate), else "prefill" (running on a
+        # prefill-role replica) -> "transfer" (KV pages streaming) ->
+        # "decode" (on a decode replica; also the forced state after a
+        # fallback — a degraded request never re-enters the prefill
+        # stage). ``first_token_time`` stamps the first RECEIVED token
+        # (the fleet-side TTFT the disagg bench leg gates).
+        self.phase: str | None = None
+        self.decode_rid: int | None = None
+        self._transfer = None
+        self.first_token_time: float | None = None
+        # True once a prefill-role replica actually accepted this
+        # request's prefill stage: from then on the fleet has spent
+        # work on it, so later routing failures queue it instead of
+        # shedding it (a submit-time degradation spent nothing and
+        # stays shed-able — colocated-fleet parity under flood)
+        self.prefill_spent = False
+
+    @property
+    def ttft(self) -> float | None:
+        """Seconds from fleet submission to the first received token."""
+        if self.first_token_time is None:
+            return None
+        return self.first_token_time - self.submit_time
 
     @property
     def done(self) -> bool:
@@ -178,11 +240,28 @@ class FleetRouter:
     def __init__(self, model, num_replicas=2, *, seed=0, max_failovers=2,
                  stale_after_s=5.0, dead_stall_ticks=4, restart_ticks=1,
                  max_affinity_entries=1 << 16, metrics=None,
-                 replica_kw=None):
+                 replica_kw=None, prefill_replicas=0, transfer=None,
+                 min_transfer_tokens=None):
         self.num_replicas = int(num_replicas)
         if self.num_replicas < 1:
             raise ValueError(f"num_replicas must be >= 1, "
                              f"got {num_replicas}")
+        # round 20: disaggregation — the first ``prefill_replicas``
+        # slots take the prefill role; at least one decode replica must
+        # remain (the decode fleet IS the fallback path, and a fleet
+        # that can only prefill can never finish a request)
+        self.prefill_replicas = int(prefill_replicas)
+        if not 0 <= self.prefill_replicas < self.num_replicas:
+            raise ValueError(
+                f"prefill_replicas must be in [0, num_replicas), got "
+                f"{prefill_replicas} of {num_replicas} (at least one "
+                "decode replica must remain — it is the fallback path)")
+        if transfer is not None and not isinstance(transfer,
+                                                   TransferConfig):
+            raise ValueError(f"transfer must be a TransferConfig or "
+                             f"None, got {type(transfer).__name__}")
+        self.transfer_cfg = (transfer if transfer is not None
+                             else TransferConfig())
         self.max_failovers = int(max_failovers)
         if self.max_failovers < 0:
             raise ValueError(f"max_failovers must be >= 0, "
@@ -203,6 +282,9 @@ class FleetRouter:
         self._replica_kw = dict(replica_kw or {})
         if "replica_id" in self._replica_kw:
             raise ValueError("replica_id is assigned by the router")
+        if "role" in self._replica_kw:
+            raise ValueError("role is assigned by the router "
+                             "(prefill_replicas= decides the split)")
         # routing randomness (the two p2c probes) is seeded: a fleet run
         # is replayable from (seed, submission order, fault plan)
         self._rng = np.random.RandomState(seed)
@@ -219,6 +301,13 @@ class FleetRouter:
                          for rid in range(self.num_replicas)]
         self.page_size = self.replicas[0].sp.cache.page_size
         self.max_seq_len = self.replicas[0].sp.max_seq_len
+        # prompts below one page have no chain-key identity — nothing
+        # addressable to transfer; they serve colocated even when
+        # disaggregated (min_transfer_tokens may raise the bar further)
+        self.min_transfer_tokens = max(
+            self.page_size, int(min_transfer_tokens or 0))
+        #: live KV-page streams: (transfer, fleet request, affinity hit)
+        self._transfers: list[tuple] = []
         #: chain key -> replica id (the prefix-affinity map): insertion-
         #: ordered with re-registration refreshing recency, bounded by
         #: ``max_affinity_entries`` (oldest evicted — a cold entry only
@@ -238,9 +327,29 @@ class FleetRouter:
 
     # -- construction / lifecycle ------------------------------------------
 
+    def role_for(self, rid: int) -> str:
+        """The fleet role of slot ``rid`` — a property of the SLOT, not
+        the predictor instance, so a supervisor restart respawns the
+        same role into the same slot."""
+        if not self.prefill_replicas:
+            return "colocated"
+        return "prefill" if rid < self.prefill_replicas else "decode"
+
     def _spawn(self, rid: int) -> ServingPredictor:
         return ServingPredictor(self._model, replica_id=rid,
+                                role=self.role_for(rid),
                                 **self._replica_kw)
+
+    def _decode_reps(self) -> list[_Replica]:
+        """The replicas user submissions decode on (every replica when
+        colocated) — the ONLY replicas the affinity map and the p2c
+        fallback ever name."""
+        return [r for r in self.replicas
+                if self.role_for(r.rid) != "prefill"]
+
+    def _prefill_reps(self) -> list[_Replica]:
+        return [r for r in self.replicas
+                if self.role_for(r.rid) == "prefill"]
 
     def _rep(self, rid: int) -> _Replica:
         for rep in self.replicas:
@@ -284,32 +393,61 @@ class FleetRouter:
                 + 0.25 * hz["inflight_steps"]
                 + 0.001 * hz["ttft_p99_ema_ms"])
 
+    def _affinity_walk(self, keys, ok, exclude=()):
+        """THE deepest-chain-key-wins affinity walk (longest shared
+        prefix decides the replica), shared by decode placement and
+        transfer-destination picks so the two can never diverge on
+        affinity semantics; ``ok`` is the caller's per-replica
+        eligibility predicate. None on no eligible registered key."""
+        for k in reversed(keys):
+            rid = self._affinity.get(k)
+            if rid is not None and rid not in exclude:
+                rep = self._rep(rid)
+                if ok(rep):
+                    return rep
+        return None
+
     def _pick_replica(self, keys, exclude=()):
         """(replica, affinity_hit) for one placement given the context's
         chain keys; replica is None when nothing admittable exists.
         Affinity first — DEEPEST registered chain key wins (longest
         shared prefix) — then two seeded candidates scored by load."""
-        for k in reversed(keys):
-            rid = self._affinity.get(k)
-            if rid is not None and rid not in exclude:
-                rep = self._rep(rid)
-                if self._admittable(rep):
-                    return rep, True
-        cands = [r for r in self.replicas
+        rep = self._affinity_walk(keys, self._admittable, exclude)
+        if rep is not None:
+            return rep, True
+        cands = [r for r in self._decode_reps()
                  if r.rid not in exclude and self._admittable(r)]
+        return self._p2c(cands, self._load_score), False
+
+    def _p2c(self, cands, score):
+        """THE power-of-two-choices draw (two seeded candidates, lower
+        score wins, rid tie-break), shared by decode and prefill picks
+        so the sampling policy can never diverge. None on no
+        candidates."""
         if not cands:
-            return None, False
+            return None
         if len(cands) > 2:
             i, j = self._rng.choice(len(cands), size=2, replace=False)
             cands = [cands[int(i)], cands[int(j)]]
-        rep = min(cands, key=lambda r: (self._load_score(r), r.rid))
-        return rep, False
+        return min(cands, key=lambda r: (score(r), r.rid))
 
     def _healthy_verdicts(self):
         """The shed decision's evidence: the admission verdicts of every
-        HEALTHY, un-stalled replica (None entries mean 'would admit')."""
-        return [r.sp.admission_verdict() for r in self.replicas
+        HEALTHY, un-stalled DECODE replica (None entries mean 'would
+        admit') — prefill replicas never hold user submissions, so
+        their SLOs never decide a fleet shed."""
+        return [r.sp.admission_verdict() for r in self._decode_reps()
                 if r.state == HEALTHY and r.stall_ticks == 0]
+
+    def _pick_prefill(self):
+        """The least-loaded healthy prefill replica (p2c like the
+        decode fallback, with the sender-side transfer backlog as an
+        extra penalty — a replica still streaming pages out is a worse
+        place for new prefill work); None when no prefill replica can
+        admit (the colocated-fallback cue)."""
+        cands = [r for r in self._prefill_reps() if self._admittable(r)]
+        return self._p2c(cands, lambda r: (
+            self._load_score(r) + 0.1 * r.sp.transfer_backlog))
 
     def _try_route(self, freq: FleetRequest) -> bool:
         """Place one request (initial submit or failover re-admit).
@@ -317,6 +455,17 @@ class FleetRouter:
         queued at the router (no healthy capacity — transient) or
         terminally shed (healthy replicas exist but every one of them
         sheds — fleet backpressure, not an outage)."""
+        # round 20: a fresh page-spanning submission on a disaggregated
+        # fleet prefills on a dedicated prefill replica first; if no
+        # prefill replica can admit RIGHT NOW, it degrades to colocated
+        # prefill on the decode fleet immediately (counted as a
+        # fallback) — disaggregation may never delay or fail a request
+        if self._wants_disagg(freq):
+            prep = self._pick_prefill()
+            if prep is not None and self._admit_prefill_on(freq, prep):
+                return True
+            freq.phase = "decode"
+            self.inst.prefill_fallbacks.inc()
         # the context (and so its chain keys) is fixed for the whole
         # placement attempt: hash once, not per race-retry iteration
         keys = prompt_chain_keys(freq.prompt_ids + freq.output_ids,
@@ -328,11 +477,17 @@ class FleetRouter:
                 verdicts = self._healthy_verdicts()
                 # SLO shedding is backpressure on NEW ARRIVALS: a
                 # request the fleet already accepted (a failover victim,
-                # or anything with received tokens) queues through the
-                # transient instead — discarding accepted in-flight
-                # work because a crash landed during a backlog spike
-                # would turn one replica's failure into request loss
-                fresh = freq.failover_count == 0 and not freq.output_ids
+                # anything with received tokens, or a round-20 fallback
+                # the fleet already spent PREFILL work on) queues
+                # through the transient instead — discarding accepted
+                # in-flight work because a crash landed during a
+                # backlog spike would turn one replica's failure into
+                # request loss. A submit-time disagg degradation spent
+                # nothing yet and stays shed-able (colocated parity —
+                # the unrouted queue must not grow unboundedly under a
+                # flood just because prefill capacity was busy).
+                fresh = (freq.failover_count == 0 and not freq.output_ids
+                         and not freq.prefill_spent)
                 if (fresh and verdicts
                         and all(v is not None for v in verdicts)):
                     self.inst.shed.inc()
@@ -349,6 +504,39 @@ class FleetRouter:
             # inner SLO shed it): try the other replicas before queueing
             exclude.add(rep.rid)
 
+    def _wants_disagg(self, freq: FleetRequest) -> bool:
+        """Is this placement the prefill stage of a disaggregated
+        request? Only a FRESH first placement qualifies: failover
+        victims, fallbacks (phase forced to "decode") and sub-page
+        prompts (no chain-key identity to address frames by) all serve
+        colocated."""
+        return (self.prefill_replicas > 0 and freq.phase is None
+                and not freq.output_ids
+                and len(freq.prompt_ids) >= self.min_transfer_tokens)
+
+    def _admit_prefill_on(self, freq: FleetRequest, rep: _Replica) -> bool:
+        """Place the PREFILL stage: a 1-token inner request (prefill
+        chunks + the first generated token) on a prefill-role replica.
+        The handoff to the decode fleet happens when it finishes
+        (:meth:`_handoff`); prefill placements never register affinity
+        entries — the map names only replicas that will keep serving
+        the prefix."""
+        inner = rep.sp.add_request(
+            freq.prompt_ids, 1, freq.eos_token_id,
+            temperature=freq.temperature, top_k=freq.top_k,
+            top_p=freq.top_p, seed=freq.seed,
+            deadline_s=freq.deadline_s, submit_time=freq.submit_time)
+        if inner.state == FAILED:
+            return False
+        freq._inner = inner
+        freq.replica_id = rep.rid
+        freq.state = RUNNING
+        freq.phase = "prefill"
+        freq.prefill_spent = True
+        rep.by_inner[inner.req_id] = freq
+        self.inst.prefill_routed.inc()
+        return True
+
     def _admit_on(self, freq: FleetRequest, rep: _Replica, keys,
                   hit: bool) -> bool:
         remaining = freq.max_new_tokens - len(freq.output_ids)
@@ -356,12 +544,19 @@ class FleetRouter:
             freq.prompt_ids + freq.output_ids, remaining,
             freq.eos_token_id, temperature=freq.temperature,
             top_k=freq.top_k, top_p=freq.top_p, seed=freq.seed,
-            deadline_s=freq.deadline_s, submit_time=freq.submit_time)
+            deadline_s=freq.deadline_s, submit_time=freq.submit_time,
+            # received tokens ride the new context as prompt: the
+            # sample-key fold continues at the received count, so a
+            # seeded stream crosses failover AND the disaggregated
+            # handoff bit-identically (round 20)
+            sample_offset=len(freq.output_ids))
         if inner.state == FAILED:
             return False
         freq._inner = inner
         freq.replica_id = rep.rid
         freq.state = RUNNING
+        if freq.phase is not None:
+            freq.phase = "decode"
         rep.by_inner[inner.req_id] = freq
         self.inst.routed.inc()
         if hit:
@@ -415,6 +610,7 @@ class FleetRouter:
         freq.state = FINISHED
         freq.replica_id = None
         freq._inner = None
+        freq._transfer = None
         self._live.pop(freq.fleet_id, None)
         self.inst.finished.inc()
 
@@ -423,6 +619,7 @@ class FleetRouter:
         freq.error = {"code": code, "message": str(message)[:300]}
         freq.replica_id = None
         freq._inner = None
+        freq._transfer = None
         self._live.pop(freq.fleet_id, None)
         self.inst.failed.inc()
         self.inst.fail_reasons.labels(reason=code).inc()
@@ -448,7 +645,18 @@ class FleetRouter:
         for freq in victims:
             if freq.state in (FINISHED, FAILED):
                 continue
+            if freq.phase == "prefill":
+                # round 20: losing the prefill replica mid-prompt only
+                # loses PREFILL work — the decode path never started.
+                # Colocated fallback owns it, and it never burns the
+                # failover budget (disaggregation existing must never
+                # cost a request its bounded migrations)
+                self._fallback(freq, "prefill replica lost mid-stream")
+                continue
             self._failover(freq, exc)
+        # transfers whose endpoints died abort on their next drive (the
+        # replica-bound cache accessors read None for a DEAD slot) —
+        # nothing to do here, and nothing of the dead pool is ever read
 
     def _failover(self, freq: FleetRequest, exc) -> None:
         """Migrate one request off a lost replica: resume from the
@@ -481,6 +689,149 @@ class FleetRouter:
         self.replicas[self.replicas.index(rep)] = _Replica(
             rep.rid, self._spawn(rep.rid))
         self.inst.restarts.inc()
+
+    # -- round 20: the prefill -> decode handoff ----------------------------
+
+    def _cache_fn(self, rep: _Replica):
+        """A crash-consistent accessor for ``rep``'s cache: reads None
+        once the slot is DEAD or the wrapper was replaced by a
+        supervisor restart — a transfer must never read a dead pool,
+        and a restart's FRESH cache must never be mistaken for it."""
+        def fn():
+            if rep.state == DEAD or rep.sp is None \
+                    or rep not in self.replicas:
+                return None
+            return rep.sp.cache
+        return fn
+
+    def _pick_transfer_dst(self, freq: FleetRequest):
+        """The decode replica the pages stream TO: the affinity map
+        first (the replica that will keep serving this prefix), else
+        the least-loaded LIVE decode replica. Deliberately NOT gated on
+        the admission verdict — a transient queue-full must not abandon
+        a transfer (the pages land, the decode admission rides the
+        normal unrouted backpressure afterwards); only DEAD/DRAINING
+        replicas are off the table. None only when every decode replica
+        is dead/draining."""
+        def live(r):
+            return r.state not in (DEAD, DRAINING) and r.sp is not None
+
+        keys = prompt_chain_keys(freq.prompt_ids + freq.output_ids,
+                                 self.page_size)
+        rep = self._affinity_walk(keys, live)
+        if rep is not None:
+            return rep, True
+        cands = [r for r in self._decode_reps() if live(r)]
+        if not cands:
+            return None, False
+        return min(cands, key=lambda r: (self._load_score(r), r.rid)), False
+
+    def _handoff(self, freq: FleetRequest, rep: _Replica) -> None:
+        """The prefill stage finished: export the prompt's registered
+        pages off the prefill replica and stream them to the decode
+        replica the affinity map names. Every unhappy path here is a
+        FALLBACK, never a failure."""
+        freq._inner = None
+        freq.replica_id = None
+        if freq.done:
+            # budget 1 (or eos on the first token): the received stream
+            # already satisfies the contract — nothing to hand off
+            self._finish(freq)
+            return
+        records = (rep.sp.cache.prefix_page_records(freq.prompt_ids)
+                   if rep.sp is not None else [])
+        if not records:
+            self._fallback(freq, "no transferable pages registered on "
+                                 "the prefill replica")
+            return
+        dst, hit = self._pick_transfer_dst(freq)
+        if dst is None:
+            self._fallback(freq, "no live decode replica at handoff")
+            return
+        # started counts BEFORE construction so a transfer that fails
+        # to open (unreadable source) keeps started >= completed+failed
+        self.inst.transfers_started.inc()
+        t = KVPageTransfer(
+            records, self._cache_fn(rep), self._cache_fn(dst),
+            config=self.transfer_cfg, instruments=self.inst,
+            src_rid=rep.rid, dst_rid=dst.rid)
+        if t.state != T_SENDING:
+            self._fallback(freq, t.failure or "transfer failed to open")
+            return
+        freq.phase = "transfer"
+        freq.decode_rid = dst.rid
+        freq._transfer = t
+        self._transfers.append((t, freq, hit))
+
+    def _complete_handoff(self, freq: FleetRequest, hit: bool) -> None:
+        """Every page landed: admit the decode stage where the pages
+        now live. If the pinned destination became unadmittable while
+        the pages streamed, normal decode routing owns the request —
+        the imported pages stay registered, so a later same-prefix
+        admission still hits them."""
+        freq._transfer = None
+        freq.phase = "decode"
+        rep = self._rep(freq.decode_rid)
+        keys = prompt_chain_keys(freq.prompt_ids + freq.output_ids,
+                                 self.page_size)
+        if (rep.state != DEAD and rep.sp is not None
+                and self._admittable(rep)
+                and self._admit_on(freq, rep, keys, hit)):
+            return
+        self._try_route(freq)
+
+    def _fallback(self, freq: FleetRequest, why: str) -> None:
+        """Graceful degradation — the round-20 headline: the request
+        serves COLOCATED on the decode fleet (today's path), counted
+        but never failed and never charged a failover. ``why`` is
+        telemetry-only: degradation is invisible to the caller beyond
+        latency."""
+        if freq.state in (FINISHED, FAILED):
+            return          # racing a terminal request is not a degradation
+        freq._transfer = None
+        freq._inner = None
+        freq.replica_id = None
+        freq.phase = "decode"
+        self.inst.prefill_fallbacks.inc()
+        if freq.done:
+            self._finish(freq)
+            return
+        self._try_route(freq)
+
+    def _drive_transfers(self) -> None:
+        """One tick of wire work for every live transfer, plus the
+        transfer-phase deadline sweep (a request streaming its pages is
+        on no replica — nobody else's TTL sweep covers it) and the
+        sender-side backlog stamps the healthz surface reads."""
+        if self._transfers:
+            now = monotonic()
+            live = []
+            for t, freq, hit in self._transfers:
+                if freq.state in (FINISHED, FAILED):
+                    t.abort("fleet request terminal")
+                    continue
+                if freq.past_deadline(now):
+                    t.abort("deadline exceeded mid-transfer")
+                    self.inst.deadline_misses.inc()
+                    self._fail(freq, "deadline_exceeded",
+                               f"transfer-phase request past its "
+                               f"{freq.deadline_s}s deadline")
+                    continue
+                state = t.tick()
+                if state == T_SENDING:
+                    live.append((t, freq, hit))
+                elif state == T_DONE:
+                    self._complete_handoff(freq, hit)
+                else:
+                    self._fallback(freq, t.failure or "transfer failed")
+            self._transfers = live
+        backlog: dict[int, int] = {}
+        for t, _, _ in self._transfers:
+            backlog[t.src_rid] = backlog.get(t.src_rid, 0) + t.backlog
+        for rep in self._prefill_reps():
+            if rep.sp is not None:
+                rep.sp.transfer_backlog = backlog.get(rep.rid, 0)
+        self.inst.transfer_backlog.set(sum(backlog.values()))
 
     # -- the tick -----------------------------------------------------------
 
@@ -531,6 +882,9 @@ class FleetRouter:
                         self._restart(rep)
                     continue
                 self._step_replica(rep, produced)
+            # round 20: one tick of KV-page wire work (new handoffs
+            # created by the sweeps above send their first window NOW)
+            self._drive_transfers()
             self._refresh_health()
         self.inst.live_replicas.set(
             sum(1 for r in self.replicas if r.state != DEAD))
@@ -570,6 +924,8 @@ class FleetRouter:
                 produced.setdefault(freq.fleet_id, []).append(int(tok))
                 landed += 1
             if landed:
+                if freq.first_token_time is None:
+                    freq.first_token_time = monotonic()
                 self.inst.tokens.labels(replica=str(rep.rid)).inc(landed)
 
     def _sweep(self, rep: _Replica) -> None:
@@ -586,14 +942,31 @@ class FleetRouter:
             if inner.state == FINISHED and inner._pending_n == 0:
                 del rep.by_inner[inner_id]
                 freq.truncated = freq.truncated or inner.truncated
-                self._finish(freq)
+                if freq.phase == "prefill":
+                    # round 20: the prefill stage retired — the fleet
+                    # request is NOT done, its pages hand off to the
+                    # decode fleet now
+                    self._handoff(freq, rep)
+                else:
+                    self._finish(freq)
             elif inner.state == FAILED:
+                del rep.by_inner[inner_id]
+                if (freq.phase == "prefill"
+                        and inner.error["code"] != "deadline_exceeded"):
+                    # round 20: an intra-replica failure of the PREFILL
+                    # stage (pool exhaustion, retry exhaustion, a raced
+                    # shed) is not the request's failure — the
+                    # colocated path may still serve it. Deadlines stay
+                    # global: an expired request is expired everywhere.
+                    self._fallback(
+                        freq, f"prefill stage failed "
+                              f"({inner.error['code']})")
+                    continue
                 # an intra-replica terminal verdict (deadline, pool
                 # exhaustion, retry exhaustion, shed) is the REQUEST's
                 # failure, not the replica's — it propagates, it does
                 # not fail over (a deadline miss is global; the rest
                 # would recur on any identically-sized replica)
-                del rep.by_inner[inner_id]
                 self._fail(freq, inner.error["code"],
                            inner.error["message"])
 
@@ -646,6 +1019,7 @@ class FleetRouter:
         out = []
         for rep in self.replicas:
             row = {"replica_id": rep.rid, "fleet_state": rep.state,
+                   "role": self.role_for(rep.rid),
                    "stall_ticks": rep.stall_ticks,
                    "assigned": len(rep.by_inner)}
             if rep.sp is not None:

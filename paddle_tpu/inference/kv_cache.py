@@ -745,6 +745,165 @@ class KVCacheManager:
             return 0.0
         return self.prefix_hit_tokens / self.prefix_query_tokens
 
+    # -- KV-page transfer surface (round 20) -------------------------------
+    #
+    # The export/import half of disaggregated prefill/decode
+    # (inference/kv_transfer.py): a prefill replica's registered prompt
+    # pages stream to the decode replica addressed by the SAME sha1
+    # chain keys, land here as zero-ref registered LRU pages, and the
+    # next admission's ``admit_prefix`` walk pins them exactly like
+    # locally-prefilled pages — transferred pages serve hits
+    # immediately, and a failed transfer unwinds to an accounting state
+    # indistinguishable from a colocated run.
+
+    def prefix_page_records(self, tokens):
+        """The chain-keyed export walk: every REGISTERED page holding a
+        prefix of ``tokens`` — full pages first, then the longest
+        registered partial tail — as ``(chain_key, page, ntok)``
+        records in chain order. Unlike :meth:`_match_prefix` there is
+        no ``n - 1`` feed cap: the exporter ships every page it has
+        (the RECEIVER's admission walk re-applies the cap). Stops at
+        the first unregistered link (a partially-evicted chain exports
+        its surviving prefix — the rest re-prefills colocated)."""
+        ps = self.page_size
+        n = len(tokens)
+        recs: list[tuple[bytes, int, int]] = []
+        pos = 0
+        h = b""
+        while pos + ps <= n:
+            nxt = self._chain_key(h, tokens[pos:pos + ps])
+            page = self._prefix_pages.get(nxt)
+            if page is None:
+                break
+            recs.append((nxt, page, ps))
+            pos += ps
+            h = nxt
+        for t in range(min(ps - 1, n - pos), 0, -1):
+            nxt = self._chain_key(h, tokens[pos:pos + t])
+            page = self._prefix_pages.get(nxt)
+            if page is not None:
+                recs.append((nxt, page, t))
+                break
+        return recs
+
+    def pin_page(self, page: int) -> None:
+        """Take one extra reference on ``page`` (an in-flight transfer's
+        eviction guard — a registered source page must stay put while
+        its frames stream). Balanced by :meth:`unpin_page`."""
+        if self._refcount[page] == 0:
+            self._lru.pop(page, None)
+        self._refcount[page] += 1
+        self._note_occupancy()
+
+    def unpin_page(self, page: int) -> None:
+        self._release_page(page)
+        self._note_occupancy()
+
+    def read_page_payload(self, page: int, ntok: int) -> dict:
+        """One page's transferable payload: the first ``ntok`` token
+        rows of every layer's K/V (+ the int8 scale planes when the
+        pool is quantized) as host numpy arrays — exactly the bytes a
+        decode replica needs to serve this page bit-identically."""
+        out = {"k": np.asarray(self.k_pages[:, page, :ntok]),
+               "v": np.asarray(self.v_pages[:, page, :ntok])}
+        if self.quantize_kv:
+            out["ks"] = np.asarray(self.k_scales[:, page, :ntok])
+            out["vs"] = np.asarray(self.v_scales[:, page, :ntok])
+        return out
+
+    def import_prefix_page(self, key: bytes, ntok: int, payload: dict):
+        """Land one transferred page: allocate a pool page, write the
+        payload rows, register ``key`` and park the page zero-ref on
+        the LRU (it serves prefix hits immediately; the admission that
+        consumes it pins it like any locally-prefilled page).
+
+        Returns ``"imported"``, ``"present"`` (idempotent re-delivery:
+        the key is already registered — a retransmitted frame is a
+        no-op), or ``None`` when the pool has no allocatable page (the
+        receiver's pressure signal — the transfer aborts and the router
+        falls back to colocated prefill). Geometry/dtype mismatches are
+        CONFIG errors between identically-built replicas: they raise.
+
+        Cost note: each ``.at[].set`` below is an eager functional
+        update — a full pool copy per plane per frame. Fine at the
+        in-process simulation scale this round ships at; the multi-host
+        follow-up (ROADMAP item 1) should batch a transfer tick's
+        frames into one donated scatter per plane."""
+        if not self.enable_prefix_cache:
+            raise RuntimeError(
+                "import_prefix_page needs enable_prefix_cache=True "
+                "(transferred pages land in the prefix registry)")
+        if key in self._prefix_pages:
+            return "present"
+        if not (0 < int(ntok) <= self.page_size):
+            raise ValueError(
+                f"ntok must be in (0, {self.page_size}], got {ntok}")
+        want = {"k", "v"} | ({"ks", "vs"} if self.quantize_kv else set())
+        if set(payload) != want:
+            raise ValueError(
+                f"payload planes {sorted(payload)} do not match this "
+                f"pool's {sorted(want)} (fp vs int8-KV replicas must be "
+                "built identically)")
+        shape = (self.num_layers, int(ntok), self.num_kv_heads,
+                 self.head_dim)
+        for name in ("k", "v"):
+            a = payload[name]
+            if tuple(a.shape) != shape or a.dtype != self.k_pages.dtype:
+                raise ValueError(
+                    f"plane '{name}' is {a.dtype}{tuple(a.shape)}, "
+                    f"expected {self.k_pages.dtype}{shape}")
+        if self.quantize_kv:
+            for name in ("ks", "vs"):
+                a = payload[name]
+                if tuple(a.shape) != shape[:3] \
+                        or a.dtype != self.k_scales.dtype:
+                    raise ValueError(
+                        f"plane '{name}' is {a.dtype}{tuple(a.shape)}, "
+                        f"expected {self.k_scales.dtype}{shape[:3]}")
+        if not self._free_pages:
+            # transfers claim strictly-FREE pages only: an imported page
+            # must never evict a registered page off the LRU (same
+            # contract as draft allowances — opportunistic work never
+            # costs a warm prefix its spot), which also keeps the
+            # failed-transfer unwind exactly reversible
+            return None
+        page = self._free_pages.pop()
+        self._refcount[page] = 0
+        self.k_pages = self.k_pages.at[:, page, :ntok].set(payload["k"])
+        self.v_pages = self.v_pages.at[:, page, :ntok].set(payload["v"])
+        if self.quantize_kv:
+            self.k_scales = self.k_scales.at[:, page, :ntok].set(
+                payload["ks"])
+            self.v_scales = self.v_scales.at[:, page, :ntok].set(
+                payload["vs"])
+        self._page_key[page] = key
+        self._prefix_pages[key] = page
+        self._lru[page] = None                 # MRU end, zero-ref
+        self._note_occupancy()
+        return "imported"
+
+    def discard_imported_prefix(self, keys) -> int:
+        """Unwind a failed transfer: unregister + free every page in
+        ``keys`` that is still zero-ref (a page an admission already
+        pinned is serving real traffic and stays). Pass the keys in
+        REVERSE import order so the free list recovers its exact
+        pre-transfer pop order — after the unwind the pool accounting
+        is indistinguishable from a run where the transfer never
+        happened. Returns the pages freed."""
+        dropped = 0
+        for key in keys:
+            page = self._prefix_pages.get(key)
+            if page is None or int(self._refcount[page]) != 0:
+                continue
+            del self._prefix_pages[key]
+            del self._page_key[page]
+            self._lru.pop(page, None)
+            self._free_pages.append(page)
+            dropped += 1
+        if dropped:
+            self._note_occupancy()
+        return dropped
+
     # -- copy-on-write -----------------------------------------------------
 
     def needs_cow(self, slot: int, pos: int) -> bool:

@@ -1,0 +1,371 @@
+"""Crash-consistent KV-page streaming between serving replicas (round 20).
+
+The wire layer of disaggregated prefill/decode
+(``inference/fleet_serving.py``): a prefill replica runs a prompt
+through the ordinary unified step, then its finished KV pages — int8
+payloads PLUS their fp32 scale planes on the quantized pool, partial
+tail pages included — stream to the decode replica the prefix-affinity
+map names, where they land through the prefix-cache registry
+(:meth:`KVCacheManager.import_prefix_page`) and immediately serve hits.
+This module owns everything between the two pools, as a first-class
+ROBUSTNESS layer:
+
+- **Frames** (:func:`encode_frame` / :func:`decode_frame`) — one page
+  per frame, addressed by the page's sha1 CHAIN KEY (the same content
+  chain the prefix registries and the router's affinity map hash, so a
+  frame is meaningful to any replica that derives the same chain) with
+  the valid-token count (partial tails ship exactly their filled rows)
+  and a CRC32 over the entire header+payload body. A corrupt frame is
+  DETECTED at decode — :class:`FrameError` — never silently ingested.
+- **Bounded in-flight window** — at most ``window`` unacked frames; a
+  frame is acked when the receiver imports (or already holds) its key.
+- **Per-frame timeout + exponential backoff + bounded retries** — a
+  dropped frame retransmits after ``timeout_ticks * backoff**retries``
+  scheduler ticks; a checksum-failed frame nacks and retransmits next
+  tick; either way at most ``max_retries`` retransmits, then the whole
+  transfer FAILS (the router's cue to fall back to colocated prefill).
+- **Idempotent receive** — re-delivered frames are no-ops keyed by
+  chain key (``"present"``), so retransmission can never double-land.
+- **Crash-consistent teardown** — the source's pages are pinned for the
+  transfer's lifetime (an LRU eviction mid-stream would ship a reused
+  page); a transfer whose source or destination replica dies mid-stream
+  fails immediately (the cache accessors return ``None`` for a DEAD
+  replica — a crashed process's pool is unreadable, period); a FAILED
+  transfer unwinds every page it imported
+  (:meth:`KVCacheManager.discard_imported_prefix`, reverse order) so
+  the decode-side free lists / refcounts / LRU / scale planes are
+  indistinguishable from a run where the transfer never happened.
+
+Fault seams (``inference/faults.py``, fired once per frame put on the
+wire — fresh sends and retransmits alike): ``transfer_drop`` loses the
+frame in flight (timeout recovery), ``transfer_corrupt`` flips a byte
+of the encoded bytes before delivery (checksum recovery). Both are
+RETURNING seams under the one-module-global-check disarmed contract.
+
+The transfer never raises out of :meth:`KVPageTransfer.tick` — failure
+is a STATE (``FAILED`` + ``failure`` reason), because the only caller
+is the fleet router's tick loop and a request must degrade to the
+colocated path, not crash the fleet.
+"""
+from __future__ import annotations
+
+import struct
+import zlib
+
+import numpy as np
+
+from .faults import fault_point
+
+__all__ = ["FrameError", "TransferConfig", "KVPageTransfer",
+           "encode_frame", "decode_frame", "SENDING", "DONE", "FAILED"]
+
+#: transfer lifecycle states
+SENDING, DONE, FAILED = "sending", "done", "failed"
+
+_MAGIC = b"KVTX"
+_VERSION = 1
+
+
+class FrameError(RuntimeError):
+    """A frame that failed to decode — truncation, bad magic/version,
+    or a checksum mismatch. The receiver treats every one of these as
+    wire corruption: detected, counted, never ingested."""
+
+
+def encode_frame(key: bytes, ntok: int, planes: dict) -> bytes:
+    """Serialize one page frame: ``magic | version | crc32(body) | body``
+    where the body is the chain key, the valid-token count and every
+    payload plane (name, dtype, shape, raw bytes) in sorted-name order.
+    The CRC covers the ENTIRE body, so corruption anywhere — key,
+    counts, shapes or payload — fails :func:`decode_frame`."""
+    body = bytearray()
+    body += struct.pack(">H", len(key)) + bytes(key)
+    body += struct.pack(">IB", int(ntok), len(planes))
+    for name in sorted(planes):
+        a = np.ascontiguousarray(planes[name])
+        nm = name.encode()
+        dt = a.dtype.str.encode()
+        raw = a.tobytes()
+        body += struct.pack(">B", len(nm)) + nm
+        body += struct.pack(">B", len(dt)) + dt
+        body += struct.pack(">B", a.ndim)
+        body += struct.pack(f">{a.ndim}I", *a.shape)
+        body += struct.pack(">I", len(raw)) + raw
+    crc = zlib.crc32(bytes(body)) & 0xFFFFFFFF
+    return _MAGIC + struct.pack(">BI", _VERSION, crc) + bytes(body)
+
+
+def decode_frame(buf: bytes):
+    """Parse + verify one frame. Returns ``(key, ntok, planes)``;
+    raises :class:`FrameError` on ANY malformation (the checksum is
+    checked before a single body byte is interpreted)."""
+    if len(buf) < 9 or buf[:4] != _MAGIC:
+        raise FrameError("bad frame magic")
+    version, crc = struct.unpack(">BI", buf[4:9])
+    if version != _VERSION:
+        raise FrameError(f"unknown frame version {version}")
+    body = buf[9:]
+    if (zlib.crc32(body) & 0xFFFFFFFF) != crc:
+        raise FrameError("frame checksum mismatch")
+    try:
+        off = 0
+        (klen,) = struct.unpack_from(">H", body, off)
+        off += 2
+        key = bytes(body[off:off + klen])
+        off += klen
+        ntok, nplanes = struct.unpack_from(">IB", body, off)
+        off += 5
+        planes = {}
+        for _ in range(nplanes):
+            (nlen,) = struct.unpack_from(">B", body, off)
+            off += 1
+            name = body[off:off + nlen].decode()
+            off += nlen
+            (dlen,) = struct.unpack_from(">B", body, off)
+            off += 1
+            dt = np.dtype(body[off:off + dlen].decode())
+            off += dlen
+            (ndim,) = struct.unpack_from(">B", body, off)
+            off += 1
+            shape = struct.unpack_from(f">{ndim}I", body, off)
+            off += 4 * ndim
+            (rlen,) = struct.unpack_from(">I", body, off)
+            off += 4
+            raw = body[off:off + rlen]
+            off += rlen
+            if len(raw) != rlen:
+                raise FrameError("truncated frame payload")
+            planes[name] = np.frombuffer(raw, dt).reshape(shape).copy()
+        return key, int(ntok), planes
+    except (struct.error, ValueError, UnicodeDecodeError) as e:
+        # a frame that PASSED the checksum but fails to parse is still
+        # wire corruption from the receiver's point of view (e.g. a
+        # truncation that sheared the CRC'd region off entirely)
+        raise FrameError(f"malformed frame body: {e}") from e
+
+
+class TransferConfig:
+    """Knobs of one KV-page stream. ``timeout_ticks`` and the retransmit
+    backoff are in fleet SCHEDULER TICKS (the router drives transfers
+    once per tick) — a dropped frame's k-th retransmit waits
+    ``timeout_ticks * backoff**k`` ticks, and every frame retransmits at
+    most ``max_retries`` times before the transfer fails."""
+
+    def __init__(self, *, window=4, max_retries=3, timeout_ticks=2,
+                 backoff=2.0):
+        self.window = int(window)
+        if self.window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        self.max_retries = int(max_retries)
+        if self.max_retries < 0:
+            raise ValueError(f"max_retries must be >= 0, "
+                             f"got {max_retries}")
+        self.timeout_ticks = int(timeout_ticks)
+        if self.timeout_ticks < 1:
+            raise ValueError(f"timeout_ticks must be >= 1, "
+                             f"got {timeout_ticks}")
+        self.backoff = float(backoff)
+        if self.backoff < 1.0:
+            raise ValueError(f"backoff must be >= 1.0, got {backoff}")
+
+
+class _Frame:
+    """Sender-side in-flight record of one unacked frame."""
+
+    __slots__ = ("seq", "retries", "resend_at")
+
+    def __init__(self, seq: int):
+        self.seq = seq
+        self.retries = 0
+        self.resend_at = 0     # tick at/after which to retransmit
+
+
+class KVPageTransfer:
+    """One chain-key-addressed page stream from a source cache to a
+    destination cache.
+
+    ``records`` is the export walk's ``[(chain_key, page, ntok)]``
+    (:meth:`KVCacheManager.prefix_page_records`); ``src_cache_fn`` /
+    ``dst_cache_fn`` return the live :class:`KVCacheManager` — or
+    ``None`` once the owning replica is DEAD (a crashed process's pool
+    is unreadable; the router binds these to the replica wrappers so a
+    restart's FRESH cache can never be mistaken for the dead one's).
+    The router drives :meth:`tick` once per scheduler round and reads
+    ``state`` / ``failure`` / ``backlog``; ``instruments`` (a
+    :class:`~paddle_tpu.observability.fleet.FleetInstruments`, optional)
+    receives the frame/byte/retry/corruption counters.
+    """
+
+    def __init__(self, records, src_cache_fn, dst_cache_fn, *,
+                 config=None, instruments=None, src_rid=-1, dst_rid=-1):
+        if not records:
+            raise ValueError("a transfer needs at least one page record")
+        self.cfg = config if config is not None else TransferConfig()
+        self.records = list(records)
+        self._src_fn = src_cache_fn
+        self._dst_fn = dst_cache_fn
+        self.src_rid = int(src_rid)
+        self.dst_rid = int(dst_rid)
+        self.inst = instruments
+        self.state = SENDING
+        self.failure: str | None = None
+        self.tick_now = 0
+        self._cursor = 0                      # next fresh record index
+        self._inflight: dict[int, _Frame] = {}
+        self._acked: set[int] = set()
+        self._imported: list[bytes] = []      # unwind list, import order
+        self._pinned = False
+        self.frames_sent = 0
+        self.bytes_sent = 0
+        self.retries = 0
+        src = self._src_fn()
+        if src is None:
+            self._fail("source replica unreadable at transfer start")
+            return
+        # pin the source pages for the stream's lifetime: a zero-ref
+        # registered page could otherwise be evicted (and its pool slot
+        # REUSED) between two of its frames
+        for _, page, _ in self.records:
+            src.pin_page(page)
+        self._pinned = True
+
+    @property
+    def backlog(self) -> int:
+        """Frames not yet acked (queued + in flight) — the healthz
+        ``transfer_backlog`` signal and the prefill routing penalty."""
+        if self.state != SENDING:
+            return 0
+        return len(self.records) - len(self._acked)
+
+    # -- teardown -----------------------------------------------------------
+
+    def _unpin(self) -> None:
+        if not self._pinned:
+            return
+        self._pinned = False
+        src = self._src_fn()
+        if src is None:
+            return               # the pool died with its replica
+        for _, page, _ in self.records:
+            src.unpin_page(page)
+
+    def _finish(self) -> str:
+        self.state = DONE
+        self._unpin()
+        if self.inst is not None:
+            self.inst.transfers_completed.inc()
+        return self.state
+
+    def _fail(self, reason: str) -> str:
+        self.state = FAILED
+        self.failure = reason
+        # unwind: every page THIS transfer imported (still zero-ref)
+        # leaves the destination registry, reverse import order, so the
+        # decode-side accounting is indistinguishable from a run where
+        # the transfer never happened
+        dst = self._dst_fn()
+        if dst is not None and self._imported:
+            dst.discard_imported_prefix(reversed(self._imported))
+        self._imported = []
+        self._unpin()
+        if self.inst is not None:
+            self.inst.transfers_failed.inc()
+        return self.state
+
+    def abort(self, reason: str) -> None:
+        """Router-side abort (replica death, deadline) — idempotent."""
+        if self.state == SENDING:
+            self._fail(reason)
+
+    # -- the wire -----------------------------------------------------------
+
+    def _timeout(self, retries: int) -> int:
+        return max(1, int(self.cfg.timeout_ticks
+                          * self.cfg.backoff ** retries))
+
+    def _send(self, fr: _Frame, src, dst) -> None:
+        """Put one frame on the wire: read the (pinned) source page AT
+        SEND TIME, encode, pass the two wire seams, deliver, import,
+        ack. Drop/corruption leave the frame in flight for the
+        timeout/nack machinery; receiver pool pressure fails the whole
+        transfer (the classic backpressure-to-fallback edge)."""
+        key, page, ntok = self.records[fr.seq]
+        buf = encode_frame(key, ntok, src.read_page_payload(page, ntok))
+        self.frames_sent += 1
+        self.bytes_sent += len(buf)
+        if self.inst is not None:
+            self.inst.transfer_frames.inc()
+            self.inst.transfer_bytes.inc(len(buf))
+        if fault_point("transfer_drop"):
+            # lost in flight: no delivery, no ack — the per-frame
+            # timeout owns recovery (exponential backoff per retry)
+            if self.inst is not None:
+                self.inst.transfer_drops.inc()
+            fr.resend_at = self.tick_now + self._timeout(fr.retries)
+            return
+        if fault_point("transfer_corrupt"):
+            b = bytearray(buf)
+            b[len(b) // 2] ^= 0xFF
+            buf = bytes(b)
+        try:
+            rkey, rntok, planes = decode_frame(buf)
+        except FrameError:
+            # DETECTED by the checksum — never ingested. Nack: the
+            # sender retransmits next tick (no timeout wait: the
+            # receiver told us, the wire didn't go quiet)
+            if self.inst is not None:
+                self.inst.transfer_corrupt.inc()
+            fr.resend_at = self.tick_now + 1
+            return
+        got = dst.import_prefix_page(rkey, rntok, planes)
+        if got is None:
+            self._fail("receiver pool pressure: no free page for import")
+            return
+        if got == "imported":
+            self._imported.append(rkey)
+        self._inflight.pop(fr.seq, None)
+        self._acked.add(fr.seq)
+        if self.inst is not None:
+            self.inst.transfer_tokens.inc(rntok)
+
+    def tick(self) -> str:
+        """One scheduler round of wire work: retransmit what timed out
+        (bounded, backed off), then fill the window with fresh sends.
+        Returns the transfer state; NEVER raises — failure is a state
+        the router reads."""
+        if self.state != SENDING:
+            return self.state
+        self.tick_now += 1
+        src = self._src_fn()
+        if src is None:
+            return self._fail("source replica lost mid-stream")
+        dst = self._dst_fn()
+        if dst is None:
+            return self._fail("destination replica lost mid-stream")
+        for seq in sorted(self._inflight):
+            fr = self._inflight.get(seq)
+            if fr is None or fr.resend_at > self.tick_now:
+                continue
+            if fr.retries >= self.cfg.max_retries:
+                return self._fail(
+                    f"frame {seq} exhausted {self.cfg.max_retries} "
+                    "retries")
+            fr.retries += 1
+            self.retries += 1
+            if self.inst is not None:
+                self.inst.transfer_retries.inc()
+            self._send(fr, src, dst)
+            if self.state != SENDING:
+                return self.state
+        while (self._cursor < len(self.records)
+               and len(self._inflight) < self.cfg.window):
+            fr = _Frame(self._cursor)
+            self._cursor += 1
+            self._inflight[fr.seq] = fr
+            fr.resend_at = self.tick_now + self._timeout(0)
+            self._send(fr, src, dst)
+            if self.state != SENDING:
+                return self.state
+        if len(self._acked) == len(self.records):
+            return self._finish()
+        return self.state

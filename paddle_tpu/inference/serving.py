@@ -182,7 +182,7 @@ class Request:
 
     def __init__(self, prompt_ids, max_new_tokens=32, eos_token_id=None,
                  temperature=0.0, top_k=0, top_p=1.0, seed=None,
-                 deadline_s=None, submit_time=None):
+                 deadline_s=None, submit_time=None, sample_offset=0):
         self.req_id = Request._next_id[0]
         Request._next_id[0] += 1
         self.prompt_ids = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
@@ -208,6 +208,18 @@ class Request:
         self.top_k = int(top_k)
         self.top_p = float(top_p)
         self.seed = self.req_id if seed is None else int(seed)
+        # round 20: the tokens-produced base of the in-jit sample-key
+        # fold. A re-admission that carries ALREADY-RECEIVED tokens in
+        # its prompt (the fleet router's failover resume and the
+        # disaggregated prefill->decode handoff both feed
+        # ``original_prompt + received``) passes the received count
+        # here, so token r+i samples with fold(base_key, r+i) — the
+        # seeded stream continues bit-identically to an uninterrupted
+        # run instead of restarting its fold at 0
+        self.sample_offset = int(sample_offset)
+        if self.sample_offset < 0:
+            raise ValueError(f"sample_offset must be >= 0, "
+                             f"got {sample_offset}")
         self.output_ids: list[int] = []
         # tokens the async engine has dispatched for this request but not
         # yet materialized on the host (always 0 in the sync engine once
@@ -352,8 +364,8 @@ class ServingPredictor:
                  spec_decode_k=None, async_engine=None,
                  max_inflight_steps=4, metrics=None, mega_decode=None,
                  slo=None, max_step_retries=3, retry_backoff_s=0.02,
-                 replica_id=0, draft_source=None, draft_layers=None,
-                 draft_num_pages=None):
+                 replica_id=0, role="colocated", draft_source=None,
+                 draft_layers=None, draft_num_pages=None):
         from ..distributed.mesh import as_serving_mesh
         from ..models.gpt import (_serving_params_cached, build_decode_step,
                                   build_prefill, build_unified_step,
@@ -601,6 +613,19 @@ class ServingPredictor:
         self.replica_id = int(replica_id)
         if self.replica_id < 0:
             raise ValueError(f"replica_id must be >= 0, got {replica_id}")
+        # round 20: disaggregation identity — the fleet role this
+        # predictor plays ("prefill" runs prompts and streams KV pages
+        # out; "decode" receives pages and serves the decode phase;
+        # "colocated" is the single-role default — the predictor itself
+        # behaves identically in all three, the label steers the fleet
+        # router) and the sender-side transfer backlog (unacked KV-page
+        # frames originating here, stamped by the router's transfer
+        # drive) the healthz surface exposes for role-aware scoring
+        if role not in ("colocated", "prefill", "decode"):
+            raise ValueError(f"role must be 'colocated', 'prefill' or "
+                             f"'decode', got {role!r}")
+        self.role = role
+        self.transfer_backlog = 0
         self._last_round_end = monotonic()
         self.max_step_retries = int(max_step_retries)
         if self.max_step_retries < 0:
@@ -745,11 +770,12 @@ class ServingPredictor:
 
     def add_request(self, prompt_ids, max_new_tokens=32, eos_token_id=None,
                     temperature=0.0, top_k=0, top_p=1.0, seed=None,
-                    deadline_s=None, submit_time=None) -> Request:
+                    deadline_s=None, submit_time=None,
+                    sample_offset=0) -> Request:
         req = Request(prompt_ids, max_new_tokens, eos_token_id,
                       temperature=temperature, top_k=top_k, top_p=top_p,
                       seed=seed, deadline_s=deadline_s,
-                      submit_time=submit_time)
+                      submit_time=submit_time, sample_offset=sample_offset)
         if len(req.prompt_ids) > self.max_seq_len:
             raise ValueError(
                 f"prompt of {len(req.prompt_ids)} tokens exceeds "
@@ -825,6 +851,11 @@ class ServingPredictor:
             # stale/stuck replica (age grows without bound) from a quiet
             # one (its driver keeps stepping it, age stays small)
             "replica_id": self.replica_id,
+            # round 20: the disaggregation role + the sender-side
+            # unacked-frame backlog (the router's prefill-scoring and
+            # drain signals)
+            "role": self.role,
+            "transfer_backlog": int(self.transfer_backlog),
             "snapshot_age_s": round(
                 max(0.0, monotonic() - self._last_round_end), 6),
             "waiting": len(self.waiting),
@@ -1849,7 +1880,9 @@ class ServingPredictor:
             produced_n = np.zeros((b,), np.int32)
             for w_i, (slot, req, _, _) in enumerate(completing):
                 tok_pos[w_i] = cache.seq_len(slot)
-                produced_n[slot] = len(req.output_ids) + req._pending_n
+                produced_n[slot] = (req.sample_offset
+                                    + len(req.output_ids)
+                                    + req._pending_n)
             fault_point("h2d")
             d_pos, d_prod = jax.device_put((tok_pos, produced_n))
             d_ids, d_slot, d_qlens, d_last, d_fb, d_emit = (
@@ -1915,7 +1948,8 @@ class ServingPredictor:
                 w += n
                 if written + n - len(d) == req._ctx_len:
                     emit_mask[slot] = 1
-                    produced_n[slot] = (len(req.output_ids)
+                    produced_n[slot] = (req.sample_offset
+                                        + len(req.output_ids)
                                         + req._pending_n)
                     temp[slot] = req.temperature
                     top_k[slot] = req.top_k

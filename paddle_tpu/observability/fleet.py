@@ -1,4 +1,7 @@
-"""Fleet-level telemetry instruments (round 18).
+"""Fleet-level telemetry instruments (round 18; round 20 adds the
+disaggregated prefill/decode transfer counters — wire frames/bytes/
+tokens/retries, drop/corruption detection, fallback accounting and the
+sender-side backlog gauge).
 
 The metrics surface of the multi-replica serving fleet
 (``inference/fleet_serving.py``): one :class:`FleetInstruments` bundle
@@ -68,6 +71,45 @@ class FleetInstruments:
         self.restarts = m.counter(
             "fleet_replica_restarts", "fresh predictors spawned into a "
             "dead replica's slot")
+        # -- round 20: disaggregated prefill/decode + KV-page transfer --
+        self.prefill_routed = m.counter(
+            "fleet_prefill_admissions",
+            "submissions placed on a prefill-role replica first")
+        self.transfers_started = m.counter(
+            "fleet_kv_transfers_started",
+            "KV-page streams opened prefill -> decode")
+        self.transfers_completed = m.counter(
+            "fleet_kv_transfers_completed",
+            "KV-page streams fully acked (every page imported)")
+        self.transfers_failed = m.counter(
+            "fleet_kv_transfers_failed",
+            "KV-page streams aborted (retries, crash, pressure)")
+        self.transfer_frames = m.counter(
+            "fleet_kv_transfer_frames",
+            "page frames put on the wire, retransmits included")
+        self.transfer_bytes = m.counter(
+            "fleet_kv_transfer_bytes",
+            "encoded wire bytes sent, retransmits included")
+        self.transfer_tokens = m.counter(
+            "fleet_kv_transfer_tokens",
+            "KV tokens landed by acked frames (per-token wire-cost "
+            "denominator)")
+        self.transfer_retries = m.counter(
+            "fleet_kv_transfer_retries",
+            "frame retransmits (timeout or checksum nack)")
+        self.transfer_drops = m.counter(
+            "fleet_kv_transfer_frames_dropped",
+            "frames lost in flight (the transfer_drop seam)")
+        self.transfer_corrupt = m.counter(
+            "fleet_kv_transfer_corrupt_detected",
+            "frames rejected by the receiver's checksum")
+        self.prefill_fallbacks = m.counter(
+            "fleet_prefill_fallbacks",
+            "requests degraded to colocated prefill on the decode "
+            "replica (transfer failure, prefill loss, no capacity)")
+        self.transfer_backlog = m.gauge(
+            "fleet_kv_transfer_backlog",
+            "unacked frames across in-flight transfers after a tick")
         # -- per-replica emission + fleet gauges ------------------------
         self.tokens = m.counter(
             "fleet_tokens_emitted", "tokens emitted, by serving replica",

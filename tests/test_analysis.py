@@ -414,6 +414,20 @@ class TestASTRules:
         fs = astlint.lint_source(textwrap.dedent(self._SWALLOW_SRC), where)
         assert len([f for f in fs if f.rule == "AL007"]) == 3, fs
 
+    def test_kv_transfer_sits_inside_both_hot_path_fences(self):
+        """Round-20 satellite: the KV-page transfer wire
+        (paddle_tpu/inference/kv_transfer.py) is hot-path serving code
+        with exactly the failure modes AL006/AL007 exist for (ad-hoc
+        timing around the wire, swallowed decode errors) — both
+        directory fences must cover it, and the module ships clean (the
+        repo gate below holds the baseline EMPTY over the real tree
+        including it)."""
+        where = "paddle_tpu/inference/kv_transfer.py"
+        fs = astlint.lint_source(textwrap.dedent(self._TIMING_SRC), where)
+        assert len([f for f in fs if f.rule == "AL006"]) == 3, fs
+        fs = astlint.lint_source(textwrap.dedent(self._SWALLOW_SRC), where)
+        assert len([f for f in fs if f.rule == "AL007"]) == 3, fs
+
 
 # ---------------------------------------------------------------------------
 # JX rules — seeded positive + negative per rule

@@ -140,6 +140,63 @@ class TestFaultPlan:
             assert fault_point("replica_stall") is None
         assert fault_point("replica_stall") is None      # disarmed
 
+    def test_transfer_seams_return_true_and_count(self):
+        """Round-20 unit fixtures: the two KV-wire seams are RETURNING
+        seams (the transfer layer applies the loss / byte-flip itself);
+        fired hits return True, unfired hits and the disarmed path
+        return None, and rates validate like every other seam."""
+        with pytest.raises(ValueError, match="transfer_drop rate"):
+            FaultPlan(transfer_drop=-0.1)
+        with pytest.raises(ValueError, match="transfer_corrupt rate"):
+            FaultPlan(transfer_corrupt=1.5)
+        with FaultPlan(seed=0, transfer_drop=1.0,
+                       transfer_corrupt=1.0) as plan:
+            assert fault_point("transfer_drop") is True
+            assert fault_point("transfer_corrupt") is True
+        assert plan.fired["transfer_drop"] == 1
+        assert plan.fired["transfer_corrupt"] == 1
+        with FaultPlan(seed=0, transfer_drop=0.0, transfer_corrupt=0.0):
+            assert fault_point("transfer_drop") is None
+            assert fault_point("transfer_corrupt") is None
+        # disarmed: one module-global check, always None
+        assert fault_point("transfer_drop") is None
+        assert fault_point("transfer_corrupt") is None
+
+    def test_corrupt_seam_payloads_always_detected_by_checksum(self):
+        """The round-20 corruption contract at the seam level: a frame
+        whose wire bytes the seam flips NEVER decodes — the checksum
+        catches every single corruption, so a corrupt payload cannot be
+        silently ingested (detection, not luck, is the defense)."""
+        import numpy as np
+
+        from paddle_tpu.inference.kv_transfer import (FrameError,
+                                                      decode_frame,
+                                                      encode_frame)
+
+        rng = np.random.RandomState(0)
+        buf = encode_frame(
+            b"\x07" * 20, 5,
+            {"k": rng.randn(2, 5, 2, 4).astype(np.float32),
+             "ks": rng.rand(2, 5, 2).astype(np.float32)})
+        with FaultPlan(seed=3, transfer_corrupt=1.0):
+            for trial in range(20):
+                assert fault_point("transfer_corrupt") is True
+                bad = bytearray(buf)
+                # the transfer layer's corruption spelling (mid-byte
+                # flip) plus harsher mutations
+                if trial % 3 == 0:
+                    bad[len(bad) // 2] ^= 0xFF
+                elif trial % 3 == 1:
+                    bad[rng.randint(len(bad))] ^= 1 << rng.randint(8)
+                else:
+                    bad = bad[:rng.randint(1, len(bad))]
+                with pytest.raises(FrameError):
+                    decode_frame(bytes(bad))
+        # the pristine frame still decodes (the flips above never
+        # mutated `buf` itself)
+        key, ntok, planes = decode_frame(buf)
+        assert key == b"\x07" * 20 and ntok == 5
+
     def test_replica_stall_draws_ride_the_one_seeded_stream(self):
         """Stall draws come from the SAME RandomState as every other
         seam, in hit order — a fleet chaos run replays from its seed."""

@@ -17,7 +17,8 @@ import numpy as np
 import pytest
 
 from paddle_tpu.inference import (FaultPlan, FleetRequest, FleetRouter,
-                                  ServingPredictor, SLOConfig)
+                                  ServingPredictor, SLOConfig,
+                                  TransferConfig)
 from paddle_tpu.inference.fleet_serving import (DEAD, DRAINING, HEALTHY,
                                                 UNHEALTHY)
 from paddle_tpu.inference.serving import FAILED, FINISHED, WAITING
@@ -392,16 +393,196 @@ def test_single_replica_fleet_bit_identical_to_bare_predictor(rng):
         assert [list(r.output_ids) for r in reqs] == want, sampling
 
 
+# -- round 20: disaggregated prefill/decode ---------------------------------
+
+
+def test_disagg_validation():
+    model = _tiny_model()
+    with pytest.raises(ValueError, match="prefill_replicas"):
+        _router(model, n=2, prefill_replicas=2)   # no decode replica left
+    with pytest.raises(ValueError, match="prefill_replicas"):
+        _router(model, n=2, prefill_replicas=-1)
+    with pytest.raises(ValueError, match="TransferConfig"):
+        _router(model, n=2, transfer=7)
+    with pytest.raises(ValueError, match="assigned by the router"):
+        _router(model, n=2, replica_kw={"role": "prefill"})
+
+
+def test_disagg_roles_routing_and_page_streaming(rng):
+    """The disaggregated happy path: page-spanning submissions prefill
+    on the prefill-role replica, their pages STREAM to a decode
+    replica, the decode admission hits the imported pages (no
+    re-prefill), and sub-page prompts serve colocated on the decode
+    fleet. Role topology rides healthz/replica_healthz."""
+    model = _tiny_model()
+    router = _router(model, n=3, prefill_replicas=1)
+    assert [r["role"] for r in router.replica_healthz()] == [
+        "prefill", "decode", "decode"]
+    assert router.replicas[0].sp.healthz()["role"] == "prefill"
+    long = rng.randint(0, TINY["vocab_size"], (20,)).tolist()  # 2p + tail
+    short = rng.randint(0, TINY["vocab_size"], (4,)).tolist()  # sub-page
+    a = router.submit(long, max_new_tokens=5)
+    b = router.submit(short, max_new_tokens=5)
+    assert a.phase == "prefill" and a.replica_id == 0
+    assert b.phase is None and b.replica_id in (1, 2)  # colocated short
+    _drain(router)
+    assert a.state == FINISHED and b.state == FINISHED
+    assert a.phase == "decode" and a.replica_id is None
+    assert a.decode_rid in (1, 2)
+    flat = router.telemetry()
+    assert flat["fleet_prefill_admissions"] == 1
+    assert flat["fleet_kv_transfers_started"] == 1
+    assert flat["fleet_kv_transfers_completed"] == 1
+    assert flat["fleet_kv_transfers_failed"] == 0
+    assert flat["fleet_prefill_fallbacks"] == 0
+    assert flat["fleet_kv_transfer_frames"] == 3       # 2 full + tail
+    assert flat["fleet_kv_transfer_tokens"] == 20
+    assert flat["fleet_kv_transfer_bytes"] > 0
+    # the decode replica served the transferred prefix from its cache:
+    # its prefix-hit counter covers the whole prompt but one token
+    dec = router._rep(a.decode_rid).sp
+    assert dec.cache.prefix_hit_tokens >= len(long) - 1
+    # a repeat of the SAME prompt affinity-routes to the decode replica
+    # holding the pages (the map names decode replicas only)
+    c = router.submit(long, max_new_tokens=3)
+    assert c.phase == "prefill"    # fresh prefill stage still runs...
+    _drain(router)
+    assert c.state == FINISHED and c.decode_rid == a.decode_rid
+    assert router.telemetry()["fleet_affinity_hits"] >= 1
+
+
+def test_disagg_disarmed_identical_to_colocated_and_bare(rng):
+    """THE disarmed-identity half of the round-20 gate: a disaggregated
+    fleet's emissions are bit-identical — greedy AND seeded-sampled —
+    to the colocated round-18 fleet AND to a bare ServingPredictor over
+    the same submissions (the sample-key fold continues across the
+    handoff via add_request(sample_offset=))."""
+    model = _tiny_model()
+    prompts = _churn_prompts(rng, 20, max_len=26)
+    for sampling in (dict(),
+                     dict(temperature=0.8, top_k=7, top_p=0.9, seed=13)):
+        sp = ServingPredictor(model, **KW)
+        want = sp.generate(prompts, max_new_tokens=5, **sampling)
+
+        def run(prefill):
+            router = _router(model, n=3, prefill_replicas=prefill)
+            reqs = [router.submit(p, max_new_tokens=5, **sampling)
+                    for p in prompts]
+            _drain(router)
+            assert all(r.state == FINISHED for r in reqs)
+            return [list(r.output_ids) for r in reqs]
+
+        assert run(0) == want, ("colocated", sampling)
+        assert run(1) == want, ("disaggregated", sampling)
+
+
+def test_disagg_degrades_colocated_never_fails(rng):
+    """The headline robustness property, path by path: no healthy
+    prefill replica / wire dead (drop) / wire corrupt — each degrades
+    to colocated prefill with BIT-IDENTICAL emissions and zero failed
+    requests; corrupt payloads are detected by the checksum, never
+    ingested."""
+    model = _tiny_model()
+    prompts = [rng.randint(0, TINY["vocab_size"],
+                           (int(rng.randint(9, 26)),)).tolist()
+               for _ in range(6)]
+    sp = ServingPredictor(model, **KW)
+    want = sp.generate(prompts, max_new_tokens=4)
+    tight = TransferConfig(max_retries=1, timeout_ticks=1)
+
+    def run(fault_kw=None, drain_prefill=False):
+        router = _router(model, n=3, prefill_replicas=1, transfer=tight)
+        if drain_prefill:
+            router.drain(0)
+        plan = FaultPlan(seed=5, **(fault_kw or {}))
+        with plan:
+            reqs = [router.submit(p, max_new_tokens=4) for p in prompts]
+            _drain(router)
+        assert all(r.state == FINISHED for r in reqs), \
+            [r.error for r in reqs if r.state == FAILED]
+        assert [list(r.output_ids) for r in reqs] == want
+        return router.telemetry(), plan
+
+    # (a) the prefill replica is draining: colocated from the start
+    flat, _ = run(drain_prefill=True)
+    assert flat["fleet_prefill_fallbacks"] == len(prompts)
+    assert flat["fleet_kv_transfers_started"] == 0
+    # (b) dead wire: every frame dropped, retries exhaust, fall back
+    flat, plan = run(dict(transfer_drop=1.0))
+    assert plan.fired["transfer_drop"] > 0
+    assert flat["fleet_kv_transfers_failed"] > 0
+    assert flat["fleet_kv_transfers_completed"] == 0
+    assert flat["fleet_prefill_fallbacks"] > 0
+    assert flat["fleet_kv_transfer_retries"] > 0
+    # (c) corrupt wire: every delivery detected by the checksum (the
+    # corrupt counter equals the seam's firings — nothing ingested)
+    flat, plan = run(dict(transfer_corrupt=1.0))
+    assert plan.fired["transfer_corrupt"] > 0
+    assert flat["fleet_kv_transfer_corrupt_detected"] == \
+        plan.fired["transfer_corrupt"]
+    assert flat["fleet_kv_transfers_completed"] == 0
+    assert flat["fleet_prefill_fallbacks"] > 0
+
+
+def test_prefill_crash_mid_stream_falls_back_without_failover(rng):
+    """Killing the prefill replica with prompts mid-prefill degrades
+    those requests to colocated — streams stay identical, the failover
+    budget is untouched (max_failovers=0 proves no migration was
+    charged), and the transfer layer never reads the dead pool."""
+    model = _tiny_model()
+    prompts = [rng.randint(0, TINY["vocab_size"],
+                           (int(rng.randint(12, 26)),)).tolist()
+               for _ in range(4)]
+    sp = ServingPredictor(model, **KW)
+    want = sp.generate(prompts, max_new_tokens=4)
+    router = _router(model, n=3, prefill_replicas=1, max_failovers=0)
+    reqs = [router.submit(p, max_new_tokens=4) for p in prompts]
+    assert any(r.phase == "prefill" for r in reqs)
+    router.tick()                         # prompts begin prefilling
+    router.kill_replica(0, reason="test") # the prefill replica dies
+    _drain(router)
+    assert all(r.state == FINISHED for r in reqs), \
+        [r.error for r in reqs if r.state == FAILED]
+    assert [list(r.output_ids) for r in reqs] == want
+    assert all(r.failover_count == 0 for r in reqs)
+    flat = router.telemetry()
+    assert flat["fleet_failovers"] == 0
+    assert flat["fleet_prefill_fallbacks"] >= 1
+
+
+def test_sample_offset_continues_seeded_streams(rng):
+    """Round-20 serving satellite: a re-admission that carries received
+    tokens in its prompt continues the seeded sample stream via
+    add_request(sample_offset=) — the mechanism behind handoff/failover
+    stream identity (offset 0 restarts the fold: the old behavior)."""
+    model = _tiny_model()
+    prompt = rng.randint(0, TINY["vocab_size"], (6,)).tolist()
+    sampling = dict(temperature=0.9, top_k=5, top_p=0.85, seed=21)
+    sp = ServingPredictor(model, **KW)
+    want = sp.generate([prompt], max_new_tokens=6, **sampling)[0]
+    sp2 = ServingPredictor(model, **KW)
+    r = sp2.add_request(prompt + want[:2], max_new_tokens=4,
+                        sample_offset=2, **sampling)
+    while sp2.has_work():
+        sp2.step()
+    sp2.flush()
+    assert list(r.output_ids) == want[2:]
+    with pytest.raises(ValueError, match="sample_offset"):
+        sp2.add_request(prompt, sample_offset=-1)
+
+
 # -- THE fleet chaos gate ---------------------------------------------------
 
 
-def _run_fleet_churn(model, prompts, *, n=3, gen_len=5, check_every=1):
+def _run_fleet_churn(model, prompts, *, n=3, gen_len=5, check_every=1,
+                     prefill_replicas=0, transfer=None):
     """Drive a continuous-arrival churn through a fleet, asserting the
     fleet-wide accounting partition after EVERY tick. Returns
     (router, reqs, ticks)."""
     router = FleetRouter(
         model, num_replicas=n, seed=3, max_failovers=4,
         dead_stall_ticks=3, restart_ticks=2,
+        prefill_replicas=prefill_replicas, transfer=transfer,
         replica_kw=dict(max_batch=2, page_size=8, max_seq_len=64,
                         retry_backoff_s=0.0))
     queued = list(prompts)
@@ -528,3 +709,70 @@ def test_chaos_churn_with_eos_early_stops(rng):
     for i, r in enumerate(reqs):
         if r.state == FINISHED:
             assert list(r.output_ids) == want[i], f"eos req {i}"
+
+
+def test_chaos_1k_tick_disaggregated_fleet_under_wire_and_replica_faults(
+        rng):
+    """THE round-20 acceptance gate: a >= 1k-tick disaggregated fleet
+    (1 prefill + 2 decode) under ALL FOUR seams — ``transfer_drop`` /
+    ``transfer_corrupt`` on the KV wire plus ``replica_crash`` /
+    ``replica_stall`` on the replicas — where
+
+    - ``tick()`` never raises (wire loss and replica loss are both
+      degradations, never outages),
+    - the fleet accounting partitions exactly after EVERY tick,
+    - every request ends terminal exactly once, none is lost,
+    - every FINISHED stream is bit-identical to the fault-free
+      COLOCATED mirror of the same submissions (a transferred page that
+      was dropped, corrupted, retried or abandoned can never change an
+      emission — the colocated fallback serves the identical stream),
+    - every armed seam actually fired, transfers both completed and
+      failed (the chaos exercised BOTH wire outcomes), and degradation
+      showed up as ``fleet_prefill_fallbacks``, not request failures.
+    """
+    model = _tiny_model()
+    # page-spanning lengths dominate so the wire carries real traffic;
+    # sub-page prompts ride along to keep the colocated path mixed in
+    prompts = [rng.randint(0, TINY["vocab_size"],
+                           (int(rng.randint(3, 26)),)).tolist()
+               for _ in range(720)]
+
+    _, want_reqs, _ = _run_fleet_churn(model, prompts, check_every=50)
+    assert all(r.state == FINISHED for r in want_reqs)
+    want = [list(r.output_ids) for r in want_reqs]
+
+    plan = FaultPlan(seed=37, replica_crash=0.002, replica_stall=0.006,
+                     stall_ticks=2, transfer_drop=0.12,
+                     transfer_corrupt=0.08)
+    with plan:
+        router, reqs, ticks = _run_fleet_churn(
+            model, prompts, prefill_replicas=1,
+            transfer=TransferConfig(window=4, max_retries=2,
+                                    timeout_ticks=1))
+    assert ticks >= 1000, ticks                  # a real 1k-tick churn
+    for seam in ("transfer_drop", "transfer_corrupt", "replica_crash",
+                 "replica_stall"):
+        assert plan.fired[seam] > 0, seam
+
+    assert all(r.state in TERMINAL for r in reqs)
+    finished = [i for i, r in enumerate(reqs) if r.state == FINISHED]
+    assert len(finished) > len(reqs) * 0.9
+    for i in finished:
+        assert list(reqs[i].output_ids) == want[i], f"request {i} diverged"
+    for r in reqs:
+        if r.state == FAILED:
+            assert r.error["code"] == "replica_lost"
+    flat = router.telemetry()
+    # both wire outcomes happened under the seams...
+    assert flat["fleet_kv_transfers_completed"] > 0
+    assert flat["fleet_kv_transfers_failed"] > 0
+    assert flat["fleet_kv_transfer_retries"] > 0
+    assert flat["fleet_kv_transfer_corrupt_detected"] > 0
+    assert flat["fleet_kv_transfer_frames_dropped"] > 0
+    # ...and degradation was counted, never terminal
+    assert flat["fleet_prefill_fallbacks"] > 0
+    assert flat["fleet_requests_finished"] == len(finished)
+    assert flat["fleet_requests_failed"] == len(reqs) - len(finished)
+    acc = router.fleet_accounting()
+    assert acc["submitted"] == acc["finished"] + acc["failed"]
+    assert acc["live"] == 0

@@ -397,6 +397,11 @@ class TestServingTelemetry:
         # the last COMPLETED scheduler round) — how a router tells a
         # stale/stuck replica from a merely quiet one
         "replica_id": lambda v: isinstance(v, int) and v >= 0,
+        # round 20: the disaggregation role and the sender-side unacked
+        # KV-frame backlog (stamped by the fleet router's transfer
+        # drive) — the role-aware routing/scoring surface
+        "role": lambda v: v in ("colocated", "prefill", "decode"),
+        "transfer_backlog": lambda v: isinstance(v, int) and v >= 0,
         "snapshot_age_s": lambda v: isinstance(v, float) and v >= 0,
         "waiting": lambda v: isinstance(v, int) and v >= 0,
         "running": lambda v: isinstance(v, int) and v >= 0,
@@ -490,6 +495,20 @@ class TestServingTelemetry:
                               replica_id=3)
         self._check_healthz(sp.healthz())
         assert sp.healthz()["replica_id"] == 3
+        # round-20 satellite: the role label rides healthz (default
+        # colocated; the fleet router assigns prefill/decode) and the
+        # transfer backlog starts empty
+        assert sp.healthz()["role"] == "colocated"
+        assert sp.healthz()["transfer_backlog"] == 0
+        pre = ServingPredictor(model, max_batch=1, page_size=8,
+                               max_seq_len=64, use_kernel=False,
+                               role="prefill")
+        assert pre.healthz()["role"] == "prefill"
+        self._check_healthz(pre.healthz())
+        with pytest.raises(ValueError, match="role"):
+            ServingPredictor(model, max_batch=1, page_size=8,
+                             max_seq_len=64, use_kernel=False,
+                             role="router")
         sp.add_request(rng.randint(0, TINY["vocab_size"], (5,)),
                        max_new_tokens=2)
         while sp.has_work():

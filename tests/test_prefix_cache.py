@@ -84,6 +84,41 @@ def test_chain_keys_deterministic_across_independent_managers(rng):
     assert prompt_chain_keys(toks[:7], 8) == []
 
 
+def test_transfer_addressing_is_the_same_chain_across_managers(rng):
+    """Round-20 satellite: the KV-transfer wire addresses frames by the
+    SAME sha1 chain the registries and the fleet affinity map hash —
+    an export walk on one manager produces records whose keys a
+    DIFFERENT-GEOMETRY manager derives identically, so an imported page
+    is immediately addressable (and hit) there. Locks the cross-manager
+    half of the disaggregation contract at the cache layer."""
+    from paddle_tpu.inference.kv_cache import prompt_chain_keys
+
+    a = _mgr()
+    b = _mgr(num_pages=24, max_batch=2)          # different geometry
+    toks = rng.randint(0, 50000, (20,)).tolist()  # 2 pages + tail 4
+    s0, _ = a.admit_prefix(toks)
+    a._seq_lens[s0] = len(toks)
+    a.register_prefix(s0, toks)
+    a.free(s0)
+    recs = a.prefix_page_records(toks)
+    assert [r[2] for r in recs] == [8, 8, 4]
+    # full-page keys ARE the module-level chain the router hashes with
+    assert [r[0] for r in recs[:2]] == prompt_chain_keys(toks, 8)
+    # ...and manager B (never having seen A) derives the same chain:
+    # importing under A's exported keys makes B's OWN admission walk
+    # find every page, partial tail included
+    for key, page, ntok in recs:
+        got = b.import_prefix_page(key, ntok,
+                                   a.read_page_payload(page, ntok))
+        assert got == "imported"
+    s1, cached = b.admit_prefix(toks)
+    assert cached == 19                          # all but the one fed token
+    # the export walk stops at the first unregistered link: a foreign
+    # suffix exports only the shared prefix
+    other = toks[:8] + [7] * 12
+    assert [r[2] for r in a.prefix_page_records(other)] == [8]
+
+
 def test_zero_ref_registered_pages_survive_on_lru_until_pressure():
     m = _mgr(num_pages=6)
     toks = list(range(16))
