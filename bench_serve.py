@@ -473,7 +473,9 @@ class _FleetLeg:
     def __init__(self, *, hidden, layers, heads, vocab, batch, prompt,
                  gen_len, page_size, chunk, use_kernel, on_tpu,
                  num_replicas=2, overload=3, prefill_replicas=0,
-                 kv_cache_dtype=None, mixed=False, transfer=None):
+                 kv_cache_dtype=None, mixed=False, transfer=None,
+                 host_tier_bytes=0, prefix_pulls=False,
+                 tiered_churn=False):
         import jax.numpy as jnp
 
         import paddle_tpu as paddle
@@ -499,7 +501,8 @@ class _FleetLeg:
         # saturated prefill queue (the colocated partner runs the same
         # cap: same long pressure on both legs)
         self._long_reqs = []
-        max_len = ((self.long_len if mixed else prompt) + gen_len + 32)
+        max_len = ((self.long_len if (mixed or tiered_churn) else prompt)
+                   + gen_len + 32)
         paddle.seed(0)
         cfg = GPTConfig(vocab_size=vocab, hidden_size=hidden,
                         num_layers=layers, num_heads=heads,
@@ -510,16 +513,30 @@ class _FleetLeg:
         self.router = FleetRouter(
             model, num_replicas=num_replicas, seed=0,
             prefill_replicas=prefill_replicas, transfer=transfer,
+            prefix_pulls=prefix_pulls,
             replica_kw=dict(
                 max_batch=batch, page_size=page_size, max_seq_len=max_len,
                 use_kernel=use_kernel, chunk=chunk,
                 dtype=jnp.bfloat16 if on_tpu else None,
+                # round 21: 0 keeps the pre-tier drop-on-evict behavior
+                host_tier_bytes=host_tier_bytes,
                 # the bounded queue makes the flood shed deterministically
                 slo=SLOConfig(max_waiting=batch + 2)))
         rng = np.random.RandomState(0)
-        self.pool = [rng.randint(0, vocab, (max(2, prompt // 2)
-                                            if mixed else prompt,))
-                     for _ in range(max(2, batch // 2))]
+        if tiered_churn:
+            # round 21: a REUSED working set of distinct multi-page
+            # prompts that deliberately OVERFLOWS the HBM pool's
+            # zero-ref headroom — by the time a prompt comes back
+            # around the cycle, its prefix pages have been LRU-evicted.
+            # Without a host tier that eviction is a drop (the repeat
+            # recomputes); with one it is a spill (the repeat restores)
+            # — exactly the gap the tiered A/B measures.
+            self.pool = [rng.randint(0, vocab, (self.long_len,))
+                         for _ in range(3 * num_replicas * batch)]
+        else:
+            self.pool = [rng.randint(0, vocab, (max(2, prompt // 2)
+                                                if mixed else prompt,))
+                         for _ in range(max(2, batch // 2))]
         self.arrivals = 0
         self.reqs = []
         self.target_live = num_replicas * batch * overload
@@ -724,6 +741,138 @@ def bench_serving_disagg(*, steps, windows, **leg_kw):
     out["fault_free_fallback_count"] = int(ff["fleet_prefill_fallbacks"])
     out["telemetry"] = flat
     return colo_out, out
+
+
+def _fleet_kv_flat(leg) -> dict:
+    """Fleet-aggregate KV-cache telemetry: the per-replica serving
+    registries summed over live replicas (the tier counters and the
+    prefix hit/query token counters live there, not on the fleet
+    registry)."""
+    out = {}
+    for rep in leg.router.replicas:
+        if rep.sp is None:
+            continue
+        for k, v in rep.sp.telemetry().items():
+            if k.startswith("kv_"):
+                out[k] = out.get(k, 0.0) + v
+    return out
+
+
+def bench_serving_tiered(*, steps, windows, **leg_kw):
+    """The round-21 tiered-KV leg: the SAME reused-prompt churn — a
+    working set of distinct multi-page prompts that deliberately
+    OVERFLOWS the HBM pool's zero-ref headroom — through a fleet with
+    the host-DRAM spill tier + cross-replica pulls armed vs a no-tier
+    partner, windows interleaved so machine drift hits both alike. On
+    the no-tier fleet a prompt's second coming recomputes its prefix
+    (the pages were dropped at eviction); on the tiered fleet it
+    restores from the host tier (or pulls from the owning replica), so
+    the strict gates are ``prefix_hit_rate`` strictly HIGHER and TTFT
+    p99 strictly LOWER than the partner on the same arrival sequence.
+
+    After the fault-free windows, a drain on the busiest-affinity
+    replica forces the pulls deterministically (its repeats must route
+    elsewhere and pull over the wire — ``cross_replica_pulls >= 1``
+    never rides on a probabilistic race), then a chaos pass arms the
+    round-21 seams (``host_spill_drop`` + ``tier_restore_corrupt``):
+    lost spills and corrupted payloads are DETECTED and degrade to
+    recompute — counted, never failed, never scattered into the pool.
+    Returns ``(notier_out, tiered_out)``; the partner keys ride the
+    tiered dict."""
+    from paddle_tpu.inference import FaultPlan, TransferConfig
+
+    tcfg = TransferConfig(window=4, max_retries=1, timeout_ticks=1)
+    common = dict(num_replicas=2, overload=2, tiered_churn=True, **leg_kw)
+    tier = _FleetLeg(host_tier_bytes=64 << 20, prefix_pulls=True,
+                     transfer=tcfg, **common)
+    base = _FleetLeg(**common)
+    tier.warm()
+    base.warm()
+    with _gc_frozen():
+        # one unrecorded window each: the first eviction cycle is where
+        # the tier's spills first READ their payloads and the restore
+        # scatter compiles its pad widths — the timed windows compare
+        # warm executables on both sides, like every other A/B here
+        tier.window(steps, record=False)
+        base.window(steps, record=False)
+        # the TTFT population starts at the timed phase too
+        tier.timed_from = len(tier.reqs)
+        base.timed_from = len(base.reqs)
+        for _ in range(windows):
+            tier.window(steps)
+            base.window(steps)
+        # fault-free snapshots: the gated tier counters and the TTFT
+        # populations must exclude the drain exercise and the chaos
+        # pass. TTFT lists are captured NOW, not at report time — a
+        # request still pending here would otherwise collect its first
+        # token during the drain/chaos windows and bill their wall
+        # clock to the fault-free tail (the no-tier partner never ticks
+        # again, so its pending requests would silently drop instead:
+        # an asymmetric population, not a comparison)
+        ff_kv = _fleet_kv_flat(tier)
+        tier_ttfts = list(tier.ttft_ms())
+        base_ttfts = list(base.ttft_ms())
+        # deterministic cross-replica pull: drain the replica owning
+        # the deepest share of the affinity map — its repeats must
+        # route to the other replica, which misses locally and PULLS
+        # the prefix over the transfer wire (a DRAINING replica is a
+        # valid pull source) instead of recomputing
+        aff = list(tier.router._affinity.values())
+        owner = max(set(aff), key=aff.count) if aff else 0
+        tier.router.drain(owner)
+        for _ in range(6):
+            tier.window(steps, record=False)
+            if tier.router.telemetry()[
+                    "fleet_prefix_pulls_completed"] >= 1:
+                break
+        tier.router.resume(owner)
+        # the chaos pass: lost spills + corrupted host payloads —
+        # bounded repeats until both seams demonstrably fired AND the
+        # corruption was detected (dropped + counted, degraded to a
+        # recompute miss); NOT recorded into the medians
+        with FaultPlan(seed=13, host_spill_drop=0.75,
+                       tier_restore_corrupt=1.0):
+            for _ in range(6):
+                tier.window(steps, record=False)
+                chaos_kv = _fleet_kv_flat(tier)
+                if (chaos_kv["kv_tier_spill_drops"]
+                        > ff_kv["kv_tier_spill_drops"]
+                        and chaos_kv["kv_tier_restore_corrupt"]
+                        > ff_kv["kv_tier_restore_corrupt"]):
+                    break
+    base_out = base.report()
+    out = tier.report()
+    post_kv = _fleet_kv_flat(tier)
+    flat = tier.router.telemetry()   # post-pull/post-chaos fleet totals
+    # both hit-rate figures are fault-free-window snapshots on the SAME
+    # arrival sequence — the strictly-higher gate compares like for like
+    out["prefix_hit_rate"] = round(
+        ff_kv["kv_prefix_hit_tokens"]
+        / max(1.0, ff_kv["kv_prefix_query_tokens"]), 4)
+    base_kv = _fleet_kv_flat(base)
+    out["notier_prefix_hit_rate"] = round(
+        base_kv["kv_prefix_hit_tokens"]
+        / max(1.0, base_kv["kv_prefix_query_tokens"]), 4)
+    out["tier_hit_rate"] = round(
+        ff_kv["kv_tier_hits"] / max(1.0, ff_kv["kv_tier_lookups"]), 4)
+    out["spill_bytes"] = int(ff_kv["kv_tier_spill_bytes"])
+    out["restore_bytes"] = int(ff_kv["kv_tier_restore_bytes"])
+    out["cross_replica_pulls"] = int(flat["fleet_prefix_pulls_completed"])
+    out["pull_fallback_count"] = int(flat["fleet_prefix_pull_fallbacks"])
+    # chaos accounting: fired-and-detected, on top of the fault-free
+    # figures (which must be exactly 0 — no corruption without the seam)
+    out["tier_spill_drops"] = int(post_kv["kv_tier_spill_drops"])
+    out["tier_corrupt_detected"] = int(post_kv["kv_tier_restore_corrupt"])
+    out["fault_free_corrupt_detected"] = int(
+        ff_kv["kv_tier_restore_corrupt"])
+    out["ttft_p50_ms"] = round(_percentile(tier_ttfts, 50), 2)
+    out["ttft_p99_ms"] = round(_percentile(tier_ttfts, 99), 2)
+    out["notier_tokens_per_s"] = base_out["value"]
+    out["notier_ttft_p99_ms"] = round(_percentile(base_ttfts, 99), 2)
+    out["vs_baseline"] = (round(out["value"] / base_out["value"], 3)
+                          if base_out["value"] else 0.0)
+    out["telemetry"] = flat
+    return base_out, out
 
 
 def bench_serving_overload(*, steps, windows, **leg_kw):
@@ -1009,6 +1158,14 @@ def main():
         # interleaved; a certainty-armed transfer_drop chaos pass shows
         # graceful colocated fallback on the same line
         ("fleet-disagg", None),
+        # round-21 tiered-KV A/B: the SAME reused-prompt churn (a
+        # working set overflowing the HBM pool's zero-ref headroom)
+        # through a host-tiered fleet with cross-replica pulls vs a
+        # no-tier partner, measured interleaved — spill/restore bytes,
+        # tier hit rate and deterministic drain-forced pulls on the
+        # line; a chaos pass arms the host_spill_drop /
+        # tier_restore_corrupt seams (detected, degraded, never failed)
+        ("fleet-tiered", None),
         # round-16 A/B: the SAME int8w+int8kv churn with the decode hot
         # loop per-op vs megakernelized (fused per-layer Pallas kernels,
         # activations pinned in VMEM) — measured interleaved, greedy
@@ -1144,6 +1301,15 @@ def main():
                 # the disagg line (colocated_* keys; vs_baseline is
                 # disagg/colocated on the interleaved pair)
                 results[name] = dict(metric=metric_for(name), **out)
+            elif name == "fleet-tiered":
+                _base_out, out = bench_serving_tiered(
+                    on_tpu=on_tpu, use_kernel=use_kernel,
+                    steps=shape["steps"], windows=2,
+                    **{k: v for k, v in shape.items() if k != "steps"})
+                # the no-tier partner's throughput/hit-rate/TTFT already
+                # ride the tiered line (notier_* keys; vs_baseline is
+                # tiered/no-tier on the interleaved pair)
+                results[name] = dict(metric=metric_for(name), **out)
             elif name == "unified-obs":
                 off_out, on_out, ratio = bench_serving_obs_ab(
                     unified=True, on_tpu=on_tpu, use_kernel=use_kernel,
@@ -1225,6 +1391,11 @@ def main():
     # colocated partner: vs_baseline = disagg/colocated tokens/s on the
     # SAME mixed churn; the TTFT-p99 pair is the headline comparison)
     _emit("fleet-disagg", None)
+    # round-21 tiered-KV leg (self-baselined on its interleaved no-tier
+    # partner: vs_baseline = tiered/no-tier tokens/s on the SAME
+    # pool-overflowing reused churn; the hit-rate/TTFT-p99 pair is the
+    # headline comparison)
+    _emit("fleet-tiered", None)
     # round-16 flagship LAST: the megakernelized int8w+int8kv decode A/B
     # (self-baselined on its interleaved mega-off partner)
     _emit("unified-mega", None)

@@ -111,7 +111,21 @@ _OPTIONAL_NUMERIC = ("vs_baseline", "p50_ms", "p99_ms", "anchor_tflops",
                      # sources over one churn must emit identically)
                      "draft_overhead_frac", "ngram_tokens_per_s",
                      "ngram_accepted_tokens_per_step",
-                     "spec_emissions_match")
+                     "spec_emissions_match",
+                     # round 21: the tiered-KV leg — host-tier hit rate
+                     # over the fault-free windows, spill/restore payload
+                     # bytes, cross-replica prefix pulls (drain-forced:
+                     # never a probabilistic race), pull degradations,
+                     # the chaos pass's fired-and-detected counts (the
+                     # fault-free corruption figure must be exactly 0),
+                     # and the interleaved no-tier partner's stats the
+                     # strictly-higher-hit-rate / strictly-lower-TTFT
+                     # gates compare against
+                     "tier_hit_rate", "spill_bytes", "restore_bytes",
+                     "cross_replica_pulls", "pull_fallback_count",
+                     "tier_spill_drops", "tier_corrupt_detected",
+                     "fault_free_corrupt_detected", "notier_tokens_per_s",
+                     "notier_prefix_hit_rate", "notier_ttft_p99_ms")
 _OPTIONAL_STRING = ("mesh_shape", "comm_quant")
 
 #: the bench_serve leg-name enum (round 16): every serving line carries
@@ -124,6 +138,7 @@ KNOWN_LEGS = frozenset((
     "unified-spmd", "unified-spec-base", "unified-spec-k4",
     "unified-spec-model", "unified-int8w", "unified-int8w-int8kv",
     "unified-mega", "unified-overload", "fleet-churn", "fleet-disagg",
+    "fleet-tiered",
 ))
 
 

@@ -58,7 +58,18 @@ builds of exactly the programs that carry the repo's numbers:
                   audit at the feedback-shifted pool positions — a
                   dispatch-ahead step that silently stopped aliasing its
                   pools would double cache memory exactly when two steps
-                  are in flight.
+                  are in flight;
+- ``serving-tiered``  the round-21 tiered KV cache's batched restore
+                  scatter (``batched_import_rows`` — the ONE donated
+                  ``pages.at[:, pg, row].set(..., mode="drop")`` jit a
+                  host-tier restore round or transfer tick issues per
+                  (K, V, scale) plane): jaxpr walk over BOTH plane
+                  geometries (the 5D fp and int8 pools, the 4D fp32
+                  scale plane) + the JX005 donation audit of the pool
+                  at argument 0 — an undonated restore would copy the
+                  whole HBM pool per plane per round, exactly the
+                  eager per-page cost the batched path exists to
+                  retire.
 
 Configs are tiny (seconds on CPU; the analysis is abstract — eval_shape /
 make_jaxpr, no FLOPs run) but structurally identical to the flagship
@@ -802,6 +813,48 @@ def analyze_serving_mega() -> list[Finding]:
     return findings
 
 
+def analyze_serving_tiered() -> list[Finding]:
+    """Round 21: the tiered KV cache's batched restore landing —
+    :func:`paddle_tpu.inference.kv_cache.batched_import_rows`, the one
+    jitted scatter a host-tier restore round (or a batched transfer
+    tick) issues per (K, V, scale) plane. The jaxpr walk covers every
+    plane geometry the landing zone drives it with — the 5D fp pool,
+    the 5D int8 pool, and the 4D fp32 scale plane — at a
+    power-of-two-padded row width (the pad rows route to the
+    ``num_pages`` out-of-bounds sentinel and drop, so the trace is the
+    production trace); JX005 audits the pool donation at argument 0."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from ..inference.kv_cache import KVCacheManager, batched_import_rows
+
+    mgr = KVCacheManager(2, 2, 8, num_pages=8, max_batch=2,
+                         max_seq_len=32, page_size=8, dtype=jnp.float32,
+                         enable_prefix_cache=True)
+    qmgr = KVCacheManager(2, 2, 8, num_pages=8, max_batch=2,
+                          max_seq_len=32, page_size=8,
+                          enable_prefix_cache=True, quantize_kv=True)
+    cap = 16                                 # one padded restore round
+    rng = np.random.RandomState(0)
+    pg = jnp.asarray(rng.randint(0, 8, (cap,)), jnp.int32)
+    row = jnp.asarray(np.tile(np.arange(8, dtype=np.int32), 2))
+    findings: list[Finding] = []
+    for target, pool, vals in (
+            ("serving-tiered-restore-fp", mgr.k_pages,
+             jnp.zeros((2, cap, 2, 8), mgr.k_pages.dtype)),
+            ("serving-tiered-restore-int8", qmgr.k_pages,
+             jnp.zeros((2, cap, 2, 8), qmgr.k_pages.dtype)),
+            ("serving-tiered-restore-scale", qmgr.k_scales,
+             jnp.zeros((2, cap, 2), qmgr.k_scales.dtype))):
+        args = (pool, vals, pg, row)
+        findings += analyze_jaxpr(trace_callable(batched_import_rows,
+                                                 *args), target)
+        findings += check_donation(batched_import_rows, args, (0,),
+                                   target)
+    return findings
+
+
 TARGETS = {
     "gpt-eager": analyze_gpt_eager,
     "bert-eager": analyze_bert_eager,
@@ -815,6 +868,7 @@ TARGETS = {
     "serving-spec-model": analyze_serving_spec_model,
     "serving-async": analyze_serving_async,
     "serving-mega": analyze_serving_mega,
+    "serving-tiered": analyze_serving_tiered,
 }
 
 

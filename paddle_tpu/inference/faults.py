@@ -58,6 +58,21 @@ retransmits):
   receiver (detected -> nack -> retransmit), never silently ingested —
   the contract tests/test_kv_transfer.py locks.
 
+Round 21 adds the HOST-TIER seams (``inference/kv_cache.py`` hits them
+on the spill/restore paths of the tiered KV cache):
+
+- ``host_spill_drop`` — a RETURNING seam: a fired hit returns ``True``
+  and the cache manager silently loses the spill (the page evicts
+  without its payload reaching the host tier — the model of a failed
+  DMA / an OOM-killed host buffer). Purely a cache-effectiveness loss:
+  the next admission recomputes, counted, never failed.
+- ``tier_restore_corrupt`` — a RETURNING seam: a fired hit returns
+  ``True`` and the cache manager flips a byte of the STORED host-tier
+  payload before its restore checksum runs. The corruption MUST be
+  detected (entry dropped + counted, the lookup degrades to a
+  recompute miss), never scattered into the device pool — the contract
+  tests/test_faults.py and tests/test_prefix_cache.py lock.
+
 Raising seams model CRASHES, so they raise **before** the operation they
 name (a half-applied operation is the scheduler's job to make
 impossible, not the plan's). ``plan.fired`` counts firings per seam for
@@ -76,7 +91,7 @@ __all__ = ["FaultPlan", "InjectedFault", "SEAMS", "active_plan",
 #: the named seams a plan may arm (a typo'd rate kwarg fails at __init__)
 SEAMS = ("pool", "h2d", "dispatch", "slow_step", "reconcile",
          "replica_crash", "replica_stall", "transfer_drop",
-         "transfer_corrupt")
+         "transfer_corrupt", "host_spill_drop", "tier_restore_corrupt")
 
 #: the armed plan; None = disarmed (the zero-cost fast path)
 _PLAN: "FaultPlan | None" = None
@@ -124,13 +139,17 @@ class FaultPlan:
                  squeeze_steps: int = 2, replica_crash: float = 0.0,
                  replica_stall: float = 0.0, stall_ticks: int = 2,
                  transfer_drop: float = 0.0,
-                 transfer_corrupt: float = 0.0):
+                 transfer_corrupt: float = 0.0,
+                 host_spill_drop: float = 0.0,
+                 tier_restore_corrupt: float = 0.0):
         rates = {"dispatch": dispatch, "h2d": h2d, "reconcile": reconcile,
                  "slow_step": slow_step, "pool": pool_squeeze,
                  "replica_crash": replica_crash,
                  "replica_stall": replica_stall,
                  "transfer_drop": transfer_drop,
-                 "transfer_corrupt": transfer_corrupt}
+                 "transfer_corrupt": transfer_corrupt,
+                 "host_spill_drop": host_spill_drop,
+                 "tier_restore_corrupt": tier_restore_corrupt}
         for name, p in rates.items():
             if not 0.0 <= float(p) <= 1.0:
                 raise ValueError(f"{name} rate must be in [0, 1], got {p}")
@@ -199,10 +218,14 @@ class FaultPlan:
                 self.fired["replica_stall"] += 1
                 return self.stall_ticks
             return None
-        if seam in ("transfer_drop", "transfer_corrupt"):
+        if seam in ("transfer_drop", "transfer_corrupt",
+                    "host_spill_drop", "tier_restore_corrupt"):
             # RETURNING seams: the transfer layer applies the loss /
             # byte-flip to its own wire bytes (a corrupt frame must
-            # reach the receiver so the checksum DETECTS it)
+            # reach the receiver so the checksum DETECTS it); the
+            # round-21 host-tier seams work the same way on the cache
+            # manager's spill/restore paths (a corrupt stored payload
+            # must reach the restore checksum so it DETECTS it)
             if self.rates[seam] and self.rng.rand() < self.rates[seam]:
                 self.fired[seam] += 1
                 return True

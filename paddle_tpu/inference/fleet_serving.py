@@ -181,6 +181,11 @@ class FleetRequest:
         # shedding it (a submit-time degradation spent nothing and
         # stays shed-able — colocated-fleet parity under flood)
         self.prefill_spent = False
+        # round 21: one cross-replica prefix pull per request, ever —
+        # a failed pull (or a failover after a successful one) falls
+        # back to colocated recompute instead of re-chasing pages
+        # around a churning fleet
+        self.pull_attempted = False
 
     @property
     def ttft(self) -> float | None:
@@ -241,7 +246,7 @@ class FleetRouter:
                  stale_after_s=5.0, dead_stall_ticks=4, restart_ticks=1,
                  max_affinity_entries=1 << 16, metrics=None,
                  replica_kw=None, prefill_replicas=0, transfer=None,
-                 min_transfer_tokens=None):
+                 min_transfer_tokens=None, prefix_pulls=False):
         self.num_replicas = int(num_replicas)
         if self.num_replicas < 1:
             raise ValueError(f"num_replicas must be >= 1, "
@@ -262,6 +267,13 @@ class FleetRouter:
                              f"None, got {type(transfer).__name__}")
         self.transfer_cfg = (transfer if transfer is not None
                              else TransferConfig())
+        # round 21: fleet-global tiered prefixes — a prefix miss on the
+        # routed replica that hits on another replica (its pool OR its
+        # host tier) becomes a KV-page pull over the transfer wire
+        # instead of a recompute. Opt-in: pulls add a transfer phase in
+        # front of the admission, so latency-sensitive small fleets can
+        # keep the pre-21 place-and-recompute behavior.
+        self.prefix_pulls = bool(prefix_pulls)
         self.max_failovers = int(max_failovers)
         if self.max_failovers < 0:
             raise ValueError(f"max_failovers must be >= 0, "
@@ -498,6 +510,12 @@ class FleetRouter:
                     freq.state = WAITING
                     self._unrouted.append(freq)
                 return False
+            # round 21: before a miss recomputes, try pulling the
+            # prefix's pages off the replica that owns them (the
+            # affinity map knows) — the request parks in a transfer
+            # phase and admits where the pages land
+            if not hit and self._maybe_pull(freq, rep, keys):
+                return True
             if self._admit_on(freq, rep, keys, hit):
                 return True
             # the verdict raced between the gate and the admission (the
@@ -761,7 +779,63 @@ class FleetRouter:
         freq.phase = "transfer"
         freq.decode_rid = dst.rid
         freq._transfer = t
-        self._transfers.append((t, freq, hit))
+        self._transfers.append((t, freq, hit, "handoff"))
+
+    def _maybe_pull(self, freq: FleetRequest, dst: _Replica,
+                    keys) -> bool:
+        """Round 21: the routed replica ``dst`` misses this context's
+        prefix, but the affinity map names another replica that owns it
+        — open a KV-page pull over the transfer wire instead of
+        recomputing. The source's export walk is restore-aware, so a
+        prefix that slid into the OWNER's host tier still serves the
+        pull. One attempt per request; every unhappy path degrades to
+        the ordinary recompute placement (counted, never failed).
+        Returns True when the request parked in the transfer phase."""
+        if not self.prefix_pulls or freq.pull_attempted or not keys:
+            return False
+        ctx = freq.prompt_ids + freq.output_ids
+        if len(ctx) < self.min_transfer_tokens:
+            return False
+
+        def owns(r):
+            # DRAINING replicas are ideal pull sources (their warm
+            # prefixes are about to be lost); only a DEAD replica's
+            # pool is unreadable
+            return (r.state != DEAD and r.sp is not None
+                    and r.rid != dst.rid)
+
+        src = self._affinity_walk(keys, owns)
+        if src is None:
+            return False
+        records = src.sp.cache.prefix_page_records(ctx)
+        if not records \
+                or sum(r[2] for r in records) < self.min_transfer_tokens:
+            return False
+        # make room on the destination BEFORE opening the stream: the
+        # import landing zone never evicts (the locked pressure
+        # contract), so a saturated pool must shed its coldest zero-ref
+        # pages down the eviction ladder first — if the room is not
+        # there, recompute instead of opening a doomed transfer
+        if not dst.sp.cache.reserve_import_room(len(records)):
+            return False
+        freq.pull_attempted = True
+        # started counts BEFORE construction (same contract as
+        # _handoff: started >= completed + failed always holds)
+        self.inst.transfers_started.inc()
+        self.inst.pulls_started.inc()
+        t = KVPageTransfer(
+            records, self._cache_fn(src), self._cache_fn(dst),
+            config=self.transfer_cfg, instruments=self.inst,
+            src_rid=src.rid, dst_rid=dst.rid)
+        if t.state != T_SENDING:
+            self.inst.pull_fallbacks.inc()
+            return False                 # admit normally: recompute
+        freq.phase = "transfer"
+        freq.state = RUNNING
+        freq.decode_rid = dst.rid
+        freq._transfer = t
+        self._transfers.append((t, freq, False, "pull"))
+        return True
 
     def _complete_handoff(self, freq: FleetRequest, hit: bool) -> None:
         """Every page landed: admit the decode stage where the pages
@@ -798,15 +872,34 @@ class FleetRouter:
             return
         self._try_route(freq)
 
+    def _pull_fallback(self, freq: FleetRequest, why: str) -> None:
+        """A cross-replica pull died on the wire: re-route the request
+        for ordinary colocated recompute. Mirrors :meth:`_fallback` but
+        charges the round-21 pull counter, NOT ``prefill_fallbacks`` —
+        the disagg bench's fault-free-fallbacks-stay-zero gate must not
+        see pull weather. ``why`` is telemetry-only."""
+        if freq.state in (FINISHED, FAILED):
+            return
+        freq._transfer = None
+        freq._inner = None
+        freq.replica_id = None
+        freq.phase = "decode"
+        self.inst.pull_fallbacks.inc()
+        if freq.done:
+            self._finish(freq)
+            return
+        self._try_route(freq)
+
     def _drive_transfers(self) -> None:
-        """One tick of wire work for every live transfer, plus the
-        transfer-phase deadline sweep (a request streaming its pages is
-        on no replica — nobody else's TTL sweep covers it) and the
-        sender-side backlog stamps the healthz surface reads."""
+        """One tick of wire work for every live transfer (prefill
+        handoffs AND round-21 prefix pulls), plus the transfer-phase
+        deadline sweep (a request streaming its pages is on no replica
+        — nobody else's TTL sweep covers it) and the sender-side
+        backlog stamps the healthz surface reads."""
         if self._transfers:
             now = monotonic()
             live = []
-            for t, freq, hit in self._transfers:
+            for t, freq, hit, kind in self._transfers:
                 if freq.state in (FINISHED, FAILED):
                     t.abort("fleet request terminal")
                     continue
@@ -819,14 +912,19 @@ class FleetRouter:
                     continue
                 state = t.tick()
                 if state == T_SENDING:
-                    live.append((t, freq, hit))
+                    live.append((t, freq, hit, kind))
                 elif state == T_DONE:
+                    if kind == "pull":
+                        self.inst.pulls_completed.inc()
                     self._complete_handoff(freq, hit)
+                elif kind == "pull":
+                    self._pull_fallback(freq,
+                                        t.failure or "pull failed")
                 else:
                     self._fallback(freq, t.failure or "transfer failed")
             self._transfers = live
         backlog: dict[int, int] = {}
-        for t, _, _ in self._transfers:
+        for t, *_ in self._transfers:
             backlog[t.src_rid] = backlog.get(t.src_rid, 0) + t.backlog
         for rep in self._prefill_reps():
             if rep.sp is not None:

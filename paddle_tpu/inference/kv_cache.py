@@ -39,15 +39,34 @@ Divergence is handled copy-on-write: a slot about to write into a page
 with refcount >= 2 gets a fresh copy via :meth:`prepare_write` — the
 device-side page copy is traced into the unified step (cow_src/cow_dst
 lanes), so shared immutable pages are never mutated.
+
+Round 21 adds the HOST TIER: a bounded host-DRAM buffer UNDER the HBM
+pool. A zero-ref prefix page falling off the LRU no longer just drops —
+its payload (K/V rows, int8 scale planes, partial tails included)
+spills to the host keyed by the SAME sha1 chain key, checksummed at
+spill time. A later admission (or export walk) whose chain breaks on
+the device registry but continues in the host tier re-admits the
+missing links through the batched import landing zone
+(:meth:`KVCacheManager.import_prefix_pages` — ONE donated scatter per
+K/V/scale plane per restore round, not a full pool copy per page) and
+the normal match walk then pins them like never-evicted pages. Eviction
+ordering is HBM -> host -> drop: the host tier runs its own LRU under
+its byte budget, and a tier entry whose checksum fails at restore is
+DETECTED, dropped and counted — degrading to a recompute, never
+scattering corrupt bytes into the pool.
 """
 from __future__ import annotations
 
 import math
+import zlib
 from collections import OrderedDict
 
 import numpy as np
 
+import jax
 import jax.numpy as jnp
+
+from .faults import fault_point
 
 
 def pages_needed(length: int, page_size: int) -> int:
@@ -227,6 +246,39 @@ def paged_copy_pages(pages, src, dst):
     return pages.at[:, dst].set(pages[:, src_c], mode="drop")
 
 
+def batched_import_rows(pages, vals, pg, row):
+    """Land one restore round's token rows in ONE scatter — the round-21
+    batched import/restore write (tpulint flagship: ``serving-tiered``).
+
+    pages: ``[L, P, page_size, kv_heads, head_dim]`` (or a 4-D scale
+    plane ``[L, P, page_size, kv_heads]``); vals: ``[L, R, kv_heads,
+    head_dim]`` (resp. ``[L, R, kv_heads]``) — flat row ``r`` lands at
+    ``pages[:, pg[r], row[r]]``. Padding rows carry ``pg == P`` (the
+    out-of-bounds sentinel) and drop, so one power-of-two-padded trace
+    serves every restore round of that width.
+    """
+    return pages.at[:, pg, row].set(vals, mode="drop")
+
+
+#: the jitted batched-import entry point: the pool argument is DONATED —
+#: a restore round updates the (potentially multi-GiB) pool in place
+#: instead of materializing a second copy per plane. The 5-D K/V pools
+#: and the 4-D scale planes each trace once per padded row width.
+_batched_import_rows_jit = jax.jit(batched_import_rows,
+                                   donate_argnums=(0,))
+
+
+def _payload_crc(planes: dict) -> int:
+    """The host-tier integrity checksum: one crc32 over every plane's
+    bytes in plane-name order — computed at spill time, verified at
+    restore (a corrupt stored payload must be DETECTED, never scattered
+    into the device pool)."""
+    crc = 0
+    for name in sorted(planes):
+        crc = zlib.crc32(planes[name].tobytes(), crc)
+    return crc
+
+
 # ---------------------------------------------------------------------------
 # host-side manager
 # ---------------------------------------------------------------------------
@@ -245,7 +297,8 @@ class KVCacheManager:
     def __init__(self, num_layers, num_kv_heads, head_dim, *, num_pages,
                  max_batch, max_seq_len, page_size=None, num_q_heads=None,
                  dtype=jnp.float32, enable_prefix_cache=False,
-                 quantize_kv=False, mesh=None, metrics=None):
+                 quantize_kv=False, mesh=None, metrics=None,
+                 host_tier_bytes=0):
         from ..ops.pallas.paged_attention import preferred_page_size
 
         if page_size is None:
@@ -322,6 +375,21 @@ class KVCacheManager:
         self._page_key: dict[int, bytes] = {}    # page -> chain key
         self._prefix_pages: dict[bytes, int] = {}  # chain key -> page
         self._lru: OrderedDict[int, None] = OrderedDict()
+        # round 21: the HOST TIER under the HBM pool — spilled page
+        # payloads keyed by chain key, LRU-ordered under a byte budget
+        # (0 disables: evictions drop exactly like pre-21). Entries are
+        # (ntok, planes dict of host numpy arrays, nbytes, crc32).
+        self.host_tier_limit = int(host_tier_bytes or 0)
+        if self.host_tier_limit < 0:
+            raise ValueError(
+                f"host_tier_bytes must be >= 0, got {host_tier_bytes}")
+        self._host_tier: OrderedDict[
+            bytes, tuple[int, dict, int, int]] = OrderedDict()
+        self._host_tier_nbytes = 0
+        # per-page registered token count — the spill path must know how
+        # many rows of a page are REAL prefix payload (partial tails
+        # spill exactly their fill, never padding rows)
+        self._page_ntok: dict[int, int] = {}
         # round 15: pool telemetry — occupancy gauges + prefix/eviction/
         # CoW counters on the observability registry (the serving
         # predictor shares its registry so one snapshot covers the stack)
@@ -353,6 +421,40 @@ class KVCacheManager:
             "kv_pages_trimmed", "pages released by draft rollback")
         self._m_withheld = m.gauge(
             "kv_pages_withheld", "pages withheld from circulation")
+        # round 21: host-tier instruments — registered unconditionally
+        # (a disabled tier reads zeros) so the flat-snapshot schema is
+        # identical with and without a tier
+        self._m_tier_pages = m.gauge(
+            "kv_tier_pages", "page payloads held in the host tier")
+        self._m_tier_bytes = m.gauge(
+            "kv_tier_bytes", "host-tier bytes in use")
+        self._m_tier_spills = m.counter(
+            "kv_tier_spills", "evicted pages spilled to the host tier")
+        self._m_tier_spill_bytes = m.counter(
+            "kv_tier_spill_bytes", "payload bytes written to the host "
+            "tier by spills")
+        self._m_tier_restores = m.counter(
+            "kv_tier_restores", "host-tier pages re-admitted to the pool")
+        self._m_tier_restore_bytes = m.counter(
+            "kv_tier_restore_bytes", "payload bytes restored from the "
+            "host tier")
+        self._m_tier_lookups = m.counter(
+            "kv_tier_lookups", "chain links probed against the host tier")
+        self._m_tier_hits = m.counter(
+            "kv_tier_hits", "host-tier probes that returned a verified "
+            "payload")
+        self._m_tier_evictions = m.counter(
+            "kv_tier_evictions", "host-tier entries dropped by its own "
+            "LRU (the HBM -> host -> drop ladder's last rung)")
+        self._m_tier_spill_drops = m.counter(
+            "kv_tier_spill_drops", "spills lost at the host_spill_drop "
+            "seam")
+        self._m_tier_corrupt = m.counter(
+            "kv_tier_restore_corrupt", "host-tier payloads rejected by "
+            "the restore checksum (detected, dropped, recomputed)")
+        self._m_restore_scatters = m.counter(
+            "kv_tier_restore_device_calls", "device scatter calls issued "
+            "by batched imports (one per plane per round)")
         self._note_occupancy()
 
     def _note_occupancy(self) -> None:
@@ -363,6 +465,8 @@ class KVCacheManager:
         self._m_pages_evictable.set(len(self._lru))
         self._m_slots_free.set(len(self._free_slots))
         self._m_withheld.set(len(self._withheld))
+        self._m_tier_pages.set(len(self._host_tier))
+        self._m_tier_bytes.set(self._host_tier_nbytes)
 
     # -- back-compat metric reads (pre-round-15 attribute surface) ---------
 
@@ -405,15 +509,197 @@ class KVCacheManager:
 
     def _alloc_page(self) -> int:
         """Claim one page: the free list first, then evict the LRU tail of
-        the zero-ref registered pages (unregistering it)."""
+        the zero-ref registered pages (unregistering it — round 21: its
+        payload spills to the host tier first instead of dropping)."""
         if self._free_pages:
             return self._free_pages.pop()
         if self._lru:
             page, _ = self._lru.popitem(last=False)   # oldest
-            del self._prefix_pages[self._page_key.pop(page)]
+            key = self._page_key.pop(page)
+            del self._prefix_pages[key]
+            ntok = self._page_ntok.pop(page, 0)
+            self._spill_page(key, page, ntok)
             self._m_evictions.inc()
             return page
         raise RuntimeError("cache exhausted: no free or evictable pages")
+
+    # -- host tier (round 21) ----------------------------------------------
+
+    def _spill_page(self, key: bytes, page: int, ntok: int) -> bool:
+        """Spill one evicted page's payload to the host tier (the middle
+        rung of the HBM -> host -> drop eviction ladder). Content-
+        addressed: a key already resident only refreshes its recency —
+        identical tokens hash to identical keys, so the stored payload
+        is already the right bytes. Host pressure evicts the tier's own
+        LRU head (the final drop). Returns True when the payload is
+        resident after the call."""
+        if not self.host_tier_limit or not ntok \
+                or not self.enable_prefix_cache:
+            return False
+        if key in self._host_tier:
+            self._host_tier.move_to_end(key)
+            return True
+        if fault_point("host_spill_drop"):
+            # the seam models a lost spill DMA / reclaimed host buffer:
+            # the eviction proceeds, the tier just never sees the bytes
+            # — a cache-effectiveness loss, counted, never an error
+            self._m_tier_spill_drops.inc()
+            return False
+        planes = {name: np.array(a) for name, a in
+                  self.read_page_payload(page, int(ntok)).items()}
+        nbytes = sum(a.nbytes for a in planes.values())
+        if nbytes > self.host_tier_limit:
+            return False
+        while self._host_tier_nbytes + nbytes > self.host_tier_limit:
+            self._drop_tier_entry(next(iter(self._host_tier)))
+            self._m_tier_evictions.inc()
+        self._host_tier[key] = (int(ntok), planes, nbytes,
+                                _payload_crc(planes))
+        self._host_tier_nbytes += nbytes
+        self._m_tier_spills.inc()
+        self._m_tier_spill_bytes.inc(nbytes)
+        return True
+
+    def _drop_tier_entry(self, key: bytes) -> None:
+        _, _, nbytes, _ = self._host_tier.pop(key)
+        self._host_tier_nbytes -= nbytes
+
+    def reserve_import_room(self, npages: int) -> bool:
+        """Replenish the strictly-free list to ``npages`` by evicting
+        LRU-tail zero-ref pages down the normal ladder (each one spills
+        to the host tier before its slot frees — content-addressed, so
+        a payload already resident costs a recency touch, not a copy).
+        The import landing zones themselves NEVER evict (the locked
+        round-20 contract: pressure returns None); this is the explicit
+        room-making step the restore round and the pull destination run
+        first. ``available_page_count`` is unchanged — pages move from
+        the evictable rung to the free rung — so a reservation inside a
+        soft admission probe mutates nothing the scheduler accounts.
+        Returns True when the room exists after the call."""
+        npages = int(npages)
+        while len(self._free_pages) < npages and self._lru:
+            page, _ = self._lru.popitem(last=False)   # oldest
+            key = self._page_key.pop(page)
+            del self._prefix_pages[key]
+            ntok = self._page_ntok.pop(page, 0)
+            self._spill_page(key, page, ntok)
+            self._m_evictions.inc()
+            self._free_pages.append(page)
+        self._note_occupancy()
+        return len(self._free_pages) >= npages
+
+    def _tier_lookup(self, key: bytes):
+        """Probe the host tier for one chain key, verifying the stored
+        checksum before handing the payload out. The
+        ``tier_restore_corrupt`` seam flips a stored byte first — the
+        mismatch is DETECTED, the entry dropped and counted, and the
+        probe degrades to a miss (the admission recomputes; corrupt
+        bytes never reach the device pool). Returns ``(ntok, planes)``
+        or None."""
+        self._m_tier_lookups.inc()
+        ent = self._host_tier.get(key)
+        if ent is None:
+            return None
+        ntok, planes, nbytes, crc = ent
+        if fault_point("tier_restore_corrupt"):
+            flat = planes[min(planes)].reshape(-1).view(np.uint8)
+            flat[flat.shape[0] // 2] ^= 0xFF
+        if _payload_crc(planes) != crc:
+            self._drop_tier_entry(key)
+            self._m_tier_corrupt.inc()
+            return None
+        self._host_tier.move_to_end(key)
+        self._m_tier_hits.inc()
+        return ntok, planes
+
+    def _tier_restore(self, tokens) -> int:
+        """Walk ``tokens``'s chain and re-admit every link the device
+        registry lost but the host tier still holds, so the match/export
+        walk that follows sees them as ordinary registered pages. The
+        walk mirrors :meth:`_match_prefix` exactly — full pages in chain
+        order, then the longest partial tail at the stop position — and
+        collects the WHOLE round's tier hits before landing them through
+        :meth:`import_prefix_pages` (one donated scatter per plane).
+        The round makes its own room first (:meth:`reserve_import_room`:
+        LRU-tail pages evict DOWN the ladder — they spill to the tier,
+        so room-making loses nothing — while this chain's resident
+        links are touched to the MRU end so they are never the
+        victims); the landing zone itself still claims strictly-free
+        pages only, and under true pressure the round lands a prefix of
+        itself with the rest staying resident in the tier. Restored
+        entries STAY in
+        the tier (content-addressed: a later re-eviction refreshes
+        recency instead of re-copying). Returns pages restored."""
+        if not self.host_tier_limit or not self._host_tier:
+            return 0
+        ps = self.page_size
+        n = len(tokens)
+        entries: list[tuple[bytes, int, dict]] = []
+        pos = 0
+        h = b""
+        while pos + ps <= n:
+            nxt = self._chain_key(h, tokens[pos:pos + ps])
+            if nxt in self._prefix_pages:
+                # touch the resident link: the room-making below evicts
+                # from the LRU tail, and this chain's own device-held
+                # links must not be the victims
+                page = self._prefix_pages[nxt]
+                if page in self._lru:
+                    self._lru.move_to_end(page)
+            else:
+                ent = self._tier_lookup(nxt)
+                if ent is None:
+                    break
+                entries.append((nxt, ent[0], ent[1]))
+            pos += ps
+            h = nxt
+        for t in range(min(ps - 1, n - pos), 0, -1):
+            nxt = self._chain_key(h, tokens[pos:pos + t])
+            if nxt in self._prefix_pages:
+                break                      # a deeper device tail wins
+            ent = self._tier_lookup(nxt)
+            if ent is not None:
+                entries.append((nxt, ent[0], ent[1]))
+                break
+        if not entries:
+            return 0
+        # make room down the eviction ladder (colder pages spill to the
+        # tier; best-effort — under true pressure the round lands a
+        # prefix of itself and the rest stays resident in the tier)
+        self.reserve_import_room(len(entries))
+        restored = 0
+        for (key, ntok, planes), status in zip(
+                entries, self.import_prefix_pages(entries)):
+            if status == "imported":
+                restored += 1
+                self._m_tier_restores.inc()
+                self._m_tier_restore_bytes.inc(
+                    sum(a.nbytes for a in planes.values()))
+        return restored
+
+    @property
+    def host_tier_page_count(self) -> int:
+        return len(self._host_tier)
+
+    @property
+    def host_tier_bytes_used(self) -> int:
+        return int(self._host_tier_nbytes)
+
+    @property
+    def host_tier_occupancy(self) -> float:
+        """Host-tier byte budget in use, 0..1 (0.0 when disabled)."""
+        if not self.host_tier_limit:
+            return 0.0
+        return self._host_tier_nbytes / self.host_tier_limit
+
+    @property
+    def tier_hit_rate(self) -> float:
+        """Fraction of host-tier probes that returned a verified
+        payload (0.0 before any probe)."""
+        lookups = int(self._m_tier_lookups.value)
+        if not lookups:
+            return 0.0
+        return int(self._m_tier_hits.value) / lookups
 
     def _release_page(self, page: int) -> None:
         """Drop one slot's reference; a zero-ref page parks on the LRU if
@@ -665,7 +951,11 @@ class KVCacheManager:
         pressure (or no free slot), ``soft=True`` returns None with
         NOTHING mutated instead of raising — the one owner of the
         can-this-fit accounting, so the check can never diverge from the
-        allocation it guards.
+        allocation it guards. (Round 21: the host-tier restore that runs
+        first is CACHE state, not admission state — it moves strictly-
+        free pages onto the evictable LRU, leaving every availability
+        count and the admission decision unchanged, so a soft None after
+        a restore still mutated nothing the scheduler accounts.)
         """
         n = len(tokens)
         if n > self.max_seq_len:
@@ -676,6 +966,11 @@ class KVCacheManager:
             if soft:
                 return None
             raise RuntimeError("no free decode slots")
+        if self.enable_prefix_cache:
+            # round 21: restore-aware admission — pull the chain's
+            # host-tier survivors back into the registry so the match
+            # walk below pins them like never-evicted pages
+            self._tier_restore(tokens)
         shared, matched = (self._match_prefix(tokens)
                            if self.enable_prefix_cache else ([], 0))
         need_total = self.pages_needed(n)
@@ -734,6 +1029,9 @@ class KVCacheManager:
             if page not in self._page_key and h not in self._prefix_pages:
                 self._page_key[page] = h
                 self._prefix_pages[h] = page
+                # the spill path needs the page's REAL fill (a partial
+                # tail spills t rows, never page_size)
+                self._page_ntok[page] = t
             pos += t
             i += 1
 
@@ -764,7 +1062,13 @@ class KVCacheManager:
         no ``n - 1`` feed cap: the exporter ships every page it has
         (the RECEIVER's admission walk re-applies the cap). Stops at
         the first unregistered link (a partially-evicted chain exports
-        its surviving prefix — the rest re-prefills colocated)."""
+        its surviving prefix — the rest re-prefills colocated). Round
+        21: the walk is restore-aware — links the HBM registry lost but
+        the host tier kept are re-admitted first, so a cross-replica
+        pull reaches THROUGH this replica's host tier with no transfer-
+        layer changes."""
+        if self.enable_prefix_cache:
+            self._tier_restore(tokens)
         ps = self.page_size
         n = len(tokens)
         recs: list[tuple[bytes, int, int]] = []
@@ -825,16 +1129,44 @@ class KVCacheManager:
         CONFIG errors between identically-built replicas: they raise.
 
         Cost note: each ``.at[].set`` below is an eager functional
-        update — a full pool copy per plane per frame. Fine at the
-        in-process simulation scale this round ships at; the multi-host
-        follow-up (ROADMAP item 1) should batch a transfer tick's
-        frames into one donated scatter per plane."""
+        update — a full pool copy per plane per frame. It stays as the
+        reference landing path (and the batched path's bit-identity
+        oracle); round 21's :meth:`import_prefix_pages` is the batched
+        spelling restore rounds and transfer ticks should ride."""
         if not self.enable_prefix_cache:
             raise RuntimeError(
                 "import_prefix_page needs enable_prefix_cache=True "
                 "(transferred pages land in the prefix registry)")
         if key in self._prefix_pages:
             return "present"
+        self._validate_import(ntok, payload)
+        if not self._free_pages:
+            # transfers claim strictly-FREE pages only: an imported page
+            # must never evict a registered page off the LRU (same
+            # contract as draft allowances — opportunistic work never
+            # costs a warm prefix its spot), which also keeps the
+            # failed-transfer unwind exactly reversible
+            return None
+        page = self._free_pages.pop()
+        self._refcount[page] = 0
+        self.k_pages = self.k_pages.at[:, page, :ntok].set(payload["k"])
+        self.v_pages = self.v_pages.at[:, page, :ntok].set(payload["v"])
+        if self.quantize_kv:
+            self.k_scales = self.k_scales.at[:, page, :ntok].set(
+                payload["ks"])
+            self.v_scales = self.v_scales.at[:, page, :ntok].set(
+                payload["vs"])
+        self._page_key[page] = key
+        self._prefix_pages[key] = page
+        self._page_ntok[page] = int(ntok)
+        self._lru[page] = None                 # MRU end, zero-ref
+        self._note_occupancy()
+        return "imported"
+
+    def _validate_import(self, ntok: int, payload: dict) -> None:
+        """The import landing zone's geometry/dtype gate, shared by the
+        per-page and batched paths. Mismatches are CONFIG errors
+        between identically-built replicas: they raise."""
         if not (0 < int(ntok) <= self.page_size):
             raise ValueError(
                 f"ntok must be in (0, {self.page_size}], got {ntok}")
@@ -860,27 +1192,90 @@ class KVCacheManager:
                     raise ValueError(
                         f"plane '{name}' is {a.dtype}{tuple(a.shape)}, "
                         f"expected {self.k_scales.dtype}{shape[:3]}")
-        if not self._free_pages:
-            # transfers claim strictly-FREE pages only: an imported page
-            # must never evict a registered page off the LRU (same
-            # contract as draft allowances — opportunistic work never
-            # costs a warm prefix its spot), which also keeps the
-            # failed-transfer unwind exactly reversible
-            return None
-        page = self._free_pages.pop()
-        self._refcount[page] = 0
-        self.k_pages = self.k_pages.at[:, page, :ntok].set(payload["k"])
-        self.v_pages = self.v_pages.at[:, page, :ntok].set(payload["v"])
-        if self.quantize_kv:
-            self.k_scales = self.k_scales.at[:, page, :ntok].set(
-                payload["ks"])
-            self.v_scales = self.v_scales.at[:, page, :ntok].set(
-                payload["vs"])
-        self._page_key[page] = key
-        self._prefix_pages[key] = page
-        self._lru[page] = None                 # MRU end, zero-ref
+
+    def import_prefix_pages(self, entries):
+        """The BATCHED landing zone (round 21): land a whole restore
+        round / transfer tick of ``(key, ntok, payload)`` entries with
+        ONE donated scatter per (K, V, scale) plane
+        (:func:`batched_import_rows`) instead of the per-page path's
+        eager full-pool copies. Registration semantics are exactly
+        :meth:`import_prefix_page`'s — zero-ref LRU parking, strictly-
+        free allocation, idempotent re-delivery — and the landed
+        payloads are bit-identical to the per-page path (locked by
+        tests/test_prefix_cache.py). Validation runs for EVERY entry
+        before anything mutates. Returns a per-entry status list
+        aligned with ``entries``: ``"imported"`` / ``"present"`` /
+        ``None`` (pool pressure — once the free list dries mid-round,
+        every later entry reads None)."""
+        if not self.enable_prefix_cache:
+            raise RuntimeError(
+                "import_prefix_pages needs enable_prefix_cache=True "
+                "(transferred pages land in the prefix registry)")
+        entries = list(entries)
+        for _, ntok, payload in entries:
+            self._validate_import(ntok, payload)
+        statuses: list = [None] * len(entries)
+        landings = []       # (entry idx, key, ntok, payload, page)
+        claimed: set[bytes] = set()
+        for i, (key, ntok, payload) in enumerate(entries):
+            if key in self._prefix_pages or key in claimed:
+                statuses[i] = "present"
+                continue
+            if not self._free_pages:
+                continue                       # stays None: pressure
+            landings.append((i, key, int(ntok), payload,
+                             self._free_pages.pop()))
+            claimed.add(key)
+        if not landings:
+            return statuses
+        self._scatter_landings(landings)
+        for i, key, ntok, _, page in landings:
+            self._refcount[page] = 0
+            self._page_key[page] = key
+            self._prefix_pages[key] = page
+            self._page_ntok[page] = ntok
+            self._lru[page] = None             # MRU end, zero-ref
+            statuses[i] = "imported"
         self._note_occupancy()
-        return "imported"
+        return statuses
+
+    def _scatter_landings(self, landings) -> None:
+        """Flatten one batch's (page, row) destinations and land every
+        plane with a single donated device scatter. The flat row axis
+        pads to a power of two (padding rows route to the ``num_pages``
+        out-of-bounds sentinel and drop), so the jit traces per padded
+        WIDTH, not per exact row count."""
+        total = sum(ntok for _, _, ntok, _, _ in landings)
+        cap = 1
+        while cap < total:
+            cap *= 2
+        pg = np.full((cap,), self.num_pages, np.int32)
+        row = np.zeros((cap,), np.int32)
+        kv_shape = (self.num_layers, cap, self.num_kv_heads,
+                    self.head_dim)
+        vals = {"k": np.zeros(kv_shape, self.k_pages.dtype),
+                "v": np.zeros(kv_shape, self.k_pages.dtype)}
+        if self.quantize_kv:
+            s_shape = kv_shape[:3]
+            vals["ks"] = np.zeros(s_shape, self.k_scales.dtype)
+            vals["vs"] = np.zeros(s_shape, self.k_scales.dtype)
+        off = 0
+        for _, _, ntok, payload, page in landings:
+            pg[off:off + ntok] = page
+            row[off:off + ntok] = np.arange(ntok, dtype=np.int32)
+            for name in vals:
+                vals[name][:, off:off + ntok] = payload[name]
+            off += ntok
+        pg = jnp.asarray(pg)
+        row = jnp.asarray(row)
+        for name, pool_attr in (("k", "k_pages"), ("v", "v_pages"),
+                                ("ks", "k_scales"), ("vs", "v_scales")):
+            if name not in vals:
+                continue
+            setattr(self, pool_attr, _batched_import_rows_jit(
+                getattr(self, pool_attr), jnp.asarray(vals[name]), pg,
+                row))
+            self._m_restore_scatters.inc()
 
     def discard_imported_prefix(self, keys) -> int:
         """Unwind a failed transfer: unregister + free every page in
@@ -889,7 +1284,9 @@ class KVCacheManager:
         REVERSE import order so the free list recovers its exact
         pre-transfer pop order — after the unwind the pool accounting
         is indistinguishable from a run where the transfer never
-        happened. Returns the pages freed."""
+        happened. (Round 21: deliberately NO host-tier spill here — an
+        unwind must leave no trace, and a half-transferred chain in the
+        tier would be exactly such a trace.) Returns the pages freed."""
         dropped = 0
         for key in keys:
             page = self._prefix_pages.get(key)
@@ -897,6 +1294,7 @@ class KVCacheManager:
                 continue
             del self._prefix_pages[key]
             del self._page_key[page]
+            self._page_ntok.pop(page, None)
             self._lru.pop(page, None)
             self._free_pages.append(page)
             dropped += 1

@@ -365,7 +365,8 @@ class ServingPredictor:
                  max_inflight_steps=4, metrics=None, mega_decode=None,
                  slo=None, max_step_retries=3, retry_backoff_s=0.02,
                  replica_id=0, role="colocated", draft_source=None,
-                 draft_layers=None, draft_num_pages=None):
+                 draft_layers=None, draft_num_pages=None,
+                 host_tier_bytes=0):
         from ..distributed.mesh import as_serving_mesh
         from ..models.gpt import (_serving_params_cached, build_decode_step,
                                   build_prefill, build_unified_step,
@@ -449,7 +450,10 @@ class ServingPredictor:
             max_seq_len=self.max_seq_len, page_size=page_size,
             num_q_heads=cfg.num_heads, dtype=kv_dtype,
             enable_prefix_cache=prefix_cache, quantize_kv=self.kv_quant,
-            mesh=self.mesh, metrics=self.metrics)
+            mesh=self.mesh, metrics=self.metrics,
+            # round 21: the host-DRAM spill tier under the HBM pool
+            # (0 disables — evictions drop exactly like pre-21)
+            host_tier_bytes=host_tier_bytes)
         self.chunk = int(chunk or preferred_chunk_size(
             cfg.num_heads, cfg.num_heads, cfg.head_dim, kv_dtype))
         # round 12: speculative decoding — build geometry for the verify
@@ -864,6 +868,10 @@ class ServingPredictor:
             "free_slots": cache.free_slot_count,
             "pool_occupancy": round(self.pool_occupancy, 4),
             "withheld_pages": cache.withheld_page_count,
+            # round 21: the host tier under the HBM pool — byte-budget
+            # occupancy (0.0 when no tier) + absolute bytes resident
+            "host_tier_occupancy": round(cache.host_tier_occupancy, 4),
+            "host_tier_bytes": int(cache.host_tier_bytes_used),
             "ttft_p99_ema_ms": round(self.ttft_p99_ema_ms, 3),
             # round 19: the draft-acceptance EMA — a router scoring
             # replicas can prefer ones whose speculation is paying off

@@ -110,6 +110,19 @@ class FleetInstruments:
         self.transfer_backlog = m.gauge(
             "fleet_kv_transfer_backlog",
             "unacked frames across in-flight transfers after a tick")
+        # -- round 21: fleet-global tiered prefixes (cross-replica pulls)
+        self.pulls_started = m.counter(
+            "fleet_prefix_pulls_started",
+            "cross-replica prefix pulls opened (a miss on the routed "
+            "replica served from the owning replica's pages instead of "
+            "recomputing)")
+        self.pulls_completed = m.counter(
+            "fleet_prefix_pulls_completed",
+            "prefix pulls fully landed before the decode admission")
+        self.pull_fallbacks = m.counter(
+            "fleet_prefix_pull_fallbacks",
+            "pulls abandoned (wire failure, pressure, deadline) — the "
+            "request recomputed its prefix colocated, never failed")
         # -- per-replica emission + fleet gauges ------------------------
         self.tokens = m.counter(
             "fleet_tokens_emitted", "tokens emitted, by serving replica",

@@ -428,6 +428,21 @@ class TestASTRules:
         fs = astlint.lint_source(textwrap.dedent(self._SWALLOW_SRC), where)
         assert len([f for f in fs if f.rule == "AL007"]) == 3, fs
 
+    def test_tiered_kv_cache_sits_inside_both_hot_path_fences(self):
+        """Round-21 satellite: the host-tier spill/restore code lives in
+        paddle_tpu/inference/kv_cache.py — hot-path serving code with
+        exactly the failure modes AL006/AL007 exist for (ad-hoc timing
+        around the spill DMA, a swallowed checksum error silently
+        scattering a corrupt payload into the pool) — both directory
+        fences must cover it, and the module ships clean (the repo gate
+        below holds the baseline EMPTY over the real tree including
+        it)."""
+        where = "paddle_tpu/inference/kv_cache.py"
+        fs = astlint.lint_source(textwrap.dedent(self._TIMING_SRC), where)
+        assert len([f for f in fs if f.rule == "AL006"]) == 3, fs
+        fs = astlint.lint_source(textwrap.dedent(self._SWALLOW_SRC), where)
+        assert len([f for f in fs if f.rule == "AL007"]) == 3, fs
+
 
 # ---------------------------------------------------------------------------
 # JX rules — seeded positive + negative per rule
@@ -859,6 +874,19 @@ class TestHazardRegressions:
         from paddle_tpu.analysis.targets import analyze_serving_async
 
         assert analyze_serving_async() == []
+
+    def test_serving_tiered_restore_is_clean_and_donates(self):
+        """The round-21 batched restore scatter (the ONE jitted landing
+        a host-tier restore round or batched transfer tick issues per
+        K/V/scale plane): jaxpr walk over all three plane geometries
+        (5D fp pool, 5D int8 pool, 4D fp32 scale plane) and the JX005
+        donation audit of the pool argument come back with ZERO
+        findings (the baseline stays empty) — an undonated restore
+        would copy the whole HBM pool per plane per round, exactly the
+        eager per-page cost the batched path exists to retire."""
+        from paddle_tpu.analysis.targets import analyze_serving_tiered
+
+        assert analyze_serving_tiered() == []
 
 
 # ---------------------------------------------------------------------------

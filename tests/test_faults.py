@@ -197,6 +197,90 @@ class TestFaultPlan:
         key, ntok, planes = decode_frame(buf)
         assert key == b"\x07" * 20 and ntok == 5
 
+    # -- round 21: the host-tier seams --------------------------------------
+
+    def test_tier_seams_return_true_and_count(self):
+        """The two tiered-KV seams are RETURNING seams like the KV-wire
+        pair: the tier applies the loss / byte-flip itself; fired hits
+        return True, unfired hits and the disarmed path return None."""
+        with pytest.raises(ValueError, match="host_spill_drop rate"):
+            FaultPlan(host_spill_drop=-0.1)
+        with pytest.raises(ValueError, match="tier_restore_corrupt rate"):
+            FaultPlan(tier_restore_corrupt=1.5)
+        with FaultPlan(seed=0, host_spill_drop=1.0,
+                       tier_restore_corrupt=1.0) as plan:
+            assert fault_point("host_spill_drop") is True
+            assert fault_point("tier_restore_corrupt") is True
+        assert plan.fired["host_spill_drop"] == 1
+        assert plan.fired["tier_restore_corrupt"] == 1
+        with FaultPlan(seed=0, host_spill_drop=0.0,
+                       tier_restore_corrupt=0.0):
+            assert fault_point("host_spill_drop") is None
+            assert fault_point("tier_restore_corrupt") is None
+        assert fault_point("host_spill_drop") is None        # disarmed
+        assert fault_point("tier_restore_corrupt") is None
+
+    @staticmethod
+    def _tiered_mgr(**over):
+        kw = dict(num_layers=1, num_kv_heads=2, head_dim=8, num_pages=8,
+                  max_batch=2, max_seq_len=32, page_size=4,
+                  enable_prefix_cache=True, host_tier_bytes=1 << 20)
+        kw.update(over)
+        return KVCacheManager(**kw)
+
+    @staticmethod
+    def _park(m, toks):
+        slot, _ = m.admit_prefix(list(toks))
+        m._seq_lens[slot] = len(toks)
+        m.register_prefix(slot, list(toks))
+        m.free(slot)
+
+    def test_spill_drop_seam_degrades_to_recompute(self):
+        """A fired ``host_spill_drop`` models a lost spill DMA: the HBM
+        eviction proceeds, the tier never sees the bytes — counted as a
+        cache-effectiveness loss, never an error — and the repeat
+        admission recomputes exactly like a pre-tier miss."""
+        m = self._tiered_mgr()
+        toks = list(range(10))                   # 2 full + 1 partial page
+        self._park(m, toks)
+        with FaultPlan(seed=0, host_spill_drop=1.0) as plan:
+            assert m.reserve_import_room(m.num_pages)
+        assert plan.fired["host_spill_drop"] == 3
+        assert int(m._m_tier_spill_drops.value) == 3
+        assert m.host_tier_page_count == 0       # nothing ever stored
+        slot, hit = m.admit_prefix(toks)
+        assert hit == 0                          # dropped -> recompute
+        assert m.free_page_count >= 0 and m.seq_len(slot) >= 0
+        m.free(slot)
+        assert m.available_page_count == m.num_pages
+
+    def test_restore_corrupt_detected_dropped_and_recomputed(self):
+        """A fired ``tier_restore_corrupt`` flips a payload byte on the
+        host->HBM read-back; the crc32 side-band catches EVERY flip: the
+        entry is dropped and counted, the admission degrades to a
+        recompute miss — corrupt bytes never land in the pool."""
+        m = self._tiered_mgr()
+        toks = list(range(100, 110))
+        self._park(m, toks)
+        assert m.reserve_import_room(m.num_pages)
+        assert m.host_tier_page_count == 3
+        with FaultPlan(seed=1, tier_restore_corrupt=1.0) as plan:
+            slot, hit = m.admit_prefix(toks)
+        assert plan.fired["tier_restore_corrupt"] >= 1
+        assert int(m._m_tier_corrupt.value) >= 1
+        assert hit == 0                          # detected -> recompute
+        assert int(m._m_tier_restores.value) == 0
+        # the poisoned entry is GONE: the next admission is a plain
+        # miss, not a repeat detection loop
+        m.free(slot)
+        assert m.host_tier_page_count < 3
+        corrupt0 = int(m._m_tier_corrupt.value)
+        slot, hit = m.admit_prefix(toks)
+        assert hit == 0
+        assert int(m._m_tier_corrupt.value) == corrupt0
+        m.free(slot)
+        assert m.available_page_count == m.num_pages
+
     def test_replica_stall_draws_ride_the_one_seeded_stream(self):
         """Stall draws come from the SAME RandomState as every other
         seam, in hit order — a fleet chaos run replays from its seed."""

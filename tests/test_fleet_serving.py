@@ -575,16 +575,22 @@ def test_sample_offset_continues_seeded_streams(rng):
 
 
 def _run_fleet_churn(model, prompts, *, n=3, gen_len=5, check_every=1,
-                     prefill_replicas=0, transfer=None):
+                     prefill_replicas=0, transfer=None, prefix_pulls=False,
+                     host_tier_bytes=0, drain_cycle=None):
     """Drive a continuous-arrival churn through a fleet, asserting the
-    fleet-wide accounting partition after EVERY tick. Returns
+    fleet-wide accounting partition after EVERY tick. With
+    ``drain_cycle=(period, dur)`` the replicas take round-robin drain
+    breaks — the round-21 pull path's bread and butter: a DRAINING
+    owner's warm prefixes must travel, not recompute. Returns
     (router, reqs, ticks)."""
     router = FleetRouter(
         model, num_replicas=n, seed=3, max_failovers=4,
         dead_stall_ticks=3, restart_ticks=2,
         prefill_replicas=prefill_replicas, transfer=transfer,
+        prefix_pulls=prefix_pulls,
         replica_kw=dict(max_batch=2, page_size=8, max_seq_len=64,
-                        retry_backoff_s=0.0))
+                        retry_backoff_s=0.0,
+                        host_tier_bytes=host_tier_bytes))
     queued = list(prompts)
     reqs = []
     ticks = 0
@@ -593,7 +599,19 @@ def _run_fleet_churn(model, prompts, *, n=3, gen_len=5, check_every=1,
     def live():
         return sum(1 for r in reqs if r.state not in TERMINAL)
 
+    draining = None
     while queued or router.has_work():
+        if drain_cycle:
+            period, dur = drain_cycle
+            if draining is not None and ticks - draining[1] >= dur:
+                if router._rep(draining[0]).state == DRAINING:
+                    router.resume(draining[0])
+                draining = None
+            if draining is None and ticks % period == 0:
+                rid = (ticks // period) % n
+                if router._rep(rid).state == HEALTHY:
+                    router.drain(rid)
+                    draining = (rid, ticks)
         while queued and live() < cap:
             reqs.append(router.submit(queued.pop(0),
                                       max_new_tokens=gen_len))
@@ -771,6 +789,185 @@ def test_chaos_1k_tick_disaggregated_fleet_under_wire_and_replica_faults(
     assert flat["fleet_kv_transfer_frames_dropped"] > 0
     # ...and degradation was counted, never terminal
     assert flat["fleet_prefill_fallbacks"] > 0
+    assert flat["fleet_requests_finished"] == len(finished)
+    assert flat["fleet_requests_failed"] == len(reqs) - len(finished)
+    acc = router.fleet_accounting()
+    assert acc["submitted"] == acc["finished"] + acc["failed"]
+    assert acc["live"] == 0
+
+# -- round 21: the tiered fleet — host spill + cross-replica pulls ----------
+
+
+def test_cross_replica_pull_serves_warm_prefix_from_drained_owner(rng):
+    """The round-21 pull path end to end: the replica that owns a warm
+    prefix drains, the repeat submission routes elsewhere, and instead
+    of recomputing, the router PULLS the pages over the KV wire — from
+    the owner's HOST TIER (the prefix was deliberately evicted off HBM
+    first, so the export walk restores through the tier), lands them in
+    the puller's cache, and the stream is bit-identical."""
+    model = _tiny_model()
+    tcfg = TransferConfig(window=4, max_retries=2, timeout_ticks=2)
+    router = _router(model, n=2, transfer=tcfg, prefix_pulls=True,
+                     replica_kw={"host_tier_bytes": 32 << 20})
+    prompt = rng.randint(0, TINY["vocab_size"], (20,)).tolist()  # 2p + tail
+    a = router.submit(prompt, max_new_tokens=4)
+    _drain(router)
+    assert a.state == FINISHED
+    want = list(a.output_ids)
+    aff = list(router._affinity.values())
+    assert aff, "page-spanning prompt must leave an affinity record"
+    owner = max(set(aff), key=aff.count)
+    own = router._rep(owner)
+    # slide the owner's warm pages down the ladder into its host tier:
+    # the pull must be served by tier RESTORES, not resident HBM pages
+    assert own.sp.cache.reserve_import_room(own.sp.cache.num_pages)
+    assert own.sp.cache.host_tier_page_count >= 3
+    router.drain(owner)
+    b = router.submit(prompt, max_new_tokens=4)
+    _drain(router)
+    router.resume(owner)
+    assert b.state == FINISHED
+    assert list(b.output_ids) == want
+    flat = router.telemetry()
+    assert flat["fleet_prefix_pulls_started"] == 1
+    assert flat["fleet_prefix_pulls_completed"] == 1
+    assert flat["fleet_prefix_pull_fallbacks"] == 0
+    assert flat["fleet_prefix_pulls_started"] >= (
+        flat["fleet_prefix_pulls_completed"]
+        + flat["fleet_prefix_pull_fallbacks"])
+    # the owner's tier actually served the export walk...
+    assert int(own.sp.cache._m_tier_restores.value) >= 3
+    # ...and the puller admitted straight onto the imported pages: the
+    # whole context but the fed token was a prefix hit, zero recompute
+    dst = router._rep(1 - owner)
+    assert dst.sp.cache.prefix_hit_tokens >= len(prompt) - 1
+
+
+def test_pulls_off_by_default_repeat_misses_recompute(rng):
+    """``prefix_pulls`` defaults OFF: the same drained-owner scenario
+    recomputes on the other replica — zero pull counters, identical
+    stream (the pull is a bandwidth optimization, never a semantic)."""
+    model = _tiny_model()
+    router = _router(model, n=2, transfer=TransferConfig(),
+                     replica_kw={"host_tier_bytes": 32 << 20})
+    prompt = rng.randint(0, TINY["vocab_size"], (20,)).tolist()
+    a = router.submit(prompt, max_new_tokens=4)
+    _drain(router)
+    aff = list(router._affinity.values())
+    owner = max(set(aff), key=aff.count)
+    router.drain(owner)
+    b = router.submit(prompt, max_new_tokens=4)
+    _drain(router)
+    assert b.state == FINISHED
+    assert list(b.output_ids) == list(a.output_ids)
+    flat = router.telemetry()
+    assert flat["fleet_prefix_pulls_started"] == 0
+    assert flat["fleet_kv_transfers_started"] == 0
+
+
+def test_tiered_fleet_disarmed_or_idle_streams_bit_identical(rng):
+    """THE round-21 identity gate: with the tier disabled (the
+    default), enabled-but-idle, or enabled WITH pulls on the wire, the
+    finished streams are bit-identical — greedy AND seeded-sampled —
+    to a bare ServingPredictor and to the no-tier round-18 fleet over
+    the same submissions. Spills, restores and pulls change where
+    prefill WORK happens, never what tokens come out."""
+    model = _tiny_model()
+    prompts = _churn_prompts(rng, 12, max_len=26)
+    for sampling in (dict(),
+                     dict(temperature=0.8, top_k=7, top_p=0.9, seed=13)):
+        sp = ServingPredictor(model, **KW)
+        want = sp.generate(prompts, max_new_tokens=4, **sampling)
+
+        def run(**over):
+            router = _router(model, n=2, **over)
+            reqs = [router.submit(p, max_new_tokens=4, **sampling)
+                    for p in prompts]
+            _drain(router)
+            assert all(r.state == FINISHED for r in reqs)
+            return [list(r.output_ids) for r in reqs]
+
+        assert run() == want, ("no tier", sampling)
+        assert run(replica_kw={"host_tier_bytes": 64 << 20}) == want, \
+            ("tier enabled, no pulls", sampling)
+        assert run(transfer=TransferConfig(), prefix_pulls=True,
+                   replica_kw={"host_tier_bytes": 64 << 20}) == want, \
+            ("tier + pulls", sampling)
+
+
+def test_chaos_1k_tick_tiered_fleet_under_tier_wire_replica_faults(rng):
+    """THE round-21 acceptance gate: a >= 1k-tick tiered fleet churn
+    over a REUSED working set whose distinct chains overflow every
+    replica's HBM pool (the eviction ladder runs hot, repeats drive
+    tier lookups and cross-replica pulls) under ALL SIX seams —
+    ``host_spill_drop`` / ``tier_restore_corrupt`` on the tier,
+    ``transfer_drop`` / ``transfer_corrupt`` on the KV wire,
+    ``replica_crash`` / ``replica_stall`` on the replicas — where
+
+    - ``tick()`` never raises (a lost spill, a corrupt restore, a dead
+      wire and a dead replica are all degradations, never outages),
+    - the fleet accounting partitions exactly after EVERY tick,
+    - every request ends terminal exactly once, none is lost,
+    - every FINISHED stream is bit-identical to the fault-free NO-TIER
+      mirror of the same submissions (a spilled page that never stored,
+      a restore the checksum rejected, a pull that fell back — none of
+      it can change an emission), and
+    - every armed seam actually fired, with the tier's detection
+      counters on the books.
+    """
+    model = _tiny_model()
+    pool = [rng.randint(0, TINY["vocab_size"],
+                        (int(rng.randint(9, 26)),)).tolist()
+            for _ in range(40)]
+    prompts = [pool[i % len(pool)] for i in range(900)]
+
+    # the fault-free no-tier mirror: greedy emissions are a pure
+    # function of the prompt (the locked fleet==bare identity), so one
+    # bare generate over the DISTINCT pool mirrors all 900 submissions
+    sp = ServingPredictor(model, **KW)
+    gen = sp.generate(pool, max_new_tokens=5)
+    want = [gen[i % len(pool)] for i in range(900)]
+
+    plan = FaultPlan(seed=41, replica_crash=0.002, replica_stall=0.006,
+                     stall_ticks=2, transfer_drop=0.1,
+                     transfer_corrupt=0.06, host_spill_drop=0.25,
+                     tier_restore_corrupt=0.25)
+    with plan:
+        router, reqs, ticks = _run_fleet_churn(
+            model, prompts, prefix_pulls=True, host_tier_bytes=8 << 20,
+            drain_cycle=(25, 10),
+            transfer=TransferConfig(window=4, max_retries=2,
+                                    timeout_ticks=1))
+    assert ticks >= 1000, ticks                  # a real 1k-tick churn
+    for seam in ("host_spill_drop", "tier_restore_corrupt",
+                 "transfer_drop", "transfer_corrupt", "replica_crash",
+                 "replica_stall"):
+        assert plan.fired[seam] > 0, seam
+
+    assert all(r.state in TERMINAL for r in reqs)
+    finished = [i for i, r in enumerate(reqs) if r.state == FINISHED]
+    assert len(finished) > len(reqs) * 0.9
+    for i in finished:
+        assert list(reqs[i].output_ids) == want[i], f"request {i} diverged"
+    for r in reqs:
+        if r.state == FAILED:
+            assert r.error["code"] == "replica_lost"
+    flat = router.telemetry()
+    # the pull wire carried real traffic, both outcomes included, and
+    # the started >= completed + fallbacks ledger holds at rest
+    assert flat["fleet_prefix_pulls_started"] > 0
+    assert flat["fleet_prefix_pulls_completed"] > 0
+    assert flat["fleet_prefix_pulls_started"] >= (
+        flat["fleet_prefix_pulls_completed"]
+        + flat["fleet_prefix_pull_fallbacks"])
+    # the tier ran hot on every replica: spills, restores, and BOTH
+    # detection counters (lost spill DMAs, checksum-rejected restores)
+    tiers = [rep.sp.cache for rep in router.replicas
+             if rep.sp is not None]
+    assert sum(int(c._m_tier_spills.value) for c in tiers) > 0
+    assert sum(int(c._m_tier_restores.value) for c in tiers) > 0
+    assert sum(int(c._m_tier_spill_drops.value) for c in tiers) > 0
+    assert sum(int(c._m_tier_corrupt.value) for c in tiers) > 0
     assert flat["fleet_requests_finished"] == len(finished)
     assert flat["fleet_requests_failed"] == len(reqs) - len(finished)
     acc = router.fleet_accounting()

@@ -409,6 +409,12 @@ class TestServingTelemetry:
         "free_slots": lambda v: isinstance(v, int) and v >= 0,
         "pool_occupancy": lambda v: isinstance(v, float) and 0 <= v <= 1,
         "withheld_pages": lambda v: isinstance(v, int) and v >= 0,
+        # round 21: the host-DRAM spill tier — occupancy of the byte
+        # budget plus resident bytes; a router scoring pull sources
+        # reads restore capacity straight off this surface
+        "host_tier_occupancy": lambda v: (isinstance(v, float)
+                                          and 0 <= v <= 1),
+        "host_tier_bytes": lambda v: isinstance(v, int) and v >= 0,
         "ttft_p99_ema_ms": lambda v: isinstance(v, float) and v >= 0,
         # round 19: the draft-acceptance EMA — a router scoring replicas
         # can prefer ones whose speculation is paying off

@@ -291,3 +291,229 @@ def test_prefix_refcounts_survive_1k_churn_steps(rng):
     _check_invariants(m)
     assert m.available_page_count == m.num_pages  # zero pages leaked
     assert m.prefix_hit_rate > 0.0                # the churn actually hit
+
+
+# -- round 21: the host-DRAM spill tier -------------------------------------
+
+
+def _fill(m, tokens, seed=0):
+    """Admit ``tokens``, write deterministic per-token K/V rows (and
+    scale rows on a quantized pool), register the chain and free the
+    slot — the zero-ref LRU-parked state a finished request leaves."""
+    import jax.numpy as jnp
+
+    slot, _ = m.admit_prefix(list(tokens))
+    rng = np.random.RandomState(seed)
+    n = len(tokens)
+    shape = (m.num_layers, n, m.num_kv_heads, m.head_dim)
+    k = (rng.randn(*shape) * 50)
+    v = (rng.randn(*shape) * 50)
+    if m.quantize_kv:
+        k, v = k.astype(np.int8), v.astype(np.int8)
+        ks = rng.rand(*shape[:3]).astype(np.float32)
+        vs = rng.rand(*shape[:3]).astype(np.float32)
+    for i in range(0, n, m.page_size):
+        pg = int(m._page_table[slot, i // m.page_size])
+        t = min(m.page_size, n - i)
+        m.k_pages = m.k_pages.at[:, pg, :t].set(
+            jnp.asarray(k[:, i:i + t], m.k_pages.dtype))
+        m.v_pages = m.v_pages.at[:, pg, :t].set(
+            jnp.asarray(v[:, i:i + t], m.v_pages.dtype))
+        if m.quantize_kv:
+            m.k_scales = m.k_scales.at[:, pg, :t].set(
+                jnp.asarray(ks[:, i:i + t]))
+            m.v_scales = m.v_scales.at[:, pg, :t].set(
+                jnp.asarray(vs[:, i:i + t]))
+    m._seq_lens[slot] = n
+    m.register_prefix(slot, list(tokens))
+    m.free(slot)
+
+
+def _payloads_by_key(m, tokens):
+    """key -> host payload planes for every registered page of the
+    chain (full pages + partial tail), via the export walk."""
+    return {key: {name: np.array(a) for name, a in
+                  m.read_page_payload(page, ntok).items()}
+            for key, page, ntok in m.prefix_page_records(tokens)}
+
+
+@pytest.mark.parametrize("kw", [
+    dict(),                                      # fp32
+    dict(dtype="float16"),                       # fp16 payloads
+    dict(quantize_kv=True),                      # int8 + fp32 scales
+], ids=["fp32", "fp16", "int8"])
+def test_spilled_then_restored_pages_bit_exact(kw):
+    """The tier round-trip contract: a prefix chain (partial tail
+    included) evicted THROUGH the host tier and restored on the next
+    admission is BIT-identical — payloads, hit counts, invariants —
+    to a control manager whose pages were never evicted."""
+    import jax.numpy as jnp
+
+    if "dtype" in kw:
+        kw = dict(kw, dtype=jnp.float16)
+    tiered = _mgr(host_tier_bytes=1 << 20, **kw)
+    control = _mgr(**kw)
+    toks = list(range(100, 120))                 # 2 full pages + tail 4
+    _fill(tiered, toks)
+    _fill(control, toks)
+    want = _payloads_by_key(control, toks)
+    assert len(want) == 3
+    # force the whole chain down the eviction ladder: every zero-ref
+    # page spills (HBM -> host), the registry forgets it
+    assert tiered.reserve_import_room(tiered.num_pages)
+    assert not tiered._prefix_pages
+    assert tiered.host_tier_page_count == 3
+    assert tiered.host_tier_bytes_used > 0
+    spill_bytes = int(tiered._m_tier_spill_bytes.value)
+    assert spill_bytes > 0
+    # the next admission restores the chain from the tier...
+    s_t, hit_t = tiered.admit_prefix(toks)
+    s_c, hit_c = control.admit_prefix(toks)
+    assert hit_t == hit_c == 19                  # all but the fed token
+    # ...bit-exactly, partial tail included
+    got = _payloads_by_key(tiered, toks)
+    assert got.keys() == want.keys()
+    for key in want:
+        for name in want[key]:
+            assert np.array_equal(got[key][name], want[key][name]), \
+                (key, name)
+    assert int(tiered._m_tier_restore_bytes.value) == spill_bytes
+    assert tiered.tier_hit_rate == 1.0
+    # restored entries STAY resident (content-addressed): a later
+    # re-eviction refreshes recency instead of re-copying
+    assert tiered.host_tier_page_count == 3
+    tiered.free(s_t)
+    control.free(s_c)
+    _check_invariants(tiered)
+    _check_invariants(control)
+
+
+def test_tier_accounting_parity_with_never_spilled_manager():
+    """Scheduler-visible accounting after a spill + restore round-trip
+    is IDENTICAL to a manager that never evicted: same free/available
+    counts, same LRU population size, same hit tokens — the tier is
+    cache state, invisible to capacity math."""
+    tiered = _mgr(host_tier_bytes=1 << 20)
+    control = _mgr()
+    for base, seed in ((0, 1), (200, 2)):
+        toks = list(range(base, base + 16))
+        _fill(tiered, toks, seed=seed)
+        _fill(control, toks, seed=seed)
+    assert tiered.reserve_import_room(4)         # spill some of the LRU
+    assert tiered.available_page_count == control.available_page_count
+    for base in (0, 200):
+        toks = list(range(base, base + 16))
+        s_t, hit_t = tiered.admit_prefix(toks)
+        s_c, hit_c = control.admit_prefix(toks)
+        assert hit_t == hit_c == 15
+        tiered.free(s_t)
+        control.free(s_c)
+    assert tiered.free_page_count == control.free_page_count
+    assert tiered.available_page_count == control.available_page_count
+    assert len(tiered._lru) == len(control._lru)
+    assert tiered._prefix_pages.keys() == control._prefix_pages.keys()
+    _check_invariants(tiered)
+    _check_invariants(control)
+
+
+def test_tier_disabled_keeps_pre21_drop_on_evict():
+    """host_tier_bytes=0 (the default): eviction drops the payload
+    exactly like pre-round-21 — nothing stored, the repeat admission
+    recomputes."""
+    m = _mgr()                                   # no tier
+    toks = list(range(20))
+    _fill(m, toks)
+    assert m.reserve_import_room(m.num_pages)
+    assert m.host_tier_page_count == 0
+    assert m.host_tier_occupancy == 0.0
+    s, hit = m.admit_prefix(toks)
+    assert hit == 0                              # dropped -> recompute
+    assert int(m._m_tier_lookups.value) == 0
+    m.free(s)
+    _check_invariants(m)
+    with pytest.raises(ValueError, match="host_tier_bytes"):
+        _mgr(host_tier_bytes=-1)
+
+
+def test_tier_budget_evicts_its_own_lru_and_oversize_never_stores():
+    """The tier is byte-bounded with its own LRU: pressure drops the
+    OLDEST payload (the final rung of the ladder), and a payload bigger
+    than the whole budget is never stored."""
+    page_bytes = 2 * 2 * 8 * 2 * 8 * 4           # L*2(K,V)*ps*heads*hd*f32
+    m = _mgr(host_tier_bytes=2 * page_bytes)     # room for two pages
+    a, b, c = list(range(8)), list(range(50, 58)), list(range(80, 88))
+    for toks, seed in ((a, 1), (b, 2), (c, 3)):
+        _fill(m, toks, seed=seed)
+    assert m.reserve_import_room(m.num_pages)
+    # three spilled, budget holds two: the oldest (a's page) dropped
+    assert m.host_tier_page_count == 2
+    assert int(m._m_tier_evictions.value) == 1
+    assert m.host_tier_bytes_used <= m.host_tier_limit
+    s, hit = m.admit_prefix(a)
+    assert hit == 0                              # a fell off the tier
+    m.free(s)
+    s, hit = m.admit_prefix(b)
+    assert hit == 7                              # b survived
+    m.free(s)
+    # a budget smaller than one payload stores nothing, loudly counted
+    tiny = _mgr(host_tier_bytes=16)
+    _fill(tiny, list(range(8)))
+    assert tiny.reserve_import_room(tiny.num_pages)
+    assert tiny.host_tier_page_count == 0
+
+
+@pytest.mark.parametrize("quant", [False, True], ids=["fp", "int8"])
+def test_batched_import_bit_identical_to_per_page_single_call_per_plane(
+        rng, quant):
+    """The round-21 batched landing zone: ``import_prefix_pages`` lands
+    a whole round with ONE donated device scatter per (K, V, scale)
+    plane — counted on ``kv_tier_restore_device_calls`` — and the
+    landed payloads are BIT-identical to the eager per-page reference
+    path (``import_prefix_page``, the bit-identity oracle)."""
+    src = _mgr(quantize_kv=quant)
+    toks = rng.randint(0, 50000, (20,)).tolist() # 2 pages + tail 4
+    _fill(src, toks, seed=7)
+    records = src.prefix_page_records(toks)
+    entries = [(key, ntok, {n: np.array(a) for n, a in
+                            src.read_page_payload(page, ntok).items()})
+               for key, page, ntok in records]
+    per_page = _mgr(quantize_kv=quant)
+    for key, ntok, payload in entries:
+        assert per_page.import_prefix_page(key, ntok, payload) \
+            == "imported"
+    batched = _mgr(quantize_kv=quant)
+    calls0 = int(batched._m_restore_scatters.value)
+    statuses = batched.import_prefix_pages(entries)
+    assert statuses == ["imported"] * 3
+    # ONE device scatter per plane for the WHOLE 3-page round
+    nplanes = 4 if quant else 2
+    assert int(batched._m_restore_scatters.value) - calls0 == nplanes
+    want = _payloads_by_key(per_page, toks)
+    got = _payloads_by_key(batched, toks)
+    assert want.keys() == got.keys() and len(want) == 3
+    for key in want:
+        for name in want[key]:
+            assert np.array_equal(got[key][name], want[key][name]), \
+                (key, name)
+    # ...and both registries serve the same hits afterwards
+    s_b, hit_b = batched.admit_prefix(toks)
+    s_p, hit_p = per_page.admit_prefix(toks)
+    assert hit_b == hit_p == 19
+    batched.free(s_b)
+    per_page.free(s_p)
+    _check_invariants(batched)
+    _check_invariants(per_page)
+    # idempotent re-delivery + in-batch duplicate keys read "present"
+    assert batched.import_prefix_pages(entries) == ["present"] * 3
+    dup = [entries[0], entries[0]]
+    fresh = _mgr(quantize_kv=quant)
+    assert fresh.import_prefix_pages(dup) == ["imported", "present"]
+    # pressure mid-round: once the free list dries, later entries stay
+    # None and nothing half-lands (same contract as the per-page path)
+    tight = _mgr(num_pages=2, quantize_kv=quant)
+    other = list(range(60000, 60016))
+    s0, _ = tight.admit_prefix(other)
+    tight.register_prefix(s0, other)
+    tight.free(s0)                               # 2 pages, all on LRU
+    assert tight.import_prefix_pages(entries) == [None] * 3
+    _check_invariants(tight)

@@ -2297,6 +2297,65 @@ def test_bench_serve_disagg_leg_gates():
     assert tel["fleet_prefill_admissions"] > 0
 
 
+def test_bench_serve_tiered_leg_gates():
+    """The round-21 bench acceptance (via --legs): on a reused-prompt
+    churn whose prefix working set deliberately overflows the HBM pool,
+    the host-tiered fleet beats its interleaved no-tier partner on BOTH
+    headline axes — prefix_hit_rate strictly higher and TTFT p99
+    strictly lower — with real tier traffic on the line (spills,
+    restores, a verified tier hit rate), at least one drain-forced
+    cross-replica pull, and a chaos pass whose lost spills + corrupted
+    host payloads are DETECTED and degrade to recompute (the
+    fault-free corruption figure stays exactly 0). Best-of-2: the
+    strict wall-clock TTFT inequality sits near a loaded CI box's
+    noise floor — one retry shields the load spike without weakening
+    the deterministic counter gates (same idiom as the smoke schema
+    test)."""
+    try:
+        _bench_serve_tiered_once()
+    except AssertionError:
+        _bench_serve_tiered_once()
+
+
+def _bench_serve_tiered_once():
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, "bench_serve.py", "--smoke", "--steps=6",
+         "--batch=2", "--prompt=8", "--gen-len=3",
+         "--legs=fleet-tiered"],
+        cwd=root, capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [l for l in proc.stdout.strip().splitlines() if l.strip()]
+    assert len(lines) == 1, proc.stdout
+    rec = json.loads(lines[0])
+    assert "error" not in rec, rec
+    assert rec["leg"] == "fleet-tiered"
+    assert rec["value"] > 0 and rec["notier_tokens_per_s"] > 0
+    # the headline pair: strictly higher hit rate, strictly lower TTFT
+    # p99 than the no-tier partner on the SAME arrival sequence
+    assert rec["prefix_hit_rate"] > rec["notier_prefix_hit_rate"]
+    assert rec["ttft_p99_ms"] < rec["notier_ttft_p99_ms"]
+    # real tier traffic over the fault-free windows
+    assert rec["spill_bytes"] > 0
+    assert rec["restore_bytes"] > 0
+    assert 0 < rec["tier_hit_rate"] <= 1
+    # the drain exercise forced at least one pull over the wire
+    assert rec["cross_replica_pulls"] >= 1
+    # chaos: both round-21 seams fired AND the corruption was detected
+    # (dropped + counted, degraded to recompute — never scattered into
+    # the pool, never a failed request); fault-free windows spotless
+    assert rec["tier_spill_drops"] > 0
+    assert rec["tier_corrupt_detected"] > 0
+    assert rec["fault_free_corrupt_detected"] == 0
+    tel = rec["telemetry"]
+    assert tel["fleet_prefix_pulls_completed"] >= 1
+    assert (tel["fleet_prefix_pulls_started"]
+            >= tel["fleet_prefix_pulls_completed"]
+            + tel["fleet_prefix_pull_fallbacks"])
+    assert tel["fleet_requests_finished"] > 0
+
+
 def test_bench_serve_legs_filtered_baseline_omits_ratio():
     """--legs selecting a leg WITHOUT its baseline leg must omit the
     (schema-optional) vs_baseline rather than emit the 0.0 dead-baseline
