@@ -86,6 +86,16 @@ schema/contract as bench.py — the flagship quantized line LAST):
   (``fault_free_fallback_count`` exactly 0; ``prefill_fallback_count``
   > 0 after the pass) — degradation, never an outage.
 
+- ``mega_off_draft_overhead_frac``/``mega_off_accepted_tokens_per_step``:
+  round 22 — the ``unified-mega-mixed`` pair runs the SAME int8w+int8kv
+  continuous-arrival MIXED prefill+decode churn (not the decode-only
+  shape of ``unified-mega``) speculating k=4 through the model draft
+  source, per-op vs fully megakernelized: the ragged mega step serves
+  every round and the k-step draft chain is ONE fused dispatch. The
+  gates: ``hbm_bytes_per_token`` + ``device_ms_per_step`` strictly below
+  the paired off-leg figures, ``draft_overhead_frac`` shrinks at equal
+  acceptance, ``mega_emissions_match`` holds 1.0.
+
 ``--smoke``: tiny CPU config — always runnable (CI leg, rc 0; gather
 reference attention keeps it fast, kernel parity is the test suite's
 job). Off-TPU without ``--smoke`` each leg emits a structured ``error``
@@ -154,7 +164,14 @@ def _hbm_bytes_per_token(sp, batch, avg_ctx):
     h = cache.num_kv_heads * cache.head_dim
     act_elt = jnp.dtype(sp.params["tok_emb"].dtype).itemsize
     if getattr(sp, "mega_decode", False):
-        act_per_layer = 2 * h  # mega is chip-local (mp == 1 enforced)
+        # chip-local at mp 1: only the (y2, s) pair crosses between the
+        # two kernels. Under mp (round 22, fuse_epilogue=False) the
+        # kernels emit their pre-psum partials and the caller completes
+        # psum + residual + LN outside: the partial, the completed s,
+        # y2, and the MLP-side partial + completed out cross HBM — 5h
+        # full-width (the psums replicate them) per layer, still far
+        # under the per-op chain's 17h.
+        act_per_layer = 2 * h if mp == 1 else 5 * h
     else:
         act_per_layer = 12 * h / mp + 5 * h
     act = 2 * cache.num_layers * act_per_layer * act_elt
@@ -1024,6 +1041,34 @@ def bench_serving_mega_ab(*, steps, windows, **leg_kw):
     return off_leg.report(), on_leg.report()
 
 
+def bench_serving_mega_mixed_ab(*, steps, windows, draft_layers, **leg_kw):
+    """The round-22 mixed-churn megakernel pair: the SAME int8w+int8kv
+    CONTINUOUS-ARRIVAL churn — every finished request immediately
+    replaced, so the timed windows mix chunked prefill and decode the
+    way a serving fleet does (NOT the decode-only shape round 16
+    measured) — speculating k=4 through the truncated-layer model draft
+    source, per-op (mega off) vs fully megakernelized (mega on: the
+    ragged mega step AND the single-dispatch fused draft chain), windows
+    interleaved so machine drift hits both legs alike. Both legs run the
+    production async engine. Returns ``(off_out, on_out)``; the emitted
+    mega-on line carries the paired off-leg stats (tokens/s, hbm bytes,
+    device ms, draft overhead, acceptance) and the greedy emission
+    bit-identity gate — the megakernel must only move WHERE the math
+    runs, never what it emits."""
+    kw = dict(spec_decode_k=4, draft_source="model",
+              draft_layers=draft_layers, async_engine=True,
+              spec_report=True, **leg_kw)
+    off_leg = _ChurnLeg(mega_decode=False, **kw)
+    on_leg = _ChurnLeg(mega_decode=True, **kw)
+    off_leg.warm()
+    on_leg.warm()
+    with _gc_frozen():
+        for _ in range(windows):
+            off_leg.window(steps)
+            on_leg.window(steps)
+    return off_leg.report(), on_leg.report()
+
+
 def main():
     import sys
 
@@ -1171,6 +1216,13 @@ def main():
         # activations pinned in VMEM) — measured interleaved, greedy
         # emissions bit-identical; the new flagship line
         ("unified-mega", None),
+        # round-22 A/B: the SAME int8w+int8kv MIXED prefill+decode churn
+        # (continuous arrivals — the realistic traffic shape) speculating
+        # k=4 through the model draft source, per-op vs fully
+        # megakernelized: the ragged mega step serves EVERY round (no
+        # prefill fallback) and the k-step draft chain is ONE fused
+        # dispatch — measured interleaved, greedy emissions bit-identical
+        ("unified-mega-mixed", None),
     ]
     if selected is not None:
         keep = set(selected)
@@ -1244,6 +1296,32 @@ def main():
                     off_out["hbm_bytes_per_token"])
                 out["mega_off_device_ms_per_step"] = (
                     off_out["device_ms_per_step"])
+                out["vs_baseline"] = (
+                    round(out["value"] / off_out["value"], 3)
+                    if off_out["value"] else 0.0)
+                out["mega_emissions_match"] = _streams_match(
+                    on_out["_streams"], off_out["_streams"])
+                results[name] = out
+            elif name == "unified-mega-mixed":
+                off_out, on_out = bench_serving_mega_mixed_ab(
+                    unified=True, on_tpu=on_tpu, use_kernel=use_kernel,
+                    weight_dtype="int8", kv_cache_dtype="int8",
+                    draft_layers=max(1, ab_shape["layers"] // 4),
+                    **ab_shape, **ab_kw)
+                out = dict(metric=ab_metric_for(name), **on_out)
+                # the paired per-op stats ride the mega-on line: the
+                # strict gates (hbm bytes + device ms strictly lower,
+                # draft overhead shrinks at equal acceptance, emissions
+                # bit-identical) compare within the interleaved pair
+                out["mega_off_tokens_per_s"] = off_out["value"]
+                out["mega_off_hbm_bytes_per_token"] = (
+                    off_out["hbm_bytes_per_token"])
+                out["mega_off_device_ms_per_step"] = (
+                    off_out["device_ms_per_step"])
+                out["mega_off_draft_overhead_frac"] = (
+                    off_out["draft_overhead_frac"])
+                out["mega_off_accepted_tokens_per_step"] = (
+                    off_out["accepted_tokens_per_step"])
                 out["vs_baseline"] = (
                     round(out["value"] / off_out["value"], 3)
                     if off_out["value"] else 0.0)
@@ -1396,9 +1474,13 @@ def main():
     # pool-overflowing reused churn; the hit-rate/TTFT-p99 pair is the
     # headline comparison)
     _emit("fleet-tiered", None)
-    # round-16 flagship LAST: the megakernelized int8w+int8kv decode A/B
-    # (self-baselined on its interleaved mega-off partner)
+    # round-16 megakernelized int8w+int8kv decode A/B (self-baselined on
+    # its interleaved mega-off partner)
     _emit("unified-mega", None)
+    # round-22 flagship LAST: the MIXED-churn megakernel A/B — ragged
+    # mega step + single-dispatch draft chain vs the per-op partner on
+    # continuous-arrival prefill+decode traffic (self-baselined)
+    _emit("unified-mega-mixed", None)
 
 
 if __name__ == "__main__":

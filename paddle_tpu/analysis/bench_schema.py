@@ -125,7 +125,15 @@ _OPTIONAL_NUMERIC = ("vs_baseline", "p50_ms", "p99_ms", "anchor_tflops",
                      "cross_replica_pulls", "pull_fallback_count",
                      "tier_spill_drops", "tier_corrupt_detected",
                      "fault_free_corrupt_detected", "notier_tokens_per_s",
-                     "notier_prefix_hit_rate", "notier_ttft_p99_ms")
+                     "notier_prefix_hit_rate", "notier_ttft_p99_ms",
+                     # round 22: the mixed-churn megakernel A/B (ragged
+                     # mega + the single-dispatch draft chain) — the
+                     # per-op partner's draft-overhead and acceptance
+                     # stats riding the mega-on line, so the
+                     # draft-overhead-shrinks-at-equal-acceptance gate
+                     # compares within the interleaved pair
+                     "mega_off_draft_overhead_frac",
+                     "mega_off_accepted_tokens_per_step")
 _OPTIONAL_STRING = ("mesh_shape", "comm_quant")
 
 #: the bench_serve leg-name enum (round 16): every serving line carries
@@ -137,8 +145,8 @@ KNOWN_LEGS = frozenset((
     "legacy-two-jit", "unified-step", "unified-async", "unified-obs",
     "unified-spmd", "unified-spec-base", "unified-spec-k4",
     "unified-spec-model", "unified-int8w", "unified-int8w-int8kv",
-    "unified-mega", "unified-overload", "fleet-churn", "fleet-disagg",
-    "fleet-tiered",
+    "unified-mega", "unified-mega-mixed", "unified-overload",
+    "fleet-churn", "fleet-disagg", "fleet-tiered",
 ))
 
 

@@ -59,6 +59,16 @@ builds of exactly the programs that carry the repo's numbers:
                   dispatch-ahead step that silently stopped aliasing its
                   pools would double cache memory exactly when two steps
                   are in flight;
+- ``serving-mega-mixed``  the round-22 ragged megakernel serving pair:
+                  the unified step built with ``mega=True`` at the MIXED
+                  packed geometry (chunk > 1, ragged q_lens — a decode
+                  lane and a prefill-chunk lane in ONE dispatch, the
+                  rounds round 16 still routed per-op) and the single-
+                  dispatch draft chain (``build_draft_chain`` — the whole
+                  k-step proposal scan as one jit running the mega layer
+                  blocks), fp and int8-weight/int8-KV variants — JX001
+                  audits the scale math at the ragged rows, JX005 the
+                  pool donation at each program's own shifted positions;
 - ``serving-tiered``  the round-21 tiered KV cache's batched restore
                   scatter (``batched_import_rows`` — the ONE donated
                   ``pages.at[:, pg, row].set(..., mode="drop")`` jit a
@@ -813,6 +823,132 @@ def analyze_serving_mega() -> list[Finding]:
     return findings
 
 
+def analyze_serving_mega_mixed() -> list[Finding]:
+    """Round-22 ragged megakernel serving: the unified step built with
+    ``mega=True`` at the MIXED packed geometry (chunk > 1, ragged
+    q_lens — one lane decoding a single token while another feeds a
+    prefill chunk; the round-16 target only walked the all-decode
+    chunk-1 shape) plus the single-dispatch draft chain
+    (``models/gpt.py build_draft_chain``): the whole k-step truncated-
+    layer proposal pass as one jit whose scan chains the mega layer
+    blocks device-side. Both the fp and the int8-weight + int8-KV
+    variants walk the jaxpr checks — JX001 audits the inline-dequant /
+    quantize-on-write scale math at the ragged rows, JX005 the pool
+    donation at the SHIFTED positions: the ragged mega step donates at
+    the unified layout (11, 12) / (11..14), the draft chain at its own
+    (4, 5) / (4..7) — a chain that silently stopped aliasing its draft
+    pool would double draft-cache memory every speculative round."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from ..inference.kv_cache import KVCacheManager
+    from ..inference.quantize import quantize_serving_params
+    from ..models.gpt import (GPTConfig, GPTForCausalLM, build_draft_chain,
+                              build_unified_step, draft_serving_params,
+                              serving_params)
+
+    cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                    num_heads=2, max_seq_len=32, mega_decode=True)
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg)
+    fp_params = serving_params(model)
+    q_params = quantize_serving_params(serving_params(model), "int8",
+                                       group_size=16)
+    page_size, chunk, b = 8, 2, 2
+    budget = b * chunk
+    rng = np.random.RandomState(0)
+    findings: list[Finding] = []
+
+    def mixed_args(params, mgr):
+        for _ in range(b):
+            mgr.admit_prefix([int(x) for x in rng.randint(0, 128, (8,))])
+        # the mixed round the round-22 kernels serve without a per-op
+        # fallback: lane 0 decodes one token, lane 1 feeds a 2-token
+        # prefill chunk — ragged q_lens, one packed pad row
+        tok_ids = jnp.asarray(rng.randint(0, 128, (budget,)), jnp.int32)
+        tok_slot = jnp.asarray([0, 1, 1, -1], jnp.int32)
+        tok_pos = jnp.asarray([8, 8, 9, 0], jnp.int32)
+        q_lens = jnp.asarray([1, 2], jnp.int32)
+        kv_lens = jnp.full((b,), 8, jnp.int32)
+        last_idx = jnp.asarray([0, 2], jnp.int32)
+        no_cow = jnp.full((b,), mgr.num_pages, jnp.int32)
+        feedback = jnp.zeros((budget,), jnp.int32)
+        prev_toks = jnp.zeros((b,), jnp.int32)
+        emit = jnp.ones((b,), jnp.int32)
+        produced = jnp.zeros((b,), jnp.int32)
+        keys = jnp.zeros((b, 2), jnp.uint32)
+        temp = jnp.asarray([0.0, 0.8], jnp.float32)
+        top_k = jnp.asarray([0, 40], jnp.int32)
+        top_p = jnp.asarray([1.0, 0.9], jnp.float32)
+        pools = ((mgr.k_pages, mgr.v_pages, mgr.k_scales, mgr.v_scales)
+                 if mgr.quantize_kv else (mgr.k_pages, mgr.v_pages))
+        return (params, tok_ids, tok_slot, tok_pos, q_lens, kv_lens,
+                last_idx, feedback, prev_toks, emit, produced) + pools + (
+                    mgr.page_table_device(), no_cow, no_cow, keys, temp,
+                    top_k, top_p)
+
+    def draft_args(params, mgr):
+        for _ in range(b):
+            mgr.admit_prefix([int(x) for x in rng.randint(0, 128, (8,))])
+        dparams = draft_serving_params(params, 1)
+        first = jnp.asarray(rng.randint(0, 128, (b,)), jnp.int32)
+        steps = jnp.asarray([2, 1], jnp.int32)   # ragged chain depths
+        kv_lens = jnp.full((b,), 8, jnp.int32)
+        pools = ((mgr.k_pages, mgr.v_pages, mgr.k_scales, mgr.v_scales)
+                 if mgr.quantize_kv else (mgr.k_pages, mgr.v_pages))
+        return (dparams, first, steps, kv_lens) + pools + (
+            mgr.page_table_device(),)
+
+    def pool(quantize_kv, layers=cfg.num_layers):
+        return KVCacheManager(
+            layers, cfg.num_heads, cfg.head_dim,
+            num_pages=2 * b * (cfg.max_seq_len // page_size), max_batch=b,
+            max_seq_len=cfg.max_seq_len, page_size=page_size,
+            dtype=jnp.float32, quantize_kv=quantize_kv,
+            enable_prefix_cache=True)
+
+    qcfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                     num_heads=2, max_seq_len=32, mega_decode=True,
+                     weight_dtype="int8", weight_quant_group_size=16,
+                     kv_cache_dtype="int8")
+
+    # the ragged mega step, fp and int8w+int8kv: pools donate at the
+    # unified layout's (11, 12) / (11..14)
+    step = build_unified_step(cfg, page_size, chunk, mega=True)
+    args = mixed_args(fp_params, pool(False))
+    findings += analyze_jaxpr(trace_callable(step, *args),
+                              "serving-mega-mixed-step")
+    findings += check_donation(step, args, (11, 12),
+                               "serving-mega-mixed-step")
+    qstep = build_unified_step(qcfg, page_size, chunk, kv_quant=True,
+                               mega=True)
+    qargs = mixed_args(q_params, pool(True))
+    findings += analyze_jaxpr(trace_callable(qstep, *qargs),
+                              "serving-mega-mixed-quant-step")
+    findings += check_donation(qstep, qargs, (11, 12, 13, 14),
+                               "serving-mega-mixed-quant-step")
+
+    # the single-dispatch draft chain (truncated 1-layer stack, k=2,
+    # mega blocks): draft pools donate at the chain layout's (4, 5) /
+    # (4..7)
+    chain = build_draft_chain(cfg, 1, page_size, 2, mega=True)
+    cargs = draft_args(fp_params, pool(False, layers=1))
+    findings += analyze_jaxpr(trace_callable(chain, *cargs),
+                              "serving-mega-draft-chain")
+    findings += check_donation(chain, cargs, (4, 5),
+                               "serving-mega-draft-chain")
+    qchain = build_draft_chain(qcfg, 1, page_size, 2, kv_quant=True,
+                               mega=True)
+    qcargs = draft_args(q_params, pool(True, layers=1))
+    findings += analyze_jaxpr(trace_callable(qchain, *qcargs),
+                              "serving-mega-draft-chain-quant")
+    findings += check_donation(qchain, qcargs, (4, 5, 6, 7),
+                               "serving-mega-draft-chain-quant")
+    return findings
+
+
 def analyze_serving_tiered() -> list[Finding]:
     """Round 21: the tiered KV cache's batched restore landing —
     :func:`paddle_tpu.inference.kv_cache.batched_import_rows`, the one
@@ -868,6 +1004,7 @@ TARGETS = {
     "serving-spec-model": analyze_serving_spec_model,
     "serving-async": analyze_serving_async,
     "serving-mega": analyze_serving_mega,
+    "serving-mega-mixed": analyze_serving_mega_mixed,
     "serving-tiered": analyze_serving_tiered,
 }
 

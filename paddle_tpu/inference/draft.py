@@ -206,15 +206,18 @@ class ModelDraftEngine:
 
     Owns the DEDICATED draft KV pool (a :class:`KVCacheManager` with
     ``draft_layers`` layers — same page machinery, same int8-KV support,
-    same head sharding under a serving mesh) and two fixed-shape builds of
-    the truncated unified step (``models/gpt.py build_draft_step``): a
-    CATCH-UP geometry (``chunk`` tokens per lane per call — replaying
-    context the pool does not hold yet) and the CHAIN geometry (chunk 1 —
-    one packed row per lane) that proposes autoregressively: chain step 1
-    feeds each lane's live last context token, steps 2..k feed the
-    previous step's ``next_toks`` carry through the feedback mask, so the
-    intermediate draft tokens stay device-resident and ONE materialization
-    per round lands every lane's k drafts.
+    same head sharding under a serving mesh) and two fixed-shape builds
+    of the truncated stack: a CATCH-UP geometry (``models/gpt.py
+    build_draft_step`` at ``chunk`` tokens per lane per call — replaying
+    context the pool does not hold yet) and, since round 22, the FUSED
+    CHAIN (``models/gpt.py build_draft_chain``): the whole k-step
+    autoregressive proposal pass as one jit — a device-side ``lax.scan``
+    whose step 1 feeds each lane's live last context token and steps
+    2..k feed the previous step's greedy argmax, so the intermediate
+    draft tokens never touch the host and a speculative round costs ONE
+    draft dispatch (+ the target's verify step). With ``mega`` on, the
+    chain's layer blocks run the persistent mega kernels of
+    ``ops/pallas/mega_decode`` at chunk-1 geometry.
 
     Crash consistency / preemption replay: per request the engine records
     the exact token ids it fed (``fed``). Every proposal starts by
@@ -231,7 +234,7 @@ class ModelDraftEngine:
     def __init__(self, config, params, draft_layers: int, *, page_size,
                  chunk, max_batch, max_seq_len, num_pages=None,
                  use_kernel=None, kv_quant=False, mesh=None, dtype=None,
-                 on_launch=None):
+                 on_launch=None, max_k=None, mega=None):
         from ..models.gpt import (build_draft_step, draft_config,
                                   draft_serving_params)
         from ..observability import MetricsRegistry
@@ -275,9 +278,25 @@ class ModelDraftEngine:
         self._catchup = build_draft_step(
             config, self.draft_layers, self.cache.page_size, self.chunk,
             use_kernel=use_kernel, kv_quant=self.kv_quant, mesh=mesh)
-        self._chain = build_draft_step(
-            config, self.draft_layers, self.cache.page_size, 1,
-            use_kernel=use_kernel, kv_quant=self.kv_quant, mesh=mesh)
+        # round 22: the k-step proposal chain is ONE fused jit
+        # (models/gpt.py build_draft_chain) — a lax.scan over the chain
+        # steps, so a speculative round costs ONE draft dispatch instead
+        # of k. Chains build lazily per requested depth through the
+        # process-wide jit cache (an adaptive-k backoff round runs a
+        # shorter scan, never masked steps it didn't ask for); ``max_k``
+        # (the predictor passes its spec_k) pre-builds the steady-state
+        # geometry so construction-time validation fires loudly.
+        # ``mega`` routes the chain's layer blocks through the
+        # persistent mega kernels (default: the config flag — the chain
+        # matches the parent build's kernel family).
+        self.max_k = int(max_k) if max_k else 0
+        self.mega = bool(getattr(config, "mega_decode", False)
+                         if mega is None else mega)
+        self._config = config
+        self._use_kernel = use_kernel
+        self._mesh = mesh
+        if self.max_k:
+            self._chain_fn(self.max_k)   # build-time validation fires HERE
         self._t_catchup = self.max_batch * self.chunk
         b = self.max_batch
         self._no_cow = jnp.full((b,), self.cache.num_pages, jnp.int32)
@@ -432,54 +451,67 @@ class ModelDraftEngine:
                     st["fed"].extend(ctx[len(st["fed"]):len(st["fed"]) + n])
         if not active:
             return {key: [] for key in lanes}
-        # -- the k-step decode chain (device-resident intermediates) ------
+        # -- the fused k-step chain: ONE dispatch for the whole round -----
+        # (round 22: the per-step loop collapsed into build_draft_chain's
+        # device-side lax.scan — intermediates never touch the host). The
+        # page table is FIXED for the whole chain, so capacity is
+        # pre-reserved here: a lane the pool cannot grow for clamps its
+        # chain length down to what fits (0 = it sits the round out).
         k_max = max(k for _, _, k in active.values())
         b = self.max_batch
-        outs = []
-        prev = self._zero_prev
-        alive = dict(active)           # lanes still chaining
-        reach = {key: 0 for key in active}   # chain steps a lane fed
-        for j in range(1, k_max + 1):
-            rows, w = [], 0
-            q_lens = np.zeros((b,), np.int32)
-            last_idx = np.full((b,), b, np.int32)
-            emit = np.zeros((b,), np.int32)
-            for key in list(alive):
-                st, ctx, k = alive[key]
-                L = len(ctx)
-                if k < j or not self._ensure(st, L - 1 + j, keep):
-                    del alive[key]
-                    continue
-                pos = L - 2 + j        # L-1 at step 1, then +1 per step
-                rows.append((w, st["slot"], ctx[-1] if j == 1 else None,
-                             pos))
-                q_lens[st["slot"]] = 1
-                last_idx[st["slot"]] = w
-                emit[st["slot"]] = 1
-                reach[key] = j
-                w += 1
-            if not rows:
-                break
-            prev = self._dispatch(self._chain, b, rows, q_lens, last_idx,
-                                  emit, prev)
-            outs.append(prev)
-            for key in alive:
-                cache.advance(alive[key][0]["slot"], 1)
-        if not outs:
+        first = np.zeros((b,), np.int32)
+        steps = np.zeros((b,), np.int32)
+        reach = {}                     # key -> chain steps the lane runs
+        for key, (st, ctx, k) in active.items():
+            L = len(ctx)
+            s = int(k)
+            while s > 0 and not self._ensure(st, L - 1 + s, keep):
+                s -= 1
+            reach[key] = s
+            if s > 0:
+                first[st["slot"]] = ctx[-1]
+                steps[st["slot"]] = s
+        if not any(reach.values()):
             return {key: [] for key in lanes}
-        # ONE hard sync lands every lane's whole chain
+        fn = self._chain_fn(k_max)
         jnp = self._jnp
-        arr = np.asarray(jnp.stack(outs))             # [steps, b]
+        res = fn(self.params, jnp.asarray(first), jnp.asarray(steps),
+                 cache.seq_lens_device(),
+                 *((cache.k_pages, cache.v_pages, cache.k_scales,
+                    cache.v_scales) if self.kv_quant
+                   else (cache.k_pages, cache.v_pages)),
+                 cache.page_table_device())
+        cache.update_pages(*res[1:])
+        self.model_steps += 1
+        if self._on_launch is not None:
+            self._on_launch()
+        # ONE hard sync lands every lane's whole chain
+        arr = np.asarray(res[0])                      # [b, k_build]
         drafts = {key: [] for key in lanes}
         for key, (st, ctx, k) in active.items():
             r = reach[key]
             if r <= 0:
                 continue
-            d = [int(arr[i, st["slot"]]) for i in range(r)]
+            cache.advance(st["slot"], r)
+            d = [int(arr[st["slot"], i]) for i in range(r)]
             drafts[key] = d
             # KV now holds ctx[-1] + the first r-1 drafts
             st["fed"].extend([ctx[-1]] + d[:r - 1])
         return drafts
+
+    def _chain_fn(self, k: int):
+        """The fused chain jit at geometry ``k`` — the round's actual
+        max requested depth, so an adaptive-k backoff round never pays
+        masked scan steps it didn't ask for. The process-wide cache in
+        models/gpt.py bounds this to one executable per distinct depth
+        (at most ``max_k`` of them; the constructor pre-builds the
+        steady-state ``max_k`` geometry)."""
+        from ..models.gpt import _draft_chain_fn
+
+        return _draft_chain_fn(
+            self._config, self.draft_layers, self.cache.page_size,
+            int(k), self._use_kernel,
+            kv_quant=self.kv_quant, mesh=self._mesh, mega=self.mega)
 
     def _ensure(self, st, new_len: int, keep: set) -> bool:
         """Grow a draft lane, evicting idle lanes under pressure — but

@@ -222,8 +222,13 @@ def paged_write_packed_prequant(pages, scales, q_toks, s_toks, page_table,
     kernel quantizes the new token's K/V inline in VMEM (the exact
     :func:`paged_write_packed_quant` formula) and emits int8 payloads
     ``q_toks [budget, kv_heads, head_dim]`` with per-row-per-head scales
-    ``s_toks [budget, kv_heads]``; this is just the scatter half.
-    Returns ``(pages, scales)``.
+    ``s_toks [budget, kv_heads]``; this is just the scatter half. Since
+    round 22 the MIXED ragged rounds drive it too: the budget packs a
+    VARIABLE 1..chunk rows per lane (a decode lane one row, a prefill-
+    chunk lane several, pad rows ``tok_slot == -1``), so consecutive
+    rows of one lane land at consecutive ``tok_pos`` — the drop-mode
+    scatter is position-addressed and never cared how many rows a lane
+    contributed. Returns ``(pages, scales)``.
     """
     pg, row = _packed_dest(page_table, tok_slot, tok_pos, page_size,
                            pages.shape[0])

@@ -129,11 +129,12 @@ autotuned default), ``token_budget`` (tokens per step, default
 when unified), ``spec_decode_k`` (speculation build geometry, default
 ``config.spec_decode_k``), ``async_engine`` (the round-13 pipelined
 engine) + ``max_inflight_steps`` (deferral bound for steps that cannot
-complete any request), ``mega_decode`` (round 16, default
-``config.mega_decode``: all-decode rounds route through the fused
-per-layer Pallas megakernels of ``ops/pallas/mega_decode`` — activations
-pinned in VMEM — while mixed rounds keep the per-op step; emissions are
-bit-identical either way).
+complete any request), ``mega_decode`` (round 16, ragged since round 22;
+default ``config.mega_decode``: EVERY round — mixed prefill+decode
+included — runs the fused per-layer Pallas megakernels of
+``ops/pallas/mega_decode`` at the unified step's packed ragged geometry,
+activations pinned in VMEM, the draft chain collapsed to one dispatch;
+emissions are bit-identical either way).
 """
 from __future__ import annotations
 
@@ -476,13 +477,16 @@ class ServingPredictor:
         self.token_budget = int(
             token_budget
             or (self.max_batch * (1 + self.spec_k) + self.chunk))
-        # round 16: the megakernelized decode build — ALL-DECODE rounds
-        # (no prefill chunk packed) route through the fused per-layer
-        # Pallas kernels at their own decode geometry (chunk = 1 + spec_k
-        # rows per lane, budget = max_batch lanes); mixed rounds keep the
-        # per-op unified step. Both programs are fixed-shape, compiled
-        # once, and donate the same pools. mega_decode=False (or a config
-        # with the flag off) is bit-identical to round-15 behavior.
+        # round 16 → 22: the megakernelized build. Round 16 kept a
+        # second all-decode-geometry program and routed by round content;
+        # round 22's ragged mega kernels accept the SAME packed
+        # (token_budget, chunk) geometry as the per-op step, so mega is
+        # now a build flavor of the ONE unified program — every round
+        # (mixed prefill+decode included) runs the fused per-layer Pallas
+        # kernels, and the round-content router is gone. Build-time
+        # validation (int4 weights) raises HERE — a predictor must fail
+        # loudly at construction, not on its first round.
+        # mega_decode=False stays bit-identical to round-15 behavior.
         self.mega_decode = bool(
             getattr(cfg, "mega_decode", False) if mega_decode is None
             else mega_decode)
@@ -494,22 +498,10 @@ class ServingPredictor:
             self._unified = build_unified_step(
                 cfg, self.cache.page_size, self.chunk,
                 use_kernel=use_kernel, kv_quant=self.kv_quant,
-                mesh=self.mesh, spec_k=self.spec_k)
+                mesh=self.mesh, spec_k=self.spec_k,
+                mega=self.mega_decode)
             self._prefill = self._decode = None
-            if self.mega_decode:
-                # build-time validation (int4 weights, mp > 1) raises
-                # HERE — a predictor must fail loudly at construction,
-                # not on its first all-decode round
-                self._mega_chunk = 1 + self.spec_k
-                self._mega_budget = self.max_batch * self._mega_chunk
-                self._mega = build_unified_step(
-                    cfg, self.cache.page_size, self._mega_chunk,
-                    use_kernel=use_kernel, kv_quant=self.kv_quant,
-                    mesh=self.mesh, spec_k=self.spec_k, mega=True)
-            else:
-                self._mega = None
         else:
-            self._mega = None
             self._unified = None
             self._decode = build_decode_step(cfg, self.cache.page_size,
                                              use_kernel=use_kernel,
@@ -551,7 +543,11 @@ class ServingPredictor:
                 max_batch=self.max_batch, max_seq_len=self.max_seq_len,
                 num_pages=draft_num_pages, use_kernel=use_kernel,
                 kv_quant=self.kv_quant, mesh=self.mesh,
-                on_launch=self._note_draft_launch)
+                on_launch=self._note_draft_launch,
+                # round 22: pin the fused chain's build geometry to the
+                # predictor's spec_k (one executable for every round) and
+                # match its kernel family to the parent build
+                max_k=self.spec_k, mega=self.mega_decode)
         # round 13: the async double-buffered engine — dispatch-ahead on
         # the unified step's device-resident token feedback; the sync
         # engine is the same pack/capacity code at pipeline depth zero.
@@ -891,12 +887,7 @@ class ServingPredictor:
         gate asserts this stays constant after warmup. Unified mode counts
         the ONE unified step; legacy counts the decode jit."""
         fn = self._unified if self.unified else self._decode
-        n = fn.trace_count[0]
-        if self._mega is not None:
-            # the mega build is a second routed program with its own
-            # one-time trace: the no-retrace gate covers BOTH
-            n += self._mega.trace_count[0]
-        return n
+        return fn.trace_count[0]
 
     @property
     def prefill_trace_count(self) -> int:
@@ -1849,16 +1840,14 @@ class ServingPredictor:
         import jax
 
         b = self.max_batch
-        # round-16 route: an ALL-DECODE round (every scheduled slot is a
-        # decode lane — no prefill chunk packed) runs the megakernelized
-        # build at its decode geometry; anything feeding a prefill chunk
-        # keeps the per-op unified step. Both fixed-shape, both traced
-        # once; the packed arrays below size to the routed budget.
+        # round 22: the round-16 round-content route is GONE — the mega
+        # build accepts the unified step's ragged packed geometry, so
+        # EVERY round (mixed prefill+decode included) runs the one
+        # program that was built at construction. One fixed shape, one
+        # trace, one steady-pack cache.
         decode_set = set(decode_slots)
-        use_mega = (self._mega is not None
-                    and all(s in decode_set for s in sched))
-        t = self._mega_budget if use_mega else self.token_budget
-        step_fn = self._mega if use_mega else self._unified
+        t = self.token_budget
+        step_fn = self._unified
         spec_len = np.zeros((b,), np.int32)
         # -- steady-decode fast path (async only) ------------------------
         # when EVERY scheduled lane is a feedback decode lane (its input
@@ -1874,10 +1863,7 @@ class ServingPredictor:
         if (self.async_engine and not drafts and not cows
                 and all(n == 1 for n in sched.values())
                 and all(self.running[s]._pending_n > 0 for s in sched)):
-            # the route rides the signature: a mega round's cached device
-            # arrays are mega-budget-shaped and must never serve a per-op
-            # round (or vice versa)
-            steady_sig = (use_mega,) + tuple(
+            steady_sig = tuple(
                 (s, self.running[s].req_id) for s in sorted(sched))
         st = self._steady
         if steady_sig is not None and st is not None \

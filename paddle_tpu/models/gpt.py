@@ -1010,10 +1010,14 @@ def build_unified_step(config: GPTConfig, page_size: int, chunk: int,
     formula) scatter into the donated pools via
     ``paged_write_packed(_prequant)``. Signature, donation, feedback,
     spec verify rows and the one-trace-per-geometry contract are all
-    UNCHANGED; callers build it at DECODE geometry (``chunk = 1 +
-    spec_k``) and route only all-decode rounds here — mixed rounds keep
-    the per-op build. ``validate_mega_config`` rejects int4 weights and
-    mp > 1 meshes at build time.
+    UNCHANGED. Round 22: the kernels serve the MIXED ragged-chunk
+    geometry (any 1..chunk rows per lane), so callers build mega at the
+    SAME ``(token_budget, chunk)`` geometry as the per-op step and route
+    EVERY round here — no prefill fallback, no second program. Under an
+    mp mesh the kernels run with ``fuse_epilogue=False`` (pre-psum
+    partials) and this builder completes ``psum -> bias -> residual ->
+    LN`` with the per-op spelling — the same two collectives per layer.
+    ``validate_mega_config`` rejects int4 weights at build time.
     """
     import jax
     import jax.numpy as jnp
@@ -1035,6 +1039,11 @@ def build_unified_step(config: GPTConfig, page_size: int, chunk: int,
         validate_mega_config(getattr(cfg, "weight_dtype", None),
                              getattr(cfg, "weight_quant_group_size", -1),
                              hd, mp)
+        # mp == 1: residual + LN2 / + b2 fuse INSIDE the kernels. mp > 1:
+        # the kernels emit pre-psum partials and the block completes the
+        # epilogue after the row-parallel psum — per-op spelling, same
+        # two collectives per layer
+        fuse_mega = mp == 1
 
     # argument layout (shared by the wrappers, shard_map specs and the
     # donation indices): params + 6 packed/lane arrays [+ spec_len] + the
@@ -1144,7 +1153,8 @@ def build_unified_step(config: GPTConfig, page_size: int, chunk: int,
             return x, ((kp, vp, ks, vs) if kv_quant else (kp, vp))
 
         def mega_block(xb, layer):
-            # the round-16 fused layer: the whole attention side is ONE
+            # the round-16 fused layer (round 22: ragged chunks, any
+            # 1..chunk rows per lane): the whole attention side is ONE
             # kernel over the [b, chunk] lane blocks (attention reads the
             # pool at kv_lens and handles this step's rows in-register —
             # same math as write-then-attend at ctx), the MLP side one
@@ -1159,9 +1169,24 @@ def build_unified_step(config: GPTConfig, page_size: int, chunk: int,
                                   q_lens, eps=eps, k_scales=ks,
                                   v_scales=vs,
                                   head_major=mesh is not None,
-                                  use_kernel=use_kernel)
+                                  use_kernel=use_kernel,
+                                  fuse_epilogue=fuse_mega)
+            if fuse_mega:
+                if kv_quant:
+                    y2, s, k_new, v_new, k_sc, v_sc = res
+                else:
+                    y2, s, k_new, v_new = res
+            else:
+                # mp > 1: the kernel emitted this shard's pre-psum
+                # output-GEMM partial; finish the epilogue with the
+                # per-op spelling (one psum, then bias/residual/LN2)
+                if kv_quant:
+                    y_part, k_new, v_new, k_sc, v_sc = res
+                else:
+                    y_part, k_new, v_new = res
+                s = xb + _srv_psum(y_part, axis) + p["bo"]
+                y2 = _srv_ln(s, p["ln2_g"], p["ln2_b"], eps)
             if kv_quant:
-                y2, s, k_new, v_new, k_sc, v_sc = res
                 # the kernel quantized inline — scatter the int8 payloads
                 # and their scale rows (the packed gather reads each
                 # token's row out of its lane block)
@@ -1172,16 +1197,22 @@ def build_unified_step(config: GPTConfig, page_size: int, chunk: int,
                     vp, vs, v_new[slot_c, off_c], v_sc[slot_c, off_c],
                     page_table, tok_slot, tok_pos, page_size)
             else:
-                y2, s, k_new, v_new = res
                 kp = paged_write_packed(kp, k_new[slot_c, off_c],
                                         page_table, tok_slot, tok_pos,
                                         page_size)
                 vp = paged_write_packed(vp, v_new[slot_c, off_c],
                                         page_table, tok_slot, tok_pos,
                                         page_size)
-            out = mega_mlp(y2.reshape(b * chunk, h),
-                           s.reshape(b * chunk, h), p,
-                           use_kernel=use_kernel)
+            if fuse_mega:
+                out = mega_mlp(y2.reshape(b * chunk, h),
+                               s.reshape(b * chunk, h), p,
+                               use_kernel=use_kernel, chunk=chunk)
+            else:
+                part = mega_mlp(y2.reshape(b * chunk, h), None, p,
+                                use_kernel=use_kernel,
+                                fuse_epilogue=False, chunk=chunk)
+                out = (s.reshape(b * chunk, h)
+                       + (_srv_psum(part, axis) + p["b2"]))
             return (out.reshape(b, chunk, h),
                     ((kp, vp, ks, vs) if kv_quant else (kp, vp)))
 
@@ -1430,8 +1461,11 @@ def draft_config(config: GPTConfig, draft_layers: int) -> GPTConfig:
             f"spec_draft_layers {draft_layers} must be < num_layers "
             f"{config.num_layers} (a full-depth draft would run the "
             "target twice per token instead of a cheap proposer)")
-    # the draft stack serves plain decode only: no nested speculation, no
-    # megakernel routing (its geometry is already minimal)
+    # the draft stack serves plain decode only: no nested speculation.
+    # mega_decode clears here because the draft jits pick their kernel
+    # family EXPLICITLY — build_draft_step stays per-op (catch-up
+    # geometry), build_draft_chain takes a ``mega`` flag (round 22: the
+    # fused k-step chain runs the mega blocks when the parent does)
     return dataclasses.replace(config, num_layers=draft_layers,
                                spec_decode_k=0, spec_draft_layers=0,
                                mega_decode=False)
@@ -1462,6 +1496,252 @@ def build_draft_step(config: GPTConfig, draft_layers: int, page_size: int,
     executable."""
     return _unified_fn(draft_config(config, draft_layers), page_size,
                        chunk, use_kernel, kv_quant=kv_quant, mesh=mesh)
+
+
+def build_draft_chain(config: GPTConfig, draft_layers: int, page_size: int,
+                      k: int, use_kernel=None, kv_quant: bool = False,
+                      mesh=None, mega: bool = False):
+    """The WHOLE k-step draft proposal chain as ONE jit (round 22).
+
+    The round-19 engine launched the chunk-1 draft step k times per
+    round, chaining tokens through the device feedback carry — k
+    dispatches, k host pack loops. This builder rolls the chain into a
+    single program: a ``lax.scan`` over the k chain steps, each step the
+    truncated stack at chunk-1 geometry (per-op blocks, or the round-16
+    mega blocks when ``mega=True`` — one persistent kernel pair per
+    layer per step, device-chained), so a speculative round costs ONE
+    draft dispatch + ONE verify dispatch.
+
+    Signature::
+
+        fn(params, first_toks[b], steps[b], kv_lens[b],
+           k_pages, v_pages[, k_scales, v_scales], page_table)
+        -> (drafts[b, k], k_pages, v_pages[, k_scales, v_scales])
+
+    ``first_toks[lane]`` is the lane's live last context token (chain
+    step 0's input), ``steps[lane]`` how many chain steps the lane runs
+    (0 = idle — the lane writes nothing and its drafts read 0),
+    ``kv_lens[lane]`` the draft pool's watermark at chain start. Chain
+    step j writes the lane's K/V at position ``kv_lens + j`` and feeds
+    its greedy argmax to step j+1 — bit-identical to k separate chunk-1
+    unified-step dispatches chained through the feedback carry. The
+    caller pre-reserves page capacity for ``kv_lens + steps`` (the page
+    table is fixed for the whole chain) and advances its host watermark
+    by the steps actually run. Pools donate; the trace-count contract
+    matches the unified step.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..inference.kv_cache import (paged_write_packed,
+                                      paged_write_packed_prequant,
+                                      paged_write_packed_quant)
+    from ..ops.pallas.paged_attention import ragged_paged_attention
+
+    cfg = draft_config(config, draft_layers)
+    eps = cfg.layer_norm_eps
+    trace_count = [0]
+    mp, axis = _mesh_mp(mesh)
+    nh_l, hd = cfg.num_heads // mp, cfg.head_dim
+    k = int(k)
+    if k < 1:
+        raise ValueError(f"draft chain length k must be >= 1, got {k}")
+    mega = bool(mega)
+    if mega:
+        from ..ops.pallas.mega_decode import (mega_attn_layer, mega_mlp,
+                                              validate_mega_config)
+
+        validate_mega_config(getattr(cfg, "weight_dtype", None),
+                             getattr(cfg, "weight_quant_group_size", -1),
+                             hd, mp)
+        fuse_mega = mp == 1
+    n_pool = 4 if kv_quant else 2
+
+    def _chain_inner(params, first_toks, steps, kv_lens0, *rest):
+        pools0 = rest[:n_pool]
+        page_table = rest[n_pool]
+        b = first_toks.shape[0]
+        lane = jnp.arange(b, dtype=jnp.int32)
+        kv_lens0 = kv_lens0.astype(jnp.int32)
+
+        def one_step(carry, j):
+            ids, pools = carry
+            if kv_quant:
+                k_pages, v_pages, k_scales, v_scales = pools
+            else:
+                k_pages, v_pages = pools
+                k_scales = v_scales = None
+            active = j < steps
+            q_lens = jnp.where(active, 1, 0).astype(jnp.int32)
+            tok_slot = jnp.where(active, lane, -1).astype(jnp.int32)
+            tok_pos = kv_lens0 + j
+            kv_lens = kv_lens0 + j
+            ctx = (kv_lens + q_lens).astype(jnp.int32)
+            valid = tok_slot >= 0
+            slot_c = jnp.clip(tok_slot, 0, b - 1)
+            scatter_b = jnp.where(valid, tok_slot, b)
+            x = (jnp.take(params["tok_emb"], jnp.maximum(ids, 0), axis=0)
+                 + params["pos_emb"][
+                     jnp.clip(tok_pos, 0,
+                              params["pos_emb"].shape[0] - 1)])
+
+            def block(x, layer):
+                # the per-op layer at chunk-1 geometry — the exact
+                # _step_inner spelling (one packed row per lane)
+                if kv_quant:
+                    p, kp, vp, ks, vs = layer
+                else:
+                    p, kp, vp = layer
+                    ks = vs = None
+                y = _srv_ln(x, p["ln1_g"], p["ln1_b"], eps)
+                qkv = _srv_mm(y, p["wqkv"], use_kernel) + p["bqkv"]
+                q, k_t, v_t = _split_qkv(qkv, nh_l, hd,
+                                         head_major=mesh is not None)
+                if kv_quant:
+                    kp, ks = paged_write_packed_quant(
+                        kp, ks, k_t, page_table, tok_slot, tok_pos,
+                        page_size)
+                    vp, vs = paged_write_packed_quant(
+                        vp, vs, v_t, page_table, tok_slot, tok_pos,
+                        page_size)
+                else:
+                    kp = paged_write_packed(kp, k_t, page_table, tok_slot,
+                                            tok_pos, page_size)
+                    vp = paged_write_packed(vp, v_t, page_table, tok_slot,
+                                            tok_pos, page_size)
+                qb = jnp.zeros((b, 1, nh_l, hd), q.dtype
+                               ).at[scatter_b, 0].set(q, mode="drop")
+                ab = ragged_paged_attention(qb, kp, vp, page_table, ctx,
+                                            q_lens, use_kernel=use_kernel,
+                                            k_scales=ks, v_scales=vs)
+                a = ab[slot_c, 0]
+                x = x + _srv_psum(_srv_mm(a.reshape(b, nh_l * hd),
+                                          p["wo"], use_kernel),
+                                  axis) + p["bo"]
+                x = x + _srv_mlp(p, _srv_ln(x, p["ln2_g"], p["ln2_b"],
+                                            eps), use_kernel, axis)
+                return x, ((kp, vp, ks, vs) if kv_quant else (kp, vp))
+
+            def mega_block(xb, layer):
+                # the fused layer at chunk-1 geometry (round 16 blocks,
+                # round-22 mp composition via fuse_epilogue)
+                if kv_quant:
+                    p, kp, vp, ks, vs = layer
+                else:
+                    p, kp, vp = layer
+                    ks = vs = None
+                h = xb.shape[-1]
+                res = mega_attn_layer(xb, p, kp, vp, page_table, kv_lens,
+                                      q_lens, eps=eps, k_scales=ks,
+                                      v_scales=vs,
+                                      head_major=mesh is not None,
+                                      use_kernel=use_kernel,
+                                      fuse_epilogue=fuse_mega)
+                if fuse_mega:
+                    if kv_quant:
+                        y2, s, k_new, v_new, k_sc, v_sc = res
+                    else:
+                        y2, s, k_new, v_new = res
+                else:
+                    if kv_quant:
+                        y_part, k_new, v_new, k_sc, v_sc = res
+                    else:
+                        y_part, k_new, v_new = res
+                    s = xb + _srv_psum(y_part, axis) + p["bo"]
+                    y2 = _srv_ln(s, p["ln2_g"], p["ln2_b"], eps)
+                if kv_quant:
+                    kp, ks = paged_write_packed_prequant(
+                        kp, ks, k_new[slot_c, 0], k_sc[slot_c, 0],
+                        page_table, tok_slot, tok_pos, page_size)
+                    vp, vs = paged_write_packed_prequant(
+                        vp, vs, v_new[slot_c, 0], v_sc[slot_c, 0],
+                        page_table, tok_slot, tok_pos, page_size)
+                else:
+                    kp = paged_write_packed(kp, k_new[slot_c, 0],
+                                            page_table, tok_slot, tok_pos,
+                                            page_size)
+                    vp = paged_write_packed(vp, v_new[slot_c, 0],
+                                            page_table, tok_slot, tok_pos,
+                                            page_size)
+                if fuse_mega:
+                    out = mega_mlp(y2.reshape(b, h), s.reshape(b, h), p,
+                                   use_kernel=use_kernel, chunk=1)
+                else:
+                    part = mega_mlp(y2.reshape(b, h), None, p,
+                                    use_kernel=use_kernel,
+                                    fuse_epilogue=False, chunk=1)
+                    out = (s.reshape(b, h)
+                           + (_srv_psum(part, axis) + p["b2"]))
+                return (out.reshape(b, 1, h),
+                        ((kp, vp, ks, vs) if kv_quant else (kp, vp)))
+
+            if mega:
+                carry0 = jnp.zeros((b, 1, x.shape[-1]), x.dtype
+                                   ).at[scatter_b, 0].set(x, mode="drop")
+                body = mega_block
+            else:
+                carry0, body = x, block
+            if kv_quant:
+                x, (k_pages, v_pages, k_scales, v_scales) = jax.lax.scan(
+                    body, carry0, (params["layers"], k_pages, v_pages,
+                                   k_scales, v_scales))
+                pools = (k_pages, v_pages, k_scales, v_scales)
+            else:
+                x, (k_pages, v_pages) = jax.lax.scan(
+                    body, carry0, (params["layers"], k_pages, v_pages))
+                pools = (k_pages, v_pages)
+            if mega:
+                x = x[slot_c, 0]
+            x = _srv_ln(x, params["lnf_g"], params["lnf_b"], eps)
+            logits = _srv_logits(params, x).astype(jnp.float32)
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            ids_next = jnp.where(active, nxt, ids)
+            return (ids_next, pools), jnp.where(active, nxt, 0)
+
+        (_, pools), drafts = jax.lax.scan(
+            one_step, (first_toks.astype(jnp.int32), pools0),
+            jnp.arange(k, dtype=jnp.int32))
+        return (drafts.T,) + tuple(pools)   # [b, k]
+
+    def chain(*args):
+        trace_count[0] += 1
+        body = _chain_inner
+        if mesh is not None:
+            from jax.sharding import PartitionSpec as P
+
+            kv_spec, sc_spec = _kv_specs()
+            rep = P()
+            pool_specs = ((kv_spec, kv_spec, sc_spec, sc_spec) if kv_quant
+                          else (kv_spec, kv_spec))
+            body = jax.shard_map(
+                _chain_inner, mesh=mesh,
+                in_specs=(serving_param_specs(args[0]),) + (rep,) * 3
+                + pool_specs + (rep,),
+                out_specs=(rep,) + pool_specs,
+                check_vma=False)
+        with jax.default_matmul_precision("default"):
+            return body(*args)
+
+    jitted = jax.jit(chain, donate_argnums=tuple(range(4, 4 + n_pool)))
+    jitted.trace_count = trace_count
+    return jitted
+
+
+def _draft_chain_fn(config: GPTConfig, draft_layers: int, page_size: int,
+                    k: int, use_kernel, kv_quant=False, mesh=None,
+                    mega=False):
+    """Process-wide jit cache for :func:`build_draft_chain` (same policy
+    as ``_unified_fn``: every predictor with the same draft geometry
+    replays one executable; ``k`` and ``mega`` are build geometry)."""
+    from ..distributed.mesh import mesh_signature
+
+    return _jit_cache_get(
+        ("draft_chain", _cfg_key(draft_config(config, draft_layers)),
+         page_size, k, use_kernel, kv_quant, mesh_signature(mesh), mega),
+        lambda: build_draft_chain(config, draft_layers, page_size, k,
+                                  use_kernel=use_kernel,
+                                  kv_quant=kv_quant, mesh=mesh,
+                                  mega=mega))
 
 
 def generate_paged(model, input_ids, max_new_tokens=20, *, page_size=None,
@@ -1569,21 +1849,15 @@ def generate_paged(model, input_ids, max_new_tokens=20, *, page_size=None,
         from ..inference.draft import DraftProposer
 
         proposers = [DraftProposer(spec_k) for _ in range(b)]
+    # round 22: with mega_decode on, the ONE unified program IS the
+    # megakernelized build — the fused kernels serve the mixed ragged-
+    # chunk geometry (any 1..chunk rows per lane), so prefill chunks and
+    # decode rounds alike run the same fixed-shape mega program (the
+    # round-16 per-op fallback + round-content router are gone)
     step = _unified_fn(cfg, mgr.page_size, chunk, use_kernel,
-                       kv_quant=kv_quant, mesh=mesh, spec_k=spec_k)
-    # round 16: with mega_decode on, ALL-DECODE rounds route through the
-    # megakernelized build at its own decode geometry (chunk = 1 + spec_k
-    # rows per lane); rounds still feeding prefill chunks keep the per-op
-    # step above — two fixed-shape programs, each compiled once
-    step_mega = None
-    if getattr(cfg, "mega_decode", False):
-        mega_chunk = 1 + spec_k
-        step_mega = _unified_fn(cfg, mgr.page_size, mega_chunk, use_kernel,
-                                kv_quant=kv_quant, mesh=mesh,
-                                spec_k=spec_k, mega=True)
-        t_mega = b * mega_chunk
-    traces_at_entry = step.trace_count[0] + (
-        step_mega.trace_count[0] if step_mega is not None else 0)
+                       kv_quant=kv_quant, mesh=mesh, spec_k=spec_k,
+                       mega=bool(getattr(cfg, "mega_decode", False)))
+    traces_at_entry = step.trace_count[0]
     # token budget: every row can feed a full chunk each round (generate
     # drives all rows in lockstep; the budget-packed scheduler lives in
     # ServingPredictor). constant per-call sampling plumbing; generate
@@ -1596,8 +1870,6 @@ def generate_paged(model, input_ids, max_new_tokens=20, *, page_size=None,
     # the synchronous convenience loop never defers emission: feedback
     # stays all-zero and the carry input is a constant (no upload)
     no_feedback = jnp.zeros((t_budget,), jnp.int32)
-    no_feedback_mega = (jnp.zeros((t_mega,), jnp.int32)
-                        if step_mega is not None else None)
     zero_prev = jnp.zeros((b,), jnp.int32)
     base_keys = jnp.zeros((b, 2), jnp.uint32)
     if temperature > 0:
@@ -1619,16 +1891,7 @@ def generate_paged(model, input_ids, max_new_tokens=20, *, page_size=None,
             if done[i] and sl is not None:
                 mgr.free(sl)
                 slots[i] = None
-        # round-16 routing: a round where EVERY live lane decodes (one
-        # context token left) runs the megakernel build at its decode
-        # geometry; any round still feeding prefill chunks stays per-op
-        live = [(i, sl) for i, sl in enumerate(slots)
-                if sl is not None and not done[i]]
-        decode_round = (step_mega is not None and all(
-            len(contexts[i]) - mgr.seq_len(sl) == 1 for i, sl in live))
-        t_route = t_mega if decode_round else t_budget
-        fn = step_mega if decode_round else step
-        fb = no_feedback_mega if decode_round else no_feedback
+        t_route, fn, fb = t_budget, step, no_feedback
         q_lens = np.zeros((b,), np.int32)
         tok_ids = np.zeros((t_route,), np.int32)
         tok_slot = np.full((t_route,), -1, np.int32)
@@ -1738,12 +2001,11 @@ def generate_paged(model, input_ids, max_new_tokens=20, *, page_size=None,
                     done[i] = True
                 if len(outs[i]) >= max_new_tokens:
                     done[i] = True
-    # traces THIS call added: 1 on a cold shape (per routed program — the
-    # mega path adds its own one-time trace), 0 when the cached jits
-    # already compiled them — never per-token (the no-retrace gate)
-    traces_now = step.trace_count[0] + (
-        step_mega.trace_count[0] if step_mega is not None else 0)
-    generate_paged.last_decode_trace_count = traces_now - traces_at_entry
+    # traces THIS call added: 1 on a cold shape, 0 when the cached jit
+    # already compiled it — never per-token (the no-retrace gate). With
+    # mega_decode on, the mega build IS the one program (round 22)
+    generate_paged.last_decode_trace_count = (step.trace_count[0]
+                                              - traces_at_entry)
     # rows that stopped early (eos) pad with the eos id, as before
     n_cols = max(len(o) for o in outs)
     pad = eos_token_id if eos_token_id is not None else 0

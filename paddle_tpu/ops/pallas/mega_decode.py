@@ -27,13 +27,16 @@ into TWO persistent ``pallas_call``s with the activations pinned in VMEM:
   ``[tokens, hidden]`` activation resident across tiles; the 4h-wide
   hidden state NEVER materializes in HBM.
 
-What stays XLA-stitched (by design, documented in ARCHITECTURE.md round
-16): the page-pool SCATTER of the kernel-quantized new K/V rows (pure
-data movement the donated-buffer scatter already does optimally — the
-quantization itself is fused, the kernel emits int8 + scales), the
-embedding gather, the sampling epilogue, and prefill chunks (the mixed
-prefill+decode step keeps the per-op path; the scheduler routes only
-all-decode rounds here — ``chunk = 1 + spec_k`` rows per lane).
+Round 22 generalizes both kernels to the MIXED ragged-chunk geometry:
+a lane may feed any ``1..chunk`` new rows per step (``q_lens`` already
+drove the per-row causal limits — the in-register new-token block IS
+small-chunk prefill), so the unified step routes EVERY round here, not
+just all-decode rounds. What stays XLA-stitched (by design, documented
+in ARCHITECTURE.md rounds 16/22): the page-pool SCATTER of the
+kernel-quantized new K/V rows (pure data movement the donated-buffer
+scatter already does optimally — the quantization itself is fused, the
+kernel emits int8 + scales), the embedding gather, and the sampling
+epilogue.
 
 Contracts shared with the sibling kernels: interpret mode off-TPU (the
 CPU suite runs the real kernel bodies), jnp composed references
@@ -46,9 +49,16 @@ avoid). int4 weights are NOT served here (split-half nibble packing
 interleaves the K rows the per-head tiles slice); ``validate_mega_config``
 rejects them loudly and the per-op path keeps serving int4.
 
-SPMD: chip-local only (``mesh`` of size 1 or None). The fused epilogue
-puts the residual add + LN2 INSIDE the kernel, which would sit on the
-wrong side of the row-parallel psum under mp > 1.
+SPMD (round 22): the kernels compose with the fully-manual ``shard_map``
+mp mesh. Head-sharded weight columns and KV pools are already
+chip-local; the ONLY mp-sensitive piece was the fused epilogue (residual
+add + LN2 / + b2), which must sit AFTER the row-parallel psum. Under
+mp > 1 the caller passes ``fuse_epilogue=False``: the kernels emit the
+pre-psum output-GEMM partial instead, and ``models/gpt.py`` completes
+``psum -> +bias -> residual -> LN2`` with the exact per-op spelling —
+one psum per kernel, the same two collectives per layer as the per-op
+build. At mesh size 1/None the epilogue stays fused (bit-identical to
+round 16).
 """
 from __future__ import annotations
 
@@ -127,12 +137,11 @@ def _quantize_rows_f32(x32):
 def validate_mega_config(weight_dtype, group_size, head_dim, mp=1) -> None:
     """Reject geometries the megakernel cannot serve — callers fall back
     to (or stay on) the per-op path with a loud reason instead of
-    silently computing something else."""
-    if mp and mp > 1:
-        raise ValueError(
-            "mega_decode is chip-local: the fused residual+LN2 epilogue "
-            "would sit before the row-parallel psum under an mp mesh of "
-            f"size {mp} — serve mega_decode at mesh size 1 or None")
+    silently computing something else. ``mp`` is accepted (and ignored)
+    since round 22: mp > 1 serves through ``fuse_epilogue=False`` — the
+    kernels emit pre-psum partials and the caller's shard_map completes
+    the row-parallel reduction, so no mesh size is rejected here."""
+    del mp  # round 22: every mp degree is servable (see the docstring)
     if weight_dtype == "int4":
         raise ValueError(
             "mega_decode does not serve int4 weights: split-half nibble "
@@ -206,7 +215,7 @@ def _kdim_scale_view(s, k, tile, nh):
 
 
 def _mega_attn_kernel(ctx_ref, qlen_ref, pt_ref, *refs, page_size, scale,
-                      eps, wq_quant, wo_quant, kv_quant):
+                      eps, wq_quant, wo_quant, kv_quant, fuse_epilogue):
     """One (lane, head, page) grid step of the fused attention-side layer.
 
     Stage schedule (all state VMEM-resident across the grid):
@@ -358,22 +367,30 @@ def _mega_attn_kernel(ctx_ref, qlen_ref, pt_ref, *refs, page_size, scale,
         # residual + LN2, still in VMEM: s = x + attn + bo; y2 = LN2(s).
         # s round-trips through the storage dtype before the LN read so
         # the statistics match the per-op path's (which LNs the STORED
-        # residual stream).
-        x32 = x_ref[...].astype(jnp.float32)
-        s_out = x32 + yacc_ref[...] + bo_ref[...].astype(jnp.float32)
-        s_ref[...] = s_out.astype(dtype)
-        s32 = s_ref[...].astype(jnp.float32)
-        y2 = _ln_f32(s32, g2_ref[...].astype(jnp.float32),
-                     b2g_ref[...].astype(jnp.float32), eps)
-        y2_ref[...] = y2.astype(dtype)
+        # residual stream). Under mp > 1 (fuse_epilogue=False) the
+        # residual/bias/LN2 must sit AFTER the row-parallel psum, so the
+        # kernel emits the raw output-GEMM partial instead and the
+        # shard_map caller completes the epilogue post-reduction.
+        if fuse_epilogue:
+            x32 = x_ref[...].astype(jnp.float32)
+            s_out = x32 + yacc_ref[...] + bo_ref[...].astype(jnp.float32)
+            s_ref[...] = s_out.astype(dtype)
+            s32 = s_ref[...].astype(jnp.float32)
+            y2 = _ln_f32(s32, g2_ref[...].astype(jnp.float32),
+                         b2g_ref[...].astype(jnp.float32), eps)
+            y2_ref[...] = y2.astype(dtype)
+        else:
+            y2_ref[...] = yacc_ref[...].astype(dtype)
+            s_ref[...] = jnp.zeros_like(s_ref)
 
 
 def mega_attn_layer(xb, p, k_pages, v_pages, page_table, ctx_lens, q_lens,
                     *, eps=1e-5, k_scales=None, v_scales=None,
-                    head_major=False, use_kernel=None):
-    """The fused attention-side decode layer over chunk blocks.
+                    head_major=False, use_kernel=None, fuse_epilogue=True):
+    """The fused attention-side decode layer over ragged chunk blocks.
 
-    xb: [b, chunk, h] per-lane token blocks (``q_lens[b]`` valid rows);
+    xb: [b, chunk, h] per-lane token blocks (``q_lens[b]`` valid rows —
+    any 1..chunk per lane, so mixed prefill+decode rounds serve here);
     p: ONE layer's serving weight dict (``_SRV_LAYER_WEIGHTS`` keys; wqkv
     /wo may be quantized ``{"q", "s"}`` stacks); pages/scales/page_table/
     ctx_lens as in ``ragged_paged_attention`` — ``ctx_lens`` counts
@@ -385,6 +402,15 @@ def mega_attn_layer(xb, p, k_pages, v_pages, page_table, ctx_lens, q_lens,
     pools are int8 (k_new/v_new are then the int8 payloads, quantized
     inline with the ``paged_write_packed_quant`` formula).
 
+    ``fuse_epilogue=False`` (the mp > 1 spelling, round 22): the
+    residual add + bo + LN2 must follow the caller's row-parallel psum,
+    so the return drops (y2, s) in favor of the single pre-psum partial:
+    ``(y_part, k_new, v_new[, k_sc, v_sc])`` with y_part ``[b, chunk,
+    h]`` = (this shard's heads' attention output) @ wo — NO residual,
+    NO bias, NO LN. Head-sharded callers pass their LOCAL wqkv/wo
+    columns and head-sharded pools; q/kv head count derives from the
+    pool's head axis.
+
     ``use_kernel``: None = kernel on TPU / composed jnp reference
     elsewhere; True forces the kernel (interpret off-TPU); False forces
     :func:`mega_attn_layer_reference`.
@@ -395,13 +421,17 @@ def mega_attn_layer(xb, p, k_pages, v_pages, page_table, ctx_lens, q_lens,
         return mega_attn_layer_reference(
             xb, p, k_pages, v_pages, page_table, ctx_lens, q_lens,
             eps=eps, k_scales=k_scales, v_scales=v_scales,
-            head_major=head_major)
+            head_major=head_major, fuse_epilogue=fuse_epilogue)
     b, chunk, h = xb.shape
     num_pages, page_size, hkv, hd = k_pages.shape
-    nh = h // hd
-    assert nh == hkv, (
-        f"mega_attn_layer serves group-1 attention (q heads == kv heads); "
-        f"got {nh} q heads over {hkv} kv heads")
+    # group-1 attention per shard: q heads == kv heads. The pool's head
+    # axis is authoritative — under the mp mesh it carries this shard's
+    # LOCAL heads while xb keeps the full (replicated) hidden width.
+    nh = hkv
+    assert _split_wq(p["wqkv"])[0].shape[1] == 3 * nh * hd, (
+        f"mega_attn_layer: wqkv columns "
+        f"{_split_wq(p['wqkv'])[0].shape[1]} do not match the pool's "
+        f"{nh} heads x {hd} head_dim (group-1: q heads == kv heads)")
     kv_quant = k_scales is not None
     wq, sq, bq4 = _qkv_views(p, nh, hd, head_major)
     wo, so = _split_wq(p["wo"])
@@ -508,7 +538,7 @@ def mega_attn_layer(xb, p, k_pages, v_pages, page_table, ctx_lens, q_lens,
     kern = functools.partial(
         _mega_attn_kernel, page_size=page_size, scale=scale,
         eps=float(eps), wq_quant=sq is not None, wo_quant=so is not None,
-        kv_quant=kv_quant)
+        kv_quant=kv_quant, fuse_epilogue=fuse_epilogue)
     with _atc.x64_off():
         outs = pl.pallas_call(
             kern, grid_spec=grid_spec, out_shape=out_shape,
@@ -523,22 +553,29 @@ def mega_attn_layer(xb, p, k_pages, v_pages, page_table, ctx_lens, q_lens,
     if kv_quant:
         k_sc = outs[4][..., 0].transpose(0, 2, 1)[:, :chunk]
         v_sc = outs[5][..., 0].transpose(0, 2, 1)[:, :chunk]
+        if not fuse_epilogue:
+            return y2, k_new, v_new, k_sc, v_sc   # y2 slot = y_part
         return y2, s, k_new, v_new, k_sc, v_sc
+    if not fuse_epilogue:
+        return y2, k_new, v_new
     return y2, s, k_new, v_new
 
 
 def mega_attn_layer_reference(xb, p, k_pages, v_pages, page_table,
                               ctx_lens, q_lens, *, eps=1e-5, k_scales=None,
-                              v_scales=None, head_major=False):
+                              v_scales=None, head_major=False,
+                              fuse_epilogue=True):
     """Composed jnp oracle for :func:`mega_attn_layer`: the existing
     per-op references (dequant matmul, gathered paged attention with the
     in-register new-token semantics, LN) chained in the megakernel's
-    exact stage order — the numerical golden AND the non-TPU fallback."""
+    exact stage order — the numerical golden AND the non-TPU fallback.
+    ``fuse_epilogue=False`` mirrors the kernel's mp spelling: the return
+    is the pre-psum output-GEMM partial (no residual/bias/LN2)."""
     from .quant_matmul import dequantize_weight
 
     b, chunk, h = xb.shape
     num_pages, page_size, hkv, hd = k_pages.shape
-    nh = h // hd
+    nh = hkv   # pool head axis is authoritative (head-sharded under mp)
     kv_quant = k_scales is not None
     dtype = xb.dtype
 
@@ -606,6 +643,13 @@ def mega_attn_layer_reference(xb, p, k_pages, v_pages, page_table,
     v_all = jnp.concatenate([vc, vf.astype(jnp.float32)], axis=1)
     o = jnp.einsum("bncs,bsnd->bcnd", pr, v_all, precision=_MXU)
     a = o.reshape(b, chunk, nh * hd).astype(dtype)
+    if not fuse_epilogue:
+        # the mp spelling: emit this shard's pre-psum partial; the caller
+        # completes psum -> +bo -> residual -> LN2 with the per-op math
+        y_part = mm(a, p["wo"]).astype(dtype)
+        if kv_quant:
+            return y_part, k_emit, v_emit, k_scr, v_scr
+        return y_part, k_emit, v_emit
     s_out32 = (xb.astype(jnp.float32)
                + mm(a, p["wo"]).astype(jnp.float32)
                + p["bo"].astype(jnp.float32))
@@ -624,10 +668,12 @@ def mega_attn_layer_reference(xb, p, k_pages, v_pages, page_table,
 
 
 def _mega_mlp_kernel(y2_ref, s_res_ref, w1_ref, b1_ref, w2_ref, b2_ref,
-                     *refs, wq_quant):
+                     *refs, wq_quant, fuse_epilogue):
     """One ffn tile of the fused MLP: GEMM1 column tile -> bias + tanh
     gelu -> GEMM2 row tile, accumulated into the residual-initialized
-    output block. The [rows, 4h] hidden state lives only in VMEM."""
+    output block (zero-initialized under ``fuse_epilogue=False`` — the
+    mp caller adds residual + b2 after its psum). The [rows, 4h] hidden
+    state lives only in VMEM."""
     if wq_quant:
         s1_ref, s2_ref, o_ref = refs
     else:
@@ -637,8 +683,11 @@ def _mega_mlp_kernel(y2_ref, s_res_ref, w1_ref, b1_ref, w2_ref, b2_ref,
 
     @pl.when(i == 0)
     def _init():
-        o_ref[...] = (s_res_ref[...].astype(jnp.float32)
-                      + b2_ref[...].astype(jnp.float32))
+        if fuse_epilogue:
+            o_ref[...] = (s_res_ref[...].astype(jnp.float32)
+                          + b2_ref[...].astype(jnp.float32))
+        else:
+            o_ref[...] = jnp.zeros_like(o_ref)
 
     y2 = y2_ref[...]
     w1 = _deq(w1_ref, s1_ref, y2.dtype)
@@ -652,8 +701,16 @@ BM_DEFAULT = 64
 BN_DEFAULT = 512
 
 
-def _mega_sig(h, f, dtype) -> str:
-    return f"mega:{h}x{f}:{jnp.dtype(dtype).name}"
+def _mega_sig(h, f, dtype, chunk=1) -> str:
+    """The autotune-cache key for a mega layer geometry. ``chunk`` (round
+    22) keys the MIXED ragged-chunk geometry: a chunk-c step runs c times
+    the token rows of the decode-only step, so its winning ffn tile can
+    differ — the legacy ``chunk == 1`` spelling stays byte-identical so
+    every decode-only entry persisted before round 22 still hits."""
+    base = f"mega:{h}x{f}"
+    if chunk and chunk > 1:
+        base += f":c{int(chunk)}"
+    return f"{base}:{jnp.dtype(dtype).name}"
 
 
 def _div_pick(pref: int, dim: int) -> int:
@@ -663,7 +720,7 @@ def _div_pick(pref: int, dim: int) -> int:
     return max(b, 1)
 
 
-def preferred_mega_blocks(h, f, dtype=jnp.bfloat16):
+def preferred_mega_blocks(h, f, dtype=jnp.bfloat16, chunk=1):
     """The autotuned ``(bm, bn, bk)`` for this layer geometry (or the
     defaults): ``bn`` tiles the ffn dim through the MLP megakernel, ``bm``
     /``bk`` are currently whole-extent (the decode token block and the
@@ -673,8 +730,12 @@ def preferred_mega_blocks(h, f, dtype=jnp.bfloat16):
     The signature deliberately omits head_dim: nothing swept today
     depends on it (the attention kernel's tiles are pinned whole-extent),
     and a key the lookup side cannot reconstruct is a cache that never
-    hits."""
-    hit = _atc.lookup(_mega_sig(h, f, dtype))
+    hits. ``chunk`` keys the mixed ragged-chunk geometry (round 22); a
+    missing chunk-c entry falls back to the chunk-1 entry before the
+    defaults (the decode sweep is a better prior than nothing)."""
+    hit = _atc.lookup(_mega_sig(h, f, dtype, chunk))
+    if not (hit and len(hit) == 3) and chunk and chunk > 1:
+        hit = _atc.lookup(_mega_sig(h, f, dtype))
     if hit and len(hit) == 3:
         bm, bn, bk = hit
     else:
@@ -682,14 +743,14 @@ def preferred_mega_blocks(h, f, dtype=jnp.bfloat16):
     return int(bm), int(bn), int(bk)
 
 
-def _mlp_bn(f, groups, h, dtype) -> int:
+def _mlp_bn(f, groups, h, dtype, chunk=1) -> int:
     """The ffn tile: the autotuned bn, shrunk to divide the ffn dim and
     align with the w2 scale groups (the quant_matmul whole-groups
     discipline): a tile at least one group wide becomes a MULTIPLE of the
     group size (the kernel reshapes multiple scale rows per tile), a
     smaller tile a divisor of it (one scale row spans several tiles) —
     the autotuned width is preserved, not collapsed to the group size."""
-    _, bn, _ = preferred_mega_blocks(h, f, dtype)
+    _, bn, _ = preferred_mega_blocks(h, f, dtype, chunk)
     if groups > 1:
         gs = f // groups
         if bn >= gs:
@@ -698,21 +759,31 @@ def _mlp_bn(f, groups, h, dtype) -> int:
     return _div_pick(bn, f)
 
 
-def mega_mlp(y2, s_res, p, *, use_kernel=None):
+def mega_mlp(y2, s_res, p, *, use_kernel=None, fuse_epilogue=True,
+             chunk=1):
     """The fused MLP half of the decode layer on the PACKED token stream:
     ``out = s_res + gelu(y2 @ w1 + b1) @ w2 + b2`` with the ffn dim
     streamed in ``bn`` tiles and the hidden state never touching HBM.
-    y2/s_res: [t, h]; returns [t, h] in y2's dtype."""
+    y2/s_res: [t, h]; returns [t, h] in y2's dtype.
+
+    ``fuse_epilogue=False`` (the mp > 1 spelling): returns the pre-psum
+    GEMM2 partial ``gelu(y2 @ w1 + b1) @ w2`` — no residual, no b2; the
+    caller completes ``psum -> +b2 -> residual`` after its collective
+    (``s_res`` may be None). ``chunk`` only keys the autotune lookup —
+    the mixed ragged-chunk geometry may prefer a different ffn tile."""
     if use_kernel is None:
         use_kernel = use_kernel_default()
     if not use_kernel:
-        return mega_mlp_reference(y2, s_res, p)
+        return mega_mlp_reference(y2, s_res, p,
+                                  fuse_epilogue=fuse_epilogue)
     t, h = y2.shape
+    if s_res is None:
+        s_res = jnp.zeros_like(y2)   # never read: fuse_epilogue is False
     w1, s1 = _split_wq(p["w1"])
     w2, s2 = _split_wq(p["w2"])
     f = w1.shape[1]
     groups2 = s2.shape[0] if s2 is not None else 1
-    bn = _mlp_bn(f, groups2, h, y2.dtype)
+    bn = _mlp_bn(f, groups2, h, y2.dtype, chunk)
     t8 = max(8, ((t + 7) // 8) * 8)
     if t8 != t:
         y2 = jnp.pad(y2, ((0, t8 - t), (0, 0)))
@@ -747,7 +818,8 @@ def mega_mlp(y2, s_res, p, *, use_kernel=None):
                 in_specs.append(pl.BlockSpec(
                     (1, h), lambda i, _s=step: (i // _s, 0)))
                 args.append(s2)
-    kern = functools.partial(_mega_mlp_kernel, wq_quant=wq_quant)
+    kern = functools.partial(_mega_mlp_kernel, wq_quant=wq_quant,
+                             fuse_epilogue=fuse_epilogue)
     with _atc.x64_off():
         out = pl.pallas_call(
             kern, grid=(nf,), in_specs=in_specs,
@@ -760,8 +832,10 @@ def mega_mlp(y2, s_res, p, *, use_kernel=None):
     return out[:t].astype(dtype)
 
 
-def mega_mlp_reference(y2, s_res, p):
-    """Composed jnp oracle for :func:`mega_mlp` (and the non-TPU path)."""
+def mega_mlp_reference(y2, s_res, p, *, fuse_epilogue=True):
+    """Composed jnp oracle for :func:`mega_mlp` (and the non-TPU path).
+    ``fuse_epilogue=False`` returns the pre-psum GEMM2 partial (see
+    :func:`mega_mlp`)."""
     from .quant_matmul import dequantize_weight
 
     dtype = y2.dtype
@@ -777,6 +851,8 @@ def mega_mlp_reference(y2, s_res, p):
     u = (mm(y2, p["w1"]).astype(jnp.float32)
          + p["b1"].astype(jnp.float32))
     g = _gelu_f32(u).astype(dtype)
+    if not fuse_epilogue:
+        return mm(g, p["w2"]).astype(dtype)
     out = (s_res.astype(jnp.float32)
            + mm(g, p["w2"]).astype(jnp.float32)
            + p["b2"].astype(jnp.float32))
@@ -789,7 +865,8 @@ def mega_mlp_reference(y2, s_res, p):
 
 
 def autotune_mega_decode(batch, h, f, dtype=jnp.bfloat16,
-                         candidates=(256, 512, 1024, 2048), iters=10):
+                         candidates=(256, 512, 1024, 2048), iters=10,
+                         chunk=1):
     """Sweep the MLP megakernel's ffn tile (``bn``) for this layer
     geometry on the current device and persist the winning ``(bm, bn,
     bk)`` on the shared autotune cache (``bm``/``bk`` ride along whole-
@@ -799,13 +876,18 @@ def autotune_mega_decode(batch, h, f, dtype=jnp.bfloat16,
     the cached tuple always describes a program that actually ran) and
     duplicates are timed once. No-op off-TPU. Timing rides the
     observability clock (tpulint AL006: one clock for durations, traces
-    and bench windows)."""
+    and bench windows). ``chunk`` (round 22) sweeps the MIXED ragged-
+    chunk geometry: the timed token block scales to ``batch * chunk``
+    rows and the result persists under the chunk-keyed signature —
+    decode-only (chunk 1) entries are never overwritten."""
     from ...observability import monotonic
 
+    chunk = max(1, int(chunk))
     if _interpret():
-        return preferred_mega_blocks(h, f, dtype)
+        return preferred_mega_blocks(h, f, dtype, chunk)
     _atc.load()
-    sig = _mega_sig(h, f, dtype)
+    sig = _mega_sig(h, f, dtype, chunk)
+    batch = batch * chunk   # the mixed round's packed token rows
     ky, ks, kw = jax.random.split(jax.random.PRNGKey(0), 3)
     y2 = jax.random.normal(ky, (batch, h), dtype)
     s_res = jax.random.normal(ks, (batch, h), dtype)
@@ -823,7 +905,8 @@ def autotune_mega_decode(batch, h, f, dtype=jnp.bfloat16,
         tried.add(eff)
         _atc.CACHE[sig] = [BM_DEFAULT, eff, int(h)]
         try:
-            step = jax.jit(functools.partial(mega_mlp, use_kernel=True))
+            step = jax.jit(functools.partial(mega_mlp, use_kernel=True,
+                                             chunk=chunk))
             step(y2, s_res, p).block_until_ready()
             t0 = monotonic()
             for _ in range(iters):
@@ -841,4 +924,4 @@ def autotune_mega_decode(batch, h, f, dtype=jnp.bfloat16,
         _atc.CACHE.pop(sig, None)
     else:
         _atc.CACHE[sig] = saved
-    return preferred_mega_blocks(h, f, dtype)
+    return preferred_mega_blocks(h, f, dtype, chunk)

@@ -888,6 +888,20 @@ class TestHazardRegressions:
 
         assert analyze_serving_tiered() == []
 
+    def test_serving_mega_mixed_is_clean_and_donates(self):
+        """The round-22 ragged megakernel pair: the unified mega step at
+        the MIXED packed geometry (chunk > 1, ragged q_lens — a decode
+        lane and a prefill-chunk lane in one dispatch) and the single-
+        dispatch draft chain, fp + int8w/int8kv — jaxpr walk (JX001
+        scale audit at the ragged rows) and the JX005 donation audit at
+        each program's own shifted pool positions come back with ZERO
+        findings (the baseline stays empty). A chain that stopped
+        aliasing its draft pools would double draft-cache memory every
+        speculative round."""
+        from paddle_tpu.analysis.targets import analyze_serving_mega_mixed
+
+        assert analyze_serving_mega_mixed() == []
+
 
 # ---------------------------------------------------------------------------
 # the gate: the repo itself, against the checked-in baseline

@@ -172,18 +172,21 @@ def test_mega_mlp_row_padding(rng):
 
 
 def test_validate_mega_config_rejections():
-    """The build-time gate: int4 weights, mp > 1 meshes and head-dim-
-    straddling scale groups are rejected LOUDLY (callers stay per-op);
-    servable geometries pass silently."""
+    """The build-time gate: int4 weights and head-dim-straddling scale
+    groups are rejected LOUDLY (callers stay per-op); servable
+    geometries pass silently. Round 22 LIFTED the round-16 mp > 1
+    rejection — mega now composes with the shard_map mesh (the
+    serving-level equivalence gate lives in test_serving.py) — so mp
+    values must pass here."""
     validate_mega_config(None, -1, 16)
     validate_mega_config("int8", -1, 16)
     validate_mega_config("int8", 16, 16)     # group == head_dim
     validate_mega_config("int8", 8, 16)      # two groups per head tile
     validate_mega_config("int8", 32, 16)     # one group spans two tiles
+    validate_mega_config(None, -1, 16, mp=2)     # round 22: no raise
+    validate_mega_config("int8", 16, 16, mp=4)   # round 22: no raise
     with pytest.raises(ValueError, match="int4"):
         validate_mega_config("int4", -1, 16)
-    with pytest.raises(ValueError, match="chip-local"):
-        validate_mega_config(None, -1, 16, mp=2)
     with pytest.raises(ValueError, match="group"):
         validate_mega_config("int8", 24, 16)  # 16 % 24 and 24 % 16 != 0
 
@@ -216,6 +219,310 @@ def test_mega_mlp_grouped_scale_tile_branches(rng):
             atc.CACHE.pop(sig, None)
         else:
             atc.CACHE[sig] = saved
+
+
+# -- round 22: ragged mixed-chunk geometry + the unfused (mp) epilogue ------
+
+
+@pytest.mark.parametrize("chunk", [1, 2, 4])
+@pytest.mark.parametrize("quant,group,kv_quant", [
+    (None, -1, False),
+    ("int8", 16, True),         # the flagship int8w-grouped + int8kv leg
+])
+def test_mega_attn_ragged_chunk_sweep(rng, chunk, quant, group, kv_quant):
+    """The round-22 mixed geometry: every chunk width the unified step's
+    packed budget can pack (decode lane + idle lane + a prefill-chunk
+    lane + a fresh ctx-0 lane) runs the kernel against the composed
+    oracle — the geometries round 16 still routed to the per-op
+    fallback."""
+    p = _layer(rng, quant=quant, group=group)
+    xb, (kp, vp, ks, vs), pt, ctx, qlens = _geometry(
+        rng, b=4, chunk=chunk, kv_quant=kv_quant)
+    ref = mega_attn_layer_reference(xb, p, kp, vp, pt, ctx, qlens,
+                                    k_scales=ks, v_scales=vs)
+    ker = mega_attn_layer(xb, p, kp, vp, pt, ctx, qlens, k_scales=ks,
+                          v_scales=vs, use_kernel=True)
+    _assert_close(ref, ker, qlens, chunk)
+
+
+def test_mega_attn_single_lane_full_chunk(rng):
+    """chunk == the whole token budget (b = 1): a pure prefill-chunk
+    round — every row live, in-chunk causal attention carrying most of
+    the mass."""
+    p = _layer(rng)
+    nh, chunk = H // HD, 4
+    kp, vp, _, _ = _pools(rng, 3, nh, False)
+    pt = jnp.asarray([[0, 1, 2]], jnp.int32)
+    ctx = jnp.asarray([5], jnp.int32)
+    qlens = jnp.asarray([chunk], jnp.int32)
+    xb = jnp.asarray(rng.randn(1, chunk, H), jnp.float32)
+    ref = mega_attn_layer_reference(xb, p, kp, vp, pt, ctx, qlens)
+    ker = mega_attn_layer(xb, p, kp, vp, pt, ctx, qlens, use_kernel=True)
+    _assert_close(ref, ker, qlens, chunk)
+
+
+@pytest.mark.parametrize("kv_quant", [False, True])
+def test_mega_attn_unfused_epilogue(rng, kv_quant):
+    """fuse_epilogue=False (the round-22 mp spelling): the kernel's
+    pre-psum output-GEMM partial matches the oracle's, AND the caller's
+    completion (residual + bo + LN2 in the per-op order) reproduces the
+    fused return BIT-exactly — the contract that makes mp > 1 serving
+    bit-identical to per-op."""
+    from paddle_tpu.ops.pallas.mega_decode import _ln_f32
+
+    p = _layer(rng)
+    xb, (kp, vp, ks, vs), pt, ctx, qlens = _geometry(rng, b=4,
+                                                     kv_quant=kv_quant)
+    ref = mega_attn_layer_reference(xb, p, kp, vp, pt, ctx, qlens,
+                                    k_scales=ks, v_scales=vs,
+                                    fuse_epilogue=False)
+    ker = mega_attn_layer(xb, p, kp, vp, pt, ctx, qlens, k_scales=ks,
+                          v_scales=vs, use_kernel=True,
+                          fuse_epilogue=False)
+    assert len(ref) == len(ker) == (5 if kv_quant else 3)
+    _assert_close(ref, ker, qlens, xb.shape[1])
+    # manual completion of the unfused oracle == the fused oracle
+    fused = mega_attn_layer_reference(xb, p, kp, vp, pt, ctx, qlens,
+                                      k_scales=ks, v_scales=vs)
+    s = xb + ref[0] + p["bo"]
+    y2 = _ln_f32(s, p["ln2_g"], p["ln2_b"], 1e-5)
+    valid = np.asarray(qlens)[:, None] > np.arange(xb.shape[1])[None]
+    m = valid[..., None]
+    np.testing.assert_array_equal(np.where(m, np.asarray(y2), 0),
+                                  np.where(m, np.asarray(fused[0]), 0))
+    np.testing.assert_array_equal(np.where(m, np.asarray(s), 0),
+                                  np.where(m, np.asarray(fused[1]), 0))
+    # the emitted K/V payloads are epilogue-independent (unfused index 1
+    # == fused index 2: only the (y2, s) head of the tuple changes)
+    np.testing.assert_array_equal(np.asarray(ref[1]), np.asarray(fused[2]))
+    np.testing.assert_array_equal(np.asarray(ref[2]), np.asarray(fused[3]))
+
+
+@pytest.mark.parametrize("quant,group", [(None, -1), ("int8", 16)])
+def test_mega_mlp_unfused_epilogue(rng, quant, group):
+    """The MLP half of the mp spelling: kernel partial vs oracle partial
+    (``s_res`` never read — callers pass None), and the caller's
+    ``s_res + partial + b2`` completion reproduces the fused oracle
+    BIT-exactly."""
+    p = _layer(rng, quant=quant, group=group)
+    t = 6
+    y2 = jnp.asarray(rng.randn(t, H), jnp.float32)
+    sres = jnp.asarray(rng.randn(t, H), jnp.float32)
+    part_ref = mega_mlp_reference(y2, None, p, fuse_epilogue=False)
+    part_ker = mega_mlp(y2, None, p, use_kernel=True, fuse_epilogue=False)
+    np.testing.assert_allclose(np.asarray(part_ker), np.asarray(part_ref),
+                               atol=2e-3, rtol=0)
+    fused = mega_mlp_reference(y2, sres, p)
+    done = sres + part_ref + p["b2"]
+    np.testing.assert_array_equal(np.asarray(done), np.asarray(fused))
+
+
+# -- round 22: the single-dispatch draft chain ------------------------------
+
+VOCAB = 97
+
+
+def _draft_cfg_params(draft_layers=1):
+    """A tiny 2-layer target model's serving params, sliced to the
+    truncated draft stack — the chain runs the SAME weights the engine
+    would."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import (GPTConfig, GPTForCausalLM,
+                                       draft_serving_params, serving_params)
+
+    paddle.seed(11)
+    cfg = GPTConfig(vocab_size=VOCAB, hidden_size=H, num_layers=2,
+                    num_heads=H // HD, max_seq_len=64)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    return cfg, model, draft_serving_params(serving_params(model),
+                                            draft_layers)
+
+
+def _chain_geometry(rng, b=3, pps=2, kv_quant=False):
+    """Per-lane draft-pool state: a mid-context lane, a deeper lane, an
+    idle lane (steps 0) — page capacity pre-reserved for kv0 + k like the
+    engine does."""
+    nh = H // HD
+    # serving pools carry a leading LAYER axis (the chain's inner scan
+    # runs over it); the truncated draft stack has 1 layer
+    pools = tuple(None if x is None else x[None]
+                  for x in _pools(rng, b * pps, nh, kv_quant))
+    pt = np.arange(b * pps, dtype=np.int32).reshape(b, pps)
+    kv0 = np.array([5, 9, 0][:b], np.int32)
+    first = rng.randint(0, VOCAB, (b,)).astype(np.int32)
+    return pools, jnp.asarray(pt), kv0, first
+
+
+@pytest.mark.parametrize("k", [1, 2, 4])
+def test_draft_chain_bit_identical_to_per_step_chain(rng, k):
+    """THE round-22 draft-chain contract: the fused k-step chain (one
+    dispatch, device-side scan) is BIT-identical — drafts AND pool
+    writes — to k separate single-step dispatches chained through the
+    host, at ragged per-lane depths (one lane a step behind, one idle)."""
+    from paddle_tpu.models.gpt import build_draft_chain
+
+    cfg, _, dparams = _draft_cfg_params()
+    (kp0, vp0, _, _), pt, kv0, first = _chain_geometry(rng)
+    steps = np.array([k, max(k - 1, 1), 0], np.int32)
+    kp_np, vp_np = np.asarray(kp0), np.asarray(vp0)
+
+    fused = build_draft_chain(cfg, 1, PAGE, k, mega=True)
+    res = fused(dparams, jnp.asarray(first), jnp.asarray(steps),
+                jnp.asarray(kv0), jnp.asarray(kp_np), jnp.asarray(vp_np),
+                pt)
+    drafts_fused = np.asarray(res[0])
+
+    single = build_draft_chain(cfg, 1, PAGE, 1, mega=True)
+    kp, vp = jnp.asarray(kp_np), jnp.asarray(vp_np)
+    ids = np.asarray(first)
+    per_step = []
+    for j in range(k):
+        active = steps > j
+        r = single(dparams, jnp.asarray(ids),
+                   jnp.asarray(active.astype(np.int32)),
+                   jnp.asarray(kv0 + j), kp, vp, pt)
+        d = np.asarray(r[0])[:, 0]
+        per_step.append(np.where(active, d, 0))
+        ids = np.where(active, d, ids).astype(np.int32)
+        kp, vp = r[1], r[2]
+    np.testing.assert_array_equal(drafts_fused, np.stack(per_step, 1))
+    np.testing.assert_array_equal(np.asarray(res[1]), np.asarray(kp))
+    np.testing.assert_array_equal(np.asarray(res[2]), np.asarray(vp))
+    # the idle lane proposed nothing and wrote nothing
+    assert not drafts_fused[2].any()
+    lane2 = np.asarray(pt)[2]
+    np.testing.assert_array_equal(np.asarray(res[1])[0][lane2],
+                                  kp_np[0][lane2])
+
+
+def test_draft_chain_mega_emits_per_op_tokens(rng):
+    """Kernel-family independence: the mega-block chain proposes the
+    SAME tokens as the per-op chain (pools agree to reference tolerance)
+    — mega changes cost, never drafts."""
+    from paddle_tpu.models.gpt import build_draft_chain
+
+    cfg, _, dparams = _draft_cfg_params()
+    (kp0, vp0, _, _), pt, kv0, first = _chain_geometry(rng)
+    steps = np.array([3, 2, 0], np.int32)
+    kp_np, vp_np = np.asarray(kp0), np.asarray(vp0)
+    out = {}
+    for mega in (False, True):
+        fn = build_draft_chain(cfg, 1, PAGE, 3, mega=mega)
+        out[mega] = fn(dparams, jnp.asarray(first), jnp.asarray(steps),
+                       jnp.asarray(kv0), jnp.asarray(kp_np),
+                       jnp.asarray(vp_np), pt)
+    np.testing.assert_array_equal(np.asarray(out[True][0]),
+                                  np.asarray(out[False][0]))
+    np.testing.assert_allclose(np.asarray(out[True][1]),
+                               np.asarray(out[False][1]), atol=2e-3)
+
+
+def test_draft_chain_int8kv_payloads_bit_identical(rng):
+    """The int8-KV chain: fused vs per-step single dispatches — the
+    quantized payloads AND scale rows land bit-identically (both sides
+    share the paged_write_packed_quant formula)."""
+    from paddle_tpu.models.gpt import build_draft_chain
+
+    cfg, _, dparams = _draft_cfg_params()
+    (kp0, vp0, ks0, vs0), pt, kv0, first = _chain_geometry(rng,
+                                                           kv_quant=True)
+    steps = np.array([2, 2, 0], np.int32)
+    raw = tuple(np.asarray(x) for x in (kp0, vp0, ks0, vs0))
+
+    fused = build_draft_chain(cfg, 1, PAGE, 2, kv_quant=True, mega=True)
+    res = fused(dparams, jnp.asarray(first), jnp.asarray(steps),
+                jnp.asarray(kv0), *(jnp.asarray(x) for x in raw), pt)
+
+    single = build_draft_chain(cfg, 1, PAGE, 1, kv_quant=True, mega=True)
+    pools = tuple(jnp.asarray(x) for x in raw)
+    ids = np.asarray(first)
+    for j in range(2):
+        active = steps > j
+        r = single(dparams, jnp.asarray(ids),
+                   jnp.asarray(active.astype(np.int32)),
+                   jnp.asarray(kv0 + j), *pools, pt)
+        ids = np.where(active, np.asarray(r[0])[:, 0], ids).astype(np.int32)
+        pools = r[1:]
+    for got, want in zip(res[1:], pools):
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    assert res[1].dtype == jnp.int8
+
+
+def test_draft_chain_preemption_replay_self_heals(rng):
+    """The engine-level self-heal (round 22, fused chain): after a
+    proposal round, a DIVERGED continuation (the target rejected mid-
+    draft) and a SHORTER context (preemption replay) must both roll the
+    draft KV back to the longest common fed prefix and propose exactly
+    what a fresh engine proposes — no commit protocol, one comparison."""
+    from paddle_tpu.inference.draft import ModelDraftEngine
+    from paddle_tpu.models.gpt import serving_params
+
+    cfg, model, _ = _draft_cfg_params()
+    params = serving_params(model)
+    kw = dict(page_size=PAGE, chunk=4, max_batch=2, max_seq_len=64,
+              max_k=3, mega=True)
+    eng = ModelDraftEngine(cfg, params, 1, **kw)
+    ctx = rng.randint(0, VOCAB, (9,)).tolist()
+    d1 = eng.propose({0: (7, ctx, 3)})[0]
+    assert len(d1) == 3
+
+    # diverged continuation: the target accepted d1[0] then emitted its
+    # own token — the fed tail past the fork must be rolled back
+    ctx2 = ctx + [int(d1[0]), (int(d1[1]) + 1) % VOCAB]
+    got = eng.propose({0: (7, ctx2, 3)})[0]
+    want = ModelDraftEngine(cfg, params, 1, **kw).propose(
+        {0: (7, ctx2, 3)})[0]
+    assert got == want and len(got) == 3
+
+    # preemption replay: the request returns with a SHORTER context
+    ctx3 = ctx[:5]
+    got = eng.propose({0: (7, ctx3, 2)})[0]
+    want = ModelDraftEngine(cfg, params, 1, **kw).propose(
+        {0: (7, ctx3, 2)})[0]
+    assert got == want and len(got) == 2
+
+
+# -- round 22: chunk-keyed autotune hygiene ---------------------------------
+
+
+def test_mega_sig_chunk_keying_no_collision():
+    """The round-22 cache-key regression gate: chunk-1 signatures stay
+    BYTE-identical to the pre-round-22 strings (persisted decode-only
+    entries keep hitting), chunk-c signatures are distinct (a mixed-round
+    sweep can never clobber the decode winner), the chunk-c lookup falls
+    back to the chunk-1 prior, and a seeded chunk-c entry never leaks
+    into the chunk-1 lookup."""
+    from paddle_tpu.ops.pallas import autotune_cache as atc
+    from paddle_tpu.ops.pallas.mega_decode import (BM_DEFAULT, BN_DEFAULT,
+                                                   _mega_sig)
+
+    sig1 = _mega_sig(H, F, jnp.float32)
+    assert sig1 == _mega_sig(H, F, jnp.float32, chunk=1)   # legacy bytes
+    sig4 = _mega_sig(H, F, jnp.float32, chunk=4)
+    assert sig4 != sig1 and ":c4" in sig4
+    saved = {s: atc.CACHE.get(s) for s in (sig1, sig4)}
+    try:
+        atc.CACHE.pop(sig1, None)
+        atc.CACHE[sig4] = [16, 32, H]
+        # the chunk-4 winner serves chunk-4 lookups ONLY; decode-only
+        # stays on the defaults
+        assert preferred_mega_blocks(H, F, jnp.float32, chunk=4) \
+            == (16, 32, H)
+        assert preferred_mega_blocks(H, F, jnp.float32) \
+            == (BM_DEFAULT, BN_DEFAULT, H)
+        # a missing chunk-4 entry falls back to the chunk-1 prior
+        atc.CACHE.pop(sig4, None)
+        atc.CACHE[sig1] = [32, 64, H]
+        assert preferred_mega_blocks(H, F, jnp.float32, chunk=4) \
+            == (32, 64, H)
+        assert preferred_mega_blocks(H, F, jnp.float32) == (32, 64, H)
+    finally:
+        for s, v in saved.items():
+            if v is None:
+                atc.CACHE.pop(s, None)
+            else:
+                atc.CACHE[s] = v
 
 
 def test_preferred_mega_blocks_default_and_cache_roundtrip():
