@@ -425,7 +425,10 @@ def bench_dp_quant(on_tpu):
     import jax
     import jax.numpy as jnp
 
-    from paddle_tpu.distributed.compressed_collectives import bytes_on_the_wire
+    # round 23: the wire model rides the shared analysis constants module
+    # (same import the JX009 HLO contract reads) — one source of truth
+    # for the analytic bytes this line carries
+    from paddle_tpu.analysis.cost_model import bytes_on_the_wire
     from paddle_tpu.models.gpt import GPTConfig
     from paddle_tpu.models.gpt_spmd import build_spmd_train_step
     from jax.sharding import Mesh

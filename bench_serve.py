@@ -146,36 +146,22 @@ def _hbm_bytes_per_token(sp, batch, avg_ctx):
     between its two kernels are the attention side's (y2, s) pair — 2h
     elements (the emitted new K/V rows exist in both paths and ride the
     KV term). Kernel-internal scratch blocks are written once and never
-    re-read — not counted for either path."""
-    import jax.numpy as jnp
+    re-read — not counted for either path.
 
-    from paddle_tpu.inference.quantize import serving_weight_bytes
+    Round 23: the formula (and the per-layer activation constants the
+    paragraphs above derive) moved to ``paddle_tpu.analysis.cost_model``
+    so this bench and the tpulint JX007 gate evaluate ONE model; this
+    wrapper just builds the geometry from the live predictor.
+    ``report()`` emits the jaxpr-derived counterpart next to it
+    (``hbm_bytes_per_token_static``) and ``python -m paddle_tpu.analysis``
+    exits 2 when the two diverge past the contracted tolerance."""
+    from paddle_tpu.analysis.cost_model import (analytic_hbm_bytes_per_token,
+                                                geometry)
 
-    cache = sp.cache
     mp = 1 if sp.mesh is None else int(sp.mesh.shape["mp"])
-    layer_b = serving_weight_bytes({"layers": sp.params["layers"]})
-    repl_b = serving_weight_bytes(sp.params) - layer_b
-    wb = (layer_b / mp + repl_b) / max(batch, 1)
-    elt = jnp.dtype(cache.k_pages.dtype).itemsize
-    kv = (2 * cache.num_layers * avg_ctx
-          * cache.num_kv_heads * cache.head_dim * elt) / mp
-    if cache.quantize_kv:
-        kv += 2 * cache.num_layers * avg_ctx * cache.num_kv_heads * 4 / mp
-    h = cache.num_kv_heads * cache.head_dim
-    act_elt = jnp.dtype(sp.params["tok_emb"].dtype).itemsize
-    if getattr(sp, "mega_decode", False):
-        # chip-local at mp 1: only the (y2, s) pair crosses between the
-        # two kernels. Under mp (round 22, fuse_epilogue=False) the
-        # kernels emit their pre-psum partials and the caller completes
-        # psum + residual + LN outside: the partial, the completed s,
-        # y2, and the MLP-side partial + completed out cross HBM — 5h
-        # full-width (the psums replicate them) per layer, still far
-        # under the per-op chain's 17h.
-        act_per_layer = 2 * h if mp == 1 else 5 * h
-    else:
-        act_per_layer = 12 * h / mp + 5 * h
-    act = 2 * cache.num_layers * act_per_layer * act_elt
-    return int(wb + kv + act)
+    return analytic_hbm_bytes_per_token(geometry(
+        sp.params, sp.cache, batch=batch, avg_ctx=avg_ctx,
+        mega=getattr(sp, "mega_decode", False), mp=mp))
 
 
 class _ChurnLeg:
@@ -376,6 +362,24 @@ class _ChurnLeg:
             # draft rollback pages is visible in the line itself
             telemetry=sp.telemetry(),
         )
+        # round 23: the jaxpr-derived static HBM model next to the
+        # analytic one, plus their relative drift — the same pair the
+        # tpulint JX007 contracts gate. Unified steps only (the legacy
+        # per-op leg has no single traced step to derive from); the keys
+        # are simply absent there, and the smoke tests assert presence on
+        # the unified legs so a silent derivation failure still fails CI
+        try:
+            from paddle_tpu.analysis.cost_model import \
+                static_hbm_for_predictor
+            static = static_hbm_for_predictor(
+                sp, self.batch, self.prompt + self.gen_len // 2)
+        except Exception:
+            static = None
+        if static is not None:
+            analytic = out["hbm_bytes_per_token"]
+            out["hbm_bytes_per_token_static"] = int(static)
+            out["hbm_model_drift_frac"] = round(
+                (static - analytic) / analytic, 4)
         if self.observability:
             # traced leg: how many host events the windows recorded
             # (spans + request-lane phases — 0 would mean the tracing

@@ -2,9 +2,14 @@
 
 Three passes and one CI gate (round 8):
 
-- **source** — :mod:`.astlint` AST rules (AL*) over the package source;
+- **source** — :mod:`.astlint` AST rules (AL*) over the package source,
+  plus the :mod:`.threadlint` AL009 thread-discipline rule over the
+  ``inference/`` + ``observability/`` packages (round 23);
 - **trace** — :mod:`.jaxpr_checks` jaxpr rules (JX*) + the eager op-dtype
-  AMP cross-check (TR001) over the flagship callables in :mod:`.targets`;
+  AMP cross-check (TR001) over the flagship callables in :mod:`.targets`,
+  plus the round-23 cost certification (:mod:`.cost_model` JX007 static
+  hbm model, :mod:`.vmem` JX008 VMEM footprints, :mod:`.collectives_audit`
+  JX009 collective contracts) against the :mod:`.contracts` table;
 - **registry** — :mod:`.registry_audit` rules (RA*) over the op table;
 - **bench** — :mod:`.bench_schema` BL001 over checked-in bench artifacts.
 
@@ -30,15 +35,16 @@ def pass_of_fingerprint(fp: str) -> str | None:
     return RULE_PASS.get(fp[:2])
 
 
-def run_pass(name: str, amp_probe_ops=None) -> list[Finding]:
+def run_pass(name: str, amp_probe_ops=None, targets=None) -> list[Finding]:
     if name == "source":
+        from . import threadlint
         from .astlint import lint_package
 
-        return lint_package()
+        return lint_package() + threadlint.lint_package()
     if name == "trace":
         from .targets import analyze_flagships
 
-        return analyze_flagships()
+        return analyze_flagships(names=targets)
     if name == "registry":
         from .registry_audit import audit_registry
 
@@ -50,10 +56,11 @@ def run_pass(name: str, amp_probe_ops=None) -> list[Finding]:
     raise ValueError(f"unknown pass {name!r}; one of {PASSES}")
 
 
-def run_all(passes=PASSES, amp_probe_ops=None) -> list[Finding]:
+def run_all(passes=PASSES, amp_probe_ops=None, targets=None) -> list[Finding]:
     out: list[Finding] = []
     for p in passes:
-        out.extend(run_pass(p, amp_probe_ops=amp_probe_ops))
+        out.extend(run_pass(p, amp_probe_ops=amp_probe_ops,
+                            targets=targets))
     return out
 
 

@@ -8,6 +8,8 @@ Usage::
     python -m paddle_tpu.analysis --passes source,bench
     python -m paddle_tpu.analysis --json           # machine-readable report
     python -m paddle_tpu.analysis --write-baseline # accept current findings
+    python -m paddle_tpu.analysis --list-targets   # flagship target names
+    python -m paddle_tpu.analysis --target serving-mega-mixed
 """
 from __future__ import annotations
 
@@ -28,7 +30,32 @@ def main(argv=None) -> int:
                     help="emit one JSON report object instead of text")
     ap.add_argument("--rules", action="store_true",
                     help="print the rule catalog and exit")
+    ap.add_argument("--target", default=None,
+                    help="comma list of flagship targets: run ONLY the "
+                         "trace pass over these (local iteration / CI "
+                         "shards skip the full sweep)")
+    ap.add_argument("--list-targets", action="store_true",
+                    help="print the flagship target names and exit")
     args = ap.parse_args(argv)
+
+    if args.list_targets:
+        # target registration is import-cheap (the analyze functions do
+        # their heavy imports lazily) — no jax init needed to list
+        from .targets import TARGETS
+        for name in TARGETS:
+            print(name)
+        return 0
+
+    targets = None
+    if args.target is not None:
+        from .targets import TARGETS
+        targets = {t.strip() for t in args.target.split(",") if t.strip()}
+        unknown = targets - set(TARGETS)
+        if unknown:
+            ap.error(f"unknown target(s) {sorted(unknown)}; "
+                     "see --list-targets")
+        # a target-restricted run is a trace-pass run by definition
+        args.passes = "trace"
 
     # deterministic gate environment: an 8-way virtual CPU mesh (the trace
     # pass analyzes the dp2/pp2/mp2 step), pinned before jax initializes —
@@ -46,13 +73,18 @@ def main(argv=None) -> int:
 
     if args.rules:
         # importing the pass modules populates the catalog
-        from . import astlint, bench_schema, jaxpr_checks, registry_audit  # noqa: F401
+        from . import (astlint, bench_schema, collectives_audit,  # noqa: F401
+                       cost_model, jaxpr_checks, registry_audit,
+                       threadlint, vmem)
         for rid, desc in sorted(RULES.items()):
             print(f"{rid}  {desc}")
         return 0
 
     passes = tuple(p.strip() for p in args.passes.split(",") if p.strip())
-    findings = run_all(passes)
+    if targets is not None and args.write_baseline:
+        ap.error("--write-baseline needs the full trace sweep; drop "
+                 "--target")
+    findings = run_all(passes, targets=targets)
 
     if args.write_baseline:
         # a partial run only owns its passes' entries: preserve the rest so
@@ -67,9 +99,15 @@ def main(argv=None) -> int:
 
     # a partial run only owns its passes' baseline entries: diffing against
     # the full set would report still-live findings of passes that did not
-    # run as "stale" (same ownership filter as --write-baseline above)
+    # run as "stale" (same ownership filter as --write-baseline above). A
+    # --target run narrows further, to trace fingerprints whose target
+    # component (rule::target::detail) starts with a selected target name
     base = {fp for fp in load_baseline()
             if pass_of_fingerprint(fp) in passes}
+    if targets is not None:
+        base = {fp for fp in base
+                if any(fp.split("::", 2)[1].startswith(t)
+                       for t in targets)}
     new, accepted, fixed = diff_against_baseline(findings, base)
     if args.json:
         print(json.dumps({

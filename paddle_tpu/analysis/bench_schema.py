@@ -36,6 +36,10 @@ _OPTIONAL_NUMERIC = ("vs_baseline", "p50_ms", "p99_ms", "anchor_tflops",
                      "anchor_frac_peak", "ttft_p50_ms", "ttft_p99_ms",
                      "prefix_hit_rate", "decode_retraces",
                      "prefill_retraces", "hbm_bytes_per_token",
+                     # round 23: the jaxpr-derived static HBM model and
+                     # its relative drift against the analytic one — the
+                     # pair the tpulint JX007 cost contracts gate
+                     "hbm_bytes_per_token_static", "hbm_model_drift_frac",
                      "mesh_chips", "tokens_per_s_per_chip",
                      "accepted_tokens_per_step", "draft_acceptance_rate",
                      # round 13: sync-vs-async serving A/B — the

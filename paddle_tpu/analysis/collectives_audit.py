@@ -1,0 +1,147 @@
+"""tpulint collective-contract audit (JX009).
+
+Two layers, matching the two places a collective can hide:
+
+**Jaxpr inventory** — walk a traced step counting collective primitives by
+``(primitive, dtype)``, multiplying through ``scan`` trip counts (a psum in
+the layer-scan body is L psums per step). The serving contracts pin the
+inventory exactly: the mp serving step is 2L row-parallel fp psums and
+NOTHING else (the "only wire traffic" claim of the round-11 sharding), and
+every mp=1 target is collective-free. A new all-gather sneaking into the
+layer chain — or a psum silently changing dtype — diverges from the
+committed table and exits 2.
+
+**Compiled-HLO audit** — GSPMD materializes collectives that never appear
+in the jaxpr (the dpquant ring's quantize->roll hops become
+``collective-permute`` ops at compile time, and a partitioning bug would
+materialize fp ``all-reduce`` the same way). So for the dpquant train step
+we compile the program and regex the HLO text the way the comm-bytes tests
+do: assert NO fp-dtype all-reduce above the small-payload allowance (loss
+scalars are fine, gradient-sized fp traffic is the regression the
+EQuARX-style wire quantization exists to prevent) and that int8 collective
+payloads are actually present on the wire.
+"""
+from __future__ import annotations
+
+import re
+
+from .findings import Finding, rule
+from .jaxpr_checks import _jaxprs_in
+
+JX009 = rule("JX009", "collective inventory diverges from the target's "
+                      "committed contract")
+
+#: jaxpr-level collective primitives (axis-bound cross-replica traffic)
+COLLECTIVE_PRIMS = frozenset({
+    "psum", "pmax", "pmin", "ppermute", "pgather", "all_gather",
+    "all_to_all", "reduce_scatter", "psum_scatter",
+})
+
+#: HLO collective op mnemonics (compiled-program surface)
+_HLO_COLLECTIVE_RE = re.compile(
+    r"= (\w+)\[([\d,]*)\]\S* "
+    r"(all-reduce|all-gather|reduce-scatter|collective-permute|all-to-all)"
+    r"\(")
+
+#: fp dtypes on the wire that the dpquant contract forbids at gradient size
+_FP_DTYPES = frozenset({"f64", "f32", "bf16", "f16"})
+
+
+def collective_inventory(closed) -> dict[str, int]:
+    """Count collectives in a traced program as ``{"prim:dtype": n}``,
+    recursing sub-jaxprs with scan-length multipliers (``while`` bodies
+    count x1 — trip counts are data-dependent, so the inventory is a
+    lower bound there; none of the contracted steps loop collectives in a
+    while)."""
+    counts: dict[str, int] = {}
+
+    def walk(jaxpr, mult):
+        for eqn in jaxpr.eqns:
+            name = eqn.primitive.name
+            if name in COLLECTIVE_PRIMS:
+                avals = [getattr(v, "aval", None) for v in eqn.invars]
+                avals = [a for a in avals if a is not None]
+                dt = str(avals[0].dtype) if avals else "?"
+                key = f"{name}:{dt}"
+                counts[key] = counts.get(key, 0) + mult
+            inner = mult
+            if name == "scan":
+                inner = mult * int(eqn.params.get("length", 1))
+            for val in eqn.params.values():
+                for sub in _jaxprs_in(val):
+                    walk(sub, inner)
+
+    walk(closed.jaxpr, 1)
+    return counts
+
+
+def check_collectives(closed, expected: dict[str, int],
+                      target: str) -> list[Finding]:
+    """JX009 jaxpr side: the traced inventory must EQUAL the contract —
+    extras, missing entries and dtype changes all count as divergence."""
+    got = collective_inventory(closed)
+    findings = []
+    for key in sorted(set(got) | set(expected)):
+        g, w = got.get(key, 0), expected.get(key, 0)
+        if g != w:
+            findings.append(Finding(
+                rule=JX009, target=target, detail=key,
+                message=f"traced step carries {g} x {key} but the contract "
+                        f"commits to {w} (full inventory: {got or '{}'})",
+                data={"inventory": got, "expected": dict(expected)}))
+    return findings
+
+
+def hlo_collectives(fn, args, *, donate_argnums=(),
+                    mesh=None) -> list[dict]:
+    """Compile ``fn(*args)`` and inventory the HLO's collectives as
+    ``[{kind, dtype, elems}]`` (the comm-bytes regex technique). ``fn``
+    may already be a jitted function (it then lowers as-is, keeping its
+    own shardings/donation); ``mesh`` supplies the context the program's
+    sharding constraints resolve against."""
+    import contextlib
+
+    import jax
+
+    jfn = (fn if hasattr(fn, "lower")
+           else jax.jit(fn, donate_argnums=donate_argnums))
+    with mesh if mesh is not None else contextlib.nullcontext():
+        txt = jfn.lower(*args).compile().as_text()
+    out = []
+    for m in _HLO_COLLECTIVE_RE.finditer(txt):
+        dtype, dims, kind = m.group(1), m.group(2), m.group(3)
+        elems = 1
+        for d in dims.split(","):
+            if d:
+                elems *= int(d)
+        out.append({"kind": kind, "dtype": dtype, "elems": elems})
+    return out
+
+
+def check_hlo_collectives(entries: list[dict], target: str, *,
+                          fp_allreduce_max_elems: int = 1024,
+                          require_s8: bool = True) -> list[Finding]:
+    """JX009 HLO side: no gradient-sized fp all-reduce; s8 payloads
+    actually present when the wire is contracted quantized."""
+    findings = []
+    for e in entries:
+        if (e["kind"] in ("all-reduce", "reduce-scatter")
+                and e["dtype"] in _FP_DTYPES
+                and e["elems"] > fp_allreduce_max_elems):
+            findings.append(Finding(
+                rule=JX009, target=target,
+                detail=f"hlo-fp-{e['kind']}:{e['dtype']}",
+                message=f"compiled HLO carries a {e['dtype']} {e['kind']} "
+                        f"of {e['elems']} elements — gradient-sized fp "
+                        "wire traffic on a step contracted int8-on-the-"
+                        f"wire (allowance {fp_allreduce_max_elems} elems "
+                        "for loss/metric scalars)",
+                data=e))
+            break
+    if require_s8 and not any(e["dtype"] == "s8" for e in entries):
+        findings.append(Finding(
+            rule=JX009, target=target, detail="hlo-no-s8-collective",
+            message="compiled HLO carries no s8 collective payload — the "
+                    "quantized gradient ring is not actually on the wire",
+            data={"entries": entries}))
+    return findings

@@ -1,0 +1,152 @@
+"""tpulint cost-certification contracts — the committed expectations table.
+
+One frozen :class:`CostContract` per certified flagship sub-target (keys
+match the ``Finding.target`` strings the analyze functions emit). The
+contract is DATA: the declared serving geometry the static models evaluate
+at (``avg_ctx``/``batch``/``mp``), the JX007 drift tolerance against the
+bench analytic model, the JX008 per-geometry VMEM budget and
+mega-residency flag, the JX009 collective inventory, and the dpquant HLO
+wire expectations. The checking logic lives in :mod:`.cost_model`,
+:mod:`.vmem` and :mod:`.collectives_audit`; changing a claim means editing
+THIS table in the same commit that changes the program — anything else
+exits 2.
+
+The VMEM budgets are per the ANALYSIS geometry (the tiny 2-layer h=32
+configs the targets trace): snug numbers a structural regression (a block
+suddenly spanning the full token axis, a scratch buffer scaling with the
+pool) blows through, not production-HBM sizing.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from .findings import Finding
+
+#: JX008 budget for the tiny-geometry serving kernels (measured footprints
+#: sit well under half of this; a block picking up a pool-sized axis
+#: overshoots it immediately)
+_SERVING_VMEM_BUDGET = 1 << 20
+
+
+@dataclass(frozen=True)
+class CostContract:
+    """Declared cost expectations for one certified target."""
+
+    avg_ctx: float = 8.0          # declared steady-state context tokens
+    batch: int = 2                # lanes amortizing the weight sweep
+    mp: int = 1                   # model-parallel ways
+    mega: bool = False            # megakernel activation regime
+    hbm_tolerance: float | None = None      # JX007 relative drift gate
+    vmem_budget_bytes: int | None = None    # JX008 per-kernel budget
+    mega_vmem_resident: bool = False        # JX008 4h-never-in-HBM check
+    collectives: dict | None = None         # JX009 exact jaxpr inventory
+    hlo_require_s8: bool = False            # JX009 HLO: s8 on the wire
+    hlo_fp_allreduce_max_elems: int = 1024  # JX009 HLO: fp allowance
+
+
+def _serving(mega: bool = False, mp: int = 1, *, vmem: bool = True,
+             collectives: dict | None = None) -> CostContract:
+    return CostContract(
+        mega=mega, mp=mp, hbm_tolerance=0.02,
+        vmem_budget_bytes=_SERVING_VMEM_BUDGET if vmem else None,
+        mega_vmem_resident=mega,
+        collectives={} if collectives is None else collectives)
+
+
+CONTRACTS: dict[str, CostContract] = {
+    # the round-7 per-op decode jit: the oldest hbm claim in the bench
+    "serving-decode": CostContract(hbm_tolerance=0.02, collectives={}),
+    # round-9/10 unified steps (fp and int8w+int8kv)
+    "serving-unified-step": _serving(),
+    "serving-quant-unified-step": _serving(),
+    # round-11 mp=2 sharded step: exactly 2 row-parallel fp psums per
+    # layer x 2 layers at the analysis geometry — and NOTHING else
+    "serving-spmd-unified-step": _serving(
+        mp=2, vmem=False, collectives={"psum:float32": 4}),
+    # round-12/13 spec + async steps ride the same per-op accounting
+    "serving-spec-step": _serving(vmem=False),
+    "serving-spec-quant-step": _serving(vmem=False),
+    "serving-async-step": _serving(vmem=False),
+    # round-16/22 megakernel steps: fused activation accounting + the
+    # 4h-never-in-HBM residency contract + kernel VMEM budgets
+    "serving-mega-step": _serving(mega=True),
+    "serving-mega-quant-step": _serving(mega=True),
+    "serving-mega-mixed-step": _serving(mega=True),
+    "serving-mega-mixed-quant-step": _serving(mega=True),
+    # the single-dispatch draft chains: VMEM + residency + zero
+    # collectives (no hbm model — the bench has no draft-chain leg)
+    "serving-mega-draft-chain": CostContract(
+        mega=True, vmem_budget_bytes=_SERVING_VMEM_BUDGET,
+        mega_vmem_resident=True, collectives={}),
+    "serving-mega-draft-chain-quant": CostContract(
+        mega=True, vmem_budget_bytes=_SERVING_VMEM_BUDGET,
+        mega_vmem_resident=True, collectives={}),
+    # round-21 tiered restore landings: pure scatter, collective-free
+    "serving-tiered-restore-fp": CostContract(collectives={}),
+    "serving-tiered-restore-int8": CostContract(collectives={}),
+    "serving-tiered-restore-scale": CostContract(collectives={}),
+    # round-14 quantized-dp train step: certified on COMPILED HLO — no
+    # gradient-sized fp all-reduce, s8 payloads actually on the wire
+    "train-dpquant-step": CostContract(
+        collectives=None, hlo_require_s8=True,
+        hlo_fp_allreduce_max_elems=1024),
+}
+
+
+def _pools(cache):
+    if getattr(cache, "quantize_kv", False):
+        return (cache.k_pages, cache.v_pages, cache.k_scales,
+                cache.v_scales)
+    return (cache.k_pages, cache.v_pages)
+
+
+def cost_certify(target: str, closed, *, params=None,
+                 cache=None) -> list[Finding]:
+    """Run every contracted static check for ``target`` over one traced
+    program. Targets without a table entry certify vacuously (returns [])
+    — adding a target to the table is what opts it in."""
+    contract = CONTRACTS.get(target)
+    if contract is None:
+        return []
+    findings: list[Finding] = []
+    if contract.hbm_tolerance is not None:
+        import jax
+
+        from . import cost_model
+
+        geom = cost_model.geometry(
+            params, cache, batch=contract.batch, avg_ctx=contract.avg_ctx,
+            mega=contract.mega, mp=contract.mp)
+        findings += cost_model.check_hbm_model(
+            closed, len(jax.tree.leaves(params)), _pools(cache), geom,
+            contract.hbm_tolerance, target)
+    if (contract.vmem_budget_bytes is not None
+            or contract.mega_vmem_resident):
+        from . import vmem
+
+        findings += vmem.check_vmem(closed, contract.vmem_budget_bytes,
+                                    contract.mega_vmem_resident, target)
+    if contract.collectives is not None:
+        from . import collectives_audit
+
+        findings += collectives_audit.check_collectives(
+            closed, contract.collectives, target)
+    return findings
+
+
+def hlo_certify(target: str, fn, args, *, donate_argnums=(),
+                mesh=None) -> list[Finding]:
+    """Run the contracted compiled-HLO audit for ``target`` (the dpquant
+    wire contract): collectives the partitioner materializes never appear
+    in the jaxpr, so this side compiles."""
+    contract = CONTRACTS.get(target)
+    if contract is None:
+        return []
+    from . import collectives_audit
+
+    entries = collectives_audit.hlo_collectives(
+        fn, args, donate_argnums=donate_argnums, mesh=mesh)
+    return collectives_audit.check_hlo_collectives(
+        entries, target,
+        fp_allreduce_max_elems=contract.hlo_fp_allreduce_max_elems,
+        require_s8=contract.hlo_require_s8)

@@ -85,9 +85,17 @@ Configs are tiny (seconds on CPU; the analysis is abstract — eval_shape /
 make_jaxpr, no FLOPs run) but structurally identical to the flagship
 shapes: every scan/remat/constraint/donation the real programs use is in
 the traced jaxpr.
+
+Round 23 adds COST CERTIFICATION on top of the hazard walk: targets with
+an entry in :mod:`.contracts` re-trace their step with ``use_kernel=True``
+(the pallas path the TPU runs) and gate the static JX007 hbm model, the
+JX008 VMEM footprints / mega-residency contract and the JX009 collective
+inventory against the committed table; ``train-dpquant`` additionally
+compiles and audits the HLO wire (fp all-reduce ban + s8 payloads).
 """
 from __future__ import annotations
 
+from .contracts import cost_certify, hlo_certify
 from .findings import Finding
 from .jaxpr_checks import (OpDtypeTrace, analyze_jaxpr, check_donation,
                            trace_callable)
@@ -183,6 +191,12 @@ def analyze_train_dpquant() -> list[Finding]:
     # the builder donates (params, momentum); both must alias outputs
     findings += check_donation(step, (params, mom, ids, labels), (0, 1),
                                "train-dpquant-step")
+    # round 23: the wire contract is only visible in COMPILED HLO (the
+    # ring's quantize->roll hops become collective-permutes at partition
+    # time) — compile and audit: no gradient-sized fp all-reduce, s8
+    # payloads actually on the wire
+    findings += hlo_certify("train-dpquant-step", step,
+                            (params, mom, ids, labels), mesh=mesh)
     return findings
 
 
@@ -223,9 +237,13 @@ def analyze_serving() -> list[Finding]:
     dec_args = (params, jnp.zeros((b,), jnp.int32), lengths,
                 mgr.k_pages, mgr.v_pages,
                 jnp.stack([mgr.slot_pages(sl) for sl in slots]))
-    findings += analyze_jaxpr(trace_callable(decode, *dec_args),
-                              "serving-decode")
+    dec_closed = trace_callable(decode, *dec_args)
+    findings += analyze_jaxpr(dec_closed, "serving-decode")
     findings += check_donation(decode, dec_args, (3, 4), "serving-decode")
+    # round 23: cost-certify the decode step against the bench analytic
+    # hbm model (the oldest per-token claim in bench_serve)
+    findings += cost_certify("serving-decode", dec_closed, params=params,
+                             cache=mgr)
     return findings
 
 
@@ -282,6 +300,12 @@ def analyze_serving_unified() -> list[Finding]:
                              "serving-unified-step")
     # the builder donates the K/V page pools; both must alias outputs
     findings += check_donation(step, args, (11, 12), "serving-unified-step")
+    # round 23: cost-certify the KERNEL build (use_kernel=True forces the
+    # pallas path the TPU runs, so JX008 sees the real launch geometry)
+    kstep = build_unified_step(cfg, page_size, chunk, use_kernel=True)
+    findings += cost_certify("serving-unified-step",
+                             trace_callable(kstep, *args), params=params,
+                             cache=mgr)
     return findings
 
 
@@ -370,6 +394,13 @@ def analyze_serving_quant() -> list[Finding]:
     # pools AND scale planes donate; all four must alias outputs
     findings += check_donation(step, args, (11, 12, 13, 14),
                                "serving-quant-unified-step")
+    # round 23: cost-certify the kernel build (static hbm vs the bench
+    # model with int8 pools + scale planes, kernel VMEM budgets)
+    kstep = build_unified_step(cfg, page_size, chunk, kv_quant=True,
+                               use_kernel=True)
+    findings += cost_certify("serving-quant-unified-step",
+                             trace_callable(kstep, *args), params=params,
+                             cache=qmgr)
     return findings
 
 
@@ -462,10 +493,17 @@ def analyze_serving_spmd() -> list[Finding]:
             qmgr.k_pages, qmgr.v_pages, qmgr.k_scales, qmgr.v_scales,
             qmgr.page_table_device(), no_cow, no_cow, keys, temp, top_k,
             top_p)
-    findings += analyze_jaxpr(trace_callable(step, *args),
-                              "serving-spmd-unified-step")
+    closed = trace_callable(step, *args)
+    findings += analyze_jaxpr(closed, "serving-spmd-unified-step")
     findings += check_donation(step, args, (11, 12, 13, 14),
                                "serving-spmd-unified-step")
+    # round 23: cost-certify the sharded step — the "only 2L row-parallel
+    # psums" claim becomes the committed JX009 inventory, and the static
+    # hbm model runs at mp=2 (contract geometry; inert on a 1-device env
+    # where the mesh degenerates)
+    if mesh.devices.size == 2:
+        findings += cost_certify("serving-spmd-unified-step", closed,
+                                 params=q_params, cache=qmgr)
     return findings
 
 
@@ -541,9 +579,12 @@ def analyze_serving_spec() -> list[Finding]:
                          enable_prefix_cache=True)
     step = build_unified_step(cfg, page_size, chunk, spec_k=spec_k)
     args = spec_args(fp_params, mgr)
-    findings += analyze_jaxpr(trace_callable(step, *args),
-                              "serving-spec-step")
+    closed = trace_callable(step, *args)
+    findings += analyze_jaxpr(closed, "serving-spec-step")
     findings += check_donation(step, args, (12, 13), "serving-spec-step")
+    # round 23: the spec step rides the per-op activation accounting
+    findings += cost_certify("serving-spec-step", closed,
+                             params=fp_params, cache=mgr)
 
     # int8-weight + int8-KV speculative step: pools AND scale planes
     # donate at (12, 13, 14, 15)
@@ -555,10 +596,12 @@ def analyze_serving_spec() -> list[Finding]:
     qstep = build_unified_step(cfg, page_size, chunk, kv_quant=True,
                                spec_k=spec_k)
     qargs = spec_args(q_params, qmgr)
-    findings += analyze_jaxpr(trace_callable(qstep, *qargs),
-                              "serving-spec-quant-step")
+    qclosed = trace_callable(qstep, *qargs)
+    findings += analyze_jaxpr(qclosed, "serving-spec-quant-step")
     findings += check_donation(qstep, qargs, (12, 13, 14, 15),
                                "serving-spec-quant-step")
+    findings += cost_certify("serving-spec-quant-step", qclosed,
+                             params=q_params, cache=qmgr)
     return findings
 
 
@@ -619,9 +662,13 @@ def analyze_serving_async() -> list[Finding]:
             feedback, prev_toks, emit, produced,
             mgr.k_pages, mgr.v_pages, mgr.page_table_device(), no_cow,
             no_cow, keys, temp, top_k, top_p)
-    findings = analyze_jaxpr(trace_callable(step, *args),
-                             "serving-async-step")
+    closed = trace_callable(step, *args)
+    findings = analyze_jaxpr(closed, "serving-async-step")
     findings += check_donation(step, args, (11, 12), "serving-async-step")
+    # round 23: the async step is geometry-identical to the unified step;
+    # its hbm certification keeps the feedback path inside the model
+    findings += cost_certify("serving-async-step", closed, params=params,
+                             cache=mgr)
     return findings
 
 
@@ -801,6 +848,14 @@ def analyze_serving_mega() -> list[Finding]:
     findings += analyze_jaxpr(trace_callable(step, *args),
                               "serving-mega-step")
     findings += check_donation(step, args, (11, 12), "serving-mega-step")
+    # round 23: cost-certify the kernel build — fused activation hbm
+    # accounting, per-kernel VMEM budgets, and the structural 4h-never-
+    # in-HBM residency contract
+    kstep = build_unified_step(cfg, page_size, chunk, mega=True,
+                               use_kernel=True)
+    findings += cost_certify("serving-mega-step",
+                             trace_callable(kstep, *args),
+                             params=fp_params, cache=mgr)
 
     # int8-weight + int8-KV megakernel step (inline dequant + in-kernel
     # quantize-on-write): pools AND scale planes donate at (11..14)
@@ -820,6 +875,11 @@ def analyze_serving_mega() -> list[Finding]:
                               "serving-mega-quant-step")
     findings += check_donation(qstep, qargs, (11, 12, 13, 14),
                                "serving-mega-quant-step")
+    qkstep = build_unified_step(qcfg, page_size, chunk, kv_quant=True,
+                                mega=True, use_kernel=True)
+    findings += cost_certify("serving-mega-quant-step",
+                             trace_callable(qkstep, *qargs),
+                             params=q_params, cache=qmgr)
     return findings
 
 
@@ -917,18 +977,32 @@ def analyze_serving_mega_mixed() -> list[Finding]:
     # the ragged mega step, fp and int8w+int8kv: pools donate at the
     # unified layout's (11, 12) / (11..14)
     step = build_unified_step(cfg, page_size, chunk, mega=True)
-    args = mixed_args(fp_params, pool(False))
+    mgr = pool(False)
+    args = mixed_args(fp_params, mgr)
     findings += analyze_jaxpr(trace_callable(step, *args),
                               "serving-mega-mixed-step")
     findings += check_donation(step, args, (11, 12),
                                "serving-mega-mixed-step")
+    # round 23: cost-certify the kernel builds at the ragged geometry —
+    # the acceptance target for the static hbm model
+    kstep = build_unified_step(cfg, page_size, chunk, mega=True,
+                               use_kernel=True)
+    findings += cost_certify("serving-mega-mixed-step",
+                             trace_callable(kstep, *args),
+                             params=fp_params, cache=mgr)
     qstep = build_unified_step(qcfg, page_size, chunk, kv_quant=True,
                                mega=True)
-    qargs = mixed_args(q_params, pool(True))
+    qmgr = pool(True)
+    qargs = mixed_args(q_params, qmgr)
     findings += analyze_jaxpr(trace_callable(qstep, *qargs),
                               "serving-mega-mixed-quant-step")
     findings += check_donation(qstep, qargs, (11, 12, 13, 14),
                                "serving-mega-mixed-quant-step")
+    qkstep = build_unified_step(qcfg, page_size, chunk, kv_quant=True,
+                                mega=True, use_kernel=True)
+    findings += cost_certify("serving-mega-mixed-quant-step",
+                             trace_callable(qkstep, *qargs),
+                             params=q_params, cache=qmgr)
 
     # the single-dispatch draft chain (truncated 1-layer stack, k=2,
     # mega blocks): draft pools donate at the chain layout's (4, 5) /
@@ -939,6 +1013,10 @@ def analyze_serving_mega_mixed() -> list[Finding]:
                               "serving-mega-draft-chain")
     findings += check_donation(chain, cargs, (4, 5),
                                "serving-mega-draft-chain")
+    kchain = build_draft_chain(cfg, 1, page_size, 2, mega=True,
+                               use_kernel=True)
+    findings += cost_certify("serving-mega-draft-chain",
+                             trace_callable(kchain, *cargs))
     qchain = build_draft_chain(qcfg, 1, page_size, 2, kv_quant=True,
                                mega=True)
     qcargs = draft_args(q_params, pool(True, layers=1))
@@ -946,6 +1024,10 @@ def analyze_serving_mega_mixed() -> list[Finding]:
                               "serving-mega-draft-chain-quant")
     findings += check_donation(qchain, qcargs, (4, 5, 6, 7),
                                "serving-mega-draft-chain-quant")
+    qkchain = build_draft_chain(qcfg, 1, page_size, 2, kv_quant=True,
+                                mega=True, use_kernel=True)
+    findings += cost_certify("serving-mega-draft-chain-quant",
+                             trace_callable(qkchain, *qcargs))
     return findings
 
 
@@ -984,10 +1066,13 @@ def analyze_serving_tiered() -> list[Finding]:
             ("serving-tiered-restore-scale", qmgr.k_scales,
              jnp.zeros((2, cap, 2), qmgr.k_scales.dtype))):
         args = (pool, vals, pg, row)
-        findings += analyze_jaxpr(trace_callable(batched_import_rows,
-                                                 *args), target)
+        closed = trace_callable(batched_import_rows, *args)
+        findings += analyze_jaxpr(closed, target)
         findings += check_donation(batched_import_rows, args, (0,),
                                    target)
+        # round 23: a restore landing is a pure local scatter — its
+        # committed collective inventory is EMPTY
+        findings += cost_certify(target, closed)
     return findings
 
 

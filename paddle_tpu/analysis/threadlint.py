@@ -1,0 +1,187 @@
+"""tpulint thread-discipline lint (AL009).
+
+``inference/`` and ``observability/`` are the two packages where real
+threads run against shared state — the async engine's dispatch pipeline,
+the fleet watchdog/supervisor, the metrics registry behind the chaos
+gates. Their locking convention is lexical: state that is ever mutated
+under ``with self._lock:`` (or any ``with``-expression whose dotted path
+ends in ``_lock``, e.g. ``self._registry._lock``) belongs to that lock,
+and every other mutation of the same attribute is a latent race.
+
+AL009 enforces exactly that, per class:
+
+1. collect the class's **guarded attributes** — every ``self.X`` mutated
+   lexically inside a lock ``with`` in any of its methods;
+2. flag any mutation of a guarded attribute OUTSIDE a lock ``with``,
+   unless the method is exempt: ``__init__``/``__enter__``/``__exit__``
+   (construction precedes sharing), or a designated single-threaded
+   driver — a method whose name contains ``dispatch``, ``reconcile`` or
+   ``tick`` (the engine/watchdog loop bodies that own their state by
+   design and take the lock only around the truly shared slices).
+
+Mutations recognized: assignment/augmented/annotated assignment to
+``self.X`` or through a subscript rooted at ``self.X`` (``self.d[k] =``),
+``del``, and calls to the standard container mutators
+(``self.X.append(...)`` etc.). Aliased mutation (``d = self.d; d[k] = v``)
+is out of lexical reach and out of scope. ``# tpulint: disable=AL009``
+suppresses a site.
+"""
+from __future__ import annotations
+
+import ast
+import os
+
+from .astlint import _dotted, _pragmas
+from .findings import Finding, rule
+
+AL009 = rule("AL009", "lock-guarded attribute mutated outside the lock "
+                      "(inference/ + observability/ thread discipline)")
+
+#: packages under paddle_tpu/ the rule fences (trailing slash, like the
+#: astlint hot-path fences)
+THREADED_DIRS = ("inference/", "observability/")
+
+#: methods allowed to touch guarded state without the lock
+_EXEMPT_METHODS = ("__init__", "__enter__", "__exit__")
+_EXEMPT_SUBSTRINGS = ("dispatch", "reconcile", "tick")
+
+_MUTATORS = frozenset({
+    "append", "appendleft", "add", "clear", "discard", "extend", "insert",
+    "pop", "popleft", "popitem", "remove", "setdefault", "update",
+})
+
+
+def _is_lock_with(stmt: ast.With) -> bool:
+    for item in stmt.items:
+        dotted = _dotted(item.context_expr)
+        if dotted.startswith("self") and dotted.split(".")[-1].endswith(
+                "_lock"):
+            return True
+    return False
+
+
+def _self_attr_of_target(node: ast.AST) -> str | None:
+    """'X' when ``node`` writes ``self.X`` (possibly through subscripts)."""
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _mutations(stmt: ast.stmt):
+    """Yield ``(attr, lineno)`` for every self-attribute mutation in one
+    statement (not descending into nested statements)."""
+    targets: list[ast.AST] = []
+    if isinstance(stmt, ast.Assign):
+        targets = list(stmt.targets)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets = [stmt.target]
+    elif isinstance(stmt, ast.Delete):
+        targets = list(stmt.targets)
+    for t in targets:
+        for el in (t.elts if isinstance(t, ast.Tuple) else [t]):
+            attr = _self_attr_of_target(el)
+            if attr is not None:
+                yield attr, stmt.lineno
+    if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+        fn = stmt.value.func
+        if isinstance(fn, ast.Attribute) and fn.attr in _MUTATORS:
+            attr = _self_attr_of_target(fn.value)
+            if attr is not None:
+                yield attr, stmt.lineno
+
+
+def _walk_method(body, in_lock, sink):
+    """Recurse a method body tracking the lexical lock context; call
+    ``sink(attr, lineno, in_lock)`` for every self-attribute mutation."""
+    for stmt in body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue  # nested defs run on their caller's schedule, skip
+        for attr, lineno in _mutations(stmt):
+            sink(attr, lineno, in_lock)
+        inner = in_lock or (isinstance(stmt, ast.With)
+                            and _is_lock_with(stmt))
+        for field in ("body", "orelse", "finalbody", "handlers"):
+            sub = getattr(stmt, field, None)
+            if not sub:
+                continue
+            if field == "handlers":
+                for h in sub:
+                    _walk_method(h.body, inner, sink)
+            else:
+                _walk_method(sub, inner, sink)
+
+
+def _is_exempt(method_name: str) -> bool:
+    if method_name in _EXEMPT_METHODS:
+        return True
+    low = method_name.lower()
+    return any(s in low for s in _EXEMPT_SUBSTRINGS)
+
+
+def lint_source(text: str, path: str = "<string>") -> list[Finding]:
+    """AL009 over one source string (also the fixture-test entry)."""
+    tree = ast.parse(text)
+    pragmas = _pragmas(text)
+    findings: list[Finding] = []
+    for cls in [n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)]:
+        methods = [n for n in cls.body
+                   if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        guarded: set[str] = set()
+        for m in methods:
+            _walk_method(m.body, False,
+                         lambda a, ln, lk: guarded.add(a) if lk else None)
+        if not guarded:
+            continue
+        for m in methods:
+            if _is_exempt(m.name):
+                continue
+            hits: list[tuple[str, int]] = []
+            _walk_method(
+                m.body, False,
+                lambda a, ln, lk: hits.append((a, ln))
+                if (not lk and a in guarded) else None)
+            for attr, lineno in hits:
+                if "AL009" in pragmas.get(lineno, ()):
+                    continue
+                findings.append(Finding(
+                    rule=AL009, target=path,
+                    detail=f"{cls.name}.{m.name}:{attr}",
+                    message=f"self.{attr} is mutated under the lock "
+                            f"elsewhere in {cls.name} but "
+                            f"{cls.name}.{m.name} mutates it without "
+                            "holding it — a racing thread can observe the "
+                            "torn update",
+                    line=lineno))
+    return findings
+
+
+def lint_file(path: str, root: str | None = None) -> list[Finding]:
+    with open(path) as f:
+        src = f.read()
+    rel = os.path.relpath(path, root) if root else path
+    try:
+        return lint_source(src, rel)
+    except SyntaxError:
+        return []  # astlint's AL000 already reports unparseable files
+
+
+def lint_package(pkg_dir: str | None = None) -> list[Finding]:
+    """AL009 over the fenced packages (the repo-gate source-pass entry)."""
+    if pkg_dir is None:
+        pkg_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    root = os.path.dirname(pkg_dir)
+    out: list[Finding] = []
+    for sub in THREADED_DIRS:
+        d = os.path.join(pkg_dir, sub.rstrip("/"))
+        if not os.path.isdir(d):
+            continue
+        for dirpath, _dirnames, filenames in os.walk(d):
+            for fname in sorted(filenames):
+                if fname.endswith(".py"):
+                    out.extend(lint_file(os.path.join(dirpath, fname),
+                                         root))
+    return out

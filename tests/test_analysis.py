@@ -904,6 +904,481 @@ class TestHazardRegressions:
 
 
 # ---------------------------------------------------------------------------
+# AL009 — thread-discipline lint (round 23)
+# ---------------------------------------------------------------------------
+
+
+class TestThreadLint:
+    _RACY = """
+        import threading
+
+        class Engine:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._inflight = {}
+
+            def submit(self, rid, req):
+                with self._lock:
+                    self._inflight[rid] = req
+
+            def cancel(self, rid):
+                self._inflight.pop(rid)
+    """
+
+    def _tlint(self, src):
+        from paddle_tpu.analysis import threadlint
+
+        return threadlint.lint_source(textwrap.dedent(src), "fixture.py")
+
+    def test_al009_fires_on_unlocked_mutation(self):
+        fs = self._tlint(self._RACY)
+        assert [f.rule for f in fs] == ["AL009"]
+        assert fs[0].detail == "Engine.cancel:_inflight"
+
+    def test_al009_silent_when_every_mutation_holds_the_lock(self):
+        fs = self._tlint("""
+            import threading
+
+            class Engine:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._inflight = {}
+
+                def submit(self, rid, req):
+                    with self._lock:
+                        self._inflight[rid] = req
+
+                def cancel(self, rid):
+                    with self._lock:
+                        self._inflight.pop(rid)
+        """)
+        assert fs == []
+
+    def test_al009_exempts_init_and_designated_drivers(self):
+        """__init__ precedes sharing; dispatch/reconcile/tick-named methods
+        are the single-threaded loop bodies that own their state."""
+        fs = self._tlint("""
+            class Engine:
+                def __init__(self):
+                    self._q = []
+
+                def submit(self, item):
+                    with self._lock:
+                        self._q.append(item)
+
+                def _dispatch_round(self):
+                    self._q.pop()
+
+                def _watchdog_tick(self):
+                    self._q = []
+
+                def _reconcile(self):
+                    self._q.extend(())
+        """)
+        assert fs == []
+
+    def test_al009_pragma_suppresses_a_site(self):
+        fs = self._tlint("""
+            class Engine:
+                def grow(self):
+                    with self._lock:
+                        self._n += 1
+
+                def reset(self):
+                    self._n = 0  # tpulint: disable=AL009
+        """)
+        assert fs == []
+
+    def test_al009_sees_subscripts_tuples_and_mutator_calls(self):
+        fs = self._tlint("""
+            class Engine:
+                def locked(self):
+                    with self._lock:
+                        self._d = {}
+                        self._a = self._b = 0
+
+                def racy(self):
+                    self._d["k"] = 1
+                    self._a, self._b = 1, 2
+                    self._d.update({})
+        """)
+        assert sorted(f.detail for f in fs) == [
+            "Engine.racy:_a", "Engine.racy:_b",
+            "Engine.racy:_d", "Engine.racy:_d"]
+
+    def test_repo_threaded_packages_are_al009_clean(self):
+        """The satellite fix-not-baseline contract: inference/ +
+        observability/ ship with zero thread-discipline findings."""
+        from paddle_tpu.analysis import threadlint
+
+        assert threadlint.lint_package() == []
+
+
+# ---------------------------------------------------------------------------
+# JX007 — static HBM cost model vs the bench analytic model (round 23)
+# ---------------------------------------------------------------------------
+
+
+class TestCostModel:
+    """Synthetic serving-shaped program: params (emb replicated + a stacked
+    layer scan) and two 5D KV pools, sized so every term is hand-checkable."""
+
+    L, H, T = 2, 8, 4
+
+    def _toy(self):
+        import jax.numpy as jnp
+        from jax import lax
+
+        from paddle_tpu.analysis.jaxpr_checks import trace_callable
+
+        L, h, t = self.L, self.H, self.T
+        emb = jnp.ones((16, h), jnp.float32)
+        stack = jnp.ones((L, h, h), jnp.float32)
+        k_pages = jnp.ones((L, 3, 4, 2, 4), jnp.float32)  # heads*hd == h
+        v_pages = jnp.ones((L, 3, 4, 2, 4), jnp.float32)
+
+        def step(emb, stack, k_pages, v_pages):
+            def body(c, w):
+                return c @ w, ()
+
+            c, _ = lax.scan(body, emb[:t], stack)
+            return c.sum() + k_pages.sum() + v_pages.sum()
+
+        closed = trace_callable(step, emb, stack, k_pages, v_pages)
+        return closed, (k_pages, v_pages)
+
+    def _geom(self, **kw):
+        from paddle_tpu.analysis.cost_model import ServingGeometry
+
+        base = dict(layer_weight_bytes=self.L * self.H * self.H * 4,
+                    replicated_weight_bytes=16 * self.H * 4,
+                    num_layers=self.L, kv_heads=2, head_dim=4,
+                    kv_itemsize=4, kv_quantized=False, act_itemsize=4,
+                    mp=1, batch=2, avg_ctx=8.0, mega=False)
+        base.update(kw)
+        return ServingGeometry(**base)
+
+    def test_static_report_matches_hand_count(self):
+        from paddle_tpu.analysis import cost_model
+
+        closed, pools = self._toy()
+        rep = cost_model.static_hbm_report(closed, 2, pools,
+                                           batch=2, avg_ctx=8.0)
+        assert rep["num_layers"] == self.L and rep["hidden"] == self.H
+        assert rep["mega"] is False
+        # wb = (layer/1 + repl)/2; kv = 2 pools x L*ctx*heads*hd*4;
+        # act = 2 roundtrips x L x 17h x 4
+        assert rep["weight_bytes_per_token"] == (512 + 512) // 2
+        assert rep["kv_bytes_per_token"] == 1024
+        assert rep["act_bytes_per_token"] == 2 * self.L * 17 * self.H * 4
+        assert rep["flow_bytes_upper_bound"] > 0
+
+    def test_jx007_silent_when_models_agree(self):
+        from paddle_tpu.analysis import cost_model
+
+        closed, pools = self._toy()
+        fs = cost_model.check_hbm_model(closed, 2, pools, self._geom(),
+                                        0.02, "t")
+        assert fs == []
+
+    def test_jx007_fires_on_drift_layer_count_and_regime(self):
+        from paddle_tpu.analysis import cost_model
+
+        closed, pools = self._toy()
+        # geometry claims 3 layers: scan-length mismatch AND hbm drift
+        fs = cost_model.check_hbm_model(closed, 2, pools,
+                                        self._geom(num_layers=3), 0.02, "t")
+        details = {f.detail for f in fs}
+        assert {"layer-scan-length", "hbm-drift"} <= details
+        assert all(f.rule == "JX007" for f in fs)
+        # geometry claims the mega activation regime: carry layout says no
+        fs = cost_model.check_hbm_model(closed, 2, pools,
+                                        self._geom(mega=True), 0.02, "t")
+        assert "activation-regime" in {f.detail for f in fs}
+
+    def test_jx007_underivable_without_a_layer_scan(self):
+        import jax.numpy as jnp
+
+        from paddle_tpu.analysis import cost_model
+        from paddle_tpu.analysis.jaxpr_checks import trace_callable
+
+        closed = trace_callable(lambda x: x * 2.0,
+                                jnp.ones((4,), jnp.float32))
+        fs = cost_model.check_hbm_model(closed, 0, (), self._geom(),
+                                        0.02, "t")
+        assert [f.detail for f in fs] == ["no-layer-scan"]
+
+
+# ---------------------------------------------------------------------------
+# JX008 — pallas VMEM footprints + mega residency (round 23)
+# ---------------------------------------------------------------------------
+
+
+class TestVmem:
+    def test_jx008_budget_gate_on_pallas_footprint(self):
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        from paddle_tpu.analysis import vmem
+        from paddle_tpu.analysis.jaxpr_checks import trace_callable
+
+        def kern(x_ref, o_ref):
+            o_ref[...] = x_ref[...] * 2.0
+
+        f = pl.pallas_call(
+            kern, out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32))
+        closed = trace_callable(f, jnp.ones((8, 128), jnp.float32))
+        [fp] = vmem.pallas_footprints(closed)
+        # in + out blocks (full array, 4 KiB each), double-buffered
+        want = vmem.LIVE_BUFFERS * 2 * 8 * 128 * 4
+        assert fp["vmem_bytes"] == want
+        assert vmem.check_vmem(closed, want, False, "t") == []
+        fs = vmem.check_vmem(closed, want - 1, False, "t")
+        assert [f.rule for f in fs] == ["JX008"]
+        assert fs[0].detail.startswith("vmem-budget:")
+
+    def _mega_scan(self, leak):
+        import jax.numpy as jnp
+        from jax import lax
+
+        from paddle_tpu.analysis.jaxpr_checks import trace_callable
+
+        b, chunk, h, L = 2, 2, 16, 2
+        stack1 = jnp.ones((L, h, 4 * h), jnp.float32)
+        stack2 = jnp.ones((L, 4 * h, h), jnp.float32)
+        x = jnp.ones((b, chunk, h), jnp.float32)
+
+        def step(x, stack1, stack2):
+            def body(c, ws):
+                w1, w2 = ws
+                if leak:
+                    hid = c.reshape(b * chunk, h) @ w1    # [t, 4h] in HBM
+                    out = (hid @ w2).reshape(b, chunk, h)
+                else:
+                    bias = w1[0].reshape(1, 4 * h)        # param plumbing
+                    out = c + bias.sum()
+                return out, ()
+
+            y, _ = lax.scan(body, x, (stack1, stack2))
+            return y
+
+        return trace_callable(step, x, stack1, stack2)
+
+    def test_jx008_mega_residency_flags_token_wide_4h_values(self):
+        from paddle_tpu.analysis import vmem
+
+        fs = vmem.check_vmem(self._mega_scan(leak=True), None, True, "t")
+        assert fs and all(f.rule == "JX008" for f in fs)
+        assert fs[0].detail.startswith("mega-hbm-residency:")
+
+    def test_jx008_mega_residency_ignores_param_plumbing(self):
+        """A (1, 4h) bias reshape and the [h, 4h] weight tiles are
+        HBM-resident by design — only token-axis 4h values are leaks."""
+        from paddle_tpu.analysis import vmem
+
+        assert vmem.check_vmem(self._mega_scan(leak=False),
+                               None, True, "t") == []
+
+    def test_jx008_mega_residency_needs_a_layer_scan(self):
+        import jax.numpy as jnp
+
+        from paddle_tpu.analysis import vmem
+        from paddle_tpu.analysis.jaxpr_checks import trace_callable
+
+        closed = trace_callable(lambda x: x * 2.0,
+                                jnp.ones((4,), jnp.float32))
+        fs = vmem.check_vmem(closed, None, True, "t")
+        assert [f.detail for f in fs] == ["no-layer-scan"]
+
+
+# ---------------------------------------------------------------------------
+# JX009 — collective inventory + compiled-HLO wire audit (round 23)
+# ---------------------------------------------------------------------------
+
+
+class TestCollectivesAudit:
+    def test_inventory_counts_with_scan_multiplier(self):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        from paddle_tpu.analysis import collectives_audit as ca
+
+        def f(x):
+            def body(c, _):
+                return lax.psum(c, "i"), ()
+
+            c, _ = lax.scan(body, x, None, length=3)
+            return c
+
+        closed = jax.make_jaxpr(f, axis_env=[("i", 2)])(
+            jnp.ones((4,), jnp.float32))
+        assert ca.collective_inventory(closed) == {"psum:float32": 3}
+        assert ca.check_collectives(closed, {"psum:float32": 3}, "t") == []
+        fs = ca.check_collectives(closed, {}, "t")
+        assert [f.rule for f in fs] == ["JX009"]
+        assert fs[0].detail == "psum:float32"
+
+    def test_contract_misses_and_dtype_changes_both_diverge(self):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        from paddle_tpu.analysis import collectives_audit as ca
+
+        closed = jax.make_jaxpr(
+            lambda x: lax.psum(x, "i"), axis_env=[("i", 2)])(
+            jnp.ones((4,), jnp.float32))
+        # contracted-but-absent entries diverge too (a REMOVED psum is as
+        # suspicious as an added one)
+        fs = ca.check_collectives(
+            closed, {"psum:float32": 1, "all_gather:float32": 1}, "t")
+        assert [f.detail for f in fs] == ["all_gather:float32"]
+
+    def test_hlo_contract_flags_fp_traffic_and_missing_s8(self):
+        from paddle_tpu.analysis import collectives_audit as ca
+
+        bad = [{"kind": "all-reduce", "dtype": "f32", "elems": 1 << 20}]
+        fs = ca.check_hlo_collectives(bad, "t")
+        assert sorted(f.detail for f in fs) == [
+            "hlo-fp-all-reduce:f32", "hlo-no-s8-collective"]
+        ok = [{"kind": "all-reduce", "dtype": "f32", "elems": 1},
+              {"kind": "all-gather", "dtype": "s8", "elems": 1 << 20}]
+        assert ca.check_hlo_collectives(ok, "t") == []
+
+    def test_hlo_collectives_reads_the_compiled_program(self):
+        import jax
+        import jax.numpy as jnp
+        import numpy as onp
+        from jax import lax
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        from paddle_tpu.analysis import collectives_audit as ca
+
+        if len(jax.devices()) < 2:
+            pytest.skip("needs >= 2 (forced host) devices")
+        mesh = Mesh(onp.array(jax.devices()[:2]), ("dp",))
+        f = shard_map(lambda x: lax.psum(x, "dp"), mesh=mesh,
+                      in_specs=P("dp"), out_specs=P())
+        entries = ca.hlo_collectives(f, (jnp.ones((4, 8), jnp.float32),),
+                                     mesh=mesh)
+        assert any(e["kind"] == "all-reduce" and e["dtype"] == "f32"
+                   and e["elems"] == 16 for e in entries), entries
+
+
+# ---------------------------------------------------------------------------
+# contracts table + the tpulint CLI (round 23)
+# ---------------------------------------------------------------------------
+
+
+class TestContractsAndCLI:
+    def test_unkeyed_target_certifies_vacuously(self):
+        from paddle_tpu.analysis.contracts import cost_certify
+
+        assert cost_certify("no-such-target", None) == []
+
+    def test_contract_keys_name_real_targets(self):
+        """A typo'd contract key would certify NOTHING silently — every key
+        must extend a registered flagship target name (the --target
+        baseline-ownership prefix rule depends on this too)."""
+        from paddle_tpu.analysis.contracts import CONTRACTS
+        from paddle_tpu.analysis.targets import TARGETS
+
+        for key in CONTRACTS:
+            assert any(key == name or key.startswith(name + "-")
+                       for name in TARGETS), key
+
+    def test_perturbed_contract_exits_2(self, monkeypatch, capsys):
+        """The satellite drift gate: deliberately break a committed
+        expectation -> the gate exits 2 with the JX009 divergence."""
+        from paddle_tpu.analysis import __main__ as cli
+        from paddle_tpu.analysis import contracts
+
+        monkeypatch.setitem(
+            contracts.CONTRACTS, "serving-tiered-restore-fp",
+            contracts.CostContract(collectives={"psum:float32": 99}))
+        rc = cli.main(["--target", "serving-tiered", "--json"])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 2
+        assert any(f["rule"] == "JX009"
+                   and f["target"] == "serving-tiered-restore-fp"
+                   for f in out["new"])
+
+    def test_target_selector_runs_clean_and_scopes_the_trace(
+            self, capsys):
+        """--target runs ONLY the named flagships' trace analyses (and
+        their cost certification) and the repo ships them clean."""
+        from paddle_tpu.analysis import __main__ as cli
+
+        rc = cli.main(["--target", "serving-tiered", "--json"])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert out["passes"] == ["trace"] and out["new"] == []
+
+    def test_list_targets_prints_the_registry(self, capsys):
+        from paddle_tpu.analysis import __main__ as cli
+        from paddle_tpu.analysis.targets import TARGETS
+
+        assert cli.main(["--list-targets"]) == 0
+        assert capsys.readouterr().out.split() == list(TARGETS)
+
+    def test_unknown_target_is_a_usage_error(self):
+        from paddle_tpu.analysis import __main__ as cli
+
+        with pytest.raises(SystemExit):
+            cli.main(["--target", "no-such-flagship"])
+
+    def test_target_forbids_write_baseline(self):
+        from paddle_tpu.analysis import __main__ as cli
+
+        with pytest.raises(SystemExit):
+            cli.main(["--target", "serving-tiered", "--write-baseline"])
+
+
+# ---------------------------------------------------------------------------
+# baseline fingerprint robustness (round-23 satellite regression)
+# ---------------------------------------------------------------------------
+
+
+class TestFingerprintRobustness:
+    _SRC = textwrap.dedent("""
+        import jax
+
+        def bench():
+            key = jax.random.PRNGKey(0)
+            q = jax.random.normal(key, (8, 8))
+            k = jax.random.normal(key, (8, 8))
+            return q, k
+    """)
+
+    def test_comment_shift_stays_suppressed_site_change_refires(self):
+        """The fingerprint excludes line numbers and prose: adding a
+        comment ABOVE a baselined site must keep it suppressed; changing
+        the site itself (a different enclosing function) must re-fire."""
+        fs = astlint.lint_source(self._SRC, "fixture.py")
+        baselined = [f for f in fs if f.rule == "AL001"]
+        assert baselined, "fixture must fire AL001 to baseline it"
+        base = {f.fingerprint for f in baselined}
+
+        shifted = "# new leading comment\n# another\n" + self._SRC
+        fs2 = astlint.lint_source(shifted, "fixture.py")
+        assert [f for f in fs2 if f.rule == "AL001"]  # still fires...
+        new, accepted, fixed = diff_against_baseline(fs2, base)
+        assert new == [] and fixed == []              # ...all suppressed
+        assert {f.fingerprint for f in accepted} == base
+        assert any(f.line != b.line
+                   for f, b in zip(sorted(accepted, key=str),
+                                   sorted(baselined, key=str)))
+
+        moved = self._SRC.replace("def bench():", "def bench_two():")
+        fs3 = astlint.lint_source(moved, "fixture.py")
+        new, _accepted, fixed = diff_against_baseline(fs3, base)
+        assert new and fixed == sorted(base)          # a DIFFERENT site
+
+
+# ---------------------------------------------------------------------------
 # the gate: the repo itself, against the checked-in baseline
 # ---------------------------------------------------------------------------
 
@@ -912,13 +1387,31 @@ class TestRepoGate:
     def test_rule_catalog_documented(self):
         from paddle_tpu.analysis import RULES
         from paddle_tpu.analysis import (astlint, bench_schema,  # noqa: F401
-                                         jaxpr_checks, registry_audit)
+                                         collectives_audit, cost_model,
+                                         jaxpr_checks, registry_audit,
+                                         threadlint, vmem)
 
         for rid in ("AL001", "AL002", "AL003", "AL004", "AL005", "AL006",
-                    "AL007",
+                    "AL007", "AL009",
                     "JX001", "JX002", "JX003", "JX004", "JX005", "JX006",
+                    "JX007", "JX008", "JX009",
                     "TR001", "RA001", "RA002", "RA003", "BL001"):
             assert rid in RULES, f"rule {rid} missing from the catalog"
+
+    def test_acceptance_targets_are_cost_contracted(self):
+        """The round-23 acceptance names serving-quant and the mixed mega
+        churn explicitly: their steps must carry a REAL hbm-drift contract
+        (the clean-run halves live in the hazard-regression tests — the
+        analyze fns now run cost_certify inline)."""
+        from paddle_tpu.analysis.contracts import CONTRACTS
+
+        for key in ("serving-quant-unified-step", "serving-mega-mixed-step",
+                    "serving-mega-mixed-quant-step"):
+            assert CONTRACTS[key].hbm_tolerance is not None, key
+        # and the mega contracts keep the structural VMEM claims armed
+        assert CONTRACTS["serving-mega-mixed-step"].mega_vmem_resident
+        assert (CONTRACTS["serving-mega-mixed-step"].vmem_budget_bytes
+                or 0) > 0
 
     def test_repo_is_clean_against_baseline(self):
         """The CI gate: every pass over the real tree + flagship callables;

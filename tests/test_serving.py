@@ -520,6 +520,18 @@ def _bench_serve_smoke_once():
         assert rec["decode_retraces"] == 1  # the no-retrace gate
         assert "vs_baseline" in rec and "prefix_hit_rate" in rec
         assert rec["hbm_bytes_per_token"] > 0
+        # round 23: every unified leg carries the jaxpr-derived static
+        # HBM model next to the analytic one and the two agree within
+        # the JX007 contract tolerance; the legacy two-jit leg has no
+        # single traced step, so the keys are absent there (presence is
+        # asserted so a silent derivation failure fails here, not just
+        # in the tpulint gate)
+        if want_leg == "legacy-two-jit":
+            assert "hbm_bytes_per_token_static" not in rec
+            assert "hbm_model_drift_frac" not in rec
+        else:
+            assert rec["hbm_bytes_per_token_static"] > 0
+            assert abs(rec["hbm_model_drift_frac"]) <= 0.02
         # round 11: every leg stamps its mesh geometry
         assert rec["mesh_shape"] == f"mp{rec['mesh_chips']}"
         assert rec["tokens_per_s_per_chip"] == pytest.approx(
@@ -2184,6 +2196,10 @@ def test_bench_serve_mega_leg_gates():
     # strictly below the per-op leg's on the same quantized churn
     assert (rec["hbm_bytes_per_token"]
             < rec["mega_off_hbm_bytes_per_token"])
+    # round 23: the jaxpr-derived static model agrees on the mega leg
+    # (the fused activation regime read off the blocked scan carry)
+    assert rec["hbm_bytes_per_token_static"] > 0
+    assert abs(rec["hbm_model_drift_frac"]) <= 0.02
 
 
 def test_bench_serve_mega_mixed_leg_gates():
@@ -2215,6 +2231,10 @@ def test_bench_serve_mega_mixed_leg_gates():
     assert rec["mega_off_device_ms_per_step"] > 0
     assert (rec["hbm_bytes_per_token"]
             < rec["mega_off_hbm_bytes_per_token"])
+    # round 23: the static model agrees on the mixed mega churn too —
+    # the acceptance criterion names this leg explicitly
+    assert rec["hbm_bytes_per_token_static"] > 0
+    assert abs(rec["hbm_model_drift_frac"]) <= 0.02
     # the draft-chain pair: overhead fractions live and sane on BOTH
     # legs, acceptance stats riding the line for the equal-acceptance
     # comparison (the smoke window is too short to gate the strict
