@@ -159,9 +159,12 @@ def _hbm_bytes_per_token(sp, batch, avg_ctx):
                                                 geometry)
 
     mp = 1 if sp.mesh is None else int(sp.mesh.shape["mp"])
+    cfg = sp.config
     return analytic_hbm_bytes_per_token(geometry(
         sp.params, sp.cache, batch=batch, avg_ctx=avg_ctx,
-        mega=getattr(sp, "mega_decode", False), mp=mp))
+        mega=getattr(sp, "mega_decode", False), mp=mp,
+        moe_experts=getattr(cfg, "moe_experts", 0),
+        moe_top_k=getattr(cfg, "moe_top_k", 0)))
 
 
 class _ChurnLeg:
@@ -181,7 +184,8 @@ class _ChurnLeg:
                  mesh_chips=1, spec_decode_k=0, spec_workload=False,
                  async_engine=False, observability=False,
                  mega_decode=False, slo=None, draft_source=None,
-                 draft_layers=None, spec_report=False):
+                 draft_layers=None, spec_report=False,
+                 moe_experts=0, moe_top_k=2, moe_capacity_factor=1.25):
         # async_engine stays EXPLICIT here (default False = the sync
         # baseline leg) even though round 14 flipped the predictor's own
         # default to async: the legacy/quant/spec/spmd legs are the
@@ -208,9 +212,14 @@ class _ChurnLeg:
         cfg = GPTConfig(vocab_size=vocab, hidden_size=hidden,
                         num_layers=layers, num_heads=heads,
                         max_seq_len=max_len, weight_dtype=weight_dtype,
-                        kv_cache_dtype=kv_cache_dtype)
+                        kv_cache_dtype=kv_cache_dtype,
+                        moe_experts=moe_experts, moe_top_k=moe_top_k,
+                        moe_capacity_factor=moe_capacity_factor)
         model = GPTForCausalLM(cfg)
         model.eval()
+        # kept for the round-25 MoE leg's eager router probe (the
+        # serving predictor only holds the extracted param tree)
+        self.model = model
         mesh = None
         if mesh_chips > 1:
             from paddle_tpu.distributed.mesh import make_serving_mesh
@@ -1073,6 +1082,66 @@ def bench_serving_mega_mixed_ab(*, steps, windows, draft_layers, **leg_kw):
     return off_leg.report(), on_leg.report()
 
 
+class _MoEChurnLeg(_ChurnLeg):
+    """The round-25 MoE churn: the standard continuous-arrival churn over
+    a top-k routed predictor, plus the router-health metrics on the
+    line. ``expert_load_imbalance`` (max/mean kept-pair load over
+    experts, layer-averaged) and ``router_drop_rate`` come from one
+    eager forward probe over a pool prompt after the timed windows —
+    every :class:`GPTMoE` layer refreshes host-readable
+    ``router_stats`` per call, so the probe reads the same routing the
+    serving step runs (same weights, same capacity math).
+    ``active_params_frac`` is the static per-token compute fraction a
+    top-k router activates (< 1 is the whole point of the A/B: total
+    params grew ~E-fold, tokens/s must not shrink E-fold)."""
+
+    def report(self):
+        out = super().report()
+        import paddle_tpu as paddle
+        from paddle_tpu.models.moe import active_params_frac
+
+        out["active_params_frac"] = round(
+            active_params_frac(self.sp.config), 4)
+        self.model(paddle.to_tensor(
+            np.asarray([self.pool[0]], dtype="int64")))
+        loads, drops = [], []
+        for layer in self.model.gpt.layers:
+            st = layer.mlp.router_stats
+            loads.append(np.asarray(st["load"], dtype=np.float64))
+            drops.append(float(st["drop_rate"]))
+        load = np.mean(loads, axis=0)
+        out["expert_load_imbalance"] = round(
+            float(load.max() / max(float(load.mean()), 1e-9)), 3)
+        out["router_drop_rate"] = round(float(np.mean(drops)), 4)
+        return out
+
+
+def bench_serving_moe_ab(*, steps, windows, **leg_kw):
+    """The round-25 dense-vs-MoE pair: the SAME churn shape through the
+    dense unified predictor vs a 4-expert top-2 routed one (capacity
+    factor 1.25 — the production setting, drops allowed and REPORTED),
+    windows interleaved so machine drift hits both legs alike. Both
+    legs run the production async engine. Unlike the mega A/Bs there is
+    no emission-identity gate — the two legs run different math by
+    construction; the contract is the schema one: the MoE line must
+    carry the router-health keys (imbalance, drop rate, active-param
+    fraction), its static-vs-analytic HBM drift must stay inside the
+    JX007 tolerance (the top_k/E expert-stack scaling on BOTH model
+    sides), and the paired dense tokens/s rides the line as the
+    efficiency anchor."""
+    dense_leg = _ChurnLeg(async_engine=True, **leg_kw)
+    moe_leg = _MoEChurnLeg(moe_experts=4, moe_top_k=2,
+                           moe_capacity_factor=1.25,
+                           async_engine=True, **leg_kw)
+    dense_leg.warm()
+    moe_leg.warm()
+    with _gc_frozen():
+        for _ in range(windows):
+            dense_leg.window(steps)
+            moe_leg.window(steps)
+    return dense_leg.report(), moe_leg.report()
+
+
 def main():
     import sys
 
@@ -1215,6 +1284,12 @@ def main():
         # line; a chaos pass arms the host_spill_drop /
         # tier_restore_corrupt seams (detected, degraded, never failed)
         ("fleet-tiered", None),
+        # round-25 MoE A/B: the SAME churn through the dense unified
+        # predictor vs a 4-expert top-2 routed one (capacity 1.25,
+        # drops reported) — router-health keys (load imbalance, drop
+        # rate, active-param fraction) on the line, the paired dense
+        # tokens/s riding it as the efficiency anchor
+        ("moe-churn", None),
         # round-16 A/B: the SAME int8w+int8kv churn with the decode hot
         # loop per-op vs megakernelized (fused per-layer Pallas kernels,
         # activations pinned in VMEM) — measured interleaved, greedy
@@ -1392,6 +1467,21 @@ def main():
                 # ride the tiered line (notier_* keys; vs_baseline is
                 # tiered/no-tier on the interleaved pair)
                 results[name] = dict(metric=metric_for(name), **out)
+            elif name == "moe-churn":
+                dense_out, moe_out = bench_serving_moe_ab(
+                    unified=True, on_tpu=on_tpu, use_kernel=use_kernel,
+                    **ab_shape, **ab_kw)
+                out = dict(metric=ab_metric_for(name), **moe_out)
+                # the paired dense stats ride the MoE line: vs_baseline
+                # = moe/dense tokens/s on the SAME interleaved churn —
+                # read it against active_params_frac (total params grew
+                # ~E-fold; throughput must track ACTIVE params, not
+                # total)
+                out["dense_tokens_per_s"] = dense_out["value"]
+                out["vs_baseline"] = (
+                    round(out["value"] / dense_out["value"], 3)
+                    if dense_out["value"] else 0.0)
+                results[name] = out
             elif name == "unified-obs":
                 off_out, on_out, ratio = bench_serving_obs_ab(
                     unified=True, on_tpu=on_tpu, use_kernel=use_kernel,
@@ -1478,6 +1568,11 @@ def main():
     # pool-overflowing reused churn; the hit-rate/TTFT-p99 pair is the
     # headline comparison)
     _emit("fleet-tiered", None)
+    # round-25 MoE leg (self-baselined on its interleaved dense partner:
+    # vs_baseline = moe/dense tokens/s on the SAME churn; the
+    # router-health keys are the headline — drop rate and imbalance at
+    # capacity 1.25, throughput tracking active not total params)
+    _emit("moe-churn", None)
     # round-16 megakernelized int8w+int8kv decode A/B (self-baselined on
     # its interleaved mega-off partner)
     _emit("unified-mega", None)

@@ -137,7 +137,15 @@ _OPTIONAL_NUMERIC = ("vs_baseline", "p50_ms", "p99_ms", "anchor_tflops",
                      # draft-overhead-shrinks-at-equal-acceptance gate
                      # compares within the interleaved pair
                      "mega_off_draft_overhead_frac",
-                     "mega_off_accepted_tokens_per_step")
+                     "mega_off_accepted_tokens_per_step",
+                     # round 25: the dense-vs-MoE interleaved A/B — the
+                     # router's per-window load dispersion (max expert
+                     # load / mean, 1.0 = perfectly balanced), the
+                     # capacity-drop fraction, the active-parameter
+                     # fraction a routed token touches, and the paired
+                     # dense leg's throughput on the MoE line
+                     "expert_load_imbalance", "router_drop_rate",
+                     "active_params_frac", "dense_tokens_per_s")
 _OPTIONAL_STRING = ("mesh_shape", "comm_quant")
 
 #: the bench_serve leg-name enum (round 16): every serving line carries
@@ -150,7 +158,7 @@ KNOWN_LEGS = frozenset((
     "unified-spmd", "unified-spec-base", "unified-spec-k4",
     "unified-spec-model", "unified-int8w", "unified-int8w-int8kv",
     "unified-mega", "unified-mega-mixed", "unified-overload",
-    "fleet-churn", "fleet-disagg", "fleet-tiered",
+    "fleet-churn", "fleet-disagg", "fleet-tiered", "moe-churn",
 ))
 
 

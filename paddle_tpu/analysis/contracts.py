@@ -42,6 +42,8 @@ class CostContract:
     collectives: dict | None = None         # JX009 exact jaxpr inventory
     hlo_require_s8: bool = False            # JX009 HLO: s8 on the wire
     hlo_fp_allreduce_max_elems: int = 1024  # JX009 HLO: fp allowance
+    moe_experts: int = 0                    # JX007 routed-expert count
+    moe_top_k: int = 0                      # JX007 experts read per token
 
 
 def _serving(mega: bool = False, mp: int = 1, *, vmem: bool = True,
@@ -90,6 +92,20 @@ CONTRACTS: dict[str, CostContract] = {
     "train-dpquant-step": CostContract(
         collectives=None, hlo_require_s8=True,
         hlo_fp_allreduce_max_elems=1024),
+    # round-25 MoE unified step (per-op path; mega rejects MoE): the hbm
+    # model charges a token only its top-k experts' weights — matching
+    # the analysis config (moe_experts=4, moe_top_k=2)
+    "serving-moe-step": CostContract(
+        hbm_tolerance=0.02, collectives={},
+        moe_experts=4, moe_top_k=2),
+    # round-25 expert-parallel train step: certified on COMPILED HLO —
+    # the ep combine rides s8 collective-permutes. The fp all-reduce
+    # allowance is WIDER than dpquant's: the mp axis legitimately psums
+    # fp activations (~seq*h elems at the analysis geometry); only the
+    # expert combine and gradient sync must stay quantized
+    "train-moe-ep-step": CostContract(
+        collectives=None, hlo_require_s8=True,
+        hlo_fp_allreduce_max_elems=1 << 16),
 }
 
 
@@ -116,7 +132,9 @@ def cost_certify(target: str, closed, *, params=None,
 
         geom = cost_model.geometry(
             params, cache, batch=contract.batch, avg_ctx=contract.avg_ctx,
-            mega=contract.mega, mp=contract.mp)
+            mega=contract.mega, mp=contract.mp,
+            moe_experts=contract.moe_experts,
+            moe_top_k=contract.moe_top_k)
         findings += cost_model.check_hbm_model(
             closed, len(jax.tree.leaves(params)), _pools(cache), geom,
             contract.hbm_tolerance, target)

@@ -93,6 +93,11 @@ class ServingGeometry:
     batch: int
     avg_ctx: float
     mega: bool
+    # round-25 MoE: the expert stacks' bytes ride separately — a decode
+    # token streams only its top-k experts' weights, not all E
+    moe_experts: int = 0
+    moe_top_k: int = 0
+    expert_weight_bytes: int = 0
 
 
 def analytic_hbm_bytes_per_token(g: ServingGeometry) -> int:
@@ -101,8 +106,13 @@ def analytic_hbm_bytes_per_token(g: ServingGeometry) -> int:
     byte once per step (amortized over the batch's lanes) + the token's own
     KV context (+ fp32 scale planes for int8 pools) + the inter-kernel
     activation round-trips."""
-    wb = (g.layer_weight_bytes / g.mp
-          + g.replicated_weight_bytes) / max(g.batch, 1)
+    lb = g.layer_weight_bytes
+    if g.moe_experts:
+        # routed experts: each token's FFN reads top_k of the E expert
+        # stacks — the other experts' weights never stream for it
+        lb += (g.expert_weight_bytes * g.moe_top_k
+               / max(g.moe_experts, 1))
+    wb = (lb / g.mp + g.replicated_weight_bytes) / max(g.batch, 1)
     kv = (2 * g.num_layers * g.avg_ctx
           * g.kv_heads * g.head_dim * g.kv_itemsize) / g.mp
     if g.kv_quantized:
@@ -113,26 +123,43 @@ def analytic_hbm_bytes_per_token(g: ServingGeometry) -> int:
     return int(wb + kv + act)
 
 
+#: the serving-pytree layer stacks whose bytes scale with routing (the
+#: per-expert FFN tree; the gate is dense — every token reads it)
+MOE_EXPERT_STACK_KEYS = ("moe_w1", "moe_b1", "moe_w2", "moe_b2")
+
+
 def geometry(params, cache, *, batch: int, avg_ctx: float, mega: bool,
-             mp: int = 1) -> ServingGeometry:
+             mp: int = 1, moe_experts: int = 0,
+             moe_top_k: int = 0) -> ServingGeometry:
     """Build the analytic geometry from a live (params, KVCacheManager)
-    pair — the adapter both ``bench_serve.py`` and the cert targets use."""
+    pair — the adapter both ``bench_serve.py`` and the cert targets use.
+    ``moe_experts``/``moe_top_k`` (round 25) split the expert stacks out
+    of ``layer_weight_bytes`` so the analytic model charges a decode
+    token only its top-k experts' weights."""
     import jax.numpy as jnp
 
     from ..inference.quantize import serving_weight_bytes
 
-    layer_b = serving_weight_bytes({"layers": params["layers"]})
+    layers = params["layers"]
+    expert_b = 0
+    if moe_experts:
+        expert_b = serving_weight_bytes(
+            {"layers": {k: v for k, v in layers.items()
+                        if k in MOE_EXPERT_STACK_KEYS}})
+    layer_b = serving_weight_bytes({"layers": layers}) - expert_b
     total_b = serving_weight_bytes(params)
     return ServingGeometry(
         layer_weight_bytes=layer_b,
-        replicated_weight_bytes=total_b - layer_b,
+        replicated_weight_bytes=total_b - layer_b - expert_b,
         num_layers=cache.num_layers,
         kv_heads=cache.num_kv_heads,
         head_dim=cache.head_dim,
         kv_itemsize=jnp.dtype(cache.k_pages.dtype).itemsize,
         kv_quantized=bool(cache.quantize_kv),
         act_itemsize=jnp.dtype(params["tok_emb"].dtype).itemsize,
-        mp=mp, batch=batch, avg_ctx=avg_ctx, mega=mega)
+        mp=mp, batch=batch, avg_ctx=avg_ctx, mega=mega,
+        moe_experts=moe_experts, moe_top_k=moe_top_k,
+        expert_weight_bytes=expert_b)
 
 
 # ---------------------------------------------------------------------------
@@ -200,7 +227,8 @@ def _iter_eqns_all(jaxpr):
 
 
 def static_hbm_report(closed, n_param_leaves: int, pool_avals, *,
-                      batch: int, avg_ctx: float, mp: int = 1) -> dict:
+                      batch: int, avg_ctx: float, mp: int = 1,
+                      moe_experts: int = 0, moe_top_k: int = 0) -> dict:
     """Derive ``hbm_bytes_per_token`` from the traced step jaxpr.
 
     ``n_param_leaves``: flattened leaf count of the params argument (the
@@ -233,9 +261,23 @@ def static_hbm_report(closed, n_param_leaves: int, pool_avals, *,
     # the leaves with a leading num_layers dim (the scanned xs), the rest
     # (embeddings / LM head / final LN) is replicated under mp
     param_avals = [v.aval for v in jaxpr.invars[:n_param_leaves]]
-    layer_bytes = sum(_aval_bytes(a) for a in param_avals
-                      if a.shape and a.shape[0] == num_layers)
-    repl_bytes = sum(_aval_bytes(a) for a in param_avals) - layer_bytes
+
+    def _layer_leaf_bytes(a):
+        if not (a.shape and a.shape[0] == num_layers):
+            return 0.0
+        b = _aval_bytes(a)
+        # round-25 MoE: an expert stack ([L, E, ...] — the leading-E
+        # leaves, incl. quantized {"q","s"} planes) streams only the
+        # token's top-k experts' slices, not all E
+        if (moe_experts and len(a.shape) >= 3
+                and a.shape[1] == moe_experts):
+            return b * moe_top_k / max(moe_experts, 1)
+        return float(b)
+
+    layer_bytes = sum(_layer_leaf_bytes(a) for a in param_avals)
+    repl_bytes = (sum(_aval_bytes(a) for a in param_avals)
+                  - sum(_aval_bytes(a) for a in param_avals
+                        if a.shape and a.shape[0] == num_layers))
     wb = (layer_bytes / mp + repl_bytes) / max(batch, 1)
 
     # KV term off the pool invar geometry (pools [L, pages, page, heads,
@@ -274,7 +316,9 @@ def check_hbm_model(closed, n_param_leaves: int, pool_avals, geom,
     try:
         static = static_hbm_report(closed, n_param_leaves, pool_avals,
                                    batch=geom.batch, avg_ctx=geom.avg_ctx,
-                                   mp=geom.mp)
+                                   mp=geom.mp,
+                                   moe_experts=geom.moe_experts,
+                                   moe_top_k=geom.moe_top_k)
     except ValueError as e:
         return [Finding(rule=JX007, target=target, detail="no-layer-scan",
                         message=f"static HBM model underivable: {e}")]
@@ -352,4 +396,7 @@ def static_hbm_for_predictor(sp, batch: int, avg_ctx: float):
     mp = 1 if mesh is None else int(mesh.shape["mp"])
     return static_hbm_report(
         closed, len(jax.tree.leaves(sp.params)), pools,
-        batch=batch, avg_ctx=avg_ctx, mp=mp)["hbm_bytes_per_token"]
+        batch=batch, avg_ctx=avg_ctx, mp=mp,
+        moe_experts=int(getattr(cfg, "moe_experts", 0) or 0),
+        moe_top_k=int(getattr(cfg, "moe_top_k", 0) or 0),
+    )["hbm_bytes_per_token"]

@@ -11,15 +11,21 @@ einsums against a capacity-bounded one-hot dispatch mask (the GShard
 formulation); with tokens sharded over dp/sep and experts over ep, XLA lowers
 the dispatch einsum to exactly the all-to-all the reference implements as
 global_scatter — but fused and overlapped over ICI.
+
+Round 25: the routing math lives in ``paddle_tpu.models.moe`` — ONE
+top-k/capacity/aux implementation shared by this fleet layer, the GPT
+``moe_experts`` decoder path, the serving step, and the SPMD trainer. This
+module keeps the reference-shaped ``MoELayer`` surface (per-expert hidden
+size, gate config dicts, process-group ep resolution) and delegates the
+gating and FFN to those primitives; ``top1_gating``/``top2_gating`` remain
+as thin aliases for callers of the old spellings.
 """
 from __future__ import annotations
 
 import numpy as np
 
-import jax
-import jax.numpy as jnp
-
 from ....autograd.engine import apply_op
+from ....models.moe import moe_ffn_einsum, topk_dispatch_combine
 from ....nn import Layer
 from ...auto_parallel.api import shard_tensor
 from ...auto_parallel.placement import Replicate, Shard
@@ -49,63 +55,15 @@ def _ep_mesh_and_axis(group=None):
     return ProcessMesh(np.arange(n), ["ep"]), 0
 
 
-def _positions_in_expert(mask, offset=None):
-    """Per-token slot index within its chosen expert's capacity buffer.
-
-    ``mask`` is a one-hot-per-token [N, E] selection; returns [N] positions
-    (0-based order of arrival at that expert). ``offset`` [E] shifts the
-    numbering (used so top-2 slots come after all top-1 slots)."""
-    ranks = jnp.cumsum(mask, axis=0)
-    if offset is not None:
-        ranks = ranks + offset[None, :]
-    return (ranks * mask).sum(axis=-1) - 1.0
-
-
-def _combine_one(gate, mask, pos, capacity):
-    keep = (pos >= 0) & (pos < capacity)
-    mask = mask * keep[:, None].astype(mask.dtype)
-    slots = jnp.clip(pos, 0, capacity - 1).astype(jnp.int32)
-    oh = jax.nn.one_hot(slots, capacity) * keep[:, None]
-    return (gate * keep)[:, None, None] * mask[:, :, None] * oh[:, None, :]
-
-
 def top2_gating(logits, capacity):
     """GShard top-2 gating (reference GShardGate): returns combine weights
     [N, E, C], dispatch mask [N, E, C], and the load-balancing aux loss."""
-    n_tokens, n_experts = logits.shape
-    probs = jax.nn.softmax(logits, axis=-1)
-
-    mask1 = jax.nn.one_hot(jnp.argmax(probs, axis=-1), n_experts)
-    probs_wo1 = probs * (1.0 - mask1)
-    mask2 = jax.nn.one_hot(jnp.argmax(probs_wo1, axis=-1), n_experts)
-
-    # aux loss: fraction of tokens per expert x mean prob per expert
-    aux_loss = jnp.sum(mask1.mean(axis=0) * probs.mean(axis=0)) * n_experts
-
-    pos1 = _positions_in_expert(mask1)
-    pos2 = _positions_in_expert(mask2, offset=mask1.sum(axis=0))
-
-    g1 = (probs * mask1).sum(axis=-1)
-    g2 = (probs * mask2).sum(axis=-1)
-    denom = jnp.maximum(g1 + g2, 1e-9)
-    combine = _combine_one(g1 / denom, mask1, pos1, capacity) + _combine_one(
-        g2 / denom, mask2, pos2, capacity
-    )
-    dispatch = (combine > 0).astype(logits.dtype)
-    return combine, dispatch, aux_loss
+    return topk_dispatch_combine(logits, int(capacity), top_k=2)
 
 
 def top1_gating(logits, capacity):
     """Switch-transformer gating (reference SwitchGate)."""
-    n_tokens, n_experts = logits.shape
-    probs = jax.nn.softmax(logits, axis=-1)
-    mask = jax.nn.one_hot(jnp.argmax(probs, axis=-1), n_experts)
-    aux_loss = jnp.sum(mask.mean(axis=0) * probs.mean(axis=0)) * n_experts
-    pos = _positions_in_expert(mask)
-    gate = (probs * mask).sum(axis=-1)
-    combine = _combine_one(gate, mask, pos, capacity)
-    dispatch = (combine > 0).astype(logits.dtype)
-    return combine, dispatch, aux_loss
+    return topk_dispatch_combine(logits, int(capacity), top_k=1)
 
 
 class MoELayer(Layer):
@@ -113,7 +71,9 @@ class MoELayer(Layer):
 
     Args follow the reference MoELayer (:263): d_model, experts given as a
     per-expert hidden size, gate config dict with type/top_k. Expert weights
-    are stacked [E, ...] and sharded over the ep axis.
+    are stacked [E, ...] and sharded over the ep axis. The forward is
+    ``models.moe.moe_ffn_einsum`` — numerically identical to the grouped
+    Pallas formulation (``models.moe.moe_ffn``) serving uses.
     """
 
     def __init__(
@@ -138,11 +98,16 @@ class MoELayer(Layer):
         self.capacity_factor = capacity_factor
         mesh, axis = _ep_mesh_and_axis(group)
         self._mesh, self._axis = mesh, axis
+        # Expert stacks shard their leading [E] dim over ep only when the
+        # axis tiles it; otherwise replicate (a 4-expert layer on an
+        # 8-chip ep mesh used to die inside shard_tensor).
+        ep_size = int(mesh.shape[axis])
+        can_shard = ep_size > 1 and num_experts % ep_size == 0
 
         def ep_place(dim0_shard):
             return [
                 Shard(0) if i == axis else Replicate() for i in range(mesh.ndim)
-            ] if dim0_shard else [Replicate()] * mesh.ndim
+            ] if (dim0_shard and can_shard) else [Replicate()] * mesh.ndim
 
         self.gate_weight = self.create_parameter([d_model, num_experts])
         w1 = self.create_parameter([num_experts, d_model, d_hidden])
@@ -156,23 +121,15 @@ class MoELayer(Layer):
         self.aux_loss = None
 
     def forward(self, x):
-        gating = top1_gating if self.gate_type == "switch" else top2_gating
+        top_k = self.top_k
         cap_factor = self.capacity_factor
 
         def pure(xv, gate_w, w1, b1, w2, b2):
             orig_shape = xv.shape
-            d = orig_shape[-1]
-            tokens = xv.reshape(-1, d)
-            n = tokens.shape[0]
-            capacity = max(int(cap_factor * n * 1.0 / w1.shape[0]) * (2 if gating is top2_gating else 1), 4)
-            logits = tokens @ gate_w
-            combine, dispatch, aux = gating(logits, capacity)
-            # dispatch: [N,E,C] x [N,d] -> [E,C,d]  (the "global_scatter")
-            expert_in = jnp.einsum("nec,nd->ecd", dispatch, tokens)
-            h = jax.nn.gelu(jnp.einsum("ecd,edh->ech", expert_in, w1) + b1[:, None, :])
-            expert_out = jnp.einsum("ech,ehd->ecd", h, w2) + b2[:, None, :]
-            # combine: [N,E,C] x [E,C,d] -> [N,d]  (the "global_gather")
-            out = jnp.einsum("nec,ecd->nd", combine, expert_out)
+            tokens = xv.reshape(-1, orig_shape[-1])
+            out, aux = moe_ffn_einsum(
+                tokens, gate_w, w1, b1, w2, b2,
+                top_k=top_k, capacity_factor=cap_factor)
             return out.reshape(orig_shape), aux
 
         out, aux = apply_op(

@@ -156,20 +156,38 @@ def choose_mesh_shape(n_devices: int) -> dict[str, int]:
     return {"dp": dp, "pp": pp, "mp": mp}
 
 
-def make_training_mesh(n_devices: int | None = None) -> Mesh:
+def make_training_mesh(n_devices: int | None = None, ep: int = 1) -> Mesh:
     """The dp x pp x mp training mesh over the first ``n_devices`` chips
     (all visible devices by default) — ``gpt_spmd.make_mesh``'s home.
     Asking for more chips than are visible fails loudly here (a silent
-    ``devs[:n]`` clip used to surface as a cryptic numpy reshape error)."""
+    ``devs[:n]`` clip used to surface as a cryptic numpy reshape error).
+
+    ``ep > 1`` (round 25, MoE) carves an EXPERT-parallel axis off the
+    device count first and factors the remainder into (dp, pp, mp) —
+    the 4-axis ``Mesh(("dp", "pp", "mp", "ep"))`` shards the expert
+    stacks' leading [E] dim over "ep" (``gpt_spmd.param_specs``) while
+    dense params ignore the axis. ``ep == 1`` keeps the 3-axis mesh
+    bit-identical to every prior round."""
     devs = _all_devices()
     n = len(devs) if n_devices is None else n_devices
-    shape = choose_mesh_shape(n)  # validates n is a positive int
+    ep = int(ep)
+    if ep < 1:
+        raise ValueError(f"ep must be >= 1, got {ep}")
+    if ep > 1 and (not isinstance(n, (int, np.integer)) or n % ep):
+        raise ValueError(
+            f"training mesh: ep={ep} must divide n_devices={n}")
+    shape = choose_mesh_shape(n if ep == 1 else n // ep)
     if n > len(devs):
         raise ValueError(
             f"training mesh of {n} chips needs 1..{len(devs)} devices "
             f"(visible: {len(devs)})")
-    arr = np.array(devs[:n]).reshape(shape["dp"], shape["pp"], shape["mp"])
-    return Mesh(arr, ("dp", "pp", "mp"))
+    if ep == 1:
+        arr = np.array(devs[:n]).reshape(
+            shape["dp"], shape["pp"], shape["mp"])
+        return Mesh(arr, ("dp", "pp", "mp"))
+    arr = np.array(devs[:n]).reshape(
+        shape["dp"], shape["pp"], shape["mp"], ep)
+    return Mesh(arr, ("dp", "pp", "mp", "ep"))
 
 
 def make_serving_mesh(mp: int | None = None) -> Mesh:

@@ -95,7 +95,7 @@ _bulk([
     "conv2d_transpose", "conv3d", "conv3d_transpose", "einsum",
     "flash_attn_unpadded", "linear", "matmul", "mm", "mv",
     "scaled_dot_product_attention",
-    "weight_only_linear", "quant_matmul",
+    "weight_only_linear", "quant_matmul", "grouped_matmul",
 ], amp="white")
 
 # -- precision-sensitive: forced fp32 under AMP (reductions/exp/norms) ------

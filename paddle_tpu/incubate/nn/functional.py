@@ -317,6 +317,21 @@ def quant_matmul(x, qweight, scales, bias=None, use_kernel=None):
     return _impl(x, qweight, scales, bias=bias, use_kernel=use_kernel)
 
 
+def grouped_matmul(x, weights, group_offsets, scales=None, use_kernel=None):
+    """Ragged grouped GEMM (round-25 MoE expert dispatch): ``out[i] =
+    x[i] @ dequant(weights)[g(i)]`` — one fused Pallas pass over an
+    ``[E, K, N]`` expert weight stack with rows of ``x`` pre-sorted by
+    expert and ``group_offsets [E+1]`` marking each expert's row range
+    (empty experts allowed). ``weights`` may be fp, int8, or nibble-packed
+    int4 with per-expert ``scales``. ``use_kernel`` as in
+    :func:`paged_attention`. (One implementation — this re-exports the
+    ``nn.quant`` op.)"""
+    from ...nn.quant import grouped_matmul as _impl
+
+    return _impl(x, weights, group_offsets, scales=scales,
+                 use_kernel=use_kernel)
+
+
 def swiglu(x, y=None):
     """SwiGLU activation (reference: incubate fused swiglu): if y is None, x
     splits in half on the last dim."""
@@ -339,7 +354,7 @@ __all__ = [
     "fused_multi_head_attention", "masked_multihead_attention",
     "fused_multi_transformer", "fused_ec_moe", "fused_gate_attention",
     "block_multihead_attention", "paged_attention",
-    "ragged_paged_attention", "quant_matmul",
+    "ragged_paged_attention", "quant_matmul", "grouped_matmul",
 ]
 
 

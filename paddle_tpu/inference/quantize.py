@@ -29,6 +29,9 @@ import jax.numpy as jnp
 
 #: the per-layer stacks that quantize (the decode-bound matmul weights)
 QUANT_LAYER_KEYS = ("wqkv", "wo", "w1", "w2")
+#: the MoE expert stacks ([L, E, K, N] — quantize per expert; the ragged
+#: grouped GEMM consumes {"q": [E, K, N], "s": [E, G, N]} slices)
+MOE_QUANT_LAYER_KEYS = ("moe_w1", "moe_w2")
 
 
 def _algo(weight_dtype: str) -> str:
@@ -67,6 +70,11 @@ def _quantize_stack(stack, weight_dtype, group_size):
     fn = functools.partial(
         _weight_quantize_fn, qmax=_qmax(_algo(weight_dtype)),
         int4=weight_dtype == "int4", group_size=group_size)
+    if stack.ndim == 4:                            # MoE: [L, E, K, N]
+        q, s = jax.vmap(jax.vmap(fn))(stack)
+        if s.ndim == 3:                            # per-channel: [L, E, N]
+            s = s[:, :, None, :]
+        return {"q": q, "s": s.astype(jnp.float32)}
     q, s = jax.vmap(fn)(stack)
     if s.ndim == 2:                                # per-channel: [L, N]
         s = s[:, None, :]
@@ -87,7 +95,8 @@ def quantize_serving_params(params, weight_dtype="int8", group_size=-1,
     fresh device arrays.
     """
     _algo(weight_dtype)  # validate early
-    keys = set(QUANT_LAYER_KEYS)
+    present = set(params["layers"])
+    keys = (set(QUANT_LAYER_KEYS) | set(MOE_QUANT_LAYER_KEYS)) & present
     if config is not None:
         named = set(getattr(config, "_name_cfg", {}))
         keys = named & keys
@@ -95,7 +104,7 @@ def quantize_serving_params(params, weight_dtype="int8", group_size=-1,
             raise ValueError(
                 f"QuantConfig names {sorted(named)} match no serving "
                 f"layer stack — restrict with names from "
-                f"{sorted(QUANT_LAYER_KEYS)}")
+                f"{sorted(QUANT_LAYER_KEYS + MOE_QUANT_LAYER_KEYS)}")
     out = dict(params)
     layers = dict(params["layers"])
     for key in sorted(keys):
@@ -146,7 +155,7 @@ def assert_quant_shardable(layers, mp: int, weight_dtype=None) -> None:
 def is_quantized_params(params) -> bool:
     """Whether a serving pytree carries quantized weight stacks."""
     return any(isinstance(params["layers"].get(k), dict)
-               for k in QUANT_LAYER_KEYS)
+               for k in QUANT_LAYER_KEYS + MOE_QUANT_LAYER_KEYS)
 
 
 def serving_weight_bytes(params) -> int:

@@ -106,6 +106,17 @@ class GPTConfig:
     # Serves mesh size 1/None, fp or int8 weights (int4 rejected loudly),
     # fp or int8 KV.
     mega_decode: bool = False
+    # round-25 Mixture-of-Experts: moe_experts > 0 replaces every block's
+    # dense MLP with a top-k routed expert FFN (models/moe.py — capacity
+    # clamping drops overflow token-choices onto the residual, ragged
+    # grouped Pallas GEMM streams only the routed experts' tiles).
+    # Serving runs through the per-op unified step (mega stays dense-only
+    # and rejects MoE loudly); training shards the expert stacks over the
+    # optional "ep" mesh axis (gpt_spmd + distributed/mesh.py).
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01  # load-balance loss weight (training)
 
     @property
     def ffn_size(self) -> int:
@@ -117,7 +128,13 @@ class GPTConfig:
 
     def num_params(self) -> int:
         h, v, l = self.hidden_size, self.vocab_size, self.num_layers
-        per_layer = 4 * h * h + 4 * h + 2 * h * self.ffn_size + h + self.ffn_size + 4 * h
+        f, e = self.ffn_size, self.moe_experts
+        if e:
+            # router gate + E stacked expert FFNs replace the dense MLP
+            mlp = h * e + e * (2 * h * f + h + f)
+        else:
+            mlp = 2 * h * f + h + f
+        per_layer = 4 * h * h + 4 * h + mlp + 4 * h
         emb = v * h + self.max_seq_len * h
         return emb + l * per_layer + 2 * h
 
@@ -261,7 +278,12 @@ class GPTDecoderLayer(Layer):
         self.ln_1 = LayerNorm(config.hidden_size, epsilon=config.layer_norm_eps)
         self.attn = GPTAttention(config)
         self.ln_2 = LayerNorm(config.hidden_size, epsilon=config.layer_norm_eps)
-        self.mlp = GPTMLP(config)
+        if getattr(config, "moe_experts", 0):
+            from .moe import GPTMoE
+
+            self.mlp = GPTMoE(config)
+        else:
+            self.mlp = GPTMLP(config)
 
     def forward(self, x, attn_mask=None, cache=None):
         if _fused_mlp_on(self.config):
@@ -420,6 +442,22 @@ _SRV_LAYER_WEIGHTS = (
     ("w2", lambda l: l.mlp.fc2.weight), ("b2", lambda l: l.mlp.fc2.bias),
 )
 
+# MoE blocks swap the dense-MLP rows for the stacked expert tree (the
+# [E, ...] stacks gain the usual leading [L] dim at extraction)
+_SRV_MOE_WEIGHTS = (
+    ("moe_gate", lambda l: l.mlp.gate_weight),
+    ("moe_w1", lambda l: l.mlp.w1), ("moe_b1", lambda l: l.mlp.b1),
+    ("moe_w2", lambda l: l.mlp.w2), ("moe_b2", lambda l: l.mlp.b2),
+)
+_DENSE_MLP_KEYS = ("w1", "b1", "w2", "b2")
+
+
+def _srv_layer_weight_table(config):
+    if getattr(config, "moe_experts", 0):
+        return tuple(kv for kv in _SRV_LAYER_WEIGHTS
+                     if kv[0] not in _DENSE_MLP_KEYS) + _SRV_MOE_WEIGHTS
+    return _SRV_LAYER_WEIGHTS
+
 
 def _srv_nonlayer_weights(model):
     gpt = model.gpt if hasattr(model, "gpt") else model
@@ -437,8 +475,9 @@ def _serving_weight_buffers(model):
     ``._data``, so stale ids mean re-extract)."""
     gpt = model.gpt if hasattr(model, "gpt") else model
     bufs = [t._data for _, t in _srv_nonlayer_weights(model)]
+    table = _srv_layer_weight_table(gpt.config)
     for l in gpt.layers:
-        bufs += [get(l)._data for _, get in _SRV_LAYER_WEIGHTS]
+        bufs += [get(l)._data for _, get in table]
     return bufs
 
 
@@ -466,7 +505,7 @@ def serving_params(model):
     params = {k: t._data for k, t in _srv_nonlayer_weights(model)}
     params["layers"] = {
         k: jnp.stack([get(l)._data for l in gpt.layers])
-        for k, get in _SRV_LAYER_WEIGHTS
+        for k, get in _srv_layer_weight_table(cfg)
     }
     return params  # lm_head (when untied) rides _srv_nonlayer_weights
 
@@ -527,6 +566,35 @@ def _srv_mlp(p, y, use_kernel=None, axis=None):
         _srv_mm(jax.nn.gelu(_srv_mm(y, p["w1"], use_kernel) + p["b1"],
                             approximate=True), p["w2"], use_kernel), axis)
             + p["b2"])
+
+
+def _srv_moe(config, p, y, use_kernel=None, valid=None):
+    """The serving MoE FFN: the SAME :func:`models.moe.moe_ffn` the eager
+    oracle runs, over the packed token rows. ``valid`` (tok_slot >= 0 in
+    the unified step) keeps padding rows out of the capacity race — they
+    route nowhere and output zero. Expert stacks are replicated under the
+    mp mesh (``serving_param_specs`` P() fallback), so there is no psum:
+    each chip computes the full MoE output redundantly — acceptable for
+    the per-op path this round (experts are small relative to KV)."""
+    lead = y.shape[:-1]
+    tokens = y.reshape(-1, y.shape[-1])
+    v = None if valid is None else valid.reshape(-1)
+    from .moe import moe_ffn
+
+    out, _aux = moe_ffn(
+        tokens, p["moe_gate"], p["moe_w1"], p["moe_b1"], p["moe_w2"],
+        p["moe_b2"], top_k=config.moe_top_k,
+        capacity_factor=config.moe_capacity_factor,
+        use_kernel=use_kernel, valid=v)
+    return out.reshape(*lead, out.shape[-1])
+
+
+def _srv_ffn(config, p, y, use_kernel=None, axis=None, valid=None):
+    """Block FFN dispatch: dense ``_srv_mlp`` vs routed ``_srv_moe`` —
+    the ONE switch every serving builder goes through."""
+    if getattr(config, "moe_experts", 0):
+        return _srv_moe(config, p, y, use_kernel, valid=valid)
+    return _srv_mlp(p, y, use_kernel, axis)
 
 
 def _split_qkv(qkv, nh, hd, head_major):
@@ -682,6 +750,11 @@ def build_prefill(config: GPTConfig, page_size: int,
     from ..inference.kv_cache import paged_write_prefill
 
     cfg = config
+    if getattr(cfg, "moe_experts", 0):
+        raise ValueError(
+            "build_prefill predates the packed unified step and has no "
+            "MoE FFN path — serve moe_experts > 0 through "
+            "build_unified_step / ServingPredictor")
     eps = cfg.layer_norm_eps
     trace_count = [0]
     mp, axis = _mesh_mp(mesh)
@@ -783,6 +856,11 @@ def build_decode_step(config: GPTConfig, page_size: int,
     from ..ops.pallas.paged_attention import paged_attention
 
     cfg = config
+    if getattr(cfg, "moe_experts", 0):
+        raise ValueError(
+            "build_decode_step predates the packed unified step and has "
+            "no MoE FFN path — serve moe_experts > 0 through "
+            "build_unified_step / ServingPredictor")
     eps = cfg.layer_norm_eps
     trace_count = [0]
     mp, axis = _mesh_mp(mesh)
@@ -1038,7 +1116,8 @@ def build_unified_step(config: GPTConfig, page_size: int, chunk: int,
 
         validate_mega_config(getattr(cfg, "weight_dtype", None),
                              getattr(cfg, "weight_quant_group_size", -1),
-                             hd, mp)
+                             hd, mp,
+                             moe_experts=getattr(cfg, "moe_experts", 0))
         # mp == 1: residual + LN2 / + b2 fuse INSIDE the kernels. mp > 1:
         # the kernels emit pre-psum partials and the block completes the
         # epilogue after the row-parallel psum — per-op spelling, same
@@ -1148,8 +1227,9 @@ def build_unified_step(config: GPTConfig, page_size: int, chunk: int,
             a = ab[slot_c, off_c]                    # back to packed [t]
             x = x + _srv_psum(_srv_mm(a.reshape(t, nh_l * hd), p["wo"],
                                       use_kernel), axis) + p["bo"]
-            x = x + _srv_mlp(p, _srv_ln(x, p["ln2_g"], p["ln2_b"], eps),
-                             use_kernel, axis)
+            x = x + _srv_ffn(cfg, p, _srv_ln(x, p["ln2_g"], p["ln2_b"],
+                                             eps),
+                             use_kernel, axis, valid=valid)
             return x, ((kp, vp, ks, vs) if kv_quant else (kp, vp))
 
         def mega_block(xb, layer):
@@ -1553,7 +1633,8 @@ def build_draft_chain(config: GPTConfig, draft_layers: int, page_size: int,
 
         validate_mega_config(getattr(cfg, "weight_dtype", None),
                              getattr(cfg, "weight_quant_group_size", -1),
-                             hd, mp)
+                             hd, mp,
+                             moe_experts=getattr(cfg, "moe_experts", 0))
         fuse_mega = mp == 1
     n_pool = 4 if kv_quant else 2
 
@@ -1618,8 +1699,9 @@ def build_draft_chain(config: GPTConfig, draft_layers: int, page_size: int,
                 x = x + _srv_psum(_srv_mm(a.reshape(b, nh_l * hd),
                                           p["wo"], use_kernel),
                                   axis) + p["bo"]
-                x = x + _srv_mlp(p, _srv_ln(x, p["ln2_g"], p["ln2_b"],
-                                            eps), use_kernel, axis)
+                x = x + _srv_ffn(cfg, p, _srv_ln(x, p["ln2_g"],
+                                                 p["ln2_b"], eps),
+                                 use_kernel, axis, valid=valid)
                 return x, ((kp, vp, ks, vs) if kv_quant else (kp, vp))
 
             def mega_block(xb, layer):

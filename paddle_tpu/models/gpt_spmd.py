@@ -60,57 +60,100 @@ def init_params(config: GPTConfig, mesh: Mesh, seed: int = 0, dtype=jnp.float32)
     def norm(k, shape):
         return (jax.random.normal(k, shape, jnp.float32) * std).astype(dtype)
 
-    params = {
-        "tok_emb": norm(next(ks), (v, h)),
-        "pos_emb": norm(next(ks), (s, h)),
-        "stages": {
-            "ln1_g": jnp.ones((pp, lps, h), dtype),
-            "ln1_b": jnp.zeros((pp, lps, h), dtype),
-            "wqkv": norm(next(ks), (pp, lps, h, 3 * h)),
-            "bqkv": jnp.zeros((pp, lps, 3 * h), dtype),
-            "wo": norm(next(ks), (pp, lps, h, h)),
-            "bo": jnp.zeros((pp, lps, h), dtype),
-            "ln2_g": jnp.ones((pp, lps, h), dtype),
-            "ln2_b": jnp.zeros((pp, lps, h), dtype),
+    stages = {
+        "ln1_g": jnp.ones((pp, lps, h), dtype),
+        "ln1_b": jnp.zeros((pp, lps, h), dtype),
+        "wqkv": norm(next(ks), (pp, lps, h, 3 * h)),
+        "bqkv": jnp.zeros((pp, lps, 3 * h), dtype),
+        "wo": norm(next(ks), (pp, lps, h, h)),
+        "bo": jnp.zeros((pp, lps, h), dtype),
+        "ln2_g": jnp.ones((pp, lps, h), dtype),
+        "ln2_b": jnp.zeros((pp, lps, h), dtype),
+    }
+    e = int(getattr(config, "moe_experts", 0) or 0)
+    if e:
+        # MoE block: router gate + stacked expert FFNs replace the dense
+        # MLP (the leading [E] expert dim shards over "ep" when present)
+        stages.update({
+            "moe_gate": norm(next(ks), (pp, lps, h, e)),
+            "moe_w1": norm(next(ks), (pp, lps, e, h, f)),
+            "moe_b1": jnp.zeros((pp, lps, e, f), dtype),
+            "moe_w2": norm(next(ks), (pp, lps, e, f, h)),
+            "moe_b2": jnp.zeros((pp, lps, e, h), dtype),
+        })
+    else:
+        stages.update({
             "w1": norm(next(ks), (pp, lps, h, f)),
             "b1": jnp.zeros((pp, lps, f), dtype),
             "w2": norm(next(ks), (pp, lps, f, h)),
             "b2": jnp.zeros((pp, lps, h), dtype),
-        },
+        })
+    params = {
+        "tok_emb": norm(next(ks), (v, h)),
+        "pos_emb": norm(next(ks), (s, h)),
+        "stages": stages,
         "lnf_g": jnp.ones((h,), dtype),
         "lnf_b": jnp.zeros((h,), dtype),
     }
     return params
 
 
-def param_specs() -> dict:
-    """PartitionSpecs: pp stacks stages, mp is the Megatron dim."""
-    return {
-        "tok_emb": P("mp", None),  # vocab-parallel embedding
-        "pos_emb": P(),
-        "stages": {
-            "ln1_g": P("pp", None, None),
-            "ln1_b": P("pp", None, None),
-            "wqkv": P("pp", None, None, "mp"),   # column parallel
-            "bqkv": P("pp", None, "mp"),
-            "wo": P("pp", None, "mp", None),     # row parallel
-            "bo": P("pp", None, None),
-            "ln2_g": P("pp", None, None),
-            "ln2_b": P("pp", None, None),
+def param_specs(moe: bool = False, ep_axis: str | None = None) -> dict:
+    """PartitionSpecs: pp stacks stages, mp is the Megatron dim.
+
+    ``moe=True`` swaps the dense-MLP rows for the expert stacks;
+    ``ep_axis`` ("ep" on the round-25 4-axis mesh, None on a 3-axis one)
+    shards the expert dim — the mp axis stays on attention only (expert
+    GEMMs are already parallel over experts)."""
+    stages = {
+        "ln1_g": P("pp", None, None),
+        "ln1_b": P("pp", None, None),
+        "wqkv": P("pp", None, None, "mp"),   # column parallel
+        "bqkv": P("pp", None, "mp"),
+        "wo": P("pp", None, "mp", None),     # row parallel
+        "bo": P("pp", None, None),
+        "ln2_g": P("pp", None, None),
+        "ln2_b": P("pp", None, None),
+    }
+    if moe:
+        stages.update({
+            "moe_gate": P("pp", None, None, None),
+            "moe_w1": P("pp", None, ep_axis, None, None),
+            "moe_b1": P("pp", None, ep_axis, None),
+            "moe_w2": P("pp", None, ep_axis, None, None),
+            "moe_b2": P("pp", None, ep_axis, None),
+        })
+    else:
+        stages.update({
             "w1": P("pp", None, None, "mp"),
             "b1": P("pp", None, "mp"),
             "w2": P("pp", None, "mp", None),
             "b2": P("pp", None, None),
-        },
+        })
+    return {
+        "tok_emb": P("mp", None),  # vocab-parallel embedding
+        "pos_emb": P(),
+        "stages": stages,
         "lnf_g": P(),
         "lnf_b": P(),
     }
 
 
-def param_shardings(mesh: Mesh):
+def _specs_for(params, mesh: Mesh) -> dict:
+    """The spec tree matching a params pytree on this mesh (MoE and the
+    ep axis inferred — keeps every pre-MoE caller signature intact)."""
+    moe = "moe_w1" in params["stages"]
+    ep_axis = "ep" if (moe and "ep" in mesh.axis_names
+                       and mesh.shape["ep"] > 1) else None
+    return param_specs(moe=moe, ep_axis=ep_axis)
+
+
+def param_shardings(mesh: Mesh, params=None):
+    specs = (param_specs() if params is None
+             else _specs_for(params, mesh))
     return jax.tree.map(
         lambda spec: NamedSharding(mesh, spec),
-        param_specs(),
+        specs,
         is_leaf=lambda x: isinstance(x, P),
     )
 
@@ -143,7 +186,7 @@ def zero_shardings(params, mesh: Mesh, stage: int):
     stage>=3: parameters themselves sharded over dp, gathered on use
     (ZeRO-3; reference GroupShardedStage3 pre-forward allgather)."""
     dp = mesh.shape["dp"]
-    base = param_specs()
+    base = _specs_for(params, mesh)
 
     def opt_spec(spec, leaf):
         return NamedSharding(mesh, _add_dp_dim(spec, leaf.shape, dp))
@@ -181,6 +224,8 @@ def _fused_mlp_on(config: GPTConfig, mesh: Mesh) -> bool:
     (CPU tests); a compiled CPU run would pay interpreter dispatch."""
     if not getattr(config, "fused_mlp", False):
         return False
+    if getattr(config, "moe_experts", 0):
+        return False  # the fused MLP kernels are dense-only
     if math.prod(mesh.shape.values()) != 1:
         return False
     if jax.default_backend() != "tpu":
@@ -200,6 +245,8 @@ def _mk_cs(mesh: Mesh):
 
 def _block(p, x, config: GPTConfig, mesh: Mesh, dp_axis="dp"):
     """One decoder block on [mb, s, h] with TP/SP sharding constraints.
+    Returns ``(x, aux)`` — the MoE load-balance loss for this layer (0.0
+    on dense configs), accumulated up the scan/pipeline.
 
     ``dp_axis=None`` drops the batch-dim constraints: the comm-quant dp
     train step vmaps this math over an explicit replica dim (the leading
@@ -213,7 +260,7 @@ def _block(p, x, config: GPTConfig, mesh: Mesh, dp_axis="dp"):
     # SP region: sequence sharded over mp
     x = cs(x, P(dp_axis, "mp", None))
     if "attn" in config.ablate:  # perf attribution: skip the whole branch
-        return _block_mlp(p, x, config, cs, dp_axis)
+        return _block_mlp(p, x, config, cs, dp_axis, mesh)
     if fused:
         from ..ops.pallas import fused_mlp as _fm
 
@@ -278,9 +325,9 @@ def _block(p, x, config: GPTConfig, mesh: Mesh, dp_axis="dp"):
         o = o.transpose(0, 2, 1, 3).reshape(mb, s, h)
     o = o @ p["wo"] + p["bo"]                  # row-parallel
     if fused:
-        return _block_mlp_fused(p, x, o, config)
+        return _block_mlp_fused(p, x, o, config), jnp.float32(0.0)
     x = x + cs(o, P(dp_axis, "mp", None))      # reduce-scatter onto SP layout
-    return _block_mlp(p, x, config, cs, dp_axis)
+    return _block_mlp(p, x, config, cs, dp_axis, mesh)
 
 
 def _block_mlp_fused(p, x, branch, config: GPTConfig):
@@ -298,19 +345,76 @@ def _block_mlp_fused(p, x, branch, config: GPTConfig):
     return s + (y @ p["w2"] + p["b2"])
 
 
-def _block_mlp(p, x, config: GPTConfig, cs, dp_axis="dp"):
+def _block_mlp(p, x, config: GPTConfig, cs, dp_axis="dp", mesh=None):
     if "mlp" in config.ablate:  # perf attribution: skip the whole branch
-        return x
+        return x, jnp.float32(0.0)
     y = _layer_norm(x, p["ln2_g"], p["ln2_b"], config.layer_norm_eps)
     if getattr(config, "remat_save_ln", False):
         from jax.ad_checkpoint import checkpoint_name
 
         y = checkpoint_name(y, "ln_out")
+    if getattr(config, "moe_experts", 0):
+        return _moe_mlp(p, x, y, config, cs, dp_axis, mesh)
     y = jax.nn.gelu(y @ p["w1"] + p["b1"], approximate=True)
     y = cs(y, P(dp_axis, None, "mp"))
     y = y @ p["w2"] + p["b2"]
     x = x + cs(y, P(dp_axis, "mp", None))
-    return x
+    return x, jnp.float32(0.0)
+
+
+def _moe_mlp(p, x, y, config: GPTConfig, cs, dp_axis, mesh):
+    """The expert-sharded MoE FFN half of a block: GShard dense-mask
+    gating (``models.moe.topk_dispatch_combine`` — the einsum twin of the
+    serving grouped-GEMM path, same routing/capacity/tie-break math) with
+    the expert dim sharded over "ep".
+
+    Dispatch is collective-FREE: ``y`` is replicated over ep, so each ep
+    shard builds its local experts' [E/ep, C, d] buffers with a slice of
+    the dispatch mask. The COMBINE is the wire: each shard's partial
+    outputs stack [ep, n, d] and reduce over the ep ring through the
+    PR-9 int8 wire-quant surface (``quantized_all_reduce_stacked``) —
+    ~4x fewer bytes than an fp all-reduce, s8 collectives on the HLO
+    (the JX009 contract). ep == 1 keeps plain einsums, no collectives."""
+    from ..distributed.compressed_collectives import (
+        quantized_all_reduce_stacked)
+    from .moe import moe_capacity, topk_dispatch_combine
+
+    mb, s, h = y.shape
+    e = int(config.moe_experts)
+    n = mb * s
+    tok = y.reshape(n, h)
+    logits = tok.astype(jnp.float32) @ p["moe_gate"].astype(jnp.float32)
+    cap = moe_capacity(n, e, config.moe_top_k, config.moe_capacity_factor)
+    combine, dispatch, aux = topk_dispatch_combine(
+        logits, cap, config.moe_top_k)
+    ep = 1
+    if mesh is not None and "ep" in mesh.axis_names:
+        ep = mesh.shape["ep"]
+    expert_in = jnp.einsum("nec,nd->ecd", dispatch.astype(tok.dtype), tok)
+    if ep > 1:
+        expert_in = cs(expert_in, P("ep", None, None))
+    hmid = jax.nn.gelu(
+        jnp.einsum("ecd,edf->ecf", expert_in, p["moe_w1"])
+        + p["moe_b1"][:, None, :], approximate=True)
+    expert_out = (jnp.einsum("ecf,efd->ecd", hmid, p["moe_w2"])
+                  + p["moe_b2"][:, None, :])
+    if ep > 1:
+        expert_out = cs(expert_out, P("ep", None, None))
+        eg = e // ep
+        out_g = expert_out.reshape(ep, eg, cap, h)
+        comb_g = combine.reshape(n, ep, eg, cap).transpose(1, 0, 2, 3)
+        partial = jnp.einsum("gnec,gecd->gnd", comb_g.astype(tok.dtype),
+                             out_g)
+        partial = cs(partial, P("ep", None, None))
+        # [ep, n, d] in, every slot the ring sum out — take slot 0
+        out = quantized_all_reduce_stacked(partial, mesh=mesh, axis="ep",
+                                           mean=False)[0]
+    else:
+        out = jnp.einsum("nec,ecd->nd", combine.astype(tok.dtype),
+                         expert_out)
+    out = out.reshape(mb, s, h).astype(x.dtype)
+    x = x + cs(out, P(dp_axis, "mp", None))
+    return x, aux
 
 
 def _stage_fn(p_stage, x, config: GPTConfig, mesh: Mesh, dp_axis="dp"):
@@ -324,7 +428,9 @@ def _stage_fn(p_stage, x, config: GPTConfig, mesh: Mesh, dp_axis="dp"):
     """
 
     def body(carry, p_layer):
-        return _block(p_layer, carry, config, mesh, dp_axis), None
+        x, aux = carry
+        x2, a = _block(p_layer, x, config, mesh, dp_axis)
+        return (x2, aux + a), None
 
     if getattr(config, "recompute", False):
         # weight-GEMM outputs AND (by default) the flash kernel's o/lse are
@@ -341,15 +447,18 @@ def _stage_fn(p_stage, x, config: GPTConfig, mesh: Mesh, dp_axis="dp"):
                 policy,
                 jax.checkpoint_policies.save_only_these_names(*names))
         body = jax.checkpoint(body, policy=policy)
-    x, _ = lax.scan(body, x, p_stage)
-    return x
+    (x, aux), _ = lax.scan(body, (x, jnp.float32(0.0)), p_stage)
+    return x, aux
 
 
 def _pipeline(stages, mbs, mesh: Mesh, config: GPTConfig, dp_axis="dp"):
     """Microbatch pipeline over the pp axis (GSPMD-pipelined stacked stages).
 
     stages: pytree with leading [pp, lps, ...] dims. mbs: [M, mb, s, h].
-    Returns [M, mb, s, h] (last-stage outputs, replicated over pp).
+    Returns ``([M, mb, s, h], aux)`` — last-stage outputs (replicated
+    over pp) and the MoE aux loss summed over every (microbatch, layer)
+    the SCHEDULE actually ran (the warm-up/drain garbage slots mask out;
+    0.0 on dense configs).
 
     Roll formulation (praxis-style GSPMD pipelining): every stage computes
     in parallel under ``vmap`` over the pp-sharded stacked dim, and the ring
@@ -368,7 +477,8 @@ def _pipeline(stages, mbs, mesh: Mesh, config: GPTConfig, dp_axis="dp"):
         def one(mb):
             return _stage_fn(p_one, mb, config, mesh, dp_axis)
 
-        return jax.lax.map(one, mbs)
+        ys, auxs = jax.lax.map(one, mbs)
+        return ys, jnp.sum(auxs)
 
     total = num_micro + num_stages - 1
     last = num_stages - 1
@@ -382,13 +492,20 @@ def _pipeline(stages, mbs, mesh: Mesh, config: GPTConfig, dp_axis="dp"):
         # shift stage s's output to stage s+1's next input via the roll
         acts = carry.at[0].set(mbs[jnp.clip(t, 0, num_micro - 1)])
         acts = cs(acts, P("pp", dp_axis, None, None))
-        y = stage_v(stages, acts)
-        return jnp.roll(y, 1, axis=0), y[last]
+        y, aux = stage_v(stages, acts)
+        return jnp.roll(y, 1, axis=0), (y[last], aux)
 
     init = jnp.zeros((num_stages,) + mbs.shape[1:], mbs.dtype)
-    _, outs = lax.scan(step, init, jnp.arange(total, dtype=jnp.int32))
+    _, (outs, auxs) = lax.scan(step, init,
+                               jnp.arange(total, dtype=jnp.int32))
+    # stage s at time t runs microbatch t - s; everything else in the
+    # warm-up/drain window is recycled garbage — mask its aux out
+    t_idx = jnp.arange(total)[:, None]
+    s_idx = jnp.arange(num_stages)[None, :]
+    sched = ((t_idx - s_idx >= 0)
+             & (t_idx - s_idx < num_micro)).astype(jnp.float32)
     # microbatch m reaches the last stage at t = m + (S-1)
-    return outs[last : last + num_micro]
+    return outs[last : last + num_micro], jnp.sum(auxs * sched)
 
 
 def loss_fn(params, ids, labels, config: GPTConfig, mesh: Mesh, num_micro: int,
@@ -412,7 +529,7 @@ def _loss_fn_inner(params, ids, labels, config: GPTConfig, mesh: Mesh,
     x = cs(x, P(dp_axis, None, None))
     mb = b // num_micro
     mbs = x.reshape(num_micro, mb, s, x.shape[-1])
-    y = _pipeline(params["stages"], mbs, mesh, config, dp_axis)
+    y, moe_aux = _pipeline(params["stages"], mbs, mesh, config, dp_axis)
     y = y.reshape(b, s, -1)
     y = _layer_norm(y, params["lnf_g"], params["lnf_b"], config.layer_norm_eps)
 
@@ -446,7 +563,12 @@ def _loss_fn_inner(params, ids, labels, config: GPTConfig, mesh: Mesh,
     nll = lax.map(jax.checkpoint(chunk_nll), (yc, lbc))  # [nchunks, b, chunk]
     nll = nll.transpose(1, 0, 2).reshape(b, s)
     valid = (jnp.arange(s) < s - 1).astype(jnp.float32)
-    return jnp.sum(nll * valid) / (b * (s - 1))
+    loss = jnp.sum(nll * valid) / (b * (s - 1))
+    if getattr(config, "moe_experts", 0):
+        # mean aux per (layer, microbatch), weighted into the objective
+        loss = loss + (getattr(config, "moe_aux_weight", 0.01)
+                       * moe_aux / (num_micro * config.num_layers))
+    return loss
 
 
 # ---------------------------------------------------------------------------
@@ -501,11 +623,21 @@ def build_spmd_train_step(
                 f"comm_quant needs batch_size {batch_size} divisible by "
                 f"dp * num_micro = {dp} * {num_micro}")
 
+    if getattr(config, "moe_experts", 0):
+        ep = mesh.shape.get("ep", 1) if "ep" in mesh.axis_names else 1
+        if ep > 1 and config.moe_experts % ep:
+            raise ValueError(
+                f"moe_experts={config.moe_experts} must divide the ep "
+                f"mesh axis ({ep}) — each ep shard owns whole experts")
+        if getattr(config, "fused_mlp", False):
+            raise ValueError(
+                "fused_mlp has no MoE path — the fused MLP kernels are "
+                "dense-only (disable fused_mlp for moe_experts > 0)")
     params = init_params(config, mesh)
     if zero_stage:
         p_shard, m_shard = zero_shardings(params, mesh, zero_stage)
     else:
-        p_shard = m_shard = param_shardings(mesh)
+        p_shard = m_shard = param_shardings(mesh, params)
     params = jax.device_put(params, p_shard)
     mom = jax.device_put(sgd_init(params), m_shard)
     data_shard = NamedSharding(mesh, P("dp", None))

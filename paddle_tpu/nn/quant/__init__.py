@@ -26,7 +26,7 @@ import jax.numpy as jnp
 from ...autograd.engine import apply_op
 
 __all__ = ["weight_quantize", "weight_dequantize", "weight_only_linear",
-           "quant_matmul"]
+           "quant_matmul", "grouped_matmul"]
 
 
 def _qmax(algo: str) -> float:
@@ -134,3 +134,20 @@ def quant_matmul(x, qweight, scales, bias=None, use_kernel=None):
         return _qmm(v, q, s, bias=b, use_kernel=use_kernel)
 
     return apply_op("quant_matmul", fn, x, qweight, scales, bias)
+
+
+def grouped_matmul(x, weights, group_offsets, scales=None, use_kernel=None):
+    """Ragged grouped GEMM (round-25 MoE expert path): ``out[i] = x[i] @
+    dequant(weights)[g(i)]`` where ``g(i)`` is the group owning row ``i``.
+    ``x [M, K]`` rows pre-sorted by group, ``weights [E, K, N]`` fp /
+    int8 / nibble-packed int4 expert stack, ``group_offsets [E+1]``
+    prefix sum (empty groups allowed), ``scales`` per-expert ``[E, N]``
+    or ``[E, groups, N]`` iff quantized. See
+    ``ops.pallas.grouped_matmul.grouped_matmul``."""
+
+    def fn(v, w, offs, s):
+        from ...ops.pallas.grouped_matmul import grouped_matmul as _gmm
+
+        return _gmm(v, w, offs, scales=s, use_kernel=use_kernel)
+
+    return apply_op("grouped_matmul", fn, x, weights, group_offsets, scales)

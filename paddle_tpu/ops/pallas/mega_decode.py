@@ -134,7 +134,8 @@ def _quantize_rows_f32(x32):
 # ---------------------------------------------------------------------------
 
 
-def validate_mega_config(weight_dtype, group_size, head_dim, mp=1) -> None:
+def validate_mega_config(weight_dtype, group_size, head_dim, mp=1,
+                         moe_experts=0) -> None:
     """Reject geometries the megakernel cannot serve — callers fall back
     to (or stay on) the per-op path with a loud reason instead of
     silently computing something else. ``mp`` is accepted (and ignored)
@@ -142,6 +143,12 @@ def validate_mega_config(weight_dtype, group_size, head_dim, mp=1) -> None:
     kernels emit pre-psum partials and the caller's shard_map completes
     the row-parallel reduction, so no mesh size is rejected here."""
     del mp  # round 22: every mp degree is servable (see the docstring)
+    if moe_experts:
+        raise ValueError(
+            "mega_decode is dense-only: the fused MLP kernel has no "
+            "routed-expert path (moe_experts="
+            f"{moe_experts}) — serve MoE configs through the per-op "
+            "unified step (mega_decode=False)")
     if weight_dtype == "int4":
         raise ValueError(
             "mega_decode does not serve int4 weights: split-half nibble "

@@ -115,6 +115,18 @@ def _quant_matmul_flops(input_shapes, attrs):
     return 2 * prod(x[:-1]) * x[-1] * w[-1]
 
 
+@register_flops("grouped_matmul")
+def _grouped_matmul_flops(input_shapes, attrs):
+    # ragged grouped GEMM: every row of x [M, K] hits exactly one
+    # expert's [K, N] tile — MACs are group-size-independent (2*M*K*N);
+    # the weight stack is [E, K|K/2, N] (int4 may be nibble-packed)
+    x = _first(input_shapes, "Input", "x", "X")
+    w = _first(input_shapes, "W", "weights", "qweight", "Y", "y")
+    if not x or len(w) < 3:
+        return 0
+    return 2 * prod(x[:-1]) * x[-1] * w[-1]
+
+
 @register_flops("weight_quantize")
 def _weight_quantize_flops(input_shapes, attrs):
     # absmax reduce + scale divide + round/clip: ~4 passes over [K, N]

@@ -402,6 +402,29 @@ class TestMoE:
         slot_usage = dispatch.sum(axis=0)  # [E, C]
         assert float(slot_usage.max()) <= 1.0 + 1e-6
 
+    def test_moe_layer_matches_grouped_ffn(self, rng):
+        """The fleet MoELayer (einsum/GShard spelling) computes the SAME
+        function as models.moe.moe_ffn (grouped-GEMM spelling serving
+        uses) — one routing implementation, two dispatch formulations."""
+        from paddle_tpu.distributed.fleet.meta_parallel import MoELayer
+        from paddle_tpu.models.moe import moe_ffn
+
+        paddle.seed(37)
+        moe = MoELayer(d_model=16, d_hidden=32, num_experts=4,
+                       gate="gshard", capacity_factor=1.25)
+        x = rng.randn(3, 8, 16).astype(np.float32)
+        out = moe(paddle.to_tensor(x))
+        ref, aux_ref = moe_ffn(
+            jnp.asarray(x).reshape(-1, 16),
+            moe.gate_weight._data, moe.w1._data, moe.b1._data,
+            moe.w2._data, moe.b2._data,
+            top_k=2, capacity_factor=1.25, use_kernel=False)
+        np.testing.assert_allclose(
+            out.numpy().reshape(-1, 16), np.asarray(ref),
+            rtol=2e-5, atol=2e-6)
+        np.testing.assert_allclose(
+            float(moe.aux_loss), float(aux_ref), rtol=1e-6)
+
 
 class TestRecompute:
     def test_recompute_grads_match(self, rng):

@@ -1,0 +1,241 @@
+"""Round-25 ragged grouped GEMM: the MoE expert-FFN Pallas kernel
+(interpret mode on CPU) vs the jnp segment-matmul oracle across fp /
+int8 / packed-int4 weights and ragged group layouts — empty experts,
+all-tokens-one-expert, odd group sizes; the custom VJP; jit replay; and
+the incubate surface routing.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.ops.pallas.grouped_matmul import (
+    dequantize_grouped_weight, grouped_matmul, grouped_matmul_reference,
+    token_group_ids)
+from paddle_tpu.ops.pallas.quant_matmul import pack_int4
+
+E, K, N = 4, 64, 128                     # kernel-eligible: n%128, k%32
+
+
+def _quantize_stack(w, bits=8, group=-1):
+    """Per-expert symmetric quantizer ([E, K, N] -> q stack + scales)."""
+    qmax = 127.0 if bits == 8 else 7.0
+    e, k, n = w.shape
+    g = k if group in (-1, None) else group
+    absmax = np.maximum(np.abs(w).reshape(e, k // g, g, n).max(2), 1e-8)
+    s = (absmax / qmax).astype(np.float32)             # [E, groups, N]
+    q = np.clip(np.round(w / np.repeat(s, g, axis=1)),
+                -qmax, qmax).astype(np.int8)
+    if bits == 4:
+        q = np.asarray(jax.vmap(pack_int4)(jnp.asarray(q)))
+    return q, (s[:, 0, :] if s.shape[1] == 1 else s)
+
+
+def _offsets(counts):
+    return jnp.asarray(np.concatenate([[0], np.cumsum(counts)]), jnp.int32)
+
+
+RAGGED_SWEEP = [
+    pytest.param([7, 0, 12, 5], id="empty-middle"),
+    pytest.param([0, 0, 24, 0], id="all-one-expert"),
+    pytest.param([1, 3, 13, 7], id="odd-sizes"),
+    pytest.param([0, 0, 0, 0], id="no-tokens"),
+    pytest.param([33, 1, 0, 2], id="over-tile"),      # group > bm row tile
+]
+
+
+# -- fp weights -------------------------------------------------------------
+
+
+@pytest.mark.parametrize("counts", RAGGED_SWEEP)
+def test_fp_kernel_matches_oracle(rng, counts):
+    m = int(sum(counts))
+    x = jnp.asarray(rng.randn(m, K), jnp.float32)
+    w = jnp.asarray(rng.randn(E, K, N).astype(np.float32) * 0.1)
+    offs = _offsets(counts)
+    got = grouped_matmul(x, w, offs, use_kernel=True)
+    ref = grouped_matmul_reference(x, w, offs)
+    assert got.shape == (m, N)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_oracle_is_segment_matmul(rng):
+    """The reference really is out[i] = x[i] @ w[g(i)] row by row."""
+    counts = [3, 0, 5, 2]
+    m = sum(counts)
+    x = rng.randn(m, K).astype(np.float32)
+    w = rng.randn(E, K, N).astype(np.float32) * 0.1
+    offs = _offsets(counts)
+    ref = np.asarray(grouped_matmul_reference(
+        jnp.asarray(x), jnp.asarray(w), offs))
+    gid = np.asarray(token_group_ids(offs, m))
+    for i in range(m):
+        np.testing.assert_allclose(ref[i], x[i] @ w[gid[i]],
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_token_group_ids_raggedness():
+    offs = _offsets([2, 0, 3, 1])
+    np.testing.assert_array_equal(
+        np.asarray(token_group_ids(offs, 6)), [0, 0, 2, 2, 2, 3])
+
+
+# -- quantized weights ------------------------------------------------------
+
+
+@pytest.mark.parametrize("counts", RAGGED_SWEEP)
+@pytest.mark.parametrize("group", [-1, 32])
+def test_int8_kernel_bit_matches_oracle(rng, counts, group):
+    """Single-k-tile int8: kernel and oracle share the exact dequant
+    arithmetic — bit-identical outputs, not just close."""
+    m = int(sum(counts))
+    w = rng.randn(E, K, N).astype(np.float32) * 0.1
+    q, s = _quantize_stack(w, bits=8, group=group)
+    x = jnp.asarray(rng.randn(m, K), jnp.float32)
+    offs = _offsets(counts)
+    got = grouped_matmul(x, jnp.asarray(q), offs, scales=jnp.asarray(s),
+                         use_kernel=True)
+    ref = grouped_matmul_reference(x, jnp.asarray(q), offs,
+                                   scales=jnp.asarray(s))
+    if group == -1:
+        # per-channel = one scale row = one dequant spelling: BIT-exact
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+    else:
+        # per-group scales apply inside the k accumulation — same math,
+        # different fp summation order
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=3e-4, atol=2e-6)
+    # and both track the fp weights they quantized from
+    fp = grouped_matmul_reference(x, jnp.asarray(w), offs)
+    if m:
+        err = np.abs(np.asarray(got) - np.asarray(fp)).max()
+        assert err < 0.5
+
+
+def test_int4_kernel_matches_oracle(rng):
+    counts = [9, 0, 14, 3]
+    m = sum(counts)
+    w = rng.randn(E, K, N).astype(np.float32) * 0.1
+    q, s = _quantize_stack(w, bits=4, group=32)
+    x = jnp.asarray(rng.randn(m, K), jnp.float32)
+    offs = _offsets(counts)
+    got = grouped_matmul(x, jnp.asarray(q), offs, scales=jnp.asarray(s),
+                         use_kernel=True)
+    ref = grouped_matmul_reference(x, jnp.asarray(q), offs,
+                                   scales=jnp.asarray(s))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_dequantize_grouped_roundtrip(rng):
+    w = rng.randn(E, K, N).astype(np.float32) * 0.1
+    q, s = _quantize_stack(w, bits=8, group=16)
+    wd = dequantize_grouped_weight(jnp.asarray(q), jnp.asarray(s), k=K)
+    assert wd.shape == (E, K, N)
+    assert float(np.abs(np.asarray(wd) - w).max()) < 5e-3
+
+
+def test_scales_required_iff_quantized(rng):
+    x = jnp.zeros((4, K), jnp.float32)
+    offs = _offsets([4, 0, 0, 0])
+    wq = jnp.zeros((E, K, N), jnp.int8)
+    wf = jnp.zeros((E, K, N), jnp.float32)
+    with pytest.raises(ValueError):
+        grouped_matmul(x, wq, offs)                   # quantized, no scales
+    with pytest.raises(ValueError):
+        grouped_matmul(x, wf, offs, scales=jnp.ones((E, N)))
+
+
+# -- custom VJP -------------------------------------------------------------
+
+
+def test_vjp_dx_matches_oracle_grad(rng):
+    counts = [5, 0, 9, 2]
+    m = sum(counts)
+    w = rng.randn(E, K, N).astype(np.float32) * 0.1
+    q, s = _quantize_stack(w, bits=8)
+    x = jnp.asarray(rng.randn(m, K), jnp.float32)
+    offs = _offsets(counts)
+    cot = jnp.asarray(rng.randn(m, N), jnp.float32)
+
+    def loss_k(v):
+        return jnp.sum(grouped_matmul(v, jnp.asarray(q), offs,
+                                      scales=jnp.asarray(s),
+                                      use_kernel=True) * cot)
+
+    def loss_r(v):
+        return jnp.sum(grouped_matmul_reference(
+            v, jnp.asarray(q), offs, scales=jnp.asarray(s)) * cot)
+
+    np.testing.assert_allclose(np.asarray(jax.grad(loss_k)(x)),
+                               np.asarray(jax.grad(loss_r)(x)),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_vjp_dw_float_weights(rng):
+    """Float expert stacks get a real dw (segment outer-product)."""
+    counts = [3, 0, 4, 1]
+    m = sum(counts)
+    x = jnp.asarray(rng.randn(m, K), jnp.float32)
+    w = jnp.asarray(rng.randn(E, K, N).astype(np.float32) * 0.1)
+    offs = _offsets(counts)
+
+    dw_k = jax.grad(lambda wv: jnp.sum(
+        grouped_matmul(x, wv, offs, use_kernel=True) ** 2))(w)
+    dw_r = jax.grad(lambda wv: jnp.sum(
+        grouped_matmul_reference(x, wv, offs) ** 2))(w)
+    np.testing.assert_allclose(np.asarray(dw_k), np.asarray(dw_r),
+                               rtol=2e-5, atol=2e-5)
+    # empty expert 1 accumulates nothing
+    np.testing.assert_array_equal(np.asarray(dw_k[1]), 0.0)
+
+
+# -- jit plumbing -----------------------------------------------------------
+
+
+def test_kernel_inside_jit_no_retrace(rng):
+    w = jnp.asarray(rng.randn(E, K, N).astype(np.float32) * 0.1)
+    calls = [0]
+
+    @jax.jit
+    def f(v, offs):
+        calls[0] += 1
+        return grouped_matmul(v, w, offs, use_kernel=True)
+
+    x = jnp.asarray(rng.randn(16, K), jnp.float32)
+    a = f(x, _offsets([4, 4, 4, 4]))
+    b = f(x + 1.0, _offsets([16, 0, 0, 0]))   # different routing, one trace
+    assert calls[0] == 1
+    assert a.shape == b.shape == (16, N)
+
+
+def test_autotune_noop_off_tpu():
+    from paddle_tpu.ops.pallas.grouped_matmul import autotune_grouped_matmul
+
+    bm, bn, bk = autotune_grouped_matmul(E, 128, K, N)
+    assert N % bn == 0 and K % bk == 0 and bm >= 8
+
+
+# -- incubate surface -------------------------------------------------------
+
+
+def test_incubate_surface_routes_and_differentiates(rng):
+    from paddle_tpu.incubate.nn import functional as F
+
+    counts = [5, 0, 8, 3]
+    m = sum(counts)
+    x = rng.randn(m, K).astype(np.float32)
+    w = rng.randn(E, K, N).astype(np.float32) * 0.1
+    offs = np.concatenate([[0], np.cumsum(counts)]).astype(np.int32)
+
+    xt = paddle.to_tensor(x, stop_gradient=False)
+    out = F.grouped_matmul(xt, paddle.to_tensor(w), paddle.to_tensor(offs))
+    ref = grouped_matmul_reference(jnp.asarray(x), jnp.asarray(w),
+                                   jnp.asarray(offs))
+    np.testing.assert_allclose(out.numpy(), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+    out.sum().backward()
+    assert xt.grad is not None and xt.grad.shape == [m, K]

@@ -1077,6 +1077,24 @@ spec("quant_matmul",
      diff=(0, 3))
 
 
+def _gmm_oracle(x, w, offs):
+    gid = np.searchsorted(offs[1:], np.arange(x.shape[0]), side="right")
+    return np.stack([x[i] @ w[gid[i]] for i in range(x.shape[0])])
+
+
+# round 25: the ragged grouped GEMM (MoE expert dispatch) — fp weights
+# through the incubate surface; kernel/int8/int4 parity is
+# tests/test_grouped_matmul.py's job
+spec("grouped_matmul",
+     lambda x, w, offs: _nnq.grouped_matmul(x, w, offs),
+     lambda rng: [
+         rng.randn(10, 16).astype("float32"),
+         (rng.randn(3, 16, 8) * 0.1).astype("float32"),
+         np.asarray([0, 4, 4, 10], dtype="int32"),
+     ],
+     oracle=_gmm_oracle, diff=(0, 1))
+
+
 _SKIP_GROUPS = {
     "stochastic op (seeded reproducibility + distribution checks in tests/test_op_stochastic.py)": [
         "bernoulli", "binomial", "dropout", "alpha_dropout", "gaussian",

@@ -2796,3 +2796,166 @@ def test_bench_serve_spec_model_leg_gates():
     assert tel["serving_draft_model_steps"] > 0
     assert tel["serving_draft_tokens_proposed{source=model}"] > 0
     assert tel["serving_spec_async_deferred_steps"] > 0
+
+
+# -- round 25: MoE serving -------------------------------------------------
+# The routed-expert FFN serves through the SAME unified step as dense
+# (per-op path; mega stays dense-only and rejects loudly). Greedy decode
+# must equal the no-cache full-forward oracle token-for-token — fp AND
+# int8w (the expert stacks quantize per expert; _oracle_greedy over a
+# dequantized-weights model is the int8w golden). Capacity drops are
+# deterministic, and the async engine stays stream-identical.
+
+MOE = dict(moe_experts=4, moe_top_k=2, moe_capacity_factor=4.0)
+# capacity_factor == num_experts -> capacity >= all tokens: ZERO drops, so
+# the per-decode-batch capacity race can't diverge from the full-context
+# oracle's (routing is per-token; capacity is the only cross-token term).
+
+
+def test_moe_predictor_matches_full_forward_oracle(rng):
+    """THE round-25 acceptance gate (fp): MoE greedy via ServingPredictor
+    == the eager full-forward oracle token-for-token."""
+    model = _tiny_model(**MOE)
+    ids = rng.randint(0, TINY["vocab_size"], (2, 9)).astype(np.int64)
+    want = _oracle_greedy(model, ids, 8)
+    sp = ServingPredictor(model, max_batch=2, page_size=8, max_seq_len=64)
+    got = sp.generate([r.tolist() for r in ids], max_new_tokens=8)
+    np.testing.assert_array_equal(np.asarray(got), want)
+    assert sp.decode_trace_count == 1          # ONE unified program
+
+
+def test_moe_generate_matches_oracle(rng):
+    """model.generate (paged path) hits the same golden."""
+    model = _tiny_model(**MOE)
+    ids = rng.randint(0, TINY["vocab_size"], (2, 7)).astype(np.int64)
+    want = _oracle_greedy(model, ids, 6)
+    got = model.generate(paddle.to_tensor(ids), max_new_tokens=6,
+                         page_size=8).numpy()
+    np.testing.assert_array_equal(got, want)
+
+
+def _dequantized_clone(model, weight_dtype="int8", group_size=-1):
+    """Clone-in-place oracle prep: replace every stack the serving
+    conversion quantizes (wqkv/wo + the MoE expert w1/w2) with its
+    quantize->dequantize fp image, so the eager full-forward computes
+    exactly what the quantized serving step computes."""
+    import jax
+
+    from paddle_tpu.nn.quant import _qmax, _weight_quantize_fn
+    from paddle_tpu.ops.pallas.quant_matmul import dequantize_weight
+
+    def deq(w):
+        fn = lambda v: _weight_quantize_fn(
+            v, qmax=_qmax(f"weight_only_{weight_dtype}"),
+            int4=weight_dtype == "int4", group_size=group_size)
+        if w.ndim == 3:                        # [E, K, N] expert stack
+            q, s = jax.vmap(fn)(w)
+            return jax.vmap(lambda qq, ss: dequantize_weight(
+                qq, ss, out_dtype=w.dtype))(q, s)
+        q, s = fn(w)
+        return dequantize_weight(q, s, out_dtype=w.dtype)
+
+    gpt = model.gpt if hasattr(model, "gpt") else model
+    for l in gpt.layers:
+        l.attn.qkv_proj.weight._data = deq(l.attn.qkv_proj.weight._data)
+        l.attn.out_proj.weight._data = deq(l.attn.out_proj.weight._data)
+        l.mlp.w1._data = deq(l.mlp.w1._data)
+        l.mlp.w2._data = deq(l.mlp.w2._data)
+    return model
+
+
+def test_moe_predictor_int8w_matches_dequantized_oracle(rng):
+    """THE round-25 acceptance gate (int8w): quantized-expert MoE greedy
+    == the full-forward oracle over the dequantized weights,
+    token-for-token (per-channel int8 dequant is one fp spelling)."""
+    model = _tiny_model(**MOE)
+    ids = rng.randint(0, TINY["vocab_size"], (2, 9)).astype(np.int64)
+    want = _oracle_greedy(_dequantized_clone(_tiny_model(**MOE)), ids, 8)
+    model.config.weight_dtype = "int8"
+    try:
+        sp = ServingPredictor(model, max_batch=2, page_size=8,
+                              max_seq_len=64)
+        got = sp.generate([r.tolist() for r in ids], max_new_tokens=8)
+        np.testing.assert_array_equal(np.asarray(got), want)
+    finally:
+        model.config.weight_dtype = None
+
+
+def test_moe_sampled_stream_identical_sync_async(rng):
+    """Seeded-sampled MoE streams: the async engine reproduces the sync
+    engine token-for-token (greedy AND sampled) over churn."""
+    prompts = _churn_prompts(rng, 6, max_len=12)
+    kw = dict(max_batch=3, max_seq_len=64, page_size=8, chunk=8)
+    for sampling in ({}, dict(temperature=0.8, top_k=12, seed=11)):
+        model = _tiny_model(**MOE)
+        want = ServingPredictor(model, async_engine=False, **kw).generate(
+            prompts, max_new_tokens=8, **sampling)
+        got = ServingPredictor(model, async_engine=True, **kw).generate(
+            prompts, max_new_tokens=8, **sampling)
+        assert got == want, f"moe async divergence ({sampling})"
+
+
+def test_moe_capacity_drop_determinism(rng):
+    """With a TIGHT capacity (drops happening), two fresh predictors
+    produce identical streams — routing tie-breaks and the capacity race
+    are deterministic, never dependent on engine warmup state."""
+    prompts = _churn_prompts(rng, 5, max_len=14)
+    kw = dict(max_batch=2, max_seq_len=64, page_size=8, chunk=8)
+    runs = []
+    for _ in range(2):
+        model = _tiny_model(**{**MOE, "moe_capacity_factor": 0.5})
+        runs.append(ServingPredictor(model, **kw).generate(
+            prompts, max_new_tokens=8))
+    assert runs[0] == runs[1]
+
+
+def test_moe_mega_rejected_loudly():
+    """mega_decode stays dense-only: composing it with moe_experts fails
+    at build time with a message naming the conflict, not a silent
+    dense fallback."""
+    model = _tiny_model(**MOE, mega_decode=True)
+    with pytest.raises(ValueError, match="dense-only"):
+        ServingPredictor(model, max_batch=2, max_seq_len=64)
+
+
+def test_moe_legacy_two_jit_path_rejected():
+    """The pre-unified builders predate the MoE FFN path — they refuse
+    rather than serving a dense approximation."""
+    model = _tiny_model(**MOE)
+    with pytest.raises(ValueError, match="[Mm]oE|moe"):
+        ServingPredictor(model, max_batch=2, unified=False)
+
+
+def test_bench_serve_moe_leg_gates():
+    """The round-25 bench acceptance (via --legs, the tier-1 smoke
+    subset selector): the dense-vs-MoE interleaved A/B emits ONE
+    schema-checked line carrying the router-health keys —
+    expert_load_imbalance (>= 1 by construction), router_drop_rate
+    (in [0, 1] at the production 1.25 capacity factor),
+    active_params_frac (< 1: top-2 of 4 experts) — the paired dense
+    tokens/s as the efficiency anchor, and a static-vs-analytic HBM
+    drift inside the JX007 tolerance (the top_k/E expert-stack scaling
+    applied on BOTH model sides)."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, "bench_serve.py", "--smoke", "--steps=6",
+         "--batch=2", "--prompt=8", "--gen-len=3",
+         "--legs=moe-churn"],
+        cwd=root, capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [l for l in proc.stdout.strip().splitlines() if l.strip()]
+    assert len(lines) == 1, proc.stdout
+    rec = json.loads(lines[0])
+    assert "error" not in rec, rec
+    assert rec["leg"] == "moe-churn"
+    assert rec["value"] > 0 and rec["dense_tokens_per_s"] > 0
+    assert rec["decode_retraces"] == 1        # ONE routed program
+    # the router-health contract: the keys must be LIVE, not defaulted
+    assert rec["expert_load_imbalance"] >= 1.0
+    assert 0.0 <= rec["router_drop_rate"] <= 1.0
+    assert 0.0 < rec["active_params_frac"] < 1.0
+    # the acceptance criterion: both HBM models scale the expert stacks
+    # by top_k/E and agree within the serving-moe-step contract
+    assert rec["hbm_bytes_per_token_static"] > 0
+    assert abs(rec["hbm_model_drift_frac"]) <= 0.02
